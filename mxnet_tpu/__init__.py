@@ -1,0 +1,58 @@
+"""mxnet_tpu: a TPU-native deep learning framework with MXNet's user surface.
+
+This is NOT a port of Apache MXNet — it is a from-scratch framework built on
+JAX/XLA/Pallas that exposes the same capabilities the reference
+(juanluisrosaramos/incubator-mxnet) ships: imperative NDArray with contexts,
+autograd, a lazy Symbol graph, Gluon (Blocks, Trainer, data), KVStore-style
+distributed training, optimizers/metrics/initializers, and a model zoo.
+
+Conventions:
+  import mxnet_tpu as mx
+  x = mx.nd.zeros((2, 3), ctx=mx.tpu())
+
+Architecture (see SURVEY.md §1): NDArray wraps `jax.Array`; imperative ops are
+XLA primitives dispatched asynchronously; `HybridBlock.hybridize()` compiles
+the forward to a single XLA executable via `jax.jit`; distributed training
+lowers KVStore push/pull to `psum`/`all_gather` over a `jax.sharding.Mesh`.
+"""
+
+__version__ = "0.1.0"
+
+from . import base
+from .base import MXNetError
+from . import context
+from .context import Context, cpu, gpu, tpu, current_context, num_gpus, num_tpus
+from . import engine
+from . import ndarray
+from . import ndarray as nd
+from .ndarray import NDArray
+from . import random
+from . import autograd
+from . import initializer
+from .initializer import init
+from . import optimizer
+from .optimizer import opt
+from . import lr_scheduler
+from . import metric
+from . import io
+from . import image
+from . import kvstore
+from .kvstore import KVStore
+from . import gluon
+from . import symbol
+from . import symbol as sym
+from . import module
+from . import module as mod
+from . import callback
+from . import monitor
+from . import profiler
+from . import amp
+from . import visualization as viz
+from . import runtime
+from . import checkpoint
+from . import parallel
+from . import models
+from . import contrib
+from .util import waitall
+
+mon = monitor

@@ -1,0 +1,92 @@
+"""Automatic mixed precision (reference: python/mxnet/contrib/amp).
+
+TPU-native: bf16 is the native MXU dtype (no loss scaling needed, unlike
+fp16 on GPUs), so `init()` casts compute-heavy layers to bfloat16 while
+keeping norms/softmax in fp32. A DynamicLossScaler is provided for fp16
+parity with the reference's amp.scale_loss / amp.unscale API.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+__all__ = ["init", "convert_block", "scale_loss", "unscale",
+           "DynamicLossScaler", "bfloat16"]
+
+bfloat16 = jnp.bfloat16
+
+_CAST_LAYERS = ("Dense", "Conv1D", "Conv2D", "Conv3D", "Conv2DTranspose",
+                "Embedding")
+_KEEP_FP32 = ("BatchNorm", "LayerNorm", "InstanceNorm", "GroupNorm")
+
+_state = {"scaler": None, "initialized": False}
+
+
+def init(target_dtype="bfloat16"):
+    """Enable AMP defaults (reference: amp.init())."""
+    _state["initialized"] = True
+    _state["target_dtype"] = target_dtype
+    if target_dtype == "float16":
+        _state["scaler"] = DynamicLossScaler()
+
+
+def convert_block(block, target_dtype="bfloat16"):
+    """Cast matmul/conv layers to bf16, keep normalisation fp32
+    (reference: amp.convert_hybrid_block)."""
+    def walk(b):
+        name = type(b).__name__
+        if name in _CAST_LAYERS:
+            b.cast(target_dtype)
+        for c in b._children.values():
+            walk(c)
+    walk(block)
+    return block
+
+
+class DynamicLossScaler:
+    """Reference: AMP dynamic loss scaling (fp16 only; bf16 doesn't need it)."""
+
+    def __init__(self, init_scale=2. ** 16, scale_factor=2.0,
+                 scale_window=2000):
+        self.loss_scale = init_scale
+        self.scale_factor = scale_factor
+        self.scale_window = scale_window
+        self._unskipped = 0
+
+    def has_overflow(self, params):
+        for p in params:
+            if p._grad is not None:
+                g = p._grad.asnumpy()
+                if not np.isfinite(g).all():
+                    return True
+        return False
+
+    def update_scale(self, overflow):
+        if overflow:
+            self.loss_scale = max(self.loss_scale / self.scale_factor, 1.0)
+            self._unskipped = 0
+        else:
+            self._unskipped += 1
+            if self._unskipped >= self.scale_window:
+                self.loss_scale *= self.scale_factor
+                self._unskipped = 0
+
+
+def scale_loss(loss, trainer_or_scaler=None):
+    scaler = _state.get("scaler")
+    if scaler is None:
+        return loss
+    return loss * scaler.loss_scale
+
+
+def unscale(grads_or_trainer):
+    scaler = _state.get("scaler")
+    if scaler is None:
+        return
+    inv = 1.0 / scaler.loss_scale
+    params = grads_or_trainer._params if hasattr(grads_or_trainer, "_params") \
+        else grads_or_trainer
+    for p in params:
+        if getattr(p, "_grad", None) is not None:
+            p._grad._rebind(p._grad._data * inv)

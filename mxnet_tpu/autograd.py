@@ -1,0 +1,341 @@
+"""Imperative autograd: record / pause / train_mode / backward / grad.
+
+Reference parity: python/mxnet/autograd.py + src/imperative/imperative.cc.
+
+TPU-native design: instead of the reference's C++ gradient tape with per-op
+registered backward kernels, recording builds a lightweight Python tape of
+(pure_fn, inputs, kwargs) nodes. `backward()` replays the tape as a *pure
+function of the leaf arrays* and differentiates it with `jax.vjp`, so every
+backward rule is XLA-generated — no hand-written backward kernels, and the
+whole backward pass is fused/compiled by XLA like any other JAX program.
+
+Mutation interplay: in-place NDArray ops rebind the underlying buffer and
+re-register the new value on the tape, so each SSA version is a distinct tape
+value (the reference enforces the same property via var version counters in
+the ThreadedEngine).
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+import numpy as np
+
+from .base import MXNetError
+
+__all__ = ["record", "pause", "train_mode", "predict_mode", "is_recording",
+           "is_training", "mark_variables", "backward", "grad", "get_symbol"]
+
+_state = threading.local()
+
+
+def _st():
+    if not hasattr(_state, "recording"):
+        _state.recording = False
+        _state.training = False
+        _state.tape = None
+        _state.last_tape = None
+    return _state
+
+
+class _TapeNode:
+    __slots__ = ("fn", "kwargs", "inputs", "n_out")
+
+    def __init__(self, fn, kwargs, inputs, n_out):
+        self.fn = fn            # pure: (*jax_arrays, **kwargs) -> array | tuple
+        self.kwargs = kwargs
+        self.inputs = inputs    # list of ('node', idx, slot)|('leaf', idx)|('const', val)
+        self.n_out = n_out
+
+
+class _Tape:
+    def __init__(self):
+        self.nodes = []
+        self.leaves = []        # NDArray objects with grads attached
+        self._leaf_ids = {}
+
+    def leaf_index(self, arr):
+        key = id(arr)
+        if key not in self._leaf_ids:
+            self._leaf_ids[key] = len(self.leaves)
+            self.leaves.append(arr)
+        return self._leaf_ids[key]
+
+    # -- replay -----------------------------------------------------------
+    def replay(self, leaf_values, want_entries):
+        """Pure replay: leaf_values -> values at `want_entries`."""
+        outs = []
+        for node in self.nodes:
+            args = [self._resolve(e, leaf_values, outs) for e in node.inputs]
+            val = node.fn(*args, **node.kwargs)
+            outs.append(val if isinstance(val, tuple) else (val,))
+        return tuple(self._resolve(e, leaf_values, outs) for e in want_entries)
+
+    @staticmethod
+    def _resolve(entry, leaf_values, node_outs):
+        kind = entry[0]
+        if kind == "node":
+            return node_outs[entry[1]][entry[2]]
+        if kind == "leaf":
+            return leaf_values[entry[1]]
+        return entry[1]  # const
+
+
+# ---------------------------------------------------------------------------
+# recording scopes
+# ---------------------------------------------------------------------------
+class _RecordingScope:
+    """Sets recording/training flags on enter, restores them on exit.
+
+    A scope that *starts* recording creates the tape; when that outermost
+    scope exits, the finished tape is stashed in `last_tape` so that
+    `backward()` can run after the `with` block (reference behaviour)."""
+
+    def __init__(self, recording, training):
+        self._rec = recording
+        self._train = training
+        self._created_tape = False
+
+    def __enter__(self):
+        st = _st()
+        self._prev = (st.recording, st.training)
+        if self._rec is not None:
+            st.recording = self._rec
+            if self._rec and st.tape is None:
+                st.tape = _Tape()
+                self._created_tape = True
+        if self._train is not None:
+            st.training = self._train
+        return self
+
+    def __exit__(self, *exc):
+        st = _st()
+        st.recording, st.training = self._prev
+        if self._created_tape:
+            st.last_tape = st.tape
+            st.tape = None
+
+
+def record(train_mode=True):
+    """Scope in which imperative ops are recorded for backward().
+
+    with autograd.record():
+        y = net(x)
+    y.backward()
+    """
+    return _RecordingScope(True, train_mode)
+
+
+def pause(train_mode=False):
+    """Scope in which recording (and optionally training mode) is paused.
+    The enclosing tape is kept; nested record() resumes onto it."""
+    return _RecordingScope(False, train_mode)
+
+
+def train_mode():
+    """Scope forcing training mode (dropout active) without recording."""
+    return _RecordingScope(None, True)
+
+
+def predict_mode():
+    """Scope forcing inference mode."""
+    return _RecordingScope(None, False)
+
+
+def is_recording():
+    return _st().recording
+
+
+def is_training():
+    return _st().training
+
+
+def set_recording(is_record):
+    st = _st()
+    prev, st.recording = st.recording, is_record
+    if is_record and st.tape is None:
+        st.tape = _Tape()
+    return prev
+
+
+def set_training(train_mode):
+    st = _st()
+    prev, st.training = st.training, train_mode
+    return prev
+
+
+# ---------------------------------------------------------------------------
+# tape construction (called from ndarray op dispatch)
+# ---------------------------------------------------------------------------
+def _entry_for(tape, nd):
+    ref = getattr(nd, "_tape_ref", None)
+    if ref is not None and ref[0] is tape:
+        return ref[1]
+    if getattr(nd, "_grad", None) is not None or getattr(nd, "_grad_req", "null") != "null":
+        entry = ("leaf", tape.leaf_index(nd))
+    else:
+        entry = ("const", nd._data)
+    nd._tape_ref = (tape, entry)
+    return entry
+
+
+def record_op(fn, nd_inputs, kwargs, nd_outputs):
+    """Append one executed op to the active tape (no-op when not recording)."""
+    st = _st()
+    tape = st.tape
+    if tape is None:
+        return
+    inputs = [_entry_for(tape, x) for x in nd_inputs]
+    idx = len(tape.nodes)
+    tape.nodes.append(_TapeNode(fn, kwargs, inputs, len(nd_outputs)))
+    for slot, out in enumerate(nd_outputs):
+        out._tape_ref = (tape, ("node", idx, slot))
+
+
+def mark_variables(variables, gradients, grad_reqs="write"):
+    """Attach gradient buffers to arrays (reference: autograd.mark_variables)."""
+    from .base import _as_list
+    variables = _as_list(variables)
+    gradients = _as_list(gradients)
+    if isinstance(grad_reqs, str):
+        grad_reqs = [grad_reqs] * len(variables)
+    for var, g, req in zip(variables, gradients, grad_reqs):
+        var._grad = g
+        var._grad_req = req
+
+
+# ---------------------------------------------------------------------------
+# backward
+# ---------------------------------------------------------------------------
+def _active_tape():
+    st = _st()
+    tape = st.tape if st.tape is not None else st.last_tape
+    if tape is None:
+        raise MXNetError("backward() called with no recorded computation "
+                         "(wrap the forward in autograd.record())")
+    return tape
+
+
+def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
+    """Compute gradients of `heads` w.r.t. all attached variables on the tape.
+
+    Replays the tape as a pure function of the leaf values and runs jax.vjp;
+    gradients are accumulated into each variable's `.grad` buffer according to
+    its grad_req ('write' overwrites, 'add' accumulates, 'null' skips).
+    """
+    from .base import _as_list
+    from .ndarray import NDArray
+    heads = _as_list(heads)
+    tape = _active_tape()
+
+    head_entries = []
+    for h in heads:
+        ref = getattr(h, "_tape_ref", None)
+        if ref is None or ref[0] is not tape:
+            raise MXNetError("head array was not computed inside the recorded scope")
+        head_entries.append(ref[1])
+
+    leaves = [v for v in tape.leaves if v._grad_req != "null"]
+    if not leaves:
+        return
+    leaf_entry_idx = {id(v): i for i, v in enumerate(tape.leaves)}
+    leaf_values = [v._data for v in tape.leaves]
+
+    def pure(vals):
+        return tape.replay(vals, head_entries)
+
+    _, vjp_fn = jax.vjp(pure, leaf_values)
+    if head_grads is None:
+        cots = tuple(jax.numpy.ones_like(h._data) for h in heads)
+    else:
+        hg = _as_list(head_grads)
+        cots = tuple(
+            (g._data if isinstance(g, NDArray) else jax.numpy.asarray(g))
+            if g is not None else jax.numpy.ones_like(h._data)
+            for h, g in zip(heads, hg))
+    grads = vjp_fn(cots)[0]
+
+    for var in leaves:
+        g = grads[leaf_entry_idx[id(var)]]
+        if var._grad is None:
+            continue
+        if var._grad_req == "add":
+            var._grad._rebind(var._grad._data + g)
+        else:
+            var._grad._rebind(jax.numpy.asarray(g, dtype=var._grad._data.dtype))
+
+    if not retain_graph:
+        st = _st()
+        if st.tape is None:
+            st.last_tape = None
+
+
+def grad(heads, variables, head_grads=None, retain_graph=None,
+         create_graph=False, train_mode=True):
+    """Return gradients of heads w.r.t. variables (reference: autograd.grad).
+
+    create_graph=True is supported by re-recording the gradient computation
+    onto the active tape via the standard op path.
+    """
+    from .base import _as_list
+    from .ndarray import NDArray, _wrap_apply
+    heads = _as_list(heads)
+    variables = _as_list(variables)
+    tape = _active_tape()
+
+    head_entries = []
+    for h in heads:
+        ref = getattr(h, "_tape_ref", None)
+        if ref is None or ref[0] is not tape:
+            raise MXNetError("head array was not computed inside the recorded scope")
+        head_entries.append(ref[1])
+
+    var_entries = []
+    for v in variables:
+        ref = getattr(v, "_tape_ref", None)
+        if ref is not None and ref[0] is tape:
+            var_entries.append(ref[1])
+        else:
+            var_entries.append(("leaf", tape.leaf_index(v)))
+            v._tape_ref = (tape, var_entries[-1])
+
+    # gradient as a pure function of (variable values, other leaf values)
+    leaf_values = [v._data for v in tape.leaves]
+    var_leaf_idx = []
+    for e in var_entries:
+        if e[0] != "leaf":
+            raise MXNetError("autograd.grad targets must be leaf variables "
+                             "(arrays used as inputs, not op outputs)")
+        var_leaf_idx.append(e[1])
+
+    if head_grads is None:
+        cots = tuple(jax.numpy.ones_like(h._data) for h in heads)
+    else:
+        hg = _as_list(head_grads)
+        cots = tuple(g._data if isinstance(g, NDArray) else jax.numpy.asarray(g)
+                     for g in hg)
+
+    def grad_fn(*var_vals):
+        vals = list(leaf_values)
+        for i, vi in enumerate(var_leaf_idx):
+            vals[vi] = var_vals[i]
+
+        def pure(vs):
+            return tape.replay(vs, head_entries)
+
+        _, vjp_fn = jax.vjp(pure, vals)
+        gs = vjp_fn(cots)[0]
+        return tuple(gs[vi] for vi in var_leaf_idx)
+
+    if create_graph:
+        outs = _wrap_apply(grad_fn, variables, {}, n_out=len(variables))
+        return list(outs)
+    with pause():
+        outs = _wrap_apply(grad_fn, variables, {}, n_out=len(variables))
+    return list(outs)
+
+
+def get_symbol(x):
+    """Reference parity stub: the recorded graph is a JAX trace, not an nnvm
+    symbol; returns None (documented divergence)."""
+    return None

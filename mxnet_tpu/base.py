@@ -1,0 +1,47 @@
+"""Base utilities: errors, dtype handling, and the native-runtime bridge.
+
+Reference parity: python/mxnet/base.py (MXNetError, c_api handles). Here the
+"C API" is the optional native dependency engine in cpp/ (loaded via ctypes by
+mxnet_tpu.engine); tensors live in PJRT-managed HBM so no handle table exists.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["MXNetError", "numeric_types", "integer_types", "string_types",
+           "mx_real_t", "_as_list", "_np_dtype"]
+
+
+class MXNetError(RuntimeError):
+    """Error raised by mxnet_tpu — parity with mxnet.base.MXNetError."""
+
+
+numeric_types = (float, int, np.generic)
+integer_types = (int, np.integer)
+string_types = (str,)
+
+mx_real_t = np.float32
+
+
+def _as_list(obj):
+    """Return obj wrapped in a list if it is not already a list/tuple."""
+    if isinstance(obj, (list, tuple)):
+        return list(obj)
+    return [obj]
+
+
+_DTYPE_ALIASES = {
+    "float": np.float32,
+    "double": np.float64,
+    None: np.float32,
+}
+
+
+def _np_dtype(dtype):
+    """Normalise a user-supplied dtype to a numpy dtype object."""
+    if dtype in _DTYPE_ALIASES:
+        return np.dtype(_DTYPE_ALIASES[dtype])
+    import jax.numpy as jnp  # local import: keep base import-light
+    if dtype is jnp.bfloat16 or dtype == "bfloat16":
+        return jnp.bfloat16
+    return np.dtype(dtype)

@@ -1,0 +1,92 @@
+"""Checkpointing (reference: mxnet.model save_checkpoint/load_checkpoint +
+gluon save/load_parameters; distributed resume via Orbax sharded checkpoints).
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from .ndarray.ndarray import NDArray, array
+
+__all__ = ["save_checkpoint", "load_checkpoint", "save_sharded",
+           "load_sharded", "CheckpointManager"]
+
+
+def save_checkpoint(prefix, epoch, symbol=None, arg_params=None,
+                    aux_params=None):
+    """Reference format: prefix-symbol.json + prefix-%04d.params."""
+    if symbol is not None:
+        symbol.save(f"{prefix}-symbol.json")
+    arrays = {}
+    for k, v in (arg_params or {}).items():
+        arrays[f"arg:{k}"] = v.asnumpy()
+    for k, v in (aux_params or {}).items():
+        arrays[f"aux:{k}"] = v.asnumpy()
+    np.savez(f"{prefix}-{epoch:04d}.params.npz", **arrays)
+
+
+def load_checkpoint(prefix, epoch):
+    from . import symbol as sym_mod
+    sym = None
+    if os.path.exists(f"{prefix}-symbol.json"):
+        sym = sym_mod.load(f"{prefix}-symbol.json")
+    arg_params, aux_params = {}, {}
+    with np.load(f"{prefix}-{epoch:04d}.params.npz") as f:
+        for k in f.keys():
+            kind, name = k.split(":", 1)
+            (arg_params if kind == "arg" else aux_params)[name] = array(f[k])
+    return sym, arg_params, aux_params
+
+
+def save_sharded(directory, step, params, _async=False):
+    """Sharded distributed checkpoint via Orbax (multi-host resume path).
+
+    params: pytree of jax arrays (possibly sharded over a Mesh)."""
+    import orbax.checkpoint as ocp
+    path = os.path.abspath(os.path.join(directory, str(step)))
+    ckptr = ocp.StandardCheckpointer()
+    ckptr.save(path, params, force=True)
+    ckptr.wait_until_finished()
+    return path
+
+
+def load_sharded(directory, step, template):
+    import orbax.checkpoint as ocp
+    path = os.path.abspath(os.path.join(directory, str(step)))
+    ckptr = ocp.StandardCheckpointer()
+    return ckptr.restore(path, template)
+
+
+class CheckpointManager:
+    """Step-stamped rolling checkpoints with resume (reference: the
+    epoch-checkpoint callbacks + kvstore resume path)."""
+
+    def __init__(self, directory, max_to_keep=3):
+        self.directory = directory
+        self.max_to_keep = max_to_keep
+        os.makedirs(directory, exist_ok=True)
+
+    def steps(self):
+        out = []
+        for name in os.listdir(self.directory):
+            if name.isdigit():
+                out.append(int(name))
+        return sorted(out)
+
+    def save(self, step, params):
+        path = save_sharded(self.directory, step, params)
+        steps = self.steps()
+        while len(steps) > self.max_to_keep:
+            victim = steps.pop(0)
+            import shutil
+            shutil.rmtree(os.path.join(self.directory, str(victim)),
+                          ignore_errors=True)
+        return path
+
+    def restore_latest(self, template):
+        steps = self.steps()
+        if not steps:
+            return None, None
+        step = steps[-1]
+        return step, load_sharded(self.directory, step, template)

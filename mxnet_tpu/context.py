@@ -1,0 +1,129 @@
+"""Device contexts: mx.cpu() / mx.tpu() / mx.gpu() over JAX devices.
+
+Reference parity: python/mxnet/context.py. The reference maps Context to a
+C++ {dev_type, dev_id} consumed by the storage manager and engine; here a
+Context resolves to a `jax.Device`, and placement happens through
+`jax.device_put` / `jax.default_device`. `mx.gpu()` is accepted as an alias
+for the accelerator so reference scripts run unmodified on TPU.
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+
+from .base import MXNetError
+
+__all__ = ["Context", "cpu", "gpu", "tpu", "current_context", "num_gpus", "num_tpus"]
+
+_context_stack = threading.local()
+
+
+def _accelerator_devices():
+    """All non-CPU JAX devices, or [] if running CPU-only."""
+    devs = jax.devices()
+    return [d for d in devs if d.platform != "cpu"]
+
+
+class Context:
+    """A device context. device_type in {'cpu', 'tpu', 'gpu'}.
+
+    'gpu' is an alias for the accelerator platform (TPU here) so that
+    reference MXNet scripts using mx.gpu(i) map onto TPU chips.
+    """
+
+    devtype2str = {1: "cpu", 2: "tpu", 3: "gpu"}
+    devstr2type = {v: k for k, v in devtype2str.items()}
+
+    def __init__(self, device_type, device_id=0):
+        if isinstance(device_type, Context):
+            device_type, device_id = device_type.device_type, device_type.device_id
+        if device_type not in self.devstr2type:
+            raise MXNetError(f"unknown device type {device_type!r}")
+        self.device_type = device_type
+        self.device_id = int(device_id)
+
+    # -- resolution -------------------------------------------------------
+    @property
+    def jax_device(self):
+        """Resolve to a concrete jax.Device (raises if unavailable)."""
+        if self.device_type == "cpu":
+            try:
+                cpus = jax.devices("cpu")
+            except RuntimeError:
+                cpus = [d for d in jax.devices() if d.platform == "cpu"]
+            if self.device_id < len(cpus):
+                return cpus[self.device_id]
+            raise MXNetError(f"cpu({self.device_id}) not available")
+        accels = _accelerator_devices()
+        if not accels:  # CPU-only process (tests): alias accelerator -> cpu
+            return jax.devices()[min(self.device_id, len(jax.devices()) - 1)]
+        if self.device_id >= len(accels):
+            raise MXNetError(
+                f"{self.device_type}({self.device_id}) not available: "
+                f"{len(accels)} accelerator device(s) visible")
+        return accels[self.device_id]
+
+    # -- protocol ---------------------------------------------------------
+    def __eq__(self, other):
+        return (isinstance(other, Context)
+                and self.device_type == other.device_type
+                and self.device_id == other.device_id)
+
+    def __hash__(self):
+        return hash((self.device_type, self.device_id))
+
+    def __repr__(self):
+        return f"{self.device_type}({self.device_id})"
+
+    __str__ = __repr__
+
+    def __enter__(self):
+        if not hasattr(_context_stack, "stack"):
+            _context_stack.stack = []
+        _context_stack.stack.append(self)
+        return self
+
+    def __exit__(self, *exc):
+        _context_stack.stack.pop()
+
+    @classmethod
+    def default_ctx(cls):
+        accels = _accelerator_devices()
+        return cls("tpu", 0) if accels else cls("cpu", 0)
+
+
+def cpu(device_id=0):
+    """Return a CPU context."""
+    return Context("cpu", device_id)
+
+
+def tpu(device_id=0):
+    """Return a TPU context — the first-class accelerator context
+    (reference: mx.gpu(); BASELINE.json north star: `mx.tpu()`)."""
+    return Context("tpu", device_id)
+
+
+def gpu(device_id=0):
+    """Alias accepted for reference-script compatibility; maps to the
+    accelerator platform (TPU)."""
+    return Context("gpu", device_id)
+
+
+def current_context():
+    """The innermost `with mx.Context(...)` context, else the default
+    (TPU if an accelerator is visible, CPU otherwise)."""
+    stack = getattr(_context_stack, "stack", None)
+    if stack:
+        return stack[-1]
+    return Context.default_ctx()
+
+
+def num_gpus():
+    """Number of accelerator devices (alias of num_tpus for parity)."""
+    return len(_accelerator_devices())
+
+
+def num_tpus():
+    """Number of TPU chips visible to this process."""
+    return len(_accelerator_devices())

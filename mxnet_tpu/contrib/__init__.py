@@ -1,0 +1,18 @@
+"""mx.contrib (reference: python/mxnet/contrib).
+
+Quantization/ONNX are explicitly stubbed (SURVEY.md §2 #49): int8 inference
+and ONNX interchange target GPU/cpu toolchains the reference wraps; on TPU
+the equivalent deployment path is the XLA executable exported by
+HybridBlock.export. Calling these raises with that guidance.
+"""
+from ..base import MXNetError
+
+
+def quantize_model(*args, **kwargs):
+    raise MXNetError("int8 quantization is stubbed on TPU; use bf16 via "
+                     "mxnet_tpu.amp (SURVEY.md §2 #49)")
+
+
+def export_onnx(*args, **kwargs):
+    raise MXNetError("ONNX export is stubbed; deploy the jitted XLA "
+                     "executable via HybridBlock.export (SURVEY.md §2 #49)")

@@ -1,0 +1,132 @@
+"""Execution engine facade (reference: src/engine/threaded_engine.cc).
+
+Two layers:
+  * Device-side op scheduling is owned by XLA/PJRT — JAX dispatch is already
+    asynchronous (ops enqueue on the device stream and Python returns
+    immediately), which is exactly the role MXNet's ThreadedEngine plays for
+    kernels. `wait_to_read`/`waitall` map onto PJRT readiness.
+  * Host-side async work (data pipeline, IO, parameter serialisation) runs on
+    the native C++ dependency engine in cpp/engine.cc when built (see
+    mxnet_tpu/_native.py), with a pure-Python threadpool fallback providing
+    identical semantics: push(fn, read_vars, write_vars) with read/write
+    dependency ordering per variable, wait_for_var, wait_for_all.
+"""
+from __future__ import annotations
+
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+__all__ = ["Var", "push", "wait_for_var", "wait_for_all", "set_bulk_size",
+           "num_workers", "native_engine_loaded"]
+
+
+class Var:
+    """A dependency variable (reference: engine::Var). Ops that write a var
+    are serialised; readers wait for the last writer."""
+    __slots__ = ("_lock", "_last_write", "_reads")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._last_write = None       # Future of last writer
+        self._reads = []              # Futures of readers since last write
+
+
+class _PyEngine:
+    def __init__(self, workers=4):
+        self._pool = ThreadPoolExecutor(max_workers=workers,
+                                        thread_name_prefix="mxtpu-engine")
+        self._pending = set()
+        self._plock = threading.Lock()
+        self.workers = workers
+
+    def push(self, fn, read_vars=(), write_vars=()):
+        deps = []
+        for v in read_vars:
+            with v._lock:
+                if v._last_write is not None:
+                    deps.append(v._last_write)
+        for v in write_vars:
+            with v._lock:
+                if v._last_write is not None:
+                    deps.append(v._last_write)
+                deps.extend(v._reads)
+
+        def task():
+            for d in deps:
+                d_exc = d.exception()
+                if d_exc is not None:
+                    raise d_exc
+            return fn()
+
+        fut = self._pool.submit(task)
+        with self._plock:
+            self._pending.add(fut)
+        fut.add_done_callback(lambda f: self._pending.discard(f))
+        for v in read_vars:
+            with v._lock:
+                v._reads.append(fut)
+        for v in write_vars:
+            with v._lock:
+                v._last_write = fut
+                v._reads = []
+        return fut
+
+    def wait_for_var(self, var):
+        with var._lock:
+            futs = list(var._reads)
+            if var._last_write is not None:
+                futs.append(var._last_write)
+        for f in futs:
+            f.result()
+
+    def wait_for_all(self):
+        with self._plock:
+            futs = list(self._pending)
+        for f in futs:
+            f.result()
+
+
+_engine = None
+_native = None
+
+
+def _get():
+    global _engine, _native
+    if _engine is None:
+        try:
+            from ._native import NativeEngine
+            _engine = NativeEngine()
+            _native = True
+        except Exception:
+            _engine = _PyEngine()
+            _native = False
+    return _engine
+
+
+def native_engine_loaded():
+    _get()
+    return bool(_native)
+
+
+def push(fn, read_vars=(), write_vars=()):
+    """Schedule fn after its dependencies (reference: Engine::PushAsync)."""
+    return _get().push(fn, read_vars, write_vars)
+
+
+def wait_for_var(var):
+    _get().wait_for_var(var)
+
+
+def wait_for_all():
+    _get().wait_for_all()
+    from .ndarray.ndarray import waitall
+    waitall()
+
+
+def set_bulk_size(size):
+    """Reference: Engine::SetBulkSize — XLA fuses op bulks itself; no-op."""
+    return size
+
+
+def num_workers():
+    return getattr(_get(), "workers", 1)

@@ -1,0 +1,11 @@
+"""mx.gluon — the imperative/hybrid high-level API (reference: python/mxnet/gluon)."""
+from .parameter import Parameter, Constant, ParameterDict
+from .block import Block, HybridBlock, SymbolBlock
+from .trainer import Trainer
+from . import nn
+from . import rnn
+from . import loss
+from . import data
+from . import utils
+from . import model_zoo
+from .utils import split_and_load, split_data, clip_global_norm

@@ -1,0 +1,472 @@
+"""Gluon Block / HybridBlock (reference: python/mxnet/gluon/block.py).
+
+TPU-native hybridization: the reference's `hybridize()` builds an nnvm graph
+executed by CachedOp. Here `hybridize()` traces the block's forward —
+*all* descendant parameters become function inputs — into one pure function
+`(params, rng, *inputs) -> outputs` and compiles it with `jax.jit`, producing
+a single XLA executable (the StableHLO module of BASELINE.json's north star).
+The jitted callable is then recorded as ONE op on the autograd tape, so
+backward differentiates the whole block as a fused unit via `jax.vjp`.
+
+Mutable aux state (BatchNorm running stats) is handled functionally: during
+tracing, layers report aux updates to the active trace context; the updates
+become extra outputs of the compiled function and are written back to the
+parameters after each call (the reference mutates aux arrays in-place from
+inside CachedOp — same semantics, functional mechanics).
+"""
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+
+import jax
+import numpy as np
+
+from ..base import MXNetError, _as_list
+from .. import autograd
+from .. import random as _random
+from ..ndarray.ndarray import NDArray, _apply
+from .parameter import Parameter, ParameterDict, DeferredInitializationError
+
+__all__ = ["Block", "HybridBlock", "SymbolBlock"]
+
+
+# ---------------------------------------------------------------------------
+# naming
+# ---------------------------------------------------------------------------
+class _NameManager:
+    _lock = threading.Lock()
+    _counters = {}
+
+    @classmethod
+    def get(cls, hint):
+        with cls._lock:
+            n = cls._counters.get(hint, 0)
+            cls._counters[hint] = n + 1
+        return f"{hint}{n}"
+
+
+class _BlockScope:
+    """Hierarchical name scoping (reference: _BlockScope)."""
+    _current = threading.local()
+
+    def __init__(self, block):
+        self._block = block
+        self._counter = {}
+        self._old = None
+
+    @staticmethod
+    def create(prefix, params, hint):
+        current = getattr(_BlockScope._current, "value", None)
+        if current is None:
+            if prefix is None:
+                prefix = _NameManager.get(hint) + "_"
+            full_params = ParameterDict(prefix, params)
+            return prefix, full_params
+        if prefix is None:
+            count = current._counter.get(hint, 0)
+            current._counter[hint] = count + 1
+            prefix = f"{hint}{count}_"
+        full_prefix = current._block.prefix + prefix
+        full_params = ParameterDict(full_prefix, params)
+        return full_prefix, full_params
+
+    def __enter__(self):
+        if self._block.prefix:
+            self._old = getattr(_BlockScope._current, "value", None)
+            _BlockScope._current.value = self
+        return self
+
+    def __exit__(self, *exc):
+        if self._block.prefix:
+            _BlockScope._current.value = self._old
+
+
+# ---------------------------------------------------------------------------
+# trace context for hybridized execution
+# ---------------------------------------------------------------------------
+class _TraceContext:
+    _current = threading.local()
+
+    def __init__(self, rng_key):
+        self._rng = rng_key
+        self.aux_updates = []      # list of (Parameter, tracer)
+
+    def next_rng(self):
+        self._rng, sub = jax.random.split(self._rng)
+        return sub
+
+    @staticmethod
+    def active():
+        return getattr(_TraceContext._current, "value", None)
+
+    def __enter__(self):
+        self._old = _TraceContext.active()
+        _TraceContext._current.value = self
+        return self
+
+    def __exit__(self, *exc):
+        _TraceContext._current.value = self._old
+
+
+def _layer_rng():
+    """Per-op RNG key: trace-aware (functional input) or global chain."""
+    ctx = _TraceContext.active()
+    if ctx is not None:
+        return ctx.next_rng()
+    return _random._next_key()
+
+
+def _report_aux_update(param, new_value):
+    """Layers call this to update aux state (running stats). Inside a trace
+    the update becomes a function output; eagerly it rebinds immediately."""
+    ctx = _TraceContext.active()
+    if ctx is not None:
+        ctx.aux_updates.append((param, new_value))
+    else:
+        param._data._rebind(new_value._data if isinstance(new_value, NDArray)
+                            else new_value)
+
+
+# ---------------------------------------------------------------------------
+# Block
+# ---------------------------------------------------------------------------
+class Block:
+    """Base building block. Subclasses implement forward(*args)."""
+
+    def __init__(self, prefix=None, params=None):
+        self._empty_prefix = prefix == ""
+        self._prefix, self._params = _BlockScope.create(
+            prefix, params, self._alias())
+        self._name = self._prefix[:-1] if self._prefix.endswith("_") \
+            else self._prefix
+        self._scope = _BlockScope(self)
+        self._children = OrderedDict()
+        self._reg_params = {}
+        self._forward_hooks = []
+        self._forward_pre_hooks = []
+
+    def _alias(self):
+        return self.__class__.__name__.lower()
+
+    # -- attribute magic: auto-register children & params -----------------
+    def __setattr__(self, name, value):
+        if isinstance(value, Block):
+            existing = self.__dict__.get("_children")
+            if existing is not None:
+                existing[name] = value
+        elif isinstance(value, Parameter):
+            reg = self.__dict__.get("_reg_params")
+            if reg is not None:
+                reg[name] = value
+        super().__setattr__(name, value)
+
+    @property
+    def prefix(self):
+        return self._prefix
+
+    @property
+    def name(self):
+        return self._name
+
+    @property
+    def params(self):
+        return self._params
+
+    def name_scope(self):
+        return self._scope
+
+    # -- parameter management ---------------------------------------------
+    def collect_params(self, select=None):
+        """All parameters of self and descendants, optionally regex-filtered."""
+        ret = ParameterDict(self._params.prefix)
+        if select is None:
+            ret.update(self._params)
+        else:
+            import re
+            pat = re.compile(select)
+            ret.update({k: v for k, v in self._params.items() if pat.match(k)})
+        for child in self._children.values():
+            ret.update(child.collect_params(select))
+        return ret
+
+    def initialize(self, init=None, ctx=None, verbose=False,
+                   force_reinit=False):
+        self.collect_params().initialize(init, ctx, verbose, force_reinit)
+        return self
+
+    def cast(self, dtype):
+        for child in self._children.values():
+            child.cast(dtype)
+        for p in self._params.values():
+            p.cast(dtype)
+        return self
+
+    def apply(self, fn):
+        for child in self._children.values():
+            child.apply(fn)
+        fn(self)
+        return self
+
+    def register_child(self, block, name=None):
+        self._children[name or str(len(self._children))] = block
+
+    def register_forward_hook(self, hook):
+        self._forward_hooks.append(hook)
+
+    def register_forward_pre_hook(self, hook):
+        self._forward_pre_hooks.append(hook)
+
+    # -- serialisation ------------------------------------------------------
+    def _collect_params_with_prefix(self, prefix=""):
+        """Structural names: attribute path -> Parameter (reference:
+        Block._collect_params_with_prefix), architecture-stable across
+        instances regardless of auto-generated name prefixes."""
+        if prefix:
+            prefix += "."
+        ret = {prefix + key: val for key, val in self._reg_params.items()}
+        for name, child in self._children.items():
+            ret.update(child._collect_params_with_prefix(prefix + name))
+        return ret
+
+    def save_parameters(self, filename, deduplicate=False):
+        params = self._collect_params_with_prefix()
+        arrays = {name: p.data().asnumpy() for name, p in params.items()
+                  if p._data is not None}
+        np.savez(filename, **arrays)
+
+    def load_parameters(self, filename, ctx=None, allow_missing=False,
+                        ignore_extra=False, cast_dtype=False,
+                        dtype_source="current"):
+        params = self._collect_params_with_prefix()
+        with np.load(filename) as f:
+            loaded = {k: f[k] for k in f.keys()}
+        for name, p in params.items():
+            if name in loaded:
+                p.set_data(NDArray(jax.numpy.asarray(loaded[name])))
+            elif not allow_missing:
+                raise MXNetError(f"Parameter {name} missing in {filename}")
+        if not ignore_extra:
+            extra = set(loaded) - set(params)
+            if extra:
+                raise MXNetError(f"extra parameters in file: {sorted(extra)}")
+
+    # alias names used across reference versions
+    save_params = save_parameters
+    load_params = load_parameters
+
+    # -- execution ----------------------------------------------------------
+    def __call__(self, *args, **kwargs):
+        for hook in self._forward_pre_hooks:
+            hook(self, args)
+        out = self.forward(*args, **kwargs)
+        for hook in self._forward_hooks:
+            hook(self, args, out)
+        return out
+
+    def forward(self, *args, **kwargs):
+        raise NotImplementedError
+
+    def hybridize(self, active=True, **kwargs):
+        """No-op on plain Blocks except recursion (reference behaviour)."""
+        for child in self._children.values():
+            child.hybridize(active, **kwargs)
+
+    def summary(self, *inputs):
+        """Print a per-layer summary (reference: Block.summary)."""
+        rows = []
+
+        def walk(block, depth):
+            n_params = sum(int(np.prod(p.shape)) for p in
+                           block._params.values() if p.shape)
+            rows.append(("  " * depth + block.name,
+                         type(block).__name__, n_params))
+            for c in block._children.values():
+                walk(c, depth + 1)
+        walk(self, 0)
+        total = sum(int(np.prod(p.shape)) for p in
+                    self.collect_params().values() if p.shape)
+        lines = [f"{'Layer':<40}{'Type':<24}{'Params':>12}", "-" * 76]
+        lines += [f"{n:<40}{t:<24}{p:>12}" for n, t, p in rows]
+        lines += ["-" * 76, f"Total params: {total}"]
+        out = "\n".join(lines)
+        print(out)
+        return out
+
+    def __repr__(self):
+        lines = [f"{type(self).__name__}("]
+        for key, child in self._children.items():
+            child_repr = repr(child).replace("\n", "\n  ")
+            lines.append(f"  ({key}): {child_repr}")
+        lines.append(")")
+        return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# HybridBlock
+# ---------------------------------------------------------------------------
+class HybridBlock(Block):
+    """Block that can be compiled to a single XLA executable.
+
+    Subclasses implement `hybrid_forward(F, x, **params)` where F is the op
+    namespace (mx.nd here; mx.sym under symbolic tracing) and params are the
+    block's own registered parameters as arrays.
+    """
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix, params)
+        self._active = False
+        self._cached_fns = {}
+        self._flags = {}
+
+    def hybridize(self, active=True, **kwargs):
+        self._active = active
+        self._flags.update(kwargs)
+        self._cached_fns = {}
+        for child in self._children.values():
+            child.hybridize(active, **kwargs)
+
+    def infer_shape(self, *args):
+        """Layers with deferred-init params override _infer_shapes."""
+        self._infer_shapes(*args)
+
+    def _infer_shapes(self, *args):
+        pass
+
+    def cast(self, dtype):
+        self._cached_fns = {}
+        return super().cast(dtype)
+
+    # -- eager path --------------------------------------------------------
+    def _forward_eager(self, *args, **kwargs):
+        from .. import ndarray as F
+        try:
+            params = {k: p.data() for k, p in self._reg_params.items()}
+        except DeferredInitializationError:
+            self._deferred_infer(*args)
+            params = {k: p.data() for k, p in self._reg_params.items()}
+        return self.hybrid_forward(F, *args, **kwargs, **params)
+
+    def _deferred_infer(self, *args):
+        self._infer_shapes(*args)
+        for p in self._reg_params.values():
+            if p._deferred_init is not None:
+                p._finish_deferred_init(p.shape)
+
+    def forward(self, *args, **kwargs):
+        return self._forward_eager(*args, **kwargs)
+
+    def __call__(self, *args, **kwargs):
+        from ..symbol.symbol import Symbol
+        if args and isinstance(args[0], Symbol):
+            return self._forward_symbolic(*args)
+        if (self._active and not kwargs
+                and _TraceContext.active() is None
+                and all(isinstance(a, NDArray) for a in args)):
+            return self._call_cached(*args)
+        return super().__call__(*args, **kwargs)
+
+    def _forward_symbolic(self, *args):
+        from .. import symbol as F
+        params = {k: p.var() for k, p in self._reg_params.items()}
+        return self.hybrid_forward(F, *args, **params)
+
+    # -- compiled path -----------------------------------------------------
+    def _call_cached(self, *args):
+        params = [p for p in self.collect_params().values()]
+        if any(p._deferred_init is not None or p._data is None for p in params):
+            # first call initialises deferred shapes through the eager path
+            return super().__call__(*args)
+        key = (tuple((a.shape, str(a.dtype)) for a in args),
+               autograd.is_training())
+        entry = self._cached_fns.get(key)
+        if entry is None:
+            entry = self._build_cached(params, args, autograd.is_training())
+            self._cached_fns[key] = entry
+        jfn, meta = entry
+
+        rng = _random._next_key()
+        n_out = meta["n_out"] + len(meta["aux"])
+
+        def runner(*vals, rng):
+            return jfn(rng, *vals)
+
+        flat = _apply(runner, list(args) + [p.data() for p in params],
+                      {"rng": rng}, n_out=n_out)
+        flat = flat if isinstance(flat, tuple) else (flat,)
+        outs, auxs = flat[:meta["n_out"]], flat[meta["n_out"]:]
+        for p, new in zip(meta["aux"], auxs):
+            p._data._rebind(new._data)
+        if meta["is_seq"]:
+            return list(outs) if meta["is_list"] else tuple(outs)
+        return outs[0]
+
+    def _build_cached(self, params, args, training):
+        block = self
+        meta = {"n_out": 1, "is_seq": False, "is_list": False, "aux": []}
+
+        def pure(rng, *vals):
+            n_args = len(args)
+            arg_vals, param_vals = vals[:n_args], vals[n_args:]
+            prev_rec = autograd.set_recording(False)
+            prev_train = autograd.set_training(training)
+            try:
+                with _TraceContext(rng) as tctx:
+                    for p, v in zip(params, param_vals):
+                        p._trace_override = NDArray(v)
+                    nd_args = [NDArray(v) for v in arg_vals]
+                    out = block.forward(*nd_args)
+                    is_seq = isinstance(out, (tuple, list))
+                    outs = tuple(out) if is_seq else (out,)
+                    meta["is_seq"] = is_seq
+                    meta["is_list"] = isinstance(out, list)
+                    meta["n_out"] = len(outs)
+                    meta["aux"] = [p for p, _ in tctx.aux_updates]
+                    flat = [o._data for o in outs]
+                    flat += [v._data if isinstance(v, NDArray) else v
+                             for _, v in tctx.aux_updates]
+                return tuple(flat)
+            finally:
+                for p in params:
+                    p._trace_override = None
+                autograd.set_recording(prev_rec)
+                autograd.set_training(prev_train)
+
+        # abstract trace now to fill `meta` (output structure, aux params)
+        jax.eval_shape(pure, _random._next_key(),
+                       *[a._data for a in args],
+                       *[p.data()._data for p in params])
+        return jax.jit(pure), meta
+
+    def export(self, path, epoch=0):
+        """Save params (+ a model description). The reference exports
+        symbol.json + params; here the compiled artifact is the XLA
+        executable, so we export parameters and an architecture repr."""
+        self.save_parameters(f"{path}-{epoch:04d}.params.npz")
+        with open(f"{path}-symbol.json", "w") as f:
+            import json
+            json.dump({"framework": "mxnet_tpu", "repr": repr(self)}, f)
+
+    def hybrid_forward(self, F, *args, **kwargs):
+        raise NotImplementedError
+
+
+class SymbolBlock(HybridBlock):
+    """Build a block from symbolic outputs (reference: SymbolBlock)."""
+
+    def __init__(self, outputs, inputs, params=None):
+        super().__init__(prefix="", params=params)
+        from ..symbol.symbol import Symbol
+        self._outputs = outputs if isinstance(outputs, Symbol) else outputs
+        self._inputs = _as_list(inputs)
+        for name, p in (params or {}).items():
+            self._reg_params[name] = p
+
+    def forward(self, *args):
+        bindings = {s.name: a for s, a in zip(self._inputs, args)}
+        for p in self.collect_params().values():
+            bindings[p.name] = p.data()
+        return self._outputs.eval_with(bindings)
+
+    def hybrid_forward(self, F, *args, **kwargs):
+        raise MXNetError("SymbolBlock executes its symbol graph directly")

@@ -1,0 +1,6 @@
+"""mx.gluon.data (reference: python/mxnet/gluon/data/)."""
+from .dataset import (Dataset, SimpleDataset, ArrayDataset, RecordFileDataset)
+from .sampler import (Sampler, SequentialSampler, RandomSampler, BatchSampler,
+                      IntervalSampler)
+from .dataloader import DataLoader, default_batchify_fn
+from . import vision

@@ -1,0 +1,4 @@
+"""mx.gluon.data.vision (reference layout)."""
+from .datasets import (MNIST, FashionMNIST, CIFAR10, CIFAR100,
+                       ImageFolderDataset, ImageRecordDataset)
+from . import transforms

@@ -1,0 +1,230 @@
+"""Vision transforms (reference: gluon/data/vision/transforms.py).
+
+Transforms operate on HWC uint8/float NDArrays (reference convention) and
+compose via `Compose`. ToTensor converts HWC->CHW float32/255.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ....ndarray.ndarray import NDArray, array, _apply
+from ...block import Block, HybridBlock
+
+__all__ = ["Compose", "Cast", "ToTensor", "Normalize", "Resize", "CenterCrop",
+           "RandomResizedCrop", "RandomFlipLeftRight", "RandomFlipTopBottom",
+           "RandomCrop", "RandomBrightness", "RandomContrast",
+           "RandomSaturation", "RandomLighting", "RandomColorJitter"]
+
+
+class Compose(Block):
+    def __init__(self, transforms):
+        super().__init__()
+        self._transforms = transforms
+
+    def forward(self, x):
+        for t in self._transforms:
+            x = t(x)
+        return x
+
+
+class Cast(HybridBlock):
+    def __init__(self, dtype="float32"):
+        super().__init__()
+        self._dtype = dtype
+
+    def hybrid_forward(self, F, x):
+        return x.astype(self._dtype)
+
+
+class ToTensor(HybridBlock):
+    """HWC uint8 [0,255] -> CHW float32 [0,1]."""
+
+    def hybrid_forward(self, F, x):
+        import jax.numpy as jnp
+        def fn(a):
+            a = a.astype(jnp.float32) / 255.0
+            if a.ndim == 3:
+                return jnp.transpose(a, (2, 0, 1))
+            return jnp.transpose(a, (0, 3, 1, 2))
+        return _apply(fn, [x])
+
+
+class Normalize(HybridBlock):
+    def __init__(self, mean=0.0, std=1.0):
+        super().__init__()
+        self._mean = np.asarray(mean, np.float32)
+        self._std = np.asarray(std, np.float32)
+
+    def hybrid_forward(self, F, x):
+        import jax.numpy as jnp
+        mean, std = self._mean, self._std
+
+        def fn(a, _m=mean, _s=std):
+            m = jnp.asarray(_m).reshape(-1, 1, 1) if _m.ndim else _m
+            s = jnp.asarray(_s).reshape(-1, 1, 1) if _s.ndim else _s
+            return (a - m) / s
+        return _apply(fn, [x])
+
+
+def _resize_hwc(a, size):
+    import jax.image
+    h, w = (size, size) if isinstance(size, int) else (size[1], size[0])
+    return jax.image.resize(a, (h, w, a.shape[2]), method="bilinear")
+
+
+class Resize(Block):
+    def __init__(self, size, keep_ratio=False, interpolation=1):
+        super().__init__()
+        self._size = size
+
+    def forward(self, x):
+        return _apply(lambda a, _s=self._size: _resize_hwc(
+            a.astype("float32"), _s), [x])
+
+
+class CenterCrop(Block):
+    def __init__(self, size, interpolation=1):
+        super().__init__()
+        self._size = (size, size) if isinstance(size, int) else size
+
+    def forward(self, x):
+        w, h = self._size
+        H, W = x.shape[0], x.shape[1]
+        y0, x0 = max((H - h) // 2, 0), max((W - w) // 2, 0)
+        return x[y0:y0 + h, x0:x0 + w, :]
+
+
+class RandomCrop(Block):
+    def __init__(self, size, pad=None, interpolation=1):
+        super().__init__()
+        self._size = (size, size) if isinstance(size, int) else size
+        self._pad = pad
+
+    def forward(self, x):
+        import numpy as _np
+        w, h = self._size
+        a = x.asnumpy()
+        if self._pad:
+            p = self._pad
+            a = _np.pad(a, ((p, p), (p, p), (0, 0)), mode="constant")
+        H, W = a.shape[:2]
+        y0 = _np.random.randint(0, max(H - h, 0) + 1)
+        x0 = _np.random.randint(0, max(W - w, 0) + 1)
+        return array(a[y0:y0 + h, x0:x0 + w])
+
+
+class RandomResizedCrop(Block):
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                 interpolation=1):
+        super().__init__()
+        self._size = (size, size) if isinstance(size, int) else size
+        self._scale = scale
+        self._ratio = ratio
+
+    def forward(self, x):
+        import numpy as _np
+        H, W = x.shape[0], x.shape[1]
+        area = H * W
+        for _ in range(10):
+            target = area * _np.random.uniform(*self._scale)
+            ar = _np.random.uniform(*self._ratio)
+            w = int(round(np.sqrt(target * ar)))
+            h = int(round(np.sqrt(target / ar)))
+            if w <= W and h <= H:
+                x0 = _np.random.randint(0, W - w + 1)
+                y0 = _np.random.randint(0, H - h + 1)
+                crop = x[y0:y0 + h, x0:x0 + w, :]
+                return _apply(lambda a, _s=self._size: _resize_hwc(
+                    a.astype("float32"), _s), [crop])
+        return _apply(lambda a, _s=self._size: _resize_hwc(
+            a.astype("float32"), _s), [x])
+
+
+class RandomFlipLeftRight(Block):
+    def forward(self, x):
+        import numpy as _np
+        if _np.random.rand() < 0.5:
+            return x[:, ::-1, :]
+        return x
+
+
+class RandomFlipTopBottom(Block):
+    def forward(self, x):
+        import numpy as _np
+        if _np.random.rand() < 0.5:
+            return x[::-1, :, :]
+        return x
+
+
+class RandomBrightness(Block):
+    def __init__(self, brightness):
+        super().__init__()
+        self._b = brightness
+
+    def forward(self, x):
+        import numpy as _np
+        alpha = 1.0 + _np.random.uniform(-self._b, self._b)
+        return x.astype("float32") * alpha
+
+
+class RandomContrast(Block):
+    def __init__(self, contrast):
+        super().__init__()
+        self._c = contrast
+
+    def forward(self, x):
+        import numpy as _np
+        alpha = 1.0 + _np.random.uniform(-self._c, self._c)
+        xf = x.astype("float32")
+        mean = xf.mean()
+        return xf * alpha + mean * (1 - alpha)
+
+
+class RandomSaturation(Block):
+    def __init__(self, saturation):
+        super().__init__()
+        self._s = saturation
+
+    def forward(self, x):
+        import numpy as _np
+        alpha = 1.0 + _np.random.uniform(-self._s, self._s)
+        xf = x.astype("float32")
+        gray = xf.mean(axis=2, keepdims=True)
+        return xf * alpha + gray * (1 - alpha)
+
+
+class RandomLighting(Block):
+    """AlexNet-style PCA lighting noise."""
+    _eigval = np.array([55.46, 4.794, 1.148], np.float32)
+    _eigvec = np.array([[-0.5675, 0.7192, 0.4009],
+                        [-0.5808, -0.0045, -0.8140],
+                        [-0.5836, -0.6948, 0.4203]], np.float32)
+
+    def __init__(self, alpha):
+        super().__init__()
+        self._alpha = alpha
+
+    def forward(self, x):
+        import numpy as _np
+        alpha = _np.random.normal(0, self._alpha, 3).astype(_np.float32)
+        rgb = (self._eigvec * alpha * self._eigval).sum(axis=1)
+        return x.astype("float32") + array(rgb.reshape(1, 1, 3))
+
+
+class RandomColorJitter(Block):
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0):
+        super().__init__()
+        self._ts = []
+        if brightness:
+            self._ts.append(RandomBrightness(brightness))
+        if contrast:
+            self._ts.append(RandomContrast(contrast))
+        if saturation:
+            self._ts.append(RandomSaturation(saturation))
+
+    def forward(self, x):
+        import numpy as _np
+        order = _np.random.permutation(len(self._ts))
+        for i in order:
+            x = self._ts[i](x)
+        return x

@@ -1,0 +1,321 @@
+"""Gluon losses (reference: python/mxnet/gluon/loss.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..ndarray.ndarray import _apply, _lift
+from .block import HybridBlock
+
+__all__ = ["Loss", "L2Loss", "L1Loss", "SigmoidBinaryCrossEntropyLoss",
+           "SigmoidBCELoss", "SoftmaxCrossEntropyLoss", "SoftmaxCELoss",
+           "KLDivLoss", "HuberLoss", "HingeLoss", "SquaredHingeLoss",
+           "LogisticLoss", "TripletLoss", "CTCLoss", "CosineEmbeddingLoss"]
+
+
+def _reduce(x, weight, sample_weight, batch_axis):
+    if sample_weight is not None:
+        x = x * sample_weight
+    if weight is not None:
+        x = x * weight
+    axes = tuple(i for i in range(x.ndim) if i != batch_axis)
+    return jnp.mean(x, axis=axes) if axes else x
+
+
+class Loss(HybridBlock):
+    def __init__(self, weight=None, batch_axis=0, **kwargs):
+        super().__init__(**kwargs)
+        self._weight = weight
+        self._batch_axis = batch_axis
+
+    def __repr__(self):
+        return f"{type(self).__name__}(batch_axis={self._batch_axis}, w={self._weight})"
+
+
+class L2Loss(Loss):
+    def __init__(self, weight=1.0, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+
+    def hybrid_forward(self, F, pred, label, sample_weight=None):
+        ins = [pred, _lift(label)] + ([sample_weight] if sample_weight is not None else [])
+
+        def fn(p, l, *sw):
+            x = jnp.square(l.reshape(p.shape) - p) / 2
+            return _reduce(x, self._weight, sw[0] if sw else None,
+                           self._batch_axis)
+        return _apply(fn, ins)
+
+
+class L1Loss(Loss):
+    def __init__(self, weight=1.0, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+
+    def hybrid_forward(self, F, pred, label, sample_weight=None):
+        ins = [pred, _lift(label)] + ([sample_weight] if sample_weight is not None else [])
+
+        def fn(p, l, *sw):
+            x = jnp.abs(l.reshape(p.shape) - p)
+            return _reduce(x, self._weight, sw[0] if sw else None,
+                           self._batch_axis)
+        return _apply(fn, ins)
+
+
+class SigmoidBinaryCrossEntropyLoss(Loss):
+    def __init__(self, from_sigmoid=False, weight=None, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._from_sigmoid = from_sigmoid
+
+    def hybrid_forward(self, F, pred, label, sample_weight=None,
+                       pos_weight=None):
+        ins = [pred, _lift(label)]
+        has_sw = sample_weight is not None
+        has_pw = pos_weight is not None
+        if has_sw:
+            ins.append(sample_weight)
+        if has_pw:
+            ins.append(_lift(pos_weight))
+
+        def fn(p, l, *rest, _fs=self._from_sigmoid, _sw=has_sw, _pw=has_pw):
+            sw = rest[0] if _sw else None
+            pw = rest[-1] if _pw else None
+            l = l.reshape(p.shape)
+            if not _fs:
+                if pw is None:
+                    # log-sum-exp stable BCE with logits
+                    x = jax.nn.relu(p) - p * l \
+                        + jnp.log1p(jnp.exp(-jnp.abs(p)))
+                else:
+                    # positive term scaled by pos_weight; stable via softplus
+                    logsig = -jax.nn.softplus(-p)       # log sigmoid(p)
+                    log1msig = -p - jax.nn.softplus(-p)  # log(1-sigmoid(p))
+                    x = -(pw * l * logsig + (1 - l) * log1msig)
+            else:
+                if pw is None:
+                    x = -(l * jnp.log(p + 1e-12)
+                          + (1 - l) * jnp.log(1 - p + 1e-12))
+                else:
+                    x = -(pw * l * jnp.log(p + 1e-12)
+                          + (1 - l) * jnp.log(1 - p + 1e-12))
+            return _reduce(x, self._weight, sw, self._batch_axis)
+        return _apply(fn, ins)
+
+
+SigmoidBCELoss = SigmoidBinaryCrossEntropyLoss
+
+
+class SoftmaxCrossEntropyLoss(Loss):
+    """Softmax CE (reference semantics: sparse labels by default)."""
+
+    def __init__(self, axis=-1, sparse_label=True, from_logits=False,
+                 weight=None, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._axis = axis
+        self._sparse_label = sparse_label
+        self._from_logits = from_logits
+
+    def hybrid_forward(self, F, pred, label, sample_weight=None):
+        ins = [pred, _lift(label)] + ([sample_weight] if sample_weight is not None else [])
+
+        def fn(p, l, *sw, _ax=self._axis, _sp=self._sparse_label,
+               _fl=self._from_logits):
+            logp = p if _fl else jax.nn.log_softmax(p, axis=_ax)
+            if _sp:
+                li = l.astype(jnp.int32)
+                x = -jnp.take_along_axis(logp, jnp.expand_dims(li, _ax),
+                                         axis=_ax)
+                x = jnp.squeeze(x, _ax)
+            else:
+                x = -jnp.sum(logp * l, axis=_ax)
+            return _reduce(x, self._weight, sw[0] if sw else None,
+                           self._batch_axis)
+        return _apply(fn, ins)
+
+
+SoftmaxCELoss = SoftmaxCrossEntropyLoss
+
+
+class KLDivLoss(Loss):
+    def __init__(self, from_logits=True, axis=-1, weight=None, batch_axis=0,
+                 **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._from_logits = from_logits
+        self._axis = axis
+
+    def hybrid_forward(self, F, pred, label, sample_weight=None):
+        ins = [pred, _lift(label)] + ([sample_weight] if sample_weight is not None else [])
+
+        def fn(p, l, *sw, _ax=self._axis, _fl=self._from_logits):
+            logp = p if _fl else jax.nn.log_softmax(p, axis=_ax)
+            x = l * (jnp.log(l + 1e-12) - logp)
+            return _reduce(jnp.mean(x, axis=_ax), self._weight,
+                           sw[0] if sw else None, self._batch_axis)
+        return _apply(fn, ins)
+
+
+class HuberLoss(Loss):
+    def __init__(self, rho=1.0, weight=None, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._rho = rho
+
+    def hybrid_forward(self, F, pred, label, sample_weight=None):
+        ins = [pred, _lift(label)] + ([sample_weight] if sample_weight is not None else [])
+
+        def fn(p, l, *sw, _r=self._rho):
+            d = jnp.abs(l.reshape(p.shape) - p)
+            x = jnp.where(d > _r, d - 0.5 * _r, 0.5 / _r * jnp.square(d))
+            return _reduce(x, self._weight, sw[0] if sw else None,
+                           self._batch_axis)
+        return _apply(fn, ins)
+
+
+class HingeLoss(Loss):
+    def __init__(self, margin=1.0, weight=None, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._margin = margin
+
+    def hybrid_forward(self, F, pred, label, sample_weight=None):
+        ins = [pred, _lift(label)] + ([sample_weight] if sample_weight is not None else [])
+
+        def fn(p, l, *sw, _m=self._margin):
+            x = jax.nn.relu(_m - p * l.reshape(p.shape))
+            return _reduce(x, self._weight, sw[0] if sw else None,
+                           self._batch_axis)
+        return _apply(fn, ins)
+
+
+class SquaredHingeLoss(HingeLoss):
+    def hybrid_forward(self, F, pred, label, sample_weight=None):
+        ins = [pred, _lift(label)] + ([sample_weight] if sample_weight is not None else [])
+
+        def fn(p, l, *sw, _m=self._margin):
+            x = jnp.square(jax.nn.relu(_m - p * l.reshape(p.shape)))
+            return _reduce(x, self._weight, sw[0] if sw else None,
+                           self._batch_axis)
+        return _apply(fn, ins)
+
+
+class LogisticLoss(Loss):
+    def __init__(self, weight=None, batch_axis=0, label_format="signed",
+                 **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._label_format = label_format
+
+    def hybrid_forward(self, F, pred, label, sample_weight=None):
+        ins = [pred, _lift(label)] + ([sample_weight] if sample_weight is not None else [])
+
+        def fn(p, l, *sw, _lf=self._label_format):
+            l = l.reshape(p.shape)
+            if _lf == "signed":
+                l = (l + 1) / 2
+            x = jax.nn.relu(p) - p * l + jnp.log1p(jnp.exp(-jnp.abs(p)))
+            return _reduce(x, self._weight, sw[0] if sw else None,
+                           self._batch_axis)
+        return _apply(fn, ins)
+
+
+class TripletLoss(Loss):
+    def __init__(self, margin=1.0, weight=None, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._margin = margin
+
+    def hybrid_forward(self, F, pred, positive, negative, sample_weight=None):
+        ins = [pred, _lift(positive), _lift(negative)]
+
+        def fn(a, p, n, _m=self._margin):
+            axes = tuple(range(1, a.ndim))
+            x = jax.nn.relu(jnp.sum(jnp.square(a - p) - jnp.square(a - n),
+                                    axis=axes) + _m)
+            return x
+        return _apply(fn, ins)
+
+
+class CosineEmbeddingLoss(Loss):
+    def __init__(self, weight=None, batch_axis=0, margin=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._margin = margin
+
+    def hybrid_forward(self, F, input1, input2, label, sample_weight=None):
+        ins = [input1, _lift(input2), _lift(label)]
+
+        def fn(a, b, l, _m=self._margin):
+            cos = jnp.sum(a * b, -1) / (
+                jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1)
+                + 1e-12)
+            l = l.reshape(cos.shape)
+            return jnp.where(l > 0, 1 - cos, jax.nn.relu(cos - _m))
+        return _apply(fn, ins)
+
+
+class CTCLoss(Loss):
+    """Connectionist temporal classification (reference: CTCLoss).
+
+    Dynamic-programming forward computed with lax.scan over time — fully
+    XLA-compilable, blank label = 0 or alphabet_size-1 per layout."""
+
+    def __init__(self, layout="NTC", label_layout="NT", weight=None, **kwargs):
+        super().__init__(weight, 0, **kwargs)
+        self._layout = layout
+        self._label_layout = label_layout
+
+    def hybrid_forward(self, F, pred, label, pred_lengths=None,
+                       label_lengths=None, sample_weight=None):
+        ins = [pred, _lift(label)]
+        has_pl = pred_lengths is not None
+        has_ll = label_lengths is not None
+        if has_pl:
+            ins.append(_lift(pred_lengths))
+        if has_ll:
+            ins.append(_lift(label_lengths))
+
+        def fn(p, l, *rest, _layout=self._layout, _pl=has_pl, _ll=has_ll):
+            plen = rest[0] if _pl else None
+            llen = rest[-1] if _ll else None
+            if _layout == "TNC":
+                p = jnp.swapaxes(p, 0, 1)
+            logp = jax.nn.log_softmax(p, axis=-1)   # (N, T, C); blank=0
+            n, t, c = logp.shape
+            l = l.astype(jnp.int32)                  # (N, L)
+            L = l.shape[1]
+            plen = plen.astype(jnp.int32) if plen is not None \
+                else jnp.full((n,), t, jnp.int32)
+            llen = llen.astype(jnp.int32) if llen is not None \
+                else jnp.full((n,), L, jnp.int32)
+            # extended labels with interleaved blanks: length 2L+1
+            ext = jnp.zeros((n, 2 * L + 1), jnp.int32)
+            ext = ext.at[:, 1::2].set(l)
+            neg_inf = -1e30
+            alpha0 = jnp.full((n, 2 * L + 1), neg_inf)
+            alpha0 = alpha0.at[:, 0].set(logp[:, 0, 0])
+            alpha0 = alpha0.at[:, 1].set(
+                jnp.take_along_axis(logp[:, 0, :], ext[:, 1:2], axis=1)[:, 0])
+
+            same = jnp.concatenate(
+                [jnp.ones((n, 2), bool),
+                 ext[:, 2:] == ext[:, :-2]], axis=1)
+
+            def step(alpha, inp):
+                lp_t, t_idx = inp
+                shifted1 = jnp.concatenate(
+                    [jnp.full((n, 1), neg_inf), alpha[:, :-1]], axis=1)
+                shifted2 = jnp.concatenate(
+                    [jnp.full((n, 2), neg_inf), alpha[:, :-2]], axis=1)
+                shifted2 = jnp.where(same, neg_inf, shifted2)
+                merged = jnp.logaddexp(jnp.logaddexp(alpha, shifted1), shifted2)
+                emit = jnp.take_along_axis(lp_t, ext, axis=1)
+                new = merged + emit
+                # sequences already past their pred_length keep alpha frozen
+                active = (t_idx < plen)[:, None]
+                return jnp.where(active, new, alpha), None
+
+            alpha_T, _ = jax.lax.scan(
+                step, alpha0,
+                (jnp.swapaxes(logp, 0, 1)[1:], jnp.arange(1, t)))
+            # final positions depend on each sequence's label length:
+            # ext indices 2*llen (trailing blank) and 2*llen - 1 (last label)
+            idx_blank = (2 * llen)[:, None]
+            idx_label = jnp.maximum(2 * llen - 1, 0)[:, None]
+            a_blank = jnp.take_along_axis(alpha_T, idx_blank, axis=1)[:, 0]
+            a_label = jnp.take_along_axis(alpha_T, idx_label, axis=1)[:, 0]
+            ll_ = jnp.logaddexp(a_blank, a_label)
+            return -ll_
+        return _apply(fn, ins)

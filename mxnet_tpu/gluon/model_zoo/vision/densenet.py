@@ -1,0 +1,103 @@
+"""DenseNet 121/161/169/201 (reference: gluon/model_zoo/vision/densenet.py)."""
+from __future__ import annotations
+
+from ...block import HybridBlock
+from ... import nn
+from ....ops.tensor_ops import concat
+
+__all__ = ["DenseNet", "densenet121", "densenet161", "densenet169",
+           "densenet201"]
+
+
+class _DenseLayer(HybridBlock):
+    def __init__(self, growth_rate, bn_size, dropout, layout="NCHW", **kwargs):
+        super().__init__(**kwargs)
+        ax = 1 if layout == "NCHW" else 3
+        self._axis = ax
+        self.body = nn.HybridSequential(prefix="")
+        self.body.add(nn.BatchNorm(axis=ax))
+        self.body.add(nn.Activation("relu"))
+        self.body.add(nn.Conv2D(bn_size * growth_rate, 1, use_bias=False,
+                                layout=layout))
+        self.body.add(nn.BatchNorm(axis=ax))
+        self.body.add(nn.Activation("relu"))
+        self.body.add(nn.Conv2D(growth_rate, 3, padding=1, use_bias=False,
+                                layout=layout))
+        if dropout:
+            self.body.add(nn.Dropout(dropout))
+
+    def hybrid_forward(self, F, x):
+        return concat(x, self.body(x), dim=self._axis)
+
+
+def _make_transition(num_output_features, layout="NCHW"):
+    ax = 1 if layout == "NCHW" else 3
+    out = nn.HybridSequential(prefix="")
+    out.add(nn.BatchNorm(axis=ax))
+    out.add(nn.Activation("relu"))
+    out.add(nn.Conv2D(num_output_features, 1, use_bias=False, layout=layout))
+    out.add(nn.AvgPool2D(2, 2, layout=layout))
+    return out
+
+
+class DenseNet(HybridBlock):
+    def __init__(self, num_init_features, growth_rate, block_config,
+                 bn_size=4, dropout=0, classes=1000, layout="NCHW", **kwargs):
+        super().__init__(**kwargs)
+        ax = 1 if layout == "NCHW" else 3
+        with self.name_scope():
+            self.features = nn.HybridSequential(prefix="")
+            self.features.add(nn.Conv2D(num_init_features, 7, 2, 3,
+                                        use_bias=False, layout=layout))
+            self.features.add(nn.BatchNorm(axis=ax))
+            self.features.add(nn.Activation("relu"))
+            self.features.add(nn.MaxPool2D(3, 2, 1, layout=layout))
+            num_features = num_init_features
+            for i, num_layers in enumerate(block_config):
+                block = nn.HybridSequential(prefix="")
+                for _ in range(num_layers):
+                    block.add(_DenseLayer(growth_rate, bn_size, dropout,
+                                          layout=layout))
+                self.features.add(block)
+                num_features += num_layers * growth_rate
+                if i != len(block_config) - 1:
+                    self.features.add(_make_transition(num_features // 2,
+                                                       layout))
+                    num_features //= 2
+            self.features.add(nn.BatchNorm(axis=ax))
+            self.features.add(nn.Activation("relu"))
+            self.features.add(nn.GlobalAvgPool2D(layout=layout))
+            self.features.add(nn.Flatten())
+            self.output = nn.Dense(classes)
+
+    def hybrid_forward(self, F, x):
+        return self.output(self.features(x))
+
+
+densenet_spec = {
+    121: (64, 32, [6, 12, 24, 16]),
+    161: (96, 48, [6, 12, 36, 24]),
+    169: (64, 32, [6, 12, 32, 32]),
+    201: (64, 32, [6, 12, 48, 32]),
+}
+
+
+def _get(num, **kwargs):
+    f, g, b = densenet_spec[num]
+    return DenseNet(f, g, b, **kwargs)
+
+
+def densenet121(**kwargs):
+    return _get(121, **kwargs)
+
+
+def densenet161(**kwargs):
+    return _get(161, **kwargs)
+
+
+def densenet169(**kwargs):
+    return _get(169, **kwargs)
+
+
+def densenet201(**kwargs):
+    return _get(201, **kwargs)

@@ -1,0 +1,170 @@
+"""Inception V3 (reference: gluon/model_zoo/vision/inception.py)."""
+from __future__ import annotations
+
+from ...block import HybridBlock
+from ... import nn
+from ....ops.tensor_ops import concat
+
+__all__ = ["Inception3", "inception_v3"]
+
+
+def _make_basic_conv(channels, kernel_size, strides=1, padding=0,
+                     layout="NCHW"):
+    ax = 1 if layout == "NCHW" else 3
+    out = nn.HybridSequential(prefix="")
+    out.add(nn.Conv2D(channels, kernel_size, strides, padding,
+                      use_bias=False, layout=layout))
+    out.add(nn.BatchNorm(axis=ax, epsilon=0.001))
+    out.add(nn.Activation("relu"))
+    return out
+
+
+class _Branches(HybridBlock):
+    def __init__(self, branches, axis, **kwargs):
+        super().__init__(**kwargs)
+        self._axis = axis
+        for i, b in enumerate(branches):
+            self.register_child(b, f"branch{i}")
+
+    def hybrid_forward(self, F, x):
+        return concat(*[b(x) for b in self._children.values()],
+                      dim=self._axis)
+
+
+def _make_A(pool_features, layout):
+    ax = 1 if layout == "NCHW" else 3
+    b1 = _make_basic_conv(64, 1, layout=layout)
+    b2 = nn.HybridSequential(prefix="")
+    b2.add(_make_basic_conv(48, 1, layout=layout))
+    b2.add(_make_basic_conv(64, 5, padding=2, layout=layout))
+    b3 = nn.HybridSequential(prefix="")
+    b3.add(_make_basic_conv(64, 1, layout=layout))
+    b3.add(_make_basic_conv(96, 3, padding=1, layout=layout))
+    b3.add(_make_basic_conv(96, 3, padding=1, layout=layout))
+    b4 = nn.HybridSequential(prefix="")
+    b4.add(nn.AvgPool2D(3, 1, 1, layout=layout))
+    b4.add(_make_basic_conv(pool_features, 1, layout=layout))
+    return _Branches([b1, b2, b3, b4], ax)
+
+
+def _make_B(layout):
+    ax = 1 if layout == "NCHW" else 3
+    b1 = _make_basic_conv(384, 3, 2, layout=layout)
+    b2 = nn.HybridSequential(prefix="")
+    b2.add(_make_basic_conv(64, 1, layout=layout))
+    b2.add(_make_basic_conv(96, 3, padding=1, layout=layout))
+    b2.add(_make_basic_conv(96, 3, 2, layout=layout))
+    b3 = nn.HybridSequential(prefix="")
+    b3.add(nn.MaxPool2D(3, 2, layout=layout))
+    return _Branches([b1, b2, b3], ax)
+
+
+def _make_C(channels_7x7, layout):
+    ax = 1 if layout == "NCHW" else 3
+    b1 = _make_basic_conv(192, 1, layout=layout)
+    c = channels_7x7
+    b2 = nn.HybridSequential(prefix="")
+    b2.add(_make_basic_conv(c, 1, layout=layout))
+    b2.add(_make_basic_conv(c, (1, 7), padding=(0, 3), layout=layout))
+    b2.add(_make_basic_conv(192, (7, 1), padding=(3, 0), layout=layout))
+    b3 = nn.HybridSequential(prefix="")
+    b3.add(_make_basic_conv(c, 1, layout=layout))
+    b3.add(_make_basic_conv(c, (7, 1), padding=(3, 0), layout=layout))
+    b3.add(_make_basic_conv(c, (1, 7), padding=(0, 3), layout=layout))
+    b3.add(_make_basic_conv(c, (7, 1), padding=(3, 0), layout=layout))
+    b3.add(_make_basic_conv(192, (1, 7), padding=(0, 3), layout=layout))
+    b4 = nn.HybridSequential(prefix="")
+    b4.add(nn.AvgPool2D(3, 1, 1, layout=layout))
+    b4.add(_make_basic_conv(192, 1, layout=layout))
+    return _Branches([b1, b2, b3, b4], ax)
+
+
+def _make_D(layout):
+    ax = 1 if layout == "NCHW" else 3
+    b1 = nn.HybridSequential(prefix="")
+    b1.add(_make_basic_conv(192, 1, layout=layout))
+    b1.add(_make_basic_conv(320, 3, 2, layout=layout))
+    b2 = nn.HybridSequential(prefix="")
+    b2.add(_make_basic_conv(192, 1, layout=layout))
+    b2.add(_make_basic_conv(192, (1, 7), padding=(0, 3), layout=layout))
+    b2.add(_make_basic_conv(192, (7, 1), padding=(3, 0), layout=layout))
+    b2.add(_make_basic_conv(192, 3, 2, layout=layout))
+    b3 = nn.HybridSequential(prefix="")
+    b3.add(nn.MaxPool2D(3, 2, layout=layout))
+    return _Branches([b1, b2, b3], ax)
+
+
+class _BranchE2(HybridBlock):
+    def __init__(self, layout, **kwargs):
+        super().__init__(**kwargs)
+        self._axis = 1 if layout == "NCHW" else 3
+        self.stem = _make_basic_conv(384, 1, layout=layout)
+        self.a = _make_basic_conv(384, (1, 3), padding=(0, 1), layout=layout)
+        self.b = _make_basic_conv(384, (3, 1), padding=(1, 0), layout=layout)
+
+    def hybrid_forward(self, F, x):
+        x = self.stem(x)
+        return concat(self.a(x), self.b(x), dim=self._axis)
+
+
+class _BranchE3(HybridBlock):
+    def __init__(self, layout, **kwargs):
+        super().__init__(**kwargs)
+        self._axis = 1 if layout == "NCHW" else 3
+        self.stem = nn.HybridSequential(prefix="")
+        self.stem.add(_make_basic_conv(448, 1, layout=layout))
+        self.stem.add(_make_basic_conv(384, 3, padding=1, layout=layout))
+        self.a = _make_basic_conv(384, (1, 3), padding=(0, 1), layout=layout)
+        self.b = _make_basic_conv(384, (3, 1), padding=(1, 0), layout=layout)
+
+    def hybrid_forward(self, F, x):
+        x = self.stem(x)
+        return concat(self.a(x), self.b(x), dim=self._axis)
+
+
+def _make_E(layout):
+    ax = 1 if layout == "NCHW" else 3
+    b1 = _make_basic_conv(320, 1, layout=layout)
+    b2 = _BranchE2(layout)
+    b3 = _BranchE3(layout)
+    b4 = nn.HybridSequential(prefix="")
+    b4.add(nn.AvgPool2D(3, 1, 1, layout=layout))
+    b4.add(_make_basic_conv(192, 1, layout=layout))
+    return _Branches([b1, b2, b3, b4], ax)
+
+
+class Inception3(HybridBlock):
+    def __init__(self, classes=1000, layout="NCHW", **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.features = nn.HybridSequential(prefix="")
+            self.features.add(_make_basic_conv(32, 3, 2, layout=layout))
+            self.features.add(_make_basic_conv(32, 3, layout=layout))
+            self.features.add(_make_basic_conv(64, 3, padding=1,
+                                               layout=layout))
+            self.features.add(nn.MaxPool2D(3, 2, layout=layout))
+            self.features.add(_make_basic_conv(80, 1, layout=layout))
+            self.features.add(_make_basic_conv(192, 3, layout=layout))
+            self.features.add(nn.MaxPool2D(3, 2, layout=layout))
+            self.features.add(_make_A(32, layout))
+            self.features.add(_make_A(64, layout))
+            self.features.add(_make_A(64, layout))
+            self.features.add(_make_B(layout))
+            self.features.add(_make_C(128, layout))
+            self.features.add(_make_C(160, layout))
+            self.features.add(_make_C(160, layout))
+            self.features.add(_make_C(192, layout))
+            self.features.add(_make_D(layout))
+            self.features.add(_make_E(layout))
+            self.features.add(_make_E(layout))
+            self.features.add(nn.AvgPool2D(8, layout=layout))
+            self.features.add(nn.Dropout(0.5))
+            self.features.add(nn.Flatten())
+            self.output = nn.Dense(classes)
+
+    def hybrid_forward(self, F, x):
+        return self.output(self.features(x))
+
+
+def inception_v3(pretrained=False, ctx=None, **kwargs):
+    return Inception3(**kwargs)

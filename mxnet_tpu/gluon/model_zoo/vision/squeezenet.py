@@ -1,0 +1,79 @@
+"""SqueezeNet 1.0/1.1 (reference: gluon/model_zoo/vision/squeezenet.py)."""
+from __future__ import annotations
+
+from ...block import HybridBlock
+from ... import nn
+from ....ops.tensor_ops import concat
+
+__all__ = ["SqueezeNet", "squeezenet1_0", "squeezenet1_1"]
+
+
+class _Fire(HybridBlock):
+    def __init__(self, squeeze_channels, expand1x1_channels,
+                 expand3x3_channels, layout="NCHW", **kwargs):
+        super().__init__(**kwargs)
+        self._axis = 1 if layout == "NCHW" else 3
+        self.squeeze = nn.Conv2D(squeeze_channels, 1, activation="relu",
+                                 layout=layout)
+        self.expand1x1 = nn.Conv2D(expand1x1_channels, 1, activation="relu",
+                                   layout=layout)
+        self.expand3x3 = nn.Conv2D(expand3x3_channels, 3, padding=1,
+                                   activation="relu", layout=layout)
+
+    def hybrid_forward(self, F, x):
+        x = self.squeeze(x)
+        return concat(self.expand1x1(x), self.expand3x3(x), dim=self._axis)
+
+
+class SqueezeNet(HybridBlock):
+    def __init__(self, version, classes=1000, layout="NCHW", **kwargs):
+        super().__init__(**kwargs)
+        assert version in ("1.0", "1.1")
+        with self.name_scope():
+            self.features = nn.HybridSequential(prefix="")
+            if version == "1.0":
+                self.features.add(nn.Conv2D(96, 7, 2, activation="relu",
+                                            layout=layout))
+                self.features.add(nn.MaxPool2D(3, 2, ceil_mode=True,
+                                               layout=layout))
+                for s, e in [(16, 64), (16, 64), (32, 128)]:
+                    self.features.add(_Fire(s, e, e, layout=layout))
+                self.features.add(nn.MaxPool2D(3, 2, ceil_mode=True,
+                                               layout=layout))
+                for s, e in [(32, 128), (48, 192), (48, 192), (64, 256)]:
+                    self.features.add(_Fire(s, e, e, layout=layout))
+                self.features.add(nn.MaxPool2D(3, 2, ceil_mode=True,
+                                               layout=layout))
+                self.features.add(_Fire(64, 256, 256, layout=layout))
+            else:
+                self.features.add(nn.Conv2D(64, 3, 2, activation="relu",
+                                            layout=layout))
+                self.features.add(nn.MaxPool2D(3, 2, ceil_mode=True,
+                                               layout=layout))
+                for s, e in [(16, 64), (16, 64)]:
+                    self.features.add(_Fire(s, e, e, layout=layout))
+                self.features.add(nn.MaxPool2D(3, 2, ceil_mode=True,
+                                               layout=layout))
+                for s, e in [(32, 128), (32, 128)]:
+                    self.features.add(_Fire(s, e, e, layout=layout))
+                self.features.add(nn.MaxPool2D(3, 2, ceil_mode=True,
+                                               layout=layout))
+                for s, e in [(48, 192), (48, 192), (64, 256), (64, 256)]:
+                    self.features.add(_Fire(s, e, e, layout=layout))
+            self.features.add(nn.Dropout(0.5))
+            self.output = nn.HybridSequential(prefix="")
+            self.output.add(nn.Conv2D(classes, 1, layout=layout))
+            self.output.add(nn.Activation("relu"))
+            self.output.add(nn.GlobalAvgPool2D(layout=layout))
+            self.output.add(nn.Flatten())
+
+    def hybrid_forward(self, F, x):
+        return self.output(self.features(x))
+
+
+def squeezenet1_0(**kwargs):
+    return SqueezeNet("1.0", **kwargs)
+
+
+def squeezenet1_1(**kwargs):
+    return SqueezeNet("1.1", **kwargs)

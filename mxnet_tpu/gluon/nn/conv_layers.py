@@ -1,0 +1,265 @@
+"""Gluon convolution & pooling layers (reference: gluon/nn/conv_layers.py).
+
+Layout note: the reference default is channel-first (NCHW). TPU MXU prefers
+channel-last (NHWC) — every layer takes `layout=` and the model zoo exposes a
+channel-last fast path; XLA handles either, but NHWC avoids relayouts.
+Weight layout follows the data layout: (O, I/g, *k) for NC*, (O, *k, I/g)
+for N*C.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ...ndarray.ndarray import _apply
+from ...ops import nn_ops as K
+from ..block import HybridBlock
+
+__all__ = ["Conv1D", "Conv2D", "Conv3D", "Conv1DTranspose", "Conv2DTranspose",
+           "Conv3DTranspose", "MaxPool1D", "MaxPool2D", "MaxPool3D",
+           "AvgPool1D", "AvgPool2D", "AvgPool3D", "GlobalMaxPool1D",
+           "GlobalMaxPool2D", "GlobalMaxPool3D", "GlobalAvgPool1D",
+           "GlobalAvgPool2D", "GlobalAvgPool3D", "ReflectionPad2D"]
+
+
+def _tuple(x, n):
+    return (x,) * n if isinstance(x, int) else tuple(x)
+
+
+class _Conv(HybridBlock):
+    def __init__(self, channels, kernel_size, strides, padding, dilation,
+                 groups, layout, in_channels=0, activation=None, use_bias=True,
+                 weight_initializer=None, bias_initializer="zeros",
+                 ndim=2, prefix=None, params=None):
+        super().__init__(prefix, params)
+        self._channels = channels
+        self._in_channels = in_channels
+        self._kernel = _tuple(kernel_size, ndim)
+        self._strides = _tuple(strides, ndim)
+        self._padding = _tuple(padding, ndim)
+        self._dilation = _tuple(dilation, ndim)
+        self._groups = groups
+        self._layout = layout
+        self._ndim = ndim
+        self._activation = activation
+        self._channel_first = layout.index("C") == 1
+        with self.name_scope():
+            wshape = self._weight_shape(in_channels)
+            self.weight = self.params.get(
+                "weight", shape=wshape, init=weight_initializer,
+                allow_deferred_init=True)
+            if use_bias:
+                self.bias = self.params.get("bias", shape=(channels,),
+                                            init=bias_initializer)
+            else:
+                self.bias = None
+
+    def _weight_shape(self, in_channels):
+        ig = in_channels // self._groups if in_channels else 0
+        if self._channel_first:
+            return (self._channels, ig) + self._kernel
+        return (self._channels,) + self._kernel + (ig,)
+
+    def _infer_shapes(self, x):
+        c_axis = self._layout.index("C")
+        in_c = x.shape[c_axis]
+        self.weight._finish_deferred_init(self._weight_shape(in_c))
+        self._in_channels = in_c
+
+    def hybrid_forward(self, F, x, weight, bias=None):
+        out = F.Convolution(x, weight, bias, kernel=self._kernel,
+                            stride=self._strides, pad=self._padding,
+                            dilate=self._dilation, num_filter=self._channels,
+                            num_group=self._groups, no_bias=bias is None,
+                            layout=self._layout)
+        if self._activation:
+            out = F.Activation(out, act_type=self._activation)
+        return out
+
+    def __repr__(self):
+        return (f"{type(self).__name__}({self._in_channels} -> "
+                f"{self._channels}, kernel_size={self._kernel}, "
+                f"stride={self._strides}, padding={self._padding})")
+
+
+class Conv1D(_Conv):
+    def __init__(self, channels, kernel_size, strides=1, padding=0,
+                 dilation=1, groups=1, layout="NCW", **kwargs):
+        super().__init__(channels, kernel_size, strides, padding, dilation,
+                         groups, layout, ndim=1, **kwargs)
+
+
+class Conv2D(_Conv):
+    def __init__(self, channels, kernel_size, strides=1, padding=0,
+                 dilation=1, groups=1, layout="NCHW", **kwargs):
+        super().__init__(channels, kernel_size, strides, padding, dilation,
+                         groups, layout, ndim=2, **kwargs)
+
+
+class Conv3D(_Conv):
+    def __init__(self, channels, kernel_size, strides=1, padding=0,
+                 dilation=1, groups=1, layout="NCDHW", **kwargs):
+        super().__init__(channels, kernel_size, strides, padding, dilation,
+                         groups, layout, ndim=3, **kwargs)
+
+
+class _ConvTranspose(_Conv):
+    def __init__(self, channels, kernel_size, strides, padding, output_padding,
+                 dilation, groups, layout, ndim, **kwargs):
+        self._output_padding = _tuple(output_padding, ndim)
+        super().__init__(channels, kernel_size, strides, padding, dilation,
+                         groups, layout, ndim=ndim, **kwargs)
+
+    def _weight_shape(self, in_channels):
+        # transposed conv weight: (I, O/g, *k)
+        return (in_channels, self._channels // self._groups) + self._kernel \
+            if in_channels else (0, self._channels // self._groups) + self._kernel
+
+    def _infer_shapes(self, x):
+        c_axis = self._layout.index("C")
+        in_c = x.shape[c_axis]
+        self.weight._finish_deferred_init(self._weight_shape(in_c))
+        self._in_channels = in_c
+
+    def hybrid_forward(self, F, x, weight, bias=None):
+        out = F.Deconvolution(x, weight, bias, kernel=self._kernel,
+                              stride=self._strides, pad=self._padding,
+                              adj=self._output_padding,
+                              num_filter=self._channels, no_bias=bias is None,
+                              layout=self._layout)
+        if self._activation:
+            out = F.Activation(out, act_type=self._activation)
+        return out
+
+
+class Conv1DTranspose(_ConvTranspose):
+    def __init__(self, channels, kernel_size, strides=1, padding=0,
+                 output_padding=0, dilation=1, groups=1, layout="NCW",
+                 **kwargs):
+        super().__init__(channels, kernel_size, strides, padding,
+                         output_padding, dilation, groups, layout, 1, **kwargs)
+
+
+class Conv2DTranspose(_ConvTranspose):
+    def __init__(self, channels, kernel_size, strides=1, padding=0,
+                 output_padding=0, dilation=1, groups=1, layout="NCHW",
+                 **kwargs):
+        super().__init__(channels, kernel_size, strides, padding,
+                         output_padding, dilation, groups, layout, 2, **kwargs)
+
+
+class Conv3DTranspose(_ConvTranspose):
+    def __init__(self, channels, kernel_size, strides=1, padding=0,
+                 output_padding=0, dilation=1, groups=1, layout="NCDHW",
+                 **kwargs):
+        super().__init__(channels, kernel_size, strides, padding,
+                         output_padding, dilation, groups, layout, 3, **kwargs)
+
+
+class _Pooling(HybridBlock):
+    def __init__(self, pool_size, strides, padding, pool_type, ndim,
+                 layout=None, ceil_mode=False, count_include_pad=True,
+                 **kwargs):
+        super().__init__(**kwargs)
+        self._kernel = _tuple(pool_size, ndim)
+        self._strides = _tuple(strides if strides is not None else pool_size,
+                               ndim)
+        self._padding = _tuple(padding, ndim)
+        self._pool_type = pool_type
+        self._layout = layout or {1: "NCW", 2: "NCHW", 3: "NCDHW"}[ndim]
+        self._count_include_pad = count_include_pad
+
+    def hybrid_forward(self, F, x):
+        return _apply(lambda a, _k=self._kernel, _pt=self._pool_type,
+                      _s=self._strides, _p=self._padding, _l=self._layout,
+                      _c=self._count_include_pad:
+                      K.pooling(a, _k, _pt, _s, _p, _l, _c), [x])
+
+    def __repr__(self):
+        return (f"{type(self).__name__}(size={self._kernel}, "
+                f"stride={self._strides}, padding={self._padding})")
+
+
+class MaxPool1D(_Pooling):
+    def __init__(self, pool_size=2, strides=None, padding=0, **kwargs):
+        super().__init__(pool_size, strides, padding, "max", 1, **kwargs)
+
+
+class MaxPool2D(_Pooling):
+    def __init__(self, pool_size=2, strides=None, padding=0, **kwargs):
+        super().__init__(pool_size, strides, padding, "max", 2, **kwargs)
+
+
+class MaxPool3D(_Pooling):
+    def __init__(self, pool_size=2, strides=None, padding=0, **kwargs):
+        super().__init__(pool_size, strides, padding, "max", 3, **kwargs)
+
+
+class AvgPool1D(_Pooling):
+    def __init__(self, pool_size=2, strides=None, padding=0, **kwargs):
+        super().__init__(pool_size, strides, padding, "avg", 1, **kwargs)
+
+
+class AvgPool2D(_Pooling):
+    def __init__(self, pool_size=2, strides=None, padding=0, **kwargs):
+        super().__init__(pool_size, strides, padding, "avg", 2, **kwargs)
+
+
+class AvgPool3D(_Pooling):
+    def __init__(self, pool_size=2, strides=None, padding=0, **kwargs):
+        super().__init__(pool_size, strides, padding, "avg", 3, **kwargs)
+
+
+class _GlobalPool(HybridBlock):
+    def __init__(self, pool_type, ndim, layout=None, keep_dims=True, **kwargs):
+        super().__init__(**kwargs)
+        self._pool_type = pool_type
+        self._layout = layout or {1: "NCW", 2: "NCHW", 3: "NCDHW"}[ndim]
+        self._keep = keep_dims
+
+    def hybrid_forward(self, F, x):
+        out = _apply(lambda a, _pt=self._pool_type, _l=self._layout:
+                     K.global_pooling(a, _pt, _l), [x])
+        return out
+
+
+class GlobalMaxPool1D(_GlobalPool):
+    def __init__(self, **kwargs):
+        super().__init__("max", 1, **kwargs)
+
+
+class GlobalMaxPool2D(_GlobalPool):
+    def __init__(self, **kwargs):
+        super().__init__("max", 2, **kwargs)
+
+
+class GlobalMaxPool3D(_GlobalPool):
+    def __init__(self, **kwargs):
+        super().__init__("max", 3, **kwargs)
+
+
+class GlobalAvgPool1D(_GlobalPool):
+    def __init__(self, **kwargs):
+        super().__init__("avg", 1, **kwargs)
+
+
+class GlobalAvgPool2D(_GlobalPool):
+    def __init__(self, **kwargs):
+        super().__init__("avg", 2, **kwargs)
+
+
+class GlobalAvgPool3D(_GlobalPool):
+    def __init__(self, **kwargs):
+        super().__init__("avg", 3, **kwargs)
+
+
+class ReflectionPad2D(HybridBlock):
+    def __init__(self, padding=0, **kwargs):
+        super().__init__(**kwargs)
+        self._padding = padding if not isinstance(padding, int) \
+            else (0, 0, 0, 0, padding, padding, padding, padding)
+
+    def hybrid_forward(self, F, x):
+        import jax.numpy as jnp
+        p = self._padding
+        pairs = tuple((p[i], p[i + 1]) for i in range(0, len(p), 2))
+        return _apply(lambda a, _p=pairs: jnp.pad(a, _p, mode="reflect"), [x])

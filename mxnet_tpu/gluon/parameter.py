@@ -1,0 +1,309 @@
+"""Gluon Parameter / ParameterDict (reference: python/mxnet/gluon/parameter.py).
+
+A Parameter owns one NDArray (weights live once, in HBM — data-parallel
+replication is handled by sharded train steps, not per-device copies) plus an
+optional gradient buffer. Deferred initialisation matches the reference: a
+Parameter created with unknown dims (0) materialises at the first forward once
+shapes are inferred.
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+
+import jax
+import numpy as np
+
+from ..base import MXNetError, _np_dtype
+from ..context import Context, current_context
+from .. import initializer as _initializer
+from .. import random as _random
+from ..ndarray.ndarray import NDArray
+
+__all__ = ["Parameter", "Constant", "ParameterDict", "DeferredInitializationError"]
+
+
+class DeferredInitializationError(MXNetError):
+    """Raised when a deferred-init parameter's data is accessed before the
+    first forward has inferred its shape."""
+
+
+class Parameter:
+    def __init__(self, name, grad_req="write", shape=None, dtype=np.float32,
+                 lr_mult=1.0, wd_mult=1.0, init=None, allow_deferred_init=False,
+                 differentiable=True, stype="default", grad_stype="default"):
+        self.name = name
+        self._grad_req = grad_req if differentiable else "null"
+        if isinstance(shape, int):
+            shape = (shape,)
+        self.shape = tuple(shape) if shape is not None else None
+        self.dtype = _np_dtype(dtype)
+        self.lr_mult = lr_mult
+        self.wd_mult = wd_mult
+        self.init = init
+        self.allow_deferred_init = allow_deferred_init
+        self._data = None            # NDArray
+        self._grad = None            # NDArray
+        self._deferred_init = None   # (initializer, ctx) awaiting shape
+        self._trace_override = None  # traced value during hybridized tracing
+        self._var = None             # symbol variable cache
+
+    # ------------------------------------------------------------------
+    @property
+    def grad_req(self):
+        return self._grad_req
+
+    @grad_req.setter
+    def grad_req(self, req):
+        self._grad_req = req
+        if self._data is not None:
+            if req == "null":
+                self._grad = None
+                self._data._grad = None
+                self._data._grad_req = "null"
+            else:
+                self._init_grad()
+
+    def _shape_is_known(self):
+        return self.shape is not None and all(s > 0 for s in self.shape)
+
+    def initialize(self, init=None, ctx=None, default_init=None,
+                   force_reinit=False):
+        if self._data is not None and not force_reinit:
+            return
+        if init is None:
+            init = self.init if self.init is not None else \
+                (default_init if default_init is not None
+                 else _initializer.Uniform(0.07))
+        init = _initializer.create(init) if not isinstance(
+            init, _initializer.Initializer) else init
+        if ctx is None:
+            ctx = current_context()
+        if isinstance(ctx, (list, tuple)):
+            ctx = ctx[0]  # weights live once; replication is via sharding
+        if not self._shape_is_known():
+            if not self.allow_deferred_init:
+                raise MXNetError(
+                    f"Cannot initialize Parameter {self.name!r}: unknown "
+                    f"shape {self.shape} and deferred init not allowed")
+            self._deferred_init = (init, ctx)
+            return
+        self._finish_init(init, ctx)
+
+    def _finish_init(self, init, ctx):
+        key = _random._next_key()
+        val = init(self.name, self.shape, self.dtype, key)
+        self._data = NDArray(jax.device_put(val, Context(ctx).jax_device))
+        self._deferred_init = None
+        if self._grad_req != "null":
+            self._init_grad()
+
+    def _init_grad(self):
+        import jax.numpy as jnp
+        self._grad = NDArray(jnp.zeros_like(self._data._data))
+        self._data._grad = self._grad
+        self._data._grad_req = self._grad_req
+
+    def _finish_deferred_init(self, inferred_shape):
+        """Called by layers at first forward once the full shape is known."""
+        if self._deferred_init is None:
+            return
+        shape = tuple(inferred_shape)
+        if self.shape is not None:
+            merged = []
+            for have, got in zip(self.shape, shape):
+                if have > 0 and got > 0 and have != got:
+                    raise MXNetError(
+                        f"shape mismatch for {self.name}: declared "
+                        f"{self.shape}, inferred {shape}")
+                merged.append(have if have > 0 else got)
+            shape = tuple(merged)
+        self.shape = shape
+        init, ctx = self._deferred_init
+        self._finish_init(init, ctx)
+
+    # ------------------------------------------------------------------
+    def data(self, ctx=None):
+        if self._trace_override is not None:
+            return self._trace_override
+        if self._data is None:
+            if self._deferred_init is not None:
+                raise DeferredInitializationError(
+                    f"Parameter {self.name!r} has deferred init; run a "
+                    f"forward pass first")
+            raise MXNetError(f"Parameter {self.name!r} has not been "
+                             f"initialized. Call .initialize()")
+        return self._data
+
+    def list_data(self):
+        return [self.data()]
+
+    def grad(self, ctx=None):
+        if self._grad is None:
+            raise MXNetError(f"Parameter {self.name!r} has no gradient "
+                             f"(grad_req={self._grad_req!r})")
+        return self._grad
+
+    def list_grad(self):
+        return [self.grad()]
+
+    def list_ctx(self):
+        if self._data is None:
+            if self._deferred_init:
+                return [self._deferred_init[1]]
+            return []
+        return [self._data.context]
+
+    def set_data(self, data):
+        if not isinstance(data, NDArray):
+            data = NDArray(jax.numpy.asarray(data))
+        if self._data is None:
+            self.shape = data.shape
+            self._data = data.astype(self.dtype) if data.dtype != self.dtype else data
+            self._deferred_init = None
+            if self._grad_req != "null":
+                self._init_grad()
+        else:
+            self._data._rebind(data._data.astype(self._data.dtype))
+            if self._grad is not None:
+                self._data._grad = self._grad
+                self._data._grad_req = self._grad_req
+
+    def zero_grad(self):
+        if self._grad is not None:
+            import jax.numpy as jnp
+            self._grad._rebind(jnp.zeros_like(self._grad._data))
+
+    def reset_ctx(self, ctx):
+        if self._data is not None:
+            self._data._rebind(jax.device_put(self._data._data,
+                                              Context(ctx).jax_device))
+
+    def cast(self, dtype):
+        self.dtype = _np_dtype(dtype)
+        if self._data is not None:
+            self._data._rebind(self._data._data.astype(self.dtype))
+            if self._grad is not None:
+                self._grad._rebind(self._grad._data.astype(self.dtype))
+                self._data._grad = self._grad
+
+    def var(self):
+        from .. import symbol
+        if self._var is None:
+            self._var = symbol.var(self.name, shape=self.shape,
+                                   dtype=self.dtype)
+        return self._var
+
+    def __repr__(self):
+        return f"Parameter {self.name} (shape={self.shape}, dtype={self.dtype})"
+
+
+class Constant(Parameter):
+    """Non-differentiable parameter holding a fixed value."""
+
+    def __init__(self, name, value):
+        if not isinstance(value, NDArray):
+            value = NDArray(jax.numpy.asarray(value))
+        self.value = value
+        super().__init__(name, grad_req="null", shape=value.shape,
+                         dtype=value.dtype,
+                         init=_initializer.Constant(0.0))
+        self._data = value
+
+
+class ParameterDict:
+    """Ordered name->Parameter mapping with a shared prefix."""
+
+    def __init__(self, prefix="", shared=None):
+        self.prefix = prefix
+        self._params = OrderedDict()
+        self._shared = shared
+
+    def items(self):
+        return self._params.items()
+
+    def keys(self):
+        return self._params.keys()
+
+    def values(self):
+        return self._params.values()
+
+    def __iter__(self):
+        return iter(self._params)
+
+    def __len__(self):
+        return len(self._params)
+
+    def __getitem__(self, key):
+        return self._params[key]
+
+    def __contains__(self, key):
+        return key in self._params
+
+    def __repr__(self):
+        lines = "\n".join(f"  {v}" for v in self._params.values())
+        return f"ParameterDict({self.prefix}\n{lines}\n)"
+
+    def get(self, name, **kwargs):
+        """Retrieve or create a parameter with `self.prefix + name`."""
+        full = self.prefix + name
+        if full in self._params:
+            return self._params[full]
+        if self._shared is not None and full in self._shared:
+            self._params[full] = self._shared[full]
+            return self._params[full]
+        param = Parameter(full, **kwargs)
+        self._params[full] = param
+        return param
+
+    def get_constant(self, name, value=None):
+        full = self.prefix + name
+        if full not in self._params:
+            self._params[full] = Constant(full, value)
+        return self._params[full]
+
+    def update(self, other):
+        for k, v in other.items():
+            self._params[k] = v
+
+    def initialize(self, init=None, ctx=None, verbose=False,
+                   force_reinit=False):
+        for p in self._params.values():
+            p.initialize(init=None, ctx=ctx, default_init=init,
+                         force_reinit=force_reinit)
+
+    def zero_grad(self):
+        for p in self._params.values():
+            p.zero_grad()
+
+    def reset_ctx(self, ctx):
+        for p in self._params.values():
+            p.reset_ctx(ctx)
+
+    def setattr(self, name, value):
+        for p in self._params.values():
+            setattr(p, name, value)
+
+    def save(self, filename, strip_prefix=""):
+        import numpy as _np
+        arrays = {}
+        for name, p in self._params.items():
+            if p._data is None:
+                continue
+            key = name[len(strip_prefix):] if name.startswith(strip_prefix) else name
+            arrays[key] = p.data().asnumpy()
+        _np.savez(filename, **arrays)
+
+    def load(self, filename, ctx=None, allow_missing=False,
+             ignore_extra=False, restore_prefix=""):
+        import numpy as _np
+        with _np.load(filename) as f:
+            loaded = {restore_prefix + k: f[k] for k in f.keys()}
+        for name, p in self._params.items():
+            if name in loaded:
+                p.set_data(NDArray(jax.numpy.asarray(loaded[name])))
+            elif not allow_missing:
+                raise MXNetError(f"Parameter {name} missing in {filename}")
+        if not ignore_extra:
+            extra = set(loaded) - set(self._params)
+            if extra:
+                raise MXNetError(f"extra parameters in file: {sorted(extra)}")

@@ -1,0 +1,233 @@
+"""RNN cells (reference: python/mxnet/gluon/rnn/rnn_cell.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...ndarray.ndarray import _apply
+from ..block import HybridBlock
+from .rnn_layer import _step_rnn
+
+__all__ = ["RecurrentCell", "RNNCell", "LSTMCell", "GRUCell",
+           "SequentialRNNCell", "DropoutCell", "ZoneoutCell", "ResidualCell",
+           "BidirectionalCell"]
+
+
+class RecurrentCell(HybridBlock):
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix, params)
+        self._modified = False
+
+    def state_info(self, batch_size=0):
+        raise NotImplementedError
+
+    def begin_state(self, batch_size=0, func=None, ctx=None, **kwargs):
+        from ... import ndarray as F
+        func = func or F.zeros
+        return [func(shape=info["shape"], ctx=ctx, **kwargs)
+                for info in self.state_info(batch_size)]
+
+    def reset(self):
+        pass
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        """Unroll the cell over `length` steps."""
+        from ...ops.tensor_ops import split, stack
+        axis = layout.find("T")
+        if hasattr(inputs, "shape"):
+            seq = split(inputs, length, axis=axis, squeeze_axis=True)
+        else:
+            seq = list(inputs)
+        states = begin_state if begin_state is not None else \
+            self.begin_state(seq[0].shape[0], dtype=seq[0].dtype)
+        outputs = []
+        for t in range(length):
+            out, states = self(seq[t], states)
+            outputs.append(out)
+        if merge_outputs or merge_outputs is None:
+            outputs = stack(*outputs, axis=axis)
+        return outputs, states
+
+
+class _GatedCell(RecurrentCell):
+    _mode = None
+    _ngates = 1
+
+    def __init__(self, hidden_size, i2h_weight_initializer=None,
+                 h2h_weight_initializer=None, i2h_bias_initializer="zeros",
+                 h2h_bias_initializer="zeros", input_size=0, **kwargs):
+        super().__init__(**kwargs)
+        self._hidden_size = hidden_size
+        self._input_size = input_size
+        ng = self._ngates
+        with self.name_scope():
+            self.i2h_weight = self.params.get(
+                "i2h_weight", shape=(ng * hidden_size, input_size),
+                init=i2h_weight_initializer, allow_deferred_init=True)
+            self.h2h_weight = self.params.get(
+                "h2h_weight", shape=(ng * hidden_size, hidden_size),
+                init=h2h_weight_initializer)
+            self.i2h_bias = self.params.get(
+                "i2h_bias", shape=(ng * hidden_size,),
+                init=i2h_bias_initializer)
+            self.h2h_bias = self.params.get(
+                "h2h_bias", shape=(ng * hidden_size,),
+                init=h2h_bias_initializer)
+
+    def _infer_shapes(self, x, *args):
+        self.i2h_weight._finish_deferred_init(
+            (self._ngates * self._hidden_size, x.shape[-1]))
+        self._input_size = x.shape[-1]
+
+    def state_info(self, batch_size=0):
+        n = 2 if self._mode == "lstm" else 1
+        return [{"shape": (batch_size, self._hidden_size), "__layout__": "NC"}
+                for _ in range(n)]
+
+    def hybrid_forward(self, F, x, states, i2h_weight, h2h_weight, i2h_bias,
+                       h2h_bias):
+        mode = self._mode
+        ns = 2 if mode == "lstm" else 1
+        state_list = states if isinstance(states, (list, tuple)) else [states]
+
+        def fn(xv, *rest, _m=mode, _ns=ns):
+            svals, (wi, wh, bi, bh) = rest[:_ns], rest[_ns:]
+            new_states, out = _step_rnn(_m, xv, tuple(svals), wi, wh, bi, bh)
+            return (out,) + tuple(new_states)
+
+        flat = _apply(fn, [x] + list(state_list)
+                      + [i2h_weight, h2h_weight, i2h_bias, h2h_bias],
+                      n_out=1 + ns)
+        return flat[0], list(flat[1:])
+
+
+class RNNCell(_GatedCell):
+    _ngates = 1
+
+    def __init__(self, hidden_size, activation="tanh", **kwargs):
+        self._mode = f"rnn_{activation}"
+        super().__init__(hidden_size, **kwargs)
+
+
+class LSTMCell(_GatedCell):
+    _mode = "lstm"
+    _ngates = 4
+
+
+class GRUCell(_GatedCell):
+    _mode = "gru"
+    _ngates = 3
+
+
+class SequentialRNNCell(RecurrentCell):
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix, params)
+
+    def add(self, cell):
+        self.register_child(cell)
+
+    def state_info(self, batch_size=0):
+        infos = []
+        for c in self._children.values():
+            infos.extend(c.state_info(batch_size))
+        return infos
+
+    def __call__(self, x, states):
+        next_states = []
+        p = 0
+        for cell in self._children.values():
+            n = len(cell.state_info())
+            x, s = cell(x, states[p:p + n])
+            next_states.extend(s)
+            p += n
+        return x, next_states
+
+    def hybrid_forward(self, F, *args, **kwargs):
+        raise NotImplementedError("SequentialRNNCell dispatches to children")
+
+
+class DropoutCell(RecurrentCell):
+    def __init__(self, rate, **kwargs):
+        super().__init__(**kwargs)
+        self._rate = rate
+
+    def state_info(self, batch_size=0):
+        return []
+
+    def __call__(self, x, states):
+        from ... import autograd
+        if self._rate and autograd.is_training():
+            from ..block import _layer_rng
+            key = _layer_rng()
+            x = _apply(lambda a, _k=key, _p=self._rate: jnp.where(
+                jax.random.bernoulli(_k, 1 - _p, a.shape),
+                a / (1 - _p), 0).astype(a.dtype), [x])
+        return x, states
+
+
+class ZoneoutCell(RecurrentCell):
+    def __init__(self, base_cell, zoneout_outputs=0.0, zoneout_states=0.0,
+                 **kwargs):
+        super().__init__(**kwargs)
+        self.base_cell = base_cell
+        self._zo, self._zs = zoneout_outputs, zoneout_states
+        self._prev_output = None
+
+    def state_info(self, batch_size=0):
+        return self.base_cell.state_info(batch_size)
+
+    def __call__(self, x, states):
+        from ... import autograd
+        out, next_states = self.base_cell(x, states)
+        if autograd.is_training() and self._zs:
+            from ..block import _layer_rng
+            mixed = []
+            for old, new in zip(states, next_states):
+                key = _layer_rng()
+                mixed.append(_apply(
+                    lambda o, n, _k=key, _p=self._zs: jnp.where(
+                        jax.random.bernoulli(_k, _p, n.shape), o, n),
+                    [old, new]))
+            next_states = mixed
+        return out, next_states
+
+
+class ResidualCell(RecurrentCell):
+    def __init__(self, base_cell, **kwargs):
+        super().__init__(**kwargs)
+        self.base_cell = base_cell
+
+    def state_info(self, batch_size=0):
+        return self.base_cell.state_info(batch_size)
+
+    def __call__(self, x, states):
+        out, next_states = self.base_cell(x, states)
+        return out + x, next_states
+
+
+class BidirectionalCell(RecurrentCell):
+    def __init__(self, l_cell, r_cell, **kwargs):
+        super().__init__(**kwargs)
+        self.l_cell = l_cell
+        self.r_cell = r_cell
+
+    def state_info(self, batch_size=0):
+        return self.l_cell.state_info(batch_size) + \
+            self.r_cell.state_info(batch_size)
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        from ...ops.tensor_ops import concat
+        nl = len(self.l_cell.state_info())
+        states = begin_state or self.begin_state(
+            inputs.shape[layout.find("N")], dtype=inputs.dtype)
+        l_out, l_states = self.l_cell.unroll(
+            length, inputs, states[:nl], layout, True)
+        from ...ops.tensor_ops import flip
+        axis = layout.find("T")
+        rev = flip(inputs, axis)
+        r_out, r_states = self.r_cell.unroll(length, rev, states[nl:],
+                                             layout, True)
+        r_out = flip(r_out, axis)
+        return concat(l_out, r_out, dim=-1), l_states + r_states

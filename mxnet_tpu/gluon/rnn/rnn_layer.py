@@ -1,0 +1,183 @@
+"""Recurrent layers (reference: python/mxnet/gluon/rnn/rnn_layer.py).
+
+TPU-native: the whole sequence loop is a `lax.scan`, so a multi-layer
+(bi)LSTM compiles to one fused XLA while-loop with MXU matmuls — the
+counterpart of the reference's fused cuDNN RNN op (src/operator/rnn.cc).
+Gate layout matches the reference: [i, f, g, o] for LSTM, [r, z, n] for GRU.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...ndarray.ndarray import NDArray, _apply
+from ..block import HybridBlock
+
+__all__ = ["RNN", "LSTM", "GRU"]
+
+
+def _step_rnn(mode, x_t, states, wi, wh, bi, bh):
+    """One timestep. x_t: (N, I). Returns (new_states, output)."""
+    if mode == "lstm":
+        h, c = states
+        gates = x_t @ wi.T + bi + h @ wh.T + bh
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        c = jax.nn.sigmoid(f) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+        h = jax.nn.sigmoid(o) * jnp.tanh(c)
+        return (h, c), h
+    if mode == "gru":
+        (h,) = states
+        xw = x_t @ wi.T + bi
+        hw = h @ wh.T + bh
+        xr, xz, xn = jnp.split(xw, 3, axis=-1)
+        hr, hz, hn = jnp.split(hw, 3, axis=-1)
+        r = jax.nn.sigmoid(xr + hr)
+        z = jax.nn.sigmoid(xz + hz)
+        n = jnp.tanh(xn + r * hn)
+        h = (1 - z) * n + z * h
+        return (h,), h
+    (h,) = states
+    g = x_t @ wi.T + bi + h @ wh.T + bh
+    h = jnp.tanh(g) if mode == "rnn_tanh" else jax.nn.relu(g)
+    return (h,), h
+
+
+def _scan_layer(mode, x, init_states, wi, wh, bi, bh, reverse=False):
+    """x: (T, N, I) -> outputs (T, N, H), final states."""
+    def step(carry, x_t):
+        new_states, out = _step_rnn(mode, x_t, carry, wi, wh, bi, bh)
+        return new_states, out
+
+    final, outs = jax.lax.scan(step, init_states, x, reverse=reverse)
+    return outs, final
+
+
+class _RNNLayer(HybridBlock):
+    def __init__(self, mode, hidden_size, num_layers=1, layout="TNC",
+                 dropout=0.0, bidirectional=False, input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 dtype="float32", **kwargs):
+        super().__init__(**kwargs)
+        assert layout in ("TNC", "NTC")
+        self._mode = mode
+        self._hidden_size = hidden_size
+        self._num_layers = num_layers
+        self._layout = layout
+        self._dropout = dropout
+        self._dir = 2 if bidirectional else 1
+        self._input_size = input_size
+        self._gates = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}[mode]
+        ng, nh = self._gates, hidden_size
+        with self.name_scope():
+            for layer in range(num_layers):
+                for d, suffix in zip(range(self._dir), ["l", "r"]):
+                    in_size = input_size if layer == 0 else nh * self._dir
+                    for name, shape, init_ in [
+                            ("i2h_weight", (ng * nh, in_size),
+                             i2h_weight_initializer),
+                            ("h2h_weight", (ng * nh, nh),
+                             h2h_weight_initializer),
+                            ("i2h_bias", (ng * nh,), i2h_bias_initializer),
+                            ("h2h_bias", (ng * nh,), h2h_bias_initializer)]:
+                        p = self.params.get(
+                            f"{suffix}{layer}_{name}", shape=shape,
+                            init=init_, dtype=dtype,
+                            allow_deferred_init=(layer == 0 and "i2h_weight"
+                                                 in name and input_size == 0))
+                        self._reg_params[f"{suffix}{layer}_{name}"] = p
+
+    def _infer_shapes(self, x, *args):
+        in_size = x.shape[-1]
+        ng, nh = self._gates, self._hidden_size
+        for d, suffix in zip(range(self._dir), ["l", "r"]):
+            self._reg_params[f"{suffix}0_i2h_weight"]._finish_deferred_init(
+                (ng * nh, in_size))
+        self._input_size = in_size
+
+    def state_info(self, batch_size=0):
+        ns = 2 if self._mode == "lstm" else 1
+        return [{"shape": (self._num_layers * self._dir, batch_size,
+                           self._hidden_size), "__layout__": "LNC"}
+                for _ in range(ns)]
+
+    def begin_state(self, batch_size=0, func=None, ctx=None, **kwargs):
+        from ... import ndarray as F
+        func = func or F.zeros
+        return [func(shape=info["shape"], ctx=ctx, **kwargs)
+                for info in self.state_info(batch_size)]
+
+    def hybrid_forward(self, F, x, *states, **params):
+        layout_ntc = self._layout == "NTC"
+        has_states = len(states) > 0
+        ns = 2 if self._mode == "lstm" else 1
+        if not has_states:
+            batch = x.shape[0] if layout_ntc else x.shape[1]
+            states = self.begin_state(batch, dtype=x.dtype)
+        state_inputs = list(states)
+
+        pnames = sorted(params.keys())
+        pvals = [params[k] for k in pnames]
+        mode, L, D, H = self._mode, self._num_layers, self._dir, self._hidden_size
+        dropout = self._dropout
+        from ... import autograd
+        training = autograd.is_training()
+
+        def fn(xv, *rest, _pn=tuple(pnames)):
+            svals = rest[:ns]
+            pv = dict(zip(_pn, rest[ns:]))
+            seq = jnp.swapaxes(xv, 0, 1) if layout_ntc else xv  # (T,N,I)
+            hs = [svals[0][i] for i in range(L * D)]
+            cs = [svals[1][i] for i in range(L * D)] if mode == "lstm" else None
+            out = seq
+            final_h, final_c = [], []
+            for layer in range(L):
+                layer_outs = []
+                for d, sfx in zip(range(D), ["l", "r"]):
+                    idx = layer * D + d
+                    init = (hs[idx], cs[idx]) if mode == "lstm" else (hs[idx],)
+                    o, fin = _scan_layer(
+                        mode, out, init,
+                        pv[f"{sfx}{layer}_i2h_weight"],
+                        pv[f"{sfx}{layer}_h2h_weight"],
+                        pv[f"{sfx}{layer}_i2h_bias"],
+                        pv[f"{sfx}{layer}_h2h_bias"],
+                        reverse=(d == 1))
+                    layer_outs.append(o)
+                    final_h.append(fin[0])
+                    if mode == "lstm":
+                        final_c.append(fin[1])
+                out = layer_outs[0] if D == 1 else \
+                    jnp.concatenate(layer_outs, axis=-1)
+            outs = jnp.swapaxes(out, 0, 1) if layout_ntc else out
+            ret = [outs, jnp.stack(final_h)]
+            if mode == "lstm":
+                ret.append(jnp.stack(final_c))
+            return tuple(ret)
+
+        flat = _apply(fn, [x] + state_inputs + pvals, n_out=2 + (ns - 1))
+        out = flat[0]
+        new_states = list(flat[1:])
+        if has_states:
+            return out, new_states
+        return out
+
+    def __repr__(self):
+        return (f"{type(self).__name__}({self._input_size} -> "
+                f"{self._hidden_size}, layers={self._num_layers}, "
+                f"bidirectional={self._dir == 2})")
+
+
+class RNN(_RNNLayer):
+    def __init__(self, hidden_size, num_layers=1, activation="tanh", **kwargs):
+        super().__init__(f"rnn_{activation}", hidden_size, num_layers, **kwargs)
+
+
+class LSTM(_RNNLayer):
+    def __init__(self, hidden_size, num_layers=1, **kwargs):
+        super().__init__("lstm", hidden_size, num_layers, **kwargs)
+
+
+class GRU(_RNNLayer):
+    def __init__(self, hidden_size, num_layers=1, **kwargs):
+        super().__init__("gru", hidden_size, num_layers, **kwargs)

@@ -1,0 +1,115 @@
+"""Gluon Trainer (reference: python/mxnet/gluon/trainer.py).
+
+Applies an optimizer to a set of Parameters after backward. Gradient
+aggregation rides the KVStore: 'device'/'local' aggregate locally; 'ici'
+lowers to psum over the mesh (see mxnet_tpu/kvstore.py). For the fully-fused
+path (whole train step as one XLA executable) see
+mxnet_tpu/parallel/data_parallel.py — this imperative Trainer matches the
+reference's semantics for Gluon scripts.
+"""
+from __future__ import annotations
+
+from ..base import MXNetError
+from .. import optimizer as opt_mod
+from .. import kvstore as kvs_mod
+from .parameter import Parameter, ParameterDict
+
+__all__ = ["Trainer"]
+
+
+class Trainer:
+    def __init__(self, params, optimizer, optimizer_params=None, kvstore="device",
+                 compression_params=None, update_on_kvstore=None):
+        if isinstance(params, (dict, ParameterDict)):
+            params = list(params.values())
+        if not isinstance(params, (list, tuple)):
+            raise MXNetError("params must be a ParameterDict or list of Parameter")
+        self._params = []
+        self._param2idx = {}
+        for i, p in enumerate(params):
+            if not isinstance(p, Parameter):
+                raise MXNetError(f"invalid parameter {p}")
+            self._params.append(p)
+            self._param2idx[p.name] = i
+        optimizer_params = optimizer_params or {}
+        param_dict = {i: p for i, p in enumerate(self._params)}
+        self._optimizer = opt_mod.create(optimizer, param_dict=param_dict,
+                                         **optimizer_params)
+        self._updater = opt_mod.get_updater(self._optimizer)
+        self._kvstore = kvs_mod.create(kvstore) if kvstore else None
+        self._kv_initialized = False
+        self._scale = 1.0
+
+    @property
+    def learning_rate(self):
+        return self._optimizer.learning_rate
+
+    @property
+    def optimizer(self):
+        return self._optimizer
+
+    def set_learning_rate(self, lr):
+        self._optimizer.set_learning_rate(lr)
+
+    def _init_kvstore(self):
+        if self._kvstore is not None:
+            for i, p in enumerate(self._params):
+                if p._data is not None:
+                    self._kvstore.init(i, p.data())
+        self._kv_initialized = True
+
+    def allreduce_grads(self):
+        """Aggregate gradients across devices (reference: _allreduce_grads).
+        With single-replica HBM-resident params this is a no-op; 'ici'
+        sharded grads psum via the kvstore."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._kvstore is not None and self._kvstore.type == "ici":
+            for i, p in enumerate(self._params):
+                if p.grad_req != "null" and p._grad is not None:
+                    agg = self._kvstore.allreduce_([p._grad._data])
+                    p._grad._rebind(agg)
+
+    def step(self, batch_size, ignore_stale_grad=False):
+        """Rescale gradients by 1/batch_size and apply one optimizer step."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self.allreduce_grads()
+        self._update(ignore_stale_grad)
+
+    def update(self, batch_size, ignore_stale_grad=False):
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self._update(ignore_stale_grad)
+
+    def _update(self, ignore_stale_grad=False):
+        for i, p in enumerate(self._params):
+            if p.grad_req == "null" or p._data is None:
+                continue
+            if p._grad is None:
+                if ignore_stale_grad:
+                    continue
+                raise MXNetError(f"Parameter {p.name} has no gradient; run "
+                                 f"backward first or set ignore_stale_grad")
+            self._updater(i, p.grad(), p.data())
+
+    def save_states(self, fname):
+        import pickle
+        import numpy as np
+        import jax
+        states = {k: jax.tree_util.tree_map(lambda x: np.asarray(x._data), v)
+                  for k, v in self._updater.states.items()}
+        with open(fname, "wb") as f:
+            pickle.dump({"num_update": self._optimizer.num_update,
+                         "states": states}, f)
+
+    def load_states(self, fname):
+        import pickle
+        from ..ndarray.ndarray import NDArray
+        import jax.numpy as jnp
+        with open(fname, "rb") as f:
+            blob = pickle.load(f)
+        self._optimizer.num_update = blob["num_update"]
+        self._updater.states = {
+            k: tuple(NDArray(jnp.asarray(s)) for s in v)
+            for k, v in blob["states"].items()}

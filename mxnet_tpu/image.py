@@ -1,0 +1,152 @@
+"""mx.image (reference: python/mxnet/image/image.py).
+
+Image ops over HWC NDArrays. Decoding uses numpy-compatible formats (npy/raw)
+since no image codecs are guaranteed offline; resize/crop/flip augmenters run
+through jax.image on device.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .base import MXNetError
+from .ndarray.ndarray import NDArray, array, _apply
+
+__all__ = ["imread", "imdecode", "imresize", "resize_short", "fixed_crop",
+           "center_crop", "random_crop", "HorizontalFlipAug", "ResizeAug",
+           "CenterCropAug", "RandomCropAug", "ColorNormalizeAug",
+           "CreateAugmenter", "Augmenter"]
+
+
+def imread(filename, flag=1, to_rgb=True):
+    if filename.endswith(".npy"):
+        return array(np.load(filename))
+    raise MXNetError("offline build: only .npy images supported in imread")
+
+
+def imdecode(buf, flag=1, to_rgb=True):
+    arr = np.frombuffer(buf, dtype=np.uint8)
+    side = int(np.sqrt(arr.size // 3))
+    return array(arr[:side * side * 3].reshape(side, side, 3))
+
+
+def imresize(src, w, h, interp=1):
+    import jax.image
+
+    def fn(a, _w=w, _h=h):
+        return jax.image.resize(a.astype("float32"), (_h, _w, a.shape[2]),
+                                method="bilinear")
+    return _apply(fn, [src])
+
+
+def resize_short(src, size, interp=1):
+    h, w = src.shape[0], src.shape[1]
+    if h > w:
+        new_w, new_h = size, int(h * size / w)
+    else:
+        new_w, new_h = int(w * size / h), size
+    return imresize(src, new_w, new_h, interp)
+
+
+def fixed_crop(src, x0, y0, w, h, size=None, interp=1):
+    out = src[y0:y0 + h, x0:x0 + w, :]
+    if size is not None and (w, h) != size:
+        out = imresize(out, size[0], size[1], interp)
+    return out
+
+
+def center_crop(src, size, interp=1):
+    h, w = src.shape[0], src.shape[1]
+    new_w, new_h = size
+    x0 = max((w - new_w) // 2, 0)
+    y0 = max((h - new_h) // 2, 0)
+    return fixed_crop(src, x0, y0, min(new_w, w), min(new_h, h), size), \
+        (x0, y0, new_w, new_h)
+
+
+def random_crop(src, size, interp=1):
+    h, w = src.shape[0], src.shape[1]
+    new_w, new_h = min(size[0], w), min(size[1], h)
+    x0 = np.random.randint(0, w - new_w + 1)
+    y0 = np.random.randint(0, h - new_h + 1)
+    return fixed_crop(src, x0, y0, new_w, new_h, size), (x0, y0, new_w, new_h)
+
+
+class Augmenter:
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def __call__(self, src):
+        raise NotImplementedError
+
+
+class ResizeAug(Augmenter):
+    def __init__(self, size, interp=1):
+        super().__init__(size=size)
+        self.size = size
+
+    def __call__(self, src):
+        return resize_short(src, self.size)
+
+
+class CenterCropAug(Augmenter):
+    def __init__(self, size, interp=1):
+        super().__init__(size=size)
+        self.size = size
+
+    def __call__(self, src):
+        return center_crop(src, self.size)[0]
+
+
+class RandomCropAug(Augmenter):
+    def __init__(self, size, interp=1):
+        super().__init__(size=size)
+        self.size = size
+
+    def __call__(self, src):
+        return random_crop(src, self.size)[0]
+
+
+class HorizontalFlipAug(Augmenter):
+    def __init__(self, p=0.5):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src):
+        if np.random.rand() < self.p:
+            return src[:, ::-1, :]
+        return src
+
+
+class ColorNormalizeAug(Augmenter):
+    def __init__(self, mean, std):
+        super().__init__(mean=mean, std=std)
+        self.mean = array(np.asarray(mean, np.float32)) \
+            if not isinstance(mean, NDArray) else mean
+        self.std = array(np.asarray(std, np.float32)) \
+            if not isinstance(std, NDArray) else std
+
+    def __call__(self, src):
+        return (src.astype("float32") - self.mean) / self.std
+
+
+def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_mirror=False,
+                    mean=None, std=None, **kwargs):
+    """Build the reference's standard augmentation pipeline."""
+    auglist = []
+    if resize > 0:
+        auglist.append(ResizeAug(resize))
+    crop_size = (data_shape[2], data_shape[1])
+    if rand_crop:
+        auglist.append(RandomCropAug(crop_size))
+    else:
+        auglist.append(CenterCropAug(crop_size))
+    if rand_mirror:
+        auglist.append(HorizontalFlipAug(0.5))
+    if mean is True:
+        mean = np.array([123.68, 116.28, 103.53], np.float32)
+    if std is True:
+        std = np.array([58.395, 57.12, 57.375], np.float32)
+    if mean is not None and mean is not False:
+        auglist.append(ColorNormalizeAug(mean, std if std is not None
+                                         and std is not False else [1, 1, 1]))
+    return auglist

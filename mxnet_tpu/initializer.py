@@ -1,0 +1,235 @@
+"""Weight initializers (reference: python/mxnet/initializer.py).
+
+Each initializer is a callable `init(name, shape, dtype, key) -> jax.Array`;
+randomness comes from an explicit JAX key so deferred Gluon initialisation is
+reproducible under `mx.random.seed`.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .base import _np_dtype
+
+__all__ = ["Initializer", "Zero", "One", "Constant", "Uniform", "Normal",
+           "Orthogonal", "Xavier", "MSRAPrelu", "Bilinear", "LSTMBias",
+           "Mixed", "register", "create"]
+
+_REGISTRY = {}
+
+
+def register(klass):
+    _REGISTRY[klass.__name__.lower()] = klass
+    return klass
+
+
+def create(initializer, **kwargs):
+    if initializer is None:
+        return Uniform(0.07)
+    if isinstance(initializer, Initializer):
+        return initializer
+    if isinstance(initializer, str):
+        name = initializer.lower()
+        aliases = {"zeros": "zero", "ones": "one", "gaussian": "normal",
+                   "msra": "msraprelu", "he": "msraprelu",
+                   "glorot": "xavier"}
+        name = aliases.get(name, name)
+        if name not in _REGISTRY:
+            raise ValueError(f"unknown initializer {initializer!r}; "
+                             f"registered: {sorted(_REGISTRY)}")
+        return _REGISTRY[name](**kwargs)
+    raise TypeError(f"cannot create initializer from {type(initializer)}")
+
+
+class Initializer:
+    """Base initializer. Subclasses implement `_init(shape, dtype, key)`."""
+
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def init_array(self, name, shape, dtype, key):
+        """Dispatch on parameter name like the reference InitDesc path:
+        bias/gamma/beta/running stats get their canonical values."""
+        dtype = _np_dtype(dtype)
+        if name.endswith("gamma") or name.endswith("running_var") \
+                or name.endswith("moving_var"):
+            return jnp.ones(shape, dtype)
+        if name.endswith("bias") or name.endswith("beta") \
+                or name.endswith("running_mean") or name.endswith("moving_mean"):
+            return jnp.zeros(shape, dtype)
+        return self._init(shape, dtype, key)
+
+    def _init(self, shape, dtype, key):
+        raise NotImplementedError
+
+    def __call__(self, name, shape, dtype, key):
+        return self.init_array(name, shape, dtype, key)
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self._kwargs})"
+
+
+@register
+class Zero(Initializer):
+    def _init(self, shape, dtype, key):
+        return jnp.zeros(shape, dtype)
+
+
+@register
+class One(Initializer):
+    def _init(self, shape, dtype, key):
+        return jnp.ones(shape, dtype)
+
+
+@register
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        super().__init__(value=value)
+        self.value = value
+
+    def _init(self, shape, dtype, key):
+        return jnp.full(shape, self.value, dtype)
+
+
+@register
+class Uniform(Initializer):
+    def __init__(self, scale=0.07):
+        super().__init__(scale=scale)
+        self.scale = scale
+
+    def _init(self, shape, dtype, key):
+        return jax.random.uniform(key, shape, jnp.float32,
+                                  -self.scale, self.scale).astype(dtype)
+
+
+@register
+class Normal(Initializer):
+    def __init__(self, sigma=0.01):
+        super().__init__(sigma=sigma)
+        self.sigma = sigma
+
+    def _init(self, shape, dtype, key):
+        return (self.sigma * jax.random.normal(key, shape)).astype(dtype)
+
+
+@register
+class Orthogonal(Initializer):
+    def __init__(self, scale=1.414, rand_type="uniform"):
+        super().__init__(scale=scale, rand_type=rand_type)
+        self.scale = scale
+
+    def _init(self, shape, dtype, key):
+        rows = shape[0]
+        cols = int(np.prod(shape[1:])) if len(shape) > 1 else 1
+        a = jax.random.normal(key, (max(rows, cols), min(rows, cols)))
+        q, r = jnp.linalg.qr(a)
+        q = q * jnp.sign(jnp.diagonal(r))
+        if rows < cols:
+            q = q.T
+        return (self.scale * q[:rows, :cols].reshape(shape)).astype(dtype)
+
+
+@register
+class Xavier(Initializer):
+    """Glorot init (reference supports uniform/gaussian, avg/in/out)."""
+
+    def __init__(self, rnd_type="uniform", factor_type="avg", magnitude=3):
+        super().__init__(rnd_type=rnd_type, factor_type=factor_type,
+                         magnitude=magnitude)
+        self.rnd_type = rnd_type
+        self.factor_type = factor_type
+        self.magnitude = float(magnitude)
+
+    def _fans(self, shape):
+        hw = int(np.prod(shape[2:])) if len(shape) > 2 else 1
+        fan_out = shape[0] * hw
+        fan_in = (shape[1] if len(shape) > 1 else shape[0]) * hw
+        return fan_in, fan_out
+
+    def _init(self, shape, dtype, key):
+        fan_in, fan_out = self._fans(shape)
+        factor = {"avg": (fan_in + fan_out) / 2.0,
+                  "in": fan_in, "out": fan_out}[self.factor_type]
+        scale = math.sqrt(self.magnitude / max(factor, 1.0))
+        if self.rnd_type == "uniform":
+            out = jax.random.uniform(key, shape, jnp.float32, -scale, scale)
+        else:
+            out = scale * jax.random.normal(key, shape)
+        return out.astype(dtype)
+
+
+@register
+class MSRAPrelu(Xavier):
+    """He initialisation (reference: MSRAPrelu)."""
+
+    def __init__(self, factor_type="avg", slope=0.25):
+        magnitude = 2.0 / (1 + slope ** 2)
+        super().__init__("gaussian", factor_type, magnitude)
+        self._kwargs = {"factor_type": factor_type, "slope": slope}
+
+
+@register
+class Bilinear(Initializer):
+    """Bilinear upsampling kernel for Deconvolution."""
+
+    def _init(self, shape, dtype, key):
+        weight = np.zeros(shape, dtype=np.float32)
+        f = math.ceil(shape[3] / 2.0)
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        for i in range(int(np.prod(shape))):
+            x = i % shape[3]
+            y = (i // shape[3]) % shape[2]
+            weight.flat[i] = (1 - abs(x / f - c)) * (1 - abs(y / f - c))
+        return jnp.asarray(weight, dtype)
+
+
+@register
+class LSTMBias(Initializer):
+    """Forget-gate bias = 1.0, others 0 (reference: LSTMBias)."""
+
+    def __init__(self, forget_bias=1.0):
+        super().__init__(forget_bias=forget_bias)
+        self.forget_bias = forget_bias
+
+    def _init(self, shape, dtype, key):
+        b = np.zeros(shape, dtype=np.float32)
+        n = shape[0] // 4
+        b[n:2 * n] = self.forget_bias
+        return jnp.asarray(b, dtype)
+
+
+class Mixed(Initializer):
+    """Pattern-matched per-name initializers (reference: Mixed)."""
+
+    def __init__(self, patterns, initializers):
+        import re
+        super().__init__()
+        self.map = [(re.compile(p), i) for p, i in zip(patterns, initializers)]
+
+    def init_array(self, name, shape, dtype, key):
+        for pat, init_ in self.map:
+            if pat.search(name):
+                return init_.init_array(name, shape, dtype, key)
+        raise ValueError(f"parameter {name} did not match any pattern")
+
+
+# convenience namespace mirroring mx.init.*
+class _InitNamespace:
+    Zero = Zero
+    One = One
+    Constant = Constant
+    Uniform = Uniform
+    Normal = Normal
+    Orthogonal = Orthogonal
+    Xavier = Xavier
+    MSRAPrelu = MSRAPrelu
+    Bilinear = Bilinear
+    LSTMBias = LSTMBias
+    Mixed = Mixed
+    Initializer = Initializer
+
+
+init = _InitNamespace

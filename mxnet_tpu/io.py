@@ -1,0 +1,281 @@
+"""mx.io data iterators (reference: python/mxnet/io/).
+
+NDArrayIter & friends with the reference's DataBatch/DataDesc protocol.
+ImageRecordIter is backed by the synthetic image pipeline (no network /
+recordio files in this environment) with identical shapes and API.
+"""
+from __future__ import annotations
+
+from collections import namedtuple
+
+import numpy as np
+
+from .base import MXNetError, _as_list
+from .ndarray.ndarray import NDArray, array
+
+__all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
+           "PrefetchingIter", "ImageRecordIter", "CSVIter"]
+
+DataDesc = namedtuple("DataDesc", ["name", "shape"])
+
+
+class DataBatch:
+    def __init__(self, data, label=None, pad=0, index=None,
+                 provide_data=None, provide_label=None):
+        self.data = data
+        self.label = label
+        self.pad = pad
+        self.index = index
+        self.provide_data = provide_data
+        self.provide_label = provide_label
+
+
+class DataIter:
+    def __init__(self, batch_size=0):
+        self.batch_size = batch_size
+
+    def __iter__(self):
+        return self
+
+    def reset(self):
+        pass
+
+    def next(self):
+        raise NotImplementedError
+
+    def __next__(self):
+        return self.next()
+
+    @property
+    def provide_data(self):
+        raise NotImplementedError
+
+    @property
+    def provide_label(self):
+        raise NotImplementedError
+
+
+def _init_data(data, allow_empty, default_name):
+    if data is None:
+        return []
+    if isinstance(data, (np.ndarray, NDArray)):
+        data = [data]
+    if isinstance(data, (list, tuple)):
+        data = {f"{default_name}{i if i else ''}" if len(data) > 1
+                else default_name: d for i, d in enumerate(data)}
+    out = []
+    for k, v in data.items():
+        if not isinstance(v, NDArray):
+            v = array(np.asarray(v, dtype=np.float32)
+                      if np.asarray(v).dtype == np.float64 else np.asarray(v))
+        out.append((k, v))
+    return out
+
+
+class NDArrayIter(DataIter):
+    """Iterate over in-memory arrays (reference: io.NDArrayIter)."""
+
+    def __init__(self, data, label=None, batch_size=1, shuffle=False,
+                 last_batch_handle="pad", data_name="data",
+                 label_name="softmax_label"):
+        super().__init__(batch_size)
+        self.data = _init_data(data, False, data_name)
+        self.label = _init_data(label, True, label_name)
+        self.num_data = self.data[0][1].shape[0]
+        self._shuffle = shuffle
+        self._last_batch_handle = last_batch_handle
+        self.cursor = -batch_size
+        self._order = np.arange(self.num_data)
+        if shuffle:
+            np.random.shuffle(self._order)
+
+    @property
+    def provide_data(self):
+        return [DataDesc(k, (self.batch_size,) + v.shape[1:])
+                for k, v in self.data]
+
+    @property
+    def provide_label(self):
+        return [DataDesc(k, (self.batch_size,) + v.shape[1:])
+                for k, v in self.label]
+
+    def reset(self):
+        self.cursor = -self.batch_size
+        if self._shuffle:
+            np.random.shuffle(self._order)
+
+    def iter_next(self):
+        self.cursor += self.batch_size
+        return self.cursor < self.num_data
+
+    def next(self):
+        if not self.iter_next():
+            raise StopIteration
+        lo = self.cursor
+        hi = min(lo + self.batch_size, self.num_data)
+        idx = self._order[lo:hi]
+        pad = 0
+        if hi - lo < self.batch_size:
+            if self._last_batch_handle == "discard":
+                raise StopIteration
+            pad = self.batch_size - (hi - lo)
+            idx = np.concatenate([idx, self._order[:pad]])
+
+        def take(arrs):
+            return [NDArray(v._data[idx]) for _, v in arrs]
+        return DataBatch(take(self.data), take(self.label), pad=pad,
+                         provide_data=self.provide_data,
+                         provide_label=self.provide_label)
+
+    def getdata(self):
+        return [v for _, v in self.data]
+
+    def getlabel(self):
+        return [v for _, v in self.label]
+
+
+class ResizeIter(DataIter):
+    """Resize an iterator to a fixed number of batches (reference)."""
+
+    def __init__(self, data_iter, size, reset_internal=True):
+        super().__init__(data_iter.batch_size)
+        self.data_iter = data_iter
+        self.size = size
+        self.reset_internal = reset_internal
+        self.cur = 0
+
+    @property
+    def provide_data(self):
+        return self.data_iter.provide_data
+
+    @property
+    def provide_label(self):
+        return self.data_iter.provide_label
+
+    def reset(self):
+        self.cur = 0
+        if self.reset_internal:
+            self.data_iter.reset()
+
+    def next(self):
+        if self.cur == self.size:
+            raise StopIteration
+        try:
+            batch = self.data_iter.next()
+        except StopIteration:
+            self.data_iter.reset()
+            batch = self.data_iter.next()
+        self.cur += 1
+        return batch
+
+
+class PrefetchingIter(DataIter):
+    """Threaded prefetch wrapper (reference: PrefetchingIter) driven by the
+    execution engine's threadpool."""
+
+    def __init__(self, iters, rename_data=None, rename_label=None):
+        iters = _as_list(iters)
+        if len(iters) != 1:
+            raise MXNetError("PrefetchingIter supports one backing iter")
+        super().__init__(iters[0].batch_size)
+        self.iter = iters[0]
+        self._pending = None
+        self._submit()
+
+    def _submit(self):
+        from . import engine
+
+        def fetch():
+            try:
+                return self.iter.next()
+            except StopIteration:
+                return None
+        self._pending = engine.push(fetch)
+
+    @property
+    def provide_data(self):
+        return self.iter.provide_data
+
+    @property
+    def provide_label(self):
+        return self.iter.provide_label
+
+    def reset(self):
+        if self._pending is not None:
+            self._pending.result()
+        self.iter.reset()
+        self._submit()
+
+    def next(self):
+        batch = self._pending.result()
+        if batch is None:
+            raise StopIteration
+        self._submit()
+        return batch
+
+
+class ImageRecordIter(DataIter):
+    """Synthetic ImageRecordIter (reference reads .rec files; offline here).
+
+    Produces deterministic random images shaped data_shape at batch_size,
+    mean/std-normalised like the reference's on-the-fly augmenter."""
+
+    def __init__(self, path_imgrec=None, data_shape=(3, 224, 224),
+                 batch_size=32, num_samples=1024, num_classes=1000,
+                 shuffle=False, rand_crop=False, rand_mirror=False,
+                 mean_r=0, mean_g=0, mean_b=0, std_r=1, std_g=1, std_b=1,
+                 seed=0, **kwargs):
+        super().__init__(batch_size)
+        self.data_shape = tuple(data_shape)
+        self.num_samples = num_samples
+        self.num_classes = num_classes
+        self._seed = seed
+        self.cursor = 0
+
+    @property
+    def provide_data(self):
+        return [DataDesc("data", (self.batch_size,) + self.data_shape)]
+
+    @property
+    def provide_label(self):
+        return [DataDesc("softmax_label", (self.batch_size,))]
+
+    def reset(self):
+        self.cursor = 0
+
+    def next(self):
+        if self.cursor + self.batch_size > self.num_samples:
+            raise StopIteration
+        rng = np.random.RandomState(self._seed + self.cursor)
+        data = rng.rand(self.batch_size, *self.data_shape).astype(np.float32)
+        label = (np.arange(self.cursor, self.cursor + self.batch_size)
+                 % self.num_classes).astype(np.float32)
+        self.cursor += self.batch_size
+        return DataBatch([array(data)], [array(label)],
+                         provide_data=self.provide_data,
+                         provide_label=self.provide_label)
+
+
+class CSVIter(DataIter):
+    def __init__(self, data_csv, data_shape, label_csv=None, label_shape=(1,),
+                 batch_size=1, **kwargs):
+        super().__init__(batch_size)
+        data = np.loadtxt(data_csv, delimiter=",", dtype=np.float32)
+        data = data.reshape((-1,) + tuple(data_shape))
+        label = np.loadtxt(label_csv, delimiter=",", dtype=np.float32) \
+            if label_csv else np.zeros(len(data), np.float32)
+        self._inner = NDArrayIter(data, label, batch_size)
+
+    @property
+    def provide_data(self):
+        return self._inner.provide_data
+
+    @property
+    def provide_label(self):
+        return self._inner.provide_label
+
+    def reset(self):
+        self._inner.reset()
+
+    def next(self):
+        return self._inner.next()

@@ -1,0 +1,197 @@
+"""KVStore (reference: python/mxnet/kvstore.py + src/kvstore/*).
+
+Backends:
+  * 'local' / 'device' — single-process aggregation (reference comm tree /
+    device comm); values pushed for a key are summed, pulls broadcast.
+  * 'ici' — the TPU-native distributed backend replacing the reference's
+    'nccl' / 'dist_sync' (BASELINE.json north star). Aggregation is a
+    `jax.lax.psum` over the 'dp' axis of a `jax.sharding.Mesh`, executed via
+    `shard_map`, so gradients ride the ICI interconnect and never touch the
+    host. Imperative push/pull on sharded NDArrays lower to one fused XLA
+    collective; inside a pjit-compiled train step the same `allreduce_`
+    helper is traced straight into the step's StableHLO module.
+
+Optimizer offload (`set_optimizer`) runs updates at pull time like the
+reference's server-side update path (update_on_kvstore=True).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .base import MXNetError, _as_list
+from .ndarray.ndarray import NDArray
+
+__all__ = ["KVStore", "create"]
+
+
+def create(name="local"):
+    """Create a KVStore. Supported: local, device, ici (+ dist aliases)."""
+    if isinstance(name, KVStore):
+        return name
+    name = name.lower()
+    if name in ("local", "local_allreduce_cpu", "local_allreduce_device"):
+        return KVStore("local")
+    if name in ("device", "nccl"):
+        return KVStore("device")
+    if name in ("ici", "dist", "dist_sync", "dist_device_sync", "dist_async",
+                "horovod"):
+        return KVStore("ici")
+    raise MXNetError(f"unknown kvstore type {name!r}")
+
+
+class KVStore:
+    def __init__(self, kind):
+        self._kind = kind
+        self._store = {}
+        self._updater = None
+        self._optimizer = None
+        self._mesh = None
+
+    @property
+    def type(self):
+        return self._kind
+
+    @property
+    def rank(self):
+        return jax.process_index() if self._kind == "ici" else 0
+
+    @property
+    def num_workers(self):
+        return jax.process_count() if self._kind == "ici" else 1
+
+    def set_mesh(self, mesh):
+        """Attach a jax.sharding.Mesh (ici backend) for psum lowering."""
+        self._mesh = mesh
+        return self
+
+    # ------------------------------------------------------------------
+    def init(self, key, value):
+        keys = _as_list(key)
+        values = _as_list(value)
+        for k, v in zip(keys, values):
+            self._store[str(k)] = NDArray(v._data)
+
+    def push(self, key, value, priority=0):
+        """Aggregate values into the store (sum across devices/workers)."""
+        keys = _as_list(key)
+        if len(keys) == 1 and not isinstance(value, (list, tuple)) or \
+                (isinstance(value, (list, tuple))
+                 and not isinstance(value[0], (list, tuple))
+                 and len(keys) == 1):
+            values = [_as_list(value)]
+        else:
+            values = [_as_list(v) for v in value]
+        for k, vals in zip(keys, values):
+            agg = self.allreduce_([v._data for v in vals])
+            k = str(k)
+            if self._updater is not None:
+                if k not in self._store:
+                    raise MXNetError(f"key {k} not initialised")
+                self._updater(k, NDArray(agg), self._store[k])
+            else:
+                self._store[k] = NDArray(agg)
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        keys = _as_list(key)
+        outs = []
+        for k in keys:
+            k = str(k)
+            if k not in self._store:
+                raise MXNetError(f"key {k} not initialised")
+            val = self._store[k]
+            outs.append(val)
+        if out is not None:
+            flat_out = _as_list(out)
+            if len(keys) == 1:
+                for o in flat_out:
+                    if isinstance(o, (list, tuple)):
+                        for oo in o:
+                            oo._assign_value(outs[0]._data)
+                    else:
+                        o._assign_value(outs[0]._data)
+            else:
+                for o, v in zip(flat_out, outs):
+                    if isinstance(o, (list, tuple)):
+                        for oo in o:
+                            oo._assign_value(v._data)
+                    else:
+                        o._assign_value(v._data)
+            return
+        return outs[0] if len(outs) == 1 else outs
+
+    def pushpull(self, key, value, out=None, priority=0):
+        self.push(key, value, priority)
+        self.pull(key, out=out if out is not None else value, priority=priority)
+
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        raise MXNetError("sparse storage is not supported on TPU "
+                         "(SURVEY.md §2 #49); use dense pull")
+
+    # ------------------------------------------------------------------
+    def allreduce_(self, arrays):
+        """Sum a list of jax arrays; on 'ici' with multiple devices this is
+        a psum over the mesh 'dp' axis via shard_map."""
+        if len(arrays) == 1:
+            a = arrays[0]
+            if self._kind == "ici" and self._mesh is not None and \
+                    np.prod([self._mesh.shape[ax] for ax in self._mesh.axis_names]) > 1:
+                return self._psum_sharded(a)
+            return a
+        out = arrays[0]
+        for a in arrays[1:]:
+            out = out + a
+        return out
+
+    def _psum_sharded(self, a):
+        from jax.sharding import PartitionSpec as P
+        from jax import shard_map
+        mesh = self._mesh
+        axis = mesh.axis_names[0]
+        f = shard_map(lambda x: jax.lax.psum(x, axis), mesh=mesh,
+                      in_specs=P(axis), out_specs=P(axis))
+        return f(a)
+
+    # ------------------------------------------------------------------
+    def set_optimizer(self, optimizer):
+        from .optimizer import get_updater, create as opt_create
+        self._optimizer = opt_create(optimizer) if not hasattr(
+            optimizer, "update") else optimizer
+        self._updater = _KVUpdater(self._optimizer)
+
+    def _set_updater(self, updater):
+        self._updater = updater
+
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        import pickle
+        states = {}
+        if self._updater is not None:
+            states = {k: jax.tree_util.tree_map(np.asarray, v)
+                      for k, v in getattr(self._updater, "states", {}).items()}
+        with open(fname, "wb") as f:
+            pickle.dump(states, f)
+
+    def load_optimizer_states(self, fname):
+        import pickle
+        with open(fname, "rb") as f:
+            pickle.load(f)
+
+    def barrier(self):
+        from .ndarray.ndarray import waitall
+        waitall()
+
+
+class _KVUpdater:
+    """Server-side updater: applies optimizer at push time."""
+
+    def __init__(self, optimizer):
+        self.optimizer = optimizer
+        self.states = {}
+
+    def __call__(self, key, grad, weight):
+        if key not in self.states:
+            self.states[key] = \
+                self.optimizer.create_state_multi_precision(key, weight)
+        self.optimizer.update_multi_precision(key, weight, grad,
+                                              self.states[key])

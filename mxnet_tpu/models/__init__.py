@@ -1,0 +1,18 @@
+"""Flagship model families (reference configs: BASELINE.json).
+
+Submodules import lazily (BERT/Transformer/SSD are sizeable):
+  models.mlp          — MNIST MLP (Gluon Sequential)
+  models.bert         — BERT-base MLM pretraining (GluonNLP parity)
+  models.transformer  — Transformer NMT seq2seq (Sockeye parity)
+  models.ssd          — SSD-512 detection (GluonCV parity)
+  models.faster_rcnn  — Faster-RCNN detection (GluonCV parity)
+"""
+import importlib
+
+__all__ = ["mlp", "bert", "transformer", "ssd", "faster_rcnn"]
+
+
+def __getattr__(name):
+    if name in __all__:
+        return importlib.import_module(f".{name}", __name__)
+    raise AttributeError(name)

@@ -1,0 +1,15 @@
+"""MLP on MNIST — BASELINE.json config #1 (Gluon nn.Sequential, imperative)."""
+from __future__ import annotations
+
+from ..gluon import nn
+
+__all__ = ["get_mlp"]
+
+
+def get_mlp(hidden=(128, 64), classes=10, activation="relu"):
+    net = nn.HybridSequential(prefix="mlp_")
+    with net.name_scope():
+        for h in hidden:
+            net.add(nn.Dense(h, activation=activation))
+        net.add(nn.Dense(classes))
+    return net

@@ -1,0 +1,209 @@
+"""mx.mod.Module (reference: python/mxnet/module/module.py).
+
+Symbol-based training harness: bind -> init_params -> fit/forward/backward/
+update, with epoch checkpoints. Executes through the jitted Executor.
+"""
+from __future__ import annotations
+
+import logging
+
+import numpy as np
+
+from .base import MXNetError, _as_list
+from . import metric as metric_mod
+from . import optimizer as opt_mod
+from . import initializer as init_mod
+from .ndarray.ndarray import NDArray, zeros
+from .checkpoint import save_checkpoint, load_checkpoint
+
+__all__ = ["Module", "BaseModule"]
+
+
+class BaseModule:
+    pass
+
+
+class Module(BaseModule):
+    def __init__(self, symbol, data_names=("data",),
+                 label_names=("softmax_label",), logger=logging,
+                 context=None, **kwargs):
+        self._symbol = symbol
+        self._data_names = list(data_names)
+        self._label_names = list(label_names or [])
+        self._ctx = context
+        self._exec = None
+        self._optimizer = None
+        self._updater = None
+        self.binded = False
+        self.params_initialized = False
+        self.optimizer_initialized = False
+
+    @property
+    def symbol(self):
+        return self._symbol
+
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False, grad_req="write"):
+        shapes = {}
+        for desc in data_shapes:
+            name, shape = (desc.name, desc.shape) if hasattr(desc, "name") \
+                else desc
+            shapes[name] = shape
+        for desc in (label_shapes or []):
+            name, shape = (desc.name, desc.shape) if hasattr(desc, "name") \
+                else desc
+            shapes[name] = shape
+        args = self._symbol.list_arguments()
+        # label vars may not require shapes if the loss ignores them
+        bind_shapes = {}
+        for a in args:
+            if a in shapes:
+                bind_shapes[a] = shapes[a]
+        self._input_names = list(bind_shapes)
+        self._param_names = [a for a in args if a not in shapes]
+        self._for_training = for_training
+        self._grad_req = grad_req
+        self._bind_shapes = bind_shapes
+        self.binded = True
+        return self
+
+    def init_params(self, initializer=None, arg_params=None, aux_params=None,
+                    allow_missing=False, force_init=False, **kwargs):
+        if not self.binded:
+            raise MXNetError("bind before init_params")
+        initializer = initializer or init_mod.Uniform(0.07)
+        from . import random as rnd
+        # infer param shapes from graph with given input shapes
+        arg_shapes, _, _ = self._symbol.infer_shape(**self._bind_shapes)
+        names = self._symbol.list_arguments()
+        shape_of = dict(zip(names, arg_shapes)) if arg_shapes else {}
+        args = {}
+        for name in names:
+            if name in self._bind_shapes:
+                args[name] = zeros(self._bind_shapes[name], ctx=self._ctx)
+            elif arg_params and name in arg_params:
+                args[name] = arg_params[name]
+            else:
+                shape = shape_of.get(name)
+                if shape is None:
+                    raise MXNetError(f"cannot infer shape for {name}")
+                key = rnd._next_key()
+                args[name] = NDArray(
+                    initializer(name, shape, np.float32, key))
+        grad_args = {name: zeros(a.shape, ctx=self._ctx)
+                     for name, a in args.items()
+                     if name in self._param_names} \
+            if self._for_training else None
+        self._exec = self._symbol.bind(self._ctx, args, grad_args,
+                                       self._grad_req)
+        self.params_initialized = True
+        return self
+
+    def init_optimizer(self, kvstore="local", optimizer="sgd",
+                       optimizer_params=None, force_init=False):
+        self._optimizer = opt_mod.create(optimizer, **(optimizer_params or {}))
+        self._updater = opt_mod.get_updater(self._optimizer)
+        self.optimizer_initialized = True
+
+    def forward(self, data_batch, is_train=None):
+        is_train = self._for_training if is_train is None else is_train
+        feeds = {}
+        for name, arr in zip(self._data_names, _as_list(data_batch.data)):
+            if name in self._exec.arg_dict:
+                feeds[name] = arr
+        if data_batch.label is not None:
+            for name, arr in zip(self._label_names,
+                                 _as_list(data_batch.label)):
+                if name in self._exec.arg_dict:
+                    feeds[name] = arr
+        self._exec.forward(is_train=is_train, **feeds)
+
+    def backward(self, out_grads=None):
+        self._exec.backward(out_grads)
+
+    def update(self):
+        for i, name in enumerate(self._param_names):
+            self._updater(i, self._exec.grad_dict[name],
+                          self._exec.arg_dict[name])
+
+    def get_outputs(self, merge_multi_context=True):
+        return self._exec.outputs
+
+    def get_params(self):
+        arg_params = {n: self._exec.arg_dict[n] for n in self._param_names}
+        return arg_params, {}
+
+    def set_params(self, arg_params, aux_params=None, allow_missing=False,
+                   force_init=True, allow_extra=False):
+        for n, v in (arg_params or {}).items():
+            if n in self._exec.arg_dict:
+                self._exec.arg_dict[n]._assign_value(v._data)
+
+    def update_metric(self, eval_metric, labels, pre_sliced=False):
+        eval_metric.update(labels, self.get_outputs())
+
+    def score(self, eval_data, eval_metric, num_batch=None, **kwargs):
+        eval_metric = metric_mod.create(eval_metric)
+        eval_metric.reset()
+        eval_data.reset()
+        for i, batch in enumerate(eval_data):
+            if num_batch is not None and i == num_batch:
+                break
+            self.forward(batch, is_train=False)
+            self.update_metric(eval_metric, batch.label)
+        return eval_metric.get_name_value()
+
+    def predict(self, eval_data, num_batch=None, **kwargs):
+        outs = []
+        eval_data.reset()
+        for i, batch in enumerate(eval_data):
+            if num_batch is not None and i == num_batch:
+                break
+            self.forward(batch, is_train=False)
+            outs.append(self.get_outputs()[0])
+        from .ops.tensor_ops import concat
+        return concat(*outs, dim=0) if len(outs) > 1 else outs[0]
+
+    def fit(self, train_data, eval_data=None, eval_metric="acc",
+            epoch_end_callback=None, batch_end_callback=None, kvstore="local",
+            optimizer="sgd", optimizer_params=None, initializer=None,
+            num_epoch=1, arg_params=None, aux_params=None,
+            begin_epoch=0, **kwargs):
+        if not self.binded:
+            self.bind([(d.name, d.shape) for d in train_data.provide_data],
+                      [(l.name, l.shape) for l in train_data.provide_label])
+        if not self.params_initialized:
+            self.init_params(initializer, arg_params, aux_params)
+        if not self.optimizer_initialized:
+            self.init_optimizer(kvstore, optimizer, optimizer_params)
+        eval_metric = metric_mod.create(eval_metric)
+        for epoch in range(begin_epoch, num_epoch):
+            eval_metric.reset()
+            train_data.reset()
+            for nbatch, batch in enumerate(train_data):
+                self.forward(batch, is_train=True)
+                self.backward()
+                self.update()
+                self.update_metric(eval_metric, batch.label)
+                if batch_end_callback:
+                    for cb in _as_list(batch_end_callback):
+                        cb(type("P", (), {"epoch": epoch, "nbatch": nbatch,
+                                          "eval_metric": eval_metric})())
+            if epoch_end_callback:
+                arg_p, aux_p = self.get_params()
+                for cb in _as_list(epoch_end_callback):
+                    cb(epoch, self._symbol, arg_p, aux_p)
+            if eval_data is not None:
+                self.score(eval_data, eval_metric)
+        return self
+
+    def save_checkpoint(self, prefix, epoch, save_optimizer_states=False):
+        arg_params, aux_params = self.get_params()
+        save_checkpoint(prefix, epoch, self._symbol, arg_params, aux_params)
+
+    @staticmethod
+    def load(prefix, epoch, load_optimizer_states=False, **kwargs):
+        sym, arg_params, aux_params = load_checkpoint(prefix, epoch)
+        mod = Module(sym, **kwargs)
+        mod._loaded_params = (arg_params, aux_params)
+        return mod

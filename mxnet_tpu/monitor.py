@@ -1,0 +1,98 @@
+"""Monitor & numeric debugging (reference: python/mxnet/monitor.py).
+
+Taps layer outputs every N steps via Gluon forward hooks (the reference
+installs engine callbacks on executors) and provides nan/inf detection —
+the failure-detection subsystem of SURVEY.md §5.
+"""
+from __future__ import annotations
+
+import logging
+
+import numpy as np
+
+from .base import MXNetError
+
+__all__ = ["Monitor", "check_numerics", "NanDetector"]
+
+
+def _stat_default(x):
+    return float(np.abs(x).mean())
+
+
+class Monitor:
+    def __init__(self, interval, stat_func=None, pattern=".*", sort=False):
+        import re
+        self.interval = interval
+        self.stat_func = stat_func or _stat_default
+        self.pattern = re.compile(pattern)
+        self.sort = sort
+        self.step = 0
+        self.activated = False
+        self.queue = []
+        self._handles = []
+
+    def install(self, block):
+        """Attach to a Gluon block tree (reference: Monitor.install on exec)."""
+        def hook(blk, inputs, output):
+            if not self.activated:
+                return
+            name = blk.name
+            if not self.pattern.match(name):
+                return
+            outs = output if isinstance(output, (list, tuple)) else [output]
+            for i, o in enumerate(outs):
+                if hasattr(o, "asnumpy"):
+                    self.queue.append((self.step, f"{name}_output{i}",
+                                       self.stat_func(o.asnumpy())))
+
+        def walk(b):
+            b.register_forward_hook(hook)
+            for c in b._children.values():
+                walk(c)
+        walk(block)
+        return self
+
+    def tic(self):
+        if self.step % self.interval == 0:
+            self.activated = True
+            self.queue = []
+        self.step += 1
+
+    def toc(self):
+        if not self.activated:
+            return []
+        self.activated = False
+        res = sorted(self.queue) if self.sort else list(self.queue)
+        self.queue = []
+        return res
+
+    def toc_print(self):
+        for step, name, value in self.toc():
+            logging.info("Batch: %7d %30s %.8g", step, name, value)
+
+
+def check_numerics(arr, name="array"):
+    """Raise MXNetError if arr contains NaN/Inf (reference:
+    MXNET_ENFORCE_DETERMINISM-style numeric guard)."""
+    a = arr.asnumpy() if hasattr(arr, "asnumpy") else np.asarray(arr)
+    if not np.isfinite(a).all():
+        n_nan = int(np.isnan(a).sum())
+        n_inf = int(np.isinf(a).sum())
+        raise MXNetError(f"{name} has {n_nan} NaN and {n_inf} Inf values")
+    return arr
+
+
+class NanDetector:
+    """Scan parameters/grads after each step; report first offender."""
+
+    def __init__(self, params):
+        self._params = list(params.values()) if hasattr(params, "values") \
+            else list(params)
+
+    def check(self, grads=True):
+        for p in self._params:
+            if p._data is not None:
+                check_numerics(p.data(), p.name)
+            if grads and p._grad is not None:
+                check_numerics(p.grad(), p.name + "_grad")
+        return True

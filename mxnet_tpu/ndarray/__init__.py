@@ -1,0 +1,22 @@
+"""mx.nd — the imperative NDArray namespace.
+
+Aggregates the NDArray type, creation ops, tensor/nn/linalg operators and the
+random sub-namespace, mirroring the reference `mxnet.ndarray` module surface.
+"""
+from .ndarray import (NDArray, zeros, ones, full, empty, array, arange,
+                      linspace, eye, zeros_like, ones_like, full_like,
+                      from_numpy, waitall, _apply, _wrap_apply, _lift)
+from .utils import save, load
+from ..ops.tensor_ops import *          # noqa: F401,F403
+from ..ops.nn_ops import *              # noqa: F401,F403
+from ..ops import tensor_ops as _t
+from ..ops import nn_ops as _n
+from ..ops import linalg_ops as linalg  # mx.nd.linalg.*
+from .. import random                   # mx.nd.random.*
+
+# reference exposes a handful of random samplers at top level too
+from ..random import (uniform, normal, randn, randint, multinomial,
+                      exponential, gamma, poisson)
+
+sample_uniform = uniform
+sample_normal = normal

@@ -1,0 +1,661 @@
+"""NDArray: the imperative tensor, TPU-native.
+
+Reference parity: python/mxnet/ndarray/ndarray.py + src/ndarray/ndarray.cc.
+
+Design (SURVEY.md §1): an NDArray wraps an immutable `jax.Array` living in
+PJRT-managed memory (HBM on TPU). MXNet's mutable semantics (`x += 1`,
+`x[2:5] = 0`, `copyto`) are provided by *rebinding* the wrapper to the new
+functional value — an SSA rename, which is exactly what the reference's
+engine does logically with its var version counters. Ops dispatch eagerly
+through JAX, which queues them asynchronously on the device stream — the same
+async-execution model as the reference's ThreadedEngine, with XLA doing the
+device-side scheduling. `wait_to_read()` maps to `block_until_ready()`.
+
+Autograd: every op executed under `autograd.record()` is appended to a tape
+via `autograd.record_op`; `backward()` replays the tape through `jax.vjp`
+(see mxnet_tpu/autograd.py).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import autograd
+from ..base import MXNetError, _np_dtype, numeric_types
+from ..context import Context, current_context
+
+__all__ = ["NDArray", "zeros", "ones", "full", "empty", "array", "arange",
+           "linspace", "eye", "zeros_like", "ones_like", "full_like",
+           "from_numpy", "_apply", "_wrap_apply", "waitall"]
+
+
+def _ctx_of_jax(arr):
+    try:
+        dev = list(arr.devices())[0]
+    except Exception:
+        return current_context()
+    if dev.platform == "cpu":
+        return Context("cpu", dev.id)
+    accels = [d for d in jax.devices() if d.platform != "cpu"]
+    try:
+        idx = accels.index(dev)
+    except ValueError:
+        idx = 0
+    return Context("tpu", idx)
+
+
+def _apply(fn, nd_inputs, kwargs=None, n_out=1):
+    """Execute a pure function over NDArray inputs; wrap + record outputs.
+
+    This is the single imperative dispatch point (reference: MXImperativeInvoke).
+    """
+    kwargs = kwargs or {}
+    raw = [x._data for x in nd_inputs]
+    out = fn(*raw, **kwargs)
+    if n_out == 1 and not isinstance(out, tuple):
+        outs = (out,)
+    else:
+        outs = tuple(out)
+    nd_outs = tuple(NDArray(o) for o in outs)
+    if autograd.is_recording():
+        autograd.record_op(fn, nd_inputs, kwargs, nd_outs)
+    return nd_outs[0] if n_out == 1 and len(nd_outs) == 1 else nd_outs
+
+
+def _wrap_apply(fn, nd_inputs, kwargs, n_out):
+    """Like _apply but always returns a tuple (used by autograd.grad)."""
+    out = _apply(fn, nd_inputs, kwargs, n_out=n_out)
+    return out if isinstance(out, tuple) else (out,)
+
+
+def _lift(other, like=None):
+    """Coerce a scalar/numpy/NDArray operand to (NDArray | scalar)."""
+    if isinstance(other, NDArray):
+        return other
+    if isinstance(other, numeric_types):
+        return other
+    if isinstance(other, (np.ndarray, list, tuple)):
+        return NDArray(jnp.asarray(other))
+    if isinstance(other, jax.Array):
+        return NDArray(other)
+    raise TypeError(f"cannot operate NDArray with {type(other)}")
+
+
+def _binary(fn, a, b):
+    b = _lift(b)
+    if isinstance(b, NDArray):
+        return _apply(fn, [a, b])
+    return _apply(lambda x, _s=b: fn(x, _s), [a])
+
+
+def _rbinary(fn, a, b):
+    b = _lift(b)
+    if isinstance(b, NDArray):
+        return _apply(fn, [b, a])
+    return _apply(lambda x, _s=b: fn(_s, x), [a])
+
+
+class NDArray:
+    """Multi-dimensional array on a device context (TPU-first).
+
+    Wraps a `jax.Array`. Supports the reference NDArray surface: asynchronous
+    imperative ops, in-place arithmetic, slicing assignment, autograd
+    integration, and context movement.
+    """
+    __slots__ = ("_data", "_grad", "_grad_req", "_tape_ref", "__weakref__")
+    __array_priority__ = 100.0
+
+    def __init__(self, data, ctx=None, dtype=None):
+        if isinstance(data, NDArray):
+            data = data._data
+        if not isinstance(data, jax.Array):
+            data = jnp.asarray(data, dtype=dtype)
+        elif dtype is not None and data.dtype != np.dtype(dtype):
+            data = data.astype(dtype)
+        if ctx is not None:
+            data = jax.device_put(data, Context(ctx).jax_device)
+        self._data = data
+        self._grad = None
+        self._grad_req = "null"
+        self._tape_ref = None
+
+    # ------------------------------------------------------------------ meta
+    @property
+    def shape(self):
+        return tuple(self._data.shape)
+
+    @property
+    def dtype(self):
+        return self._data.dtype
+
+    @property
+    def size(self):
+        return int(self._data.size)
+
+    @property
+    def ndim(self):
+        return self._data.ndim
+
+    @property
+    def context(self):
+        return _ctx_of_jax(self._data)
+
+    ctx = context
+
+    @property
+    def grad(self):
+        return self._grad
+
+    @property
+    def T(self):
+        return self.transpose()
+
+    @property
+    def stype(self):
+        return "default"
+
+    def __repr__(self):
+        return f"\n{np.asarray(self._data)}\n<NDArray {'x'.join(map(str, self.shape))} @{self.context}>"
+
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of unsized object")
+        return self.shape[0]
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    def __bool__(self):
+        if self.size != 1:
+            raise ValueError("The truth value of an NDArray with multiple "
+                             "elements is ambiguous.")
+        return bool(np.asarray(self._data))
+
+    def __float__(self):
+        return float(np.asarray(self._data))
+
+    def __int__(self):
+        return int(np.asarray(self._data))
+
+    def __index__(self):
+        return int(np.asarray(self._data))
+
+    def __hash__(self):
+        return id(self)
+
+    # ------------------------------------------------------------- transfers
+    def asnumpy(self):
+        """Copy to a numpy array (blocks until computed — reference parity)."""
+        return np.asarray(self._data)
+
+    def asscalar(self):
+        if self.size != 1:
+            raise ValueError("The current array is not a scalar")
+        return self.asnumpy().reshape(()).item()
+
+    item = asscalar
+
+    def astype(self, dtype, copy=True):
+        dtype = _np_dtype(dtype)
+        if not copy and self._data.dtype == dtype:
+            return self
+        return _apply(lambda a, _d=dtype: a.astype(_d), [self])
+
+    def copy(self):
+        # underlying jax.Array is immutable, so sharing the buffer is a
+        # semantically correct (and free) copy
+        return NDArray(self._data)
+
+    def copyto(self, other):
+        """Copy into another NDArray (rebind) or onto a Context."""
+        if isinstance(other, NDArray):
+            other._assign_value(jax.device_put(
+                self._data.astype(other.dtype), other.context.jax_device))
+            return other
+        if isinstance(other, Context):
+            return NDArray(jax.device_put(self._data, other.jax_device))
+        raise TypeError(f"copyto does not support type {type(other)}")
+
+    def as_in_context(self, ctx):
+        ctx = Context(ctx)
+        if ctx == self.context:
+            return self
+        return NDArray(jax.device_put(self._data, ctx.jax_device))
+
+    as_in_ctx = as_in_context
+
+    def as_nd_ndarray(self):
+        return self
+
+    def to_device(self, ctx):
+        return self.as_in_context(ctx)
+
+    def wait_to_read(self):
+        """Block until the value is materialised (reference: WaitToRead)."""
+        self._data.block_until_ready()
+        return self
+
+    def detach(self):
+        out = NDArray(self._data)
+        return out
+
+    # ------------------------------------------------------------- autograd
+    def attach_grad(self, grad_req="write", stype=None):
+        """Allocate a gradient buffer so backward() writes into `.grad`."""
+        self._grad = NDArray(jnp.zeros_like(self._data))
+        self._grad_req = grad_req
+        self._tape_ref = None
+
+    def backward(self, out_grad=None, retain_graph=False, train_mode=True):
+        autograd.backward([self], [out_grad] if out_grad is not None else None,
+                          retain_graph=retain_graph, train_mode=train_mode)
+
+    # ---------------------------------------------------- mutation (rebind)
+    def _rebind(self, jax_value):
+        """Raw SSA rename: point this wrapper at a new device value."""
+        self._data = jax_value
+        self._tape_ref = None
+
+    def _assign(self, out_nd):
+        """In-place op result: adopt value *and* tape identity of out_nd."""
+        self._data = out_nd._data
+        self._tape_ref = out_nd._tape_ref
+        return self
+
+    def _assign_value(self, jax_value):
+        self._data = jax_value
+        self._tape_ref = None
+        return self
+
+    # ------------------------------------------------------------- indexing
+    @staticmethod
+    def _unwrap_index(key):
+        if isinstance(key, NDArray):
+            return key._data
+        if isinstance(key, tuple):
+            return tuple(NDArray._unwrap_index(k) for k in key)
+        if isinstance(key, slice) or key is None or key is Ellipsis:
+            return key
+        return key
+
+    def __getitem__(self, key):
+        key = NDArray._unwrap_index(key)
+        return _apply(lambda a, _k=key: a[_k], [self])
+
+    def __setitem__(self, key, value):
+        if isinstance(key, slice) and key == slice(None) and not isinstance(value, NDArray):
+            # x[:] = scalar/array — full overwrite
+            newv = jnp.broadcast_to(jnp.asarray(value, dtype=self.dtype), self.shape)
+            self._assign_value(jax.device_put(newv, self.context.jax_device))
+            return
+        key_u = NDArray._unwrap_index(key)
+        if isinstance(value, NDArray):
+            out = _apply(lambda a, v, _k=key_u: a.at[_k].set(v.astype(a.dtype)),
+                         [self, value])
+        else:
+            val = jnp.asarray(value)
+            out = _apply(lambda a, _k=key_u, _v=val: a.at[_k].set(_v.astype(a.dtype)),
+                         [self])
+        self._assign(out)
+
+    # ------------------------------------------------------------ arithmetic
+    def __add__(self, other):
+        return _binary(jnp.add, self, other)
+    __radd__ = __add__
+
+    def __sub__(self, other):
+        return _binary(jnp.subtract, self, other)
+
+    def __rsub__(self, other):
+        return _rbinary(jnp.subtract, self, other)
+
+    def __mul__(self, other):
+        return _binary(jnp.multiply, self, other)
+    __rmul__ = __mul__
+
+    def __truediv__(self, other):
+        return _binary(jnp.divide, self, other)
+
+    def __rtruediv__(self, other):
+        return _rbinary(jnp.divide, self, other)
+
+    def __floordiv__(self, other):
+        return _binary(jnp.floor_divide, self, other)
+
+    def __mod__(self, other):
+        return _binary(jnp.mod, self, other)
+
+    def __rmod__(self, other):
+        return _rbinary(jnp.mod, self, other)
+
+    def __pow__(self, other):
+        return _binary(jnp.power, self, other)
+
+    def __rpow__(self, other):
+        return _rbinary(jnp.power, self, other)
+
+    def __matmul__(self, other):
+        return _binary(jnp.matmul, self, other)
+
+    def __neg__(self):
+        return _apply(jnp.negative, [self])
+
+    def __abs__(self):
+        return _apply(jnp.abs, [self])
+
+    def __iadd__(self, other):
+        return self._assign(self + other)
+
+    def __isub__(self, other):
+        return self._assign(self - other)
+
+    def __imul__(self, other):
+        return self._assign(self * other)
+
+    def __itruediv__(self, other):
+        return self._assign(self / other)
+
+    # ------------------------------------------------------------ comparison
+    def __eq__(self, other):
+        if other is None:
+            return False
+        return _binary(lambda a, b: (a == b).astype(jnp.float32), self, other)
+
+    def __ne__(self, other):
+        if other is None:
+            return True
+        return _binary(lambda a, b: (a != b).astype(jnp.float32), self, other)
+
+    def __lt__(self, other):
+        return _binary(lambda a, b: (a < b).astype(jnp.float32), self, other)
+
+    def __le__(self, other):
+        return _binary(lambda a, b: (a <= b).astype(jnp.float32), self, other)
+
+    def __gt__(self, other):
+        return _binary(lambda a, b: (a > b).astype(jnp.float32), self, other)
+
+    def __ge__(self, other):
+        return _binary(lambda a, b: (a >= b).astype(jnp.float32), self, other)
+
+    # ------------------------------------------------------------ shape ops
+    def reshape(self, *shape, **kwargs):
+        if len(shape) == 1 and isinstance(shape[0], (list, tuple)):
+            shape = tuple(shape[0])
+        if kwargs.get("shape") is not None:
+            shape = tuple(kwargs["shape"])
+        shape = tuple(int(s) for s in shape)
+        # reference reshape magic values: 0 = copy dim, -1 = infer
+        out_shape = []
+        for i, s in enumerate(shape):
+            if s == 0:
+                out_shape.append(self.shape[i])
+            else:
+                out_shape.append(s)
+        return _apply(lambda a, _s=tuple(out_shape): a.reshape(_s), [self])
+
+    def reshape_like(self, other):
+        return _apply(lambda a, b: a.reshape(b.shape), [self, _lift(other)])
+
+    def transpose(self, *axes):
+        if len(axes) == 1 and isinstance(axes[0], (list, tuple)):
+            axes = tuple(axes[0])
+        axes = axes if axes else None
+        return _apply(lambda a, _ax=axes: jnp.transpose(a, _ax), [self])
+
+    def flatten(self):
+        """Reference semantics: collapse all trailing dims -> (batch, -1)."""
+        return _apply(lambda a: a.reshape(a.shape[0], -1) if a.ndim > 1 else a, [self])
+
+    def expand_dims(self, axis):
+        return _apply(lambda a, _ax=axis: jnp.expand_dims(a, _ax), [self])
+
+    def squeeze(self, axis=None):
+        return _apply(lambda a, _ax=axis: jnp.squeeze(a, _ax), [self])
+
+    def broadcast_to(self, shape):
+        return _apply(lambda a, _s=tuple(shape): jnp.broadcast_to(a, _s), [self])
+
+    def broadcast_like(self, other):
+        return _apply(lambda a, b: jnp.broadcast_to(a, b.shape), [self, _lift(other)])
+
+    def tile(self, reps):
+        return _apply(lambda a, _r=tuple(reps) if not isinstance(reps, int) else reps:
+                      jnp.tile(a, _r), [self])
+
+    def repeat(self, repeats, axis=None):
+        return _apply(lambda a, _r=repeats, _ax=axis: jnp.repeat(a, _r, _ax), [self])
+
+    def swapaxes(self, a1, a2):
+        return _apply(lambda a, _a=a1, _b=a2: jnp.swapaxes(a, _a, _b), [self])
+
+    def split(self, num_outputs, axis=0):
+        return _apply(lambda a, _n=num_outputs, _ax=axis:
+                      tuple(jnp.split(a, _n, _ax)), [self], n_out=num_outputs)
+
+    def slice_axis(self, axis, begin, end):
+        return _apply(lambda a, _ax=axis, _b=begin, _e=end:
+                      jax.lax.slice_in_dim(a, _b, _e if _e is not None else a.shape[_ax],
+                                           axis=_ax), [self])
+
+    # ------------------------------------------------------------ reductions
+    def _reduce(self, fn, axis=None, keepdims=False):
+        if isinstance(axis, list):
+            axis = tuple(axis)
+        return _apply(lambda a, _ax=axis, _k=keepdims: fn(a, axis=_ax, keepdims=_k),
+                      [self])
+
+    def sum(self, axis=None, keepdims=False):
+        return self._reduce(jnp.sum, axis, keepdims)
+
+    def mean(self, axis=None, keepdims=False):
+        return self._reduce(jnp.mean, axis, keepdims)
+
+    def max(self, axis=None, keepdims=False):
+        return self._reduce(jnp.max, axis, keepdims)
+
+    def min(self, axis=None, keepdims=False):
+        return self._reduce(jnp.min, axis, keepdims)
+
+    def prod(self, axis=None, keepdims=False):
+        return self._reduce(jnp.prod, axis, keepdims)
+
+    def argmax(self, axis=None, keepdims=False):
+        return _apply(lambda a, _ax=axis, _k=keepdims:
+                      jnp.argmax(a, axis=_ax, keepdims=_k).astype(jnp.float32), [self])
+
+    def argmin(self, axis=None, keepdims=False):
+        return _apply(lambda a, _ax=axis, _k=keepdims:
+                      jnp.argmin(a, axis=_ax, keepdims=_k).astype(jnp.float32), [self])
+
+    def norm(self, ord=2, axis=None, keepdims=False):
+        return _apply(lambda a, _o=ord, _ax=axis, _k=keepdims:
+                      jnp.linalg.norm(a.reshape(-1) if _ax is None else a,
+                                      ord=_o, axis=_ax, keepdims=_k), [self])
+
+    # -------------------------------------------------------------- math ops
+    def _unary(self, fn):
+        return _apply(fn, [self])
+
+    def abs(self):
+        return self._unary(jnp.abs)
+
+    def exp(self):
+        return self._unary(jnp.exp)
+
+    def log(self):
+        return self._unary(jnp.log)
+
+    def sqrt(self):
+        return self._unary(jnp.sqrt)
+
+    def square(self):
+        return self._unary(jnp.square)
+
+    def sign(self):
+        return self._unary(jnp.sign)
+
+    def round(self):
+        return self._unary(jnp.round)
+
+    def floor(self):
+        return self._unary(jnp.floor)
+
+    def ceil(self):
+        return self._unary(jnp.ceil)
+
+    def sigmoid(self):
+        return self._unary(jax.nn.sigmoid)
+
+    def tanh(self):
+        return self._unary(jnp.tanh)
+
+    def relu(self):
+        return self._unary(jax.nn.relu)
+
+    def softmax(self, axis=-1):
+        return _apply(lambda a, _ax=axis: jax.nn.softmax(a, axis=_ax), [self])
+
+    def log_softmax(self, axis=-1):
+        return _apply(lambda a, _ax=axis: jax.nn.log_softmax(a, axis=_ax), [self])
+
+    def clip(self, a_min=None, a_max=None):
+        return _apply(lambda a, _lo=a_min, _hi=a_max: jnp.clip(a, _lo, _hi), [self])
+
+    def dot(self, other):
+        return _binary(jnp.dot, self, _lift(other))
+
+    def one_hot(self, depth, on_value=1.0, off_value=0.0):
+        return _apply(lambda a, _d=depth, _on=on_value, _off=off_value:
+                      jax.nn.one_hot(a.astype(jnp.int32), _d) * (_on - _off) + _off,
+                      [self])
+
+    def topk(self, k=1, axis=-1, ret_typ="indices", is_ascend=False):
+        def _topk(a, _k=k, _ax=axis, _ret=ret_typ, _asc=is_ascend):
+            x = -a if _asc else a
+            x = jnp.moveaxis(x, _ax, -1)
+            vals, idxs = jax.lax.top_k(x, _k)
+            if _asc:
+                vals = -vals
+            vals = jnp.moveaxis(vals, -1, _ax)
+            idxs = jnp.moveaxis(idxs, -1, _ax).astype(jnp.float32)
+            if _ret == "value":
+                return vals
+            if _ret == "both":
+                return (vals, idxs)
+            return idxs
+        n_out = 2 if ret_typ == "both" else 1
+        return _apply(_topk, [self], n_out=n_out)
+
+    def sort(self, axis=-1, is_ascend=True):
+        return _apply(lambda a, _ax=axis, _asc=is_ascend:
+                      jnp.sort(a, axis=_ax) if _asc else -jnp.sort(-a, axis=_ax),
+                      [self])
+
+    def argsort(self, axis=-1, is_ascend=True):
+        return _apply(lambda a, _ax=axis, _asc=is_ascend:
+                      (jnp.argsort(a, axis=_ax) if _asc
+                       else jnp.argsort(-a, axis=_ax)).astype(jnp.float32), [self])
+
+    def take(self, indices, axis=0):
+        idx = _lift(indices)
+        return _apply(lambda a, i, _ax=axis: jnp.take(a, i.astype(jnp.int32), axis=_ax),
+                      [self, idx])
+
+    def pick(self, index, axis=-1, keepdims=False):
+        idx = _lift(index)
+        return _apply(lambda a, i, _ax=axis, _k=keepdims:
+                      jnp.take_along_axis(a, jnp.expand_dims(i.astype(jnp.int32), _ax),
+                                          axis=_ax)
+                      if _k else
+                      jnp.squeeze(jnp.take_along_axis(
+                          a, jnp.expand_dims(i.astype(jnp.int32), _ax), axis=_ax), _ax),
+                      [self, idx])
+
+    def tostype(self, stype):
+        if stype != "default":
+            raise MXNetError("sparse storage is not supported on TPU "
+                             "(SURVEY.md §2 #49)")
+        return self
+
+
+# ---------------------------------------------------------------------------
+# creation ops (reference: mx.nd.zeros/ones/...)
+# ---------------------------------------------------------------------------
+def _place(val, ctx):
+    ctx = Context(ctx) if ctx is not None else current_context()
+    return NDArray(jax.device_put(val, ctx.jax_device))
+
+
+def zeros(shape, ctx=None, dtype=None, **kwargs):
+    shape = (shape,) if isinstance(shape, int) else tuple(shape)
+    return _place(jnp.zeros(shape, dtype=_np_dtype(dtype)), ctx)
+
+
+def ones(shape, ctx=None, dtype=None, **kwargs):
+    shape = (shape,) if isinstance(shape, int) else tuple(shape)
+    return _place(jnp.ones(shape, dtype=_np_dtype(dtype)), ctx)
+
+
+def full(shape, val, ctx=None, dtype=None, **kwargs):
+    shape = (shape,) if isinstance(shape, int) else tuple(shape)
+    return _place(jnp.full(shape, val, dtype=_np_dtype(dtype)), ctx)
+
+
+def empty(shape, ctx=None, dtype=None):
+    return zeros(shape, ctx=ctx, dtype=dtype)
+
+
+def array(source_array, ctx=None, dtype=None):
+    if isinstance(source_array, NDArray):
+        source_array = source_array._data
+    if dtype is None:
+        arr = np.asarray(source_array)
+        dtype = arr.dtype if arr.dtype != np.float64 else np.float32
+        source_array = arr
+    return _place(jnp.asarray(source_array, dtype=_np_dtype(dtype)), ctx)
+
+
+def from_numpy(arr, zero_copy=False):
+    return array(arr)
+
+
+def arange(start, stop=None, step=1.0, repeat=1, ctx=None, dtype=None):
+    out = jnp.arange(start, stop, step, dtype=_np_dtype(dtype))
+    if repeat > 1:
+        out = jnp.repeat(out, repeat)
+    return _place(out, ctx)
+
+
+def linspace(start, stop, num, endpoint=True, ctx=None, dtype=None):
+    return _place(jnp.linspace(start, stop, num, endpoint=endpoint,
+                               dtype=_np_dtype(dtype)), ctx)
+
+
+def eye(N, M=0, k=0, ctx=None, dtype=None):
+    return _place(jnp.eye(N, M if M else None, k, dtype=_np_dtype(dtype)), ctx)
+
+
+def zeros_like(other, **kwargs):
+    return _apply(jnp.zeros_like, [other])
+
+
+def ones_like(other, **kwargs):
+    return _apply(jnp.ones_like, [other])
+
+
+def full_like(other, fill_value, **kwargs):
+    return _apply(lambda a, _v=fill_value: jnp.full_like(a, _v), [other])
+
+
+def waitall():
+    """Block until all queued computation is materialised
+    (reference: MXNDArrayWaitAll)."""
+    try:
+        jax.effects_barrier()
+    except Exception:
+        pass

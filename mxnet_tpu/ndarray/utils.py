@@ -1,0 +1,32 @@
+"""NDArray serialisation (reference: mx.nd.save / mx.nd.load, C API
+NDArraySave/NDArrayLoad). Format: numpy .npz — portable, no custom binary."""
+from __future__ import annotations
+
+import numpy as np
+
+from .ndarray import NDArray, array
+
+__all__ = ["save", "load"]
+
+
+def save(fname, data):
+    """Save a list or str-keyed dict of NDArrays."""
+    if isinstance(data, NDArray):
+        data = [data]
+    if isinstance(data, (list, tuple)):
+        arrays = {f"arr:{i}": d.asnumpy() for i, d in enumerate(data)}
+    elif isinstance(data, dict):
+        arrays = {f"key:{k}": v.asnumpy() for k, v in data.items()}
+    else:
+        raise TypeError(f"unsupported data type {type(data)}")
+    np.savez(fname if fname.endswith(".npz") else fname, **arrays)
+
+
+def load(fname):
+    """Load NDArrays saved by `save` — returns list or dict matching input."""
+    with np.load(fname, allow_pickle=False) as f:
+        keys = list(f.keys())
+        if all(k.startswith("arr:") for k in keys):
+            items = sorted(keys, key=lambda k: int(k.split(":", 1)[1]))
+            return [array(f[k]) for k in items]
+        return {k.split(":", 1)[1]: array(f[k]) for k in keys}

@@ -1,0 +1,11 @@
+"""Operator library (reference: src/operator/*).
+
+All ops are pure XLA-traceable functions over `jax.Array`s, exposed
+imperatively through the NDArray dispatch in mxnet_tpu.ndarray and
+symbolically through mxnet_tpu.symbol. Hot fused kernels live in
+pallas_kernels.py.
+"""
+from . import tensor_ops
+from . import nn_ops
+from . import linalg_ops
+from . import pallas_kernels

@@ -1,0 +1,125 @@
+"""Linear-algebra ops (reference: src/operator/tensor/la_op.cc — mx.nd.linalg)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..ndarray.ndarray import _apply, _lift
+
+__all__ = ["gemm", "gemm2", "potrf", "potri", "trsm", "trmm", "sumlogdiag",
+           "syrk", "gelqf", "syevd", "inverse", "det", "slogdet", "cholesky",
+           "qr", "svd", "solve", "norm"]
+
+
+def gemm(A, B, C, alpha=1.0, beta=1.0, transpose_a=False, transpose_b=False):
+    def fn(a, b, c, _al=alpha, _be=beta, _ta=transpose_a, _tb=transpose_b):
+        if _ta:
+            a = jnp.swapaxes(a, -1, -2)
+        if _tb:
+            b = jnp.swapaxes(b, -1, -2)
+        return _al * jnp.matmul(a, b) + _be * c
+    return _apply(fn, [A, _lift(B), _lift(C)])
+
+
+def gemm2(A, B, alpha=1.0, transpose_a=False, transpose_b=False):
+    def fn(a, b, _al=alpha, _ta=transpose_a, _tb=transpose_b):
+        if _ta:
+            a = jnp.swapaxes(a, -1, -2)
+        if _tb:
+            b = jnp.swapaxes(b, -1, -2)
+        return _al * jnp.matmul(a, b)
+    return _apply(fn, [A, _lift(B)])
+
+
+def potrf(A):
+    """Cholesky factor (lower)."""
+    return _apply(jnp.linalg.cholesky, [A])
+
+
+cholesky = potrf
+
+
+def potri(A):
+    """Inverse from Cholesky factor: (A A^T)^-1 given lower A."""
+    def fn(a):
+        eye = jnp.eye(a.shape[-1], dtype=a.dtype)
+        linv = jax.scipy.linalg.solve_triangular(a, eye, lower=True)
+        return jnp.matmul(jnp.swapaxes(linv, -1, -2), linv)
+    return _apply(fn, [A])
+
+
+def trsm(A, B, alpha=1.0, rightside=False, lower=True, transpose=False):
+    def fn(a, b, _al=alpha, _r=rightside, _lo=lower, _t=transpose):
+        if _r:
+            # X A = alpha B  ->  A^T X^T = alpha B^T
+            xt = jax.scipy.linalg.solve_triangular(
+                jnp.swapaxes(a, -1, -2), jnp.swapaxes(_al * b, -1, -2),
+                lower=not _lo if not _t else _lo)
+            return jnp.swapaxes(xt, -1, -2)
+        return jax.scipy.linalg.solve_triangular(a, _al * b, lower=_lo, trans=int(_t))
+    return _apply(fn, [A, _lift(B)])
+
+
+def trmm(A, B, alpha=1.0, rightside=False, lower=True, transpose=False):
+    def fn(a, b, _al=alpha, _r=rightside, _lo=lower, _t=transpose):
+        tri = jnp.tril(a) if _lo else jnp.triu(a)
+        if _t:
+            tri = jnp.swapaxes(tri, -1, -2)
+        return _al * (jnp.matmul(b, tri) if _r else jnp.matmul(tri, b))
+    return _apply(fn, [A, _lift(B)])
+
+
+def sumlogdiag(A):
+    return _apply(lambda a: jnp.sum(jnp.log(jnp.diagonal(a, axis1=-2, axis2=-1)),
+                                    axis=-1), [A])
+
+
+def syrk(A, alpha=1.0, transpose=False):
+    def fn(a, _al=alpha, _t=transpose):
+        at = jnp.swapaxes(a, -1, -2)
+        return _al * (jnp.matmul(at, a) if _t else jnp.matmul(a, at))
+    return _apply(fn, [A])
+
+
+def gelqf(A):
+    """LQ factorisation (reference: linalg_gelqf)."""
+    def fn(a):
+        q, r = jnp.linalg.qr(jnp.swapaxes(a, -1, -2))
+        return jnp.swapaxes(r, -1, -2), jnp.swapaxes(q, -1, -2)
+    return _apply(fn, [A], n_out=2)
+
+
+def syevd(A):
+    def fn(a):
+        w, v = jnp.linalg.eigh(a)
+        return jnp.swapaxes(v, -1, -2), w
+    return _apply(fn, [A], n_out=2)
+
+
+def inverse(A):
+    return _apply(jnp.linalg.inv, [A])
+
+
+def det(A):
+    return _apply(jnp.linalg.det, [A])
+
+
+def slogdet(A):
+    return _apply(lambda a: tuple(jnp.linalg.slogdet(a)), [A], n_out=2)
+
+
+def qr(A):
+    return _apply(lambda a: tuple(jnp.linalg.qr(a)), [A], n_out=2)
+
+
+def svd(A):
+    return _apply(lambda a: tuple(jnp.linalg.svd(a, full_matrices=False)), [A],
+                  n_out=3)
+
+
+def solve(A, B):
+    return _apply(jnp.linalg.solve, [A, _lift(B)])
+
+
+def norm(A, ord=2, axis=None, keepdims=False):
+    return A.norm(ord=ord, axis=axis, keepdims=keepdims)

@@ -1,0 +1,402 @@
+"""Neural-network ops (reference: src/operator/nn/*).
+
+Two layers:
+  * pure kernels over `jax.Array` (suffix-free lowercase functions) — these
+    are what Gluon layers call inside `hybrid_forward`, so a hybridized net
+    traces into one XLA executable. Convs ride `lax.conv_general_dilated`
+    (MXU), layouts are configurable (reference default NCHW accepted; NHWC is
+    the TPU-preferred fast path used by the model zoo's `layout` option).
+  * imperative NDArray wrappers with the reference's legacy op names
+    (FullyConnected, Convolution, BatchNorm, Pooling, Activation, Dropout,
+    SoftmaxOutput, ...) dispatched through `_apply` so autograd records them.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..ndarray.ndarray import NDArray, _apply, _lift
+
+__all__ = [
+    "fully_connected", "convolution", "deconvolution", "batch_norm",
+    "layer_norm", "group_norm", "instance_norm", "pooling", "global_pooling",
+    "activation", "leaky_relu", "dropout", "embedding", "softmax",
+    "log_softmax", "softmax_cross_entropy", "rnn_step",
+    "FullyConnected", "Convolution", "Deconvolution", "BatchNorm", "LayerNorm",
+    "Pooling", "Activation", "Dropout", "Embedding", "SoftmaxOutput",
+    "softmax_nd", "log_softmax_nd", "relu", "sigmoid", "gelu", "silu",
+]
+
+
+# ---------------------------------------------------------------------------
+# pure kernels (jax.Array -> jax.Array)
+# ---------------------------------------------------------------------------
+def fully_connected(x, weight, bias=None, flatten=True):
+    """y = x @ W^T + b. weight: (num_hidden, in_units) — reference convention
+    (src/operator/nn/fully_connected.cc)."""
+    if flatten and x.ndim > 2:
+        x = x.reshape(x.shape[0], -1)
+    y = jnp.matmul(x, weight.T)
+    if bias is not None:
+        y = y + bias
+    return y
+
+
+def _conv_dn(ndim, layout):
+    if layout is None:
+        layout = {1: "NCW", 2: "NCHW", 3: "NCDHW"}[ndim]
+    spatial = layout.replace("N", "").replace("C", "")
+    rhs = "OI" + spatial  # weight layout (out_ch, in_ch, *kernel)
+    return layout, lax.conv_dimension_numbers(
+        (1,) * (ndim + 2), (1,) * (ndim + 2), (layout, rhs, layout))
+
+
+def convolution(x, weight, bias=None, stride=1, pad=0, dilate=1,
+                num_group=1, layout=None):
+    """N-d convolution on the MXU. weight layout (O, I/g, *k) for NC* layouts
+    or (O, *k, I/g) for N*C layouts (reference: conv layout semantics)."""
+    ndim = x.ndim - 2
+    if isinstance(stride, int):
+        stride = (stride,) * ndim
+    if isinstance(pad, int):
+        pad = (pad,) * ndim
+    if isinstance(dilate, int):
+        dilate = (dilate,) * ndim
+    if layout is None:
+        layout = {1: "NCW", 2: "NCHW", 3: "NCDHW"}[ndim]
+    spatial = layout.replace("N", "").replace("C", "")
+    rhs = ("OI" + spatial) if layout.index("C") == 1 else ("O" + spatial + "I")
+    dn = lax.conv_dimension_numbers(x.shape, weight.shape, (layout, rhs, layout))
+    y = lax.conv_general_dilated(
+        x, weight, window_strides=tuple(stride),
+        padding=tuple((p, p) for p in pad),
+        rhs_dilation=tuple(dilate), dimension_numbers=dn,
+        feature_group_count=num_group,
+        preferred_element_type=jnp.float32 if x.dtype == jnp.bfloat16 else None)
+    if y.dtype != x.dtype:
+        y = y.astype(x.dtype)
+    if bias is not None:
+        c_axis = layout.index("C")
+        shape = [1] * y.ndim
+        shape[c_axis] = -1
+        y = y + bias.reshape(shape)
+    return y
+
+
+def deconvolution(x, weight, bias=None, stride=1, pad=0, adj=0, layout=None):
+    """Transposed convolution (reference: deconvolution.cc). weight (I, O, *k)."""
+    ndim = x.ndim - 2
+    if isinstance(stride, int):
+        stride = (stride,) * ndim
+    if isinstance(pad, int):
+        pad = (pad,) * ndim
+    if isinstance(adj, int):
+        adj = (adj,) * ndim
+    if layout is None:
+        layout = {1: "NCW", 2: "NCHW", 3: "NCDHW"}[ndim]
+    spatial = layout.replace("N", "").replace("C", "")
+    rhs = "IO" + spatial
+    dn = lax.conv_dimension_numbers(x.shape, weight.shape, (layout, rhs, layout))
+    k = weight.shape[2:]
+    padding = tuple((d - 1 - p, d - 1 - p + a) for d, p, a in
+                    zip(k, pad, adj))
+    y = lax.conv_general_dilated(
+        x, weight, window_strides=(1,) * ndim, padding=padding,
+        lhs_dilation=tuple(stride), dimension_numbers=dn,
+        transpose_kernel=True)
+    if bias is not None:
+        c_axis = layout.index("C")
+        shape = [1] * y.ndim
+        shape[c_axis] = -1
+        y = y + bias.reshape(shape)
+    return y
+
+
+def batch_norm(x, gamma, beta, moving_mean, moving_var, eps=1e-5,
+               momentum=0.9, training=True, axis=1):
+    """BatchNorm. Returns (y, new_moving_mean, new_moving_var)."""
+    axes = tuple(i for i in range(x.ndim) if i != axis)
+    shape = [1] * x.ndim
+    shape[axis] = -1
+    if training:
+        mean = jnp.mean(x, axis=axes)
+        var = jnp.var(x, axis=axes)
+        new_mean = momentum * moving_mean + (1 - momentum) * mean
+        new_var = momentum * moving_var + (1 - momentum) * var
+    else:
+        mean, var = moving_mean, moving_var
+        new_mean, new_var = moving_mean, moving_var
+    inv = lax.rsqrt(var.astype(jnp.float32) + eps).astype(x.dtype)
+    y = (x - mean.reshape(shape).astype(x.dtype)) * inv.reshape(shape)
+    y = y * gamma.reshape(shape).astype(x.dtype) + beta.reshape(shape).astype(x.dtype)
+    return y, new_mean, new_var
+
+
+def layer_norm(x, gamma, beta, axis=-1, eps=1e-5):
+    mean = jnp.mean(x, axis=axis, keepdims=True)
+    var = jnp.var(x, axis=axis, keepdims=True)
+    y = (x - mean) * lax.rsqrt(var + eps)
+    shape = [1] * x.ndim
+    shape[axis] = -1
+    return y * gamma.reshape(shape) + beta.reshape(shape)
+
+
+def group_norm(x, gamma, beta, num_groups, eps=1e-5):
+    """GroupNorm over channel-first (N, C, ...) layout."""
+    n, c = x.shape[0], x.shape[1]
+    orig = x.shape
+    xg = x.reshape(n, num_groups, c // num_groups, -1)
+    mean = jnp.mean(xg, axis=(2, 3), keepdims=True)
+    var = jnp.var(xg, axis=(2, 3), keepdims=True)
+    xg = (xg - mean) * lax.rsqrt(var + eps)
+    y = xg.reshape(orig)
+    shape = [1] * x.ndim
+    shape[1] = -1
+    return y * gamma.reshape(shape) + beta.reshape(shape)
+
+
+def instance_norm(x, gamma, beta, eps=1e-5):
+    axes = tuple(range(2, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    y = (x - mean) * lax.rsqrt(var + eps)
+    shape = [1] * x.ndim
+    shape[1] = -1
+    return y * gamma.reshape(shape) + beta.reshape(shape)
+
+
+def pooling(x, kernel, pool_type="max", stride=None, pad=0, layout=None,
+            count_include_pad=True):
+    """Max/avg/sum pooling via lax.reduce_window."""
+    ndim = x.ndim - 2
+    if isinstance(kernel, int):
+        kernel = (kernel,) * ndim
+    stride = stride or kernel
+    if isinstance(stride, int):
+        stride = (stride,) * ndim
+    if isinstance(pad, int):
+        pad = (pad,) * ndim
+    if layout is None:
+        layout = {1: "NCW", 2: "NCHW", 3: "NCDHW"}[ndim]
+    c_axis = layout.index("C")
+    window = [1] * x.ndim
+    strides = [1] * x.ndim
+    paddings = [(0, 0)] * x.ndim
+    sp = [i for i in range(x.ndim) if i not in (0, c_axis)]
+    for i, ax in enumerate(sp):
+        window[ax] = kernel[i]
+        strides[ax] = stride[i]
+        paddings[ax] = (pad[i], pad[i])
+    if pool_type == "max":
+        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+        return lax.reduce_window(x, jnp.asarray(init, x.dtype), lax.max,
+                                 tuple(window), tuple(strides), tuple(paddings))
+    s = lax.reduce_window(x, jnp.asarray(0, x.dtype), lax.add,
+                          tuple(window), tuple(strides), tuple(paddings))
+    if pool_type == "sum":
+        return s
+    if count_include_pad:
+        denom = 1
+        for k in kernel:
+            denom *= k
+        return s / denom
+    ones = jnp.ones_like(x)
+    cnt = lax.reduce_window(ones, jnp.asarray(0, x.dtype), lax.add,
+                            tuple(window), tuple(strides), tuple(paddings))
+    return s / cnt
+
+
+def global_pooling(x, pool_type="avg", layout="NCHW"):
+    c_axis = layout.index("C")
+    axes = tuple(i for i in range(x.ndim) if i not in (0, c_axis))
+    if pool_type == "max":
+        return jnp.max(x, axis=axes, keepdims=True)
+    return jnp.mean(x, axis=axes, keepdims=True)
+
+
+_ACTS = {
+    "relu": jax.nn.relu,
+    "sigmoid": jax.nn.sigmoid,
+    "tanh": jnp.tanh,
+    "softrelu": jax.nn.softplus,
+    "softsign": jax.nn.soft_sign,
+    "gelu": jax.nn.gelu,
+    "erf_gelu": lambda x: jax.nn.gelu(x, approximate=False),
+    "swish": jax.nn.silu,
+    "silu": jax.nn.silu,
+    "mish": jax.nn.mish,
+    "relu6": lambda x: jnp.clip(x, 0, 6),
+    "hard_sigmoid": jax.nn.hard_sigmoid,
+    "hard_swish": jax.nn.hard_swish,
+    "exp": jnp.exp,
+    "identity": lambda x: x,
+}
+
+
+def activation(x, act_type="relu"):
+    return _ACTS[act_type](x)
+
+
+def leaky_relu(x, act_type="leaky", slope=0.25, alpha=None):
+    if act_type in ("leaky", "prelu"):
+        a = slope if alpha is None else alpha
+        return jnp.where(x >= 0, x, a * x)
+    if act_type == "elu":
+        return jnp.where(x >= 0, x, slope * jnp.expm1(x))
+    if act_type == "selu":
+        return jax.nn.selu(x)
+    if act_type == "gelu":
+        return jax.nn.gelu(x)
+    raise ValueError(f"unknown leaky_relu act_type {act_type}")
+
+
+def dropout(x, key, p=0.5, training=True):
+    if not training or p <= 0:
+        return x
+    keep = 1.0 - p
+    mask = jax.random.bernoulli(key, keep, x.shape)
+    return jnp.where(mask, x / keep, 0).astype(x.dtype)
+
+
+def embedding(indices, weight):
+    return jnp.take(weight, indices.astype(jnp.int32), axis=0)
+
+
+def softmax(x, axis=-1, temperature=None):
+    if temperature is not None and temperature != 1.0:
+        x = x / temperature
+    return jax.nn.softmax(x, axis=axis)
+
+
+def log_softmax(x, axis=-1):
+    return jax.nn.log_softmax(x, axis=axis)
+
+
+def softmax_cross_entropy(logits, labels, sparse=True, axis=-1):
+    logp = jax.nn.log_softmax(logits, axis=axis)
+    if sparse:
+        lab = labels.astype(jnp.int32)
+        return -jnp.take_along_axis(logp, lab[..., None], axis=axis)[..., 0]
+    return -jnp.sum(labels * logp, axis=axis)
+
+
+def rnn_step(x, h, wx, wh, b, mode="rnn_tanh"):
+    g = jnp.matmul(x, wx.T) + jnp.matmul(h, wh.T) + b
+    if mode == "rnn_tanh":
+        return jnp.tanh(g)
+    if mode == "rnn_relu":
+        return jax.nn.relu(g)
+    raise ValueError(mode)
+
+
+# ---------------------------------------------------------------------------
+# imperative NDArray wrappers (reference legacy op names)
+# ---------------------------------------------------------------------------
+def FullyConnected(data, weight, bias=None, num_hidden=None, no_bias=False,
+                   flatten=True, **kwargs):
+    ins = [data, weight] + ([] if no_bias or bias is None else [bias])
+    if no_bias or bias is None:
+        return _apply(lambda x, w, _f=flatten: fully_connected(x, w, None, _f), ins)
+    return _apply(lambda x, w, b, _f=flatten: fully_connected(x, w, b, _f), ins)
+
+
+def Convolution(data, weight, bias=None, kernel=None, stride=1, pad=0,
+                dilate=1, num_filter=None, num_group=1, no_bias=False,
+                layout=None, **kwargs):
+    if no_bias or bias is None:
+        return _apply(lambda x, w, _s=stride, _p=pad, _d=dilate, _g=num_group,
+                      _l=layout: convolution(x, w, None, _s, _p, _d, _g, _l),
+                      [data, weight])
+    return _apply(lambda x, w, b, _s=stride, _p=pad, _d=dilate, _g=num_group,
+                  _l=layout: convolution(x, w, b, _s, _p, _d, _g, _l),
+                  [data, weight, bias])
+
+
+def Deconvolution(data, weight, bias=None, kernel=None, stride=1, pad=0,
+                  adj=0, num_filter=None, no_bias=False, layout=None, **kwargs):
+    if no_bias or bias is None:
+        return _apply(lambda x, w, _s=stride, _p=pad, _a=adj, _l=layout:
+                      deconvolution(x, w, None, _s, _p, _a, _l), [data, weight])
+    return _apply(lambda x, w, b, _s=stride, _p=pad, _a=adj, _l=layout:
+                  deconvolution(x, w, b, _s, _p, _a, _l), [data, weight, bias])
+
+
+def BatchNorm(data, gamma, beta, moving_mean, moving_var, eps=1e-5,
+              momentum=0.9, fix_gamma=False, use_global_stats=False,
+              axis=1, **kwargs):
+    from .. import autograd
+    training = autograd.is_training() and not use_global_stats
+    out, new_mean, new_var = _apply(
+        lambda x, g, b, mm, mv, _e=eps, _m=momentum, _t=training, _ax=axis:
+        batch_norm(x, jnp.ones_like(g) if fix_gamma else g, b, mm, mv,
+                   _e, _m, _t, _ax),
+        [data, gamma, beta, moving_mean, moving_var], n_out=3)
+    if training:
+        # reference semantics: aux states are mutated in place during training
+        moving_mean._assign_value(new_mean._data)
+        moving_var._assign_value(new_var._data)
+    return out
+
+
+def LayerNorm(data, gamma, beta, axis=-1, eps=1e-5, **kwargs):
+    return _apply(lambda x, g, b, _ax=axis, _e=eps: layer_norm(x, g, b, _ax, _e),
+                  [data, gamma, beta])
+
+
+def Pooling(data, kernel=None, pool_type="max", stride=None, pad=0,
+            global_pool=False, layout=None, **kwargs):
+    if global_pool:
+        return _apply(lambda x, _pt=pool_type, _l=layout or "NCHW":
+                      global_pooling(x, _pt, _l), [data])
+    return _apply(lambda x, _k=kernel, _pt=pool_type, _s=stride, _p=pad,
+                  _l=layout: pooling(x, _k, _pt, _s, _p, _l), [data])
+
+
+def Activation(data, act_type="relu", **kwargs):
+    return _apply(lambda x, _a=act_type: activation(x, _a), [data])
+
+
+def LeakyReLU(data, act_type="leaky", slope=0.25, **kwargs):
+    return _apply(lambda x, _a=act_type, _s=slope: leaky_relu(x, _a, _s), [data])
+
+
+def Dropout(data, p=0.5, mode="training", **kwargs):
+    from .. import autograd
+    from ..random import _next_key
+    if not autograd.is_training() and mode != "always":
+        return data
+    key = _next_key()
+    return _apply(lambda x, _k=key, _p=p: dropout(x, _k, _p, True), [data])
+
+
+def Embedding(data, weight, input_dim=None, output_dim=None, **kwargs):
+    return _apply(lambda i, w: embedding(i, w), [data, weight])
+
+
+def SoftmaxOutput(data, label=None, **kwargs):
+    return _apply(lambda x: jax.nn.softmax(x, axis=-1), [data])
+
+
+def softmax_nd(data, axis=-1, temperature=None):
+    return _apply(lambda x, _ax=axis, _t=temperature: softmax(x, _ax, _t), [data])
+
+
+def log_softmax_nd(data, axis=-1):
+    return _apply(lambda x, _ax=axis: log_softmax(x, _ax), [data])
+
+
+def relu(data):
+    return _apply(jax.nn.relu, [data])
+
+
+def sigmoid(data):
+    return _apply(jax.nn.sigmoid, [data])
+
+
+def gelu(data):
+    return _apply(jax.nn.gelu, [data])
+
+
+def silu(data):
+    return _apply(jax.nn.silu, [data])

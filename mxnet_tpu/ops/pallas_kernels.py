@@ -1,0 +1,225 @@
+"""Hand-written TPU Pallas kernels for the hot ops.
+
+Reference parity: the reference fuses attention/layernorm via cuDNN and
+hand-written CUDA (src/operator/contrib); here the fused fast paths are
+Mosaic/Pallas kernels targeting VMEM + MXU directly.
+
+Kernels:
+  * flash_attention — memory-efficient attention, online softmax, O(S) memory,
+    grid (batch*heads, q_blocks, kv_blocks) with VMEM accumulators. Forward is
+    Pallas; backward recomputes via the XLA path (custom_vjp) which XLA fuses.
+  * fused_layer_norm — single-pass layernorm.
+
+All kernels fall back to pure-XLA implementations off-TPU (CPU test mesh) or
+for shapes that don't tile (seq not multiple of block after padding).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+try:
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    _HAS_PALLAS = True
+except Exception:  # pragma: no cover
+    _HAS_PALLAS = False
+
+__all__ = ["flash_attention", "fused_layer_norm", "attention_reference",
+           "on_tpu"]
+
+
+def on_tpu():
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
+# ---------------------------------------------------------------------------
+# reference XLA attention (also the backward path + CPU fallback)
+# ---------------------------------------------------------------------------
+def attention_reference(q, k, v, causal=False, sm_scale=None, mask=None):
+    """q,k,v: (B, H, S, D). Plain XLA attention — fused well by XLA, used as
+    the fallback and as the recompute backward for the Pallas forward."""
+    if sm_scale is None:
+        sm_scale = 1.0 / (q.shape[-1] ** 0.5)
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * sm_scale
+    if causal:
+        qi = lax.broadcasted_iota(jnp.int32, s.shape[-2:], 0)
+        kj = lax.broadcasted_iota(jnp.int32, s.shape[-2:], 1)
+        s = jnp.where(qi >= kj, s, -1e30)
+    if mask is not None:
+        s = jnp.where(mask, s, -1e30)
+    p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+
+
+# ---------------------------------------------------------------------------
+# Pallas flash attention forward
+# ---------------------------------------------------------------------------
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr,
+                      *, sm_scale, causal, block_q, block_k, seq_len):
+    kb = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(kb == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, -1e30)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    qb = pl.program_id(1)
+    q_start = qb * block_q
+    k_start = kb * block_k
+
+    def compute():
+        q = q_ref[0].astype(jnp.float32)          # (bq, d)
+        k = k_ref[0].astype(jnp.float32)          # (bk, d)
+        v = v_ref[0].astype(jnp.float32)          # (bk, d)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * sm_scale
+        if causal:
+            qi = q_start + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            kj = k_start + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(qi >= kj, s, -1e30)
+
+        m_prev = m_scr[:, :1]                      # (bq, 1)
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)                     # (bq, bk)
+        l_new = alpha * l_scr[:, :1] + jnp.sum(p, axis=-1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    if causal:
+        # skip fully-masked kv blocks above the diagonal
+        @pl.when(k_start <= q_start + block_q - 1)
+        def _():
+            compute()
+    else:
+        compute()
+
+    @pl.when(kb == nk - 1)
+    def _finalize():
+        o_ref[0] = (acc_scr[:] / l_scr[:, :1]).astype(o_ref.dtype)
+
+
+def _flash_fwd_pallas(q, k, v, causal, sm_scale, block_q=128, block_k=128):
+    b, h, s, d = q.shape
+    bh = b * h
+    qr = q.reshape(bh, s, d)
+    kr = k.reshape(bh, s, d)
+    vr = v.reshape(bh, s, d)
+    grid = (bh, pl.cdiv(s, block_q), pl.cdiv(s, block_k))
+    kern = functools.partial(
+        _flash_fwd_kernel, sm_scale=sm_scale, causal=causal,
+        block_q=block_q, block_k=block_k, seq_len=s)
+    out = pl.pallas_call(
+        kern,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh_, i, j: (bh_, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh_, i, j: (bh_, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh_, i, j: (bh_, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda bh_, i, j: (bh_, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, s, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+    )(qr, kr, vr)
+    return out.reshape(b, h, s, d)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def flash_attention(q, k, v, causal=False, sm_scale=None):
+    """Fused attention. q,k,v: (B, H, S, D) -> (B, H, S, D).
+
+    On TPU with S % 128 == 0 runs the Pallas flash kernel (O(S) memory,
+    MXU matmuls in fp32 accumulation); otherwise the XLA reference path.
+    """
+    return _flash_attention_impl(q, k, v, causal, sm_scale)
+
+
+def _flash_attention_impl(q, k, v, causal, sm_scale):
+    if sm_scale is None:
+        sm_scale = 1.0 / (q.shape[-1] ** 0.5)
+    s = q.shape[2]
+    if _HAS_PALLAS and on_tpu() and s % 128 == 0 and s >= 128:
+        try:
+            return _flash_fwd_pallas(q, k, v, causal, sm_scale)
+        except Exception:
+            pass
+    return attention_reference(q, k, v, causal=causal, sm_scale=sm_scale)
+
+
+def _flash_fwd_rule(q, k, v, causal, sm_scale):
+    out = _flash_attention_impl(q, k, v, causal, sm_scale)
+    return out, (q, k, v)
+
+
+def _flash_bwd_rule(causal, sm_scale, res, g):
+    q, k, v = res
+    # recompute-backward through the XLA reference (flash-style pallas bwd is
+    # a further optimisation; XLA fuses this into a few MXU matmuls)
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: attention_reference(q_, k_, v_, causal=causal,
+                                               sm_scale=sm_scale), q, k, v)
+    return vjp(g)
+
+
+flash_attention.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+# ---------------------------------------------------------------------------
+# fused layer norm
+# ---------------------------------------------------------------------------
+def _ln_kernel(x_ref, g_ref, b_ref, o_ref, *, eps):
+    x = x_ref[:].astype(jnp.float32)
+    mean = jnp.mean(x, axis=-1, keepdims=True)
+    xc = x - mean
+    var = jnp.mean(xc * xc, axis=-1, keepdims=True)
+    y = xc * lax.rsqrt(var + eps)
+    o_ref[:] = (y * g_ref[:].astype(jnp.float32)
+                + b_ref[:].astype(jnp.float32)).astype(o_ref.dtype)
+
+
+def fused_layer_norm(x, gamma, beta, eps=1e-5, block_rows=256):
+    """LayerNorm over the last axis. Pallas single-pass on TPU; XLA fallback."""
+    d = x.shape[-1]
+    rows = 1
+    for s in x.shape[:-1]:
+        rows *= s
+    if (_HAS_PALLAS and on_tpu() and d % 128 == 0 and rows % 8 == 0
+            and rows >= 8):
+        br = min(block_rows, rows)
+        while rows % br:
+            br //= 2
+        x2 = x.reshape(rows, d)
+        out = pl.pallas_call(
+            functools.partial(_ln_kernel, eps=eps),
+            grid=(rows // br,),
+            in_specs=[
+                pl.BlockSpec((br, d), lambda i: (i, 0)),
+                pl.BlockSpec((d,), lambda i: (0,)),
+                pl.BlockSpec((d,), lambda i: (0,)),
+            ],
+            out_specs=pl.BlockSpec((br, d), lambda i: (i, 0)),
+            out_shape=jax.ShapeDtypeStruct((rows, d), x.dtype),
+        )(x2, gamma, beta)
+        return out.reshape(x.shape)
+    mean = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.var(x, axis=-1, keepdims=True)
+    return (x - mean) * lax.rsqrt(var + eps) * gamma + beta

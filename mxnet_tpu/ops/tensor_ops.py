@@ -1,0 +1,454 @@
+"""Tensor ops: elemwise, broadcast, reduce, shape manipulation.
+
+Reference parity: src/operator/tensor/* (elemwise_binary_op, broadcast_reduce,
+matrix_op, indexing_op). Every function takes/returns NDArrays and dispatches
+through the single imperative entry point `_apply`, so autograd records them.
+Reference-style `broadcast_*` aliases are provided because jnp broadcasts by
+default — they are the same XLA op.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..ndarray.ndarray import NDArray, _apply, _binary, _lift
+
+__all__ = [
+    # elemwise binary
+    "add", "subtract", "multiply", "divide", "modulo", "power", "maximum",
+    "minimum", "hypot", "broadcast_add", "broadcast_sub", "broadcast_mul",
+    "broadcast_div", "broadcast_mod", "broadcast_power", "broadcast_maximum",
+    "broadcast_minimum", "broadcast_equal", "broadcast_not_equal",
+    "broadcast_greater", "broadcast_greater_equal", "broadcast_lesser",
+    "broadcast_lesser_equal", "broadcast_logical_and", "broadcast_logical_or",
+    "broadcast_logical_xor", "broadcast_like", "broadcast_to", "broadcast_axis",
+    # elemwise unary
+    "abs", "sign", "round", "rint", "ceil", "floor", "trunc", "fix", "square",
+    "sqrt", "rsqrt", "cbrt", "rcbrt", "exp", "expm1", "log", "log10", "log2",
+    "log1p", "sin", "cos", "tan", "arcsin", "arccos", "arctan", "sinh", "cosh",
+    "tanh", "arcsinh", "arccosh", "arctanh", "reciprocal", "negative",
+    "logical_not", "erf", "erfinv", "gamma", "gammaln", "clip",
+    # reduce
+    "sum", "nansum", "mean", "prod", "nanprod", "max", "min", "norm", "argmax",
+    "argmin", "sum_axis", "max_axis", "min_axis",
+    # shape
+    "reshape", "reshape_like", "flatten", "transpose", "expand_dims", "squeeze",
+    "concat", "concatenate", "stack", "split", "tile", "repeat", "pad",
+    "slice", "slice_axis", "slice_like", "flip", "reverse", "swapaxes",
+    "depth_to_space", "space_to_depth",
+    # indexing / selection
+    "take", "pick", "gather_nd", "scatter_nd", "where", "boolean_mask",
+    "one_hot", "topk", "sort", "argsort", "shuffle", "diag",
+    # misc
+    "dot", "batch_dot", "add_n", "ElementWiseSum", "cast", "Cast",
+    "zeros_like", "ones_like", "shape_array", "size_array", "cumsum",
+]
+
+
+def _unary_factory(fn):
+    def op(data, **kwargs):
+        return _apply(fn, [data])
+    return op
+
+
+def _binary_factory(fn):
+    def op(lhs, rhs, **kwargs):
+        if not isinstance(lhs, NDArray):
+            lhs = _lift(lhs)
+            if not isinstance(lhs, NDArray):   # scalar-scalar degenerate
+                return fn(lhs, rhs)
+        return _binary(fn, lhs, rhs)
+    return op
+
+
+def _cmp(fn):
+    return _binary_factory(lambda a, b: fn(a, b).astype(jnp.float32))
+
+
+# -- elemwise binary ---------------------------------------------------------
+add = broadcast_add = _binary_factory(jnp.add)
+subtract = broadcast_sub = _binary_factory(jnp.subtract)
+multiply = broadcast_mul = _binary_factory(jnp.multiply)
+divide = broadcast_div = _binary_factory(jnp.divide)
+modulo = broadcast_mod = _binary_factory(jnp.mod)
+power = broadcast_power = _binary_factory(jnp.power)
+maximum = broadcast_maximum = _binary_factory(jnp.maximum)
+minimum = broadcast_minimum = _binary_factory(jnp.minimum)
+hypot = _binary_factory(jnp.hypot)
+broadcast_equal = _cmp(jnp.equal)
+broadcast_not_equal = _cmp(jnp.not_equal)
+broadcast_greater = _cmp(jnp.greater)
+broadcast_greater_equal = _cmp(jnp.greater_equal)
+broadcast_lesser = _cmp(jnp.less)
+broadcast_lesser_equal = _cmp(jnp.less_equal)
+broadcast_logical_and = _cmp(jnp.logical_and)
+broadcast_logical_or = _cmp(jnp.logical_or)
+broadcast_logical_xor = _cmp(jnp.logical_xor)
+
+
+def broadcast_to(data, shape):
+    return data.broadcast_to(shape)
+
+
+def broadcast_like(lhs, rhs):
+    return lhs.broadcast_like(rhs)
+
+
+def broadcast_axis(data, axis, size):
+    axes = axis if isinstance(axis, (list, tuple)) else [axis]
+    sizes = size if isinstance(size, (list, tuple)) else [size]
+
+    def fn(a, _axes=tuple(axes), _sizes=tuple(sizes)):
+        shape = list(a.shape)
+        for ax, s in zip(_axes, _sizes):
+            shape[ax] = s
+        return jnp.broadcast_to(a, tuple(shape))
+    return _apply(fn, [data])
+
+
+# -- elemwise unary ----------------------------------------------------------
+abs = _unary_factory(jnp.abs)
+sign = _unary_factory(jnp.sign)
+round = _unary_factory(jnp.round)
+rint = _unary_factory(jnp.rint)
+ceil = _unary_factory(jnp.ceil)
+floor = _unary_factory(jnp.floor)
+trunc = _unary_factory(jnp.trunc)
+fix = _unary_factory(jnp.trunc)
+square = _unary_factory(jnp.square)
+sqrt = _unary_factory(jnp.sqrt)
+rsqrt = _unary_factory(jax.lax.rsqrt)
+cbrt = _unary_factory(jnp.cbrt)
+rcbrt = _unary_factory(lambda a: 1.0 / jnp.cbrt(a))
+exp = _unary_factory(jnp.exp)
+expm1 = _unary_factory(jnp.expm1)
+log = _unary_factory(jnp.log)
+log10 = _unary_factory(jnp.log10)
+log2 = _unary_factory(jnp.log2)
+log1p = _unary_factory(jnp.log1p)
+sin = _unary_factory(jnp.sin)
+cos = _unary_factory(jnp.cos)
+tan = _unary_factory(jnp.tan)
+arcsin = _unary_factory(jnp.arcsin)
+arccos = _unary_factory(jnp.arccos)
+arctan = _unary_factory(jnp.arctan)
+sinh = _unary_factory(jnp.sinh)
+cosh = _unary_factory(jnp.cosh)
+tanh = _unary_factory(jnp.tanh)
+arcsinh = _unary_factory(jnp.arcsinh)
+arccosh = _unary_factory(jnp.arccosh)
+arctanh = _unary_factory(jnp.arctanh)
+reciprocal = _unary_factory(jnp.reciprocal)
+negative = _unary_factory(jnp.negative)
+logical_not = _unary_factory(lambda a: jnp.logical_not(a).astype(jnp.float32))
+erf = _unary_factory(jax.scipy.special.erf)
+erfinv = _unary_factory(jax.scipy.special.erfinv)
+gamma = _unary_factory(lambda a: jnp.exp(jax.scipy.special.gammaln(a)))
+gammaln = _unary_factory(jax.scipy.special.gammaln)
+
+
+def clip(data, a_min=None, a_max=None, **kwargs):
+    return data.clip(a_min, a_max)
+
+
+# -- reductions --------------------------------------------------------------
+def sum(data, axis=None, keepdims=False, **kwargs):
+    return data.sum(axis=axis, keepdims=keepdims)
+
+
+def nansum(data, axis=None, keepdims=False):
+    return _apply(lambda a, _ax=axis, _k=keepdims:
+                  jnp.nansum(a, axis=_ax, keepdims=_k), [data])
+
+
+def mean(data, axis=None, keepdims=False, **kwargs):
+    return data.mean(axis=axis, keepdims=keepdims)
+
+
+def prod(data, axis=None, keepdims=False):
+    return data.prod(axis=axis, keepdims=keepdims)
+
+
+def nanprod(data, axis=None, keepdims=False):
+    return _apply(lambda a, _ax=axis, _k=keepdims:
+                  jnp.nanprod(a, axis=_ax, keepdims=_k), [data])
+
+
+def max(data, axis=None, keepdims=False):
+    return data.max(axis=axis, keepdims=keepdims)
+
+
+def min(data, axis=None, keepdims=False):
+    return data.min(axis=axis, keepdims=keepdims)
+
+
+sum_axis, max_axis, min_axis = sum, max, min
+
+
+def norm(data, ord=2, axis=None, keepdims=False):
+    return data.norm(ord=ord, axis=axis, keepdims=keepdims)
+
+
+def argmax(data, axis=None, keepdims=False):
+    return data.argmax(axis=axis, keepdims=keepdims)
+
+
+def argmin(data, axis=None, keepdims=False):
+    return data.argmin(axis=axis, keepdims=keepdims)
+
+
+def cumsum(data, axis=None, dtype=None):
+    return _apply(lambda a, _ax=axis: jnp.cumsum(a, axis=_ax), [data])
+
+
+# -- shape manipulation ------------------------------------------------------
+def reshape(data, shape, **kwargs):
+    return data.reshape(shape)
+
+
+def reshape_like(lhs, rhs):
+    return lhs.reshape_like(rhs)
+
+
+def flatten(data, **kwargs):
+    return data.flatten()
+
+
+Flatten = flatten
+
+
+def transpose(data, axes=None):
+    return data.transpose(*(axes or ()))
+
+
+def expand_dims(data, axis):
+    return data.expand_dims(axis)
+
+
+def squeeze(data, axis=None):
+    return data.squeeze(axis)
+
+
+def concat(*data, dim=1, **kwargs):
+    if len(data) == 1 and isinstance(data[0], (list, tuple)):
+        data = tuple(data[0])
+    return _apply(lambda *xs, _d=dim: jnp.concatenate(xs, axis=_d), list(data))
+
+
+def concatenate(arrays, axis=0):
+    return concat(*arrays, dim=axis)
+
+
+def stack(*data, axis=0, **kwargs):
+    if len(data) == 1 and isinstance(data[0], (list, tuple)):
+        data = tuple(data[0])
+    return _apply(lambda *xs, _ax=axis: jnp.stack(xs, axis=_ax), list(data))
+
+
+def split(data, num_outputs, axis=1, squeeze_axis=False):
+    def fn(a, _n=num_outputs, _ax=axis, _sq=squeeze_axis):
+        parts = jnp.split(a, _n, _ax)
+        if _sq:
+            parts = [jnp.squeeze(p, _ax) for p in parts]
+        return tuple(parts)
+    out = _apply(fn, [data], n_out=num_outputs)
+    return list(out) if isinstance(out, tuple) else [out]
+
+
+def tile(data, reps):
+    return data.tile(reps)
+
+
+def repeat(data, repeats, axis=None):
+    return data.repeat(repeats, axis)
+
+
+def pad(data, mode="constant", pad_width=None, constant_value=0):
+    """Reference pad: pad_width is a flat tuple of (before, after) per axis."""
+    pw = tuple(pad_width)
+    pairs = tuple((pw[i], pw[i + 1]) for i in range(0, len(pw), 2))
+    jmode = {"constant": "constant", "edge": "edge", "reflect": "reflect"}[mode]
+
+    def fn(a, _p=pairs, _m=jmode, _v=constant_value):
+        if _m == "constant":
+            return jnp.pad(a, _p, mode=_m, constant_values=_v)
+        return jnp.pad(a, _p, mode=_m)
+    return _apply(fn, [data])
+
+
+def slice(data, begin, end, step=None):
+    import builtins
+    steps = step if step is not None else [None] * len(begin)
+    idx = tuple(builtins.slice(b, e, s) for b, e, s in zip(begin, end, steps))
+    return _apply(lambda a, _i=idx: a[_i], [data])
+
+
+def slice_axis(data, axis, begin, end):
+    return data.slice_axis(axis, begin, end)
+
+
+def slice_like(data, shape_like, axes=None):
+    def fn(a, b, _axes=tuple(axes) if axes else None):
+        axes_ = _axes if _axes is not None else range(b.ndim)
+        import builtins
+        idx = [builtins.slice(None)] * a.ndim
+        for ax in axes_:
+            idx[ax] = builtins.slice(0, b.shape[ax])
+        return a[tuple(idx)]
+    return _apply(fn, [data, shape_like])
+
+
+def flip(data, axis):
+    return _apply(lambda a, _ax=axis: jnp.flip(a, _ax), [data])
+
+
+reverse = flip
+
+
+def swapaxes(data, dim1, dim2):
+    return data.swapaxes(dim1, dim2)
+
+
+SwapAxis = swapaxes
+
+
+def depth_to_space(data, block_size):
+    def fn(a, _b=block_size):
+        n, c, h, w = a.shape
+        a = a.reshape(n, _b, _b, c // (_b * _b), h, w)
+        a = a.transpose(0, 3, 4, 1, 5, 2)
+        return a.reshape(n, c // (_b * _b), h * _b, w * _b)
+    return _apply(fn, [data])
+
+
+def space_to_depth(data, block_size):
+    def fn(a, _b=block_size):
+        n, c, h, w = a.shape
+        a = a.reshape(n, c, h // _b, _b, w // _b, _b)
+        a = a.transpose(0, 3, 5, 1, 2, 4)
+        return a.reshape(n, c * _b * _b, h // _b, w // _b)
+    return _apply(fn, [data])
+
+
+# -- indexing / selection ----------------------------------------------------
+def take(a, indices, axis=0, mode="clip"):
+    return a.take(indices, axis=axis)
+
+
+def pick(data, index, axis=-1, keepdims=False):
+    return data.pick(index, axis=axis, keepdims=keepdims)
+
+
+def gather_nd(data, indices):
+    idx = _lift(indices)
+    return _apply(lambda a, i: a[tuple(i.astype(jnp.int32))], [data, idx])
+
+
+def scatter_nd(data, indices, shape):
+    idx = _lift(indices)
+    return _apply(lambda d, i, _s=tuple(shape):
+                  jnp.zeros(_s, d.dtype).at[tuple(i.astype(jnp.int32))].set(d),
+                  [data, idx])
+
+
+def where(condition, x, y):
+    return _apply(lambda c, a, b: jnp.where(c.astype(bool), a, b),
+                  [_lift(condition), _lift(x), _lift(y)])
+
+
+def boolean_mask(data, index, axis=0):
+    """Dynamic-shape op: computed on host side via numpy (documented
+    divergence — data-dependent shapes don't exist under XLA)."""
+    import numpy as np
+    from ..ndarray.ndarray import array as _array
+    mask = np.asarray(index.asnumpy(), dtype=bool)
+    return _array(np.compress(mask, data.asnumpy(), axis=axis))
+
+
+def one_hot(indices, depth, on_value=1.0, off_value=0.0, dtype=None):
+    return indices.one_hot(depth, on_value, off_value)
+
+
+def topk(data, k=1, axis=-1, ret_typ="indices", is_ascend=False):
+    return data.topk(k=k, axis=axis, ret_typ=ret_typ, is_ascend=is_ascend)
+
+
+def sort(data, axis=-1, is_ascend=True):
+    return data.sort(axis=axis, is_ascend=is_ascend)
+
+
+def argsort(data, axis=-1, is_ascend=True):
+    return data.argsort(axis=axis, is_ascend=is_ascend)
+
+
+def shuffle(data):
+    from ..random import _next_key
+    key = _next_key()
+    return _apply(lambda a, _k=key: jax.random.permutation(_k, a, axis=0), [data])
+
+
+def diag(data, k=0):
+    return _apply(lambda a, _k=k: jnp.diag(a, _k) if a.ndim <= 2
+                  else jnp.diagonal(a, _k, -2, -1), [data])
+
+
+# -- linear algebra entry points --------------------------------------------
+def dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    def fn(a, b, _ta=transpose_a, _tb=transpose_b):
+        if _ta:
+            a = a.T
+        if _tb:
+            b = b.T
+        return jnp.dot(a, b)
+    return _apply(fn, [lhs, _lift(rhs)])
+
+
+def batch_dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    def fn(a, b, _ta=transpose_a, _tb=transpose_b):
+        if _ta:
+            a = jnp.swapaxes(a, -1, -2)
+        if _tb:
+            b = jnp.swapaxes(b, -1, -2)
+        return jnp.matmul(a, b)
+    return _apply(fn, [lhs, _lift(rhs)])
+
+
+def add_n(*args):
+    if len(args) == 1 and isinstance(args[0], (list, tuple)):
+        args = tuple(args[0])
+    return _apply(lambda *xs: functools_reduce(xs), list(args))
+
+
+def functools_reduce(xs):
+    out = xs[0]
+    for x in xs[1:]:
+        out = out + x
+    return out
+
+
+ElementWiseSum = add_n
+
+
+def cast(data, dtype):
+    return data.astype(dtype)
+
+
+Cast = cast
+
+
+def zeros_like(data, **kwargs):
+    return _apply(jnp.zeros_like, [data])
+
+
+def ones_like(data, **kwargs):
+    return _apply(jnp.ones_like, [data])
+
+
+def shape_array(data):
+    from ..ndarray.ndarray import array as _array
+    return _array(jnp.asarray(data.shape, dtype=jnp.int32))
+
+
+def size_array(data):
+    from ..ndarray.ndarray import array as _array
+    return _array(jnp.asarray([data.size], dtype=jnp.int32))

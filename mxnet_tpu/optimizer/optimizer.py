@@ -1,0 +1,444 @@
+"""Optimizers (reference: python/mxnet/optimizer/optimizer.py).
+
+TPU-native design: every update rule is a *pure jitted function*
+`(weight, grad, *state, lr, wd) -> (new_weight, *new_state)` over jax arrays.
+Imperative `update()` rebinds the weight NDArray; inside a pjit-compiled
+train step the same pure rules are applied functionally (see
+parallel/data_parallel.py), so there is exactly one implementation of each
+rule. Multi-precision keeps an fp32 master copy for bf16 weights
+(reference: update_multi_precision / momentum in fp32).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..base import MXNetError
+
+__all__ = ["Optimizer", "SGD", "NAG", "Adam", "AdamW", "Adamax", "Nadam",
+           "AdaGrad", "AdaDelta", "RMSProp", "Ftrl", "LAMB", "LARS", "Signum",
+           "SGLD", "DCASGD", "create", "register"]
+
+_REGISTRY = {}
+
+
+def register(klass):
+    _REGISTRY[klass.__name__.lower()] = klass
+    return klass
+
+
+def create(name, **kwargs):
+    if isinstance(name, Optimizer):
+        return name
+    name = name.lower()
+    if name not in _REGISTRY:
+        raise MXNetError(f"unknown optimizer {name!r}: {sorted(_REGISTRY)}")
+    return _REGISTRY[name](**kwargs)
+
+
+class Optimizer:
+    """Base optimizer with per-parameter state, lr scaling and schedulers."""
+
+    def __init__(self, learning_rate=0.01, wd=0.0, rescale_grad=1.0,
+                 clip_gradient=None, lr_scheduler=None, param_dict=None,
+                 multi_precision=False, **kwargs):
+        self.lr = learning_rate
+        self.wd = wd
+        self.rescale_grad = rescale_grad
+        self.clip_gradient = clip_gradient
+        self.lr_scheduler = lr_scheduler
+        if lr_scheduler is not None:
+            self.lr_scheduler.base_lr = learning_rate
+        self.multi_precision = multi_precision
+        self.num_update = 0
+        self._index_update_count = {}
+        self.param_dict = param_dict or {}
+        self.lr_mult = {}
+        self.wd_mult = {}
+        self.idx2name = {}
+
+    # -- bookkeeping ------------------------------------------------------
+    def _update_count(self, index):
+        self._index_update_count.setdefault(index, 0)
+        self._index_update_count[index] += 1
+        self.num_update = max(self.num_update, self._index_update_count[index])
+
+    def _get_lr(self, index):
+        lr = self.lr_scheduler(self.num_update) if self.lr_scheduler else self.lr
+        if index in self.param_dict:
+            lr *= getattr(self.param_dict[index], "lr_mult", 1.0)
+        lr *= self.lr_mult.get(index, self.lr_mult.get(
+            self.idx2name.get(index, index), 1.0))
+        return lr
+
+    def _get_wd(self, index):
+        wd = self.wd
+        if index in self.param_dict:
+            wd *= getattr(self.param_dict[index], "wd_mult", 1.0)
+        wd *= self.wd_mult.get(index, self.wd_mult.get(
+            self.idx2name.get(index, index), 1.0))
+        return wd
+
+    def set_learning_rate(self, lr):
+        self.lr = lr
+        if self.lr_scheduler is not None:
+            self.lr_scheduler.base_lr = lr
+
+    @property
+    def learning_rate(self):
+        return self.lr_scheduler(self.num_update) if self.lr_scheduler else self.lr
+
+    def set_lr_mult(self, args_lr_mult):
+        self.lr_mult = dict(args_lr_mult)
+
+    def set_wd_mult(self, args_wd_mult):
+        self.wd_mult = dict(args_wd_mult)
+
+    # -- functional API (shared with pjit train steps) --------------------
+    def init_state(self, weight_value):
+        """Pure: weight jax.Array -> tuple of state arrays."""
+        return ()
+
+    def apply(self, weight, grad, state, lr, wd):
+        """Pure update rule: -> (new_weight, new_state_tuple)."""
+        raise NotImplementedError
+
+    def _preprocess(self, grad):
+        g = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        return g
+
+    # -- imperative API (reference signature) -----------------------------
+    def create_state(self, index, weight):
+        from ..ndarray.ndarray import NDArray
+        return tuple(NDArray(s) for s in self.init_state(weight._data))
+
+    def create_state_multi_precision(self, index, weight):
+        if self.multi_precision and weight.dtype != np.float32:
+            master = weight._data.astype(jnp.float32)
+            from ..ndarray.ndarray import NDArray
+            return (NDArray(master),) + self.create_state(index, weight)
+        return self.create_state(index, weight)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        g = self._preprocess(grad._data.astype(jnp.float32)
+                             if grad.dtype != np.float32 else grad._data)
+        svals = tuple(s._data for s in state) if isinstance(state, tuple) else \
+            ((state._data,) if state is not None else ())
+        new_w, new_s = self.apply(weight._data, g.astype(weight._data.dtype),
+                                  svals, lr, wd)
+        weight._rebind(new_w)
+        states = state if isinstance(state, tuple) else \
+            ((state,) if state is not None else ())
+        for s_nd, s_val in zip(states, new_s):
+            s_nd._rebind(s_val)
+
+    def update_multi_precision(self, index, weight, grad, state):
+        if self.multi_precision and weight.dtype != np.float32:
+            master, rest = state[0], state[1:]
+            self._update_count(index)
+            lr, wd = self._get_lr(index), self._get_wd(index)
+            g = self._preprocess(grad._data.astype(jnp.float32))
+            new_m, new_s = self.apply(master._data, g,
+                                      tuple(s._data for s in rest), lr, wd)
+            master._rebind(new_m)
+            weight._rebind(new_m.astype(weight._data.dtype))
+            for s_nd, s_val in zip(rest, new_s):
+                s_nd._rebind(s_val)
+        else:
+            self.update(index, weight, grad, state)
+
+    def __repr__(self):
+        return f"{type(self).__name__}(lr={self.lr}, wd={self.wd})"
+
+
+@register
+class SGD(Optimizer):
+    """SGD with momentum and weight decay (reference sgd_mom_update)."""
+
+    def __init__(self, learning_rate=0.01, momentum=0.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+
+    def init_state(self, w):
+        return (jnp.zeros_like(w),) if self.momentum else ()
+
+    def apply(self, w, g, state, lr, wd):
+        g = g + wd * w
+        if self.momentum:
+            m = state[0] * self.momentum + g
+            return w - lr * m, (m,)
+        return w - lr * g, ()
+
+
+@register
+class NAG(SGD):
+    """Nesterov accelerated SGD."""
+
+    def apply(self, w, g, state, lr, wd):
+        g = g + wd * w
+        if self.momentum:
+            m = state[0] * self.momentum + g
+            return w - lr * (g + self.momentum * m), (m,)
+        return w - lr * g, ()
+
+
+@register
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def init_state(self, w):
+        return (jnp.zeros_like(w), jnp.zeros_like(w),
+                jnp.zeros((), jnp.int32))
+
+    def apply(self, w, g, state, lr, wd):
+        m, v, t = state
+        t = t + 1
+        g = g + wd * w
+        m = self.beta1 * m + (1 - self.beta1) * g
+        v = self.beta2 * v + (1 - self.beta2) * g * g
+        tf = t.astype(jnp.float32)
+        mhat = m / (1 - self.beta1 ** tf)
+        vhat = v / (1 - self.beta2 ** tf)
+        return w - lr * mhat / (jnp.sqrt(vhat) + self.epsilon), (m, v, t)
+
+
+@register
+class AdamW(Adam):
+    """Adam with decoupled weight decay (reference: contrib adamw)."""
+
+    def apply(self, w, g, state, lr, wd):
+        m, v, t = state
+        t = t + 1
+        m = self.beta1 * m + (1 - self.beta1) * g
+        v = self.beta2 * v + (1 - self.beta2) * g * g
+        tf = t.astype(jnp.float32)
+        mhat = m / (1 - self.beta1 ** tf)
+        vhat = v / (1 - self.beta2 ** tf)
+        upd = mhat / (jnp.sqrt(vhat) + self.epsilon) + wd * w
+        return w - lr * upd, (m, v, t)
+
+
+@register
+class Adamax(Adam):
+    def apply(self, w, g, state, lr, wd):
+        m, u, t = state
+        t = t + 1
+        g = g + wd * w
+        m = self.beta1 * m + (1 - self.beta1) * g
+        u = jnp.maximum(self.beta2 * u, jnp.abs(g))
+        tf = t.astype(jnp.float32)
+        return w - lr / (1 - self.beta1 ** tf) * m / (u + self.epsilon), (m, u, t)
+
+
+@register
+class Nadam(Adam):
+    def apply(self, w, g, state, lr, wd):
+        m, v, t = state
+        t = t + 1
+        g = g + wd * w
+        m = self.beta1 * m + (1 - self.beta1) * g
+        v = self.beta2 * v + (1 - self.beta2) * g * g
+        tf = t.astype(jnp.float32)
+        mhat = m / (1 - self.beta1 ** tf)
+        vhat = v / (1 - self.beta2 ** tf)
+        mbar = self.beta1 * mhat + (1 - self.beta1) * g / (1 - self.beta1 ** tf)
+        return w - lr * mbar / (jnp.sqrt(vhat) + self.epsilon), (m, v, t)
+
+
+@register
+class AdaGrad(Optimizer):
+    def __init__(self, learning_rate=0.01, eps=1e-7, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.float_stable_eps = eps
+
+    def init_state(self, w):
+        return (jnp.zeros_like(w),)
+
+    def apply(self, w, g, state, lr, wd):
+        g = g + wd * w
+        h = state[0] + g * g
+        return w - lr * g / (jnp.sqrt(h) + self.float_stable_eps), (h,)
+
+
+@register
+class AdaDelta(Optimizer):
+    def __init__(self, learning_rate=1.0, rho=0.9, epsilon=1e-5, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.rho, self.epsilon = rho, epsilon
+
+    def init_state(self, w):
+        return (jnp.zeros_like(w), jnp.zeros_like(w))
+
+    def apply(self, w, g, state, lr, wd):
+        acc_g, acc_d = state
+        g = g + wd * w
+        acc_g = self.rho * acc_g + (1 - self.rho) * g * g
+        d = jnp.sqrt(acc_d + self.epsilon) / jnp.sqrt(acc_g + self.epsilon) * g
+        acc_d = self.rho * acc_d + (1 - self.rho) * d * d
+        return w - lr * d, (acc_g, acc_d)
+
+
+@register
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate=0.001, rho=0.9, momentum=0.9,
+                 epsilon=1e-8, centered=False, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.rho, self.momentum = rho, momentum
+        self.epsilon, self.centered = epsilon, centered
+
+    def init_state(self, w):
+        if self.centered:
+            return (jnp.zeros_like(w), jnp.zeros_like(w), jnp.zeros_like(w))
+        return (jnp.zeros_like(w),)
+
+    def apply(self, w, g, state, lr, wd):
+        g = g + wd * w
+        if self.centered:
+            n, mg, mom = state
+            n = self.rho * n + (1 - self.rho) * g * g
+            mg = self.rho * mg + (1 - self.rho) * g
+            mom = self.momentum * mom \
+                - lr * g / jnp.sqrt(n - mg * mg + self.epsilon)
+            return w + mom, (n, mg, mom)
+        n = self.rho * state[0] + (1 - self.rho) * g * g
+        return w - lr * g / (jnp.sqrt(n) + self.epsilon), (n,)
+
+
+@register
+class Ftrl(Optimizer):
+    def __init__(self, learning_rate=0.1, lamda1=0.01, beta=1.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.lamda1, self.beta = lamda1, beta
+
+    def init_state(self, w):
+        return (jnp.zeros_like(w), jnp.zeros_like(w))
+
+    def apply(self, w, g, state, lr, wd):
+        z, n = state
+        sigma = (jnp.sqrt(n + g * g) - jnp.sqrt(n)) / lr
+        z = z + g - sigma * w
+        n = n + g * g
+        new_w = jnp.where(
+            jnp.abs(z) > self.lamda1,
+            -(z - jnp.sign(z) * self.lamda1)
+            / ((self.beta + jnp.sqrt(n)) / lr + wd),
+            0.0).astype(w.dtype)
+        return new_w, (z, n)
+
+
+@register
+class LAMB(Optimizer):
+    """Layer-wise adaptive moments (reference: contrib lamb_update) — the
+    large-batch BERT optimizer."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-6, lower_bound=None, upper_bound=None,
+                 bias_correction=True, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self.lower_bound, self.upper_bound = lower_bound, upper_bound
+        self.bias_correction = bias_correction
+
+    def init_state(self, w):
+        return (jnp.zeros_like(w), jnp.zeros_like(w), jnp.zeros((), jnp.int32))
+
+    def apply(self, w, g, state, lr, wd):
+        m, v, t = state
+        t = t + 1
+        m = self.beta1 * m + (1 - self.beta1) * g
+        v = self.beta2 * v + (1 - self.beta2) * g * g
+        if self.bias_correction:
+            tf = t.astype(jnp.float32)
+            mhat = m / (1 - self.beta1 ** tf)
+            vhat = v / (1 - self.beta2 ** tf)
+        else:
+            mhat, vhat = m, v
+        r = mhat / (jnp.sqrt(vhat) + self.epsilon) + wd * w
+        w_norm = jnp.linalg.norm(w)
+        r_norm = jnp.linalg.norm(r)
+        ratio = jnp.where(jnp.logical_and(w_norm > 0, r_norm > 0),
+                          w_norm / r_norm, 1.0)
+        if self.lower_bound is not None:
+            ratio = jnp.maximum(ratio, self.lower_bound)
+        if self.upper_bound is not None:
+            ratio = jnp.minimum(ratio, self.upper_bound)
+        return w - lr * ratio * r, (m, v, t)
+
+
+@register
+class LARS(SGD):
+    """Layer-wise adaptive rate scaling for large-batch SGD."""
+
+    def __init__(self, learning_rate=0.1, momentum=0.9, eta=0.001,
+                 epsilon=1e-8, **kwargs):
+        super().__init__(learning_rate=learning_rate, momentum=momentum,
+                         **kwargs)
+        self.eta, self.epsilon = eta, epsilon
+
+    def apply(self, w, g, state, lr, wd):
+        w_norm = jnp.linalg.norm(w)
+        g_norm = jnp.linalg.norm(g)
+        trust = jnp.where(
+            jnp.logical_and(w_norm > 0, g_norm > 0),
+            self.eta * w_norm / (g_norm + wd * w_norm + self.epsilon), 1.0)
+        return super().apply(w, g, state, lr * trust, wd)
+
+
+@register
+class Signum(Optimizer):
+    def __init__(self, learning_rate=0.01, momentum=0.9, wd_lh=0.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+        self.wd_lh = wd_lh
+
+    def init_state(self, w):
+        return (jnp.zeros_like(w),) if self.momentum else ()
+
+    def apply(self, w, g, state, lr, wd):
+        if self.momentum:
+            m = self.momentum * state[0] - (1 - self.momentum) * (g + wd * w)
+            return (1 - lr * self.wd_lh) * w + lr * jnp.sign(m), (m,)
+        return (1 - lr * self.wd_lh) * w - lr * jnp.sign(g + wd * w), ()
+
+
+@register
+class SGLD(Optimizer):
+    """Stochastic gradient Langevin dynamics."""
+
+    def init_state(self, w):
+        from .. import random as _random
+        return (_random._next_key(),)
+
+    def apply(self, w, g, state, lr, wd):
+        key, sub = jax.random.split(state[0])
+        noise = jnp.sqrt(lr) * jax.random.normal(sub, w.shape, jnp.float32)
+        return w - lr / 2 * (g + wd * w) + noise.astype(w.dtype), (key,)
+
+
+@register
+class DCASGD(Optimizer):
+    """Delay-compensated async SGD (reference: DCASGD)."""
+
+    def __init__(self, learning_rate=0.01, momentum=0.0, lamda=0.04, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum, self.lamda = momentum, lamda
+
+    def init_state(self, w):
+        return (jnp.zeros_like(w), w)
+
+    def apply(self, w, g, state, lr, wd):
+        mom, prev_w = state
+        g = g + wd * w
+        g = g + self.lamda * g * g * (w - prev_w)
+        mom = self.momentum * mom - lr * g
+        return w + mom, (mom, w)

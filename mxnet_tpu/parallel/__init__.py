@@ -1,0 +1,15 @@
+"""mxnet_tpu.parallel — distributed training over jax.sharding.Mesh.
+
+Axes: dp (data) / tp (tensor) / pp (pipeline) / sp (sequence) / ep (expert).
+See SURVEY.md §2 #37-41.
+"""
+from .mesh import make_mesh, single_axis_mesh, shard_batch, P, Mesh
+from .functional import functional_call, param_values
+from .data_parallel import DataParallelTrainer, make_train_step
+from . import tensor_parallel
+from .tensor_parallel import (column_parallel_dense, row_parallel_dense,
+                              shard_params, tp_rules_transformer)
+from .pipeline import pipeline_apply, stack_stage_params
+from .ring_attention import ring_attention, ring_attention_sharded
+from . import moe
+from .moe import moe_ffn, init_moe_params, moe_param_specs

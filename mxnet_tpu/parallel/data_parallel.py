@@ -1,0 +1,126 @@
+"""Data-parallel training as ONE pjit-compiled XLA program.
+
+Reference path being replaced: Gluon Trainer + KVStore nccl allreduce
+(python/mxnet/gluon/trainer.py, src/kvstore/kvstore_nccl.cc). TPU-native
+path: parameters live replicated over the mesh, the batch is sharded over
+'dp', and XLA's SPMD partitioner inserts the gradient psum over ICI
+automatically from the sharding annotations — no explicit collective calls,
+no host round-trips, buffers donated so weights update in place in HBM.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from .functional import functional_call, param_values, collect_params_ordered
+from .mesh import make_mesh
+
+__all__ = ["DataParallelTrainer", "make_train_step"]
+
+
+def make_train_step(block, loss_block, optimizer, mesh=None, dp_axis="dp",
+                    donate=True, compute_dtype=None):
+    """Build (step_fn, init_state). step_fn(state, x, y, lr) -> (state, loss).
+
+    The returned step is jit-compiled once; with a mesh, x/y are expected
+    sharded over `dp_axis` and params replicated.
+    """
+    names = [n for n, _ in collect_params_ordered(block)]
+    trainable = [n for n, p in collect_params_ordered(block)
+                 if p.grad_req != "null"]
+    trainable_set = set(trainable)
+
+    def loss_of(params, x, y, rng):
+        out, aux = functional_call(block, params, [x], training=True, rng=rng)
+        out = out[0] if isinstance(out, tuple) else out
+        if compute_dtype is not None:
+            out = out.astype(jnp.float32)
+        loss_nd, _ = functional_call(loss_block, {}, [out, y], training=True)
+        loss = loss_nd[0] if isinstance(loss_nd, tuple) else loss_nd
+        return jnp.mean(loss), aux
+
+    def step(state, x, y, lr, rng):
+        params, opt_state, num_update = state
+        (loss, aux), grads = jax.value_and_grad(loss_of, has_aux=True)(
+            params, x, y, rng)
+        new_params = dict(params)
+        new_opt = dict(opt_state)
+        wd = optimizer.wd
+        for n in names:
+            if n not in trainable_set:
+                continue
+            g = grads[n]
+            if optimizer.clip_gradient is not None:
+                g = jnp.clip(g, -optimizer.clip_gradient,
+                             optimizer.clip_gradient)
+            w, s = optimizer.apply(params[n], g.astype(params[n].dtype),
+                                   opt_state[n], lr, wd)
+            new_params[n] = w
+            new_opt[n] = s
+        # BatchNorm running stats updated functionally
+        for n, v in aux.items():
+            if n in new_params:
+                new_params[n] = v
+        return (new_params, new_opt, num_update + 1), loss
+
+    def init_state():
+        params = param_values(block)
+        opt_state = {n: optimizer.init_state(params[n]) for n in trainable}
+        return (params, opt_state, jnp.zeros((), jnp.int32))
+
+    donate_argnums = (0,) if donate else ()
+    if mesh is not None:
+        repl = NamedSharding(mesh, P())
+        data_sh = NamedSharding(mesh, P(dp_axis))
+        step_fn = jax.jit(
+            step,
+            in_shardings=(None, data_sh, data_sh, None, None),
+            donate_argnums=donate_argnums)
+    else:
+        step_fn = jax.jit(step, donate_argnums=donate_argnums)
+    return step_fn, init_state
+
+
+class DataParallelTrainer:
+    """High-level fused data-parallel trainer.
+
+    Usage:
+        trainer = DataParallelTrainer(net, loss, mx.optimizer.SGD(...), mesh)
+        loss = trainer.step(x, y)           # one XLA program per step
+        trainer.sync_to_params()            # write weights back to Gluon
+    """
+
+    def __init__(self, block, loss_block, optimizer, mesh=None, dp_axis="dp"):
+        self.block = block
+        self.mesh = mesh
+        self.dp_axis = dp_axis
+        self.optimizer = optimizer
+        self._step_fn, init = make_train_step(block, loss_block, optimizer,
+                                              mesh, dp_axis)
+        self.state = init()
+        self._rng = jax.random.PRNGKey(0)
+        self.num_update = 0
+
+    def step(self, x, y, lr=None):
+        from ..ndarray.ndarray import NDArray
+        x = x._data if isinstance(x, NDArray) else x
+        y = y._data if isinstance(y, NDArray) else y
+        if self.mesh is not None:
+            sh = NamedSharding(self.mesh, P(self.dp_axis))
+            x = jax.device_put(x, sh)
+            y = jax.device_put(y, sh)
+        self.num_update += 1
+        lr = lr if lr is not None else self.optimizer.learning_rate
+        self.optimizer.num_update = self.num_update
+        self._rng, sub = jax.random.split(self._rng)
+        self.state, loss = self._step_fn(self.state, x, y, lr, sub)
+        return loss
+
+    def sync_to_params(self):
+        """Write the functional state back into the Gluon Parameters."""
+        params, _, _ = self.state
+        for name, p in collect_params_ordered(self.block):
+            p._data._rebind(params[name])

@@ -1,0 +1,59 @@
+"""Functional bridge: run a Gluon block as a pure function of its parameters.
+
+This is the seam between the imperative Gluon surface and pjit-compiled
+training: `functional_call` executes block.forward with every descendant
+Parameter overridden by a passed-in array, recording off, so the call can be
+traced by jax.jit / shard_map / grad. (Reference analogue: CachedOp's
+parameter-input graph.)
+"""
+from __future__ import annotations
+
+import jax
+
+from .. import autograd
+from ..gluon.block import _TraceContext
+from ..ndarray.ndarray import NDArray
+
+__all__ = ["param_values", "functional_call", "collect_params_ordered"]
+
+
+def collect_params_ordered(block):
+    """Stable-ordered list of (name, Parameter) for a block tree."""
+    return list(block.collect_params().items())
+
+
+def param_values(block, dtype=None):
+    """Dict name -> jax array of current parameter values."""
+    out = {}
+    for name, p in collect_params_ordered(block):
+        v = p.data()._data
+        if dtype is not None and v.dtype != dtype and \
+                jax.numpy.issubdtype(v.dtype, jax.numpy.floating):
+            v = v.astype(dtype)
+        out[name] = v
+    return out
+
+
+def functional_call(block, params, args, training=False, rng=None):
+    """Pure: params dict name->array, args: jax arrays -> output array(s)."""
+    plist = [p for _, p in collect_params_ordered(block)]
+    names = [n for n, _ in collect_params_ordered(block)]
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    prev_rec = autograd.set_recording(False)
+    prev_train = autograd.set_training(training)
+    try:
+        with _TraceContext(rng) as tctx:
+            for n, p in zip(names, plist):
+                p._trace_override = NDArray(params[n])
+            nd_args = [NDArray(a) for a in args]
+            out = block.forward(*nd_args)
+            aux = {p.name: (v._data if isinstance(v, NDArray) else v)
+                   for p, v in tctx.aux_updates}
+        if isinstance(out, (tuple, list)):
+            return tuple(o._data for o in out), aux
+        return out._data, aux
+    finally:
+        for p in plist:
+            p._trace_override = None
+        autograd.set_recording(prev_rec)
+        autograd.set_training(prev_train)

@@ -1,0 +1,56 @@
+"""Device mesh utilities (reference role: kvstore device topology + NCCL
+communicator setup; TPU-native: jax.sharding.Mesh over ICI).
+
+Canonical axis names used across the framework:
+  dp — data parallel        tp — tensor parallel
+  pp — pipeline parallel    sp — sequence/context parallel
+  ep — expert parallel
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["make_mesh", "single_axis_mesh", "Mesh", "NamedSharding", "P",
+           "replicated", "shard_batch", "local_mesh_devices"]
+
+
+def local_mesh_devices():
+    return jax.devices()
+
+
+def make_mesh(axes, devices=None):
+    """Create a Mesh from {axis_name: size}. Sizes must multiply to the
+    device count; -1 infers one axis."""
+    devices = devices if devices is not None else jax.devices()
+    names = list(axes.keys())
+    sizes = list(axes.values())
+    n = len(devices)
+    if -1 in sizes:
+        known = int(np.prod([s for s in sizes if s != -1]))
+        sizes[sizes.index(-1)] = max(n // known, 1)
+    total = int(np.prod(sizes))
+    if total > n:
+        raise ValueError(f"mesh {dict(zip(names, sizes))} needs {total} "
+                         f"devices, have {n}")
+    dev_array = np.asarray(devices[:total]).reshape(sizes)
+    return Mesh(dev_array, tuple(names))
+
+
+def single_axis_mesh(axis="dp", n=None):
+    devices = jax.devices()
+    n = n or len(devices)
+    return Mesh(np.asarray(devices[:n]), (axis,))
+
+
+def replicated(mesh):
+    return NamedSharding(mesh, P())
+
+
+def shard_batch(mesh, batch, axis="dp"):
+    """Shard leading batch dim over the mesh axis."""
+    sharding = NamedSharding(mesh, P(axis))
+    return jax.tree_util.tree_map(
+        lambda x: jax.device_put(x, sharding), batch)

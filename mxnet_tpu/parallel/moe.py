@@ -1,0 +1,79 @@
+"""Mixture-of-Experts with expert parallelism over the 'ep' mesh axis.
+
+Required by the build brief (ep sharding in dryrun_multichip). Switch-style
+top-1 routing with capacity factor; expert FFN weights carry a leading
+expert axis sharded P('ep'), dispatch/combine are einsums whose expert
+contraction XLA partitions into all-to-alls over ICI.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+__all__ = ["moe_ffn", "init_moe_params", "moe_param_specs"]
+
+
+def init_moe_params(key, n_experts, d_model, d_ff, dtype=jnp.float32):
+    k1, k2, k3 = jax.random.split(key, 3)
+    scale_in = 1.0 / (d_model ** 0.5)
+    scale_out = 1.0 / (d_ff ** 0.5)
+    return {
+        "gate": (jax.random.normal(k1, (d_model, n_experts)) * scale_in
+                 ).astype(dtype),
+        "wi": (jax.random.normal(k2, (n_experts, d_model, d_ff)) * scale_in
+               ).astype(dtype),
+        "wo": (jax.random.normal(k3, (n_experts, d_ff, d_model)) * scale_out
+               ).astype(dtype),
+    }
+
+
+def moe_param_specs(ep_axis="ep"):
+    return {"gate": P(), "wi": P(ep_axis, None, None),
+            "wo": P(ep_axis, None, None)}
+
+
+def moe_ffn(params, x, capacity_factor=1.25, activation=jax.nn.gelu):
+    """x: (B, T, D) -> (B, T, D), plus aux load-balancing loss.
+
+    Dense dispatch (Mesh-TensorFlow style): dispatch mask (B,T,E,C) einsummed
+    against expert weights; the E axis is sharded over 'ep'.
+    """
+    b, t, d = x.shape
+    e = params["gate"].shape[1]
+    tokens = b * t
+    capacity = max(int(capacity_factor * tokens / e), 1)
+
+    logits = jnp.einsum("btd,de->bte", x, params["gate"],
+                        preferred_element_type=jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)
+    expert_idx = jnp.argmax(probs, axis=-1)              # (B,T)
+    expert_mask = jax.nn.one_hot(expert_idx, e)          # (B,T,E)
+    gate_val = jnp.sum(probs * expert_mask, axis=-1)     # (B,T)
+
+    # position of each token within its expert's buffer
+    flat_mask = expert_mask.reshape(tokens, e)
+    pos = jnp.cumsum(flat_mask, axis=0) * flat_mask - 1.0   # (BT, E)
+    keep = pos < capacity
+    pos = jnp.where(keep, pos, 0.0).astype(jnp.int32)
+    flat_mask = flat_mask * keep
+
+    # load-balance aux loss (Switch Transformer eq. 4)
+    density = jnp.mean(expert_mask.reshape(tokens, e), axis=0)
+    density_proxy = jnp.mean(probs.reshape(tokens, e), axis=0)
+    aux_loss = e * jnp.sum(density * density_proxy)
+
+    dispatch = flat_mask[:, :, None] * jax.nn.one_hot(pos, capacity)  # BT,E,C
+    dispatch = dispatch.reshape(b, t, e, capacity)
+    gate_dispatch = dispatch * gate_val[:, :, None, None]
+
+    # route tokens to expert buffers: (E, C, D)
+    expert_in = jnp.einsum("btec,btd->ecd", dispatch, x)
+    h = activation(jnp.einsum("ecd,edf->ecf", expert_in, params["wi"],
+                              preferred_element_type=jnp.float32)
+                   .astype(x.dtype))
+    expert_out = jnp.einsum("ecf,efd->ecd", h, params["wo"],
+                            preferred_element_type=jnp.float32
+                            ).astype(x.dtype)
+    out = jnp.einsum("btec,ecd->btd", gate_dispatch, expert_out)
+    return out, aux_loss

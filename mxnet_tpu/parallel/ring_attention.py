@@ -1,0 +1,86 @@
+"""Ring attention: sequence/context parallelism over the 'sp' mesh axis.
+
+First-class per the build brief (long-context training). Each device holds a
+sequence shard of Q/K/V; K/V blocks rotate around the ring with
+`lax.ppermute` while the local Q accumulates an online-softmax partial — the
+blockwise/flash combine — so attention over sequence length S costs O(S/P)
+memory per chip and the K/V transfers ride ICI neighbour links, overlapping
+with the block matmuls (Liu et al., Ring Attention; PAPERS.md).
+
+Causal masking uses the global block indices so the rotated source shard is
+masked correctly at every step.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+from jax import shard_map
+
+__all__ = ["ring_attention", "ring_attention_sharded"]
+
+
+def _block_attn(q, k, v, mask):
+    """Partial attention stats for one K/V block.
+    q: (B,H,Sq,D) k,v: (B,H,Sk,D). Returns (m, l, o_unnorm)."""
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                   preferred_element_type=jnp.float32)
+    s = jnp.where(mask, s, -1e30)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    m = jnp.maximum(m, -1e30)
+    p = jnp.exp(s - m)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    o = jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v)
+    return m, l, o.astype(jnp.float32)
+
+
+def ring_attention(q, k, v, axis_name="sp", causal=False, sm_scale=None):
+    """Call INSIDE shard_map with q,k,v sequence-sharded: (B,H,S/P,D)."""
+    if sm_scale is None:
+        sm_scale = 1.0 / (q.shape[-1] ** 0.5)
+    q = q * sm_scale
+    n_dev = jax.lax.axis_size(axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    s_loc = q.shape[2]
+    perm = [(i, (i + 1) % n_dev) for i in range(n_dev)]
+
+    qi = jax.lax.broadcasted_iota(jnp.int32, (s_loc, s_loc), 0)
+    kj = jax.lax.broadcasted_iota(jnp.int32, (s_loc, s_loc), 1)
+
+    def step(carry, i):
+        k_cur, v_cur, m_acc, l_acc, o_acc = carry
+        src = (my_idx - i) % n_dev      # which shard this K/V block is
+        if causal:
+            # global positions: my rows = my_idx*s_loc + qi ; cols = src*s_loc + kj
+            mask = (my_idx * s_loc + qi)[None, None] >= \
+                   (src * s_loc + kj)[None, None]
+        else:
+            mask = jnp.ones((1, 1, s_loc, s_loc), bool)
+        m_b, l_b, o_b = _block_attn(q, k_cur, v_cur, mask)
+        m_new = jnp.maximum(m_acc, m_b)
+        alpha = jnp.exp(m_acc - m_new)
+        beta = jnp.exp(m_b - m_new)
+        l_new = l_acc * alpha + l_b * beta
+        o_new = o_acc * alpha + o_b * beta
+        k_next = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_next = jax.lax.ppermute(v_cur, axis_name, perm)
+        return (k_next, v_next, m_new, l_new, o_new), None
+
+    b, h, _, d = q.shape
+    m0 = jnp.full((b, h, s_loc, 1), -1e30, jnp.float32)
+    l0 = jnp.zeros((b, h, s_loc, 1), jnp.float32)
+    o0 = jnp.zeros((b, h, s_loc, d), jnp.float32)
+    carry, _ = jax.lax.scan(step, (k, v, m0, l0, o0), jnp.arange(n_dev))
+    _, _, m, l, o = carry
+    return (o / jnp.maximum(l, 1e-30)).astype(q.dtype)
+
+
+def ring_attention_sharded(q, k, v, mesh, axis_name="sp", causal=False):
+    """Convenience wrapper: shard (B,H,S,D) arrays over S and run the ring."""
+    spec = P(None, None, axis_name, None)
+    f = shard_map(
+        functools.partial(ring_attention, axis_name=axis_name, causal=causal),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    return f(q, k, v)

@@ -1,0 +1,81 @@
+"""Tensor (model) parallelism over the 'tp' mesh axis.
+
+Reference analogue: example/model-parallel (manual device placement of
+layer halves). TPU-native: Megatron-style column/row parallel matmuls
+expressed as sharding constraints — XLA's SPMD partitioner turns the
+row-parallel contraction into a reduce-scatter/all-reduce over ICI; no
+explicit collectives in user code.
+
+Helpers here are pure functions over jax arrays plus a PartitionSpec rule
+table, used by models/bert.py's tp mode and __graft_entry__.dryrun_multichip.
+"""
+from __future__ import annotations
+
+import re
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+__all__ = ["column_parallel_dense", "row_parallel_dense", "shard_params",
+           "tp_rules_transformer", "constrain"]
+
+
+def constrain(x, mesh, spec):
+    if mesh is None:
+        return x
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+
+
+def column_parallel_dense(x, weight, bias=None, mesh=None, tp_axis="tp"):
+    """y = x @ W^T with W sharded over its OUTPUT dim -> y sharded on last
+    axis. (Megatron column-parallel: no communication in forward.)"""
+    y = jnp.matmul(x, weight.T)
+    if bias is not None:
+        y = y + bias
+    return constrain(y, mesh, P(*([None] * (y.ndim - 1) + [tp_axis])))
+
+
+def row_parallel_dense(x, weight, bias=None, mesh=None, tp_axis="tp"):
+    """y = x @ W^T with W sharded over its INPUT dim; x arrives sharded on
+    its last axis, the contraction forces an all-reduce (inserted by SPMD)."""
+    y = jnp.matmul(x, weight.T)
+    y = constrain(y, mesh, P(*([None] * y.ndim)))
+    if bias is not None:
+        y = y + bias
+    return y
+
+
+def tp_rules_transformer(tp_axis="tp", dp_axis=None):
+    """PartitionSpec rules (regex -> spec) for a standard transformer:
+    QKV & FFN-in column-parallel, attn-out & FFN-out row-parallel,
+    embeddings sharded over vocab."""
+    return [
+        (r".*(query|key|value|qkv).*weight$", P(tp_axis, None)),
+        (r".*(ffn_1|intermediate|fc1|inter).*weight$", P(tp_axis, None)),
+        (r".*(proj|ffn_2|output_dense|fc2|out).*weight$", P(None, tp_axis)),
+        (r".*(query|key|value|qkv|ffn_1|intermediate|fc1|inter).*bias$",
+         P(tp_axis)),
+        (r".*word_embed.*weight$", P(tp_axis, None)),
+        (r".*", P()),
+    ]
+
+
+def shard_params(params, mesh, rules):
+    """Apply the first matching rule per param name; device_put accordingly."""
+    out = {}
+    compiled = [(re.compile(pat), spec) for pat, spec in rules]
+    for name, val in params.items():
+        spec = P()
+        for pat, s in compiled:
+            if pat.match(name):
+                spec = s
+                break
+        # drop axes that don't divide evenly (stay replicated)
+        fixed = []
+        for dim, ax in zip(val.shape, tuple(spec) + (None,) * val.ndim):
+            if ax is not None and dim % mesh.shape[ax] != 0:
+                ax = None
+            fixed.append(ax)
+        out[name] = jax.device_put(val, NamedSharding(mesh, P(*fixed)))
+    return out

@@ -1,0 +1,77 @@
+"""Profiler (reference: python/mxnet/profiler.py).
+
+`set_config/start/stop/dumps` map onto jax.profiler (XLA/TPU traces viewable
+in TensorBoard/Perfetto), plus a host-side op tally from the imperative
+dispatch path for `dumps()` parity.
+"""
+from __future__ import annotations
+
+import contextlib
+import time
+from collections import defaultdict
+
+import jax
+
+__all__ = ["set_config", "start", "stop", "pause", "resume", "dumps",
+           "Scope", "record_op"]
+
+_state = {"dir": "/tmp/mxtpu_profile", "running": False,
+          "ops": defaultdict(lambda: [0, 0.0]), "t0": None}
+
+
+def set_config(profile_all=False, profile_symbolic=True,
+               profile_imperative=True, profile_memory=True, profile_api=True,
+               filename=None, **kwargs):
+    if filename:
+        _state["dir"] = filename.rsplit("/", 1)[0] if "/" in filename \
+            else "."
+
+
+def start():
+    _state["running"] = True
+    _state["t0"] = time.time()
+    try:
+        jax.profiler.start_trace(_state["dir"])
+    except Exception:
+        pass
+
+
+def stop():
+    if not _state["running"]:
+        return
+    _state["running"] = False
+    try:
+        jax.profiler.stop_trace()
+    except Exception:
+        pass
+
+
+def pause():
+    _state["running"] = False
+
+
+def resume():
+    _state["running"] = True
+
+
+def record_op(name, seconds):
+    if _state["running"]:
+        entry = _state["ops"][name]
+        entry[0] += 1
+        entry[1] += seconds
+
+
+def dumps(reset=False):
+    lines = [f"{'op':<40}{'calls':>10}{'total_ms':>14}"]
+    for name, (calls, total) in sorted(_state["ops"].items(),
+                                       key=lambda kv: -kv[1][1]):
+        lines.append(f"{name:<40}{calls:>10}{total * 1e3:>14.3f}")
+    if reset:
+        _state["ops"].clear()
+    return "\n".join(lines)
+
+
+@contextlib.contextmanager
+def Scope(name="profile"):
+    with jax.profiler.TraceAnnotation(name):
+        yield

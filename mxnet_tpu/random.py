@@ -1,0 +1,132 @@
+"""Global RNG (reference: python/mxnet/random.py + mshadow RandomState).
+
+TPU-native design: a process-global, thread-safe JAX PRNG key chain.
+`mx.random.seed(n)` resets the chain; every random op folds in a fresh
+subkey, so imperative randomness is reproducible yet side-effect free at the
+XLA level (each op's key is captured as a constant on the autograd tape, so
+tape replay is deterministic).
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+import jax.numpy as jnp
+
+from .base import _np_dtype
+
+__all__ = ["seed", "uniform", "normal", "randn", "randint", "gamma",
+           "exponential", "poisson", "negative_binomial",
+           "generalized_negative_binomial", "multinomial", "shuffle",
+           "bernoulli"]
+
+_lock = threading.Lock()
+_key = jax.random.PRNGKey(0)
+
+
+def seed(seed_state, ctx="all"):
+    """Seed the global RNG chain."""
+    global _key
+    with _lock:
+        _key = jax.random.PRNGKey(int(seed_state))
+
+
+def _next_key():
+    global _key
+    with _lock:
+        _key, sub = jax.random.split(_key)
+        return sub
+
+
+def _place(val, ctx, dtype=None):
+    from .ndarray.ndarray import NDArray
+    from .context import Context, current_context
+    ctx = Context(ctx) if ctx is not None else current_context()
+    if dtype is not None:
+        val = val.astype(_np_dtype(dtype))
+    return NDArray(jax.device_put(val, ctx.jax_device))
+
+
+def _shape(shape):
+    if shape is None:
+        return ()
+    return (shape,) if isinstance(shape, int) else tuple(shape)
+
+
+def uniform(low=0.0, high=1.0, shape=None, dtype=None, ctx=None, **kwargs):
+    k = _next_key()
+    return _place(jax.random.uniform(k, _shape(shape), minval=low, maxval=high),
+                  ctx, dtype)
+
+
+def normal(loc=0.0, scale=1.0, shape=None, dtype=None, ctx=None, **kwargs):
+    k = _next_key()
+    return _place(loc + scale * jax.random.normal(k, _shape(shape)), ctx, dtype)
+
+
+def randn(*shape, loc=0.0, scale=1.0, dtype=None, ctx=None):
+    return normal(loc, scale, shape, dtype, ctx)
+
+
+def randint(low, high=None, shape=None, dtype="int32", ctx=None):
+    if high is None:
+        low, high = 0, low
+    k = _next_key()
+    return _place(jax.random.randint(k, _shape(shape), low, high), ctx, dtype)
+
+
+def gamma(alpha=1.0, beta=1.0, shape=None, dtype=None, ctx=None):
+    k = _next_key()
+    return _place(jax.random.gamma(k, alpha, _shape(shape)) * beta, ctx, dtype)
+
+
+def exponential(scale=1.0, shape=None, dtype=None, ctx=None):
+    k = _next_key()
+    return _place(jax.random.exponential(k, _shape(shape)) * scale, ctx, dtype)
+
+
+def poisson(lam=1.0, shape=None, dtype=None, ctx=None):
+    k = _next_key()
+    return _place(jax.random.poisson(k, lam, _shape(shape)).astype(jnp.float32),
+                  ctx, dtype)
+
+
+def negative_binomial(k=1, p=1.0, shape=None, dtype=None, ctx=None):
+    key = _next_key()
+    g = jax.random.gamma(key, k, _shape(shape)) * ((1 - p) / p)
+    key2 = _next_key()
+    return _place(jax.random.poisson(key2, g).astype(jnp.float32), ctx, dtype)
+
+
+def generalized_negative_binomial(mu=1.0, alpha=1.0, shape=None, dtype=None,
+                                  ctx=None):
+    k = 1.0 / alpha
+    p = k / (k + mu)
+    return negative_binomial(k, p, shape, dtype, ctx)
+
+
+def bernoulli(prob=0.5, shape=None, dtype=None, ctx=None):
+    k = _next_key()
+    return _place(jax.random.bernoulli(k, prob, _shape(shape)).astype(jnp.float32),
+                  ctx, dtype)
+
+
+def multinomial(data, shape=1, get_prob=False, dtype="int32"):
+    """Sample category indices from probability rows (reference semantics)."""
+    from .ndarray.ndarray import NDArray
+    k = _next_key()
+    logits = jnp.log(jnp.maximum(data._data, 1e-30))
+    n = shape if isinstance(shape, int) else shape[0]
+    if data._data.ndim == 1:
+        out = jax.random.categorical(k, logits, shape=(n,))
+    else:
+        out = jax.random.categorical(k, logits[:, None, :],
+                                     shape=(logits.shape[0], n), axis=-1)
+        if n == 1:
+            out = out[:, 0]
+    return NDArray(out.astype(_np_dtype(dtype)))
+
+
+def shuffle(data):
+    from .ops.tensor_ops import shuffle as _shuf
+    return _shuf(data)

@@ -1,0 +1,49 @@
+"""Runtime feature detection (reference: python/mxnet/runtime.py)."""
+from __future__ import annotations
+
+import jax
+
+__all__ = ["Features", "feature_list"]
+
+
+class Feature:
+    def __init__(self, name, enabled):
+        self.name = name
+        self.enabled = enabled
+
+    def __repr__(self):
+        return f"[{'✔' if self.enabled else '✖'} {self.name}]"
+
+
+class Features(dict):
+    def __init__(self):
+        devices = jax.devices()
+        has_tpu = any(d.platform != "cpu" for d in devices)
+        try:
+            from jax.experimental import pallas  # noqa: F401
+            has_pallas = True
+        except Exception:
+            has_pallas = False
+        from . import engine
+        feats = {
+            "TPU": has_tpu,
+            "XLA": True,
+            "PALLAS": has_pallas,
+            "BF16": True,
+            "ICI_COLLECTIVES": has_tpu,
+            "NATIVE_ENGINE": engine.native_engine_loaded(),
+            "DIST_KVSTORE": True,
+            "CUDA": False,
+            "CUDNN": False,
+            "NCCL": False,
+            "OPENCV": False,
+            "BLAS_OPEN": True,
+        }
+        super().__init__({k: Feature(k, v) for k, v in feats.items()})
+
+    def is_enabled(self, name):
+        return self[name].enabled
+
+
+def feature_list():
+    return list(Features().values())

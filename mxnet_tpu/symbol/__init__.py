@@ -1,0 +1,4 @@
+"""mx.sym / mx.symbol (reference: python/mxnet/symbol)."""
+from .symbol import (Symbol, Variable, var, Group, load, load_json, Executor)
+from .ops import *   # noqa: F401,F403
+from . import ops

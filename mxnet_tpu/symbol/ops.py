@@ -1,0 +1,249 @@
+"""Symbolic operator namespace (reference: mxnet.symbol ops).
+
+Registers pure kernels (shared with ops/nn_ops.py) under stable names so
+graphs serialise, and exposes the reference's symbol-level API
+(sym.FullyConnected, sym.Activation, ...).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..ops import nn_ops as K
+from .symbol import Symbol, _make, register_op
+
+__all__ = ["FullyConnected", "Convolution", "Activation", "BatchNorm",
+           "LayerNorm", "Pooling", "Dropout", "Embedding", "softmax",
+           "log_softmax", "SoftmaxOutput", "flatten", "Flatten", "reshape",
+           "transpose", "concat", "Concat", "dot", "batch_dot", "sum", "mean",
+           "max", "min", "relu", "sigmoid", "tanh", "exp", "log", "sqrt",
+           "square", "negative", "zeros", "ones", "broadcast_add",
+           "broadcast_mul", "elemwise_add", "expand_dims", "squeeze"]
+
+# -- elemwise registry -------------------------------------------------------
+register_op("elemwise_add", jnp.add)
+register_op("elemwise_sub", jnp.subtract)
+register_op("elemwise_mul", jnp.multiply)
+register_op("elemwise_div", jnp.divide)
+register_op("elemwise_pow", jnp.power)
+register_op("elemwise_add_scalar", lambda a, scalar: a + scalar)
+register_op("elemwise_sub_scalar", lambda a, scalar: a - scalar)
+register_op("elemwise_mul_scalar", lambda a, scalar: a * scalar)
+register_op("elemwise_div_scalar", lambda a, scalar: a / scalar)
+register_op("elemwise_pow_scalar", lambda a, scalar: a ** scalar)
+register_op("rsub_scalar", lambda a, scalar: scalar - a)
+register_op("rdiv_scalar", lambda a, scalar: scalar / a)
+register_op("negative", jnp.negative)
+register_op("relu", jax.nn.relu)
+register_op("sigmoid", jax.nn.sigmoid)
+register_op("tanh", jnp.tanh)
+register_op("exp", jnp.exp)
+register_op("log", jnp.log)
+register_op("sqrt", jnp.sqrt)
+register_op("square", jnp.square)
+register_op("softmax", lambda a, axis=-1: jax.nn.softmax(a, axis=axis))
+register_op("log_softmax", lambda a, axis=-1: jax.nn.log_softmax(a, axis=axis))
+register_op("sum", lambda a, axis=None, keepdims=False:
+            jnp.sum(a, axis=axis, keepdims=keepdims))
+register_op("mean", lambda a, axis=None, keepdims=False:
+            jnp.mean(a, axis=axis, keepdims=keepdims))
+register_op("max", lambda a, axis=None, keepdims=False:
+            jnp.max(a, axis=axis, keepdims=keepdims))
+register_op("min", lambda a, axis=None, keepdims=False:
+            jnp.min(a, axis=axis, keepdims=keepdims))
+register_op("reshape", lambda a, shape: a.reshape(shape))
+register_op("flatten", lambda a: a.reshape(a.shape[0], -1))
+register_op("transpose", lambda a, axes=None: jnp.transpose(a, axes))
+register_op("expand_dims", lambda a, axis: jnp.expand_dims(a, axis))
+register_op("squeeze", lambda a, axis=None: jnp.squeeze(a, axis))
+register_op("concat", lambda *xs, dim=1: jnp.concatenate(xs, axis=dim))
+register_op("dot", jnp.dot)
+register_op("batch_dot", jnp.matmul)
+register_op("FullyConnected",
+            lambda x, w, *b, no_bias=False, num_hidden=None, flatten=True:
+            K.fully_connected(x, w, b[0] if b else None, flatten))
+register_op("Convolution",
+            lambda x, w, *b, kernel=None, stride=1, pad=0, dilate=1,
+            num_filter=None, num_group=1, no_bias=False, layout=None:
+            K.convolution(x, w, b[0] if b else None, stride, pad, dilate,
+                          num_group, layout))
+register_op("Activation", lambda x, act_type="relu": K.activation(x, act_type))
+register_op("BatchNorm",
+            lambda x, g, b, mm, mv, eps=1e-5, momentum=0.9, axis=1,
+            fix_gamma=False, use_global_stats=False:
+            K.batch_norm(x, g, b, mm, mv, eps, momentum, False, axis)[0])
+register_op("LayerNorm", lambda x, g, b, axis=-1, eps=1e-5:
+            K.layer_norm(x, g, b, axis, eps))
+register_op("Pooling",
+            lambda x, kernel=None, pool_type="max", stride=None, pad=0,
+            global_pool=False, layout=None:
+            K.global_pooling(x, pool_type, layout or "NCHW") if global_pool
+            else K.pooling(x, kernel, pool_type, stride, pad, layout))
+register_op("Dropout", lambda x, p=0.5: x)  # symbolic graphs are inference
+register_op("Embedding", lambda i, w, input_dim=None, output_dim=None:
+            K.embedding(i, w))
+register_op("SoftmaxOutput", lambda x, *l: jax.nn.softmax(x, axis=-1))
+register_op("zeros", lambda shape=(), dtype=None: jnp.zeros(shape, dtype))
+register_op("ones", lambda shape=(), dtype=None: jnp.ones(shape, dtype))
+
+
+# -- symbol-level API --------------------------------------------------------
+def FullyConnected(data, weight=None, bias=None, num_hidden=None,
+                   no_bias=False, flatten=True, name=None, **kwargs):
+    ins = [data, weight] + ([] if no_bias or bias is None else [bias])
+    return _make("FullyConnected", ins,
+                 {"no_bias": no_bias or bias is None, "num_hidden": num_hidden,
+                  "flatten": flatten}, name=name)
+
+
+def Convolution(data, weight=None, bias=None, kernel=None, stride=1, pad=0,
+                dilate=1, num_filter=None, num_group=1, no_bias=False,
+                layout=None, name=None, **kwargs):
+    ins = [data, weight] + ([] if no_bias or bias is None else [bias])
+    return _make("Convolution", ins,
+                 {"kernel": kernel, "stride": stride, "pad": pad,
+                  "dilate": dilate, "num_filter": num_filter,
+                  "num_group": num_group, "no_bias": no_bias or bias is None,
+                  "layout": layout}, name=name)
+
+
+def Activation(data, act_type="relu", name=None, **kwargs):
+    return _make("Activation", [data], {"act_type": act_type}, name=name)
+
+
+def BatchNorm(data, gamma=None, beta=None, moving_mean=None, moving_var=None,
+              eps=1e-5, momentum=0.9, axis=1, fix_gamma=False,
+              use_global_stats=False, name=None, **kwargs):
+    return _make("BatchNorm", [data, gamma, beta, moving_mean, moving_var],
+                 {"eps": eps, "momentum": momentum, "axis": axis}, name=name)
+
+
+def LayerNorm(data, gamma=None, beta=None, axis=-1, eps=1e-5, name=None,
+              **kwargs):
+    return _make("LayerNorm", [data, gamma, beta],
+                 {"axis": axis, "eps": eps}, name=name)
+
+
+def Pooling(data, kernel=None, pool_type="max", stride=None, pad=0,
+            global_pool=False, layout=None, name=None, **kwargs):
+    return _make("Pooling", [data],
+                 {"kernel": kernel, "pool_type": pool_type, "stride": stride,
+                  "pad": pad, "global_pool": global_pool, "layout": layout},
+                 name=name)
+
+
+def Dropout(data, p=0.5, name=None, **kwargs):
+    return _make("Dropout", [data], {"p": p}, name=name)
+
+
+def Embedding(data, weight=None, input_dim=None, output_dim=None, name=None,
+              **kwargs):
+    return _make("Embedding", [data, weight],
+                 {"input_dim": input_dim, "output_dim": output_dim}, name=name)
+
+
+def SoftmaxOutput(data, label=None, name=None, **kwargs):
+    return _make("SoftmaxOutput", [data], {}, name=name)
+
+
+def softmax(data, axis=-1, name=None):
+    return _make("softmax", [data], {"axis": axis}, name=name)
+
+
+def log_softmax(data, axis=-1, name=None):
+    return _make("log_softmax", [data], {"axis": axis}, name=name)
+
+
+def flatten(data, name=None, **kwargs):
+    return _make("flatten", [data], {}, name=name)
+
+
+Flatten = flatten
+
+
+def reshape(data, shape, name=None, **kwargs):
+    return _make("reshape", [data], {"shape": tuple(shape)}, name=name)
+
+
+def transpose(data, axes=None, name=None):
+    return _make("transpose", [data], {"axes": axes}, name=name)
+
+
+def concat(*data, dim=1, name=None, **kwargs):
+    if len(data) == 1 and isinstance(data[0], (list, tuple)):
+        data = tuple(data[0])
+    return _make("concat", list(data), {"dim": dim}, name=name)
+
+
+Concat = concat
+
+
+def dot(lhs, rhs, name=None, **kwargs):
+    return _make("dot", [lhs, rhs], {}, name=name)
+
+
+def batch_dot(lhs, rhs, name=None, **kwargs):
+    return _make("batch_dot", [lhs, rhs], {}, name=name)
+
+
+def sum(data, axis=None, keepdims=False, name=None):
+    return _make("sum", [data], {"axis": axis, "keepdims": keepdims}, name=name)
+
+
+def mean(data, axis=None, keepdims=False, name=None):
+    return _make("mean", [data], {"axis": axis, "keepdims": keepdims},
+                 name=name)
+
+
+def max(data, axis=None, keepdims=False, name=None):
+    return _make("max", [data], {"axis": axis, "keepdims": keepdims}, name=name)
+
+
+def min(data, axis=None, keepdims=False, name=None):
+    return _make("min", [data], {"axis": axis, "keepdims": keepdims}, name=name)
+
+
+def expand_dims(data, axis, name=None):
+    return _make("expand_dims", [data], {"axis": axis}, name=name)
+
+
+def squeeze(data, axis=None, name=None):
+    return _make("squeeze", [data], {"axis": axis}, name=name)
+
+
+def broadcast_add(lhs, rhs, name=None):
+    return _make("elemwise_add", [lhs, rhs], {}, name=name)
+
+
+def broadcast_mul(lhs, rhs, name=None):
+    return _make("elemwise_mul", [lhs, rhs], {}, name=name)
+
+
+elemwise_add = broadcast_add
+
+
+def _unary(opname):
+    def f(data, name=None, **kwargs):
+        return _make(opname, [data], {}, name=name)
+    f.__name__ = opname
+    return f
+
+
+relu = _unary("relu")
+sigmoid = _unary("sigmoid")
+tanh = _unary("tanh")
+exp = _unary("exp")
+log = _unary("log")
+sqrt = _unary("sqrt")
+square = _unary("square")
+negative = _unary("negative")
+
+
+def zeros(shape, dtype=None, name=None, **kwargs):
+    return _make("zeros", [], {"shape": tuple(shape), "dtype": dtype},
+                 name=name)
+
+
+def ones(shape, dtype=None, name=None, **kwargs):
+    return _make("ones", [], {"shape": tuple(shape), "dtype": dtype},
+                 name=name)

@@ -1,0 +1,23 @@
+"""Misc utilities (reference: python/mxnet/util.py)."""
+from __future__ import annotations
+
+__all__ = ["waitall", "is_np_array", "set_np", "use_np"]
+
+
+def waitall():
+    from .ndarray.ndarray import waitall as _w
+    _w()
+
+
+def is_np_array():
+    return False
+
+
+def set_np(shape=True, array=True):
+    raise NotImplementedError(
+        "numpy-semantics mode is not needed: mxnet_tpu NDArray already "
+        "follows numpy broadcasting via jax.numpy")
+
+
+def use_np(func):
+    return func

@@ -1,0 +1,34 @@
+"""Network visualization (reference: python/mxnet/visualization.py)."""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["print_summary", "plot_network"]
+
+
+def print_summary(symbol_or_block, shape=None, **kwargs):
+    """Print a layer table for a Symbol or Gluon Block."""
+    from .gluon.block import Block
+    if isinstance(symbol_or_block, Block):
+        return symbol_or_block.summary()
+    sym = symbol_or_block
+    nodes = sym._topo()
+    lines = [f"{'Name':<36}{'Op':<24}{'Inputs':<40}", "-" * 100]
+    for n in nodes:
+        ins = ",".join(i.name for i in n._inputs)
+        lines.append(f"{n.name:<36}{n._op or 'Variable':<24}{ins:<40}")
+    out = "\n".join(lines)
+    print(out)
+    return out
+
+
+def plot_network(symbol, title="plot", shape=None, **kwargs):
+    """Text DAG rendering (graphviz is not guaranteed offline; the reference
+    returns a Digraph — here an ASCII adjacency list with the same info)."""
+    nodes = symbol._topo()
+    lines = [f"digraph-text {title} {{"]
+    for n in nodes:
+        for i in n._inputs:
+            lines.append(f"  {i.name} -> {n.name} [{n._op}]")
+    lines.append("}")
+    return "\n".join(lines)

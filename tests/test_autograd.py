@@ -1,0 +1,137 @@
+"""Autograd tests (reference model: tests/python/unittest/test_autograd.py)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd
+
+
+def test_simple_backward():
+    x = nd.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = (x * x).sum()
+    y.backward()
+    assert np.allclose(x.grad.asnumpy(), [2, 4, 6])
+
+
+def test_chain():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+        z = y * x  # x^3 -> dz/dx = 3x^2
+    z.backward()
+    assert np.allclose(x.grad.asnumpy(), [12.0])
+
+
+def test_multiple_inputs():
+    a = nd.array([1.0, 2.0])
+    b = nd.array([3.0, 4.0])
+    a.attach_grad()
+    b.attach_grad()
+    with autograd.record():
+        c = (a * b).sum()
+    c.backward()
+    assert np.allclose(a.grad.asnumpy(), [3, 4])
+    assert np.allclose(b.grad.asnumpy(), [1, 2])
+
+
+def test_head_gradient():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 2
+    y.backward(nd.array([10.0, 100.0]))
+    assert np.allclose(x.grad.asnumpy(), [20, 200])
+
+
+def test_backward_outside_scope():
+    x = nd.array([3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x.exp()
+    y.backward()
+    assert np.allclose(x.grad.asnumpy(), np.exp(3.0), rtol=1e-5)
+
+
+def test_pause():
+    x = nd.array([1.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 2
+        with autograd.pause():
+            z = x * 100  # not recorded
+        w = y + 1
+    w.backward()
+    assert np.allclose(x.grad.asnumpy(), [2.0])
+    assert not autograd.is_recording()
+
+
+def test_training_modes():
+    assert not autograd.is_training()
+    with autograd.record(train_mode=True):
+        assert autograd.is_training()
+        with autograd.predict_mode():
+            assert not autograd.is_training()
+    with autograd.train_mode():
+        assert autograd.is_training()
+
+
+def test_grad_function():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x * x
+        grads = autograd.grad(y, [x])
+    assert np.allclose(grads[0].asnumpy(), [12.0])
+
+
+def test_grad_req_add():
+    x = nd.array([1.0])
+    x.attach_grad(grad_req="add")
+    for _ in range(2):
+        with autograd.record():
+            y = x * 3
+        y.backward()
+    assert np.allclose(x.grad.asnumpy(), [6.0])
+
+
+def test_detach():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+        z = y.detach() * x
+    z.backward()
+    # z = const(4) * x -> dz/dx = 4
+    assert np.allclose(x.grad.asnumpy(), [4.0])
+
+
+def test_mark_variables():
+    x = nd.array([5.0])
+    g = nd.zeros((1,))
+    autograd.mark_variables([x], [g])
+    with autograd.record():
+        y = x * x
+    y.backward()
+    assert np.allclose(x.grad.asnumpy(), [10.0])
+
+
+def test_inplace_during_record():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 2
+        y += 1
+        z = y.sum()
+    z.backward()
+    assert np.allclose(x.grad.asnumpy(), [2, 2])
+
+
+def test_getitem_grad():
+    x = nd.array([[1.0, 2.0], [3.0, 4.0]])
+    x.attach_grad()
+    with autograd.record():
+        y = x[0].sum()
+    y.backward()
+    assert np.allclose(x.grad.asnumpy(), [[1, 1], [0, 0]])

@@ -1,0 +1,207 @@
+"""Gluon core tests (reference model: tests/python/unittest/test_gluon.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd, gluon
+from mxnet_tpu.gluon import nn
+
+
+def test_dense_shapes_and_forward():
+    layer = nn.Dense(4, in_units=3)
+    layer.initialize()
+    x = nd.ones((2, 3))
+    y = layer(x)
+    assert y.shape == (2, 4)
+
+
+def test_dense_deferred_init():
+    layer = nn.Dense(5)
+    layer.initialize()
+    y = layer(nd.ones((2, 7)))
+    assert y.shape == (2, 5)
+    assert layer.weight.shape == (5, 7)
+
+
+def test_sequential_and_params():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, activation="relu"), nn.Dense(3))
+    net.initialize()
+    y = net(nd.ones((4, 6)))
+    assert y.shape == (4, 3)
+    params = net.collect_params()
+    assert len(params) == 4  # 2 weights + 2 biases
+    names = list(params.keys())
+    assert any("weight" in n for n in names)
+
+
+def test_hybridize_parity():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu"), nn.Dense(4))
+    net.initialize()
+    x = nd.array(np.random.rand(5, 8).astype(np.float32))
+    y_eager = net(x).asnumpy()
+    net.hybridize()
+    y_hybrid = net(x).asnumpy()
+    assert np.allclose(y_eager, y_hybrid, atol=1e-5)
+    # second call uses the cached executable
+    y2 = net(x).asnumpy()
+    assert np.allclose(y_hybrid, y2)
+
+
+def test_hybridize_backward():
+    net = nn.Dense(1, in_units=2)
+    net.initialize()
+    net.hybridize()
+    x = nd.array([[1.0, 2.0]])
+    with autograd.record():
+        y = net(x)
+    y.backward()
+    assert np.allclose(net.weight.grad().asnumpy(), [[1.0, 2.0]])
+
+
+def test_batchnorm_running_stats():
+    bn = nn.BatchNorm(in_channels=3)
+    bn.initialize()
+    x = nd.array(np.random.rand(8, 3, 4, 4).astype(np.float32) * 5 + 2)
+    with autograd.record():
+        bn(x)
+    rm = bn.running_mean.data().asnumpy()
+    assert not np.allclose(rm, 0)  # stats updated
+    # inference mode uses running stats
+    y = bn(x)
+    assert y.shape == x.shape
+
+
+def test_batchnorm_hybrid_stats():
+    bn = nn.BatchNorm(in_channels=2)
+    bn.initialize()
+    bn.hybridize()
+    x = nd.array(np.random.rand(4, 2, 3, 3).astype(np.float32) + 10)
+    with autograd.record():
+        bn(x)
+    rm = bn.running_mean.data().asnumpy()
+    assert rm.mean() > 0.5  # moved toward ~10 batch mean
+
+
+def test_conv2d():
+    conv = nn.Conv2D(8, kernel_size=3, padding=1, in_channels=3)
+    conv.initialize()
+    x = nd.ones((2, 3, 16, 16))
+    y = conv(x)
+    assert y.shape == (2, 8, 16, 16)
+    conv_s = nn.Conv2D(4, kernel_size=3, strides=2)
+    conv_s.initialize()
+    y2 = conv_s(nd.ones((1, 3, 8, 8)))
+    assert y2.shape == (1, 4, 3, 3)
+
+
+def test_conv2d_nhwc():
+    conv = nn.Conv2D(8, kernel_size=3, padding=1, layout="NHWC")
+    conv.initialize()
+    y = conv(nd.ones((2, 16, 16, 3)))
+    assert y.shape == (2, 16, 16, 8)
+
+
+def test_pooling():
+    x = nd.ones((1, 2, 8, 8))
+    assert nn.MaxPool2D(2, 2)(x).shape == (1, 2, 4, 4)
+    assert nn.AvgPool2D(2, 2)(x).shape == (1, 2, 4, 4)
+    assert nn.GlobalAvgPool2D()(x).shape == (1, 2, 1, 1)
+
+
+def test_embedding_dropout_layernorm():
+    emb = nn.Embedding(10, 4)
+    emb.initialize()
+    y = emb(nd.array([1, 2, 3]))
+    assert y.shape == (3, 4)
+    ln = nn.LayerNorm(in_channels=4)
+    ln.initialize()
+    z = ln(y)
+    assert np.allclose(z.asnumpy().mean(-1), 0, atol=1e-5)
+    do = nn.Dropout(0.5)
+    with autograd.record():
+        d = do(y)
+    assert d.shape == y.shape
+
+
+def test_save_load_parameters(tmp_path):
+    net = nn.HybridSequential()
+    net.add(nn.Dense(4, in_units=3), nn.Dense(2, in_units=4))
+    net.initialize()
+    f = str(tmp_path / "net.params.npz")
+    net.save_parameters(f)
+    w_before = net[0].weight.data().asnumpy()
+
+    net2 = nn.HybridSequential()
+    net2.add(nn.Dense(4, in_units=3), nn.Dense(2, in_units=4))
+    net2.initialize()
+    net2.load_parameters(f)
+    # prefixes differ but structural (strip-prefix) names must map — load by
+    # matching relative names requires same architecture
+    assert np.allclose(net2[0].weight.data().asnumpy(), w_before)
+
+
+def test_trainer_step_sgd():
+    net = nn.Dense(1, in_units=1, use_bias=False)
+    net.initialize(mx.init.Constant(2.0))
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    x = nd.array([[1.0]])
+    with autograd.record():
+        y = net(x)          # y = 2x
+        loss = (y * y).sum()  # dL/dw = 2*y*x = 4
+    loss.backward()
+    trainer.step(1)
+    assert np.allclose(net.weight.data().asnumpy(), [[2.0 - 0.4]])
+
+
+def test_mlp_convergence():
+    """End-to-end: MLP learns a separable toy problem (SURVEY.md §4)."""
+    np.random.seed(0)
+    n = 256
+    x = np.random.randn(n, 10).astype(np.float32)
+    w_true = np.random.randn(10, 3).astype(np.float32)
+    labels = np.argmax(x @ w_true, axis=1).astype(np.float32)
+
+    net = nn.HybridSequential()
+    net.add(nn.Dense(32, activation="relu"), nn.Dense(3))
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 0.01})
+    xs, ys = nd.array(x), nd.array(labels)
+    for _ in range(60):
+        with autograd.record():
+            out = net(xs)
+            loss = loss_fn(out, ys)
+        loss.backward()
+        trainer.step(n)
+    preds = net(xs).asnumpy().argmax(1)
+    acc = (preds == labels).mean()
+    assert acc > 0.9, f"accuracy {acc}"
+
+
+def test_block_repr_and_summary():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(4, in_units=3))
+    net.initialize()
+    assert "Dense" in repr(net)
+    out = net.summary()
+    assert "Total params" in out
+
+
+def test_clip_global_norm():
+    a = nd.ones((2,)) * 3
+    b = nd.ones((2,)) * 4
+    total = gluon.utils.clip_global_norm([a, b], 1.0)
+    assert abs(total - np.sqrt(9 * 2 + 16 * 2)) < 1e-4
+    new_norm = np.sqrt((a.asnumpy() ** 2).sum() + (b.asnumpy() ** 2).sum())
+    assert new_norm <= 1.0 + 1e-5
+
+
+def test_split_and_load():
+    data = nd.arange(0, 12).reshape((6, 2))
+    parts = gluon.utils.split_and_load(data, [mx.cpu(), mx.cpu()])
+    assert len(parts) == 2 and parts[0].shape == (3, 2)

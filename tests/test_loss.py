@@ -1,0 +1,101 @@
+"""Loss tests (reference model: tests/python/unittest/test_loss.py)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd, gluon
+from mxnet_tpu.gluon import loss as gloss
+
+
+def test_l2_l1():
+    pred = nd.array([[1.0, 2.0]])
+    label = nd.array([[2.0, 4.0]])
+    l2 = gloss.L2Loss()(pred, label)
+    assert np.allclose(l2.asnumpy(), [(1 + 4) / 2 / 2])
+    l1 = gloss.L1Loss()(pred, label)
+    assert np.allclose(l1.asnumpy(), [1.5])
+
+
+def test_softmax_ce_sparse_and_dense():
+    pred = nd.array([[10.0, 0.0, 0.0], [0.0, 10.0, 0.0]])
+    label = nd.array([0, 1])
+    l = gloss.SoftmaxCrossEntropyLoss()(pred, label)
+    assert l.asnumpy().max() < 1e-3
+    dense = nd.array([[1.0, 0, 0], [0, 1.0, 0]])
+    l2 = gloss.SoftmaxCrossEntropyLoss(sparse_label=False)(pred, dense)
+    assert np.allclose(l.asnumpy(), l2.asnumpy(), atol=1e-5)
+
+
+def test_sigmoid_bce_matches_manual():
+    pred = nd.array([[0.5, -0.5]])
+    label = nd.array([[1.0, 0.0]])
+    l = gloss.SigmoidBCELoss()(pred, label)
+    p = 1 / (1 + np.exp(-np.array([0.5, -0.5])))
+    manual = -(np.log(p[0]) + np.log(1 - p[1])) / 2
+    assert np.allclose(l.asnumpy(), [manual], atol=1e-4)
+
+
+def test_sigmoid_bce_pos_weight():
+    pred = nd.array([[0.3]])
+    label = nd.array([[1.0]])
+    base = gloss.SigmoidBCELoss()(pred, label).asnumpy()
+    weighted = gloss.SigmoidBCELoss()(pred, label, None,
+                                      nd.array([2.0])).asnumpy()
+    assert np.allclose(weighted, 2 * base, atol=1e-5)
+
+
+def test_kl_huber_hinge():
+    pred = nd.array([[0.0, 0.0]])
+    label = nd.array([[0.5, 0.5]])
+    kl = gloss.KLDivLoss(from_logits=False)(pred, label)
+    assert kl.asnumpy()[0] < 1e-5  # uniform vs uniform
+    h = gloss.HuberLoss(rho=1.0)(nd.array([[3.0]]), nd.array([[0.0]]))
+    assert np.allclose(h.asnumpy(), [2.5])
+    hg = gloss.HingeLoss()(nd.array([[0.5]]), nd.array([[1.0]]))
+    assert np.allclose(hg.asnumpy(), [0.5])
+
+
+def test_losses_backward():
+    for L in [gloss.L2Loss(), gloss.L1Loss(), gloss.SoftmaxCrossEntropyLoss(),
+              gloss.SigmoidBCELoss(), gloss.HuberLoss()]:
+        pred = nd.array([[0.4, 0.6]])
+        pred.attach_grad()
+        label = nd.array([0]) if isinstance(L, gloss.SoftmaxCrossEntropyLoss) \
+            else nd.array([[1.0, 0.0]])
+        with autograd.record():
+            l = L(pred, label)
+        l.backward()
+        assert np.isfinite(pred.grad.asnumpy()).all()
+
+
+def test_ctc_loss_basic():
+    t, n, c = 8, 2, 5
+    np.random.seed(0)
+    pred = nd.array(np.random.randn(n, t, c).astype(np.float32))
+    label = nd.array([[1, 2, 0], [3, 0, 0]])
+    l = gloss.CTCLoss()(pred, label,
+                        nd.array([8, 8]), nd.array([2, 1]))
+    v = l.asnumpy()
+    assert v.shape == (n,)
+    assert np.isfinite(v).all() and (v > 0).all()
+
+
+def test_ctc_loss_length_sensitivity():
+    """Padded labels must not change the loss when label_lengths given."""
+    t, c = 6, 4
+    np.random.seed(1)
+    logits = np.random.randn(1, t, c).astype(np.float32)
+    l_short = gloss.CTCLoss()(nd.array(logits), nd.array([[1, 2]]),
+                              nd.array([6]), nd.array([2]))
+    padded = gloss.CTCLoss()(nd.array(logits), nd.array([[1, 2, 0, 0]]),
+                             nd.array([6]), nd.array([2]))
+    assert np.allclose(l_short.asnumpy(), padded.asnumpy(), atol=1e-4)
+
+
+def test_triplet_cosine():
+    a = nd.array([[1.0, 0.0]])
+    p = nd.array([[1.0, 0.1]])
+    n_ = nd.array([[-1.0, 0.0]])
+    tl = gloss.TripletLoss()(a, p, n_)
+    assert tl.asnumpy()[0] >= 0
+    ce = gloss.CosineEmbeddingLoss()(a, p, nd.array([1.0]))
+    assert ce.asnumpy()[0] < 0.01

@@ -1,0 +1,154 @@
+"""NDArray tests (reference model: tests/python/unittest/test_ndarray.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def test_creation():
+    a = nd.zeros((2, 3))
+    assert a.shape == (2, 3)
+    assert a.dtype == np.float32
+    assert np.allclose(a.asnumpy(), 0)
+    b = nd.ones((4,), dtype="int32")
+    assert b.dtype == np.int32
+    c = nd.full((2, 2), 7.5)
+    assert np.allclose(c.asnumpy(), 7.5)
+    d = nd.array([[1, 2], [3, 4]])
+    assert d.shape == (2, 2)
+    e = nd.arange(0, 10, 2)
+    assert np.allclose(e.asnumpy(), [0, 2, 4, 6, 8])
+
+
+def test_arithmetic():
+    a = nd.array([1.0, 2.0, 3.0])
+    b = nd.array([4.0, 5.0, 6.0])
+    assert np.allclose((a + b).asnumpy(), [5, 7, 9])
+    assert np.allclose((a - b).asnumpy(), [-3, -3, -3])
+    assert np.allclose((a * b).asnumpy(), [4, 10, 18])
+    assert np.allclose((b / a).asnumpy(), [4, 2.5, 2])
+    assert np.allclose((a ** 2).asnumpy(), [1, 4, 9])
+    assert np.allclose((2 + a).asnumpy(), [3, 4, 5])
+    assert np.allclose((1 - a).asnumpy(), [0, -1, -2])
+    assert np.allclose((-a).asnumpy(), [-1, -2, -3])
+
+
+def test_inplace():
+    a = nd.ones((3,))
+    a += 2
+    assert np.allclose(a.asnumpy(), 3)
+    a *= 2
+    assert np.allclose(a.asnumpy(), 6)
+    a[:] = 0.5
+    assert np.allclose(a.asnumpy(), 0.5)
+
+
+def test_indexing():
+    a = nd.array(np.arange(12).reshape(3, 4))
+    assert np.allclose(a[1].asnumpy(), [4, 5, 6, 7])
+    assert np.allclose(a[1:3, 2].asnumpy(), [6, 10])
+    a[0, 0] = 99
+    assert a[0, 0].asscalar() == 99
+    idx = nd.array([0, 2], dtype="int32")
+    assert np.allclose(a.take(idx).asnumpy()[1], a[2].asnumpy())
+
+
+def test_reshape_magic():
+    a = nd.zeros((2, 3, 4))
+    assert a.reshape((6, 4)).shape == (6, 4)
+    assert a.reshape((0, -1)).shape == (2, 12)
+    assert a.reshape(-1).shape == (24,)
+    assert a.transpose().shape == (4, 3, 2)
+    assert a.transpose((1, 0, 2)).shape == (3, 2, 4)
+    assert a.flatten().shape == (2, 12)
+    assert a.expand_dims(0).shape == (1, 2, 3, 4)
+
+
+def test_reductions():
+    a = nd.array([[1.0, 2.0], [3.0, 4.0]])
+    assert a.sum().asscalar() == 10
+    assert np.allclose(a.sum(axis=0).asnumpy(), [4, 6])
+    assert a.mean().asscalar() == 2.5
+    assert a.max().asscalar() == 4
+    assert a.min().asscalar() == 1
+    assert np.allclose(a.argmax(axis=1).asnumpy(), [1, 1])
+    assert abs(a.norm().asscalar() - np.sqrt(30)) < 1e-5
+
+
+def test_broadcast_ops():
+    a = nd.ones((2, 1, 3))
+    b = nd.ones((1, 4, 3))
+    assert nd.broadcast_add(a, b).shape == (2, 4, 3)
+    assert nd.broadcast_mul(a, b).shape == (2, 4, 3)
+    c = nd.array([1.0, 2.0])
+    assert np.allclose(nd.broadcast_greater(c, nd.array([1.5, 1.5])).asnumpy(),
+                       [0, 1])
+
+
+def test_concat_stack_split():
+    a = nd.ones((2, 3))
+    b = nd.zeros((2, 3))
+    c = nd.concat(a, b, dim=0)
+    assert c.shape == (4, 3)
+    s = nd.stack(a, b, axis=0)
+    assert s.shape == (2, 2, 3)
+    parts = nd.split(c, 2, axis=0)
+    assert len(parts) == 2 and parts[0].shape == (2, 3)
+
+
+def test_dtype_cast_copy():
+    a = nd.array([1.5, 2.5])
+    b = a.astype("int32")
+    assert b.dtype == np.int32
+    c = nd.zeros((2,))
+    a.copyto(c)
+    assert np.allclose(c.asnumpy(), [1.5, 2.5])
+    d = a.copy()
+    d += 1
+    assert np.allclose(a.asnumpy(), [1.5, 2.5])
+
+
+def test_context():
+    a = nd.zeros((2, 2), ctx=mx.cpu())
+    assert a.context.device_type in ("cpu", "tpu")
+    b = a.as_in_context(mx.cpu(0))
+    assert b.shape == (2, 2)
+    assert mx.num_tpus() >= 0
+
+
+def test_save_load(tmp_path):
+    a = nd.array([1.0, 2.0])
+    b = nd.array([[3.0]])
+    f = str(tmp_path / "arrays.npz")
+    nd.save(f, [a, b])
+    loaded = nd.load(f)
+    assert np.allclose(loaded[0].asnumpy(), a.asnumpy())
+    nd.save(f, {"x": a, "y": b})
+    loaded = nd.load(f)
+    assert set(loaded) == {"x", "y"}
+
+
+def test_wait_and_async():
+    a = nd.ones((100, 100))
+    b = nd.dot(a, a)
+    b.wait_to_read()
+    assert b[0, 0].asscalar() == 100
+    mx.waitall()
+
+
+def test_topk_sort():
+    a = nd.array([[3.0, 1.0, 2.0]])
+    assert np.allclose(a.topk(k=2).asnumpy(), [[0, 2]])
+    assert np.allclose(a.sort().asnumpy(), [[1, 2, 3]])
+    assert np.allclose(a.argsort().asnumpy(), [[1, 2, 0]])
+
+
+def test_one_hot_where_clip():
+    a = nd.array([0, 2])
+    oh = a.one_hot(3)
+    assert np.allclose(oh.asnumpy(), [[1, 0, 0], [0, 0, 1]])
+    w = nd.where(nd.array([1.0, 0.0]), nd.array([1.0, 1.0]),
+                 nd.array([2.0, 2.0]))
+    assert np.allclose(w.asnumpy(), [1, 2])
+    assert np.allclose(nd.clip(nd.array([-1.0, 5.0]), 0, 1).asnumpy(), [0, 1])
