@@ -74,5 +74,5 @@ def pipeline_apply(stage_fn, stacked_params, x_micro, mesh, pp_axis="pp"):
 
     f = shard_map(per_device, mesh=mesh,
                   in_specs=(P(pp_axis), P()), out_specs=P(),
-                  check_rep=False)
+                  check_vma=False)
     return f(stacked_params, x_micro)

@@ -82,5 +82,6 @@ def ring_attention_sharded(q, k, v, mesh, axis_name="sp", causal=False):
     spec = P(None, None, axis_name, None)
     f = shard_map(
         functools.partial(ring_attention, axis_name=axis_name, causal=causal),
-        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False)
     return f(q, k, v)

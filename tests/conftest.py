@@ -4,11 +4,18 @@ Must set env BEFORE jax initialises its backends.
 """
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = \
         flags + " --xla_force_host_platform_device_count=8"
+
+import jax  # noqa: E402
+
+# the axon sitecustomize force-registers the TPU backend regardless of env;
+# jax.config wins over it as long as no backend has initialised yet
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
