@@ -1,0 +1,89 @@
+"""Headline benchmark: ResNet-50 training throughput, images/sec/chip.
+
+One jitted train step (forward + backward + SGD-momentum update, donated
+buffers), bf16 NHWC — the MXU-native layout. `vs_baseline` divides by the
+reference class number from SURVEY.md §6: MXNet+cuDNN on A100 ~= 2500
+images/sec/chip fp16 ResNet-50.
+
+Prints exactly ONE JSON line on stdout.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+BASELINE_IMG_S = 2500.0
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon.block import extract_pure_fn
+    from mxnet_tpu.gluon.model_zoo.vision import resnet50_v1
+
+    on_tpu = jax.default_backend() == "tpu"
+    smoke = "--smoke" in sys.argv
+    if smoke or not on_tpu:
+        batch, steps = 8, 3
+    else:
+        batch, steps = 128, 30
+    batch = int(os.environ.get("BENCH_BATCH", batch))
+    steps = int(os.environ.get("BENCH_STEPS", steps))
+    print(f"[bench] backend={jax.default_backend()} batch={batch} "
+          f"steps={steps}", file=sys.stderr)
+
+    net = resnet50_v1(layout="NHWC")
+    net.initialize()
+    net.cast("bfloat16")
+    x = mx.nd.random.uniform(shape=(batch, 224, 224, 3), dtype="bfloat16")
+    net(x)  # materialise deferred-shape params
+    fwd, params = extract_pure_fn(net, x, training=True)
+
+    key = jax.random.PRNGKey(0)
+    labels = jax.random.randint(key, (batch,), 0, 1000)
+    images = x._data
+
+    def loss_fn(p, xb, yb):
+        logits = fwd(p, xb).astype(jnp.float32)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        return -jnp.mean(jnp.take_along_axis(logp, yb[:, None], 1))
+
+    lr, mu = 0.1, 0.9
+
+    def train_step(p, mom, xb, yb):
+        loss, g = jax.value_and_grad(loss_fn)(p, xb, yb)
+        new_mom = [mu * m + gg.astype(m.dtype) for m, gg in zip(mom, g)]
+        new_p = [pp - lr * m for pp, m in zip(p, new_mom)]
+        return new_p, new_mom, loss
+
+    step = jax.jit(train_step, donate_argnums=(0, 1))
+    mom = [jnp.zeros_like(p) for p in params]
+
+    # warmup: compile + one extra to stabilise. NB sync via host fetch:
+    # under the axon tunnel block_until_ready does not actually block.
+    params, mom, loss = step(params, mom, images, labels)
+    params, mom, loss = step(params, mom, images, labels)
+    float(loss)
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, mom, loss = step(params, mom, images, labels)
+    final_loss = float(loss)
+    dt = time.perf_counter() - t0
+
+    img_s = batch * steps / dt
+    print(f"[bench] loss={final_loss:.4f} dt={dt:.3f}s", file=sys.stderr)
+    print(json.dumps({
+        "metric": "resnet50_train_throughput",
+        "value": round(img_s, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(img_s / BASELINE_IMG_S, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,237 @@
+// Native dependency engine (reference: src/engine/threaded_engine.cc,
+// threaded_engine_perdevice.cc — re-designed, not translated).
+//
+// Role in the TPU build: XLA/PJRT owns on-device scheduling, so this engine
+// schedules HOST-side async work (data pipeline, IO, serialisation) with the
+// same read/write-variable dependency semantics MXNet's ThreadedEngine gives
+// kernels:
+//   * ops that READ a var run concurrently with other readers;
+//   * an op that WRITES a var waits for all prior readers+writer and blocks
+//     later ops until it completes (program order per var);
+//   * WaitForVar blocks until every op touching the var so far is done;
+//   * WaitForAll blocks until the engine drains.
+//
+// Exposed as a plain C ABI consumed via ctypes (mxnet_tpu/_native.py).
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <deque>
+#include <mutex>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct Op;
+
+struct VarState {
+  std::deque<std::pair<Op*, bool>> queue;  // (op, is_write) in program order
+  int running_reads = 0;
+  bool running_write = false;
+};
+
+struct Op {
+  void (*fn)(void*);
+  void* arg;
+  std::vector<uint64_t> reads;
+  std::vector<uint64_t> writes;
+  std::atomic<int> wait{0};
+};
+
+class Engine {
+ public:
+  explicit Engine(int workers) : workers_(workers > 0 ? workers : 1) {
+    for (int i = 0; i < workers_; ++i)
+      threads_.emplace_back([this] { WorkerLoop(); });
+  }
+
+  ~Engine() {
+    {
+      std::unique_lock<std::mutex> lk(ready_mu_);
+      shutdown_ = true;
+    }
+    ready_cv_.notify_all();
+    for (auto& t : threads_) t.join();
+  }
+
+  uint64_t NewVar() {
+    std::unique_lock<std::mutex> lk(vars_mu_);
+    uint64_t id = next_var_++;
+    vars_.emplace(id, VarState{});
+    return id;
+  }
+
+  void DelVar(uint64_t v) {
+    // deferred: only erase when idle on that var (caller guarantees no
+    // in-flight ops, matching Engine::DeleteVariable semantics)
+    std::unique_lock<std::mutex> lk(vars_mu_);
+    auto it = vars_.find(v);
+    if (it != vars_.end() && it->second.queue.empty() &&
+        it->second.running_reads == 0 && !it->second.running_write)
+      vars_.erase(it);
+  }
+
+  void Push(void (*fn)(void*), void* arg, const uint64_t* reads, int nreads,
+            const uint64_t* writes, int nwrites) {
+    Op* op = new Op();
+    op->fn = fn;
+    op->arg = arg;
+    op->reads.assign(reads, reads + nreads);
+    op->writes.assign(writes, writes + nwrites);
+    pending_.fetch_add(1);
+    // wait on every var; each var either admits the op now or queues it
+    op->wait.store(nreads + nwrites + 1);  // +1 guard against races below
+    {
+      std::unique_lock<std::mutex> lk(vars_mu_);
+      for (uint64_t v : op->reads) AdmitOrQueue(op, v, /*is_write=*/false);
+      for (uint64_t v : op->writes) AdmitOrQueue(op, v, /*is_write=*/true);
+    }
+    FinishDep(op);  // drop the guard
+  }
+
+  void WaitForVar(uint64_t v) {
+    std::unique_lock<std::mutex> lk(vars_mu_);
+    idle_cv_.wait(lk, [&] {
+      auto it = vars_.find(v);
+      if (it == vars_.end()) return true;
+      const VarState& s = it->second;
+      return s.queue.empty() && s.running_reads == 0 && !s.running_write;
+    });
+  }
+
+  void WaitAll() {
+    std::unique_lock<std::mutex> lk(vars_mu_);
+    idle_cv_.wait(lk, [&] { return pending_.load() == 0; });
+  }
+
+  int workers() const { return workers_; }
+
+ private:
+  // vars_mu_ must be held
+  void AdmitOrQueue(Op* op, uint64_t v, bool is_write) {
+    VarState& s = vars_[v];
+    bool can_run = s.queue.empty() && !s.running_write &&
+                   (!is_write || s.running_reads == 0);
+    if (can_run) {
+      if (is_write)
+        s.running_write = true;
+      else
+        ++s.running_reads;
+      FinishDepLocked(op);
+    } else {
+      s.queue.emplace_back(op, is_write);
+    }
+  }
+
+  void FinishDep(Op* op) {
+    if (op->wait.fetch_sub(1) == 1) Enqueue(op);
+  }
+
+  void FinishDepLocked(Op* op) { FinishDep(op); }
+
+  void Enqueue(Op* op) {
+    {
+      std::unique_lock<std::mutex> lk(ready_mu_);
+      ready_.push_back(op);
+    }
+    ready_cv_.notify_one();
+  }
+
+  void WorkerLoop() {
+    for (;;) {
+      Op* op;
+      {
+        std::unique_lock<std::mutex> lk(ready_mu_);
+        ready_cv_.wait(lk, [&] { return shutdown_ || !ready_.empty(); });
+        if (shutdown_ && ready_.empty()) return;
+        op = ready_.front();
+        ready_.pop_front();
+      }
+      op->fn(op->arg);
+      Complete(op);
+    }
+  }
+
+  void Complete(Op* op) {
+    std::vector<Op*> unblocked;
+    {
+      std::unique_lock<std::mutex> lk(vars_mu_);
+      for (uint64_t v : op->reads) Release(v, /*is_write=*/false, &unblocked);
+      for (uint64_t v : op->writes) Release(v, /*is_write=*/true, &unblocked);
+      pending_.fetch_sub(1);
+    }
+    idle_cv_.notify_all();
+    for (Op* u : unblocked) FinishDep(u);
+    delete op;
+  }
+
+  // vars_mu_ must be held; collects ops whose dep count on v resolves
+  void Release(uint64_t v, bool is_write, std::vector<Op*>* unblocked) {
+    auto it = vars_.find(v);
+    if (it == vars_.end()) return;
+    VarState& s = it->second;
+    if (is_write)
+      s.running_write = false;
+    else
+      --s.running_reads;
+    // drain: a write runs alone; consecutive reads run together
+    while (!s.queue.empty()) {
+      auto [op, w] = s.queue.front();
+      if (w) {
+        if (s.running_reads == 0 && !s.running_write) {
+          s.running_write = true;
+          s.queue.pop_front();
+          unblocked->push_back(op);
+        }
+        break;
+      }
+      if (s.running_write) break;
+      ++s.running_reads;
+      s.queue.pop_front();
+      unblocked->push_back(op);
+    }
+  }
+
+  const int workers_;
+  std::vector<std::thread> threads_;
+
+  std::mutex vars_mu_;
+  std::unordered_map<uint64_t, VarState> vars_;
+  uint64_t next_var_ = 1;
+  std::atomic<int> pending_{0};
+  std::condition_variable idle_cv_;  // waits on vars_mu_
+
+  std::mutex ready_mu_;
+  std::condition_variable ready_cv_;
+  std::deque<Op*> ready_;
+  bool shutdown_ = false;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* MXTPUEngineCreate(int workers) { return new Engine(workers); }
+void MXTPUEngineDelete(void* h) { delete static_cast<Engine*>(h); }
+uint64_t MXTPUEngineNewVar(void* h) {
+  return static_cast<Engine*>(h)->NewVar();
+}
+void MXTPUEngineDelVar(void* h, uint64_t v) {
+  static_cast<Engine*>(h)->DelVar(v);
+}
+void MXTPUEnginePush(void* h, void (*fn)(void*), void* arg,
+                     const uint64_t* reads, int nreads, const uint64_t* writes,
+                     int nwrites) {
+  static_cast<Engine*>(h)->Push(fn, arg, reads, nreads, writes, nwrites);
+}
+void MXTPUEngineWaitForVar(void* h, uint64_t v) {
+  static_cast<Engine*>(h)->WaitForVar(v);
+}
+void MXTPUEngineWaitAll(void* h) { static_cast<Engine*>(h)->WaitAll(); }
+int MXTPUEngineNumWorkers(void* h) {
+  return static_cast<Engine*>(h)->workers();
+}
+
+}  // extern "C"

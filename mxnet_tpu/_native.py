@@ -1,0 +1,126 @@
+"""ctypes binding for the native C++ dependency engine (cpp/engine.cc).
+
+Builds the shared library on first import (g++, repo-local output); raises on
+any failure so mxnet_tpu.engine can fall back to the pure-Python engine with
+identical semantics. Exception propagation matches _PyEngine: once an op
+touching a var raises, every later op depending on that var re-raises the
+same error (var poisoning — the C++ side schedules but does not know about
+Python exceptions; MXNet's ThreadedEngine likewise rethrows stored
+exception_ptrs on WaitForVar/WaitAll).
+"""
+from __future__ import annotations
+
+import atexit
+import ctypes
+import itertools
+import os
+import subprocess
+import threading
+from concurrent.futures import Future
+from pathlib import Path
+
+__all__ = ["NativeEngine"]
+
+_CB = ctypes.CFUNCTYPE(None, ctypes.c_void_p)
+_U64A = ctypes.POINTER(ctypes.c_uint64)
+
+
+def _build_lib():
+    root = Path(__file__).resolve().parent.parent
+    src = root / "cpp" / "engine.cc"
+    out = root / "cpp" / "build" / "libmxtpu_engine.so"
+    if out.exists() and out.stat().st_mtime >= src.stat().st_mtime:
+        return out
+    out.parent.mkdir(parents=True, exist_ok=True)
+    tmp = out.with_suffix(f".so.tmp{os.getpid()}")
+    subprocess.run(
+        ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
+         str(src), "-o", str(tmp)],
+        check=True, capture_output=True)
+    os.replace(tmp, out)
+    return out
+
+
+def _load():
+    lib = ctypes.CDLL(str(_build_lib()))
+    lib.MXTPUEngineCreate.restype = ctypes.c_void_p
+    lib.MXTPUEngineCreate.argtypes = [ctypes.c_int]
+    lib.MXTPUEngineDelete.argtypes = [ctypes.c_void_p]
+    lib.MXTPUEngineNewVar.restype = ctypes.c_uint64
+    lib.MXTPUEngineNewVar.argtypes = [ctypes.c_void_p]
+    lib.MXTPUEngineDelVar.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+    lib.MXTPUEnginePush.argtypes = [ctypes.c_void_p, _CB, ctypes.c_void_p,
+                                    _U64A, ctypes.c_int, _U64A, ctypes.c_int]
+    lib.MXTPUEngineWaitForVar.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+    lib.MXTPUEngineWaitAll.argtypes = [ctypes.c_void_p]
+    lib.MXTPUEngineNumWorkers.restype = ctypes.c_int
+    lib.MXTPUEngineNumWorkers.argtypes = [ctypes.c_void_p]
+    return lib
+
+
+class NativeEngine:
+    def __init__(self, workers=None):
+        if workers is None:
+            workers = min(8, os.cpu_count() or 4)
+        self._lib = _load()
+        self._h = self._lib.MXTPUEngineCreate(workers)
+        self.workers = workers
+        self._tasks = {}
+        self._ids = itertools.count(1)
+        self._lock = threading.Lock()
+        self._poisoned = {}          # native var id -> exception
+        self._trampoline = _CB(self._run)  # must outlive all pushes
+        atexit.register(self._shutdown)
+
+    # -- C++ worker thread enters Python here (ctypes grabs the GIL) --------
+    def _run(self, key):
+        with self._lock:
+            fn, fut, read_ids, write_ids = self._tasks.pop(key)
+        try:
+            with self._lock:
+                for v in read_ids + write_ids:
+                    if v in self._poisoned:
+                        raise self._poisoned[v]
+            fut.set_result(fn())
+        except BaseException as exc:  # noqa: BLE001 — stored, not swallowed
+            with self._lock:
+                for v in write_ids:
+                    self._poisoned[v] = exc
+            fut.set_exception(exc)
+
+    def _var_id(self, var):
+        vid = getattr(var, "_native_id", None)
+        if vid is None:
+            vid = self._lib.MXTPUEngineNewVar(self._h)
+            var._native_id = vid
+        return vid
+
+    def push(self, fn, read_vars=(), write_vars=()):
+        read_ids = [self._var_id(v) for v in read_vars]
+        write_ids = [self._var_id(v) for v in write_vars]
+        read_ids = [v for v in read_ids if v not in write_ids]
+        fut = Future()
+        key = next(self._ids)
+        with self._lock:
+            self._tasks[key] = (fn, fut, read_ids, write_ids)
+        ra = (ctypes.c_uint64 * len(read_ids))(*read_ids)
+        wa = (ctypes.c_uint64 * len(write_ids))(*write_ids)
+        self._lib.MXTPUEnginePush(self._h, self._trampoline,
+                                  ctypes.c_void_p(key),
+                                  ra, len(read_ids), wa, len(write_ids))
+        return fut
+
+    def wait_for_var(self, var):
+        vid = getattr(var, "_native_id", None)
+        if vid is not None and self._h:
+            self._lib.MXTPUEngineWaitForVar(self._h, vid)
+
+    def wait_for_all(self):
+        if self._h:
+            self._lib.MXTPUEngineWaitAll(self._h)
+
+    def _shutdown(self):
+        h, self._h = self._h, None
+        if h:
+            self._lib.MXTPUEngineWaitAll(h)
+            self._lib.MXTPUEngineDelete(h)

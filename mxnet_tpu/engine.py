@@ -23,7 +23,7 @@ __all__ = ["Var", "push", "wait_for_var", "wait_for_all", "set_bulk_size",
 class Var:
     """A dependency variable (reference: engine::Var). Ops that write a var
     are serialised; readers wait for the last writer."""
-    __slots__ = ("_lock", "_last_write", "_reads")
+    __slots__ = ("_lock", "_last_write", "_reads", "_native_id")
 
     def __init__(self):
         self._lock = threading.Lock()

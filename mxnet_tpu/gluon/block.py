@@ -28,7 +28,7 @@ from .. import random as _random
 from ..ndarray.ndarray import NDArray, _apply
 from .parameter import Parameter, ParameterDict, DeferredInitializationError
 
-__all__ = ["Block", "HybridBlock", "SymbolBlock"]
+__all__ = ["Block", "HybridBlock", "SymbolBlock", "extract_pure_fn"]
 
 
 # ---------------------------------------------------------------------------
@@ -107,6 +107,29 @@ class _TraceContext:
 
     def __exit__(self, *exc):
         _TraceContext._current.value = self._old
+
+
+def _run_traced(block, params, param_vals, arg_vals, training, rng):
+    """Run block.forward under a functional trace: parameters overridden with
+    `param_vals`, layer RNG drawn from `rng`, aux updates captured instead of
+    applied. Returns (outputs_tuple, aux_updates, is_seq, is_list). Shared by
+    the compiled-forward cache and extract_pure_fn."""
+    prev_rec = autograd.set_recording(False)
+    prev_train = autograd.set_training(training)
+    try:
+        with _TraceContext(rng) as tctx:
+            for p, v in zip(params, param_vals):
+                p._trace_override = NDArray(v)
+            nd_args = [NDArray(v) for v in arg_vals]
+            out = block.forward(*nd_args)
+            is_seq = isinstance(out, (tuple, list))
+            outs = tuple(out) if is_seq else (out,)
+            return outs, list(tctx.aux_updates), is_seq, isinstance(out, list)
+    finally:
+        for p in params:
+            p._trace_override = None
+        autograd.set_recording(prev_rec)
+        autograd.set_training(prev_train)
 
 
 def _layer_rng():
@@ -408,29 +431,16 @@ class HybridBlock(Block):
         def pure(rng, *vals):
             n_args = len(args)
             arg_vals, param_vals = vals[:n_args], vals[n_args:]
-            prev_rec = autograd.set_recording(False)
-            prev_train = autograd.set_training(training)
-            try:
-                with _TraceContext(rng) as tctx:
-                    for p, v in zip(params, param_vals):
-                        p._trace_override = NDArray(v)
-                    nd_args = [NDArray(v) for v in arg_vals]
-                    out = block.forward(*nd_args)
-                    is_seq = isinstance(out, (tuple, list))
-                    outs = tuple(out) if is_seq else (out,)
-                    meta["is_seq"] = is_seq
-                    meta["is_list"] = isinstance(out, list)
-                    meta["n_out"] = len(outs)
-                    meta["aux"] = [p for p, _ in tctx.aux_updates]
-                    flat = [o._data for o in outs]
-                    flat += [v._data if isinstance(v, NDArray) else v
-                             for _, v in tctx.aux_updates]
-                return tuple(flat)
-            finally:
-                for p in params:
-                    p._trace_override = None
-                autograd.set_recording(prev_rec)
-                autograd.set_training(prev_train)
+            outs, aux_updates, is_seq, is_list = _run_traced(
+                block, params, param_vals, arg_vals, training, rng)
+            meta["is_seq"] = is_seq
+            meta["is_list"] = is_list
+            meta["n_out"] = len(outs)
+            meta["aux"] = [p for p, _ in aux_updates]
+            flat = [o._data for o in outs]
+            flat += [v._data if isinstance(v, NDArray) else v
+                     for _, v in aux_updates]
+            return tuple(flat)
 
         # abstract trace now to fill `meta` (output structure, aux params)
         jax.eval_shape(pure, _random._next_key(),
@@ -449,6 +459,32 @@ class HybridBlock(Block):
 
     def hybrid_forward(self, F, *args, **kwargs):
         raise NotImplementedError
+
+
+def extract_pure_fn(block, *example_args, training=False, rng_seed=0):
+    """Lower a Block's forward to a pure jittable `(params, *arrays) -> arrays`.
+
+    The block must be fully initialised (run one eager forward first for
+    deferred shapes). Returns `(fn, param_arrays)` where `param_arrays` is the
+    list of raw `jax.Array` leaves in `collect_params()` order. Aux-state
+    updates (BatchNorm running stats) are computed but dropped — this is the
+    inference/export path (reference analogue: exporting the nnvm symbol of a
+    hybridized net, gluon/block.py `export`).
+    """
+    params = list(block.collect_params().values())
+
+    def fn(param_vals, *arg_vals):
+        outs, _aux, _seq, _lst = _run_traced(
+            block, params, param_vals, arg_vals, training,
+            jax.random.PRNGKey(rng_seed))
+        res = tuple(o._data for o in outs)
+        return res if len(res) > 1 else res[0]
+
+    param_vals = [p.data()._data for p in params]
+    # abstract-trace with the example args now so a shape/structure problem
+    # surfaces here, not as an opaque error when the caller later jits fn
+    jax.eval_shape(fn, param_vals, *[a._data for a in example_args])
+    return fn, param_vals
 
 
 class SymbolBlock(HybridBlock):

@@ -68,14 +68,18 @@ def convolution(x, weight, bias=None, stride=1, pad=0, dilate=1,
     spatial = layout.replace("N", "").replace("C", "")
     rhs = ("OI" + spatial) if layout.index("C") == 1 else ("O" + spatial + "I")
     dn = lax.conv_dimension_numbers(x.shape, weight.shape, (layout, rhs, layout))
+    # bf16 in / bf16 out: the TPU MXU accumulates in fp32 internally, and a
+    # preferred_element_type upcast would poison the conv transpose (the AD
+    # rule requires cotangent dtype == primal dtype). fp32 master weights
+    # compute in the activation dtype; the astype transpose returns the
+    # weight cotangent in fp32 (the multi-precision optimizer pattern).
+    if weight.dtype != x.dtype:
+        weight = weight.astype(x.dtype)
     y = lax.conv_general_dilated(
         x, weight, window_strides=tuple(stride),
         padding=tuple((p, p) for p in pad),
         rhs_dilation=tuple(dilate), dimension_numbers=dn,
-        feature_group_count=num_group,
-        preferred_element_type=jnp.float32 if x.dtype == jnp.bfloat16 else None)
-    if y.dtype != x.dtype:
-        y = y.astype(x.dtype)
+        feature_group_count=num_group)
     if bias is not None:
         c_axis = layout.index("C")
         shape = [1] * y.ndim
@@ -189,10 +193,16 @@ def pooling(x, kernel, pool_type="max", stride=None, pad=0, layout=None,
         strides[ax] = stride[i]
         paddings[ax] = (pad[i], pad[i])
     if pool_type == "max":
-        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
-        return lax.reduce_window(x, jnp.asarray(init, x.dtype), lax.max,
+        # init must be a python scalar: an array-valued init defeats XLA's
+        # monoid recognition and kills the reduce_window VJP on TPU
+        if jnp.issubdtype(x.dtype, jnp.floating):
+            init = -jnp.inf
+        else:
+            init = int(jnp.iinfo(x.dtype).min)
+        return lax.reduce_window(x, init, lax.max,
                                  tuple(window), tuple(strides), tuple(paddings))
-    s = lax.reduce_window(x, jnp.asarray(0, x.dtype), lax.add,
+    zero = 0.0 if jnp.issubdtype(x.dtype, jnp.floating) else 0
+    s = lax.reduce_window(x, zero, lax.add,
                           tuple(window), tuple(strides), tuple(paddings))
     if pool_type == "sum":
         return s
@@ -202,7 +212,7 @@ def pooling(x, kernel, pool_type="max", stride=None, pad=0, layout=None,
             denom *= k
         return s / denom
     ones = jnp.ones_like(x)
-    cnt = lax.reduce_window(ones, jnp.asarray(0, x.dtype), lax.add,
+    cnt = lax.reduce_window(ones, zero, lax.add,
                             tuple(window), tuple(strides), tuple(paddings))
     return s / cnt
 

@@ -273,12 +273,11 @@ def _moe_ffn(bp, h, cfg, ep_size):
     dispatch = (flat_mask[:, :, None]
                 * jax.nn.one_hot(pos, capacity, dtype=a.dtype))
     dispatch = dispatch.reshape(mb, s_loc, e, capacity)
-    gated = dispatch * gate_val[:, :, None, None]
 
-    # local expert slice along E
+    # local expert slice along E; gate multiply after slicing (1/ep the work)
     ep_idx = lax.axis_index("ep")
     disp_loc = lax.dynamic_slice_in_dim(dispatch, ep_idx * e_loc, e_loc, 2)
-    gated_loc = lax.dynamic_slice_in_dim(gated, ep_idx * e_loc, e_loc, 2)
+    gated_loc = disp_loc * gate_val[:, :, None, None]
 
     expert_in = jnp.einsum("bsec,bsd->ecd", disp_loc, a)
     u = jax.nn.gelu(jnp.einsum("ecd,edf->ecf", expert_in, bp["wi_e"]))
