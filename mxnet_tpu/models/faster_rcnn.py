@@ -1,0 +1,176 @@
+"""Faster R-CNN (GluonCV faster_rcnn_resnet50_v1b parity — RPN, proposal
+NMS, ROIAlign, two-stage head; rebuilt TPU-first from gluoncv behavior).
+
+TPU-first choices:
+  * every stage has STATIC shapes: fixed top-k pre-NMS proposals, fixed
+    post-NMS budget (invalid slots flagged, not dropped), fixed fg/bg sample
+    counts — so the full two-stage pipeline jits into one XLA program;
+  * ROIAlign is the vectorised bilinear gather from ops.detection_ops
+    (vmap over rois), not a per-roi loop;
+  * NHWC backbone (MXU conv layout).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..ndarray.ndarray import NDArray, _apply
+from ..gluon import nn
+from ..gluon.block import HybridBlock
+from ..gluon.model_zoo.vision.resnet import get_resnet
+from ..ops import detection_ops as D
+
+__all__ = ["FasterRCNN", "faster_rcnn_resnet50_v1", "rpn_anchors",
+           "generate_proposals", "rcnn_targets"]
+
+
+def rpn_anchors(feat_h, feat_w, stride=16, scales=(8, 16, 32),
+                ratios=(0.5, 1, 2)):
+    """Anchors in input-pixel corner coords, (feat_h*feat_w*K, 4)."""
+    base = []
+    for s in scales:
+        for r in ratios:
+            w = s * stride * np.sqrt(r)
+            h = s * stride / np.sqrt(r)
+            base.append([-w / 2, -h / 2, w / 2, h / 2])
+    base = np.asarray(base, np.float32)                    # (K, 4)
+    cy = (np.arange(feat_h) + 0.5) * stride
+    cx = (np.arange(feat_w) + 0.5) * stride
+    cyx = np.stack(np.meshgrid(cy, cx, indexing="ij"), -1).reshape(-1, 1, 2)
+    shift = np.concatenate([cyx[..., ::-1], cyx[..., ::-1]], -1)  # (HW,1,4)
+    return (base[None] + shift).reshape(-1, 4).astype(np.float32)
+
+
+def generate_proposals(obj_logits, deltas, anchors, im_size, pre_nms=2000,
+                       post_nms=300, nms_thresh=0.7, min_size=4.0):
+    """RPN outputs -> fixed post_nms proposal boxes per image.
+
+    obj_logits (A,), deltas (A, 4), anchors (A, 4) -> (post_nms, 4) boxes +
+    (post_nms,) validity scores (0 for suppressed slots).
+    """
+    boxes = D.box_decode(deltas, anchors, variances=(1, 1, 1, 1))
+    h, w = im_size
+    boxes = jnp.stack([
+        jnp.clip(boxes[:, 0], 0, w), jnp.clip(boxes[:, 1], 0, h),
+        jnp.clip(boxes[:, 2], 0, w), jnp.clip(boxes[:, 3], 0, h)], -1)
+    wh = boxes[:, 2:] - boxes[:, :2]
+    score = jax.nn.sigmoid(obj_logits)
+    score = jnp.where(jnp.min(wh, -1) >= min_size, score, 0.0)
+    k = min(pre_nms, boxes.shape[0])
+    top_s, top_i = lax.top_k(score, k)
+    top_b = boxes[top_i]
+    keep = D.nms(top_b, top_s, nms_thresh, post_nms)
+    kept_s = jnp.where(keep, top_s, 0.0)
+    order_s, order_i = lax.top_k(kept_s, post_nms)
+    return top_b[order_i], order_s
+
+
+def rcnn_targets(proposals, gt, num_samples=128, fg_fraction=0.25,
+                 fg_iou=0.5, key=None):
+    """Sample proposals against gt (M, 5) [cls, box] rows (cls=-1 pad).
+
+    Static shapes: returns (rois (S,4), cls_t (S,) int32 0=bg,
+    box_t (S,4), box_mask (S,1)). Highest-IoU-first deterministic sampling
+    (the reference samples randomly; deterministic top-k keeps this a pure
+    function of inputs — rng can be layered on by shuffling proposals).
+    """
+    gt_boxes, gt_cls = gt[:, 1:], gt[:, 0]
+    valid = gt_cls >= 0
+    # append gt boxes as candidate rois (reference does this in training)
+    cand = jnp.concatenate([proposals, gt_boxes], 0)
+    iou = jnp.where(valid[None, :], D.box_iou(cand, gt_boxes), 0.0)
+    best_iou = jnp.max(iou, 1)
+    best_gt = jnp.argmax(iou, 1)
+    n_fg = int(num_samples * fg_fraction)
+    fg_score = jnp.where(best_iou >= fg_iou, best_iou, 0.0)
+    fg_s, fg_i = lax.top_k(fg_score, n_fg)
+    bg_score = jnp.where(best_iou < fg_iou, 1.0 - best_iou, 0.0)
+    bg_s, bg_i = lax.top_k(bg_score, num_samples - n_fg)
+    idx = jnp.concatenate([fg_i, bg_i])
+    is_fg = jnp.concatenate([fg_s > 0, jnp.zeros(num_samples - n_fg, bool)])
+    rois = cand[idx]
+    assigned = best_gt[idx]
+    cls_t = jnp.where(is_fg, gt_cls[assigned].astype(jnp.int32) + 1, 0)
+    box_t = D.box_encode(gt_boxes[assigned], rois, variances=(1, 1, 1, 1))
+    box_t = jnp.where(is_fg[:, None], box_t, 0.0)
+    return rois, cls_t, box_t, is_fg[:, None].astype(box_t.dtype)
+
+
+class FasterRCNN(HybridBlock):
+    """Two-stage detector.
+
+    forward(x NHWC) -> (obj_logits (B, A), rpn_deltas (B, A, 4),
+    features NHWC). Proposals/targets/head run through `rpn_proposals`,
+    `roi_head` — split so training can sample targets between stages, same
+    structure as the reference's training loop.
+    """
+
+    def __init__(self, num_classes=20, backbone_layers=50, input_size=512,
+                 roi_size=(7, 7), post_nms=300, **kwargs):
+        super().__init__(**kwargs)
+        self.num_classes = num_classes
+        self.input_size = input_size
+        self.stride = 16
+        self.post_nms = post_nms
+        self._roi_size = roi_size
+        f = input_size // self.stride
+        self.anchors = rpn_anchors(f, f, self.stride)
+        with self.name_scope():
+            base = get_resnet(1, backbone_layers, layout="NHWC")
+            feats = list(base.features._children.values())
+            self.backbone = nn.HybridSequential(prefix="backbone_")
+            with self.backbone.name_scope():
+                for b in feats[:7]:         # through stage3: stride 16
+                    self.backbone.add(b)
+            self.rpn_conv = nn.Conv2D(512, 3, padding=1, activation="relu",
+                                      layout="NHWC", prefix="rpn_conv_")
+            self.rpn_obj = nn.Conv2D(9, 1, layout="NHWC", prefix="rpn_obj_")
+            self.rpn_box = nn.Conv2D(36, 1, layout="NHWC", prefix="rpn_box_")
+            self.head = nn.HybridSequential(prefix="head_")
+            with self.head.name_scope():
+                self.head.add(nn.Dense(1024, activation="relu"),
+                              nn.Dense(1024, activation="relu"))
+            self.cls_score = nn.Dense(num_classes + 1, prefix="cls_")
+            self.box_pred = nn.Dense((num_classes + 1) * 4, prefix="box_")
+
+    def hybrid_forward(self, F, x):
+        feat = self.backbone(x)
+        r = self.rpn_conv(feat)
+        obj = self.rpn_obj(r).reshape((0, -1))            # (B, A)
+        deltas = self.rpn_box(r).reshape((0, -1, 4))      # (B, A, 4)
+        return obj, deltas, feat
+
+    def rpn_proposals(self, obj, deltas, pre_nms=2000):
+        size = (self.input_size, self.input_size)
+        anchors = jnp.asarray(self.anchors)
+        post = self.post_nms
+
+        def fn(o, d):
+            return jax.vmap(lambda oo, dd: generate_proposals(
+                oo, dd, anchors, size, pre_nms, post))(o, d)
+
+        return _apply(fn, [obj, deltas], n_out=2)
+
+    def roi_head(self, feat, rois):
+        """feat (B, H, W, C) NHWC + rois (B, R, 4) input coords ->
+        (cls_scores (B, R, C+1), box_deltas (B, R, C+1, 4))."""
+        scale = 1.0 / self.stride
+        oh, ow = self._roi_size
+
+        def align(f, r):
+            fc = jnp.moveaxis(f, -1, 0)                   # NCHW per image
+            return D.roi_align(fc, r, (oh, ow), spatial_scale=scale)
+
+        pooled = _apply(lambda f, r: jax.vmap(align)(f, r), [feat, rois])
+        b, rn = pooled.shape[0], pooled.shape[1]
+        flat = pooled.reshape((b * rn, -1))
+        h = self.head(flat)
+        cls = self.cls_score(h).reshape((b, rn, self.num_classes + 1))
+        box = self.box_pred(h).reshape((b, rn, self.num_classes + 1, 4))
+        return cls, box
+
+
+def faster_rcnn_resnet50_v1(num_classes=20, **kwargs):
+    return FasterRCNN(num_classes=num_classes, backbone_layers=50, **kwargs)

@@ -1,0 +1,161 @@
+"""SSD object detection (GluonCV ssd_512_resnet50_v1 parity — anchors,
+multibox target/detection, NMS; rebuilt TPU-first from gluoncv.model_zoo.ssd
+behavior).
+
+TPU-first choices:
+  * NHWC feature maps end to end (MXU-native conv layout);
+  * anchors precomputed as a static numpy table at build time (the reference
+    regenerates MultiBoxPrior on device every forward);
+  * static-shape target assignment + decode/NMS from ops.detection_ops, so
+    train step AND inference (including NMS) each compile to one XLA program.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..ndarray.ndarray import NDArray, _apply
+from ..gluon import nn
+from ..gluon.block import HybridBlock
+from ..gluon.model_zoo.vision.resnet import get_resnet
+from ..ops import detection_ops as D
+
+__all__ = ["SSD", "ssd_512_resnet50_v1", "SSDTargetGenerator", "ssd_decode"]
+
+def _pyramid_spec(input_size):
+    """Feature-map sizes + per-map anchor sizes/ratios for an input edge.
+
+    512 -> maps (64, 32, 16, 8, 4, 2, 1) matching the reference SSD-512
+    pyramid; anchor scales follow the standard SSD linear scale rule."""
+    feat_sizes = [input_size // 8, input_size // 16, input_size // 32]
+    while feat_sizes[-1] > 1:
+        feat_sizes.append(max(feat_sizes[-1] // 2, 1))
+    n = len(feat_sizes)
+    s_min, s_max = 0.07, 0.9
+    scales = [s_min + (s_max - s_min) * k / (n - 1) for k in range(n)]
+    scales.append(1.0)
+    sizes = tuple((scales[k], float(np.sqrt(scales[k] * scales[k + 1])))
+                  for k in range(n))
+    wide = (1, 2, 0.5, 3, 1.0 / 3)
+    narrow = (1, 2, 0.5)
+    ratios = tuple(wide if 2 <= k < n - 2 else narrow for k in range(n))
+    return tuple(feat_sizes), sizes, ratios
+
+
+def build_anchors(input_size=512):
+    """Static anchor table (A, 4), normalised corners."""
+    feat_sizes, sizes, ratios = _pyramid_spec(input_size)
+    out = [D.multibox_prior(s, s, sizes=sz, ratios=rt)
+           for s, sz, rt in zip(feat_sizes, sizes, ratios)]
+    return np.concatenate(out, 0)
+
+
+class _ConvBlock(nn.HybridSequential):
+    """conv(3x3 s2 or s1) + BN + relu feature-pyramid extension."""
+
+    def __init__(self, channels, stride, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.add(nn.Conv2D(channels // 2, 1, layout="NHWC"),
+                     nn.BatchNorm(axis=3), nn.Activation("relu"),
+                     nn.Conv2D(channels, 3, strides=stride, padding=1,
+                               layout="NHWC"),
+                     nn.BatchNorm(axis=3), nn.Activation("relu"))
+
+
+class SSD(HybridBlock):
+    """forward(x NHWC (B, 512, 512, 3)) -> (cls_preds (B, A, C+1),
+    loc_preds (B, A*4)). Anchors via .anchors (numpy, static)."""
+
+    def __init__(self, num_classes=20, backbone_layers=50, input_size=512,
+                 **kwargs):
+        super().__init__(**kwargs)
+        self.num_classes = num_classes
+        self.input_size = input_size
+        feat_sizes, sizes, ratios = _pyramid_spec(input_size)
+        self.anchors = build_anchors(input_size)
+        n_anch = [len(s) + len(r) - 1 for s, r in zip(sizes, ratios)]
+        n_extras = len(feat_sizes) - 3
+        with self.name_scope():
+            base = get_resnet(1, backbone_layers, layout="NHWC")
+            # features children: conv, bn, relu, pool, stage1..4, gap, flat.
+            # pyramid maps at strides 8/16/32 come from stage2/3/4 (64/32/16
+            # at 512 input); four stride-2 extras add 8/4/2/1.
+            feats = list(base.features._children.values())
+            self.stem = nn.HybridSequential(prefix="stem_")
+            with self.stem.name_scope():
+                for b in feats[:5]:        # conv, bn, relu, pool, stage1
+                    self.stem.add(b)
+            self.stage2 = feats[5]
+            self.stage3 = feats[6]
+            self.stage4 = feats[7]
+            self.extras = nn.HybridSequential(prefix="extras_")
+            with self.extras.name_scope():
+                for i in range(n_extras):
+                    self.extras.add(_ConvBlock(512 if i == 0 else 256, 2))
+            self.cls_heads = nn.HybridSequential(prefix="cls_")
+            self.loc_heads = nn.HybridSequential(prefix="loc_")
+            with self.cls_heads.name_scope():
+                for k in n_anch:
+                    self.cls_heads.add(nn.Conv2D(k * (num_classes + 1), 3,
+                                                 padding=1, layout="NHWC"))
+            with self.loc_heads.name_scope():
+                for k in n_anch:
+                    self.loc_heads.add(nn.Conv2D(k * 4, 3, padding=1,
+                                                 layout="NHWC"))
+
+    def hybrid_forward(self, F, x):
+        f = self.stem(x)
+        maps = []
+        for stage in (self.stage2, self.stage3, self.stage4):
+            f = stage(f)
+            maps.append(f)                  # strides 8/16/32
+        for blk in self.extras:
+            f = blk(f)
+            maps.append(f)                  # halving down to 1x1
+        cls_out, loc_out = [], []
+        nc = self.num_classes + 1
+        for m, ch, lh in zip(maps, self.cls_heads, self.loc_heads):
+            c = ch(m)                       # (B, h, w, K*(C+1))
+            l = lh(m)
+            cls_out.append(c.reshape((0, -1, nc)))
+            loc_out.append(l.reshape((0, -1)))
+        cls_preds = _apply(lambda *cs: jnp.concatenate(cs, 1), cls_out)
+        loc_preds = _apply(lambda *ls: jnp.concatenate(ls, 1), loc_out)
+        return cls_preds, loc_preds
+
+
+class SSDTargetGenerator:
+    """Match gt to the model's static anchors (reference: MultiBoxTarget)."""
+
+    def __init__(self, anchors, iou_threshold=0.5):
+        self._anchors = jnp.asarray(anchors)
+        self._iou = iou_threshold
+
+    def __call__(self, labels):
+        """labels: NDArray (B, M, 5) [cls, x0, y0, x1, y1] -> cls_t, loc_t,
+        loc_mask NDArrays."""
+        return _apply(
+            lambda lab: D.multibox_target(self._anchors, lab, self._iou),
+            [labels], n_out=3)
+
+
+def ssd_decode(cls_preds, loc_preds, anchors, nms_threshold=0.45,
+               score_threshold=0.01, max_det=100):
+    """(B, A, C+1) logits + (B, A*4) -> (B, max_det, 6) detections."""
+    def fn(cp, lp):
+        probs = jnp.moveaxis(_softmax(cp), -1, 1)   # (B, C+1, A)
+        return D.multibox_detection(probs, lp, jnp.asarray(anchors),
+                                    nms_threshold, score_threshold,
+                                    max_det=max_det)
+    return _apply(fn, [cls_preds, loc_preds])
+
+
+def _softmax(x):
+    m = jnp.max(x, -1, keepdims=True)
+    e = jnp.exp(x - m)
+    return e / jnp.sum(e, -1, keepdims=True)
+
+
+def ssd_512_resnet50_v1(num_classes=20, **kwargs):
+    return SSD(num_classes=num_classes, backbone_layers=50, **kwargs)
