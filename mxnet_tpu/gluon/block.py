@@ -497,6 +497,7 @@ class SymbolBlock(HybridBlock):
         self._inputs = _as_list(inputs)
         for name, p in (params or {}).items():
             self._reg_params[name] = p
+            self._params._params[p.name] = p  # visible to collect_params
 
     def forward(self, *args):
         bindings = {s.name: a for s, a in zip(self._inputs, args)}

@@ -109,6 +109,9 @@ class _RNNLayer(HybridBlock):
 
     def hybrid_forward(self, F, x, *states, **params):
         layout_ntc = self._layout == "NTC"
+        # both call styles: net(x, [h, c]) (reference) and net(x, h, c)
+        if len(states) == 1 and isinstance(states[0], (list, tuple)):
+            states = tuple(states[0])
         has_states = len(states) > 0
         ns = 2 if self._mode == "lstm" else 1
         if not has_states:

@@ -194,6 +194,11 @@ class LSTMBias(Initializer):
         super().__init__(forget_bias=forget_bias)
         self.forget_bias = forget_bias
 
+    def init_array(self, name, shape, dtype, key):
+        # bypass the generic name dispatch: '*bias' would zero-init and
+        # defeat this initializer's whole purpose
+        return self._init(shape, _np_dtype(dtype), key)
+
     def _init(self, shape, dtype, key):
         b = np.zeros(shape, dtype=np.float32)
         n = shape[0] // 4

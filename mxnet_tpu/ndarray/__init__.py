@@ -14,6 +14,11 @@ from ..ops import nn_ops as _n
 from ..ops import linalg_ops as linalg  # mx.nd.linalg.*
 from .. import random                   # mx.nd.random.*
 
+# the star import surfaces the raw jax-level kernels; the imperative
+# NDArray namespace wants the recorded wrappers under the reference names
+softmax = _n.softmax_nd
+log_softmax = _n.log_softmax_nd
+
 # reference exposes a handful of random samplers at top level too
 from ..random import (uniform, normal, randn, randint, multinomial,
                       exponential, gamma, poisson)

@@ -24,7 +24,8 @@ __all__ = [
     "activation", "leaky_relu", "dropout", "embedding", "softmax",
     "log_softmax", "softmax_cross_entropy", "rnn_step",
     "FullyConnected", "Convolution", "Deconvolution", "BatchNorm", "LayerNorm",
-    "Pooling", "Activation", "Dropout", "Embedding", "SoftmaxOutput",
+    "Pooling", "Activation", "LeakyReLU", "Dropout", "Embedding",
+    "SoftmaxOutput",
     "softmax_nd", "log_softmax_nd", "relu", "sigmoid", "gelu", "silu",
 ]
 
@@ -105,10 +106,13 @@ def deconvolution(x, weight, bias=None, stride=1, pad=0, adj=0, layout=None):
     k = weight.shape[2:]
     padding = tuple((d - 1 - p, d - 1 - p + a) for d, p, a in
                     zip(k, pad, adj))
+    # gradient formulation of transposed conv: dilate the input by `stride`
+    # and convolve with the spatially-flipped kernel (out = (in-1)*s - 2p +
+    # k + adj, reference deconvolution.cc semantics)
+    flipped = lax.rev(weight, tuple(range(2, weight.ndim)))
     y = lax.conv_general_dilated(
-        x, weight, window_strides=(1,) * ndim, padding=padding,
-        lhs_dilation=tuple(stride), dimension_numbers=dn,
-        transpose_kernel=True)
+        x, flipped, window_strides=(1,) * ndim, padding=padding,
+        lhs_dilation=tuple(stride), dimension_numbers=dn)
     if bias is not None:
         c_axis = layout.index("C")
         shape = [1] * y.ndim

@@ -75,9 +75,11 @@ def make_train_step(block, loss_block, optimizer, mesh=None, dp_axis="dp",
     if mesh is not None:
         repl = NamedSharding(mesh, P())
         data_sh = NamedSharding(mesh, P(dp_axis))
+        # params/opt-state replicate over the mesh (broadcast over the state
+        # pytree); batch shards over dp; lr is a python scalar, rng replicates
         step_fn = jax.jit(
             step,
-            in_shardings=(None, data_sh, data_sh, None, None),
+            in_shardings=(repl, data_sh, data_sh, None, repl),
             donate_argnums=donate_argnums)
     else:
         step_fn = jax.jit(step, donate_argnums=donate_argnums)

@@ -22,6 +22,18 @@ from jax import shard_map
 __all__ = ["ring_attention", "ring_attention_sharded"]
 
 
+def _as_varying(x, axis_name):
+    """lax.pcast(..., 'varying') where available; no-op off shard_map."""
+    try:
+        from jax.lax import pcast
+        return pcast(x, to="varying", axes=axis_name)
+    except Exception:
+        try:
+            return jax.lax.pvary(x, axis_name)
+        except Exception:
+            return x
+
+
 def _block_attn(q, k, v, mask):
     """Partial attention stats for one K/V block.
     q: (B,H,Sq,D) k,v: (B,H,Sk,D). Returns (m, l, o_unnorm)."""
@@ -72,6 +84,9 @@ def ring_attention(q, k, v, axis_name="sp", causal=False, sm_scale=None):
     m0 = jnp.full((b, h, s_loc, 1), -1e30, jnp.float32)
     l0 = jnp.zeros((b, h, s_loc, 1), jnp.float32)
     o0 = jnp.zeros((b, h, s_loc, d), jnp.float32)
+    # mark the accumulators device-varying so the scan carry types agree
+    # under shard_map's VMA checking (the k/v carries vary via ppermute)
+    m0, l0, o0 = (_as_varying(t, axis_name) for t in (m0, l0, o0))
     carry, _ = jax.lax.scan(step, (k, v, m0, l0, o0), jnp.arange(n_dev))
     _, _, m, l, o = carry
     return (o / jnp.maximum(l, 1e-30)).astype(q.dtype)

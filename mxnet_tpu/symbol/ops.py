@@ -9,8 +9,10 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+import numpy as _np
+
 from ..ops import nn_ops as K
-from .symbol import Symbol, _make, register_op
+from .symbol import Symbol, _make, register_op, register_shape_rule
 
 __all__ = ["FullyConnected", "Convolution", "Activation", "BatchNorm",
            "LayerNorm", "Pooling", "Dropout", "Embedding", "softmax",
@@ -82,9 +84,87 @@ register_op("Pooling",
 register_op("Dropout", lambda x, p=0.5: x)  # symbolic graphs are inference
 register_op("Embedding", lambda i, w, input_dim=None, output_dim=None:
             K.embedding(i, w))
-register_op("SoftmaxOutput", lambda x, *l: jax.nn.softmax(x, axis=-1))
+
+
+@jax.custom_vjp
+def _softmax_output(x, label):
+    return jax.nn.softmax(x, axis=-1)
+
+
+def _so_fwd(x, label):
+    p = jax.nn.softmax(x, axis=-1)
+    return p, (p, label)
+
+
+def _so_bwd(res, g):
+    # loss head (reference: softmax_output-inl.h): the incoming cotangent is
+    # ignored; grad wrt logits is p - onehot(label)
+    p, label = res
+    oh = jax.nn.one_hot(label.astype(jnp.int32), p.shape[-1], dtype=p.dtype)
+    return (p - oh, jnp.zeros(label.shape, label.dtype))
+
+
+_softmax_output.defvjp(_so_fwd, _so_bwd)
+register_op("SoftmaxOutput",
+            lambda x, *l: _softmax_output(x, l[0]) if l
+            else jax.nn.softmax(x, axis=-1))
 register_op("zeros", lambda shape=(), dtype=None: jnp.zeros(shape, dtype))
 register_op("ones", lambda shape=(), dtype=None: jnp.ones(shape, dtype))
+
+
+# -- parameter shape-inference rules (reference: per-op nnvm InferShape) ----
+def _fc_shapes(ins, attrs):
+    data = ins[0]
+    if data is None:
+        return ins
+    nh = attrs.get("num_hidden")
+    in_f = int(_np.prod(data[1:])) if attrs.get("flatten", True) else data[-1]
+    out = [data, (nh, in_f)]
+    if len(ins) == 3:
+        out.append((nh,))
+    return out
+
+
+def _conv_shapes(ins, attrs):
+    data = ins[0]
+    if data is None:
+        return ins
+    layout = attrs.get("layout") or {3: "NCW", 4: "NCHW", 5: "NCDHW"}[len(data)]
+    c = data[layout.index("C")]
+    k = attrs.get("kernel")
+    k = (k,) * (len(data) - 2) if isinstance(k, int) else tuple(k)
+    nf, g = attrs.get("num_filter"), attrs.get("num_group", 1)
+    w = (nf, c // g) + k if layout.index("C") == 1 else (nf,) + k + (c // g,)
+    out = [data, w]
+    if len(ins) == 3:
+        out.append((nf,))
+    return out
+
+
+def _norm_shapes(ins, attrs):
+    data = ins[0]
+    if data is None:
+        return ins
+    c = data[attrs.get("axis", 1) if len(data) > 1 else 0]
+    return [data] + [(c,)] * (len(ins) - 1)
+
+
+def _ln_shapes(ins, attrs):
+    data = ins[0]
+    if data is None:
+        return ins
+    return [data] + [(data[attrs.get("axis", -1)],)] * (len(ins) - 1)
+
+
+def _embed_shapes(ins, attrs):
+    return [ins[0], (attrs.get("input_dim"), attrs.get("output_dim"))]
+
+
+register_shape_rule("FullyConnected", _fc_shapes)
+register_shape_rule("Convolution", _conv_shapes)
+register_shape_rule("BatchNorm", _norm_shapes)
+register_shape_rule("LayerNorm", _ln_shapes)
+register_shape_rule("Embedding", _embed_shapes)
 
 
 # -- symbol-level API --------------------------------------------------------
@@ -143,7 +223,8 @@ def Embedding(data, weight=None, input_dim=None, output_dim=None, name=None,
 
 
 def SoftmaxOutput(data, label=None, name=None, **kwargs):
-    return _make("SoftmaxOutput", [data], {}, name=name)
+    ins = [data] if label is None else [data, label]
+    return _make("SoftmaxOutput", ins, {}, name=name)
 
 
 def softmax(data, axis=-1, name=None):

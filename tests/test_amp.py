@@ -1,0 +1,74 @@
+"""AMP tests (SURVEY.md §2 #32)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import amp, nd, autograd, gluon
+from mxnet_tpu.gluon import nn
+
+
+def test_convert_block_casts_matmul_keeps_norms():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, in_units=4), nn.BatchNorm(axis=1, in_channels=8),
+            nn.Dense(2, in_units=8))
+    net.initialize()
+    amp.convert_block(net, "bfloat16")
+    dense_w = net[0].weight.data()
+    bn_gamma = net[1].gamma.data()
+    assert "bfloat16" in str(dense_w.dtype)
+    assert "float32" in str(bn_gamma.dtype)
+
+
+def test_bf16_forward_backward():
+    net = nn.Dense(4, in_units=4)
+    net.initialize()
+    net.cast("bfloat16")
+    x = nd.random.uniform(shape=(2, 4), dtype="bfloat16")
+    with autograd.record():
+        y = net(x)
+        loss = (y * y).sum()
+    loss.backward()
+    g = net.weight.grad()
+    assert "bfloat16" in str(g.dtype)
+    assert np.isfinite(g.asnumpy().astype(np.float32)).all()
+
+
+def test_dynamic_loss_scaler_down_on_overflow():
+    s = amp.DynamicLossScaler(init_scale=1024.0, scale_factor=2.0,
+                              scale_window=2)
+    s.update_scale(True)
+    assert s.loss_scale == 512.0
+    s.update_scale(False)
+    s.update_scale(False)
+    assert s.loss_scale == 1024.0  # window hit -> scale back up
+
+
+def test_scale_loss_and_unscale_roundtrip():
+    amp.init(target_dtype="float16")
+    try:
+        net = nn.Dense(2, in_units=2)
+        net.initialize()
+        x = nd.ones((1, 2))
+        with autograd.record():
+            y = net(x).sum()
+            scaled = amp.scale_loss(y)
+        scaled.backward()
+        scale = amp._state["scaler"].loss_scale
+        g_scaled = net.weight.grad().asnumpy().copy()
+        amp.unscale([p for p in net.collect_params().values()])
+        g = net.weight.grad().asnumpy()
+        np.testing.assert_allclose(g * scale, g_scaled, rtol=1e-3)
+    finally:
+        amp._state["scaler"] = None
+        amp._state["initialized"] = False
+
+
+def test_overflow_detection():
+    net = nn.Dense(2, in_units=2)
+    net.initialize()
+    x = nd.ones((1, 2))
+    with autograd.record():
+        y = net(x).sum() * float("inf")
+    y.backward()
+    s = amp.DynamicLossScaler()
+    assert s.has_overflow(list(net.collect_params().values()))

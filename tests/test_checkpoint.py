@@ -1,0 +1,70 @@
+"""Checkpoint/resume tests (SURVEY.md §2 #36, §5)."""
+import os
+import tempfile
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, checkpoint, gluon
+from mxnet_tpu.gluon import nn
+
+
+def test_save_load_checkpoint_roundtrip():
+    with tempfile.TemporaryDirectory() as d:
+        prefix = os.path.join(d, "model")
+        arg = {"w": nd.array([1.0, 2.0]), "b": nd.array([0.5])}
+        aux = {"mean": nd.array([0.1])}
+        checkpoint.save_checkpoint(prefix, 3, None, arg, aux)
+        sym, arg2, aux2 = checkpoint.load_checkpoint(prefix, 3)
+        np.testing.assert_allclose(arg2["w"].asnumpy(), [1.0, 2.0])
+        np.testing.assert_allclose(aux2["mean"].asnumpy(), [0.1])
+
+
+def test_gluon_save_load_parameters():
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "net.params.npz")
+        net = nn.Dense(3, in_units=2)
+        net.initialize(mx.init.Normal(1.0))
+        net.save_parameters(path)
+        net2 = nn.Dense(3, in_units=2)
+        net2.load_parameters(path)
+        np.testing.assert_allclose(net.weight.data().asnumpy(),
+                                   net2.weight.data().asnumpy())
+
+
+def test_sharded_roundtrip():
+    with tempfile.TemporaryDirectory() as d:
+        params = {"layer": {"w": jnp.arange(6.0).reshape(2, 3),
+                            "b": jnp.zeros(3)}}
+        checkpoint.save_sharded(d, 100, params)
+        template = {"layer": {"w": jnp.zeros((2, 3)), "b": jnp.ones(3)}}
+        restored = checkpoint.load_sharded(d, 100, template)
+        np.testing.assert_allclose(np.asarray(restored["layer"]["w"]),
+                                   np.arange(6.0).reshape(2, 3))
+
+
+def test_checkpoint_manager_rolls():
+    with tempfile.TemporaryDirectory() as d:
+        mgr = checkpoint.CheckpointManager(d, max_to_keep=2)
+        for step in (1, 2, 3):
+            mgr.save(step, {"w": jnp.full((2,), float(step))})
+        assert mgr.steps() == [2, 3]
+        step, restored = mgr.restore_latest({"w": jnp.zeros(2)})
+        assert step == 3
+        np.testing.assert_allclose(np.asarray(restored["w"]), [3.0, 3.0])
+
+
+def test_sharded_checkpoint_of_sharded_params():
+    """Save params laid out on an 8-device mesh; restore matches."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from mxnet_tpu.parallel.mesh import make_mesh
+    mesh = make_mesh({"dp": 8})
+    w = jnp.arange(32.0).reshape(8, 4)
+    sharded = jax.device_put(w, NamedSharding(mesh, P("dp", None)))
+    with tempfile.TemporaryDirectory() as d:
+        checkpoint.save_sharded(d, 0, {"w": sharded})
+        restored = checkpoint.load_sharded(d, 0, {"w": jnp.zeros((8, 4))})
+        np.testing.assert_allclose(np.asarray(restored["w"]), np.asarray(w))

@@ -1,0 +1,106 @@
+"""Gluon data tests (SURVEY.md §2 #19-20): datasets, samplers, DataLoader,
+vision transforms."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu import gluon
+from mxnet_tpu.gluon.data import (ArrayDataset, SimpleDataset, DataLoader,
+                                  SequentialSampler, RandomSampler,
+                                  BatchSampler)
+from mxnet_tpu.gluon.data.vision import transforms, MNIST, CIFAR10
+
+
+def test_array_dataset_and_transform():
+    ds = ArrayDataset(np.arange(10, dtype=np.float32),
+                      np.arange(10, dtype=np.float32) * 2)
+    assert len(ds) == 10
+    x, y = ds[3]
+    assert float(y) == 6.0
+    ds2 = ds.transform(lambda x, y: (x + 1, y), lazy=True)
+    assert float(ds2[0][0]) == 1.0
+    first = SimpleDataset(list(range(5))).transform_first(lambda x: x * 10)
+    assert first[2] == 20
+
+
+def test_samplers():
+    assert list(SequentialSampler(5)) == [0, 1, 2, 3, 4]
+    rs = list(RandomSampler(50))
+    assert sorted(rs) == list(range(50)) and rs != list(range(50))
+    bs = list(BatchSampler(SequentialSampler(7), 3, "keep"))
+    assert bs == [[0, 1, 2], [3, 4, 5], [6]]
+    bs2 = list(BatchSampler(SequentialSampler(7), 3, "discard"))
+    assert bs2 == [[0, 1, 2], [3, 4, 5]]
+    bs3 = list(BatchSampler(SequentialSampler(7), 3, "rollover"))
+    assert bs3[0] == [0, 1, 2]
+
+
+def test_dataloader_batching_shuffle_lastbatch():
+    x = np.arange(10, dtype=np.float32)
+    y = x * 2
+    ds = ArrayDataset(x, y)
+    dl = DataLoader(ds, batch_size=4, shuffle=False, last_batch="keep")
+    bs = list(dl)
+    assert len(bs) == 3 and bs[-1][0].shape == (2,)
+    dl2 = DataLoader(ds, batch_size=4, shuffle=True, last_batch="discard")
+    seen = np.concatenate([b[0].asnumpy() for b in dl2])
+    assert len(seen) == 8
+    dl3 = DataLoader(ds, batch_size=5, num_workers=2)
+    total = sum(b[0].shape[0] for b in dl3)
+    assert total == 10
+
+
+def test_dataloader_batchify_structure():
+    ds = SimpleDataset([(np.float32(i), np.float32(i * 2), np.float32(i * 3))
+                        for i in range(6)])
+    dl = DataLoader(ds, batch_size=2)
+    b = next(iter(dl))
+    assert len(b) == 3 and b[0].shape == (2,)
+
+
+def test_vision_datasets_learnable_and_shapes():
+    tr = MNIST(train=True)
+    x, y = tr[0]
+    assert x.shape == (28, 28, 1)
+    c = CIFAR10(train=False)
+    xc, yc = c[5]
+    assert xc.shape == (32, 32, 3)
+    # deterministic per index
+    x2, y2 = tr[0]
+    np.testing.assert_array_equal(x.asnumpy(), x2.asnumpy())
+    # same class templates distinguishable: two samples of same class closer
+    a0 = tr[0][0].asnumpy().astype(np.float32)
+    a10 = tr[10][0].asnumpy().astype(np.float32)   # same class (idx % 10)
+    b1 = tr[1][0].asnumpy().astype(np.float32)     # different class
+    assert np.abs(a0 - a10).mean() < np.abs(a0 - b1).mean() + 30
+
+
+def test_transforms():
+    img = nd.array(np.random.randint(0, 255, (8, 6, 3)), dtype="uint8")
+    t = transforms.ToTensor()(img)
+    assert t.shape == (3, 8, 6)
+    assert float(t.asnumpy().max()) <= 1.0
+    norm = transforms.Normalize(mean=(0.5, 0.5, 0.5), std=(0.5, 0.5, 0.5))
+    n = norm(t)
+    assert n.shape == (3, 8, 6)
+    assert n.asnumpy().min() >= -1.01
+    res = transforms.Resize((4, 4))(img)
+    assert res.shape[:2] == (4, 4)
+    cc = transforms.CenterCrop((4, 4))(img)
+    assert cc.shape[:2] == (4, 4)
+    rc = transforms.RandomCrop(4)(img)
+    assert rc.shape[:2] == (4, 4)
+    f = transforms.RandomFlipLeftRight()(img)
+    assert f.shape == img.shape
+    comp = transforms.Compose([transforms.Resize((4, 4)),
+                               transforms.ToTensor()])
+    assert comp(img).shape == (3, 4, 4)
+
+
+def test_dataloader_over_transformed_vision():
+    ds = MNIST(train=False).transform_first(transforms.ToTensor())
+    dl = DataLoader(ds, batch_size=32)
+    x, y = next(iter(dl))
+    assert x.shape == (32, 1, 28, 28)
+    assert float(x.asnumpy().max()) <= 1.0

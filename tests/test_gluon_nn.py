@@ -1,0 +1,170 @@
+"""Gluon nn layer tests (SURVEY.md §2 #16): shapes, numerics vs closed
+forms, hybridize parity, gradients flow through every layer family."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd
+from mxnet_tpu.gluon import nn
+
+
+def _check_hybrid_parity(net, x, rtol=1e-4, atol=1e-5):
+    y1 = net(x)
+    net.hybridize()
+    y2 = net(x)
+    np.testing.assert_allclose(y1.asnumpy(), y2.asnumpy(), rtol=rtol,
+                               atol=atol)
+    return y2
+
+
+@pytest.mark.parametrize("cls,kwargs,xshape,yshape", [
+    (nn.Conv1D, dict(channels=4, kernel_size=3, padding=1), (2, 3, 8),
+     (2, 4, 8)),
+    (nn.Conv2D, dict(channels=4, kernel_size=3, strides=2, padding=1),
+     (2, 3, 8, 8), (2, 4, 4, 4)),
+    (nn.Conv3D, dict(channels=2, kernel_size=3, padding=1), (1, 2, 4, 4, 4),
+     (1, 2, 4, 4, 4)),
+    (nn.Conv2DTranspose, dict(channels=3, kernel_size=2, strides=2),
+     (2, 4, 4, 4), (2, 3, 8, 8)),
+    (nn.MaxPool2D, dict(pool_size=2, strides=2), (1, 2, 8, 8), (1, 2, 4, 4)),
+    (nn.AvgPool2D, dict(pool_size=2, strides=2), (1, 2, 8, 8), (1, 2, 4, 4)),
+    (nn.GlobalAvgPool2D, {}, (2, 3, 5, 5), (2, 3, 1, 1)),
+    (nn.GlobalMaxPool2D, {}, (2, 3, 5, 5), (2, 3, 1, 1)),
+])
+def test_conv_pool_shapes(cls, kwargs, xshape, yshape):
+    net = cls(**kwargs)
+    net.initialize()
+    x = nd.random.uniform(shape=xshape)
+    y = _check_hybrid_parity(net, x)
+    assert y.shape == yshape
+
+
+def test_conv2d_nhwc_matches_nchw():
+    kw = dict(channels=4, kernel_size=3, padding=1, use_bias=False)
+    a = nn.Conv2D(layout="NCHW", in_channels=3, **kw)
+    b = nn.Conv2D(layout="NHWC", in_channels=3, **kw)
+    a.initialize()
+    b.initialize()
+    b.weight.set_data(a.weight.data().transpose((0, 2, 3, 1)))
+    x = nd.random.uniform(shape=(2, 3, 6, 6))
+    ya = a(x).asnumpy()
+    yb = b(x.transpose((0, 2, 3, 1))).asnumpy()
+    np.testing.assert_allclose(ya, yb.transpose(0, 3, 1, 2), rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_avgpool_value():
+    net = nn.AvgPool1D(pool_size=2, strides=2)
+    y = net(nd.array([[[1.0, 3.0, 5.0, 7.0]]]))
+    np.testing.assert_allclose(y.asnumpy(), [[[2.0, 6.0]]])
+
+
+def test_batchnorm_train_vs_eval():
+    net = nn.BatchNorm(axis=1, in_channels=3, momentum=0.5)
+    net.initialize()
+    x = nd.random.normal(2.0, 3.0, shape=(8, 3, 4, 4))
+    with autograd.record():
+        y = net(x)
+    yn = y.asnumpy()
+    assert abs(yn.mean()) < 0.1 and abs(yn.std() - 1.0) < 0.1
+    # running stats moved toward batch stats (momentum 0.5: 0 -> ~1.0)
+    rm = net.running_mean.data().asnumpy()
+    assert rm.mean() > 0.5
+    y_eval = net(x).asnumpy()          # eval mode uses running stats
+    assert not np.allclose(yn, y_eval)
+
+
+def test_layernorm_groupnorm_instancenorm():
+    x = nd.random.normal(1.0, 2.0, shape=(4, 6, 5))
+    ln = nn.LayerNorm(in_channels=5)
+    ln.initialize()
+    y = ln(x).asnumpy()
+    np.testing.assert_allclose(y.mean(-1), 0, atol=1e-5)
+    np.testing.assert_allclose(y.std(-1), 1, atol=1e-2)
+
+    gn = nn.GroupNorm(num_groups=2, in_channels=6)
+    gn.initialize()
+    xg = nd.random.normal(shape=(2, 6, 4, 4))
+    assert gn(xg).shape == (2, 6, 4, 4)
+
+    inorm = nn.InstanceNorm(in_channels=6)
+    inorm.initialize()
+    assert inorm(xg).shape == (2, 6, 4, 4)
+
+
+def test_activations():
+    x = nd.array([-2.0, -0.5, 0.0, 0.5, 2.0])
+    np.testing.assert_allclose(nn.Activation("relu")(x).asnumpy(),
+                               [0, 0, 0, 0.5, 2.0])
+    lrelu = nn.LeakyReLU(0.1)
+    np.testing.assert_allclose(lrelu(x).asnumpy()[0], -0.2, rtol=1e-6)
+    elu = nn.ELU(1.0)
+    assert elu(x).asnumpy()[0] < 0
+    selu = nn.SELU()
+    assert selu(x).shape == (5,)
+    sw = nn.Swish()
+    np.testing.assert_allclose(sw(x).asnumpy()[2], 0.0, atol=1e-7)
+    g = nn.GELU()
+    assert abs(g(x).asnumpy()[2]) < 1e-6
+    prelu = nn.PReLU()
+    prelu.initialize()
+    y = prelu(x)
+    assert y.shape == (5,)
+
+
+def test_embedding_grad_sparse_rows():
+    net = nn.Embedding(10, 4)
+    net.initialize()
+    idx = nd.array([1, 3, 3], dtype="int32")
+    with autograd.record():
+        y = net(idx).sum()
+    y.backward()
+    g = net.weight.grad().asnumpy()
+    assert (g[1] == 1).all() and (g[3] == 2).all() and (g[0] == 0).all()
+
+
+def test_dropout_train_eval():
+    net = nn.Dropout(0.5)
+    x = nd.ones((1000,))
+    with autograd.record(train_mode=True):
+        y = net(x)
+    yn = y.asnumpy()
+    assert (yn == 0).mean() > 0.3            # roughly half dropped
+    assert abs(yn.mean() - 1.0) < 0.2        # inverted scaling
+    assert (net(x).asnumpy() == 1).all()     # identity in eval
+
+
+def test_sequential_slicing_and_lambda():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(4, in_units=4), nn.Dense(2, in_units=4),
+            nn.HybridLambda(lambda F, x: x * 2))
+    net.initialize()
+    assert len(net) == 3
+    y = net(nd.ones((1, 4)))
+    assert y.shape == (1, 2)
+    sub = net[:2]
+    assert len(sub) == 2
+
+
+def test_concurrent():
+    net = nn.Concurrent()
+    net.add(nn.Dense(2, in_units=3), nn.Dense(4, in_units=3))
+    net.initialize()
+    y = net(nd.ones((2, 3)))
+    assert y.shape == (2, 6)   # concat along axis 1
+
+
+def test_reflection_pad():
+    net = nn.ReflectionPad2D(1)
+    x = nd.array(np.arange(4, dtype=np.float32).reshape(1, 1, 2, 2))
+    y = net(x).asnumpy()
+    assert y.shape == (1, 1, 4, 4)
+    assert y[0, 0, 0, 0] == 3.0  # reflected corner
+
+
+def test_deferred_init_and_in_units_inference():
+    net = nn.Dense(4)
+    net.initialize()
+    y = net(nd.ones((2, 7)))
+    assert net.weight.shape == (4, 7)
+    assert y.shape == (2, 4)

@@ -1,0 +1,130 @@
+"""Gluon rnn tests (SURVEY.md §2 #17): layers, cells, unroll, bidirectional,
+gradient flow, layer/cell parity."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd
+from mxnet_tpu.gluon import rnn, nn
+
+
+@pytest.mark.parametrize("cls,nstate", [(rnn.RNN, 1), (rnn.GRU, 1),
+                                        (rnn.LSTM, 2)])
+def test_layer_shapes_tnc(cls, nstate):
+    net = cls(hidden_size=8, num_layers=2)
+    net.initialize()
+    x = nd.random.uniform(shape=(5, 3, 4))           # (T, N, C)
+    out = net(x)
+    assert out.shape == (5, 3, 8)
+    states = net.begin_state(batch_size=3)
+    assert len(states) == nstate
+    out2, new_states = net(x, states)
+    assert out2.shape == (5, 3, 8)
+    assert len(new_states) == nstate
+    assert new_states[0].shape == (2, 3, 8)          # (layers, N, H)
+
+
+def test_layer_nTC_layout():
+    net = rnn.LSTM(hidden_size=8, layout="NTC")
+    net.initialize()
+    x = nd.random.uniform(shape=(3, 5, 4))
+    assert net(x).shape == (3, 5, 8)
+
+
+def test_bidirectional_doubles_features():
+    net = rnn.LSTM(hidden_size=8, bidirectional=True)
+    net.initialize()
+    x = nd.random.uniform(shape=(5, 3, 4))
+    assert net(x).shape == (5, 3, 16)
+
+
+def test_gradient_flows():
+    net = rnn.GRU(hidden_size=8)
+    net.initialize()
+    x = nd.random.uniform(shape=(5, 3, 4))
+    with autograd.record():
+        loss = (net(x) ** 2).sum()
+    loss.backward()
+    for p in net.collect_params().values():
+        g = p.grad().asnumpy()
+        assert np.isfinite(g).all()
+        assert np.abs(g).sum() > 0
+
+
+@pytest.mark.parametrize("cell_cls", [rnn.RNNCell, rnn.GRUCell, rnn.LSTMCell])
+def test_cell_step_and_unroll(cell_cls):
+    cell = cell_cls(hidden_size=8, input_size=4)
+    cell.initialize()
+    x = nd.random.uniform(shape=(3, 4))
+    states = cell.begin_state(batch_size=3)
+    out, new_states = cell(x, states)
+    assert out.shape == (3, 8)
+    seq = nd.random.uniform(shape=(3, 5, 4))
+    outs, final = cell.unroll(5, seq, layout="NTC", merge_outputs=True)
+    assert outs.shape == (3, 5, 8)
+
+
+def test_lstm_layer_matches_cell_unroll():
+    """Fused lax.scan layer == step-by-step cell with shared params."""
+    layer = rnn.LSTM(hidden_size=6, num_layers=1, input_size=4)
+    layer.initialize()
+    x = nd.random.uniform(shape=(7, 2, 4))           # TNC
+    out = layer(x).asnumpy()
+
+    cell = rnn.LSTMCell(hidden_size=6, input_size=4)
+    cell.initialize()
+    # copy layer params (l0 naming) into the cell
+    lp = {k.split("_", 1)[-1] if False else k: v
+          for k, v in layer.collect_params().items()}
+    lvals = {k: v for k, v in layer.collect_params().items()}
+    cvals = {k: v for k, v in cell.collect_params().items()}
+
+    def find(sub, d):
+        return [v for k, v in d.items() if sub in k]
+
+    for name in ("i2h_weight", "h2h_weight", "i2h_bias", "h2h_bias"):
+        src = find(name, lvals)
+        dst = find(name, cvals)
+        assert len(src) == 1 and len(dst) == 1, name
+        dst[0].set_data(src[0].data())
+
+    states = cell.begin_state(batch_size=2)
+    outs = []
+    for t in range(7):
+        o, states = cell(x[t], states)
+        outs.append(o.asnumpy())
+    np.testing.assert_allclose(out, np.stack(outs), rtol=1e-4, atol=1e-5)
+
+
+def test_sequential_rnn_cell():
+    stack = rnn.SequentialRNNCell()
+    stack.add(rnn.LSTMCell(8, input_size=4))
+    stack.add(rnn.GRUCell(6, input_size=8))
+    stack.initialize()
+    x = nd.random.uniform(shape=(2, 4))
+    states = stack.begin_state(batch_size=2)
+    out, new_states = stack(x, states)
+    assert out.shape == (2, 6)
+
+
+def test_rnn_learns_sum_task():
+    """LSTM learns to output the running mean of inputs (tiny regression)."""
+    from mxnet_tpu import gluon
+    np.random.seed(0)
+    net = nn.HybridSequential()
+    lstm = rnn.LSTM(hidden_size=16, layout="NTC", input_size=1)
+    net.add(lstm, nn.Dense(1, flatten=False, in_units=16))
+    net.initialize(mx.init.Xavier())
+    x_np = np.random.rand(32, 6, 1).astype(np.float32)
+    y_np = np.cumsum(x_np, axis=1) / np.arange(1, 7).reshape(1, 6, 1)
+    x, y = nd.array(x_np), nd.array(y_np)
+    lf = gluon.loss.L2Loss()
+    tr = gluon.Trainer(net.collect_params(), "adam", {"learning_rate": 0.01})
+    losses = []
+    for _ in range(30):
+        with autograd.record():
+            loss = lf(net(x), y).mean()
+        loss.backward()
+        tr.step(32)
+        losses.append(float(loss.asnumpy()))
+    assert losses[-1] < losses[0] * 0.5

@@ -1,0 +1,88 @@
+"""Initializer tests (SURVEY.md §2 #26)."""
+import numpy as np
+import jax
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import init
+from mxnet_tpu import initializer
+
+
+KEY = jax.random.PRNGKey(0)
+
+
+def _draw(ini, name="weight", shape=(64, 64)):
+    return np.asarray(ini(name, shape, np.float32, KEY))
+
+
+def test_zero_one_constant():
+    assert (_draw(init.Zero()) == 0).all()
+    assert (_draw(init.One()) == 1).all()
+    assert (_draw(init.Constant(2.5)) == 2.5).all()
+
+
+def test_uniform_normal_stats():
+    u = _draw(init.Uniform(0.5), shape=(256, 256))
+    assert u.min() >= -0.5 and u.max() <= 0.5
+    n = _draw(init.Normal(0.1), shape=(256, 256))
+    assert abs(n.std() - 0.1) < 0.01 and abs(n.mean()) < 0.01
+
+
+def test_orthogonal():
+    w = _draw(init.Orthogonal(scale=1.0), shape=(32, 32))
+    np.testing.assert_allclose(w @ w.T, np.eye(32), atol=1e-4)
+
+
+def test_xavier_scale():
+    w = _draw(init.Xavier(factor_type="avg", magnitude=3), shape=(100, 100))
+    bound = np.sqrt(3.0 / 100)
+    assert abs(w.std() - bound / np.sqrt(3)) < 0.02
+    assert w.min() >= -bound - 1e-6 and w.max() <= bound + 1e-6
+
+
+def test_msra_prelu():
+    w = _draw(init.MSRAPrelu(), shape=(128, 128))
+    assert w.std() > 0
+
+
+def test_bilinear_upsampling_kernel():
+    w = _draw(init.Bilinear(), shape=(1, 1, 4, 4))
+    # symmetric, peak at center
+    k = w[0, 0]
+    np.testing.assert_allclose(k, k[::-1, ::-1], atol=1e-6)
+
+
+def test_lstmbias_forget_gate():
+    b = _draw(init.LSTMBias(forget_bias=1.0), name="lstm_bias",
+              shape=(4 * 8,))
+    # the forget-gate quarter is 1, everything else 0
+    quarters = b.reshape(4, 8)
+    sums = quarters.sum(1)
+    assert (sums > 0).sum() == 1
+
+
+def test_name_dispatch_bias_gamma():
+    ini = init.Normal(1.0)
+    assert (_draw(ini, name="fc_bias", shape=(8,)) == 0).all()
+    assert (_draw(ini, name="bn_gamma", shape=(8,)) == 1).all()
+    assert (_draw(ini, name="bn_running_var", shape=(8,)) == 1).all()
+
+
+def test_mixed():
+    ini = init.Mixed([".*special.*", ".*"],
+                     [init.One(), init.Zero()])
+    assert (_draw(ini, name="special_weight", shape=(4,)) == 1).all()
+    assert (_draw(ini, name="plain_weight", shape=(4,)) == 0).all()
+
+
+def test_create_by_name():
+    ini = initializer.create("xavier", magnitude=2)
+    assert isinstance(ini, init.Xavier)
+
+
+def test_block_initialize_uses_initializer():
+    from mxnet_tpu.gluon import nn
+    net = nn.Dense(4, in_units=4)
+    net.initialize(init.One())
+    assert (net.weight.data().asnumpy() == 1).all()
+    assert (net.bias.data().asnumpy() == 0).all()

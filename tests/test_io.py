@@ -1,0 +1,81 @@
+"""mx.io iterator tests (SURVEY.md §2 #29)."""
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu import io as mio
+
+
+def test_ndarrayiter_batches():
+    x = np.arange(20, dtype=np.float32).reshape(10, 2)
+    y = np.arange(10, dtype=np.float32)
+    it = mio.NDArrayIter(x, y, batch_size=4, shuffle=False)
+    batches = list(it)
+    assert len(batches) == 3  # 10/4 -> pad to 12
+    b0 = batches[0]
+    np.testing.assert_allclose(b0.data[0].asnumpy(), x[:4])
+    np.testing.assert_allclose(b0.label[0].asnumpy(), y[:4])
+    assert batches[-1].pad == 2
+
+
+def test_ndarrayiter_discard_and_rollover():
+    x = np.arange(10, dtype=np.float32)
+    it = mio.NDArrayIter(x, None, batch_size=4, last_batch_handle="discard")
+    assert len(list(it)) == 2
+    it.reset()
+    assert len(list(it)) == 2
+
+
+def test_ndarrayiter_shuffle_reproducible_cover():
+    x = np.arange(8, dtype=np.float32)
+    it = mio.NDArrayIter(x, None, batch_size=4, shuffle=True)
+    seen = np.concatenate([b.data[0].asnumpy() for b in it])
+    np.testing.assert_array_equal(np.sort(seen), x)
+
+
+def test_ndarrayiter_dict_data():
+    data = {"a": np.zeros((6, 2), np.float32), "b": np.ones((6, 3), np.float32)}
+    it = mio.NDArrayIter(data, batch_size=3)
+    descs = it.provide_data
+    names = sorted(d.name for d in descs)
+    assert names == ["a", "b"]
+
+
+def test_resizeiter():
+    x = np.arange(8, dtype=np.float32)
+    base = mio.NDArrayIter(x, None, batch_size=4)
+    it = mio.ResizeIter(base, 5)
+    assert len(list(it)) == 5  # rolls over the underlying iterator
+
+
+def test_prefetchingiter():
+    x = np.arange(16, dtype=np.float32)
+    base = mio.NDArrayIter(x, None, batch_size=4)
+    it = mio.PrefetchingIter(base)
+    batches = list(it)
+    assert len(batches) == 4
+    seen = np.concatenate([b.data[0].asnumpy() for b in batches])
+    np.testing.assert_array_equal(np.sort(seen), x)
+
+
+def test_csviter():
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "data.csv")
+        arr = np.arange(12, dtype=np.float32).reshape(6, 2)
+        np.savetxt(path, arr, delimiter=",")
+        it = mio.CSVIter(data_csv=path, data_shape=(2,), batch_size=3)
+        batches = list(it)
+        assert len(batches) == 2
+        np.testing.assert_allclose(batches[0].data[0].asnumpy(), arr[:3])
+
+
+def test_imagerecorditer_synthetic():
+    it = mio.ImageRecordIter(batch_size=2, data_shape=(3, 16, 16),
+                             label_width=1, num_samples=6)
+    batches = list(it)
+    assert len(batches) == 3
+    assert batches[0].data[0].shape == (2, 3, 16, 16)

@@ -1,0 +1,70 @@
+"""KVStore tests (SURVEY.md §2 #28)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, kvstore
+
+
+def test_create_kinds():
+    assert kvstore.create("local").type == "local"
+    assert kvstore.create("device").type == "device"
+    assert kvstore.create("nccl").type == "device"
+    assert kvstore.create("dist_sync").type == "ici"
+    with pytest.raises(Exception):
+        kvstore.create("bogus")
+
+
+def test_init_push_pull_aggregation():
+    kv = kvstore.create("local")
+    kv.init("w", nd.zeros((4,)))
+    kv.push("w", [nd.ones((4,)), nd.ones((4,)) * 2])  # device grads sum
+    out = nd.zeros((4,))
+    kv.pull("w", out=out)
+    np.testing.assert_allclose(out.asnumpy(), np.full(4, 3.0))
+
+
+def test_pushpull_and_multiple_keys():
+    kv = kvstore.create("device")
+    kv.init(["a", "b"], [nd.zeros((2,)), nd.zeros((2,))])
+    kv.push(["a", "b"], [[nd.ones((2,))], [nd.ones((2,)) * 5]])
+    outs = kv.pull(["a", "b"])
+    np.testing.assert_allclose(outs[0].asnumpy(), [1, 1])
+    np.testing.assert_allclose(outs[1].asnumpy(), [5, 5])
+
+
+def test_optimizer_offload():
+    """set_optimizer makes push apply the update instead of overwriting."""
+    kv = kvstore.create("local")
+    kv.set_optimizer(mx.optimizer.create("sgd", learning_rate=0.5))
+    w0 = nd.ones((3,))
+    kv.init(0, w0)
+    kv.push(0, [nd.ones((3,))])           # grad = 1 -> w = 1 - 0.5
+    out = nd.zeros((3,))
+    kv.pull(0, out=out)
+    np.testing.assert_allclose(out.asnumpy(), np.full(3, 0.5))
+
+
+def test_rank_and_workers_single_process():
+    kv = kvstore.create("ici")
+    assert kv.rank == 0
+    assert kv.num_workers == 1
+
+
+def test_row_sparse_raises():
+    kv = kvstore.create("local")
+    with pytest.raises(Exception):
+        kv.row_sparse_pull("x")
+
+
+def test_ici_mesh_allreduce():
+    """ici kvstore push over an 8-device mesh = psum of per-device shards."""
+    import jax
+    from mxnet_tpu.parallel.mesh import make_mesh
+    kv = kvstore.create("ici").set_mesh(make_mesh({"dp": 8}))
+    kv.init("g", nd.zeros((8, 2)))
+    vals = [nd.array(np.full((8, 2), float(i))) for i in range(2)]
+    kv.push("g", vals)
+    out = nd.zeros((8, 2))
+    kv.pull("g", out=out)
+    np.testing.assert_allclose(out.asnumpy(), np.full((8, 2), 1.0))

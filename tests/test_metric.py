@@ -1,0 +1,84 @@
+"""Metric tests (SURVEY.md §2 #27)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu import metric
+
+
+def test_accuracy():
+    m = metric.Accuracy()
+    m.update(nd.array([0, 1, 1]), nd.array([[0.9, 0.1], [0.2, 0.8],
+                                            [0.7, 0.3]]))
+    assert abs(m.get()[1] - 2.0 / 3) < 1e-6
+    m.reset()
+    assert np.isnan(m.get()[1])
+
+
+def test_topk_accuracy():
+    m = metric.TopKAccuracy(top_k=2)
+    pred = nd.array([[0.1, 0.2, 0.7], [0.6, 0.3, 0.1]])
+    m.update(nd.array([1, 2]), pred)
+    assert abs(m.get()[1] - 0.5) < 1e-6
+
+
+def test_f1_and_mcc():
+    f1 = metric.F1()
+    mcc = metric.MCC()
+    labels = nd.array([1, 1, 0, 0])
+    preds = nd.array([[0.2, 0.8], [0.6, 0.4], [0.9, 0.1], [0.3, 0.7]])
+    f1.update(labels, preds)
+    mcc.update(labels, preds)
+    # tp=1 fn=1 tn=1 fp=1 -> precision=recall=0.5 -> f1=0.5, mcc=0
+    assert abs(f1.get()[1] - 0.5) < 1e-6
+    assert abs(mcc.get()[1]) < 1e-6
+
+
+def test_mae_mse_rmse():
+    labels = nd.array([1.0, 2.0, 3.0])
+    preds = nd.array([2.0, 2.0, 5.0])
+    for name, want in (("mae", 1.0), ("mse", 5.0 / 3),
+                       ("rmse", np.sqrt(5.0 / 3))):
+        m = metric.create(name)
+        m.update(labels, preds)
+        assert abs(m.get()[1] - want) < 1e-5, name
+
+
+def test_cross_entropy_and_nll_perplexity():
+    labels = nd.array([0, 1])
+    preds = nd.array([[0.5, 0.5], [0.5, 0.5]])
+    ce = metric.CrossEntropy()
+    ce.update(labels, preds)
+    assert abs(ce.get()[1] - np.log(2)) < 1e-5
+    pp = metric.Perplexity(ignore_label=None)
+    pp.update(labels, preds)
+    assert abs(pp.get()[1] - 2.0) < 1e-4
+
+
+def test_pearson():
+    m = metric.PearsonCorrelation()
+    x = np.arange(10, dtype=np.float32)
+    m.update(nd.array(x), nd.array(2 * x + 1))
+    assert abs(m.get()[1] - 1.0) < 1e-5
+
+
+def test_composite_and_custom():
+    comp = metric.CompositeEvalMetric()
+    comp.add(metric.Accuracy())
+    comp.add(metric.TopKAccuracy(top_k=2))
+    comp.update(nd.array([1]), nd.array([[0.1, 0.9]]))
+    names, values = zip(*comp.get_name_value())
+    assert "accuracy" in names and "top_k_accuracy_2" in names
+
+    cust = metric.CustomMetric(lambda label, pred: float(np.sum(label)),
+                               name="sumlabel")
+    cust.update(nd.array([1.0, 2.0]), nd.array([0.0, 0.0]))
+    assert abs(cust.get()[1] - 3.0) < 1e-6
+
+
+def test_create_by_name():
+    m = metric.create("accuracy")
+    assert isinstance(m, metric.Accuracy)
+    m2 = metric.create("top_k_accuracy", top_k=3)
+    assert m2.top_k == 3

@@ -1,0 +1,79 @@
+"""Module API tests (SURVEY.md §2 #13): bind/init/fit/predict/checkpoint."""
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, sym, io as mio
+
+
+def _softmax_mlp():
+    data = sym.Variable("data")
+    w1, b1 = sym.Variable("w1"), sym.Variable("b1")
+    h = sym.Activation(sym.FullyConnected(data, w1, b1, num_hidden=16),
+                       act_type="relu")
+    w2, b2 = sym.Variable("w2"), sym.Variable("b2")
+    out = sym.FullyConnected(h, w2, b2, num_hidden=3)
+    return sym.SoftmaxOutput(out, sym.Variable("softmax_label"))
+
+
+def _toy_iter(n=96, batch=32, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, 6).astype(np.float32)
+    w = rng.randn(6, 3).astype(np.float32)
+    y = np.argmax(x @ w, 1).astype(np.float32)
+    return mio.NDArrayIter(x, y, batch_size=batch, label_name="softmax_label")
+
+
+def test_bind_and_forward():
+    mod = mx.mod.Module(_softmax_mlp(), data_names=("data",),
+                        label_names=("softmax_label",))
+    it = _toy_iter()
+    mod.bind([(d.name, d.shape) for d in it.provide_data],
+             [(l.name, l.shape) for l in it.provide_label])
+    mod.init_params()
+    batch = next(iter(it))
+    mod.forward(batch)
+    out = mod.get_outputs()[0]
+    assert out.shape == (32, 3)
+    np.testing.assert_allclose(out.asnumpy().sum(1), np.ones(32), rtol=1e-4)
+
+
+def test_fit_converges():
+    mod = mx.mod.Module(_softmax_mlp())
+    it = _toy_iter()
+    mod.fit(it, num_epoch=30, optimizer="adam",
+            optimizer_params={"learning_rate": 0.01})
+    m = mx.metric.Accuracy()
+    mod.score(_toy_iter(), m)
+    assert m.get()[1] > 0.8, m.get()
+
+
+def test_predict():
+    mod = mx.mod.Module(_softmax_mlp())
+    it = _toy_iter()
+    mod.fit(it, num_epoch=2)
+    preds = mod.predict(_toy_iter())
+    assert preds.shape[0] == 96
+
+
+def test_save_load_checkpoint():
+    with tempfile.TemporaryDirectory() as d:
+        prefix = os.path.join(d, "mod")
+        mod = mx.mod.Module(_softmax_mlp())
+        it = _toy_iter()
+        mod.fit(it, num_epoch=2)
+        mod.save_checkpoint(prefix, 2)
+        arg1, _ = mod.get_params()
+        mod2 = mx.mod.Module.load(prefix, 2)
+        it2 = _toy_iter()
+        mod2.bind([(dd.name, dd.shape) for dd in it2.provide_data],
+                  [(l.name, l.shape) for l in it2.provide_label])
+        mod2.init_params(arg_params=mod2._loaded_params[0],
+                         aux_params=mod2._loaded_params[1])
+        arg2, _ = mod2.get_params()
+        for k in arg1:
+            np.testing.assert_allclose(arg1[k].asnumpy(), arg2[k].asnumpy(),
+                                       rtol=1e-5)

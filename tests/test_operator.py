@@ -1,0 +1,195 @@
+"""Operator numerics (SURVEY.md §2 #3-4, #7-8) vs numpy and torch-cpu
+closed forms."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd
+
+
+def test_arithmetic_broadcast():
+    a = nd.array([[1.0, 2.0], [3.0, 4.0]])
+    b = nd.array([10.0, 20.0])
+    np.testing.assert_allclose((a + b).asnumpy(), [[11, 22], [13, 24]])
+    np.testing.assert_allclose((a * b).asnumpy(), [[10, 40], [30, 80]])
+    np.testing.assert_allclose((b / a).asnumpy(), [[10, 10], [10 / 3, 5]])
+    np.testing.assert_allclose((a - 1).asnumpy(), [[0, 1], [2, 3]])
+    np.testing.assert_allclose((2 ** a).asnumpy(), [[2, 4], [8, 16]])
+    np.testing.assert_allclose((a == a).asnumpy(), np.ones((2, 2)))
+    np.testing.assert_allclose((a > 2).asnumpy(), [[0, 0], [1, 1]])
+
+
+def test_inplace_ops():
+    a = nd.ones((3,))
+    a += 2
+    np.testing.assert_allclose(a.asnumpy(), [3, 3, 3])
+    a[:] = 7
+    np.testing.assert_allclose(a.asnumpy(), [7, 7, 7])
+    a *= 2
+    np.testing.assert_allclose(a.asnumpy(), [14, 14, 14])
+
+
+def test_reduce_ops():
+    x = nd.array(np.arange(24, dtype=np.float32).reshape(2, 3, 4))
+    xn = x.asnumpy()
+    np.testing.assert_allclose(x.sum().asnumpy(), xn.sum())
+    np.testing.assert_allclose(x.mean(axis=1).asnumpy(), xn.mean(1))
+    np.testing.assert_allclose(x.max(axis=(0, 2)).asnumpy(), xn.max((0, 2)))
+    np.testing.assert_allclose(x.min().asnumpy(), 0)
+    np.testing.assert_allclose(nd.prod(x[:, :1, :1]).asnumpy(),
+                               xn[:, :1, :1].prod())
+    np.testing.assert_allclose(x.argmax(axis=2).asnumpy(), xn.argmax(2))
+    np.testing.assert_allclose(nd.norm(x).asnumpy(),
+                               np.linalg.norm(xn), rtol=1e-5)
+
+
+def test_shape_manipulation():
+    x = nd.array(np.arange(12, dtype=np.float32).reshape(3, 4))
+    assert x.reshape((2, 6)).shape == (2, 6)
+    assert x.reshape((0, 2, 2)).shape == (3, 2, 2)   # 0 = copy dim
+    assert x.reshape((-1,)).shape == (12,)
+    assert x.T.shape == (4, 3)
+    assert nd.expand_dims(x, 1).shape == (3, 1, 4)
+    c = nd.concat(x, x, dim=0)
+    assert c.shape == (6, 4)
+    s = nd.stack(x, x, axis=0)
+    assert s.shape == (2, 3, 4)
+    parts = nd.split(x, 2, axis=1)
+    assert parts[0].shape == (3, 2)
+    assert nd.flip(x, axis=1).asnumpy()[0, 0] == 3
+    t = nd.tile(x, reps=(2, 1))
+    assert t.shape == (6, 4)
+
+
+def test_indexing_slicing():
+    x = nd.array(np.arange(20, dtype=np.float32).reshape(4, 5))
+    xn = x.asnumpy()
+    np.testing.assert_allclose(x[1].asnumpy(), xn[1])
+    np.testing.assert_allclose(x[1:3].asnumpy(), xn[1:3])
+    np.testing.assert_allclose(x[:, 2].asnumpy(), xn[:, 2])
+    np.testing.assert_allclose(x[1, 2].asscalar(), 7.0)
+    np.testing.assert_allclose(
+        nd.take(x, nd.array([0, 3], dtype="int32")).asnumpy(), xn[[0, 3]])
+    np.testing.assert_allclose(
+        x.slice_axis(axis=1, begin=1, end=3).asnumpy(), xn[:, 1:3])
+
+
+def test_dot_and_batch_dot():
+    a = np.random.rand(3, 4).astype(np.float32)
+    b = np.random.rand(4, 5).astype(np.float32)
+    np.testing.assert_allclose(nd.dot(nd.array(a), nd.array(b)).asnumpy(),
+                               a @ b, rtol=1e-5)
+    ab = np.random.rand(2, 3, 4).astype(np.float32)
+    bb = np.random.rand(2, 4, 5).astype(np.float32)
+    np.testing.assert_allclose(
+        nd.batch_dot(nd.array(ab), nd.array(bb)).asnumpy(),
+        np.einsum("bij,bjk->bik", ab, bb), rtol=1e-5)
+
+
+def test_conv2d_matches_torch():
+    torch = pytest.importorskip("torch")
+    x = np.random.rand(2, 3, 8, 8).astype(np.float32)
+    w = np.random.rand(5, 3, 3, 3).astype(np.float32)
+    b = np.random.rand(5).astype(np.float32)
+    ours = nd.Convolution(nd.array(x), nd.array(w), nd.array(b),
+                          kernel=(3, 3), num_filter=5, stride=(2, 2),
+                          pad=(1, 1)).asnumpy()
+    theirs = torch.nn.functional.conv2d(
+        torch.tensor(x), torch.tensor(w), torch.tensor(b), stride=2,
+        padding=1).numpy()
+    np.testing.assert_allclose(ours, theirs, rtol=1e-4, atol=1e-5)
+
+
+def test_deconv2d_matches_torch():
+    torch = pytest.importorskip("torch")
+    x = np.random.rand(2, 4, 5, 5).astype(np.float32)
+    w = np.random.rand(4, 3, 2, 2).astype(np.float32)  # (in, out, kh, kw)
+    ours = nd.Deconvolution(nd.array(x), nd.array(w), kernel=(2, 2),
+                            num_filter=3, stride=(2, 2)).asnumpy()
+    theirs = torch.nn.functional.conv_transpose2d(
+        torch.tensor(x), torch.tensor(w), stride=2).numpy()
+    np.testing.assert_allclose(ours, theirs, rtol=1e-4, atol=1e-5)
+
+
+def test_maxpool_matches_torch():
+    torch = pytest.importorskip("torch")
+    x = np.random.rand(2, 3, 9, 9).astype(np.float32)
+    ours = nd.Pooling(nd.array(x), kernel=(3, 3), pool_type="max",
+                      stride=(2, 2), pad=(1, 1)).asnumpy()
+    theirs = torch.nn.functional.max_pool2d(
+        torch.tensor(x), 3, stride=2, padding=1).numpy()
+    np.testing.assert_allclose(ours, theirs, rtol=1e-5)
+
+
+def test_batchnorm_inference_closed_form():
+    x = np.random.rand(2, 3, 4).astype(np.float32)
+    gamma = np.array([1.0, 2.0, 0.5], np.float32)
+    beta = np.array([0.0, 1.0, -1.0], np.float32)
+    mean = np.array([0.5, 0.4, 0.3], np.float32)
+    var = np.array([1.0, 2.0, 0.5], np.float32)
+    out = nd.BatchNorm(nd.array(x), nd.array(gamma), nd.array(beta),
+                       nd.array(mean), nd.array(var), use_global_stats=True,
+                       eps=1e-5).asnumpy()
+    want = ((x - mean.reshape(1, 3, 1)) / np.sqrt(var.reshape(1, 3, 1) + 1e-5)
+            * gamma.reshape(1, 3, 1) + beta.reshape(1, 3, 1))
+    np.testing.assert_allclose(out, want, rtol=1e-4)
+
+
+def test_softmax_family():
+    x = nd.array([[1.0, 2.0, 3.0]])
+    s = nd.softmax(x).asnumpy()
+    np.testing.assert_allclose(s.sum(), 1.0, rtol=1e-6)
+    ls = nd.log_softmax(x).asnumpy()
+    np.testing.assert_allclose(np.exp(ls), s, rtol=1e-5)
+    x2 = nd.array([[1.0, 2.0], [3.0, 4.0]])
+    s0 = nd.softmax(x2, axis=0).asnumpy()
+    np.testing.assert_allclose(s0.sum(0), [1, 1], rtol=1e-6)
+
+
+def test_one_hot_where_clip():
+    oh = nd.one_hot(nd.array([0, 2], dtype="int32"), 3).asnumpy()
+    np.testing.assert_allclose(oh, [[1, 0, 0], [0, 0, 1]])
+    w = nd.where(nd.array([1.0, 0.0]), nd.array([5.0, 5.0]),
+                 nd.array([9.0, 9.0])).asnumpy()
+    np.testing.assert_allclose(w, [5, 9])
+    c = nd.clip(nd.array([-5.0, 0.5, 5.0]), 0.0, 1.0).asnumpy()
+    np.testing.assert_allclose(c, [0, 0.5, 1])
+
+
+def test_linalg_ops():
+    a = np.random.rand(4, 4).astype(np.float32) + np.eye(4, dtype=np.float32) * 4
+    sym = a @ a.T
+    l = nd.linalg.potrf(nd.array(sym)).asnumpy()
+    np.testing.assert_allclose(l @ l.T, sym, rtol=1e-3, atol=1e-3)
+    np.testing.assert_allclose(
+        nd.linalg.gemm2(nd.array(a), nd.array(a)).asnumpy(), a @ a,
+        rtol=1e-4)
+    g = nd.linalg.syrk(nd.array(a)).asnumpy()
+    np.testing.assert_allclose(g, a @ a.T, rtol=1e-3, atol=1e-4)
+
+
+def test_cast_and_dtype_prop():
+    x = nd.array([1.5, 2.5])
+    y = x.astype("int32")
+    assert y.dtype == np.int32
+    z = x.astype("bfloat16")
+    assert "bfloat16" in str(z.dtype)
+
+
+def test_grad_matches_finite_difference():
+    """backward through a composite op chain vs finite differences."""
+    xv = np.random.rand(5).astype(np.float32)
+    x = nd.array(xv)
+    x.attach_grad()
+    with autograd.record():
+        y = (nd.exp(x) * nd.sin(x) + x ** 2).sum()
+    y.backward()
+    g = x.grad.asnumpy()
+    eps = 1e-3
+    for i in range(5):
+        xp, xm = xv.copy(), xv.copy()
+        xp[i] += eps
+        xm[i] -= eps
+        fd = ((np.exp(xp) * np.sin(xp) + xp ** 2).sum()
+              - (np.exp(xm) * np.sin(xm) + xm ** 2).sum()) / (2 * eps)
+        np.testing.assert_allclose(g[i], fd, rtol=1e-2)

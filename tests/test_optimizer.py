@@ -1,0 +1,129 @@
+"""Optimizer + LR scheduler tests (SURVEY.md §2 #24-25)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu import optimizer as opt
+from mxnet_tpu import lr_scheduler as lrs
+
+ALL_OPTS = ["sgd", "nag", "adam", "adamw", "adamax", "nadam", "adagrad",
+            "adadelta", "rmsprop", "ftrl", "lamb", "lars", "signum"]
+
+
+@pytest.mark.parametrize("name", ALL_OPTS)
+def test_create_and_converge_quadratic(name):
+    """Every optimizer minimises f(w) = ||w||^2 / 2 from w0=1."""
+    o = opt.create(name, learning_rate=0.1)
+    w = nd.ones((4,))
+    state = o.create_state(0, w)
+    for _ in range(150):
+        grad = nd.array(w.asnumpy())      # df/dw = w
+        o.update(0, w, grad, state)
+    final = np.abs(w.asnumpy()).max()
+    assert final < 0.9, f"{name}: {final}"
+
+
+def test_sgd_closed_form():
+    o = opt.create("sgd", learning_rate=0.5)
+    w = nd.array([2.0])
+    o.update(0, w, nd.array([1.0]), o.create_state(0, w))
+    assert abs(float(w.asnumpy()[0]) - 1.5) < 1e-6
+
+
+def test_sgd_momentum_accumulation():
+    o = opt.create("sgd", learning_rate=1.0, momentum=0.5)
+    w = nd.array([0.0])
+    s = o.create_state(0, w)
+    o.update(0, w, nd.array([1.0]), s)     # m=1, w=-1
+    o.update(0, w, nd.array([1.0]), s)     # m=1.5, w=-2.5
+    assert abs(float(w.asnumpy()[0]) + 2.5) < 1e-6
+
+
+def test_adam_bias_correction_first_step():
+    lr, eps = 0.1, 1e-8
+    o = opt.create("adam", learning_rate=lr, epsilon=eps)
+    w = nd.array([1.0])
+    o.update(0, w, nd.array([0.5]), o.create_state(0, w))
+    # bias-corrected first step is ~ -lr * sign(g)
+    assert abs(float(w.asnumpy()[0]) - (1.0 - lr)) < 1e-3
+
+
+def test_weight_decay_and_rescale():
+    o = opt.create("sgd", learning_rate=1.0, wd=0.1, rescale_grad=0.5)
+    w = nd.array([1.0])
+    o.update(0, w, nd.array([1.0]), o.create_state(0, w))
+    # g = 1*0.5 + 0.1*1 = 0.6 -> w = 0.4
+    assert abs(float(w.asnumpy()[0]) - 0.4) < 1e-6
+
+
+def test_clip_gradient():
+    o = opt.create("sgd", learning_rate=1.0, clip_gradient=0.1)
+    w = nd.array([1.0])
+    o.update(0, w, nd.array([100.0]), o.create_state(0, w))
+    assert abs(float(w.asnumpy()[0]) - 0.9) < 1e-6
+
+
+def test_multi_precision_bf16():
+    o = opt.create("sgd", learning_rate=0.01, momentum=0.9,
+                   multi_precision=True)
+    w = nd.ones((8,), dtype="bfloat16")
+    state = o.create_state_multi_precision(0, w)
+    assert str(state[0].dtype).endswith("float32")  # fp32 master copy
+    for _ in range(5):
+        o.update_multi_precision(0, w, nd.ones((8,), dtype="bfloat16"), state)
+    assert w.dtype == np.dtype("bfloat16") or "bfloat16" in str(w.dtype)
+    # master tracks more precision than bf16 steps would
+    assert float(state[0].asnumpy()[0]) < 1.0
+
+
+def test_lr_mult_and_set_lr():
+    o = opt.create("sgd", learning_rate=1.0)
+    o.set_lr_mult({0: 0.1})
+    w = nd.array([1.0])
+    o.update(0, w, nd.array([1.0]), o.create_state(0, w))
+    assert abs(float(w.asnumpy()[0]) - 0.9) < 1e-6
+    o.set_learning_rate(2.0)
+    assert o.learning_rate == 2.0
+
+
+def test_factor_scheduler():
+    s = lrs.FactorScheduler(step=10, factor=0.5, base_lr=1.0)
+    assert s(0) == 1.0
+    assert abs(s(10) - 0.5) < 1e-9
+    assert abs(s(20) - 0.25) < 1e-9
+
+
+def test_multifactor_scheduler():
+    s = lrs.MultiFactorScheduler(step=[5, 15], factor=0.1, base_lr=1.0)
+    assert s(0) == 1.0
+    assert abs(s(6) - 0.1) < 1e-9
+    assert abs(s(16) - 0.01) < 1e-9
+
+
+def test_poly_and_cosine_schedulers():
+    p = lrs.PolyScheduler(max_update=100, base_lr=1.0, final_lr=0.0, pwr=1)
+    assert abs(p(50) - 0.5) < 1e-6
+    c = lrs.CosineScheduler(max_update=100, base_lr=1.0, final_lr=0.0)
+    assert abs(c(0) - 1.0) < 1e-6
+    assert abs(c(100)) < 1e-6
+    assert abs(c(50) - 0.5) < 1e-2
+
+
+def test_warmup():
+    s = lrs.CosineScheduler(max_update=100, base_lr=1.0,
+                            warmup_steps=10, warmup_begin_lr=0.0)
+    assert s(0) < s(5) < s(10)
+    assert abs(s(10) - 1.0) < 0.11
+
+
+def test_optimizer_with_scheduler():
+    sch = lrs.FactorScheduler(step=1, factor=0.5, base_lr=1.0)
+    o = opt.create("sgd", learning_rate=1.0, lr_scheduler=sch)
+    w = nd.array([10.0])
+    s = o.create_state(0, w)
+    o.update(0, w, nd.array([1.0]), s)
+    first = float(w.asnumpy()[0])
+    o.update(0, w, nd.array([1.0]), s)
+    second = first - float(w.asnumpy()[0])
+    assert second < (10.0 - first)  # lr decayed between steps

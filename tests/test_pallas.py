@@ -1,0 +1,92 @@
+"""Pallas kernel tests (SURVEY.md §2 #42). On the CPU test mesh the kernels
+fall back to the XLA reference path — these tests pin the numerics and the
+custom-vjp wiring; the Pallas fast path is exercised on real TPU by bench.py."""
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from mxnet_tpu.ops.pallas_kernels import (flash_attention,
+                                          attention_reference,
+                                          fused_layer_norm, on_tpu)
+from mxnet_tpu.ops.nn_ops import layer_norm
+
+
+def _qkv(b=2, h=2, s=128, d=16, dtype=jnp.float32):
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    return tuple(jax.random.normal(k, (b, h, s, d), dtype) for k in ks)
+
+
+def test_attention_reference_is_softmax_attention():
+    q, k, v = _qkv(s=8)
+    out = attention_reference(q, k, v)
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    want = jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, -1), v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(want), rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_flash_matches_reference():
+    q, k, v = _qkv()
+    for causal in (False, True):
+        got = flash_attention(q, k, v, causal)
+        want = attention_reference(q, k, v, causal=causal)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-4, atol=2e-5)
+
+
+def test_flash_causality():
+    """Future K/V must not influence causal outputs."""
+    q, k, v = _qkv(s=16)
+    out1 = flash_attention(q, k, v, True)
+    k2 = k.at[:, :, 8:].set(999.0)
+    v2 = v.at[:, :, 8:].set(-999.0)
+    out2 = flash_attention(q, k2, v2, True)
+    np.testing.assert_allclose(np.asarray(out1[:, :, :8]),
+                               np.asarray(out2[:, :, :8]), rtol=1e-5)
+
+
+def test_flash_grad_matches_reference_grad():
+    q, k, v = _qkv(s=32)
+
+    def f_flash(q, k, v):
+        return (flash_attention(q, k, v, True) ** 2).sum()
+
+    def f_ref(q, k, v):
+        return (attention_reference(q, k, v, causal=True) ** 2).sum()
+
+    g1 = jax.grad(f_flash, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-4,
+                                   atol=2e-5)
+
+
+def test_fused_layer_norm_matches_unfused():
+    x = jax.random.normal(jax.random.PRNGKey(0), (64, 128))
+    g = jax.random.normal(jax.random.PRNGKey(1), (128,))
+    b = jax.random.normal(jax.random.PRNGKey(2), (128,))
+    got = fused_layer_norm(x, g, b)
+    want = layer_norm(x, g, b, axis=-1)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_flash_bf16():
+    q, k, v = _qkv(dtype=jnp.bfloat16)
+    out = flash_attention(q, k, v)
+    assert out.dtype == jnp.bfloat16
+    want = attention_reference(q.astype(jnp.float32), k.astype(jnp.float32),
+                               v.astype(jnp.float32))
+    np.testing.assert_allclose(np.asarray(out, dtype=np.float32),
+                               np.asarray(want), rtol=0.05, atol=0.05)
+
+
+def test_flash_odd_length_fallback():
+    """Non-128-multiple sequence takes the XLA path but stays correct."""
+    q, k, v = _qkv(s=100)
+    got = flash_attention(q, k, v, True)
+    want = attention_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-4,
+                               atol=2e-5)

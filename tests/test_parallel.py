@@ -1,0 +1,159 @@
+"""Parallelism tests on the virtual 8-device CPU mesh (SURVEY.md §2 #37-41):
+each strategy must match its single-device reference numerically."""
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd, gluon
+from mxnet_tpu.parallel.mesh import make_mesh, shard_batch
+from mxnet_tpu.parallel.ring_attention import ring_attention as _ring_attn
+from mxnet_tpu.parallel import tensor_parallel as tp
+from mxnet_tpu.parallel import pipeline as pp
+from mxnet_tpu.parallel import moe as moe_mod
+from mxnet_tpu.ops.pallas_kernels import attention_reference
+
+
+def test_make_mesh_and_shard_batch():
+    mesh = make_mesh({"dp": 4, "tp": 2})
+    assert dict(mesh.shape) == {"dp": 4, "tp": 2}
+    x = jnp.arange(32.0).reshape(8, 4)
+    xs = shard_batch(mesh, x, "dp")
+    np.testing.assert_allclose(np.asarray(xs), np.asarray(x))
+
+
+def test_ring_attention_matches_reference():
+    mesh = make_mesh({"sp": 8})
+    B, H, S, D = 2, 2, 64, 8
+    key = jax.random.PRNGKey(0)
+    q, k, v = (jax.random.normal(kk, (B, H, S, D))
+               for kk in jax.random.split(key, 3))
+    for causal in (False, True):
+        ref = attention_reference(q, k, v, causal=causal)
+        ring = shard_map(
+            lambda q_, k_, v_: _ring_attn(q_, k_, v_, "sp", causal=causal),
+            mesh=mesh,
+            in_specs=(P(None, None, "sp", None),) * 3,
+            out_specs=P(None, None, "sp", None))(q, k, v)
+        np.testing.assert_allclose(np.asarray(ring), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-5)
+
+
+def test_data_parallel_step_matches_single_device():
+    from mxnet_tpu.parallel.data_parallel import make_train_step
+    from mxnet_tpu.gluon import nn
+
+    def build():
+        net = nn.HybridSequential()
+        net.add(nn.Dense(16, activation="relu", in_units=8),
+                nn.Dense(3, in_units=16))
+        net.initialize(mx.init.Xavier())
+        return net
+
+    mx.random.seed(3)
+    net_a = build()
+    # copy weights into net_b
+    net_b = build()
+    for (ka, pa), (kb, pb) in zip(net_a.collect_params().items(),
+                                  net_b.collect_params().items()):
+        # deep copy: the dp step donates its input buffers
+        pb.set_data(nd.array(pa.data().asnumpy()))
+
+    loss = gluon.loss.SoftmaxCrossEntropyLoss()
+    o1 = mx.optimizer.create("sgd", learning_rate=0.1)
+    o2 = mx.optimizer.create("sgd", learning_rate=0.1)
+    x = jax.random.normal(jax.random.PRNGKey(1), (16, 8))
+    y = jax.random.randint(jax.random.PRNGKey(2), (16,), 0, 3)
+
+    step_1, init_1 = make_train_step(net_a, loss, o1)
+    s1 = init_1()
+    s1, l1 = step_1(s1, x, y, 0.1, jax.random.PRNGKey(0))
+
+    mesh = make_mesh({"dp": 8})
+    step_8, init_8 = make_train_step(net_b, loss, o2, mesh=mesh)
+    s8 = init_8()
+    s8, l8 = step_8(s8, shard_batch(mesh, x), shard_batch(mesh, y), 0.1,
+                    jax.random.PRNGKey(0))
+    assert abs(float(l1) - float(l8)) < 1e-5
+    # the two nets carry different auto-prefixes; match params positionally
+    for n1, n8 in zip(sorted(s1[0]), sorted(s8[0])):
+        np.testing.assert_allclose(np.asarray(s1[0][n1]),
+                                   np.asarray(s8[0][n8]), rtol=1e-5,
+                                   atol=1e-6, err_msg=f"{n1} vs {n8}")
+
+
+def test_tensor_parallel_dense_matches_dense():
+    mesh = make_mesh({"tp": 8})
+    key = jax.random.PRNGKey(0)
+    x = jax.random.normal(key, (4, 16))
+    w1 = jax.random.normal(jax.random.PRNGKey(1), (32, 16)) * 0.1
+    w2 = jax.random.normal(jax.random.PRNGKey(2), (16, 32)) * 0.1
+    want = jnp.matmul(jax.nn.relu(jnp.matmul(x, w1.T)), w2.T)
+
+    def fn(x_, w1_, w2_):
+        h = jax.nn.relu(tp.column_parallel_dense(x_, w1_, mesh=mesh))
+        return tp.row_parallel_dense(h, w2_, mesh=mesh)
+
+    with mesh:
+        got = jax.jit(fn, in_shardings=(
+            NamedSharding(mesh, P()),
+            NamedSharding(mesh, P("tp", None)),
+            NamedSharding(mesh, P(None, "tp"))))(x, w1, w2)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_pipeline_matches_sequential():
+    mesh = make_mesh({"pp": 4})
+    key = jax.random.PRNGKey(0)
+    ws = [jax.random.normal(k, (8, 8)) * 0.3
+          for k in jax.random.split(key, 4)]
+    stacked = pp.stack_stage_params([{"w": w} for w in ws])
+    x = jax.random.normal(jax.random.PRNGKey(9), (6, 4, 8))  # (micro, mb, D)
+
+    def stage_fn(params, h):
+        return jnp.tanh(jnp.matmul(h, params["w"]))
+
+    got = pp.pipeline_apply(stage_fn, stacked, x, mesh)
+    want = x
+    for w in ws:
+        want = jnp.tanh(jnp.matmul(want, w))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_moe_sharded_matches_dense():
+    mesh = make_mesh({"ep": 4})
+    params = moe_mod.init_moe_params(jax.random.PRNGKey(0), 4, 8, 16)
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 8, 8))
+    # capacity >= tokens so nothing drops; sharded == unsharded
+    out_ref, aux_ref = moe_mod.moe_ffn(params, x, capacity_factor=4.0)
+    specs = moe_mod.moe_param_specs()
+    sharded = jax.tree_util.tree_map(
+        lambda v, s: jax.device_put(v, NamedSharding(mesh, s)), params, specs)
+    with mesh:
+        out_sh, aux_sh = jax.jit(
+            lambda p, xx: moe_mod.moe_ffn(p, xx, capacity_factor=4.0))(
+            sharded, x)
+    np.testing.assert_allclose(np.asarray(out_sh), np.asarray(out_ref),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_trainer_kvstore_dp_allreduce():
+    """gluon.Trainer with kvstore aggregates multi-device grads."""
+    from mxnet_tpu.gluon import nn
+    net = nn.Dense(2, in_units=2)
+    net.initialize()
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 1.0}, kvstore="local")
+    w0 = net.weight.data().asnumpy().copy()
+    x = nd.ones((4, 2))
+    with autograd.record():
+        loss = net(x).sum()
+    loss.backward()
+    tr.step(4)
+    w1 = net.weight.data().asnumpy()
+    assert not np.allclose(w0, w1)

@@ -1,0 +1,69 @@
+"""mx.random tests (SURVEY.md §2 #31)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def test_seed_reproducibility():
+    mx.random.seed(7)
+    a = mx.random.uniform(shape=(16,)).asnumpy()
+    mx.random.seed(7)
+    b = mx.random.uniform(shape=(16,)).asnumpy()
+    np.testing.assert_array_equal(a, b)
+    c = mx.random.uniform(shape=(16,)).asnumpy()
+    assert not np.array_equal(b, c)  # key chain advances
+
+
+def test_uniform_range_and_moments():
+    x = mx.random.uniform(-2, 3, shape=(5000,)).asnumpy()
+    assert x.min() >= -2 and x.max() <= 3
+    assert abs(x.mean() - 0.5) < 0.1
+
+
+def test_normal_moments():
+    x = mx.random.normal(1.0, 2.0, shape=(5000,)).asnumpy()
+    assert abs(x.mean() - 1.0) < 0.15
+    assert abs(x.std() - 2.0) < 0.15
+
+
+def test_randint():
+    x = mx.random.randint(0, 10, shape=(1000,)).asnumpy()
+    assert x.min() >= 0 and x.max() <= 9
+    assert set(np.unique(x)) == set(range(10))
+
+
+def test_gamma_exponential_poisson():
+    g = mx.random.gamma(2.0, 2.0, shape=(3000,)).asnumpy()
+    assert g.min() > 0 and abs(g.mean() - 4.0) < 0.5
+    e = mx.random.exponential(2.0, shape=(3000,)).asnumpy()
+    assert e.min() >= 0 and abs(e.mean() - 2.0) < 0.3
+    p = mx.random.poisson(3.0, shape=(3000,)).asnumpy()
+    assert abs(p.mean() - 3.0) < 0.3
+
+
+def test_multinomial():
+    probs = nd.array([0.0, 0.3, 0.7])
+    s = mx.random.multinomial(probs, shape=2000).asnumpy().ravel()
+    assert (s == 0).sum() == 0
+    assert abs((s == 2).mean() - 0.7) < 0.1
+
+
+def test_shuffle():
+    x = nd.arange(100)
+    y = mx.random.shuffle(x).asnumpy()
+    assert not np.array_equal(y, np.arange(100))
+    np.testing.assert_array_equal(np.sort(y), np.arange(100))
+
+
+def test_nd_random_namespace():
+    assert nd.random.uniform(shape=(3,)).shape == (3,)
+    assert nd.random.normal(shape=(2, 2)).shape == (2, 2)
+
+
+def test_dtype_and_ctx():
+    x = mx.random.uniform(shape=(4,), dtype="float32")
+    assert x.dtype == np.float32
+    b = mx.random.bernoulli(0.5, shape=(1000,)).asnumpy()
+    assert set(np.unique(b)) <= {0.0, 1.0}

@@ -1,0 +1,110 @@
+"""Symbol API tests (SURVEY.md §2 #12)."""
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, sym
+
+
+def _mlp():
+    data = sym.Variable("data")
+    w1 = sym.Variable("w1")
+    b1 = sym.Variable("b1")
+    h = sym.Activation(sym.FullyConnected(data, w1, b1, num_hidden=8),
+                       act_type="relu")
+    w2 = sym.Variable("w2")
+    b2 = sym.Variable("b2")
+    return sym.FullyConnected(h, w2, b2, num_hidden=3)
+
+
+def test_variable_and_arguments():
+    out = _mlp()
+    args = out.list_arguments()
+    assert args == ["data", "w1", "b1", "w2", "b2"]
+    assert len(out.list_outputs()) == 1
+
+
+def test_infer_shape():
+    out = _mlp()
+    arg_shapes, out_shapes, _ = out.infer_shape(
+        data=(2, 4), w1=(8, 4), b1=(8,), w2=(3, 8), b2=(3,))
+    assert out_shapes == [(2, 3)]
+
+
+def test_executor_forward_backward():
+    out = _mlp()
+    rng = np.random.RandomState(0)
+    args = {"data": nd.array(rng.rand(2, 4)),
+            "w1": nd.array(rng.rand(8, 4)), "b1": nd.zeros((8,)),
+            "w2": nd.array(rng.rand(3, 8)), "b2": nd.zeros((3,))}
+    grads = {k: nd.zeros(v.shape) for k, v in args.items()}
+    ex = out.bind(None, args, grads)
+    y = ex.forward(is_train=True)
+    y0 = y[0] if isinstance(y, (list, tuple)) else y
+    assert y0.shape == (2, 3)
+    ex.backward(nd.ones((2, 3)))
+    assert np.abs(grads["w1"].asnumpy()).sum() > 0
+    assert np.abs(grads["data"].asnumpy()).sum() > 0
+
+
+def test_simple_bind():
+    out = _mlp()
+    ex = out.simple_bind(data=(2, 4), w1=(8, 4), b1=(8,), w2=(3, 8), b2=(3,))
+    y = ex.forward()
+    y0 = y[0] if isinstance(y, (list, tuple)) else y
+    assert y0.shape == (2, 3)
+
+
+def test_symbol_arithmetic_and_eval():
+    a = sym.Variable("a")
+    b = sym.Variable("b")
+    c = (a + b * 2.0) / 2.0
+    out = c.eval_with({"a": nd.array([2.0]), "b": nd.array([4.0])})
+    np.testing.assert_allclose(out.asnumpy(), [5.0])
+
+
+def test_tojson_load_roundtrip():
+    out = _mlp()
+    js = out.tojson()
+    loaded = mx.sym.load_json(js)
+    assert loaded.list_arguments() == out.list_arguments()
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "net-symbol.json")
+        out.save(path)
+        again = mx.sym.load(path)
+        assert again.list_arguments() == out.list_arguments()
+
+
+def test_get_internals_and_group():
+    out = _mlp()
+    internals = out.get_internals()
+    names = internals.list_outputs()
+    assert any("fullyconnected" in n.lower() or "FullyConnected" in n
+               for n in names) or len(names) > 3
+
+
+def test_symbolblock_from_symbol():
+    from mxnet_tpu.gluon import SymbolBlock, nn
+    data = sym.Variable("data")
+    w = sym.Variable("w")
+    out = sym.FullyConnected(data, w, None, num_hidden=4, no_bias=True)
+    from mxnet_tpu.gluon.parameter import Parameter
+    p = Parameter("w", shape=(4, 3))
+    p.initialize()
+    blk = SymbolBlock(out, [data], params={"w": p})
+    y = blk(nd.ones((2, 3)))
+    assert y.shape == (2, 4)
+
+
+def test_hybridblock_symbolic_trace():
+    """Calling a HybridBlock on a Symbol yields a Symbol graph."""
+    from mxnet_tpu.gluon import nn
+    net = nn.Dense(5, in_units=3)
+    net.initialize()
+    data = sym.Variable("data")
+    out = net(data)
+    assert hasattr(out, "list_arguments")
+    assert "data" in out.list_arguments()
