@@ -69,6 +69,7 @@ class NativeEngine:
         self._ids = itertools.count(1)
         self._lock = threading.Lock()
         self._poisoned = {}          # native var id -> exception
+        self._pending = set()        # futures not yet completed
         self._trampoline = _CB(self._run)  # must outlive all pushes
         atexit.register(self._shutdown)
 
@@ -96,13 +97,24 @@ class NativeEngine:
         return vid
 
     def push(self, fn, read_vars=(), write_vars=()):
-        read_ids = [self._var_id(v) for v in read_vars]
-        write_ids = [self._var_id(v) for v in write_vars]
+        read_ids = list(dict.fromkeys(self._var_id(v) for v in read_vars))
+        write_ids = list(dict.fromkeys(self._var_id(v) for v in write_vars))
         read_ids = [v for v in read_ids if v not in write_ids]
         fut = Future()
         key = next(self._ids)
         with self._lock:
             self._tasks[key] = (fn, fut, read_ids, write_ids)
+            self._pending.add(fut)
+        fut.add_done_callback(self._discard)
+        # Mirror _PyEngine's per-var future bookkeeping so the wait_* rethrow
+        # semantics are identical across engines (failed readers included).
+        for v in read_vars:
+            with v._lock:
+                v._reads.append(fut)
+        for v in write_vars:
+            with v._lock:
+                v._last_write = fut
+                v._reads = []
         ra = (ctypes.c_uint64 * len(read_ids))(*read_ids)
         wa = (ctypes.c_uint64 * len(write_ids))(*write_ids)
         self._lib.MXTPUEnginePush(self._h, self._trampoline,
@@ -110,14 +122,30 @@ class NativeEngine:
                                   ra, len(read_ids), wa, len(write_ids))
         return fut
 
+    def _discard(self, fut):
+        with self._lock:
+            self._pending.discard(fut)
+
     def wait_for_var(self, var):
         vid = getattr(var, "_native_id", None)
         if vid is not None and self._h:
             self._lib.MXTPUEngineWaitForVar(self._h, vid)
+        with var._lock:
+            futs = list(var._reads)
+            if var._last_write is not None:
+                futs.append(var._last_write)
+        for f in futs:
+            f.result()
 
     def wait_for_all(self):
+        # Snapshot before the native wait, exactly like _PyEngine snapshots
+        # _pending: failures in flight at call time are rethrown.
+        with self._lock:
+            futs = list(self._pending)
         if self._h:
             self._lib.MXTPUEngineWaitAll(self._h)
+        for f in futs:
+            f.result()
 
     def _shutdown(self):
         h, self._h = self._h, None

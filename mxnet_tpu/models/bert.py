@@ -235,7 +235,8 @@ _SPECS = {
 
 def get_bert(model_name="bert_12_768_12", vocab_size=30522, max_length=512,
              dropout=0.1, **kwargs):
-    check_arg(model_name in _SPECS, f"unknown bert spec {model_name}")
+    if model_name not in _SPECS:
+        raise MXNetError(f"unknown bert spec {model_name}")
     layers, units, hidden, heads = _SPECS[model_name]
     return BERTModel(vocab_size=vocab_size, units=units, hidden_size=hidden,
                      num_layers=layers, num_heads=heads, max_length=max_length,

@@ -50,10 +50,38 @@ def test_error_poisons_dependents(eng):
     fe = eng.push(boom, write_vars=[v])
     fr = eng.push(lambda: 1, read_vars=[v])
     fw = eng.push(lambda: 2, write_vars=[v])
-    eng.wait_for_all()
+    try:
+        eng.wait_for_all()
+    except RuntimeError:
+        pass  # wait may rethrow the poisoned error (ThreadedEngine::WaitForAll)
     assert fe.exception() is not None
     assert fr.exception() is not None
     assert fw.exception() is not None
+
+
+@pytest.mark.parametrize("eng", _engines(), ids=lambda e: type(e).__name__)
+def test_wait_for_var_reraises_poisoned(eng):
+    """WaitForVar rethrows a stored exception (ThreadedEngine parity) even
+    when the caller never retained the op's future."""
+    v = Var()
+
+    def boom():
+        raise RuntimeError("boom")
+
+    eng.push(boom, write_vars=[v])
+    with pytest.raises(RuntimeError, match="boom"):
+        eng.wait_for_var(v)
+
+
+@pytest.mark.parametrize("eng", _engines(), ids=lambda e: type(e).__name__)
+def test_duplicate_vars_no_deadlock(eng):
+    """A repeated write (or read) var in one push must not self-deadlock."""
+    v, r = Var(), Var()
+    fut = eng.push(lambda: 42, read_vars=[r, r], write_vars=[v, v])
+    assert fut.result(timeout=5) == 42
+    f2 = eng.push(lambda: 7, write_vars=[v])
+    assert f2.result(timeout=5) == 7
+    eng.wait_for_all()
 
 
 @pytest.mark.parametrize("eng", _engines(), ids=lambda e: type(e).__name__)
@@ -89,3 +117,18 @@ def test_facade_push_wait():
 def test_native_engine_loads():
     """The native engine must actually build+load in this environment."""
     assert engine.native_engine_loaded()
+
+
+@pytest.mark.parametrize("eng", _engines(), ids=lambda e: type(e).__name__)
+def test_wait_for_var_raises_failed_reader(eng):
+    """A failed READER's error also surfaces from wait_for_var — both
+    engines share the per-var future bookkeeping."""
+    v = Var()
+    eng.push(lambda: 1, write_vars=[v])
+
+    def boom():
+        raise RuntimeError("reader-boom")
+
+    eng.push(boom, read_vars=[v])
+    with pytest.raises(RuntimeError, match="reader-boom"):
+        eng.wait_for_var(v)

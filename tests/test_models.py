@@ -198,3 +198,19 @@ def test_detection_ops():
     out = D.roi_align(jnp.arange(32, dtype=jnp.float32).reshape(2, 4, 4),
                       jnp.array([[0, 0, 3, 3]], jnp.float32), (2, 2))
     assert out.shape == (1, 2, 2, 2)
+
+
+def test_get_bert_specs():
+    """get_bert/bert_base construct from the named spec table; unknown
+    names raise MXNetError (regression: NameError in get_bert)."""
+    import pytest
+
+    from mxnet_tpu.base import MXNetError
+    from mxnet_tpu.models.bert import bert_base, get_bert
+
+    net = bert_base(vocab_size=64, max_length=32)
+    assert net._units == 768 and len(net.encoder.layers._children) == 12
+    net2 = get_bert("bert_24_1024_16", vocab_size=64, max_length=32)
+    assert net2._units == 1024 and len(net2.encoder.layers._children) == 24
+    with pytest.raises(MXNetError):
+        get_bert("bert_unknown")

@@ -108,3 +108,19 @@ def test_hybridblock_symbolic_trace():
     out = net(data)
     assert hasattr(out, "list_arguments")
     assert "data" in out.list_arguments()
+
+
+def test_group_infer_shape():
+    """Group-headed symbols infer member shapes (module.py binds Groups)."""
+    data = sym.Variable("data")
+    w1 = sym.Variable("w1")
+    b1 = sym.Variable("b1")
+    h = sym.FullyConnected(data, w1, b1, num_hidden=8)
+    out2 = sym.Activation(h, act_type="relu")
+    g = sym.Group([h, out2])
+    arg_shapes, out_shapes, _ = g.infer_shape(data=(2, 4))
+    assert out_shapes == [(2, 8), (2, 8)]
+    assert (8, 4) in arg_shapes and (8,) in arg_shapes
+    nested = sym.Group([sym.Group([h]), out2])
+    _, out_shapes, _ = nested.infer_shape(data=(2, 4))
+    assert out_shapes == [(2, 8), (2, 8)]
