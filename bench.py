@@ -36,7 +36,7 @@ def main():
     print(f"[bench] backend={jax.default_backend()} batch={batch} "
           f"steps={steps}", file=sys.stderr)
 
-    net = resnet50_v1(layout="NHWC")
+    net = resnet50_v1(layout="NHWC", stem_s2d=True)
     net.initialize()
     net.cast("bfloat16")
     x = mx.nd.random.uniform(shape=(batch, 224, 224, 3), dtype="bfloat16")
@@ -47,17 +47,21 @@ def main():
     labels = jax.random.randint(key, (batch,), 0, 1000)
     images = x._data
 
+    aux_idx = list(fwd.aux_indices)
+
     def loss_fn(p, xb, yb):
-        logits = fwd(p, xb).astype(jnp.float32)
-        logp = jax.nn.log_softmax(logits, axis=-1)
-        return -jnp.mean(jnp.take_along_axis(logp, yb[:, None], 1))
+        logits, aux = fwd(p, xb)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        return -jnp.mean(jnp.take_along_axis(logp, yb[:, None], 1)), aux
 
     lr, mu = 0.1, 0.9
 
     def train_step(p, mom, xb, yb):
-        loss, g = jax.value_and_grad(loss_fn)(p, xb, yb)
+        (loss, aux), g = jax.value_and_grad(loss_fn, has_aux=True)(p, xb, yb)
         new_mom = [mu * m + gg.astype(m.dtype) for m, gg in zip(mom, g)]
         new_p = [pp - lr * m for pp, m in zip(p, new_mom)]
+        for i, v in zip(aux_idx, aux):  # BN running stats carry through
+            new_p[i] = v
         return new_p, new_mom, loss
 
     step = jax.jit(train_step, donate_argnums=(0, 1))

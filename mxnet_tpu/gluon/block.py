@@ -466,24 +466,42 @@ def extract_pure_fn(block, *example_args, training=False, rng_seed=0):
 
     The block must be fully initialised (run one eager forward first for
     deferred shapes). Returns `(fn, param_arrays)` where `param_arrays` is the
-    list of raw `jax.Array` leaves in `collect_params()` order. Aux-state
-    updates (BatchNorm running stats) are computed but dropped — this is the
-    inference/export path (reference analogue: exporting the nnvm symbol of a
-    hybridized net, gluon/block.py `export`).
+    list of raw `jax.Array` leaves in `collect_params()` order.
+
+    With `training=False` (the inference/export path; reference analogue:
+    exporting the nnvm symbol of a hybridized net, gluon/block.py `export`)
+    `fn(params, *xs)` returns the output array(s).
+
+    With `training=True`, aux-state updates (BatchNorm running stats) become
+    part of the result: `fn(params, *xs) -> (outputs, aux_updates)` where
+    `aux_updates[i]` is the new value for `params[fn.aux_indices[i]]`. Carry
+    them in a train loop with::
+
+        out, aux = fn(params, *xs)
+        for i, v in zip(fn.aux_indices, aux):
+            params[i] = v
     """
     params = list(block.collect_params().values())
+    idx_of = {id(p): i for i, p in enumerate(params)}
+    meta = {"aux_idx": ()}
 
     def fn(param_vals, *arg_vals):
-        outs, _aux, _seq, _lst = _run_traced(
+        outs, aux, _seq, _lst = _run_traced(
             block, params, param_vals, arg_vals, training,
             jax.random.PRNGKey(rng_seed))
+        meta["aux_idx"] = tuple(idx_of[id(p)] for p, _ in aux)
         res = tuple(o._data for o in outs)
-        return res if len(res) > 1 else res[0]
+        res = res if len(res) > 1 else res[0]
+        if not training:
+            return res
+        return res, [v._data if isinstance(v, NDArray) else v for _, v in aux]
 
     param_vals = [p.data()._data for p in params]
     # abstract-trace with the example args now so a shape/structure problem
     # surfaces here, not as an opaque error when the caller later jits fn
+    # (this also fills meta["aux_idx"] — the aux set is static per block)
     jax.eval_shape(fn, param_vals, *[a._data for a in example_args])
+    fn.aux_indices = meta["aux_idx"]
     return fn, param_vals
 
 

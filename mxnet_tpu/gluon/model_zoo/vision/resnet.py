@@ -24,6 +24,27 @@ def _conv3x3(channels, stride, in_channels, layout):
                      use_bias=False, in_channels=in_channels, layout=layout)
 
 
+class S2DStemConv(HybridBlock):
+    """Drop-in replacement for the NHWC 7x7/s2/p3 stem Conv2D that computes
+    via space-to-depth (see ops.nn_ops.stem_conv_s2d). Same parameter shape
+    (O, 7, 7, C) and identical math; much better MXU tiling. Enabled with
+    `get_resnet(..., stem_s2d=True)` (NHWC only)."""
+
+    def __init__(self, channels, in_channels=3, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.weight = self.params.get(
+                "weight", shape=(channels, 7, 7, in_channels),
+                allow_deferred_init=True)
+
+    def _infer_shapes(self, x):
+        self.weight._finish_deferred_init(
+            (self.weight.shape[0], 7, 7, x.shape[3]))
+
+    def hybrid_forward(self, F, x, weight):
+        return F.StemConvS2D(x, weight, num_filter=self.weight.shape[0])
+
+
 class BasicBlockV1(HybridBlock):
     def __init__(self, channels, stride, downsample=False, in_channels=0,
                  layout="NCHW", **kwargs):
@@ -151,9 +172,10 @@ class BottleneckV2(HybridBlock):
 
 class ResNetV1(HybridBlock):
     def __init__(self, block, layers, channels, classes=1000, thumbnail=False,
-                 layout="NCHW", **kwargs):
+                 layout="NCHW", stem_s2d=False, **kwargs):
         super().__init__(**kwargs)
         assert len(layers) == len(channels) - 1
+        assert not (stem_s2d and layout != "NHWC"), "stem_s2d needs NHWC"
         ax = _bn_axis(layout)
         self._layout = layout
         with self.name_scope():
@@ -161,8 +183,12 @@ class ResNetV1(HybridBlock):
             if thumbnail:
                 self.features.add(_conv3x3(channels[0], 1, 0, layout))
             else:
-                self.features.add(nn.Conv2D(channels[0], 7, 2, 3,
-                                            use_bias=False, layout=layout))
+                if stem_s2d:
+                    self.features.add(S2DStemConv(channels[0]))
+                else:
+                    self.features.add(nn.Conv2D(channels[0], 7, 2, 3,
+                                                use_bias=False,
+                                                layout=layout))
                 self.features.add(nn.BatchNorm(axis=ax))
                 self.features.add(nn.Activation("relu"))
                 self.features.add(nn.MaxPool2D(3, 2, 1, layout=layout))

@@ -14,7 +14,8 @@ import numpy as _np
 from ..ops import nn_ops as K
 from .symbol import Symbol, _make, register_op, register_shape_rule
 
-__all__ = ["FullyConnected", "Convolution", "Activation", "BatchNorm",
+__all__ = ["FullyConnected", "Convolution", "StemConvS2D", "Activation",
+           "BatchNorm",
            "LayerNorm", "Pooling", "Dropout", "Embedding", "softmax",
            "log_softmax", "SoftmaxOutput", "flatten", "Flatten", "reshape",
            "transpose", "concat", "Concat", "dot", "batch_dot", "sum", "mean",
@@ -69,6 +70,8 @@ register_op("Convolution",
             num_filter=None, num_group=1, no_bias=False, layout=None:
             K.convolution(x, w, b[0] if b else None, stride, pad, dilate,
                           num_group, layout))
+register_op("StemConvS2D",
+            lambda x, w, num_filter=None: K.stem_conv_s2d(x, w))
 register_op("Activation", lambda x, act_type="relu": K.activation(x, act_type))
 register_op("BatchNorm",
             lambda x, g, b, mm, mv, eps=1e-5, momentum=0.9, axis=1,
@@ -162,6 +165,9 @@ def _embed_shapes(ins, attrs):
 
 register_shape_rule("FullyConnected", _fc_shapes)
 register_shape_rule("Convolution", _conv_shapes)
+register_shape_rule("StemConvS2D",
+                    lambda ins, attrs: ins if ins[0] is None
+                    else [ins[0], (attrs["num_filter"], 7, 7, ins[0][3])])
 register_shape_rule("BatchNorm", _norm_shapes)
 register_shape_rule("LayerNorm", _ln_shapes)
 register_shape_rule("Embedding", _embed_shapes)
@@ -174,6 +180,11 @@ def FullyConnected(data, weight=None, bias=None, num_hidden=None,
     return _make("FullyConnected", ins,
                  {"no_bias": no_bias or bias is None, "num_hidden": num_hidden,
                   "flatten": flatten}, name=name)
+
+
+def StemConvS2D(data, weight=None, num_filter=None, name=None, **kwargs):
+    return _make("StemConvS2D", [data, weight], {"num_filter": num_filter},
+                 name=name)
 
 
 def Convolution(data, weight=None, bias=None, kernel=None, stride=1, pad=0,

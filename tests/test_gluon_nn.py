@@ -168,3 +168,77 @@ def test_deferred_init_and_in_units_inference():
     y = net(nd.ones((2, 7)))
     assert net.weight.shape == (4, 7)
     assert y.shape == (2, 4)
+
+
+def test_batchnorm_fused_grad_matches_autodiff():
+    """The hand-fused BN backward (custom_vjp) must match jax autodiff of
+    the naive formulation to fp32 tolerance."""
+    import jax
+    import jax.numpy as jnp
+
+    from mxnet_tpu.ops.nn_ops import _bn_train
+
+    rng = np.random.RandomState(7)
+    x = jnp.asarray(rng.randn(4, 5, 6, 3).astype(np.float32))
+    gamma = jnp.asarray(rng.rand(3).astype(np.float32) + 0.5)
+    beta = jnp.asarray(rng.randn(3).astype(np.float32))
+    eps = 1e-5
+
+    def fused_loss(x, g, b):
+        y, _m, _v = _bn_train(x, g, b, jnp.zeros(x.shape[3]), 3, eps)
+        return jnp.sum(jnp.sin(y))
+
+    def naive_loss(x, g, b):
+        axes = (0, 1, 2)
+        mean = jnp.mean(x, axis=axes)
+        var = jnp.var(x, axis=axes)
+        y = (x - mean) * jax.lax.rsqrt(var + eps) * g + b
+        return jnp.sum(jnp.sin(y))
+
+    for i, (gf, gn) in enumerate(zip(jax.grad(fused_loss, (0, 1, 2))(x, gamma, beta),
+                                     jax.grad(naive_loss, (0, 1, 2))(x, gamma, beta))):
+        np.testing.assert_allclose(np.asarray(gf), np.asarray(gn),
+                                   rtol=2e-4, atol=2e-4, err_msg=f"arg {i}")
+
+
+def test_stem_conv_s2d_equivalence():
+    """stem_conv_s2d == 7x7/s2/p3 NHWC conv, values and gradients."""
+    import jax
+    import jax.numpy as jnp
+
+    from mxnet_tpu.ops.nn_ops import convolution, stem_conv_s2d
+
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(2, 32, 32, 3).astype(np.float32))
+    w = jnp.asarray(rng.randn(16, 7, 7, 3).astype(np.float32))
+    ref = convolution(x, w, stride=2, pad=3, layout="NHWC")
+    out = stem_conv_s2d(x, w)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-4, atol=1e-4)
+
+    gref = jax.grad(lambda x, w: jnp.sum(
+        jnp.sin(convolution(x, w, stride=2, pad=3, layout="NHWC"))),
+        (0, 1))(x, w)
+    gs2d = jax.grad(lambda x, w: jnp.sum(jnp.sin(stem_conv_s2d(x, w))),
+                    (0, 1))(x, w)
+    for a, b in zip(gs2d, gref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-3, atol=1e-3)
+
+
+def test_batchnorm_large_mean_no_nan():
+    """One-pass E[x^2]-E[x]^2 variance is clamped: huge mean, tiny std must
+    not NaN (fp32 cancellation regression)."""
+    import jax.numpy as jnp
+
+    from mxnet_tpu.ops.nn_ops import _bn_train
+
+    x = jnp.full((8, 16, 16, 4), 1000.0) + 0.01 * jnp.asarray(
+        np.random.RandomState(0).randn(8, 16, 16, 4).astype(np.float32))
+    g = jnp.ones((4,))
+    b = jnp.zeros((4,))
+    # worst case for raw moments: huge mean, tiny std, zero shift (lagging
+    # running mean) — the shifted/clamped formulation must stay finite
+    y, mean, var = _bn_train(x, g, b, jnp.zeros(x.shape[3]), 3, 1e-5)
+    assert np.isfinite(np.asarray(y)).all()
+    assert (np.asarray(var) >= 0).all()

@@ -124,3 +124,35 @@ def test_group_infer_shape():
     nested = sym.Group([sym.Group([h]), out2])
     _, out_shapes, _ = nested.infer_shape(data=(2, 4))
     assert out_shapes == [(2, 8), (2, 8)]
+
+
+def test_indexed_group_output():
+    """g[i] (indexed Group output) infers shapes and evaluates."""
+    data = sym.Variable("data")
+    w1 = sym.Variable("w1")
+    b1 = sym.Variable("b1")
+    h = sym.FullyConnected(data, w1, b1, num_hidden=8)
+    r = sym.Activation(h, act_type="relu")
+    g = sym.Group([h, r])
+    one = g[1]
+    _, out_shapes, _ = one.infer_shape(data=(2, 4))
+    assert out_shapes == [(2, 8)]
+    vals = {"data": np.zeros((2, 4), np.float32) - 1.0,
+            "w1": np.ones((8, 4), np.float32),
+            "b1": np.zeros((8,), np.float32)}
+    out = one._eval_with_values({k: mx.nd.array(v)._data
+                                 for k, v in vals.items()})
+    assert np.allclose(np.asarray(out), 0.0)  # relu(-4) == 0
+
+
+def test_s2d_stem_symbolic_trace():
+    """S2DStemConv traces symbolically (F=sym) like the Conv2D it replaces."""
+    from mxnet_tpu.gluon.model_zoo.vision.resnet import S2DStemConv
+    blk = S2DStemConv(16)
+    blk.initialize()
+    x = nd.random.uniform(shape=(1, 8, 8, 3))
+    blk(x)  # materialise deferred weight
+    out = blk(sym.Variable("data"))
+    assert "data" in out.list_arguments()
+    _, out_shapes, _ = out.infer_shape(data=(2, 8, 8, 3))
+    assert out_shapes == [(2, 4, 4, 16)]
