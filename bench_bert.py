@@ -1,0 +1,119 @@
+"""BERT-base MLM pretraining throughput, tokens/sec/chip (BASELINE.json's
+second headline metric).
+
+One jitted bf16 train step: BERT-base (12x768x12, vocab 30522) MLM at
+seq_len 512, Pallas flash attention, 76 masked positions/sequence (15%),
+AdamW-free SGD-momentum update (same optimizer as the ResNet bench so the
+two headline numbers are comparable), donated buffers.
+
+Baseline denominator: no published per-chip MXNet/GluonNLP A100 number
+exists in BASELINE.json ("published": {}), so the reference class is derived
+the same way SURVEY.md §6 derives the ResNet one — A100 fp16-class sustained
+transformer throughput. BERT-base training costs ~0.72 GFLOP/token at
+seq 512 (6*110e6 params-matmul + 12 layers * 12*S*d attention / 3 passes);
+NVIDIA's tuned BERT runs at ~35% MFU on A100 (312 TFLOPs peak) ->
+0.35*312e12/0.72e9 ~= 150k tokens/s/chip. We use 150000.
+
+Run directly, or via `python bench.py` which merges this metric into its
+single JSON line. Prints ONE JSON line when run standalone.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+BASELINE_TOK_S = 150_000.0
+SEQ, MASKED = 512, 76
+
+
+def measure(batch=None, steps=None):
+    import jax
+    import jax.numpy as jnp
+
+    import mxnet_tpu as mx  # noqa: F401  (registers dtypes/ops)
+    from mxnet_tpu.gluon.block import extract_pure_fn
+    from mxnet_tpu.models.bert import BERTForPretraining, bert_base
+
+    on_tpu = jax.default_backend() == "tpu"
+    if batch is None:
+        batch = 24 if on_tpu else 2
+    if steps is None:
+        steps = 20 if on_tpu else 2
+    seq = SEQ if on_tpu else 64
+    masked = MASKED if on_tpu else 8
+    print(f"[bench_bert] backend={jax.default_backend()} batch={batch} "
+          f"seq={seq} steps={steps}", file=sys.stderr)
+
+    model = BERTForPretraining(bert_base(max_length=seq, dropout=0.0))
+    model.initialize()
+    model.cast("bfloat16")
+
+    key = jax.random.PRNGKey(0)
+    k1, k2, k3, k4 = jax.random.split(key, 4)
+    tok = mx.nd.NDArray(jax.random.randint(k1, (batch, seq), 0, 30522))
+    seg = mx.nd.NDArray(jnp.zeros((batch, seq), jnp.int32))
+    vl = mx.nd.NDArray(jnp.full((batch,), seq, jnp.int32))
+    pos = mx.nd.NDArray(jax.random.randint(k2, (batch, masked), 0, seq))
+    model(tok, seg, vl, pos)  # materialise params
+    fwd, params = extract_pure_fn(model, tok, seg, vl, pos, training=True)
+    aux_idx = list(fwd.aux_indices)
+
+    mlm_labels = jax.random.randint(k3, (batch, masked), 0, 30522)
+    nsp_labels = jax.random.randint(k4, (batch,), 0, 2)
+
+    def loss_fn(p, t, s, v, mp, ml, nl):
+        (mlm, nsp), aux = fwd(p, t, s, v, mp)
+        mlm = mlm.astype(jnp.float32)
+        nsp = nsp.astype(jnp.float32)
+        lp = jax.nn.log_softmax(mlm, axis=-1)
+        l_mlm = -jnp.mean(jnp.take_along_axis(lp, ml[..., None], -1))
+        lp2 = jax.nn.log_softmax(nsp, axis=-1)
+        l_nsp = -jnp.mean(jnp.take_along_axis(lp2, nl[:, None], -1))
+        return l_mlm + l_nsp, aux
+
+    lr, mu = 1e-3, 0.9
+
+    def train_step(p, mom, *data):
+        (loss, aux), g = jax.value_and_grad(loss_fn, has_aux=True)(p, *data)
+        new_mom = [mu * m + gg.astype(m.dtype) for m, gg in zip(mom, g)]
+        new_p = [pp - lr * m for pp, m in zip(p, new_mom)]
+        for i, v in zip(aux_idx, aux):
+            new_p[i] = v
+        return new_p, new_mom, loss
+
+    step = jax.jit(train_step, donate_argnums=(0, 1))
+    mom = [jnp.zeros_like(p) for p in params]
+    data = (tok._data, seg._data, vl._data, pos._data, mlm_labels, nsp_labels)
+
+    params, mom, loss = step(params, mom, *data)
+    params, mom, loss = step(params, mom, *data)
+    float(loss)  # sync (host fetch; see bench.py note on the axon tunnel)
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, mom, loss = step(params, mom, *data)
+    final_loss = float(loss)
+    dt = time.perf_counter() - t0
+
+    tok_s = batch * seq * steps / dt
+    print(f"[bench_bert] loss={final_loss:.4f} dt={dt:.3f}s",
+          file=sys.stderr)
+    return {
+        "metric": "bert_base_mlm_train_throughput",
+        "value": round(tok_s, 1),
+        "unit": "tokens/sec/chip",
+        "vs_baseline": round(tok_s / BASELINE_TOK_S, 4),
+    }
+
+
+def main():
+    batch = os.environ.get("BENCH_BERT_BATCH")
+    steps = os.environ.get("BENCH_BERT_STEPS")
+    res = measure(int(batch) if batch else None, int(steps) if steps else None)
+    print(json.dumps(res))
+
+
+if __name__ == "__main__":
+    main()

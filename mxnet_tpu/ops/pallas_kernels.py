@@ -186,29 +186,38 @@ flash_attention.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 # ---------------------------------------------------------------------------
 # fused layer norm
 # ---------------------------------------------------------------------------
-def _ln_kernel(x_ref, g_ref, b_ref, o_ref, *, eps):
+def _ln_kernel(x_ref, g_ref, b_ref, o_ref, mean_ref, rstd_ref, *, eps):
     x = x_ref[:].astype(jnp.float32)
     mean = jnp.mean(x, axis=-1, keepdims=True)
     xc = x - mean
     var = jnp.mean(xc * xc, axis=-1, keepdims=True)
-    y = xc * lax.rsqrt(var + eps)
+    rstd = lax.rsqrt(var + eps)
+    y = xc * rstd
     o_ref[:] = (y * g_ref[:].astype(jnp.float32)
                 + b_ref[:].astype(jnp.float32)).astype(o_ref.dtype)
+    mean_ref[:] = mean
+    rstd_ref[:] = rstd
 
 
-def fused_layer_norm(x, gamma, beta, eps=1e-5, block_rows=256):
-    """LayerNorm over the last axis. Pallas single-pass on TPU; XLA fallback."""
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _fused_ln(x, gamma, beta, eps):
+    y, _m, _r = _fused_ln_fwd_impl(x, gamma, beta, eps)
+    return y
+
+
+def _fused_ln_fwd_impl(x, gamma, beta, eps):
     d = x.shape[-1]
     rows = 1
     for s in x.shape[:-1]:
         rows *= s
+    lead = x.shape[:-1]
     if (_HAS_PALLAS and on_tpu() and d % 128 == 0 and rows % 8 == 0
             and rows >= 8):
-        br = min(block_rows, rows)
+        br = min(256, rows)
         while rows % br:
             br //= 2
         x2 = x.reshape(rows, d)
-        out = pl.pallas_call(
+        out, mean, rstd = pl.pallas_call(
             functools.partial(_ln_kernel, eps=eps),
             grid=(rows // br,),
             in_specs=[
@@ -216,10 +225,54 @@ def fused_layer_norm(x, gamma, beta, eps=1e-5, block_rows=256):
                 pl.BlockSpec((d,), lambda i: (0,)),
                 pl.BlockSpec((d,), lambda i: (0,)),
             ],
-            out_specs=pl.BlockSpec((br, d), lambda i: (i, 0)),
-            out_shape=jax.ShapeDtypeStruct((rows, d), x.dtype),
+            out_specs=[
+                pl.BlockSpec((br, d), lambda i: (i, 0)),
+                pl.BlockSpec((br, 1), lambda i: (i, 0)),
+                pl.BlockSpec((br, 1), lambda i: (i, 0)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((rows, d), x.dtype),
+                jax.ShapeDtypeStruct((rows, 1), jnp.float32),
+                jax.ShapeDtypeStruct((rows, 1), jnp.float32),
+            ],
         )(x2, gamma, beta)
-        return out.reshape(x.shape)
-    mean = jnp.mean(x, axis=-1, keepdims=True)
-    var = jnp.var(x, axis=-1, keepdims=True)
-    return (x - mean) * lax.rsqrt(var + eps) * gamma + beta
+        return (out.reshape(x.shape), mean.reshape(lead + (1,)),
+                rstd.reshape(lead + (1,)))
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=-1, keepdims=True)
+    xc = xf - mean
+    var = jnp.mean(xc * xc, axis=-1, keepdims=True)
+    rstd = lax.rsqrt(var + eps)
+    y = ((xc * rstd) * gamma.astype(jnp.float32)
+         + beta.astype(jnp.float32)).astype(x.dtype)
+    return y, mean, rstd
+
+
+def _fused_ln_vjp_fwd(x, gamma, beta, eps):
+    y, mean, rstd = _fused_ln_fwd_impl(x, gamma, beta, eps)
+    return y, (x, gamma, mean, rstd)
+
+
+def _fused_ln_vjp_bwd(eps, res, dy):
+    x, gamma, mean, rstd = res
+    red = tuple(range(x.ndim - 1))
+    xhat = (x.astype(jnp.float32) - mean) * rstd
+    dyf = dy.astype(jnp.float32)
+    dgamma = jnp.sum(dyf * xhat, axis=red)
+    dbeta = jnp.sum(dyf, axis=red)
+    dxhat = dyf * gamma.astype(jnp.float32)
+    m1 = jnp.mean(dxhat, axis=-1, keepdims=True)
+    m2 = jnp.mean(dxhat * xhat, axis=-1, keepdims=True)
+    dx = (dxhat - m1 - xhat * m2) * rstd
+    return (dx.astype(x.dtype), dgamma.astype(gamma.dtype),
+            dbeta.astype(gamma.dtype))
+
+
+_fused_ln.defvjp(_fused_ln_vjp_fwd, _fused_ln_vjp_bwd)
+
+
+def fused_layer_norm(x, gamma, beta, eps=1e-5):
+    """LayerNorm over the last axis. Pallas single-pass forward on TPU (XLA
+    fallback elsewhere) with a closed-form custom-vjp backward, so it is
+    trainable on the Pallas path too."""
+    return _fused_ln(x, gamma, beta, float(eps))
