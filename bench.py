@@ -81,12 +81,24 @@ def main():
 
     img_s = batch * steps / dt
     print(f"[bench] loss={final_loss:.4f} dt={dt:.3f}s", file=sys.stderr)
-    print(json.dumps({
+    result = {
         "metric": "resnet50_train_throughput",
         "value": round(img_s, 2),
         "unit": "images/sec/chip",
         "vs_baseline": round(img_s / BASELINE_IMG_S, 4),
-    }))
+    }
+
+    # Second headline metric (BASELINE.json): BERT-base MLM tokens/sec/chip.
+    # Merged into the same single JSON line so the driver's one-line parse
+    # still works; a BERT failure must not take down the ResNet metric.
+    if not smoke and os.environ.get("BENCH_SKIP_BERT") != "1":
+        try:
+            import bench_bert
+            result["extra_metrics"] = [bench_bert.measure()]
+        except Exception as e:  # pragma: no cover
+            print(f"[bench] bert bench failed: {e!r}", file=sys.stderr)
+
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":

@@ -256,7 +256,10 @@ class Block:
         params = self._collect_params_with_prefix()
         arrays = {name: p.data().asnumpy() for name, p in params.items()
                   if p._data is not None}
-        np.savez(filename, **arrays)
+        # write through a file object: np.savez(str) appends ".npz", which
+        # breaks the conventional "net.params" filenames round-trip
+        with open(filename, "wb") as f:
+            np.savez(f, **arrays)
 
     def load_parameters(self, filename, ctx=None, allow_missing=False,
                         ignore_extra=False, cast_dtype=False,

@@ -6,16 +6,21 @@ Mosaic/Pallas kernels targeting VMEM + MXU directly.
 
 Kernels:
   * flash_attention — memory-efficient attention, online softmax, O(S) memory,
-    grid (batch*heads, q_blocks, kv_blocks) with VMEM accumulators. Forward is
-    Pallas; backward recomputes via the XLA path (custom_vjp) which XLA fuses.
+    grid (batch*heads, q_blocks, kv_blocks) with VMEM accumulators. Forward
+    saves per-row logsumexp; backward is the FlashAttention-2 style pair of
+    Pallas kernels (dk/dv over kv-blocks, dq over q-blocks) with in-kernel
+    recompute of the probabilities — O(S) memory end to end.
   * fused_layer_norm — single-pass layernorm.
 
 All kernels fall back to pure-XLA implementations off-TPU (CPU test mesh) or
-for shapes that don't tile (seq not multiple of block after padding).
+for shapes that don't tile (seq not multiple of block after padding). Set
+MXTPU_PALLAS_INTERPRET=1 to run the kernels in Pallas interpret mode on CPU
+(used by tests to pin the kernel numerics without a chip).
 """
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -37,6 +42,17 @@ def on_tpu():
         return jax.default_backend() == "tpu"
     except Exception:
         return False
+
+
+def _interpret():
+    """Pallas interpret mode: lets the CPU test mesh execute the real kernel
+    bodies (slowly) so their numerics are pinned without TPU hardware."""
+    return os.environ.get("MXTPU_PALLAS_INTERPRET") == "1"
+
+
+def _pallas_ok(seq_len):
+    return (_HAS_PALLAS and (on_tpu() or _interpret())
+            and seq_len % 128 == 0 and seq_len >= 128)
 
 
 # ---------------------------------------------------------------------------
@@ -62,8 +78,9 @@ def attention_reference(q, k, v, causal=False, sm_scale=None, mask=None):
 # ---------------------------------------------------------------------------
 # Pallas flash attention forward
 # ---------------------------------------------------------------------------
-def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr,
-                      *, sm_scale, causal, block_q, block_k, seq_len):
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
+                      acc_scr, *, sm_scale, causal, block_q, block_k,
+                      seq_len):
     kb = pl.program_id(2)
     nk = pl.num_programs(2)
 
@@ -110,9 +127,12 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr,
     @pl.when(kb == nk - 1)
     def _finalize():
         o_ref[0] = (acc_scr[:] / l_scr[:, :1]).astype(o_ref.dtype)
+        lse_ref[0] = m_scr[:, 0] + jnp.log(l_scr[:, 0])
 
 
 def _flash_fwd_pallas(q, k, v, causal, sm_scale, block_q=128, block_k=128):
+    """Returns (out, lse); lse is the per-row logsumexp of the scaled logits,
+    shape (B*H, S) fp32 — the backward kernels' softmax residual."""
     b, h, s, d = q.shape
     bh = b * h
     qr = q.reshape(bh, s, d)
@@ -122,7 +142,7 @@ def _flash_fwd_pallas(q, k, v, causal, sm_scale, block_q=128, block_k=128):
     kern = functools.partial(
         _flash_fwd_kernel, sm_scale=sm_scale, causal=causal,
         block_q=block_q, block_k=block_k, seq_len=s)
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kern,
         grid=grid,
         in_specs=[
@@ -130,8 +150,14 @@ def _flash_fwd_pallas(q, k, v, causal, sm_scale, block_q=128, block_k=128):
             pl.BlockSpec((1, block_k, d), lambda bh_, i, j: (bh_, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda bh_, i, j: (bh_, j, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda bh_, i, j: (bh_, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, s, d), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh_, i, j: (bh_, i, 0)),
+            pl.BlockSpec((1, block_q), lambda bh_, i, j: (bh_, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, s, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, s), jnp.float32),
+        ],
         scratch_shapes=[
             pltpu.VMEM((block_q, 128), jnp.float32),
             pltpu.VMEM((block_q, 128), jnp.float32),
@@ -139,8 +165,9 @@ def _flash_fwd_pallas(q, k, v, causal, sm_scale, block_q=128, block_k=128):
         ],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=_interpret(),
     )(qr, kr, vr)
-    return out.reshape(b, h, s, d)
+    return out.reshape(b, h, s, d), lse
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
@@ -156,27 +183,192 @@ def flash_attention(q, k, v, causal=False, sm_scale=None):
 def _flash_attention_impl(q, k, v, causal, sm_scale):
     if sm_scale is None:
         sm_scale = 1.0 / (q.shape[-1] ** 0.5)
-    s = q.shape[2]
-    if _HAS_PALLAS and on_tpu() and s % 128 == 0 and s >= 128:
+    if _pallas_ok(q.shape[2]):
         try:
-            return _flash_fwd_pallas(q, k, v, causal, sm_scale)
+            return _flash_fwd_pallas(q, k, v, causal, sm_scale)[0]
         except Exception:
             pass
     return attention_reference(q, k, v, causal=causal, sm_scale=sm_scale)
 
 
+# ---------------------------------------------------------------------------
+# Pallas flash attention backward (FlashAttention-2 split):
+#   kernel 1 — dk/dv: kv-blocks parallel, q-blocks innermost/sequential
+#   kernel 2 — dq:    q-blocks parallel, kv-blocks innermost/sequential
+# Both recompute p = exp(s - lse) from the forward's logsumexp, so nothing
+# O(S^2) is ever materialised.
+# ---------------------------------------------------------------------------
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                          dk_ref, dv_ref, dk_scr, dv_scr, *,
+                          sm_scale, causal, block_q, block_k):
+    kb = pl.program_id(1)
+    qb = pl.program_id(2)
+    nq = pl.num_programs(2)
+
+    @pl.when(qb == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    q_start = qb * block_q
+    k_start = kb * block_k
+
+    def compute():
+        q = q_ref[0].astype(jnp.float32)           # (bq, d)
+        k = k_ref[0].astype(jnp.float32)           # (bk, d)
+        v = v_ref[0].astype(jnp.float32)           # (bk, d)
+        do = do_ref[0].astype(jnp.float32)         # (bq, d)
+        lse = lse_ref[0]                           # (bq,)
+        delta = delta_ref[0]                       # (bq,)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * sm_scale
+        if causal:
+            qi = q_start + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            kj = k_start + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(qi >= kj, s, -1e30)
+        p = jnp.exp(s - lse[:, None])              # (bq, bk)
+        dv_scr[:] += jax.lax.dot_general(          # p^T @ dO
+            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(                  # dO @ V^T
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * sm_scale
+        dk_scr[:] += jax.lax.dot_general(          # dS^T @ Q
+            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+
+    if causal:
+        @pl.when(k_start <= q_start + block_q - 1)
+        def _():
+            compute()
+    else:
+        compute()
+
+    @pl.when(qb == nq - 1)
+    def _finalize():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                         dq_ref, dq_scr, *, sm_scale, causal, block_q,
+                         block_k):
+    qb = pl.program_id(1)
+    kb = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(kb == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    q_start = qb * block_q
+    k_start = kb * block_k
+
+    def compute():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0]
+        delta = delta_ref[0]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * sm_scale
+        if causal:
+            qi = q_start + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            kj = k_start + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(qi >= kj, s, -1e30)
+        p = jnp.exp(s - lse[:, None])
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * sm_scale
+        dq_scr[:] += jax.lax.dot_general(          # dS @ K
+            ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+
+    if causal:
+        @pl.when(k_start <= q_start + block_q - 1)
+        def _():
+            compute()
+    else:
+        compute()
+
+    @pl.when(kb == nk - 1)
+    def _finalize():
+        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _flash_bwd_pallas(q, k, v, o, lse, g, causal, sm_scale,
+                      block_q=128, block_k=128):
+    b, h, s, d = q.shape
+    bh = b * h
+    qr = q.reshape(bh, s, d)
+    kr = k.reshape(bh, s, d)
+    vr = v.reshape(bh, s, d)
+    gr = g.reshape(bh, s, d)
+    # delta_i = rowsum(dO ∘ O): the softmax-jacobian correction term; cheap
+    # elementwise+reduce, left to XLA.
+    delta = jnp.sum(o.astype(jnp.float32) * g.astype(jnp.float32),
+                    axis=-1).reshape(bh, s)
+    nq = pl.cdiv(s, block_q)
+    nk = pl.cdiv(s, block_k)
+
+    qspec = pl.BlockSpec((1, block_q, d), lambda b_, j, i: (b_, i, 0))
+    kspec = pl.BlockSpec((1, block_k, d), lambda b_, j, i: (b_, j, 0))
+    rowq = pl.BlockSpec((1, block_q), lambda b_, j, i: (b_, i))
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_bwd_dkv_kernel, sm_scale=sm_scale,
+                          causal=causal, block_q=block_q, block_k=block_k),
+        grid=(bh, nk, nq),
+        in_specs=[qspec, kspec, kspec, qspec, rowq, rowq],
+        out_specs=[kspec, kspec],
+        out_shape=[jax.ShapeDtypeStruct((bh, s, d), q.dtype)] * 2,
+        scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
+                        pltpu.VMEM((block_k, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=_interpret(),
+    )(qr, kr, vr, gr, lse, delta)
+
+    qspec2 = pl.BlockSpec((1, block_q, d), lambda b_, i, j: (b_, i, 0))
+    kspec2 = pl.BlockSpec((1, block_k, d), lambda b_, i, j: (b_, j, 0))
+    rowq2 = pl.BlockSpec((1, block_q), lambda b_, i, j: (b_, i))
+    dq = pl.pallas_call(
+        functools.partial(_flash_bwd_dq_kernel, sm_scale=sm_scale,
+                          causal=causal, block_q=block_q, block_k=block_k),
+        grid=(bh, nq, nk),
+        in_specs=[qspec2, kspec2, kspec2, qspec2, rowq2, rowq2],
+        out_specs=qspec2,
+        out_shape=jax.ShapeDtypeStruct((bh, s, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=_interpret(),
+    )(qr, kr, vr, gr, lse, delta)
+    rs = (b, h, s, d)
+    return dq.reshape(rs), dk.reshape(rs), dv.reshape(rs)
+
+
 def _flash_fwd_rule(q, k, v, causal, sm_scale):
-    out = _flash_attention_impl(q, k, v, causal, sm_scale)
-    return out, (q, k, v)
+    scale = sm_scale if sm_scale is not None else 1.0 / (q.shape[-1] ** 0.5)
+    if _pallas_ok(q.shape[2]):
+        try:
+            out, lse = _flash_fwd_pallas(q, k, v, causal, scale)
+            return out, (q, k, v, out, lse)
+        except Exception:
+            pass
+    out = attention_reference(q, k, v, causal=causal, sm_scale=scale)
+    return out, (q, k, v, None, None)
 
 
 def _flash_bwd_rule(causal, sm_scale, res, g):
-    q, k, v = res
-    # recompute-backward through the XLA reference (flash-style pallas bwd is
-    # a further optimisation; XLA fuses this into a few MXU matmuls)
+    q, k, v, o, lse = res
+    scale = sm_scale if sm_scale is not None else 1.0 / (q.shape[-1] ** 0.5)
+    if o is not None and _pallas_ok(q.shape[2]):
+        try:
+            return _flash_bwd_pallas(q, k, v, o, lse, g, causal, scale)
+        except Exception:
+            pass
+    # fallback: recompute-backward through the XLA reference
     _, vjp = jax.vjp(
         lambda q_, k_, v_: attention_reference(q_, k_, v_, causal=causal,
-                                               sm_scale=sm_scale), q, k, v)
+                                               sm_scale=scale), q, k, v)
     return vjp(g)
 
 
@@ -211,8 +403,8 @@ def _fused_ln_fwd_impl(x, gamma, beta, eps):
     for s in x.shape[:-1]:
         rows *= s
     lead = x.shape[:-1]
-    if (_HAS_PALLAS and on_tpu() and d % 128 == 0 and rows % 8 == 0
-            and rows >= 8):
+    if (_HAS_PALLAS and (on_tpu() or _interpret()) and d % 128 == 0
+            and rows % 8 == 0 and rows >= 8):
         br = min(256, rows)
         while rows % br:
             br //= 2
@@ -235,6 +427,7 @@ def _fused_ln_fwd_impl(x, gamma, beta, eps):
                 jax.ShapeDtypeStruct((rows, 1), jnp.float32),
                 jax.ShapeDtypeStruct((rows, 1), jnp.float32),
             ],
+            interpret=_interpret(),
         )(x2, gamma, beta)
         return (out.reshape(x.shape), mean.reshape(lead + (1,)),
                 rstd.reshape(lead + (1,)))
