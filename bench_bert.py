@@ -28,23 +28,15 @@ BASELINE_TOK_S = 150_000.0
 SEQ, MASKED = 512, 76
 
 
-def measure(batch=None, steps=None):
+def build_step(batch, seq, masked):
+    """Build the jitted BERT MLM train step. Returns (step, params, mom,
+    data) — shared by measure() and tools/profile_bert.py."""
     import jax
     import jax.numpy as jnp
 
     import mxnet_tpu as mx  # noqa: F401  (registers dtypes/ops)
     from mxnet_tpu.gluon.block import extract_pure_fn
     from mxnet_tpu.models.bert import BERTForPretraining, bert_base
-
-    on_tpu = jax.default_backend() == "tpu"
-    if batch is None:
-        batch = 24 if on_tpu else 2
-    if steps is None:
-        steps = 20 if on_tpu else 2
-    seq = SEQ if on_tpu else 64
-    masked = MASKED if on_tpu else 8
-    print(f"[bench_bert] backend={jax.default_backend()} batch={batch} "
-          f"seq={seq} steps={steps}", file=sys.stderr)
 
     model = BERTForPretraining(bert_base(max_length=seq, dropout=0.0))
     model.initialize()
@@ -86,6 +78,23 @@ def measure(batch=None, steps=None):
     step = jax.jit(train_step, donate_argnums=(0, 1))
     mom = [jnp.zeros_like(p) for p in params]
     data = (tok._data, seg._data, vl._data, pos._data, mlm_labels, nsp_labels)
+    return step, params, mom, data
+
+
+def measure(batch=None, steps=None):
+    import jax
+
+    on_tpu = jax.default_backend() == "tpu"
+    if batch is None:
+        batch = 16 if on_tpu else 2
+    if steps is None:
+        steps = 20 if on_tpu else 2
+    seq = SEQ if on_tpu else 64
+    masked = MASKED if on_tpu else 8
+    print(f"[bench_bert] backend={jax.default_backend()} batch={batch} "
+          f"seq={seq} steps={steps}", file=sys.stderr)
+
+    step, params, mom, data = build_step(batch, seq, masked)
 
     params, mom, loss = step(params, mom, *data)
     params, mom, loss = step(params, mom, *data)

@@ -35,6 +35,7 @@ from .optimizer import opt
 from . import lr_scheduler
 from . import metric
 from . import io
+from . import recordio
 from . import image
 from . import kvstore
 from .kvstore import KVStore
@@ -47,6 +48,7 @@ from . import callback
 from . import monitor
 from . import profiler
 from . import amp
+from . import utils
 from . import visualization as viz
 from . import runtime
 from . import checkpoint

@@ -1,9 +1,17 @@
 """Automatic mixed precision (reference: python/mxnet/contrib/amp).
 
 TPU-native: bf16 is the native MXU dtype (no loss scaling needed, unlike
-fp16 on GPUs), so `init()` casts compute-heavy layers to bfloat16 while
-keeping norms/softmax in fp32. A DynamicLossScaler is provided for fp16
-parity with the reference's amp.scale_loss / amp.unscale API.
+fp16 on GPUs). `init()` turns on op-level autocast — the matmul/conv entry
+points in `ops.nn_ops` consult `amp.autocast_dtype()` and run fp32 inputs
+through the MXU in the target dtype (the reference patches its op namespace
+with cast wrappers at amp.init(); here the cast lives in the op, applied at
+trace time, so one jit recompile picks it up). Normalisation layers listed
+in `_KEEP_FP32` are kept/re-cast to fp32 by `convert_block`.
+
+For fp16 parity the reference's dynamic loss scaling is wired into
+`gluon.Trainer.step`: when `init(target_dtype="float16")` installed a
+`DynamicLossScaler`, step() unscales gradients, skips the update on
+overflow, and halves the scale (§5 failure-detection: `skip_nonfinite`).
 """
 from __future__ import annotations
 
@@ -11,8 +19,9 @@ import numpy as np
 
 import jax.numpy as jnp
 
-__all__ = ["init", "convert_block", "scale_loss", "unscale",
-           "DynamicLossScaler", "bfloat16"]
+__all__ = ["init", "reset", "convert_block", "scale_loss", "unscale",
+           "DynamicLossScaler", "bfloat16", "autocast_dtype", "is_active",
+           "grads_nonfinite"]
 
 bfloat16 = jnp.bfloat16
 
@@ -20,22 +29,49 @@ _CAST_LAYERS = ("Dense", "Conv1D", "Conv2D", "Conv3D", "Conv2DTranspose",
                 "Embedding")
 _KEEP_FP32 = ("BatchNorm", "LayerNorm", "InstanceNorm", "GroupNorm")
 
-_state = {"scaler": None, "initialized": False}
+_state = {"scaler": None, "initialized": False, "target_dtype": None}
 
 
 def init(target_dtype="bfloat16"):
-    """Enable AMP defaults (reference: amp.init())."""
+    """Enable AMP (reference: amp.init()). Turns on op-level autocast for
+    matmul/conv ops and, for float16, installs a DynamicLossScaler that
+    gluon.Trainer.step consults."""
     _state["initialized"] = True
     _state["target_dtype"] = target_dtype
     if target_dtype == "float16":
         _state["scaler"] = DynamicLossScaler()
 
 
+def reset():
+    """Disable AMP again (test helper / parity with amp re-init)."""
+    _state["initialized"] = False
+    _state["target_dtype"] = None
+    _state["scaler"] = None
+
+
+def is_active():
+    return _state["initialized"]
+
+
+def autocast_dtype():
+    """The dtype fp32 matmul/conv inputs are cast to under AMP, or None.
+    Consulted by ops.nn_ops.fully_connected / convolution at trace time."""
+    if not _state["initialized"]:
+        return None
+    t = _state.get("target_dtype") or "bfloat16"
+    return jnp.float16 if str(t) in ("float16", "fp16") else jnp.bfloat16
+
+
 def convert_block(block, target_dtype="bfloat16"):
-    """Cast matmul/conv layers to bf16, keep normalisation fp32
+    """Cast matmul/conv layers to the target dtype and force the
+    normalisation layers in `_KEEP_FP32` back to fp32 — so it is safe to
+    call after a blanket `net.cast("bfloat16")`
     (reference: amp.convert_hybrid_block)."""
     def walk(b):
         name = type(b).__name__
+        if name in _KEEP_FP32:
+            b.cast("float32")
+            return
         if name in _CAST_LAYERS:
             b.cast(target_dtype)
         for c in b._children.values():
@@ -55,12 +91,7 @@ class DynamicLossScaler:
         self._unskipped = 0
 
     def has_overflow(self, params):
-        for p in params:
-            if p._grad is not None:
-                g = p._grad.asnumpy()
-                if not np.isfinite(g).all():
-                    return True
-        return False
+        return grads_nonfinite(params)
 
     def update_scale(self, overflow):
         if overflow:
@@ -71,6 +102,20 @@ class DynamicLossScaler:
             if self._unskipped >= self.scale_window:
                 self.loss_scale *= self.scale_factor
                 self._unskipped = 0
+
+
+def grads_nonfinite(params):
+    """True if any parameter gradient contains inf/nan. One fused device
+    reduction + a single host sync."""
+    checks = [jnp.isfinite(p._grad._data.astype(jnp.float32)).all()
+              for p in params
+              if getattr(p, "_grad", None) is not None]
+    if not checks:
+        return False
+    ok = checks[0]
+    for c in checks[1:]:
+        ok = jnp.logical_and(ok, c)
+    return not bool(ok)
 
 
 def scale_loss(loss, trainer_or_scaler=None):

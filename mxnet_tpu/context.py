@@ -14,7 +14,8 @@ import jax
 
 from .base import MXNetError
 
-__all__ = ["Context", "cpu", "gpu", "tpu", "current_context", "num_gpus", "num_tpus"]
+__all__ = ["Context", "cpu", "gpu", "tpu", "current_context", "num_gpus",
+           "num_tpus", "memory_info", "gpu_memory_info"]
 
 _context_stack = threading.local()
 
@@ -127,3 +128,15 @@ def num_gpus():
 def num_tpus():
     """Number of TPU chips visible to this process."""
     return len(_accelerator_devices())
+
+
+def memory_info(ctx=None):
+    """(free_bytes, total_bytes) of a context's device HBM (reference:
+    context.gpu_memory_info; backed by utils/memory.py over PJRT)."""
+    from .utils.memory import memory_info as _mi
+    return _mi(ctx if ctx is not None else current_context())
+
+
+def gpu_memory_info(device_id=0):
+    """Reference-named alias: free/total for accelerator `device_id`."""
+    return memory_info(Context("tpu", device_id))

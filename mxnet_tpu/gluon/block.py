@@ -403,8 +403,13 @@ class HybridBlock(Block):
         if any(p._deferred_init is not None or p._data is None for p in params):
             # first call initialises deferred shapes through the eager path
             return super().__call__(*args)
+        from .. import amp
+        # amp.autocast_dtype() is read at trace time by the matmul/conv
+        # ops; keying the compiled cache on it makes amp.init()/reset()
+        # after a compile actually take effect (fresh trace) instead of
+        # silently reusing the pre-AMP executable
         key = (tuple((a.shape, str(a.dtype)) for a in args),
-               autograd.is_training())
+               autograd.is_training(), str(amp.autocast_dtype()))
         entry = self._cached_fns.get(key)
         if entry is None:
             entry = self._build_cached(params, args, autograd.is_training())

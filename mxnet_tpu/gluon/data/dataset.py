@@ -1,6 +1,8 @@
 """Datasets (reference: python/mxnet/gluon/data/dataset.py)."""
 from __future__ import annotations
 
+import os
+
 __all__ = ["Dataset", "SimpleDataset", "ArrayDataset", "RecordFileDataset"]
 
 
@@ -90,22 +92,33 @@ class ArrayDataset(Dataset):
 
 
 class RecordFileDataset(Dataset):
-    """Reference reads RecordIO files; binary recordio depends on dmlc-core.
-    Here: a simple length-prefixed binary record format with the same API."""
+    """A dataset over a real RecordIO .rec file (reference:
+    gluon.data.RecordFileDataset over recordio.MXIndexedRecordIO). Uses the
+    .idx sidecar for random access when present, else loads sequentially."""
 
     def __init__(self, filename):
-        import struct
-        self._records = []
-        with open(filename, "rb") as f:
+        from ...recordio import MXRecordIO, MXIndexedRecordIO
+        idx_path = os.path.splitext(filename)[0] + ".idx"
+        if os.path.exists(idx_path):
+            self._rec = MXIndexedRecordIO(idx_path, filename, "r")
+            self._keys = self._rec.keys
+            self._records = None
+        else:
+            self._rec = None
+            self._records = []
+            r = MXRecordIO(filename, "r")
             while True:
-                header = f.read(8)
-                if len(header) < 8:
+                item = r.read()
+                if item is None:
                     break
-                (n,) = struct.unpack("<Q", header)
-                self._records.append(f.read(n))
+                self._records.append(item)
+            r.close()
 
     def __len__(self):
-        return len(self._records)
+        return len(self._keys) if self._records is None else \
+            len(self._records)
 
     def __getitem__(self, idx):
+        if self._records is None:
+            return self._rec.read_idx(self._keys[idx])
         return self._records[idx]

@@ -18,8 +18,15 @@ __all__ = ["Trainer"]
 
 
 class Trainer:
+    """`skip_nonfinite=True` (SURVEY.md §5 failure detection) skips the
+    optimizer update when any gradient is inf/nan instead of poisoning the
+    weights; when AMP installed a DynamicLossScaler (amp.init("float16")),
+    step() additionally unscales gradients and drives the scaler's
+    overflow-skip/halve protocol."""
+
     def __init__(self, params, optimizer, optimizer_params=None, kvstore="device",
-                 compression_params=None, update_on_kvstore=None):
+                 compression_params=None, update_on_kvstore=None,
+                 skip_nonfinite=False):
         if isinstance(params, (dict, ParameterDict)):
             params = list(params.values())
         if not isinstance(params, (list, tuple)):
@@ -39,6 +46,7 @@ class Trainer:
         self._kvstore = kvs_mod.create(kvstore) if kvstore else None
         self._kv_initialized = False
         self._scale = 1.0
+        self.skip_nonfinite = skip_nonfinite
 
     @property
     def learning_rate(self):
@@ -67,19 +75,43 @@ class Trainer:
         if self._kvstore is not None and self._kvstore.type == "ici":
             for i, p in enumerate(self._params):
                 if p.grad_req != "null" and p._grad is not None:
-                    agg = self._kvstore.allreduce_([p._grad._data])
+                    # explicit layout: a Trainer gradient is one whole array
+                    # for one parameter (possibly dim0-SHARDED for memory —
+                    # FSDP-style), never a stack of per-replica towers;
+                    # 'auto' would misread dim0 sharding as a replica stack
+                    # and reduce the leading dim away
+                    agg = self._kvstore.allreduce_([p._grad._data],
+                                                   layout="replicated")
                     p._grad._rebind(agg)
 
     def step(self, batch_size, ignore_stale_grad=False):
-        """Rescale gradients by 1/batch_size and apply one optimizer step."""
+        """Rescale gradients by 1/batch_size and apply one optimizer step.
+        Under an AMP loss scaler: unscale, skip on overflow, adjust scale.
+        With skip_nonfinite: skip the update when any grad is inf/nan."""
         if not self._kv_initialized:
             self._init_kvstore()
         self._optimizer.rescale_grad = self._scale / batch_size
         self.allreduce_grads()
+        if self._guard_says_skip():
+            return
         self._update(ignore_stale_grad)
+
+    def _guard_says_skip(self):
+        """Shared AMP-unscale / overflow-skip / nonfinite-skip guard for
+        step() and update(). Returns True when the update must be skipped."""
+        from .. import amp
+        scaler = amp._state.get("scaler") if amp.is_active() else None
+        if scaler is not None:
+            amp.unscale(self)
+            overflow = scaler.has_overflow(self._params)
+            scaler.update_scale(overflow)
+            return overflow
+        return self.skip_nonfinite and amp.grads_nonfinite(self._params)
 
     def update(self, batch_size, ignore_stale_grad=False):
         self._optimizer.rescale_grad = self._scale / batch_size
+        if self._guard_says_skip():
+            return
         self._update(ignore_stale_grad)
 
     def _update(self, ignore_stale_grad=False):

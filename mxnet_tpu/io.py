@@ -6,6 +6,7 @@ recordio files in this environment) with identical shapes and API.
 """
 from __future__ import annotations
 
+import os
 from collections import namedtuple
 
 import numpy as np
@@ -215,9 +216,12 @@ class PrefetchingIter(DataIter):
 
 
 class ImageRecordIter(DataIter):
-    """Synthetic ImageRecordIter (reference reads .rec files; offline here).
+    """ImageRecordIter: reads a real RecordIO .rec of packed images when
+    `path_imgrec` exists (reference: io.ImageRecordIter over
+    src/io/iter_image_recordio_2.cc); otherwise produces the deterministic
+    synthetic stream (offline testing).
 
-    Produces deterministic random images shaped data_shape at batch_size,
+    Images are decoded (PIL), resized to data_shape, CHW float32,
     mean/std-normalised like the reference's on-the-fly augmenter."""
 
     def __init__(self, path_imgrec=None, data_shape=(3, 224, 224),
@@ -231,6 +235,36 @@ class ImageRecordIter(DataIter):
         self.num_classes = num_classes
         self._seed = seed
         self.cursor = 0
+        self._mean = np.array([mean_r, mean_g, mean_b], np.float32)
+        self._std = np.array([std_r or 1, std_g or 1, std_b or 1], np.float32)
+        # Streaming reader: never load the whole .rec into host memory
+        # (production recs are 100s of GB). With an .idx sidecar, random
+        # access via MXIndexedRecordIO; without, sequential per-batch reads.
+        self._rec = None
+        self._keys = None
+        if path_imgrec is not None and os.path.exists(path_imgrec):
+            from .recordio import MXRecordIO, MXIndexedRecordIO
+            idx_path = os.path.splitext(path_imgrec)[0] + ".idx"
+            if os.path.exists(idx_path):
+                self._rec = MXIndexedRecordIO(idx_path, path_imgrec, "r")
+                self._keys = self._rec.keys
+                self.num_samples = len(self._keys)
+            else:
+                self._rec = MXRecordIO(path_imgrec, "r")
+                self.num_samples = int(os.environ.get(
+                    "MXTPU_IMGREC_MAX_SAMPLES", 2 ** 62))
+
+    def _decode(self, raw):
+        from .recordio import unpack_img
+        header, img = unpack_img(raw, iscolor=1)
+        c, h, w = self.data_shape
+        if img.shape[:2] != (h, w):
+            from PIL import Image
+            img = np.asarray(Image.fromarray(img).resize((w, h)))
+        x = img.astype(np.float32)
+        x = (x - self._mean) / self._std
+        label = header.label if np.ndim(header.label) else float(header.label)
+        return x.transpose(2, 0, 1), np.float32(label)
 
     @property
     def provide_data(self):
@@ -242,14 +276,33 @@ class ImageRecordIter(DataIter):
 
     def reset(self):
         self.cursor = 0
+        if self._rec is not None and self._keys is None:
+            self._rec.reset()      # sequential stream: rewind the file
+
+    def _next_raw(self, i):
+        if self._keys is not None:
+            return self._rec.read_idx(self._keys[i])
+        return self._rec.read()    # sequential; None at EOF
 
     def next(self):
         if self.cursor + self.batch_size > self.num_samples:
             raise StopIteration
-        rng = np.random.RandomState(self._seed + self.cursor)
-        data = rng.rand(self.batch_size, *self.data_shape).astype(np.float32)
-        label = (np.arange(self.cursor, self.cursor + self.batch_size)
-                 % self.num_classes).astype(np.float32)
+        if self._rec is not None:
+            raws = []
+            for i in range(self.cursor, self.cursor + self.batch_size):
+                raw = self._next_raw(i)
+                if raw is None:
+                    raise StopIteration     # sequential EOF mid-batch
+                raws.append(raw)
+            decoded = [self._decode(r) for r in raws]
+            data = np.stack([d for d, _ in decoded])
+            label = np.array([l for _, l in decoded], np.float32)
+        else:
+            rng = np.random.RandomState(self._seed + self.cursor)
+            data = rng.rand(self.batch_size,
+                            *self.data_shape).astype(np.float32)
+            label = (np.arange(self.cursor, self.cursor + self.batch_size)
+                     % self.num_classes).astype(np.float32)
         self.cursor += self.batch_size
         return DataBatch([array(data)], [array(label)],
                          provide_data=self.provide_data,

@@ -13,6 +13,16 @@ Backends:
 
 Optimizer offload (`set_optimizer`) runs updates at pull time like the
 reference's server-side update path (update_on_kvstore=True).
+
+'ici' allreduce semantics (explicit — see `KVStore.allreduce_`): a list of
+tower arrays is summed elementwise; the result is then reduced across a mesh
+axis according to its layout — "stacked" (leading dim indexes replicas;
+reduced away, like the reference's per-GPU push) or "replicated" (already
+identical everywhere; identity). "auto" inspects `.sharding`.
+
+Multi-host (DCN) bootstrap: `init_distributed()` wraps
+`jax.distributed.initialize` (reference: src/kvstore/kvstore_dist.h ps-lite
+scheduler bootstrap) so `rank`/`num_workers` are real on multi-host pods.
 """
 from __future__ import annotations
 
@@ -23,7 +33,51 @@ import numpy as np
 from .base import MXNetError, _as_list
 from .ndarray.ndarray import NDArray
 
-__all__ = ["KVStore", "create"]
+__all__ = ["KVStore", "create", "init_distributed"]
+
+_DIST_INITIALIZED = False
+
+
+def init_distributed(coordinator_address=None, num_processes=None,
+                     process_id=None, **kwargs):
+    """Initialise the multi-host runtime (DCN) so an 'ici' KVStore spans
+    processes. Arguments mirror `jax.distributed.initialize`; with none
+    given, JAX reads the cluster env (JAX_COORDINATOR_ADDRESS / cloud TPU
+    metadata). Safe to call more than once. Reference parity: the ps-lite
+    scheduler/server bootstrap of kvstore_dist; here the XLA runtime owns
+    rendezvous and the collectives ride ICI/DCN."""
+    global _DIST_INITIALIZED
+    if _DIST_INITIALIZED:
+        return
+    # NB: do NOT call jax.process_count() (or any backend-touching API)
+    # here — it initialises the XLA backend, after which
+    # jax.distributed.initialize refuses to run.
+    try:
+        if jax.distributed.is_initialized():
+            _DIST_INITIALIZED = True
+            return
+    except Exception:
+        pass
+    try:
+        jax.distributed.initialize(coordinator_address, num_processes,
+                                   process_id, **kwargs)
+        _DIST_INITIALIZED = True
+    except Exception as e:
+        if coordinator_address is not None or num_processes is not None:
+            raise MXNetError(f"distributed init failed: {e}") from e
+        # No explicit args: plain single-host is normal, but if cluster env
+        # vars are present this is a FAILED multi-host bootstrap — warn
+        # loudly instead of silently training rank-0-everywhere.
+        import os
+        import warnings
+        if any(os.environ.get(k) for k in
+               ("JAX_COORDINATOR_ADDRESS", "COORDINATOR_ADDRESS",
+                "MEGASCALE_COORDINATOR_ADDRESS")):
+            warnings.warn(
+                f"init_distributed: cluster env detected but "
+                f"jax.distributed.initialize failed ({e!r}); continuing "
+                f"SINGLE-PROCESS — cross-host gradients will NOT reduce",
+                RuntimeWarning, stacklevel=2)
 
 
 def create(name="local"):
@@ -130,27 +184,72 @@ class KVStore:
                          "(SURVEY.md §2 #49); use dense pull")
 
     # ------------------------------------------------------------------
-    def allreduce_(self, arrays):
-        """Sum a list of jax arrays; on 'ici' with multiple devices this is
-        a psum over the mesh 'dp' axis via shard_map."""
-        if len(arrays) == 1:
-            a = arrays[0]
-            if self._kind == "ici" and self._mesh is not None and \
-                    np.prod([self._mesh.shape[ax] for ax in self._mesh.axis_names]) > 1:
-                return self._psum_sharded(a)
-            return a
+    def allreduce_(self, arrays, axis=None, layout="auto"):
+        """Sum tower values across data-parallel replicas.
+
+        `arrays` (list of jax arrays) is summed elementwise — the 'local' /
+        'device' comm-tree aggregation. On the 'ici' backend the result is
+        then reduced across mesh axis `axis` (default: the mesh's first axis
+        name) according to `layout`:
+
+          * "replicated" — the value is already identical on every device
+            (the usual state of a gradient produced by a pjit step, where
+            XLA inserted the psum); the cross-replica sum is an identity.
+          * "stacked"    — the leading dim indexes replicas (shape[0] a
+            multiple of the axis size, sharded over it): local rows are
+            summed and psum'd, and the leading dim is REDUCED AWAY, so a
+            (R, *shape) stack comes back as (*shape) — matching the
+            reference semantics where R workers each push shape-X grads
+            and pull back the shape-X sum.
+          * "auto"       — "stacked" iff `.sharding` is a NamedSharding
+            whose spec partitions dim 0 over `axis`; else "replicated".
+
+        CAVEAT on "auto": a dim0-sharded array is indistinguishable from a
+        replica stack by its sharding alone — a gradient that is merely
+        SHARDED over dim 0 for memory (FSDP-style) would be misread as a
+        stack and lose its leading dim. Callers that know the layout must
+        say so explicitly (gluon.Trainer passes layout="replicated");
+        "auto" is the convention for imperative push() of stacked towers.
+        """
         out = arrays[0]
         for a in arrays[1:]:
             out = out + a
-        return out
+        if self._kind != "ici" or self._mesh is None:
+            return out
+        mesh = self._mesh
+        axis = axis or mesh.axis_names[0]
+        if mesh.shape[axis] <= 1:
+            return out
+        if layout == "auto":
+            layout = "stacked" if self._is_stacked(out, axis) else "replicated"
+        if layout == "replicated":
+            return out
+        if layout != "stacked":
+            raise MXNetError(f"unknown allreduce layout {layout!r}")
+        return self._psum_stacked(out, axis)
 
-    def _psum_sharded(self, a):
+    @staticmethod
+    def _is_stacked(a, axis):
+        sh = getattr(a, "sharding", None)
+        spec = getattr(sh, "spec", None)
+        if not spec:
+            return False
+        dim0 = spec[0]
+        if isinstance(dim0, (tuple, list)):
+            return axis in dim0
+        return dim0 == axis
+
+    def _psum_stacked(self, a, axis):
         from jax.sharding import PartitionSpec as P
         from jax import shard_map
         mesh = self._mesh
-        axis = mesh.axis_names[0]
-        f = shard_map(lambda x: jax.lax.psum(x, axis), mesh=mesh,
-                      in_specs=P(axis), out_specs=P(axis))
+        n = mesh.shape[axis]
+        if a.ndim == 0 or a.shape[0] % n:
+            raise MXNetError(
+                f"stacked allreduce needs dim0 divisible by mesh axis "
+                f"{axis!r} size {n}, got shape {a.shape}")
+        f = shard_map(lambda x: jax.lax.psum(jnp.sum(x, axis=0), axis),
+                      mesh=mesh, in_specs=P(axis), out_specs=P())
         return f(a)
 
     # ------------------------------------------------------------------
@@ -165,9 +264,13 @@ class KVStore:
 
     def save_optimizer_states(self, fname, dump_optimizer=False):
         import pickle
+
+        def to_np(x):
+            return np.asarray(x._data if isinstance(x, NDArray) else x)
+
         states = {}
         if self._updater is not None:
-            states = {k: jax.tree_util.tree_map(np.asarray, v)
+            states = {k: jax.tree_util.tree_map(to_np, v)
                       for k, v in getattr(self._updater, "states", {}).items()}
         with open(fname, "wb") as f:
             pickle.dump(states, f)
@@ -175,7 +278,13 @@ class KVStore:
     def load_optimizer_states(self, fname):
         import pickle
         with open(fname, "rb") as f:
-            pickle.load(f)
+            states = pickle.load(f)
+        if self._updater is None:
+            raise MXNetError("set_optimizer must be called before "
+                             "load_optimizer_states")
+        self._updater.states = {
+            k: jax.tree_util.tree_map(lambda x: NDArray(jnp.asarray(x)), v)
+            for k, v in states.items()}
 
     def barrier(self):
         from .ndarray.ndarray import waitall

@@ -6,7 +6,8 @@ TPU-first choices:
   * fused QKV projection — one (D, 3D) matmul feeding the MXU instead of
     three small ones;
   * attention rides ops.pallas_kernels.flash_attention (Pallas on TPU,
-    XLA reference off-TPU); padding masks use the masked XLA path;
+    XLA reference off-TPU); padding masks ride the kernel's kv_lengths
+    scalar-prefetch path — no dense (B,1,1,S) mask is ever built;
   * static-shape MLM: `masked_positions` (B, P) with a fixed prediction
     budget P, gathered with take_along_axis — no dynamic shapes under jit;
   * everything is a HybridBlock: `hybridize()` compiles the whole encoder
@@ -21,7 +22,7 @@ from ..base import MXNetError
 from ..ndarray.ndarray import NDArray, _apply
 from ..gluon import nn
 from ..gluon.block import HybridBlock
-from ..ops.pallas_kernels import flash_attention, attention_reference
+from ..ops.pallas_kernels import flash_attention
 
 __all__ = ["BERTModel", "BERTEncoder", "BERTEncoderLayer",
            "MultiHeadSelfAttention", "PositionwiseFFN", "BERTForPretraining",
@@ -54,21 +55,24 @@ class MultiHeadSelfAttention(HybridBlock):
                                  prefix="proj_")
             self.dropout = nn.Dropout(dropout)
 
-    def hybrid_forward(self, F, x, mask=None):
+    def hybrid_forward(self, F, x, valid_length=None):
         qkv = self.qkv(x)
         h = self._num_heads
 
-        def attn(qkv_raw, *maybe_mask):
+        def attn(qkv_raw, *maybe_vl):
             q, k, v = jnp.split(qkv_raw, 3, axis=-1)
             q, k, v = (_split_heads(t, h) for t in (q, k, v))
-            if maybe_mask:
-                # additive mask (B, 1, 1, S): masked XLA attention path
-                out = attention_reference(q, k, v, mask=maybe_mask[0])
+            if maybe_vl:
+                # padding mask as per-row kv length: rides the Pallas flash
+                # kernel's scalar-prefetch masked path (XLA mask fallback
+                # off-TPU) instead of a dense (B,1,1,S) additive mask
+                out = flash_attention(
+                    q, k, v, kv_lengths=maybe_vl[0].astype(jnp.int32))
             else:
                 out = flash_attention(q, k, v)
             return _merge_heads(out)
 
-        inputs = [qkv] + ([mask] if mask is not None else [])
+        inputs = [qkv] + ([valid_length] if valid_length is not None else [])
         out = _apply(attn, inputs)
         return self.dropout(self.proj(out))
 
@@ -99,8 +103,8 @@ class BERTEncoderLayer(HybridBlock):
             self.ffn = PositionwiseFFN(units, hidden_size, dropout)
             self.ln2 = nn.LayerNorm(in_channels=units)
 
-    def hybrid_forward(self, F, x, mask=None):
-        x = self.ln1(x + self.attention(x, mask))
+    def hybrid_forward(self, F, x, valid_length=None):
+        x = self.ln1(x + self.attention(x, valid_length))
         return self.ln2(x + self.ffn(x))
 
 
@@ -120,7 +124,7 @@ class BERTEncoder(HybridBlock):
                     self.layers.add(BERTEncoderLayer(
                         units, hidden_size, num_heads, dropout))
 
-    def hybrid_forward(self, F, x, mask=None, position_weight=None):
+    def hybrid_forward(self, F, x, valid_length=None, position_weight=None):
         seq_len = x.shape[1]
 
         def add_pos(a, p):
@@ -129,7 +133,7 @@ class BERTEncoder(HybridBlock):
         x = _apply(add_pos, [x, position_weight])
         x = self.dropout(self.ln(x))
         for layer in self.layers:
-            x = layer(x, mask)
+            x = layer(x, valid_length)
         return x
 
 
@@ -163,25 +167,12 @@ class BERTModel(HybridBlock):
             self.mlm_bias = self.params.get("mlm_bias", shape=(vocab_size,),
                                             init="zeros")
 
-    def _attn_mask(self, token_ids, valid_length):
-        """valid_length (B,) -> additive mask (B, 1, 1, S)."""
-        seq_len = token_ids.shape[1]
-
-        def build(vl):
-            pos = jnp.arange(seq_len)[None, :]
-            keep = pos < vl[:, None]
-            return jnp.where(keep, 0.0, -1e9)[:, None, None, :]
-
-        return _apply(build, [valid_length])
-
     def hybrid_forward(self, F, token_ids, segment_ids, valid_length=None,
                        masked_positions=None, mlm_bias=None):
         # mlm_bias arrives as a registered-param kwarg; decode_mlm reads it
         # through Parameter.data() so the tied path stays uniform
         x = self.word_embed(token_ids) + self.token_type_embed(segment_ids)
-        mask = (self._attn_mask(token_ids, valid_length)
-                if valid_length is not None else None)
-        seq = self.encoder(x, mask)
+        seq = self.encoder(x, valid_length)
         pooled = self.pooler(seq.slice_axis(axis=1, begin=0, end=1)
                              .reshape((0, -1)))
         if masked_positions is None:

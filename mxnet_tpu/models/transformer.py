@@ -7,7 +7,8 @@ TPU-first choices:
   * fused QKV for self-attention, fused KV for cross-attention (MXU-sized
     matmuls);
   * causal self-attention in the decoder via ops.pallas_kernels
-    flash_attention(causal=True) when unmasked, masked XLA path otherwise;
+    flash_attention rides the Pallas kernels, with padding expressed as
+    per-row kv valid lengths (scalar-prefetch masked flash path);
   * beam search is ONE jitted program: `lax.scan` over decode steps with
     static (batch, beam, max_len) shapes — no dynamic shapes, no host sync
     inside the loop.
@@ -25,7 +26,7 @@ from ..base import MXNetError
 from ..ndarray.ndarray import NDArray, _apply
 from ..gluon import nn
 from ..gluon.block import HybridBlock, extract_pure_fn
-from ..ops.pallas_kernels import flash_attention, attention_reference
+from ..ops.pallas_kernels import flash_attention
 
 __all__ = ["TransformerEncoder", "TransformerDecoder", "TransformerNMT",
            "transformer_base", "beam_search", "sinusoid_table"]
@@ -51,13 +52,6 @@ def _merge_heads(x):
     return x.transpose(0, 2, 1, 3).reshape(b, s, h * dh)
 
 
-def _length_mask(valid_length, seq_len):
-    """(B,) -> additive (B, 1, 1, S)."""
-    pos = jnp.arange(seq_len)[None, :]
-    keep = pos < valid_length[:, None]
-    return jnp.where(keep, 0.0, -1e9)[:, None, None, :]
-
-
 class SelfAttention(HybridBlock):
     """Fused-QKV self-attention; causal flag for decoder use."""
 
@@ -74,20 +68,17 @@ class SelfAttention(HybridBlock):
                                  prefix="proj_")
             self.dropout = nn.Dropout(dropout)
 
-    def hybrid_forward(self, F, x, mask=None):
+    def hybrid_forward(self, F, x, valid_length=None):
         h, causal = self._h, self._causal
 
-        def attn(qkv_raw, *maybe_mask):
+        def attn(qkv_raw, *maybe_vl):
             q, k, v = jnp.split(qkv_raw, 3, axis=-1)
             q, k, v = (_split_heads(t, h) for t in (q, k, v))
-            if maybe_mask:
-                out = attention_reference(q, k, v, causal=causal,
-                                          mask=maybe_mask[0])
-            else:
-                out = flash_attention(q, k, v, causal=causal)
+            kv_len = maybe_vl[0].astype(jnp.int32) if maybe_vl else None
+            out = flash_attention(q, k, v, causal=causal, kv_lengths=kv_len)
             return _merge_heads(out)
 
-        inputs = [self.qkv(x)] + ([mask] if mask is not None else [])
+        inputs = [self.qkv(x)] +             ([valid_length] if valid_length is not None else [])
         return self.dropout(self.proj(_apply(attn, inputs)))
 
 
@@ -106,21 +97,21 @@ class CrossAttention(HybridBlock):
                                  prefix="proj_")
             self.dropout = nn.Dropout(dropout)
 
-    def hybrid_forward(self, F, x, memory, mem_mask=None):
+    def hybrid_forward(self, F, x, memory, mem_valid_length=None):
         h = self._h
 
-        def attn(q_raw, kv_raw, *maybe_mask):
+        def attn(q_raw, kv_raw, *maybe_vl):
             k, v = jnp.split(kv_raw, 2, axis=-1)
             q = _split_heads(q_raw, h)
             k = _split_heads(k, h)
             v = _split_heads(v, h)
-            mask = maybe_mask[0] if maybe_mask else None
-            out = attention_reference(q, k, v, mask=mask)
+            kv_len = maybe_vl[0].astype(jnp.int32) if maybe_vl else None
+            out = flash_attention(q, k, v, kv_lengths=kv_len)
             return _merge_heads(out)
 
         inputs = [self.q(x), self.kv(memory)]
-        if mem_mask is not None:
-            inputs.append(mem_mask)
+        if mem_valid_length is not None:
+            inputs.append(mem_valid_length)
         return self.dropout(self.proj(_apply(attn, inputs)))
 
 
@@ -147,8 +138,8 @@ class EncoderLayer(HybridBlock):
             self.ffn = _FFN(units, hidden, dropout)
             self.ln2 = nn.LayerNorm(in_channels=units)
 
-    def hybrid_forward(self, F, x, mask=None):
-        x = self.ln1(x + self.attn(x, mask))
+    def hybrid_forward(self, F, x, valid_length=None):
+        x = self.ln1(x + self.attn(x, valid_length))
         return self.ln2(x + self.ffn(x))
 
 
@@ -164,9 +155,10 @@ class DecoderLayer(HybridBlock):
             self.ffn = _FFN(units, hidden, dropout)
             self.ln3 = nn.LayerNorm(in_channels=units)
 
-    def hybrid_forward(self, F, x, memory, self_mask=None, mem_mask=None):
-        x = self.ln1(x + self.self_attn(x, self_mask))
-        x = self.ln2(x + self.cross_attn(x, memory, mem_mask))
+    def hybrid_forward(self, F, x, memory, self_valid_length=None,
+                       mem_valid_length=None):
+        x = self.ln1(x + self.self_attn(x, self_valid_length))
+        x = self.ln2(x + self.cross_attn(x, memory, mem_valid_length))
         return self.ln3(x + self.ffn(x))
 
 
@@ -184,7 +176,7 @@ class TransformerEncoder(HybridBlock):
                     self.layers.add(EncoderLayer(units, hidden, num_heads,
                                                  dropout))
 
-    def hybrid_forward(self, F, x, mask=None):
+    def hybrid_forward(self, F, x, valid_length=None):
         s = x.shape[1]
         pos, scale = self._pos, self._scale
 
@@ -193,7 +185,7 @@ class TransformerEncoder(HybridBlock):
 
         x = self.dropout(_apply(add_pos, [x]))
         for layer in self.layers:
-            x = layer(x, mask)
+            x = layer(x, valid_length)
         return x
 
 
@@ -211,8 +203,8 @@ class TransformerDecoder(HybridBlock):
                     self.layers.add(DecoderLayer(units, hidden, num_heads,
                                                  dropout))
 
-    def hybrid_forward(self, F, x, memory, self_mask=None, mem_mask=None,
-                       position_offset=0):
+    def hybrid_forward(self, F, x, memory, self_valid_length=None,
+                       mem_valid_length=None, position_offset=0):
         s = x.shape[1]
         pos, scale = self._pos, self._scale
         off = position_offset
@@ -222,7 +214,7 @@ class TransformerDecoder(HybridBlock):
 
         x = self.dropout(_apply(add_pos, [x]))
         for layer in self.layers:
-            x = layer(x, memory, self_mask, mem_mask)
+            x = layer(x, memory, self_valid_length, mem_valid_length)
         return x
 
 
@@ -245,12 +237,8 @@ class TransformerNMT(HybridBlock):
                                               num_heads, max_length, dropout)
 
     def encode(self, src, src_valid_length=None):
-        mask = None
-        if src_valid_length is not None:
-            s = src.shape[1]
-            mask = _apply(lambda vl, _s=s: _length_mask(vl, _s),
-                          [src_valid_length])
-        return self.encoder(self.embed(src), mask), mask
+        return (self.encoder(self.embed(src), src_valid_length),
+                src_valid_length)
 
     def project(self, x):
         """Tied output projection: logits = x @ embed.T."""
@@ -258,8 +246,8 @@ class TransformerNMT(HybridBlock):
         return _apply(lambda a, ww: jnp.einsum("bsd,vd->bsv", a, ww), [x, w])
 
     def hybrid_forward(self, F, src, tgt, src_valid_length=None):
-        memory, mem_mask = self.encode(src, src_valid_length)
-        out = self.decoder(self.embed(tgt), memory, None, mem_mask)
+        memory, mem_vl = self.encode(src, src_valid_length)
+        out = self.decoder(self.embed(tgt), memory, None, mem_vl)
         return self.project(out)
 
 

@@ -607,6 +607,10 @@ def full(shape, val, ctx=None, dtype=None, **kwargs):
 
 
 def empty(shape, ctx=None, dtype=None):
+    """Allocate without defined contents. Documented divergence: XLA has no
+    uninitialised-buffer primitive (every jnp array is a defined value), so
+    this returns zeros — same shape/dtype/placement contract, deterministic
+    contents. Reference: ndarray.empty leaves memory uninitialised."""
     return zeros(shape, ctx=ctx, dtype=dtype)
 
 

@@ -36,14 +36,26 @@ __all__ = [
 # ---------------------------------------------------------------------------
 # pure kernels (jax.Array -> jax.Array)
 # ---------------------------------------------------------------------------
+def _amp_cast(x, weight):
+    """Op-level AMP autocast (amp.init()): fp32 matmul/conv inputs run on
+    the MXU in the AMP target dtype. Applied at trace time; no-op when AMP
+    is off or inputs are already low-precision."""
+    from ..amp import autocast_dtype
+    dt = autocast_dtype()
+    if dt is not None and x.dtype == jnp.float32:
+        return x.astype(dt), weight.astype(dt)
+    return x, weight
+
+
 def fully_connected(x, weight, bias=None, flatten=True):
     """y = x @ W^T + b. weight: (num_hidden, in_units) — reference convention
     (src/operator/nn/fully_connected.cc)."""
     if flatten and x.ndim > 2:
         x = x.reshape(x.shape[0], -1)
+    x, weight = _amp_cast(x, weight)
     y = jnp.matmul(x, weight.T)
     if bias is not None:
-        y = y + bias
+        y = y + bias.astype(y.dtype)
     return y
 
 
@@ -72,6 +84,7 @@ def convolution(x, weight, bias=None, stride=1, pad=0, dilate=1,
     spatial = layout.replace("N", "").replace("C", "")
     rhs = ("OI" + spatial) if layout.index("C") == 1 else ("O" + spatial + "I")
     dn = lax.conv_dimension_numbers(x.shape, weight.shape, (layout, rhs, layout))
+    x, weight = _amp_cast(x, weight)
     # bf16 in / bf16 out: the TPU MXU accumulates in fp32 internally, and a
     # preferred_element_type upcast would poison the conv transpose (the AD
     # rule requires cotangent dtype == primal dtype). fp32 master weights
@@ -88,7 +101,7 @@ def convolution(x, weight, bias=None, stride=1, pad=0, dilate=1,
         c_axis = layout.index("C")
         shape = [1] * y.ndim
         shape[c_axis] = -1
-        y = y + bias.reshape(shape)
+        y = y + bias.reshape(shape).astype(y.dtype)
     return y
 
 
@@ -151,7 +164,7 @@ def deconvolution(x, weight, bias=None, stride=1, pad=0, adj=0, layout=None):
         c_axis = layout.index("C")
         shape = [1] * y.ndim
         shape[c_axis] = -1
-        y = y + bias.reshape(shape)
+        y = y + bias.reshape(shape).astype(y.dtype)
     return y
 
 

@@ -51,8 +51,38 @@ def _interpret():
 
 
 def _pallas_ok(seq_len):
+    if os.environ.get("MXTPU_PALLAS_DISABLE") == "1":  # A/B vs XLA path
+        return False
     return (_HAS_PALLAS and (on_tpu() or _interpret())
             and seq_len % 128 == 0 and seq_len >= 128)
+
+
+def _block_sizes(sq, sk):
+    """Largest tiling block (<=512) that divides each sequence length —
+    bigger blocks amortise grid overhead and feed the MXU larger dots;
+    override with MXTPU_FLASH_BLOCK_Q / MXTPU_FLASH_BLOCK_K."""
+    def pick(s, env):
+        forced = int(os.environ.get(env, "0"))
+        if forced and s % forced == 0:
+            return min(forced, s)
+        for b in (512, 256, 128):
+            if s % b == 0:
+                return b
+        return 128
+    return pick(sq, "MXTPU_FLASH_BLOCK_Q"), pick(sk, "MXTPU_FLASH_BLOCK_K")
+
+
+_warned_fallback = set()
+
+
+def _warn_fallback(site, err):
+    """The Pallas path raising and silently taking the XLA path cost a 10%
+    bench regression once (r2); surface it loudly, once per site."""
+    if site not in _warned_fallback:
+        _warned_fallback.add(site)
+        import warnings
+        warnings.warn(f"pallas {site} kernel failed, using XLA fallback: "
+                      f"{err!r}", RuntimeWarning, stacklevel=3)
 
 
 # ---------------------------------------------------------------------------
@@ -60,7 +90,10 @@ def _pallas_ok(seq_len):
 # ---------------------------------------------------------------------------
 def attention_reference(q, k, v, causal=False, sm_scale=None, mask=None):
     """q,k,v: (B, H, S, D). Plain XLA attention — fused well by XLA, used as
-    the fallback and as the recompute backward for the Pallas forward."""
+    the fallback and as the recompute backward for the Pallas forward.
+
+    mask: boolean (True = attend) or additive float (0 = attend, large
+    negative = masked), broadcastable to (B, H, Sq, Sk)."""
     if sm_scale is None:
         sm_scale = 1.0 / (q.shape[-1] ** 0.5)
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
@@ -70,7 +103,10 @@ def attention_reference(q, k, v, causal=False, sm_scale=None, mask=None):
         kj = lax.broadcasted_iota(jnp.int32, s.shape[-2:], 1)
         s = jnp.where(qi >= kj, s, -1e30)
     if mask is not None:
-        s = jnp.where(mask, s, -1e30)
+        if mask.dtype == jnp.bool_:
+            s = jnp.where(mask, s, -1e30)
+        else:  # additive convention
+            s = s + mask.astype(s.dtype)
     p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
     return jnp.einsum("bhqk,bhkd->bhqd", p, v)
 
@@ -78,9 +114,17 @@ def attention_reference(q, k, v, causal=False, sm_scale=None, mask=None):
 # ---------------------------------------------------------------------------
 # Pallas flash attention forward
 # ---------------------------------------------------------------------------
-def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
-                      acc_scr, *, sm_scale, causal, block_q, block_k,
-                      seq_len):
+def _flash_fwd_kernel(*refs, sm_scale, causal, block_q, block_k,
+                      num_heads, has_lengths):
+    """has_lengths: a scalar-prefetch (B,) int32 `kv_lengths` ref leads the
+    arg list; key positions >= kv_lengths[b] are masked (padding mask) and
+    fully-masked kv blocks are skipped dynamically."""
+    if has_lengths:
+        (vl_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
+         m_scr, l_scr, acc_scr) = refs
+    else:
+        vl_ref = None
+        q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr = refs
     kb = pl.program_id(2)
     nk = pl.num_programs(2)
 
@@ -93,32 +137,46 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
     qb = pl.program_id(1)
     q_start = qb * block_q
     k_start = kb * block_k
+    vl = vl_ref[pl.program_id(0) // num_heads] if has_lengths else None
 
     def compute():
-        q = q_ref[0].astype(jnp.float32)          # (bq, d)
-        k = k_ref[0].astype(jnp.float32)          # (bk, d)
-        v = v_ref[0].astype(jnp.float32)          # (bk, d)
+        # dots run in the INPUT dtype (bf16 on the bench path — 2x MXU rate
+        # vs f32) with fp32 accumulation; softmax math stays fp32
+        q = q_ref[0]                               # (bq, d)
+        k = k_ref[0]                               # (bk, d)
+        v = v_ref[0]                               # (bk, d)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * sm_scale
         if causal:
             qi = q_start + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
             kj = k_start + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
             s = jnp.where(qi >= kj, s, -1e30)
+        if has_lengths:
+            kj = k_start + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(kj < vl, s, -1e30)
 
         m_prev = m_scr[:, :1]                      # (bq, 1)
         m_cur = jnp.max(s, axis=-1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_cur)
         alpha = jnp.exp(m_prev - m_new)
-        p = jnp.exp(s - m_new)                     # (bq, bk)
+        p = jnp.exp(s - m_new)                     # (bq, bk) fp32
         l_new = alpha * l_scr[:, :1] + jnp.sum(p, axis=-1, keepdims=True)
         acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
         m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
         l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
 
+    # skip kv blocks that are fully masked (above the causal diagonal /
+    # entirely beyond the valid length)
+    live = True
     if causal:
-        # skip fully-masked kv blocks above the diagonal
-        @pl.when(k_start <= q_start + block_q - 1)
+        live = k_start <= q_start + block_q - 1
+    if has_lengths:
+        live = jnp.logical_and(live, k_start < vl) if causal \
+            else k_start < vl
+    if causal or has_lengths:
+        @pl.when(live)
         def _():
             compute()
     else:
@@ -126,68 +184,124 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
 
     @pl.when(kb == nk - 1)
     def _finalize():
-        o_ref[0] = (acc_scr[:] / l_scr[:, :1]).astype(o_ref.dtype)
-        lse_ref[0] = m_scr[:, 0] + jnp.log(l_scr[:, 0])
+        # guard: a row with every key masked (kv_length 0) has l == 0
+        o_ref[0] = (acc_scr[:] /
+                    jnp.maximum(l_scr[:, :1], 1e-30)).astype(o_ref.dtype)
+        # lse broadcast across the 128-lane minor dim (Mosaic needs the last
+        # two block dims (8,128)-aligned, so a (block_q,) vector can't be an
+        # output on its own)
+        lse_ref[0] = m_scr[:] + jnp.log(jnp.maximum(l_scr[:], 1e-30))
 
 
-def _flash_fwd_pallas(q, k, v, causal, sm_scale, block_q=128, block_k=128):
-    """Returns (out, lse); lse is the per-row logsumexp of the scaled logits,
-    shape (B*H, S) fp32 — the backward kernels' softmax residual."""
-    b, h, s, d = q.shape
+def _flash_fwd_pallas(q, k, v, causal, sm_scale, lengths=None,
+                      block_q=None, block_k=None):
+    """Returns (out, lse); lse is the per-row logsumexp of the scaled
+    logits, shape (B*H, S, 128) fp32 with the value broadcast across the
+    minor (lane) dim — the backward kernels' softmax residual.
+    lengths: optional (B,) int32 kv valid lengths (padding mask).
+    Sq and Sk may differ (cross-attention); causal requires Sq == Sk."""
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
     bh = b * h
-    qr = q.reshape(bh, s, d)
-    kr = k.reshape(bh, s, d)
-    vr = v.reshape(bh, s, d)
-    grid = (bh, pl.cdiv(s, block_q), pl.cdiv(s, block_k))
+    if block_q is None or block_k is None:
+        block_q, block_k = _block_sizes(sq, sk)
+    qr = q.reshape(bh, sq, d)
+    kr = k.reshape(bh, sk, d)
+    vr = v.reshape(bh, sk, d)
+    grid = (bh, pl.cdiv(sq, block_q), pl.cdiv(sk, block_k))
+    has_lengths = lengths is not None
     kern = functools.partial(
         _flash_fwd_kernel, sm_scale=sm_scale, causal=causal,
-        block_q=block_q, block_k=block_k, seq_len=s)
-    out, lse = pl.pallas_call(
-        kern,
+        block_q=block_q, block_k=block_k, num_heads=h,
+        has_lengths=has_lengths)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1 if has_lengths else 0,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda bh_, i, j: (bh_, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bh_, i, j: (bh_, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bh_, i, j: (bh_, j, 0)),
+            pl.BlockSpec((1, block_q, d), lambda bh_, i, j, *_: (bh_, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh_, i, j, *_: (bh_, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh_, i, j, *_: (bh_, j, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, block_q, d), lambda bh_, i, j: (bh_, i, 0)),
-            pl.BlockSpec((1, block_q), lambda bh_, i, j: (bh_, i)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((bh, s, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, s), jnp.float32),
+            pl.BlockSpec((1, block_q, d), lambda bh_, i, j, *_: (bh_, i, 0)),
+            pl.BlockSpec((1, block_q, 128), lambda bh_, i, j, *_: (bh_, i, 0)),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, 128), jnp.float32),
             pltpu.VMEM((block_q, 128), jnp.float32),
             pltpu.VMEM((block_q, d), jnp.float32),
         ],
+    )
+    call = pl.pallas_call(
+        kern,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, sq, 128), jnp.float32),
+        ],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=_interpret(),
-    )(qr, kr, vr)
-    return out.reshape(b, h, s, d), lse
+    )
+    if has_lengths:
+        out, lse = call(lengths.astype(jnp.int32), qr, kr, vr)
+    else:
+        out, lse = call(qr, kr, vr)
+    return out.reshape(b, h, sq, d), lse
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def flash_attention(q, k, v, causal=False, sm_scale=None):
+def flash_attention(q, k, v, causal=False, sm_scale=None, kv_lengths=None):
     """Fused attention. q,k,v: (B, H, S, D) -> (B, H, S, D).
 
     On TPU with S % 128 == 0 runs the Pallas flash kernel (O(S) memory,
     MXU matmuls in fp32 accumulation); otherwise the XLA reference path.
-    """
+
+    kv_lengths: optional (B,) int32 per-sequence valid key length (the
+    reference's padding mask expressed TPU-natively — key positions
+    >= kv_lengths[b] are masked, and fully-masked kv blocks are skipped
+    inside the kernel via scalar prefetch)."""
+    if kv_lengths is None:
+        return _flash_plain(q, k, v, causal, sm_scale)
+    return _flash_vl(q, k, v, kv_lengths, causal, sm_scale)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _flash_plain(q, k, v, causal=False, sm_scale=None):
     return _flash_attention_impl(q, k, v, causal, sm_scale)
+
+
+def _lengths_mask(lengths, seq_len):
+    """(B,) lengths -> (B, 1, 1, S) boolean mask for the XLA fallback."""
+    pos = jnp.arange(seq_len)[None, :]
+    return (pos < lengths[:, None])[:, None, None, :]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def _flash_vl(q, k, v, lengths, causal=False, sm_scale=None):
+    return _flash_vl_impl(q, k, v, lengths, causal, sm_scale)
+
+
+def _flash_vl_impl(q, k, v, lengths, causal, sm_scale):
+    if sm_scale is None:
+        sm_scale = 1.0 / (q.shape[-1] ** 0.5)
+    if _pallas_ok(q.shape[2]) and _pallas_ok(k.shape[2]):
+        try:
+            return _flash_fwd_pallas(q, k, v, causal, sm_scale,
+                                     lengths=lengths)[0]
+        except Exception as e:
+            _warn_fallback("flash_fwd_vl", e)
+    return attention_reference(q, k, v, causal=causal, sm_scale=sm_scale,
+                               mask=_lengths_mask(lengths, k.shape[2]))
 
 
 def _flash_attention_impl(q, k, v, causal, sm_scale):
     if sm_scale is None:
         sm_scale = 1.0 / (q.shape[-1] ** 0.5)
-    if _pallas_ok(q.shape[2]):
+    if _pallas_ok(q.shape[2]) and _pallas_ok(k.shape[2]):
         try:
             return _flash_fwd_pallas(q, k, v, causal, sm_scale)[0]
-        except Exception:
-            pass
+        except Exception as e:
+            _warn_fallback("flash_fwd", e)
     return attention_reference(q, k, v, causal=causal, sm_scale=sm_scale)
 
 
@@ -198,9 +312,15 @@ def _flash_attention_impl(q, k, v, causal, sm_scale):
 # Both recompute p = exp(s - lse) from the forward's logsumexp, so nothing
 # O(S^2) is ever materialised.
 # ---------------------------------------------------------------------------
-def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                          dk_ref, dv_ref, dk_scr, dv_scr, *,
-                          sm_scale, causal, block_q, block_k):
+def _flash_bwd_dkv_kernel(*refs, sm_scale, causal, block_q, block_k,
+                          num_heads, has_lengths):
+    if has_lengths:
+        (vl_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         dk_ref, dv_ref, dk_scr, dv_scr) = refs
+    else:
+        vl_ref = None
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         dk_ref, dv_ref, dk_scr, dv_scr) = refs
     kb = pl.program_id(1)
     qb = pl.program_id(2)
     nq = pl.num_programs(2)
@@ -212,31 +332,42 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     q_start = qb * block_q
     k_start = kb * block_k
+    vl = vl_ref[pl.program_id(0) // num_heads] if has_lengths else None
 
     def compute():
-        q = q_ref[0].astype(jnp.float32)           # (bq, d)
-        k = k_ref[0].astype(jnp.float32)           # (bk, d)
-        v = v_ref[0].astype(jnp.float32)           # (bk, d)
-        do = do_ref[0].astype(jnp.float32)         # (bq, d)
-        lse = lse_ref[0]                           # (bq,)
-        delta = delta_ref[0]                       # (bq,)
+        q = q_ref[0]                               # (bq, d) input dtype
+        k = k_ref[0]                               # (bk, d)
+        v = v_ref[0]                               # (bk, d)
+        do = do_ref[0]                             # (bq, d)
+        lse = lse_ref[0][:, :1]                    # (bq, 1) lane-broadcast
+        delta = delta_ref[0][:, :1]                # (bq, 1)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * sm_scale
         if causal:
             qi = q_start + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
             kj = k_start + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
             s = jnp.where(qi >= kj, s, -1e30)
-        p = jnp.exp(s - lse[:, None])              # (bq, bk)
+        if has_lengths:
+            kj = k_start + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(kj < vl, s, -1e30)
+        p = jnp.exp(s - lse).astype(do.dtype)      # (bq, bk)
         dv_scr[:] += jax.lax.dot_general(          # p^T @ dO
             p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(                  # dO @ V^T
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[:, None]) * sm_scale
+        ds = (p.astype(jnp.float32) * (dp - delta)
+              * sm_scale).astype(q.dtype)
         dk_scr[:] += jax.lax.dot_general(          # dS^T @ Q
             ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
 
+    live = True
     if causal:
-        @pl.when(k_start <= q_start + block_q - 1)
+        live = k_start <= q_start + block_q - 1
+    if has_lengths:
+        live = jnp.logical_and(live, k_start < vl) if causal \
+            else k_start < vl
+    if causal or has_lengths:
+        @pl.when(live)
         def _():
             compute()
     else:
@@ -248,9 +379,15 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
 
 
-def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                         dq_ref, dq_scr, *, sm_scale, causal, block_q,
-                         block_k):
+def _flash_bwd_dq_kernel(*refs, sm_scale, causal, block_q, block_k,
+                         num_heads, has_lengths):
+    if has_lengths:
+        (vl_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         dq_ref, dq_scr) = refs
+    else:
+        vl_ref = None
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         dq_ref, dq_scr) = refs
     qb = pl.program_id(1)
     kb = pl.program_id(2)
     nk = pl.num_programs(2)
@@ -261,29 +398,39 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     q_start = qb * block_q
     k_start = kb * block_k
+    vl = vl_ref[pl.program_id(0) // num_heads] if has_lengths else None
 
     def compute():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
-        lse = lse_ref[0]
-        delta = delta_ref[0]
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
+        lse = lse_ref[0][:, :1]
+        delta = delta_ref[0][:, :1]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * sm_scale
         if causal:
             qi = q_start + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
             kj = k_start + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
             s = jnp.where(qi >= kj, s, -1e30)
-        p = jnp.exp(s - lse[:, None])
+        if has_lengths:
+            kj = k_start + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(kj < vl, s, -1e30)
+        p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[:, None]) * sm_scale
+        ds = (p * (dp - delta) * sm_scale).astype(k.dtype)
         dq_scr[:] += jax.lax.dot_general(          # dS @ K
             ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
 
+    live = True
     if causal:
-        @pl.when(k_start <= q_start + block_q - 1)
+        live = k_start <= q_start + block_q - 1
+    if has_lengths:
+        live = jnp.logical_and(live, k_start < vl) if causal \
+            else k_start < vl
+    if causal or has_lengths:
+        @pl.when(live)
         def _():
             compute()
     else:
@@ -294,65 +441,84 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
 
 
-def _flash_bwd_pallas(q, k, v, o, lse, g, causal, sm_scale,
-                      block_q=128, block_k=128):
-    b, h, s, d = q.shape
+def _flash_bwd_pallas(q, k, v, o, lse, g, causal, sm_scale, lengths=None,
+                      block_q=None, block_k=None):
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
     bh = b * h
-    qr = q.reshape(bh, s, d)
-    kr = k.reshape(bh, s, d)
-    vr = v.reshape(bh, s, d)
-    gr = g.reshape(bh, s, d)
+    if block_q is None or block_k is None:
+        block_q, block_k = _block_sizes(sq, sk)
+    qr = q.reshape(bh, sq, d)
+    kr = k.reshape(bh, sk, d)
+    vr = v.reshape(bh, sk, d)
+    gr = g.reshape(bh, sq, d)
     # delta_i = rowsum(dO ∘ O): the softmax-jacobian correction term; cheap
-    # elementwise+reduce, left to XLA.
+    # elementwise+reduce, left to XLA. Lane-broadcast to 128 like lse so the
+    # block shape is Mosaic-tileable.
     delta = jnp.sum(o.astype(jnp.float32) * g.astype(jnp.float32),
-                    axis=-1).reshape(bh, s)
-    nq = pl.cdiv(s, block_q)
-    nk = pl.cdiv(s, block_k)
+                    axis=-1).reshape(bh, sq)
+    delta = jnp.broadcast_to(delta[..., None], (bh, sq, 128))
+    lse = jnp.broadcast_to(lse[..., None], (bh, sq, 128))  # compact residual
+    nq = pl.cdiv(sq, block_q)
+    nk = pl.cdiv(sk, block_k)
+    has_lengths = lengths is not None
+    nsp = 1 if has_lengths else 0
+    scal = (lengths.astype(jnp.int32),) if has_lengths else ()
 
-    qspec = pl.BlockSpec((1, block_q, d), lambda b_, j, i: (b_, i, 0))
-    kspec = pl.BlockSpec((1, block_k, d), lambda b_, j, i: (b_, j, 0))
-    rowq = pl.BlockSpec((1, block_q), lambda b_, j, i: (b_, i))
+    qspec = pl.BlockSpec((1, block_q, d), lambda b_, j, i, *_: (b_, i, 0))
+    kspec = pl.BlockSpec((1, block_k, d), lambda b_, j, i, *_: (b_, j, 0))
+    rowq = pl.BlockSpec((1, block_q, 128), lambda b_, j, i, *_: (b_, i, 0))
     dk, dv = pl.pallas_call(
         functools.partial(_flash_bwd_dkv_kernel, sm_scale=sm_scale,
-                          causal=causal, block_q=block_q, block_k=block_k),
-        grid=(bh, nk, nq),
-        in_specs=[qspec, kspec, kspec, qspec, rowq, rowq],
-        out_specs=[kspec, kspec],
-        out_shape=[jax.ShapeDtypeStruct((bh, s, d), q.dtype)] * 2,
-        scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
-                        pltpu.VMEM((block_k, d), jnp.float32)],
+                          causal=causal, block_q=block_q, block_k=block_k,
+                          num_heads=h, has_lengths=has_lengths),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=nsp,
+            grid=(bh, nk, nq),
+            in_specs=[qspec, kspec, kspec, qspec, rowq, rowq],
+            out_specs=[kspec, kspec],
+            scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
+                            pltpu.VMEM((block_k, d), jnp.float32)],
+        ),
+        out_shape=[jax.ShapeDtypeStruct((bh, sk, d), q.dtype)] * 2,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=_interpret(),
-    )(qr, kr, vr, gr, lse, delta)
+    )(*scal, qr, kr, vr, gr, lse, delta)
 
-    qspec2 = pl.BlockSpec((1, block_q, d), lambda b_, i, j: (b_, i, 0))
-    kspec2 = pl.BlockSpec((1, block_k, d), lambda b_, i, j: (b_, j, 0))
-    rowq2 = pl.BlockSpec((1, block_q), lambda b_, i, j: (b_, i))
+    qspec2 = pl.BlockSpec((1, block_q, d), lambda b_, i, j, *_: (b_, i, 0))
+    kspec2 = pl.BlockSpec((1, block_k, d), lambda b_, i, j, *_: (b_, j, 0))
+    rowq2 = pl.BlockSpec((1, block_q, 128), lambda b_, i, j, *_: (b_, i, 0))
     dq = pl.pallas_call(
         functools.partial(_flash_bwd_dq_kernel, sm_scale=sm_scale,
-                          causal=causal, block_q=block_q, block_k=block_k),
-        grid=(bh, nq, nk),
-        in_specs=[qspec2, kspec2, kspec2, qspec2, rowq2, rowq2],
-        out_specs=qspec2,
-        out_shape=jax.ShapeDtypeStruct((bh, s, d), q.dtype),
-        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+                          causal=causal, block_q=block_q, block_k=block_k,
+                          num_heads=h, has_lengths=has_lengths),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=nsp,
+            grid=(bh, nq, nk),
+            in_specs=[qspec2, kspec2, kspec2, qspec2, rowq2, rowq2],
+            out_specs=qspec2,
+            scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        ),
+        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=_interpret(),
-    )(qr, kr, vr, gr, lse, delta)
-    rs = (b, h, s, d)
-    return dq.reshape(rs), dk.reshape(rs), dv.reshape(rs)
+    )(*scal, qr, kr, vr, gr, lse, delta)
+    return (dq.reshape(b, h, sq, d), dk.reshape(b, h, sk, d),
+            dv.reshape(b, h, sk, d))
 
 
 def _flash_fwd_rule(q, k, v, causal, sm_scale):
     scale = sm_scale if sm_scale is not None else 1.0 / (q.shape[-1] ** 0.5)
-    if _pallas_ok(q.shape[2]):
+    if _pallas_ok(q.shape[2]) and _pallas_ok(k.shape[2]):
         try:
             out, lse = _flash_fwd_pallas(q, k, v, causal, scale)
-            return out, (q, k, v, out, lse)
-        except Exception:
-            pass
+            # residual kept compact: (bh, sq), not the lane-broadcast
+            # (bh, sq, 128) the kernel writes (128x the HBM held fwd->bwd)
+            return out, (q, k, v, out, lse[..., 0])
+        except Exception as e:
+            _warn_fallback("flash_fwd", e)
     out = attention_reference(q, k, v, causal=causal, sm_scale=scale)
     return out, (q, k, v, None, None)
 
@@ -363,8 +529,8 @@ def _flash_bwd_rule(causal, sm_scale, res, g):
     if o is not None and _pallas_ok(q.shape[2]):
         try:
             return _flash_bwd_pallas(q, k, v, o, lse, g, causal, scale)
-        except Exception:
-            pass
+        except Exception as e:
+            _warn_fallback("flash_bwd", e)
     # fallback: recompute-backward through the XLA reference
     _, vjp = jax.vjp(
         lambda q_, k_, v_: attention_reference(q_, k_, v_, causal=causal,
@@ -372,7 +538,44 @@ def _flash_bwd_rule(causal, sm_scale, res, g):
     return vjp(g)
 
 
-flash_attention.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+_flash_plain.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+def _flash_vl_fwd_rule(q, k, v, lengths, causal, sm_scale):
+    scale = sm_scale if sm_scale is not None else 1.0 / (q.shape[-1] ** 0.5)
+    if _pallas_ok(q.shape[2]) and _pallas_ok(k.shape[2]):
+        try:
+            out, lse = _flash_fwd_pallas(q, k, v, causal, scale,
+                                         lengths=lengths)
+            return out, (q, k, v, lengths, out, lse[..., 0])
+        except Exception as e:
+            _warn_fallback("flash_fwd_vl", e)
+    out = attention_reference(q, k, v, causal=causal, sm_scale=scale,
+                              mask=_lengths_mask(lengths, k.shape[2]))
+    return out, (q, k, v, lengths, None, None)
+
+
+def _flash_vl_bwd_rule(causal, sm_scale, res, g):
+    import numpy as np
+    q, k, v, lengths, o, lse = res
+    scale = sm_scale if sm_scale is not None else 1.0 / (q.shape[-1] ** 0.5)
+    dlen = np.zeros(lengths.shape, dtype=jax.dtypes.float0)
+    if o is not None and _pallas_ok(q.shape[2]):
+        try:
+            dq, dk, dv = _flash_bwd_pallas(q, k, v, o, lse, g, causal, scale,
+                                           lengths=lengths)
+            return dq, dk, dv, dlen
+        except Exception as e:
+            _warn_fallback("flash_bwd_vl", e)
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: attention_reference(
+            q_, k_, v_, causal=causal, sm_scale=scale,
+            mask=_lengths_mask(lengths, k.shape[2])), q, k, v)
+    dq, dk, dv = vjp(g)
+    return dq, dk, dv, dlen
+
+
+_flash_vl.defvjp(_flash_vl_fwd_rule, _flash_vl_bwd_rule)
 
 
 # ---------------------------------------------------------------------------

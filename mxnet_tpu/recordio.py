@@ -1,0 +1,224 @@
+"""RecordIO: the reference's binary record container
+(reference: python/mxnet/recordio.py + dmlc-core/src/recordio.cc).
+
+Same on-disk format as the reference so .rec files interoperate:
+
+  record  := [uint32 kMagic][uint32 lrec][payload][pad to 4 bytes]
+  lrec    := (cflag << 29) | length        (little-endian)
+  cflag   := 0 whole record; 1/2/3 begin/middle/end of a multi-part record
+             (payloads >= 2^29 - 1 bytes are split, as in dmlc-core)
+
+`IRHeader` + `pack`/`unpack` implement the image-record convention
+(flag, float label or flag-many float labels, id, id2) and
+`pack_img`/`unpack_img` encode/decode image payloads (PIL here; the
+reference uses OpenCV).
+
+`MXIndexedRecordIO` adds the `.idx` sidecar (``key\\tbyte-offset\\n`` lines)
+for random access — the format ImageRecordIter and the im2rec tooling use.
+
+Pure Python + NumPy: record IO is host-side input-pipeline work; the TPU
+never sees these bytes until the batch is device_put.
+"""
+from __future__ import annotations
+
+import collections
+import io as _io
+import os
+import struct
+
+import numpy as np
+
+from .base import MXNetError
+
+__all__ = ["MXRecordIO", "MXIndexedRecordIO", "IRHeader",
+           "pack", "unpack", "pack_img", "unpack_img"]
+
+_kMagic = 0xced7230a
+_LEN_MASK = (1 << 29) - 1
+_MAX_CHUNK = _LEN_MASK - 1
+
+
+def _lrec(cflag, length):
+    return (cflag << 29) | length
+
+
+class MXRecordIO:
+    """Sequential .rec reader/writer (reference: MXRecordIO)."""
+
+    def __init__(self, uri, flag):
+        if flag not in ("r", "w"):
+            raise MXNetError(f"invalid flag {flag!r}: use 'r' or 'w'")
+        self.uri = uri
+        self.flag = flag
+        self.is_open = False
+        self.open()
+
+    def open(self):
+        self.fp = open(self.uri, "rb" if self.flag == "r" else "wb")
+        self.is_open = True
+
+    def close(self):
+        if self.is_open:
+            self.fp.close()
+            self.is_open = False
+
+    def reset(self):
+        self.close()
+        self.open()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def tell(self):
+        return self.fp.tell()
+
+    def write(self, buf):
+        """Append one record (bytes)."""
+        if self.flag != "w":
+            raise MXNetError("record file opened for reading")
+        n = len(buf)
+        if n <= _MAX_CHUNK:
+            chunks = [(0, buf)]
+        else:  # multi-part framing, dmlc-core style
+            parts = [buf[i:i + _MAX_CHUNK] for i in range(0, n, _MAX_CHUNK)]
+            chunks = [(1, parts[0])]
+            chunks += [(2, p) for p in parts[1:-1]]
+            chunks.append((3, parts[-1]))
+        for cflag, part in chunks:
+            self.fp.write(struct.pack("<II", _kMagic,
+                                      _lrec(cflag, len(part))))
+            self.fp.write(part)
+            pad = (4 - len(part) % 4) % 4
+            if pad:
+                self.fp.write(b"\x00" * pad)
+
+    def read(self):
+        """Read the next record, or None at EOF."""
+        if self.flag != "r":
+            raise MXNetError("record file opened for writing")
+        out = []
+        while True:
+            header = self.fp.read(8)
+            if len(header) < 8:
+                if out:
+                    raise MXNetError("truncated multi-part record")
+                return None
+            magic, lrec = struct.unpack("<II", header)
+            if magic != _kMagic:
+                raise MXNetError(f"invalid record magic {magic:#x} in "
+                                 f"{self.uri}")
+            cflag, length = lrec >> 29, lrec & _LEN_MASK
+            data = self.fp.read(length)
+            if len(data) < length:
+                raise MXNetError("truncated record payload")
+            pad = (4 - length % 4) % 4
+            if pad:
+                self.fp.read(pad)
+            if cflag == 0:
+                return data
+            out.append(data)
+            if cflag == 3:
+                return b"".join(out)
+
+
+class MXIndexedRecordIO(MXRecordIO):
+    """.rec + .idx random-access pair (reference: MXIndexedRecordIO)."""
+
+    def __init__(self, idx_path, uri, flag, key_type=int):
+        self.idx_path = idx_path
+        self.idx = collections.OrderedDict()
+        self.key_type = key_type
+        super().__init__(uri, flag)
+        if flag == "r" and os.path.exists(idx_path):
+            with open(idx_path) as f:
+                for line in f:
+                    parts = line.strip().split("\t")
+                    if len(parts) == 2:
+                        self.idx[key_type(parts[0])] = int(parts[1])
+
+    @property
+    def keys(self):
+        return list(self.idx.keys())
+
+    def close(self):
+        if self.is_open and self.flag == "w":
+            with open(self.idx_path, "w") as f:
+                for k, pos in self.idx.items():
+                    f.write(f"{k}\t{pos}\n")
+        super().close()
+
+    def seek(self, idx):
+        if idx not in self.idx:
+            raise MXNetError(f"key {idx} not in index")
+        self.fp.seek(self.idx[idx])
+
+    def read_idx(self, idx):
+        self.seek(idx)
+        return self.read()
+
+    def write_idx(self, idx, buf):
+        key = self.key_type(idx)
+        self.idx[key] = self.tell()
+        self.write(buf)
+
+
+IRHeader = collections.namedtuple("IRHeader", ["flag", "label", "id", "id2"])
+_IR_FORMAT = "<IfQQ"
+_IR_SIZE = struct.calcsize(_IR_FORMAT)
+
+
+def pack(header, s):
+    """IRHeader + payload bytes -> record bytes. flag > 0 means the label
+    is a (flag,)-float array stored after the fixed header."""
+    header = IRHeader(*header)
+    if header.flag > 0:
+        label = np.asarray(header.label, dtype=np.float32)
+        if label.size != header.flag:
+            raise MXNetError(f"label size {label.size} != flag {header.flag}")
+        header = header._replace(label=0.0)
+        return struct.pack(_IR_FORMAT, *header) + label.tobytes() + s
+    return struct.pack(_IR_FORMAT, *header) + s
+
+
+def unpack(s):
+    """record bytes -> (IRHeader, payload bytes)."""
+    header = IRHeader(*struct.unpack(_IR_FORMAT, s[:_IR_SIZE]))
+    s = s[_IR_SIZE:]
+    if header.flag > 0:
+        label = np.frombuffer(s[:header.flag * 4], dtype=np.float32)
+        header = header._replace(label=label)
+        s = s[header.flag * 4:]
+    return header, s
+
+
+def pack_img(header, img, quality=95, img_fmt=".jpg"):
+    """IRHeader + HWC uint8 image -> record bytes (PIL-encoded; the
+    reference encodes with cv2.imencode)."""
+    from PIL import Image
+    img = np.asarray(img, dtype=np.uint8)
+    buf = _io.BytesIO()
+    fmt = "JPEG" if img_fmt.lower() in (".jpg", ".jpeg") else "PNG"
+    kw = {"quality": quality} if fmt == "JPEG" else {}
+    Image.fromarray(img).save(buf, format=fmt, **kw)
+    return pack(header, buf.getvalue())
+
+
+def unpack_img(s, iscolor=-1):
+    """record bytes -> (IRHeader, HWC uint8 ndarray)."""
+    from PIL import Image
+    header, payload = unpack(s)
+    img = Image.open(_io.BytesIO(payload))
+    if iscolor == 0:
+        img = img.convert("L")
+    elif iscolor == 1:
+        img = img.convert("RGB")
+    return header, np.asarray(img)

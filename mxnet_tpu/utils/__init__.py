@@ -1,0 +1,5 @@
+"""Utility subpackage (reference: python/mxnet/util.py + src/storage/*)."""
+from . import memory
+from .memory import memory_info, memory_stats
+
+__all__ = ["memory", "memory_info", "memory_stats"]

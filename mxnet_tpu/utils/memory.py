@@ -1,0 +1,104 @@
+"""Per-device memory statistics (SURVEY.md §2 #10).
+
+Reference parity: the reference exposes the storage manager's pool state via
+`mx.context.gpu_memory_info(dev_id)` (python/mxnet/context.py backed by
+src/storage/storage.cc). On TPU the PJRT runtime owns HBM, so the equivalent
+surface is `jax.Device.memory_stats()`; this module normalises it into the
+reference's (free, total) contract plus a richer stats dict.
+
+Platforms whose PJRT client doesn't implement memory_stats (notably the CPU
+test backend) get a psutil/os-based host-memory fallback so the API is
+always usable.
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+
+from ..base import MXNetError
+
+__all__ = ["memory_info", "memory_stats", "gpu_memory_info"]
+
+
+def _host_memory():
+    """(free, total) bytes of host RAM — fallback for backends without
+    PJRT memory stats (e.g. the CPU test mesh)."""
+    try:
+        total = os.sysconf("SC_PHYS_PAGES") * os.sysconf("SC_PAGE_SIZE")
+    except (ValueError, OSError, AttributeError):
+        return 0, 0
+    avail = total
+    try:
+        with open("/proc/meminfo") as f:
+            for line in f:
+                if line.startswith("MemAvailable:"):
+                    avail = int(line.split()[1]) * 1024
+                    break
+    except OSError:
+        pass
+    return avail, total
+
+
+# HBM per chip for TPU generations whose PJRT client (e.g. the axon tunnel)
+# doesn't implement memory_stats(); keyed by substring of device_kind.
+_HBM_TABLE = (
+    ("v5 lite", 16 << 30), ("v5e", 16 << 30), ("v5p", 95 << 30),
+    ("v4", 32 << 30), ("v3", 16 << 30), ("v2", 8 << 30), ("v6", 32 << 30),
+)
+
+
+def _hbm_from_kind(kind):
+    kind = (kind or "").lower()
+    for sub, size in _HBM_TABLE:
+        if sub in kind:
+            return size
+    return 0
+
+
+def _resolve_device(ctx_or_id=0):
+    from ..context import Context
+    if isinstance(ctx_or_id, Context):
+        return ctx_or_id.jax_device
+    if isinstance(ctx_or_id, jax.Device):
+        return ctx_or_id
+    devs = jax.devices()
+    i = int(ctx_or_id)
+    if i >= len(devs):
+        raise MXNetError(f"device {i} not available ({len(devs)} visible)")
+    return devs[i]
+
+
+def memory_stats(ctx_or_id=0):
+    """Raw per-device memory stats dict. Keys follow PJRT
+    (`bytes_in_use`, `bytes_limit`, `peak_bytes_in_use`, ...); backends
+    without PJRT stats report {'bytes_in_use': 0, 'bytes_limit': <host>}."""
+    dev = _resolve_device(ctx_or_id)
+    stats = None
+    try:
+        stats = dev.memory_stats()
+    except Exception:
+        stats = None
+    if stats:
+        return dict(stats)
+    if dev.platform != "cpu":
+        hbm = _hbm_from_kind(getattr(dev, "device_kind", ""))
+        if hbm:
+            return {"bytes_in_use": 0, "bytes_limit": hbm,
+                    "source": "device_kind table (PJRT stats unavailable)"}
+    free, total = _host_memory()
+    return {"bytes_in_use": max(total - free, 0), "bytes_limit": total,
+            "source": "host"}
+
+
+def memory_info(ctx_or_id=0):
+    """(free_bytes, total_bytes) for a device — the reference's
+    `gpu_memory_info` contract."""
+    s = memory_stats(ctx_or_id)
+    total = int(s.get("bytes_limit") or s.get("bytes_reservable_limit") or 0)
+    used = int(s.get("bytes_in_use") or 0)
+    return max(total - used, 0), total
+
+
+# reference-named alias
+gpu_memory_info = memory_info

@@ -72,3 +72,126 @@ def test_overflow_detection():
     y.backward()
     s = amp.DynamicLossScaler()
     assert s.has_overflow(list(net.collect_params().values()))
+
+
+@pytest.fixture
+def _amp_off():
+    yield
+    amp.reset()
+
+
+def test_init_autocasts_dense_compute(_amp_off):
+    """amp.init() must actually change op compute dtype: fp32 in, bf16 out."""
+    net = nn.Dense(4, in_units=4)
+    net.initialize()
+    x = nd.ones((2, 4))
+    assert "float32" in str(net(x).dtype)
+    amp.init("bfloat16")
+    y = net(x)
+    assert "bfloat16" in str(y.dtype)
+    # params stay fp32 masters
+    assert "float32" in str(net.weight.data().dtype)
+
+
+def test_convert_block_fixes_blanket_cast(_amp_off):
+    """_KEEP_FP32 is live: convert_block after net.cast('bfloat16') restores
+    the norm layers to fp32."""
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, in_units=4), nn.BatchNorm(axis=1, in_channels=8))
+    net.initialize()
+    net.cast("bfloat16")
+    assert "bfloat16" in str(net[1].gamma.data().dtype)
+    amp.convert_block(net, "bfloat16")
+    assert "float32" in str(net[1].gamma.data().dtype)
+    assert "bfloat16" in str(net[0].weight.data().dtype)
+
+
+def test_trainer_skips_update_on_overflow_and_halves_scale(_amp_off):
+    """The VERDICT-mandated test: force an overflow, assert the update is
+    skipped and the loss scale halves."""
+    amp.init("float16")
+    scaler = amp._state["scaler"]
+    scaler.loss_scale = 1024.0
+    net = nn.Dense(2, in_units=2)
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    w0 = net.weight.data().asnumpy().copy()
+    x = nd.ones((1, 2))
+    with autograd.record():
+        loss = amp.scale_loss(net(x).sum() * float("inf"))
+    loss.backward()
+    trainer.step(1)
+    np.testing.assert_allclose(net.weight.data().asnumpy(), w0)  # skipped
+    assert scaler.loss_scale == 512.0                            # halved
+    # a clean step afterwards must update
+    with autograd.record():
+        loss = amp.scale_loss(net(x).sum())
+    loss.backward()
+    trainer.step(1)
+    assert not np.allclose(net.weight.data().asnumpy(), w0)
+
+
+def test_init_busts_hybridize_cache(_amp_off):
+    """amp.init() after a hybridized net compiled must still take effect
+    (the jit cache is keyed on the autocast dtype)."""
+    net = nn.Dense(4, in_units=4)
+    net.initialize()
+    net.hybridize()
+    x = nd.ones((2, 4))
+    assert "float32" in str(net(x).dtype)   # compiled pre-AMP
+    amp.init("bfloat16")
+    assert "bfloat16" in str(net(x).dtype)  # fresh trace post-AMP
+    amp.reset()
+    assert "float32" in str(net(x).dtype)   # and back
+
+
+def test_trainer_update_also_guarded(_amp_off):
+    """The allreduce_grads()+update() flow must hit the same AMP
+    unscale/overflow guard as step()."""
+    amp.init("float16")
+    scaler = amp._state["scaler"]
+    scaler.loss_scale = 1024.0
+    net = nn.Dense(2, in_units=2)
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    w0 = net.weight.data().asnumpy().copy()
+    x = nd.ones((1, 2))
+    with autograd.record():
+        loss = amp.scale_loss(net(x).sum() * float("inf"))
+    loss.backward()
+    trainer.allreduce_grads()
+    trainer.update(1)
+    np.testing.assert_allclose(net.weight.data().asnumpy(), w0)
+    assert scaler.loss_scale == 512.0
+    # clean grads: update() must unscale before applying
+    with autograd.record():
+        loss = amp.scale_loss(net(x).sum())
+    loss.backward()
+    trainer.allreduce_grads()
+    trainer.update(1)
+    w1 = net.weight.data().asnumpy()
+    assert not np.allclose(w1, w0)
+    # grad of sum(xW^T+b) wrt W is x=1; unscaled update = lr*1 = 0.1
+    np.testing.assert_allclose(w0 - w1, np.full_like(w0, 0.1), rtol=1e-3)
+
+
+def test_trainer_skip_nonfinite(_amp_off):
+    """skip_nonfinite guards non-AMP training too (§5 failure detection)."""
+    net = nn.Dense(2, in_units=2)
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1}, skip_nonfinite=True)
+    w0 = net.weight.data().asnumpy().copy()
+    x = nd.ones((1, 2))
+    with autograd.record():
+        loss = net(x).sum() * float("nan")
+    loss.backward()
+    trainer.step(1)
+    np.testing.assert_allclose(net.weight.data().asnumpy(), w0)
+    with autograd.record():
+        loss = net(x).sum()
+    loss.backward()
+    trainer.step(1)
+    assert not np.allclose(net.weight.data().asnumpy(), w0)

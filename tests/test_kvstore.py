@@ -68,3 +68,85 @@ def test_ici_mesh_allreduce():
     out = nd.zeros((8, 2))
     kv.pull("g", out=out)
     np.testing.assert_allclose(out.asnumpy(), np.full((8, 2), 1.0))
+
+
+def _dp_mesh():
+    from mxnet_tpu.parallel.mesh import make_mesh
+    return make_mesh({"dp": 8})
+
+
+def test_ici_allreduce_stacked_layout():
+    """A (R, *shape) stack sharded over the dp axis reduces to (*shape):
+    8 replicas each contribute their row, result is the row-sum."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    mesh = _dp_mesh()
+    kv = kvstore.create("ici").set_mesh(mesh)
+    stacked = np.arange(8 * 3, dtype=np.float32).reshape(8, 3)
+    a = jax.device_put(stacked, NamedSharding(mesh, P("dp")))
+    # auto-detects stacked from the sharding
+    got = kv.allreduce_([a])
+    np.testing.assert_allclose(np.asarray(got), stacked.sum(0))
+    # explicit layout gives the same
+    got2 = kv.allreduce_([a], layout="stacked")
+    np.testing.assert_allclose(np.asarray(got2), stacked.sum(0))
+
+
+def test_ici_allreduce_replicated_layout():
+    """A replicated gradient (XLA already psum'd it inside the step) must NOT
+    be multiplied by the axis size."""
+    import jax
+    mesh = _dp_mesh()
+    kv = kvstore.create("ici").set_mesh(mesh)
+    a = np.full((8, 2), 3.0, np.float32)  # host array: replicated semantics
+    got = kv.allreduce_([jax.numpy.asarray(a)])
+    np.testing.assert_allclose(np.asarray(got), a)
+    got2 = kv.allreduce_([jax.numpy.asarray(a)], layout="replicated")
+    np.testing.assert_allclose(np.asarray(got2), a)
+
+
+def test_ici_allreduce_stacked_bad_shape_raises():
+    mesh = _dp_mesh()
+    kv = kvstore.create("ici").set_mesh(mesh)
+    with pytest.raises(Exception):
+        kv.allreduce_([nd.ones((3, 2))._data], layout="stacked")
+
+
+def test_optimizer_states_roundtrip(tmp_path):
+    """save/load_optimizer_states must actually restore momentum buffers."""
+    kv = kvstore.create("local")
+    kv.set_optimizer(mx.optimizer.create("sgd", learning_rate=0.1,
+                                         momentum=0.9))
+    kv.init("w", nd.ones((3,)))
+    kv.push("w", [nd.ones((3,))])     # builds momentum state
+    fname = str(tmp_path / "opt.states")
+    kv.save_optimizer_states(fname)
+    w_after_1 = nd.array(kv.pull("w").asnumpy())  # copy: store mutates
+
+    kv2 = kvstore.create("local")
+    kv2.set_optimizer(mx.optimizer.create("sgd", learning_rate=0.1,
+                                          momentum=0.9))
+    kv2.init("w", w_after_1)          # weights come from the param ckpt
+    kv2.load_optimizer_states(fname)  # momentum comes from the state file
+    # one more push on both must produce identical weights (momentum carried)
+    kv.push("w", [nd.ones((3,))])
+    kv2.push("w", [nd.ones((3,))])
+    np.testing.assert_allclose(kv.pull("w").asnumpy(),
+                               kv2.pull("w").asnumpy())
+
+
+def test_load_optimizer_states_requires_optimizer(tmp_path):
+    kv = kvstore.create("local")
+    kv.set_optimizer(mx.optimizer.create("sgd"))
+    fname = str(tmp_path / "opt.states")
+    kv.save_optimizer_states(fname)
+    kv2 = kvstore.create("local")
+    with pytest.raises(Exception):
+        kv2.load_optimizer_states(fname)
+
+
+def test_init_distributed_single_host_noop():
+    """No cluster env, no args: init_distributed stays single-process."""
+    kvstore.init_distributed()
+    kv = kvstore.create("ici")
+    assert kv.num_workers == 1 and kv.rank == 0

@@ -1,0 +1,30 @@
+"""Memory-stats API tests (SURVEY.md §2 #10)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.utils import memory_info, memory_stats
+
+
+def test_memory_stats_keys():
+    s = memory_stats(0)
+    assert "bytes_in_use" in s and "bytes_limit" in s
+    assert s["bytes_limit"] >= 0 and s["bytes_in_use"] >= 0
+
+
+def test_memory_info_sane():
+    free, total = memory_info(0)
+    assert total > 0          # host fallback still reports real RAM
+    assert 0 <= free <= total
+
+
+def test_memory_info_via_context():
+    free, total = mx.context.memory_info(mx.cpu())
+    assert 0 <= free <= total and total > 0
+    free2, total2 = mx.context.gpu_memory_info(0)
+    assert 0 <= free2 <= total2
+
+
+def test_memory_info_bad_device():
+    with pytest.raises(Exception):
+        memory_info(10_000)

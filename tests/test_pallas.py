@@ -131,6 +131,85 @@ def test_flash_kernel_bwd_interpret(_pallas_interpret, causal):
                                    atol=2e-4)
 
 
+def test_flash_kv_lengths_matches_masked_reference():
+    """kv_lengths fallback path == boolean-masked reference (CPU path)."""
+    q, k, v = _qkv(s=128)
+    vl = jnp.array([64, 128])
+    got = flash_attention(q, k, v, kv_lengths=vl)
+    pos = jnp.arange(128)[None, :]
+    mask = (pos < vl[:, None])[:, None, None, :]
+    want = attention_reference(q, k, v, mask=mask)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-4,
+                               atol=2e-5)
+
+
+def test_attention_reference_additive_mask_convention():
+    """Additive masks (0 = keep, -1e9 = drop) must mask the RIGHT positions
+    (regression: the boolean interpretation inverted them)."""
+    q, k, v = _qkv(s=8)
+    vl = jnp.array([4, 8])
+    pos = jnp.arange(8)[None, :]
+    keep = pos < vl[:, None]
+    additive = jnp.where(keep, 0.0, -1e9)[:, None, None, :]
+    boolean = keep[:, None, None, :]
+    got = attention_reference(q, k, v, mask=additive)
+    want = attention_reference(q, k, v, mask=boolean)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-5,
+                               atol=1e-6)
+    # and masked != unmasked (the mask actually does something)
+    unmasked = attention_reference(q, k, v)
+    assert not np.allclose(np.asarray(got), np.asarray(unmasked))
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_kernel_lengths_interpret(_pallas_interpret, causal):
+    """The scalar-prefetch masked kernel (fwd + bwd) == masked XLA attention,
+    including combined with causal."""
+    q, k, v = _qkv(b=2, h=1, s=256, d=64)
+    vl = jnp.array([100, 256])
+    w = jax.random.normal(jax.random.PRNGKey(7), q.shape)
+    pos = jnp.arange(256)[None, :]
+    mask = (pos < vl[:, None])[:, None, None, :]
+
+    got = flash_attention(q, k, v, causal, kv_lengths=vl)
+    want = attention_reference(q, k, v, causal=causal, mask=mask)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-4,
+                               atol=2e-5)
+
+    def f_flash(q, k, v):
+        return (flash_attention(q, k, v, causal, kv_lengths=vl) * w).sum()
+
+    def f_ref(q, k, v):
+        return (attention_reference(q, k, v, causal=causal, mask=mask)
+                * w).sum()
+
+    g1 = jax.grad(f_flash, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-3,
+                                   atol=2e-4)
+
+
+def test_flash_kernel_rectangular_interpret(_pallas_interpret):
+    """Cross-attention shape: Sq != Sk rides the kernel (fwd + bwd)."""
+    ks = jax.random.split(jax.random.PRNGKey(1), 4)
+    q = jax.random.normal(ks[0], (1, 1, 128, 64))
+    k = jax.random.normal(ks[1], (1, 1, 256, 64))
+    v = jax.random.normal(ks[2], (1, 1, 256, 64))
+    w = jax.random.normal(ks[3], q.shape)
+    got = flash_attention(q, k, v)
+    want = attention_reference(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-4,
+                               atol=2e-5)
+    g1 = jax.grad(lambda *a: (flash_attention(*a) * w).sum(),
+                  argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(lambda *a: (attention_reference(*a) * w).sum(),
+                  argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-3,
+                                   atol=2e-4)
+
+
 def test_fused_ln_kernel_interpret(_pallas_interpret):
     x = jax.random.normal(jax.random.PRNGKey(0), (64, 128))
     g = jax.random.normal(jax.random.PRNGKey(1), (128,))

@@ -1,0 +1,165 @@
+"""RecordIO tests (SURVEY.md §1 serialization row; reference:
+tests/python/unittest/test_recordio.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import recordio
+
+
+def test_recordio_roundtrip(tmp_path):
+    path = str(tmp_path / "a.rec")
+    w = recordio.MXRecordIO(path, "w")
+    for i in range(5):
+        w.write(bytes([i]) * (i * 7 + 1))
+    w.close()
+    r = recordio.MXRecordIO(path, "r")
+    for i in range(5):
+        assert r.read() == bytes([i]) * (i * 7 + 1)
+    assert r.read() is None
+    r.reset()
+    assert r.read() == b"\x00"
+    r.close()
+
+
+def test_recordio_magic_framing(tmp_path):
+    """Framing matches the reference format: magic + lrec + 4-byte pad."""
+    import struct
+    path = str(tmp_path / "a.rec")
+    w = recordio.MXRecordIO(path, "w")
+    w.write(b"abcde")  # 5 bytes -> 3 pad
+    w.close()
+    blob = open(path, "rb").read()
+    magic, lrec = struct.unpack("<II", blob[:8])
+    assert magic == 0xced7230a
+    assert lrec >> 29 == 0 and (lrec & ((1 << 29) - 1)) == 5
+    assert blob[8:13] == b"abcde"
+    assert len(blob) == 16  # 8 header + 5 data + 3 pad
+
+
+def test_indexed_recordio(tmp_path):
+    rec_p = str(tmp_path / "a.rec")
+    idx_p = str(tmp_path / "a.idx")
+    w = recordio.MXIndexedRecordIO(idx_p, rec_p, "w")
+    for i in range(10):
+        w.write_idx(i, f"record{i}".encode())
+    w.close()
+    r = recordio.MXIndexedRecordIO(idx_p, rec_p, "r")
+    assert r.keys == list(range(10))
+    assert r.read_idx(7) == b"record7"
+    assert r.read_idx(2) == b"record2"  # random access, out of order
+    r.close()
+
+
+def test_pack_unpack_header():
+    h = recordio.IRHeader(0, 3.5, 42, 0)
+    s = recordio.pack(h, b"payload")
+    h2, payload = recordio.unpack(s)
+    assert payload == b"payload"
+    assert h2.id == 42 and abs(h2.label - 3.5) < 1e-6
+
+
+def test_pack_unpack_multi_label():
+    h = recordio.IRHeader(3, np.array([1.0, 2.0, 3.0], np.float32), 7, 0)
+    s = recordio.pack(h, b"x")
+    h2, payload = recordio.unpack(s)
+    assert payload == b"x"
+    np.testing.assert_allclose(h2.label, [1.0, 2.0, 3.0])
+
+
+def test_pack_unpack_img(tmp_path):
+    img = (np.random.RandomState(0).rand(32, 32, 3) * 255).astype(np.uint8)
+    s = recordio.pack_img(recordio.IRHeader(0, 1.0, 0, 0), img,
+                          img_fmt=".png")
+    h, img2 = recordio.unpack_img(s, iscolor=1)
+    np.testing.assert_array_equal(img, img2)  # png is lossless
+
+
+def test_image_record_iter_reads_rec(tmp_path):
+    """ImageRecordIter on a generated .rec yields the packed images."""
+    path = str(tmp_path / "im.rec")
+    rng = np.random.RandomState(0)
+    w = recordio.MXRecordIO(path, "w")
+    imgs = []
+    for i in range(8):
+        img = (rng.rand(8, 8, 3) * 255).astype(np.uint8)
+        imgs.append(img)
+        w.write(recordio.pack_img(recordio.IRHeader(0, float(i % 3), i, 0),
+                                  img, img_fmt=".png"))
+    w.close()
+
+    it = mx.io.ImageRecordIter(path_imgrec=path, data_shape=(3, 8, 8),
+                               batch_size=4)
+    batch = it.next()
+    data = batch.data[0].asnumpy()
+    label = batch.label[0].asnumpy()
+    assert data.shape == (4, 3, 8, 8)
+    np.testing.assert_allclose(data[0], imgs[0].astype(np.float32)
+                               .transpose(2, 0, 1))
+    np.testing.assert_allclose(label, [0, 1, 2, 0])
+    batch2 = it.next()
+    with pytest.raises(StopIteration):
+        it.next()
+
+
+def test_image_record_iter_indexed_lazy(tmp_path):
+    """With an .idx sidecar the iterator random-accesses lazily (no
+    whole-file load) and reset() re-iterates."""
+    rec_p = str(tmp_path / "im.rec")
+    idx_p = str(tmp_path / "im.idx")
+    rng = np.random.RandomState(0)
+    w = recordio.MXIndexedRecordIO(idx_p, rec_p, "w")
+    for i in range(6):
+        img = (rng.rand(8, 8, 3) * 255).astype(np.uint8)
+        w.write_idx(i, recordio.pack_img(
+            recordio.IRHeader(0, float(i), i, 0), img, img_fmt=".png"))
+    w.close()
+    it = mx.io.ImageRecordIter(path_imgrec=rec_p, data_shape=(3, 8, 8),
+                               batch_size=3)
+    assert it.num_samples == 6
+    b1 = it.next()
+    b2 = it.next()
+    np.testing.assert_allclose(b2.label[0].asnumpy(), [3, 4, 5])
+    with pytest.raises(StopIteration):
+        it.next()
+    it.reset()
+    np.testing.assert_allclose(it.next().label[0].asnumpy(), [0, 1, 2])
+
+
+def test_record_file_dataset(tmp_path):
+    from mxnet_tpu.gluon.data import RecordFileDataset
+    rec_p = str(tmp_path / "d.rec")
+    idx_p = str(tmp_path / "d.idx")
+    w = recordio.MXIndexedRecordIO(idx_p, rec_p, "w")
+    for i in range(6):
+        w.write_idx(i, f"item{i}".encode())
+    w.close()
+    ds = RecordFileDataset(rec_p)          # picks up the .idx sidecar
+    assert len(ds) == 6
+    assert ds[4] == b"item4"
+    # and without the index (sequential load)
+    import os
+    os.remove(idx_p)
+    ds2 = RecordFileDataset(rec_p)
+    assert len(ds2) == 6 and ds2[1] == b"item1"
+
+
+def test_multipart_record_framing(tmp_path):
+    """Multi-part framing (cflag 1/2/3) round-trips; exercised with a
+    shrunken chunk limit so the test stays small."""
+    import mxnet_tpu.recordio as rio
+    path = str(tmp_path / "big.rec")
+    old = rio._MAX_CHUNK
+    rio._MAX_CHUNK = 16
+    try:
+        w = rio.MXRecordIO(path, "w")
+        payload = bytes(range(256)) * 2   # 512 bytes -> 32 chunks
+        w.write(payload)
+        w.write(b"after")
+        w.close()
+        r = rio.MXRecordIO(path, "r")
+        assert r.read() == payload
+        assert r.read() == b"after"
+        r.close()
+    finally:
+        rio._MAX_CHUNK = old

@@ -1,0 +1,44 @@
+"""Capture a device profile of the BERT MLM bench train step and print the
+per-op time breakdown (same methodology as profile_bench.py; evidence base
+for the BERT tokens/sec tuning).
+
+Usage:  python tools/profile_bert.py [--batch N] [--steps N]
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from profile_bench import parse_xspace  # noqa: E402  (tools/ sibling)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=16)
+    ap.add_argument("--steps", type=int, default=10)
+    ap.add_argument("--seq", type=int, default=512)
+    ap.add_argument("--masked", type=int, default=76)
+    ap.add_argument("--logdir", default="/tmp/mxtpu_prof_bert")
+    args = ap.parse_args()
+
+    import jax
+    import bench_bert
+    step, params, mom, data = bench_bert.build_step(args.batch, args.seq,
+                                                    args.masked)
+    params, mom, loss = step(params, mom, *data)
+    params, mom, loss = step(params, mom, *data)
+    float(loss)
+
+    jax.profiler.start_trace(args.logdir)
+    for _ in range(args.steps):
+        params, mom, loss = step(params, mom, *data)
+    float(loss)
+    jax.profiler.stop_trace()
+    parse_xspace(args.logdir)
+
+
+if __name__ == "__main__":
+    main()
