@@ -44,6 +44,7 @@ from . import symbol
 from . import symbol as sym
 from . import module
 from . import module as mod
+from . import operator
 from . import callback
 from . import monitor
 from . import profiler

@@ -24,7 +24,8 @@ import numpy as np
 from .base import MXNetError
 
 __all__ = ["record", "pause", "train_mode", "predict_mode", "is_recording",
-           "is_training", "mark_variables", "backward", "grad", "get_symbol"]
+           "is_training", "mark_variables", "backward", "grad", "get_symbol",
+           "Function"]
 
 _state = threading.local()
 
@@ -339,3 +340,69 @@ def get_symbol(x):
     """Reference parity stub: the recorded graph is a JAX trace, not an nnvm
     symbol; returns None (documented divergence)."""
     return None
+
+
+# ---------------------------------------------------------------------------
+# user-defined differentiable ops (reference: autograd.Function)
+# ---------------------------------------------------------------------------
+class Function:
+    """Customised differentiation (reference: python/mxnet/autograd.py
+    class Function). Subclass and implement `forward(self, *inputs)` and
+    `backward(self, *output_grads)`, both over NDArrays; calling the
+    instance runs forward and records the custom backward on the tape.
+
+    TPU-native mechanics: the pair is packaged as one `jax.custom_vjp`
+    pure function, so the tape's `jax.vjp` replay invokes the user backward
+    exactly where the reference's tape would, and the op (with its custom
+    gradient) still traces/compiles under jit. Both methods must therefore
+    be expressible with traceable array ops — no host syncs (`.asnumpy()`).
+
+    State saved in forward (e.g. `self._saved = x`) is visible in backward;
+    like the reference, use one instance per call when saving state."""
+
+    def __init__(self):
+        self._n_out = None
+
+    def forward(self, *inputs):
+        raise NotImplementedError
+
+    def backward(self, *output_grads):
+        raise NotImplementedError
+
+    # internal: run a user method over raw jax arrays, NDArray in/out
+    def _run(self, method, raw):
+        from .ndarray.ndarray import NDArray
+        with pause():
+            out = method(*[NDArray(r) for r in raw])
+        outs = out if isinstance(out, (tuple, list)) else (out,)
+        return tuple(o._data for o in outs)
+
+    def __call__(self, *inputs):
+        from .ndarray.ndarray import NDArray
+        fn = self
+
+        @jax.custom_vjp
+        def op(*raw):
+            outs = fn._run(fn.forward, raw)
+            return outs if len(outs) > 1 else outs[0]
+
+        def op_fwd(*raw):
+            return op(*raw), None
+
+        def op_bwd(_res, g):
+            gs = g if isinstance(g, tuple) else (g,)
+            in_grads = fn._run(fn.backward, gs)
+            if len(in_grads) != len(inputs):
+                raise MXNetError(
+                    f"{type(fn).__name__}.backward returned "
+                    f"{len(in_grads)} grads for {len(inputs)} inputs")
+            return in_grads
+
+        op.defvjp(op_fwd, op_bwd)
+
+        raw = [x._data for x in inputs]
+        out = op(*raw)
+        outs = out if isinstance(out, tuple) else (out,)
+        nd_outs = tuple(NDArray(o) for o in outs)
+        record_op(op, list(inputs), {}, nd_outs)
+        return nd_outs[0] if len(nd_outs) == 1 else nd_outs

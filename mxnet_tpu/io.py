@@ -22,13 +22,14 @@ DataDesc = namedtuple("DataDesc", ["name", "shape"])
 
 class DataBatch:
     def __init__(self, data, label=None, pad=0, index=None,
-                 provide_data=None, provide_label=None):
+                 provide_data=None, provide_label=None, bucket_key=None):
         self.data = data
         self.label = label
         self.pad = pad
         self.index = index
         self.provide_data = provide_data
         self.provide_label = provide_label
+        self.bucket_key = bucket_key  # BucketingModule routing
 
 
 class DataIter:
@@ -251,20 +252,23 @@ class ImageRecordIter(DataIter):
                 self.num_samples = len(self._keys)
             else:
                 self._rec = MXRecordIO(path_imgrec, "r")
-                self.num_samples = int(os.environ.get(
-                    "MXTPU_IMGREC_MAX_SAMPLES", 2 ** 62))
+                self.num_samples = None  # unknown: EOF drives StopIteration
 
     def _decode(self, raw):
         from .recordio import unpack_img
-        header, img = unpack_img(raw, iscolor=1)
         c, h, w = self.data_shape
+        header, img = unpack_img(raw, iscolor=0 if c == 1 else 1)
         if img.shape[:2] != (h, w):
             from PIL import Image
             img = np.asarray(Image.fromarray(img).resize((w, h)))
         x = img.astype(np.float32)
-        x = (x - self._mean) / self._std
+        if c == 1:
+            x = (x - self._mean[0]) / self._std[0]
+            x = x[None]                              # (1, H, W)
+        else:
+            x = ((x - self._mean) / self._std).transpose(2, 0, 1)
         label = header.label if np.ndim(header.label) else float(header.label)
-        return x.transpose(2, 0, 1), np.float32(label)
+        return x, np.float32(label)
 
     @property
     def provide_data(self):
@@ -285,7 +289,8 @@ class ImageRecordIter(DataIter):
         return self._rec.read()    # sequential; None at EOF
 
     def next(self):
-        if self.cursor + self.batch_size > self.num_samples:
+        if self.num_samples is not None and \
+                self.cursor + self.batch_size > self.num_samples:
             raise StopIteration
         if self._rec is not None:
             raws = []

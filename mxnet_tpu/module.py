@@ -16,7 +16,7 @@ from . import initializer as init_mod
 from .ndarray.ndarray import NDArray, zeros
 from .checkpoint import save_checkpoint, load_checkpoint
 
-__all__ = ["Module", "BaseModule"]
+__all__ = ["Module", "BaseModule", "BucketingModule"]
 
 
 class BaseModule:
@@ -207,3 +207,153 @@ class Module(BaseModule):
         mod = Module(sym, **kwargs)
         mod._loaded_params = (arg_params, aux_params)
         return mod
+
+
+class BucketingModule(BaseModule):
+    """Variable-length Symbol training over shape buckets (reference:
+    python/mxnet/module/bucketing_module.py).
+
+    `sym_gen(bucket_key) -> (symbol, data_names, label_names)` builds the
+    per-bucket graph; one Module (one jitted Executor — XLA needs static
+    shapes, so a bucket IS a compile cache entry) is created per key, and
+    every bucket shares the default bucket's parameter arrays (the same
+    NDArray objects are bound into each Executor, so one optimizer update
+    is visible to all buckets) and one shared updater/optimizer state."""
+
+    def __init__(self, sym_gen, default_bucket_key=None, logger=logging,
+                 context=None, **kwargs):
+        if default_bucket_key is None:
+            raise MXNetError("BucketingModule needs default_bucket_key")
+        self._sym_gen = sym_gen
+        self._default_bucket_key = default_bucket_key
+        self._ctx = context
+        self._buckets = {}
+        self._curr_module = None
+        self._curr_bucket_key = None
+        self.binded = False
+        self.params_initialized = False
+        self.optimizer_initialized = False
+
+    @property
+    def default_bucket_key(self):
+        return self._default_bucket_key
+
+    @property
+    def symbol(self):
+        return self._curr_module.symbol if self._curr_module else None
+
+    def _gen_module(self, bucket_key, data_shapes, label_shapes):
+        sym, data_names, label_names = self._sym_gen(bucket_key)
+        self._names_cache = getattr(self, "_names_cache", {})
+        self._names_cache[bucket_key] = (data_names, label_names)
+        mod = Module(sym, data_names, label_names, context=self._ctx)
+        mod.bind(data_shapes, label_shapes,
+                 for_training=self._for_training, grad_req=self._grad_req)
+        return mod
+
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False, grad_req="write"):
+        self._for_training = for_training
+        self._grad_req = grad_req
+        mod = self._gen_module(self._default_bucket_key, data_shapes,
+                               label_shapes)
+        self._buckets[self._default_bucket_key] = mod
+        self._curr_module = mod
+        self._curr_bucket_key = self._default_bucket_key
+        self.binded = True
+        return self
+
+    def init_params(self, initializer=None, arg_params=None, aux_params=None,
+                    allow_missing=False, force_init=False, **kwargs):
+        if not self.binded:
+            raise MXNetError("bind before init_params")
+        self._buckets[self._default_bucket_key].init_params(
+            initializer, arg_params, aux_params, allow_missing, force_init)
+        self.params_initialized = True
+        return self
+
+    def init_optimizer(self, kvstore="local", optimizer="sgd",
+                       optimizer_params=None, force_init=False):
+        base = self._buckets[self._default_bucket_key]
+        base.init_optimizer(kvstore, optimizer, optimizer_params)
+        # one updater (one optimizer-state dict) shared across buckets
+        self._optimizer = base._optimizer
+        self._updater = base._updater
+        for mod in self._buckets.values():
+            mod._optimizer, mod._updater = self._optimizer, self._updater
+        self.optimizer_initialized = True
+
+    def switch_bucket(self, bucket_key, data_shapes, label_shapes=None):
+        """Select (creating + param-sharing on first use) the bucket's
+        executor. Per-bucket jit caches are keyed by the bucket's static
+        shapes, so re-switching is free after first compile."""
+        if bucket_key not in self._buckets:
+            mod = self._gen_module(bucket_key, data_shapes, label_shapes)
+            base = self._buckets[self._default_bucket_key]
+            # buckets must agree on parameter names AND order: storage is
+            # shared by name, and the one shared updater keys optimizer
+            # state by positional index in _param_names — a silent
+            # mismatch would train private weights / cross momenta
+            if mod._param_names != base._param_names:
+                raise MXNetError(
+                    f"bucket {bucket_key!r} parameters "
+                    f"{mod._param_names} do not match the default "
+                    f"bucket's {base._param_names}; sym_gen must produce "
+                    f"identically-named/-ordered parameters per bucket")
+            if self.params_initialized:
+                arg_params, aux_params = base.get_params()
+                # same NDArray objects => shared storage across buckets
+                mod.init_params(arg_params=arg_params,
+                                aux_params=aux_params)
+            if self.optimizer_initialized:
+                mod._optimizer, mod._updater = self._optimizer, self._updater
+            self._buckets[bucket_key] = mod
+        self._curr_module = self._buckets[bucket_key]
+        self._curr_bucket_key = bucket_key
+        return self._curr_module
+
+    def _shapes_for(self, batch):
+        # names cached per bucket — sym_gen builds a whole graph, far too
+        # heavy for the per-batch hot path
+        cached = getattr(self, "_names_cache", {}).get(batch.bucket_key)
+        if cached is None:
+            _, data_names, label_names = self._sym_gen(batch.bucket_key)
+            self._names_cache = getattr(self, "_names_cache", {})
+            self._names_cache[batch.bucket_key] = (data_names, label_names)
+        else:
+            data_names, label_names = cached
+        data = [(n, a.shape) for n, a in
+                zip(data_names, _as_list(batch.data))]
+        labels = None
+        if batch.label is not None:
+            labels = [(n, a.shape) for n, a in
+                      zip(label_names, _as_list(batch.label))]
+        return data, labels
+
+    def forward(self, data_batch, is_train=None):
+        key = data_batch.bucket_key
+        if key is None:
+            key = self._default_bucket_key
+            data_batch.bucket_key = key
+        data_shapes, label_shapes = self._shapes_for(data_batch)
+        self.switch_bucket(key, data_shapes, label_shapes)
+        self._curr_module.forward(data_batch, is_train)
+
+    def backward(self, out_grads=None):
+        self._curr_module.backward(out_grads)
+
+    def update(self):
+        self._curr_module.update()
+
+    def get_outputs(self, merge_multi_context=True):
+        return self._curr_module.get_outputs(merge_multi_context)
+
+    def get_params(self):
+        return self._buckets[self._default_bucket_key].get_params()
+
+    def set_params(self, arg_params, aux_params=None, **kwargs):
+        for mod in self._buckets.values():
+            mod.set_params(arg_params, aux_params, **kwargs)
+
+    def update_metric(self, eval_metric, labels, pre_sliced=False):
+        self._curr_module.update_metric(eval_metric, labels)

@@ -25,3 +25,6 @@ from ..random import (uniform, normal, randn, randint, multinomial,
 
 sample_uniform = uniform
 sample_normal = normal
+
+# custom-op invocation entry (reference: mx.nd.Custom)
+from ..operator import Custom

@@ -1,0 +1,164 @@
+"""Custom operator extension point (reference: python/mxnet/operator.py —
+CustomOp / CustomOpProp / register, backed by src/operator/custom/custom.cc).
+
+The reference runs custom ops on a dedicated thread through the C API; here
+a custom op is packaged as an `autograd.Function`-style `jax.custom_vjp`
+pure function, so it records on the imperative tape, differentiates through
+`backward()`, and traces under jit like any built-in op. The CustomOp
+methods must therefore use traceable array ops (no `.asnumpy()`).
+
+Usage (reference idiom):
+
+    class Sigmoid(mx.operator.CustomOp):
+        def forward(self, is_train, req, in_data, out_data, aux):
+            self.assign(out_data[0], req[0], 1 / (1 + (-in_data[0]).exp()))
+        def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+            y = out_data[0]
+            self.assign(in_grad[0], req[0], out_grad[0] * y * (1 - y))
+
+    @mx.operator.register("sigmoid")
+    class SigmoidProp(mx.operator.CustomOpProp):
+        def list_arguments(self): return ["data"]
+        def list_outputs(self): return ["output"]
+        def infer_shape(self, in_shape): return in_shape, [in_shape[0]]
+        def create_operator(self, ctx, shapes, dtypes): return Sigmoid()
+
+    y = mx.nd.Custom(x, op_type="sigmoid")
+"""
+from __future__ import annotations
+
+import jax
+
+from .base import MXNetError
+
+__all__ = ["CustomOp", "CustomOpProp", "register", "get", "Custom"]
+
+_registry = {}
+
+
+class CustomOp:
+    """Base class for custom operator implementations."""
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        raise NotImplementedError
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        raise NotImplementedError
+
+    @staticmethod
+    def assign(dst, req, src):
+        """Write `src` into `dst` honouring the grad_req (reference
+        semantics: 'write'/'inplace' overwrite, 'add' accumulates,
+        'null' drops)."""
+        if req == "null":
+            return
+        if req == "add":
+            dst._rebind(dst._data + src._data)
+        else:
+            dst._rebind(src._data)
+
+
+class CustomOpProp:
+    """Describes a custom op: arguments, outputs, shapes, operator factory.
+    `needs_top_grad` mirrors the reference default (True)."""
+
+    def __init__(self, need_top_grad=True):
+        self.need_top_grad = need_top_grad
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def list_auxiliary_states(self):
+        return []
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]], []
+
+    def infer_type(self, in_type):
+        return in_type, [in_type[0]] * len(self.list_outputs()), []
+
+    def create_operator(self, ctx, shapes, dtypes):
+        raise NotImplementedError
+
+
+def register(op_type):
+    """Class decorator registering a CustomOpProp under `op_type`
+    (reference: mx.operator.register)."""
+    def wrap(prop_cls):
+        if not issubclass(prop_cls, CustomOpProp):
+            raise MXNetError(f"{prop_cls} must subclass CustomOpProp")
+        _registry[op_type] = prop_cls
+        return prop_cls
+    return wrap
+
+
+def get(op_type):
+    if op_type not in _registry:
+        raise MXNetError(f"custom op {op_type!r} is not registered")
+    return _registry[op_type]
+
+
+def Custom(*inputs, op_type=None, **prop_kwargs):
+    """Invoke a registered custom op on NDArrays (reference:
+    mx.nd.Custom(..., op_type=...))."""
+    from .ndarray.ndarray import NDArray
+    from . import autograd
+    from .context import current_context
+
+    if op_type is None:
+        raise MXNetError("Custom requires op_type=")
+    prop = get(op_type)(**prop_kwargs)
+    n_in = len(prop.list_arguments())
+    n_out = len(prop.list_outputs())
+    if len(inputs) != n_in:
+        raise MXNetError(f"{op_type} expects {n_in} inputs, got "
+                         f"{len(inputs)}")
+    in_shapes = [tuple(x.shape) for x in inputs]
+    shapes = prop.infer_shape(list(in_shapes))
+    out_shapes = list(shapes[1])
+    op = prop.create_operator(current_context(), in_shapes, None)
+
+    def run_forward(raw):
+        import jax.numpy as jnp
+        with autograd.pause():
+            ins = [NDArray(r) for r in raw]
+            outs = [NDArray(jnp.zeros(s, ins[0].dtype if ins else None))
+                    for s in out_shapes]
+            op.forward(autograd.is_training(), ["write"] * n_out, ins,
+                       outs, [])
+        return tuple(o._data for o in outs)
+
+    @jax.custom_vjp
+    def custom_fn(*raw):
+        outs = run_forward(raw)
+        return outs if n_out > 1 else outs[0]
+
+    def custom_fwd(*raw):
+        outs = run_forward(raw)
+        return (outs if n_out > 1 else outs[0]), (raw, outs)
+
+    def custom_bwd(res, g):
+        import jax.numpy as jnp
+        raw, outs = res
+        gs = g if isinstance(g, tuple) else (g,)
+        with autograd.pause():
+            ins_nd = [NDArray(r) for r in raw]
+            outs_nd = [NDArray(o) for o in outs]
+            grads_nd = [NDArray(gg) for gg in gs]
+            in_grads = [NDArray(jnp.zeros(s, r.dtype))
+                        for s, r in zip(in_shapes, raw)]
+            op.backward(["write"] * n_in, grads_nd, ins_nd, outs_nd,
+                        in_grads, [])
+        return tuple(ig._data for ig in in_grads)
+
+    custom_fn.defvjp(custom_fwd, custom_bwd)
+
+    raw = [x._data for x in inputs]
+    out = custom_fn(*raw)
+    outs = out if isinstance(out, tuple) else (out,)
+    nd_outs = tuple(NDArray(o) for o in outs)
+    autograd.record_op(custom_fn, list(inputs), {}, nd_outs)
+    return nd_outs[0] if n_out == 1 else nd_outs

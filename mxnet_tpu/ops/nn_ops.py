@@ -37,13 +37,18 @@ __all__ = [
 # pure kernels (jax.Array -> jax.Array)
 # ---------------------------------------------------------------------------
 def _amp_cast(x, weight):
-    """Op-level AMP autocast (amp.init()): fp32 matmul/conv inputs run on
-    the MXU in the AMP target dtype. Applied at trace time; no-op when AMP
-    is off or inputs are already low-precision."""
+    """Op-level AMP autocast (amp.init()): fp32 matmul/conv operands run on
+    the MXU in the AMP target dtype. EITHER side being fp32 is downcast —
+    a bf16 activation meeting an fp32 master weight must not promote the
+    dot back to fp32. Applied at trace time; no-op when AMP is off."""
     from ..amp import autocast_dtype
     dt = autocast_dtype()
-    if dt is not None and x.dtype == jnp.float32:
-        return x.astype(dt), weight.astype(dt)
+    if dt is None:
+        return x, weight
+    if x.dtype == jnp.float32:
+        x = x.astype(dt)
+    if weight.dtype == jnp.float32:
+        weight = weight.astype(dt)
     return x, weight
 
 

@@ -1,0 +1,126 @@
+"""Custom-op extension points (SURVEY gap: autograd.Function +
+mx.operator.CustomOp; reference: python/mxnet/autograd.py class Function,
+python/mxnet/operator.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd
+
+
+class _WrongGrad(autograd.Function):
+    """Custom backward that deliberately disagrees with the natural
+    gradient — proves the tape calls OUR backward, not autodiff."""
+
+    def forward(self, x):
+        return x * x
+
+    def backward(self, dy):
+        return dy * 100.0
+
+
+def test_function_custom_backward_overrides_autodiff():
+    x = nd.array(np.array([1.0, 2.0, 3.0], np.float32))
+    x.attach_grad()
+    f = _WrongGrad()
+    with autograd.record():
+        y = f(x)
+        z = (y * 2).sum()
+    z.backward()
+    # natural grad would be 2*2x = [4, 8, 12]; custom gives 2*100
+    np.testing.assert_allclose(x.grad.asnumpy(), [200.0, 200.0, 200.0])
+
+
+def test_function_multi_input_output():
+    class Swap(autograd.Function):
+        def forward(self, a, b):
+            return b * 2, a * 3
+
+        def backward(self, da, db):
+            return db * 3, da * 2
+
+    a = nd.array(np.array([1.0], np.float32))
+    b = nd.array(np.array([5.0], np.float32))
+    a.attach_grad()
+    b.attach_grad()
+    with autograd.record():
+        o1, o2 = Swap()(a, b)
+        loss = o1.sum() + 10 * o2.sum()
+    loss.backward()
+    np.testing.assert_allclose(a.grad.asnumpy(), [30.0])  # 10 * 3
+    np.testing.assert_allclose(b.grad.asnumpy(), [2.0])
+
+
+def test_function_saved_state():
+    class Scale(autograd.Function):
+        def forward(self, x):
+            self._x = x
+            return x * x
+
+        def backward(self, dy):
+            return dy * 2 * self._x  # the true gradient, via saved state
+
+    x = nd.array(np.array([3.0], np.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = Scale()(x)
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [6.0])
+
+
+def test_function_bad_grad_count_raises():
+    class Bad(autograd.Function):
+        def forward(self, a, b):
+            return a + b
+
+        def backward(self, dy):
+            return dy  # one grad for two inputs
+
+    a = nd.ones((2,))
+    b = nd.ones((2,))
+    a.attach_grad()
+    b.attach_grad()
+    with pytest.raises(Exception):
+        with autograd.record():
+            y = Bad()(a, b)
+        y.backward()
+
+
+class _SigmoidOp(mx.operator.CustomOp):
+    def forward(self, is_train, req, in_data, out_data, aux):
+        self.assign(out_data[0], req[0], 1 / (1 + (-in_data[0]).exp()))
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        y = out_data[0]
+        self.assign(in_grad[0], req[0], out_grad[0] * y * (1 - y))
+
+
+@mx.operator.register("test_sigmoid")
+class _SigmoidProp(mx.operator.CustomOpProp):
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]], []
+
+    def create_operator(self, ctx, shapes, dtypes):
+        return _SigmoidOp()
+
+
+def test_custom_op_forward_backward():
+    x = nd.array(np.array([0.0, 1.0, -1.0], np.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = nd.Custom(x, op_type="test_sigmoid")
+        z = y.sum()
+    z.backward()
+    sig = 1 / (1 + np.exp(-np.array([0.0, 1.0, -1.0])))
+    np.testing.assert_allclose(y.asnumpy(), sig, rtol=1e-5)
+    np.testing.assert_allclose(x.grad.asnumpy(), sig * (1 - sig), rtol=1e-5)
+
+
+def test_custom_op_unregistered_raises():
+    with pytest.raises(Exception):
+        nd.Custom(nd.ones((2,)), op_type="never_registered")
+
+
+def test_custom_op_wrong_arity_raises():
+    with pytest.raises(Exception):
+        nd.Custom(nd.ones((2,)), nd.ones((2,)), op_type="test_sigmoid")

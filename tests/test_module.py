@@ -77,3 +77,74 @@ def test_save_load_checkpoint():
         for k in arg1:
             np.testing.assert_allclose(arg1[k].asnumpy(), arg2[k].asnumpy(),
                                        rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# BucketingModule (reference: module/bucketing_module.py)
+# ---------------------------------------------------------------------------
+def _bucket_sym_gen(seq_len):
+    """Embedding -> mean over time -> FC -> SoftmaxOutput; parameter shapes
+    are independent of seq_len, so buckets can share them."""
+    data = mx.sym.Variable("data")
+    label = mx.sym.Variable("softmax_label")
+    embed_w = mx.sym.Variable("embed_weight")
+    fc_w = mx.sym.Variable("fc_weight")
+    fc_b = mx.sym.Variable("fc_bias")
+    x = mx.sym.Embedding(data, weight=embed_w, input_dim=10, output_dim=4)
+    x = mx.sym.mean(x, axis=1)
+    x = mx.sym.FullyConnected(x, weight=fc_w, bias=fc_b, num_hidden=2)
+    out = mx.sym.SoftmaxOutput(x, label)
+    return out, ["data"], ["softmax_label"]
+
+
+def _bucket_batch(seq_len, batch=4, seed=0):
+    rng = np.random.RandomState(seed + seq_len)
+    data = rng.randint(0, 10, (batch, seq_len)).astype(np.float32)
+    label = (data.sum(axis=1) % 2).astype(np.float32)
+    return mx.io.DataBatch([mx.nd.array(data)], [mx.nd.array(label)],
+                           bucket_key=seq_len)
+
+
+def test_bucketing_module_two_lengths_share_params():
+    mod = mx.mod.BucketingModule(_bucket_sym_gen, default_bucket_key=8)
+    b8 = _bucket_batch(8)
+    b5 = _bucket_batch(5)
+    mod.bind([("data", (4, 8))], [("softmax_label", (4,))])
+    mod.init_params()
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.5})
+
+    # train alternating buckets; the same parameter ARRAYS must be updated
+    arg0 = {k: v.asnumpy().copy() for k, v in mod.get_params()[0].items()}
+    for step in range(4):
+        batch = b8 if step % 2 == 0 else b5
+        mod.forward(batch, is_train=True)
+        mod.backward()
+        mod.update()
+    arg1 = mod.get_params()[0]
+    assert set(arg1) == {"embed_weight", "fc_weight", "fc_bias"}
+    assert any(not np.allclose(arg0[k], arg1[k].asnumpy()) for k in arg0)
+
+    # the bucket-5 module sees the SAME objects (shared storage)
+    m8 = mod._buckets[8]
+    m5 = mod._buckets[5]
+    for name in ("embed_weight", "fc_weight", "fc_bias"):
+        assert m8._exec.arg_dict[name] is m5._exec.arg_dict[name]
+
+    # forward on either bucket gives consistent predictions for equal input
+    # padded to its length: run the same sequence content through both
+    mod.forward(b5, is_train=False)
+    out5 = mod.get_outputs()[0].asnumpy()
+    assert out5.shape == (4, 2)
+    np.testing.assert_allclose(out5.sum(axis=1), np.ones(4), rtol=1e-5)
+
+
+def test_bucketing_module_default_key_routing():
+    mod = mx.mod.BucketingModule(_bucket_sym_gen, default_bucket_key=6)
+    mod.bind([("data", (4, 6))], [("softmax_label", (4,))])
+    mod.init_params()
+    batch = _bucket_batch(6)
+    batch.bucket_key = None          # no key -> default bucket
+    mod.forward(batch, is_train=False)
+    assert mod._curr_bucket_key == 6
+    assert mod.get_outputs()[0].shape == (4, 2)
