@@ -10,6 +10,9 @@ Kernels:
     saves per-row logsumexp; backward is the FlashAttention-2 style pair of
     Pallas kernels (dk/dv over kv-blocks, dq over q-blocks) with in-kernel
     recompute of the probabilities — O(S) memory end to end.
+  * flash_block_attention — (out, lse) blockwise partial with gradients
+    through both outputs; the ring-attention building block (the lse
+    cotangent folds into the Pallas backward as a delta shift).
   * fused_layer_norm — single-pass layernorm.
 
 All kernels fall back to pure-XLA implementations off-TPU (CPU test mesh) or
@@ -33,8 +36,8 @@ try:
 except Exception:  # pragma: no cover
     _HAS_PALLAS = False
 
-__all__ = ["flash_attention", "fused_layer_norm", "attention_reference",
-           "on_tpu"]
+__all__ = ["flash_attention", "flash_block_attention", "fused_layer_norm",
+           "attention_reference", "on_tpu"]
 
 
 def on_tpu():
@@ -70,6 +73,21 @@ def _block_sizes(sq, sk):
                 return b
         return 128
     return pick(sq, "MXTPU_FLASH_BLOCK_Q"), pick(sk, "MXTPU_FLASH_BLOCK_K")
+
+
+def _sds(shape, dtype, *refs):
+    """ShapeDtypeStruct whose vma is the union of the inputs' varying axes —
+    under shard_map(check_vma=True) pallas_call out_shapes must carry vma
+    or lowering refuses (and the try/except would silently fall back)."""
+    vma = None
+    try:
+        sets = [jax.typeof(r).vma for r in refs]
+        vma = frozenset().union(*sets) if sets else None
+    except Exception:
+        vma = None
+    if vma:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)
 
 
 _warned_fallback = set()
@@ -236,8 +254,8 @@ def _flash_fwd_pallas(q, k, v, causal, sm_scale, lengths=None,
         kern,
         grid_spec=grid_spec,
         out_shape=[
-            jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, sq, 128), jnp.float32),
+            _sds((bh, sq, d), q.dtype, q, k, v),
+            _sds((bh, sq, 128), jnp.float32, q, k, v),
         ],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
@@ -442,7 +460,10 @@ def _flash_bwd_dq_kernel(*refs, sm_scale, causal, block_q, block_k,
 
 
 def _flash_bwd_pallas(q, k, v, o, lse, g, causal, sm_scale, lengths=None,
-                      block_q=None, block_k=None):
+                      block_q=None, block_k=None, delta_shift=None):
+    """delta_shift (B,H,Sq) fp32, optional: subtracted from the standard
+    delta = rowsum(dO∘O). Used by flash_block_attention to fold an lse
+    cotangent into the backward (dS gains +g_lse∘p, i.e. delta -= g_lse)."""
     b, h, sq, d = q.shape
     sk = k.shape[2]
     bh = b * h
@@ -457,6 +478,8 @@ def _flash_bwd_pallas(q, k, v, o, lse, g, causal, sm_scale, lengths=None,
     # block shape is Mosaic-tileable.
     delta = jnp.sum(o.astype(jnp.float32) * g.astype(jnp.float32),
                     axis=-1).reshape(bh, sq)
+    if delta_shift is not None:
+        delta = delta - delta_shift.astype(jnp.float32).reshape(bh, sq)
     delta = jnp.broadcast_to(delta[..., None], (bh, sq, 128))
     lse = jnp.broadcast_to(lse[..., None], (bh, sq, 128))  # compact residual
     nq = pl.cdiv(sq, block_q)
@@ -480,7 +503,7 @@ def _flash_bwd_pallas(q, k, v, o, lse, g, causal, sm_scale, lengths=None,
             scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
                             pltpu.VMEM((block_k, d), jnp.float32)],
         ),
-        out_shape=[jax.ShapeDtypeStruct((bh, sk, d), q.dtype)] * 2,
+        out_shape=[_sds((bh, sk, d), q.dtype, q, k, v, g)] * 2,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=_interpret(),
@@ -500,7 +523,7 @@ def _flash_bwd_pallas(q, k, v, o, lse, g, causal, sm_scale, lengths=None,
             out_specs=qspec2,
             scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         ),
-        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+        out_shape=_sds((bh, sq, d), q.dtype, q, k, v, g),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=_interpret(),
@@ -579,6 +602,93 @@ _flash_vl.defvjp(_flash_vl_fwd_rule, _flash_vl_bwd_rule)
 
 
 # ---------------------------------------------------------------------------
+# flash block attention: (out, lse) with gradients through BOTH — the ring
+# attention building block (partial softmax results merge across ring steps
+# via lse, so the lse cotangent is nonzero: d lse/dS = p folds into the
+# standard backward as delta -= g_lse).
+# ---------------------------------------------------------------------------
+def _block_fwd_xla(q, k, v, causal, scale):
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if causal:
+        qi = lax.broadcasted_iota(jnp.int32, s.shape[-2:], 0)
+        kj = lax.broadcasted_iota(jnp.int32, s.shape[-2:], 1)
+        s = jnp.where(qi >= kj, s, -1e30)
+    lse = jax.scipy.special.logsumexp(s, axis=-1)
+    p = jnp.exp(s - lse[..., None])
+    out = jnp.einsum("bhqk,bhkd->bhqd", p.astype(q.dtype), v)
+    return out, lse
+
+
+def _block_bwd_xla(q, k, v, out, lse, g, g_lse, causal, scale):
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if causal:
+        qi = lax.broadcasted_iota(jnp.int32, s.shape[-2:], 0)
+        kj = lax.broadcasted_iota(jnp.int32, s.shape[-2:], 1)
+        s = jnp.where(qi >= kj, s, -1e30)
+    p = jnp.exp(s - lse[..., None])
+    gf = g.astype(jnp.float32)
+    dv = jnp.einsum("bhqk,bhqd->bhkd", p, gf)
+    dp = jnp.einsum("bhqd,bhkd->bhqk", gf, v.astype(jnp.float32))
+    delta = (jnp.sum(gf * out.astype(jnp.float32), axis=-1)
+             - g_lse.astype(jnp.float32))
+    ds = p * (dp - delta[..., None]) * scale
+    dq = jnp.einsum("bhqk,bhkd->bhqd", ds, k.astype(jnp.float32))
+    dk = jnp.einsum("bhqk,bhqd->bhkd", ds, q.astype(jnp.float32))
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+def _flash_block_impl(q, k, v, causal, sm_scale):
+    """Shared primal: (out, lse, used_pallas)."""
+    scale = sm_scale if sm_scale is not None else 1.0 / (q.shape[-1] ** 0.5)
+    if _pallas_ok(q.shape[2]) and _pallas_ok(k.shape[2]):
+        try:
+            out, lse = _flash_fwd_pallas(q, k, v, causal, scale)
+            b, h, s, _ = q.shape
+            return out, lse[..., 0].reshape(b, h, s), True
+        except Exception as e:
+            _warn_fallback("flash_block_fwd", e)
+    out, lse = _block_fwd_xla(q, k, v, causal, scale)
+    return out, lse, False
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def flash_block_attention(q, k, v, causal=False, sm_scale=None):
+    """Blockwise attention partial: returns (out, lse) where `out` is the
+    softmax attention over ONLY these keys and `lse` its per-row logsumexp
+    of scaled logits. Partials from disjoint key sets merge exactly:
+        lse = logaddexp(lse_a, lse_b)
+        out = out_a*exp(lse_a-lse) + out_b*exp(lse_b-lse)
+    — the combine used by parallel/ring_attention.py. Pallas on TPU-tiling
+    shapes, XLA otherwise; differentiable through BOTH outputs."""
+    out, lse, _ = _flash_block_impl(q, k, v, causal, sm_scale)
+    return out, lse
+
+
+def _flash_block_fwd_rule(q, k, v, causal, sm_scale):
+    out, lse, used_pallas = _flash_block_impl(q, k, v, causal, sm_scale)
+    return (out, lse), (q, k, v, out, lse, used_pallas)
+
+
+def _flash_block_bwd_rule(causal, sm_scale, res, cts):
+    q, k, v, out, lse, used_pallas = res
+    g, g_lse = cts
+    scale = sm_scale if sm_scale is not None else 1.0 / (q.shape[-1] ** 0.5)
+    if used_pallas:
+        try:
+            return _flash_bwd_pallas(
+                q, k, v, out, lse.reshape(-1, lse.shape[-1]), g, causal,
+                scale, delta_shift=g_lse)
+        except Exception as e:
+            _warn_fallback("flash_block_bwd", e)
+    return _block_bwd_xla(q, k, v, out, lse, g, g_lse, causal, scale)
+
+
+flash_block_attention.defvjp(_flash_block_fwd_rule, _flash_block_bwd_rule)
+
+
+# ---------------------------------------------------------------------------
 # fused layer norm
 # ---------------------------------------------------------------------------
 def _ln_kernel(x_ref, g_ref, b_ref, o_ref, mean_ref, rstd_ref, *, eps):
@@ -626,9 +736,9 @@ def _fused_ln_fwd_impl(x, gamma, beta, eps):
                 pl.BlockSpec((br, 1), lambda i: (i, 0)),
             ],
             out_shape=[
-                jax.ShapeDtypeStruct((rows, d), x.dtype),
-                jax.ShapeDtypeStruct((rows, 1), jnp.float32),
-                jax.ShapeDtypeStruct((rows, 1), jnp.float32),
+                _sds((rows, d), x.dtype, x, gamma, beta),
+                _sds((rows, 1), jnp.float32, x, gamma, beta),
+                _sds((rows, 1), jnp.float32, x, gamma, beta),
             ],
             interpret=_interpret(),
         )(x2, gamma, beta)

@@ -7,8 +7,18 @@ blockwise/flash combine — so attention over sequence length S costs O(S/P)
 memory per chip and the K/V transfers ride ICI neighbour links, overlapping
 with the block matmuls (Liu et al., Ring Attention; PAPERS.md).
 
-Causal masking uses the global block indices so the rotated source shard is
-masked correctly at every step.
+This IS ring *flash* attention (SURVEY #42): on TPU-tiling shard shapes the
+per-step block compute is `ops.pallas_kernels.flash_block_attention` — the
+Pallas flash kernel returning (out, lse) — and partials merge across ring
+steps with the exact logsumexp combine; the backward reuses the Pallas
+dq/dk/dv kernels through flash_block's custom vjp (the lse cotangent folds
+in as a delta shift). Off-TPU / non-tiling shapes take the same math on the
+XLA path inside flash_block_attention.
+
+Causal masking decomposes per ring step by global shard index: the shard's
+own block is causal, earlier shards are fully visible, later shards are
+skipped (zero contribution) — chosen with `lax.switch` on the rotated
+source index.
 """
 from __future__ import annotations
 
@@ -18,6 +28,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 from jax import shard_map
+
+from ..ops.pallas_kernels import flash_block_attention
 
 __all__ = ["ring_attention", "ring_attention_sharded"]
 
@@ -34,62 +46,66 @@ def _as_varying(x, axis_name):
             return x
 
 
-def _block_attn(q, k, v, mask):
-    """Partial attention stats for one K/V block.
-    q: (B,H,Sq,D) k,v: (B,H,Sk,D). Returns (m, l, o_unnorm)."""
-    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
-                   preferred_element_type=jnp.float32)
-    s = jnp.where(mask, s, -1e30)
-    m = jnp.max(s, axis=-1, keepdims=True)
-    m = jnp.maximum(m, -1e30)
-    p = jnp.exp(s - m)
-    l = jnp.sum(p, axis=-1, keepdims=True)
-    o = jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v)
-    return m, l, o.astype(jnp.float32)
-
-
 def ring_attention(q, k, v, axis_name="sp", causal=False, sm_scale=None):
-    """Call INSIDE shard_map with q,k,v sequence-sharded: (B,H,S/P,D)."""
+    """Call INSIDE shard_map with q,k,v sequence-sharded: (B,H,S/P,D).
+
+    Per ring step the local block attention is flash_block_attention
+    (Pallas kernel on TPU shapes) returning a normalized partial + its
+    logsumexp; partials merge with the exact combine
+        lse' = logaddexp(lse, lse_b)
+        o'   = o*exp(lse-lse') + o_b*exp(lse_b-lse')."""
     if sm_scale is None:
         sm_scale = 1.0 / (q.shape[-1] ** 0.5)
-    q = q * sm_scale
     n_dev = jax.lax.axis_size(axis_name)
     my_idx = jax.lax.axis_index(axis_name)
     s_loc = q.shape[2]
+    b, h, _, d = q.shape
     perm = [(i, (i + 1) % n_dev) for i in range(n_dev)]
 
-    qi = jax.lax.broadcasted_iota(jnp.int32, (s_loc, s_loc), 0)
-    kj = jax.lax.broadcasted_iota(jnp.int32, (s_loc, s_loc), 1)
+    def full_block(k_cur, v_cur):
+        out, lse = flash_block_attention(q, k_cur, v_cur, False, sm_scale)
+        return out.astype(jnp.float32), lse
+
+    def diag_block(k_cur, v_cur):
+        out, lse = flash_block_attention(q, k_cur, v_cur, True, sm_scale)
+        return out.astype(jnp.float32), lse
+
+    def skip_block(k_cur, v_cur):
+        # zero contribution, derived from the (device-varying) inputs so all
+        # switch branches agree on varying-manner WITHOUT a pcast — pcast's
+        # transpose is a psum, which breaks under outer shard_maps running
+        # check_vma=False (composite 5-axis step)
+        zero = q.astype(jnp.float32) * 0.0
+        return zero, zero[..., 0] - 1e30
 
     def step(carry, i):
-        k_cur, v_cur, m_acc, l_acc, o_acc = carry
+        k_cur, v_cur, o_acc, lse_acc = carry
         src = (my_idx - i) % n_dev      # which shard this K/V block is
         if causal:
-            # global positions: my rows = my_idx*s_loc + qi ; cols = src*s_loc + kj
-            mask = (my_idx * s_loc + qi)[None, None] >= \
-                   (src * s_loc + kj)[None, None]
+            # later shards (src > my_idx) are wholly in the future: skip;
+            # my own shard is the causal diagonal; earlier are fully seen
+            branch = jnp.where(src == my_idx, 1,
+                               jnp.where(src < my_idx, 0, 2))
+            o_b, lse_b = jax.lax.switch(
+                branch, [full_block, diag_block, skip_block], k_cur, v_cur)
         else:
-            mask = jnp.ones((1, 1, s_loc, s_loc), bool)
-        m_b, l_b, o_b = _block_attn(q, k_cur, v_cur, mask)
-        m_new = jnp.maximum(m_acc, m_b)
-        alpha = jnp.exp(m_acc - m_new)
-        beta = jnp.exp(m_b - m_new)
-        l_new = l_acc * alpha + l_b * beta
-        o_new = o_acc * alpha + o_b * beta
+            o_b, lse_b = full_block(k_cur, v_cur)
+        lse_new = jnp.logaddexp(lse_acc, lse_b)
+        w_acc = jnp.exp(lse_acc - lse_new)[..., None]
+        w_b = jnp.exp(lse_b - lse_new)[..., None]
+        o_new = o_acc * w_acc + o_b * w_b
         k_next = jax.lax.ppermute(k_cur, axis_name, perm)
         v_next = jax.lax.ppermute(v_cur, axis_name, perm)
-        return (k_next, v_next, m_new, l_new, o_new), None
+        return (k_next, v_next, o_new, lse_new), None
 
-    b, h, _, d = q.shape
-    m0 = jnp.full((b, h, s_loc, 1), -1e30, jnp.float32)
-    l0 = jnp.zeros((b, h, s_loc, 1), jnp.float32)
     o0 = jnp.zeros((b, h, s_loc, d), jnp.float32)
+    lse0 = jnp.full((b, h, s_loc), -1e30, jnp.float32)
     # mark the accumulators device-varying so the scan carry types agree
     # under shard_map's VMA checking (the k/v carries vary via ppermute)
-    m0, l0, o0 = (_as_varying(t, axis_name) for t in (m0, l0, o0))
-    carry, _ = jax.lax.scan(step, (k, v, m0, l0, o0), jnp.arange(n_dev))
-    _, _, m, l, o = carry
-    return (o / jnp.maximum(l, 1e-30)).astype(q.dtype)
+    o0, lse0 = (_as_varying(t, axis_name) for t in (o0, lse0))
+    carry, _ = jax.lax.scan(step, (k, v, o0, lse0), jnp.arange(n_dev))
+    _, _, o, _lse = carry
+    return o.astype(q.dtype)
 
 
 def ring_attention_sharded(q, k, v, mesh, axis_name="sp", causal=False):
