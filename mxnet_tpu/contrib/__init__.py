@@ -1,18 +1,17 @@
 """mx.contrib (reference: python/mxnet/contrib).
 
-Quantization/ONNX are explicitly stubbed (SURVEY.md §2 #49): int8 inference
-and ONNX interchange target GPU/cpu toolchains the reference wraps; on TPU
-the equivalent deployment path is the XLA executable exported by
-HybridBlock.export. Calling these raises with that guidance.
+Quantization is REAL on TPU: the MXU multiplies int8 natively, so
+`contrib.quantization` implements calibrated symmetric int8 inference
+(see that module). ONNX export stays a gated stub — the `onnx` package is
+not available in this environment, and the TPU-native deployment path is
+the XLA executable exported by HybridBlock.export.
 """
 from ..base import MXNetError
-
-
-def quantize_model(*args, **kwargs):
-    raise MXNetError("int8 quantization is stubbed on TPU; use bf16 via "
-                     "mxnet_tpu.amp (SURVEY.md §2 #49)")
+from . import quantization
+from .quantization import quantize_model, quantize_net
 
 
 def export_onnx(*args, **kwargs):
-    raise MXNetError("ONNX export is stubbed; deploy the jitted XLA "
-                     "executable via HybridBlock.export (SURVEY.md §2 #49)")
+    raise MXNetError(
+        "ONNX export requires the `onnx` package, which is not available "
+        "here; deploy the jitted XLA executable via HybridBlock.export")

@@ -1,0 +1,316 @@
+"""INT8 quantization (reference: python/mxnet/contrib/quantization.py +
+src/operator/quantization/*).
+
+TPU-native: the MXU multiplies int8 x int8 into int32 natively, so int8
+inference is a first-class fast path — not a GPU-only feature. The design
+maps the reference's calibrated symmetric per-tensor scheme onto XLA:
+
+  * `quantize` / `dequantize` — symmetric linear mapping
+    q = clip(round(x / scale), -127, 127), x ≈ q * scale
+    (reference: quantize_v2 with min/max calib -> int8).
+  * `QuantizedDense` / `QuantizedConv2D` — weights stored int8 + fp scale;
+    activations quantized dynamically per call (or with a calibrated
+    static scale); the dot runs int8 x int8 -> int32
+    (`preferred_element_type=jnp.int32`) and one fp multiply rescales.
+  * `quantize_model` / `quantize_net` — walk a Gluon block tree and swap
+    Dense/Conv2D layers for their quantized twins, optionally running
+    calibration batches to fix activation scales ('naive' max-abs
+    calibration, reference's calib_mode='naive').
+
+Excluded layers (first/last, by name) mirror the reference's
+`excluded_sym_names`.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray, _apply
+
+__all__ = ["quantize", "dequantize", "QuantizedDense", "QuantizedConv2D",
+           "quantize_net", "quantize_model"]
+
+
+def _scale_of(amax):
+    return jnp.maximum(amax, 1e-12) / 127.0
+
+
+_ACTS = {
+    None: lambda y: y,
+    "relu": lambda y: jnp.maximum(y, 0),
+    "sigmoid": jax.nn.sigmoid,
+    "tanh": jnp.tanh,
+    "gelu": jax.nn.gelu,
+    "softrelu": jax.nn.softplus,
+    "softsign": jax.nn.soft_sign,
+}
+
+
+def _act_fn(name, layer_name):
+    if name not in _ACTS:
+        raise MXNetError(
+            f"quantized layer {layer_name!r}: unsupported activation "
+            f"{name!r} (supported: {sorted(k for k in _ACTS if k)})")
+    return _ACTS[name]
+
+
+def quantize(data, min_range=None, max_range=None, out_type="int8"):
+    """Symmetric int8 quantization. Returns (quantized, min_range,
+    max_range) like the reference's quantize op. min/max default to the
+    observed +-absmax."""
+    if out_type != "int8":
+        raise MXNetError("TPU quantization is int8 (MXU-native)")
+
+    def f(x):
+        amax = jnp.max(jnp.abs(x))
+        scale = _scale_of(amax)
+        q = jnp.clip(jnp.round(x / scale), -127, 127).astype(jnp.int8)
+        return q, -amax, amax
+
+    if isinstance(data, NDArray):
+        return _apply(f, [data], n_out=3)
+    return f(data)
+
+
+def dequantize(data, min_range, max_range):
+    """int8 -> float32 (reference: dequantize op)."""
+    def f(q, mn, mx):
+        scale = _scale_of(jnp.maximum(jnp.abs(mn), jnp.abs(mx)))
+        return q.astype(jnp.float32) * scale
+
+    if isinstance(data, NDArray):
+        return _apply(f, [data, min_range, max_range])
+    return f(data, min_range, max_range)
+
+
+def _quantize_weight(w):
+    """fp weight -> (int8 weight, fp32 scale), symmetric per-tensor."""
+    amax = float(jnp.max(jnp.abs(w)))
+    scale = max(amax, 1e-12) / 127.0
+    q = jnp.clip(jnp.round(w / scale), -127, 127).astype(jnp.int8)
+    return q, np.float32(scale)
+
+
+def _dyn_act_scale(x):
+    return _scale_of(jnp.max(jnp.abs(x)))
+
+
+class _QuantizedBase:
+    """Common int8 layer mechanics; not a Block — forward is pure and goes
+    through _apply so it records on the tape and traces under jit."""
+
+    def __init__(self, name):
+        self.name = name
+        self._act_scale = None      # set by calibration; else dynamic
+
+    def observe(self, x):
+        """Calibration: track max-abs of activations (naive calib)."""
+        amax = float(jnp.max(jnp.abs(x._data if isinstance(x, NDArray)
+                                     else x)))
+        prev = self._act_scale_amax = max(
+            getattr(self, "_act_scale_amax", 0.0), amax)
+        self._act_scale = np.float32(max(prev, 1e-12) / 127.0)
+
+
+class QuantizedDense(_QuantizedBase):
+    """int8 y = (x_q @ W_q^T) * (s_x * s_w) + b (reference:
+    quantized_fully_connected). Weight held int8; activation quantized
+    dynamically unless calibrated."""
+
+    def __init__(self, dense):
+        super().__init__(getattr(dense, "name", "dense"))
+        w = dense.weight.data()._data.astype(jnp.float32)
+        self.wq, self.w_scale = _quantize_weight(w)
+        self.bias = (dense.bias.data()._data.astype(jnp.float32)
+                     if getattr(dense, "bias", None) is not None else None)
+        self._flatten = getattr(dense, "_flatten", True)
+        self._act = _act_fn(getattr(dense, "_activation", None), self.name)
+
+    def __call__(self, x):
+        wq, w_scale = self.wq, self.w_scale
+        bias, act = self.bias, self._act
+        static_scale = self._act_scale
+        flatten = self._flatten
+
+        def f(xv):
+            if flatten and xv.ndim > 2:
+                xv = xv.reshape(xv.shape[0], -1)
+            xf = xv.astype(jnp.float32)
+            s_x = static_scale if static_scale is not None \
+                else _dyn_act_scale(xf)
+            xq = jnp.clip(jnp.round(xf / s_x), -127, 127).astype(jnp.int8)
+            acc = jax.lax.dot_general(
+                xq, wq, (((xq.ndim - 1,), (1,)), ((), ())),
+                preferred_element_type=jnp.int32)
+            y = acc.astype(jnp.float32) * (s_x * w_scale)
+            if bias is not None:
+                y = y + bias
+            return act(y)
+
+        return _apply(f, [x] if isinstance(x, NDArray) else [NDArray(x)])
+
+
+class QuantizedConv2D(_QuantizedBase):
+    """int8 NHWC/NCHW conv -> int32 accum -> fp rescale (reference:
+    quantized_conv)."""
+
+    def __init__(self, conv):
+        super().__init__(getattr(conv, "name", "conv"))
+        w = conv.weight.data()._data.astype(jnp.float32)
+        self.wq, self.w_scale = _quantize_weight(w)
+        self.bias = (conv.bias.data()._data.astype(jnp.float32)
+                     if getattr(conv, "bias", None) is not None else None)
+        self._stride = getattr(conv, "_strides", 1)
+        self._pad = getattr(conv, "_padding", 0)
+        self._dilation = getattr(conv, "_dilation", 1)
+        self._groups = getattr(conv, "_groups", 1)
+        self._layout = getattr(conv, "_layout", None) or "NCHW"
+        self._act = _act_fn(getattr(conv, "_activation", None), self.name)
+
+    def __call__(self, x):
+        wq, w_scale = self.wq, self.w_scale
+        bias, act = self.bias, self._act
+        stride, pad, layout = self._stride, self._pad, self._layout
+        dilation, groups = self._dilation, self._groups
+        static_scale = self._act_scale
+
+        def f(xv):
+            from jax import lax
+            xf = xv.astype(jnp.float32)
+            s_x = static_scale if static_scale is not None \
+                else _dyn_act_scale(xf)
+            xq = jnp.clip(jnp.round(xf / s_x), -127, 127).astype(jnp.int8)
+            ndim = xv.ndim - 2
+            st = (stride,) * ndim if isinstance(stride, int) \
+                else tuple(stride)
+            pd = (pad,) * ndim if isinstance(pad, int) else tuple(pad)
+            dl = (dilation,) * ndim if isinstance(dilation, int) \
+                else tuple(dilation)
+            spatial = layout.replace("N", "").replace("C", "")
+            rhs = ("OI" + spatial) if layout.index("C") == 1 \
+                else ("O" + spatial + "I")
+            dn = lax.conv_dimension_numbers(xq.shape, wq.shape,
+                                            (layout, rhs, layout))
+            acc = lax.conv_general_dilated(
+                xq, wq, window_strides=st,
+                padding=tuple((p, p) for p in pd),
+                rhs_dilation=dl, feature_group_count=groups,
+                dimension_numbers=dn, preferred_element_type=jnp.int32)
+            y = acc.astype(jnp.float32) * (s_x * w_scale)
+            if bias is not None:
+                c_axis = layout.index("C")
+                shape = [1] * y.ndim
+                shape[c_axis] = -1
+                y = y + bias.reshape(shape)
+            return act(y)
+
+        return _apply(f, [x] if isinstance(x, NDArray) else [NDArray(x)])
+
+
+_SEQ_TYPES = ("HybridSequential", "Sequential")
+
+
+class QuantizedNet:
+    """Result of quantize_net: same call signature as the source block,
+    with listed layers running int8. Supports (nested) Sequential trees —
+    quantize_net raises up front for structures it cannot rewire, so a
+    returned QuantizedNet never silently runs fp32."""
+
+    def __init__(self, block, replacements):
+        self._block = block
+        self._replacements = replacements  # id(child) -> quantized twin
+
+    def __call__(self, x):
+        return self._forward(self._block, x, observe=False)
+
+    def _forward(self, block, x, observe):
+        """Run `block` with quantized twins substituted; with observe=True
+        runs the ORIGINAL layers but feeds each twin's calibrator."""
+        for c in block._children.values():
+            q = self._replacements.get(id(c))
+            if q is not None:
+                if observe:
+                    q.observe(x)
+                    x = c(x)
+                else:
+                    x = q(x)
+            elif type(c).__name__ in _SEQ_TYPES:
+                x = self._forward(c, x, observe)
+            else:
+                x = c(x)
+        return x
+
+    @property
+    def quantized_layers(self):
+        return list(self._replacements.values())
+
+
+def quantize_net(network, quantized_dtype="int8", exclude_layers=None,
+                 calib_data=None, num_calib_batches=None, **kwargs):
+    """Quantize a Gluon net's Dense/Conv2D layers to int8 (reference:
+    contrib.quantization.quantize_net). Returns a callable QuantizedNet.
+
+    calib_data: optional iterable of input batches used to fix activation
+    scales (naive max-abs); without it activations quantize dynamically."""
+    if quantized_dtype not in ("int8", "auto"):
+        raise MXNetError("TPU quantization supports int8")
+    exclude = set(exclude_layers or [])
+    if type(network).__name__ not in _SEQ_TYPES:
+        raise MXNetError(
+            "quantize_net rewires (nested) HybridSequential/Sequential "
+            "trees; for custom Blocks wrap the quantizable submodules in a "
+            "Sequential or use QuantizedDense/QuantizedConv2D directly")
+    replacements = {}
+
+    def walk(b, path=""):
+        for name, child in b._children.items():
+            cls = type(child).__name__
+            cpath = f"{path}.{name}" if path else name
+            if cpath in exclude or cls in exclude:
+                continue
+            if cls == "Dense":
+                replacements[id(child)] = QuantizedDense(child)
+            elif cls == "Conv2D":
+                replacements[id(child)] = QuantizedConv2D(child)
+            elif cls in _SEQ_TYPES:
+                walk(child, cpath)
+            elif any(type(g).__name__ in ("Dense", "Conv2D")
+                     for g in _descendants(child)):
+                # a quantizable layer hiding under a custom block would be
+                # silently skipped at call time — refuse instead
+                raise MXNetError(
+                    f"cannot quantize inside custom block {cpath!r} "
+                    f"({cls}); exclude it via exclude_layers or quantize "
+                    f"its layers directly")
+
+    walk(network)
+    if not replacements:
+        raise MXNetError("no quantizable (Dense/Conv2D) layers found")
+    qnet = QuantizedNet(network, replacements)
+
+    if calib_data is not None:
+        n = 0
+        for batch in calib_data:
+            x = batch[0] if isinstance(batch, (tuple, list)) else batch
+            # run the ORIGINAL fp net, observing inputs to each twin —
+            # same traversal as inference, nested containers included
+            qnet._forward(network, x, observe=True)
+            n += 1
+            if num_calib_batches is not None and n >= num_calib_batches:
+                break
+    return qnet
+
+
+def _descendants(block):
+    for c in getattr(block, "_children", {}).values():
+        yield c
+        yield from _descendants(c)
+
+
+def quantize_model(sym_or_net, *args, **kwargs):
+    """Reference-named entry: quantize a Gluon block (the Symbol/Module
+    path quantizes the bound net the same way)."""
+    return quantize_net(sym_or_net, *args, **kwargs)

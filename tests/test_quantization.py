@@ -1,0 +1,149 @@
+"""INT8 quantization tests (SURVEY.md §2 #49; reference:
+tests/python/quantization/test_quantization.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.contrib import quantization as q
+from mxnet_tpu.gluon import nn
+
+
+def test_quantize_dequantize_roundtrip():
+    x = nd.array(np.linspace(-2.0, 2.0, 64).astype(np.float32))
+    xq, mn, mx_ = q.quantize(x)
+    assert "int8" in str(xq.dtype)
+    back = q.dequantize(xq, mn, mx_)
+    np.testing.assert_allclose(back.asnumpy(), x.asnumpy(), atol=2.0 / 127)
+
+
+def test_quantized_dense_matches_fp():
+    mx.random.seed(0)
+    dense = nn.Dense(16, in_units=32)
+    dense.initialize()
+    qd = q.QuantizedDense(dense)
+    assert str(qd.wq.dtype) == "int8"
+    x = nd.random.uniform(-1, 1, shape=(4, 32))
+    y_fp = dense(x).asnumpy()
+    y_q = qd(x).asnumpy()
+    # int8 symmetric: ~1% of dynamic range
+    err = np.abs(y_fp - y_q).max() / (np.abs(y_fp).max() + 1e-6)
+    assert err < 0.05, err
+
+
+def test_quantized_conv_matches_fp():
+    mx.random.seed(1)
+    conv = nn.Conv2D(8, kernel_size=3, padding=1, in_channels=4)
+    conv.initialize()
+    x = nd.random.uniform(-1, 1, shape=(2, 4, 8, 8))
+    y_fp = conv(x).asnumpy()
+    qc = q.QuantizedConv2D(conv)
+    y_q = qc(x).asnumpy()
+    err = np.abs(y_fp - y_q).max() / (np.abs(y_fp).max() + 1e-6)
+    assert err < 0.05, err
+
+
+def test_quantize_net_end_to_end():
+    mx.random.seed(2)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(32, activation="relu", in_units=16),
+            nn.Dense(10, in_units=32))
+    net.initialize()
+    x = nd.random.uniform(-1, 1, shape=(8, 16))
+    y_fp = net(x).asnumpy()
+    qnet = q.quantize_net(net)
+    assert len(qnet.quantized_layers) == 2
+    y_q = qnet(x).asnumpy()
+    err = np.abs(y_fp - y_q).max() / (np.abs(y_fp).max() + 1e-6)
+    assert err < 0.1, err
+    # argmax (classification decision) should essentially agree
+    agree = (y_fp.argmax(1) == y_q.argmax(1)).mean()
+    assert agree >= 0.75
+
+
+def test_quantize_net_calibration_freezes_scales():
+    mx.random.seed(3)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, in_units=4))
+    net.initialize()
+    calib = [nd.random.uniform(-1, 1, shape=(4, 4)) for _ in range(3)]
+    qnet = q.quantize_net(net, calib_data=calib, num_calib_batches=3)
+    (layer,) = qnet.quantized_layers
+    assert layer._act_scale is not None and layer._act_scale > 0
+    x = nd.random.uniform(-1, 1, shape=(4, 4))
+    err = np.abs(net(x).asnumpy() - qnet(x).asnumpy()).max()
+    assert err < 0.1
+
+
+def test_quantize_net_exclude_layers():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, in_units=4), nn.Dense(2, in_units=8))
+    net.initialize()
+    qnet = q.quantize_net(net, exclude_layers=["1"])
+    assert len(qnet.quantized_layers) == 1
+
+
+def test_quantize_net_no_quantizable_raises():
+    net = nn.HybridSequential()
+    net.add(nn.Dropout(0.5))
+    with pytest.raises(Exception):
+        q.quantize_net(net)
+
+
+def test_quantize_net_nested_sequential():
+    """Nested Sequential containers are rewired too (not silently fp)."""
+    mx.random.seed(4)
+    inner = nn.HybridSequential()
+    inner.add(nn.Dense(16, activation="relu", in_units=8))
+    net = nn.HybridSequential()
+    net.add(inner, nn.Dense(4, in_units=16))
+    net.initialize()
+    x = nd.random.uniform(-1, 1, shape=(4, 8))
+    y_fp = net(x).asnumpy()
+    qnet = q.quantize_net(net)
+    assert len(qnet.quantized_layers) == 2
+    y_q = qnet(x).asnumpy()
+    err = np.abs(y_fp - y_q).max() / (np.abs(y_fp).max() + 1e-6)
+    assert err < 0.1, err
+
+
+def test_quantize_net_custom_block_refused():
+    """Quantizable layers hidden in a custom block raise instead of
+    silently running fp32."""
+    class Custom(nn.HybridBlock):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            with self.name_scope():
+                self.fc = nn.Dense(4, in_units=4)
+
+        def hybrid_forward(self, F, x):
+            return self.fc(x)
+
+    net = nn.HybridSequential()
+    net.add(Custom())
+    net.initialize()
+    with pytest.raises(Exception):
+        q.quantize_net(net)
+
+
+def test_quantized_conv_dilation_and_groups():
+    mx.random.seed(5)
+    conv = nn.Conv2D(8, kernel_size=3, padding=2, dilation=2, groups=2,
+                     in_channels=4)
+    conv.initialize()
+    x = nd.random.uniform(-1, 1, shape=(2, 4, 8, 8))
+    y_fp = conv(x).asnumpy()
+    qc = q.QuantizedConv2D(conv)
+    y_q = qc(x).asnumpy()
+    assert y_q.shape == y_fp.shape
+    err = np.abs(y_fp - y_q).max() / (np.abs(y_fp).max() + 1e-6)
+    assert err < 0.05, err
+
+
+def test_quantized_dense_sigmoid_activation():
+    dense = nn.Dense(4, activation="sigmoid", in_units=4)
+    dense.initialize()
+    x = nd.random.uniform(-1, 1, shape=(2, 4))
+    y_fp = dense(x).asnumpy()
+    y_q = q.QuantizedDense(dense)(x).asnumpy()
+    np.testing.assert_allclose(y_fp, y_q, atol=0.02)

@@ -163,3 +163,39 @@ def test_multipart_record_framing(tmp_path):
         r.close()
     finally:
         rio._MAX_CHUNK = old
+
+
+def test_im2rec_tool(tmp_path):
+    """tools/im2rec.py builds .lst/.rec/.idx that our readers consume."""
+    import subprocess, sys, os
+    from PIL import Image
+    root = tmp_path / "imgs"
+    for cls in ("cat", "dog"):
+        (root / cls).mkdir(parents=True)
+        for i in range(3):
+            arr = (np.random.RandomState(hash(cls) % 100 + i)
+                   .rand(10, 10, 3) * 255).astype(np.uint8)
+            Image.fromarray(arr).save(root / cls / f"{i}.png")
+    prefix = str(tmp_path / "pack")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "im2rec.py"),
+         prefix, str(root)],
+        capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr
+    # .lst has 6 entries with labels 0 (cat) and 1 (dog)
+    lines = open(prefix + ".lst").read().strip().splitlines()
+    assert len(lines) == 6
+    labels = sorted({float(l.split("\t")[1]) for l in lines})
+    assert labels == [0.0, 1.0]
+    # readable by MXIndexedRecordIO + unpack_img
+    r = recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "r")
+    assert len(r.keys) == 6
+    h, img = recordio.unpack_img(r.read_idx(0), iscolor=1)
+    assert img.shape == (10, 10, 3)
+    r.close()
+    # and by ImageRecordIter
+    it = mx.io.ImageRecordIter(path_imgrec=prefix + ".rec",
+                               data_shape=(3, 10, 10), batch_size=6)
+    batch = it.next()
+    assert batch.data[0].shape == (6, 3, 10, 10)
