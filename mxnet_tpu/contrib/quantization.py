@@ -63,9 +63,16 @@ def quantize(data, min_range=None, max_range=None, out_type="int8"):
     observed +-absmax."""
     if out_type != "int8":
         raise MXNetError("TPU quantization is int8 (MXU-native)")
+    calib = None
+    if min_range is not None or max_range is not None:
+        mn = float(getattr(min_range, "asnumpy", lambda: min_range)()
+                   if hasattr(min_range, "asnumpy") else (min_range or 0.0))
+        mx_ = float(getattr(max_range, "asnumpy", lambda: max_range)()
+                    if hasattr(max_range, "asnumpy") else (max_range or 0.0))
+        calib = max(abs(mn), abs(mx_))
 
     def f(x):
-        amax = jnp.max(jnp.abs(x))
+        amax = jnp.float32(calib) if calib is not None             else jnp.max(jnp.abs(x))
         scale = _scale_of(amax)
         q = jnp.clip(jnp.round(x / scale), -127, 127).astype(jnp.int8)
         return q, -amax, amax
@@ -76,14 +83,17 @@ def quantize(data, min_range=None, max_range=None, out_type="int8"):
 
 
 def dequantize(data, min_range, max_range):
-    """int8 -> float32 (reference: dequantize op)."""
+    """int8 -> float32 (reference: dequantize op). Ranges may be NDArrays,
+    jax arrays, or plain floats."""
     def f(q, mn, mx):
         scale = _scale_of(jnp.maximum(jnp.abs(mn), jnp.abs(mx)))
         return q.astype(jnp.float32) * scale
 
     if isinstance(data, NDArray):
-        return _apply(f, [data, min_range, max_range])
-    return f(data, min_range, max_range)
+        def lift(r):
+            return r if isinstance(r, NDArray) else NDArray(jnp.asarray(r))
+        return _apply(f, [data, lift(min_range), lift(max_range)])
+    return f(data, jnp.asarray(min_range), jnp.asarray(max_range))
 
 
 def _quantize_weight(w):

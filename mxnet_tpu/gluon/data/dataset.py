@@ -97,7 +97,7 @@ class RecordFileDataset(Dataset):
     .idx sidecar for random access when present, else loads sequentially."""
 
     def __init__(self, filename):
-        from ...recordio import MXRecordIO, MXIndexedRecordIO
+        from ...recordio import MXIndexedRecordIO, open_record_file
         idx_path = os.path.splitext(filename)[0] + ".idx"
         if os.path.exists(idx_path):
             self._rec = MXIndexedRecordIO(idx_path, filename, "r")
@@ -105,14 +105,9 @@ class RecordFileDataset(Dataset):
             self._records = None
         else:
             self._rec = None
-            self._records = []
-            r = MXRecordIO(filename, "r")
-            while True:
-                item = r.read()
-                if item is None:
-                    break
-                self._records.append(item)
-            r.close()
+            # native mmap reader (cpp/recordio.cc) when it builds; list of
+            # bytes from the Python scan otherwise — same random access
+            self._records = open_record_file(filename)
 
     def __len__(self):
         return len(self._keys) if self._records is None else \

@@ -1,8 +1,9 @@
 """mx.io data iterators (reference: python/mxnet/io/).
 
 NDArrayIter & friends with the reference's DataBatch/DataDesc protocol.
-ImageRecordIter is backed by the synthetic image pipeline (no network /
-recordio files in this environment) with identical shapes and API.
+ImageRecordIter reads real RecordIO .rec packs (native mmap reader or
+.idx random access; sequential streaming otherwise) and falls back to a
+deterministic synthetic stream when no file is given (offline testing).
 """
 from __future__ import annotations
 
@@ -244,15 +245,25 @@ class ImageRecordIter(DataIter):
         self._rec = None
         self._keys = None
         if path_imgrec is not None and os.path.exists(path_imgrec):
-            from .recordio import MXRecordIO, MXIndexedRecordIO
+            from .recordio import (MXRecordIO, MXIndexedRecordIO,
+                                   NativeRecordFile)
             idx_path = os.path.splitext(path_imgrec)[0] + ".idx"
             if os.path.exists(idx_path):
                 self._rec = MXIndexedRecordIO(idx_path, path_imgrec, "r")
                 self._keys = self._rec.keys
                 self.num_samples = len(self._keys)
             else:
-                self._rec = MXRecordIO(path_imgrec, "r")
-                self.num_samples = None  # unknown: EOF drives StopIteration
+                try:
+                    # native mmap reader: random access without an .idx.
+                    # _keys is a range (identity, O(1) memory) — a list
+                    # would allocate GBs on production-sized recs
+                    native = NativeRecordFile(path_imgrec)
+                    self._rec = native
+                    self._keys = range(len(native))
+                    self.num_samples = len(native)
+                except Exception:
+                    self._rec = MXRecordIO(path_imgrec, "r")
+                    self.num_samples = None  # unknown: EOF ends epoch
 
     def _decode(self, raw):
         from .recordio import unpack_img
@@ -285,7 +296,9 @@ class ImageRecordIter(DataIter):
 
     def _next_raw(self, i):
         if self._keys is not None:
-            return self._rec.read_idx(self._keys[i])
+            if hasattr(self._rec, "read_idx"):       # .idx sidecar path
+                return self._rec.read_idx(self._keys[i])
+            return self._rec[self._keys[i]]          # native mmap reader
         return self._rec.read()    # sequential; None at EOF
 
     def next(self):

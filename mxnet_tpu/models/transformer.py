@@ -29,7 +29,8 @@ from ..gluon.block import HybridBlock, extract_pure_fn
 from ..ops.pallas_kernels import flash_attention
 
 __all__ = ["TransformerEncoder", "TransformerDecoder", "TransformerNMT",
-           "transformer_base", "beam_search", "sinusoid_table"]
+           "transformer_base", "beam_search", "beam_search_cached",
+           "decode_step", "decoder_weights", "sinusoid_table"]
 
 
 def sinusoid_table(max_len, units):
@@ -321,6 +322,197 @@ def beam_search(model: TransformerNMT, src, src_valid_length=None,
         lp = ((5.0 + lengths) / 6.0) ** alpha             # GNMT length norm
         norm = scores / lp
         norm = norm.reshape(B, K)
+        order = jnp.argsort(-norm, axis=1)
+        tokens = tokens.reshape(B, K, max_length)
+        tokens = jnp.take_along_axis(tokens, order[:, :, None], axis=1)
+        norm = jnp.take_along_axis(norm, order, axis=1)
+        return tokens, norm
+
+    tokens, norm = jax.jit(run)()
+    return NDArray(tokens), NDArray(norm)
+
+
+# ---------------------------------------------------------------------------
+# KV-cached incremental decode (reference class: gluonnlp's decoder states /
+# Sockeye inference caches). TPU-native: caches are static (B, H, Lmax, dh)
+# buffers updated with dynamic_update_slice, attention over the cache is
+# masked by the current step — so ONE compiled program serves every step,
+# and beam search drops from O(L^3) to O(L^2) total attention work.
+# ---------------------------------------------------------------------------
+def _dense_w(dense):
+    w = dense.weight.data()._data
+    b = dense.bias.data()._data if dense.bias is not None else None
+    return w, b
+
+
+def _ln_w(ln):
+    return (ln.gamma.data()._data, ln.beta.data()._data,
+            jnp.float32(ln._epsilon))
+
+
+def decoder_weights(model):
+    """Snapshot the decoder's weights as a pytree of jax arrays for the
+    pure cached-decode program."""
+    dec = model.decoder
+    layers = []
+    for layer in dec.layers:
+        layers.append(dict(
+            qkv=_dense_w(layer.self_attn.qkv),
+            sproj=_dense_w(layer.self_attn.proj),
+            q=_dense_w(layer.cross_attn.q),
+            kv=_dense_w(layer.cross_attn.kv),
+            cproj=_dense_w(layer.cross_attn.proj),
+            ffn1=_dense_w(layer.ffn.ffn1),
+            ffn2=_dense_w(layer.ffn.ffn2),
+            ln1=_ln_w(layer.ln1), ln2=_ln_w(layer.ln2),
+            ln3=_ln_w(layer.ln3)))
+    first = dec.layers[0]
+    return dict(embed=model.embed.weight.data()._data, layers=layers,
+                pos=jnp.asarray(dec._pos), scale=jnp.float32(dec._scale),
+                num_heads=first.self_attn._h)
+
+
+def _ln_apply(x, lnw):
+    g, b, eps = lnw
+    mu = jnp.mean(x, axis=-1, keepdims=True)
+    xc = x - mu
+    var = jnp.mean(xc * xc, axis=-1, keepdims=True)
+    return xc * jax.lax.rsqrt(var + eps) * g + b
+
+
+def _affine(x, wb):
+    w, b = wb
+    y = x @ w.T
+    return y + b if b is not None else y
+
+
+def _heads(x, h):
+    b, u = x.shape
+    return x.reshape(b, h, 1, u // h)
+
+
+def precompute_memory_kv(weights, memory):
+    """Cross-attention K/V for every layer, computed once per sequence:
+    list of (k (B,H,S,dh), v (B,H,S,dh))."""
+    out = []
+    h = weights["num_heads"]
+    for L in weights["layers"]:
+        kv = _affine(memory, L["kv"])
+        k, v = jnp.split(kv, 2, axis=-1)
+        out.append((_split_heads(k, h), _split_heads(v, h)))
+    return out
+
+
+def decode_step(weights, caches, mem_kv, mem_vl, tok_t, t):
+    """One incremental decode step.
+
+    caches: (k, v) stacks of shape (n_layers, B, H, Lmax, dh).
+    tok_t: (B,) int32 current tokens; t: scalar step index.
+    Returns (logits (B, V), new_caches)."""
+    h = weights["num_heads"]
+    x = weights["embed"][tok_t] * weights["scale"] + weights["pos"][t]
+    k_caches, v_caches = caches
+    new_k, new_v = [], []
+    lmax = k_caches.shape[3]
+    step_mask = (jnp.arange(lmax) <= t)[None, None, None, :]
+    for li, L in enumerate(weights["layers"]):
+        # self-attention over the cache
+        qkv = _affine(x, L["qkv"])
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        qh, kh, vh = (_heads(a, h) for a in (q, k, v))
+        kc = lax.dynamic_update_slice(k_caches[li], kh, (0, 0, t, 0))
+        vc = lax.dynamic_update_slice(v_caches[li], vh, (0, 0, t, 0))
+        new_k.append(kc)
+        new_v.append(vc)
+        dh = qh.shape[-1]
+        s = jnp.einsum("bhqd,bhkd->bhqk", qh, kc,
+                       preferred_element_type=jnp.float32) / jnp.sqrt(
+                           jnp.float32(dh))
+        s = jnp.where(step_mask, s, -1e30)
+        p = jax.nn.softmax(s, axis=-1).astype(vc.dtype)
+        attn = _merge_heads(jnp.einsum("bhqk,bhkd->bhqd", p, vc))[:, 0]
+        x = _ln_apply(x + _affine(attn, L["sproj"]), L["ln1"])
+        # cross-attention over the precomputed memory K/V
+        mk, mv = mem_kv[li]
+        qc = _heads(_affine(x, L["q"]), h)
+        s = jnp.einsum("bhqd,bhkd->bhqk", qc, mk,
+                       preferred_element_type=jnp.float32) / jnp.sqrt(
+                           jnp.float32(dh))
+        if mem_vl is not None:
+            keep = (jnp.arange(mk.shape[2])[None, :]
+                    < mem_vl[:, None])[:, None, None, :]
+            s = jnp.where(keep, s, -1e30)
+        p = jax.nn.softmax(s, axis=-1).astype(mv.dtype)
+        attn = _merge_heads(jnp.einsum("bhqk,bhkd->bhqd", p, mv))[:, 0]
+        x = _ln_apply(x + _affine(attn, L["cproj"]), L["ln2"])
+        # ffn
+        f = jnp.maximum(_affine(x, L["ffn1"]), 0)
+        x = _ln_apply(x + _affine(f, L["ffn2"]), L["ln3"])
+    logits = x @ weights["embed"].T
+    return logits, (jnp.stack(new_k), jnp.stack(new_v))
+
+
+def beam_search_cached(model, src, src_valid_length=None, beam_size=4,
+                       max_length=32, bos_id=2, eos_id=3, alpha=0.6):
+    """Beam search with KV caches: one jitted `lax.scan`, O(L) attention
+    per step instead of re-running the decoder over the whole prefix.
+    Same contract as `beam_search`."""
+    weights = decoder_weights(model)
+    B = src.shape[0]
+    K = beam_size
+    V = model.vocab_size
+    h = weights["num_heads"]
+    u = weights["embed"].shape[1]
+    dh = u // h
+    n_layers = len(weights["layers"])
+
+    memory, _ = model.encode(src, src_valid_length)
+    # project K/V once per source sequence, THEN repeat per beam — the
+    # repeated copies are byte-identical, so projecting after repeat would
+    # do beam_size-times redundant MXU work
+    mem_kv = [(jnp.repeat(mk, K, axis=0), jnp.repeat(mv, K, axis=0))
+              for mk, mv in precompute_memory_kv(weights, memory._data)]
+    mem_vl = (jnp.repeat(src_valid_length._data, K, axis=0)
+              if src_valid_length is not None else None)
+
+    neg_inf = -1e9
+
+    def step(carry, t):
+        tokens, scores, done, caches = carry
+        tok_t = tokens[:, t]
+        logits, caches = decode_step(weights, caches, mem_kv, mem_vl,
+                                     tok_t, t)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+        eos_only = jnp.full((V,), neg_inf).at[eos_id].set(0.0)
+        logp = jnp.where(done[:, None], eos_only[None], logp)
+        cand = (scores[:, None] + logp).reshape(B, K * V)
+        top_scores, top_idx = lax.top_k(cand, K)
+        beam_idx = top_idx // V
+        tok_idx = (top_idx % V).astype(jnp.int32)
+        flat_beam = (jnp.arange(B)[:, None] * K + beam_idx).reshape(-1)
+        tokens = tokens[flat_beam]
+        done = done[flat_beam]
+        k_c, v_c = caches
+        caches = (k_c[:, flat_beam], v_c[:, flat_beam])
+        tokens = tokens.at[:, t + 1].set(
+            jnp.where(done, tokens[:, t + 1], tok_idx.reshape(-1)))
+        done = jnp.logical_or(done, tok_idx.reshape(-1) == eos_id)
+        return (tokens, top_scores.reshape(-1), done, caches), None
+
+    tokens0 = jnp.zeros((B * K, max_length), jnp.int32).at[:, 0].set(bos_id)
+    scores0 = jnp.where(jnp.arange(B * K) % K == 0, 0.0, neg_inf)
+    done0 = jnp.zeros((B * K,), bool)
+    caches0 = (jnp.zeros((n_layers, B * K, h, max_length, dh),
+                         weights["embed"].dtype),) * 2
+
+    def run():
+        (tokens, scores, done, _), _ = lax.scan(
+            step, (tokens0, scores0, done0, caches0),
+            jnp.arange(max_length - 1))
+        lengths = jnp.argmax(tokens == eos_id, axis=1)
+        lengths = jnp.where(lengths == 0, max_length, lengths + 1)
+        lp = ((5.0 + lengths) / 6.0) ** alpha
+        norm = (scores / lp).reshape(B, K)
         order = jnp.argsort(-norm, axis=1)
         tokens = tokens.reshape(B, K, max_length)
         tokens = jnp.take_along_axis(tokens, order[:, :, None], axis=1)

@@ -16,8 +16,11 @@ reference uses OpenCV).
 `MXIndexedRecordIO` adds the `.idx` sidecar (``key\\tbyte-offset\\n`` lines)
 for random access — the format ImageRecordIter and the im2rec tooling use.
 
-Pure Python + NumPy: record IO is host-side input-pipeline work; the TPU
-never sees these bytes until the batch is device_put.
+Record IO is host-side input-pipeline work (the TPU never sees these bytes
+until the batch is device_put). The sequential/packing classes are Python;
+the random-access hot path (`open_record_file` / `NativeRecordFile`) is
+backed by the native C++ mmap reader in cpp/recordio.cc when it builds —
+the counterpart of the reference's dmlc-core C++ RecordIO.
 """
 from __future__ import annotations
 
@@ -31,7 +34,8 @@ import numpy as np
 from .base import MXNetError
 
 __all__ = ["MXRecordIO", "MXIndexedRecordIO", "IRHeader",
-           "pack", "unpack", "pack_img", "unpack_img"]
+           "pack", "unpack", "pack_img", "unpack_img",
+           "NativeRecordFile", "open_record_file"]
 
 _kMagic = 0xced7230a
 _LEN_MASK = (1 << 29) - 1
@@ -222,3 +226,121 @@ def unpack_img(s, iscolor=-1):
     elif iscolor == 1:
         img = img.convert("RGB")
     return header, np.asarray(img)
+
+
+# ---------------------------------------------------------------------------
+# native fast path (cpp/recordio.cc): mmap + upfront offset index, zero-copy
+# record access for the DataLoader hot path — the counterpart of the
+# reference's dmlc-core C++ RecordIO (its Python class defers to the C++
+# reader the same way).
+# ---------------------------------------------------------------------------
+_native_lib = None
+_native_tried = False
+
+
+def _load_native():
+    global _native_lib, _native_tried
+    if _native_tried:
+        return _native_lib
+    _native_tried = True
+    try:
+        import ctypes
+        import subprocess
+        from pathlib import Path
+        root = Path(__file__).resolve().parent.parent
+        src = root / "cpp" / "recordio.cc"
+        out = root / "cpp" / "build" / "libmxtpu_recordio.so"
+        if not out.exists() or out.stat().st_mtime < src.stat().st_mtime:
+            out.parent.mkdir(parents=True, exist_ok=True)
+            tmp = out.with_suffix(f".so.tmp{os.getpid()}")
+            subprocess.run(
+                ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+                 str(src), "-o", str(tmp)],
+                check=True, capture_output=True)
+            os.replace(tmp, out)
+        import ctypes as ct
+        lib = ct.CDLL(str(out))
+        lib.MXTPURecOpen.restype = ct.c_void_p
+        lib.MXTPURecOpen.argtypes = [ct.c_char_p]
+        lib.MXTPURecCount.restype = ct.c_int64
+        lib.MXTPURecCount.argtypes = [ct.c_void_p]
+        lib.MXTPURecGet.restype = ct.c_int
+        lib.MXTPURecGet.argtypes = [ct.c_void_p, ct.c_int64,
+                                    ct.POINTER(ct.POINTER(ct.c_uint8)),
+                                    ct.POINTER(ct.c_uint64)]
+        lib.MXTPURecGetCopy.restype = ct.c_int64
+        lib.MXTPURecGetCopy.argtypes = [ct.c_void_p, ct.c_int64,
+                                        ct.c_char_p, ct.c_uint64]
+        lib.MXTPURecClose.argtypes = [ct.c_void_p]
+        _native_lib = lib
+    except Exception:
+        _native_lib = None
+    return _native_lib
+
+
+class NativeRecordFile:
+    """Random-access view of a whole .rec via the native mmap reader.
+    Returns bytes objects (copied out of the map — safe to keep). Raises
+    MXNetError if the native library cannot be built or the file does not
+    parse; callers fall back to the Python MXRecordIO."""
+
+    def __init__(self, path):
+        import ctypes
+        lib = _load_native()
+        if lib is None:
+            raise MXNetError("native recordio unavailable")
+        self._lib = lib
+        self._h = lib.MXTPURecOpen(str(path).encode())
+        if not self._h:
+            raise MXNetError(f"native recordio failed to open {path}")
+        self._n = lib.MXTPURecCount(self._h)
+        self._ct = ctypes
+
+    def __len__(self):
+        return self._n
+
+    def __getitem__(self, i):
+        if i < 0:
+            i += self._n
+        ct = self._ct
+        ptr = ct.POINTER(ct.c_uint8)()
+        ln = ct.c_uint64()
+        rc = self._lib.MXTPURecGet(self._h, i, ct.byref(ptr), ct.byref(ln))
+        if rc == 0:
+            return ct.string_at(ptr, ln.value)
+        if rc == 1:  # multipart
+            size = self._lib.MXTPURecGetCopy(self._h, i, None, 0)
+            buf = ct.create_string_buffer(size)
+            w = self._lib.MXTPURecGetCopy(self._h, i, buf, size)
+            if w != size:
+                raise MXNetError("native recordio copy failed")
+            return buf.raw
+        raise IndexError(i)
+
+    def close(self):
+        if getattr(self, "_h", None):
+            self._lib.MXTPURecClose(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+def open_record_file(path):
+    """Random-access reader for a .rec: native mmap reader when the C++
+    library builds, else a Python scan into a list of bytes."""
+    try:
+        return NativeRecordFile(path)
+    except MXNetError:
+        records = []
+        r = MXRecordIO(path, "r")
+        while True:
+            item = r.read()
+            if item is None:
+                break
+            records.append(item)
+        r.close()
+        return records

@@ -214,3 +214,26 @@ def test_get_bert_specs():
     assert net2._units == 1024 and len(net2.encoder.layers._children) == 24
     with pytest.raises(MXNetError):
         get_bert("bert_unknown")
+
+
+def test_beam_search_cached_matches_full_recompute():
+    """KV-cached incremental decode (decode_step + beam_search_cached) must
+    produce EXACTLY the same beams as the re-run-the-prefix decoder."""
+    from mxnet_tpu.models.transformer import (TransformerNMT, beam_search,
+                                              beam_search_cached)
+    mx.random.seed(11)
+    t = TransformerNMT(50, units=32, hidden=64, num_layers=2, num_heads=4,
+                       max_length=32, dropout=0.0)
+    t.initialize()
+    rng = np.random.RandomState(0)
+    src = mx.nd.array(rng.randint(4, 50, (2, 12)).astype(np.int32))
+    svl = mx.nd.array(np.array([8, 12], np.int32))
+    tok1, sc1 = beam_search(t, src, svl, beam_size=3, max_length=10)
+    tok2, sc2 = beam_search_cached(t, src, svl, beam_size=3, max_length=10)
+    np.testing.assert_array_equal(tok1.asnumpy(), tok2.asnumpy())
+    np.testing.assert_allclose(sc1.asnumpy(), sc2.asnumpy(), rtol=1e-4,
+                               atol=1e-4)
+    # and without source lengths
+    tok3, _ = beam_search(t, src, beam_size=2, max_length=8)
+    tok4, _ = beam_search_cached(t, src, beam_size=2, max_length=8)
+    np.testing.assert_array_equal(tok3.asnumpy(), tok4.asnumpy())
