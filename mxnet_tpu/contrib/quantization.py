@@ -63,13 +63,14 @@ def quantize(data, min_range=None, max_range=None, out_type="int8"):
     observed +-absmax."""
     if out_type != "int8":
         raise MXNetError("TPU quantization is int8 (MXU-native)")
+    def _to_float(r):
+        if r is None:
+            return 0.0
+        return float(r.asnumpy()) if hasattr(r, "asnumpy") else float(r)
+
     calib = None
     if min_range is not None or max_range is not None:
-        mn = float(getattr(min_range, "asnumpy", lambda: min_range)()
-                   if hasattr(min_range, "asnumpy") else (min_range or 0.0))
-        mx_ = float(getattr(max_range, "asnumpy", lambda: max_range)()
-                    if hasattr(max_range, "asnumpy") else (max_range or 0.0))
-        calib = max(abs(mn), abs(mx_))
+        calib = max(abs(_to_float(min_range)), abs(_to_float(max_range)))
 
     def f(x):
         amax = jnp.float32(calib) if calib is not None             else jnp.max(jnp.abs(x))

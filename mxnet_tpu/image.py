@@ -1,10 +1,11 @@
 """mx.image (reference: python/mxnet/image/image.py).
 
-Image ops over HWC NDArrays. Decoding uses numpy-compatible formats (npy/raw)
-since no image codecs are guaranteed offline; resize/crop/flip augmenters run
-through jax.image on device.
+Image ops over HWC NDArrays. Decoding uses PIL (the reference uses
+OpenCV); resize/crop/flip augmenters run through jax.image on device.
 """
 from __future__ import annotations
+
+import io as _io
 
 import numpy as np
 
@@ -18,15 +19,45 @@ __all__ = ["imread", "imdecode", "imresize", "resize_short", "fixed_crop",
 
 
 def imread(filename, flag=1, to_rgb=True):
-    if filename.endswith(".npy"):
+    """Read an image file to an HWC uint8 NDArray (reference: cv2.imread;
+    PIL here). flag=0 decodes grayscale (H, W, 1)."""
+    if str(filename).endswith(".npy"):
         return array(np.load(filename))
-    raise MXNetError("offline build: only .npy images supported in imread")
+    from PIL import Image
+    img = Image.open(filename)
+    img = img.convert("L") if flag == 0 else img.convert("RGB")
+    arr = np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[:, :, None]
+    return array(arr)
 
 
 def imdecode(buf, flag=1, to_rgb=True):
-    arr = np.frombuffer(buf, dtype=np.uint8)
-    side = int(np.sqrt(arr.size // 3))
-    return array(arr[:side * side * 3].reshape(side, side, 3))
+    """Decode encoded image bytes (JPEG/PNG/... via PIL). A buffer with NO
+    recognised image header falls back to raw-square interpretation (the
+    synthetic pipeline's format); a RECOGNISED but corrupt image raises,
+    like the reference's imdecode — silent garbage is worse than an
+    error."""
+    if isinstance(buf, NDArray):
+        buf = bytes(buf.asnumpy().astype(np.uint8))
+    from PIL import Image, UnidentifiedImageError
+    try:
+        img = Image.open(_io.BytesIO(buf))
+    except UnidentifiedImageError:
+        arr = np.frombuffer(buf, dtype=np.uint8)
+        ch = 1 if flag == 0 else 3
+        side = int(np.sqrt(arr.size // ch))
+        if side == 0:
+            raise MXNetError("imdecode: cannot decode buffer")
+        return array(arr[:side * side * ch].reshape(side, side, ch))
+    try:
+        img = img.convert("L") if flag == 0 else img.convert("RGB")
+        arr = np.asarray(img)
+    except Exception as e:
+        raise MXNetError(f"imdecode: corrupt image data: {e}") from e
+    if arr.ndim == 2:
+        arr = arr[:, :, None]
+    return array(arr)
 
 
 def imresize(src, w, h, interp=1):

@@ -68,6 +68,9 @@ class CompositeConfig(NamedTuple):
     n_micro: int = 2
     capacity_factor: float = 2.0
     lr: float = 0.1
+    remat: bool = False   # jax.checkpoint each transformer layer: trade
+                          # recompute FLOPs for activation memory (long-seq
+                          # / big-batch configs)
 
 
 # ---------------------------------------------------------------------------
@@ -289,15 +292,20 @@ def _moe_ffn(bp, h, cfg, ep_size):
 
 def _stage_fn(bp_local, h, cfg, ep_size, layers_per_stage):
     """Apply this device's layers_per_stage transformer layers sequentially.
-    bp_local leaves: (layers_per_stage, ...)."""
-    def one(i, x):
-        bp = jax.tree_util.tree_map(lambda p: p[i], bp_local)
+    bp_local leaves: (layers_per_stage, ...). With cfg.remat each layer is
+    rematerialised on backward (jax.checkpoint) so only layer BOUNDARY
+    activations are kept live — the standard long-sequence memory/FLOPs
+    trade."""
+    def one(bp, x):
         x = _attention(bp, x, cfg)
         x = _dense_ffn(bp, x)
         x = _moe_ffn(bp, x, cfg, ep_size)
         return x
+    if cfg.remat:
+        one = jax.checkpoint(one)
     for i in range(layers_per_stage):   # static unroll: tiny depth
-        h = one(i, h)
+        bp = jax.tree_util.tree_map(lambda p: p[i], bp_local)
+        h = one(bp, h)
     return h
 
 

@@ -22,19 +22,27 @@ __all__ = ["DataParallelTrainer", "make_train_step"]
 
 
 def make_train_step(block, loss_block, optimizer, mesh=None, dp_axis="dp",
-                    donate=True, compute_dtype=None):
+                    donate=True, compute_dtype=None, remat=False):
     """Build (step_fn, init_state). step_fn(state, x, y, lr) -> (state, loss).
 
     The returned step is jit-compiled once; with a mesh, x/y are expected
-    sharded over `dp_axis` and params replicated.
+    sharded over `dp_axis` and params replicated. remat=True wraps the
+    model forward in `jax.checkpoint` so backward recomputes activations
+    instead of keeping them live (long-seq / big-batch memory relief).
     """
     names = [n for n, _ in collect_params_ordered(block)]
     trainable = [n for n, p in collect_params_ordered(block)
                  if p.grad_req != "null"]
     trainable_set = set(trainable)
 
+    def fwd(params, x, rng):
+        return functional_call(block, params, [x], training=True, rng=rng)
+
+    if remat:
+        fwd = jax.checkpoint(fwd)
+
     def loss_of(params, x, y, rng):
-        out, aux = functional_call(block, params, [x], training=True, rng=rng)
+        out, aux = fwd(params, x, rng)
         out = out[0] if isinstance(out, tuple) else out
         if compute_dtype is not None:
             out = out.astype(jnp.float32)

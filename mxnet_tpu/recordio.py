@@ -244,7 +244,6 @@ def _load_native():
         return _native_lib
     _native_tried = True
     try:
-        import ctypes
         import subprocess
         from pathlib import Path
         root = Path(__file__).resolve().parent.parent

@@ -78,3 +78,21 @@ def test_make_composite_mesh_factorisation():
     mesh = make_composite_mesh(8)
     assert int(np.prod(list(mesh.shape.values()))) == 8
     assert set(mesh.shape) == {"dp", "pp", "tp", "sp", "ep"}
+
+
+def test_composite_remat_matches(problem):
+    """cfg.remat=True (jax.checkpoint per layer) must change memory, not
+    math: same updated params and loss as the non-remat sharded step."""
+    params, tokens, targets, ref_p, ref_loss = problem
+    mesh = _mesh_from_sizes((2, 1, 2, 1, 2))
+    cfg_r = CFG._replace(remat=True)
+    step, shard_params, data_sh = make_composite_train_step(mesh, cfg_r)
+    p = shard_params(jax.tree_util.tree_map(jnp.copy, params))
+    tok = jax.device_put(tokens, data_sh)
+    tgt = jax.device_put(targets, data_sh)
+    new_p, loss = step(p, tok, tgt)
+    host = jax.tree_util.tree_map(np.asarray, new_p)
+    assert np.isclose(float(loss), ref_loss, rtol=1e-4)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=2e-3, atol=2e-4),
+        host, ref_p)

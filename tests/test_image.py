@@ -1,0 +1,60 @@
+"""mx.image tests (reference: tests/python/unittest/test_image.py)."""
+import io as _io
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def _img(h=12, w=10, seed=0):
+    return (np.random.RandomState(seed).rand(h, w, 3) * 255).astype(np.uint8)
+
+
+def test_imread_png(tmp_path):
+    from PIL import Image
+    arr = _img()
+    p = str(tmp_path / "x.png")
+    Image.fromarray(arr).save(p)
+    img = mx.image.imread(p)
+    np.testing.assert_array_equal(img.asnumpy(), arr)
+
+
+def test_imread_grayscale(tmp_path):
+    from PIL import Image
+    arr = _img()
+    p = str(tmp_path / "x.png")
+    Image.fromarray(arr).save(p)
+    g = mx.image.imread(p, flag=0)
+    assert g.shape == (12, 10, 1)
+
+
+def test_imdecode_bytes():
+    from PIL import Image
+    arr = _img()
+    buf = _io.BytesIO()
+    Image.fromarray(arr).save(buf, format="PNG")
+    img = mx.image.imdecode(buf.getvalue())
+    np.testing.assert_array_equal(img.asnumpy(), arr)
+
+
+def test_imresize_and_resize_short():
+    x = mx.nd.array(_img(20, 10).astype(np.float32))
+    y = mx.image.imresize(x, 5, 8)
+    assert y.shape == (8, 5, 3)
+    z = mx.image.resize_short(x, 6)
+    assert min(z.shape[0], z.shape[1]) == 6
+
+
+def test_crops_and_augmenters():
+    x = mx.nd.array(_img(16, 16).astype(np.float32))
+    c, box = mx.image.center_crop(x, (8, 8))   # reference returns (img, box)
+    assert c.shape[:2] == (8, 8)
+    augs = mx.image.CreateAugmenter((3, 8, 8), rand_mirror=True,
+                                    mean=np.zeros(3, np.float32),
+                                    std=np.ones(3, np.float32))
+    out = x
+    for a in augs:
+        out = a(out)
+    assert out.shape[-1] == 3 or out.shape[0] == 3

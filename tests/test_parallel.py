@@ -226,3 +226,38 @@ def test_trainer_kvstore_dp_allreduce():
     tr.step(4)
     w1 = net.weight.data().asnumpy()
     assert not np.allclose(w0, w1)
+
+
+def test_data_parallel_remat_matches():
+    """make_train_step(remat=True) rematerialises the forward on backward
+    — memory trade only, identical math."""
+    from mxnet_tpu.parallel.data_parallel import make_train_step
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu import gluon
+
+    def build():
+        mx.random.seed(7)
+        net = nn.HybridSequential()
+        net.add(nn.Dense(16, activation="relu", in_units=8),
+                nn.Dense(3, in_units=16))
+        net.initialize(mx.init.Xavier())
+        net(mx.nd.zeros((1, 8)))
+        return net
+
+    loss = gluon.loss.SoftmaxCrossEntropyLoss()
+    opt = mx.optimizer.create("sgd", learning_rate=0.1)
+    x = jax.random.normal(jax.random.PRNGKey(0), (8, 8))
+    y = jax.random.randint(jax.random.PRNGKey(1), (8,), 0, 3)
+
+    outs = []
+    for remat in (False, True):
+        net = build()
+        step, init_state = make_train_step(net, loss, opt, remat=remat)
+        state = init_state()
+        state, l = step(state, x, y, 0.1, jax.random.PRNGKey(2))
+        outs.append((jax.tree_util.tree_map(np.asarray, state[0]), float(l)))
+    (p0, l0), (p1, l1) = outs
+    assert np.isclose(l0, l1, rtol=1e-6)
+    # the two nets carry different auto-prefixes; compare positionally
+    for k0, k1 in zip(sorted(p0), sorted(p1)):
+        np.testing.assert_allclose(p0[k0], p1[k1], rtol=1e-6, atol=1e-7)
