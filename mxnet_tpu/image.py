@@ -18,18 +18,30 @@ __all__ = ["imread", "imdecode", "imresize", "resize_short", "fixed_crop",
            "CreateAugmenter", "Augmenter"]
 
 
+def _finish_decode(arr, flag, to_rgb):
+    """Common post-decode: channel-count per `flag`, order per `to_rgb`
+    (reference cv2 semantics: to_rgb=False keeps BGR order)."""
+    if arr.ndim == 2:
+        arr = arr[:, :, None]
+    if flag == 0 and arr.shape[-1] == 3:         # luminance (ITU-R 601)
+        arr = (arr.astype(np.float32)
+               @ np.array([0.299, 0.587, 0.114], np.float32))
+        arr = arr.astype(np.uint8)[:, :, None]
+    if flag != 0 and not to_rgb and arr.shape[-1] == 3:
+        arr = arr[:, :, ::-1]                    # RGB -> BGR
+    return array(np.ascontiguousarray(arr))
+
+
 def imread(filename, flag=1, to_rgb=True):
     """Read an image file to an HWC uint8 NDArray (reference: cv2.imread;
-    PIL here). flag=0 decodes grayscale (H, W, 1)."""
+    PIL here). flag=0 yields grayscale (H, W, 1); to_rgb=False returns
+    BGR channel order (cv2 parity)."""
     if str(filename).endswith(".npy"):
-        return array(np.load(filename))
+        return _finish_decode(np.load(filename), flag, to_rgb)
     from PIL import Image
     img = Image.open(filename)
     img = img.convert("L") if flag == 0 else img.convert("RGB")
-    arr = np.asarray(img)
-    if arr.ndim == 2:
-        arr = arr[:, :, None]
-    return array(arr)
+    return _finish_decode(np.asarray(img), flag, to_rgb)
 
 
 def imdecode(buf, flag=1, to_rgb=True):
@@ -55,9 +67,7 @@ def imdecode(buf, flag=1, to_rgb=True):
         arr = np.asarray(img)
     except Exception as e:
         raise MXNetError(f"imdecode: corrupt image data: {e}") from e
-    if arr.ndim == 2:
-        arr = arr[:, :, None]
-    return array(arr)
+    return _finish_decode(arr, flag, to_rgb)
 
 
 def imresize(src, w, h, interp=1):

@@ -21,7 +21,10 @@ __all__ = ["seed", "uniform", "normal", "randn", "randint", "gamma",
            "bernoulli"]
 
 _lock = threading.Lock()
-_key = jax.random.PRNGKey(0)
+# Created on first use, NOT at import: building a PRNGKey runs a jit and
+# initialises the XLA backend, which would make `import mxnet_tpu` grab the
+# TPU and break jax.distributed.initialize-after-import (multi-host).
+_key = None
 
 
 def seed(seed_state, ctx="all"):
@@ -34,6 +37,8 @@ def seed(seed_state, ctx="all"):
 def _next_key():
     global _key
     with _lock:
+        if _key is None:
+            _key = jax.random.PRNGKey(0)
         _key, sub = jax.random.split(_key)
         return sub
 

@@ -1,5 +1,7 @@
 """RecordIO tests (SURVEY.md §1 serialization row; reference:
 tests/python/unittest/test_recordio.py)."""
+import os
+
 import numpy as np
 import pytest
 
@@ -203,7 +205,11 @@ def test_im2rec_tool(tmp_path):
 
 def _native_available():
     import mxnet_tpu.recordio as rio
-    return rio._load_native() is not None
+    ok = rio._load_native() is not None
+    if not ok and os.environ.get("MXTPU_REQUIRE_NATIVE") == "1":
+        raise AssertionError("MXTPU_REQUIRE_NATIVE=1 but native recordio "
+                             "library failed to build")
+    return ok
 
 
 def test_native_record_reader(tmp_path):
