@@ -1,0 +1,58 @@
+"""Data-parallel ResNet training over a device mesh — the fused-step path.
+
+Usage: python examples/data_parallel_resnet.py [--smoke]
+On a TPU pod slice this shards the batch over every chip; offline it runs
+on the virtual CPU mesh (XLA_FLAGS=--xla_force_host_platform_device_count=8).
+The whole train step (fwd+bwd+allreduce+update) is ONE compiled program
+with donated buffers — gradients never leave HBM.
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--steps", type=int, default=10)
+    ap.add_argument("--smoke", action="store_true")
+    args = ap.parse_args()
+    if args.smoke:
+        args.batch_size, args.steps = 8, 2
+
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon
+    from mxnet_tpu.gluon.model_zoo.vision import resnet18_v1
+    from mxnet_tpu.parallel.mesh import make_mesh, shard_batch
+    from mxnet_tpu.parallel.data_parallel import make_train_step
+
+    mx.random.seed(0)
+    n_dev = len(jax.devices())
+    mesh = make_mesh({"dp": n_dev})
+    print(f"devices: {n_dev}, mesh: {dict(mesh.shape)}")
+
+    size = 32 if args.smoke else 64
+    net = resnet18_v1()
+    net.initialize(mx.init.Xavier())
+    net(mx.nd.zeros((1, 3, size, size)))   # materialise deferred shapes
+
+    loss = gluon.loss.SoftmaxCrossEntropyLoss()
+    opt = mx.optimizer.create("sgd", learning_rate=0.05, momentum=0.9)
+    step, init_state = make_train_step(net, loss, opt, mesh=mesh)
+    state = init_state()
+
+    key = jax.random.PRNGKey(0)
+    x = jax.random.normal(key, (args.batch_size, 3, size, size))
+    y = jax.random.randint(key, (args.batch_size,), 0, 10)
+    xs, ys = shard_batch(mesh, x), shard_batch(mesh, y)
+
+    for i in range(args.steps):
+        state, l = step(state, xs, ys, 0.05, jax.random.PRNGKey(i))
+        print(f"step {i}: loss={float(l):.4f}")
+
+
+if __name__ == "__main__":
+    main()

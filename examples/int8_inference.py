@@ -1,0 +1,44 @@
+"""Quantize a trained net to int8 and compare against fp32.
+
+Usage: python examples/int8_inference.py [--smoke]
+On TPU the int8 dots run natively on the MXU with int32 accumulation.
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--smoke", action="store_true")
+    ap.parse_args()
+
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+    from mxnet_tpu.contrib import quantization as q
+    from mxnet_tpu.gluon import nn
+
+    mx.random.seed(0)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(64, activation="relu", in_units=32),
+            nn.Dense(10, in_units=64))
+    net.initialize()
+
+    calib = [nd.random.uniform(-1, 1, shape=(16, 32)) for _ in range(4)]
+    qnet = q.quantize_net(net, calib_data=calib)
+
+    x = nd.random.uniform(-1, 1, shape=(8, 32))
+    y_fp = net(x).asnumpy()
+    y_q = qnet(x).asnumpy()
+    rel = np.abs(y_fp - y_q).max() / (np.abs(y_fp).max() + 1e-9)
+    agree = (y_fp.argmax(1) == y_q.argmax(1)).mean()
+    print(f"quantized {len(qnet.quantized_layers)} layers")
+    print(f"max relative error vs fp32: {rel:.4f}")
+    print(f"argmax agreement: {agree:.2%}")
+
+
+if __name__ == "__main__":
+    main()
