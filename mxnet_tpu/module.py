@@ -122,6 +122,10 @@ class Module(BaseModule):
         self._exec.backward(out_grads)
 
     def update(self):
+        if self._updater is None:
+            raise MXNetError("call init_optimizer() before update() "
+                             "(reference: Module.update asserts "
+                             "optimizer_initialized)")
         for i, name in enumerate(self._param_names):
             self._updater(i, self._exec.grad_dict[name],
                           self._exec.arg_dict[name])

@@ -176,26 +176,29 @@ register_shape_rule("Embedding", _embed_shapes)
 # -- symbol-level API --------------------------------------------------------
 def FullyConnected(data, weight=None, bias=None, num_hidden=None,
                    no_bias=False, flatten=True, name=None, **kwargs):
-    ins = [data, weight] + ([] if no_bias or bias is None else [bias])
+    auto_bias = not no_bias             # reference: bias auto-created too
+    ins = [data, weight] + ([] if no_bias else [bias])
     return _make("FullyConnected", ins,
-                 {"no_bias": no_bias or bias is None, "num_hidden": num_hidden,
-                  "flatten": flatten}, name=name)
+                 {"no_bias": no_bias, "num_hidden": num_hidden,
+                  "flatten": flatten}, name=name,
+                 input_names=["data", "weight", "bias"])
 
 
 def StemConvS2D(data, weight=None, num_filter=None, name=None, **kwargs):
     return _make("StemConvS2D", [data, weight], {"num_filter": num_filter},
-                 name=name)
+                 name=name, input_names=["data", "weight"])
 
 
 def Convolution(data, weight=None, bias=None, kernel=None, stride=1, pad=0,
                 dilate=1, num_filter=None, num_group=1, no_bias=False,
                 layout=None, name=None, **kwargs):
-    ins = [data, weight] + ([] if no_bias or bias is None else [bias])
+    ins = [data, weight] + ([] if no_bias else [bias])
     return _make("Convolution", ins,
                  {"kernel": kernel, "stride": stride, "pad": pad,
                   "dilate": dilate, "num_filter": num_filter,
-                  "num_group": num_group, "no_bias": no_bias or bias is None,
-                  "layout": layout}, name=name)
+                  "num_group": num_group, "no_bias": no_bias,
+                  "layout": layout}, name=name,
+                 input_names=["data", "weight", "bias"])
 
 
 def Activation(data, act_type="relu", name=None, **kwargs):
@@ -206,13 +209,16 @@ def BatchNorm(data, gamma=None, beta=None, moving_mean=None, moving_var=None,
               eps=1e-5, momentum=0.9, axis=1, fix_gamma=False,
               use_global_stats=False, name=None, **kwargs):
     return _make("BatchNorm", [data, gamma, beta, moving_mean, moving_var],
-                 {"eps": eps, "momentum": momentum, "axis": axis}, name=name)
+                 {"eps": eps, "momentum": momentum, "axis": axis}, name=name,
+                 input_names=["data", "gamma", "beta", "moving_mean",
+                              "moving_var"])
 
 
 def LayerNorm(data, gamma=None, beta=None, axis=-1, eps=1e-5, name=None,
               **kwargs):
     return _make("LayerNorm", [data, gamma, beta],
-                 {"axis": axis, "eps": eps}, name=name)
+                 {"axis": axis, "eps": eps}, name=name,
+                 input_names=["data", "gamma", "beta"])
 
 
 def Pooling(data, kernel=None, pool_type="max", stride=None, pad=0,
@@ -230,7 +236,8 @@ def Dropout(data, p=0.5, name=None, **kwargs):
 def Embedding(data, weight=None, input_dim=None, output_dim=None, name=None,
               **kwargs):
     return _make("Embedding", [data, weight],
-                 {"input_dim": input_dim, "output_dim": output_dim}, name=name)
+                 {"input_dim": input_dim, "output_dim": output_dim},
+                 name=name, input_names=["data", "weight"])
 
 
 def SoftmaxOutput(data, label=None, name=None, **kwargs):
