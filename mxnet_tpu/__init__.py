@@ -45,6 +45,7 @@ from . import symbol as sym
 from . import module
 from . import module as mod
 from . import operator
+from . import name
 from . import callback
 from . import monitor
 from . import profiler

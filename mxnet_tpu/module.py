@@ -71,6 +71,10 @@ class Module(BaseModule):
                     allow_missing=False, force_init=False, **kwargs):
         if not self.binded:
             raise MXNetError("bind before init_params")
+        loaded = getattr(self, "_loaded_params", None)
+        if loaded is not None:  # Module.load: restore checkpoint params
+            arg_params = arg_params or loaded[0]
+            aux_params = aux_params or loaded[1]
         initializer = initializer or init_mod.Uniform(0.07)
         from . import random as rnd
         # infer param shapes from graph with given input shapes
@@ -94,8 +98,13 @@ class Module(BaseModule):
                      for name, a in args.items()
                      if name in self._param_names} \
             if self._for_training else None
+        # restored aux states pass through; anything missing is defaulted
+        # by Executor.__init__ (moving_var=1, else 0)
+        aux = {n: aux_params[n]
+               for n in self._symbol.list_auxiliary_states()
+               if aux_params and n in aux_params} or None
         self._exec = self._symbol.bind(self._ctx, args, grad_args,
-                                       self._grad_req)
+                                       self._grad_req, aux_states=aux)
         self.params_initialized = True
         return self
 
@@ -135,13 +144,16 @@ class Module(BaseModule):
 
     def get_params(self):
         arg_params = {n: self._exec.arg_dict[n] for n in self._param_names}
-        return arg_params, {}
+        return arg_params, dict(self._exec.aux_dict)
 
     def set_params(self, arg_params, aux_params=None, allow_missing=False,
                    force_init=True, allow_extra=False):
         for n, v in (arg_params or {}).items():
             if n in self._exec.arg_dict:
                 self._exec.arg_dict[n]._assign_value(v._data)
+        for n, v in (aux_params or {}).items():
+            if n in self._exec.aux_dict:
+                self._exec.aux_dict[n]._assign_value(v._data)
 
     def update_metric(self, eval_metric, labels, pre_sliced=False):
         eval_metric.update(labels, self.get_outputs())

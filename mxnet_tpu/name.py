@@ -1,0 +1,57 @@
+"""Symbol auto-naming (reference: python/mxnet/name.py).
+
+The reference names anonymous symbol ops `{op}{N}` with a process-global
+counter held by a NameManager; checkpoint name stability across processes is
+achieved by installing a fresh NameManager (or a Prefix) around model
+construction. Same contract here: `with NameManager():` gives the block its
+own zeroed counters, `with Prefix("p_"):` prepends a prefix.
+"""
+from __future__ import annotations
+
+import threading
+
+__all__ = ["NameManager", "Prefix", "current"]
+
+_tls = threading.local()
+
+
+def _stack():
+    if not hasattr(_tls, "stack"):
+        _tls.stack = [NameManager()]
+    return _tls.stack
+
+
+def current():
+    return _stack()[-1]
+
+
+class NameManager:
+    """Scoped auto-name counters: `{op}{N}` per op type (reference
+    behaviour), isolated per manager so model construction can be made
+    deterministic regardless of what was built earlier in the process."""
+
+    def __init__(self):
+        self._counts = {}
+
+    def get(self, hint):
+        i = self._counts.get(hint, 0)
+        self._counts[hint] = i + 1
+        return f"{hint}{i}"
+
+    def __enter__(self):
+        _stack().append(self)
+        return self
+
+    def __exit__(self, *exc):
+        _stack().pop()
+
+
+class Prefix(NameManager):
+    """NameManager that prepends a fixed prefix (reference: mx.name.Prefix)."""
+
+    def __init__(self, prefix):
+        super().__init__()
+        self._prefix = prefix
+
+    def get(self, hint):
+        return self._prefix + super().get(hint)

@@ -28,3 +28,6 @@ sample_normal = normal
 
 # custom-op invocation entry (reference: mx.nd.Custom)
 from ..operator import Custom
+
+# control-flow operators (reference: mx.nd.contrib.foreach/while_loop/cond)
+from . import contrib

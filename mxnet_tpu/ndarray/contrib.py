@@ -1,0 +1,273 @@
+"""mx.nd.contrib — control-flow operators (foreach / while_loop / cond).
+
+Reference parity: python/mxnet/ndarray/contrib.py (imperative semantics) and
+src/operator/control_flow.cc (the symbolic scan/while/cond operators).
+
+TPU-native design: the reference has TWO implementations — an imperative one
+(a plain Python loop over eager ops) and a symbolic one (nnvm subgraph ops
+executed by the GraphExecutor). Here the split is by *trace context*:
+
+- Called on concrete NDArrays (imperative), these run the reference's exact
+  Python-loop semantics: every op inside the body dispatches eagerly and is
+  recorded on the autograd tape per-op, so closures over parameters get
+  gradients exactly as in the reference.
+- Called on tracers — i.e. inside `jax.jit` via `HybridBlock.hybridize()`,
+  `Symbol.bind`, or an exported pure fn — they lower to `lax.scan` /
+  `lax.while_loop` / `lax.cond`: ONE compiled XLA While/Conditional op,
+  which is the form the TPU wants (no Python unrolling, static shapes,
+  fusion across the loop body).
+
+Semantics notes (matching the reference):
+- `foreach` iterates dim 0 of each data array; outputs are stacked on dim 0.
+- `while_loop` imperative returns outputs with first dim = actual steps run;
+  the traced/compiled path pads to `max_iterations` with zeros (the reference
+  documents the same imperative/symbolic shape asymmetry).
+- `cond` branch functions are thunks over closures, like the reference.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..base import MXNetError, _as_list
+from .ndarray import NDArray, _apply
+
+__all__ = ["foreach", "while_loop", "cond"]
+
+
+def _is_traced(nds):
+    return any(isinstance(x._data, jax.core.Tracer) for x in nds)
+
+
+def _as_nd_list(x, what):
+    xs = _as_list(x) if x is not None else []
+    for v in xs:
+        if not isinstance(v, NDArray):
+            raise MXNetError(f"{what} must be NDArray(s), got {type(v)}")
+    return list(xs)
+
+
+def _pack_like(template, values):
+    """Return values as a bare NDArray if the user passed one, else a list."""
+    values = list(values)
+    if not isinstance(template, (list, tuple)):
+        return values[0] if len(values) == 1 else values
+    return values
+
+
+class _TracedBody:
+    """Run a user body over raw jax values by round-tripping NDArray wrappers.
+
+    Recording is suspended inside: under a trace the whole control-flow op is
+    a single XLA op in an already-pure function, so the per-op tape must not
+    see the tracer intermediates.
+    """
+
+    def __init__(self, fn):
+        self.fn = fn
+
+    def __call__(self, *raw_groups):
+        from .. import autograd
+        prev = autograd.set_recording(False)
+        try:
+            nd_groups = [[NDArray(v) for v in grp] for grp in raw_groups]
+            return self.fn(*nd_groups)
+        finally:
+            autograd.set_recording(prev)
+
+
+def foreach(body, data, init_states):
+    """Iterate `body` over dim 0 of `data`, threading `states` through.
+
+    body(data_slice, states) -> (outputs, new_states). Outputs are stacked
+    along a new leading axis; final states are returned alongside.
+
+    Reference: python/mxnet/ndarray/contrib.py (foreach).
+    """
+    data_list = _as_nd_list(data, "foreach data")
+    state_list = _as_nd_list(init_states, "foreach init_states")
+    if not data_list:
+        raise MXNetError("foreach needs at least one data array")
+    length = data_list[0].shape[0]
+    for d in data_list[1:]:
+        if d.shape[0] != length:
+            raise MXNetError("foreach data arrays must share dim 0 "
+                             f"({d.shape[0]} != {length})")
+
+    def call_body(slices, states):
+        d_in = _pack_like(data, slices)
+        s_in = _pack_like(init_states, states)
+        outs, new_states = body(d_in, s_in)
+        return _as_list(outs) if outs is not None else [], _as_list(new_states)
+
+    if not _is_traced(data_list + state_list):
+        # reference-exact imperative path: eager per-step ops on the tape
+        states = state_list
+        per_step = []
+        for i in range(length):
+            outs, states = call_body([d[i] for d in data_list], states)
+            per_step.append(outs)
+        if per_step and per_step[0]:
+            from ..ops.tensor_ops import stack
+            stacked = [stack(*[step[k] for step in per_step], axis=0)
+                       for k in range(len(per_step[0]))]
+        else:
+            stacked = []
+        return _pack_like_or_empty(stacked), _pack_like(init_states, states)
+
+    # traced path: one lax.scan
+    traced = _TracedBody(lambda d, s: call_body(d, s))
+
+    def pure(*raw):
+        nd_data = raw[:len(data_list)]
+        nd_states = list(raw[len(data_list):])
+
+        def step(carry, xs):
+            outs, new_states = traced(list(xs), list(carry))
+            return tuple(v._data for v in new_states), \
+                tuple(v._data for v in outs)
+
+        carry, ys = lax.scan(step, tuple(nd_states), tuple(nd_data))
+        return tuple(ys) + tuple(carry)
+
+    n_states = len(state_list)
+    # probe output arity once (dead values; XLA removes them from the trace)
+    from .. import autograd
+    prev = autograd.set_recording(False)
+    try:
+        outs0, _ = call_body([d[0] for d in data_list], state_list)
+    finally:
+        autograd.set_recording(prev)
+    n_out = len(outs0)
+    res = _apply(pure, data_list + state_list, n_out=n_out + n_states)
+    res = list(res) if isinstance(res, tuple) else [res]
+    return (_pack_like_or_empty(res[:n_out]),
+            _pack_like(init_states, res[n_out:]))
+
+
+def _pack_like_or_empty(values):
+    if not values:
+        return []
+    return values[0] if len(values) == 1 else values
+
+
+def while_loop(cond, func, loop_vars, max_iterations=None):
+    """Run `func` while `cond` holds, up to `max_iterations`.
+
+    cond(*loop_vars) -> scalar NDArray (truth value);
+    func(*loop_vars) -> (step_output(s), new_loop_vars).
+    Returns (outputs stacked on dim 0, final loop_vars). Imperative calls
+    return the actual number of steps on dim 0; traced calls return
+    `max_iterations` rows, zero-padded past termination (XLA static shapes).
+
+    Reference: python/mxnet/ndarray/contrib.py (while_loop).
+    """
+    var_list = _as_nd_list(loop_vars, "while_loop loop_vars")
+    if not var_list:
+        raise MXNetError("while_loop needs at least one loop var")
+    if max_iterations is None:
+        raise MXNetError("while_loop requires max_iterations")
+    max_iterations = int(max_iterations)
+
+    def call_func(vs):
+        outs, new_vars = func(*vs)
+        return (_as_list(outs) if outs is not None else [],
+                _as_list(new_vars))
+
+    if not _is_traced(var_list):
+        steps, vs = [], var_list
+        for _ in range(max_iterations):
+            keep = cond(*vs)
+            if not bool(keep.asscalar() if isinstance(keep, NDArray) else keep):
+                break
+            outs, vs = call_func(vs)
+            steps.append(outs)
+        if steps and steps[0]:
+            from ..ops.tensor_ops import stack
+            stacked = [stack(*[s[k] for s in steps], axis=0)
+                       for k in range(len(steps[0]))]
+        else:
+            stacked = []
+        return _pack_like_or_empty(stacked), _pack_like(loop_vars, vs)
+
+    traced_cond = _TracedBody(lambda vs: cond(*vs))
+    traced_func = _TracedBody(lambda vs: call_func(vs))
+
+    from .. import autograd
+    prev = autograd.set_recording(False)
+    try:
+        outs0, _ = call_func(var_list)
+    finally:
+        autograd.set_recording(prev)
+    n_out, n_vars = len(outs0), len(var_list)
+
+    def pure(*raw):
+        init = tuple(raw)
+        out_bufs = tuple(
+            jnp.zeros((max_iterations,) + o.shape, o._data.dtype)
+            for o in outs0)
+
+        def step(carry, i):
+            vs, bufs, active = carry
+            keep = jnp.logical_and(
+                active, jnp.squeeze(traced_cond(list(vs))._data).astype(bool))
+
+            def take(args):
+                vs, bufs = args
+                outs, new_vars = traced_func(list(vs))
+                new_bufs = tuple(
+                    lax.dynamic_update_index_in_dim(b, o._data, i, 0)
+                    for b, o in zip(bufs, outs))
+                return tuple(v._data for v in new_vars), new_bufs
+
+            new_vs, new_bufs = lax.cond(keep, take, lambda a: a, (vs, bufs))
+            return (new_vs, new_bufs, keep), None
+
+        (vs, bufs, _), _ = lax.scan(
+            step, (init, out_bufs, jnp.bool_(True)),
+            jnp.arange(max_iterations))
+        return tuple(bufs) + tuple(vs)
+
+    res = _apply(pure, var_list, n_out=n_out + n_vars)
+    res = list(res) if isinstance(res, tuple) else [res]
+    return (_pack_like_or_empty(res[:n_out]),
+            _pack_like(loop_vars, res[n_out:]))
+
+
+def cond(pred, then_func, else_func, inputs=None):
+    """Select a branch on a scalar predicate.
+
+    pred: scalar NDArray (or a thunk returning one); then/else are thunks
+    over closures, like the reference's symbolic `cond`. Imperative calls
+    evaluate only the taken branch; traced calls lower to `lax.cond` (both
+    branches traced once, one selected at run time on device).
+
+    Reference: python/mxnet/ndarray/contrib.py (cond).
+    """
+    if callable(pred):
+        pred = pred()
+    if not isinstance(pred, NDArray):
+        raise MXNetError("cond pred must be a scalar NDArray")
+    if inputs is not None:
+        raise MXNetError("pass branch inputs via closures (reference API)")
+
+    if not _is_traced([pred]):
+        taken = then_func if bool(pred.asscalar()) else else_func
+        outs = _as_list(taken())
+        return outs[0] if len(outs) == 1 else outs
+
+    # traced: both branches must produce matching pytrees
+    def run_branch(fn):
+        from .. import autograd
+        prev = autograd.set_recording(False)
+        try:
+            return [o._data for o in _as_list(fn())]
+        finally:
+            autograd.set_recording(prev)
+
+    raw = lax.cond(jnp.squeeze(pred._data).astype(bool),
+                   lambda _: run_branch(then_func),
+                   lambda _: run_branch(else_func), None)
+    outs = [NDArray(r) for r in raw]
+    return outs[0] if len(outs) == 1 else outs

@@ -12,7 +12,8 @@ import jax.numpy as jnp
 import numpy as _np
 
 from ..ops import nn_ops as K
-from .symbol import Symbol, _make, register_op, register_shape_rule
+from .symbol import (Symbol, _make, register_aux_slots, register_op,
+                     register_shape_rule, register_train_op)
 
 __all__ = ["FullyConnected", "Convolution", "StemConvS2D", "Activation",
            "BatchNorm",
@@ -73,10 +74,32 @@ register_op("Convolution",
 register_op("StemConvS2D",
             lambda x, w, num_filter=None: K.stem_conv_s2d(x, w))
 register_op("Activation", lambda x, act_type="relu": K.activation(x, act_type))
-register_op("BatchNorm",
-            lambda x, g, b, mm, mv, eps=1e-5, momentum=0.9, axis=1,
-            fix_gamma=False, use_global_stats=False:
-            K.batch_norm(x, g, b, mm, mv, eps, momentum, False, axis)[0])
+def _bn_infer(x, g, b, mm, mv, eps=1e-5, momentum=0.9, axis=1,
+              fix_gamma=False, use_global_stats=False):
+    if fix_gamma:
+        g = jnp.ones_like(g)
+    return K.batch_norm(x, g, b, mm, mv, eps, momentum, False, axis)[0]
+
+
+register_op("BatchNorm", _bn_infer)
+
+
+def _bn_train_variant(x, g, b, mm, mv, eps=1e-5, momentum=0.9, axis=1,
+                      fix_gamma=False, use_global_stats=False):
+    """Training BatchNorm: batch stats normalise, moving stats update
+    (reference: BN's mutable aux inputs written during the forward).
+    use_global_stats freezes the moving stats (fine-tune mode)."""
+    if fix_gamma:
+        g = jnp.ones_like(g)
+    if use_global_stats:
+        return K.batch_norm(x, g, b, mm, mv, eps, momentum, False, axis)[0], {}
+    y, new_mm, new_mv = K.batch_norm(x, g, b, mm, mv, eps, momentum, True,
+                                     axis)
+    return y, {3: new_mm, 4: new_mv}
+
+
+register_train_op("BatchNorm", _bn_train_variant)
+register_aux_slots("BatchNorm", (3, 4))  # moving_mean, moving_var
 register_op("LayerNorm", lambda x, g, b, axis=-1, eps=1e-5:
             K.layer_norm(x, g, b, axis, eps))
 register_op("Pooling",
@@ -176,7 +199,6 @@ register_shape_rule("Embedding", _embed_shapes)
 # -- symbol-level API --------------------------------------------------------
 def FullyConnected(data, weight=None, bias=None, num_hidden=None,
                    no_bias=False, flatten=True, name=None, **kwargs):
-    auto_bias = not no_bias             # reference: bias auto-created too
     ins = [data, weight] + ([] if no_bias else [bias])
     return _make("FullyConnected", ins,
                  {"no_bias": no_bias, "num_hidden": num_hidden,
@@ -209,7 +231,9 @@ def BatchNorm(data, gamma=None, beta=None, moving_mean=None, moving_var=None,
               eps=1e-5, momentum=0.9, axis=1, fix_gamma=False,
               use_global_stats=False, name=None, **kwargs):
     return _make("BatchNorm", [data, gamma, beta, moving_mean, moving_var],
-                 {"eps": eps, "momentum": momentum, "axis": axis}, name=name,
+                 {"eps": eps, "momentum": momentum, "axis": axis,
+                  "fix_gamma": fix_gamma,
+                  "use_global_stats": use_global_stats}, name=name,
                  input_names=["data", "gamma", "beta", "moving_mean",
                               "moving_var"])
 

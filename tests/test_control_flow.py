@@ -1,0 +1,180 @@
+"""Control-flow operators: foreach / while_loop / cond.
+
+Mirrors the reference tests/python/unittest/test_contrib_control_flow.py:
+imperative semantics vs hand-rolled loops, gradient flow through the
+imperative path, and the traced (lax-lowered) path inside jax.jit matching
+the imperative result.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+
+
+def test_foreach_cumsum():
+    data = nd.array(np.arange(12, dtype=np.float32).reshape(4, 3))
+    init = nd.zeros((3,))
+
+    def body(x, s):
+        new_s = s + x
+        return new_s, new_s
+
+    outs, final = nd.contrib.foreach(body, data, init)
+    expect = np.cumsum(np.arange(12, dtype=np.float32).reshape(4, 3), axis=0)
+    np.testing.assert_allclose(outs.asnumpy(), expect, rtol=1e-6)
+    np.testing.assert_allclose(final.asnumpy(), expect[-1], rtol=1e-6)
+
+
+def test_foreach_multi_state_and_data():
+    d0 = nd.array(np.ones((5, 2), np.float32))
+    d1 = nd.array(np.full((5, 2), 2.0, np.float32))
+    s0 = nd.zeros((2,))
+    s1 = nd.ones((2,))
+
+    def body(data, states):
+        x, y = data
+        a, b = states
+        return [x + y], [a + x, b * 1.0]
+
+    outs, (fa, fb) = nd.contrib.foreach(body, [d0, d1], [s0, s1])
+    np.testing.assert_allclose(outs.asnumpy(), np.full((5, 2), 3.0))
+    np.testing.assert_allclose(fa.asnumpy(), np.full((2,), 5.0))
+    np.testing.assert_allclose(fb.asnumpy(), np.ones((2,)))
+
+
+def test_foreach_gradient_through_closure():
+    # closures over parameters get grads on the imperative path, like the
+    # reference's eager foreach (a plain Python loop over recorded ops)
+    w = nd.array(np.array([2.0], np.float32))
+    w.attach_grad()
+    data = nd.array(np.arange(3, dtype=np.float32).reshape(3, 1))
+
+    with autograd.record():
+        def body(x, s):
+            out = x * w + s
+            return out, out
+
+        outs, final = nd.contrib.foreach(body, data, nd.zeros((1,)))
+        loss = final.sum()
+    loss.backward()
+    # final = ((0*w)+1*w)+2*w = 3w -> dloss/dw = 3
+    np.testing.assert_allclose(w.grad.asnumpy(), [3.0], rtol=1e-6)
+
+
+def test_foreach_traced_matches_imperative():
+    data_np = np.random.RandomState(0).randn(6, 4).astype(np.float32)
+    init_np = np.zeros(4, np.float32)
+
+    def body(x, s):
+        return x * 2.0, s + x
+
+    outs_i, fin_i = nd.contrib.foreach(body, nd.array(data_np),
+                                       nd.array(init_np))
+
+    @jax.jit
+    def run(d, s):
+        o, f = nd.contrib.foreach(body, nd.NDArray(d), nd.NDArray(s))
+        return o._data, f._data
+
+    o_t, f_t = run(jnp.asarray(data_np), jnp.asarray(init_np))
+    np.testing.assert_allclose(np.asarray(o_t), outs_i.asnumpy(), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(f_t), fin_i.asnumpy(), rtol=1e-6)
+
+
+def test_while_loop_imperative():
+    # sum i while i < 5: outputs have actual-step count on dim 0 (reference
+    # imperative semantics)
+    i = nd.array(np.array([0.0], np.float32))
+    s = nd.array(np.array([0.0], np.float32))
+
+    outs, (fi, fs) = nd.contrib.while_loop(
+        cond=lambda i, s: i < 5,
+        func=lambda i, s: (i * 10.0, [i + 1, s + i]),
+        loop_vars=[i, s], max_iterations=20)
+    assert outs.shape == (5, 1)
+    np.testing.assert_allclose(outs.asnumpy()[:, 0], [0, 10, 20, 30, 40])
+    np.testing.assert_allclose(fi.asnumpy(), [5.0])
+    np.testing.assert_allclose(fs.asnumpy(), [10.0])
+
+
+def test_while_loop_traced_padded():
+    @jax.jit
+    def run(i0, s0):
+        outs, (fi, fs) = nd.contrib.while_loop(
+            cond=lambda i, s: i < 5,
+            func=lambda i, s: (i * 10.0, [i + 1, s + i]),
+            loop_vars=[nd.NDArray(i0), nd.NDArray(s0)], max_iterations=8)
+        return outs._data, fi._data, fs._data
+
+    o, fi, fs = run(jnp.zeros((1,)), jnp.zeros((1,)))
+    assert o.shape == (8, 1)  # padded to max_iterations
+    np.testing.assert_allclose(np.asarray(o)[:, 0],
+                               [0, 10, 20, 30, 40, 0, 0, 0])
+    np.testing.assert_allclose(np.asarray(fi), [5.0])
+    np.testing.assert_allclose(np.asarray(fs), [10.0])
+
+
+def test_while_loop_requires_max_iterations():
+    v = nd.zeros((1,))
+    with pytest.raises(mx.base.MXNetError):
+        nd.contrib.while_loop(lambda x: x < 1, lambda x: (x, [x]), [v])
+
+
+def test_cond_imperative_lazy_branches():
+    calls = []
+
+    def then_fn():
+        calls.append("then")
+        return nd.ones((2,))
+
+    def else_fn():
+        calls.append("else")
+        return nd.zeros((2,))
+
+    out = nd.contrib.cond(nd.array([1.0]), then_fn, else_fn)
+    np.testing.assert_allclose(out.asnumpy(), np.ones(2))
+    assert calls == ["then"]  # untaken branch never runs imperatively
+
+
+def test_cond_traced():
+    @jax.jit
+    def run(p, x):
+        xe = nd.NDArray(x)
+        return nd.contrib.cond(nd.NDArray(p),
+                               lambda: xe * 2.0, lambda: xe - 1.0)._data
+
+    np.testing.assert_allclose(
+        np.asarray(run(jnp.asarray([1.0]), jnp.asarray([3.0]))), [6.0])
+    np.testing.assert_allclose(
+        np.asarray(run(jnp.asarray([0.0]), jnp.asarray([3.0]))), [2.0])
+
+
+def test_foreach_rnn_like_scan_under_hybrid_trace():
+    # the traced path is ONE lax.scan: make sure a Dense layer used inside
+    # the body (parameters as closures inside an outer jit) compiles and
+    # matches the imperative result
+    from mxnet_tpu.gluon import nn
+    cell = nn.Dense(3)
+    cell.initialize()
+    x_np = np.random.RandomState(1).randn(4, 2, 3).astype(np.float32)
+
+    def body(x, s):
+        h = cell(x + s)
+        return h, h
+
+    outs_i, fin_i = nd.contrib.foreach(body, nd.array(x_np),
+                                       nd.zeros((2, 3)))
+
+    @jax.jit
+    def run(d):
+        o, f = nd.contrib.foreach(body, nd.NDArray(d), nd.zeros((2, 3)))
+        return o._data, f._data
+
+    o_t, f_t = run(jnp.asarray(x_np))
+    np.testing.assert_allclose(np.asarray(o_t), outs_i.asnumpy(),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(f_t), fin_i.asnumpy(),
+                               rtol=1e-5, atol=1e-5)

@@ -156,3 +156,81 @@ def test_s2d_stem_symbolic_trace():
     assert "data" in out.list_arguments()
     _, out_shapes, _ = out.infer_shape(data=(2, 8, 8, 3))
     assert out_shapes == [(2, 4, 4, 16)]
+
+
+def test_batchnorm_aux_states():
+    """BN moving stats are auxiliary states, not trainable arguments
+    (reference: nnvm mutable inputs excluded from gradients)."""
+    data = sym.Variable("data")
+    net = sym.BatchNorm(sym.FullyConnected(data, num_hidden=4,
+                                           name="fc"), name="bn")
+    args = net.list_arguments()
+    aux = net.list_auxiliary_states()
+    assert "bn_moving_mean" in aux and "bn_moving_var" in aux
+    assert not any("moving" in a for a in args)
+    arg_shapes, _, aux_shapes = net.infer_shape(data=(2, 3))
+    assert len(arg_shapes) == len(args)
+    assert aux_shapes == [(4,), (4,)]
+
+
+def test_batchnorm_train_updates_moving_stats():
+    """Executor.forward(is_train=True) uses batch stats and writes the
+    moving-average update back to aux_dict; inference uses moving stats."""
+    rs = np.random.RandomState(0)
+    x_np = (rs.randn(64, 4).astype(np.float32) * 3.0 + 7.0)
+    data = sym.Variable("data")
+    net = sym.BatchNorm(data, name="bn", momentum=0.5)
+    ex = net.simple_bind(grad_req="null", data=(64, 4),
+                         bn_gamma=(4,), bn_beta=(4,))
+    ex.arg_dict["bn_gamma"]._assign_value(mx.nd.ones((4,))._data)
+    mm0 = ex.aux_dict["bn_moving_mean"].asnumpy().copy()
+    out_t = ex.forward(is_train=True, data=mx.nd.array(x_np))[0]
+    # training output is batch-normalised: ~zero mean, unit var
+    o = out_t.asnumpy()
+    assert abs(o.mean()) < 1e-2 and abs(o.var() - 1.0) < 0.1
+    mm1 = ex.aux_dict["bn_moving_mean"].asnumpy()
+    assert not np.allclose(mm0, mm1)  # moving stats moved
+    expect = 0.5 * mm0 + 0.5 * x_np.mean(axis=0)
+    np.testing.assert_allclose(mm1, expect, rtol=1e-4, atol=1e-4)
+    # inference normalises with the (updated) moving stats
+    out_i = ex.forward(is_train=False, data=mx.nd.array(x_np))[0].asnumpy()
+    assert abs(out_i.mean()) > 0.1  # not batch-normalised to zero
+
+
+def test_module_excludes_aux_from_optimizer():
+    """Module training must not apply optimizer updates to BN moving stats
+    (round-2 review finding)."""
+    from mxnet_tpu.module import Module
+    from mxnet_tpu.io import NDArrayIter
+    rs = np.random.RandomState(1)
+    x = rs.randn(32, 6).astype(np.float32)
+    y = rs.randint(0, 2, (32,)).astype(np.float32)
+    data = sym.Variable("data")
+    label = sym.Variable("softmax_label")
+    h = sym.BatchNorm(sym.FullyConnected(data, num_hidden=8, name="fc"),
+                      name="bn")
+    out = sym.SoftmaxOutput(sym.FullyConnected(h, num_hidden=2, name="out"),
+                            label, name="softmax")
+    mod = Module(out, data_names=["data"], label_names=["softmax_label"])
+    it = NDArrayIter(x, y, batch_size=16)
+    mod.fit(it, num_epoch=2, optimizer_params={"learning_rate": 0.1})
+    arg_params, aux_params = mod.get_params()
+    assert "bn_moving_mean" in aux_params
+    assert "bn_moving_mean" not in arg_params
+    assert not any(n.endswith("moving_mean") or n.endswith("moving_var")
+                   for n in mod._param_names)
+    # moving stats were updated by forward passes (train mode), not frozen
+    assert not np.allclose(aux_params["bn_moving_mean"].asnumpy(), 0.0)
+
+
+def test_name_manager_scoped_counters():
+    """mx.name.NameManager gives deterministic auto-names regardless of
+    prior construction; Prefix prepends (reference: python/mxnet/name.py)."""
+    d = sym.Variable("d")
+    _ = sym.FullyConnected(d, num_hidden=2)   # bump the global counter
+    with mx.name.NameManager():
+        s = sym.FullyConnected(d, num_hidden=2)
+        assert "fullyconnected0_weight" in s.list_arguments()
+    with mx.name.Prefix("enc_"):
+        s = sym.FullyConnected(d, num_hidden=2)
+        assert "enc_fullyconnected0_weight" in s.list_arguments()
