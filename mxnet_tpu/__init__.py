@@ -46,6 +46,9 @@ from . import module
 from . import module as mod
 from . import operator
 from . import name
+from . import test_utils
+from . import attribute
+from .attribute import AttrScope
 from . import callback
 from . import monitor
 from . import profiler

@@ -1,0 +1,57 @@
+"""Symbol attribute scoping (reference: python/mxnet/attribute.py).
+
+`with mx.AttrScope(ctx_group="dev1"):` attaches the given attributes to
+every Symbol created inside the scope (the reference uses this for context
+groups and custom graph annotations; here attrs also ride `tojson`, so
+sharding hints can be round-tripped with the graph).
+"""
+from __future__ import annotations
+
+import threading
+
+from .base import MXNetError
+
+__all__ = ["AttrScope"]
+
+_tls = threading.local()
+
+
+def _stack():
+    if not hasattr(_tls, "stack"):
+        _tls.stack = [AttrScope()]
+    return _tls.stack
+
+
+class AttrScope:
+    """Scoped user attributes applied to symbols created within."""
+
+    def __init__(self, **kwargs):
+        for v in kwargs.values():
+            if not isinstance(v, str):
+                raise MXNetError("AttrScope values must be strings "
+                                 "(reference contract)")
+        self._attr = kwargs
+
+    @classmethod
+    def current(cls):
+        return _stack()[-1]
+
+    def get(self, attr=None):
+        """Merge scope attrs with (and prefer) the explicitly-given ones."""
+        if not self._attr:
+            return attr or {}
+        merged = dict(self._attr)
+        merged.update(attr or {})
+        return merged
+
+    def __enter__(self):
+        parent = _stack()[-1]
+        merged = dict(parent._attr)
+        merged.update(self._attr)
+        pushed = AttrScope()
+        pushed._attr = merged
+        _stack().append(pushed)
+        return self
+
+    def __exit__(self, *exc):
+        _stack().pop()

@@ -90,6 +90,9 @@ class Context:
 
     @classmethod
     def default_ctx(cls):
+        override = getattr(cls, "_default_override", None)
+        if override is not None:
+            return override
         accels = _accelerator_devices()
         return cls("tpu", 0) if accels else cls("cpu", 0)
 

@@ -1,0 +1,8 @@
+"""gluon.contrib (reference: python/mxnet/gluon/contrib).
+
+Experimental-tier Gluon layers: cross-replica SyncBatchNorm, pixel shuffle,
+convolutional and variational-dropout RNN cells.
+"""
+from . import nn
+from . import rnn
+from . import estimator

@@ -1,0 +1,372 @@
+"""Gluon Estimator: the high-level fit loop with event handlers
+(reference: python/mxnet/gluon/contrib/estimator/estimator.py +
+event_handler.py).
+
+Estimator.fit drives: for each epoch, for each batch — forward under
+autograd.record, backward, trainer.step — firing handler events
+(train_begin/epoch_begin/batch_begin/batch_end/epoch_end/train_end).
+Handlers cover the reference set: metric logging, validation, checkpointing
+(best-model tracking), and early stopping.
+
+TPU notes: the loop keeps device math asynchronous — metrics pull values
+host-side only at batch_end (one sync point per batch, same cadence as the
+reference), and the forward/backward dispatch through the recorded tape so
+hybridized nets run as single XLA executables.
+"""
+from __future__ import annotations
+
+import logging
+import time
+
+from ... import autograd
+from ... import metric as metric_mod
+from ...base import MXNetError, _as_list
+from ..trainer import Trainer
+
+__all__ = ["Estimator", "TrainBegin", "TrainEnd", "EpochBegin", "EpochEnd",
+           "BatchBegin", "BatchEnd", "StoppingHandler", "MetricHandler",
+           "ValidationHandler", "LoggingHandler", "CheckpointHandler",
+           "EarlyStoppingHandler"]
+
+
+# --------------------------------------------------------------------------
+# event mixins (reference: event_handler.py defines these exact hooks)
+# --------------------------------------------------------------------------
+class TrainBegin:
+    def train_begin(self, estimator, *args, **kwargs):
+        pass
+
+
+class TrainEnd:
+    def train_end(self, estimator, *args, **kwargs):
+        pass
+
+
+class EpochBegin:
+    def epoch_begin(self, estimator, *args, **kwargs):
+        pass
+
+
+class EpochEnd:
+    def epoch_end(self, estimator, *args, **kwargs):
+        pass
+
+
+class BatchBegin:
+    def batch_begin(self, estimator, *args, **kwargs):
+        pass
+
+
+class BatchEnd:
+    def batch_end(self, estimator, *args, **kwargs):
+        pass
+
+
+class StoppingHandler(TrainBegin, BatchEnd, EpochEnd):
+    """Stop on max_epoch/max_batch (reference: StoppingHandler)."""
+
+    def __init__(self, max_epoch=None, max_batch=None):
+        self.max_epoch = max_epoch
+        self.max_batch = max_batch
+        self.current_batch = 0
+        self.current_epoch = 0
+        self.stop_training = False
+
+    def train_begin(self, estimator, *args, **kwargs):
+        self.current_batch = 0
+        self.current_epoch = 0
+
+    def batch_end(self, estimator, *args, **kwargs):
+        self.current_batch += 1
+        if self.max_batch and self.current_batch >= self.max_batch:
+            self.stop_training = True
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        self.current_epoch += 1
+        if self.max_epoch and self.current_epoch >= self.max_epoch:
+            self.stop_training = True
+
+
+class MetricHandler(EpochBegin, BatchEnd):
+    """Reset train metrics each epoch; update them each batch."""
+
+    def __init__(self, metrics):
+        self.metrics = _as_list(metrics)
+
+    def epoch_begin(self, estimator, *args, **kwargs):
+        for m in self.metrics:
+            m.reset()
+
+    def batch_end(self, estimator, pred=None, label=None, loss=None,
+                  **kwargs):
+        for m in self.metrics:
+            if "loss" in m.name:
+                m.update(0, loss)
+            else:
+                m.update(label, pred)
+
+
+class ValidationHandler(TrainBegin, BatchEnd, EpochEnd):
+    """Run evaluation every `epoch_period` epochs (or `batch_period`
+    batches). Results update the estimator's `val_metrics` objects (so
+    CheckpointHandler/EarlyStoppingHandler can monitor them) and append to
+    `estimator.val_results`."""
+
+    def __init__(self, val_data, eval_fn, epoch_period=1, batch_period=None):
+        self.val_data = val_data
+        self.eval_fn = eval_fn
+        self.epoch_period = epoch_period
+        self.batch_period = batch_period
+        self.current_batch = 0
+        self.current_epoch = 0
+
+    def train_begin(self, estimator, *args, **kwargs):
+        self.current_batch = 0
+        self.current_epoch = 0
+
+    def _run(self, estimator):
+        res = self.eval_fn(self.val_data)
+        if res is not None:
+            estimator.val_results.append(res)
+
+    def batch_end(self, estimator, *args, **kwargs):
+        self.current_batch += 1
+        if self.batch_period and \
+                self.current_batch % self.batch_period == 0:
+            self._run(estimator)
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        self.current_epoch += 1
+        if self.epoch_period and \
+                self.current_epoch % self.epoch_period == 0:
+            self._run(estimator)
+
+
+class LoggingHandler(TrainBegin, TrainEnd, EpochBegin, EpochEnd, BatchEnd):
+    """Log metric values per epoch (and optionally every N batches)."""
+
+    def __init__(self, log_interval="epoch", metrics=None, logger=None):
+        self.log_interval = log_interval
+        self.metrics = _as_list(metrics) if metrics else []
+        self.logger = logger or logging.getLogger("mxnet_tpu.estimator")
+        self.batch_index = 0
+
+    def train_begin(self, estimator, *args, **kwargs):
+        self._t0 = time.time()
+        self.logger.info("training begin")
+
+    def train_end(self, estimator, *args, **kwargs):
+        self.logger.info("training done in %.1fs", time.time() - self._t0)
+
+    def epoch_begin(self, estimator, *args, **kwargs):
+        self.batch_index = 0
+
+    def batch_end(self, estimator, *args, **kwargs):
+        self.batch_index += 1
+        if isinstance(self.log_interval, int) and \
+                self.batch_index % self.log_interval == 0:
+            self._log(f"batch {self.batch_index}")
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        self._log("epoch end")
+
+    def _log(self, where):
+        vals = ", ".join(f"{m.name}={m.get()[1]:.4f}" for m in self.metrics)
+        self.logger.info("[%s] %s", where, vals)
+
+
+class CheckpointHandler(TrainBegin, BatchEnd, EpochEnd):
+    """Save net parameters each epoch; track the best run by a monitored
+    metric (reference: CheckpointHandler save_best/mode)."""
+
+    def __init__(self, model_dir, model_prefix="model", monitor=None,
+                 mode="min", save_best=False, epoch_period=1):
+        import os
+        self.model_dir = model_dir
+        self.model_prefix = model_prefix
+        self.monitor = monitor
+        self.save_best = save_best
+        self.epoch_period = epoch_period
+        if mode not in ("min", "max"):
+            raise MXNetError(f"mode must be min or max, got {mode}")
+        self.mode = mode
+        self.train_begin(None)
+        os.makedirs(model_dir, exist_ok=True)
+
+    def train_begin(self, estimator, *args, **kwargs):
+        self.current_epoch = 0
+        self.best = float("inf") if self.mode == "min" else -float("inf")
+
+    def _path(self, tag):
+        import os
+        return os.path.join(self.model_dir, f"{self.model_prefix}-{tag}.params")
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        self.current_epoch += 1
+        if self.current_epoch % self.epoch_period == 0:
+            estimator.net.save_parameters(self._path(f"epoch{self.current_epoch}"))
+        if self.save_best and self.monitor is not None:
+            _, value = self.monitor.get()
+            better = value < self.best if self.mode == "min" \
+                else value > self.best
+            if better:
+                self.best = value
+                estimator.net.save_parameters(self._path("best"))
+
+
+class EarlyStoppingHandler(TrainBegin, EpochEnd):
+    """Stop when the monitored metric stops improving for `patience`
+    epochs (reference: EarlyStoppingHandler)."""
+
+    def __init__(self, monitor, mode="min", patience=0, min_delta=0.0):
+        self.monitor = monitor
+        if mode not in ("min", "max"):
+            raise MXNetError(f"mode must be min or max, got {mode}")
+        self.mode = mode
+        self.patience = patience
+        self.min_delta = min_delta
+        self.train_begin(None)
+
+    def train_begin(self, estimator, *args, **kwargs):
+        # reset so a handler instance can be reused across fit() calls
+        # (reference behaviour)
+        self.best = None
+        self.wait = 0
+        self.stop_training = False
+        self.stopped_epoch = None
+        self.current_epoch = 0
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        self.current_epoch += 1
+        _, value = self.monitor.get()
+        improved = self.best is None or (
+            value < self.best - self.min_delta if self.mode == "min"
+            else value > self.best + self.min_delta)
+        if improved:
+            self.best = value
+            self.wait = 0
+        else:
+            self.wait += 1
+            if self.wait > self.patience:
+                self.stop_training = True
+                self.stopped_epoch = self.current_epoch
+
+
+# --------------------------------------------------------------------------
+# the estimator
+# --------------------------------------------------------------------------
+class Estimator:
+    """High-level train/evaluate driver (reference: estimator.Estimator).
+
+    Estimator(net, loss, train_metrics, trainer).fit(train_data, epochs=N)
+    """
+
+    def __init__(self, net, loss, train_metrics=None, trainer=None,
+                 context=None, val_metrics=None):
+        self.net = net
+        self.loss = loss
+        self.train_metrics = [metric_mod.create(m) if isinstance(m, str)
+                              else m for m in _as_list(train_metrics or [])]
+        if not any("loss" in m.name for m in self.train_metrics):
+            self.train_metrics.append(_LossMetric("train_loss"))
+        # persistent val metric OBJECTS: Checkpoint/EarlyStopping handlers
+        # monitor these across epochs; evaluate() updates them in place
+        self.val_metrics = [metric_mod.create(m) if isinstance(m, str)
+                            else m
+                            for m in _as_list(val_metrics or ["accuracy"])]
+        self.val_results = []   # dicts appended by ValidationHandler
+        self.trainer = trainer or Trainer(
+            net.collect_params(), "adam", {"learning_rate": 1e-3})
+        self.context = context
+
+    # -- evaluation --------------------------------------------------------
+    def evaluate(self, val_data, val_metrics=None):
+        metrics = self.val_metrics if val_metrics is None else [
+            metric_mod.create(m) if isinstance(m, str) else m
+            for m in _as_list(val_metrics)]
+        for m in metrics:
+            m.reset()
+        for batch in val_data:
+            data, label = self._split_batch(batch)
+            pred = self.net(data)
+            for m in metrics:
+                if "loss" in m.name:
+                    m.update(0, self.loss(pred, label))
+                else:
+                    m.update(label, pred)
+        if hasattr(val_data, "reset"):
+            val_data.reset()
+        return {m.name: m.get()[1] for m in metrics}
+
+    @staticmethod
+    def _split_batch(batch):
+        if isinstance(batch, (list, tuple)):
+            return batch[0], batch[1]
+        return batch.data[0], batch.label[0]
+
+    # -- training ----------------------------------------------------------
+    def fit(self, train_data, val_data=None, epochs=None, event_handlers=None,
+            batches=None):
+        if epochs is None and batches is None:
+            raise MXNetError("fit needs epochs or batches")
+        handlers = list(_as_list(event_handlers or []))
+        if not any(isinstance(h, MetricHandler) for h in handlers):
+            handlers.append(MetricHandler(self.train_metrics))
+        if val_data is not None and \
+                not any(isinstance(h, ValidationHandler) for h in handlers):
+            handlers.append(ValidationHandler(
+                val_data, lambda d: self.evaluate(d)))
+        handlers.append(StoppingHandler(epochs, batches))
+
+        # event order matters (reference sorts the same way): metrics
+        # update first so validation/logging/checkpoint/early-stop observe
+        # CURRENT-batch values; the stop counter runs last
+        def rank(h):
+            if isinstance(h, MetricHandler):
+                return 0
+            if isinstance(h, ValidationHandler):
+                return 1
+            if isinstance(h, StoppingHandler):
+                return 3
+            return 2
+        handlers.sort(key=rank)
+
+        def fire(event, **kwargs):
+            stop = False
+            for h in handlers:
+                fn = getattr(h, event, None)
+                if fn is not None:
+                    fn(self, **kwargs)
+                stop = stop or getattr(h, "stop_training", False)
+            return stop
+
+        fire("train_begin")
+        stop = False
+        while not stop:
+            fire("epoch_begin")
+            for batch in train_data:
+                data, label = self._split_batch(batch)
+                fire("batch_begin")
+                with autograd.record():
+                    pred = self.net(data)
+                    loss = self.loss(pred, label)
+                loss.backward()
+                self.trainer.step(data.shape[0])
+                stop = fire("batch_end", pred=pred, label=label, loss=loss)
+                if stop:
+                    break
+            if hasattr(train_data, "reset"):
+                train_data.reset()
+            stop = fire("epoch_end") or stop
+        fire("train_end")
+        return self
+
+
+class _LossMetric(metric_mod.EvalMetric):
+    """Mean of per-batch loss values (reference: estimator's Loss metric)."""
+
+    def update(self, _, loss):
+        import numpy as np
+        v = loss.asnumpy() if hasattr(loss, "asnumpy") else np.asarray(loss)
+        self.sum_metric += float(v.mean())
+        self.num_inst += 1

@@ -1,0 +1,154 @@
+"""gluon.contrib.nn (reference: python/mxnet/gluon/contrib/nn/basic_layers.py).
+
+TPU-native SyncBatchNorm: the reference synchronises BN statistics across
+GPUs with an NCCL allreduce inside a CUDA kernel (num_devices, key-based
+comm). Here cross-replica reduction is `lax.pmean` over a *mesh axis name* —
+inside a `shard_map`/`pjit` data-parallel step the statistics ride the ICI
+allreduce XLA inserts; outside any mesh context the layer degrades to plain
+BatchNorm (single-replica semantics, exactly what the reference does with
+num_devices=1).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ... import autograd
+from ...base import MXNetError
+from ...ndarray.ndarray import _apply
+from ..block import HybridBlock
+from ..nn.basic_layers import BatchNorm, Concurrent, Identity
+
+__all__ = ["SyncBatchNorm", "HybridConcurrent", "Concurrent", "Identity",
+           "PixelShuffle1D", "PixelShuffle2D", "PixelShuffle3D",
+           "SparseEmbedding"]
+
+# reference exposes HybridConcurrent as the hybridizable variant; the
+# TPU-native Concurrent is already hybrid-safe (pure fan-out + concat)
+HybridConcurrent = Concurrent
+
+
+def _maybe_pmean(v, axis_name):
+    """pmean over `axis_name` when bound in the current trace (i.e. inside
+    shard_map over a mesh with that axis); identity otherwise."""
+    if axis_name is None:
+        return v
+    try:
+        return lax.pmean(v, axis_name)
+    except NameError:
+        return v
+
+
+def sync_batch_norm(x, gamma, beta, moving_mean, moving_var, eps=1e-5,
+                    momentum=0.9, training=True, axis=1, axis_name="dp"):
+    """BatchNorm with cross-replica statistics (one fused fp32 moment pass
+    + pmean over the mesh axis). Returns (y, new_mean, new_var)."""
+    from ...ops.nn_ops import batch_norm
+    if not training:
+        return batch_norm(x, gamma, beta, moving_mean, moving_var, eps,
+                          momentum, False, axis)
+    red = tuple(i for i in range(x.ndim) if i != axis)
+    xf = x.astype(jnp.float32)
+    m = _maybe_pmean(jnp.mean(xf, red), axis_name)
+    m2 = _maybe_pmean(jnp.mean(xf * xf, red), axis_name)
+    var = jnp.maximum(m2 - m * m, 0.0)
+    shape = [1] * x.ndim
+    shape[axis] = -1
+    inv = lax.rsqrt(var + eps)
+    scale = (gamma.astype(jnp.float32) * inv).reshape(shape)
+    shift = (beta.astype(jnp.float32)
+             - gamma.astype(jnp.float32) * m * inv).reshape(shape)
+    y = (xf * scale + shift).astype(x.dtype)
+    new_mean = (momentum * moving_mean.astype(jnp.float32)
+                + (1 - momentum) * m).astype(moving_mean.dtype)
+    new_var = (momentum * moving_var.astype(jnp.float32)
+               + (1 - momentum) * var).astype(moving_var.dtype)
+    return y, new_mean, new_var
+
+
+class SyncBatchNorm(BatchNorm):
+    """Cross-replica BatchNorm (reference: gluon.contrib.nn.SyncBatchNorm).
+
+    `axis_name` names the mesh axis to reduce statistics over (the
+    reference's num_devices/comm-key pair maps to a jax mesh axis). Used
+    inside a data-parallel shard_map step the stats are global-batch; used
+    eagerly it is a plain BatchNorm.
+    """
+
+    def __init__(self, in_channels=0, num_devices=None, momentum=0.9,
+                 epsilon=1e-5, axis=1, axis_name="dp", **kwargs):
+        super().__init__(axis=axis, momentum=momentum, epsilon=epsilon,
+                         in_channels=in_channels, **kwargs)
+        self._axis_name = axis_name  # num_devices accepted for API parity
+
+    def hybrid_forward(self, F, x, gamma, beta, running_mean, running_var):
+        from ..block import _report_aux_update
+        training = autograd.is_training() and not self._use_global_stats
+        outs = _apply(
+            lambda a, g, b, mm, mv, _e=self._epsilon, _m=self._momentum,
+            _t=training, _ax=self._axis, _an=self._axis_name:
+            sync_batch_norm(a, g, b, mm, mv, _e, _m, _t, _ax, _an),
+            [x, gamma, beta, running_mean, running_var], n_out=3)
+        out, new_mean, new_var = outs
+        if training:
+            _report_aux_update(self.running_mean, new_mean)
+            _report_aux_update(self.running_var, new_var)
+        return out
+
+
+def _pixel_shuffle(x, factors, ndim):
+    """Rearrange (N, C*prod(f), *S) -> (N, C, *S*f) (reference:
+    contrib.nn.PixelShuffle*D, NC* layouts)."""
+    n, c = x.shape[0], x.shape[1]
+    spatial = x.shape[2:]
+    cf = 1
+    for f in factors:
+        cf *= f
+    if c % cf:
+        raise MXNetError(f"channels {c} not divisible by {factors}")
+    c_out = c // cf
+    # (N, C_out, *factors, *S) -> interleave factor axes after each spatial
+    x = x.reshape((n, c_out) + tuple(factors) + spatial)
+    perm = [0, 1]
+    for i in range(ndim):
+        perm.extend([2 + ndim + i, 2 + i])
+    x = x.transpose(perm)
+    out_spatial = tuple(s * f for s, f in zip(spatial, factors))
+    return x.reshape((n, c_out) + out_spatial)
+
+
+class _PixelShuffle(HybridBlock):
+    _ndim = None
+
+    def __init__(self, factor, **kwargs):
+        super().__init__(**kwargs)
+        if isinstance(factor, int):
+            factor = (factor,) * self._ndim
+        self._factors = tuple(int(f) for f in factor)
+
+    def hybrid_forward(self, F, x):
+        return _apply(lambda a, _f=self._factors, _n=self._ndim:
+                      _pixel_shuffle(a, _f, _n), [x])
+
+    def __repr__(self):
+        return f"{type(self).__name__}(factor={self._factors})"
+
+
+class PixelShuffle1D(_PixelShuffle):
+    _ndim = 1
+
+
+class PixelShuffle2D(_PixelShuffle):
+    _ndim = 2
+
+
+class PixelShuffle3D(_PixelShuffle):
+    _ndim = 3
+
+
+def SparseEmbedding(*args, **kwargs):
+    raise MXNetError(
+        "SparseEmbedding is a documented divergence (SURVEY.md §8): TPU/XLA "
+        "has no sparse storage; dense gluon.nn.Embedding lowers to a "
+        "take/one-hot matmul that the MXU executes efficiently")

@@ -1,0 +1,224 @@
+"""gluon.contrib.rnn (reference: python/mxnet/gluon/contrib/rnn/
+conv_rnn_cell.py + rnn_cell.py).
+
+Convolutional LSTM cells (gates are convolutions over spatial feature maps),
+VariationalDropoutCell (one dropout mask reused across all time steps), and
+LSTMPCell (projected LSTM). All cells are step functions compatible with
+`RecurrentCell.unroll`; under `hybridize`/`foreach` the whole unroll compiles
+to one XLA program (`lax.scan` on the traced path).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ... import autograd
+from ...base import MXNetError
+from ...ndarray.ndarray import _apply
+from ...ops import nn_ops as K
+from ..block import _layer_rng
+from ..rnn.rnn_cell import RecurrentCell
+
+__all__ = ["Conv1DLSTMCell", "Conv2DLSTMCell", "Conv3DLSTMCell",
+           "VariationalDropoutCell", "LSTMPCell"]
+
+
+def _sigmoid(v):
+    return jax.nn.sigmoid(v)
+
+
+class _ConvLSTMCell(RecurrentCell):
+    """ConvLSTM: x/h-to-gates are convolutions; state is a feature map
+    (Shi et al. 2015; reference: gluon.contrib.rnn.Conv*DLSTMCell).
+
+    input_shape is (C, *spatial) in the NC* layout, required up front like
+    the reference (state shape must be known before the first step)."""
+    _ndim = None
+
+    def __init__(self, input_shape, hidden_channels, i2h_kernel=3,
+                 h2h_kernel=3, i2h_pad=None,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 conv_layout=None, **kwargs):
+        super().__init__(**kwargs)
+        nd = self._ndim
+        self._input_shape = tuple(input_shape)
+        self._hc = hidden_channels
+        self._ik = (i2h_kernel,) * nd if isinstance(i2h_kernel, int) \
+            else tuple(i2h_kernel)
+        self._hk = (h2h_kernel,) * nd if isinstance(h2h_kernel, int) \
+            else tuple(h2h_kernel)
+        if any(k % 2 == 0 for k in self._hk):
+            raise MXNetError("h2h_kernel must be odd ('same' padding "
+                             "preserves the state's spatial shape)")
+        self._ip = tuple(k // 2 for k in self._ik) if i2h_pad is None \
+            else ((i2h_pad,) * nd if isinstance(i2h_pad, int)
+                  else tuple(i2h_pad))
+        self._hp = tuple(k // 2 for k in self._hk)
+        in_c = self._input_shape[0]
+        with self.name_scope():
+            self.i2h_weight = self.params.get(
+                "i2h_weight", shape=(4 * hidden_channels, in_c) + self._ik,
+                init=i2h_weight_initializer)
+            self.h2h_weight = self.params.get(
+                "h2h_weight",
+                shape=(4 * hidden_channels, hidden_channels) + self._hk,
+                init=h2h_weight_initializer)
+            self.i2h_bias = self.params.get(
+                "i2h_bias", shape=(4 * hidden_channels,),
+                init=i2h_bias_initializer)
+            self.h2h_bias = self.params.get(
+                "h2h_bias", shape=(4 * hidden_channels,),
+                init=h2h_bias_initializer)
+
+    def state_info(self, batch_size=0):
+        spatial = tuple(
+            (s + 2 * p - k) + 1
+            for s, p, k in zip(self._input_shape[1:], self._ip, self._ik))
+        shape = (batch_size, self._hc) + spatial
+        return [{"shape": shape, "__layout__": "NC" + "DHW"[-self._ndim:]},
+                {"shape": shape, "__layout__": "NC" + "DHW"[-self._ndim:]}]
+
+    def hybrid_forward(self, F, x, states, i2h_weight, h2h_weight,
+                       i2h_bias, h2h_bias):
+        h, c = states
+
+        def fn(xv, hv, cv, wi, wh, bi, bh, _ip=self._ip, _hp=self._hp,
+               _hc=self._hc):
+            gates = (K.convolution(xv, wi, bi, stride=1, pad=_ip)
+                     + K.convolution(hv, wh, bh, stride=1, pad=_hp))
+            i, f, g, o = jnp.split(gates, 4, axis=1)
+            new_c = _sigmoid(f) * cv + _sigmoid(i) * jnp.tanh(g)
+            new_h = _sigmoid(o) * jnp.tanh(new_c)
+            return new_h, new_c
+
+        new_h, new_c = _apply(fn, [x, h, c, i2h_weight, h2h_weight,
+                                   i2h_bias, h2h_bias], n_out=2)
+        return new_h, [new_h, new_c]
+
+
+class Conv1DLSTMCell(_ConvLSTMCell):
+    _ndim = 1
+
+
+class Conv2DLSTMCell(_ConvLSTMCell):
+    _ndim = 2
+
+
+class Conv3DLSTMCell(_ConvLSTMCell):
+    _ndim = 3
+
+
+class VariationalDropoutCell(RecurrentCell):
+    """Wrap a cell so input/state/output dropout masks are sampled ONCE per
+    sequence and reused at every step (Gal & Ghahramani 2016; reference:
+    gluon.contrib.rnn.VariationalDropoutCell). Call reset() between
+    sequences to resample."""
+
+    def __init__(self, base_cell, drop_inputs=0.0, drop_states=0.0,
+                 drop_outputs=0.0, **kwargs):
+        super().__init__(**kwargs)
+        self.base_cell = base_cell
+        self._di, self._ds, self._do = drop_inputs, drop_states, drop_outputs
+        self.reset()
+
+    def state_info(self, batch_size=0):
+        return self.base_cell.state_info(batch_size)
+
+    def reset(self):
+        # draw ONE concrete base key now (reset runs eagerly); per-kind
+        # keys are fold_in-derived at use, never cached — caching anything
+        # produced under a jax trace would leak tracers into later calls.
+        # The same key regenerates the identical mask at every step (XLA
+        # dedups the bernoulli inside one compiled unroll).
+        self._base_key = _layer_rng()
+        self.base_cell.reset()
+
+    @staticmethod
+    def _kind_id(kind):
+        if kind == "i":
+            return 0
+        if kind == "o":
+            return 1
+        return 2 + int(kind[1:])  # "s{k}" state masks
+
+    def _mask(self, kind, rate, x):
+        if not rate or not autograd.is_training():
+            return x
+        m = _apply(lambda a, _k=self._base_key, _id=self._kind_id(kind),
+                   _p=rate: (
+            jax.random.bernoulli(jax.random.fold_in(_k, _id), 1 - _p,
+                                 a.shape) / (1 - _p)
+        ).astype(a.dtype), [x])
+        return x * m
+
+    def __call__(self, x, states):
+        x = self._mask("i", self._di, x)
+        states = [self._mask(f"s{k}", self._ds, s)
+                  for k, s in enumerate(states)]
+        out, next_states = self.base_cell(x, states)
+        out = self._mask("o", self._do, out)
+        return out, next_states
+
+    def hybrid_forward(self, F, *args, **kwargs):
+        raise NotImplementedError("VariationalDropoutCell dispatches to "
+                                  "its base cell")
+
+
+class LSTMPCell(RecurrentCell):
+    """LSTM with a projected recurrent state (Sak et al. 2014; reference:
+    gluon.contrib.rnn.LSTMPCell). States: [r (B, projection), c (B, hidden)];
+    the h2h matmul runs on the smaller projected state — the same
+    wide-matmul-friendly shape the MXU wants."""
+
+    def __init__(self, hidden_size, projection_size, input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 h2r_weight_initializer=None, i2h_bias_initializer="zeros",
+                 h2h_bias_initializer="zeros", **kwargs):
+        super().__init__(**kwargs)
+        self._hidden_size = hidden_size
+        self._projection_size = projection_size
+        self._input_size = input_size
+        with self.name_scope():
+            self.i2h_weight = self.params.get(
+                "i2h_weight", shape=(4 * hidden_size, input_size),
+                init=i2h_weight_initializer, allow_deferred_init=True)
+            self.h2h_weight = self.params.get(
+                "h2h_weight", shape=(4 * hidden_size, projection_size),
+                init=h2h_weight_initializer)
+            self.h2r_weight = self.params.get(
+                "h2r_weight", shape=(projection_size, hidden_size),
+                init=h2r_weight_initializer)
+            self.i2h_bias = self.params.get(
+                "i2h_bias", shape=(4 * hidden_size,),
+                init=i2h_bias_initializer)
+            self.h2h_bias = self.params.get(
+                "h2h_bias", shape=(4 * hidden_size,),
+                init=h2h_bias_initializer)
+
+    def _infer_shapes(self, x, *args):
+        self.i2h_weight._finish_deferred_init(
+            (4 * self._hidden_size, x.shape[-1]))
+        self._input_size = x.shape[-1]
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._projection_size),
+                 "__layout__": "NC"},
+                {"shape": (batch_size, self._hidden_size),
+                 "__layout__": "NC"}]
+
+    def hybrid_forward(self, F, x, states, i2h_weight, h2h_weight,
+                       h2r_weight, i2h_bias, h2h_bias):
+        r, c = states
+
+        def fn(xv, rv, cv, wi, wh, wr, bi, bh, _h=self._hidden_size):
+            gates = (xv @ wi.T + bi) + (rv @ wh.T + bh)
+            i, f, g, o = jnp.split(gates, 4, axis=-1)
+            new_c = _sigmoid(f) * cv + _sigmoid(i) * jnp.tanh(g)
+            new_h = _sigmoid(o) * jnp.tanh(new_c)
+            new_r = new_h @ wr.T
+            return new_r, new_c
+
+        new_r, new_c = _apply(fn, [x, r, c, i2h_weight, h2h_weight,
+                                   h2r_weight, i2h_bias, h2h_bias], n_out=2)
+        return new_r, [new_r, new_c]

@@ -108,13 +108,7 @@ def foreach(body, data, init_states):
         for i in range(length):
             outs, states = call_body([d[i] for d in data_list], states)
             per_step.append(outs)
-        if per_step and per_step[0]:
-            from ..ops.tensor_ops import stack
-            stacked = [stack(*[step[k] for step in per_step], axis=0)
-                       for k in range(len(per_step[0]))]
-        else:
-            stacked = []
-        return _pack_like_or_empty(stacked), _pack_like(init_states, states)
+        return _stack_steps(per_step), _pack_like(init_states, states)
 
     # traced path: one lax.scan
     traced = _TracedBody(lambda d, s: call_body(d, s))
@@ -152,6 +146,16 @@ def _pack_like_or_empty(values):
     return values[0] if len(values) == 1 else values
 
 
+def _stack_steps(per_step):
+    """Stack the k-th output of every step along a new dim 0."""
+    if not per_step or not per_step[0]:
+        return []
+    from ..ops.tensor_ops import stack
+    return _pack_like_or_empty(
+        [stack(*[step[k] for step in per_step], axis=0)
+         for k in range(len(per_step[0]))])
+
+
 def while_loop(cond, func, loop_vars, max_iterations=None):
     """Run `func` while `cond` holds, up to `max_iterations`.
 
@@ -183,13 +187,7 @@ def while_loop(cond, func, loop_vars, max_iterations=None):
                 break
             outs, vs = call_func(vs)
             steps.append(outs)
-        if steps and steps[0]:
-            from ..ops.tensor_ops import stack
-            stacked = [stack(*[s[k] for s in steps], axis=0)
-                       for k in range(len(steps[0]))]
-        else:
-            stacked = []
-        return _pack_like_or_empty(stacked), _pack_like(loop_vars, vs)
+        return _stack_steps(steps), _pack_like(loop_vars, vs)
 
     traced_cond = _TracedBody(lambda vs: cond(*vs))
     traced_func = _TracedBody(lambda vs: call_func(vs))

@@ -85,7 +85,7 @@ register_op("BatchNorm", _bn_infer)
 
 
 def _bn_train_variant(x, g, b, mm, mv, eps=1e-5, momentum=0.9, axis=1,
-                      fix_gamma=False, use_global_stats=False):
+                      fix_gamma=False, use_global_stats=False, _rng=None):
     """Training BatchNorm: batch stats normalise, moving stats update
     (reference: BN's mutable aux inputs written during the forward).
     use_global_stats freezes the moving stats (fine-tune mode)."""
@@ -99,7 +99,7 @@ def _bn_train_variant(x, g, b, mm, mv, eps=1e-5, momentum=0.9, axis=1,
 
 
 register_train_op("BatchNorm", _bn_train_variant)
-register_aux_slots("BatchNorm", (3, 4))  # moving_mean, moving_var
+register_aux_slots("BatchNorm", {3: "zeros", 4: "ones"})  # mean, var
 register_op("LayerNorm", lambda x, g, b, axis=-1, eps=1e-5:
             K.layer_norm(x, g, b, axis, eps))
 register_op("Pooling",
@@ -107,7 +107,19 @@ register_op("Pooling",
             global_pool=False, layout=None:
             K.global_pooling(x, pool_type, layout or "NCHW") if global_pool
             else K.pooling(x, kernel, pool_type, stride, pad, layout))
-register_op("Dropout", lambda x, p=0.5: x)  # symbolic graphs are inference
+register_op("Dropout", lambda x, p=0.5: x)  # inference: identity
+
+
+def _dropout_train(x, p=0.5, _rng=None):
+    """Inverted dropout for Executor.forward(is_train=True); the key is a
+    per-node fold of the step key the Executor draws each forward."""
+    if not p or _rng is None:
+        return x, {}
+    keep = jax.random.bernoulli(_rng, 1 - p, x.shape)
+    return jnp.where(keep, x / (1 - p), 0).astype(x.dtype), {}
+
+
+register_train_op("Dropout", _dropout_train)
 register_op("Embedding", lambda i, w, input_dim=None, output_dim=None:
             K.embedding(i, w))
 
@@ -228,8 +240,11 @@ def Activation(data, act_type="relu", name=None, **kwargs):
 
 
 def BatchNorm(data, gamma=None, beta=None, moving_mean=None, moving_var=None,
-              eps=1e-5, momentum=0.9, axis=1, fix_gamma=False,
+              eps=1e-5, momentum=0.9, axis=1, fix_gamma=True,
               use_global_stats=False, name=None, **kwargs):
+    """fix_gamma defaults True, matching the reference op (gamma pinned to
+    1 unless explicitly released); gluon.nn.BatchNorm trains gamma via
+    scale=True, also matching the reference Gluon layer."""
     return _make("BatchNorm", [data, gamma, beta, moving_mean, moving_var],
                  {"eps": eps, "momentum": momentum, "axis": axis,
                   "fix_gamma": fix_gamma,

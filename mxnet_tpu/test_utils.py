@@ -1,0 +1,197 @@
+"""mx.test_utils (reference: python/mxnet/test_utils.py).
+
+The public testing surface users (and the reference's own unit tests) build
+on: tolerance-aware comparison, random tensors, finite-difference gradient
+checking, and symbolic forward/backward checks.
+
+TPU-native notes: `check_numeric_gradient` verifies the *XLA-generated*
+backward (`jax.vjp` of the recorded tape / symbol program) against central
+finite differences — the reference checks hand-written CUDA backward kernels
+the same way. Default tolerances are fp32-sized; loosen for bfloat16.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .base import MXNetError, _as_list
+from .context import Context, cpu, current_context
+
+__all__ = ["default_context", "set_default_context", "assert_almost_equal",
+           "almost_equal", "same", "rand_ndarray", "rand_shape_2d",
+           "rand_shape_3d", "rand_shape_nd", "check_numeric_gradient",
+           "check_symbolic_forward", "check_symbolic_backward",
+           "numeric_grad", "list_tpus", "get_mnist"]
+
+_rng = np.random.RandomState(12345)
+
+
+def default_context():
+    """Context under test (reference: test_utils.default_context)."""
+    return current_context()
+
+
+def set_default_context(ctx):
+    """Process-wide default context override (reference:
+    test_utils.set_default_context). Pass None to restore auto-detection."""
+    Context._default_override = ctx
+
+
+def same(a, b):
+    return np.array_equal(np.asarray(a), np.asarray(b))
+
+
+def almost_equal(a, b, rtol=1e-5, atol=1e-8):
+    return np.allclose(np.asarray(a), np.asarray(b), rtol=rtol, atol=atol)
+
+
+def _to_numpy(x):
+    return x.asnumpy() if hasattr(x, "asnumpy") else np.asarray(x)
+
+
+def assert_almost_equal(a, b, rtol=1e-5, atol=1e-8, names=("a", "b")):
+    a_np, b_np = _to_numpy(a), _to_numpy(b)
+    if a_np.shape != b_np.shape:
+        raise AssertionError(
+            f"shape mismatch {names[0]}{a_np.shape} vs {names[1]}{b_np.shape}")
+    if not np.allclose(a_np, b_np, rtol=rtol, atol=atol):
+        err = np.abs(a_np - b_np)
+        rel = err / (np.abs(b_np) + atol)
+        idx = np.unravel_index(np.argmax(rel), rel.shape)
+        raise AssertionError(
+            f"{names[0]} != {names[1]} (rtol={rtol}, atol={atol}): "
+            f"max abs err {err.max():.3g}, max rel err {rel.max():.3g} "
+            f"at {idx}: {a_np[idx]!r} vs {b_np[idx]!r}")
+
+
+def rand_shape_2d(dim0=10, dim1=10):
+    return (_rng.randint(1, dim0 + 1), _rng.randint(1, dim1 + 1))
+
+
+def rand_shape_3d(dim0=10, dim1=10, dim2=10):
+    return (_rng.randint(1, dim0 + 1), _rng.randint(1, dim1 + 1),
+            _rng.randint(1, dim2 + 1))
+
+
+def rand_shape_nd(ndim, dim=10):
+    return tuple(_rng.randint(1, dim + 1, size=ndim))
+
+
+def rand_ndarray(shape, dtype=np.float32, ctx=None):
+    from .ndarray.ndarray import array
+    return array(_rng.standard_normal(size=shape).astype(dtype), ctx=ctx)
+
+
+def list_tpus():
+    """Indices of available TPU chips (reference: test_utils.list_gpus)."""
+    from .context import num_tpus
+    return list(range(num_tpus()))
+
+
+list_gpus = list_tpus
+
+
+def numeric_grad(f, inputs, eps=1e-4):
+    """Central finite differences of scalar-valued f over numpy inputs."""
+    grads = []
+    for i, x in enumerate(inputs):
+        g = np.zeros_like(x, dtype=np.float64)
+        flat = x.reshape(-1)
+        gflat = g.reshape(-1)
+        for j in range(flat.size):
+            orig = flat[j]
+            flat[j] = orig + eps
+            fp = float(f(*inputs))
+            flat[j] = orig - eps
+            fm = float(f(*inputs))
+            flat[j] = orig
+            gflat[j] = (fp - fm) / (2 * eps)
+        grads.append(g.astype(x.dtype))
+    return grads
+
+
+def check_numeric_gradient(fn, inputs, rtol=1e-2, atol=1e-4, eps=1e-3):
+    """Verify autograd gradients of `fn` (NDArrays -> NDArray) against
+    central finite differences (reference: check_numeric_gradient — the
+    same contract, tape+jax.vjp instead of the imperative C++ tape)."""
+    from . import autograd
+    from .ndarray.ndarray import array
+
+    inputs_np = [np.asarray(_to_numpy(x), dtype=np.float64) for x in inputs]
+    nds = [array(x.astype(np.float32)) for x in inputs_np]
+    for x in nds:
+        x.attach_grad()
+    with autograd.record():
+        out = fn(*nds)
+        loss = out.sum()
+    loss.backward()
+
+    def f_np(*xs):
+        vals = [array(x.astype(np.float32)) for x in xs]
+        return _to_numpy(fn(*vals).sum())
+
+    expected = numeric_grad(f_np, inputs_np, eps=eps)
+    for i, (x, exp) in enumerate(zip(nds, expected)):
+        assert_almost_equal(x.grad, exp, rtol=rtol, atol=atol,
+                            names=(f"autograd_grad[{i}]",
+                                   f"numeric_grad[{i}]"))
+
+
+def check_symbolic_forward(sym, inputs, expected, rtol=1e-5, atol=1e-8,
+                           ctx=None):
+    """Bind `sym` with `inputs` (list or name->value dict) and compare
+    outputs with `expected` (reference: check_symbolic_forward)."""
+    from .ndarray.ndarray import array
+    names = sym.list_arguments()
+    if not isinstance(inputs, dict):
+        inputs = dict(zip(names, inputs))
+    args = {k: array(_to_numpy(v).astype(np.float32))
+            for k, v in inputs.items()}
+    ex = sym.bind(ctx, args, None, grad_req="null")
+    outs = ex.forward()
+    for o, e in zip(_as_list(outs), _as_list(expected)):
+        assert_almost_equal(o, e, rtol=rtol, atol=atol,
+                            names=("forward", "expected"))
+    return outs
+
+
+def check_symbolic_backward(sym, inputs, out_grads, expected, rtol=1e-5,
+                            atol=1e-8, ctx=None):
+    """Run the Executor backward with `out_grads` and compare the argument
+    gradients with `expected` (dict name->grad or list in argument order)."""
+    from .ndarray.ndarray import array, zeros
+    names = sym.list_arguments()
+    if not isinstance(inputs, dict):
+        inputs = dict(zip(names, inputs))
+    args = {k: array(_to_numpy(v).astype(np.float32))
+            for k, v in inputs.items()}
+    grads = {k: zeros(v.shape) for k, v in args.items()}
+    ex = sym.bind(ctx, args, grads)
+    ex.forward(is_train=True)
+    ex.backward([array(_to_numpy(g).astype(np.float32))
+                 for g in _as_list(out_grads)])
+    if not isinstance(expected, dict):
+        expected = dict(zip(names, expected))
+    for k, e in expected.items():
+        assert_almost_equal(grads[k], e, rtol=rtol, atol=atol,
+                            names=(f"grad[{k}]", f"expected[{k}]"))
+    return grads
+
+
+def get_mnist(seed=0):
+    """Synthetic MNIST-shaped dataset (offline-safe, like the vision
+    datasets): dict with train/test images (N,1,28,28) in [0,1] and labels.
+    The digits are class-dependent gaussian blobs, linearly separable enough
+    for convergence smoke tests (reference get_mnist downloads the real
+    set; this environment has no egress)."""
+    rs = np.random.RandomState(seed)
+    def make(n):
+        y = rs.randint(0, 10, n)
+        x = rs.rand(n, 1, 28, 28).astype(np.float32) * 0.1
+        for i in range(n):
+            r, c = divmod(int(y[i]), 4)
+            x[i, 0, 6 * r:6 * r + 6, 7 * c:7 * c + 6] += 0.9
+        return x, y.astype(np.float32)
+    xtr, ytr = make(512)
+    xte, yte = make(128)
+    return {"train_data": xtr, "train_label": ytr,
+            "test_data": xte, "test_label": yte}

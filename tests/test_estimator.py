@@ -1,0 +1,125 @@
+"""gluon.contrib.estimator: fit loop + event handlers (reference:
+python/mxnet/gluon/contrib/estimator)."""
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.gluon import Trainer, loss as loss_mod, nn
+from mxnet_tpu.gluon.contrib import estimator as est
+from mxnet_tpu.gluon.data import ArrayDataset, DataLoader
+
+
+def _toy():
+    rs = np.random.RandomState(0)
+    x = rs.randn(128, 8).astype(np.float32)
+    y = (x[:, 0] > 0).astype(np.float32)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu"), nn.Dense(2))
+    net.initialize()
+    data = DataLoader(ArrayDataset(nd.array(x), nd.array(y)), batch_size=32)
+    return net, data, x, y
+
+
+def test_estimator_fit_converges():
+    net, data, x, y = _toy()
+    e = est.Estimator(net, loss_mod.SoftmaxCrossEntropyLoss(),
+                      train_metrics=["accuracy"],
+                      trainer=Trainer(net.collect_params(), "adam",
+                                      {"learning_rate": 0.01}))
+    e.fit(data, epochs=12)
+    res = e.evaluate(data, ["accuracy"])
+    assert res["accuracy"] > 0.95, res
+
+
+def test_estimator_stop_by_batches():
+    net, data, *_ = _toy()
+    e = est.Estimator(net, loss_mod.SoftmaxCrossEntropyLoss())
+    seen = []
+
+    class Counter(est.BatchEnd):
+        def batch_end(self, estimator, **kwargs):
+            seen.append(1)
+
+    e.fit(data, batches=3, event_handlers=[Counter()])
+    assert len(seen) == 3
+
+
+def test_checkpoint_and_early_stopping():
+    net, data, *_ = _toy()
+    acc = mx.metric.create("accuracy")
+    e = est.Estimator(net, loss_mod.SoftmaxCrossEntropyLoss(),
+                      train_metrics=[acc],
+                      trainer=Trainer(net.collect_params(), "adam",
+                                      {"learning_rate": 0.01}))
+    with tempfile.TemporaryDirectory() as d:
+        ckpt = est.CheckpointHandler(d, monitor=acc, mode="max",
+                                     save_best=True)
+        early = est.EarlyStoppingHandler(monitor=acc, mode="max",
+                                         patience=2)
+        e.fit(data, epochs=10, event_handlers=[ckpt, early])
+        assert os.path.exists(os.path.join(d, "model-epoch1.params"))
+        assert os.path.exists(os.path.join(d, "model-best.params"))
+        # early stopping kicks in once accuracy plateaus at 1.0
+        assert early.best is not None
+    # loss metric auto-added and populated
+    lm = [m for m in e.train_metrics if "loss" in m.name][0]
+    assert np.isfinite(lm.get()[1])
+
+
+def test_validation_handler_runs():
+    net, data, *_ = _toy()
+    runs = []
+    e = est.Estimator(net, loss_mod.SoftmaxCrossEntropyLoss())
+    vh = est.ValidationHandler(data, lambda d: runs.append(e.evaluate(d)))
+    e.fit(data, epochs=2, event_handlers=[vh])
+    assert len(runs) == 2 and "accuracy" in runs[0]
+
+
+def test_val_metrics_monitored_and_handler_reuse():
+    """Validation metrics are observable (monitored by EarlyStopping) and
+    handlers reset across fit() calls (round-2 review findings)."""
+    net, data, *_ = _toy()
+    e = est.Estimator(net, loss_mod.SoftmaxCrossEntropyLoss(),
+                      val_metrics=["accuracy"],
+                      trainer=Trainer(net.collect_params(), "adam",
+                                      {"learning_rate": 0.01}))
+    val_acc = e.val_metrics[0]
+    early = est.EarlyStoppingHandler(monitor=val_acc, mode="max",
+                                     patience=1)
+    e.fit(data, val_data=data, epochs=6, event_handlers=[early])
+    assert len(e.val_results) >= 1          # results recorded
+    assert val_acc.get()[1] > 0.5           # monitored object updated
+    first_best = early.best
+    # reuse the same handler: state must reset, training must not
+    # insta-stop from stale stop_training
+    seen = []
+
+    class Counter(est.BatchEnd):
+        def batch_end(self, estimator, **kwargs):
+            seen.append(1)
+
+    e.fit(data, val_data=data, epochs=2, event_handlers=[early, Counter()])
+    assert len(seen) >= 8                   # 2 epochs x 4 batches ran
+    assert early.current_epoch <= 2
+
+
+def test_metric_handler_ordering():
+    """User handlers at batch_end see CURRENT-batch metric values."""
+    net, data, *_ = _toy()
+    acc = mx.metric.create("accuracy")
+    e = est.Estimator(net, loss_mod.SoftmaxCrossEntropyLoss(),
+                      train_metrics=[acc])
+    counts = []
+
+    class Probe(est.BatchEnd):
+        def batch_end(self, estimator, **kwargs):
+            counts.append(acc.num_inst)
+
+    e.fit(data, batches=3, event_handlers=[Probe()])
+    # metric already includes the current batch (32 samples each) when the
+    # user handler fires
+    assert counts == [32, 64, 96]

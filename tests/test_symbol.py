@@ -234,3 +234,41 @@ def test_name_manager_scoped_counters():
     with mx.name.Prefix("enc_"):
         s = sym.FullyConnected(d, num_hidden=2)
         assert "enc_fullyconnected0_weight" in s.list_arguments()
+
+
+def test_attr_scope():
+    """mx.AttrScope attaches attrs to symbols created inside the scope and
+    they round-trip through tojson (reference: python/mxnet/attribute.py)."""
+    with mx.AttrScope(ctx_group="dev1", stage="encoder"):
+        a = sym.Variable("a", attr={"grp": "x"})
+        with mx.AttrScope(stage="decoder"):
+            b = sym.FullyConnected(a, num_hidden=4, name="fcattr")
+    assert a.attr("ctx_group") == "dev1" and a.attr("grp") == "x"
+    assert b.attr("stage") == "decoder" and b.attr("ctx_group") == "dev1"
+    outside = sym.Variable("c")
+    assert outside.attr("ctx_group") is None
+    loaded = mx.sym.load_json(b.tojson())
+    assert loaded.attr("stage") == "decoder"
+    assert "num_hidden" in b.list_attr()  # op attrs still visible
+    import pytest
+    with pytest.raises(mx.base.MXNetError):
+        mx.AttrScope(bad=3)  # non-string values rejected
+
+
+def test_symbolic_dropout_train_vs_inference():
+    """Dropout is identity in inference and drops+rescales in training
+    (round-2 review finding: the train variant must not be a no-op)."""
+    data = sym.Variable("data")
+    net = sym.Dropout(data, p=0.5)
+    x = np.ones((64, 64), np.float32)
+    ex = net.bind(None, {"data": mx.nd.array(x)},
+                  {"data": mx.nd.zeros((64, 64))})
+    out_inf = ex.forward(is_train=False)[0].asnumpy()
+    np.testing.assert_array_equal(out_inf, x)  # identity
+    out_tr = ex.forward(is_train=True)[0].asnumpy()
+    zeros = (out_tr == 0).mean()
+    assert 0.3 < zeros < 0.7           # ~half dropped
+    kept = out_tr[out_tr != 0]
+    np.testing.assert_allclose(kept, 2.0, rtol=1e-6)  # inverted scaling
+    out_tr2 = ex.forward(is_train=True)[0].asnumpy()
+    assert not np.array_equal(out_tr, out_tr2)  # fresh key per step

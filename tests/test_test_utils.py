@@ -1,0 +1,93 @@
+"""mx.test_utils: the public testing surface (reference:
+python/mxnet/test_utils.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, sym, test_utils
+
+
+def test_assert_almost_equal():
+    test_utils.assert_almost_equal(nd.ones((2, 2)), np.ones((2, 2)))
+    with pytest.raises(AssertionError):
+        test_utils.assert_almost_equal(nd.ones((2, 2)),
+                                       np.ones((2, 2)) + 0.1)
+    with pytest.raises(AssertionError):  # shape mismatch
+        test_utils.assert_almost_equal(nd.ones((2,)), np.ones((3,)))
+    assert test_utils.almost_equal([1.0], [1.0 + 1e-9])
+    assert test_utils.same([1, 2], [1, 2])
+
+
+def test_rand_helpers():
+    s = test_utils.rand_shape_nd(4, dim=5)
+    assert len(s) == 4 and all(1 <= d <= 5 for d in s)
+    x = test_utils.rand_ndarray((3, 4))
+    assert x.shape == (3, 4) and x.dtype == np.float32
+
+
+def test_check_numeric_gradient_catches_wrong_backward():
+    """The checker passes a correct op and fails a deliberately-wrong
+    custom gradient (the reference uses it exactly this way)."""
+    test_utils.check_numeric_gradient(
+        lambda a, b: (a * b).tanh(), [np.random.RandomState(0).rand(3, 2),
+                                      np.random.RandomState(1).rand(3, 2)])
+
+    from mxnet_tpu import autograd
+
+    class BadGrad(autograd.Function):
+        def forward(self, x):
+            return x * x
+
+        def backward(self, dy):
+            return dy * 3.14  # wrong on purpose (should be 2x*dy)
+
+    with pytest.raises(AssertionError):
+        test_utils.check_numeric_gradient(
+            lambda a: BadGrad()(a), [np.random.RandomState(2).rand(4)])
+
+
+def test_check_symbolic_forward_backward():
+    a = sym.Variable("a")
+    b = sym.Variable("b")
+    out = a * b + a
+    a_np = np.array([[1.0, 2.0], [3.0, 4.0]], np.float32)
+    b_np = np.array([[2.0, 2.0], [0.5, 1.0]], np.float32)
+    test_utils.check_symbolic_forward(out, {"a": a_np, "b": b_np},
+                                      [a_np * b_np + a_np])
+    og = np.ones_like(a_np)
+    test_utils.check_symbolic_backward(out, {"a": a_np, "b": b_np}, [og],
+                                       {"a": b_np + 1.0, "b": a_np})
+
+
+def test_default_context_override():
+    orig = test_utils.default_context()
+    try:
+        test_utils.set_default_context(mx.cpu(0))
+        assert test_utils.default_context().device_type == "cpu"
+    finally:
+        test_utils.set_default_context(None)
+    assert test_utils.default_context() == orig
+
+
+def test_get_mnist_trains():
+    """The synthetic MNIST must be learnable (convergence smoke contract)."""
+    from mxnet_tpu import autograd, gluon
+    from mxnet_tpu.gluon import nn
+    data = test_utils.get_mnist()
+    assert data["train_data"].shape == (512, 1, 28, 28)
+    net = nn.HybridSequential()
+    net.add(nn.Flatten(), nn.Dense(64, activation="relu"), nn.Dense(10))
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 0.01})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    x = nd.array(data["train_data"])
+    y = nd.array(data["train_label"])
+    for _ in range(30):
+        with autograd.record():
+            loss = loss_fn(net(x), y)
+        loss.backward()
+        trainer.step(x.shape[0])
+    pred = net(nd.array(data["test_data"])).asnumpy().argmax(1)
+    acc = (pred == data["test_label"]).mean()
+    assert acc > 0.9, f"synthetic mnist should be learnable, acc={acc}"
