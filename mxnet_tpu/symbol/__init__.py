@@ -2,3 +2,4 @@
 from .symbol import (Symbol, Variable, var, Group, load, load_json, Executor)
 from .ops import *   # noqa: F401,F403
 from . import ops
+from . import contrib

@@ -1,0 +1,289 @@
+"""mx.sym.contrib — symbolic control flow (reference:
+python/mxnet/symbol/contrib.py + src/operator/control_flow.cc).
+
+The reference builds nnvm subgraph ops (_foreach/_while_loop/_cond) whose
+bodies are cut-out symbol graphs with captured closure variables lifted to
+extra op inputs. Same structure here: the body function is called once on
+placeholder Variables to build the subgraph; free Variables (weights used
+inside the body) are auto-captured as node inputs; evaluation lowers to ONE
+`lax.scan` / masked scan / `lax.cond` inside the executor's XLA program —
+the TPU-native form (static shapes, no Python unrolling).
+
+Subgraph attrs serialize through `tojson` (nested graph JSON), so
+control-flow graphs round-trip like any other symbol.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..base import MXNetError, _as_list
+from .symbol import (Group, Symbol, Variable, _make, register_op,
+                     register_shape_rule)
+
+__all__ = ["foreach", "while_loop", "cond"]
+
+
+def _sym_list(x, what):
+    xs = _as_list(x) if x is not None else []
+    for v in xs:
+        if not isinstance(v, Symbol):
+            raise MXNetError(f"{what} must be Symbol(s), got {type(v)}")
+    return list(xs)
+
+
+def _free_vars(heads, bound_names):
+    """Variables used by `heads` that are not placeholders: the body's
+    closure captures, lifted to op inputs (reference: _cut_subgraph)."""
+    seen, out = set(), []
+    for h in heads:
+        for n in h._topo():
+            if n._op is None and n.name not in bound_names \
+                    and id(n) not in seen:
+                seen.add(id(n))
+                out.append(n)
+    return out
+
+
+def _pack(template, values):
+    values = list(values)
+    if not isinstance(template, (list, tuple)):
+        return values[0] if len(values) == 1 else values
+    return values
+
+
+def _eval_heads(heads, values):
+    return tuple(h._eval_with_values(values) for h in heads)
+
+
+# ---------------------------------------------------------------------------
+# foreach
+# ---------------------------------------------------------------------------
+def _foreach_eval(*arrays, sub_outs=None, in_names=None, n_data=0,
+                  n_states=0, n_out=0):
+    data = arrays[:n_data]
+    states = arrays[n_data:n_data + n_states]
+    caps = arrays[n_data + n_states:]
+    cap_vals = dict(zip(in_names[n_data + n_states:], caps))
+
+    def step(carry, xs):
+        vals = dict(zip(in_names[:n_data], xs))
+        vals.update(zip(in_names[n_data:n_data + n_states], carry))
+        vals.update(cap_vals)
+        outs = _eval_heads(sub_outs, vals)
+        return tuple(outs[n_out:]), tuple(outs[:n_out])
+
+    carry, ys = lax.scan(step, tuple(states), tuple(data))
+    return tuple(ys) + tuple(carry)
+
+
+register_op("_foreach", _foreach_eval)
+
+
+def _subgraph_capture_shapes(ins, names, heads, bound_shapes):
+    """Fill unknown capture shapes by running the SUBGRAPH's own shape
+    inference from the known outer shapes (the reference runs nnvm
+    InferShape on the subgraph the same way)."""
+    g = Group(heads) if len(heads) > 1 else heads[0]
+    arg_shapes, _, _ = g.infer_shape(**bound_shapes)
+    if arg_shapes is None:
+        return ins
+    shape_of = dict(zip(g.list_arguments(), arg_shapes))
+    return [s if s is not None else shape_of.get(names[k])
+            for k, s in enumerate(ins)]
+
+
+def _foreach_shapes(ins, attrs):
+    names = attrs["in_names"]
+    n_d, n_s = attrs["n_data"], attrs["n_states"]
+    bind = {}
+    for i, s in enumerate(ins):
+        if s is not None:
+            bind[names[i]] = tuple(s[1:]) if i < n_d else tuple(s)
+    return _subgraph_capture_shapes(ins, names, attrs["sub_outs"], bind)
+
+
+register_shape_rule("_foreach", _foreach_shapes)
+
+
+def foreach(body, data, init_states, name="foreach"):
+    """Symbolic scan: body(data_slice, states) -> (outputs, new_states),
+    iterated over dim 0 of `data`, compiled to one `lax.scan`.
+
+    Returns (outputs, final_states) — outputs stacked on a new dim 0.
+    """
+    data_list = _sym_list(data, "foreach data")
+    state_list = _sym_list(init_states, "foreach init_states")
+    if not data_list:
+        raise MXNetError("foreach needs at least one data symbol")
+
+    slice_vars = [Variable(f"__{name}_data{i}__")
+                  for i in range(len(data_list))]
+    state_vars = [Variable(f"__{name}_state{j}__")
+                  for j in range(len(state_list))]
+    outs, new_states = body(_pack(data, slice_vars),
+                            _pack(init_states, state_vars))
+    out_list = _sym_list(outs, "foreach outputs") if outs is not None else []
+    new_state_list = _sym_list(new_states, "foreach states")
+    if len(new_state_list) != len(state_list):
+        raise MXNetError("foreach body must return as many states as given")
+
+    placeholders = [v.name for v in slice_vars + state_vars]
+    captures = _free_vars(out_list + new_state_list, set(placeholders))
+    in_names = placeholders + [c.name for c in captures]
+    n_out, n_states = len(out_list), len(state_list)
+
+    node = _make("_foreach", data_list + state_list + list(captures),
+                 {"sub_outs": out_list + new_state_list,
+                  "in_names": in_names, "n_data": len(data_list),
+                  "n_states": n_states, "n_out": n_out},
+                 name=name, n_out=n_out + n_states)
+    outs_syms = [node[i] for i in range(n_out)]
+    state_syms = [node[n_out + j] for j in range(n_states)]
+    outs_packed = [] if not out_list else (
+        outs_syms[0] if not isinstance(outs, (list, tuple)) else outs_syms)
+    return outs_packed, _pack(init_states, state_syms)
+
+
+# ---------------------------------------------------------------------------
+# while_loop
+# ---------------------------------------------------------------------------
+def _while_eval(*arrays, sub_cond=None, sub_outs=None, in_names=None,
+                n_vars=0, n_out=0, max_iterations=0):
+    vs = arrays[:n_vars]
+    caps = dict(zip(in_names[n_vars:], arrays[n_vars:]))
+
+    def probe(values):
+        return _eval_heads(sub_outs, values)
+
+    # output buffers sized from an abstract probe of one step
+    vals0 = dict(zip(in_names[:n_vars], vs))
+    vals0.update(caps)
+    shapes = jax.eval_shape(lambda v: probe(v), vals0)
+
+    bufs0 = tuple(jnp.zeros((max_iterations,) + s.shape, s.dtype)
+                  for s in shapes[:n_out])
+
+    def step(carry, i):
+        cur, bufs, active = carry
+        vals = dict(zip(in_names[:n_vars], cur))
+        vals.update(caps)
+        keep = jnp.logical_and(
+            active,
+            jnp.squeeze(_eval_heads([sub_cond], vals)[0]).astype(bool))
+
+        def take(args):
+            cur, bufs = args
+            outs = probe(vals)
+            new_bufs = tuple(
+                lax.dynamic_update_index_in_dim(b, o, i, 0)
+                for b, o in zip(bufs, outs[:n_out]))
+            return tuple(outs[n_out:]), new_bufs
+
+        new_cur, new_bufs = lax.cond(keep, take, lambda a: a, (cur, bufs))
+        return (new_cur, new_bufs, keep), None
+
+    (vs_f, bufs, _), _ = lax.scan(
+        step, (tuple(vs), bufs0, jnp.bool_(True)),
+        jnp.arange(max_iterations))
+    return tuple(bufs) + tuple(vs_f)
+
+
+register_op("_while_loop", _while_eval)
+
+
+def _while_shapes(ins, attrs):
+    names = attrs["in_names"]
+    bind = {names[i]: tuple(s) for i, s in enumerate(ins) if s is not None}
+    return _subgraph_capture_shapes(
+        ins, names, [attrs["sub_cond"]] + attrs["sub_outs"], bind)
+
+
+register_shape_rule("_while_loop", _while_shapes)
+
+
+def while_loop(cond, func, loop_vars, max_iterations=None, name="while"):
+    """Symbolic while: cond(*loop_vars) -> scalar Symbol;
+    func(*loop_vars) -> (step_outputs, new_loop_vars). Outputs are padded
+    to `max_iterations` rows (XLA static shapes, same contract as the
+    reference symbolic while_loop)."""
+    var_list = _sym_list(loop_vars, "while_loop loop_vars")
+    if not var_list:
+        raise MXNetError("while_loop needs at least one loop var")
+    if max_iterations is None:
+        raise MXNetError("while_loop requires max_iterations")
+
+    vars_ph = [Variable(f"__{name}_var{i}__") for i in range(len(var_list))]
+    cond_sym = cond(*vars_ph)
+    outs, new_vars = func(*vars_ph)
+    out_list = _sym_list(outs, "while outputs") if outs is not None else []
+    new_var_list = _sym_list(new_vars, "while loop vars")
+    if len(new_var_list) != len(var_list):
+        raise MXNetError("while_loop func must return as many loop_vars")
+
+    placeholders = [v.name for v in vars_ph]
+    captures = _free_vars([cond_sym] + out_list + new_var_list,
+                          set(placeholders))
+    in_names = placeholders + [c.name for c in captures]
+    n_out, n_vars = len(out_list), len(var_list)
+
+    node = _make("_while_loop", var_list + list(captures),
+                 {"sub_cond": cond_sym,
+                  "sub_outs": out_list + new_var_list,
+                  "in_names": in_names, "n_vars": n_vars, "n_out": n_out,
+                  "max_iterations": int(max_iterations)},
+                 name=name, n_out=n_out + n_vars)
+    outs_syms = [node[i] for i in range(n_out)]
+    var_syms = [node[n_out + j] for j in range(n_vars)]
+    return (outs_syms[0] if n_out == 1 else outs_syms), \
+        _pack(loop_vars, var_syms)
+
+
+# ---------------------------------------------------------------------------
+# cond
+# ---------------------------------------------------------------------------
+def _cond_eval(*arrays, sub_pred=None, sub_then=None, sub_else=None,
+               in_names=None):
+    vals = dict(zip(in_names, arrays))
+    pred = jnp.squeeze(_eval_heads([sub_pred], vals)[0]).astype(bool)
+    out = lax.cond(pred,
+                   lambda v: _eval_heads(sub_then, v),
+                   lambda v: _eval_heads(sub_else, v), vals)
+    return tuple(out)
+
+
+register_op("_cond", _cond_eval)
+
+
+def _cond_shapes(ins, attrs):
+    names = attrs["in_names"]
+    bind = {names[i]: tuple(s) for i, s in enumerate(ins) if s is not None}
+    return _subgraph_capture_shapes(
+        ins, names, [attrs["sub_pred"]] + attrs["sub_then"]
+        + attrs["sub_else"], bind)
+
+
+register_shape_rule("_cond", _cond_shapes)
+
+
+def cond(pred, then_func, else_func, name="cond"):
+    """Symbolic branch: pred is a scalar Symbol; then/else are thunks
+    returning Symbol(s) of matching shapes, lowered to `lax.cond` (both
+    branches compiled, one executed on device)."""
+    if not isinstance(pred, Symbol):
+        raise MXNetError("cond pred must be a Symbol")
+    then_list = _sym_list(then_func(), "cond then outputs")
+    else_list = _sym_list(else_func(), "cond else outputs")
+    if len(then_list) != len(else_list):
+        raise MXNetError("cond branches must return the same arity")
+
+    captures = _free_vars([pred] + then_list + else_list, set())
+    in_names = [c.name for c in captures]
+    n_out = len(then_list)
+    node = _make("_cond", list(captures),
+                 {"sub_pred": pred, "sub_then": then_list,
+                  "sub_else": else_list, "in_names": in_names},
+                 name=name, n_out=n_out)
+    outs = [node[i] for i in range(n_out)]
+    return outs[0] if n_out == 1 else outs

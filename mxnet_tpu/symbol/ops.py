@@ -18,7 +18,9 @@ from .symbol import (Symbol, _make, register_aux_slots, register_op,
 __all__ = ["FullyConnected", "Convolution", "StemConvS2D", "Activation",
            "BatchNorm",
            "LayerNorm", "Pooling", "Dropout", "Embedding", "softmax",
-           "log_softmax", "SoftmaxOutput", "flatten", "Flatten", "reshape",
+           "log_softmax", "SoftmaxOutput", "LinearRegressionOutput",
+           "MAERegressionOutput", "LogisticRegressionOutput",
+           "flatten", "Flatten", "reshape",
            "transpose", "concat", "Concat", "dot", "batch_dot", "sum", "mean",
            "max", "min", "relu", "sigmoid", "tanh", "exp", "log", "sqrt",
            "square", "negative", "zeros", "ones", "broadcast_add",
@@ -37,6 +39,23 @@ register_op("elemwise_div_scalar", lambda a, scalar: a / scalar)
 register_op("elemwise_pow_scalar", lambda a, scalar: a ** scalar)
 register_op("rsub_scalar", lambda a, scalar: scalar - a)
 register_op("rdiv_scalar", lambda a, scalar: scalar / a)
+# comparisons return float 0/1 arrays (reference: broadcast_lesser etc.)
+register_op("broadcast_lesser",
+            lambda a, b: (a < b).astype(jnp.float32))
+register_op("broadcast_lesser_equal",
+            lambda a, b: (a <= b).astype(jnp.float32))
+register_op("broadcast_greater",
+            lambda a, b: (a > b).astype(jnp.float32))
+register_op("broadcast_greater_equal",
+            lambda a, b: (a >= b).astype(jnp.float32))
+register_op("broadcast_lesser_scalar",
+            lambda a, scalar: (a < scalar).astype(jnp.float32))
+register_op("broadcast_lesser_equal_scalar",
+            lambda a, scalar: (a <= scalar).astype(jnp.float32))
+register_op("broadcast_greater_scalar",
+            lambda a, scalar: (a > scalar).astype(jnp.float32))
+register_op("broadcast_greater_equal_scalar",
+            lambda a, scalar: (a >= scalar).astype(jnp.float32))
 register_op("negative", jnp.negative)
 register_op("relu", jax.nn.relu)
 register_op("sigmoid", jax.nn.sigmoid)
@@ -146,6 +165,44 @@ _softmax_output.defvjp(_so_fwd, _so_bwd)
 register_op("SoftmaxOutput",
             lambda x, *l: _softmax_output(x, l[0]) if l
             else jax.nn.softmax(x, axis=-1))
+
+
+def _regression_output(link, grad_fn):
+    """Loss-head factory (reference: src/operator/regression_output-inl.h):
+    forward applies the link; backward ignores the incoming cotangent and
+    emits grad_fn(pred, label) * grad_scale / num_output, where num_output
+    is the per-sample element count — the reference's exact scaling."""
+
+    import functools
+
+    @functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+    def op(x, label, grad_scale):
+        return link(x)
+
+    def fwd(x, label, grad_scale):
+        p = link(x)
+        return p, (p, label)
+
+    def bwd(grad_scale, res, g):
+        p, label = res
+        lab = label.reshape(p.shape).astype(p.dtype)
+        # NB: plain `max` here would resolve to the symbol-level reduce op
+        # this module exports — use the product directly (empty shape -> 1)
+        num_output = int(_np.prod(p.shape[1:])) or 1
+        return (grad_fn(p, lab) * (grad_scale / num_output),
+                jnp.zeros(label.shape, label.dtype))
+
+    op.defvjp(fwd, bwd)
+    return lambda x, *l, grad_scale=1.0: (
+        op(x, l[0], float(grad_scale)) if l else link(x))
+
+
+register_op("LinearRegressionOutput",
+            _regression_output(lambda x: x, lambda p, y: p - y))
+register_op("MAERegressionOutput",
+            _regression_output(lambda x: x, lambda p, y: jnp.sign(p - y)))
+register_op("LogisticRegressionOutput",
+            _regression_output(jax.nn.sigmoid, lambda p, y: p - y))
 register_op("zeros", lambda shape=(), dtype=None: jnp.zeros(shape, dtype))
 register_op("ones", lambda shape=(), dtype=None: jnp.ones(shape, dtype))
 
@@ -282,6 +339,27 @@ def Embedding(data, weight=None, input_dim=None, output_dim=None, name=None,
 def SoftmaxOutput(data, label=None, name=None, **kwargs):
     ins = [data] if label is None else [data, label]
     return _make("SoftmaxOutput", ins, {}, name=name)
+
+
+def LinearRegressionOutput(data, label=None, grad_scale=1.0, name=None,
+                           **kwargs):
+    ins = [data] if label is None else [data, label]
+    return _make("LinearRegressionOutput", ins,
+                 {"grad_scale": grad_scale}, name=name)
+
+
+def MAERegressionOutput(data, label=None, grad_scale=1.0, name=None,
+                        **kwargs):
+    ins = [data] if label is None else [data, label]
+    return _make("MAERegressionOutput", ins,
+                 {"grad_scale": grad_scale}, name=name)
+
+
+def LogisticRegressionOutput(data, label=None, grad_scale=1.0, name=None,
+                             **kwargs):
+    ins = [data] if label is None else [data, label]
+    return _make("LogisticRegressionOutput", ins,
+                 {"grad_scale": grad_scale}, name=name)
 
 
 def softmax(data, axis=-1, name=None):

@@ -13,22 +13,97 @@ def print_summary(symbol_or_block, shape=None, **kwargs):
         return symbol_or_block.summary()
     sym = symbol_or_block
     nodes = sym._topo()
-    lines = [f"{'Name':<36}{'Op':<24}{'Inputs':<40}", "-" * 100]
+    shape_of = {}
+    if shape:
+        arg_shapes, _, aux_shapes = sym.infer_shape(**shape)
+        if arg_shapes is not None:
+            shape_of = dict(zip(sym.list_arguments(), arg_shapes))
+            shape_of.update(zip(sym.list_auxiliary_states(), aux_shapes))
+    lines = [f"{'Name':<36}{'Op':<24}{'Shape':<18}{'Inputs':<40}",
+             "-" * 118]
     for n in nodes:
         ins = ",".join(i.name for i in n._inputs)
-        lines.append(f"{n.name:<36}{n._op or 'Variable':<24}{ins:<40}")
+        s = str(shape_of.get(n.name, "")) if n._op is None else ""
+        lines.append(f"{n.name:<36}{n._op or 'Variable':<24}{s:<18}{ins:<40}")
     out = "\n".join(lines)
     print(out)
     return out
 
 
-def plot_network(symbol, title="plot", shape=None, **kwargs):
-    """Text DAG rendering (graphviz is not guaranteed offline; the reference
-    returns a Digraph — here an ASCII adjacency list with the same info)."""
+_NODE_STYLE = {
+    None: ("oval", "#8dd3c7"),            # Variable
+    "FullyConnected": ("box", "#fb8072"),
+    "Convolution": ("box", "#fb8072"),
+    "StemConvS2D": ("box", "#fb8072"),
+    "BatchNorm": ("box", "#bebada"),
+    "LayerNorm": ("box", "#bebada"),
+    "Activation": ("box", "#ffffb3"),
+    "Pooling": ("box", "#80b1d3"),
+    "SoftmaxOutput": ("box", "#fccde5"),
+}
+
+
+class Digraph:
+    """Minimal graphviz.Digraph stand-in: accumulates nodes/edges and
+    renders DOT source (`.source`, `.save`). The reference returns a
+    graphviz Digraph; the package is not available offline, so this carries
+    the same DOT output contract (paste into any graphviz renderer)."""
+
+    def __init__(self, title="plot"):
+        self.title = title
+        self._lines = []
+
+    def node(self, name, label=None, shape="box", fillcolor="white"):
+        self._lines.append(
+            f'  "{name}" [label="{label or name}", shape={shape}, '
+            f'style=filled, fillcolor="{fillcolor}"];')
+
+    def edge(self, src, dst, label=None):
+        lab = f' [label="{label}"]' if label else ""
+        self._lines.append(f'  "{src}" -> "{dst}"{lab};')
+
+    @property
+    def source(self):
+        body = "\n".join(self._lines)
+        return f'digraph "{self.title}" {{\nrankdir=BT;\n{body}\n}}'
+
+    def save(self, filename):
+        with open(filename, "w") as f:
+            f.write(self.source)
+        return filename
+
+    def __str__(self):
+        return self.source
+
+
+def plot_network(symbol, title="plot", shape=None, node_attrs=None,
+                 hide_weights=True, **kwargs):
+    """DOT-format DAG of a Symbol (reference: plot_network returns a
+    graphviz Digraph; this returns a Digraph stand-in whose `.source` is
+    valid DOT). `hide_weights` folds parameter Variables into their
+    consumer node, like the reference."""
     nodes = symbol._topo()
-    lines = [f"digraph-text {title} {{"]
+    g = Digraph(title)
+    hidden = set()
+    if hide_weights:
+        for n in nodes:
+            if n._op is None and (n.name.endswith(("_weight", "_bias",
+                                                   "_gamma", "_beta",
+                                                   "_moving_mean",
+                                                   "_moving_var"))):
+                hidden.add(id(n))
     for n in nodes:
+        if id(n) in hidden:
+            continue
+        shape_style, color = _NODE_STYLE.get(n._op, ("box", "#d9d9d9"))
+        label = n.name if n._op is None else f"{n._op}\\n{n.name}"
+        g.node(n.name, label=label, shape=shape_style, fillcolor=color)
+    for n in nodes:
+        if id(n) in hidden:
+            continue
         for i in n._inputs:
-            lines.append(f"  {i.name} -> {n.name} [{n._op}]")
-    lines.append("}")
-    return "\n".join(lines)
+            base, _ = i._resolve_head()
+            if id(base) in hidden:
+                continue
+            g.edge(base.name, n.name)
+    return g

@@ -1,0 +1,173 @@
+"""Symbolic control flow: sym.contrib.foreach / while_loop / cond
+(reference: python/mxnet/symbol/contrib.py — subgraph ops)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, sym
+
+
+def test_sym_foreach_cumsum():
+    data = sym.Variable("data")
+    init = sym.Variable("init")
+
+    def body(x, s):
+        new_s = s + x
+        return new_s, new_s
+
+    outs, final = sym.contrib.foreach(body, data, init)
+    g = sym.Group([outs, final])
+    d = np.arange(12, dtype=np.float32).reshape(4, 3)
+    ex = g.bind(None, {"data": nd.array(d), "init": nd.zeros((3,))})
+    o, f = ex.forward()
+    expect = np.cumsum(d, axis=0)
+    np.testing.assert_allclose(o.asnumpy(), expect, rtol=1e-6)
+    np.testing.assert_allclose(f.asnumpy(), expect[-1], rtol=1e-6)
+
+
+def test_sym_foreach_closure_capture_and_grad():
+    """Weights used inside the body are auto-captured as op inputs and get
+    gradients through the scan (reference: _cut_subgraph capture)."""
+    data = sym.Variable("data")
+    init = sym.Variable("init")
+    w = sym.Variable("w")
+
+    def body(x, s):
+        out = x * w + s
+        return out, out
+
+    outs, final = sym.contrib.foreach(body, data, init)
+    assert "w" in final.list_arguments()  # captured
+    d = np.arange(3, dtype=np.float32).reshape(3, 1)
+    args = {"data": nd.array(d), "init": nd.zeros((1,)),
+            "w": nd.array([2.0])}
+    grads = {k: nd.zeros(v.shape) for k, v in args.items()}
+    ex = final.bind(None, args, grads)
+    out = ex.forward(is_train=True)[0]
+    np.testing.assert_allclose(out.asnumpy(), [3 * 2.0])  # (0+1+2)*w
+    ex.backward(nd.ones((1,)))
+    np.testing.assert_allclose(grads["w"].asnumpy(), [3.0], rtol=1e-6)
+
+
+def test_sym_foreach_infer_shape():
+    data = sym.Variable("data")
+    init = sym.Variable("init")
+    outs, final = sym.contrib.foreach(lambda x, s: (x * 2.0, s + x),
+                                      data, init)
+    _, out_shapes, _ = outs.infer_shape(data=(5, 4), init=(4,))
+    assert out_shapes == [(5, 4)]
+
+
+def test_sym_foreach_tojson_roundtrip():
+    data = sym.Variable("data")
+    init = sym.Variable("init")
+    outs, final = sym.contrib.foreach(lambda x, s: (x + s, s + x),
+                                      data, init)
+    js = final.tojson()
+    loaded = sym.load_json(js)
+    assert loaded.list_arguments() == final.list_arguments()
+    d = np.ones((4, 2), np.float32)
+    for s in (final, loaded):
+        ex = s.bind(None, {"data": nd.array(d), "init": nd.zeros((2,))})
+        np.testing.assert_allclose(ex.forward()[0].asnumpy(),
+                                   np.full((2,), 4.0))
+
+
+def test_sym_while_loop():
+    i = sym.Variable("i")
+    s = sym.Variable("s")
+    outs, (fi, fs) = sym.contrib.while_loop(
+        cond=lambda i, s: i < 5.0,
+        func=lambda i, s: (i * 10.0, [i + 1.0, s + i]),
+        loop_vars=[i, s], max_iterations=8)
+    g = sym.Group([outs, fi, fs])
+    ex = g.bind(None, {"i": nd.zeros((1,)), "s": nd.zeros((1,))})
+    o, vi, vs = ex.forward()
+    assert o.shape == (8, 1)  # padded to max_iterations
+    np.testing.assert_allclose(o.asnumpy()[:, 0],
+                               [0, 10, 20, 30, 40, 0, 0, 0])
+    np.testing.assert_allclose(vi.asnumpy(), [5.0])
+    np.testing.assert_allclose(vs.asnumpy(), [10.0])
+
+
+def test_sym_cond():
+    x = sym.Variable("x")
+    out = sym.contrib.cond(x.sum() > 0.0,
+                           lambda: x * 2.0,
+                           lambda: x - 1.0)
+    ex = out.bind(None, {"x": nd.array([3.0])})
+    np.testing.assert_allclose(ex.forward()[0].asnumpy(), [6.0])
+    ex2 = out.bind(None, {"x": nd.array([-3.0])})
+    np.testing.assert_allclose(ex2.forward()[0].asnumpy(), [-4.0])
+
+
+def test_sym_cond_branch_arity_mismatch():
+    x = sym.Variable("x")
+    with pytest.raises(mx.base.MXNetError):
+        sym.contrib.cond(x.sum() > 0, lambda: [x, x], lambda: x)
+
+
+def test_sym_foreach_capture_shape_inference():
+    """Captured weight shapes are inferred THROUGH the subgraph (Module
+    init path: weights used only inside the scan body)."""
+    data = sym.Variable("data")   # (T, B, D)
+    init = sym.Variable("init")
+    w = sym.Variable("w")
+
+    def body(x, s):
+        h = sym.FullyConnected(x, w, None, num_hidden=8, no_bias=True)
+        return h, s + h
+
+    outs, final = sym.contrib.foreach(body, data, init)
+    arg_shapes, out_shapes, _ = outs.infer_shape(data=(5, 2, 3), init=(2, 8))
+    shape_of = dict(zip(outs.list_arguments(), arg_shapes))
+    assert shape_of["w"] == (8, 3)
+    assert out_shapes == [(5, 2, 8)]
+
+
+def test_regression_outputs():
+    """Regression heads: backward = (pred-label)*grad_scale/num_output
+    (reference: regression_output-inl.h — per-sample element count, NOT
+    batch size)."""
+    x = sym.Variable("x")
+    y = sym.Variable("y")
+    out = sym.LinearRegressionOutput(x, y)
+    xv = nd.array([[1.0], [2.0]])
+    yv = nd.array([0.5, 0.5])
+    grads = {"x": nd.zeros((2, 1)), "y": nd.zeros((2,))}
+    ex = out.bind(None, {"x": xv, "y": yv}, grads)
+    np.testing.assert_allclose(ex.forward(is_train=True)[0].asnumpy(),
+                               xv.asnumpy())
+    ex.backward()
+    np.testing.assert_allclose(grads["x"].asnumpy(),
+                               [[0.5], [1.5]])  # pred-label, num_output=1
+    # grad_scale honoured
+    out2 = sym.LinearRegressionOutput(x, y, grad_scale=0.5)
+    ex2 = out2.bind(None, {"x": xv, "y": yv},
+                    {"x": nd.zeros((2, 1)), "y": nd.zeros((2,))})
+    ex2.forward(is_train=True)
+    ex2.backward()
+    np.testing.assert_allclose(ex2.grad_dict["x"].asnumpy(),
+                               [[0.25], [0.75]])
+    out_log = sym.LogisticRegressionOutput(x, y)
+    ex = out_log.bind(None, {"x": xv, "y": yv})
+    np.testing.assert_allclose(
+        ex.forward()[0].asnumpy(),
+        1 / (1 + np.exp(-xv.asnumpy())), rtol=1e-6)
+
+
+def test_group_tojson_roundtrip():
+    """Group symbols serialize: heads expand to members and load back as a
+    Group (round-2 review finding: tojson raised KeyError on Groups)."""
+    a = sym.Variable("a")
+    h = sym.FullyConnected(a, num_hidden=4, name="gfc")
+    g = sym.Group([h, sym.Activation(h, act_type="relu", name="gact")])
+    loaded = sym.load_json(g.tojson())
+    assert len(loaded.list_outputs()) == 2
+    assert loaded.list_arguments() == g.list_arguments()
+    vals = {"a": nd.ones((2, 3)), "gfc_weight": nd.ones((4, 3)),
+            "gfc_bias": nd.zeros((4,))}
+    ex = loaded.bind(None, vals)
+    o1, o2 = ex.forward()
+    np.testing.assert_allclose(o1.asnumpy(), np.full((2, 4), 3.0))
+    np.testing.assert_allclose(o2.asnumpy(), np.full((2, 4), 3.0))

@@ -1,0 +1,34 @@
+"""Visualization: print_summary table and plot_network DOT output
+(reference: python/mxnet/visualization.py)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import sym
+
+
+def _net():
+    data = sym.Variable("data")
+    h = sym.Activation(sym.FullyConnected(data, num_hidden=8, name="fc1"),
+                       act_type="relu", name="act1")
+    h = sym.BatchNorm(h, name="bn1")
+    return sym.FullyConnected(h, num_hidden=2, name="fc2")
+
+
+def test_print_summary_with_shapes(capsys):
+    out = mx.visualization.print_summary(_net(), shape={"data": (4, 16)})
+    assert "fc1" in out and "FullyConnected" in out
+    assert "(8, 16)" in out  # inferred weight shape shown
+
+
+def test_plot_network_dot(tmp_path):
+    g = mx.visualization.plot_network(_net(), title="mlp")
+    src = g.source
+    assert src.startswith('digraph "mlp"')
+    assert '"fc1" -> "act1"' in src
+    # weights folded away by default
+    assert "fc1_weight" not in src
+    g2 = mx.visualization.plot_network(_net(), hide_weights=False)
+    assert "fc1_weight" in g2.source
+    p = g.save(str(tmp_path / "net.dot"))
+    with open(p) as f:
+        assert f.read() == src
