@@ -16,11 +16,61 @@ from . import initializer as init_mod
 from .ndarray.ndarray import NDArray, zeros
 from .checkpoint import save_checkpoint, load_checkpoint
 
-__all__ = ["Module", "BaseModule", "BucketingModule"]
+__all__ = ["Module", "BaseModule", "BucketingModule",
+           "SequentialModule"]
 
 
 class BaseModule:
-    pass
+    """Shared train/eval driver (reference: module/base_module.py — the
+    generic fit/score loops live on the base, concrete modules provide
+    bind/init/forward/backward/update)."""
+
+    def update_metric(self, eval_metric, labels, pre_sliced=False):
+        eval_metric.update(labels, self.get_outputs())
+
+    def score(self, eval_data, eval_metric, num_batch=None, **kwargs):
+        eval_metric = metric_mod.create(eval_metric)
+        eval_metric.reset()
+        eval_data.reset()
+        for i, batch in enumerate(eval_data):
+            if num_batch is not None and i == num_batch:
+                break
+            self.forward(batch, is_train=False)
+            self.update_metric(eval_metric, batch.label)
+        return eval_metric.get_name_value()
+
+    def fit(self, train_data, eval_data=None, eval_metric="acc",
+            epoch_end_callback=None, batch_end_callback=None, kvstore="local",
+            optimizer="sgd", optimizer_params=None, initializer=None,
+            num_epoch=1, arg_params=None, aux_params=None,
+            begin_epoch=0, **kwargs):
+        if not self.binded:
+            self.bind([(d.name, d.shape) for d in train_data.provide_data],
+                      [(l.name, l.shape) for l in train_data.provide_label])
+        if not self.params_initialized:
+            self.init_params(initializer, arg_params, aux_params)
+        if not self.optimizer_initialized:
+            self.init_optimizer(kvstore, optimizer, optimizer_params)
+        eval_metric = metric_mod.create(eval_metric)
+        for epoch in range(begin_epoch, num_epoch):
+            eval_metric.reset()
+            train_data.reset()
+            for nbatch, batch in enumerate(train_data):
+                self.forward(batch, is_train=True)
+                self.backward()
+                self.update()
+                self.update_metric(eval_metric, batch.label)
+                if batch_end_callback:
+                    for cb in _as_list(batch_end_callback):
+                        cb(type("P", (), {"epoch": epoch, "nbatch": nbatch,
+                                          "eval_metric": eval_metric})())
+            if epoch_end_callback:
+                arg_p, aux_p = self.get_params()
+                for cb in _as_list(epoch_end_callback):
+                    cb(epoch, getattr(self, "_symbol", None), arg_p, aux_p)
+            if eval_data is not None:
+                self.score(eval_data, eval_metric)
+        return self
 
 
 class Module(BaseModule):
@@ -44,6 +94,7 @@ class Module(BaseModule):
 
     def bind(self, data_shapes, label_shapes=None, for_training=True,
              inputs_need_grad=False, force_rebind=False, grad_req="write"):
+        self._inputs_need_grad = inputs_need_grad
         shapes = {}
         for desc in data_shapes:
             name, shape = (desc.name, desc.shape) if hasattr(desc, "name") \
@@ -94,9 +145,12 @@ class Module(BaseModule):
                 key = rnd._next_key()
                 args[name] = NDArray(
                     initializer(name, shape, np.float32, key))
+        grad_names = set(self._param_names)
+        if getattr(self, "_inputs_need_grad", False):
+            grad_names.update(self._data_names)  # chained modules need dX
         grad_args = {name: zeros(a.shape, ctx=self._ctx)
                      for name, a in args.items()
-                     if name in self._param_names} \
+                     if name in grad_names} \
             if self._for_training else None
         # restored aux states pass through; anything missing is defaulted
         # by Executor.__init__ (moving_var=1, else 0)
@@ -142,6 +196,15 @@ class Module(BaseModule):
     def get_outputs(self, merge_multi_context=True):
         return self._exec.outputs
 
+    def get_input_grads(self, merge_multi_context=True):
+        """Gradients wrt the data inputs (requires bind(inputs_need_grad=
+        True); reference: Module.get_input_grads)."""
+        if not getattr(self, "_inputs_need_grad", False) \
+                or not self._for_training:
+            raise MXNetError("bind with for_training=True and "
+                             "inputs_need_grad=True to read input gradients")
+        return [self._exec.grad_dict[n] for n in self._data_names]
+
     def get_params(self):
         arg_params = {n: self._exec.arg_dict[n] for n in self._param_names}
         return arg_params, dict(self._exec.aux_dict)
@@ -155,20 +218,6 @@ class Module(BaseModule):
             if n in self._exec.aux_dict:
                 self._exec.aux_dict[n]._assign_value(v._data)
 
-    def update_metric(self, eval_metric, labels, pre_sliced=False):
-        eval_metric.update(labels, self.get_outputs())
-
-    def score(self, eval_data, eval_metric, num_batch=None, **kwargs):
-        eval_metric = metric_mod.create(eval_metric)
-        eval_metric.reset()
-        eval_data.reset()
-        for i, batch in enumerate(eval_data):
-            if num_batch is not None and i == num_batch:
-                break
-            self.forward(batch, is_train=False)
-            self.update_metric(eval_metric, batch.label)
-        return eval_metric.get_name_value()
-
     def predict(self, eval_data, num_batch=None, **kwargs):
         outs = []
         eval_data.reset()
@@ -179,39 +228,6 @@ class Module(BaseModule):
             outs.append(self.get_outputs()[0])
         from .ops.tensor_ops import concat
         return concat(*outs, dim=0) if len(outs) > 1 else outs[0]
-
-    def fit(self, train_data, eval_data=None, eval_metric="acc",
-            epoch_end_callback=None, batch_end_callback=None, kvstore="local",
-            optimizer="sgd", optimizer_params=None, initializer=None,
-            num_epoch=1, arg_params=None, aux_params=None,
-            begin_epoch=0, **kwargs):
-        if not self.binded:
-            self.bind([(d.name, d.shape) for d in train_data.provide_data],
-                      [(l.name, l.shape) for l in train_data.provide_label])
-        if not self.params_initialized:
-            self.init_params(initializer, arg_params, aux_params)
-        if not self.optimizer_initialized:
-            self.init_optimizer(kvstore, optimizer, optimizer_params)
-        eval_metric = metric_mod.create(eval_metric)
-        for epoch in range(begin_epoch, num_epoch):
-            eval_metric.reset()
-            train_data.reset()
-            for nbatch, batch in enumerate(train_data):
-                self.forward(batch, is_train=True)
-                self.backward()
-                self.update()
-                self.update_metric(eval_metric, batch.label)
-                if batch_end_callback:
-                    for cb in _as_list(batch_end_callback):
-                        cb(type("P", (), {"epoch": epoch, "nbatch": nbatch,
-                                          "eval_metric": eval_metric})())
-            if epoch_end_callback:
-                arg_p, aux_p = self.get_params()
-                for cb in _as_list(epoch_end_callback):
-                    cb(epoch, self._symbol, arg_p, aux_p)
-            if eval_data is not None:
-                self.score(eval_data, eval_metric)
-        return self
 
     def save_checkpoint(self, prefix, epoch, save_optimizer_states=False):
         arg_params, aux_params = self.get_params()
@@ -373,3 +389,112 @@ class BucketingModule(BaseModule):
 
     def update_metric(self, eval_metric, labels, pre_sliced=False):
         self._curr_module.update_metric(eval_metric, labels)
+
+
+class SequentialModule(BaseModule):
+    """Chain of Modules executed in order (reference:
+    python/mxnet/module/sequential_module.py).
+
+    Each added module consumes the previous module's outputs as its data.
+    By default only the LAST module receives labels (the reference's
+    META_TAKE_LABELS); pass take_labels=True to add() to override. All
+    modules after the first bind with inputs_need_grad=True so backward
+    chains output gradients through the whole stack.
+    """
+
+    def __init__(self, logger=logging, **kwargs):
+        self._modules = []
+        self._take_labels = []
+        self.binded = False
+        self.params_initialized = False
+        self.optimizer_initialized = False
+
+    def add(self, module, take_labels=False, **kwargs):
+        self._modules.append(module)
+        self._take_labels.append(take_labels)
+        return self
+
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False, grad_req="write"):
+        if not self._modules:
+            raise MXNetError("add modules before bind")
+        cur_shapes = [(d.name, d.shape) if hasattr(d, "name") else d
+                      for d in data_shapes]
+        label_shapes = [(l.name, l.shape) if hasattr(l, "name") else l
+                        for l in (label_shapes or [])]
+        for i, mod in enumerate(self._modules):
+            last = i == len(self._modules) - 1
+            takes = self._take_labels[i] or (last and not
+                                             any(self._take_labels))
+            mod.bind(cur_shapes, label_shapes if takes else None,
+                     for_training=for_training,
+                     inputs_need_grad=inputs_need_grad or i > 0,
+                     grad_req=grad_req)
+            if not last:
+                shapes = dict(cur_shapes)
+                if takes:
+                    shapes.update(dict(label_shapes))
+                _, out_shapes, _ = mod._symbol.infer_shape(
+                    **{k: v for k, v in shapes.items()
+                       if k in mod._symbol.list_arguments()})
+                if out_shapes is None:
+                    raise MXNetError(
+                        f"cannot infer output shapes of module {i}")
+                next_names = self._modules[i + 1]._data_names
+                if len(next_names) != len(out_shapes):
+                    raise MXNetError(
+                        f"module {i} produces {len(out_shapes)} outputs "
+                        f"but module {i + 1} declares "
+                        f"{len(next_names)} data inputs {next_names}")
+                cur_shapes = list(zip(next_names, out_shapes))
+        self.binded = True
+        return self
+
+    def init_params(self, initializer=None, arg_params=None, aux_params=None,
+                    **kwargs):
+        for mod in self._modules:
+            mod.init_params(initializer, arg_params, aux_params, **kwargs)
+        self.params_initialized = True
+        return self
+
+    def init_optimizer(self, kvstore="local", optimizer="sgd",
+                       optimizer_params=None, **kwargs):
+        for mod in self._modules:
+            mod.init_optimizer(kvstore, optimizer, optimizer_params)
+        self.optimizer_initialized = True
+
+    def forward(self, data_batch, is_train=None):
+        from .io import DataBatch
+        batch = data_batch
+        for i, mod in enumerate(self._modules):
+            mod.forward(batch, is_train=is_train)
+            if i < len(self._modules) - 1:
+                batch = DataBatch(data=mod.get_outputs(),
+                                  label=data_batch.label)
+
+    def backward(self, out_grads=None):
+        for i in range(len(self._modules) - 1, -1, -1):
+            self._modules[i].backward(out_grads)
+            out_grads = self._modules[i].get_input_grads() if i > 0 else None
+
+    def update(self):
+        for mod in self._modules:
+            mod.update()
+
+    def get_outputs(self, merge_multi_context=True):
+        return self._modules[-1].get_outputs()
+
+    def get_params(self):
+        arg_params, aux_params = {}, {}
+        for mod in self._modules:
+            a, x = mod.get_params()
+            arg_params.update(a)
+            aux_params.update(x)
+        return arg_params, aux_params
+
+    def set_params(self, arg_params, aux_params=None, **kwargs):
+        for mod in self._modules:
+            mod.set_params(arg_params, aux_params, **kwargs)
+
+    def get_input_grads(self, merge_multi_context=True):
+        return self._modules[0].get_input_grads()

@@ -148,3 +148,39 @@ def test_bucketing_module_default_key_routing():
     mod.forward(batch, is_train=False)
     assert mod._curr_bucket_key == 6
     assert mod.get_outputs()[0].shape == (4, 2)
+
+
+def test_sequential_module():
+    """SequentialModule chains modules; backward flows input grads between
+    them (reference: python/mxnet/module/sequential_module.py)."""
+    from mxnet_tpu.module import SequentialModule
+    rs = np.random.RandomState(3)
+    x = rs.randn(64, 6).astype(np.float32)
+    y = (x[:, 0] + x[:, 1] > 0).astype(np.float32)
+
+    with mx.name.NameManager():
+        d1 = sym.Variable("data")
+        feat = sym.Activation(sym.FullyConnected(d1, num_hidden=16,
+                                                 name="m1fc"),
+                              act_type="relu")
+        d2 = sym.Variable("mid")
+        out = sym.SoftmaxOutput(sym.FullyConnected(d2, num_hidden=2,
+                                                   name="m2fc"),
+                                sym.Variable("softmax_label"),
+                                name="softmax")
+    seq = SequentialModule()
+    seq.add(mx.mod.Module(feat, data_names=["data"], label_names=[]))
+    seq.add(mx.mod.Module(out, data_names=["mid"],
+                          label_names=["softmax_label"]))
+    it = mio.NDArrayIter(x, y, batch_size=16)
+    seq.fit(it, optimizer="adam",
+            optimizer_params={"learning_rate": 0.02}, num_epoch=10)
+
+    m = mx.metric.create("acc")
+    it.reset()
+    for batch in it:
+        seq.forward(batch, is_train=False)
+        seq.update_metric(m, batch.label)
+    assert m.get()[1] > 0.9, m.get()
+    arg_p, _ = seq.get_params()
+    assert "m1fc_weight" in arg_p and "m2fc_weight" in arg_p
