@@ -6,6 +6,7 @@ OpenCV); resize/crop/flip augmenters run through jax.image on device.
 from __future__ import annotations
 
 import io as _io
+import os
 
 import numpy as np
 
@@ -15,7 +16,7 @@ from .ndarray.ndarray import NDArray, array, _apply
 __all__ = ["imread", "imdecode", "imresize", "resize_short", "fixed_crop",
            "center_crop", "random_crop", "HorizontalFlipAug", "ResizeAug",
            "CenterCropAug", "RandomCropAug", "ColorNormalizeAug",
-           "CreateAugmenter", "Augmenter"]
+           "CreateAugmenter", "Augmenter", "ForceResizeAug", "ImageIter", "ImageDetIter"]
 
 
 def _finish_decode(arr, flag, to_rgb):
@@ -170,6 +171,19 @@ class ColorNormalizeAug(Augmenter):
         return (src.astype("float32") - self.mean) / self.std
 
 
+class ForceResizeAug(Augmenter):
+    """Resize to exactly (w, h), ignoring aspect ratio (reference:
+    image/detection.py ForceResizeAug) — normalised det boxes stay
+    valid under a full-image resize."""
+
+    def __init__(self, size, interp=1):
+        super().__init__(size=size)
+        self.size = size
+
+    def __call__(self, src):
+        return imresize(src, self.size[0], self.size[1])
+
+
 def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_mirror=False,
                     mean=None, std=None, **kwargs):
     """Build the reference's standard augmentation pipeline."""
@@ -191,3 +205,199 @@ def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_mirror=False,
         auglist.append(ColorNormalizeAug(mean, std if std is not None
                                          and std is not False else [1, 1, 1]))
     return auglist
+
+
+class ImageIter:
+    """Image iterator over a RecordIO file or an image list (reference:
+    python/mxnet/image.py ImageIter): decodes, runs the augmenter pipeline,
+    and yields NCHW float batches.
+
+    rec mode: path_imgrec (+ optional path_imgidx for shuffled access);
+    list mode: path_imglist (.lst: "index\\tlabel...\\tpath") + path_root.
+    A partial final batch raises StopIteration, like the reference.
+    """
+
+    def __init__(self, batch_size, data_shape, label_width=1,
+                 path_imgrec=None, path_imgidx=None, path_imglist=None,
+                 path_root=None, shuffle=False, aug_list=None,
+                 data_name="data", label_name="softmax_label", seed=0,
+                 **kwargs):
+        from .io import DataDesc
+        self.batch_size = batch_size
+        self.data_shape = tuple(data_shape)
+        self.label_width = label_width
+        self._shuffle = shuffle
+        self._rng = np.random.RandomState(seed)
+        self.auglist = aug_list if aug_list is not None else \
+            CreateAugmenter(self.data_shape)
+        self._record = None
+        self._list = None
+        if path_imgrec:
+            from . import recordio
+            if path_imgidx:
+                self._record = recordio.MXIndexedRecordIO(
+                    path_imgidx, path_imgrec, "r")
+                self._keys = list(self._record.keys)
+            else:
+                self._record = recordio.MXRecordIO(path_imgrec, "r")
+                self._keys = None
+                if shuffle:
+                    raise MXNetError("shuffle needs path_imgidx "
+                                     "(indexed record access)")
+        elif path_imglist:
+            self._list = []
+            with open(path_imglist) as f:
+                for line in f:
+                    parts = line.strip().split("\t")
+                    if len(parts) < 3:
+                        continue
+                    labels = np.array([float(v) for v in parts[1:-1]],
+                                      np.float32)
+                    self._list.append((labels, parts[-1]))
+            self._root = path_root or "."
+        else:
+            raise MXNetError("ImageIter needs path_imgrec or path_imglist")
+        self.provide_data = [DataDesc(data_name,
+                                      (batch_size,) + self.data_shape)]
+        lshape = (batch_size,) if label_width == 1 \
+            else (batch_size, label_width)
+        self.provide_label = [DataDesc(label_name, lshape)]
+        self.reset()
+
+    def reset(self):
+        self._cursor = 0
+        if self._record is not None and self._keys is None:
+            self._record.reset()
+        if self._shuffle:
+            if self._list is not None:
+                self._rng.shuffle(self._list)
+            else:
+                self._rng.shuffle(self._keys)
+
+    def _read_sample(self):
+        from . import recordio
+        if self._list is not None:
+            if self._cursor >= len(self._list):
+                return None
+            label, path = self._list[self._cursor]
+            self._cursor += 1
+            img = imread(os.path.join(self._root, path))
+            return label, img
+        if self._keys is not None:
+            if self._cursor >= len(self._keys):
+                return None
+            s = self._record.read_idx(self._keys[self._cursor])
+            self._cursor += 1
+        else:
+            s = self._record.read()
+            if s is None:
+                return None
+        header, img = recordio.unpack_img(s)
+        label = np.atleast_1d(np.asarray(header.label, np.float32))
+        return label, array(np.ascontiguousarray(img))
+
+    def _postprocess(self, label, img):
+        for aug in self.auglist:
+            img = aug(img)
+        arr = img.asnumpy() if hasattr(img, "asnumpy") else np.asarray(img)
+        return label, arr.astype(np.float32).transpose(2, 0, 1)  # HWC->CHW
+
+    def _convert_label(self, label):
+        out = np.zeros(self.label_width, np.float32)
+        vals = label[:self.label_width]
+        out[:len(vals)] = vals
+        return out
+
+    def _stack_labels(self, labels):
+        stacked = np.stack(labels)
+        return stacked[:, 0] if self.label_width == 1 else stacked
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        from .io import DataBatch
+        data = np.empty((self.batch_size,) + self.data_shape, np.float32)
+        labels = []
+        for i in range(self.batch_size):
+            sample = self._read_sample()
+            if sample is None:
+                raise StopIteration  # partial batch dropped (reference)
+            label, img = self._postprocess(*sample)
+            data[i] = img
+            labels.append(self._convert_label(label))
+        return DataBatch(data=[array(data)],
+                         label=[array(self._stack_labels(labels))])
+
+    next = __next__
+
+
+class ImageDetIter(ImageIter):
+    """Detection variant (reference: image/detection.py ImageDetIter):
+    labels are object lists in the reference det-record format
+    [header_width, object_width, (extra header...), obj0..objN-1 fields],
+    padded with -1 rows to the iterator-wide max object count.
+
+    Geometry: boxes are normalised [0,1] coordinates, which are invariant
+    under full-image resize — so the default pipeline is a plain resize to
+    data_shape, never a crop/flip. Geometry-changing augmenters are
+    rejected because this iterator does not transform labels (the
+    reference ships DetAugmenters that move boxes with the pixels; pass
+    label-preserving augmenters only)."""
+
+    _GEOMETRIC_AUGS = None  # set after class body (needs the classes)
+
+    def __init__(self, batch_size, data_shape, path_imgrec=None,
+                 max_objects=8, object_width=5, aug_list=None, **kwargs):
+        self._max_objects = max_objects
+        self._object_width = object_width
+        if aug_list is None:
+            h, w = data_shape[1], data_shape[2]
+            aug_list = [ForceResizeAug((w, h))]
+        else:
+            bad = [a for a in aug_list
+                   if isinstance(a, ImageDetIter._GEOMETRIC_AUGS)]
+            if bad:
+                raise MXNetError(
+                    f"ImageDetIter cannot apply geometry-changing "
+                    f"augmenters {[type(a).__name__ for a in bad]}: boxes "
+                    f"would no longer match the pixels. Use label-"
+                    f"preserving augmenters (color, ForceResizeAug)")
+        super().__init__(batch_size, data_shape, label_width=1,
+                         path_imgrec=path_imgrec, aug_list=aug_list,
+                         **kwargs)
+        from .io import DataDesc
+        self.provide_label = [DataDesc(
+            self.provide_label[0].name,
+            (batch_size, max_objects, object_width))]
+
+    def _convert_label(self, flat):
+        flat = np.asarray(flat, np.float32).ravel()
+        if flat.size < 2:
+            raise MXNetError(f"det record label too short ({flat.size} "
+                             "floats): expected [header_width, "
+                             "object_width, objects...]")
+        hw, ow = int(flat[0]), int(flat[1])
+        if hw < 2 or ow < 1:
+            raise MXNetError(f"malformed det label header "
+                             f"(header_width={hw}, object_width={ow})")
+        if ow < self._object_width:
+            raise MXNetError(
+                f"record object_width {ow} < iterator object_width "
+                f"{self._object_width}")
+        body = flat[hw:]
+        n = body.size // ow
+        objs = body[:n * ow].reshape(n, ow)[:, :self._object_width]
+        out = np.full((self._max_objects, self._object_width), -1.0,
+                      np.float32)
+        out[:min(n, self._max_objects)] = objs[:self._max_objects]
+        return out
+
+    def _stack_labels(self, labels):
+        return np.stack(labels)
+
+
+# crops/flips move pixels without moving boxes; ImageDetIter
+# rejects them (see its docstring)
+ImageDetIter._GEOMETRIC_AUGS = (ResizeAug, CenterCropAug,
+                               RandomCropAug, HorizontalFlipAug)

@@ -58,3 +58,100 @@ def test_crops_and_augmenters():
     for a in augs:
         out = a(out)
     assert out.shape[-1] == 3 or out.shape[0] == 3
+
+
+def _make_rec(tmp_path, n=10, det=False):
+    """Write a tiny .rec/.idx with solid-color images."""
+    from mxnet_tpu import recordio
+    rec = str(tmp_path / "data.rec")
+    idx = str(tmp_path / "data.idx")
+    w = recordio.MXIndexedRecordIO(idx, rec, "w")
+    for i in range(n):
+        img = np.full((40, 32, 3), i * 20 % 255, np.uint8)
+        if det:
+            # det format: [header_width=2, object_width=5, cls,x0,y0,x1,y1]
+            label = [2, 5, float(i % 3), 0.1, 0.1, 0.5, 0.6]
+            header = recordio.IRHeader(len(label), label, i, 0)
+        else:
+            header = recordio.IRHeader(0, float(i % 4), i, 0)
+        w.write_idx(i, recordio.pack_img(header, img, img_fmt=".png"))
+    w.close()
+    return rec, idx
+
+
+def test_image_iter_rec(tmp_path):
+    rec, idx = _make_rec(tmp_path, n=10)
+    it = mx.image.ImageIter(batch_size=4, data_shape=(3, 24, 24),
+                            path_imgrec=rec, path_imgidx=idx,
+                            shuffle=True, seed=1)
+    assert it.provide_data[0].shape == (4, 3, 24, 24)
+    batches = list(it)
+    assert len(batches) == 2  # 10 // 4, partial dropped
+    b = batches[0]
+    assert b.data[0].shape == (4, 3, 24, 24)
+    assert b.label[0].shape == (4,)
+    # epoch 2 after reset
+    it.reset()
+    assert len(list(it)) == 2
+
+
+def test_image_iter_list(tmp_path):
+    from PIL import Image
+    paths = []
+    for i in range(6):
+        p = tmp_path / f"im{i}.png"
+        Image.fromarray(np.full((30, 30, 3), i * 30, np.uint8)).save(p)
+        paths.append(p.name)
+    lst = tmp_path / "data.lst"
+    with open(lst, "w") as f:
+        for i, p in enumerate(paths):
+            f.write(f"{i}\t{i % 2}\t{p}\n")
+    it = mx.image.ImageIter(batch_size=3, data_shape=(3, 16, 16),
+                            path_imglist=str(lst),
+                            path_root=str(tmp_path))
+    b = next(it)
+    assert b.data[0].shape == (3, 3, 16, 16)
+    np.testing.assert_allclose(b.label[0].asnumpy(), [0, 1, 0])
+
+
+def test_image_det_iter(tmp_path):
+    rec, idx = _make_rec(tmp_path, n=8, det=True)
+    it = mx.image.ImageDetIter(batch_size=4, data_shape=(3, 24, 24),
+                               path_imgrec=rec, path_imgidx=idx,
+                               max_objects=3)
+    b = next(it)
+    assert b.label[0].shape == (4, 3, 5)
+    lab = b.label[0].asnumpy()
+    np.testing.assert_allclose(lab[0, 0], [0.0, 0.1, 0.1, 0.5, 0.6],
+                               rtol=1e-6)
+    assert (lab[:, 1:] == -1).all()  # padding rows
+
+
+def test_image_det_iter_rejects_geometric_augs(tmp_path):
+    rec, idx = _make_rec(tmp_path, n=4, det=True)
+    with pytest.raises(mx.base.MXNetError):
+        mx.image.ImageDetIter(batch_size=2, data_shape=(3, 24, 24),
+                              path_imgrec=rec, path_imgidx=idx,
+                              aug_list=[mx.image.RandomCropAug((24, 24))])
+    # label-preserving augmenters are fine
+    it = mx.image.ImageDetIter(
+        batch_size=2, data_shape=(3, 24, 24), path_imgrec=rec,
+        path_imgidx=idx,
+        aug_list=[mx.image.ForceResizeAug((24, 24)),
+                  mx.image.ColorNormalizeAug([128] * 3, [64] * 3)])
+    assert next(it).data[0].shape == (2, 3, 24, 24)
+
+
+def test_image_det_iter_malformed_labels(tmp_path):
+    from mxnet_tpu import recordio
+    rec = str(tmp_path / "bad.rec")
+    idx = str(tmp_path / "bad.idx")
+    w = recordio.MXIndexedRecordIO(idx, rec, "w")
+    img = np.zeros((20, 20, 3), np.uint8)
+    w.write_idx(0, recordio.pack_img(
+        recordio.IRHeader(0, 1.0, 0, 0), img, img_fmt=".png"))  # cls label
+    w.close()
+    it = mx.image.ImageDetIter(batch_size=1, data_shape=(3, 16, 16),
+                               path_imgrec=rec, path_imgidx=idx)
+    with pytest.raises(mx.base.MXNetError):
+        next(it)
