@@ -44,6 +44,7 @@ from . import symbol
 from . import symbol as sym
 from . import module
 from . import module as mod
+from . import rnn
 from . import operator
 from . import name
 from . import test_utils

@@ -1,0 +1,116 @@
+"""mx.rnn — bucketing IO for variable-length sequence training
+(reference: python/mxnet/rnn/io.py).
+
+BucketSentenceIter sorts sentences into length buckets and yields padded
+batches tagged with `bucket_key`, the routing key BucketingModule uses to
+pick the per-bucket compiled Executor. On TPU a bucket IS a compile-cache
+entry (XLA needs static shapes), so bucketing is the idiomatic
+variable-length strategy — a handful of executables instead of one per
+length.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .base import MXNetError
+from .io import DataBatch, DataDesc
+
+__all__ = ["BucketSentenceIter"]
+
+
+class BucketSentenceIter:
+    """Bucketed language-model iterator: for each sentence the label is the
+    input shifted left by one (next-token prediction), padded with
+    `invalid_label` to the bucket length.
+
+    sentences: list of lists of int token ids.
+    buckets: sorted bucket lengths; defaults to the lengths present.
+    """
+
+    def __init__(self, sentences, batch_size, buckets=None,
+                 invalid_label=-1, data_name="data",
+                 label_name="softmax_label", dtype="float32",
+                 layout="NT", seed=0):
+        if buckets is None:
+            lengths = {len(s) for s in sentences if len(s) >= 2}
+            buckets = sorted(lengths)
+        self.buckets = sorted(buckets)
+        if not self.buckets:
+            raise MXNetError("no buckets (need sentences of length >= 2)")
+        self.batch_size = batch_size
+        self.invalid_label = invalid_label
+        self.data_name = data_name
+        self.label_name = label_name
+        if layout not in ("NT", "TN"):
+            raise MXNetError(f"layout must be NT or TN, got {layout!r}")
+        self._layout = layout
+        self._dtype = np.dtype(dtype)
+        self._rng = np.random.RandomState(seed)
+
+        self._data = [[] for _ in self.buckets]
+        skipped = 0
+        for s in sentences:
+            idx = np.searchsorted(self.buckets, len(s))
+            if idx == len(self.buckets) or len(s) < 2:
+                skipped += 1  # longer than the largest bucket, or trivial
+                continue
+            buf = np.full(self.buckets[idx], invalid_label, np.int32)
+            buf[:len(s)] = s
+            self._data[idx].append(buf)
+        self.skipped = skipped
+        self._data = [np.asarray(b, np.int32).reshape(-1, blen)
+                      for b, blen in zip(self._data, self.buckets)]
+        self.default_bucket_key = max(self.buckets)
+        self.reset()
+
+    def _shape(self, blen):
+        return ((self.batch_size, blen) if self._layout == "NT"
+                else (blen, self.batch_size))
+
+    # providers describe the DEFAULT bucket (reference behaviour); each
+    # DataBatch carries its own bucket-sized descs
+    @property
+    def provide_data(self):
+        return [DataDesc(self.data_name,
+                         self._shape(self.default_bucket_key))]
+
+    @property
+    def provide_label(self):
+        return [DataDesc(self.label_name,
+                         self._shape(self.default_bucket_key))]
+
+    def reset(self):
+        self._plan = []  # (bucket_idx, start) per batch
+        for i, arr in enumerate(self._data):
+            if len(arr) == 0:
+                continue
+            order = self._rng.permutation(len(arr))
+            self._data[i] = arr[order]
+            for start in range(0, len(arr) - self.batch_size + 1,
+                               self.batch_size):
+                self._plan.append((i, start))
+        self._rng.shuffle(self._plan)
+        self._cursor = 0
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        from .ndarray.ndarray import array
+        if self._cursor >= len(self._plan):
+            raise StopIteration
+        bidx, start = self._plan[self._cursor]
+        self._cursor += 1
+        blen = self.buckets[bidx]
+        chunk = self._data[bidx][start:start + self.batch_size]
+        data = chunk.astype(self._dtype)
+        label = np.full_like(data, self.invalid_label)
+        label[:, :-1] = data[:, 1:]  # next-token target
+        if self._layout == "TN":
+            data, label = data.T, label.T
+        return DataBatch(
+            data=[array(data)], label=[array(label)], bucket_key=blen,
+            provide_data=[DataDesc(self.data_name, self._shape(blen))],
+            provide_label=[DataDesc(self.label_name, self._shape(blen))])
+
+    next = __next__

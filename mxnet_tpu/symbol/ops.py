@@ -11,6 +11,7 @@ import jax.numpy as jnp
 
 import numpy as _np
 
+from ..base import MXNetError
 from ..ops import nn_ops as K
 from .symbol import (Symbol, _make, register_aux_slots, register_op,
                      register_shape_rule, register_train_op)
@@ -143,28 +144,55 @@ register_op("Embedding", lambda i, w, input_dim=None, output_dim=None:
             K.embedding(i, w))
 
 
-@jax.custom_vjp
-def _softmax_output(x, label):
+import functools as _functools
+
+
+@_functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5))
+def _softmax_output_op(x, label, use_ignore, ignore_label, normalization,
+                       grad_scale):
     return jax.nn.softmax(x, axis=-1)
 
 
-def _so_fwd(x, label):
+def _so_fwd(x, label, use_ignore, ignore_label, normalization, grad_scale):
     p = jax.nn.softmax(x, axis=-1)
     return p, (p, label)
 
 
-def _so_bwd(res, g):
-    # loss head (reference: softmax_output-inl.h): the incoming cotangent is
-    # ignored; grad wrt logits is p - onehot(label)
+def _so_bwd(use_ignore, ignore_label, normalization, grad_scale, res, g):
+    """Loss-head backward (reference: src/operator/softmax_output-inl.h):
+    the cotangent is ignored; grad = (p - onehot(label)) * grad_scale,
+    with ignore_label rows zeroed when use_ignore (padding positions —
+    essential for bucketed LM training), 'valid' dividing by the
+    non-ignored label count and 'batch' by the leading dim."""
     p, label = res
-    oh = jax.nn.one_hot(label.astype(jnp.int32), p.shape[-1], dtype=p.dtype)
-    return (p - oh, jnp.zeros(label.shape, label.dtype))
+    ilab = label.astype(jnp.int32)
+    oh = jax.nn.one_hot(ilab, p.shape[-1], dtype=p.dtype)
+    grad = (p - oh) * grad_scale
+    if use_ignore:
+        keep = (ilab != int(ignore_label)).astype(p.dtype)
+        grad = grad * keep[..., None]
+        valid_cnt = jnp.maximum(keep.sum(), 1.0)
+    else:
+        valid_cnt = float(int(_np.prod(label.shape)))
+    if normalization == "valid":
+        grad = grad / valid_cnt
+    elif normalization == "batch":
+        grad = grad / p.shape[0]
+    return (grad, jnp.zeros(label.shape, label.dtype))
 
 
-_softmax_output.defvjp(_so_fwd, _so_bwd)
-register_op("SoftmaxOutput",
-            lambda x, *l: _softmax_output(x, l[0]) if l
-            else jax.nn.softmax(x, axis=-1))
+_softmax_output_op.defvjp(_so_fwd, _so_bwd)
+
+
+def _softmax_output_eval(x, *l, use_ignore=False, ignore_label=-1,
+                         normalization="null", grad_scale=1.0):
+    if not l:
+        return jax.nn.softmax(x, axis=-1)
+    return _softmax_output_op(x, l[0], bool(use_ignore), int(ignore_label),
+                              normalization, float(grad_scale))
+
+
+register_op("SoftmaxOutput", _softmax_output_eval)
 
 
 def _regression_output(link, grad_fn):
@@ -336,9 +364,17 @@ def Embedding(data, weight=None, input_dim=None, output_dim=None, name=None,
                  name=name, input_names=["data", "weight"])
 
 
-def SoftmaxOutput(data, label=None, name=None, **kwargs):
+def SoftmaxOutput(data, label=None, use_ignore=False, ignore_label=-1,
+                  normalization="null", grad_scale=1.0, name=None,
+                  **kwargs):
+    if normalization not in ("null", "valid", "batch"):
+        raise MXNetError(f"SoftmaxOutput normalization must be "
+                         f"null/valid/batch, got {normalization!r}")
     ins = [data] if label is None else [data, label]
-    return _make("SoftmaxOutput", ins, {}, name=name)
+    return _make("SoftmaxOutput", ins,
+                 {"use_ignore": use_ignore, "ignore_label": ignore_label,
+                  "normalization": normalization,
+                  "grad_scale": grad_scale}, name=name)
 
 
 def LinearRegressionOutput(data, label=None, grad_scale=1.0, name=None,

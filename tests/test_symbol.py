@@ -272,3 +272,29 @@ def test_symbolic_dropout_train_vs_inference():
     np.testing.assert_allclose(kept, 2.0, rtol=1e-6)  # inverted scaling
     out_tr2 = ex.forward(is_train=True)[0].asnumpy()
     assert not np.array_equal(out_tr, out_tr2)  # fresh key per step
+
+
+def test_softmax_output_use_ignore():
+    """SoftmaxOutput(use_ignore=True) zeroes gradients at ignore_label
+    positions (reference: softmax_output-inl.h). Without it, padded
+    positions emit grad=p and silently corrupt training (found by the
+    bucketed-LM end-to-end drive)."""
+    x = sym.Variable("x")
+    y = sym.Variable("y")
+    out = sym.SoftmaxOutput(x, y, use_ignore=True, ignore_label=-1)
+    xv = nd.array(np.random.RandomState(0).randn(4, 3).astype(np.float32))
+    yv = nd.array(np.array([0, 2, -1, -1], np.float32))
+    grads = {"x": nd.zeros((4, 3)), "y": nd.zeros((4,))}
+    ex = out.bind(None, {"x": xv, "y": yv}, grads)
+    ex.forward(is_train=True)
+    ex.backward()
+    g = grads["x"].asnumpy()
+    assert np.abs(g[:2]).sum() > 0        # real rows got p - onehot
+    np.testing.assert_allclose(g[2:], 0.0)  # ignored rows zeroed
+    # default (no ignore): padded rows DO get gradients — reference parity
+    out2 = sym.SoftmaxOutput(x, y)
+    ex2 = out2.bind(None, {"x": xv, "y": yv},
+                    {"x": nd.zeros((4, 3)), "y": nd.zeros((4,))})
+    ex2.forward(is_train=True)
+    ex2.backward()
+    assert np.abs(ex2.grad_dict["x"].asnumpy()[2:]).sum() > 0
