@@ -395,7 +395,12 @@ class HybridBlock(Block):
     def _forward_symbolic(self, *args):
         from .. import symbol as F
         params = {k: p.var() for k, p in self._reg_params.items()}
-        return self.hybrid_forward(F, *args, **params)
+        try:
+            return self.hybrid_forward(F, *args, **params)
+        except NotImplementedError:
+            # containers (HybridSequential/Concurrent) route through
+            # forward(); their children symbolically trace themselves
+            return self.forward(*args)
 
     # -- compiled path -----------------------------------------------------
     def _call_cached(self, *args):
@@ -456,14 +461,40 @@ class HybridBlock(Block):
                        *[p.data()._data for p in params])
         return jax.jit(pure), meta
 
-    def export(self, path, epoch=0):
-        """Save params (+ a model description). The reference exports
-        symbol.json + params; here the compiled artifact is the XLA
-        executable, so we export parameters and an architecture repr."""
-        self.save_parameters(f"{path}-{epoch:04d}.params.npz")
-        with open(f"{path}-symbol.json", "w") as f:
-            import json
-            json.dump({"framework": "mxnet_tpu", "repr": repr(self)}, f)
+    def export(self, path, epoch=0, num_inputs=1):
+        """Export `path-symbol.json` + `path-{epoch:04d}.params.npz`
+        (reference: HybridBlock.export). The graph is re-traced
+        symbolically, so the artifact reloads with `SymbolBlock.imports`
+        and runs as one jitted Executor. Blocks whose layers have no
+        symbolic trace fall back to params + an architecture repr."""
+        import json
+        from .. import symbol as sym_mod
+        data = [sym_mod.Variable("data" if i == 0 else f"data{i}")
+                for i in range(num_inputs)]
+        try:
+            out = self(*data)
+        except Exception as e:  # non-symbolic layer in the graph
+            import warnings
+            warnings.warn(
+                f"{type(self).__name__}.export: no symbolic trace "
+                f"({type(e).__name__}: {e}); writing params + repr only — "
+                f"NOT loadable by SymbolBlock.imports")
+            self.save_parameters(f"{path}-{epoch:04d}.params.npz")
+            with open(f"{path}-symbol.json", "w") as f:
+                json.dump({"framework": "mxnet_tpu", "repr": repr(self)}, f)
+            return
+        if isinstance(out, (list, tuple)):
+            out = sym_mod.Group(list(out))
+        out.save(f"{path}-symbol.json")
+        # params keyed by their GLOBAL names — the symbol's argument names
+        # (reference export format: arg:/aux: checkpoint-style prefixes)
+        aux_names = set(out.list_auxiliary_states())
+        arrays = {
+            ("aux:" if p.name in aux_names else "arg:") + p.name:
+                p.data().asnumpy()
+            for p in self.collect_params().values() if p._data is not None}
+        with open(f"{path}-{epoch:04d}.params.npz", "wb") as f:
+            np.savez(f, **arrays)
 
     def hybrid_forward(self, F, *args, **kwargs):
         raise NotImplementedError
@@ -530,6 +561,46 @@ class SymbolBlock(HybridBlock):
         for p in self.collect_params().values():
             bindings[p.name] = p.data()
         return self._outputs.eval_with(bindings)
+
+    @staticmethod
+    def imports(symbol_file, input_names, param_file=None, ctx=None):
+        """Load an exported model (reference: SymbolBlock.imports):
+        symbol.json from `HybridBlock.export`/`Symbol.save` plus its
+        params file; returns a ready-to-run SymbolBlock."""
+        import json as _json
+        from .. import symbol as sym_mod
+        from ..ndarray.ndarray import NDArray
+        from .parameter import Parameter
+        with open(symbol_file) as f:
+            blob = _json.load(f)
+        if "nodes" not in blob:  # HybridBlock.export's non-symbolic fallback
+            raise MXNetError(
+                f"{symbol_file} is a repr-only export (the source block "
+                "had no symbolic trace); re-export a symbolically "
+                "traceable net or reload via load_parameters")
+        out = sym_mod.load_json(_json.dumps(blob))
+        input_names = _as_list(input_names)
+        inputs = [sym_mod.Variable(n) for n in input_names]
+        params = {}
+        if param_file:
+            with np.load(param_file) as f:
+                for k in f.keys():
+                    name = k.split(":", 1)[1] if ":" in k else k
+                    p = Parameter(name, shape=f[k].shape)
+                    p.set_data(NDArray(f[k]))
+                    params[name] = p
+            missing = [a for a in (out.list_arguments()
+                                   + out.list_auxiliary_states())
+                       if a not in params and a not in input_names]
+            if missing:
+                raise MXNetError(f"params file missing arguments {missing}")
+        else:
+            # no params file: create uninitialized Parameters (reference
+            # behaviour); callers initialize() or set_data() before use
+            for a in out.list_arguments() + out.list_auxiliary_states():
+                if a not in input_names:
+                    params[a] = Parameter(a)
+        return SymbolBlock(out, inputs, params=params)
 
     def hybrid_forward(self, F, *args, **kwargs):
         raise MXNetError("SymbolBlock executes its symbol graph directly")

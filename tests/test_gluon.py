@@ -233,3 +233,56 @@ def test_extract_pure_fn_training_aux():
     fn_eval, params = extract_pure_fn(net, x)
     y = fn_eval(params, x._data)
     assert y.shape == (16, 4)
+
+
+def test_export_imports_roundtrip(tmp_path):
+    """HybridBlock.export writes a real symbol.json + checkpoint-style
+    params that SymbolBlock.imports reloads to identical outputs
+    (reference: the export/imports deployment pair)."""
+    from mxnet_tpu.gluon import SymbolBlock
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu"), nn.Dense(4))
+    net.initialize()
+    x = nd.random.uniform(shape=(2, 8))
+    expect = net(x).asnumpy()
+
+    path = str(tmp_path / "model")
+    net.export(path, epoch=3)
+    import os
+    assert os.path.exists(path + "-symbol.json")
+    assert os.path.exists(path + "-0003.params.npz")
+
+    loaded = SymbolBlock.imports(path + "-symbol.json", ["data"],
+                                 path + "-0003.params.npz")
+    got = loaded(x).asnumpy()
+    np.testing.assert_allclose(got, expect, rtol=1e-5, atol=1e-6)
+
+
+def test_imports_fallback_and_no_params(tmp_path):
+    """Non-symbolic exports warn and are rejected by imports with a clear
+    error; imports without a params file yields uninitialized Parameters
+    (round-2 review findings)."""
+    import warnings
+    from mxnet_tpu.gluon import SymbolBlock
+    # BatchNorm has no symbolic trace -> fallback artifact
+    net = nn.HybridSequential()
+    net.add(nn.Dense(4), nn.BatchNorm())
+    net.initialize()
+    net(nd.ones((2, 3)))
+    path = str(tmp_path / "bnnet")
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        net.export(path)
+    assert any("no symbolic trace" in str(x.message) for x in w)
+    with pytest.raises(mx.base.MXNetError):
+        SymbolBlock.imports(path + "-symbol.json", ["data"])
+
+    # symbolic net, no params file: uninitialized Parameters exist
+    net2 = nn.HybridSequential()
+    net2.add(nn.Dense(4))
+    net2.initialize()
+    net2(nd.ones((2, 3)))
+    p2 = str(tmp_path / "ok")
+    net2.export(p2)
+    blk = SymbolBlock.imports(p2 + "-symbol.json", ["data"])
+    assert len(blk.collect_params()) == 2  # weight+bias, no data
