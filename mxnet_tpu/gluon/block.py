@@ -132,6 +132,14 @@ def _run_traced(block, params, param_vals, arg_vals, training, rng):
         autograd.set_training(prev_train)
 
 
+def is_symbolic(x):
+    """True when a hybrid_forward input is a Symbol (symbolic trace /
+    export path) rather than an NDArray — layers branch on this to emit
+    graph nodes instead of eager kernels."""
+    from ..symbol.symbol import Symbol
+    return isinstance(x, Symbol)
+
+
 def _layer_rng():
     """Per-op RNG key: trace-aware (functional input) or global chain."""
     ctx = _TraceContext.active()
@@ -493,6 +501,15 @@ class HybridBlock(Block):
             ("aux:" if p.name in aux_names else "arg:") + p.name:
                 p.data().asnumpy()
             for p in self.collect_params().values() if p._data is not None}
+        input_names = {d.name for d in data}
+        unmaterialized = [
+            a for a in out.list_arguments() + out.list_auxiliary_states()
+            if a not in input_names
+            and f"arg:{a}" not in arrays and f"aux:{a}" not in arrays]
+        if unmaterialized:
+            raise MXNetError(
+                f"export: parameters {unmaterialized} have no data "
+                "(deferred init) — run one forward pass before export")
         with open(f"{path}-{epoch:04d}.params.npz", "wb") as f:
             np.savez(f, **arrays)
 
@@ -581,12 +598,16 @@ class SymbolBlock(HybridBlock):
         out = sym_mod.load_json(_json.dumps(blob))
         input_names = _as_list(input_names)
         inputs = [sym_mod.Variable(n) for n in input_names]
+        # aux states (BN running stats) must not be optimized
+        aux_names = set(out.list_auxiliary_states())
         params = {}
         if param_file:
             with np.load(param_file) as f:
                 for k in f.keys():
                     name = k.split(":", 1)[1] if ":" in k else k
-                    p = Parameter(name, shape=f[k].shape)
+                    p = Parameter(name, shape=f[k].shape,
+                                  grad_req="null" if name in aux_names
+                                  else "write")
                     p.set_data(NDArray(f[k]))
                     params[name] = p
             missing = [a for a in (out.list_arguments()
@@ -599,7 +620,8 @@ class SymbolBlock(HybridBlock):
             # behaviour); callers initialize() or set_data() before use
             for a in out.list_arguments() + out.list_auxiliary_states():
                 if a not in input_names:
-                    params[a] = Parameter(a)
+                    params[a] = Parameter(
+                        a, grad_req="null" if a in aux_names else "write")
         return SymbolBlock(out, inputs, params=params)
 
     def hybrid_forward(self, F, *args, **kwargs):

@@ -8,7 +8,8 @@ from ... import autograd
 from ...base import MXNetError
 from ...ndarray.ndarray import NDArray, _apply
 from ...ops import nn_ops as K
-from ..block import Block, HybridBlock, _layer_rng, _report_aux_update
+from ..block import (Block, HybridBlock, _layer_rng, _report_aux_update,
+                     is_symbolic)
 
 __all__ = ["Sequential", "HybridSequential", "Dense", "Dropout", "Flatten",
            "Lambda", "HybridLambda", "Embedding", "BatchNorm", "LayerNorm",
@@ -230,6 +231,13 @@ class BatchNorm(HybridBlock):
         return super().cast(dtype)
 
     def hybrid_forward(self, F, x, gamma, beta, running_mean, running_var):
+        if is_symbolic(x):
+            # symbolic trace (export path): aux-state updates are handled
+            # by the Executor's train registry, not the gluon tape
+            return F.BatchNorm(x, gamma, beta, running_mean, running_var,
+                               eps=self._epsilon, momentum=self._momentum,
+                               axis=self._axis, fix_gamma=not self._scale,
+                               use_global_stats=self._use_global_stats)
         training = autograd.is_training() and not self._use_global_stats
         outs = _apply(
             lambda a, g, b, mm, mv, _e=self._epsilon, _m=self._momentum,
@@ -270,7 +278,7 @@ class LayerNorm(HybridBlock):
         self.beta._finish_deferred_init((c,))
 
     def hybrid_forward(self, F, x, gamma, beta):
-        if self._axis in (-1, x.ndim - 1):
+        if not is_symbolic(x) and self._axis in (-1, x.ndim - 1):
             # fused fast path (Pallas on TPU)
             from ...ops.pallas_kernels import fused_layer_norm
 

@@ -12,7 +12,7 @@ import numpy as np
 
 from ...ndarray.ndarray import _apply
 from ...ops import nn_ops as K
-from ..block import HybridBlock
+from ..block import HybridBlock, is_symbolic
 
 __all__ = ["Conv1D", "Conv2D", "Conv3D", "Conv1DTranspose", "Conv2DTranspose",
            "Conv3DTranspose", "MaxPool1D", "MaxPool2D", "MaxPool3D",
@@ -169,6 +169,12 @@ class _Pooling(HybridBlock):
         self._count_include_pad = count_include_pad
 
     def hybrid_forward(self, F, x):
+        if is_symbolic(x):
+            return F.Pooling(x, kernel=self._kernel,
+                             pool_type=self._pool_type,
+                             stride=self._strides, pad=self._padding,
+                             layout=self._layout,
+                             count_include_pad=self._count_include_pad)
         return _apply(lambda a, _k=self._kernel, _pt=self._pool_type,
                       _s=self._strides, _p=self._padding, _l=self._layout,
                       _c=self._count_include_pad:
@@ -217,8 +223,13 @@ class _GlobalPool(HybridBlock):
         self._keep = keep_dims
 
     def hybrid_forward(self, F, x):
-        out = _apply(lambda a, _pt=self._pool_type, _l=self._layout:
-                     K.global_pooling(a, _pt, _l), [x])
+        if is_symbolic(x):
+            out = F.Pooling(x, global_pool=True,
+                            pool_type=self._pool_type, layout=self._layout)
+            return out if self._keep else F.flatten(out)
+        out = _apply(lambda a, _pt=self._pool_type, _l=self._layout,
+                     _keep=self._keep:
+                     K.global_pooling(a, _pt, _l, keepdims=_keep), [x])
         return out
 
 

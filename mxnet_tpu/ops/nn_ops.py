@@ -344,12 +344,12 @@ def pooling(x, kernel, pool_type="max", stride=None, pad=0, layout=None,
     return s / cnt
 
 
-def global_pooling(x, pool_type="avg", layout="NCHW"):
+def global_pooling(x, pool_type="avg", layout="NCHW", keepdims=True):
     c_axis = layout.index("C")
     axes = tuple(i for i in range(x.ndim) if i not in (0, c_axis))
     if pool_type == "max":
-        return jnp.max(x, axis=axes, keepdims=True)
-    return jnp.mean(x, axis=axes, keepdims=True)
+        return jnp.max(x, axis=axes, keepdims=keepdims)
+    return jnp.mean(x, axis=axes, keepdims=keepdims)
 
 
 _ACTS = {

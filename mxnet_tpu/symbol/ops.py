@@ -124,9 +124,10 @@ register_op("LayerNorm", lambda x, g, b, axis=-1, eps=1e-5:
             K.layer_norm(x, g, b, axis, eps))
 register_op("Pooling",
             lambda x, kernel=None, pool_type="max", stride=None, pad=0,
-            global_pool=False, layout=None:
+            global_pool=False, layout=None, count_include_pad=True:
             K.global_pooling(x, pool_type, layout or "NCHW") if global_pool
-            else K.pooling(x, kernel, pool_type, stride, pad, layout))
+            else K.pooling(x, kernel, pool_type, stride, pad, layout,
+                           count_include_pad))
 register_op("Dropout", lambda x, p=0.5: x)  # inference: identity
 
 
@@ -346,10 +347,12 @@ def LayerNorm(data, gamma=None, beta=None, axis=-1, eps=1e-5, name=None,
 
 
 def Pooling(data, kernel=None, pool_type="max", stride=None, pad=0,
-            global_pool=False, layout=None, name=None, **kwargs):
+            global_pool=False, layout=None, count_include_pad=True,
+            name=None, **kwargs):
     return _make("Pooling", [data],
                  {"kernel": kernel, "pool_type": pool_type, "stride": stride,
-                  "pad": pad, "global_pool": global_pool, "layout": layout},
+                  "pad": pad, "global_pool": global_pool, "layout": layout,
+                  "count_include_pad": count_include_pad},
                  name=name)
 
 

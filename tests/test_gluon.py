@@ -264,9 +264,9 @@ def test_imports_fallback_and_no_params(tmp_path):
     (round-2 review findings)."""
     import warnings
     from mxnet_tpu.gluon import SymbolBlock
-    # BatchNorm has no symbolic trace -> fallback artifact
+    # a Lambda over raw NDArray ops has no symbolic trace -> fallback
     net = nn.HybridSequential()
-    net.add(nn.Dense(4), nn.BatchNorm())
+    net.add(nn.Dense(4), nn.Lambda(lambda x: x * x.sigmoid()))
     net.initialize()
     net(nd.ones((2, 3)))
     path = str(tmp_path / "bnnet")
@@ -286,3 +286,27 @@ def test_imports_fallback_and_no_params(tmp_path):
     net2.export(p2)
     blk = SymbolBlock.imports(p2 + "-symbol.json", ["data"])
     assert len(blk.collect_params()) == 2  # weight+bias, no data
+
+
+def test_export_imports_resnet(tmp_path):
+    """Model-zoo nets (conv/BN/pool) export to a real symbol.json with aux
+    states and reload to identical outputs — the full deployment path."""
+    from mxnet_tpu.gluon import SymbolBlock
+    from mxnet_tpu.gluon.model_zoo.vision import resnet18_v1
+    net = resnet18_v1()
+    net.initialize()
+    x = nd.random.uniform(shape=(1, 3, 64, 64))
+    expect = net(x).asnumpy()
+
+    path = str(tmp_path / "resnet18")
+    net.export(path)
+    loaded = SymbolBlock.imports(path + "-symbol.json", ["data"],
+                                 path + "-0000.params.npz")
+    got = loaded(x).asnumpy()
+    np.testing.assert_allclose(got, expect, rtol=2e-3, atol=2e-4)
+    # aux states (BN running stats) rode the aux: prefix
+    import numpy as _np
+    with _np.load(path + "-0000.params.npz") as f:
+        keys = list(f.keys())
+    assert any(k.startswith("aux:") for k in keys)
+    assert any(k.startswith("arg:") for k in keys)

@@ -242,3 +242,12 @@ def test_batchnorm_large_mean_no_nan():
     y, mean, var = _bn_train(x, g, b, jnp.zeros(x.shape[3]), 3, 1e-5)
     assert np.isfinite(np.asarray(y)).all()
     assert (np.asarray(var) >= 0).all()
+
+
+def test_global_pool_keep_dims():
+    """keep_dims=False squeezes spatial dims (round-2 review finding)."""
+    from mxnet_tpu.gluon import nn
+    x = mx.nd.random.uniform(shape=(2, 5, 4, 4))
+    assert nn.GlobalAvgPool2D()(x).shape == (2, 5, 1, 1)
+    assert nn.GlobalAvgPool2D(keep_dims=False)(x).shape == (2, 5)
+    assert nn.GlobalMaxPool2D(keep_dims=False)(x).shape == (2, 5)
