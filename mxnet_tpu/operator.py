@@ -101,22 +101,32 @@ def get(op_type):
     return _registry[op_type]
 
 
-def Custom(*inputs, op_type=None, **prop_kwargs):
-    """Invoke a registered custom op on NDArrays (reference:
-    mx.nd.Custom(..., op_type=...))."""
-    from .ndarray.ndarray import NDArray
-    from . import autograd
-    from .context import current_context
-
+def _prop_for(op_type, prop_kwargs, n_inputs):
+    """Instantiate the registered prop and check input arity (shared by
+    nd.Custom, sym.Custom and the graph-eval path)."""
     if op_type is None:
         raise MXNetError("Custom requires op_type=")
     prop = get(op_type)(**prop_kwargs)
     n_in = len(prop.list_arguments())
-    n_out = len(prop.list_outputs())
-    if len(inputs) != n_in:
+    if n_inputs != n_in:
         raise MXNetError(f"{op_type} expects {n_in} inputs, got "
-                         f"{len(inputs)}")
-    in_shapes = [tuple(x.shape) for x in inputs]
+                         f"{n_inputs}")
+    return prop
+
+
+def _build_custom_fn(op_type, prop_kwargs, in_shapes, train=False):
+    """Package a registered CustomOp as one `jax.custom_vjp` pure function
+    over raw arrays (shared by the imperative mx.nd.Custom and the
+    symbolic sym.Custom node). `train` is the is_train flag forwarded to
+    CustomOp.forward (captured by the CALLER before any autograd.pause).
+    Returns (custom_fn, n_in, n_out)."""
+    from .ndarray.ndarray import NDArray
+    from . import autograd
+    from .context import current_context
+
+    prop = _prop_for(op_type, prop_kwargs, len(in_shapes))
+    n_in = len(prop.list_arguments())
+    n_out = len(prop.list_outputs())
     shapes = prop.infer_shape(list(in_shapes))
     out_shapes = list(shapes[1])
     op = prop.create_operator(current_context(), in_shapes, None)
@@ -127,8 +137,7 @@ def Custom(*inputs, op_type=None, **prop_kwargs):
             ins = [NDArray(r) for r in raw]
             outs = [NDArray(jnp.zeros(s, ins[0].dtype if ins else None))
                     for s in out_shapes]
-            op.forward(autograd.is_training(), ["write"] * n_out, ins,
-                       outs, [])
+            op.forward(train, ["write"] * n_out, ins, outs, [])
         return tuple(o._data for o in outs)
 
     @jax.custom_vjp
@@ -155,6 +164,18 @@ def Custom(*inputs, op_type=None, **prop_kwargs):
         return tuple(ig._data for ig in in_grads)
 
     custom_fn.defvjp(custom_fwd, custom_bwd)
+    return custom_fn, n_in, n_out
+
+
+def Custom(*inputs, op_type=None, **prop_kwargs):
+    """Invoke a registered custom op on NDArrays (reference:
+    mx.nd.Custom(..., op_type=...))."""
+    from .ndarray.ndarray import NDArray
+    from . import autograd
+
+    in_shapes = [tuple(x.shape) for x in inputs]
+    custom_fn, _, n_out = _build_custom_fn(
+        op_type, prop_kwargs, in_shapes, train=autograd.is_training())
 
     raw = [x._data for x in inputs]
     out = custom_fn(*raw)

@@ -21,7 +21,7 @@ __all__ = ["FullyConnected", "Convolution", "StemConvS2D", "Activation",
            "LayerNorm", "Pooling", "Dropout", "Embedding", "softmax",
            "log_softmax", "SoftmaxOutput", "LinearRegressionOutput",
            "MAERegressionOutput", "LogisticRegressionOutput",
-           "flatten", "Flatten", "reshape",
+           "flatten", "Flatten", "reshape", "Custom",
            "transpose", "concat", "Concat", "dot", "batch_dot", "sum", "mean",
            "max", "min", "relu", "sigmoid", "tanh", "exp", "log", "sqrt",
            "square", "negative", "zeros", "ones", "broadcast_add",
@@ -502,3 +502,49 @@ def zeros(shape, dtype=None, name=None, **kwargs):
 def ones(shape, dtype=None, name=None, **kwargs):
     return _make("ones", [], {"shape": tuple(shape), "dtype": dtype},
                  name=name)
+
+
+# -- custom ops in symbol graphs (reference: mx.sym.Custom / custom.cc) -----
+def _custom_eval(*args, _train=False, op_type=None, **prop_kwargs):
+    from ..operator import _build_custom_fn
+    in_shapes = [tuple(a.shape) for a in args]
+    fn, _, _ = _build_custom_fn(op_type, prop_kwargs, in_shapes,
+                                train=_train)
+    return fn(*args)
+
+
+register_op("_custom", _custom_eval)
+register_train_op(
+    "_custom",
+    lambda *args, _rng=None, **kw: (_custom_eval(*args, _train=True, **kw),
+                                    {}))
+
+
+def _custom_shapes(ins, attrs):
+    """Let CustomOpProp.infer_shape fill unknown input shapes (reference:
+    custom-op shape inference completes weight shapes). The prop receives
+    the partially-known list (None for unknowns) and returns the
+    completed input shapes as its first element."""
+    from ..operator import get as _get_custom
+    kw = {k: v for k, v in attrs.items() if k != "op_type"}
+    try:
+        filled = _get_custom(attrs["op_type"])(**kw).infer_shape(list(ins))
+        return list(filled[0])
+    except Exception:
+        return ins  # prop cannot handle partial shapes: leave unknown
+
+
+register_shape_rule("_custom", _custom_shapes)
+
+
+def Custom(*inputs, op_type=None, name=None, **prop_kwargs):
+    """Place a registered CustomOp in a symbol graph (reference:
+    mx.sym.Custom). Shapes/arity come from the registered CustomOpProp;
+    attrs are plain JSON values, so the graph round-trips through
+    symbol.json (the op must be registered again at load time, like the
+    reference)."""
+    from ..operator import _prop_for
+    prop = _prop_for(op_type, prop_kwargs, len(inputs))
+    return _make("_custom", list(inputs),
+                 {"op_type": op_type, **prop_kwargs}, name=name,
+                 n_out=len(prop.list_outputs()))
