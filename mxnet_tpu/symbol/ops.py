@@ -17,7 +17,7 @@ from .symbol import (Symbol, _make, register_aux_slots, register_op,
                      register_shape_rule, register_train_op)
 
 __all__ = ["FullyConnected", "Convolution", "StemConvS2D", "Activation",
-           "BatchNorm",
+           "BatchNorm", "Deconvolution",
            "LayerNorm", "Pooling", "Dropout", "Embedding", "softmax",
            "log_softmax", "SoftmaxOutput", "LinearRegressionOutput",
            "MAERegressionOutput", "LogisticRegressionOutput",
@@ -91,6 +91,11 @@ register_op("Convolution",
             num_filter=None, num_group=1, no_bias=False, layout=None:
             K.convolution(x, w, b[0] if b else None, stride, pad, dilate,
                           num_group, layout))
+register_op("Deconvolution",
+            lambda x, w, *b, kernel=None, stride=1, pad=0, adj=0,
+            num_filter=None, no_bias=False, layout=None:
+            K.deconvolution(x, w, b[0] if b else None, stride, pad, adj,
+                            layout))
 register_op("StemConvS2D",
             lambda x, w, num_filter=None: K.stem_conv_s2d(x, w))
 register_op("Activation", lambda x, act_type="relu": K.activation(x, act_type))
@@ -249,20 +254,29 @@ def _fc_shapes(ins, attrs):
     return out
 
 
-def _conv_shapes(ins, attrs):
+def _convlike_shapes(ins, attrs, weight_shape):
+    """Shared data->weight/bias fill for conv-family ops;
+    weight_shape(num_filter, in_c, groups, kernel, channel_first)."""
     data = ins[0]
     if data is None:
         return ins
-    layout = attrs.get("layout") or {3: "NCW", 4: "NCHW", 5: "NCDHW"}[len(data)]
+    layout = attrs.get("layout") or {3: "NCW", 4: "NCHW",
+                                     5: "NCDHW"}[len(data)]
     c = data[layout.index("C")]
     k = attrs.get("kernel")
     k = (k,) * (len(data) - 2) if isinstance(k, int) else tuple(k)
     nf, g = attrs.get("num_filter"), attrs.get("num_group", 1)
-    w = (nf, c // g) + k if layout.index("C") == 1 else (nf,) + k + (c // g,)
-    out = [data, w]
+    out = [data, weight_shape(nf, c, g, k, layout.index("C") == 1)]
     if len(ins) == 3:
         out.append((nf,))
     return out
+
+
+def _conv_shapes(ins, attrs):
+    return _convlike_shapes(
+        ins, attrs,
+        lambda nf, c, g, k, cf: (nf, c // g) + k if cf
+        else (nf,) + k + (c // g,))
 
 
 def _norm_shapes(ins, attrs):
@@ -285,7 +299,15 @@ def _embed_shapes(ins, attrs):
 
 
 register_shape_rule("FullyConnected", _fc_shapes)
+def _deconv_shapes(ins, attrs):
+    # transposed conv weight is (I, O/g, *k) in every layout (the rhs
+    # spec is "IO"+spatial — see K.deconvolution)
+    return _convlike_shapes(
+        ins, attrs, lambda nf, c, g, k, cf: (c, nf // g) + k)
+
+
 register_shape_rule("Convolution", _conv_shapes)
+register_shape_rule("Deconvolution", _deconv_shapes)
 register_shape_rule("StemConvS2D",
                     lambda ins, attrs: ins if ins[0] is None
                     else [ins[0], (attrs["num_filter"], 7, 7, ins[0][3])])
@@ -307,6 +329,17 @@ def FullyConnected(data, weight=None, bias=None, num_hidden=None,
 def StemConvS2D(data, weight=None, num_filter=None, name=None, **kwargs):
     return _make("StemConvS2D", [data, weight], {"num_filter": num_filter},
                  name=name, input_names=["data", "weight"])
+
+
+def Deconvolution(data, weight=None, bias=None, kernel=None, stride=1,
+                  pad=0, adj=0, num_filter=None, no_bias=False, layout=None,
+                  name=None, **kwargs):
+    ins = [data, weight] + ([] if no_bias else [bias])
+    return _make("Deconvolution", ins,
+                 {"kernel": kernel, "stride": stride, "pad": pad,
+                  "adj": adj, "num_filter": num_filter, "no_bias": no_bias,
+                  "layout": layout}, name=name,
+                 input_names=["data", "weight", "bias"])
 
 
 def Convolution(data, weight=None, bias=None, kernel=None, stride=1, pad=0,
@@ -524,13 +557,15 @@ def _custom_shapes(ins, attrs):
     """Let CustomOpProp.infer_shape fill unknown input shapes (reference:
     custom-op shape inference completes weight shapes). The prop receives
     the partially-known list (None for unknowns) and returns the
-    completed input shapes as its first element."""
+    completed input shapes as its first element. An unregistered op_type
+    propagates (loading a graph requires re-registering its custom ops);
+    only a prop that cannot handle partial shapes falls back."""
     from ..operator import get as _get_custom
     kw = {k: v for k, v in attrs.items() if k != "op_type"}
+    prop = _get_custom(attrs["op_type"])(**kw)  # raises if unregistered
     try:
-        filled = _get_custom(attrs["op_type"])(**kw).infer_shape(list(ins))
-        return list(filled[0])
-    except Exception:
+        return list(prop.infer_shape(list(ins))[0])
+    except (TypeError, ValueError, AttributeError, IndexError):
         return ins  # prop cannot handle partial shapes: leave unknown
 
 

@@ -215,3 +215,25 @@ def test_custom_op_train_flag_and_multi_output_roundtrip():
     o1, o2 = ex2.forward()
     np.testing.assert_allclose(o1.asnumpy(), [2, 2, 2])
     np.testing.assert_allclose(o2.asnumpy(), [2, 2, 2])
+
+
+def test_multi_output_custom_direct_bind():
+    """Binding a multi-output custom node DIRECTLY yields all outputs
+    (round-2 review finding: index-0-only truncation)."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd, sym
+    try:
+        mx.operator.get("flagged2")
+    except mx.base.MXNetError:
+        test_custom_op_train_flag_and_multi_output_roundtrip()
+    node = sym.Custom(sym.Variable("x"), op_type="flagged2", name="direct")
+    assert len(node.list_outputs()) == 2
+    ex = node.bind(None, {"x": nd.ones((3,))})
+    outs = ex.forward()
+    assert len(outs) == 2
+    np.testing.assert_allclose(outs[0].asnumpy(), [2, 2, 2])
+    np.testing.assert_allclose(outs[1].asnumpy(), [2, 2, 2])
+    _, out_shapes, _ = node.infer_shape(x=(3,))
+    assert out_shapes == [(3,), (3,)]
+    loaded = sym.load_json(node.tojson())
+    assert len(loaded.bind(None, {"x": nd.ones((3,))}).forward()) == 2

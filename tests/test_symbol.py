@@ -298,3 +298,28 @@ def test_softmax_output_use_ignore():
     ex2.forward(is_train=True)
     ex2.backward()
     assert np.abs(ex2.grad_dict["x"].asnumpy()[2:]).sum() > 0
+
+
+def test_deconvolution_symbol_and_transpose_layer_trace():
+    """sym.Deconvolution matches the nd kernel, and Conv2DTranspose layers
+    trace symbolically (export path for decoder/GAN nets)."""
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.ops import nn_ops as K
+    rs = np.random.RandomState(0)
+    x = rs.randn(2, 3, 5, 5).astype(np.float32)
+    w = rs.randn(3, 4, 3, 3).astype(np.float32)
+    out = sym.Deconvolution(sym.Variable("x"), sym.Variable("w"),
+                            kernel=3, stride=2, num_filter=4, no_bias=True)
+    ex = out.bind(None, {"x": nd.array(x), "w": nd.array(w)})
+    got = ex.forward()[0].asnumpy()
+    expect = np.asarray(K.deconvolution(x, w, None, 2, 0, 0, None))
+    np.testing.assert_allclose(got, expect, rtol=1e-5, atol=1e-5)
+    _, out_shapes, _ = out.infer_shape(x=(2, 3, 5, 5))
+    assert out_shapes == [got.shape]
+
+    blk = nn.Conv2DTranspose(6, 3, strides=2)
+    blk.initialize()
+    blk(nd.array(x))
+    traced = blk(sym.Variable("data"))
+    _, shapes, _ = traced.infer_shape(data=(2, 3, 5, 5))
+    assert shapes[0][1] == 6  # channels out
