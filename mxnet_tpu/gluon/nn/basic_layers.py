@@ -313,8 +313,7 @@ class InstanceNorm(HybridBlock):
         self.beta._finish_deferred_init((c,))
 
     def hybrid_forward(self, F, x, gamma, beta):
-        return _apply(lambda a, g, b, _e=self._epsilon:
-                      K.instance_norm(a, g, b, _e), [x, gamma, beta])
+        return F.InstanceNorm(x, gamma, beta, eps=self._epsilon)
 
 
 class GroupNorm(HybridBlock):
@@ -340,8 +339,8 @@ class GroupNorm(HybridBlock):
         self.beta._finish_deferred_init((c,))
 
     def hybrid_forward(self, F, x, gamma, beta):
-        return _apply(lambda a, g, b, _n=self._num_groups, _e=self._epsilon:
-                      K.group_norm(a, g, b, _n, _e), [x, gamma, beta])
+        return F.GroupNorm(x, gamma, beta, num_groups=self._num_groups,
+                           eps=self._epsilon)
 
 
 class Activation(HybridBlock):
@@ -378,9 +377,7 @@ class PReLU(HybridBlock):
                 init=alpha_initializer or init_mod.Constant(0.25))
 
     def hybrid_forward(self, F, x, alpha):
-        return _apply(lambda a, al: jnp.where(
-            a >= 0, a, al.reshape((1, -1) + (1,) * (a.ndim - 2)) * a
-            if a.ndim > 1 else al * a), [x, alpha])
+        return F.PReLU(x, alpha)
 
 
 class ELU(HybridBlock):

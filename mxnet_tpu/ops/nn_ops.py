@@ -27,6 +27,7 @@ __all__ = [
     "activation", "leaky_relu", "dropout", "embedding", "softmax",
     "log_softmax", "softmax_cross_entropy", "rnn_step",
     "FullyConnected", "Convolution", "Deconvolution", "BatchNorm", "LayerNorm",
+    "InstanceNorm", "GroupNorm", "PReLU",
     "Pooling", "Activation", "LeakyReLU", "Dropout", "Embedding",
     "SoftmaxOutput",
     "softmax_nd", "log_softmax_nd", "relu", "sigmoid", "gelu", "silu",
@@ -484,6 +485,28 @@ def BatchNorm(data, gamma, beta, moving_mean, moving_var, eps=1e-5,
 def LayerNorm(data, gamma, beta, axis=-1, eps=1e-5, **kwargs):
     return _apply(lambda x, g, b, _ax=axis, _e=eps: layer_norm(x, g, b, _ax, _e),
                   [data, gamma, beta])
+
+
+def prelu(x, alpha):
+    """PReLU with shared or per-channel alpha (reference: leaky_relu-inl.h
+    act_type='prelu')."""
+    if x.ndim > 1:
+        alpha = alpha.reshape((1, -1) + (1,) * (x.ndim - 2))
+    return jnp.where(x >= 0, x, alpha * x)
+
+
+def InstanceNorm(data, gamma, beta, eps=1e-5, **kwargs):
+    return _apply(lambda x, g, b, _e=eps: instance_norm(x, g, b, _e),
+                  [data, gamma, beta])
+
+
+def GroupNorm(data, gamma, beta, num_groups=1, eps=1e-5, **kwargs):
+    return _apply(lambda x, g, b, _n=num_groups, _e=eps:
+                  group_norm(x, g, b, _n, _e), [data, gamma, beta])
+
+
+def PReLU(data, alpha, **kwargs):
+    return _apply(prelu, [data, alpha])
 
 
 def Pooling(data, kernel=None, pool_type="max", stride=None, pad=0,

@@ -17,7 +17,7 @@ from .symbol import (Symbol, _make, register_aux_slots, register_op,
                      register_shape_rule, register_train_op)
 
 __all__ = ["FullyConnected", "Convolution", "StemConvS2D", "Activation",
-           "BatchNorm", "Deconvolution",
+           "BatchNorm", "Deconvolution", "InstanceNorm", "GroupNorm", "PReLU",
            "LayerNorm", "Pooling", "Dropout", "Embedding", "softmax",
            "log_softmax", "SoftmaxOutput", "LinearRegressionOutput",
            "MAERegressionOutput", "LogisticRegressionOutput",
@@ -127,6 +127,11 @@ register_train_op("BatchNorm", _bn_train_variant)
 register_aux_slots("BatchNorm", {3: "zeros", 4: "ones"})  # mean, var
 register_op("LayerNorm", lambda x, g, b, axis=-1, eps=1e-5:
             K.layer_norm(x, g, b, axis, eps))
+register_op("InstanceNorm", lambda x, g, b, eps=1e-5:
+            K.instance_norm(x, g, b, eps))
+register_op("GroupNorm", lambda x, g, b, num_groups=1, eps=1e-5:
+            K.group_norm(x, g, b, num_groups, eps))
+register_op("PReLU", K.prelu)
 register_op("Pooling",
             lambda x, kernel=None, pool_type="max", stride=None, pad=0,
             global_pool=False, layout=None, count_include_pad=True:
@@ -313,6 +318,19 @@ register_shape_rule("StemConvS2D",
                     else [ins[0], (attrs["num_filter"], 7, 7, ins[0][3])])
 register_shape_rule("BatchNorm", _norm_shapes)
 register_shape_rule("LayerNorm", _ln_shapes)
+
+
+def _chan1_shapes(ins, attrs):
+    data = ins[0]
+    if data is None:
+        return ins
+    c = data[1] if len(data) > 1 else data[0]
+    return [data] + [(c,)] * (len(ins) - 1)
+
+
+register_shape_rule("InstanceNorm", _chan1_shapes)
+register_shape_rule("GroupNorm", _chan1_shapes)
+register_shape_rule("PReLU", _chan1_shapes)
 register_shape_rule("Embedding", _embed_shapes)
 
 
@@ -377,6 +395,23 @@ def LayerNorm(data, gamma=None, beta=None, axis=-1, eps=1e-5, name=None,
     return _make("LayerNorm", [data, gamma, beta],
                  {"axis": axis, "eps": eps}, name=name,
                  input_names=["data", "gamma", "beta"])
+
+
+def InstanceNorm(data, gamma=None, beta=None, eps=1e-5, name=None, **kwargs):
+    return _make("InstanceNorm", [data, gamma, beta], {"eps": eps},
+                 name=name, input_names=["data", "gamma", "beta"])
+
+
+def GroupNorm(data, gamma=None, beta=None, num_groups=1, eps=1e-5,
+              name=None, **kwargs):
+    return _make("GroupNorm", [data, gamma, beta],
+                 {"num_groups": num_groups, "eps": eps}, name=name,
+                 input_names=["data", "gamma", "beta"])
+
+
+def PReLU(data, alpha=None, name=None, **kwargs):
+    return _make("PReLU", [data, alpha], {}, name=name,
+                 input_names=["data", "alpha"])
 
 
 def Pooling(data, kernel=None, pool_type="max", stride=None, pad=0,

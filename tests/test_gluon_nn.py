@@ -251,3 +251,25 @@ def test_global_pool_keep_dims():
     assert nn.GlobalAvgPool2D()(x).shape == (2, 5, 1, 1)
     assert nn.GlobalAvgPool2D(keep_dims=False)(x).shape == (2, 5)
     assert nn.GlobalMaxPool2D(keep_dims=False)(x).shape == (2, 5)
+
+
+def test_norm_and_prelu_layers_trace_symbolically():
+    """InstanceNorm/GroupNorm/PReLU emit symbol nodes matching their eager
+    kernels (completes gluon layer export coverage)."""
+    from mxnet_tpu import sym
+    from mxnet_tpu.gluon import nn
+    rs = np.random.RandomState(0)
+    x_np = rs.randn(2, 6, 5, 5).astype(np.float32)
+    for blk in (nn.InstanceNorm(), nn.GroupNorm(num_groups=3), nn.PReLU()):
+        blk.initialize()
+        x = mx.nd.array(x_np if not isinstance(blk, nn.PReLU)
+                        else x_np[:, :1])
+        expect = blk(x).asnumpy()
+        traced = blk(sym.Variable("data"))
+        bindings = {"data": x}
+        for p in blk.collect_params().values():
+            bindings[p.name] = p.data()
+        got = traced.eval_with(bindings).asnumpy()
+        np.testing.assert_allclose(got, expect, rtol=1e-5, atol=1e-5)
+        _, out_shapes, _ = traced.infer_shape(data=x.shape)
+        assert out_shapes == [x.shape]
