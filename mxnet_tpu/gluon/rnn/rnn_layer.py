@@ -52,6 +52,51 @@ def _scan_layer(mode, x, init_states, wi, wh, bi, bh, reverse=False):
     return outs, final
 
 
+def rnn_forward(mode, num_layers, num_dir, layout_ntc, pnames,
+                xv, svals, pvseq, dropout=0.0, rng=None):
+    """Pure multi-layer (bi)RNN forward over raw arrays: the single kernel
+    behind both the eager layer and the symbolic "RNN" op. Inter-layer
+    dropout (reference rnn-inl.h semantics: between stacked layers, not
+    after the last) applies only when an `rng` key is given — training
+    paths thread one, inference paths pass None. Returns
+    (outputs, stacked_h[, stacked_c])."""
+    import jax
+    L, D = num_layers, num_dir
+    pv = dict(zip(pnames, pvseq))
+    seq = jnp.swapaxes(xv, 0, 1) if layout_ntc else xv  # (T,N,I)
+    hs = [svals[0][i] for i in range(L * D)]
+    cs = [svals[1][i] for i in range(L * D)] if mode == "lstm" else None
+    out = seq
+    final_h, final_c = [], []
+    for layer in range(L):
+        layer_outs = []
+        for d, sfx in zip(range(D), ["l", "r"]):
+            idx = layer * D + d
+            init = (hs[idx], cs[idx]) if mode == "lstm" else (hs[idx],)
+            o, fin = _scan_layer(
+                mode, out, init,
+                pv[f"{sfx}{layer}_i2h_weight"],
+                pv[f"{sfx}{layer}_h2h_weight"],
+                pv[f"{sfx}{layer}_i2h_bias"],
+                pv[f"{sfx}{layer}_h2h_bias"],
+                reverse=(d == 1))
+            layer_outs.append(o)
+            final_h.append(fin[0])
+            if mode == "lstm":
+                final_c.append(fin[1])
+        out = layer_outs[0] if D == 1 else \
+            jnp.concatenate(layer_outs, axis=-1)
+        if dropout and rng is not None and layer < L - 1:
+            keep = jax.random.bernoulli(
+                jax.random.fold_in(rng, layer), 1 - dropout, out.shape)
+            out = jnp.where(keep, out / (1 - dropout), 0).astype(out.dtype)
+    outs = jnp.swapaxes(out, 0, 1) if layout_ntc else out
+    ret = [outs, jnp.stack(final_h)]
+    if mode == "lstm":
+        ret.append(jnp.stack(final_c))
+    return tuple(ret)
+
+
 class _RNNLayer(HybridBlock):
     def __init__(self, mode, hidden_size, num_layers=1, layout="TNC",
                  dropout=0.0, bidirectional=False, input_size=0,
@@ -114,49 +159,40 @@ class _RNNLayer(HybridBlock):
             states = tuple(states[0])
         has_states = len(states) > 0
         ns = 2 if self._mode == "lstm" else 1
+        pnames = sorted(params.keys())
+        pvals = [params[k] for k in pnames]
+        mode, L, D = self._mode, self._num_layers, self._dir
+
+        from ..block import is_symbolic
+        if is_symbolic(x):
+            # zero initial states are synthesised inside the RNN op at
+            # bind time (batch size is unknown while tracing)
+            node = F.RNN(x, *(list(states) + pvals if has_states
+                              else pvals), mode=mode,
+                         num_layers=L, num_dir=D,
+                         hidden_size=self._hidden_size,
+                         layout_ntc=layout_ntc, pnames=tuple(pnames),
+                         state_outputs=has_states,
+                         dropout=self._dropout)
+            if not has_states:
+                return node[0]
+            return node[0], [node[i] for i in range(1, 1 + ns)]
+
         if not has_states:
             batch = x.shape[0] if layout_ntc else x.shape[1]
             states = self.begin_state(batch, dtype=x.dtype)
         state_inputs = list(states)
 
-        pnames = sorted(params.keys())
-        pvals = [params[k] for k in pnames]
-        mode, L, D, H = self._mode, self._num_layers, self._dir, self._hidden_size
-        dropout = self._dropout
         from ... import autograd
-        training = autograd.is_training()
+        from ..block import _layer_rng
+        key = _layer_rng() if (self._dropout and autograd.is_training()) \
+            else None
 
-        def fn(xv, *rest, _pn=tuple(pnames)):
-            svals = rest[:ns]
-            pv = dict(zip(_pn, rest[ns:]))
-            seq = jnp.swapaxes(xv, 0, 1) if layout_ntc else xv  # (T,N,I)
-            hs = [svals[0][i] for i in range(L * D)]
-            cs = [svals[1][i] for i in range(L * D)] if mode == "lstm" else None
-            out = seq
-            final_h, final_c = [], []
-            for layer in range(L):
-                layer_outs = []
-                for d, sfx in zip(range(D), ["l", "r"]):
-                    idx = layer * D + d
-                    init = (hs[idx], cs[idx]) if mode == "lstm" else (hs[idx],)
-                    o, fin = _scan_layer(
-                        mode, out, init,
-                        pv[f"{sfx}{layer}_i2h_weight"],
-                        pv[f"{sfx}{layer}_h2h_weight"],
-                        pv[f"{sfx}{layer}_i2h_bias"],
-                        pv[f"{sfx}{layer}_h2h_bias"],
-                        reverse=(d == 1))
-                    layer_outs.append(o)
-                    final_h.append(fin[0])
-                    if mode == "lstm":
-                        final_c.append(fin[1])
-                out = layer_outs[0] if D == 1 else \
-                    jnp.concatenate(layer_outs, axis=-1)
-            outs = jnp.swapaxes(out, 0, 1) if layout_ntc else out
-            ret = [outs, jnp.stack(final_h)]
-            if mode == "lstm":
-                ret.append(jnp.stack(final_c))
-            return tuple(ret)
+        def fn(xv, *rest, _pn=tuple(pnames), _m=mode, _L=L, _D=D,
+               _ln=layout_ntc, _ns=ns, _dp=self._dropout, _k=key):
+            return rnn_forward(_m, _L, _D, _ln, _pn,
+                               xv, rest[:_ns], rest[_ns:],
+                               dropout=_dp, rng=_k)
 
         flat = _apply(fn, [x] + state_inputs + pvals, n_out=2 + (ns - 1))
         out = flat[0]

@@ -21,7 +21,7 @@ __all__ = ["FullyConnected", "Convolution", "StemConvS2D", "Activation",
            "LayerNorm", "Pooling", "Dropout", "Embedding", "softmax",
            "log_softmax", "SoftmaxOutput", "LinearRegressionOutput",
            "MAERegressionOutput", "LogisticRegressionOutput",
-           "flatten", "Flatten", "reshape", "Custom",
+           "flatten", "Flatten", "reshape", "Custom", "RNN",
            "transpose", "concat", "Concat", "dot", "batch_dot", "sum", "mean",
            "max", "min", "relu", "sigmoid", "tanh", "exp", "log", "sqrt",
            "square", "negative", "zeros", "ones", "broadcast_add",
@@ -618,3 +618,66 @@ def Custom(*inputs, op_type=None, name=None, **prop_kwargs):
     return _make("_custom", list(inputs),
                  {"op_type": op_type, **prop_kwargs}, name=name,
                  n_out=len(prop.list_outputs()))
+
+
+# -- fused RNN layers as one symbol node (reference: sym.RNN / rnn-inl.h) ---
+def _rnn_eval(x, *rest, mode="lstm", num_layers=1, num_dir=1,
+              hidden_size=0, layout_ntc=False, pnames=(),
+              state_outputs=False, dropout=0.0, _rng=None):
+    from ..gluon.rnn.rnn_layer import rnn_forward
+    ns = 2 if mode == "lstm" else 1
+    if state_outputs:
+        svals, pvals = rest[:ns], rest[ns:]
+    else:
+        batch = x.shape[0] if layout_ntc else x.shape[1]
+        zero = jnp.zeros((num_layers * num_dir, batch, hidden_size),
+                         x.dtype)
+        svals, pvals = (zero,) * ns, rest
+    return rnn_forward(mode, num_layers, num_dir, layout_ntc, pnames,
+                       x, svals, pvals, dropout=dropout, rng=_rng)
+
+
+register_op("RNN", _rnn_eval)
+# training: inter-layer dropout keyed off the Executor's step rng
+register_train_op("RNN", lambda *a, _rng=None, **kw:
+                  (_rnn_eval(*a, _rng=_rng, **kw), {}))
+
+
+def _rnn_shapes(ins, attrs):
+    data = ins[0]
+    if data is None:
+        return ins
+    mode = attrs.get("mode", "lstm")
+    L, D = attrs.get("num_layers", 1), attrs.get("num_dir", 1)
+    H = attrs.get("hidden_size")
+    g = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}[mode]
+    ns = (2 if mode == "lstm" else 1) if attrs.get("state_outputs") else 0
+    batch = data[0] if attrs.get("layout_ntc") else data[1]
+    in_size = data[-1]
+    out = [data] + [(L * D, batch, H)] * ns
+    for name in attrs.get("pnames", ()):
+        layer = int(name.split("_")[0][1:])
+        if name.endswith("i2h_weight"):
+            out.append((g * H, in_size if layer == 0 else H * D))
+        elif name.endswith("h2h_weight"):
+            out.append((g * H, H))
+        else:
+            out.append((g * H,))
+    return out
+
+
+register_shape_rule("RNN", _rnn_shapes)
+
+
+def RNN(data, *state_and_params, mode="lstm", num_layers=1, num_dir=1,
+        hidden_size=0, layout_ntc=False, pnames=(), state_outputs=False,
+        dropout=0.0, name=None):
+    """Fused multi-layer (bi)RNN node (reference: mx.sym.RNN): one lax.scan
+    stack per layer/direction compiled inside the Executor's program."""
+    ns = (2 if mode == "lstm" else 1)
+    return _make("RNN", [data] + list(state_and_params),
+                 {"mode": mode, "num_layers": num_layers,
+                  "num_dir": num_dir, "hidden_size": hidden_size,
+                  "layout_ntc": layout_ntc, "pnames": tuple(pnames),
+                  "state_outputs": state_outputs, "dropout": dropout},
+                 name=name, n_out=1 + ns)
