@@ -6,18 +6,94 @@ reference class number from SURVEY.md §6: MXNet+cuDNN on A100 ~= 2500
 images/sec/chip fp16 ResNet-50.
 
 Prints exactly ONE JSON line on stdout.
+
+The TPU tunnel is flaky: backend init can transiently raise ``UNAVAILABLE``
+(this crashed the round-2 measurement of record). So the default entrypoint
+is a *supervisor* that runs the actual benchmark in a fresh subprocess
+(fresh PJRT client per try) with bounded retry + backoff, and re-emits the
+worker's single JSON line. ``--worker`` runs the measurement directly.
 """
 from __future__ import annotations
 
 import json
 import os
+import subprocess
 import sys
 import time
 
 BASELINE_IMG_S = 2500.0
 
+# ~5 min of total backoff across 6 attempts, per VERDICT r2 item 1.
+RETRY_SLEEPS = [5, 15, 30, 60, 90]
+WORKER_TIMEOUT_S = 600     # per attempt: a healthy run takes ~2-4 min
+DEADLINE_S = 1500          # stop STARTING attempts past this wall-clock
+
+
+def supervise() -> int:
+    """Run the worker in fresh subprocesses until one emits a JSON line.
+
+    Two failure modes observed on the axon tunnel: backend init raising
+    UNAVAILABLE (fails fast -> all 6 attempts fit in ~5 min of backoff)
+    and backend init hanging (each attempt burns WORKER_TIMEOUT_S -> the
+    DEADLINE_S cap bounds total wall clock so the driver isn't blocked)."""
+    argv = [a for a in sys.argv[1:] if a != "--worker"]
+    cmd = [sys.executable, os.path.abspath(__file__), "--worker", *argv]
+    attempts = len(RETRY_SLEEPS) + 1
+    t_start = time.monotonic()
+
+    def last_json_line(stdout_bytes):
+        found = None
+        for raw in (stdout_bytes or b"").decode(errors="replace").splitlines():
+            raw = raw.strip()
+            if raw.startswith("{"):
+                try:
+                    json.loads(raw)
+                    found = raw
+                except ValueError:
+                    pass
+        return found
+
+    for attempt in range(attempts):
+        print(f"[bench] attempt {attempt + 1}/{attempts}", file=sys.stderr)
+        out_bytes = b""
+        try:
+            proc = subprocess.run(
+                cmd, stdout=subprocess.PIPE, stderr=None,
+                timeout=WORKER_TIMEOUT_S)
+            out_bytes = proc.stdout
+            if proc.returncode != 0:
+                print(f"[bench] worker exited rc={proc.returncode}",
+                      file=sys.stderr)
+        except subprocess.TimeoutExpired as e:
+            # the worker can hang AFTER printing its result (tunnel-flaky
+            # PJRT teardown) — salvage whatever stdout was captured
+            out_bytes = e.stdout
+            print(f"[bench] worker timed out after {WORKER_TIMEOUT_S}s "
+                  "(hung backend init or teardown?)", file=sys.stderr)
+        line = last_json_line(out_bytes)
+        if line is not None:
+            print(line)
+            return 0
+        if time.monotonic() - t_start > DEADLINE_S:
+            print(f"[bench] overall deadline {DEADLINE_S}s exceeded",
+                  file=sys.stderr)
+            break
+        if attempt < len(RETRY_SLEEPS):
+            delay = RETRY_SLEEPS[attempt]
+            print(f"[bench] no result; retrying in {delay}s "
+                  "(fresh process, fresh TPU client)", file=sys.stderr)
+            time.sleep(delay)
+    print("[bench] all attempts failed", file=sys.stderr)
+    return 1
+
 
 def main():
+    # honor JAX_PLATFORMS=cpu despite the axon sitecustomize force-
+    # registering the TPU backend (jax.config wins if set before init) —
+    # lets CI/smoke runs avoid the tunnel entirely
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        import jax
+        jax.config.update("jax_platforms", "cpu")
     import jax
     import jax.numpy as jnp
 
@@ -102,4 +178,7 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    if "--worker" in sys.argv:
+        main()
+    else:
+        sys.exit(supervise())

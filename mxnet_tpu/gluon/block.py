@@ -112,8 +112,11 @@ class _TraceContext:
 def _run_traced(block, params, param_vals, arg_vals, training, rng):
     """Run block.forward under a functional trace: parameters overridden with
     `param_vals`, layer RNG drawn from `rng`, aux updates captured instead of
-    applied. Returns (outputs_tuple, aux_updates, is_seq, is_list). Shared by
-    the compiled-forward cache and extract_pure_fn."""
+    applied. Returns (leaf_outputs_tuple, treedef, aux_updates). The output
+    can be arbitrarily nested (e.g. RNN layers return `(out, [h, c])`) — it
+    is pytree-flattened with NDArray leaves and the treedef lets callers
+    rebuild the exact structure. Shared by the compiled-forward cache and
+    extract_pure_fn."""
     prev_rec = autograd.set_recording(False)
     prev_train = autograd.set_training(training)
     try:
@@ -122,9 +125,9 @@ def _run_traced(block, params, param_vals, arg_vals, training, rng):
                 p._trace_override = NDArray(v)
             nd_args = [NDArray(v) for v in arg_vals]
             out = block.forward(*nd_args)
-            is_seq = isinstance(out, (tuple, list))
-            outs = tuple(out) if is_seq else (out,)
-            return outs, list(tctx.aux_updates), is_seq, isinstance(out, list)
+            leaves, treedef = jax.tree_util.tree_flatten(
+                out, is_leaf=lambda x: isinstance(x, NDArray))
+            return tuple(leaves), treedef, list(tctx.aux_updates)
     finally:
         for p in params:
             p._trace_override = None
@@ -441,21 +444,18 @@ class HybridBlock(Block):
         outs, auxs = flat[:meta["n_out"]], flat[meta["n_out"]:]
         for p, new in zip(meta["aux"], auxs):
             p._data._rebind(new._data)
-        if meta["is_seq"]:
-            return list(outs) if meta["is_list"] else tuple(outs)
-        return outs[0]
+        return jax.tree_util.tree_unflatten(meta["treedef"], list(outs))
 
     def _build_cached(self, params, args, training):
         block = self
-        meta = {"n_out": 1, "is_seq": False, "is_list": False, "aux": []}
+        meta = {"n_out": 1, "treedef": None, "aux": []}
 
         def pure(rng, *vals):
             n_args = len(args)
             arg_vals, param_vals = vals[:n_args], vals[n_args:]
-            outs, aux_updates, is_seq, is_list = _run_traced(
+            outs, treedef, aux_updates = _run_traced(
                 block, params, param_vals, arg_vals, training, rng)
-            meta["is_seq"] = is_seq
-            meta["is_list"] = is_list
+            meta["treedef"] = treedef
             meta["n_out"] = len(outs)
             meta["aux"] = [p for p, _ in aux_updates]
             flat = [o._data for o in outs]
@@ -542,10 +542,11 @@ def extract_pure_fn(block, *example_args, training=False, rng_seed=0):
     meta = {"aux_idx": ()}
 
     def fn(param_vals, *arg_vals):
-        outs, aux, _seq, _lst = _run_traced(
+        outs, treedef, aux = _run_traced(
             block, params, param_vals, arg_vals, training,
             jax.random.PRNGKey(rng_seed))
         meta["aux_idx"] = tuple(idx_of[id(p)] for p, _ in aux)
+        meta["out_treedef"] = treedef
         res = tuple(o._data for o in outs)
         res = res if len(res) > 1 else res[0]
         if not training:
@@ -558,6 +559,10 @@ def extract_pure_fn(block, *example_args, training=False, rng_seed=0):
     # (this also fills meta["aux_idx"] — the aux set is static per block)
     jax.eval_shape(fn, param_vals, *[a._data for a in example_args])
     fn.aux_indices = meta["aux_idx"]
+    # nested block outputs (e.g. RNN's (out, [h, c])) come back FLAT from
+    # fn; this treedef recovers the structure: tree_unflatten(out_treedef,
+    # flat_outputs)
+    fn.out_treedef = meta["out_treedef"]
     return fn, param_vals
 
 

@@ -52,15 +52,42 @@ def _scan_layer(mode, x, init_states, wi, wh, bi, bh, reverse=False):
     return outs, final
 
 
+def _scan_layer_masked(mode, x, lengths, init_states, wi, wh, bi, bh):
+    """Variable-length scan: past each row's length the carry freezes (so
+    final states are the states at t = len-1) and outputs are zeroed —
+    cuDNN variable-length semantics (reference rnn-inl.h
+    use_sequence_length path). One lax.scan; the mask is a select fused
+    into the loop body, not a host-side ragged loop."""
+    T = x.shape[0]
+    ln = lengths.astype(jnp.int32)
+
+    def step(carry, inp):
+        x_t, t = inp
+        new_states, out = _step_rnn(mode, x_t, carry, wi, wh, bi, bh)
+        valid = (t < ln)[:, None]
+        new_states = tuple(jnp.where(valid, ns, cs)
+                           for ns, cs in zip(new_states, carry))
+        return new_states, jnp.where(valid, out, 0).astype(out.dtype)
+
+    final, outs = jax.lax.scan(
+        step, init_states, (x, jnp.arange(T, dtype=jnp.int32)))
+    return outs, final
+
+
 def rnn_forward(mode, num_layers, num_dir, layout_ntc, pnames,
-                xv, svals, pvseq, dropout=0.0, rng=None):
+                xv, svals, pvseq, dropout=0.0, rng=None, seq_len=None):
     """Pure multi-layer (bi)RNN forward over raw arrays: the single kernel
     behind both the eager layer and the symbolic "RNN" op. Inter-layer
     dropout (reference rnn-inl.h semantics: between stacked layers, not
     after the last) applies only when an `rng` key is given — training
-    paths thread one, inference paths pass None. Returns
-    (outputs, stacked_h[, stacked_c])."""
+    paths thread one, inference paths pass None. With `seq_len` (N,), the
+    cuDNN use_sequence_length contract holds: padded steps emit zeros,
+    final states come from each row's last valid step, and the reverse
+    direction flips only the valid prefix (SequenceReverse + forward
+    masked scan — the classic variable-length-biRNN correctness trap).
+    Returns (outputs, stacked_h[, stacked_c])."""
     import jax
+    from ...ops.seq_ops import sequence_reverse_k
     L, D = num_layers, num_dir
     pv = dict(zip(pnames, pvseq))
     seq = jnp.swapaxes(xv, 0, 1) if layout_ntc else xv  # (T,N,I)
@@ -73,13 +100,17 @@ def rnn_forward(mode, num_layers, num_dir, layout_ntc, pnames,
         for d, sfx in zip(range(D), ["l", "r"]):
             idx = layer * D + d
             init = (hs[idx], cs[idx]) if mode == "lstm" else (hs[idx],)
-            o, fin = _scan_layer(
-                mode, out, init,
-                pv[f"{sfx}{layer}_i2h_weight"],
-                pv[f"{sfx}{layer}_h2h_weight"],
-                pv[f"{sfx}{layer}_i2h_bias"],
-                pv[f"{sfx}{layer}_h2h_bias"],
-                reverse=(d == 1))
+            ws = (pv[f"{sfx}{layer}_i2h_weight"],
+                  pv[f"{sfx}{layer}_h2h_weight"],
+                  pv[f"{sfx}{layer}_i2h_bias"],
+                  pv[f"{sfx}{layer}_h2h_bias"])
+            if seq_len is None:
+                o, fin = _scan_layer(mode, out, init, *ws, reverse=(d == 1))
+            else:
+                inp = out if d == 0 else sequence_reverse_k(out, seq_len)
+                o, fin = _scan_layer_masked(mode, inp, seq_len, init, *ws)
+                if d == 1:
+                    o = sequence_reverse_k(o, seq_len)
             layer_outs.append(o)
             final_h.append(fin[0])
             if mode == "lstm":
@@ -102,9 +133,10 @@ class _RNNLayer(HybridBlock):
                  dropout=0.0, bidirectional=False, input_size=0,
                  i2h_weight_initializer=None, h2h_weight_initializer=None,
                  i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
-                 dtype="float32", **kwargs):
+                 dtype="float32", use_sequence_length=False, **kwargs):
         super().__init__(**kwargs)
         assert layout in ("TNC", "NTC")
+        self._use_sequence_length = use_sequence_length
         self._mode = mode
         self._hidden_size = hidden_size
         self._num_layers = num_layers
@@ -154,7 +186,29 @@ class _RNNLayer(HybridBlock):
 
     def hybrid_forward(self, F, x, *states, **params):
         layout_ntc = self._layout == "NTC"
-        # both call styles: net(x, [h, c]) (reference) and net(x, h, c)
+        # call styles: net(x, [h, c]) (reference), net(x, h, c), and with
+        # use_sequence_length=True the LAST positional arg is the (N,)
+        # sequence_length (reference: rnn_layer.forward(inputs, states,
+        # sequence_length))
+        seq_len = None
+        if self._use_sequence_length:
+            if not states:
+                raise ValueError("use_sequence_length=True: call as "
+                                 "net(x[, states], sequence_length)")
+            seq_len = states[-1]
+            # catch net(x, states) with the lengths forgotten: lengths are
+            # a 1-D (N,) vector, never a state tensor or state list.
+            # Symbols have no static rank while tracing — let them through.
+            from ..block import is_symbolic
+            if not is_symbolic(seq_len) and (
+                    isinstance(seq_len, (list, tuple)) or
+                    getattr(seq_len, "ndim", None) != 1):
+                raise ValueError(
+                    "use_sequence_length=True: the last positional argument "
+                    "must be the 1-D (batch,) sequence_length vector, got "
+                    f"{type(seq_len).__name__} with shape "
+                    f"{getattr(seq_len, 'shape', '?')}")
+            states = states[:-1]
         if len(states) == 1 and isinstance(states[0], (list, tuple)):
             states = tuple(states[0])
         has_states = len(states) > 0
@@ -167,12 +221,14 @@ class _RNNLayer(HybridBlock):
         if is_symbolic(x):
             # zero initial states are synthesised inside the RNN op at
             # bind time (batch size is unknown while tracing)
-            node = F.RNN(x, *(list(states) + pvals if has_states
-                              else pvals), mode=mode,
+            extra = ([seq_len] if seq_len is not None else []) + \
+                (list(states) if has_states else [])
+            node = F.RNN(x, *(extra + pvals), mode=mode,
                          num_layers=L, num_dir=D,
                          hidden_size=self._hidden_size,
                          layout_ntc=layout_ntc, pnames=tuple(pnames),
                          state_outputs=has_states,
+                         use_sequence_length=seq_len is not None,
                          dropout=self._dropout)
             if not has_states:
                 return node[0]
@@ -188,13 +244,20 @@ class _RNNLayer(HybridBlock):
         key = _layer_rng() if (self._dropout and autograd.is_training()) \
             else None
 
-        def fn(xv, *rest, _pn=tuple(pnames), _m=mode, _L=L, _D=D,
-               _ln=layout_ntc, _ns=ns, _dp=self._dropout, _k=key):
-            return rnn_forward(_m, _L, _D, _ln, _pn,
-                               xv, rest[:_ns], rest[_ns:],
-                               dropout=_dp, rng=_k)
+        has_seq = seq_len is not None
 
-        flat = _apply(fn, [x] + state_inputs + pvals, n_out=2 + (ns - 1))
+        def fn(xv, *rest, _pn=tuple(pnames), _m=mode, _L=L, _D=D,
+               _ln=layout_ntc, _ns=ns, _dp=self._dropout, _k=key,
+               _hs=has_seq):
+            sl = rest[_ns] if _hs else None
+            pv = rest[_ns + 1:] if _hs else rest[_ns:]
+            return rnn_forward(_m, _L, _D, _ln, _pn,
+                               xv, rest[:_ns], pv,
+                               dropout=_dp, rng=_k, seq_len=sl)
+
+        seq_in = [seq_len] if has_seq else []
+        flat = _apply(fn, [x] + state_inputs + seq_in + pvals,
+                      n_out=2 + (ns - 1))
         out = flat[0]
         new_states = list(flat[1:])
         if has_states:

@@ -9,6 +9,8 @@ from .ndarray import (NDArray, zeros, ones, full, empty, array, arange,
 from .utils import save, load
 from ..ops.tensor_ops import *          # noqa: F401,F403
 from ..ops.nn_ops import *              # noqa: F401,F403
+from ..ops.seq_ops import (SequenceMask, SequenceLast,  # noqa: F401
+                           SequenceReverse, smooth_l1, softmin, hard_sigmoid)
 from ..ops import tensor_ops as _t
 from ..ops import nn_ops as _n
 from ..ops import linalg_ops as linalg  # mx.nd.linalg.*

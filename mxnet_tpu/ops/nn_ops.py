@@ -365,7 +365,9 @@ _ACTS = {
     "silu": jax.nn.silu,
     "mish": jax.nn.mish,
     "relu6": lambda x: jnp.clip(x, 0, 6),
-    "hard_sigmoid": jax.nn.hard_sigmoid,
+    # MXNet semantics: clip(0.2*x + 0.5, 0, 1) — NOT jax.nn.hard_sigmoid's
+    # 1/6 slope; must match nd.hard_sigmoid (ops/seq_ops.py)
+    "hard_sigmoid": lambda x: jnp.clip(0.2 * x + 0.5, 0.0, 1.0),
     "hard_swish": jax.nn.hard_swish,
     "exp": jnp.exp,
     "identity": lambda x: x,

@@ -1,0 +1,136 @@
+"""Sequence ops: SequenceMask / SequenceLast / SequenceReverse + small
+pointwise ops (smooth_l1, softmin, hard_sigmoid).
+
+Reference parity: src/operator/sequence_mask.cc, sequence_last.cc,
+sequence_reverse.cc, src/operator/tensor/elemwise_unary_op (smooth_l1,
+hard_sigmoid), softmin (softmax.cc). The reference implements the sequence
+ops as per-batch CUDA loops over the time axis; here each one is a single
+vectorised XLA op (a select or one gather), static-shape and
+jit/vmap/grad-compatible, so they fuse into surrounding RNN/attention
+programs instead of breaking them into host-synchronised steps.
+
+Conventions (same as the reference): `data` is (T, N, ...) for axis=0 or
+(N, T, ...) for axis=1; `sequence_length` is (N,) counting valid steps;
+`use_sequence_length=False` means the op degenerates (mask: identity,
+last: data[-1], reverse: full flip).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..ndarray.ndarray import NDArray, _apply
+
+__all__ = ["SequenceMask", "SequenceLast", "SequenceReverse",
+           "smooth_l1", "softmin", "hard_sigmoid",
+           "sequence_mask_k", "sequence_last_k", "sequence_reverse_k",
+           "smooth_l1_k", "softmin_k", "hard_sigmoid_k"]
+
+
+# --------------------------------------------------------------- raw kernels
+def _valid_mask(T, lengths, axis, ndim):
+    """Boolean mask of valid positions, broadcastable to the data rank:
+    (T, N, 1, ...) for axis=0 or (N, T, 1, ...) for axis=1."""
+    t = jnp.arange(T, dtype=jnp.int32)
+    ln = lengths.astype(jnp.int32)
+    m = t[:, None] < ln[None, :] if axis == 0 else t[None, :] < ln[:, None]
+    return m.reshape(m.shape + (1,) * (ndim - 2))
+
+
+def sequence_mask_k(data, lengths=None, value=0.0, axis=0):
+    if lengths is None:
+        return data
+    mask = _valid_mask(data.shape[axis], lengths, axis, data.ndim)
+    return jnp.where(mask, data, jnp.asarray(value, data.dtype))
+
+
+def sequence_last_k(data, lengths=None, axis=0):
+    T = data.shape[axis]
+    if lengths is None:
+        return jnp.take(data, T - 1, axis=axis)
+    idx = jnp.clip(lengths.astype(jnp.int32) - 1, 0, T - 1)  # (N,)
+    if axis == 0:
+        idx = idx.reshape((1, -1) + (1,) * (data.ndim - 2))
+    else:
+        idx = idx.reshape((-1, 1) + (1,) * (data.ndim - 2))
+    # one XLA gather along time, per batch element
+    return jnp.take_along_axis(data, idx, axis=axis).squeeze(axis)
+
+
+def sequence_reverse_k(data, lengths=None, axis=0):
+    """Reverse the valid prefix along time; padding stays in place.
+    out[t, n] = data[len[n]-1-t, n] for t < len[n], else data[t, n]."""
+    if axis != 0:
+        raise ValueError("SequenceReverse supports axis=0 only (reference: "
+                         "src/operator/sequence_reverse.cc)")
+    if lengths is None:
+        return jnp.flip(data, axis=0)
+    T = data.shape[0]
+    t = jnp.arange(T, dtype=jnp.int32)[:, None]          # (T, 1)
+    ln = lengths.astype(jnp.int32)[None, :]              # (1, N)
+    src = jnp.where(t < ln, ln - 1 - t, t)               # (T, N)
+    src = src.reshape(src.shape + (1,) * (data.ndim - 2))
+    return jnp.take_along_axis(data, src, axis=0)
+
+
+def smooth_l1_k(data, scalar=1.0):
+    """f(x) = 0.5*(sigma*x)^2 for |x| < 1/sigma^2, else |x| - 0.5/sigma^2
+    (reference: smooth_l1 in src/operator/tensor, sigma passed as `scalar`)."""
+    sigma2 = scalar * scalar
+    ax = jnp.abs(data)
+    return jnp.where(ax < 1.0 / sigma2,
+                     0.5 * sigma2 * data * data,
+                     ax - 0.5 / sigma2)
+
+
+def softmin_k(data, axis=-1):
+    return jax.nn.softmax(-data, axis=axis)
+
+
+def hard_sigmoid_k(data, alpha=0.2, beta=0.5):
+    """MXNet definition: clip(alpha*x + beta, 0, 1) — note alpha defaults to
+    0.2, NOT jax.nn.hard_sigmoid's 1/6 slope."""
+    return jnp.clip(alpha * data + beta, 0.0, 1.0)
+
+
+# ------------------------------------------------- imperative NDArray surface
+def _seq_args(data, sequence_length, use_sequence_length):
+    if use_sequence_length:
+        if sequence_length is None:
+            raise ValueError("use_sequence_length=True requires "
+                             "sequence_length")
+        return [data, sequence_length]
+    return [data]
+
+
+def SequenceMask(data, sequence_length=None, use_sequence_length=False,
+                 value=0.0, axis=0, **kwargs):
+    ins = _seq_args(data, sequence_length, use_sequence_length)
+    return _apply(lambda *a: sequence_mask_k(
+        a[0], a[1] if len(a) > 1 else None, value=value, axis=axis), ins)
+
+
+def SequenceLast(data, sequence_length=None, use_sequence_length=False,
+                 axis=0, **kwargs):
+    ins = _seq_args(data, sequence_length, use_sequence_length)
+    return _apply(lambda *a: sequence_last_k(
+        a[0], a[1] if len(a) > 1 else None, axis=axis), ins)
+
+
+def SequenceReverse(data, sequence_length=None, use_sequence_length=False,
+                    axis=0, **kwargs):
+    ins = _seq_args(data, sequence_length, use_sequence_length)
+    return _apply(lambda *a: sequence_reverse_k(
+        a[0], a[1] if len(a) > 1 else None, axis=axis), ins)
+
+
+def smooth_l1(data, scalar=1.0, **kwargs):
+    return _apply(lambda x: smooth_l1_k(x, scalar=scalar), [data])
+
+
+def softmin(data, axis=-1, **kwargs):
+    return _apply(lambda x: softmin_k(x, axis=axis), [data])
+
+
+def hard_sigmoid(data, alpha=0.2, beta=0.5, **kwargs):
+    return _apply(lambda x: hard_sigmoid_k(x, alpha=alpha, beta=beta), [data])

@@ -22,6 +22,8 @@ __all__ = ["FullyConnected", "Convolution", "StemConvS2D", "Activation",
            "log_softmax", "SoftmaxOutput", "LinearRegressionOutput",
            "MAERegressionOutput", "LogisticRegressionOutput",
            "flatten", "Flatten", "reshape", "Custom", "RNN",
+           "SequenceMask", "SequenceLast", "SequenceReverse",
+           "smooth_l1", "softmin", "hard_sigmoid",
            "transpose", "concat", "Concat", "dot", "batch_dot", "sum", "mean",
            "max", "min", "relu", "sigmoid", "tanh", "exp", "log", "sqrt",
            "square", "negative", "zeros", "ones", "broadcast_add",
@@ -620,12 +622,86 @@ def Custom(*inputs, op_type=None, name=None, **prop_kwargs):
                  n_out=len(prop.list_outputs()))
 
 
+# -- sequence ops (reference: src/operator/sequence_*.cc) -------------------
+from ..ops import seq_ops as _seq
+
+register_op("SequenceMask",
+            lambda *ins, use_sequence_length=False, value=0.0, axis=0:
+            _seq.sequence_mask_k(ins[0],
+                                 ins[1] if use_sequence_length else None,
+                                 value=value, axis=axis))
+register_op("SequenceLast",
+            lambda *ins, use_sequence_length=False, axis=0:
+            _seq.sequence_last_k(ins[0],
+                                 ins[1] if use_sequence_length else None,
+                                 axis=axis))
+register_op("SequenceReverse",
+            lambda *ins, use_sequence_length=False, axis=0:
+            _seq.sequence_reverse_k(ins[0],
+                                    ins[1] if use_sequence_length else None,
+                                    axis=axis))
+register_op("smooth_l1",
+            lambda x, scalar=1.0: _seq.smooth_l1_k(x, scalar=scalar))
+register_op("softmin", lambda x, axis=-1: _seq.softmin_k(x, axis=axis))
+register_op("hard_sigmoid",
+            lambda x, alpha=0.2, beta=0.5:
+            _seq.hard_sigmoid_k(x, alpha=alpha, beta=beta))
+
+
+def _seq_inputs(data, sequence_length, use_sequence_length):
+    try:
+        return _seq._seq_args(data, sequence_length, use_sequence_length)
+    except ValueError as e:
+        raise MXNetError(str(e)) from None
+
+
+def SequenceMask(data, sequence_length=None, use_sequence_length=False,
+                 value=0.0, axis=0, name=None):
+    return _make("SequenceMask",
+                 _seq_inputs(data, sequence_length, use_sequence_length),
+                 {"use_sequence_length": use_sequence_length,
+                  "value": value, "axis": axis}, name=name)
+
+
+def SequenceLast(data, sequence_length=None, use_sequence_length=False,
+                 axis=0, name=None):
+    return _make("SequenceLast",
+                 _seq_inputs(data, sequence_length, use_sequence_length),
+                 {"use_sequence_length": use_sequence_length, "axis": axis},
+                 name=name)
+
+
+def SequenceReverse(data, sequence_length=None, use_sequence_length=False,
+                    axis=0, name=None):
+    return _make("SequenceReverse",
+                 _seq_inputs(data, sequence_length, use_sequence_length),
+                 {"use_sequence_length": use_sequence_length, "axis": axis},
+                 name=name)
+
+
+def smooth_l1(data, scalar=1.0, name=None):
+    return _make("smooth_l1", [data], {"scalar": scalar}, name=name)
+
+
+def softmin(data, axis=-1, name=None):
+    return _make("softmin", [data], {"axis": axis}, name=name)
+
+
+def hard_sigmoid(data, alpha=0.2, beta=0.5, name=None):
+    return _make("hard_sigmoid", [data], {"alpha": alpha, "beta": beta},
+                 name=name)
+
+
 # -- fused RNN layers as one symbol node (reference: sym.RNN / rnn-inl.h) ---
 def _rnn_eval(x, *rest, mode="lstm", num_layers=1, num_dir=1,
               hidden_size=0, layout_ntc=False, pnames=(),
-              state_outputs=False, dropout=0.0, _rng=None):
+              state_outputs=False, use_sequence_length=False, dropout=0.0,
+              _rng=None):
     from ..gluon.rnn.rnn_layer import rnn_forward
     ns = 2 if mode == "lstm" else 1
+    seq_len = None
+    if use_sequence_length:
+        seq_len, rest = rest[0], rest[1:]
     if state_outputs:
         svals, pvals = rest[:ns], rest[ns:]
     else:
@@ -634,7 +710,8 @@ def _rnn_eval(x, *rest, mode="lstm", num_layers=1, num_dir=1,
                          x.dtype)
         svals, pvals = (zero,) * ns, rest
     return rnn_forward(mode, num_layers, num_dir, layout_ntc, pnames,
-                       x, svals, pvals, dropout=dropout, rng=_rng)
+                       x, svals, pvals, dropout=dropout, rng=_rng,
+                       seq_len=seq_len)
 
 
 register_op("RNN", _rnn_eval)
@@ -654,7 +731,9 @@ def _rnn_shapes(ins, attrs):
     ns = (2 if mode == "lstm" else 1) if attrs.get("state_outputs") else 0
     batch = data[0] if attrs.get("layout_ntc") else data[1]
     in_size = data[-1]
-    out = [data] + [(L * D, batch, H)] * ns
+    out = [data] + \
+        ([(batch,)] if attrs.get("use_sequence_length") else []) + \
+        [(L * D, batch, H)] * ns
     for name in attrs.get("pnames", ()):
         layer = int(name.split("_")[0][1:])
         if name.endswith("i2h_weight"):
@@ -671,13 +750,17 @@ register_shape_rule("RNN", _rnn_shapes)
 
 def RNN(data, *state_and_params, mode="lstm", num_layers=1, num_dir=1,
         hidden_size=0, layout_ntc=False, pnames=(), state_outputs=False,
-        dropout=0.0, name=None):
+        use_sequence_length=False, dropout=0.0, name=None):
     """Fused multi-layer (bi)RNN node (reference: mx.sym.RNN): one lax.scan
-    stack per layer/direction compiled inside the Executor's program."""
+    stack per layer/direction compiled inside the Executor's program. With
+    use_sequence_length=True the first extra input (after data) is the (N,)
+    sequence_length vector (reference rnn-inl.h variable-length path)."""
     ns = (2 if mode == "lstm" else 1)
     return _make("RNN", [data] + list(state_and_params),
                  {"mode": mode, "num_layers": num_layers,
                   "num_dir": num_dir, "hidden_size": hidden_size,
                   "layout_ntc": layout_ntc, "pnames": tuple(pnames),
-                  "state_outputs": state_outputs, "dropout": dropout},
+                  "state_outputs": state_outputs,
+                  "use_sequence_length": use_sequence_length,
+                  "dropout": dropout},
                  name=name, n_out=1 + ns)

