@@ -2,16 +2,11 @@
 
 Quantization is REAL on TPU: the MXU multiplies int8 natively, so
 `contrib.quantization` implements calibrated symmetric int8 inference
-(see that module). ONNX export stays a gated stub — the `onnx` package is
-not available in this environment, and the TPU-native deployment path is
-the XLA executable exported by HybridBlock.export.
+(see that module). ONNX export is self-contained — `contrib.onnx`
+hand-encodes the protobuf wire format, so no `onnx` package is needed.
 """
 from ..base import MXNetError
 from . import quantization
 from .quantization import quantize_model, quantize_net
-
-
-def export_onnx(*args, **kwargs):
-    raise MXNetError(
-        "ONNX export requires the `onnx` package, which is not available "
-        "here; deploy the jitted XLA executable via HybridBlock.export")
+from . import onnx
+from .onnx import export_model as export_onnx

@@ -1,0 +1,10 @@
+"""mx.contrib.onnx (reference: python/mxnet/contrib/onnx).
+
+Export is self-contained (hand-rolled protobuf wire format — see proto.py);
+no `onnx` package needed. Import (onnx→mxnet) is out of scope: the
+deployment inverse here is SymbolBlock.imports on the native symbol.json.
+"""
+from .export import export_model
+from . import proto
+
+__all__ = ["export_model", "proto"]

@@ -1,0 +1,472 @@
+"""Symbol-graph → ONNX exporter (reference: python/mxnet/contrib/onnx/
+mx2onnx/export_model.py + _op_translations.py).
+
+The reference converts nnvm graph JSON to ONNX via the `onnx` helper API;
+that package is unavailable offline, so serialization goes through the
+hand-rolled wire-format encoder in `proto.py`. The converter registry
+mirrors the reference's per-op translation table. Target opset 11 (Dropout
+ratio / Squeeze axes are still attributes there, Gemm's C is optional —
+the most portable pre-13 opset).
+
+Layout note: exported CNNs must be NCHW (ONNX's only layout) — the zoo
+default. NHWC-built nets (the TPU fast path) should be re-built NCHW for
+export; conversion is a deploy-time concern, not a train-time one.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ...base import MXNetError
+from . import proto as P
+
+__all__ = ["export_model"]
+
+OPSET = 11
+IR_VERSION = 6
+
+_CONVERTERS = {}
+
+
+def register_converter(opname):
+    def deco(fn):
+        _CONVERTERS[opname] = fn
+        return fn
+    return deco
+
+
+class _Ctx:
+    """Per-export state: tensor naming, emitted nodes, initializers."""
+
+    def __init__(self):
+        self.nodes = []          # encoded NodeProto bytes, topo order
+        self.initializers = []   # encoded TensorProto bytes
+        self.name_of = {}        # id(symbol node) -> output tensor name
+        self.params = {}         # stripped name -> numpy array
+        self._uniq = 0
+
+    def tensor(self, sym_input):
+        base, oi = sym_input._resolve_head()
+        name = self.name_of[id(base)]
+        return name if base._n_out == 1 else f"{name}.{oi}"
+
+    def fresh(self, hint):
+        self._uniq += 1
+        return f"{hint}__{self._uniq}"
+
+    def add_node(self, op_type, inputs, outputs, name, *attrs):
+        self.nodes.append(P.message(
+            *[P.f_bytes(1, i) for i in inputs],
+            *[P.f_bytes(2, o) for o in outputs],
+            P.f_bytes(3, name),
+            P.f_bytes(4, op_type),
+            *[P.f_bytes(5, a) for a in attrs]))
+
+    def add_initializer(self, name, array):
+        arr = np.ascontiguousarray(array)
+        if arr.dtype == np.float64:
+            arr = arr.astype(np.float32)
+        self.initializers.append(P.message(
+            *[P.f_varint(1, d) for d in arr.shape],
+            P.f_varint(2, P.onnx_dtype(arr.dtype)),
+            P.f_bytes(8, name),
+            P.f_bytes(9, arr.tobytes())))
+        return name
+
+    def const(self, hint, array):
+        return self.add_initializer(self.fresh(hint), np.asarray(array))
+
+
+# ------------------------------------------------------------ attr helpers
+def A_f(name, v):
+    return P.message(P.f_bytes(1, name), P.f_varint(20, P.ATTR_FLOAT),
+                     P.f_float(2, v))
+
+
+def A_i(name, v):
+    return P.message(P.f_bytes(1, name), P.f_varint(20, P.ATTR_INT),
+                     P.f_varint(3, v))
+
+
+def A_s(name, v):
+    return P.message(P.f_bytes(1, name), P.f_varint(20, P.ATTR_STRING),
+                     P.f_bytes(4, v))
+
+
+def A_ints(name, vs):
+    return P.message(P.f_bytes(1, name), P.f_varint(20, P.ATTR_INTS),
+                     *[P.f_varint(8, v) for v in vs])
+
+
+def _pair(v):
+    return tuple(v) if isinstance(v, (tuple, list)) else (int(v), int(v))
+
+
+# ------------------------------------------------------------- converters
+@register_converter("Convolution")
+def _conv(node, ctx, out):
+    a = node._attrs
+    k, s = _pair(a["kernel"]), _pair(a.get("stride", 1))
+    p, d = _pair(a.get("pad", 0)), _pair(a.get("dilate", 1))
+    if (a.get("layout") or "NCHW") != "NCHW":
+        raise MXNetError("ONNX export requires NCHW convolutions; rebuild "
+                         "the net with layout='NCHW' for export")
+    ctx.add_node("Conv", [ctx.tensor(i) for i in node._inputs], [out],
+                 node.name,
+                 A_ints("kernel_shape", k), A_ints("strides", s),
+                 A_ints("pads", (p[0], p[1], p[0], p[1])),
+                 A_ints("dilations", d),
+                 A_i("group", a.get("num_group", 1)))
+
+
+@register_converter("StemConvS2D")
+def _stem(node, ctx, out):
+    # the space-to-depth stem is the NHWC TPU fast path: its weights are
+    # pre-reshaped for the s2d input, so there is no attr-preserving ONNX
+    # Conv equivalent — same story as the NHWC layout guard in _conv
+    raise MXNetError(
+        "ONNX export: StemConvS2D (stem_s2d=True, the NHWC TPU stem) has "
+        "no ONNX equivalent; rebuild the net with stem_s2d=False / "
+        "layout='NCHW' for export")
+
+
+@register_converter("BatchNorm")
+def _bn(node, ctx, out):
+    a = node._attrs
+    ins = [ctx.tensor(i) for i in node._inputs]
+    if a.get("fix_gamma", True):
+        # MXNet computes with gamma pinned to ones when fix_gamma (the sym
+        # op's default); serializing raw gamma would silently diverge
+        gamma = ctx.params.get(ins[1])
+        if gamma is None:
+            raise MXNetError(f"ONNX export: BatchNorm {node.name!r} has "
+                             "fix_gamma=True but its gamma is not a "
+                             "parameter; cannot pin to ones")
+        ins[1] = ctx.const(node.name + "_fixed_gamma",
+                           np.ones_like(np.asarray(gamma, np.float32)))
+    ctx.add_node("BatchNormalization", ins, [out], node.name,
+                 A_f("epsilon", a.get("eps", 1e-5)),
+                 A_f("momentum", a.get("momentum", 0.9)))
+
+
+@register_converter("Activation")
+def _act(node, ctx, out):
+    table = {"relu": "Relu", "sigmoid": "Sigmoid", "tanh": "Tanh",
+             "softrelu": "Softplus", "softsign": "Softsign"}
+    act = node._attrs.get("act_type", "relu")
+    if act not in table:
+        raise MXNetError(f"ONNX export: unsupported act_type {act!r}")
+    ctx.add_node(table[act], [ctx.tensor(node._inputs[0])], [out], node.name)
+
+
+@register_converter("Pooling")
+def _pool(node, ctx, out):
+    a = node._attrs
+    x = ctx.tensor(node._inputs[0])
+    ptype = a.get("pool_type", "max")
+    if a.get("global_pool"):
+        op = {"max": "GlobalMaxPool", "avg": "GlobalAveragePool"}[ptype]
+        ctx.add_node(op, [x], [out], node.name)
+        return
+    k = _pair(a["kernel"])
+    s = _pair(a.get("stride") or k)
+    p = _pair(a.get("pad", 0))
+    attrs = [A_ints("kernel_shape", k), A_ints("strides", s),
+             A_ints("pads", (p[0], p[1], p[0], p[1]))]
+    if ptype == "max":
+        ctx.add_node("MaxPool", [x], [out], node.name, *attrs)
+    else:
+        attrs.append(A_i("count_include_pad",
+                         1 if a.get("count_include_pad", True) else 0))
+        ctx.add_node("AveragePool", [x], [out], node.name, *attrs)
+
+
+@register_converter("FullyConnected")
+def _fc(node, ctx, out):
+    a = node._attrs
+    x = ctx.tensor(node._inputs[0])
+    if a.get("flatten", True):
+        flat = ctx.fresh(node.name + "_flat")
+        ctx.add_node("Flatten", [x], [flat], node.name + "_flatten",
+                     A_i("axis", 1))
+        x = flat
+    ins = [x, ctx.tensor(node._inputs[1])]
+    if not a.get("no_bias"):
+        ins.append(ctx.tensor(node._inputs[2]))
+    ctx.add_node("Gemm", ins, [out], node.name,
+                 A_f("alpha", 1.0), A_f("beta", 1.0),
+                 A_i("transA", 0), A_i("transB", 1))
+
+
+@register_converter("flatten")
+def _flatten(node, ctx, out):
+    ctx.add_node("Flatten", [ctx.tensor(node._inputs[0])], [out],
+                 node.name, A_i("axis", 1))
+
+
+def _softmax_decomposed(node, ctx, out, log):
+    # opset-11 Softmax has coerce-to-2D semantics: only axis == last is
+    # equivalent to MXNet's per-axis softmax, so other axes get the
+    # explicit max-shifted Exp/ReduceSum/Div decomposition
+    axis = node._attrs.get("axis", -1)
+    x = ctx.tensor(node._inputs[0])
+    mx_ = ctx.fresh(node.name + "_max")
+    ctx.add_node("ReduceMax", [x], [mx_], node.name + "_max",
+                 A_ints("axes", (axis,)), A_i("keepdims", 1))
+    shifted = ctx.fresh(node.name + "_shift")
+    ctx.add_node("Sub", [x, mx_], [shifted], node.name + "_shift")
+    ex = ctx.fresh(node.name + "_exp")
+    ctx.add_node("Exp", [shifted], [ex], node.name + "_exp")
+    s = ctx.fresh(node.name + "_sum")
+    ctx.add_node("ReduceSum", [ex], [s], node.name + "_sum",
+                 A_ints("axes", (axis,)), A_i("keepdims", 1))
+    if log:
+        ls = ctx.fresh(node.name + "_logsum")
+        ctx.add_node("Log", [s], [ls], node.name + "_logsum")
+        ctx.add_node("Sub", [shifted, ls], [out], node.name)
+    else:
+        ctx.add_node("Div", [ex, s], [out], node.name)
+
+
+@register_converter("softmax")
+def _softmax(node, ctx, out):
+    axis = node._attrs.get("axis", -1)
+    if axis == -1:
+        ctx.add_node("Softmax", [ctx.tensor(node._inputs[0])], [out],
+                     node.name, A_i("axis", -1))
+    else:
+        _softmax_decomposed(node, ctx, out, log=False)
+
+
+@register_converter("log_softmax")
+def _log_softmax(node, ctx, out):
+    axis = node._attrs.get("axis", -1)
+    if axis == -1:
+        ctx.add_node("LogSoftmax", [ctx.tensor(node._inputs[0])], [out],
+                     node.name, A_i("axis", -1))
+    else:
+        _softmax_decomposed(node, ctx, out, log=True)
+
+
+@register_converter("Dropout")
+def _dropout(node, ctx, out):
+    ctx.add_node("Dropout", [ctx.tensor(node._inputs[0])], [out],
+                 node.name, A_f("ratio", node._attrs.get("p", 0.5)))
+
+
+@register_converter("concat")
+def _concat(node, ctx, out):
+    ctx.add_node("Concat", [ctx.tensor(i) for i in node._inputs], [out],
+                 node.name, A_i("axis", node._attrs.get("dim", 1)))
+
+
+@register_converter("reshape")
+def _reshape(node, ctx, out):
+    shape = ctx.const(node.name + "_shape",
+                      np.asarray(node._attrs["shape"], dtype=np.int64))
+    ctx.add_node("Reshape", [ctx.tensor(node._inputs[0]), shape], [out],
+                 node.name)
+
+
+@register_converter("transpose")
+def _transpose(node, ctx, out):
+    axes = node._attrs.get("axes")
+    attrs = [A_ints("perm", axes)] if axes else []
+    ctx.add_node("Transpose", [ctx.tensor(node._inputs[0])], [out],
+                 node.name, *attrs)
+
+
+@register_converter("expand_dims")
+def _expand_dims(node, ctx, out):
+    ctx.add_node("Unsqueeze", [ctx.tensor(node._inputs[0])], [out],
+                 node.name, A_ints("axes", (node._attrs["axis"],)))
+
+
+@register_converter("squeeze")
+def _squeeze(node, ctx, out):
+    ax = node._attrs.get("axis")
+    if ax is None:
+        attrs = []
+    else:
+        axes = tuple(ax) if isinstance(ax, (tuple, list)) else (ax,)
+        attrs = [A_ints("axes", axes)]
+    ctx.add_node("Squeeze", [ctx.tensor(node._inputs[0])], [out],
+                 node.name, *attrs)
+
+
+@register_converter("Embedding")
+def _embedding(node, ctx, out):
+    idx = ctx.fresh(node.name + "_idx")
+    ctx.add_node("Cast", [ctx.tensor(node._inputs[0])], [idx],
+                 node.name + "_cast", A_i("to", P.INT64))
+    ctx.add_node("Gather", [ctx.tensor(node._inputs[1]), idx], [out],
+                 node.name, A_i("axis", 0))
+
+
+@register_converter("LayerNorm")
+def _layernorm(node, ctx, out):
+    # opset 11 has no LayerNormalization (added in 17): emit the primitive
+    # decomposition mean/var normalize + affine, matching numerics
+    a = node._attrs
+    axis, eps = a.get("axis", -1), a.get("eps", 1e-5)
+    x, g, b = [ctx.tensor(i) for i in node._inputs]
+    mu = ctx.fresh(node.name + "_mean")
+    ctx.add_node("ReduceMean", [x], [mu], node.name + "_mu",
+                 A_ints("axes", (axis,)), A_i("keepdims", 1))
+    xc = ctx.fresh(node.name + "_centered")
+    ctx.add_node("Sub", [x, mu], [xc], node.name + "_sub")
+    sq = ctx.fresh(node.name + "_sq")
+    ctx.add_node("Mul", [xc, xc], [sq], node.name + "_sq_mul")
+    var = ctx.fresh(node.name + "_var")
+    ctx.add_node("ReduceMean", [sq], [var], node.name + "_varm",
+                 A_ints("axes", (axis,)), A_i("keepdims", 1))
+    veps = ctx.fresh(node.name + "_vareps")
+    epsname = ctx.const(node.name + "_eps", np.float32(eps))
+    ctx.add_node("Add", [var, epsname], [veps], node.name + "_addeps")
+    std = ctx.fresh(node.name + "_std")
+    ctx.add_node("Sqrt", [veps], [std], node.name + "_sqrt")
+    norm = ctx.fresh(node.name + "_norm")
+    ctx.add_node("Div", [xc, std], [norm], node.name + "_div")
+    scaled = ctx.fresh(node.name + "_scaled")
+    ctx.add_node("Mul", [norm, g], [scaled], node.name + "_scale")
+    ctx.add_node("Add", [scaled, b], [out], node.name)
+
+
+def _binary(onnx_op):
+    def conv(node, ctx, out):
+        ctx.add_node(onnx_op, [ctx.tensor(i) for i in node._inputs], [out],
+                     node.name)
+    return conv
+
+
+for _mx, _ox in [("elemwise_add", "Add"), ("elemwise_sub", "Sub"),
+                 ("elemwise_mul", "Mul"), ("elemwise_div", "Div"),
+                 ("broadcast_add", "Add"), ("broadcast_sub", "Sub"),
+                 ("broadcast_mul", "Mul"), ("broadcast_div", "Div"),
+                 ("dot", "MatMul")]:
+    _CONVERTERS[_mx] = _binary(_ox)
+
+
+def _scalar(onnx_op, swap=False):
+    def conv(node, ctx, out):
+        c = ctx.const(node.name + "_scalar",
+                      np.float32(node._attrs["scalar"]))
+        x = ctx.tensor(node._inputs[0])
+        ins = [c, x] if swap else [x, c]
+        ctx.add_node(onnx_op, ins, [out], node.name)
+    return conv
+
+
+for _mx, _ox, _swap in [("elemwise_add_scalar", "Add", False),
+                        ("elemwise_sub_scalar", "Sub", False),
+                        ("elemwise_mul_scalar", "Mul", False),
+                        ("elemwise_div_scalar", "Div", False),
+                        ("rsub_scalar", "Sub", True),
+                        ("rdiv_scalar", "Div", True)]:
+    _CONVERTERS[_mx] = _scalar(_ox, _swap)
+
+
+def _unary(onnx_op):
+    def conv(node, ctx, out):
+        ctx.add_node(onnx_op, [ctx.tensor(node._inputs[0])], [out],
+                     node.name)
+    return conv
+
+
+for _mx, _ox in [("relu", "Relu"), ("sigmoid", "Sigmoid"),
+                 ("tanh", "Tanh"), ("exp", "Exp"), ("log", "Log"),
+                 ("sqrt", "Sqrt"), ("negative", "Neg"), ("abs", "Abs"),
+                 ("square", None)]:
+    if _ox:
+        _CONVERTERS[_mx] = _unary(_ox)
+
+
+@register_converter("square")
+def _square(node, ctx, out):
+    x = ctx.tensor(node._inputs[0])
+    ctx.add_node("Mul", [x, x], [out], node.name)
+
+
+# ------------------------------------------------------------- entry point
+def _strip(params):
+    """Accept reference-style 'arg:x'/'aux:x' keys or plain names; values
+    may be NDArray or numpy."""
+    out = {}
+    for k, v in params.items():
+        name = k.split(":", 1)[1] if k.startswith(("arg:", "aux:")) else k
+        out[name] = v.asnumpy() if hasattr(v, "asnumpy") else np.asarray(v)
+    return out
+
+
+def _value_info(name, shape, elem_type=P.FLOAT):
+    # a PRESENT-but-empty TensorShapeProto means rank 0 in ONNX; unknown
+    # shape must OMIT the shape field entirely (unknown rank)
+    parts = [P.f_varint(1, elem_type)]
+    if shape:
+        dims = P.message(*[P.f_bytes(1, P.message(P.f_varint(1, d)))
+                           for d in shape])
+        parts.append(P.f_bytes(2, dims))
+    tensor = P.message(*parts)
+    return P.message(P.f_bytes(1, name),
+                     P.f_bytes(2, P.message(P.f_bytes(1, tensor))))
+
+
+def export_model(sym, params, input_shapes=None, in_dtype="float32",
+                 onnx_file_path="model.onnx", graph_name="mxnet_tpu"):
+    """Export a Symbol + params to an ONNX file (reference:
+    mx.contrib.onnx.export_model). `input_shapes` maps data-variable names
+    to shapes (or a single tuple when there is one input); shapes are only
+    metadata in the file, so dynamic batch still works downstream.
+    Returns the path written."""
+    params = _strip(params)
+    nodes = sym._topo()
+    heads = sym._head_entries()
+    ctx = _Ctx()
+    ctx.params = params
+
+    data_inputs = []
+    if isinstance(input_shapes, (tuple, list)) and input_shapes and \
+            not isinstance(input_shapes[0], (tuple, list, dict)):
+        input_shapes = {"data": tuple(input_shapes)}
+    input_shapes = dict(input_shapes or {})
+
+    for n in nodes:
+        if n._op is None:
+            ctx.name_of[id(n)] = n.name
+            if n.name in params:
+                ctx.add_initializer(n.name, params[n.name])
+            else:
+                shape = input_shapes.get(n.name, n._shape_hint or ())
+                data_inputs.append(_value_info(
+                    n.name, shape, P.onnx_dtype(np.dtype(in_dtype))))
+            continue
+        conv = _CONVERTERS.get(n._op)
+        if conv is None:
+            raise MXNetError(
+                f"ONNX export: no converter for op {n._op!r} "
+                f"(node {n.name!r}); supported: "
+                f"{sorted(_CONVERTERS)}")
+        ctx.name_of[id(n)] = n.name
+        conv(n, ctx, n.name)
+
+    out_infos = []
+    for hn, oi in heads:
+        name = ctx.name_of[id(hn)]
+        if hn._n_out > 1:
+            name = f"{name}.{oi}"
+        out_infos.append(_value_info(name, ()))
+
+    graph = P.message(
+        *[P.f_bytes(1, n) for n in ctx.nodes],
+        P.f_bytes(2, graph_name),
+        *[P.f_bytes(5, t) for t in ctx.initializers],
+        *[P.f_bytes(11, v) for v in data_inputs],
+        *[P.f_bytes(12, v) for v in out_infos])
+    model = P.message(
+        P.f_varint(1, IR_VERSION),
+        P.f_bytes(2, "mxnet_tpu"),
+        P.f_bytes(3, "1.0"),
+        P.f_bytes(7, graph),
+        P.f_bytes(8, P.message(P.f_bytes(1, ""), P.f_varint(2, OPSET))))
+    with open(onnx_file_path, "wb") as f:
+        f.write(model)
+    return onnx_file_path

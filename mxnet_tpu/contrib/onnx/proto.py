@@ -1,0 +1,216 @@
+"""Self-contained ONNX protobuf wire-format encoder/decoder.
+
+The `onnx` package is unavailable offline, so this module hand-encodes the
+~8 message types an ONNX model file needs (reference:
+python/mxnet/contrib/onnx/mx2onnx uses the onnx helper API; the wire format
+itself is standard protobuf: https://protobuf.dev/programming-guides/encoding).
+
+Field numbers below are from onnx/onnx.proto (stable since IR version 3):
+
+ModelProto:      1 ir_version, 2 producer_name, 3 producer_version,
+                 7 graph, 8 opset_import
+OperatorSetIdProto: 1 domain, 2 version
+GraphProto:      1 node, 2 name, 5 initializer, 11 input, 12 output,
+                 13 value_info
+NodeProto:       1 input, 2 output, 3 name, 4 op_type, 5 attribute,
+                 7 domain
+AttributeProto:  1 name, 2 f, 3 i, 4 s, 5 t, 7 floats, 8 ints, 9 strings,
+                 20 type
+TensorProto:     1 dims, 2 data_type, 8 name, 9 raw_data
+ValueInfoProto:  1 name, 2 type
+TypeProto:       1 tensor_type
+TypeProto.Tensor: 1 elem_type, 2 shape
+TensorShapeProto: 1 dim;  Dimension: 1 dim_value, 2 dim_param
+
+The decoder returns nested dicts keyed by field number — enough for tests
+to validate an exported graph node-by-node without the onnx package.
+"""
+from __future__ import annotations
+
+import struct
+
+# TensorProto.DataType
+FLOAT = 1
+UINT8 = 2
+INT8 = 3
+INT32 = 6
+INT64 = 7
+BOOL = 9
+FLOAT16 = 10
+DOUBLE = 11
+BFLOAT16 = 16
+
+# AttributeProto.AttributeType
+ATTR_FLOAT = 1
+ATTR_INT = 2
+ATTR_STRING = 3
+ATTR_TENSOR = 4
+ATTR_FLOATS = 6
+ATTR_INTS = 7
+ATTR_STRINGS = 8
+
+_NP_TO_ONNX = {
+    "float32": FLOAT, "uint8": UINT8, "int8": INT8, "int32": INT32,
+    "int64": INT64, "bool": BOOL, "float16": FLOAT16, "float64": DOUBLE,
+    "bfloat16": BFLOAT16,
+}
+
+
+def onnx_dtype(np_dtype):
+    name = str(np_dtype)
+    if name not in _NP_TO_ONNX:
+        raise ValueError(f"no ONNX dtype for {name}")
+    return _NP_TO_ONNX[name]
+
+
+# ------------------------------------------------------------------ encoder
+def _varint(n):
+    n &= 0xFFFFFFFFFFFFFFFF
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _tag(field, wire):
+    return _varint((field << 3) | wire)
+
+
+def f_varint(field, value):
+    """Wire type 0: int64 / enum / bool fields."""
+    return _tag(field, 0) + _varint(int(value))
+
+
+def f_float(field, value):
+    """Wire type 5: float fields."""
+    return _tag(field, 5) + struct.pack("<f", float(value))
+
+
+def f_bytes(field, data):
+    """Wire type 2: string / bytes / embedded message fields."""
+    if isinstance(data, str):
+        data = data.encode("utf-8")
+    return _tag(field, 2) + _varint(len(data)) + data
+
+
+def message(*fields):
+    return b"".join(fields)
+
+
+# ------------------------------------------------------------------ decoder
+def _read_varint(buf, pos):
+    result = shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+
+
+def decode(buf):
+    """Decode one protobuf message into {field_number: [values]} (repeated
+    fields accumulate in order). Length-delimited values stay as bytes —
+    callers descend with another decode() where a field is a submessage."""
+    fields = {}
+    pos = 0
+    while pos < len(buf):
+        key, pos = _read_varint(buf, pos)
+        field, wire = key >> 3, key & 0x7
+        if wire == 0:
+            val, pos = _read_varint(buf, pos)
+        elif wire == 1:
+            val = struct.unpack("<d", buf[pos:pos + 8])[0]
+            pos += 8
+        elif wire == 2:
+            ln, pos = _read_varint(buf, pos)
+            val = bytes(buf[pos:pos + ln])
+            pos += ln
+        elif wire == 5:
+            val = struct.unpack("<f", buf[pos:pos + 4])[0]
+            pos += 4
+        else:
+            raise ValueError(f"unsupported wire type {wire}")
+        fields.setdefault(field, []).append(val)
+    return fields
+
+
+def decode_model(buf):
+    """Parse a serialized ModelProto into a friendly dict for tests:
+    {ir_version, opset, graph: {name, inputs, outputs, initializers:
+    {name: (dims, data_type, raw)}, nodes: [{op_type, name, inputs,
+    outputs, attrs: {name: value}}]}}."""
+    m = decode(buf)
+    graph = decode(m[7][0])
+    out = {
+        "ir_version": m.get(1, [None])[0],
+        "opset": [(decode(o).get(1, [b""])[0].decode(),
+                   decode(o).get(2, [0])[0]) for o in m.get(8, [])],
+        "graph": {
+            "name": graph.get(2, [b""])[0].decode(),
+            "inputs": [_value_info(v) for v in graph.get(11, [])],
+            "outputs": [_value_info(v) for v in graph.get(12, [])],
+            "initializers": {},
+            "nodes": [],
+        },
+    }
+    for t in graph.get(5, []):
+        td = decode(t)
+        name = td.get(8, [b""])[0].decode()
+        out["graph"]["initializers"][name] = (
+            tuple(td.get(1, [])), td.get(2, [None])[0],
+            td.get(9, [b""])[0])
+    for n in graph.get(1, []):
+        nd = decode(n)
+        out["graph"]["nodes"].append({
+            "op_type": nd.get(4, [b""])[0].decode(),
+            "name": nd.get(3, [b""])[0].decode(),
+            "inputs": [s.decode() for s in nd.get(1, [])],
+            "outputs": [s.decode() for s in nd.get(2, [])],
+            "attrs": {a["name"]: a["value"]
+                      for a in (_attr(x) for x in nd.get(5, []))},
+        })
+    return out
+
+
+def _value_info(buf):
+    v = decode(buf)
+    name = v.get(1, [b""])[0].decode()
+    shape = ()
+    if 2 in v:
+        tp = decode(v[2][0])
+        if 1 in tp:
+            tt = decode(tp[1][0])
+            if 2 in tt:
+                dims = decode(tt[2][0]).get(1, [])
+                shape = tuple(decode(d).get(1, [0])[0] for d in dims)
+    return (name, shape)
+
+
+def _attr(buf):
+    a = decode(buf)
+    name = a.get(1, [b""])[0].decode()
+    atype = a.get(20, [0])[0]
+    if atype == ATTR_FLOAT:
+        value = a[2][0]
+    elif atype == ATTR_INT:
+        value = _signed(a[3][0])
+    elif atype == ATTR_STRING:
+        value = a[4][0].decode()
+    elif atype == ATTR_INTS:
+        value = tuple(_signed(i) for i in a.get(8, []))
+    elif atype == ATTR_FLOATS:
+        value = tuple(a.get(7, []))
+    else:
+        value = a
+    return {"name": name, "value": value}
+
+
+def _signed(u):
+    return u - (1 << 64) if u >= (1 << 63) else u

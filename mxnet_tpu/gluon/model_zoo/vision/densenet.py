@@ -3,7 +3,6 @@ from __future__ import annotations
 
 from ...block import HybridBlock
 from ... import nn
-from ....ops.tensor_ops import concat
 
 __all__ = ["DenseNet", "densenet121", "densenet161", "densenet169",
            "densenet201"]
@@ -27,7 +26,7 @@ class _DenseLayer(HybridBlock):
             self.body.add(nn.Dropout(dropout))
 
     def hybrid_forward(self, F, x):
-        return concat(x, self.body(x), dim=self._axis)
+        return F.concat(x, self.body(x), dim=self._axis)
 
 
 def _make_transition(num_output_features, layout="NCHW"):

@@ -3,7 +3,6 @@ from __future__ import annotations
 
 from ...block import HybridBlock
 from ... import nn
-from ....ops.tensor_ops import concat
 
 __all__ = ["Inception3", "inception_v3"]
 
@@ -27,7 +26,7 @@ class _Branches(HybridBlock):
             self.register_child(b, f"branch{i}")
 
     def hybrid_forward(self, F, x):
-        return concat(*[b(x) for b in self._children.values()],
+        return F.concat(*[b(x) for b in self._children.values()],
                       dim=self._axis)
 
 
@@ -104,7 +103,7 @@ class _BranchE2(HybridBlock):
 
     def hybrid_forward(self, F, x):
         x = self.stem(x)
-        return concat(self.a(x), self.b(x), dim=self._axis)
+        return F.concat(self.a(x), self.b(x), dim=self._axis)
 
 
 class _BranchE3(HybridBlock):
@@ -119,7 +118,7 @@ class _BranchE3(HybridBlock):
 
     def hybrid_forward(self, F, x):
         x = self.stem(x)
-        return concat(self.a(x), self.b(x), dim=self._axis)
+        return F.concat(self.a(x), self.b(x), dim=self._axis)
 
 
 def _make_E(layout):

@@ -3,7 +3,6 @@ from __future__ import annotations
 
 from ...block import HybridBlock
 from ... import nn
-from ....ops.tensor_ops import concat
 
 __all__ = ["SqueezeNet", "squeezenet1_0", "squeezenet1_1"]
 
@@ -21,8 +20,11 @@ class _Fire(HybridBlock):
                                    activation="relu", layout=layout)
 
     def hybrid_forward(self, F, x):
+        # F.concat, not the nd-level helper: symbolic export needs the
+        # trace-polymorphic namespace (this was an export-blocking bug)
         x = self.squeeze(x)
-        return concat(self.expand1x1(x), self.expand3x3(x), dim=self._axis)
+        return F.concat(self.expand1x1(x), self.expand3x3(x),
+                        dim=self._axis)
 
 
 class SqueezeNet(HybridBlock):

@@ -427,6 +427,10 @@ class Concurrent(Sequential):
         self.axis = axis
 
     def forward(self, x):
-        from ...ops.tensor_ops import concat
         outs = [block(x) for block in self._children.values()]
+        from ..block import is_symbolic
+        if is_symbolic(outs[0]):
+            from ...symbol import ops as S
+            return S.concat(*outs, dim=self.axis)
+        from ...ops.tensor_ops import concat
         return concat(*outs, dim=self.axis)

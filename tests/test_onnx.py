@@ -1,0 +1,254 @@
+"""ONNX export: structure validated node-by-node via the wire-format
+decoder, numerics validated by executing the decoded graph with a
+torch-backed mini-interpreter (an implementation independent of the
+framework's own compute path)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, sym
+from mxnet_tpu.contrib.onnx import export_model, proto
+
+
+def _mlp():
+    x = sym.Variable("data")
+    h = sym.FullyConnected(x, num_hidden=16, name="fc1")
+    h = sym.Activation(h, act_type="relu", name="relu1")
+    h = sym.FullyConnected(h, num_hidden=10, name="fc2")
+    out = sym.softmax(h, name="sm")
+    shapes = out.infer_shape(data=(2, 8))[0]
+    args = {n: nd.random.uniform(shape=s)
+            for n, s in zip(out.list_arguments(), shapes)}
+    params = {k: v for k, v in args.items() if k != "data"}
+    return out, args, params
+
+
+def test_mlp_structure_node_by_node(tmp_path):
+    out, args, params = _mlp()
+    path = export_model(out, params, {"data": (2, 8)},
+                        onnx_file_path=str(tmp_path / "mlp.onnx"))
+    m = proto.decode_model(open(path, "rb").read())
+    assert m["opset"] == [("", 11)]
+    g = m["graph"]
+    assert g["inputs"] == [("data", (2, 8))]
+    assert [o[0] for o in g["outputs"]] == ["sm"]
+    got = [(n["op_type"], n["inputs"], n["outputs"]) for n in g["nodes"]]
+    assert got == [
+        ("Flatten", ["data"], ["fc1_flat__1"]),
+        ("Gemm", ["fc1_flat__1", "fc1_weight", "fc1_bias"], ["fc1"]),
+        ("Relu", ["fc1"], ["relu1"]),
+        ("Flatten", ["relu1"], ["fc2_flat__2"]),
+        ("Gemm", ["fc2_flat__2", "fc2_weight", "fc2_bias"], ["fc2"]),
+        ("Softmax", ["fc2"], ["sm"]),
+    ]
+    gemm = g["nodes"][1]["attrs"]
+    assert gemm == {"alpha": 1.0, "beta": 1.0, "transA": 0, "transB": 1}
+    assert set(g["initializers"]) == set(params)
+    for k, v in params.items():
+        dims, dtype, raw = g["initializers"][k]
+        assert dims == v.shape and dtype == proto.FLOAT
+        assert np.allclose(np.frombuffer(raw, np.float32).reshape(dims),
+                           v.asnumpy())
+
+
+# ---------------------------------------------------------------- runtime
+def _run_onnx(model, feeds):
+    """Execute a decoded ONNX graph with torch ops — independent of the
+    framework's jax compute path."""
+    import torch
+    import torch.nn.functional as F
+    g = model["graph"]
+    env = {k: torch.from_numpy(np.frombuffer(raw, np.float32)
+                               .reshape([int(d) for d in dims]).copy())
+           for k, (dims, _dt, raw) in g["initializers"].items()}
+    for k, v in feeds.items():
+        env[k] = torch.from_numpy(np.asarray(v, np.float32))
+
+    for n in g["nodes"]:
+        op, a = n["op_type"], n["attrs"]
+        x = [env[i] for i in n["inputs"]]
+        if op == "Conv":
+            y = F.conv2d(x[0], x[1], x[2] if len(x) > 2 else None,
+                         stride=list(a["strides"]),
+                         padding=list(a["pads"][:2]),
+                         dilation=list(a["dilations"]),
+                         groups=a["group"])
+        elif op == "BatchNormalization":
+            y = F.batch_norm(x[0], x[3], x[4], x[1], x[2],
+                             training=False, eps=a["epsilon"])
+        elif op == "Relu":
+            y = F.relu(x[0])
+        elif op == "MaxPool":
+            y = F.max_pool2d(x[0], list(a["kernel_shape"]),
+                             stride=list(a["strides"]),
+                             padding=list(a["pads"][:2]))
+        elif op == "AveragePool":
+            y = F.avg_pool2d(x[0], list(a["kernel_shape"]),
+                             stride=list(a["strides"]),
+                             padding=list(a["pads"][:2]),
+                             count_include_pad=bool(
+                                 a.get("count_include_pad", 1)))
+        elif op == "GlobalAveragePool":
+            y = x[0].mean(dim=(2, 3), keepdim=True)
+        elif op == "GlobalMaxPool":
+            y = x[0].amax(dim=(2, 3), keepdim=True)
+        elif op == "Gemm":
+            y = x[0] @ (x[1].t() if a["transB"] else x[1])
+            if len(x) > 2:
+                y = y + x[2]
+        elif op == "Flatten":
+            y = x[0].reshape(x[0].shape[0], -1)
+        elif op == "Add":
+            y = x[0] + x[1]
+        elif op == "Sub":
+            y = x[0] - x[1]
+        elif op == "Mul":
+            y = x[0] * x[1]
+        elif op == "Div":
+            y = x[0] / x[1]
+        elif op == "Sqrt":
+            y = x[0].sqrt()
+        elif op == "Exp":
+            y = x[0].exp()
+        elif op == "Log":
+            y = x[0].log()
+        elif op == "ReduceMean":
+            y = x[0].mean(dim=list(a["axes"]),
+                          keepdim=bool(a.get("keepdims", 1)))
+        elif op == "ReduceMax":
+            y = x[0].amax(dim=list(a["axes"]),
+                          keepdim=bool(a.get("keepdims", 1)))
+        elif op == "ReduceSum":
+            y = x[0].sum(dim=list(a["axes"]),
+                         keepdim=bool(a.get("keepdims", 1)))
+        elif op == "Softmax":
+            y = F.softmax(x[0], dim=a.get("axis", -1))
+        elif op == "Concat":
+            y = __import__("torch").cat(x, dim=a["axis"])
+        elif op == "Dropout":
+            y = x[0]  # inference
+        elif op == "Reshape":
+            y = x[0].reshape([int(d) for d in x[1].tolist()])
+        else:
+            raise AssertionError(f"mini-runtime: unimplemented op {op}")
+        env[n["outputs"][0]] = y
+    return [env[name].numpy() for name, _ in g["outputs"]]
+
+
+def test_mlp_numerics_vs_torch_runtime(tmp_path):
+    out, args, params = _mlp()
+    path = export_model(out, params, {"data": (2, 8)},
+                        onnx_file_path=str(tmp_path / "mlp.onnx"))
+    m = proto.decode_model(open(path, "rb").read())
+    ref = out.bind(None, args).forward()[0].asnumpy()
+    got = _run_onnx(m, {"data": args["data"].asnumpy()})[0]
+    assert np.allclose(got, ref, atol=1e-5)
+
+
+@pytest.mark.parametrize("name", ["resnet18_v1", "alexnet",
+                                  "squeezenet1.0", "densenet121"])
+def test_zoo_cnn_exports_and_runs(name, tmp_path):
+    from mxnet_tpu.gluon.model_zoo.vision import get_model
+    net = get_model(name, classes=10)
+    net.initialize()
+    shape = (1, 3, 64, 64)
+    x = nd.random.uniform(shape=shape)
+    ref = net(x).asnumpy()
+    graph = net(sym.Variable("data"))
+    params = {k: v.data() for k, v in net.collect_params().items()}
+    path = export_model(graph, params, {"data": shape},
+                        onnx_file_path=str(tmp_path / f"{name}.onnx"))
+    m = proto.decode_model(open(path, "rb").read())
+    g = m["graph"]
+    assert len(g["nodes"]) > 5
+    # every non-data graph input is materialised as an initializer
+    assert set(g["initializers"]) == set(graph.list_arguments() +
+                                         graph.list_auxiliary_states()) - \
+        {"data"}
+    got = _run_onnx(m, {"data": x.asnumpy()})[0]
+    assert np.allclose(got, ref, atol=1e-3), \
+        f"{name}: onnx runtime diverges (max err " \
+        f"{np.abs(got - ref).max():.2e})"
+
+
+def test_unsupported_op_raises(tmp_path):
+    g = sym.SequenceReverse(sym.Variable("d"))
+    with pytest.raises(mx.base.MXNetError, match="no converter"):
+        export_model(g, {}, {"d": (3, 2)},
+                     onnx_file_path=str(tmp_path / "x.onnx"))
+
+
+def test_fix_gamma_pins_ones(tmp_path):
+    """sym.BatchNorm defaults fix_gamma=True (gamma pinned to ones in
+    compute); the exporter must pin the serialized scale too."""
+    x = sym.Variable("data")
+    out = sym.BatchNorm(x, name="bn")  # fix_gamma=True default
+    shapes = dict(zip(out.list_arguments() + out.list_auxiliary_states(),
+                      list(out.infer_shape(data=(2, 3, 4, 4))[0]) +
+                      list(out.infer_shape(data=(2, 3, 4, 4))[2])))
+    params = {n: nd.random.uniform(1.5, 2.5, shape=s)
+              for n, s in shapes.items() if n != "data"}
+    path = export_model(out, params, {"data": (2, 3, 4, 4)},
+                        onnx_file_path=str(tmp_path / "bn.onnx"))
+    m = proto.decode_model(open(path, "rb").read())
+    bn = [n for n in m["graph"]["nodes"]
+          if n["op_type"] == "BatchNormalization"][0]
+    scale_name = bn["inputs"][1]
+    assert scale_name != "bn_gamma", "raw gamma serialized despite fix_gamma"
+    dims, _dt, raw = m["graph"]["initializers"][scale_name]
+    assert np.allclose(np.frombuffer(raw, np.float32), 1.0)
+    # numerics agree with the framework's fix_gamma compute (aux states
+    # must go through aux_states=, not args — Executor defaults them
+    # otherwise)
+    aux_names = set(out.list_auxiliary_states())
+    data = nd.random.uniform(shape=(2, 3, 4, 4))
+    args = {"data": data,
+            **{k: v for k, v in params.items() if k not in aux_names}}
+    aux = {k: v for k, v in params.items() if k in aux_names}
+    ref = out.bind(None, args, aux_states=aux).forward()[0].asnumpy()
+    got = _run_onnx(m, {"data": data.asnumpy()})[0]
+    assert np.allclose(got, ref, atol=1e-4)
+
+
+def test_softmax_nonlast_axis_decomposed(tmp_path):
+    """opset-11 Softmax coerces to 2D, so axis != -1 must be decomposed
+    into max-shifted Exp/ReduceSum/Div to keep MXNet's per-axis meaning."""
+    x = sym.Variable("data")
+    out = sym.softmax(x, axis=1, name="sm")
+    path = export_model(out, {}, {"data": (2, 3, 5)},
+                        onnx_file_path=str(tmp_path / "sm.onnx"))
+    m = proto.decode_model(open(path, "rb").read())
+    ops = [n["op_type"] for n in m["graph"]["nodes"]]
+    assert "Softmax" not in ops and "Div" in ops and "ReduceMax" in ops
+    d = nd.random.uniform(shape=(2, 3, 5))
+    ref = out.bind(None, {"data": d}).forward()[0].asnumpy()
+    got = _run_onnx(m, {"data": d.asnumpy()})[0]
+    assert np.allclose(got, ref, atol=1e-5)
+
+
+def test_unknown_output_shape_omits_shape_field(tmp_path):
+    """Unknown shapes must omit TensorShapeProto (present-but-empty means
+    rank 0 to ONNX consumers)."""
+    out, args, params = _mlp()
+    path = export_model(out, params, {"data": (2, 8)},
+                        onnx_file_path=str(tmp_path / "m.onnx"))
+    raw = open(path, "rb").read()
+    g = proto.decode(proto.decode(raw)[7][0])
+    (out_vi,) = g[12]
+    v = proto.decode(out_vi)
+    tensor = proto.decode(proto.decode(v[2][0])[1][0])
+    assert 2 not in tensor, "shape field present for unknown output shape"
+
+
+def test_stem_s2d_rejected(tmp_path):
+    from mxnet_tpu.gluon.model_zoo.vision import resnet18_v1
+    net = resnet18_v1(layout="NHWC", stem_s2d=True)
+    net.initialize()
+    x = nd.random.uniform(shape=(1, 32, 32, 3))
+    net(x)
+    graph = net(sym.Variable("data"))
+    with pytest.raises(mx.base.MXNetError, match="stem_s2d|NCHW|NHWC"):
+        export_model(graph,
+                     {k: v.data() for k, v in net.collect_params().items()},
+                     {"data": (1, 32, 32, 3)},
+                     onnx_file_path=str(tmp_path / "s.onnx"))
