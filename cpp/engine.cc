@@ -11,13 +11,39 @@
 //   * WaitForVar blocks until every op touching the var so far is done;
 //   * WaitForAll blocks until the engine drains.
 //
+// Debug mode (MXTPU_ENGINE_DEBUG=1 or MXTPUEngineSetDebug) is the race /
+// deadlock detector (reference: the ENGINE_DEBUG checks + NaiveEngine
+// cross-validation story of threaded_engine):
+//   * write-write / read-write hazard detection — per-var running-state
+//     invariants (at most one running writer, never writer+readers) are
+//     verified at every release and on demand via MXTPUEngineDebugCheck.
+//     MXTPUEngineDebugBypassPush schedules an op WITHOUT dependency
+//     admission, simulating a buggy scheduler so tests can provoke a real
+//     concurrent-writer hazard and watch the detector catch it.
+//   * deadlock detection — an op that lists the same var as both read and
+//     write would wait on itself forever (admission admits the read, then
+//     queues the write behind it). Debug mode records the cycle and drops
+//     the redundant read dep so the program stays live. Dependency cycles
+//     ACROSS ops cannot form by construction: Push acquires all vars
+//     atomically in program order, so every wait edge points to an
+//     earlier-pushed op (verified by a queue seq-monotonicity assert).
+//   * stall watchdog — MXTPUEngineWaitAllFor(ms) returns nonzero instead
+//     of blocking forever when the engine cannot drain.
+// Errors are recorded (MXTPUEngineLastError), not aborted, so the Python
+// layer can raise.
+//
 // Exposed as a plain C ABI consumed via ctypes (mxnet_tpu/_native.py).
 
 #include <atomic>
+#include <chrono>
 #include <condition_variable>
 #include <cstdint>
+#include <cstdlib>
+#include <cstdio>
+#include <cstring>
 #include <deque>
 #include <mutex>
+#include <string>
 #include <thread>
 #include <unordered_map>
 #include <vector>
@@ -29,7 +55,7 @@ struct Op;
 struct VarState {
   std::deque<std::pair<Op*, bool>> queue;  // (op, is_write) in program order
   int running_reads = 0;
-  bool running_write = false;
+  int running_writes = 0;  // int, not bool: debug mode must SEE a double-admit
 };
 
 struct Op {
@@ -37,12 +63,15 @@ struct Op {
   void* arg;
   std::vector<uint64_t> reads;
   std::vector<uint64_t> writes;
+  uint64_t seq = 0;
   std::atomic<int> wait{0};
 };
 
 class Engine {
  public:
   explicit Engine(int workers) : workers_(workers > 0 ? workers : 1) {
+    const char* dbg = std::getenv("MXTPU_ENGINE_DEBUG");
+    debug_ = dbg && dbg[0] && std::strcmp(dbg, "0") != 0;
     for (int i = 0; i < workers_; ++i)
       threads_.emplace_back([this] { WorkerLoop(); });
   }
@@ -69,7 +98,7 @@ class Engine {
     std::unique_lock<std::mutex> lk(vars_mu_);
     auto it = vars_.find(v);
     if (it != vars_.end() && it->second.queue.empty() &&
-        it->second.running_reads == 0 && !it->second.running_write)
+        it->second.running_reads == 0 && it->second.running_writes == 0)
       vars_.erase(it);
   }
 
@@ -80,15 +109,54 @@ class Engine {
     op->arg = arg;
     op->reads.assign(reads, reads + nreads);
     op->writes.assign(writes, writes + nwrites);
+    // self-dependency = guaranteed deadlock (read admits, write queues
+    // behind it, op waits on itself): ALWAYS drop the redundant read dep
+    // (a write already orders after all prior readers); debug mode also
+    // reports the cycle so the caller can fix their dependency lists
+    {
+      std::vector<uint64_t> cleaned;
+      for (uint64_t r : op->reads) {
+        bool also_written = false;
+        for (uint64_t w : op->writes) also_written |= (w == r);
+        if (!also_written)
+          cleaned.push_back(r);
+        else if (debug_)
+          RecordError("deadlock: op reads AND writes var " +
+                      std::to_string(r) +
+                      " (self-dependency cycle; read dep dropped)");
+      }
+      op->reads.swap(cleaned);
+    }
     pending_.fetch_add(1);
     // wait on every var; each var either admits the op now or queues it
-    op->wait.store(nreads + nwrites + 1);  // +1 guard against races below
+    op->wait.store(static_cast<int>(op->reads.size() + op->writes.size()) +
+                   1);  // +1 guard against races below
     {
       std::unique_lock<std::mutex> lk(vars_mu_);
+      op->seq = next_seq_++;
       for (uint64_t v : op->reads) AdmitOrQueue(op, v, /*is_write=*/false);
       for (uint64_t v : op->writes) AdmitOrQueue(op, v, /*is_write=*/true);
     }
     FinishDep(op);  // drop the guard
+  }
+
+  // Debug only: schedule WITHOUT dependency admission — simulates a buggy
+  // scheduler so tests can provoke a real write-write hazard.
+  void DebugBypassPush(void (*fn)(void*), void* arg, const uint64_t* reads,
+                       int nreads, const uint64_t* writes, int nwrites) {
+    Op* op = new Op();
+    op->fn = fn;
+    op->arg = arg;
+    op->reads.assign(reads, reads + nreads);
+    op->writes.assign(writes, writes + nwrites);
+    pending_.fetch_add(1);
+    {
+      std::unique_lock<std::mutex> lk(vars_mu_);
+      for (uint64_t v : op->reads) ++vars_[v].running_reads;
+      for (uint64_t v : op->writes) ++vars_[v].running_writes;
+    }
+    DebugCheck();
+    Enqueue(op);
   }
 
   void WaitForVar(uint64_t v) {
@@ -97,7 +165,8 @@ class Engine {
       auto it = vars_.find(v);
       if (it == vars_.end()) return true;
       const VarState& s = it->second;
-      return s.queue.empty() && s.running_reads == 0 && !s.running_write;
+      return s.queue.empty() && s.running_reads == 0 &&
+             s.running_writes == 0;
     });
   }
 
@@ -106,17 +175,80 @@ class Engine {
     idle_cv_.wait(lk, [&] { return pending_.load() == 0; });
   }
 
+  // 0 = drained; 1 = timed out with work still pending (stall/deadlock)
+  int WaitAllFor(int timeout_ms) {
+    std::unique_lock<std::mutex> lk(vars_mu_);
+    bool ok = idle_cv_.wait_for(lk, std::chrono::milliseconds(timeout_ms),
+                                [&] { return pending_.load() == 0; });
+    if (!ok)
+      RecordErrorLocked(
+          "stall: engine did not drain within " +
+          std::to_string(timeout_ms) + "ms with " +
+          std::to_string(pending_.load()) + " op(s) pending");
+    return ok ? 0 : 1;
+  }
+
+  // 0 = invariants hold; 1 = hazard recorded
+  int DebugCheck() {
+    std::unique_lock<std::mutex> lk(vars_mu_);
+    int bad = 0;
+    for (auto& [id, s] : vars_) {
+      if (s.running_writes > 1) {
+        RecordErrorLocked("write-write hazard: var " + std::to_string(id) +
+                          " has " + std::to_string(s.running_writes) +
+                          " concurrent writers");
+        bad = 1;
+      }
+      if (s.running_writes > 0 && s.running_reads > 0) {
+        RecordErrorLocked("read-write hazard: var " + std::to_string(id) +
+                          " has a writer and " +
+                          std::to_string(s.running_reads) +
+                          " reader(s) running concurrently");
+        bad = 1;
+      }
+      if (s.running_writes < 0 || s.running_reads < 0) {
+        RecordErrorLocked("release underflow on var " + std::to_string(id));
+        bad = 1;
+      }
+    }
+    return bad;
+  }
+
+  void SetDebug(bool on) { debug_ = on; }
+  bool debug() const { return debug_; }
+
+  const char* LastError() {
+    // thread_local snapshot: the pointer stays valid on THIS thread until
+    // its next LastError() call — concurrent callers cannot invalidate it
+    // (a shared member snapshot would be a use-after-free under races)
+    static thread_local std::string snapshot;
+    std::unique_lock<std::mutex> lk(err_mu_);
+    snapshot = last_error_;
+    return snapshot.c_str();
+  }
+
+  void ClearError() {
+    std::unique_lock<std::mutex> lk(err_mu_);
+    last_error_.clear();
+  }
+
   int workers() const { return workers_; }
 
  private:
   // vars_mu_ must be held
   void AdmitOrQueue(Op* op, uint64_t v, bool is_write) {
     VarState& s = vars_[v];
-    bool can_run = s.queue.empty() && !s.running_write &&
+    if (debug_ && !s.queue.empty() && s.queue.back().first->seq >= op->seq) {
+      // proof obligation for deadlock-freedom: per-var queues are in push
+      // order, so wait edges always point to earlier ops (acyclic)
+      RecordErrorLocked("queue order violation on var " + std::to_string(v) +
+                        " (wait-graph acyclicity broken)");
+    }
+    bool can_run = s.queue.empty() && s.running_writes == 0 &&
                    (!is_write || s.running_reads == 0);
     if (can_run) {
       if (is_write)
-        s.running_write = true;
+        ++s.running_writes;
       else
         ++s.running_reads;
       FinishDepLocked(op);
@@ -172,36 +304,62 @@ class Engine {
     auto it = vars_.find(v);
     if (it == vars_.end()) return;
     VarState& s = it->second;
-    if (is_write)
-      s.running_write = false;
-    else
+    if (is_write) {
+      if (debug_ && s.running_writes > 1)
+        RecordErrorLocked("write-write hazard: var " + std::to_string(v) +
+                          " had " + std::to_string(s.running_writes) +
+                          " concurrent writers at release");
+      if (debug_ && s.running_writes > 0 && s.running_reads > 0)
+        RecordErrorLocked("read-write hazard: var " + std::to_string(v) +
+                          " released a write while " +
+                          std::to_string(s.running_reads) +
+                          " reader(s) were running");
+      --s.running_writes;
+    } else {
       --s.running_reads;
+    }
+    if (debug_ && (s.running_writes < 0 || s.running_reads < 0))
+      RecordErrorLocked("release underflow on var " + std::to_string(v));
     // drain: a write runs alone; consecutive reads run together
     while (!s.queue.empty()) {
       auto [op, w] = s.queue.front();
       if (w) {
-        if (s.running_reads == 0 && !s.running_write) {
-          s.running_write = true;
+        if (s.running_reads == 0 && s.running_writes == 0) {
+          ++s.running_writes;
           s.queue.pop_front();
           unblocked->push_back(op);
         }
         break;
       }
-      if (s.running_write) break;
+      if (s.running_writes > 0) break;
       ++s.running_reads;
       s.queue.pop_front();
       unblocked->push_back(op);
     }
   }
 
+  void RecordError(const std::string& msg) {
+    std::unique_lock<std::mutex> lk(err_mu_);
+    if (last_error_.size() > 4096) return;  // bounded: keep earliest
+    if (!last_error_.empty()) last_error_ += "; ";
+    last_error_ += msg;
+  }
+  // alias: callable with vars_mu_ held (err_mu_ is a distinct leaf lock)
+  void RecordErrorLocked(const std::string& msg) { RecordError(msg); }
+
   const int workers_;
   std::vector<std::thread> threads_;
+  bool debug_ = false;
 
   std::mutex vars_mu_;
   std::unordered_map<uint64_t, VarState> vars_;
   uint64_t next_var_ = 1;
+  uint64_t next_seq_ = 1;
   std::atomic<int> pending_{0};
   std::condition_variable idle_cv_;  // waits on vars_mu_
+
+  std::mutex err_mu_;
+  std::string last_error_;
 
   std::mutex ready_mu_;
   std::condition_variable ready_cv_;
@@ -230,8 +388,34 @@ void MXTPUEngineWaitForVar(void* h, uint64_t v) {
   static_cast<Engine*>(h)->WaitForVar(v);
 }
 void MXTPUEngineWaitAll(void* h) { static_cast<Engine*>(h)->WaitAll(); }
+int MXTPUEngineWaitAllFor(void* h, int timeout_ms) {
+  return static_cast<Engine*>(h)->WaitAllFor(timeout_ms);
+}
 int MXTPUEngineNumWorkers(void* h) {
   return static_cast<Engine*>(h)->workers();
+}
+
+// ---- debug / race-detector API ----
+void MXTPUEngineSetDebug(void* h, int on) {
+  static_cast<Engine*>(h)->SetDebug(on != 0);
+}
+int MXTPUEngineDebugEnabled(void* h) {
+  return static_cast<Engine*>(h)->debug() ? 1 : 0;
+}
+int MXTPUEngineDebugCheck(void* h) {
+  return static_cast<Engine*>(h)->DebugCheck();
+}
+const char* MXTPUEngineLastError(void* h) {
+  return static_cast<Engine*>(h)->LastError();
+}
+void MXTPUEngineClearError(void* h) {
+  static_cast<Engine*>(h)->ClearError();
+}
+void MXTPUEngineDebugBypassPush(void* h, void (*fn)(void*), void* arg,
+                                const uint64_t* reads, int nreads,
+                                const uint64_t* writes, int nwrites) {
+  static_cast<Engine*>(h)->DebugBypassPush(fn, arg, reads, nreads, writes,
+                                           nwrites);
 }
 
 }  // extern "C"

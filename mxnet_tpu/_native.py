@@ -53,8 +53,21 @@ def _load():
                                     _U64A, ctypes.c_int, _U64A, ctypes.c_int]
     lib.MXTPUEngineWaitForVar.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
     lib.MXTPUEngineWaitAll.argtypes = [ctypes.c_void_p]
+    lib.MXTPUEngineWaitAllFor.restype = ctypes.c_int
+    lib.MXTPUEngineWaitAllFor.argtypes = [ctypes.c_void_p, ctypes.c_int]
     lib.MXTPUEngineNumWorkers.restype = ctypes.c_int
     lib.MXTPUEngineNumWorkers.argtypes = [ctypes.c_void_p]
+    lib.MXTPUEngineSetDebug.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.MXTPUEngineDebugEnabled.restype = ctypes.c_int
+    lib.MXTPUEngineDebugEnabled.argtypes = [ctypes.c_void_p]
+    lib.MXTPUEngineDebugCheck.restype = ctypes.c_int
+    lib.MXTPUEngineDebugCheck.argtypes = [ctypes.c_void_p]
+    lib.MXTPUEngineLastError.restype = ctypes.c_char_p
+    lib.MXTPUEngineLastError.argtypes = [ctypes.c_void_p]
+    lib.MXTPUEngineClearError.argtypes = [ctypes.c_void_p]
+    lib.MXTPUEngineDebugBypassPush.argtypes = [
+        ctypes.c_void_p, _CB, ctypes.c_void_p, _U64A, ctypes.c_int, _U64A,
+        ctypes.c_int]
     return lib
 
 
@@ -96,18 +109,20 @@ class NativeEngine:
             var._native_id = vid
         return vid
 
-    def push(self, fn, read_vars=(), write_vars=()):
+    def _push_impl(self, fn, read_vars, write_vars, dedup, native_push):
+        """Shared body of push and the debug push variants: task + future
+        bookkeeping, per-var future mirroring (so wait_* rethrow semantics
+        match _PyEngine — failed readers included), then the C call."""
         read_ids = list(dict.fromkeys(self._var_id(v) for v in read_vars))
         write_ids = list(dict.fromkeys(self._var_id(v) for v in write_vars))
-        read_ids = [v for v in read_ids if v not in write_ids]
+        if dedup:
+            read_ids = [v for v in read_ids if v not in write_ids]
         fut = Future()
         key = next(self._ids)
         with self._lock:
             self._tasks[key] = (fn, fut, read_ids, write_ids)
             self._pending.add(fut)
         fut.add_done_callback(self._discard)
-        # Mirror _PyEngine's per-var future bookkeeping so the wait_* rethrow
-        # semantics are identical across engines (failed readers included).
         for v in read_vars:
             with v._lock:
                 v._reads.append(fut)
@@ -117,10 +132,13 @@ class NativeEngine:
                 v._reads = []
         ra = (ctypes.c_uint64 * len(read_ids))(*read_ids)
         wa = (ctypes.c_uint64 * len(write_ids))(*write_ids)
-        self._lib.MXTPUEnginePush(self._h, self._trampoline,
-                                  ctypes.c_void_p(key),
-                                  ra, len(read_ids), wa, len(write_ids))
+        native_push(self._h, self._trampoline, ctypes.c_void_p(key),
+                    ra, len(read_ids), wa, len(write_ids))
         return fut
+
+    def push(self, fn, read_vars=(), write_vars=()):
+        return self._push_impl(fn, read_vars, write_vars, dedup=True,
+                               native_push=self._lib.MXTPUEnginePush)
 
     def _discard(self, fut):
         with self._lock:
@@ -146,6 +164,41 @@ class NativeEngine:
             self._lib.MXTPUEngineWaitAll(self._h)
         for f in futs:
             f.result()
+
+    # -- debug / race-detector surface (MXTPU_ENGINE_DEBUG=1) ---------------
+    def set_debug(self, on):
+        self._lib.MXTPUEngineSetDebug(self._h, 1 if on else 0)
+
+    def debug_enabled(self):
+        return bool(self._lib.MXTPUEngineDebugEnabled(self._h))
+
+    def debug_check(self):
+        """Returns 0 if per-var invariants hold, 1 if a hazard was found
+        (details in last_error)."""
+        return int(self._lib.MXTPUEngineDebugCheck(self._h))
+
+    def last_error(self):
+        return (self._lib.MXTPUEngineLastError(self._h) or b"").decode()
+
+    def clear_error(self):
+        self._lib.MXTPUEngineClearError(self._h)
+
+    def wait_for_all_timeout(self, timeout_ms):
+        """0 = drained; 1 = stall/deadlock suspected (work still pending)."""
+        return int(self._lib.MXTPUEngineWaitAllFor(self._h, timeout_ms))
+
+    def _debug_push_raw(self, fn, read_vars=(), write_vars=()):
+        """TEST ONLY: push without the Python-side reads/writes dedup so
+        the native self-dependency (deadlock) detector can be exercised."""
+        return self._push_impl(fn, read_vars, write_vars, dedup=False,
+                               native_push=self._lib.MXTPUEnginePush)
+
+    def _debug_bypass_push(self, fn, read_vars=(), write_vars=()):
+        """TEST ONLY: schedule fn WITHOUT dependency admission — simulates
+        a scheduler bug so the hazard detector can be provoked."""
+        return self._push_impl(
+            fn, read_vars, write_vars, dedup=False,
+            native_push=self._lib.MXTPUEngineDebugBypassPush)
 
     def _shutdown(self):
         h, self._h = self._h, None

@@ -10,14 +10,27 @@ Two layers:
     mxnet_tpu/_native.py), with a pure-Python threadpool fallback providing
     identical semantics: push(fn, read_vars, write_vars) with read/write
     dependency ordering per variable, wait_for_var, wait_for_all.
+
+Engine-var users today: data prefetch (io.py / gluon DataLoader), NDArray
+save/load (ndarray/utils.py — async writes ordered against loads by a
+per-file Var), and recordio writes (recordio.py).
+
+Debug mode (MXTPU_ENGINE_DEBUG=1 or `set_debug(True)`) turns on the race /
+deadlock detector: write-write and read-write hazard checks on every
+release, self-dependency (deadlock-cycle) detection at push, and a bounded
+`wait_for_all_timeout` for stall watchdogs. Errors are reported via
+`last_error()` / raised by `debug_check_raise()`.
 """
 from __future__ import annotations
 
+import os as _os
 import threading
 from concurrent.futures import ThreadPoolExecutor
 
 __all__ = ["Var", "push", "wait_for_var", "wait_for_all", "set_bulk_size",
-           "num_workers", "native_engine_loaded"]
+           "num_workers", "native_engine_loaded", "file_var", "set_debug",
+           "debug_enabled", "debug_check", "debug_check_raise", "last_error",
+           "clear_error", "wait_for_all_timeout"]
 
 
 class Var:
@@ -38,8 +51,61 @@ class _PyEngine:
         self._pending = set()
         self._plock = threading.Lock()
         self.workers = workers
+        self._debug = bool(_os.environ.get("MXTPU_ENGINE_DEBUG"))
+        self._last_error = ""
+        self._hazard = False
+
+    # debug surface mirroring NativeEngine (the Python engine's scheduling
+    # is future-based so bypass-injection does not apply; self-dep and
+    # stall detection are the meaningful checks here)
+    def set_debug(self, on):
+        self._debug = bool(on)
+
+    def debug_enabled(self):
+        return self._debug
+
+    def debug_check(self):
+        # invariant violations only — a recorded stall is informational,
+        # matching the native engine's per-var invariant scan
+        return 1 if self._hazard else 0
+
+    def last_error(self):
+        return self._last_error
+
+    def clear_error(self):
+        self._last_error = ""
+        self._hazard = False
+
+    def _record(self, msg, hazard=False):
+        if hazard:
+            self._hazard = True
+        if len(self._last_error) > 4096:
+            return  # bounded: keep the earliest messages
+        self._last_error = (self._last_error + "; " if self._last_error
+                            else "") + msg
+
+    def wait_for_all_timeout(self, timeout_ms):
+        import time
+        deadline = time.monotonic() + timeout_ms / 1000.0
+        with self._plock:
+            futs = list(self._pending)
+        for f in futs:
+            rem = deadline - time.monotonic()
+            if rem <= 0 or not _done_within(f, rem):
+                self._record(f"stall: engine did not drain within "
+                             f"{timeout_ms}ms")
+                return 1
+        return 0
 
     def push(self, fn, read_vars=(), write_vars=()):
+        if self._debug:
+            overlap = [v for v in read_vars if v in write_vars]
+            for _v in overlap:
+                self._record("deadlock: op reads AND writes the same var "
+                             "(self-dependency cycle; read dep dropped)",
+                             hazard=True)
+            if overlap:
+                read_vars = [v for v in read_vars if v not in write_vars]
         deps = []
         for v in read_vars:
             with v._lock:
@@ -86,6 +152,17 @@ class _PyEngine:
             f.result()
 
 
+def _done_within(fut, seconds):
+    from concurrent.futures import TimeoutError as _FTimeout
+    try:
+        fut.exception(timeout=seconds)
+        return True
+    except _FTimeout:
+        return False
+    except Exception:
+        return True  # completed (with error) counts as done
+
+
 _engine = None
 _native = None
 
@@ -130,3 +207,74 @@ def set_bulk_size(size):
 
 def num_workers():
     return getattr(_get(), "workers", 1)
+
+
+# ---------------------------------------------------------- file vars
+_file_vars = {}
+_file_vars_lock = threading.Lock()
+
+
+def file_var(path):
+    """The dependency Var for a filesystem path. Host IO (NDArray save,
+    recordio writes) pushes write ops on this var; loads/readers wait on it
+    — the same var discipline the reference engine applies to NDArray
+    save/load (reference: NDArray::Save pushed with the array + output
+    vars)."""
+    p = _os.path.abspath(str(path))
+    with _file_vars_lock:
+        v = _file_vars.get(p)
+        if v is None:
+            if len(_file_vars) > 256:
+                _evict_drained_file_vars_locked()
+            v = _file_vars[p] = Var()
+        return v
+
+
+def _evict_drained_file_vars_locked():
+    """Drop file vars whose ops have all completed (step-stamped checkpoint
+    runs would otherwise leak one Var + native var id per path)."""
+    eng = _get()
+    for p, v in list(_file_vars.items()):
+        with v._lock:
+            done = (v._last_write is None or v._last_write.done()) and \
+                all(f.done() for f in v._reads)
+        if done:
+            nid = getattr(v, "_native_id", None)
+            if nid is not None and getattr(eng, "_h", None):
+                eng._lib.MXTPUEngineDelVar(eng._h, nid)
+            del _file_vars[p]
+
+
+# ---------------------------------------------------------- debug facade
+def set_debug(on):
+    """Toggle the engine race/deadlock detector (env: MXTPU_ENGINE_DEBUG)."""
+    _get().set_debug(on)
+
+
+def debug_enabled():
+    return _get().debug_enabled()
+
+
+def debug_check():
+    """0 = per-var scheduling invariants hold; 1 = hazard recorded."""
+    return _get().debug_check()
+
+
+def debug_check_raise():
+    """Raise MXNetError when the detector has recorded a hazard."""
+    if _get().debug_check():
+        from .base import MXNetError
+        raise MXNetError(f"engine hazard: {last_error()}")
+
+
+def last_error():
+    return _get().last_error()
+
+
+def clear_error():
+    _get().clear_error()
+
+
+def wait_for_all_timeout(timeout_ms):
+    """Bounded drain: 0 = drained, 1 = stall/deadlock suspected."""
+    return _get().wait_for_all_timeout(timeout_ms)

@@ -102,6 +102,38 @@ class KVStore:
         self._updater = None
         self._optimizer = None
         self._mesh = None
+        self._compression = None   # {"type": "2bit"|"int8", ...}
+        self._residuals = {}       # key -> error-feedback residual (sharded)
+        self._wire_cache = {}      # (shape,dtype,axis,cfg) -> jitted program
+
+    def set_gradient_compression(self, compression_params):
+        """Enable quantized allreduce with error feedback (reference:
+        python/mxnet/kvstore.py set_gradient_compression, 2-bit with
+        residuals). TPU-native re-design: instead of ps-lite server
+        compression, the stacked 'ici' allreduce becomes a shard_map that
+        quantizes each replica's local contribution, `all_gather`s the
+        small codes over the mesh axis (a psum of codes is meaningless, so
+        the exchange is gather + local dequant-sum — the same traffic
+        pattern as the reference's compressed push), and keeps the
+        quantization error as a per-replica residual added into the next
+        step ("error feedback", which preserves convergence).
+
+        types:
+          * '2bit'  — values quantize to {-threshold, 0, +threshold}
+            (threshold param, default 0.5); 4 codes pack per byte: 16x
+            less wire traffic than f32.
+          * 'int8'  — symmetric per-tensor scale (pmax-synced), int8
+            codes: 4x less wire traffic.
+        """
+        p = dict(compression_params or {})
+        ctype = p.get("type")
+        if ctype not in ("2bit", "int8"):
+            raise MXNetError(f"unsupported gradient compression {ctype!r}; "
+                             "use '2bit' or 'int8'")
+        p.setdefault("threshold", 0.5)
+        self._compression = p
+        self._residuals = {}
+        return self
 
     @property
     def type(self):
@@ -116,8 +148,12 @@ class KVStore:
         return jax.process_count() if self._kind == "ici" else 1
 
     def set_mesh(self, mesh):
-        """Attach a jax.sharding.Mesh (ici backend) for psum lowering."""
+        """Attach a jax.sharding.Mesh (ici backend) for psum lowering.
+        Invalidates compiled compressed-collective programs and residuals —
+        both are placed on the old mesh."""
         self._mesh = mesh
+        self._wire_cache = {}
+        self._residuals = {}
         return self
 
     # ------------------------------------------------------------------
@@ -138,7 +174,7 @@ class KVStore:
         else:
             values = [_as_list(v) for v in value]
         for k, vals in zip(keys, values):
-            agg = self.allreduce_([v._data for v in vals])
+            agg = self.allreduce_([v._data for v in vals], key=str(k))
             k = str(k)
             if self._updater is not None:
                 if k not in self._store:
@@ -184,7 +220,7 @@ class KVStore:
                          "(SURVEY.md §2 #49); use dense pull")
 
     # ------------------------------------------------------------------
-    def allreduce_(self, arrays, axis=None, layout="auto"):
+    def allreduce_(self, arrays, axis=None, layout="auto", key=None):
         """Sum tower values across data-parallel replicas.
 
         `arrays` (list of jax arrays) is summed elementwise — the 'local' /
@@ -226,6 +262,8 @@ class KVStore:
             return out
         if layout != "stacked":
             raise MXNetError(f"unknown allreduce layout {layout!r}")
+        if self._compression is not None and key is not None:
+            return self._compressed_psum_stacked(out, axis, key)
         return self._psum_stacked(out, axis)
 
     @staticmethod
@@ -251,6 +289,120 @@ class KVStore:
         f = shard_map(lambda x: jax.lax.psum(jnp.sum(x, axis=0), axis),
                       mesh=mesh, in_specs=P(axis), out_specs=P())
         return f(a)
+
+    # ----------------------------------------- compressed collectives
+    def compression_wire_fn(self, a, axis=None):
+        """The compressed-allreduce program for a stacked array like `a`,
+        shard_map-wrapped, exposed so tests/tools can inspect its jaxpr
+        (e.g. assert the all_gather operand is uint8/int8 — the bytes that
+        actually cross the interconnect). Call with (stacked, residual)
+        full-shape arrays or pass to jax.make_jaxpr."""
+        from jax.sharding import PartitionSpec as P
+        from jax import shard_map
+        axis = axis or self._mesh.axis_names[0]
+        n = self._mesh.shape[axis]
+        wire = self._make_wire_fn(a.shape[1:], a.dtype, axis)
+        return shard_map(wire, mesh=self._mesh,
+                         in_specs=(P(axis), P(axis)),
+                         out_specs=(P(), P(axis)), check_vma=False)
+
+    def _make_wire_fn(self, inner_shape, dtype, axis):
+        comp = dict(self._compression)
+        ctype, thr = comp["type"], float(comp["threshold"])
+        size = 1
+        for d in inner_shape:
+            size *= int(d)
+
+        if ctype == "2bit":
+            pad = (-size) % 4
+            weights = jnp.asarray([1, 4, 16, 64], jnp.uint8)
+
+            def encode(local):
+                flat = jnp.concatenate(
+                    [local.ravel().astype(jnp.float32),
+                     jnp.zeros((pad,), jnp.float32)]) if pad else \
+                    local.ravel().astype(jnp.float32)
+                codes = jnp.where(flat >= thr, jnp.uint8(1),
+                                  jnp.where(flat <= -thr, jnp.uint8(2),
+                                            jnp.uint8(0)))
+                packed = (codes.reshape(-1, 4) * weights).sum(
+                    axis=1, dtype=jnp.uint8)
+                return packed, None
+
+            def decode(packed, _meta):
+                codes = jnp.stack(
+                    [(packed >> s) & 3 for s in (0, 2, 4, 6)],
+                    axis=1).reshape(-1)[:size]
+                val = jnp.where(codes == 1, thr,
+                                jnp.where(codes == 2, -thr, 0.0))
+                return val.reshape(inner_shape).astype(dtype)
+
+            def wire(rows, r):
+                local = jnp.sum(rows, axis=0) + r[0]
+                packed, _ = encode(local)
+                gathered = jax.lax.all_gather(packed, axis)   # (n, bytes)
+                total = jnp.sum(
+                    jax.vmap(lambda p: decode(p, None))(gathered), axis=0)
+                new_r = local - decode(packed, None)
+                return total.astype(dtype), new_r[None].astype(dtype)
+
+            wire_bytes = (size + pad) // 4
+        else:  # int8
+            def wire(rows, r):
+                local = (jnp.sum(rows, axis=0) + r[0]).astype(jnp.float32)
+                # one shared scale so the gathered codes sum exactly
+                absmax = jax.lax.pmax(jnp.max(jnp.abs(local)), axis)
+                scale = jnp.maximum(absmax, 1e-30) / 127.0
+                codes = jnp.clip(jnp.round(local / scale),
+                                 -127, 127).astype(jnp.int8)
+                gathered = jax.lax.all_gather(codes, axis)  # (n, *inner)
+                total = jnp.sum(gathered.astype(jnp.int32), axis=0) * scale
+                new_r = local - codes.astype(jnp.float32) * scale
+                return total.astype(dtype), new_r[None].astype(dtype)
+
+            wire_bytes = size  # int8: one byte per element
+
+        wire.wire_bytes = wire_bytes
+        wire.raw_bytes = size * jnp.dtype(dtype).itemsize
+        return wire
+
+    def _compressed_psum_stacked(self, a, axis, key):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from jax import shard_map
+        mesh = self._mesh
+        n = mesh.shape[axis]
+        if a.ndim == 0 or a.shape[0] % n:
+            raise MXNetError(
+                f"stacked allreduce needs dim0 divisible by mesh axis "
+                f"{axis!r} size {n}, got shape {a.shape}")
+        inner = a.shape[1:]
+        res = self._residuals.get(key)
+        if res is None or res.shape != (n,) + inner:
+            res = jax.device_put(jnp.zeros((n,) + inner, a.dtype),
+                                 NamedSharding(mesh, P(axis)))
+        cfg = (inner, str(a.dtype), axis, self._compression["type"],
+               float(self._compression["threshold"]))
+        entry = self._wire_cache.get(cfg)
+        if entry is None:
+            wire = self._make_wire_fn(inner, a.dtype, axis)
+            # check_vma=False: the total IS replicated (every device sums
+            # the same all_gathered codes) but the static checker cannot
+            # infer replication through the decode/sum pipeline. jit the
+            # shard_map and CACHE it — a fresh trace per step would
+            # recompile the collective every push.
+            f = jax.jit(shard_map(wire, mesh=mesh,
+                                  in_specs=(P(axis), P(axis)),
+                                  out_specs=(P(), P(axis)),
+                                  check_vma=False))
+            entry = self._wire_cache[cfg] = (f, wire)
+        f, wire = entry
+        total, new_res = f(a, res)
+        self._residuals[key] = new_res
+        self.compression_stats = {
+            "key": key, "type": self._compression["type"],
+            "wire_bytes_per_replica": int(wire.wire_bytes),
+            "raw_bytes_per_replica": int(wire.raw_bytes)}
+        return total
 
     # ------------------------------------------------------------------
     def set_optimizer(self, optimizer):

@@ -1,5 +1,11 @@
 """NDArray serialisation (reference: mx.nd.save / mx.nd.load, C API
-NDArraySave/NDArrayLoad). Format: numpy .npz — portable, no custom binary."""
+NDArraySave/NDArrayLoad). Format: numpy .npz — portable, no custom binary.
+
+The disk write is pushed onto the dependency engine with a per-file write
+var (reference: NDArray::Save is a PushAsync over the array vars), so
+save() returns once the values are snapshotted and the write overlaps
+compute; load() waits on the same var, ordering after any in-flight save
+to that path. `engine.wait_for_all()` is the global barrier."""
 from __future__ import annotations
 
 import numpy as np
@@ -9,8 +15,17 @@ from .ndarray import NDArray, array
 __all__ = ["save", "load"]
 
 
+def _npz_path(fname):
+    # np.savez appends .npz when absent; the file var must track the path
+    # actually written
+    fname = str(fname)
+    return fname if fname.endswith(".npz") else fname + ".npz"
+
+
 def save(fname, data):
-    """Save a list or str-keyed dict of NDArrays."""
+    """Save a list or str-keyed dict of NDArrays. The write is async on
+    the engine (ordered per file); values are snapshotted at call time."""
+    from .. import engine
     if isinstance(data, NDArray):
         data = [data]
     if isinstance(data, (list, tuple)):
@@ -19,12 +34,17 @@ def save(fname, data):
         arrays = {f"key:{k}": v.asnumpy() for k, v in data.items()}
     else:
         raise TypeError(f"unsupported data type {type(data)}")
-    np.savez(fname if fname.endswith(".npz") else fname, **arrays)
+    engine.push(lambda: np.savez(fname, **arrays),
+                write_vars=[engine.file_var(_npz_path(fname))])
 
 
 def load(fname):
-    """Load NDArrays saved by `save` — returns list or dict matching input."""
-    with np.load(fname, allow_pickle=False) as f:
+    """Load NDArrays saved by `save` — returns list or dict matching input.
+    Waits on the file's engine var first (ordering after async saves)."""
+    from .. import engine
+    engine.wait_for_var(engine.file_var(_npz_path(fname)))
+    # np.savez appended .npz for bare names; open what was written
+    with np.load(_npz_path(fname), allow_pickle=False) as f:
         keys = list(f.keys())
         if all(k.startswith("arr:") for k in keys):
             items = sorted(keys, key=lambda k: int(k.split(":", 1)[1]))

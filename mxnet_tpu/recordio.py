@@ -47,7 +47,12 @@ def _lrec(cflag, length):
 
 
 class MXRecordIO:
-    """Sequential .rec reader/writer (reference: MXRecordIO)."""
+    """Sequential .rec reader/writer (reference: MXRecordIO).
+
+    Writes are pushed onto the dependency engine against a per-file var
+    (framing/packing happens on the caller, the disk append runs async in
+    program order); readers and close() wait on the var, so write→read on
+    the same path is race-free without a global sync."""
 
     def __init__(self, uri, flag):
         if flag not in ("r", "w"):
@@ -55,14 +60,23 @@ class MXRecordIO:
         self.uri = uri
         self.flag = flag
         self.is_open = False
+        from . import engine
+        self._engine = engine
+        self._fvar = engine.file_var(uri)
         self.open()
 
     def open(self):
+        if self.flag == "r":
+            # order after any in-flight async writes to this path
+            self._engine.wait_for_var(self._fvar)
         self.fp = open(self.uri, "rb" if self.flag == "r" else "wb")
+        self._wpos = 0
         self.is_open = True
 
     def close(self):
         if self.is_open:
+            if self.flag == "w":
+                self._engine.wait_for_var(self._fvar)  # drain async appends
             self.fp.close()
             self.is_open = False
 
@@ -83,10 +97,14 @@ class MXRecordIO:
             pass
 
     def tell(self):
-        return self.fp.tell()
+        # write mode: the logical offset (async appends may not have hit
+        # the file yet); read mode: the real file position
+        return self._wpos if self.flag == "w" else self.fp.tell()
 
     def write(self, buf):
-        """Append one record (bytes)."""
+        """Append one record (bytes). Framing happens here (so offsets are
+        known synchronously for the .idx sidecar); the disk append runs
+        async on the engine, serialised per file."""
         if self.flag != "w":
             raise MXNetError("record file opened for reading")
         n = len(buf)
@@ -97,13 +115,18 @@ class MXRecordIO:
             chunks = [(1, parts[0])]
             chunks += [(2, p) for p in parts[1:-1]]
             chunks.append((3, parts[-1]))
+        framed = []
         for cflag, part in chunks:
-            self.fp.write(struct.pack("<II", _kMagic,
+            framed.append(struct.pack("<II", _kMagic,
                                       _lrec(cflag, len(part))))
-            self.fp.write(part)
+            framed.append(part)
             pad = (4 - len(part) % 4) % 4
             if pad:
-                self.fp.write(b"\x00" * pad)
+                framed.append(b"\x00" * pad)
+        blob = b"".join(framed)
+        self._wpos += len(blob)
+        fp = self.fp
+        self._engine.push(lambda: fp.write(blob), write_vars=[self._fvar])
 
     def read(self):
         """Read the next record, or None at EOF."""
@@ -285,6 +308,8 @@ class NativeRecordFile:
 
     def __init__(self, path):
         import ctypes
+        from . import engine
+        engine.wait_for_var(engine.file_var(path))  # order after writers
         lib = _load_native()
         if lib is None:
             raise MXNetError("native recordio unavailable")

@@ -1,4 +1,6 @@
 """KVStore tests (SURVEY.md §2 #28)."""
+import jax
+import jax.numpy as jnp
 import numpy as np
 import pytest
 
@@ -150,3 +152,111 @@ def test_init_distributed_single_host_noop():
     kvstore.init_distributed()
     kv = kvstore.create("ici")
     assert kv.num_workers == 1 and kv.rank == 0
+
+
+# ------------------------------------------------- gradient compression
+def _stacked(mesh, arr):
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    return jax.device_put(arr, NamedSharding(mesh, P("dp")))
+
+
+def test_compression_rejects_unknown_type():
+    import pytest
+    from mxnet_tpu.base import MXNetError
+    with pytest.raises(MXNetError):
+        kvstore.create("ici").set_gradient_compression({"type": "4bit"})
+
+
+def test_int8_compression_close_to_exact_and_wire_is_int8():
+    """int8 codes with a pmax-shared scale: result within quantization
+    error of the exact sum, and the gathered operand really is int8."""
+    mesh = _dp_mesh()
+    kv = kvstore.create("ici").set_mesh(mesh)
+    kv.set_gradient_compression({"type": "int8"})
+    rs = np.random.RandomState(0)
+    stacked = rs.randn(8, 64).astype(np.float32)
+    a = _stacked(mesh, stacked)
+    got = np.asarray(kv.allreduce_([a], layout="stacked", key="w"))
+    exact = stacked.sum(0)
+    # per-replica quant error <= scale/2; 8 replicas
+    scale = np.abs(stacked).max() / 127.0
+    assert np.abs(got - exact).max() <= 8 * scale * 0.51 + 1e-6
+    st = kv.compression_stats
+    assert st["wire_bytes_per_replica"] * 4 == st["raw_bytes_per_replica"]
+    # the all_gather moves int8, not f32: check the jaxpr
+    jaxpr = str(jax.make_jaxpr(kv.compression_wire_fn(a))(
+        jnp.zeros((8, 64), jnp.float32), jnp.zeros((8, 64), jnp.float32)))
+    import re
+    m = re.search(r":i8\[[^\]]*\]\s*=\s*all_gather", jaxpr)
+    assert m, jaxpr[:2000]
+
+
+def test_2bit_compression_wire_is_16x_smaller():
+    mesh = _dp_mesh()
+    kv = kvstore.create("ici").set_mesh(mesh)
+    kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    stacked = np.full((8, 64), 0.6, np.float32)
+    a = _stacked(mesh, stacked)
+    got = np.asarray(kv.allreduce_([a], layout="stacked", key="w"))
+    # every element >= threshold: each replica contributes +0.5
+    np.testing.assert_allclose(got, np.full(64, 8 * 0.5), rtol=1e-6)
+    st = kv.compression_stats
+    assert st["wire_bytes_per_replica"] * 16 == st["raw_bytes_per_replica"]
+    jaxpr = str(jax.make_jaxpr(kv.compression_wire_fn(a))(
+        jnp.zeros((8, 64), jnp.float32), jnp.zeros((8, 64), jnp.float32)))
+    import re
+    m = re.search(r":u8\[[^\]]*\]\s*=\s*all_gather", jaxpr)
+    assert m, jaxpr[:2000]
+
+
+def test_2bit_error_feedback_accumulates():
+    """A constant gradient below threshold must still get through over
+    steps via the residual (the whole point of error feedback)."""
+    mesh = _dp_mesh()
+    kv = kvstore.create("ici").set_mesh(mesh)
+    kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    stacked = np.full((8, 16), 0.2, np.float32)  # below threshold
+    a = _stacked(mesh, stacked)
+    sums = [np.asarray(kv.allreduce_([a], layout="stacked", key="g")).mean()
+            for _ in range(10)]
+    # step pattern: residual builds 0.2,0.4->fire 0.5,...; over 10 steps
+    # the mean transmitted value approaches the true 8*0.2=1.6 per step
+    assert abs(np.mean(sums) - 8 * 0.2) < 0.25, sums
+    assert max(sums) > 0  # it does fire
+
+
+def test_compressed_training_matches_uncompressed():
+    """MLP trained with int8-compressed ici allreduce converges to the
+    same solution as uncompressed (within tolerance) on the 8-device
+    mesh — the VERDICT r2 item 4 acceptance test."""
+    from mxnet_tpu.parallel.mesh import make_mesh
+
+    def train(compression):
+        rs = np.random.RandomState(1)
+        w_true = rs.randn(10, 1).astype(np.float32)
+        X = rs.randn(256, 10).astype(np.float32)
+        y = X @ w_true
+        mesh = make_mesh({"dp": 8})
+        kv = kvstore.create("ici").set_mesh(mesh)
+        if compression:
+            kv.set_gradient_compression(compression)
+        w = jnp.zeros((10, 1), jnp.float32)
+        kv.init("w", mx.nd.array(np.zeros((10, 1), np.float32)))
+        grad_fn = jax.jit(jax.grad(
+            lambda w, X, y: jnp.mean((X @ w - y) ** 2)))
+        lr = 0.05
+        for step in range(60):
+            # 8 towers, each on its slice of the batch (stacked layout)
+            grads = np.stack([np.asarray(grad_fn(
+                w, X[i * 32:(i + 1) * 32], y[i * 32:(i + 1) * 32]))
+                for i in range(8)])
+            g = _stacked(mesh, grads.astype(np.float32))
+            total = kv.allreduce_([g], layout="stacked", key="w")
+            w = w - lr * jnp.asarray(total) / 8.0
+        final = float(jnp.mean((X @ w - y) ** 2))
+        return final
+
+    base = train(None)
+    comp = train({"type": "int8"})
+    assert base < 1e-3, f"uncompressed failed to converge: {base}"
+    assert comp < 5e-3, f"int8-compressed failed to converge: {comp}"
