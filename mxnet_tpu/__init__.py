@@ -54,6 +54,7 @@ from . import callback
 from . import monitor
 from . import profiler
 from . import amp
+from . import upstream
 from . import utils
 from . import visualization as viz
 from . import runtime

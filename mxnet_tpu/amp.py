@@ -15,6 +15,7 @@ overflow, and halves the scale (§5 failure-detection: `skip_nonfinite`).
 """
 from __future__ import annotations
 
+import jax
 import numpy as np
 
 import jax.numpy as jnp
@@ -104,18 +105,24 @@ class DynamicLossScaler:
                 self._unskipped = 0
 
 
+@jax.jit
+def _any_nonfinite(grads):
+    # jit's own tracing cache keys on the input avals, so this compiles
+    # once per gradient-signature — no hand-rolled cache needed
+    bad = [jnp.sum(~jnp.isfinite(g.astype(jnp.float32)), dtype=jnp.int32)
+           for g in grads]
+    return sum(bad) > 0
+
+
 def grads_nonfinite(params):
-    """True if any parameter gradient contains inf/nan. One fused device
-    reduction + a single host sync."""
-    checks = [jnp.isfinite(p._grad._data.astype(jnp.float32)).all()
-              for p in params
-              if getattr(p, "_grad", None) is not None]
-    if not checks:
+    """True if any parameter gradient contains inf/nan. ONE jitted program
+    over all gradients producing a single scalar — one dispatch + one host
+    sync per step, not one tiny `isfinite().all()` launch per parameter."""
+    grads = [p._grad._data for p in params
+             if getattr(p, "_grad", None) is not None]
+    if not grads:
         return False
-    ok = checks[0]
-    for c in checks[1:]:
-        ok = jnp.logical_and(ok, c)
-    return not bool(ok)
+    return bool(_any_nonfinite(grads))
 
 
 def scale_loss(loss, trainer_or_scaler=None):

@@ -1,0 +1,309 @@
+"""Upstream-MXNet binary ``.params`` interop (reference:
+src/ndarray/ndarray.cc NDArray::Save/Load, src/c_api MXNDArraySave/Load,
+python/mxnet/model.py load_checkpoint).
+
+The reference serialises NDArray lists with a dmlc::Stream layout; real
+deployments have years of ``model-0000.params`` files in it. This module
+reads and writes that layout so upstream checkpoints load straight into
+mxnet_tpu nets (and ours export back). Dense tensors only — sparse storage
+is a documented divergence (SURVEY §8).
+
+Wire layout (all little-endian):
+
+  list file      := [u64 0x112 magic][u64 reserved]
+                    [u64 N][N x ndarray][u64 K][K x string]
+  string         := [u64 len][bytes]                (dmlc string save)
+  ndarray        := [u32 version magic]
+                    V3 (0xF993FACA): [i32 stype]  (0 = dense; others
+                                                   rejected)
+                    [shape][i32 dev_type][i32 dev_id][i32 type_flag]
+                    [raw bytes, C order]
+  shape          := [u32 ndim][ndim x i64]          (V2/V3; V1 uses u32
+                                                     dims; pre-magic
+                                                     legacy: the first u32
+                                                     IS ndim, u32 dims)
+
+``arg:``/``aux:`` key prefixes follow the reference Module checkpoint
+convention (model.py:save_checkpoint); gluon ``.params`` files carry bare
+block-scoped names (e.g. ``resnetv10_conv2d0_weight``).
+"""
+from __future__ import annotations
+
+import struct
+
+import numpy as np
+
+from .base import MXNetError
+from .ndarray.ndarray import NDArray, array
+
+__all__ = ["save_params", "load_params", "load_checkpoint_params",
+           "load_params_into"]
+
+_LIST_MAGIC = 0x112
+_V1 = 0xF993FAC8   # u32 dims
+_V2 = 0xF993FAC9   # i64 dims
+_V3 = 0xF993FACA   # + i32 storage type
+_DTYPE_OF_FLAG = {0: np.float32, 1: np.float64, 2: np.float16, 3: np.uint8,
+                  4: np.int32, 5: np.int8, 6: np.int64}
+try:  # flag 12 = kBfloat16 (mshadow/base.h), present in upstream >= 1.6
+    import ml_dtypes as _mld
+    _DTYPE_OF_FLAG[12] = _mld.bfloat16
+except ImportError:  # pragma: no cover
+    pass
+_FLAG_OF_DTYPE = {np.dtype(v): k for k, v in _DTYPE_OF_FLAG.items()}
+
+
+class _Reader:
+    def __init__(self, data):
+        self.data = data
+        self.pos = 0
+
+    def take(self, n):
+        if self.pos + n > len(self.data):
+            raise MXNetError("truncated upstream .params file")
+        out = self.data[self.pos:self.pos + n]
+        self.pos += n
+        return out
+
+    def u32(self):
+        return struct.unpack("<I", self.take(4))[0]
+
+    def i32(self):
+        return struct.unpack("<i", self.take(4))[0]
+
+    def u64(self):
+        return struct.unpack("<Q", self.take(8))[0]
+
+    def i64(self):
+        return struct.unpack("<q", self.take(8))[0]
+
+
+def _read_ndarray(r):
+    first = r.u32()
+    if first == _V3:
+        stype = r.i32()
+        if stype != 0:
+            raise MXNetError(f"sparse storage type {stype} not supported "
+                             "on TPU (dense only; SURVEY §8)")
+        ndim = r.u32()
+        shape = tuple(r.i64() for _ in range(ndim))
+    elif first == _V2:
+        ndim = r.u32()
+        shape = tuple(r.i64() for _ in range(ndim))
+    elif first == _V1:
+        ndim = r.u32()
+        shape = tuple(r.u32() for _ in range(ndim))
+    else:
+        # pre-magic legacy: `first` IS ndim (u32 dims)
+        ndim = first
+        if ndim > 32:
+            raise MXNetError(f"unrecognised ndarray magic {first:#x}")
+        shape = tuple(r.u32() for _ in range(ndim))
+    r.i32()  # dev_type — arrays always load to the default device here
+    r.i32()  # dev_id
+    type_flag = r.i32()
+    if type_flag not in _DTYPE_OF_FLAG:
+        raise MXNetError(f"unknown type_flag {type_flag}")
+    dtype = np.dtype(_DTYPE_OF_FLAG[type_flag])
+    size = int(np.prod(shape, dtype=np.int64)) if shape else 1
+    raw = r.take(size * dtype.itemsize)
+    return np.frombuffer(raw, dtype=dtype).reshape(shape).copy()
+
+
+def _write_ndarray(out, arr):
+    arr = np.ascontiguousarray(arr)
+    if arr.dtype not in _FLAG_OF_DTYPE:
+        # no silent float32 coercion: a round trip must preserve values
+        # AND dtype semantics (the reference errors the same way)
+        supported = sorted(str(np.dtype(v)) for v in _DTYPE_OF_FLAG.values())
+        raise MXNetError(f"dtype {arr.dtype} has no upstream type_flag; "
+                         f"supported: {supported}")
+    out.append(struct.pack("<I", _V2))
+    out.append(struct.pack("<I", arr.ndim))
+    for d in arr.shape:
+        out.append(struct.pack("<q", d))
+    out.append(struct.pack("<ii", 1, 0))  # cpu(0), like reference saves
+    out.append(struct.pack("<i", _FLAG_OF_DTYPE[arr.dtype]))
+    out.append(arr.tobytes())
+
+
+def save_params(fname, data):
+    """Write a dict (or list) of NDArrays in the upstream binary layout
+    (reference: MXNDArraySave). Dict keys become the saved names."""
+    if isinstance(data, NDArray):
+        data = [data]
+    if isinstance(data, dict):
+        names = list(data.keys())
+        arrays = [data[k] for k in names]
+    else:
+        names, arrays = [], list(data)
+    out = [struct.pack("<QQ", _LIST_MAGIC, 0),
+           struct.pack("<Q", len(arrays))]
+    for a in arrays:
+        _write_ndarray(out, a.asnumpy() if hasattr(a, "asnumpy") else a)
+    out.append(struct.pack("<Q", len(names)))
+    for n in names:
+        b = n.encode("utf-8")
+        out.append(struct.pack("<Q", len(b)))
+        out.append(b)
+    with open(fname, "wb") as f:
+        f.write(b"".join(out))
+    return fname
+
+
+def load_params(fname):
+    """Read an upstream .params file: dict when names are present, else a
+    list (reference: MXNDArrayLoad return convention)."""
+    with open(fname, "rb") as f:
+        r = _Reader(f.read())
+    if r.u64() != _LIST_MAGIC:
+        raise MXNetError(f"{fname}: not an upstream NDArray list file "
+                         "(bad magic)")
+    r.u64()  # reserved
+    n = r.u64()
+    arrays = [array(_read_ndarray(r)) for _ in range(n)]
+    k = r.u64()
+    names = []
+    for _ in range(k):
+        ln = r.u64()
+        names.append(r.take(ln).decode("utf-8"))
+    if not names:
+        return arrays
+    if len(names) != len(arrays):
+        raise MXNetError(f"{fname}: {len(names)} names for {len(arrays)} "
+                         "arrays")
+    return dict(zip(names, arrays))
+
+
+def load_checkpoint_params(fname):
+    """Split a Module-style checkpoint into (arg_params, aux_params) by the
+    'arg:'/'aux:' key prefixes (reference: model.py load_checkpoint)."""
+    loaded = load_params(fname)
+    if not isinstance(loaded, dict):
+        raise MXNetError(f"{fname} has no names; not a checkpoint")
+    arg_params, aux_params = {}, {}
+    for k, v in loaded.items():
+        if k.startswith("arg:"):
+            arg_params[k[4:]] = v
+        elif k.startswith("aux:"):
+            aux_params[k[4:]] = v
+        else:
+            arg_params[k] = v
+    return arg_params, aux_params
+
+
+def _strip_scope(name):
+    """Drop the leading block-scope prefix (`resnetv10_`, `mobilenet0_`,
+    ...) so checkpoints from a differently-numbered scope still match:
+    upstream and mxnet_tpu both auto-name scopes with a global counter, so
+    the same architecture saved in different processes differs only there.
+    Strips the first segment only when it is digit-bearing AND the tail
+    still carries a digit-bearing segment (the layer identity):
+    `net0_batchnorm0_running_mean` -> `batchnorm0_running_mean`, but the
+    bare layer names `conv2d0_weight` / `batchnorm0_running_mean` are left
+    intact — the layer counter, not a scope, carries their identity."""
+    head, _, tail = name.partition("_")
+    if tail and any(c.isdigit() for c in head) and \
+            any(c.isdigit() for seg in tail.split("_") for c in seg):
+        return tail
+    return name
+
+
+def load_params_into(block, fname, name_map=None, allow_missing=False,
+                     ignore_extra=False):
+    """Load an upstream .params (gluon save_parameters or Module
+    checkpoint) into a Block. Matching order per target param: explicit
+    `name_map` (upstream name per OUR name), exact name, scope-stripped
+    name; every match is shape-checked. Returns the list of our param
+    names that were set (reference: gluon Block.load_parameters +
+    model_zoo model_store loading)."""
+    arg_params, aux_params = load_checkpoint_params(fname)
+    merged = {**arg_params, **aux_params}
+    file_order = list(merged)
+    stripped = {}
+    for k in merged:
+        stripped.setdefault(_strip_scope(k), []).append(k)
+    params = block.collect_params()
+    name_map = name_map or {}
+
+    # Phase 1: resolve every target by name (name_map > exact > stripped)
+    # WITHOUT consuming anything, so a later fallback cannot be steered by
+    # a stale table.
+    mapping, unresolved = {}, []
+    mismatch_msg = None
+    for ours in params:
+        explicit = ours in name_map
+        if explicit:
+            src = name_map[ours]
+            if src not in merged:
+                raise MXNetError(f"name_map: {src!r} not in {fname}")
+        elif ours in merged:
+            src = ours
+        else:
+            cands = stripped.get(_strip_scope(ours), [])
+            if len(cands) > 1:
+                raise MXNetError(
+                    f"ambiguous match for {ours!r} in {fname}: {cands}; "
+                    "disambiguate via name_map")
+            src = cands[0] if cands else None
+        if src is not None and \
+                tuple(params[ours].shape) != tuple(merged[src].shape):
+            msg = (f"shape mismatch for {ours!r}: param "
+                   f"{tuple(params[ours].shape)} vs file "
+                   f"{tuple(merged[src].shape)}")
+            if explicit:
+                raise MXNetError(msg)  # the user pinned this pairing
+            # an implicit name hit with the wrong shape is counter drift,
+            # not a verdict: let the positional fallback try; re-raise
+            # this (better diagnostic) if it can't
+            mismatch_msg = mismatch_msg or msg
+            src = None
+        if src is None:
+            unresolved.append(ours)
+        else:
+            mapping[ours] = src
+
+    # Phase 2: if names could not resolve everything, fall back to ORDERED
+    # positional matching for the WHOLE file (a consistent bijection, only
+    # when counts match and every shape agrees in order). Covers
+    # layer-counter drift (`conv2d1_weight` net vs `conv2d0_weight` file:
+    # the same architecture built twice in one process shifts the
+    # NameManager counters — upstream has the identical behaviour).
+    if unresolved:
+        ours_order = list(params)
+        if len(file_order) == len(ours_order) and all(
+                tuple(params[o].shape) == tuple(merged[s].shape)
+                for o, s in zip(ours_order, file_order)):
+            mapping = dict(zip(ours_order, file_order))
+        elif not allow_missing:
+            raise MXNetError(
+                mismatch_msg or
+                f"no parameter for {unresolved[0]!r} in {fname} "
+                "(pass allow_missing=True to skip)")
+        else:
+            for ours in unresolved:
+                mapping.pop(ours, None)
+
+    # duplicate targets would silently drop data
+    taken = {}
+    for ours, src in mapping.items():
+        if src in taken:
+            raise MXNetError(f"{src!r} in {fname} matched both "
+                             f"{taken[src]!r} and {ours!r}; use name_map")
+        taken[src] = ours
+
+    loaded = []
+    for ours, p in params.items():
+        src = mapping.get(ours)
+        if src is None:
+            continue
+        v = merged.pop(src)
+        if tuple(p.shape) != tuple(v.shape):
+            raise MXNetError(f"shape mismatch for {ours!r}: param "
+                             f"{tuple(p.shape)} vs file {tuple(v.shape)}")
+        p.set_data(v)
+        loaded.append(ours)
+    if merged and not ignore_extra:
+        raise MXNetError(f"extra parameters in {fname}: "
+                         f"{sorted(merged)[:8]}... (pass ignore_extra=True)")
+    return loaded
