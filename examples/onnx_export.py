@@ -65,6 +65,18 @@ def main():
     assert set(g["initializers"]) == {k for k in params}
     print(f"wrote {path} ({size} bytes), opset {model['opset']}")
     print("ops:", " -> ".join(ops))
+
+    # 4. and back again: onnx2mx import reproduces the trained net
+    from mxnet_tpu.contrib.onnx import import_model, import_to_gluon
+    xv = nd.array(rs.randn(2, 1, 16, 16).astype(np.float32))
+    ref = net(xv).asnumpy()
+    sym2, arg_p, aux_p = import_model(path)
+    ex = sym2.bind(None, {"data": xv, **arg_p}, aux_states=aux_p)
+    got = ex.forward()[0].asnumpy()
+    assert np.allclose(got, ref, atol=1e-5), "import diverges from source"
+    block = import_to_gluon(path)
+    assert np.allclose(block(xv).asnumpy(), ref, atol=1e-5)
+    print("import round-trip: logits identical")
     print("OK")
 
 

@@ -1,10 +1,12 @@
 """mx.contrib.onnx (reference: python/mxnet/contrib/onnx).
 
-Export is self-contained (hand-rolled protobuf wire format — see proto.py);
-no `onnx` package needed. Import (onnx→mxnet) is out of scope: the
-deployment inverse here is SymbolBlock.imports on the native symbol.json.
+Both directions are self-contained (hand-rolled protobuf wire format —
+see proto.py); no `onnx` package needed:
+  * export_model: Symbol + params → .onnx (mx2onnx)
+  * import_model / import_to_gluon: .onnx → Symbol + params (onnx2mx)
 """
 from .export import export_model
+from .import_model import import_model, import_to_gluon
 from . import proto
 
-__all__ = ["export_model", "proto"]
+__all__ = ["export_model", "import_model", "import_to_gluon", "proto"]

@@ -141,11 +141,39 @@ def decode(buf):
     return fields
 
 
+def _packed_ints(values):
+    """Repeated int64 fields arrive either as individual varints (our
+    encoder) or as ONE length-delimited packed blob (proto3 writers like
+    the onnx package / torch exporters). Normalise to a tuple of ints."""
+    out = []
+    for v in values:
+        if isinstance(v, bytes):
+            pos = 0
+            while pos < len(v):
+                n, pos = _read_varint(v, pos)
+                out.append(n)
+        else:
+            out.append(v)
+    return tuple(out)
+
+
+def _packed_floats(values):
+    out = []
+    for v in values:
+        if isinstance(v, bytes):
+            out.extend(x[0] for x in struct.iter_unpack("<f", v))
+        else:
+            out.append(v)
+    return tuple(out)
+
+
 def decode_model(buf):
     """Parse a serialized ModelProto into a friendly dict for tests:
     {ir_version, opset, graph: {name, inputs, outputs, initializers:
     {name: (dims, data_type, raw)}, nodes: [{op_type, name, inputs,
-    outputs, attrs: {name: value}}]}}."""
+    outputs, attrs: {name: value}}]}}. Handles both unpacked (this repo's
+    encoder) and proto3-packed repeated int/float fields (external ONNX
+    writers)."""
     m = decode(buf)
     graph = decode(m[7][0])
     out = {
@@ -164,7 +192,7 @@ def decode_model(buf):
         td = decode(t)
         name = td.get(8, [b""])[0].decode()
         out["graph"]["initializers"][name] = (
-            tuple(td.get(1, [])), td.get(2, [None])[0],
+            _packed_ints(td.get(1, [])), td.get(2, [None])[0],
             td.get(9, [b""])[0])
     for n in graph.get(1, []):
         nd = decode(n)
@@ -204,9 +232,9 @@ def _attr(buf):
     elif atype == ATTR_STRING:
         value = a[4][0].decode()
     elif atype == ATTR_INTS:
-        value = tuple(_signed(i) for i in a.get(8, []))
+        value = tuple(_signed(i) for i in _packed_ints(a.get(8, [])))
     elif atype == ATTR_FLOATS:
-        value = tuple(a.get(7, []))
+        value = _packed_floats(a.get(7, []))
     else:
         value = a
     return {"name": name, "value": value}

@@ -24,6 +24,7 @@ __all__ = ["FullyConnected", "Convolution", "StemConvS2D", "Activation",
            "flatten", "Flatten", "reshape", "Custom", "RNN",
            "SequenceMask", "SequenceLast", "SequenceReverse",
            "smooth_l1", "softmin", "hard_sigmoid",
+           "cast", "Cast", "take",
            "transpose", "concat", "Concat", "dot", "batch_dot", "sum", "mean",
            "max", "min", "relu", "sigmoid", "tanh", "exp", "log", "sqrt",
            "square", "negative", "zeros", "ones", "broadcast_add",
@@ -620,6 +621,27 @@ def Custom(*inputs, op_type=None, name=None, **prop_kwargs):
     return _make("_custom", list(inputs),
                  {"op_type": op_type, **prop_kwargs}, name=name,
                  n_out=len(prop.list_outputs()))
+
+
+# -- cast / indexing (reference: tensor cast + take ops) --------------------
+register_op("cast", lambda x, dtype="float32": x.astype(dtype))
+register_op("take",
+            lambda a, idx, axis=0, mode="clip":
+            jnp.take(a, idx.astype(jnp.int32), axis=axis,
+                     mode={"clip": "clip", "wrap": "wrap"}.get(mode, "clip")))
+register_op("abs", jnp.abs)
+
+
+def cast(data, dtype="float32", name=None):
+    return _make("cast", [data], {"dtype": dtype}, name=name)
+
+
+Cast = cast
+
+
+def take(a, indices, axis=0, mode="clip", name=None):
+    return _make("take", [a, indices], {"axis": axis, "mode": mode},
+                 name=name)
 
 
 # -- sequence ops (reference: src/operator/sequence_*.cc) -------------------

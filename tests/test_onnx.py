@@ -252,3 +252,147 @@ def test_stem_s2d_rejected(tmp_path):
                      {k: v.data() for k, v in net.collect_params().items()},
                      {"data": (1, 32, 32, 3)},
                      onnx_file_path=str(tmp_path / "s.onnx"))
+
+
+# -------------------------------------------------- import (onnx2mx)
+def test_import_mlp_roundtrip(tmp_path):
+    """export -> import -> bind reproduces the original network exactly
+    (reference: onnx2mx import_model return convention)."""
+    from mxnet_tpu.contrib.onnx import import_model
+    out, args, params = _mlp()
+    path = export_model(out, params, {"data": (2, 8)},
+                        onnx_file_path=str(tmp_path / "m.onnx"))
+    ref = out.bind(None, args).forward()[0].asnumpy()
+    sym2, arg_p, aux_p = import_model(path)
+    assert set(arg_p) == set(params) and not aux_p
+    ex = sym2.bind(None, {"data": args["data"], **arg_p})
+    np.testing.assert_allclose(ex.forward()[0].asnumpy(), ref, atol=1e-5)
+
+
+@pytest.mark.parametrize("name", ["resnet18_v1", "squeezenet1.0"])
+def test_import_zoo_cnn_roundtrip(name, tmp_path):
+    """CNN with BatchNorm/pools/concat: import must classify running stats
+    as aux and reproduce logits."""
+    from mxnet_tpu.contrib.onnx import import_model
+    from mxnet_tpu.gluon.model_zoo.vision import get_model
+    net = get_model(name, classes=10)
+    net.initialize()
+    x = nd.random.uniform(shape=(1, 3, 64, 64))
+    ref = net(x).asnumpy()
+    graph = net(sym.Variable("data"))
+    params = {k: v.data() for k, v in net.collect_params().items()}
+    path = export_model(graph, params, {"data": (1, 3, 64, 64)},
+                        onnx_file_path=str(tmp_path / "z.onnx"))
+    sym2, arg_p, aux_p = import_model(path)
+    if "resnet" in name:
+        assert aux_p, "BN running stats should import as aux"
+        assert all("running" in k for k in aux_p)
+    ex = sym2.bind(None, {"data": x, **arg_p}, aux_states=aux_p)
+    np.testing.assert_allclose(ex.forward()[0].asnumpy(), ref, atol=1e-4)
+
+
+def test_import_to_gluon_runs(tmp_path):
+    from mxnet_tpu.contrib.onnx import import_to_gluon
+    out, args, params = _mlp()
+    path = export_model(out, params, {"data": (2, 8)},
+                        onnx_file_path=str(tmp_path / "g.onnx"))
+    ref = out.bind(None, args).forward()[0].asnumpy()
+    block = import_to_gluon(path)
+    got = block(args["data"]).asnumpy()
+    np.testing.assert_allclose(got, ref, atol=1e-5)
+
+
+def test_import_unknown_op_raises(tmp_path):
+    from mxnet_tpu.contrib.onnx import proto as P2, import_model
+    node = P2.message(P2.f_bytes(1, "x"), P2.f_bytes(2, "y"),
+                      P2.f_bytes(3, "n0"), P2.f_bytes(4, "NotAnOp"))
+    vi = P2.message(P2.f_bytes(1, "x"))
+    graph = P2.message(P2.f_bytes(1, node), P2.f_bytes(2, "g"),
+                       P2.f_bytes(11, vi),
+                       P2.f_bytes(12, P2.message(P2.f_bytes(1, "y"))))
+    model = P2.message(P2.f_varint(1, 6), P2.f_bytes(7, graph))
+    p = tmp_path / "bad.onnx"
+    p.write_bytes(model)
+    with pytest.raises(mx.base.MXNetError, match="no importer"):
+        import_model(str(p))
+
+
+def test_proto_decodes_packed_repeated_fields():
+    """External ONNX writers pack repeated ints (proto3); the decoder must
+    read packed and unpacked forms identically."""
+    from mxnet_tpu.contrib.onnx import proto as P2
+    # TensorProto with PACKED dims [2, 3] (field 1, wire type 2)
+    packed_dims = P2._varint(2) + P2._varint(3)
+    t = P2.message(P2.f_bytes(1, packed_dims),
+                   P2.f_varint(2, P2.FLOAT),
+                   P2.f_bytes(8, "w"),
+                   P2.f_bytes(9, np.arange(6, np.float32).tobytes()
+                              if False else
+                              np.arange(6, dtype=np.float32).tobytes()))
+    # AttributeProto with PACKED ints (field 8)
+    at = P2.message(P2.f_bytes(1, "kernel_shape"),
+                    P2.f_varint(20, P2.ATTR_INTS),
+                    P2.f_bytes(8, P2._varint(3) + P2._varint(3)))
+    node = P2.message(P2.f_bytes(1, "x"), P2.f_bytes(2, "y"),
+                      P2.f_bytes(3, "n"), P2.f_bytes(4, "MaxPool"),
+                      P2.f_bytes(5, at))
+    graph = P2.message(P2.f_bytes(1, node), P2.f_bytes(2, "g"),
+                       P2.f_bytes(5, t),
+                       P2.f_bytes(12, P2.message(P2.f_bytes(1, "y"))))
+    model = P2.message(P2.f_varint(1, 6), P2.f_bytes(7, graph))
+    m = P2.decode_model(model)
+    assert m["graph"]["initializers"]["w"][0] == (2, 3)
+    assert m["graph"]["nodes"][0]["attrs"]["kernel_shape"] == (3, 3)
+
+
+def test_import_reshape_net_no_orphan_params(tmp_path):
+    """Reshape shape tensors are attrs after import, never params."""
+    from mxnet_tpu.contrib.onnx import import_model
+    x = sym.Variable("data")
+    g = sym.reshape(sym.FullyConnected(x, num_hidden=12, name="fc"),
+                    shape=(2, 3, 4))
+    shapes = g.infer_shape(data=(2, 6))[0]
+    args = {n: nd.random.uniform(shape=s)
+            for n, s in zip(g.list_arguments(), shapes)}
+    params = {k: v for k, v in args.items() if k != "data"}
+    path = export_model(g, params, {"data": (2, 6)},
+                        onnx_file_path=str(tmp_path / "r.onnx"))
+    sym2, arg_p, aux_p = import_model(path)
+    assert set(arg_p) == set(params), arg_p.keys()  # no shape-tensor leak
+    ref = g.bind(None, args).forward()[0].asnumpy()
+    got = sym2.bind(None, {"data": args["data"], **arg_p}).forward()[0]
+    np.testing.assert_allclose(got.asnumpy(), ref, atol=1e-6)
+
+
+def test_import_squeeze_multi_axis_roundtrip(tmp_path):
+    from mxnet_tpu.contrib.onnx import import_model
+    g = sym.squeeze(sym.Variable("data"), axis=(1, 3))
+    path = export_model(g, {}, {"data": (2, 1, 3, 1)},
+                        onnx_file_path=str(tmp_path / "sq.onnx"))
+    sym2, _, _ = import_model(path)
+    d = nd.random.uniform(shape=(2, 1, 3, 1))
+    out = sym2.bind(None, {"data": d}).forward()[0]
+    assert out.shape == (2, 3)
+
+
+def test_import_pool_spec_defaults(tmp_path):
+    """A spec-minimal external MaxPool (no strides attr) means stride 1."""
+    from mxnet_tpu.contrib.onnx import proto as P2, import_model
+    at = P2.message(P2.f_bytes(1, "kernel_shape"),
+                    P2.f_varint(20, P2.ATTR_INTS),
+                    P2.f_varint(8, 2), P2.f_varint(8, 2))
+    node = P2.message(P2.f_bytes(1, "data"), P2.f_bytes(2, "y"),
+                      P2.f_bytes(3, "p0"), P2.f_bytes(4, "MaxPool"),
+                      P2.f_bytes(5, at))
+    vi = P2.message(P2.f_bytes(1, "data"))
+    graph = P2.message(P2.f_bytes(1, node), P2.f_bytes(2, "g"),
+                       P2.f_bytes(11, vi),
+                       P2.f_bytes(12, P2.message(P2.f_bytes(1, "y"))))
+    model = P2.message(P2.f_varint(1, 6), P2.f_bytes(7, graph))
+    p = tmp_path / "pool.onnx"
+    p.write_bytes(model)
+    sym2, _, _ = import_model(str(p))
+    d = nd.array(np.arange(2 * 1 * 4 * 4, dtype=np.float32)
+                 .reshape(2, 1, 4, 4))
+    out = sym2.bind(None, {"data": d}).forward()[0]
+    assert out.shape == (2, 1, 3, 3), out.shape  # stride 1, valid pads
