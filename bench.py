@@ -88,6 +88,12 @@ def supervise() -> int:
 
 
 def main():
+    # perf lever (BENCH_XLA_FLAGS=1): XLA latency-hiding scheduler +
+    # async collectives — must land in env BEFORE backend init
+    if os.environ.get("BENCH_XLA_FLAGS") == "1":
+        os.environ["LIBTPU_INIT_ARGS"] = (
+            os.environ.get("LIBTPU_INIT_ARGS", "") +
+            " --xla_tpu_enable_latency_hiding_scheduler=true")
     # honor JAX_PLATFORMS=cpu despite the axon sitecustomize force-
     # registering the TPU backend (jax.config wins if set before init) —
     # lets CI/smoke runs avoid the tunnel entirely
@@ -131,17 +137,26 @@ def main():
         return -jnp.mean(jnp.take_along_axis(logp, yb[:, None], 1)), aux
 
     lr, mu = 0.1, 0.9
+    # perf lever (BENCH_FUSED_SGD=1): one flattened multi-tensor update in
+    # fp32 (reference: multi_sgd_mom_update) instead of per-tensor subtract
+    # fusions; momentum master copy in fp32 either way it's enabled
+    fused = os.environ.get("BENCH_FUSED_SGD") == "1"
 
     def train_step(p, mom, xb, yb):
         (loss, aux), g = jax.value_and_grad(loss_fn, has_aux=True)(p, xb, yb)
-        new_mom = [mu * m + gg.astype(m.dtype) for m, gg in zip(mom, g)]
-        new_p = [pp - lr * m for pp, m in zip(p, new_mom)]
+        if fused:
+            from mxnet_tpu.optimizer.optimizer import fused_sgd_mom_kernel
+            new_p, new_mom = fused_sgd_mom_kernel(p, mom, g, lr, mu)
+        else:
+            new_mom = [mu * m + gg.astype(m.dtype) for m, gg in zip(mom, g)]
+            new_p = [pp - lr * m for pp, m in zip(p, new_mom)]
         for i, v in zip(aux_idx, aux):  # BN running stats carry through
             new_p[i] = v
         return new_p, new_mom, loss
 
     step = jax.jit(train_step, donate_argnums=(0, 1))
-    mom = [jnp.zeros_like(p) for p in params]
+    mom = [jnp.zeros(p.shape, jnp.float32) if fused else jnp.zeros_like(p)
+           for p in params]
 
     # warmup: compile + one extra to stabilise. NB sync via host fetch:
     # under the axon tunnel block_until_ready does not actually block.
