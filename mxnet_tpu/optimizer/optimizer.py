@@ -454,47 +454,56 @@ class DCASGD(Optimizer):
 # split back; under jit the concat/update/split compiles to a single fused
 # region instead of num_params small ones.
 # ---------------------------------------------------------------------------
-def fused_sgd_mom_kernel(params, moms, grads, lr, momentum, wd=0.0):
-    """Pure arrays version used inside jitted train steps:
-    params/moms/grads are matching lists; returns (new_params, new_moms).
-    Momentum follows the reference update: m = mu*m + grad (+ wd*w);
-    w -= lr*m."""
+def fused_sgd_mom_kernel(params, moms, grads, lr, momentum=0.9, wd=0.0,
+                         rescale_grad=1.0):
+    """Pure arrays version used inside jitted train steps: params/grads
+    (and moms, or None for momentum-free) are matching lists; returns
+    (new_params, new_moms). The math runs on ONE flattened fp32 vector
+    (m = mu*m + g + wd*w; w -= lr*m, the reference update); outputs cast
+    back to each input's own dtype. lr/momentum/wd/rescale_grad are traced
+    scalars — schedules do NOT retrace."""
     import jax.numpy as jnp
     sizes = [int(p.size) for p in params]
     shapes = [p.shape for p in params]
     pdt = [p.dtype for p in params]
     offs = []
     total = 0
-    for s in sizes:
+    for sz in sizes:
         offs.append(total)
-        total += s
+        total += sz
     flat_p = jnp.concatenate([p.ravel().astype(jnp.float32) for p in params])
-    flat_m = jnp.concatenate([m.ravel().astype(jnp.float32) for m in moms])
     flat_g = jnp.concatenate([g.ravel().astype(jnp.float32) for g in grads])
-    if wd:
-        flat_g = flat_g + wd * flat_p
-    flat_m = momentum * flat_m + flat_g
-    flat_p = flat_p - lr * flat_m
-    new_p, new_m = [], []
-    for off, s, shp, dt in zip(offs, sizes, shapes, pdt):
-        new_p.append(jax.lax.dynamic_slice_in_dim(flat_p, off, s)
-                     .reshape(shp).astype(dt))
-        new_m.append(jax.lax.dynamic_slice_in_dim(flat_m, off, s)
-                     .reshape(shp))
-    return new_p, new_m
+    flat_g = flat_g * rescale_grad + wd * flat_p
+    if moms is not None:
+        mdt = [m.dtype for m in moms]
+        flat_m = jnp.concatenate([m.ravel().astype(jnp.float32)
+                                  for m in moms])
+        flat_m = momentum * flat_m + flat_g
+        upd = flat_m
+    else:
+        upd = flat_g
+    flat_p = flat_p - lr * upd
+
+    def split(flat, dts):
+        return [jax.lax.dynamic_slice_in_dim(flat, off, sz)
+                .reshape(shp).astype(dt)
+                for off, sz, shp, dt in zip(offs, sizes, shapes, dts)]
+
+    if moms is None:
+        return split(flat_p, pdt), None
+    return split(flat_p, pdt), split(flat_m, mdt)
 
 
 _fused_sgd_jit = None
 
 
 def _fused_jit():
-    # one module-level jitted entry: retraces per (pytree, shapes, statics)
-    # via jit's own cache instead of building a fresh wrapper per call
+    # one module-level jitted entry: retraces per pytree/shape signature
+    # via jit's own cache; lr/momentum/wd stay traced so schedules reuse
+    # the compiled program
     global _fused_sgd_jit
     if _fused_sgd_jit is None:
-        _fused_sgd_jit = jax.jit(
-            fused_sgd_mom_kernel,
-            static_argnames=("lr", "momentum", "wd"))
+        _fused_sgd_jit = jax.jit(fused_sgd_mom_kernel)
     return _fused_sgd_jit
 
 
@@ -502,12 +511,11 @@ def multi_sgd_mom_update(weights, grads, moms, lr, momentum=0.9, wd=0.0,
                          rescale_grad=1.0):
     """Imperative multi-tensor SGD-momentum (reference:
     mx.nd.multi_sgd_mom_update): updates every weight/mom NDArray in one
-    fused dispatch."""
+    fused dispatch. Momentum buffers keep their own dtype."""
     pv = [w._data for w in weights]
     mv = [m._data for m in moms]
-    gv = [g._data * rescale_grad if rescale_grad != 1.0 else g._data
-          for g in grads]
-    new_p, new_m = _fused_jit()(pv, mv, gv, lr=lr, momentum=momentum, wd=wd)
+    gv = [g._data for g in grads]
+    new_p, new_m = _fused_jit()(pv, mv, gv, lr, momentum, wd, rescale_grad)
     for w, np_ in zip(weights, new_p):
         w._rebind(np_)
     for m, nm in zip(moms, new_m):
@@ -516,13 +524,11 @@ def multi_sgd_mom_update(weights, grads, moms, lr, momentum=0.9, wd=0.0,
 
 
 def multi_sgd_update(weights, grads, lr, wd=0.0, rescale_grad=1.0):
-    """Momentum-free variant (reference: mx.nd.multi_sgd_update)."""
-    import jax.numpy as jnp
-    zero_m = [jnp.zeros_like(w._data, dtype=jnp.float32) for w in weights]
-    gv = [g._data * rescale_grad if rescale_grad != 1.0 else g._data
-          for g in grads]
-    new_p, _ = _fused_jit()([w._data for w in weights], zero_m, gv,
-                            lr=lr, momentum=0.0, wd=wd)
+    """Momentum-free variant (reference: mx.nd.multi_sgd_update) — no
+    momentum buffers are materialised at all."""
+    pv = [w._data for w in weights]
+    gv = [g._data for g in grads]
+    new_p, _ = _fused_jit()(pv, None, gv, lr, 0.0, wd, rescale_grad)
     for w, np_ in zip(weights, new_p):
         w._rebind(np_)
     return weights

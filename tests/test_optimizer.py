@@ -127,3 +127,48 @@ def test_optimizer_with_scheduler():
     o.update(0, w, nd.array([1.0]), s)
     second = first - float(w.asnumpy()[0])
     assert second < (10.0 - first)  # lr decayed between steps
+
+
+def test_multi_tensor_sgd_matches_per_tensor():
+    """fused_sgd_mom_kernel == per-tensor SGD-momentum across mixed
+    shapes/dtypes; momentum keeps its own dtype; lr schedules reuse the
+    compiled program (no retrace per lr value)."""
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.optimizer.optimizer import (
+        fused_sgd_mom_kernel, multi_sgd_mom_update, multi_sgd_update,
+        _fused_jit)
+    rs = np.random.RandomState(3)
+    shapes = [(4, 3), (7,), (2, 2, 2)]
+    dtypes = [np.float32, np.float32, np.float16]
+    ws = [nd.array(rs.randn(*s).astype(dt)) for s, dt in zip(shapes, dtypes)]
+    gs = [nd.array(rs.randn(*s).astype(dt)) for s, dt in zip(shapes, dtypes)]
+    ms = [nd.zeros(s).astype(dt) for s, dt in zip(shapes, dtypes)]
+    ref_w = [w.asnumpy().astype(np.float32) for w in ws]
+    ref_m = [m.asnumpy().astype(np.float32) for m in ms]
+    lr, mu, wd = 0.1, 0.9, 0.01
+    for step, lr_t in enumerate([0.1, 0.05]):  # schedule: two lr values
+        before = _fused_jit()._cache_size() if step == 1 else None
+        multi_sgd_mom_update(ws, gs, ms, lr=lr_t, momentum=mu, wd=wd)
+        if step == 1:
+            assert _fused_jit()._cache_size() == before, \
+                "lr change retraced the fused update"
+        for i in range(len(ws)):
+            g32 = gs[i].asnumpy().astype(np.float32) + wd * ref_w[i]
+            ref_m[i] = mu * ref_m[i] + g32
+            ref_w[i] = ref_w[i] - lr_t * ref_m[i]
+            tol = 1e-5 if dtypes[i] == np.float32 else 2e-2
+            np.testing.assert_allclose(
+                ws[i].asnumpy().astype(np.float32), ref_w[i],
+                rtol=tol, atol=tol)
+            assert ws[i].dtype == np.dtype(dtypes[i])
+            assert ms[i].dtype == np.dtype(dtypes[i]), \
+                "momentum dtype drifted"
+
+    # momentum-free variant
+    ws2 = [nd.array(rs.randn(3, 3).astype(np.float32))]
+    gs2 = [nd.array(rs.randn(3, 3).astype(np.float32))]
+    w0 = ws2[0].asnumpy().copy()
+    multi_sgd_update(ws2, gs2, lr=0.5)
+    np.testing.assert_allclose(ws2[0].asnumpy(),
+                               w0 - 0.5 * gs2[0].asnumpy(), rtol=1e-6)
