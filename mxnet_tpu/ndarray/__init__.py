@@ -11,6 +11,9 @@ from ..ops.tensor_ops import *          # noqa: F401,F403
 from ..ops.nn_ops import *              # noqa: F401,F403
 from ..ops.seq_ops import (SequenceMask, SequenceLast,  # noqa: F401
                            SequenceReverse, smooth_l1, softmin, hard_sigmoid)
+from ..ops.extra_ops import *           # noqa: F401,F403
+from ..optimizer.optimizer import (multi_sgd_update,  # noqa: F401
+                                   multi_sgd_mom_update)
 from ..ops import tensor_ops as _t
 from ..ops import nn_ops as _n
 from ..ops import linalg_ops as linalg  # mx.nd.linalg.*

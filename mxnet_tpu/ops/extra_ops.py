@@ -1,0 +1,331 @@
+"""Classic reference ops outside the core CNN/NLP set (reference:
+src/operator/{lrn,l2_normalization,upsampling,bilinear_resize,crop,
+slice_channel,roi_pooling,spatial_transformer,correlation,make_loss}.cc
++ tensor ops batch_take/ravel/unravel/digamma).
+
+Every kernel is a static-shape vectorised XLA program (shifts, gathers,
+`jax.image.resize`) rather than the reference's per-element CUDA loops, so
+they fuse into surrounding jit programs. ROIPooling is provided for parity
+but `detection_ops.roi_align` is the production path on TPU (quantised max
+bins need data-dependent windows, which XLA only handles via masking).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray, _apply
+
+__all__ = ["LRN", "L2Normalization", "UpSampling", "BilinearResize2D",
+           "Crop", "SliceChannel", "ROIPooling", "GridGenerator",
+           "BilinearSampler", "SpatialTransformer", "Correlation",
+           "MakeLoss", "BlockGrad", "stop_gradient", "batch_take",
+           "ravel_multi_index", "unravel_index", "digamma"]
+
+
+# --------------------------------------------------------------- kernels
+def lrn_k(x, alpha=1e-4, beta=0.75, knorm=2.0, nsize=5):
+    """Across-channel local response norm, NCHW (reference: lrn.cc):
+    out = x / (knorm + alpha/n * sum_{window} x^2)^beta. The channel
+    window sum is a static stack of shifted slices — one fused region."""
+    half = nsize // 2
+    sq = x * x
+    pad = jnp.pad(sq, ((0, 0), (half, half), (0, 0), (0, 0)))
+    c = x.shape[1]
+    win = sum(pad[:, i:i + c] for i in range(nsize))
+    return x / jnp.power(knorm + (alpha / nsize) * win, beta)
+
+
+def l2_normalization_k(x, eps=1e-10, mode="instance"):
+    if mode == "instance":
+        axes = tuple(range(1, x.ndim))
+    elif mode == "channel":
+        axes = (1,)
+    elif mode == "spatial":
+        axes = tuple(range(2, x.ndim))
+    else:
+        raise MXNetError(f"L2Normalization: unknown mode {mode!r}")
+    norm = jnp.sqrt(jnp.sum(x * x, axis=axes, keepdims=True) + eps)
+    return x / norm
+
+
+def upsampling_k(x, scale=2, sample_type="nearest"):
+    n, c, h, w = x.shape
+    if sample_type == "nearest":
+        return jnp.repeat(jnp.repeat(x, scale, axis=2), scale, axis=3)
+    if sample_type == "bilinear":
+        return jax.image.resize(x, (n, c, h * scale, w * scale),
+                                method="bilinear")
+    raise MXNetError(f"UpSampling: unknown sample_type {sample_type!r}")
+
+
+def bilinear_resize_k(x, height, width):
+    n, c = x.shape[:2]
+    return jax.image.resize(x, (n, c, height, width), method="bilinear")
+
+
+def crop_k(x, h_w=None, offset=(0, 0), like_shape=None, center_crop=False):
+    th, tw = like_shape[2:] if like_shape is not None else h_w
+    if center_crop:
+        oy = (x.shape[2] - th) // 2
+        ox = (x.shape[3] - tw) // 2
+    else:
+        oy, ox = offset
+    return x[:, :, oy:oy + th, ox:ox + tw]
+
+
+def batch_take_k(a, idx):
+    return jnp.take_along_axis(
+        a, idx.astype(jnp.int32).reshape(-1, 1), axis=1)[:, 0]
+
+
+def grid_generator_k(affine, target_shape):
+    """(N, 6) affine -> (N, 2, H, W) normalised sampling grid in [-1, 1]
+    (reference: GridGenerator affine mode; row 0 = x coords, row 1 = y)."""
+    h, w = target_shape
+    theta = affine.reshape(-1, 2, 3)
+    ys = jnp.linspace(-1.0, 1.0, h)
+    xs = jnp.linspace(-1.0, 1.0, w)
+    gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+    ones = jnp.ones_like(gx)
+    base = jnp.stack([gx.ravel(), gy.ravel(), ones.ravel()])  # (3, HW)
+    out = jnp.einsum("nij,jk->nik", theta, base)              # (N, 2, HW)
+    return out.reshape(-1, 2, h, w)
+
+
+def bilinear_sampler_k(data, grid):
+    """Sample NCHW `data` at `grid` (N, 2, Ho, Wo) of [-1, 1] coords
+    (reference: BilinearSampler). Out-of-range samples clamp to the border
+    after zero-weighting, matching the reference's zero padding."""
+    n, c, h, w = data.shape
+    gx = (grid[:, 0] + 1.0) * 0.5 * (w - 1)
+    gy = (grid[:, 1] + 1.0) * 0.5 * (h - 1)
+    x0 = jnp.floor(gx)
+    y0 = jnp.floor(gy)
+    wx = gx - x0
+    wy = gy - y0
+
+    def gather(yi, xi):
+        valid = ((yi >= 0) & (yi < h) & (xi >= 0) & (xi < w))
+        yc = jnp.clip(yi, 0, h - 1).astype(jnp.int32)
+        xc = jnp.clip(xi, 0, w - 1).astype(jnp.int32)
+        flat = data.reshape(n, c, h * w)
+        idx = (yc * w + xc).reshape(n, 1, -1)
+        vals = jnp.take_along_axis(
+            flat, jnp.broadcast_to(idx, (n, c, idx.shape[-1])), axis=2)
+        vals = vals.reshape(n, c, *gx.shape[1:])
+        return vals * valid[:, None].astype(data.dtype)
+
+    out = (gather(y0, x0) * ((1 - wx) * (1 - wy))[:, None]
+           + gather(y0, x0 + 1) * (wx * (1 - wy))[:, None]
+           + gather(y0 + 1, x0) * ((1 - wx) * wy)[:, None]
+           + gather(y0 + 1, x0 + 1) * (wx * wy)[:, None])
+    return out.astype(data.dtype)
+
+
+def spatial_transformer_k(data, affine, target_shape):
+    """STN = GridGenerator + BilinearSampler (reference:
+    spatial_transformer.cc, affine/ bilinear mode only — same as cuDNN)."""
+    return bilinear_sampler_k(data, grid_generator_k(affine, target_shape))
+
+
+def _round_half_away(x):
+    # C round(): ties away from zero (jnp.round is half-to-even)
+    return jnp.where(x >= 0, jnp.floor(x + 0.5), jnp.ceil(x - 0.5))
+
+
+def roi_pooling_k(data, rois, pooled_size, spatial_scale):
+    """Max pooling over quantised ROI bins (reference: roi_pooling.cc).
+    data (N, C, H, W); rois (R, 5) = [batch_idx, x1, y1, x2, y2] in input
+    coords. Masked-max formulation (static shapes; see module docstring).
+    Bin windows clamp to the image like the reference; empty bins emit 0."""
+    ph, pw = pooled_size
+    n, c, h, w = data.shape
+
+    ys = jnp.arange(h, dtype=jnp.float32)
+    xs = jnp.arange(w, dtype=jnp.float32)
+
+    def one_roi(roi):
+        bidx = roi[0].astype(jnp.int32)
+        x1 = _round_half_away(roi[1] * spatial_scale)
+        y1 = _round_half_away(roi[2] * spatial_scale)
+        x2 = _round_half_away(roi[3] * spatial_scale)
+        y2 = _round_half_away(roi[4] * spatial_scale)
+        rh = jnp.maximum(y2 - y1 + 1.0, 1.0)
+        rw = jnp.maximum(x2 - x1 + 1.0, 1.0)
+        bh, bw = rh / ph, rw / pw
+        iy = jnp.arange(ph, dtype=jnp.float32)
+        ix = jnp.arange(pw, dtype=jnp.float32)
+        ys0 = jnp.clip(jnp.floor(y1 + iy * bh), 0, h)
+        ys1 = jnp.clip(jnp.ceil(y1 + (iy + 1) * bh), 0, h)
+        xs0 = jnp.clip(jnp.floor(x1 + ix * bw), 0, w)
+        xs1 = jnp.clip(jnp.ceil(x1 + (ix + 1) * bw), 0, w)
+        # masks: (ph, H) and (pw, W)
+        my = (ys[None] >= ys0[:, None]) & (ys[None] < ys1[:, None])
+        mx_ = (xs[None] >= xs0[:, None]) & (xs[None] < xs1[:, None])
+        mask = my[:, None, :, None] & mx_[None, :, None, :]  # (ph,pw,H,W)
+        img = data[bidx]                                      # (C, H, W)
+        neg = jnp.asarray(-jnp.inf, data.dtype)
+        vals = jnp.where(mask[:, :, None], img[None, None], neg)
+        out = jnp.max(vals, axis=(3, 4))                      # (ph, pw, C)
+        out = jnp.where(jnp.isfinite(out), out, 0.0)  # empty bin -> 0
+        return jnp.transpose(out, (2, 0, 1)).astype(data.dtype)
+
+    return jax.vmap(one_roi)(rois.astype(jnp.float32))
+
+
+def correlation_k(a, b, kernel_size=1, max_displacement=4, stride1=1,
+                  stride2=1, is_multiply=True):
+    """FlowNet-style correlation (reference: correlation.cc):
+    out[:, k, y, x] = mean_c a[:, c, y, x] (*|abs-diff) b_shifted_k for
+    each displacement k stepped by `stride2` in a (2d+1)^2 window, output
+    spatially subsampled by `stride1` — a static stack of shifted
+    elementwise products. kernel_size=1 only (the FlowNet configuration)."""
+    if kernel_size != 1:
+        raise MXNetError("Correlation: kernel_size != 1 not supported "
+                         "(FlowNet uses 1; larger kernels need a patch "
+                         "reduction the reference rarely exercises)")
+    d = max_displacement
+    n, c, h, w = a.shape
+    pad_b = jnp.pad(b, ((0, 0), (0, 0), (d, d), (d, d)))
+    outs = []
+    for dy in range(-d, d + 1, stride2):
+        for dx in range(-d, d + 1, stride2):
+            shifted = pad_b[:, :, d + dy:d + dy + h, d + dx:d + dx + w]
+            prod = a * shifted if is_multiply else jnp.abs(a - shifted)
+            outs.append(jnp.mean(prod, axis=1))
+    out = jnp.stack(outs, axis=1)
+    return out[:, :, ::stride1, ::stride1]
+
+
+# ---------------------------------------------------- autograd-shaping ops
+import functools
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def _make_loss(x, grad_scale):
+    return x
+
+
+def _ml_fwd(x, grad_scale):
+    return x, x  # residual keeps the aval for shape/dtype
+
+
+def _ml_bwd(grad_scale, res, g):
+    # the node IS the loss: incoming cotangent is ignored, gradient is
+    # grad_scale everywhere (reference: make_loss.cc)
+    return (jnp.full(res.shape, grad_scale, res.dtype),)
+
+
+_make_loss.defvjp(_ml_fwd, _ml_bwd)
+
+
+def make_loss_k(x, grad_scale=1.0):
+    return _make_loss(x, grad_scale)
+
+
+# ------------------------------------------------- imperative nd wrappers
+def LRN(data, alpha=1e-4, beta=0.75, knorm=2.0, nsize=5, **kw):
+    return _apply(lambda x: lrn_k(x, alpha, beta, knorm, nsize), [data])
+
+
+def L2Normalization(data, eps=1e-10, mode="instance", **kw):
+    return _apply(lambda x: l2_normalization_k(x, eps, mode), [data])
+
+
+def UpSampling(data, scale=2, sample_type="nearest", num_filter=0, **kw):
+    return _apply(lambda x: upsampling_k(x, scale, sample_type), [data])
+
+
+def BilinearResize2D(data, height=None, width=None, **kw):
+    return _apply(lambda x: bilinear_resize_k(x, height, width), [data])
+
+
+def Crop(data, crop_like=None, h_w=None, offset=(0, 0),
+         center_crop=False, **kw):
+    if crop_like is not None:
+        return _apply(lambda x, y: crop_k(x, like_shape=y.shape,
+                                          offset=offset,
+                                          center_crop=center_crop),
+                      [data, crop_like])
+    return _apply(lambda x: crop_k(x, h_w=h_w, offset=offset,
+                                   center_crop=center_crop), [data])
+
+
+def SliceChannel(data, num_outputs, axis=1, squeeze_axis=False, **kw):
+    """reference: slice_channel.cc (a.k.a. split)."""
+    def fn(x):
+        parts = jnp.split(x, num_outputs, axis=axis)
+        if squeeze_axis:
+            parts = [jnp.squeeze(p, axis=axis) for p in parts]
+        return tuple(parts)
+    return _apply(fn, [data], n_out=num_outputs)
+
+
+def ROIPooling(data, rois, pooled_size=(7, 7), spatial_scale=1.0, **kw):
+    return _apply(lambda x, r: roi_pooling_k(x, r, pooled_size,
+                                             spatial_scale), [data, rois])
+
+
+def GridGenerator(data, transform_type="affine", target_shape=None, **kw):
+    if transform_type != "affine":
+        raise MXNetError("GridGenerator: only affine mode (like cuDNN)")
+    return _apply(lambda a: grid_generator_k(a, target_shape), [data])
+
+
+def BilinearSampler(data, grid, **kw):
+    return _apply(bilinear_sampler_k, [data, grid])
+
+
+def SpatialTransformer(data, loc, target_shape=None,
+                       transform_type="affine", sampler_type="bilinear",
+                       **kw):
+    if transform_type != "affine" or sampler_type != "bilinear":
+        raise MXNetError("SpatialTransformer: affine+bilinear only "
+                         "(the cuDNN-supported mode)")
+    return _apply(lambda x, a: spatial_transformer_k(x, a, target_shape),
+                  [data, loc])
+
+
+def Correlation(data1, data2, kernel_size=1, max_displacement=4, stride1=1,
+                stride2=1, is_multiply=True, **kw):
+    return _apply(lambda a, b: correlation_k(
+        a, b, kernel_size=kernel_size, max_displacement=max_displacement,
+        stride1=stride1, stride2=stride2, is_multiply=is_multiply),
+        [data1, data2])
+
+
+def MakeLoss(data, grad_scale=1.0, **kw):
+    return _apply(lambda x: make_loss_k(x, grad_scale), [data])
+
+
+def BlockGrad(data, **kw):
+    return _apply(jax.lax.stop_gradient, [data])
+
+
+stop_gradient = BlockGrad
+
+
+def batch_take(a, indices, **kw):
+    return _apply(batch_take_k, [a, indices])
+
+
+def ravel_multi_index(data, shape=None, **kw):
+    def fn(x):
+        idx = tuple(x[i].astype(jnp.int32) for i in range(x.shape[0]))
+        return jnp.ravel_multi_index(idx, shape, mode="clip").astype(
+            jnp.float32)
+    return _apply(fn, [data])
+
+
+def unravel_index(data, shape=None, **kw):
+    def fn(x):
+        out = jnp.unravel_index(x.astype(jnp.int32), shape)
+        return jnp.stack(out).astype(jnp.float32)
+    return _apply(fn, [data])
+
+
+def digamma(data, **kw):
+    return _apply(jax.scipy.special.digamma, [data])

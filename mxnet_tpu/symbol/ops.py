@@ -25,6 +25,8 @@ __all__ = ["FullyConnected", "Convolution", "StemConvS2D", "Activation",
            "SequenceMask", "SequenceLast", "SequenceReverse",
            "smooth_l1", "softmin", "hard_sigmoid",
            "cast", "Cast", "take",
+           "LRN", "L2Normalization", "UpSampling", "BlockGrad",
+           "stop_gradient", "MakeLoss", "SliceChannel", "split",
            "transpose", "concat", "Concat", "dot", "batch_dot", "sum", "mean",
            "max", "min", "relu", "sigmoid", "tanh", "exp", "log", "sqrt",
            "square", "negative", "zeros", "ones", "broadcast_add",
@@ -621,6 +623,62 @@ def Custom(*inputs, op_type=None, name=None, **prop_kwargs):
     return _make("_custom", list(inputs),
                  {"op_type": op_type, **prop_kwargs}, name=name,
                  n_out=len(prop.list_outputs()))
+
+
+# -- classic extra ops (reference: lrn.cc, l2_normalization.cc, ...) --------
+from ..ops import extra_ops as _extra
+
+register_op("LRN", lambda x, alpha=1e-4, beta=0.75, knorm=2.0, nsize=5:
+            _extra.lrn_k(x, alpha, beta, knorm, nsize))
+register_op("L2Normalization", lambda x, eps=1e-10, mode="instance":
+            _extra.l2_normalization_k(x, eps, mode))
+register_op("UpSampling", lambda x, scale=2, sample_type="nearest",
+            num_filter=0: _extra.upsampling_k(x, scale, sample_type))
+register_op("BlockGrad", jax.lax.stop_gradient)
+register_op("MakeLoss", lambda x, grad_scale=1.0:
+            _extra.make_loss_k(x, grad_scale))
+register_op("SliceChannel",
+            lambda x, num_outputs=1, axis=1, squeeze_axis=False:
+            tuple(jnp.squeeze(p, axis=axis) if squeeze_axis else p
+                  for p in jnp.split(x, num_outputs, axis=axis)))
+
+
+def LRN(data, alpha=1e-4, beta=0.75, knorm=2.0, nsize=5, name=None):
+    return _make("LRN", [data], {"alpha": alpha, "beta": beta,
+                                 "knorm": knorm, "nsize": nsize}, name=name)
+
+
+def L2Normalization(data, eps=1e-10, mode="instance", name=None):
+    return _make("L2Normalization", [data], {"eps": eps, "mode": mode},
+                 name=name)
+
+
+def UpSampling(data, scale=2, sample_type="nearest", num_filter=0,
+               name=None, **kwargs):
+    return _make("UpSampling", [data],
+                 {"scale": scale, "sample_type": sample_type}, name=name)
+
+
+def BlockGrad(data, name=None):
+    return _make("BlockGrad", [data], {}, name=name)
+
+
+stop_gradient = BlockGrad
+
+
+def MakeLoss(data, grad_scale=1.0, name=None, **kwargs):
+    return _make("MakeLoss", [data], {"grad_scale": grad_scale}, name=name)
+
+
+def SliceChannel(data, num_outputs=1, axis=1, squeeze_axis=False,
+                 name=None):
+    return _make("SliceChannel", [data],
+                 {"num_outputs": num_outputs, "axis": axis,
+                  "squeeze_axis": squeeze_axis}, name=name,
+                 n_out=num_outputs)
+
+
+split = SliceChannel
 
 
 # -- cast / indexing (reference: tensor cast + take ops) --------------------

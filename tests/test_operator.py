@@ -4,7 +4,7 @@ import numpy as np
 import pytest
 
 import mxnet_tpu as mx
-from mxnet_tpu import nd, autograd
+from mxnet_tpu import nd, autograd, sym
 
 
 def test_arithmetic_broadcast():
@@ -193,3 +193,156 @@ def test_grad_matches_finite_difference():
         fd = ((np.exp(xp) * np.sin(xp) + xp ** 2).sum()
               - (np.exp(xm) * np.sin(xm) + xm ** 2).sum()) / (2 * eps)
         np.testing.assert_allclose(g[i], fd, rtol=1e-2)
+
+
+# ------------------ classic extra ops (reference: lrn.cc, stn, ...) -------
+def test_lrn_matches_formula():
+    rs = np.random.RandomState(0)
+    x = rs.randn(2, 6, 3, 3).astype(np.float32)
+    out = nd.LRN(nd.array(x), alpha=1e-3, beta=0.75, knorm=2.0,
+                 nsize=3).asnumpy()
+    ref = np.empty_like(x)
+    for c in range(6):
+        lo, hi = max(0, c - 1), min(6, c + 2)
+        s = (x[:, lo:hi] ** 2).sum(1)
+        ref[:, c] = x[:, c] / (2.0 + (1e-3 / 3) * s) ** 0.75
+    np.testing.assert_allclose(out, ref, rtol=1e-5)
+
+
+def test_l2_normalization_modes():
+    rs = np.random.RandomState(1)
+    x = rs.randn(2, 3, 4, 4).astype(np.float32)
+    inst = nd.L2Normalization(nd.array(x), mode="instance").asnumpy()
+    ref = x / np.sqrt((x ** 2).sum(axis=(1, 2, 3), keepdims=True) + 1e-10)
+    np.testing.assert_allclose(inst, ref, rtol=1e-5)
+    chan = nd.L2Normalization(nd.array(x), mode="channel").asnumpy()
+    refc = x / np.sqrt((x ** 2).sum(axis=1, keepdims=True) + 1e-10)
+    np.testing.assert_allclose(chan, refc, rtol=1e-5)
+
+
+def test_upsampling_and_bilinear_resize():
+    x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    up = nd.UpSampling(nd.array(x), scale=2).asnumpy()
+    assert up.shape == (1, 1, 8, 8)
+    np.testing.assert_allclose(up[0, 0, :2, :2], x[0, 0, 0, 0])
+    bz = nd.BilinearResize2D(nd.array(x), height=2, width=2).asnumpy()
+    assert bz.shape == (1, 1, 2, 2)
+
+
+def test_slice_channel_and_crop():
+    x = np.arange(24, dtype=np.float32).reshape(2, 4, 3)
+    parts = nd.SliceChannel(nd.array(x), num_outputs=2, axis=1)
+    assert len(parts) == 2 and parts[0].shape == (2, 2, 3)
+    np.testing.assert_allclose(parts[1].asnumpy(), x[:, 2:])
+    sq = nd.SliceChannel(nd.array(x), num_outputs=4, axis=1,
+                         squeeze_axis=True)
+    assert sq[0].shape == (2, 3)
+    img = np.arange(36, dtype=np.float32).reshape(1, 1, 6, 6)
+    c = nd.Crop(nd.array(img), h_w=(4, 4), center_crop=True).asnumpy()
+    np.testing.assert_allclose(c[0, 0], img[0, 0, 1:5, 1:5])
+
+
+def test_block_grad_and_make_loss():
+    x = nd.array(np.array([1.0, 2.0], np.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = (nd.BlockGrad(x) * 3 + x * 2).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [2.0, 2.0])
+    x2 = nd.array(np.array([1.0, 2.0], np.float32))
+    x2.attach_grad()
+    with autograd.record():
+        loss = nd.MakeLoss(x2 * x2, grad_scale=0.5)
+    loss.backward()
+    # d(x^2)/dx with head grad 0.5 everywhere = 0.5 * 2x
+    np.testing.assert_allclose(x2.grad.asnumpy(), [1.0, 2.0])
+
+
+def test_spatial_transformer_identity_and_shift():
+    rs = np.random.RandomState(2)
+    img = rs.randn(1, 1, 5, 5).astype(np.float32)
+    ident = np.array([[1, 0, 0, 0, 1, 0]], np.float32)
+    out = nd.SpatialTransformer(nd.array(img), nd.array(ident),
+                                target_shape=(5, 5)).asnumpy()
+    np.testing.assert_allclose(out, img, atol=1e-5)
+    # grid generator emits x row then y row in [-1, 1]
+    g = nd.GridGenerator(nd.array(ident), target_shape=(3, 3)).asnumpy()
+    np.testing.assert_allclose(g[0, 0, 0], [-1, 0, 1], atol=1e-6)
+    np.testing.assert_allclose(g[0, 1, :, 0], [-1, 0, 1], atol=1e-6)
+
+
+def test_roi_pooling_max_bins():
+    x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    rois = np.array([[0, 0, 0, 3, 3]], np.float32)  # whole image
+    out = nd.ROIPooling(nd.array(x), nd.array(rois),
+                        pooled_size=(2, 2), spatial_scale=1.0).asnumpy()
+    np.testing.assert_allclose(out[0, 0], [[5, 7], [13, 15]])
+
+
+def test_correlation_zero_displacement_is_mean_product():
+    rs = np.random.RandomState(3)
+    a = rs.randn(1, 4, 5, 5).astype(np.float32)
+    b = rs.randn(1, 4, 5, 5).astype(np.float32)
+    out = nd.Correlation(nd.array(a), nd.array(b),
+                         max_displacement=1).asnumpy()
+    assert out.shape == (1, 9, 5, 5)
+    np.testing.assert_allclose(out[0, 4], (a * b).mean(1)[0], rtol=1e-5)
+
+
+def test_batch_take_ravel_unravel_digamma():
+    a = np.arange(12, dtype=np.float32).reshape(4, 3)
+    idx = np.array([0, 2, 1, 0], np.float32)
+    out = nd.batch_take(nd.array(a), nd.array(idx)).asnumpy()
+    np.testing.assert_allclose(out, [0, 5, 7, 9])
+    m = nd.ravel_multi_index(
+        nd.array(np.array([[1, 2], [0, 1]], np.float32)),
+        shape=(3, 4)).asnumpy()
+    np.testing.assert_allclose(m, [4, 9])
+    u = nd.unravel_index(nd.array(np.array([4, 9], np.float32)),
+                         shape=(3, 4)).asnumpy()
+    np.testing.assert_allclose(u, [[1, 2], [0, 1]])
+    from scipy.special import digamma as sp_digamma
+    v = np.array([0.5, 1.5, 3.0], np.float32)
+    np.testing.assert_allclose(nd.digamma(nd.array(v)).asnumpy(),
+                               sp_digamma(v), rtol=1e-5)
+
+
+def test_extra_ops_symbolic_roundtrip():
+    """LRN/L2Norm/UpSampling/MakeLoss/BlockGrad/SliceChannel exist in the
+    sym registry and survive tojson round trips."""
+    x = sym.Variable("data")
+    g = sym.L2Normalization(sym.LRN(x, nsize=3), mode="channel")
+    g2 = mx.sym.load_json(g.tojson())
+    d = nd.random.uniform(shape=(1, 4, 3, 3))
+    ref = nd.L2Normalization(nd.LRN(d, nsize=3), mode="channel").asnumpy()
+    got = g2.bind(None, {"data": d}).forward()[0].asnumpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-5)
+    parts = sym.SliceChannel(x, num_outputs=2, axis=1)
+    outs = parts.bind(None, {"data": d}).forward()
+    assert len(outs) == 2 and outs[0].shape == (1, 2, 3, 3)
+
+
+def test_roi_pooling_oversized_roi_empty_bins_zero():
+    """ROI beyond the image: bins clamp and empty bins emit 0, never -inf
+    (reference roi_pooling.cc clamping)."""
+    x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    rois = np.array([[0, 0, 0, 7, 7]], np.float32)
+    out = nd.ROIPooling(nd.array(x), nd.array(rois),
+                        pooled_size=(2, 2), spatial_scale=1.0).asnumpy()
+    assert np.all(np.isfinite(out))
+    np.testing.assert_allclose(out[0, 0, 0, 0], 15.0)  # valid bin
+    assert out[0, 0, 1, 1] == 0.0                      # fully OOB bin
+
+
+def test_correlation_stride_semantics():
+    rs = np.random.RandomState(4)
+    a = rs.randn(1, 2, 8, 8).astype(np.float32)
+    b = rs.randn(1, 2, 8, 8).astype(np.float32)
+    # stride1 subsamples the OUTPUT; stride2 strides the displacement grid
+    out = nd.Correlation(nd.array(a), nd.array(b), max_displacement=2,
+                         stride1=2, stride2=2).asnumpy()
+    assert out.shape == (1, 9, 4, 4), out.shape
+    out2 = nd.Correlation(nd.array(a), nd.array(b), max_displacement=2,
+                          is_multiply=False).asnumpy()
+    np.testing.assert_allclose(out2[0, 12], np.abs(a - b).mean(1)[0],
+                               rtol=1e-5)
