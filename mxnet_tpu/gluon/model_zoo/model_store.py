@@ -1,0 +1,83 @@
+"""Pretrained-weight store (reference: gluon/model_zoo/model_store.py).
+
+The reference downloads sha1-stamped ``name-<hash>.params`` files from the
+MXNet model store. This environment has zero egress, so the store is a
+LOCAL directory (default ``$MXNET_HOME/models`` or ``~/.mxnet/models`` —
+the same place the reference caches its downloads): drop an upstream
+``.params`` (binary NDArray-list format, read by `mxnet_tpu.upstream`) or
+a native ``.params.npz`` there and ``get_model(name, pretrained=True)``
+finds and loads it, hash-suffixed upstream filenames included.
+"""
+from __future__ import annotations
+
+import glob
+import os
+
+import numpy as np
+
+from ...base import MXNetError
+
+__all__ = ["get_model_file", "apply_pretrained"]
+
+
+def _root(root=None):
+    if root is None:
+        root = os.path.join(os.environ.get(
+            "MXNET_HOME", os.path.expanduser("~/.mxnet")), "models")
+    return os.path.expanduser(root)
+
+
+def get_model_file(name, root=None):
+    """Locate a weights file for `name`: exact `{name}.params`,
+    `{name}.params.npz`, or a hash-stamped upstream download
+    `{name}-<sha1>.params` (newest first)."""
+    root = _root(root)
+    exact = [os.path.join(root, f"{name}.params"),
+             os.path.join(root, f"{name}.params.npz")]
+    for p in exact:
+        if os.path.exists(p):
+            return p
+    stamped = sorted(glob.glob(os.path.join(root, f"{name}-*.params")),
+                     key=os.path.getmtime, reverse=True)
+    if stamped:
+        return stamped[0]
+    raise MXNetError(
+        f"no pretrained weights for {name!r} in {root} (offline "
+        f"environment: place an upstream '{name}-<hash>.params' or a "
+        f"'{name}.params.npz' there; reference model_store would download "
+        "it)")
+
+
+def apply_pretrained(net, name, root=None, ctx=None):
+    """Load the store's weights for `name` into `net`. Upstream binary
+    files go through mxnet_tpu.upstream (scope-strip name translation);
+    .npz files are native saves keyed by parameter name. Every parameter
+    must be covered and shape-consistent (like the binary path)."""
+    path = get_model_file(name, root)
+    if path.endswith(".npz"):
+        params = net.collect_params()
+        loaded = set()
+        with np.load(path) as f:
+            for k in f.keys():
+                bare = k.split(":", 1)[1] if ":" in k else k
+                if bare not in params:
+                    raise MXNetError(f"{path}: {bare!r} not a parameter "
+                                     f"of {type(net).__name__}")
+                p = params[bare]
+                if p.shape is not None and all(p.shape) and \
+                        tuple(p.shape) != f[k].shape:
+                    raise MXNetError(
+                        f"{path}: shape mismatch for {bare!r}: param "
+                        f"{tuple(p.shape)} vs file {f[k].shape}")
+                p.set_data(f[k])
+                loaded.add(bare)
+        missing = sorted(set(params) - loaded)
+        if missing:
+            raise MXNetError(f"{path} is missing parameters "
+                             f"{missing[:8]}...")
+    else:
+        from ... import upstream
+        upstream.load_params_into(net, path)
+    if ctx is not None:
+        net.collect_params().reset_ctx(ctx)
+    return net

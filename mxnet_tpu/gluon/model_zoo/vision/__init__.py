@@ -1,4 +1,12 @@
-"""mx.gluon.model_zoo.vision (reference: gluon/model_zoo/vision/__init__.py)."""
+"""mx.gluon.model_zoo.vision (reference: gluon/model_zoo/vision/__init__.py).
+
+Every constructor here accepts ``pretrained=True`` (+ optional ``root=``):
+weights come from the local model store (gluon/model_zoo/model_store.py —
+upstream binary .params or native .npz), matching the reference's
+download-then-load flow minus the download.
+"""
+import functools
+
 from .resnet import *        # noqa: F401,F403
 from .alexnet import *       # noqa: F401,F403
 from .vgg import *           # noqa: F401,F403
@@ -6,6 +14,7 @@ from .squeezenet import *    # noqa: F401,F403
 from .densenet import *      # noqa: F401,F403
 from .mobilenet import *     # noqa: F401,F403
 from .inception import *     # noqa: F401,F403
+from ..model_store import apply_pretrained
 
 _models = {
     "resnet18_v1": resnet18_v1, "resnet34_v1": resnet34_v1,
@@ -26,6 +35,29 @@ _models = {
     "mobilenetv2_0.5": mobilenet_v2_0_5, "mobilenetv2_0.25": mobilenet_v2_0_25,
     "inceptionv3": inception_v3,
 }
+
+
+def _with_pretrained(name, builder):
+    """Make `pretrained=True` real for every zoo constructor: the raw
+    builders either raised or (worse) silently ignored it. Signature
+    matches the reference ctors — pretrained/ctx positional-friendly."""
+    @functools.wraps(builder)
+    def ctor(pretrained=False, ctx=None, root=None, **kwargs):
+        net = builder(**kwargs)
+        if pretrained:
+            apply_pretrained(net, name, root=root, ctx=ctx)
+        elif ctx is not None:
+            net.collect_params().reset_ctx(ctx)
+        return net
+    return ctor
+
+
+_models = {name: _with_pretrained(name, b) for name, b in _models.items()}
+# rebind the module-level constructor names so direct calls
+# (vision.resnet18_v1(pretrained=True)) go through the store too
+for _n, _b in _models.items():
+    globals()[_b.__name__] = _b
+del _n, _b
 
 
 def get_model(name, **kwargs):

@@ -36,5 +36,5 @@ class AlexNet(HybridBlock):
         return self.output(self.features(x))
 
 
-def alexnet(pretrained=False, ctx=None, **kwargs):
+def alexnet(**kwargs):
     return AlexNet(**kwargs)

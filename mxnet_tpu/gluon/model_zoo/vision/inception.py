@@ -165,5 +165,5 @@ class Inception3(HybridBlock):
         return self.output(self.features(x))
 
 
-def inception_v3(pretrained=False, ctx=None, **kwargs):
+def inception_v3(**kwargs):
     return Inception3(**kwargs)

@@ -268,15 +268,14 @@ resnet_block_versions = [
 ]
 
 
-def get_resnet(version, num_layers, pretrained=False, ctx=None, **kwargs):
+def get_resnet(version, num_layers, **kwargs):
+    # pretrained/ctx are handled by the model_store wrapper in
+    # vision/__init__.py — raw builders only construct
     assert num_layers in resnet_spec
     block_type, layers, channels = resnet_spec[num_layers]
     resnet_class = resnet_net_versions[version - 1]
     block_class = resnet_block_versions[version - 1][block_type]
-    net = resnet_class(block_class, layers, channels, **kwargs)
-    if pretrained:
-        raise ValueError("pretrained weights unavailable offline")
-    return net
+    return resnet_class(block_class, layers, channels, **kwargs)
 
 
 def resnet18_v1(**kwargs):

@@ -42,7 +42,7 @@ class VGG(HybridBlock):
         return self.output(self.features(x))
 
 
-def get_vgg(num_layers, pretrained=False, ctx=None, **kwargs):
+def get_vgg(num_layers, **kwargs):
     layers, filters = vgg_spec[num_layers]
     return VGG(layers, filters, **kwargs)
 

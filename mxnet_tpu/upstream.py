@@ -192,6 +192,12 @@ def load_checkpoint_params(fname):
     return arg_params, aux_params
 
 
+def _shape_known(s):
+    """False for deferred-init shapes (None or 0-dims): those finalise
+    from the loaded data instead of being checked against it."""
+    return s is not None and all(d for d in s)
+
+
 def _strip_scope(name):
     """Drop the leading block-scope prefix (`resnetv10_`, `mobilenet0_`,
     ...) so checkpoints from a differently-numbered scope still match:
@@ -246,7 +252,7 @@ def load_params_into(block, fname, name_map=None, allow_missing=False,
                     f"ambiguous match for {ours!r} in {fname}: {cands}; "
                     "disambiguate via name_map")
             src = cands[0] if cands else None
-        if src is not None and \
+        if src is not None and _shape_known(params[ours].shape) and \
                 tuple(params[ours].shape) != tuple(merged[src].shape):
             msg = (f"shape mismatch for {ours!r}: param "
                    f"{tuple(params[ours].shape)} vs file "
@@ -271,8 +277,18 @@ def load_params_into(block, fname, name_map=None, allow_missing=False,
     # NameManager counters — upstream has the identical behaviour).
     if unresolved:
         ours_order = list(params)
+        def _suffix(n):
+            return n.rsplit("_", 1)[-1]
+
+        # positional bijection needs evidence it is the SAME architecture:
+        # ordered shapes agree wherever our shape is known, and every pair
+        # agrees on the parameter-kind suffix (weight/bias/gamma/...) —
+        # without the suffix guard a fully deferred-shape net would zip
+        # against any same-count checkpoint
         if len(file_order) == len(ours_order) and all(
-                tuple(params[o].shape) == tuple(merged[s].shape)
+                (not _shape_known(params[o].shape) or
+                 tuple(params[o].shape) == tuple(merged[s].shape)) and
+                _suffix(o) == _suffix(s)
                 for o, s in zip(ours_order, file_order)):
             mapping = dict(zip(ours_order, file_order))
         elif not allow_missing:
@@ -298,10 +314,10 @@ def load_params_into(block, fname, name_map=None, allow_missing=False,
         if src is None:
             continue
         v = merged.pop(src)
-        if tuple(p.shape) != tuple(v.shape):
+        if _shape_known(p.shape) and tuple(p.shape) != tuple(v.shape):
             raise MXNetError(f"shape mismatch for {ours!r}: param "
                              f"{tuple(p.shape)} vs file {tuple(v.shape)}")
-        p.set_data(v)
+        p.set_data(v)  # finalises deferred-shape params from the data
         loaded.append(ours)
     if merged and not ignore_extra:
         raise MXNetError(f"extra parameters in {fname}: "

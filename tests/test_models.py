@@ -237,3 +237,49 @@ def test_beam_search_cached_matches_full_recompute():
     tok3, _ = beam_search(t, src, beam_size=2, max_length=8)
     tok4, _ = beam_search_cached(t, src, beam_size=2, max_length=8)
     np.testing.assert_array_equal(tok3.asnumpy(), tok4.asnumpy())
+
+
+def test_pretrained_loads_from_local_store(tmp_path):
+    """get_model(name, pretrained=True) loads upstream-format weights from
+    the local model store (reference flow minus the download), including
+    hash-stamped filenames and nets with deferred shapes."""
+    import numpy as np
+    from mxnet_tpu import nd, upstream
+    from mxnet_tpu.gluon.model_zoo.vision import get_model
+    src = get_model("resnet18_v1", classes=10)
+    src.initialize()
+    x = nd.random.uniform(shape=(1, 3, 32, 32))
+    ref = src(x).asnumpy()
+    # save like an upstream download: hash-stamped, arg/aux split
+    store = tmp_path / "models"
+    store.mkdir()
+    blob = {}
+    for k, v in src.collect_params().items():
+        kind = "aux" if "running_" in k else "arg"
+        blob[f"{kind}:{k}"] = v.data()
+    upstream.save_params(str(store / "resnet18_v1-a0666292.params"), blob)
+
+    net = get_model("resnet18_v1", classes=10, pretrained=True,
+                    root=str(store))
+    got = net(x).asnumpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-5)
+
+
+def test_pretrained_missing_raises_helpfully(tmp_path):
+    import pytest
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon.model_zoo.vision import get_model
+    with pytest.raises(mx.MXNetError, match="offline"):
+        get_model("alexnet", pretrained=True, root=str(tmp_path))
+
+
+def test_pretrained_not_silently_ignored(tmp_path):
+    """Every zoo ctor must honor pretrained=True (alexnet/vgg used to
+    swallow it)."""
+    import pytest
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon.model_zoo import vision
+    for ctor in [vision.alexnet, vision.vgg11, vision.squeezenet1_0,
+                 vision.mobilenet0_25]:
+        with pytest.raises(mx.MXNetError, match="offline"):
+            ctor(pretrained=True, root=str(tmp_path))

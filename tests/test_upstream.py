@@ -159,12 +159,14 @@ def test_positional_fallback_is_all_or_nothing(tmp_path):
     net.add(nn.Dense(4, in_units=4), nn.Dense(4, in_units=4))
     net.initialize()
     ours = list(net.collect_params())
-    # file where one key collides with a real param name but the ordered
-    # shape sequence still aligns -> consistent positional bijection
+    # file where one DRIFTED key collides with a real param name (it
+    # holds a different position's tensor) while the ordered shape+suffix
+    # sequence still aligns -> the consistent positional bijection must
+    # win over the stale name match, with no KeyError
     f = str(tmp_path / "mix.params")
     vals = [nd.random.uniform(shape=net.collect_params()[k].shape)
             for k in ours]
-    keys = [ours[1], "zzz0_aaa0_x", "zzz0_aaa0_y", "zzz0_aaa0_z"]
+    keys = [ours[2], "drift0_bias", "drift1_weight", "drift1_bias"]
     upstream.save_params(f, dict(zip(keys, vals)))
     loaded = upstream.load_params_into(net, f)
     assert sorted(loaded) == sorted(ours)
