@@ -11,5 +11,6 @@ from .tensor_parallel import (column_parallel_dense, row_parallel_dense,
                               shard_params, tp_rules_transformer)
 from .pipeline import pipeline_apply, stack_stage_params
 from .ring_attention import ring_attention, ring_attention_sharded
+from .ulysses import ulysses_attention, ulysses_attention_sharded
 from . import moe
 from .moe import moe_ffn, init_moe_params, moe_param_specs
