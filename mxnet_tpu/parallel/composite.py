@@ -46,6 +46,7 @@ from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .ring_attention import ring_attention
+from .ulysses import ulysses_attention
 
 __all__ = ["CompositeConfig", "make_composite_mesh", "init_composite_params",
            "make_composite_train_step", "f_identity_bwd_psum",
@@ -71,6 +72,10 @@ class CompositeConfig(NamedTuple):
     remat: bool = False   # jax.checkpoint each transformer layer: trade
                           # recompute FLOPs for activation memory (long-seq
                           # / big-batch configs)
+    sp_strategy: str = "ring"   # 'ring' (ppermute K/V rotation) or
+                                # 'alltoall' (Ulysses head reshuffle);
+                                # numerically interchangeable, different
+                                # comms profiles — see parallel/ulysses.py
 
 
 # ---------------------------------------------------------------------------
@@ -226,7 +231,8 @@ def _ln(x, g, b, eps=1e-5):
 
 
 def _attention(bp, h, cfg):
-    """Megatron TP attention with ring-attention over 'sp'.
+    """Megatron TP attention with sequence parallelism over 'sp' — ring
+    or all-to-all per cfg.sp_strategy.
     h: (mb, S_loc, D) replicated over tp/ep; weights head-sharded over tp."""
     a = _ln(h, bp["ln1_g"], bp["ln1_b"])
     a = f_identity_bwd_psum(a, "tp")
@@ -234,7 +240,14 @@ def _attention(bp, h, cfg):
     q = jnp.einsum("bsd,dhk->bhsk", a, bp["wq"])
     k = jnp.einsum("bsd,dhk->bhsk", a, bp["wk"])
     v = jnp.einsum("bsd,dhk->bhsk", a, bp["wv"])
-    o = ring_attention(q, k, v, axis_name="sp", causal=True)
+    if cfg.sp_strategy == "alltoall":
+        # ulysses takes (B, S/P, H, Dh); heads here are the tp-local set
+        o = jnp.swapaxes(
+            ulysses_attention(jnp.swapaxes(q, 1, 2), jnp.swapaxes(k, 1, 2),
+                              jnp.swapaxes(v, 1, 2), axis_name="sp",
+                              causal=True), 1, 2)
+    else:
+        o = ring_attention(q, k, v, axis_name="sp", causal=True)
     out = jnp.einsum("bhsk,hkd->bsd", o, bp["wo"])
     out = g_psum_bwd_identity(out, "tp") + bp["bo"]
     return h + out
@@ -366,6 +379,12 @@ def make_composite_train_step(mesh, cfg: CompositeConfig):
     assert cfg.seq_len % mesh_shape["sp"] == 0
     assert cfg.n_experts % mesh_shape["ep"] == 0
     assert cfg.batch % (mesh_shape["dp"] * cfg.n_micro) == 0
+    assert cfg.sp_strategy in ("ring", "alltoall"), \
+        f"unknown sp_strategy {cfg.sp_strategy!r}"
+    if cfg.sp_strategy == "alltoall":
+        # ulysses shards the tp-LOCAL head set over 'sp'
+        assert (cfg.n_heads // mesh_shape["tp"]) % mesh_shape["sp"] == 0, \
+            "alltoall sp needs tp-local heads divisible by sp size"
 
     n_total_tokens = cfg.batch * cfg.seq_len
     specs = composite_param_specs()

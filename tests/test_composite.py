@@ -184,3 +184,22 @@ def test_pp_microbatch_counts(problem, n_micro, sizes):
         name = jax.tree_util.keystr(path)
         np.testing.assert_allclose(flat_new[name], ref_v,
                                    rtol=2e-4, atol=2e-5, err_msg=name)
+
+
+def test_composite_alltoall_sp_matches_single_device(problem):
+    """cfg.sp_strategy='alltoall' (Ulysses) slots into the flagship step
+    with identical numerics to the ring default and the single-device
+    run."""
+    params, tokens, targets, ref_p, ref_loss = problem
+    mesh = _mesh_from_sizes((2, 1, 1, 2, 2))  # dp2 x sp2 x ep2:
+    # tp=1 keeps 4 local heads over sp=2 -> 2 head blocks per
+    # device, exercising the all_to_all ordering non-trivially
+    cfg = CFG._replace(sp_strategy="alltoall")
+    new_p, loss = _run_cfg(mesh, cfg, params, tokens, targets)
+    assert abs(loss - ref_loss) < 1e-4, (loss, ref_loss)
+    flat_new = {jax.tree_util.keystr(p): v
+                for p, v in jax.tree_util.tree_leaves_with_path(new_p)}
+    for path, ref_v in jax.tree_util.tree_leaves_with_path(ref_p):
+        name = jax.tree_util.keystr(path)
+        np.testing.assert_allclose(flat_new[name], ref_v,
+                                   rtol=2e-4, atol=2e-5, err_msg=name)
