@@ -33,7 +33,10 @@ from jax import lax
 from ..base import MXNetError, _as_list
 from .ndarray import NDArray, _apply
 
-__all__ = ["foreach", "while_loop", "cond"]
+__all__ = ["foreach", "while_loop", "cond",
+           "interleaved_matmul_selfatt_qk",
+           "interleaved_matmul_selfatt_valatt", "div_sqrt_dim",
+           "arange_like", "index_copy", "index_array"]
 
 
 def _is_traced(nds):
@@ -269,3 +272,90 @@ def cond(pred, then_func, else_func, inputs=None):
                    lambda _: run_branch(else_func), None)
     outs = [NDArray(r) for r in raw]
     return outs[0] if len(outs) == 1 else outs
+
+
+# ---------------------------------------------------------------------------
+# transformer/NLP helper ops (reference: src/operator/contrib/transformer.cc
+# interleaved_matmul_selfatt_qk/valatt, div_sqrt_dim; tensor contrib
+# arange_like, index_copy, index_array). The interleaved ops are the fused
+# BERT self-attention entry points GluonNLP-era code calls; here each is a
+# couple of einsums XLA fuses onto the MXU — the reference needed
+# hand-written interleaved GEMMs to avoid transposes, the reshape/transpose
+# below is free at trace time.
+# ---------------------------------------------------------------------------
+def _split_interleaved(qkv, heads):
+    """(S, B, heads*3*dh) with per-head [q|k|v] packing ->
+    three (B*heads, S, dh) arrays."""
+    s, b, hd3 = qkv.shape
+    dh = hd3 // (3 * heads)
+
+    def pick(i):
+        x = qkv.reshape(s, b, heads, 3, dh)[:, :, :, i, :]
+        return x.transpose(1, 2, 0, 3).reshape(b * heads, s, dh)
+    return pick(0), pick(1), pick(2), dh
+
+
+def interleaved_matmul_selfatt_qk(queries_keys_values, heads, **kw):
+    """(S, B, H*3*dh) -> (B*H, S, S) scaled q.k^T scores (the 1/sqrt(dh)
+    scale is INSIDE the op, matching the reference kernel)."""
+    def fn(qkv):
+        q, k, _v, dh = _split_interleaved(qkv, heads)
+        return jnp.einsum("nqd,nkd->nqk", q, k) / jnp.sqrt(
+            jnp.asarray(dh, qkv.dtype))
+    return _apply(fn, [queries_keys_values])
+
+
+def interleaved_matmul_selfatt_valatt(queries_keys_values, attention,
+                                      heads, **kw):
+    """(S, B, H*3*dh) + (B*H, S, S) attention weights -> (S, B, H*dh)."""
+    def fn(qkv, att):
+        s, b, _ = qkv.shape
+        _q, _k, v, dh = _split_interleaved(qkv, heads)
+        out = jnp.einsum("nqk,nkd->nqd", att, v)       # (B*H, S, dh)
+        return out.reshape(b, heads, s, dh).transpose(2, 0, 1, 3) \
+                  .reshape(s, b, heads * dh)
+    return _apply(fn, [queries_keys_values, attention])
+
+
+def div_sqrt_dim(data, **kw):
+    """data / sqrt(data.shape[-1]) (reference: contrib.div_sqrt_dim)."""
+    return _apply(lambda x: x / jnp.sqrt(jnp.asarray(x.shape[-1],
+                                                     x.dtype)), [data])
+
+
+def arange_like(data, start=0.0, step=1.0, repeat=1, axis=None, **kw):
+    """An arange shaped like `data` (flat) or like data's `axis` length
+    (reference: contrib.arange_like — the shape comes from a tensor so the
+    graph stays shape-polymorphic). With `repeat`, each value appears
+    `repeat` times within the SAME total length (reference semantics:
+    [0,0,1,1,...])."""
+    def ramp(n, dtype):
+        count = -(-n // repeat)  # ceil
+        vals = start + step * jnp.arange(count, dtype=dtype)
+        return jnp.repeat(vals, repeat)[:n]
+
+    def fn(x):
+        if axis is None:
+            return ramp(x.size, x.dtype).reshape(x.shape)
+        return ramp(x.shape[axis], x.dtype)
+    return _apply(fn, [data])
+
+
+def index_copy(old_tensor, index_vector, new_tensor, **kw):
+    """Functional row copy: out = old with out[index[i]] = new[i]
+    (reference: contrib.index_copy)."""
+    def fn(old, idx, new):
+        return old.at[idx.astype(jnp.int32)].set(new)
+    return _apply(fn, [old_tensor, index_vector, new_tensor])
+
+
+def index_array(data, axes=None, **kw):
+    """Per-element coordinate array: out[i1..in] = (i1..in) (or the chosen
+    axes), shape data.shape + (k,). int32, not the reference's int64 —
+    JAX runs x64-disabled and index ranges fit (documented divergence)."""
+    def fn(x):
+        grids = jnp.meshgrid(*[jnp.arange(d) for d in x.shape],
+                             indexing="ij")
+        sel = grids if axes is None else [grids[a] for a in axes]
+        return jnp.stack(sel, axis=-1).astype(jnp.int32)
+    return _apply(fn, [data])

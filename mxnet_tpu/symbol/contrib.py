@@ -287,3 +287,83 @@ def cond(pred, then_func, else_func, name="cond"):
                  name=name, n_out=n_out)
     outs = [node[i] for i in range(n_out)]
     return outs[0] if n_out == 1 else outs
+
+
+# ---------------------------------------------------------------------------
+# transformer/NLP helper ops — symbol counterparts of ndarray.contrib's
+# (reference: sym.contrib.interleaved_matmul_selfatt_* etc.), so
+# hybrid_forward code calling F.contrib.<op> survives hybridize()/export.
+# Kernels shared via the raw fns in ndarray/contrib.py's _apply closures
+# would not serialise; these re-state the math as registered pure kernels.
+# ---------------------------------------------------------------------------
+import jax.numpy as _jnp
+
+
+def _ileave_split(qkv, heads):
+    s, b, hd3 = qkv.shape
+    dh = hd3 // (3 * heads)
+
+    def pick(i):
+        x = qkv.reshape(s, b, heads, 3, dh)[:, :, :, i, :]
+        return x.transpose(1, 2, 0, 3).reshape(b * heads, s, dh)
+    return pick(0), pick(1), pick(2), dh
+
+
+def _ileave_qk(qkv, heads=1):
+    q, k, _v, dh = _ileave_split(qkv, heads)
+    return _jnp.einsum("nqd,nkd->nqk", q, k) / _jnp.sqrt(
+        _jnp.asarray(dh, qkv.dtype))
+
+
+def _ileave_valatt(qkv, att, heads=1):
+    s, b, _ = qkv.shape
+    _q, _k, v, dh = _ileave_split(qkv, heads)
+    out = _jnp.einsum("nqk,nkd->nqd", att, v)
+    return out.reshape(b, heads, s, dh).transpose(2, 0, 1, 3) \
+              .reshape(s, b, heads * dh)
+
+
+register_op("_contrib_interleaved_matmul_selfatt_qk", _ileave_qk)
+register_op("_contrib_interleaved_matmul_selfatt_valatt", _ileave_valatt)
+register_op("_contrib_div_sqrt_dim",
+            lambda x: x / _jnp.sqrt(_jnp.asarray(x.shape[-1], x.dtype)))
+
+
+def _arange_like_k(x, start=0.0, step=1.0, repeat=1, axis=None):
+    def ramp(n):
+        count = -(-n // repeat)
+        vals = start + step * _jnp.arange(count, dtype=x.dtype)
+        return _jnp.repeat(vals, repeat)[:n]
+    if axis is None:
+        return ramp(x.size).reshape(x.shape)
+    return ramp(x.shape[axis])
+
+
+register_op("_contrib_arange_like", _arange_like_k)
+
+
+def interleaved_matmul_selfatt_qk(queries_keys_values, heads, name=None):
+    return _make("_contrib_interleaved_matmul_selfatt_qk",
+                 [queries_keys_values], {"heads": heads}, name=name)
+
+
+def interleaved_matmul_selfatt_valatt(queries_keys_values, attention,
+                                      heads, name=None):
+    return _make("_contrib_interleaved_matmul_selfatt_valatt",
+                 [queries_keys_values, attention], {"heads": heads},
+                 name=name)
+
+
+def div_sqrt_dim(data, name=None):
+    return _make("_contrib_div_sqrt_dim", [data], {}, name=name)
+
+
+def arange_like(data, start=0.0, step=1.0, repeat=1, axis=None, name=None):
+    return _make("_contrib_arange_like", [data],
+                 {"start": start, "step": step, "repeat": repeat,
+                  "axis": axis}, name=name)
+
+
+__all__ += ["interleaved_matmul_selfatt_qk",
+            "interleaved_matmul_selfatt_valatt", "div_sqrt_dim",
+            "arange_like"]

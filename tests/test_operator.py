@@ -346,3 +346,81 @@ def test_correlation_stride_semantics():
                           is_multiply=False).asnumpy()
     np.testing.assert_allclose(out2[0, 12], np.abs(a - b).mean(1)[0],
                                rtol=1e-5)
+
+
+# ----------------- transformer/NLP contrib helpers (reference: contrib) ----
+def test_interleaved_selfatt_matches_manual_multihead():
+    rs = np.random.RandomState(0)
+    S, B, H, dh = 6, 2, 3, 4
+    qkv = rs.randn(S, B, H * 3 * dh).astype(np.float32)
+    att = nd.contrib.interleaved_matmul_selfatt_qk(nd.array(qkv), heads=H)
+    assert att.shape == (B * H, S, S)
+    x = qkv.reshape(S, B, H, 3, dh)
+    qb = x[:, :, :, 0].transpose(1, 2, 0, 3).reshape(B * H, S, dh)
+    kb = x[:, :, :, 1].transpose(1, 2, 0, 3).reshape(B * H, S, dh)
+    vb = x[:, :, :, 2].transpose(1, 2, 0, 3).reshape(B * H, S, dh)
+    ref = np.einsum("nqd,nkd->nqk", qb, kb) / np.sqrt(dh)
+    np.testing.assert_allclose(att.asnumpy(), ref, rtol=1e-5, atol=1e-6)
+    w = np.exp(ref - ref.max(-1, keepdims=True))
+    w = (w / w.sum(-1, keepdims=True)).astype(np.float32)
+    out = nd.contrib.interleaved_matmul_selfatt_valatt(
+        nd.array(qkv), nd.array(w), heads=H)
+    refo = np.einsum("nqk,nkd->nqd", w, vb).reshape(B, H, S, dh) \
+        .transpose(2, 0, 1, 3).reshape(S, B, H * dh)
+    np.testing.assert_allclose(out.asnumpy(), refo, rtol=1e-5, atol=1e-6)
+
+
+def test_contrib_nlp_helpers():
+    a = nd.contrib.arange_like(nd.array(np.zeros((3, 4), np.float32)))
+    assert a.shape == (3, 4) and float(a.asnumpy()[0, 1]) == 1.0
+    a2 = nd.contrib.arange_like(nd.array(np.zeros((3, 4), np.float32)),
+                                axis=1, start=2.0)
+    np.testing.assert_allclose(a2.asnumpy(), [2, 3, 4, 5])
+    d = nd.contrib.div_sqrt_dim(nd.array(np.ones((2, 16), np.float32)))
+    np.testing.assert_allclose(d.asnumpy(), 0.25)
+    ic = nd.contrib.index_copy(nd.array(np.zeros((4, 2), np.float32)),
+                               nd.array(np.array([1, 3], np.float32)),
+                               nd.array(np.ones((2, 2), np.float32)))
+    np.testing.assert_allclose(ic.asnumpy(),
+                               [[0, 0], [1, 1], [0, 0], [1, 1]])
+    ia = nd.contrib.index_array(nd.array(np.zeros((2, 3), np.float32)))
+    assert ia.shape == (2, 3, 2)
+    assert ia.asnumpy()[1, 2].tolist() == [1, 2]
+
+
+def test_arange_like_repeat_semantics():
+    """repeat keeps the TOTAL length, repeating each value (reference:
+    [0,0,1,1,...])."""
+    a = nd.contrib.arange_like(nd.array(np.zeros((6,), np.float32)),
+                               repeat=2)
+    np.testing.assert_allclose(a.asnumpy(), [0, 0, 1, 1, 2, 2])
+    a2 = nd.contrib.arange_like(nd.array(np.zeros((2, 5), np.float32)),
+                                axis=1, repeat=2)
+    np.testing.assert_allclose(a2.asnumpy(), [0, 0, 1, 1, 2])
+
+
+def test_contrib_nlp_ops_hybridize():
+    """F.contrib.interleaved_* works under hybridize (symbol registry
+    counterparts exist and serialize)."""
+    from mxnet_tpu.gluon import nn
+
+    class Att(nn.HybridBlock):
+        def __init__(self):
+            super().__init__()
+            self.proj = nn.Dense(3 * 2 * 4, flatten=False)
+
+        def hybrid_forward(self, F, x):
+            qkv = F.transpose(self.proj(x), axes=(1, 0, 2))  # (S, B, 3HD)
+            att = F.contrib.interleaved_matmul_selfatt_qk(qkv, heads=2)
+            att = F.softmax(att, axis=-1)
+            out = F.contrib.interleaved_matmul_selfatt_valatt(qkv, att,
+                                                              heads=2)
+            return F.contrib.div_sqrt_dim(out)
+
+    net = Att()
+    net.initialize()
+    x = nd.random.uniform(shape=(2, 6, 8))  # (B, S, D)
+    eager = net(x).asnumpy()
+    net.hybridize()
+    hyb = net(x).asnumpy()
+    np.testing.assert_allclose(eager, hyb, rtol=1e-5, atol=1e-6)
