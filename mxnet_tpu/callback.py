@@ -12,8 +12,9 @@ def do_checkpoint(prefix, period=1):
     from .checkpoint import save_checkpoint
 
     def _callback(epoch, sym, arg_params, aux_params):
+        # reference saves 1-based (epoch 0 -> prefix-0001.params)
         if (epoch + 1) % period == 0:
-            save_checkpoint(prefix, epoch, sym, arg_params, aux_params)
+            save_checkpoint(prefix, epoch + 1, sym, arg_params, aux_params)
     return _callback
 
 

@@ -1,0 +1,79 @@
+"""Callbacks + profiler (SURVEY §4 subsystem inventory; reference:
+python/mxnet/callback.py, python/mxnet/profiler.py)."""
+import logging
+import types
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import callback, nd, profiler
+
+
+def _param(epoch=0, nbatch=0, metric=None):
+    return types.SimpleNamespace(epoch=epoch, nbatch=nbatch,
+                                 eval_metric=metric)
+
+
+def test_speedometer_logs_speed(caplog):
+    sp = callback.Speedometer(batch_size=32, frequent=2)
+    metric = mx.metric.Accuracy()
+    metric.update(nd.array(np.array([0., 1.])),
+                  nd.array(np.array([[0.9, 0.1], [0.2, 0.8]])))
+    with caplog.at_level(logging.INFO):
+        sp(_param(nbatch=1, metric=metric))   # init tick
+        sp(_param(nbatch=2, metric=metric))   # fires
+    assert any("samples/sec" in r.message for r in caplog.records)
+    # epoch restart (nbatch goes backwards) re-inits instead of crashing
+    sp(_param(epoch=1, nbatch=1, metric=metric))
+
+
+def test_log_train_metric(caplog):
+    metric = mx.metric.Accuracy()
+    metric.update(nd.array(np.array([1.])),
+                  nd.array(np.array([[0.1, 0.9]])))
+    cb = callback.log_train_metric(period=1, auto_reset=True)
+    with caplog.at_level(logging.INFO):
+        cb(_param(nbatch=1, metric=metric))
+    assert any("Train-" in r.message for r in caplog.records)
+    assert metric.num_inst == 0  # auto_reset happened
+
+
+def test_do_checkpoint_writes_files(tmp_path):
+    from mxnet_tpu import sym
+    prefix = str(tmp_path / "cb")
+    cb = callback.do_checkpoint(prefix, period=2)
+    s = sym.FullyConnected(sym.Variable("data"), num_hidden=2, name="fc")
+    arg = {"fc_weight": nd.ones((2, 3)), "fc_bias": nd.zeros((2,))}
+    cb(0, s, arg, {})                       # epoch 0: (0+1)%2 != 0 -> skip
+    import os
+    assert not os.path.exists(f"{prefix}-0001.params.npz")
+    cb(1, s, arg, {})                       # epoch 1: fires
+    assert os.path.exists(f"{prefix}-0002.params.npz")
+    _sym2, arg2, _aux2 = mx.checkpoint.load_checkpoint(prefix, 2)
+    np.testing.assert_allclose(arg2["fc_weight"].asnumpy(), 1.0)
+
+
+def test_progress_bar(capsys):
+    pb = callback.ProgressBar(total=4, length=8)
+    pb(_param(nbatch=2))
+    out = capsys.readouterr().out
+    assert "50%" in out
+
+
+def test_profiler_op_tally_and_scope(tmp_path):
+    profiler.set_config(filename=str(tmp_path / "profile.json"))
+    profiler.start()
+    profiler.record_op("dot", 0.002)
+    profiler.record_op("dot", 0.001)
+    profiler.record_op("add", 0.0005)
+    with profiler.Scope("block"):
+        pass
+    profiler.pause()
+    profiler.record_op("dot", 5.0)          # paused: not recorded
+    profiler.resume()
+    dump = profiler.dumps(reset=True)
+    assert "dot" in dump and "add" in dump
+    line = [ln for ln in dump.splitlines() if ln.startswith("dot")][0]
+    assert int(line.split()[1]) == 2        # two recorded calls
+    assert "dot" not in profiler.dumps()    # reset cleared the tally
+    profiler.stop()
