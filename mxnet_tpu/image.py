@@ -16,7 +16,10 @@ from .ndarray.ndarray import NDArray, array, _apply
 __all__ = ["imread", "imdecode", "imresize", "resize_short", "fixed_crop",
            "center_crop", "random_crop", "HorizontalFlipAug", "ResizeAug",
            "CenterCropAug", "RandomCropAug", "ColorNormalizeAug",
-           "CreateAugmenter", "Augmenter", "ForceResizeAug", "ImageIter", "ImageDetIter"]
+           "CreateAugmenter", "Augmenter", "ForceResizeAug", "ImageIter",
+           "ImageDetIter", "CastAug", "BrightnessJitterAug",
+           "ContrastJitterAug", "SaturationJitterAug", "LightingAug",
+           "RandomOrderAug", "color_normalize", "random_size_crop"]
 
 
 def _finish_decode(arr, flag, to_rgb):
@@ -184,6 +187,126 @@ class ForceResizeAug(Augmenter):
         return imresize(src, self.size[0], self.size[1])
 
 
+class CastAug(Augmenter):
+    """Cast to float32 (reference: CastAug)."""
+
+    def __init__(self, typ="float32"):
+        super().__init__(typ=typ)
+        self.typ = typ
+
+    def __call__(self, src):
+        return src.astype(self.typ)
+
+
+class BrightnessJitterAug(Augmenter):
+    """src *= 1 + U(-brightness, brightness) (reference)."""
+
+    def __init__(self, brightness, rng=None):
+        super().__init__(brightness=brightness)
+        self.brightness = brightness
+        self._rng = rng or np.random.RandomState()
+
+    def __call__(self, src):
+        alpha = 1.0 + self._rng.uniform(-self.brightness, self.brightness)
+        return src.astype("float32") * alpha
+
+
+class ContrastJitterAug(Augmenter):
+    """Blend with the grayscale mean (reference coefficients)."""
+
+    def __init__(self, contrast, rng=None):
+        super().__init__(contrast=contrast)
+        self.contrast = contrast
+        self._coef = array(np.array([0.299, 0.587, 0.114], np.float32))
+        self._rng = rng or np.random.RandomState()
+
+    def __call__(self, src):
+        alpha = 1.0 + self._rng.uniform(-self.contrast, self.contrast)
+        x = src.astype("float32")
+        gray = (x * self._coef).sum() * (3.0 / x.size)
+        return x * alpha + gray * (1 - alpha)
+
+
+class SaturationJitterAug(Augmenter):
+    """Blend with the per-pixel grayscale (reference coefficients)."""
+
+    def __init__(self, saturation, rng=None):
+        super().__init__(saturation=saturation)
+        self.saturation = saturation
+        self._coef = array(np.array([0.299, 0.587, 0.114], np.float32))
+        self._rng = rng or np.random.RandomState()
+
+    def __call__(self, src):
+        alpha = 1.0 + self._rng.uniform(-self.saturation, self.saturation)
+        x = src.astype("float32")
+        gray_nd = (x * self._coef).sum(axis=2, keepdims=True)
+        return x * alpha + gray_nd * (1 - alpha)
+
+
+class LightingAug(Augmenter):
+    """AlexNet-style PCA noise (reference: LightingAug)."""
+
+    def __init__(self, alphastd, eigval, eigvec, rng=None):
+        super().__init__(alphastd=alphastd)
+        self.alphastd = alphastd
+        self.eigval = np.asarray(eigval, np.float32)
+        self.eigvec = np.asarray(eigvec, np.float32)
+        self._rng = rng or np.random.RandomState()
+
+    def __call__(self, src):
+        alpha = self._rng.normal(0, self.alphastd, size=(3,))
+        rgb = np.dot(self.eigvec * alpha, self.eigval).astype(np.float32)
+        return src.astype("float32") + array(rgb)
+
+
+class RandomOrderAug(Augmenter):
+    """Apply child augmenters in random order (reference)."""
+
+    def __init__(self, ts, rng=None):
+        super().__init__()
+        self.ts = list(ts)
+        self._rng = rng or np.random.RandomState()
+
+    def __call__(self, src):
+        order = self._rng.permutation(len(self.ts))
+        for i in order:
+            src = self.ts[i](src)
+        return src
+
+
+def color_normalize(src, mean, std=None):
+    """(src - mean) / std (reference: mx.image.color_normalize)."""
+    out = src.astype("float32") - (mean if isinstance(mean, NDArray)
+                                   else array(np.asarray(mean, np.float32)))
+    if std is not None:
+        out = out / (std if isinstance(std, NDArray)
+                     else array(np.asarray(std, np.float32)))
+    return out
+
+
+def random_size_crop(src, size, area, ratio, rng=None, **kwargs):
+    """Random area/aspect crop then resize (reference: the inception-style
+    random_size_crop); falls back to center crop when no box fits."""
+    rng = rng or np.random.RandomState()
+    h, w = src.shape[0], src.shape[1]
+    src_area = h * w
+    if isinstance(area, (int, float)):
+        area = (area, 1.0)
+    for _ in range(10):
+        target_area = rng.uniform(*area) * src_area
+        log_ratio = (np.log(ratio[0]), np.log(ratio[1]))
+        aspect = np.exp(rng.uniform(*log_ratio))
+        new_w = int(round(np.sqrt(target_area * aspect)))
+        new_h = int(round(np.sqrt(target_area / aspect)))
+        if new_w <= w and new_h <= h:
+            x0 = rng.randint(0, w - new_w + 1)
+            y0 = rng.randint(0, h - new_h + 1)
+            out = fixed_crop(src, x0, y0, new_w, new_h, size)
+            return out, (x0, y0, new_w, new_h)
+    out, box = center_crop(src, size)
+    return out, box
+
+
 def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_mirror=False,
                     mean=None, std=None, **kwargs):
     """Build the reference's standard augmentation pipeline."""
@@ -197,6 +320,25 @@ def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_mirror=False,
         auglist.append(CenterCropAug(crop_size))
     if rand_mirror:
         auglist.append(HorizontalFlipAug(0.5))
+    auglist.append(CastAug())   # reference emits float32 unconditionally
+    brightness = kwargs.get("brightness", 0)
+    contrast = kwargs.get("contrast", 0)
+    saturation = kwargs.get("saturation", 0)
+    jitters = []
+    if brightness:
+        jitters.append(BrightnessJitterAug(brightness))
+    if contrast:
+        jitters.append(ContrastJitterAug(contrast))
+    if saturation:
+        jitters.append(SaturationJitterAug(saturation))
+    if jitters:
+        auglist.append(RandomOrderAug(jitters))
+    if kwargs.get("pca_noise", 0):
+        eigval = np.array([55.46, 4.794, 1.148], np.float32)
+        eigvec = np.array([[-0.5675, 0.7192, 0.4009],
+                           [-0.5808, -0.0045, -0.8140],
+                           [-0.5836, -0.6948, 0.4203]], np.float32)
+        auglist.append(LightingAug(kwargs["pca_noise"], eigval, eigvec))
     if mean is True:
         mean = np.array([123.68, 116.28, 103.53], np.float32)
     if std is True:
@@ -355,7 +497,15 @@ class ImageDetIter(ImageIter):
             h, w = data_shape[1], data_shape[2]
             aug_list = [ForceResizeAug((w, h))]
         else:
-            bad = [a for a in aug_list
+            def flatten(augs):
+                # look inside container augmenters: a RandomOrderAug
+                # wrapping a flip would silently corrupt boxes otherwise
+                for a in augs:
+                    if isinstance(a, RandomOrderAug):
+                        yield from flatten(a.ts)
+                    else:
+                        yield a
+            bad = [a for a in flatten(aug_list)
                    if isinstance(a, ImageDetIter._GEOMETRIC_AUGS)]
             if bad:
                 raise MXNetError(

@@ -6,6 +6,7 @@ import numpy as np
 import pytest
 
 import mxnet_tpu as mx
+from mxnet_tpu import nd
 
 
 def _img(h=12, w=10, seed=0):
@@ -155,3 +156,69 @@ def test_image_det_iter_malformed_labels(tmp_path):
                                path_imgrec=rec, path_imgidx=idx)
     with pytest.raises(mx.base.MXNetError):
         next(it)
+
+
+def test_jitter_augmenters_and_color_normalize():
+    from mxnet_tpu import image
+    rs = np.random.RandomState(0)
+    img = nd.array(rs.randint(0, 255, (8, 8, 3)).astype(np.uint8))
+    b = image.BrightnessJitterAug(0.5, rng=np.random.RandomState(1))(img)
+    assert b.shape == img.shape and str(b.dtype) == "float32"
+    c = image.ContrastJitterAug(0.5, rng=np.random.RandomState(2))(img)
+    s = image.SaturationJitterAug(0.5, rng=np.random.RandomState(3))(img)
+    assert c.shape == img.shape and s.shape == img.shape
+    li = image.LightingAug(0.1, [55.46, 4.794, 1.148],
+                           np.eye(3), rng=np.random.RandomState(4))(img)
+    assert li.shape == img.shape
+    ro = image.RandomOrderAug(
+        [image.CastAug(), image.BrightnessJitterAug(0.0)],
+        rng=np.random.RandomState(5))(img)
+    assert str(ro.dtype) == "float32"
+    cn = image.color_normalize(img, mean=[120, 120, 120], std=[60, 60, 60])
+    ref = (img.asnumpy().astype(np.float32) - 120) / 60
+    np.testing.assert_allclose(cn.asnumpy(), ref, rtol=1e-6)
+
+
+def test_random_size_crop_and_create_augmenter_jitter():
+    from mxnet_tpu import image
+    rs = np.random.RandomState(0)
+    img = nd.array(rs.randint(0, 255, (32, 40, 3)).astype(np.uint8))
+    out, (x0, y0, w, h) = image.random_size_crop(
+        img, size=(16, 16), area=(0.3, 0.9), ratio=(0.7, 1.4),
+        rng=np.random.RandomState(1))
+    assert out.shape == (16, 16, 3)
+    assert 0 <= x0 and x0 + w <= 40 and 0 <= y0 and y0 + h <= 32
+    augs = image.CreateAugmenter((3, 16, 16), rand_crop=True,
+                                 rand_mirror=True, brightness=0.2,
+                                 contrast=0.2, saturation=0.2,
+                                 pca_noise=0.05, mean=True, std=True)
+    kinds = [type(a).__name__ for a in augs]
+    assert "RandomOrderAug" in kinds and "LightingAug" in kinds
+    x = img
+    for a in augs:
+        x = a(x)
+    assert x.shape == (16, 16, 3)
+
+
+def test_detiter_rejects_wrapped_geometric_aug(tmp_path):
+    from mxnet_tpu import image, recordio
+    # minimal det .rec with one image
+    rec = str(tmp_path / "det.rec")
+    w = recordio.MXRecordIO(rec, "w")
+    img = np.zeros((8, 8, 3), np.uint8)
+    header = recordio.IRHeader(7, [2.0, 5.0, 0.0, 0.1, 0.1, 0.9, 0.9], 0, 0)
+    w.write(recordio.pack_img(header, img, img_fmt=".png"))
+    w.close()
+    with pytest.raises(mx.base.MXNetError, match="geometry"):
+        image.ImageDetIter(
+            batch_size=1, data_shape=(3, 8, 8), path_imgrec=rec,
+            aug_list=[image.RandomOrderAug([image.HorizontalFlipAug(1.0)])])
+
+
+def test_create_augmenter_emits_float32():
+    from mxnet_tpu import image
+    augs = image.CreateAugmenter((3, 8, 8))
+    x = nd.array(np.zeros((8, 8, 3), np.uint8))
+    for a in augs:
+        x = a(x)
+    assert str(x.dtype) == "float32"
