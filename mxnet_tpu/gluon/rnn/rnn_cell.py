@@ -32,7 +32,12 @@ class RecurrentCell(HybridBlock):
 
     def unroll(self, length, inputs, begin_state=None, layout="NTC",
                merge_outputs=None, valid_length=None):
-        """Unroll the cell over `length` steps."""
+        """Unroll the cell over `length` steps. With `valid_length` (N,),
+        outputs past each row's length are zeroed and the returned states
+        are the states at its LAST VALID step (reference: rnn_cell.unroll
+        valid_length — implemented with SequenceMask/SequenceLast, not a
+        ragged host loop)."""
+        from ...ops.seq_ops import SequenceLast, SequenceMask
         from ...ops.tensor_ops import split, stack
         axis = layout.find("T")
         if hasattr(inputs, "shape"):
@@ -42,9 +47,25 @@ class RecurrentCell(HybridBlock):
         states = begin_state if begin_state is not None else \
             self.begin_state(seq[0].shape[0], dtype=seq[0].dtype)
         outputs = []
+        state_hist = []
         for t in range(length):
             out, states = self(seq[t], states)
             outputs.append(out)
+            if valid_length is not None:
+                state_hist.append(states)
+        if valid_length is not None:
+            # states at t = valid_length-1 per row: one gather per state
+            states = [SequenceLast(stack(*[st[i] for st in state_hist],
+                                         axis=0), valid_length, True,
+                                   axis=0)
+                      for i in range(len(states))]
+            merged = stack(*outputs, axis=axis)
+            merged = SequenceMask(merged, valid_length, True,
+                                  axis=axis)
+            if merge_outputs or merge_outputs is None:
+                return merged, states
+            return split(merged, length, axis=axis, squeeze_axis=True), \
+                states
         if merge_outputs or merge_outputs is None:
             outputs = stack(*outputs, axis=axis)
         return outputs, states
@@ -218,16 +239,31 @@ class BidirectionalCell(RecurrentCell):
 
     def unroll(self, length, inputs, begin_state=None, layout="NTC",
                merge_outputs=None, valid_length=None):
-        from ...ops.tensor_ops import concat
+        """With `valid_length`, the reverse direction flips only each
+        row's VALID prefix (SequenceReverse), so the right cell never
+        reads padding first — the same variable-length-biRNN contract as
+        the fused layer path."""
+        from ...ops.tensor_ops import concat, flip, swapaxes
         nl = len(self.l_cell.state_info())
         states = begin_state or self.begin_state(
             inputs.shape[layout.find("N")], dtype=inputs.dtype)
-        l_out, l_states = self.l_cell.unroll(
-            length, inputs, states[:nl], layout, True)
-        from ...ops.tensor_ops import flip
         axis = layout.find("T")
-        rev = flip(inputs, axis)
+        l_out, l_states = self.l_cell.unroll(
+            length, inputs, states[:nl], layout, True, valid_length)
+        if valid_length is None:
+            rev = flip(inputs, axis)
+        else:
+            from ...ops.seq_ops import SequenceReverse
+            tnc = inputs if axis == 0 else swapaxes(inputs, 0, 1)
+            rev = SequenceReverse(tnc, valid_length, True)
+            rev = rev if axis == 0 else swapaxes(rev, 0, 1)
         r_out, r_states = self.r_cell.unroll(length, rev, states[nl:],
-                                             layout, True)
-        r_out = flip(r_out, axis)
+                                             layout, True, valid_length)
+        if valid_length is None:
+            r_out = flip(r_out, axis)
+        else:
+            from ...ops.seq_ops import SequenceReverse
+            tnc = r_out if axis == 0 else swapaxes(r_out, 0, 1)
+            r_out = SequenceReverse(tnc, valid_length, True)
+            r_out = r_out if axis == 0 else swapaxes(r_out, 0, 1)
         return concat(l_out, r_out, dim=-1), l_states + r_states

@@ -257,3 +257,46 @@ def test_varlen_lstm_hybridized_matches_eager():
     assert np.allclose(out_e.asnumpy(), out_h.asnumpy(), atol=1e-5)
     for a, b in zip(fin_e, fin_h):
         assert np.allclose(a.asnumpy(), b.asnumpy(), atol=1e-5)
+
+
+def test_cell_unroll_valid_length():
+    """unroll(valid_length=...) masks padded outputs and returns states
+    from each row's last valid step (previously silently ignored)."""
+    cell = rnn.LSTMCell(5, input_size=3)
+    cell.initialize()
+    rs = np.random.RandomState(0)
+    T, N, C = 6, 3, 3
+    x = nd.array(rs.randn(N, T, C).astype(np.float32))  # NTC
+    lens = [2, 6, 4]
+    out, states = cell.unroll(T, x, valid_length=nd.array(
+        np.array(lens, dtype=np.float32)))
+    out = out.asnumpy()
+    for n, l in enumerate(lens):
+        # per-row reference: unroll exactly l steps, unpadded
+        o_ref, s_ref = cell.unroll(l, nd.array(x.asnumpy()[n:n+1, :l]))
+        np.testing.assert_allclose(out[n, :l], o_ref.asnumpy()[0],
+                                   atol=1e-5)
+        assert np.all(out[n, l:] == 0.0)
+        for sg, sr in zip(states, s_ref):
+            np.testing.assert_allclose(sg.asnumpy()[n], sr.asnumpy()[0],
+                                       atol=1e-5)
+
+
+def test_bidirectional_cell_unroll_valid_length():
+    """BidirectionalCell.unroll(valid_length): reverse direction flips
+    only the valid prefix — matches per-row unpadded unrolls."""
+    bi = rnn.BidirectionalCell(rnn.LSTMCell(4, input_size=3),
+                               rnn.LSTMCell(4, input_size=3))
+    bi.initialize()
+    rs = np.random.RandomState(1)
+    T, N, C = 5, 3, 3
+    x = nd.array(rs.randn(N, T, C).astype(np.float32))
+    lens = [2, 5, 3]
+    out, _ = bi.unroll(T, x, valid_length=nd.array(
+        np.array(lens, np.float32)))
+    out = out.asnumpy()
+    for n, l in enumerate(lens):
+        o_ref, _ = bi.unroll(l, nd.array(x.asnumpy()[n:n+1, :l]))
+        np.testing.assert_allclose(out[n, :l], o_ref.asnumpy()[0],
+                                   atol=1e-5, err_msg=f"row {n}")
+        assert np.all(out[n, l:] == 0.0)
