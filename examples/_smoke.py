@@ -1,0 +1,8 @@
+"""Shared --smoke guard for the example scripts: force the CPU backend
+BEFORE jax initialises so smoke runs never grab the (single, possibly
+flaky) TPU tunnel. Import this FIRST in every example."""
+import sys
+
+if "--smoke" in sys.argv:
+    import jax
+    jax.config.update("jax_platforms", "cpu")

@@ -4,6 +4,11 @@ Usage: python examples/bert_pretrain.py [--smoke]
 The attention path rides the Pallas flash kernels on TPU (padding masks
 as per-row kv lengths). Matches bench_bert.py's step construction.
 """
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.dirname(_os.path.abspath(__file__)))
+import _smoke  # noqa: F401,E402 — forces CPU under --smoke
 import argparse
 import os
 import sys

@@ -8,6 +8,11 @@ TPU notes: hybridize compiles the whole forward into one XLA program;
 export re-traces it symbolically so the deployed artifact is the same
 graph the Executor jits at serve time.
 """
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.dirname(_os.path.abspath(__file__)))
+import _smoke  # noqa: F401,E402 — forces CPU under --smoke
 import argparse
 import os
 import sys

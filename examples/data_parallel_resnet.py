@@ -6,6 +6,11 @@ on the virtual CPU mesh (XLA_FLAGS=--xla_force_host_platform_device_count=8).
 The whole train step (fwd+bwd+allreduce+update) is ONE compiled program
 with donated buffers — gradients never leave HBM.
 """
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.dirname(_os.path.abspath(__file__)))
+import _smoke  # noqa: F401,E402 — forces CPU under --smoke
 import argparse
 import os
 import sys

@@ -3,6 +3,11 @@
 Usage: python examples/int8_inference.py [--smoke]
 On TPU the int8 dots run natively on the MXU with int32 accumulation.
 """
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.dirname(_os.path.abspath(__file__)))
+import _smoke  # noqa: F401,E402 — forces CPU under --smoke
 import argparse
 import os
 import sys

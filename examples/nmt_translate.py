@@ -2,6 +2,11 @@
 
 Usage: python examples/nmt_translate.py [--smoke]
 """
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.dirname(_os.path.abspath(__file__)))
+import _smoke  # noqa: F401,E402 — forces CPU under --smoke
 import argparse
 import os
 import sys

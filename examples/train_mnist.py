@@ -5,6 +5,11 @@ Mirrors the reference's gluon MNIST example: Dataset -> DataLoader ->
 HybridBlock -> Trainer -> metric, with hybridize() compiling the whole
 net into one XLA executable.
 """
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.dirname(_os.path.abspath(__file__)))
+import _smoke  # noqa: F401,E402 — forces CPU under --smoke
 import argparse
 import os
 import sys

@@ -9,6 +9,11 @@ TPU notes: the unrolled LSTM compiles to ONE lax.scan XLA program via
 hybridize; hidden states are carried across BPTT windows and detached
 (reference: detach() between truncated-BPTT segments).
 """
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.dirname(_os.path.abspath(__file__)))
+import _smoke  # noqa: F401,E402 — forces CPU under --smoke
 import argparse
 import math
 import os

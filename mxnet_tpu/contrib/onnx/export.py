@@ -42,7 +42,21 @@ class _Ctx:
         self.initializers = []   # encoded TensorProto bytes
         self.name_of = {}        # id(symbol node) -> output tensor name
         self.params = {}         # stripped name -> numpy array
+        self.shape_of = {}       # tensor name -> inferred shape (or None)
+        self.used = set()        # tensor names some node consumes
         self._uniq = 0
+
+    def rank_of(self, tensor_name, default=4):
+        s = self.shape_of.get(tensor_name)
+        return len(s) if s is not None else default
+
+    def channel_param(self, hint, array, data_rank):
+        """A (C,)-param reshaped to broadcast against the channel axis of
+        an NC... tensor of `data_rank` under ONNX's right-aligned rules:
+        (C, 1, ..., 1) with data_rank-2 trailing ones."""
+        arr = np.asarray(array, np.float32).reshape(
+            (-1,) + (1,) * (data_rank - 2))
+        return self.const(hint, arr)
 
     def tensor(self, sym_input):
         base, oi = sym_input._resolve_head()
@@ -54,6 +68,7 @@ class _Ctx:
         return f"{hint}__{self._uniq}"
 
     def add_node(self, op_type, inputs, outputs, name, *attrs):
+        self.used.update(inputs)
         self.nodes.append(P.message(
             *[P.f_bytes(1, i) for i in inputs],
             *[P.f_bytes(2, o) for o in outputs],
@@ -127,6 +142,91 @@ def _stem(node, ctx, out):
         "ONNX export: StemConvS2D (stem_s2d=True, the NHWC TPU stem) has "
         "no ONNX equivalent; rebuild the net with stem_s2d=False / "
         "layout='NCHW' for export")
+
+
+@register_converter("Deconvolution")
+def _deconv(node, ctx, out):
+    a = node._attrs
+    if (a.get("layout") or "NCHW") != "NCHW":
+        raise MXNetError("ONNX export requires NCHW deconvolutions")
+    w = ctx.params.get(ctx.tensor(node._inputs[1]))
+    k = tuple(w.shape[2:]) if w is not None else _pair(a["kernel"])
+    s = _pair(a.get("stride", 1))
+    p = _pair(a.get("pad", 0))
+    adj = _pair(a.get("adj", 0))
+    ctx.add_node("ConvTranspose", [ctx.tensor(i) for i in node._inputs],
+                 [out], node.name,
+                 A_ints("kernel_shape", k), A_ints("strides", s),
+                 A_ints("pads", (p[0], p[1], p[0], p[1])),
+                 A_ints("output_padding", adj),
+                 A_i("group", 1))
+
+
+@register_converter("InstanceNorm")
+def _instancenorm(node, ctx, out):
+    ctx.add_node("InstanceNormalization",
+                 [ctx.tensor(i) for i in node._inputs], [out], node.name,
+                 A_f("epsilon", node._attrs.get("eps", 1e-5)))
+
+
+@register_converter("PReLU")
+def _prelu(node, ctx, out):
+    x = ctx.tensor(node._inputs[0])
+    slope_name = ctx.tensor(node._inputs[1])
+    alpha = ctx.params.get(slope_name)
+    rank = ctx.rank_of(x)
+    if alpha is not None and alpha.ndim == 1 and rank > 2:
+        # ONNX PRelu broadcasts the slope from the RIGHT: a (C,) slope
+        # must become (C, 1, ..., 1) to align with NC...'s channel axis
+        # (rank-2 inputs broadcast (C,) directly)
+        slope_name = ctx.channel_param(node.name + "_slope", alpha, rank)
+    ctx.add_node("PRelu", [x, slope_name], [out], node.name)
+
+
+@register_converter("GroupNorm")
+def _groupnorm(node, ctx, out):
+    # opset 11 has no GroupNormalization (opset 18): decompose via
+    # Reshape(0, G, -1) -> normalize over axis 2 -> Reshape back to the
+    # input's own Shape -> per-channel affine
+    a = node._attrs
+    g_count, eps = a.get("num_groups", 1), a.get("eps", 1e-5)
+    x, gamma_n, beta_n = [ctx.tensor(i) for i in node._inputs]
+    gamma = ctx.params.get(gamma_n)
+    beta = ctx.params.get(beta_n)
+    if gamma is None or beta is None:
+        raise MXNetError(f"ONNX export: GroupNorm {node.name!r} needs "
+                         "parameter gamma/beta")
+    shp = ctx.const(node.name + "_gshape",
+                    np.asarray([0, g_count, -1], np.int64))
+    grouped = ctx.fresh(node.name + "_grouped")
+    ctx.add_node("Reshape", [x, shp], [grouped], node.name + "_group")
+    mu = ctx.fresh(node.name + "_mu")
+    ctx.add_node("ReduceMean", [grouped], [mu], node.name + "_mu",
+                 A_ints("axes", (2,)), A_i("keepdims", 1))
+    xc = ctx.fresh(node.name + "_xc")
+    ctx.add_node("Sub", [grouped, mu], [xc], node.name + "_sub")
+    sq = ctx.fresh(node.name + "_sq")
+    ctx.add_node("Mul", [xc, xc], [sq], node.name + "_sqm")
+    var = ctx.fresh(node.name + "_var")
+    ctx.add_node("ReduceMean", [sq], [var], node.name + "_varm",
+                 A_ints("axes", (2,)), A_i("keepdims", 1))
+    veps = ctx.fresh(node.name + "_veps")
+    epsname = ctx.const(node.name + "_eps", np.float32(eps))
+    ctx.add_node("Add", [var, epsname], [veps], node.name + "_adde")
+    std = ctx.fresh(node.name + "_std")
+    ctx.add_node("Sqrt", [veps], [std], node.name + "_sqrt")
+    norm = ctx.fresh(node.name + "_norm")
+    ctx.add_node("Div", [xc, std], [norm], node.name + "_div")
+    xshape = ctx.fresh(node.name + "_xshape")
+    ctx.add_node("Shape", [x], [xshape], node.name + "_shape")
+    back = ctx.fresh(node.name + "_back")
+    ctx.add_node("Reshape", [norm, xshape], [back], node.name + "_ungroup")
+    rank = ctx.rank_of(x)
+    gname = ctx.channel_param(node.name + "_gamma", gamma, rank)
+    bname = ctx.channel_param(node.name + "_beta", beta, rank)
+    scaled = ctx.fresh(node.name + "_scaled")
+    ctx.add_node("Mul", [back, gname], [scaled], node.name + "_scale")
+    ctx.add_node("Add", [scaled, bname], [out], node.name)
 
 
 @register_converter("BatchNorm")
@@ -429,11 +529,30 @@ def export_model(sym, params, input_shapes=None, in_dtype="float32",
         input_shapes = {"data": tuple(input_shapes)}
     input_shapes = dict(input_shapes or {})
 
+    # per-tensor shape table (rank-dependent converters: PReLU/GroupNorm
+    # channel-param broadcasting): one inference pass over the internals
+    if input_shapes:
+        try:
+            from ...symbol.symbol import Group as _Group, _node_output
+            internals = _Group([_node_output(n, i) for n in nodes
+                                for i in range(n._n_out)])
+            _, int_shapes, _ = internals.infer_shape(**input_shapes)
+            if int_shapes is not None:
+                k = 0
+                for n in nodes:
+                    for i in range(n._n_out):
+                        name = n.name if n._n_out == 1 else f"{n.name}.{i}"
+                        ctx.shape_of[name] = int_shapes[k]
+                        k += 1
+        except Exception:
+            pass  # shapes stay unknown; converters use their defaults
+
+    param_vars = []
     for n in nodes:
         if n._op is None:
             ctx.name_of[id(n)] = n.name
             if n.name in params:
-                ctx.add_initializer(n.name, params[n.name])
+                param_vars.append(n.name)
             else:
                 shape = input_shapes.get(n.name, n._shape_hint or ())
                 data_inputs.append(_value_info(
@@ -447,6 +566,13 @@ def export_model(sym, params, input_shapes=None, in_dtype="float32",
                 f"{sorted(_CONVERTERS)}")
         ctx.name_of[id(n)] = n.name
         conv(n, ctx, n.name)
+
+    # serialize only params some emitted node consumes: converters that
+    # substitute reshaped copies (PReLU slope, GroupNorm affine, fixed
+    # gamma) would otherwise leave dead duplicates in the file
+    for name in param_vars:
+        if name in ctx.used:
+            ctx.add_initializer(name, params[name])
 
     out_infos = []
     for hn, oi in heads:

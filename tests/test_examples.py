@@ -9,7 +9,7 @@ import pytest
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 _EXAMPLES = sorted(
     f for f in os.listdir(os.path.join(_REPO, "examples"))
-    if f.endswith(".py"))
+    if f.endswith(".py") and not f.startswith("_"))
 
 
 @pytest.mark.parametrize("script", _EXAMPLES)

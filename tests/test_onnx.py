@@ -58,7 +58,9 @@ def _run_onnx(model, feeds):
     import torch
     import torch.nn.functional as F
     g = model["graph"]
-    env = {k: torch.from_numpy(np.frombuffer(raw, np.float32)
+    dt_of = {proto.FLOAT: np.float32, proto.INT64: np.int64,
+             proto.INT32: np.int32, proto.FLOAT16: np.float16}
+    env = {k: torch.from_numpy(np.frombuffer(raw, dt_of.get(_dt, np.float32))
                                .reshape([int(d) for d in dims]).copy())
            for k, (dims, _dt, raw) in g["initializers"].items()}
     for k, v in feeds.items():
@@ -128,7 +130,28 @@ def _run_onnx(model, feeds):
         elif op == "Dropout":
             y = x[0]  # inference
         elif op == "Reshape":
-            y = x[0].reshape([int(d) for d in x[1].tolist()])
+            tgt = [int(d) for d in x[1].tolist()]
+            shp = list(x[0].shape)
+            tgt = [shp[i] if d == 0 else d for i, d in enumerate(tgt)]
+            y = x[0].reshape(tgt)
+        elif op == "Shape":
+            y = __import__("torch").tensor(list(x[0].shape),
+                                           dtype=__import__("torch").int64)
+        elif op == "ConvTranspose":
+            y = F.conv_transpose2d(
+                x[0], x[1], x[2] if len(x) > 2 else None,
+                stride=list(a["strides"]), padding=list(a["pads"][:2]),
+                output_padding=list(a.get("output_padding", (0, 0))),
+                groups=a.get("group", 1))
+        elif op == "InstanceNormalization":
+            y = F.instance_norm(x[0], weight=x[1], bias=x[2],
+                                eps=a["epsilon"])
+        elif op == "PRelu":
+            # honest ONNX semantics: right-aligned unidirectional
+            # broadcast of the slope AS SHIPPED (no flatten rescue —
+            # a wrong slope shape must fail here like in onnxruntime)
+            torch_mod = __import__("torch")
+            y = torch_mod.where(x[0] >= 0, x[0], x[0] * x[1])
         else:
             raise AssertionError(f"mini-runtime: unimplemented op {op}")
         env[n["outputs"][0]] = y
@@ -396,3 +419,30 @@ def test_import_pool_spec_defaults(tmp_path):
                  .reshape(2, 1, 4, 4))
     out = sym2.bind(None, {"data": d}).forward()[0]
     assert out.shape == (2, 1, 3, 3), out.shape  # stride 1, valid pads
+
+
+def test_deconv_norm_prelu_export_runs(tmp_path):
+    """Conv2DTranspose + InstanceNorm + GroupNorm + PReLU export and
+    reproduce framework numerics under the torch runtime (the conv
+    autoencoder deployment path)."""
+    from mxnet_tpu.gluon import nn
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(8, 3, padding=1),
+            nn.GroupNorm(num_groups=2),
+            nn.PReLU(),
+            nn.Conv2DTranspose(4, 4, strides=2, padding=1),
+            nn.InstanceNorm(),
+            nn.Activation("relu"))
+    net.initialize()
+    x = nd.random.uniform(shape=(2, 3, 8, 8))
+    ref = net(x).asnumpy()
+    graph = net(sym.Variable("data"))
+    params = {k: v.data() for k, v in net.collect_params().items()}
+    path = export_model(graph, params, {"data": (2, 3, 8, 8)},
+                        onnx_file_path=str(tmp_path / "dn.onnx"))
+    m = proto.decode_model(open(path, "rb").read())
+    ops = [n["op_type"] for n in m["graph"]["nodes"]]
+    assert "ConvTranspose" in ops and "InstanceNormalization" in ops
+    assert "PRelu" in ops and "Shape" in ops
+    got = _run_onnx(m, {"data": x.asnumpy()})[0]
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
