@@ -44,6 +44,15 @@ class Trainer:
                                          **optimizer_params)
         self._updater = opt_mod.get_updater(self._optimizer)
         self._kvstore = kvs_mod.create(kvstore) if kvstore else None
+        if compression_params:
+            # reference semantics: forward to the store (previously this
+            # argument was accepted and silently dropped). NB the Trainer's
+            # own allreduce path uses replicated layout (grads are already
+            # reduced in-step), so compression engages on stacked pushes
+            # through this store — kvstore.set_gradient_compression docs.
+            if self._kvstore is None:
+                raise MXNetError("compression_params requires a kvstore")
+            self._kvstore.set_gradient_compression(compression_params)
         self._kv_initialized = False
         self._scale = 1.0
         self.skip_nonfinite = skip_nonfinite

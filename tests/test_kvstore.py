@@ -260,3 +260,23 @@ def test_compressed_training_matches_uncompressed():
     comp = train({"type": "int8"})
     assert base < 1e-3, f"uncompressed failed to converge: {base}"
     assert comp < 5e-3, f"int8-compressed failed to converge: {comp}"
+
+
+def test_trainer_forwards_compression_params():
+    """gluon.Trainer(compression_params=...) configures the store
+    (previously accepted and silently dropped)."""
+    from mxnet_tpu.gluon import nn
+    net = nn.Dense(4, in_units=3)
+    net.initialize()
+    tr = mx.gluon.Trainer(net.collect_params(), "sgd",
+                          {"learning_rate": 0.1}, kvstore="ici",
+                          compression_params={"type": "int8"})
+    assert tr._kvstore._compression == {"type": "int8", "threshold": 0.5}
+    with pytest.raises(mx.base.MXNetError):
+        mx.gluon.Trainer(net.collect_params(), "sgd",
+                         {"learning_rate": 0.1}, kvstore="ici",
+                         compression_params={"type": "bogus"})
+    with pytest.raises(mx.base.MXNetError):
+        mx.gluon.Trainer(net.collect_params(), "sgd",
+                         {"learning_rate": 0.1}, kvstore=None,
+                         compression_params={"type": "int8"})
