@@ -39,7 +39,11 @@ class Trainer:
             self._params.append(p)
             self._param2idx[p.name] = i
         optimizer_params = optimizer_params or {}
+        # both int and str keys: the local updater passes int indices, the
+        # kvstore updater stringifies keys — lr_mult/wd_mult lookups must
+        # hit either way
         param_dict = {i: p for i, p in enumerate(self._params)}
+        param_dict.update({str(i): p for i, p in enumerate(self._params)})
         self._optimizer = opt_mod.create(optimizer, param_dict=param_dict,
                                          **optimizer_params)
         self._updater = opt_mod.get_updater(self._optimizer)
@@ -53,6 +57,15 @@ class Trainer:
             if self._kvstore is None:
                 raise MXNetError("compression_params requires a kvstore")
             self._kvstore.set_gradient_compression(compression_params)
+        self._update_on_kvstore = bool(update_on_kvstore)
+        if self._update_on_kvstore:
+            # reference semantics (previously accepted and ignored): the
+            # optimizer runs ON the store — push applies the update to the
+            # stored weight, pull brings it back (server-side update path,
+            # kvstore.set_optimizer)
+            if self._kvstore is None:
+                raise MXNetError("update_on_kvstore=True requires a kvstore")
+            self._kvstore.set_optimizer(self._optimizer)
         self._kv_initialized = False
         self._scale = 1.0
         self.skip_nonfinite = skip_nonfinite
@@ -69,10 +82,15 @@ class Trainer:
         self._optimizer.set_learning_rate(lr)
 
     def _init_kvstore(self):
+        # incremental + idempotent: deferred-init params materialise after
+        # the first forward, so keys join the store as their data appears
+        if not hasattr(self, "_kv_keys"):
+            self._kv_keys = set()
         if self._kvstore is not None:
             for i, p in enumerate(self._params):
-                if p._data is not None:
+                if i not in self._kv_keys and p._data is not None:
                     self._kvstore.init(i, p.data())
+                    self._kv_keys.add(i)
         self._kv_initialized = True
 
     def allreduce_grads(self):
@@ -97,11 +115,19 @@ class Trainer:
         """Rescale gradients by 1/batch_size and apply one optimizer step.
         Under an AMP loss scaler: unscale, skip on overflow, adjust scale.
         With skip_nonfinite: skip the update when any grad is inf/nan."""
-        if not self._kv_initialized:
-            self._init_kvstore()
         self._optimizer.rescale_grad = self._scale / batch_size
+        self._init_kvstore()   # incremental: picks up late-materialised params
         self.allreduce_grads()
         if self._guard_says_skip():
+            return
+        if self._update_on_kvstore:
+            def apply_on_store(i, p):
+                # Trainer gradients are whole per-param arrays, never
+                # replica stacks: pin the layout so a dim0-sharded grad
+                # is not misread as a stack (kvstore 'auto' caveat)
+                self._kvstore.push(i, [p.grad()], layout="replicated")
+                self._kvstore.pull(i, out=p.data())
+            self._for_each_updatable(apply_on_store, ignore_stale_grad)
             return
         self._update(ignore_stale_grad)
 
@@ -118,12 +144,17 @@ class Trainer:
         return self.skip_nonfinite and amp.grads_nonfinite(self._params)
 
     def update(self, batch_size, ignore_stale_grad=False):
+        if self._update_on_kvstore:
+            raise MXNetError("update() cannot be called when "
+                             "update_on_kvstore=True: the store owns the "
+                             "optimizer (reference asserts the same); use "
+                             "step()")
         self._optimizer.rescale_grad = self._scale / batch_size
         if self._guard_says_skip():
             return
         self._update(ignore_stale_grad)
 
-    def _update(self, ignore_stale_grad=False):
+    def _for_each_updatable(self, apply_fn, ignore_stale_grad):
         for i, p in enumerate(self._params):
             if p.grad_req == "null" or p._data is None:
                 continue
@@ -132,9 +163,18 @@ class Trainer:
                     continue
                 raise MXNetError(f"Parameter {p.name} has no gradient; run "
                                  f"backward first or set ignore_stale_grad")
-            self._updater(i, p.grad(), p.data())
+            apply_fn(i, p)
+
+    def _update(self, ignore_stale_grad=False):
+        self._for_each_updatable(
+            lambda i, p: self._updater(i, p.grad(), p.data()),
+            ignore_stale_grad)
 
     def save_states(self, fname):
+        if self._update_on_kvstore:
+            # the optimizer state lives ON the store
+            self._kvstore.save_optimizer_states(fname)
+            return
         import pickle
         import numpy as np
         import jax
@@ -145,6 +185,9 @@ class Trainer:
                          "states": states}, f)
 
     def load_states(self, fname):
+        if self._update_on_kvstore:
+            self._kvstore.load_optimizer_states(fname)
+            return
         import pickle
         from ..ndarray.ndarray import NDArray
         import jax.numpy as jnp

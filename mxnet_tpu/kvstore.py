@@ -163,8 +163,11 @@ class KVStore:
         for k, v in zip(keys, values):
             self._store[str(k)] = NDArray(v._data)
 
-    def push(self, key, value, priority=0):
-        """Aggregate values into the store (sum across devices/workers)."""
+    def push(self, key, value, priority=0, layout="auto"):
+        """Aggregate values into the store (sum across devices/workers).
+        `layout` forwards to allreduce_ — callers pushing whole per-param
+        arrays (not replica stacks) should pin "replicated" so dim0-sharded
+        values are never misread as stacks (see allreduce_ caveat)."""
         keys = _as_list(key)
         if len(keys) == 1 and not isinstance(value, (list, tuple)) or \
                 (isinstance(value, (list, tuple))
@@ -174,7 +177,8 @@ class KVStore:
         else:
             values = [_as_list(v) for v in value]
         for k, vals in zip(keys, values):
-            agg = self.allreduce_([v._data for v in vals], key=str(k))
+            agg = self.allreduce_([v._data for v in vals], layout=layout,
+                                  key=str(k))
             k = str(k)
             if self._updater is not None:
                 if k not in self._store:

@@ -280,3 +280,76 @@ def test_trainer_forwards_compression_params():
         mx.gluon.Trainer(net.collect_params(), "sgd",
                          {"learning_rate": 0.1}, kvstore=None,
                          compression_params={"type": "int8"})
+
+
+def test_trainer_update_on_kvstore_matches_local_update():
+    """update_on_kvstore=True (previously ignored): the optimizer runs on
+    the store (push applies, pull returns) with identical numerics to the
+    local-update path, momentum state included."""
+    from mxnet_tpu import autograd
+    from mxnet_tpu.gluon import nn, loss as gloss
+
+    def run(on_kv):
+        mx.random.seed(5)
+        np.random.seed(5)
+        net = nn.Dense(4, in_units=6)
+        net.initialize()
+        tr = mx.gluon.Trainer(net.collect_params(), "sgd",
+                              {"learning_rate": 0.1, "momentum": 0.9},
+                              kvstore="local", update_on_kvstore=on_kv)
+        lf = gloss.L2Loss()
+        rs = np.random.RandomState(0)
+        x = nd.array(rs.randn(8, 6).astype(np.float32))
+        y = nd.array(rs.randn(8, 4).astype(np.float32))
+        for _ in range(3):
+            with autograd.record():
+                loss = lf(net(x), y)
+            loss.backward()
+            tr.step(8)
+        return {k: v.data().asnumpy() for k, v in
+                net.collect_params().items()}
+
+    a, b = run(False), run(True)
+    for (k0, v0), (k1, v1) in zip(a.items(), b.items()):
+        np.testing.assert_allclose(v0, v1, rtol=1e-6,
+                                   err_msg=f"{k0} vs {k1}")
+
+
+def test_trainer_update_on_kvstore_requires_store():
+    from mxnet_tpu.gluon import nn
+    net = nn.Dense(2, in_units=2)
+    net.initialize()
+    with pytest.raises(mx.base.MXNetError):
+        mx.gluon.Trainer(net.collect_params(), "sgd", {},
+                         kvstore=None, update_on_kvstore=True)
+
+
+def test_update_on_kvstore_respects_mults_and_states(tmp_path):
+    """lr_mult/wd_mult survive the stringified store keys; trainer
+    save/load_states round-trips the STORE's optimizer state; update()
+    is rejected (the store owns the optimizer)."""
+    from mxnet_tpu import autograd
+    from mxnet_tpu.gluon import nn, loss as gloss
+    net = nn.Dense(3, in_units=4)
+    net.initialize()
+    net.bias.lr_mult = 0.0          # frozen via multiplier
+    tr = mx.gluon.Trainer(net.collect_params(), "sgd",
+                          {"learning_rate": 0.5, "momentum": 0.9},
+                          kvstore="local", update_on_kvstore=True)
+    lf = gloss.L2Loss()
+    x = nd.array(np.ones((2, 4), np.float32))
+    y = nd.array(np.zeros((2, 3), np.float32))
+    b0 = net.bias.data().asnumpy().copy()
+    w0 = net.weight.data().asnumpy().copy()
+    with autograd.record():
+        loss = lf(net(x), y)
+    loss.backward()
+    tr.step(2)
+    assert np.allclose(net.bias.data().asnumpy(), b0), \
+        "lr_mult=0 ignored on the kvstore path"
+    assert not np.allclose(net.weight.data().asnumpy(), w0)
+    f = str(tmp_path / "t.states")
+    tr.save_states(f)
+    tr.load_states(f)                # momentum restored from the STORE
+    with pytest.raises(mx.base.MXNetError, match="update_on_kvstore"):
+        tr.update(2)
