@@ -44,6 +44,7 @@ from . import symbol
 from . import symbol as sym
 from . import module
 from . import module as mod
+from . import model
 from . import rnn
 from . import operator
 from . import name
@@ -51,6 +52,7 @@ from . import test_utils
 from . import attribute
 from .attribute import AttrScope
 from . import callback
+from . import rtc
 from . import monitor
 from . import profiler
 from . import amp

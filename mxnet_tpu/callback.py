@@ -3,9 +3,15 @@ from __future__ import annotations
 
 import logging
 import time
+from collections import namedtuple
 
 __all__ = ["Speedometer", "do_checkpoint", "log_train_metric",
-           "ProgressBar"]
+           "ProgressBar", "BatchEndParam"]
+
+# callback payload contract (reference: model.py BatchEndParam; defined
+# here so module.py can use it without importing the legacy model module)
+BatchEndParam = namedtuple("BatchEndParams",
+                           ["epoch", "nbatch", "eval_metric", "locals"])
 
 
 def do_checkpoint(prefix, period=1):

@@ -67,6 +67,7 @@ class Trainer:
                 raise MXNetError("update_on_kvstore=True requires a kvstore")
             self._kvstore.set_optimizer(self._optimizer)
         self._kv_initialized = False
+        self._kv_keys = set()
         self._scale = 1.0
         self.skip_nonfinite = skip_nonfinite
 
@@ -84,8 +85,6 @@ class Trainer:
     def _init_kvstore(self):
         # incremental + idempotent: deferred-init params materialise after
         # the first forward, so keys join the store as their data appears
-        if not hasattr(self, "_kv_keys"):
-            self._kv_keys = set()
         if self._kvstore is not None:
             for i, p in enumerate(self._params):
                 if i not in self._kv_keys and p._data is not None:

@@ -428,16 +428,34 @@ class KVStore:
         if self._updater is not None:
             states = {k: jax.tree_util.tree_map(to_np, v)
                       for k, v in getattr(self._updater, "states", {}).items()}
+        # num_update AND the per-key counts ride along so lr schedules
+        # resume at the right step — num_update is max(per-key counts), so
+        # restoring it alone would stagnate until post-resume pushes catch
+        # up (the reference pickles the whole updater for the same reason)
+        blob = {"states": states, "num_update": 0, "index_update_count": {}}
+        if self._optimizer is not None:
+            blob["num_update"] = getattr(self._optimizer, "num_update", 0)
+            blob["index_update_count"] = dict(
+                getattr(self._optimizer, "_index_update_count", {}))
         with open(fname, "wb") as f:
-            pickle.dump(states, f)
+            pickle.dump(blob, f)
 
     def load_optimizer_states(self, fname):
         import pickle
         with open(fname, "rb") as f:
-            states = pickle.load(f)
+            blob = pickle.load(f)
         if self._updater is None:
             raise MXNetError("set_optimizer must be called before "
                              "load_optimizer_states")
+        # accept both the {"states", "num_update"} blob and the legacy
+        # bare state dict
+        states = blob.get("states", blob) if isinstance(blob, dict) and \
+            "states" in blob else blob
+        if isinstance(blob, dict) and "num_update" in blob \
+                and self._optimizer is not None:
+            self._optimizer.num_update = blob["num_update"]
+            self._optimizer._index_update_count = dict(
+                blob.get("index_update_count", {}))
         self._updater.states = {
             k: jax.tree_util.tree_map(lambda x: NDArray(jnp.asarray(x)), v)
             for k, v in states.items()}

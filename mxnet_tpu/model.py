@@ -1,0 +1,176 @@
+"""Legacy model API (reference: python/mxnet/model.py).
+
+`FeedForward` is the pre-Module training front end the reference kept for
+backward compatibility; old tutorials and serialized scripts still call
+it. Here it is a thin adapter over `mxnet_tpu.module.Module` — the Module
+path is the one jit-compiled executor, so FeedForward inherits the
+TPU-native design (one XLA program per bound signature) for free.
+
+`BatchEndParam` is the callback payload contract shared by
+`mx.callback.Speedometer` et al. (reference: model.py BatchEndParam).
+"""
+from __future__ import annotations
+
+import logging
+
+import numpy as np
+
+from .callback import BatchEndParam  # noqa: F401  (reference home: model.py)
+from .checkpoint import save_checkpoint, load_checkpoint  # noqa: F401
+from .io import NDArrayIter
+from .module import Module
+
+__all__ = ["BatchEndParam", "FeedForward", "save_checkpoint",
+           "load_checkpoint"]
+
+
+def _as_iter(X, y=None, batch_size=128, shuffle=False, label_name=None):
+    """Coerce array-likes to an NDArrayIter (reference: model._init_iter)."""
+    if hasattr(X, "provide_data"):
+        return X
+    data = X.asnumpy() if hasattr(X, "asnumpy") else np.asarray(X)
+    label = None
+    if y is not None:
+        label = y.asnumpy() if hasattr(y, "asnumpy") else np.asarray(y)
+        if label_name:
+            label = {label_name: label}
+    batch_size = min(batch_size, len(data))
+    return NDArrayIter(data, label, batch_size=batch_size, shuffle=shuffle)
+
+
+class FeedForward:
+    """Reference model.FeedForward: symbol-level train/predict convenience.
+
+    Deprecated upstream in favour of Module (which this delegates to), kept
+    for API parity. `ctx` is accepted and ignored beyond device selection —
+    placement is XLA's job here, not a device-list loop.
+    """
+
+    def __init__(self, symbol, ctx=None, num_epoch=None, epoch_size=None,
+                 optimizer="sgd", initializer=None, numpy_batch_size=128,
+                 arg_params=None, aux_params=None, begin_epoch=0,
+                 logger=logging, **kwargs):
+        self.symbol = symbol
+        self.ctx = ctx
+        self.num_epoch = num_epoch
+        self.optimizer = optimizer
+        self.initializer = initializer
+        self.numpy_batch_size = numpy_batch_size
+        self.arg_params = arg_params
+        self.aux_params = aux_params
+        self.begin_epoch = begin_epoch
+        self.optimizer_params = kwargs.pop("optimizer_params", None) or {
+            k: v for k, v in kwargs.items()
+            if k in ("learning_rate", "momentum", "wd", "clip_gradient")}
+        self.logger = logger
+        self._module = None
+
+    # ------------------------------------------------------------ training
+    def fit(self, X, y=None, eval_data=None, eval_metric="acc",
+            epoch_end_callback=None, batch_end_callback=None,
+            kvstore="local", logger=None, work_load_list=None,
+            monitor=None, eval_end_callback=None,
+            eval_batch_end_callback=None):
+        label_name = None
+        args = self.symbol.list_arguments()
+        for cand in ("softmax_label", "label"):
+            if cand in args:
+                label_name = cand
+                break
+        train = _as_iter(X, y, self.numpy_batch_size, shuffle=True,
+                         label_name=label_name)
+        if eval_data is not None and not hasattr(eval_data, "provide_data"):
+            eval_data = _as_iter(eval_data[0], eval_data[1],
+                                 self.numpy_batch_size,
+                                 label_name=label_name)
+        label_names = [d.name for d in (train.provide_label or [])]
+        self._module = Module(self.symbol,
+                              data_names=[d.name for d in train.provide_data],
+                              label_names=label_names, context=self.ctx)
+        self._module.fit(
+            train, eval_data=eval_data, eval_metric=eval_metric,
+            epoch_end_callback=epoch_end_callback,
+            batch_end_callback=batch_end_callback, kvstore=kvstore,
+            optimizer=self.optimizer,
+            optimizer_params=self.optimizer_params,
+            initializer=self.initializer,
+            arg_params=self.arg_params, aux_params=self.aux_params,
+            begin_epoch=self.begin_epoch,
+            num_epoch=self.num_epoch if self.num_epoch is not None else 1)
+        self.arg_params, self.aux_params = self._module.get_params()
+        return self
+
+    # ----------------------------------------------------------- inference
+    def _ensure_module(self, it):
+        """Lazily bind an inference Module (load()-ed models have params
+        but no module yet)."""
+        if self._module is not None:
+            return self._module
+        self._module = Module(
+            self.symbol,
+            data_names=[d.name for d in it.provide_data],
+            label_names=[], context=self.ctx)
+        batch_size = it.provide_data[0].shape[0]
+        # bind loss-only label vars with a dummy shape: the output head
+        # (e.g. SoftmaxOutput) ignores them at inference, but the
+        # executor still needs every graph input materialised
+        label_shapes = [(n, (batch_size,))
+                        for n in self.symbol.list_arguments()
+                        if n in ("softmax_label", "label")
+                        or n.endswith("_label")]
+        self._module.bind([(d.name, d.shape) for d in it.provide_data],
+                          label_shapes or None, for_training=False)
+        self._module.init_params(self.initializer,
+                                 self.arg_params, self.aux_params)
+        return self._module
+
+    def predict(self, X, num_batch=None, return_data=False, reset=True):
+        it = _as_iter(X, batch_size=self.numpy_batch_size)
+        mod = self._ensure_module(it)
+        if reset:
+            it.reset()
+        outs = []
+        for i, batch in enumerate(it):
+            if num_batch is not None and i == num_batch:
+                break
+            mod.forward(batch, is_train=False)
+            out = mod.get_outputs()[0].asnumpy()
+            pad = getattr(batch, "pad", 0) or 0
+            if pad:  # NDArrayIter wraps the last batch; drop the filler
+                out = out[:len(out) - pad]
+            outs.append(out)
+        return np.concatenate(outs, axis=0)
+
+    def score(self, X, eval_metric="acc", num_batch=None, **kwargs):
+        it = _as_iter(X, batch_size=self.numpy_batch_size)
+        mod = self._ensure_module(it)
+        res = mod.score(it, eval_metric, num_batch=num_batch)
+        return res[0][1]
+
+    # ------------------------------------------------------- serialization
+    def save(self, prefix, epoch=None):
+        epoch = self.num_epoch if epoch is None else epoch
+        save_checkpoint(prefix, epoch or 0, self.symbol,
+                        self.arg_params or {}, self.aux_params or {})
+
+    @staticmethod
+    def load(prefix, epoch, ctx=None, **kwargs):
+        sym, arg_params, aux_params = load_checkpoint(prefix, epoch)
+        return FeedForward(sym, ctx=ctx, arg_params=arg_params,
+                           aux_params=aux_params, begin_epoch=epoch,
+                           **kwargs)
+
+    @staticmethod
+    def create(symbol, X, y=None, ctx=None, num_epoch=None,
+               optimizer="sgd", initializer=None, eval_data=None,
+               eval_metric="acc", epoch_end_callback=None,
+               batch_end_callback=None, kvstore="local", logger=None,
+               **kwargs):
+        model = FeedForward(symbol, ctx=ctx, num_epoch=num_epoch,
+                            optimizer=optimizer, initializer=initializer,
+                            **kwargs)
+        model.fit(X, y, eval_data=eval_data, eval_metric=eval_metric,
+                  epoch_end_callback=epoch_end_callback,
+                  batch_end_callback=batch_end_callback, kvstore=kvstore,
+                  logger=logger)
+        return model

@@ -15,6 +15,7 @@ from . import optimizer as opt_mod
 from . import initializer as init_mod
 from .ndarray.ndarray import NDArray, zeros
 from .checkpoint import save_checkpoint, load_checkpoint
+from .callback import BatchEndParam
 
 __all__ = ["Module", "BaseModule", "BucketingModule",
            "SequentialModule"]
@@ -61,9 +62,11 @@ class BaseModule:
                 self.update()
                 self.update_metric(eval_metric, batch.label)
                 if batch_end_callback:
+                    param = BatchEndParam(epoch=epoch, nbatch=nbatch,
+                                          eval_metric=eval_metric,
+                                          locals=None)
                     for cb in _as_list(batch_end_callback):
-                        cb(type("P", (), {"epoch": epoch, "nbatch": nbatch,
-                                          "eval_metric": eval_metric})())
+                        cb(param)
             if epoch_end_callback:
                 arg_p, aux_p = self.get_params()
                 for cb in _as_list(epoch_end_callback):
@@ -225,7 +228,11 @@ class Module(BaseModule):
             if num_batch is not None and i == num_batch:
                 break
             self.forward(batch, is_train=False)
-            outs.append(self.get_outputs()[0])
+            out = self.get_outputs()[0]
+            pad = getattr(batch, "pad", 0) or 0
+            if pad:  # NDArrayIter wraps the last batch; drop the filler
+                out = out[:out.shape[0] - pad]
+            outs.append(out)
         from .ops.tensor_ops import concat
         return concat(*outs, dim=0) if len(outs) > 1 else outs[0]
 

@@ -22,7 +22,8 @@ __all__ = ["LRN", "L2Normalization", "UpSampling", "BilinearResize2D",
            "Crop", "SliceChannel", "ROIPooling", "GridGenerator",
            "BilinearSampler", "SpatialTransformer", "Correlation",
            "MakeLoss", "BlockGrad", "stop_gradient", "batch_take",
-           "ravel_multi_index", "unravel_index", "digamma"]
+           "ravel_multi_index", "unravel_index", "digamma", "khatri_rao",
+           "moments"]
 
 
 # --------------------------------------------------------------- kernels
@@ -329,3 +330,43 @@ def unravel_index(data, shape=None, **kw):
 
 def digamma(data, **kw):
     return _apply(jax.scipy.special.digamma, [data])
+
+
+def khatri_rao(*matrices, **kw):
+    """Column-wise Kronecker product (reference: contrib/krprod.cc,
+    `mx.nd.khatri_rao`). Inputs (n_i, k) with a shared column count k;
+    output (prod n_i, k). One einsum per pair -> a single fused XLA
+    contraction chain, no per-column loops."""
+    if not matrices:
+        raise MXNetError("khatri_rao: need at least one matrix")
+
+    def fn(*ms):
+        out = ms[0]
+        for m in ms[1:]:
+            if m.shape[-1] != out.shape[-1]:
+                raise MXNetError(
+                    "khatri_rao: column counts differ "
+                    f"({out.shape[-1]} vs {m.shape[-1]})")
+            out = jnp.einsum("ik,jk->ijk", out, m).reshape(
+                out.shape[0] * m.shape[0], m.shape[-1])
+        return out
+    return _apply(fn, list(matrices))
+
+
+def moments(data, axes=None, keepdims=False, **kw):
+    """Mean and variance along `axes` (reference: nn/moments.cc). Returns
+    (mean, var) computed in one pass — XLA fuses both reductions over a
+    single read of the input."""
+    if axes is None:
+        ax = None
+    else:
+        ax = tuple(axes) if isinstance(axes, (list, tuple)) else (axes,)
+
+    def fn(x):
+        mean = jnp.mean(x, axis=ax, keepdims=True)
+        var = jnp.mean((x - mean) * (x - mean), axis=ax, keepdims=True)
+        if not keepdims:
+            mean = jnp.squeeze(mean, axis=ax)
+            var = jnp.squeeze(var, axis=ax)
+        return mean, var
+    return _apply(fn, [data], n_out=2)

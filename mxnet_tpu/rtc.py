@@ -1,0 +1,109 @@
+"""Runtime kernel compilation (reference: python/mxnet/rtc.py).
+
+The reference's ``mx.rtc`` JIT-compiles CUDA C source at runtime via NVRTC
+(``CudaModule``/``CudaKernel``). The TPU has no user-facing ISA to hand
+raw source to — the runtime-compilation story here is **Pallas**: a kernel
+is Python source describing per-tile math, lowered through Mosaic at call
+time. ``TpuModule`` keeps the reference's workflow (source string in,
+named callable kernels out) with Pallas as the backend; the CUDA entry
+points raise with that guidance (SURVEY §8 designed divergence).
+
+Example::
+
+    mod = mx.rtc.TpuModule('''
+    def axpy(x_ref, y_ref, o_ref):
+        o_ref[...] = 2.0 * x_ref[...] + y_ref[...]
+    ''', exports=["axpy"])
+    kern = mod.get_kernel("axpy")
+    z = kern(x, y)            # NDArrays in, NDArray out (same shape as x)
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .base import MXNetError
+from .ndarray.ndarray import NDArray, _apply
+
+try:
+    from jax.experimental import pallas as pl
+    _HAS_PALLAS = True
+except Exception:  # pragma: no cover
+    _HAS_PALLAS = False
+
+__all__ = ["TpuModule", "TpuKernel", "CudaModule", "CudaKernel"]
+
+
+class TpuKernel:
+    """One compiled kernel: NDArray positional args, one NDArray out whose
+    shape/dtype mirror the first input (the reference kernel contract is
+    likewise caller-declared; elementwise is the common case)."""
+
+    def __init__(self, fn, name, interpret):
+        self._fn = fn
+        self._name = name
+        self._interpret = interpret
+
+    def __call__(self, *args, out_shape=None, out_dtype=None):
+        if not args:
+            raise MXNetError(f"rtc kernel {self._name}: need >=1 input")
+        first = args[0]
+        shape = out_shape or first.shape
+        dtype = out_dtype or first.dtype
+
+        def run(*raw):
+            return pl.pallas_call(
+                self._fn,
+                out_shape=jax.ShapeDtypeStruct(shape, jnp.dtype(dtype)),
+                interpret=self._interpret,
+            )(*raw)
+        nd_args = [a if isinstance(a, NDArray) else NDArray(jnp.asarray(a))
+                   for a in args]
+        return _apply(run, nd_args)
+
+
+class TpuModule:
+    """Compile Pallas kernel bodies from source at runtime.
+
+    ``source`` is Python defining one function per kernel (Pallas ref
+    signature: inputs..., output ref last). ``exports`` names the kernels
+    to expose, mirroring the reference's ``CudaModule(source, exports=)``.
+    """
+
+    def __init__(self, source, options=(), exports=(), interpret=None):
+        if not _HAS_PALLAS:  # pragma: no cover
+            raise MXNetError("rtc.TpuModule: Pallas unavailable")
+        if interpret is None:
+            # CPU hosts run the same kernel bodies via interpret mode
+            interpret = jax.default_backend() != "tpu"
+        self._interpret = interpret
+        namespace = {"jax": jax, "jnp": jnp, "pl": pl}
+        try:
+            exec(compile(source, "<mx.rtc>", "exec"), namespace)
+        except SyntaxError as e:
+            raise MXNetError(f"rtc.TpuModule: source does not compile: {e}")
+        self._kernels = {}
+        for name in (exports or
+                     [k for k, v in namespace.items() if callable(v)
+                      and getattr(v, "__module__", None) is None]):
+            if name not in namespace or not callable(namespace[name]):
+                raise MXNetError(f"rtc.TpuModule: no kernel {name!r} "
+                                 "in source")
+            self._kernels[name] = namespace[name]
+
+    def get_kernel(self, name, signature=None):
+        if name not in self._kernels:
+            raise MXNetError(
+                f"rtc.TpuModule: kernel {name!r} not exported "
+                f"(have {sorted(self._kernels)})")
+        return TpuKernel(self._kernels[name], name, self._interpret)
+
+
+def CudaModule(*a, **kw):
+    raise MXNetError(
+        "mx.rtc.CudaModule compiles CUDA C, which has no TPU equivalent. "
+        "Use mx.rtc.TpuModule with a Pallas kernel body instead "
+        "(SURVEY.md §8).")
+
+
+CudaKernel = CudaModule

@@ -137,6 +137,31 @@ def test_optimizer_states_roundtrip(tmp_path):
                                kv2.pull("w").asnumpy())
 
 
+def test_optimizer_states_resume_num_update(tmp_path):
+    """lr schedules must resume at the saved step on the kvstore path:
+    save/load_optimizer_states round-trips optimizer.num_update (a silent
+    reset would re-serve the warmup/undecayed learning rate)."""
+    kv = kvstore.create("local")
+    kv.set_optimizer(mx.optimizer.create("sgd", learning_rate=0.1))
+    kv.init("w", nd.ones((3,)))
+    for _ in range(5):
+        kv.push("w", [nd.ones((3,))])
+    assert kv._optimizer.num_update == 5
+    fname = str(tmp_path / "opt.states")
+    kv.save_optimizer_states(fname)
+
+    kv2 = kvstore.create("local")
+    kv2.set_optimizer(mx.optimizer.create("sgd", learning_rate=0.1))
+    kv2.init("w", nd.ones((3,)))
+    kv2.load_optimizer_states(fname)
+    assert kv2._optimizer.num_update == 5
+    # counting must CONTINUE from the restored per-key counts, not
+    # stagnate at max(5, fresh-count) until post-resume pushes catch up
+    for _ in range(2):
+        kv2.push("w", [nd.ones((3,))])
+    assert kv2._optimizer.num_update == 7
+
+
 def test_load_optimizer_states_requires_optimizer(tmp_path):
     kv = kvstore.create("local")
     kv.set_optimizer(mx.optimizer.create("sgd"))

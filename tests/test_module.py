@@ -59,6 +59,25 @@ def test_predict():
     assert preds.shape[0] == 96
 
 
+def test_predict_trims_pad():
+    """n % batch != 0: the padded last batch's wrap-around rows must not
+    appear in the concatenated prediction."""
+    mod = mx.mod.Module(_softmax_mlp())
+    it = _toy_iter()
+    mod.fit(it, num_epoch=2)
+    rng = np.random.RandomState(3)
+    x = rng.randn(50, 6).astype(np.float32)
+    it50 = mio.NDArrayIter(x, np.zeros(50, np.float32), batch_size=32,
+                           label_name="softmax_label")
+    preds = mod.predict(it50)
+    assert preds.shape == (50, 3)
+    it32 = mio.NDArrayIter(x[:32], np.zeros(32, np.float32), batch_size=32,
+                           label_name="softmax_label")
+    np.testing.assert_allclose(preds.asnumpy()[:32],
+                               mod.predict(it32).asnumpy(),
+                               rtol=1e-5, atol=1e-6)
+
+
 def test_save_load_checkpoint():
     with tempfile.TemporaryDirectory() as d:
         prefix = os.path.join(d, "mod")
