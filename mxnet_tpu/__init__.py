@@ -26,6 +26,8 @@ from . import engine
 from . import ndarray
 from . import ndarray as nd
 from .ndarray import NDArray
+from . import numpy as np            # mx.np: numpy front end
+from . import numpy_extension as npx  # mx.npx: np-mode switches + nn ops
 from . import random
 from . import autograd
 from . import initializer

@@ -24,12 +24,20 @@ from .. import random                   # mx.nd.random.*
 softmax = _n.softmax_nd
 log_softmax = _n.log_softmax_nd
 
+from ..ops.compat_ops import *          # noqa: F401,F403  (classic names)
+
 # reference exposes a handful of random samplers at top level too
 from ..random import (uniform, normal, randn, randint, multinomial,
                       exponential, gamma, poisson)
 
 sample_uniform = uniform
 sample_normal = normal
+sample_gamma = gamma
+sample_exponential = exponential
+sample_poisson = poisson
+random_uniform = uniform
+random_normal = normal
+random_gamma = gamma
 
 # custom-op invocation entry (reference: mx.nd.Custom)
 from ..operator import Custom

@@ -47,10 +47,16 @@ def _ctx_of_jax(arr):
     return Context("tpu", idx)
 
 
+_np_ndarray_cls = None  # set by mxnet_tpu.numpy on import (mx.np arrays)
+
+
 def _apply(fn, nd_inputs, kwargs=None, n_out=1):
     """Execute a pure function over NDArray inputs; wrap + record outputs.
 
     This is the single imperative dispatch point (reference: MXImperativeInvoke).
+    np-ness propagates: if any input is an mx.np ndarray, outputs are too —
+    this one rule carries the numpy front end through every op, Gluon block
+    and the autograd tape without a parallel dispatch path.
     """
     kwargs = kwargs or {}
     raw = [x._data for x in nd_inputs]
@@ -59,7 +65,13 @@ def _apply(fn, nd_inputs, kwargs=None, n_out=1):
         outs = (out,)
     else:
         outs = tuple(out)
-    nd_outs = tuple(NDArray(o) for o in outs)
+    cls = NDArray
+    if _np_ndarray_cls is not None:
+        for x in nd_inputs:
+            if isinstance(x, _np_ndarray_cls):
+                cls = _np_ndarray_cls
+                break
+    nd_outs = tuple(cls(o) for o in outs)
     if autograd.is_recording():
         autograd.record_op(fn, nd_inputs, kwargs, nd_outs)
     return nd_outs[0] if n_out == 1 and len(nd_outs) == 1 else nd_outs
@@ -208,7 +220,7 @@ class NDArray:
     def copy(self):
         # underlying jax.Array is immutable, so sharing the buffer is a
         # semantically correct (and free) copy
-        return NDArray(self._data)
+        return type(self)(self._data)
 
     def copyto(self, other):
         """Copy into another NDArray (rebind) or onto a Context."""
@@ -224,7 +236,7 @@ class NDArray:
         ctx = Context(ctx)
         if ctx == self.context:
             return self
-        return NDArray(jax.device_put(self._data, ctx.jax_device))
+        return type(self)(jax.device_put(self._data, ctx.jax_device))
 
     as_in_ctx = as_in_context
 
@@ -240,13 +252,12 @@ class NDArray:
         return self
 
     def detach(self):
-        out = NDArray(self._data)
-        return out
+        return type(self)(self._data)
 
     # ------------------------------------------------------------- autograd
     def attach_grad(self, grad_req="write", stype=None):
         """Allocate a gradient buffer so backward() writes into `.grad`."""
-        self._grad = NDArray(jnp.zeros_like(self._data))
+        self._grad = type(self)(jnp.zeros_like(self._data))
         self._grad_req = grad_req
         self._tape_ref = None
 

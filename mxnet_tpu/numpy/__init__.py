@@ -1,0 +1,569 @@
+"""mx.np — the numpy-compatible array front end (reference:
+python/mxnet/numpy/, MXNet's "deepnumpy" from 1.6/2.0).
+
+TPU-native design: there is no second dispatch path. `np.ndarray`
+subclasses `mx.nd.NDArray`, and the single imperative dispatch point
+(`ndarray._apply`) propagates np-ness — any op with an np input yields np
+outputs. That one rule carries the numpy front end through every existing
+kernel, every Gluon block (net(np_x) returns np arrays), and the autograd
+tape, with zero duplicated op code. Functions here are thin numpy-named
+adapters over `jnp`, so numpy semantics (broadcasting, dtype promotion,
+0-d results, negative axes, boolean masks) come from XLA's own numpy
+implementation rather than a reimplementation.
+
+Divergences (SURVEY §8): float64 truncates to float32 (JAX x64 off, TPU
+native dtypes); boolean-mask indexing and `nonzero`/`unique` are
+eager-only (data-dependent shapes cannot live under jit — use `where`
+inside compiled code).
+"""
+from __future__ import annotations
+
+import numpy as _onp
+
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError, numeric_types
+from ..ndarray import ndarray as _nd_mod
+from ..ndarray.ndarray import NDArray, _apply, _np_dtype
+
+__all__ = ["ndarray", "array", "asarray", "zeros", "ones", "full", "empty",
+           "arange", "linspace", "logspace", "eye", "identity",
+           "zeros_like", "ones_like", "full_like", "empty_like",
+           "concatenate", "stack", "vstack", "hstack", "dstack", "split",
+           "expand_dims", "squeeze", "reshape", "transpose", "swapaxes",
+           "moveaxis", "broadcast_to", "broadcast_arrays", "tile", "repeat",
+           "flip", "roll", "where", "take", "take_along_axis", "sort",
+           "argsort", "unique", "nonzero", "dot", "matmul", "tensordot",
+           "einsum", "inner", "outer", "trace", "diag", "tril", "triu",
+           "maximum", "minimum", "clip", "meshgrid", "atleast_1d",
+           "atleast_2d", "atleast_3d", "pad", "cumsum", "cumprod",
+           "append", "delete", "insert", "ravel",
+           "may_share_memory", "shares_memory",
+           "pi", "e", "inf", "nan", "newaxis", "random", "linalg"]
+
+
+# --------------------------------------------------------------------- array
+class ndarray(NDArray):
+    """numpy-flavoured NDArray. Identical storage (a `jax.Array`); only the
+    printed form and a few numpy-named members differ from nd."""
+
+    def __repr__(self):
+        return f"array({_onp.asarray(self._data)})"
+
+    def __str__(self):
+        return str(_onp.asarray(self._data))
+
+    # numpy members not on the nd surface
+    def item(self, *args):
+        return _onp.asarray(self._data).item(*args)
+
+    def tolist(self):
+        return _onp.asarray(self._data).tolist()
+
+    def std(self, axis=None, keepdims=False, ddof=0):
+        return _apply(lambda a: jnp.std(a, axis=axis, ddof=ddof,
+                                        keepdims=keepdims), [self])
+
+    def var(self, axis=None, keepdims=False, ddof=0):
+        return _apply(lambda a: jnp.var(a, axis=axis, ddof=ddof,
+                                        keepdims=keepdims), [self])
+
+    def all(self, axis=None, keepdims=False):
+        return _apply(lambda a: jnp.all(a, axis=axis, keepdims=keepdims),
+                      [self])
+
+    def any(self, axis=None, keepdims=False):
+        return _apply(lambda a: jnp.any(a, axis=axis, keepdims=keepdims),
+                      [self])
+
+    def cumsum(self, axis=None, dtype=None):
+        return _apply(lambda a: jnp.cumsum(a, axis=axis, dtype=dtype), [self])
+
+    def ravel(self):
+        return _apply(jnp.ravel, [self])
+
+    def nonzero(self):
+        return tuple(ndarray(i) for i in jnp.nonzero(self._data))
+
+    # numpy semantics: comparisons yield BOOL arrays (nd yields 0/1
+    # floats for reference parity), so masks feed boolean indexing
+    def __eq__(self, other):
+        if other is None:   # numpy: x == None -> elementwise False
+            return ndarray(jnp.zeros(self.shape, jnp.bool_))
+        return _binary(jnp.equal)(self, other)
+
+    def __ne__(self, other):
+        if other is None:
+            return ndarray(jnp.ones(self.shape, jnp.bool_))
+        return _binary(jnp.not_equal)(self, other)
+
+    def __lt__(self, other):
+        return _binary(jnp.less)(self, other)
+
+    def __le__(self, other):
+        return _binary(jnp.less_equal)(self, other)
+
+    def __gt__(self, other):
+        return _binary(jnp.greater)(self, other)
+
+    def __ge__(self, other):
+        return _binary(jnp.greater_equal)(self, other)
+
+    __hash__ = NDArray.__hash__  # defining __eq__ clears it otherwise
+
+    @property
+    def flat(self):
+        return iter(self.reshape(-1))
+
+    def as_nd_ndarray(self):
+        """View as classic nd (shared buffer)."""
+        return NDArray(self._data)
+
+    def as_np_ndarray(self):
+        return self
+
+
+NDArray.as_np_ndarray = lambda self: ndarray(self._data)
+_nd_mod._np_ndarray_cls = ndarray  # turn on np propagation in _apply
+
+
+def _c(x, dtype=None):
+    """Coerce to an np ndarray (shared buffer for NDArray inputs)."""
+    if isinstance(x, ndarray):
+        return x if dtype is None else x.astype(dtype)
+    if isinstance(x, NDArray):
+        out = ndarray(x._data)
+        return out if dtype is None else out.astype(dtype)
+    return array(x, dtype=dtype)
+
+
+def array(obj, dtype=None, ctx=None):
+    if isinstance(obj, NDArray):
+        data = obj._data if dtype is None else obj._data.astype(
+            _np_dtype(dtype))
+        return ndarray(data, ctx=ctx)
+    return ndarray(jnp.asarray(_onp.asarray(obj),
+                               dtype=_np_dtype(dtype) if dtype else None),
+                   ctx=ctx)
+
+
+def asarray(obj, dtype=None):
+    if isinstance(obj, ndarray) and dtype is None:
+        return obj
+    return array(obj, dtype=dtype)
+
+
+# ------------------------------------------------------------------ factories
+def _factory(jfn):
+    def f(*args, dtype=None, ctx=None, **kw):
+        kw.pop("order", None)
+        if dtype is not None:
+            kw["dtype"] = _np_dtype(dtype)
+        return ndarray(jfn(*args, **kw), ctx=ctx)
+    f.__name__ = jfn.__name__
+    return f
+
+
+zeros = _factory(jnp.zeros)
+ones = _factory(jnp.ones)
+full = _factory(jnp.full)
+arange = _factory(jnp.arange)
+linspace = _factory(jnp.linspace)
+logspace = _factory(jnp.logspace)
+eye = _factory(jnp.eye)
+identity = _factory(jnp.identity)
+
+
+def empty(shape, dtype=None, ctx=None):
+    # XLA has no uninitialised-buffer primitive (SURVEY §8): zeros
+    return zeros(shape, dtype=dtype or "float32", ctx=ctx)
+
+
+def zeros_like(a, dtype=None):
+    return _apply(lambda x: jnp.zeros_like(x, dtype=_np_dtype(dtype)
+                                           if dtype else None), [_c(a)])
+
+
+def ones_like(a, dtype=None):
+    return _apply(lambda x: jnp.ones_like(x, dtype=_np_dtype(dtype)
+                                          if dtype else None), [_c(a)])
+
+
+def full_like(a, fill_value, dtype=None):
+    return _apply(lambda x: jnp.full_like(x, fill_value,
+                                          dtype=_np_dtype(dtype)
+                                          if dtype else None), [_c(a)])
+
+
+empty_like = zeros_like
+
+
+# ------------------------------------------------------- generated math suite
+def _unary(jfn):
+    def f(x, **kw):
+        kw.pop("out", None)
+        return _apply(lambda a: jfn(a, **kw), [_c(x)])
+    f.__name__ = jfn.__name__
+    return f
+
+
+def _binary(jfn):
+    def f(x1, x2, **kw):
+        kw.pop("out", None)
+        a_nd, b_nd = isinstance(x1, NDArray), isinstance(x2, NDArray)
+        if a_nd and b_nd:
+            return _apply(lambda a, b: jfn(a, b, **kw), [_c(x1), _c(x2)])
+        if a_nd:  # python scalars stay weakly typed (no silent upcast)
+            return _apply(lambda a, _b=x2: jfn(a, _b, **kw), [_c(x1)])
+        if b_nd:
+            return _apply(lambda b, _a=x1: jfn(_a, b, **kw), [_c(x2)])
+        return array(jfn(jnp.asarray(x1), jnp.asarray(x2), **kw))
+    f.__name__ = jfn.__name__
+    return f
+
+
+_UNARY = ("negative positive absolute abs fabs sign rint floor ceil "
+          "trunc sqrt cbrt square reciprocal exp expm1 exp2 log log2 log10 "
+          "log1p sin cos tan arcsin arccos arctan sinh cosh tanh arcsinh "
+          "arccosh arctanh degrees radians isnan isinf isfinite logical_not "
+          "invert")
+_BINARY = ("add subtract multiply divide true_divide mod remainder power "
+           "float_power hypot arctan2 logaddexp copysign logical_and "
+           "logical_or logical_xor equal not_equal less less_equal greater "
+           "greater_equal fmax fmin bitwise_and bitwise_or bitwise_xor "
+           "left_shift right_shift floor_divide")
+for _name in _UNARY.split():
+    globals()[_name] = _unary(getattr(jnp, _name))
+    __all__.append(_name)
+fix = _unary(jnp.trunc)   # numpy fix == round toward zero == trunc
+fix.__name__ = "fix"
+__all__.append("fix")
+for _name in _BINARY.split():
+    globals()[_name] = _binary(getattr(jnp, _name))
+    __all__.append(_name)
+maximum = _binary(jnp.maximum)
+minimum = _binary(jnp.minimum)
+
+
+def _reduction(jfn, name=None):
+    def f(a, axis=None, dtype=None, keepdims=False, **kw):
+        kw.pop("out", None)
+        kwargs = dict(axis=axis, keepdims=keepdims, **kw)
+        if dtype is not None:
+            kwargs["dtype"] = _np_dtype(dtype)
+        return _apply(lambda x: jfn(x, **kwargs), [_c(a)])
+    f.__name__ = name or jfn.__name__
+    return f
+
+
+for _name in ("sum prod mean max min amax amin all any nanmax nanmin "
+              "nansum nanmean median").split():
+    globals()[_name] = _reduction(getattr(jnp, _name))
+    __all__.append(_name)
+
+
+def std(a, axis=None, keepdims=False, ddof=0):
+    return _apply(lambda x: jnp.std(x, axis=axis, ddof=ddof,
+                                    keepdims=keepdims), [_c(a)])
+
+
+def var(a, axis=None, keepdims=False, ddof=0):
+    return _apply(lambda x: jnp.var(x, axis=axis, ddof=ddof,
+                                    keepdims=keepdims), [_c(a)])
+
+
+def argmax(a, axis=None):
+    return _apply(lambda x: jnp.argmax(x, axis=axis), [_c(a)])
+
+
+def argmin(a, axis=None):
+    return _apply(lambda x: jnp.argmin(x, axis=axis), [_c(a)])
+
+
+def average(a, axis=None, weights=None):
+    if weights is None:
+        return mean(a, axis=axis)
+    return _apply(lambda x, w: jnp.average(x, axis=axis, weights=w),
+                  [_c(a), _c(weights)])
+
+
+def cumsum(a, axis=None, dtype=None):
+    return _apply(lambda x: jnp.cumsum(x, axis=axis, dtype=dtype), [_c(a)])
+
+
+def cumprod(a, axis=None, dtype=None):
+    return _apply(lambda x: jnp.cumprod(x, axis=axis, dtype=dtype), [_c(a)])
+
+
+__all__ += ["std", "var", "argmax", "argmin", "average"]
+
+
+# ----------------------------------------------------------------- shape ops
+def reshape(a, newshape, order="C"):
+    return _apply(lambda x: jnp.reshape(x, newshape), [_c(a)])
+
+
+def transpose(a, axes=None):
+    return _apply(lambda x: jnp.transpose(x, axes), [_c(a)])
+
+
+def swapaxes(a, axis1, axis2):
+    return _apply(lambda x: jnp.swapaxes(x, axis1, axis2), [_c(a)])
+
+
+def moveaxis(a, source, destination):
+    return _apply(lambda x: jnp.moveaxis(x, source, destination), [_c(a)])
+
+
+def expand_dims(a, axis):
+    return _apply(lambda x: jnp.expand_dims(x, axis), [_c(a)])
+
+
+def squeeze(a, axis=None):
+    return _apply(lambda x: jnp.squeeze(x, axis=axis), [_c(a)])
+
+
+def ravel(a):
+    return _apply(jnp.ravel, [_c(a)])
+
+
+def broadcast_to(a, shape):
+    return _apply(lambda x: jnp.broadcast_to(x, shape), [_c(a)])
+
+
+def _as_list(res, n):
+    """Multi-output _apply returns a bare ndarray when n==1 — wrap it
+    (list(ndarray) would iterate rows, not make a 1-list)."""
+    return [res] if n == 1 else list(res)
+
+
+def broadcast_arrays(*arrays):
+    n = len(arrays)
+    return _as_list(_apply(lambda *xs: tuple(jnp.broadcast_arrays(*xs)),
+                           [_c(a) for a in arrays], n_out=n), n)
+
+
+def tile(a, reps):
+    return _apply(lambda x: jnp.tile(x, reps), [_c(a)])
+
+
+def repeat(a, repeats, axis=None):
+    return _apply(lambda x: jnp.repeat(x, repeats, axis=axis), [_c(a)])
+
+
+def flip(a, axis=None):
+    return _apply(lambda x: jnp.flip(x, axis=axis), [_c(a)])
+
+
+def roll(a, shift, axis=None):
+    return _apply(lambda x: jnp.roll(x, shift, axis=axis), [_c(a)])
+
+
+def pad(a, pad_width, mode="constant", **kw):
+    return _apply(lambda x: jnp.pad(x, pad_width, mode=mode, **kw), [_c(a)])
+
+
+def concatenate(seq, axis=0):
+    return _apply(lambda *xs: jnp.concatenate(xs, axis=axis),
+                  [_c(a) for a in seq])
+
+
+def stack(seq, axis=0):
+    return _apply(lambda *xs: jnp.stack(xs, axis=axis),
+                  [_c(a) for a in seq])
+
+
+def vstack(seq):
+    return _apply(lambda *xs: jnp.vstack(xs), [_c(a) for a in seq])
+
+
+def hstack(seq):
+    return _apply(lambda *xs: jnp.hstack(xs), [_c(a) for a in seq])
+
+
+def dstack(seq):
+    return _apply(lambda *xs: jnp.dstack(xs), [_c(a) for a in seq])
+
+
+def split(a, indices_or_sections, axis=0):
+    a = _c(a)
+    n = indices_or_sections if isinstance(indices_or_sections, int) \
+        else len(indices_or_sections) + 1
+    return _as_list(_apply(lambda x: tuple(jnp.split(
+        x, indices_or_sections, axis=axis)), [a], n_out=n), n)
+
+
+def atleast_1d(*arys):
+    outs = [_apply(jnp.atleast_1d, [_c(a)]) for a in arys]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_2d(*arys):
+    outs = [_apply(jnp.atleast_2d, [_c(a)]) for a in arys]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_3d(*arys):
+    outs = [_apply(jnp.atleast_3d, [_c(a)]) for a in arys]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def append(arr, values, axis=None):
+    return _apply(lambda a, v: jnp.append(a, v, axis=axis),
+                  [_c(arr), _c(values)])
+
+
+def delete(arr, obj, axis=None):
+    return _apply(lambda a: jnp.delete(a, obj, axis=axis), [_c(arr)])
+
+
+def insert(arr, obj, values, axis=None):
+    return _apply(lambda a, v: jnp.insert(a, obj, v, axis=axis),
+                  [_c(arr), _c(values)])
+
+
+def meshgrid(*xi, indexing="xy"):
+    # NB: builtins max/min/sum/all/any are shadowed by the reductions
+    # defined above — module code must not call them bare
+    n = len(xi) or 1
+    return _as_list(_apply(lambda *xs: tuple(jnp.meshgrid(
+        *xs, indexing=indexing)), [_c(x) for x in xi], n_out=n), n)
+
+
+# ----------------------------------------------------------- linalg-ish ops
+def dot(a, b):
+    return _apply(jnp.dot, [_c(a), _c(b)])
+
+
+def matmul(a, b):
+    return _apply(jnp.matmul, [_c(a), _c(b)])
+
+
+def tensordot(a, b, axes=2):
+    return _apply(lambda x, y: jnp.tensordot(x, y, axes=axes),
+                  [_c(a), _c(b)])
+
+
+def einsum(subscripts, *operands):
+    return _apply(lambda *xs: jnp.einsum(subscripts, *xs),
+                  [_c(o) for o in operands])
+
+
+def inner(a, b):
+    return _apply(jnp.inner, [_c(a), _c(b)])
+
+
+def outer(a, b):
+    return _apply(jnp.outer, [_c(a), _c(b)])
+
+
+def trace(a, offset=0, axis1=0, axis2=1):
+    return _apply(lambda x: jnp.trace(x, offset=offset, axis1=axis1,
+                                      axis2=axis2), [_c(a)])
+
+
+def diag(v, k=0):
+    return _apply(lambda x: jnp.diag(x, k=k), [_c(v)])
+
+
+def tril(m, k=0):
+    return _apply(lambda x: jnp.tril(x, k=k), [_c(m)])
+
+
+def triu(m, k=0):
+    return _apply(lambda x: jnp.triu(x, k=k), [_c(m)])
+
+
+# ------------------------------------------------------- select and indexing
+def where(condition, x=None, y=None):
+    if x is None and y is None:
+        return nonzero(condition)
+    return _apply(lambda c, a, b: jnp.where(c, a, b),
+                  [_c(condition), _c(x), _c(y)])
+
+
+def take(a, indices, axis=None, mode="clip"):
+    return _apply(lambda x, i: jnp.take(x, i.astype(jnp.int32), axis=axis,
+                                        mode=mode),
+                  [_c(a), _c(indices)])
+
+
+def take_along_axis(a, indices, axis):
+    return _apply(lambda x, i: jnp.take_along_axis(
+        x, i.astype(jnp.int32), axis=axis), [_c(a), _c(indices)])
+
+
+def sort(a, axis=-1):
+    return _apply(lambda x: jnp.sort(x, axis=axis), [_c(a)])
+
+
+def argsort(a, axis=-1):
+    return _apply(lambda x: jnp.argsort(x, axis=axis), [_c(a)])
+
+
+def clip(a, a_min=None, a_max=None):
+    return _apply(lambda x: jnp.clip(x, a_min, a_max), [_c(a)])
+
+
+def unique(ar, return_index=False, return_inverse=False,
+           return_counts=False):
+    """Eager-only (data-dependent output shape — SURVEY §8)."""
+    res = jnp.unique(_c(ar)._data, return_index=return_index,
+                     return_inverse=return_inverse,
+                     return_counts=return_counts)
+    if isinstance(res, tuple):
+        return tuple(ndarray(r) for r in res)
+    return ndarray(res)
+
+
+def nonzero(a):
+    """Eager-only (data-dependent output shape — SURVEY §8)."""
+    return tuple(ndarray(i) for i in jnp.nonzero(_c(a)._data))
+
+
+def isclose(a, b, rtol=1e-5, atol=1e-8, equal_nan=False):
+    return _apply(lambda x, y: jnp.isclose(x, y, rtol=rtol, atol=atol,
+                                           equal_nan=equal_nan),
+                  [_c(a), _c(b)])
+
+
+def allclose(a, b, rtol=1e-5, atol=1e-8, equal_nan=False):
+    return bool(jnp.allclose(_c(a)._data, _c(b)._data, rtol=rtol,
+                             atol=atol, equal_nan=equal_nan))
+
+
+def array_equal(a1, a2):
+    return bool(jnp.array_equal(_c(a1)._data, _c(a2)._data))
+
+
+def may_share_memory(a, b, max_work=None):
+    # jax.Arrays are immutable; buffer identity is the only sharing
+    return isinstance(a, NDArray) and isinstance(b, NDArray) \
+        and a._data is b._data
+
+
+shares_memory = may_share_memory
+__all__ += ["isclose", "allclose", "array_equal"]
+
+# ------------------------------------------------------------------ constants
+pi = _onp.pi
+e = _onp.e
+inf = _onp.inf
+nan = _onp.nan
+newaxis = None
+# dtype names, numpy-style
+float16 = _onp.float16
+float32 = _onp.float32
+float64 = _onp.float64
+int8 = _onp.int8
+int16 = _onp.int16
+int32 = _onp.int32
+int64 = _onp.int64
+uint8 = _onp.uint8
+bool_ = _onp.bool_
+__all__ += ["float16", "float32", "float64", "int8", "int16", "int32",
+            "int64", "uint8", "bool_"]
+
+from . import random     # noqa: E402  (needs ndarray defined)
+from . import linalg     # noqa: E402

@@ -1,0 +1,96 @@
+"""mx.np.linalg — numpy-named decompositions over jnp.linalg (reference:
+python/mxnet/numpy/linalg.py). On TPU these lower to XLA's batched
+factorisation kernels; everything differentiates through jax.vjp like any
+other op on the tape."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..ndarray.ndarray import _apply
+
+__all__ = ["norm", "svd", "cholesky", "inv", "pinv", "det", "slogdet",
+           "solve", "lstsq", "eig", "eigh", "eigvals", "eigvalsh", "qr",
+           "matrix_rank", "tensorinv", "tensorsolve"]
+
+
+def _c(x):
+    from . import _c as coerce
+    return coerce(x)
+
+
+def norm(x, ord=None, axis=None, keepdims=False):
+    return _apply(lambda a: jnp.linalg.norm(a, ord=ord, axis=axis,
+                                            keepdims=keepdims), [_c(x)])
+
+
+def svd(a, full_matrices=False, compute_uv=True):
+    if not compute_uv:
+        return _apply(lambda x: jnp.linalg.svd(
+            x, full_matrices=full_matrices, compute_uv=False), [_c(a)])
+    return _apply(lambda x: tuple(jnp.linalg.svd(
+        x, full_matrices=full_matrices)), [_c(a)], n_out=3)
+
+
+def cholesky(a):
+    return _apply(jnp.linalg.cholesky, [_c(a)])
+
+
+def inv(a):
+    return _apply(jnp.linalg.inv, [_c(a)])
+
+
+def pinv(a, rcond=None):
+    return _apply(lambda x: jnp.linalg.pinv(x, rcond=rcond), [_c(a)])
+
+
+def det(a):
+    return _apply(jnp.linalg.det, [_c(a)])
+
+
+def slogdet(a):
+    return _apply(lambda x: tuple(jnp.linalg.slogdet(x)), [_c(a)], n_out=2)
+
+
+def solve(a, b):
+    return _apply(jnp.linalg.solve, [_c(a), _c(b)])
+
+
+def lstsq(a, b, rcond="warn"):
+    rc = None if rcond == "warn" else rcond
+    return _apply(lambda x, y: tuple(jnp.linalg.lstsq(x, y, rcond=rc)),
+                  [_c(a), _c(b)], n_out=4)
+
+
+def eig(a):
+    return _apply(lambda x: tuple(jnp.linalg.eig(x)), [_c(a)], n_out=2)
+
+
+def eigh(a, UPLO="L"):
+    return _apply(lambda x: tuple(jnp.linalg.eigh(x, UPLO=UPLO)),
+                  [_c(a)], n_out=2)
+
+
+def eigvals(a):
+    return _apply(jnp.linalg.eigvals, [_c(a)])
+
+
+def eigvalsh(a, UPLO="L"):
+    return _apply(lambda x: jnp.linalg.eigvalsh(x, UPLO=UPLO), [_c(a)])
+
+
+def qr(a, mode="reduced"):
+    return _apply(lambda x: tuple(jnp.linalg.qr(x, mode=mode)),
+                  [_c(a)], n_out=2)
+
+
+def matrix_rank(a, tol=None):
+    return _apply(lambda x: jnp.linalg.matrix_rank(x, tol=tol), [_c(a)])
+
+
+def tensorinv(a, ind=2):
+    return _apply(lambda x: jnp.linalg.tensorinv(x, ind=ind), [_c(a)])
+
+
+def tensorsolve(a, b, axes=None):
+    return _apply(lambda x, y: jnp.linalg.tensorsolve(x, y, axes=axes),
+                  [_c(a), _c(b)])

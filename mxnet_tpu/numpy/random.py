@@ -1,0 +1,155 @@
+"""mx.np.random — numpy-named sampling over the global RNG key chain
+(reference: python/mxnet/numpy/random.py). Shares the seed/key state with
+mx.random so `mx.random.seed` and `np.random.seed` are one stream."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as _onp
+
+from .. import random as _mxrand
+from ..ndarray.ndarray import NDArray, _np_dtype
+
+__all__ = ["seed", "uniform", "normal", "randint", "rand", "randn",
+           "choice", "shuffle", "permutation", "multinomial", "gamma",
+           "exponential", "beta", "chisquare", "laplace", "gumbel",
+           "logistic", "lognormal", "pareto", "power", "rayleigh",
+           "weibull"]
+
+
+def seed(seed_state):
+    _mxrand.seed(seed_state)
+
+
+def _np(val, dtype=None):
+    from . import ndarray
+    if dtype is not None:
+        val = val.astype(_np_dtype(dtype))
+    return ndarray(val)
+
+
+def _size(size):
+    if size is None:
+        return ()
+    return (size,) if isinstance(size, int) else tuple(size)
+
+
+def uniform(low=0.0, high=1.0, size=None, dtype=None, ctx=None):
+    k = _mxrand._next_key()
+    return _np(jax.random.uniform(k, _size(size), minval=low, maxval=high),
+               dtype)
+
+
+def normal(loc=0.0, scale=1.0, size=None, dtype=None, ctx=None):
+    k = _mxrand._next_key()
+    return _np(loc + scale * jax.random.normal(k, _size(size)), dtype)
+
+
+def randint(low, high=None, size=None, dtype="int32", ctx=None):
+    if high is None:
+        low, high = 0, low
+    k = _mxrand._next_key()
+    return _np(jax.random.randint(k, _size(size), low, high), dtype)
+
+
+def rand(*shape):
+    return uniform(size=shape or None)
+
+
+def randn(*shape):
+    return normal(size=shape or None)
+
+
+def choice(a, size=None, replace=True, p=None):
+    k = _mxrand._next_key()
+    arr = a._data if isinstance(a, NDArray) else (
+        jnp.arange(a) if isinstance(a, int) else jnp.asarray(a))
+    pv = None if p is None else (p._data if isinstance(p, NDArray)
+                                 else jnp.asarray(p))
+    return _np(jax.random.choice(k, arr, _size(size), replace=replace, p=pv))
+
+
+def shuffle(x):
+    """In-place permutation along axis 0 (numpy contract: mutates x)."""
+    k = _mxrand._next_key()
+    x._assign_value(jax.random.permutation(k, x._data, axis=0))
+
+
+def permutation(x):
+    k = _mxrand._next_key()
+    arr = jnp.arange(x) if isinstance(x, int) else (
+        x._data if isinstance(x, NDArray) else jnp.asarray(x))
+    return _np(jax.random.permutation(k, arr, axis=0))
+
+
+def multinomial(n, pvals, size=None):
+    """Counts over `len(pvals)` categories from n draws."""
+    k = _mxrand._next_key()
+    pv = pvals._data if isinstance(pvals, NDArray) else jnp.asarray(pvals)
+    draws = jax.random.categorical(
+        k, jnp.log(pv), shape=_size(size) + (int(n),))
+    counts = jax.nn.one_hot(draws, pv.shape[-1], dtype=jnp.int32).sum(-2)
+    return _np(counts)
+
+
+def gamma(shape, scale=1.0, size=None, dtype=None, ctx=None):
+    k = _mxrand._next_key()
+    return _np(jax.random.gamma(k, shape, _size(size)) * scale, dtype)
+
+
+def exponential(scale=1.0, size=None):
+    k = _mxrand._next_key()
+    return _np(jax.random.exponential(k, _size(size)) * scale)
+
+
+def beta(a, b, size=None):
+    k = _mxrand._next_key()
+    return _np(jax.random.beta(k, a, b, _size(size)))
+
+
+def chisquare(df, size=None):
+    k = _mxrand._next_key()
+    return _np(jax.random.chisquare(k, df, shape=_size(size)))
+
+
+def laplace(loc=0.0, scale=1.0, size=None):
+    k = _mxrand._next_key()
+    return _np(loc + scale * jax.random.laplace(k, _size(size)))
+
+
+def gumbel(loc=0.0, scale=1.0, size=None):
+    k = _mxrand._next_key()
+    return _np(loc + scale * jax.random.gumbel(k, _size(size)))
+
+
+def logistic(loc=0.0, scale=1.0, size=None):
+    k = _mxrand._next_key()
+    return _np(loc + scale * jax.random.logistic(k, _size(size)))
+
+
+def lognormal(mean=0.0, sigma=1.0, size=None):
+    k = _mxrand._next_key()
+    return _np(jnp.exp(mean + sigma * jax.random.normal(k, _size(size))))
+
+
+def pareto(a, size=None):
+    # numpy.random.pareto is the LOMAX (Pareto II, support [0, inf)):
+    # classical Pareto minus 1 (numpy docs call this out explicitly)
+    k = _mxrand._next_key()
+    return _np(jax.random.pareto(k, a, shape=_size(size)) - 1.0)
+
+
+def power(a, size=None):
+    k = _mxrand._next_key()
+    return _np(jax.random.uniform(k, _size(size)) ** (1.0 / a))
+
+
+def rayleigh(scale=1.0, size=None):
+    k = _mxrand._next_key()
+    return _np(jax.random.rayleigh(k, shape=_size(size)) * scale)
+
+
+def weibull(a, size=None):
+    k = _mxrand._next_key()
+    return _np(jax.random.weibull_min(k, scale=1.0, concentration=a,
+                                      shape=_size(size)))

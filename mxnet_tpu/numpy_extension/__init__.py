@@ -1,0 +1,256 @@
+"""mx.npx — numpy-extension namespace (reference:
+python/mxnet/numpy_extension/ + ndarray/numpy_extension/).
+
+Carries the operators numpy itself doesn't have (the nn set) plus the
+np-mode switches. Everything delegates to the existing TPU kernels in
+`ops/` — np-ness of the output follows the input through `_apply`, so
+these wrappers add no second dispatch path.
+
+np-mode semantics here: this rebuild's NDArray is numpy-shaped from birth
+(0-d and 0-size arrays always work — jax.Array underneath), so
+`np_shape`/`np_array` scopes don't change behaviour; `set_np` flips the
+flag that `is_np_array()` reports (Gluon users branch on it, and
+Parameter/DataLoader outputs convert with `.as_np_ndarray()`).
+"""
+from __future__ import annotations
+
+from contextlib import contextmanager
+
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray, _apply
+from ..ops import nn_ops as _nn
+from ..ops import tensor_ops as _t
+
+__all__ = ["set_np", "reset_np", "is_np_array", "is_np_shape", "np_shape",
+           "np_array", "use_np", "softmax", "log_softmax", "masked_softmax",
+           "relu", "sigmoid", "gelu", "one_hot", "pick", "topk", "batch_dot",
+           "reshape_like", "batch_flatten", "fully_connected", "convolution",
+           "pooling", "batch_norm", "layer_norm", "dropout", "embedding",
+           "activation", "leaky_relu", "arange_like", "gamma", "sequence_mask",
+           "waitall", "save", "load", "seed"]
+
+class _Flags:
+    """Process-global np-mode state (reference parity: one C++ global;
+    worker threads must see the main thread's set_np)."""
+    np_array = False
+    np_shape = False
+
+
+_state = _Flags()
+
+
+def _flags():
+    return _state
+
+
+def set_np(shape=True, array=True):
+    f = _flags()
+    f.np_shape, f.np_array = bool(shape), bool(array)
+
+
+def reset_np():
+    set_np(False, False)
+
+
+def is_np_array():
+    return _flags().np_array
+
+
+def is_np_shape():
+    return _flags().np_shape
+
+
+@contextmanager
+def np_shape(active=True):
+    f = _flags()
+    prev = f.np_shape
+    f.np_shape = bool(active)
+    try:
+        yield
+    finally:
+        f.np_shape = prev
+
+
+@contextmanager
+def np_array(active=True):
+    f = _flags()
+    prev = f.np_array
+    f.np_array = bool(active)
+    try:
+        yield
+    finally:
+        f.np_array = prev
+
+
+def use_np(func):
+    """Decorator: run `func` with np semantics active (reference:
+    npx.use_np; works on functions and Gluon forward methods)."""
+    import functools
+
+    @functools.wraps(func)
+    def wrapper(*args, **kwargs):
+        with np_shape(True), np_array(True):
+            return func(*args, **kwargs)
+    return wrapper
+
+
+# ------------------------------------------------------------------- nn ops
+def _npc(x):
+    from ..numpy import _c
+    return _c(x)
+
+
+softmax = _nn.softmax_nd
+log_softmax = _nn.log_softmax_nd
+relu = _nn.relu
+sigmoid = _nn.sigmoid
+pick = _t.pick
+one_hot = _t.one_hot
+topk = _t.topk
+reshape_like = _t.reshape_like
+
+
+def gelu(data, approximation="erf"):
+    return _apply(lambda x: jax.nn.gelu(x, approximate=(
+        approximation == "tanh")), [_npc(data)])
+
+
+def masked_softmax(data, mask=None, axis=-1, temperature=1.0):
+    if mask is None:
+        return softmax(data, axis=axis, temperature=temperature)
+    return _apply(
+        lambda x, m: jax.nn.softmax(
+            jnp.where(m.astype(bool), x / temperature, -1e30), axis=axis),
+        [_npc(data), _npc(mask)])
+
+
+def batch_dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    def fn(a, b):
+        if transpose_a:
+            a = jnp.swapaxes(a, -1, -2)
+        if transpose_b:
+            b = jnp.swapaxes(b, -1, -2)
+        return jnp.matmul(a, b)
+    return _apply(fn, [_npc(lhs), _npc(rhs)])
+
+
+def batch_flatten(data):
+    return _apply(lambda x: x.reshape(x.shape[0], -1), [_npc(data)])
+
+
+def fully_connected(x, weight, bias=None, num_hidden=None, no_bias=False,
+                    flatten=True):
+    # num_hidden is declarative in the reference symbol API; the weight
+    # shape already carries it here
+    if no_bias or bias is None:
+        return _apply(lambda a, w: _nn.fully_connected(
+            a, w, None, flatten=flatten), [_npc(x), _npc(weight)])
+    return _apply(lambda a, w, b: _nn.fully_connected(
+        a, w, b, flatten=flatten), [_npc(x), _npc(weight), _npc(bias)])
+
+
+def convolution(data, weight, bias=None, **kwargs):
+    kwargs.pop("num_filter", None)  # declarative in the reference API
+    kwargs.pop("kernel", None)
+    if bias is None:
+        return _apply(lambda a, w: _nn.convolution(a, w, None, **kwargs),
+                      [_npc(data), _npc(weight)])
+    return _apply(lambda a, w, b: _nn.convolution(a, w, b, **kwargs),
+                  [_npc(data), _npc(weight), _npc(bias)])
+
+
+def pooling(data, kernel, **kwargs):
+    return _apply(lambda a: _nn.pooling(a, kernel, **kwargs), [_npc(data)])
+
+
+def batch_norm(data, gamma, beta, running_mean, running_var, eps=1e-5,
+               momentum=0.9, training=False, axis=1):
+    """Returns y; running stats are updated in place when training (the
+    reference mutates its aux inputs the same way)."""
+    rm, rv = _npc(running_mean), _npc(running_var)
+    y, new_m, new_v = _apply(
+        lambda a, g, b, m, v: _nn.batch_norm(
+            a, g, b, m, v, eps=eps, momentum=momentum, training=training,
+            axis=axis),
+        [_npc(data), _npc(gamma), _npc(beta), rm, rv], n_out=3)
+    if training:
+        running_mean._assign_value(new_m._data)
+        running_var._assign_value(new_v._data)
+    return y
+
+
+def layer_norm(data, gamma, beta, axis=-1, eps=1e-5):
+    return _apply(lambda a, g, b: _nn.layer_norm(a, g, b, axis=axis,
+                                                 eps=eps),
+                  [_npc(data), _npc(gamma), _npc(beta)])
+
+
+def dropout(data, p=0.5, training=True, **kwargs):
+    from .. import random as _r
+    key = _r._next_key()
+    return _apply(lambda a: _nn.dropout(a, key, p=p, training=training),
+                  [_npc(data)])
+
+
+def embedding(data, weight, input_dim=None, output_dim=None, **kwargs):
+    return _apply(lambda i, w: _nn.embedding(i, w),
+                  [_npc(data), _npc(weight)])
+
+
+def activation(data, act_type="relu"):
+    return _apply(lambda a: _nn.activation(a, act_type=act_type),
+                  [_npc(data)])
+
+
+def leaky_relu(data, act_type="leaky", slope=0.25, **kwargs):
+    return _apply(lambda a: _nn.leaky_relu(a, act_type=act_type,
+                                           slope=slope, **kwargs),
+                  [_npc(data)])
+
+
+def arange_like(data, start=0.0, step=1.0, axis=None):
+    from ..ndarray.contrib import arange_like as _al
+    return _al(_npc(data), start=start, step=step, axis=axis)
+
+
+def gamma(data):
+    return _t.gamma(_npc(data))
+
+
+def sequence_mask(data, sequence_length=None, use_sequence_length=False,
+                  value=0.0, axis=0):
+    from ..ops.seq_ops import SequenceMask as _sm
+    if sequence_length is None:
+        return _sm(_npc(data), use_sequence_length=False, value=value,
+                   axis=axis)
+    return _sm(_npc(data), _npc(sequence_length),
+               use_sequence_length=use_sequence_length, value=value,
+               axis=axis)
+
+
+# ------------------------------------------------------------------- utils
+def waitall():
+    from ..ndarray.ndarray import waitall as _w
+    _w()
+
+
+def seed(seed_state):
+    from .. import random as _r
+    _r.seed(seed_state)
+
+
+def save(file, arr):
+    """Save np arrays (dict or list) — npz container like nd.save."""
+    from ..ndarray.utils import save as _save
+    _save(file, arr)
+
+
+def load(file):
+    from ..ndarray.utils import load as _load
+    out = _load(file)
+    if isinstance(out, dict):
+        return {k: v.as_np_ndarray() for k, v in out.items()}
+    return [v.as_np_ndarray() for v in out]

@@ -1,0 +1,465 @@
+"""Classic reference op-name compatibility surface (reference:
+src/operator/tensor/elemwise_binary_op_basic.cc, regression_output-inl.h,
+optimizer_op.cc, nn/im2col.cc, and the python/mxnet/ndarray op namespace).
+
+Three groups, all TPU-first:
+- aliases and small math ops the reference exposes under its own names
+  (elemwise_*, broadcast_axes, softsign, argmax_channel, ...): thin
+  `_apply` dispatches over jnp — they fuse into surrounding programs.
+- loss heads (LinearRegressionOutput et al.): reuse the SAME custom_vjp
+  kernels the symbol executor registers, so imperative and symbolic
+  training have one set of gradient semantics.
+- single-tensor optimizer update ops (sgd_update, adam_update, ...):
+  the reference's imperative update primitives for hand-rolled training
+  loops. State inputs (mom/mean/var/...) are updated IN PLACE (SSA
+  rebind), matching the reference's mutate-inputs contract; the new
+  weight is returned (and written to `out` when given).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as _np
+
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray, _apply
+
+__all__ = [
+    "broadcast_axes", "broadcast_hypot", "elemwise_add", "elemwise_sub",
+    "elemwise_mul", "elemwise_div", "identity", "SwapAxis", "crop",
+    "softsign", "argmax_channel", "degrees", "radians", "logical_and",
+    "logical_or", "logical_xor", "isnan", "isinf", "isfinite", "logaddexp",
+    "cumprod", "trace", "tril", "triu", "lcm", "gcd", "histogram",
+    "bincount", "SoftmaxActivation",
+    "LinearRegressionOutput", "MAERegressionOutput",
+    "LogisticRegressionOutput", "SVMOutput",
+    "im2col", "col2im", "RNN",
+    "multi_sum_sq", "sgd_update", "sgd_mom_update", "mp_sgd_update",
+    "mp_sgd_mom_update", "nag_mom_update", "adam_update", "signsgd_update",
+    "signum_update", "rmsprop_update", "rmspropalex_update", "ftrl_update",
+    "lamb_update_phase1", "lamb_update_phase2",
+]
+
+
+# ------------------------------------------------------- aliases, small math
+def _unary(jfn):
+    def f(data, **kw):
+        return _apply(lambda x: jfn(x), [data])
+    return f
+
+
+def _binary(jfn):
+    def f(lhs, rhs, **kw):
+        return _apply(jfn, [lhs, rhs])
+    return f
+
+
+elemwise_add = _binary(jnp.add)
+elemwise_sub = _binary(jnp.subtract)
+elemwise_mul = _binary(jnp.multiply)
+elemwise_div = _binary(jnp.divide)
+broadcast_hypot = _binary(jnp.hypot)
+logical_and = _binary(jnp.logical_and)
+logical_or = _binary(jnp.logical_or)
+logical_xor = _binary(jnp.logical_xor)
+lcm = _binary(jnp.lcm)
+gcd = _binary(jnp.gcd)
+logaddexp = _binary(jnp.logaddexp)
+degrees = _unary(jnp.degrees)
+radians = _unary(jnp.radians)
+isnan = _unary(jnp.isnan)
+isinf = _unary(jnp.isinf)
+isfinite = _unary(jnp.isfinite)
+
+
+def identity(data, **kw):
+    return _apply(lambda x: x, [data])
+
+
+def softsign(data, **kw):
+    return _apply(lambda x: x / (1 + jnp.abs(x)), [data])
+
+
+def argmax_channel(data, **kw):
+    """Per-sample argmax over the channel axis (axis 1; the classic
+    softmax-prediction helper — (N, C) logits -> (N,) classes)."""
+    return _apply(lambda x: jnp.argmax(x, axis=1).astype(jnp.float32),
+                  [data])
+
+
+def broadcast_axes(data, axis=0, size=1, **kw):
+    from .tensor_ops import broadcast_axis
+    return broadcast_axis(data, axis, size)
+
+
+def SwapAxis(data, dim1=0, dim2=0, **kw):
+    from .tensor_ops import swapaxes
+    return swapaxes(data, dim1, dim2)
+
+
+def crop(data, begin, end, step=None, **kw):
+    """Deprecated reference alias of `slice` (NOT the symbol Crop op)."""
+    from .tensor_ops import slice as _slice
+    return _slice(data, begin, end, step)
+
+
+def cumprod(data, axis=None, **kw):
+    return _apply(lambda x: jnp.cumprod(x, axis=axis), [data])
+
+
+def trace(data, offset=0, axis1=0, axis2=1, **kw):
+    return _apply(lambda x: jnp.trace(x, offset=offset, axis1=axis1,
+                                      axis2=axis2), [data])
+
+
+def tril(data, k=0, **kw):
+    return _apply(lambda x: jnp.tril(x, k=k), [data])
+
+
+def triu(data, k=0, **kw):
+    return _apply(lambda x: jnp.triu(x, k=k), [data])
+
+
+def histogram(data, bins=10, range=None, **kw):
+    """(counts, bin_edges) like numpy; bin count is static so the whole op
+    is one fused jit-able program."""
+    if isinstance(bins, NDArray):
+        return _apply(lambda x, b: tuple(jnp.histogram(x, bins=b)),
+                      [data, bins], n_out=2)
+    return _apply(lambda x: tuple(jnp.histogram(x, bins=bins, range=range)),
+                  [data], n_out=2)
+
+
+def bincount(data, weights=None, minlength=0, **kw):
+    """Eager-only when minlength doesn't cover the data (output length is
+    data-dependent — SURVEY §8 pattern)."""
+    length = int(max(int(minlength),
+                     int(jnp.max(data._data)) + 1 if data.size else 1))
+    if weights is None:
+        return _apply(lambda x: jnp.bincount(x.astype(jnp.int32),
+                                             length=length), [data])
+    return _apply(lambda x, w: jnp.bincount(x.astype(jnp.int32), weights=w,
+                                            length=length),
+                  [data, weights])
+
+
+def SoftmaxActivation(data, mode="instance", **kw):
+    """Deprecated reference op: softmax over features ('instance') or over
+    the channel axis at each position ('channel')."""
+    axis = -1 if mode == "instance" else 1
+    return _apply(lambda x: jax.nn.softmax(x, axis=axis), [data])
+
+
+# ------------------------------------------------------------- loss heads
+def _head(op_name):
+    def f(data, label=None, grad_scale=1.0, **kw):
+        # resolved lazily: the kernels register when symbol/ops.py loads,
+        # which is after this module during package init
+        from .. import symbol  # noqa: F401  (ensures registration ran)
+        from ..symbol.symbol import _OP_REGISTRY
+        kernel = _OP_REGISTRY[op_name]
+        if label is None:
+            return _apply(lambda x: kernel(x), [data])
+        return _apply(lambda x, l: kernel(x, l, grad_scale=grad_scale),
+                      [data, label])
+    f.__name__ = op_name
+    return f
+
+
+LinearRegressionOutput = _head("LinearRegressionOutput")
+MAERegressionOutput = _head("MAERegressionOutput")
+LogisticRegressionOutput = _head("LogisticRegressionOutput")
+
+
+def SVMOutput(data, label=None, margin=1.0, regularization_coefficient=1.0,
+              use_linear=False, **kw):
+    """Reference SVMOutput (src/operator/svm_output.cc): forward is the
+    identity; backward is the (squared) hinge-loss gradient at the true
+    class margin."""
+    if label is None:
+        return _apply(lambda x: x, [data])
+
+    import functools
+
+    @functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
+    def op(x, y, margin, reg, linear):
+        return x
+
+    def fwd(x, y, margin, reg, linear):
+        return x, (x, y)
+
+    def bwd(margin, reg, linear, res, g):
+        x, y = res
+        iy = y.astype(jnp.int32)
+        oh = jax.nn.one_hot(iy, x.shape[-1], dtype=x.dtype)
+        score_y = jnp.take_along_axis(x, iy[:, None], -1)
+        viol = (margin - (2 * oh - 1) * x) > 0   # margin violated per class
+        if linear:
+            gx = jnp.where(viol, -(2 * oh - 1) * reg, 0.0)
+        else:
+            gx = jnp.where(viol, -2 * (margin - (2 * oh - 1) * x)
+                           * (2 * oh - 1) * reg, 0.0)
+        del score_y
+        return (gx.astype(x.dtype), jnp.zeros(y.shape, y.dtype))
+
+    op.defvjp(fwd, bwd)
+    return _apply(lambda x, y: op(x, y, float(margin),
+                                  float(regularization_coefficient),
+                                  bool(use_linear)), [data, label])
+
+
+# ---------------------------------------------------------------- im2col
+def _im2col_fn(x, kernel, stride, dilate, pad):
+    patches = jax.lax.conv_general_dilated_patches(
+        x, filter_shape=kernel, window_strides=stride,
+        padding=[(p, p) for p in pad], rhs_dilation=dilate,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    n = x.shape[0]
+    return patches.reshape(n, patches.shape[1], -1)
+
+
+def _norm2(v, n):
+    if isinstance(v, int):
+        return (v,) * n
+    return tuple(v)
+
+
+def im2col(data, kernel, stride=1, dilate=1, pad=0, **kw):
+    """Sliding-window unfold, NCHW -> (N, C*prod(kernel), L) (reference:
+    src/operator/nn/im2col.cc). One XLA patches op, no per-window loops."""
+    nd_spatial = data.ndim - 2
+    kernel = _norm2(kernel, nd_spatial)
+    stride, dilate, pad = (_norm2(stride, nd_spatial),
+                           _norm2(dilate, nd_spatial),
+                           _norm2(pad, nd_spatial))
+    return _apply(lambda x: _im2col_fn(x, kernel, stride, dilate, pad),
+                  [data])
+
+
+def col2im(data, output_size, kernel, stride=1, dilate=1, pad=0, **kw):
+    """Fold columns back, summing overlaps — implemented as the exact
+    adjoint (jax.vjp) of im2col, which is its mathematical definition."""
+    nd_spatial = len(tuple(output_size)) if not isinstance(output_size, int) \
+        else 1
+    out_sp = _norm2(output_size, nd_spatial)
+    kernel = _norm2(kernel, len(out_sp))
+    stride, dilate, pad = (_norm2(stride, len(out_sp)),
+                           _norm2(dilate, len(out_sp)),
+                           _norm2(pad, len(out_sp)))
+
+    def fn(cols):
+        n = cols.shape[0]
+        c = cols.shape[1] // int(_np.prod(kernel))
+        ref = jnp.zeros((n, c) + out_sp, cols.dtype)
+        _, vjp = jax.vjp(
+            lambda img: _im2col_fn(img, kernel, stride, dilate, pad), ref)
+        return vjp(cols)[0]
+    return _apply(fn, [data])
+
+
+# ------------------------------------------------------------------ nd.RNN
+def RNN(data, *state_and_params, state_outputs=False, mode="lstm", **kwargs):
+    """Imperative fused RNN — the same kernel the sym.RNN node compiles
+    (symbol/ops.py _rnn_eval), dispatched eagerly. The kernel always
+    produces (out, h[, c]); `state_outputs` picks what the caller sees."""
+    from ..symbol.ops import _rnn_eval
+    ns = 2 if mode == "lstm" else 1
+    res = _apply(lambda *a: _rnn_eval(*a, state_outputs=state_outputs,
+                                      mode=mode, **kwargs),
+                 [data] + list(state_and_params), n_out=1 + ns)
+    return res if state_outputs else res[0]
+
+
+# ------------------------------------------- optimizer update primitives
+def _prep_grad(g, w, rescale_grad, clip_gradient, wd):
+    g = g * rescale_grad
+    if clip_gradient is not None and clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    return g + wd * w
+
+
+def _emit(weight, new_w, out):
+    target = out if out is not None else weight
+    target._assign_value(new_w.astype(target.dtype))
+    return target
+
+
+def multi_sum_sq(*arrays, num_arrays=None, **kw):
+    """Per-tensor sum of squares in one fused program (reference:
+    multi_sum_sq.cc; feeds LARS-style global norms)."""
+    arrs = list(arrays[:num_arrays] if num_arrays else arrays)
+    return _apply(lambda *xs: jnp.stack(
+        [jnp.sum(x.astype(jnp.float32) * x) for x in xs]), arrs)
+
+
+def sgd_update(weight, grad, lr, wd=0.0, rescale_grad=1.0,
+               clip_gradient=-1.0, out=None, **kw):
+    new_w = _apply(lambda w, g: w - lr * _prep_grad(
+        g, w, rescale_grad, clip_gradient, wd), [weight, grad])
+    return _emit(weight, new_w._data, out)
+
+
+def sgd_mom_update(weight, grad, mom, lr, momentum=0.0, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=-1.0, out=None, **kw):
+    def fn(w, g, m):
+        new_m = momentum * m - lr * _prep_grad(g, w, rescale_grad,
+                                               clip_gradient, wd)
+        return new_m, w + new_m
+    new_m, new_w = _apply(fn, [weight, grad, mom], n_out=2)
+    mom._assign_value(new_m._data)
+    return _emit(weight, new_w._data, out)
+
+
+def mp_sgd_update(weight, grad, weight32, lr, wd=0.0, rescale_grad=1.0,
+                  clip_gradient=-1.0, out=None, **kw):
+    """Multi-precision: master fp32 weight carries the update, the low-
+    precision weight is its cast (reference mp_sgd_update)."""
+    new_w32 = _apply(lambda w32, g: w32 - lr * _prep_grad(
+        g.astype(jnp.float32), w32, rescale_grad, clip_gradient, wd),
+        [weight32, grad])
+    weight32._assign_value(new_w32._data)
+    return _emit(weight, new_w32._data, out)
+
+
+def mp_sgd_mom_update(weight, grad, mom, weight32, lr, momentum=0.0,
+                      wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                      out=None, **kw):
+    def fn(w32, g, m):
+        new_m = momentum * m - lr * _prep_grad(
+            g.astype(jnp.float32), w32, rescale_grad, clip_gradient, wd)
+        return new_m, w32 + new_m
+    new_m, new_w32 = _apply(fn, [weight32, grad, mom], n_out=2)
+    mom._assign_value(new_m._data)
+    weight32._assign_value(new_w32._data)
+    return _emit(weight, new_w32._data, out)
+
+
+def nag_mom_update(weight, grad, mom, lr, momentum=0.0, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=-1.0, out=None, **kw):
+    def fn(w, g, m):
+        gr = _prep_grad(g, w, rescale_grad, clip_gradient, wd)
+        new_m = momentum * m + gr
+        return new_m, w - lr * (gr + momentum * new_m)
+    new_m, new_w = _apply(fn, [weight, grad, mom], n_out=2)
+    mom._assign_value(new_m._data)
+    return _emit(weight, new_w._data, out)
+
+
+def adam_update(weight, grad, mean, var, lr, beta1=0.9, beta2=0.999,
+                epsilon=1e-8, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                out=None, **kw):
+    def fn(w, g, m, v):
+        gr = _prep_grad(g, w, rescale_grad, clip_gradient, wd)
+        new_m = beta1 * m + (1 - beta1) * gr
+        new_v = beta2 * v + (1 - beta2) * gr * gr
+        return new_m, new_v, w - lr * new_m / (jnp.sqrt(new_v) + epsilon)
+    new_m, new_v, new_w = _apply(fn, [weight, grad, mean, var], n_out=3)
+    mean._assign_value(new_m._data)
+    var._assign_value(new_v._data)
+    return _emit(weight, new_w._data, out)
+
+
+def signsgd_update(weight, grad, lr, wd=0.0, rescale_grad=1.0,
+                   clip_gradient=-1.0, out=None, **kw):
+    new_w = _apply(lambda w, g: w - lr * jnp.sign(_prep_grad(
+        g, w, rescale_grad, clip_gradient, wd)), [weight, grad])
+    return _emit(weight, new_w._data, out)
+
+
+def signum_update(weight, grad, mom, lr, momentum=0.0, wd=0.0,
+                  rescale_grad=1.0, clip_gradient=-1.0, wd_lh=0.0,
+                  out=None, **kw):
+    def fn(w, g, m):
+        gr = _prep_grad(g, w, rescale_grad, clip_gradient, wd)
+        new_m = momentum * m - (1 - momentum) * gr
+        return new_m, (1 - lr * wd_lh) * w + lr * jnp.sign(new_m)
+    new_m, new_w = _apply(fn, [weight, grad, mom], n_out=2)
+    mom._assign_value(new_m._data)
+    return _emit(weight, new_w._data, out)
+
+
+def rmsprop_update(weight, grad, n, lr, gamma1=0.95, epsilon=1e-8, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=-1.0, out=None, **kw):
+    def fn(w, g, nn_):
+        gr = _prep_grad(g, w, rescale_grad, clip_gradient, wd)
+        new_n = gamma1 * nn_ + (1 - gamma1) * gr * gr
+        return new_n, w - lr * gr / jnp.sqrt(new_n + epsilon)
+    new_n, new_w = _apply(fn, [weight, grad, n], n_out=2)
+    n._assign_value(new_n._data)
+    return _emit(weight, new_w._data, out)
+
+
+def rmspropalex_update(weight, grad, n, g, delta, lr, gamma1=0.95,
+                       gamma2=0.9, epsilon=1e-8, wd=0.0, rescale_grad=1.0,
+                       clip_gradient=-1.0, out=None, **kw):
+    """RMSProp with the Alex Graves centering + momentum variant."""
+    def fn(w, gr_, n_, gavg, d):
+        gr = _prep_grad(gr_, w, rescale_grad, clip_gradient, wd)
+        new_n = gamma1 * n_ + (1 - gamma1) * gr * gr
+        new_g = gamma1 * gavg + (1 - gamma1) * gr
+        new_d = gamma2 * d - lr * gr / jnp.sqrt(
+            new_n - new_g * new_g + epsilon)
+        return new_n, new_g, new_d, w + new_d
+    new_n, new_g, new_d, new_w = _apply(fn, [weight, grad, n, g, delta],
+                                        n_out=4)
+    n._assign_value(new_n._data)
+    g._assign_value(new_g._data)
+    delta._assign_value(new_d._data)
+    return _emit(weight, new_w._data, out)
+
+
+def ftrl_update(weight, grad, z, n, lr, lamda1=0.01, beta=1.0, wd=0.0,
+                rescale_grad=1.0, clip_gradient=-1.0, out=None, **kw):
+    def fn(w, g, z_, n_):
+        gr = g * rescale_grad
+        if clip_gradient is not None and clip_gradient > 0:
+            gr = jnp.clip(gr, -clip_gradient, clip_gradient)
+        new_n = n_ + gr * gr
+        sigma = (jnp.sqrt(new_n) - jnp.sqrt(n_)) / lr
+        new_z = z_ + gr - sigma * w
+        new_w = jnp.where(
+            jnp.abs(new_z) > lamda1,
+            -(new_z - jnp.sign(new_z) * lamda1)
+            / ((beta + jnp.sqrt(new_n)) / lr + wd), 0.0)
+        return new_z, new_n, new_w.astype(w.dtype)
+    new_z, new_n, new_w = _apply(fn, [weight, grad, z, n], n_out=3)
+    z._assign_value(new_z._data)
+    n._assign_value(new_n._data)
+    return _emit(weight, new_w._data, out)
+
+
+def lamb_update_phase1(weight, grad, mean, var, t, beta1=0.9, beta2=0.999,
+                       epsilon=1e-6, wd=0.0, rescale_grad=1.0,
+                       clip_gradient=-1.0, bias_correction=True, **kw):
+    """LAMB step direction (reference lamb_update_phase1): returns g' =
+    m_hat/(sqrt(v_hat)+eps) + wd*w; phase2 applies the trust ratio."""
+    def fn(w, g, m, v):
+        gr = g * rescale_grad
+        if clip_gradient is not None and clip_gradient > 0:
+            gr = jnp.clip(gr, -clip_gradient, clip_gradient)
+        new_m = beta1 * m + (1 - beta1) * gr
+        new_v = beta2 * v + (1 - beta2) * gr * gr
+        if bias_correction:
+            mh = new_m / (1 - beta1 ** t)
+            vh = new_v / (1 - beta2 ** t)
+        else:
+            mh, vh = new_m, new_v
+        return new_m, new_v, mh / (jnp.sqrt(vh) + epsilon) + wd * w
+    new_m, new_v, gprime = _apply(fn, [weight, grad, mean, var], n_out=3)
+    mean._assign_value(new_m._data)
+    var._assign_value(new_v._data)
+    return gprime
+
+
+def lamb_update_phase2(weight, g_prime, r1, r2, lr, lower_bound=-1.0,
+                       upper_bound=-1.0, out=None, **kw):
+    def fn(w, gp, r1_, r2_):
+        r1c = r1_
+        if lower_bound > 0:
+            r1c = jnp.maximum(r1c, lower_bound)
+        if upper_bound > 0:
+            r1c = jnp.minimum(r1c, upper_bound)
+        ratio = jnp.where(jnp.logical_and(r1c > 0, r2_ > 0), r1c / r2_, 1.0)
+        return w - lr * ratio * gp
+    new_w = _apply(fn, [weight, g_prime, r1, r2])
+    return _emit(weight, new_w._data, out)

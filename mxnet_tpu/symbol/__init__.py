@@ -1,5 +1,7 @@
 """mx.sym / mx.symbol (reference: python/mxnet/symbol)."""
 from .symbol import (Symbol, Variable, var, Group, load, load_json, Executor)
+
+fromjson = load_json   # reference alias (mx.sym.fromjson)
 from .ops import *   # noqa: F401,F403
 from . import ops
 from . import contrib

@@ -22,6 +22,7 @@ __all__ = ["FullyConnected", "Convolution", "StemConvS2D", "Activation",
            "log_softmax", "SoftmaxOutput", "LinearRegressionOutput",
            "MAERegressionOutput", "LogisticRegressionOutput",
            "flatten", "Flatten", "reshape", "Custom", "RNN",
+           "slice", "slice_axis",
            "SequenceMask", "SequenceLast", "SequenceReverse",
            "smooth_l1", "softmin", "hard_sigmoid",
            "cast", "Cast", "take",
@@ -529,6 +530,35 @@ def max(data, axis=None, keepdims=False, name=None):
 
 def min(data, axis=None, keepdims=False, name=None):
     return _make("min", [data], {"axis": axis, "keepdims": keepdims}, name=name)
+
+
+def _slice_kernel(a, begin=(), end=(), step=None):
+    import builtins
+    step = step or [None] * len(begin)
+    # builtins.slice: the symbolic `slice` op shadows the name below
+    idx = tuple(builtins.slice(b, e, s)
+                for b, e, s in zip(begin, end, step))
+    return a[idx]
+
+
+register_op("slice", _slice_kernel)
+register_op("slice_axis",
+            lambda a, axis=0, begin=0, end=None:
+            jax.lax.slice_in_dim(a, begin, a.shape[axis] if end is None
+                                 else (end if end >= 0
+                                       else a.shape[axis] + end),
+                                 axis=axis))
+
+
+def slice(data, begin, end, step=None, name=None):  # noqa: A001
+    return _make("slice", [data],
+                 {"begin": tuple(begin), "end": tuple(end),
+                  "step": tuple(step) if step else None}, name=name)
+
+
+def slice_axis(data, axis, begin, end, name=None):
+    return _make("slice_axis", [data],
+                 {"axis": axis, "begin": begin, "end": end}, name=name)
 
 
 def expand_dims(data, axis, name=None):

@@ -1,7 +1,13 @@
-"""Misc utilities (reference: python/mxnet/util.py)."""
+"""Misc utilities (reference: python/mxnet/util.py).
+
+The np-mode switches delegate to mx.npx — one process-global flag
+(reference parity: the C++ side keeps one global, not per-thread state),
+whether flipped via mx.util or mx.npx.
+"""
 from __future__ import annotations
 
-__all__ = ["waitall", "is_np_array", "set_np", "use_np"]
+__all__ = ["waitall", "is_np_array", "is_np_shape", "set_np", "reset_np",
+           "use_np"]
 
 
 def waitall():
@@ -10,14 +16,25 @@ def waitall():
 
 
 def is_np_array():
-    return False
+    from . import numpy_extension as npx
+    return npx.is_np_array()
+
+
+def is_np_shape():
+    from . import numpy_extension as npx
+    return npx.is_np_shape()
 
 
 def set_np(shape=True, array=True):
-    raise NotImplementedError(
-        "numpy-semantics mode is not needed: mxnet_tpu NDArray already "
-        "follows numpy broadcasting via jax.numpy")
+    from . import numpy_extension as npx
+    npx.set_np(shape=shape, array=array)
+
+
+def reset_np():
+    from . import numpy_extension as npx
+    npx.reset_np()
 
 
 def use_np(func):
-    return func
+    from . import numpy_extension as npx
+    return npx.use_np(func)

@@ -1,0 +1,303 @@
+"""mx.np / mx.npx numpy front end (SURVEY.md §2 row 58; reference:
+python/mxnet/numpy/ + numpy_extension/). The design under test: np-ness
+propagates through the single `_apply` dispatch point, so one rule covers
+ops, Gluon blocks and autograd."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+np = mx.np
+npx = mx.npx
+
+
+# ----------------------------------------------------------------- creation
+def test_creation_and_repr():
+    a = np.array([[1.0, 2.0], [3.0, 4.0]])
+    assert isinstance(a, np.ndarray) and isinstance(a, nd.NDArray)
+    assert "array(" in repr(a)
+    assert np.zeros((2, 3)).shape == (2, 3)
+    assert np.ones(4, dtype="int32").dtype == onp.int32
+    assert np.arange(5).asnumpy().tolist() == [0, 1, 2, 3, 4]
+    onp.testing.assert_allclose(np.linspace(0, 1, 5).asnumpy(),
+                                onp.linspace(0, 1, 5), rtol=1e-6)
+    assert np.eye(3).asnumpy()[1, 1] == 1.0
+    assert np.full((2,), 7.0).asnumpy().tolist() == [7.0, 7.0]
+
+
+def test_zero_dim_and_scalars():
+    s = np.array(3.5)
+    assert s.shape == () and s.ndim == 0
+    assert s.item() == pytest.approx(3.5)
+    total = np.sum(np.ones((3, 3)))
+    assert total.shape == ()          # numpy semantics: 0-d, not (1,)
+    assert float(total) == 9.0
+
+
+def test_type_propagation_through_nd_ops():
+    """Any op touching an np input returns np — including classic nd ops."""
+    a = np.ones((2, 3))
+    b = nd.ones((2, 3))
+    assert isinstance(a + b, np.ndarray)
+    assert isinstance(b + a, np.ndarray)       # nd op, np operand
+    assert isinstance(nd.concat(b, b, dim=0), nd.NDArray)
+    assert not isinstance(nd.concat(b, b, dim=0), np.ndarray)
+    assert isinstance(a.as_nd_ndarray(), nd.NDArray)
+    assert not isinstance(a.as_nd_ndarray(), np.ndarray)
+    assert isinstance(b.as_np_ndarray(), np.ndarray)
+
+
+# ---------------------------------------------------------------- arithmetic
+def test_arithmetic_matches_numpy():
+    x = onp.random.RandomState(0).randn(3, 4).astype(onp.float32)
+    y = onp.random.RandomState(1).randn(4).astype(onp.float32)
+    a, b = np.array(x), np.array(y)
+    onp.testing.assert_allclose((a + b).asnumpy(), x + y, rtol=1e-6)
+    onp.testing.assert_allclose((a * 2 - b / 3).asnumpy(), x * 2 - y / 3,
+                                rtol=1e-5)
+    onp.testing.assert_allclose((a @ b).asnumpy(), x @ y, rtol=1e-5)
+    onp.testing.assert_allclose(np.maximum(a, 0).asnumpy(),
+                                onp.maximum(x, 0))
+    onp.testing.assert_allclose(np.exp(a).asnumpy(), onp.exp(x), rtol=1e-5)
+    onp.testing.assert_allclose(np.hypot(a, a).asnumpy(), onp.hypot(x, x),
+                                rtol=1e-6)
+    assert (np.equal(a, a).asnumpy()).all()
+    assert np.logical_not(np.zeros(3)).asnumpy().all()
+
+
+def test_reductions_match_numpy():
+    x = onp.random.RandomState(2).rand(4, 5).astype(onp.float32)
+    a = np.array(x)
+    onp.testing.assert_allclose(np.mean(a, axis=0).asnumpy(), x.mean(0),
+                                rtol=1e-5)
+    onp.testing.assert_allclose(np.var(a, ddof=1).item(), x.var(ddof=1),
+                                rtol=1e-4)
+    onp.testing.assert_allclose(np.cumsum(a, axis=1).asnumpy(),
+                                x.cumsum(1), rtol=1e-5)
+    assert np.argmax(a).item() == x.argmax()
+    onp.testing.assert_allclose(np.median(a).item(), onp.median(x),
+                                rtol=1e-5)
+    assert a.std(axis=1).shape == (4,)
+
+
+# ------------------------------------------------------------------ indexing
+def test_boolean_and_fancy_indexing():
+    x = onp.arange(12, dtype=onp.float32).reshape(3, 4)
+    a = np.array(x)
+    mask = a > 5
+    onp.testing.assert_allclose(a[mask].asnumpy(), x[x > 5])
+    idx = np.array([2, 0], dtype="int32")
+    onp.testing.assert_allclose(a[idx].asnumpy(), x[[2, 0]])
+    onp.testing.assert_allclose(a[:, 1].asnumpy(), x[:, 1])
+    nz = np.nonzero(a > 8)
+    assert [i.asnumpy().tolist() for i in nz] == \
+        [list(r) for r in onp.nonzero(x > 8)]
+
+
+def test_where_take_sort_unique():
+    x = onp.array([3, 1, 2, 3, 1], dtype=onp.float32)
+    a = np.array(x)
+    onp.testing.assert_allclose(np.where(a > 2, a, 0).asnumpy(),
+                                onp.where(x > 2, x, 0))
+    onp.testing.assert_allclose(np.sort(a).asnumpy(), onp.sort(x))
+    onp.testing.assert_allclose(np.take(a, np.array([0, 4])).asnumpy(),
+                                x[[0, 4]])
+    u = np.unique(a)
+    onp.testing.assert_allclose(u.asnumpy(), [1, 2, 3])
+
+
+# ----------------------------------------------------------------- shape ops
+def test_shape_manipulation():
+    a = np.arange(24).reshape((2, 3, 4))
+    assert np.transpose(a).shape == (4, 3, 2)
+    assert np.moveaxis(a, 0, -1).shape == (3, 4, 2)
+    assert np.concatenate([a, a], axis=1).shape == (2, 6, 4)
+    assert np.stack([a, a]).shape == (2, 2, 3, 4)
+    parts = np.split(np.arange(9), 3)
+    assert len(parts) == 3 and parts[1].asnumpy().tolist() == [3, 4, 5]
+    assert np.expand_dims(a, 0).shape == (1, 2, 3, 4)
+    assert np.flip(np.arange(3)).asnumpy().tolist() == [2, 1, 0]
+    assert np.pad(np.ones((2, 2)), 1).shape == (4, 4)
+    g1, g2 = np.meshgrid(np.arange(2), np.arange(3))
+    assert g1.shape == (3, 2) and g2.shape == (3, 2)
+    assert np.atleast_2d(np.array(5.0)).shape == (1, 1)
+
+
+def test_einsum_tensordot_linalg():
+    x = onp.random.RandomState(3).rand(3, 3).astype(onp.float32)
+    a = np.array(x)
+    onp.testing.assert_allclose(np.einsum("ij,jk->ik", a, a).asnumpy(),
+                                x @ x, rtol=1e-5)
+    onp.testing.assert_allclose(np.trace(a).item(), onp.trace(x),
+                                rtol=1e-5)
+    spd = np.array(x @ x.T + 3 * onp.eye(3, dtype=onp.float32))
+    onp.testing.assert_allclose(
+        (np.linalg.cholesky(spd) @ np.linalg.cholesky(spd).T).asnumpy(),
+        spd.asnumpy(), rtol=1e-4, atol=1e-5)
+    inv = np.linalg.inv(spd)
+    onp.testing.assert_allclose((spd @ inv).asnumpy(), onp.eye(3),
+                                atol=1e-4)
+    u, s, vt = np.linalg.svd(a)
+    onp.testing.assert_allclose(
+        (u * s[None, :]).asnumpy() @ vt.asnumpy(), x, atol=1e-4)
+    w, v = np.linalg.eigh(spd)
+    assert w.shape == (3,) and isinstance(v, np.ndarray)
+    assert np.linalg.norm(a).shape == ()
+
+
+# ------------------------------------------------------------------- random
+def test_random_suite():
+    np.random.seed(7)
+    u = np.random.uniform(size=(100,))
+    assert isinstance(u, np.ndarray) and 0 <= float(u.min()) \
+        and float(u.max()) <= 1
+    n = np.random.normal(2.0, 0.1, size=(500,))
+    assert abs(float(n.mean()) - 2.0) < 0.05
+    r = np.random.randint(0, 5, size=(50,))
+    assert r.dtype == onp.int32 and int(r.max()) < 5
+    c = np.random.choice(5, size=(10,))
+    assert c.shape == (10,)
+    p = np.random.permutation(6)
+    assert sorted(p.asnumpy().tolist()) == [0, 1, 2, 3, 4, 5]
+    x = np.arange(8)
+    np.random.shuffle(x)
+    assert sorted(x.asnumpy().tolist()) == list(range(8))
+    # seeding is deterministic and shared with mx.random
+    np.random.seed(3)
+    a = np.random.uniform(size=(4,)).asnumpy()
+    mx.random.seed(3)
+    b = np.random.uniform(size=(4,)).asnumpy()
+    onp.testing.assert_allclose(a, b)
+
+
+# ----------------------------------------------------------------- autograd
+def test_autograd_through_np_ops():
+    a = np.array([1.0, 2.0, 3.0])
+    a.attach_grad()
+    with mx.autograd.record():
+        y = np.sum(np.square(a) * 2)
+    y.backward()
+    assert isinstance(a.grad, nd.NDArray)
+    onp.testing.assert_allclose(a.grad.asnumpy(), [4.0, 8.0, 12.0])
+
+
+def test_gluon_forward_returns_np():
+    """net(np_x) -> np output via _apply propagation; backward works."""
+    from mxnet_tpu.gluon import nn
+    net = nn.Dense(3, in_units=4)
+    net.initialize()
+    x = np.random.uniform(size=(2, 4))
+    x.attach_grad()
+    with mx.autograd.record():
+        out = net(x)
+        loss = np.sum(out * out)
+    assert isinstance(out, np.ndarray)
+    loss.backward()
+    assert net.weight.grad() is not None
+    assert x.grad.shape == (2, 4)
+
+
+# --------------------------------------------------------------------- npx
+def test_npx_mode_switches():
+    assert not npx.is_np_array()
+    npx.set_np()
+    assert npx.is_np_array() and npx.is_np_shape()
+    npx.reset_np()
+    assert not npx.is_np_array()
+    with npx.np_array(True):
+        assert npx.is_np_array()
+    assert not npx.is_np_array()
+
+    @npx.use_np
+    def f():
+        return npx.is_np_array()
+    assert f() and not npx.is_np_array()
+
+
+def test_npx_nn_ops():
+    x = np.array(onp.random.RandomState(5).randn(2, 6).astype(onp.float32))
+    s = npx.softmax(x)
+    onp.testing.assert_allclose(s.asnumpy().sum(1), onp.ones(2), rtol=1e-5)
+    assert isinstance(s, np.ndarray)
+    onp.testing.assert_allclose(
+        npx.log_softmax(x).asnumpy(), onp.log(s.asnumpy()), rtol=1e-4,
+        atol=1e-5)
+    assert float(npx.relu(np.array([-1.0, 2.0])).asnumpy()[0]) == 0.0
+    oh = npx.one_hot(np.array([0, 2], dtype="int32"), 3)
+    assert oh.asnumpy().tolist() == [[1, 0, 0], [0, 0, 1]]
+    w = np.random.normal(size=(5, 6))
+    fc = npx.fully_connected(x, w)
+    assert fc.shape == (2, 5) and isinstance(fc, np.ndarray)
+    bd = npx.batch_dot(np.ones((2, 3, 4)), np.ones((2, 4, 5)))
+    assert bd.shape == (2, 3, 5)
+    onp.testing.assert_allclose(
+        npx.masked_softmax(x, np.array([[1, 1, 1, 0, 0, 0]] * 2))
+        .asnumpy()[:, 3:], onp.zeros((2, 3)), atol=1e-6)
+    assert npx.batch_flatten(np.ones((2, 3, 4))).shape == (2, 12)
+    emb = npx.embedding(np.array([1, 0], dtype="int32"),
+                        np.arange(6).reshape((3, 2)))
+    assert emb.asnumpy().tolist() == [[2, 3], [0, 1]]
+
+
+def test_npx_batch_norm_updates_running_stats():
+    x = np.random.normal(5.0, 2.0, size=(16, 3))
+    gamma, beta = np.ones(3), np.zeros(3)
+    rm, rv = np.zeros(3), np.ones(3)
+    y = npx.batch_norm(x, gamma, beta, rm, rv, training=True, axis=1,
+                       momentum=0.0)
+    assert y.shape == x.shape
+    onp.testing.assert_allclose(rm.asnumpy(), x.asnumpy().mean(0),
+                                rtol=1e-3)
+    # inference path: stats untouched
+    rm2 = np.array(rm.asnumpy())
+    _ = npx.batch_norm(x, gamma, beta, rm2, rv, training=False, axis=1)
+    onp.testing.assert_allclose(rm2.asnumpy(), rm.asnumpy())
+
+
+def test_npx_save_load_roundtrip(tmp_path):
+    f = str(tmp_path / "arrs")
+    npx.save(f, {"a": np.arange(4), "b": np.ones((2, 2))})
+    out = npx.load(f)
+    assert isinstance(out["a"], np.ndarray)
+    onp.testing.assert_allclose(out["a"].asnumpy(), [0, 1, 2, 3])
+
+
+def test_review_regressions():
+    """Pinned fixes: floor_divide arity, single-output split/meshgrid,
+    ==None semantics, Lomax pareto, util<->npx one global flag."""
+    onp.testing.assert_allclose(
+        np.floor_divide(np.array([7.0, -7.0]), 2).asnumpy(),
+        onp.floor_divide(onp.array([7.0, -7.0]), 2))
+    parts = np.split(np.arange(4).reshape(2, 2), 1)
+    assert len(parts) == 1 and parts[0].shape == (2, 2)
+    (g,) = np.meshgrid(np.arange(3))
+    assert g.shape == (3,)
+    (b,) = np.broadcast_arrays(np.ones((2, 2)))
+    assert b.shape == (2, 2)
+    a = np.arange(3)
+    eq = a == None                                   # noqa: E711
+    assert eq.dtype == onp.bool_ and not eq.asnumpy().any()
+    assert (a != None).asnumpy().all()               # noqa: E711
+    np.random.seed(0)
+    p = np.random.pareto(3.0, size=(2000,))
+    assert float(p.min()) >= 0.0 and float(p.min()) < 0.5  # Lomax support
+    # one global np flag, visible across modules and threads
+    import threading
+    mx.util.set_np()
+    seen = []
+    t = threading.Thread(target=lambda: seen.append(npx.is_np_array()))
+    t.start(); t.join()
+    assert seen == [True] and mx.util.is_np_array()
+    npx.reset_np()
+    assert not mx.util.is_np_array()
+    assert npx.gamma(np.array([4.0])).asnumpy()[0] == pytest.approx(6.0)
+
+
+def test_np_array_function_interop():
+    """np arrays slot into plain-numpy call sites via asnumpy()."""
+    a = np.arange(3)
+    assert onp.asarray(a.asnumpy()).sum() == 3
+    assert np.allclose(a, a.copy())
+    assert np.array_equal(a, np.array([0, 1, 2]))
+    assert np.may_share_memory(a, a.copy())      # immutable buffer shared
+    assert not np.may_share_memory(a, a + 0)
