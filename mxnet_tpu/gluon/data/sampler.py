@@ -4,7 +4,7 @@ from __future__ import annotations
 import numpy as np
 
 __all__ = ["Sampler", "SequentialSampler", "RandomSampler", "BatchSampler",
-           "IntervalSampler"]
+           "IntervalSampler", "FilterSampler"]
 
 
 class Sampler:
@@ -51,6 +51,21 @@ class IntervalSampler(Sampler):
 
     def __len__(self):
         return self._length
+
+
+class FilterSampler(Sampler):
+    """Indices of dataset items passing `fn(item)` (reference:
+    gluon/data/sampler.py FilterSampler). The filter runs once, on the
+    host — data selection is IO-side work, not device work."""
+
+    def __init__(self, fn, dataset):
+        self._indices = [i for i in range(len(dataset)) if fn(dataset[i])]
+
+    def __iter__(self):
+        return iter(self._indices)
+
+    def __len__(self):
+        return len(self._indices)
 
 
 class BatchSampler(Sampler):

@@ -13,7 +13,8 @@ from ...block import Block, HybridBlock
 __all__ = ["Compose", "Cast", "ToTensor", "Normalize", "Resize", "CenterCrop",
            "RandomResizedCrop", "RandomFlipLeftRight", "RandomFlipTopBottom",
            "RandomCrop", "RandomBrightness", "RandomContrast",
-           "RandomSaturation", "RandomLighting", "RandomColorJitter"]
+           "RandomSaturation", "RandomHue", "RandomLighting",
+           "RandomColorJitter"]
 
 
 class Compose(Block):
@@ -193,6 +194,30 @@ class RandomSaturation(Block):
         return xf * alpha + gray * (1 - alpha)
 
 
+class RandomHue(Block):
+    """Hue jitter by YIQ rotation (reference: image.HueJitterAug): rotate
+    the chroma plane by a random angle in [-hue, hue]*pi."""
+    _t_yiq = np.array([[0.299, 0.587, 0.114],
+                       [0.596, -0.274, -0.321],
+                       [0.211, -0.523, 0.311]], np.float32)
+    _t_rgb = np.array([[1.0, 0.956, 0.621],
+                       [1.0, -0.272, -0.647],
+                       [1.0, -1.107, 1.705]], np.float32)
+
+    def __init__(self, hue):
+        super().__init__()
+        self._h = hue
+
+    def forward(self, x):
+        import numpy as _np
+        alpha = _np.random.uniform(-self._h, self._h)
+        u, w = _np.cos(alpha * _np.pi), _np.sin(alpha * _np.pi)
+        rot = _np.array([[1, 0, 0], [0, u, -w], [0, w, u]], _np.float32)
+        m = self._t_rgb @ rot @ self._t_yiq
+        xf = x.astype("float32")
+        return xf.dot(array(m.T.astype(_np.float32)))
+
+
 class RandomLighting(Block):
     """AlexNet-style PCA lighting noise."""
     _eigval = np.array([55.46, 4.794, 1.148], np.float32)
@@ -221,6 +246,8 @@ class RandomColorJitter(Block):
             self._ts.append(RandomContrast(contrast))
         if saturation:
             self._ts.append(RandomSaturation(saturation))
+        if hue:
+            self._ts.append(RandomHue(hue))
 
     def forward(self, x):
         import numpy as _np

@@ -10,7 +10,8 @@ from .block import HybridBlock
 __all__ = ["Loss", "L2Loss", "L1Loss", "SigmoidBinaryCrossEntropyLoss",
            "SigmoidBCELoss", "SoftmaxCrossEntropyLoss", "SoftmaxCELoss",
            "KLDivLoss", "HuberLoss", "HingeLoss", "SquaredHingeLoss",
-           "LogisticLoss", "TripletLoss", "CTCLoss", "CosineEmbeddingLoss"]
+           "LogisticLoss", "TripletLoss", "CTCLoss", "CosineEmbeddingLoss",
+           "PoissonNLLLoss", "GaussianNLLLoss"]
 
 
 def _reduce(x, weight, sample_weight, batch_axis):
@@ -318,4 +319,58 @@ class CTCLoss(Loss):
             a_label = jnp.take_along_axis(alpha_T, idx_label, axis=1)[:, 0]
             ll_ = jnp.logaddexp(a_blank, a_label)
             return -ll_
+        return _apply(fn, ins)
+
+
+class PoissonNLLLoss(Loss):
+    """Poisson negative log likelihood (reference: gluon/loss.py
+    PoissonNLLLoss): pred is the rate (or its log with from_logits),
+    L = pred - label*log(pred) [+ Stirling approx of log(label!)]."""
+
+    def __init__(self, weight=1.0, from_logits=True, batch_axis=0,
+                 compute_full=False, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._from_logits = from_logits
+        self._compute_full = compute_full
+
+    def hybrid_forward(self, F, pred, label, sample_weight=None,
+                       epsilon=1e-8):
+        ins = [pred, _lift(label)] + (
+            [sample_weight] if sample_weight is not None else [])
+
+        def fn(p, l, *sw):
+            l = l.reshape(p.shape)
+            if self._from_logits:
+                x = jnp.exp(p) - l * p
+            else:
+                x = p - l * jnp.log(p + epsilon)
+            if self._compute_full:
+                # Stirling: label*log(label) - label + 0.5*log(2*pi*label),
+                # applied where label > 1 (the reference's guard)
+                stirling = (l * jnp.log(jnp.maximum(l, 1.0)) - l
+                            + 0.5 * jnp.log(2 * jnp.pi * jnp.maximum(l, 1.0)))
+                x = x + jnp.where(l > 1.0, stirling, 0.0)
+            # reference reduces to the mean over ALL elements
+            return _reduce(x, self._weight, sw[0] if sw else None,
+                           self._batch_axis).mean()
+        return _apply(fn, ins)
+
+
+class GaussianNLLLoss(Loss):
+    """Heteroscedastic Gaussian NLL: 0.5*(log(var) + (pred-label)^2/var),
+    clamped at `eps` (torch-compatible semantics; MXNet 2.x parity)."""
+
+    def __init__(self, weight=1.0, batch_axis=0, eps=1e-6, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._eps = eps
+
+    def hybrid_forward(self, F, pred, label, var, sample_weight=None):
+        ins = [pred, _lift(label), _lift(var)] + (
+            [sample_weight] if sample_weight is not None else [])
+
+        def fn(p, l, v, *sw):
+            v = jnp.maximum(v, self._eps)
+            x = 0.5 * (jnp.log(v) + jnp.square(l.reshape(p.shape) - p) / v)
+            return _reduce(x, self._weight, sw[0] if sw else None,
+                           self._batch_axis)
         return _apply(fn, ins)

@@ -2,3 +2,12 @@
 from .basic_layers import *    # noqa: F401,F403
 from .conv_layers import *     # noqa: F401,F403
 from ..block import Block, HybridBlock, SymbolBlock
+
+
+def __getattr__(name):
+    # SyncBatchNorm's reference home is gluon.contrib.nn; resolve lazily
+    # to avoid a circular import at package init
+    if name == "SyncBatchNorm":
+        from ..contrib.nn import SyncBatchNorm
+        return SyncBatchNorm
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

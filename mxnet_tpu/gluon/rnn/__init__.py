@@ -1,5 +1,6 @@
 """mx.gluon.rnn (reference: python/mxnet/gluon/rnn/)."""
 from .rnn_layer import RNN, LSTM, GRU
 from .rnn_cell import (RecurrentCell, RNNCell, LSTMCell, GRUCell,
-                       SequentialRNNCell, DropoutCell, ZoneoutCell,
-                       ResidualCell, BidirectionalCell)
+                       SequentialRNNCell, HybridSequentialRNNCell,
+                       DropoutCell, ZoneoutCell, ResidualCell,
+                       BidirectionalCell, ModifierCell)

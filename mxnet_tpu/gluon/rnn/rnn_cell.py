@@ -9,8 +9,9 @@ from ..block import HybridBlock
 from .rnn_layer import _step_rnn
 
 __all__ = ["RecurrentCell", "RNNCell", "LSTMCell", "GRUCell",
-           "SequentialRNNCell", "DropoutCell", "ZoneoutCell", "ResidualCell",
-           "BidirectionalCell"]
+           "SequentialRNNCell", "HybridSequentialRNNCell", "DropoutCell",
+           "ZoneoutCell", "ResidualCell", "BidirectionalCell",
+           "ModifierCell"]
 
 
 class RecurrentCell(HybridBlock):
@@ -168,6 +169,31 @@ class SequentialRNNCell(RecurrentCell):
         raise NotImplementedError("SequentialRNNCell dispatches to children")
 
 
+class HybridSequentialRNNCell(SequentialRNNCell):
+    """Reference parity: the hybrid-capable stacked cell. Here every cell
+    already traces into one jitted program, so the behaviour is identical
+    to SequentialRNNCell — the name exists for ported code."""
+
+
+class ModifierCell(RecurrentCell):
+    """Base for cells that decorate another cell (reference:
+    rnn_cell.ModifierCell — Dropout/Zoneout/Residual subclasses).
+    Delegates state bookkeeping to `base_cell`."""
+
+    def __init__(self, base_cell, **kwargs):
+        super().__init__(**kwargs)
+        self.base_cell = base_cell
+
+    def state_info(self, batch_size=0):
+        return self.base_cell.state_info(batch_size)
+
+    def begin_state(self, batch_size=0, func=None, **kwargs):
+        return self.base_cell.begin_state(batch_size, func=func, **kwargs)
+
+    def __call__(self, x, states):
+        return self.base_cell(x, states)
+
+
 class DropoutCell(RecurrentCell):
     def __init__(self, rate, **kwargs):
         super().__init__(**kwargs)
@@ -187,16 +213,15 @@ class DropoutCell(RecurrentCell):
         return x, states
 
 
-class ZoneoutCell(RecurrentCell):
+class ZoneoutCell(ModifierCell):
     def __init__(self, base_cell, zoneout_outputs=0.0, zoneout_states=0.0,
                  **kwargs):
-        super().__init__(**kwargs)
-        self.base_cell = base_cell
+        super().__init__(base_cell, **kwargs)
         self._zo, self._zs = zoneout_outputs, zoneout_states
         self._prev_output = None
 
-    def state_info(self, batch_size=0):
-        return self.base_cell.state_info(batch_size)
+    def reset(self):
+        self._prev_output = None
 
     def __call__(self, x, states):
         from ... import autograd
@@ -211,17 +236,24 @@ class ZoneoutCell(RecurrentCell):
                         jax.random.bernoulli(_k, _p, n.shape), o, n),
                     [old, new]))
             next_states = mixed
+        if autograd.is_training() and self._zo:
+            # reference semantics: zoned-out output positions keep the
+            # PREVIOUS step's output (zeros on the first step)
+            from ..block import _layer_rng
+            prev = self._prev_output
+            key = _layer_rng()
+            if prev is None:
+                out = _apply(lambda n, _k=key, _p=self._zo: jnp.where(
+                    jax.random.bernoulli(_k, _p, n.shape), 0.0, n), [out])
+            else:
+                out = _apply(lambda n, o, _k=key, _p=self._zo: jnp.where(
+                    jax.random.bernoulli(_k, _p, n.shape), o, n),
+                    [out, prev])
+            self._prev_output = out
         return out, next_states
 
 
-class ResidualCell(RecurrentCell):
-    def __init__(self, base_cell, **kwargs):
-        super().__init__(**kwargs)
-        self.base_cell = base_cell
-
-    def state_info(self, batch_size=0):
-        return self.base_cell.state_info(batch_size)
-
+class ResidualCell(ModifierCell):
     def __call__(self, x, states):
         out, next_states = self.base_cell(x, states)
         return out + x, next_states

@@ -16,9 +16,22 @@ from .base import _np_dtype
 
 __all__ = ["Initializer", "Zero", "One", "Constant", "Uniform", "Normal",
            "Orthogonal", "Xavier", "MSRAPrelu", "Bilinear", "LSTMBias",
-           "Mixed", "register", "create"]
+           "Mixed", "register", "create", "InitDesc"]
 
 _REGISTRY = {}
+
+
+class InitDesc(str):
+    """Parameter-name descriptor passed to initializers (reference:
+    python/mxnet/initializer.py InitDesc): a str subclass carrying the
+    attr dict and the global-init flag, so name-dispatch initializers
+    keep working on plain strings."""
+
+    def __new__(cls, name, attrs=None, global_init=None):
+        obj = super().__new__(cls, name)
+        obj.attrs = attrs or {}
+        obj.global_init = global_init
+        return obj
 
 
 def register(klass):

@@ -104,3 +104,23 @@ def test_dataloader_over_transformed_vision():
     x, y = next(iter(dl))
     assert x.shape == (32, 1, 28, 28)
     assert float(x.asnumpy().max()) <= 1.0
+
+
+def test_filter_sampler_and_random_hue():
+    from mxnet_tpu.gluon.data import FilterSampler, ArrayDataset
+    from mxnet_tpu.gluon.data.vision import transforms
+    ds = ArrayDataset(np.arange(10).astype(np.float32))
+    samp = FilterSampler(lambda x: float(x) % 2 == 0, ds)
+    assert list(samp) == [0, 2, 4, 6, 8] and len(samp) == 5
+
+    img = mx.nd.random.uniform(shape=(8, 8, 3)) * 255
+    out = transforms.RandomHue(0.5)(img)
+    assert out.shape == (8, 8, 3)
+    # hue rotation preserves luma (Y of YIQ) up to float error
+    y_w = np.array([0.299, 0.587, 0.114], np.float32)
+    np.testing.assert_allclose((out.asnumpy() * y_w).sum(-1),
+                               (img.asnumpy() * y_w).sum(-1),
+                               rtol=1e-3, atol=1e-2)
+    jitter = transforms.RandomColorJitter(brightness=0.1, hue=0.1)
+    assert len(jitter._ts) == 2
+    assert jitter(img).shape == (8, 8, 3)

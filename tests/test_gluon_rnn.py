@@ -300,3 +300,44 @@ def test_bidirectional_cell_unroll_valid_length():
         np.testing.assert_allclose(out[n, :l], o_ref.asnumpy()[0],
                                    atol=1e-5, err_msg=f"row {n}")
         assert np.all(out[n, l:] == 0.0)
+
+
+def test_modifier_and_hybrid_sequential_cells():
+    """ModifierCell delegation + HybridSequentialRNNCell parity
+    (reference: rnn_cell.ModifierCell/HybridSequentialRNNCell)."""
+    from mxnet_tpu.gluon import rnn
+    res = rnn.ResidualCell(rnn.LSTMCell(3, input_size=3))
+    res.base_cell.initialize()
+    assert isinstance(res, rnn.ModifierCell)
+    assert res.state_info() == res.base_cell.state_info()
+    x = nd.random.uniform(shape=(2, 3))
+    states = res.begin_state(batch_size=2)
+    out, _ = res(x, states)
+    assert out.shape == (2, 3)
+
+    seq = rnn.HybridSequentialRNNCell()
+    seq.add(rnn.LSTMCell(4, input_size=3))
+    seq.add(rnn.GRUCell(5, input_size=4))
+    seq.initialize()
+    outs, st = seq.unroll(6, nd.random.uniform(shape=(2, 6, 3)),
+                          layout="NTC")
+    assert outs.shape == (2, 6, 5)      # merged (N,T,C)
+    assert len(st) == 3                 # lstm h,c + gru h
+
+
+def test_zoneout_outputs_applies_in_training():
+    """zoneout_outputs must actually zone out (was a silent no-op): with
+    rate ~1 every output position keeps the previous step's output
+    (zeros on step one)."""
+    from mxnet_tpu.gluon import rnn
+    cell = rnn.ZoneoutCell(rnn.LSTMCell(4, input_size=3),
+                           zoneout_outputs=0.999999)
+    cell.base_cell.initialize()
+    x = nd.random.uniform(shape=(2, 3)) + 1.0
+    states = cell.begin_state(batch_size=2)
+    with autograd.record():
+        out, _ = cell(x, states)
+    np.testing.assert_allclose(out.asnumpy(), np.zeros((2, 4)))
+    # inference: no zoneout, output flows through
+    out_inf, _ = cell(x, states)
+    assert np.abs(out_inf.asnumpy()).sum() > 0

@@ -1,5 +1,6 @@
 """Loss tests (reference model: tests/python/unittest/test_loss.py)."""
 import numpy as np
+import pytest
 
 import mxnet_tpu as mx
 from mxnet_tpu import nd, autograd, gluon
@@ -99,3 +100,33 @@ def test_triplet_cosine():
     assert tl.asnumpy()[0] >= 0
     ce = gloss.CosineEmbeddingLoss()(a, p, nd.array([1.0]))
     assert ce.asnumpy()[0] < 0.01
+
+
+def test_poisson_nll_loss():
+    """Rate-1 prediction at label k: L = exp(logp) - k*logp (from_logits)."""
+    pred = nd.array([[0.0], [0.0]])       # log-rate 0 -> rate 1
+    label = nd.array([[1.0], [2.0]])
+    l = gloss.PoissonNLLLoss(from_logits=True)(pred, label)
+    np.testing.assert_allclose(l.asnumpy(), [1.0], rtol=1e-5)
+    # torch parity on a random case (log_input=True, reduction='mean')
+    torch = pytest.importorskip("torch")
+    p = np.random.RandomState(0).randn(4, 3).astype(np.float32)
+    y = np.random.RandomState(1).poisson(2.0, (4, 3)).astype(np.float32)
+    ours = gloss.PoissonNLLLoss(from_logits=True)(nd.array(p), nd.array(y))
+    ref = torch.nn.functional.poisson_nll_loss(
+        torch.tensor(p), torch.tensor(y), log_input=True,
+        reduction="mean")
+    np.testing.assert_allclose(float(ours.asnumpy()), float(ref), rtol=1e-5)
+
+
+def test_gaussian_nll_loss_matches_torch():
+    torch = pytest.importorskip("torch")
+    rng = np.random.RandomState(2)
+    p = rng.randn(5, 3).astype(np.float32)
+    y = rng.randn(5, 3).astype(np.float32)
+    v = rng.rand(5, 3).astype(np.float32) + 0.1
+    ours = gloss.GaussianNLLLoss()(nd.array(p), nd.array(y), nd.array(v))
+    ref = torch.nn.functional.gaussian_nll_loss(
+        torch.tensor(p), torch.tensor(y), torch.tensor(v),
+        full=False, reduction="none").mean(-1).numpy()
+    np.testing.assert_allclose(ours.asnumpy(), ref, rtol=1e-4, atol=1e-5)
