@@ -1,0 +1,75 @@
+"""The mx.np numpy front end, end to end (reference: MXNet's "deepnumpy"
+crash course). One script shows the contract: np arrays are numpy-
+semantic (bool masks, 0-d reductions, np.random/np.linalg), flow through
+Gluon blocks and autograd unchanged (np in -> np out), and npx carries
+the nn ops numpy doesn't have.
+
+Usage: python examples/numpy_frontend.py [--steps N] [--smoke]
+"""
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.dirname(_os.path.abspath(__file__)))
+import _smoke  # noqa: F401,E402 — forces CPU under --smoke
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import mxnet_tpu as mx
+from mxnet_tpu import np, npx
+from mxnet_tpu.gluon import nn, Trainer
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=150)
+    ap.add_argument("--smoke", action="store_true")
+    args = ap.parse_args()
+    steps = 20 if args.smoke else args.steps
+
+    npx.set_np()
+    np.random.seed(0)
+
+    # -- numpy semantics on device ---------------------------------------
+    a = np.arange(12).reshape((3, 4)).astype("float32")
+    print("mean (0-d):", np.mean(a))                # 0-d, numpy-style
+    print("masked:", a[a > 5.0])                    # boolean mask (eager)
+    u, s, vt = np.linalg.svd(a @ a.T + np.eye(3))
+    print("svd singular values:", s)
+
+    # -- np arrays through Gluon + autograd ------------------------------
+    net = nn.HybridSequential()
+    net.add(nn.Dense(64, activation="relu"), nn.Dense(3))
+    net.initialize()
+    net.hybridize()                                 # one XLA executable
+
+    x = np.random.normal(size=(512, 16))
+    w_true = np.random.normal(size=(16, 3))
+    labels = np.argmax(x @ w_true, axis=1)
+
+    trainer = Trainer(net.collect_params(), "adam",
+                      {"learning_rate": 0.01})
+    for step in range(steps):
+        with mx.autograd.record():
+            logits = net(x)                          # np in -> np out
+            logp = npx.log_softmax(logits)
+            loss = -np.mean(np.take_along_axis(
+                logp, labels.astype("int32").reshape(-1, 1), 1))
+        loss.backward()
+        trainer.step(x.shape[0])
+        if step % 50 == 0 or step == steps - 1:
+            acc = float(np.mean(np.argmax(logits, axis=1) == labels))
+            print(f"step {step}: loss={float(loss):.4f} acc={acc:.3f}")
+
+    assert isinstance(logits, np.ndarray)
+    final_acc = float(np.mean(np.argmax(net(x), axis=1) == labels))
+    if not args.smoke:
+        assert final_acc > 0.9, final_acc
+    npx.reset_np()
+    print("final accuracy:", final_acc)
+
+
+if __name__ == "__main__":
+    main()
