@@ -39,7 +39,11 @@ from . import metric
 from . import io
 from . import recordio
 from . import image
+from . import image as img             # reference alias (mx.img)
+from . import registry
+from . import log
 from . import kvstore
+from . import kvstore as kv            # reference alias (mx.kv)
 from .kvstore import KVStore
 from . import gluon
 from . import symbol

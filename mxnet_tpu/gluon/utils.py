@@ -70,5 +70,28 @@ def check_sha1(filename, sha1_hash):
 
 def download(url, path=None, overwrite=False, sha1_hash=None, retries=5,
              verify_ssl=True):
+    """Offline 'download': file:// URLs and existing local paths copy to
+    `path` (with sha1 verification when given); network URLs raise — this
+    environment has no egress, datasets are generated locally."""
+    import os
+    import shutil
+    src = url[len("file://"):] if url.startswith("file://") else url
+    if os.path.exists(src):
+        if path is None:
+            fname = os.path.basename(src)
+        elif os.path.isdir(path):
+            fname = os.path.join(path, os.path.basename(src))
+        else:
+            fname = path
+        if overwrite or not os.path.exists(fname) or \
+                (sha1_hash and not check_sha1(fname, sha1_hash)):
+            if os.path.abspath(src) != os.path.abspath(fname):
+                os.makedirs(os.path.dirname(os.path.abspath(fname)),
+                            exist_ok=True)
+                shutil.copyfile(src, fname)
+        if sha1_hash and not check_sha1(fname, sha1_hash):
+            raise MXNetError(f"sha1 mismatch for {fname}")
+        return fname
     raise MXNetError("network access is disabled in this environment; "
-                     "datasets are generated locally (gluon.data.vision)")
+                     "datasets are generated locally (gluon.data.vision), "
+                     "and download() accepts file:// or local paths")

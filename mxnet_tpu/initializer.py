@@ -16,7 +16,7 @@ from .base import _np_dtype
 
 __all__ = ["Initializer", "Zero", "One", "Constant", "Uniform", "Normal",
            "Orthogonal", "Xavier", "MSRAPrelu", "Bilinear", "LSTMBias",
-           "Mixed", "register", "create", "InitDesc"]
+           "Mixed", "Load", "register", "create", "InitDesc"]
 
 _REGISTRY = {}
 
@@ -234,6 +234,41 @@ class Mixed(Initializer):
         raise ValueError(f"parameter {name} did not match any pattern")
 
 
+class Load(Initializer):
+    """Initialize from a dict of saved arrays by name (reference:
+    initializer.Load): params present in the dict take their saved value,
+    the rest fall back to `default_init` (or error)."""
+
+    def __init__(self, param, default_init=None, verbose=False):
+        super().__init__()
+        self.param = {}
+        for name, arr in (param or {}).items():
+            clean = name[4:] if name.startswith(("arg:", "aux:")) else name
+            self.param[clean] = arr
+        self.default_init = default_init
+        self.verbose = verbose
+
+    def init_array(self, name, shape, dtype, key):
+        if name in self.param:
+            arr = self.param[name]
+            val = arr.asnumpy() if hasattr(arr, "asnumpy") else np.asarray(arr)
+            if tuple(val.shape) != tuple(shape):
+                raise ValueError(
+                    f"Load: shape mismatch for {name}: saved {val.shape} "
+                    f"vs required {shape}")
+            if self.verbose:
+                import logging
+                logging.info("Load: initialized %s from saved params", name)
+            return jnp.asarray(val, dtype=dtype)
+        if self.default_init is not None:
+            return self.default_init.init_array(name, shape, dtype, key)
+        raise ValueError(f"Load: no saved value for {name} and no "
+                         "default_init")
+
+
+register(Load)
+
+
 # convenience namespace mirroring mx.init.*
 class _InitNamespace:
     Zero = Zero
@@ -247,7 +282,9 @@ class _InitNamespace:
     Bilinear = Bilinear
     LSTMBias = LSTMBias
     Mixed = Mixed
+    Load = Load
     Initializer = Initializer
+    InitDesc = InitDesc
 
 
 init = _InitNamespace

@@ -16,7 +16,7 @@ from .base import MXNetError, _as_list
 from .ndarray.ndarray import NDArray, array
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
-           "PrefetchingIter", "ImageRecordIter", "CSVIter"]
+           "PrefetchingIter", "ImageRecordIter", "CSVIter", "LibSVMIter"]
 
 DataDesc = namedtuple("DataDesc", ["name", "shape"])
 
@@ -336,6 +336,47 @@ class CSVIter(DataIter):
         label = np.loadtxt(label_csv, delimiter=",", dtype=np.float32) \
             if label_csv else np.zeros(len(data), np.float32)
         self._inner = NDArrayIter(data, label, batch_size)
+
+    @property
+    def provide_data(self):
+        return self._inner.provide_data
+
+    @property
+    def provide_label(self):
+        return self._inner.provide_label
+
+    def reset(self):
+        self._inner.reset()
+
+    def next(self):
+        return self._inner.next()
+
+
+class LibSVMIter(DataIter):
+    """LibSVM-format reader (reference: io.LibSVMIter). The reference
+    yields CSR batches; TPU storage is dense (SURVEY §8), so rows densify
+    at parse time — same values, MXU-ready layout."""
+
+    def __init__(self, data_libsvm, data_shape, label_shape=(1,),
+                 batch_size=1, **kwargs):
+        super().__init__(batch_size)
+        dim = int(data_shape[0]) if not isinstance(data_shape, int) \
+            else int(data_shape)
+        rows, labels = [], []
+        with open(data_libsvm) as f:
+            for line in f:
+                parts = line.split("#", 1)[0].split()
+                if not parts:
+                    continue
+                labels.append(float(parts[0]))
+                row = np.zeros(dim, np.float32)
+                for tok in parts[1:]:
+                    idx, val = tok.split(":")
+                    row[int(idx)] = float(val)
+                rows.append(row)
+        data = np.stack(rows) if rows else np.zeros((0, dim), np.float32)
+        self._inner = NDArrayIter(data, np.asarray(labels, np.float32),
+                                  batch_size)
 
     @property
     def provide_data(self):

@@ -1,0 +1,39 @@
+"""Logging helpers (reference: python/mxnet/log.py): a get_logger with
+the reference's level names and an optional file handler."""
+from __future__ import annotations
+
+import logging
+
+__all__ = ["get_logger", "getLogger", "DEBUG", "INFO", "WARNING", "ERROR",
+           "CRITICAL", "NOTSET"]
+
+DEBUG = logging.DEBUG
+INFO = logging.INFO
+WARNING = logging.WARNING
+ERROR = logging.ERROR
+CRITICAL = logging.CRITICAL
+NOTSET = logging.NOTSET
+
+_FORMAT = "%(asctime)s [%(levelname)s] %(name)s: %(message)s"
+
+
+def get_logger(name=None, filename=None, filemode="a", level=WARNING):
+    logger = logging.getLogger(name)
+    if filename:
+        if not any(isinstance(h, logging.FileHandler)
+                   for h in logger.handlers):
+            handler = logging.FileHandler(filename, filemode)
+            handler.setFormatter(logging.Formatter(_FORMAT))
+            logger.addHandler(handler)
+    elif not logger.handlers:
+        # reference behaviour: a formatted console handler, so INFO/DEBUG
+        # actually print at the requested level (root's lastResort is
+        # WARNING+ only)
+        handler = logging.StreamHandler()
+        handler.setFormatter(logging.Formatter(_FORMAT))
+        logger.addHandler(handler)
+    logger.setLevel(level)
+    return logger
+
+
+getLogger = get_logger

@@ -6,7 +6,7 @@ random sub-namespace, mirroring the reference `mxnet.ndarray` module surface.
 from .ndarray import (NDArray, zeros, ones, full, empty, array, arange,
                       linspace, eye, zeros_like, ones_like, full_like,
                       from_numpy, waitall, _apply, _wrap_apply, _lift)
-from .utils import save, load
+from .utils import save, load, load_frombuffer
 from ..ops.tensor_ops import *          # noqa: F401,F403
 from ..ops.nn_ops import *              # noqa: F401,F403
 from ..ops.seq_ops import (SequenceMask, SequenceLast,  # noqa: F401
@@ -44,3 +44,6 @@ from ..operator import Custom
 
 # control-flow operators (reference: mx.nd.contrib.foreach/while_loop/cond)
 from . import contrib
+
+# sparse compatibility namespace (densifying — SURVEY §8)
+from . import sparse

@@ -1,0 +1,98 @@
+"""mx.nd.sparse (reference: python/mxnet/ndarray/sparse.py).
+
+SURVEY §8 designed divergence: XLA/TPU has no sparse storage — the MXU
+wants dense tiles, and HBM is sized for dense gradients. This namespace
+keeps ported code RUNNING instead of crashing: the constructors accept
+the reference's CSR/row-sparse ingredients and return an equivalent
+DENSE NDArray (stype 'default'), which is the TPU-correct representation
+of the same values; `retain` is the exact dense equivalent (zero the
+dropped rows). Only operations whose CONTRACT is sparse storage (e.g.
+kvstore.row_sparse_pull) raise, from their own entry points.
+"""
+from __future__ import annotations
+
+import warnings
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..base import MXNetError
+from .ndarray import NDArray, array as _dense_array
+
+__all__ = ["csr_matrix", "row_sparse_array", "array", "zeros", "empty",
+           "CSRNDArray", "RowSparseNDArray", "retain"]
+
+# the reference classes exist as names so isinstance-style ported code
+# imports cleanly; on TPU every array is dense, so they never instantiate
+CSRNDArray = NDArray
+RowSparseNDArray = NDArray
+
+
+def _warn(kind):
+    warnings.warn(
+        f"mx.nd.sparse.{kind}: TPU storage is dense (SURVEY.md §8) — "
+        "returning an equivalent dense NDArray", stacklevel=3)
+
+
+def csr_matrix(arg1, shape=None, ctx=None, dtype=None):
+    """Build the dense equivalent of a CSR matrix.
+
+    Accepts the reference forms: a dense array-like, or the tuple
+    (data, indices, indptr) with `shape`.
+    """
+    _warn("csr_matrix")
+    if isinstance(arg1, tuple) and len(arg1) == 3:
+        data, indices, indptr = (np.asarray(x) for x in arg1)
+        if shape is None:
+            raise MXNetError("csr_matrix((data, indices, indptr)) needs "
+                             "an explicit shape")
+        out = np.zeros(shape, dtype or data.dtype)
+        for row in range(shape[0]):
+            lo, hi = int(indptr[row]), int(indptr[row + 1])
+            out[row, indices[lo:hi].astype(np.int64)] = data[lo:hi]
+        return _dense_array(out, ctx=ctx)
+    return _dense_array(np.asarray(arg1), ctx=ctx, dtype=dtype)
+
+
+def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):
+    """Dense equivalent of a row-sparse array: (data, indices) scatter
+    into a zeros tensor of `shape`."""
+    _warn("row_sparse_array")
+    if isinstance(arg1, tuple) and len(arg1) == 2:
+        data, indices = (np.asarray(x) for x in arg1)
+        if shape is None:
+            raise MXNetError("row_sparse_array((data, indices)) needs "
+                             "an explicit shape")
+        out = np.zeros(shape, dtype or data.dtype)
+        out[indices.astype(np.int64)] = data
+        return _dense_array(out, ctx=ctx)
+    return _dense_array(np.asarray(arg1), ctx=ctx, dtype=dtype)
+
+
+def array(source_array, ctx=None, dtype=None):
+    """scipy.sparse matrices densify; everything else passes through."""
+    if hasattr(source_array, "todense"):   # scipy.sparse duck-type
+        _warn("array")
+        return _dense_array(np.asarray(source_array.todense()), ctx=ctx,
+                            dtype=dtype)
+    return _dense_array(source_array, ctx=ctx, dtype=dtype)
+
+
+def zeros(stype, shape, ctx=None, dtype=None):
+    from .ndarray import zeros as _zeros
+    if stype != "default":
+        _warn(f"zeros({stype!r})")
+    return _zeros(shape, ctx=ctx, dtype=dtype or "float32")
+
+
+def empty(stype, shape, ctx=None, dtype=None):
+    return zeros(stype, shape, ctx=ctx, dtype=dtype)
+
+
+def retain(data, indices):
+    """Reference sparse.retain keeps only the given rows. The dense
+    equivalent (zeroing the rest) is exact and jit-friendly."""
+    from .ndarray import _apply
+    return _apply(
+        lambda x, i: jnp.zeros_like(x).at[i.astype(jnp.int32)].set(
+            x[i.astype(jnp.int32)]), [data, indices])

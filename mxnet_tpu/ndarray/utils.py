@@ -38,6 +38,16 @@ def save(fname, data):
                 write_vars=[engine.file_var(_npz_path(fname))])
 
 
+def _decode_npz(f):
+    """One decoder for the save() payload (list = 'arr:<i>' keys, dict =
+    '<kind>:<name>' keys) shared by file and buffer loading."""
+    keys = list(f.keys())
+    if all(k.startswith("arr:") for k in keys):
+        items = sorted(keys, key=lambda k: int(k.split(":", 1)[1]))
+        return [array(f[k]) for k in items]
+    return {k.split(":", 1)[1]: array(f[k]) for k in keys}
+
+
 def load(fname):
     """Load NDArrays saved by `save` — returns list or dict matching input.
     Waits on the file's engine var first (ordering after async saves)."""
@@ -45,8 +55,12 @@ def load(fname):
     engine.wait_for_var(engine.file_var(_npz_path(fname)))
     # np.savez appended .npz for bare names; open what was written
     with np.load(_npz_path(fname), allow_pickle=False) as f:
-        keys = list(f.keys())
-        if all(k.startswith("arr:") for k in keys):
-            items = sorted(keys, key=lambda k: int(k.split(":", 1)[1]))
-            return [array(f[k]) for k in items]
-        return {k.split(":", 1)[1]: array(f[k]) for k in keys}
+        return _decode_npz(f)
+
+
+def load_frombuffer(buf):
+    """Load NDArrays from an in-memory save() payload (reference:
+    mx.nd.load_frombuffer over the C NDArrayLoadFromBuffer)."""
+    import io as _io
+    with np.load(_io.BytesIO(buf), allow_pickle=False) as f:
+        return _decode_npz(f)

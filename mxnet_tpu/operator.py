@@ -31,7 +31,8 @@ import jax
 
 from .base import MXNetError
 
-__all__ = ["CustomOp", "CustomOpProp", "register", "get", "Custom"]
+__all__ = ["CustomOp", "CustomOpProp", "register", "get", "Custom",
+           "get_all_registered_operators"]
 
 _registry = {}
 
@@ -99,6 +100,12 @@ def get(op_type):
     if op_type not in _registry:
         raise MXNetError(f"custom op {op_type!r} is not registered")
     return _registry[op_type]
+
+
+def get_all_registered_operators():
+    """Names of registered custom ops (reference:
+    mx.operator.get_all_registered_operators over MXListAllOpNames)."""
+    return sorted(_registry)
 
 
 def _prop_for(op_type, prop_kwargs, n_inputs):

@@ -1,0 +1,62 @@
+"""Generic name registry factories (reference: python/mxnet/registry.py).
+
+The reference builds optimizer/initializer/loss registries from these
+three factories; this rebuild's core registries predate the module, so
+it exists for extension authors porting `mx.registry`-based plugins.
+"""
+from __future__ import annotations
+
+from .base import MXNetError
+
+__all__ = ["get_register_func", "get_alias_func", "get_create_func"]
+
+_REGISTRIES = {}
+
+
+def _registry(base_class, nickname):
+    return _REGISTRIES.setdefault((base_class, nickname), {})
+
+
+def get_register_func(base_class, nickname):
+    reg = _registry(base_class, nickname)
+
+    def register(klass, name=None):
+        if not issubclass(klass, base_class):
+            raise MXNetError(
+                f"{klass} must subclass {base_class.__name__} to register "
+                f"as a {nickname}")
+        reg[(name or klass.__name__).lower()] = klass
+        return klass
+    register.__name__ = f"register_{nickname}"
+    return register
+
+
+def get_alias_func(base_class, nickname):
+    register = get_register_func(base_class, nickname)
+
+    def alias(*aliases):
+        def wrap(klass):
+            for a in aliases:
+                register(klass, a)
+            return klass
+        return wrap
+    alias.__name__ = f"alias_{nickname}"
+    return alias
+
+
+def get_create_func(base_class, nickname):
+    reg = _registry(base_class, nickname)
+
+    def create(*args, **kwargs):
+        if args and isinstance(args[0], base_class):
+            return args[0]
+        if not args or not isinstance(args[0], str):
+            raise MXNetError(f"create expects a {nickname} name or "
+                             "instance")
+        name, args = args[0].lower(), args[1:]
+        if name not in reg:
+            raise MXNetError(f"{name!r} is not a registered {nickname}; "
+                             f"have {sorted(reg)}")
+        return reg[name](*args, **kwargs)
+    create.__name__ = f"create_{nickname}"
+    return create

@@ -20,7 +20,8 @@ __all__ = ["default_context", "set_default_context", "assert_almost_equal",
            "almost_equal", "same", "rand_ndarray", "rand_shape_2d",
            "rand_shape_3d", "rand_shape_nd", "check_numeric_gradient",
            "check_symbolic_forward", "check_symbolic_backward",
-           "numeric_grad", "list_tpus", "get_mnist"]
+           "numeric_grad", "list_tpus", "list_gpus", "get_mnist",
+           "download"]
 
 _rng = np.random.RandomState(12345)
 
@@ -195,3 +196,21 @@ def get_mnist(seed=0):
     xte, yte = make(128)
     return {"train_data": xtr, "train_label": ytr,
             "test_data": xte, "test_label": yte}
+
+
+def download(url, fname=None, dirname=None, overwrite=False, retries=5):
+    """Offline download (reference: test_utils.download): file:// and
+    local paths copy; network URLs raise with guidance."""
+    from .gluon.utils import download as _dl
+    import os
+    path = fname
+    if path is None and dirname is not None:
+        os.makedirs(dirname, exist_ok=True)
+        src = url[len("file://"):] if url.startswith("file://") else url
+        path = os.path.join(dirname, os.path.basename(src))
+    return _dl(url, path=path, overwrite=overwrite)
+
+
+def list_gpus():
+    """Reference helper name; TPUs stand in for GPUs here."""
+    return list_tpus()

@@ -86,3 +86,17 @@ def test_block_initialize_uses_initializer():
     net.initialize(init.One())
     assert (net.weight.data().asnumpy() == 1).all()
     assert (net.bias.data().asnumpy() == 0).all()
+
+
+def test_load_initializer():
+    """initializer.Load: saved values win, default_init covers the rest."""
+    from mxnet_tpu.gluon import nn
+    saved = {"arg:weight": mx.nd.ones((3, 4)) * 7}
+    init = mx.init.Load({"arg:weight": saved["arg:weight"]},
+                        default_init=mx.init.Zero())
+    w = init.init_array("weight", (3, 4), "float32", None)
+    np.testing.assert_allclose(np.asarray(w), np.full((3, 4), 7.0))
+    b = init.init_array("bias", (3,), "float32", None)
+    np.testing.assert_allclose(np.asarray(b), np.zeros(3))
+    with pytest.raises(ValueError, match="shape mismatch"):
+        init.init_array("weight", (2, 2), "float32", None)

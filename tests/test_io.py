@@ -79,3 +79,22 @@ def test_imagerecorditer_synthetic():
     batches = list(it)
     assert len(batches) == 3
     assert batches[0].data[0].shape == (2, 3, 16, 16)
+
+
+def test_libsvm_iter_densifies(tmp_path):
+    """LibSVMIter parses the reference on-disk format; rows densify
+    (SURVEY SS8) and batch like NDArrayIter."""
+    import os
+    f = os.path.join(tmp_path, "data.libsvm")
+    with open(f, "w") as fh:
+        fh.write("1 0:1.5 3:2.0\n")
+        fh.write("0 1:0.5  # trailing comment\n")
+        fh.write("\n")
+        fh.write("1 2:3.0 3:1.0\n")
+        fh.write("0 0:2.5\n")
+    it = mio.LibSVMIter(data_libsvm=f, data_shape=(4,), batch_size=2)
+    batches = list(it)
+    assert len(batches) == 2
+    x0 = batches[0].data[0].asnumpy()
+    np.testing.assert_allclose(x0, [[1.5, 0, 0, 2.0], [0, 0.5, 0, 0]])
+    np.testing.assert_allclose(batches[0].label[0].asnumpy(), [1, 0])

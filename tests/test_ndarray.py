@@ -152,3 +152,28 @@ def test_one_hot_where_clip():
                  nd.array([2.0, 2.0]))
     assert np.allclose(w.asnumpy(), [1, 2])
     assert np.allclose(nd.clip(nd.array([-1.0, 5.0]), 0, 1).asnumpy(), [0, 1])
+
+
+def test_sparse_namespace_densifies():
+    """mx.nd.sparse keeps ported code running: constructors produce the
+    DENSE equivalent (SURVEY §8) with a warning, retain zeroes rows."""
+    import warnings
+    from mxnet_tpu.ndarray import sparse
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        m = sparse.csr_matrix((np.array([1.0, 2.0, 3.0]),
+                               np.array([0, 2, 1]),
+                               np.array([0, 2, 3])), shape=(2, 3))
+        np.testing.assert_allclose(m.asnumpy(), [[1, 0, 2], [0, 3, 0]])
+        r = sparse.row_sparse_array((np.ones((2, 3)), np.array([0, 2])),
+                                    shape=(4, 3))
+        np.testing.assert_allclose(r.asnumpy()[1], np.zeros(3))
+        np.testing.assert_allclose(r.asnumpy()[2], np.ones(3))
+    assert m.stype == "default"
+    kept = sparse.retain(nd.array([[1.0, 1], [2, 2], [3, 3]]),
+                         nd.array([0, 2]))
+    np.testing.assert_allclose(kept.asnumpy(), [[1, 1], [0, 0], [3, 3]])
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        z = sparse.zeros("row_sparse", (2, 2))
+    assert z.asnumpy().sum() == 0

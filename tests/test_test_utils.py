@@ -91,3 +91,68 @@ def test_get_mnist_trains():
     pred = net(nd.array(data["test_data"])).asnumpy().argmax(1)
     acc = (pred == data["test_label"]).mean()
     assert acc > 0.9, f"synthetic mnist should be learnable, acc={acc}"
+
+
+def test_download_local_and_offline(tmp_path):
+    import os
+    import pytest as _pytest
+    src = os.path.join(tmp_path, "src.txt")
+    with open(src, "w") as f:
+        f.write("payload")
+    dst = mx.test_utils.download("file://" + src,
+                                 fname=os.path.join(tmp_path, "dst.txt"))
+    assert open(dst).read() == "payload"
+    with _pytest.raises(mx.base.MXNetError, match="network"):
+        mx.test_utils.download("http://example.com/x.bin")
+
+
+def test_registry_factories_and_aliases():
+    """mx.registry factories build a working register/alias/create trio;
+    mx.kv and mx.img are the reference namespace aliases."""
+    class Base:
+        pass
+    reg = mx.registry.get_register_func(Base, "thing")
+    alias = mx.registry.get_alias_func(Base, "thing")
+    create = mx.registry.get_create_func(Base, "thing")
+
+    @alias("widget")
+    class MyThing(Base):
+        def __init__(self, x=1):
+            self.x = x
+    reg(MyThing)
+    t = create("widget", x=5)
+    assert isinstance(t, MyThing) and t.x == 5
+    assert create("mything").x == 1
+    with pytest.raises(mx.base.MXNetError):
+        create("nope")
+    with pytest.raises(mx.base.MXNetError):
+        reg(int)
+
+    assert mx.kv is mx.kvstore and mx.img is mx.image
+    logger = mx.log.get_logger("t_mxlog", level=mx.log.INFO)
+    assert logger.level == mx.log.INFO
+    assert mx.operator.get_all_registered_operators() == sorted(
+        mx.operator._registry)
+    assert mx.test_utils.list_gpus() == mx.test_utils.list_tpus()
+
+
+def test_download_dirname_creates_directory(tmp_path):
+    import os
+    src = os.path.join(tmp_path, "payload.bin")
+    with open(src, "wb") as f:
+        f.write(b"abc")
+    out_dir = os.path.join(tmp_path, "fresh_dir")
+    dst = mx.test_utils.download("file://" + src, dirname=out_dir)
+    assert dst == os.path.join(out_dir, "payload.bin")
+    assert os.path.isdir(out_dir) and open(dst, "rb").read() == b"abc"
+
+
+def test_load_frombuffer_roundtrip(tmp_path):
+    import os
+    f = os.path.join(tmp_path, "arrs")
+    mx.nd.save(f, {"w": nd.arange(4)})
+    from mxnet_tpu import engine
+    engine.wait_for_all()
+    with open(f + ".npz", "rb") as fh:
+        out = mx.nd.load_frombuffer(fh.read())
+    np.testing.assert_allclose(out["w"].asnumpy(), [0, 1, 2, 3])
