@@ -266,7 +266,8 @@ class Load(Initializer):
                          "default_init")
 
 
-register(Load)
+# NB: deliberately NOT register()ed — Load needs a saved-params dict and
+# cannot be constructed from a bare name (reference does the same)
 
 
 # convenience namespace mirroring mx.init.*

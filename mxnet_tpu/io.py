@@ -357,8 +357,8 @@ class LibSVMIter(DataIter):
     yields CSR batches; TPU storage is dense (SURVEY §8), so rows densify
     at parse time — same values, MXU-ready layout."""
 
-    def __init__(self, data_libsvm, data_shape, label_shape=(1,),
-                 batch_size=1, **kwargs):
+    def __init__(self, data_libsvm, data_shape, label_libsvm=None,
+                 label_shape=(1,), batch_size=1, **kwargs):
         super().__init__(batch_size)
         dim = int(data_shape[0]) if not isinstance(data_shape, int) \
             else int(data_shape)
@@ -368,15 +368,33 @@ class LibSVMIter(DataIter):
                 parts = line.split("#", 1)[0].split()
                 if not parts:
                     continue
-                labels.append(float(parts[0]))
+                # reference: multi-label lines are comma-separated; the
+                # leading field is absent entirely when labels come from a
+                # separate label_libsvm file
+                if label_libsvm is None and ":" not in parts[0]:
+                    labels.append([float(v) for v in parts[0].split(",")])
+                    feats = parts[1:]
+                else:
+                    feats = parts
                 row = np.zeros(dim, np.float32)
-                for tok in parts[1:]:
+                for tok in feats:
                     idx, val = tok.split(":")
                     row[int(idx)] = float(val)
                 rows.append(row)
+        if label_libsvm is not None:
+            labels = []
+            with open(label_libsvm) as f:
+                for line in f:
+                    line = line.split("#", 1)[0].strip()
+                    if line:
+                        labels.append([float(v)
+                                       for v in line.replace(",", " ")
+                                       .split()])
         data = np.stack(rows) if rows else np.zeros((0, dim), np.float32)
-        self._inner = NDArrayIter(data, np.asarray(labels, np.float32),
-                                  batch_size)
+        lab = np.asarray(labels, np.float32)
+        if lab.ndim == 2 and lab.shape[1] == 1:
+            lab = lab[:, 0]
+        self._inner = NDArrayIter(data, lab, batch_size)
 
     @property
     def provide_data(self):

@@ -18,9 +18,12 @@ _FORMAT = "%(asctime)s [%(levelname)s] %(name)s: %(message)s"
 
 
 def get_logger(name=None, filename=None, filemode="a", level=WARNING):
+    import os
     logger = logging.getLogger(name)
     if filename:
+        target = os.path.abspath(filename)
         if not any(isinstance(h, logging.FileHandler)
+                   and getattr(h, "baseFilename", None) == target
                    for h in logger.handlers):
             handler = logging.FileHandler(filename, filemode)
             handler.setFormatter(logging.Formatter(_FORMAT))

@@ -217,6 +217,47 @@ class BERTForPretraining(HybridBlock):
         return mlm, self.nsp(pooled)
 
 
+class BERTClassifier(HybridBlock):
+    """Sentence(-pair) classification head on the pooled output
+    (gluonnlp BERTClassifier contract: dropout -> dense(num_classes))."""
+
+    def __init__(self, bert: BERTModel, num_classes=2, dropout=0.1,
+                 **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.bert = bert
+            self.dropout = nn.Dropout(dropout) if dropout else None
+            self.classifier = nn.Dense(num_classes, flatten=False,
+                                       in_units=bert._units,
+                                       prefix="classifier_")
+
+    def hybrid_forward(self, F, token_ids, segment_ids, valid_length=None):
+        _, pooled = self.bert(token_ids, segment_ids, valid_length)
+        if self.dropout is not None:
+            pooled = self.dropout(pooled)
+        return self.classifier(pooled)
+
+
+class BERTRegression(HybridBlock):
+    """Single-value regression head on the pooled output (gluonnlp
+    BERTRegression contract)."""
+
+    def __init__(self, bert: BERTModel, dropout=0.1, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.bert = bert
+            self.dropout = nn.Dropout(dropout) if dropout else None
+            self.regression = nn.Dense(1, flatten=False,
+                                       in_units=bert._units,
+                                       prefix="regression_")
+
+    def hybrid_forward(self, F, token_ids, segment_ids, valid_length=None):
+        _, pooled = self.bert(token_ids, segment_ids, valid_length)
+        if self.dropout is not None:
+            pooled = self.dropout(pooled)
+        return self.regression(pooled)
+
+
 _SPECS = {
     # name: (num_layers, units, hidden, heads)
     "bert_12_768_12": (12, 768, 3072, 12),

@@ -51,7 +51,22 @@ def csr_matrix(arg1, shape=None, ctx=None, dtype=None):
             lo, hi = int(indptr[row]), int(indptr[row + 1])
             out[row, indices[lo:hi].astype(np.int64)] = data[lo:hi]
         return _dense_array(out, ctx=ctx)
-    return _dense_array(np.asarray(arg1), ctx=ctx, dtype=dtype)
+    if isinstance(arg1, tuple) and len(arg1) == 2 \
+            and isinstance(arg1[1], tuple):
+        # reference COO form: (data, (row, col))
+        data = np.asarray(arg1[0])
+        row, col = (np.asarray(x).astype(np.int64) for x in arg1[1])
+        if shape is None:
+            raise MXNetError("csr_matrix((data, (row, col))) needs an "
+                             "explicit shape")
+        out = np.zeros(shape, dtype or data.dtype)
+        out[row, col] = data
+        return _dense_array(out, ctx=ctx)
+    dense = np.asarray(arg1)
+    if shape is not None and tuple(dense.shape) != tuple(shape):
+        raise MXNetError(f"csr_matrix: dense input shape {dense.shape} "
+                         f"does not match shape={tuple(shape)}")
+    return _dense_array(dense, ctx=ctx, dtype=dtype)
 
 
 def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):

@@ -103,9 +103,13 @@ def get(op_type):
 
 
 def get_all_registered_operators():
-    """Names of registered custom ops (reference:
-    mx.operator.get_all_registered_operators over MXListAllOpNames)."""
-    return sorted(_registry)
+    """All operator names: the built-in imperative op surface plus
+    registered custom ops (reference contract: MXListAllOpNames returns
+    every operator, not just custom ones)."""
+    from . import ndarray as nd
+    builtin = [n for n in dir(nd)
+               if not n.startswith("_") and callable(getattr(nd, n))]
+    return sorted(set(builtin) | set(_registry))
 
 
 def _prop_for(op_type, prop_kwargs, n_inputs):

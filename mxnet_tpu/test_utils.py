@@ -204,10 +204,13 @@ def download(url, fname=None, dirname=None, overwrite=False, retries=5):
     from .gluon.utils import download as _dl
     import os
     path = fname
-    if path is None and dirname is not None:
+    if dirname is not None:
         os.makedirs(dirname, exist_ok=True)
-        src = url[len("file://"):] if url.startswith("file://") else url
-        path = os.path.join(dirname, os.path.basename(src))
+        if path is None:
+            src = url[len("file://"):] if url.startswith("file://") else url
+            path = os.path.join(dirname, os.path.basename(src))
+        else:   # reference: dirname and fname compose
+            path = os.path.join(dirname, path)
     return _dl(url, path=path, overwrite=overwrite)
 
 

@@ -98,3 +98,19 @@ def test_libsvm_iter_densifies(tmp_path):
     x0 = batches[0].data[0].asnumpy()
     np.testing.assert_allclose(x0, [[1.5, 0, 0, 2.0], [0, 0.5, 0, 0]])
     np.testing.assert_allclose(batches[0].label[0].asnumpy(), [1, 0])
+
+
+def test_libsvm_iter_label_file_and_multilabel(tmp_path):
+    import os
+    data_f = os.path.join(tmp_path, "d.libsvm")
+    lab_f = os.path.join(tmp_path, "l.libsvm")
+    with open(data_f, "w") as f:
+        f.write("0:1.0\n2:2.0\n")         # no leading label field
+    with open(lab_f, "w") as f:
+        f.write("1,0\n0,1\n")             # multi-label rows
+    it = mio.LibSVMIter(data_libsvm=data_f, label_libsvm=lab_f,
+                        data_shape=(3,), label_shape=(2,), batch_size=2)
+    b = next(iter(it))
+    np.testing.assert_allclose(b.data[0].asnumpy(),
+                               [[1, 0, 0], [0, 0, 2.0]])
+    np.testing.assert_allclose(b.label[0].asnumpy(), [[1, 0], [0, 1]])

@@ -283,3 +283,38 @@ def test_pretrained_not_silently_ignored(tmp_path):
                  vision.mobilenet0_25]:
         with pytest.raises(mx.MXNetError, match="offline"):
             ctor(pretrained=True, root=str(tmp_path))
+
+
+def test_bert_classifier_finetunes():
+    """BERTClassifier (gluonnlp contract): pooled -> dense, trains on a
+    separable toy task; BERTRegression emits (B, 1)."""
+    from mxnet_tpu.models.bert import BERTClassifier, BERTRegression
+    bert = _tiny_bert()
+    clf = BERTClassifier(bert, num_classes=2, dropout=0.0)
+    clf.initialize(mx.init.Normal(0.05))
+    clf.hybridize()
+    B, S = 8, 16
+    rng = np.random.RandomState(0)
+    # class = whether token 3 appears first: learnable from embeddings
+    tok = rng.randint(4, 64, (B, S))
+    labels = rng.randint(0, 2, B)
+    tok[:, 0] = np.where(labels, 3, 2)
+    tok_nd = nd.array(tok, dtype="int32")
+    seg = nd.array(np.zeros((B, S)), dtype="int32")
+    vl = nd.array(np.full((B,), S), dtype="int32")
+    lossfn = mx.gluon.loss.SoftmaxCrossEntropyLoss()
+    tr = mx.gluon.Trainer(clf.collect_params(), "adam",
+                          {"learning_rate": 5e-3})
+    y = nd.array(labels.astype(np.float32))
+    for _ in range(30):
+        with mx.autograd.record():
+            out = clf(tok_nd, seg, vl)
+            l = lossfn(out, y)
+        l.backward()
+        tr.step(B)
+    pred = np.argmax(clf(tok_nd, seg, vl).asnumpy(), 1)
+    assert (pred == labels).mean() > 0.8
+
+    reg = BERTRegression(bert, dropout=0.0)
+    reg.regression.initialize(mx.init.Normal(0.05))
+    assert reg(tok_nd, seg, vl).shape == (B, 1)

@@ -177,3 +177,18 @@ def test_sparse_namespace_densifies():
         warnings.simplefilter("ignore")
         z = sparse.zeros("row_sparse", (2, 2))
     assert z.asnumpy().sum() == 0
+
+
+def test_sparse_csr_coo_form_and_shape_check():
+    import warnings
+    from mxnet_tpu.ndarray import sparse
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        m = sparse.csr_matrix((np.array([5.0, 7.0]),
+                               (np.array([0, 1]), np.array([2, 0]))),
+                              shape=(2, 3))
+        np.testing.assert_allclose(m.asnumpy(), [[0, 0, 5], [7, 0, 0]])
+        with pytest.raises(mx.base.MXNetError, match="does not match"):
+            sparse.csr_matrix(np.ones((2, 2)), shape=(3, 3))
+    with pytest.raises(ValueError, match="unknown initializer"):
+        mx.initializer.create("load")
