@@ -301,3 +301,26 @@ def test_np_array_function_interop():
     assert np.array_equal(a, np.array([0, 1, 2]))
     assert np.may_share_memory(a, a.copy())      # immutable buffer shared
     assert not np.may_share_memory(a, a + 0)
+
+
+def test_np_arrays_under_jit_and_mesh():
+    """np arrays hold ordinary jax.Arrays: they jit and shard like nd.
+    Pins that the front end adds no Python-level obstacles to the
+    compiled/SPMD paths."""
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    a = np.arange(16.0).reshape((8, 2))
+
+    @jax.jit
+    def f(x):
+        return (x * 2).sum(axis=1)
+
+    out = f(a._data)                       # raw buffer drops straight in
+    onp.testing.assert_allclose(onp.asarray(out),
+                                (a.asnumpy() * 2).sum(1))
+    mesh = Mesh(onp.array(jax.devices()[:8]).reshape(8), ("dp",))
+    sharded = jax.device_put(a._data, NamedSharding(mesh, P("dp", None)))
+    b = np.ndarray(sharded)                # np view over a sharded array
+    assert isinstance(b + 1, np.ndarray)
+    onp.testing.assert_allclose((b + 1).asnumpy(), a.asnumpy() + 1)
