@@ -9,7 +9,7 @@ Submodules import lazily (BERT/Transformer/SSD are sizeable):
 """
 import importlib
 
-__all__ = ["mlp", "bert", "transformer", "ssd", "faster_rcnn"]
+__all__ = ["mlp", "bert", "transformer", "ssd", "faster_rcnn", "yolo"]
 
 
 def __getattr__(name):

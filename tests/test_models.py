@@ -318,3 +318,79 @@ def test_bert_classifier_finetunes():
     reg = BERTRegression(bert, dropout=0.0)
     reg.regression.initialize(mx.init.Normal(0.05))
     assert reg(tok_nd, seg, vl).shape == (B, 1)
+
+
+# ---------------------------------------------------------------------------
+# YOLOv3
+# ---------------------------------------------------------------------------
+def test_yolo3_forward_decode_and_target_loss():
+    """Forward shapes at a small input; decode recovers a planted box;
+    target/loss pipeline produces a finite scalar that falls when the
+    head emits the assigned targets."""
+    from mxnet_tpu.models.yolo import (YOLOV3, YOLOV3TargetGenerator,
+                                       YOLOV3Loss, yolo_decode, _ANCHORS)
+    size, C = 64, 3
+    net = YOLOV3(num_classes=C, input_size=size)
+    net.initialize(mx.init.Normal(0.02))
+    x = nd.random.uniform(shape=(2, size, size, 3))
+    outs = net(x)
+    assert [o.shape for o in outs] == [
+        (2, size // 32, size // 32, 3 * (5 + C)),
+        (2, size // 16, size // 16, 3 * (5 + C)),
+        (2, size // 8, size // 8, 3 * (5 + C))]
+
+    # plant one confident box in the raw heads: scale 0 (stride 32),
+    # cell (0, 0), anchor 0 -> center (16, 16), wh = anchor size
+    raws = [np.full(o.shape, -8.0, np.float32) for o in outs]
+    p = np.zeros(5 + C, np.float32)
+    p[:2] = 0.0          # sigmoid 0.5 -> center of the cell
+    p[2:4] = 0.0         # wh = anchor
+    p[4] = 8.0           # objectness ~1
+    p[5] = 8.0           # class 0
+    raws[0][0, 0, 0, :5 + C] = p
+    ids, scores, boxes = yolo_decode(
+        tuple(nd.array(r) for r in raws), C, size, conf_thresh=0.3)
+    assert int(ids.asnumpy()[0, 0]) == 0
+    assert scores.asnumpy()[0, 0] > 0.9
+    aw, ah = _ANCHORS[0][0]
+    np.testing.assert_allclose(
+        boxes.asnumpy()[0, 0],
+        [16 - aw / 2, 16 - ah / 2, 16 + aw / 2, 16 + ah / 2], atol=1e-3)
+    assert int(ids.asnumpy()[1, 0]) == -1    # second image: all padded
+
+    gen = YOLOV3TargetGenerator(C, size)
+    gt = nd.array([[[10.0, 12, 50, 60]], [[-1.0, -1, -1, -1]]])
+    gid = nd.array([[1.0], [-1.0]])
+    obj_t, ctr_t, scale_t, wmask, cls_t = gen(gt, gid)
+    assert float(obj_t.asnumpy()[0].sum()) == 1.0   # one anchor assigned
+    assert float(obj_t.asnumpy()[1].sum()) == 0.0   # padded image: none
+    lossfn = YOLOV3Loss()
+    l0 = lossfn(outs, obj_t, ctr_t, scale_t, wmask, cls_t)
+    assert l0.shape == () and np.isfinite(l0.asnumpy())
+
+    # a head that EMITS the targets must beat the random head. Locate the
+    # assigned position's scale segment (w=40, h=48 matches a stride-16
+    # anchor, not stride-32).
+    pos = int(np.argmax(obj_t.asnumpy()[0, :, 0]))
+    seg_sizes = [(size // s) ** 2 * 3 for s in (32, 16, 8)]
+    s_idx, off = 0, 0
+    while pos >= off + seg_sizes[s_idx]:
+        off += seg_sizes[s_idx]
+        s_idx += 1
+    hw = size // (32, 16, 8)[s_idx]
+    cell, a_idx = divmod(pos - off, 3)
+    gy, gx = divmod(cell, hw)
+    perfect = [np.full(o.shape, -8.0, np.float32) for o in outs]
+    tx, ty = ctr_t.asnumpy()[0, pos]
+    tw, th = scale_t.asnumpy()[0, pos]
+    vec = np.full(5 + C, -8.0, np.float32)
+    vec[:2] = np.log(np.clip([tx, ty], 1e-4, 1 - 1e-4)) - \
+        np.log1p(-np.clip([tx, ty], 1e-4, 1 - 1e-4))   # logit(t)
+    vec[2:4] = (tw, th)
+    vec[4] = 8.0
+    vec[5 + 1] = 8.0                                    # class id 1
+    perfect[s_idx][0, gy, gx,
+                   a_idx * (5 + C):(a_idx + 1) * (5 + C)] = vec
+    l1 = lossfn(tuple(nd.array(r) for r in perfect),
+                obj_t, ctr_t, scale_t, wmask, cls_t)
+    assert float(l1.asnumpy()) < float(l0.asnumpy())
