@@ -110,73 +110,118 @@ def main():
     on_tpu = jax.default_backend() == "tpu"
     smoke = "--smoke" in sys.argv
     if smoke or not on_tpu:
-        batch, steps = 8, 3
+        candidates, steps = [8], 3
     else:
-        batch, steps = 128, 30
-    batch = int(os.environ.get("BENCH_BATCH", batch))
+        # batch sweep: larger batches fill the MXU better; measure both
+        # and report the best (reference-class numbers likewise pick
+        # their best per-chip batch). BENCH_BATCH pins a single size.
+        candidates, steps = [128, 256], 30
+    if os.environ.get("BENCH_BATCH"):
+        candidates = [int(os.environ["BENCH_BATCH"])]
     steps = int(os.environ.get("BENCH_STEPS", steps))
-    print(f"[bench] backend={jax.default_backend()} batch={batch} "
-          f"steps={steps}", file=sys.stderr)
+    print(f"[bench] backend={jax.default_backend()} "
+          f"candidates={candidates} steps={steps}", file=sys.stderr)
 
     net = resnet50_v1(layout="NHWC", stem_s2d=True)
     net.initialize()
     net.cast("bfloat16")
-    x = mx.nd.random.uniform(shape=(batch, 224, 224, 3), dtype="bfloat16")
-    net(x)  # materialise deferred-shape params
-    fwd, params = extract_pure_fn(net, x, training=True)
-
-    key = jax.random.PRNGKey(0)
-    labels = jax.random.randint(key, (batch,), 0, 1000)
-    images = x._data
-
-    aux_idx = list(fwd.aux_indices)
-
-    def loss_fn(p, xb, yb):
-        logits, aux = fwd(p, xb)
-        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-        return -jnp.mean(jnp.take_along_axis(logp, yb[:, None], 1)), aux
+    # materialise deferred-shape params ONCE (eager forward at the
+    # smallest batch) — per-candidate eager forwards would burn sweep
+    # budget for nothing
+    warm = mx.nd.random.uniform(shape=(8, 224, 224, 3), dtype="bfloat16")
+    net(warm)
 
     lr, mu = 0.1, 0.9
-    # perf lever (BENCH_FUSED_SGD=1): one flattened multi-tensor update in
-    # fp32 (reference: multi_sgd_mom_update) instead of per-tensor subtract
-    # fusions; momentum master copy in fp32 either way it's enabled
+    # perf lever (BENCH_FUSED_SGD=1, measured 2026-07-31: REJECTED at
+    # batch 128, -5.5% — see docs/PERF.md lever verdicts)
     fused = os.environ.get("BENCH_FUSED_SGD") == "1"
+    t_sweep = time.monotonic()
+    # later candidates only start while comfortably inside the worker
+    # timeout — a half-finished sweep must never eat the whole attempt
+    SWEEP_BUDGET_S = 300
 
-    def train_step(p, mom, xb, yb):
-        (loss, aux), g = jax.value_and_grad(loss_fn, has_aux=True)(p, xb, yb)
-        if fused:
-            from mxnet_tpu.optimizer.optimizer import fused_sgd_mom_kernel
-            new_p, new_mom = fused_sgd_mom_kernel(p, mom, g, lr, mu)
-        else:
-            new_mom = [mu * m + gg.astype(m.dtype) for m, gg in zip(mom, g)]
-            new_p = [pp - lr * m for pp, m in zip(p, new_mom)]
-        for i, v in zip(aux_idx, aux):  # BN running stats carry through
-            new_p[i] = v
-        return new_p, new_mom, loss
+    def measure(batch):
+        x = mx.nd.random.uniform(shape=(batch, 224, 224, 3),
+                                 dtype="bfloat16")
+        fwd, params = extract_pure_fn(net, x, training=True)
+        # donate COPIES: donation deletes the input buffers on TPU, and
+        # the net's own parameter arrays must survive for the next
+        # sweep candidate's trace
+        params = [jnp.array(p) for p in params]
+        key = jax.random.PRNGKey(0)
+        labels = jax.random.randint(key, (batch,), 0, 1000)
+        images = x._data
+        aux_idx = list(fwd.aux_indices)
 
-    step = jax.jit(train_step, donate_argnums=(0, 1))
-    mom = [jnp.zeros(p.shape, jnp.float32) if fused else jnp.zeros_like(p)
-           for p in params]
+        def loss_fn(p, xb, yb):
+            logits, aux = fwd(p, xb)
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+            return -jnp.mean(jnp.take_along_axis(logp, yb[:, None], 1)), aux
 
-    # warmup: compile + one extra to stabilise. NB sync via host fetch:
-    # under the axon tunnel block_until_ready does not actually block.
-    params, mom, loss = step(params, mom, images, labels)
-    params, mom, loss = step(params, mom, images, labels)
-    float(loss)
+        def train_step(p, mom, xb, yb):
+            (loss, aux), g = jax.value_and_grad(
+                loss_fn, has_aux=True)(p, xb, yb)
+            if fused:
+                from mxnet_tpu.optimizer.optimizer import \
+                    fused_sgd_mom_kernel
+                new_p, new_mom = fused_sgd_mom_kernel(p, mom, g, lr, mu)
+            else:
+                new_mom = [mu * m + gg.astype(m.dtype)
+                           for m, gg in zip(mom, g)]
+                new_p = [pp - lr * m for pp, m in zip(p, new_mom)]
+            for i, v in zip(aux_idx, aux):  # BN running stats carry
+                new_p[i] = v
+            return new_p, new_mom, loss
 
-    t0 = time.perf_counter()
-    for _ in range(steps):
+        step = jax.jit(train_step, donate_argnums=(0, 1))
+        mom = [jnp.zeros(p.shape, jnp.float32) if fused
+               else jnp.zeros_like(p) for p in params]
+        # warmup: compile + one extra to stabilise. NB sync via host
+        # fetch: under the axon tunnel block_until_ready doesn't block.
         params, mom, loss = step(params, mom, images, labels)
-    final_loss = float(loss)
-    dt = time.perf_counter() - t0
+        params, mom, loss = step(params, mom, images, labels)
+        float(loss)
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            params, mom, loss = step(params, mom, images, labels)
+        final_loss = float(loss)
+        dt = time.perf_counter() - t0
+        img_s = batch * steps / dt
+        print(f"[bench] batch={batch} loss={final_loss:.4f} dt={dt:.3f}s "
+              f"-> {img_s:.1f} img/s", file=sys.stderr)
+        return img_s
 
-    img_s = batch * steps / dt
-    print(f"[bench] loss={final_loss:.4f} dt={dt:.3f}s", file=sys.stderr)
+    best_img_s, best_batch = 0.0, candidates[0]
+    for i, batch in enumerate(candidates):
+        if i > 0 and time.monotonic() - t_sweep > SWEEP_BUDGET_S:
+            print(f"[bench] sweep budget spent; skipping batch {batch}",
+                  file=sys.stderr)
+            continue
+        try:
+            img_s = measure(batch)
+        except Exception as e:  # e.g. OOM at the larger batch
+            print(f"[bench] batch {batch} failed: {e!r}", file=sys.stderr)
+            continue
+        if img_s > best_img_s:
+            best_img_s, best_batch = img_s, batch
+            # checkpoint the best-so-far on stdout: the supervisor keeps
+            # the LAST parseable JSON line, so if a later candidate (or
+            # BERT) wedges the tunnel, this measurement still lands
+            print(json.dumps({
+                "metric": "resnet50_train_throughput",
+                "value": round(best_img_s, 2),
+                "unit": "images/sec/chip",
+                "vs_baseline": round(best_img_s / BASELINE_IMG_S, 4)}),
+                flush=True)
+    if best_img_s == 0.0:
+        raise RuntimeError("no batch candidate completed")
+    print(f"[bench] best: batch={best_batch} {best_img_s:.1f} img/s",
+          file=sys.stderr)
     result = {
         "metric": "resnet50_train_throughput",
-        "value": round(img_s, 2),
+        "value": round(best_img_s, 2),
         "unit": "images/sec/chip",
-        "vs_baseline": round(img_s / BASELINE_IMG_S, 4),
+        "vs_baseline": round(best_img_s / BASELINE_IMG_S, 4),
     }
 
     # Second headline metric (BASELINE.json): BERT-base MLM tokens/sec/chip.
@@ -189,7 +234,7 @@ def main():
         except Exception as e:  # pragma: no cover
             print(f"[bench] bert bench failed: {e!r}", file=sys.stderr)
 
-    print(json.dumps(result))
+    print(json.dumps(result), flush=True)
 
 
 if __name__ == "__main__":

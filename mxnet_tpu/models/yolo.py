@@ -202,15 +202,22 @@ def yolo_decode(outs, num_classes, input_size, conf_thresh=0.1,
         cls = jax.nn.sigmoid(p[..., 5:])
         scores_all = obj * cls                              # (B, N, C)
         boxes = jnp.concatenate([xy - wh / 2, xy + wh / 2], -1)
-        k = min(nms_topk, p.shape[1])
+        n, c = scores_all.shape[1], scores_all.shape[2]
+        k = min(nms_topk, n * c)
 
         def per_image(bx, sc):
-            cid = jnp.argmax(sc, -1)
-            best = jnp.max(sc, -1)
-            top = jnp.argsort(-best)[:k]                    # preselect
-            bx_k, best_k, cid_k = bx[top], best[top], cid[top]
+            # reference box_nms contract (force_suppress=False): every
+            # (position, class) pair is a candidate, and only same-class
+            # boxes suppress each other — a rider and their horse both
+            # survive even at high IOU
+            flat_scores = sc.reshape(-1)                    # (N*C,)
+            flat_cls = jnp.tile(jnp.arange(c), n).astype(jnp.float32)
+            top = jnp.argsort(-flat_scores)[:k]             # preselect
+            bx_k = bx[top // c]
+            best_k = flat_scores[top]
+            cid_k = flat_cls[top]
             keep = _nms(bx_k, best_k, iou_threshold=nms_thresh,
-                        max_out=max_out)
+                        max_out=max_out, class_ids=cid_k)
             best_k = jnp.where(jnp.logical_and(keep, best_k > conf_thresh),
                                best_k, 0.0)
             order = jnp.argsort(-best_k)[:max_out]
@@ -234,7 +241,6 @@ class YOLOV3TargetGenerator:
     def __init__(self, num_classes, input_size):
         self.num_classes = num_classes
         self.input_size = input_size
-        self.grid, self.anchors, self.stride = _grids_and_anchors(input_size)
         # per-scale segment offsets in the flat N dimension
         self._seg = []
         off = 0
@@ -297,12 +303,27 @@ class YOLOV3TargetGenerator:
 
 class YOLOV3Loss:
     """Objectness BCE + center BCE + scale L1 + class BCE, masked by the
-    assignment (reference: YOLOV3Loss)."""
+    assignment (reference: YOLOV3Loss). With `gt_boxes` (and the loss
+    constructed with `input_size`), unassigned predictions whose decoded
+    box overlaps ANY gt above `ignore_iou_thresh` are EXCLUDED from the
+    objectness loss — the reference's dynamic ignore mask, which stops
+    training from suppressing near-duplicate detections."""
 
-    def __call__(self, outs, obj_t, ctr_t, scale_t, wmask, cls_t):
+    def __init__(self, input_size=None, ignore_iou_thresh=0.7):
+        self._ignore = ignore_iou_thresh
+        if input_size is not None:
+            self._tables = _grids_and_anchors(input_size)
+        else:
+            self._tables = None
+
+    def __call__(self, outs, obj_t, ctr_t, scale_t, wmask, cls_t,
+                 gt_boxes=None):
         nc = cls_t.shape[-1]
+        tables = self._tables
+        ignore_thresh = self._ignore
+        use_ignore = gt_boxes is not None and tables is not None
 
-        def fn(o1, o2, o3, obj, ctr, sc, wm, cl):
+        def fn(o1, o2, o3, obj, ctr, sc, wm, cl, *maybe_gt):
             flat = [r.reshape(r.shape[0], -1, 5 + nc) for r in (o1, o2, o3)]
             p = jnp.concatenate(flat, 1).astype(jnp.float32)
 
@@ -310,14 +331,31 @@ class YOLOV3Loss:
                 return (jax.nn.relu(logit) - logit * label
                         + jnp.log1p(jnp.exp(-jnp.abs(logit))))
 
+            obj_weight = jnp.ones_like(obj)
+            if use_ignore:
+                grid, anchors, stride = tables
+                gt = maybe_gt[0].astype(jnp.float32)        # (B, M, 4)
+                xy = (jax.nn.sigmoid(p[..., :2]) + grid) * stride
+                wh = jnp.exp(jnp.clip(p[..., 2:4], -10, 8)) * anchors
+                pb = jnp.concatenate([xy - wh / 2, xy + wh / 2], -1)
+                from ..ops.detection_ops import box_iou
+                max_iou = jax.vmap(
+                    lambda bx, g: box_iou(bx, g).max(-1))(pb, gt)
+                ignore = jnp.logical_and(max_iou[..., None] > ignore_thresh,
+                                         obj < 0.5)
+                obj_weight = jnp.where(ignore, 0.0, 1.0)
+
             denom = jnp.maximum(obj.sum(), 1.0)
-            l_obj = bce(p[..., 4:5], obj).mean() * obj.shape[1]
+            l_obj = (bce(p[..., 4:5], obj) * obj_weight).mean() \
+                * obj.shape[1]
             l_ctr = (bce(p[..., :2], ctr) * obj * wm).sum() / denom
             l_scale = (jnp.abs(p[..., 2:4] - sc) * obj * wm).sum() / denom
             l_cls = (bce(p[..., 5:], cl) * obj).sum() / denom
             return l_obj + l_ctr + l_scale + l_cls
-        return _apply(fn, list(outs) + [obj_t, ctr_t, scale_t, wmask,
-                                        cls_t])
+        ins = list(outs) + [obj_t, ctr_t, scale_t, wmask, cls_t]
+        if use_ignore:
+            ins.append(gt_boxes)
+        return _apply(fn, ins)
 
 
 def yolo3_darknet53(num_classes=20, input_size=416, **kwargs):

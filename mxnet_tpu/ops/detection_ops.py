@@ -108,12 +108,17 @@ def multibox_target(anchors, labels, ious_threshold=0.5,
     return jax.vmap(per_image)(labels)
 
 
-def nms(boxes, scores, iou_threshold=0.45, max_out=100):
+def nms(boxes, scores, iou_threshold=0.45, max_out=100, class_ids=None):
     """Static-shape greedy NMS. boxes (N,4), scores (N,) -> keep mask (N,)
-    with at most max_out survivors."""
+    with at most max_out survivors. With `class_ids` (N,), only same-class
+    boxes suppress each other (reference box_nms force_suppress=False)."""
     order = jnp.argsort(-scores)
     boxes_s = boxes[order]
     iou = box_iou(boxes_s, boxes_s)
+    if class_ids is not None:
+        cls_s = class_ids[order]
+        same = cls_s[:, None] == cls_s[None, :]
+        iou = jnp.where(same, iou, 0.0)
     n = boxes.shape[0]
 
     def body(i, keep):

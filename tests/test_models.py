@@ -394,3 +394,54 @@ def test_yolo3_forward_decode_and_target_loss():
     l1 = lossfn(tuple(nd.array(r) for r in perfect),
                 obj_t, ctr_t, scale_t, wmask, cls_t)
     assert float(l1.asnumpy()) < float(l0.asnumpy())
+
+
+def test_yolo3_per_class_nms_and_ignore_mask():
+    """Reference semantics pinned: (a) overlapping boxes of DIFFERENT
+    classes both survive NMS (force_suppress=False); (b) an unassigned
+    high-IOU prediction is excluded from the objectness loss."""
+    from mxnet_tpu.models.yolo import (YOLOV3TargetGenerator, YOLOV3Loss,
+                                       yolo_decode, _ANCHORS)
+    size, C = 64, 3
+    shape32 = (1, 2, 2, 3 * (5 + C))
+    raws = [np.full(shape32, -8.0, np.float32),
+            np.full((1, 4, 4, 3 * (5 + C)), -8.0, np.float32),
+            np.full((1, 8, 8, 3 * (5 + C)), -8.0, np.float32)]
+    # same cell/anchor emits strong class-1 AND class-2 (identical box)
+    v = np.full(5 + C, -8.0, np.float32)
+    v[:2] = 0.0; v[2:4] = 0.0; v[4] = 8.0
+    v[5 + 1] = 8.0
+    v[5 + 2] = 7.5
+    raws[0][0, 0, 0, :5 + C] = v
+    ids, scores, boxes = yolo_decode(
+        tuple(nd.array(r) for r in raws), C, size, conf_thresh=0.3,
+        nms_thresh=0.45)
+    got = set(int(i) for i in ids.asnumpy()[0] if i >= 0)
+    assert got == {1, 2}            # both classes kept despite IOU=1
+    np.testing.assert_allclose(boxes.asnumpy()[0, 0],
+                               boxes.asnumpy()[0, 1], atol=1e-4)
+
+    # ignore mask: gt box, assigned anchor at pos_a; craft a SECOND
+    # prediction overlapping gt strongly at a different anchor — with
+    # gt_boxes passed, its objectness penalty disappears
+    gen = YOLOV3TargetGenerator(C, size)
+    gt = nd.array([[[8.0, 8, 56, 56]]])     # big central box
+    gid = nd.array([[0.0]])
+    targets = gen(gt, gid)
+    lossfn = YOLOV3Loss(input_size=size, ignore_iou_thresh=0.7)
+    # build heads where the stride-32 cell (1,1) anchor 2 ALSO predicts
+    # ~exactly the gt box (48x48 at center 32,32 -> IOU ~1; the ASSIGNED
+    # anchor is a stride-16 one, so this one is unassigned and would be
+    # penalised without the mask). tx=ty=-8 puts sigmoid ~0 -> center at
+    # the cell's top-left corner (32, 32).
+    aw, ah = _ANCHORS[0][2]
+    hot = [np.full(r.shape, -8.0, np.float32) for r in raws]
+    vec = np.full(5 + C, -8.0, np.float32)
+    vec[2] = np.log(48.0 / aw); vec[3] = np.log(48.0 / ah)
+    vec[4] = 8.0                            # confident objectness
+    hot[0][0, 1, 1, 2 * (5 + C):3 * (5 + C)] = vec
+    outs = tuple(nd.array(r) for r in hot)
+    l_no_gt = lossfn(outs, *targets)
+    l_with_gt = lossfn(outs, *targets, gt_boxes=gt)
+    # removing the false-negative penalty must LOWER the loss
+    assert float(l_with_gt.asnumpy()) < float(l_no_gt.asnumpy())
