@@ -1,4 +1,5 @@
 """mx.gluon.data.vision (reference layout)."""
 from .datasets import (MNIST, FashionMNIST, CIFAR10, CIFAR100,
-                       ImageFolderDataset, ImageRecordDataset)
+                       ImageFolderDataset, ImageRecordDataset,
+                       ImageListDataset)
 from . import transforms

@@ -14,7 +14,7 @@ from ..dataset import Dataset
 from ....ndarray.ndarray import array
 
 __all__ = ["MNIST", "FashionMNIST", "CIFAR10", "CIFAR100", "ImageFolderDataset",
-           "ImageRecordDataset"]
+           "ImageRecordDataset", "ImageListDataset"]
 
 
 class _SyntheticImageDataset(Dataset):
@@ -89,6 +89,45 @@ class ImageFolderDataset(Dataset):
             self.synsets.append(cls)
             for fname in sorted(os.listdir(path)):
                 self.items.append((os.path.join(path, fname), i))
+
+    def __len__(self):
+        return len(self.items)
+
+    def __getitem__(self, idx):
+        from ....image import imread
+        path, label = self.items[idx]
+        img = imread(path, self._flag)
+        if self._transform is not None:
+            return self._transform(img, label)
+        return img, label
+
+
+class ImageListDataset(Dataset):
+    """Images named by a .lst-style list (reference: ImageListDataset):
+    `imglist` is a path to a tab-separated `index\tlabel\tpath` file (the
+    im2rec .lst format) or an in-memory list of [label, path] entries;
+    paths resolve relative to `root`."""
+
+    def __init__(self, root=".", imglist=None, flag=1, transform=None):
+        import os
+        self._transform = transform
+        self._flag = flag
+        self.items = []
+        if isinstance(imglist, str):
+            with open(imglist) as f:
+                for line in f:
+                    parts = line.rstrip("\n").split("\t")
+                    if len(parts) < 3:
+                        continue
+                    label = float(parts[1]) if len(parts) == 3 \
+                        else [float(v) for v in parts[1:-1]]
+                    self.items.append(
+                        (os.path.join(root, parts[-1]), label))
+        else:
+            for entry in (imglist or []):
+                label, path = entry[:-1], entry[-1]
+                label = label[0] if len(label) == 1 else list(label)
+                self.items.append((os.path.join(root, path), label))
 
     def __len__(self):
         return len(self.items)

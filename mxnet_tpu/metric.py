@@ -7,8 +7,8 @@ from .base import MXNetError, _as_list
 
 __all__ = ["EvalMetric", "Accuracy", "TopKAccuracy", "F1", "MCC", "MAE",
            "MSE", "RMSE", "CrossEntropy", "NegativeLogLikelihood",
-           "Perplexity", "PearsonCorrelation", "Loss", "CompositeEvalMetric",
-           "CustomMetric", "create", "np"]
+           "Perplexity", "PearsonCorrelation", "PCC", "Loss",
+           "CompositeEvalMetric", "CustomMetric", "create", "np"]
 
 _REGISTRY = {}
 
@@ -266,6 +266,46 @@ class PearsonCorrelation(EvalMetric):
         lab = np.concatenate(self._labels)
         pre = np.concatenate(self._preds)
         return self.name, float(np.corrcoef(lab, pre)[0, 1])
+
+
+@register
+class PCC(EvalMetric):
+    """Multiclass Pearson correlation / Matthews generalisation over the
+    confusion matrix (reference: metric.PCC)."""
+
+    def __init__(self, name="pcc", **kwargs):
+        super().__init__(name, **kwargs)
+
+    def reset(self):
+        super().reset()
+        self._cm = None
+
+    def update(self, labels, preds):
+        for label, pred in zip(_as_list(labels), _as_list(preds)):
+            lab = _to_np(label).ravel().astype(np.int64)
+            p = _to_np(pred)
+            cls = p.argmax(-1).ravel().astype(np.int64) if p.ndim > 1 \
+                else (p.ravel() > 0.5).astype(np.int64)
+            k = int(max(lab.max(initial=0), cls.max(initial=0))) + 1
+            if self._cm is None or self._cm.shape[0] < k:
+                new = np.zeros((k, k), np.float64)
+                if self._cm is not None:
+                    new[:self._cm.shape[0], :self._cm.shape[1]] = self._cm
+                self._cm = new
+            np.add.at(self._cm, (lab, cls), 1)
+            self.num_inst += lab.size
+
+    def get(self):
+        if self._cm is None:
+            return self.name, float("nan")
+        c = self._cm
+        n = c.sum()
+        t = c.sum(axis=1)   # true counts
+        p = c.sum(axis=0)   # predicted counts
+        cov_tp = np.trace(c) * n - (t * p).sum()
+        denom = np.sqrt(n * n - (p * p).sum()) * \
+            np.sqrt(n * n - (t * t).sum())
+        return self.name, float(cov_tp / denom) if denom else float("nan")
 
 
 @register

@@ -36,7 +36,7 @@ from .ndarray import NDArray, _apply
 __all__ = ["foreach", "while_loop", "cond",
            "interleaved_matmul_selfatt_qk",
            "interleaved_matmul_selfatt_valatt", "div_sqrt_dim",
-           "arange_like", "index_copy", "index_array"]
+           "arange_like", "index_copy", "index_array", "boolean_mask"]
 
 
 def _is_traced(nds):
@@ -347,6 +347,19 @@ def index_copy(old_tensor, index_vector, new_tensor, **kw):
     def fn(old, idx, new):
         return old.at[idx.astype(jnp.int32)].set(new)
     return _apply(fn, [old_tensor, index_vector, new_tensor])
+
+
+def boolean_mask(data, index, axis=0, **kw):
+    """Rows of `data` where `index` is nonzero (reference:
+    contrib.boolean_mask). Eager-only: the output length is
+    data-dependent, which cannot live under jit (SURVEY §8 pattern —
+    use nd.where/SequenceMask inside compiled code)."""
+    import numpy as _onp
+    mask = _onp.asarray(index._data).astype(bool)
+    idx = _onp.nonzero(mask)[0]
+    def fn(x, _i=jnp.asarray(idx, jnp.int32)):
+        return jnp.take(x, _i, axis=axis)
+    return _apply(fn, [data])
 
 
 def index_array(data, axes=None, **kw):

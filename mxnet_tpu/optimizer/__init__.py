@@ -1,6 +1,6 @@
 """mx.optimizer namespace (reference: python/mxnet/optimizer/)."""
 from .optimizer import (Optimizer, SGD, NAG, Adam, AdamW, Adamax, Nadam,
-                        AdaGrad, AdaDelta, RMSProp, Ftrl, LAMB, LARS, Signum,
-                        SGLD, DCASGD, create, register)
+                        AdaGrad, AdaDelta, RMSProp, Ftrl, Ftml, LAMB, LARS,
+                        Signum, SGLD, DCASGD, create, register)
 from . import optimizer as opt
 from .updater import Updater, get_updater

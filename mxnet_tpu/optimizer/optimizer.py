@@ -19,8 +19,8 @@ import numpy as np
 from ..base import MXNetError
 
 __all__ = ["Optimizer", "SGD", "NAG", "Adam", "AdamW", "Adamax", "Nadam",
-           "AdaGrad", "AdaDelta", "RMSProp", "Ftrl", "LAMB", "LARS", "Signum",
-           "SGLD", "DCASGD", "create", "register",
+           "AdaGrad", "AdaDelta", "RMSProp", "Ftrl", "Ftml", "LAMB", "LARS",
+           "Signum", "SGLD", "DCASGD", "create", "register",
            "fused_sgd_mom_kernel", "multi_sgd_mom_update",
            "multi_sgd_update"]
 
@@ -336,6 +336,35 @@ class Ftrl(Optimizer):
             / ((self.beta + jnp.sqrt(n)) / lr + wd),
             0.0).astype(w.dtype)
         return new_w, (z, n)
+
+
+@register
+class Ftml(Optimizer):
+    """Follow The Moving Leader (reference: optimizer.Ftml,
+    ftml_update.cc): adaptive per-coordinate learning rates with a
+    shifting regularizer — Adam-like state (v, z, d) plus the step
+    counter for bias correction."""
+
+    def __init__(self, learning_rate=0.0025, beta1=0.6, beta2=0.999,
+                 epsilon=1e-8, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def init_state(self, w):
+        return (jnp.zeros_like(w), jnp.zeros_like(w), jnp.zeros_like(w),
+                jnp.zeros((), jnp.int32))
+
+    def apply(self, w, g, state, lr, wd):
+        v, z, d_prev, t = state
+        t = t + 1
+        tf = t.astype(jnp.float32)
+        g = g + wd * w
+        v = self.beta2 * v + (1 - self.beta2) * g * g
+        d = (1 - self.beta1 ** tf) / lr * (
+            jnp.sqrt(v / (1 - self.beta2 ** tf)) + self.epsilon)
+        sigma = d - self.beta1 * d_prev
+        z = self.beta1 * z + (1 - self.beta1) * g - sigma * w
+        return (-z / d).astype(w.dtype), (v, z, d, t)
 
 
 @register

@@ -13,7 +13,7 @@ from collections import defaultdict
 import jax
 
 __all__ = ["set_config", "start", "stop", "pause", "resume", "dumps",
-           "Scope", "record_op"]
+           "dump", "Scope", "record_op"]
 
 _state = {"dir": "/tmp/mxtpu_profile", "running": False,
           "ops": defaultdict(lambda: [0, 0.0]), "t0": None}
@@ -69,6 +69,14 @@ def dumps(reset=False):
     if reset:
         _state["ops"].clear()
     return "\n".join(lines)
+
+
+def dump(finished=True, profile_process="worker"):
+    """Reference profiler.dump: write the op table to stderr (the
+    reference writes its json trace file; jax.profiler owns trace files
+    here, so dump surfaces the host-side op accounting)."""
+    import sys
+    print(dumps(), file=sys.stderr)
 
 
 @contextlib.contextmanager

@@ -178,3 +178,10 @@ def test_foreach_rnn_like_scan_under_hybrid_trace():
                                rtol=1e-5, atol=1e-5)
     np.testing.assert_allclose(np.asarray(f_t), fin_i.asnumpy(),
                                rtol=1e-5, atol=1e-5)
+
+
+def test_boolean_mask_eager():
+    from mxnet_tpu.ndarray import contrib
+    data = nd.array([[1.0, 2], [3, 4], [5, 6]])
+    out = contrib.boolean_mask(data, nd.array([1.0, 0, 1]))
+    np.testing.assert_allclose(out.asnumpy(), [[1, 2], [5, 6]])

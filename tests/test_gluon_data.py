@@ -124,3 +124,26 @@ def test_filter_sampler_and_random_hue():
     jitter = transforms.RandomColorJitter(brightness=0.1, hue=0.1)
     assert len(jitter._ts) == 2
     assert jitter(img).shape == (8, 8, 3)
+
+
+def test_image_list_dataset(tmp_path):
+    import os
+    from PIL import Image
+    from mxnet_tpu.gluon.data.vision import ImageListDataset
+    os.makedirs(os.path.join(tmp_path, "imgs"), exist_ok=True)
+    lst = os.path.join(tmp_path, "data.lst")
+    with open(lst, "w") as f:
+        for i in range(3):
+            p = os.path.join("imgs", f"im{i}.png")
+            Image.new("RGB", (8, 8), (i * 40, 0, 0)).save(
+                os.path.join(tmp_path, p))
+            f.write(f"{i}\t{i % 2}\t{p}\n")
+    ds = ImageListDataset(root=str(tmp_path), imglist=lst)
+    assert len(ds) == 3
+    img, label = ds[2]
+    assert img.shape == (8, 8, 3) and label == 0.0
+    # in-memory list form
+    ds2 = ImageListDataset(root=str(tmp_path),
+                           imglist=[[1.0, "imgs/im0.png"]])
+    img2, label2 = ds2[0]
+    assert label2 == 1.0 and img2.shape == (8, 8, 3)

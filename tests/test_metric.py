@@ -82,3 +82,21 @@ def test_create_by_name():
     assert isinstance(m, metric.Accuracy)
     m2 = metric.create("top_k_accuracy", top_k=3)
     assert m2.top_k == 3
+
+
+def test_pcc_matches_mcc_binary_and_handles_multiclass():
+    """Binary PCC == MCC (its generalisation); multiclass gives a finite
+    correlation in [-1, 1], 1.0 for perfect predictions."""
+    pcc = mx.metric.create("pcc")
+    preds = nd.array([[0.9, 0.1], [0.2, 0.8], [0.7, 0.3], [0.4, 0.6]])
+    labels = nd.array([0, 1, 1, 1])
+    pcc.update([labels], [preds])
+    mcc = mx.metric.MCC()
+    mcc.update([labels], [preds])
+    assert pcc.get()[1] == pytest.approx(mcc.get()[1], rel=1e-6)
+
+    pcc3 = mx.metric.PCC()
+    lab3 = nd.array([0, 1, 2, 1, 0])
+    perfect = nd.one_hot(lab3, 3)
+    pcc3.update([lab3], [perfect])
+    assert pcc3.get()[1] == pytest.approx(1.0)

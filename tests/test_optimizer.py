@@ -8,7 +8,7 @@ from mxnet_tpu import optimizer as opt
 from mxnet_tpu import lr_scheduler as lrs
 
 ALL_OPTS = ["sgd", "nag", "adam", "adamw", "adamax", "nadam", "adagrad",
-            "adadelta", "rmsprop", "ftrl", "lamb", "lars", "signum"]
+            "adadelta", "rmsprop", "ftrl", "ftml", "lamb", "lars", "signum"]
 
 
 @pytest.mark.parametrize("name", ALL_OPTS)
@@ -17,7 +17,7 @@ def test_create_and_converge_quadratic(name):
     o = opt.create(name, learning_rate=0.1)
     w = nd.ones((4,))
     state = o.create_state(0, w)
-    for _ in range(150):
+    for _ in range(400):
         grad = nd.array(w.asnumpy())      # df/dw = w
         o.update(0, w, grad, state)
     final = np.abs(w.asnumpy()).max()
@@ -172,3 +172,17 @@ def test_multi_tensor_sgd_matches_per_tensor():
     multi_sgd_update(ws2, gs2, lr=0.5)
     np.testing.assert_allclose(ws2[0].asnumpy(),
                                w0 - 0.5 * gs2[0].asnumpy(), rtol=1e-6)
+
+
+def test_ftml_converges_quadratic():
+    """FTML minimises a simple quadratic (reference ftml_update rules:
+    w = -z/d after the shifting-regularizer update)."""
+    opt = mx.optimizer.create("ftml", learning_rate=0.1)
+    w = nd.array([5.0, -3.0])
+    state = opt.init_state(w._data)
+    import jax.numpy as jnp
+    for _ in range(400):
+        g = 2 * w._data              # d/dw of w^2
+        new_w, state = opt.apply(w._data, g, state, 0.1, 0.0)
+        w = nd.NDArray(new_w)
+    assert float(nd.norm(w).asnumpy()) < 0.01
