@@ -60,9 +60,35 @@ for _n, _b in _models.items():
 del _n, _b
 
 
+# detection constructors (gluoncv get_model names) resolve lazily —
+# the models package imports heavier pieces than the classification zoo
+_DETECTION = {
+    "yolo3_darknet53": ("mxnet_tpu.models.yolo", "yolo3_darknet53"),
+    "yolo3_darknet53_voc": ("mxnet_tpu.models.yolo", "yolo3_darknet53"),
+    "yolo3_darknet53_coco": ("mxnet_tpu.models.yolo", "yolo3_darknet53"),
+    "ssd_512_resnet50_v1": ("mxnet_tpu.models.ssd", "ssd_512_resnet50_v1"),
+    "ssd_512_resnet50_v1_voc": ("mxnet_tpu.models.ssd",
+                                "ssd_512_resnet50_v1"),
+}
+
+
 def get_model(name, **kwargs):
-    """Create a model by name (reference: model_zoo.vision.get_model)."""
+    """Create a model by name (reference: model_zoo.vision.get_model,
+    plus the gluoncv detection names)."""
     name = name.lower()
+    if name in _DETECTION:
+        import importlib
+        mod, fn = _DETECTION[name]
+        if kwargs.pop("pretrained", False):
+            raise ValueError(
+                f"{name}: no pretrained detection weights ship in this "
+                "offline environment — train from scratch or load your "
+                "own via load_parameters")
+        if name.endswith("_coco"):
+            kwargs.setdefault("num_classes", 80)
+        return getattr(importlib.import_module(mod), fn)(**kwargs)
     if name not in _models:
-        raise ValueError(f"model {name!r} not in zoo: {sorted(_models)}")
+        raise ValueError(
+            f"model {name!r} not in zoo: "
+            f"{sorted(_models) + sorted(_DETECTION)}")
     return _models[name](**kwargs)

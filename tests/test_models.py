@@ -445,3 +445,20 @@ def test_yolo3_per_class_nms_and_ignore_mask():
     l_with_gt = lossfn(outs, *targets, gt_boxes=gt)
     # removing the false-negative penalty must LOWER the loss
     assert float(l_with_gt.asnumpy()) < float(l_no_gt.asnumpy())
+
+
+def test_get_model_detection_names():
+    from mxnet_tpu.gluon.model_zoo.vision import get_model
+    from mxnet_tpu.models.yolo import YOLOV3
+    from mxnet_tpu.models.ssd import SSD
+    y = get_model("yolo3_darknet53", num_classes=3, input_size=64)
+    assert isinstance(y, YOLOV3) and y.num_classes == 3
+    yc = get_model("yolo3_darknet53_coco", input_size=64)
+    assert yc.num_classes == 80
+    s = get_model("ssd_512_resnet50_v1", num_classes=4, input_size=128,
+                  backbone_layers=18)
+    assert isinstance(s, SSD)
+    with pytest.raises(ValueError, match="not in zoo"):
+        get_model("not_a_model")
+    with pytest.raises(ValueError, match="pretrained"):
+        get_model("yolo3_darknet53", pretrained=True, input_size=64)
