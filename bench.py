@@ -135,7 +135,6 @@ def main():
     # perf lever (BENCH_FUSED_SGD=1, measured 2026-07-31: REJECTED at
     # batch 128, -5.5% — see docs/PERF.md lever verdicts)
     fused = os.environ.get("BENCH_FUSED_SGD") == "1"
-    t_sweep = time.monotonic()
     # later candidates only start while comfortably inside the worker
     # timeout — a half-finished sweep must never eat the whole attempt
     SWEEP_BUDGET_S = 300
@@ -191,30 +190,17 @@ def main():
               f"-> {img_s:.1f} img/s", file=sys.stderr)
         return img_s
 
-    best_img_s, best_batch = 0.0, candidates[0]
-    for i, batch in enumerate(candidates):
-        if i > 0 and time.monotonic() - t_sweep > SWEEP_BUDGET_S:
-            print(f"[bench] sweep budget spent; skipping batch {batch}",
-                  file=sys.stderr)
-            continue
-        try:
-            img_s = measure(batch)
-        except Exception as e:  # e.g. OOM at the larger batch
-            print(f"[bench] batch {batch} failed: {e!r}", file=sys.stderr)
-            continue
-        if img_s > best_img_s:
-            best_img_s, best_batch = img_s, batch
-            # checkpoint the best-so-far on stdout: the supervisor keeps
-            # the LAST parseable JSON line, so if a later candidate (or
-            # BERT) wedges the tunnel, this measurement still lands
-            print(json.dumps({
-                "metric": "resnet50_train_throughput",
-                "value": round(best_img_s, 2),
-                "unit": "images/sec/chip",
-                "vs_baseline": round(best_img_s / BASELINE_IMG_S, 4)}),
-                flush=True)
-    if best_img_s == 0.0:
-        raise RuntimeError("no batch candidate completed")
+    from bench_util import sweep
+
+    def checkpoint_resnet(img_s):
+        print(json.dumps({
+            "metric": "resnet50_train_throughput",
+            "value": round(img_s, 2),
+            "unit": "images/sec/chip",
+            "vs_baseline": round(img_s / BASELINE_IMG_S, 4)}), flush=True)
+
+    best_img_s, best_batch = sweep(candidates, SWEEP_BUDGET_S, measure,
+                                   on_best=checkpoint_resnet, tag="bench")
     print(f"[bench] best: batch={best_batch} {best_img_s:.1f} img/s",
           file=sys.stderr)
     result = {
@@ -230,7 +216,14 @@ def main():
     if not smoke and os.environ.get("BENCH_SKIP_BERT") != "1":
         try:
             import bench_bert
-            result["extra_metrics"] = [bench_bert.measure()]
+
+            def checkpoint(bert_res):
+                merged = dict(result)
+                merged["extra_metrics"] = [bert_res]
+                print(json.dumps(merged), flush=True)
+
+            result["extra_metrics"] = [
+                bench_bert.measure(on_result=checkpoint)]
         except Exception as e:  # pragma: no cover
             print(f"[bench] bert bench failed: {e!r}", file=sys.stderr)
 

@@ -1,0 +1,42 @@
+"""Shared candidate-sweep protocol for the benchmark workers.
+
+One implementation of the budget-gated, failure-tolerant sweep both
+bench.py (ResNet batch sizes) and bench_bert.py (BERT batch sizes) run:
+- candidates after the first only START inside `budget_s` (a slow
+  compile can't eat the supervisor's per-attempt timeout);
+- a failing candidate (e.g. OOM at the larger batch) is skipped, never
+  fatal, as long as at least one candidate lands;
+- `on_best(value)` fires whenever the best-so-far improves, letting the
+  caller checkpoint its JSON line (the supervisor keeps the LAST
+  parseable stdout line, so a wedged later candidate can't lose a
+  completed measurement).
+"""
+from __future__ import annotations
+
+import sys
+import time
+
+
+def sweep(candidates, budget_s, run_one, on_best=None, tag="bench"):
+    """Run `run_one(candidate) -> float` over candidates; return
+    (best_value, best_candidate). Raises RuntimeError if none land."""
+    best, best_cand = 0.0, None
+    t_start = time.monotonic()
+    for i, cand in enumerate(candidates):
+        if i > 0 and time.monotonic() - t_start > budget_s:
+            print(f"[{tag}] sweep budget spent; skipping {cand}",
+                  file=sys.stderr)
+            continue
+        try:
+            value = run_one(cand)
+        except Exception as e:  # e.g. OOM at the larger candidate
+            print(f"[{tag}] candidate {cand} failed: {e!r}",
+                  file=sys.stderr)
+            continue
+        if value > best:
+            best, best_cand = value, cand
+            if on_best is not None:
+                on_best(best)
+    if best_cand is None:
+        raise RuntimeError(f"[{tag}] no sweep candidate completed")
+    return best, best_cand

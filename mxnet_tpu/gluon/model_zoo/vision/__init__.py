@@ -84,9 +84,16 @@ def get_model(name, **kwargs):
                 f"{name}: no pretrained detection weights ship in this "
                 "offline environment — train from scratch or load your "
                 "own via load_parameters")
+        # gluoncv get_model signature compatibility: ctx/root are
+        # accepted everywhere; placement is XLA's job here
+        ctx = kwargs.pop("ctx", None)
+        kwargs.pop("root", None)
         if name.endswith("_coco"):
             kwargs.setdefault("num_classes", 80)
-        return getattr(importlib.import_module(mod), fn)(**kwargs)
+        net = getattr(importlib.import_module(mod), fn)(**kwargs)
+        if ctx is not None:
+            net.collect_params().reset_ctx(ctx)
+        return net
     if name not in _models:
         raise ValueError(
             f"model {name!r} not in zoo: "

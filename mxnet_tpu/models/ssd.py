@@ -158,5 +158,4 @@ def _softmax(x):
 
 
 def ssd_512_resnet50_v1(num_classes=20, **kwargs):
-    kwargs.setdefault("backbone_layers", 50)
     return SSD(num_classes=num_classes, **kwargs)
