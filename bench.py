@@ -23,10 +23,12 @@ import time
 
 BASELINE_IMG_S = 2500.0
 
-# ~5 min of total backoff across 6 attempts, per VERDICT r2 item 1.
-RETRY_SLEEPS = [5, 15, 30, 60, 90]
+# backoff tail sized for the tunnel's observed outage pattern (it flaps
+# on minutes-to-hours scales): 8 attempts, ~10 min of sleeps, and a
+# 40-minute overall deadline. Per VERDICT r2 item 1.
+RETRY_SLEEPS = [5, 15, 30, 60, 90, 150, 240]
 WORKER_TIMEOUT_S = 600     # per attempt: a healthy run takes ~2-4 min
-DEADLINE_S = 1500          # stop STARTING attempts past this wall-clock
+DEADLINE_S = 2400          # stop STARTING attempts past this wall-clock
 
 
 def supervise() -> int:

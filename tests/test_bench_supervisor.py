@@ -84,7 +84,8 @@ def test_supervisor_takes_last_checkpoint_line(monkeypatch):
 
 def test_supervisor_all_attempts_fail(monkeypatch):
     crash = subprocess.CompletedProcess([], 1, stdout=b"")
-    rc, printed = _run_supervise(monkeypatch, [crash] * 6)
+    rc, printed = _run_supervise(monkeypatch,
+                                 [crash] * (len(bench.RETRY_SLEEPS) + 1))
     assert rc == 1 and printed == []
 
 
