@@ -6,10 +6,13 @@ Submodules import lazily (BERT/Transformer/SSD are sizeable):
   models.transformer  — Transformer NMT seq2seq (Sockeye parity)
   models.ssd          — SSD-512 detection (GluonCV parity)
   models.faster_rcnn  — Faster-RCNN detection (GluonCV parity)
+  models.yolo         — YOLOv3 detection (GluonCV parity)
+  models.fcn          — FCN-8s/16s/32s segmentation (example/fcn-xs parity)
 """
 import importlib
 
-__all__ = ["mlp", "bert", "transformer", "ssd", "faster_rcnn", "yolo"]
+__all__ = ["mlp", "bert", "transformer", "ssd", "faster_rcnn", "yolo",
+           "fcn"]
 
 
 def __getattr__(name):

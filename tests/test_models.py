@@ -462,3 +462,52 @@ def test_get_model_detection_names():
         get_model("not_a_model")
     with pytest.raises(ValueError, match="pretrained"):
         get_model("yolo3_darknet53", pretrained=True, input_size=64)
+
+
+# ---------------------------------------------------------------------------
+# FCN segmentation
+# ---------------------------------------------------------------------------
+def test_fcn_shapes_and_overfit_one_image():
+    """FCN-8s emits per-pixel logits at input resolution and can overfit
+    a single synthetic mask (reference example/fcn-xs training loop)."""
+    from mxnet_tpu.models.fcn import FCN
+    size, C = 64, 3
+    net = FCN(num_classes=C, backbone_layers=18, input_size=size, stride=8)
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    x = nd.random.uniform(shape=(2, size, size, 3))
+    out = net(x)
+    assert out.shape == (2, size, size, C)
+    # stride variants share the contract
+    for s in (16, 32):
+        n2 = FCN(num_classes=C, backbone_layers=18, input_size=size,
+                 stride=s)
+        n2.initialize(mx.init.Xavier())
+        assert n2(x).shape == (2, size, size, C)
+
+    # overfit: left half class 1, right half class 2
+    mask = np.ones((1, size, size), np.float32)
+    mask[:, :, size // 2:] = 2
+    y = nd.array(mask)
+    lf = gluon.loss.SoftmaxCrossEntropyLoss(axis=-1)
+    tr = gluon.Trainer(net.collect_params(), "adam",
+                       {"learning_rate": 3e-3})
+    img = nd.random.uniform(shape=(1, size, size, 3))
+    losses = []
+    for _ in range(12):
+        with autograd.record():
+            logits = net(img)
+            loss = lf(logits.reshape((-1, C)), y.reshape((-1,))).mean()
+        loss.backward()
+        tr.step(1)
+        losses.append(float(loss.asnumpy()))
+    assert losses[-1] < losses[0] * 0.7
+    pred = np.argmax(net(img).asnumpy(), -1)
+    acc = (pred == mask).mean()
+    assert acc > 0.8, acc
+
+
+def test_fcn_rejects_bad_input_size():
+    from mxnet_tpu.models.fcn import FCN
+    with pytest.raises(mx.base.MXNetError, match="divisible by 32"):
+        FCN(num_classes=3, input_size=100)
