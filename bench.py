@@ -114,12 +114,14 @@ def main():
     if smoke or not on_tpu:
         candidates, steps = [8], 3
     else:
-        # batch sweep: larger batches fill the MXU better; measure both
-        # and report the best (reference-class numbers likewise pick
-        # their best per-chip batch). BENCH_BATCH pins a single size.
-        candidates, steps = [128, 256], 30
+        # the round-2 on-chip sweep located the optimum: 96→2498, 128→2711,
+        # 160→2293, 192→2427, 256→2352 img/s (docs/PERF.md) — larger
+        # batches LOSE on this chip, so the default measures the known
+        # best only. BENCH_BATCH=a or BENCH_BATCH=a,b re-opens the sweep.
+        candidates, steps = [128], 30
     if os.environ.get("BENCH_BATCH"):
-        candidates = [int(os.environ["BENCH_BATCH"])]
+        candidates = [int(b) for b in
+                      os.environ["BENCH_BATCH"].split(",")]
     steps = int(os.environ.get("BENCH_STEPS", steps))
     print(f"[bench] backend={jax.default_backend()} "
           f"candidates={candidates} steps={steps}", file=sys.stderr)
