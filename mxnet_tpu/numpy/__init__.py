@@ -522,6 +522,65 @@ def nonzero(a):
     return tuple(ndarray(i) for i in jnp.nonzero(_c(a)._data))
 
 
+def histogram(a, bins=10, range=None):
+    return _apply(lambda x: tuple(jnp.histogram(x, bins=bins,
+                                                range=range)),
+                  [_c(a)], n_out=2)
+
+
+def bincount(a, weights=None, minlength=0):
+    """Eager-only when minlength doesn't cover the data (output length
+    is data-dependent — SURVEY §8)."""
+    from ..ops.compat_ops import bincount as _bc
+    return _bc(_c(a), weights=None if weights is None else _c(weights),
+               minlength=minlength)
+
+
+def percentile(a, q, axis=None, keepdims=False):
+    return _apply(lambda x: jnp.percentile(x, q, axis=axis,
+                                           keepdims=keepdims), [_c(a)])
+
+
+def quantile(a, q, axis=None, keepdims=False):
+    return _apply(lambda x: jnp.quantile(x, q, axis=axis,
+                                         keepdims=keepdims), [_c(a)])
+
+
+def digitize(x, bins, right=False):
+    return _apply(lambda a, b: jnp.digitize(a, b, right=right),
+                  [_c(x), _c(bins)])
+
+
+def searchsorted(a, v, side="left"):
+    return _apply(lambda x, q: jnp.searchsorted(x, q, side=side),
+                  [_c(a), _c(v)])
+
+
+def count_nonzero(a, axis=None, keepdims=False):
+    return _apply(lambda x: jnp.count_nonzero(x, axis=axis,
+                                              keepdims=keepdims), [_c(a)])
+
+
+def argwhere(a):
+    """Eager-only (data-dependent shape — SURVEY §8)."""
+    return ndarray(jnp.argwhere(_c(a)._data))
+
+
+def flatnonzero(a):
+    """Eager-only (data-dependent shape — SURVEY §8)."""
+    return ndarray(jnp.flatnonzero(_c(a)._data))
+
+
+def interp(x, xp, fp):
+    return _apply(lambda a, b, c: jnp.interp(a, b, c),
+                  [_c(x), _c(xp), _c(fp)])
+
+
+__all__ += ["histogram", "bincount", "percentile", "quantile", "digitize",
+            "searchsorted", "count_nonzero", "argwhere", "flatnonzero",
+            "interp"]
+
+
 def isclose(a, b, rtol=1e-5, atol=1e-8, equal_nan=False):
     return _apply(lambda x, y: jnp.isclose(x, y, rtol=rtol, atol=atol,
                                            equal_nan=equal_nan),

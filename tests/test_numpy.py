@@ -324,3 +324,32 @@ def test_np_arrays_under_jit_and_mesh():
     b = np.ndarray(sharded)                # np view over a sharded array
     assert isinstance(b + 1, np.ndarray)
     onp.testing.assert_allclose((b + 1).asnumpy(), a.asnumpy() + 1)
+
+
+def test_histogram_percentile_search_family():
+    x = onp.random.RandomState(9).rand(200).astype(onp.float32)
+    a = np.array(x)
+    counts, edges = np.histogram(a, bins=8, range=(0, 1))
+    ref_c, ref_e = onp.histogram(x, bins=8, range=(0, 1))
+    onp.testing.assert_allclose(counts.asnumpy(), ref_c)
+    onp.testing.assert_allclose(edges.asnumpy(), ref_e, rtol=1e-6)
+    onp.testing.assert_allclose(np.percentile(a, 50).item(),
+                                onp.percentile(x, 50), rtol=1e-5)
+    onp.testing.assert_allclose(np.quantile(a, 0.25).item(),
+                                onp.quantile(x, 0.25), rtol=1e-5)
+    bins = np.array([0.25, 0.5, 0.75])
+    onp.testing.assert_allclose(np.digitize(a, bins).asnumpy(),
+                                onp.digitize(x, bins.asnumpy()))
+    srt = np.sort(a)
+    onp.testing.assert_allclose(
+        np.searchsorted(srt, np.array([0.1, 0.9])).asnumpy(),
+        onp.searchsorted(onp.sort(x), [0.1, 0.9]))
+    assert np.count_nonzero(np.array([0, 1, 2, 0])).item() == 2
+    onp.testing.assert_allclose(
+        np.argwhere(np.array([0, 3, 0, 5])).asnumpy(), [[1], [3]])
+    assert np.flatnonzero(np.array([0, 1, 0, 2])).asnumpy().tolist() == [1, 3]
+    bc = np.bincount(np.array([0, 1, 1, 4], dtype="int32"))
+    assert bc.asnumpy().tolist() == [1, 2, 0, 0, 1]
+    onp.testing.assert_allclose(
+        np.interp(np.array([0.5]), np.array([0.0, 1.0]),
+                  np.array([10.0, 20.0])).asnumpy(), [15.0])
