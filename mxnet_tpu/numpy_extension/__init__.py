@@ -27,7 +27,8 @@ from ..ops import tensor_ops as _t
 __all__ = ["set_np", "reset_np", "is_np_array", "is_np_shape", "np_shape",
            "np_array", "use_np", "softmax", "log_softmax", "masked_softmax",
            "relu", "sigmoid", "gelu", "one_hot", "pick", "topk", "batch_dot",
-           "reshape_like", "batch_flatten", "fully_connected", "convolution",
+           "reshape_like", "gather_nd", "scatter_nd", "slice", "reshape",
+           "batch_flatten", "fully_connected", "convolution",
            "pooling", "batch_norm", "layer_norm", "dropout", "embedding",
            "activation", "leaky_relu", "arange_like", "gamma", "sequence_mask",
            "waitall", "save", "load", "seed"]
@@ -111,6 +112,10 @@ pick = _t.pick
 one_hot = _t.one_hot
 topk = _t.topk
 reshape_like = _t.reshape_like
+gather_nd = _t.gather_nd
+scatter_nd = _t.scatter_nd
+slice = _t.slice           # noqa: A001  (reference npx name)
+reshape = _t.reshape
 
 
 def gelu(data, approximation="erf"):
