@@ -178,3 +178,31 @@ def test_log_second_filename_attaches(tmp_path):
     for h in lg.handlers:
         h.flush()
     assert "hello" in open(f2).read()
+
+
+def test_parse_log_tool(tmp_path):
+    """tools/parse_log.py parses Speedometer/validation lines (reference
+    tools/parse_log.py contract)."""
+    import os
+    import subprocess
+    import sys as _sys
+    log = os.path.join(tmp_path, "train.log")
+    with open(log, "w") as f:
+        f.write(
+            "INFO:root:Epoch[0] Batch [50] Speed: 2500.00 samples/sec\t"
+            "accuracy=0.800000\n"
+            "INFO:root:Epoch[0] Batch [100] Speed: 2700.00 samples/sec\t"
+            "accuracy=0.850000\n"
+            "INFO:root:Epoch[0] Validation-accuracy=0.820000\n"
+            "INFO:root:Epoch[1] Batch [50] Speed: 2600.00 samples/sec\t"
+            "accuracy=0.900000\n")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = subprocess.run(
+        [_sys.executable, os.path.join(repo, "tools", "parse_log.py"),
+         log, "--format", "csv"],
+        capture_output=True, text=True, timeout=60)
+    assert out.returncode == 0, out.stderr
+    lines = out.stdout.strip().splitlines()
+    assert lines[0] == "epoch,speed(avg),train-accuracy,val-accuracy"
+    assert lines[1].startswith("0,2600.0,0.85000,0.82000")
+    assert lines[2].startswith("1,2600.0,0.90000,nan")

@@ -1,0 +1,79 @@
+#!/usr/bin/env python
+"""Parse training logs into a metric table (reference: tools/parse_log.py).
+
+Reads Speedometer/epoch lines as produced by mx.callback.Speedometer and
+Module.fit logging:
+
+    INFO:root:Epoch[3] Batch [200] Speed: 2701.52 samples/sec  accuracy=0.93
+    INFO:root:Epoch[3] Validation-accuracy=0.91
+
+Usage: python tools/parse_log.py train.log [--format markdown|csv]
+"""
+from __future__ import annotations
+
+import argparse
+import re
+import sys
+from collections import defaultdict
+
+_BATCH = re.compile(
+    r"Epoch\[(\d+)\].*?Speed:\s*([\d.]+)\s*samples/sec(.*)$")
+_METRIC = re.compile(r"(\w[\w-]*)=([\d.eE+-]+)")
+_VAL = re.compile(r"Epoch\[(\d+)\]\s+Validation-(\w[\w-]*)=([\d.eE+-]+)")
+
+
+def parse(lines):
+    """-> {epoch: {"speed": [..], "train": {m: last}, "val": {m: v}}}"""
+    epochs = defaultdict(lambda: {"speed": [], "train": {}, "val": {}})
+    for line in lines:
+        m = _BATCH.search(line)
+        if m:
+            ep = int(m.group(1))
+            epochs[ep]["speed"].append(float(m.group(2)))
+            for name, val in _METRIC.findall(m.group(3)):
+                epochs[ep]["train"][name] = float(val)
+            continue
+        v = _VAL.search(line)
+        if v:
+            epochs[int(v.group(1))]["val"][v.group(2)] = float(v.group(3))
+    return dict(epochs)
+
+
+def render(epochs, fmt="markdown"):
+    train_keys = sorted({k for e in epochs.values() for k in e["train"]})
+    val_keys = sorted({k for e in epochs.values() for k in e["val"]})
+    header = (["epoch", "speed(avg)"] + [f"train-{k}" for k in train_keys]
+              + [f"val-{k}" for k in val_keys])
+    rows = []
+    for ep in sorted(epochs):
+        e = epochs[ep]
+        speed = sum(e["speed"]) / len(e["speed"]) if e["speed"] else float("nan")
+        rows.append([str(ep), f"{speed:.1f}"]
+                    + [f"{e['train'].get(k, float('nan')):.5f}"
+                       for k in train_keys]
+                    + [f"{e['val'].get(k, float('nan')):.5f}"
+                       for k in val_keys])
+    if fmt == "csv":
+        return "\n".join(",".join(r) for r in [header] + rows)
+    sep = ["---"] * len(header)
+    return "\n".join("| " + " | ".join(r) + " |"
+                     for r in [header, sep] + rows)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("logfile")
+    ap.add_argument("--format", choices=("markdown", "csv"),
+                    default="markdown")
+    args = ap.parse_args()
+    with open(args.logfile) as f:
+        epochs = parse(f)
+    if not epochs:
+        print("no Speedometer/epoch lines found", file=sys.stderr)
+        return 1
+    print(render(epochs, args.format))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
