@@ -82,11 +82,16 @@ class CompositeConfig(NamedTuple):
 # mesh construction
 # ---------------------------------------------------------------------------
 def make_composite_mesh(n_devices, priority=("dp", "tp", "sp", "pp", "ep"),
-                        devices=None):
+                        devices=None, n_layers=None):
     """Factorise n_devices over the 5 axes (unused axes get size 1).
 
     Prime factors are dealt round-robin to `priority` so as many axes as
     possible are >1 (e.g. 8 -> dp2*tp2*sp2; 16 -> dp2*tp2*sp2*pp2).
+
+    Pass `n_layers` to keep the factorisation pp-compatible with your
+    model: any factor that would make `pp` stop dividing `n_layers`
+    is dealt to the next axis in `priority` instead (GPipe needs
+    n_layers % pp == 0 — see make_composite_train_step).
     """
     sizes = {ax: 1 for ax in AXES}
     n = n_devices
@@ -100,7 +105,12 @@ def make_composite_mesh(n_devices, priority=("dp", "tp", "sp", "pp", "ep"),
     if n > 1:
         factors.append(n)
     for i, f in enumerate(sorted(factors, reverse=True)):
-        sizes[priority[i % len(priority)]] *= f
+        order = [priority[(i + j) % len(priority)]
+                 for j in range(len(priority))]
+        ax = next((a for a in order
+                   if a != "pp" or n_layers is None
+                   or n_layers % (sizes["pp"] * f) == 0), "dp")
+        sizes[ax] *= f
     devs = devices if devices is not None else jax.devices()[:n_devices]
     import numpy as np
     shape = tuple(sizes[ax] for ax in AXES)
@@ -373,18 +383,35 @@ def make_composite_train_step(mesh, cfg: CompositeConfig):
     5-axis-parallel causal-LM, compiled as a single XLA program over `mesh`.
     """
     mesh_shape = dict(mesh.shape)
-    assert cfg.n_layers % mesh_shape["pp"] == 0
-    assert cfg.n_heads % mesh_shape["tp"] == 0
-    assert cfg.d_ff % mesh_shape["tp"] == 0
-    assert cfg.seq_len % mesh_shape["sp"] == 0
-    assert cfg.n_experts % mesh_shape["ep"] == 0
-    assert cfg.batch % (mesh_shape["dp"] * cfg.n_micro) == 0
-    assert cfg.sp_strategy in ("ring", "alltoall"), \
-        f"unknown sp_strategy {cfg.sp_strategy!r}"
+    divisibility = [
+        ("n_layers", cfg.n_layers, "pp",
+         "pipeline stages each own n_layers/pp layers — rebuild the mesh "
+         "with make_composite_mesh(n, n_layers=...) to steer pp"),
+        ("n_heads", cfg.n_heads, "tp", "heads are column-split over tp"),
+        ("d_ff", cfg.d_ff, "tp", "the MLP hidden dim is split over tp"),
+        ("seq_len", cfg.seq_len, "sp", "the sequence is split over sp"),
+        ("n_experts", cfg.n_experts, "ep", "experts are sharded over ep"),
+    ]
+    for name, value, ax, why in divisibility:
+        if value % mesh_shape[ax] != 0:
+            raise ValueError(
+                f"CompositeConfig.{name}={value} is not divisible by the "
+                f"mesh's {ax}={mesh_shape[ax]}: {why}")
+    if cfg.batch % (mesh_shape["dp"] * cfg.n_micro) != 0:
+        raise ValueError(
+            f"CompositeConfig.batch={cfg.batch} must be divisible by "
+            f"dp*n_micro={mesh_shape['dp']}*{cfg.n_micro} (each dp shard "
+            "splits its local batch into n_micro pipeline microbatches)")
+    if cfg.sp_strategy not in ("ring", "alltoall"):
+        raise ValueError(f"unknown sp_strategy {cfg.sp_strategy!r}")
     if cfg.sp_strategy == "alltoall":
         # ulysses shards the tp-LOCAL head set over 'sp'
-        assert (cfg.n_heads // mesh_shape["tp"]) % mesh_shape["sp"] == 0, \
-            "alltoall sp needs tp-local heads divisible by sp size"
+        if (cfg.n_heads // mesh_shape["tp"]) % mesh_shape["sp"] != 0:
+            raise ValueError(
+                f"sp_strategy='alltoall' reshuffles the tp-local head set "
+                f"over sp: n_heads/tp={cfg.n_heads // mesh_shape['tp']} "
+                f"must be divisible by sp={mesh_shape['sp']} (use "
+                f"sp_strategy='ring' or adjust n_heads)")
 
     n_total_tokens = cfg.batch * cfg.seq_len
     specs = composite_param_specs()

@@ -1,11 +1,11 @@
 """The bench supervisor protocol (bench.py supervise + bench_util.sweep):
-the driver's measurement of record must survive crashing workers, hanging
-workers (stdout salvage), and flaky candidates. These pin the exact
-failure modes the axon tunnel produces (VERDICT r2 item 1)."""
+the driver's measurement of record must survive a dead tunnel (window
+hunting via cheap probes), crashing workers, hanging workers (stdout
+salvage), and flaky candidates. These pin the exact failure modes the
+axon tunnel produces (VERDICT r2 item 1, r3 item 1)."""
 import json
 import subprocess
 import sys
-import types
 
 import pytest
 
@@ -19,21 +19,34 @@ def _ok(stdout):
     return subprocess.CompletedProcess([], 0, stdout=stdout)
 
 
-def _run_supervise(monkeypatch, behaviors):
-    """Run supervise() with scripted per-attempt worker behaviors:
-    each entry is either a CompletedProcess, a TimeoutExpired, or an
-    exception instance. Returns (rc, printed_lines)."""
-    calls = iter(behaviors)
+def _run_supervise(monkeypatch, probes, workers, tick=1.0):
+    """Run supervise() with scripted probe results (bools; exhausting the
+    list repeats the last entry) and per-window worker behaviors (each a
+    CompletedProcess or TimeoutExpired). A fake clock advances `tick`
+    seconds per probe/sleep so deadline logic is testable without wall
+    time. Returns (rc, printed_json_lines, n_probes_used)."""
+    probe_iter = {"i": 0}
+    worker_iter = iter(workers)
+    clock = {"t": 0.0}
+
+    def fake_probe():
+        i = min(probe_iter["i"], len(probes) - 1)
+        probe_iter["i"] += 1
+        clock["t"] += tick
+        return probes[i]
 
     def fake_run(cmd, stdout=None, stderr=None, timeout=None):
-        b = next(calls)
+        b = next(worker_iter)
         if isinstance(b, BaseException):
             raise b
         return b
 
     printed = []
+    monkeypatch.setattr(bench, "probe_tunnel", fake_probe)
     monkeypatch.setattr(bench.subprocess, "run", fake_run)
-    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    monkeypatch.setattr(bench.time, "sleep",
+                        lambda s: clock.__setitem__("t", clock["t"] + s))
+    monkeypatch.setattr(bench.time, "monotonic", lambda: clock["t"])
     real_print = print
 
     def capture(*args, **kwargs):
@@ -44,20 +57,33 @@ def _run_supervise(monkeypatch, behaviors):
                                  if k != "file"}, file=sys.stderr)
     monkeypatch.setattr("builtins.print", capture)
     rc = bench.supervise()
-    return rc, printed
+    return rc, printed, probe_iter["i"]
 
 
 def test_supervisor_happy_path(monkeypatch):
     line = json.dumps({"metric": "m", "value": 1.0})
-    rc, printed = _run_supervise(monkeypatch, [_ok(line.encode())])
+    rc, printed, _ = _run_supervise(monkeypatch, [True], [_ok(line.encode())])
     assert rc == 0 and printed == [line]
 
 
-def test_supervisor_retries_after_crash(monkeypatch):
-    """UNAVAILABLE-style crash (rc!=0, no JSON) then success."""
+def test_supervisor_hunts_through_dead_window(monkeypatch):
+    """THE round-2/3 failure mode: tunnel dead for a while, then a
+    window opens. Dead probes must cost probe+sleep time, not 600s
+    worker timeouts, and the worker runs exactly once."""
+    line = json.dumps({"metric": "m", "value": 2.0})
+    rc, printed, n_probes = _run_supervise(
+        monkeypatch, [False, False, False, True], [_ok(line.encode())])
+    assert rc == 0 and printed == [line]
+    assert n_probes == 4
+
+
+def test_supervisor_retries_after_worker_crash(monkeypatch):
+    """UNAVAILABLE-style crash (rc!=0, no JSON) sends the supervisor
+    back to probing; the reopened window succeeds."""
     line = json.dumps({"metric": "m", "value": 2.0})
     crash = subprocess.CompletedProcess([], 1, stdout=b"boom\n")
-    rc, printed = _run_supervise(monkeypatch, [crash, _ok(line.encode())])
+    rc, printed, _ = _run_supervise(
+        monkeypatch, [True, True], [crash, _ok(line.encode())])
     assert rc == 0 and printed == [line]
 
 
@@ -67,7 +93,7 @@ def test_supervisor_salvages_hung_worker_stdout(monkeypatch):
     line = json.dumps({"metric": "m", "value": 3.0})
     hung = subprocess.TimeoutExpired(cmd=[], timeout=600,
                                      output=(line + "\n").encode())
-    rc, printed = _run_supervise(monkeypatch, [hung])
+    rc, printed, _ = _run_supervise(monkeypatch, [True], [hung])
     assert rc == 0 and printed == [line]
 
 
@@ -78,15 +104,44 @@ def test_supervisor_takes_last_checkpoint_line(monkeypatch):
     l2 = json.dumps({"metric": "m", "value": 2.0,
                      "extra_metrics": [{"metric": "b"}]})
     out = (l1 + "\n[noise] not json\n" + l2 + "\n").encode()
-    rc, printed = _run_supervise(monkeypatch, [_ok(out)])
+    rc, printed, _ = _run_supervise(monkeypatch, [True], [_ok(out)])
     assert rc == 0 and printed == [l2]
 
 
-def test_supervisor_all_attempts_fail(monkeypatch):
-    crash = subprocess.CompletedProcess([], 1, stdout=b"")
-    rc, printed = _run_supervise(monkeypatch,
-                                 [crash] * (len(bench.RETRY_SLEEPS) + 1))
+def test_supervisor_dead_tunnel_returns_rc1_inside_deadline(monkeypatch):
+    """Tunnel dead the whole window: rc=1 must come back (never a hang /
+    driver-side rc=124), with probes spaced PROBE_SLEEP_S apart so the
+    deadline buys ~deadline/(probe+sleep) windows."""
+    monkeypatch.setenv("BENCH_DEADLINE_S", "1200")
+    rc, printed, n_probes = _run_supervise(
+        monkeypatch, [False], [], tick=float(bench.PROBE_TIMEOUT_S))
     assert rc == 1 and printed == []
+    # each dead cycle costs <= PROBE_TIMEOUT_S + PROBE_SLEEP_S = 135s
+    # -> at least 8 windows inside 1200s (vs round 3's 3 blind attempts)
+    assert n_probes >= 8
+
+
+def test_supervisor_respects_env_deadline(monkeypatch):
+    monkeypatch.setenv("BENCH_DEADLINE_S", "100")
+    rc, printed, n_probes = _run_supervise(
+        monkeypatch, [False], [], tick=float(bench.PROBE_TIMEOUT_S))
+    assert rc == 1
+    assert n_probes <= 2
+
+
+def test_probe_tunnel_timeout_is_dead(monkeypatch):
+    """A hanging backend init (the observed DOWN mode) reads as dead."""
+    def hang(cmd, stdout=None, stderr=None, timeout=None):
+        raise subprocess.TimeoutExpired(cmd=cmd, timeout=timeout)
+    monkeypatch.setattr(bench.subprocess, "run", hang)
+    assert bench.probe_tunnel() is False
+
+
+def test_probe_tunnel_success(monkeypatch):
+    monkeypatch.setattr(
+        bench.subprocess, "run",
+        lambda *a, **k: subprocess.CompletedProcess([], 0))
+    assert bench.probe_tunnel() is True
 
 
 # ------------------------------------------------------------- sweep unit
@@ -103,7 +158,6 @@ def test_sweep_skips_failures_and_reports_best():
                                   on_best=seen.append)
     assert (best, cand) == (30.0, 32)
     assert seen == [10.0, 30.0]       # checkpoint per improvement
-
 
 def test_sweep_budget_gates_later_candidates(monkeypatch):
     clock = {"t": 0.0}

@@ -80,6 +80,33 @@ def test_make_composite_mesh_factorisation():
     assert set(mesh.shape) == {"dp", "pp", "tp", "sp", "ep"}
 
 
+def test_make_composite_mesh_respects_n_layers():
+    """VERDICT r3 weak 5: a pp-hostile factorisation must not silently
+    produce a mesh the train step rejects. With n_layers given, any
+    factor that would break n_layers % pp == 0 is dealt elsewhere."""
+    # priority that WANTS pp=2 for 4 devices; n_layers=3 forbids it
+    mesh = make_composite_mesh(4, priority=("pp", "dp", "tp", "sp", "ep"),
+                               n_layers=3)
+    assert mesh.shape["pp"] == 1
+    assert int(np.prod(list(mesh.shape.values()))) == 4
+    # n_layers=4 allows pp=2 (and then pp*2=4 divides too)
+    mesh = make_composite_mesh(4, priority=("pp", "dp", "tp", "sp", "ep"),
+                               n_layers=4)
+    assert mesh.shape["pp"] >= 2
+
+
+def test_train_step_rejects_bad_factorisation_with_clear_error(problem):
+    """Divisibility violations raise ValueError naming the config field,
+    the mesh axis, and the make_composite_mesh(n_layers=...) remedy."""
+    mesh = _mesh_from_sizes((1, 2, 1, 1, 1))   # pp=2
+    with pytest.raises(ValueError, match="n_layers.*pp.*n_layers="):
+        make_composite_train_step(mesh, CFG._replace(n_layers=3))
+    with pytest.raises(ValueError, match="batch.*dp\\*n_micro"):
+        make_composite_train_step(
+            _mesh_from_sizes((2, 1, 1, 1, 1)),
+            CFG._replace(batch=6, n_micro=4))
+
+
 def test_composite_remat_matches(problem):
     """cfg.remat=True (jax.checkpoint per layer) must change memory, not
     math: same updated params and loss as the non-remat sharded step."""
