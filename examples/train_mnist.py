@@ -27,12 +27,23 @@ def main():
     ap.add_argument("--epochs", type=int, default=3)
     ap.add_argument("--batch-size", type=int, default=64)
     ap.add_argument("--lr", type=float, default=0.1)
+    ap.add_argument("--kv-store", default="device",
+                    help="device | local | dist (multi-process via "
+                         "tools/launch.py)")
     ap.add_argument("--smoke", action="store_true")
     args = ap.parse_args()
     if args.smoke:
         args.epochs = 1
 
-    mx.random.seed(0)
+    kv = None
+    if args.kv_store.startswith("dist"):
+        # launched by tools/launch.py: coordinator/rank come from the env
+        from mxnet_tpu import kvstore
+        kvstore.init_distributed()
+        kv = kvstore.create(args.kv_store)
+        print(f"kvstore rank {kv.rank}/{kv.num_workers}")
+
+    mx.random.seed(kv.rank if kv is not None else 0)  # per-worker shuffle
     train = MNIST(train=True)
     loader = gluon.data.DataLoader(
         train.transform_first(lambda x: x.astype("float32") / 255.0),
@@ -44,7 +55,8 @@ def main():
     net.hybridize()
 
     trainer = gluon.Trainer(net.collect_params(), "sgd",
-                            {"learning_rate": args.lr, "momentum": 0.9})
+                            {"learning_rate": args.lr, "momentum": 0.9},
+                            kvstore=kv if kv is not None else "device")
     loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
     metric = mx.metric.Accuracy()
 

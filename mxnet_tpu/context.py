@@ -21,9 +21,13 @@ _context_stack = threading.local()
 
 
 def _accelerator_devices():
-    """All non-CPU JAX devices, or [] if running CPU-only."""
-    devs = jax.devices()
-    return [d for d in devs if d.platform != "cpu"]
+    """This process's non-CPU JAX devices, or [] if running CPU-only.
+
+    local_devices, not devices: under multi-process training (tools/
+    launch.py, multi-host pods) the global list contains other workers'
+    chips, which are not addressable from here — a Context must always
+    resolve to a device this process can place data on."""
+    return [d for d in jax.local_devices() if d.platform != "cpu"]
 
 
 class Context:
@@ -49,16 +53,19 @@ class Context:
     def jax_device(self):
         """Resolve to a concrete jax.Device (raises if unavailable)."""
         if self.device_type == "cpu":
-            try:
-                cpus = jax.devices("cpu")
-            except RuntimeError:
-                cpus = [d for d in jax.devices() if d.platform == "cpu"]
+            cpus = [d for d in jax.local_devices() if d.platform == "cpu"]
+            if not cpus:
+                try:
+                    cpus = jax.devices("cpu")
+                except RuntimeError:
+                    cpus = []
             if self.device_id < len(cpus):
                 return cpus[self.device_id]
             raise MXNetError(f"cpu({self.device_id}) not available")
         accels = _accelerator_devices()
         if not accels:  # CPU-only process (tests): alias accelerator -> cpu
-            return jax.devices()[min(self.device_id, len(jax.devices()) - 1)]
+            local = jax.local_devices()
+            return local[min(self.device_id, len(local) - 1)]
         if self.device_id >= len(accels):
             raise MXNetError(
                 f"{self.device_type}({self.device_id}) not available: "

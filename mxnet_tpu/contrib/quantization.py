@@ -1,4 +1,4 @@
-"""INT8 quantization (reference: python/mxnet/contrib/quantization.py +
+"""INT8/UINT8 quantization (reference: python/mxnet/contrib/quantization.py +
 src/operator/quantization/*).
 
 TPU-native: the MXU multiplies int8 x int8 into int32 natively, so int8
@@ -12,10 +12,26 @@ maps the reference's calibrated symmetric per-tensor scheme onto XLA:
     activations quantized dynamically per call (or with a calibrated
     static scale); the dot runs int8 x int8 -> int32
     (`preferred_element_type=jnp.int32`) and one fp multiply rescales.
-  * `quantize_model` / `quantize_net` — walk a Gluon block tree and swap
-    Dense/Conv2D layers for their quantized twins, optionally running
-    calibration batches to fix activation scales ('naive' max-abs
-    calibration, reference's calib_mode='naive').
+    uint8 activations (post-ReLU ranges) use the standard zero-point
+    decomposition: x_u8 in [0,255] is computed as (x_u8-128):int8 through
+    the MXU plus a precomputed +128 correction term — still int8 hardware
+    math, twice the effective resolution for non-negative tensors.
+  * `quantize_net` / `quantize_model` — quantize ARBITRARY Gluon block
+    trees (custom HybridBlocks, zoo resnets with residual blocks, ...):
+    every Dense/Conv2D instance's `forward` is re-routed through a mode
+    switch, so whatever call path the net takes — eager, or traced inside
+    a parent's hybridize()/jit — hits the int8 twin. This replaces the
+    reference's symbol-graph rewrite with the JAX-native equivalent
+    (rewire at trace time, let XLA fuse the requantization chain).
+
+Calibration (reference calib_mode semantics):
+  * 'naive'   — max-abs of each layer's input over the calib batches.
+  * 'entropy' — KL-divergence-optimal clipping threshold per layer
+    (reference: _get_optimal_threshold): histogram |x| into 2048 bins,
+    scan candidate thresholds, pick the one whose 128-level quantized
+    distribution minimises KL(P||Q). Ignoring rare outliers tightens the
+    scale and recovers accuracy on heavy-tailed activations.
+  * None      — no calibration; activations quantize dynamically.
 
 Excluded layers (first/last, by name) mirror the reference's
 `excluded_sym_names`.
@@ -31,7 +47,7 @@ from ..base import MXNetError
 from ..ndarray.ndarray import NDArray, _apply
 
 __all__ = ["quantize", "dequantize", "QuantizedDense", "QuantizedConv2D",
-           "quantize_net", "quantize_model"]
+           "quantize_net", "quantize_model", "kl_optimal_threshold"]
 
 
 def _scale_of(amax):
@@ -73,7 +89,8 @@ def quantize(data, min_range=None, max_range=None, out_type="int8"):
         calib = max(abs(_to_float(min_range)), abs(_to_float(max_range)))
 
     def f(x):
-        amax = jnp.float32(calib) if calib is not None             else jnp.max(jnp.abs(x))
+        amax = jnp.float32(calib) if calib is not None \
+            else jnp.max(jnp.abs(x))
         scale = _scale_of(amax)
         q = jnp.clip(jnp.round(x / scale), -127, 127).astype(jnp.int8)
         return q, -amax, amax
@@ -97,6 +114,62 @@ def dequantize(data, min_range, max_range):
     return f(data, jnp.asarray(min_range), jnp.asarray(max_range))
 
 
+# ---------------------------------------------------------------------------
+# KL (entropy) calibration
+# ---------------------------------------------------------------------------
+_HIST_BINS = 2048
+_QUANT_LEVELS = 128
+
+
+def kl_optimal_threshold(hist, amax, num_quantized_bins=_QUANT_LEVELS):
+    """KL-divergence-optimal clipping threshold (reference:
+    contrib.quantization._get_optimal_threshold; symmetric |x| variant).
+
+    hist: counts of |x| over `len(hist)` uniform bins spanning [0, amax].
+    Scans thresholds T = edge(i) for i in [num_quantized_bins, n]: P is the
+    clipped distribution (outlier mass folded into the last bin), Q is P
+    merged into num_quantized_bins levels and re-expanded over P's support.
+    Returns the T minimising KL(P||Q)."""
+    hist = np.asarray(hist, np.float64)
+    n = len(hist)
+    if amax <= 0 or hist.sum() == 0:
+        return max(amax, 1e-12)
+    if hist.sum() < 4 * num_quantized_bins:
+        # too few calibration samples for a meaningful distribution: a
+        # sparse histogram lets a tiny threshold reach KL~0 by capturing
+        # a handful of low bins. Fall back to max-abs (naive) behaviour.
+        return amax
+    bin_width = amax / n
+    best_i, best_kl = n, np.inf
+    for i in range(num_quantized_bins, n + 1):
+        p = hist[:i].copy()
+        p[i - 1] += hist[i:].sum()           # clip: outliers -> edge bin
+        nonzero = p > 0
+        # quantize the i bins into num_quantized_bins merged levels
+        factor = i / num_quantized_bins
+        idx = np.minimum((np.arange(i) / factor).astype(np.int64),
+                         num_quantized_bins - 1)
+        q_merged = np.bincount(idx, weights=hist[:i],
+                               minlength=num_quantized_bins)
+        # expand each level uniformly over its NONZERO source bins
+        counts = np.bincount(idx, weights=nonzero.astype(np.float64),
+                             minlength=num_quantized_bins)
+        expand = np.where(counts > 0, q_merged / np.maximum(counts, 1), 0.0)
+        q = expand[idx] * nonzero
+        p_sum, q_sum = p.sum(), q.sum()
+        if q_sum == 0:
+            continue
+        p_n = p / p_sum
+        q_n = q / q_sum
+        mask = (p_n > 0) & (q_n > 0)
+        kl = float(np.sum(p_n[mask] * np.log(p_n[mask] / q_n[mask])))
+        # P mass with no Q support contributes +inf in theory; penalise
+        kl += float(np.sum(p_n[(p_n > 0) & (q_n == 0)])) * 10.0
+        if kl < best_kl:
+            best_kl, best_i = kl, i
+    return best_i * bin_width
+
+
 def _quantize_weight(w):
     """fp weight -> (int8 weight, fp32 scale), symmetric per-tensor."""
     amax = float(jnp.max(jnp.abs(w)))
@@ -116,14 +189,48 @@ class _QuantizedBase:
     def __init__(self, name):
         self.name = name
         self._act_scale = None      # set by calibration; else dynamic
+        self._act_unsigned = False  # uint8 activation path (zero-point 128)
+        self._amax = 0.0
+        self._min_seen = np.inf
+        self._hist = None           # |x| histogram for entropy calib
 
-    def observe(self, x):
-        """Calibration: track max-abs of activations (naive calib)."""
-        amax = float(jnp.max(jnp.abs(x._data if isinstance(x, NDArray)
-                                     else x)))
-        prev = self._act_scale_amax = max(
-            getattr(self, "_act_scale_amax", 0.0), amax)
-        self._act_scale = np.float32(max(prev, 1e-12) / 127.0)
+    def observe(self, x, collect_hist=False):
+        """Calibration pass 1: track max-abs (and min, for uint8 'auto').
+        Pass 2 (collect_hist=True): accumulate the |x| histogram over
+        [0, amax] for the KL threshold search."""
+        xv = np.asarray(x._data if isinstance(x, NDArray) else x,
+                        np.float32)
+        if collect_hist:
+            h, _ = np.histogram(np.abs(xv), bins=_HIST_BINS,
+                                range=(0.0, max(self._amax, 1e-12)))
+            self._hist = h if self._hist is None else self._hist + h
+            return
+        self._amax = max(self._amax, float(np.max(np.abs(xv))))
+        self._min_seen = min(self._min_seen, float(np.min(xv)))
+
+    def finalize_calibration(self, calib_mode, quantized_dtype):
+        """Turn observed stats into a static activation scale + signedness."""
+        amax = self._amax
+        if calib_mode == "entropy" and self._hist is not None:
+            amax = kl_optimal_threshold(self._hist, self._amax)
+        unsigned = (quantized_dtype == "uint8"
+                    or (quantized_dtype == "auto" and self._min_seen >= 0.0))
+        self._act_unsigned = bool(unsigned)
+        levels = 255.0 if unsigned else 127.0
+        self._act_scale = np.float32(max(amax, 1e-12) / levels)
+
+
+def _quantize_act(xf, s_x, unsigned):
+    """fp activation -> (int8 array fed to the MXU, needs_correction).
+
+    signed:   q = clip(round(x/s), -127, 127) : int8
+    unsigned: q = clip(round(x/s), 0, 255) - 128 : int8, plus a +128
+              correction applied by the caller (zero-point decomposition
+              keeps the hardware op int8 x int8)."""
+    if unsigned:
+        qu = jnp.clip(jnp.round(xf / s_x), 0, 255)
+        return (qu - 128).astype(jnp.int8), True
+    return jnp.clip(jnp.round(xf / s_x), -127, 127).astype(jnp.int8), False
 
 
 class QuantizedDense(_QuantizedBase):
@@ -135,15 +242,18 @@ class QuantizedDense(_QuantizedBase):
         super().__init__(getattr(dense, "name", "dense"))
         w = dense.weight.data()._data.astype(jnp.float32)
         self.wq, self.w_scale = _quantize_weight(w)
+        # zero-point correction: +128 * sum_in W_q[o, in] per output
+        self._corr = 128 * jnp.sum(self.wq.astype(jnp.int32), axis=1)
         self.bias = (dense.bias.data()._data.astype(jnp.float32)
                      if getattr(dense, "bias", None) is not None else None)
         self._flatten = getattr(dense, "_flatten", True)
         self._act = _act_fn(getattr(dense, "_activation", None), self.name)
 
     def __call__(self, x):
-        wq, w_scale = self.wq, self.w_scale
+        wq, w_scale, corr = self.wq, self.w_scale, self._corr
         bias, act = self.bias, self._act
         static_scale = self._act_scale
+        unsigned = self._act_unsigned
         flatten = self._flatten
 
         def f(xv):
@@ -152,10 +262,12 @@ class QuantizedDense(_QuantizedBase):
             xf = xv.astype(jnp.float32)
             s_x = static_scale if static_scale is not None \
                 else _dyn_act_scale(xf)
-            xq = jnp.clip(jnp.round(xf / s_x), -127, 127).astype(jnp.int8)
+            xq, needs_corr = _quantize_act(xf, s_x, unsigned)
             acc = jax.lax.dot_general(
                 xq, wq, (((xq.ndim - 1,), (1,)), ((), ())),
                 preferred_element_type=jnp.int32)
+            if needs_corr:
+                acc = acc + corr
             y = acc.astype(jnp.float32) * (s_x * w_scale)
             if bias is not None:
                 y = y + bias
@@ -180,26 +292,50 @@ class QuantizedConv2D(_QuantizedBase):
         self._groups = getattr(conv, "_groups", 1)
         self._layout = getattr(conv, "_layout", None) or "NCHW"
         self._act = _act_fn(getattr(conv, "_activation", None), self.name)
+        self._corr_cache = {}   # input shape -> +128 correction map
+
+    def _conv_args(self, ndim):
+        stride, pad, dilation = self._stride, self._pad, self._dilation
+        st = (stride,) * ndim if isinstance(stride, int) else tuple(stride)
+        pd = (pad,) * ndim if isinstance(pad, int) else tuple(pad)
+        dl = (dilation,) * ndim if isinstance(dilation, int) \
+            else tuple(dilation)
+        return st, pd, dl
+
+    def _correction(self, shape, dn, st, pd, dl):
+        """+128 * conv(ones) int32 map (border-aware under zero padding);
+        one int8 conv per distinct input shape, cached. Never caches a
+        tracer: a value produced inside someone else's jit trace must not
+        leak to later eager calls (UnexpectedTracerError)."""
+        key = tuple(shape)
+        cached = self._corr_cache.get(key)
+        if cached is not None:
+            return cached
+        ones = jnp.ones(shape, jnp.int8)
+        corr = 128 * jax.lax.conv_general_dilated(
+            ones, self.wq, window_strides=st,
+            padding=tuple((p, p) for p in pd), rhs_dilation=dl,
+            feature_group_count=self._groups, dimension_numbers=dn,
+            preferred_element_type=jnp.int32)
+        if not isinstance(corr, jax.core.Tracer):
+            self._corr_cache[key] = corr
+        return corr
 
     def __call__(self, x):
         wq, w_scale = self.wq, self.w_scale
         bias, act = self.bias, self._act
-        stride, pad, layout = self._stride, self._pad, self._layout
-        dilation, groups = self._dilation, self._groups
+        layout, groups = self._layout, self._groups
         static_scale = self._act_scale
+        unsigned = self._act_unsigned
+        me = self
 
         def f(xv):
             from jax import lax
             xf = xv.astype(jnp.float32)
             s_x = static_scale if static_scale is not None \
                 else _dyn_act_scale(xf)
-            xq = jnp.clip(jnp.round(xf / s_x), -127, 127).astype(jnp.int8)
-            ndim = xv.ndim - 2
-            st = (stride,) * ndim if isinstance(stride, int) \
-                else tuple(stride)
-            pd = (pad,) * ndim if isinstance(pad, int) else tuple(pad)
-            dl = (dilation,) * ndim if isinstance(dilation, int) \
-                else tuple(dilation)
+            xq, needs_corr = _quantize_act(xf, s_x, unsigned)
+            st, pd, dl = me._conv_args(xv.ndim - 2)
             spatial = layout.replace("N", "").replace("C", "")
             rhs = ("OI" + spatial) if layout.index("C") == 1 \
                 else ("O" + spatial + "I")
@@ -210,6 +346,8 @@ class QuantizedConv2D(_QuantizedBase):
                 padding=tuple((p, p) for p in pd),
                 rhs_dilation=dl, feature_group_count=groups,
                 dimension_numbers=dn, preferred_element_type=jnp.int32)
+            if needs_corr:
+                acc = acc + me._correction(xq.shape, dn, st, pd, dl)
             y = acc.astype(jnp.float32) * (s_x * w_scale)
             if bias is not None:
                 c_axis = layout.index("C")
@@ -221,104 +359,168 @@ class QuantizedConv2D(_QuantizedBase):
         return _apply(f, [x] if isinstance(x, NDArray) else [NDArray(x)])
 
 
-_SEQ_TYPES = ("HybridSequential", "Sequential")
+# ---------------------------------------------------------------------------
+# arbitrary-block rewiring
+# ---------------------------------------------------------------------------
+class _Router:
+    """Mode switch installed as `instance.forward` on each quantized layer.
+
+    Modes: 'fp32' (original math — the net behaves as if untouched),
+    'observe'/'hist' (original math, feeding the twin's calibrator),
+    'int8' (the quantized twin). The instance attribute shadows the class
+    method, so EVERY call path — eager, container, custom hybrid_forward,
+    or a parent's jit trace — routes through it."""
+
+    def __init__(self, orig_forward, twin, ctl):
+        self._orig = orig_forward
+        self.twin = twin
+        self._ctl = ctl
+
+    def __call__(self, x, *args, **kwargs):
+        mode = self._ctl["mode"]
+        if mode == "int8":
+            return self.twin(x)
+        if mode == "observe":
+            self.twin.observe(x)
+        elif mode == "hist":
+            self.twin.observe(x, collect_hist=True)
+        return self._orig(x, *args, **kwargs)
+
+
+def _walk_layers(block, path="", seen=None):
+    """Yield (path, block) for every descendant, depth-first."""
+    seen = set() if seen is None else seen
+    for name, child in getattr(block, "_children", {}).items():
+        if id(child) in seen:
+            continue
+        seen.add(id(child))
+        cpath = f"{path}.{name}" if path else name
+        yield cpath, child
+        yield from _walk_layers(child, cpath, seen)
+
+
+def _swap_caches(block, store, seen=None):
+    """Temporarily swap every HybridBlock's compiled-fn cache for a
+    mode-private one: a trace baked with fp32 layers must never serve an
+    int8 call (and vice versa)."""
+    seen = set() if seen is None else seen
+    if id(block) in seen:
+        return
+    seen.add(id(block))
+    if hasattr(block, "_cached_fns"):
+        store.setdefault(id(block), {})
+        block._cached_fns, store[id(block)] = \
+            store[id(block)], block._cached_fns
+    for child in getattr(block, "_children", {}).values():
+        _swap_caches(child, store, seen)
 
 
 class QuantizedNet:
     """Result of quantize_net: same call signature as the source block,
-    with listed layers running int8. Supports (nested) Sequential trees —
-    quantize_net raises up front for structures it cannot rewire, so a
-    returned QuantizedNet never silently runs fp32."""
+    with every quantized layer running int8 — arbitrary block trees
+    included. The source network still computes fp32 when called directly
+    (the routers sit idle in 'fp32' mode outside QuantizedNet calls)."""
 
-    def __init__(self, block, replacements):
+    def __init__(self, block, routers):
         self._block = block
-        self._replacements = replacements  # id(child) -> quantized twin
+        self._routers = routers            # path -> _Router
+        self._ctl = routers[next(iter(routers))]._ctl if routers else \
+            {"mode": "fp32"}
+        self._q_caches = {}
+
+    def _run(self, x, mode):
+        self._ctl["mode"] = mode
+        # calibration reads concrete activation values (np.asarray) — it
+        # must NEVER run inside a jit trace, so hybridization is forced
+        # off for observe/hist passes
+        deactivated = []
+        if mode in ("observe", "hist"):
+            for _, b in _walk_layers(self._block):
+                if getattr(b, "_active", False):
+                    b._active = False
+                    deactivated.append(b)
+            if getattr(self._block, "_active", False):
+                self._block._active = False
+                deactivated.append(self._block)
+        _swap_caches(self._block, self._q_caches)
+        try:
+            return self._block(x)
+        finally:
+            _swap_caches(self._block, self._q_caches)
+            for b in deactivated:
+                b._active = True
+            self._ctl["mode"] = "fp32"
 
     def __call__(self, x):
-        return self._forward(self._block, x, observe=False)
-
-    def _forward(self, block, x, observe):
-        """Run `block` with quantized twins substituted; with observe=True
-        runs the ORIGINAL layers but feeds each twin's calibrator."""
-        for c in block._children.values():
-            q = self._replacements.get(id(c))
-            if q is not None:
-                if observe:
-                    q.observe(x)
-                    x = c(x)
-                else:
-                    x = q(x)
-            elif type(c).__name__ in _SEQ_TYPES:
-                x = self._forward(c, x, observe)
-            else:
-                x = c(x)
-        return x
+        return self._run(x, "int8")
 
     @property
     def quantized_layers(self):
-        return list(self._replacements.values())
+        return [r.twin for r in self._routers.values()]
 
 
 def quantize_net(network, quantized_dtype="int8", exclude_layers=None,
-                 calib_data=None, num_calib_batches=None, **kwargs):
-    """Quantize a Gluon net's Dense/Conv2D layers to int8 (reference:
-    contrib.quantization.quantize_net). Returns a callable QuantizedNet.
+                 calib_data=None, num_calib_batches=None,
+                 calib_mode="naive", **kwargs):
+    """Quantize a Gluon net's Dense/Conv2D layers to int8/uint8
+    (reference: contrib.quantization.quantize_net). Works on ARBITRARY
+    block trees — zoo models with custom residual blocks included.
+    Returns a callable QuantizedNet; the original net keeps its fp32
+    behaviour when called directly.
 
-    calib_data: optional iterable of input batches used to fix activation
-    scales (naive max-abs); without it activations quantize dynamically."""
-    if quantized_dtype not in ("int8", "auto"):
-        raise MXNetError("TPU quantization supports int8")
+    calib_data: iterable of input batches (or (data, label) tuples) used
+    to fix activation scales. calib_mode: 'naive' (max-abs) or 'entropy'
+    (KL-optimal thresholds; needs calib_data). quantized_dtype: 'int8',
+    'uint8' (zero-point-decomposed activations), or 'auto' (uint8 where
+    the calibrated activation range is non-negative)."""
+    if quantized_dtype not in ("int8", "uint8", "auto"):
+        raise MXNetError("quantized_dtype must be int8, uint8, or auto")
+    if calib_mode not in (None, "none", "naive", "entropy"):
+        raise MXNetError("calib_mode must be 'naive', 'entropy', or None")
+    if calib_mode == "entropy" and calib_data is None:
+        raise MXNetError("calib_mode='entropy' requires calib_data")
+    if quantized_dtype in ("uint8", "auto") and (
+            calib_data is None or calib_mode not in ("naive", "entropy")):
+        raise MXNetError(f"quantized_dtype={quantized_dtype!r} requires "
+                         "calib_data AND calib_mode='naive'|'entropy' "
+                         "(signedness is a calibration-time decision)")
     exclude = set(exclude_layers or [])
-    if type(network).__name__ not in _SEQ_TYPES:
-        raise MXNetError(
-            "quantize_net rewires (nested) HybridSequential/Sequential "
-            "trees; for custom Blocks wrap the quantizable submodules in a "
-            "Sequential or use QuantizedDense/QuantizedConv2D directly")
-    replacements = {}
-
-    def walk(b, path=""):
-        for name, child in b._children.items():
-            cls = type(child).__name__
-            cpath = f"{path}.{name}" if path else name
-            if cpath in exclude or cls in exclude:
-                continue
-            if cls == "Dense":
-                replacements[id(child)] = QuantizedDense(child)
-            elif cls == "Conv2D":
-                replacements[id(child)] = QuantizedConv2D(child)
-            elif cls in _SEQ_TYPES:
-                walk(child, cpath)
-            elif any(type(g).__name__ in ("Dense", "Conv2D")
-                     for g in _descendants(child)):
-                # a quantizable layer hiding under a custom block would be
-                # silently skipped at call time — refuse instead
-                raise MXNetError(
-                    f"cannot quantize inside custom block {cpath!r} "
-                    f"({cls}); exclude it via exclude_layers or quantize "
-                    f"its layers directly")
-
-    walk(network)
-    if not replacements:
+    ctl = {"mode": "fp32"}
+    routers = {}
+    for cpath, child in _walk_layers(network):
+        cls = type(child).__name__
+        if cpath in exclude or cls in exclude \
+                or getattr(child, "name", None) in exclude:
+            continue
+        if cls == "Dense":
+            twin = QuantizedDense(child)
+        elif cls == "Conv2D":
+            twin = QuantizedConv2D(child)
+        else:
+            continue
+        router = _Router(child.forward, twin, ctl)
+        child.forward = router       # instance attr shadows class method
+        routers[cpath] = router
+    if not routers:
         raise MXNetError("no quantizable (Dense/Conv2D) layers found")
-    qnet = QuantizedNet(network, replacements)
+    qnet = QuantizedNet(network, routers)
 
-    if calib_data is not None:
+    if calib_data is not None and calib_mode in ("naive", "entropy"):
+        batches = []
         n = 0
         for batch in calib_data:
             x = batch[0] if isinstance(batch, (tuple, list)) else batch
-            # run the ORIGINAL fp net, observing inputs to each twin —
-            # same traversal as inference, nested containers included
-            qnet._forward(network, x, observe=True)
+            batches.append(x)
+            qnet._run(x, "observe")       # pass 1: amax/min ranges
             n += 1
             if num_calib_batches is not None and n >= num_calib_batches:
                 break
+        if calib_mode == "entropy":
+            for x in batches:             # pass 2: histograms in [0, amax]
+                qnet._run(x, "hist")
+        for r in routers.values():
+            r.twin.finalize_calibration(calib_mode, quantized_dtype)
     return qnet
-
-
-def _descendants(block):
-    for c in getattr(block, "_children", {}).values():
-        yield c
-        yield from _descendants(c)
 
 
 def quantize_model(sym_or_net, *args, **kwargs):

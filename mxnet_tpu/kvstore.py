@@ -38,17 +38,41 @@ __all__ = ["KVStore", "create", "init_distributed"]
 _DIST_INITIALIZED = False
 
 
+def _cluster_env():
+    """Read the launcher-provided cluster spec from the environment.
+
+    Two spellings are honoured: the reference's ps-lite variables
+    (DMLC_PS_ROOT_URI/DMLC_PS_ROOT_PORT/DMLC_NUM_WORKER/DMLC_WORKER_ID —
+    what upstream tools/launch.py exports) and the native MXTPU_* ones
+    (what tools/launch.py here exports). Returns (coord, n, rank) or
+    (None, None, None)."""
+    import os
+    coord = os.environ.get("MXTPU_COORDINATOR")
+    if coord is None and os.environ.get("DMLC_PS_ROOT_URI"):
+        coord = (os.environ["DMLC_PS_ROOT_URI"] + ":"
+                 + os.environ.get("DMLC_PS_ROOT_PORT", "9091"))
+    n = os.environ.get("MXTPU_NUM_WORKERS", os.environ.get("DMLC_NUM_WORKER"))
+    rank = os.environ.get("MXTPU_WORKER_ID", os.environ.get("DMLC_WORKER_ID"))
+    if coord and n is not None and rank is not None:
+        return coord, int(n), int(rank)
+    return None, None, None
+
+
 def init_distributed(coordinator_address=None, num_processes=None,
                      process_id=None, **kwargs):
     """Initialise the multi-host runtime (DCN) so an 'ici' KVStore spans
     processes. Arguments mirror `jax.distributed.initialize`; with none
-    given, JAX reads the cluster env (JAX_COORDINATOR_ADDRESS / cloud TPU
-    metadata). Safe to call more than once. Reference parity: the ps-lite
-    scheduler/server bootstrap of kvstore_dist; here the XLA runtime owns
-    rendezvous and the collectives ride ICI/DCN."""
+    given, the launcher env is consulted first (MXTPU_*/DMLC_* — what
+    tools/launch.py exports, reference parity with the dmlc_tracker
+    bootstrap), then JAX reads its own cluster env (JAX_COORDINATOR_ADDRESS
+    / cloud TPU metadata). Safe to call more than once. Reference parity:
+    the ps-lite scheduler/server bootstrap of kvstore_dist; here the XLA
+    runtime owns rendezvous and the collectives ride ICI/DCN."""
     global _DIST_INITIALIZED
     if _DIST_INITIALIZED:
         return
+    if coordinator_address is None and num_processes is None:
+        coordinator_address, num_processes, process_id = _cluster_env()
     # NB: do NOT call jax.process_count() (or any backend-touching API)
     # here — it initialises the XLA backend, after which
     # jax.distributed.initialize refuses to run.
@@ -78,6 +102,15 @@ def init_distributed(coordinator_address=None, num_processes=None,
                 f"jax.distributed.initialize failed ({e!r}); continuing "
                 f"SINGLE-PROCESS — cross-host gradients will NOT reduce",
                 RuntimeWarning, stacklevel=2)
+
+
+def _is_process_local(a):
+    """True for arrays every device of which is addressable here — i.e.
+    NOT an already-global pjit array whose psum XLA inserted in-step."""
+    try:
+        return bool(a.sharding.is_fully_addressable)
+    except AttributeError:
+        return True
 
 
 def create(name="local"):
@@ -254,7 +287,16 @@ class KVStore:
         out = arrays[0]
         for a in arrays[1:]:
             out = out + a
-        if self._kind != "ici" or self._mesh is None:
+        if self._kind != "ici":
+            return out
+        if self._mesh is None:
+            # no mesh attached: imperative multi-PROCESS training (the
+            # tools/launch.py path). A process-local array must still
+            # reduce across workers — upstream dist_sync sums worker
+            # gradients through ps-lite; here it's one psum over the
+            # global device mesh.
+            if jax.process_count() > 1 and _is_process_local(out):
+                return self.allreduce_process_sum(out)
             return out
         mesh = self._mesh
         axis = axis or mesh.axis_names[0]
@@ -280,6 +322,31 @@ class KVStore:
         if isinstance(dim0, (tuple, list)):
             return axis in dim0
         return dim0 == axis
+
+    def allreduce_process_sum(self, a):
+        """Sum a process-LOCAL array across all workers (imperative
+        dist-sync: each process trained on its own batch and holds its own
+        gradient). One shard_map psum over the global device mesh — the
+        launcher-spawned CPU case and a multi-host TPU pod take the same
+        path. Returns a local array equal to the cross-worker sum."""
+        import numpy as _np
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+        from jax import shard_map
+        if jax.process_count() <= 1:
+            return a
+        devs = _np.asarray(jax.devices())
+        mesh = Mesh(devs, ("dp",))
+        ldc = jax.local_device_count()
+        # one identical row per local device; the final /ldc undoes the
+        # duplication so the result is exactly sum-over-processes
+        local = _np.broadcast_to(_np.asarray(a)[None],
+                                 (ldc,) + tuple(a.shape))
+        garr = jax.make_array_from_process_local_data(
+            NamedSharding(mesh, P("dp")), _np.ascontiguousarray(local))
+        f = shard_map(lambda x: jax.lax.psum(jnp.sum(x, axis=0), "dp"),
+                      mesh=mesh, in_specs=P("dp"), out_specs=P())
+        total = jax.device_get(f(garr))
+        return jnp.asarray(total) / ldc
 
     def _psum_stacked(self, a, axis):
         from jax.sharding import PartitionSpec as P

@@ -36,7 +36,11 @@ from .ndarray import NDArray, _apply
 __all__ = ["foreach", "while_loop", "cond",
            "interleaved_matmul_selfatt_qk",
            "interleaved_matmul_selfatt_valatt", "div_sqrt_dim",
-           "arange_like", "index_copy", "index_array", "boolean_mask"]
+           "arange_like", "index_copy", "index_array", "boolean_mask",
+           "ROIAlign", "box_nms", "box_non_maximum_suppression", "box_iou",
+           "box_encode", "box_decode", "MultiBoxPrior", "MultiBoxTarget",
+           "MultiBoxDetection", "Proposal", "MultiProposal",
+           "DeformableConvolution", "fft", "ifft", "count_sketch"]
 
 
 def _is_traced(nds):
@@ -372,3 +376,175 @@ def index_array(data, axes=None, **kw):
         sel = grids if axes is None else [grids[a] for a in axes]
         return jnp.stack(sel, axis=-1).astype(jnp.int32)
     return _apply(fn, [data])
+
+
+# ---------------------------------------------------------------------------
+# detection / vision contrib ops (upstream: src/operator/contrib/
+# roi_align.cc, bounding_box.cc, multibox_*.cc, proposal.cc,
+# multi_proposal.cc, deformable_convolution.cc, fft.cc, count_sketch.cc).
+# Kernels live in ops/detection_ops.py + ops/contrib_ops.py; these wrappers
+# expose them under the reference nd.contrib names with reference layouts.
+# ---------------------------------------------------------------------------
+from ..ops import detection_ops as _det
+from ..ops import contrib_ops as _cops
+
+
+def ROIAlign(data, rois, pooled_size=(7, 7), spatial_scale=1.0,
+             sample_ratio=2, **kw):
+    """data (B, C, H, W), rois (R, 5) [batch_idx, x0, y0, x1, y1] ->
+    (R, C, ph, pw) (upstream: contrib.ROIAlign / roi_align.cc)."""
+    pooled_size = tuple(pooled_size)
+    return _apply(lambda d, r: _cops.roi_align_batched(
+        d, r, pooled_size=pooled_size, spatial_scale=spatial_scale,
+        sample_ratio=max(int(sample_ratio), 1)), [data, rois])
+
+
+def box_nms(data, overlap_thresh=0.5, valid_thresh=0.0, topk=-1,
+            coord_start=2, score_index=1, id_index=-1, background_id=-1,
+            force_suppress=False, **kw):
+    """NMS over rows of (..., N, K) box records; suppressed rows become -1
+    (upstream: contrib.box_nms / bounding_box.cc)."""
+    return _apply(lambda d: _cops.box_nms(
+        d, overlap_thresh=overlap_thresh, valid_thresh=valid_thresh,
+        topk=int(topk), coord_start=int(coord_start),
+        score_index=int(score_index), id_index=int(id_index),
+        background_id=int(background_id),
+        force_suppress=bool(force_suppress)), [data])
+
+
+box_non_maximum_suppression = box_nms
+
+
+def box_iou(lhs, rhs, format="corner", **kw):
+    """Pairwise IoU (upstream: contrib.box_iou): lhs (..., N, 4),
+    rhs (..., M, 4) -> (..., N, M)."""
+    return _apply(lambda a, b: _cops.box_iou_generic(a, b, format=format),
+                  [lhs, rhs])
+
+
+def box_encode(samples, matches, anchors, refs, means=(0., 0., 0., 0.),
+               stds=(0.1, 0.1, 0.2, 0.2), **kw):
+    """GluonCV-style batched target encoding (upstream: contrib.box_encode):
+    samples (B, A) {+1 pos, else ignore}, matches (B, A) gt indices,
+    anchors (B, A, 4), refs (B, M, 4) -> (targets (B, A, 4), mask (B, A, 4)).
+    Targets are (raw_offset - mean) / std, upstream's normalisation order.
+    """
+    def fn(s, m, a, r):
+        def per(sb, mb, ab, rb):
+            gt = rb[mb.astype(jnp.int32)]
+            raw = _det.box_encode(gt, ab, variances=(1.0, 1.0, 1.0, 1.0))
+            t = (raw - jnp.asarray(means, raw.dtype)) \
+                / jnp.asarray(stds, raw.dtype)
+            mask = (sb > 0.5)[:, None].astype(t.dtype)
+            return t * mask, jnp.broadcast_to(mask, t.shape)
+        return jax.vmap(per)(s, m, a, r)
+    return _apply(fn, [samples, matches, anchors, refs], n_out=2)
+
+
+def box_decode(data, anchors, std0=0.1, std1=0.1, std2=0.2, std3=0.2,
+               clip=-1.0, format="corner", **kw):
+    """Decode (B, A, 4) offsets against anchors (1|B, A, 4) (upstream:
+    contrib.box_decode)."""
+    def fn(d, a):
+        a = _cops.to_corner(a, format)
+        a2 = jnp.broadcast_to(a, d.shape)
+        dec = jax.vmap(lambda dd, aa: _det.box_decode(
+            dd, aa, variances=(std0, std1, std2, std3)))(d, a2)
+        return jnp.clip(dec, 0.0, clip) if clip > 0 else dec
+    return _apply(fn, [data, anchors])
+
+
+def MultiBoxPrior(data, sizes=(1.0,), ratios=(1.0,), clip=False,
+                  steps=(-1.0, -1.0), offsets=(0.5, 0.5), **kw):
+    """Anchors for a feature map (upstream: contrib.MultiBoxPrior):
+    data (B, C, H, W) -> (1, H*W*K, 4) normalised corners."""
+    return _apply(lambda d: _cops.multibox_prior_k(
+        d, sizes=tuple(sizes), ratios=tuple(ratios), clip=bool(clip),
+        offsets=tuple(offsets), steps=tuple(steps)), [data])
+
+
+def MultiBoxTarget(anchor, label, cls_pred, overlap_threshold=0.5,
+                   variances=(0.1, 0.1, 0.2, 0.2), **kw):
+    """SSD target assignment (upstream: contrib.MultiBoxTarget).
+    anchor (1, A, 4); label (B, M, 5) [cls x0 y0 x1 y1, cls=-1 pad];
+    cls_pred (B, C+1, A) (shape source only). Returns the upstream triple
+    [loc_target (B, A*4), loc_mask (B, A*4), cls_target (B, A)]."""
+    return _apply(lambda a, lab, cp: _cops.multibox_target_k(
+        a, lab, cp, overlap_threshold=overlap_threshold,
+        variances=tuple(variances)), [anchor, label, cls_pred], n_out=3)
+
+
+def MultiBoxDetection(cls_prob, loc_pred, anchor, threshold=0.01,
+                      nms_threshold=0.45, nms_topk=400, max_det=100,
+                      variances=(0.1, 0.1, 0.2, 0.2), **kw):
+    """Decode + per-class NMS (upstream: contrib.MultiBoxDetection).
+    Output (B, max_det, 6) rows [cls_id, score, x0, y0, x1, y1], -1 pads —
+    a STATIC detection budget instead of upstream's (B, A, 6) dynamic
+    suppression (the XLA-friendly form; same surviving boxes)."""
+    return _apply(lambda cp, lp, a: _cops.multibox_detection_k(
+        cp, lp, a, threshold=threshold, nms_threshold=nms_threshold,
+        nms_topk=int(nms_topk), max_det=int(max_det),
+        variances=tuple(variances)), [cls_prob, loc_pred, anchor])
+
+
+def MultiProposal(cls_prob, bbox_pred, im_info, rpn_pre_nms_top_n=6000,
+                  rpn_post_nms_top_n=300, threshold=0.7, rpn_min_size=16,
+                  scales=(4, 8, 16, 32), ratios=(0.5, 1, 2),
+                  feature_stride=16, output_score=False, **kw):
+    """Batched RPN proposals (upstream: contrib.MultiProposal)."""
+    def fn(cp, bp, info):
+        rois, scores = _cops.multi_proposal(
+            cp, bp, info, rpn_pre_nms_top_n=int(rpn_pre_nms_top_n),
+            rpn_post_nms_top_n=int(rpn_post_nms_top_n),
+            threshold=threshold, rpn_min_size=rpn_min_size,
+            scales=tuple(scales), ratios=tuple(ratios),
+            feature_stride=int(feature_stride))
+        return (rois, scores) if output_score else rois
+    return _apply(fn, [cls_prob, bbox_pred, im_info],
+                  n_out=2 if output_score else 1)
+
+
+def Proposal(cls_prob, bbox_pred, im_info, **kw):
+    """Single-image RPN proposals (upstream: contrib.Proposal)."""
+    if cls_prob.shape[0] != 1:
+        raise MXNetError("Proposal expects batch 1; use MultiProposal")
+    return MultiProposal(cls_prob, bbox_pred, im_info, **kw)
+
+
+def DeformableConvolution(data, offset, weight, bias=None, kernel=(3, 3),
+                          stride=(1, 1), dilate=(1, 1), pad=(0, 0),
+                          num_filter=0, num_group=1, num_deformable_group=1,
+                          no_bias=False, **kw):
+    """Deformable conv v1 (upstream: contrib.DeformableConvolution).
+    data (B, C, H, W); offset (B, 2*dg*kh*kw, OH, OW); weight
+    (num_filter, C/num_group, kh, kw)."""
+    def fn(*arrs):
+        d, off, w = arrs[:3]
+        b = arrs[3] if len(arrs) > 3 else None
+        return _cops.deformable_convolution(
+            d, off, w, bias=b, kernel=tuple(kernel), stride=tuple(stride),
+            dilate=tuple(dilate), pad=tuple(pad), num_group=int(num_group),
+            num_deformable_group=int(num_deformable_group))
+    ins = [data, offset, weight]
+    if bias is not None and not no_bias:
+        ins.append(bias)
+    return _apply(fn, ins)
+
+
+def fft(data, compute_size=128, **kw):
+    """Real -> interleaved [re, im] FFT along the last axis (upstream:
+    contrib.fft; compute_size is a CUDA batching knob — accepted,
+    irrelevant under XLA)."""
+    return _apply(_cops.fft, [data])
+
+
+def ifft(data, compute_size=128, **kw):
+    """Interleaved [re, im] -> real inverse FFT, UNNORMALISED like the
+    upstream kernel: ifft(fft(x)) == d * x."""
+    return _apply(_cops.ifft, [data])
+
+
+def count_sketch(data, h, s, out_dim, **kw):
+    """Count-sketch projection to out_dim (upstream: contrib.count_sketch)."""
+    return _apply(lambda d, hh, ss: _cops.count_sketch(
+        d, hh, ss, int(out_dim)), [data, h, s])

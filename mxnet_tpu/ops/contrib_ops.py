@@ -1,0 +1,414 @@
+"""Reference `contrib` operator kernels (upstream: src/operator/contrib/).
+
+TPU-first redesigns, not translations:
+
+- DeformableConvolution (deformable_convolution.cc / deformable_im2col.h):
+  upstream materialises deformable im2col columns with a CUDA kernel, then
+  GEMMs. Here the bilinear sampling is a vectorised gather over the whole
+  output grid (one XLA gather) and the contraction is one einsum — the MXU
+  does the GEMM, there is no per-pixel loop anywhere.
+- Proposal / MultiProposal (proposal.cc, multi_proposal.cc): upstream sorts
+  + NMS-es on the CPU/GPU with dynamic box counts. Here everything is
+  STATIC-shape: fixed top-k budgets (lax.top_k) and the shared mask-NMS from
+  detection_ops, so RPN proposal generation compiles into the same XLA
+  program as the backbone (the SSD trick, applied to RCNN).
+- fft / ifft (fft.cc): upstream wraps cuFFT; here it's jnp.fft with the
+  reference's interleaved real/imag layout.
+- count_sketch (count_sketch.cc): the hash-projection is a one-hot matmul
+  (MXU) rather than scatter-adds.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .detection_ops import box_iou, nms, roi_align
+
+__all__ = ["deformable_convolution", "proposal", "multi_proposal",
+           "fft", "ifft", "count_sketch", "roi_align_batched", "box_nms",
+           "generate_base_anchors", "to_corner", "box_iou_generic",
+           "multibox_prior_k", "multibox_target_k", "multibox_detection_k"]
+
+
+# ---------------------------------------------------------------------------
+# DeformableConvolution
+# ---------------------------------------------------------------------------
+def _out_dim(size, k, stride, pad, dilate):
+    return (size + 2 * pad - (dilate * (k - 1) + 1)) // stride + 1
+
+
+def deformable_convolution(data, offset, weight, bias=None, kernel=(3, 3),
+                           stride=(1, 1), dilate=(1, 1), pad=(0, 0),
+                           num_group=1, num_deformable_group=1):
+    """Deformable conv v1 (upstream: src/operator/contrib/
+    deformable_convolution.cc).
+
+    data: (B, C, H, W); offset: (B, 2*dg*kh*kw, OH, OW) with channel
+    layout [dg][kh*kw][dy, dx] (upstream's order); weight:
+    (F, C/num_group, kh, kw); returns (B, F, OH, OW).
+
+    Out-of-image samples contribute zero (upstream im2col semantics).
+    """
+    B, C, H, W = data.shape
+    F = weight.shape[0]
+    kh, kw = kernel
+    sh, sw = stride
+    dh, dw = dilate
+    ph, pw = pad
+    dg = num_deformable_group
+    oh = _out_dim(H, kh, sh, ph, dh)
+    ow = _out_dim(W, kw, sw, pw, dw)
+    K = kh * kw
+
+    # base sampling positions: (OH, OW, K)
+    oy = (jnp.arange(oh) * sh - ph)[:, None, None]
+    ox = (jnp.arange(ow) * sw - pw)[None, :, None]
+    ky = (jnp.arange(K) // kw) * dh
+    kx = (jnp.arange(K) % kw) * dw
+    base_y = (oy + ky[None, None, :]).astype(data.dtype)    # (OH, 1, K)
+    base_x = (ox + kx[None, None, :]).astype(data.dtype)    # (1, OW, K)
+
+    off = offset.reshape(B, dg, K, 2, oh, ow)
+    dy = jnp.transpose(off[:, :, :, 0], (0, 3, 4, 1, 2))    # (B,OH,OW,dg,K)
+    dx = jnp.transpose(off[:, :, :, 1], (0, 3, 4, 1, 2))
+    sy = base_y[None, :, :, None, :] + dy                    # (B,OH,OW,dg,K)
+    sx = base_x[None, :, :, None, :] + dx
+
+    # bilinear gather with zero outside the image
+    valid = ((sy > -1.0) & (sy < H) & (sx > -1.0) & (sx < W))
+    y0 = jnp.floor(sy)
+    x0 = jnp.floor(sx)
+    wy = sy - y0
+    wx = sx - x0
+    y0i = jnp.clip(y0.astype(jnp.int32), 0, H - 1)
+    x0i = jnp.clip(x0.astype(jnp.int32), 0, W - 1)
+    y1i = jnp.clip(y0i + 1, 0, H - 1)
+    x1i = jnp.clip(x0i + 1, 0, W - 1)
+    # corner validity (zero-pad like upstream's bilinear in im2col)
+    vy0 = (y0 >= 0) & (y0 <= H - 1)
+    vy1 = (y0 + 1 >= 0) & (y0 + 1 <= H - 1)
+    vx0 = (x0 >= 0) & (x0 <= W - 1)
+    vx1 = (x0 + 1 >= 0) & (x0 + 1 <= W - 1)
+
+    cg = C // dg         # channels per deformable group
+    datag = data.reshape(B, dg, cg, H, W)
+
+    def per_group(img, yg, xg, vg):
+        # img: (cg, H, W); yg/xg/vg: (OH, OW, K) -> (OH, OW, K, cg)
+        vals = img[:, yg, xg]                     # (cg, OH, OW, K)
+        vals = jnp.where(vg[None], vals, 0.0)
+        return jnp.moveaxis(vals, 0, -1)
+
+    # vmap dg (img axis 0 / index axis 2), then batch
+    per_image = jax.vmap(per_group, in_axes=(0, 2, 2, 2), out_axes=2)
+
+    def gather_corner(yi, xi, v):
+        # yi/xi/v: (B, OH, OW, dg, K) -> (B, OH, OW, dg, K, cg)
+        return jax.vmap(per_image)(datag, yi, xi, v)
+
+    v00 = gather_corner(y0i, x0i, valid & vy0 & vx0)
+    v01 = gather_corner(y0i, x1i, valid & vy0 & vx1)
+    v10 = gather_corner(y1i, x0i, valid & vy1 & vx0)
+    v11 = gather_corner(y1i, x1i, valid & vy1 & vx1)
+    wy_ = wy[..., None]
+    wx_ = wx[..., None]
+    # samples: (B, OH, OW, dg, K, cg)
+    samples = (v00 * (1 - wy_) * (1 - wx_) + v01 * (1 - wy_) * wx_
+               + v10 * wy_ * (1 - wx_) + v11 * wy_ * wx_)
+    # columns in (C, K) order = deformable im2col
+    cols = jnp.moveaxis(samples, -1, 4)           # (B, OH, OW, dg, cg, K)
+    cols = cols.reshape(B, oh, ow, C, K)
+
+    # grouped contraction on the MXU
+    gc = C // num_group
+    cols_g = cols.reshape(B, oh, ow, num_group, gc, K)
+    w_g = weight.reshape(num_group, F // num_group, gc, kh * kw)
+    out = jnp.einsum("bhwgck,gfck->bhwgf", cols_g, w_g)
+    out = out.reshape(B, oh, ow, F).transpose(0, 3, 1, 2)
+    if bias is not None:
+        out = out + bias[None, :, None, None]
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Proposal / MultiProposal (RPN)
+# ---------------------------------------------------------------------------
+def generate_base_anchors(feature_stride=16, scales=(8, 16, 32),
+                          ratios=(0.5, 1, 2)):
+    """Upstream GenerateAnchor (proposal.cc): base anchors centred on a
+    feature_stride x feature_stride cell, corner format, numpy."""
+    base = np.array([0, 0, feature_stride - 1, feature_stride - 1], np.float32)
+    w = base[2] - base[0] + 1
+    h = base[3] - base[1] + 1
+    cx = base[0] + 0.5 * (w - 1)
+    cy = base[1] + 0.5 * (h - 1)
+    anchors = []
+    size = w * h
+    for r in ratios:
+        ws = np.round(np.sqrt(size / r))
+        hs = np.round(ws * r)
+        for s in scales:
+            w_s, h_s = ws * s, hs * s
+            anchors.append([cx - 0.5 * (w_s - 1), cy - 0.5 * (h_s - 1),
+                            cx + 0.5 * (w_s - 1), cy + 0.5 * (h_s - 1)])
+    return np.asarray(anchors, np.float32)
+
+
+def _bbox_transform_inv(boxes, deltas):
+    """Upstream BBoxTransformInv: apply (dx, dy, dw, dh) to corner boxes."""
+    w = boxes[:, 2] - boxes[:, 0] + 1.0
+    h = boxes[:, 3] - boxes[:, 1] + 1.0
+    cx = boxes[:, 0] + 0.5 * (w - 1.0)
+    cy = boxes[:, 1] + 0.5 * (h - 1.0)
+    dx, dy, dw, dh = deltas[:, 0], deltas[:, 1], deltas[:, 2], deltas[:, 3]
+    pcx = dx * w + cx
+    pcy = dy * h + cy
+    pw = jnp.exp(jnp.clip(dw, -10.0, 10.0)) * w
+    ph = jnp.exp(jnp.clip(dh, -10.0, 10.0)) * h
+    return jnp.stack([pcx - 0.5 * (pw - 1.0), pcy - 0.5 * (ph - 1.0),
+                      pcx + 0.5 * (pw - 1.0), pcy + 0.5 * (ph - 1.0)], -1)
+
+
+def multi_proposal(cls_prob, bbox_pred, im_info, rpn_pre_nms_top_n=6000,
+                   rpn_post_nms_top_n=300, threshold=0.7, rpn_min_size=16,
+                   scales=(4, 8, 16, 32), ratios=(0.5, 1, 2),
+                   feature_stride=16):
+    """RPN proposals, batched (upstream: src/operator/contrib/
+    multi_proposal.cc). STATIC shapes: fixed pre/post-NMS budgets.
+
+    cls_prob: (B, 2A, H, W) [background scores first, foreground second —
+    upstream layout]; bbox_pred: (B, 4A, H, W); im_info: (B, 3)
+    [height, width, scale]. Returns (rois (B*post, 5) [batch_idx, x0..y1],
+    scores (B*post, 1)). Slots past the surviving proposals repeat the
+    best box (a static-shape stand-in for upstream's duplicated-sample
+    padding; their score column is 0).
+    """
+    B, A2, H, W = cls_prob.shape
+    A = A2 // 2
+    base_np = generate_base_anchors(feature_stride, scales, ratios)
+    if base_np.shape[0] != A:
+        raise ValueError(
+            f"cls_prob implies {A} anchors/position but scales x ratios "
+            f"gives {base_np.shape[0]} ({len(scales)}x{len(ratios)})")
+    base = jnp.asarray(base_np)
+    shift_x = jnp.arange(W) * feature_stride
+    shift_y = jnp.arange(H) * feature_stride
+    sy, sx = jnp.meshgrid(shift_y, shift_x, indexing="ij")
+    shifts = jnp.stack([sx, sy, sx, sy], -1).reshape(-1, 4)   # (HW, 4)
+    anchors = (base[None, :, :] + shifts[:, None, :]).reshape(-1, 4)  # (HWA,4)
+    n_total = anchors.shape[0]
+    pre = min(rpn_pre_nms_top_n, n_total)
+    post = min(rpn_post_nms_top_n, pre)
+
+    def per_image(scores_map, deltas_map, info):
+        # foreground scores: channels [A:2A] -> (H, W, A) -> (HWA,)
+        fg = scores_map[A:].transpose(1, 2, 0).reshape(-1)
+        deltas = deltas_map.reshape(A, 4, H, W).transpose(2, 3, 0, 1)
+        deltas = deltas.reshape(-1, 4)
+        boxes = _bbox_transform_inv(anchors, deltas)
+        # clip to image
+        boxes = jnp.stack([
+            jnp.clip(boxes[:, 0], 0.0, info[1] - 1.0),
+            jnp.clip(boxes[:, 1], 0.0, info[0] - 1.0),
+            jnp.clip(boxes[:, 2], 0.0, info[1] - 1.0),
+            jnp.clip(boxes[:, 3], 0.0, info[0] - 1.0)], -1)
+        # min-size filter (scaled by im scale, upstream semantics)
+        min_sz = rpn_min_size * info[2]
+        ws = boxes[:, 2] - boxes[:, 0] + 1.0
+        hs = boxes[:, 3] - boxes[:, 1] + 1.0
+        ok = (ws >= min_sz) & (hs >= min_sz)
+        fg = jnp.where(ok, fg, -1.0)
+        # static pre-NMS top-k
+        top_s, top_i = lax.top_k(fg, pre)
+        top_b = boxes[top_i]
+        keep = nms(top_b, top_s, iou_threshold=threshold, max_out=post)
+        kept_s = jnp.where(keep & (top_s > -1.0), top_s, 0.0)
+        out_s, out_i = lax.top_k(kept_s, post)
+        out_b = top_b[out_i]
+        # empty slots repeat the best surviving box, score 0
+        out_b = jnp.where((out_s > 0)[:, None], out_b,
+                          jnp.broadcast_to(out_b[0], out_b.shape))
+        return out_b, out_s[:, None]
+
+    boxes, scores = jax.vmap(per_image)(cls_prob, bbox_pred, im_info)
+    batch_idx = jnp.repeat(jnp.arange(B, dtype=boxes.dtype), post)
+    rois = jnp.concatenate([batch_idx[:, None],
+                            boxes.reshape(B * post, 4)], -1)
+    return rois, scores.reshape(B * post, 1)
+
+
+def proposal(cls_prob, bbox_pred, im_info, **kwargs):
+    """Single-image Proposal (upstream: proposal.cc) — batch must be 1;
+    thin front over multi_proposal (identical math)."""
+    assert cls_prob.shape[0] == 1, "Proposal expects batch 1; use " \
+        "MultiProposal for batched inputs"
+    return multi_proposal(cls_prob, bbox_pred, im_info, **kwargs)
+
+
+# ---------------------------------------------------------------------------
+# fft / ifft / count_sketch
+# ---------------------------------------------------------------------------
+def fft(data):
+    """Upstream contrib.fft (fft.cc): (..., d) real -> (..., 2d) with
+    interleaved [re, im] pairs along the last axis."""
+    z = jnp.fft.fft(data.astype(jnp.float32), axis=-1)
+    out = jnp.stack([z.real, z.imag], -1)
+    return out.reshape(*data.shape[:-1], 2 * data.shape[-1]).astype(
+        jnp.float32)
+
+
+def ifft(data):
+    """Upstream contrib.ifft: (..., 2d) interleaved [re, im] -> (..., d)
+    real part of the UNNORMALISED inverse transform — upstream does not
+    divide by d, so ifft(fft(x)) == d * x (pinned in tests)."""
+    d = data.shape[-1] // 2
+    pairs = data.reshape(*data.shape[:-1], d, 2)
+    z = lax.complex(pairs[..., 0].astype(jnp.float32),
+                    pairs[..., 1].astype(jnp.float32))
+    return (jnp.fft.ifft(z, axis=-1).real * d).astype(jnp.float32)
+
+
+def count_sketch(data, h, s, out_dim):
+    """Count sketch projection (upstream: count_sketch.cc): out[n, h[j]]
+    += s[j] * data[n, j]. h: (d,) ints in [0, out_dim); s: (d,) signs.
+
+    TPU design: the scatter-add is a one-hot (d, out_dim) matmul — the
+    MXU eats it; no atomics, deterministic."""
+    h = jnp.asarray(h).reshape(-1).astype(jnp.int32)
+    s = jnp.asarray(s).reshape(-1).astype(data.dtype)
+    proj = jax.nn.one_hot(h, out_dim, dtype=data.dtype) * s[:, None]
+    return data @ proj
+
+
+# ---------------------------------------------------------------------------
+# reference-layout kernels SHARED by nd.contrib and sym.contrib (one
+# implementation of each transform; the two front ends only adapt calling
+# conventions)
+# ---------------------------------------------------------------------------
+def to_corner(x, fmt):
+    """Box layout cast: 'corner' passthrough, 'center' (cx,cy,w,h) ->
+    (x0,y0,x1,y1) (upstream box format attr)."""
+    if fmt == "corner":
+        return x
+    if fmt == "center":
+        half = x[..., 2:] * 0.5
+        return jnp.concatenate([x[..., :2] - half, x[..., :2] + half], -1)
+    raise ValueError(f"unknown box format {fmt!r}")
+
+
+def box_iou_generic(lhs, rhs, format="corner"):
+    """Pairwise IoU with shared leading batch dims (upstream:
+    contrib.box_iou): (..., N, 4) x (..., M, 4) -> (..., N, M)."""
+    a = to_corner(lhs, format)
+    b = to_corner(rhs, format)
+    if a.ndim <= 2 and b.ndim <= 2:
+        return box_iou(a, b)
+    if a.shape[:-2] != b.shape[:-2]:
+        raise ValueError("box_iou batch dims must match "
+                         f"({a.shape[:-2]} vs {b.shape[:-2]})")
+    batch = a.shape[:-2]
+    out = jax.vmap(box_iou)(a.reshape((-1,) + a.shape[-2:]),
+                            b.reshape((-1,) + b.shape[-2:]))
+    return out.reshape(batch + out.shape[-2:])
+
+
+def multibox_prior_k(data, sizes=(1.0,), ratios=(1.0,), clip=False,
+                     offsets=(0.5, 0.5), steps=(-1.0, -1.0)):
+    """Anchors for a feature map (upstream: contrib.MultiBoxPrior):
+    data (B, C, H, W) -> (1, H*W*K, 4) normalised corners. `steps`
+    overrides the implicit 1/feat cell stride (SSD presets pass the
+    backbone stride explicitly)."""
+    from .detection_ops import multibox_prior
+    boxes = multibox_prior(data.shape[-2], data.shape[-1],
+                           sizes=tuple(sizes), ratios=tuple(ratios),
+                           offsets=tuple(offsets), steps=tuple(steps))
+    boxes = jnp.asarray(boxes.clip(0.0, 1.0) if clip else boxes)
+    return boxes[None]
+
+
+def multibox_target_k(anchor, label, cls_pred, overlap_threshold=0.5,
+                      variances=(0.1, 0.1, 0.2, 0.2)):
+    """Upstream contrib.MultiBoxTarget triple: anchor (1, A, 4), label
+    (B, M, 5), cls_pred (B, C+1, A) [shape source only] ->
+    [loc_target (B, A*4), loc_mask (B, A*4), cls_target (B, A)]."""
+    from .detection_ops import multibox_target
+    cls_t, loc_t, loc_m = multibox_target(
+        anchor[0], label, ious_threshold=overlap_threshold,
+        variances=tuple(variances))
+    B, A = cls_t.shape
+    mask4 = jnp.broadcast_to(loc_m, loc_t.shape)
+    return (loc_t.reshape(B, A * 4) * mask4.reshape(B, A * 4),
+            mask4.reshape(B, A * 4), cls_t.astype(jnp.float32))
+
+
+def multibox_detection_k(cls_prob, loc_pred, anchor, threshold=0.01,
+                         nms_threshold=0.45, nms_topk=400, max_det=100,
+                         variances=(0.1, 0.1, 0.2, 0.2)):
+    """Upstream contrib.MultiBoxDetection with a STATIC max_det budget."""
+    from .detection_ops import multibox_detection
+    return multibox_detection(
+        cls_prob, loc_pred, anchor[0], nms_threshold=nms_threshold,
+        score_threshold=threshold, nms_topk=int(nms_topk),
+        max_det=int(max_det), variances=tuple(variances))
+
+
+# ---------------------------------------------------------------------------
+# batched ROIAlign + reference-layout box_nms
+# ---------------------------------------------------------------------------
+def roi_align_batched(data, rois, pooled_size=(7, 7), spatial_scale=1.0,
+                      sample_ratio=2):
+    """Upstream contrib.ROIAlign signature (roi_align.cc): data
+    (B, C, H, W), rois (R, 5) [batch_idx, x0, y0, x1, y1] in input
+    coords -> (R, C, ph, pw). Rows with batch_idx < 0 yield zeros
+    (upstream's invalid-roi convention)."""
+    idx = rois[:, 0].astype(jnp.int32)
+    boxes = rois[:, 1:]
+    feats = data[jnp.clip(idx, 0, data.shape[0] - 1)]  # (R, C, H, W)
+
+    def one(f, b):
+        return roi_align(f, b[None], out_size=pooled_size,
+                         spatial_scale=spatial_scale,
+                         sampling_ratio=sample_ratio)[0]
+
+    out = jax.vmap(one)(feats, boxes)
+    return jnp.where((idx >= 0)[:, None, None, None], out, 0.0)
+
+
+def box_nms(data, overlap_thresh=0.5, valid_thresh=0.0, topk=-1,
+            coord_start=2, score_index=1, id_index=-1, background_id=-1,
+            force_suppress=False):
+    """Upstream contrib.box_nms (bounding_box.cc): data (..., N, K) rows
+    holding [.., score, .., x0, y0, x1, y1, ..]; suppressed/invalid rows
+    come back as all -1, survivors sorted by descending score (upstream's
+    output convention)."""
+    batched = data.ndim == 3
+    arr = data if batched else data[None]
+    _, N, K = arr.shape
+
+    def per_batch(rows):
+        scores = rows[:, score_index]
+        boxes = lax.dynamic_slice_in_dim(rows, coord_start, 4, 1)
+        valid = scores > valid_thresh
+        s = jnp.where(valid, scores, -jnp.inf)
+        if topk > 0:
+            kth = lax.top_k(s, min(topk, N))[0][-1]
+            s = jnp.where(s >= kth, s, -jnp.inf)
+        cls = None
+        if id_index >= 0 and not force_suppress:
+            cls = rows[:, id_index]
+            if background_id >= 0:
+                s = jnp.where(cls == background_id, -jnp.inf, s)
+        keep = nms(boxes, jnp.where(jnp.isfinite(s), s, -1e30), overlap_thresh,
+                   max_out=N,
+                   class_ids=cls.astype(jnp.int32) if cls is not None
+                   else None)
+        keep = keep & jnp.isfinite(s)
+        # survivors first, by descending score; dead rows are -1
+        order = jnp.argsort(jnp.where(keep, -scores, jnp.inf))
+        out = jnp.where(keep[order][:, None], rows[order], -1.0)
+        return out
+
+    out = jax.vmap(per_batch)(arr)
+    return out if batched else out[0]

@@ -53,10 +53,13 @@ def box_decode(pred, anchors, variances=(0.1, 0.1, 0.2, 0.2)):
     return jnp.concatenate([xy - 0.5 * wh, xy + 0.5 * wh], -1)
 
 
-def multibox_prior(feat_h, feat_w, sizes=(1.0,), ratios=(1.0,), offsets=(0.5, 0.5)):
+def multibox_prior(feat_h, feat_w, sizes=(1.0,), ratios=(1.0,),
+                   offsets=(0.5, 0.5), steps=(-1.0, -1.0)):
     """Anchor boxes for one feature map, normalised corner format
     (reference: MultiBoxPrior). Returns (feat_h*feat_w*K, 4) numpy, where
-    K = len(sizes) + len(ratios) - 1 (first size pairs with every ratio)."""
+    K = len(sizes) + len(ratios) - 1 (first size pairs with every ratio).
+    `steps` (y, x) overrides the implicit 1/feat cell stride when > 0
+    (upstream's explicit-stride attr used by SSD presets)."""
     ws, hs = [], []
     for i, s in enumerate(sizes):
         for j, r in enumerate(ratios):
@@ -65,8 +68,10 @@ def multibox_prior(feat_h, feat_w, sizes=(1.0,), ratios=(1.0,), offsets=(0.5, 0.
             ws.append(s * np.sqrt(r))
             hs.append(s / np.sqrt(r))
     ws, hs = np.asarray(ws), np.asarray(hs)
-    cy = (np.arange(feat_h) + offsets[0]) / feat_h
-    cx = (np.arange(feat_w) + offsets[1]) / feat_w
+    step_y = steps[0] if steps[0] > 0 else 1.0 / feat_h
+    step_x = steps[1] if steps[1] > 0 else 1.0 / feat_w
+    cy = (np.arange(feat_h) + offsets[0]) * step_y
+    cx = (np.arange(feat_w) + offsets[1]) * step_x
     cyx = np.stack(np.meshgrid(cy, cx, indexing="ij"), -1)  # (H, W, 2)
     cyx = np.repeat(cyx.reshape(-1, 1, 2), len(ws), 1)      # (HW, K, 2)
     wh = np.stack([ws, hs], -1)[None]                        # (1, K, 2)
@@ -91,13 +96,17 @@ def multibox_target(anchors, labels, ious_threshold=0.5,
         iou = jnp.where(valid[None, :], iou, 0.0)
         best_gt = jnp.argmax(iou, 1)                     # (A,)
         best_iou = jnp.max(iou, 1)
-        # force-match: each valid gt claims its best anchor
+        # force-match: each valid gt claims its best anchor. Invalid
+        # (padding) gts must not scatter at all — their argmax lands on
+        # anchor 0 and a duplicate-index write could overwrite a valid
+        # gt's claim — so their index is pushed out of bounds and dropped.
         best_anchor = jnp.argmax(iou, 0)                 # (M,)
+        scatter_idx = jnp.where(valid, best_anchor, anchors.shape[0])
         forced = jnp.zeros(anchors.shape[0], bool)
-        forced = forced.at[best_anchor].set(valid)
+        forced = forced.at[scatter_idx].set(True, mode="drop")
         gt_of_forced = jnp.zeros(anchors.shape[0], jnp.int32)
-        gt_of_forced = gt_of_forced.at[best_anchor].set(
-            jnp.arange(lab.shape[0], dtype=jnp.int32))
+        gt_of_forced = gt_of_forced.at[scatter_idx].set(
+            jnp.arange(lab.shape[0], dtype=jnp.int32), mode="drop")
         pos = jnp.logical_or(best_iou >= ious_threshold, forced)
         assigned = jnp.where(forced, gt_of_forced, best_gt.astype(jnp.int32))
         cls_t = jnp.where(pos, gt_cls[assigned].astype(jnp.int32) + 1, 0)

@@ -367,3 +367,203 @@ def arange_like(data, start=0.0, step=1.0, repeat=1, axis=None, name=None):
 __all__ += ["interleaved_matmul_selfatt_qk",
             "interleaved_matmul_selfatt_valatt", "div_sqrt_dim",
             "arange_like"]
+
+
+# ---------------------------------------------------------------------------
+# detection / vision contrib ops (upstream: src/operator/contrib/ — see
+# ops/contrib_ops.py for the TPU kernel designs). Registered as pure
+# kernels so graphs using them serialise/round-trip like any other op.
+# ---------------------------------------------------------------------------
+from ..ops import detection_ops as _det
+from ..ops import contrib_ops as _cops
+
+
+del _det  # kernels live in ops/contrib_ops.py; nothing here uses _det
+
+
+def _roi_align_k(data, rois, pooled_size=(7, 7), spatial_scale=1.0,
+                 sample_ratio=2):
+    return _cops.roi_align_batched(
+        data, rois, pooled_size=tuple(pooled_size),
+        spatial_scale=spatial_scale, sample_ratio=max(int(sample_ratio), 1))
+
+
+def _box_nms_k(data, overlap_thresh=0.5, valid_thresh=0.0, topk=-1,
+               coord_start=2, score_index=1, id_index=-1, background_id=-1,
+               force_suppress=False):
+    return _cops.box_nms(
+        data, overlap_thresh=overlap_thresh, valid_thresh=valid_thresh,
+        topk=int(topk), coord_start=int(coord_start),
+        score_index=int(score_index), id_index=int(id_index),
+        background_id=int(background_id),
+        force_suppress=bool(force_suppress))
+
+
+def _box_iou_k(lhs, rhs, format="corner"):
+    return _cops.box_iou_generic(lhs, rhs, format=format)
+
+
+def _multibox_prior_k(data, sizes=(1.0,), ratios=(1.0,), clip=False,
+                      offsets=(0.5, 0.5), steps=(-1.0, -1.0)):
+    return _cops.multibox_prior_k(data, sizes=tuple(sizes),
+                                  ratios=tuple(ratios), clip=bool(clip),
+                                  offsets=tuple(offsets),
+                                  steps=tuple(steps))
+
+
+def _multibox_target_k(anchor, label, cls_pred, overlap_threshold=0.5,
+                       variances=(0.1, 0.1, 0.2, 0.2)):
+    return _cops.multibox_target_k(anchor, label, cls_pred,
+                                   overlap_threshold=overlap_threshold,
+                                   variances=tuple(variances))
+
+
+def _multibox_detection_k(cls_prob, loc_pred, anchor, threshold=0.01,
+                          nms_threshold=0.45, nms_topk=400, max_det=100,
+                          variances=(0.1, 0.1, 0.2, 0.2)):
+    return _cops.multibox_detection_k(
+        cls_prob, loc_pred, anchor, threshold=threshold,
+        nms_threshold=nms_threshold, nms_topk=int(nms_topk),
+        max_det=int(max_det), variances=tuple(variances))
+
+
+def _multi_proposal_k(cls_prob, bbox_pred, im_info, rpn_pre_nms_top_n=6000,
+                      rpn_post_nms_top_n=300, threshold=0.7, rpn_min_size=16,
+                      scales=(4, 8, 16, 32), ratios=(0.5, 1, 2),
+                      feature_stride=16):
+    rois, _scores = _cops.multi_proposal(
+        cls_prob, bbox_pred, im_info,
+        rpn_pre_nms_top_n=int(rpn_pre_nms_top_n),
+        rpn_post_nms_top_n=int(rpn_post_nms_top_n), threshold=threshold,
+        rpn_min_size=rpn_min_size, scales=tuple(scales),
+        ratios=tuple(ratios), feature_stride=int(feature_stride))
+    return rois
+
+
+def _deformable_conv_k(*arrs, kernel=(3, 3), stride=(1, 1), dilate=(1, 1),
+                       pad=(0, 0), num_group=1, num_deformable_group=1):
+    data, offset, weight = arrs[:3]
+    bias = arrs[3] if len(arrs) > 3 else None
+    return _cops.deformable_convolution(
+        data, offset, weight, bias=bias, kernel=tuple(kernel),
+        stride=tuple(stride), dilate=tuple(dilate), pad=tuple(pad),
+        num_group=int(num_group),
+        num_deformable_group=int(num_deformable_group))
+
+
+def _count_sketch_k(data, h, s, out_dim=0):
+    return _cops.count_sketch(data, h, s, int(out_dim))
+
+
+register_op("_contrib_ROIAlign", _roi_align_k)
+register_op("_contrib_box_nms", _box_nms_k)
+register_op("_contrib_box_iou", _box_iou_k)
+register_op("_contrib_MultiBoxPrior", _multibox_prior_k)
+register_op("_contrib_MultiBoxTarget", _multibox_target_k)
+register_op("_contrib_MultiBoxDetection", _multibox_detection_k)
+register_op("_contrib_MultiProposal", _multi_proposal_k)
+register_op("_contrib_DeformableConvolution", _deformable_conv_k)
+register_op("_contrib_fft", lambda x, compute_size=128: _cops.fft(x))
+register_op("_contrib_ifft", lambda x, compute_size=128: _cops.ifft(x))
+register_op("_contrib_count_sketch", _count_sketch_k)
+
+
+def ROIAlign(data, rois, pooled_size=(7, 7), spatial_scale=1.0,
+             sample_ratio=2, name=None, **kw):
+    return _make("_contrib_ROIAlign", [data, rois],
+                 {"pooled_size": list(pooled_size),
+                  "spatial_scale": spatial_scale,
+                  "sample_ratio": sample_ratio}, name=name)
+
+
+def box_nms(data, overlap_thresh=0.5, valid_thresh=0.0, topk=-1,
+            coord_start=2, score_index=1, id_index=-1, background_id=-1,
+            force_suppress=False, name=None, **kw):
+    return _make("_contrib_box_nms", [data],
+                 {"overlap_thresh": overlap_thresh,
+                  "valid_thresh": valid_thresh, "topk": topk,
+                  "coord_start": coord_start, "score_index": score_index,
+                  "id_index": id_index, "background_id": background_id,
+                  "force_suppress": force_suppress}, name=name)
+
+
+box_non_maximum_suppression = box_nms
+
+
+def box_iou(lhs, rhs, format="corner", name=None, **kw):
+    return _make("_contrib_box_iou", [lhs, rhs], {"format": format},
+                 name=name)
+
+
+def MultiBoxPrior(data, sizes=(1.0,), ratios=(1.0,), clip=False,
+                  steps=(-1.0, -1.0), offsets=(0.5, 0.5), name=None, **kw):
+    return _make("_contrib_MultiBoxPrior", [data],
+                 {"sizes": list(sizes), "ratios": list(ratios),
+                  "clip": clip, "offsets": list(offsets),
+                  "steps": list(steps)}, name=name)
+
+
+def MultiBoxTarget(anchor, label, cls_pred, overlap_threshold=0.5,
+                   variances=(0.1, 0.1, 0.2, 0.2), name=None, **kw):
+    return _make("_contrib_MultiBoxTarget", [anchor, label, cls_pred],
+                 {"overlap_threshold": overlap_threshold,
+                  "variances": list(variances)}, name=name, n_out=3)
+
+
+def MultiBoxDetection(cls_prob, loc_pred, anchor, threshold=0.01,
+                      nms_threshold=0.45, nms_topk=400, max_det=100,
+                      variances=(0.1, 0.1, 0.2, 0.2), name=None, **kw):
+    return _make("_contrib_MultiBoxDetection", [cls_prob, loc_pred, anchor],
+                 {"threshold": threshold, "nms_threshold": nms_threshold,
+                  "nms_topk": nms_topk, "max_det": max_det,
+                  "variances": list(variances)}, name=name)
+
+
+def MultiProposal(cls_prob, bbox_pred, im_info, rpn_pre_nms_top_n=6000,
+                  rpn_post_nms_top_n=300, threshold=0.7, rpn_min_size=16,
+                  scales=(4, 8, 16, 32), ratios=(0.5, 1, 2),
+                  feature_stride=16, name=None, **kw):
+    return _make("_contrib_MultiProposal", [cls_prob, bbox_pred, im_info],
+                 {"rpn_pre_nms_top_n": rpn_pre_nms_top_n,
+                  "rpn_post_nms_top_n": rpn_post_nms_top_n,
+                  "threshold": threshold, "rpn_min_size": rpn_min_size,
+                  "scales": list(scales), "ratios": list(ratios),
+                  "feature_stride": feature_stride}, name=name)
+
+
+Proposal = MultiProposal
+
+
+def DeformableConvolution(data, offset, weight, bias=None, kernel=(3, 3),
+                          stride=(1, 1), dilate=(1, 1), pad=(0, 0),
+                          num_filter=0, num_group=1, num_deformable_group=1,
+                          no_bias=False, name=None, **kw):
+    ins = [data, offset, weight]
+    if bias is not None and not no_bias:
+        ins.append(bias)
+    return _make("_contrib_DeformableConvolution", ins,
+                 {"kernel": list(kernel), "stride": list(stride),
+                  "dilate": list(dilate), "pad": list(pad),
+                  "num_group": num_group,
+                  "num_deformable_group": num_deformable_group}, name=name)
+
+
+def fft(data, compute_size=128, name=None, **kw):
+    return _make("_contrib_fft", [data], {"compute_size": compute_size},
+                 name=name)
+
+
+def ifft(data, compute_size=128, name=None, **kw):
+    return _make("_contrib_ifft", [data], {"compute_size": compute_size},
+                 name=name)
+
+
+def count_sketch(data, h, s, out_dim, name=None, **kw):
+    return _make("_contrib_count_sketch", [data, h, s],
+                 {"out_dim": out_dim}, name=name)
+
+
+__all__ += ["ROIAlign", "box_nms", "box_non_maximum_suppression", "box_iou",
+            "MultiBoxPrior", "MultiBoxTarget", "MultiBoxDetection",
+            "Proposal", "MultiProposal", "DeformableConvolution",
+            "fft", "ifft", "count_sketch"]

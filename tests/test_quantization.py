@@ -107,9 +107,11 @@ def test_quantize_net_nested_sequential():
     assert err < 0.1, err
 
 
-def test_quantize_net_custom_block_refused():
-    """Quantizable layers hidden in a custom block raise instead of
-    silently running fp32."""
+def test_quantize_net_custom_block_supported():
+    """Quantizable layers inside CUSTOM blocks are rewired too (r3 weak 3:
+    the old implementation refused anything but Sequential trees)."""
+    mx.random.seed(6)
+
     class Custom(nn.HybridBlock):
         def __init__(self, **kw):
             super().__init__(**kw)
@@ -117,13 +119,149 @@ def test_quantize_net_custom_block_refused():
                 self.fc = nn.Dense(4, in_units=4)
 
         def hybrid_forward(self, F, x):
-            return self.fc(x)
+            return self.fc(x) + x          # residual: not a plain chain
 
     net = nn.HybridSequential()
     net.add(Custom())
     net.initialize()
-    with pytest.raises(Exception):
-        q.quantize_net(net)
+    x = nd.random.uniform(-1, 1, shape=(2, 4))
+    y_fp = net(x).asnumpy()
+    qnet = q.quantize_net(net)
+    assert len(qnet.quantized_layers) == 1
+    y_q = qnet(x).asnumpy()
+    err = np.abs(y_fp - y_q).max() / (np.abs(y_fp).max() + 1e-6)
+    assert err < 0.1, err
+    # the ORIGINAL net still runs fp32 when called directly
+    np.testing.assert_allclose(net(x).asnumpy(), y_fp, rtol=1e-6)
+
+
+def test_quantize_net_zoo_resnet18():
+    """The obvious int8 target works end to end: quantize_net over a zoo
+    resnet18 (custom residual HybridBlocks), classification decisions
+    within 1% of fp32 on synthetic data (VERDICT r3 item 4 done-bar)."""
+    mx.random.seed(7)
+    from mxnet_tpu.gluon.model_zoo.vision import resnet18_v1
+    net = resnet18_v1(classes=10)
+    net.initialize()
+    x = nd.random.uniform(0, 1, shape=(8, 3, 32, 32))
+    y_fp = net(x).asnumpy()
+    qnet = q.quantize_net(net, calib_data=[x], calib_mode="naive")
+    assert len(qnet.quantized_layers) >= 18   # convs + fc
+    y_q = qnet(x).asnumpy()
+    agree = (y_fp.argmax(1) == y_q.argmax(1)).mean()
+    assert agree >= 0.99, agree
+    rel = np.abs(y_fp - y_q).max() / (np.abs(y_fp).max() + 1e-6)
+    assert rel < 0.15, rel
+
+
+def test_entropy_calibration_beats_naive_on_skewed_activations():
+    """A heavy-tailed input (one huge outlier) wrecks max-abs scaling;
+    the KL threshold clips the tail and must reconstruct the bulk better
+    (VERDICT r3 item 4 done-bar)."""
+    mx.random.seed(8)
+    rs = np.random.RandomState(0)
+    bulk = rs.uniform(-1, 1, size=(256, 32)).astype(np.float32)
+    bulk[0, 0] = 80.0           # lone outlier -> naive scale 80/127
+    dense = nn.Dense(16, in_units=32)
+    dense.initialize()
+
+    def quantize_with(mode):
+        net = nn.HybridSequential()
+        net.add(dense)
+        qnet = q.quantize_net(net, calib_data=[nd.array(bulk)],
+                              calib_mode=mode)
+        (layer,) = qnet.quantized_layers
+        return qnet, layer
+
+    _, naive_layer = quantize_with("naive")
+    q_ent, ent_layer = quantize_with("entropy")
+    assert ent_layer._act_scale < naive_layer._act_scale * 0.5, \
+        (ent_layer._act_scale, naive_layer._act_scale)
+    # reconstruction of the BULK is tighter under the entropy scale
+    x_eval = nd.array(rs.uniform(-1, 1, size=(64, 32)).astype(np.float32))
+    y_fp = dense(x_eval).asnumpy()
+    err_ent = np.abs(q_ent(x_eval).asnumpy() - y_fp).mean()
+    s_naive = float(naive_layer._act_scale)
+    # naive error floor ~ uniform quantization noise at scale 80/127
+    assert err_ent < s_naive, (err_ent, s_naive)
+
+
+def test_kl_threshold_closed_form():
+    """Decaying bulk + lone outlier -> threshold well below amax (coarse
+    128-level merges can't reconstruct a non-uniform bulk, clipping can)."""
+    hist = np.zeros(2048)
+    hist[:128] = np.linspace(1000.0, 10.0, 128)   # decaying bulk
+    hist[-1] = 1.0                                 # outlier at amax
+    t = q.kl_optimal_threshold(hist, amax=80.0)
+    assert t < 20.0, t
+    # uniform histogram -> keep (close to) the full range
+    t_full = q.kl_optimal_threshold(np.ones(2048), amax=1.0)
+    assert t_full > 0.9
+
+
+def test_uint8_activations_zero_point_decomposition():
+    """quantized_dtype='uint8' on non-negative activations: the int8
+    MXU path + 128-correction must match fp32 within uint8 resolution,
+    and beat int8 resolution on the same data."""
+    mx.random.seed(9)
+    dense = nn.Dense(16, in_units=32)
+    dense.initialize()
+    x = nd.random.uniform(0, 1, shape=(64, 32))    # post-relu-like
+    net = nn.HybridSequential()
+    net.add(dense)
+    y_fp = dense(x).asnumpy()
+
+    q_u8 = q.quantize_net(net, quantized_dtype="uint8", calib_data=[x])
+    (l_u8,) = q_u8.quantized_layers
+    assert l_u8._act_unsigned
+    err_u8 = np.abs(q_u8(x).asnumpy() - y_fp).mean()
+
+    q_s8 = q.quantize_net(net, quantized_dtype="int8", calib_data=[x])
+    err_s8 = np.abs(q_s8(x).asnumpy() - y_fp).mean()
+    assert err_u8 < err_s8, (err_u8, err_s8)
+
+    # 'auto' picks uint8 for non-negative ranges
+    q_auto = q.quantize_net(net, quantized_dtype="auto", calib_data=[x])
+    (l_auto,) = q_auto.quantized_layers
+    assert l_auto._act_unsigned
+
+
+def test_uint8_conv_border_correction():
+    """The zero-point correction map is border-aware under zero padding:
+    a padded uint8 conv must still match fp32 at the edges."""
+    mx.random.seed(10)
+    conv = nn.Conv2D(4, kernel_size=3, padding=1, in_channels=2)
+    conv.initialize()
+    x = nd.random.uniform(0, 1, shape=(2, 2, 6, 6))
+    net = nn.HybridSequential()
+    net.add(conv)
+    y_fp = conv(x).asnumpy()
+    qnet = q.quantize_net(net, quantized_dtype="uint8", calib_data=[x])
+    y_q = qnet(x).asnumpy()
+    err = np.abs(y_fp - y_q).max() / (np.abs(y_fp).max() + 1e-6)
+    assert err < 0.05, err
+
+
+def test_quantize_net_inside_hybridize_trace():
+    """A hybridized parent jit-traces THROUGH the routers: int8 math in
+    the compiled executable, and mode-private caches keep fp32/int8
+    executables separate."""
+    mx.random.seed(11)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, activation="relu", in_units=4),
+            nn.Dense(2, in_units=8))
+    net.initialize()
+    x = nd.random.uniform(-1, 1, shape=(4, 4))
+    y_fp_pre = net(x).asnumpy()
+    net.hybridize()
+    net(x)                       # build the fp32 compiled cache
+    qnet = q.quantize_net(net)
+    y_q = qnet(x).asnumpy()
+    y_fp_post = net(x).asnumpy()       # original net: still fp32 math
+    np.testing.assert_allclose(y_fp_post, y_fp_pre, rtol=1e-5, atol=1e-6)
+    assert np.abs(y_q - y_fp_pre).max() > 0  # actually quantized
+    err = np.abs(y_q - y_fp_pre).max() / (np.abs(y_fp_pre).max() + 1e-6)
+    assert err < 0.1, err
 
 
 def test_quantized_conv_dilation_and_groups():
@@ -147,3 +285,52 @@ def test_quantized_dense_sigmoid_activation():
     y_fp = dense(x).asnumpy()
     y_q = q.QuantizedDense(dense)(x).asnumpy()
     np.testing.assert_allclose(y_fp, y_q, atol=0.02)
+
+
+def test_calibration_on_hybridized_net():
+    """Calibration must not run inside a jit trace (observe() reads
+    concrete values): a pre-hybridized, pre-compiled net calibrates fine
+    and then runs int8 through the compiled path."""
+    mx.random.seed(12)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, activation="relu", in_units=4))
+    net.initialize()
+    x = nd.random.uniform(-1, 1, shape=(128, 4))
+    net.hybridize()
+    net(x)                        # compiled fp32 cache exists
+    qnet = q.quantize_net(net, calib_data=[x], calib_mode="entropy")
+    (layer,) = qnet.quantized_layers
+    assert layer._act_scale is not None
+    y_q = qnet(x).asnumpy()
+    y_fp = net(x).asnumpy()
+    err = np.abs(y_q - y_fp).max() / (np.abs(y_fp).max() + 1e-6)
+    assert err < 0.1, err
+    # hybridization flags restored after calibration
+    assert net._active
+
+
+def test_uint8_conv_no_tracer_leak_across_jit_boundary():
+    """The +128 correction map computed inside a jit trace must not be
+    cached and served to a later EAGER call of the same shape."""
+    mx.random.seed(13)
+    conv = nn.Conv2D(4, kernel_size=3, padding=1, in_channels=2)
+    conv.initialize()
+    net = nn.HybridSequential()
+    net.add(conv)
+    x = nd.random.uniform(0, 1, shape=(1, 2, 5, 5))
+    qnet = q.quantize_net(net, quantized_dtype="uint8", calib_data=[x])
+    net.hybridize()
+    y_jit = qnet(x).asnumpy()       # populates nothing tracer-shaped...
+    net.hybridize(False)
+    y_eager = qnet(x).asnumpy()     # ...or this raises UnexpectedTracer
+    np.testing.assert_allclose(y_jit, y_eager, rtol=1e-5, atol=1e-6)
+
+
+def test_uint8_requires_calibrating_mode():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(4, in_units=4))
+    net.initialize()
+    x = nd.random.uniform(0, 1, shape=(2, 4))
+    with pytest.raises(Exception, match="calib_mode"):
+        q.quantize_net(net, quantized_dtype="uint8", calib_data=[x],
+                       calib_mode=None)
