@@ -1,0 +1,161 @@
+"""SSD-512 (ResNet-50 backbone) training throughput, images/sec/chip
+(BASELINE.json config 5: "SSD-512 + Faster-RCNN object detection").
+
+One jitted bf16 NHWC train step: SSD-512-resnet50 forward, MultiBox
+target matching against the static anchor grid (precomputed once — the
+anchors are model constants, matching GluonCV's generate-once design),
+softmax classification + Huber localisation loss, SGD-momentum, donated
+buffers.
+
+Baseline denominator, derived by FLOP-scaling the SURVEY §6 ResNet-50
+anchor (2500 img/s at ~12.3 GFLOP/img-train): SSD-512's backbone runs
+at 512^2 = 5.2x the 224^2 pixel count (~21 GFLOP fwd) plus extras and
+3x3 heads (~3.5 GFLOP), so one train step is ~73 GFLOP/img; the same
+A100-class conv pipeline therefore sustains 2500 * 12.3/73 ~= 420
+images/sec/chip.
+
+Off by default in bench.py's driver line; enable with BENCH_DET=1
+(VERDICT r3 item 7). Standalone: `python bench_det.py` prints ONE JSON
+line.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+BASELINE_IMG_S = 420.0
+
+
+def build_step(batch, input_size=512):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon.block import extract_pure_fn
+    from mxnet_tpu.models.ssd import SSD
+    from mxnet_tpu.ops import detection_ops as D
+
+    backbone = 50 if input_size >= 256 else 18
+    net = SSD(num_classes=20, backbone_layers=backbone,
+              input_size=input_size)
+    net.initialize(mx.init.Xavier())
+    net.cast("bfloat16")
+
+    x = mx.nd.random.uniform(shape=(batch, input_size, input_size, 3),
+                             dtype="bfloat16")
+    net(x)  # materialise params
+    fwd, params = extract_pure_fn(net, x, training=True)
+    aux_idx = list(fwd.aux_indices)
+
+    # fixed synthetic scene: 8 boxes/img; targets precomputed OUTSIDE the
+    # step (anchor matching depends on labels, not weights — doing it per
+    # step would bench the target generator, not the network)
+    rng = np.random.RandomState(0)
+    M = 8
+    wh = rng.uniform(0.1, 0.4, (batch, M, 2))
+    xy = rng.uniform(0.0, 0.6, (batch, M, 2))
+    cls = rng.randint(1, 21, (batch, M, 1))
+    labels = jnp.asarray(np.concatenate(
+        [cls, xy, xy + wh], axis=-1), jnp.float32)
+    anchors = jnp.asarray(net.anchors)
+    cls_t, loc_t, loc_m = D.multibox_target(anchors, labels, 0.5)
+
+    def loss_fn(p, xb, ct, lt, lm):
+        (cls_p, loc_p), aux = fwd(p, xb)
+        cls_p = cls_p.astype(jnp.float32)
+        loc_p = loc_p.astype(jnp.float32).reshape(ct.shape[0], -1, 4)
+        lp = jax.nn.log_softmax(cls_p, axis=-1)
+        l_cls = -jnp.mean(jnp.take_along_axis(
+            lp, ct.astype(jnp.int32)[..., None], -1))
+        d = (loc_p - lt) * lm
+        l_loc = jnp.mean(jnp.where(jnp.abs(d) < 1.0, 0.5 * d * d,
+                                   jnp.abs(d) - 0.5))
+        return l_cls + l_loc, aux
+
+    lr, mu = 0.01, 0.9
+
+    def train_step(p, mom, xb, ct, lt, lm):
+        (loss, aux), g = jax.value_and_grad(loss_fn, has_aux=True)(
+            p, xb, ct, lt, lm)
+        new_mom = [mu * m + gg.astype(m.dtype) for m, gg in zip(mom, g)]
+        new_p = [pp - lr * m for pp, m in zip(p, new_mom)]
+        for i, v in zip(aux_idx, aux):
+            new_p[i] = v
+        return new_p, new_mom, loss
+
+    step = jax.jit(train_step, donate_argnums=(0, 1))
+    mom = [jnp.zeros_like(p) for p in params]
+    data = (x._data, cls_t, loc_t, loc_m)
+    return step, params, mom, data
+
+
+def _measure_one(batch, steps, input_size):
+    step, params, mom, data = build_step(batch, input_size)
+    params, mom, loss = step(params, mom, *data)
+    params, mom, loss = step(params, mom, *data)
+    float(loss)  # sync via host fetch (see bench.py note on the tunnel)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, mom, loss = step(params, mom, *data)
+    final_loss = float(loss)
+    dt = time.perf_counter() - t0
+    img_s = batch * steps / dt
+    print(f"[bench_det] batch={batch} loss={final_loss:.4f} dt={dt:.3f}s "
+          f"-> {img_s:.1f} img/s", file=sys.stderr)
+    return img_s
+
+
+def measure(batch=None, steps=None, on_result=None):
+    import jax
+
+    on_tpu = jax.default_backend() == "tpu"
+    if batch is None:
+        candidates = [16, 32] if on_tpu else [2]
+    else:
+        candidates = list(batch) if isinstance(batch, (list, tuple)) \
+            else [batch]
+    if steps is None:
+        steps = 10 if on_tpu else 2
+    input_size = 512 if on_tpu else 128
+    print(f"[bench_det] backend={jax.default_backend()} "
+          f"candidates={candidates} input={input_size} steps={steps}",
+          file=sys.stderr)
+
+    from bench_util import sweep
+    SWEEP_BUDGET_S = 200
+
+    best, _ = sweep(candidates, SWEEP_BUDGET_S,
+                    lambda b: _measure_one(b, steps, input_size),
+                    on_best=None if on_result is None
+                    else (lambda v: on_result(_result(v))),
+                    tag="bench_det")
+    return _result(best)
+
+
+def _result(img_s):
+    return {
+        "metric": "ssd512_train_throughput",
+        "value": round(img_s, 1),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(img_s / BASELINE_IMG_S, 4),
+    }
+
+
+def main():
+    # honor JAX_PLATFORMS=cpu despite the axon sitecustomize (same dance
+    # as bench.py — jax.config wins if set before backend init)
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    batch = os.environ.get("BENCH_DET_BATCH")
+    steps = os.environ.get("BENCH_DET_STEPS")
+    res = measure([int(b) for b in batch.split(",")] if batch else None,
+                  int(steps) if steps else None)
+    print(json.dumps(res))
+
+
+if __name__ == "__main__":
+    main()

@@ -14,6 +14,8 @@ export; conversion is a deploy-time concern, not a train-time one.
 """
 from __future__ import annotations
 
+import math
+
 import numpy as np
 
 from ...base import MXNetError
@@ -253,9 +255,41 @@ def _act(node, ctx, out):
     table = {"relu": "Relu", "sigmoid": "Sigmoid", "tanh": "Tanh",
              "softrelu": "Softplus", "softsign": "Softsign"}
     act = node._attrs.get("act_type", "relu")
+    if act == "gelu":
+        return _gelu_tanh(node, ctx, out)
     if act not in table:
         raise MXNetError(f"ONNX export: unsupported act_type {act!r}")
     ctx.add_node(table[act], [ctx.tensor(node._inputs[0])], [out], node.name)
+
+
+def _emit(ctx, nm, op, ins, hint, *attrs):
+    """Emit one intermediate node `nm+hint` and return its output name —
+    the shared helper for multi-node decomposition converters."""
+    t = ctx.fresh(nm + hint)
+    ctx.add_node(op, ins, [t], nm + hint, *attrs)
+    return t
+
+
+def _gelu_tanh(node, ctx, out):
+    """gelu decomposed as the tanh approximation — the framework's eager
+    kernel is jax.nn.gelu(approximate=True), so the export must emit the
+    SAME curve: 0.5*x*(1 + tanh(sqrt(2/pi)*(x + 0.044715*x^3)))."""
+    nm = node.name
+    x = ctx.tensor(node._inputs[0])
+
+    def n2(op, ins, hint, *attrs):
+        return _emit(ctx, nm, op, ins, hint, *attrs)
+
+    x2 = n2("Mul", [x, x], "_x2")
+    x3 = n2("Mul", [x2, x], "_x3")
+    c0 = ctx.const(nm + "_c0", np.float32(0.044715))
+    inner = n2("Add", [x, n2("Mul", [x3, c0], "_cx3")], "_inner")
+    cs = ctx.const(nm + "_s2pi", np.float32(math.sqrt(2.0 / math.pi)))
+    th = n2("Tanh", [n2("Mul", [inner, cs], "_scaled")], "_tanh")
+    one = ctx.const(nm + "_one", np.float32(1.0))
+    half = ctx.const(nm + "_half", np.float32(0.5))
+    gate = n2("Mul", [n2("Add", [th, one], "_1p"), half], "_gate")
+    ctx.add_node("Mul", [x, gate], [out], nm)
 
 
 @register_converter("Pooling")
@@ -284,14 +318,25 @@ def _pool(node, ctx, out):
 def _fc(node, ctx, out):
     a = node._attrs
     x = ctx.tensor(node._inputs[0])
-    if a.get("flatten", True):
-        flat = ctx.fresh(node.name + "_flat")
-        ctx.add_node("Flatten", [x], [flat], node.name + "_flatten",
-                     A_i("axis", 1))
-        x = flat
-    ins = [x, ctx.tensor(node._inputs[1])]
-    if not a.get("no_bias"):
-        ins.append(ctx.tensor(node._inputs[2]))
+    w = ctx.tensor(node._inputs[1])
+    b = None if a.get("no_bias") else ctx.tensor(node._inputs[2])
+    if not a.get("flatten", True):
+        # N-D input (e.g. (B,S,D) transformer activations): Gemm is 2-D
+        # only in ONNX, so emit Transpose(W) + MatMul + Add instead
+        wt = ctx.fresh(node.name + "_wT")
+        ctx.add_node("Transpose", [w], [wt], node.name + "_wT",
+                     A_ints("perm", (1, 0)))
+        if b is None:
+            ctx.add_node("MatMul", [x, wt], [out], node.name)
+        else:
+            mm = ctx.fresh(node.name + "_mm")
+            ctx.add_node("MatMul", [x, wt], [mm], node.name + "_mm")
+            ctx.add_node("Add", [mm, b], [out], node.name)
+        return
+    flat = ctx.fresh(node.name + "_flat")
+    ctx.add_node("Flatten", [x], [flat], node.name + "_flatten",
+                 A_i("axis", 1))
+    ins = [flat, w] + ([b] if b is not None else [])
     ctx.add_node("Gemm", ins, [out], node.name,
                  A_f("alpha", 1.0), A_f("beta", 1.0),
                  A_i("transA", 0), A_i("transB", 1))
@@ -327,9 +372,50 @@ def _softmax_decomposed(node, ctx, out, log):
         ctx.add_node("Div", [ex, s], [out], node.name)
 
 
+def _length_masked_softmax(node, ctx, out):
+    """softmax(use_length=True): mask positions >= per-batch length along
+    the last axis, then softmax. Decomposed to Shape/Gather/Range/Less/
+    Where so the sequence length stays DYNAMIC in the exported graph
+    (any S at inference), mirroring the framework kernel's arange mask
+    with the same -1e9 fill."""
+    nm = node.name
+    x = ctx.tensor(node._inputs[0])
+    ln = ctx.tensor(node._inputs[1])
+    s = ctx.shape_of.get(x)
+    if s is None:
+        # the Unsqueeze axes below are rank-dependent; a guessed rank
+        # would export a silently-wrong mask broadcast
+        raise MXNetError(
+            "ONNX export: length-masked softmax needs the data rank — "
+            "pass input_shapes to export_model so shapes infer")
+    rank = len(s)
+
+    def n2(op, ins, hint, *attrs):
+        return _emit(ctx, nm, op, ins, hint, *attrs)
+
+    shape = n2("Shape", [x], "_shape")
+    last = ctx.const(nm + "_lastidx", np.asarray(rank - 1, np.int64))
+    sdim = n2("Gather", [shape, last], "_sdim", A_i("axis", 0))
+    zero = ctx.const(nm + "_zero", np.asarray(0, np.int64))
+    one = ctx.const(nm + "_one", np.asarray(1, np.int64))
+    rng = n2("Range", [zero, sdim, one], "_range")         # (S,) int64
+    lcast = n2("Cast", [ln], "_lcast", A_i("to", P.INT64))  # (B,)
+    lexp = n2("Unsqueeze", [lcast], "_lexp",
+              A_ints("axes", tuple(range(1, rank))))        # (B,1,..,1)
+    mask = n2("Less", [rng, lexp], "_mask")                 # (B,1,..,S)
+    neg = ctx.const(nm + "_neg", np.float32(-1e9))
+    masked = n2("Where", [mask, x, neg], "_masked")
+    ctx.add_node("Softmax", [masked], [out], nm, A_i("axis", -1))
+
+
 @register_converter("softmax")
 def _softmax(node, ctx, out):
     axis = node._attrs.get("axis", -1)
+    if len(node._inputs) > 1:
+        if axis != -1:
+            raise MXNetError("ONNX export: length-masked softmax is "
+                             "last-axis only")
+        return _length_masked_softmax(node, ctx, out)
     if axis == -1:
         ctx.add_node("Softmax", [ctx.tensor(node._inputs[0])], [out],
                      node.name, A_i("axis", -1))
@@ -365,6 +451,20 @@ def _reshape(node, ctx, out):
                       np.asarray(node._attrs["shape"], dtype=np.int64))
     ctx.add_node("Reshape", [ctx.tensor(node._inputs[0]), shape], [out],
                  node.name)
+
+
+@register_converter("slice_axis")
+def _slice_axis(node, ctx, out):
+    a = node._attrs
+    end = a.get("end")
+    ends = np.asarray([2**62 if end is None else end], np.int64)
+    ins = [ctx.tensor(node._inputs[0]),
+           ctx.const(node.name + "_starts",
+                     np.asarray([a["begin"]], np.int64)),
+           ctx.const(node.name + "_ends", ends),
+           ctx.const(node.name + "_axes",
+                     np.asarray([a["axis"]], np.int64))]
+    ctx.add_node("Slice", ins, [out], node.name)
 
 
 @register_converter("transpose")
@@ -442,7 +542,7 @@ for _mx, _ox in [("elemwise_add", "Add"), ("elemwise_sub", "Sub"),
                  ("elemwise_mul", "Mul"), ("elemwise_div", "Div"),
                  ("broadcast_add", "Add"), ("broadcast_sub", "Sub"),
                  ("broadcast_mul", "Mul"), ("broadcast_div", "Div"),
-                 ("dot", "MatMul")]:
+                 ("dot", "MatMul"), ("batch_dot", "MatMul")]:
     _CONVERTERS[_mx] = _binary(_ox)
 
 

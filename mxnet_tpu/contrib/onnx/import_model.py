@@ -184,8 +184,12 @@ def _gemm(node, ctx, S):
 
 @register_importer("MatMul")
 def _matmul(node, ctx, S):
-    return S.dot(ctx.get(node["inputs"][0]), ctx.get(node["inputs"][1]),
-                 name=node["name"] or None)
+    # ONNX MatMul has numpy-matmul semantics at every rank (batched at
+    # rank>2) — that's batch_dot (jnp.matmul), NOT dot (jnp.dot, which
+    # outer-products the batch dims of rank>2 operands)
+    return S.batch_dot(ctx.get(node["inputs"][0]),
+                       ctx.get(node["inputs"][1]),
+                       name=node["name"] or None)
 
 
 @register_importer("Flatten")
@@ -242,9 +246,10 @@ def _transpose(node, ctx, S):
 
 @register_importer("Unsqueeze")
 def _unsqueeze(node, ctx, S):
-    (axis,) = node["attrs"]["axes"]
-    return S.expand_dims(ctx.get(node["inputs"][0]), axis=int(axis),
-                         name=node["name"] or None)
+    out = ctx.get(node["inputs"][0])
+    for axis in sorted(int(a) for a in node["attrs"]["axes"]):
+        out = S.expand_dims(out, axis=axis)
+    return out
 
 
 @register_importer("Squeeze")
@@ -269,9 +274,72 @@ def _cast(node, ctx, S):
 
 @register_importer("Gather")
 def _gather(node, ctx, S):
-    return S.take(ctx.get(node["inputs"][0]), ctx.get(node["inputs"][1]),
-                  axis=node["attrs"].get("axis", 0),
-                  name=node["name"] or None)
+    axis = node["attrs"].get("axis", 0)
+    idx_name = node["inputs"][1]
+    try:
+        idx = ctx.const_array(idx_name)
+    except (KeyError, MXNetError):
+        idx = None
+    if idx is not None and idx.size <= 16:
+        # inline small constant indices as an attr: keeps the gather
+        # concrete at trace time (Shape->Gather->Range mask chains)
+        from ...symbol.symbol import _make
+        val = int(idx) if idx.ndim == 0 else tuple(int(i) for i in idx)
+        return _make("take", [ctx.get(node["inputs"][0])],
+                     {"axis": axis, "indices": val},
+                     name=node["name"] or None)
+    return S.take(ctx.get(node["inputs"][0]), ctx.get(idx_name),
+                  axis=axis, name=node["name"] or None)
+
+
+@register_importer("Shape")
+def _shape(node, ctx, S):
+    return S.shape_array(ctx.get(node["inputs"][0]),
+                         name=node["name"] or None)
+
+
+@register_importer("Range")
+def _range(node, ctx, S):
+    # limit may be a graph tensor (the exporter's dynamic attention mask:
+    # Shape -> Gather -> Range — concrete at trace time since shapes are
+    # static under jit); start/delta must be constants, inlined as attrs
+    # so only the limit rides the graph
+    start = ctx.const_array(node["inputs"][0])
+    delta = ctx.const_array(node["inputs"][2])
+    return S._dynamic_arange(ctx.get(node["inputs"][1]),
+                             start=int(start), delta=int(delta),
+                             name=node["name"] or None)
+
+
+@register_importer("Less")
+def _less(node, ctx, S):
+    return S.broadcast_lesser(ctx.get(node["inputs"][0]),
+                              ctx.get(node["inputs"][1]))
+
+
+@register_importer("Where")
+def _where(node, ctx, S):
+    return S.where(ctx.get(node["inputs"][0]), ctx.get(node["inputs"][1]),
+                   ctx.get(node["inputs"][2]), name=node["name"] or None)
+
+
+@register_importer("Slice")
+def _slice(node, ctx, S):
+    starts = ctx.const_array(node["inputs"][1]).tolist()
+    ends = ctx.const_array(node["inputs"][2]).tolist()
+    if len(node["inputs"]) > 3:
+        axes = ctx.const_array(node["inputs"][3]).tolist()
+    else:
+        axes = list(range(len(starts)))
+    if len(node["inputs"]) > 4:
+        steps = ctx.const_array(node["inputs"][4]).tolist()
+        if any(s != 1 for s in steps):
+            raise MXNetError("ONNX import: Slice steps != 1 unsupported")
+    out = ctx.get(node["inputs"][0])
+    for s, e, ax in zip(starts, ends, axes):
+        out = S.slice_axis(out, axis=int(ax), begin=int(s),
+                           end=None if e >= 2**31 else int(e))
+    return out
 
 
 def _binary(op_method):

@@ -15,13 +15,15 @@ TPU-first choices:
 """
 from __future__ import annotations
 
+import math
+
 import numpy as np
 import jax.numpy as jnp
 
 from ..base import MXNetError
 from ..ndarray.ndarray import NDArray, _apply
 from ..gluon import nn
-from ..gluon.block import HybridBlock
+from ..gluon.block import HybridBlock, is_symbolic as _is_symbol
 from ..ops.pallas_kernels import flash_attention
 
 __all__ = ["BERTModel", "BERTEncoder", "BERTEncoderLayer",
@@ -55,7 +57,31 @@ class MultiHeadSelfAttention(HybridBlock):
                                  prefix="proj_")
             self.dropout = nn.Dropout(dropout)
 
+    def _symbolic_forward(self, F, x, valid_length):
+        """Symbolic attention for export: the flash kernel decomposed into
+        named graph ops (slice/reshape/batch_dot/length-masked softmax) so
+        ONNX export and SymbolBlock reload see a serialisable graph.
+        Numerics match the eager path (same masking rule, bf16-free)."""
+        d, h = self._units, self._num_heads
+        qkv = self.qkv(x)
+        q = F.slice_axis(qkv, axis=-1, begin=0, end=d)
+        k = F.slice_axis(qkv, axis=-1, begin=d, end=2 * d)
+        v = F.slice_axis(qkv, axis=-1, begin=2 * d, end=3 * d)
+
+        def heads(t):  # (B,S,D) -> (B,h,S,dh)
+            return F.transpose(F.reshape(t, (0, 0, h, -1)), (0, 2, 1, 3))
+
+        kt = F.transpose(F.reshape(k, (0, 0, h, -1)), (0, 2, 3, 1))
+        scores = F.batch_dot(heads(q), kt) * (1.0 / math.sqrt(d // h))
+        attnw = F.softmax(scores, length=valid_length, axis=-1) \
+            if valid_length is not None else F.softmax(scores, axis=-1)
+        out = F.batch_dot(attnw, heads(v))          # (B,h,S,dh)
+        out = F.reshape(F.transpose(out, (0, 2, 1, 3)), (0, 0, -1))
+        return self.dropout(self.proj(out))
+
     def hybrid_forward(self, F, x, valid_length=None):
+        if _is_symbol(x):
+            return self._symbolic_forward(F, x, valid_length)
         qkv = self.qkv(x)
         h = self._num_heads
 
@@ -125,12 +151,26 @@ class BERTEncoder(HybridBlock):
                         units, hidden_size, num_heads, dropout))
 
     def hybrid_forward(self, F, x, valid_length=None, position_weight=None):
-        seq_len = x.shape[1]
+        if _is_symbol(x):
+            # static seq length via shape inference (shaped input Variables)
+            try:
+                _, out_shapes, _ = x.infer_shape()
+                seq_len = out_shapes[0][1]
+            except Exception as e:
+                raise MXNetError(
+                    "BERT symbolic trace needs shaped input Variables "
+                    "(sym.Variable('token_ids', shape=(B, S))) so the "
+                    f"position slice is static: {e!r}") from e
+            pos = F.expand_dims(F.slice_axis(
+                position_weight, axis=0, begin=0, end=int(seq_len)), 0)
+            x = F.broadcast_add(x, pos)
+        else:
+            seq_len = x.shape[1]
 
-        def add_pos(a, p):
-            return a + p[:seq_len][None]
+            def add_pos(a, p):
+                return a + p[:seq_len][None]
 
-        x = _apply(add_pos, [x, position_weight])
+            x = _apply(add_pos, [x, position_weight])
         x = self.dropout(self.ln(x))
         for layer in self.layers:
             x = layer(x, valid_length)
@@ -171,6 +211,10 @@ class BERTModel(HybridBlock):
                        masked_positions=None, mlm_bias=None):
         # mlm_bias arrives as a registered-param kwarg; decode_mlm reads it
         # through Parameter.data() so the tied path stays uniform
+        if masked_positions is not None and _is_symbol(token_ids):
+            raise MXNetError(
+                "symbolic BERT trace covers the encoder surface "
+                "(sequence_output, pooled_output); MLM decode is eager-only")
         x = self.word_embed(token_ids) + self.token_type_embed(segment_ids)
         seq = self.encoder(x, valid_length)
         pooled = self.pooler(seq.slice_axis(axis=1, begin=0, end=1)

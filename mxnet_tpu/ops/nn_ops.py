@@ -18,6 +18,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..base import MXNetError
 from ..ndarray.ndarray import NDArray, _apply, _lift
 
 __all__ = [
@@ -545,7 +546,29 @@ def SoftmaxOutput(data, label=None, **kwargs):
     return _apply(lambda x: jax.nn.softmax(x, axis=-1), [data])
 
 
-def softmax_nd(data, axis=-1, temperature=None):
+def softmax_nd(data, length=None, axis=-1, temperature=None,
+               use_length=False):
+    # positional order matches the reference AND the symbol-side softmax:
+    # (data, length, axis, ...) — python/mxnet/ndarray/gen_op softmax
+    # reference: softmax(..., use_length=True) masks positions >= the
+    # per-batch length along the (last) softmax axis (src/operator/nn/
+    # softmax.cc); same kernel the symbol op and ONNX export pin
+    if length is not None or use_length:
+        if length is None:
+            raise MXNetError("softmax: use_length=True needs a length input")
+
+        def masked(x, ln, _ax=axis, _t=temperature):
+            if _t is not None and _t != 1.0:
+                x = x / _t
+            if _ax % x.ndim != x.ndim - 1:
+                raise MXNetError(
+                    "softmax: length masking supports the last axis only")
+            idx = jnp.arange(x.shape[-1])
+            lb = ln.astype(jnp.int32).reshape(
+                (ln.shape[0],) + (1,) * (x.ndim - 1))
+            return jax.nn.softmax(jnp.where(idx < lb, x, -1e9), axis=-1)
+
+        return _apply(masked, [data, length])
     return _apply(lambda x, _ax=axis, _t=temperature: softmax(x, _ax, _t), [data])
 
 

@@ -31,7 +31,10 @@ __all__ = ["FullyConnected", "Convolution", "StemConvS2D", "Activation",
            "transpose", "concat", "Concat", "dot", "batch_dot", "sum", "mean",
            "max", "min", "relu", "sigmoid", "tanh", "exp", "log", "sqrt",
            "square", "negative", "zeros", "ones", "broadcast_add",
-           "broadcast_mul", "elemwise_add", "expand_dims", "squeeze"]
+           "broadcast_mul", "elemwise_add", "expand_dims", "squeeze",
+           "where", "shape_array", "_dynamic_arange", "broadcast_lesser",
+           "broadcast_lesser_equal", "broadcast_greater",
+           "broadcast_greater_equal"]
 
 # -- elemwise registry -------------------------------------------------------
 register_op("elemwise_add", jnp.add)
@@ -71,7 +74,23 @@ register_op("exp", jnp.exp)
 register_op("log", jnp.log)
 register_op("sqrt", jnp.sqrt)
 register_op("square", jnp.square)
-register_op("softmax", lambda a, axis=-1: jax.nn.softmax(a, axis=axis))
+def _softmax_kernel(a, *length, axis=-1, use_length=False):
+    """Softmax with optional per-batch length masking of the softmax axis
+    (reference: softmax(..., use_length=True), src/operator/nn/softmax.cc).
+    `length` has shape (B,) = data's leading dim; positions >= length along
+    the (last) softmax axis are excluded. -1e9 (not -inf) keeps fully-padded
+    query rows finite and matches the ONNX export decomposition bit-for-bit."""
+    if not length:
+        return jax.nn.softmax(a, axis=axis)
+    (ln,) = length
+    if axis % a.ndim != a.ndim - 1:
+        raise MXNetError("softmax: length masking supports the last axis only")
+    idx = jnp.arange(a.shape[-1])
+    lb = ln.astype(jnp.int32).reshape((ln.shape[0],) + (1,) * (a.ndim - 1))
+    return jax.nn.softmax(jnp.where(idx < lb, a, -1e9), axis=-1)
+
+
+register_op("softmax", _softmax_kernel)
 register_op("log_softmax", lambda a, axis=-1: jax.nn.log_softmax(a, axis=axis))
 register_op("sum", lambda a, axis=None, keepdims=False:
             jnp.sum(a, axis=axis, keepdims=keepdims))
@@ -81,7 +100,10 @@ register_op("max", lambda a, axis=None, keepdims=False:
             jnp.max(a, axis=axis, keepdims=keepdims))
 register_op("min", lambda a, axis=None, keepdims=False:
             jnp.min(a, axis=axis, keepdims=keepdims))
-register_op("reshape", lambda a, shape: a.reshape(shape))
+# reference reshape magic codes (0 = copy input dim) resolved against the
+# concrete input shape at execution; -1 passes through to jnp
+register_op("reshape", lambda a, shape: a.reshape(
+    tuple(a.shape[i] if s == 0 else s for i, s in enumerate(shape))))
 register_op("flatten", lambda a: a.reshape(a.shape[0], -1))
 register_op("transpose", lambda a, axes=None: jnp.transpose(a, axes))
 register_op("expand_dims", lambda a, axis: jnp.expand_dims(a, axis))
@@ -475,7 +497,12 @@ def LogisticRegressionOutput(data, label=None, grad_scale=1.0, name=None,
                  {"grad_scale": grad_scale}, name=name)
 
 
-def softmax(data, axis=-1, name=None):
+def softmax(data, length=None, axis=-1, use_length=False, name=None):
+    if length is not None or use_length:
+        if length is None:
+            raise MXNetError("softmax: use_length=True needs a length input")
+        return _make("softmax", [data, length],
+                     {"axis": axis, "use_length": True}, name=name)
     return _make("softmax", [data], {"axis": axis}, name=name)
 
 
@@ -575,6 +602,19 @@ def broadcast_add(lhs, rhs, name=None):
 
 def broadcast_mul(lhs, rhs, name=None):
     return _make("elemwise_mul", [lhs, rhs], {}, name=name)
+
+
+def _broadcast_cmp(opname):
+    def f(lhs, rhs, name=None):
+        return _make(opname, [lhs, rhs], {}, name=name)
+    f.__name__ = opname
+    return f
+
+
+broadcast_lesser = _broadcast_cmp("broadcast_lesser")
+broadcast_lesser_equal = _broadcast_cmp("broadcast_lesser_equal")
+broadcast_greater = _broadcast_cmp("broadcast_greater")
+broadcast_greater_equal = _broadcast_cmp("broadcast_greater_equal")
 
 
 elemwise_add = broadcast_add
@@ -713,10 +753,21 @@ split = SliceChannel
 
 # -- cast / indexing (reference: tensor cast + take ops) --------------------
 register_op("cast", lambda x, dtype="float32": x.astype(dtype))
-register_op("take",
-            lambda a, idx, axis=0, mode="clip":
-            jnp.take(a, idx.astype(jnp.int32), axis=axis,
-                     mode={"clip": "clip", "wrap": "wrap"}.get(mode, "clip")))
+def _take_kernel(a, *maybe_idx, axis=0, mode="clip", indices=None):
+    # `indices` as an ATTR (no second input) keeps the gather concrete
+    # when `a` is itself concrete (numpy) under jit tracing — the ONNX
+    # importer inlines constant indices this way so Shape->Gather->Range
+    # mask chains fold at trace time instead of failing on a traced arange
+    m = {"clip": "clip", "wrap": "wrap"}.get(mode, "clip")
+    if not maybe_idx and isinstance(a, _np.ndarray):
+        return _np.take(a, _np.asarray(indices), axis=axis, mode=m)
+    idx = maybe_idx[0] if maybe_idx else jnp.asarray(indices)
+    if hasattr(idx, "astype"):
+        idx = idx.astype(jnp.int32)
+    return jnp.take(a, idx, axis=axis, mode=m)
+
+
+register_op("take", _take_kernel)
 register_op("abs", jnp.abs)
 
 
@@ -874,3 +925,37 @@ def RNN(data, *state_and_params, mode="lstm", num_layers=1, num_dir=1,
                   "use_sequence_length": use_sequence_length,
                   "dropout": dropout},
                  name=name, n_out=1 + ns)
+
+
+# --------------------------------------------------------------------------
+# dynamic-shape helpers (reference: mx.sym.shape_array, mx.sym.where —
+# src/operator/tensor/elemwise_unary_op_basic.cc, control_flow_op.cc).
+# These also let the ONNX importer rebuild the exporter's dynamic
+# attention-mask idiom (Shape -> Range -> Less -> Where) eagerly.
+# NUMPY output on purpose: a shape is static under jit, and keeping the
+# value out of jnp (which lifts constants into tracers at trace time)
+# lets Shape->Gather->Range chains fold to Python ints — the ONNX
+# importer's dynamic attention mask relies on this
+register_op("shape_array", lambda a: _np.asarray(a.shape, _np.int32))
+register_op("where", lambda c, a, b: jnp.where(c != 0, a, b))
+# arange whose limit arrives as a (scalar) graph INPUT, not an attr.
+# Executable when the limit is concrete: eagerly, or under jit when it
+# folds from static shapes (shape_array output is concrete at trace
+# time); a genuinely data-dependent limit is a dynamic shape and raises.
+register_op("_dynamic_arange",
+            lambda l, start=0, delta=1:
+            jnp.arange(int(start), int(_np.asarray(l).reshape(-1)[0]),
+                       int(delta)))
+
+
+def shape_array(data, name=None):
+    return _make("shape_array", [data], {}, name=name)
+
+
+def where(condition, x, y, name=None):
+    return _make("where", [condition, x, y], {}, name=name)
+
+
+def _dynamic_arange(limit, start=0, delta=1, name=None):
+    return _make("_dynamic_arange", [limit],
+                 {"start": start, "delta": delta}, name=name)

@@ -137,6 +137,48 @@ def _run_onnx(model, feeds):
         elif op == "Shape":
             y = __import__("torch").tensor(list(x[0].shape),
                                            dtype=__import__("torch").int64)
+        elif op == "MatMul":
+            y = x[0] @ x[1]
+        elif op == "Transpose":
+            y = x[0].permute(list(a["perm"])) if "perm" in a \
+                else x[0].t()
+        elif op == "Slice":
+            starts, ends = x[1].tolist(), x[2].tolist()
+            axes = x[3].tolist() if len(x) > 3 else list(range(len(starts)))
+            steps = x[4].tolist() if len(x) > 4 else [1] * len(starts)
+            slc = [slice(None)] * x[0].dim()
+            for s, e, ax, st in zip(starts, ends, axes, steps):
+                slc[ax] = slice(s, e, st)
+            y = x[0][tuple(slc)]
+        elif op == "Cast":
+            tm = __import__("torch")
+            to = {proto.FLOAT: tm.float32, proto.INT64: tm.int64,
+                  proto.INT32: tm.int32, proto.FLOAT16: tm.float16}
+            y = x[0].to(to[a["to"]])
+        elif op == "Gather":
+            got = np.take(x[0].numpy(), x[1].numpy().astype(np.int64),
+                          axis=a.get("axis", 0))
+            y = __import__("torch").from_numpy(np.asarray(got))
+        elif op == "Range":
+            y = __import__("torch").arange(
+                int(x[0]), int(x[1]), int(x[2]))
+        elif op == "Less":
+            y = x[0] < x[1]
+        elif op == "Where":
+            y = __import__("torch").where(x[0], x[1], x[2])
+        elif op == "Tanh":
+            y = x[0].tanh()
+        elif op == "Unsqueeze":
+            y = x[0]
+            for ax in sorted(a["axes"]):
+                y = y.unsqueeze(ax)
+        elif op == "Squeeze":
+            y = x[0]
+            if "axes" in a:
+                for ax in sorted(a["axes"], reverse=True):
+                    y = y.squeeze(ax)
+            else:
+                y = y.squeeze()
         elif op == "ConvTranspose":
             y = F.conv_transpose2d(
                 x[0], x[1], x[2] if len(x) > 2 else None,
@@ -446,3 +488,99 @@ def test_deconv_norm_prelu_export_runs(tmp_path):
     assert "PRelu" in ops and "Shape" in ops
     got = _run_onnx(m, {"data": x.asnumpy()})[0]
     np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
+
+
+def _bert_mini():
+    from mxnet_tpu.models.bert import BERTModel
+    net = BERTModel(vocab_size=50, units=32, hidden_size=64, num_layers=2,
+                    num_heads=4, max_length=16, dropout=0.0)
+    net.initialize()
+    return net
+
+
+def test_bert_encoder_export_matches_torch_runtime(tmp_path):
+    """BERT-mini (VERDICT r3 item 8): the symbolic encoder trace —
+    fused-QKV attention decomposed to slice/batch_dot/length-masked
+    softmax — exports to opset 11 and reproduces the framework's eager
+    (flash-attention-path) logits under the independent torch runtime,
+    including a ragged valid_length batch."""
+    net = _bert_mini()
+    B, S = 2, 12
+    rng = np.random.RandomState(7)
+    tok = rng.randint(0, 50, (B, S)).astype(np.float32)
+    seg = rng.randint(0, 2, (B, S)).astype(np.float32)
+    vl = np.array([12, 7], np.float32)
+    ref_seq, ref_pool = net(nd.array(tok), nd.array(seg), nd.array(vl))
+    g = sym.Group(list(net(sym.Variable("token_ids", shape=(B, S)),
+                           sym.Variable("segment_ids", shape=(B, S)),
+                           sym.Variable("valid_length", shape=(B,)))))
+    params = {k: v.data() for k, v in net.collect_params().items()}
+    path = export_model(g, params,
+                        {"token_ids": (B, S), "segment_ids": (B, S),
+                         "valid_length": (B,)},
+                        onnx_file_path=str(tmp_path / "bert.onnx"))
+    m = proto.decode_model(open(path, "rb").read())
+    ops = [n["op_type"] for n in m["graph"]["nodes"]]
+    # attention mask ops present and dynamic (no baked-in mask constant)
+    for required in ("Range", "Less", "Where", "MatMul", "Tanh"):
+        assert required in ops, f"missing {required} in exported graph"
+    got = _run_onnx(m, {"token_ids": tok, "segment_ids": seg,
+                        "valid_length": vl})
+    np.testing.assert_allclose(got[0], ref_seq.asnumpy(),
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(got[1], ref_pool.asnumpy(),
+                               rtol=1e-4, atol=1e-4)
+    # the mask must actually bite: full-length ref on the padded row
+    # diverges from the ragged run
+    ref_full, _ = net(nd.array(tok), nd.array(seg))
+    assert not np.allclose(got[0][1], ref_full.asnumpy()[1], atol=1e-4)
+
+
+def test_bert_export_no_valid_length(tmp_path):
+    net = _bert_mini()
+    B, S = 2, 8
+    rng = np.random.RandomState(3)
+    tok = rng.randint(0, 50, (B, S)).astype(np.float32)
+    seg = np.zeros((B, S), np.float32)
+    ref_seq, ref_pool = net(nd.array(tok), nd.array(seg))
+    g = sym.Group(list(net(sym.Variable("token_ids", shape=(B, S)),
+                           sym.Variable("segment_ids", shape=(B, S)))))
+    params = {k: v.data() for k, v in net.collect_params().items()}
+    path = export_model(g, params,
+                        {"token_ids": (B, S), "segment_ids": (B, S)},
+                        onnx_file_path=str(tmp_path / "bert_nm.onnx"))
+    m = proto.decode_model(open(path, "rb").read())
+    got = _run_onnx(m, {"token_ids": tok, "segment_ids": seg})
+    np.testing.assert_allclose(got[1], ref_pool.asnumpy(),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_bert_import_roundtrip(tmp_path):
+    """Export bert-mini, import it back, bind, and match the framework's
+    eager logits — the dynamic attention-mask idiom (Shape/Range/Less/
+    Where) must rebuild and execute through the importer."""
+    from mxnet_tpu.contrib.onnx import import_model
+    net = _bert_mini()
+    B, S = 2, 10
+    rng = np.random.RandomState(11)
+    tok = rng.randint(0, 50, (B, S)).astype(np.float32)
+    seg = rng.randint(0, 2, (B, S)).astype(np.float32)
+    vl = np.array([10, 4], np.float32)
+    ref_seq, ref_pool = net(nd.array(tok), nd.array(seg), nd.array(vl))
+    g = sym.Group(list(net(sym.Variable("token_ids", shape=(B, S)),
+                           sym.Variable("segment_ids", shape=(B, S)),
+                           sym.Variable("valid_length", shape=(B,)))))
+    params = {k: v.data() for k, v in net.collect_params().items()}
+    path = export_model(g, params,
+                        {"token_ids": (B, S), "segment_ids": (B, S),
+                         "valid_length": (B,)},
+                        onnx_file_path=str(tmp_path / "bert_i.onnx"))
+    s2, args, aux = import_model(path)
+    feed = dict(args)
+    feed.update(token_ids=nd.array(tok), segment_ids=nd.array(seg),
+                valid_length=nd.array(vl))
+    outs = s2.bind(None, feed, aux_states=aux).forward()
+    np.testing.assert_allclose(outs[0].asnumpy(), ref_seq.asnumpy(),
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(outs[1].asnumpy(), ref_pool.asnumpy(),
+                               rtol=1e-4, atol=1e-4)

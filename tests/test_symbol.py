@@ -361,3 +361,21 @@ def test_auto_names_assigned_at_creation_order():
         # access b's name first: must still be activation1 (creation order)
         assert b.name == "activation1"
         assert a.name == "activation0"
+
+
+def test_softmax_use_length_json_roundtrip():
+    """Length-masked softmax (reference: softmax(use_length=True)) is a
+    2-input node that must survive tojson -> load_json -> bind with the
+    mask still biting."""
+    d = mx.sym.Variable("scores")
+    ln = mx.sym.Variable("ln")
+    out = mx.sym.softmax(d, length=ln, axis=-1)
+    loaded = mx.sym.load_json(out.tojson())
+    scores = mx.nd.random.uniform(shape=(2, 3, 5))
+    lens = mx.nd.array(np.array([5, 2], np.float32))
+    got = loaded.bind(None, {"scores": scores, "ln": lens}).forward()[0]
+    a = got.asnumpy()
+    assert np.allclose(a.sum(-1), 1.0, atol=1e-5)
+    assert np.allclose(a[1, :, 2:], 0.0, atol=1e-6)
+    ref = mx.nd.softmax(scores, length=lens).asnumpy()
+    assert np.allclose(a, ref, atol=1e-6)
