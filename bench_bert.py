@@ -66,23 +66,19 @@ def build_step(batch, seq, masked):
         return l_mlm + l_nsp, aux
 
     lr, mu = 1e-3, 0.9
-
-    def train_step(p, mom, *data):
-        (loss, aux), g = jax.value_and_grad(loss_fn, has_aux=True)(p, *data)
-        new_mom = [mu * m + gg.astype(m.dtype) for m, gg in zip(mom, g)]
-        new_p = [pp - lr * m for pp, m in zip(p, new_mom)]
-        for i, v in zip(aux_idx, aux):
-            new_p[i] = v
-        return new_p, new_mom, loss
-
-    step = jax.jit(train_step, donate_argnums=(0, 1))
+    # same lever as bench.py's BENCH_UNROLL: k steps per dispatch
+    unroll = int(os.environ.get("BENCH_BERT_UNROLL", "1"))
+    from bench_util import make_sgd_step
+    step = make_sgd_step(loss_fn, aux_idx, lr, mu, unroll)
     mom = [jnp.zeros_like(p) for p in params]
     data = (tok._data, seg._data, vl._data, pos._data, mlm_labels, nsp_labels)
-    return step, params, mom, data
+    return step, params, mom, data, unroll
 
 
 def _measure_one(batch, steps, seq, masked):
-    step, params, mom, data = build_step(batch, seq, masked)
+    # unroll comes back from build_step so the tok/s numerator can never
+    # disagree with what was actually compiled
+    step, params, mom, data, unroll = build_step(batch, seq, masked)
     params, mom, loss = step(params, mom, *data)
     params, mom, loss = step(params, mom, *data)
     float(loss)  # sync (host fetch; see bench.py note on the axon tunnel)
@@ -91,7 +87,7 @@ def _measure_one(batch, steps, seq, masked):
         params, mom, loss = step(params, mom, *data)
     final_loss = float(loss)
     dt = time.perf_counter() - t0
-    tok_s = batch * seq * steps / dt
+    tok_s = batch * seq * steps * unroll / dt
     print(f"[bench_bert] batch={batch} loss={final_loss:.4f} dt={dt:.3f}s "
           f"-> {tok_s:.0f} tok/s", file=sys.stderr)
     return tok_s

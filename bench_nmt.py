@@ -56,17 +56,8 @@ def build_step(batch, seq, vocab=36548):
         lp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
         return -jnp.mean(jnp.take_along_axis(lp, y[..., None], -1)), aux
 
-    lr, mu = 1e-3, 0.9
-
-    def train_step(p, mom, *data):
-        (loss, aux), g = jax.value_and_grad(loss_fn, has_aux=True)(p, *data)
-        new_mom = [mu * m + gg.astype(m.dtype) for m, gg in zip(mom, g)]
-        new_p = [pp - lr * m for pp, m in zip(p, new_mom)]
-        for i, v in zip(aux_idx, aux):
-            new_p[i] = v
-        return new_p, new_mom, loss
-
-    step = jax.jit(train_step, donate_argnums=(0, 1))
+    from bench_util import make_sgd_step
+    step = make_sgd_step(loss_fn, aux_idx, lr=1e-3, mu=0.9)
     mom = [jnp.zeros_like(p) for p in params]
     data = (src._data, tgt._data, vl._data, labels)
     return step, params, mom, data

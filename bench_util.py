@@ -17,6 +17,32 @@ import sys
 import time
 
 
+def make_sgd_step(loss_fn, aux_idx, lr, mu, unroll=1):
+    """The jitted SGD-momentum train step every bench worker uses:
+    value_and_grad(loss_fn) -> per-tensor momentum update -> aux (BN
+    running stats) spliced back into the param list, optionally unrolled
+    k steps per dispatch (the BENCH_UNROLL lever). Donation caveat lives
+    with the callers: donate COPIES of params, the originals die."""
+    import jax
+
+    def step_1(p, mom, *data):
+        (loss, aux), g = jax.value_and_grad(loss_fn, has_aux=True)(p, *data)
+        new_mom = [mu * m + gg.astype(m.dtype) for m, gg in zip(mom, g)]
+        new_p = [pp - lr * m for pp, m in zip(p, new_mom)]
+        for i, v in zip(aux_idx, aux):
+            new_p[i] = v
+        return new_p, new_mom, loss
+
+    def step_k(p, mom, *data):
+        loss = None
+        for _ in range(unroll):
+            p, mom, loss = step_1(p, mom, *data)
+        return p, mom, loss
+
+    return jax.jit(step_k if unroll > 1 else step_1,
+                   donate_argnums=(0, 1))
+
+
 def sweep(candidates, budget_s, run_one, on_best=None, tag="bench"):
     """Run `run_one(candidate) -> float` over candidates; return
     (best_value, best_candidate). Raises RuntimeError if none land."""

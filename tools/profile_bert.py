@@ -26,8 +26,8 @@ def main():
 
     import jax
     import bench_bert
-    step, params, mom, data = bench_bert.build_step(args.batch, args.seq,
-                                                    args.masked)
+    step, params, mom, data, _unroll = bench_bert.build_step(
+        args.batch, args.seq, args.masked)
     params, mom, loss = step(params, mom, *data)
     params, mom, loss = step(params, mom, *data)
     float(loss)
