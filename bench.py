@@ -187,8 +187,9 @@ def main():
     # step boundaries. Measured 2026-07-31 (docs/PERF.md): 1 -> 2759.9,
     # 2 -> 2799.3, 4 -> 2843.9, 8 -> 2863.1 img/s; 8 is the default on
     # TPU (compile ~7min, inside WORKER_TIMEOUT_S).
-    unroll = int(os.environ.get("BENCH_UNROLL",
-                                "8" if on_tpu and not smoke else "1"))
+    unroll = max(1, int(os.environ.get("BENCH_UNROLL",
+                                       "8" if on_tpu and not smoke
+                                       else "1")))
     # later candidates only start while comfortably inside the worker
     # timeout — a half-finished sweep must never eat the whole attempt
     SWEEP_BUDGET_S = 300
@@ -235,20 +236,9 @@ def main():
         step = jax.jit(train_step, donate_argnums=(0, 1))
         mom = [jnp.zeros(p.shape, jnp.float32) if fused
                else jnp.zeros_like(p) for p in params]
-        # warmup: compile + one extra to stabilise. NB sync via host
-        # fetch: under the axon tunnel block_until_ready doesn't block.
-        params, mom, loss = step(params, mom, images, labels)
-        params, mom, loss = step(params, mom, images, labels)
-        float(loss)
-        t0 = time.perf_counter()
-        for _ in range(steps):
-            params, mom, loss = step(params, mom, images, labels)
-        final_loss = float(loss)
-        dt = time.perf_counter() - t0
-        img_s = batch * steps * unroll / dt
-        print(f"[bench] batch={batch} loss={final_loss:.4f} dt={dt:.3f}s "
-              f"-> {img_s:.1f} img/s", file=sys.stderr)
-        return img_s
+        from bench_util import timed_measure
+        return timed_measure(step, params, mom, (images, labels), steps,
+                             batch * unroll, tag=f"bench b{batch}")
 
     from bench_util import sweep
 
@@ -289,17 +279,22 @@ def main():
 
     # remaining BASELINE configs (VERDICT r3 item 7), opt-in so the
     # driver's default line stays fast; a failure can't take down the
-    # headline metrics
-    for flag, modname in (("BENCH_NMT", "bench_nmt"),
-                          ("BENCH_DET", "bench_det")):
-        if smoke or os.environ.get(flag) != "1":
-            continue
+    # headline metrics. BENCH_DET=1 runs BOTH halves of BASELINE config
+    # 5 (SSD-512 and Faster-RCNN).
+    extra_measures = []
+    if os.environ.get("BENCH_NMT") == "1":
+        extra_measures.append(("bench_nmt", "measure"))
+    if os.environ.get("BENCH_DET") == "1":
+        extra_measures.append(("bench_det", "measure"))
+        extra_measures.append(("bench_det", "measure_rcnn"))
+    for modname, fn in ([] if smoke else extra_measures):
         try:
             mod = __import__(modname)
-            result.setdefault("extra_metrics", []).append(mod.measure())
+            result.setdefault("extra_metrics", []).append(
+                getattr(mod, fn)())
             print(json.dumps(result), flush=True)  # checkpoint
         except Exception as e:  # pragma: no cover
-            print(f"[bench] {modname} failed: {e!r}", file=sys.stderr)
+            print(f"[bench] {modname}.{fn} failed: {e!r}", file=sys.stderr)
 
     print(json.dumps(result), flush=True)
 

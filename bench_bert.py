@@ -66,8 +66,13 @@ def build_step(batch, seq, masked):
         return l_mlm + l_nsp, aux
 
     lr, mu = 1e-3, 0.9
-    # same lever as bench.py's BENCH_UNROLL: k steps per dispatch
-    unroll = int(os.environ.get("BENCH_BERT_UNROLL", "1"))
+    # same lever as bench.py's BENCH_UNROLL: k steps per dispatch.
+    # Measured 2026-07-31: 1 -> 165.8k, 4 -> 174.7k, 8 -> 175.8k tok/s;
+    # default 4 (8's +0.6% is not worth the extra compile inside the
+    # shared 900s worker budget).
+    on_tpu = jax.default_backend() == "tpu"
+    unroll = max(1, int(os.environ.get("BENCH_BERT_UNROLL",
+                                       "4" if on_tpu else "1")))
     from bench_util import make_sgd_step
     step = make_sgd_step(loss_fn, aux_idx, lr, mu, unroll)
     mom = [jnp.zeros_like(p) for p in params]
@@ -79,18 +84,10 @@ def _measure_one(batch, steps, seq, masked):
     # unroll comes back from build_step so the tok/s numerator can never
     # disagree with what was actually compiled
     step, params, mom, data, unroll = build_step(batch, seq, masked)
-    params, mom, loss = step(params, mom, *data)
-    params, mom, loss = step(params, mom, *data)
-    float(loss)  # sync (host fetch; see bench.py note on the axon tunnel)
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        params, mom, loss = step(params, mom, *data)
-    final_loss = float(loss)
-    dt = time.perf_counter() - t0
-    tok_s = batch * seq * steps * unroll / dt
-    print(f"[bench_bert] batch={batch} loss={final_loss:.4f} dt={dt:.3f}s "
-          f"-> {tok_s:.0f} tok/s", file=sys.stderr)
-    return tok_s
+    from bench_util import timed_measure
+    return timed_measure(step, params, mom, data, steps,
+                         batch * seq * unroll,
+                         tag=f"bench_bert b{batch}")
 
 
 def measure(batch=None, steps=None, on_result=None):
@@ -139,6 +136,11 @@ def _result(tok_s):
 
 
 def main():
+    # honor JAX_PLATFORMS=cpu despite the axon sitecustomize (same dance
+    # as bench.py — jax.config wins if set before backend init)
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        import jax
+        jax.config.update("jax_platforms", "cpu")
     batch = os.environ.get("BENCH_BERT_BATCH")
     steps = os.environ.get("BENCH_BERT_STEPS")
     res = measure([int(b) for b in batch.split(",")] if batch else None,

@@ -115,6 +115,7 @@ def build_rcnn_step(batch, input_size=512):
     net = FasterRCNN(num_classes=20, backbone_layers=backbone,
                      input_size=input_size, post_nms=post_nms)
     net.initialize(mx.init.Xavier())
+    net.cast("bfloat16")  # same fp16-class basis as every sibling bench
 
     class _Train(HybridBlock):
         def __init__(self, inner, **kw):
@@ -137,7 +138,8 @@ def build_rcnn_step(batch, input_size=512):
             return obj, deltas, cls, box, cls_t, box_t, box_m
 
     wrap = _Train(net)
-    x = mx.nd.random.uniform(shape=(batch, input_size, input_size, 3))
+    x = mx.nd.random.uniform(shape=(batch, input_size, input_size, 3),
+                             dtype="bfloat16")
     rng = np.random.RandomState(0)
     M = 8
     wh = rng.uniform(0.1, 0.3, (batch, M, 2)) * input_size
@@ -189,18 +191,9 @@ def build_rcnn_step(batch, input_size=512):
 
 def _measure_rcnn(batch, steps, input_size):
     step, params, mom, data = build_rcnn_step(batch, input_size)
-    params, mom, loss = step(params, mom, *data)
-    params, mom, loss = step(params, mom, *data)
-    float(loss)
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        params, mom, loss = step(params, mom, *data)
-    final_loss = float(loss)
-    dt = time.perf_counter() - t0
-    img_s = batch * steps / dt
-    print(f"[bench_rcnn] batch={batch} loss={final_loss:.4f} dt={dt:.3f}s "
-          f"-> {img_s:.1f} img/s", file=sys.stderr)
-    return img_s
+    from bench_util import timed_measure
+    return timed_measure(step, params, mom, data, steps, batch,
+                         tag=f"bench_rcnn b{batch}")
 
 
 def measure_rcnn(batch=None, steps=None, on_result=None):
@@ -237,18 +230,9 @@ def measure_rcnn(batch=None, steps=None, on_result=None):
 
 def _measure_one(batch, steps, input_size):
     step, params, mom, data = build_step(batch, input_size)
-    params, mom, loss = step(params, mom, *data)
-    params, mom, loss = step(params, mom, *data)
-    float(loss)  # sync via host fetch (see bench.py note on the tunnel)
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        params, mom, loss = step(params, mom, *data)
-    final_loss = float(loss)
-    dt = time.perf_counter() - t0
-    img_s = batch * steps / dt
-    print(f"[bench_det] batch={batch} loss={final_loss:.4f} dt={dt:.3f}s "
-          f"-> {img_s:.1f} img/s", file=sys.stderr)
-    return img_s
+    from bench_util import timed_measure
+    return timed_measure(step, params, mom, data, steps, batch,
+                         tag=f"bench_det b{batch}")
 
 
 def measure(batch=None, steps=None, on_result=None):
@@ -295,6 +279,9 @@ def main():
         jax.config.update("jax_platforms", "cpu")
     batch = os.environ.get("BENCH_DET_BATCH")
     steps = os.environ.get("BENCH_DET_STEPS")
+    # standalone: BENCH_DET_RCNN=1 SELECTS the Faster-RCNN metric (one
+    # JSON line per invocation); the bench.py driver's BENCH_DET=1 runs
+    # both detectors and merges them as extra_metrics
     if os.environ.get("BENCH_DET_RCNN") == "1":
         res = measure_rcnn(
             [int(b) for b in batch.split(",")] if batch else None,

@@ -65,18 +65,10 @@ def build_step(batch, seq, vocab=36548):
 
 def _measure_one(batch, steps, seq):
     step, params, mom, data = build_step(batch, seq)
-    params, mom, loss = step(params, mom, *data)
-    params, mom, loss = step(params, mom, *data)
-    float(loss)  # sync via host fetch (see bench.py note on the tunnel)
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        params, mom, loss = step(params, mom, *data)
-    final_loss = float(loss)
-    dt = time.perf_counter() - t0
-    tok_s = batch * seq * 2 * steps / dt  # src+tgt tokens
-    print(f"[bench_nmt] batch={batch} loss={final_loss:.4f} dt={dt:.3f}s "
-          f"-> {tok_s:.0f} tok/s", file=sys.stderr)
-    return tok_s
+    from bench_util import timed_measure
+    return timed_measure(step, params, mom, data, steps,
+                         batch * seq * 2,  # src+tgt tokens
+                         tag=f"bench_nmt b{batch}")
 
 
 def measure(batch=None, steps=None, on_result=None):

@@ -17,7 +17,28 @@ import sys
 import time
 
 
+def timed_measure(step, params, mom, data, steps, items_per_dispatch,
+                  tag="bench"):
+    """The shared measurement protocol: 2 warmup dispatches (compile +
+    stabilise), host-fetch sync (block_until_ready doesn't block under
+    the axon tunnel), then `steps` timed dispatches. Returns
+    items_per_dispatch * steps / elapsed."""
+    params, mom, loss = step(params, mom, *data)
+    params, mom, loss = step(params, mom, *data)
+    float(loss)
+    t0 = time.monotonic()
+    for _ in range(steps):
+        params, mom, loss = step(params, mom, *data)
+    final_loss = float(loss)
+    dt = time.monotonic() - t0
+    rate = items_per_dispatch * steps / dt
+    print(f"[{tag}] loss={final_loss:.4f} dt={dt:.3f}s "
+          f"-> {rate:.1f} items/s", file=sys.stderr)
+    return rate
+
+
 def make_sgd_step(loss_fn, aux_idx, lr, mu, unroll=1):
+    unroll = max(1, int(unroll))  # 0/negative would zero the numerator
     """The jitted SGD-momentum train step every bench worker uses:
     value_and_grad(loss_fn) -> per-tensor momentum update -> aux (BN
     running stats) spliced back into the param list, optionally unrolled
