@@ -212,31 +212,31 @@ def main():
             logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
             return -jnp.mean(jnp.take_along_axis(logp, yb[:, None], 1)), aux
 
-        def train_step_1(p, mom, xb, yb):
-            (loss, aux), g = jax.value_and_grad(
-                loss_fn, has_aux=True)(p, xb, yb)
-            if fused:
+        from bench_util import make_sgd_step, timed_measure
+        if fused:
+            # the (REJECTED) multi-tensor lever replaces the whole
+            # per-tensor update, so it keeps its own step body
+            def train_step_1(p, mom, xb, yb):
+                (loss, aux), g = jax.value_and_grad(
+                    loss_fn, has_aux=True)(p, xb, yb)
                 from mxnet_tpu.optimizer.optimizer import \
                     fused_sgd_mom_kernel
                 new_p, new_mom = fused_sgd_mom_kernel(p, mom, g, lr, mu)
-            else:
-                new_mom = [mu * m + gg.astype(m.dtype)
-                           for m, gg in zip(mom, g)]
-                new_p = [pp - lr * m for pp, m in zip(p, new_mom)]
-            for i, v in zip(aux_idx, aux):  # BN running stats carry
-                new_p[i] = v
-            return new_p, new_mom, loss
+                for i, v in zip(aux_idx, aux):  # BN running stats carry
+                    new_p[i] = v
+                return new_p, new_mom, loss
 
-        def train_step(p, mom, xb, yb):
-            loss = None
-            for _ in range(unroll):  # static unroll: one dispatch, k steps
-                p, mom, loss = train_step_1(p, mom, xb, yb)
-            return p, mom, loss
+            def train_step(p, mom, xb, yb):
+                loss = None
+                for _ in range(unroll):
+                    p, mom, loss = train_step_1(p, mom, xb, yb)
+                return p, mom, loss
 
-        step = jax.jit(train_step, donate_argnums=(0, 1))
+            step = jax.jit(train_step, donate_argnums=(0, 1))
+        else:
+            step = make_sgd_step(loss_fn, aux_idx, lr, mu, unroll)
         mom = [jnp.zeros(p.shape, jnp.float32) if fused
                else jnp.zeros_like(p) for p in params]
-        from bench_util import timed_measure
         return timed_measure(step, params, mom, (images, labels), steps,
                              batch * unroll, tag=f"bench b{batch}")
 

@@ -38,12 +38,12 @@ def timed_measure(step, params, mom, data, steps, items_per_dispatch,
 
 
 def make_sgd_step(loss_fn, aux_idx, lr, mu, unroll=1):
-    unroll = max(1, int(unroll))  # 0/negative would zero the numerator
     """The jitted SGD-momentum train step every bench worker uses:
     value_and_grad(loss_fn) -> per-tensor momentum update -> aux (BN
     running stats) spliced back into the param list, optionally unrolled
     k steps per dispatch (the BENCH_UNROLL lever). Donation caveat lives
     with the callers: donate COPIES of params, the originals die."""
+    unroll = max(1, int(unroll))  # 0/negative would zero the numerator
     import jax
 
     def step_1(p, mom, *data):

@@ -429,6 +429,7 @@ class QuantizedNet:
         self._q_caches = {}
 
     def _run(self, x, mode):
+        args = x if isinstance(x, tuple) else (x,)
         self._ctl["mode"] = mode
         # calibration reads concrete activation values (np.asarray) — it
         # must NEVER run inside a jit trace, so hybridization is forced
@@ -444,15 +445,17 @@ class QuantizedNet:
                 deactivated.append(self._block)
         _swap_caches(self._block, self._q_caches)
         try:
-            return self._block(x)
+            return self._block(*args)
         finally:
             _swap_caches(self._block, self._q_caches)
             for b in deactivated:
                 b._active = True
             self._ctl["mode"] = "fp32"
 
-    def __call__(self, x):
-        return self._run(x, "int8")
+    def __call__(self, *args):
+        # multi-input nets (BERT: token_ids, segment_ids, ...) pass
+        # through as-is; single-input callers are unchanged
+        return self._run(args if len(args) > 1 else args[0], "int8")
 
     @property
     def quantized_layers(self):
@@ -461,7 +464,7 @@ class QuantizedNet:
 
 def quantize_net(network, quantized_dtype="int8", exclude_layers=None,
                  calib_data=None, num_calib_batches=None,
-                 calib_mode="naive", **kwargs):
+                 calib_mode="naive", calib_inputs=1, **kwargs):
     """Quantize a Gluon net's Dense/Conv2D layers to int8/uint8
     (reference: contrib.quantization.quantize_net). Works on ARBITRARY
     block trees — zoo models with custom residual blocks included.
@@ -509,7 +512,14 @@ def quantize_net(network, quantized_dtype="int8", exclude_layers=None,
         batches = []
         n = 0
         for batch in calib_data:
-            x = batch[0] if isinstance(batch, (tuple, list)) else batch
+            if isinstance(batch, (tuple, list)):
+                # (data, label) convention by default; calib_inputs=k
+                # feeds the first k elements as the net's inputs (multi-
+                # input nets like BERT: (token_ids, segment_ids, ...))
+                x = tuple(batch[:calib_inputs]) if calib_inputs > 1 \
+                    else batch[0]
+            else:
+                x = batch
             batches.append(x)
             qnet._run(x, "observe")       # pass 1: amax/min ranges
             n += 1
