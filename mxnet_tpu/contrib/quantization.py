@@ -428,8 +428,9 @@ class QuantizedNet:
             {"mode": "fp32"}
         self._q_caches = {}
 
-    def _run(self, x, mode):
-        args = x if isinstance(x, tuple) else (x,)
+    def _run(self, args, mode):
+        # internal contract: `args` is ALWAYS the tuple of net inputs —
+        # a tuple-valued single input is never splatted by accident
         self._ctl["mode"] = mode
         # calibration reads concrete activation values (np.asarray) — it
         # must NEVER run inside a jit trace, so hybridization is forced
@@ -455,7 +456,9 @@ class QuantizedNet:
     def __call__(self, *args):
         # multi-input nets (BERT: token_ids, segment_ids, ...) pass
         # through as-is; single-input callers are unchanged
-        return self._run(args if len(args) > 1 else args[0], "int8")
+        if not args:
+            raise MXNetError("QuantizedNet expects at least one input")
+        return self._run(args, "int8")
 
     @property
     def quantized_layers(self):
@@ -516,10 +519,9 @@ def quantize_net(network, quantized_dtype="int8", exclude_layers=None,
                 # (data, label) convention by default; calib_inputs=k
                 # feeds the first k elements as the net's inputs (multi-
                 # input nets like BERT: (token_ids, segment_ids, ...))
-                x = tuple(batch[:calib_inputs]) if calib_inputs > 1 \
-                    else batch[0]
+                x = tuple(batch[:calib_inputs])
             else:
-                x = batch
+                x = (batch,)
             batches.append(x)
             qnet._run(x, "observe")       # pass 1: amax/min ranges
             n += 1
