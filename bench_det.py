@@ -83,10 +83,11 @@ def build_step(batch, input_size=512):
         return l_cls + l_loc, aux
 
     from bench_util import make_sgd_step
-    step = make_sgd_step(loss_fn, aux_idx, lr=0.01, mu=0.9)
+    unroll = max(1, int(os.environ.get("BENCH_DET_UNROLL", "1")))
+    step = make_sgd_step(loss_fn, aux_idx, lr=0.01, mu=0.9, unroll=unroll)
     mom = [jnp.zeros_like(p) for p in params]
     data = (x._data, cls_t, loc_t, loc_m)
-    return step, params, mom, data
+    return step, params, mom, data, unroll
 
 
 BASELINE_RCNN_IMG_S = 270.0
@@ -229,9 +230,9 @@ def measure_rcnn(batch=None, steps=None, on_result=None):
 
 
 def _measure_one(batch, steps, input_size):
-    step, params, mom, data = build_step(batch, input_size)
+    step, params, mom, data, unroll = build_step(batch, input_size)
     from bench_util import timed_measure
-    return timed_measure(step, params, mom, data, steps, batch,
+    return timed_measure(step, params, mom, data, steps, batch * unroll,
                          tag=f"bench_det b{batch}")
 
 

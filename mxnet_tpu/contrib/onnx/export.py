@@ -372,21 +372,22 @@ def _softmax_decomposed(node, ctx, out, log):
         ctx.add_node("Div", [ex, s], [out], node.name)
 
 
-def _length_masked_softmax(node, ctx, out):
-    """softmax(use_length=True): mask positions >= per-batch length along
-    the last axis, then softmax. Decomposed to Shape/Gather/Range/Less/
-    Where so the sequence length stays DYNAMIC in the exported graph
-    (any S at inference), mirroring the framework kernel's arange mask
-    with the same -1e9 fill."""
+def _masked_softmax(node, ctx, out, length, causal):
+    """softmax(use_length=True and/or causal=True): mask the last axis by
+    per-batch length and/or by the causal row bound, then softmax.
+    Decomposed to Shape/Gather/Range/Less/And/Where so the sequence
+    lengths stay DYNAMIC in the exported graph (any S at inference),
+    mirroring the framework kernel's arange masks with the same -1e9
+    fill. (opset 11 has no LessOrEqual, so causal col <= row emits
+    Less(col, row + 1).)"""
     nm = node.name
     x = ctx.tensor(node._inputs[0])
-    ln = ctx.tensor(node._inputs[1])
     s = ctx.shape_of.get(x)
     if s is None:
         # the Unsqueeze axes below are rank-dependent; a guessed rank
         # would export a silently-wrong mask broadcast
         raise MXNetError(
-            "ONNX export: length-masked softmax needs the data rank — "
+            "ONNX export: masked softmax needs the data rank — "
             "pass input_shapes to export_model so shapes infer")
     rank = len(s)
 
@@ -394,15 +395,27 @@ def _length_masked_softmax(node, ctx, out):
         return _emit(ctx, nm, op, ins, hint, *attrs)
 
     shape = n2("Shape", [x], "_shape")
-    last = ctx.const(nm + "_lastidx", np.asarray(rank - 1, np.int64))
-    sdim = n2("Gather", [shape, last], "_sdim", A_i("axis", 0))
     zero = ctx.const(nm + "_zero", np.asarray(0, np.int64))
     one = ctx.const(nm + "_one", np.asarray(1, np.int64))
-    rng = n2("Range", [zero, sdim, one], "_range")         # (S,) int64
-    lcast = n2("Cast", [ln], "_lcast", A_i("to", P.INT64))  # (B,)
-    lexp = n2("Unsqueeze", [lcast], "_lexp",
-              A_ints("axes", tuple(range(1, rank))))        # (B,1,..,1)
-    mask = n2("Less", [rng, lexp], "_mask")                 # (B,1,..,S)
+    last = ctx.const(nm + "_lastidx", np.asarray(rank - 1, np.int64))
+    sdim = n2("Gather", [shape, last], "_sdim", A_i("axis", 0))
+    cols = n2("Range", [zero, sdim, one], "_range")         # (S,) int64
+    mask = None
+    if length:
+        ln = ctx.tensor(node._inputs[1])
+        lcast = n2("Cast", [ln], "_lcast", A_i("to", P.INT64))  # (B,)
+        lexp = n2("Unsqueeze", [lcast], "_lexp",
+                  A_ints("axes", tuple(range(1, rank))))    # (B,1,..,1)
+        mask = n2("Less", [cols, lexp], "_lenmask")         # (B,1,..,S)
+    if causal:
+        rowidx = ctx.const(nm + "_rowidx", np.asarray(rank - 2, np.int64))
+        qdim = n2("Gather", [shape, rowidx], "_qdim")
+        rows = n2("Range", [zero, qdim, one], "_rowrange")  # (Sq,) int64
+        rowsu = n2("Unsqueeze", [rows], "_rowsu", A_ints("axes", (1,)))
+        rowp1 = n2("Add", [rowsu, one], "_rowp1")           # (Sq, 1)
+        cmask = n2("Less", [cols, rowp1], "_causalmask")    # (Sq, S)
+        mask = cmask if mask is None else \
+            n2("And", [mask, cmask], "_mask")
     neg = ctx.const(nm + "_neg", np.float32(-1e9))
     masked = n2("Where", [mask, x, neg], "_masked")
     ctx.add_node("Softmax", [masked], [out], nm, A_i("axis", -1))
@@ -411,11 +424,13 @@ def _length_masked_softmax(node, ctx, out):
 @register_converter("softmax")
 def _softmax(node, ctx, out):
     axis = node._attrs.get("axis", -1)
-    if len(node._inputs) > 1:
+    length = len(node._inputs) > 1
+    causal = node._attrs.get("causal", False)
+    if length or causal:
         if axis != -1:
-            raise MXNetError("ONNX export: length-masked softmax is "
+            raise MXNetError("ONNX export: masked softmax is "
                              "last-axis only")
-        return _length_masked_softmax(node, ctx, out)
+        return _masked_softmax(node, ctx, out, length, causal)
     if axis == -1:
         ctx.add_node("Softmax", [ctx.tensor(node._inputs[0])], [out],
                      node.name, A_i("axis", -1))

@@ -1,0 +1,30 @@
+"""Shared symbolic multi-head attention decomposition.
+
+The flash-attention blocks (models.bert.MultiHeadSelfAttention,
+models.transformer.SelfAttention/CrossAttention) trace eagerly through
+the Pallas kernel; for export/serialization they decompose into named
+graph ops instead. ONE decomposition lives here so the export numerics
+(head reshape, 1/sqrt(head_dim) scale, -1e9 masked softmax) cannot
+diverge between models.
+"""
+from __future__ import annotations
+
+import math
+
+
+def sym_attention(F, q, k, v, num_heads, units, length=None, causal=False):
+    """(B, S, D) projected q/k/v Symbols -> (B, S, D) attention output.
+
+    `length` is an optional (B,) kv valid-length Symbol; `causal` masks
+    past-the-row positions — both ride the softmax op's masked form, the
+    same kernel the ONNX decomposition pins."""
+    h = num_heads
+
+    def heads(t):  # (B, S, D) -> (B, h, S, dh)
+        return F.transpose(F.reshape(t, (0, 0, h, -1)), (0, 2, 1, 3))
+
+    kt = F.transpose(F.reshape(k, (0, 0, h, -1)), (0, 2, 3, 1))
+    scores = F.batch_dot(heads(q), kt) * (1.0 / math.sqrt(units // h))
+    attnw = F.softmax(scores, length=length, axis=-1, causal=causal)
+    out = F.batch_dot(attnw, heads(v))
+    return F.reshape(F.transpose(out, (0, 2, 1, 3)), (0, 0, -1))

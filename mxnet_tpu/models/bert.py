@@ -15,8 +15,6 @@ TPU-first choices:
 """
 from __future__ import annotations
 
-import math
-
 import numpy as np
 import jax.numpy as jnp
 
@@ -25,6 +23,7 @@ from ..ndarray.ndarray import NDArray, _apply
 from ..gluon import nn
 from ..gluon.block import HybridBlock, is_symbolic as _is_symbol
 from ..ops.pallas_kernels import flash_attention
+from ._sym_attention import sym_attention
 
 __all__ = ["BERTModel", "BERTEncoder", "BERTEncoderLayer",
            "MultiHeadSelfAttention", "PositionwiseFFN", "BERTForPretraining",
@@ -59,24 +58,16 @@ class MultiHeadSelfAttention(HybridBlock):
 
     def _symbolic_forward(self, F, x, valid_length):
         """Symbolic attention for export: the flash kernel decomposed into
-        named graph ops (slice/reshape/batch_dot/length-masked softmax) so
-        ONNX export and SymbolBlock reload see a serialisable graph.
-        Numerics match the eager path (same masking rule, bf16-free)."""
-        d, h = self._units, self._num_heads
+        named graph ops so ONNX export and SymbolBlock reload see a
+        serialisable graph (shared decomposition:
+        models/_sym_attention.py; numerics match the eager path)."""
+        d = self._units
         qkv = self.qkv(x)
         q = F.slice_axis(qkv, axis=-1, begin=0, end=d)
         k = F.slice_axis(qkv, axis=-1, begin=d, end=2 * d)
         v = F.slice_axis(qkv, axis=-1, begin=2 * d, end=3 * d)
-
-        def heads(t):  # (B,S,D) -> (B,h,S,dh)
-            return F.transpose(F.reshape(t, (0, 0, h, -1)), (0, 2, 1, 3))
-
-        kt = F.transpose(F.reshape(k, (0, 0, h, -1)), (0, 2, 3, 1))
-        scores = F.batch_dot(heads(q), kt) * (1.0 / math.sqrt(d // h))
-        attnw = F.softmax(scores, length=valid_length, axis=-1) \
-            if valid_length is not None else F.softmax(scores, axis=-1)
-        out = F.batch_dot(attnw, heads(v))          # (B,h,S,dh)
-        out = F.reshape(F.transpose(out, (0, 2, 1, 3)), (0, 0, -1))
+        out = sym_attention(F, q, k, v, self._num_heads, d,
+                            length=valid_length)
         return self.dropout(self.proj(out))
 
     def hybrid_forward(self, F, x, valid_length=None):

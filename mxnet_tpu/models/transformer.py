@@ -25,8 +25,22 @@ from jax import lax
 from ..base import MXNetError
 from ..ndarray.ndarray import NDArray, _apply
 from ..gluon import nn
-from ..gluon.block import HybridBlock, extract_pure_fn
+from ..gluon.block import HybridBlock, extract_pure_fn, \
+    is_symbolic as _is_symbol
 from ..ops.pallas_kernels import flash_attention
+from ._sym_attention import sym_attention
+
+
+def _sym_dim(s, axis):
+    """Static dim of a traced Symbol via shape inference (needs shaped
+    input Variables, like the BERT symbolic path)."""
+    try:
+        _, out_shapes, _ = s.infer_shape()
+        return int(out_shapes[0][axis])
+    except Exception as e:
+        raise MXNetError(
+            "transformer symbolic trace needs shaped input Variables "
+            f"(sym.Variable(name, shape=...)): {e!r}") from e
 
 __all__ = ["TransformerEncoder", "TransformerDecoder", "TransformerNMT",
            "transformer_base", "beam_search", "beam_search_cached",
@@ -69,7 +83,21 @@ class SelfAttention(HybridBlock):
                                  prefix="proj_")
             self.dropout = nn.Dropout(dropout)
 
+    def _symbolic_forward(self, F, x, valid_length):
+        """Flash attention decomposed into named graph ops for export
+        (shared decomposition: models/_sym_attention.py)."""
+        qkv = self.qkv(x)
+        d = self.qkv._units // 3
+        q = F.slice_axis(qkv, axis=-1, begin=0, end=d)
+        k = F.slice_axis(qkv, axis=-1, begin=d, end=2 * d)
+        v = F.slice_axis(qkv, axis=-1, begin=2 * d, end=3 * d)
+        out = sym_attention(F, q, k, v, self._h, d, length=valid_length,
+                            causal=self._causal)
+        return self.dropout(self.proj(out))
+
     def hybrid_forward(self, F, x, valid_length=None):
+        if _is_symbol(x):
+            return self._symbolic_forward(F, x, valid_length)
         h, causal = self._h, self._causal
 
         def attn(qkv_raw, *maybe_vl):
@@ -98,7 +126,18 @@ class CrossAttention(HybridBlock):
                                  prefix="proj_")
             self.dropout = nn.Dropout(dropout)
 
+    def _symbolic_forward(self, F, x, memory, mem_valid_length):
+        kv = self.kv(memory)
+        d = self.kv._units // 2
+        k = F.slice_axis(kv, axis=-1, begin=0, end=d)
+        v = F.slice_axis(kv, axis=-1, begin=d, end=2 * d)
+        out = sym_attention(F, self.q(x), k, v, self._h, d,
+                            length=mem_valid_length)
+        return self.dropout(self.proj(out))
+
     def hybrid_forward(self, F, x, memory, mem_valid_length=None):
+        if _is_symbol(x):
+            return self._symbolic_forward(F, x, memory, mem_valid_length)
         h = self._h
 
         def attn(q_raw, kv_raw, *maybe_vl):
@@ -177,14 +216,28 @@ class TransformerEncoder(HybridBlock):
                     self.layers.add(EncoderLayer(units, hidden, num_heads,
                                                  dropout))
 
+    def collect_constants(self):
+        """Non-param constants the symbolic graph references (the
+        sinusoid table); merge into the params dict for bind/export."""
+        return {self.prefix + "pos_table": NDArray(jnp.asarray(self._pos))}
+
     def hybrid_forward(self, F, x, valid_length=None):
-        s = x.shape[1]
-        pos, scale = self._pos, self._scale
+        if _is_symbol(x):
+            s = _sym_dim(x, 1)
+            pos = F.Variable(self.prefix + "pos_table",
+                             shape=self._pos.shape)
+            x = F.broadcast_add(
+                x * self._scale,
+                F.expand_dims(F.slice_axis(pos, axis=0, begin=0, end=s), 0))
+        else:
+            s = x.shape[1]
+            pos, scale = self._pos, self._scale
 
-        def add_pos(a):
-            return a * scale + jnp.asarray(pos[:s])[None]
+            def add_pos(a):
+                return a * scale + jnp.asarray(pos[:s])[None]
 
-        x = self.dropout(_apply(add_pos, [x]))
+            x = _apply(add_pos, [x])
+        x = self.dropout(x)
         for layer in self.layers:
             x = layer(x, valid_length)
         return x
@@ -204,16 +257,31 @@ class TransformerDecoder(HybridBlock):
                     self.layers.add(DecoderLayer(units, hidden, num_heads,
                                                  dropout))
 
+    def collect_constants(self):
+        return {self.prefix + "pos_table": NDArray(jnp.asarray(self._pos))}
+
     def hybrid_forward(self, F, x, memory, self_valid_length=None,
                        mem_valid_length=None, position_offset=0):
-        s = x.shape[1]
-        pos, scale = self._pos, self._scale
-        off = position_offset
+        if _is_symbol(x):
+            if position_offset != 0:
+                raise MXNetError("symbolic decoder trace covers the "
+                                 "teacher-forcing path (position_offset=0)")
+            s = _sym_dim(x, 1)
+            pos = F.Variable(self.prefix + "pos_table",
+                             shape=self._pos.shape)
+            x = F.broadcast_add(
+                x * self._scale,
+                F.expand_dims(F.slice_axis(pos, axis=0, begin=0, end=s), 0))
+        else:
+            s = x.shape[1]
+            pos, scale = self._pos, self._scale
+            off = position_offset
 
-        def add_pos(a):
-            return a * scale + jnp.asarray(pos[off:off + s])[None]
+            def add_pos(a):
+                return a * scale + jnp.asarray(pos[off:off + s])[None]
 
-        x = self.dropout(_apply(add_pos, [x]))
+            x = _apply(add_pos, [x])
+        x = self.dropout(x)
         for layer in self.layers:
             x = layer(x, memory, self_valid_length, mem_valid_length)
         return x
@@ -241,8 +309,18 @@ class TransformerNMT(HybridBlock):
         return (self.encoder(self.embed(src), src_valid_length),
                 src_valid_length)
 
+    def collect_constants(self):
+        """Pos tables for bind/export of the symbolic graph (merge into
+        the params dict alongside collect_params)."""
+        return {**self.encoder.collect_constants(),
+                **self.decoder.collect_constants()}
+
     def project(self, x):
         """Tied output projection: logits = x @ embed.T."""
+        if _is_symbol(x):
+            from .. import symbol as F
+            return F.batch_dot(x, F.transpose(self.embed.weight.var(),
+                                              (1, 0)))
         w = self.embed.weight.data()
         return _apply(lambda a, ww: jnp.einsum("bsd,vd->bsv", a, ww), [x, w])
 

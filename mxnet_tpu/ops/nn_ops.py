@@ -547,28 +547,36 @@ def SoftmaxOutput(data, label=None, **kwargs):
 
 
 def softmax_nd(data, length=None, axis=-1, temperature=None,
-               use_length=False):
+               use_length=False, causal=False):
     # positional order matches the reference AND the symbol-side softmax:
     # (data, length, axis, ...) — python/mxnet/ndarray/gen_op softmax
     # reference: softmax(..., use_length=True) masks positions >= the
     # per-batch length along the (last) softmax axis (src/operator/nn/
-    # softmax.cc); same kernel the symbol op and ONNX export pin
-    if length is not None or use_length:
-        if length is None:
+    # softmax.cc); `causal` (attention-export extension) masks positions
+    # past the query row. Same kernel the symbol op and ONNX export pin.
+    if length is not None or use_length or causal:
+        if use_length and length is None:
             raise MXNetError("softmax: use_length=True needs a length input")
 
-        def masked(x, ln, _ax=axis, _t=temperature):
+        def masked(x, *maybe_ln, _ax=axis, _t=temperature):
             if _t is not None and _t != 1.0:
                 x = x / _t
             if _ax % x.ndim != x.ndim - 1:
                 raise MXNetError(
-                    "softmax: length masking supports the last axis only")
+                    "softmax: masking supports the last axis only")
+            keep = jnp.ones((), bool)
             idx = jnp.arange(x.shape[-1])
-            lb = ln.astype(jnp.int32).reshape(
-                (ln.shape[0],) + (1,) * (x.ndim - 1))
-            return jax.nn.softmax(jnp.where(idx < lb, x, -1e9), axis=-1)
+            if maybe_ln:
+                lb = maybe_ln[0].astype(jnp.int32).reshape(
+                    (maybe_ln[0].shape[0],) + (1,) * (x.ndim - 1))
+                keep = keep & (idx < lb)
+            if causal:
+                keep = keep & (idx[None, :] <= jnp.arange(
+                    x.shape[-2])[:, None])
+            return jax.nn.softmax(jnp.where(keep, x, -1e9), axis=-1)
 
-        return _apply(masked, [data, length])
+        ins = [data] + ([length] if length is not None else [])
+        return _apply(masked, ins)
     return _apply(lambda x, _ax=axis, _t=temperature: softmax(x, _ax, _t), [data])
 
 

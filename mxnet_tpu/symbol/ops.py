@@ -74,20 +74,29 @@ register_op("exp", jnp.exp)
 register_op("log", jnp.log)
 register_op("sqrt", jnp.sqrt)
 register_op("square", jnp.square)
-def _softmax_kernel(a, *length, axis=-1, use_length=False):
-    """Softmax with optional per-batch length masking of the softmax axis
-    (reference: softmax(..., use_length=True), src/operator/nn/softmax.cc).
-    `length` has shape (B,) = data's leading dim; positions >= length along
-    the (last) softmax axis are excluded. -1e9 (not -inf) keeps fully-padded
-    query rows finite and matches the ONNX export decomposition bit-for-bit."""
-    if not length:
+def _softmax_kernel(a, *length, axis=-1, use_length=False, causal=False):
+    """Softmax with optional masking of the softmax axis (reference:
+    softmax(..., use_length=True), src/operator/nn/softmax.cc; the causal
+    flag is the attention-export extension). `length` has shape (B,) =
+    data's leading dim; positions >= length along the (last) softmax axis
+    are excluded. causal=True additionally masks positions past the query
+    row (axis -2). -1e9 (not -inf) keeps fully-masked rows finite and
+    matches the ONNX export decomposition bit-for-bit."""
+    if not length and not causal:
         return jax.nn.softmax(a, axis=axis)
-    (ln,) = length
     if axis % a.ndim != a.ndim - 1:
-        raise MXNetError("softmax: length masking supports the last axis only")
+        raise MXNetError("softmax: masking supports the last axis only")
+    keep = jnp.ones((), bool)
     idx = jnp.arange(a.shape[-1])
-    lb = ln.astype(jnp.int32).reshape((ln.shape[0],) + (1,) * (a.ndim - 1))
-    return jax.nn.softmax(jnp.where(idx < lb, a, -1e9), axis=-1)
+    if length:
+        (ln,) = length
+        lb = ln.astype(jnp.int32).reshape(
+            (ln.shape[0],) + (1,) * (a.ndim - 1))
+        keep = keep & (idx < lb)
+    if causal:
+        rows = jnp.arange(a.shape[-2])[:, None]
+        keep = keep & (idx[None, :] <= rows)
+    return jax.nn.softmax(jnp.where(keep, a, -1e9), axis=-1)
 
 
 register_op("softmax", _softmax_kernel)
@@ -497,12 +506,17 @@ def LogisticRegressionOutput(data, label=None, grad_scale=1.0, name=None,
                  {"grad_scale": grad_scale}, name=name)
 
 
-def softmax(data, length=None, axis=-1, use_length=False, name=None):
+def softmax(data, length=None, axis=-1, use_length=False, causal=False,
+            name=None):
     if length is not None or use_length:
         if length is None:
             raise MXNetError("softmax: use_length=True needs a length input")
         return _make("softmax", [data, length],
-                     {"axis": axis, "use_length": True}, name=name)
+                     {"axis": axis, "use_length": True, "causal": causal},
+                     name=name)
+    if causal:
+        return _make("softmax", [data], {"axis": axis, "causal": True},
+                     name=name)
     return _make("softmax", [data], {"axis": axis}, name=name)
 
 
