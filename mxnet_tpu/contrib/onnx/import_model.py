@@ -317,6 +317,14 @@ def _less(node, ctx, S):
                               ctx.get(node["inputs"][1]))
 
 
+@register_importer("And")
+def _and(node, ctx, S):
+    # comparison importers yield float 0/1 masks (the reference
+    # broadcast_lesser convention), so logical-and is their product
+    return S.broadcast_mul(ctx.get(node["inputs"][0]),
+                           ctx.get(node["inputs"][1]))
+
+
 @register_importer("Where")
 def _where(node, ctx, S):
     return S.where(ctx.get(node["inputs"][0]), ctx.get(node["inputs"][1]),

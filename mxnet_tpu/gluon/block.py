@@ -224,6 +224,16 @@ class Block:
             ret.update(child.collect_params(select))
         return ret
 
+    def collect_constants(self):
+        """Non-param constants that symbolic traces reference (e.g. the
+        transformer's sinusoid position tables). Recursive like
+        collect_params; blocks owning constants override and merge with
+        super()'s result. Merge into the params dict for bind/export."""
+        out = {}
+        for child in self._children.values():
+            out.update(child.collect_constants())
+        return out
+
     def initialize(self, init=None, ctx=None, verbose=False,
                    force_reinit=False):
         self.collect_params().initialize(init, ctx, verbose, force_reinit)
@@ -469,15 +479,29 @@ class HybridBlock(Block):
                        *[p.data()._data for p in params])
         return jax.jit(pure), meta
 
-    def export(self, path, epoch=0, num_inputs=1):
+    def export(self, path, epoch=0, num_inputs=1, input_shapes=None):
         """Export `path-symbol.json` + `path-{epoch:04d}.params.npz`
         (reference: HybridBlock.export). The graph is re-traced
         symbolically, so the artifact reloads with `SymbolBlock.imports`
         and runs as one jitted Executor. Blocks whose layers have no
-        symbolic trace fall back to params + an architecture repr."""
+        symbolic trace fall back to params + an architecture repr.
+        `input_shapes` (list, one per input) puts shape hints on the
+        traced Variables — required by blocks whose symbolic trace
+        reads static dims (transformer position slices)."""
         import json
         from .. import symbol as sym_mod
-        data = [sym_mod.Variable("data" if i == 0 else f"data{i}")
+        if input_shapes is not None:
+            if (not isinstance(input_shapes, (list, tuple))
+                    or len(input_shapes) != num_inputs
+                    or not all(s is None or isinstance(s, (list, tuple))
+                               for s in input_shapes)):
+                raise MXNetError(
+                    "export: input_shapes must be a list of one shape "
+                    f"tuple (or None) per input, got {input_shapes!r} "
+                    f"for num_inputs={num_inputs}")
+        shapes = list(input_shapes or [None] * num_inputs)
+        data = [sym_mod.Variable("data" if i == 0 else f"data{i}",
+                                 shape=shapes[i])
                 for i in range(num_inputs)]
         try:
             out = self(*data)
@@ -501,6 +525,12 @@ class HybridBlock(Block):
             ("aux:" if p.name in aux_names else "arg:") + p.name:
                 p.data().asnumpy()
             for p in self.collect_params().values() if p._data is not None}
+        # non-param constants the symbolic graph references (e.g. the
+        # transformer's sinusoid tables — collected recursively, so
+        # wrapper blocks export nested models' constants too) ship in
+        # the same params file and bind like any other argument
+        for cname, cval in self.collect_constants().items():
+            arrays["arg:" + cname] = cval.asnumpy()
         input_names = {d.name for d in data}
         unmaterialized = [
             a for a in out.list_arguments() + out.list_auxiliary_states()

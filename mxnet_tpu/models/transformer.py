@@ -217,9 +217,9 @@ class TransformerEncoder(HybridBlock):
                                                  dropout))
 
     def collect_constants(self):
-        """Non-param constants the symbolic graph references (the
-        sinusoid table); merge into the params dict for bind/export."""
-        return {self.prefix + "pos_table": NDArray(jnp.asarray(self._pos))}
+        out = super().collect_constants()
+        out[self.prefix + "pos_table"] = NDArray(jnp.asarray(self._pos))
+        return out
 
     def hybrid_forward(self, F, x, valid_length=None):
         if _is_symbol(x):
@@ -258,7 +258,9 @@ class TransformerDecoder(HybridBlock):
                                                  dropout))
 
     def collect_constants(self):
-        return {self.prefix + "pos_table": NDArray(jnp.asarray(self._pos))}
+        out = super().collect_constants()
+        out[self.prefix + "pos_table"] = NDArray(jnp.asarray(self._pos))
+        return out
 
     def hybrid_forward(self, F, x, memory, self_valid_length=None,
                        mem_valid_length=None, position_offset=0):
@@ -308,12 +310,6 @@ class TransformerNMT(HybridBlock):
     def encode(self, src, src_valid_length=None):
         return (self.encoder(self.embed(src), src_valid_length),
                 src_valid_length)
-
-    def collect_constants(self):
-        """Pos tables for bind/export of the symbolic graph (merge into
-        the params dict alongside collect_params)."""
-        return {**self.encoder.collect_constants(),
-                **self.decoder.collect_constants()}
 
     def project(self, x):
         """Tied output projection: logits = x @ embed.T."""

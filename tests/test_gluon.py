@@ -310,3 +310,27 @@ def test_export_imports_resnet(tmp_path):
         keys = list(f.keys())
     assert any(k.startswith("aux:") for k in keys)
     assert any(k.startswith("arg:") for k in keys)
+
+
+def test_transformer_export_symbolblock_roundtrip(tmp_path):
+    """HybridBlock.export with input_shapes ships the transformer's
+    sinusoid tables (collect_constants) in the params file, so
+    SymbolBlock.imports reloads and reproduces the trained logits —
+    the reference deployment pair for seq2seq."""
+    import numpy as np
+    from mxnet_tpu.models.transformer import TransformerNMT
+    from mxnet_tpu.gluon.block import SymbolBlock
+    net = TransformerNMT(vocab_size=25, units=16, hidden=32, num_layers=1,
+                         num_heads=4, max_length=10, dropout=0.0)
+    net.initialize()
+    rng = np.random.RandomState(2)
+    B, S = 2, 6
+    src = nd.array(rng.randint(0, 25, (B, S)).astype(np.float32))
+    tgt = nd.array(rng.randint(0, 25, (B, S)).astype(np.float32))
+    ref = net(src, tgt).asnumpy()
+    path = str(tmp_path / "nmt")
+    net.export(path, num_inputs=2, input_shapes=[(B, S), (B, S)])
+    loaded = SymbolBlock.imports(f"{path}-symbol.json", ["data", "data1"],
+                                 f"{path}-0000.params.npz")
+    got = loaded(src, tgt).asnumpy()
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)

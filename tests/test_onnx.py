@@ -652,3 +652,36 @@ def test_masked_softmax_causal_plus_length_export():
     # row 0 attends only to col 0; batch 1 cols >= 3 are dead
     assert np.allclose(got[:, :, 0, 1:], 0, atol=1e-7)
     assert np.allclose(got[1, :, :, 3:], 0, atol=1e-7)
+
+
+def test_transformer_nmt_import_roundtrip(tmp_path):
+    """Export the NMT model, import it back, bind, and match eager
+    logits — the dynamic causal idiom (two Range chains + Less/And)
+    must rebuild and execute through the importer too."""
+    from mxnet_tpu.contrib.onnx import import_model
+    from mxnet_tpu.models.transformer import TransformerNMT
+    net = TransformerNMT(vocab_size=35, units=16, hidden=32, num_layers=1,
+                         num_heads=4, max_length=12, dropout=0.0)
+    net.initialize()
+    B, S = 2, 8
+    rng = np.random.RandomState(9)
+    src = rng.randint(0, 35, (B, S)).astype(np.float32)
+    tgt = rng.randint(0, 35, (B, S)).astype(np.float32)
+    vl = np.array([8, 5], np.float32)
+    ref = net(nd.array(src), nd.array(tgt), nd.array(vl)).asnumpy()
+    g = net(sym.Variable("src", shape=(B, S)),
+            sym.Variable("tgt", shape=(B, S)),
+            sym.Variable("src_valid_length", shape=(B,)))
+    params = {k: v.data() for k, v in net.collect_params().items()}
+    params.update(net.collect_constants())
+    path = export_model(g, params,
+                        {"src": (B, S), "tgt": (B, S),
+                         "src_valid_length": (B,)},
+                        onnx_file_path=str(tmp_path / "nmt_i.onnx"))
+    s2, args, aux = import_model(path)
+    feed = dict(args)
+    feed.update(src=nd.array(src), tgt=nd.array(tgt),
+                src_valid_length=nd.array(vl))
+    outs = s2.bind(None, feed, aux_states=aux).forward()
+    np.testing.assert_allclose(outs[0].asnumpy(), ref,
+                               rtol=2e-4, atol=2e-4)
