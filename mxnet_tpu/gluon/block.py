@@ -528,14 +528,16 @@ class HybridBlock(Block):
         # non-param constants the symbolic graph references (e.g. the
         # transformer's sinusoid tables — collected recursively, so
         # wrapper blocks export nested models' constants too) ship in
-        # the same params file and bind like any other argument
+        # the same params file; the const: prefix makes imports load
+        # them grad_req='null' so fine-tuning can't drift them
         for cname, cval in self.collect_constants().items():
-            arrays["arg:" + cname] = cval.asnumpy()
+            arrays["const:" + cname] = cval.asnumpy()
         input_names = {d.name for d in data}
         unmaterialized = [
             a for a in out.list_arguments() + out.list_auxiliary_states()
             if a not in input_names
-            and f"arg:{a}" not in arrays and f"aux:{a}" not in arrays]
+            and f"arg:{a}" not in arrays and f"aux:{a}" not in arrays
+            and f"const:{a}" not in arrays]
         if unmaterialized:
             raise MXNetError(
                 f"export: parameters {unmaterialized} have no data "
@@ -639,10 +641,13 @@ class SymbolBlock(HybridBlock):
         if param_file:
             with np.load(param_file) as f:
                 for k in f.keys():
-                    name = k.split(":", 1)[1] if ":" in k else k
+                    prefix, _, rest = k.partition(":")
+                    name = rest if rest else k
+                    # aux states AND shipped constants (const: prefix,
+                    # e.g. sinusoid tables) must not be optimized
+                    frozen = name in aux_names or prefix == "const"
                     p = Parameter(name, shape=f[k].shape,
-                                  grad_req="null" if name in aux_names
-                                  else "write")
+                                  grad_req="null" if frozen else "write")
                     p.set_data(NDArray(f[k]))
                     params[name] = p
             missing = [a for a in (out.list_arguments()

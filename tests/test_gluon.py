@@ -334,3 +334,37 @@ def test_transformer_export_symbolblock_roundtrip(tmp_path):
                                  f"{path}-0000.params.npz")
     got = loaded(src, tgt).asnumpy()
     np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
+
+
+def test_exported_constants_frozen_on_reimport(tmp_path):
+    """Shipped constants (const: prefix) reload grad_req='null' — a
+    Trainer on the re-imported transformer must NOT update the sinusoid
+    tables (r4 review finding: they came back as trainable args)."""
+    import numpy as np
+    from mxnet_tpu.models.transformer import TransformerNMT
+    from mxnet_tpu.gluon.block import SymbolBlock
+    net = TransformerNMT(vocab_size=20, units=16, hidden=32, num_layers=1,
+                         num_heads=4, max_length=10, dropout=0.0)
+    net.initialize()
+    path = str(tmp_path / "nmtf")
+    net.export(path, num_inputs=2, input_shapes=[(2, 5), (2, 5)])
+    loaded = SymbolBlock.imports(f"{path}-symbol.json", ["data", "data1"],
+                                 f"{path}-0000.params.npz")
+    consts = {k: p for k, p in loaded.collect_params().items()
+              if k.endswith("pos_table")}
+    assert consts and all(p.grad_req == "null" for p in consts.values())
+    rng = np.random.RandomState(0)
+    src = nd.array(rng.randint(0, 20, (2, 5)).astype(np.float32))
+    tgt = nd.array(rng.randint(0, 20, (2, 5)).astype(np.float32))
+    lab = nd.array(rng.randint(0, 20, (2, 5)).astype(np.float32))
+    before = {k: p.data().asnumpy().copy() for k, p in consts.items()}
+    tr = gluon.Trainer(loaded.collect_params(), "sgd",
+                       {"learning_rate": 0.5})
+    lossf = gluon.loss.SoftmaxCrossEntropyLoss()
+    with autograd.record():
+        out = loaded(src, tgt)
+        L = lossf(out.reshape((-1, 20)), lab.reshape((-1,))).mean()
+    L.backward()
+    tr.step(2)
+    for k, p in consts.items():
+        np.testing.assert_array_equal(p.data().asnumpy(), before[k])
