@@ -282,6 +282,8 @@ def main():
     # headline metrics. BENCH_DET=1 runs BOTH halves of BASELINE config
     # 5 (SSD-512 and Faster-RCNN).
     extra_measures = []
+    if os.environ.get("BENCH_MLP") == "1":
+        extra_measures.append(("bench_mlp", "measure"))
     if os.environ.get("BENCH_NMT") == "1":
         extra_measures.append(("bench_nmt", "measure"))
     if os.environ.get("BENCH_DET") == "1":

@@ -368,3 +368,31 @@ def test_exported_constants_frozen_on_reimport(tmp_path):
     tr.step(2)
     for k, p in consts.items():
         np.testing.assert_array_equal(p.data().asnumpy(), before[k])
+
+
+def test_bert_export_symbolblock_roundtrip(tmp_path):
+    """BERT deploys through the reference export/imports pair too: the
+    symbolic trace (decomposed flash attention) exports with shape
+    hints and reloads as one Executor, ragged valid_length included."""
+    import numpy as np
+    from mxnet_tpu.models.bert import BERTModel
+    from mxnet_tpu.gluon.block import SymbolBlock
+    net = BERTModel(vocab_size=40, units=32, hidden_size=64, num_layers=2,
+                    num_heads=4, max_length=12, dropout=0.0)
+    net.initialize()
+    rng = np.random.RandomState(6)
+    B, S = 2, 9
+    tok = nd.array(rng.randint(0, 40, (B, S)).astype(np.float32))
+    seg = nd.array(np.zeros((B, S), np.float32))
+    vl = nd.array(np.array([9, 4], np.float32))
+    ref_seq, ref_pool = net(tok, seg, vl)
+    path = str(tmp_path / "bert")
+    net.export(path, num_inputs=3, input_shapes=[(B, S), (B, S), (B,)])
+    loaded = SymbolBlock.imports(f"{path}-symbol.json",
+                                 ["data", "data1", "data2"],
+                                 f"{path}-0000.params.npz")
+    got_seq, got_pool = loaded(tok, seg, vl)
+    np.testing.assert_allclose(got_pool.asnumpy(), ref_pool.asnumpy(),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(got_seq.asnumpy(), ref_seq.asnumpy(),
+                               rtol=1e-4, atol=1e-5)
