@@ -284,6 +284,8 @@ def main():
     extra_measures = []
     if os.environ.get("BENCH_MLP") == "1":
         extra_measures.append(("bench_mlp", "measure"))
+    if os.environ.get("BENCH_INT8") == "1":
+        extra_measures.append(("bench_int8", "measure"))
     if os.environ.get("BENCH_NMT") == "1":
         extra_measures.append(("bench_nmt", "measure"))
     if os.environ.get("BENCH_DET") == "1":

@@ -396,3 +396,28 @@ def test_bert_export_symbolblock_roundtrip(tmp_path):
                                rtol=1e-4, atol=1e-5)
     np.testing.assert_allclose(got_seq.asnumpy(), ref_seq.asnumpy(),
                                rtol=1e-4, atol=1e-5)
+
+
+def test_bert_classifier_export_symbolblock_roundtrip(tmp_path):
+    """The finetune deployment path: BERTClassifier (bert + pooled-output
+    head) exports symbolically and reloads through SymbolBlock."""
+    import numpy as np
+    from mxnet_tpu.models.bert import BERTModel, BERTClassifier
+    from mxnet_tpu.gluon.block import SymbolBlock
+    bert = BERTModel(vocab_size=30, units=32, hidden_size=64, num_layers=1,
+                     num_heads=4, max_length=10, dropout=0.0)
+    clf = BERTClassifier(bert, num_classes=3, dropout=0.0)
+    clf.initialize()
+    rng = np.random.RandomState(8)
+    B, S = 2, 7
+    tok = nd.array(rng.randint(0, 30, (B, S)).astype(np.float32))
+    seg = nd.array(np.zeros((B, S), np.float32))
+    vl = nd.array(np.array([7, 3], np.float32))
+    ref = clf(tok, seg, vl).asnumpy()
+    path = str(tmp_path / "bclf")
+    clf.export(path, num_inputs=3, input_shapes=[(B, S), (B, S), (B,)])
+    loaded = SymbolBlock.imports(f"{path}-symbol.json",
+                                 ["data", "data1", "data2"],
+                                 f"{path}-0000.params.npz")
+    got = loaded(tok, seg, vl).asnumpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
