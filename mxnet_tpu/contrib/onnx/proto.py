@@ -173,38 +173,49 @@ def decode_model(buf):
     {name: (dims, data_type, raw)}, nodes: [{op_type, name, inputs,
     outputs, attrs: {name: value}}]}}. Handles both unpacked (this repo's
     encoder) and proto3-packed repeated int/float fields (external ONNX
-    writers)."""
-    m = decode(buf)
-    graph = decode(m[7][0])
-    out = {
-        "ir_version": m.get(1, [None])[0],
-        "opset": [(decode(o).get(1, [b""])[0].decode(),
-                   decode(o).get(2, [0])[0]) for o in m.get(8, [])],
-        "graph": {
-            "name": graph.get(2, [b""])[0].decode(),
-            "inputs": [_value_info(v) for v in graph.get(11, [])],
-            "outputs": [_value_info(v) for v in graph.get(12, [])],
-            "initializers": {},
-            "nodes": [],
-        },
-    }
-    for t in graph.get(5, []):
-        td = decode(t)
-        name = td.get(8, [b""])[0].decode()
-        out["graph"]["initializers"][name] = (
-            _packed_ints(td.get(1, [])), td.get(2, [None])[0],
-            td.get(9, [b""])[0])
-    for n in graph.get(1, []):
-        nd = decode(n)
-        out["graph"]["nodes"].append({
-            "op_type": nd.get(4, [b""])[0].decode(),
-            "name": nd.get(3, [b""])[0].decode(),
-            "inputs": [s.decode() for s in nd.get(1, [])],
-            "outputs": [s.decode() for s in nd.get(2, [])],
-            "attrs": {a["name"]: a["value"]
-                      for a in (_attr(x) for x in nd.get(5, []))},
-        })
-    return out
+    writers). Truncated/garbage input raises MXNetError (the wire walk
+    always terminates — lengths only ever ADVANCE the cursor)."""
+    from ...base import MXNetError
+    try:
+        m = decode(buf)
+        graph = decode(m[7][0])
+        out = {
+            "ir_version": m.get(1, [None])[0],
+            "opset": [(decode(o).get(1, [b""])[0].decode(),
+                       decode(o).get(2, [0])[0]) for o in m.get(8, [])],
+            "graph": {
+                "name": graph.get(2, [b""])[0].decode(),
+                "inputs": [_value_info(v) for v in graph.get(11, [])],
+                "outputs": [_value_info(v) for v in graph.get(12, [])],
+                "initializers": {},
+                "nodes": [],
+            },
+        }
+        for t in graph.get(5, []):
+            td = decode(t)
+            name = td.get(8, [b""])[0].decode()
+            out["graph"]["initializers"][name] = (
+                _packed_ints(td.get(1, [])), td.get(2, [None])[0],
+                td.get(9, [b""])[0])
+        for n in graph.get(1, []):
+            nd = decode(n)
+            out["graph"]["nodes"].append({
+                "op_type": nd.get(4, [b""])[0].decode(),
+                "name": nd.get(3, [b""])[0].decode(),
+                "inputs": [s.decode() for s in nd.get(1, [])],
+                "outputs": [s.decode() for s in nd.get(2, [])],
+                "attrs": {a["name"]: a["value"]
+                          for a in (_attr(x) for x in nd.get(5, []))},
+            })
+        return out
+    except (IndexError, KeyError, struct.error, UnicodeDecodeError,
+            ValueError, TypeError, AttributeError) as e:
+        # the full set garbage can produce: unsupported wire types
+        # (ValueError), scalar where a submessage/bytes was expected
+        # (TypeError/AttributeError), truncation (IndexError/struct)
+        raise MXNetError(
+            f"malformed ONNX file: {type(e).__name__} while walking the "
+            "protobuf wire (truncated or not an ONNX model?)") from e
 
 
 def _value_info(buf):

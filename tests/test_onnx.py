@@ -685,3 +685,27 @@ def test_transformer_nmt_import_roundtrip(tmp_path):
     outs = s2.bind(None, feed, aux_states=aux).forward()
     np.testing.assert_allclose(outs[0].asnumpy(), ref,
                                rtol=2e-4, atol=2e-4)
+
+
+def test_decode_model_malformed_raises_cleanly(tmp_path):
+    """Truncated or garbage bytes must raise MXNetError('malformed...')
+    — never hang (the wire walk only advances) and never leak a bare
+    IndexError. Truncations that happen to land on a field boundary may
+    decode leniently to a partial dict; both outcomes are acceptable,
+    a hang or foreign exception is not."""
+    out, args, params = _mlp()
+    path = export_model(out, params, {"data": (2, 8)},
+                        onnx_file_path=str(tmp_path / "m.onnx"))
+    raw = open(path, "rb").read()
+    for cut in (1, 7, len(raw) // 3, len(raw) // 2, len(raw) - 2):
+        try:
+            m = proto.decode_model(raw[:cut])
+            assert isinstance(m, dict)          # lenient partial decode
+        except mx.base.MXNetError as e:
+            assert "malformed ONNX file" in str(e)
+    # each of these drives a DIFFERENT underlying failure: bad wire type
+    # (ValueError), scalar-where-submessage (TypeError), varint
+    # truncation (IndexError) — all must surface as the one contract
+    for garbage in (b"\xff" * 64, b"\x0b", b"\x38\x01"):
+        with pytest.raises(mx.base.MXNetError, match="malformed ONNX"):
+            proto.decode_model(garbage)
