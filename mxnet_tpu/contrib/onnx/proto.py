@@ -29,6 +29,8 @@ from __future__ import annotations
 
 import struct
 
+from ...base import MXNetError
+
 # TensorProto.DataType
 FLOAT = 1
 UINT8 = 2
@@ -103,9 +105,16 @@ def message(*fields):
 
 
 # ------------------------------------------------------------------ decoder
+class WireError(Exception):
+    """Raised by the wire layer on structurally invalid input (truncation,
+    unsupported wire type, scalar where a submessage was expected)."""
+
+
 def _read_varint(buf, pos):
     result = shift = 0
     while True:
+        if pos >= len(buf):
+            raise WireError("truncated varint")
         b = buf[pos]
         pos += 1
         result |= (b & 0x7F) << shift
@@ -117,7 +126,12 @@ def _read_varint(buf, pos):
 def decode(buf):
     """Decode one protobuf message into {field_number: [values]} (repeated
     fields accumulate in order). Length-delimited values stay as bytes —
-    callers descend with another decode() where a field is a submessage."""
+    callers descend with another decode() where a field is a submessage.
+    Raises WireError on structural garbage; always terminates (lengths
+    only ever ADVANCE the cursor)."""
+    if not isinstance(buf, (bytes, bytearray, memoryview)):
+        raise WireError(
+            f"expected a submessage, found {type(buf).__name__}")
     fields = {}
     pos = 0
     while pos < len(buf):
@@ -126,17 +140,23 @@ def decode(buf):
         if wire == 0:
             val, pos = _read_varint(buf, pos)
         elif wire == 1:
+            if pos + 8 > len(buf):
+                raise WireError("truncated fixed64")
             val = struct.unpack("<d", buf[pos:pos + 8])[0]
             pos += 8
         elif wire == 2:
             ln, pos = _read_varint(buf, pos)
+            if pos + ln > len(buf):
+                raise WireError("length-delimited field overruns buffer")
             val = bytes(buf[pos:pos + ln])
             pos += ln
         elif wire == 5:
+            if pos + 4 > len(buf):
+                raise WireError("truncated fixed32")
             val = struct.unpack("<f", buf[pos:pos + 4])[0]
             pos += 4
         else:
-            raise ValueError(f"unsupported wire type {wire}")
+            raise WireError(f"unsupported wire type {wire}")
         fields.setdefault(field, []).append(val)
     return fields
 
@@ -175,7 +195,6 @@ def decode_model(buf):
     encoder) and proto3-packed repeated int/float fields (external ONNX
     writers). Truncated/garbage input raises MXNetError (the wire walk
     always terminates — lengths only ever ADVANCE the cursor)."""
-    from ...base import MXNetError
     try:
         m = decode(buf)
         graph = decode(m[7][0])
@@ -208,14 +227,16 @@ def decode_model(buf):
                           for a in (_attr(x) for x in nd.get(5, []))},
             })
         return out
-    except (IndexError, KeyError, struct.error, UnicodeDecodeError,
-            ValueError, TypeError, AttributeError) as e:
-        # the full set garbage can produce: unsupported wire types
-        # (ValueError), scalar where a submessage/bytes was expected
-        # (TypeError/AttributeError), truncation (IndexError/struct)
+    except (WireError, KeyError, UnicodeDecodeError,
+            AttributeError) as e:
+        # WireError covers the structural garbage the hardened wire layer
+        # detects; KeyError = required field absent; AttributeError =
+        # a STRING field arrived with a scalar wire type (.decode() on a
+        # number) — the one shape the wire layer can't type-check. The
+        # chained original (`from e`) keeps any real decoder bug visible.
         raise MXNetError(
-            f"malformed ONNX file: {type(e).__name__} while walking the "
-            "protobuf wire (truncated or not an ONNX model?)") from e
+            f"malformed ONNX file: {type(e).__name__}: {e} "
+            "(truncated or not an ONNX model?)") from e
 
 
 def _value_info(buf):

@@ -265,3 +265,26 @@ def test_image_record_iter_native_no_idx(tmp_path):
     assert it.num_samples == 5
     batch = it.next()
     np.testing.assert_allclose(batch.label[0].asnumpy(), [0, 1, 2, 3, 4])
+
+
+def test_recordio_truncated_file_never_hangs(tmp_path):
+    """A truncated .rec either yields the intact prefix records or
+    raises MXNetError on a torn record — the reader must terminate
+    (mid-header truncation = clean EOF, mid-payload = error)."""
+    path = str(tmp_path / "t.rec")
+    w = recordio.MXRecordIO(path, "w")
+    for i in range(5):
+        w.write(b"payload-%d" % i * 10)
+    w.close()
+    raw = open(path, "rb").read()
+    bad = str(tmp_path / "bad.rec")
+    for cut in (1, 7, len(raw) // 3, len(raw) - 3):
+        open(bad, "wb").write(raw[:cut])
+        try:
+            r = recordio.MXRecordIO(bad, "r")
+            n = 0
+            while r.read() is not None:
+                n += 1
+            assert n <= 5
+        except mx.base.MXNetError:
+            pass  # torn record rejected — also fine

@@ -179,3 +179,23 @@ def test_unsupported_dtype_raises(tmp_path):
     with pytest.raises(mx.MXNetError, match="type_flag"):
         upstream.save_params(str(tmp_path / "b.params"),
                              {"m": np.zeros((2,), dtype=np.uint32)})
+
+
+def test_load_params_malformed_raises_cleanly(tmp_path):
+    """Truncated/garbage .params files raise MXNetError at every cut
+    point — never a hang or a bare struct/Index error (same contract the
+    ONNX decoder pins)."""
+    from mxnet_tpu.upstream import save_params, load_params
+    p = {"arg:w": nd.array(np.random.randn(4, 3).astype(np.float32)),
+         "aux:m": nd.array(np.zeros(3, np.float32))}
+    good = str(tmp_path / "u.params")
+    save_params(good, p)
+    raw = open(good, "rb").read()
+    bad = str(tmp_path / "bad.params")
+    for cut in (1, 8, len(raw) // 3, len(raw) // 2, len(raw) - 2):
+        open(bad, "wb").write(raw[:cut])
+        with pytest.raises(mx.base.MXNetError):
+            load_params(bad)
+    open(bad, "wb").write(b"\xff" * 64)
+    with pytest.raises(mx.base.MXNetError):
+        load_params(bad)
