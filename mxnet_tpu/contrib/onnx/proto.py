@@ -227,13 +227,16 @@ def decode_model(buf):
                           for a in (_attr(x) for x in nd.get(5, []))},
             })
         return out
-    except (WireError, KeyError, UnicodeDecodeError,
-            AttributeError) as e:
+    except (WireError, KeyError, UnicodeDecodeError, AttributeError,
+            struct.error, TypeError) as e:
         # WireError covers the structural garbage the hardened wire layer
-        # detects; KeyError = required field absent; AttributeError =
-        # a STRING field arrived with a scalar wire type (.decode() on a
-        # number) — the one shape the wire layer can't type-check. The
-        # chained original (`from e`) keeps any real decoder bug visible.
+        # detects; the rest are value-level shapes it can't type-check:
+        # KeyError = required field absent; AttributeError/TypeError = a
+        # field arrived with the wrong wire type (.decode()/compare on a
+        # number, or bytes where an int was declared); struct.error = a
+        # packed blob whose length isn't a multiple of the element size.
+        # The chained original (`from e`) keeps any real decoder bug
+        # visible under the wrapper.
         raise MXNetError(
             f"malformed ONNX file: {type(e).__name__}: {e} "
             "(truncated or not an ONNX model?)") from e

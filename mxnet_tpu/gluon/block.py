@@ -626,7 +626,12 @@ class SymbolBlock(HybridBlock):
         from ..ndarray.ndarray import NDArray
         from .parameter import Parameter
         with open(symbol_file) as f:
-            blob = _json.load(f)
+            try:
+                blob = _json.load(f)
+            except ValueError as e:  # JSONDecodeError
+                raise MXNetError(
+                    f"{symbol_file}: malformed symbol JSON "
+                    f"({e})") from e
         if "nodes" not in blob:  # HybridBlock.export's non-symbolic fallback
             raise MXNetError(
                 f"{symbol_file} is a repr-only export (the source block "

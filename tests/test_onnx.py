@@ -709,3 +709,24 @@ def test_decode_model_malformed_raises_cleanly(tmp_path):
     for garbage in (b"\xff" * 64, b"\x0b", b"\x38\x01"):
         with pytest.raises(mx.base.MXNetError, match="malformed ONNX"):
             proto.decode_model(garbage)
+
+
+def test_decode_model_crafted_attr_garbage():
+    """Value-level garbage the wire walk can't type-check also surfaces
+    as MXNetError: a packed-floats blob of non-multiple-of-4 length
+    (struct.error underneath) and an ATTR_INT whose payload arrives as
+    bytes (TypeError underneath)."""
+    name = proto.f_bytes(1, b"a")
+    # AttributeProto type=FLOATS(6) with a 3-byte packed field-7 blob
+    bad_floats = proto.message(name, proto.f_varint(20, 6),
+                               proto.f_bytes(7, b"\x00\x01\x02"))
+    # AttributeProto type=INT(2) with field 3 as length-delimited bytes
+    bad_int = proto.message(name, proto.f_varint(20, 2),
+                            proto.f_bytes(3, b"xy"))
+    for attr in (bad_floats, bad_int):
+        node = proto.message(proto.f_bytes(4, b"Relu"),
+                             proto.f_bytes(5, attr))
+        graph = proto.message(proto.f_bytes(1, node))
+        model = proto.message(proto.f_bytes(7, graph))
+        with pytest.raises(mx.base.MXNetError, match="malformed ONNX"):
+            proto.decode_model(bytes(model))

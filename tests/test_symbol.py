@@ -379,3 +379,19 @@ def test_softmax_use_length_json_roundtrip():
     assert np.allclose(a[1, :, 2:], 0.0, atol=1e-6)
     ref = mx.nd.softmax(scores, length=lens).asnumpy()
     assert np.allclose(a, ref, atol=1e-6)
+
+
+def test_load_json_malformed_raises_cleanly():
+    """Corrupt symbol JSON raises MXNetError at LOAD time for every
+    failure class — non-JSON, foreign structure, truncation, and unknown
+    op names (validated up front like the reference's nnvm loader, not
+    deferred to the first bind)."""
+    g = mx.sym.FullyConnected(mx.sym.Variable("d"), num_hidden=4,
+                              name="fc")
+    js = g.tojson()
+    for bad in ("{{{", '{"hello": 1}', js[: len(js) // 2],
+                js.replace("FullyConnected", "NoSuchOp")):
+        with pytest.raises(mx.base.MXNetError):
+            mx.sym.load_json(bad)
+    assert mx.sym.load_json(js).list_arguments() == \
+        ["d", "fc_weight", "fc_bias"]
