@@ -132,6 +132,34 @@ def supervise() -> int:
     return 1
 
 
+def _enable_compile_cache():
+    """Persistent XLA compilation cache (VERDICT r4 item 1a).
+
+    The unroll=8 ResNet step costs ~7min of XLA compile cold — longer
+    than many tunnel windows stay up, which is how rounds 1-4 lost the
+    driver-captured measurement. With the cache warm (any prior worker
+    run, or tools/warm_cache.py), the same program deserialises in
+    seconds, so even a ~3-minute window lands the number. Cache keys
+    include jaxlib version + backend + compile options, so entries
+    written through the tunnel today are valid for the driver's
+    end-of-round run on the same image. BENCH_CACHE=0 disables."""
+    if os.environ.get("BENCH_CACHE") == "0":
+        return
+    import jax
+    cache_dir = os.environ.get(
+        "JAX_COMPILATION_CACHE_DIR",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     ".jax_cache"))
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          2.0)
+        print(f"[bench] compile cache: {cache_dir}", file=sys.stderr)
+    except Exception as e:  # pragma: no cover - config API drift
+        print(f"[bench] compile cache unavailable: {e!r}",
+              file=sys.stderr)
+
+
 def main():
     # perf lever (BENCH_XLA_FLAGS=1): XLA latency-hiding scheduler +
     # async collectives — must land in env BEFORE backend init
@@ -145,6 +173,7 @@ def main():
     if os.environ.get("JAX_PLATFORMS") == "cpu":
         import jax
         jax.config.update("jax_platforms", "cpu")
+    _enable_compile_cache()
     import jax
     import jax.numpy as jnp
 
@@ -187,14 +216,16 @@ def main():
     # step boundaries. Measured 2026-07-31 (docs/PERF.md): 1 -> 2759.9,
     # 2 -> 2799.3, 4 -> 2843.9, 8 -> 2863.1 img/s; 8 is the default on
     # TPU (compile ~7min, inside WORKER_TIMEOUT_S).
-    unroll = max(1, int(os.environ.get("BENCH_UNROLL",
-                                       "8" if on_tpu and not smoke
-                                       else "1")))
+    full_unroll = max(1, int(os.environ.get("BENCH_UNROLL",
+                                            "8" if on_tpu and not smoke
+                                            else "1")))
     # later candidates only start while comfortably inside the worker
     # timeout — a half-finished sweep must never eat the whole attempt
     SWEEP_BUDGET_S = 300
 
-    def measure(batch):
+    def measure(batch, unroll=None, steps=steps):
+        if unroll is None:
+            unroll = full_unroll
         x = mx.nd.random.uniform(shape=(batch, 224, 224, 3),
                                  dtype="bfloat16")
         fwd, params = extract_pure_fn(net, x, training=True)
@@ -249,8 +280,35 @@ def main():
             "unit": "images/sec/chip",
             "vs_baseline": round(img_s / BASELINE_IMG_S, 4)}), flush=True)
 
-    best_img_s, best_batch = sweep(candidates, SWEEP_BUDGET_S, measure,
-                                   on_best=checkpoint_resnet, tag="bench")
+    # Staged measurement (VERDICT r4 item 1b): land a fast unroll=1
+    # number FIRST, so a tunnel flap during the ~7min unroll=8 compile
+    # can no longer zero the run — the supervisor keeps the last
+    # parseable stdout line, and this line exists within ~2min cold
+    # (seconds with a warm compile cache). The full-unroll sweep then
+    # upgrades it. BENCH_STAGED=0 disables.
+    stage1_img_s = 0.0
+    if (on_tpu and not smoke and full_unroll > 1
+            and os.environ.get("BENCH_STAGED") != "0"):
+        try:
+            stage1_img_s = measure(candidates[0], unroll=1, steps=10)
+            checkpoint_resnet(stage1_img_s)
+        except Exception as e:
+            print(f"[bench] stage-1 (unroll=1) failed: {e!r}",
+                  file=sys.stderr)
+
+    try:
+        best_img_s, best_batch = sweep(candidates, SWEEP_BUDGET_S,
+                                       measure,
+                                       on_best=checkpoint_resnet,
+                                       tag="bench")
+    except RuntimeError:
+        # full-unroll sweep landed nothing (flap mid-compile?) — fall
+        # back to the stage-1 number so BERT still gets its shot
+        if stage1_img_s <= 0:
+            raise
+        # fallback ONLY: the stage-1 number is 10 steps of unroll=1 —
+        # never let it outvote a completed full-unroll measurement
+        best_img_s, best_batch = stage1_img_s, candidates[0]
     print(f"[bench] best: batch={best_batch} {best_img_s:.1f} img/s",
           file=sys.stderr)
     result = {

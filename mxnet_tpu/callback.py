@@ -6,7 +6,7 @@ import time
 from collections import namedtuple
 
 __all__ = ["Speedometer", "do_checkpoint", "log_train_metric",
-           "ProgressBar", "BatchEndParam"]
+           "ProgressBar", "BatchEndParam", "LogValidationMetricsCallback"]
 
 # callback payload contract (reference: model.py BatchEndParam; defined
 # here so module.py can use it without importing the legacy model module)
@@ -71,6 +71,19 @@ def log_train_metric(period, auto_reset=False):
             if auto_reset:
                 param.eval_metric.reset()
     return _callback
+
+
+class LogValidationMetricsCallback:
+    """Log each validation metric at epoch end (reference:
+    callback.py LogValidationMetricsCallback) — an eval_end_callback
+    for Module.fit."""
+
+    def __call__(self, param):
+        if param.eval_metric is None:
+            return
+        for name, value in param.eval_metric.get_name_value():
+            logging.info("Epoch[%d] Validation-%s=%f", param.epoch,
+                         name, value)
 
 
 class ProgressBar:

@@ -548,3 +548,9 @@ def count_sketch(data, h, s, out_dim, **kw):
     """Count-sketch projection to out_dim (upstream: contrib.count_sketch)."""
     return _apply(lambda d, hh, ss: _cops.count_sketch(
         d, hh, ss, int(out_dim)), [data, h, s])
+
+
+# upstream documents these two under contrib (adaptive_avg_pooling.cc,
+# bilinear_resize.cc); the implementations live with the other classic
+# ops — re-export, don't duplicate
+from ..ops.extra_ops import AdaptiveAvgPooling2D, BilinearResize2D  # noqa: E402,F401

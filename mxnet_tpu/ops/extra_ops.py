@@ -19,6 +19,7 @@ from ..base import MXNetError
 from ..ndarray.ndarray import NDArray, _apply
 
 __all__ = ["LRN", "L2Normalization", "UpSampling", "BilinearResize2D",
+           "AdaptiveAvgPooling2D",
            "Crop", "SliceChannel", "ROIPooling", "GridGenerator",
            "BilinearSampler", "SpatialTransformer", "Correlation",
            "MakeLoss", "BlockGrad", "stop_gradient", "batch_take",
@@ -65,6 +66,40 @@ def upsampling_k(x, scale=2, sample_type="nearest"):
 def bilinear_resize_k(x, height, width):
     n, c = x.shape[:2]
     return jax.image.resize(x, (n, c, height, width), method="bilinear")
+
+
+def _adaptive_pool_matrix(in_size, out_size):
+    """(out, in) averaging matrix for adaptive pooling: output cell i
+    averages input rows floor(i*I/O) .. ceil((i+1)*I/O)-1 — the upstream
+    region rule (src/operator/contrib/adaptive_avg_pooling-inl.h). Built
+    with host numpy at trace time (shapes are static under jit), so the
+    pool lowers to a matmul the MXU eats directly."""
+    import numpy as onp
+    m = onp.zeros((out_size, in_size), onp.float32)
+    for i in range(out_size):
+        lo = (i * in_size) // out_size
+        hi = -((-(i + 1) * in_size) // out_size)  # ceil
+        m[i, lo:hi] = 1.0 / (hi - lo)
+    return m
+
+
+def adaptive_avg_pool2d_k(x, output_size):
+    """NCHW adaptive average pool to (OH, OW) (reference:
+    contrib.AdaptiveAvgPooling2D). Implemented as two dense contractions
+    out = Mh @ x @ Mw^T rather than a gather loop — static pooling
+    matrices, MXU-friendly."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    oh, ow = int(output_size[0]), int(output_size[1])
+    h, w = x.shape[2], x.shape[3]
+    # weights stay float32 (1/3 in bf16 costs ~2e-3 before the einsum
+    # even runs; integer dtypes would truncate them to 0) and HIGHEST
+    # keeps the MXU pass off bf16; only the result drops back to x.dtype
+    mh = jnp.asarray(_adaptive_pool_matrix(h, oh))
+    mw = jnp.asarray(_adaptive_pool_matrix(w, ow))
+    out = jnp.einsum("nchw,oh,pw->ncop", x.astype(jnp.float32), mh, mw,
+                     precision=jax.lax.Precision.HIGHEST)
+    return out.astype(x.dtype)
 
 
 def crop_k(x, h_w=None, offset=(0, 0), like_shape=None, center_crop=False):
@@ -242,6 +277,11 @@ def UpSampling(data, scale=2, sample_type="nearest", num_filter=0, **kw):
 
 def BilinearResize2D(data, height=None, width=None, **kw):
     return _apply(lambda x: bilinear_resize_k(x, height, width), [data])
+
+
+def AdaptiveAvgPooling2D(data, output_size=1, **kw):
+    """reference: contrib.AdaptiveAvgPooling2D (NCHW)."""
+    return _apply(lambda x: adaptive_avg_pool2d_k(x, output_size), [data])
 
 
 def Crop(data, crop_like=None, h_w=None, offset=(0, 0),

@@ -563,7 +563,32 @@ def count_sketch(data, h, s, out_dim, name=None, **kw):
                  {"out_dim": out_dim}, name=name)
 
 
+from ..ops import extra_ops as _extra
+
+register_op("_contrib_AdaptiveAvgPooling2D",
+            lambda x, output_size=1:
+            _extra.adaptive_avg_pool2d_k(x, output_size))
+register_op("_contrib_BilinearResize2D",
+            lambda x, height=0, width=0:
+            _extra.bilinear_resize_k(x, int(height), int(width)))
+
+
+def AdaptiveAvgPooling2D(data, output_size=1, name=None, **kw):
+    """reference: contrib.AdaptiveAvgPooling2D (adaptive_avg_pooling.cc)."""
+    out = (list(output_size) if isinstance(output_size, (tuple, list))
+           else int(output_size))
+    return _make("_contrib_AdaptiveAvgPooling2D", [data],
+                 {"output_size": out}, name=name)
+
+
+def BilinearResize2D(data, height=None, width=None, name=None, **kw):
+    """reference: contrib.BilinearResize2D (bilinear_resize.cc)."""
+    return _make("_contrib_BilinearResize2D", [data],
+                 {"height": int(height), "width": int(width)}, name=name)
+
+
 __all__ += ["ROIAlign", "box_nms", "box_non_maximum_suppression", "box_iou",
             "MultiBoxPrior", "MultiBoxTarget", "MultiBoxDetection",
             "Proposal", "MultiProposal", "DeformableConvolution",
-            "fft", "ifft", "count_sketch"]
+            "fft", "ifft", "count_sketch", "AdaptiveAvgPooling2D",
+            "BilinearResize2D"]
