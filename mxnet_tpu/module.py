@@ -214,7 +214,28 @@ class Module(BaseModule):
 
     def set_params(self, arg_params, aux_params=None, allow_missing=False,
                    force_init=True, allow_extra=False):
-        for n, v in (arg_params or {}).items():
+        params = arg_params or {}
+        if not allow_missing:
+            missing = [n for n in self._param_names if n not in params]
+            if missing:
+                raise MXNetError(
+                    f"set_params: missing {missing} (pass "
+                    f"allow_missing=True to initialize them)")
+        if not allow_extra:
+            extra = [n for n in params if n not in self._param_names]
+            if extra:
+                raise MXNetError(
+                    f"set_params: unknown parameters {extra} (pass "
+                    f"allow_extra=True to ignore)")
+        # upstream documents set_params as init_params(arg_params=...,
+        # force_init=...); before the executor exists (bind -> set_params
+        # -> score, the classic deploy flow) that is literally what runs
+        if self._exec is None:
+            return self.init_params(arg_params=params,
+                                    aux_params=aux_params,
+                                    allow_missing=allow_missing,
+                                    force_init=force_init)
+        for n, v in params.items():
             if n in self._exec.arg_dict:
                 self._exec.arg_dict[n]._assign_value(v._data)
         for n, v in (aux_params or {}).items():
@@ -500,6 +521,9 @@ class SequentialModule(BaseModule):
         return arg_params, aux_params
 
     def set_params(self, arg_params, aux_params=None, **kwargs):
+        # each sub-module owns only its slice of the combined dict, so
+        # sibling params are expected "extras" here
+        kwargs.setdefault("allow_extra", True)
         for mod in self._modules:
             mod.set_params(arg_params, aux_params, **kwargs)
 

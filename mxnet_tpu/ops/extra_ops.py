@@ -275,8 +275,26 @@ def UpSampling(data, scale=2, sample_type="nearest", num_filter=0, **kw):
     return _apply(lambda x: upsampling_k(x, scale, sample_type), [data])
 
 
-def BilinearResize2D(data, height=None, width=None, **kw):
-    return _apply(lambda x: bilinear_resize_k(x, height, width), [data])
+def _resize_target(shape, height, width, scale_height, scale_width):
+    """Resolve the (H, W) target from explicit sizes or upstream's
+    scale_height/scale_width mode (bilinear_resize-inl.h)."""
+    h = int(height) if height else (
+        int(round(shape[2] * scale_height)) if scale_height else 0)
+    w = int(width) if width else (
+        int(round(shape[3] * scale_width)) if scale_width else 0)
+    if h <= 0 or w <= 0:
+        raise MXNetError("BilinearResize2D: need height+width or "
+                         "scale_height+scale_width")
+    return h, w
+
+
+def BilinearResize2D(data, height=None, width=None, scale_height=None,
+                     scale_width=None, **kw):
+    def fn(x):
+        h, w = _resize_target(x.shape, height, width,
+                              scale_height, scale_width)
+        return bilinear_resize_k(x, h, w)
+    return _apply(fn, [data])
 
 
 def AdaptiveAvgPooling2D(data, output_size=1, **kw):

@@ -1,0 +1,9 @@
+"""mx.rnn — legacy symbolic RNN API (reference: python/mxnet/rnn/):
+bucketing IO (io.py) + the classic cell zoo (rnn_cell.py) the word-LM /
+bucketing examples bind through Module and BucketingModule."""
+from .io import *            # noqa: F401,F403
+from .io import __all__ as _io_all
+from .rnn_cell import *      # noqa: F401,F403
+from .rnn_cell import __all__ as _cell_all
+
+__all__ = list(_io_all) + list(_cell_all)

@@ -12,8 +12,8 @@ from __future__ import annotations
 
 import numpy as np
 
-from .base import MXNetError
-from .io import DataBatch, DataDesc
+from ..base import MXNetError
+from ..io import DataBatch, DataDesc
 
 __all__ = ["BucketSentenceIter"]
 
@@ -96,7 +96,7 @@ class BucketSentenceIter:
         return self
 
     def __next__(self):
-        from .ndarray.ndarray import array
+        from ..ndarray.ndarray import array
         if self._cursor >= len(self._plan):
             raise StopIteration
         bidx, start = self._plan[self._cursor]

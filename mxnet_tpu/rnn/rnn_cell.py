@@ -1,0 +1,641 @@
+"""Legacy symbolic RNN cell API (reference: python/mxnet/rnn/rnn_cell.py).
+
+The classic bucketing / word-LM examples build their networks from these
+cells: construct the cells once (weights are shared across time steps),
+call ``unroll(length, inputs)`` inside a BucketingModule's ``sym_gen``,
+bind, fit.
+
+TPU-first notes:
+- An unrolled cell graph is a static-length chain of FullyConnected +
+  elementwise nodes — exactly what XLA fuses well, and each bucket is
+  one compiled executable (SURVEY §3), so the per-step Python loop here
+  costs nothing at run time.
+- ``FusedRNNCell`` emits the single ``sym.RNN`` node, whose executor
+  lowers the whole stack to one ``lax.scan`` (gluon/rnn/rnn_layer.py) —
+  the TPU counterpart of the cuDNN fused path this cell selects
+  upstream. Gate order matches the fused kernel ([i,f,g,o] LSTM,
+  [r,z,n] GRU) and ``unfuse()`` produces cells whose parameter names
+  coincide with the fused ``pnames``, so the same checkpoint binds both
+  ways.
+- ``begin_state`` divergence: upstream passes ``shape=(0, H)`` and lets
+  nnvm back-infer the 0 batch dim. Our executor traces concrete shapes,
+  so zero states are graph nodes derived from a `like` tensor (unroll
+  wires this automatically) or built eagerly from an explicit
+  ``batch_size``.
+- Upstream attaches an ``__init__`` attr so LSTM forget biases start at
+  ``forget_bias``; here pass ``mx.init.LSTMBias(forget_bias)`` (or a
+  ``Mixed`` pattern on ``*_i2h_bias``) to Module init — the cell keeps
+  the argument for API parity and records it on the bias variable's
+  user attrs.
+"""
+from __future__ import annotations
+
+from ..base import MXNetError
+from ..symbol import ops as S
+from ..symbol.symbol import Symbol, Variable, _make
+
+__all__ = ["RNNParams", "BaseRNNCell", "RNNCell", "LSTMCell", "GRUCell",
+           "FusedRNNCell", "SequentialRNNCell", "BidirectionalCell",
+           "DropoutCell", "ModifierCell", "ZoneoutCell", "ResidualCell"]
+
+
+class RNNParams:
+    """Container for cell weights (reference: RNNParams). ``get`` returns
+    the same Variable for the same name, so cells called at every
+    timestep share one weight set."""
+
+    def __init__(self, prefix=""):
+        self._prefix = prefix
+        self._params = {}
+
+    def get(self, name, **kwargs):
+        name = self._prefix + name
+        if name not in self._params:
+            self._params[name] = Variable(name, **kwargs)
+        return self._params[name]
+
+
+def _normalize_sequence(length, inputs, layout, merge, in_layout=None):
+    """list-of-(N,C) <-> merged (N,T,C)/(T,N,C) normalisation (reference:
+    rnn_cell._normalize_sequence). Returns (inputs, axis) where axis is
+    the time axis of the ORIGINAL layout."""
+    if layout not in ("NTC", "TNC"):
+        raise MXNetError(f"unsupported layout {layout!r} (NTC or TNC)")
+    axis = layout.find("T")
+    if isinstance(inputs, Symbol):
+        if merge is False:
+            sliced = S.SliceChannel(inputs, num_outputs=length, axis=axis,
+                                    squeeze_axis=True)
+            inputs = [sliced[i] for i in range(length)]
+    else:
+        inputs = list(inputs)
+        if length is not None and len(inputs) != length:
+            raise MXNetError(f"expected {length} inputs, got {len(inputs)}")
+        if merge is True:
+            inputs = [S.expand_dims(i, axis=axis) for i in inputs]
+            inputs = S.concat(*inputs, dim=axis)
+    return inputs, axis
+
+
+class BaseRNNCell:
+    """Abstract cell (reference: BaseRNNCell). Subclasses implement
+    ``__call__(inputs, states) -> (output, next_states)`` and
+    ``state_info``."""
+
+    def __init__(self, prefix="", params=None):
+        if params is None:
+            params = RNNParams(prefix)
+            self._own_params = True
+        else:
+            self._own_params = False
+        self._prefix = prefix
+        self._params = params
+        self._modified = False
+        self.reset()
+
+    def reset(self):
+        """Before re-unrolling: restart the per-timestep name counter."""
+        self._init_counter = -1
+        self._counter = -1
+
+    def __call__(self, inputs, states):
+        raise NotImplementedError
+
+    @property
+    def params(self):
+        self._own_params = False
+        return self._params
+
+    @property
+    def state_info(self):
+        raise NotImplementedError
+
+    @property
+    def state_shape(self):
+        return [info["shape"] for info in self.state_info]
+
+    @property
+    def _gate_names(self):
+        return ()
+
+    def begin_state(self, func=None, like=None, batch_size=0,
+                    batch_axis=0, **kwargs):
+        """Initial states. With ``like`` (any Symbol whose ``batch_axis``
+        axis is the batch), states are graph-derived zeros — what
+        ``unroll`` passes. With ``batch_size``, concrete zeros. With
+        ``func``, upstream-style ``func(name=..., shape=..., **kwargs)``."""
+        if self._modified:
+            raise MXNetError(
+                "begin_state on a modifier-wrapped cell: call it on the "
+                "wrapper (ZoneoutCell/ResidualCell own the state)")
+        states = []
+        for info in self.state_info:
+            self._init_counter += 1
+            name = f"{self._prefix}begin_state_{self._init_counter}"
+            shape = tuple(info["shape"])
+            if func is not None:
+                states.append(func(name=name, shape=shape, **kwargs))
+            elif like is not None:
+                states.append(_make("_rnn_zero_state", [like],
+                                    {"shape": shape,
+                                     "batch_axis": batch_axis},
+                                    name=name))
+            elif batch_size:
+                states.append(S.zeros(
+                    shape=tuple(batch_size if s == 0 else s for s in shape),
+                    name=name))
+            else:
+                raise MXNetError(
+                    "begin_state needs `like=` (a Symbol carrying the "
+                    "batch dim), `batch_size=`, or an explicit `func` — "
+                    "shapes are concrete under XLA tracing")
+        return states
+
+    def unroll(self, length, inputs=None, begin_state=None, layout="NTC",
+               merge_outputs=None):
+        """Unroll ``length`` steps (reference: BaseRNNCell.unroll).
+        Returns (outputs, final_states); ``merge_outputs=None`` keeps the
+        form of ``inputs`` (merged in -> merged out)."""
+        self.reset()
+        was_merged = isinstance(inputs, Symbol)
+        steps, axis = _normalize_sequence(length, inputs, layout, False)
+        if begin_state is None:
+            begin_state = self.begin_state(like=steps[0])
+        states = begin_state
+        outputs = []
+        for i in range(length):
+            output, states = self(steps[i], states)
+            outputs.append(output)
+        if merge_outputs is None:
+            merge_outputs = was_merged
+        outputs, _ = _normalize_sequence(length, outputs, layout,
+                                         merge_outputs)
+        return outputs, states
+
+    # fused<->unfused weight conversion is the identity here: the fused
+    # sym.RNN node takes the SAME per-matrix parameters the unfused
+    # cells use (no cuDNN flat blob on TPU — rnn_layer.py), so a
+    # checkpoint binds either form directly.
+    def unpack_weights(self, args):
+        return dict(args)
+
+    def pack_weights(self, args):
+        return dict(args)
+
+
+class RNNCell(BaseRNNCell):
+    """Vanilla RNN cell, tanh or relu (reference: rnn_cell.RNNCell)."""
+
+    def __init__(self, num_hidden, activation="tanh", prefix="rnn_",
+                 params=None):
+        super().__init__(prefix, params)
+        self._num_hidden = num_hidden
+        self._activation = activation
+        self._iW = self.params.get("i2h_weight")
+        self._iB = self.params.get("i2h_bias")
+        self._hW = self.params.get("h2h_weight")
+        self._hB = self.params.get("h2h_bias")
+
+    @property
+    def state_info(self):
+        return [{"shape": (0, self._num_hidden), "__layout__": "NC"}]
+
+    @property
+    def _gate_names(self):
+        return ("",)
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        name = f"{self._prefix}t{self._counter}_"
+        i2h = S.FullyConnected(data=inputs, weight=self._iW, bias=self._iB,
+                               num_hidden=self._num_hidden,
+                               name=f"{name}i2h")
+        h2h = S.FullyConnected(data=states[0], weight=self._hW,
+                               bias=self._hB,
+                               num_hidden=self._num_hidden,
+                               name=f"{name}h2h")
+        output = S.Activation(i2h + h2h, act_type=self._activation,
+                              name=f"{name}out")
+        return output, [output]
+
+
+class LSTMCell(BaseRNNCell):
+    """LSTM cell (reference: rnn_cell.LSTMCell). Gate order [i, f, g, o]
+    — the fused kernel's order, so fused/unfused share checkpoints."""
+
+    def __init__(self, num_hidden, prefix="lstm_", params=None,
+                 forget_bias=1.0):
+        super().__init__(prefix, params)
+        self._num_hidden = num_hidden
+        self._iW = self.params.get("i2h_weight")
+        self._iB = self.params.get("i2h_bias")
+        # record the upstream init contract on the variable for tooling;
+        # apply it via mx.init.LSTMBias at Module init time
+        self._iB._user_attrs = {
+            **getattr(self._iB, "_user_attrs", {}),
+            "__init__": f"lstmbias(forget_bias={forget_bias})"}
+        self._hW = self.params.get("h2h_weight")
+        self._hB = self.params.get("h2h_bias")
+
+    @property
+    def state_info(self):
+        H = self._num_hidden
+        return [{"shape": (0, H), "__layout__": "NC"},
+                {"shape": (0, H), "__layout__": "NC"}]
+
+    @property
+    def _gate_names(self):
+        return ("_i", "_f", "_c", "_o")
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        name = f"{self._prefix}t{self._counter}_"
+        H = self._num_hidden
+        i2h = S.FullyConnected(data=inputs, weight=self._iW, bias=self._iB,
+                               num_hidden=4 * H, name=f"{name}i2h")
+        h2h = S.FullyConnected(data=states[0], weight=self._hW,
+                               bias=self._hB, num_hidden=4 * H,
+                               name=f"{name}h2h")
+        gates = i2h + h2h
+        sliced = S.SliceChannel(gates, num_outputs=4, axis=1,
+                                name=f"{name}slice")
+        in_gate = S.Activation(sliced[0], act_type="sigmoid")
+        forget_gate = S.Activation(sliced[1], act_type="sigmoid")
+        in_transform = S.Activation(sliced[2], act_type="tanh")
+        out_gate = S.Activation(sliced[3], act_type="sigmoid")
+        next_c = forget_gate * states[1] + in_gate * in_transform
+        next_h = out_gate * S.Activation(next_c, act_type="tanh")
+        return next_h, [next_h, next_c]
+
+
+class GRUCell(BaseRNNCell):
+    """GRU cell (reference: rnn_cell.GRUCell). Gate order [r, z, n]."""
+
+    def __init__(self, num_hidden, prefix="gru_", params=None):
+        super().__init__(prefix, params)
+        self._num_hidden = num_hidden
+        self._iW = self.params.get("i2h_weight")
+        self._iB = self.params.get("i2h_bias")
+        self._hW = self.params.get("h2h_weight")
+        self._hB = self.params.get("h2h_bias")
+
+    @property
+    def state_info(self):
+        return [{"shape": (0, self._num_hidden), "__layout__": "NC"}]
+
+    @property
+    def _gate_names(self):
+        return ("_r", "_z", "_o")
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        name = f"{self._prefix}t{self._counter}_"
+        H = self._num_hidden
+        prev = states[0]
+        i2h = S.FullyConnected(data=inputs, weight=self._iW, bias=self._iB,
+                               num_hidden=3 * H, name=f"{name}i2h")
+        h2h = S.FullyConnected(data=prev, weight=self._hW, bias=self._hB,
+                               num_hidden=3 * H, name=f"{name}h2h")
+        i2h_s = S.SliceChannel(i2h, num_outputs=3, axis=1,
+                               name=f"{name}i2h_slice")
+        h2h_s = S.SliceChannel(h2h, num_outputs=3, axis=1,
+                               name=f"{name}h2h_slice")
+        reset = S.Activation(i2h_s[0] + h2h_s[0], act_type="sigmoid")
+        update = S.Activation(i2h_s[1] + h2h_s[1], act_type="sigmoid")
+        next_h_tmp = S.Activation(i2h_s[2] + reset * h2h_s[2],
+                                  act_type="tanh")
+        ones = _make("_rnn_ones_like", [update], {})
+        next_h = (ones - update) * next_h_tmp + update * prev
+        return next_h, [next_h]
+
+
+class FusedRNNCell(BaseRNNCell):
+    """Whole-stack fused cell (reference: rnn_cell.FusedRNNCell — the
+    cuDNN path). Emits ONE ``sym.RNN`` node; the executor runs the full
+    multi-layer (bi)RNN as a single lax.scan program. Only ``unroll``
+    works (like upstream: no per-step ``__call__``)."""
+
+    _MODES = ("rnn_relu", "rnn_tanh", "lstm", "gru")
+
+    def __init__(self, num_hidden, num_layers=1, mode="lstm",
+                 bidirectional=False, dropout=0.0, get_next_state=False,
+                 forget_bias=1.0, prefix=None, params=None):
+        if mode not in self._MODES:
+            raise MXNetError(f"FusedRNNCell mode must be one of "
+                             f"{self._MODES}, got {mode!r}")
+        if prefix is None:
+            prefix = f"{mode}_"
+        super().__init__(prefix, params)
+        self._num_hidden = num_hidden
+        self._num_layers = num_layers
+        self._mode = mode
+        self._bidirectional = bidirectional
+        self._dropout = dropout
+        self._get_next_state = get_next_state
+        self._forget_bias = forget_bias
+        self._pnames = []
+        in_sfx = ["l"] + (["r"] if bidirectional else [])
+        for layer in range(num_layers):
+            for sfx in in_sfx:
+                for part in ("i2h", "h2h"):
+                    self._pnames.append(f"{sfx}{layer}_{part}_weight")
+                    self._pnames.append(f"{sfx}{layer}_{part}_bias")
+        self._pvars = [self.params.get(n) for n in self._pnames]
+
+    @property
+    def _num_dir(self):
+        return 2 if self._bidirectional else 1
+
+    @property
+    def state_info(self):
+        LD = self._num_layers * self._num_dir
+        H = self._num_hidden
+        info = [{"shape": (LD, 0, H), "__layout__": "LNC"}]
+        if self._mode == "lstm":
+            info.append({"shape": (LD, 0, H), "__layout__": "LNC"})
+        return info
+
+    @property
+    def _gate_names(self):
+        return {"rnn_relu": ("",), "rnn_tanh": ("",),
+                "lstm": ("_i", "_f", "_c", "_o"),
+                "gru": ("_r", "_z", "_o")}[self._mode]
+
+    def __call__(self, inputs, states):
+        raise MXNetError("FusedRNNCell cannot be stepped — call unroll() "
+                         "(upstream fused cells are sequence-level too)")
+
+    def unroll(self, length, inputs=None, begin_state=None, layout="NTC",
+               merge_outputs=None):
+        self.reset()
+        was_merged = isinstance(inputs, Symbol)
+        # the fused node wants the merged sequence; the batch axis of
+        # the merged layout feeds the zero-state (TNC puts it second)
+        inputs, _ = _normalize_sequence(length, inputs, layout, True)
+        if begin_state is None:
+            begin_state = self.begin_state(
+                like=inputs, batch_axis=(0 if layout == "NTC" else 1))
+        ns = 2 if self._mode == "lstm" else 1
+        out = S.RNN(inputs, *begin_state, *self._pvars,
+                    mode=self._mode, num_layers=self._num_layers,
+                    num_dir=self._num_dir, hidden_size=self._num_hidden,
+                    layout_ntc=(layout == "NTC"),
+                    pnames=tuple(self._pnames), state_outputs=True,
+                    dropout=self._dropout, name=f"{self._prefix}rnn")
+        outputs = out[0]
+        states = [out[1 + i] for i in range(ns)] \
+            if self._get_next_state else []
+        if merge_outputs is None:
+            merge_outputs = was_merged
+        outputs, _ = _normalize_sequence(length, outputs, layout,
+                                         merge_outputs)
+        return outputs, states
+
+    def unfuse(self):
+        """Equivalent SequentialRNNCell of unfused cells (reference:
+        FusedRNNCell.unfuse). Parameter names coincide with the fused
+        ``pnames`` (prefix + l{i}_...), so weights bind either way —
+        no blob repacking needed on TPU (see pack_weights note)."""
+        if self._bidirectional:
+            raise MXNetError("unfuse: bidirectional stacks unroll only "
+                             "fused (upstream unfuses to BidirectionalCell"
+                             " — use FusedRNNCell directly on TPU)")
+        # each sub-cell owns RNNParams(prefix + l{i}_): its variable
+        # names then equal the fused node's prefix+pname, so the same
+        # arg dict binds both graphs (upstream needs unpack_weights for
+        # this; TPU-side the names already coincide)
+        stack = SequentialRNNCell()
+        make = {"rnn_relu":
+                lambda p: RNNCell(self._num_hidden, activation="relu",
+                                  prefix=p),
+                "rnn_tanh":
+                lambda p: RNNCell(self._num_hidden, activation="tanh",
+                                  prefix=p),
+                "lstm":
+                lambda p: LSTMCell(self._num_hidden, prefix=p,
+                                   forget_bias=self._forget_bias),
+                "gru":
+                lambda p: GRUCell(self._num_hidden, prefix=p)}[self._mode]
+        for layer in range(self._num_layers):
+            stack.add(make(f"{self._prefix}l{layer}_"))
+            if self._dropout > 0 and layer < self._num_layers - 1:
+                stack.add(DropoutCell(
+                    self._dropout, prefix=f"{self._prefix}_dropout{layer}_"))
+        return stack
+
+
+class SequentialRNNCell(BaseRNNCell):
+    """Stack cells in sequence (reference: SequentialRNNCell)."""
+
+    def __init__(self, params=None):
+        super().__init__(prefix="", params=params)
+        self._cells = []
+
+    def add(self, cell):
+        self._cells.append(cell)
+
+    @property
+    def state_info(self):
+        return [info for c in self._cells for info in c.state_info]
+
+    def begin_state(self, **kwargs):
+        if self._modified:
+            raise MXNetError("begin_state on a modifier-wrapped cell")
+        return [s for c in self._cells for s in c.begin_state(**kwargs)]
+
+    def reset(self):
+        super().reset()
+        for c in getattr(self, "_cells", ()):
+            c.reset()
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        next_states = []
+        pos = 0
+        for cell in self._cells:
+            n = len(cell.state_info)
+            cell_states = states[pos:pos + n]
+            pos += n
+            inputs, new = cell(inputs, cell_states)
+            next_states.extend(new)
+        return inputs, next_states
+
+    def unroll(self, length, inputs=None, begin_state=None, layout="NTC",
+               merge_outputs=None):
+        # per-cell unroll so a FusedRNNCell member could still fuse is
+        # upstream behaviour; the simple chain matches it for the
+        # unfused cells this container holds
+        self.reset()
+        was_merged = isinstance(inputs, Symbol)
+        steps, _ = _normalize_sequence(length, inputs, layout, False)
+        if begin_state is None:
+            begin_state = self.begin_state(like=steps[0])
+        states = begin_state
+        outputs = []
+        for i in range(length):
+            output, states = self(steps[i], states)
+            outputs.append(output)
+        if merge_outputs is None:
+            merge_outputs = was_merged
+        outputs, _ = _normalize_sequence(length, outputs, layout,
+                                         merge_outputs)
+        return outputs, states
+
+
+class BidirectionalCell(BaseRNNCell):
+    """Run two cells over the sequence in opposite directions and concat
+    the per-step outputs (reference: BidirectionalCell). Unroll-only."""
+
+    def __init__(self, l_cell, r_cell, params=None, output_prefix="bi_"):
+        super().__init__(prefix="", params=params)
+        self._l_cell = l_cell
+        self._r_cell = r_cell
+        self._output_prefix = output_prefix
+
+    @property
+    def state_info(self):
+        return self._l_cell.state_info + self._r_cell.state_info
+
+    def begin_state(self, **kwargs):
+        if self._modified:
+            raise MXNetError("begin_state on a modifier-wrapped cell")
+        return (self._l_cell.begin_state(**kwargs) +
+                self._r_cell.begin_state(**kwargs))
+
+    def reset(self):
+        super().reset()
+        for c in (getattr(self, "_l_cell", None),
+                  getattr(self, "_r_cell", None)):
+            if c is not None:
+                c.reset()
+
+    def __call__(self, inputs, states):
+        raise MXNetError("BidirectionalCell cannot be stepped — the "
+                         "reverse direction needs the whole sequence; "
+                         "call unroll()")
+
+    def unroll(self, length, inputs=None, begin_state=None, layout="NTC",
+               merge_outputs=None):
+        self.reset()
+        was_merged = isinstance(inputs, Symbol)
+        steps, axis = _normalize_sequence(length, inputs, layout, False)
+        if begin_state is None:
+            begin_state = self.begin_state(like=steps[0])
+        nl = len(self._l_cell.state_info)
+        l_outputs, l_states = self._l_cell.unroll(
+            length, steps, begin_state[:nl], layout, merge_outputs=False)
+        r_outputs, r_states = self._r_cell.unroll(
+            length, list(reversed(steps)), begin_state[nl:], layout,
+            merge_outputs=False)
+        outputs = [S.concat(lo, ro, dim=1,
+                            name=f"{self._output_prefix}t{i}")
+                   for i, (lo, ro) in enumerate(
+                       zip(l_outputs, reversed(r_outputs)))]
+        if merge_outputs is None:
+            merge_outputs = was_merged
+        outputs, _ = _normalize_sequence(length, outputs, layout,
+                                         merge_outputs)
+        return outputs, l_states + r_states
+
+
+class DropoutCell(BaseRNNCell):
+    """Dropout on the per-step output, stateless (reference:
+    DropoutCell)."""
+
+    def __init__(self, dropout, prefix="dropout_", params=None):
+        super().__init__(prefix, params)
+        self._dropout = dropout
+
+    @property
+    def state_info(self):
+        return []
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        if self._dropout > 0:
+            inputs = S.Dropout(inputs, p=self._dropout,
+                               name=f"{self._prefix}t{self._counter}")
+        return inputs, states
+
+
+class ModifierCell(BaseRNNCell):
+    """Base for cells that wrap another cell (reference: ModifierCell).
+    The wrapped cell's params are reused; the wrapper owns none."""
+
+    def __init__(self, base_cell):
+        super().__init__(prefix=base_cell._prefix + "mod_", params=None)
+        base_cell._modified = True
+        self.base_cell = base_cell
+
+    @property
+    def params(self):
+        return self.base_cell.params
+
+    @property
+    def state_info(self):
+        return self.base_cell.state_info
+
+    def begin_state(self, **kwargs):
+        self.base_cell._modified = False
+        begin = self.base_cell.begin_state(**kwargs)
+        self.base_cell._modified = True
+        return begin
+
+    def reset(self):
+        super().reset()
+        if getattr(self, "base_cell", None) is not None:
+            self.base_cell.reset()
+
+
+class ZoneoutCell(ModifierCell):
+    """Zoneout regularisation (reference: ZoneoutCell; Krueger et al.):
+    with probability z, a state unit keeps its previous value. Uses the
+    Dropout node's train/inference split, so inference is the expected
+    identity blend."""
+
+    def __init__(self, base_cell, zoneout_outputs=0.0, zoneout_states=0.0):
+        if isinstance(base_cell, FusedRNNCell):
+            raise MXNetError("ZoneoutCell needs a steppable cell; "
+                             "FusedRNNCell is sequence-level (upstream "
+                             "raises here too)")
+        super().__init__(base_cell)
+        self._zoneout_outputs = zoneout_outputs
+        self._zoneout_states = zoneout_states
+        self._prev_output = None
+
+    def reset(self):
+        super().reset()
+        self._prev_output = None
+
+    def __call__(self, inputs, states):
+        output, next_states = self.base_cell(inputs, states)
+
+        def zone(new, old, rate):
+            # Dropout(ones, p=rate)*(1-rate) is 1 w.p. (1-rate): the
+            # KEEP-NEW mask (inverted-dropout scaling undone). A unit
+            # zones out (keeps old) w.p. rate; inference blends
+            # (1-rate)*new + rate*old, the zoneout expectation.
+            mask = S.Dropout(_make("_rnn_ones_like", [new], {}),
+                             p=rate) * (1.0 - rate)
+            return mask * new + (1.0 - mask) * old
+
+        prev = self._prev_output
+        if prev is None:
+            prev = _make("_rnn_zero_state", [output],
+                         {"shape": (0,) + tuple(
+                             self.base_cell.state_info[0]["shape"][1:])})
+        if self._zoneout_outputs > 0:
+            output = zone(output, prev, self._zoneout_outputs)
+        self._prev_output = output
+        if self._zoneout_states > 0:
+            next_states = [zone(n, o, self._zoneout_states)
+                           for n, o in zip(next_states, states)]
+        return output, next_states
+
+
+class ResidualCell(ModifierCell):
+    """Output = cell(output) + inputs (reference: ResidualCell)."""
+
+    def __call__(self, inputs, states):
+        output, states = self.base_cell(inputs, states)
+        return output + inputs, states

@@ -569,8 +569,10 @@ register_op("_contrib_AdaptiveAvgPooling2D",
             lambda x, output_size=1:
             _extra.adaptive_avg_pool2d_k(x, output_size))
 register_op("_contrib_BilinearResize2D",
-            lambda x, height=0, width=0:
-            _extra.bilinear_resize_k(x, int(height), int(width)))
+            lambda x, height=0, width=0, scale_height=0.0, scale_width=0.0:
+            _extra.bilinear_resize_k(
+                x, *_extra._resize_target(x.shape, height, width,
+                                          scale_height, scale_width)))
 
 
 def AdaptiveAvgPooling2D(data, output_size=1, name=None, **kw):
@@ -581,10 +583,17 @@ def AdaptiveAvgPooling2D(data, output_size=1, name=None, **kw):
                  {"output_size": out}, name=name)
 
 
-def BilinearResize2D(data, height=None, width=None, name=None, **kw):
-    """reference: contrib.BilinearResize2D (bilinear_resize.cc)."""
+def BilinearResize2D(data, height=None, width=None, scale_height=None,
+                     scale_width=None, name=None, **kw):
+    """reference: contrib.BilinearResize2D (bilinear_resize.cc);
+    explicit height/width, or the scale_height/scale_width mode."""
+    if not (height and width) and not (scale_height and scale_width):
+        raise MXNetError("BilinearResize2D: need height+width or "
+                         "scale_height+scale_width")
     return _make("_contrib_BilinearResize2D", [data],
-                 {"height": int(height), "width": int(width)}, name=name)
+                 {"height": int(height or 0), "width": int(width or 0),
+                  "scale_height": float(scale_height or 0.0),
+                  "scale_width": float(scale_width or 0.0)}, name=name)
 
 
 __all__ += ["ROIAlign", "box_nms", "box_non_maximum_suppression", "box_iou",

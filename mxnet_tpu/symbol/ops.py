@@ -950,6 +950,17 @@ def RNN(data, *state_and_params, mode="lstm", num_layers=1, num_dir=1,
 # value out of jnp (which lifts constants into tracers at trace time)
 # lets Shape->Gather->Range chains fold to Python ints — the ONNX
 # importer's dynamic attention mask relies on this
+# zero initial RNN state derived from a graph tensor: 0 in `shape`
+# marks the batch dim, filled from the like-input's leading axis at
+# trace time (the legacy rnn_cell.begin_state path — upstream uses
+# sym.zeros with shape=(0, H) and nnvm back-infers the 0; our executor
+# traces concrete shapes, so the batch rides the graph instead)
+register_op("_rnn_zero_state",
+            lambda x, shape=(), batch_axis=0: jnp.zeros(
+                tuple(x.shape[batch_axis] if s == 0 else s for s in shape),
+                x.dtype))
+register_op("_rnn_ones_like", jnp.ones_like)
+
 register_op("shape_array", lambda a: _np.asarray(a.shape, _np.int32))
 register_op("where", lambda c, a, b: jnp.where(c != 0, a, b))
 # arange whose limit arrives as a (scalar) graph INPUT, not an attr.

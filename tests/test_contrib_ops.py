@@ -397,3 +397,19 @@ def test_log_validation_metrics_callback(caplog):
     with caplog.at_level(logging.INFO):
         cb(BatchEndParam(epoch=3, nbatch=0, eval_metric=m, locals=None))
     assert any("Validation-accuracy" in r.message for r in caplog.records)
+
+
+def test_bilinear_resize2d_scale_mode_and_errors():
+    x = np.random.RandomState(4).rand(1, 2, 6, 8).astype(np.float32)
+    y = nd.contrib.BilinearResize2D(nd.array(x), scale_height=2.0,
+                                    scale_width=0.5)
+    assert y.shape == (1, 2, 12, 4)
+    s = sym.contrib.BilinearResize2D(sym.Variable("d"), scale_height=2.0,
+                                     scale_width=0.5)
+    out = mx.sym.load_json(s.tojson()).bind(
+        mx.cpu(), {"d": nd.array(x)}).forward()[0]
+    np.testing.assert_allclose(out.asnumpy(), y.asnumpy(), atol=1e-6)
+    with pytest.raises(mx.base.MXNetError):
+        nd.contrib.BilinearResize2D(nd.array(x), height=10)  # no width
+    with pytest.raises(mx.base.MXNetError):
+        sym.contrib.BilinearResize2D(sym.Variable("d"), width=4)

@@ -203,3 +203,62 @@ def test_sequential_module():
     assert m.get()[1] > 0.9, m.get()
     arg_p, _ = seq.get_params()
     assert "m1fc_weight" in arg_p and "m2fc_weight" in arg_p
+
+
+def test_set_params_before_first_forward():
+    """bind -> set_params -> score (the classic deploy flow) must work
+    without a prior forward/init_params: upstream documents set_params
+    as init_params(arg_params=..., force_init=...)."""
+    import numpy as np
+    from mxnet_tpu import nd, sym
+    from mxnet_tpu.module import Module
+    from mxnet_tpu.io import NDArrayIter
+
+    x = sym.Variable("data")
+    net = sym.SoftmaxOutput(
+        sym.FullyConnected(x, num_hidden=3, name="fc"),
+        sym.Variable("softmax_label"), name="softmax")
+    rs = np.random.RandomState(0)
+    X = rs.randn(8, 4).astype(np.float32)
+    y = np.zeros(8, np.float32)
+    it = NDArrayIter({"data": X}, {"softmax_label": y}, batch_size=8)
+    args = {"fc_weight": nd.array(rs.randn(3, 4).astype(np.float32)),
+            "fc_bias": nd.array(np.zeros(3, np.float32))}
+
+    mod = Module(net, data_names=["data"], label_names=["softmax_label"])
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label,
+             for_training=False)
+    mod.set_params(args, {})          # no forward has happened yet
+    mod.forward(next(iter(it)), is_train=False)
+    out = mod.get_outputs()[0].asnumpy()
+    want = X @ args["fc_weight"].asnumpy().T
+    want = np.exp(want - want.max(1, keepdims=True))
+    want /= want.sum(1, keepdims=True)
+    np.testing.assert_allclose(out, want, rtol=1e-4)
+
+
+def test_set_params_validates_names():
+    import numpy as np
+    import pytest
+    from mxnet_tpu import nd, sym
+    from mxnet_tpu.module import Module
+    from mxnet_tpu.io import NDArrayIter
+    import mxnet_tpu as mx
+
+    x = sym.Variable("data")
+    net = sym.SoftmaxOutput(
+        sym.FullyConnected(x, num_hidden=3, name="fc"),
+        sym.Variable("softmax_label"), name="softmax")
+    it = NDArrayIter({"data": np.zeros((4, 4), np.float32)},
+                     {"softmax_label": np.zeros(4, np.float32)},
+                     batch_size=4)
+    mod = Module(net, data_names=["data"], label_names=["softmax_label"])
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    w = nd.array(np.zeros((3, 4), np.float32))
+    b = nd.array(np.zeros(3, np.float32))
+    with pytest.raises(mx.base.MXNetError):   # typo'd name, missing real
+        mod.set_params({"fc_weigth": w, "fc_bias": b})
+    with pytest.raises(mx.base.MXNetError):   # extra key
+        mod.set_params({"fc_weight": w, "fc_bias": b, "bogus": b})
+    mod.set_params({"fc_weight": w, "fc_bias": b})          # exact: fine
+    mod.set_params({"fc_bias": b}, allow_missing=True)      # partial: ok
