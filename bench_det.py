@@ -93,13 +93,15 @@ def build_step(batch, input_size=512):
 BASELINE_RCNN_IMG_S = 270.0
 
 
-def build_rcnn_step(batch, input_size=512):
+def build_rcnn_step(batch, input_size=512, return_parts=False):
     """Full two-stage train step in ONE jitted program: backbone+RPN,
     proposal generation (static-k top-k + NMS), target sampling, RoIAlign
     head, RPN + RCNN losses. The reference runs this as a Python training
     loop around imperative ops; here the whole pipeline compiles into a
     single XLA executable (proposals/NMS are static-shape, so nothing
-    falls back to the host between stages)."""
+    falls back to the host between stages). With return_parts=True also
+    returns (net, fwd) so callers (tools/det_convergence.py) can run
+    held-out eval with the trained params."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -187,6 +189,8 @@ def build_rcnn_step(batch, input_size=512):
     step = make_sgd_step(loss_fn, aux_idx, lr=1e-3, mu=0.9)
     mom = [jnp.zeros_like(p) for p in params]
     data = (x._data, gt._data, rpn_cls_t, rpn_box_t, rpn_box_m)
+    if return_parts:
+        return step, params, mom, data, (net, fwd)
     return step, params, mom, data
 
 

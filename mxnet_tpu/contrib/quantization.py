@@ -50,8 +50,9 @@ __all__ = ["quantize", "dequantize", "QuantizedDense", "QuantizedConv2D",
            "quantize_net", "quantize_model", "kl_optimal_threshold"]
 
 
-def _scale_of(amax):
-    return jnp.maximum(amax, 1e-12) / 127.0
+# canonical symmetric-int8 scale shared with the op-level surface
+# (ops/contrib_ops.int8_scale) — one formula, one place
+from ..ops.contrib_ops import int8_scale as _scale_of  # noqa: E402
 
 
 _ACTS = {

@@ -554,3 +554,34 @@ def count_sketch(data, h, s, out_dim, **kw):
 # bilinear_resize.cc); the implementations live with the other classic
 # ops — re-export, don't duplicate
 from ..ops.extra_ops import AdaptiveAvgPooling2D, BilinearResize2D  # noqa: E402,F401
+
+
+# -- op-level quantization (reference: src/operator/quantization/*.cc) ------
+def quantize(data, min_range, max_range, out_type="uint8", **kw):
+    """float -> (q, out_min, out_max) inside the given tensor range
+    (upstream: quantize.cc; uint8 affine, int8 symmetric)."""
+    return _apply(lambda x, a, b: _cops.quantize(x, a, b, out_type),
+                  [data, min_range, max_range], n_out=3)
+
+
+def quantize_v2(data, out_type="int8", min_calib_range=None,
+                max_calib_range=None, **kw):
+    """Calibrated (attr ranges) or dynamic (data min/max) quantization
+    (upstream: quantize_v2.cc)."""
+    return _apply(lambda x: _cops.quantize_v2(
+        x, out_type, min_calib_range, max_calib_range), [data], n_out=3)
+
+
+def dequantize(data, min_range, max_range, out_type="float32", **kw):
+    """quantized (uint8/int8/int32) -> float32 (upstream: dequantize.cc)."""
+    return _apply(lambda q, a, b: _cops.dequantize(q, a, b, out_type),
+                  [data, min_range, max_range])
+
+
+def requantize(data, min_range, max_range, min_calib_range=None,
+               max_calib_range=None, **kw):
+    """int32 accumulator -> int8 with a new range (upstream:
+    requantize.cc); returns (q8, out_min, out_max)."""
+    return _apply(lambda q, a, b: _cops.requantize(
+        q, a, b, min_calib_range, max_calib_range),
+        [data, min_range, max_range], n_out=3)

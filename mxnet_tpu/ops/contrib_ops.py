@@ -24,9 +24,11 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..base import MXNetError
 from .detection_ops import box_iou, nms, roi_align
 
-__all__ = ["deformable_convolution", "proposal", "multi_proposal",
+__all__ = ["quantize", "quantize_v2", "dequantize", "requantize",
+           "deformable_convolution", "proposal", "multi_proposal",
            "fft", "ifft", "count_sketch", "roi_align_batched", "box_nms",
            "generate_base_anchors", "to_corner", "box_iou_generic",
            "multibox_prior_k", "multibox_target_k", "multibox_detection_k"]
@@ -412,3 +414,86 @@ def box_nms(data, overlap_thresh=0.5, valid_thresh=0.0, topk=-1,
 
     out = jax.vmap(per_batch)(arr)
     return out if batched else out[0]
+
+
+# ---------------------------------------------------------------------------
+# op-level quantization (reference: src/operator/quantization/quantize.cc,
+# quantize_v2.cc, dequantize.cc, requantize.cc). The graph-level
+# quantize_net (contrib/quantization.py) uses its own fused kernels; these
+# are the documented op-level entry points with the upstream (q, min, max)
+# three-output contract. int8 is symmetric (MXU-native), uint8 affine.
+# ---------------------------------------------------------------------------
+_INT32_QMAX = float(2 ** 31 - 1)
+
+
+def int8_scale(amax):
+    """Canonical symmetric-int8 scale (float value of one int8 unit).
+    The ONE place this formula lives: the graph-level quantize_net
+    (contrib/quantization.py _scale_of) aliases it, and the op-level
+    surface below divides by it — keep them bit-identical."""
+    return jnp.maximum(amax, 1e-12) / 127.0
+
+
+def _scalar(r):
+    return jnp.reshape(jnp.asarray(r, jnp.float32), ())
+
+
+def _absmax(mn, mx):
+    return jnp.maximum(jnp.abs(mn), jnp.abs(mx))
+
+
+def quantize(x, mn, mx, out_type="uint8"):
+    """float -> (quantized, out_min, out_max) inside [mn, mx]."""
+    mn, mx = _scalar(mn), _scalar(mx)
+    if out_type == "uint8":
+        scale = 255.0 / jnp.maximum(mx - mn, 1e-12)
+        q = jnp.clip(jnp.round((x - mn) * scale), 0, 255).astype(jnp.uint8)
+        return q, mn, mx
+    if out_type != "int8":
+        raise MXNetError(f"quantize: out_type must be int8/uint8, "
+                         f"got {out_type!r}")
+    amax = _absmax(mn, mx)
+    q = jnp.clip(jnp.round(x / int8_scale(amax)),
+                 -127, 127).astype(jnp.int8)
+    return q, -amax, amax
+
+
+def quantize_v2(x, out_type="int8", min_calib_range=None,
+                max_calib_range=None):
+    """Calibrated (attr ranges) or dynamic (data min/max) quantization."""
+    if min_calib_range is None or max_calib_range is None:
+        mn, mx = jnp.min(x).astype(jnp.float32), \
+            jnp.max(x).astype(jnp.float32)
+    else:
+        mn, mx = _scalar(min_calib_range), _scalar(max_calib_range)
+    return quantize(x, mn, mx, out_type=out_type)
+
+
+def dequantize(q, mn, mx, out_type="float32"):
+    """quantized -> float32; understands uint8 (affine), int8 (symmetric)
+    and int32 (the quantized-matmul accumulator range)."""
+    if out_type != "float32":
+        raise MXNetError("dequantize: out_type must be float32")
+    mn, mx = _scalar(mn), _scalar(mx)
+    if q.dtype == jnp.uint8:
+        return q.astype(jnp.float32) * (mx - mn) / 255.0 + mn
+    if q.dtype == jnp.int8:
+        return q.astype(jnp.float32) * int8_scale(_absmax(mn, mx))
+    if q.dtype == jnp.int32:
+        return q.astype(jnp.float32) * _absmax(mn, mx) / _INT32_QMAX
+    raise MXNetError(f"dequantize: unsupported dtype {q.dtype}")
+
+
+def requantize(q32, mn, mx, min_calib_range=None, max_calib_range=None):
+    """int32 accumulator -> int8 under a new (calibrated or dynamic)
+    range; returns (q8, -amax, amax)."""
+    if q32.dtype != jnp.int32:
+        raise MXNetError("requantize expects int32 input")
+    f = dequantize(q32, mn, mx)
+    if min_calib_range is not None and max_calib_range is not None:
+        amax = _absmax(_scalar(min_calib_range), _scalar(max_calib_range))
+    else:
+        amax = jnp.max(jnp.abs(f))
+    q = jnp.clip(jnp.round(f / int8_scale(amax)),
+                 -127, 127).astype(jnp.int8)
+    return q, -amax, amax

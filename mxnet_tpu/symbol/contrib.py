@@ -596,8 +596,57 @@ def BilinearResize2D(data, height=None, width=None, scale_height=None,
                   "scale_width": float(scale_width or 0.0)}, name=name)
 
 
+# -- op-level quantization (reference: src/operator/quantization/*.cc) ------
+register_op("_contrib_quantize",
+            lambda x, a, b, out_type="uint8":
+            _cops.quantize(x, a, b, out_type), )
+register_op("_contrib_quantize_v2",
+            lambda x, out_type="int8", min_calib_range=None,
+            max_calib_range=None:
+            _cops.quantize_v2(x, out_type, min_calib_range,
+                              max_calib_range))
+register_op("_contrib_dequantize",
+            lambda q, a, b, out_type="float32":
+            _cops.dequantize(q, a, b, out_type))
+register_op("_contrib_requantize",
+            lambda q, a, b, min_calib_range=None, max_calib_range=None:
+            _cops.requantize(q, a, b, min_calib_range, max_calib_range))
+
+
+def quantize(data, min_range, max_range, out_type="uint8", name=None,
+             **kw):
+    """reference: quantize.cc — (q, out_min, out_max), range as inputs."""
+    return _make("_contrib_quantize", [data, min_range, max_range],
+                 {"out_type": out_type}, name=name, n_out=3)
+
+
+def quantize_v2(data, out_type="int8", min_calib_range=None,
+                max_calib_range=None, name=None, **kw):
+    """reference: quantize_v2.cc — calibration ranges as attrs."""
+    return _make("_contrib_quantize_v2", [data],
+                 {"out_type": out_type,
+                  "min_calib_range": min_calib_range,
+                  "max_calib_range": max_calib_range}, name=name, n_out=3)
+
+
+def dequantize(data, min_range, max_range, out_type="float32", name=None,
+               **kw):
+    """reference: dequantize.cc."""
+    return _make("_contrib_dequantize", [data, min_range, max_range],
+                 {"out_type": out_type}, name=name)
+
+
+def requantize(data, min_range, max_range, min_calib_range=None,
+               max_calib_range=None, name=None, **kw):
+    """reference: requantize.cc — int32 -> int8 under a new range."""
+    return _make("_contrib_requantize", [data, min_range, max_range],
+                 {"min_calib_range": min_calib_range,
+                  "max_calib_range": max_calib_range}, name=name, n_out=3)
+
+
 __all__ += ["ROIAlign", "box_nms", "box_non_maximum_suppression", "box_iou",
             "MultiBoxPrior", "MultiBoxTarget", "MultiBoxDetection",
             "Proposal", "MultiProposal", "DeformableConvolution",
             "fft", "ifft", "count_sketch", "AdaptiveAvgPooling2D",
-            "BilinearResize2D"]
+            "BilinearResize2D", "quantize", "quantize_v2", "dequantize",
+            "requantize"]
