@@ -158,10 +158,16 @@ class FasterRCNN(HybridBlock):
         (cls_scores (B, R, C+1), box_deltas (B, R, C+1, 4))."""
         scale = 1.0 / self.stride
         oh, ow = self._roi_size
+        # perf lever (MXTPU_ROIALIGN=mm): einsum RoIAlign — the pool as
+        # two MXU contractions instead of a gather (A/B on chip; numerics
+        # identical, pinned by test_detection parity)
+        import os
+        align_k = D.roi_align_mm if os.environ.get(
+            "MXTPU_ROIALIGN") == "mm" else D.roi_align
 
         def align(f, r):
             fc = jnp.moveaxis(f, -1, 0)                   # NCHW per image
-            return D.roi_align(fc, r, (oh, ow), spatial_scale=scale)
+            return align_k(fc, r, (oh, ow), spatial_scale=scale)
 
         pooled = _apply(lambda f, r: jax.vmap(align)(f, r), [feat, rois])
         b, rn = pooled.shape[0], pooled.shape[1]

@@ -585,3 +585,49 @@ def requantize(data, min_range, max_range, min_calib_range=None,
     return _apply(lambda q, a, b: _cops.requantize(
         q, a, b, min_calib_range, max_calib_range),
         [data, min_range, max_range], n_out=3)
+
+
+def quantized_fully_connected(data, weight, bias, min_data, max_data,
+                              min_weight, max_weight, num_hidden=None,
+                              no_bias=False, **kw):
+    """int8 FC -> int32 accumulator (upstream:
+    quantized_fully_connected.cc); (acc, out_min, out_max)."""
+    ins = [data, weight] + ([] if no_bias or bias is None else [bias]) \
+        + [min_data, max_data, min_weight, max_weight]
+
+    def f(xq, wq, *rest):
+        b, (mnd, mxd, mnw, mxw) = _cops.split_quantized_bias(rest)
+        return _cops.quantized_fully_connected(
+            xq, wq, b, mnd, mxd, mnw, mxw, num_hidden=num_hidden)
+    return _apply(f, ins, n_out=3)
+
+
+def quantized_conv(data, weight, bias, min_data, max_data, min_weight,
+                   max_weight, kernel=None, stride=(1, 1), pad=(0, 0),
+                   dilate=(1, 1), num_filter=None, layout="NCHW",
+                   no_bias=False, **kw):
+    """int8 conv -> int32 accumulator (upstream: quantized_conv.cc)."""
+    ins = [data, weight] + ([] if no_bias or bias is None else [bias]) \
+        + [min_data, max_data, min_weight, max_weight]
+
+    def f(xq, wq, *rest):
+        b, (mnd, mxd, mnw, mxw) = _cops.split_quantized_bias(rest)
+        return _cops.quantized_conv(
+            xq, wq, b, mnd, mxd, mnw, mxw, kernel=kernel, stride=stride,
+            pad=pad, dilate=dilate, num_filter=num_filter, layout=layout)
+    return _apply(f, ins, n_out=3)
+
+
+def quantized_pooling(data, min_range, max_range, kernel=(2, 2),
+                      pool_type="max", stride=None, pad=(0, 0),
+                      layout="NCHW", **kw):
+    """Pooling in the quantized domain (upstream: quantized_pooling.cc)."""
+    return _apply(lambda q, a, b: _cops.quantized_pooling(
+        q, a, b, kernel=kernel, pool_type=pool_type, stride=stride,
+        pad=pad, layout=layout), [data, min_range, max_range], n_out=3)
+
+
+def quantized_flatten(data, min_range, max_range, **kw):
+    """reference: quantized_flatten.cc."""
+    return _apply(_cops.quantized_flatten,
+                  [data, min_range, max_range], n_out=3)

@@ -28,6 +28,8 @@ from ..base import MXNetError
 from .detection_ops import box_iou, nms, roi_align
 
 __all__ = ["quantize", "quantize_v2", "dequantize", "requantize",
+           "quantized_fully_connected", "quantized_conv",
+           "quantized_pooling", "quantized_flatten",
            "deformable_convolution", "proposal", "multi_proposal",
            "fft", "ifft", "count_sketch", "roi_align_batched", "box_nms",
            "generate_base_anchors", "to_corner", "box_iou_generic",
@@ -497,3 +499,123 @@ def requantize(q32, mn, mx, min_calib_range=None, max_calib_range=None):
     q = jnp.clip(jnp.round(f / int8_scale(amax)),
                  -127, 127).astype(jnp.int8)
     return q, -amax, amax
+
+
+def split_quantized_bias(rest):
+    """Decode the optional-bias positional contract shared by every
+    quantized compute op: inputs are (data, weight[, bias], min_data,
+    max_data, min_weight, max_weight), so a 4-long tail means no bias.
+    The ONE place this decoding lives — nd and sym wrappers both call
+    it."""
+    return (None, rest) if len(rest) == 4 else (rest[0], rest[1:])
+
+
+def _q8_scales(mn_d, mx_d, mn_w, mx_w):
+    sd = int8_scale(_absmax(_scalar(mn_d), _scalar(mx_d)))
+    sw = int8_scale(_absmax(_scalar(mn_w), _scalar(mx_w)))
+    return sd, sw
+
+
+def _q8_out_range(sd, sw):
+    # the int32 accumulator's representable float range: one acc unit is
+    # sd*sw, so dequantize(acc, -r, r) with r = sd*sw*INT32_QMAX recovers
+    # acc*sd*sw exactly (see dequantize int32 branch)
+    r = sd * sw * _INT32_QMAX
+    return -r, r
+
+
+def quantized_fully_connected(xq, wq, bias, mn_d, mx_d, mn_w, mx_w,
+                              num_hidden=None):
+    """int8 x int8 -> int32 FC (reference: quantized_fully_connected.cc).
+    xq (..., K) int8, wq (num_hidden, K) int8, bias float32 or None
+    (folded into the accumulator at the joint scale, upstream's int32-
+    bias path). Returns (acc int32, out_min, out_max) such that
+    dequantize(acc, out_min, out_max) == x_f @ w_f.T + bias up to
+    quantization error."""
+    if xq.dtype != jnp.int8 or wq.dtype != jnp.int8:
+        raise MXNetError("quantized_fully_connected expects int8 inputs "
+                         "(use quantize/quantize_v2 first)")
+    sd, sw = _q8_scales(mn_d, mx_d, mn_w, mx_w)
+    x2 = xq.reshape(-1, xq.shape[-1]) if xq.ndim > 2 else xq
+    acc = lax.dot_general(x2, wq, (((x2.ndim - 1,), (1,)), ((), ())),
+                          preferred_element_type=jnp.int32)
+    if bias is not None:
+        acc = acc + jnp.round(bias.astype(jnp.float32)
+                              / (sd * sw)).astype(jnp.int32)
+    if xq.ndim > 2:
+        acc = acc.reshape(xq.shape[:-1] + (wq.shape[0],))
+    lo, hi = _q8_out_range(sd, sw)
+    return acc, lo, hi
+
+
+def quantized_conv(xq, wq, bias, mn_d, mx_d, mn_w, mx_w, kernel=None,
+                   stride=(1, 1), pad=(0, 0), dilate=(1, 1),
+                   num_filter=None, layout="NCHW"):
+    """int8 conv -> int32 accumulator (reference: quantized_conv.cc).
+    xq NCHW/NHWC int8, wq (F, C, kh, kw) int8 (NCHW weight layout, like
+    the reference). Returns (acc int32, out_min, out_max)."""
+    if xq.dtype != jnp.int8 or wq.dtype != jnp.int8:
+        raise MXNetError("quantized_conv expects int8 inputs")
+    sd, sw = _q8_scales(mn_d, mx_d, mn_w, mx_w)
+    st = tuple(stride) if not isinstance(stride, int) else (stride,) * 2
+    pd = tuple(pad) if not isinstance(pad, int) else (pad,) * 2
+    dl = tuple(dilate) if not isinstance(dilate, int) else (dilate,) * 2
+    rhs = "OIHW"
+    dn = lax.conv_dimension_numbers(
+        xq.shape, wq.shape, (layout, rhs, layout))
+    acc = lax.conv_general_dilated(
+        xq, wq, st, [(pd[0], pd[0]), (pd[1], pd[1])],
+        rhs_dilation=dl, dimension_numbers=dn,
+        preferred_element_type=jnp.int32)
+    if bias is not None:
+        b32 = jnp.round(bias.astype(jnp.float32)
+                        / (sd * sw)).astype(jnp.int32)
+        acc = acc + (b32[None, :, None, None] if layout == "NCHW"
+                     else b32[None, None, None, :])
+    lo, hi = _q8_out_range(sd, sw)
+    return acc, lo, hi
+
+
+def quantized_pooling(xq, mn, mx, kernel=(2, 2), pool_type="max",
+                      stride=None, pad=(0, 0), layout="NCHW"):
+    """Pooling directly on the quantized domain (reference:
+    quantized_pooling.cc): max-pool commutes with the monotone quantize
+    map; avg-pool averages in int32 then rounds back. Ranges pass
+    through unchanged."""
+    if stride is None:
+        stride = kernel
+    st = tuple(stride) if not isinstance(stride, int) else (stride,) * 2
+    kn = tuple(kernel) if not isinstance(kernel, int) else (kernel,) * 2
+    pd = tuple(pad) if not isinstance(pad, int) else (pad,) * 2
+    if layout == "NCHW":
+        window = (1, 1) + kn
+        strides = (1, 1) + st
+        pads = ((0, 0), (0, 0), (pd[0], pd[0]), (pd[1], pd[1]))
+    else:
+        window = (1,) + kn + (1,)
+        strides = (1,) + st + (1,)
+        pads = ((0, 0), (pd[0], pd[0]), (pd[1], pd[1]), (0, 0))
+    if xq.dtype == jnp.int8:
+        ident, lo_q, hi_q = -128, -127, 127
+    elif xq.dtype == jnp.uint8:
+        ident, lo_q, hi_q = 0, 0, 255
+    else:
+        raise MXNetError(f"quantized_pooling: int8/uint8 input, "
+                         f"got {xq.dtype}")
+    if pool_type == "max":
+        out = lax.reduce_window(xq, jnp.array(ident, xq.dtype), lax.max,
+                                window, strides, pads)
+        return out, _scalar(mn), _scalar(mx)
+    if pool_type != "avg":
+        raise MXNetError("quantized_pooling: pool_type max or avg")
+    s = lax.reduce_window(xq.astype(jnp.int32), jnp.array(0, jnp.int32),
+                          lax.add, window, strides, pads)
+    n = kn[0] * kn[1]
+    out = jnp.clip(jnp.round(s.astype(jnp.float32) / n),
+                   lo_q, hi_q).astype(xq.dtype)
+    return out, _scalar(mn), _scalar(mx)
+
+
+def quantized_flatten(xq, mn, mx):
+    """reference: quantized_flatten.cc — reshape, ranges untouched."""
+    return xq.reshape(xq.shape[0], -1), _scalar(mn), _scalar(mx)

@@ -644,9 +644,80 @@ def requantize(data, min_range, max_range, min_calib_range=None,
                   "max_calib_range": max_calib_range}, name=name, n_out=3)
 
 
+def _qfc_eval(xq, wq, *rest, num_hidden=None, no_bias=False):
+    b, ranges = _cops.split_quantized_bias(rest)
+    return _cops.quantized_fully_connected(xq, wq, b, *ranges,
+                                           num_hidden=num_hidden)
+
+
+def _qconv_eval(xq, wq, *rest, stride=(1, 1), pad=(0, 0), dilate=(1, 1),
+                layout="NCHW", no_bias=False, **akw):
+    b, ranges = _cops.split_quantized_bias(rest)
+    return _cops.quantized_conv(xq, wq, b, *ranges, stride=stride,
+                                pad=pad, dilate=dilate, layout=layout)
+
+
+register_op("_contrib_quantized_fully_connected", _qfc_eval)
+register_op("_contrib_quantized_conv", _qconv_eval)
+register_op("_contrib_quantized_pooling",
+            lambda q, a, b, kernel=(2, 2), pool_type="max", stride=None,
+            pad=(0, 0), layout="NCHW":
+            _cops.quantized_pooling(
+                q, a, b, kernel=tuple(kernel), pool_type=pool_type,
+                stride=None if stride is None else tuple(stride),
+                pad=tuple(pad), layout=layout))
+register_op("_contrib_quantized_flatten", _cops.quantized_flatten)
+
+
+def quantized_fully_connected(data, weight, bias, min_data, max_data,
+                              min_weight, max_weight, num_hidden=None,
+                              no_bias=False, name=None, **kw):
+    """reference: quantized_fully_connected.cc."""
+    ins = [data, weight] + ([] if no_bias or bias is None else [bias]) \
+        + [min_data, max_data, min_weight, max_weight]
+    return _make("_contrib_quantized_fully_connected", ins,
+                 {"num_hidden": num_hidden, "no_bias": no_bias},
+                 name=name, n_out=3)
+
+
+def quantized_conv(data, weight, bias, min_data, max_data, min_weight,
+                   max_weight, kernel=None, stride=(1, 1), pad=(0, 0),
+                   dilate=(1, 1), num_filter=None, layout="NCHW",
+                   no_bias=False, name=None, **kw):
+    """reference: quantized_conv.cc."""
+    ins = [data, weight] + ([] if no_bias or bias is None else [bias]) \
+        + [min_data, max_data, min_weight, max_weight]
+    def _l2(v):
+        return [v, v] if isinstance(v, int) else list(v)
+    return _make("_contrib_quantized_conv", ins,
+                 {"stride": _l2(stride), "pad": _l2(pad),
+                  "dilate": _l2(dilate), "layout": layout,
+                  "no_bias": no_bias}, name=name, n_out=3)
+
+
+def quantized_pooling(data, min_range, max_range, kernel=(2, 2),
+                      pool_type="max", stride=None, pad=(0, 0),
+                      layout="NCHW", name=None, **kw):
+    """reference: quantized_pooling.cc."""
+    def _l2(v):
+        return [v, v] if isinstance(v, int) else list(v)
+    return _make("_contrib_quantized_pooling",
+                 [data, min_range, max_range],
+                 {"kernel": _l2(kernel), "pool_type": pool_type,
+                  "stride": None if stride is None else _l2(stride),
+                  "pad": _l2(pad), "layout": layout}, name=name, n_out=3)
+
+
+def quantized_flatten(data, min_range, max_range, name=None, **kw):
+    """reference: quantized_flatten.cc."""
+    return _make("_contrib_quantized_flatten",
+                 [data, min_range, max_range], {}, name=name, n_out=3)
+
+
 __all__ += ["ROIAlign", "box_nms", "box_non_maximum_suppression", "box_iou",
             "MultiBoxPrior", "MultiBoxTarget", "MultiBoxDetection",
             "Proposal", "MultiProposal", "DeformableConvolution",
             "fft", "ifft", "count_sketch", "AdaptiveAvgPooling2D",
             "BilinearResize2D", "quantize", "quantize_v2", "dequantize",
-            "requantize"]
+            "requantize", "quantized_fully_connected", "quantized_conv",
+            "quantized_pooling", "quantized_flatten"]

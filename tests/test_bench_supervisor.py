@@ -176,3 +176,23 @@ def test_sweep_raises_when_nothing_lands():
         raise ValueError("x")
     with pytest.raises(RuntimeError, match="no sweep candidate"):
         bench_util.sweep([1, 2], 1e9, always_fail)
+
+
+def test_supervisor_keeps_stage1_line_when_full_compile_flaps(monkeypatch):
+    """The staged worker (VERDICT r4 item 1b) prints a fast unroll=1
+    checkpoint BEFORE the ~7min unroll=8 compile; if the tunnel flaps
+    mid-compile (worker killed, rc!=0, or wedged), that stage-1 line IS
+    the measurement of record — a short window can no longer yield
+    nothing."""
+    stage1 = json.dumps({"metric": "resnet50_train_throughput",
+                         "value": 2434.05, "vs_baseline": 0.9736})
+    # crash mid-compile after printing stage-1
+    crashed = subprocess.CompletedProcess([], 137,
+                                          stdout=(stage1 + "\n").encode())
+    rc, printed, _ = _run_supervise(monkeypatch, [True], [crashed])
+    assert rc == 0 and printed == [stage1]
+    # ...and the wedged variant (TimeoutExpired mid-compile)
+    wedged = subprocess.TimeoutExpired(cmd=[], timeout=900,
+                                       output=(stage1 + "\n").encode())
+    rc2, printed2, _ = _run_supervise(monkeypatch, [True], [wedged])
+    assert rc2 == 0 and printed2 == [stage1]

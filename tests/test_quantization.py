@@ -467,3 +467,104 @@ def test_sym_contrib_quantize_json_roundtrip():
                                       nd.array([0.0]), nd.array([4.0]),
                                       out_type="uint8")
     np.testing.assert_allclose(outs[0].asnumpy(), ref_q.asnumpy())
+
+
+def test_quantized_fully_connected_end_to_end():
+    """quantize_v2 -> quantized_fully_connected -> dequantize ~= float FC
+    within quantization error (upstream quantized_fully_connected.cc)."""
+    rs = np.random.RandomState(6)
+    x = rs.randn(8, 32).astype(np.float32)
+    w = (rs.randn(16, 32) * 0.2).astype(np.float32)
+    b = rs.randn(16).astype(np.float32)
+    xq, xmn, xmx = nd.contrib.quantize_v2(nd.array(x), out_type="int8")
+    wq, wmn, wmx = nd.contrib.quantize_v2(nd.array(w), out_type="int8")
+    acc, omn, omx = nd.contrib.quantized_fully_connected(
+        xq, wq, nd.array(b), xmn, xmx, wmn, wmx, num_hidden=16)
+    assert acc.asnumpy().dtype == np.int32
+    out = nd.contrib.dequantize(acc, omn, omx).asnumpy()
+    ref = x @ w.T + b
+    # error bound: K * (sx*|w| + sw*|x|) rounding terms; loose 2% rel
+    assert np.abs(out - ref).max() / np.abs(ref).max() < 0.02
+    # int8 deploy chain continues: requantize to int8 with the observed
+    # float range, dequantize, same answer within int8 resolution
+    amax = float(np.abs(ref).max()) * 1.05
+    q8, qmn, qmx = nd.contrib.requantize(acc, omn, omx,
+                                         min_calib_range=-amax,
+                                         max_calib_range=amax)
+    out8 = nd.contrib.dequantize(q8, qmn, qmx).asnumpy()
+    assert np.abs(out8 - ref).max() <= amax / 127 * 0.51 + 0.02 * np.abs(ref).max()
+
+
+def test_quantized_conv_matches_float():
+    rs = np.random.RandomState(7)
+    x = rs.randn(2, 3, 10, 10).astype(np.float32)
+    w = (rs.randn(8, 3, 3, 3) * 0.2).astype(np.float32)
+    xq, xmn, xmx = nd.contrib.quantize_v2(nd.array(x), out_type="int8")
+    wq, wmn, wmx = nd.contrib.quantize_v2(nd.array(w), out_type="int8")
+    acc, omn, omx = nd.contrib.quantized_conv(
+        xq, wq, None, xmn, xmx, wmn, wmx, kernel=(3, 3), pad=(1, 1),
+        no_bias=True)
+    out = nd.contrib.dequantize(acc, omn, omx).asnumpy()
+    import jax.numpy as jnp
+    from jax import lax
+    dn = lax.conv_dimension_numbers(x.shape, w.shape,
+                                    ("NCHW", "OIHW", "NCHW"))
+    ref = np.asarray(lax.conv_general_dilated(
+        jnp.asarray(x), jnp.asarray(w), (1, 1), [(1, 1), (1, 1)],
+        dimension_numbers=dn))
+    assert np.abs(out - ref).max() / np.abs(ref).max() < 0.03
+
+
+def test_quantized_pooling_and_flatten():
+    rs = np.random.RandomState(8)
+    x = rs.randn(2, 4, 8, 8).astype(np.float32)
+    xq, lo, hi = nd.contrib.quantize_v2(nd.array(x), out_type="int8")
+    # max-pool commutes with the monotone quantize map exactly
+    pq, pmn, pmx = nd.contrib.quantized_pooling(xq, lo, hi,
+                                                kernel=(2, 2),
+                                                pool_type="max")
+    dq = nd.contrib.dequantize(pq, pmn, pmx).asnumpy()
+    ref = x.reshape(2, 4, 4, 2, 4, 2).max((3, 5))
+    amax = np.abs(x).max()
+    assert np.abs(dq - ref).max() <= amax / 127 * 0.51 + 1e-6
+    fq, fmn, fmx = nd.contrib.quantized_flatten(pq, pmn, pmx)
+    assert fq.shape == (2, 4 * 4 * 4)
+    # sym chain survives JSON
+    s = sym.contrib.quantized_pooling(sym.Variable("q"),
+                                      sym.Variable("a"),
+                                      sym.Variable("b"), kernel=(2, 2),
+                                      pool_type="avg")
+    g = mx.sym.load_json(s.tojson())
+    outs = g.bind(mx.cpu(), {"q": xq, "a": lo, "b": hi}).forward()
+    assert outs[0].asnumpy().dtype == np.int8
+
+
+def test_quantized_pooling_uint8_and_int_attrs():
+    """uint8 pooling (identity 0, clip 0..255) and int stride/pad attrs
+    through sym (review findings r5)."""
+    rs = np.random.RandomState(9)
+    x = rs.rand(1, 2, 8, 8).astype(np.float32)
+    xq, lo, hi = nd.contrib.quantize(nd.array(x), nd.array([0.0]),
+                                     nd.array([1.0]), out_type="uint8")
+    pq, pa, pb = nd.contrib.quantized_pooling(xq, lo, hi, kernel=2,
+                                              pool_type="max", stride=2)
+    assert pq.asnumpy().dtype == np.uint8
+    ref = x.reshape(1, 2, 4, 2, 4, 2).max((3, 5))
+    back = nd.contrib.dequantize(pq, pa, pb).asnumpy()
+    assert np.abs(back - ref).max() <= 1.0 / 255 + 1e-6
+    # avg keeps the full uint8 range (no int8 clip)
+    aq, _, _ = nd.contrib.quantized_pooling(xq, lo, hi, kernel=2,
+                                            pool_type="avg", stride=2)
+    assert aq.asnumpy().max() > 127  # would be impossible under int8 clip
+    # sym accepts plain ints for kernel/stride/pad
+    s = sym.contrib.quantized_pooling(sym.Variable("q"), sym.Variable("a"),
+                                      sym.Variable("b"), kernel=2,
+                                      pool_type="max", stride=2)
+    outs = mx.sym.load_json(s.tojson()).bind(
+        mx.cpu(), {"q": xq, "a": lo, "b": hi}).forward()
+    np.testing.assert_allclose(outs[0].asnumpy(), pq.asnumpy())
+    s2 = sym.contrib.quantized_conv(
+        sym.Variable("d"), sym.Variable("w"), None, sym.Variable("a1"),
+        sym.Variable("b1"), sym.Variable("a2"), sym.Variable("b2"),
+        stride=1, pad=1, no_bias=True)
+    assert "_contrib_quantized_conv" in s2.tojson()
