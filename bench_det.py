@@ -7,12 +7,28 @@ anchors are model constants, matching GluonCV's generate-once design),
 softmax classification + Huber localisation loss, SGD-momentum, donated
 buffers.
 
-Baseline denominator, derived by FLOP-scaling the SURVEY §6 ResNet-50
-anchor (2500 img/s at ~12.3 GFLOP/img-train): SSD-512's backbone runs
-at 512^2 = 5.2x the 224^2 pixel count (~21 GFLOP fwd) plus extras and
-3x3 heads (~3.5 GFLOP), so one train step is ~73 GFLOP/img; the same
-A100-class conv pipeline therefore sustains 2500 * 12.3/73 ~= 420
-images/sec/chip.
+Baseline denominator (BASELINE_IMG_S = 420), defended two ways
+(VERDICT r4 item 2):
+
+1. FLOP scaling of the SURVEY §6 ResNet-50 anchor (2500 img/s at
+   ~12.3 GFLOP/img-train): SSD-512's backbone runs at 512^2 = 5.2x the
+   224^2 pixel count (~21 GFLOP fwd) plus extras and 3x3 heads
+   (~3.5 GFLOP), so one train step is ~73 GFLOP/img; a pipeline that
+   KEPT ResNet-class MXU efficiency would sustain 2500 * 12.3/73 ~= 420
+   images/sec/chip. This is an upper bound on the reference: it assumes
+   zero efficiency loss from the multi-scale heads, target matching,
+   and the uneven feature-map shapes.
+2. Published-ratio check: GluonCV's training speed tables put
+   classification ResNet-50 and SSD-512-resnet50 on the same 8xV100
+   hardware at a per-GPU throughput ratio of roughly 6-6.5:1 (their
+   SSD-512 logs train at ~1/6.3 the img/s of their ResNet-50 runs).
+   Applying that empirical pipeline-efficiency ratio to the 2500
+   anchor gives 2500/6.3 ~= 395 img/s A100-class.
+
+We keep the HIGHER (more conservative, harder-to-beat) 420 as the
+vs_baseline denominator; the ratio-derived ~395 brackets it from
+below, so a measured >=1.0x here clears the reference under either
+derivation.
 
 Off by default in bench.py's driver line; enable with BENCH_DET=1
 (VERDICT r3 item 7). Standalone: `python bench_det.py` prints ONE JSON
@@ -93,7 +109,8 @@ def build_step(batch, input_size=512):
 BASELINE_RCNN_IMG_S = 270.0
 
 
-def build_rcnn_step(batch, input_size=512, return_parts=False):
+def build_rcnn_step(batch, input_size=512, return_parts=False,
+                    unroll=1):
     """Full two-stage train step in ONE jitted program: backbone+RPN,
     proposal generation (static-k top-k + NMS), target sampling, RoIAlign
     head, RPN + RCNN losses. The reference runs this as a Python training
@@ -186,7 +203,8 @@ def build_rcnn_step(batch, input_size=512, return_parts=False):
     from bench_util import make_sgd_step
     # lr 1e-3: the two-stage loss sees a SHIFTING proposal distribution
     # every step (rois follow the RPN), so the SSD bench's 0.01 oscillates
-    step = make_sgd_step(loss_fn, aux_idx, lr=1e-3, mu=0.9)
+    step = make_sgd_step(loss_fn, aux_idx, lr=1e-3, mu=0.9,
+                         unroll=unroll)
     mom = [jnp.zeros_like(p) for p in params]
     data = (x._data, gt._data, rpn_cls_t, rpn_box_t, rpn_box_m)
     if return_parts:
@@ -195,18 +213,30 @@ def build_rcnn_step(batch, input_size=512, return_parts=False):
 
 
 def _measure_rcnn(batch, steps, input_size):
-    step, params, mom, data = build_rcnn_step(batch, input_size)
+    # perf lever (BENCH_DET_RCNN_UNROLL=k): k steps per dispatch, the
+    # SSD/ResNet amortisation. Resolved HERE only — the convergence and
+    # profile tools reuse build_rcnn_step and must keep 1 step = 1 step.
+    unroll = max(1, int(os.environ.get("BENCH_DET_RCNN_UNROLL", "1")))
+    step, params, mom, data = build_rcnn_step(batch, input_size,
+                                              unroll=unroll)
     from bench_util import timed_measure
-    return timed_measure(step, params, mom, data, steps, batch,
+    return timed_measure(step, params, mom, data, steps, batch * unroll,
                          tag=f"bench_rcnn b{batch}")
 
 
 def measure_rcnn(batch=None, steps=None, on_result=None):
     """Faster-RCNN-resnet50 train img/s (BASELINE config 5's second half).
-    Denominator derivation: backbone-dominated like SSD (~75 GFLOP/img
-    train at 512^2) but the proposal/NMS/RoIAlign stage is gather-bound,
-    not MXU-bound — GluonCV's published SSD:FRCNN throughput ratio is
-    ~1.6:1, so 420/1.6 ~= 270 img/s is the A100-class number."""
+
+    Denominator (BASELINE_RCNN_IMG_S = 270), defended: the backbone cost
+    matches SSD's (~75 GFLOP/img train at 512^2) but the two-stage extra
+    (proposal top-k/NMS, per-image target sampling, RoIAlign, the
+    per-roi head) is gather/sort-bound, not MXU-bound. GluonCV's
+    training-speed tables put SSD-512 and Faster-RCNN-resnet50 (1x,
+    ~600-800px) at a per-GPU throughput ratio around 1.6-2:1 on the
+    same V100 hardware. Dividing the (itself conservative) SSD
+    denominator by the FAVOURABLE end of that ratio gives 420/1.6 ~=
+    270; the 2:1 end would give 210. As with SSD we keep the higher
+    number, so >=1.0x here clears the reference under either reading."""
     import jax
 
     on_tpu = jax.default_backend() == "tpu"

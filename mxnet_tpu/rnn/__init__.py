@@ -5,5 +5,7 @@ from .io import *            # noqa: F401,F403
 from .io import __all__ as _io_all
 from .rnn_cell import *      # noqa: F401,F403
 from .rnn_cell import __all__ as _cell_all
+from .rnn import *           # noqa: F401,F403
+from .rnn import __all__ as _rnn_all
 
-__all__ = list(_io_all) + list(_cell_all)
+__all__ = list(_io_all) + list(_cell_all) + list(_rnn_all)

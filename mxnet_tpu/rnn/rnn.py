@@ -1,0 +1,42 @@
+"""RNN checkpoint helpers (reference: python/mxnet/rnn/rnn.py).
+
+Upstream converts between the fused cuDNN parameter blob and per-matrix
+weights here (unpack/pack around every save/load). On TPU the fused
+``sym.RNN`` node already binds the per-matrix names (rnn_cell.py), so
+pack/unpack are identity — these wrappers keep the reference's API and
+calling convention so classic training scripts port unchanged."""
+from __future__ import annotations
+
+from ..base import _as_list
+from ..checkpoint import load_checkpoint, save_checkpoint
+
+__all__ = ["save_rnn_checkpoint", "load_rnn_checkpoint",
+           "do_rnn_checkpoint"]
+
+
+def save_rnn_checkpoint(cells, prefix, epoch, symbol, arg_params,
+                        aux_params):
+    """reference: rnn.save_rnn_checkpoint — save with cell weights in
+    the unfused (per-matrix) layout."""
+    args = dict(arg_params)
+    for cell in _as_list(cells):
+        args = cell.unpack_weights(args)
+    save_checkpoint(prefix, epoch, symbol, args, aux_params)
+
+
+def load_rnn_checkpoint(cells, prefix, epoch):
+    """reference: rnn.load_rnn_checkpoint."""
+    sym, args, aux = load_checkpoint(prefix, epoch)
+    for cell in _as_list(cells):
+        args = cell.pack_weights(args)
+    return sym, args, aux
+
+
+def do_rnn_checkpoint(cells, prefix, period=1):
+    """Epoch-end callback (reference: rnn.do_rnn_checkpoint)."""
+    period = int(max(1, period))
+
+    def _callback(iter_no, sym=None, arg=None, aux=None):
+        if (iter_no + 1) % period == 0:
+            save_rnn_checkpoint(cells, prefix, iter_no + 1, sym, arg, aux)
+    return _callback

@@ -134,6 +134,17 @@ class BaseRNNCell:
             name = f"{self._prefix}begin_state_{self._init_counter}"
             shape = tuple(info["shape"])
             if func is not None:
+                if 0 in shape and batch_size:
+                    shape = tuple(batch_size if s == 0 else s
+                                  for s in shape)
+                elif 0 in shape:
+                    # upstream's func=sym.zeros with shape=(0, H) relies
+                    # on nnvm back-inferring the 0 batch dim; here a
+                    # 0-dim would silently build EMPTY state arrays
+                    raise MXNetError(
+                        "begin_state(func=...) needs batch_size= (the "
+                        "0-batch back-inference is an nnvm feature; "
+                        "XLA shapes are concrete)")
                 states.append(func(name=name, shape=shape, **kwargs))
             elif like is not None:
                 states.append(_make("_rnn_zero_state", [like],

@@ -304,3 +304,39 @@ def test_zoneout_inference_expectation():
     np.testing.assert_allclose(y[:, 0], (1 - z) * yp[:, 0], atol=1e-5)
     np.testing.assert_allclose(
         y[:, 1], (1 - z) * yp[:, 1] + z * y[:, 0], atol=1e-5)
+
+
+def test_rnn_checkpoint_helpers(tmp_path):
+    """save/load_rnn_checkpoint + do_rnn_checkpoint (reference:
+    rnn/rnn.py) round-trip the cell weights."""
+    rs = np.random.RandomState(10)
+    x = nd.array(rs.randn(N, T, I).astype(np.float32))
+    cell = mx.rnn.LSTMCell(num_hidden=H, prefix="ck_")
+    out, _ = cell.unroll(T, inputs=sym.Variable("data"),
+                         merge_outputs=True)
+    shapes, _, _ = out.infer_shape(data=(N, T, I))
+    args = {n: nd.array(rs.randn(*s).astype(np.float32) * 0.1)
+            for n, s in zip(out.list_arguments(), shapes) if n != "data"}
+    prefix = str(tmp_path / "lm")
+    mx.rnn.save_rnn_checkpoint(cell, prefix, 3, out, args, {})
+    s2, args2, aux2 = mx.rnn.load_rnn_checkpoint(cell, prefix, 3)
+    assert sorted(args2) == sorted(args)
+    for k in args:
+        np.testing.assert_allclose(args2[k].asnumpy(), args[k].asnumpy())
+    # the callback form saves on the period
+    cb = mx.rnn.do_rnn_checkpoint(cell, prefix, period=2)
+    cb(1, out, args, {})          # epoch 1 -> (1+1) % 2 == 0 -> saves
+    s3, args3, _ = mx.rnn.load_rnn_checkpoint(cell, prefix, 2)
+    assert sorted(args3) == sorted(args)
+
+
+def test_begin_state_func_contract():
+    """func=sym.zeros works with batch_size; the upstream 0-batch idiom
+    raises a helpful error instead of silently building empty states."""
+    cell = mx.rnn.GRUCell(num_hidden=H, prefix="f_")
+    states = cell.begin_state(func=sym.zeros, batch_size=3)
+    v = states[0].bind(mx.cpu(), {}).forward()[0].asnumpy()
+    assert v.shape == (3, H) and (v == 0).all()
+    cell.reset()
+    with pytest.raises(mx.base.MXNetError):
+        cell.begin_state(func=sym.zeros)

@@ -48,6 +48,8 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
     || continue
   run_task det_rcnn_roimm 900 env BENCH_DET_RCNN=1 MXTPU_ROIALIGN=mm \
     python bench_det.py || continue
+  run_task det_rcnn_unroll4 900 env BENCH_DET_RCNN=1 \
+    BENCH_DET_RCNN_UNROLL=4 python bench_det.py || continue
   # 5. conv1x1+BN epilogue per-shape sweep (VERDICT item 3)
   run_task convbn_sweep 900 python tools/probe_fused_convbn.py || continue
   # 6. detection convergence evidence (VERDICT item 8)
