@@ -6,7 +6,8 @@ import time
 from collections import namedtuple
 
 __all__ = ["Speedometer", "do_checkpoint", "log_train_metric",
-           "ProgressBar", "BatchEndParam", "LogValidationMetricsCallback"]
+           "ProgressBar", "BatchEndParam", "LogValidationMetricsCallback",
+           "module_checkpoint"]
 
 # callback payload contract (reference: model.py BatchEndParam; defined
 # here so module.py can use it without importing the legacy model module)
@@ -70,6 +71,17 @@ def log_train_metric(period, auto_reset=False):
                          param.nbatch, msg)
             if auto_reset:
                 param.eval_metric.reset()
+    return _callback
+
+
+def module_checkpoint(mod, prefix, period=1, save_optimizer_states=False):
+    """Epoch-end callback saving a Module's checkpoint (reference:
+    callback.module_checkpoint)."""
+    period = int(max(1, period))
+
+    def _callback(epoch, sym=None, arg=None, aux=None):
+        if (epoch + 1) % period == 0:
+            mod.save_checkpoint(prefix, epoch + 1, save_optimizer_states)
     return _callback
 
 

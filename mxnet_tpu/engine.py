@@ -278,3 +278,23 @@ def clear_error():
 def wait_for_all_timeout(timeout_ms):
     """Bounded drain: 0 = drained, 1 = stall/deadlock suspected."""
     return _get().wait_for_all_timeout(timeout_ms)
+
+
+class bulk:
+    """Bulk-execution scope (reference: mxnet.engine.bulk): upstream
+    batches `size` engine ops into one dependency-graph segment and
+    restores the previous bulk size on exit — it never synchronizes.
+    Here op fusion is XLA's job and the host-side engine already batches
+    per dispatch, so the scope is ordering-neutral by construction (the
+    engine's var dependency tracking already gives in-scope ops their
+    order); no drain on exit, matching the reference's non-blocking
+    contract."""
+
+    def __init__(self, size=15):
+        self.size = int(size)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False

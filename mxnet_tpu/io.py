@@ -16,7 +16,8 @@ from .base import MXNetError, _as_list
 from .ndarray.ndarray import NDArray, array
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
-           "PrefetchingIter", "ImageRecordIter", "CSVIter", "LibSVMIter"]
+           "PrefetchingIter", "ImageRecordIter", "CSVIter", "LibSVMIter",
+           "MNISTIter"]
 
 DataDesc = namedtuple("DataDesc", ["name", "shape"])
 
@@ -395,6 +396,69 @@ class LibSVMIter(DataIter):
         if lab.ndim == 2 and lab.shape[1] == 1:
             lab = lab[:, 0]
         self._inner = NDArrayIter(data, lab, batch_size)
+
+    @property
+    def provide_data(self):
+        return self._inner.provide_data
+
+    @property
+    def provide_label(self):
+        return self._inner.provide_label
+
+    def reset(self):
+        self._inner.reset()
+
+    def next(self):
+        return self._inner.next()
+
+
+class MNISTIter(DataIter):
+    """idx-format MNIST reader (reference: io.MNISTIter / iter_mnist.cc).
+
+    `image`/`label` point at idx files (idx3-ubyte images, idx1-ubyte
+    labels; .gz accepted). flat=True yields (N, 784) instead of
+    (N, 1, 28, 28); images scale to [0, 1) like the reference's
+    default input_shape path."""
+
+    def __init__(self, image, label, batch_size=128, shuffle=False,
+                 flat=False, seed=0, **kwargs):
+        super().__init__(batch_size)
+        imgs = self._read_idx(image, magic=2051)
+        labs = self._read_idx(label, magic=2049)
+        if len(imgs) != len(labs):
+            raise MXNetError(f"MNISTIter: {len(imgs)} images vs "
+                             f"{len(labs)} labels")
+        data = imgs.astype(np.float32) / 255.0
+        data = data.reshape(len(data), -1) if flat \
+            else data.reshape(len(data), 1, *imgs.shape[1:])
+        if shuffle:
+            order = np.random.RandomState(seed).permutation(len(data))
+            data, labs = data[order], labs[order]
+        self._inner = NDArrayIter(data, labs.astype(np.float32),
+                                  batch_size)
+
+    @staticmethod
+    def _read_idx(path, magic):
+        import gzip
+        import struct
+        opener = gzip.open if str(path).endswith(".gz") else open
+        with opener(path, "rb") as f:
+            raw = f.read()
+        try:
+            got_magic, = struct.unpack(">i", raw[:4])
+        except struct.error as e:
+            raise MXNetError(f"MNISTIter: {path} truncated ({e})") from e
+        if got_magic != magic:
+            raise MXNetError(f"MNISTIter: {path} has magic {got_magic}, "
+                             f"expected {magic} (idx format)")
+        ndim = got_magic % 256
+        try:
+            dims = struct.unpack(f">{ndim}i", raw[4:4 + 4 * ndim])
+            return np.frombuffer(raw[4 + 4 * ndim:],
+                                 np.uint8).reshape(dims)
+        except (struct.error, ValueError) as e:
+            raise MXNetError(
+                f"MNISTIter: {path} inconsistent idx payload ({e})") from e
 
     @property
     def provide_data(self):

@@ -171,6 +171,40 @@ MAERegressionOutput = _head("MAERegressionOutput")
 LogisticRegressionOutput = _head("LogisticRegressionOutput")
 
 
+import functools as _functools
+
+
+@_functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
+def _svm_op(x, y, margin, reg, linear):
+    return x
+
+
+def _svm_fwd(x, y, margin, reg, linear):
+    return x, (x, y)
+
+
+def _svm_bwd(margin, reg, linear, res, g):
+    x, y = res
+    iy = y.astype(jnp.int32)
+    oh = jax.nn.one_hot(iy, x.shape[-1], dtype=x.dtype)
+    viol = (margin - (2 * oh - 1) * x) > 0   # margin violated per class
+    if linear:
+        gx = jnp.where(viol, -(2 * oh - 1) * reg, 0.0)
+    else:
+        gx = jnp.where(viol, -2 * (margin - (2 * oh - 1) * x)
+                       * (2 * oh - 1) * reg, 0.0)
+    return (gx.astype(x.dtype), jnp.zeros(y.shape, y.dtype))
+
+
+_svm_op.defvjp(_svm_fwd, _svm_bwd)
+
+
+def svm_output_k(x, y, margin=1.0, reg=1.0, linear=False):
+    """Raw-array SVMOutput core (identity fwd, hinge bwd) shared by the
+    nd wrapper below and the sym registration."""
+    return _svm_op(x, y, float(margin), float(reg), bool(linear))
+
+
 def SVMOutput(data, label=None, margin=1.0, regularization_coefficient=1.0,
               use_linear=False, **kw):
     """Reference SVMOutput (src/operator/svm_output.cc): forward is the
@@ -178,34 +212,9 @@ def SVMOutput(data, label=None, margin=1.0, regularization_coefficient=1.0,
     class margin."""
     if label is None:
         return _apply(lambda x: x, [data])
-
-    import functools
-
-    @functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
-    def op(x, y, margin, reg, linear):
-        return x
-
-    def fwd(x, y, margin, reg, linear):
-        return x, (x, y)
-
-    def bwd(margin, reg, linear, res, g):
-        x, y = res
-        iy = y.astype(jnp.int32)
-        oh = jax.nn.one_hot(iy, x.shape[-1], dtype=x.dtype)
-        score_y = jnp.take_along_axis(x, iy[:, None], -1)
-        viol = (margin - (2 * oh - 1) * x) > 0   # margin violated per class
-        if linear:
-            gx = jnp.where(viol, -(2 * oh - 1) * reg, 0.0)
-        else:
-            gx = jnp.where(viol, -2 * (margin - (2 * oh - 1) * x)
-                           * (2 * oh - 1) * reg, 0.0)
-        del score_y
-        return (gx.astype(x.dtype), jnp.zeros(y.shape, y.dtype))
-
-    op.defvjp(fwd, bwd)
-    return _apply(lambda x, y: op(x, y, float(margin),
-                                  float(regularization_coefficient),
-                                  bool(use_linear)), [data, label])
+    return _apply(lambda x, y: svm_output_k(
+        x, y, margin, regularization_coefficient, use_linear),
+        [data, label])
 
 
 # ---------------------------------------------------------------- im2col

@@ -33,7 +33,8 @@ __all__ = [
     "argmin", "sum_axis", "max_axis", "min_axis",
     # shape
     "reshape", "reshape_like", "flatten", "transpose", "expand_dims", "squeeze",
-    "concat", "concatenate", "stack", "split", "tile", "repeat", "pad",
+    "concat", "concatenate", "stack", "split", "split_v2", "tile",
+    "repeat", "pad", "masked_softmax", "cast_storage",
     "slice", "slice_axis", "slice_like", "flip", "reverse", "swapaxes",
     "depth_to_space", "space_to_depth",
     # indexing / selection
@@ -253,6 +254,58 @@ def split(data, num_outputs, axis=1, squeeze_axis=False):
         return tuple(parts)
     out = _apply(fn, [data], n_out=num_outputs)
     return list(out) if isinstance(out, tuple) else [out]
+
+
+def split_v2(data, indices_or_sections, axis=0, squeeze_axis=False):
+    """numpy-style split (reference: split_v2 / _split_v2): an int means
+    equal sections, a tuple means split points along `axis`."""
+    if isinstance(indices_or_sections, int):
+        n_out = indices_or_sections
+    else:
+        indices_or_sections = tuple(int(i) for i in indices_or_sections)
+        n_out = len(indices_or_sections) + 1
+
+    def fn(a, _s=indices_or_sections, _ax=axis, _sq=squeeze_axis):
+        parts = jnp.split(a, _s, _ax)
+        if _sq:
+            parts = [jnp.squeeze(p, _ax) for p in parts]
+        return tuple(parts)
+    out = _apply(fn, [data], n_out=n_out)
+    return list(out) if isinstance(out, tuple) else [out]
+
+
+def masked_softmax_k(x, m, axis=-1, temperature=1.0):
+    """The ONE masked-softmax kernel (raw arrays) — shared by the nd
+    wrapper below and the sym registration (symbol/ops.py)."""
+    neg = jnp.finfo(x.dtype if jnp.issubdtype(x.dtype, jnp.floating)
+                    else jnp.float32).min
+    z = jnp.where(m.astype(bool), x / temperature, neg)
+    out = jax.nn.softmax(z, axis=axis)
+    return jnp.where(m.astype(bool), out, 0.0).astype(x.dtype)
+
+
+def masked_softmax(data, mask, axis=-1, temperature=1.0):
+    """Softmax over `axis` with masked-off positions getting exactly 0
+    probability (reference: masked_softmax, src/operator/nn/softmax.cc)."""
+    return _apply(lambda x, m: masked_softmax_k(x, m, axis, temperature),
+                  [data, _lift(mask)])
+
+
+def cast_storage(data, stype="default"):
+    """Storage-type cast (reference: cast_storage op). 'default' is the
+    identity; 'row_sparse'/'csr' build the documented-divergence sparse
+    containers (dense-backed on TPU — ndarray/sparse.py)."""
+    if stype == "default":
+        if hasattr(data, "tostype"):
+            return data.tostype("default")
+        return _apply(lambda a: a, [data])
+    if stype in ("row_sparse", "csr"):
+        from ..ndarray import sparse as _sparse
+        dense = data.asnumpy()
+        return (_sparse.row_sparse_array(dense) if stype == "row_sparse"
+                else _sparse.csr_matrix(dense))
+    from ..base import MXNetError
+    raise MXNetError(f"cast_storage: unknown stype {stype!r}")
 
 
 def tile(data, reps):

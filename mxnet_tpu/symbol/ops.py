@@ -11,7 +11,7 @@ import jax.numpy as jnp
 
 import numpy as _np
 
-from ..base import MXNetError
+from ..base import MXNetError, _np_dtype
 from ..ops import nn_ops as K
 from .symbol import (Symbol, _make, register_aux_slots, register_op,
                      register_shape_rule, register_train_op)
@@ -984,3 +984,149 @@ def where(condition, x, y, name=None):
 def _dynamic_arange(limit, start=0, delta=1, name=None):
     return _make("_dynamic_arange", [limit],
                  {"start": start, "delta": delta}, name=name)
+
+
+# -- indexing/selection mirrors of the nd surface (VERDICT-style probe
+# gaps, round 5): one_hot, topk, pick, gather_nd, slice_like,
+# broadcast_axis, masked_softmax, SVMOutput -------------------------------
+def _one_hot_eval(idx, depth=0, on_value=1.0, off_value=0.0,
+                  dtype=None):
+    oh = jax.nn.one_hot(idx.astype(jnp.int32), int(depth))
+    out = oh * (on_value - off_value) + off_value
+    return out.astype(_np_dtype(dtype) if dtype else jnp.float32)
+
+
+register_op("one_hot", _one_hot_eval)
+
+
+def one_hot(indices, depth, on_value=1.0, off_value=0.0, dtype=None,
+            name=None):
+    return _make("one_hot", [indices],
+                 {"depth": int(depth), "on_value": on_value,
+                  "off_value": off_value, "dtype": dtype}, name=name)
+
+
+def _topk_eval(x, k=1, axis=-1, ret_typ="indices", is_ascend=False):
+    if ret_typ not in ("indices", "value", "both", "mask"):
+        raise MXNetError(f"topk: unknown ret_typ {ret_typ!r}")
+    v = -x if not is_ascend else x
+    vals, idx = jax.lax.top_k(jnp.moveaxis(-v, axis, -1), int(k))
+    # lax.top_k takes the LARGEST of (-v) = smallest of v when ascending
+    if ret_typ == "mask":
+        # same-shape 0/1 mask of the selected entries (reference mode)
+        moved = jnp.moveaxis(x, axis, -1)
+        mask = jnp.zeros_like(moved).at[
+            (*jnp.indices(idx.shape[:-1], sparse=True), idx)].set(1.0)
+        return jnp.moveaxis(mask, -1, axis)
+    vals = jnp.moveaxis(vals if not is_ascend else -vals, -1, axis)
+    idx = jnp.moveaxis(idx, -1, axis)
+    if ret_typ == "value":
+        return vals
+    if ret_typ == "both":
+        return vals, idx.astype(jnp.float32)
+    return idx.astype(jnp.float32)  # reference returns float indices
+
+
+register_op("topk", _topk_eval)
+
+
+def topk(data, k=1, axis=-1, ret_typ="indices", is_ascend=False,
+         name=None):
+    return _make("topk", [data],
+                 {"k": int(k), "axis": axis, "ret_typ": ret_typ,
+                  "is_ascend": bool(is_ascend)}, name=name,
+                 n_out=2 if ret_typ == "both" else 1)
+
+
+register_op("pick",
+            lambda x, i, axis=-1, keepdims=False:
+            (jnp.take_along_axis(x, i.astype(jnp.int32)[..., None]
+                                 if i.ndim == x.ndim - 1 else
+                                 i.astype(jnp.int32), axis)
+             if keepdims else
+             jnp.squeeze(jnp.take_along_axis(
+                 x, i.astype(jnp.int32)[..., None]
+                 if i.ndim == x.ndim - 1 else i.astype(jnp.int32),
+                 axis), axis)))
+
+
+def pick(data, index, axis=-1, keepdims=False, name=None):
+    return _make("pick", [data, index],
+                 {"axis": axis, "keepdims": bool(keepdims)}, name=name)
+
+
+register_op("gather_nd",
+            lambda a, i: a[tuple(i.astype(jnp.int32))])
+
+
+def gather_nd(data, indices, name=None):
+    return _make("gather_nd", [data, indices], {}, name=name)
+
+
+def _slice_like_eval(a, b, axes=None):
+    import builtins
+    axes_ = axes if axes else tuple(range(b.ndim))
+    idx = [builtins.slice(None)] * a.ndim
+    for ax in axes_:
+        idx[ax] = builtins.slice(0, b.shape[ax])
+    return a[tuple(idx)]
+
+
+register_op("slice_like", _slice_like_eval)
+
+
+def slice_like(data, shape_like, axes=None, name=None):
+    return _make("slice_like", [data, shape_like],
+                 {"axes": tuple(axes) if axes else None}, name=name)
+
+
+def _broadcast_axis_eval(a, axis=0, size=1):
+    axes = axis if isinstance(axis, (list, tuple)) else [axis]
+    sizes = size if isinstance(size, (list, tuple)) else [size]
+    shape = list(a.shape)
+    for ax, s in zip(axes, sizes):
+        shape[ax] = s
+    return jnp.broadcast_to(a, tuple(shape))
+
+
+register_op("broadcast_axis", _broadcast_axis_eval)
+
+
+def broadcast_axis(data, axis=0, size=1, name=None):
+    return _make("broadcast_axis", [data],
+                 {"axis": axis, "size": size}, name=name)
+
+
+from ..ops.tensor_ops import masked_softmax_k as _masked_softmax_k
+
+register_op("masked_softmax", _masked_softmax_k)
+
+
+def masked_softmax(data, mask, axis=-1, temperature=1.0, name=None):
+    """reference: masked_softmax (softmax.cc) — masked-off positions get
+    exactly 0 probability."""
+    return _make("masked_softmax", [data, mask],
+                 {"axis": axis, "temperature": temperature}, name=name)
+
+
+from ..ops.compat_ops import svm_output_k as _svm_k
+
+register_op("SVMOutput", lambda x, y=None, margin=1.0,
+            regularization_coefficient=1.0, use_linear=False:
+            x if y is None else _svm_k(
+                x, y, margin, regularization_coefficient, use_linear))
+
+
+def SVMOutput(data, label=None, margin=1.0,
+              regularization_coefficient=1.0, use_linear=False,
+              name=None, **kw):
+    """reference: svm_output.cc — identity forward, hinge-loss backward."""
+    ins = [data] if label is None else [data, label]
+    return _make("SVMOutput", ins,
+                 {"margin": margin,
+                  "regularization_coefficient": regularization_coefficient,
+                  "use_linear": use_linear}, name=name)
+
+
+__all__ += ["one_hot", "topk", "pick", "gather_nd", "slice_like",
+            "broadcast_axis", "masked_softmax", "SVMOutput"]

@@ -7,7 +7,7 @@ whether flipped via mx.util or mx.npx.
 from __future__ import annotations
 
 __all__ = ["waitall", "is_np_array", "is_np_shape", "set_np", "reset_np",
-           "use_np"]
+           "use_np", "set_module"]
 
 
 def waitall():
@@ -38,3 +38,13 @@ def reset_np():
 def use_np(func):
     from . import numpy_extension as npx
     return npx.use_np(func)
+
+
+def set_module(module):
+    """Decorator overriding `__module__` for nicer reprs/docs (reference:
+    python/mxnet/util.py set_module)."""
+    def decorator(obj):
+        if module is not None:
+            obj.__module__ = module
+        return obj
+    return decorator

@@ -424,3 +424,178 @@ def test_contrib_nlp_ops_hybridize():
     net.hybridize()
     hyb = net(x).asnumpy()
     np.testing.assert_allclose(eager, hyb, rtol=1e-5, atol=1e-6)
+
+
+# ---- round-5 probe-gap surface: masked_softmax, split_v2, cast_storage,
+# sym mirrors (one_hot/topk/pick/gather_nd/slice_like/broadcast_axis/
+# SVMOutput), io.MNISTIter, util.set_module, engine.bulk,
+# callback.module_checkpoint --------------------------------------------
+def test_masked_softmax_nd_and_sym():
+    rs = np.random.RandomState(0)
+    x = nd.array(rs.randn(3, 5).astype(np.float32))
+    m = nd.array((np.arange(5) < 3).astype(np.float32))
+    out = nd.masked_softmax(x, m).asnumpy()
+    assert np.allclose(out[:, 3:], 0)
+    np.testing.assert_allclose(out.sum(-1), 1.0, atol=1e-5)
+    ref = np.exp(x.asnumpy()[:, :3])
+    ref /= ref.sum(-1, keepdims=True)
+    np.testing.assert_allclose(out[:, :3], ref, atol=1e-5)
+    s = sym.masked_softmax(sym.Variable("x"), sym.Variable("m"))
+    got = mx.sym.load_json(s.tojson()).bind(
+        mx.cpu(), {"x": x, "m": m}).forward()[0].asnumpy()
+    np.testing.assert_allclose(got, out, atol=1e-6)
+
+
+def test_split_v2_sections_and_indices():
+    x = nd.array(np.arange(12, dtype=np.float32).reshape(2, 6))
+    eq = nd.split_v2(x, 3, axis=1)
+    assert [p.shape for p in eq] == [(2, 2)] * 3
+    at = nd.split_v2(x, (2, 5), axis=1)
+    assert [p.shape[1] for p in at] == [2, 3, 1]
+    np.testing.assert_allclose(at[1].asnumpy(), x.asnumpy()[:, 2:5])
+
+
+def test_cast_storage_contract():
+    x = nd.array(np.eye(3, dtype=np.float32))
+    same = nd.cast_storage(x, "default")
+    np.testing.assert_allclose(same.asnumpy(), x.asnumpy())
+    import warnings
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")   # documented dense divergence
+        rsp = nd.cast_storage(x, "row_sparse")
+    np.testing.assert_allclose(rsp.asnumpy(), x.asnumpy())
+    with pytest.raises(mx.base.MXNetError):
+        nd.cast_storage(x, "bogus")
+
+
+def test_sym_indexing_mirrors_match_nd():
+    rs = np.random.RandomState(1)
+    x = nd.array(rs.randn(4, 6).astype(np.float32))
+    # topk both + value parity vs numpy
+    tk = sym.topk(sym.Variable("x"), k=3, ret_typ="both")
+    vals, idx = mx.sym.load_json(tk.tojson()).bind(
+        mx.cpu(), {"x": x}).forward()
+    ref = np.sort(x.asnumpy(), -1)[:, ::-1][:, :3]
+    np.testing.assert_allclose(vals.asnumpy(), ref, atol=1e-6)
+    # pick matches take_along_axis
+    i = nd.array(np.array([0, 2, 5, 1], np.float32))
+    pk = sym.pick(sym.Variable("x"), sym.Variable("i"))
+    got = pk.bind(mx.cpu(), {"x": x, "i": i}).forward()[0].asnumpy()
+    want = np.take_along_axis(x.asnumpy(),
+                              i.asnumpy().astype(int)[:, None], -1)[:, 0]
+    np.testing.assert_allclose(got, want)
+    # gather_nd
+    g = sym.gather_nd(sym.Variable("x"), sym.Variable("i2"))
+    i2 = nd.array(np.array([[0, 3], [1, 2]], np.float32))
+    got = g.bind(mx.cpu(), {"x": x, "i2": i2}).forward()[0].asnumpy()
+    np.testing.assert_allclose(got, x.asnumpy()[[0, 3], [1, 2]])
+    # slice_like + broadcast_axis
+    sl = sym.slice_like(sym.Variable("x"), sym.Variable("y"), axes=(1,))
+    y = nd.zeros((9, 4))
+    assert sl.bind(mx.cpu(), {"x": x, "y": y}).forward()[0].shape == (4, 4)
+    ba = sym.broadcast_axis(sym.Variable("z"), axis=0, size=5)
+    z = nd.ones((1, 3))
+    assert ba.bind(mx.cpu(), {"z": z}).forward()[0].shape == (5, 3)
+    # one_hot on/off values
+    oh = sym.one_hot(sym.Variable("i"), depth=3, on_value=2.0,
+                     off_value=-1.0)
+    got = oh.bind(mx.cpu(), {"i": nd.array([1.0])}).forward()[0].asnumpy()
+    np.testing.assert_allclose(got, [[-1.0, 2.0, -1.0]])
+
+
+def test_sym_svm_output_backward():
+    """SVMOutput: identity forward; hinge gradient on backward (matches
+    the nd compat op, which is closed-form pinned elsewhere)."""
+    rs = np.random.RandomState(2)
+    xv = nd.array(rs.randn(4, 3).astype(np.float32))
+    yv = nd.array(np.array([0, 1, 2, 0], np.float32))
+    s = sym.SVMOutput(sym.Variable("x"), sym.Variable("y"), margin=1.0)
+    ex = s.bind(mx.cpu(), {"x": xv, "y": yv},
+                args_grad={"x": nd.zeros(xv.shape)})
+    out = ex.forward(is_train=True)[0]
+    np.testing.assert_allclose(out.asnumpy(), xv.asnumpy())
+    ex.backward(nd.ones(xv.shape))
+    g_sym = ex.grad_dict["x"].asnumpy()
+    from mxnet_tpu.ops.compat_ops import SVMOutput as nd_svm
+    from mxnet_tpu import autograd
+    x2 = nd.array(xv.asnumpy())
+    x2.attach_grad()
+    with autograd.record():
+        o = nd_svm(x2, yv)
+    o.backward(nd.ones(o.shape))
+    np.testing.assert_allclose(g_sym, x2.grad.asnumpy(), atol=1e-6)
+
+
+def test_mnist_iter_reads_idx(tmp_path):
+    import struct
+    rs = np.random.RandomState(3)
+    imgs = rs.randint(0, 256, (10, 28, 28)).astype(np.uint8)
+    labs = rs.randint(0, 10, 10).astype(np.uint8)
+    ip = tmp_path / "imgs-idx3-ubyte"
+    lp = tmp_path / "labs-idx1-ubyte"
+    ip.write_bytes(struct.pack(">iiii", 2051, 10, 28, 28)
+                   + imgs.tobytes())
+    lp.write_bytes(struct.pack(">ii", 2049, 10) + labs.tobytes())
+    it = mx.io.MNISTIter(image=str(ip), label=str(lp), batch_size=5)
+    b = next(iter(it))
+    assert b.data[0].shape == (5, 1, 28, 28)
+    np.testing.assert_allclose(b.data[0].asnumpy(),
+                               imgs[:5, None] / 255.0, atol=1e-6)
+    np.testing.assert_allclose(b.label[0].asnumpy(), labs[:5])
+    flat = mx.io.MNISTIter(image=str(ip), label=str(lp), batch_size=5,
+                           flat=True)
+    assert next(iter(flat)).data[0].shape == (5, 784)
+    with pytest.raises(mx.base.MXNetError):
+        mx.io.MNISTIter(image=str(lp), label=str(ip), batch_size=5)
+
+
+def test_set_module_and_bulk_and_module_checkpoint(tmp_path):
+    @mx.util.set_module("mxnet_tpu")
+    def f():
+        return 1
+    assert f.__module__ == "mxnet_tpu"
+    with mx.engine.bulk(4):
+        y = nd.ones((2,)) + 1
+    np.testing.assert_allclose(y.asnumpy(), 2)
+    # module_checkpoint saves through the Module
+    from mxnet_tpu.module import Module
+    from mxnet_tpu.io import NDArrayIter
+    net = sym.SoftmaxOutput(
+        sym.FullyConnected(sym.Variable("data"), num_hidden=2, name="fc"),
+        sym.Variable("softmax_label"), name="softmax")
+    it = NDArrayIter({"data": np.zeros((4, 3), np.float32)},
+                     {"softmax_label": np.zeros(4, np.float32)},
+                     batch_size=4)
+    mod = Module(net, data_names=["data"], label_names=["softmax_label"])
+    mod.fit(it, num_epoch=1,
+            epoch_end_callback=mx.callback.module_checkpoint(
+                mod, str(tmp_path / "mc"), period=1))
+    s2, a2, x2 = mx.model.load_checkpoint(str(tmp_path / "mc"), 1)
+    assert "fc_weight" in a2
+
+
+def test_topk_mask_and_one_hot_dtype():
+    """review r5: ret_typ='mask' returns a same-shape 0/1 mask; one_hot
+    honors an explicit dtype; unknown ret_typ raises."""
+    x = nd.array(np.array([[0., 1., 2., 3., 4.]], np.float32))
+    s = sym.topk(sym.Variable("x"), k=2, ret_typ="mask")
+    got = s.bind(mx.cpu(), {"x": x}).forward()[0].asnumpy()
+    np.testing.assert_allclose(got, [[0, 0, 0, 1, 1]])
+    oh = sym.one_hot(sym.Variable("i"), depth=3, dtype="int32")
+    o = oh.bind(mx.cpu(), {"i": nd.array([1.0])}).forward()[0].asnumpy()
+    assert o.dtype == np.int32 and (o == [[0, 1, 0]]).all()
+    with pytest.raises(mx.base.MXNetError):
+        sym.topk(sym.Variable("x"), ret_typ="bogus").bind(
+            mx.cpu(), {"x": x}).forward()
+
+
+def test_mnist_iter_truncated_file_raises(tmp_path):
+    import struct
+    p = tmp_path / "bad"
+    p.write_bytes(b"\x00\x00")                     # truncated header
+    with pytest.raises(mx.base.MXNetError):
+        mx.io.MNISTIter(image=str(p), label=str(p), batch_size=1)
+    q = tmp_path / "short"
+    q.write_bytes(struct.pack(">iiii", 2051, 10, 28, 28) + b"\x00" * 10)
+    with pytest.raises(mx.base.MXNetError):       # payload < header dims
+        mx.io.MNISTIter(image=str(q), label=str(q), batch_size=1)

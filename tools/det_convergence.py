@@ -25,18 +25,38 @@ _COLORS = np.array([[0.9, 0.1, 0.1], [0.1, 0.9, 0.1],
                     [0.15, 0.15, 0.95], [0.9, 0.9, 0.1]], np.float32)
 NUM_CLASSES = len(_COLORS)
 
+# FRCNN scene geometry, shared by train AND held-out eval: boxes sized
+# to overlap the model's stride-16 RPN anchors at small inputs
+RCNN_SCENE_KW = {"m_boxes": 2, "box_range": (0.4, 0.75)}
 
-def make_scenes(n, size, m_boxes=3, seed=0):
+
+def make_scenes(n, size, m_boxes=3, seed=0, box_range=(0.25, 0.5)):
     """Render n scenes of m colored rectangles on noise background.
     Returns images (n, size, size, 3) f32 and labels (n, m, 5)
-    [cls, x1, y1, x2, y2] normalized, -1-padded."""
+    [cls, x1, y1, x2, y2] normalized, -1-padded. box_range scales the
+    rectangles — the FRCNN run uses larger boxes so the planted objects
+    overlap the model's stride-16 RPN anchor sizes at small inputs."""
     rs = np.random.RandomState(seed)
     imgs = rs.uniform(0.3, 0.5, (n, size, size, 3)).astype(np.float32)
     labels = np.full((n, m_boxes, 5), -1.0, np.float32)
     for i in range(n):
+        placed = []
         for j in range(m_boxes):
-            w, h = rs.uniform(0.25, 0.5, 2)
-            x1, y1 = rs.uniform(0.05, 0.95 - w), rs.uniform(0.05, 0.95 - h)
+            # rejection-sample placements so later rectangles cannot
+            # paint over earlier ones (an occluded gt box would count
+            # as a miss in the recall denominator regardless of model
+            # quality); scenes that can't fit another box keep the -1
+            # pad row, which every consumer already skips
+            for _ in range(20):
+                w, h = rs.uniform(box_range[0], box_range[1], 2)
+                x1 = rs.uniform(0.05, 0.95 - w)
+                y1 = rs.uniform(0.05, 0.95 - h)
+                cand = (x1, y1, x1 + w, y1 + h)
+                if all(_iou(cand, p) < 0.1 for p in placed):
+                    break
+            else:
+                continue
+            placed.append(cand)
             c = rs.randint(NUM_CLASSES)
             px1, py1 = int(x1 * size), int(y1 * size)
             px2, py2 = int((x1 + w) * size), int((y1 + h) * size)
@@ -158,7 +178,7 @@ def run_rcnn(args):
     from mxnet_tpu.models.faster_rcnn import FasterRCNN  # for anchors
 
     n_train = batch * 24
-    imgs, labels = make_scenes(n_train, size, seed=0)
+    imgs, labels = make_scenes(n_train, size, seed=0, **RCNN_SCENE_KW)
     # bench_det's step takes (x, gt_pixels, rpn_cls_t, rpn_box_t,
     # rpn_box_m); regenerate those per chunk
     net_like = FasterRCNN(num_classes=20,
@@ -188,7 +208,8 @@ def run_rcnn(args):
                   f"({time.time()-t0:.0f}s)", file=sys.stderr)
     # held-out sanity: after training, the RPN's decoded+NMS'd proposals
     # must cover the planted boxes (recall@IoU0.5) and be finite
-    ev_imgs, ev_labels = make_scenes(batch, size, seed=99)
+    ev_imgs, ev_labels = make_scenes(batch, size, seed=99,
+                                     **RCNN_SCENE_KW)
     ev_gt_px = ev_labels.copy()
     ev_gt_px[..., 1:] *= size
     ev_gt_px[ev_labels[..., 0] < 0] = -1
