@@ -5,12 +5,14 @@ compose via `Compose`. ToTensor converts HWC->CHW float32/255.
 """
 from __future__ import annotations
 
+import builtins
 import numpy as np
 
 from ....ndarray.ndarray import NDArray, array, _apply
 from ...block import Block, HybridBlock
 
-__all__ = ["Compose", "Cast", "ToTensor", "Normalize", "Resize", "CenterCrop",
+__all__ = ["Rotate",
+           "Compose", "Cast", "ToTensor", "Normalize", "Resize", "CenterCrop",
            "RandomResizedCrop", "RandomFlipLeftRight", "RandomFlipTopBottom",
            "RandomCrop", "RandomBrightness", "RandomContrast",
            "RandomSaturation", "RandomHue", "RandomLighting",
@@ -234,6 +236,77 @@ class RandomLighting(Block):
         alpha = _np.random.normal(0, self._alpha, 3).astype(_np.float32)
         rgb = (self._eigvec * alpha * self._eigval).sum(axis=1)
         return x.astype("float32") + array(rgb.reshape(1, 1, 3))
+
+
+class Rotate(Block):
+    """Rotate an (H, W, C) image by a fixed angle in degrees
+    (reference: transforms.Rotate). zoom_in crops to the largest
+    axis-aligned rectangle with no border; zoom_out keeps every source
+    pixel (pads with zeros). Bilinear sampling through the same
+    grid-sample kernel the SpatialTransformer op uses."""
+
+    def __init__(self, rotation_degrees, zoom_in=False, zoom_out=False):
+        super().__init__()
+        if zoom_in and zoom_out:
+            raise ValueError("Rotate: zoom_in and zoom_out are exclusive")
+        self._deg = float(rotation_degrees)
+        self._zoom_in = zoom_in
+        self._zoom_out = zoom_out
+        self._grids = {}    # (h, w) -> sampling grid (angle is fixed)
+
+    def _grid(self, h, w):
+        """Pixel-space rotation grid in the sampler's per-axis [-1, 1]
+        coords — correct for non-square images (normalized-space
+        rotation would shear them)."""
+        import math
+        import numpy as _np
+        if (h, w) in self._grids:
+            return self._grids[(h, w)]
+        rad = math.radians(self._deg)
+        c, s = math.cos(rad), math.sin(rad)
+        ca, sa = builtins.abs(c), builtins.abs(s)
+        zx = zy = 1.0
+        if self._zoom_out:
+            # scale so every source pixel fits in the frame
+            zx = zy = builtins.max((w * ca + h * sa) / w,
+                                   (h * ca + w * sa) / h)
+        elif self._zoom_in:
+            # largest same-aspect rectangle inscribed in the rotation
+            # (the classic inscribed-rect formula)
+            long_s, short_s = builtins.max(w, h), builtins.min(w, h)
+            if short_s <= 2.0 * sa * ca * long_s or                     builtins.abs(sa - ca) < 1e-10:
+                half = 0.5 * short_s
+                wr, hr = (half / sa, half / ca) if w >= h                     else (half / ca, half / sa)
+            else:
+                cos2 = ca * ca - sa * sa
+                wr = (w * ca - h * sa) / cos2
+                hr = (h * ca - w * sa) / cos2
+            zx, zy = wr / w, hr / h
+        # output pixel centres -> rotate in PIXEL units around the centre
+        cy, cx = (h - 1) / 2.0, (w - 1) / 2.0
+        gy, gx = _np.meshgrid(_np.arange(h), _np.arange(w), indexing="ij")
+        px = (gx - cx) * zx
+        py = (gy - cy) * zy
+        sx_pix = c * px - s * py + cx
+        sy_pix = s * px + c * py + cy
+        # per-axis normalization for the [-1, 1] bilinear sampler
+        sx = (2.0 * sx_pix / (w - 1) - 1.0).astype(_np.float32)
+        sy = (2.0 * sy_pix / (h - 1) - 1.0).astype(_np.float32)
+        grid = _np.stack([sx, sy])[None]        # (1, 2, H, W)
+        self._grids[(h, w)] = grid
+        return grid
+
+    def forward(self, x):
+        from ....ops.extra_ops import bilinear_sampler_k
+        from ....ndarray.ndarray import _apply as _ap
+        grid = self._grid(x.shape[0], x.shape[1])
+        import jax.numpy as jnp
+
+        def fn(img):
+            chw = jnp.moveaxis(img.astype(jnp.float32), -1, 0)[None]
+            out = bilinear_sampler_k(chw, jnp.asarray(grid))
+            return jnp.moveaxis(out[0], 0, -1).astype(img.dtype)
+        return _ap(fn, [x])
 
 
 class RandomColorJitter(Block):

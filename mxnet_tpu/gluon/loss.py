@@ -4,6 +4,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from ..base import MXNetError
 from ..ndarray.ndarray import _apply, _lift
 from .block import HybridBlock
 
@@ -11,7 +12,7 @@ __all__ = ["Loss", "L2Loss", "L1Loss", "SigmoidBinaryCrossEntropyLoss",
            "SigmoidBCELoss", "SoftmaxCrossEntropyLoss", "SoftmaxCELoss",
            "KLDivLoss", "HuberLoss", "HingeLoss", "SquaredHingeLoss",
            "LogisticLoss", "TripletLoss", "CTCLoss", "CosineEmbeddingLoss",
-           "PoissonNLLLoss", "GaussianNLLLoss"]
+           "PoissonNLLLoss", "GaussianNLLLoss", "SDMLLoss"]
 
 
 def _reduce(x, weight, sample_weight, batch_axis):
@@ -244,6 +245,41 @@ class CosineEmbeddingLoss(Loss):
                 + 1e-12)
             l = l.reshape(cos.shape)
             return jnp.where(l > 0, 1 - cos, jax.nn.relu(cos - _m))
+        return _apply(fn, ins)
+
+
+class SDMLLoss(Loss):
+    """Smoothed deep metric learning loss (reference: gluon/loss.py
+    SDMLLoss): for paired batches (x1[i] matches x2[i]), cross-entropy
+    between label-smoothed identity targets and the softmax over
+    NEGATIVE pairwise euclidean distances — relative distances learn a
+    retrieval metric without explicit negative mining."""
+
+    def __init__(self, smoothing_parameter=0.3, weight=1.0, batch_axis=0,
+                 **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._smoothing = float(smoothing_parameter)
+
+    def hybrid_forward(self, F, x1, x2, sample_weight=None):
+        ins = [x1, _lift(x2)] + ([sample_weight]
+                                 if sample_weight is not None else [])
+
+        def fn(a, b, *sw, _sm=self._smoothing):
+            n = a.shape[0]
+            if n < 2:
+                raise MXNetError(
+                    "SDMLLoss needs batch >= 2 (the loss contrasts each "
+                    "pair against the rest of the batch; drop the last "
+                    "partial batch or use last_batch_handle='discard')")
+            d = jnp.sqrt(jnp.sum((a[:, None, :] - b[None, :, :]) ** 2,
+                                 -1) + 1e-12)
+            logp = jax.nn.log_softmax(-d, axis=-1)
+            # label smoothing over the off-diagonal
+            target = (jnp.eye(n) * (1.0 - _sm)
+                      + (1.0 - jnp.eye(n)) * _sm / (n - 1))
+            x = -jnp.sum(target * logp, axis=-1)
+            return _reduce(x, self._weight, sw[0] if sw else None,
+                           self._batch_axis)
         return _apply(fn, ins)
 
 

@@ -19,7 +19,7 @@ __all__ = ["imread", "imdecode", "imresize", "resize_short", "fixed_crop",
            "CreateAugmenter", "Augmenter", "ForceResizeAug", "ImageIter",
            "ImageDetIter", "CastAug", "BrightnessJitterAug",
            "ContrastJitterAug", "SaturationJitterAug", "LightingAug",
-           "RandomOrderAug", "color_normalize", "random_size_crop"]
+           "RandomOrderAug", "color_normalize", "random_size_crop", "ColorJitterAug"]
 
 
 def _finish_decode(arr, flag, to_rgb):
@@ -272,6 +272,24 @@ class RandomOrderAug(Augmenter):
         for i in order:
             src = self.ts[i](src)
         return src
+
+
+class ColorJitterAug(RandomOrderAug):
+    """Brightness+contrast+saturation jitter in random order
+    (reference: image.ColorJitterAug)."""
+
+    def __init__(self, brightness=0.0, contrast=0.0, saturation=0.0,
+                 rng=None):
+        ts = []
+        if brightness > 0:
+            ts.append(BrightnessJitterAug(brightness, rng))
+        if contrast > 0:
+            ts.append(ContrastJitterAug(contrast, rng))
+        if saturation > 0:
+            ts.append(SaturationJitterAug(saturation, rng))
+        super().__init__(ts, rng)
+        self._kwargs = {"brightness": brightness, "contrast": contrast,
+                        "saturation": saturation}
 
 
 def color_normalize(src, mean, std=None):

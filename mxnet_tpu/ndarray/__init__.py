@@ -30,6 +30,7 @@ from ..ops.compat_ops import *          # noqa: F401,F403  (classic names)
 from ..random import (uniform, normal, randn, randint, multinomial,
                       exponential, gamma, poisson)
 
+sample_multinomial = multinomial
 sample_uniform = uniform
 sample_normal = normal
 sample_gamma = gamma

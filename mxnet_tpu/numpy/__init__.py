@@ -36,6 +36,7 @@ __all__ = ["ndarray", "array", "asarray", "zeros", "ones", "full", "empty",
            "flip", "roll", "where", "take", "take_along_axis", "sort",
            "argsort", "unique", "nonzero", "dot", "matmul", "tensordot",
            "einsum", "inner", "outer", "trace", "diag", "tril", "triu",
+           "cross", "vander",
            "maximum", "minimum", "clip", "meshgrid", "atleast_1d",
            "atleast_2d", "atleast_3d", "pad", "cumsum", "cumprod",
            "append", "delete", "insert", "ravel",
@@ -438,6 +439,16 @@ def dot(a, b):
 
 def matmul(a, b):
     return _apply(jnp.matmul, [_c(a), _c(b)])
+
+
+def cross(a, b, axis=-1):
+    return _apply(lambda x, y: jnp.cross(x, y, axis=axis),
+                  [_c(a), _c(b)])
+
+
+def vander(x, N=None, increasing=False):
+    return _apply(lambda v: jnp.vander(v, N=N, increasing=increasing),
+                  [_c(x)])
 
 
 def tensordot(a, b, axes=2):

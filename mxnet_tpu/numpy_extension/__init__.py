@@ -31,7 +31,7 @@ __all__ = ["set_np", "reset_np", "is_np_array", "is_np_shape", "np_shape",
            "batch_flatten", "fully_connected", "convolution",
            "pooling", "batch_norm", "layer_norm", "dropout", "embedding",
            "activation", "leaky_relu", "arange_like", "gamma", "sequence_mask",
-           "waitall", "save", "load", "seed"]
+           "waitall", "save", "load", "seed", "rnn"]
 
 class _Flags:
     """Process-global np-mode state (reference parity: one C++ global;
@@ -169,6 +169,14 @@ def convolution(data, weight, bias=None, **kwargs):
 
 def pooling(data, kernel, **kwargs):
     return _apply(lambda a: _nn.pooling(a, kernel, **kwargs), [_npc(data)])
+
+
+def rnn(data, *state_and_params, **kwargs):
+    """Fused multi-layer RNN (reference: npx.rnn over rnn-inl.h) — the
+    same kernel as nd.RNN / sym.RNN, np-array in/out."""
+    from ..ops.compat_ops import RNN as _rnn
+    return _rnn(_npc(data), *[_npc(a) for a in state_and_params],
+                **kwargs)
 
 
 def batch_norm(data, gamma, beta, running_mean, running_var, eps=1e-5,

@@ -8,7 +8,8 @@ from ..ndarray.ndarray import _apply, _lift
 
 __all__ = ["gemm", "gemm2", "potrf", "potri", "trsm", "trmm", "sumlogdiag",
            "syrk", "gelqf", "syevd", "inverse", "det", "slogdet", "cholesky",
-           "qr", "svd", "solve", "norm"]
+           "qr", "svd", "solve", "norm", "extractdiag", "makediag",
+           "extracttrian", "maketrian"]
 
 
 def gemm(A, B, C, alpha=1.0, beta=1.0, transpose_a=False, transpose_b=False):
@@ -123,3 +124,83 @@ def solve(A, B):
 
 def norm(A, ord=2, axis=None, keepdims=False):
     return A.norm(ord=ord, axis=axis, keepdims=keepdims)
+
+
+# -- diagonal / triangle packing (reference: la_op.cc extractdiag /
+# makediag / extracttrian / maketrian) -------------------------------------
+def _trian_indices(n, offset, lower):
+    import numpy as onp
+    return (onp.tril_indices(n, k=offset) if lower
+            else onp.triu_indices(n, k=offset))
+
+
+def _trian_count(n, offset, lower):
+    """#entries in the (lower: tril, upper: triu) triangle at `offset`
+    of an n x n matrix — arithmetic, no index materialisation."""
+    k = offset if lower else -offset
+    # tril(n, k): sum_i clip(i + k + 1, 0, n)
+    if k >= n - 1:
+        return n * n
+    if k < -n:
+        return 0
+    full_rows = max(0, -(k + 1))          # rows contributing 0
+    m = n - full_rows                     # rows with i + k + 1 in [1, n]
+    start = full_rows + k + 1             # count at first contributing row
+    capped = max(0, m - (n - start))      # rows already capped at n
+    ramp = m - capped
+    return start * ramp + ramp * (ramp - 1) // 2 + capped * n
+
+
+def _trian_n_for(length, offset, lower):
+    lo, hi = 1, 1 << 20
+    while lo < hi:
+        mid = (lo + hi) // 2
+        if _trian_count(mid, offset, lower) < length:
+            lo = mid + 1
+        else:
+            hi = mid
+    if _trian_count(lo, offset, lower) != length:
+        raise ValueError(f"maketrian: no matrix size yields a packed "
+                         f"length of {length} at offset {offset}")
+    return lo
+
+
+def extractdiag_k(a, offset=0):
+    return jnp.diagonal(a, offset=offset, axis1=-2, axis2=-1)
+
+
+def makediag_k(v, offset=0):
+    n = v.shape[-1] + abs(int(offset))
+    idx = jnp.arange(v.shape[-1])
+    r = idx + max(0, -offset)
+    c = idx + max(0, offset)
+    out = jnp.zeros(v.shape[:-1] + (n, n), v.dtype)
+    return out.at[..., r, c].set(v)
+
+
+def extracttrian_k(a, offset=0, lower=True):
+    rows, cols = _trian_indices(a.shape[-1], int(offset), bool(lower))
+    return a[..., rows, cols]
+
+
+def maketrian_k(v, offset=0, lower=True):
+    n = _trian_n_for(v.shape[-1], int(offset), bool(lower))
+    rows, cols = _trian_indices(n, int(offset), bool(lower))
+    out = jnp.zeros(v.shape[:-1] + (n, n), v.dtype)
+    return out.at[..., rows, cols].set(v)
+
+
+def extractdiag(A, offset=0):
+    return _apply(lambda a: extractdiag_k(a, int(offset)), [A])
+
+
+def makediag(A, offset=0):
+    return _apply(lambda a: makediag_k(a, int(offset)), [A])
+
+
+def extracttrian(A, offset=0, lower=True):
+    return _apply(lambda a: extracttrian_k(a, offset, lower), [A])
+
+
+def maketrian(A, offset=0, lower=True):
+    return _apply(lambda a: maketrian_k(a, offset, lower), [A])

@@ -32,6 +32,7 @@ __all__ = [
     "Pooling", "Activation", "LeakyReLU", "Dropout", "Embedding",
     "SoftmaxOutput",
     "softmax_nd", "log_softmax_nd", "relu", "sigmoid", "gelu", "silu",
+    "Pooling_v1", "Convolution_v1",
 ]
 
 
@@ -598,3 +599,9 @@ def gelu(data):
 
 def silu(data):
     return _apply(jax.nn.silu, [data])
+
+
+# legacy _v1 spellings (reference: pooling_v1.cc, convolution_v1.cc —
+# identical semantics; upstream kept both op names registered)
+Pooling_v1 = Pooling
+Convolution_v1 = Convolution

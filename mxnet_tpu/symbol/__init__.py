@@ -5,3 +5,5 @@ fromjson = load_json   # reference alias (mx.sym.fromjson)
 from .ops import *   # noqa: F401,F403
 from . import ops
 from . import contrib
+from . import linalg   # mx.sym.linalg.*
+from . import random   # mx.sym.random.*

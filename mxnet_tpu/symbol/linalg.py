@@ -1,0 +1,157 @@
+"""mx.sym.linalg — symbolic mirror of mx.nd.linalg (reference:
+src/operator/tensor/la_op.cc registered under linalg_*).
+
+Each op registers a raw-array kernel (shared with ops/linalg_ops where a
+packing helper exists) so linalg graphs serialize through symbol JSON
+like any other node."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.linalg_ops import (extractdiag_k, extracttrian_k, makediag_k,
+                              maketrian_k)
+from .symbol import _make, register_op
+
+__all__ = ["gemm", "gemm2", "potrf", "potri", "trsm", "trmm", "syrk",
+           "sumlogdiag", "extractdiag", "makediag", "extracttrian",
+           "maketrian", "inverse", "det"]
+
+
+def _gemm_eval(a, b, c, alpha=1.0, beta=1.0, transpose_a=False,
+               transpose_b=False):
+    if transpose_a:
+        a = jnp.swapaxes(a, -1, -2)
+    if transpose_b:
+        b = jnp.swapaxes(b, -1, -2)
+    return alpha * jnp.matmul(a, b) + beta * c
+
+
+def _gemm2_eval(a, b, alpha=1.0, transpose_a=False, transpose_b=False):
+    if transpose_a:
+        a = jnp.swapaxes(a, -1, -2)
+    if transpose_b:
+        b = jnp.swapaxes(b, -1, -2)
+    return alpha * jnp.matmul(a, b)
+
+
+def _trsm_eval(a, b, alpha=1.0, rightside=False, lower=True,
+               transpose=False):
+    if rightside:
+        xt = jax.scipy.linalg.solve_triangular(
+            jnp.swapaxes(a, -1, -2), jnp.swapaxes(alpha * b, -1, -2),
+            lower=not lower if not transpose else lower)
+        return jnp.swapaxes(xt, -1, -2)
+    return jax.scipy.linalg.solve_triangular(a, alpha * b, lower=lower,
+                                             trans=int(transpose))
+
+
+def _trmm_eval(a, b, alpha=1.0, rightside=False, lower=True,
+               transpose=False):
+    tri = jnp.tril(a) if lower else jnp.triu(a)
+    if transpose:
+        tri = jnp.swapaxes(tri, -1, -2)
+    return alpha * (jnp.matmul(b, tri) if rightside
+                    else jnp.matmul(tri, b))
+
+
+def _potri_eval(a):
+    eye = jnp.eye(a.shape[-1], dtype=a.dtype)
+    linv = jax.scipy.linalg.solve_triangular(a, eye, lower=True)
+    return jnp.matmul(jnp.swapaxes(linv, -1, -2), linv)
+
+
+def _syrk_eval(a, alpha=1.0, transpose=False):
+    at = jnp.swapaxes(a, -1, -2)
+    return alpha * (jnp.matmul(at, a) if transpose else jnp.matmul(a, at))
+
+
+register_op("_linalg_gemm", _gemm_eval)
+register_op("_linalg_gemm2", _gemm2_eval)
+register_op("_linalg_potrf", jnp.linalg.cholesky)
+register_op("_linalg_potri", _potri_eval)
+register_op("_linalg_trsm", _trsm_eval)
+register_op("_linalg_trmm", _trmm_eval)
+register_op("_linalg_syrk", _syrk_eval)
+register_op("_linalg_sumlogdiag",
+            lambda a: jnp.sum(jnp.log(jnp.diagonal(a, axis1=-2, axis2=-1)),
+                              axis=-1))
+register_op("_linalg_extractdiag", extractdiag_k)
+register_op("_linalg_makediag", makediag_k)
+register_op("_linalg_extracttrian", extracttrian_k)
+register_op("_linalg_maketrian", maketrian_k)
+register_op("_linalg_inverse", jnp.linalg.inv)
+register_op("_linalg_det", jnp.linalg.det)
+
+
+def gemm(A, B, C, alpha=1.0, beta=1.0, transpose_a=False,
+         transpose_b=False, name=None):
+    return _make("_linalg_gemm", [A, B, C],
+                 {"alpha": alpha, "beta": beta, "transpose_a": transpose_a,
+                  "transpose_b": transpose_b}, name=name)
+
+
+def gemm2(A, B, alpha=1.0, transpose_a=False, transpose_b=False,
+          name=None):
+    return _make("_linalg_gemm2", [A, B],
+                 {"alpha": alpha, "transpose_a": transpose_a,
+                  "transpose_b": transpose_b}, name=name)
+
+
+def potrf(A, name=None):
+    return _make("_linalg_potrf", [A], {}, name=name)
+
+
+def potri(A, name=None):
+    return _make("_linalg_potri", [A], {}, name=name)
+
+
+def trsm(A, B, alpha=1.0, rightside=False, lower=True, transpose=False,
+         name=None):
+    return _make("_linalg_trsm", [A, B],
+                 {"alpha": alpha, "rightside": rightside, "lower": lower,
+                  "transpose": transpose}, name=name)
+
+
+def trmm(A, B, alpha=1.0, rightside=False, lower=True, transpose=False,
+         name=None):
+    return _make("_linalg_trmm", [A, B],
+                 {"alpha": alpha, "rightside": rightside, "lower": lower,
+                  "transpose": transpose}, name=name)
+
+
+def syrk(A, alpha=1.0, transpose=False, name=None):
+    return _make("_linalg_syrk", [A],
+                 {"alpha": alpha, "transpose": transpose}, name=name)
+
+
+def sumlogdiag(A, name=None):
+    return _make("_linalg_sumlogdiag", [A], {}, name=name)
+
+
+def extractdiag(A, offset=0, name=None):
+    return _make("_linalg_extractdiag", [A], {"offset": int(offset)},
+                 name=name)
+
+
+def makediag(A, offset=0, name=None):
+    return _make("_linalg_makediag", [A], {"offset": int(offset)},
+                 name=name)
+
+
+def extracttrian(A, offset=0, lower=True, name=None):
+    return _make("_linalg_extracttrian", [A],
+                 {"offset": int(offset), "lower": bool(lower)}, name=name)
+
+
+def maketrian(A, offset=0, lower=True, name=None):
+    return _make("_linalg_maketrian", [A],
+                 {"offset": int(offset), "lower": bool(lower)}, name=name)
+
+
+def inverse(A, name=None):
+    return _make("_linalg_inverse", [A], {}, name=name)
+
+
+def det(A, name=None):
+    return _make("_linalg_det", [A], {}, name=name)

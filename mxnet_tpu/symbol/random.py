@@ -1,0 +1,89 @@
+"""mx.sym.random — symbolic samplers (reference: symbol/random.py over
+src/operator/random/sample_op.cc).
+
+Training executions draw fresh samples each step through the executor's
+per-node rng threading (the same mechanism as Dropout); inference
+executions are deterministic from the `seed` attr — the XLA-friendly
+reading of the reference's global-seed statefulness (a traced program
+must be pure, so randomness must arrive via a key)."""
+from __future__ import annotations
+
+import jax
+
+from .symbol import _make, register_op, register_train_op
+
+__all__ = ["uniform", "normal", "randint", "gamma", "exponential",
+           "poisson"]
+
+
+def _sampler(draw):
+    def infer_eval(shape=(), seed=0, **kw):
+        return draw(jax.random.PRNGKey(int(seed)), tuple(shape), **kw)
+
+    def train_eval(shape=(), seed=0, _rng=None, **kw):
+        key = _rng if _rng is not None else jax.random.PRNGKey(int(seed))
+        return draw(key, tuple(shape), **kw), {}
+    return infer_eval, train_eval
+
+
+def _reg(name, draw):
+    infer_eval, train_eval = _sampler(draw)
+    register_op(name, infer_eval)
+    register_train_op(name, train_eval)
+
+
+_reg("_random_uniform",
+     lambda key, shape, low=0.0, high=1.0:
+     jax.random.uniform(key, shape, minval=low, maxval=high))
+_reg("_random_normal",
+     lambda key, shape, loc=0.0, scale=1.0:
+     loc + scale * jax.random.normal(key, shape))
+# int32 output, like the reference sample_op
+_reg("_random_randint",
+     lambda key, shape, low=0, high=2:
+     jax.random.randint(key, shape, int(low), int(high)))
+_reg("_random_gamma",
+     lambda key, shape, alpha=1.0, beta=1.0:
+     jax.random.gamma(key, alpha, shape) * beta)
+_reg("_random_exponential",
+     lambda key, shape, lam=1.0:
+     jax.random.exponential(key, shape) / lam)
+_reg("_random_poisson",
+     lambda key, shape, lam=1.0:
+     jax.random.poisson(key, lam, shape).astype("float32"))
+
+
+def uniform(low=0.0, high=1.0, shape=(1,), seed=0, name=None, **kw):
+    return _make("_random_uniform", [],
+                 {"low": low, "high": high, "shape": tuple(shape),
+                  "seed": seed}, name=name)
+
+
+def normal(loc=0.0, scale=1.0, shape=(1,), seed=0, name=None, **kw):
+    return _make("_random_normal", [],
+                 {"loc": loc, "scale": scale, "shape": tuple(shape),
+                  "seed": seed}, name=name)
+
+
+def randint(low, high, shape=(1,), seed=0, name=None, **kw):
+    return _make("_random_randint", [],
+                 {"low": low, "high": high, "shape": tuple(shape),
+                  "seed": seed}, name=name)
+
+
+def gamma(alpha=1.0, beta=1.0, shape=(1,), seed=0, name=None, **kw):
+    return _make("_random_gamma", [],
+                 {"alpha": alpha, "beta": beta, "shape": tuple(shape),
+                  "seed": seed}, name=name)
+
+
+def exponential(lam=1.0, shape=(1,), seed=0, name=None, **kw):
+    return _make("_random_exponential", [],
+                 {"lam": lam, "shape": tuple(shape), "seed": seed},
+                 name=name)
+
+
+def poisson(lam=1.0, shape=(1,), seed=0, name=None, **kw):
+    return _make("_random_poisson", [],
+                 {"lam": lam, "shape": tuple(shape), "seed": seed},
+                 name=name)

@@ -599,3 +599,151 @@ def test_mnist_iter_truncated_file_raises(tmp_path):
     q.write_bytes(struct.pack(">iiii", 2051, 10, 28, 28) + b"\x00" * 10)
     with pytest.raises(mx.base.MXNetError):       # payload < header dims
         mx.io.MNISTIter(image=str(q), label=str(q), batch_size=1)
+
+
+# ---- round-5 wave-2 probe gaps: linalg packing, sym.linalg, sym.random,
+# np.cross/vander, npx.rnn, transforms.Rotate, ColorJitterAug, SDMLLoss,
+# _v1 aliases, sample_multinomial ---------------------------------------
+def test_linalg_diag_trian_roundtrips():
+    rs = np.random.RandomState(0)
+    a = rs.randn(4, 4).astype(np.float32)
+    spd = a @ a.T + 4 * np.eye(4, dtype=np.float32)
+    v = nd.linalg.extractdiag(nd.array(spd))
+    np.testing.assert_allclose(v.asnumpy(), np.diag(spd), rtol=1e-6)
+    D = nd.linalg.makediag(v, offset=1).asnumpy()
+    assert D.shape == (5, 5)
+    np.testing.assert_allclose(np.diag(D, 1), np.diag(spd), rtol=1e-6)
+    t = nd.linalg.extracttrian(nd.array(spd))
+    M = nd.linalg.maketrian(t).asnumpy()
+    np.testing.assert_allclose(M, np.tril(spd), atol=1e-6)
+    u = nd.linalg.extracttrian(nd.array(spd), offset=1, lower=False)
+    U = nd.linalg.maketrian(u, offset=1, lower=False).asnumpy()
+    np.testing.assert_allclose(U, np.triu(spd, 1), atol=1e-6)
+
+
+def test_sym_linalg_matches_nd_and_json():
+    rs = np.random.RandomState(1)
+    a = rs.randn(3, 3).astype(np.float32)
+    spd = a @ a.T + 3 * np.eye(3, dtype=np.float32)
+    L = sym.linalg.potrf(sym.Variable("A"))
+    rec = sym.linalg.gemm2(L, L, transpose_b=True)
+    out = mx.sym.load_json(rec.tojson()).bind(
+        mx.cpu(), {"A": nd.array(spd)}).forward()[0].asnumpy()
+    np.testing.assert_allclose(out, spd, rtol=1e-4)
+    sld = sym.linalg.sumlogdiag(sym.linalg.potrf(sym.Variable("A")))
+    got = sld.bind(mx.cpu(), {"A": nd.array(spd)}).forward()[0].asnumpy()
+    want = 0.5 * np.linalg.slogdet(spd)[1]
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_sym_random_deterministic_inference_fresh_training():
+    u = sym.random.uniform(shape=(2, 3), seed=5)
+    a = u.bind(mx.cpu(), {}).forward()[0].asnumpy()
+    b = mx.sym.load_json(u.tojson()).bind(
+        mx.cpu(), {}).forward()[0].asnumpy()
+    np.testing.assert_allclose(a, b)     # inference: seed-deterministic
+    assert a.shape == (2, 3) and (0 <= a).all() and (a < 1).all()
+    n = sym.random.normal(loc=2.0, scale=0.1, shape=(500,), seed=1)
+    s = n.bind(mx.cpu(), {}).forward()[0].asnumpy()
+    assert abs(s.mean() - 2.0) < 0.05
+
+
+def test_np_cross_vander_npx_rnn():
+    c = mx.np.cross(mx.np.array([1., 0, 0]), mx.np.array([0., 1, 0]))
+    np.testing.assert_allclose(np.asarray(c.asnumpy()), [0, 0, 1])
+    v = mx.np.vander(mx.np.array([1., 2., 3.]), 3).asnumpy()
+    np.testing.assert_allclose(v, np.vander([1., 2., 3.], 3))
+    # npx.rnn mirrors nd.RNN (fused lax.scan kernel)
+    rs = np.random.RandomState(2)
+    T_, N_, I_, H_ = 3, 2, 4, 5
+    x = rs.randn(T_, N_, I_).astype(np.float32)
+    params = [rs.randn(*s).astype(np.float32) * 0.1 for s in
+              [(H_, I_), (H_, H_), (H_,), (H_,)]]
+    pn = ("l0_i2h_weight", "l0_h2h_weight", "l0_i2h_bias", "l0_h2h_bias")
+    args_nd = [nd.array(p) for p in params]
+    out_nd = mx.nd.RNN(nd.array(x), *args_nd, mode="rnn_tanh",
+                       hidden_size=H_, pnames=pn)
+    out_np = mx.npx.rnn(mx.np.array(x), *[mx.np.array(p) for p in params],
+                        mode="rnn_tanh", hidden_size=H_, pnames=pn)
+    np.testing.assert_allclose(out_np[0].asnumpy() if isinstance(
+        out_np, (list, tuple)) else out_np.asnumpy(),
+        out_nd[0].asnumpy() if isinstance(out_nd, (list, tuple))
+        else out_nd.asnumpy(), atol=1e-5)
+
+
+def test_rotate_and_color_jitter():
+    img = np.zeros((8, 8, 1), np.float32)
+    img[0, :, 0] = 1.0
+    r = mx.gluon.data.vision.transforms.Rotate(90)(
+        nd.array(img)).asnumpy()
+    # positive degrees rotate counter-clockwise (PIL convention):
+    # top row -> left column
+    assert (r[:, 0, 0] > 0.5).all() and (r[:, 2:, 0] < 0.5).all()
+    back = mx.gluon.data.vision.transforms.Rotate(-90)(
+        nd.array(r)).asnumpy()
+    assert (back[0, :, 0] > 0.5).sum() >= 6   # round trip restores (edges clip)
+    aug = mx.image.ColorJitterAug(0.2, 0.2, 0.2)
+    out = aug(nd.array(np.ones((4, 4, 3), np.float32) * 100))
+    assert out.shape == (4, 4, 3) and np.isfinite(out.asnumpy()).all()
+
+
+def test_sdml_loss_prefers_matching_pairs():
+    rs = np.random.RandomState(3)
+    l = mx.gluon.loss.SDMLLoss(smoothing_parameter=0.2)
+    x1 = nd.array(rs.randn(6, 8).astype(np.float32))
+    x2 = nd.array(rs.randn(6, 8).astype(np.float32))
+    match = l(x1, x1).asnumpy()
+    rand = l(x1, x2).asnumpy()
+    assert match.shape == (6,) and match.mean() < rand.mean()
+
+
+def test_v1_aliases_and_sample_multinomial():
+    x = nd.random.uniform(shape=(1, 3, 8, 8))
+    p = mx.nd.Pooling_v1(x, kernel=(2, 2), stride=(2, 2))
+    np.testing.assert_allclose(
+        p.asnumpy(), mx.nd.Pooling(x, kernel=(2, 2),
+                                   stride=(2, 2)).asnumpy())
+    m = nd.sample_multinomial(nd.array([[0.0, 0.0, 1.0]]), shape=5)
+    assert (m.asnumpy() == 2).all()
+
+
+def test_rotate_non_square_no_shear():
+    """review r5: pixel-space rotation — a 90-degree rotate of a
+    non-square image keeps straight lines straight (the normalized-
+    coords version sheared the image into a band)."""
+    img = np.zeros((10, 20, 1), np.float32)
+    img[0, :, 0] = 1.0
+    r = mx.gluon.data.vision.transforms.Rotate(90)(
+        nd.array(img)).asnumpy()
+    cols_lit = ((r[:, :, 0] > 0.5).any(axis=0)).sum()
+    assert cols_lit <= 2, cols_lit          # one vertical line, not a band
+    # grid is cached per (h, w)
+    t = mx.gluon.data.vision.transforms.Rotate(30)
+    t(nd.array(img)); t(nd.array(img))
+    assert len(t._grids) == 1
+
+
+def test_sdml_weight_and_batch1_guard():
+    rs = np.random.RandomState(0)
+    x1 = nd.array(rs.randn(4, 3).astype(np.float32))
+    x2 = nd.array(rs.randn(4, 3).astype(np.float32))
+    np.testing.assert_allclose(
+        mx.gluon.loss.SDMLLoss(weight=10.0)(x1, x2).asnumpy(),
+        10 * mx.gluon.loss.SDMLLoss(weight=1.0)(x1, x2).asnumpy(),
+        rtol=1e-6)
+    with pytest.raises(mx.base.MXNetError):
+        mx.gluon.loss.SDMLLoss()(nd.ones((1, 3)), nd.ones((1, 3)))
+
+
+def test_trian_count_closed_form_and_randint_dtype():
+    from mxnet_tpu.ops.linalg_ops import (_trian_count, _trian_indices,
+                                          _trian_n_for)
+    for n in (1, 2, 5, 9):
+        for k in (-3, -1, 0, 1, 3):
+            for lower in (True, False):
+                assert _trian_count(n, k, lower) == \
+                    len(_trian_indices(n, k, lower)[0])
+    assert _trian_n_for(2000 * 2001 // 2, 0, True) == 2000
+    i = sym.random.randint(0, 5, shape=(3,)).bind(
+        mx.cpu(), {}).forward()[0]
+    assert i.asnumpy().dtype == np.int32
