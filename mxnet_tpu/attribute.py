@@ -55,3 +55,9 @@ class AttrScope:
 
     def __exit__(self, *exc):
         _stack().pop()
+
+
+def current():
+    """Module-level accessor for the active AttrScope (reference:
+    attribute.py current())."""
+    return AttrScope.current()

@@ -9,7 +9,8 @@ from __future__ import annotations
 import numpy as np
 
 __all__ = ["MXNetError", "numeric_types", "integer_types", "string_types",
-           "mx_real_t", "_as_list", "_np_dtype"]
+           "mx_real_t", "_as_list", "_np_dtype",
+           "py_str", "c_str"]
 
 
 class MXNetError(RuntimeError):
@@ -45,3 +46,14 @@ def _np_dtype(dtype):
     if dtype is jnp.bfloat16 or dtype == "bfloat16":
         return jnp.bfloat16
     return np.dtype(dtype)
+
+
+def py_str(x):
+    """bytes -> str (reference: base.py py_str ctypes helper)."""
+    return x.decode("utf-8") if isinstance(x, bytes) else str(x)
+
+
+def c_str(x):
+    """str -> ctypes char_p (reference: base.py c_str)."""
+    import ctypes
+    return ctypes.c_char_p(x.encode("utf-8"))

@@ -14,7 +14,7 @@ from ..block import (Block, HybridBlock, _layer_rng, _report_aux_update,
 __all__ = ["Sequential", "HybridSequential", "Dense", "Dropout", "Flatten",
            "Lambda", "HybridLambda", "Embedding", "BatchNorm", "LayerNorm",
            "InstanceNorm", "GroupNorm", "Activation", "LeakyReLU", "PReLU",
-           "ELU", "SELU", "Swish", "GELU", "SiLU", "Concurrent", "Identity"]
+           "ELU", "SELU", "Swish", "GELU", "SiLU", "Concurrent", "Identity", "BatchNormReLU"]
 
 
 class _SequentialContainer:
@@ -253,6 +253,17 @@ class BatchNorm(HybridBlock):
     def __repr__(self):
         return (f"BatchNorm(axis={self._axis}, eps={self._epsilon}, "
                 f"momentum={self._momentum}, in_channels={self.in_channels})")
+
+
+
+class BatchNormReLU(BatchNorm):
+    """BatchNorm with a fused ReLU epilogue (reference: nn.BatchNormReLU
+    — upstream fuses via cuDNN; XLA fuses the relu into the BN kernel
+    here, so subclass + relu is already the fused program)."""
+
+    def hybrid_forward(self, F, x, *args, **kwargs):
+        out = super().hybrid_forward(F, x, *args, **kwargs)
+        return F.relu(out)   # F-dispatch keeps the symbolic path alive
 
 
 class LayerNorm(HybridBlock):

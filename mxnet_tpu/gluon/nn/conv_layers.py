@@ -18,7 +18,7 @@ __all__ = ["Conv1D", "Conv2D", "Conv3D", "Conv1DTranspose", "Conv2DTranspose",
            "Conv3DTranspose", "MaxPool1D", "MaxPool2D", "MaxPool3D",
            "AvgPool1D", "AvgPool2D", "AvgPool3D", "GlobalMaxPool1D",
            "GlobalMaxPool2D", "GlobalMaxPool3D", "GlobalAvgPool1D",
-           "GlobalAvgPool2D", "GlobalAvgPool3D", "ReflectionPad2D"]
+           "GlobalAvgPool2D", "GlobalAvgPool3D", "ReflectionPad2D", "ZeroPad2D"]
 
 
 def _tuple(x, n):
@@ -261,6 +261,23 @@ class GlobalAvgPool2D(_GlobalPool):
 class GlobalAvgPool3D(_GlobalPool):
     def __init__(self, **kwargs):
         super().__init__("avg", 3, **kwargs)
+
+
+class ZeroPad2D(HybridBlock):
+    """Zero padding on H/W of NCHW input (reference: nn.ZeroPad2D).
+    padding: int or (pad_h_before, pad_h_after, pad_w_before,
+    pad_w_after) in the upstream 4-tuple convention."""
+
+    def __init__(self, padding=0, **kwargs):
+        super().__init__(**kwargs)
+        self._padding = (padding,) * 4 if isinstance(padding, int) \
+            else tuple(padding)
+
+    def hybrid_forward(self, F, x):
+        import jax.numpy as jnp
+        ph0, ph1, pw0, pw1 = self._padding
+        pairs = ((0, 0), (0, 0), (ph0, ph1), (pw0, pw1))
+        return _apply(lambda a, _p=pairs: jnp.pad(a, _p), [x])
 
 
 class ReflectionPad2D(HybridBlock):

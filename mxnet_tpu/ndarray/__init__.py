@@ -31,6 +31,13 @@ from ..random import (uniform, normal, randn, randint, multinomial,
                       exponential, gamma, poisson)
 
 sample_multinomial = multinomial
+
+# flat linalg_* spellings (upstream registers la_op under both
+# mx.nd.linalg.gemm2 and mx.nd.linalg_gemm2)
+from ..ops import linalg_ops as _linalg_mod
+for _ln in _linalg_mod.__all__:
+    globals()[f"linalg_{_ln}"] = getattr(_linalg_mod, _ln)
+del _ln
 sample_uniform = uniform
 sample_normal = normal
 sample_gamma = gamma

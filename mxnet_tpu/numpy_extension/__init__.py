@@ -31,7 +31,7 @@ __all__ = ["set_np", "reset_np", "is_np_array", "is_np_shape", "np_shape",
            "batch_flatten", "fully_connected", "convolution",
            "pooling", "batch_norm", "layer_norm", "dropout", "embedding",
            "activation", "leaky_relu", "arange_like", "gamma", "sequence_mask",
-           "waitall", "save", "load", "seed", "rnn"]
+           "waitall", "save", "load", "seed", "rnn", "slice_like", "smooth_l1", "multibox_prior", "multibox_target", "multibox_detection", "roi_align"]
 
 class _Flags:
     """Process-global np-mode state (reference parity: one C++ global;
@@ -169,6 +169,43 @@ def convolution(data, weight, bias=None, **kwargs):
 
 def pooling(data, kernel, **kwargs):
     return _apply(lambda a: _nn.pooling(a, kernel, **kwargs), [_npc(data)])
+
+
+def slice_like(data, shape_like, axes=None):
+    from ..ops.tensor_ops import slice_like as _sl
+    return _sl(_npc(data), _npc(shape_like), axes=axes)
+
+
+def smooth_l1(data, scalar=1.0):
+    from ..ops.seq_ops import smooth_l1 as _sm
+    return _sm(_npc(data), scalar=scalar)
+
+
+def multibox_prior(data, sizes=(1.0,), ratios=(1.0,), **kw):
+    from ..ndarray import contrib as _ndc
+    return _ndc.MultiBoxPrior(_npc(data), sizes=sizes, ratios=ratios,
+                              **kw)
+
+
+def multibox_target(anchor, label, cls_pred, **kw):
+    from ..ndarray import contrib as _ndc
+    return _ndc.MultiBoxTarget(_npc(anchor), _npc(label),
+                               _npc(cls_pred), **kw)
+
+
+def multibox_detection(cls_prob, loc_pred, anchor, **kw):
+    from ..ndarray import contrib as _ndc
+    return _ndc.MultiBoxDetection(_npc(cls_prob), _npc(loc_pred),
+                                  _npc(anchor), **kw)
+
+
+def roi_align(data, rois, pooled_size=(7, 7), spatial_scale=1.0,
+              sample_ratio=2, **kw):
+    from ..ndarray import contrib as _ndc
+    return _ndc.ROIAlign(_npc(data), _npc(rois),
+                         pooled_size=pooled_size,
+                         spatial_scale=spatial_scale,
+                         sample_ratio=sample_ratio, **kw)
 
 
 def rnn(data, *state_and_params, **kwargs):

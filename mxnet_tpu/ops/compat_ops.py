@@ -38,7 +38,8 @@ __all__ = [
     "mp_sgd_mom_update", "nag_mom_update", "adam_update", "signsgd_update",
     "signum_update", "rmsprop_update", "rmspropalex_update", "ftrl_update",
     "lamb_update_phase1", "lamb_update_phase2",
-]
+    "choose_element_0index", "fill_element_0index",
+    "IdentityAttachKLSparseReg"]
 
 
 # ------------------------------------------------------- aliases, small math
@@ -472,3 +473,44 @@ def lamb_update_phase2(weight, g_prime, r1, r2, lr, lower_bound=-1.0,
         return w - lr * ratio * gp
     new_w = _apply(fn, [weight, g_prime, r1, r2])
     return _emit(weight, new_w._data, out)
+
+
+def choose_element_0index(lhs, rhs, **kw):
+    """Pick lhs[i, rhs[i]] along axis 1 (reference:
+    choose_element_0index — the classic softmax-label gather)."""
+    return _apply(lambda a, i: jnp.take_along_axis(
+        a, i.astype(jnp.int32)[:, None], 1)[:, 0], [lhs, rhs])
+
+
+def fill_element_0index(lhs, mhs, rhs, **kw):
+    """lhs with lhs[i, rhs[i]] = mhs[i] (reference:
+    fill_element_0index)."""
+    return _apply(lambda a, v, i: a.at[
+        jnp.arange(a.shape[0]), i.astype(jnp.int32)].set(v),
+        [lhs, mhs, rhs])
+
+
+def IdentityAttachKLSparseReg(data, sparseness_target=0.1,
+                              penalty=0.001, momentum=0.9, **kw):
+    """Identity forward; backward adds the KL-sparseness penalty
+    gradient on the mean activation (reference:
+    identity_attach_KL_sparse_reg.cc). The running-average momentum of
+    the upstream op is folded into the per-batch mean (documented
+    divergence: stateless, XLA-pure)."""
+    import functools
+
+    @functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+    def op(x, rho, pen):
+        return x
+
+    def fwd(x, rho, pen):
+        return x, x
+
+    def bwd(rho, pen, x, g):
+        rho_hat = jnp.clip(jnp.mean(x, axis=0), 1e-6, 1 - 1e-6)
+        dkl = (-rho / rho_hat + (1 - rho) / (1 - rho_hat)) / x.shape[0]
+        return (g + pen * dkl[None, :].astype(x.dtype),)
+
+    op.defvjp(fwd, bwd)
+    return _apply(lambda x: op(x, float(sparseness_target),
+                               float(penalty)), [data])

@@ -42,7 +42,7 @@ __all__ = [
     "one_hot", "topk", "sort", "argsort", "shuffle", "diag",
     # misc
     "dot", "batch_dot", "add_n", "ElementWiseSum", "cast", "Cast",
-    "zeros_like", "ones_like", "shape_array", "size_array", "cumsum",
+    "zeros_like", "ones_like", "shape_array", "size_array", "cumsum", "Pad",
 ]
 
 
@@ -505,3 +505,7 @@ def shape_array(data):
 def size_array(data):
     from ..ndarray.ndarray import array as _array
     return _array(jnp.asarray([data.size], dtype=jnp.int32))
+
+
+# upstream registers the capitalized spelling too (pad.cc)
+Pad = pad

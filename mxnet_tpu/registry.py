@@ -60,3 +60,25 @@ def get_create_func(base_class, nickname):
         return reg[name](*args, **kwargs)
     create.__name__ = f"create_{nickname}"
     return create
+
+
+def get_registry(base_class):
+    """Dict view of the registry for a base class (reference:
+    registry.get_registry). The internal store keys on
+    (base_class, nickname); this aggregates every nickname registry of
+    the class."""
+    out = {}
+    for (cls, _nick), reg in _REGISTRIES.items():
+        if cls is base_class:
+            out.update(reg)
+    # the core registries (optimizer/initializer/metric) predate this
+    # module and keep their own _REGISTRY dict — always merge them so
+    # plugin registrations never shadow away the built-ins
+    import importlib
+    mod = getattr(base_class, "__module__", "")
+    if mod.startswith("mxnet_tpu"):
+        core = getattr(importlib.import_module(mod), "_REGISTRY", None)
+        if isinstance(core, dict):
+            for k, v in core.items():
+                out.setdefault(k, v)
+    return out

@@ -217,3 +217,14 @@ def download(url, fname=None, dirname=None, overwrite=False, retries=5):
 def list_gpus():
     """Reference helper name; TPUs stand in for GPUs here."""
     return list_tpus()
+
+
+def assert_exception(f, exception_type, *args, **kwargs):
+    """Assert f(*args, **kwargs) raises exception_type (reference:
+    test_utils.assert_exception)."""
+    try:
+        f(*args, **kwargs)
+    except exception_type:
+        return
+    raise AssertionError(
+        f"{f} did not raise {exception_type.__name__}")

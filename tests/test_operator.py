@@ -747,3 +747,73 @@ def test_trian_count_closed_form_and_randint_dtype():
     i = sym.random.randint(0, 5, shape=(3,)).bind(
         mx.cpu(), {}).forward()[0]
     assert i.asnumpy().dtype == np.int32
+
+
+def test_wave3_surface():
+    """round-5 wave-3 probe gaps: blocks, flat linalg aliases, legacy
+    element_0index ops, KL sparse reg, npx detection wrappers, misc
+    helpers."""
+    rs = np.random.RandomState(0)
+    x = nd.array(rs.randn(2, 3, 4, 4).astype(np.float32))
+    bn = mx.gluon.nn.BatchNormReLU()
+    bn.initialize()
+    assert (bn(x).asnumpy() >= 0).all()
+    assert mx.gluon.nn.ZeroPad2D(1)(x).shape == (2, 3, 6, 6)
+    a = rs.randn(3, 3).astype(np.float32)
+    spd = a @ a.T + 3 * np.eye(3, dtype=np.float32)
+    np.testing.assert_allclose(
+        nd.linalg_potrf(nd.array(spd)).asnumpy(),
+        np.linalg.cholesky(spd), rtol=1e-4)
+    m = nd.array(rs.randn(4, 5).astype(np.float32))
+    i = nd.array(np.array([1, 0, 3, 2], np.float32))
+    np.testing.assert_allclose(
+        nd.choose_element_0index(m, i).asnumpy(),
+        m.asnumpy()[np.arange(4), [1, 0, 3, 2]])
+    filled = nd.fill_element_0index(
+        m, nd.array(np.full(4, 9.0, np.float32)), i).asnumpy()
+    assert (filled[np.arange(4), [1, 0, 3, 2]] == 9.0).all()
+    assert nd.Pad is nd.pad
+    # KL sparse reg: identity fwd, penalty-shifted bwd
+    from mxnet_tpu import autograd
+    d = nd.array(rs.rand(8, 3).astype(np.float32))
+    d.attach_grad()
+    with autograd.record():
+        out = nd.IdentityAttachKLSparseReg(d, penalty=0.5)
+    np.testing.assert_allclose(out.asnumpy(), d.asnumpy())
+    out.backward(nd.ones(out.shape))
+    assert not np.allclose(d.grad.asnumpy(), 1.0)
+    # npx detection wrappers delegate to the contrib kernels
+    pri = mx.npx.multibox_prior(mx.np.zeros((1, 1, 4, 4)), sizes=(0.3,))
+    assert pri.shape[1] == 16 and pri.shape[2] == 4
+    # registry aggregates (optimizers are registered under Optimizer)
+    from mxnet_tpu.optimizer.optimizer import Optimizer
+    reg = mx.registry.get_registry(Optimizer)
+    assert "sgd" in reg and "adam" in reg
+    assert mx.base.py_str(b"abc") == "abc"
+    mx.test_utils.assert_exception(lambda: 1 / 0, ZeroDivisionError)
+    import pytest as _pt
+    with _pt.raises(AssertionError):
+        mx.test_utils.assert_exception(lambda: None, ValueError)
+
+
+def test_wave3_review_fixes():
+    """review r5 wave3: npx.smooth_l1 imports, BatchNormReLU hybridizes
+    (symbolic path), get_registry merges plugins WITH built-ins."""
+    s = mx.npx.smooth_l1(mx.np.array([0.2, 2.0]))
+    np.testing.assert_allclose(np.asarray(s.asnumpy()),
+                               [0.5 * 0.04, 1.5], atol=1e-6)
+    bn = mx.gluon.nn.BatchNormReLU()
+    bn.initialize()
+    x = nd.array(np.random.RandomState(0).randn(2, 3, 4, 4)
+                 .astype(np.float32))
+    bn(x)
+    bn.hybridize()
+    assert (bn(x).asnumpy() >= 0).all()
+    from mxnet_tpu.optimizer.optimizer import Optimizer
+    reg_fn = mx.registry.get_register_func(Optimizer, "optimizer")
+
+    class _PluginOpt(Optimizer):
+        pass
+    reg_fn(_PluginOpt, "_plugin_opt_test")
+    r = mx.registry.get_registry(Optimizer)
+    assert "_plugin_opt_test" in r and "sgd" in r

@@ -26,8 +26,14 @@ run_task() {  # run_task <name> <timeout_s> <cmd...>
 }
 
 DEADLINE=$(( $(date +%s) + ${QUEUE_BUDGET_S:-28800} ))
+N_PROBE=0
 while [ "$(date +%s)" -lt "$DEADLINE" ]; do
-  if ! probe; then sleep 45; continue; fi
+  N_PROBE=$((N_PROBE + 1))
+  if ! probe; then
+    echo "[queue] $(date +%F_%T) probe $N_PROBE dead" >> "$LOG/queue.log"
+    sleep 45
+    continue
+  fi
   echo "[queue] $(date +%F_%T) window LIVE" >> "$LOG/queue.log"
   # 1. headline bench, warm compile cache: timing evidence + numbers
   run_task warmbench 1200 python bench.py --worker || continue
@@ -50,6 +56,9 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
     python bench_det.py || continue
   run_task det_rcnn_unroll4 900 env BENCH_DET_RCNN=1 \
     BENCH_DET_RCNN_UNROLL=4 python bench_det.py || continue
+  run_task det_ssd_lhs 900 env \
+    LIBTPU_INIT_ARGS=--xla_tpu_enable_latency_hiding_scheduler=true \
+    python bench_det.py || continue
   # 5. conv1x1+BN epilogue per-shape sweep (VERDICT item 3)
   run_task convbn_sweep 900 python tools/probe_fused_convbn.py || continue
   # 6. detection convergence evidence (VERDICT item 8)
