@@ -20,7 +20,7 @@ from ..block import _layer_rng
 from ..rnn.rnn_cell import RecurrentCell
 
 __all__ = ["Conv1DLSTMCell", "Conv2DLSTMCell", "Conv3DLSTMCell",
-           "VariationalDropoutCell", "LSTMPCell"]
+           "VariationalDropoutCell", "LSTMPCell", "Conv1DRNNCell", "Conv2DRNNCell", "Conv3DRNNCell", "Conv1DGRUCell", "Conv2DGRUCell", "Conv3DGRUCell"]
 
 
 def _sigmoid(v):
@@ -34,6 +34,7 @@ class _ConvLSTMCell(RecurrentCell):
     input_shape is (C, *spatial) in the NC* layout, required up front like
     the reference (state shape must be known before the first step)."""
     _ndim = None
+    _gmul = 4
 
     def __init__(self, input_shape, hidden_channels, i2h_kernel=3,
                  h2h_kernel=3, i2h_pad=None,
@@ -56,19 +57,20 @@ class _ConvLSTMCell(RecurrentCell):
                   else tuple(i2h_pad))
         self._hp = tuple(k // 2 for k in self._hk)
         in_c = self._input_shape[0]
+        g = self._gmul      # gates per hidden channel (LSTM 4, GRU 3, RNN 1)
         with self.name_scope():
             self.i2h_weight = self.params.get(
-                "i2h_weight", shape=(4 * hidden_channels, in_c) + self._ik,
+                "i2h_weight", shape=(g * hidden_channels, in_c) + self._ik,
                 init=i2h_weight_initializer)
             self.h2h_weight = self.params.get(
                 "h2h_weight",
-                shape=(4 * hidden_channels, hidden_channels) + self._hk,
+                shape=(g * hidden_channels, hidden_channels) + self._hk,
                 init=h2h_weight_initializer)
             self.i2h_bias = self.params.get(
-                "i2h_bias", shape=(4 * hidden_channels,),
+                "i2h_bias", shape=(g * hidden_channels,),
                 init=i2h_bias_initializer)
             self.h2h_bias = self.params.get(
-                "h2h_bias", shape=(4 * hidden_channels,),
+                "h2h_bias", shape=(g * hidden_channels,),
                 init=h2h_bias_initializer)
 
     def state_info(self, batch_size=0):
@@ -106,6 +108,86 @@ class Conv2DLSTMCell(_ConvLSTMCell):
 
 
 class Conv3DLSTMCell(_ConvLSTMCell):
+    _ndim = 3
+
+
+class _ConvRNNCell(_ConvLSTMCell):
+    """Conv RNN cell, tanh/relu (reference: contrib.rnn.Conv*DRNNCell)."""
+    _gmul = 1
+
+    def __init__(self, *args, activation="tanh", **kwargs):
+        super().__init__(*args, **kwargs)
+        if activation not in ("tanh", "relu"):
+            raise MXNetError(f"Conv RNN cell: activation must be "
+                             f"tanh/relu, got {activation!r}")
+        self._act = activation
+
+    def state_info(self, batch_size=0):
+        return super().state_info(batch_size)[:1]
+
+    def hybrid_forward(self, F, x, states, i2h_weight, h2h_weight,
+                       i2h_bias, h2h_bias):
+        (h,) = states
+
+        def fn(xv, hv, wi, wh, bi, bh, _ip=self._ip, _hp=self._hp,
+               _a=self._act):
+            z = (K.convolution(xv, wi, bi, stride=1, pad=_ip)
+                 + K.convolution(hv, wh, bh, stride=1, pad=_hp))
+            return jnp.tanh(z) if _a == "tanh" else jnp.maximum(z, 0)
+
+        new_h = _apply(fn, [x, h, i2h_weight, h2h_weight, i2h_bias,
+                            h2h_bias])
+        return new_h, [new_h]
+
+
+class Conv1DRNNCell(_ConvRNNCell):
+    _ndim = 1
+
+
+class Conv2DRNNCell(_ConvRNNCell):
+    _ndim = 2
+
+
+class Conv3DRNNCell(_ConvRNNCell):
+    _ndim = 3
+
+
+class _ConvGRUCell(_ConvLSTMCell):
+    """Conv GRU cell, [r, z, n] gate order (reference:
+    contrib.rnn.Conv*DGRUCell)."""
+    _gmul = 3
+
+    def state_info(self, batch_size=0):
+        return super().state_info(batch_size)[:1]
+
+    def hybrid_forward(self, F, x, states, i2h_weight, h2h_weight,
+                       i2h_bias, h2h_bias):
+        (h,) = states
+
+        def fn(xv, hv, wi, wh, bi, bh, _ip=self._ip, _hp=self._hp):
+            xg = K.convolution(xv, wi, bi, stride=1, pad=_ip)
+            hg = K.convolution(hv, wh, bh, stride=1, pad=_hp)
+            xr, xz, xn = jnp.split(xg, 3, axis=1)
+            hr, hz, hn = jnp.split(hg, 3, axis=1)
+            r = _sigmoid(xr + hr)
+            z = _sigmoid(xz + hz)
+            n = jnp.tanh(xn + r * hn)
+            return (1 - z) * n + z * hv
+
+        new_h = _apply(fn, [x, h, i2h_weight, h2h_weight, i2h_bias,
+                            h2h_bias])
+        return new_h, [new_h]
+
+
+class Conv1DGRUCell(_ConvGRUCell):
+    _ndim = 1
+
+
+class Conv2DGRUCell(_ConvGRUCell):
+    _ndim = 2
+
+
+class Conv3DGRUCell(_ConvGRUCell):
     _ndim = 3
 
 

@@ -8,7 +8,7 @@ from .base import MXNetError, _as_list
 __all__ = ["EvalMetric", "Accuracy", "TopKAccuracy", "F1", "MCC", "MAE",
            "MSE", "RMSE", "CrossEntropy", "NegativeLogLikelihood",
            "Perplexity", "PearsonCorrelation", "PCC", "Loss",
-           "CompositeEvalMetric", "CustomMetric", "create", "np"]
+           "CompositeEvalMetric", "CustomMetric", "create", "np", "Torch", "Caffe"]
 
 _REGISTRY = {}
 
@@ -362,3 +362,17 @@ class CustomMetric(EvalMetric):
             else:
                 self.sum_metric += v
                 self.num_inst += 1
+
+
+# upstream framework-comparison aliases: both report the averaged loss
+# (reference: metric.Torch / metric.Caffe)
+@register
+class Torch(Loss):
+    def __init__(self, name="torch", **kwargs):
+        super().__init__(name=name, **kwargs)
+
+
+@register
+class Caffe(Loss):
+    def __init__(self, name="caffe", **kwargs):
+        super().__init__(name=name, **kwargs)

@@ -220,10 +220,12 @@ def SVMOutput(data, label=None, margin=1.0, regularization_coefficient=1.0,
 
 # ---------------------------------------------------------------- im2col
 def _im2col_fn(x, kernel, stride, dilate, pad):
+    sp = "DHW"[3 - (x.ndim - 2):]         # 1D "W", 2D "HW", 3D "DHW"
+    dn = ("NC" + sp, "OI" + sp, "NC" + sp)
     patches = jax.lax.conv_general_dilated_patches(
         x, filter_shape=kernel, window_strides=stride,
         padding=[(p, p) for p in pad], rhs_dilation=dilate,
-        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        dimension_numbers=dn)
     n = x.shape[0]
     return patches.reshape(n, patches.shape[1], -1)
 

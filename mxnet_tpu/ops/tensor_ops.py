@@ -28,6 +28,7 @@ __all__ = [
     "log1p", "sin", "cos", "tan", "arcsin", "arccos", "arctan", "sinh", "cosh",
     "tanh", "arcsinh", "arccosh", "arctanh", "reciprocal", "negative",
     "logical_not", "erf", "erfinv", "gamma", "gammaln", "clip",
+    "relu6", "log_sigmoid", "mish",
     # reduce
     "sum", "nansum", "mean", "prod", "nanprod", "max", "min", "norm", "argmax",
     "argmin", "sum_axis", "max_axis", "min_axis",
@@ -142,6 +143,9 @@ arctanh = _unary_factory(jnp.arctanh)
 reciprocal = _unary_factory(jnp.reciprocal)
 negative = _unary_factory(jnp.negative)
 logical_not = _unary_factory(lambda a: jnp.logical_not(a).astype(jnp.float32))
+relu6 = _unary_factory(jax.nn.relu6)
+log_sigmoid = _unary_factory(jax.nn.log_sigmoid)
+mish = _unary_factory(jax.nn.mish)
 erf = _unary_factory(jax.scipy.special.erf)
 erfinv = _unary_factory(jax.scipy.special.erfinv)
 gamma = _unary_factory(lambda a: jnp.exp(jax.scipy.special.gammaln(a)))

@@ -1130,3 +1130,115 @@ def SVMOutput(data, label=None, margin=1.0,
 
 __all__ += ["one_hot", "topk", "pick", "gather_nd", "slice_like",
             "broadcast_axis", "masked_softmax", "SVMOutput"]
+
+
+# -- classic spatial extra ops, sym side (wave 4: upstream registers
+# these under both namespaces; nd side lives in ops/extra_ops.py) ---------
+from ..ops import extra_ops as _xtra
+
+from ..ops.tensor_ops import functools_reduce as _fold_add
+
+register_op("add_n", lambda *xs: _fold_add(xs))   # one n-ary-add impl
+register_op("Crop",
+            lambda x, *like, h_w=None, offset=(0, 0), center_crop=False:
+            _xtra.crop_k(x, like_shape=like[0].shape, offset=offset,
+                         center_crop=center_crop) if like else
+            _xtra.crop_k(x, h_w=h_w, offset=offset,
+                         center_crop=center_crop))
+register_op("ROIPooling",
+            lambda x, rois, pooled_size=(7, 7), spatial_scale=1.0:
+            _xtra.roi_pooling_k(x, rois, tuple(pooled_size),
+                                spatial_scale))
+register_op("GridGenerator",
+            lambda a, target_shape=None:
+            _xtra.grid_generator_k(a, tuple(target_shape)))
+register_op("BilinearSampler", _xtra.bilinear_sampler_k)
+register_op("SpatialTransformer",
+            lambda x, a, target_shape=None:
+            _xtra.spatial_transformer_k(x, a, tuple(target_shape)))
+register_op("Correlation",
+            lambda a, b, kernel_size=1, max_displacement=4, stride1=1,
+            stride2=1, is_multiply=True:
+            _xtra.correlation_k(a, b, kernel_size=kernel_size,
+                                max_displacement=max_displacement,
+                                stride1=stride1, stride2=stride2,
+                                is_multiply=is_multiply))
+
+from ..ops.compat_ops import _im2col_fn as _im2col_k
+from ..ops.compat_ops import _norm2 as _normN
+
+
+def _im2col_eval(x, kernel=None, stride=1, dilate=1, pad=0):
+    nsp = x.ndim - 2          # spatial dims from the DATA, like nd side
+    return _im2col_k(x, _normN(kernel, nsp), _normN(stride, nsp),
+                     _normN(dilate, nsp), _normN(pad, nsp))
+
+
+register_op("im2col", _im2col_eval)
+
+
+def add_n(*args, name=None):
+    return _make("add_n", list(args), {}, name=name)
+
+
+def Crop(data, crop_like=None, h_w=None, offset=(0, 0),
+         center_crop=False, name=None, **kw):
+    if crop_like is None and h_w is None:
+        raise MXNetError("Crop: need crop_like or h_w")
+    ins = [data] + ([crop_like] if crop_like is not None else [])
+    return _make("Crop", ins,
+                 {"h_w": h_w, "offset": tuple(offset),
+                  "center_crop": center_crop}, name=name)
+
+
+def ROIPooling(data, rois, pooled_size=(7, 7), spatial_scale=1.0,
+               name=None, **kw):
+    return _make("ROIPooling", [data, rois],
+                 {"pooled_size": tuple(pooled_size),
+                  "spatial_scale": spatial_scale}, name=name)
+
+
+def GridGenerator(data, transform_type="affine", target_shape=None,
+                  name=None, **kw):
+    if transform_type != "affine":
+        raise MXNetError("GridGenerator: only affine mode")
+    if target_shape is None:
+        raise MXNetError("GridGenerator: target_shape is required")
+    return _make("GridGenerator", [data],
+                 {"target_shape": tuple(target_shape)}, name=name)
+
+
+def BilinearSampler(data, grid, name=None, **kw):
+    return _make("BilinearSampler", [data, grid], {}, name=name)
+
+
+def SpatialTransformer(data, loc, target_shape=None,
+                       transform_type="affine",
+                       sampler_type="bilinear", name=None, **kw):
+    if transform_type != "affine" or sampler_type != "bilinear":
+        raise MXNetError("SpatialTransformer: affine+bilinear only")
+    if target_shape is None:
+        raise MXNetError("SpatialTransformer: target_shape is required")
+    return _make("SpatialTransformer", [data, loc],
+                 {"target_shape": tuple(target_shape)}, name=name)
+
+
+def Correlation(data1, data2, kernel_size=1, max_displacement=4,
+                stride1=1, stride2=1, is_multiply=True, name=None, **kw):
+    return _make("Correlation", [data1, data2],
+                 {"kernel_size": kernel_size,
+                  "max_displacement": max_displacement, "stride1": stride1,
+                  "stride2": stride2, "is_multiply": is_multiply},
+                 name=name)
+
+
+def im2col(data, kernel, stride=1, dilate=1, pad=0, name=None, **kw):
+    return _make("im2col", [data],
+                 {"kernel": kernel if isinstance(kernel, int)
+                  else tuple(kernel), "stride": stride,
+                  "dilate": dilate, "pad": pad}, name=name)
+
+
+__all__ += ["add_n", "Crop", "ROIPooling", "GridGenerator",
+            "BilinearSampler", "SpatialTransformer", "Correlation",
+            "im2col"]

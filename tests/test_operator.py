@@ -817,3 +817,72 @@ def test_wave3_review_fixes():
     reg_fn(_PluginOpt, "_plugin_opt_test")
     r = mx.registry.get_registry(Optimizer)
     assert "_plugin_opt_test" in r and "sgd" in r
+
+
+def test_wave4_surface():
+    """round-5 wave-4: sym spatial extra ops (vs nd parity + JSON),
+    add_n, im2col, conv RNN/GRU cells, activations, metric aliases."""
+    rs = np.random.RandomState(0)
+    x = nd.array(rs.randn(1, 2, 8, 8).astype(np.float32))
+    rois = nd.array(np.array([[0, 0, 0, 7, 7]], np.float32))
+    s = sym.ROIPooling(sym.Variable("x"), sym.Variable("r"),
+                       pooled_size=(2, 2))
+    got = mx.sym.load_json(s.tojson()).bind(
+        mx.cpu(), {"x": x, "r": rois}).forward()[0]
+    np.testing.assert_allclose(
+        got.asnumpy(),
+        mx.nd.ROIPooling(x, rois, pooled_size=(2, 2)).asnumpy())
+    v = nd.array(np.ones((2, 2), np.float32))
+    out = sym.add_n(sym.Variable("a"), sym.Variable("b"),
+                    sym.Variable("c")).bind(
+        mx.cpu(), {"a": v, "b": v, "c": v}).forward()[0]
+    assert (out.asnumpy() == 3).all()
+    theta = nd.array(np.array([[1, 0, 0, 0, 1, 0]], np.float32))
+    ident = sym.SpatialTransformer(
+        sym.Variable("x"), sym.Variable("t"), target_shape=(8, 8)).bind(
+        mx.cpu(), {"x": x, "t": theta}).forward()[0]
+    np.testing.assert_allclose(ident.asnumpy(), x.asnumpy(), atol=1e-4)
+    got = sym.im2col(sym.Variable("x"), kernel=(3, 3), pad=1).bind(
+        mx.cpu(), {"x": x}).forward()[0]
+    np.testing.assert_allclose(
+        got.asnumpy(), mx.nd.im2col(x, kernel=(3, 3), pad=1).asnumpy())
+    # conv rnn/gru cells: shape-preserving steps
+    cell = mx.gluon.contrib.rnn.Conv2DRNNCell((2, 8, 8), 3)
+    cell.initialize()
+    out, st = cell(x, [nd.zeros((1, 3, 8, 8))])
+    assert out.shape == (1, 3, 8, 8) and len(st) == 1
+    gru = mx.gluon.contrib.rnn.Conv1DGRUCell((2, 8), 3)
+    gru.initialize()
+    o2, s2 = gru(nd.array(rs.randn(1, 2, 8).astype(np.float32)),
+                 [nd.zeros((1, 3, 8))])
+    assert o2.shape == (1, 3, 8)
+    vv = nd.array(np.array([-1.0, 3.0, 9.0], np.float32))
+    np.testing.assert_allclose(nd.relu6(vv).asnumpy(), [0, 3, 6])
+    np.testing.assert_allclose(
+        nd.log_sigmoid(vv).asnumpy(),
+        np.log(1 / (1 + np.exp(-vv.asnumpy()))), atol=1e-6)
+    assert mx.metric.Torch().name == "torch"
+    assert mx.metric.Caffe().name == "caffe"
+
+
+def test_wave4_review_fixes():
+    """review r5 wave4: metric.create('torch'/'caffe'), conv-RNN
+    activation guard, ndim-generic im2col with nd/sym parity, required
+    target_shape/crop args raise MXNetError."""
+    assert mx.metric.create("torch").name == "torch"
+    assert mx.metric.create("caffe").name == "caffe"
+    with pytest.raises(mx.base.MXNetError):
+        mx.gluon.contrib.rnn.Conv2DRNNCell((2, 4, 4), 3,
+                                           activation="leaky")
+    x1 = nd.array(np.random.RandomState(0).randn(1, 2, 9)
+                  .astype(np.float32))
+    w = mx.nd.im2col(x1, kernel=(3,), pad=1)        # 1D now works
+    g = sym.im2col(sym.Variable("x"), kernel=3, pad=1).bind(
+        mx.cpu(), {"x": x1}).forward()[0]
+    np.testing.assert_allclose(g.asnumpy(), w.asnumpy())
+    for bad in (lambda: sym.GridGenerator(sym.Variable("d")),
+                lambda: sym.SpatialTransformer(sym.Variable("d"),
+                                               sym.Variable("l")),
+                lambda: sym.Crop(sym.Variable("d"))):
+        with pytest.raises(mx.base.MXNetError):
+            bad()
