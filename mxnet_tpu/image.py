@@ -19,7 +19,7 @@ __all__ = ["imread", "imdecode", "imresize", "resize_short", "fixed_crop",
            "CreateAugmenter", "Augmenter", "ForceResizeAug", "ImageIter",
            "ImageDetIter", "CastAug", "BrightnessJitterAug",
            "ContrastJitterAug", "SaturationJitterAug", "LightingAug",
-           "RandomOrderAug", "color_normalize", "random_size_crop", "ColorJitterAug"]
+           "RandomOrderAug", "color_normalize", "random_size_crop", "ColorJitterAug", "DetAugmenter", "DetBorrowAug", "DetHorizontalFlipAug", "CreateDetAugmenter", "scale_down", "copyMakeBorder"]
 
 
 def _finish_decode(arr, flag, to_rgb):
@@ -492,6 +492,122 @@ class ImageIter:
     next = __next__
 
 
+class DetAugmenter:
+    """Label-aware augmenter base (reference: image/detection.py
+    DetAugmenter): __call__(src, label) -> (src, label) where label is
+    the packed (max_objects, object_width) box array with [cls, x1, y1,
+    x2, y2, ...] rows in normalised coords, -1-padded."""
+
+    def __call__(self, src, label):
+        raise NotImplementedError
+
+
+class DetBorrowAug(DetAugmenter):
+    """Lift an image-only augmenter into the det pipeline (reference:
+    DetBorrowAug) — labels pass through untouched."""
+
+    def __init__(self, augmenter):
+        self.augmenter = augmenter
+
+    def __call__(self, src, label):
+        return self.augmenter(src), label
+
+
+class DetHorizontalFlipAug(DetAugmenter):
+    """Mirror image AND boxes with probability p (reference:
+    DetHorizontalFlipAug)."""
+
+    def __init__(self, p=0.5, rng=None):
+        self.p = p
+        self._rng = rng or np.random.RandomState()
+
+    def __call__(self, src, label):
+        if self._rng.uniform() >= self.p:
+            return src, label
+        arr = src.asnumpy() if hasattr(src, "asnumpy") else np.asarray(src)
+        flipped = array(np.ascontiguousarray(arr[:, ::-1]))
+        label = label.copy()
+        valid = label[:, 0] >= 0
+        x1 = label[valid, 1].copy()
+        x2 = label[valid, 3].copy()
+        label[valid, 1] = 1.0 - x2
+        label[valid, 3] = 1.0 - x1
+        return flipped, label
+
+
+def CreateDetAugmenter(data_shape, resize=0, rand_mirror=False,
+                       mean=None, std=None, brightness=0, contrast=0,
+                       saturation=0, pca_noise=0, rand_crop=0,
+                       rand_pad=0, **kwargs):
+    """Detection augmentation pipeline (reference: CreateDetAugmenter).
+
+    Geometry support here is resize + mirror (boxes move with pixels);
+    the reference's IoU-sampled rand_crop/rand_pad modes are not
+    implemented (documented divergence — raise rather than silently
+    corrupt boxes)."""
+    if rand_crop or rand_pad:
+        raise MXNetError("CreateDetAugmenter: rand_crop/rand_pad (IoU-"
+                         "sampled geometry) not supported; use resize + "
+                         "rand_mirror + color augmenters")
+    h, w = data_shape[1], data_shape[2]
+    auglist = []
+    if resize > 0:
+        # resize-short first (uniform scale: normalised boxes unchanged)
+        auglist.append(DetBorrowAug(ResizeAug(resize)))
+    auglist.append(DetBorrowAug(ForceResizeAug((w, h))))
+    if rand_mirror:
+        auglist.append(DetHorizontalFlipAug(0.5))
+    auglist.append(DetBorrowAug(CastAug()))
+    jitters = []
+    if brightness:
+        jitters.append(BrightnessJitterAug(brightness))
+    if contrast:
+        jitters.append(ContrastJitterAug(contrast))
+    if saturation:
+        jitters.append(SaturationJitterAug(saturation))
+    if jitters:
+        auglist.append(DetBorrowAug(RandomOrderAug(jitters)))
+    if pca_noise:
+        eigval = np.array([55.46, 4.794, 1.148], np.float32)
+        eigvec = np.array([[-0.5675, 0.7192, 0.4009],
+                           [-0.5808, -0.0045, -0.8140],
+                           [-0.5836, -0.6948, 0.4203]], np.float32)
+        auglist.append(DetBorrowAug(LightingAug(pca_noise, eigval,
+                                                eigvec)))
+    if mean is True:
+        mean = np.array([123.68, 116.28, 103.53], np.float32)
+    if std is True:
+        std = np.array([58.395, 57.12, 57.375], np.float32)
+    if mean is not None:
+        auglist.append(DetBorrowAug(ColorNormalizeAug(
+            mean, std if std is not None else np.ones(3, np.float32))))
+    return auglist
+
+
+def scale_down(src_size, size):
+    """Shrink (w, h) to fit inside src_size keeping aspect (reference:
+    image.scale_down)."""
+    w, h = size
+    sw, sh = src_size
+    if sh < h:
+        w, h = float(w * sh) / h, sh
+    if sw < w:
+        w, h = sw, float(h * sw) / w
+    return int(w), int(h)
+
+
+def copyMakeBorder(src, top, bot, left, right, type=0, values=0.0):
+    """Pad an HWC image with a constant border (reference:
+    image.copyMakeBorder / cv2 semantics, constant mode only)."""
+    if type != 0:
+        raise MXNetError("copyMakeBorder: only BORDER_CONSTANT (type=0) "
+                         "is supported")
+    arr = src.asnumpy() if hasattr(src, "asnumpy") else np.asarray(src)
+    out = np.pad(arr, ((top, bot), (left, right), (0, 0)),
+                 constant_values=values)
+    return array(out)
+
+
 class ImageDetIter(ImageIter):
     """Detection variant (reference: image/detection.py ImageDetIter):
     labels are object lists in the reference det-record format
@@ -521,6 +637,13 @@ class ImageDetIter(ImageIter):
                 for a in augs:
                     if isinstance(a, RandomOrderAug):
                         yield from flatten(a.ts)
+                    elif isinstance(a, DetBorrowAug):
+                        # borrowed image augs still crop/flip pixels
+                        # without touching boxes — validate the wrapped
+                        # augmenter, not the wrapper
+                        yield from flatten([a.augmenter])
+                    elif isinstance(a, DetAugmenter):
+                        continue   # label-aware: moves boxes WITH pixels
                     else:
                         yield a
             bad = [a for a in flatten(aug_list)
@@ -539,7 +662,19 @@ class ImageDetIter(ImageIter):
             self.provide_label[0].name,
             (batch_size, max_objects, object_width))]
 
+    def _postprocess(self, label, img):
+        label = self._convert_label(label)
+        for aug in self.auglist:
+            if isinstance(aug, DetAugmenter):
+                img, label = aug(img, label)
+            else:
+                img = aug(img)
+        arr = img.asnumpy() if hasattr(img, "asnumpy") else np.asarray(img)
+        return label, arr.astype(np.float32).transpose(2, 0, 1)
+
     def _convert_label(self, flat):
+        if isinstance(flat, np.ndarray) and flat.ndim == 2:
+            return flat                  # already packed (post-augment)
         flat = np.asarray(flat, np.float32).ravel()
         if flat.size < 2:
             raise MXNetError(f"det record label too short ({flat.size} "
@@ -565,7 +700,9 @@ class ImageDetIter(ImageIter):
         return np.stack(labels)
 
 
-# crops/flips move pixels without moving boxes; ImageDetIter
-# rejects them (see its docstring)
-ImageDetIter._GEOMETRIC_AUGS = (ResizeAug, CenterCropAug,
-                               RandomCropAug, HorizontalFlipAug)
+# crops/flips move pixels without moving boxes; ImageDetIter rejects
+# them (see its docstring). Full-image resizes (ResizeAug/
+# ForceResizeAug) are NOT here: boxes are stored normalised, and a
+# whole-image rescale leaves normalised coordinates unchanged.
+ImageDetIter._GEOMETRIC_AUGS = (CenterCropAug, RandomCropAug,
+                                HorizontalFlipAug)

@@ -17,7 +17,8 @@ from .ndarray.ndarray import NDArray, array
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
            "PrefetchingIter", "ImageRecordIter", "CSVIter", "LibSVMIter",
-           "MNISTIter"]
+           "MNISTIter",
+           "ImageDetRecordIter"]
 
 DataDesc = namedtuple("DataDesc", ["name", "shape"])
 
@@ -473,3 +474,32 @@ class MNISTIter(DataIter):
 
     def next(self):
         return self._inner.next()
+
+
+def ImageDetRecordIter(batch_size, data_shape, path_imgrec=None,
+                       label_pad_width=None, label_pad_value=-1.0,
+                       object_width=5, max_objects=None, **kwargs):
+    """Detection record iterator (reference: io.ImageDetRecordIter, the
+    C++ iter over det-packed RecordIO). Thin wrapper over
+    image.ImageDetIter translating the C++ parameter names:
+    label_pad_width (padded label length in floats, incl. the 2-float
+    header) maps to max_objects; label_pad_value must stay the -1
+    sentinel every consumer here checks for."""
+    if float(label_pad_value) != -1.0:
+        raise MXNetError("ImageDetRecordIter: label_pad_value must be "
+                         "-1 (the pad sentinel detection ops test for)")
+    if max_objects is None:
+        if label_pad_width is not None:
+            body = int(label_pad_width) - 2
+            if body <= 0 or body % int(object_width):
+                raise MXNetError(
+                    f"ImageDetRecordIter: label_pad_width "
+                    f"{label_pad_width} does not decompose as 2-float "
+                    f"header + k*object_width({object_width})")
+            max_objects = body // int(object_width)
+        else:
+            max_objects = 8
+    from .image import ImageDetIter
+    return ImageDetIter(batch_size, data_shape, path_imgrec=path_imgrec,
+                        max_objects=max_objects,
+                        object_width=object_width, **kwargs)

@@ -4,7 +4,7 @@ from __future__ import annotations
 import math
 
 __all__ = ["LRScheduler", "FactorScheduler", "MultiFactorScheduler",
-           "PolyScheduler", "CosineScheduler"]
+           "PolyScheduler", "CosineScheduler", "WarmUpScheduler"]
 
 
 class LRScheduler:
@@ -90,3 +90,28 @@ class CosineScheduler(LRScheduler):
         t = min(num_update - self.warmup_steps, span) / span
         return self.final_lr + (self.base_lr - self.final_lr) \
             * (1 + math.cos(math.pi * t)) / 2
+
+
+class WarmUpScheduler(LRScheduler):
+    """Linear warmup wrapped around any base scheduler (reference:
+    gluonnlp-style WarmUpScheduler; upstream schedulers take
+    warmup_steps inline — this is the composable form): lr ramps
+    0 -> base over `warmup_steps`, then delegates."""
+
+    def __init__(self, base_scheduler, warmup_steps=0,
+                 warmup_begin_lr=0.0, warmup_mode="linear", **kwargs):
+        if getattr(base_scheduler, "warmup_steps", 0):
+            raise ValueError(
+                "WarmUpScheduler: base scheduler already has "
+                "warmup_steps — composing two warmups would dip the lr "
+                "right after the outer ramp ends")
+        base_lr = getattr(base_scheduler, "base_lr", 0.01)
+        super().__init__(base_lr=base_lr, warmup_steps=int(warmup_steps),
+                         warmup_begin_lr=warmup_begin_lr,
+                         warmup_mode=warmup_mode)
+        self.base_scheduler = base_scheduler
+
+    def __call__(self, num_update):
+        if self.warmup_steps and num_update < self.warmup_steps:
+            return self.get_warmup_lr(num_update)   # base-class ramp
+        return self.base_scheduler(num_update - self.warmup_steps)

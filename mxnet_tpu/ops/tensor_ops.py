@@ -37,7 +37,8 @@ __all__ = [
     "concat", "concatenate", "stack", "split", "split_v2", "tile",
     "repeat", "pad", "masked_softmax", "cast_storage",
     "slice", "slice_axis", "slice_like", "flip", "reverse", "swapaxes",
-    "depth_to_space", "space_to_depth",
+    "depth_to_space", "space_to_depth", "moveaxis", "rollaxis",
+    "array_split",
     # indexing / selection
     "take", "pick", "gather_nd", "scatter_nd", "where", "boolean_mask",
     "one_hot", "topk", "sort", "argsort", "shuffle", "diag",
@@ -360,6 +361,30 @@ def flip(data, axis):
 
 
 reverse = flip
+
+
+def moveaxis(data, source, destination):
+    return _apply(lambda a: jnp.moveaxis(a, source, destination), [data])
+
+
+def rollaxis(data, axis, start=0):
+    return _apply(lambda a: jnp.rollaxis(a, axis, start), [data])
+
+
+def array_split(data, indices_or_sections, axis=0):
+    """numpy array_split semantics: an int gives that many (possibly
+    unequal) parts; a tuple gives split points."""
+    secs = indices_or_sections
+    if isinstance(secs, int):
+        n_out = secs
+    else:
+        secs = tuple(int(i) for i in secs)
+        n_out = len(secs) + 1
+
+    def fn(a, _s=secs, _ax=axis):
+        return tuple(jnp.array_split(a, _s, _ax))
+    out = _apply(fn, [data], n_out=n_out)
+    return list(out) if isinstance(out, tuple) else [out]
 
 
 def swapaxes(data, dim1, dim2):

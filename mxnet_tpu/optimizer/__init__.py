@@ -1,5 +1,5 @@
 """mx.optimizer namespace (reference: python/mxnet/optimizer/)."""
-from .optimizer import (Optimizer, SGD, NAG, Adam, AdamW, Adamax, Nadam,
+from .optimizer import (Optimizer, SGD, NAG, Adam, AdaBelief, AdamW, Adamax, Nadam,
                         AdaGrad, AdaDelta, RMSProp, Ftrl, Ftml, LAMB, LARS,
                         Signum, SGLD, DCASGD, create, register)
 from . import optimizer as opt

@@ -22,7 +22,7 @@ __all__ = ["Optimizer", "SGD", "NAG", "Adam", "AdamW", "Adamax", "Nadam",
            "AdaGrad", "AdaDelta", "RMSProp", "Ftrl", "Ftml", "LAMB", "LARS",
            "Signum", "SGLD", "DCASGD", "create", "register",
            "fused_sgd_mom_kernel", "multi_sgd_mom_update",
-           "multi_sgd_update"]
+           "multi_sgd_update", "AdaBelief"]
 
 _REGISTRY = {}
 
@@ -212,6 +212,25 @@ class Adam(Optimizer):
         mhat = m / (1 - self.beta1 ** tf)
         vhat = v / (1 - self.beta2 ** tf)
         return w - lr * mhat / (jnp.sqrt(vhat) + self.epsilon), (m, v, t)
+
+
+@register
+class AdaBelief(Adam):
+    """AdaBelief (Zhuang et al. 2020, upstream contrib): Adam with the
+    second moment over the PREDICTION ERROR (g - m) instead of g —
+    adapts the step to the gradient's deviation from its own trend."""
+
+    def apply(self, w, g, state, lr, wd):
+        m, s, t = state
+        t = t + 1
+        g = g + wd * w
+        m = self.beta1 * m + (1 - self.beta1) * g
+        diff = g - m
+        s = self.beta2 * s + (1 - self.beta2) * diff * diff + self.epsilon
+        tf = t.astype(jnp.float32)
+        mhat = m / (1 - self.beta1 ** tf)
+        shat = s / (1 - self.beta2 ** tf)
+        return w - lr * mhat / (jnp.sqrt(shat) + self.epsilon), (m, s, t)
 
 
 @register

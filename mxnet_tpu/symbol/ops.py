@@ -1242,3 +1242,26 @@ def im2col(data, kernel, stride=1, dilate=1, pad=0, name=None, **kw):
 __all__ += ["add_n", "Crop", "ROIPooling", "GridGenerator",
             "BilinearSampler", "SpatialTransformer", "Correlation",
             "im2col"]
+
+
+register_op("ones_like", jnp.ones_like)
+register_op("zeros_like", jnp.zeros_like)
+register_op("full", lambda shape=(), val=0.0, dtype=None:
+            jnp.full(tuple(shape), val,
+                     _np_dtype(dtype) if dtype else jnp.float32))
+
+
+def ones_like(data, name=None):
+    return _make("ones_like", [data], {}, name=name)
+
+
+def zeros_like(data, name=None):
+    return _make("zeros_like", [data], {}, name=name)
+
+
+def full(shape, val, dtype=None, name=None, **kw):
+    return _make("full", [], {"shape": tuple(shape), "val": val,
+                              "dtype": dtype}, name=name)
+
+
+__all__ += ["ones_like", "zeros_like", "full"]

@@ -886,3 +886,106 @@ def test_wave4_review_fixes():
                 lambda: sym.Crop(sym.Variable("d"))):
         with pytest.raises(mx.base.MXNetError):
             bad()
+
+
+def test_wave5_det_data_and_misc():
+    """round-5 wave-5: det augmenter protocol (flip moves boxes with
+    pixels), CreateDetAugmenter factory, scale_down/copyMakeBorder,
+    nd moveaxis/rollaxis/array_split, sym likes/full, AdaBelief,
+    WarmUpScheduler."""
+    img = nd.array(np.zeros((8, 8, 3), np.float32))
+    lab = np.full((3, 5), -1.0, np.float32)
+    lab[0] = [1, 0.0, 0.0, 0.25, 0.5]
+    img2, lab2 = mx.image.DetHorizontalFlipAug(p=1.0)(img, lab)
+    np.testing.assert_allclose(lab2[0], [1, 0.75, 0.0, 1.0, 0.5])
+    assert (lab2[1:] == -1).all()
+    with pytest.raises(mx.base.MXNetError):
+        mx.image.CreateDetAugmenter((3, 8, 8), rand_crop=1)
+    augs = mx.image.CreateDetAugmenter((3, 8, 8), rand_mirror=True,
+                                       brightness=0.1, mean=True,
+                                       std=True)
+    out, lab3 = img, lab
+    for a in augs:
+        if isinstance(a, mx.image.DetAugmenter):
+            out, lab3 = a(out, lab3)
+        else:
+            out = a(out)
+    assert np.isfinite(np.asarray(out.asnumpy())).all()
+    assert mx.image.scale_down((8, 8), (16, 4)) == (8, 2)
+    b = mx.image.copyMakeBorder(img, 1, 2, 3, 4, values=7.0)
+    assert b.shape == (11, 15, 3) and float(b.asnumpy()[0, 0, 0]) == 7.0
+    x = nd.array(np.arange(24, dtype=np.float32).reshape(2, 3, 4))
+    assert nd.moveaxis(x, 0, 2).shape == (3, 4, 2)
+    assert nd.rollaxis(x, 2).shape == (4, 2, 3)
+    parts = nd.array_split(nd.array(np.arange(7, dtype=np.float32)), 3)
+    assert [p.shape[0] for p in parts] == [3, 2, 2]
+    v = nd.array(np.ones((2, 2), np.float32))
+    o = sym.ones_like(sym.Variable("v")).bind(
+        mx.cpu(), {"v": v}).forward()[0]
+    assert (o.asnumpy() == 1).all()
+    f = mx.sym.load_json(sym.full((2, 3), 7.0).tojson()).bind(
+        mx.cpu(), {}).forward()[0]
+    assert f.shape == (2, 3) and (f.asnumpy() == 7).all()
+    # AdaBelief closed-form first step: w -= lr * sign-ish update
+    opt = mx.optimizer.create("adabelief", learning_rate=0.1)
+    import jax.numpy as jnp
+    st = opt.init_state(jnp.ones(2))
+    w2, st2 = opt.apply(jnp.ones(2), jnp.ones(2) * 0.5, st, 0.1, 0.0)
+    assert np.isfinite(np.asarray(w2)).all() and w2[0] < 1.0
+    s = mx.lr_scheduler.WarmUpScheduler(
+        mx.lr_scheduler.FactorScheduler(step=100, factor=0.5,
+                                        base_lr=0.1), warmup_steps=10)
+    assert abs(s(5) - 0.05) < 1e-9 and abs(s(10) - 0.1) < 1e-9
+    # ImageDetRecordIter translates the C++ kwargs onto the det reader
+    with pytest.raises(mx.base.MXNetError):
+        mx.io.ImageDetRecordIter(1, (3, 8, 8), label_pad_width=11)
+    with pytest.raises(mx.base.MXNetError):
+        mx.io.ImageDetRecordIter(1, (3, 8, 8), label_pad_value=0.0)
+
+
+
+def test_wave5_review_fixes():
+    """review r5 wave5: DetBorrowAug can't smuggle geometric augs,
+    CreateDetAugmenter honors resize, copyMakeBorder rejects
+    non-constant borders, WarmUpScheduler refuses double warmup and
+    reuses the base-class ramp, label_pad_width maps to max_objects."""
+    with pytest.raises(mx.base.MXNetError):
+        mx.image.ImageDetIter(
+            1, (3, 8, 8), path_imglist=None, path_imgrec="/nonexistent",
+            aug_list=[mx.image.DetBorrowAug(
+                mx.image.RandomCropAug((4, 4)))])
+    augs = mx.image.CreateDetAugmenter((3, 8, 8), resize=12)
+    assert any(isinstance(a, mx.image.DetBorrowAug)
+               and isinstance(a.augmenter, mx.image.ResizeAug)
+               for a in augs)
+    img = nd.zeros((4, 4, 3))
+    with pytest.raises(mx.base.MXNetError):
+        mx.image.copyMakeBorder(img, 1, 1, 1, 1, type=2)
+    import pytest as _pt
+    with _pt.raises(ValueError):
+        mx.lr_scheduler.WarmUpScheduler(
+            mx.lr_scheduler.FactorScheduler(step=10, base_lr=0.1,
+                                            warmup_steps=5),
+            warmup_steps=10)
+    s = mx.lr_scheduler.WarmUpScheduler(
+        mx.lr_scheduler.FactorScheduler(step=100, factor=0.5,
+                                        base_lr=0.1), warmup_steps=10)
+    assert abs(s(5) - 0.05) < 1e-9 and abs(s(10) - 0.1) < 1e-9
+    # label_pad_width 2 + 3*5 = 17 -> 3 objects
+    import struct, tempfile, os
+    import numpy as _np
+    from mxnet_tpu import recordio
+    d = tempfile.mkdtemp()
+    rec = recordio.MXIndexedRecordIO(os.path.join(d, "a.idx"),
+                                     os.path.join(d, "a.rec"), "w")
+    img8 = (_np.random.RandomState(0).rand(8, 8, 3) * 255).astype(
+        _np.uint8)
+    lab = _np.array([2, 5, 1, 0.1, 0.1, 0.5, 0.5], _np.float32)
+    rec.write_idx(0, recordio.pack_img(
+        recordio.IRHeader(len(lab), lab, 0, 0), img8))
+    rec.close()
+    it = mx.io.ImageDetRecordIter(
+        1, (3, 8, 8), path_imgrec=os.path.join(d, "a.rec"),
+        path_imgidx=os.path.join(d, "a.idx"), label_pad_width=17)
+    b = next(iter(it))
+    assert b.label[0].shape == (1, 3, 5)
