@@ -10,3 +10,12 @@ from . import quantization
 from .quantization import quantize_model, quantize_net
 from . import onnx
 from .onnx import export_model as export_onnx
+from . import text
+from . import io
+from . import autograd
+from . import tensorboard
+
+# upstream exposes the op namespaces under contrib too
+# (mx.contrib.ndarray IS mx.nd.contrib, same module object)
+from ..ndarray import contrib as ndarray
+from ..symbol import contrib as symbol

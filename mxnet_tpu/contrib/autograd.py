@@ -1,0 +1,31 @@
+"""mx.contrib.autograd (reference: python/mxnet/contrib/autograd.py —
+the pre-1.0 experimental API): thin aliases over the stable mx.autograd
+so ancient scripts import-port cleanly."""
+from __future__ import annotations
+
+from ..autograd import (record as train_section,  # noqa: F401
+                        pause as test_section)
+from ..autograd import backward, grad, mark_variables  # noqa: F401
+
+__all__ = ["train_section", "test_section", "backward", "grad",
+           "mark_variables", "grad_and_loss"]
+
+
+def grad_and_loss(func, argnum=None):
+    """Return a function computing both gradients and loss (reference:
+    contrib.autograd.grad_and_loss)."""
+    from .. import autograd as ag
+    from ..ndarray.ndarray import NDArray
+
+    def wrapped(*args):
+        ins = list(args)
+        which = range(len(ins)) if argnum is None else (
+            [argnum] if isinstance(argnum, int) else list(argnum))
+        for i in which:
+            if isinstance(ins[i], NDArray):
+                ins[i].attach_grad()
+        with ag.record():
+            out = func(*ins)
+        out.backward()
+        return [ins[i].grad for i in which], out
+    return wrapped

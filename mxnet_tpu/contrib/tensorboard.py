@@ -1,0 +1,34 @@
+"""mx.contrib.tensorboard (reference: python/mxnet/contrib/
+tensorboard.py): LogMetricsCallback streaming eval metrics to a
+TensorBoard event file. The writer dependency (tensorboardX /
+torch.utils.tensorboard) is optional; without it the constructor
+raises with guidance (this environment ships torch-cpu, whose
+SummaryWriter works offline)."""
+from __future__ import annotations
+
+__all__ = ["LogMetricsCallback"]
+
+
+class LogMetricsCallback:
+    def __init__(self, logging_dir, prefix=None):
+        try:
+            from torch.utils.tensorboard import SummaryWriter
+        except Exception:
+            try:
+                from tensorboardX import SummaryWriter  # type: ignore
+            except Exception as e:
+                raise ImportError(
+                    "contrib.tensorboard needs torch.utils.tensorboard "
+                    "or tensorboardX for the event writer") from e
+        self.prefix = prefix
+        self._step = 0
+        self.summary_writer = SummaryWriter(logging_dir)
+
+    def __call__(self, param):
+        if param.eval_metric is None:
+            return
+        self._step += 1
+        for name, value in param.eval_metric.get_name_value():
+            if self.prefix is not None:
+                name = f"{self.prefix}-{name}"
+            self.summary_writer.add_scalar(name, value, self._step)

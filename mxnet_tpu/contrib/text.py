@@ -1,0 +1,250 @@
+"""mx.contrib.text — vocabulary + token embeddings (reference:
+python/mxnet/contrib/text/{vocab,embedding,utils}.py).
+
+The reference downloads pretrained GloVe/fastText tables; this
+environment has zero egress, so pretrained names raise with guidance
+and `CustomEmbedding` loads any local token-vector file — the same
+object model (Vocabulary composition, token_to_idx/idx_to_token,
+get_vecs_by_tokens) the reference tooling builds on.
+"""
+from __future__ import annotations
+
+import re
+
+import numpy as np
+
+from ..base import MXNetError
+
+__all__ = ["utils", "vocab", "embedding", "Vocabulary"]
+
+
+def count_tokens_from_str(source_str, token_delim=" ", seq_delim="\n",
+                          to_lower=False, counter_to_update=None):
+    """Token counter over a delimited string (reference:
+    text.utils.count_tokens_from_str)."""
+    import collections
+    source = source_str.lower() if to_lower else source_str
+    # upstream semantics: delimiters are regex ALTERNATES (multi-char
+    # delimiters split as whole tokens, not per character)
+    tokens = [t for t in re.split(f"{token_delim}|{seq_delim}", source)
+              if t]
+    counter = counter_to_update if counter_to_update is not None \
+        else collections.Counter()
+    counter.update(tokens)
+    return counter
+
+
+class Vocabulary:
+    """Indexed vocabulary with reserved tokens (reference:
+    text.vocab.Vocabulary): index 0 is `unknown_token`; tokens rank by
+    frequency then alphabetically, capped by most_freq_count and
+    min_freq."""
+
+    def __init__(self, counter=None, most_freq_count=None, min_freq=1,
+                 unknown_token="<unk>", reserved_tokens=None):
+        if min_freq < 1:
+            raise MXNetError("Vocabulary: min_freq must be >= 1")
+        reserved_tokens = list(reserved_tokens or [])
+        if unknown_token in reserved_tokens:
+            raise MXNetError("Vocabulary: unknown_token must not be in "
+                             "reserved_tokens")
+        if len(set(reserved_tokens)) != len(reserved_tokens):
+            raise MXNetError("Vocabulary: duplicate reserved tokens")
+        self._unknown_token = unknown_token
+        self._reserved_tokens = reserved_tokens
+        self._idx_to_token = [unknown_token] + reserved_tokens
+        if counter is not None:
+            special = set(self._idx_to_token)
+            pairs = sorted(counter.items(), key=lambda kv: (-kv[1], kv[0]))
+            if most_freq_count is not None:
+                pairs = pairs[:most_freq_count]
+            for tok, freq in pairs:
+                if freq >= min_freq and tok not in special:
+                    self._idx_to_token.append(tok)
+        self._token_to_idx = {t: i for i, t in
+                              enumerate(self._idx_to_token)}
+
+    def __len__(self):
+        return len(self._idx_to_token)
+
+    @property
+    def token_to_idx(self):
+        return self._token_to_idx
+
+    @property
+    def idx_to_token(self):
+        return self._idx_to_token
+
+    @property
+    def unknown_token(self):
+        return self._unknown_token
+
+    @property
+    def reserved_tokens(self):
+        return self._reserved_tokens
+
+    def to_indices(self, tokens):
+        """Token(s) -> index/indices, unknowns map to index 0."""
+        single = isinstance(tokens, str)
+        toks = [tokens] if single else tokens
+        idx = [self._token_to_idx.get(t, 0) for t in toks]
+        return idx[0] if single else idx
+
+    def to_tokens(self, indices):
+        single = isinstance(indices, int)
+        idxs = [indices] if single else indices
+        for i in idxs:
+            if not 0 <= i < len(self):
+                raise MXNetError(f"Vocabulary: index {i} out of range")
+        toks = [self._idx_to_token[i] for i in idxs]
+        return toks[0] if single else toks
+
+
+class _TokenEmbedding(Vocabulary):
+    """Base embedding: vocabulary + (V, D) vector table (reference:
+    text.embedding._TokenEmbedding)."""
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self._vec_len = 0
+        self._idx_to_vec = None
+
+    @property
+    def vec_len(self):
+        return self._vec_len
+
+    @property
+    def idx_to_vec(self):
+        return self._idx_to_vec
+
+    def _load_embedding_table(self, path, elem_delim=" ",
+                              encoding="utf8"):
+        tokens, vecs = [], []
+        with open(path, encoding=encoding) as f:
+            for ln, line in enumerate(f):
+                parts = line.rstrip().split(elem_delim)
+                if len(parts) < 2:
+                    continue
+                tok, vals = parts[0], parts[1:]
+                if ln == 0 and len(vals) == 1:
+                    continue        # fastText-style "count dim" header
+                try:
+                    vec = np.asarray([float(v) for v in vals], np.float32)
+                except ValueError as e:
+                    raise MXNetError(
+                        f"{path}:{ln + 1}: bad embedding row ({e})") from e
+                if self._vec_len and vec.size != self._vec_len:
+                    raise MXNetError(
+                        f"{path}:{ln + 1}: vector length {vec.size} != "
+                        f"{self._vec_len}")
+                self._vec_len = vec.size
+                tokens.append(tok)
+                vecs.append(vec)
+        if not tokens:
+            raise MXNetError(f"{path}: no embedding rows found")
+        # index 0 = unknown -> zero vector (reference init)
+        self._idx_to_token = [self.unknown_token] + tokens
+        self._token_to_idx = {t: i for i, t in
+                              enumerate(self._idx_to_token)}
+        table = np.zeros((len(self._idx_to_token), self._vec_len),
+                         np.float32)
+        table[1:] = np.stack(vecs)
+        self._idx_to_vec = table
+
+    def get_vecs_by_tokens(self, tokens, lower_case_backup=False):
+        """Token(s) -> vector(s); unknown tokens get the zero vector."""
+        from ..ndarray.ndarray import array
+        single = isinstance(tokens, str)
+        toks = [tokens] if single else tokens
+        idx = []
+        for t in toks:
+            i = self._token_to_idx.get(t)
+            if i is None and lower_case_backup:
+                i = self._token_to_idx.get(t.lower())
+            idx.append(0 if i is None else i)
+        vecs = self._idx_to_vec[idx]
+        return array(vecs[0] if single else vecs)
+
+    def update_token_vectors(self, tokens, new_vectors):
+        toks = [tokens] if isinstance(tokens, str) else tokens
+        vals = np.asarray(
+            new_vectors.asnumpy() if hasattr(new_vectors, "asnumpy")
+            else new_vectors, np.float32).reshape(len(toks), -1)
+        for t, v in zip(toks, vals):
+            if t not in self._token_to_idx:
+                raise MXNetError(f"update_token_vectors: {t!r} not in "
+                                 "the embedding vocabulary")
+            self._idx_to_vec[self._token_to_idx[t]] = v
+
+
+class CustomEmbedding(_TokenEmbedding):
+    """Load embeddings from a local token-vector text file (reference:
+    text.embedding.CustomEmbedding) — one 'token v0 v1 ...' row per
+    line."""
+
+    def __init__(self, pretrained_file_path, elem_delim=" ",
+                 encoding="utf8", vocabulary=None, **kwargs):
+        super().__init__(**kwargs)
+        self._load_embedding_table(pretrained_file_path, elem_delim,
+                                   encoding)
+        if vocabulary is not None:
+            self._restrict_to(vocabulary)
+
+    def _restrict_to(self, vocabulary):
+        """Reindex the table onto `vocabulary`'s tokens (reference:
+        embeddings compose with an explicit Vocabulary)."""
+        table = np.zeros((len(vocabulary), self._vec_len), np.float32)
+        for i, tok in enumerate(vocabulary.idx_to_token):
+            j = self._token_to_idx.get(tok)
+            if j is not None:
+                table[i] = self._idx_to_vec[j]
+        self._idx_to_token = list(vocabulary.idx_to_token)
+        self._token_to_idx = dict(vocabulary.token_to_idx)
+        self._idx_to_vec = table
+
+
+class CompositeEmbedding(_TokenEmbedding):
+    """Concatenate several embeddings over one vocabulary (reference:
+    text.embedding.CompositeEmbedding)."""
+
+    def __init__(self, vocabulary, token_embeddings, **kwargs):
+        super().__init__(**kwargs)
+        embs = token_embeddings if isinstance(token_embeddings,
+                                              (list, tuple)) \
+            else [token_embeddings]
+        self._idx_to_token = list(vocabulary.idx_to_token)
+        self._token_to_idx = dict(vocabulary.token_to_idx)
+        parts = []
+        for e in embs:
+            t = np.zeros((len(vocabulary), e.vec_len), np.float32)
+            for i, tok in enumerate(vocabulary.idx_to_token):
+                j = e.token_to_idx.get(tok)
+                if j is not None:
+                    t[i] = e.idx_to_vec[j]
+            parts.append(t)
+        self._idx_to_vec = np.concatenate(parts, axis=1)
+        self._vec_len = self._idx_to_vec.shape[1]
+
+
+def _no_pretrained(name):
+    def ctor(*a, **k):
+        raise MXNetError(
+            f"contrib.text.embedding.{name}: pretrained tables need "
+            "network access (none in this environment) — load a local "
+            "file with CustomEmbedding(pretrained_file_path=...)")
+    return ctor
+
+
+class _Namespace:
+    def __init__(self, **members):
+        self.__dict__.update(members)
+
+
+utils = _Namespace(count_tokens_from_str=count_tokens_from_str)
+vocab = _Namespace(Vocabulary=Vocabulary)
+embedding = _Namespace(
+    CustomEmbedding=CustomEmbedding,
+    CompositeEmbedding=CompositeEmbedding,
+    GloVe=_no_pretrained("GloVe"),
+    FastText=_no_pretrained("FastText"),
+    get_pretrained_file_names=_no_pretrained("get_pretrained_file_names"))

@@ -325,6 +325,36 @@ def random_size_crop(src, size, area, ratio, rng=None, **kwargs):
     return out, box
 
 
+def _color_augmenters(brightness=0, contrast=0, saturation=0,
+                      pca_noise=0, mean=None, std=None):
+    """The ONE color-jitter + PCA-noise + normalize tail shared by
+    CreateAugmenter and CreateDetAugmenter (constants live here only)."""
+    out = []
+    jitters = []
+    if brightness:
+        jitters.append(BrightnessJitterAug(brightness))
+    if contrast:
+        jitters.append(ContrastJitterAug(contrast))
+    if saturation:
+        jitters.append(SaturationJitterAug(saturation))
+    if jitters:
+        out.append(RandomOrderAug(jitters))
+    if pca_noise:
+        eigval = np.array([55.46, 4.794, 1.148], np.float32)
+        eigvec = np.array([[-0.5675, 0.7192, 0.4009],
+                           [-0.5808, -0.0045, -0.8140],
+                           [-0.5836, -0.6948, 0.4203]], np.float32)
+        out.append(LightingAug(pca_noise, eigval, eigvec))
+    if mean is True:
+        mean = np.array([123.68, 116.28, 103.53], np.float32)
+    if std is True:
+        std = np.array([58.395, 57.12, 57.375], np.float32)
+    if mean is not None and mean is not False:
+        out.append(ColorNormalizeAug(mean, std if std is not None
+                                     and std is not False else [1, 1, 1]))
+    return out
+
+
 def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_mirror=False,
                     mean=None, std=None, **kwargs):
     """Build the reference's standard augmentation pipeline."""
@@ -339,31 +369,11 @@ def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_mirror=False,
     if rand_mirror:
         auglist.append(HorizontalFlipAug(0.5))
     auglist.append(CastAug())   # reference emits float32 unconditionally
-    brightness = kwargs.get("brightness", 0)
-    contrast = kwargs.get("contrast", 0)
-    saturation = kwargs.get("saturation", 0)
-    jitters = []
-    if brightness:
-        jitters.append(BrightnessJitterAug(brightness))
-    if contrast:
-        jitters.append(ContrastJitterAug(contrast))
-    if saturation:
-        jitters.append(SaturationJitterAug(saturation))
-    if jitters:
-        auglist.append(RandomOrderAug(jitters))
-    if kwargs.get("pca_noise", 0):
-        eigval = np.array([55.46, 4.794, 1.148], np.float32)
-        eigvec = np.array([[-0.5675, 0.7192, 0.4009],
-                           [-0.5808, -0.0045, -0.8140],
-                           [-0.5836, -0.6948, 0.4203]], np.float32)
-        auglist.append(LightingAug(kwargs["pca_noise"], eigval, eigvec))
-    if mean is True:
-        mean = np.array([123.68, 116.28, 103.53], np.float32)
-    if std is True:
-        std = np.array([58.395, 57.12, 57.375], np.float32)
-    if mean is not None and mean is not False:
-        auglist.append(ColorNormalizeAug(mean, std if std is not None
-                                         and std is not False else [1, 1, 1]))
+    auglist.extend(_color_augmenters(
+        brightness=kwargs.get("brightness", 0),
+        contrast=kwargs.get("contrast", 0),
+        saturation=kwargs.get("saturation", 0),
+        pca_noise=kwargs.get("pca_noise", 0), mean=mean, std=std))
     return auglist
 
 
@@ -558,29 +568,9 @@ def CreateDetAugmenter(data_shape, resize=0, rand_mirror=False,
     if rand_mirror:
         auglist.append(DetHorizontalFlipAug(0.5))
     auglist.append(DetBorrowAug(CastAug()))
-    jitters = []
-    if brightness:
-        jitters.append(BrightnessJitterAug(brightness))
-    if contrast:
-        jitters.append(ContrastJitterAug(contrast))
-    if saturation:
-        jitters.append(SaturationJitterAug(saturation))
-    if jitters:
-        auglist.append(DetBorrowAug(RandomOrderAug(jitters)))
-    if pca_noise:
-        eigval = np.array([55.46, 4.794, 1.148], np.float32)
-        eigvec = np.array([[-0.5675, 0.7192, 0.4009],
-                           [-0.5808, -0.0045, -0.8140],
-                           [-0.5836, -0.6948, 0.4203]], np.float32)
-        auglist.append(DetBorrowAug(LightingAug(pca_noise, eigval,
-                                                eigvec)))
-    if mean is True:
-        mean = np.array([123.68, 116.28, 103.53], np.float32)
-    if std is True:
-        std = np.array([58.395, 57.12, 57.375], np.float32)
-    if mean is not None:
-        auglist.append(DetBorrowAug(ColorNormalizeAug(
-            mean, std if std is not None else np.ones(3, np.float32))))
+    auglist.extend(DetBorrowAug(a) for a in _color_augmenters(
+        brightness=brightness, contrast=contrast, saturation=saturation,
+        pca_noise=pca_noise, mean=mean, std=std))
     return auglist
 
 

@@ -1,0 +1,126 @@
+"""mx.contrib.text + the round-5 contrib submodules (reference:
+python/mxnet/contrib/{text,io,autograd,tensorboard}.py)."""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, gluon
+from mxnet_tpu.contrib import text
+
+
+def test_count_tokens_and_vocabulary():
+    c = text.utils.count_tokens_from_str("a b b c c c\nd a",
+                                         to_lower=True)
+    assert c["c"] == 3 and c["a"] == 2
+    v = text.vocab.Vocabulary(c, min_freq=2,
+                              reserved_tokens=["<pad>"])
+    assert v.idx_to_token[:2] == ["<unk>", "<pad>"]
+    # frequency rank then alpha; min_freq drops d (freq 1)
+    assert "d" not in v.token_to_idx and "b" in v.token_to_idx
+    assert v.to_indices("zzz") == 0
+    assert v.to_tokens(0) == "<unk>"
+    with pytest.raises(mx.base.MXNetError):
+        v.to_tokens(len(v))
+    with pytest.raises(mx.base.MXNetError):
+        text.vocab.Vocabulary(c, unknown_token="<pad>",
+                              reserved_tokens=["<pad>"])
+
+
+def test_custom_and_composite_embedding(tmp_path):
+    p = tmp_path / "emb.txt"
+    p.write_text("a 1.0 2.0\nb 3.0 4.0\nc 5.0 6.0\n")
+    emb = text.embedding.CustomEmbedding(str(p))
+    assert emb.vec_len == 2 and len(emb) == 4   # <unk> + 3
+    np.testing.assert_allclose(
+        emb.get_vecs_by_tokens(["b", "nope"]).asnumpy(),
+        [[3, 4], [0, 0]])
+    emb.update_token_vectors("a", nd.array(np.array([9.0, 9.0],
+                                                    np.float32)))
+    np.testing.assert_allclose(
+        emb.get_vecs_by_tokens("a").asnumpy(), [9, 9])
+    # restricted onto an explicit vocabulary
+    import collections
+    v = text.vocab.Vocabulary(collections.Counter(
+        {"a": 2, "b": 2, "x": 2}))
+    emb2 = text.embedding.CustomEmbedding(str(p), vocabulary=v)
+    assert len(emb2) == len(v)
+    np.testing.assert_allclose(
+        emb2.get_vecs_by_tokens("x").asnumpy(), [0, 0])
+    comp = text.embedding.CompositeEmbedding(v, [emb2, emb2])
+    assert comp.idx_to_vec.shape == (len(v), 4)
+    with pytest.raises(mx.base.MXNetError):
+        text.embedding.GloVe()
+    # corrupt rows raise with the file:line
+    bad = tmp_path / "bad.txt"
+    bad.write_text("a 1.0 2.0\nb 3.0 oops\n")
+    with pytest.raises(mx.base.MXNetError):
+        text.embedding.CustomEmbedding(str(bad))
+
+
+def test_dataloader_iter_adapts_to_module():
+    rs = np.random.RandomState(0)
+    X = rs.randn(64, 4).astype(np.float32)
+    y = (X.sum(1) > 0).astype(np.float32)
+    ds = gluon.data.ArrayDataset(nd.array(X), nd.array(y))
+    it = mx.contrib.io.DataLoaderIter(
+        gluon.data.DataLoader(ds, batch_size=16))
+    assert it.provide_data[0].shape == (16, 4)
+    from mxnet_tpu.module import Module
+    from mxnet_tpu import sym
+    net = sym.SoftmaxOutput(
+        sym.FullyConnected(sym.Variable("data"), num_hidden=2,
+                           name="fc"),
+        sym.Variable("softmax_label"), name="softmax")
+    mod = Module(net, data_names=["data"],
+                 label_names=["softmax_label"])
+    mod.fit(it, num_epoch=8, optimizer="adam",
+            optimizer_params={"learning_rate": 0.05})
+    it.reset()
+    m = mx.metric.Accuracy()
+    mod.score(it, m)
+    assert m.get()[1] > 0.9, m.get()
+
+
+def test_contrib_autograd_legacy_api():
+    from mxnet_tpu.contrib import autograd as cag
+    x = nd.array(np.array([2.0, 3.0], np.float32))
+    grads, loss = cag.grad_and_loss(lambda a: (a * a).sum())(x)
+    np.testing.assert_allclose(grads[0].asnumpy(), [4.0, 6.0])
+    assert float(loss.asnumpy()) == 13.0
+    with cag.train_section():
+        pass                      # alias of autograd.record
+
+
+def test_tensorboard_callback(tmp_path):
+    pytest.importorskip("torch.utils.tensorboard")
+    cb = mx.contrib.tensorboard.LogMetricsCallback(str(tmp_path),
+                                                   prefix="val")
+    m = mx.metric.Accuracy()
+    m.update([nd.array([0, 1])], [nd.array([[0.9, 0.1], [0.2, 0.8]])])
+    from mxnet_tpu.callback import BatchEndParam
+    cb(BatchEndParam(epoch=0, nbatch=0, eval_metric=m, locals=None))
+    cb.summary_writer.flush()
+    assert any(os.listdir(tmp_path))
+
+
+def test_contrib_op_namespace_aliases():
+    assert mx.contrib.ndarray is mx.nd.contrib
+    assert mx.contrib.symbol is mx.sym.contrib
+
+
+def test_text_delimiter_and_det_std_guards():
+    """review r5: multi-char delimiters split whole tokens (upstream
+    alternation semantics); CreateDetAugmenter treats std=False like
+    CreateAugmenter does (no divide-by-zero normalize stage)."""
+    c = text.utils.count_tokens_from_str("hello<sep>world",
+                                         token_delim="<sep>")
+    assert c == {"hello": 1, "world": 1}
+    img = nd.array(np.full((4, 4, 3), 100.0, np.float32))
+    augs = mx.image.CreateDetAugmenter((3, 4, 4), mean=True, std=False)
+    out, lab = img, np.full((1, 5), -1.0, np.float32)
+    for a in augs:
+        out, lab = a(out, lab) if isinstance(a, mx.image.DetAugmenter) \
+            else (a(out), lab)
+    assert np.isfinite(np.asarray(out.asnumpy())).all()
