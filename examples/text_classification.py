@@ -1,0 +1,131 @@
+"""Text classification with mx.contrib.text (reference workflow:
+the contrib.text embedding tutorials): corpus -> Vocabulary ->
+CustomEmbedding -> embedding-initialized gluon classifier.
+
+Synthetic two-topic corpus (offline env); the embedding table is
+written locally and loaded back through the real file path, the
+Embedding layer is initialized from it, then fine-tuned end to end.
+
+Usage: python examples/text_classification.py [--epochs N] [--smoke]
+"""
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.dirname(_os.path.abspath(__file__)))
+import _smoke  # noqa: F401,E402 — forces CPU under --smoke
+import argparse
+import collections
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+from mxnet_tpu.contrib import text
+from mxnet_tpu.gluon import nn
+
+TOPICS = {
+    0: ["market", "stock", "trade", "price", "profit", "bank"],
+    1: ["goal", "match", "team", "coach", "score", "league"],
+}
+
+
+def make_corpus(n, seed):
+    rs = np.random.RandomState(seed)
+    docs, labels = [], []
+    for _ in range(n):
+        t = rs.randint(2)
+        words = list(rs.choice(TOPICS[t], 8))
+        # noise words shared by both topics
+        words += list(rs.choice(["the", "a", "of", "and"], 4))
+        rs.shuffle(words)
+        docs.append(" ".join(words))
+        labels.append(t)
+    return docs, np.array(labels, np.float32)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=8)
+    ap.add_argument("--embed-dim", type=int, default=16)
+    ap.add_argument("--seq-len", type=int, default=12)
+    ap.add_argument("--smoke", action="store_true")
+    args = ap.parse_args()
+    if args.smoke:
+        args.epochs = 4
+
+    train_docs, train_y = make_corpus(512, seed=0)
+    val_docs, val_y = make_corpus(128, seed=1)
+
+    counter = collections.Counter()
+    for d in train_docs:
+        text.utils.count_tokens_from_str(d, counter_to_update=counter)
+    vocab = text.vocab.Vocabulary(counter, min_freq=1)
+
+    # a "pretrained" table: topic words get distinct directions (stands
+    # in for GloVe, which needs downloads this env cannot do)
+    rs = np.random.RandomState(42)
+    with tempfile.TemporaryDirectory() as tmpdir:
+        emb_path = os.path.join(tmpdir, "pretrained.txt")
+        with open(emb_path, "w") as f:
+            for t, words in TOPICS.items():
+                for w in words:
+                    vec = rs.randn(args.embed_dim) * 0.1
+                    vec[t] += 1.0
+                    f.write(w + " " + " ".join(f"{v:.4f}" for v in vec)
+                            + "\n")
+            for w in ["the", "a", "of", "and"]:
+                vec = rs.randn(args.embed_dim) * 0.1
+                f.write(w + " " + " ".join(f"{v:.4f}" for v in vec)
+                        + "\n")
+        emb = text.embedding.CustomEmbedding(emb_path, vocabulary=vocab)
+
+    def encode(docs):
+        out = np.zeros((len(docs), args.seq_len), np.float32)
+        for i, d in enumerate(docs):
+            idx = vocab.to_indices(d.split()[:args.seq_len])
+            out[i, :len(idx)] = idx
+        return out
+
+    Xtr, Xva = encode(train_docs), encode(val_docs)
+
+    net = nn.HybridSequential()
+    with net.name_scope():
+        embed = nn.Embedding(len(vocab), args.embed_dim)
+        net.add(embed,
+                nn.GlobalAvgPool1D(layout="NWC"),
+                nn.Dense(2))
+    net.initialize(mx.init.Xavier())
+    # seed the Embedding from the loaded table (the classic fine-tune
+    # recipe)
+    embed.weight.set_data(nd.array(emb.idx_to_vec))
+
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 0.01})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    ds = gluon.data.ArrayDataset(nd.array(Xtr), nd.array(train_y))
+    loader = gluon.data.DataLoader(ds, batch_size=64, shuffle=True)
+    for epoch in range(args.epochs):
+        total = 0.0
+        for xb, yb in loader:
+            with autograd.record():
+                out = net(xb)
+                L = loss_fn(out, yb)
+            L.backward()
+            trainer.step(xb.shape[0])
+            total += float(L.asnumpy().mean())
+        print(f"epoch {epoch}: loss {total / len(loader):.4f}")
+
+    preds = net(nd.array(Xva)).asnumpy().argmax(1)
+    acc = float((preds == val_y).mean())
+    print(f"validation accuracy: {acc:.3f}")
+    assert acc > 0.95, acc
+    print("text_classification: OK")
+
+
+if __name__ == "__main__":
+    main()
