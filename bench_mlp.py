@@ -59,16 +59,27 @@ def measure(on_result=None):
 
     lossf = gluon.loss.SoftmaxCrossEntropyLoss()
 
-    def run(net, n):
+    def run(net, n, fused=True):
+        from mxnet_tpu import profiler
         tr = gluon.Trainer(net.collect_params(), "sgd",
-                           {"learning_rate": 0.05, "momentum": 0.9})
-        # warmup (compile on the hybridized path)
+                           {"learning_rate": 0.05, "momentum": 0.9},
+                           fused=fused)
+        # warmup (compile on the hybridized path, fused-kernel cache on
+        # the imperative one)
         for _ in range(2):
             with autograd.record():
                 L = lossf(net(X), y).mean()
             L.backward()
             tr.step(batch)
         float(L.asnumpy())
+        # host dispatch count for ONE steady-state step() (trainer-issued
+        # launches: allreduce + guard + optimizer updates)
+        with autograd.record():
+            L = lossf(net(X), y).mean()
+        L.backward()
+        profiler.reset_dispatches()
+        tr.step(batch)
+        step_dispatches = profiler.dispatch_count()
         t0 = time.monotonic()
         for _ in range(n):
             with autograd.record():
@@ -77,16 +88,23 @@ def measure(on_result=None):
             tr.step(batch)
         final = float(L.asnumpy())
         dt = time.monotonic() - t0
-        return batch * n / dt, final
+        return batch * n / dt, n / dt, step_dispatches, final
 
-    imp_net = build()
-    imp_s, imp_loss = run(imp_net, imp_steps)
-    print(f"[bench_mlp] imperative: {imp_s:.0f} samples/s "
-          f"(loss {imp_loss:.4f})", file=sys.stderr)
+    imp_s, imp_steps_s, imp_disp, imp_loss = run(build(), imp_steps)
+    print(f"[bench_mlp] imperative fused: {imp_s:.0f} samples/s "
+          f"({imp_steps_s:.2f} steps/s, {imp_disp} step dispatches, "
+          f"loss {imp_loss:.4f})", file=sys.stderr)
+
+    unf_s, unf_steps_s, unf_disp, unf_loss = run(build(), imp_steps,
+                                                 fused=False)
+    print(f"[bench_mlp] imperative unfused: {unf_s:.0f} samples/s "
+          f"({unf_steps_s:.2f} steps/s, {unf_disp} step dispatches, "
+          f"loss {unf_loss:.4f}, fused is {imp_s / unf_s:.2f}x)",
+          file=sys.stderr)
 
     hyb_net = build()
     hyb_net.hybridize()
-    hyb_s, hyb_loss = run(hyb_net, steps)
+    hyb_s, hyb_steps_s, _, hyb_loss = run(hyb_net, steps)
     print(f"[bench_mlp] hybridized: {hyb_s:.0f} samples/s "
           f"(loss {hyb_loss:.4f}, {hyb_s / imp_s:.1f}x the imperative "
           "path — the CachedOp story)", file=sys.stderr)
@@ -97,6 +115,11 @@ def measure(on_result=None):
         "unit": "samples/sec/chip",
         "vs_baseline": round(hyb_s / BASELINE_SAMPLES_S, 4),
         "imperative_samples_s": round(imp_s, 1),
+        "imperative_steps_s_fused": round(imp_steps_s, 3),
+        "imperative_steps_s_unfused": round(unf_steps_s, 3),
+        "imperative_samples_s_unfused": round(unf_s, 1),
+        "step_dispatches_fused": int(imp_disp),
+        "step_dispatches_unfused": int(unf_disp),
     }
     if on_result is not None:
         on_result(res)

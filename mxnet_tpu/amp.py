@@ -136,9 +136,11 @@ def unscale(grads_or_trainer):
     scaler = _state.get("scaler")
     if scaler is None:
         return
+    from . import profiler
     inv = 1.0 / scaler.loss_scale
     params = grads_or_trainer._params if hasattr(grads_or_trainer, "_params") \
         else grads_or_trainer
     for p in params:
         if getattr(p, "_grad", None) is not None:
+            profiler.record_dispatch("amp_unscale")
             p._grad._rebind(p._grad._data * inv)

@@ -28,9 +28,9 @@ import threading
 from concurrent.futures import ThreadPoolExecutor
 
 __all__ = ["Var", "push", "wait_for_var", "wait_for_all", "set_bulk_size",
-           "num_workers", "native_engine_loaded", "file_var", "set_debug",
-           "debug_enabled", "debug_check", "debug_check_raise", "last_error",
-           "clear_error", "wait_for_all_timeout"]
+           "get_bulk_size", "num_workers", "native_engine_loaded", "file_var",
+           "set_debug", "debug_enabled", "debug_check", "debug_check_raise",
+           "last_error", "clear_error", "wait_for_all_timeout"]
 
 
 class Var:
@@ -200,9 +200,37 @@ def wait_for_all():
     waitall()
 
 
+# Bulk size = the fused Trainer path's gradient-bucket byte cap
+# (optimizer/multi_tensor.py groups parameters into dtype-homogeneous
+# buckets of at most this many bytes; one allreduce + one fused optimizer
+# dispatch per bucket). Reference Engine::SetBulkSize counts ops; here the
+# analogous dispatch-batching knob is bytes, and 0 keeps the reference's
+# "unbulked" meaning: every parameter gets its own bucket.
+_DEFAULT_BULK_BYTES = 64 << 20
+_OP_COUNT_SCALE = 4096   # below this, `size` is a reference op count
+_bulk_size = _DEFAULT_BULK_BYTES
+
+
 def set_bulk_size(size):
-    """Reference: Engine::SetBulkSize — XLA fuses op bulks itself; no-op."""
-    return size
+    """Set the fused-update bucket byte cap (reference: Engine::SetBulkSize).
+    0 = unbulked/per-parameter buckets. The reference's argument counts
+    OPS (typical values 4-15); a byte cap that small would silently
+    degrade every bucket to per-param, so op-count-scale sizes
+    (0 < size < 4096) mean "bulked at the default byte cap" while
+    byte-scale sizes pass through as caps. Returns the previous value so
+    scopes can restore it."""
+    global _bulk_size
+    prev = _bulk_size
+    size = max(0, int(size))
+    if 0 < size < _OP_COUNT_SCALE:
+        size = _DEFAULT_BULK_BYTES
+    _bulk_size = size
+    return prev
+
+
+def get_bulk_size():
+    """The current fused-update bucket byte cap (0 = per-param buckets)."""
+    return _bulk_size
 
 
 def num_workers():
@@ -284,17 +312,21 @@ class bulk:
     """Bulk-execution scope (reference: mxnet.engine.bulk): upstream
     batches `size` engine ops into one dependency-graph segment and
     restores the previous bulk size on exit — it never synchronizes.
-    Here op fusion is XLA's job and the host-side engine already batches
-    per dispatch, so the scope is ordering-neutral by construction (the
-    engine's var dependency tracking already gives in-scope ops their
-    order); no drain on exit, matching the reference's non-blocking
+    Here the scope sets `set_bulk_size` (the fused Trainer path's
+    gradient-bucket byte cap; 0 = per-param, op-count-scale sizes map to
+    the default byte cap — see set_bulk_size) for its extent and restores
+    the previous cap on exit. Device-op fusion inside a bucket remains
+    XLA's job; no drain on exit, matching the reference's non-blocking
     contract."""
 
-    def __init__(self, size=15):
+    def __init__(self, size=_DEFAULT_BULK_BYTES):
         self.size = int(size)
+        self._prev = None
 
     def __enter__(self):
+        self._prev = set_bulk_size(self.size)
         return self
 
     def __exit__(self, *exc):
+        set_bulk_size(self._prev)
         return False

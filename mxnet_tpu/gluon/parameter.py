@@ -186,6 +186,17 @@ class Parameter:
                 self._grad._rebind(self._grad._data.astype(self.dtype))
                 self._data._grad = self._grad
 
+    def _struct_sig(self):
+        """Structural identity consumed by the Trainer's fused-bucket
+        cache: captures everything bucketing depends on (materialised
+        shape/dtype, gradient dtype, grad_req), so deferred init, cast()
+        and grad_req flips invalidate stale bucket layouts."""
+        return (self.name,
+                None if self._data is None
+                else (tuple(self._data.shape), str(self._data.dtype)),
+                None if self._grad is None else str(self._grad.dtype),
+                self._grad_req)
+
     def var(self):
         from .. import symbol
         if self._var is None:

@@ -1,0 +1,46 @@
+"""Version-portable jax API surface.
+
+The codebase targets the current jax API (`jax.shard_map` with its
+`check_vma` replication checker); older jaxlibs in the field (0.4.x) ship
+the same primitive as `jax.experimental.shard_map.shard_map` with the
+checker spelled `check_rep`. Importing through this module keeps every
+call site on the new spelling while still running on the baked-in
+toolchain.
+"""
+from __future__ import annotations
+
+__all__ = ["shard_map", "axis_size"]
+
+import inspect
+
+try:
+    from jax import shard_map as _shard_map          # jax >= 0.5
+except ImportError:                                   # jax 0.4.x
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+# the replication-checker kwarg was renamed check_rep -> check_vma; key on
+# the actual signature, not the import location (some jax versions export
+# the top-level name while still taking check_rep)
+_CHECK_KW = ("check_vma"
+             if "check_vma" in inspect.signature(_shard_map).parameters
+             else "check_rep")
+
+
+def shard_map(f, mesh=None, in_specs=None, out_specs=None, check_vma=None,
+              **kwargs):
+    """`jax.shard_map` on every supported jax: `check_vma` is translated to
+    the installed version's keyword (`check_rep` on 0.4.x)."""
+    if check_vma is not None:
+        kwargs[_CHECK_KW] = check_vma
+    return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                      **kwargs)
+
+
+def axis_size(axis_name):
+    """`jax.lax.axis_size` (new jax) with the `psum(1, axis)` idiom as the
+    0.4.x fallback — constant-folds to a static int inside shard_map."""
+    import jax
+    try:
+        return jax.lax.axis_size(axis_name)
+    except AttributeError:
+        return jax.lax.psum(1, axis_name)

@@ -138,6 +138,7 @@ class KVStore:
         self._compression = None   # {"type": "2bit"|"int8", ...}
         self._residuals = {}       # key -> error-feedback residual (sharded)
         self._wire_cache = {}      # (shape,dtype,axis,cfg) -> jitted program
+        self._flat_cache = {}      # bucket sig -> (flatten, split) jits
 
     def set_gradient_compression(self, compression_params):
         """Enable quantized allreduce with error feedback (reference:
@@ -331,7 +332,7 @@ class KVStore:
         path. Returns a local array equal to the cross-worker sum."""
         import numpy as _np
         from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-        from jax import shard_map
+        from .jax_compat import shard_map
         if jax.process_count() <= 1:
             return a
         devs = _np.asarray(jax.devices())
@@ -348,9 +349,72 @@ class KVStore:
         total = jax.device_get(f(garr))
         return jnp.asarray(total) / ldc
 
+    # ----------------------------------------- bucketed (flat) allreduce
+    def allreduce_flat(self, arrays, key=None):
+        """Bucketed allreduce for the fused Trainer path: reduce MANY
+        same-dtype per-param gradients ("replicated" layout — whole arrays,
+        never replica stacks) as ONE flattened buffer, then split back.
+        One collective per bucket instead of one per parameter.
+
+        Identity fast paths return the input list untouched with zero
+        dispatches: non-'ici' stores, a mesh-attached 'ici' store (a
+        replicated value needs no cross-replica sum), and single-process
+        runs. The flatten/split programs are jitted and cached per
+        (shapes, dtype) signature."""
+        from . import profiler
+        if len(arrays) <= 1:
+            if arrays and self._kind == "ici":
+                out = self.allreduce_([arrays[0]], layout="replicated",
+                                      key=key)
+                if out is not arrays[0]:
+                    profiler.record_dispatch("kv_allreduce")
+                return [out]
+            return list(arrays)
+        if self._kind != "ici" or self._mesh is not None:
+            return list(arrays)
+        if jax.process_count() <= 1:
+            return list(arrays)
+        local = [_is_process_local(a) for a in arrays]
+        if not all(local):
+            if not any(local):
+                return list(arrays)
+            # mixed-locality bucket (e.g. one grad came out of a pjit
+            # sub-step as a global array): reduce per-param like the
+            # unfused path rather than silently skipping the local ones
+            out = []
+            for a in arrays:
+                r = self.allreduce_([a], layout="replicated", key=key)
+                if r is not a:
+                    profiler.record_dispatch("kv_allreduce")
+                out.append(r)
+            return out
+        sig = tuple((tuple(a.shape), str(a.dtype)) for a in arrays)
+        fns = self._flat_cache.get(sig)
+        if fns is None:
+            profiler.record_jit_cache(False)
+            fns = self._flat_cache[sig] = self._build_flat_fns(sig)
+        else:
+            profiler.record_jit_cache(True)
+        flatten, split = fns
+        profiler.record_dispatch("kv_flatten")
+        flat = flatten(list(arrays))
+        profiler.record_dispatch("kv_allreduce")
+        red = self.allreduce_process_sum(flat)
+        profiler.record_dispatch("kv_split")
+        return split(red)
+
+    @staticmethod
+    def _build_flat_fns(sig):
+        from .optimizer.multi_tensor import split_flat
+        shapes = [shp for shp, _ in sig]
+        flatten = jax.jit(
+            lambda xs: jnp.concatenate([x.ravel() for x in xs]))
+        split = jax.jit(lambda flat: split_flat(flat, shapes))
+        return flatten, split
+
     def _psum_stacked(self, a, axis):
         from jax.sharding import PartitionSpec as P
-        from jax import shard_map
+        from .jax_compat import shard_map
         mesh = self._mesh
         n = mesh.shape[axis]
         if a.ndim == 0 or a.shape[0] % n:
@@ -369,7 +433,7 @@ class KVStore:
         actually cross the interconnect). Call with (stacked, residual)
         full-shape arrays or pass to jax.make_jaxpr."""
         from jax.sharding import PartitionSpec as P
-        from jax import shard_map
+        from .jax_compat import shard_map
         axis = axis or self._mesh.axis_names[0]
         n = self._mesh.shape[axis]
         wire = self._make_wire_fn(a.shape[1:], a.dtype, axis)
@@ -439,7 +503,7 @@ class KVStore:
 
     def _compressed_psum_stacked(self, a, axis, key):
         from jax.sharding import NamedSharding, PartitionSpec as P
-        from jax import shard_map
+        from .jax_compat import shard_map
         mesh = self._mesh
         n = mesh.shape[axis]
         if a.ndim == 0 or a.shape[0] % n:

@@ -33,6 +33,12 @@ try:
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
     _HAS_PALLAS = True
+    # older jax spells it TPUCompilerParams; module-local alias keeps the
+    # call sites on the current spelling without mutating jax's namespace
+    _CompilerParams = getattr(pltpu, "CompilerParams",
+                              getattr(pltpu, "TPUCompilerParams", None))
+    if _CompilerParams is None:  # pallas too old for either spelling:
+        _HAS_PALLAS = False      # route to the non-pallas fallback
 except Exception:  # pragma: no cover
     _HAS_PALLAS = False
 
@@ -257,7 +263,7 @@ def _flash_fwd_pallas(q, k, v, causal, sm_scale, lengths=None,
             _sds((bh, sq, d), q.dtype, q, k, v),
             _sds((bh, sq, 128), jnp.float32, q, k, v),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=_interpret(),
     )
@@ -504,7 +510,7 @@ def _flash_bwd_pallas(q, k, v, o, lse, g, causal, sm_scale, lengths=None,
                             pltpu.VMEM((block_k, d), jnp.float32)],
         ),
         out_shape=[_sds((bh, sk, d), q.dtype, q, k, v, g)] * 2,
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=_interpret(),
     )(*scal, qr, kr, vr, gr, lse, delta)
@@ -524,7 +530,7 @@ def _flash_bwd_pallas(q, k, v, o, lse, g, causal, sm_scale, lengths=None,
             scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         ),
         out_shape=_sds((bh, sq, d), q.dtype, q, k, v, g),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=_interpret(),
     )(*scal, qr, kr, vr, gr, lse, delta)

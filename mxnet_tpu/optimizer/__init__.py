@@ -4,3 +4,5 @@ from .optimizer import (Optimizer, SGD, NAG, Adam, AdaBelief, AdamW, Adamax, Nad
                         Signum, SGLD, DCASGD, create, register)
 from . import optimizer as opt
 from .updater import Updater, get_updater
+from . import multi_tensor
+from .multi_tensor import FusedUpdater, build_buckets

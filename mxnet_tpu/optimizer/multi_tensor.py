@@ -1,0 +1,260 @@
+"""Fused multi-tensor optimizer updates for the imperative Trainer path.
+
+The per-param imperative path costs one XLA dispatch per gradient for the
+allreduce plus one jitted `apply` per parameter — O(num_params) launches
+per `Trainer.step()`, the dispatch-bound regime the XLA-fusion literature
+targets. This subsystem makes one step cost O(num_buckets):
+
+  * `build_buckets` groups parameters into dtype-homogeneous, byte-capped
+    buckets (cap = `engine.get_bulk_size()`; 0 keeps the reference
+    "unbulked" meaning — one parameter per bucket).
+  * `KVStore.allreduce_flat` (kvstore.py) reduces each bucket's gradients
+    as ONE flattened buffer — one collective per bucket instead of one per
+    parameter.
+  * `FusedUpdater` compiles ONE jitted multi-tensor update per
+    (optimizer, bucket signature): the whole bucket's weights / grads /
+    optimizer states go through a single XLA executable that applies the
+    optimizer's pure `apply` rule per parameter — including
+    `multi_precision` fp32 master weights and folded AMP unscale — with
+    the state buffers donated. lr/wd/rescale/inv-scale ride in as
+    weak-typed traced scalars, so schedules and loss-scale changes never
+    retrace.
+
+Numerics mirror `Optimizer.update` / `update_multi_precision` op for op,
+so the fused path matches the per-param path bit for bit (up to XLA's
+fp32 reassociation inside a fused region).
+
+Telemetry (profiler.py): every kernel launch is tallied via
+`profiler.record_dispatch`, kernel-cache lookups via
+`profiler.record_jit_cache`, bucket layouts via `profiler.record_buckets`
+— all surfaced in `profiler.dumps()`.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import profiler
+from .updater import Updater
+from .optimizer import Optimizer, DCASGD
+
+__all__ = ["FusedUpdater", "build_buckets", "bucket_signature", "supports",
+           "flat_layout", "split_flat"]
+
+
+def flat_layout(shapes):
+    """(sizes, offsets, total) for packing arrays of `shapes` into one
+    flat buffer — the one offset table shared by the kvstore bucketed
+    allreduce and the fused SGD kernels."""
+    sizes = [int(np.prod(shp, dtype=np.int64)) if shp else 1
+             for shp in shapes]
+    offs, total = [], 0
+    for sz in sizes:
+        offs.append(total)
+        total += sz
+    return sizes, offs, total
+
+
+def split_flat(flat, shapes):
+    """Inverse of a ravel+concatenate pack over arrays of `shapes`."""
+    sizes, offs, _ = flat_layout(shapes)
+    return [jax.lax.dynamic_slice_in_dim(flat, off, sz).reshape(shp)
+            for off, sz, shp in zip(offs, sizes, shapes)]
+
+
+def supports(optimizer):
+    """True when the optimizer's imperative semantics are fully captured by
+    its pure `apply` rule, so the fused kernel reproduces the per-param
+    path exactly. Excluded:
+
+      * subclasses overriding `update` / `update_multi_precision` /
+        `create_state_multi_precision` / `_preprocess` (custom imperative
+        behaviour the kernel would not see — the kernel inlines the BASE
+        rescale+clip preprocessing);
+      * DCASGD — its `init_state` aliases the live weight buffer as the
+        delay-compensation state, which is unsafe with donated state
+        buffers.
+    """
+    t = type(optimizer)
+    if isinstance(optimizer, DCASGD):
+        return False
+    return (t.update is Optimizer.update
+            and t.update_multi_precision is Optimizer.update_multi_precision
+            and t.create_state_multi_precision
+            is Optimizer.create_state_multi_precision
+            and t._preprocess is Optimizer._preprocess)
+
+
+# lr/wd/rescale_grad ride into the kernel as traced scalars and the update
+# counters change every step — everything else scalar in the optimizer's
+# __dict__ (momentum, betas, epsilon, clip_gradient, bounds, ...) gets
+# baked in at trace time and must key the kernel cache
+_NON_HYPER = frozenset({"lr", "wd", "rescale_grad", "num_update"})
+
+
+def _hyper_sig(optimizer):
+    """Snapshot of the scalar hyperparameters `apply` closes over, so
+    mid-run mutation (opt.momentum = 0.0, opt.beta1 = ...) recompiles the
+    fused kernel instead of silently reusing stale trace-time constants —
+    matching the per-param path, which reads them eagerly every step."""
+    return tuple(sorted(
+        (k, v) for k, v in vars(optimizer).items()
+        if k not in _NON_HYPER
+        and isinstance(v, (int, float, bool, str, type(None)))))
+
+
+def _grad_nbytes(p):
+    g = p.grad()._data
+    return int(g.size) * jnp.dtype(g.dtype).itemsize
+
+
+def build_buckets(pairs, cap_bytes):
+    """Group an ordered list of (index, Parameter) into dtype-homogeneous
+    buckets of at most `cap_bytes` gradient bytes (cap <= 0: one parameter
+    per bucket). A single parameter larger than the cap still gets its own
+    bucket. Order within and across buckets is declaration order, so the
+    layout is deterministic."""
+    buckets, cur, cur_key, cur_bytes = [], [], None, 0
+    for i, p in pairs:
+        key = (str(p.data().dtype), str(p.grad().dtype))
+        nbytes = _grad_nbytes(p)
+        if cur and (key != cur_key or cap_bytes <= 0
+                    or cur_bytes + nbytes > cap_bytes):
+            buckets.append(cur)
+            cur, cur_bytes = [], 0
+        cur_key = key
+        cur.append((i, p))
+        cur_bytes += nbytes
+    if cur:
+        buckets.append(cur)
+    return buckets
+
+
+def bucket_signature(bucket, optimizer):
+    """Static kernel-cache key for one bucket: per-param shapes/dtypes,
+    state layout, and multi-precision role."""
+    sig = []
+    for idx, p in bucket:
+        w = p.data()._data
+        g = p.grad()._data
+        mp = bool(optimizer.multi_precision and w.dtype != np.float32)
+        sig.append((tuple(w.shape), str(w.dtype), str(g.dtype), mp))
+    return tuple(sig)
+
+
+def _make_kernel(optimizer, mp_flags, clip, unscale, n):
+    """Trace ONE jitted update over a whole bucket. Per parameter it
+    replays exactly what `Optimizer.update` / `update_multi_precision`
+    do — f32 upcast, rescale, clip, dtype-matched downcast, `apply`,
+    master-weight downcast — so a bucket of n parameters compiles to a
+    single XLA executable instead of n launches. When `unscale` is set the
+    AMP 1/loss_scale multiply is folded in and the unscaled per-param
+    gradients come back as outputs (so `p.grad()` observes the same value
+    the per-param path leaves behind). State buffers are donated: for
+    Adam-family optimizers that is the bulk of the update's memory
+    traffic."""
+
+    def kernel(weights, grads, states, lrs, wds, rescale, inv):
+        new_ws, new_ss, out_gs = [], [], []
+        for i in range(n):
+            w, g, sv = weights[i], grads[i], states[i]
+            if unscale:
+                g = g * inv
+                out_gs.append(g)
+            gg = g if g.dtype == jnp.float32 else g.astype(jnp.float32)
+            gg = gg * rescale
+            if clip is not None:
+                gg = jnp.clip(gg, -clip, clip)
+            if mp_flags[i]:
+                master, rest = sv[0], tuple(sv[1:])
+                new_m, new_s = optimizer.apply(master, gg, rest,
+                                               lrs[i], wds[i])
+                new_ws.append(new_m.astype(w.dtype))
+                full = (new_m,) + tuple(new_s)
+            else:
+                if gg.dtype != w.dtype:
+                    gg = gg.astype(w.dtype)
+                new_w, new_s = optimizer.apply(w, gg, tuple(sv),
+                                               lrs[i], wds[i])
+                new_ws.append(new_w)
+                full = tuple(new_s)
+            # if a hyperparameter mutation shrank apply()'s state arity
+            # (momentum -> 0), pass the untouched slots through: every
+            # donated input buffer then has a live output (donation-safe)
+            # and the stale-state-kept semantics match the per-param path
+            new_ss.append(full + tuple(sv[len(full):]))
+        return new_ws, new_ss, out_gs
+
+    return jax.jit(kernel, donate_argnums=(2,))
+
+
+class FusedUpdater(Updater):
+    """Updater that applies a whole bucket of parameters in one fused
+    dispatch. Shares the per-index `states` dict with the plain Updater,
+    so `Trainer.save_states`/`load_states` and the per-param `__call__`
+    fallback keep working unchanged."""
+
+    def __init__(self, optimizer):
+        super().__init__(optimizer)
+        self._kernels = {}
+
+    def update_bucket(self, bucket, inv_scale=None):
+        """Apply one optimizer step to every (index, Parameter) in
+        `bucket` via a single cached jitted kernel. `inv_scale` (AMP
+        1/loss_scale) is folded into the kernel when given; the kernel
+        then also rebinds each param's gradient to its unscaled value."""
+        opt = self.optimizer
+        weights, grads, states, state_nds = [], [], [], []
+        lrs, wds, mp_flags = [], [], []
+        for idx, p in bucket:
+            w = p.data()
+            if idx not in self.states:
+                self.states[idx] = \
+                    opt.create_state_multi_precision(idx, w)
+            opt._update_count(idx)
+            lrs.append(float(opt._get_lr(idx)))
+            wds.append(float(opt._get_wd(idx)))
+            st = self.states[idx]
+            st = st if isinstance(st, tuple) else \
+                ((st,) if st is not None else ())
+            mp_flags.append(bool(opt.multi_precision
+                                 and w.dtype != np.float32))
+            weights.append(w._data)
+            grads.append(p.grad()._data)
+            states.append(tuple(s._data for s in st))
+            state_nds.append(st)
+
+        unscale = inv_scale is not None
+        clip = None if opt.clip_gradient is None else float(opt.clip_gradient)
+        # state avals belong in the key: load_states() can swap in state
+        # arrays with different shapes/dtypes without touching the bucket
+        # signature, and jax would retrace while the telemetry claimed a hit
+        state_sig = tuple(tuple((tuple(s.shape), str(s.dtype)) for s in sv)
+                          for sv in states)
+        key = (bucket_signature(bucket, opt), state_sig, _hyper_sig(opt),
+               unscale)
+        kern = self._kernels.get(key)
+        if kern is None:
+            profiler.record_jit_cache(False)
+            kern = self._kernels[key] = _make_kernel(
+                opt, tuple(mp_flags), clip, unscale, len(bucket))
+        else:
+            profiler.record_jit_cache(True)
+        profiler.record_dispatch("fused_update")
+        # python-float lr/wd/rescale/inv become weak-typed f32 tracers:
+        # identical promotion to the per-param path's python scalars, and
+        # value changes (lr schedules, loss-scale moves) hit the jit cache
+        new_ws, new_ss, out_gs = kern(
+            weights, grads, states, tuple(lrs), tuple(wds),
+            float(opt.rescale_grad),
+            0.0 if inv_scale is None else float(inv_scale))
+
+        for (idx, p), new_w, new_s, st in zip(bucket, new_ws, new_ss,
+                                              state_nds):
+            p.data()._rebind(new_w)
+            for s_nd, s_val in zip(st, new_s):
+                s_nd._rebind(s_val)
+        if out_gs:
+            for (idx, p), g in zip(bucket, out_gs):
+                p.grad()._rebind(g)

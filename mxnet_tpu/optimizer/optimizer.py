@@ -121,20 +121,32 @@ class Optimizer:
 
     def create_state_multi_precision(self, index, weight):
         if self.multi_precision and weight.dtype != np.float32:
-            master = weight._data.astype(jnp.float32)
             from ..ndarray.ndarray import NDArray
-            return (NDArray(master),) + self.create_state(index, weight)
+            # state derives from the MASTER: momentum/variance live in fp32
+            # (reference semantics), and state dtypes stay stable across
+            # updates — the first apply() would promote low-precision zero
+            # states to fp32 anyway, which also defeated buffer donation in
+            # the fused kernel; going through create_state keeps subclass
+            # overrides of that extension point honored
+            master = NDArray(weight._data.astype(jnp.float32))
+            return (master,) + tuple(self.create_state(index, master))
         return self.create_state(index, weight)
 
     def update(self, index, weight, grad, state):
+        from .. import profiler
         self._update_count(index)
         lr, wd = self._get_lr(index), self._get_wd(index)
         g = self._preprocess(grad._data.astype(jnp.float32)
                              if grad.dtype != np.float32 else grad._data)
+        if g.dtype != weight._data.dtype:
+            # cast back to the weight dtype only when they differ — for the
+            # common all-fp32 case the old unconditional astype chained a
+            # no-op convert onto every gradient
+            g = g.astype(weight._data.dtype)
         svals = tuple(s._data for s in state) if isinstance(state, tuple) else \
             ((state._data,) if state is not None else ())
-        new_w, new_s = self.apply(weight._data, g.astype(weight._data.dtype),
-                                  svals, lr, wd)
+        profiler.record_dispatch("opt_update")
+        new_w, new_s = self.apply(weight._data, g, svals, lr, wd)
         weight._rebind(new_w)
         states = state if isinstance(state, tuple) else \
             ((state,) if state is not None else ())
@@ -143,10 +155,12 @@ class Optimizer:
 
     def update_multi_precision(self, index, weight, grad, state):
         if self.multi_precision and weight.dtype != np.float32:
+            from .. import profiler
             master, rest = state[0], state[1:]
             self._update_count(index)
             lr, wd = self._get_lr(index), self._get_wd(index)
             g = self._preprocess(grad._data.astype(jnp.float32))
+            profiler.record_dispatch("opt_update_mp")
             new_m, new_s = self.apply(master._data, g,
                                       tuple(s._data for s in rest), lr, wd)
             master._rebind(new_m)
@@ -511,14 +525,9 @@ def fused_sgd_mom_kernel(params, moms, grads, lr, momentum=0.9, wd=0.0,
     back to each input's own dtype. lr/momentum/wd/rescale_grad are traced
     scalars — schedules do NOT retrace."""
     import jax.numpy as jnp
-    sizes = [int(p.size) for p in params]
+    from .multi_tensor import split_flat
     shapes = [p.shape for p in params]
     pdt = [p.dtype for p in params]
-    offs = []
-    total = 0
-    for sz in sizes:
-        offs.append(total)
-        total += sz
     flat_p = jnp.concatenate([p.ravel().astype(jnp.float32) for p in params])
     flat_g = jnp.concatenate([g.ravel().astype(jnp.float32) for g in grads])
     flat_g = flat_g * rescale_grad + wd * flat_p
@@ -533,9 +542,8 @@ def fused_sgd_mom_kernel(params, moms, grads, lr, momentum=0.9, wd=0.0,
     flat_p = flat_p - lr * upd
 
     def split(flat, dts):
-        return [jax.lax.dynamic_slice_in_dim(flat, off, sz)
-                .reshape(shp).astype(dt)
-                for off, sz, shp, dt in zip(offs, sizes, shapes, dts)]
+        return [a.astype(dt)
+                for a, dt in zip(split_flat(flat, shapes), dts)]
 
     if moms is None:
         return split(flat_p, pdt), None

@@ -24,5 +24,12 @@ class Updater:
         return self.states
 
 
-def get_updater(optimizer):
+def get_updater(optimizer, fused=False):
+    """Updater factory. `fused=True` returns a
+    `multi_tensor.FusedUpdater` — same states dict and per-param
+    `__call__`, plus `update_bucket` for whole-bucket fused dispatches
+    (used by the gluon Trainer's fused path)."""
+    if fused:
+        from .multi_tensor import FusedUpdater
+        return FusedUpdater(optimizer)
     return Updater(optimizer)

@@ -27,7 +27,8 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
-from jax import shard_map
+from ..jax_compat import shard_map
+from ..jax_compat import axis_size as _axis_size
 
 from ..ops.pallas_kernels import flash_block_attention
 
@@ -60,7 +61,7 @@ def ring_attention(q, k, v, axis_name="sp", causal=False, sm_scale=None):
         o'   = o*exp(lse-lse') + o_b*exp(lse_b-lse')."""
     if sm_scale is None:
         sm_scale = 1.0 / (q.shape[-1] ** 0.5)
-    n_dev = jax.lax.axis_size(axis_name)
+    n_dev = _axis_size(axis_name)
     my_idx = jax.lax.axis_index(axis_name)
     s_loc = q.shape[2]
     b, h, _, d = q.shape

@@ -26,7 +26,9 @@ import functools
 
 import jax
 import jax.numpy as jnp
-from jax import lax, shard_map
+from jax import lax
+from ..jax_compat import shard_map
+from ..jax_compat import axis_size as _axis_size
 from jax.sharding import PartitionSpec as P
 
 from ..ops.pallas_kernels import flash_block_attention
@@ -37,7 +39,7 @@ __all__ = ["ulysses_attention", "ulysses_attention_sharded"]
 def ulysses_attention(q, k, v, axis_name="sp", causal=False, sm_scale=None):
     """Call INSIDE shard_map with q/k/v sequence-sharded: (B, S/P, H, Dh).
     Requires H divisible by the axis size. Returns (B, S/P, H, Dh)."""
-    p = lax.axis_size(axis_name)
+    p = _axis_size(axis_name)
     b, s_loc, h, dh = q.shape
     if h % p:
         raise ValueError(f"ulysses_attention: heads {h} not divisible by "

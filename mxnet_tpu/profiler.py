@@ -13,10 +13,18 @@ from collections import defaultdict
 import jax
 
 __all__ = ["set_config", "start", "stop", "pause", "resume", "dumps",
-           "dump", "Scope", "record_op"]
+           "dump", "Scope", "record_op", "record_dispatch", "dispatch_count",
+           "reset_dispatches", "record_jit_cache", "jit_cache_stats",
+           "record_buckets", "bucket_sizes"]
 
 _state = {"dir": "/tmp/mxtpu_profile", "running": False,
-          "ops": defaultdict(lambda: [0, 0.0]), "t0": None}
+          "ops": defaultdict(lambda: [0, 0.0]), "t0": None,
+          # recompile/dispatch telemetry for the fused-update subsystem
+          # (optimizer/multi_tensor.py): always-on counters — a dispatch
+          # regression guard must not depend on the trace being started
+          "dispatches": defaultdict(int),
+          "jit_cache": [0, 0],          # [hits, misses]
+          "buckets": []}                # last-built fused bucket sizes (bytes)
 
 
 def set_config(profile_all=False, profile_symbolic=True,
@@ -61,13 +69,66 @@ def record_op(name, seconds):
         entry[1] += seconds
 
 
+def record_dispatch(name="dispatch", n=1):
+    """Count a device dispatch issued from the imperative training hot path
+    (one jitted-executable launch / collective). Always on — the fused
+    Trainer path and its regression tests key off this counter."""
+    _state["dispatches"][name] += n
+
+
+def dispatch_count(name=None):
+    """Total device dispatches recorded since the last reset, or the count
+    for one named dispatch site."""
+    if name is not None:
+        return _state["dispatches"].get(name, 0)
+    return sum(_state["dispatches"].values())
+
+
+def reset_dispatches():
+    """Zero the fused-path telemetry as a unit: the dispatch counters AND
+    the jit-cache hit/miss tallies (a dispatch window always starts with a
+    fresh compile picture; `dumps(reset=True)` calls this too)."""
+    _state["dispatches"].clear()
+    _state["jit_cache"][0] = _state["jit_cache"][1] = 0
+
+
+def record_jit_cache(hit):
+    """Tally a fused-kernel jit cache lookup (hit=True) or compile (miss)."""
+    _state["jit_cache"][0 if hit else 1] += 1
+
+
+def jit_cache_stats():
+    """(hits, misses) of the fused-update kernel cache."""
+    return tuple(_state["jit_cache"])
+
+
+def record_buckets(sizes_bytes):
+    """Record the byte sizes of the fused path's gradient buckets."""
+    _state["buckets"] = [int(s) for s in sizes_bytes]
+
+
+def bucket_sizes():
+    return list(_state["buckets"])
+
+
 def dumps(reset=False):
     lines = [f"{'op':<40}{'calls':>10}{'total_ms':>14}"]
     for name, (calls, total) in sorted(_state["ops"].items(),
                                        key=lambda kv: -kv[1][1]):
         lines.append(f"{name:<40}{calls:>10}{total * 1e3:>14.3f}")
+    if _state["dispatches"]:
+        lines.append(f"[dispatch] total={dispatch_count()}")
+        for name, n in sorted(_state["dispatches"].items()):
+            lines.append(f"[dispatch] {name}={n}")
+    hits, misses = _state["jit_cache"]
+    if hits or misses:
+        lines.append(f"[jit-cache] hits={hits} misses={misses}")
+    if _state["buckets"]:
+        lines.append(f"[buckets] sizes_bytes={_state['buckets']}")
     if reset:
         _state["ops"].clear()
+        reset_dispatches()
+        _state["buckets"] = []
     return "\n".join(lines)
 
 

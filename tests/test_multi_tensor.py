@@ -1,0 +1,340 @@
+"""Fused multi-tensor Trainer path (optimizer/multi_tensor.py): numerical
+parity vs the per-param reference path, dispatch-count regression guards,
+bucketing, and the engine bulk-size wiring."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import amp, autograd, engine, gluon, nd, profiler
+from mxnet_tpu.optimizer import multi_tensor
+
+FUSED_OPTS = ["sgd", "nag", "adam", "adamw", "lamb"]
+
+
+def _data(n=8, d=16, k=4):
+    rng = np.random.RandomState(0)
+    X = nd.array(rng.randn(n, d).astype(np.float32))
+    y = nd.array(rng.randint(0, k, n).astype(np.float32))
+    return X, y
+
+
+def _build(X, layers=3, hidden=16, k=4, seed=0):
+    mx.random.seed(seed)
+    net = gluon.nn.Sequential()
+    for _ in range(layers):
+        net.add(gluon.nn.Dense(hidden, activation="relu"))
+    net.add(gluon.nn.Dense(k))
+    net.initialize(mx.init.Xavier())
+    net(X)  # materialise
+    return net
+
+
+def _train(fused, opt, X, y, steps=3, opt_params=None, trainer_kw=None,
+           cast=None):
+    net = _build(X)
+    if cast:
+        net.cast(cast)
+        X = X.astype(cast)
+    lossf = gluon.loss.SoftmaxCrossEntropyLoss()
+    tr = gluon.Trainer(net.collect_params(), opt,
+                       dict({"learning_rate": 0.05}, **(opt_params or {})),
+                       fused=fused, **(trainer_kw or {}))
+    for _ in range(steps):
+        with autograd.record():
+            L = lossf(net(X), y).mean()
+        L.backward()
+        tr.step(X.shape[0])
+    return [p.data().asnumpy().astype(np.float32)
+            for p in net.collect_params().values()]
+
+
+def _assert_parity(fused, unfused, rtol=1e-4, atol=1e-7, tag=""):
+    for i, (a, b) in enumerate(zip(fused, unfused)):
+        np.testing.assert_allclose(a, b, rtol=rtol, atol=atol,
+                                   err_msg=f"{tag} param {i}")
+
+
+# ------------------------------------------------------------ parity suite
+@pytest.mark.parametrize("opt", FUSED_OPTS)
+def test_fused_parity(opt):
+    """Fused step() matches the per-param path to fp32-reassociation
+    tolerance (the kernel fuses mul/add chains XLA keeps separate in the
+    eager path) for all five fused optimizers."""
+    X, y = _data()
+    _assert_parity(_train(True, opt, X, y, opt_params={"wd": 0.01}),
+                   _train(False, opt, X, y, opt_params={"wd": 0.01}),
+                   tag=opt)
+
+
+@pytest.mark.parametrize("opt", ["sgd", "adam", "lamb"])
+def test_fused_parity_multi_precision(opt):
+    """bf16 weights + fp32 master copies: the fused kernel applies the
+    update on the master and downcasts, like update_multi_precision."""
+    X, y = _data()
+    kw = {"opt_params": {"multi_precision": True, "momentum": 0.9}
+          if opt == "sgd" else {"multi_precision": True},
+          "cast": "bfloat16"}
+    _assert_parity(_train(True, opt, X, y, **kw),
+                   _train(False, opt, X, y, **kw),
+                   rtol=2e-2, atol=1e-3, tag=f"{opt}-mp")
+
+
+def test_fused_skip_nonfinite_and_null_grads():
+    """A nan gradient skips the whole update on both paths; grad_req="null"
+    params ride along untouched."""
+    X, y = _data()
+    net = _build(X)
+    params = net.collect_params()
+    list(params.values())[-1].grad_req = "null"   # sparse-style frozen head
+    tr = gluon.Trainer(params, "sgd", {"learning_rate": 0.5},
+                       skip_nonfinite=True)
+    assert tr._fused
+    lossf = gluon.loss.SoftmaxCrossEntropyLoss()
+    with autograd.record():
+        L = lossf(net(X), y).mean()
+    L.backward()
+    w_before = [p.data().asnumpy() for p in params.values()]
+    poisoned = next(p for p in params.values() if p._grad is not None)
+    poisoned._grad._rebind(poisoned._grad._data * np.nan)
+    tr.step(X.shape[0])
+    for a, b in zip(w_before, [p.data().asnumpy() for p in params.values()]):
+        np.testing.assert_array_equal(a, b)
+    # finite grads do update, with the frozen param still untouched
+    with autograd.record():
+        L = lossf(net(X), y).mean()
+    L.backward()
+    tr.step(X.shape[0])
+    after = [p.data().asnumpy() for p in params.values()]
+    assert any(not np.array_equal(a, b) for a, b in zip(w_before, after))
+    np.testing.assert_array_equal(w_before[-1], after[-1])
+
+
+def test_fused_amp_overflow_skip_parity():
+    """Under the fp16 DynamicLossScaler the fused path folds unscale into
+    the kernel, skips on overflow, and halves the scale — same protocol
+    (and same resulting weights) as the per-param path."""
+    X, y = _data()
+
+    def run(fused):
+        amp.reset()
+        amp.init("float16")
+        net = _build(X)
+        tr = gluon.Trainer(net.collect_params(), "sgd",
+                           {"learning_rate": 0.05}, fused=fused)
+        lossf = gluon.loss.SoftmaxCrossEntropyLoss()
+        for i in range(3):
+            with autograd.record():
+                L = amp.scale_loss(lossf(net(X), y).mean())
+            L.backward()
+            if i == 1:   # poison step 1: must be skipped, scale halved
+                p0 = list(net.collect_params().values())[0]
+                p0._grad._rebind(p0._grad._data * np.inf)
+            tr.step(X.shape[0])
+        scale = amp._state["scaler"].loss_scale
+        amp.reset()
+        return [p.data().asnumpy() for p in
+                net.collect_params().values()], scale
+
+    wf, sf = run(True)
+    wu, su = run(False)
+    assert sf == su
+    _assert_parity(wf, wu, tag="amp")
+
+
+def test_fused_matches_with_per_param_buckets():
+    """bulk_size=0 keeps reference 'unbulked' semantics: one param per
+    bucket, still numerically identical."""
+    X, y = _data()
+    prev = engine.set_bulk_size(0)
+    try:
+        fused = _train(True, "adam", X, y)
+    finally:
+        engine.set_bulk_size(prev)
+    _assert_parity(fused, _train(False, "adam", X, y), tag="bulk0")
+
+
+# ------------------------------------------------ dispatch regression guard
+def test_dispatch_count_50_param_mlp():
+    """Acceptance guard: a >=50-parameter model steps in <= 4 device
+    dispatches on the fused imperative path, and dumps(reset=True) resets
+    the counter."""
+    X, y = _data()
+    net = _build(X, layers=24)          # 25 Dense layers -> 50 params
+    params = net.collect_params()
+    assert len(params) >= 50
+    tr = gluon.Trainer(params, "sgd",
+                       {"learning_rate": 0.05, "momentum": 0.9})
+    lossf = gluon.loss.SoftmaxCrossEntropyLoss()
+    for _ in range(2):                  # warm the kernel cache
+        with autograd.record():
+            L = lossf(net(X), y).mean()
+        L.backward()
+        tr.step(X.shape[0])
+    with autograd.record():
+        L = lossf(net(X), y).mean()
+    L.backward()
+    profiler.reset_dispatches()
+    tr.step(X.shape[0])
+    assert profiler.dispatch_count() <= 4, profiler.dumps()
+    assert profiler.jit_cache_stats() == (1, 0)   # warm: pure cache hit
+    assert "[dispatch]" in profiler.dumps()
+    profiler.dumps(reset=True)
+    assert profiler.dispatch_count() == 0
+    assert profiler.jit_cache_stats() == (0, 0)
+
+
+def test_unfused_dispatch_count_scales_with_params():
+    """The per-param escape hatch really is O(num_params)."""
+    X, y = _data()
+    net = _build(X)
+    tr = gluon.Trainer(net.collect_params(), "sgd", {"learning_rate": 0.05},
+                       fused=False)
+    lossf = gluon.loss.SoftmaxCrossEntropyLoss()
+    with autograd.record():
+        L = lossf(net(X), y).mean()
+    L.backward()
+    profiler.reset_dispatches()
+    tr.step(X.shape[0])
+    assert profiler.dispatch_count() == len(net.collect_params())
+
+
+# ----------------------------------------------------------- bucketing unit
+def test_build_buckets_caps_and_dtype_homogeneity():
+    X, _ = _data()
+    net = _build(X)
+    pairs = [(i, p) for i, p in enumerate(net.collect_params().values())]
+    # cap 0: per-param
+    assert [len(b) for b in multi_tensor.build_buckets(pairs, 0)] == \
+        [1] * len(pairs)
+    # huge cap: one bucket (all fp32)
+    assert len(multi_tensor.build_buckets(pairs, 1 << 30)) == 1
+    # tiny cap: each param alone even though larger than the cap
+    assert [len(b) for b in multi_tensor.build_buckets(pairs, 8)] == \
+        [1] * len(pairs)
+    # dtype change breaks a bucket
+    list(pairs[1][1].cast("bfloat16") for _ in range(1))
+    bks = multi_tensor.build_buckets(pairs, 1 << 30)
+    assert len(bks) == 3   # fp32 | bf16 | fp32 (declaration order kept)
+
+
+def test_bucket_cache_invalidates_on_bulk_size_change():
+    X, y = _data()
+    net = _build(X)
+    tr = gluon.Trainer(net.collect_params(), "sgd", {"learning_rate": 0.05})
+    lossf = gluon.loss.SoftmaxCrossEntropyLoss()
+    with autograd.record():
+        L = lossf(net(X), y).mean()
+    L.backward()
+    tr.step(X.shape[0])
+    assert len(tr._buckets) == 1
+    prev = engine.set_bulk_size(0)
+    try:
+        with autograd.record():
+            L = lossf(net(X), y).mean()
+        L.backward()
+        tr.step(X.shape[0])
+        assert len(tr._buckets) == len(net.collect_params())
+    finally:
+        engine.set_bulk_size(prev)
+
+
+# ------------------------------------------------------- engine bulk wiring
+def test_set_bulk_size_roundtrip_and_scope():
+    base = engine.get_bulk_size()
+    assert base > 0                       # fused/bulked by default
+    prev = engine.set_bulk_size(12345)
+    assert prev == base
+    assert engine.get_bulk_size() == 12345
+    with engine.bulk(1 << 20):
+        assert engine.get_bulk_size() == 1 << 20
+    assert engine.get_bulk_size() == 12345
+    # reference op-count-scale sizes (set_bulk_size(15) / bulk(15) idiom)
+    # mean "bulked at the default byte cap", never a tiny byte cap
+    with engine.bulk(15):
+        assert engine.get_bulk_size() == engine._DEFAULT_BULK_BYTES
+    with engine.bulk(0):
+        assert engine.get_bulk_size() == 0    # 0 stays per-param
+    assert engine.get_bulk_size() == 12345
+    engine.set_bulk_size(15)
+    assert engine.get_bulk_size() == engine._DEFAULT_BULK_BYTES
+    engine.set_bulk_size(12345)
+    engine.set_bulk_size(base)
+    assert engine.get_bulk_size() == base
+
+
+def test_hyperparam_mutation_recompiles():
+    """Mutating a trace-time hyperparameter (momentum) mid-run must key a
+    fresh fused kernel — the per-param path reads it eagerly every step,
+    so a stale cached kernel would silently diverge."""
+    X, y = _data()
+
+    def run(fused):
+        net = _build(X)
+        tr = gluon.Trainer(net.collect_params(), "sgd",
+                           {"learning_rate": 0.05, "momentum": 0.9},
+                           fused=fused)
+        lossf = gluon.loss.SoftmaxCrossEntropyLoss()
+        for step in range(4):
+            if step == 2:
+                # 0.0 also shrinks apply()'s state arity: the kernel must
+                # pass the now-untouched momentum slot through (donation
+                # safety) while matching the per-param stale-state keep
+                tr._optimizer.momentum = 0.0
+            with autograd.record():
+                L = lossf(net(X), y).mean()
+            L.backward()
+            tr.step(X.shape[0])
+        return [p.data().asnumpy() for p in net.collect_params().values()]
+
+    _assert_parity(run(True), run(False), tag="momentum-mutation")
+
+
+# ----------------------------------------------------- fallback / coverage
+def test_unsupported_optimizer_falls_back():
+    X, y = _data()
+    net = _build(X)
+    tr = gluon.Trainer(net.collect_params(), "dcasgd",
+                       {"learning_rate": 0.05})
+    assert not tr._fused                  # aliasing state: per-param path
+    lossf = gluon.loss.SoftmaxCrossEntropyLoss()
+    with autograd.record():
+        L = lossf(net(X), y).mean()
+    L.backward()
+    tr.step(X.shape[0])                   # still trains
+
+
+def test_fused_save_load_states_roundtrip(tmp_path):
+    X, y = _data()
+    net = _build(X)
+    tr = gluon.Trainer(net.collect_params(), "adam", {"learning_rate": 0.05})
+    lossf = gluon.loss.SoftmaxCrossEntropyLoss()
+    with autograd.record():
+        L = lossf(net(X), y).mean()
+    L.backward()
+    tr.step(X.shape[0])
+    f = str(tmp_path / "states.bin")
+    tr.save_states(f)
+    tr2 = gluon.Trainer(net.collect_params(), "adam",
+                        {"learning_rate": 0.05})
+    tr2.load_states(f)
+    assert tr2._optimizer.num_update == tr._optimizer.num_update
+    for k, v in tr._updater.states.items():
+        for a, b in zip(v, tr2._updater.states[k]):
+            np.testing.assert_allclose(np.asarray(a._data),
+                                       np.asarray(b._data))
+
+
+def test_kvstore_allreduce_flat_identity_and_roundtrip():
+    """allreduce_flat: identity fast-paths return the inputs untouched;
+    the flatten/split programs round-trip shapes exactly."""
+    import jax.numpy as jnp
+    from mxnet_tpu import kvstore
+    kv = kvstore.create("ici")
+    arrs = [jnp.ones((3, 4)), jnp.zeros((5,)), jnp.full((2, 2), 7.0)]
+    out = kv.allreduce_flat(arrs)
+    assert all(a is b for a, b in zip(arrs, out))   # single process: identity
+    flatten, split = kvstore.KVStore._build_flat_fns(
+        tuple((tuple(a.shape), str(a.dtype)) for a in arrs))
+    back = split(flatten(arrs))
+    for a, b in zip(arrs, back):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
