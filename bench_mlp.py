@@ -21,6 +21,9 @@ an extra field, not against a baseline.
 
 Off by default; BENCH_MLP=1 adds it to bench.py's extra_metrics.
 Standalone: `python bench_mlp.py` prints ONE JSON line.
+`--trace [path]` additionally captures a Chrome-trace of a few training
+steps (mx.profiler + observability tracer; open in Perfetto) and reports
+the tracer's overhead against an untraced run of the same loop.
 """
 from __future__ import annotations
 
@@ -32,7 +35,7 @@ import time
 BASELINE_SAMPLES_S = 500_000.0
 
 
-def measure(on_result=None):
+def measure(on_result=None, trace=None):
     import jax
     import numpy as np
 
@@ -121,6 +124,50 @@ def measure(on_result=None):
         "step_dispatches_fused": int(imp_disp),
         "step_dispatches_unfused": int(unf_disp),
     }
+    if trace:
+        from mxnet_tpu import profiler
+
+        def timed_loop(net, tr, n):
+            t0 = time.monotonic()
+            for _ in range(n):
+                with autograd.record():
+                    L = lossf(net(X), y).mean()
+                L.backward()
+                tr.step(batch)
+            float(L.asnumpy())
+            return time.monotonic() - t0
+
+        from mxnet_tpu.observability import tracer
+        net = build()
+        tr = gluon.Trainer(net.collect_params(), "sgd",
+                           {"learning_rate": 0.05, "momentum": 0.9})
+        timed_loop(net, tr, 2)                       # warm the caches
+        # the artifact: full capture (host spans + jax device trace)
+        profiler.set_config(filename=trace)
+        profiler.start()
+        timed_loop(net, tr, imp_steps)
+        profiler.stop()
+        trace_file = profiler.dump()
+        n_events = tracer.events_recorded()
+        # overhead: HOST tracer alone (the always-on subsystem), warm —
+        # the jax device trace above is capture-time-only cost; more
+        # steps than the throughput loops, or noise swamps the signal
+        n_ov = max(10, imp_steps)
+        ons, offs = [], []
+        for _ in range(3):                 # alternate + take mins: robust
+            tracer.start()                 # to scheduler noise on shared
+            timed_loop(net, tr, 1)         # boxes (warm grad-norm jit)
+            ons.append(timed_loop(net, tr, n_ov))
+            tracer.stop()
+            tracer.clear()
+            offs.append(timed_loop(net, tr, n_ov))
+        t_on, t_off = min(ons), min(offs)
+        overhead_pct = (t_on - t_off) / t_off * 100.0
+        print(f"[bench_mlp] trace: {trace_file} ({n_events} host events; "
+              f"host-tracer overhead {overhead_pct:+.1f}% on {n_ov} "
+              "imperative steps)", file=sys.stderr)
+        res["trace_file"] = trace_file
+        res["trace_overhead_pct"] = round(overhead_pct, 2)
     if on_result is not None:
         on_result(res)
     return res
@@ -132,7 +179,14 @@ def main():
     if os.environ.get("JAX_PLATFORMS") == "cpu":
         import jax
         jax.config.update("jax_platforms", "cpu")
-    print(json.dumps(measure()))
+    trace = None
+    args = sys.argv[1:]
+    if "--trace" in args:
+        i = args.index("--trace")
+        trace = (args[i + 1] if len(args) > i + 1
+                 and not args[i + 1].startswith("-")
+                 else "/tmp/mxtpu_profile/bench_mlp_trace.json")
+    print(json.dumps(measure(trace=trace)))
 
 
 if __name__ == "__main__":

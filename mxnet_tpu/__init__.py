@@ -60,6 +60,7 @@ from .attribute import AttrScope
 from . import callback
 from . import rtc
 from . import monitor
+from . import observability
 from . import profiler
 from . import amp
 from . import upstream

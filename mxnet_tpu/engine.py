@@ -25,7 +25,11 @@ from __future__ import annotations
 
 import os as _os
 import threading
+import time as _time
 from concurrent.futures import ThreadPoolExecutor
+
+from .observability import tracer as _tracer
+from .observability import registry as _obs_registry
 
 __all__ = ["Var", "push", "wait_for_var", "wait_for_all", "set_bulk_size",
            "get_bulk_size", "num_workers", "native_engine_loaded", "file_var",
@@ -177,6 +181,8 @@ def _get():
         except Exception:
             _engine = _PyEngine()
             _native = False
+        # idle time is derivable: elapsed * workers - engine_busy_seconds
+        _reg.gauge("engine_workers").set(getattr(_engine, "workers", 1))
     return _engine
 
 
@@ -185,19 +191,91 @@ def native_engine_loaded():
     return bool(_native)
 
 
+# ------------------------------------------------- observability hooks
+# Always-on metrics (queue depth, worker busy time, task/var-wait latency)
+# plus per-task tracer spans named by dispatch site when a trace is being
+# captured. Instrumentation lives in the module facade so the native C++
+# engine and the Python fallback are measured identically. Engine pushes
+# are IO-scale (prefetch batches, checkpoint writes), so one clock pair +
+# a gauge store per task is noise; op-scale dispatch goes through XLA, not
+# here.
+_queue_depth = 0
+_qlock = threading.Lock()
+_reg = _obs_registry()
+_q_gauge = _reg.gauge("engine_queue_depth")
+_q_gauge.set(0)
+_busy_counter = _reg.counter("engine_busy_seconds")
+_task_hist = _reg.histogram("engine_task_seconds")
+_wait_hist = _reg.histogram("engine_var_wait_seconds")
+
+
+def _dispatch_site(fn):
+    """Span name for an engine task: module.qualname of the pushed fn —
+    e.g. `io.task`, `utils.do_save` — the dispatch site, not the worker."""
+    qn = getattr(fn, "__qualname__", None) or \
+        getattr(fn, "__name__", None) or type(fn).__name__
+    mod = getattr(fn, "__module__", None) or ""
+    return f"{mod.rsplit('.', 1)[-1]}.{qn}" if mod else qn
+
+
+def _queue_delta(d):
+    global _queue_depth
+    with _qlock:
+        _queue_depth += d
+        depth = _queue_depth
+    _q_gauge.set(depth)
+    if _tracer.ACTIVE:
+        _tracer.counter("engine_queue_depth", depth)
+    return depth
+
+
 def push(fn, read_vars=(), write_vars=()):
     """Schedule fn after its dependencies (reference: Engine::PushAsync)."""
-    return _get().push(fn, read_vars, write_vars)
+    _queue_delta(+1)
+    site = _dispatch_site(fn) if _tracer.ACTIVE else None
+    # one-shot: the normal decrement runs in _task's finally, but a task
+    # whose DEPENDENCY failed never runs fn (the engine re-raises the dep
+    # error before entering it) — the done-callback below catches that
+    # path so the depth gauge cannot leak upward
+    dec_once = threading.Lock()
+
+    def _dec():
+        if dec_once.acquire(blocking=False):
+            _queue_delta(-1)
+
+    def _task():
+        t0 = _time.perf_counter()
+        try:
+            if _tracer.ACTIVE:
+                with _tracer.span(
+                        f"engine:{site or _dispatch_site(fn)}",
+                        cat="engine"):
+                    return fn()
+            return fn()
+        finally:
+            dt = _time.perf_counter() - t0
+            _busy_counter.inc(dt)
+            _task_hist.observe(dt)
+            _dec()
+
+    fut = _get().push(_task, read_vars, write_vars)
+    if hasattr(fut, "add_done_callback"):
+        fut.add_done_callback(lambda _f: _dec())
+    return fut
 
 
 def wait_for_var(var):
-    _get().wait_for_var(var)
+    t0 = _time.perf_counter()
+    with _tracer.span("engine.wait_for_var", cat="engine"):
+        _get().wait_for_var(var)
+    _wait_hist.observe(_time.perf_counter() - t0)
 
 
 def wait_for_all():
-    _get().wait_for_all()
-    from .ndarray.ndarray import waitall
-    waitall()
+    with _tracer.span("engine.wait_for_all", cat="engine"):
+        _get().wait_for_all()
+        from .ndarray.ndarray import waitall
+        waitall()
 
 
 # Bulk size = the fused Trainer path's gradient-bucket byte cap

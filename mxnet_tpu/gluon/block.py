@@ -256,10 +256,21 @@ class Block:
         self._children[name or str(len(self._children))] = block
 
     def register_forward_hook(self, hook):
-        self._forward_hooks.append(hook)
+        """Register `hook(block, inputs, output)` to run after forward.
+        Returns a removable HookHandle (reference behaviour; previously
+        the registration leaked with no way to detach)."""
+        from .utils import HookHandle
+        handle = HookHandle()
+        handle.attach(self._forward_hooks, hook)
+        return handle
 
     def register_forward_pre_hook(self, hook):
-        self._forward_pre_hooks.append(hook)
+        """Register `hook(block, inputs)` to run before forward; returns a
+        removable HookHandle."""
+        from .utils import HookHandle
+        handle = HookHandle()
+        handle.attach(self._forward_pre_hooks, hook)
+        return handle
 
     # -- serialisation ------------------------------------------------------
     def _collect_params_with_prefix(self, prefix=""):

@@ -12,9 +12,32 @@ from __future__ import annotations
 from ..base import MXNetError
 from .. import optimizer as opt_mod
 from .. import kvstore as kvs_mod
+from ..observability import tracer as _tracer
+from ..observability import registry as _obs_registry
 from .parameter import Parameter, ParameterDict
 
 __all__ = ["Trainer"]
+
+_reg = _obs_registry()
+_steps_counter = _reg.counter("trainer_steps")
+_steps_s_gauge = _reg.gauge("trainer_steps_per_s")
+_grad_norm_gauge = _reg.gauge("trainer_grad_norm")
+_grad_norm_fn = None
+
+
+def _global_grad_norm(grads):
+    """L2 norm over all gradients as ONE jitted launch (cached by jax.jit
+    on the gradient pytree signature). Only issued while a trace is being
+    captured; returns the PENDING device scalar — the gauge coerces it to
+    float at snapshot time, so the step path never syncs for it."""
+    global _grad_norm_fn
+    import jax
+    import jax.numpy as jnp
+    if _grad_norm_fn is None:
+        _grad_norm_fn = jax.jit(lambda gs: jnp.sqrt(sum(
+            jnp.vdot(g.astype(jnp.float32), g.astype(jnp.float32)).real
+            for g in gs)))
+    return _grad_norm_fn(grads)
 
 
 class Trainer:
@@ -84,6 +107,7 @@ class Trainer:
         self._kv_initialized = False
         self._kv_keys = set()
         self._scale = 1.0
+        self._last_step_t = None   # steps/s gauge anchor
         self.skip_nonfinite = skip_nonfinite
 
     @property
@@ -116,6 +140,12 @@ class Trainer:
         instead of one per parameter. Zero-arg on purpose: it is a
         documented gluon override point; the bucket layout comes from the
         `_get_buckets` cache, so the step()-time call does not rebuild it."""
+        if _tracer.ACTIVE:
+            with _tracer.span("Trainer.allreduce_grads", cat="trainer"):
+                return self._allreduce_grads_impl()
+        return self._allreduce_grads_impl()
+
+    def _allreduce_grads_impl(self):
         from .. import profiler
         if not self._kv_initialized:
             self._init_kvstore()
@@ -148,6 +178,27 @@ class Trainer:
         """Rescale gradients by 1/batch_size and apply one optimizer step.
         Under an AMP loss scaler: unscale, skip on overflow, adjust scale.
         With skip_nonfinite: skip the update when any grad is inf/nan."""
+        import time
+        if _tracer.ACTIVE:
+            with _tracer.span("Trainer.step", cat="trainer",
+                              args={"batch_size": int(batch_size),
+                                    "params": len(self._params),
+                                    "fused": self._fused}):
+                self._step_impl(batch_size, ignore_stale_grad)
+            grads = [p._grad._data for p in self._params
+                     if p._grad is not None]
+            if grads:
+                _grad_norm_gauge.set(_global_grad_norm(grads))
+        else:
+            self._step_impl(batch_size, ignore_stale_grad)
+        _steps_counter.inc()
+        now = time.perf_counter()
+        last = self._last_step_t
+        self._last_step_t = now
+        if last is not None and now > last:
+            _steps_s_gauge.set(1.0 / (now - last))
+
+    def _step_impl(self, batch_size, ignore_stale_grad):
         self._optimizer.rescale_grad = self._scale / batch_size
         self._init_kvstore()   # incremental: picks up late-materialised params
         self.allreduce_grads()
@@ -277,8 +328,15 @@ class Trainer:
             profiler.record_dispatch("nonfinite_guard")
             if amp.grads_nonfinite(self._params):
                 return
-        for bucket in buckets:
-            self._updater.update_bucket(bucket, inv_scale=inv_scale)
+        if not _tracer.ACTIVE:
+            for bucket in buckets:
+                self._updater.update_bucket(bucket, inv_scale=inv_scale)
+            return
+        for bi, bucket in enumerate(buckets):
+            with _tracer.span(
+                    "Trainer.fused_bucket", cat="trainer",
+                    args={"bucket": bi, "params": len(bucket)}):
+                self._updater.update_bucket(bucket, inv_scale=inv_scale)
 
     def save_states(self, fname):
         if self._update_on_kvstore:

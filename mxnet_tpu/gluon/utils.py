@@ -7,7 +7,39 @@ from ..base import MXNetError
 from ..ndarray.ndarray import NDArray, _apply
 
 __all__ = ["split_data", "split_and_load", "clip_global_norm",
-           "check_sha1", "download"]
+           "check_sha1", "download", "HookHandle"]
+
+
+class HookHandle:
+    """A removable reference to a registered hook (reference:
+    mxnet.gluon.utils.HookHandle). `Block.register_forward_hook` /
+    `register_forward_pre_hook` return one; `detach()` (or exiting the
+    handle used as a context manager) unregisters the hook. Idempotent —
+    a second detach is a no-op."""
+
+    def __init__(self):
+        self._hooks = None
+        self._hook = None
+
+    def attach(self, hooks_list, hook):
+        if self._hooks is not None:
+            raise MXNetError("HookHandle is already attached")
+        self._hooks = hooks_list
+        self._hook = hook
+        hooks_list.append(hook)
+
+    def detach(self):
+        if self._hooks is not None and self._hook in self._hooks:
+            self._hooks.remove(self._hook)
+        self._hooks = None
+        self._hook = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.detach()
+        return False
 
 
 def split_data(data, num_slice, batch_axis=0, even_split=True):

@@ -32,8 +32,30 @@ import numpy as np
 
 from .base import MXNetError, _as_list
 from .ndarray.ndarray import NDArray
+from .observability import tracer as _tracer
+from .observability import registry as _obs_registry
 
 __all__ = ["KVStore", "create", "init_distributed"]
+
+# always-on collective accounting (bytes entering a cross-replica reduce),
+# per collective kind — the per-collective byte/latency signal motivating
+# arxiv 2004.13336-style weight-update sharding decisions
+_reg = _obs_registry()
+_coll_bytes = {}
+
+
+def _count_collective(op, nbytes):
+    c = _coll_bytes.get(op)
+    if c is None:
+        c = _coll_bytes[op] = _reg.counter("kv_collective_bytes", op=op)
+    c.inc(int(nbytes))
+
+
+def _nbytes(a):
+    try:
+        return int(a.nbytes)
+    except Exception:
+        return 0
 
 _DIST_INITIALIZED = False
 
@@ -202,6 +224,13 @@ class KVStore:
         `layout` forwards to allreduce_ — callers pushing whole per-param
         arrays (not replica stacks) should pin "replicated" so dim0-sharded
         values are never misread as stacks (see allreduce_ caveat)."""
+        if _tracer.ACTIVE:
+            with _tracer.span("kv.push", cat="kvstore",
+                              args={"key": str(key), "store": self._kind}):
+                return self._push_impl(key, value, priority, layout)
+        return self._push_impl(key, value, priority, layout)
+
+    def _push_impl(self, key, value, priority=0, layout="auto"):
         keys = _as_list(key)
         if len(keys) == 1 and not isinstance(value, (list, tuple)) or \
                 (isinstance(value, (list, tuple))
@@ -222,6 +251,13 @@ class KVStore:
                 self._store[k] = NDArray(agg)
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        if _tracer.ACTIVE:
+            with _tracer.span("kv.pull", cat="kvstore",
+                              args={"key": str(key), "store": self._kind}):
+                return self._pull_impl(key, out, priority, ignore_sparse)
+        return self._pull_impl(key, out, priority, ignore_sparse)
+
+    def _pull_impl(self, key, out=None, priority=0, ignore_sparse=True):
         keys = _as_list(key)
         outs = []
         for k in keys:
@@ -330,11 +366,22 @@ class KVStore:
         gradient). One shard_map psum over the global device mesh — the
         launcher-spawned CPU case and a multi-host TPU pod take the same
         path. Returns a local array equal to the cross-worker sum."""
+        if jax.process_count() <= 1:
+            return a
+        nbytes = _nbytes(a)
+        _count_collective("process_sum", nbytes)
+        if _tracer.ACTIVE:
+            with _tracer.span("kv.allreduce_process_sum", cat="kvstore",
+                              args={"bytes": nbytes,
+                                    "workers": jax.process_count(),
+                                    "devices": jax.device_count()}):
+                return self._process_sum_impl(a)
+        return self._process_sum_impl(a)
+
+    def _process_sum_impl(self, a):
         import numpy as _np
         from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
         from .jax_compat import shard_map
-        if jax.process_count() <= 1:
-            return a
         devs = _np.asarray(jax.devices())
         mesh = Mesh(devs, ("dp",))
         ldc = jax.local_device_count()
@@ -361,6 +408,16 @@ class KVStore:
         replicated value needs no cross-replica sum), and single-process
         runs. The flatten/split programs are jitted and cached per
         (shapes, dtype) signature."""
+        if _tracer.ACTIVE:
+            with _tracer.span(
+                    "kv.allreduce_flat", cat="kvstore",
+                    args={"bytes": sum(_nbytes(a) for a in arrays),
+                          "arrays": len(arrays), "store": self._kind,
+                          "devices": jax.device_count()}):
+                return self._allreduce_flat_impl(arrays, key)
+        return self._allreduce_flat_impl(arrays, key)
+
+    def _allreduce_flat_impl(self, arrays, key=None):
         from . import profiler
         if len(arrays) <= 1:
             if arrays and self._kind == "ici":
@@ -421,8 +478,14 @@ class KVStore:
             raise MXNetError(
                 f"stacked allreduce needs dim0 divisible by mesh axis "
                 f"{axis!r} size {n}, got shape {a.shape}")
+        _count_collective("psum_stacked", _nbytes(a))
         f = shard_map(lambda x: jax.lax.psum(jnp.sum(x, axis=0), axis),
                       mesh=mesh, in_specs=P(axis), out_specs=P())
+        if _tracer.ACTIVE:
+            with _tracer.span("kv.psum_stacked", cat="kvstore",
+                              args={"bytes": _nbytes(a), "axis": axis,
+                                    "devices": int(n)}):
+                return f(a)
         return f(a)
 
     # ----------------------------------------- compressed collectives
@@ -531,7 +594,15 @@ class KVStore:
                                   check_vma=False))
             entry = self._wire_cache[cfg] = (f, wire)
         f, wire = entry
-        total, new_res = f(a, res)
+        _count_collective("compressed_gather", int(wire.wire_bytes))
+        if _tracer.ACTIVE:
+            with _tracer.span("kv.compressed_allreduce", cat="kvstore",
+                              args={"wire_bytes": int(wire.wire_bytes),
+                                    "raw_bytes": int(wire.raw_bytes),
+                                    "devices": int(n), "key": key}):
+                total, new_res = f(a, res)
+        else:
+            total, new_res = f(a, res)
         self._residuals[key] = new_res
         self.compression_stats = {
             "key": key, "type": self._compression["type"],

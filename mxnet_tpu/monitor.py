@@ -3,6 +3,12 @@
 Taps layer outputs every N steps via Gluon forward hooks (the reference
 installs engine callbacks on executors) and provides nan/inf detection —
 the failure-detection subsystem of SURVEY.md §5.
+
+Numeric checks run ON DEVICE: `check_numerics`/`NanDetector` reduce
+`jnp.isfinite(x).all()` to a single scalar per array and only pull that
+scalar to host — a NaN scan over a model no longer transfers every
+parameter through the device→host pipe. The per-value NaN/Inf counts in
+the error message are computed on the (rare) failure path only.
 """
 from __future__ import annotations
 
@@ -32,24 +38,46 @@ class Monitor:
         self._handles = []
 
     def install(self, block):
-        """Attach to a Gluon block tree (reference: Monitor.install on exec)."""
+        """Attach to a Gluon block tree (reference: Monitor.install on
+        exec). The hook registrations are kept as removable HookHandles
+        (`self.handles`); `remove()` detaches them all."""
         def hook(blk, inputs, output):
             if not self.activated:
                 return
             name = blk.name
             if not self.pattern.match(name):
                 return
+            import jax
             outs = output if isinstance(output, (list, tuple)) else [output]
             for i, o in enumerate(outs):
-                if hasattr(o, "asnumpy"):
-                    self.queue.append((self.step, f"{name}_output{i}",
-                                       self.stat_func(o.asnumpy())))
+                if not hasattr(o, "asnumpy"):
+                    continue
+                if isinstance(getattr(o, "_data", None), jax.core.Tracer):
+                    # hybridized forward: the hook fires during jit
+                    # tracing where outputs are abstract — no concrete
+                    # value to tap this call (stat_func bugs still raise)
+                    continue
+                self.queue.append((self.step, f"{name}_output{i}",
+                                   self.stat_func(o.asnumpy())))
 
         def walk(b):
-            b.register_forward_hook(hook)
+            self._handles.append(b.register_forward_hook(hook))
             for c in b._children.values():
                 walk(c)
         walk(block)
+        return self
+
+    @property
+    def handles(self):
+        """The live HookHandles from install() (empty after remove())."""
+        return list(self._handles)
+
+    def remove(self):
+        """Detach every hook install() registered (the reference leaks
+        them; here the handles are stored and detached on demand)."""
+        for h in self._handles:
+            h.detach()
+        self._handles = []
         return self
 
     def tic(self):
@@ -71,19 +99,40 @@ class Monitor:
             logging.info("Batch: %7d %30s %.8g", step, name, value)
 
 
+def _all_finite_on_device(data):
+    """One device-side reduce to a scalar; only the bool crosses to host.
+    Non-float dtypes are finite by construction."""
+    import jax.numpy as jnp
+    if not (jnp.issubdtype(data.dtype, jnp.floating)
+            or jnp.issubdtype(data.dtype, jnp.complexfloating)):
+        return True
+    return bool(jnp.isfinite(data).all())
+
+
 def check_numerics(arr, name="array"):
     """Raise MXNetError if arr contains NaN/Inf (reference:
-    MXNET_ENFORCE_DETERMINISM-style numeric guard)."""
-    a = arr.asnumpy() if hasattr(arr, "asnumpy") else np.asarray(arr)
-    if not np.isfinite(a).all():
-        n_nan = int(np.isnan(a).sum())
-        n_inf = int(np.isinf(a).sum())
-        raise MXNetError(f"{name} has {n_nan} NaN and {n_inf} Inf values")
-    return arr
+    MXNET_ENFORCE_DETERMINISM-style numeric guard). The finite check runs
+    on device; the full array is pulled to host only to build the error
+    message once a non-finite value was detected."""
+    import jax
+    data = arr._data if hasattr(arr, "_data") else arr
+    if isinstance(data, jax.Array):
+        if _all_finite_on_device(data):
+            return arr
+        a = np.asarray(data)      # failure path: counts for the message
+    else:
+        a = np.asarray(data)
+        if a.dtype.kind not in "fc" or np.isfinite(a).all():
+            return arr
+    n_nan = int(np.isnan(a).sum())
+    n_inf = int(np.isinf(a).sum())
+    raise MXNetError(f"{name} has {n_nan} NaN and {n_inf} Inf values")
 
 
 class NanDetector:
-    """Scan parameters/grads after each step; report first offender."""
+    """Scan parameters/grads after each step; report first offender.
+    Each array's scan is one device-side `isfinite().all()` launch plus a
+    scalar sync — no full-array device→host transfer on the clean path."""
 
     def __init__(self, params):
         self._params = list(params.values()) if hasattr(params, "values") \

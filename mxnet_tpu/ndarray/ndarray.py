@@ -26,6 +26,7 @@ import numpy as np
 from .. import autograd
 from ..base import MXNetError, _np_dtype, numeric_types
 from ..context import Context, current_context
+from ..observability import tracer as _tracer
 
 __all__ = ["NDArray", "zeros", "ones", "full", "empty", "array", "arange",
            "linspace", "eye", "zeros_like", "ones_like", "full_like",
@@ -60,7 +61,23 @@ def _apply(fn, nd_inputs, kwargs=None, n_out=1):
     """
     kwargs = kwargs or {}
     raw = [x._data for x in nd_inputs]
-    out = fn(*raw, **kwargs)
+    if _tracer.ACTIVE and _tracer.sample_op():
+        # SAMPLED op span (1-in-N, MXTPU_TRACE_OP_SAMPLE): per-op tracing
+        # at full rate would dominate an imperative trace; the cold branch
+        # above is one module-attribute load when tracing is off
+        from time import perf_counter_ns
+        name = getattr(fn, "__name__", None) or "op"
+        if name == "<lambda>":
+            name = getattr(fn, "__qualname__", name).split(".<locals>")[0]
+        t0 = perf_counter_ns()
+        out = fn(*raw, **kwargs)
+        t1 = perf_counter_ns()
+        _tracer.complete(f"nd.{name.lstrip('_')}", t0, t1, cat="op",
+                         args={"sampled": _tracer._op_sample_rate})
+        from .. import profiler
+        profiler.record_op(f"nd.{name.lstrip('_')}", (t1 - t0) / 1e9)
+    else:
+        out = fn(*raw, **kwargs)
     if n_out == 1 and not isinstance(out, tuple):
         outs = (out,)
     else:

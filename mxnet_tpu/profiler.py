@@ -1,65 +1,147 @@
 """Profiler (reference: python/mxnet/profiler.py).
 
-`set_config/start/stop/dumps` map onto jax.profiler (XLA/TPU traces viewable
-in TensorBoard/Perfetto), plus a host-side op tally from the imperative
-dispatch path for `dumps()` parity.
+`set_config/start/stop/dumps/dump` map onto THREE recorders at once:
+
+  * `jax.profiler` — the XLA/TPU device trace (TensorBoard/Perfetto).
+  * `observability.tracer` — the host-side Chrome-trace span recorder
+    (engine tasks, KVStore collectives, Trainer steps, sampled op
+    dispatch). `dump()` writes its `profile.json`, reference-style.
+  * `observability.metrics_registry` — the always-on dispatch/jit-cache/
+    bucket telemetry the fused-Trainer subsystem (PR 1) keys off. The
+    public counter API below (`record_dispatch`/`dispatch_count`/...) is
+    unchanged; the storage moved from an ad-hoc `_state` dict into the
+    labelled registry so `mx.observability.summary()` and the JSONL sink
+    see the same numbers.
+
+pause()/resume() genuinely suspend/restart both the jax device trace and
+the host tracer (each resume opens a fresh jax trace session in the same
+directory — the XLA profiler has no native pause).
 """
 from __future__ import annotations
 
 import contextlib
+import os
 import time
 from collections import defaultdict
 
 import jax
+
+from .observability import tracer as _tracer
+from .observability import registry as _registry
 
 __all__ = ["set_config", "start", "stop", "pause", "resume", "dumps",
            "dump", "Scope", "record_op", "record_dispatch", "dispatch_count",
            "reset_dispatches", "record_jit_cache", "jit_cache_stats",
            "record_buckets", "bucket_sizes"]
 
-_state = {"dir": "/tmp/mxtpu_profile", "running": False,
+_state = {"dir": "/tmp/mxtpu_profile", "filename": None, "running": False,
           "ops": defaultdict(lambda: [0, 0.0]), "t0": None,
-          # recompile/dispatch telemetry for the fused-update subsystem
-          # (optimizer/multi_tensor.py): always-on counters — a dispatch
-          # regression guard must not depend on the trace being started
-          "dispatches": defaultdict(int),
-          "jit_cache": [0, 0],          # [hits, misses]
-          "buckets": []}                # last-built fused bucket sizes (bytes)
+          "paused": False,         # pause() called on a live session
+          "jax_trace": False,      # a jax.profiler trace session is open
+          "jax_paused": False}     # pause() closed one; resume() reopens
+
+# registry handles are cached — reset() zeroes values but keeps handles,
+# so these references stay valid for the life of the process
+_reg = _registry()
+_dispatch = {}                          # site -> Counter
+_jit_hit = _reg.counter("jit_cache", result="hit")
+_jit_miss = _reg.counter("jit_cache", result="miss")
+_buckets_gauge = _reg.gauge("fused_bucket_sizes_bytes")
 
 
 def set_config(profile_all=False, profile_symbolic=True,
                profile_imperative=True, profile_memory=True, profile_api=True,
                filename=None, **kwargs):
+    """`filename` is the Chrome-trace target `dump()` writes (full path
+    preserved — reference `profile.json` behavior); its directory is also
+    where `jax.profiler` drops the device trace."""
     if filename:
-        _state["dir"] = filename.rsplit("/", 1)[0] if "/" in filename \
-            else "."
+        _state["filename"] = filename
+        _state["dir"] = os.path.dirname(filename) or "."
 
 
-def start():
-    _state["running"] = True
-    _state["t0"] = time.time()
+def _start_jax_trace():
     try:
         jax.profiler.start_trace(_state["dir"])
+        _state["jax_trace"] = True
+        return
     except Exception:
         pass
+    # start_trace raises if a session is already open (double start(), or
+    # a crashed earlier capture). Close the stray session and retry once —
+    # swallowing without this would leak it and silently break every
+    # later capture in the process.
+    try:
+        jax.profiler.stop_trace()
+        jax.profiler.start_trace(_state["dir"])
+        _state["jax_trace"] = True
+    except Exception:
+        _state["jax_trace"] = False
 
 
-def stop():
-    if not _state["running"]:
+def _stop_jax_trace():
+    if not _state["jax_trace"]:
         return
-    _state["running"] = False
+    _state["jax_trace"] = False
     try:
         jax.profiler.stop_trace()
     except Exception:
         pass
 
 
-def pause():
+def start():
+    _state["running"] = True
+    _state["paused"] = False
+    _state["t0"] = time.time()
+    _tracer.start()
+    _start_jax_trace()
+    # interleave: host spans also annotate the device trace while one is
+    # being captured
+    _tracer.set_jax_annotation(_state["jax_trace"])
+
+
+def stop():
+    if not _state["running"] and not _state["jax_paused"]:
+        return
+    # a PAUSED session must also finalize here: leaving jax_paused set
+    # would let a later resume() reopen recording the caller believes
+    # stopped (and leak a half-open jax trace session)
     _state["running"] = False
+    _state["paused"] = False
+    _tracer.set_jax_annotation(False)
+    _stop_jax_trace()
+    _state["jax_paused"] = False
+    _tracer.stop()      # buffer is kept for dump()
+
+
+def pause():
+    """Suspend profiling: the host tracer stops recording (buffer kept)
+    and the jax device-trace session is closed — work done while paused
+    appears in NEITHER trace. resume() restarts both."""
+    if not _state["running"]:
+        return
+    _state["running"] = False
+    _state["paused"] = True
+    _tracer.pause()
+    if _state["jax_trace"]:
+        _tracer.set_jax_annotation(False)
+        _stop_jax_trace()
+        _state["jax_paused"] = True
 
 
 def resume():
+    # only a PAUSED session resumes; after stop() this is a no-op (stop
+    # finalized — reopening recording behind the caller's back would
+    # leave span overhead on indefinitely)
+    if not _state["paused"]:
+        return
+    _state["paused"] = False
     _state["running"] = True
+    _tracer.resume()
+    if _state["jax_paused"]:
+        _state["jax_paused"] = False
+        _start_jax_trace()
+        _tracer.set_jax_annotation(_state["jax_trace"])
 
 
 def record_op(name, seconds):
@@ -73,42 +155,48 @@ def record_dispatch(name="dispatch", n=1):
     """Count a device dispatch issued from the imperative training hot path
     (one jitted-executable launch / collective). Always on — the fused
     Trainer path and its regression tests key off this counter."""
-    _state["dispatches"][name] += n
+    c = _dispatch.get(name)
+    if c is None:
+        c = _dispatch[name] = _reg.counter("dispatch", site=name)
+    c.inc(n)
 
 
 def dispatch_count(name=None):
     """Total device dispatches recorded since the last reset, or the count
     for one named dispatch site."""
     if name is not None:
-        return _state["dispatches"].get(name, 0)
-    return sum(_state["dispatches"].values())
+        c = _dispatch.get(name)
+        return c.value if c is not None else 0
+    return sum(c.value for c in _dispatch.values())
 
 
 def reset_dispatches():
     """Zero the fused-path telemetry as a unit: the dispatch counters AND
     the jit-cache hit/miss tallies (a dispatch window always starts with a
     fresh compile picture; `dumps(reset=True)` calls this too)."""
-    _state["dispatches"].clear()
-    _state["jit_cache"][0] = _state["jit_cache"][1] = 0
+    for c in _dispatch.values():
+        c.reset()
+    _jit_hit.reset()
+    _jit_miss.reset()
 
 
 def record_jit_cache(hit):
     """Tally a fused-kernel jit cache lookup (hit=True) or compile (miss)."""
-    _state["jit_cache"][0 if hit else 1] += 1
+    (_jit_hit if hit else _jit_miss).inc()
 
 
 def jit_cache_stats():
     """(hits, misses) of the fused-update kernel cache."""
-    return tuple(_state["jit_cache"])
+    return (_jit_hit.value, _jit_miss.value)
 
 
 def record_buckets(sizes_bytes):
     """Record the byte sizes of the fused path's gradient buckets."""
-    _state["buckets"] = [int(s) for s in sizes_bytes]
+    _buckets_gauge.set([int(s) for s in sizes_bytes])
 
 
 def bucket_sizes():
-    return list(_state["buckets"])
+    return list(_buckets_gauge.value or [])
 
 
 def dumps(reset=False):
@@ -116,31 +204,41 @@ def dumps(reset=False):
     for name, (calls, total) in sorted(_state["ops"].items(),
                                        key=lambda kv: -kv[1][1]):
         lines.append(f"{name:<40}{calls:>10}{total * 1e3:>14.3f}")
-    if _state["dispatches"]:
+    if dispatch_count():
         lines.append(f"[dispatch] total={dispatch_count()}")
-        for name, n in sorted(_state["dispatches"].items()):
-            lines.append(f"[dispatch] {name}={n}")
-    hits, misses = _state["jit_cache"]
+        for name in sorted(_dispatch):
+            if _dispatch[name].value:
+                lines.append(f"[dispatch] {name}={_dispatch[name].value}")
+    hits, misses = jit_cache_stats()
     if hits or misses:
         lines.append(f"[jit-cache] hits={hits} misses={misses}")
-    if _state["buckets"]:
-        lines.append(f"[buckets] sizes_bytes={_state['buckets']}")
+    if bucket_sizes():
+        lines.append(f"[buckets] sizes_bytes={bucket_sizes()}")
     if reset:
         _state["ops"].clear()
         reset_dispatches()
-        _state["buckets"] = []
+        _buckets_gauge.reset()
     return "\n".join(lines)
 
 
 def dump(finished=True, profile_process="worker"):
-    """Reference profiler.dump: write the op table to stderr (the
-    reference writes its json trace file; jax.profiler owns trace files
-    here, so dump surfaces the host-side op accounting)."""
+    """Reference profiler.dump: write the Chrome-trace `profile.json`
+    (host spans — engine tasks, collectives, Trainer steps, sampled ops;
+    the jax device trace lives beside it in the same directory) and echo
+    the host op table to stderr. Returns the trace path."""
     import sys
+    path = _state["filename"] or os.path.join(_state["dir"], "profile.json")
+    _tracer.dump(path)
     print(dumps(), file=sys.stderr)
+    return path
 
 
 @contextlib.contextmanager
 def Scope(name="profile"):
+    """Annotate a region in the device trace AND account it in the host
+    op tally (so `dumps()` shows scoped regions) and the host tracer."""
+    t0 = time.perf_counter()
     with jax.profiler.TraceAnnotation(name):
-        yield
+        with _tracer.span(name, cat="scope"):
+            yield
+    record_op(name, time.perf_counter() - t0)

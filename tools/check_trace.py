@@ -1,0 +1,142 @@
+#!/usr/bin/env python
+"""Standalone Chrome-trace JSON validator.
+
+Loads a trace (either `{"traceEvents": [...]}` object form or a bare
+event array) and fails on malformed or unbalanced events, so trace-format
+regressions in `mx.profiler.dump()` / `mx.observability.tracer` fail
+tier-1 (tests/test_observability.py invokes this; it also runs standalone:
+
+    python tools/check_trace.py profile.json
+
+exit 0 = valid, 1 = invalid (errors on stderr), 2 = unreadable input).
+
+Checks
+  * top-level shape: object with a `traceEvents` list, or a list.
+  * every event is an object with a one-char `ph`.
+  * duration/instant/counter events (`B`/`E`/`X`/`i`/`C`) carry the
+    required keys: numeric non-negative `ts`, `pid`, `tid`; `name` for
+    everything except `E` (Chrome emits nameless `E`s).
+  * `X` events carry a non-negative numeric `dur`.
+  * `ts` is monotonically non-decreasing in file order (the exporters
+    here sort; an unsorted trace loads wrong in some viewers).
+  * `B`/`E` balance per (pid, tid): every `E` pops a matching `B`
+    (name-checked when the `E` is named), nothing left open at EOF.
+
+No framework imports — usable on traces from any writer.
+"""
+from __future__ import annotations
+
+import json
+import numbers
+import sys
+
+_PHASES_NEEDING_TS = ("B", "E", "X", "i", "I", "C")
+
+
+def _is_num(v):
+    return isinstance(v, numbers.Real) and not isinstance(v, bool)
+
+
+def validate_events(events):
+    """Validate a traceEvents list; returns a list of error strings
+    (empty = valid)."""
+    errors = []
+    last_ts = None
+    stacks = {}            # (pid, tid) -> [name, ...] of open B spans
+    for i, ev in enumerate(events):
+        where = f"event {i}"
+        if not isinstance(ev, dict):
+            errors.append(f"{where}: not an object: {ev!r}")
+            continue
+        ph = ev.get("ph")
+        if not isinstance(ph, str) or len(ph) != 1:
+            errors.append(f"{where}: missing/malformed 'ph': {ph!r}")
+            continue
+        if ph == "M":
+            if "name" not in ev:
+                errors.append(f"{where}: metadata event without 'name'")
+            continue
+        if ph not in _PHASES_NEEDING_TS:
+            continue            # other phases (async, flow, ...) pass
+        for key in ("ts", "pid", "tid"):
+            if key not in ev:
+                errors.append(f"{where} (ph={ph}): missing '{key}'")
+        if ph != "E" and not isinstance(ev.get("name"), str):
+            errors.append(f"{where} (ph={ph}): missing/malformed 'name'")
+        ts = ev.get("ts")
+        if ts is not None:
+            if not _is_num(ts) or ts < 0:
+                errors.append(f"{where}: 'ts' not a non-negative number: "
+                              f"{ts!r}")
+            else:
+                if last_ts is not None and ts < last_ts:
+                    errors.append(f"{where}: 'ts' went backwards "
+                                  f"({ts} < {last_ts})")
+                last_ts = ts
+        if ph == "X":
+            dur = ev.get("dur")
+            if not _is_num(dur) or dur < 0:
+                errors.append(f"{where}: X event needs non-negative "
+                              f"'dur', got {dur!r}")
+        track = (ev.get("pid"), ev.get("tid"))
+        if ph == "B":
+            stacks.setdefault(track, []).append(ev.get("name"))
+        elif ph == "E":
+            stack = stacks.get(track)
+            if not stack:
+                errors.append(f"{where}: 'E' with no open 'B' on "
+                              f"pid/tid {track}")
+                continue
+            opened = stack.pop()
+            name = ev.get("name")
+            if name and name != opened:
+                errors.append(f"{where}: 'E' name {name!r} does not close "
+                              f"open span {opened!r} on pid/tid {track}")
+    for track, stack in stacks.items():
+        if stack:
+            errors.append(f"EOF: {len(stack)} unclosed 'B' span(s) on "
+                          f"pid/tid {track}: {stack[-3:]!r}")
+    return errors
+
+
+def validate(trace):
+    """Validate a loaded trace (dict or list form); returns error list."""
+    if isinstance(trace, dict):
+        events = trace.get("traceEvents")
+        if not isinstance(events, list):
+            return ["top-level object has no 'traceEvents' list"]
+    elif isinstance(trace, list):
+        events = trace
+    else:
+        return [f"top level must be an object or array, got "
+                f"{type(trace).__name__}"]
+    return validate_events(events)
+
+
+def validate_file(path):
+    with open(path) as f:
+        return validate(json.load(f))
+
+
+def main(argv=None):
+    argv = argv if argv is not None else sys.argv[1:]
+    if len(argv) != 1:
+        print("usage: check_trace.py <trace.json>", file=sys.stderr)
+        return 2
+    try:
+        errors = validate_file(argv[0])
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"check_trace: cannot read {argv[0]}: {e}", file=sys.stderr)
+        return 2
+    for err in errors:
+        print(f"check_trace: {err}", file=sys.stderr)
+    if errors:
+        print(f"check_trace: INVALID ({len(errors)} error(s))",
+              file=sys.stderr)
+        return 1
+    print(f"check_trace: OK ({argv[0]})", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
