@@ -61,6 +61,7 @@ from . import callback
 from . import rtc
 from . import monitor
 from . import observability
+from . import fault
 from . import profiler
 from . import amp
 from . import upstream

@@ -21,8 +21,8 @@ import numpy as np
 import jax.numpy as jnp
 
 __all__ = ["init", "reset", "convert_block", "scale_loss", "unscale",
-           "DynamicLossScaler", "bfloat16", "autocast_dtype", "is_active",
-           "grads_nonfinite"]
+           "unscale_arrays", "DynamicLossScaler", "bfloat16",
+           "autocast_dtype", "is_active", "grads_nonfinite"]
 
 bfloat16 = jnp.bfloat16
 
@@ -132,15 +132,35 @@ def scale_loss(loss, trainer_or_scaler=None):
     return loss * scaler.loss_scale
 
 
+_unscale_fn = None
+
+
+def unscale_arrays(grads, inv_scale):
+    """Multiply every gradient array by `inv_scale` as ONE jitted
+    multi-tensor launch (cached by jax.jit on the gradient pytree
+    signature — scale moves hit the cache, the scalar is an argument).
+    Counts as a single `amp_unscale` dispatch. The scalar is cast to
+    each grad's dtype before the multiply, matching the per-array
+    `g * python_float` weak-promotion semantics this replaces."""
+    global _unscale_fn
+    from . import profiler
+    if not grads:
+        return []
+    if _unscale_fn is None:
+        _unscale_fn = jax.jit(
+            lambda gs, inv: [g * inv.astype(g.dtype) for g in gs])
+    profiler.record_dispatch("amp_unscale")
+    return _unscale_fn(list(grads), jnp.float32(inv_scale))
+
+
 def unscale(grads_or_trainer):
     scaler = _state.get("scaler")
     if scaler is None:
         return
-    from . import profiler
     inv = 1.0 / scaler.loss_scale
     params = grads_or_trainer._params if hasattr(grads_or_trainer, "_params") \
         else grads_or_trainer
-    for p in params:
-        if getattr(p, "_grad", None) is not None:
-            profiler.record_dispatch("amp_unscale")
-            p._grad._rebind(p._grad._data * inv)
+    live = [p for p in params if getattr(p, "_grad", None) is not None]
+    outs = unscale_arrays([p._grad._data for p in live], inv)
+    for p, g in zip(live, outs):
+        p._grad._rebind(g)

@@ -1,21 +1,80 @@
 """Checkpointing (reference: mxnet.model save_checkpoint/load_checkpoint +
-gluon save/load_parameters; distributed resume via Orbax sharded checkpoints).
+gluon save/load_parameters; distributed resume via Orbax sharded
+checkpoints), hardened for preemption:
+
+  * **atomic save** — every sharded checkpoint is written into a hidden
+    temp dir and `os.replace`-d into place, so a torn write (preemption
+    mid-save) never shadows a good step;
+  * **checksum manifest** — each step dir carries ``manifest.json``
+    (per-file size + sha256); `validate_checkpoint` verifies it and
+    `CheckpointManager.restore_latest` falls back to the newest *valid*
+    step (counted in ``checkpoint_fallbacks``);
+  * **async save** — `_async=True` pushes the save through the
+    dependency engine on the step dir's `file_var`, ordered against
+    later loads of the same path;
+  * **emergency save** — `CheckpointManager.enable_emergency_save`
+    registers a synchronous save with `fault.preemption`, so a SIGTERM
+    produces one last checkpoint inside the grace window;
+  * **resharded restore** — the restore template's sharding wins: params
+    saved on one mesh restore onto a different mesh/device count
+    (portable redistribution in the spirit of arXiv:2112.01075);
+  * **extras** — arbitrary sidecar blobs (trainer optimizer states, data
+    cursors) ride in the same atomic dir, checksummed by the manifest.
+
+Save/load IO retries per `fault.policy_from_env("MXTPU_CKPT")`; the
+``checkpoint.save`` / ``checkpoint.load`` fault points make the paths
+testable (tools/chaos_check.py).
 """
 from __future__ import annotations
 
+import hashlib
+import itertools
+import json
 import os
+import shutil
 
 import numpy as np
 
+from .base import MXNetError
 from .ndarray.ndarray import NDArray, array
+from .observability import registry as _obs_registry
+from .fault import injection as _finj
+from .fault import retry as _retry
 
 __all__ = ["save_checkpoint", "load_checkpoint", "save_sharded",
-           "load_sharded", "CheckpointManager"]
+           "load_sharded", "CheckpointManager", "validate_checkpoint",
+           "read_extra", "MANIFEST_NAME", "CHECKPOINT_FORMAT"]
+
+MANIFEST_NAME = "manifest.json"
+CHECKPOINT_FORMAT = 1
+
+_tmp_seq = itertools.count()
+
+_reg = _obs_registry()
+_saves_counter = _reg.counter("checkpoint_saves")
+_fallback_counter = _reg.counter("checkpoint_fallbacks")
+_last_step_gauge = _reg.gauge("checkpoint_last_step")
+
+_ckpt_policy = None
+
+
+def _policy():
+    global _ckpt_policy
+    if _ckpt_policy is None:
+        # retry only plausibly-transient IO errors (+ the injectable
+        # fault): re-running a multi-GB Orbax save on a deterministic
+        # failure would waste the preemption grace window
+        _ckpt_policy = _retry.policy_from_env(
+            "MXTPU_CKPT", max_retries=3, base_delay=0.1, max_delay=2.0,
+            deadline=60.0, name="checkpoint",
+            retry_on=(OSError, _finj.FaultInjected))
+    return _ckpt_policy
 
 
 def save_checkpoint(prefix, epoch, symbol=None, arg_params=None,
                     aux_params=None):
-    """Reference format: prefix-symbol.json + prefix-%04d.params."""
+    """Reference format: prefix-symbol.json + prefix-%04d.params.
+    The params file is written atomically (tmp + rename)."""
     if symbol is not None:
         symbol.save(f"{prefix}-symbol.json")
     arrays = {}
@@ -23,7 +82,11 @@ def save_checkpoint(prefix, epoch, symbol=None, arg_params=None,
         arrays[f"arg:{k}"] = v.asnumpy()
     for k, v in (aux_params or {}).items():
         arrays[f"aux:{k}"] = v.asnumpy()
-    np.savez(f"{prefix}-{epoch:04d}.params.npz", **arrays)
+    final = f"{prefix}-{epoch:04d}.params.npz"
+    # np.savez appends ".npz" to names without it: keep the suffix
+    tmp = f"{prefix}-{epoch:04d}.tmp{os.getpid()}.params.npz"
+    np.savez(tmp, **arrays)
+    os.replace(tmp, final)
 
 
 def load_checkpoint(prefix, epoch):
@@ -39,33 +102,218 @@ def load_checkpoint(prefix, epoch):
     return sym, arg_params, aux_params
 
 
-def save_sharded(directory, step, params, _async=False):
-    """Sharded distributed checkpoint via Orbax (multi-host resume path).
+# ------------------------------------------------------------- manifest
+def _sha256(path, chunk=1 << 20):
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        while True:
+            block = f.read(chunk)
+            if not block:
+                break
+            h.update(block)
+    return h.hexdigest()
 
-    params: pytree of jax arrays (possibly sharded over a Mesh)."""
-    import orbax.checkpoint as ocp
-    path = os.path.abspath(os.path.join(directory, str(step)))
-    ckptr = ocp.StandardCheckpointer()
-    ckptr.save(path, params, force=True)
-    ckptr.wait_until_finished()
-    return path
+
+def _walk_files(root):
+    for dirpath, _dirnames, filenames in os.walk(root):
+        for name in filenames:
+            full = os.path.join(dirpath, name)
+            yield os.path.relpath(full, root), full
 
 
-def load_sharded(directory, step, template):
-    import orbax.checkpoint as ocp
-    path = os.path.abspath(os.path.join(directory, str(step)))
-    ckptr = ocp.StandardCheckpointer()
-    return ckptr.restore(path, template)
+def _write_manifest(root, step):
+    """Checksum every file under `root` into manifest.json (written last:
+    its presence marks the payload complete *before* the dir rename makes
+    the step visible — two commit barriers, either catches a tear)."""
+    files = {}
+    for rel, full in _walk_files(root):
+        if rel == MANIFEST_NAME:
+            continue
+        files[rel] = {"bytes": os.path.getsize(full), "sha256": _sha256(full)}
+    manifest = {"step": int(step), "format": CHECKPOINT_FORMAT,
+                "complete": True, "files": files}
+    path = os.path.join(root, MANIFEST_NAME)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(manifest, f, indent=1)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    return manifest
+
+
+def _manifest_complete(path):
+    """Structural validity only (manifest present, readable, complete) —
+    the cheap check retention uses; restore still runs the full
+    checksummed `validate_checkpoint`."""
+    try:
+        with open(os.path.join(path, MANIFEST_NAME)) as f:
+            return bool(json.load(f).get("complete"))
+    except (OSError, json.JSONDecodeError):
+        return False
+
+
+def validate_checkpoint(path):
+    """Validate one step dir against its manifest. Returns a list of
+    error strings — empty means the checkpoint is intact. A missing
+    manifest (torn or pre-manifest save) is an error."""
+    errors = []
+    mpath = os.path.join(path, MANIFEST_NAME)
+    if not os.path.isdir(path):
+        return [f"{path}: not a checkpoint directory"]
+    if not os.path.exists(mpath):
+        return [f"{path}: no {MANIFEST_NAME} (torn or foreign write)"]
+    try:
+        with open(mpath) as f:
+            manifest = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        return [f"{path}: unreadable manifest ({e})"]
+    if manifest.get("format", 0) > CHECKPOINT_FORMAT:
+        errors.append(f"{path}: manifest format {manifest.get('format')} "
+                      f"is newer than supported {CHECKPOINT_FORMAT}")
+    if not manifest.get("complete"):
+        errors.append(f"{path}: manifest not marked complete")
+    for rel, meta in manifest.get("files", {}).items():
+        full = os.path.join(path, rel)
+        if not os.path.exists(full):
+            errors.append(f"{path}: missing file {rel}")
+            continue
+        size = os.path.getsize(full)
+        if size != meta.get("bytes"):
+            errors.append(f"{path}: {rel} is {size} bytes, manifest says "
+                          f"{meta.get('bytes')}")
+            continue
+        if _sha256(full) != meta.get("sha256"):
+            errors.append(f"{path}: {rel} checksum mismatch")
+    return errors
+
+
+# ------------------------------------------------------- sharded save
+def _step_path(directory, step):
+    return os.path.abspath(os.path.join(directory, str(step)))
+
+
+def save_sharded(directory, step, params, _async=False, extras=None):
+    """Sharded distributed checkpoint via Orbax (multi-host resume path),
+    committed atomically: Orbax writes into a hidden tmp dir, `extras`
+    (name -> bytes sidecars) land beside it, the checksum manifest is
+    fsync'd, and only then does `os.replace` publish the step dir.
+
+    params: pytree of jax arrays (possibly sharded over a Mesh).
+    _async=True pushes the whole save through the dependency engine on
+    the step dir's file_var and returns the Future; readers of the same
+    path (load_sharded/validate via the engine) order after it."""
+    from . import engine
+    directory = os.path.abspath(directory)
+    os.makedirs(directory, exist_ok=True)
+    final = _step_path(directory, step)
+
+    def do_save(params=params, extras=extras):
+        import orbax.checkpoint as ocp
+        if _finj.ENABLED:
+            _finj.check("checkpoint.save", context=final)
+        # per-INVOCATION unique tmp: a sync save (e.g. emergency) may
+        # overlap an in-flight async save of the same step in the same
+        # process; the dir rename commits whichever finishes last whole
+        tmp = os.path.join(directory,
+                           f".tmp-{step}-{os.getpid()}-{next(_tmp_seq)}")
+        shutil.rmtree(tmp, ignore_errors=True)
+        aside = None
+        try:
+            ckptr = ocp.StandardCheckpointer()
+            # orbax owns the payload dir layout; it must not collide with
+            # the manifest/extras names, so the pytree goes one level down
+            ckptr.save(os.path.join(tmp, "state"), params, force=True)
+            ckptr.wait_until_finished()
+            for name, blob in (extras or {}).items():
+                if os.sep in name or name == MANIFEST_NAME:
+                    raise MXNetError(f"invalid extra name {name!r}")
+                with open(os.path.join(tmp, name), "wb") as f:
+                    f.write(blob if isinstance(blob, bytes)
+                            else bytes(blob))
+            _write_manifest(tmp, step)
+            if os.path.exists(final):
+                # POSIX rename refuses a non-empty target dir, so an
+                # overwrite needs two renames — move the old step ASIDE
+                # (atomic) rather than rmtree'ing it first, so the last
+                # good checkpoint survives a crash until the new one is
+                # published; the loss window shrinks to the instant
+                # between the two renames
+                aside = tmp + ".old"
+                os.replace(final, aside)
+            os.replace(tmp, final)
+        except BaseException:
+            if aside is not None and os.path.exists(aside) and \
+                    not os.path.exists(final):
+                os.replace(aside, final)   # roll the old good step back
+            shutil.rmtree(tmp, ignore_errors=True)
+            raise
+        if aside is not None:
+            shutil.rmtree(aside, ignore_errors=True)
+        _saves_counter.inc()
+        _last_step_gauge.set(int(step))
+        return final
+
+    if _async:
+        return engine.push(lambda: _policy().call(do_save),
+                           write_vars=[engine.file_var(final)])
+    return _policy().call(do_save)
+
+
+def load_sharded(directory, step, template, validate=True):
+    """Restore one step. The TEMPLATE's sharding wins: passing a pytree
+    laid out on a different mesh/device count reshards at restore —
+    params saved on 8 chips restore onto 2 (or 1) without a conversion
+    pass. validate=True checks the manifest first and raises MXNetError
+    on a torn/corrupt checkpoint."""
+    from . import engine
+    final = _step_path(directory, step)
+    try:
+        engine.wait_for_var(engine.file_var(final))  # order after async saves
+    except Exception:
+        # a FAILED async save already surfaced through its Future /
+        # engine.failures(); the on-disk state decides from here — the
+        # manifest validation below rejects anything torn
+        pass
+    if validate:
+        errors = validate_checkpoint(final)
+        if errors:
+            raise MXNetError("invalid checkpoint: " + "; ".join(errors))
+
+    def do_load():
+        import orbax.checkpoint as ocp
+        if _finj.ENABLED:
+            _finj.check("checkpoint.load", context=final)
+        ckptr = ocp.StandardCheckpointer()
+        state = os.path.join(final, "state")
+        if not os.path.isdir(state):     # pre-manifest layout (PR <= 2)
+            state = final
+        return ckptr.restore(state, template)
+
+    return _policy().call(do_load)
+
+
+def read_extra(directory, step, name):
+    """Read one extras sidecar saved by save_sharded (bytes), or None."""
+    path = os.path.join(_step_path(directory, step), name)
+    if not os.path.exists(path):
+        return None
+    with open(path, "rb") as f:
+        return f.read()
 
 
 class CheckpointManager:
     """Step-stamped rolling checkpoints with resume (reference: the
-    epoch-checkpoint callbacks + kvstore resume path)."""
+    epoch-checkpoint callbacks + kvstore resume path), preemption-safe:
+    atomic manifest-validated saves, newest-*valid* restore with fallback,
+    optional async saves, and a SIGTERM emergency save."""
 
     def __init__(self, directory, max_to_keep=3):
-        self.directory = directory
+        self.directory = os.path.abspath(directory)
         self.max_to_keep = max_to_keep
-        os.makedirs(directory, exist_ok=True)
+        self._pending = []            # in-flight async save futures
+        self._emergency = None
+        os.makedirs(self.directory, exist_ok=True)
 
     def steps(self):
         out = []
@@ -74,19 +322,139 @@ class CheckpointManager:
                 out.append(int(name))
         return sorted(out)
 
-    def save(self, step, params):
-        path = save_sharded(self.directory, step, params)
-        steps = self.steps()
-        while len(steps) > self.max_to_keep:
-            victim = steps.pop(0)
-            import shutil
-            shutil.rmtree(os.path.join(self.directory, str(victim)),
-                          ignore_errors=True)
+    def valid_steps(self):
+        """Steps whose manifest validates, oldest first."""
+        return [s for s in self.steps()
+                if not validate_checkpoint(_step_path(self.directory, s))]
+
+    def save(self, step, params, _async=False, extras=None):
+        """Save one step atomically, then prune to `max_to_keep`.
+        Retention recomputes from the post-save listing and never deletes
+        the step just written (re-saving an existing step used to make
+        the count off by one). _async=True returns a Future (the prune
+        rides in the same engine task); `wait()` drains."""
+        if _async:
+            fut = save_sharded(self.directory, step, params, _async=True,
+                               extras=extras)
+            # prune AFTER the save lands, ordered on the same file_var
+            from . import engine
+            path = _step_path(self.directory, step)
+            done = engine.push(lambda: self._prune(step),
+                               read_vars=[engine.file_var(path)])
+            # compact only futures that finished CLEANLY — a failed save
+            # must stay queued so wait() honours its re-raise contract.
+            # Bounded for fire-and-forget users who never call wait():
+            # each dropped failure was already surfaced through
+            # engine.failures() / engine_task_failures, so log and move on
+            self._pending = [f for f in self._pending
+                             if not f.done() or f.exception() is not None]
+            cap = 2 * self.max_to_keep + 8
+            if len(self._pending) > cap:
+                live = [f for f in self._pending if not f.done()]
+                failed = [f for f in self._pending if f.done()]
+                from .log import get_logger
+                while failed and len(live) + len(failed) > cap:
+                    get_logger("mxnet_tpu.checkpoint").warning(
+                        "dropping unobserved async-save failure: %r",
+                        failed.pop(0).exception())
+                self._pending = failed + live
+            self._pending.append(fut)
+            self._pending.append(done)
+            return fut
+        path = save_sharded(self.directory, step, params, extras=extras)
+        self._prune(step)
         return path
 
-    def restore_latest(self, template):
+    def _prune(self, just_saved):
         steps = self.steps()
-        if not steps:
-            return None, None
-        step = steps[-1]
-        return step, load_sharded(self.directory, step, template)
+        if just_saved not in steps:   # async rename may not have landed
+            steps = sorted(steps + [int(just_saved)])
+        # manifest-less dirs are EXCLUDED from the quota so a torn step
+        # can never evict a valid fallback — but they are never deleted
+        # here: a dir without a manifest may be a perfectly good
+        # pre-manifest (PR<=2 layout) checkpoint, and retention must not
+        # destroy the only resume points on upgrade. (Cheap structural
+        # check only; restore runs the full checksummed validation.)
+        steps = [s for s in steps
+                 if s == just_saved or
+                 _manifest_complete(_step_path(self.directory, s))]
+        excess = len(steps) - self.max_to_keep
+        for victim in steps:
+            if excess <= 0:
+                break
+            if victim == just_saved:
+                continue              # never delete the step just written
+            shutil.rmtree(_step_path(self.directory, victim),
+                          ignore_errors=True)
+            excess -= 1
+
+    def wait(self):
+        """Drain in-flight async saves, re-raising the first failure."""
+        pending, self._pending = self._pending, []
+        first_exc = None
+        for f in pending:
+            try:
+                f.result()
+            except Exception as e:
+                if first_exc is None:
+                    first_exc = e
+        if first_exc is not None:
+            raise first_exc
+
+    def restore_latest(self, template, validate=True):
+        """Restore the newest VALID step (manifest-checked); torn or
+        unreadable steps are skipped — each skip counts into the
+        ``checkpoint_fallbacks`` counter — falling back until a valid
+        one loads. Returns (step, params) or (None, None)."""
+        for step in reversed(self.steps()):
+            path = _step_path(self.directory, step)
+            if validate:
+                errors = validate_checkpoint(path)
+                if errors:
+                    _fallback_counter.inc()
+                    _log_fallback(step, errors)
+                    continue
+            try:
+                return step, load_sharded(self.directory, step, template,
+                                          validate=False)
+            except Exception as e:
+                _fallback_counter.inc()
+                _log_fallback(step, [repr(e)])
+        return None, None
+
+    def read_extra(self, step, name):
+        return read_extra(self.directory, step, name)
+
+    # ------------------------------------------------- emergency save
+    def enable_emergency_save(self, params_fn, step_fn=None,
+                              extras_fn=None):
+        """Arm a SIGTERM emergency checkpoint: installs the preemption
+        handler and registers a synchronous save of `params_fn()` at step
+        `step_fn()` (default: one past the newest step). The training
+        loop polls `mx.fault.check_preempted()` to unwind afterwards.
+        Returns the registered callback (pass to `disable_...`)."""
+        from .fault import preemption as _pre
+
+        def emergency():
+            step = step_fn() if step_fn is not None else \
+                (self.steps()[-1] + 1 if self.steps() else 0)
+            extras = extras_fn() if extras_fn is not None else None
+            self.save(int(step), params_fn(), extras=extras)
+
+        self.disable_emergency_save()   # re-arm replaces, never stacks
+        _pre.install_preemption_handler()
+        _pre.on_preemption(emergency)
+        self._emergency = emergency
+        return emergency
+
+    def disable_emergency_save(self):
+        if self._emergency is not None:
+            from .fault import preemption as _pre
+            _pre.remove_on_preemption(self._emergency)
+            self._emergency = None
+
+
+def _log_fallback(step, errors):
+    from .log import get_logger
+    get_logger("mxnet_tpu.checkpoint").warning(
+        "skipping invalid checkpoint step %s: %s", step, "; ".join(errors))

@@ -23,6 +23,7 @@ release, self-dependency (deadlock-cycle) detection at push, and a bounded
 """
 from __future__ import annotations
 
+import collections as _collections
 import os as _os
 import threading
 import time as _time
@@ -30,11 +31,13 @@ from concurrent.futures import ThreadPoolExecutor
 
 from .observability import tracer as _tracer
 from .observability import registry as _obs_registry
+from .fault import injection as _finj
 
 __all__ = ["Var", "push", "wait_for_var", "wait_for_all", "set_bulk_size",
            "get_bulk_size", "num_workers", "native_engine_loaded", "file_var",
            "set_debug", "debug_enabled", "debug_check", "debug_check_raise",
-           "last_error", "clear_error", "wait_for_all_timeout"]
+           "last_error", "clear_error", "wait_for_all_timeout",
+           "failures", "clear_failures", "pending_tasks", "tasks_completed"]
 
 
 class Var:
@@ -208,6 +211,38 @@ _busy_counter = _reg.counter("engine_busy_seconds")
 _task_hist = _reg.histogram("engine_task_seconds")
 _wait_hist = _reg.histogram("engine_var_wait_seconds")
 
+# ------------------------------------------------ sticky failure report
+# A task that raises poisons its vars (dependents re-raise), but the only
+# carrier used to be the Future — callers that never call .result() (fire
+# and forget pushes: prefetch, async checkpoint saves) would lose the
+# error entirely. Every ROOT-CAUSE task failure (fn itself raised, not a
+# dependency re-raise) is recorded here and counted, so supervisors can
+# poll `failures()` / the `engine_task_failures` counter.
+_FAILURE_LOG_CAP = 64
+_failures = _collections.deque(maxlen=_FAILURE_LOG_CAP)
+_failures_lock = threading.Lock()
+_fail_counter = _reg.counter("engine_task_failures")
+
+
+def _record_failure(site, exc):
+    _fail_counter.inc()
+    with _failures_lock:
+        _failures.append({"site": site, "error": repr(exc),
+                          "time": _time.time()})
+
+
+def failures():
+    """Sticky engine-task failure report: the most recent root-cause task
+    errors (site + repr, newest last; bounded). Dependency re-raises are
+    not double-counted."""
+    with _failures_lock:
+        return list(_failures)
+
+
+def clear_failures():
+    with _failures_lock:
+        _failures.clear()
+
 
 def _dispatch_site(fn):
     """Span name for an engine task: module.qualname of the pushed fn —
@@ -243,6 +278,18 @@ def push(fn, read_vars=(), write_vars=()):
         if dec_once.acquire(blocking=False):
             _queue_delta(-1)
 
+    def _run_fn():
+        # fault point + sticky failure report wrap the USER fn only:
+        # dependency re-raises happen in the inner engines before _task's
+        # fn runs, so a recorded failure is always the root cause
+        try:
+            if _finj.ENABLED:
+                _finj.check("engine.task", context=_dispatch_site(fn))
+            return fn()
+        except BaseException as exc:
+            _record_failure(site or _dispatch_site(fn), exc)
+            raise
+
     def _task():
         t0 = _time.perf_counter()
         try:
@@ -250,8 +297,8 @@ def push(fn, read_vars=(), write_vars=()):
                 with _tracer.span(
                         f"engine:{site or _dispatch_site(fn)}",
                         cat="engine"):
-                    return fn()
-            return fn()
+                    return _run_fn()
+            return _run_fn()
         finally:
             dt = _time.perf_counter() - t0
             _busy_counter.inc(dt)
@@ -262,6 +309,20 @@ def push(fn, read_vars=(), write_vars=()):
     if hasattr(fut, "add_done_callback"):
         fut.add_done_callback(lambda _f: _dec())
     return fut
+
+
+def pending_tasks():
+    """Engine tasks currently queued or running (the queue-depth gauge's
+    instantaneous value — what the watchdog polls before deciding
+    whether a bounded drain is warranted)."""
+    with _qlock:
+        return _queue_depth
+
+
+def tasks_completed():
+    """Monotonic count of engine tasks that have finished (success or
+    failure) since process start — the watchdog's progress signal."""
+    return _task_hist.count
 
 
 def wait_for_var(var):

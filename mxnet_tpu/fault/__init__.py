@@ -1,0 +1,59 @@
+"""mx.fault — fault tolerance: the reflexes to PR 2's sensors.
+
+Production TPU fleets preempt VMs, lose data shards, and hang in
+collectives; this package turns those events from run-killers into
+recoveries (reference capability: the dmlc tracker's restart semantics +
+MXNet's tolerant data iters; design: SURVEY.md §5 failure detection).
+
+Four orthogonal pieces:
+
+  * `injection` — deterministic, seeded registry of named failure points
+    (`io.read`, `io.decode`, `engine.task`, `kv.collective`, `kv.init`,
+    `grad.nan`, `preempt.sigterm`, `checkpoint.save`, `checkpoint.load`)
+    toggled via ``MXTPU_FAULTS=point:key=val:...,point2:...`` or
+    `fault.inject(...)` — every recovery path below is testable without
+    real hardware failures (tools/chaos_check.py drives them all).
+  * `retry` — reusable exponential-backoff-with-jitter-and-deadline
+    policy (`RetryPolicy`), applied to recordio/ImageRecordIter reads,
+    checkpoint save/load, and `kvstore.init_distributed`.
+  * `watchdog` — per-step deadline built on
+    `engine.wait_for_all_timeout`; on a stall it dumps an observability
+    snapshot (+ trace when capturing) before raising `WatchdogTimeout`.
+  * `preemption` — SIGTERM handler with emergency callbacks (the
+    CheckpointManager registers its emergency save here); training loops
+    poll `check_preempted()` and catch `Preempted`.
+
+Recoveries are visible as metrics: ``fault_injected{point=}``,
+``fault_retries{site=}``, ``watchdog_timeouts``, plus the subsystem
+counters ``data_records_skipped``, ``engine_task_failures``,
+``trainer_steps_skipped`` and ``checkpoint_fallbacks``.
+"""
+from __future__ import annotations
+
+from . import injection
+from . import retry
+from . import watchdog
+from . import preemption
+
+from .injection import (FaultInjected, inject, clear, configure, active,
+                        should_fire, check, hits, fires, points)
+from .retry import RetryPolicy, retry_call, policy_from_env
+from .watchdog import StepWatchdog, WatchdogTimeout
+from .preemption import (Preempted, install_preemption_handler,
+                         uninstall_preemption_handler, on_preemption,
+                         preempted, check_preempted, reset_preemption)
+
+__all__ = [
+    "injection", "retry", "watchdog", "preemption",
+    # injection
+    "FaultInjected", "inject", "clear", "configure", "active",
+    "should_fire", "check", "hits", "fires", "points",
+    # retry
+    "RetryPolicy", "retry_call", "policy_from_env",
+    # watchdog
+    "StepWatchdog", "WatchdogTimeout",
+    # preemption
+    "Preempted", "install_preemption_handler",
+    "uninstall_preemption_handler", "on_preemption", "preempted",
+    "check_preempted", "reset_preemption",
+]

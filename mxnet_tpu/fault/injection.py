@@ -1,0 +1,247 @@
+"""Deterministic fault injection: a seeded registry of named failure
+points (reference capability: chaos-testing the dmlc tracker / tolerant
+iter paths without real hardware failures).
+
+A failure point is a string name checked at a specific code site
+(`engine.task` inside every engine task, `io.decode` per record decode,
+...). A *spec* attached to the point decides, deterministically, which
+hits fire:
+
+  * ``at=3+7``   — fire on exactly the 3rd and 7th hit (1-based);
+  * ``n=2``      — fire at most 2 times total;
+  * ``p=0.25``   — fire each hit with probability 0.25 drawn from a
+                   ``seed``-ed RNG (so a schedule is random *but
+                   reproducible*);
+  * ``action``   — ``raise`` (default, raises `FaultInjected`),
+                   ``stall`` (sleeps ``delay`` seconds — a stuck
+                   collective / hung engine task), or ``sigterm``
+                   (``os.kill(getpid(), SIGTERM)`` — simulated
+                   preemption, caught by `fault.preemption`).
+
+Specs come from the API (`inject()`) or the ``MXTPU_FAULTS`` env var —
+comma-separated ``point[:key=val]*`` items, e.g.::
+
+    MXTPU_FAULTS="io.read:p=0.1:seed=7,preempt.sigterm:at=12:action=sigterm"
+
+Hot paths guard on the module-level `ENABLED` flag (False whenever no
+spec is registered), so the disabled cost is one attribute load.
+"""
+from __future__ import annotations
+
+import os
+import random
+import threading
+import time
+
+from ..base import MXNetError
+from ..observability import registry as _obs_registry
+
+__all__ = ["FaultInjected", "POINTS", "ENABLED", "inject", "clear",
+           "configure", "active", "should_fire", "check", "hits", "fires",
+           "points"]
+
+# the failure points wired through the framework (a spec may name any
+# string — new sites don't need registration here — but these are the
+# ones the subsystems check)
+POINTS = ("io.read", "io.decode", "engine.task", "kv.collective",
+          "kv.init", "grad.nan", "preempt.sigterm", "checkpoint.save",
+          "checkpoint.load")
+
+ENABLED = False            # fast-path guard; True iff any spec registered
+
+_reg = _obs_registry()
+_lock = threading.Lock()
+_specs = {}                # point -> _Spec
+_injected_counters = {}    # point -> Counter handle
+
+
+class FaultInjected(MXNetError):
+    """Raised at an armed failure point (action="raise")."""
+
+    def __init__(self, point, context=""):
+        self.point = point
+        self.context = context
+        msg = f"injected fault at {point!r}"
+        if context:
+            msg += f" ({context})"
+        super().__init__(msg)
+
+
+class _Spec:
+    __slots__ = ("point", "prob", "times", "at", "action", "delay",
+                 "message", "_rng", "hits", "fires")
+
+    def __init__(self, point, prob=1.0, times=None, at=None, seed=0,
+                 action="raise", delay=0.5, message=""):
+        if action not in ("raise", "stall", "sigterm"):
+            raise MXNetError(f"unknown fault action {action!r}; use "
+                             "'raise', 'stall' or 'sigterm'")
+        self.point = point
+        self.prob = float(prob)
+        self.times = None if times is None else int(times)
+        self.at = None if at is None else frozenset(int(a) for a in at)
+        self.action = action
+        self.delay = float(delay)
+        self.message = message
+        self._rng = random.Random(seed)
+        self.hits = 0       # times the point was reached
+        self.fires = 0      # times the fault actually triggered
+
+    def decide(self):
+        """One hit: returns True when the fault fires. Caller holds _lock."""
+        self.hits += 1
+        if self.times is not None and self.fires >= self.times:
+            return False
+        if self.at is not None:
+            fire = self.hits in self.at
+        elif self.prob >= 1.0:
+            fire = True
+        else:
+            fire = self._rng.random() < self.prob
+        if fire:
+            self.fires += 1
+        return fire
+
+
+def _counter(point):
+    c = _injected_counters.get(point)
+    if c is None:
+        c = _injected_counters[point] = _reg.counter("fault_injected",
+                                                     point=point)
+    return c
+
+
+def inject(point, prob=1.0, times=None, at=None, seed=0, action="raise",
+           delay=0.5, message=""):
+    """Arm a failure point. Replaces any existing spec for `point`.
+
+    at: iterable of 1-based hit indices that fire (overrides prob);
+    times: max total fires; seed: RNG seed for probabilistic schedules;
+    action: 'raise' | 'stall' (sleep `delay` s) | 'sigterm'."""
+    global ENABLED
+    spec = _Spec(point, prob=prob, times=times, at=at, seed=seed,
+                 action=action, delay=delay, message=message)
+    with _lock:
+        _specs[point] = spec
+        ENABLED = True
+    return spec
+
+
+def clear(point=None):
+    """Disarm one failure point, or all of them (point=None)."""
+    global ENABLED
+    with _lock:
+        if point is None:
+            _specs.clear()
+        else:
+            _specs.pop(point, None)
+        ENABLED = bool(_specs)
+
+
+def configure(spec_string):
+    """Arm failure points from an ``MXTPU_FAULTS``-style string:
+    comma-separated ``point[:key=val]*`` items. Returns the spec list."""
+    out = []
+    for item in (spec_string or "").split(","):
+        item = item.strip()
+        if not item:
+            continue
+        parts = item.split(":")
+        point, kw = parts[0], {}
+        for p in parts[1:]:
+            if "=" not in p:
+                raise MXNetError(f"malformed MXTPU_FAULTS item {item!r}: "
+                                 f"expected key=val, got {p!r}")
+            k, v = p.split("=", 1)
+            k = {"p": "prob", "n": "times"}.get(k, k)
+            if k == "at":
+                kw["at"] = [int(x) for x in v.split("+")]
+            elif k == "prob":
+                kw["prob"] = float(v)
+            elif k in ("times", "seed"):
+                kw[k] = int(v)
+            elif k == "delay":
+                kw["delay"] = float(v)
+            elif k in ("action", "message"):
+                kw[k] = v
+            else:
+                raise MXNetError(f"unknown MXTPU_FAULTS key {k!r} in "
+                                 f"{item!r}")
+        out.append(inject(point, **kw))
+    return out
+
+
+def active(point=None):
+    """Whether a spec is armed for `point` (or any point, point=None)."""
+    with _lock:
+        return bool(_specs) if point is None else point in _specs
+
+
+def points():
+    """Currently armed point names."""
+    with _lock:
+        return sorted(_specs)
+
+
+def hits(point):
+    """How many times `point` was reached (armed specs only)."""
+    with _lock:
+        s = _specs.get(point)
+        return s.hits if s is not None else 0
+
+
+def fires(point):
+    """How many times `point` actually fired."""
+    with _lock:
+        s = _specs.get(point)
+        return s.fires if s is not None else 0
+
+
+def should_fire(point):
+    """One hit at `point`: True when the armed schedule says fire (the
+    caller then applies its own failure semantics — e.g. the Trainer
+    poisons gradients for `grad.nan`). Counts into
+    ``fault_injected{point=}`` when firing."""
+    if not ENABLED:
+        return False
+    with _lock:
+        spec = _specs.get(point)
+        if spec is None:
+            return False
+        fire = spec.decide()
+    if fire:
+        _counter(point).inc()
+    return fire
+
+
+def check(point, context=""):
+    """One hit at `point`, applying the spec's action when it fires:
+    raise `FaultInjected`, stall (sleep), or deliver SIGTERM to this
+    process. Returns True when the fault fired with a non-raise action,
+    False when nothing fired."""
+    if not ENABLED:
+        return False
+    with _lock:
+        spec = _specs.get(point)
+        if spec is None:
+            return False
+        fire = spec.decide()
+        action, delay, msg = spec.action, spec.delay, spec.message
+    if not fire:
+        return False
+    _counter(point).inc()
+    if action == "stall":
+        time.sleep(delay)
+        return True
+    if action == "sigterm":
+        import signal
+        os.kill(os.getpid(), signal.SIGTERM)
+        return True
+    raise FaultInjected(point, msg or context)
+
+
+# env arming: parsed once at import — the chaos harness and users arm
+# via API; MXTPU_FAULTS covers launcher-driven runs
+_env = os.environ.get("MXTPU_FAULTS")
+if _env:
+    configure(_env)

@@ -1,0 +1,160 @@
+"""Hung-step watchdog: a per-step deadline on the async engine.
+
+A stuck collective or wedged engine task leaves the run silently hanging
+— `engine.wait_for_all()` would block forever. `StepWatchdog.check()`
+instead bounds the drain with `engine.wait_for_all_timeout`; on a stall
+it writes a post-mortem snapshot (metrics registry + engine failure
+report + the captured trace, when one is being recorded) and raises
+`WatchdogTimeout`, so the supervisor restarts the task instead of
+burning the reservation.
+
+Wiring: `gluon.Trainer.step` calls `maybe_check()` each step, which is a
+no-op unless ``MXTPU_STEP_TIMEOUT_MS`` is set (or a default watchdog was
+installed via `set_default`). Loops with their own structure construct a
+`StepWatchdog` directly.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+
+from ..base import MXNetError
+from ..observability import registry as _obs_registry
+
+__all__ = ["WatchdogTimeout", "StepWatchdog", "set_default", "maybe_check"]
+
+_reg = _obs_registry()
+_timeout_counter = _reg.counter("watchdog_timeouts")
+
+
+class WatchdogTimeout(MXNetError):
+    """The engine failed to drain within the step deadline. The snapshot
+    path (when one was written) is in `.snapshot_path`."""
+
+    def __init__(self, msg, snapshot_path=None):
+        self.snapshot_path = snapshot_path
+        super().__init__(msg)
+
+
+class StepWatchdog:
+    """Per-step stall detection + post-mortem snapshot.
+
+    `check()` is free when the engine is drained, and a pending queue
+    with task COMPLETIONS since the previous check (a moving pipeline)
+    is never flagged or blocked on. Only zero completions across a full
+    inter-check window escalates to the bounded
+    `engine.wait_for_all_timeout` drain, whose expiry dumps the snapshot
+    and raises `WatchdogTimeout`.
+
+    CONTRACT: the deadline is a bound on any single engine task that is
+    the only thing in flight — set `timeout_ms` ABOVE the longest
+    legitimate task (e.g. the largest async checkpoint save); a lone
+    task that outlives both the inter-check window and the drain
+    deadline is indistinguishable from a hang and is reported as one.
+
+    timeout_ms: the escalation drain deadline (None reads
+    ``MXTPU_STEP_TIMEOUT_MS``; 0 disables);
+    snapshot_dir: where stall post-mortems are written."""
+
+    def __init__(self, timeout_ms=None, snapshot_dir=None):
+        if timeout_ms is None:
+            timeout_ms = float(os.environ.get("MXTPU_STEP_TIMEOUT_MS", 0))
+        self.timeout_ms = int(timeout_ms)
+        self.snapshot_dir = snapshot_dir or os.environ.get(
+            "MXTPU_WATCHDOG_DIR", "/tmp/mxtpu_watchdog")
+        self._last_completed = None
+
+    @property
+    def enabled(self):
+        return self.timeout_ms > 0
+
+    def check(self, step=None):
+        """Returns 0 when the engine is drained, making progress, or
+        drains within the deadline; raises `WatchdogTimeout` (after
+        writing the post-mortem) on a genuine stall."""
+        if not self.enabled:
+            return 0
+        from .. import engine
+        completed = engine.tasks_completed()
+        if engine.pending_tasks() == 0:
+            self._last_completed = completed
+            return 0
+        if self._last_completed is None:
+            # first observation of a busy queue: establish the window
+            # baseline instead of escalating blind — a legitimate long
+            # task started before this watchdog must get one full
+            # inter-check window before it can be called a hang
+            self._last_completed = completed
+            return 0
+        if completed > self._last_completed:
+            self._last_completed = completed
+            return 0
+        stalled = engine.wait_for_all_timeout(self.timeout_ms)
+        self._last_completed = engine.tasks_completed()
+        if not stalled:
+            return 0
+        _timeout_counter.inc()
+        path = self.dump_snapshot(step=step,
+                                  reason=f"no engine progress, and the "
+                                         f"pending queue did not drain "
+                                         f"within {self.timeout_ms}ms")
+        raise WatchdogTimeout(
+            f"watchdog: step{'' if step is None else f' {step}'} exceeded "
+            f"{self.timeout_ms}ms engine-drain deadline with no progress "
+            f"(snapshot: {path}; engine: {engine.last_error() or 'n/a'})",
+            snapshot_path=path)
+
+    def dump_snapshot(self, step=None, reason=""):
+        """Write the post-mortem: metrics snapshot, engine failure report
+        and last_error as JSON, plus the in-flight trace when the tracer
+        is capturing. Returns the JSON path."""
+        from .. import engine
+        from ..observability import tracer
+        os.makedirs(self.snapshot_dir, exist_ok=True)
+        stamp = time.strftime("%Y%m%d-%H%M%S")
+        base = os.path.join(self.snapshot_dir, f"watchdog-{stamp}")
+        trace_path = None
+        if tracer.ACTIVE:
+            trace_path = base + ".trace.json"
+            tracer.dump(trace_path)
+        snap = {
+            "time": time.time(),
+            "step": step,
+            "reason": reason,
+            "engine_last_error": engine.last_error(),
+            "engine_failures": engine.failures(),
+            "trace": trace_path,
+            "metrics": _reg.snapshot(),
+        }
+        path = base + ".json"
+        with open(path, "w") as f:
+            json.dump(snap, f, indent=1, default=str)
+        return path
+
+
+_UNSET = object()      # not yet constructed (env decides on first use)
+_DISABLED = object()   # explicitly uninstalled via set_default(None)
+_default = _UNSET
+
+
+def set_default(watchdog):
+    """Install the watchdog `maybe_check()` consults. `None` genuinely
+    uninstalls it — even with ``MXTPU_STEP_TIMEOUT_MS`` set, no default
+    is reconstructed until the next `set_default(watchdog)`."""
+    global _default
+    _default = _DISABLED if watchdog is None else watchdog
+    return watchdog
+
+
+def maybe_check(step=None):
+    """Trainer hook: check the default watchdog, constructing one from
+    ``MXTPU_STEP_TIMEOUT_MS`` on first call. No-op (and near-free) when
+    uninstalled or no deadline is configured — a 0-timeout watchdog is
+    disabled."""
+    global _default
+    if _default is _DISABLED:
+        return 0
+    if _default is _UNSET:
+        _default = StepWatchdog()
+    return _default.check(step=step)

@@ -14,12 +14,15 @@ from .. import optimizer as opt_mod
 from .. import kvstore as kvs_mod
 from ..observability import tracer as _tracer
 from ..observability import registry as _obs_registry
+from ..fault import injection as _finj
+from ..fault import watchdog as _fwatchdog
 from .parameter import Parameter, ParameterDict
 
 __all__ = ["Trainer"]
 
 _reg = _obs_registry()
 _steps_counter = _reg.counter("trainer_steps")
+_skips_counter = _reg.counter("trainer_steps_skipped")
 _steps_s_gauge = _reg.gauge("trainer_steps_per_s")
 _grad_norm_gauge = _reg.gauge("trainer_grad_norm")
 _grad_norm_fn = None
@@ -47,6 +50,11 @@ class Trainer:
     step() additionally unscales gradients and drives the scaler's
     overflow-skip/halve protocol.
 
+    `max_skipped_steps=N` escalates graceful degradation: more than N
+    CONSECUTIVE skipped updates raise MXNetError (each skip also counts
+    into the `trainer_steps_skipped` metric; `consecutive_skipped_steps`
+    exposes the running streak so loops can retry a batch).
+
     `fused=True` (the default) routes step() through the multi-tensor
     subsystem (optimizer/multi_tensor.py): parameters are grouped into
     dtype-homogeneous byte-capped buckets (cap = engine.get_bulk_size()),
@@ -59,7 +67,7 @@ class Trainer:
 
     def __init__(self, params, optimizer, optimizer_params=None, kvstore="device",
                  compression_params=None, update_on_kvstore=None,
-                 skip_nonfinite=False, fused=True):
+                 skip_nonfinite=False, fused=True, max_skipped_steps=None):
         if isinstance(params, (dict, ParameterDict)):
             params = list(params.values())
         if not isinstance(params, (list, tuple)):
@@ -109,6 +117,12 @@ class Trainer:
         self._scale = 1.0
         self._last_step_t = None   # steps/s gauge anchor
         self.skip_nonfinite = skip_nonfinite
+        # graceful-degradation escalation: N+1 CONSECUTIVE skipped
+        # updates (AMP overflow / nonfinite grads) raise instead of
+        # silently free-running — persistent NaNs are a training outage,
+        # not noise (None disables; see docs/RELIABILITY.md)
+        self.max_skipped_steps = max_skipped_steps
+        self._consecutive_skips = 0
 
     @property
     def learning_rate(self):
@@ -200,9 +214,36 @@ class Trainer:
 
     def _step_impl(self, batch_size, ignore_stale_grad):
         self._optimizer.rescale_grad = self._scale / batch_size
+        if _finj.ENABLED and _finj.should_fire("grad.nan"):
+            # deterministic NaN-gradient injection (chaos testing the
+            # skip_nonfinite / AMP-overflow reflexes end to end)
+            for p in self._params:
+                if p._grad is not None:
+                    p._grad._rebind(p._grad._data * float("nan"))
         self._init_kvstore()   # incremental: picks up late-materialised params
         self.allreduce_grads()
         self._apply_update(ignore_stale_grad)
+        _fwatchdog.maybe_check(step=int(_steps_counter.value))
+
+    # ------------------------------------------ skip-streak escalation
+    @property
+    def consecutive_skipped_steps(self):
+        return self._consecutive_skips
+
+    def _note_skip(self, reason):
+        self._consecutive_skips += 1
+        _skips_counter.inc()
+        if self.max_skipped_steps is not None and \
+                self._consecutive_skips > self.max_skipped_steps:
+            raise MXNetError(
+                f"Trainer: {self._consecutive_skips} consecutive skipped "
+                f"updates ({reason}) exceeds max_skipped_steps="
+                f"{self.max_skipped_steps} — gradients are persistently "
+                f"non-finite; lower the learning rate or restore a "
+                f"checkpoint")
+
+    def _note_applied(self):
+        self._consecutive_skips = 0
 
     def _apply_update(self, ignore_stale_grad):
         """Guard (AMP / nonfinite) + optimizer application, shared by
@@ -211,6 +252,7 @@ class Trainer:
             self._fused_update(ignore_stale_grad)
             return
         if self._guard_says_skip():
+            self._note_skip("AMP overflow / nonfinite gradients")
             return
         if self._update_on_kvstore:
             def apply_on_store(i, p):
@@ -220,8 +262,10 @@ class Trainer:
                 self._kvstore.push(i, [p.grad()], layout="replicated")
                 self._kvstore.pull(i, out=p.data())
             self._for_each_updatable(apply_on_store, ignore_stale_grad)
+            self._note_applied()
             return
         self._update(ignore_stale_grad)
+        self._note_applied()
 
     def _guard_says_skip(self):
         """Shared AMP-unscale / overflow-skip / nonfinite-skip guard for
@@ -313,30 +357,38 @@ class Trainer:
             if overflow:
                 amp.unscale(self)   # rare path: grads end unscaled, as in
                 scaler.update_scale(True)   # the per-param path
+                self._note_skip("AMP overflow")
                 return
             inv_scale = 1.0 / scaler.loss_scale
             scaler.update_scale(False)
             # per-param amp.unscale touches EVERY grad; params outside
             # the buckets (grad_req="null" with an accumulated grad,
-            # stale-skipped) must observe the same unscaled values
+            # stale-skipped) must observe the same unscaled values —
+            # one fused multi-tensor launch, same as amp.unscale
             bucketed = {id(p) for b in buckets for _, p in b}
-            for p in self._params:
-                if p._grad is not None and id(p) not in bucketed:
-                    profiler.record_dispatch("amp_unscale")
-                    p._grad._rebind(p._grad._data * inv_scale)
+            leftovers = [p for p in self._params
+                         if p._grad is not None and id(p) not in bucketed]
+            for p, g in zip(leftovers,
+                            amp.unscale_arrays(
+                                [p._grad._data for p in leftovers],
+                                inv_scale)):
+                p._grad._rebind(g)
         elif self.skip_nonfinite:
             profiler.record_dispatch("nonfinite_guard")
             if amp.grads_nonfinite(self._params):
+                self._note_skip("nonfinite gradients")
                 return
         if not _tracer.ACTIVE:
             for bucket in buckets:
                 self._updater.update_bucket(bucket, inv_scale=inv_scale)
+            self._note_applied()
             return
         for bi, bucket in enumerate(buckets):
             with _tracer.span(
                     "Trainer.fused_bucket", cat="trainer",
                     args={"bucket": bi, "params": len(bucket)}):
                 self._updater.update_bucket(bucket, inv_scale=inv_scale)
+        self._note_applied()
 
     def save_states(self, fname):
         if self._update_on_kvstore:

@@ -14,6 +14,28 @@ import numpy as np
 
 from .base import MXNetError, _as_list
 from .ndarray.ndarray import NDArray, array
+from .observability import registry as _obs_registry
+from .fault import injection as _finj
+from .fault import retry as _retry
+
+_reg = _obs_registry()
+_skipped_counter = _reg.counter("data_records_skipped")
+
+_io_policy = None
+
+
+def _read_policy():
+    global _io_policy
+    if _io_policy is None:
+        # retry only plausibly-TRANSIENT read errors (OSError, plus the
+        # injectable fault for chaos testing); deterministic corruption
+        # (bad magic, truncated payload) goes straight to the bounded
+        # skip path instead of burning 3 backoff sleeps per bad record
+        _io_policy = _retry.policy_from_env(
+            "MXTPU_IO", max_retries=3, base_delay=0.02, max_delay=0.5,
+            deadline=30.0, name="io_read",
+            retry_on=(OSError, _finj.FaultInjected))
+    return _io_policy
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
            "PrefetchingIter", "ImageRecordIter", "CSVIter", "LibSVMIter",
@@ -206,16 +228,38 @@ class PrefetchingIter(DataIter):
         return self.iter.provide_label
 
     def reset(self):
+        # drain the in-flight fetch WITHOUT re-raising: a worker error
+        # already surfaced (or is being abandoned) — reset() is the
+        # recovery point, so worker state must come back clean and the
+        # iterator be reusable afterwards
         if self._pending is not None:
-            self._pending.result()
+            try:
+                self._pending.result()
+            except BaseException:
+                pass
+        self._pending = None
         self.iter.reset()
         self._submit()
 
     def next(self):
-        batch = self._pending.result()
+        if self._pending is None:       # recovering from a surfaced error
+            self._submit()
+        fut = self._pending
+        try:
+            # EOF is signalled by a None batch (the fetch task converts
+            # the backing iter's StopIteration) — only WORKER ERRORS
+            # re-raise out of the future
+            batch = fut.result()
+        except BaseException:
+            # surface the worker error promptly, exactly once: the next
+            # call prefetches the FOLLOWING batch instead of replaying
+            # this future forever (the engine also logged the failure —
+            # engine.failures())
+            self._pending = None
+            raise
         if batch is None:
-            raise StopIteration
-        self._submit()
+            raise StopIteration         # EOF: _pending stays done-None,
+        self._submit()                  # so repeated next() re-raises
         return batch
 
 
@@ -232,13 +276,21 @@ class ImageRecordIter(DataIter):
                  batch_size=32, num_samples=1024, num_classes=1000,
                  shuffle=False, rand_crop=False, rand_mirror=False,
                  mean_r=0, mean_g=0, mean_b=0, std_r=1, std_g=1, std_b=1,
-                 seed=0, **kwargs):
+                 seed=0, max_bad_records=None, **kwargs):
         super().__init__(batch_size)
         self.data_shape = tuple(data_shape)
         self.num_samples = num_samples
         self.num_classes = num_classes
         self._seed = seed
         self.cursor = 0
+        # bounded bad-record tolerance (reference: the C++ iter logs and
+        # skips undecodable records): per-epoch budget, lifetime tally
+        if max_bad_records is None:
+            max_bad_records = int(os.environ.get("MXTPU_MAX_BAD_RECORDS",
+                                                 16))
+        self.max_bad_records = max_bad_records
+        self.records_skipped = 0      # lifetime, mirrors the global metric
+        self._epoch_skipped = 0
         self._mean = np.array([mean_r, mean_g, mean_b], np.float32)
         self._std = np.array([std_r or 1, std_g or 1, std_b or 1], np.float32)
         # Streaming reader: never load the whole .rec into host memory
@@ -293,6 +345,7 @@ class ImageRecordIter(DataIter):
 
     def reset(self):
         self.cursor = 0
+        self._epoch_skipped = 0    # the bad-record budget is per epoch
         if self._rec is not None and self._keys is None:
             self._rec.reset()      # sequential stream: rewind the file
 
@@ -303,27 +356,78 @@ class ImageRecordIter(DataIter):
             return self._rec[self._keys[i]]          # native mmap reader
         return self._rec.read()    # sequential; None at EOF
 
+    def _read_raw(self, i):
+        """One record read with the io.read fault point + retry/backoff.
+        Random-access reads (idx sidecar / native mmap) are idempotent
+        and retry per the MXTPU_IO policy; the sequential stream cannot
+        reposition, so its errors propagate after a single attempt."""
+        def attempt():
+            if _finj.ENABLED:
+                _finj.check("io.read", context=f"record {i}")
+            return self._next_raw(i)
+        if self._keys is not None:
+            return _read_policy().call(attempt)
+        return attempt()
+
+    def _skip_bad_record(self, i, exc):
+        """Bounded skip of an undecodable/unreadable record (reference
+        tolerance: the C++ iter logs and moves on). Over-budget raises —
+        a mostly-corrupt shard is a data outage, not noise."""
+        self.records_skipped += 1
+        self._epoch_skipped += 1
+        _skipped_counter.inc()
+        from .log import get_logger
+        get_logger("mxnet_tpu.io").warning(
+            "skipping corrupt record %s (%s skipped this epoch): %r",
+            i, self._epoch_skipped, exc)
+        if self._epoch_skipped > self.max_bad_records:
+            raise MXNetError(
+                f"ImageRecordIter: {self._epoch_skipped} bad records in "
+                f"one epoch exceeds max_bad_records={self.max_bad_records}"
+            ) from exc
+
     def next(self):
-        if self.num_samples is not None and \
-                self.cursor + self.batch_size > self.num_samples:
-            raise StopIteration
         if self._rec is not None:
-            raws = []
-            for i in range(self.cursor, self.cursor + self.batch_size):
-                raw = self._next_raw(i)
+            decoded = []
+            while len(decoded) < self.batch_size:
+                if self.num_samples is not None and \
+                        self.num_samples - self.cursor < \
+                        self.batch_size - len(decoded):
+                    # epoch end: too few records left to ever complete
+                    # this batch — stop WITHOUT consuming them (matching
+                    # the old drop-partial semantics), so tail records
+                    # that can't ship are neither decoded nor charged
+                    # against the bad-record budget
+                    raise StopIteration
+                i = self.cursor
+                self.cursor += 1
+                try:
+                    raw = self._read_raw(i)
+                except Exception as e:
+                    if self._keys is None:
+                        raise             # sequential: cannot reposition
+                    self._skip_bad_record(i, e)
+                    continue
                 if raw is None:
-                    raise StopIteration     # sequential EOF mid-batch
-                raws.append(raw)
-            decoded = [self._decode(r) for r in raws]
+                    raise StopIteration   # sequential EOF mid-batch
+                try:
+                    if _finj.ENABLED:
+                        _finj.check("io.decode", context=f"record {i}")
+                    decoded.append(self._decode(raw))
+                except Exception as e:
+                    self._skip_bad_record(i, e)
             data = np.stack([d for d, _ in decoded])
             label = np.array([l for _, l in decoded], np.float32)
         else:
+            if self.num_samples is not None and \
+                    self.cursor + self.batch_size > self.num_samples:
+                raise StopIteration
             rng = np.random.RandomState(self._seed + self.cursor)
             data = rng.rand(self.batch_size,
                             *self.data_shape).astype(np.float32)
             label = (np.arange(self.cursor, self.cursor + self.batch_size)
                      % self.num_classes).astype(np.float32)
-        self.cursor += self.batch_size
+            self.cursor += self.batch_size
         return DataBatch([array(data)], [array(label)],
                          provide_data=self.provide_data,
                          provide_label=self.provide_label)

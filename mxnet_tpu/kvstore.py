@@ -34,6 +34,8 @@ from .base import MXNetError, _as_list
 from .ndarray.ndarray import NDArray
 from .observability import tracer as _tracer
 from .observability import registry as _obs_registry
+from .fault import injection as _finj
+from .fault import retry as _retry
 
 __all__ = ["KVStore", "create", "init_distributed"]
 
@@ -104,9 +106,34 @@ def init_distributed(coordinator_address=None, num_processes=None,
             return
     except Exception:
         pass
+    def _attempt():
+        if _finj.ENABLED:
+            _finj.check("kv.init", context=str(coordinator_address))
+        try:
+            jax.distributed.initialize(coordinator_address, num_processes,
+                                       process_id, **kwargs)
+        except BaseException:
+            # jax's State.initialize assigns service/client BEFORE the
+            # connect completes and refuses to run twice; without this
+            # reset every retry would die instantly on "should only be
+            # called once" instead of re-attempting the rendezvous
+            try:
+                jax.distributed.shutdown()
+            except Exception:
+                pass
+            raise
+
+    explicit = coordinator_address is not None or num_processes is not None
     try:
-        jax.distributed.initialize(coordinator_address, num_processes,
-                                   process_id, **kwargs)
+        if explicit:
+            # a cold coordinator is the NORMAL multi-host bootstrap race
+            # (rank 0 may come up seconds later): exponential backoff with
+            # jitter + deadline instead of one-shot failure
+            _retry.policy_from_env(
+                "MXTPU_DIST", max_retries=4, base_delay=0.5, max_delay=8.0,
+                deadline=120.0, name="init_distributed").call(_attempt)
+        else:
+            _attempt()
         _DIST_INITIALIZED = True
     except Exception as e:
         if coordinator_address is not None or num_processes is not None:
@@ -321,6 +348,10 @@ class KVStore:
         say so explicitly (gluon.Trainer passes layout="replicated");
         "auto" is the convention for imperative push() of stacked towers.
         """
+        if _finj.ENABLED:
+            # 'stall' specs here simulate a hung collective (the watchdog
+            # test bed); 'raise' specs simulate a lost peer
+            _finj.check("kv.collective", context=f"key={key}")
         out = arrays[0]
         for a in arrays[1:]:
             out = out + a
@@ -455,6 +486,11 @@ class KVStore:
         flatten, split = fns
         profiler.record_dispatch("kv_flatten")
         flat = flatten(list(arrays))
+        if _finj.ENABLED:
+            # fires ONLY where the flat path actually performs a cross-
+            # worker collective (the identity/mixed fast paths above hit
+            # allreduce_'s own check per array instead)
+            _finj.check("kv.collective", context=f"flat key={key}")
         profiler.record_dispatch("kv_allreduce")
         red = self.allreduce_process_sum(flat)
         profiler.record_dispatch("kv_split")

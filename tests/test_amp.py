@@ -43,6 +43,38 @@ def test_dynamic_loss_scaler_down_on_overflow():
     assert s.loss_scale == 1024.0  # window hit -> scale back up
 
 
+def test_dynamic_loss_scaler_floor_at_one():
+    """Satellite (ISSUE 3): repeated overflows halve the scale but never
+    push it below 1.0 (the floor that keeps grads representable)."""
+    s = amp.DynamicLossScaler(init_scale=4.0, scale_factor=2.0,
+                              scale_window=100)
+    for _ in range(10):
+        s.update_scale(True)
+    assert s.loss_scale == 1.0
+    s.update_scale(True)
+    assert s.loss_scale == 1.0      # clamped, not 0.5
+
+
+def test_dynamic_loss_scaler_window_resets_on_overflow():
+    """An overflow inside the growth window resets the unskipped streak:
+    growth needs a FULL clean window afterwards."""
+    s = amp.DynamicLossScaler(init_scale=1024.0, scale_factor=2.0,
+                              scale_window=3)
+    s.update_scale(False)
+    s.update_scale(False)
+    s.update_scale(True)            # overflow 1 step before growth
+    assert s.loss_scale == 512.0
+    s.update_scale(False)
+    s.update_scale(False)
+    assert s.loss_scale == 512.0    # streak restarted: no growth yet
+    s.update_scale(False)
+    assert s.loss_scale == 1024.0   # full clean window -> doubles
+    # and the window counter resets after growth too
+    s.update_scale(False)
+    s.update_scale(False)
+    assert s.loss_scale == 1024.0
+
+
 def test_scale_loss_and_unscale_roundtrip():
     amp.init(target_dtype="float16")
     try:

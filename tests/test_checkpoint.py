@@ -68,3 +68,204 @@ def test_sharded_checkpoint_of_sharded_params():
         checkpoint.save_sharded(d, 0, {"w": sharded})
         restored = checkpoint.load_sharded(d, 0, {"w": jnp.zeros((8, 4))})
         np.testing.assert_allclose(np.asarray(restored["w"]), np.asarray(w))
+
+
+# ------------------------- preemption-safe checkpointing (ISSUE 3) ------
+def _corrupt_one_payload_byte(step_dir):
+    for dirpath, _, files in os.walk(step_dir):
+        for f in files:
+            full = os.path.join(dirpath, f)
+            if f != checkpoint.MANIFEST_NAME and os.path.getsize(full) > 4:
+                blob = open(full, "rb").read()
+                with open(full, "wb") as fh:
+                    fh.write(bytes([blob[0] ^ 0xFF]) + blob[1:])
+                return full
+    raise AssertionError("no payload file to corrupt")
+
+
+def test_atomic_save_writes_manifest_and_validates():
+    with tempfile.TemporaryDirectory() as d:
+        checkpoint.save_sharded(d, 7, {"w": jnp.arange(4.0)},
+                                extras={"meta.json": b"{}"})
+        step_dir = os.path.join(d, "7")
+        assert os.path.exists(os.path.join(step_dir,
+                                           checkpoint.MANIFEST_NAME))
+        assert checkpoint.validate_checkpoint(step_dir) == []
+        assert checkpoint.read_extra(d, 7, "meta.json") == b"{}"
+        # no tmp dirs left behind
+        assert not [n for n in os.listdir(d) if n.startswith(".tmp")]
+
+
+def test_validate_detects_corruption_and_tears():
+    with tempfile.TemporaryDirectory() as d:
+        checkpoint.save_sharded(d, 1, {"w": jnp.arange(8.0)})
+        step_dir = os.path.join(d, "1")
+        _corrupt_one_payload_byte(step_dir)
+        errs = checkpoint.validate_checkpoint(step_dir)
+        assert errs and "checksum" in " ".join(errs)
+        with pytest.raises(mx.MXNetError, match="invalid checkpoint"):
+            checkpoint.load_sharded(d, 1, {"w": jnp.zeros(8)})
+        # a bare dir (torn before the manifest landed) is invalid too
+        os.makedirs(os.path.join(d, "2"))
+        assert checkpoint.validate_checkpoint(os.path.join(d, "2"))
+
+
+def test_restore_latest_falls_back_to_newest_valid():
+    from mxnet_tpu.observability import registry
+    with tempfile.TemporaryDirectory() as d:
+        mgr = checkpoint.CheckpointManager(d, max_to_keep=5)
+        for s in (1, 2, 3):
+            mgr.save(s, {"w": jnp.full((2,), float(s))})
+        _corrupt_one_payload_byte(os.path.join(d, "3"))
+        fb0 = registry().counter("checkpoint_fallbacks").value
+        step, restored = mgr.restore_latest({"w": jnp.zeros(2)})
+        assert step == 2
+        np.testing.assert_allclose(np.asarray(restored["w"]), [2.0, 2.0])
+        assert registry().counter("checkpoint_fallbacks").value == fb0 + 1
+        assert mgr.valid_steps() == [1, 2]
+
+
+def test_retention_recomputes_after_save_never_deletes_new():
+    """Satellite: re-saving an existing step must not make max_to_keep
+    off by one, and the just-written step always survives pruning."""
+    with tempfile.TemporaryDirectory() as d:
+        mgr = checkpoint.CheckpointManager(d, max_to_keep=2)
+        for s in (1, 2, 3):
+            mgr.save(s, {"w": jnp.full((2,), float(s))})
+        assert mgr.steps() == [2, 3]
+        mgr.save(3, {"w": jnp.full((2,), 3.5)})   # re-save existing step
+        assert mgr.steps() == [2, 3]
+        mgr.save(1, {"w": jnp.full((2,), 1.5)})   # older than survivors
+        assert 1 in mgr.steps() and len(mgr.steps()) == 2
+
+
+def test_retention_never_deletes_pre_manifest_dirs():
+    """Manifest-less step dirs (pre-manifest layout, or torn) are
+    excluded from the retention quota but NEVER auto-deleted — an
+    upgrade must not destroy old-format resume points."""
+    with tempfile.TemporaryDirectory() as d:
+        legacy = os.path.join(d, "10")
+        os.makedirs(legacy)
+        with open(os.path.join(legacy, "payload"), "wb") as f:
+            f.write(b"old-format checkpoint")
+        mgr = checkpoint.CheckpointManager(d, max_to_keep=2)
+        for s in (20, 21, 22):
+            mgr.save(s, {"w": jnp.full((2,), float(s))})
+        assert os.path.exists(legacy)          # survived every prune
+        assert mgr.steps() == [10, 21, 22]     # quota counted valid only
+
+
+def test_async_save_via_engine_and_wait():
+    with tempfile.TemporaryDirectory() as d:
+        mgr = checkpoint.CheckpointManager(d, max_to_keep=2)
+        fut = mgr.save(5, {"w": jnp.arange(4.0)}, _async=True)
+        mgr.wait()
+        assert fut.done() and fut.exception() is None
+        assert mgr.valid_steps() == [5]
+        step, restored = mgr.restore_latest({"w": jnp.zeros(4)})
+        assert step == 5
+        np.testing.assert_allclose(np.asarray(restored["w"]),
+                                   np.arange(4.0))
+
+
+def test_async_save_failure_surfaces_and_recovers():
+    from mxnet_tpu import fault, engine
+    with tempfile.TemporaryDirectory() as d:
+        mgr = checkpoint.CheckpointManager(d, max_to_keep=2)
+        fault.inject("checkpoint.save", times=10)   # out-retries policy
+        try:
+            mgr.save(6, {"w": jnp.arange(4.0)}, _async=True)
+            with pytest.raises(fault.FaultInjected):
+                mgr.wait()
+        finally:
+            fault.clear()
+            engine.clear_failures()
+        assert mgr.valid_steps() == []
+        mgr.save(6, {"w": jnp.arange(4.0)})       # sync re-save recovers
+        assert mgr.valid_steps() == [6]
+        step, _ = mgr.restore_latest({"w": jnp.zeros(4)})
+        assert step == 6
+
+
+def test_async_save_error_survives_later_saves():
+    """wait()'s re-raise contract: a failed async save must surface even
+    when more saves were queued after it finished failing."""
+    from mxnet_tpu import fault, engine
+    import time
+    with tempfile.TemporaryDirectory() as d:
+        mgr = checkpoint.CheckpointManager(d, max_to_keep=5)
+        fault.inject("checkpoint.save", times=10)
+        try:
+            fut = mgr.save(1, {"w": jnp.arange(2.0)}, _async=True)
+            while not fut.done():
+                time.sleep(0.01)
+        finally:
+            fault.clear()
+        mgr.save(2, {"w": jnp.arange(2.0)}, _async=True)  # compacts queue
+        with pytest.raises(fault.FaultInjected):
+            mgr.wait()
+        engine.clear_failures()
+        mgr.wait()                       # drained: contract reset
+
+
+def test_save_retries_injected_fault():
+    from mxnet_tpu import fault
+    from mxnet_tpu.observability import registry
+    with tempfile.TemporaryDirectory() as d:
+        r0 = registry().counter("fault_retries", site="checkpoint").value
+        fault.inject("checkpoint.save", times=1)
+        try:
+            checkpoint.save_sharded(d, 4, {"w": jnp.arange(4.0)})
+        finally:
+            fault.clear()
+        assert checkpoint.validate_checkpoint(os.path.join(d, "4")) == []
+        assert registry().counter("fault_retries",
+                                  site="checkpoint").value >= r0 + 1
+
+
+def test_emergency_save_on_sigterm():
+    import signal
+    from mxnet_tpu import fault
+    with tempfile.TemporaryDirectory() as d:
+        mgr = checkpoint.CheckpointManager(d, max_to_keep=3)
+        state = {"step": 11, "w": jnp.full((2,), 11.0)}
+        mgr.enable_emergency_save(
+            params_fn=lambda: {"w": state["w"]},
+            step_fn=lambda: state["step"],
+            extras_fn=lambda: {"meta.json": b'{"emergency": true}'})
+        try:
+            os.kill(os.getpid(), signal.SIGTERM)
+            assert fault.preempted()
+            with pytest.raises(fault.Preempted):
+                fault.check_preempted()
+        finally:
+            mgr.disable_emergency_save()
+            fault.reset_preemption(clear_callbacks=True)
+            fault.uninstall_preemption_handler()
+        assert mgr.valid_steps() == [11]
+        assert mgr.read_extra(11, "meta.json") == b'{"emergency": true}'
+        step, restored = mgr.restore_latest({"w": jnp.zeros(2)})
+        assert step == 11
+        np.testing.assert_allclose(np.asarray(restored["w"]), [11., 11.])
+
+
+def test_resharded_restore_onto_different_device_count():
+    """Restore-template sharding wins: params saved from an 8-device
+    mesh restore onto a 2-device mesh (and back to 1) numerically
+    equal — the portable-redistribution resume path."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from mxnet_tpu.parallel.mesh import make_mesh
+    mesh8 = make_mesh({"dp": 8})
+    w = jnp.arange(64.0).reshape(8, 8)
+    sharded = jax.device_put(w, NamedSharding(mesh8, P("dp", None)))
+    with tempfile.TemporaryDirectory() as d:
+        checkpoint.save_sharded(d, 0, {"w": sharded})
+        mesh2 = make_mesh({"dp": 2})
+        tmpl2 = {"w": jax.device_put(jnp.zeros((8, 8)),
+                                     NamedSharding(mesh2, P("dp", None)))}
+        out2 = checkpoint.load_sharded(d, 0, tmpl2)
+        assert len(out2["w"].sharding.device_set) == 2
+        np.testing.assert_allclose(np.asarray(out2["w"]), np.asarray(w))
+        out1 = checkpoint.load_sharded(d, 0, {"w": jnp.zeros((8, 8))})
+        np.testing.assert_allclose(np.asarray(out1["w"]), np.asarray(w))

@@ -237,6 +237,66 @@ def test_debug_facade_and_env(monkeypatch):
     assert eng.debug_check() == 0
 
 
+def test_debug_detector_clean_under_concurrent_load():
+    """Satellite (ISSUE 3): dependency-respecting traffic pushed from
+    MANY threads at once must not trip the race detector — false
+    positives under concurrency would make debug mode useless on real
+    pipelines."""
+    eng = _native()
+    eng.set_debug(True)
+    import threading
+    vs = [Var() for _ in range(8)]
+    stop = threading.Barrier(4)
+
+    def pusher(tid):
+        stop.wait()
+        for i in range(100):
+            eng.push(lambda: None,
+                     read_vars=[vs[(tid + i) % 8]],
+                     write_vars=[vs[(tid + i + 1) % 8]])
+
+    threads = [threading.Thread(target=pusher, args=(t,)) for t in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    eng.wait_for_all()
+    assert eng.debug_check() == 0, eng.last_error()
+    assert eng.last_error() == ""
+
+
+def test_debug_detector_finds_hazard_amid_concurrent_load():
+    """The detector must still catch a real hazard while legitimate
+    concurrent traffic is in flight (no lost signal under load)."""
+    eng = _native()
+    eng.set_debug(True)
+    import threading
+    vs = [Var() for _ in range(4)]
+    v_bug = Var()
+    gate = threading.Event()
+    done = threading.Event()
+
+    def legit():
+        for i in range(50):
+            eng.push(lambda: None, read_vars=[vs[i % 4]],
+                     write_vars=[vs[(i + 1) % 4]])
+        done.set()
+
+    t = threading.Thread(target=legit)
+    t.start()
+    eng.push(gate.wait, write_vars=[v_bug])          # legit writer, held
+    time.sleep(0.05)
+    eng._debug_bypass_push(gate.wait, write_vars=[v_bug])  # buggy writer
+    time.sleep(0.05)
+    assert eng.debug_check() == 1
+    assert "write-write hazard" in eng.last_error()
+    gate.set()
+    done.wait(5)
+    t.join()
+    eng.wait_for_all()
+    eng.clear_error()
+
+
 def test_file_vars_order_save_load_and_recordio(tmp_path):
     """NDArray save/load and recordio writes route through per-file engine
     vars: async write then read is race-free."""

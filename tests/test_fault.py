@@ -1,0 +1,413 @@
+"""mx.fault tests: deterministic injection, retry/backoff, hung-step
+watchdog, preemption handling, engine failure reporting, Trainer
+escalation (ISSUE 3 tentpole)."""
+import os
+import signal
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import fault, engine, nd, autograd, gluon
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.observability import registry
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    yield
+    fault.clear()
+    fault.reset_preemption(clear_callbacks=True)
+    fault.uninstall_preemption_handler()
+    fault.watchdog.set_default(None)
+    engine.clear_failures()
+
+
+# ------------------------------------------------------------ injection
+def test_injection_at_schedule_deterministic():
+    fault.inject("io.read", at=[2, 4])
+    fired = [fault.should_fire("io.read") for _ in range(5)]
+    assert fired == [False, True, False, True, False]
+    assert fault.hits("io.read") == 5
+    assert fault.fires("io.read") == 2
+
+
+def test_injection_times_bound_and_counter():
+    c0 = registry().counter("fault_injected", point="engine.task").value
+    fault.inject("engine.task", times=2)
+    assert [fault.should_fire("engine.task") for _ in range(4)] == \
+        [True, True, False, False]
+    assert registry().counter("fault_injected",
+                              point="engine.task").value == c0 + 2
+
+
+def test_injection_prob_seeded_reproducible():
+    fault.inject("io.decode", prob=0.5, seed=7)
+    a = [fault.should_fire("io.decode") for _ in range(32)]
+    fault.inject("io.decode", prob=0.5, seed=7)
+    b = [fault.should_fire("io.decode") for _ in range(32)]
+    assert a == b
+    assert 0 < sum(a) < 32          # probabilistic, not constant
+
+
+def test_injection_check_raises_and_stalls():
+    fault.inject("checkpoint.save", times=1)
+    with pytest.raises(fault.FaultInjected):
+        fault.check("checkpoint.save")
+    assert fault.check("checkpoint.save") is False   # exhausted
+    fault.inject("kv.collective", action="stall", delay=0.05, times=1)
+    t0 = time.monotonic()
+    assert fault.check("kv.collective") is True
+    assert time.monotonic() - t0 >= 0.05
+
+
+def test_env_configure_parsing():
+    specs = fault.configure("io.read:p=0.25:seed=3,grad.nan:at=2+5,"
+                            "kv.collective:n=1:action=stall:delay=0.01")
+    assert len(specs) == 3
+    assert fault.active("grad.nan")
+    assert not fault.should_fire("grad.nan")
+    assert fault.should_fire("grad.nan")
+    with pytest.raises(mx.MXNetError):
+        fault.configure("io.read:bogus=1")
+    fault.clear("io.read")
+    assert not fault.active("io.read")
+    assert fault.active("kv.collective")
+    fault.clear()
+    assert not fault.active()
+
+
+# ---------------------------------------------------------------- retry
+def test_retry_succeeds_after_transient_failures():
+    calls = []
+    pol = fault.RetryPolicy(max_retries=3, base_delay=0.001, seed=0,
+                            name="t1")
+    r0 = registry().counter("fault_retries", site="t1").value
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise OSError("transient")
+        return "ok"
+
+    assert pol.call(flaky) == "ok"
+    assert len(calls) == 3
+    assert registry().counter("fault_retries", site="t1").value == r0 + 2
+
+
+def test_retry_exhaustion_reraises_and_counts_giveup():
+    pol = fault.RetryPolicy(max_retries=2, base_delay=0.001, name="t2")
+    g0 = registry().counter("fault_retry_giveups", site="t2").value
+    with pytest.raises(OSError):
+        pol.call(lambda: (_ for _ in ()).throw(OSError("hard")))
+    assert registry().counter("fault_retry_giveups",
+                              site="t2").value == g0 + 1
+
+
+def test_retry_deadline_stops_early():
+    pol = fault.RetryPolicy(max_retries=100, base_delay=0.2, jitter=0.0,
+                            deadline=0.05, name="t3")
+    t0 = time.monotonic()
+    with pytest.raises(OSError):
+        pol.call(lambda: (_ for _ in ()).throw(OSError("x")))
+    assert time.monotonic() - t0 < 0.15   # gave up, did not sleep 0.2
+
+def test_retry_backoff_growth_and_jitter_bounds():
+    pol = fault.RetryPolicy(base_delay=0.1, multiplier=2.0, max_delay=0.5,
+                            jitter=0.5, seed=11)
+    ds = [pol.delay(a) for a in (1, 2, 3, 4, 5)]
+    for a, d in enumerate(ds, 1):
+        nominal = min(0.5, 0.1 * 2.0 ** (a - 1))
+        assert 0.5 * nominal <= d <= 1.5 * nominal
+
+
+def test_retry_never_swallows_preemption():
+    pol = fault.RetryPolicy(max_retries=5, base_delay=0.001)
+    calls = []
+
+    def preempted_fn():
+        calls.append(1)
+        raise fault.Preempted("now")
+
+    with pytest.raises(fault.Preempted):
+        pol.call(preempted_fn)
+    assert len(calls) == 1          # no retry on preemption
+
+
+def test_policy_from_env(monkeypatch):
+    monkeypatch.setenv("MXTPU_IO_RETRIES", "7")
+    monkeypatch.setenv("MXTPU_IO_RETRY_BASE", "0.25")
+    pol = fault.policy_from_env("MXTPU_IO")
+    assert pol.max_retries == 7
+    assert pol.base_delay == 0.25
+    assert pol.name == "io"
+
+
+# ------------------------------------------------------------- watchdog
+def test_watchdog_clean_and_stall(tmp_path):
+    wd = fault.StepWatchdog(timeout_ms=2000, snapshot_dir=str(tmp_path))
+    assert wd.check(step=1) == 0          # drained engine: clean
+    gate = threading.Event()
+    engine.push(gate.wait)
+    wd2 = fault.StepWatchdog(timeout_ms=100, snapshot_dir=str(tmp_path))
+    w0 = registry().counter("watchdog_timeouts").value
+    assert wd2.check(step=1) == 0   # first sight of a busy queue: baseline
+    with pytest.raises(fault.WatchdogTimeout) as ei:
+        wd2.check(step=2)           # full no-progress window: stall
+    gate.set()
+    engine.wait_for_all()
+    assert registry().counter("watchdog_timeouts").value == w0 + 1
+    snap = ei.value.snapshot_path
+    assert snap and os.path.exists(snap)
+    import json
+    blob = json.load(open(snap))
+    assert blob["step"] == 2
+    assert "metrics" in blob and "engine_queue_depth" in blob["metrics"]
+    engine.clear_error()
+
+
+def test_watchdog_tolerates_slow_but_moving_queue(tmp_path):
+    """A deep-but-progressing engine queue (long async save overlapping
+    steps) is NOT a stall: no block, no raise."""
+    wd = fault.StepWatchdog(timeout_ms=100, snapshot_dir=str(tmp_path))
+    assert wd.check() == 0              # drained: records the baseline
+    gate = threading.Event()
+    engine.push(gate.wait)              # long-running task...
+    engine.push(lambda: None).result()  # ...but other work completes
+    t0 = time.monotonic()
+    assert wd.check() == 0              # progress observed: no drain wait
+    assert time.monotonic() - t0 < 0.09
+    gate.set()
+    engine.wait_for_all()
+
+
+def test_watchdog_set_default_none_uninstalls(monkeypatch):
+    """set_default(None) must win over MXTPU_STEP_TIMEOUT_MS."""
+    monkeypatch.setenv("MXTPU_STEP_TIMEOUT_MS", "50")
+    fault.watchdog.set_default(None)
+    gate = threading.Event()
+    engine.push(gate.wait)
+    assert fault.watchdog.maybe_check() == 0    # uninstalled: no deadline
+    gate.set()
+    engine.wait_for_all()
+
+
+def test_preemption_callback_bound_method_roundtrip():
+    """on_preemption accepts bound methods (no attribute stamping) and
+    remove_on_preemption removes them by equality."""
+    class Saver:
+        def __init__(self):
+            self.saved = 0
+
+        def save(self):
+            self.saved += 1
+
+    s = Saver()
+    fault.install_preemption_handler()
+    fault.on_preemption(s.save)
+    fault.preemption.remove_on_preemption(s.save)
+    os.kill(os.getpid(), signal.SIGTERM)
+    assert s.saved == 0                 # removed before delivery
+
+
+def test_watchdog_disabled_is_noop():
+    wd = fault.StepWatchdog(timeout_ms=0)
+    assert not wd.enabled
+    assert wd.check() == 0
+    assert fault.watchdog.maybe_check() == 0
+
+
+def test_trainer_step_hits_default_watchdog(tmp_path):
+    """Trainer.step consults the default watchdog each step."""
+    wd = fault.watchdog.set_default(
+        fault.StepWatchdog(timeout_ms=150, snapshot_dir=str(tmp_path)))
+    net = nn.Dense(2, in_units=2)
+    net.initialize()
+    tr = gluon.Trainer(net.collect_params(), "sgd", {"learning_rate": 0.1})
+    x = nd.ones((1, 2))
+    with autograd.record():
+        loss = net(x).sum()
+    loss.backward()
+    tr.step(1)                      # clean step passes the deadline
+    gate = threading.Event()
+    engine.push(gate.wait)          # wedge the engine
+    with autograd.record():
+        loss = net(x).sum()
+    loss.backward()
+    with pytest.raises(fault.WatchdogTimeout):
+        tr.step(1)
+    gate.set()
+    engine.wait_for_all()
+    engine.clear_error()
+
+
+# ----------------------------------------------------------- preemption
+def test_sigterm_runs_emergency_callbacks_then_check_raises():
+    ran = []
+    fault.install_preemption_handler()
+    fault.on_preemption(lambda: ran.append("saved"))
+    assert not fault.preempted()
+    fault.check_preempted()         # no-op before the signal
+    os.kill(os.getpid(), signal.SIGTERM)
+    assert fault.preempted()
+    assert ran == ["saved"]
+    with pytest.raises(fault.Preempted):
+        fault.check_preempted()
+    # second delivery does not double-run callbacks
+    os.kill(os.getpid(), signal.SIGTERM)
+    assert ran == ["saved"]
+    fault.reset_preemption()
+    assert not fault.preempted()
+
+
+def test_sigterm_fault_point_action():
+    fault.install_preemption_handler()
+    fault.inject("preempt.sigterm", at=[2], action="sigterm")
+    assert fault.check("preempt.sigterm") is False
+    assert fault.check("preempt.sigterm") is True
+    with pytest.raises(fault.Preempted):
+        fault.check_preempted()
+
+
+# ------------------------------------------------- engine failure report
+def test_engine_failures_sticky_and_counted():
+    engine.clear_failures()
+    c0 = registry().counter("engine_task_failures").value
+
+    def boom():
+        raise RuntimeError("task-boom")
+
+    fut = engine.push(boom)
+    with pytest.raises(RuntimeError):
+        fut.result()
+    fs = engine.failures()
+    assert fs and "task-boom" in fs[-1]["error"]
+    assert registry().counter("engine_task_failures").value == c0 + 1
+    # a dependency re-raise is NOT double-counted as a root cause
+    v = engine.Var()
+    f1 = engine.push(boom, write_vars=[v])
+    f2 = engine.push(lambda: 1, read_vars=[v])
+    try:
+        f2.result()
+    except RuntimeError:
+        pass
+    assert registry().counter("engine_task_failures").value == c0 + 2
+    engine.clear_failures()
+    assert engine.failures() == []
+
+
+def test_engine_injected_fault_recorded():
+    fault.inject("engine.task", times=1)
+    fut = engine.push(lambda: 42)
+    with pytest.raises(fault.FaultInjected):
+        fut.result()
+    assert any("FaultInjected" in f["error"] for f in engine.failures())
+    fault.clear()
+    assert engine.push(lambda: 42).result() == 42
+
+
+# ------------------------------------------------- trainer integration
+def _one_step(net, tr, x, poison=False):
+    with autograd.record():
+        loss = net(x).sum() * (float("nan") if poison else 1.0)
+    loss.backward()
+    tr.step(1)
+
+
+@pytest.mark.parametrize("fused", [True, False])
+def test_trainer_max_skipped_steps_escalates(fused):
+    net = nn.Dense(2, in_units=2)
+    net.initialize()
+    tr = gluon.Trainer(net.collect_params(), "sgd", {"learning_rate": 0.1},
+                       skip_nonfinite=True, max_skipped_steps=1,
+                       fused=fused)
+    x = nd.ones((1, 2))
+    s0 = registry().counter("trainer_steps_skipped").value
+    _one_step(net, tr, x, poison=True)
+    assert tr.consecutive_skipped_steps == 1
+    with pytest.raises(mx.MXNetError, match="consecutive skipped"):
+        _one_step(net, tr, x, poison=True)
+    assert registry().counter("trainer_steps_skipped").value == s0 + 2
+    tr._consecutive_skips = 0
+    _one_step(net, tr, x)           # clean step resets the streak
+    assert tr.consecutive_skipped_steps == 0
+
+
+def test_grad_nan_injection_skips_exactly_scheduled_step():
+    net = nn.Dense(2, in_units=2)
+    net.initialize()
+    tr = gluon.Trainer(net.collect_params(), "sgd", {"learning_rate": 0.1},
+                       skip_nonfinite=True)
+    x = nd.ones((1, 2))
+    fault.inject("grad.nan", at=[2])
+    _one_step(net, tr, x)
+    assert tr.consecutive_skipped_steps == 0
+    w_before = net.weight.data().asnumpy().copy()
+    _one_step(net, tr, x)           # injected NaN: update skipped
+    assert tr.consecutive_skipped_steps == 1
+    np.testing.assert_array_equal(net.weight.data().asnumpy(), w_before)
+    _one_step(net, tr, x)           # schedule exhausted: trains again
+    assert tr.consecutive_skipped_steps == 0
+    assert not np.array_equal(net.weight.data().asnumpy(), w_before)
+
+
+def test_amp_unscale_is_one_fused_dispatch():
+    from mxnet_tpu import amp, profiler
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, in_units=4), nn.Dense(4, in_units=8),
+            nn.Dense(2, in_units=4))
+    net.initialize()
+    amp.init("float16")
+    try:
+        scaler = amp._state["scaler"]
+        scaler.loss_scale = 64.0
+        x = nd.ones((2, 4))
+        with autograd.record():
+            loss = amp.scale_loss(net(x).sum())
+        loss.backward()
+        grads = {n: p.grad().asnumpy().copy()
+                 for n, p in net.collect_params().items()}
+        profiler.reset_dispatches()
+        amp.unscale([p for p in net.collect_params().values()])
+        assert profiler.dispatch_count("amp_unscale") == 1   # ONE kernel
+        for n, p in net.collect_params().items():
+            np.testing.assert_allclose(p.grad().asnumpy() * 64.0,
+                                       grads[n], rtol=1e-3)
+    finally:
+        amp.reset()
+
+
+def test_kv_init_distributed_retries(monkeypatch):
+    """kv.init fault point: transient bootstrap failures retry with
+    backoff instead of failing the job."""
+    from mxnet_tpu import kvstore
+    monkeypatch.setattr(kvstore, "_DIST_INITIALIZED", False)
+    monkeypatch.setenv("MXTPU_DIST_RETRY_BASE", "0.001")
+    calls = []
+
+    def fake_init(*a, **kw):
+        calls.append(1)
+
+    monkeypatch.setattr(kvstore.jax.distributed, "initialize", fake_init)
+    monkeypatch.setattr(kvstore.jax.distributed, "is_initialized",
+                        lambda: False, raising=False)
+    fault.inject("kv.init", times=2)
+    kvstore.init_distributed("127.0.0.1:9", 1, 0)
+    assert len(calls) == 1          # 2 injected failures, 3rd attempt ran
+    assert kvstore._DIST_INITIALIZED
+    monkeypatch.setattr(kvstore, "_DIST_INITIALIZED", False)
+
+
+def test_kv_collective_stall_injection():
+    """A 'stall' spec on kv.collective delays the allreduce — the hung-
+    collective simulation the watchdog guards against."""
+    from mxnet_tpu import kvstore
+    kv = kvstore.create("device")
+    import jax.numpy as jnp
+    fault.inject("kv.collective", action="stall", delay=0.05, times=1)
+    t0 = time.monotonic()
+    out = kv.allreduce_([jnp.ones(4)])
+    assert time.monotonic() - t0 >= 0.05
+    np.testing.assert_allclose(np.asarray(out), np.ones(4))

@@ -100,6 +100,148 @@ def test_libsvm_iter_densifies(tmp_path):
     np.testing.assert_allclose(batches[0].label[0].asnumpy(), [1, 0])
 
 
+def _make_rec(path, n=12, corrupt=(), img_size=8):
+    """A .rec+.idx pack with optionally corrupt payloads (garbage bytes
+    framed as valid records — the framing survives, decode fails)."""
+    from mxnet_tpu import recordio
+    rng = np.random.RandomState(0)
+    idx = os.path.splitext(path)[0] + ".idx"
+    w = recordio.MXIndexedRecordIO(idx, path, "w")
+    for i in range(n):
+        header = recordio.IRHeader(0, float(i % 3), i, 0)
+        if i in corrupt:
+            blob = recordio.pack(header, b"\xba\xad" * 8)
+        else:
+            img = (rng.rand(img_size, img_size) * 255).astype(np.uint8)
+            blob = recordio.pack_img(header, img, img_fmt=".png")
+        w.write_idx(i, blob)
+    w.close()
+
+
+def test_imagerecorditer_skips_corrupt_records_bounded(tmp_path):
+    """ISSUE 3: bounded bad-record tolerance with the
+    data_records_skipped metric (reference C++ iter behaviour)."""
+    from mxnet_tpu.observability import registry
+    rec = os.path.join(tmp_path, "c.rec")
+    _make_rec(rec, n=12, corrupt={1, 5})
+    c0 = registry().counter("data_records_skipped").value
+    it = mio.ImageRecordIter(path_imgrec=rec, data_shape=(1, 8, 8),
+                             batch_size=5)
+    batches = list(it)
+    assert len(batches) == 2          # 10 good records -> 2 batches of 5
+    assert it.records_skipped == 2
+    assert registry().counter("data_records_skipped").value == c0 + 2
+    labels = np.concatenate([b.label[0].asnumpy() for b in batches])
+    expect = [float(i % 3) for i in range(12) if i not in (1, 5)]
+    np.testing.assert_allclose(labels, expect)
+    it.reset()                        # budget is per epoch
+    assert len(list(it)) == 2 and it.records_skipped == 4
+
+
+def test_imagerecorditer_bad_record_budget_enforced(tmp_path):
+    rec = os.path.join(tmp_path, "c2.rec")
+    _make_rec(rec, n=8, corrupt={0, 2, 4})
+    it = mio.ImageRecordIter(path_imgrec=rec, data_shape=(1, 8, 8),
+                             batch_size=4, max_bad_records=2)
+    with pytest.raises(mx.MXNetError, match="max_bad_records"):
+        list(it)
+
+
+def test_imagerecorditer_read_fault_retries(tmp_path):
+    """ISSUE 3: transient read errors retry (io.read fault point + the
+    MXTPU_IO policy) without skipping data."""
+    from mxnet_tpu import fault
+    from mxnet_tpu.observability import registry
+    rec = os.path.join(tmp_path, "r.rec")
+    _make_rec(rec, n=8)
+    fault.inject("io.read", times=2)
+    r0 = registry().counter("fault_retries", site="io_read").value
+    try:
+        it = mio.ImageRecordIter(path_imgrec=rec, data_shape=(1, 8, 8),
+                                 batch_size=4)
+        batches = list(it)
+    finally:
+        fault.clear()
+    assert len(batches) == 2
+    assert it.records_skipped == 0    # retried, never skipped
+    assert registry().counter("fault_retries",
+                              site="io_read").value >= r0 + 2
+
+
+def test_prefetchingiter_surfaces_worker_error_and_stays_usable():
+    """Satellite: a worker exception surfaces promptly from next() and
+    the iterator keeps working (and is fully reusable after reset)."""
+    class Flaky(mio.DataIter):
+        def __init__(self):
+            super().__init__(2)
+            self.n = 0
+            self.fail_once = True
+
+        @property
+        def provide_data(self):
+            return []
+
+        @property
+        def provide_label(self):
+            return []
+
+        def reset(self):
+            self.n = 0
+
+        def next(self):
+            self.n += 1
+            if self.n == 2 and self.fail_once:
+                self.fail_once = False
+                raise RuntimeError("worker-boom")
+            if self.n > 4:
+                raise StopIteration
+            return mio.DataBatch([self.n], [])
+
+    pf = mio.PrefetchingIter(Flaky())
+    assert pf.next().data[0] == 1
+    with pytest.raises(RuntimeError, match="worker-boom"):
+        pf.next()
+    assert pf.next().data[0] == 3     # usable right after the error
+    pf.reset()
+    assert [b.data[0] for b in pf] == [1, 2, 3, 4]
+    pf.reset()                        # reusable repeatedly
+    assert [b.data[0] for b in pf] == [1, 2, 3, 4]
+
+
+def test_prefetchingiter_reset_recovers_from_pending_error():
+    """reset() must drain a failed in-flight fetch and resubmit — it
+    used to re-raise and permanently wedge the iterator."""
+    class FailFirst(mio.DataIter):
+        def __init__(self):
+            super().__init__(1)
+            self.n = 0
+            self.armed = True
+
+        @property
+        def provide_data(self):
+            return []
+
+        @property
+        def provide_label(self):
+            return []
+
+        def reset(self):
+            self.n = 0
+
+        def next(self):
+            self.n += 1
+            if self.n == 1 and self.armed:
+                self.armed = False
+                raise ValueError("first-fetch-boom")
+            if self.n > 2:
+                raise StopIteration
+            return mio.DataBatch([self.n], [])
+
+    pf = mio.PrefetchingIter(FailFirst())   # in-flight fetch fails
+    pf.reset()                              # swallow + resubmit
+    assert [b.data[0] for b in pf] == [1, 2]
+
+
 def test_libsvm_iter_label_file_and_multilabel(tmp_path):
     import os
     data_f = os.path.join(tmp_path, "d.libsvm")
