@@ -318,6 +318,18 @@ def main():
         "vs_baseline": round(best_img_s / BASELINE_IMG_S, 4),
     }
 
+    # Captured one-executable step (ISSUE 4): steps/s + dispatches/step of
+    # `Trainer.capture` on the reference MLP, recorded alongside the
+    # headline metric on every non-smoke run (cheap: a few MLP steps).
+    if not smoke:
+        try:
+            import bench_mlp
+            result["captured_step_throughput"] = \
+                bench_mlp.measure_captured()
+        except Exception as e:  # pragma: no cover
+            print(f"[bench] captured-step bench failed: {e!r}",
+                  file=sys.stderr)
+
     # Second headline metric (BASELINE.json): BERT-base MLM tokens/sec/chip.
     # Merged into the same single JSON line so the driver's one-line parse
     # still works; a BERT failure must not take down the ResNet metric.

@@ -35,17 +35,20 @@ import time
 BASELINE_SAMPLES_S = 500_000.0
 
 
-def measure(on_result=None, trace=None):
+def _setup():
+    """Shared bench fixture: (batch, steps, X, y, lossf, build) for the
+    784-512-256-10 MLP — ONE definition for measure(), measure_captured()
+    and the trace mode, so the compared numbers always run the same
+    model and data."""
     import jax
     import numpy as np
 
     import mxnet_tpu as mx
-    from mxnet_tpu import nd, autograd, gluon
+    from mxnet_tpu import nd, gluon
 
     on_tpu = jax.default_backend() == "tpu"
     batch = 512 if on_tpu else 64
     steps = 30 if on_tpu else 3
-    imp_steps = max(3, steps // 5)   # imperative is slow; fewer steps
 
     rng = np.random.RandomState(0)
     X = nd.array(rng.randn(batch, 784).astype(np.float32))
@@ -60,38 +63,81 @@ def measure(on_result=None, trace=None):
         net(X)  # materialise
         return net
 
-    lossf = gluon.loss.SoftmaxCrossEntropyLoss()
+    return batch, steps, X, y, gluon.loss.SoftmaxCrossEntropyLoss(), build
 
-    def run(net, n, fused=True):
-        from mxnet_tpu import profiler
-        tr = gluon.Trainer(net.collect_params(), "sgd",
-                           {"learning_rate": 0.05, "momentum": 0.9},
-                           fused=fused)
-        # warmup (compile on the hybridized path, fused-kernel cache on
-        # the imperative one)
-        for _ in range(2):
-            with autograd.record():
-                L = lossf(net(X), y).mean()
-            L.backward()
-            tr.step(batch)
-        float(L.asnumpy())
-        # host dispatch count for ONE steady-state step() (trainer-issued
-        # launches: allreduce + guard + optimizer updates)
+
+def _run_imperative(net, n, batch, X, y, lossf, fused=True):
+    """n timed record/backward/step() iterations after a 2-step warmup
+    (compile on the hybridized path, fused-kernel cache on the imperative
+    one); also reports ONE steady-state step()'s trainer-issued
+    dispatches (allreduce + guard + optimizer updates)."""
+    from mxnet_tpu import autograd, gluon, profiler
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.05, "momentum": 0.9},
+                       fused=fused)
+    # warm past every lazy compile: hybridized forward, fused-kernel
+    # cache, AND the cached jitted backward (which only compiles once a
+    # tape structure has repeated _VJP_COMPILE_AFTER times — fewer warmup
+    # steps would land that compile inside the timed loop)
+    for _ in range(max(2, autograd._VJP_COMPILE_AFTER + 1)):
         with autograd.record():
             L = lossf(net(X), y).mean()
         L.backward()
-        profiler.reset_dispatches()
         tr.step(batch)
-        step_dispatches = profiler.dispatch_count()
-        t0 = time.monotonic()
-        for _ in range(n):
-            with autograd.record():
-                L = lossf(net(X), y).mean()
-            L.backward()
-            tr.step(batch)
-        final = float(L.asnumpy())
-        dt = time.monotonic() - t0
-        return batch * n / dt, n / dt, step_dispatches, final
+    float(L.asnumpy())
+    with autograd.record():
+        L = lossf(net(X), y).mean()
+    L.backward()
+    profiler.reset_dispatches()
+    tr.step(batch)
+    step_dispatches = profiler.dispatch_count()
+    t0 = time.monotonic()
+    for _ in range(n):
+        with autograd.record():
+            L = lossf(net(X), y).mean()
+        L.backward()
+        tr.step(batch)
+    final = float(L.asnumpy())
+    dt = time.monotonic() - t0
+    return batch * n / dt, n / dt, step_dispatches, final
+
+
+def _run_captured(net, n, batch, X, y, lossf):
+    """The whole step as ONE executable (Trainer.capture): steps/s and
+    trainer-issued dispatches/step against the PR-1 fused baseline."""
+    from mxnet_tpu import gluon, profiler
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.05, "momentum": 0.9})
+    step = tr.capture(lambda a, b: lossf(net(a), b).mean())
+    for _ in range(2):                       # compile + warm
+        step(X, y)
+    profiler.reset_dispatches()
+    step(X, y)
+    step_dispatches = profiler.dispatch_count()
+    fallback = step.last_fallback_reason
+    t0 = time.monotonic()
+    for _ in range(n):
+        L = step(X, y)
+    final = float(L.asnumpy())
+    dt = time.monotonic() - t0
+    fallback = fallback or step.last_fallback_reason
+    if fallback is not None:
+        print(f"[bench_mlp] WARNING: captured step fell back "
+              f"({fallback})", file=sys.stderr)
+    return batch * n / dt, n / dt, step_dispatches, final, fallback
+
+
+def measure(on_result=None, trace=None):
+    from mxnet_tpu import autograd, gluon
+
+    batch, steps, X, y, lossf, build = _setup()
+    imp_steps = max(3, steps // 5)   # imperative is slow; fewer steps
+
+    def run(net, n, fused=True):
+        return _run_imperative(net, n, batch, X, y, lossf, fused=fused)
+
+    def run_captured(net, n):
+        return _run_captured(net, n, batch, X, y, lossf)[:4]
 
     imp_s, imp_steps_s, imp_disp, imp_loss = run(build(), imp_steps)
     print(f"[bench_mlp] imperative fused: {imp_s:.0f} samples/s "
@@ -104,6 +150,12 @@ def measure(on_result=None, trace=None):
           f"({unf_steps_s:.2f} steps/s, {unf_disp} step dispatches, "
           f"loss {unf_loss:.4f}, fused is {imp_s / unf_s:.2f}x)",
           file=sys.stderr)
+
+    cap_s, cap_steps_s, cap_disp, cap_loss = run_captured(build(), steps)
+    print(f"[bench_mlp] captured: {cap_s:.0f} samples/s "
+          f"({cap_steps_s:.2f} steps/s, {cap_disp} dispatches/step, "
+          f"loss {cap_loss:.4f}, {cap_s / imp_s:.2f}x the fused "
+          "imperative baseline)", file=sys.stderr)
 
     hyb_net = build()
     hyb_net.hybridize()
@@ -123,6 +175,10 @@ def measure(on_result=None, trace=None):
         "imperative_samples_s_unfused": round(unf_s, 1),
         "step_dispatches_fused": int(imp_disp),
         "step_dispatches_unfused": int(unf_disp),
+        "captured_samples_s": round(cap_s, 1),
+        "captured_steps_s": round(cap_steps_s, 3),
+        "captured_dispatches_per_step": int(cap_disp),
+        "captured_vs_fused": round(cap_s / imp_s, 3),
     }
     if trace:
         from mxnet_tpu import profiler
@@ -173,6 +229,42 @@ def measure(on_result=None, trace=None):
     return res
 
 
+def measure_captured(on_result=None):
+    """Captured-step-only bench (the `--captured` mode): steps/s and
+    dispatches/step for the one-executable `Trainer.capture` step against
+    the PR-1 fused imperative baseline on the same MLP (shared `_setup`
+    fixture and loop helpers — identical model/protocol to measure()).
+    Cheap enough for bench.py to record `captured_step_throughput`
+    alongside the headline metric on every run."""
+    batch, steps, X, y, lossf, build = _setup()
+    steps = max(5, steps)
+    # same budget split as measure(): the imperative twin is the slow
+    # side, so it gets the reduced step count
+    imp_steps = max(3, steps // 5)
+
+    _, cap_steps_s, disp, _, fallback = _run_captured(
+        build(), steps, batch, X, y, lossf)
+    _, fused_steps_s, _, _ = _run_imperative(
+        build(), imp_steps, batch, X, y, lossf)
+
+    res = {
+        "metric": "captured_step_throughput",
+        "value": round(cap_steps_s * batch, 1),
+        "unit": "samples/sec/chip",
+        "captured_steps_s": round(cap_steps_s, 3),
+        "fused_imperative_steps_s": round(fused_steps_s, 3),
+        "captured_vs_fused": round(cap_steps_s / fused_steps_s, 3),
+        "captured_dispatches_per_step": int(disp),
+        "fallback": fallback,
+    }
+    print(f"[bench_mlp] captured-only: {cap_steps_s:.2f} steps/s "
+          f"({disp} dispatch/step, {res['captured_vs_fused']}x the fused "
+          "imperative loop)", file=sys.stderr)
+    if on_result is not None:
+        on_result(res)
+    return res
+
+
 def main():
     # honor JAX_PLATFORMS=cpu despite the axon sitecustomize (same dance
     # as bench.py — jax.config wins if set before backend init)
@@ -181,6 +273,9 @@ def main():
         jax.config.update("jax_platforms", "cpu")
     trace = None
     args = sys.argv[1:]
+    if "--captured" in args:
+        print(json.dumps(measure_captured()))
+        return
     if "--trace" in args:
         i = args.index("--trace")
         trace = (args[i + 1] if len(args) > i + 1

@@ -22,7 +22,7 @@ import jax.numpy as jnp
 
 __all__ = ["init", "reset", "convert_block", "scale_loss", "unscale",
            "unscale_arrays", "DynamicLossScaler", "bfloat16",
-           "autocast_dtype", "is_active", "grads_nonfinite"]
+           "autocast_dtype", "is_active", "grads_nonfinite", "scaler"]
 
 bfloat16 = jnp.bfloat16
 
@@ -52,6 +52,14 @@ def reset():
 
 def is_active():
     return _state["initialized"]
+
+
+def scaler():
+    """The installed DynamicLossScaler, or None. Non-None only for fp16
+    AMP (bf16 needs no loss scaling) — the one accessor the Trainer and
+    the captured step (cachedop.py) consult, so the overflow-skip
+    protocol has a single source of truth."""
+    return _state.get("scaler") if _state["initialized"] else None
 
 
 def autocast_dtype():

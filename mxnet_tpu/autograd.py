@@ -17,15 +17,17 @@ the ThreadedEngine).
 from __future__ import annotations
 
 import threading
+from collections import OrderedDict
 
 import jax
 import numpy as np
 
 from .base import MXNetError
+from .observability import registry as _obs_registry
 
 __all__ = ["record", "pause", "train_mode", "predict_mode", "is_recording",
            "is_training", "mark_variables", "backward", "grad", "get_symbol",
-           "Function"]
+           "Function", "vjp_cache_stats", "clear_vjp_cache"]
 
 _state = threading.local()
 
@@ -80,6 +82,302 @@ class _Tape:
         if kind == "leaf":
             return leaf_values[entry[1]]
         return entry[1]  # const
+
+    # -- pure-replay export -----------------------------------------------
+    def export(self, want_entries):
+        """Export this tape as a *value-free* replay program.
+
+        Returns `(spec, extras)` — `spec` is a `_ReplaySpec` whose
+        `replay(leaf_vals, extra_vals)` recomputes `want_entries` as a pure
+        function with every array VALUE (leaf, const, array-valued kwarg)
+        lifted out as an argument, and whose `key` identifies the program
+        structurally (node fns, static kwargs, wiring, avals) but not by
+        value. `extras` is the list of lifted arrays from THIS tape; a
+        structurally identical later tape yields an equal key and its own
+        extras, so one jitted backward compiles once and replays every
+        step. Returns `(None, None)` when a node is not structurally
+        keyable (unhashable kwargs / closure over arrays)."""
+        extras, nodes, key_nodes = [], [], []
+
+        def lift(v):
+            extras.append(v)
+            return len(extras) - 1
+
+        def rewrite(entry):
+            if entry[0] != "const":
+                return entry, entry
+            v = entry[1]
+            if isinstance(v, (jax.Array, np.ndarray)):
+                pos = lift(v)
+                return ("extra", pos), ("extra", _aval_sig(v))
+            try:
+                hash(v)
+            except TypeError:
+                return None, None
+            return entry, ("const", v)
+
+        for node in self.nodes:
+            fk = _fn_key(node.fn)
+            if fk is None:
+                return None, None
+            ins, ins_key = [], []
+            for e in node.inputs:
+                re_, rk = rewrite(e)
+                if re_ is None:
+                    return None, None
+                ins.append(re_)
+                ins_key.append(rk)
+            skw, skw_key, akw, akw_key = {}, [], [], []
+            for k, v in sorted(node.kwargs.items()):
+                if isinstance(v, (jax.Array, np.ndarray)):
+                    akw.append((k, lift(v)))
+                    akw_key.append((k, _aval_sig(v)))
+                    continue
+                vk = _static_key(v)
+                if vk is None:
+                    return None, None
+                skw[k] = v
+                skw_key.append((k, vk))
+            nodes.append((node.fn, skw, tuple(akw), tuple(ins), node.n_out))
+            key_nodes.append((fk, tuple(skw_key), tuple(akw_key),
+                              tuple(ins_key), node.n_out))
+        want, want_key = [], []
+        for e in want_entries:
+            re_, rk = rewrite(e)
+            if re_ is None:
+                return None, None
+            want.append(re_)
+            want_key.append(rk)
+        spec = _ReplaySpec(tuple(nodes), tuple(want),
+                           (tuple(key_nodes), tuple(want_key)))
+        return spec, extras
+
+
+def _aval_sig(a):
+    return (tuple(a.shape), str(getattr(a, "dtype", type(a).__name__)))
+
+
+def _static_key(v, depth=0):
+    """Canonical hashable key for a static (non-array) kwarg value:
+    scalars key by value, lists/tuples/dicts recursively (shape lists
+    etc.), anything else by value when hashable. None = unkeyable."""
+    if depth > 4:
+        return None
+    if isinstance(v, (list, tuple)):
+        parts = tuple(_static_key(x, depth + 1) for x in v)
+        return None if any(p is None for p in parts) else ("seq", parts)
+    if isinstance(v, dict):
+        parts = tuple((k, _static_key(x, depth + 1))
+                      for k, x in sorted(v.items()))
+        return None if any(p is None for _, p in parts) else ("map", parts)
+    try:
+        hash(v)
+    except TypeError:
+        return None
+    return ("v", v)
+
+
+_HASHABLE_SCALARS = (int, float, bool, str, bytes, type(None), np.generic)
+
+
+def _fn_key(fn, depth=0):
+    """Structural identity for a tape node's fn: python functions key on
+    (code object, closure/default scalar values — the `_binary` scalar
+    lambdas are re-created per op with the scalar as a default); anything
+    without a __code__ (jitted callables, custom_vjp wrappers, builtins)
+    keys on object identity. Returns None when a closure/default holds
+    something non-scalar (arrays), i.e. the node is not cache-keyable.
+    The cache holds the fn objects strongly, so identity keys cannot be
+    recycled while an entry is alive."""
+    code = getattr(fn, "__code__", None)
+    if code is None:
+        return ("id", id(fn))
+    if depth > 3:
+        return None
+    parts = []
+    cells = getattr(fn, "__closure__", None) or ()
+    for c in cells:
+        try:
+            v = c.cell_contents
+        except ValueError:       # empty cell
+            return None
+        k = _closure_val_key(v, depth)
+        if k is None:
+            return None
+        parts.append(k)
+    for v in (getattr(fn, "__defaults__", None) or ()):
+        k = _closure_val_key(v, depth)
+        if k is None:
+            return None
+        parts.append(k)
+    return ("code", id(code), tuple(parts))
+
+
+def _closure_val_key(v, depth):
+    if isinstance(v, _HASHABLE_SCALARS):
+        return ("v", v)
+    if callable(v):
+        return ("f", _fn_key(v, depth + 1))
+    return None
+
+
+class _ReplaySpec:
+    """Value-free tape program (see `_Tape.export`). Holds node fns
+    strongly — never array values — so a cached entry pins the ids its
+    key references without leaking step data."""
+    __slots__ = ("nodes", "want", "key")
+
+    def __init__(self, nodes, want, key):
+        self.nodes = nodes
+        self.want = want
+        self.key = key
+
+    def replay(self, leaf_vals, extra_vals):
+        outs = []
+
+        def resolve(e):
+            kind = e[0]
+            if kind == "node":
+                return outs[e[1]][e[2]]
+            if kind == "leaf":
+                return leaf_vals[e[1]]
+            if kind == "extra":
+                return extra_vals[e[1]]
+            return e[1]  # const (hashable scalar)
+
+        for fn, skw, akw, ins, n_out in self.nodes:
+            kw = dict(skw)
+            for k, pos in akw:
+                kw[k] = extra_vals[pos]
+            val = fn(*[resolve(e) for e in ins], **kw)
+            outs.append(val if isinstance(val, tuple) else (val,))
+        return tuple(resolve(e) for e in self.want)
+
+
+# ---------------------------------------------------------------------------
+# cached jitted backward (vjp-callable cache)
+# ---------------------------------------------------------------------------
+# The uncached backward() re-traces jax.vjp over the tape replay every call
+# and executes both passes op-by-op — per-op dispatch on every step of an
+# uncaptured training loop. This cache compiles the whole backward (replay +
+# vjp) ONCE per tape structure; repeated identical-shape backward calls
+# become one jitted launch with the step's values (leaves, consts, rng
+# kwargs, cotangents) passed as arguments.
+_VJP_CACHE_MAX = 16
+_VJP_SEEN_MAX = 512
+_VJP_COMPILE_AFTER = 5           # sightings before paying the jit compile
+_vjp_cache = OrderedDict()       # key -> jitted (leaf_vals, extras, cots) fn
+# key -> (sighting count, spec). The spec rides along purely to PIN the
+# node fns whose ids the key quotes: without the strong ref, CPython
+# freelists recycle a dead per-step wrapper's address and two DIFFERENT
+# ephemeral programs would be conflated as a repeated sighting.
+_vjp_seen = {}
+_vjp_blacklist = {}              # shape-key -> consecutive miss streak
+_vjp_lock = threading.Lock()
+_reg = _obs_registry()
+_vjp_hits = _reg.counter("autograd_vjp_cache", result="hit")
+_vjp_misses = _reg.counter("autograd_vjp_cache", result="miss")
+
+
+def vjp_cache_stats():
+    """(hits, misses) of the cached-backward lookaside (telemetry series
+    `autograd_vjp_cache{result=}` in the observability registry)."""
+    return int(_vjp_hits.value), int(_vjp_misses.value)
+
+
+def clear_vjp_cache():
+    """Drop every cached backward program (test/bench helper)."""
+    with _vjp_lock:
+        _vjp_cache.clear()
+        _vjp_seen.clear()
+        _vjp_blacklist.clear()
+
+
+def _make_backward_fn(spec):
+    def bwd(leaf_vals, extra_vals, cots):
+        def pure(vals):
+            return spec.replay(vals, extra_vals)
+
+        _, vjp_fn = jax.vjp(pure, list(leaf_vals))
+        return vjp_fn(tuple(cots))[0]
+
+    return jax.jit(bwd)
+
+
+def _cached_backward(spec, extras, leaf_values, cots):
+    """Run the backward through the jitted cache; None = take the uncached
+    path this call. Compilation is DEFERRED until a key has been seen
+    `_VJP_COMPILE_AFTER` times: short-lived tapes (tests, eval snippets,
+    few-step loops) never pay a jit compile, a real training loop
+    compiles once early on and hits from then on. Blacklisted tape
+    shapes (e.g. a fresh custom_vjp object per step keys a different
+    program every call) stop being tried after 3 consecutive misses."""
+    key = (spec.key,
+           tuple(_aval_sig(v) for v in leaf_values),
+           tuple(_aval_sig(c) for c in cots))
+    # identity-free shape of the same program: when this recurs with ever-
+    # new fn identities, every lookup misses — stop trying after 3 in a row
+    shape_key = (len(spec.nodes), key[1], key[2], len(extras))
+    hit = False
+    with _vjp_lock:
+        jfn = _vjp_cache.get(key)
+        if jfn is not None:
+            _vjp_cache.move_to_end(key)
+            _vjp_blacklist.pop(shape_key, None)
+            hit = True
+        else:
+            seen = _vjp_seen.get(key, (0, None))[0] + 1
+            if seen > 1:
+                # the key REPEATED: keys are stable for this tape shape —
+                # a genuine repeat lifts an earlier blacklist
+                _vjp_blacklist.pop(shape_key, None)
+            elif _vjp_blacklist.get(shape_key, 0) >= 3:
+                # blacklisted shape with yet another never-seen key: stay
+                # on the cheap path, but RECORD the sighting so a stable
+                # program arriving later can still prove itself above —
+                # and COUNT the miss, or the telemetry would freeze while
+                # a 100%-miss workload keeps running uncached
+                if len(_vjp_seen) > _VJP_SEEN_MAX:
+                    _vjp_seen.clear()
+                _vjp_seen[key] = (seen, spec)
+                _vjp_misses.inc()
+                return None
+            else:
+                # never-seen key for this shape — ever-new fn identities
+                # (fresh custom_vjp per step) look exactly like this
+                if len(_vjp_blacklist) > 64:
+                    _vjp_blacklist.clear()
+                _vjp_blacklist[shape_key] = \
+                    _vjp_blacklist.get(shape_key, 0) + 1
+            if seen < _VJP_COMPILE_AFTER:
+                if len(_vjp_seen) > _VJP_SEEN_MAX:
+                    _vjp_seen.clear()
+                _vjp_seen[key] = (seen, spec)
+                jfn = None           # early sightings: defer the compile
+            else:
+                _vjp_seen.pop(key, None)
+                while len(_vjp_cache) >= _VJP_CACHE_MAX:
+                    _vjp_cache.popitem(last=False)
+                jfn = _vjp_cache[key] = _make_backward_fn(spec)
+    if hit:
+        _vjp_hits.inc()
+    else:
+        _vjp_misses.inc()
+    if jfn is None:
+        return None
+    try:
+        return jfn(leaf_values, extras, cots)
+    except Exception:
+        # jax.jit traces lazily at this call: a tape fn that only works
+        # under eager vjp (concrete-value branching, host conversions)
+        # raises HERE, possibly after steps of healthy uncached
+        # backwards. Drop the poisoned entry and blacklist the shape so
+        # every later call takes the uncached path instead of failing
+        # forever; the caller falls back to plain jax.vjp this step too.
+        with _vjp_lock:
+            _vjp_cache.pop(key, None)
+            _vjp_blacklist[shape_key] = 3
+        return None
 
 
 # ---------------------------------------------------------------------------
@@ -242,10 +540,6 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
     leaf_entry_idx = {id(v): i for i, v in enumerate(tape.leaves)}
     leaf_values = [v._data for v in tape.leaves]
 
-    def pure(vals):
-        return tape.replay(vals, head_entries)
-
-    _, vjp_fn = jax.vjp(pure, leaf_values)
     if head_grads is None:
         cots = tuple(jax.numpy.ones_like(h._data) for h in heads)
     else:
@@ -254,7 +548,19 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
             (g._data if isinstance(g, NDArray) else jax.numpy.asarray(g))
             if g is not None else jax.numpy.ones_like(h._data)
             for h, g in zip(heads, hg))
-    grads = vjp_fn(cots)[0]
+
+    # cached path: one jitted program per tape structure (values ride in
+    # as arguments) instead of a fresh vjp re-trace + per-op dispatch
+    grads = None
+    spec, extras = tape.export(head_entries)
+    if spec is not None:
+        grads = _cached_backward(spec, extras, leaf_values, cots)
+    if grads is None:
+        def pure(vals):
+            return tape.replay(vals, head_entries)
+
+        _, vjp_fn = jax.vjp(pure, leaf_values)
+        grads = vjp_fn(cots)[0]
 
     for var in leaves:
         g = grads[leaf_entry_idx[id(var)]]

@@ -1,0 +1,765 @@
+"""CachedOp-style one-executable training step (reference capability:
+src/imperative/cached_op.cc — the engine behind `HybridBlock.hybridize` —
+extended here to the WHOLE training step, the paper's "lazy graphs lower
+to one jitted XLA executable" claim applied end to end).
+
+`Trainer.capture(loss_fn)` (convenience: `mx.jit_step(trainer, loss_fn)`)
+returns a `CachedStep` that compiles one full step into ONE jitted XLA
+executable:
+
+  * hybridized forward + loss — `loss_fn(*batch)` is traced functionally
+    (parameters become program inputs via the same `_TraceContext`
+    mechanism HybridBlock uses, so hybridized blocks inline and BatchNorm
+    aux updates become extra outputs);
+  * the backward via `jax.vjp` of that trace — no tape, no re-trace;
+  * in-graph gradient reduction over the 'ici' mesh (the kvstore's
+    `graph_allreduce` / `graph_reduce_scatter` lowering replaces the
+    host-driven `allreduce_flat` round-trip, so XLA's latency-hiding
+    scheduler overlaps the psum with backward compute, arXiv:2301.13062);
+  * the AMP unscale + nonfinite/overflow guard as a `lax.cond` (the skip
+    branch passes weights/state through untouched);
+  * the multi-tensor optimizer update (the same staged numerics as the
+    fused bucketed kernel — `multi_tensor.apply_param_update`).
+
+Parameter and optimizer-state buffers are DONATED to the executable, so
+Adam-family steps update in place instead of doubling live HBM.
+
+Executables are cached by (batch avals, parameter signature, optimizer
+state signature, scale mode, hyperparameters, mesh); per-step values —
+lr/wd schedules, loss scale, rescale, the grad.nan poison, the RNG key —
+ride in as weak-typed arguments and never retrace. Unsupported
+configurations (custom-update optimizers, `update_on_kvstore`, gradient
+compression, multi-process 'ici' without a mesh, host syncs inside
+`loss_fn`) fall back TRANSPARENTLY to the imperative record/backward/step
+path, with the reason recorded on `cachedop_fallbacks{reason=}`.
+
+`sharded_update=True` (arXiv:2004.13336) additionally reduce-scatters
+each eligible gradient, updates only this replica's row-shard of the
+weight and optimizer state, and all-gathers the new weights inside the
+same program; optimizer state stays row-sharded across steps (each
+replica only ever touches its shard). Eligible = elementwise update rule
+(`Optimizer.elementwise`) and dim 0 divisible by the mesh axis;
+ineligible parameters take the replicated psum+update path in the same
+executable.
+
+Reliability interplay (docs/RELIABILITY.md): captured steps still honor
+the step watchdog (`MXTPU_STEP_TIMEOUT_MS`) and the `grad.nan` fault
+point — the injection multiplies the in-graph gradients by a NaN poison
+argument, so the overflow/nonfinite `lax.cond` reflex is chaos-testable
+without leaving the executable.
+"""
+from __future__ import annotations
+
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .base import MXNetError
+from . import autograd
+from . import kvstore as kvs_mod
+from . import profiler
+from . import random as _random
+from .gluon.block import _TraceContext
+from .ndarray.ndarray import NDArray
+from .observability import tracer as _tracer
+from .observability import registry as _obs_registry
+from .fault import injection as _finj
+
+__all__ = ["CachedStep", "jit_step"]
+
+_reg = _obs_registry()
+_hits = _reg.counter("cachedop_cache_hits")
+_miss_counters = {}      # reason -> Counter cachedop_cache_misses{reason=}
+_fallback_counters = {}  # reason -> Counter cachedop_fallbacks{reason=}
+
+# cache-key layout; positions feed miss-reason classification
+_KEY_FIELDS = ("shape_change", "param_change", "state_change", "scale_mode",
+               "hyper_change", "autocast", "mesh", "sharded", "grad_reduce",
+               "clip")
+
+
+def _miss(reason):
+    c = _miss_counters.get(reason)
+    if c is None:
+        c = _miss_counters[reason] = _reg.counter("cachedop_cache_misses",
+                                                  reason=reason)
+    c.inc()
+
+
+def _fallback(reason):
+    c = _fallback_counters.get(reason)
+    if c is None:
+        c = _fallback_counters[reason] = _reg.counter("cachedop_fallbacks",
+                                                      reason=reason)
+    c.inc()
+
+
+# executables retained per CachedStep; a full jitted step program is heavy
+# (variable-length NLP batches would otherwise accumulate one per shape
+# forever), so the cache is a bounded LRU like the backward cache's
+_CACHE_MAX = 8
+
+
+class _CaptureUnsupported(Exception):
+    """Internal: this call cannot be captured — take the imperative path."""
+
+    def __init__(self, reason):
+        self.reason = reason
+        super().__init__(reason)
+
+
+# one aval-signature format shared with the backward cache, so the two
+# cache-key layouts cannot drift apart
+from .autograd import _aval_sig as _aval  # noqa: E402
+
+
+def _dev0_view(a):
+    """Zero-copy single-device view of a REPLICATED mesh output: shard 0
+    holds the full logical value, and a one-device array keeps every
+    eager/hybridized consumer (eval forwards, monitors, checkpoints)
+    working without caring that the captured step ran on a mesh."""
+    try:
+        return a.addressable_shards[0].data
+    except Exception:
+        return a
+
+
+def jit_step(trainer, loss_fn, **kwargs):
+    """Convenience for `trainer.capture(loss_fn, **kwargs)`:
+
+        step = mx.jit_step(trainer, lambda x, y: lossf(net(x), y).mean())
+        for x, y in batches:
+            loss = step(x, y)
+    """
+    return CachedStep(trainer, loss_fn, **kwargs)
+
+
+class CachedStep:
+    """One captured training step (see module docstring). Calling it runs
+    forward + backward + gradient reduction + guard + optimizer update as
+    one dispatch and returns `loss_fn`'s output (loss first) as NDArrays.
+
+    `grad_reduce` ('mean', the default, or 'sum') states how the in-graph
+    mesh reduction composes with the loss: a batch-MEAN loss needs the
+    per-replica gradients averaged over the axis to match the imperative
+    whole-batch semantics; a per-sample-SUM loss needs them summed.
+    """
+
+    def __init__(self, trainer, loss_fn, sharded_update=False,
+                 grad_reduce="mean"):
+        if grad_reduce not in ("mean", "sum"):
+            raise MXNetError(f"grad_reduce must be 'mean' or 'sum', "
+                             f"got {grad_reduce!r}")
+        self._trainer = trainer
+        self._loss_fn = loss_fn
+        self._sharded = bool(sharded_update)
+        self._grad_reduce = grad_reduce
+        from collections import OrderedDict
+        self._cache = OrderedDict()   # LRU: key -> (jfn, meta)
+        self._last_key = None
+        self._warned = set()
+        # mesh captures: ("d"|"n", idx) -> (device-0 view, mesh-resident
+        # array); as long as the param still holds the view, the next
+        # step reuses the mesh copy instead of re-broadcasting
+        self._mesh_cache = {}
+        self.last_fallback_reason = None
+
+    def _mesh_resident(self, kind, idx, cur):
+        c = self._mesh_cache.get((kind, idx))
+        if c is not None and c[0] is cur:
+            return c[1]
+        return cur
+
+    def _store(self, key, entry):
+        while len(self._cache) >= _CACHE_MAX:
+            self._cache.popitem(last=False)
+        self._cache[key] = entry
+
+    # ------------------------------------------------------------------
+    @property
+    def cache_size(self):
+        return len(self._cache)
+
+    def __call__(self, *batch, batch_size=None):
+        if _tracer.ACTIVE:
+            with _tracer.span("Trainer.captured_step", cat="trainer",
+                              args={"params": len(self._trainer._params),
+                                    "sharded": self._sharded,
+                                    "cache_size": len(self._cache)}):
+                return self._call_impl(batch, batch_size)
+        return self._call_impl(batch, batch_size)
+
+    def _call_impl(self, batch, batch_size):
+        batch_nd = [b if isinstance(b, NDArray) else NDArray(jnp.asarray(b))
+                    for b in batch]
+        if batch_size is None:
+            if not batch_nd or batch_nd[0].ndim == 0:
+                raise MXNetError("capture: pass batch_size= when the first "
+                                 "batch argument has no leading batch dim")
+            batch_size = int(batch_nd[0].shape[0])
+        self.last_fallback_reason = None
+        try:
+            return self._captured(batch_nd, batch_size)
+        except _CaptureUnsupported as e:
+            self.last_fallback_reason = e.reason
+            _fallback(e.reason)
+            if e.reason not in self._warned:
+                self._warned.add(e.reason)
+                warnings.warn(f"CachedStep: falling back to the imperative "
+                              f"path ({e.reason})", RuntimeWarning,
+                              stacklevel=3)
+            return self._imperative(batch_nd, batch_size)
+
+    # --------------------------------------------------- imperative twin
+    def _imperative(self, batch_nd, batch_size):
+        """Reference-semantics fallback: record, backward on the (AMP-
+        scaled) loss, `Trainer.step`. Same return value as the captured
+        path (the RAW loss, not the scaled one)."""
+        from . import amp
+        with autograd.record():
+            out = self._loss_fn(*batch_nd)
+            leaves, _ = jax.tree_util.tree_flatten(
+                out, is_leaf=lambda x: isinstance(x, NDArray))
+            if not leaves or not isinstance(leaves[0], NDArray):
+                raise MXNetError("capture: loss_fn must return an NDArray "
+                                 "loss (optionally nested with extra "
+                                 "outputs, loss leaf first)")
+            sc = amp.scaler()
+            head = leaves[0] * sc.loss_scale if sc is not None else leaves[0]
+        head.backward()
+        self._trainer.step(batch_size)
+        return out
+
+    # ------------------------------------------------------ captured path
+    def _captured(self, batch_nd, batch_size):
+        tr = self._trainer
+        opt = tr._optimizer
+        from . import amp
+        from .optimizer import multi_tensor
+        if tr._update_on_kvstore:
+            raise _CaptureUnsupported("update_on_kvstore")
+        if not multi_tensor.supports(opt):
+            raise _CaptureUnsupported("optimizer")
+        kv = tr._kvstore
+        spec = None
+        if kv is not None and kv.type == "ici":
+            if kv._compression is not None:
+                raise _CaptureUnsupported("compression")
+            spec = kv.capture_spec()
+            if spec is None and jax.process_count() > 1:
+                raise _CaptureUnsupported("multiprocess")
+        if self._sharded and spec is None:
+            raise MXNetError(
+                "sharded_update=True needs an 'ici' kvstore with a "
+                "multi-device mesh attached (kvstore.set_mesh)")
+        params = tr._params
+        if any(p._deferred_init is not None for p in params):
+            raise _CaptureUnsupported("deferred_init")
+        diff = [(i, p) for i, p in enumerate(params)
+                if p.grad_req != "null" and p._data is not None
+                and p._grad is not None]
+        if not diff:
+            raise _CaptureUnsupported("no_grads")
+        if spec is not None:
+            _, _, n_rep = spec
+            for b in batch_nd:
+                if b.ndim == 0 or b.shape[0] % n_rep:
+                    raise _CaptureUnsupported("batch_not_divisible")
+
+        scaler = amp.scaler()
+        scale_mode = ("amp" if scaler is not None
+                      else "skip" if tr.skip_nonfinite else "none")
+
+        updater = tr._updater
+        state_nds = []
+        for i, p in diff:
+            if i not in updater.states:
+                updater.states[i] = opt.create_state_multi_precision(
+                    i, p.data())
+            st = updater.states[i]
+            st = st if isinstance(st, tuple) else \
+                ((st,) if st is not None else ())
+            state_nds.append(st)
+
+        key = (
+            tuple(_aval(b._data) for b in batch_nd),
+            tuple(p._struct_sig() for p in params),
+            tuple(tuple(_aval(s._data) for s in sv) for sv in state_nds),
+            scale_mode,
+            multi_tensor._hyper_sig(opt),
+            str(amp.autocast_dtype()),
+            None if spec is None else (id(spec[0]), spec[1], spec[2]),
+            self._sharded,
+            self._grad_reduce,
+            None if opt.clip_gradient is None else float(opt.clip_gradient),
+        )
+        entry = self._cache.get(key)
+        if entry is None:
+            _miss(self._miss_reason(key))
+            profiler.record_jit_cache(False)
+            self._last_key = key
+            try:
+                entry = self._build(batch_nd, diff, state_nds, scale_mode,
+                                    spec)
+            except _CaptureUnsupported as e:
+                # negative-cache the failure: later steps with the same
+                # signature skip straight to the imperative path instead
+                # of re-running the abstract pre-pass every step
+                self._store(key, ("unsupported", e.reason))
+                raise
+            self._store(key, entry)
+        elif entry[0] == "unsupported":
+            self._cache.move_to_end(key)
+            self._last_key = key
+            raise _CaptureUnsupported(entry[1])
+        else:
+            self._cache.move_to_end(key)
+            _hits.inc()
+            profiler.record_jit_cache(True)
+            self._last_key = key
+        jfn, meta = entry
+        try:
+            return self._dispatch(jfn, meta, batch_nd, diff, state_nds,
+                                  batch_size, scaler, scale_mode)
+        except _CaptureUnsupported as e:
+            # a first-dispatch compile failure is as permanent as a build
+            # failure: negative-cache it so later steps skip straight to
+            # the imperative path
+            self._store(key, ("unsupported", e.reason))
+            raise
+
+    def _miss_reason(self, key):
+        last = self._last_key
+        if last is None:
+            return "first"
+        for name, a, b in zip(_KEY_FIELDS, key, last):
+            if a != b:
+                return name
+        return "other"
+
+    # ------------------------------------------------------------ build
+    def _build(self, batch_nd, diff, state_nds, scale_mode, spec):
+        tr = self._trainer
+        opt = tr._optimizer
+        kv = tr._kvstore
+        from .optimizer.multi_tensor import apply_param_update
+        from .jax_compat import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        diff_ids = {id(p) for _, p in diff}
+        diff_params = [p for _, p in diff]
+        nondiff = [p for p in tr._params
+                   if p._data is not None and id(p) not in diff_ids]
+        guard = scale_mode != "none"
+        unscale = scale_mode == "amp"
+        clip = None if opt.clip_gradient is None else float(opt.clip_gradient)
+        mp_flags = [bool(opt.multi_precision
+                         and p.data()._data.dtype != np.float32)
+                    for _, p in diff]
+        n_diff = len(diff)
+        mean = self._grad_reduce == "mean"
+        mesh = axis = None
+        n_rep = 1
+        if spec is not None:
+            mesh, axis, n_rep = spec
+
+        # per-param sharded-update eligibility (arXiv:2004.13336)
+        shard_ok = []
+        for (i, p), sv in zip(diff, state_nds):
+            w = p.data()._data
+            shard_ok.append(bool(
+                self._sharded and type(opt).elementwise and w.ndim >= 1
+                and w.shape[0] >= n_rep and w.shape[0] % n_rep == 0
+                and all(s._data.shape == w.shape or s._data.ndim == 0
+                        for s in sv)))
+
+        loss_fn = self._loss_fn
+        meta = {"treedef": None, "n_out": 0, "aux": [], "nondiff": nondiff}
+
+        def traced(rng, diff_vals, nondiff_vals, batch_vals):
+            """Functional run of loss_fn: every trainer parameter reads its
+            traced value, layer RNG flows from `rng`, aux updates (BN
+            running stats) are captured as outputs."""
+            nd_list = meta["nondiff"]
+            prev_rec = autograd.set_recording(False)
+            prev_train = autograd.set_training(True)
+            try:
+                with _TraceContext(rng) as tctx:
+                    for p, v in zip(diff_params, diff_vals):
+                        p._trace_override = NDArray(v)
+                    for p, v in zip(nd_list, nondiff_vals):
+                        p._trace_override = NDArray(v)
+                    out = loss_fn(*[NDArray(v) for v in batch_vals])
+                    leaves, treedef = jax.tree_util.tree_flatten(
+                        out, is_leaf=lambda x: isinstance(x, NDArray))
+                    if not leaves or not all(isinstance(l, NDArray)
+                                             for l in leaves):
+                        raise MXNetError(
+                            "capture: loss_fn must return NDArray(s), "
+                            "loss leaf first")
+                    meta["treedef"] = treedef
+                    meta["n_out"] = len(leaves)
+                    meta["aux"] = [p for p, _ in tctx.aux_updates]
+                    return ([l._data for l in leaves],
+                            [v._data if isinstance(v, NDArray) else v
+                             for _, v in tctx.aux_updates])
+            finally:
+                for p in diff_params:
+                    p._trace_override = None
+                for p in nd_list:
+                    p._trace_override = None
+                autograd.set_recording(prev_rec)
+                autograd.set_training(prev_train)
+
+        # abstract pre-pass: (a) surface trace errors (host syncs inside
+        # loss_fn) as a clean fallback, (b) discover the aux-update set so
+        # aux params NOT already program inputs become ones (else their
+        # values would bake in as compile-time constants)
+        rng0 = _random._next_key()
+        dvals = [p.data()._data for p in diff_params]
+        bvals = [b._data for b in batch_nd]
+
+        from .gluon import parameter as _param_mod
+
+        def _prepass():
+            # the watch collects Parameters whose CONCRETE data the trace
+            # reads (no override installed): non-trainer params a
+            # fine-tuning loss_fn touches — left alone they would bake in
+            # as compile-time constants and go stale on set_data()
+            watch = set()
+            prev = _param_mod._capture_watch
+            _param_mod._capture_watch = watch
+            try:
+                nvals0 = [p._data._data for p in meta["nondiff"]]
+                jax.eval_shape(traced, rng0, dvals, nvals0, bvals)
+            finally:
+                _param_mod._capture_watch = prev
+            return watch
+
+        try:
+            for _ in range(3):   # promotion closes after one extra pass
+                watch = _prepass()
+                known = set(diff_ids)
+                known.update(id(p) for p in meta["nondiff"])
+                promote = [p for p in watch
+                           if p._data is not None and id(p) not in known]
+                promote += [p for p in meta["aux"]
+                            if id(p) not in known
+                            and all(p is not q for q in promote)]
+                if not promote:
+                    break
+                meta["nondiff"] = meta["nondiff"] + promote
+        except MXNetError:
+            raise
+        except _CaptureUnsupported:
+            raise
+        except Exception as e:
+            raise _CaptureUnsupported(
+                f"trace_error:{type(e).__name__}") from e
+        if mesh is not None and meta["n_out"] != 1:
+            # extra outputs have no canonical cross-replica layout
+            raise _CaptureUnsupported("extra_outputs_mesh")
+        nondiff = meta["nondiff"]
+        pos_of = {id(p): j for j, p in enumerate(nondiff)}
+        meta["aux_pos"] = [pos_of.get(id(p)) for p in meta["aux"]]
+
+        def program(batch_vals, diff_vals, nondiff_vals, state_vals, rng,
+                    lrs, wds, rescale, inv_scale, loss_scale, poison):
+            def fwd(dv):
+                leaves, aux = traced(rng, dv, nondiff_vals, batch_vals)
+                return leaves[0], (leaves[1:], aux)
+
+            head, vjp_fn, (extra, aux_vals) = jax.vjp(
+                fwd, diff_vals, has_aux=True)
+            cot = jnp.ones_like(head) * jnp.asarray(loss_scale, head.dtype)
+            grads = list(vjp_fn(cot)[0])
+            # grad.nan fault point: poison is 1.0 unless the injection
+            # schedule fired this step (then NaN) — same reflex test as the
+            # imperative trainer's gradient poisoning, in-graph
+            grads = [g * poison for g in grads]
+
+            if mesh is not None:
+                grads = [
+                    kv.graph_reduce_scatter(g, axis, n_rep, mean=mean)
+                    if sh else kv.graph_allreduce(g, axis, n_rep, mean=mean)
+                    for g, sh in zip(grads, shard_ok)]
+                head = kv.graph_allreduce(head, axis, n_rep, mean=mean)
+                aux_vals = [kv.graph_allreduce(v, axis, n_rep, mean=True)
+                            for v in aux_vals]
+
+            # local (shard) views of weights; states arrive pre-sharded
+            # through their in_specs
+            w_locals, sv_locals = [], []
+            for k in range(n_diff):
+                w = diff_vals[k]
+                sv = tuple(state_vals[k])
+                if shard_ok[k]:
+                    chunk = w.shape[0] // n_rep
+                    ridx = jax.lax.axis_index(axis)
+                    w = jax.lax.dynamic_slice_in_dim(w, ridx * chunk,
+                                                     chunk, 0)
+                w_locals.append(w)
+                sv_locals.append(sv)
+
+            flag = jnp.zeros((), jnp.int32)
+            if guard:
+                shard_cnt = sum(
+                    (jnp.sum(~jnp.isfinite(g.astype(jnp.float32)),
+                             dtype=jnp.int32)
+                     for g, sh in zip(grads, shard_ok) if sh),
+                    jnp.zeros((), jnp.int32))
+                repl_cnt = sum(
+                    (jnp.sum(~jnp.isfinite(g.astype(jnp.float32)),
+                             dtype=jnp.int32)
+                     for g, sh in zip(grads, shard_ok) if not sh),
+                    jnp.zeros((), jnp.int32))
+                if mesh is not None and any(shard_ok):
+                    shard_cnt = kv.graph_allreduce(shard_cnt, axis, n_rep)
+                flag = ((shard_cnt + repl_cnt) > 0).astype(jnp.int32)
+
+            def do_update(_):
+                nws, nss, ogs = [], [], []
+                for k in range(n_diff):
+                    nw, ns, og = apply_param_update(
+                        opt, w_locals[k], grads[k], sv_locals[k],
+                        lrs[k], wds[k], mp_flags[k], clip, rescale,
+                        inv_scale if unscale else None)
+                    nws.append(nw)
+                    nss.append(ns)
+                    ogs.append(og if og is not None else grads[k])
+                return tuple(nws), tuple(nss), tuple(ogs)
+
+            def skip_update(_):
+                # grads still end unscaled on the skip path (per-param
+                # path parity: amp.unscale runs before the skip)
+                ogs = tuple(g * inv_scale for g in grads) if unscale \
+                    else tuple(grads)
+                return (tuple(w_locals),
+                        tuple(tuple(sv) for sv in sv_locals), ogs)
+
+            if guard:
+                new_ws, new_ss, out_gs = jax.lax.cond(
+                    flag > 0, skip_update, do_update, None)
+            else:
+                new_ws, new_ss, out_gs = do_update(None)
+
+            if mesh is not None and any(shard_ok):
+                # sharded params: all-gather the new weights IN-PROGRAM;
+                # states and grads stay row-sharded (out_specs P(axis))
+                new_ws = tuple(
+                    kv.graph_all_gather(w, axis) if sh else w
+                    for w, sh in zip(new_ws, shard_ok))
+            return ([head] + list(extra), list(aux_vals), list(new_ws),
+                    [tuple(sv) for sv in new_ss], list(out_gs), flag)
+
+        if mesh is None:
+            fn = program
+        else:
+            def state_spec(k, sv):
+                return tuple(
+                    P(axis) if shard_ok[k] and s._data.ndim != 0 else P()
+                    for s in sv)
+
+            in_specs = (
+                [P(axis)] * len(batch_nd),
+                [P()] * n_diff,
+                [P()] * len(nondiff),
+                [state_spec(k, sv) for k, sv in enumerate(state_nds)],
+                P(),
+                tuple(P() for _ in range(n_diff)),
+                tuple(P() for _ in range(n_diff)),
+                P(), P(), P(), P(),
+            )
+            out_specs = (
+                [P()],                                   # head (reduced)
+                [P()] * len(meta["aux"]),                # aux (pmean'd)
+                [P()] * n_diff,                          # new weights
+                [state_spec(k, sv) for k, sv in enumerate(state_nds)],
+                [P(axis) if sh else P() for sh in shard_ok],   # grads
+                P(),                                     # guard flag
+            )
+            fn = shard_map(program, mesh=mesh, in_specs=in_specs,
+                           out_specs=out_specs, check_vma=False)
+            # imperative arrays are committed to one device; resharding
+            # onto the mesh must be explicit (jit refuses to guess).
+            # device_put is a no-op once an array already carries the
+            # right sharding — params/state pay it on the first step only.
+            from jax.sharding import NamedSharding
+            repl = NamedSharding(mesh, P())
+            meta["shardings"] = (
+                [NamedSharding(mesh, P(axis)) for _ in batch_nd],
+                [repl] * n_diff,
+                [repl] * len(nondiff),
+                [tuple(NamedSharding(mesh, s)
+                       for s in state_spec(k, sv))
+                 for k, sv in enumerate(state_nds)],
+                repl,
+            )
+
+        jfn = jax.jit(fn, donate_argnums=(1, 3))
+        meta.update({
+            "fresh": True,     # first dispatch compiles: scope the CPU
+                               # donation-noop warning to that call only
+            "guard": guard,
+            "unscale": unscale,
+            "shard_ok": shard_ok,
+            "mesh": spec,
+            "coll_bytes": 0 if mesh is None else sum(
+                int(p._grad._data.size)
+                * jnp.dtype(p._grad._data.dtype).itemsize
+                for _, p in diff),
+            "coll_op": ("in_graph_reduce_scatter"
+                        if any(shard_ok) else "in_graph_psum"),
+        })
+        return jfn, meta
+
+    # --------------------------------------------------------- dispatch
+    def _dispatch(self, jfn, meta, batch_nd, diff, state_nds, batch_size,
+                  scaler, scale_mode):
+        tr = self._trainer
+        opt = tr._optimizer
+        tr._optimizer.rescale_grad = tr._scale / batch_size
+        # optimistic update-count bump (the skip branch rolls it back, so
+        # lr schedules see exactly what the imperative skip leaves behind)
+        snapshot = (opt.num_update,
+                    {i: opt._index_update_count.get(i) for i, _ in diff})
+        for i, _ in diff:
+            opt._update_count(i)
+        lrs = tuple(float(opt._get_lr(i)) for i, _ in diff)
+        wds = tuple(float(opt._get_wd(i)) for i, _ in diff)
+        rescale = float(opt.rescale_grad)
+        inv_scale = 0.0 if scaler is None else 1.0 / float(scaler.loss_scale)
+        loss_scale = 1.0 if scaler is None else float(scaler.loss_scale)
+        poison = (float("nan")
+                  if _finj.ENABLED and _finj.should_fire("grad.nan")
+                  else 1.0)
+        rng = _random._next_key()
+
+        profiler.record_dispatch("captured_step")
+        if meta["coll_bytes"]:
+            kvs_mod._count_collective(meta["coll_op"], meta["coll_bytes"])
+        batch_vals = [b._data for b in batch_nd]
+        diff_vals = [self._mesh_resident("d", i, p.data()._data)
+                     for i, p in diff]
+        nondiff_vals = [self._mesh_resident("n", j, p._data._data)
+                        for j, p in enumerate(meta["nondiff"])]
+        state_vals = [tuple(s._data for s in sv) for sv in state_nds]
+        sh = meta.get("shardings")
+        if sh is not None:
+            batch_vals, diff_vals, nondiff_vals, state_vals, rng = \
+                jax.device_put(
+                    (batch_vals, diff_vals, nondiff_vals, state_vals, rng),
+                    (sh[0], sh[1], sh[2], sh[3], sh[4]))
+            # frozen nondiff params broadcast onto the mesh ONCE: remember
+            # the mesh-resident copy so later steps skip the transfer
+            for j, p in enumerate(meta["nondiff"]):
+                self._mesh_cache[("n", j)] = (p._data._data,
+                                              nondiff_vals[j])
+        args = (batch_vals, diff_vals, nondiff_vals, state_vals,
+                rng, lrs, wds, rescale, inv_scale, loss_scale, poison)
+        fresh = meta.pop("fresh", False)
+        try:
+            if fresh:
+                # buffer donation is a no-op on CPU test meshes; jax warns
+                # at compile time — suppress it HERE, not process-wide
+                with warnings.catch_warnings():
+                    warnings.filterwarnings(
+                        "ignore", message="Some donated buffers were not")
+                    loss_leaves, aux_vals, new_ws, new_ss, out_gs, flag = \
+                        jfn(*args)
+            else:
+                loss_leaves, aux_vals, new_ws, new_ss, out_gs, flag = \
+                    jfn(*args)
+        except Exception as e:
+            # no update ran: un-bump the optimistic update counts so lr
+            # schedules stay aligned with what was actually applied
+            num_update, counts = snapshot
+            opt.num_update = num_update
+            for i, c in counts.items():
+                if c is None:
+                    opt._index_update_count.pop(i, None)
+                else:
+                    opt._index_update_count[i] = c
+            # donation hazard: if the program EXECUTED far enough to
+            # consume its donated inputs before failing, the param/state
+            # buffers are gone — falling back would read deleted arrays
+            # and silently train garbage. Only a failure that left every
+            # donated buffer alive (trace/compile-stage errors) may take
+            # the transparent imperative fallback.
+            donated_dead = any(
+                getattr(a, "is_deleted", lambda: False)()
+                for group in (diff_vals, state_vals)
+                for leaf in group
+                for a in (leaf if isinstance(leaf, tuple) else (leaf,)))
+            if donated_dead:
+                raise MXNetError(
+                    "captured step failed AFTER its donated parameter/"
+                    "state buffers were consumed — model state is lost; "
+                    "restore from a checkpoint (see docs/PERFORMANCE.md "
+                    f"donation rules). Cause: {type(e).__name__}: {e}"
+                ) from e
+            if fresh and not isinstance(e, _CaptureUnsupported):
+                # first call = trace/compile of the backward+update stages
+                # (the forward-only prepass cannot see those): treat like
+                # any other capture failure — transparent fallback
+                raise _CaptureUnsupported(
+                    f"compile_error:{type(e).__name__}") from e
+            raise
+
+        # Interop rule for mesh captures: anything eager code may consume
+        # (params, aux, replicated grads, the loss) is rebound to a ZERO-
+        # COPY device-0 shard view of the replicated mesh output, so
+        # eval/monitoring/hybridized forwards keep working on one device;
+        # the mesh-resident array itself is kept in _mesh_cache so the
+        # next captured step pays no re-broadcast. Row-sharded outputs
+        # (optimizer state, sharded-update grads) stay mesh-resident —
+        # their next-step in_specs match exactly and .asnumpy()/save see
+        # the full logical value.
+        if sh is not None:
+            for (i, p), w in zip(diff, new_ws):
+                v = _dev0_view(w)
+                p.data()._rebind(v)
+                self._mesh_cache[("d", i)] = (v, w)
+            for (_, p), g, sok in zip(diff, out_gs, meta["shard_ok"]):
+                p._grad._rebind(g if sok else _dev0_view(g))
+            for p, v, j in zip(meta["aux"], aux_vals, meta["aux_pos"]):
+                view = _dev0_view(v)
+                p._data._rebind(view)
+                if j is not None:
+                    self._mesh_cache[("n", j)] = (view, v)
+            loss_leaves = [_dev0_view(v) for v in loss_leaves]
+        else:
+            for (_, p), w in zip(diff, new_ws):
+                p.data()._rebind(w)
+            for (_, p), g in zip(diff, out_gs):
+                p._grad._rebind(g)
+            for p, v in zip(meta["aux"], aux_vals):
+                p._data._rebind(v)
+        for sv_nd, sv_new in zip(state_nds, new_ss):
+            for s_nd, s_val in zip(sv_nd, sv_new):
+                s_nd._rebind(s_val)
+
+        applied = True
+        if meta["guard"]:
+            overflow = bool(flag)   # ONE host sync — the imperative
+            applied = not overflow  # nonfinite guard pays the same
+            if scaler is not None:
+                scaler.update_scale(overflow)
+        if applied:
+            tr._note_applied()
+        else:
+            num_update, counts = snapshot
+            opt.num_update = num_update
+            for i, c in counts.items():
+                if c is None:
+                    opt._index_update_count.pop(i, None)
+                else:
+                    opt._index_update_count[i] = c
+            tr._note_skip("AMP overflow" if scale_mode == "amp"
+                          else "nonfinite gradients")
+        tr._tick_step()
+
+        out_nd = [NDArray(v) for v in loss_leaves]
+        return jax.tree_util.tree_unflatten(meta["treedef"], out_nd)

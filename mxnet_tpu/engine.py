@@ -357,7 +357,14 @@ def set_bulk_size(size):
     degrade every bucket to per-param, so op-count-scale sizes
     (0 < size < 4096) mean "bulked at the default byte cap" while
     byte-scale sizes pass through as caps. Returns the previous value so
-    scopes can restore it."""
+    scopes can restore it.
+
+    Bulk/captured interplay: the cap shapes the IMPERATIVE fused path's
+    bucket layout only. A captured step (`Trainer.capture`,
+    mxnet_tpu/cachedop.py) is already one executable — there is nothing
+    left to bulk, so the cap (and `engine.bulk()` scopes) neither affect
+    it nor invalidate its cache; the imperative fallback path inside a
+    CachedStep still honors the cap like any `Trainer.step`."""
     global _bulk_size
     prev = _bulk_size
     size = max(0, int(size))

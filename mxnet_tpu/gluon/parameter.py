@@ -27,6 +27,16 @@ class DeferredInitializationError(MXNetError):
     first forward has inferred its shape."""
 
 
+# Set by cachedop during its capture pre-pass (a collecting set): every
+# Parameter whose CONCRETE data is read while tracing — i.e. one NOT
+# overridden as a program input — is recorded here so the captured step
+# can promote it to an input instead of baking its value into the
+# executable as a compile-time constant (fine-tuning setups read frozen
+# backbone params that are not in the Trainer's param list). None
+# (default) keeps the hot path at one global load + is-None check.
+_capture_watch = None
+
+
 class Parameter:
     def __init__(self, name, grad_req="write", shape=None, dtype=np.float32,
                  lr_mult=1.0, wd_mult=1.0, init=None, allow_deferred_init=False,
@@ -125,6 +135,8 @@ class Parameter:
     def data(self, ctx=None):
         if self._trace_override is not None:
             return self._trace_override
+        if _capture_watch is not None:
+            _capture_watch.add(self)
         if self._data is None:
             if self._deferred_init is not None:
                 raise DeferredInitializationError(

@@ -188,11 +188,31 @@ class Trainer:
                     profiler.record_dispatch("allreduce")
                 p._grad._rebind(agg)
 
+    def capture(self, loss_fn, sharded_update=False, grad_reduce="mean"):
+        """Capture one FULL training step — `loss_fn(*batch)` forward,
+        backward, in-graph 'ici' gradient reduction, AMP/nonfinite guard,
+        optimizer update — as ONE jitted XLA executable with parameter and
+        optimizer-state buffers donated (mxnet_tpu/cachedop.py, the
+        CachedOp of the whole step). Returns a `CachedStep`; call it with
+        the batch instead of the record/backward/step() triple:
+
+            step = trainer.capture(lambda x, y: lossf(net(x), y).mean())
+            for x, y in batches:
+                loss = step(x, y)            # one device dispatch
+
+        `sharded_update=True` (needs an 'ici' kvstore with a mesh)
+        reduce-scatters gradients, updates each replica's weight shard and
+        all-gathers the new weights inside the same program
+        (arXiv:2004.13336). Unsupported configurations fall back to the
+        imperative path transparently; see docs/PERFORMANCE.md."""
+        from ..cachedop import CachedStep
+        return CachedStep(self, loss_fn, sharded_update=sharded_update,
+                          grad_reduce=grad_reduce)
+
     def step(self, batch_size, ignore_stale_grad=False):
         """Rescale gradients by 1/batch_size and apply one optimizer step.
         Under an AMP loss scaler: unscale, skip on overflow, adjust scale.
         With skip_nonfinite: skip the update when any grad is inf/nan."""
-        import time
         if _tracer.ACTIVE:
             with _tracer.span("Trainer.step", cat="trainer",
                               args={"batch_size": int(batch_size),
@@ -205,12 +225,7 @@ class Trainer:
                 _grad_norm_gauge.set(_global_grad_norm(grads))
         else:
             self._step_impl(batch_size, ignore_stale_grad)
-        _steps_counter.inc()
-        now = time.perf_counter()
-        last = self._last_step_t
-        self._last_step_t = now
-        if last is not None and now > last:
-            _steps_s_gauge.set(1.0 / (now - last))
+        self._tick_step()
 
     def _step_impl(self, batch_size, ignore_stale_grad):
         self._optimizer.rescale_grad = self._scale / batch_size
@@ -223,7 +238,19 @@ class Trainer:
         self._init_kvstore()   # incremental: picks up late-materialised params
         self.allreduce_grads()
         self._apply_update(ignore_stale_grad)
+
+    def _tick_step(self):
+        """Per-step bookkeeping shared by the imperative `step()` and the
+        captured step (cachedop.py): watchdog deadline check, step
+        counter, steps/s gauge."""
+        import time
         _fwatchdog.maybe_check(step=int(_steps_counter.value))
+        _steps_counter.inc()
+        now = time.perf_counter()
+        last = self._last_step_t
+        self._last_step_t = now
+        if last is not None and now > last:
+            _steps_s_gauge.set(1.0 / (now - last))
 
     # ------------------------------------------ skip-streak escalation
     @property
@@ -271,7 +298,7 @@ class Trainer:
         """Shared AMP-unscale / overflow-skip / nonfinite-skip guard for
         step() and update(). Returns True when the update must be skipped."""
         from .. import amp, profiler
-        scaler = amp._state.get("scaler") if amp.is_active() else None
+        scaler = amp.scaler()
         if scaler is not None:
             # same "nonfinite_guard" tally as the fused path, so
             # fused-vs-unfused dispatch comparisons stay symmetric
@@ -343,7 +370,7 @@ class Trainer:
         kernel per bucket (AMP unscale folded in)."""
         from .. import amp, profiler
         buckets = self._get_buckets(self._updatable_pairs(ignore_stale_grad))
-        scaler = amp._state.get("scaler") if amp.is_active() else None
+        scaler = amp.scaler()
         if scaler is None and not buckets:
             return
         inv_scale = None

@@ -505,6 +505,49 @@ class KVStore:
         split = jax.jit(lambda flat: split_flat(flat, shapes))
         return flatten, split
 
+    # ------------------------------------- in-jit collective lowering
+    # The captured train step (cachedop.py) lowers gradient reduction
+    # INTO the jitted program instead of the host-driven allreduce_flat
+    # round-trip: the helpers below are called while TRACING inside a
+    # shard_map over this store's mesh, so the psum / reduce-scatter /
+    # all-gather become ops of the step's own StableHLO module and XLA's
+    # scheduler overlaps them with backward compute (arXiv:2301.13062).
+    def capture_spec(self):
+        """(mesh, axis, size) when a captured step should lower its
+        gradient reduction in-graph over this store, else None (identity
+        reduction: non-'ici' stores, no mesh, or a 1-wide axis)."""
+        if self._kind != "ici" or self._mesh is None:
+            return None
+        axis = self._mesh.axis_names[0]
+        n = int(self._mesh.shape[axis])
+        if n <= 1:
+            return None
+        return self._mesh, axis, n
+
+    def graph_allreduce(self, g, axis, size, mean=False):
+        """In-graph psum over `axis` (trace-time only — must run inside a
+        shard_map over this store's mesh). `mean` folds the 1/size of a
+        batch-mean loss into the same fused region."""
+        out = jax.lax.psum(g, axis)
+        if mean:
+            out = out * (1.0 / size)
+        return out
+
+    def graph_reduce_scatter(self, g, axis, size, mean=False):
+        """In-graph reduce-scatter over dim 0 (trace-time only): each
+        replica gets its 1/size contiguous row-shard of the summed value —
+        the gradient half of the arXiv:2004.13336 sharded weight update."""
+        out = jax.lax.psum_scatter(g, axis, scatter_dimension=0, tiled=True)
+        if mean:
+            out = out * (1.0 / size)
+        return out
+
+    def graph_all_gather(self, x, axis):
+        """In-graph all-gather over dim 0 (trace-time only): reassembles
+        row-shards into the full replicated value — the parameter half of
+        the sharded weight update."""
+        return jax.lax.all_gather(x, axis, axis=0, tiled=True)
+
     def _psum_stacked(self, a, axis):
         from jax.sharding import PartitionSpec as P
         from .jax_compat import shard_map

@@ -40,7 +40,7 @@ from .updater import Updater
 from .optimizer import Optimizer, DCASGD
 
 __all__ = ["FusedUpdater", "build_buckets", "bucket_signature", "supports",
-           "flat_layout", "split_flat"]
+           "flat_layout", "split_flat", "apply_param_update"]
 
 
 def flat_layout(shapes):
@@ -143,11 +143,43 @@ def bucket_signature(bucket, optimizer):
     return tuple(sig)
 
 
+def apply_param_update(optimizer, w, g, sv, lr, wd, mp, clip, rescale,
+                       inv_scale=None):
+    """ONE parameter's in-graph optimizer application, staged exactly like
+    `Optimizer.update` / `update_multi_precision` — optional folded AMP
+    unscale, f32 upcast, rescale, clip, dtype-matched downcast, `apply`,
+    master-weight downcast, and state-arity passthrough (if a
+    hyperparameter mutation shrank apply()'s state arity, e.g.
+    momentum -> 0, the untouched slots pass through so every donated
+    input buffer has a live output and the stale-state-kept semantics
+    match the per-param path). The single source of the fused numerics,
+    shared by the bucketed `_make_kernel` and the captured-step program
+    (mxnet_tpu/cachedop.py). Returns `(new_w, new_state_tuple,
+    unscaled_grad_or_None)`."""
+    out_g = None
+    if inv_scale is not None:
+        g = g * inv_scale
+        out_g = g
+    gg = g if g.dtype == jnp.float32 else g.astype(jnp.float32)
+    gg = gg * rescale
+    if clip is not None:
+        gg = jnp.clip(gg, -clip, clip)
+    if mp:
+        master, rest = sv[0], tuple(sv[1:])
+        new_m, new_s = optimizer.apply(master, gg, rest, lr, wd)
+        new_w = new_m.astype(w.dtype)
+        full = (new_m,) + tuple(new_s)
+    else:
+        if gg.dtype != w.dtype:
+            gg = gg.astype(w.dtype)
+        new_w, new_s = optimizer.apply(w, gg, tuple(sv), lr, wd)
+        full = tuple(new_s)
+    return new_w, full + tuple(sv[len(full):]), out_g
+
+
 def _make_kernel(optimizer, mp_flags, clip, unscale, n):
-    """Trace ONE jitted update over a whole bucket. Per parameter it
-    replays exactly what `Optimizer.update` / `update_multi_precision`
-    do — f32 upcast, rescale, clip, dtype-matched downcast, `apply`,
-    master-weight downcast — so a bucket of n parameters compiles to a
+    """Trace ONE jitted update over a whole bucket (per-param staging:
+    `apply_param_update`), so a bucket of n parameters compiles to a
     single XLA executable instead of n launches. When `unscale` is set the
     AMP 1/loss_scale multiply is folded in and the unscaled per-param
     gradients come back as outputs (so `p.grad()` observes the same value
@@ -158,32 +190,13 @@ def _make_kernel(optimizer, mp_flags, clip, unscale, n):
     def kernel(weights, grads, states, lrs, wds, rescale, inv):
         new_ws, new_ss, out_gs = [], [], []
         for i in range(n):
-            w, g, sv = weights[i], grads[i], states[i]
-            if unscale:
-                g = g * inv
-                out_gs.append(g)
-            gg = g if g.dtype == jnp.float32 else g.astype(jnp.float32)
-            gg = gg * rescale
-            if clip is not None:
-                gg = jnp.clip(gg, -clip, clip)
-            if mp_flags[i]:
-                master, rest = sv[0], tuple(sv[1:])
-                new_m, new_s = optimizer.apply(master, gg, rest,
-                                               lrs[i], wds[i])
-                new_ws.append(new_m.astype(w.dtype))
-                full = (new_m,) + tuple(new_s)
-            else:
-                if gg.dtype != w.dtype:
-                    gg = gg.astype(w.dtype)
-                new_w, new_s = optimizer.apply(w, gg, tuple(sv),
-                                               lrs[i], wds[i])
-                new_ws.append(new_w)
-                full = tuple(new_s)
-            # if a hyperparameter mutation shrank apply()'s state arity
-            # (momentum -> 0), pass the untouched slots through: every
-            # donated input buffer then has a live output (donation-safe)
-            # and the stale-state-kept semantics match the per-param path
-            new_ss.append(full + tuple(sv[len(full):]))
+            new_w, full, out_g = apply_param_update(
+                optimizer, weights[i], grads[i], states[i], lrs[i], wds[i],
+                mp_flags[i], clip, rescale, inv if unscale else None)
+            new_ws.append(new_w)
+            new_ss.append(full)
+            if out_g is not None:
+                out_gs.append(out_g)
         return new_ws, new_ss, out_gs
 
     return jax.jit(kernel, donate_argnums=(2,))

@@ -44,6 +44,14 @@ def create(name, **kwargs):
 class Optimizer:
     """Base optimizer with per-parameter state, lr scaling and schedulers."""
 
+    # True when `apply` is elementwise over the weight tensor (no
+    # whole-tensor reductions like LAMB/LARS trust ratios, no RNG): the
+    # rule then commutes with dim-0 sharding, which is what the captured
+    # step's `sharded_update` mode (cachedop.py, arXiv:2004.13336) needs
+    # to update each replica's weight shard independently. Conservative
+    # default: subclasses opt in.
+    elementwise = False
+
     def __init__(self, learning_rate=0.01, wd=0.0, rescale_grad=1.0,
                  clip_gradient=None, lr_scheduler=None, param_dict=None,
                  multi_precision=False, **kwargs):
@@ -178,6 +186,8 @@ class Optimizer:
 class SGD(Optimizer):
     """SGD with momentum and weight decay (reference sgd_mom_update)."""
 
+    elementwise = True
+
     def __init__(self, learning_rate=0.01, momentum=0.0, **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
         self.momentum = momentum
@@ -207,6 +217,8 @@ class NAG(SGD):
 
 @register
 class Adam(Optimizer):
+    elementwise = True
+
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-8, **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
@@ -292,6 +304,8 @@ class Nadam(Adam):
 
 @register
 class AdaGrad(Optimizer):
+    elementwise = True
+
     def __init__(self, learning_rate=0.01, eps=1e-7, **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
         self.float_stable_eps = eps
@@ -307,6 +321,8 @@ class AdaGrad(Optimizer):
 
 @register
 class AdaDelta(Optimizer):
+    elementwise = True
+
     def __init__(self, learning_rate=1.0, rho=0.9, epsilon=1e-5, **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
         self.rho, self.epsilon = rho, epsilon
@@ -325,6 +341,8 @@ class AdaDelta(Optimizer):
 
 @register
 class RMSProp(Optimizer):
+    elementwise = True
+
     def __init__(self, learning_rate=0.001, rho=0.9, momentum=0.9,
                  epsilon=1e-8, centered=False, **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
@@ -351,6 +369,8 @@ class RMSProp(Optimizer):
 
 @register
 class Ftrl(Optimizer):
+    elementwise = True
+
     def __init__(self, learning_rate=0.1, lamda1=0.01, beta=1.0, **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
         self.lamda1, self.beta = lamda1, beta
@@ -442,6 +462,7 @@ class LAMB(Optimizer):
 @register
 class LARS(SGD):
     """Layer-wise adaptive rate scaling for large-batch SGD."""
+    elementwise = False    # whole-tensor trust ratio
 
     def __init__(self, learning_rate=0.1, momentum=0.9, eta=0.001,
                  epsilon=1e-8, **kwargs):
@@ -460,6 +481,8 @@ class LARS(SGD):
 
 @register
 class Signum(Optimizer):
+    elementwise = True
+
     def __init__(self, learning_rate=0.01, momentum=0.9, wd_lh=0.0, **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
         self.momentum = momentum

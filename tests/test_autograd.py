@@ -135,3 +135,107 @@ def test_getitem_grad():
         y = x[0].sum()
     y.backward()
     assert np.allclose(x.grad.asnumpy(), [[1, 1], [0, 0]])
+
+
+# ---------------------------------------------------------------------------
+# cached jitted backward (vjp-callable cache)
+# ---------------------------------------------------------------------------
+def test_backward_vjp_cache_hits_on_repeat():
+    """Repeated identical-shape backward calls stop re-tracing: the second
+    call hits the cached jitted program and produces the same gradients."""
+    autograd.clear_vjp_cache()
+    x = nd.array(np.array([[1.0, -2.0], [3.0, 0.5]], np.float32))
+    x.attach_grad()
+    grads = []
+    h0, m0 = autograd.vjp_cache_stats()
+    n = autograd._VJP_COMPILE_AFTER + 2
+    for _ in range(n):
+        with autograd.record():
+            y = ((x * 2.0 + 1.0) ** 2).sum()
+        y.backward()
+        grads.append(x.grad.asnumpy().copy())
+    h1, m1 = autograd.vjp_cache_stats()
+    # early sightings defer (short-lived tapes never pay a compile), the
+    # threshold sighting compiles, everything after is a pure hit
+    assert m1 - m0 == autograd._VJP_COMPILE_AFTER
+    assert h1 - h0 == 2
+    expect = 4.0 * (2.0 * x.asnumpy() + 1.0)   # d/dx sum((2x+1)^2)
+    for g in grads:
+        np.testing.assert_allclose(g, expect, rtol=1e-6)
+
+
+def test_backward_vjp_cache_new_batch_values():
+    """A structurally identical tape over NEW constant values (fresh batch)
+    hits the cache and still differentiates against the new values."""
+    autograd.clear_vjp_cache()
+    h0, m0 = autograd.vjp_cache_stats()
+    w = nd.array(np.ones((3,), np.float32))
+    w.attach_grad()
+    for scale in [1.0 + 2 * i for i in range(autograd._VJP_COMPILE_AFTER + 1)]:
+        batch = nd.array(np.full((3,), scale, np.float32))
+        with autograd.record():
+            y = (w * batch).sum()
+        y.backward()
+        np.testing.assert_allclose(w.grad.asnumpy(),
+                                   np.full((3,), scale, np.float32))
+    h, m = autograd.vjp_cache_stats()
+    # deferred sightings, one compile, then a hit whose NEW const value
+    # rides in as an argument (not a baked jit constant)
+    assert (h - h0, m - m0) == (1, autograd._VJP_COMPILE_AFTER)
+
+
+def test_backward_vjp_cache_shape_change_misses():
+    autograd.clear_vjp_cache()
+    h0, m0 = autograd.vjp_cache_stats()
+    for n in (2, 4):
+        x = nd.array(np.ones((n,), np.float32))
+        x.attach_grad()
+        with autograd.record():
+            y = (x * 3.0).sum()
+        y.backward()
+        np.testing.assert_allclose(x.grad.asnumpy(), np.full((n,), 3.0))
+    h, m = autograd.vjp_cache_stats()
+    assert (h - h0, m - m0) == (0, 2)
+
+
+def test_backward_vjp_cache_custom_function_blacklists():
+    """autograd.Function builds a fresh custom_vjp per call — identity keys
+    never repeat, so the cache must blacklist the shape instead of
+    compiling forever, and gradients stay correct throughout."""
+    autograd.clear_vjp_cache()
+    h0, m0 = autograd.vjp_cache_stats()
+
+    class Double(autograd.Function):
+        def forward(self, x):
+            return x * 2
+
+        def backward(self, dy):
+            return dy * 2
+
+    for _ in range(5):
+        x = nd.array(np.ones((2,), np.float32))
+        x.attach_grad()
+        f = Double()
+        with autograd.record():
+            y = f(x).sum()
+        y.backward()
+        np.testing.assert_allclose(x.grad.asnumpy(), [2.0, 2.0])
+    h, m = autograd.vjp_cache_stats()
+    # every call is a miss (the blacklisted path still counts, so the
+    # telemetry shows the true 100% miss rate), none ever hits, and —
+    # the point of the blacklist — nothing was ever compiled/cached
+    assert h - h0 == 0 and m - m0 == 5
+    assert len(autograd._vjp_cache) == 0
+
+
+def test_backward_vjp_cache_retain_graph_and_head_grads():
+    autograd.clear_vjp_cache()
+    x = nd.array(np.array([1.0, 2.0], np.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+    y.backward(out_grad=nd.array(np.array([1.0, 10.0], np.float32)),
+               retain_graph=True)
+    np.testing.assert_allclose(x.grad.asnumpy(), [2.0, 40.0])
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [2.0, 4.0])

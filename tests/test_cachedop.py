@@ -1,0 +1,524 @@
+"""Captured one-executable training step (mxnet_tpu/cachedop.py):
+captured-vs-imperative parity (fused and unfused optimizers, AMP
+overflow-skip, the 'ici' kvstore on the CPU test mesh, sharded_update),
+single-dispatch guarantees, cache hit/miss/fallback telemetry, and the
+cached-backward interplay."""
+import warnings
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import amp, autograd, fault, gluon, nd, profiler
+from mxnet_tpu.observability import registry
+from mxnet_tpu.parallel.mesh import make_mesh
+
+BATCH, DIM, CLS = 8, 16, 4
+
+
+def _data():
+    rng = np.random.RandomState(0)
+    X = nd.array(rng.randn(BATCH, DIM).astype(np.float32))
+    y = nd.array(rng.randint(0, CLS, BATCH).astype(np.float32))
+    return X, y
+
+
+def _build(X, layers=3, hidden=16, seed=0, bn=False):
+    mx.random.seed(seed)
+    net = gluon.nn.Sequential()
+    for _ in range(layers):
+        net.add(gluon.nn.Dense(hidden, activation="relu"))
+    if bn:
+        net.add(gluon.nn.BatchNorm())
+    net.add(gluon.nn.Dense(CLS))
+    net.initialize(mx.init.Xavier())
+    net(X)
+    return net
+
+
+_lossf = gluon.loss.SoftmaxCrossEntropyLoss()
+
+
+def _weights(net):
+    return [p.data().asnumpy().astype(np.float32)
+            for p in net.collect_params().values()]
+
+
+def _train_imperative(net, tr, X, y, steps):
+    for _ in range(steps):
+        with autograd.record():
+            L = _lossf(net(X), y).mean()
+        L.backward()
+        tr.step(BATCH)
+    return _weights(net)
+
+
+def _train_captured(net, tr, X, y, steps, **cap_kw):
+    step = tr.capture(lambda a, b: _lossf(net(a), b).mean(), **cap_kw)
+    for _ in range(steps):
+        step(X, y)
+        assert step.last_fallback_reason is None, step.last_fallback_reason
+    assert step.cache_size == 1          # one executable for the whole run
+    return _weights(net)
+
+
+def _assert_parity(a, b, rtol=1e-4, atol=1e-6, tag=""):
+    for i, (x, z) in enumerate(zip(a, b)):
+        np.testing.assert_allclose(x, z, rtol=rtol, atol=atol,
+                                   err_msg=f"{tag} param {i}")
+
+
+# ------------------------------------------------------------------ parity
+@pytest.mark.parametrize("opt", ["sgd", "adam"])
+def test_captured_parity_fused(opt):
+    """Captured step == fused imperative path, per optimizer family."""
+    X, y = _data()
+    kw = {"learning_rate": 0.05, "wd": 0.01}
+    if opt == "sgd":
+        kw["momentum"] = 0.9
+    net_i = _build(X)
+    imp = _train_imperative(
+        net_i, gluon.Trainer(net_i.collect_params(), opt, dict(kw)), X, y, 4)
+    net_c = _build(X)
+    cap = _train_captured(
+        net_c, gluon.Trainer(net_c.collect_params(), opt, dict(kw)), X, y, 4)
+    _assert_parity(cap, imp, tag=opt)
+
+
+def test_captured_parity_unfused_trainer():
+    """fused=False Trainer: the captured program still matches the
+    per-param reference updates."""
+    X, y = _data()
+    net_i = _build(X)
+    imp = _train_imperative(
+        net_i, gluon.Trainer(net_i.collect_params(), "adam",
+                             {"learning_rate": 0.05}, fused=False), X, y, 3)
+    net_c = _build(X)
+    cap = _train_captured(
+        net_c, gluon.Trainer(net_c.collect_params(), "adam",
+                             {"learning_rate": 0.05}, fused=False), X, y, 3)
+    _assert_parity(cap, imp, tag="unfused")
+
+
+def test_captured_batchnorm_aux_carried():
+    """BN running stats (aux updates) are outputs of the captured program
+    and match the imperative path."""
+    X, y = _data()
+    net_i = _build(X, bn=True)
+    imp = _train_imperative(
+        net_i, gluon.Trainer(net_i.collect_params(), "sgd",
+                             {"learning_rate": 0.05}), X, y, 3)
+    net_c = _build(X, bn=True)
+    cap = _train_captured(
+        net_c, gluon.Trainer(net_c.collect_params(), "sgd",
+                             {"learning_rate": 0.05}), X, y, 3)
+    _assert_parity(cap, imp, tag="bn")
+    fresh = _weights(_build(X, bn=True))
+    assert any(not np.array_equal(c, f) for c, f in zip(cap, fresh))
+
+
+def test_captured_amp_overflow_skip_parity():
+    """fp16 loss-scaler protocol inside the lax.cond guard: a NaN step
+    (grad.nan fault point -> in-graph poison) skips the update and halves
+    the scale exactly like the imperative path."""
+    X, y = _data()
+
+    def run(captured):
+        amp.reset()
+        amp.init("float16")
+        fault.injection.clear()
+        fault.injection.inject("grad.nan", at=[2])
+        net = _build(X)
+        tr = gluon.Trainer(net.collect_params(), "sgd",
+                           {"learning_rate": 0.05})
+        try:
+            if captured:
+                step = tr.capture(lambda a, b: _lossf(net(a), b).mean())
+                for _ in range(4):
+                    step(X, y)
+                    assert step.last_fallback_reason is None
+            else:
+                for _ in range(4):
+                    with autograd.record():
+                        L = amp.scale_loss(_lossf(net(X), y).mean())
+                    L.backward()
+                    tr.step(BATCH)
+            return _weights(net), amp._state["scaler"].loss_scale
+        finally:
+            amp.reset()
+            fault.injection.clear()
+
+    wc, sc = run(True)
+    wi, si = run(False)
+    assert sc == si
+    _assert_parity(wc, wi, tag="amp")
+
+
+def test_captured_skip_nonfinite_and_streak():
+    """skip_nonfinite guard skips poisoned steps in-graph; the skip streak
+    escalation still fires on the captured path."""
+    X, y = _data()
+    fault.injection.clear()
+    fault.injection.inject("grad.nan", at=[2])
+    try:
+        net = _build(X)
+        tr = gluon.Trainer(net.collect_params(), "sgd",
+                           {"learning_rate": 0.05}, skip_nonfinite=True)
+        step = tr.capture(lambda a, b: _lossf(net(a), b).mean())
+        step(X, y)
+        before = _weights(net)
+        step(X, y)                      # poisoned: must skip
+        _assert_parity(_weights(net), before, rtol=0, atol=0, tag="skip")
+        assert tr.consecutive_skipped_steps == 1
+        step(X, y)                      # clean: applies, streak resets
+        assert tr.consecutive_skipped_steps == 0
+    finally:
+        fault.injection.clear()
+    # escalation: every step poisoned + max_skipped_steps=1 -> raises
+    fault.injection.inject("grad.nan", prob=1.0)
+    try:
+        net = _build(X)
+        tr = gluon.Trainer(net.collect_params(), "sgd",
+                           {"learning_rate": 0.05}, skip_nonfinite=True,
+                           max_skipped_steps=1)
+        step = tr.capture(lambda a, b: _lossf(net(a), b).mean())
+        step(X, y)
+        with pytest.raises(Exception, match="consecutive skipped"):
+            step(X, y)
+    finally:
+        fault.injection.clear()
+
+
+# ------------------------------------------------------ 'ici' on the mesh
+def test_captured_ici_psum_and_sharded_update_parity():
+    """Captured step over the CPU test mesh: batch sharded over 'dp',
+    gradients psum'd IN-GRAPH — matches the imperative replicated run;
+    and sharded_update=True (in-graph reduce-scatter + per-shard update +
+    all-gather, arXiv:2004.13336) matches the replicated-update capture
+    on the same 2-device mesh."""
+    X, y = _data()
+    mesh = make_mesh({"dp": 2})
+    net_i = _build(X)
+    tr_i = gluon.Trainer(net_i.collect_params(), "adam",
+                         {"learning_rate": 0.05}, kvstore="ici")
+    tr_i._kvstore.set_mesh(mesh)
+    imp = _train_imperative(net_i, tr_i, X, y, 4)
+
+    def run(sharded):
+        net = _build(X)
+        tr = gluon.Trainer(net.collect_params(), "adam",
+                           {"learning_rate": 0.05}, kvstore="ici")
+        tr._kvstore.set_mesh(mesh)
+        return _train_captured(net, tr, X, y, 4, sharded_update=sharded)
+
+    cap = run(False)
+    _assert_parity(cap, imp, rtol=2e-4, atol=1e-5, tag="ici")
+    # the in-graph collective is accounted per step
+    snap = registry().snapshot()
+    ops = {tuple(s["labels"].items()) for s in snap["kv_collective_bytes"]}
+    assert (("op", "in_graph_psum"),) in ops
+    _assert_parity(run(True), cap, rtol=2e-4, atol=1e-5, tag="sharded")
+    assert (("op", "in_graph_reduce_scatter"),) in {
+        tuple(s["labels"].items())
+        for s in registry().snapshot()["kv_collective_bytes"]}
+
+
+def test_sharded_update_requires_mesh():
+    X, y = _data()
+    net = _build(X)
+    tr = gluon.Trainer(net.collect_params(), "sgd", {"learning_rate": 0.05})
+    step = tr.capture(lambda a, b: _lossf(net(a), b).mean(),
+                      sharded_update=True)
+    with pytest.raises(Exception, match="sharded_update"):
+        step(X, y)
+
+
+def test_sharded_update_lamb_falls_back_to_replicated_update():
+    """LAMB's trust ratio is a whole-tensor norm (elementwise=False): its
+    params take the replicated-update path inside the same sharded
+    program, and the numerics still match the imperative run."""
+    X, y = _data()
+    mesh = make_mesh({"dp": 2})
+    net_i = _build(X)
+    tr_i = gluon.Trainer(net_i.collect_params(), "lamb",
+                         {"learning_rate": 0.01}, kvstore="ici")
+    tr_i._kvstore.set_mesh(mesh)
+    imp = _train_imperative(net_i, tr_i, X, y, 3)
+    net_c = _build(X)
+    tr_c = gluon.Trainer(net_c.collect_params(), "lamb",
+                         {"learning_rate": 0.01}, kvstore="ici")
+    tr_c._kvstore.set_mesh(mesh)
+    cap = _train_captured(net_c, tr_c, X, y, 3, sharded_update=True)
+    _assert_parity(cap, imp, rtol=2e-4, atol=1e-5, tag="lamb")
+
+
+# ----------------------------------------------- dispatch-count guarantees
+def test_captured_single_dispatch_per_step():
+    """Acceptance guard: ONE device dispatch per warm captured step, zero
+    imperative op dispatches (the loss_fn is not re-executed eagerly),
+    while the per-param escape hatch on the SAME net is O(num_params)."""
+    from mxnet_tpu.ndarray import ndarray as nd_mod
+    X, y = _data()
+    net_u = _build(X)
+    tr_u = gluon.Trainer(net_u.collect_params(), "sgd",
+                         {"learning_rate": 0.05}, fused=False)
+    with autograd.record():
+        L = _lossf(net_u(X), y).mean()
+    L.backward()
+    profiler.reset_dispatches()
+    tr_u.step(BATCH)
+    imperative = profiler.dispatch_count()
+    assert imperative >= len(net_u.collect_params())   # O(num_params)
+
+    net = _build(X)
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.05, "momentum": 0.9})
+    step = tr.capture(lambda a, b: _lossf(net(a), b).mean())
+    for _ in range(2):                   # warm: compile once
+        step(X, y)
+    calls = [0]
+    orig = nd_mod._apply
+
+    def counting_apply(*a, **kw):
+        calls[0] += 1
+        return orig(*a, **kw)
+
+    profiler.reset_dispatches()
+    nd_mod._apply = counting_apply
+    try:
+        step(X, y)
+    finally:
+        nd_mod._apply = orig
+    assert profiler.dispatch_count() == 1 < imperative, profiler.dumps()
+    assert profiler.jit_cache_stats() == (1, 0)   # warm: pure cache hit
+    assert calls[0] == 0                  # no eager op dispatch at all
+
+
+# --------------------------------------------------- cache / fallback / obs
+def test_cache_hit_miss_counters_and_reasons():
+    X, y = _data()
+    net = _build(X)
+    tr = gluon.Trainer(net.collect_params(), "sgd", {"learning_rate": 0.05})
+    step = tr.capture(lambda a, b: _lossf(net(a), b).mean())
+
+    def series(name):
+        snap = registry().snapshot().get(name, [])
+        return {tuple(sorted(s["labels"].items())): s["value"] for s in snap}
+
+    h0 = series("cachedop_cache_hits").get((), 0)
+    m0 = series("cachedop_cache_misses")
+    step(X, y)
+    step(X, y)
+    assert series("cachedop_cache_hits").get((), 0) == h0 + 1
+    m1 = series("cachedop_cache_misses")
+    assert m1.get((("reason", "first"),), 0) == \
+        m0.get((("reason", "first"),), 0) + 1
+    # shape change: a labelled miss, then the old shape still hits
+    rng = np.random.RandomState(1)
+    X2 = nd.array(rng.randn(4, DIM).astype(np.float32))
+    y2 = nd.array(rng.randint(0, CLS, 4).astype(np.float32))
+    step(X2, y2)
+    m2 = series("cachedop_cache_misses")
+    assert m2.get((("reason", "shape_change"),), 0) == \
+        m1.get((("reason", "shape_change"),), 0) + 1
+    assert step.cache_size == 2
+    h1 = series("cachedop_cache_hits").get((), 0)
+    step(X, y)
+    assert series("cachedop_cache_hits").get((), 0) == h1 + 1
+    # scale-mode flip: another labelled miss
+    amp.reset()
+    amp.init("float16")
+    try:
+        step(X, y)
+    finally:
+        amp.reset()
+    m3 = series("cachedop_cache_misses")
+    assert m3.get((("reason", "scale_mode"),), 0) == \
+        m2.get((("reason", "scale_mode"),), 0) + 1
+
+
+def test_fallback_transparent_and_labelled():
+    """A loss_fn that syncs to host cannot capture: the step still trains
+    (imperative fallback) and the reason lands on the counter."""
+    X, y = _data()
+    net = _build(X)
+    tr = gluon.Trainer(net.collect_params(), "sgd", {"learning_rate": 0.05})
+
+    def bad_loss(a, b):
+        L = _lossf(net(a), b).mean()
+        float(L.asnumpy())              # host sync inside the forward
+        return L
+
+    before = _weights(net)
+    step = tr.capture(bad_loss)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)
+        L = step(X, y)
+    assert step.last_fallback_reason.startswith("trace_error")
+    assert np.isfinite(float(L.asnumpy()))
+    after = _weights(net)
+    assert any(not np.array_equal(a, b) for a, b in zip(before, after))
+    snap = registry().snapshot()
+    reasons = {s["labels"].get("reason", "") for s in
+               snap.get("cachedop_fallbacks", [])}
+    assert any(r.startswith("trace_error") for r in reasons)
+
+
+def test_unsupported_optimizer_falls_back():
+    X, y = _data()
+    net = _build(X)
+    tr = gluon.Trainer(net.collect_params(), "dcasgd",
+                       {"learning_rate": 0.05})
+    step = tr.capture(lambda a, b: _lossf(net(a), b).mean())
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)
+        step(X, y)
+    assert step.last_fallback_reason == "optimizer"
+
+
+def test_captured_step_span_and_counters():
+    """Trainer.captured_step span is recorded when tracing, and the step
+    counter ticks like the imperative path."""
+    from mxnet_tpu.observability import tracer
+    X, y = _data()
+    net = _build(X)
+    tr = gluon.Trainer(net.collect_params(), "sgd", {"learning_rate": 0.05})
+    step = tr.capture(lambda a, b: _lossf(net(a), b).mean())
+    step(X, y)
+    snap = registry().snapshot()
+    steps0 = snap["trainer_steps"][0]["value"]
+    tracer.start()
+    try:
+        step(X, y)
+    finally:
+        tracer.stop()
+    names = {e.get("name") for e in
+             tracer.to_chrome_trace()["traceEvents"]}
+    tracer.clear()
+    assert "Trainer.captured_step" in names
+    snap = registry().snapshot()
+    assert snap["trainer_steps"][0]["value"] == steps0 + 1
+
+
+def test_jit_step_convenience_and_save_load_states(tmp_path):
+    """mx.jit_step == Trainer.capture; optimizer state updated by the
+    captured program round-trips through save_states/load_states."""
+    X, y = _data()
+    net = _build(X)
+    tr = gluon.Trainer(net.collect_params(), "adam", {"learning_rate": 0.05})
+    step = mx.jit_step(tr, lambda a, b: _lossf(net(a), b).mean())
+    assert isinstance(step, mx.CachedStep)
+    step(X, y)
+    step(X, y)
+    f = str(tmp_path / "states.bin")
+    tr.save_states(f)
+    tr2 = gluon.Trainer(net.collect_params(), "adam",
+                        {"learning_rate": 0.05})
+    tr2.load_states(f)
+    assert tr2._optimizer.num_update == tr._optimizer.num_update == 2
+    for k, v in tr._updater.states.items():
+        for a, b in zip(v, tr2._updater.states[k]):
+            np.testing.assert_allclose(np.asarray(a._data),
+                                       np.asarray(b._data))
+
+
+def test_lr_schedule_rides_without_retrace():
+    """Changing the learning rate between steps must NOT grow the capture
+    cache (lr is a weak-typed argument), and the schedule is honored."""
+    X, y = _data()
+    net_c = _build(X)
+    tr_c = gluon.Trainer(net_c.collect_params(), "sgd",
+                         {"learning_rate": 0.05})
+    step = tr_c.capture(lambda a, b: _lossf(net_c(a), b).mean())
+    step(X, y)
+    tr_c.set_learning_rate(0.005)
+    step(X, y)
+    assert step.cache_size == 1
+    net_i = _build(X)
+    tr_i = gluon.Trainer(net_i.collect_params(), "sgd",
+                         {"learning_rate": 0.05})
+    _train_imperative(net_i, tr_i, X, y, 1)
+    tr_i.set_learning_rate(0.005)
+    _train_imperative(net_i, tr_i, X, y, 1)
+    _assert_parity(_weights(net_c), _weights(net_i), tag="lr-schedule")
+
+
+def test_captured_parity_multi_precision():
+    """bf16 weights + fp32 master copies: the captured update stages the
+    master exactly like update_multi_precision."""
+    X, y = _data()
+
+    def run(captured):
+        net = _build(X)
+        net.cast("bfloat16")
+        Xb = X.astype("bfloat16")
+        tr = gluon.Trainer(net.collect_params(), "sgd",
+                           {"learning_rate": 0.05, "momentum": 0.9,
+                            "multi_precision": True})
+        if captured:
+            step = tr.capture(lambda a, b: _lossf(net(a), b).mean())
+            for _ in range(3):
+                step(Xb, y)
+                assert step.last_fallback_reason is None
+        else:
+            for _ in range(3):
+                with autograd.record():
+                    L = _lossf(net(Xb), y).mean()
+                L.backward()
+                tr.step(BATCH)
+        return _weights(net)
+
+    _assert_parity(run(True), run(False), rtol=2e-2, atol=1e-3, tag="mp")
+
+
+def test_captured_interleaves_with_imperative_steps():
+    """Captured and imperative steps share the optimizer state dict, so a
+    mixed loop equals an all-imperative loop."""
+    X, y = _data()
+    net_i = _build(X)
+    tr_i = gluon.Trainer(net_i.collect_params(), "adam",
+                         {"learning_rate": 0.05})
+    imp = _train_imperative(net_i, tr_i, X, y, 4)
+
+    net_m = _build(X)
+    tr_m = gluon.Trainer(net_m.collect_params(), "adam",
+                         {"learning_rate": 0.05})
+    step = tr_m.capture(lambda a, b: _lossf(net_m(a), b).mean())
+    for k in range(4):
+        if k % 2 == 0:
+            step(X, y)
+        else:
+            with autograd.record():
+                L = _lossf(net_m(X), y).mean()
+            L.backward()
+            tr_m.step(BATCH)
+    _assert_parity(_weights(net_m), imp, tag="mixed")
+
+
+def test_frozen_params_promoted_not_baked():
+    """Fine-tuning: params OUTSIDE the trainer's list (frozen backbone)
+    must become program inputs, not baked constants — set_data() on the
+    frozen subtree must be visible to later captured steps."""
+    X, y = _data()
+    mx.random.seed(0)
+    backbone = gluon.nn.Dense(16, activation="relu")
+    head = gluon.nn.Dense(CLS)
+    net = gluon.nn.Sequential()
+    net.add(backbone, head)
+    net.initialize(mx.init.Xavier())
+    net(X)
+    tr = gluon.Trainer(head.collect_params(), "sgd",   # head ONLY
+                       {"learning_rate": 0.05})
+    step = tr.capture(lambda a, b: _lossf(net(a), b).mean())
+    l0 = float(step(X, y).asnumpy())
+    assert step.last_fallback_reason is None
+    # zero the backbone: the captured loss must change immediately and
+    # match an eager forward over the SAME parameter values
+    for p in backbone.collect_params().values():
+        p.set_data(nd.zeros(p.shape))
+    expected = float(_lossf(net(X), y).mean().asnumpy())
+    l1 = float(step(X, y).asnumpy())
+    assert step.last_fallback_reason is None
+    assert step.cache_size == 1            # same executable, new input
+    assert abs(l1 - l0) > 1e-4
+    np.testing.assert_allclose(l1, expected, rtol=2e-4)

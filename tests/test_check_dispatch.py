@@ -1,0 +1,23 @@
+"""Captured-step dispatch budget wired into tier-1 (ISSUE 4 acceptance):
+a warm captured step must stay within <=2 trainer-issued dispatches and
+match the imperative path's numerics (same pattern as chaos_check /
+check_trace)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+import check_dispatch  # noqa: E402
+
+
+def test_captured_dispatch_budget_and_parity():
+    res = check_dispatch.run(steps=4)
+    assert res["ok"], res["errors"]
+    assert res["captured_dispatches_per_step"] <= res["budget"] == 2
+    # the captured step really is ONE launch in steady state
+    assert set(res["captured_per_step"]) == {1}
+    assert res["max_rel_dev"] < 1e-3
+
+
+def test_check_dispatch_cli_smoke():
+    assert callable(check_dispatch.main)
+    assert check_dispatch.DISPATCH_BUDGET == 2

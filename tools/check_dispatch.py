@@ -1,0 +1,145 @@
+#!/usr/bin/env python
+"""Captured-step dispatch-budget checker (ISSUE 4 acceptance; same tier-1
+wiring pattern as chaos_check/check_trace).
+
+Trains a small MLP N steps twice — once through the captured one-
+executable step (`Trainer.capture`) and once through the imperative
+record/backward/step() loop — asserting that
+
+  * a warm captured step stays within the dispatch budget (<= 2
+    trainer-issued device dispatches per step; in practice exactly 1,
+    the `captured_step` launch),
+  * the captured step never silently falls back to the imperative path,
+  * the capture cache compiles ONCE (every warm step is a jit-cache hit),
+  * final parameters MATCH the imperative run to tight tolerance.
+
+Standalone:
+
+    JAX_PLATFORMS=cpu python tools/check_dispatch.py [--steps N] [--budget B]
+
+exit 0 = within budget + parity, 1 = violation (details on stderr).
+Prints one JSON line with the measured numbers on stdout.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+DEFAULT_STEPS = 5
+DISPATCH_BUDGET = 2
+
+
+def run(steps=DEFAULT_STEPS, budget=DISPATCH_BUDGET):
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, gluon, nd, profiler
+
+    rng = np.random.RandomState(0)
+    X = nd.array(rng.randn(16, 32).astype(np.float32))
+    y = nd.array(rng.randint(0, 8, 16).astype(np.float32))
+    lossf = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    def build():
+        mx.random.seed(0)
+        net = gluon.nn.Sequential()
+        net.add(gluon.nn.Dense(32, activation="relu"),
+                gluon.nn.Dense(32, activation="relu"),
+                gluon.nn.Dense(8))
+        net.initialize(mx.init.Xavier())
+        net(X)
+        return net
+
+    errors = []
+
+    # -- captured ----------------------------------------------------------
+    net_c = build()
+    tr_c = gluon.Trainer(net_c.collect_params(), "sgd",
+                         {"learning_rate": 0.05, "momentum": 0.9})
+    step = tr_c.capture(lambda a, b: lossf(net_c(a), b).mean())
+    step(X, y)                              # compile
+    per_step = []
+    for _ in range(steps):
+        profiler.reset_dispatches()
+        step(X, y)
+        per_step.append(profiler.dispatch_count())
+        if step.last_fallback_reason is not None:
+            errors.append(f"captured step fell back: "
+                          f"{step.last_fallback_reason}")
+    worst = max(per_step)
+    if worst > budget:
+        errors.append(f"captured dispatch budget exceeded: {worst}/step "
+                      f"(budget {budget}; per-step {per_step})")
+    if step.cache_size != 1:
+        errors.append(f"capture cache grew to {step.cache_size} entries "
+                      f"for a fixed-shape loop (expected 1)")
+
+    # -- imperative twin ---------------------------------------------------
+    net_i = build()
+    tr_i = gluon.Trainer(net_i.collect_params(), "sgd",
+                         {"learning_rate": 0.05, "momentum": 0.9})
+    with autograd.record():
+        L = lossf(net_i(X), y).mean()
+    L.backward()
+    tr_i.step(16)                           # warm the fused-kernel cache
+    imp_per_step = None
+    for _ in range(steps):
+        with autograd.record():
+            L = lossf(net_i(X), y).mean()
+        L.backward()
+        profiler.reset_dispatches()
+        tr_i.step(16)
+        imp_per_step = profiler.dispatch_count()
+
+    # both nets have now taken exactly steps+1 updates
+    max_dev = 0.0
+    for pc, pi in zip(net_c.collect_params().values(),
+                      net_i.collect_params().values()):
+        a, b = pc.data().asnumpy(), pi.data().asnumpy()
+        dev = float(np.max(np.abs(a - b) / (np.abs(b) + 1e-6)))
+        max_dev = max(max_dev, dev)
+        if not np.allclose(a, b, rtol=1e-4, atol=1e-6):
+            errors.append(f"parity violation on {pc.name}: "
+                          f"max rel dev {dev:.2e}")
+            break
+
+    return {
+        "steps": steps,
+        "captured_dispatches_per_step": worst,
+        "captured_per_step": per_step,
+        "imperative_dispatches_per_step": imp_per_step,
+        "budget": budget,
+        "max_rel_dev": max_dev,
+        "errors": errors,
+        "ok": not errors,
+    }
+
+
+def main(argv=None):
+    argv = list(sys.argv[1:] if argv is None else argv)
+    steps, budget = DEFAULT_STEPS, DISPATCH_BUDGET
+    if "--steps" in argv:
+        steps = int(argv[argv.index("--steps") + 1])
+    if "--budget" in argv:
+        budget = int(argv[argv.index("--budget") + 1])
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    res = run(steps=steps, budget=budget)
+    print(json.dumps(res))
+    for err in res["errors"]:
+        print(f"check_dispatch: {err}", file=sys.stderr)
+    if res["errors"]:
+        print("check_dispatch: FAIL", file=sys.stderr)
+        return 1
+    print(f"check_dispatch: OK ({res['captured_dispatches_per_step']} "
+          f"dispatch/step captured vs "
+          f"{res['imperative_dispatches_per_step']} imperative)",
+          file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
