@@ -72,7 +72,22 @@ def supervise() -> int:
     probe live -> run the full worker once (fresh process, fresh PJRT
     client); salvage its stdout even if it wedges during teardown. A
     worker that lands no JSON (tunnel flapped mid-run, UNAVAILABLE at
-    init) sends us back to probing — the window may reopen."""
+    init) sends us back to probing — the window may reopen.
+
+    Output contract: EXACTLY ONE JSON line on stdout on every exit path —
+    the worker's measurement on success, else `{"ok": false, "reason":
+    ...}` (`tunnel_dead` when the probe deadline exhausts,
+    `supervisor_error` on an unexpected crash) so the driver's one-line
+    parse never lands on nothing (BENCH_r05 had `parsed: null`)."""
+    try:
+        return _supervise_impl()
+    except Exception as e:
+        print(json.dumps({"ok": False, "reason": "supervisor_error",
+                          "error": repr(e)}), flush=True)
+        return 1
+
+
+def _supervise_impl() -> int:
     argv = [a for a in sys.argv[1:] if a != "--worker"]
     cmd = [sys.executable, os.path.abspath(__file__), "--worker", *argv]
     deadline = _deadline_s()
@@ -129,6 +144,9 @@ def supervise() -> int:
         time.sleep(5)  # brief pause, then hunt for the next window
     print(f"[bench] no measurement within {deadline:.0f}s "
           f"({n_probe} probes, {n_worker} worker runs)", file=sys.stderr)
+    print(json.dumps({"ok": False, "reason": "tunnel_dead",
+                      "probes": n_probe, "worker_runs": n_worker,
+                      "deadline_s": deadline}), flush=True)
     return 1
 
 
@@ -354,6 +372,8 @@ def main():
     extra_measures = []
     if os.environ.get("BENCH_MLP") == "1":
         extra_measures.append(("bench_mlp", "measure"))
+    if os.environ.get("BENCH_PREFETCH") == "1":
+        extra_measures.append(("bench_mlp", "measure_prefetch"))
     if os.environ.get("BENCH_INT8") == "1":
         extra_measures.append(("bench_int8", "measure"))
     if os.environ.get("BENCH_NMT") == "1":

@@ -24,6 +24,9 @@ Standalone: `python bench_mlp.py` prints ONE JSON line.
 `--trace [path]` additionally captures a Chrome-trace of a few training
 steps (mx.profiler + observability tracer; open in Perfetto) and reports
 the tracer's overhead against an untraced run of the same loop.
+`--prefetch` measures the input pipeline instead: host-prefetch vs
+device-resident prefetch feeding a captured step on an input-bound
+configuration (ISSUE 5; also via BENCH_PREFETCH=1 in bench.py).
 """
 from __future__ import annotations
 
@@ -265,16 +268,135 @@ def measure_captured(on_result=None):
     return res
 
 
+def measure_prefetch(on_result=None):
+    """The `--prefetch` mode (ISSUE 5): steps/s of a warm captured step
+    fed by (a) the host-prefetch DataLoader baseline and (b) the
+    device-resident prefetcher (`DataLoader(prefetch_to_device=...)`) on
+    an INPUT-BOUND configuration — per-sample host augmentation makes the
+    pipeline, not the tiny MLP step, the bottleneck. Reports the
+    starvation count (input-bound vs compute-bound classification) and
+    synchronous-H2D per step for both paths; runs over the 'ici' mesh
+    when >= 2 devices are visible so the sharded per-step placement is
+    what the device path eliminates."""
+    import jax
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, nd
+    from mxnet_tpu.gluon.data import ArrayDataset, DataLoader
+    from mxnet_tpu.observability import registry
+
+    on_tpu = jax.default_backend() == "tpu"
+    batch = 512 if on_tpu else 256
+    n_steps = 30 if on_tpu else 8
+    rng = np.random.RandomState(0)
+    N = batch * n_steps
+    Xh = rng.randn(N, 784).astype(np.float32)
+    yh = rng.randint(0, 10, N).astype(np.float32)
+
+    def aug(x, y):
+        # host augmentation heavy enough to input-bind the small step
+        out = x
+        for k in range(3):
+            out = np.tanh(out * 1.01) + 0.001 * np.roll(out, k + 1)
+        return out.astype(np.float32), y
+    ds = ArrayDataset(Xh, yh).transform(aug)
+
+    lossf = gluon.loss.SoftmaxCrossEntropyLoss()
+    mx.random.seed(0)
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(512, activation="relu"),
+            gluon.nn.Dense(256, activation="relu"),
+            gluon.nn.Dense(10))
+    net.initialize(mx.init.Xavier())
+    net(nd.array(Xh[:batch]))
+
+    on_mesh = len(jax.devices()) >= 2
+    if on_mesh:
+        from mxnet_tpu.parallel.mesh import make_mesh
+        tr = gluon.Trainer(net.collect_params(), "sgd",
+                           {"learning_rate": 0.05, "momentum": 0.9},
+                           kvstore="ici")
+        tr._kvstore.set_mesh(make_mesh({"dp": 2}))
+        target = tr._kvstore
+    else:
+        tr = gluon.Trainer(net.collect_params(), "sgd",
+                           {"learning_rate": 0.05, "momentum": 0.9})
+        target = True
+    step = tr.capture(lambda a, b: lossf(net(a), b).mean())
+    step(nd.array(Xh[:batch]), nd.array(yh[:batch]))      # compile
+
+    sync = registry().counter("prefetch_h2d_sync")
+    starved = registry().counter("prefetch_starved")
+
+    def run(loader):
+        sync0, starved0, n = sync.value, starved.value, 0
+        t0 = time.monotonic()
+        for xb, yb in loader:
+            L = step(xb, yb)
+            n += 1
+        float(L.asnumpy())
+        dt = time.monotonic() - t0
+        return (n / dt, (sync.value - sync0) / max(n, 1),
+                starved.value - starved0, n)
+
+    mk = dict(batch_size=batch, last_batch="discard", prefetch=4)
+    host_steps_s, host_sync, _, n_host = run(DataLoader(ds, **mk))
+    dev_steps_s, dev_sync, starved_steps, n_dev = run(
+        DataLoader(ds, prefetch_to_device=target, **mk))
+    input_bound = starved_steps >= n_dev / 2
+
+    # the global batch shards over the dp=2 mesh, so per-chip samples/s
+    # is the global rate over the participating devices
+    n_chips = 2 if on_mesh else 1
+    res = {
+        "metric": "prefetch_input_pipeline",
+        "value": round(dev_steps_s * batch / n_chips, 1),
+        "unit": "samples/sec/chip",
+        "devices": n_chips,
+        "host_steps_s": round(host_steps_s, 3),
+        "device_steps_s": round(dev_steps_s, 3),
+        "device_vs_host": round(dev_steps_s / host_steps_s, 3),
+        "sync_h2d_per_step_host": round(host_sync, 2),
+        "sync_h2d_per_step_device": round(dev_sync, 2),
+        "starved_steps": int(starved_steps),
+        "steps": int(n_dev),
+        "input_bound": bool(input_bound),
+        "mesh": bool(on_mesh),
+    }
+    print(f"[bench_mlp] prefetch: host {host_steps_s:.2f} steps/s "
+          f"({host_sync:.1f} sync H2D/step) -> device "
+          f"{dev_steps_s:.2f} steps/s ({dev_sync:.1f} sync H2D/step, "
+          f"{res['device_vs_host']}x); {starved_steps}/{n_dev} steps "
+          f"starved -> {'INPUT' if input_bound else 'COMPUTE'}-bound",
+          file=sys.stderr)
+    if on_result is not None:
+        on_result(res)
+    return res
+
+
 def main():
+    args = sys.argv[1:]
+    # --prefetch wants >= 2 devices so the mesh placement path is what's
+    # measured; on a CPU-only run fork the host platform BEFORE any jax
+    # import (no-op if something already imported jax)
+    if "--prefetch" in args and "jax" not in sys.modules \
+            and os.environ.get("JAX_PLATFORMS", "") == "cpu" \
+            and "xla_force_host_platform_device_count" not in \
+            os.environ.get("XLA_FLAGS", ""):
+        os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                                   " --xla_force_host_platform_device_count=2")
     # honor JAX_PLATFORMS=cpu despite the axon sitecustomize (same dance
     # as bench.py — jax.config wins if set before backend init)
     if os.environ.get("JAX_PLATFORMS") == "cpu":
         import jax
         jax.config.update("jax_platforms", "cpu")
     trace = None
-    args = sys.argv[1:]
     if "--captured" in args:
         print(json.dumps(measure_captured()))
+        return
+    if "--prefetch" in args:
+        print(json.dumps(measure_prefetch()))
         return
     if "--trace" in args:
         i = args.index("--trace")

@@ -72,6 +72,8 @@ from . import checkpoint
 from . import parallel
 from . import models
 from . import contrib
+from . import prefetch
+from .prefetch import DevicePrefetcher
 from . import cachedop
 from .cachedop import jit_step, CachedStep
 from .util import waitall

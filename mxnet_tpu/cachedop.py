@@ -42,6 +42,12 @@ replica only ever touches its shard). Eligible = elementwise update rule
 ineligible parameters take the replicated psum+update path in the same
 executable.
 
+Input interplay (mxnet_tpu/prefetch.py): a batch staged by the device
+prefetcher with this step's exact mesh sharding enters the executable
+with NO second placement; host batches pay a counted synchronous
+transfer (`prefetch_h2d_sync`), and device-committed batches in a
+different layout reshard with `cachedop_fallbacks{reason=resharded_input}`.
+
 Reliability interplay (docs/RELIABILITY.md): captured steps still honor
 the step watchdog (`MXTPU_STEP_TIMEOUT_MS`) and the `grad.nan` fault
 point — the injection multiplies the in-graph gradients by a NaN poison
@@ -192,8 +198,21 @@ class CachedStep:
         return self._call_impl(batch, batch_size)
 
     def _call_impl(self, batch, batch_size):
-        batch_nd = [b if isinstance(b, NDArray) else NDArray(jnp.asarray(b))
-                    for b in batch]
+        from . import prefetch as _prefetch_mod
+        batch_nd = []
+        for b in batch:
+            if isinstance(b, NDArray):
+                batch_nd.append(b)
+                continue
+            arr = jnp.asarray(b)
+            if not isinstance(b, jax.Array):
+                # a HOST batch converted inside the step dispatch is a
+                # synchronous critical-path transfer — the device
+                # prefetcher (mxnet_tpu/prefetch.py) exists to make this
+                # count zero on warm steps
+                _prefetch_mod.record_sync_h2d(
+                    int(arr.size) * jnp.dtype(arr.dtype).itemsize)
+            batch_nd.append(NDArray(arr))
         if batch_size is None:
             if not batch_nd or batch_nd[0].ndim == 0:
                 raise MXNetError("capture: pass batch_size= when the first "
@@ -648,10 +667,30 @@ class CachedStep:
         state_vals = [tuple(s._data for s in sv) for sv in state_nds]
         sh = meta.get("shardings")
         if sh is not None:
-            batch_vals, diff_vals, nondiff_vals, state_vals, rng = \
-                jax.device_put(
-                    (batch_vals, diff_vals, nondiff_vals, state_vals, rng),
-                    (sh[0], sh[1], sh[2], sh[3], sh[4]))
+            from . import prefetch as _prefetch_mod
+            # Batch placement: a device-prefetched batch already carries
+            # the step's exact NamedSharding — use it as-is (zero-copy,
+            # no critical-path H2D). Anything else pays a synchronous
+            # per-step placement here (counted, so check_dispatch can
+            # assert zero with the prefetcher active); a batch that is
+            # device-COMMITTED but in a different layout additionally
+            # records cachedop_fallbacks{reason=resharded_input} — the
+            # producer staged it, just not where this step runs.
+            staged = []
+            for v, tgt in zip(batch_vals, sh[0]):
+                if getattr(v, "sharding", None) == tgt:
+                    staged.append(v)
+                    continue
+                if getattr(v, "committed", False):
+                    _fallback("resharded_input")
+                _prefetch_mod.record_sync_h2d(
+                    int(v.size) * jnp.dtype(v.dtype).itemsize)
+                staged.append(jax.device_put(v, tgt))
+            batch_vals = staged
+            # params/state/rng: no-ops once mesh-resident (first step only)
+            diff_vals, nondiff_vals, state_vals, rng = jax.device_put(
+                (diff_vals, nondiff_vals, state_vals, rng),
+                (sh[1], sh[2], sh[3], sh[4]))
             # frozen nondiff params broadcast onto the mesh ONCE: remember
             # the mesh-resident copy so later steps skip the transfer
             for j, p in enumerate(meta["nondiff"]):

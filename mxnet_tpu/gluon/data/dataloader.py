@@ -6,8 +6,21 @@ engine's threadpool (numpy staging, GIL released inside numpy/jax) and
 prefetched ahead of consumption, overlapping host batching + H2D transfer
 with device compute — the same pipeline role as the reference's
 multi-worker loader, without pickling overhead.
+
+Device mode (`prefetch_to_device=`): batches additionally stage through a
+`mxnet_tpu.prefetch.DevicePrefetcher` — double-buffered engine tasks that
+issue the committed (optionally mesh-sharded) `jax.device_put` while the
+previous step computes, so a captured step (`Trainer.capture`) performs
+zero synchronous H2D on its critical path. Pass True (default device), a
+Context/device, a Mesh, or a KVStore/Trainer/CachedStep to match a
+captured step's sharding. `pin_memory=True` maps onto this staging path
+(the TPU runtime has no pinned-host allocator; a one-time warning
+documents the mapping — see docs/PERFORMANCE.md, "The input pipeline").
 """
 from __future__ import annotations
+
+import warnings
+from collections import deque
 
 import numpy as np
 
@@ -30,11 +43,26 @@ def default_batchify_fn(data):
     return array(arr)
 
 
+_PIN_MEMORY_WARNED = False
+
+
+def _warn_pin_memory_once():
+    global _PIN_MEMORY_WARNED
+    if not _PIN_MEMORY_WARNED:
+        _PIN_MEMORY_WARNED = True
+        warnings.warn(
+            "DataLoader(pin_memory=True): the TPU runtime has no pinned-"
+            "host allocator — mapping it to prefetch_to_device staging "
+            "(engine-prefetched async device_put; docs/PERFORMANCE.md "
+            "'The input pipeline'). Pass prefetch_to_device=... "
+            "explicitly to silence this.", UserWarning, stacklevel=3)
+
+
 class DataLoader:
     def __init__(self, dataset, batch_size=None, shuffle=False, sampler=None,
                  last_batch=None, batch_sampler=None, batchify_fn=None,
                  num_workers=0, pin_memory=False, prefetch=None,
-                 thread_pool=False, timeout=120):
+                 thread_pool=False, timeout=120, prefetch_to_device=None):
         self._dataset = dataset
         if batch_sampler is None:
             if batch_size is None:
@@ -51,6 +79,14 @@ class DataLoader:
         self._num_workers = num_workers
         self._prefetch = max(0, prefetch if prefetch is not None
                              else 2 * max(num_workers, 1))
+        if pin_memory and prefetch_to_device is None:
+            # reference parity: accepted, not ignored — pinning exists to
+            # make H2D async, and the staging-slot path IS that here.
+            # An EXPLICIT prefetch_to_device=False stays on the host path
+            # (the documented opt-out).
+            _warn_pin_memory_once()
+            prefetch_to_device = True
+        self._prefetch_to_device = prefetch_to_device
 
     def __len__(self):
         return len(self._batch_sampler)
@@ -58,13 +94,15 @@ class DataLoader:
     def _make_batch(self, indices):
         return self._batchify_fn([self._dataset[i] for i in indices])
 
-    def __iter__(self):
+    def _host_iter(self):
+        """Host-batch pipeline: up to `prefetch` batchify tasks in flight
+        on the engine pool. Abandoning the generator mid-epoch (early
+        break / GC) cancels queued tasks and no-ops in-flight ones — an
+        abandoned epoch must not keep consuming the dataset."""
         if self._prefetch == 0:
-            for indices in self._batch_sampler:
-                yield self._make_batch(indices)
+            yield from self._plain_iter()
             return
-        # pipelined prefetch through the engine threadpool
-        from collections import deque
+        state = {"closed": False}
         pending = deque()
         it = iter(self._batch_sampler)
 
@@ -73,13 +111,54 @@ class DataLoader:
                 indices = next(it)
             except StopIteration:
                 return False
-            pending.append(engine.push(lambda idx=indices: self._make_batch(idx)))
+
+            def make_batch(idx=indices):
+                if state["closed"]:
+                    return None
+                return self._make_batch(idx)
+            pending.append(engine.push(make_batch))
             return True
 
-        for _ in range(self._prefetch):
-            if not submit():
-                break
-        while pending:
-            fut = pending.popleft()
-            submit()
-            yield fut.result()
+        try:
+            for _ in range(self._prefetch):
+                if not submit():
+                    break
+            while pending:
+                fut = pending.popleft()
+                submit()
+                yield fut.result()
+        finally:
+            state["closed"] = True
+            if not engine.native_engine_loaded():
+                for fut in pending:
+                    fut.cancel()
+            pending.clear()
+
+    def _plain_iter(self):
+        """Unpipelined batchify (also the prefetch=0 host path): runs in
+        whichever thread iterates it — the consumer, or a staging task."""
+        for indices in self._batch_sampler:
+            yield self._make_batch(indices)
+
+    def _device_iter(self):
+        """Device pipeline: the host-batch generator above feeds a
+        DevicePrefetcher whose staging slots overlap the committed
+        (mesh-sharded) device_put with the consumer's compute.
+
+        Handing the loader itself to DevicePrefetcher routes the
+        engine-backed host generator through the global blocking-slot
+        ledger (mxnet_tpu/prefetch.py): at least one pool worker stays
+        free across every concurrent device pipeline, and a pipeline
+        granted no slots — 1-worker engine, workers already spoken for,
+        or prefetch=0 — batchifies inline in its staging task instead."""
+        from ...prefetch import DevicePrefetcher
+        pf = DevicePrefetcher(self, device=self._prefetch_to_device)
+        try:
+            yield from pf
+        finally:
+            pf.close()
+
+    def __iter__(self):
+        if self._prefetch_to_device not in (None, False):
+            return self._device_iter()
+        return self._host_iter()

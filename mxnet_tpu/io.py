@@ -198,26 +198,80 @@ class ResizeIter(DataIter):
 
 class PrefetchingIter(DataIter):
     """Threaded prefetch wrapper (reference: PrefetchingIter) driven by the
-    execution engine's threadpool."""
+    execution engine's threadpool.
 
-    def __init__(self, iters, rename_data=None, rename_label=None):
+    `prefetch_to_device=` additionally stages each fetched DataBatch onto
+    a committed device (or mesh sharding) INSIDE the prefetch task, so
+    the consumer's step dispatch performs no synchronous H2D — same
+    placement targets as `DataLoader(prefetch_to_device=...)` (see
+    mxnet_tpu/prefetch.py and docs/PERFORMANCE.md, "The input pipeline").
+    `close()` (also `__del__`) drops the in-flight fetch: abandoning the
+    iterator mid-epoch must not leave engine work running."""
+
+    def __init__(self, iters, rename_data=None, rename_label=None,
+                 prefetch_to_device=None):
         iters = _as_list(iters)
         if len(iters) != 1:
             raise MXNetError("PrefetchingIter supports one backing iter")
         super().__init__(iters[0].batch_size)
         self.iter = iters[0]
+        self._placement = None
+        if prefetch_to_device not in (None, False):
+            from .prefetch import resolve_placement
+            self._placement = resolve_placement(prefetch_to_device)
+        # the fetch closure must NOT capture self (a queued task would
+        # keep the iterator alive and __del__ cleanup could never fire
+        # while the very fetch it should drop is pending) — shared
+        # mutable state rides in this dict instead, like prefetch._State
+        self._fstate = {"closed": False}
         self._pending = None
         self._submit()
 
+    @property
+    def _closed(self):
+        return self._fstate["closed"]
+
     def _submit(self):
         from . import engine
+        placement = self._placement
+        st = self._fstate
+        it = self.iter
 
-        def fetch():
+        def fetch(st=st, it=it, placement=placement):
+            if st["closed"]:
+                return None
             try:
-                return self.iter.next()
+                batch = it.next()
             except StopIteration:
                 return None
+            if placement is not None and not st["closed"]:
+                from .prefetch import place
+                batch.data = place(batch.data, placement)
+                if batch.label is not None:
+                    batch.label = place(batch.label, placement)
+            return batch
         self._pending = engine.push(fetch)
+
+    def close(self):
+        """Drop the in-flight prefetch (cancel when still queued, no-op
+        it otherwise). reset() reopens the iterator.
+
+        A fetch that could not be cancelled stays referenced in
+        `_pending` so a later reset() DRAINS it before reopening —
+        discarding it would let the orphan race the new epoch's first
+        fetch over the freshly-reset backing iterator."""
+        self._fstate["closed"] = True
+        fut = self._pending
+        if fut is not None:
+            from . import engine
+            if not engine.native_engine_loaded() and fut.cancel():
+                self._pending = None      # never ran; nothing to drain
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
 
     @property
     def provide_data(self):
@@ -238,10 +292,13 @@ class PrefetchingIter(DataIter):
             except BaseException:
                 pass
         self._pending = None
+        self._fstate["closed"] = False  # close() is undone by a reset()
         self.iter.reset()
         self._submit()
 
     def next(self):
+        if self._closed:
+            raise StopIteration         # closed mid-epoch; reset() reopens
         if self._pending is None:       # recovering from a surfaced error
             self._submit()
         fut = self._pending

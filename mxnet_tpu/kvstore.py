@@ -524,6 +524,19 @@ class KVStore:
             return None
         return self._mesh, axis, n
 
+    def batch_sharding(self):
+        """The `NamedSharding` a device prefetcher should stage input
+        batches with so a captured step over this store consumes them
+        without a second placement: leading dim over the capture_spec
+        axis. None when capture_spec is None (single-device staging is
+        the right call then) — see mxnet_tpu/prefetch.py."""
+        spec = self.capture_spec()
+        if spec is None:
+            return None
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        mesh, axis, _ = spec
+        return NamedSharding(mesh, P(axis))
+
     def graph_allreduce(self, g, axis, size, mean=False):
         """In-graph psum over `axis` (trace-time only — must run inside a
         shard_map over this store's mesh). `mean` folds the 1/size of a

@@ -1,0 +1,428 @@
+"""Device-resident input pipeline: engine-driven double-buffered prefetch
+with async sharded H2D (reference capability: src/io/ the C++ prefetcher
+threads + gluon's worker-process loaders, re-landed on the dependency
+engine per the paper's scheduler split — "data prefetch" is host-engine
+work, on-device scheduling stays with XLA/PJRT).
+
+`DevicePrefetcher` wraps any batch iterable and keeps `depth` staging
+slots in flight: each slot is an engine task (`engine.push` with a
+per-slot write Var plus a shared source Var, so the race detector covers
+the pipeline) that pulls the next host batch, converts it, and issues a
+non-blocking `jax.device_put` onto its COMMITTED placement — a single
+device, or the mesh sharding a captured step (`Trainer.capture`) runs
+under. By the time the training loop asks for batch N+1, its transfer has
+been overlapping step N's compute; the step dispatch sees an array that
+already carries the right layout and performs ZERO synchronous H2D work
+(arXiv:1810.09868: keep the accelerator fed without host round-trips).
+
+Sharded placement (arXiv:2112.01075: place once, don't redistribute on
+device): a mesh-backed placement shards the LEADING dim over the mesh's
+first axis (`NamedSharding(mesh, P(axis))`) — exactly the in_spec the
+captured step compiles against — and falls back to mesh-replicated for
+leaves whose dim 0 does not divide the axis (scalars, odd label packs).
+Pass `capture_spec=` a KVStore / Trainer / CachedStep / (mesh, axis, n)
+tuple / Mesh and the prefetcher matches the step's layout automatically.
+
+Telemetry (docs/OBSERVABILITY.md):
+  prefetch_depth            gauge      batches staged or in flight
+  prefetch_batches          counter    batches delivered to the consumer
+  prefetch_starved          counter    consumer arrived before the head
+                                       slot was ready (input-bound step)
+  prefetch_h2d_bytes        histogram  bytes staged per batch
+  prefetch_h2d_seconds      histogram  staging (convert + put) latency
+  prefetch_h2d_sync         counter    SYNCHRONOUS critical-path
+                                       transfers (recorded by the step
+                                       dispatch, not by this module —
+                                       zero when the prefetcher feeds a
+                                       captured step with matching layout)
+"""
+from __future__ import annotations
+
+import time as _time
+from collections import deque
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import engine
+from .ndarray.ndarray import NDArray
+from .observability import tracer as _tracer
+from .observability import registry as _obs_registry
+
+__all__ = ["DevicePrefetcher", "resolve_placement", "place",
+           "record_sync_h2d", "sync_h2d_count", "DEFAULT_DEPTH"]
+
+# double-buffered by default: slot k stages batch N+1 while the step
+# consumes batch N; raise to 3 for triple buffering when step times are
+# jittery. The effective depth is clamped to engine workers - 1 (a
+# staging task may block on an engine-backed source, e.g. DataLoader's
+# batchify futures on the same pool — one worker must stay free or the
+# pipeline deadlocks on itself).
+DEFAULT_DEPTH = 2
+
+_reg = _obs_registry()
+_depth_gauge = _reg.gauge("prefetch_depth")
+_starved = _reg.counter("prefetch_starved")
+_batches_counter = _reg.counter("prefetch_batches")
+_h2d_bytes = _reg.histogram("prefetch_h2d_bytes", base=1.0)
+_h2d_seconds = _reg.histogram("prefetch_h2d_seconds")
+_sync_h2d = _reg.counter("prefetch_h2d_sync")
+_sync_h2d_bytes = _reg.counter("prefetch_h2d_sync_bytes")
+
+
+# ---- global accounting of staging slots that may BLOCK on engine work.
+# A staging task whose SOURCE is itself engine-backed (DataLoader's
+# pipelined batchify) blocks a pool worker while it waits on that future;
+# if such slots ever covered every worker, the batchify tasks they wait
+# on could never run (the Python fallback engine parks dep-waiting tasks
+# ON workers). Pipelines reserve their slots here so that across ALL
+# concurrently-active device pipelines at least one worker stays free;
+# a pipeline that gets 0 must feed staging from a non-engine (inline)
+# source instead — DataLoader._device_iter does exactly that.
+import threading as _threading  # noqa: E402
+
+_blocking_lock = _threading.Lock()
+_blocking_slots = 0
+
+
+def reserve_blocking_slots(want):
+    """Reserve up to `want` staging slots for a pipeline whose source
+    blocks on engine futures. Returns the number granted (possibly 0 —
+    use an inline source then). Pair with `release_blocking_slots`."""
+    global _blocking_slots
+    with _blocking_lock:
+        avail = max(0, engine.num_workers() - 1 - _blocking_slots)
+        got = min(max(0, int(want)), avail)
+        _blocking_slots += got
+        return got
+
+
+def release_blocking_slots(n):
+    global _blocking_slots
+    with _blocking_lock:
+        _blocking_slots = max(0, _blocking_slots - max(0, int(n)))
+
+
+# depth gauge: DELTA accounting (like engine._queue_delta) — with more
+# than one pipeline alive (train + eval loaders) last-write-wins set()
+# calls would corrupt each other's readings and a closing pipeline would
+# zero the track out from under a live one
+_depth_total = 0
+
+
+def _depth_delta(d):
+    global _depth_total
+    with _blocking_lock:
+        _depth_total += d
+        n = _depth_total
+    _depth_gauge.set(n)
+    if _tracer.ACTIVE:
+        # counter track: input-pipeline depth is visible IN the step
+        # trace next to engine_queue_depth (starvation shows as the
+        # track pinning to 0 while steps run)
+        _tracer.counter("prefetch_depth", n)
+
+
+def record_sync_h2d(nbytes=0):
+    """Account one SYNCHRONOUS host->device transfer on the step's
+    critical path (a batch arrived without its target layout and had to
+    be converted/placed inside the dispatch). The captured step
+    (cachedop.py) calls this; tools/check_dispatch.py asserts the count
+    stays ZERO on warm steps when a DevicePrefetcher feeds the loop."""
+    _sync_h2d.inc()
+    _sync_h2d_bytes.inc(int(nbytes))
+
+
+def sync_h2d_count():
+    """Synchronous critical-path H2D transfers since process start (or the
+    registry's last reset)."""
+    return _sync_h2d.value
+
+
+def _spec_to_sharding(mesh, axis):
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    return NamedSharding(mesh, P(axis))
+
+
+def resolve_placement(target):
+    """Normalise a placement target into what `place` consumes — a
+    concrete `jax.Device` (committed single-device staging) or a
+    `NamedSharding` (leading-dim sharded over a mesh axis):
+
+      True                      -> default device
+      Context / jax.Device      -> that device
+      Mesh                      -> P(first axis) over it
+      (mesh, axis, n)           -> P(axis) — a kvstore `capture_spec()`
+      KVStore                   -> its capture_spec (default device when
+                                   the store has no multi-device mesh)
+      CachedStep / Trainer      -> their kvstore's capture_spec
+      None / False              -> None (no device staging)
+    """
+    if target is None or target is False:
+        return None
+    if target is True:
+        return jax.devices()[0]
+    if isinstance(target, jax.Device):
+        return target
+    from .context import Context
+    if isinstance(target, Context):
+        return target.jax_device
+    if isinstance(target, str):
+        return Context(target).jax_device
+    from jax.sharding import Mesh
+    if isinstance(target, Mesh):
+        return _spec_to_sharding(target, target.axis_names[0])
+    if isinstance(target, tuple) and len(target) == 3:
+        mesh, axis, _ = target
+        return _spec_to_sharding(mesh, axis)
+    # CachedStep / Trainer -> the kvstore underneath (a kvstore-less
+    # Trainer degrades to default-device staging, same as a meshless
+    # store — the docstring's "default device" promise)
+    trainer = getattr(target, "_trainer", target)
+    if hasattr(trainer, "_kvstore"):
+        kv = trainer._kvstore
+        if kv is None:
+            return jax.devices()[0]
+        target = kv
+    if hasattr(target, "batch_sharding"):
+        # the store's batch_sharding() is THE source of truth for "the
+        # sharding a captured step's batches want" — never re-derive it
+        sharding = target.batch_sharding()
+        return jax.devices()[0] if sharding is None else sharding
+    raise TypeError(f"cannot resolve a device/mesh placement from "
+                    f"{type(target).__name__!r}")
+
+
+def _leaf_sharding(placement, ndim, shape):
+    """Per-leaf placement: mesh placements shard dim 0 when it divides
+    the axis, and replicate otherwise (scalars, non-divisible leaves) so
+    a mixed batch structure still stages in one pass."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    if isinstance(placement, NamedSharding) and len(placement.spec) \
+            and placement.spec[0] is not None:
+        axis = placement.spec[0]
+        n = int(placement.mesh.shape[axis])
+        if ndim == 0 or shape[0] % n:
+            return NamedSharding(placement.mesh, P())
+    return placement
+
+
+def place(batch, placement):
+    """Stage one batch: tree-map a non-blocking committed
+    `jax.device_put` over the leaves (NDArray leaves contribute their
+    device value; anything else converts via numpy first, float64
+    narrowing to float32 like `nd.array`). Returns the same structure
+    with NDArray leaves and records the H2D byte/latency histograms.
+    The transfer itself is asynchronous — this accounts the staging
+    (convert + enqueue) cost, which is what the consumer could ever have
+    blocked on."""
+    t0 = _time.perf_counter()
+    staged_bytes = [0]
+
+    def put(leaf):
+        data = leaf._data if isinstance(leaf, NDArray) else np.asarray(leaf)
+        if getattr(data, "dtype", None) == np.float64:
+            data = data.astype(np.float32)
+        sh = _leaf_sharding(placement, getattr(data, "ndim", 0),
+                            tuple(getattr(data, "shape", ())))
+        arr = jax.device_put(data, sh)
+        staged_bytes[0] += int(arr.size) * jnp.dtype(arr.dtype).itemsize
+        return NDArray(arr)
+
+    out = jax.tree_util.tree_map(
+        put, batch, is_leaf=lambda x: isinstance(x, NDArray))
+    _h2d_bytes.observe(staged_bytes[0])
+    _h2d_seconds.observe(_time.perf_counter() - t0)
+    return out
+
+
+# sentinels a staging task may return instead of a batch
+_EOF = object()       # the source iterator is exhausted
+_DROPPED = object()   # the prefetcher was closed before the task ran
+
+
+class _State:
+    """Mutable pipeline state shared between the consumer and the engine
+    tasks. Deliberately NOT the DevicePrefetcher itself: task closures
+    hold only this object, so dropping the prefetcher triggers __del__
+    cleanup even while tasks are queued."""
+    __slots__ = ("it", "closed", "exhausted")
+
+    def __init__(self, it):
+        self.it = it
+        self.closed = False
+        self.exhausted = False
+
+
+class DevicePrefetcher:
+    """Iterate `source`, returning batches already resident on the device
+    (or sharded over the mesh) — see the module docstring.
+
+        pf = DevicePrefetcher(loader, capture_spec=trainer._kvstore)
+        for xb, yb in pf:
+            loss = step(xb, yb)      # zero synchronous H2D on this path
+        pf.close()                   # (also: context manager / __del__)
+
+    `source` is any iterable of batches (NDArray / numpy / nested
+    tuples). `depth` staging slots run as engine tasks — write Vars per
+    slot plus a shared source Var serialise slot reuse and source
+    iteration, and put the whole pipeline under the engine race
+    detector. Abandoning the iterator cancels/drops pending work.
+
+    A source that itself blocks on engine futures needs workers to
+    spare: a DataLoader handed in directly participates in the
+    `reserve_blocking_slots` ledger exactly like
+    `DataLoader(prefetch_to_device=...)` (granted no slots, it
+    batchifies inline); any OTHER engine-backed iterable should be
+    wrapped the same way — reserve slots manually, or go through a
+    DataLoader."""
+
+    def __init__(self, source, depth=None, device=None, capture_spec=None):
+        target = capture_spec if capture_spec is not None else device
+        self._placement = resolve_placement(True if target is None
+                                            else target)
+        depth = DEFAULT_DEPTH if depth is None else int(depth)
+        self._reserved = 0
+        if hasattr(source, "_host_iter") and hasattr(source, "_plain_iter"):
+            # a DataLoader: its pipelined host path blocks staging tasks
+            # on engine futures — take slots from the global ledger (the
+            # class docstring's own example is DevicePrefetcher(loader))
+            if getattr(source, "_prefetch", 0):
+                self._reserved = reserve_blocking_slots(depth)
+            source = source._host_iter() if self._reserved \
+                else source._plain_iter()
+            depth = self._reserved or depth
+        self._depth = max(1, min(depth, max(1, engine.num_workers() - 1)))
+        self._state = _State(iter(source))
+        self._slot_vars = [engine.Var() for _ in range(self._depth)]
+        self._src_var = engine.Var()
+        self._pending = deque()
+        self._slot = 0
+        self._delivered = 0
+        for _ in range(self._depth):
+            self._submit()
+
+    # ------------------------------------------------------------ produce
+    def _submit(self):
+        st = self._state
+        if st.closed or st.exhausted:
+            return False
+        slot = self._slot
+        self._slot = (self._slot + 1) % self._depth
+        placement = self._placement
+
+        def prefetch_stage(st=st, placement=placement):
+            if st.closed:
+                return _DROPPED
+            try:
+                item = next(st.it)
+            except StopIteration:
+                st.exhausted = True
+                return _EOF
+            if st.closed:
+                return _DROPPED
+            if _tracer.ACTIVE:
+                with _tracer.span("prefetch:h2d", cat="data"):
+                    return place(item, placement)
+            return place(item, placement)
+
+        fut = engine.push(prefetch_stage,
+                          write_vars=(self._slot_vars[slot], self._src_var))
+        self._pending.append(fut)
+        _depth_delta(+1)
+        return True
+
+    # ------------------------------------------------------------ consume
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        while True:
+            if not self._pending:
+                raise StopIteration
+            fut = self._pending.popleft()
+            _depth_delta(-1)
+            was_ready = fut.done()
+            try:
+                res = fut.result()
+            except BaseException:
+                # a staging failure taints every in-flight slot (both
+                # engines propagate the root cause through the shared
+                # vars — the native one poisons them permanently): drop
+                # the queue, re-arm on FRESH vars, and surface the error
+                # exactly once (the engine also recorded it —
+                # engine.failures()); the pipeline continues on the
+                # next batch
+                self._drop_pending()
+                self._slot_vars = [engine.Var() for _ in range(self._depth)]
+                self._src_var = engine.Var()
+                self._slot = 0
+                for _ in range(self._depth):
+                    self._submit()
+                raise
+            if res is _EOF or res is _DROPPED:
+                continue          # drain trailing sentinel slots
+            if not was_ready and self._delivered >= self._depth:
+                # the accelerator got here first and the slot held a REAL
+                # batch: the step just blocked on input — the signature
+                # of an input-bound loop. EOF sentinels and the first
+                # `depth` batches (pipeline fill right after
+                # construction, not-ready by definition) don't count.
+                _starved.inc()
+            self._delivered += 1
+            _batches_counter.inc()
+            self._submit()
+            return res
+
+    next = __next__
+
+    @property
+    def depth(self):
+        return self._depth
+
+    @property
+    def in_flight(self):
+        """Slots currently staged or staging (the depth gauge's value)."""
+        return len(self._pending)
+
+    # ------------------------------------------------------------ cleanup
+    def _drop_pending(self):
+        native = engine.native_engine_loaded()
+        while self._pending:
+            fut = self._pending.popleft()
+            _depth_delta(-1)
+            if not native:
+                fut.cancel()
+
+    def close(self):
+        """Drop the pipeline: queued staging tasks are cancelled (Python
+        engine) or reduced to no-ops via the closed flag (native engine /
+        already-running tasks), and a generator source is closed — an
+        abandoned epoch must not keep consuming the dataset."""
+        st = self._state
+        if st.closed:
+            return
+        st.closed = True
+        self._drop_pending()
+        release_blocking_slots(self._reserved)
+        self._reserved = 0
+        it_close = getattr(st.it, "close", None)
+        if it_close is not None:
+            try:
+                it_close()
+            except Exception:
+                pass    # a worker may be mid-next() on the generator
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -111,11 +111,17 @@ def test_supervisor_takes_last_checkpoint_line(monkeypatch):
 def test_supervisor_dead_tunnel_returns_rc1_inside_deadline(monkeypatch):
     """Tunnel dead the whole window: rc=1 must come back (never a hang /
     driver-side rc=124), with probes spaced PROBE_SLEEP_S apart so the
-    deadline buys ~deadline/(probe+sleep) windows."""
+    deadline buys ~deadline/(probe+sleep) windows — AND the output
+    contract still holds: one parseable JSON line (`ok: false,
+    tunnel_dead`) so the driver's parse never lands on nothing (the
+    BENCH_r05 `parsed: null` failure)."""
     monkeypatch.setenv("BENCH_DEADLINE_S", "1200")
     rc, printed, n_probes = _run_supervise(
         monkeypatch, [False], [], tick=float(bench.PROBE_TIMEOUT_S))
-    assert rc == 1 and printed == []
+    assert rc == 1 and len(printed) == 1
+    rec = json.loads(printed[0])
+    assert rec["ok"] is False and rec["reason"] == "tunnel_dead"
+    assert rec["probes"] == n_probes and rec["worker_runs"] == 0
     # each dead cycle costs <= PROBE_TIMEOUT_S + PROBE_SLEEP_S = 135s
     # -> at least 8 windows inside 1200s (vs round 3's 3 blind attempts)
     assert n_probes >= 8
@@ -127,6 +133,29 @@ def test_supervisor_respects_env_deadline(monkeypatch):
         monkeypatch, [False], [], tick=float(bench.PROBE_TIMEOUT_S))
     assert rc == 1
     assert n_probes <= 2
+    assert json.loads(printed[-1])["reason"] == "tunnel_dead"
+
+
+def test_supervisor_emits_json_on_crash(monkeypatch):
+    """An unexpected supervisor crash (not a worker failure) must still
+    land the one-JSON-line contract: ok=false, reason=supervisor_error."""
+    printed = []
+    monkeypatch.setattr(bench, "probe_tunnel",
+                        lambda: (_ for _ in ()).throw(OSError("boom")))
+    real_print = print
+
+    def capture(*args, **kwargs):
+        if args and isinstance(args[0], str) and args[0].startswith("{"):
+            printed.append(args[0])
+        else:
+            real_print(*args, **{k: v for k, v in kwargs.items()
+                                 if k != "file"}, file=sys.stderr)
+    monkeypatch.setattr("builtins.print", capture)
+    rc = bench.supervise()
+    assert rc == 1 and len(printed) == 1
+    rec = json.loads(printed[0])
+    assert rec["ok"] is False and rec["reason"] == "supervisor_error"
+    assert "boom" in rec["error"]
 
 
 def test_probe_tunnel_timeout_is_dead(monkeypatch):

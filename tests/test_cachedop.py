@@ -522,3 +522,80 @@ def test_frozen_params_promoted_not_baked():
     assert step.cache_size == 1            # same executable, new input
     assert abs(l1 - l0) > 1e-4
     np.testing.assert_allclose(l1, expected, rtol=2e-4)
+
+
+# ------------------------------------------- device-resident input (ISSUE 5)
+def test_captured_step_accepts_prefetched_sharded_batches():
+    """A DevicePrefetcher staged with the step's capture_spec feeds the
+    captured mesh step with ZERO synchronous H2D on warm steps, no
+    fallback, and bitwise the numerics of the host-fed captured twin."""
+    from mxnet_tpu.prefetch import DevicePrefetcher
+    X, y = _data()
+    Xh, yh = X.asnumpy(), y.asnumpy()
+    mesh = make_mesh({"dp": 2})
+
+    def trainer_for(net):
+        tr = gluon.Trainer(net.collect_params(), "sgd",
+                           {"learning_rate": 0.05, "momentum": 0.9},
+                           kvstore="ici")
+        tr._kvstore.set_mesh(mesh)
+        return tr
+
+    # host-fed captured twin
+    net_h = _build(X)
+    host = _train_captured(net_h, trainer_for(net_h), X, y, 4)
+
+    # prefetched twin: identical batches arrive pre-sharded
+    net_p = _build(X)
+    tr_p = trainer_for(net_p)
+    step = tr_p.capture(lambda a, b: _lossf(net_p(a), b).mean())
+    step(X, y)                                  # compile (1st update)
+    sync = registry().counter("prefetch_h2d_sync")
+    pf = DevicePrefetcher(((Xh, yh) for _ in range(3)),
+                          capture_spec=tr_p._kvstore)
+    before = sync.value
+    for xb, yb in pf:
+        step(xb, yb)
+        assert step.last_fallback_reason is None
+    pf.close()
+    assert sync.value == before                  # zero critical-path H2D
+    assert step.cache_size == 1                  # no retrace either
+
+    # same 4 updates, same batches -> bitwise-identical parameters
+    for a, b in zip(_weights(net_p), host):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_resharded_input_counted_not_fallen_back():
+    """A device-COMMITTED batch in the WRONG layout still runs captured
+    (explicit reshard), but the mismatch is recorded on
+    cachedop_fallbacks{reason=resharded_input}."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    X, y = _data()
+    mesh = make_mesh({"dp": 2})
+    net = _build(X)
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.05}, kvstore="ici")
+    tr._kvstore.set_mesh(mesh)
+    step = tr.capture(lambda a, b: _lossf(net(a), b).mean())
+    step(X, y)                                   # compile
+    repl = NamedSharding(mesh, P())              # committed, NOT P('dp')
+    xr = nd.NDArray(jax.device_put(X._data, repl))
+    yr = nd.NDArray(jax.device_put(y._data, repl))
+    c = registry().counter("cachedop_fallbacks", reason="resharded_input")
+    before = c.value
+    step(xr, yr)
+    assert c.value - before == 2                 # both batch args resharded
+    assert step.last_fallback_reason is None     # captured path, not fallback
+    assert step.cache_size == 1
+
+
+def test_kvstore_batch_sharding_matches_capture_spec():
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    import mxnet_tpu as mx
+    kv = mx.kv.create("ici")
+    assert kv.batch_sharding() is None           # no mesh yet
+    mesh = make_mesh({"dp": 2})
+    kv.set_mesh(mesh)
+    assert kv.batch_sharding() == NamedSharding(mesh, P("dp"))

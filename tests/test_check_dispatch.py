@@ -16,6 +16,14 @@ def test_captured_dispatch_budget_and_parity():
     # the captured step really is ONE launch in steady state
     assert set(res["captured_per_step"]) == {1}
     assert res["max_rel_dev"] < 1e-3
+    # ISSUE 5: the warm-step budget also covers the input side — the
+    # device prefetcher makes synchronous H2D exactly zero, and the
+    # detector provably fires on the host-path control
+    assert res["prefetch_sync_h2d_per_step"] == 0
+    assert res["prefetch_detector_fires"] is True
+    # conftest forks 8 CPU devices, so the MESH placement path is what
+    # ran (the configuration where the per-step device_put used to live)
+    assert res["prefetch_mesh"] is True
 
 
 def test_check_dispatch_cli_smoke():

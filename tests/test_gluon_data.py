@@ -147,3 +147,289 @@ def test_image_list_dataset(tmp_path):
                            imglist=[[1.0, "imgs/im0.png"]])
     img2, label2 = ds2[0]
     assert label2 == 1.0 and img2.shape == (8, 8, 3)
+
+
+# ---------------------------------------------------------------------------
+# Device-resident input pipeline (ISSUE 5): DataLoader(prefetch_to_device=),
+# DevicePrefetcher metrics, pin_memory mapping, abandoned-epoch cleanup.
+# ---------------------------------------------------------------------------
+def _prefetch_snapshot():
+    from mxnet_tpu.observability import registry
+    return {k: v for k, v in registry().snapshot().items()
+            if k.startswith("prefetch")}
+
+
+def test_device_prefetch_parity_bitwise():
+    """Device-staged batches are BITWISE the host path's batches — the
+    prefetcher moves placement, never values."""
+    x = np.arange(120, dtype=np.float32).reshape(30, 4)
+    y = np.arange(30, dtype=np.float32)
+    ds = ArrayDataset(x, y)
+    host = [(a.asnumpy(), b.asnumpy())
+            for a, b in DataLoader(ds, batch_size=8)]
+    dev = list(DataLoader(ds, batch_size=8, prefetch_to_device=True))
+    assert len(host) == len(dev)
+    for (ha, hb), (da, db) in zip(host, dev):
+        np.testing.assert_array_equal(ha, da.asnumpy())
+        np.testing.assert_array_equal(hb, db.asnumpy())
+        # staged = COMMITTED placement (the point of the device mode)
+        assert da._data.committed and db._data.committed
+
+
+def test_device_prefetch_sharded_matches_mesh_layout():
+    """A mesh placement target stages batches with the captured step's
+    exact NamedSharding (leading dim over the axis), replicating leaves
+    whose dim 0 does not divide it."""
+    import jax
+    if len(jax.devices()) < 2:
+        pytest.skip("needs >= 2 devices")
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from mxnet_tpu.parallel.mesh import make_mesh
+    from mxnet_tpu.prefetch import DevicePrefetcher
+    mesh = make_mesh({"dp": 2})
+    xb = np.arange(48, dtype=np.float32).reshape(8, 6)
+    odd = np.arange(3, dtype=np.float32)          # 3 % 2 -> replicated
+    pf = DevicePrefetcher(iter([(xb, odd)]), capture_spec=mesh)
+    a, b = next(pf)
+    assert a._data.sharding == NamedSharding(mesh, P("dp"))
+    assert b._data.sharding == NamedSharding(mesh, P())
+    np.testing.assert_array_equal(a.asnumpy(), xb)
+    with pytest.raises(StopIteration):
+        next(pf)
+    pf.close()
+
+
+def test_device_prefetch_metrics_depth_bytes_batches():
+    from mxnet_tpu.observability import registry
+    reg = registry()
+    batches0 = reg.counter("prefetch_batches").value
+    h2d = reg.histogram("prefetch_h2d_bytes", base=1.0)
+    count0 = h2d.count
+    ds = ArrayDataset(np.ones((24, 5), np.float32))
+    dl = DataLoader(ds, batch_size=6, prefetch_to_device=True)
+    it = iter(dl)
+    first = next(it)
+    # depth gauge: staging slots are in flight while the epoch runs
+    assert reg.gauge("prefetch_depth").value >= 1
+    rest = list(it)
+    assert 1 + len(rest) == 4
+    assert reg.counter("prefetch_batches").value - batches0 == 4
+    assert h2d.count - count0 == 4
+    # 6*5 float32 = 120 bytes per batch staged
+    assert h2d.min <= 120 <= h2d.max
+
+
+def test_device_prefetch_starvation_counter():
+    """A slow producer + fast consumer is INPUT-BOUND: the consumer
+    arrives before the head slot is ready and the starvation counter
+    says so."""
+    import time
+    from mxnet_tpu.observability import registry
+    starved = registry().counter("prefetch_starved")
+
+    def slow(x):
+        time.sleep(0.05)
+        return x
+    ds = ArrayDataset(np.ones((8, 3), np.float32)).transform(slow)
+    before = starved.value
+    n = len(list(DataLoader(ds, batch_size=2, prefetch_to_device=True)))
+    assert n == 4
+    assert starved.value > before
+
+
+def test_dataloader_early_break_cancels_pending_prefetch():
+    """Abandoning the iterator mid-epoch (early break) must DROP queued
+    engine prefetch work — the dataset stops being consumed (the
+    satellite fix: previously the whole epoch kept batchifying)."""
+    from mxnet_tpu import engine
+    from mxnet_tpu.gluon.data.dataset import Dataset
+
+    class Counting(Dataset):
+        def __init__(self, n):
+            self.n = n
+            self.reads = 0
+
+        def __len__(self):
+            return self.n
+
+        def __getitem__(self, i):
+            self.reads += 1
+            return np.float32(i)
+
+    ds = Counting(400)
+    it = iter(DataLoader(ds, batch_size=4, prefetch=8))
+    next(it)
+    it.close()                    # generator close = the early-break path
+    engine.wait_for_all()         # in-flight tasks finish as no-ops
+    settled = ds.reads
+    assert settled < 400          # the epoch was NOT fully consumed
+    engine.wait_for_all()
+    assert ds.reads == settled    # ...and nothing keeps running after
+
+    # device mode: same contract through the DevicePrefetcher
+    ds2 = Counting(400)
+    it2 = iter(DataLoader(ds2, batch_size=4, prefetch=8,
+                          prefetch_to_device=True))
+    next(it2)
+    it2.close()
+    engine.wait_for_all()
+    settled2 = ds2.reads
+    assert settled2 < 400
+    engine.wait_for_all()
+    assert ds2.reads == settled2
+
+
+def test_prefetching_iter_close_drops_pending():
+    """PrefetchingIter.close()/__del__: the in-flight fetch is dropped and
+    the backing iter stops being consumed; reset() reopens."""
+    from mxnet_tpu import io as mio
+    from mxnet_tpu import engine
+
+    class CountingIter(mio.DataIter):
+        def __init__(self):
+            super().__init__(2)
+            self.calls = 0
+
+        def reset(self):
+            self.calls = 0
+
+        def next(self):
+            self.calls += 1
+            if self.calls > 100:
+                raise StopIteration
+            return mio.DataBatch([nd.array(np.ones((2, 3)))],
+                                 [nd.array(np.zeros(2))])
+
+    base = CountingIter()
+    pf = mio.PrefetchingIter(base)
+    pf.next()
+    pf.close()
+    engine.wait_for_all()
+    settled = base.calls
+    assert settled <= 3
+    with pytest.raises(StopIteration):
+        pf.next()                  # closed: no new work is queued
+    engine.wait_for_all()
+    assert base.calls == settled
+    pf.reset()                     # reopens for reuse
+    assert pf.next() is not None
+    pf.close()
+
+
+def test_prefetching_iter_device_mode():
+    from mxnet_tpu import io as mio
+    data = np.random.RandomState(0).rand(8, 4).astype(np.float32)
+    base = mio.NDArrayIter(data, np.arange(8).astype(np.float32),
+                           batch_size=4)
+    pf = mio.PrefetchingIter(base, prefetch_to_device=True)
+    batch = pf.next()
+    assert batch.data[0]._data.committed
+    np.testing.assert_array_equal(batch.data[0].asnumpy(), data[:4])
+    pf.close()
+
+
+def test_pin_memory_explicit_false_opts_out():
+    """prefetch_to_device=False is an explicit opt-out: pin_memory must
+    neither warn nor force device staging over it."""
+    import warnings as _w
+    from mxnet_tpu.gluon.data import dataloader as dl_mod
+    ds = ArrayDataset(np.ones((4, 2), np.float32))
+    prev = dl_mod._PIN_MEMORY_WARNED
+    dl_mod._PIN_MEMORY_WARNED = False
+    try:
+        with _w.catch_warnings():
+            _w.simplefilter("error")
+            dl = DataLoader(ds, batch_size=2, pin_memory=True,
+                            prefetch_to_device=False)
+        assert dl._prefetch_to_device is False
+    finally:
+        dl_mod._PIN_MEMORY_WARNED = prev
+
+
+def test_pin_memory_maps_to_device_prefetch_with_one_warning():
+    """pin_memory=True is not silently ignored anymore: it maps onto the
+    staging-slot path (one-time warning documents the mapping)."""
+    import warnings as _w
+    from mxnet_tpu.gluon.data import dataloader as dl_mod
+    ds = ArrayDataset(np.ones((8, 2), np.float32))
+    prev = dl_mod._PIN_MEMORY_WARNED
+    dl_mod._PIN_MEMORY_WARNED = False
+    try:
+        with pytest.warns(UserWarning, match="prefetch_to_device"):
+            dl = DataLoader(ds, batch_size=4, pin_memory=True)
+        assert dl._prefetch_to_device is True
+        for b in dl:
+            assert b._data.committed
+        with _w.catch_warnings():
+            _w.simplefilter("error")      # second construction: silent
+            DataLoader(ds, batch_size=4, pin_memory=True)
+    finally:
+        dl_mod._PIN_MEMORY_WARNED = prev
+
+
+def test_device_prefetch_surfaces_worker_error_and_continues():
+    """A staging error surfaces exactly once; the pipeline keeps going on
+    the following batch (same contract as PrefetchingIter)."""
+    from mxnet_tpu.prefetch import DevicePrefetcher
+
+    def gen():
+        yield np.ones((2, 2), np.float32)
+        raise ValueError("bad batch")
+
+    pf = DevicePrefetcher(gen(), depth=1)
+    first = next(pf)
+    assert first.shape == (2, 2)
+    with pytest.raises(ValueError, match="bad batch"):
+        next(pf)
+    with pytest.raises(StopIteration):
+        next(pf)
+    pf.close()
+
+
+def test_resolve_placement_trainer_without_kvstore():
+    """A kvstore-less Trainer is a documented placement target: it
+    degrades to default-device staging instead of raising."""
+    import jax
+    from mxnet_tpu.prefetch import resolve_placement
+    net = gluon.nn.Dense(2)
+    net.initialize()
+    net(nd.array(np.ones((2, 3), np.float32)))
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.1}, kvstore=None)
+    assert resolve_placement(tr) == jax.devices()[0]
+
+
+def test_concurrent_device_loaders_share_the_blocking_budget():
+    """Two interleaved device pipelines (train + eval) must not pin the
+    whole engine pool: the blocking-slot ledger grants at most
+    workers-1 slots ACROSS pipelines, and both epochs complete."""
+    from mxnet_tpu import engine
+    from mxnet_tpu import prefetch as pf_mod
+    x = np.arange(64, dtype=np.float32).reshape(16, 4)
+    ds = ArrayDataset(x)
+    a = iter(DataLoader(ds, batch_size=4, prefetch_to_device=True))
+    b = iter(DataLoader(ds, batch_size=4, prefetch_to_device=True))
+    got_a, got_b = next(a), next(b)            # both pipelines live at once
+    assert pf_mod._blocking_slots <= max(0, engine.num_workers() - 1)
+    ra = [got_a] + list(a)
+    rb = [got_b] + list(b)
+    assert len(ra) == len(rb) == 4
+    np.testing.assert_array_equal(ra[0].asnumpy(), rb[0].asnumpy())
+    assert pf_mod._blocking_slots == 0         # ledger drains with the epochs
+
+
+def test_prefetching_iter_close_then_reset_immediately():
+    """close() immediately followed by reset() (no drain in between):
+    the orphaned in-flight fetch must not race the new epoch — reset
+    drains it, and the reopened iterator yields the epoch's batches in
+    order with none lost."""
+    from mxnet_tpu import io as mio
+    data = np.arange(24, dtype=np.float32).reshape(12, 2)
+    base = mio.NDArrayIter(data, np.zeros(12, np.float32), batch_size=4)
+    pf = mio.PrefetchingIter(base)
+    pf.next()
+    pf.close()
+    pf.reset()                     # no engine.wait_for_all() on purpose
+    got = [pf.next().data[0].asnumpy() for _ in range(3)]
+    np.testing.assert_array_equal(np.concatenate(got), data)
+    pf.close()

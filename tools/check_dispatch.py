@@ -13,6 +13,15 @@ record/backward/step() loop — asserting that
   * the capture cache compiles ONCE (every warm step is a jit-cache hit),
   * final parameters MATCH the imperative run to tight tolerance.
 
+ISSUE 5 extension — the warm-step budget also covers the INPUT side:
+with the device prefetcher (`mxnet_tpu.prefetch.DevicePrefetcher`)
+feeding the captured step, a warm step must perform ZERO synchronous
+host->device transfers (the `prefetch_h2d_sync` counter stays flat),
+while a host-path control batch must trip the same detector (proving
+the zero is a measurement, not a dead counter). Runs over the 'ici'
+mesh when >= 2 devices are available (the sharded-placement path),
+single-device otherwise.
+
 Standalone:
 
     JAX_PLATFORMS=cpu python tools/check_dispatch.py [--steps N] [--budget B]
@@ -104,15 +113,94 @@ def run(steps=DEFAULT_STEPS, budget=DISPATCH_BUDGET):
                           f"max rel dev {dev:.2e}")
             break
 
-    return {
+    prefetch_res = _run_prefetch_phase(steps, errors)
+
+    res = {
         "steps": steps,
         "captured_dispatches_per_step": worst,
         "captured_per_step": per_step,
         "imperative_dispatches_per_step": imp_per_step,
         "budget": budget,
         "max_rel_dev": max_dev,
-        "errors": errors,
-        "ok": not errors,
+    }
+    res.update(prefetch_res)
+    res["errors"] = errors
+    res["ok"] = not errors
+    return res
+
+
+def _run_prefetch_phase(steps, errors):
+    """Zero-synchronous-H2D budget for the device-prefetched input path
+    (ISSUE 5): warm captured steps fed by a DevicePrefetcher must leave
+    the `prefetch_h2d_sync` counter flat; a host-path batch through the
+    same warm step must move it (detector liveness control)."""
+    import jax
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, nd
+    from mxnet_tpu.observability import registry
+    from mxnet_tpu.prefetch import DevicePrefetcher
+
+    sync = registry().counter("prefetch_h2d_sync")
+    rng = np.random.RandomState(1)
+    Xh = rng.randn(16, 32).astype(np.float32)
+    yh = rng.randint(0, 8, 16).astype(np.float32)
+    lossf = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    mx.random.seed(0)
+    net = gluon.nn.Sequential()
+    net.add(gluon.nn.Dense(32, activation="relu"), gluon.nn.Dense(8))
+    net.initialize(mx.init.Xavier())
+    net(nd.array(Xh))
+
+    on_mesh = len(jax.devices()) >= 2
+    if on_mesh:
+        from mxnet_tpu.parallel.mesh import make_mesh
+        tr = gluon.Trainer(net.collect_params(), "sgd",
+                           {"learning_rate": 0.05}, kvstore="ici")
+        tr._kvstore.set_mesh(make_mesh({"dp": 2}))
+    else:
+        tr = gluon.Trainer(net.collect_params(), "sgd",
+                           {"learning_rate": 0.05})
+    step = tr.capture(lambda a, b: lossf(net(a), b).mean())
+    step(nd.array(Xh), nd.array(yh))            # compile
+
+    # control: host batches through the WARM step must fire the detector
+    # (mesh: per-step device_put sharding; 1-device: raw-numpy convert)
+    base = sync.value
+    if on_mesh:
+        step(nd.array(Xh), nd.array(yh))
+    else:
+        step(Xh, yh)
+    detector_fires = sync.value > base
+    if not detector_fires:
+        errors.append("sync-H2D detector did not fire on host-path "
+                      "batches (the zero below would be vacuous)")
+
+    # device-prefetched loop: every warm step must be transfer-free
+    pf = DevicePrefetcher(((Xh, yh) for _ in range(steps)),
+                          capture_spec=tr._kvstore if on_mesh else None)
+    worst_sync = 0
+    try:
+        for xb, yb in pf:
+            base = sync.value
+            step(xb, yb)
+            worst_sync = max(worst_sync, sync.value - base)
+            if step.last_fallback_reason is not None:
+                errors.append(f"prefetched captured step fell back: "
+                              f"{step.last_fallback_reason}")
+    finally:
+        pf.close()
+    if worst_sync:
+        errors.append(f"device-prefetched warm step performed "
+                      f"{worst_sync} synchronous H2D transfer(s) "
+                      f"(budget 0)")
+    return {
+        "prefetch_sync_h2d_per_step": worst_sync,
+        "prefetch_sync_h2d_budget": 0,
+        "prefetch_detector_fires": detector_fires,
+        "prefetch_mesh": on_mesh,
     }
 
 
@@ -136,7 +224,9 @@ def main(argv=None):
         return 1
     print(f"check_dispatch: OK ({res['captured_dispatches_per_step']} "
           f"dispatch/step captured vs "
-          f"{res['imperative_dispatches_per_step']} imperative)",
+          f"{res['imperative_dispatches_per_step']} imperative; "
+          f"{res['prefetch_sync_h2d_per_step']} sync H2D/step with the "
+          f"device prefetcher)",
           file=sys.stderr)
     return 0
 
