@@ -348,6 +348,22 @@ def main():
             print(f"[bench] captured-step bench failed: {e!r}",
                   file=sys.stderr)
 
+    # Serving headline (ISSUE 6): continuous-batching tokens/s + p99
+    # latency under Poisson arrivals, recorded as first-class fields of
+    # the supervisor JSON contract alongside the training metric (a serve
+    # failure must not take down the headline). BENCH_SERVE=0 disables.
+    if not smoke and os.environ.get("BENCH_SERVE") != "0":
+        try:
+            import bench_serve
+            sres = bench_serve.measure()
+            # scalar contract fields only — the BERT block below assigns
+            # (not appends) extra_metrics, so serve stays out of that list
+            result["serve_tokens_per_s"] = sres["value"]
+            result["serve_p99_ms"] = sres["p99_ms"]
+            result["serve_speedup_vs_static"] = sres["speedup_vs_static"]
+        except Exception as e:  # pragma: no cover
+            print(f"[bench] serve bench failed: {e!r}", file=sys.stderr)
+
     # Second headline metric (BASELINE.json): BERT-base MLM tokens/sec/chip.
     # Merged into the same single JSON line so the driver's one-line parse
     # still works; a BERT failure must not take down the ResNet metric.

@@ -71,6 +71,7 @@ from . import runtime
 from . import checkpoint
 from . import parallel
 from . import models
+from . import serve
 from . import contrib
 from . import prefetch
 from .prefetch import DevicePrefetcher

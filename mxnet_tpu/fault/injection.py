@@ -45,7 +45,7 @@ __all__ = ["FaultInjected", "POINTS", "ENABLED", "inject", "clear",
 # ones the subsystems check)
 POINTS = ("io.read", "io.decode", "engine.task", "kv.collective",
           "kv.init", "grad.nan", "preempt.sigterm", "checkpoint.save",
-          "checkpoint.load")
+          "checkpoint.load", "serve.admit", "serve.decode")
 
 ENABLED = False            # fast-path guard; True iff any spec registered
 

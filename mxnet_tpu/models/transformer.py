@@ -27,7 +27,8 @@ from ..ndarray.ndarray import NDArray, _apply
 from ..gluon import nn
 from ..gluon.block import HybridBlock, extract_pure_fn, \
     is_symbolic as _is_symbol
-from ..ops.pallas_kernels import flash_attention
+from ..ops.pallas_kernels import flash_attention, \
+    single_query_cached_attention
 from ._sym_attention import sym_attention
 
 
@@ -44,7 +45,10 @@ def _sym_dim(s, axis):
 
 __all__ = ["TransformerEncoder", "TransformerDecoder", "TransformerNMT",
            "transformer_base", "beam_search", "beam_search_cached",
-           "decode_step", "decoder_weights", "sinusoid_table"]
+           "decode_step", "decoder_weights", "encoder_weights",
+           "encode_memory", "decode_embed", "decode_project",
+           "decoder_layer_qkv", "decoder_layer_self_post",
+           "decoder_layer_cross", "decoder_layer_ffn", "sinusoid_table"]
 
 
 def sinusoid_table(max_len, units):
@@ -446,6 +450,45 @@ def decoder_weights(model):
                 num_heads=first.self_attn._h)
 
 
+def encoder_weights(model):
+    """Snapshot the encoder's weights as a pytree of jax arrays for the
+    pure `encode_memory` program (the serving prefill executable)."""
+    enc = model.encoder
+    layers = []
+    for layer in enc.layers:
+        layers.append(dict(
+            qkv=_dense_w(layer.attn.qkv),
+            proj=_dense_w(layer.attn.proj),
+            ffn1=_dense_w(layer.ffn.ffn1),
+            ffn2=_dense_w(layer.ffn.ffn2),
+            ln1=_ln_w(layer.ln1), ln2=_ln_w(layer.ln2)))
+    first = enc.layers[0]
+    return dict(embed=model.embed.weight.data()._data, layers=layers,
+                pos=jnp.asarray(enc._pos), scale=jnp.float32(enc._scale),
+                num_heads=first.attn._h)
+
+
+def encode_memory(weights, src, src_vl=None):
+    """Pure-jax encoder forward (inference path, dropout off): src (B, S)
+    int32 -> memory (B, S, U). Jittable — the serving prefill executable
+    runs this + `precompute_memory_kv` as ONE program. Rides the same
+    `flash_attention` the eager encoder uses, so the two paths share
+    numerics."""
+    h = weights["num_heads"]
+    s = src.shape[1]
+    x = weights["embed"][src] * weights["scale"] + weights["pos"][:s][None]
+    kv_len = src_vl.astype(jnp.int32) if src_vl is not None else None
+    for L in weights["layers"]:
+        qkv = _affine(x, L["qkv"])
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q, k, v = (_split_heads(t, h) for t in (q, k, v))
+        a = _merge_heads(flash_attention(q, k, v, kv_lengths=kv_len))
+        x = _ln_apply(x + _affine(a, L["proj"]), L["ln1"])
+        f = jnp.maximum(_affine(x, L["ffn1"]), 0)
+        x = _ln_apply(x + _affine(f, L["ffn2"]), L["ln2"])
+    return x
+
+
 def _ln_apply(x, lnw):
     g, b, eps = lnw
     mu = jnp.mean(x, axis=-1, keepdims=True)
@@ -477,6 +520,55 @@ def precompute_memory_kv(weights, memory):
     return out
 
 
+# Factored decode core (ISSUE 6 satellite): `decode_step` (the dense-cache
+# beam-search path) and the serving engine's paged-KV decode
+# (mxnet_tpu/serve/decode.py) compose the SAME per-layer functions below —
+# only the KV-cache layout (dense (B,H,Lmax,dh) buffers vs paged page
+# pools) and the attention gather differ, and the attention math itself is
+# `ops.pallas_kernels.single_query_cached_attention` in both, so the two
+# decoders are bitwise-identical on identical context (pinned by
+# tests/test_serve.py::test_paged_decode_bitwise_parity).
+def decode_embed(weights, tok_t, t):
+    """Embed the current token(s) at position(s) t: tok_t (B,) int32,
+    t scalar or (B,) int32 -> (B, U)."""
+    return weights["embed"][tok_t] * weights["scale"] + weights["pos"][t]
+
+
+def decode_project(weights, x):
+    """Tied output projection for the decode path: (B, U) -> (B, V)."""
+    return x @ weights["embed"].T
+
+
+def decoder_layer_qkv(L, x):
+    """Fused self-attention QKV projection: (B, U) -> three (B, U)."""
+    qkv = _affine(x, L["qkv"])
+    return jnp.split(qkv, 3, axis=-1)
+
+
+def decoder_layer_self_post(L, x, attn):
+    """Residual + proj + LN after self-attention. attn: (B, U) merged."""
+    return _ln_apply(x + _affine(attn, L["sproj"]), L["ln1"])
+
+
+def decoder_layer_cross(L, h, x, mk, mv, mem_vl=None):
+    """Cross-attention over precomputed memory K/V (mk/mv (B,H,S,dh)) for
+    one decode token x (B, U), including residual + LN."""
+    qc = _heads(_affine(x, L["q"]), h)
+    keep = None
+    if mem_vl is not None:
+        keep = (jnp.arange(mk.shape[2])[None, :]
+                < mem_vl[:, None])[:, None, None, :]
+    attn = _merge_heads(
+        single_query_cached_attention(qc, mk, mv, keep))[:, 0]
+    return _ln_apply(x + _affine(attn, L["cproj"]), L["ln2"])
+
+
+def decoder_layer_ffn(L, x):
+    """Position-wise FFN + residual + LN."""
+    f = jnp.maximum(_affine(x, L["ffn1"]), 0)
+    return _ln_apply(x + _affine(f, L["ffn2"]), L["ln3"])
+
+
 def decode_step(weights, caches, mem_kv, mem_vl, tok_t, t):
     """One incremental decode step.
 
@@ -484,45 +576,28 @@ def decode_step(weights, caches, mem_kv, mem_vl, tok_t, t):
     tok_t: (B,) int32 current tokens; t: scalar step index.
     Returns (logits (B, V), new_caches)."""
     h = weights["num_heads"]
-    x = weights["embed"][tok_t] * weights["scale"] + weights["pos"][t]
+    x = decode_embed(weights, tok_t, t)
     k_caches, v_caches = caches
     new_k, new_v = [], []
     lmax = k_caches.shape[3]
     step_mask = (jnp.arange(lmax) <= t)[None, None, None, :]
     for li, L in enumerate(weights["layers"]):
         # self-attention over the cache
-        qkv = _affine(x, L["qkv"])
-        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q, k, v = decoder_layer_qkv(L, x)
         qh, kh, vh = (_heads(a, h) for a in (q, k, v))
         kc = lax.dynamic_update_slice(k_caches[li], kh, (0, 0, t, 0))
         vc = lax.dynamic_update_slice(v_caches[li], vh, (0, 0, t, 0))
         new_k.append(kc)
         new_v.append(vc)
-        dh = qh.shape[-1]
-        s = jnp.einsum("bhqd,bhkd->bhqk", qh, kc,
-                       preferred_element_type=jnp.float32) / jnp.sqrt(
-                           jnp.float32(dh))
-        s = jnp.where(step_mask, s, -1e30)
-        p = jax.nn.softmax(s, axis=-1).astype(vc.dtype)
-        attn = _merge_heads(jnp.einsum("bhqk,bhkd->bhqd", p, vc))[:, 0]
-        x = _ln_apply(x + _affine(attn, L["sproj"]), L["ln1"])
+        attn = _merge_heads(
+            single_query_cached_attention(qh, kc, vc, step_mask))[:, 0]
+        x = decoder_layer_self_post(L, x, attn)
         # cross-attention over the precomputed memory K/V
         mk, mv = mem_kv[li]
-        qc = _heads(_affine(x, L["q"]), h)
-        s = jnp.einsum("bhqd,bhkd->bhqk", qc, mk,
-                       preferred_element_type=jnp.float32) / jnp.sqrt(
-                           jnp.float32(dh))
-        if mem_vl is not None:
-            keep = (jnp.arange(mk.shape[2])[None, :]
-                    < mem_vl[:, None])[:, None, None, :]
-            s = jnp.where(keep, s, -1e30)
-        p = jax.nn.softmax(s, axis=-1).astype(mv.dtype)
-        attn = _merge_heads(jnp.einsum("bhqk,bhkd->bhqd", p, mv))[:, 0]
-        x = _ln_apply(x + _affine(attn, L["cproj"]), L["ln2"])
+        x = decoder_layer_cross(L, h, x, mk, mv, mem_vl)
         # ffn
-        f = jnp.maximum(_affine(x, L["ffn1"]), 0)
-        x = _ln_apply(x + _affine(f, L["ffn2"]), L["ln3"])
-    logits = x @ weights["embed"].T
+        x = decoder_layer_ffn(L, x)
+    logits = decode_project(weights, x)
     return logits, (jnp.stack(new_k), jnp.stack(new_v))
 
 

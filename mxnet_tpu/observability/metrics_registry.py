@@ -156,12 +156,36 @@ class Histogram(_Metric):
                 return self._base * (2.0 ** i)
         return self.max
 
+    def quantiles(self, qs=(0.5, 0.95, 0.99)):
+        """Several quantiles in ONE bucket walk: {q: estimate}. The
+        serving latency reporters (serve.Server, bench_serve) read
+        p50/p95/p99 per snapshot — walking the buckets once instead of
+        len(qs) times keeps the per-step reporting cost flat."""
+        if not self.count:
+            return {q: 0.0 for q in qs}
+        order = sorted(qs)
+        out = {}
+        targets = [(q, q * self.count) for q in order]
+        seen = 0
+        ti = 0
+        for i, n in enumerate(self.buckets):
+            seen += n
+            while ti < len(targets) and seen >= targets[ti][1]:
+                out[targets[ti][0]] = self._base * (2.0 ** i)
+                ti += 1
+            if ti == len(targets):
+                break
+        for q, _ in targets[ti:]:
+            out[q] = self.max
+        return out
+
     def snapshot(self):
+        qs = self.quantiles((0.5, 0.95, 0.99))
         return {"count": self.count, "sum": self.sum,
                 "min": self.min if self.count else 0.0,
                 "max": self.max if self.count else 0.0,
                 "mean": self.mean,
-                "p50": self.quantile(0.5), "p99": self.quantile(0.99)}
+                "p50": qs[0.5], "p95": qs[0.95], "p99": qs[0.99]}
 
 
 class MetricsRegistry:

@@ -43,7 +43,8 @@ except Exception:  # pragma: no cover
     _HAS_PALLAS = False
 
 __all__ = ["flash_attention", "flash_block_attention", "fused_layer_norm",
-           "attention_reference", "on_tpu", "conv1x1_bn_stats"]
+           "attention_reference", "on_tpu", "conv1x1_bn_stats",
+           "single_query_cached_attention", "ragged_paged_attention"]
 
 
 def on_tpu():
@@ -692,6 +693,193 @@ def _flash_block_bwd_rule(causal, sm_scale, res, cts):
 
 
 flash_block_attention.defvjp(_flash_block_fwd_rule, _flash_block_bwd_rule)
+
+
+# ---------------------------------------------------------------------------
+# single-query cached attention + ragged paged attention (ISSUE 6)
+#
+# `single_query_cached_attention` is the SHARED decode-attention math: the
+# dense-cache incremental decoder (models/transformer.py decode_step) and the
+# serving engine's paged-KV fallback path both call this exact function, so
+# a request decoded through the paged cache is bitwise-identical to one
+# decoded through the dense cache (given the same context width).
+#
+# `ragged_paged_attention` (arXiv:2604.15464 style) lets requests of
+# DIFFERENT lengths share one attention launch per decode step: each slot
+# owns a page table into a fixed device-resident page pool, and the Pallas
+# kernel walks that table with scalar-prefetch index maps (the page id is
+# read from SMEM before the DMA is issued, so the gather never materialises
+# a dense (S, Lmax) context in HBM). Off-TPU (the CPU test mesh) a pure-lax
+# gather fallback reproduces the same numbers through the shared math above.
+# ---------------------------------------------------------------------------
+def single_query_cached_attention(qh, kc, vc, mask=None):
+    """Attention of a single query token over a cached context.
+
+    qh: (B, H, 1, dh); kc/vc: (B, H, L, dh); mask: boolean broadcastable to
+    (B, H, 1, L), True = attend (None = attend everywhere). Returns
+    (B, H, 1, dh). fp32 score accumulation, softmax in fp32, output in the
+    value dtype — the decode-path contract shared by the dense and paged
+    decoders."""
+    dh = qh.shape[-1]
+    s = jnp.einsum("bhqd,bhkd->bhqk", qh, kc,
+                   preferred_element_type=jnp.float32) / jnp.sqrt(
+                       jnp.float32(dh))
+    if mask is not None:
+        s = jnp.where(mask, s, -1e30)
+    p = jax.nn.softmax(s, axis=-1).astype(vc.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, vc)
+
+
+def _paged_attention_lax(q, k_pages, v_pages, page_tables, lengths):
+    """Pure-lax fallback: gather each slot's pages into a dense context,
+    then run the SAME shared math as the dense decoder (so CPU serving is
+    bitwise-parity with `decode_step` on equal context width).
+
+    q: (S, H, dh); k_pages/v_pages: (P, psize, H, dh);
+    page_tables: (S, npages) int32; lengths: (S,) int32 valid positions
+    (including the current token). Returns (S, H, dh)."""
+    S, H, dh = q.shape
+    psize = k_pages.shape[1]
+    npages = page_tables.shape[1]
+    L = npages * psize
+    kc = k_pages[page_tables].reshape(S, L, H, dh).transpose(0, 2, 1, 3)
+    vc = v_pages[page_tables].reshape(S, L, H, dh).transpose(0, 2, 1, 3)
+    mask = (jnp.arange(L)[None, :] < lengths[:, None])[:, None, None, :]
+    return single_query_cached_attention(q[:, :, None, :], kc, vc,
+                                         mask)[:, :, 0]
+
+
+def _rpa_kernel(pt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
+                m_scr, l_scr, acc_scr, *, psize, num_heads, sm_scale):
+    """Ragged paged attention, one (slot, head) per grid row, one KV page
+    per inner step. The page id for (slot, page_slot) was already consumed
+    by the BlockSpec index maps (scalar prefetch); here we only need the
+    slot's valid length for masking and dead-page skipping."""
+    g = pl.program_id(0)                    # slot * num_heads + head
+    j = pl.program_id(1)                    # page slot within the request
+    nj = pl.num_programs(1)
+    s_idx = g // num_heads
+    length = len_ref[s_idx]
+    k_start = j * psize
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, -1e30)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # pages entirely beyond the valid length are skipped — the ragged part:
+    # a 3-token request costs one page of work while its 300-token
+    # neighbour walks its whole table, in the same launch
+    @pl.when(k_start < length)
+    def _compute():
+        q = q_ref[0]                        # (1, dh)
+        k = k_ref[0, 0]                     # (psize, dh)
+        v = v_ref[0, 0]                     # (psize, dh)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * sm_scale
+        kj = k_start + lax.broadcasted_iota(jnp.int32, (1, psize), 1)
+        s = jnp.where(kj < length, s, -1e30)
+        m_prev = m_scr[:1, :1]              # (1, 1)
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)              # (1, psize) fp32
+        l_new = alpha * l_scr[:1, :1] + jnp.sum(p, axis=-1, keepdims=True)
+        acc = acc_scr[:1] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        # (8, x) scratch: every row carries the running value (a (1, x)
+        # block would violate Mosaic's (8, 128) min tile); row 0 is read
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+        acc_scr[:] = jnp.broadcast_to(acc, acc_scr.shape)
+
+    @pl.when(j == nj - 1)
+    def _finalize():
+        # a slot with length 0 (empty decode slot) has l == 0: guard the
+        # divide; its output is garbage the scheduler never reads
+        o_ref[0] = (acc_scr[:1] /
+                    jnp.maximum(l_scr[:1, :1], 1e-30)).astype(o_ref.dtype)
+
+
+def _rpa_pallas(q, k_pages, v_pages, page_tables, lengths, sm_scale):
+    S, H, dh = q.shape
+    psize = k_pages.shape[1]
+    npages = page_tables.shape[1]
+    qr = q.reshape(S * H, 1, dh)
+    # page-major layout for the kernel: (H, P, psize, dh) so one (slot,
+    # head, page) block is a contiguous (psize, dh) tile
+    kr = k_pages.transpose(2, 0, 1, 3)
+    vr = v_pages.transpose(2, 0, 1, 3)
+    grid = (S * H, npages)
+    kern = functools.partial(_rpa_kernel, psize=psize, num_heads=H,
+                             sm_scale=sm_scale)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,              # page tables + lengths
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, dh), lambda g, j, pt, ln: (g, 0, 0)),
+            # the paged gather: the page id comes from the scalar-
+            # prefetched table, so the DMA fetches exactly the pages the
+            # slot owns — never a dense (S, Lmax) context
+            pl.BlockSpec((1, 1, psize, dh),
+                         lambda g, j, pt, ln, _h=H: (g % _h, pt[g // _h, j],
+                                                     0, 0)),
+            pl.BlockSpec((1, 1, psize, dh),
+                         lambda g, j, pt, ln, _h=H: (g % _h, pt[g // _h, j],
+                                                     0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, dh), lambda g, j, pt, ln: (g, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((8, 128), jnp.float32),
+            pltpu.VMEM((8, 128), jnp.float32),
+            pltpu.VMEM((8, dh), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kern,
+        grid_spec=grid_spec,
+        out_shape=_sds((S * H, 1, dh), q.dtype, q, k_pages, v_pages),
+        compiler_params=_CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+        interpret=_interpret(),
+    )(page_tables.astype(jnp.int32), lengths.astype(jnp.int32), qr, kr, vr)
+    return out.reshape(S, H, dh)
+
+
+def _rpa_pallas_ok(psize):
+    if os.environ.get("MXTPU_PALLAS_DISABLE") == "1":
+        return False
+    return (_HAS_PALLAS and (on_tpu() or _interpret())
+            and psize % 8 == 0 and psize >= 8)
+
+
+def ragged_paged_attention(q, k_pages, v_pages, page_tables, lengths,
+                           sm_scale=None):
+    """One shared attention launch per decode step over a paged KV cache.
+
+    q: (S, H, dh) — ONE query token per decode slot; k_pages/v_pages:
+    (P, psize, H, dh) fixed-size page pools; page_tables: (S, npages)
+    int32 page ids per slot (unused entries must point at a valid page —
+    the pool's reserved null page 0); lengths: (S,) int32 valid cached
+    positions per slot INCLUDING the current token. Returns (S, H, dh).
+
+    On TPU (or MXTPU_PALLAS_INTERPRET=1) runs the Pallas kernel: the page
+    table rides in scalar-prefetch SMEM and the BlockSpec index maps read
+    it to DMA exactly the owned pages, skipping pages beyond each slot's
+    length — mixed-length slots share one launch. Elsewhere the pure-lax
+    gather fallback reproduces the same numbers through
+    `single_query_cached_attention` (inference-only; no custom vjp)."""
+    if sm_scale is None:
+        sm_scale = 1.0 / (q.shape[-1] ** 0.5)
+    if _rpa_pallas_ok(k_pages.shape[1]):
+        try:
+            return _rpa_pallas(q, k_pages, v_pages, page_tables, lengths,
+                               sm_scale)
+        except Exception as e:
+            _warn_fallback("ragged_paged", e)
+    return _paged_attention_lax(q, k_pages, v_pages, page_tables, lengths)
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,233 @@
+"""Serving decode runtime: ONE cached decode executable + ONE cached
+prefill executable over device-resident paged KV state (ISSUE 6).
+
+The decode step is compiled exactly once per server: every shape in the
+program is static — `(slots, num_pages, page_size)` for the self-attention
+page pools, `(slots, max_src_len)` for the per-slot encoder memory — and
+everything that changes between steps (slot occupancy, page tables,
+per-slot lengths, current tokens) rides as ARGUMENTS, so ragged batch
+composition never retraces (`decode_traces` stays 1; enforced by
+tools/check_dispatch.py's serve phase in tier-1). The K/V page pools are
+DONATED to the executable, so the per-step page writes are in-place
+scatters into the same device buffers — the paged cache never doubles in
+HBM.
+
+Slot conventions (shared with serve.scheduler):
+
+  * inactive slots route their scatter writes to the pool's reserved null
+    page 0 and their outputs are garbage the scheduler never reads — no
+    branches on occupancy inside the program;
+  * `lens[s]` is the number of cached positions BEFORE this step — also
+    the position index of the token being decoded (BOS decodes at 0);
+  * page tables are padded with the null page, so unused entries gather
+    valid memory.
+
+The per-layer math is `models.transformer`'s factored decode core
+(`decode_embed` / `decoder_layer_*`), and the self-attention is
+`ops.pallas_kernels.ragged_paged_attention` — the Pallas kernel on TPU,
+the shared-math lax gather on the CPU mesh — so a paged decode is
+bitwise-identical to the dense-cache `decode_step` on equal context
+width (tests/test_serve.py pins this).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .. import profiler
+from ..base import MXNetError
+from ..models.transformer import (decode_embed, decode_project,
+                                  decoder_layer_qkv, decoder_layer_self_post,
+                                  decoder_layer_cross, decoder_layer_ffn,
+                                  encode_memory, precompute_memory_kv)
+from ..observability import tracer as _tracer
+from ..ops.pallas_kernels import ragged_paged_attention
+from .kv_pages import NULL_PAGE
+
+__all__ = ["DecodeRuntime", "MemoryStateLost"]
+
+
+class MemoryStateLost(MXNetError):
+    """A prefill dispatch failed AFTER consuming its donated encoder-
+    memory buffers: every slot's cross-attention state is gone, not just
+    the request being admitted. The runtime has already rebuilt zeroed
+    buffers; the scheduler must restart ALL in-flight requests (their
+    re-admission re-prefills each slot)."""
+
+
+class DecodeRuntime:
+    """Device state + the two cached executables of one serving engine.
+
+    weights / enc_weights: `models.transformer.decoder_weights` /
+    `encoder_weights` snapshots. All device state (K/V page pools, per-slot
+    encoder memory) lives on this object; the scheduler only ever hands it
+    host-side int arrays."""
+
+    def __init__(self, weights, enc_weights, slots, num_pages, page_size,
+                 max_pages_per_slot, max_src_len):
+        u = weights["embed"].shape[1]
+        h = weights["num_heads"]
+        if u % h:
+            raise MXNetError("units not divisible by heads")
+        self._w = weights
+        self._ew = enc_weights
+        self.slots = int(slots)
+        self.num_pages = int(num_pages)
+        self.page_size = int(page_size)
+        self.max_pages_per_slot = int(max_pages_per_slot)
+        self.max_src_len = int(max_src_len)
+        self._h = h
+        self._dh = u // h
+        self._n_layers = len(weights["layers"])
+        max_pos = weights["pos"].shape[0]
+        if self.max_pages_per_slot * self.page_size > max_pos:
+            raise MXNetError(
+                f"page budget covers {self.max_pages_per_slot * page_size} "
+                f"positions but the decoder pos table has only {max_pos}")
+        enc_pos = enc_weights["pos"].shape[0]
+        if self.max_src_len > enc_pos:
+            raise MXNetError(
+                f"max_src_len {self.max_src_len} exceeds the encoder pos "
+                f"table ({enc_pos}) — every prefill would fail")
+        dtype = weights["embed"].dtype
+        shape = (self._n_layers, self.num_pages, self.page_size, h, self._dh)
+        self.k_pages = jnp.zeros(shape, dtype)
+        self.v_pages = jnp.zeros(shape, dtype)
+        self.reset_mem()
+        # retrace telemetry: the python bodies run ONLY while jax traces,
+        # so these counters are exactly the number of compilations — the
+        # check_dispatch serve gate asserts they stay at 1 across every
+        # slot-occupancy / page-table variation
+        self.decode_traces = 0
+        self.prefill_traces = 0
+        self._decode_fn = jax.jit(self._decode_program,
+                                  donate_argnums=(0, 1))
+        self._prefill_fn = jax.jit(self._prefill_program,
+                                   donate_argnums=(0, 1, 2))
+        self._remap_fn = jax.jit(
+            lambda kp, vp, perm: (kp[:, perm], vp[:, perm]),
+            donate_argnums=(0, 1))
+
+    # ------------------------------------------------------- programs
+    def _decode_program(self, k_pages, v_pages, page_tables, lens, tok,
+                        active, mem_k, mem_v, mem_vl):
+        self.decode_traces += 1
+        w, h, psize = self._w, self._h, self.page_size
+        s_n = tok.shape[0]
+        x = decode_embed(w, tok, lens)                       # (S, U)
+        rows = jnp.arange(s_n)
+        page = page_tables[rows, lens // psize]
+        page = jnp.where(active > 0, page, NULL_PAGE)
+        off = lens % psize
+        for li, L in enumerate(w["layers"]):
+            q, k, v = decoder_layer_qkv(L, x)
+            qh = q.reshape(s_n, h, self._dh)
+            kh = k.reshape(s_n, h, self._dh)
+            vh = v.reshape(s_n, h, self._dh)
+            k_pages = k_pages.at[li, page, off].set(kh)
+            v_pages = v_pages.at[li, page, off].set(vh)
+            a = ragged_paged_attention(qh, k_pages[li], v_pages[li],
+                                       page_tables, lens + 1)
+            x = decoder_layer_self_post(L, x, a.reshape(s_n, h * self._dh))
+            x = decoder_layer_cross(L, h, x, mem_k[li], mem_v[li], mem_vl)
+            x = decoder_layer_ffn(L, x)
+        logits = decode_project(w, x)
+        next_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return k_pages, v_pages, next_tok, logits
+
+    def _prefill_program(self, mem_k, mem_v, mem_vl, src, src_len, slot):
+        self.prefill_traces += 1
+        memory = encode_memory(self._ew, src, src_len)       # (1, Ssrc, U)
+        kv = precompute_memory_kv(self._w, memory)
+        mk = jnp.stack([k for k, _ in kv])   # (n_layers, 1, H, Ssrc, dh)
+        mv = jnp.stack([v for _, v in kv])
+        mem_k = lax.dynamic_update_slice(mem_k, mk, (0, slot, 0, 0, 0))
+        mem_v = lax.dynamic_update_slice(mem_v, mv, (0, slot, 0, 0, 0))
+        mem_vl = lax.dynamic_update_slice(mem_vl,
+                                          src_len.astype(jnp.int32), (slot,))
+        return mem_k, mem_v, mem_vl
+
+    # ---------------------------------------------------------- calls
+    def prefill(self, slot, src_tokens, src_len=None):
+        """Encode one request's source into decode slot `slot`: pads to
+        the static (1, max_src_len) shape, runs the cached prefill
+        executable (encoder + cross-attention K/V projection + slot
+        write, ONE dispatch) against the donated memory buffers."""
+        src = np.asarray(src_tokens, np.int32).reshape(-1)
+        if src_len is None:
+            src_len = src.size
+        if src.size > self.max_src_len:
+            raise MXNetError(f"source length {src.size} exceeds the "
+                             f"server's max_src_len {self.max_src_len}")
+        padded = np.zeros((1, self.max_src_len), np.int32)
+        padded[0, :src.size] = src
+        profiler.record_dispatch("serve_prefill")
+        old = (self.mem_k, self.mem_v, self.mem_vl)
+        try:
+            with _tracer.span("serve.prefill", cat="serve",
+                              args={"slot": int(slot),
+                                    "src_len": int(src_len)}):
+                self.mem_k, self.mem_v, self.mem_vl = self._prefill_fn(
+                    self.mem_k, self.mem_v, self.mem_vl,
+                    jnp.asarray(padded), jnp.asarray([src_len], jnp.int32),
+                    jnp.int32(slot))
+        except Exception as e:
+            # donation hazard (same rule as cachedop): a failure that
+            # consumed the donated memory buffers loses EVERY slot's
+            # encoder state, not just this request's — rebuild zeroed
+            # buffers and tell the scheduler to restart the in-flight
+            # requests. A failure that left the buffers alive (trace/
+            # compile-stage, CPU no-op donation) stays per-request.
+            if any(getattr(a, "is_deleted", lambda: False)()
+                   for a in old):
+                self.reset_mem()
+                raise MemoryStateLost(
+                    f"prefill failed after consuming donated memory "
+                    f"buffers: {type(e).__name__}: {e}") from e
+            raise
+
+    def decode(self, page_tables, lens, tok, active):
+        """One decode step for every slot (ONE dispatch): writes each
+        active slot's K/V into its current page in place, runs the shared
+        ragged-paged-attention launch, returns (next_tok (S,) host int32,
+        logits (S, V) device array)."""
+        profiler.record_dispatch("serve_decode")
+        self.k_pages, self.v_pages, next_tok, logits = self._decode_fn(
+            self.k_pages, self.v_pages,
+            jnp.asarray(page_tables, jnp.int32),
+            jnp.asarray(lens, jnp.int32), jnp.asarray(tok, jnp.int32),
+            jnp.asarray(active, jnp.int32),
+            self.mem_k, self.mem_v, self.mem_vl)
+        return np.asarray(next_tok), logits
+
+    def remap_pages(self, mapping):
+        """Apply a `PagePool.defrag()` renumbering to the device pools:
+        one gather-permutation dispatch (donated, in-place)."""
+        if not mapping:
+            return
+        perm = np.arange(self.num_pages)
+        for old, new in mapping.items():
+            perm[new] = old
+        profiler.record_dispatch("serve_page_remap")
+        self.k_pages, self.v_pages = self._remap_fn(
+            self.k_pages, self.v_pages, jnp.asarray(perm))
+
+    def reset_pages(self):
+        """Drop ALL cached KV state (used by the scheduler's catastrophic
+        failure path after an executable error, when page contents can no
+        longer be trusted)."""
+        shape = self.k_pages.shape
+        dtype = self.k_pages.dtype
+        self.k_pages = jnp.zeros(shape, dtype)
+        self.v_pages = jnp.zeros(shape, dtype)
+
+    def reset_mem(self):
+        """Rebuild zeroed per-slot encoder memory (after a prefill
+        failure consumed the donated buffers)."""
+        shape = (self._n_layers, self.slots, self._h, self.max_src_len,
+                 self._dh)
+        self.mem_k = jnp.zeros(shape, self._w["embed"].dtype)
+        self.mem_v = jnp.zeros(shape, self._w["embed"].dtype)
+        self.mem_vl = jnp.zeros((self.slots,), jnp.int32)

@@ -1,0 +1,87 @@
+"""Dependency-engine drive for the serving scheduler (ISSUE 6).
+
+The serving crank is host-side async work — exactly what the dependency
+engine (mxnet_tpu/engine.py) schedules for prefetch and checkpoint IO —
+so the decode loop runs as engine tasks rather than a dedicated thread:
+
+  * ONE loop task at a time, serialised on a private engine `Var` (the
+    same write-var discipline as the prefetcher's staging slots, so the
+    race detector covers the serving loop too);
+  * `kick()` arms the loop when work arrives and is a no-op while a loop
+    task is already scheduled — submits never pile up tasks;
+  * the task cranks `scheduler.step()` until the engine is idle
+    (bounded per-task burst, then re-pushes itself, so checkpoint saves
+    and prefetch staging interleave with decoding instead of starving
+    behind an unbounded serving task).
+
+A loop-task failure surfaces through the engine's sticky failure report
+(`engine.failures()`), like every other engine task.
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+from .. import engine
+
+__all__ = ["EngineLoop"]
+
+# steps one engine task cranks before re-pushing itself: long enough to
+# amortise the push, short enough that other engine users interleave
+_BURST = 64
+
+
+class EngineLoop:
+    def __init__(self, scheduler):
+        self._sched = scheduler
+        self._var = engine.Var()
+        self._lock = threading.Lock()
+        self._armed = False
+        self._closed = False
+
+    def kick(self):
+        """Ensure a loop task is scheduled (no-op when one already is)."""
+        with self._lock:
+            if self._armed or self._closed:
+                return
+            self._armed = True
+        engine.push(self._loop_task, write_vars=[self._var])
+
+    def _loop_task(self):
+        for _ in range(_BURST):
+            if self._closed:
+                break
+            if not self._sched.step():
+                # no progress: either drained, or queued work is waiting
+                # on pages that only in-flight decodes can free — the
+                # truthiness of step() guarantees actives keep making
+                # progress, so "no progress + pending" means drained-race
+                with self._lock:
+                    if self._closed or not self._sched.pending_work():
+                        self._armed = False
+                        return
+                continue
+        # burst spent (or closing): yield the worker, keep the loop armed
+        with self._lock:
+            if self._closed or not self._sched.pending_work():
+                self._armed = False
+                return
+        engine.push(self._loop_task, write_vars=[self._var])
+
+    def wait_idle(self, timeout=None):
+        """Block until the scheduler drains (engine-task completion plus a
+        pending-work poll, since a new submit can re-arm the loop)."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            engine.wait_for_var(self._var)
+            if not self._sched.pending_work():
+                return True
+            if deadline is not None and time.monotonic() > deadline:
+                return False
+            self.kick()
+            time.sleep(0.001)
+
+    def close(self):
+        with self._lock:
+            self._closed = True
+        engine.wait_for_var(self._var)

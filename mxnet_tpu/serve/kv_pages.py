@@ -1,0 +1,140 @@
+"""Paged KV-cache allocator (ISSUE 6; reference capability: vLLM-style
+block tables, arXiv:2604.15464's page pools, rebuilt for static-shape TPU
+serving).
+
+The device-side KV store is a FIXED pool of pages — per decoder layer a
+`(num_pages, page_size, H, dh)` K array and V array that never change
+shape, so the decode executable compiles ONCE. This module owns the HOST
+side: which page ids are free, which belong to which request, and the
+accounting that proves no request ever leaks device memory.
+
+Conventions:
+
+  * page id 0 is the RESERVED null page: never allocated, absorbs the
+    scatter writes of inactive decode slots and the gathers of unused
+    page-table entries (tables are padded with 0), so the executable
+    needs no branches on slot occupancy. Usable capacity is therefore
+    ``num_pages - 1``.
+  * `alloc` is all-or-nothing: a request that needs k pages either gets
+    all k or `PageAllocError` (the scheduler turns that into admission
+    backpressure / preemption) — no partial grants to roll back.
+  * `defrag()` renumbers live pages down into the low indices and returns
+    the old->new mapping; the caller (serve.decode.DecodeRuntime) applies
+    the same permutation to the device pools and page tables. Useful when
+    a long-running server wants to shrink its pool watermark.
+
+Accounting rides the metrics registry: `kv_pages_in_use` (gauge, MUST
+return to 0 after every request completes — asserted by the tier-1 serve
+tests including the chaos case), `kv_page_allocs` / `kv_page_frees` /
+`kv_page_alloc_failures` counters and `kv_pool_defrags`.
+"""
+from __future__ import annotations
+
+import threading
+
+from ..base import MXNetError
+from ..observability import registry as _obs_registry
+
+__all__ = ["PagePool", "PageAllocError", "NULL_PAGE"]
+
+NULL_PAGE = 0
+
+
+class PageAllocError(MXNetError):
+    """The pool cannot serve the requested number of pages."""
+
+
+class PagePool:
+    """Host-side page allocator over a fixed device page pool."""
+
+    def __init__(self, num_pages, page_size, registry=None):
+        if num_pages < 2:
+            raise MXNetError("PagePool needs num_pages >= 2 (page 0 is "
+                             "the reserved null page)")
+        if page_size < 1:
+            raise MXNetError("page_size must be >= 1")
+        self.num_pages = int(num_pages)
+        self.page_size = int(page_size)
+        self._lock = threading.Lock()
+        # LIFO free stack: hot pages get reused while still cache/TLB warm
+        self._free = list(range(self.num_pages - 1, NULL_PAGE, -1))
+        self._live = set()
+        reg = registry if registry is not None else _obs_registry()
+        reg.gauge("kv_pages_total").set(self.capacity)
+        self._in_use_gauge = reg.gauge("kv_pages_in_use")
+        self._in_use_gauge.set(0)
+        self._allocs = reg.counter("kv_page_allocs")
+        self._frees = reg.counter("kv_page_frees")
+        self._failures = reg.counter("kv_page_alloc_failures")
+        self._defrags = reg.counter("kv_pool_defrags")
+
+    # ------------------------------------------------------------- info
+    @property
+    def capacity(self):
+        """Usable pages (the null page is not allocatable)."""
+        return self.num_pages - 1
+
+    def available(self):
+        with self._lock:
+            return len(self._free)
+
+    def in_use(self):
+        with self._lock:
+            return len(self._live)
+
+    def pages_for(self, tokens):
+        """Pages needed to cache `tokens` positions."""
+        return max(1, -(-int(tokens) // self.page_size))
+
+    # ------------------------------------------------------------ alloc
+    def alloc(self, n=1):
+        """Allocate `n` pages atomically; returns the page-id list.
+        Raises `PageAllocError` (and counts `kv_page_alloc_failures`)
+        when fewer than `n` pages are free — nothing is granted."""
+        n = int(n)
+        with self._lock:
+            if n > len(self._free):
+                self._failures.inc()
+                raise PageAllocError(
+                    f"page pool exhausted: want {n}, "
+                    f"{len(self._free)}/{self.capacity} free")
+            pages = [self._free.pop() for _ in range(n)]
+            self._live.update(pages)
+            self._allocs.inc(n)
+            self._in_use_gauge.set(len(self._live))
+        return pages
+
+    def free(self, pages):
+        """Return pages to the pool. Double-frees and the null page are
+        errors (they would corrupt another request's cache)."""
+        with self._lock:
+            for p in pages:
+                p = int(p)
+                if p == NULL_PAGE:
+                    raise MXNetError("cannot free the reserved null page")
+                if p not in self._live:
+                    raise MXNetError(f"double free of page {p}")
+                self._live.discard(p)
+                self._free.append(p)
+                self._frees.inc()
+            self._in_use_gauge.set(len(self._live))
+
+    # ----------------------------------------------------------- defrag
+    def defrag(self):
+        """Compact live pages into the lowest ids. Returns {old: new} for
+        every page that moved (possibly empty); the caller must apply the
+        same renumbering to its device pools and page tables BEFORE the
+        next decode step. Counts `kv_pool_defrags`."""
+        with self._lock:
+            live = sorted(self._live)
+            mapping = {}
+            for new_id, old_id in enumerate(live, start=NULL_PAGE + 1):
+                if old_id != new_id:
+                    mapping[old_id] = new_id
+            if mapping:
+                self._live = set(range(NULL_PAGE + 1,
+                                       NULL_PAGE + 1 + len(live)))
+                self._free = list(range(self.num_pages - 1,
+                                        NULL_PAGE + len(live), -1))
+            self._defrags.inc()
+            return mapping

@@ -1,0 +1,569 @@
+"""Continuous (inflight) batching scheduler (ISSUE 6).
+
+Every `step()` is one turn of the serving crank:
+
+  1. ADMIT — pop queued requests into free decode slots while pages are
+     available (all-or-nothing first-page grant), running the cached
+     prefill executable per admission;
+  2. DECODE — one shared decode dispatch for ALL active slots (mixed
+     lengths share the ragged-paged-attention launch), growing each
+     active request by one token and one cache position, allocating a
+     fresh page exactly when a request crosses a page boundary;
+  3. EVICT — requests that emitted EOS or hit their token budget leave
+     their slot and return every page to the pool immediately, so the
+     NEXT step can admit into the freed capacity. No drain barriers:
+     short requests never wait for long ones (`static_batching=True`
+     flips exactly this off — admission only into an EMPTY batch — and is
+     the baseline `bench_serve.py` beats).
+
+Backpressure: the admission queue is bounded (`max_queue`); a submit into
+a full queue raises `ServeOverloaded` (counted) instead of buffering
+unboundedly. A request that cannot get its next page mid-decode is
+PREEMPTED — pages freed, requeued at the front — rather than deadlocking
+the pool (`serve_page_preemptions`).
+
+Fault discipline (fault/injection.py points `serve.admit` /
+`serve.decode`): an admit-time fault fails ONLY the request being
+admitted. A decode-time fault kills the whole in-flight batch — every
+active request frees its pages and is retried from scratch (bounded by
+`max_retries`) or failed cleanly; either way `kv_pages_in_use` returns to
+baseline (the chaos test asserts this). An error raised by the decode
+executable itself additionally resets the page pools (their contents are
+no longer trustworthy after a partial in-place step).
+"""
+from __future__ import annotations
+
+import collections
+import threading
+import time
+
+from ..base import MXNetError
+from ..fault import injection as _finj
+from ..observability import registry as _obs_registry
+from ..observability import tracer as _tracer
+from .decode import MemoryStateLost
+from .kv_pages import NULL_PAGE, PageAllocError
+
+__all__ = ["Request", "Scheduler", "ServeError", "ServeOverloaded",
+           "StepResult"]
+
+_STREAM_END = object()
+
+
+class ServeError(MXNetError):
+    """A request failed inside the serving engine."""
+
+
+class ServeOverloaded(ServeError):
+    """Admission queue full — backpressure; retry later."""
+
+
+class Request:
+    """One inference request + its result/stream plumbing. Create via
+    `Server.submit`; consume via `.result()` / `.stream()` / `.tokens`."""
+
+    def __init__(self, rid, src, max_new_tokens):
+        self.id = rid
+        self.src = src
+        self.max_new_tokens = int(max_new_tokens)
+        self.state = "queued"       # queued|running|done|failed
+        self.tokens = []            # generated ids (EOS included if hit)
+        self.error = None
+        self.retries = 0            # fault retries (budget: max_retries)
+        self.preemptions = 0        # page-pressure requeues (own budget)
+        self.t_submit = time.perf_counter()
+        self.t_first_token = None
+        self.t_done = None
+        self._slot = None
+        self._pages = []
+        self._cur_tok = None
+        self._done = threading.Event()
+        self._chunks = collections.deque()  # streamed tokens + sentinel
+        self._chunk_cv = threading.Condition()
+        self._inline_sched = None   # set by Server(engine_driven=False)
+
+    # ------------------------------------------------------- consumer
+    @property
+    def ttft(self):
+        """Seconds from submit to first generated token (None until)."""
+        if self.t_first_token is None:
+            return None
+        return self.t_first_token - self.t_submit
+
+    @property
+    def latency(self):
+        if self.t_done is None:
+            return None
+        return self.t_done - self.t_submit
+
+    def done(self):
+        return self._done.is_set()
+
+    def result(self, timeout=None):
+        """Block until the request finishes; returns the generated token
+        list, or raises `ServeError` if it failed. In inline mode
+        (Server(engine_driven=False)) this call cranks the scheduler,
+        still honouring the deadline."""
+        wait_timeout = timeout
+        if self._inline_sched is not None:
+            deadline = None if timeout is None \
+                else time.monotonic() + timeout
+            while not self._done.is_set():
+                if deadline is not None and time.monotonic() > deadline:
+                    break
+                self._inline_sched.step()
+            if deadline is not None:
+                # the crank spent (part of) the budget; only the
+                # remainder may be slept away below
+                wait_timeout = max(0.0, deadline - time.monotonic())
+        if not self._done.wait(wait_timeout):
+            raise ServeError(f"request {self.id} timed out after "
+                             f"{timeout}s")
+        if self.state == "failed":
+            raise ServeError(f"request {self.id} failed: {self.error}")
+        return list(self.tokens)
+
+    def stream(self, timeout=None):
+        """Yield generated token ids as they are produced; raises
+        `ServeError` at the end if the request failed. `timeout` bounds
+        the wait for EACH token (inline mode cranks the scheduler up to
+        that per-token deadline)."""
+        while True:
+            with self._chunk_cv:
+                item = self._chunks.popleft() if self._chunks else None
+            if item is None:
+                if self._inline_sched is not None:
+                    deadline = None if timeout is None \
+                        else time.monotonic() + timeout
+                    while True:
+                        with self._chunk_cv:
+                            if self._chunks:
+                                break
+                        if deadline is not None and \
+                                time.monotonic() > deadline:
+                            raise ServeError(
+                                f"request {self.id}: no token within "
+                                f"{timeout}s")
+                        self._inline_sched.step()
+                    continue
+                with self._chunk_cv:
+                    while not self._chunks:
+                        if not self._chunk_cv.wait(timeout):
+                            raise ServeError(
+                                f"request {self.id}: no token within "
+                                f"{timeout}s")
+                    item = self._chunks.popleft()
+            if item is _STREAM_END:
+                if self.state == "failed":
+                    raise ServeError(
+                        f"request {self.id} failed: {self.error}")
+                return
+            yield item
+
+    # ------------------------------------------------------- producer
+    def _emit(self, tok):
+        self.tokens.append(tok)
+        with self._chunk_cv:
+            self._chunks.append(tok)
+            self._chunk_cv.notify_all()
+
+    def _finish(self, state, error=None):
+        self.state = state
+        self.error = error
+        self.t_done = time.perf_counter()
+        with self._chunk_cv:
+            self._chunks.append(_STREAM_END)
+            self._chunk_cv.notify_all()
+        self._done.set()
+
+
+class StepResult:
+    """What one scheduler turn did (truthy = progress was made)."""
+    __slots__ = ("admitted", "decoded", "completed", "preempted", "retried")
+
+    def __init__(self, admitted=0, decoded=0, completed=0, preempted=0,
+                 retried=0):
+        self.admitted = admitted
+        self.decoded = decoded
+        self.completed = completed
+        self.preempted = preempted
+        self.retried = retried
+
+    def __bool__(self):
+        return bool(self.admitted or self.decoded)
+
+
+class Scheduler:
+    def __init__(self, runtime, pool, bos_id=2, eos_id=3, max_queue=64,
+                 max_retries=1, max_preemptions=8, static_batching=False):
+        import numpy as np
+        self._np = np
+        self._rt = runtime
+        self._pool = pool
+        self.bos_id = int(bos_id)
+        self.eos_id = int(eos_id)
+        self.max_queue = int(max_queue)
+        self.max_retries = int(max_retries)
+        # page-pressure preemptions are legitimate queueing, not faults —
+        # they get their own (laxer) restart budget so transient capacity
+        # pressure cannot burn a request's fault retries
+        self.max_preemptions = int(max_preemptions)
+        self.static_batching = bool(static_batching)
+        s = runtime.slots
+        self._slots = [None] * s                       # Request per slot
+        self._page_tables = np.full(
+            (s, runtime.max_pages_per_slot), NULL_PAGE, np.int32)
+        self._lens = np.zeros((s,), np.int32)
+        self._queue = collections.deque()
+        self._lock = threading.Lock()
+        # serialises whole turns: step() (engine loop or inline result()
+        # cranks from several threads), defrag()'s device remap, and
+        # shutdown() must never interleave mid-turn
+        self._step_lock = threading.Lock()
+        self._next_id = 0
+        self.tokens_generated = 0   # per-instance (the registry counter
+                                    # below is process-global)
+        reg = _obs_registry()
+        self._m_queue = reg.gauge("serve_queue_depth")
+        self._m_queue.set(0)
+        self._m_active = reg.gauge("serve_active_slots")
+        self._m_active.set(0)
+        self._m_tokens = reg.counter("serve_tokens")
+        self._m_ok = reg.counter("serve_requests", result="ok")
+        self._m_failed = reg.counter("serve_requests", result="failed")
+        self._m_rejected = reg.counter("serve_requests", result="rejected")
+        self._m_retries = reg.counter("serve_decode_retries")
+        self._m_preempt = reg.counter("serve_page_preemptions")
+        self._m_ttft = reg.histogram("serve_ttft_seconds")
+        self._m_latency = reg.histogram("serve_request_seconds")
+        self._m_step = reg.histogram("serve_decode_step_seconds")
+
+    # ------------------------------------------------------------ API
+    def submit(self, src_tokens, max_new_tokens):
+        """Enqueue a request; returns the `Request` handle. Raises
+        `ServeOverloaded` when the bounded admission queue is full and
+        `ServeError` when the `serve.admit` fault point fires."""
+        max_new = int(max_new_tokens)
+        if max_new < 1:
+            raise MXNetError("max_new_tokens must be >= 1")
+        if max_new > self._rt.max_pages_per_slot * self._rt.page_size:
+            raise MXNetError(
+                f"max_new_tokens {max_new} exceeds the per-slot page "
+                f"budget ({self._rt.max_pages_per_slot} pages x "
+                f"{self._rt.page_size})")
+        need = self._pool.pages_for(max_new)
+        if need > self._pool.capacity:
+            # doomed even with the pool to itself: reject at submit time
+            # instead of burning prefills + retries on guaranteed
+            # mid-decode page exhaustion
+            raise MXNetError(
+                f"max_new_tokens {max_new} needs {need} pages but the "
+                f"pool only has {self._pool.capacity} total")
+        src = self._np.asarray(src_tokens, self._np.int32).reshape(-1)
+        if src.size == 0:
+            raise MXNetError("src_tokens must be non-empty (an empty "
+                             "source has no cross-attention context)")
+        if src.size > self._rt.max_src_len:
+            raise MXNetError(f"source length {src.size} exceeds the "
+                             f"server's max_src_len "
+                             f"{self._rt.max_src_len}")
+        with self._lock:
+            rid = self._next_id
+            self._next_id += 1
+        req = Request(rid, src, max_new)
+        try:
+            if _finj.ENABLED:
+                _finj.check("serve.admit", context=f"request {rid}")
+        except Exception as e:
+            self._m_failed.inc()
+            req._finish("failed", f"admit fault: {e!r}")
+            raise ServeError(f"request {rid} rejected at admission: "
+                             f"{e}") from e
+        with self._lock:
+            if len(self._queue) >= self.max_queue:
+                self._m_rejected.inc()
+                req._finish("failed", "admission queue full")
+                raise ServeOverloaded(
+                    f"admission queue full ({self.max_queue}); retry "
+                    "later")
+            self._queue.append(req)
+            self._m_queue.set(len(self._queue))
+        if _tracer.ACTIVE:
+            _tracer.instant("serve.submit", args={"id": rid})
+        return req
+
+    def pending_work(self):
+        with self._lock:
+            return bool(self._queue) or any(
+                r is not None for r in self._slots)
+
+    def active_count(self):
+        return sum(1 for r in self._slots if r is not None)
+
+    # ----------------------------------------------------------- step
+    def step(self):
+        """One serving turn: admit -> decode -> evict. Returns a
+        `StepResult` (truthy when any progress was made). Turns are
+        serialised on an internal lock (inline handles may crank from
+        several threads; `defrag`/`shutdown` take the same lock)."""
+        with self._step_lock:
+            return self._step_locked()
+
+    def _step_locked(self):
+        res = StepResult()
+        res.admitted = self._admit(res)
+        active = [(s, r) for s, r in enumerate(self._slots)
+                  if r is not None]
+        if not active:
+            self._m_active.set(0)
+            return res
+        t0 = time.perf_counter()
+        try:
+            if _finj.ENABLED:
+                _finj.check("serve.decode",
+                            context=f"{len(active)} active")
+            self._grow_pages(active, res)
+            active = [(s, r) for s, r in enumerate(self._slots)
+                      if r is not None]
+            if not active:
+                return res
+            next_tok = self._decode(active)
+        except _finj.FaultInjected as e:
+            self._fail_inflight(active, res, e, reset_pages=False)
+            return res
+        except Exception as e:  # executable error: pages untrustworthy
+            self._fail_inflight(active, res, e, reset_pages=True)
+            return res
+        self._m_step.observe(time.perf_counter() - t0)
+        res.decoded = len(active)
+        now = time.perf_counter()
+        for s, r in active:
+            tok = int(next_tok[s])
+            if r.t_first_token is None:
+                r.t_first_token = now
+            r._emit(tok)
+            r._cur_tok = tok
+            self._lens[s] += 1
+            if tok == self.eos_id or len(r.tokens) >= r.max_new_tokens:
+                self._evict(s, r, "done")
+                res.completed += 1
+        self._m_active.set(self.active_count())
+        return res
+
+    def defrag(self):
+        """Compact the page pool: renumber live pages into the low ids,
+        remap the device pools (one gather dispatch) and every active
+        slot's page table + request page list. Takes the step lock, so
+        it is safe to call from any thread while the engine loop is
+        decoding; a no-op when the pool is already compact. Returns the
+        number of pages that moved."""
+        with self._step_lock:
+            return self._defrag_locked()
+
+    def _defrag_locked(self):
+        mapping = self._pool.defrag()
+        if not mapping:
+            return 0
+        self._rt.remap_pages(mapping)
+        np = self._np
+        remap = np.arange(self._rt.num_pages)
+        for old, new in mapping.items():
+            remap[old] = new
+        self._page_tables = remap[self._page_tables].astype(np.int32)
+        for r in self._slots:
+            if r is not None:
+                r._pages = [mapping.get(p, p) for p in r._pages]
+        return len(mapping)
+
+    def shutdown(self, reason="server closed"):
+        """Fail every queued and in-flight request (pages freed, events
+        set) — `Server.close()` calls this so held handles can never
+        block forever on a stopped loop."""
+        with self._step_lock:
+            self._shutdown_locked(reason)
+
+    def _shutdown_locked(self, reason):
+        with self._lock:
+            queued = list(self._queue)
+            self._queue.clear()
+            self._m_queue.set(0)
+        for r in queued:
+            self._m_failed.inc()
+            r._finish("failed", reason)
+        for s, r in enumerate(self._slots):
+            if r is not None:
+                self._release_slot(s, r)
+                self._m_failed.inc()
+                r._finish("failed", reason)
+        self._m_active.set(0)
+
+    def run_until_idle(self, max_steps=100000):
+        """Drive `step()` until queue and slots drain (tests/bench)."""
+        for _ in range(max_steps):
+            if not self.pending_work():
+                return
+            self.step()
+        raise MXNetError("scheduler failed to drain")
+
+    # ------------------------------------------------------- internals
+    def _admit(self, res=None):
+        admitted = 0
+        while True:
+            # static mode: admit only into an EMPTY batch — but fill the
+            # whole batch in that one turn (requests admitted THIS call
+            # don't close the window, or "static" would degenerate to
+            # sequential batch-size-1 decoding)
+            if self.static_batching and self.active_count() > admitted:
+                break
+            free = [s for s, r in enumerate(self._slots) if r is None]
+            if not free:
+                break
+            with self._lock:
+                if not self._queue:
+                    break
+                req = self._queue.popleft()
+                self._m_queue.set(len(self._queue))
+            try:
+                pages = self._pool.alloc(1)
+            except PageAllocError:
+                # no first page -> push back and stop admitting; decode
+                # progress on the current actives will free pages
+                with self._lock:
+                    self._queue.appendleft(req)
+                    self._m_queue.set(len(self._queue))
+                break
+            s = free[0]
+            try:
+                self._rt.prefill(s, req.src)
+            except Exception as e:
+                self._pool.free(pages)
+                self._m_failed.inc()
+                req._finish("failed", f"prefill error: {e!r}")
+                if isinstance(e, MemoryStateLost):
+                    # the donated memory buffers died: EVERY in-flight
+                    # slot lost its encoder state (the runtime already
+                    # rebuilt zeroed buffers) — restart those requests
+                    # from scratch; re-admission re-prefills each slot
+                    self._fail_inflight(
+                        [(s2, r2) for s2, r2 in enumerate(self._slots)
+                         if r2 is not None],
+                        res if res is not None else StepResult(), e,
+                        reset_pages=False)
+                    break
+                continue
+            req.state = "running"
+            req._slot = s
+            req._pages = pages
+            req._cur_tok = self.bos_id
+            self._slots[s] = req
+            self._page_tables[s, :] = NULL_PAGE
+            self._page_tables[s, 0] = pages[0]
+            self._lens[s] = 0
+            admitted += 1
+        if admitted:
+            self._m_active.set(self.active_count())
+        return admitted
+
+    def _grow_pages(self, active, res):
+        """Allocate the next page for any active slot whose NEXT cached
+        position crosses a page boundary; preempt (free + requeue) the
+        request when the pool is dry instead of wedging the batch."""
+        psize = self._rt.page_size
+        for s, r in active:
+            pos = int(self._lens[s])
+            if pos == 0 or pos % psize:
+                continue        # current page still has room
+            slot_page = pos // psize
+            try:
+                page = self._pool.alloc(1)[0]
+            except PageAllocError:
+                self._m_preempt.inc()
+                self._requeue(s, r, "page pool exhausted mid-decode",
+                              preempted=True)
+                res.preempted += 1
+                continue
+            r._pages.append(page)
+            self._page_tables[s, slot_page] = page
+
+    def _decode(self, active):
+        mask = self._np.zeros((self._rt.slots,), self._np.int32)
+        toks = self._np.zeros((self._rt.slots,), self._np.int32)
+        for s, r in active:
+            mask[s] = 1
+            toks[s] = r._cur_tok
+        if _tracer.ACTIVE:
+            with _tracer.span("serve.decode_step", cat="serve",
+                              args={"active": len(active)}):
+                out, _ = self._rt.decode(self._page_tables, self._lens,
+                                         toks, mask)
+        else:
+            out, _ = self._rt.decode(self._page_tables, self._lens,
+                                     toks, mask)
+        return out
+
+    def _release_slot(self, s, r):
+        if r._pages:
+            self._pool.free(r._pages)
+        r._pages = []
+        r._slot = None
+        self._slots[s] = None
+        self._page_tables[s, :] = NULL_PAGE
+        self._lens[s] = 0
+
+    def _evict(self, s, r, state):
+        self._release_slot(s, r)
+        self._m_ok.inc()
+        # token/TTFT metrics land ONCE, at completion — per-step counting
+        # would double-report any request a fault or preemption restarted
+        self._m_tokens.inc(len(r.tokens))
+        self.tokens_generated += len(r.tokens)
+        if r.ttft is not None:
+            self._m_ttft.observe(r.ttft)
+        self._m_latency.observe(time.perf_counter() - r.t_submit)
+        r._finish(state)
+        if _tracer.ACTIVE:
+            _tracer.instant("serve.request_done", args={
+                "id": r.id, "tokens": len(r.tokens),
+                "ttft_ms": round((r.ttft or 0) * 1e3, 3)})
+
+    def _requeue(self, s, r, why, preempted=False):
+        """Restart a request from scratch (pages freed, queued at the
+        front); fail it cleanly when the relevant restart budget is
+        spent (fault retries and page preemptions count separately). The
+        stream restarts too: undelivered chunks from the aborted attempt
+        are dropped and TTFT re-arms, so consumers see one clean token
+        sequence (tokens a live streamer already pulled before the fault
+        are superseded by the retry — inherent to streaming + retry)."""
+        self._release_slot(s, r)
+        if preempted:
+            r.preemptions += 1
+            exhausted = r.preemptions > self.max_preemptions
+        else:
+            r.retries += 1
+            exhausted = r.retries > self.max_retries
+        r.tokens = []
+        r._cur_tok = None
+        r.t_first_token = None
+        with r._chunk_cv:
+            r._chunks.clear()
+        if exhausted:
+            self._m_failed.inc()
+            r._finish("failed", why)
+            return False
+        r.state = "queued"
+        with self._lock:
+            self._queue.appendleft(r)
+            self._m_queue.set(len(self._queue))
+        return True
+
+    def _fail_inflight(self, active, res, exc, reset_pages):
+        """A decode-time fault killed the whole in-flight batch: every
+        active request retries from scratch or fails cleanly; page
+        accounting returns to baseline either way."""
+        self._m_retries.inc()
+        for s, r in active:
+            if self._requeue(s, r, f"decode fault: {exc!r}"):
+                res.retried += 1
+        if reset_pages:
+            self._rt.reset_pages()
+        self._m_active.set(self.active_count())

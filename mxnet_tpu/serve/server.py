@@ -1,0 +1,176 @@
+"""Request-level serving API (ISSUE 6): `serve.Server`.
+
+    model = transformer_base(vocab_size=...);  # trained TransformerNMT
+    srv = mx.serve.Server(model, slots=8, page_size=16, num_pages=128)
+    h = srv.submit([5, 9, 11], max_new_tokens=32)   # source token ids
+    print(h.result())                               # generated ids
+    for tok in srv.stream([5, 9, 11]):              # or stream them
+        ...
+    srv.close()
+
+One `Server` owns: the weight snapshots (`decoder_weights` /
+`encoder_weights`), the device-resident paged KV state + the two cached
+executables (`serve.decode.DecodeRuntime`), the page allocator
+(`serve.kv_pages.PagePool`), the continuous-batching scheduler, and an
+engine-driven decode loop (`serve.engine_bridge.EngineLoop`). Submissions
+from any thread kick the loop; decoding happens on engine workers.
+`engine_driven=False` runs the crank inline in `result()`/`stream()`
+instead — deterministic single-threaded mode for tests and benches.
+
+Observability: per-request TTFT/latency histograms with p50/p95/p99
+(`serve_ttft_seconds`, `serve_request_seconds`), `serve_tokens` and
+tokens/s (`serve_tokens_per_s` gauge via `throughput()`), queue/slot
+gauges, KV-page accounting from the pool, and `serve.*` trace spans when
+the tracer is active (docs/SERVING.md + docs/OBSERVABILITY.md).
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+from ..base import MXNetError
+from ..models.transformer import decoder_weights, encoder_weights
+from ..observability import registry as _obs_registry
+from .decode import DecodeRuntime
+from .engine_bridge import EngineLoop
+from .kv_pages import PagePool
+from .scheduler import Scheduler
+
+__all__ = ["Server"]
+
+
+class Server:
+    """Continuous-batching inference server for a `TransformerNMT`.
+
+    slots: max concurrent decoding requests; page_size: tokens per KV
+    page; num_pages: device pool size INCLUDING the reserved null page;
+    max_src_len: static source padding length; max_new_tokens: per-slot
+    generation cap (and page-budget denominator). See docs/SERVING.md for
+    pool sizing."""
+
+    def __init__(self, model, slots=8, page_size=16, num_pages=None,
+                 max_src_len=32, max_new_tokens=32, bos_id=2, eos_id=3,
+                 max_queue=64, max_retries=1, static_batching=False,
+                 engine_driven=True):
+        if max_new_tokens < 1:
+            raise MXNetError("max_new_tokens must be >= 1")
+        self.max_new_tokens = int(max_new_tokens)
+        if num_pages is None:
+            # every slot can hold a full-length request + the null page
+            num_pages = slots * \
+                (-(-int(max_new_tokens) // int(page_size))) + 1
+        self._pool = PagePool(num_pages, page_size)
+        pages_per_slot = self._pool.pages_for(max_new_tokens)
+        self._rt = DecodeRuntime(
+            decoder_weights(model), encoder_weights(model), slots=slots,
+            num_pages=num_pages, page_size=page_size,
+            max_pages_per_slot=pages_per_slot, max_src_len=max_src_len)
+        self._sched = Scheduler(self._rt, self._pool, bos_id=bos_id,
+                                eos_id=eos_id, max_queue=max_queue,
+                                max_retries=max_retries,
+                                static_batching=static_batching)
+        self._engine_driven = bool(engine_driven)
+        self._loop = EngineLoop(self._sched) if self._engine_driven \
+            else None
+        self._closed = False
+        # serialises submit() against close(): a submit that slips past
+        # the closed check after shutdown drained the queue would strand
+        # its handle forever
+        self._close_lock = threading.Lock()
+        self._t_start = time.perf_counter()
+        self._m_tps = _obs_registry().gauge("serve_tokens_per_s")
+
+    # ------------------------------------------------------------- API
+    @property
+    def scheduler(self):
+        return self._sched
+
+    @property
+    def runtime(self):
+        return self._rt
+
+    @property
+    def pool(self):
+        return self._pool
+
+    def submit(self, src_tokens, max_new_tokens=None):
+        """Enqueue a request; returns its `Request` handle immediately.
+        Raises `ServeOverloaded` under backpressure. The handle's
+        `.result(timeout)` / `.stream(timeout)` / `.done()` consume it."""
+        with self._close_lock:
+            if self._closed:
+                raise MXNetError("Server is closed")
+            req = self._sched.submit(
+                src_tokens, max_new_tokens if max_new_tokens is not None
+                else self.max_new_tokens)
+            if self._loop is not None:
+                self._loop.kick()
+            else:
+                req._inline_sched = self._sched
+            return req
+
+    def stream(self, src_tokens, max_new_tokens=None, timeout=None):
+        """Submit + yield generated token ids as they are produced."""
+        req = self.submit(src_tokens, max_new_tokens)
+        yield from req.stream(timeout=timeout)
+
+    def wait(self, handles=None, timeout=None):
+        """Await completion of `handles` (or ALL traffic when None):
+        inline mode cranks the scheduler up to the deadline; engine mode
+        waits on the loop / the handles' events. Returns True when
+        everything asked for finished (failed counts as finished),
+        False on timeout."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+
+        def expired():
+            return deadline is not None and time.monotonic() > deadline
+
+        if handles is None:
+            if self._loop is not None:
+                return self._loop.wait_idle(timeout)
+            while self._sched.pending_work():
+                if expired():
+                    return False
+                self._sched.step()
+            return True
+        for h in handles:
+            if self._loop is None:
+                while not h.done():
+                    if expired():
+                        return False
+                    self._sched.step()
+            else:
+                rem = None if deadline is None \
+                    else max(0.0, deadline - time.monotonic())
+                if not h._done.wait(rem):
+                    return False
+        return True
+
+    def throughput(self):
+        """THIS server's generated tokens/s since construction — counted
+        per scheduler instance, so concurrent servers don't pollute each
+        other (also sets the `serve_tokens_per_s` gauge, last-writer-
+        wins across servers)."""
+        dt = max(time.perf_counter() - self._t_start, 1e-9)
+        tps = self._sched.tokens_generated / dt
+        self._m_tps.set(tps)
+        return tps
+
+    def close(self):
+        """Stop the loop and FAIL any still-pending requests (their
+        handles unblock with `ServeError`, their pages return to the
+        pool) — close never strands a held `Request`."""
+        with self._close_lock:
+            if self._closed:
+                return
+            self._closed = True
+        if self._loop is not None:
+            self._loop.close()
+        self._sched.shutdown()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

@@ -24,6 +24,13 @@ def test_captured_dispatch_budget_and_parity():
     # conftest forks 8 CPU devices, so the MESH placement path is what
     # ran (the configuration where the per-step device_put used to live)
     assert res["prefetch_mesh"] is True
+    # ISSUE 6: the serve decode loop is ONE dispatch per warm decode
+    # turn, never retraces across varying slot occupancy, and returns
+    # every KV page when the traffic drains
+    assert res["serve_decode_dispatches_per_step"] <= 1
+    assert res["serve_decode_retraces"] == 0
+    assert res["serve_pages_leaked"] == 0
+    assert res["serve_decode_steps_measured"] > 0
 
 
 def test_check_dispatch_cli_smoke():

@@ -149,6 +149,17 @@ def test_metrics_registry_semantics(tmp_path):
     assert snap["count"] == 4 and abs(snap["sum"] - 0.407) < 1e-9
     assert snap["min"] == 0.001 and snap["max"] == 0.4
     assert 0.001 <= snap["p50"] <= 0.01 and snap["p99"] >= 0.1
+    # quantile-snapshot satellite (ISSUE 6): p95 in the snapshot, and
+    # quantiles() walks the buckets once for all requested points,
+    # agreeing with the one-at-a-time quantile() estimates
+    assert snap["p50"] <= snap["p95"] <= snap["p99"]
+    qs = h.quantiles((0.5, 0.95, 0.99))
+    assert qs == {0.5: h.quantile(0.5), 0.95: h.quantile(0.95),
+                  0.99: h.quantile(0.99)}
+    # empty histogram: quantiles are 0.0 (separate registry so this
+    # test's series/JSONL counts below stay unchanged)
+    empty = metrics_registry.MetricsRegistry().histogram("lat_empty")
+    assert empty.quantiles((0.5,)) == {0.5: 0.0}
     full = reg.snapshot()
     assert {"requests", "depth", "lat"} <= set(full)
     assert {s["labels"]["route"] for s in full["requests"]} == \

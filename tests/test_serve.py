@@ -1,0 +1,622 @@
+"""Serving engine (ISSUE 6): paged KV cache, ragged paged attention,
+continuous batching, request API, fault/chaos behaviour.
+
+The load-bearing guarantees pinned here:
+
+  * the paged decode path is BITWISE-identical to the dense-cache
+    `decode_step` on equal context width (shared decode core);
+  * the KV page pool NEVER leaks: `in_use` returns to 0 after every
+    request completes — including chaos (decode faults, exhausted
+    retries) and page-exhaustion preemption;
+  * the decode executable compiles once and never retraces across slot
+    occupancy / page-table changes (also gated in check_dispatch).
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.fault import injection as finj
+from mxnet_tpu.observability import registry
+from mxnet_tpu.serve import (PageAllocError, PagePool, ServeError,
+                             ServeOverloaded)
+from mxnet_tpu.serve.kv_pages import NULL_PAGE
+
+
+def _tiny_model(vocab=50, units=32, layers=2, heads=4, max_length=32,
+                seed=11):
+    from mxnet_tpu.models.transformer import TransformerNMT
+    mx.random.seed(seed)
+    m = TransformerNMT(vocab, units=units, hidden=2 * units,
+                       num_layers=layers, num_heads=heads,
+                       max_length=max_length, dropout=0.0)
+    m.initialize()
+    return m
+
+
+def _server(model=None, **kw):
+    model = model if model is not None else _tiny_model()
+    kw.setdefault("slots", 2)
+    kw.setdefault("page_size", 4)
+    kw.setdefault("max_src_len", 16)
+    kw.setdefault("max_new_tokens", 12)
+    kw.setdefault("engine_driven", False)
+    return mx.serve.Server(model, **kw)
+
+
+@pytest.fixture(autouse=True)
+def _clear_faults():
+    finj.clear()
+    yield
+    finj.clear()
+
+
+# ---------------------------------------------------------------- pool
+def test_page_pool_alloc_free_accounting():
+    pool = PagePool(num_pages=8, page_size=4)
+    assert pool.capacity == 7 and pool.available() == 7
+    a = pool.alloc(3)
+    assert len(a) == 3 and NULL_PAGE not in a
+    assert pool.in_use() == 3 and pool.available() == 4
+    b = pool.alloc(4)
+    assert pool.available() == 0
+    pool.free(a)
+    assert pool.in_use() == 4 and pool.available() == 3
+    pool.free(b)
+    assert pool.in_use() == 0 and pool.available() == 7
+    assert pool.pages_for(1) == 1 and pool.pages_for(4) == 1
+    assert pool.pages_for(5) == 2 and pool.pages_for(0) == 1
+
+
+def test_page_pool_exhaustion_is_atomic_and_counted():
+    reg = registry()
+    fail0 = reg.counter("kv_page_alloc_failures").value
+    pool = PagePool(num_pages=4, page_size=2)
+    pool.alloc(2)
+    with pytest.raises(PageAllocError):
+        pool.alloc(2)       # only 1 free: all-or-nothing
+    assert pool.available() == 1    # nothing was granted
+    assert reg.counter("kv_page_alloc_failures").value == fail0 + 1
+
+
+def test_page_pool_free_errors():
+    pool = PagePool(num_pages=4, page_size=2)
+    pages = pool.alloc(1)
+    pool.free(pages)
+    with pytest.raises(MXNetError):
+        pool.free(pages)            # double free
+    with pytest.raises(MXNetError):
+        pool.free([NULL_PAGE])      # reserved null page
+
+
+def test_page_pool_defrag_mapping():
+    pool = PagePool(num_pages=8, page_size=2)
+    a = pool.alloc(5)               # pages 1..5
+    pool.free([a[0], a[2]])         # live: {2, 4, 5} (alloc order 1..5)
+    live = sorted({1, 2, 3, 4, 5} - {a[0], a[2]})
+    mapping = pool.defrag()
+    # live pages renumbered to 1..3; only movers appear in the mapping
+    assert set(mapping.keys()) <= set(live)
+    assert sorted(mapping.values()) == sorted(
+        n for n, o in zip(range(1, 4), live) if n != o)
+    assert pool.in_use() == 3
+    assert pool.available() == 4
+    # post-defrag allocations hand out ids above the compacted range
+    assert all(p > 3 for p in pool.alloc(2))
+
+
+# ----------------------------------------------- ragged paged attention
+def _paged_fixture(seed=0, S=3, H=2, dh=8, P=9, psize=8, npages=2):
+    import jax.numpy as jnp
+    rng = np.random.RandomState(seed)
+    q = jnp.asarray(rng.randn(S, H, dh).astype(np.float32))
+    kp = jnp.asarray(rng.randn(P, psize, H, dh).astype(np.float32))
+    vp = jnp.asarray(rng.randn(P, psize, H, dh).astype(np.float32))
+    pt = jnp.asarray(np.array([[1, 2], [3, 0], [4, 5]], np.int32))
+    lens = jnp.asarray(np.array([12, 5, 16], np.int32))
+    return q, kp, vp, pt, lens
+
+
+def test_paged_attention_lax_matches_shared_math():
+    """The gather fallback must be EXACTLY the shared single-query math
+    over the gathered context (that is what buys decode-path parity)."""
+    import jax.numpy as jnp
+    from mxnet_tpu.ops.pallas_kernels import (
+        _paged_attention_lax, single_query_cached_attention)
+    q, kp, vp, pt, lens = _paged_fixture()
+    out = _paged_attention_lax(q, kp, vp, pt, lens)
+    S, H, dh = q.shape
+    L = pt.shape[1] * kp.shape[1]
+    kc = kp[pt].reshape(S, L, H, dh).transpose(0, 2, 1, 3)
+    vc = vp[pt].reshape(S, L, H, dh).transpose(0, 2, 1, 3)
+    mask = (jnp.arange(L)[None, :] < lens[:, None])[:, None, None, :]
+    ref = single_query_cached_attention(q[:, :, None, :], kc, vc,
+                                        mask)[:, :, 0]
+    assert np.array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_paged_attention_kernel_interpret(monkeypatch):
+    """The Pallas ragged-paged kernel numerics, pinned on CPU via
+    interpret mode (same harness as the flash-kernel tests)."""
+    monkeypatch.setenv("MXTPU_PALLAS_INTERPRET", "1")
+    from mxnet_tpu.ops.pallas_kernels import (_paged_attention_lax,
+                                              ragged_paged_attention)
+    q, kp, vp, pt, lens = _paged_fixture()
+    out_k = ragged_paged_attention(q, kp, vp, pt, lens)
+    ref = _paged_attention_lax(q, kp, vp, pt, lens)
+    np.testing.assert_allclose(np.asarray(out_k), np.asarray(ref),
+                               rtol=2e-6, atol=2e-6)
+
+
+# --------------------------------------------------- decode-path parity
+def test_paged_decode_bitwise_parity():
+    """The serve paged decode and the dense-cache `decode_step` (the
+    beam-search path) share one decode core + KV layout: on identical
+    memory and equal context width, executing both cores op-by-op (the
+    shared functions themselves, outside jit) produces BITWISE-equal
+    logits at every step. The jitted production path is additionally
+    checked to pick identical tokens (whole-program XLA fusion is allowed
+    its ~1-ULP reassociation, but never a different argmax here)."""
+    import jax.numpy as jnp
+    from mxnet_tpu.models.transformer import (decode_step, decoder_weights,
+                                              encoder_weights)
+    from mxnet_tpu.serve.decode import DecodeRuntime
+
+    model = _tiny_model()
+    w = decoder_weights(model)
+    ew = encoder_weights(model)
+    rng = np.random.RandomState(3)
+    src = rng.randint(4, 50, (9,)).astype(np.int32)
+
+    psize, npages = 4, 4            # paged context width = dense Lmax
+    lmax = psize * npages
+    rt = DecodeRuntime(w, ew, slots=2, num_pages=2 * npages + 1,
+                       page_size=psize, max_pages_per_slot=npages,
+                       max_src_len=12)
+    rt.prefill(0, src)
+
+    # dense twin fed the EXACT memory the prefill executable wrote
+    n_layers = len(w["layers"])
+    h = w["num_heads"]
+    dh = w["embed"].shape[1] // h
+    mem_kv = [(rt.mem_k[li, 0:1], rt.mem_v[li, 0:1])
+              for li in range(n_layers)]
+    mem_vl = rt.mem_vl[0:1]
+    caches = (jnp.zeros((n_layers, 1, h, lmax, dh), w["embed"].dtype),) * 2
+
+    page_tables = np.full((2, npages), NULL_PAGE, np.int32)
+    page_tables[0] = [1, 2, 3, 4]   # slot 0 owns 4 pages
+    pt_dev = jnp.asarray(page_tables)
+    active = jnp.asarray(np.array([1, 0], np.int32))
+    lens = np.zeros((2,), np.int32)
+    tok = np.array([2, 0], np.int32)        # BOS
+    # the eager core keeps its own copy of the page state (the jitted
+    # runtime call donates rt.k_pages/v_pages)
+    kp, vp = jnp.array(rt.k_pages), jnp.array(rt.v_pages)
+
+    for t in range(8):
+        logits_d, caches = decode_step(
+            w, caches, mem_kv, mem_vl, jnp.asarray(tok[:1]), t)
+        # the shared core, executed eagerly: bitwise
+        kp, vp, _, logits_e = rt._decode_program(
+            kp, vp, pt_dev, jnp.asarray(lens), jnp.asarray(tok), active,
+            rt.mem_k, rt.mem_v, rt.mem_vl)
+        assert np.array_equal(np.asarray(logits_e)[0],
+                              np.asarray(logits_d)[0]), f"step {t}"
+        # the jitted production path: same token choice, logits ~1 ULP
+        next_paged, logits_p = rt.decode(page_tables, lens, tok, active)
+        np.testing.assert_allclose(np.asarray(logits_p)[0],
+                                   np.asarray(logits_d)[0],
+                                   rtol=2e-6, atol=2e-6)
+        nxt = int(np.argmax(np.asarray(logits_d)[0]))
+        assert int(next_paged[0]) == nxt
+        tok = np.array([nxt, 0], np.int32)
+        lens[0] += 1
+
+
+def test_serve_greedy_matches_beam1_cached():
+    """End to end: the server's greedy decode equals `beam_search_cached`
+    with beam_size=1 (same shared decode core, full pipeline)."""
+    from mxnet_tpu.models.transformer import beam_search_cached
+    model = _tiny_model()
+    rng = np.random.RandomState(0)
+    src = rng.randint(4, 50, (8,)).astype(np.int32)
+    srv = _server(model, max_new_tokens=11)
+    try:
+        got = srv.submit(src).result()
+    finally:
+        srv.close()
+    tokens, _ = beam_search_cached(model, mx.nd.array(src.reshape(1, -1)),
+                                   beam_size=1, max_length=12)
+    beam = tokens.asnumpy()[0, 0].tolist()   # [BOS, tok, tok, ...]
+    want = beam[1:1 + len(got)]
+    eos_cut = want.index(3) + 1 if 3 in want else len(want)
+    assert got == want[:eos_cut] or got == want
+
+
+# ----------------------------------------------- continuous batching
+def test_continuous_batching_admits_midflight_and_frees_pages():
+    srv = _server(max_new_tokens=12)
+    sched = srv.scheduler
+    rng = np.random.RandomState(1)
+    long1 = srv.submit(rng.randint(4, 50, (6,)), max_new_tokens=10)
+    short = srv.submit(rng.randint(4, 50, (5,)), max_new_tokens=2)
+    late = srv.submit(rng.randint(4, 50, (7,)), max_new_tokens=3)
+    r = sched.step()
+    assert r.admitted == 2          # both slots fill, `late` queues
+    assert sched.active_count() == 2
+    saw_midflight = False
+    for _ in range(40):
+        if not sched.pending_work():
+            break
+        sched.step()
+        states = (long1.state, late.state)
+        if states == ("running", "running"):
+            saw_midflight = True    # late admitted while long1 in flight
+    assert saw_midflight, "continuous batching never backfilled"
+    assert len(short.result()) == 2
+    assert len(long1.result()) == 10
+    assert len(late.result()) == 3
+    assert srv.pool.in_use() == 0
+    srv.close()
+
+
+def test_static_batching_needs_more_steps():
+    """Same mixed-length workload: static batching (admit only into an
+    empty batch) must take strictly more scheduler turns than continuous
+    batching — the bench's speedup, in deterministic step counts."""
+    def run(static):
+        model = _tiny_model(seed=13)
+        srv = _server(model, slots=2, max_new_tokens=12,
+                      static_batching=static)
+        rng = np.random.RandomState(5)
+        for budget in (12, 2, 6, 3):
+            srv.submit(rng.randint(4, 50, (6,)), max_new_tokens=budget)
+        steps = 0
+        while srv.scheduler.pending_work():
+            srv.scheduler.step()
+            steps += 1
+            assert steps < 200
+        assert srv.pool.in_use() == 0
+        srv.close()
+        return steps
+
+    s_static = run(True)
+    s_cont = run(False)
+    assert s_cont < s_static, (s_cont, s_static)
+
+
+def test_static_batching_fills_whole_batch_per_window():
+    """static_batching admits into an EMPTY batch only, but fills ALL
+    free slots in that one admission turn (regression: the window used
+    to close after the first admission, degenerating to batch-size-1)."""
+    model = _tiny_model(seed=23)
+    srv = _server(model, slots=3, max_new_tokens=4, static_batching=True)
+    rng = np.random.RandomState(21)
+    for _ in range(4):
+        srv.submit(rng.randint(4, 50, (5,)), max_new_tokens=4)
+    r = srv.scheduler.step()
+    assert r.admitted == 3          # whole batch, one window
+    assert srv.scheduler.active_count() == 3
+    # mid-flight: no admission until the batch drains
+    r = srv.scheduler.step()
+    assert r.admitted == 0
+    srv.scheduler.run_until_idle()
+    assert srv.pool.in_use() == 0
+    srv.close()
+
+
+def test_close_fails_pending_requests_instead_of_stranding():
+    srv = _server()
+    rng = np.random.RandomState(22)
+    h = srv.submit(rng.randint(4, 50, (5,)))    # queued, never stepped
+    srv.close()
+    assert h.state == "failed" and h.done()
+    with pytest.raises(ServeError):
+        h.result(timeout=1)
+    assert srv.pool.in_use() == 0
+
+
+def test_backpressure_bounded_queue():
+    reg = registry()
+    rej0 = reg.counter("serve_requests", result="rejected").value
+    srv = _server(max_queue=2)
+    rng = np.random.RandomState(2)
+    srv.submit(rng.randint(4, 50, (4,)))
+    srv.submit(rng.randint(4, 50, (4,)))
+    with pytest.raises(ServeOverloaded):
+        srv.submit(rng.randint(4, 50, (4,)))
+    assert reg.counter("serve_requests", result="rejected").value \
+        == rej0 + 1
+    srv.scheduler.run_until_idle()
+    assert srv.pool.in_use() == 0
+    srv.close()
+
+
+def test_submit_validates_source_tokens():
+    srv = _server()
+    with pytest.raises(MXNetError):
+        srv.submit([], max_new_tokens=4)            # empty source
+    with pytest.raises(MXNetError):
+        srv.submit(np.arange(4, 40, dtype=np.int32))  # > max_src_len
+    srv.close()
+
+
+def test_submit_rejects_request_pool_can_never_serve():
+    """A token budget needing more pages than the WHOLE pool holds is
+    rejected at submit time (it would deterministically exhaust the pool
+    mid-decode and burn retries)."""
+    model = _tiny_model(seed=27)
+    srv = _server(model, slots=2, page_size=2, num_pages=3,  # 2 usable
+                  max_new_tokens=6)
+    with pytest.raises(MXNetError):
+        srv.submit(np.arange(4, 9, dtype=np.int32), max_new_tokens=6)
+    h = srv.submit(np.arange(4, 9, dtype=np.int32), max_new_tokens=4)
+    assert len(h.result(timeout=30)) >= 1
+    assert srv.pool.in_use() == 0
+    srv.close()
+
+
+def test_throughput_is_per_server():
+    """serve_tokens is process-global; throughput() must count per
+    scheduler instance (regression: a second — even concurrent — server
+    double-counted the first one's tokens)."""
+    model = _tiny_model(seed=28)
+    a = _server(model, max_new_tokens=4)
+    b = _server(model, max_new_tokens=4)    # concurrently alive
+    a.submit(np.arange(4, 10, dtype=np.int32)).result(timeout=30)
+    assert b.throughput() == 0.0            # a's tokens don't leak into b
+    assert a.throughput() > 0
+    b.submit(np.arange(4, 10, dtype=np.int32)).result(timeout=30)
+    assert b.scheduler.tokens_generated == 4
+    assert a.scheduler.tokens_generated == 4
+    a.close()
+    b.close()
+
+
+def test_construction_validates_encoder_pos_table():
+    """max_src_len beyond the ENCODER position table fails at
+    construction, not with an opaque shape error on every prefill."""
+    model = _tiny_model(seed=29, max_length=8)
+    with pytest.raises(MXNetError):
+        _server(model, max_src_len=16)
+
+
+def test_streaming_yields_incrementally():
+    srv = _server(max_new_tokens=6)
+    rng = np.random.RandomState(4)
+    toks = list(srv.stream(rng.randint(4, 50, (5,)), timeout=30))
+    assert 1 <= len(toks) <= 6
+    assert all(isinstance(t, int) for t in toks)
+    assert srv.pool.in_use() == 0
+    srv.close()
+
+
+def test_engine_driven_server():
+    """The decode loop as dependency-engine tasks: submits from the user
+    thread, decoding on engine workers, clean drain + close."""
+    from mxnet_tpu import engine
+    srv = _server(engine_driven=True, max_new_tokens=6)
+    rng = np.random.RandomState(6)
+    hs = [srv.submit(rng.randint(4, 50, (n,))) for n in (5, 8, 3)]
+    res = [h.result(timeout=60) for h in hs]
+    assert all(1 <= len(r) <= 6 for r in res)
+    assert srv.wait(timeout=30)
+    assert srv.pool.in_use() == 0
+    srv.close()
+    assert not any("serve" in f["site"] for f in engine.failures())
+
+
+def test_page_exhaustion_preempts_not_deadlocks():
+    """Two long requests on a pool that cannot hold both: the loser is
+    preempted (pages freed, requeued) instead of wedging the batch, and
+    everything still completes with zero leaked pages."""
+    reg = registry()
+    pre0 = reg.counter("serve_page_preemptions").value
+    model = _tiny_model(seed=17)
+    srv = _server(model, slots=2, page_size=2, num_pages=4,  # 3 usable
+                  max_new_tokens=6, max_retries=5)
+    rng = np.random.RandomState(7)
+    h1 = srv.submit(rng.randint(4, 50, (5,)), max_new_tokens=6)
+    h2 = srv.submit(rng.randint(4, 50, (6,)), max_new_tokens=6)
+    srv.scheduler.run_until_idle(max_steps=500)
+    assert len(h1.result()) >= 1 and len(h2.result()) >= 1
+    assert reg.counter("serve_page_preemptions").value > pre0
+    # preemption is queueing, not a fault: the retry budget is untouched
+    assert h1.preemptions + h2.preemptions >= 1
+    assert h1.retries == 0 and h2.retries == 0
+    assert srv.pool.in_use() == 0
+    srv.close()
+
+
+def test_defrag_midflight_keeps_decoding_correctly():
+    """Pool compaction between steps (device remap + table remap) must
+    not change what a request generates."""
+    def run(with_defrag):
+        model = _tiny_model(seed=19)
+        srv = _server(model, slots=2, page_size=2, max_new_tokens=8)
+        rng = np.random.RandomState(8)
+        h1 = srv.submit(rng.randint(4, 50, (6,)), max_new_tokens=8)
+        h2 = srv.submit(rng.randint(4, 50, (4,)), max_new_tokens=2)
+        sched = srv.scheduler
+        for i in range(40):
+            if not sched.pending_work():
+                break
+            sched.step()
+            if with_defrag and i == 3:
+                # h2 finished -> holes in the pool -> compaction moves
+                # h1's live pages mid-request
+                sched.defrag()
+        out = (h1.result(), h2.result())
+        assert srv.pool.in_use() == 0
+        srv.close()
+        return out
+
+    assert run(True) == run(False)
+
+
+# ------------------------------------------------------------- chaos
+def test_chaos_decode_fault_retries_without_leaking():
+    """A fault mid-decode kills the in-flight batch: requests are retried
+    from scratch and complete; page accounting returns to baseline."""
+    reg = registry()
+    ret0 = reg.counter("serve_decode_retries").value
+    srv = _server(max_new_tokens=6, max_retries=2)
+    rng = np.random.RandomState(9)
+    finj.inject("serve.decode", at=[2])      # second decode turn dies
+    h1 = srv.submit(rng.randint(4, 50, (5,)))
+    h2 = srv.submit(rng.randint(4, 50, (7,)))
+    srv.scheduler.run_until_idle(max_steps=500)
+    assert finj.fires("serve.decode") == 1
+    assert len(h1.result()) >= 1 and len(h2.result()) >= 1
+    assert h1.retries + h2.retries >= 1
+    assert reg.counter("serve_decode_retries").value == ret0 + 1
+    assert srv.pool.in_use() == 0
+    # the stream restarted with the retry: no pre-fault token prefix
+    # duplicated ahead of the regenerated sequence
+    assert list(h1.stream(timeout=1)) == h1.result()
+    assert list(h2.stream(timeout=1)) == h2.result()
+    srv.close()
+
+
+def test_requeue_rearms_stream_and_ttft():
+    """A retried request restarts its stream (undelivered chunks of the
+    aborted attempt dropped) and re-arms TTFT measurement."""
+    srv = _server(max_new_tokens=6, max_retries=2)
+    rng = np.random.RandomState(24)
+    finj.inject("serve.decode", at=[2])      # die after one emitted token
+    h = srv.submit(rng.randint(4, 50, (5,)))
+    sched = srv.scheduler
+    sched.step()                             # admit + first token
+    assert len(h.tokens) == 1 and h.t_first_token is not None
+    sched.step()                             # fault -> requeue
+    assert h.state == "queued" and h.retries == 1
+    assert h.t_first_token is None           # TTFT re-arms
+    assert not h._chunks                     # aborted chunks dropped
+    sched.run_until_idle(max_steps=200)
+    assert list(h.stream(timeout=1)) == h.result()
+    assert h.ttft is not None and h.ttft <= h.latency
+    assert srv.pool.in_use() == 0
+    srv.close()
+
+
+def test_chaos_decode_fault_exhausted_retries_fails_cleanly():
+    srv = _server(max_new_tokens=6, max_retries=1)
+    rng = np.random.RandomState(10)
+    finj.inject("serve.decode", prob=1.0)    # every decode turn dies
+    h = srv.submit(rng.randint(4, 50, (5,)))
+    srv.scheduler.run_until_idle(max_steps=100)
+    assert h.state == "failed"
+    with pytest.raises(ServeError):
+        h.result(timeout=1)
+    assert srv.pool.in_use() == 0            # failed != leaked
+    srv.close()
+
+
+def test_prefill_failure_fails_only_that_request():
+    """An ordinary prefill error (donated buffers still alive — the CPU
+    case) fails the admitted request only; in-flight traffic continues."""
+    srv = _server(max_new_tokens=4)
+    rng = np.random.RandomState(25)
+    ok1 = srv.submit(rng.randint(4, 50, (5,)))
+    srv.scheduler.step()                     # ok1 admitted + decoding
+    orig = srv.runtime.prefill
+    calls = {"n": 0}
+
+    def flaky(slot, src, src_len=None):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("transient prefill failure")
+        return orig(slot, src, src_len)
+
+    srv.runtime.prefill = flaky
+    bad = srv.submit(rng.randint(4, 50, (4,)))
+    ok2 = srv.submit(rng.randint(4, 50, (6,)))
+    srv.scheduler.run_until_idle(max_steps=200)
+    assert bad.state == "failed"
+    assert len(ok1.result()) >= 1 and len(ok2.result()) >= 1
+    assert srv.pool.in_use() == 0
+    srv.close()
+
+
+def test_prefill_memory_loss_restarts_inflight_requests():
+    """A prefill failure that consumed the donated memory buffers
+    (`MemoryStateLost`) restarts EVERY in-flight request — re-admission
+    re-prefills each slot — with zero leaked pages."""
+    from mxnet_tpu.serve.decode import MemoryStateLost
+    srv = _server(max_new_tokens=4, max_retries=2)
+    rng = np.random.RandomState(26)
+    inflight = srv.submit(rng.randint(4, 50, (5,)))
+    srv.scheduler.step()                     # admitted + one token
+    assert inflight.state == "running"
+    orig = srv.runtime.prefill
+    calls = {"n": 0}
+
+    def lossy(slot, src, src_len=None):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            srv.runtime.reset_mem()          # what the real path does
+            raise MemoryStateLost("prefill consumed donated buffers")
+        return orig(slot, src, src_len)
+
+    srv.runtime.prefill = lossy
+    bad = srv.submit(rng.randint(4, 50, (4,)))
+    srv.scheduler.run_until_idle(max_steps=200)
+    assert bad.state == "failed"
+    # the in-flight request was restarted from scratch and completed
+    assert inflight.retries >= 1
+    assert len(inflight.result()) >= 1
+    assert srv.pool.in_use() == 0
+    srv.close()
+
+
+def test_chaos_admit_fault_rejects_one_request():
+    srv = _server()
+    rng = np.random.RandomState(12)
+    finj.inject("serve.admit", at=[1])
+    with pytest.raises(ServeError):
+        srv.submit(rng.randint(4, 50, (4,)))
+    h = srv.submit(rng.randint(4, 50, (4,)))  # next one sails through
+    assert len(h.result()) >= 1
+    assert srv.pool.in_use() == 0
+    srv.close()
+
+
+# ------------------------------------------------------------ metrics
+def test_serve_metrics_and_percentiles():
+    reg = registry()
+    ttft = reg.histogram("serve_ttft_seconds")
+    lat = reg.histogram("serve_request_seconds")
+    t0, l0 = ttft.count, lat.count
+    srv = _server(max_new_tokens=4)
+    rng = np.random.RandomState(14)
+    hs = [srv.submit(rng.randint(4, 50, (5,))) for _ in range(3)]
+    for h in hs:
+        h.result()
+    srv.close()
+    assert ttft.count == t0 + 3 and lat.count == l0 + 3
+    snap = lat.snapshot()
+    # the quantile-snapshot satellite: p50/p95/p99 all present + ordered
+    assert snap["count"] >= 3
+    assert snap["p50"] <= snap["p95"] <= snap["p99"]
+    qs = lat.quantiles((0.5, 0.95, 0.99))
+    assert qs[0.5] == snap["p50"] and qs[0.99] == snap["p99"]
+    tps = srv.throughput()
+    assert tps > 0
+    assert reg.gauge("serve_tokens_per_s").snapshot() == tps
+
+
+def test_encode_memory_matches_eager_encoder_bitwise():
+    """The prefill executable's pure encoder is bitwise-equal to the
+    eager `model.encode` path (they share flash_attention and the
+    layer math)."""
+    import jax.numpy as jnp
+    from mxnet_tpu.models.transformer import encode_memory, encoder_weights
+    model = _tiny_model()
+    rng = np.random.RandomState(15)
+    src = rng.randint(4, 50, (2, 12)).astype(np.int32)
+    svl = np.array([8, 12], np.int32)
+    eager, _ = model.encode(mx.nd.array(src), mx.nd.array(svl))
+    pure = encode_memory(encoder_weights(model), jnp.asarray(src),
+                         jnp.asarray(svl))
+    assert np.array_equal(eager.asnumpy(), np.asarray(pure))

@@ -22,6 +22,14 @@ the zero is a measurement, not a dead counter). Runs over the 'ici'
 mesh when >= 2 devices are available (the sharded-placement path),
 single-device otherwise.
 
+ISSUE 6 extension — the warm-step budget also covers the SERVE decode
+loop: a warm continuous-batching decode turn must be at most ONE device
+dispatch (the shared ragged-paged-attention decode executable), the
+decode executable must never RETRACE while slot occupancy and page
+tables vary mid-flight (mixed-length admissions/evictions between
+steps), and the KV page pool must return to zero pages in use once
+every request completes.
+
 Standalone:
 
     JAX_PLATFORMS=cpu python tools/check_dispatch.py [--steps N] [--budget B]
@@ -114,6 +122,7 @@ def run(steps=DEFAULT_STEPS, budget=DISPATCH_BUDGET):
             break
 
     prefetch_res = _run_prefetch_phase(steps, errors)
+    serve_res = _run_serve_phase(errors)
 
     res = {
         "steps": steps,
@@ -124,6 +133,7 @@ def run(steps=DEFAULT_STEPS, budget=DISPATCH_BUDGET):
         "max_rel_dev": max_dev,
     }
     res.update(prefetch_res)
+    res.update(serve_res)
     res["errors"] = errors
     res["ok"] = not errors
     return res
@@ -204,6 +214,77 @@ def _run_prefetch_phase(steps, errors):
     }
 
 
+def _run_serve_phase(errors):
+    """Serve decode-loop budget (ISSUE 6): warm continuous-batching decode
+    turns are at most ONE dispatch (the shared paged-decode executable),
+    the executable never retraces while slot occupancy and page tables
+    vary, and the page pool returns to baseline when the traffic drains."""
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import profiler
+    from mxnet_tpu.models.transformer import TransformerNMT
+
+    mx.random.seed(0)
+    model = TransformerNMT(32, units=16, hidden=32, num_layers=1,
+                           num_heads=2, max_length=32, dropout=0.0)
+    model.initialize()
+    srv = mx.serve.Server(model, slots=3, page_size=4, max_src_len=8,
+                          max_new_tokens=12, engine_driven=False)
+    sched = srv.scheduler
+    rng = np.random.RandomState(0)
+
+    # warm: one request through prefill + a decode step compiles both
+    # executables
+    srv.submit(rng.randint(4, 32, (5,)), max_new_tokens=4)
+    sched.step()
+    sched.step()
+    warm_traces = srv.runtime.decode_traces
+
+    # mixed-length traffic so occupancy and page-table contents vary
+    # between steps (1 -> 3 active, staggered completions)
+    for n, mt in ((3, 10), (7, 3), (6, 7), (4, 12), (8, 5)):
+        srv.submit(rng.randint(4, 32, (n,)), max_new_tokens=mt)
+    worst = 0
+    decode_steps = 0
+    for _ in range(100):
+        if not sched.pending_work():
+            break
+        profiler.reset_dispatches()
+        r = sched.step()
+        if r.decoded and not r.admitted:
+            # a pure decode turn: the only allowed launch is the decode
+            # executable itself (admission turns additionally pay the
+            # prefill executable per admitted request)
+            worst = max(worst, profiler.dispatch_count())
+            decode_steps += 1
+    # capture BEFORE close(): Scheduler.shutdown clears queue/slots and
+    # frees pages, which would mask a wedged scheduler or a leak
+    undrained = sched.pending_work()
+    retraces = srv.runtime.decode_traces - warm_traces
+    leaked = srv.pool.in_use()
+    srv.close()
+    if undrained:
+        errors.append("serve phase did not drain")
+    if decode_steps == 0:
+        errors.append("serve phase measured no pure decode turns")
+    if worst > 1:
+        errors.append(f"serve decode budget exceeded: {worst} "
+                      f"dispatches/turn (budget 1)")
+    if retraces:
+        errors.append(f"serve decode executable retraced {retraces}x "
+                      "across occupancy changes (budget 0)")
+    if leaked:
+        errors.append(f"serve phase leaked {leaked} KV pages")
+    return {
+        "serve_decode_dispatches_per_step": worst,
+        "serve_decode_budget": 1,
+        "serve_decode_steps_measured": decode_steps,
+        "serve_decode_retraces": retraces,
+        "serve_pages_leaked": leaked,
+    }
+
+
 def main(argv=None):
     argv = list(sys.argv[1:] if argv is None else argv)
     steps, budget = DEFAULT_STEPS, DISPATCH_BUDGET
@@ -226,7 +307,9 @@ def main(argv=None):
           f"dispatch/step captured vs "
           f"{res['imperative_dispatches_per_step']} imperative; "
           f"{res['prefetch_sync_h2d_per_step']} sync H2D/step with the "
-          f"device prefetcher)",
+          f"device prefetcher; "
+          f"{res['serve_decode_dispatches_per_step']} dispatch/decode "
+          f"turn, {res['serve_decode_retraces']} retraces serving)",
           file=sys.stderr)
     return 0
 
