@@ -361,6 +361,13 @@ def main():
             result["serve_tokens_per_s"] = sres["value"]
             result["serve_p99_ms"] = sres["p99_ms"]
             result["serve_speedup_vs_static"] = sres["speedup_vs_static"]
+            # ISSUE 7: decode p99 while a background-train flood contends
+            # for the engine — the QoS win a serving tenant sees when it
+            # shares chips with training (FIFO twin rides along)
+            if "p99_contended_ms" in sres:
+                result["serve_p99_contended_ms"] = sres["p99_contended_ms"]
+                result["serve_p99_contended_fifo_ms"] = \
+                    sres["p99_contended_fifo_ms"]
         except Exception as e:  # pragma: no cover
             print(f"[bench] serve bench failed: {e!r}", file=sys.stderr)
 

@@ -1,6 +1,14 @@
 """Serving benchmark (ISSUE 6): request latency percentiles + aggregate
 tokens/s under Poisson arrivals, continuous vs static batching.
 
+ISSUE 7 extension — the `--background-train` arm replays the same trace
+while a sustained background engine flood (prefetch/checkpoint stand-in
+tasks) contends for the engine workers, once with QoS priorities on and
+once with `engine.set_qos(False)` (pure FIFO): the contended p99 pair is
+what the priority classes + aging actually buy a serving tenant sharing
+chips with training. `p99_contended_ms` rides the supervisor JSON as
+`serve_p99_contended_ms`.
+
 The workload is a mixed-length open-loop arrival process: exponential
 inter-arrival times (Poisson process, seeded), source lengths and token
 budgets drawn from a spread so a static batch always carries stragglers.
@@ -104,7 +112,42 @@ def _run(policy_static, reqs):
     }
 
 
-def measure(seed=0, repeats=2):
+def measure_contended(reqs, qos=True):
+    """One continuous-batching pass under the background-train flood
+    (`bench_util.BackgroundEngineLoad`, the same generator the
+    check_qos gate floods with), with or without priority scheduling
+    (engine.set_qos)."""
+    from mxnet_tpu import engine
+    from bench_util import BackgroundEngineLoad
+
+    prev = engine.set_qos(qos)
+    try:
+        with BackgroundEngineLoad(engine.num_workers() * 32, task_s=0.01):
+            time.sleep(0.2)             # let the backlog build
+            return _run(policy_static=False, reqs=reqs)
+    finally:
+        engine.set_qos(prev)
+        engine.wait_for_all()
+
+
+def _contended_fields(reqs):
+    """The QoS-vs-FIFO contended arm, one pass each (the deterministic
+    decode-turn witness makes repeats unnecessary): decode p99 while a
+    background-train flood contends for the engine, with and without
+    priority scheduling. One source for both the supervisor-contract
+    fields in measure() and the standalone --background-train line."""
+    qos = measure_contended(reqs, qos=True)
+    fifo = measure_contended(reqs, qos=False)
+    return {
+        "p99_contended_ms": round(qos["p99_ms"], 2),
+        "p99_contended_fifo_ms": round(fifo["p99_ms"], 2),
+        "contended_p99_ratio_fifo_over_qos": round(
+            fifo["p99_ms"] / max(qos["p99_ms"], 1e-9), 3),
+        "tokens_per_s_contended": round(qos["tokens_per_s"], 2),
+    }
+
+
+def measure(seed=0, repeats=2, background_train=True):
     """Best-of-`repeats` per policy: shared-box wall clocks are noisy at
     this scale, so each arm keeps its best run — and the DETERMINISTIC
     witness rides along: `decode_turns` (one shared dispatch per serving
@@ -115,6 +158,16 @@ def measure(seed=0, repeats=2):
                 for _ in range(repeats)), key=lambda r: r["wall_s"])
     stat = min((_run(policy_static=True, reqs=reqs)
                 for _ in range(repeats)), key=lambda r: r["wall_s"])
+    contended = {}
+    if background_train:
+        try:
+            contended = _contended_fields(reqs)
+        except Exception as exc:
+            # The contended arm runs AFTER cont/stat: a failure here must
+            # not discard the uncontended serve fields already measured
+            # (bench.py's per-field guard can then still see them).
+            print(f"[bench_serve] contended arm failed: {exc!r}",
+                  file=sys.stderr)
     return {
         "metric": "serve_throughput",
         "unit": "tokens/sec",
@@ -133,13 +186,26 @@ def measure(seed=0, repeats=2):
             cont["tokens_per_s"] / max(stat["tokens_per_s"], 1e-9), 3),
         "turns_ratio_vs_static": round(
             stat["decode_turns"] / max(cont["decode_turns"], 1), 3),
+        **contended,
     }
 
 
-def main():
+def main(argv=None):
+    argv = list(sys.argv[1:] if argv is None else argv)
     if os.environ.get("JAX_PLATFORMS") == "cpu":
         import jax
         jax.config.update("jax_platforms", "cpu")
+    if "--background-train" in argv:
+        # contended arm only: decode p99 under background-train load,
+        # QoS vs FIFO
+        fields = _contended_fields(_workload())
+        print(json.dumps({
+            "metric": "serve_p99_contended",
+            "unit": "ms",
+            "value": fields.pop("p99_contended_ms"),
+            **fields,
+        }), flush=True)
+        return 0
     print(json.dumps(measure()), flush=True)
     return 0
 

@@ -87,3 +87,59 @@ def sweep(candidates, budget_s, run_one, on_best=None, tag="bench"):
     if best_cand is None:
         raise RuntimeError(f"[{tag}] no sweep candidate completed")
     return best, best_cand
+
+
+class BackgroundEngineLoad:
+    """Sustained background dependency-engine flood (ISSUE 7): a producer
+    thread keeps `target` short sleep tasks live in one cancellable
+    TaskGroup at PRIORITY_BACKGROUND — the stand-in for a co-tenant
+    training loop's host-side work (prefetch staging, async checkpoint
+    IO). One implementation shared by `bench_serve.py
+    --background-train` and the `tools/check_qos.py` tier-1 gate so the
+    bench and the gate measure the same contention."""
+
+    def __init__(self, target, task_s=0.02):
+        import threading
+        from mxnet_tpu import engine
+        self._engine = engine
+        self.group = engine.TaskGroup("background_load")
+        self.target = int(target)
+        self.task_s = float(task_s)
+        self._stop = threading.Event()
+        self.error = None     # a dead flood thread makes any "no
+                              # starvation under load" assertion vacuous:
+                              # consumers must check this after the run
+        self._thread = threading.Thread(target=self._produce, daemon=True)
+
+    def _produce(self):
+        while not self._stop.is_set():
+            short = self.target - self.group.live()
+            try:
+                for _ in range(max(0, short)):
+                    self.group.push(
+                        lambda: time.sleep(self.task_s),
+                        priority=self._engine.PRIORITY_BACKGROUND)
+            except self._engine.EngineQueueFull:
+                pass          # bounded background class: back off, keep
+                              # flooding — the load stays sustained
+            except BaseException as exc:  # noqa: BLE001
+                self.error = exc
+                return
+            time.sleep(0.005)
+
+    def __enter__(self):
+        self._thread.start()
+        return self
+
+    def __exit__(self, *exc):
+        self._stop.set()
+        self._thread.join(timeout=10)
+        self.group.cancel()
+        self.group.drain(timeout=60)
+        if self.error is not None and not any(exc):
+            # surface a dead producer thread: a run "under load" whose
+            # flood silently stopped would pass its contention
+            # assertions vacuously
+            raise RuntimeError(
+                f"background flood thread died: {self.error!r}")
+        return False

@@ -11,6 +11,19 @@
 //   * WaitForVar blocks until every op touching the var so far is done;
 //   * WaitForAll blocks until the engine drains.
 //
+// QoS (ISSUE 7): ready ops dispatch by PRIORITY CLASS, not FIFO — class 0
+// ("high", e.g. serve decode turns) preempts queued class-1 ("normal") and
+// class-2 ("background", prefetch/checkpoint) work at dispatch time. Ops
+// already running are never interrupted. Starvation is bounded by AGING:
+// a queued op's effective class drops by one for every `aging_ms_` it has
+// waited, floored at class 0 — promoted background work beats fresh
+// normal work (ties among promoted classes go to the longest waiter)
+// while the native high class wins its ties, keeping high-priority
+// dispatch latency bounded under any backlog. Admission (bounded queues,
+// deadlines, task groups) lives in the Python facade (mxnet_tpu/engine.py)
+// so both engine implementations share one policy; this file only orders
+// the ready queue.
+//
 // Debug mode (MXTPU_ENGINE_DEBUG=1 or MXTPUEngineSetDebug) is the race /
 // deadlock detector (reference: the ENGINE_DEBUG checks + NaiveEngine
 // cross-validation story of threaded_engine):
@@ -58,12 +71,16 @@ struct VarState {
   int running_writes = 0;  // int, not bool: debug mode must SEE a double-admit
 };
 
+constexpr int kClasses = 3;  // 0 = high, 1 = normal, 2 = background
+
 struct Op {
   void (*fn)(void*);
   void* arg;
   std::vector<uint64_t> reads;
   std::vector<uint64_t> writes;
   uint64_t seq = 0;
+  int pri = 1;
+  std::chrono::steady_clock::time_point enq;  // set when the op turns READY
   std::atomic<int> wait{0};
 };
 
@@ -72,6 +89,16 @@ class Engine {
   explicit Engine(int workers) : workers_(workers > 0 ? workers : 1) {
     const char* dbg = std::getenv("MXTPU_ENGINE_DEBUG");
     debug_ = dbg && dbg[0] && std::strcmp(dbg, "0") != 0;
+    const char* aging = std::getenv("MXTPU_ENGINE_AGING_MS");
+    if (aging && aging[0]) {
+      // strtol + endptr: a malformed value must keep the 100ms default
+      // (parity with _PyEngine's ValueError fallback) — atoi would
+      // return 0 and silently disable aging. An explicit "0" disables.
+      char* end = nullptr;
+      long ms = std::strtol(aging, &end, 10);
+      if (end != aging && *end == '\0' && ms >= 0 && ms <= INT32_MAX)
+        aging_ms_.store(static_cast<int>(ms));
+    }
     for (int i = 0; i < workers_; ++i)
       threads_.emplace_back([this] { WorkerLoop(); });
   }
@@ -103,10 +130,11 @@ class Engine {
   }
 
   void Push(void (*fn)(void*), void* arg, const uint64_t* reads, int nreads,
-            const uint64_t* writes, int nwrites) {
+            const uint64_t* writes, int nwrites, int pri = 1) {
     Op* op = new Op();
     op->fn = fn;
     op->arg = arg;
+    op->pri = pri < 0 ? 0 : (pri >= kClasses ? kClasses - 1 : pri);
     op->reads.assign(reads, reads + nreads);
     op->writes.assign(writes, writes + nwrites);
     // self-dependency = guaranteed deadlock (read admits, write queues
@@ -217,6 +245,11 @@ class Engine {
   void SetDebug(bool on) { debug_ = on; }
   bool debug() const { return debug_; }
 
+  void SetAgingMs(int ms) {
+    if (ms >= 0) aging_ms_.store(ms);
+  }
+  int aging_ms() const { return aging_ms_.load(); }
+
   const char* LastError() {
     // thread_local snapshot: the pointer stays valid on THIS thread until
     // its next LastError() call — concurrent callers cannot invalidate it
@@ -264,11 +297,64 @@ class Engine {
   void FinishDepLocked(Op* op) { FinishDep(op); }
 
   void Enqueue(Op* op) {
+    op->enq = std::chrono::steady_clock::now();
     {
       std::unique_lock<std::mutex> lk(ready_mu_);
-      ready_.push_back(op);
+      ready_[op->pri].push_back(op);
     }
     ready_cv_.notify_one();
+  }
+
+  // ready_mu_ must be held
+  bool AnyReadyLocked() const {
+    for (int c = 0; c < kClasses; ++c)
+      if (!ready_[c].empty()) return true;
+    return false;
+  }
+
+  // ready_mu_ must be held. Effective class of a queue head = its class
+  // minus one per aging_ms_ waited, FLOORED at class 0: promoted work can
+  // tie the high class but never outrank it — a decode turn's dispatch
+  // wait stays bounded by one running task no matter how stale the
+  // backlog, while promoted background beats fresh normal work. Ties go
+  // to the NATIVE high class first, then to the longest-waiting head
+  // (fairness among promoted classes). Per-class queues are FIFO, so the
+  // head is each class's oldest — the candidate aging promoted furthest.
+  Op* PopBestLocked() {
+    const auto now = std::chrono::steady_clock::now();
+    const int aging = aging_ms_.load();
+    int best = -1;
+    long best_eff = 0;
+    bool best_promoted = false;
+    std::chrono::steady_clock::time_point best_enq;
+    for (int c = 0; c < kClasses; ++c) {
+      if (ready_[c].empty()) continue;
+      Op* head = ready_[c].front();
+      long eff = c;
+      if (aging > 0) {
+        long waited = std::chrono::duration_cast<std::chrono::milliseconds>(
+                          now - head->enq)
+                          .count();
+        eff -= waited / aging;
+        if (eff < 0) eff = 0;
+      }
+      const bool promoted = c != 0;
+      const bool better =
+          best < 0 || eff < best_eff ||
+          (eff == best_eff && !promoted && best_promoted) ||
+          (eff == best_eff && promoted == best_promoted &&
+           head->enq < best_enq);
+      if (better) {
+        best = c;
+        best_eff = eff;
+        best_promoted = promoted;
+        best_enq = head->enq;
+      }
+    }
+    if (best < 0) return nullptr;
+    Op* op = ready_[best].front();
+    ready_[best].pop_front();
+    return op;
   }
 
   void WorkerLoop() {
@@ -276,10 +362,9 @@ class Engine {
       Op* op;
       {
         std::unique_lock<std::mutex> lk(ready_mu_);
-        ready_cv_.wait(lk, [&] { return shutdown_ || !ready_.empty(); });
-        if (shutdown_ && ready_.empty()) return;
-        op = ready_.front();
-        ready_.pop_front();
+        ready_cv_.wait(lk, [&] { return shutdown_ || AnyReadyLocked(); });
+        if (shutdown_ && !AnyReadyLocked()) return;
+        op = PopBestLocked();
       }
       op->fn(op->arg);
       Complete(op);
@@ -361,9 +446,11 @@ class Engine {
   std::mutex err_mu_;
   std::string last_error_;
 
+  std::atomic<int> aging_ms_{100};
+
   std::mutex ready_mu_;
   std::condition_variable ready_cv_;
-  std::deque<Op*> ready_;
+  std::deque<Op*> ready_[kClasses];
   bool shutdown_ = false;
 };
 
@@ -383,6 +470,17 @@ void MXTPUEnginePush(void* h, void (*fn)(void*), void* arg,
                      const uint64_t* reads, int nreads, const uint64_t* writes,
                      int nwrites) {
   static_cast<Engine*>(h)->Push(fn, arg, reads, nreads, writes, nwrites);
+}
+void MXTPUEnginePushPri(void* h, void (*fn)(void*), void* arg,
+                        const uint64_t* reads, int nreads,
+                        const uint64_t* writes, int nwrites, int pri) {
+  static_cast<Engine*>(h)->Push(fn, arg, reads, nreads, writes, nwrites, pri);
+}
+void MXTPUEngineSetAgingMs(void* h, int ms) {
+  static_cast<Engine*>(h)->SetAgingMs(ms);
+}
+int MXTPUEngineGetAgingMs(void* h) {
+  return static_cast<Engine*>(h)->aging_ms();
 }
 void MXTPUEngineWaitForVar(void* h, uint64_t v) {
   static_cast<Engine*>(h)->WaitForVar(v);

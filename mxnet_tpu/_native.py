@@ -11,13 +11,18 @@ exception_ptrs on WaitForVar/WaitAll).
 from __future__ import annotations
 
 import atexit
+import contextlib
 import ctypes
 import itertools
 import os
 import subprocess
 import threading
-from concurrent.futures import Future
+from concurrent.futures import Future, InvalidStateError
 from pathlib import Path
+
+from ._engine_common import (FailureLog, failure_site,
+                             reraise_unless_cancelled, set_exc as _set_exc)
+from .base import MXNetError
 
 __all__ = ["NativeEngine"]
 
@@ -51,6 +56,12 @@ def _load():
     lib.MXTPUEngineDelVar.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
     lib.MXTPUEnginePush.argtypes = [ctypes.c_void_p, _CB, ctypes.c_void_p,
                                     _U64A, ctypes.c_int, _U64A, ctypes.c_int]
+    lib.MXTPUEnginePushPri.argtypes = [ctypes.c_void_p, _CB, ctypes.c_void_p,
+                                       _U64A, ctypes.c_int, _U64A,
+                                       ctypes.c_int, ctypes.c_int]
+    lib.MXTPUEngineSetAgingMs.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.MXTPUEngineGetAgingMs.restype = ctypes.c_int
+    lib.MXTPUEngineGetAgingMs.argtypes = [ctypes.c_void_p]
     lib.MXTPUEngineWaitForVar.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
     lib.MXTPUEngineWaitAll.argtypes = [ctypes.c_void_p]
     lib.MXTPUEngineWaitAllFor.restype = ctypes.c_int
@@ -83,6 +94,9 @@ class NativeEngine:
         self._lock = threading.Lock()
         self._poisoned = {}          # native var id -> exception
         self._pending = set()        # futures not yet completed
+        self._failures = FailureLog()
+        self._hcv = threading.Condition(threading.Lock())
+        self._inflight = 0           # C calls currently holding the handle
         self._trampoline = _CB(self._run)  # must outlive all pushes
         atexit.register(self._shutdown)
 
@@ -90,55 +104,134 @@ class NativeEngine:
     def _run(self, key):
         with self._lock:
             fn, fut, read_ids, write_ids = self._tasks.pop(key)
+        if fut.cancelled():
+            return  # externally cancelled before running: skip, no poison
         try:
             with self._lock:
                 for v in read_ids + write_ids:
                     if v in self._poisoned:
                         raise self._poisoned[v]
-            fut.set_result(fn())
-        except BaseException as exc:  # noqa: BLE001 — stored, not swallowed
+        except BaseException as exc:   # dependency re-raise: NOT a root cause
             with self._lock:
                 for v in write_ids:
                     self._poisoned[v] = exc
-            fut.set_exception(exc)
+            _set_exc(fut, exc)
+            return
+        try:
+            res = fn()
+        except BaseException as exc:  # noqa: BLE001 — stored, not swallowed
+            self._record_failure(fn, exc)
+            with self._lock:
+                for v in write_ids:
+                    self._poisoned[v] = exc
+            _set_exc(fut, exc)
+        else:
+            try:
+                fut.set_result(res)
+            except InvalidStateError:
+                pass                   # raced an external cancel
+
+    # sticky per-instance failure report: ROOT-CAUSE task errors only
+    # (dependency re-raises recorded once at the source; cancelled /
+    # skipped tasks never run fn, so they cannot appear here) — parity
+    # with engine._PyEngine.failures()
+    def _record_failure(self, fn, exc):
+        self._failures.record(failure_site(fn), exc)
+
+    def failures(self):
+        return self._failures.list()
+
+    def clear_failures(self):
+        return self._failures.clear()
+
+    @contextlib.contextmanager
+    def _live(self):
+        """Hold the native handle across ONE C call. close()/_shutdown
+        null `_h` and then WAIT for in-flight holders before deleting the
+        engine, so use-after-close raises MXNetError instead of handing a
+        freed (or null) Engine* to native code — including the race where
+        close() lands between the handle read and the C call."""
+        with self._hcv:
+            h = self._h
+            if not h:
+                raise MXNetError("NativeEngine is closed")
+            self._inflight += 1
+        try:
+            yield h
+        finally:
+            with self._hcv:
+                self._inflight -= 1
+                if not self._inflight:
+                    self._hcv.notify_all()
 
     def _var_id(self, var):
         vid = getattr(var, "_native_id", None)
         if vid is None:
-            vid = self._lib.MXTPUEngineNewVar(self._h)
+            with self._live() as h:
+                vid = self._lib.MXTPUEngineNewVar(h)
             var._native_id = vid
         return vid
 
-    def _push_impl(self, fn, read_vars, write_vars, dedup, native_push):
+    def del_var(self, nid):
+        """Release one native var id (facade file-var eviction). A closed
+        engine already freed every native var — soft no-op."""
+        try:
+            with self._live() as h:
+                self._lib.MXTPUEngineDelVar(h, nid)
+        except MXNetError:
+            pass
+
+    def _push_impl(self, fn, read_vars, write_vars, dedup, native_push,
+                   priority=None):
         """Shared body of push and the debug push variants: task + future
         bookkeeping, per-var future mirroring (so wait_* rethrow semantics
         match _PyEngine — failed readers included), then the C call."""
-        read_ids = list(dict.fromkeys(self._var_id(v) for v in read_vars))
-        write_ids = list(dict.fromkeys(self._var_id(v) for v in write_vars))
-        if dedup:
-            read_ids = [v for v in read_ids if v not in write_ids]
-        fut = Future()
-        key = next(self._ids)
-        with self._lock:
-            self._tasks[key] = (fn, fut, read_ids, write_ids)
-            self._pending.add(fut)
-        fut.add_done_callback(self._discard)
-        for v in read_vars:
-            with v._lock:
-                v._reads.append(fut)
-        for v in write_vars:
-            with v._lock:
-                v._last_write = fut
-                v._reads = []
-        ra = (ctypes.c_uint64 * len(read_ids))(*read_ids)
-        wa = (ctypes.c_uint64 * len(write_ids))(*write_ids)
-        native_push(self._h, self._trampoline, ctypes.c_void_p(key),
-                    ra, len(read_ids), wa, len(write_ids))
+        with self._live() as h:   # held across the bookkeeping + C call:
+            # a concurrent close() cannot delete the engine mid-push
+            read_ids = list(dict.fromkeys(self._var_id(v)
+                                          for v in read_vars))
+            write_ids = list(dict.fromkeys(self._var_id(v)
+                                           for v in write_vars))
+            if dedup:
+                read_ids = [v for v in read_ids if v not in write_ids]
+            fut = Future()
+            key = next(self._ids)
+            with self._lock:
+                self._tasks[key] = (fn, fut, read_ids, write_ids)
+                self._pending.add(fut)
+            fut.add_done_callback(self._discard)
+            for v in read_vars:
+                with v._lock:
+                    v._reads.append(fut)
+            for v in write_vars:
+                with v._lock:
+                    v._last_write = fut
+                    v._reads = []
+            ra = (ctypes.c_uint64 * len(read_ids))(*read_ids)
+            wa = (ctypes.c_uint64 * len(write_ids))(*write_ids)
+            if priority is None:
+                native_push(h, self._trampoline, ctypes.c_void_p(key),
+                            ra, len(read_ids), wa, len(write_ids))
+            else:
+                native_push(h, self._trampoline, ctypes.c_void_p(key),
+                            ra, len(read_ids), wa, len(write_ids),
+                            int(priority))
         return fut
 
-    def push(self, fn, read_vars=(), write_vars=()):
+    def push(self, fn, read_vars=(), write_vars=(), priority=1):
         return self._push_impl(fn, read_vars, write_vars, dedup=True,
-                               native_push=self._lib.MXTPUEnginePush)
+                               native_push=self._lib.MXTPUEnginePushPri,
+                               priority=priority)
+
+    def set_aging_ms(self, ms):
+        """Starvation-aging interval: a queued op's effective priority
+        class drops by one per `ms` waited (0 disables aging)."""
+        with self._live() as h:
+            self._lib.MXTPUEngineSetAgingMs(h, int(ms))
+
+    def get_aging_ms(self):
+        with self._live() as h:
+            return int(self._lib.MXTPUEngineGetAgingMs(h))
 
     def _discard(self, fut):
         with self._lock:
@@ -146,46 +239,59 @@ class NativeEngine:
 
     def wait_for_var(self, var):
         vid = getattr(var, "_native_id", None)
-        if vid is not None and self._h:
-            self._lib.MXTPUEngineWaitForVar(self._h, vid)
+        if vid is not None:
+            try:
+                with self._live() as h:
+                    self._lib.MXTPUEngineWaitForVar(h, vid)
+            except MXNetError:
+                pass   # closed: _shutdown's WaitAll already drained
         with var._lock:
             futs = list(var._reads)
             if var._last_write is not None:
                 futs.append(var._last_write)
         for f in futs:
-            f.result()
+            reraise_unless_cancelled(f)
 
     def wait_for_all(self):
         # Snapshot before the native wait, exactly like _PyEngine snapshots
         # _pending: failures in flight at call time are rethrown.
         with self._lock:
             futs = list(self._pending)
-        if self._h:
-            self._lib.MXTPUEngineWaitAll(self._h)
+        try:
+            with self._live() as h:
+                self._lib.MXTPUEngineWaitAll(h)
+        except MXNetError:
+            pass       # closed: _shutdown's WaitAll already drained
         for f in futs:
-            f.result()
+            reraise_unless_cancelled(f)
 
     # -- debug / race-detector surface (MXTPU_ENGINE_DEBUG=1) ---------------
     def set_debug(self, on):
-        self._lib.MXTPUEngineSetDebug(self._h, 1 if on else 0)
+        with self._live() as h:
+            self._lib.MXTPUEngineSetDebug(h, 1 if on else 0)
 
     def debug_enabled(self):
-        return bool(self._lib.MXTPUEngineDebugEnabled(self._h))
+        with self._live() as h:
+            return bool(self._lib.MXTPUEngineDebugEnabled(h))
 
     def debug_check(self):
         """Returns 0 if per-var invariants hold, 1 if a hazard was found
         (details in last_error)."""
-        return int(self._lib.MXTPUEngineDebugCheck(self._h))
+        with self._live() as h:
+            return int(self._lib.MXTPUEngineDebugCheck(h))
 
     def last_error(self):
-        return (self._lib.MXTPUEngineLastError(self._h) or b"").decode()
+        with self._live() as h:
+            return (self._lib.MXTPUEngineLastError(h) or b"").decode()
 
     def clear_error(self):
-        self._lib.MXTPUEngineClearError(self._h)
+        with self._live() as h:
+            self._lib.MXTPUEngineClearError(h)
 
     def wait_for_all_timeout(self, timeout_ms):
         """0 = drained; 1 = stall/deadlock suspected (work still pending)."""
-        return int(self._lib.MXTPUEngineWaitAllFor(self._h, timeout_ms))
+        with self._live() as h:
+            return int(self._lib.MXTPUEngineWaitAllFor(h, timeout_ms))
 
     def _debug_push_raw(self, fn, read_vars=(), write_vars=()):
         """TEST ONLY: push without the Python-side reads/writes dedup so
@@ -201,7 +307,15 @@ class NativeEngine:
             native_push=self._lib.MXTPUEngineDebugBypassPush)
 
     def _shutdown(self):
-        h, self._h = self._h, None
+        with self._hcv:
+            h, self._h = self._h, None
+            while self._inflight:      # wait out in-flight C calls: the
+                self._hcv.wait()       # handle must not be freed under them
         if h:
             self._lib.MXTPUEngineWaitAll(h)
             self._lib.MXTPUEngineDelete(h)
+
+    def close(self):
+        """Drain and stop the native worker threads (parity with
+        _PyEngine.close for transient instances; also runs at exit)."""
+        self._shutdown()

@@ -193,7 +193,8 @@ def _step_path(directory, step):
     return os.path.abspath(os.path.join(directory, str(step)))
 
 
-def save_sharded(directory, step, params, _async=False, extras=None):
+def save_sharded(directory, step, params, _async=False, extras=None,
+                 _group=None):
     """Sharded distributed checkpoint via Orbax (multi-host resume path),
     committed atomically: Orbax writes into a hidden tmp dir, `extras`
     (name -> bytes sidecars) land beside it, the checksum manifest is
@@ -201,8 +202,12 @@ def save_sharded(directory, step, params, _async=False, extras=None):
 
     params: pytree of jax arrays (possibly sharded over a Mesh).
     _async=True pushes the whole save through the dependency engine on
-    the step dir's file_var and returns the Future; readers of the same
-    path (load_sharded/validate via the engine) order after it."""
+    the step dir's file_var — BACKGROUND priority, so serve decode turns
+    and other latency-critical engine work preempt a queued save at
+    dispatch time — and returns the Future; readers of the same path
+    (load_sharded/validate via the engine) order after it. `_group`
+    attaches the task to an engine TaskGroup (CheckpointManager passes
+    its own so queued saves are cancellable as a unit)."""
     from . import engine
     directory = os.path.abspath(directory)
     os.makedirs(directory, exist_ok=True)
@@ -255,8 +260,27 @@ def save_sharded(directory, step, params, _async=False, extras=None):
         return final
 
     if _async:
-        return engine.push(lambda: _policy().call(do_save),
-                           write_vars=[engine.file_var(final)])
+        try:
+            return engine.push(lambda: _policy().call(do_save),
+                               write_vars=[engine.file_var(final)],
+                               priority=engine.PRIORITY_BACKGROUND,
+                               group=_group)
+        except engine.EngineQueueFull:
+            # bounded background class (`reject` policy): save
+            # SYNCHRONOUSLY — backpressure blocks the caller for one
+            # save rather than dropping a checkpoint or crashing the
+            # step; errors ride the returned future so wait() keeps its
+            # re-raise contract. Order after any QUEUED save of the same
+            # step first (they serialize on file_var(final)): two writers
+            # interleaving in the step's deterministic tmp dir would
+            # rename a torn tree. inline_future(write_vars=) takes the
+            # var's write slot ATOMICALLY before waiting, so two degraded
+            # savers of the same step serialize too (a separate
+            # wait-then-run would let both pass the wait). A poisoned var
+            # re-raises on the future exactly as a queued dependent would.
+            return engine.inline_future(lambda: _policy().call(do_save),
+                                        site="checkpoint.do_save",
+                                        write_vars=[engine.file_var(final)])
     return _policy().call(do_save)
 
 
@@ -309,10 +333,15 @@ class CheckpointManager:
     optional async saves, and a SIGTERM emergency save."""
 
     def __init__(self, directory, max_to_keep=3):
+        from . import engine
         self.directory = os.path.abspath(directory)
         self.max_to_keep = max_to_keep
         self._pending = []            # in-flight async save futures
         self._emergency = None
+        # every async save + its prune ride in one cancellable engine
+        # TaskGroup: queued-not-started saves can be dropped as a unit
+        # (cancel_pending) when a preemption makes them moot
+        self._group = engine.TaskGroup("checkpoint")
         os.makedirs(self.directory, exist_ok=True)
 
     def steps(self):
@@ -335,12 +364,38 @@ class CheckpointManager:
         rides in the same engine task); `wait()` drains."""
         if _async:
             fut = save_sharded(self.directory, step, params, _async=True,
-                               extras=extras)
+                               extras=extras, _group=self._group)
             # prune AFTER the save lands, ordered on the same file_var
             from . import engine
             path = _step_path(self.directory, step)
-            done = engine.push(lambda: self._prune(step),
-                               read_vars=[engine.file_var(path)])
+
+            def prune_after(fut=fut, step=step):
+                # a SHED/cancelled save resolves its var CLEANLY (skip
+                # sentinel, by design) — nothing landed, so pruning with
+                # `step` as just_saved would count a phantom step and
+                # evict a valid old checkpoint; a FAILED engine save
+                # poisons the var and this task never runs. A failed
+                # SYNC-FALLBACK save (bounded class, reject policy)
+                # never wrote the var: its error is already recorded and
+                # rides `fut` for wait() — skip the prune rather than
+                # re-raise it here as a phantom prune root cause
+                if fut.exception() is not None:
+                    return None
+                if engine.skipped(fut.result()):
+                    return None
+                return self._prune(step)
+
+            try:
+                done = engine.push(prune_after,
+                                   read_vars=[engine.file_var(path)],
+                                   priority=engine.PRIORITY_BACKGROUND,
+                                   group=self._group)
+            except engine.EngineQueueFull:
+                # skip this round's prune rather than block the trainer
+                # on the save: retention recomputes from the full
+                # post-save listing, so the next successful save's prune
+                # self-heals the missed one
+                done = None
             # compact only futures that finished CLEANLY — a failed save
             # must stay queued so wait() honours its re-raise contract.
             # Bounded for fire-and-forget users who never call wait():
@@ -359,7 +414,8 @@ class CheckpointManager:
                         failed.pop(0).exception())
                 self._pending = failed + live
             self._pending.append(fut)
-            self._pending.append(done)
+            if done is not None:
+                self._pending.append(done)
             return fut
         path = save_sharded(self.directory, step, params, extras=extras)
         self._prune(step)
@@ -401,6 +457,17 @@ class CheckpointManager:
         if first_exc is not None:
             raise first_exc
 
+    def cancel_pending(self, drain_timeout=None):
+        """Cancel queued-not-started async saves/prunes (engine TaskGroup
+        cancel — their futures resolve to `engine.CANCELLED`, nothing is
+        poisoned and no failure is recorded) and wait for in-flight ones
+        to settle. A preemption handler calls this before the emergency
+        save so stale queued saves cannot delay the one that matters.
+        Returns the number of cancelled tasks."""
+        n = self._group.cancel()
+        self._group.drain(drain_timeout)
+        return n
+
     def restore_latest(self, template, validate=True):
         """Restore the newest VALID step (manifest-checked); torn or
         unreadable steps are skipped — each skip counts into the
@@ -436,6 +503,11 @@ class CheckpointManager:
         from .fault import preemption as _pre
 
         def emergency():
+            # stale queued async saves/prunes must not compete with the
+            # emergency save for workers/disk: cancel queued-not-started
+            # ones, bounded drain of in-flight (a wedged save must not
+            # stall the SIGTERM grace window)
+            self.cancel_pending(drain_timeout=30.0)
             step = step_fn() if step_fn is not None else \
                 (self.steps()[-1] + 1 if self.steps() else 0)
             extras = extras_fn() if extras_fn is not None else None

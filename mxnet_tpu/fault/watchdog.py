@@ -124,6 +124,11 @@ class StepWatchdog:
             "reason": reason,
             "engine_last_error": engine.last_error(),
             "engine_failures": engine.failures(),
+            # per-task queue state (site/class/group/age/overdue, oldest
+            # first): a stall post-mortem names WHICH task wedged the
+            # drain and what was queued behind it — e.g. a stuck
+            # background save ahead of high-priority decode turns
+            "engine_pending": engine.pending_report(),
             "trace": trace_path,
             "metrics": _reg.snapshot(),
         }

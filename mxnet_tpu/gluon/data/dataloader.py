@@ -105,6 +105,7 @@ class DataLoader:
         state = {"closed": False}
         pending = deque()
         it = iter(self._batch_sampler)
+        group = engine.TaskGroup("dataloader")
 
         def submit():
             try:
@@ -116,7 +117,15 @@ class DataLoader:
                 if state["closed"]:
                     return None
                 return self._make_batch(idx)
-            pending.append(engine.push(make_batch))
+            try:
+                fut = group.push(make_batch,
+                                 priority=engine.PRIORITY_BACKGROUND)
+            except engine.EngineQueueFull:
+                # bounded background class under the `reject` policy:
+                # backpressure must not crash the epoch — the skipped
+                # path below batchifies inline, same as a shed task
+                fut = engine.skipped_future()
+            pending.append((fut, indices))
             return True
 
         try:
@@ -124,14 +133,22 @@ class DataLoader:
                 if not submit():
                     break
             while pending:
-                fut = pending.popleft()
+                fut, indices = pending.popleft()
                 submit()
-                yield fut.result()
+                batch = fut.result()
+                if engine.skipped(batch):
+                    # the batchify task was SHED by a bounded background
+                    # queue (engine.set_queue_limit) before it ran: its
+                    # sampler indices are known, so batchify inline —
+                    # backpressure must not drop training batches
+                    batch = self._make_batch(indices)
+                yield batch
         finally:
             state["closed"] = True
-            if not engine.native_engine_loaded():
-                for fut in pending:
-                    fut.cancel()
+            # TaskGroup cancel works on BOTH engines: queued batchify
+            # tasks never run (futures resolve to engine.CANCELLED);
+            # in-flight ones no-op via the closed flag
+            group.cancel()
             pending.clear()
 
     def _plain_iter(self):

@@ -225,6 +225,11 @@ class PrefetchingIter(DataIter):
         # mutable state rides in this dict instead, like prefetch._State
         self._fstate = {"closed": False}
         self._pending = None
+        from . import engine
+        # fetches ride in a cancellable TaskGroup (ISSUE 7): close()
+        # cancels a queued fetch on BOTH engines, replacing the old
+        # Python-engine-only Future.cancel
+        self._fetch_group = engine.TaskGroup("prefetch_iter")
         self._submit()
 
     @property
@@ -250,22 +255,27 @@ class PrefetchingIter(DataIter):
                 if batch.label is not None:
                     batch.label = place(batch.label, placement)
             return batch
-        self._pending = engine.push(fetch)
+        self._fetch_fn = fetch      # inline fallback for SHED tasks
+        try:
+            self._pending = self._fetch_group.push(
+                fetch, priority=engine.PRIORITY_BACKGROUND)
+        except engine.EngineQueueFull:
+            # bounded background class (`reject` policy): degrade to the
+            # shed path — next() sees the skip sentinel and fetches inline
+            self._pending = engine.skipped_future()
 
     def close(self):
-        """Drop the in-flight prefetch (cancel when still queued, no-op
-        it otherwise). reset() reopens the iterator.
+        """Drop the in-flight prefetch (TaskGroup cancel: a still-queued
+        fetch never runs — its future resolves to engine.CANCELLED — on
+        BOTH engines; an in-flight one no-ops via the closed flag).
+        reset() reopens the iterator.
 
         A fetch that could not be cancelled stays referenced in
         `_pending` so a later reset() DRAINS it before reopening —
         discarding it would let the orphan race the new epoch's first
         fetch over the freshly-reset backing iterator."""
         self._fstate["closed"] = True
-        fut = self._pending
-        if fut is not None:
-            from . import engine
-            if not engine.native_engine_loaded() and fut.cancel():
-                self._pending = None      # never ran; nothing to drain
+        self._fetch_group.cancel()
 
     def __del__(self):
         try:
@@ -307,6 +317,12 @@ class PrefetchingIter(DataIter):
             # the backing iter's StopIteration) — only WORKER ERRORS
             # re-raise out of the future
             batch = fut.result()
+            from . import engine as _eng
+            if _eng.skipped(batch):
+                # the fetch was SHED by a bounded background queue
+                # before it ran (the backing iter never advanced):
+                # fetch inline — backpressure must not drop batches
+                batch = self._fetch_fn()
         except BaseException:
             # surface the worker error promptly, exactly once: the next
             # call prefetches the FOLLOWING batch instead of replaying

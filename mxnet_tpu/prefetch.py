@@ -73,10 +73,12 @@ _sync_h2d_bytes = _reg.counter("prefetch_h2d_sync_bytes")
 
 # ---- global accounting of staging slots that may BLOCK on engine work.
 # A staging task whose SOURCE is itself engine-backed (DataLoader's
-# pipelined batchify) blocks a pool worker while it waits on that future;
-# if such slots ever covered every worker, the batchify tasks they wait
-# on could never run (the Python fallback engine parks dep-waiting tasks
-# ON workers). Pipelines reserve their slots here so that across ALL
+# pipelined batchify) blocks a pool worker while it waits INSIDE its fn
+# on that future; if such slots ever covered every worker, the batchify
+# tasks they wait on could never run (dependency ADMISSION no longer
+# parks workers — both engines dispatch from ready queues — but a fn
+# blocking mid-execution still holds its worker). Pipelines reserve
+# their slots here so that across ALL
 # concurrently-active device pipelines at least one worker stays free;
 # a pipeline that gets 0 must feed staging from a non-engine (inline)
 # source instead — DataLoader._device_iter does exactly that.
@@ -284,6 +286,12 @@ class DevicePrefetcher:
                                             else target)
         depth = DEFAULT_DEPTH if depth is None else int(depth)
         self._reserved = 0
+        # staging is BACKGROUND-class engine work in one cancellable
+        # TaskGroup (ISSUE 7): serve decode turns preempt queued staging
+        # at dispatch time, and close() cancels queued-not-started slots
+        # on BOTH engines via group.cancel() instead of the old
+        # Python-engine-only Future.cancel
+        self._group = engine.TaskGroup("prefetch")
         if hasattr(source, "_host_iter") and hasattr(source, "_plain_iter"):
             # a DataLoader: its pipelined host path blocks staging tasks
             # on engine futures — take slots from the global ledger (the
@@ -327,8 +335,32 @@ class DevicePrefetcher:
                     return place(item, placement)
             return place(item, placement)
 
-        fut = engine.push(prefetch_stage,
-                          write_vars=(self._slot_vars[slot], self._src_var))
+        try:
+            fut = engine.push(prefetch_stage,
+                              write_vars=(self._slot_vars[slot],
+                                          self._src_var),
+                              priority=engine.PRIORITY_BACKGROUND,
+                              group=self._group)
+        except engine.EngineQueueFull:
+            # bounded background class (`reject` policy): stage THIS slot
+            # synchronously instead of raising out of the training loop.
+            # Order after every in-flight stage first — they serialize on
+            # _src_var, so the source iterator must not be advanced
+            # underneath them.
+            try:
+                engine.wait_for_var(self._src_var)
+            except BaseException as poison:
+                # a poisoned source var means an earlier stage failed and
+                # __next__'s recovery has not run yet: advancing the
+                # source inline would consume a real item that
+                # _drop_pending then discards (silently losing a batch —
+                # on the pure engine path a stage queued behind the
+                # poison never runs fn, so the source never moves). Ride
+                # the poison on the fallback future instead: recovery
+                # sees one more tainted slot, the item stays unconsumed.
+                fut = engine.failed_future(poison)
+            else:
+                fut = engine.inline_future(prefetch_stage)
         self._pending.append(fut)
         _depth_delta(+1)
         return True
@@ -361,7 +393,13 @@ class DevicePrefetcher:
                 for _ in range(self._depth):
                     self._submit()
                 raise
-            if res is _EOF or res is _DROPPED:
+            if res is _EOF or res is _DROPPED or engine.skipped(res):
+                if engine.skipped(res):
+                    # a staging slot SHED by a bounded background queue
+                    # (not our own close) is re-staged, not lost — the
+                    # source never advanced, so the pipeline keeps its
+                    # depth; _submit no-ops when closed/exhausted
+                    self._submit()
                 continue          # drain trailing sentinel slots
             if not was_ready and self._delivered >= self._depth:
                 # the accelerator got here first and the slot held a REAL
@@ -388,22 +426,22 @@ class DevicePrefetcher:
 
     # ------------------------------------------------------------ cleanup
     def _drop_pending(self):
-        native = engine.native_engine_loaded()
         while self._pending:
-            fut = self._pending.popleft()
+            self._pending.popleft()
             _depth_delta(-1)
-            if not native:
-                fut.cancel()
 
     def close(self):
-        """Drop the pipeline: queued staging tasks are cancelled (Python
-        engine) or reduced to no-ops via the closed flag (native engine /
-        already-running tasks), and a generator source is closed — an
-        abandoned epoch must not keep consuming the dataset."""
+        """Drop the pipeline: queued-not-started staging tasks are
+        cancelled through the engine TaskGroup (both engines — their
+        futures resolve to engine.CANCELLED without running), in-flight
+        ones are reduced to no-ops via the closed flag, and a generator
+        source is closed — an abandoned epoch must not keep consuming
+        the dataset."""
         st = self._state
         if st.closed:
             return
         st.closed = True
+        self._group.cancel()
         self._drop_pending()
         release_blocking_slots(self._reserved)
         self._reserved = 0

@@ -28,9 +28,10 @@ from . import scheduler
 from . import engine_bridge
 from . import server
 from .kv_pages import PagePool, PageAllocError
-from .scheduler import Request, Scheduler, ServeError, ServeOverloaded
+from .scheduler import (Request, Scheduler, ServeDeadlineExceeded,
+                        ServeError, ServeOverloaded)
 from .server import Server
 
 __all__ = ["Server", "Request", "Scheduler", "PagePool", "PageAllocError",
-           "ServeError", "ServeOverloaded", "kv_pages", "decode",
-           "scheduler", "engine_bridge", "server"]
+           "ServeError", "ServeOverloaded", "ServeDeadlineExceeded",
+           "kv_pages", "decode", "scheduler", "engine_bridge", "server"]

@@ -45,7 +45,7 @@ from .decode import MemoryStateLost
 from .kv_pages import NULL_PAGE, PageAllocError
 
 __all__ = ["Request", "Scheduler", "ServeError", "ServeOverloaded",
-           "StepResult"]
+           "ServeDeadlineExceeded", "StepResult"]
 
 _STREAM_END = object()
 
@@ -58,17 +58,28 @@ class ServeOverloaded(ServeError):
     """Admission queue full — backpressure; retry later."""
 
 
+class ServeDeadlineExceeded(ServeError):
+    """The request's `deadline_ms` elapsed before it finished: it was
+    evicted (queued or mid-decode), its pages freed, and
+    `serve_deadline_expired` counted it."""
+
+
 class Request:
     """One inference request + its result/stream plumbing. Create via
     `Server.submit`; consume via `.result()` / `.stream()` / `.tokens`."""
 
-    def __init__(self, rid, src, max_new_tokens):
+    def __init__(self, rid, src, max_new_tokens, deadline_ms=None):
         self.id = rid
         self.src = src
         self.max_new_tokens = int(max_new_tokens)
+        # absolute monotonic deadline: survives retries/preemptions (the
+        # budget is end-to-end, not per-attempt)
+        self.deadline = None if deadline_ms is None \
+            else time.monotonic() + float(deadline_ms) / 1e3
         self.state = "queued"       # queued|running|done|failed
         self.tokens = []            # generated ids (EOS included if hit)
         self.error = None
+        self._exc = None            # typed failure (ServeDeadlineExceeded)
         self.retries = 0            # fault retries (budget: max_retries)
         self.preemptions = 0        # page-pressure requeues (own budget)
         self.t_submit = time.perf_counter()
@@ -81,6 +92,7 @@ class Request:
         self._chunks = collections.deque()  # streamed tokens + sentinel
         self._chunk_cv = threading.Condition()
         self._inline_sched = None   # set by Server(engine_driven=False)
+        self._on_finish = None      # one-shot scheduler bookkeeping hook
 
     # ------------------------------------------------------- consumer
     @property
@@ -120,6 +132,8 @@ class Request:
             raise ServeError(f"request {self.id} timed out after "
                              f"{timeout}s")
         if self.state == "failed":
+            if self._exc is not None:
+                raise self._exc
             raise ServeError(f"request {self.id} failed: {self.error}")
         return list(self.tokens)
 
@@ -155,6 +169,8 @@ class Request:
                     item = self._chunks.popleft()
             if item is _STREAM_END:
                 if self.state == "failed":
+                    if self._exc is not None:
+                        raise self._exc
                     raise ServeError(
                         f"request {self.id} failed: {self.error}")
                 return
@@ -171,6 +187,9 @@ class Request:
         self.state = state
         self.error = error
         self.t_done = time.perf_counter()
+        cb, self._on_finish = self._on_finish, None
+        if cb is not None:
+            cb()
         with self._chunk_cv:
             self._chunks.append(_STREAM_END)
             self._chunk_cv.notify_all()
@@ -216,6 +235,11 @@ class Scheduler:
         self._lens = np.zeros((s,), np.int32)
         self._queue = collections.deque()
         self._lock = threading.Lock()
+        # live admitted requests carrying a deadline — gates the per-turn
+        # expiry sweep so deadline-free workloads never pay the O(queue)
+        # scan (same idiom as engine._admit's _deadline_queued gate)
+        self._deadline_live = 0
+        self._deadline_lock = threading.Lock()
         # serialises whole turns: step() (engine loop or inline result()
         # cranks from several threads), defrag()'s device remap, and
         # shutdown() must never interleave mid-turn
@@ -234,15 +258,20 @@ class Scheduler:
         self._m_rejected = reg.counter("serve_requests", result="rejected")
         self._m_retries = reg.counter("serve_decode_retries")
         self._m_preempt = reg.counter("serve_page_preemptions")
+        self._m_deadline = reg.counter("serve_deadline_expired")
         self._m_ttft = reg.histogram("serve_ttft_seconds")
         self._m_latency = reg.histogram("serve_request_seconds")
         self._m_step = reg.histogram("serve_decode_step_seconds")
 
     # ------------------------------------------------------------ API
-    def submit(self, src_tokens, max_new_tokens):
+    def submit(self, src_tokens, max_new_tokens, deadline_ms=None):
         """Enqueue a request; returns the `Request` handle. Raises
         `ServeOverloaded` when the bounded admission queue is full and
-        `ServeError` when the `serve.admit` fault point fires."""
+        `ServeError` when the `serve.admit` fault point fires.
+        `deadline_ms` bounds the request END-TO-END (queue wait included):
+        once it elapses the request is evicted wherever it is — queued or
+        mid-decode — with `ServeDeadlineExceeded`, its pages freed and
+        `serve_deadline_expired` counting the eviction."""
         max_new = int(max_new_tokens)
         if max_new < 1:
             raise MXNetError("max_new_tokens must be >= 1")
@@ -270,7 +299,7 @@ class Scheduler:
         with self._lock:
             rid = self._next_id
             self._next_id += 1
-        req = Request(rid, src, max_new)
+        req = Request(rid, src, max_new, deadline_ms=deadline_ms)
         try:
             if _finj.ENABLED:
                 _finj.check("serve.admit", context=f"request {rid}")
@@ -288,6 +317,10 @@ class Scheduler:
                     "later")
             self._queue.append(req)
             self._m_queue.set(len(self._queue))
+            if req.deadline is not None:
+                with self._deadline_lock:
+                    self._deadline_live += 1
+                req._on_finish = self._dec_deadline_live
         if _tracer.ACTIVE:
             _tracer.instant("serve.submit", args={"id": rid})
         return req
@@ -311,6 +344,7 @@ class Scheduler:
 
     def _step_locked(self):
         res = StepResult()
+        self._expire_deadlines()
         res.admitted = self._admit(res)
         active = [(s, r) for s, r in enumerate(self._slots)
                   if r is not None]
@@ -406,6 +440,50 @@ class Scheduler:
         raise MXNetError("scheduler failed to drain")
 
     # ------------------------------------------------------- internals
+    def _dec_deadline_live(self):
+        with self._deadline_lock:
+            self._deadline_live -= 1
+
+    def _expire_deadlines(self):
+        """Evict every request whose end-to-end deadline has elapsed —
+        queued requests leave the admission queue, running ones leave
+        their slot with pages freed — finishing each with a clean
+        `ServeDeadlineExceeded` (serve_deadline_expired counts them).
+        Gated on the live deadline count: a deadline-free workload pays
+        one lock acquire per turn, not an O(queue) sweep."""
+        with self._deadline_lock:
+            if not self._deadline_live:
+                return
+        now = time.monotonic()
+        expired = []
+        with self._lock:
+            stale = [r for r in self._queue
+                     if r.deadline is not None and now > r.deadline]
+            if stale:
+                stale_ids = {id(r) for r in stale}   # O(n) rebuild, not
+                keep = collections.deque(r for r in self._queue  # O(n*k)
+                                         if id(r) not in stale_ids)
+                self._queue = keep
+                self._m_queue.set(len(keep))
+                expired.extend(stale)
+        for s, r in enumerate(self._slots):
+            if r is not None and r.deadline is not None \
+                    and now > r.deadline:
+                self._release_slot(s, r)
+                expired.append(r)
+        for r in expired:
+            self._m_deadline.inc()
+            self._m_failed.inc()
+            r._exc = ServeDeadlineExceeded(
+                f"request {r.id} exceeded its deadline "
+                f"({len(r.tokens)} token(s) generated)")
+            r._finish("failed", "deadline exceeded")
+            if _tracer.ACTIVE:
+                _tracer.instant("serve.deadline_expired",
+                                args={"id": r.id})
+        if expired:
+            self._m_active.set(self.active_count())
+
     def _admit(self, res=None):
         admitted = 0
         while True:

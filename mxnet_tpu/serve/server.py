@@ -93,25 +93,33 @@ class Server:
     def pool(self):
         return self._pool
 
-    def submit(self, src_tokens, max_new_tokens=None):
+    def submit(self, src_tokens, max_new_tokens=None, deadline_ms=None):
         """Enqueue a request; returns its `Request` handle immediately.
         Raises `ServeOverloaded` under backpressure. The handle's
-        `.result(timeout)` / `.stream(timeout)` / `.done()` consume it."""
+        `.result(timeout)` / `.stream(timeout)` / `.done()` consume it.
+
+        `deadline_ms` bounds the request END-TO-END (queue wait
+        included): when it elapses the scheduler evicts the request —
+        queued or mid-decode — with a clean `ServeDeadlineExceeded`,
+        frees its KV pages, and counts it into
+        `serve_deadline_expired`."""
         with self._close_lock:
             if self._closed:
                 raise MXNetError("Server is closed")
             req = self._sched.submit(
                 src_tokens, max_new_tokens if max_new_tokens is not None
-                else self.max_new_tokens)
+                else self.max_new_tokens, deadline_ms=deadline_ms)
             if self._loop is not None:
                 self._loop.kick()
             else:
                 req._inline_sched = self._sched
             return req
 
-    def stream(self, src_tokens, max_new_tokens=None, timeout=None):
+    def stream(self, src_tokens, max_new_tokens=None, timeout=None,
+               deadline_ms=None):
         """Submit + yield generated token ids as they are produced."""
-        req = self.submit(src_tokens, max_new_tokens)
+        req = self.submit(src_tokens, max_new_tokens,
+                          deadline_ms=deadline_ms)
         yield from req.stream(timeout=timeout)
 
     def wait(self, handles=None, timeout=None):

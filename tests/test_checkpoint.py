@@ -269,3 +269,124 @@ def test_resharded_restore_onto_different_device_count():
         np.testing.assert_allclose(np.asarray(out2["w"]), np.asarray(w))
         out1 = checkpoint.load_sharded(d, 0, {"w": jnp.zeros((8, 8))})
         np.testing.assert_allclose(np.asarray(out1["w"]), np.asarray(w))
+
+
+def test_async_save_rejected_by_bounded_queue_falls_back_sync():
+    """QoS backpressure (ISSUE 7 review): an async save whose engine push
+    is REJECTED by a bounded background class (reject policy) falls back
+    to a synchronous save — the checkpoint lands, wait() stays clean,
+    and the deferred prune self-heals on the next unthrottled save."""
+    import threading
+    import time
+    from mxnet_tpu import engine
+    with tempfile.TemporaryDirectory() as d:
+        mgr = checkpoint.CheckpointManager(d, max_to_keep=2)
+        gate = threading.Event()
+        [engine.push(gate.wait) for _ in range(engine.num_workers())]
+        time.sleep(0.05)
+        engine.push(lambda: None, priority=engine.PRIORITY_BACKGROUND)
+        time.sleep(0.05)
+        prev = engine.set_queue_limit(engine.PRIORITY_BACKGROUND, 1,
+                                      "reject")
+        try:
+            fut = mgr.save(1, {"w": jnp.ones(2)}, _async=True)
+            # sync fallback: the step is on disk before any engine drain
+            assert fut.done() and not checkpoint.validate_checkpoint(
+                os.path.join(d, "1"))
+            fut2 = mgr.save(2, {"w": jnp.full((2,), 2.0)}, _async=True)
+            assert fut2.done()
+        finally:
+            engine.set_queue_limit(engine.PRIORITY_BACKGROUND, *prev)
+            gate.set()
+            engine.wait_for_all()
+        mgr.wait()
+        assert mgr.steps() == [1, 2]
+        # prunes were deferred (their pushes rejected too); the next
+        # unthrottled save recomputes retention over the full listing
+        mgr.save(3, {"w": jnp.full((2,), 3.0)})
+        assert mgr.steps() == [2, 3]
+        step, restored = mgr.restore_latest({"w": jnp.zeros(2)})
+        assert step == 3
+
+
+def test_rejected_save_fallback_orders_after_queued_save_of_same_step():
+    """Regression (ISSUE 7 review): the reject-policy sync-save fallback
+    serializes on the step's file_var — with a save of step N queued
+    behind a wedged engine, a rejected re-save of the SAME step must
+    wait for it instead of writing the step dir concurrently (two
+    writers interleaving in the deterministic tmp dir would rename a
+    torn tree)."""
+    import threading
+    import time
+    from mxnet_tpu import engine
+    with tempfile.TemporaryDirectory() as d:
+        mgr = checkpoint.CheckpointManager(d, max_to_keep=4)
+        gate = threading.Event()
+        [engine.push(gate.wait) for _ in range(engine.num_workers())]
+        time.sleep(0.05)
+        first = mgr.save(1, {"w": jnp.ones(2)}, _async=True)  # queued
+        prev = engine.set_queue_limit(engine.PRIORITY_BACKGROUND, 1,
+                                      "reject")
+        results = {}
+
+        def resave():
+            results["fut"] = mgr.save(1, {"w": jnp.full((2,), 2.0)},
+                                      _async=True)
+
+        t = threading.Thread(target=resave)
+        try:
+            t.start()
+            time.sleep(0.2)
+            # the fallback must be PARKED behind the queued save, not
+            # already done (the old code wrote immediately, racing it)
+            assert t.is_alive()
+            assert not first.done()
+        finally:
+            engine.set_queue_limit(engine.PRIORITY_BACKGROUND, *prev)
+            gate.set()
+            t.join(timeout=30)
+        assert not t.is_alive()
+        first.result(timeout=30)
+        results["fut"].result(timeout=30)
+        engine.wait_for_all()
+        # last writer wins, and the step validates (no torn tree)
+        assert not checkpoint.validate_checkpoint(os.path.join(d, "1"))
+        step, restored = mgr.restore_latest({"w": jnp.zeros(2)})
+        assert step == 1
+        np.testing.assert_allclose(np.asarray(restored["w"]),
+                                   np.full((2,), 2.0))
+
+
+def test_cancel_pending_and_emergency_save_cancels_queued_saves():
+    """cancel_pending(): queued-not-started async saves resolve to
+    engine.CANCELLED (no failure, nothing written); the emergency-save
+    callback calls it so stale queued saves cannot compete with the
+    SIGTERM save for workers/disk."""
+    import threading
+    from mxnet_tpu import engine, fault
+    with tempfile.TemporaryDirectory() as d:
+        mgr = checkpoint.CheckpointManager(d, max_to_keep=5)
+        cb = mgr.enable_emergency_save(
+            params_fn=lambda: {"w": jnp.full((2,), 9.0)},
+            step_fn=lambda: 9)
+        gate = threading.Event()
+        eng = engine._get()
+        blockers = [engine.push(gate.wait) for _ in range(eng.workers)]
+        try:
+            futs = [mgr.save(s, {"w": jnp.full((2,), float(s))},
+                             _async=True) for s in (1, 2)]
+            # cancelled members settle at DISPATCH (a worker pops the
+            # skip): open the gate shortly after cb() cancels them, so
+            # its bounded drain completes without waiting out the timeout
+            threading.Timer(0.3, gate.set).start()
+            cb()   # emergency: cancel queued saves, then save step 9 inline
+            for f in futs:
+                assert f.result(timeout=10) is engine.CANCELLED
+        finally:
+            gate.set()
+            mgr.disable_emergency_save()
+            fault.reset_preemption(clear_callbacks=True)
+            fault.uninstall_preemption_handler()
+        engine.wait_for_all()
+        assert mgr.valid_steps() == [9]        # cancelled saves never wrote
+        assert engine.failures() == []         # cancelled is not a failure

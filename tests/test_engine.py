@@ -331,3 +331,546 @@ def test_file_vars_order_save_load_and_recordio(tmp_path):
     for off, p in zip(offsets, payloads):
         magic, lrec = st.unpack("<II", blob[off:off + 8])
         assert magic == 0xced7230a and (lrec & ((1 << 29) - 1)) == len(p)
+
+
+# ---------------------- QoS: priorities / groups / queues (ISSUE 7) --------
+def _engine_kinds():
+    kinds = ["py"]
+    try:
+        from mxnet_tpu._native import NativeEngine  # noqa: F401
+        kinds.append("native")
+    except Exception:
+        pass
+    return kinds
+
+
+def _make_one_worker_engine(kind, aging_ms=100):
+    """Fresh 1-worker engine per TEST (not per collection): shared
+    engines leak worker threads for the session and let one test's
+    wedged tasks poison the next (order-dependent flakes)."""
+    if kind == "py":
+        return _PyEngine(1, aging_ms=aging_ms)
+    from mxnet_tpu._native import NativeEngine
+    eng = NativeEngine(1)
+    eng.set_aging_ms(aging_ms)
+    return eng
+
+
+@pytest.mark.parametrize("kind", _engine_kinds())
+def test_priority_preempts_queued_background(kind):
+    """A high-priority push dispatches before ALL queued background work,
+    even when pushed last (1 worker -> fully deterministic order)."""
+    import threading
+    eng = _make_one_worker_engine(kind)
+    try:
+        order = []
+        gate = threading.Event()
+        eng.push(gate.wait)                   # hold the only worker
+        time.sleep(0.02)                      # let it start
+        for i in range(6):
+            eng.push(lambda i=i: order.append(("bg", i)), priority=2)
+        eng.push(lambda: order.append(("hi", 0)), priority=0)
+        gate.set()
+        eng.wait_for_all()
+        assert order[0] == ("hi", 0), order
+        # background work still ran, FIFO within its class
+        assert [x for x in order if x[0] == "bg"] == [("bg", i)
+                                                      for i in range(6)]
+    finally:
+        eng.close()
+
+
+@pytest.mark.parametrize("kind", _engine_kinds())
+def test_aging_prevents_starvation(kind):
+    """A background task that has waited past the aging ladder beats
+    FRESH normal-class work (promotion), while the native high class
+    still wins its ties — aged background cannot add latency to a
+    decode turn, only to same-or-lower classes."""
+    import threading
+    eng = _make_one_worker_engine(kind, aging_ms=40)
+    try:
+        order = []
+        gate = threading.Event()
+        eng.push(gate.wait, priority=1)       # hold the only worker
+        time.sleep(0.02)
+        eng.push(lambda: order.append("bg-aged"), priority=2)
+        time.sleep(0.25)                      # ages past class distance
+        eng.push(lambda: order.append("norm"), priority=1)
+        eng.push(lambda: order.append("hi"), priority=0)
+        gate.set()
+        eng.wait_for_all()
+        # high first (native class wins ties), then the aged background
+        # beats the fresh normal task
+        assert order == ["hi", "bg-aged", "norm"], order
+    finally:
+        eng.close()
+
+
+def test_task_group_cancel_skips_queued_poisons_nothing():
+    """TaskGroup.cancel: queued-not-started members never run, their
+    futures resolve to engine.CANCELLED in dependency order, the var
+    stays usable (nothing poisoned), and nothing lands in any failure
+    report or trips the race detector."""
+    import threading
+    engine.set_debug(True)
+    engine.clear_error()
+    base_failures = len(engine.failures())
+    v = Var()
+    gate = threading.Event()
+    started = threading.Event()
+    ran = []
+    g = engine.TaskGroup("test.cancel")
+
+    def inflight_fn():
+        started.set()
+        gate.wait(5)
+        ran.append("inflight")
+
+    inflight = g.push(inflight_fn, write_vars=[v])
+    assert started.wait(5)                    # genuinely in flight
+    queued = [g.push(lambda i=i: ran.append(i), write_vars=[v])
+              for i in range(4)]
+    n = g.cancel()
+    assert n == 4
+    gate.set()
+    assert g.drain(timeout=10)
+    assert inflight.result(timeout=5) is not None or True  # completed
+    for f in queued:
+        assert engine.skipped(f.result(timeout=5))
+        assert f.result(timeout=5) is engine.CANCELLED
+    assert ran == ["inflight"]                # in-flight drained, rest skipped
+    # var NOT poisoned: a later writer runs fine
+    assert engine.push(lambda: 7, write_vars=[v]).result(timeout=5) == 7
+    # no failures recorded, race detector quiet, group fully drained
+    assert len(engine.failures()) == base_failures
+    assert engine.debug_check() == 0, engine.last_error()
+    assert g.live() == 0
+    engine.set_debug(False)
+
+
+def test_task_group_leak_free_gauge():
+    """active_groups() returns to zero once a group's tasks settle."""
+    g = engine.TaskGroup("test.leak")
+    assert engine.active_groups() == 0 or g.live() == 0
+    f = g.push(lambda: 1)
+    f.result(timeout=5)
+    assert g.drain(timeout=5)
+    assert g.live() == 0
+    assert engine.active_groups() == 0
+
+
+def test_bounded_queue_reject_policy():
+    """Over-limit background pushes raise EngineQueueFull and count into
+    engine_queue_rejections{class=background}; high-water gauge moves."""
+    import threading
+    from mxnet_tpu.observability import registry
+    rej = registry().counter("engine_queue_rejections",
+                             **{"class": "background"})
+    base = rej.value
+    gate = threading.Event()
+    v = Var()
+    # the gate task runs immediately (leaves the queue); the dep-blocked
+    # tasks below are the deterministic queued-not-started population
+    engine.push(gate.wait, write_vars=[v])
+    time.sleep(0.02)
+    prev = engine.set_queue_limit(engine.PRIORITY_BACKGROUND, 2, "reject")
+    try:
+        f1 = engine.push(lambda: 1, read_vars=[v],
+                         priority=engine.PRIORITY_BACKGROUND)
+        f2 = engine.push(lambda: 2, read_vars=[v],
+                         priority=engine.PRIORITY_BACKGROUND)
+        with pytest.raises(engine.EngineQueueFull):
+            engine.push(lambda: 3, read_vars=[v],
+                        priority=engine.PRIORITY_BACKGROUND)
+        assert rej.value == base + 1
+    finally:
+        engine.set_queue_limit(engine.PRIORITY_BACKGROUND, *prev)
+        gate.set()
+    assert f1.result(timeout=5) == 1 and f2.result(timeout=5) == 2
+    engine.wait_for_all()
+    hw = registry().gauge("engine_queue_high_water",
+                          **{"class": "background"})
+    assert (hw.value or 0) >= 2
+
+
+def test_bounded_queue_shed_oldest_policy():
+    """shed_oldest: the class's oldest queued task is cancelled to make
+    room — its future resolves to engine.CANCELLED, the newcomer runs."""
+    import threading
+    gate = threading.Event()
+    v = Var()
+    engine.push(gate.wait, write_vars=[v])
+    time.sleep(0.02)
+    prev = engine.set_queue_limit(engine.PRIORITY_BACKGROUND, 2,
+                                  "shed_oldest")
+    try:
+        oldest = engine.push(lambda: "a", read_vars=[v],
+                             priority=engine.PRIORITY_BACKGROUND)
+        f2 = engine.push(lambda: "b", read_vars=[v],
+                         priority=engine.PRIORITY_BACKGROUND)
+        f3 = engine.push(lambda: "c", read_vars=[v],
+                         priority=engine.PRIORITY_BACKGROUND)
+    finally:
+        engine.set_queue_limit(engine.PRIORITY_BACKGROUND, *prev)
+        gate.set()
+    assert oldest.result(timeout=5) is engine.CANCELLED
+    assert f2.result(timeout=5) == "b"
+    assert f3.result(timeout=5) == "c"
+    engine.wait_for_all()
+
+
+def test_bounded_queue_block_policy():
+    """block: an over-limit push waits for the class to drain, then
+    proceeds (no rejection, no shed)."""
+    import threading
+    gate = threading.Event()
+    v = Var()
+    engine.push(gate.wait, write_vars=[v])
+    time.sleep(0.02)
+    prev = engine.set_queue_limit(engine.PRIORITY_BACKGROUND, 1, "block")
+    done = []
+    try:
+        engine.push(lambda: done.append(1), read_vars=[v],
+                    priority=engine.PRIORITY_BACKGROUND)
+
+        def over_limit():
+            f = engine.push(lambda: done.append(2), read_vars=[v],
+                            priority=engine.PRIORITY_BACKGROUND)
+            f.result(timeout=10)
+
+        t = threading.Thread(target=over_limit)
+        t.start()
+        time.sleep(0.1)
+        assert not done and t.is_alive()      # blocked at admission
+        gate.set()
+        t.join(timeout=10)
+        assert not t.is_alive()
+    finally:
+        engine.set_queue_limit(engine.PRIORITY_BACKGROUND, *prev)
+        gate.set()
+    engine.wait_for_all()
+    assert sorted(done) == [1, 2]
+
+
+def test_deadline_expires_queued_task_without_poisoning():
+    """A task whose deadline elapses before it starts is skipped: future
+    resolves to engine.EXPIRED, the var stays clean, counter moves."""
+    import threading
+    from mxnet_tpu.observability import registry
+    exp = registry().counter("engine_deadline_expired")
+    base = exp.value
+    gate = threading.Event()
+    v = Var()
+    engine.push(gate.wait, write_vars=[v])
+    fut = engine.push(lambda: "ran", write_vars=[v], deadline_ms=30)
+    time.sleep(0.12)
+    gate.set()
+    assert fut.result(timeout=5) is engine.EXPIRED
+    assert exp.value == base + 1
+    assert engine.push(lambda: 9, write_vars=[v]).result(timeout=5) == 9
+    engine.wait_for_all()
+
+
+def test_inline_future_records_failure_like_an_engine_task():
+    """Regression (ISSUE 7 review): the reject-policy inline fallback
+    must not lose the sticky failure report — a fire-and-forget caller
+    (async save whose future nobody waits) still sees the error in
+    engine.failures() / engine_task_failures."""
+    from mxnet_tpu.observability import registry
+    cnt = registry().counter("engine_task_failures")
+    base_n, base_c = len(engine.failures()), cnt.value
+
+    f = engine.inline_future(lambda: 1 / 0, site="test.inline_save")
+    assert isinstance(f.exception(), ZeroDivisionError)
+    rep = engine.failures()
+    assert len(rep) == base_n + 1 and rep[-1]["site"] == "test.inline_save"
+    assert cnt.value == base_c + 1
+    # the success path records nothing
+    assert engine.inline_future(lambda: 7).result() == 7
+    assert len(engine.failures()) == base_n + 1
+    engine.clear_failures()
+
+
+def test_group_cancel_racing_push_keeps_admission_accounting():
+    """Regression: group.cancel() racing push() must not corrupt the
+    bounded-queue accounting. A record joins its group only AFTER
+    admission, so a concurrent cancel can never decrement a queued count
+    that was never incremented (which used to drive the count negative —
+    or over-admit — under a full reject-policy class)."""
+    import threading
+    pri = engine.PRIORITY_BACKGROUND
+    prev = engine.set_queue_limit(pri, 2, "reject")
+    g = engine.TaskGroup("race")
+    stop = threading.Event()
+
+    def pusher():
+        while not stop.is_set():
+            try:
+                g.push(lambda: None, priority=pri)
+            except engine.EngineQueueFull:
+                pass
+
+    threads = [threading.Thread(target=pusher) for _ in range(4)]
+    try:
+        for t in threads:
+            t.start()
+        for _ in range(200):
+            g.cancel()
+        time.sleep(0.05)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+        g.cancel_and_drain(timeout=10)
+        engine.set_queue_limit(pri, *prev)
+    engine.wait_for_all()
+    assert engine._queued_count[pri] == 0
+    # the class must still admit normally (no phantom occupants either)
+    assert engine.push(lambda: 7, priority=pri).result(timeout=5) == 7
+    assert engine.active_groups() == 0
+
+
+def test_shed_bookkeeping_stays_bounded_behind_pinned_head():
+    """Regression: under shed_oldest, a head record pinned queued by a
+    slow dependency must not let settled records behind it accumulate in
+    the shed deque without bound — compaction keeps it O(limit)."""
+    import threading
+    pri = engine.PRIORITY_BACKGROUND
+    gate = threading.Event()
+    v = Var()
+    engine.push(gate.wait, write_vars=[v])
+    time.sleep(0.02)
+    limit = 8
+    prev = engine.set_queue_limit(pri, limit, "shed_oldest")
+    try:
+        head = engine.push(lambda: "head", read_vars=[v], priority=pri)
+        for _ in range(200):   # each settles while the head stays queued
+            engine.push(lambda: None, priority=pri).result(timeout=5)
+        assert len(engine._queued_records[pri]) <= 4 * limit + 16
+    finally:
+        engine.set_queue_limit(pri, *prev)
+        gate.set()
+    assert head.result(timeout=5) == "head"
+    engine.wait_for_all()
+
+
+@pytest.mark.parametrize("eng", _engines(), ids=lambda e: type(e).__name__)
+def test_instance_failures_parity(eng):
+    """Satellite (ISSUE 7): both engine implementations keep the same
+    sticky per-instance failure report — root causes only, dependency
+    re-raises excluded."""
+    eng.clear_failures()
+    v = Var()
+
+    def boom():
+        raise RuntimeError("qos-boom")
+
+    eng.push(boom, write_vars=[v])
+    dep = eng.push(lambda: 1, read_vars=[v])   # poisoned dependent
+    try:
+        eng.wait_for_all()
+    except RuntimeError:
+        pass
+    assert dep.exception() is not None
+    fails = eng.failures()
+    assert len(fails) == 1, fails              # root cause ONLY
+    assert "qos-boom" in fails[0]["error"]
+    assert fails[0]["site"]
+    eng.clear_failures()
+    assert eng.failures() == []
+
+
+def test_priority_inversion_postmortem_and_aging_resolution(tmp_path):
+    """Satellite (ISSUE 7): wait_for_all_timeout under a priority-inverted
+    queue (background work wedging the workers ahead of queued high-
+    priority tasks) -> the watchdog post-mortem names the inversion via
+    pending_report (class + overdue), and once the wedge releases, aging/
+    priority dispatch runs the high task BEFORE the queued background
+    backlog — the regression this test pins."""
+    import json
+    import threading
+    from mxnet_tpu.fault.watchdog import StepWatchdog
+    order = []
+    gate = threading.Event()
+    nw = engine.num_workers()
+    wedge_group = engine.TaskGroup("test.wedge")
+    for _ in range(nw):                        # wedge EVERY worker
+        wedge_group.push(gate.wait, priority=engine.PRIORITY_BACKGROUND)
+    time.sleep(0.05)
+    for i in range(6):                         # queued background backlog
+        wedge_group.push(lambda i=i: order.append(("bg", i)),
+                         priority=engine.PRIORITY_BACKGROUND)
+    hi = engine.push(lambda: order.append(("hi", 0)),
+                     priority=engine.PRIORITY_HIGH, deadline_ms=60_000)
+    # the queue is inverted NOW: high work queued behind a background wedge
+    assert engine.wait_for_all_timeout(150) == 1
+    wd = StepWatchdog(timeout_ms=100, snapshot_dir=str(tmp_path))
+    path = wd.dump_snapshot(step=7, reason="priority-inverted queue")
+    snap = json.load(open(path))
+    pend = snap["engine_pending"]
+    assert any(p["class"] == "high" and p["state"] == "queued"
+               for p in pend), pend
+    assert any(p["class"] == "background" and p["state"] == "running"
+               for p in pend), pend
+    # release the wedge: the high task completes and the engine drains
+    # (with several workers the exact interleave is concurrent, so the
+    # ORDER pin runs on a 1-worker engine below)
+    gate.set()
+    hi.result(timeout=10)
+    engine.wait_for_all()
+    engine.clear_error()
+    assert ("hi", 0) in order and len(order) == 7
+    assert wedge_group.drain(timeout=10)
+
+    # deterministic resolution pin (1 worker): after the same wedge
+    # shape, priority dispatch runs the queued high task FIRST no matter
+    # how stale the background backlog (promotion floors at the high
+    # class), while the aged background still jumps fresh normal work —
+    # "aging resolves the inversion without unbounding decode latency"
+    eng = _PyEngine(1, aging_ms=100)
+    try:
+        order2 = []
+        gate2 = threading.Event()
+        eng.push(gate2.wait)
+        time.sleep(0.02)
+        eng.push(lambda: order2.append("bg-aged"), priority=2)
+        time.sleep(0.35)                       # ages past 3 intervals
+        eng.push(lambda: order2.append("norm"), priority=1)
+        eng.push(lambda: order2.append("hi"), priority=0)
+        gate2.set()
+        eng.wait_for_all()
+        assert order2 == ["hi", "bg-aged", "norm"], order2
+    finally:
+        eng.close()
+
+
+def test_malformed_aging_env_keeps_default_on_both_engines(monkeypatch):
+    """A malformed MXTPU_ENGINE_AGING_MS keeps the 100ms default on BOTH
+    engines instead of silently disabling aging (the native parser used
+    atoi, which maps "fast" to 0 = aging off); an explicit "0" still
+    disables it."""
+    def make_engines():
+        out = [_PyEngine(1)]
+        try:
+            from mxnet_tpu._native import NativeEngine
+            out.append(NativeEngine(1))
+        except Exception:
+            pass
+        return out
+
+    # int()-accepted forms the native strtol+endptr parse REJECTS must
+    # fall back on the Python side too, or the parity pair runs with
+    # different starvation bounds; strtol-accepted leading whitespace
+    # must parse on both.
+    cases = [("fast", 100), ("0", 0), ("250", 250),
+             ("250 ", 100), ("1_0", 100), (" 250", 250),
+             (str(2**31), 100)]
+    for raw, want in cases:
+        monkeypatch.setenv("MXTPU_ENGINE_AGING_MS", raw)
+        for eng_i in make_engines():
+            try:
+                assert eng_i.get_aging_ms() == want, \
+                    (raw, type(eng_i).__name__)
+            finally:
+                eng_i.close()
+
+
+def test_native_use_after_close_raises_not_segfaults():
+    """close() nulls the handle; any later call must raise MXNetError
+    instead of handing nullptr to C (a use-after-close used to SIGSEGV)."""
+    try:
+        from mxnet_tpu._native import NativeEngine
+    except Exception:
+        pytest.skip("native engine unavailable")
+    from mxnet_tpu.base import MXNetError
+    eng = NativeEngine(1)
+    assert eng.push(lambda: 1).result(timeout=5) == 1
+    eng.close()
+    with pytest.raises(MXNetError):
+        eng.push(lambda: 2)
+    with pytest.raises(MXNetError):
+        eng.get_aging_ms()
+    # close is idempotent and wait_for_all on a closed engine stays a no-op
+    eng.close()
+    eng.wait_for_all()
+
+
+def test_inline_future_write_vars_serializes_degraded_writers():
+    """Two degraded pushers of the same var (reject-policy fallback) must
+    serialize: inline_future(write_vars=) takes the write slot atomically
+    BEFORE waiting, so both cannot pass a wait-then-run window and
+    interleave (the torn-checkpoint hazard in save_sharded's fallback)."""
+    import threading
+
+    v = Var()
+    inflight = [0]
+    peak = [0]
+    lock = threading.Lock()
+
+    def tracked(i):
+        with lock:
+            inflight[0] += 1
+            peak[0] = max(peak[0], inflight[0])
+        try:
+            time.sleep(0.1)
+            return i
+        finally:
+            with lock:
+                inflight[0] -= 1
+
+    futs = [None, None]
+
+    def degraded(i):
+        futs[i] = engine.inline_future(lambda: tracked(i), write_vars=[v])
+
+    ts = [threading.Thread(target=degraded, args=(i,)) for i in range(2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=30)
+    assert sorted(f.result() for f in futs) == [0, 1]
+    assert peak[0] == 1, f"degraded writers overlapped (peak={peak[0]})"
+    # the var's write slot now holds the last inline future: a queued
+    # dependent (or wait_for_var) orders after it and sees no poison
+    engine.wait_for_var(v)
+
+
+def test_push_failure_after_admission_rolls_back_qos_state():
+    """An inner-engine push that raises (bad var object) AFTER the facade
+    admitted the record must roll the admission back: bounded-queue slots
+    are released, the group drains to zero, and pending_report carries no
+    phantom queued entry."""
+    prev_limit, prev_policy = engine.set_queue_limit(
+        engine.PRIORITY_BACKGROUND, 1, "reject")
+    g = engine.TaskGroup("test.rollback")
+    try:
+        for _ in range(3):   # > limit: leaked slots would reject the 2nd
+            with pytest.raises(Exception):
+                engine.push(lambda: None, read_vars=["not-a-var"],
+                            priority=engine.PRIORITY_BACKGROUND, group=g)
+        f = engine.push(lambda: 7, priority=engine.PRIORITY_BACKGROUND,
+                        group=g)
+        assert f.result(timeout=10) == 7
+        assert g.drain(timeout=10)
+        assert engine.active_groups() == 0
+        assert not [p for p in engine.pending_report()
+                    if p.get("group") == "test.rollback"]
+    finally:
+        engine.set_queue_limit(engine.PRIORITY_BACKGROUND,
+                               prev_limit, prev_policy)
+        engine.wait_for_all()
+
+
+def test_py_engine_push_after_close_raises():
+    """Parity with NativeEngine's use-after-close guard: pushing onto a
+    closed _PyEngine must raise, not enqueue onto worker-less ready
+    queues where the future silently never settles (a hang)."""
+    from mxnet_tpu.base import MXNetError
+    eng = _PyEngine(1)
+    assert eng.push(lambda: 1).result(timeout=5) == 1
+    eng.wait_for_all()
+    eng.close()
+    with pytest.raises(MXNetError):
+        eng.push(lambda: 2)
+    eng.close()            # idempotent
+    eng.wait_for_all()     # no-op on a drained closed engine

@@ -433,3 +433,190 @@ def test_prefetching_iter_close_then_reset_immediately():
     got = [pf.next().data[0].asnumpy() for _ in range(3)]
     np.testing.assert_array_equal(np.concatenate(got), data)
     pf.close()
+
+
+def test_shed_background_batchify_falls_back_inline():
+    """QoS backpressure (ISSUE 7): a DataLoader batchify task SHED by a
+    bounded background queue is recomputed inline from its sampler
+    indices — backpressure drops engine work, never training batches."""
+    import threading
+    import time
+    from mxnet_tpu import engine
+    x = np.arange(24, dtype=np.float32).reshape(12, 2)
+    loader = DataLoader(ArrayDataset(x), batch_size=3, prefetch=2)
+    gate = threading.Event()
+    wedges = [engine.push(gate.wait) for _ in range(engine.num_workers())]
+    time.sleep(0.05)
+    prev = engine.set_queue_limit(engine.PRIORITY_BACKGROUND, 1,
+                                  "shed_oldest")
+    try:
+        it = iter(loader)               # queues batchify tasks; sheds fire
+        time.sleep(0.05)
+        gate.set()
+        got = [b.asnumpy() for b in it]
+    finally:
+        engine.set_queue_limit(engine.PRIORITY_BACKGROUND, *prev)
+        gate.set()
+        engine.wait_for_all()
+    np.testing.assert_allclose(np.concatenate(got, axis=0), x)
+    assert all(f.done() for f in wedges)
+
+
+def test_shed_staging_slot_is_restaged_not_lost():
+    """QoS backpressure (ISSUE 7): a DevicePrefetcher staging slot SHED
+    by a bounded background queue is re-staged — the pipeline keeps its
+    depth and delivers every batch in order."""
+    import threading
+    import time
+    from mxnet_tpu import engine
+    from mxnet_tpu.prefetch import DevicePrefetcher
+    gate = threading.Event()
+    engine.push(gate.wait)              # occupy at least one worker
+    for _ in range(max(0, engine.num_workers() - 1)):
+        engine.push(gate.wait)
+    time.sleep(0.05)
+    prev = engine.set_queue_limit(engine.PRIORITY_BACKGROUND, 1,
+                                  "shed_oldest")
+    try:
+        src = [np.full((2, 2), float(i), np.float32) for i in range(6)]
+        pf = DevicePrefetcher(iter(src), depth=2)   # 2nd push sheds 1st
+        time.sleep(0.05)
+        gate.set()
+        out = [b.asnumpy()[0, 0] for b in pf]
+        pf.close()
+    finally:
+        engine.set_queue_limit(engine.PRIORITY_BACKGROUND, *prev)
+        gate.set()
+        engine.wait_for_all()
+    assert out == [float(i) for i in range(6)], out
+
+
+def _wedge_and_fill_background(gate):
+    """Occupy every worker (normal class) and park ONE background dummy
+    in the queue so a limit-1 reject policy bounces every later
+    background push deterministically."""
+    import time
+    from mxnet_tpu import engine
+    wedges = [engine.push(gate.wait) for _ in range(engine.num_workers())]
+    time.sleep(0.05)
+    dummy = engine.push(lambda: None, priority=engine.PRIORITY_BACKGROUND)
+    time.sleep(0.05)
+    return wedges, dummy
+
+
+def test_rejected_background_batchify_falls_back_inline():
+    """QoS backpressure (ISSUE 7 review): a DataLoader batchify push
+    REJECTED by a bounded background queue (reject policy) is computed
+    inline — EngineQueueFull never escapes the training loop."""
+    import threading
+    from mxnet_tpu import engine
+    x = np.arange(24, dtype=np.float32).reshape(12, 2)
+    loader = DataLoader(ArrayDataset(x), batch_size=3, prefetch=2)
+    gate = threading.Event()
+    _wedge_and_fill_background(gate)
+    prev = engine.set_queue_limit(engine.PRIORITY_BACKGROUND, 1, "reject")
+    try:
+        got = [b.asnumpy() for b in loader]   # every push rejects
+    finally:
+        engine.set_queue_limit(engine.PRIORITY_BACKGROUND, *prev)
+        gate.set()
+        engine.wait_for_all()
+    np.testing.assert_allclose(np.concatenate(got, axis=0), x)
+
+
+def test_rejected_staging_slot_staged_synchronously():
+    """QoS backpressure (ISSUE 7 review): a DevicePrefetcher staging push
+    REJECTED by the bounded background class stages the slot
+    synchronously — every batch still arrives, in order."""
+    import threading
+    from mxnet_tpu import engine
+    from mxnet_tpu.prefetch import DevicePrefetcher
+    gate = threading.Event()
+    _wedge_and_fill_background(gate)
+    prev = engine.set_queue_limit(engine.PRIORITY_BACKGROUND, 1, "reject")
+    try:
+        src = [np.full((2, 2), float(i), np.float32) for i in range(6)]
+        pf = DevicePrefetcher(iter(src), depth=2)   # both slots reject
+        out = [b.asnumpy()[0, 0] for b in pf]
+        pf.close()
+    finally:
+        engine.set_queue_limit(engine.PRIORITY_BACKGROUND, *prev)
+        gate.set()
+        engine.wait_for_all()
+    assert out == [float(i) for i in range(6)], out
+
+
+def test_rejected_submit_over_poisoned_source_drops_no_batch():
+    """Regression (ISSUE 7 review): a rejected staging push that finds
+    the source var POISONED by an earlier failure must NOT advance the
+    source inline — the consumed item would be discarded by the failure
+    recovery's _drop_pending, silently losing a batch. The fallback
+    rides the poison instead; after the error surfaces, the item is
+    still there to deliver."""
+    import threading
+    from mxnet_tpu import engine
+    from mxnet_tpu.prefetch import DevicePrefetcher
+
+    class Src:
+        def __init__(self):
+            self.n = 0
+
+        def __iter__(self):
+            return self
+
+        def __next__(self):
+            self.n += 1
+            if self.n == 1:
+                return np.full((2, 2), 1.0, np.float32)
+            if self.n == 2:
+                raise ValueError("bad batch")
+            if self.n == 3:
+                return np.full((2, 2), 3.0, np.float32)
+            raise StopIteration
+
+    pf = DevicePrefetcher(Src(), depth=2)   # s1 fails -> poisons _src_var
+    for f in list(pf._pending):             # let both stages settle
+        try:
+            f.result(timeout=5)
+        except Exception:
+            pass
+    gate = threading.Event()
+    _wedge_and_fill_background(gate)
+    prev = engine.set_queue_limit(engine.PRIORITY_BACKGROUND, 1, "reject")
+    try:
+        first = next(pf)                    # re-arm push rejects, var poisoned
+        assert first.asnumpy()[0, 0] == 1.0
+        with pytest.raises(ValueError, match="bad batch"):
+            next(pf)
+        third = next(pf)                    # the item the bug used to lose
+        assert third.asnumpy()[0, 0] == 3.0
+        with pytest.raises(StopIteration):
+            next(pf)
+        pf.close()
+    finally:
+        engine.set_queue_limit(engine.PRIORITY_BACKGROUND, *prev)
+        gate.set()
+        engine.wait_for_all()
+
+
+def test_rejected_prefetching_iter_fetches_inline():
+    """QoS backpressure (ISSUE 7 review): a PrefetchingIter fetch push
+    REJECTED by the bounded background class falls back to the inline
+    fetch path (same as shed) — no batch lost, no EngineQueueFull."""
+    import threading
+    from mxnet_tpu import engine
+    from mxnet_tpu import io as mio
+    data = np.arange(24, dtype=np.float32).reshape(12, 2)
+    gate = threading.Event()
+    _wedge_and_fill_background(gate)
+    prev = engine.set_queue_limit(engine.PRIORITY_BACKGROUND, 1, "reject")
+    try:
+        base = mio.NDArrayIter(data, np.zeros(12, np.float32), batch_size=4)
+        pf = mio.PrefetchingIter(base)              # arm push rejects
+        got = [pf.next().data[0].asnumpy() for _ in range(3)]
+        pf.close()
+    finally:
+        engine.set_queue_limit(engine.PRIORITY_BACKGROUND, *prev)
+        gate.set()
+        engine.wait_for_all()
+    np.testing.assert_array_equal(np.concatenate(got), data)

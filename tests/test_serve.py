@@ -620,3 +620,177 @@ def test_encode_memory_matches_eager_encoder_bitwise():
     pure = encode_memory(encoder_weights(model), jnp.asarray(src),
                          jnp.asarray(svl))
     assert np.array_equal(eager.asnumpy(), np.asarray(pure))
+
+
+# ------------------------------------------- per-request deadlines (ISSUE 7)
+def test_deadline_expired_in_queue_evicted_cleanly():
+    """A queued request whose deadline elapses before admission fails
+    with ServeDeadlineExceeded — not a generic ServeError — pages stay
+    at baseline and serve_deadline_expired counts it."""
+    from mxnet_tpu.serve import ServeDeadlineExceeded
+    reg = registry()
+    base = reg.counter("serve_deadline_expired").value
+    srv = _server(slots=1, max_new_tokens=8)
+    rng = np.random.RandomState(21)
+    # a long request occupies the only slot...
+    long_h = srv.submit(rng.randint(4, 50, (5,)), max_new_tokens=8)
+    srv.scheduler.step()                 # admit it
+    # ...so this one waits in queue past its deadline
+    doomed = srv.submit(rng.randint(4, 50, (4,)), max_new_tokens=4,
+                        deadline_ms=1)
+    import time
+    time.sleep(0.02)
+    srv.scheduler.step()                 # sweep fires
+    assert doomed.done()
+    with pytest.raises(ServeDeadlineExceeded):
+        doomed.result()
+    assert reg.counter("serve_deadline_expired").value == base + 1
+    srv.scheduler.run_until_idle()
+    assert len(long_h.result()) >= 1     # the slot holder is unaffected
+    assert srv.pool.in_use() == 0
+    srv.close()
+
+
+def test_deadline_expired_mid_decode_frees_pages():
+    """A RUNNING request past its deadline is evicted mid-decode: pages
+    return to the pool, the stream ends with ServeDeadlineExceeded, and
+    other in-flight requests keep decoding."""
+    from mxnet_tpu.serve import ServeDeadlineExceeded
+    srv = _server(slots=2, max_new_tokens=12)
+    rng = np.random.RandomState(22)
+    doomed = srv.submit(rng.randint(4, 50, (5,)), max_new_tokens=12,
+                        deadline_ms=30)
+    other = srv.submit(rng.randint(4, 50, (4,)), max_new_tokens=3)
+    sched = srv.scheduler
+    sched.step()                          # admit both, decode one token
+    import time
+    time.sleep(0.05)                      # doomed's deadline elapses
+    sched.run_until_idle(max_steps=200)
+    with pytest.raises(ServeDeadlineExceeded):
+        doomed.result()
+    assert doomed.state == "failed"
+    assert len(other.result()) >= 1       # neighbour finished normally
+    assert srv.pool.in_use() == 0         # evicted pages freed
+    srv.close()
+
+
+def test_no_deadline_requests_unaffected():
+    """deadline_ms=None (default) keeps the old behaviour bit-for-bit."""
+    srv = _server(max_new_tokens=4)
+    rng = np.random.RandomState(23)
+    h = srv.submit(rng.randint(4, 50, (5,)))
+    assert len(h.result(timeout=60)) >= 1
+    assert srv.pool.in_use() == 0
+    srv.close()
+
+
+def test_engine_loop_survives_injected_task_fault():
+    """QoS hardening (ISSUE 7): an injected engine.task fault that kills
+    a serve loop task must not wedge the server — the loop re-arms on a
+    fresh var (serve_loop_restarts counts it) and every request still
+    completes with zero leaked pages."""
+    from mxnet_tpu import engine
+    reg = registry()
+    base_restarts = reg.counter("serve_loop_restarts").value
+    srv = _server(engine_driven=True, max_new_tokens=6)
+    rng = np.random.RandomState(24)
+    # warm one request through so the executables are compiled and the
+    # fault hits a steady-state loop task
+    srv.submit(rng.randint(4, 50, (4,))).result(timeout=120)
+    # drain BEFORE arming: the warm-up loop task may still be in flight
+    # (result() returns on the last token, the task disarms later) and a
+    # straggler task from an earlier test could otherwise absorb the
+    # at=[1] fault — it must hit the loop task the next submit kicks
+    engine.wait_for_all()
+    finj.inject("engine.task", at=[1])    # the NEXT engine task dies
+    hs = [srv.submit(rng.randint(4, 50, (n,))) for n in (5, 6, 3)]
+    res = [h.result(timeout=120) for h in hs]
+    finj.clear("engine.task")
+    assert all(1 <= len(r) <= 6 for r in res)
+    assert srv.wait(timeout=60)
+    assert srv.pool.in_use() == 0
+    srv.close()
+    assert reg.counter("serve_loop_restarts").value > base_restarts
+    # the fault is VISIBLE (sticky failure report), not swallowed
+    assert any("FaultInjected" in f["error"] for f in engine.failures())
+    engine.clear_failures()
+
+
+def test_engine_loop_survives_high_class_queue_limits():
+    """QoS hardening (ISSUE 7): a bounded HIGH-class queue that sheds or
+    rejects a serve loop task must not leave the loop armed-but-taskless
+    — shed tasks re-push, rejected kicks disarm so the next kick
+    retries."""
+    import threading
+    import time
+    from mxnet_tpu import engine
+    from mxnet_tpu.serve.engine_bridge import EngineLoop
+
+    class FakeSched:
+        def __init__(self, work):
+            self.work = work
+
+        def step(self):
+            if self.work:
+                self.work -= 1
+                return True
+            return False
+
+        def pending_work(self):
+            return self.work > 0
+
+    # shed: wedge every worker, queue the loop task, shed it with a
+    # second high push — the loop must re-push itself and still drain
+    sched = FakeSched(3)
+    loop = EngineLoop(sched)
+    gate = threading.Event()
+    for _ in range(engine.num_workers()):
+        engine.push(gate.wait)
+    time.sleep(0.05)
+    prev = engine.set_queue_limit(engine.PRIORITY_HIGH, 1, "shed_oldest")
+    try:
+        loop.kick()                              # queued loop task
+        engine.push(lambda: None, priority=engine.PRIORITY_HIGH)  # sheds it
+        gate.set()
+        deadline = time.monotonic() + 10
+        while sched.pending_work() and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert not sched.pending_work()          # shed loop task re-pushed
+    finally:
+        engine.set_queue_limit(engine.PRIORITY_HIGH, *prev)
+        gate.set()
+    loop.close()
+    engine.wait_for_all()
+
+    # reject: a kick into a full high queue disarms instead of wedging;
+    # once the limit lifts, the next kick decodes again
+    sched2 = FakeSched(2)
+    loop2 = EngineLoop(sched2)
+    gate2 = threading.Event()
+    blocker = engine.push(gate2.wait, priority=engine.PRIORITY_HIGH)
+    time.sleep(0.05)
+    prev = engine.set_queue_limit(engine.PRIORITY_HIGH, 1, "reject")
+    try:
+        wedge = threading.Event()
+        for _ in range(engine.num_workers()):
+            engine.push(wedge.wait)
+        time.sleep(0.05)
+        # blocker running, workers wedged: one queued high task fills the
+        # limit, so the loop's kick is rejected -> must disarm cleanly
+        engine.push(lambda: None, priority=engine.PRIORITY_HIGH)
+        loop2.kick()
+        assert sched2.pending_work()             # nothing ran yet
+        wedge.set()
+        gate2.set()
+    finally:
+        engine.set_queue_limit(engine.PRIORITY_HIGH, *prev)
+        gate2.set()
+        wedge.set()
+    engine.wait_for_all()
+    loop2.kick()                                 # retried kick proceeds
+    deadline = time.monotonic() + 10
+    while sched2.pending_work() and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert not sched2.pending_work()
+    loop2.close()
+    assert blocker.done()
