@@ -348,6 +348,24 @@ def main():
             print(f"[bench] captured-step bench failed: {e!r}",
                   file=sys.stderr)
 
+    # Rule-sharded captured step (ISSUE 8): steps/s + per-device param
+    # bytes of the (dp,tp) shard plan vs the replicated captured step,
+    # as first-class supervisor fields. Needs >= 4 devices (a (2,2)
+    # mesh); below that the fields are omitted rather than faked.
+    # BENCH_SHARD=0 disables.
+    if not smoke and os.environ.get("BENCH_SHARD") != "0":
+        try:
+            import bench_mlp
+            shres = bench_mlp.measure_shard()
+            if shres.get("value") is not None:
+                result["shard_step_throughput"] = shres["value"]
+                result["shard_param_bytes_per_dev"] = \
+                    shres["shard_param_bytes_per_dev"]
+                result["shard_vs_replicated"] = \
+                    shres["shard_vs_replicated"]
+        except Exception as e:  # pragma: no cover
+            print(f"[bench] shard bench failed: {e!r}", file=sys.stderr)
+
     # Serving headline (ISSUE 6): continuous-batching tokens/s + p99
     # latency under Poisson arrivals, recorded as first-class fields of
     # the supervisor JSON contract alongside the training metric (a serve

@@ -375,6 +375,118 @@ def measure_prefetch(on_result=None):
     return res
 
 
+def measure_shard(on_result=None, axes="dp,tp"):
+    """The `--shard dp,tp` arm (ISSUE 8): steps/s and per-device
+    parameter bytes of the rule-sharded captured step (2-D ('dp','tp')
+    mesh, `shard.DEFAULT_RULES`-style layout) against the replicated
+    captured step on the same MLP and global batch. Needs >= 4 devices
+    (a (2,2) mesh); reports ``value: None`` below that so the supervisor
+    contract fields stay honest on a 1-chip run."""
+    import jax
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, nd, shard
+    from jax.sharding import PartitionSpec as P
+
+    if len(jax.devices()) < 4:
+        res = {"metric": "shard_step_throughput", "value": None,
+               "unit": "samples/sec/chip", "skipped": "needs >= 4 devices"}
+        print("[bench_mlp] shard: skipped (needs >= 4 devices)",
+              file=sys.stderr)
+        if on_result is not None:
+            on_result(res)
+        return res
+
+    batch, steps, X, y, lossf, build = _setup()
+    steps = max(5, steps)
+    # the zoo MLP: 512/256 hidden divide dp=2; the 10-way head weight is
+    # (10, 256) — 10 % 2 == 0, so even the head row-shards
+    rules = ((r"_bias$", None),
+             (r"dense2_weight$", P("tp", None)),
+             (r"_weight$", P("dp", None)),
+             (r".*", None))
+
+    def run(shard_axes):
+        """shard_axes=None: the REPLICATED baseline — the plain 1-D
+        'dp' mesh captured step (params whole on every device)."""
+        mx.random.seed(0)
+        net = build()
+        tr = gluon.Trainer(net.collect_params(), "sgd",
+                           {"learning_rate": 0.05, "momentum": 0.9},
+                           kvstore="ici")
+        plan = None
+        if shard_axes is not None:
+            plan = tr.shard(mesh=shard_axes, rules=rules)
+        else:
+            from mxnet_tpu.parallel.mesh import make_mesh
+            tr._kvstore.set_mesh(make_mesh({"dp": n_chips}))
+        step = tr.capture(lambda a, b: lossf(net(a), b).mean())
+        for _ in range(2):
+            step(X, y)                       # compile + warm
+        fallback = step.last_fallback_reason
+        t0 = time.monotonic()
+        for _ in range(steps):
+            L = step(X, y)
+        float(L.asnumpy())
+        dt = time.monotonic() - t0
+        params = {p.name: p.data()._data
+                  for p in net.collect_params().values()}
+        total = sum(int(np.prod(a.shape)) * a.dtype.itemsize
+                    for a in params.values())
+        per_dev = total if plan is None else \
+            plan.param_bytes_per_device(params)[0]
+        return steps / dt, per_dev, total, fallback
+
+    # `axes` names the mesh axes IN ORDER (first = the data axis);
+    # BENCH_SHARD_MESH gives their sizes — "--shard tp,dp" genuinely
+    # runs a tp-major mesh, not just a different label
+    axis_names = [a.strip() for a in axes.split(",")]
+    sizes = [int(s) for s in os.environ.get("BENCH_SHARD_MESH",
+                                            "2,2").split(",")]
+    if len(axis_names) != len(sizes):
+        # a silent zip-truncation here would run a fully-replicated mesh
+        # while the JSON claims a sharded one
+        raise ValueError(
+            f"--shard names {len(axis_names)} axes ({axes!r}) but "
+            f"BENCH_SHARD_MESH gives {len(sizes)} sizes ({sizes})")
+    mesh_axes = dict(zip(axis_names, sizes))
+    n_chips = 1
+    for s in mesh_axes.values():
+        n_chips *= s
+    shard_steps_s, per_dev, total, fb = run(mesh_axes)
+    repl_steps_s, repl_per_dev, _, repl_fb = run(None)
+    if repl_fb is not None:
+        # a baseline that silently fell back measured the IMPERATIVE
+        # loop — the ratio would compare against the wrong thing
+        print(f"[bench_mlp] WARNING: replicated baseline fell back "
+              f"({repl_fb}); shard_vs_replicated compares against the "
+              f"imperative path", file=sys.stderr)
+    res = {
+        "metric": "shard_step_throughput",
+        "value": round(shard_steps_s * batch / n_chips, 1),
+        "unit": "samples/sec/chip",
+        "axes": axes,
+        "mesh": mesh_axes,
+        "shard_steps_s": round(shard_steps_s, 3),
+        "replicated_steps_s": round(repl_steps_s, 3),
+        "shard_vs_replicated": round(shard_steps_s / repl_steps_s, 3),
+        "shard_param_bytes_per_dev": int(per_dev),
+        "replicated_param_bytes_per_dev": int(repl_per_dev),
+        "param_bytes_total": int(total),
+        "fallback": fb,
+        "replicated_fallback": repl_fb,
+    }
+    print(f"[bench_mlp] shard ({axes}): {shard_steps_s:.2f} steps/s "
+          f"sharded vs {repl_steps_s:.2f} replicated "
+          f"({res['shard_vs_replicated']}x); param bytes/dev "
+          f"{per_dev} vs {repl_per_dev} replicated "
+          f"({per_dev / total:.2f}x of total)", file=sys.stderr)
+    if on_result is not None:
+        on_result(res)
+    return res
+
+
 def main():
     args = sys.argv[1:]
     # --prefetch wants >= 2 devices so the mesh placement path is what's
@@ -386,6 +498,13 @@ def main():
             os.environ.get("XLA_FLAGS", ""):
         os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
                                    " --xla_force_host_platform_device_count=2")
+    # --shard wants >= 4 (a (2,2) mesh) — same dance
+    if "--shard" in args and "jax" not in sys.modules \
+            and os.environ.get("JAX_PLATFORMS", "") == "cpu" \
+            and "xla_force_host_platform_device_count" not in \
+            os.environ.get("XLA_FLAGS", ""):
+        os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                                   " --xla_force_host_platform_device_count=4")
     # honor JAX_PLATFORMS=cpu despite the axon sitecustomize (same dance
     # as bench.py — jax.config wins if set before backend init)
     if os.environ.get("JAX_PLATFORMS") == "cpu":
@@ -397,6 +516,12 @@ def main():
         return
     if "--prefetch" in args:
         print(json.dumps(measure_prefetch()))
+        return
+    if "--shard" in args:
+        i = args.index("--shard")
+        axes = (args[i + 1] if len(args) > i + 1
+                and not args[i + 1].startswith("-") else "dp,tp")
+        print(json.dumps(measure_shard(axes=axes)))
         return
     if "--trace" in args:
         i = args.index("--trace")

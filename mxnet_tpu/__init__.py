@@ -75,6 +75,7 @@ from . import serve
 from . import contrib
 from . import prefetch
 from .prefetch import DevicePrefetcher
+from . import shard
 from . import cachedop
 from .cachedop import jit_step, CachedStep
 from .util import waitall

@@ -83,7 +83,7 @@ _fallback_counters = {}  # reason -> Counter cachedop_fallbacks{reason=}
 # cache-key layout; positions feed miss-reason classification
 _KEY_FIELDS = ("shape_change", "param_change", "state_change", "scale_mode",
                "hyper_change", "autocast", "mesh", "sharded", "grad_reduce",
-               "clip")
+               "clip", "plan")
 
 
 def _miss(reason):
@@ -130,6 +130,19 @@ def _dev0_view(a):
         return a.addressable_shards[0].data
     except Exception:
         return a
+
+
+def _logical_view(a):
+    """The value eager code should see for a mesh output: a replicated
+    array collapses to its zero-copy device-0 shard view; a genuinely
+    SHARDED array (rule-driven FSDP/TP layout) IS its own logical value —
+    it stays mesh-resident so the next step pays no re-placement and
+    per-device memory stays at the shard size (.asnumpy()/save still see
+    the full logical array)."""
+    spec = getattr(getattr(a, "sharding", None), "spec", None)
+    if spec is not None and any(e is not None for e in tuple(spec)):
+        return a
+    return _dev0_view(a)
 
 
 def jit_step(trainer, loss_fn, **kwargs):
@@ -222,6 +235,20 @@ class CachedStep:
         try:
             return self._captured(batch_nd, batch_size)
         except _CaptureUnsupported as e:
+            kv = getattr(self._trainer, "_kvstore", None)
+            if kv is not None and getattr(kv, "_shard_plan", None) \
+                    is not None:
+                # with a shard plan the params/optimizer state live
+                # SHARDED between steps — the imperative path would mix
+                # mesh-resident and host arrays and train garbage, so
+                # the fallback is NOT transparent here (fallback matrix:
+                # docs/PERFORMANCE.md "Parameter sharding")
+                raise MXNetError(
+                    f"captured step with a shard plan cannot fall back "
+                    f"to the imperative path (reason: {e.reason}); fix "
+                    f"the configuration or detach the plan "
+                    f"(kvstore.set_mesh) before training imperatively"
+                ) from e
             self.last_fallback_reason = e.reason
             _fallback(e.reason)
             if e.reason not in self._warned:
@@ -263,12 +290,20 @@ class CachedStep:
             raise _CaptureUnsupported("optimizer")
         kv = tr._kvstore
         spec = None
+        plan = None
         if kv is not None and kv.type == "ici":
             if kv._compression is not None:
                 raise _CaptureUnsupported("compression")
-            spec = kv.capture_spec()
-            if spec is None and jax.process_count() > 1:
-                raise _CaptureUnsupported("multiprocess")
+            plan = kv.shard_plan()
+            if plan is None:
+                spec = kv.capture_spec()
+                if spec is None and jax.process_count() > 1:
+                    raise _CaptureUnsupported("multiprocess")
+        if self._sharded and plan is not None:
+            raise MXNetError(
+                "sharded_update=True composes with the 1-D replicated "
+                "mesh only; a shard plan already shards weights and "
+                "optimizer state per-rule — drop sharded_update")
         if self._sharded and spec is None:
             raise MXNetError(
                 "sharded_update=True needs an 'ici' kvstore with a "
@@ -286,6 +321,18 @@ class CachedStep:
             for b in batch_nd:
                 if b.ndim == 0 or b.shape[0] % n_rep:
                     raise _CaptureUnsupported("batch_not_divisible")
+        if plan is not None and jax.process_count() > 1:
+            # multi-controller plan sharding would need host batches
+            # placed onto non-addressable devices — refuse cleanly here
+            # (the no-fallback rule turns this into an MXNetError)
+            # instead of dying inside device_put
+            raise _CaptureUnsupported("multiprocess")
+        # NB under a plan a batch whose dim 0 the data axis does not
+        # divide is NOT an error: every such leaf replicates
+        # (per-leaf, in the build's batch_sh) and the global-batch loss
+        # math is unchanged — a routine end-of-epoch partial batch must
+        # degrade (one extra cache entry, no dp parallelism for that
+        # step), never abort a run that has no imperative fallback.
 
         scaler = amp.scaler()
         scale_mode = ("amp" if scaler is not None
@@ -313,6 +360,7 @@ class CachedStep:
             self._sharded,
             self._grad_reduce,
             None if opt.clip_gradient is None else float(opt.clip_gradient),
+            None if plan is None else plan.signature(),
         )
         entry = self._cache.get(key)
         if entry is None:
@@ -321,7 +369,7 @@ class CachedStep:
             self._last_key = key
             try:
                 entry = self._build(batch_nd, diff, state_nds, scale_mode,
-                                    spec)
+                                    spec, plan)
             except _CaptureUnsupported as e:
                 # negative-cache the failure: later steps with the same
                 # signature skip straight to the imperative path instead
@@ -359,7 +407,8 @@ class CachedStep:
         return "other"
 
     # ------------------------------------------------------------ build
-    def _build(self, batch_nd, diff, state_nds, scale_mode, spec):
+    def _build(self, batch_nd, diff, state_nds, scale_mode, spec,
+               plan=None):
         tr = self._trainer
         opt = tr._optimizer
         kv = tr._kvstore
@@ -384,7 +433,8 @@ class CachedStep:
         if spec is not None:
             mesh, axis, n_rep = spec
 
-        # per-param sharded-update eligibility (arXiv:2004.13336)
+        # per-param sharded-update eligibility (arXiv:2004.13336);
+        # irrelevant under a shard plan (rules own the layout there)
         shard_ok = []
         for (i, p), sv in zip(diff, state_nds):
             w = p.data()._data
@@ -393,6 +443,14 @@ class CachedStep:
                 and w.shape[0] >= n_rep and w.shape[0] % n_rep == 0
                 and all(s._data.shape == w.shape or s._data.ndim == 0
                         for s in sv)))
+
+        # rule-resolved per-parameter specs (the GSPMD-lowered path):
+        # grads are pinned to the weight's layout IN-GRAPH so they
+        # materialise already reduce-scattered (kvstore.graph_constrain)
+        plan_specs = None
+        if plan is not None:
+            plan_specs = [plan.spec_for(p.name, p.data()._data.shape)
+                          for _, p in diff]
 
         loss_fn = self._loss_fn
         meta = {"treedef": None, "n_out": 0, "aux": [], "nondiff": nondiff}
@@ -499,6 +557,14 @@ class CachedStep:
             # imperative trainer's gradient poisoning, in-graph
             grads = [g * poison for g in grads]
 
+            if plan_specs is not None:
+                # rule-driven layout: no explicit psum — the loss is
+                # computed over the GLOBAL batch, so the dp reduction is
+                # already part of the backward; the constraint makes each
+                # gradient land reduce-scattered into its weight's layout
+                grads = [kv.graph_constrain(g, ps)
+                         for g, ps in zip(grads, plan_specs)]
+
             if mesh is not None:
                 grads = [
                     kv.graph_reduce_scatter(g, axis, n_rep, mean=mean)
@@ -573,8 +639,62 @@ class CachedStep:
             return ([head] + list(extra), list(aux_vals), list(new_ws),
                     [tuple(sv) for sv in new_ss], list(out_gs), flag)
 
-        if mesh is None:
+        jit_kwargs = {}
+        if mesh is None and plan is None:
             fn = program
+        elif plan is not None:
+            # Rule-driven GSPMD lowering: the program itself contains no
+            # explicit collectives — inputs arrive committed to their
+            # per-rule NamedShardings (dispatch places them once;
+            # thereafter a no-op), out_shardings pin params/state/grads
+            # to the SAME layouts so donation reuses the sharded buffers
+            # in place, and the partitioner inserts the FSDP
+            # gather-before-use / reduce-scatter-after-backward and TP
+            # collectives the specs imply.
+            from jax.sharding import NamedSharding
+            fn = program
+            pmesh = plan.mesh
+            repl = NamedSharding(pmesh, P())
+            n_dp = int(pmesh.shape[plan.data_axis])
+            bsh = plan.batch_sharding()
+
+            def batch_sh(b):
+                if b.ndim >= 1 and b.shape[0] % n_dp == 0:
+                    return bsh
+                return repl
+
+            diff_sh = [NamedSharding(pmesh, ps) for ps in plan_specs]
+            nondiff_sh = [plan.sharding(p.name, p._data._data.shape)
+                          for p in nondiff]
+            state_sh = []
+            for (i, p), sv in zip(diff, state_nds):
+                w_shape = p.data()._data.shape
+                state_sh.append(tuple(
+                    NamedSharding(pmesh, plan.state_spec(
+                        p.name, w_shape, s._data.shape)) for s in sv))
+            aux_sh = [plan.sharding(p.name, p._data._data.shape)
+                      for p in meta["aux"]]
+            jit_kwargs["out_shardings"] = (
+                [repl] * meta["n_out"],      # loss leaves: replicated
+                aux_sh,
+                diff_sh,                     # new weights keep their rule
+                state_sh,                    # state stays sharded
+                diff_sh,                     # grads land in weight layout
+                repl,                        # guard flag
+            )
+            meta["shardings"] = (
+                [batch_sh(b) for b in batch_nd],
+                diff_sh, nondiff_sh, state_sh, repl,
+            )
+            # per-spec collective accounting: gradient bytes entering the
+            # cross-replica reduction, attributed to the layout that rule
+            # produced (kv_collective_bytes{op=spmd_grad_reduce,spec=})
+            per_spec = {}
+            for (i, p), ps in zip(diff, plan_specs):
+                g = p._grad._data
+                nbytes = int(g.size) * jnp.dtype(g.dtype).itemsize
+                per_spec[str(ps)] = per_spec.get(str(ps), 0) + nbytes
+            meta["coll_specs"] = sorted(per_spec.items())
         else:
             def state_spec(k, sv):
                 return tuple(
@@ -617,7 +737,7 @@ class CachedStep:
                 repl,
             )
 
-        jfn = jax.jit(fn, donate_argnums=(1, 3))
+        jfn = jax.jit(fn, donate_argnums=(1, 3), **jit_kwargs)
         meta.update({
             "fresh": True,     # first dispatch compiles: scope the CPU
                                # donation-noop warning to that call only
@@ -625,6 +745,7 @@ class CachedStep:
             "unscale": unscale,
             "shard_ok": shard_ok,
             "mesh": spec,
+            "plan": plan is not None,
             "coll_bytes": 0 if mesh is None else sum(
                 int(p._grad._data.size)
                 * jnp.dtype(p._grad._data.dtype).itemsize
@@ -659,6 +780,9 @@ class CachedStep:
         profiler.record_dispatch("captured_step")
         if meta["coll_bytes"]:
             kvs_mod._count_collective(meta["coll_op"], meta["coll_bytes"])
+        for spec_str, nbytes in meta.get("coll_specs", ()):
+            kvs_mod._count_collective("spmd_grad_reduce", nbytes,
+                                      spec=spec_str)
         batch_vals = [b._data for b in batch_nd]
         diff_vals = [self._mesh_resident("d", i, p.data()._data)
                      for i, p in diff]
@@ -756,7 +880,24 @@ class CachedStep:
         # (optimizer state, sharded-update grads) stay mesh-resident —
         # their next-step in_specs match exactly and .asnumpy()/save see
         # the full logical value.
-        if sh is not None:
+        if sh is not None and meta.get("plan"):
+            # rule-sharded layout: params/grads/aux that a rule SHARDS
+            # stay mesh-resident (the global array is the logical value
+            # and per-device memory stays at the shard size); replicated
+            # ones collapse to the device-0 view like the 1-D mesh path
+            for (i, p), w in zip(diff, new_ws):
+                v = _logical_view(w)
+                p.data()._rebind(v)
+                self._mesh_cache[("d", i)] = (v, w)
+            for (_, p), g in zip(diff, out_gs):
+                p._grad._rebind(_logical_view(g))
+            for p, v, j in zip(meta["aux"], aux_vals, meta["aux_pos"]):
+                view = _logical_view(v)
+                p._data._rebind(view)
+                if j is not None:
+                    self._mesh_cache[("n", j)] = (view, v)
+            loss_leaves = [_dev0_view(v) for v in loss_leaves]
+        elif sh is not None:
             for (i, p), w in zip(diff, new_ws):
                 v = _dev0_view(w)
                 p.data()._rebind(v)

@@ -43,7 +43,8 @@ from .fault import retry as _retry
 
 __all__ = ["save_checkpoint", "load_checkpoint", "save_sharded",
            "load_sharded", "CheckpointManager", "validate_checkpoint",
-           "read_extra", "MANIFEST_NAME", "CHECKPOINT_FORMAT"]
+           "read_extra", "saved_partition_specs", "derive_partition_specs",
+           "spec_mismatches", "MANIFEST_NAME", "CHECKPOINT_FORMAT"]
 
 MANIFEST_NAME = "manifest.json"
 CHECKPOINT_FORMAT = 1
@@ -121,10 +122,14 @@ def _walk_files(root):
             yield os.path.relpath(full, root), full
 
 
-def _write_manifest(root, step):
+def _write_manifest(root, step, partition_specs=None):
     """Checksum every file under `root` into manifest.json (written last:
     its presence marks the payload complete *before* the dir rename makes
-    the step visible — two commit barriers, either catches a tear)."""
+    the step visible — two commit barriers, either catches a tear).
+    `partition_specs` ({leaf name -> JSON-encoded PartitionSpec}) records
+    the ACTIVE sharding layout each param was saved under, so a
+    spec-mismatched restore is diagnosable from the manifest instead of
+    failing deep inside device_put (ISSUE 8)."""
     files = {}
     for rel, full in _walk_files(root):
         if rel == MANIFEST_NAME:
@@ -132,6 +137,8 @@ def _write_manifest(root, step):
         files[rel] = {"bytes": os.path.getsize(full), "sha256": _sha256(full)}
     manifest = {"step": int(step), "format": CHECKPOINT_FORMAT,
                 "complete": True, "files": files}
+    if partition_specs:
+        manifest["partition_specs"] = dict(partition_specs)
     path = os.path.join(root, MANIFEST_NAME)
     tmp = path + ".tmp"
     with open(tmp, "w") as f:
@@ -156,7 +163,10 @@ def _manifest_complete(path):
 def validate_checkpoint(path):
     """Validate one step dir against its manifest. Returns a list of
     error strings — empty means the checkpoint is intact. A missing
-    manifest (torn or pre-manifest save) is an error."""
+    manifest (torn or pre-manifest save) is an error. (Partition-spec
+    differences against a restore template are NOT errors — the restore
+    reshards template-wins; `spec_mismatches(path, template)` is the
+    pre-flight diagnosis for those.)"""
     errors = []
     mpath = os.path.join(path, MANIFEST_NAME)
     if not os.path.isdir(path):
@@ -188,13 +198,102 @@ def validate_checkpoint(path):
     return errors
 
 
+# ----------------------------------------------------- partition specs
+def _leaf_name(path):
+    """Compact "/"-joined name for one tree_flatten_with_path key path."""
+    parts = []
+    for k in path:
+        for attr in ("key", "idx", "name"):
+            v = getattr(k, attr, None)
+            if v is not None:
+                parts.append(str(v))
+                break
+        else:
+            parts.append(str(k))
+    return "/".join(parts)
+
+
+def derive_partition_specs(params):
+    """{leaf name -> JSON-encoded PartitionSpec} for every leaf of a
+    params pytree that carries a NamedSharding (the layout a shard plan
+    left it in); leaves without one are recorded as replicated ([])."""
+    import jax
+    from .shard.rules import spec_to_json
+    leaves = jax.tree_util.tree_flatten_with_path(
+        params, is_leaf=lambda x: isinstance(x, NDArray))[0]
+    out = {}
+    for path, leaf in leaves:
+        data = getattr(leaf, "_data", leaf)
+        spec = getattr(getattr(data, "sharding", None), "spec", None)
+        out[_leaf_name(path)] = spec_to_json(spec) if spec is not None \
+            else []
+    return out
+
+
+def saved_partition_specs(directory, step=None):
+    """The partition specs recorded in a checkpoint's manifest, as
+    {leaf name -> PartitionSpec}, or None for a checkpoint saved without
+    them. `directory` may be the step dir itself (step=None) or the
+    checkpoint root + step."""
+    from .shard.rules import spec_from_json
+    path = directory if step is None else _step_path(directory, step)
+    try:
+        with open(os.path.join(path, MANIFEST_NAME)) as f:
+            manifest = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+    specs = manifest.get("partition_specs")
+    if specs is None:
+        return None
+    return {k: spec_from_json(v) for k, v in specs.items()}
+
+
+def _trim_spec(spec_json):
+    """Canonical spec form: trailing Nones trimmed, so P('dp') and
+    P('dp', None) — the same layout — never read as a mismatch."""
+    out = list(spec_json or [])
+    while out and out[-1] is None:
+        out.pop()
+    return out
+
+
+def spec_mismatches(path, template):
+    """Saved-vs-template partition-layout differences for one step dir,
+    from the manifest's recorded `partition_specs` (human-readable
+    strings; empty when the checkpoint predates specs or nothing
+    differs). A mismatch is NOT corruption — the restore reshards
+    template-wins — this is the pre-flight answer to "what will move,
+    and why did a restore die in device_put" without reading XLA
+    stacks. `load_sharded` appends the same diagnosis to any restore
+    failure."""
+    saved = None
+    try:
+        with open(os.path.join(path, MANIFEST_NAME)) as f:
+            saved = json.load(f).get("partition_specs")
+    except (OSError, json.JSONDecodeError):
+        pass
+    if not saved:
+        return []
+    want = derive_partition_specs(template)
+    lines = []
+    for name, tspec in want.items():
+        sspec = saved.get(name)
+        if sspec is not None and _trim_spec(sspec) != _trim_spec(tspec):
+            lines.append(f"{name}: saved as {sspec}, template wants "
+                         f"{tspec}")
+    for name in saved:
+        if name not in want:
+            lines.append(f"{name}: saved but absent from the template")
+    return lines
+
+
 # ------------------------------------------------------- sharded save
 def _step_path(directory, step):
     return os.path.abspath(os.path.join(directory, str(step)))
 
 
 def save_sharded(directory, step, params, _async=False, extras=None,
-                 _group=None):
+                 _group=None, partition_specs=None):
     """Sharded distributed checkpoint via Orbax (multi-host resume path),
     committed atomically: Orbax writes into a hidden tmp dir, `extras`
     (name -> bytes sidecars) land beside it, the checksum manifest is
@@ -207,11 +306,23 @@ def save_sharded(directory, step, params, _async=False, extras=None,
     dispatch time — and returns the Future; readers of the same path
     (load_sharded/validate via the engine) order after it. `_group`
     attaches the task to an engine TaskGroup (CheckpointManager passes
-    its own so queued saves are cancellable as a unit)."""
+    its own so queued saves are cancellable as a unit).
+
+    `partition_specs` records each param's active PartitionSpec in the
+    manifest (default: DERIVED from the params' own shardings — a
+    rule-sharded training run documents its layout for free); pass
+    False to omit."""
     from . import engine
     directory = os.path.abspath(directory)
     os.makedirs(directory, exist_ok=True)
     final = _step_path(directory, step)
+    if partition_specs is None:
+        try:
+            partition_specs = derive_partition_specs(params)
+        except Exception:
+            partition_specs = None   # exotic pytree: save without specs
+    elif partition_specs is False:
+        partition_specs = None
 
     def do_save(params=params, extras=extras):
         import orbax.checkpoint as ocp
@@ -236,7 +347,7 @@ def save_sharded(directory, step, params, _async=False, extras=None,
                 with open(os.path.join(tmp, name), "wb") as f:
                     f.write(blob if isinstance(blob, bytes)
                             else bytes(blob))
-            _write_manifest(tmp, step)
+            _write_manifest(tmp, step, partition_specs=partition_specs)
             if os.path.exists(final):
                 # POSIX rename refuses a non-empty target dir, so an
                 # overwrite needs two renames — move the old step ASIDE
@@ -314,7 +425,22 @@ def load_sharded(directory, step, template, validate=True):
             state = final
         return ckptr.restore(state, template)
 
-    return _policy().call(do_load)
+    try:
+        return _policy().call(do_load)
+    except MXNetError:
+        raise
+    except Exception as e:
+        # a restore that died inside orbax/device_put is opaque; when
+        # the manifest recorded the save-time partition specs, name the
+        # layout differences so the operator sees "saved P('dp') on a
+        # (2,2) mesh, template wants P('tp')" instead of an XLA stack
+        diag = spec_mismatches(final, template)
+        if diag:
+            raise MXNetError(
+                f"restore of {final} failed ({type(e).__name__}: {e}); "
+                f"saved-vs-template partition-spec differences: "
+                + "; ".join(diag)) from e
+        raise
 
 
 def read_extra(directory, step, name):

@@ -209,6 +209,107 @@ class Trainer:
         return CachedStep(self, loss_fn, sharded_update=sharded_update,
                           grad_reduce=grad_reduce)
 
+    # -------------------------------------- rule-driven sharding (shard/)
+    @property
+    def shard_plan(self):
+        """The `shard.ShardPlan` attached to this trainer's kvstore, or
+        None (replicated layout)."""
+        kv = self._kvstore
+        return kv.shard_plan() if kv is not None and kv.type == "ici" \
+            else None
+
+    def shard(self, mesh=None, rules=None, data_axis=None):
+        """Attach a rule-driven FSDP/TP shard plan (mxnet_tpu/shard/) to
+        this trainer's 'ici' kvstore and move already-initialised
+        parameters, gradients, and optimizer state onto their per-rule
+        layouts. Captured steps (`capture`) then compile against the
+        sharded layout — params/grads/state live sharded BETWEEN steps
+        and per-device parameter memory drops by each rule's shard
+        factor. `mesh` is a Mesh / {axis: size} dict / (dp, tp) tuple
+        (None reuses the store's mesh, else builds dp x 1 over every
+        device); `rules=None` uses `shard.DEFAULT_RULES`. Returns the
+        plan. See docs/PERFORMANCE.md "Parameter sharding"."""
+        from .. import shard as shard_mod
+        from ..optimizer import multi_tensor
+        kv = self._kvstore
+        if kv is None or kv.type != "ici":
+            raise MXNetError("Trainer.shard needs kvstore='ici' (got "
+                             f"{None if kv is None else kv.type!r})")
+        if self._update_on_kvstore:
+            raise MXNetError("Trainer.shard is incompatible with "
+                             "update_on_kvstore=True (the captured step "
+                             "owns the optimizer)")
+        if not multi_tensor.supports(self._optimizer):
+            raise MXNetError(
+                f"Trainer.shard: optimizer "
+                f"{type(self._optimizer).__name__} has custom imperative "
+                f"update semantics the captured step cannot reproduce — "
+                f"a shard plan admits no imperative fallback")
+        import jax
+        if jax.process_count() > 1:
+            raise MXNetError(
+                "Trainer.shard: rule-driven sharding is single-controller "
+                "only for now (host batches cannot be placed onto "
+                "non-addressable devices); use the 1-D 'ici' mesh path "
+                "on multi-host pods")
+        if mesh is None and kv._mesh is not None:
+            mesh = kv._mesh
+        plan = shard_mod.plan(mesh, rules=rules, data_axis=data_axis)
+        kv.set_shard_plan(plan)
+        self._place_on_plan(plan)
+        return plan
+
+    def resize_mesh(self, mesh, devices=None):
+        """Elastic reshard: rebuild the active shard plan over a new mesh
+        (shrink after a preemption, grow when capacity returns) and move
+        live parameters, gradients, and optimizer state onto it through
+        device-side collective redistribution — no host round-trip of
+        the full state (shard/redistribute.py, arXiv:2112.01075;
+        `shard_resharded_bytes` accounts the moved bytes). The next call
+        of any captured step recompiles against the new mesh and
+        training continues. Returns the new plan."""
+        from .. import shard as shard_mod
+        kv = self._kvstore
+        old = self.shard_plan
+        if old is None:
+            raise MXNetError("Trainer.resize_mesh needs an active shard "
+                             "plan (call Trainer.shard first)")
+        new_mesh = shard_mod.as_mesh(mesh, devices=devices)
+        if old.data_axis not in new_mesh.axis_names:
+            raise MXNetError(
+                f"resize_mesh: new mesh axes {new_mesh.axis_names} do "
+                f"not include the plan's data axis {old.data_axis!r}")
+        plan = old.with_mesh(new_mesh)
+        kv.set_shard_plan(plan)
+        self._place_on_plan(plan)
+        return plan
+
+    def _place_on_plan(self, plan):
+        """Move every initialised param + grad + optimizer-state leaf
+        onto `plan`'s shardings (collective redistribution; a leaf
+        already in its target layout moves nothing)."""
+        from ..shard.redistribute import redistribute
+        for i, p in enumerate(self._params):
+            if p._data is None:
+                continue
+            sh = plan.sharding(p.name, p._data.shape)
+            redistribute(p._data, sh)
+            if p._grad is not None:
+                redistribute(p._grad, sh)
+            st = self._updater.states.get(i) if self._updater is not None \
+                else None
+            if st is None:
+                continue
+            leaves = st if isinstance(st, tuple) else (st,)
+            w_shape = tuple(p._data.shape)
+            for s in leaves:
+                if s is None:
+                    continue
+                from jax.sharding import NamedSharding
+                redistribute(s, NamedSharding(
+                    plan.mesh, plan.state_spec(p.name, w_shape,
+                                               s._data.shape)))
+
     def step(self, batch_size, ignore_stale_grad=False):
         """Rescale gradients by 1/batch_size and apply one optimizer step.
         Under an AMP loss scaler: unscale, skip on overflow, adjust scale.

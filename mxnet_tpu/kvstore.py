@@ -46,10 +46,17 @@ _reg = _obs_registry()
 _coll_bytes = {}
 
 
-def _count_collective(op, nbytes):
-    c = _coll_bytes.get(op)
+def _count_collective(op, nbytes, spec=None):
+    """`spec` (a PartitionSpec, stringified) adds a second label so the
+    rule-sharded captured step's traffic is attributable per layout —
+    which rules move bytes, not just which collective kinds."""
+    key = op if spec is None else (op, str(spec))
+    c = _coll_bytes.get(key)
     if c is None:
-        c = _coll_bytes[op] = _reg.counter("kv_collective_bytes", op=op)
+        labels = {"op": op}
+        if spec is not None:
+            labels["spec"] = str(spec)
+        c = _coll_bytes[key] = _reg.counter("kv_collective_bytes", **labels)
     c.inc(int(nbytes))
 
 
@@ -184,6 +191,7 @@ class KVStore:
         self._updater = None
         self._optimizer = None
         self._mesh = None
+        self._shard_plan = None    # shard.ShardPlan (rule-driven 2-D)
         self._compression = None   # {"type": "2bit"|"int8", ...}
         self._residuals = {}       # key -> error-feedback residual (sharded)
         self._wire_cache = {}      # (shape,dtype,axis,cfg) -> jitted program
@@ -233,11 +241,31 @@ class KVStore:
     def set_mesh(self, mesh):
         """Attach a jax.sharding.Mesh (ici backend) for psum lowering.
         Invalidates compiled compressed-collective programs and residuals —
-        both are placed on the old mesh."""
+        both are placed on the old mesh — and drops any attached shard
+        plan (its shardings name the old mesh; re-attach via
+        set_shard_plan)."""
         self._mesh = mesh
+        self._shard_plan = None
         self._wire_cache = {}
         self._residuals = {}
         return self
+
+    def set_shard_plan(self, plan):
+        """Attach a `shard.ShardPlan` (rule-driven FSDP/TP layout over a
+        named 2-D mesh — mxnet_tpu/shard/). Implies `set_mesh(plan.mesh)`;
+        a captured step over this store then compiles with per-parameter
+        in/out shardings instead of the 1-D replicated shard_map (see
+        docs/PERFORMANCE.md "Parameter sharding"). 'ici' stores only."""
+        if self._kind != "ici":
+            raise MXNetError("set_shard_plan needs an 'ici' kvstore "
+                             f"(this store is {self._kind!r})")
+        self.set_mesh(plan.mesh)
+        self._shard_plan = plan
+        return self
+
+    def shard_plan(self):
+        """The attached `ShardPlan`, or None (replicated 1-D lowering)."""
+        return self._shard_plan
 
     # ------------------------------------------------------------------
     def init(self, key, value):
@@ -530,6 +558,8 @@ class KVStore:
         without a second placement: leading dim over the capture_spec
         axis. None when capture_spec is None (single-device staging is
         the right call then) — see mxnet_tpu/prefetch.py."""
+        if self._shard_plan is not None:
+            return self._shard_plan.batch_sharding()
         spec = self.capture_spec()
         if spec is None:
             return None
@@ -560,6 +590,19 @@ class KVStore:
         row-shards into the full replicated value — the parameter half of
         the sharded weight update."""
         return jax.lax.all_gather(x, axis, axis=0, tiled=True)
+
+    def graph_constrain(self, x, spec):
+        """In-graph sharding constraint for an ARBITRARY PartitionSpec
+        (trace-time only, inside a jit compiled over this store's mesh):
+        the generalisation of the three fixed-lowering helpers above to
+        rule-driven layouts — the GSPMD partitioner materialises whatever
+        collective the constraint implies (psum, reduce-scatter,
+        all-gather, all-to-all). The rule-sharded captured step pins its
+        gradients with this so they materialise ALREADY reduce-scattered
+        into each parameter's layout instead of replicated-then-sliced."""
+        from jax.sharding import NamedSharding
+        return jax.lax.with_sharding_constraint(
+            x, NamedSharding(self._mesh, spec))
 
     def _psum_stacked(self, a, axis):
         from jax.sharding import PartitionSpec as P

@@ -150,15 +150,21 @@ def _spec_to_sharding(mesh, axis):
 def resolve_placement(target):
     """Normalise a placement target into what `place` consumes — a
     concrete `jax.Device` (committed single-device staging) or a
-    `NamedSharding` (leading-dim sharded over a mesh axis):
+    `NamedSharding` (batch dim sharded over a mesh axis):
 
       True                      -> default device
       Context / jax.Device      -> that device
-      Mesh                      -> P(first axis) over it
+      Mesh                      -> P(first axis) over it (2-D meshes
+                                   shard the batch over the FIRST axis
+                                   and replicate over the rest)
+      NamedSharding             -> used as-is (non-leading batch axes
+                                   and multi-axis specs allowed)
       (mesh, axis, n)           -> P(axis) — a kvstore `capture_spec()`
-      KVStore                   -> its capture_spec (default device when
+      shard.ShardPlan           -> its batch_sharding() (P(data_axis))
+      KVStore                   -> its shard plan's / capture_spec's
+                                   batch sharding (default device when
                                    the store has no multi-device mesh)
-      CachedStep / Trainer      -> their kvstore's capture_spec
+      CachedStep / Trainer      -> their kvstore's, as above
       None / False              -> None (no device staging)
     """
     if target is None or target is False:
@@ -166,6 +172,9 @@ def resolve_placement(target):
     if target is True:
         return jax.devices()[0]
     if isinstance(target, jax.Device):
+        return target
+    from jax.sharding import NamedSharding
+    if isinstance(target, NamedSharding):
         return target
     from .context import Context
     if isinstance(target, Context):
@@ -196,16 +205,53 @@ def resolve_placement(target):
                     f"{type(target).__name__!r}")
 
 
+# one warning per (shape, spec) pair per process: a fallback to
+# replication is a silent per-device memory multiplier — say so once
+_fallback_warned = set()
+_leaf_fallbacks = _reg.counter("prefetch_leaf_replicated")
+
+
+# one shared "product of mesh-axis sizes for one spec entry" helper —
+# the shard-rules normaliser and this leaf placement must agree on what
+# a spec entry means (tuple axes included)
+from .shard.rules import _axis_size as _axis_product  # noqa: E402
+
+
 def _leaf_sharding(placement, ndim, shape):
-    """Per-leaf placement: mesh placements shard dim 0 when it divides
-    the axis, and replicate otherwise (scalars, non-divisible leaves) so
-    a mixed batch structure still stages in one pass."""
+    """Per-leaf placement: a mesh placement applies when every sharded
+    entry of its spec divides the corresponding dim — the batch axis may
+    be NON-LEADING (P(None, 'dp')) and an entry may name a TUPLE of mesh
+    axes; 2-D meshes replicate over the axes the spec leaves out. A leaf
+    that cannot take the spec (scalars, non-divisible dims) replicates
+    instead, with ONE warning per (shape, spec) — a silently replicated
+    batch dim multiplies per-device memory by the axis size, so the
+    fallback is loud (and counted: `prefetch_leaf_replicated`)."""
+    import warnings
     from jax.sharding import NamedSharding, PartitionSpec as P
-    if isinstance(placement, NamedSharding) and len(placement.spec) \
-            and placement.spec[0] is not None:
-        axis = placement.spec[0]
-        n = int(placement.mesh.shape[axis])
-        if ndim == 0 or shape[0] % n:
+    if not isinstance(placement, NamedSharding):
+        return placement
+    spec = tuple(placement.spec)
+    if ndim == 0:
+        # scalars have no batch dim: replicated IS their layout, not a
+        # fallback — no warning, no counter
+        return NamedSharding(placement.mesh, P())
+    for dim, entry in enumerate(spec):
+        if entry is None:
+            continue
+        n = _axis_product(placement.mesh, entry)
+        if n <= 1:
+            continue
+        if ndim <= dim or shape[dim] % n:
+            _leaf_fallbacks.inc()
+            key = (tuple(shape), str(placement.spec))
+            if key not in _fallback_warned:
+                _fallback_warned.add(key)
+                warnings.warn(
+                    f"prefetch: batch leaf of shape {tuple(shape)} "
+                    f"cannot shard as {placement.spec} (dim {dim} not "
+                    f"divisible by {n}); staging it REPLICATED — "
+                    f"per-device memory for this leaf is the full size",
+                    RuntimeWarning, stacklevel=4)
             return NamedSharding(placement.mesh, P())
     return placement
 

@@ -1,0 +1,37 @@
+"""Rule-driven parameter sharding (FSDP/TP) over a named 2-D mesh, with
+elastic resharding (ISSUE 8; docs/PERFORMANCE.md "Parameter sharding").
+
+  rules.py        — ordered regex rules -> PartitionSpec (+ DEFAULT_RULES
+                    for the model zoo, None -> replicated fallback)
+  mesh.py         — ('dp','tp') mesh construction + ShardPlan (resolved
+                    per-parameter NamedShardings the captured step
+                    compiles against)
+  redistribute.py — portable collective-based mesh->mesh moves (elastic
+                    resize + resharded restore; arXiv:2112.01075)
+
+Quick start::
+
+    import mxnet_tpu as mx
+    tr = mx.gluon.Trainer(net.collect_params(), "adam",
+                          {"learning_rate": 1e-3}, kvstore="ici")
+    plan = tr.shard(mesh={"dp": 2, "tp": 2})       # DEFAULT_RULES
+    step = tr.capture(lambda x, y: lossf(net(x), y).mean())
+    ...
+    tr.resize_mesh({"dp": 1, "tp": 2})             # after a preemption
+"""
+from . import rules
+from . import mesh
+from . import redistribute
+from .rules import (DEFAULT_RULES, match_partition_rules, validate_rules,
+                    normalize_spec, spec_to_json, spec_from_json)
+from .mesh import ShardPlan, plan, make_mesh_2d, as_mesh
+from .redistribute import redistribute as redistribute_array
+from .redistribute import redistribute_tree, resharded_bytes
+
+__all__ = [
+    "rules", "mesh", "redistribute",
+    "DEFAULT_RULES", "match_partition_rules", "validate_rules",
+    "normalize_spec", "spec_to_json", "spec_from_json",
+    "ShardPlan", "plan", "make_mesh_2d", "as_mesh",
+    "redistribute_array", "redistribute_tree", "resharded_bytes",
+]

@@ -1,0 +1,195 @@
+"""Named 2-D mesh construction + the `ShardPlan` that drives rule-based
+parameter sharding through the captured step.
+
+A plan binds (mesh, ordered rules, data axis) and resolves every
+parameter name to a concrete `NamedSharding`. It layers over
+`kvstore.capture_spec`: a KVStore with a plan attached
+(`KVStore.set_shard_plan`) makes `Trainer.capture` compile the step with
+per-parameter in/out shardings instead of the 1-D replicated shard_map —
+the GSPMD partitioner then inserts the FSDP gather-before-use /
+reduce-scatter-after-backward and the TP collectives the specs imply
+(the generalisation of the hand-written psum/reduce-scatter/all-gather
+lowering to arbitrary specs; arXiv:2112.01075's portable-collectives
+framing). Params, grads, and optimizer state stay sharded BETWEEN steps;
+only what a spec replicates is ever whole on a device.
+
+Canonical axes: ``dp`` (data parallel — the batch shards over it) and
+``tp`` (tensor parallel). `make_mesh_2d(dp=..., tp=...)` builds the
+standard layout; any `jax.sharding.Mesh` whose axis names the rules
+reference works.
+"""
+from __future__ import annotations
+
+import itertools
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..base import MXNetError
+from . import rules as _rules
+
+__all__ = ["make_mesh_2d", "as_mesh", "ShardPlan", "plan"]
+
+_plan_seq = itertools.count()
+
+
+def make_mesh_2d(dp=-1, tp=1, devices=None):
+    """The canonical ('dp', 'tp') mesh. ``dp=-1`` infers the data axis
+    from the device count; sizes must multiply to at most the devices
+    available."""
+    from ..parallel.mesh import make_mesh
+    return make_mesh({"dp": dp, "tp": tp}, devices=devices)
+
+
+def as_mesh(target, devices=None):
+    """Normalise a Mesh / {axis: size} dict / (dp, tp) tuple into a
+    `jax.sharding.Mesh`."""
+    if isinstance(target, Mesh):
+        return target
+    if isinstance(target, dict):
+        from ..parallel.mesh import make_mesh
+        return make_mesh(target, devices=devices)
+    if isinstance(target, (tuple, list)) and len(target) == 2 and \
+            all(isinstance(x, int) for x in target):
+        return make_mesh_2d(dp=target[0], tp=target[1], devices=devices)
+    raise MXNetError(f"cannot build a mesh from {target!r}; pass a "
+                     f"jax.sharding.Mesh, an {{axis: size}} dict, or a "
+                     f"(dp, tp) tuple")
+
+
+class ShardPlan:
+    """Resolved rule-driven sharding over one mesh.
+
+    Resolution is lazy and cached per (name, shape): `spec_for` matches
+    the ordered rules (first match wins, `re.search` — shard/rules.py),
+    then normalises against the mesh and the concrete shape; every
+    downgrade (non-divisible dim, unknown axis) and unmatched name is
+    recorded in `report()` instead of raising. `sharding(name, shape)`
+    returns the `NamedSharding` the captured step compiles against.
+
+    The plan is immutable w.r.t. its mesh; `with_mesh(new_mesh)` derives
+    the same rules over a different mesh — the elastic-resize primitive
+    (`Trainer.resize_mesh` redistributes live state between the two
+    plans' shardings via shard/redistribute.py).
+    """
+
+    def __init__(self, mesh, rules=None, data_axis=None):
+        if not isinstance(mesh, Mesh):
+            mesh = as_mesh(mesh)
+        self.mesh = mesh
+        self.rules = tuple(rules if rules is not None
+                           else _rules.DEFAULT_RULES)
+        _rules.validate_rules(self.rules)   # fail fast on bad rule sets
+        axes = mesh.axis_names
+        self.data_axis = data_axis if data_axis is not None else axes[0]
+        if self.data_axis not in axes:
+            raise MXNetError(f"data_axis {self.data_axis!r} is not an "
+                             f"axis of the mesh {axes}")
+        self._cache = {}          # (name, shape) -> PartitionSpec
+        self._unmatched = []
+        self._fallbacks = []
+        self._warned = set()
+        # identity for executable cache keys: a NEW plan (new mesh, new
+        # rules) must miss the captured-step cache even if specs coincide
+        self.plan_id = next(_plan_seq)
+
+    # ------------------------------------------------------- resolution
+    def spec_for(self, name, shape):
+        """Normalised PartitionSpec for one parameter."""
+        key = (name, tuple(int(s) for s in shape))
+        spec = self._cache.get(key)
+        if spec is None:
+            specs, report = _rules.match_partition_rules(
+                self.rules, {name: key[1]}, mesh=self.mesh)
+            spec = self._cache[key] = specs[name]
+            self._unmatched.extend(report["unmatched"])
+            self._fallbacks.extend(report["fallbacks"])
+        return spec
+
+    def sharding(self, name, shape):
+        return NamedSharding(self.mesh, self.spec_for(name, shape))
+
+    def state_spec(self, name, param_shape, state_shape):
+        """Spec for one optimizer-state leaf of a parameter: elementwise
+        state (same shape as the weight) rides the weight's spec; scalars
+        and shape-mismatched state replicate."""
+        if tuple(state_shape) == tuple(param_shape):
+            return self.spec_for(name, param_shape)
+        return P()
+
+    def batch_sharding(self):
+        """Leading batch dim over the data axis, replicated over the rest
+        — the in_spec captured steps compile their batches against and
+        what the device prefetcher stages with."""
+        return NamedSharding(self.mesh, P(self.data_axis))
+
+    def replicated(self):
+        return NamedSharding(self.mesh, P())
+
+    # ------------------------------------------------------- reporting
+    def report(self):
+        """{"unmatched": [...], "fallbacks": [...]} accumulated across
+        every resolution so far (deduplicated, order-preserving)."""
+        seen = set()
+        unmatched = [n for n in self._unmatched
+                     if not (n in seen or seen.add(n))]
+        fb, seen_fb = [], set()
+        for item in self._fallbacks:
+            if item not in seen_fb:
+                seen_fb.add(item)
+                fb.append(item)
+        return {"unmatched": unmatched, "fallbacks": fb}
+
+    def describe(self, named_shapes):
+        """Resolve {name: shape-bearing} eagerly; returns {name: spec}."""
+        return {name: self.spec_for(name, tuple(getattr(v, "shape", v)))
+                for name, v in named_shapes.items()}
+
+    def param_bytes_per_device(self, named_arrays):
+        """(per_device_bytes, total_bytes) this plan's layout costs for a
+        {name: array} set — the dp/tp shard-factor savings the bench and
+        acceptance tests assert on."""
+        per_dev = total = 0
+        for name, a in named_arrays.items():
+            data = getattr(a, "_data", a)
+            nbytes = int(np.prod(data.shape or (1,))) * \
+                np.dtype(data.dtype).itemsize
+            spec = self.spec_for(name, data.shape)
+            factor = 1
+            for entry in tuple(spec):
+                if entry is not None:
+                    factor *= _rules._axis_size(self.mesh, entry)
+            total += nbytes
+            per_dev += nbytes // factor
+        return per_dev, total
+
+    def with_mesh(self, mesh):
+        """Same rules + data axis over a different mesh (the elastic
+        resize target). The new mesh must name the data axis."""
+        mesh = as_mesh(mesh)
+        return ShardPlan(mesh, rules=self.rules, data_axis=self.data_axis)
+
+    # executable cache key: plan identity + the mesh's device fingerprint
+    def signature(self):
+        return (self.plan_id, self.data_axis,
+                tuple(self.mesh.axis_names),
+                tuple(self.mesh.shape[a] for a in self.mesh.axis_names))
+
+    def __repr__(self):
+        shape = dict(self.mesh.shape)
+        return (f"ShardPlan(mesh={shape}, rules={len(self.rules)}, "
+                f"data_axis={self.data_axis!r})")
+
+
+def plan(mesh=None, rules=None, data_axis=None, devices=None):
+    """Build a `ShardPlan`. `mesh` may be a Mesh, an {axis: size} dict,
+    a (dp, tp) tuple, or None — None builds the canonical 2-D mesh with
+    every visible device on 'dp' and tp=1."""
+    if mesh is None:
+        mesh = make_mesh_2d(dp=len(devices or jax.devices()), tp=1,
+                            devices=devices)
+    else:
+        mesh = as_mesh(mesh, devices=devices)
+    return ShardPlan(mesh, rules=rules, data_axis=data_axis)

@@ -1,0 +1,135 @@
+"""Portable collective-based array redistribution for mesh→mesh moves
+(arXiv:2112.01075: memory-efficient redistribution through portable
+collectives — never materialise the full logical array on one host).
+
+`redistribute(x, target_sharding)` moves one (possibly sharded) array
+onto a target `NamedSharding`:
+
+  * same device set, different layout — a jitted identity with
+    `out_shardings` pinned, so XLA lowers the move to its collective
+    repertoire (all-gather / all-to-all / collective-permute) and the
+    data rides the interconnect;
+  * different device set (elastic shrink/grow after a preemption) —
+    `jax.device_put` onto the target sharding, which transfers PER
+    SHARD device-to-device; no step of either path ever gathers the
+    full value to host memory (`shard_host_gather_bytes` exists to
+    prove the claim: this module never increments it).
+
+`redistribute_tree` maps a pytree of arrays onto a pytree (or dict) of
+shardings in one call — what `Trainer.resize_mesh` and the resharded
+checkpoint restore use to move params + optimizer state as a unit.
+
+Accounting: every moved array counts its LOGICAL bytes into the
+``shard_resharded_bytes`` counter (and a move that is already in the
+target layout counts nothing and returns the input unchanged).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import NamedSharding, Sharding
+
+from ..base import MXNetError
+from ..observability import registry as _obs_registry
+from ..observability import tracer as _tracer
+
+__all__ = ["redistribute", "redistribute_tree", "resharded_bytes"]
+
+_reg = _obs_registry()
+_resharded = _reg.counter("shard_resharded_bytes")
+_reshards = _reg.counter("shard_reshards")
+# intentionally never incremented by this module: the zero IS the
+# "no full host gather" guarantee tests pin (tests/test_shard.py)
+_host_gather = _reg.counter("shard_host_gather_bytes")
+
+# jitted identity per target sharding — the collective reshard program.
+# Bounded FIFO: elastic resize cycles (shrink on preemption, grow on
+# capacity return) would otherwise pin every old Mesh and its compiled
+# executables forever.
+_respec_cache = {}
+_RESPEC_CACHE_MAX = 32
+
+
+def resharded_bytes():
+    """Logical bytes moved through `redistribute` since process start
+    (or the registry's last reset)."""
+    return _resharded.value
+
+
+def _nbytes(a):
+    return int(np.prod(tuple(a.shape) or (1,))) * np.dtype(a.dtype).itemsize
+
+
+def _same_device_set(a, target):
+    sh = getattr(a, "sharding", None)
+    if sh is None:
+        return False
+    try:
+        return set(sh.device_set) == set(target.device_set)
+    except Exception:
+        return False
+
+
+def redistribute(x, target):
+    """Move one array onto `target` (a `Sharding`). Returns `x` unchanged
+    when it already carries the target sharding. See module docstring for
+    the collective vs device-to-device path split."""
+    if not isinstance(target, Sharding):
+        raise MXNetError(f"redistribute target must be a jax Sharding, "
+                         f"got {type(target).__name__}")
+    data = getattr(x, "_data", x)   # NDArray leaves contribute their array
+    if getattr(data, "sharding", None) == target:
+        return x
+    nbytes = _nbytes(data)
+    _resharded.inc(nbytes)
+    _reshards.inc()
+    # an NDArray caller rebinds to the output and drops the source, so
+    # the source shards may be DONATED — no transient 2x per array at
+    # exactly the memory-constrained moment (post-preemption resize) the
+    # module exists for; a raw-array caller keeps its input alive
+    donate = hasattr(x, "_rebind")
+
+    def _move():
+        if _same_device_set(data, target):
+            # same devices, new layout: ONE compiled identity whose
+            # out_shardings force the move — XLA picks the collectives
+            key = (target, data.shape, str(data.dtype), donate)
+            fn = _respec_cache.get(key)
+            if fn is None:
+                while len(_respec_cache) >= _RESPEC_CACHE_MAX:
+                    _respec_cache.pop(next(iter(_respec_cache)))
+                fn = _respec_cache[key] = jax.jit(
+                    lambda v: v, out_shardings=target,
+                    donate_argnums=(0,) if donate else ())
+            import warnings as _warnings
+            with _warnings.catch_warnings():
+                # donation is a no-op on CPU test meshes; jax warns at
+                # compile time — scope the suppression to this call
+                _warnings.filterwarnings(
+                    "ignore", message="Some donated buffers were not")
+                return fn(data)
+        # different device set (mesh shrink/grow): shard-wise
+        # device-to-device placement; never a host gather of the whole
+        return jax.device_put(data, target)
+
+    if _tracer.ACTIVE:
+        with _tracer.span("shard.redistribute", cat="shard",
+                          args={"bytes": nbytes,
+                                "target": str(getattr(target, "spec", ""))}):
+            out = _move()
+    else:
+        out = _move()
+    if hasattr(x, "_rebind"):
+        x._rebind(out)
+        return x
+    return out
+
+
+def redistribute_tree(tree, shardings):
+    """`redistribute` over a pytree. `shardings` is either a matching
+    pytree of Shardings or a single Sharding applied to every leaf."""
+    if isinstance(shardings, Sharding):
+        return jax.tree_util.tree_map(
+            lambda a: redistribute(a, shardings), tree)
+    return jax.tree_util.tree_map(redistribute, tree, shardings)

@@ -24,6 +24,13 @@ def test_captured_dispatch_budget_and_parity():
     # conftest forks 8 CPU devices, so the MESH placement path is what
     # ran (the configuration where the per-step device_put used to live)
     assert res["prefetch_mesh"] is True
+    # ISSUE 8: the rule-sharded (2,2) captured step stays within the
+    # same budget, feeds transfer-free from the device prefetcher, and
+    # genuinely shrinks per-device parameter bytes
+    assert res["shard_mesh"] is True
+    assert res["shard_dispatches_per_step"] <= res["budget"]
+    assert res["shard_sync_h2d_per_step"] == 0
+    assert res["shard_param_bytes_frac"] < 1.0
     # ISSUE 6: the serve decode loop is ONE dispatch per warm decode
     # turn, never retraces across varying slot occupancy, and returns
     # every KV page when the traffic drains

@@ -1,0 +1,513 @@
+"""Rule-driven parameter sharding (mxnet_tpu/shard/, ISSUE 8): rule
+matching edge cases, the (2,2) rule-sharded captured step vs the
+replicated baseline (documented fp tolerance — the partitioner reorders
+the contraction; see docs/PERFORMANCE.md "Parameter sharding"),
+per-device param-byte reduction, partition specs in the checkpoint
+manifest, the save-on-(2,2)/restore-on-(1,2) elastic path, and
+`Trainer.resize_mesh` live resharding vs a cold resharded restore."""
+import os
+import tempfile
+import warnings
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, checkpoint, gluon, nd, shard
+from mxnet_tpu.observability import registry
+
+BATCH, DIM, CLS = 8, 16, 4
+
+
+def _mesh22():
+    return shard.make_mesh_2d(dp=2, tp=2)
+
+
+# ------------------------------------------------------------- rules
+def test_first_match_wins_over_later_rules():
+    """Overlap precedence: rule order IS the priority order."""
+    rules = ((r"_weight$", P("tp")),      # broad, first
+             (r"dense0_weight$", P("dp")))  # specific, but too late
+    specs, _ = shard.match_partition_rules(
+        rules, {"dense0_weight": (8, 4)})
+    assert specs["dense0_weight"] == P("tp")
+    # flipped order: the specific rule now wins
+    specs, _ = shard.match_partition_rules(
+        tuple(reversed(rules)), {"dense0_weight": (8, 4)})
+    assert specs["dense0_weight"] == P("dp")
+
+
+def test_anchored_vs_substring_matching():
+    """Matching is re.search (substring); ^...$ anchors make it exact."""
+    sub, _ = shard.match_partition_rules(
+        ((r"dense", P("dp")), (r".*", None)),
+        {"predense0_weight": (8, 4)})
+    assert sub["predense0_weight"] == P("dp")       # substring hit
+    anchored, _ = shard.match_partition_rules(
+        (("^dense", P("dp")), (r".*", None)),
+        {"predense0_weight": (8, 4)})
+    assert anchored["predense0_weight"] == P()      # anchored miss -> None
+
+
+def test_unmatched_param_reported_and_replicated():
+    specs, report = shard.match_partition_rules(
+        ((r"_weight$", P("dp")),), {"odd_thing": (4, 4)})
+    assert specs["odd_thing"] == P()
+    assert report["unmatched"] == ["odd_thing"]
+    with pytest.raises(Exception, match="no partition rule"):
+        shard.match_partition_rules(((r"_weight$", P("dp")),),
+                                    {"odd_thing": (4, 4)},
+                                    on_unmatched="error")
+
+
+def test_scalars_and_non_divisible_dims_replicate_with_report():
+    mesh = _mesh22()
+    specs, report = shard.match_partition_rules(
+        ((r".*", P("dp")),),
+        {"scalar": (), "one": (1,), "odd": (7, 4), "even": (4, 4)},
+        mesh=mesh)
+    assert specs["scalar"] == P() and specs["one"] == P()
+    assert specs["odd"] == P()          # 7 % 2 != 0 -> replicated
+    assert specs["even"] == P("dp")
+    assert ("odd", 0, "dp", "not_divisible") in report["fallbacks"]
+
+
+def test_validate_rules_rejects_garbage():
+    with pytest.raises(Exception, match="bad regex"):
+        shard.validate_rules((("(", P("dp")),))
+    with pytest.raises(Exception, match="spec must be"):
+        shard.validate_rules((("x", 42),))
+    # tuples convert, None passes
+    out = shard.validate_rules((("x", ("dp", None)), ("y", None)))
+    assert out[0][1] == P("dp", None) and out[1][1] is None
+
+
+def test_spec_json_roundtrip():
+    for spec in (P(), P("dp"), P(None, "tp"), P(("dp", "tp"), None)):
+        assert shard.spec_from_json(shard.spec_to_json(spec)) == spec
+
+
+def test_default_rules_cover_model_zoo_names():
+    """DEFAULT_RULES: attention/ffn weights -> tp, other weights -> dp,
+    norms/biases replicated, nothing unmatched."""
+    mesh = _mesh22()
+    names = {
+        "dense0_weight": (32, 16), "dense0_bias": (32,),
+        "batchnorm0_gamma": (32,), "batchnorm0_running_mean": (32,),
+        "conv0_weight": (8, 3, 3, 4),
+        "transformernmt0_embed_weight": (32, 16),
+        "enc0_selfattention0_qkv_weight": (48, 16),
+        "enc0_selfattention0_proj_weight": (16, 16),
+        "enc0__ffn0_ffn1_weight": (32, 16),
+    }
+    specs, report = shard.match_partition_rules(shard.DEFAULT_RULES,
+                                                names, mesh=mesh)
+    assert report["unmatched"] == []
+    assert specs["dense0_weight"] == P("dp")
+    assert specs["conv0_weight"] == P("dp")
+    assert specs["dense0_bias"] == P()
+    assert specs["batchnorm0_gamma"] == P()
+    assert specs["transformernmt0_embed_weight"] == P("tp")
+    assert specs["enc0_selfattention0_qkv_weight"] == P("tp")
+    assert specs["enc0__ffn0_ffn1_weight"] == P("tp")
+
+
+# ------------------------------------------------------------- plan
+def test_plan_shardings_and_bytes():
+    plan = shard.plan({"dp": 2, "tp": 2})
+    sh = plan.sharding("dense0_weight", (32, 16))
+    assert sh == NamedSharding(plan.mesh, P("dp"))
+    assert plan.batch_sharding() == NamedSharding(plan.mesh, P("dp"))
+    per_dev, total = plan.param_bytes_per_device(
+        {"dense0_weight": np.zeros((32, 16), np.float32),
+         "dense0_bias": np.zeros((32,), np.float32)})
+    assert total == 32 * 16 * 4 + 32 * 4
+    assert per_dev == 32 * 16 * 4 // 2 + 32 * 4   # weight dp-halved
+    # state leaves: elementwise ride the weight spec, scalars replicate
+    assert plan.state_spec("dense0_weight", (32, 16), (32, 16)) == P("dp")
+    assert plan.state_spec("dense0_weight", (32, 16), ()) == P()
+    p2 = plan.with_mesh({"dp": 1, "tp": 2})
+    assert p2.rules == plan.rules and p2.signature() != plan.signature()
+
+
+# ------------------------------------- the rule-sharded captured step
+def _data():
+    rng = np.random.RandomState(0)
+    X = nd.array(rng.randn(BATCH, DIM).astype(np.float32))
+    y = nd.array(rng.randint(0, CLS, BATCH).astype(np.float32))
+    return X, y
+
+
+def _build(X, seed=0):
+    mx.random.seed(seed)
+    net = gluon.nn.Sequential()
+    net.add(gluon.nn.Dense(16, activation="relu"),
+            gluon.nn.Dense(16, activation="relu"),
+            gluon.nn.Dense(CLS))
+    net.initialize(mx.init.Xavier())
+    net(X)
+    return net
+
+
+_lossf = gluon.loss.SoftmaxCrossEntropyLoss()
+
+
+def _weights(net):
+    return [p.data().asnumpy().astype(np.float32)
+            for p in net.collect_params().values()]
+
+
+# rules that exercise BOTH layouts on a plain MLP: layer 0/1 weights
+# FSDP over dp, the head TP over tp, biases replicated
+_MLP_RULES = ((r"_bias$", None),
+              (r"dense2_weight$", P("tp", None)),
+              (r"_weight$", P("dp", None)),
+              (r".*", None))
+
+
+def test_sharded_captured_step_matches_replicated_baseline():
+    """(2,2) rule-sharded captured step vs the imperative replicated
+    baseline: allclose at the documented fp tolerance (TP splits the
+    contraction; FSDP changes only the schedule), params genuinely live
+    sharded, per-device bytes drop, and the per-spec collective bytes
+    are accounted."""
+    X, y = _data()
+    net_i = _build(X)
+    tr_i = gluon.Trainer(net_i.collect_params(), "adam",
+                         {"learning_rate": 0.05})
+    for _ in range(4):
+        with autograd.record():
+            L = _lossf(net_i(X), y).mean()
+        L.backward()
+        tr_i.step(BATCH)
+    imp = _weights(net_i)
+
+    net_s = _build(X)
+    tr_s = gluon.Trainer(net_s.collect_params(), "adam",
+                         {"learning_rate": 0.05}, kvstore="ici")
+    plan = tr_s.shard(mesh={"dp": 2, "tp": 2}, rules=_MLP_RULES)
+    assert plan.report()["unmatched"] == []
+    step = tr_s.capture(lambda a, b: _lossf(net_s(a), b).mean())
+    for _ in range(4):
+        step(X, y)
+        assert step.last_fallback_reason is None
+    assert step.cache_size == 1
+    for a, b in zip(_weights(net_s), imp):
+        np.testing.assert_allclose(a, b, rtol=2e-4, atol=1e-5)
+
+    # params live sharded between steps: the FSDP weight's per-device
+    # shard is half the logical array, the TP head a quarter... of its
+    # own layout; biases stay replicated
+    w0 = list(net_s.collect_params().values())[0].data()._data
+    assert w0.sharding.spec == P("dp")
+    assert w0.addressable_shards[0].data.nbytes == w0.nbytes // 2
+    params = {p.name: p.data()._data
+              for p in net_s.collect_params().values()}
+    per_dev, total = plan.param_bytes_per_device(params)
+    assert per_dev < total
+    # per-spec collective accounting (kv_collective_bytes{op=,spec=})
+    snap = registry().snapshot()
+    series = {tuple(sorted(s["labels"].items()))
+              for s in snap.get("kv_collective_bytes", [])}
+    assert any(lbl == (("op", "spmd_grad_reduce"),
+                       ("spec", "PartitionSpec('dp',)")) or
+               lbl == (("op", "spmd_grad_reduce"),
+                       ("spec", str(P("dp")))) for lbl in series)
+
+
+def test_sharded_step_single_dispatch_and_no_retrace():
+    X, y = _data()
+    from mxnet_tpu import profiler
+    net = _build(X)
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.05, "momentum": 0.9},
+                       kvstore="ici")
+    tr.shard(mesh={"dp": 2, "tp": 2}, rules=_MLP_RULES)
+    step = tr.capture(lambda a, b: _lossf(net(a), b).mean())
+    step(X, y)
+    step(X, y)
+    profiler.reset_dispatches()
+    step(X, y)
+    assert profiler.dispatch_count() == 1
+    assert step.cache_size == 1
+
+
+def test_shard_plan_refuses_imperative_fallback():
+    """With a plan attached a capture failure must raise, not silently
+    train garbage on mesh-resident params."""
+    X, y = _data()
+    net = _build(X)
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.05}, kvstore="ici")
+    tr.shard(mesh={"dp": 2, "tp": 2}, rules=_MLP_RULES)
+
+    def bad_loss(a, b):
+        L = _lossf(net(a), b).mean()
+        float(L.asnumpy())              # host sync inside the forward
+        return L
+
+    step = tr.capture(bad_loss)
+    with pytest.raises(Exception, match="cannot fall back"):
+        step(X, y)
+    # unsupported-optimizer configurations are refused up front
+    net2 = _build(X)
+    tr2 = gluon.Trainer(net2.collect_params(), "dcasgd",
+                        {"learning_rate": 0.05}, kvstore="ici")
+    with pytest.raises(Exception, match="custom imperative"):
+        tr2.shard(mesh={"dp": 2, "tp": 2})
+    # sharded_update composes with the 1-D path only
+    net3 = _build(X)
+    tr3 = gluon.Trainer(net3.collect_params(), "sgd",
+                        {"learning_rate": 0.05}, kvstore="ici")
+    tr3.shard(mesh={"dp": 2, "tp": 2}, rules=_MLP_RULES)
+    step3 = tr3.capture(lambda a, b: _lossf(net3(a), b).mean(),
+                        sharded_update=True)
+    with pytest.raises(Exception, match="drop sharded_update"):
+        step3(X, y)
+
+
+def test_partial_batch_degrades_instead_of_aborting():
+    """A final batch the dp axis does not divide must NOT kill a run
+    that has no imperative fallback: the batch replicates for that step
+    (one extra cache entry) and the update matches the imperative
+    partial-batch step."""
+    X, y = _data()
+    Xo = nd.array(X.asnumpy()[:5])          # 5 % 2 != 0
+    yo = nd.array(y.asnumpy()[:5])
+
+    net_i = _build(X)
+    tr_i = gluon.Trainer(net_i.collect_params(), "sgd",
+                         {"learning_rate": 0.05})
+    for a, b, n in ((X, y, BATCH), (Xo, yo, 5)):
+        with autograd.record():
+            L = _lossf(net_i(a), b).mean()
+        L.backward()
+        tr_i.step(n)
+    imp = _weights(net_i)
+
+    net_s = _build(X)
+    tr_s = gluon.Trainer(net_s.collect_params(), "sgd",
+                         {"learning_rate": 0.05}, kvstore="ici")
+    tr_s.shard(mesh={"dp": 2, "tp": 2}, rules=_MLP_RULES)
+    step = tr_s.capture(lambda a, b: _lossf(net_s(a), b).mean())
+    step(X, y)
+    step(Xo, yo)                            # partial batch: degrades
+    assert step.last_fallback_reason is None
+    assert step.cache_size == 2             # one entry per batch shape
+    for a, b in zip(_weights(net_s), imp):
+        np.testing.assert_allclose(a, b, rtol=2e-4, atol=1e-5)
+
+
+def test_sharded_step_with_device_prefetcher_zero_sync_h2d():
+    from mxnet_tpu.prefetch import DevicePrefetcher
+    X, y = _data()
+    Xh, yh = X.asnumpy(), y.asnumpy()
+    net = _build(X)
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.05}, kvstore="ici")
+    tr.shard(mesh={"dp": 2, "tp": 2}, rules=_MLP_RULES)
+    step = tr.capture(lambda a, b: _lossf(net(a), b).mean())
+    step(X, y)                                   # compile
+    sync = registry().counter("prefetch_h2d_sync")
+    pf = DevicePrefetcher(((Xh, yh) for _ in range(3)),
+                          capture_spec=tr._kvstore)
+    before = sync.value
+    for xb, yb in pf:
+        step(xb, yb)
+        assert step.last_fallback_reason is None
+    pf.close()
+    assert sync.value == before
+    assert step.cache_size == 1
+
+
+# -------------------------------------------------- elastic resharding
+def test_manifest_partition_specs_and_elastic_restore():
+    """Save on (2,2): the manifest records each param's PartitionSpec;
+    restore onto a (1,2) template reshards (template wins) and the
+    values round-trip exactly."""
+    plan22 = shard.plan({"dp": 2, "tp": 2}, rules=_MLP_RULES)
+    rng = np.random.RandomState(3)
+    w = jnp.asarray(rng.randn(16, 8).astype(np.float32))
+    b = jnp.asarray(rng.randn(8).astype(np.float32))
+    params = {
+        "dense0_weight": jax.device_put(
+            w, plan22.sharding("dense0_weight", w.shape)),
+        "dense0_bias": jax.device_put(
+            b, plan22.sharding("dense0_bias", b.shape)),
+    }
+    with tempfile.TemporaryDirectory() as d:
+        checkpoint.save_sharded(d, 0, params)
+        specs = checkpoint.saved_partition_specs(d, 0)
+        assert specs["dense0_weight"] == P("dp")
+        assert specs["dense0_bias"] == P()
+        # restore onto the SHRUNK mesh: the (1,2) template's layout wins
+        plan12 = plan22.with_mesh({"dp": 1, "tp": 2})
+        tmpl = {
+            "dense0_weight": jax.device_put(
+                jnp.zeros_like(w),
+                plan12.sharding("dense0_weight", w.shape)),
+            "dense0_bias": jax.device_put(
+                jnp.zeros_like(b),
+                plan12.sharding("dense0_bias", b.shape)),
+        }
+        out = checkpoint.load_sharded(d, 0, tmpl)
+        np.testing.assert_array_equal(np.asarray(out["dense0_weight"]), w)
+        np.testing.assert_array_equal(np.asarray(out["dense0_bias"]), b)
+        assert len(out["dense0_weight"].sharding.device_set) == 2
+        # pre-flight diagnosis: spec_mismatches names the layouts that
+        # will reshard instead of failing deep in device_put — while
+        # validate_checkpoint stays clean (a mismatch is NOT corruption)
+        step_dir = checkpoint._step_path(d, 0)
+        diag = checkpoint.spec_mismatches(step_dir, tmpl)
+        assert any("dense0_weight" in line for line in diag)
+        assert checkpoint.validate_checkpoint(step_dir) == []
+        # equivalent layouts never read as a mismatch (trailing-None
+        # canonicalisation: P('dp') == P('dp', None))
+        plan22b = shard.plan({"dp": 2, "tp": 2}, rules=_MLP_RULES)
+        same = {
+            "dense0_weight": jax.device_put(
+                jnp.zeros_like(w),
+                NamedSharding(plan22b.mesh, P("dp", None))),
+            "dense0_bias": jax.device_put(
+                jnp.zeros_like(b), NamedSharding(plan22b.mesh, P())),
+        }
+        assert checkpoint.spec_mismatches(step_dir, same) == []
+
+
+def test_resize_mesh_live_matches_cold_resharded_restore():
+    """Trainer.resize_mesh (2,2)->(1,2): live collective reshard keeps
+    params/state bitwise, counts `shard_resharded_bytes` without any
+    host gather, and training after the resize matches a cold resharded
+    restore of the same state bit for bit."""
+    X, y = _data()
+
+    net = _build(X)
+    tr = gluon.Trainer(net.collect_params(), "adam",
+                       {"learning_rate": 0.05}, kvstore="ici")
+    tr.shard(mesh={"dp": 2, "tp": 2}, rules=_MLP_RULES)
+    step = tr.capture(lambda a, b: _lossf(net(a), b).mean())
+    for _ in range(3):
+        step(X, y)
+    w_before = _weights(net)
+
+    rb = registry().counter("shard_resharded_bytes")
+    hg = registry().counter("shard_host_gather_bytes")
+    b0, h0 = rb.value, hg.value
+    tr.resize_mesh({"dp": 1, "tp": 2})
+    assert rb.value > b0                  # state moved through redistribute
+    assert hg.value == h0 == 0            # ... with no full host gather
+    for a, b in zip(_weights(net), w_before):
+        np.testing.assert_array_equal(a, b)
+    p0 = list(net.collect_params().values())[0].data()._data
+    assert len(p0.sharding.device_set) == 2     # now on the (1,2) mesh
+    for _ in range(2):
+        step(X, y)
+        assert step.last_fallback_reason is None
+    live = _weights(net)
+
+    # cold twin: identical state restored onto a FRESH (1,2) trainer
+    with tempfile.TemporaryDirectory() as d:
+        f = os.path.join(d, "states.bin")
+        net_c = _build(X)
+        tr_c = gluon.Trainer(net_c.collect_params(), "adam",
+                             {"learning_rate": 0.05}, kvstore="ici")
+        tr_c.shard(mesh={"dp": 2, "tp": 2}, rules=_MLP_RULES)
+        step_c = tr_c.capture(lambda a, b: _lossf(net_c(a), b).mean())
+        for _ in range(3):
+            step_c(X, y)
+        tr_c.save_states(f)
+        net_r = _build(X, seed=9)       # different init, fully restored
+        for p, q in zip(net_r.collect_params().values(),
+                        net_c.collect_params().values()):
+            p.set_data(nd.array(q.data().asnumpy()))
+        tr_r = gluon.Trainer(net_r.collect_params(), "adam",
+                             {"learning_rate": 0.05}, kvstore="ici")
+        tr_r.load_states(f)
+        tr_r.shard(mesh={"dp": 1, "tp": 2}, rules=_MLP_RULES)
+        step_r = tr_r.capture(lambda a, b: _lossf(net_r(a), b).mean())
+        for _ in range(2):
+            step_r(X, y)
+        for a, b in zip(live, _weights(net_r)):
+            np.testing.assert_array_equal(a, b)
+
+
+def test_resize_same_device_set_respec_and_cycles():
+    """(2,2)->(4,1) keeps the SAME device set — the donating jitted-
+    identity respec path (collectives, source buffers donated) — and
+    repeated shrink/grow cycles keep training without leaking stale
+    executables (the respec cache is bounded)."""
+    X, y = _data()
+    net = _build(X)
+    tr = gluon.Trainer(net.collect_params(), "adam",
+                       {"learning_rate": 0.05}, kvstore="ici")
+    tr.shard(mesh={"dp": 2, "tp": 2}, rules=_MLP_RULES)
+    step = tr.capture(lambda a, b: _lossf(net(a), b).mean())
+    for _ in range(3):
+        step(X, y)
+    w_before = _weights(net)
+    tr.resize_mesh({"dp": 4, "tp": 1})
+    for a, b in zip(_weights(net), w_before):
+        np.testing.assert_array_equal(a, b)
+    p0 = list(net.collect_params().values())[0].data()._data
+    assert len(p0.sharding.device_set) == 4
+    for axes in ({"dp": 2, "tp": 2}, {"dp": 4, "tp": 1},
+                 {"dp": 2, "tp": 2}):
+        tr.resize_mesh(axes)
+        step(X, y)
+        assert step.last_fallback_reason is None
+
+
+def test_redistribute_same_mesh_respec_is_exact():
+    mesh = _mesh22()
+    x = jax.device_put(jnp.arange(64, dtype=jnp.float32).reshape(8, 8),
+                       NamedSharding(mesh, P("dp")))
+    ref = np.asarray(x)
+    out = shard.redistribute_array(x, NamedSharding(mesh, P(None, "tp")))
+    np.testing.assert_array_equal(np.asarray(out), ref)
+    assert out.sharding.spec == P(None, "tp")
+    # already in layout: returned unchanged, nothing counted
+    c = registry().counter("shard_resharded_bytes")
+    before = c.value
+    again = shard.redistribute_array(out, NamedSharding(mesh,
+                                                        P(None, "tp")))
+    assert again is out and c.value == before
+
+
+# ------------------------------------------------- prefetch placement
+def test_prefetch_leaf_sharding_2d_and_non_leading_axis():
+    from mxnet_tpu.prefetch import _leaf_sharding
+    mesh = _mesh22()
+    lead = NamedSharding(mesh, P("dp"))
+    # divisible leading dim: spec applies untouched
+    assert _leaf_sharding(lead, 2, (8, 4)) is lead
+    # non-leading batch axis: dim 1 checked, not dim 0
+    mid = NamedSharding(mesh, P(None, "dp"))
+    assert _leaf_sharding(mid, 2, (3, 8)) is mid
+    # scalar: replicated silently (no warning)
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        out = _leaf_sharding(lead, 0, ())
+    assert out.spec == P()
+    # non-divisible batch dim: replicated WITH a (once-per-layout) warning
+    with pytest.warns(RuntimeWarning, match="REPLICATED"):
+        out = _leaf_sharding(mid, 2, (3, 7))
+    assert out.spec == P()
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")      # second time: silent
+        out2 = _leaf_sharding(mid, 2, (3, 7))
+    assert out2.spec == P()
+
+
+def test_resolve_placement_accepts_plan_and_namedsharding():
+    from mxnet_tpu.prefetch import resolve_placement
+    plan = shard.plan({"dp": 2, "tp": 2})
+    assert resolve_placement(plan) == plan.batch_sharding()
+    sh = NamedSharding(plan.mesh, P(None, "dp"))
+    assert resolve_placement(sh) is sh
+    # a kvstore with a plan resolves to the plan's batch sharding
+    kv = mx.kv.create("ici")
+    kv.set_shard_plan(plan)
+    assert resolve_placement(kv) == plan.batch_sharding()

@@ -22,6 +22,12 @@ the zero is a measurement, not a dead counter). Runs over the 'ici'
 mesh when >= 2 devices are available (the sharded-placement path),
 single-device otherwise.
 
+ISSUE 8 extension — the warm-step budget also covers the RULE-SHARDED
+captured step: with a (2,2) ('dp','tp') shard plan (mxnet_tpu/shard/)
+attached, a warm step must stay within the same dispatch budget, do zero
+synchronous H2D when the device prefetcher feeds it, and genuinely
+reduce per-device parameter bytes (>= 4 devices; skipped below that).
+
 ISSUE 6 extension — the warm-step budget also covers the SERVE decode
 loop: a warm continuous-batching decode turn must be at most ONE device
 dispatch (the shared ragged-paged-attention decode executable), the
@@ -122,6 +128,7 @@ def run(steps=DEFAULT_STEPS, budget=DISPATCH_BUDGET):
             break
 
     prefetch_res = _run_prefetch_phase(steps, errors)
+    shard_res = _run_shard_phase(steps, errors)
     serve_res = _run_serve_phase(errors)
 
     res = {
@@ -133,6 +140,7 @@ def run(steps=DEFAULT_STEPS, budget=DISPATCH_BUDGET):
         "max_rel_dev": max_dev,
     }
     res.update(prefetch_res)
+    res.update(shard_res)
     res.update(serve_res)
     res["errors"] = errors
     res["ok"] = not errors
@@ -211,6 +219,84 @@ def _run_prefetch_phase(steps, errors):
         "prefetch_sync_h2d_budget": 0,
         "prefetch_detector_fires": detector_fires,
         "prefetch_mesh": on_mesh,
+    }
+
+
+def _run_shard_phase(steps, errors):
+    """Rule-sharded captured step budget (ISSUE 8): on a 2-D (2,2) mesh
+    with the DEFAULT_RULES shard plan, a warm captured step must stay
+    within the same <=2 dispatch budget (in practice 1), do ZERO
+    synchronous H2D with the device prefetcher feeding it, and actually
+    reduce per-device parameter bytes below the replicated footprint.
+    Needs >= 4 devices (the tier-1 conftest forks 8 CPU devices);
+    single-device standalone runs report the phase skipped."""
+    import jax
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, nd, profiler
+    from mxnet_tpu.observability import registry
+    from mxnet_tpu.prefetch import DevicePrefetcher
+
+    if len(jax.devices()) < 4:
+        return {"shard_mesh": False, "shard_dispatches_per_step": None,
+                "shard_sync_h2d_per_step": None,
+                "shard_param_bytes_frac": None}
+
+    sync = registry().counter("prefetch_h2d_sync")
+    rng = np.random.RandomState(2)
+    Xh = rng.randn(16, 32).astype(np.float32)
+    yh = rng.randint(0, 8, 16).astype(np.float32)
+    lossf = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    mx.random.seed(0)
+    net = gluon.nn.Sequential()
+    net.add(gluon.nn.Dense(32, activation="relu"), gluon.nn.Dense(8))
+    net.initialize(mx.init.Xavier())
+    net(nd.array(Xh))
+
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.05, "momentum": 0.9},
+                       kvstore="ici")
+    plan = tr.shard(mesh={"dp": 2, "tp": 2})
+    params = {p.name: p.data()._data
+              for p in net.collect_params().values()}
+    per_dev, total = plan.param_bytes_per_device(params)
+    frac = per_dev / total
+    if frac >= 1.0:
+        errors.append(f"shard plan did not reduce per-device parameter "
+                      f"bytes ({per_dev}/{total})")
+
+    step = tr.capture(lambda a, b: lossf(net(a), b).mean())
+    step(nd.array(Xh), nd.array(yh))            # compile
+    worst = 0
+    worst_sync = 0
+    pf = DevicePrefetcher(((Xh, yh) for _ in range(steps)),
+                          capture_spec=tr._kvstore)
+    try:
+        for xb, yb in pf:
+            base = sync.value
+            profiler.reset_dispatches()
+            step(xb, yb)
+            worst = max(worst, profiler.dispatch_count())
+            worst_sync = max(worst_sync, sync.value - base)
+            if step.last_fallback_reason is not None:
+                errors.append(f"sharded captured step fell back: "
+                              f"{step.last_fallback_reason}")
+    finally:
+        pf.close()
+    if worst > DISPATCH_BUDGET:
+        errors.append(f"sharded captured dispatch budget exceeded: "
+                      f"{worst}/step (budget {DISPATCH_BUDGET})")
+    if worst_sync:
+        errors.append(f"sharded device-prefetched warm step performed "
+                      f"{worst_sync} synchronous H2D transfer(s) "
+                      f"(budget 0)")
+    return {
+        "shard_mesh": True,
+        "shard_dispatches_per_step": worst,
+        "shard_sync_h2d_per_step": worst_sync,
+        "shard_param_bytes_frac": round(frac, 4),
     }
 
 
@@ -303,11 +389,16 @@ def main(argv=None):
     if res["errors"]:
         print("check_dispatch: FAIL", file=sys.stderr)
         return 1
+    shard_txt = ("shard phase skipped (<4 devices)"
+                 if not res["shard_mesh"] else
+                 f"{res['shard_dispatches_per_step']} dispatch/step "
+                 f"sharded (2,2) at "
+                 f"{res['shard_param_bytes_frac']}x param bytes/dev")
     print(f"check_dispatch: OK ({res['captured_dispatches_per_step']} "
           f"dispatch/step captured vs "
           f"{res['imperative_dispatches_per_step']} imperative; "
           f"{res['prefetch_sync_h2d_per_step']} sync H2D/step with the "
-          f"device prefetcher; "
+          f"device prefetcher; {shard_txt}; "
           f"{res['serve_decode_dispatches_per_step']} dispatch/decode "
           f"turn, {res['serve_decode_retraces']} retraces serving)",
           file=sys.stderr)
