@@ -85,7 +85,15 @@ def _load():
 class NativeEngine:
     def __init__(self, workers=None):
         if workers is None:
-            workers = min(8, os.cpu_count() or 4)
+            # floor at the _PyEngine default (4): engine tasks are host-
+            # side and frequently BLOCK (gate waits, checkpoint IO, a
+            # prefetch stage waiting on its source) — sizing purely by
+            # cpu_count gave a 1-worker engine on 1-CPU machines, where
+            # one blocking task wedges every other push (the watchdog's
+            # "slow but moving queue" contract, DevicePrefetcher's
+            # depth<=workers-1 clamp, and async saves all assume a second
+            # worker exists)
+            workers = min(8, max(4, os.cpu_count() or 4))
         self._lib = _load()
         self._h = self._lib.MXTPUEngineCreate(workers)
         self.workers = workers

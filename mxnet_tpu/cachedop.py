@@ -102,6 +102,21 @@ def _fallback(reason):
     c.inc()
 
 
+def _note_step_failure(exc):
+    """Step-failure surfacing for the recovery supervisor: a captured (or
+    fallback-imperative) step that DIES mid-flight records what killed it
+    — ``cachedop_step_failures{kind=<exception type>}`` plus a trace
+    instant — before the exception propagates, so a crash report written
+    seconds later attributes the step death even when the raising layer's
+    own telemetry was lost with the wedge. Cold path: the registry's
+    (name, labels) memo is the handle cache."""
+    kind = type(exc).__name__
+    _reg.counter("cachedop_step_failures", kind=kind).inc()
+    if _tracer.ACTIVE:
+        _tracer.instant("cachedop.step_failure", cat="trainer",
+                        args={"kind": kind, "error": str(exc)[:200]})
+
+
 # executables retained per CachedStep; a full jitted step program is heavy
 # (variable-length NLP batches would otherwise accumulate one per shape
 # forever), so the cache is a bounded LRU like the backward cache's
@@ -202,13 +217,19 @@ class CachedStep:
         return len(self._cache)
 
     def __call__(self, *batch, batch_size=None):
-        if _tracer.ACTIVE:
-            with _tracer.span("Trainer.captured_step", cat="trainer",
-                              args={"params": len(self._trainer._params),
-                                    "sharded": self._sharded,
-                                    "cache_size": len(self._cache)}):
-                return self._call_impl(batch, batch_size)
-        return self._call_impl(batch, batch_size)
+        try:
+            if _tracer.ACTIVE:
+                with _tracer.span("Trainer.captured_step", cat="trainer",
+                                  args={"params": len(self._trainer._params),
+                                        "sharded": self._sharded,
+                                        "cache_size": len(self._cache)}):
+                    return self._call_impl(batch, batch_size)
+            return self._call_impl(batch, batch_size)
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except BaseException as e:
+            _note_step_failure(e)
+            raise
 
     def _call_impl(self, batch, batch_size):
         from . import prefetch as _prefetch_mod

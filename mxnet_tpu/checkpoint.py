@@ -43,10 +43,13 @@ from .fault import retry as _retry
 
 __all__ = ["save_checkpoint", "load_checkpoint", "save_sharded",
            "load_sharded", "CheckpointManager", "validate_checkpoint",
-           "read_extra", "saved_partition_specs", "derive_partition_specs",
-           "spec_mismatches", "MANIFEST_NAME", "CHECKPOINT_FORMAT"]
+           "read_extra", "read_health", "is_healthy",
+           "saved_partition_specs", "derive_partition_specs",
+           "spec_mismatches", "MANIFEST_NAME", "HEALTH_NAME",
+           "CHECKPOINT_FORMAT"]
 
 MANIFEST_NAME = "manifest.json"
+HEALTH_NAME = "health.json"
 CHECKPOINT_FORMAT = 1
 
 _tmp_seq = itertools.count()
@@ -54,6 +57,7 @@ _tmp_seq = itertools.count()
 _reg = _obs_registry()
 _saves_counter = _reg.counter("checkpoint_saves")
 _fallback_counter = _reg.counter("checkpoint_fallbacks")
+_unhealthy_counter = _reg.counter("checkpoint_unhealthy_skips")
 _last_step_gauge = _reg.gauge("checkpoint_last_step")
 
 _ckpt_policy = None
@@ -452,6 +456,38 @@ def read_extra(directory, step, name):
         return f.read()
 
 
+# ------------------------------------------- last-known-good journal
+# A checkpoint can be INTACT (manifest validates) yet poisoned: a NaN
+# storm that slipped past detection for a step or two leaves a
+# checksummed-perfect checkpoint full of garbage. The health journal
+# records the trainer's rolling loss/finiteness stats AT SAVE TIME
+# (``health.json`` sidecar, checksummed by the manifest like any extra),
+# so a corrupt-state rollback picks a step that was *healthy*, not
+# merely readable. fault/supervisor.py writes it on every periodic save.
+
+def read_health(directory, step=None):
+    """The health record saved with a checkpoint ({"loss", "finite",
+    "healthy", ...} — whatever the saver recorded), or None when the step
+    predates health journaling or the sidecar is unreadable. `directory`
+    may be the step dir itself (step=None) or the checkpoint root +
+    step."""
+    path = directory if step is None else _step_path(directory, step)
+    try:
+        with open(os.path.join(path, HEALTH_NAME)) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError, ValueError):
+        return None
+
+
+def is_healthy(health):
+    """The rollback-eligibility verdict for one health record: an absent
+    record (pre-journal checkpoint) is trusted — only an explicit
+    ``healthy: false`` (or unparseable verdict) disqualifies a step."""
+    if health is None:
+        return True
+    return bool(health.get("healthy", True))
+
+
 class CheckpointManager:
     """Step-stamped rolling checkpoints with resume (reference: the
     epoch-checkpoint callbacks + kvstore resume path), preemption-safe:
@@ -482,12 +518,26 @@ class CheckpointManager:
         return [s for s in self.steps()
                 if not validate_checkpoint(_step_path(self.directory, s))]
 
-    def save(self, step, params, _async=False, extras=None):
+    def save(self, step, params, _async=False, extras=None, health=None):
         """Save one step atomically, then prune to `max_to_keep`.
         Retention recomputes from the post-save listing and never deletes
         the step just written (re-saving an existing step used to make
         the count off by one). _async=True returns a Future (the prune
-        rides in the same engine task); `wait()` drains."""
+        rides in the same engine task); `wait()` drains.
+
+        `health` (a JSON-able dict; convention: at least ``{"healthy":
+        bool}`` plus whatever loss/finiteness stats produced the verdict)
+        lands as the ``health.json`` sidecar — the last-known-good
+        journal `restore_latest_healthy` consults."""
+        if extras and HEALTH_NAME in extras:
+            # unconditional (not only when health= is passed): a forged
+            # or stale sidecar smuggled through extras would be trusted
+            # by restore_latest_healthy — health= is the only door
+            raise MXNetError(f"extras may not name {HEALTH_NAME!r}; "
+                             f"pass health= instead")
+        if health is not None:
+            extras = dict(extras or {})
+            extras[HEALTH_NAME] = json.dumps(health).encode()
         if _async:
             fut = save_sharded(self.directory, step, params, _async=True,
                                extras=extras, _group=self._group)
@@ -560,12 +610,31 @@ class CheckpointManager:
         steps = [s for s in steps
                  if s == just_saved or
                  _manifest_complete(_step_path(self.directory, s))]
+        # pin the newest HEALTHY step, but only while the step just
+        # written is itself journalled UNhealthy: retention must not
+        # defeat the last-known-good journal — a run of consecutive
+        # unhealthy saves (NaN storm with a deferred health check) would
+        # otherwise evict every rollback target before the rollback
+        # happens. A healthy just_saved IS the last known good, so no
+        # pin: quota stays exact in steady state (max_to_keep=1 keeps
+        # holding exactly one), and the pin's max_to_keep+1 dirs exist
+        # only during an unhealthy streak.
+        newest_healthy = None
+        if not is_healthy(read_health(_step_path(self.directory,
+                                                 just_saved))):
+            for s in reversed(steps):
+                if s != just_saved and is_healthy(
+                        read_health(_step_path(self.directory, s))):
+                    newest_healthy = s
+                    break
         excess = len(steps) - self.max_to_keep
         for victim in steps:
             if excess <= 0:
                 break
             if victim == just_saved:
                 continue              # never delete the step just written
+            if victim == newest_healthy:
+                continue              # never delete the last known good
             shutil.rmtree(_step_path(self.directory, victim),
                           ignore_errors=True)
             excess -= 1
@@ -594,11 +663,20 @@ class CheckpointManager:
         self._group.drain(drain_timeout)
         return n
 
-    def restore_latest(self, template, validate=True):
-        """Restore the newest VALID step (manifest-checked); torn or
-        unreadable steps are skipped — each skip counts into the
-        ``checkpoint_fallbacks`` counter — falling back until a valid
-        one loads. Returns (step, params) or (None, None)."""
+    def _restore_scan(self, template, validate=True, want_healthy=False,
+                      skipped_unhealthy=None):
+        """Shared descending candidate scan for the restore-latest
+        flavors. EVERY candidate actually tried is re-validated against
+        its manifest (full sha256) — not just the first: with several
+        torn/corrupt steps in a row the scan must detect each one, and
+        each skipped-corrupt candidate counts into
+        ``checkpoint_fallbacks``. `want_healthy` additionally skips
+        intact steps whose health journal says ``healthy: false``
+        (counted into ``checkpoint_unhealthy_skips``; their step numbers
+        are appended to `skipped_unhealthy`, newest first, so the caller
+        can fall back to a merely-valid step WITHOUT re-validating —
+        re-scanning would double-count the corrupt skips and re-checksum
+        every candidate)."""
         for step in reversed(self.steps()):
             path = _step_path(self.directory, step)
             if validate:
@@ -607,6 +685,15 @@ class CheckpointManager:
                     _fallback_counter.inc()
                     _log_fallback(step, errors)
                     continue
+            if want_healthy and not is_healthy(read_health(path)):
+                _unhealthy_counter.inc()
+                if skipped_unhealthy is not None:
+                    skipped_unhealthy.append(step)
+                from .log import get_logger
+                get_logger("mxnet_tpu.checkpoint").warning(
+                    "rollback skipping step %s: intact but journalled "
+                    "unhealthy (health.json verdict)", step)
+                continue
             try:
                 return step, load_sharded(self.directory, step, template,
                                           validate=False)
@@ -615,16 +702,70 @@ class CheckpointManager:
                 _log_fallback(step, [repr(e)])
         return None, None
 
+    def restore_latest(self, template, validate=True):
+        """Restore the newest VALID step (manifest-checked); torn or
+        unreadable steps are skipped — each skip counts into the
+        ``checkpoint_fallbacks`` counter — falling back until a valid
+        one loads. Returns (step, params) or (None, None)."""
+        return self._restore_scan(template, validate=validate)
+
+    def restore_latest_healthy(self, template, validate=True,
+                               strict=False):
+        """Restore the newest step that is both VALID (manifest-checked)
+        and HEALTHY per its last-known-good journal (`read_health` /
+        `is_healthy`; steps without a journal are trusted). The
+        corrupt-state rollback path (fault/supervisor.py) uses this so a
+        NaN storm that poisoned the most recent — intact — checkpoint
+        rolls back PAST it to the last step whose loss stats were clean.
+        When no healthy step exists, falls back to the newest merely-
+        valid one with a warning (`strict=True` returns (None, None)
+        instead). Returns (step, params) or (None, None)."""
+        skipped = []
+        step, params = self._restore_scan(template, validate=validate,
+                                          want_healthy=True,
+                                          skipped_unhealthy=skipped)
+        if step is not None or strict:
+            return step, params
+        # fall back to the steps the scan ABOVE already validated and
+        # set aside as unhealthy (newest first) — no second checksum
+        # pass, no double-counted fallbacks
+        from .log import get_logger
+        for step in skipped:
+            try:
+                params = load_sharded(self.directory, step, template,
+                                      validate=False)
+            except Exception as e:
+                _fallback_counter.inc()
+                _log_fallback(step, [repr(e)])
+                continue
+            get_logger("mxnet_tpu.checkpoint").warning(
+                "no HEALTHY checkpoint found; restoring newest intact "
+                "step %s despite its health journal — expect the "
+                "failure to recur", step)
+            return step, params
+        return None, None
+
+    def healthy_steps(self):
+        """Steps that are valid AND journalled healthy (oldest first)."""
+        return [s for s in self.valid_steps()
+                if is_healthy(self.read_health(s))]
+
     def read_extra(self, step, name):
         return read_extra(self.directory, step, name)
 
+    def read_health(self, step):
+        return read_health(self.directory, step)
+
     # ------------------------------------------------- emergency save
     def enable_emergency_save(self, params_fn, step_fn=None,
-                              extras_fn=None):
+                              extras_fn=None, health_fn=None):
         """Arm a SIGTERM emergency checkpoint: installs the preemption
         handler and registers a synchronous save of `params_fn()` at step
         `step_fn()` (default: one past the newest step). The training
         loop polls `mx.fault.check_preempted()` to unwind afterwards.
+        `health_fn` (optional) supplies the save's health-journal record
+        — a preemption during a NaN storm then saves an honestly
+        unhealthy-marked checkpoint that rollback will skip past.
         Returns the registered callback (pass to `disable_...`)."""
         from .fault import preemption as _pre
 
@@ -637,7 +778,13 @@ class CheckpointManager:
             step = step_fn() if step_fn is not None else \
                 (self.steps()[-1] + 1 if self.steps() else 0)
             extras = extras_fn() if extras_fn is not None else None
-            self.save(int(step), params_fn(), extras=extras)
+            # params BEFORE health: a health_fn that inspects the same
+            # snapshot (fault/supervisor.py shares one) must find it
+            # already materialised — the grace window is too short to
+            # snapshot a large model twice
+            params = params_fn()
+            health = health_fn() if health_fn is not None else None
+            self.save(int(step), params, extras=extras, health=health)
 
         self.disable_emergency_save()   # re-arm replaces, never stacks
         _pre.install_preemption_handler()

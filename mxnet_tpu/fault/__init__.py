@@ -5,7 +5,8 @@ collectives; this package turns those events from run-killers into
 recoveries (reference capability: the dmlc tracker's restart semantics +
 MXNet's tolerant data iters; design: SURVEY.md §5 failure detection).
 
-Four orthogonal pieces:
+Five pieces — four orthogonal reflexes plus the supervisor that
+composes them into a closed detect → diagnose → recover loop:
 
   * `injection` — deterministic, seeded registry of named failure points
     (`io.read`, `io.decode`, `engine.task`, `kv.collective`, `kv.init`,
@@ -22,6 +23,13 @@ Four orthogonal pieces:
   * `preemption` — SIGTERM handler with emergency callbacks (the
     CheckpointManager registers its emergency save here); training loops
     poll `check_preempted()` and catch `Preempted`.
+  * `supervisor` — the crash-only recovery loop (`run_supervised`):
+    classifies every failure into a domain (transient / corrupt-state /
+    hang / capacity-loss / preemption) and applies the matching policy —
+    retry, rollback + deterministic replay, post-mortem + in-process
+    restart, mesh shrink to survivors, or emergency-save + resumable
+    exit — under a bounded restart budget (docs/RELIABILITY.md
+    "Recovery playbook"; tier-1 gate: tools/check_resilience.py).
 
 Recoveries are visible as metrics: ``fault_injected{point=}``,
 ``fault_retries{site=}``, ``watchdog_timeouts``, plus the subsystem
@@ -34,20 +42,27 @@ from . import injection
 from . import retry
 from . import watchdog
 from . import preemption
+from . import supervisor
 
-from .injection import (FaultInjected, inject, clear, configure, active,
-                        should_fire, check, hits, fires, points)
+from .injection import (FaultInjected, DeviceLost, inject, clear,
+                        configure, active, should_fire, check, hits,
+                        fires, points, check_device_loss, lost_devices,
+                        reset_lost_devices)
 from .retry import RetryPolicy, retry_call, policy_from_env
 from .watchdog import StepWatchdog, WatchdogTimeout
 from .preemption import (Preempted, install_preemption_handler,
                          uninstall_preemption_handler, on_preemption,
                          preempted, check_preempted, reset_preemption)
+from .supervisor import (TrainingSupervisor, run_supervised,
+                         RecoveryExhausted, NonFiniteLoss, DivergedLoss,
+                         classify_failure, DOMAINS)
 
 __all__ = [
-    "injection", "retry", "watchdog", "preemption",
+    "injection", "retry", "watchdog", "preemption", "supervisor",
     # injection
-    "FaultInjected", "inject", "clear", "configure", "active",
-    "should_fire", "check", "hits", "fires", "points",
+    "FaultInjected", "DeviceLost", "inject", "clear", "configure",
+    "active", "should_fire", "check", "hits", "fires", "points",
+    "check_device_loss", "lost_devices", "reset_lost_devices",
     # retry
     "RetryPolicy", "retry_call", "policy_from_env",
     # watchdog
@@ -56,4 +71,7 @@ __all__ = [
     "Preempted", "install_preemption_handler",
     "uninstall_preemption_handler", "on_preemption", "preempted",
     "check_preempted", "reset_preemption",
+    # supervisor
+    "TrainingSupervisor", "run_supervised", "RecoveryExhausted",
+    "NonFiniteLoss", "DivergedLoss", "classify_failure", "DOMAINS",
 ]

@@ -36,16 +36,18 @@ import time
 from ..base import MXNetError
 from ..observability import registry as _obs_registry
 
-__all__ = ["FaultInjected", "POINTS", "ENABLED", "inject", "clear",
-           "configure", "active", "should_fire", "check", "hits", "fires",
-           "points"]
+__all__ = ["FaultInjected", "DeviceLost", "POINTS", "ENABLED", "inject",
+           "clear", "configure", "active", "should_fire", "check", "hits",
+           "fires", "points", "check_device_loss", "lost_devices",
+           "reset_lost_devices"]
 
 # the failure points wired through the framework (a spec may name any
 # string — new sites don't need registration here — but these are the
 # ones the subsystems check)
 POINTS = ("io.read", "io.decode", "engine.task", "kv.collective",
-          "kv.init", "grad.nan", "preempt.sigterm", "checkpoint.save",
-          "checkpoint.load", "serve.admit", "serve.decode")
+          "kv.timeout", "kv.init", "grad.nan", "preempt.sigterm",
+          "checkpoint.save", "checkpoint.load", "serve.admit",
+          "serve.decode", "device.lost")
 
 ENABLED = False            # fast-path guard; True iff any spec registered
 
@@ -53,6 +55,7 @@ _reg = _obs_registry()
 _lock = threading.Lock()
 _specs = {}                # point -> _Spec
 _injected_counters = {}    # point -> Counter handle
+_lost_devices = set()      # device ids masked by fired device.lost points
 
 
 class FaultInjected(MXNetError):
@@ -67,12 +70,26 @@ class FaultInjected(MXNetError):
         super().__init__(msg)
 
 
+class DeviceLost(MXNetError):
+    """Raised by `check_device_loss` when the ``device.lost`` fault point
+    fires: the named device drops out of the active set (a simulated
+    chip/host loss). The lost ids accumulate in `lost_devices()` so a
+    recovery supervisor can build a survivor mesh."""
+
+    def __init__(self, device, context=""):
+        self.device = int(device)
+        msg = f"injected device loss: device {device} left the active set"
+        if context:
+            msg += f" ({context})"
+        super().__init__(msg)
+
+
 class _Spec:
     __slots__ = ("point", "prob", "times", "at", "action", "delay",
-                 "message", "_rng", "hits", "fires")
+                 "message", "device", "_rng", "hits", "fires")
 
     def __init__(self, point, prob=1.0, times=None, at=None, seed=0,
-                 action="raise", delay=0.5, message=""):
+                 action="raise", delay=0.5, message="", device=None):
         if action not in ("raise", "stall", "sigterm"):
             raise MXNetError(f"unknown fault action {action!r}; use "
                              "'raise', 'stall' or 'sigterm'")
@@ -83,6 +100,7 @@ class _Spec:
         self.action = action
         self.delay = float(delay)
         self.message = message
+        self.device = None if device is None else int(device)
         self._rng = random.Random(seed)
         self.hits = 0       # times the point was reached
         self.fires = 0      # times the fault actually triggered
@@ -112,15 +130,17 @@ def _counter(point):
 
 
 def inject(point, prob=1.0, times=None, at=None, seed=0, action="raise",
-           delay=0.5, message=""):
+           delay=0.5, message="", device=None):
     """Arm a failure point. Replaces any existing spec for `point`.
 
     at: iterable of 1-based hit indices that fire (overrides prob);
     times: max total fires; seed: RNG seed for probabilistic schedules;
-    action: 'raise' | 'stall' (sleep `delay` s) | 'sigterm'."""
+    action: 'raise' | 'stall' (sleep `delay` s) | 'sigterm';
+    device: the device id a firing ``device.lost`` point masks (see
+    `check_device_loss`)."""
     global ENABLED
     spec = _Spec(point, prob=prob, times=times, at=at, seed=seed,
-                 action=action, delay=delay, message=message)
+                 action=action, delay=delay, message=message, device=device)
     with _lock:
         _specs[point] = spec
         ENABLED = True
@@ -128,13 +148,18 @@ def inject(point, prob=1.0, times=None, at=None, seed=0, action="raise",
 
 
 def clear(point=None):
-    """Disarm one failure point, or all of them (point=None)."""
+    """Disarm one failure point, or all of them (point=None). Clearing
+    the ``device.lost`` point (or everything) also unmasks any devices a
+    previous fire removed from the active set."""
     global ENABLED
     with _lock:
         if point is None:
             _specs.clear()
+            _lost_devices.clear()
         else:
             _specs.pop(point, None)
+            if point == "device.lost":
+                _lost_devices.clear()
         ENABLED = bool(_specs)
 
 
@@ -158,7 +183,7 @@ def configure(spec_string):
                 kw["at"] = [int(x) for x in v.split("+")]
             elif k == "prob":
                 kw["prob"] = float(v)
-            elif k in ("times", "seed"):
+            elif k in ("times", "seed", "device"):
                 kw[k] = int(v)
             elif k == "delay":
                 kw["delay"] = float(v)
@@ -238,6 +263,50 @@ def check(point, context=""):
         os.kill(os.getpid(), signal.SIGTERM)
         return True
     raise FaultInjected(point, msg or context)
+
+
+def check_device_loss(context=""):
+    """One hit at the ``device.lost`` point. When the schedule fires, the
+    spec's `device` id (default: the highest-id device not yet lost) is
+    masked from the active set — it joins `lost_devices()` — and
+    `DeviceLost` raises so a supervisor can shrink the mesh to the
+    survivors (`Trainer.resize_mesh`). The action key is ignored: device
+    loss always raises; real hardware does not sleep politely. Returns
+    False when nothing fired."""
+    if not ENABLED:
+        return False
+    with _lock:
+        spec = _specs.get("device.lost")
+        if spec is None:
+            return False
+        fire = spec.decide()
+        device = spec.device
+        if fire and device is None:
+            import jax
+            for d in range(jax.device_count() - 1, -1, -1):
+                if d not in _lost_devices:
+                    device = d
+                    break
+            else:
+                device = 0
+        if fire:
+            _lost_devices.add(int(device))
+    if not fire:
+        return False
+    _counter("device.lost").inc()
+    raise DeviceLost(device, context)
+
+
+def lost_devices():
+    """Device ids masked by fired ``device.lost`` points (sorted)."""
+    with _lock:
+        return sorted(_lost_devices)
+
+
+def reset_lost_devices():
+    """Unmask every lost device (recovery complete / test hygiene)."""
+    with _lock:
+        _lost_devices.clear()
 
 
 # env arming: parsed once at import — the chaos harness and users arm

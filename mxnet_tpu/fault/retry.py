@@ -21,6 +21,7 @@ Env-tunable site defaults via `policy_from_env(prefix)`:
 """
 from __future__ import annotations
 
+import math
 import os
 import random
 import time
@@ -102,11 +103,29 @@ def retry_call(fn, *args, policy=None, **kwargs):
     return (policy or RetryPolicy()).call(fn, *args, **kwargs)
 
 
+_warned_env = set()        # keys already warned about (one warning per key)
+
+
 def _env_float(key, default):
+    """Strtol-parity env parsing (the MXTPU_ENGINE_AGING_MS discipline):
+    a malformed, non-finite, or negative value falls back to the default
+    with ONE warning per key instead of crashing at import — a typo'd
+    retry knob on a fleet launcher must degrade, not kill every worker."""
     v = os.environ.get(key)
+    if v is None:
+        return default
     try:
-        return float(v) if v is not None else default
-    except ValueError:
+        out = float(v.strip())
+        if not math.isfinite(out) or out < 0:
+            raise ValueError(f"non-finite or negative: {out}")
+        return out
+    except (ValueError, AttributeError) as e:
+        if key not in _warned_env:
+            _warned_env.add(key)
+            from ..log import get_logger
+            get_logger("mxnet_tpu.fault").warning(
+                "ignoring malformed %s=%r (%s); using default %s",
+                key, v, e, default)
         return default
 
 
@@ -114,7 +133,9 @@ def policy_from_env(prefix, max_retries=4, base_delay=0.05, max_delay=2.0,
                     deadline=30.0, name=None, **kw):
     """A RetryPolicy whose knobs read ``<prefix>_RETRIES`` /
     ``_RETRY_BASE`` / ``_RETRY_MAX`` / ``_RETRY_DEADLINE`` env overrides.
-    ``<prefix>_RETRIES=0`` disables retrying at that site."""
+    ``<prefix>_RETRIES=0`` disables retrying at that site. Malformed
+    values fall back to the defaults with a one-time warning (see
+    `_env_float`)."""
     return RetryPolicy(
         max_retries=int(_env_float(f"{prefix}_RETRIES", max_retries)),
         base_delay=_env_float(f"{prefix}_RETRY_BASE", base_delay),

@@ -1,0 +1,796 @@
+"""Self-healing training: a crash-only recovery supervisor.
+
+PR 3 built the reflexes (injection, retry, watchdog, preemption-safe
+checkpoints) and PR 8 the elastic resharding — but nothing connected
+detect → diagnose → recover: a failed step still killed the process.
+`TrainingSupervisor` (or the `fault.run_supervised` convenience) closes
+the loop as a crash-only state machine around the training loop:
+
+    RUN ──failure──▶ CLASSIFY ──▶ RECOVER(domain) ──▶ RUN
+
+Every failure lands in one of five domains, each with a policy:
+
+  ================  ==========================  =======================
+  domain            detected by                 recovery (parity)
+  ================  ==========================  =======================
+  transient         any retryable step error    retry the SAME batch via
+                                                `RetryPolicy` (bitwise)
+  corrupt_state     non-finite loss, loss       rollback to last VALID +
+                    divergence                  HEALTHY checkpoint, replay
+                                                the data stream (bitwise)
+  hang              `WatchdogTimeout`,          watchdog post-mortem,
+                    `kvstore.CollectiveTimeout` bounded engine drain, then
+                                                rollback + replay (bitwise)
+  capacity_loss     `DeviceLost`                shrink the mesh to the
+                    (device.lost fault point)   survivors via
+                                                `Trainer.resize_mesh` and
+                                                continue sharded (NOT
+                                                bitwise: reduction
+                                                geometry changes)
+  preemption        SIGTERM / `Preempted`       emergency save (armed on
+                                                the CheckpointManager) →
+                                                resumable exit (bitwise
+                                                across the restart)
+  ================  ==========================  =======================
+
+Rollback + replay is deterministic: the periodic checkpoint records the
+number of batches consumed (`supervisor.json` extra) beside the params,
+optimizer state (`Trainer.states_bytes`) and a HEALTH verdict
+(`checkpoint.HEALTH_NAME`); recovery restores the newest valid+healthy
+step (`restore_latest_healthy` — an intact checkpoint written mid-NaN-
+storm is skipped) and fast-forwards a freshly built data iterator by the
+recorded batch count, so the recovered trajectory is bitwise-equal to a
+fault-free run (given a replayable data factory and a step function with
+no hidden host state).
+
+Escalation is bounded: each recovery consumes one unit of
+`restart_budget` with exponential backoff between incidents; a window of
+clean progress (`budget_reset_steps` applied steps) restores the full
+budget. Exhausting it writes a structured CRASH REPORT (incidents,
+domains, engine pending report, metrics snapshot) and raises
+`RecoveryExhausted` — the process-level supervisor's cue that in-process
+recovery is out of moves.
+
+Observability: ``fault_recoveries{domain=}``,
+``fault_restart_budget_remaining``, ``fault_crash_reports``, and one
+trace instant per incident. The chaos soak `tools/check_resilience.py`
+drives every domain in tier-1; knobs and parity promises are documented
+in docs/RELIABILITY.md "Recovery playbook".
+"""
+from __future__ import annotations
+
+import json
+import math
+import os
+import time
+
+from ..base import MXNetError
+from ..observability import registry as _obs_registry
+from ..observability import tracer as _tracer
+from . import injection as _finj
+from .injection import DeviceLost
+from .preemption import Preempted, check_preempted
+from .retry import RetryPolicy
+from .watchdog import StepWatchdog, WatchdogTimeout, _warn_unwritable
+
+__all__ = ["DOMAINS", "TrainingSupervisor", "run_supervised",
+           "RecoveryExhausted", "NonFiniteLoss", "DivergedLoss",
+           "classify_failure"]
+
+DOMAINS = ("transient", "corrupt_state", "hang", "capacity_loss",
+           "preemption")
+
+META_NAME = "supervisor.json"      # per-checkpoint replay cursor extra
+STATES_NAME = "trainer.states"     # per-checkpoint optimizer-state extra
+
+_reg = _obs_registry()
+_budget_gauge = _reg.gauge("fault_restart_budget_remaining")
+_crash_counter = _reg.counter("fault_crash_reports")
+
+
+def _count_recovery(domain):
+    # cold failure path: the registry's own (name, labels) memo is the
+    # cache — no hand-rolled handle dict needed here
+    _reg.counter("fault_recoveries", domain=domain).inc()
+
+
+def _log():
+    from ..log import get_logger
+    return get_logger("mxnet_tpu.fault")
+
+
+class RecoveryExhausted(MXNetError):
+    """The restart budget ran out (or a domain had no viable recovery).
+    `.report` holds the structured crash report; `.report_path` names
+    the JSON on disk (None when the crash dir was unwritable)."""
+
+    def __init__(self, msg, report=None, report_path=None):
+        self.report = report
+        self.report_path = report_path
+        super().__init__(msg)
+
+
+class NonFiniteLoss(MXNetError):
+    """The recorded loss went inf/NaN — corrupt-state domain."""
+
+
+class DivergedLoss(MXNetError):
+    """The recorded loss exploded against its rolling window —
+    corrupt-state domain."""
+
+
+def classify_failure(exc):
+    """Map one failure to its recovery domain (the default `classify`
+    hook). Anything unrecognised is TRANSIENT — the safest default: a
+    retry is cheap, and a persistently failing step escalates to
+    rollback and then the restart budget anyway."""
+    from ..kvstore import CollectiveTimeout
+    if isinstance(exc, Preempted):
+        return "preemption"
+    if isinstance(exc, DeviceLost):
+        return "capacity_loss"
+    if isinstance(exc, (WatchdogTimeout, CollectiveTimeout)):
+        return "hang"
+    if isinstance(exc, (NonFiniteLoss, DivergedLoss)):
+        return "corrupt_state"
+    return "transient"
+
+
+class _NonTransient(BaseException):
+    """Carrier lifting a non-transient failure OVER the RetryPolicy
+    (which retries `Exception` subclasses): a hang or device loss must
+    reach its own domain policy, not burn step retries."""
+
+    def __init__(self, exc):
+        self.exc = exc
+        super().__init__(repr(exc))
+
+
+class _ReplayCursor:
+    """Deterministic batch stream with seek: wraps a zero-arg factory
+    (or a re-iterable collection) and counts batches drawn; `seek(n)`
+    rebuilds the stream and re-draws n batches so a rollback replays the
+    exact fault-free sequence (epoch wrap included). A bare one-shot
+    iterator still trains but refuses seek — rollback/resume need a
+    replayable source."""
+
+    def __init__(self, data):
+        if callable(data):
+            self._factory = data
+        elif hasattr(data, "__next__"):
+            self._factory = None          # consumed-once: not replayable
+            self._one_shot = data
+        else:
+            self._factory = lambda: iter(data)
+        self._it = None
+        self.drawn = 0
+
+    @property
+    def replayable(self):
+        return self._factory is not None
+
+    def _fresh(self):
+        if self._factory is not None:
+            return iter(self._factory())
+        it, self._one_shot = self._one_shot, None
+        if it is None:
+            raise MXNetError("data iterator already consumed and not "
+                             "replayable; pass a zero-arg factory")
+        return it
+
+    def next(self):
+        if self._it is None:
+            self._it = self._fresh()
+        try:
+            batch = next(self._it)
+        except StopIteration:
+            if not self.replayable:
+                raise
+            self._it = self._fresh()      # epoch wrap
+            batch = next(self._it)        # empty stream: let it propagate
+        self.drawn += 1
+        return batch
+
+    def seek(self, n):
+        if not self.replayable:
+            raise MXNetError(
+                "rollback/resume needs a replayable data source — pass a "
+                "zero-arg iterator factory (or a re-iterable dataset) to "
+                "the supervisor, not a half-consumed iterator")
+        self._it = self._fresh()
+        self.drawn = 0
+        for _ in range(int(n)):
+            self.next()
+
+
+class TrainingSupervisor:
+    """Crash-only recovery supervisor around a training loop.
+
+    trainer:  the `gluon.Trainer` whose params/optimizer state define
+              the recoverable state (default snapshot/restore hooks read
+              them structurally; override with params_fn/set_params_fn).
+    step_fn:  `step_fn(batch) -> loss` — runs ONE training step and
+              returns a loss (anything `float(np.asarray(...))` accepts).
+              Must be repeat-safe until the update applies: a failure
+              before the optimizer update may be retried on the same
+              batch (the imperative and captured steps both qualify).
+    data:     zero-arg iterator factory (replayable → rollback/resume
+              work), a re-iterable dataset, or a bare iterator
+              (trainable, but rollback refuses).
+
+    checkpoint_dir/manager: where periodic + emergency checkpoints live;
+              None disables checkpointing (then corrupt-state/hang
+              failures go straight to the crash report).
+    checkpoint_every: periodic save cadence in applied steps.
+    restart_budget: recoveries allowed before the crash report;
+              `budget_reset_steps` clean applied steps restore it.
+    check_every: loss health-check cadence (finiteness + divergence).
+    divergence_factor: loss > factor * max(1, |median(window)|) raises
+              `DivergedLoss` (needs >= 4 recorded losses).
+    retry:    `RetryPolicy` for in-step transient retries (None → a
+              default 3-attempt policy; retries are counted in
+              ``fault_retries{site=supervisor_step}`` and do NOT consume
+              restart budget — exhausting them escalates to rollback,
+              which does).
+    """
+
+    def __init__(self, trainer, step_fn, data, *, checkpoint_dir=None,
+                 manager=None, checkpoint_every=10, max_to_keep=3,
+                 restart_budget=5, budget_reset_steps=64,
+                 backoff_base=0.05, backoff_max=5.0, retry=None,
+                 check_every=1, divergence_factor=1e4, health_window=16,
+                 watchdog=None, crash_dir=None, classify=None,
+                 on_capacity_loss=None, params_fn=None, set_params_fn=None,
+                 emergency_save=True, drain_timeout_ms=2000,
+                 sleep=time.sleep):
+        from ..checkpoint import CheckpointManager
+        self._trainer = trainer
+        self._step_fn = step_fn
+        self._cursor = _ReplayCursor(data)
+        if manager is None and checkpoint_dir is not None:
+            manager = CheckpointManager(checkpoint_dir,
+                                        max_to_keep=max_to_keep)
+        self._mgr = manager
+        self.checkpoint_every = int(checkpoint_every)
+        self.restart_budget = int(restart_budget)
+        self.budget_reset_steps = int(budget_reset_steps)
+        self.backoff_base = float(backoff_base)
+        self.backoff_max = float(backoff_max)
+        self._retry = retry if retry is not None else RetryPolicy(
+            max_retries=3, base_delay=0.02, max_delay=0.5,
+            name="supervisor_step")
+        self.check_every = max(1, int(check_every))
+        self.divergence_factor = float(divergence_factor)
+        self.health_window = int(health_window)
+        self._watchdog = watchdog if watchdog is not None else \
+            StepWatchdog()
+        self._crash_dir = crash_dir or os.environ.get(
+            "MXTPU_CRASH_DIR", self._watchdog.snapshot_dir)
+        self._classify = classify or classify_failure
+        self._on_capacity_loss = on_capacity_loss
+        self._params_fn = params_fn or self._default_params
+        self._set_params_fn = set_params_fn or self._default_set_params
+        self._emergency = bool(emergency_save) and self._mgr is not None
+        self.drain_timeout_ms = int(drain_timeout_ms)
+        self._sleep = sleep
+
+        self._applied = 0             # updates applied (= batches consumed)
+        self._pending_batch = None    # drawn but not yet applied
+        self._losses = []             # rolling float window
+        self._budget_left = self.restart_budget
+        self._consec_incidents = 0
+        self._steps_since_incident = 0
+        self.incidents = []           # structured incident log
+        self.recoveries = {d: 0 for d in DOMAINS}
+        _budget_gauge.set(self._budget_left)
+
+    # --------------------------------------------- default state hooks
+    def _default_params(self):
+        """Structural-keyed jax-array snapshot of the trainer's params
+        (auto-names drift across in-process rebuilds; positions don't)."""
+        import jax.numpy as jnp
+        return {f"p{i:03d}": jnp.asarray(p._data._data)
+                for i, p in enumerate(self._trainer._params)
+                if p._data is not None}
+
+    def _default_set_params(self, tree):
+        from ..ndarray.ndarray import NDArray
+        for i, p in enumerate(self._trainer._params):
+            if p._data is None:
+                continue
+            arr = tree[f"p{i:03d}"]
+            p.set_data(NDArray(getattr(arr, "_data", arr)))
+
+    def _template(self):
+        """Restore template from the LIVE params — the template's
+        sharding wins at restore, so a rule-sharded trainer restores
+        straight back onto its current mesh layout."""
+        return self._params_fn()
+
+    # ------------------------------------------------- state snapshots
+    def _meta_blob(self):
+        return json.dumps({"applied": self._applied,
+                           "loss_window": self._losses[-self.health_window:],
+                           "time": time.time()}).encode()
+
+    def _extras(self):
+        return {META_NAME: self._meta_blob(),
+                STATES_NAME: self._trainer.states_bytes()}
+
+    def health_record(self, params=None):
+        """The last-known-good journal entry for the CURRENT rolling
+        window (written with every periodic and emergency save). Besides
+        the loss stats it checks the PARAMS themselves for finiteness: a
+        NaN that poisoned the weights at step k only shows in the loss
+        at k+1, so a checkpoint saved between the two would otherwise be
+        journalled healthy while holding garbage. `params` lets the
+        caller pass an already-materialised snapshot (the periodic save
+        shares one with the payload instead of snapshotting twice)."""
+        window = self._losses[-self.health_window:]
+        finite = all(math.isfinite(v) for v in window)
+        diverged = self._diverged(window)
+        params_finite = self._params_finite(params)
+        return {"applied": self._applied,
+                "loss": window[-1] if window else None,
+                "finite": finite, "diverged": diverged,
+                "params_finite": params_finite,
+                "window": len(window),
+                "healthy": finite and not diverged and params_finite}
+
+    def _params_finite(self, params=None):
+        import jax.numpy as jnp
+        try:
+            leaves = [getattr(v, "_data", v)
+                      for v in (params if params is not None
+                                else self._params_fn()).values()]
+            if not leaves:
+                return True
+            # one stacked reduction -> ONE host sync for the whole tree
+            return bool(jnp.all(jnp.stack(
+                [jnp.isfinite(a).all() for a in leaves])))
+        except Exception:
+            return True    # exotic leaves: fall back to loss stats only
+
+    def _diverged(self, window):
+        if len(window) < 4 or not all(math.isfinite(v) for v in window):
+            return False
+        prior = sorted(window[:-1])
+        median = prior[len(prior) // 2]
+        return window[-1] > self.divergence_factor * max(1.0, abs(median))
+
+    def _save_checkpoint(self):
+        params = self._params_fn()
+        self._mgr.save(self._applied, params, extras=self._extras(),
+                       health=self.health_record(params=params))
+
+    # ------------------------------------------------------- main loop
+    def run(self, num_steps, resume=None):
+        """Drive `num_steps` applied training steps under supervision.
+        `resume=None` auto-resumes when the checkpoint dir already holds
+        steps (the restart half of a preemption). Returns a report dict:
+        ``outcome`` ("completed" | "preempted" | "data_exhausted" — the
+        last only for non-replayable sources that ran dry), ``applied``,
+        ``final_loss``, ``incidents``, ``recoveries``,
+        ``budget_remaining``, ``resumed_from``. Raises
+        `RecoveryExhausted` (after writing the crash report) when the
+        restart budget runs out."""
+        resumed_from = None
+        outcome = "completed"
+        self._arm()
+        try:
+            if resume is None:
+                resume = self._mgr is not None and bool(self._mgr.steps())
+            if resume:
+                resumed_from = self._restore(initial=True)
+            elif self._mgr is not None and self._mgr.steps():
+                # resume=False over a dir that already holds steps is a
+                # foreign-state trap: a later ROLLBACK would scan the
+                # whole dir and restore the old run's newest healthy
+                # step — silently splicing two unrelated runs. Refuse
+                # the ambiguity instead.
+                raise MXNetError(
+                    f"supervisor: resume=False but checkpoint dir "
+                    f"{self._mgr.directory!r} already holds steps "
+                    f"{self._mgr.steps()} — a rollback would restore "
+                    f"that foreign state; pass resume=True to continue "
+                    f"it, or point at a fresh directory")
+            if self._mgr is not None and resumed_from is None:
+                # step-0 last-known-good: rollback must NEVER be
+                # impossible — a hang on the very first step restores
+                # here and replays from the top (still bitwise)
+                self._save_checkpoint()
+            while self._applied < num_steps:
+                try:
+                    if _finj.ENABLED:
+                        _finj.check("preempt.sigterm", context="supervisor")
+                        _finj.check_device_loss(
+                            context=f"step {self._applied}")
+                    check_preempted()
+                    if self._pending_batch is None:
+                        try:
+                            self._pending_batch = self._cursor.next()
+                        except StopIteration:
+                            # a one-shot iterator ran dry (or the stream
+                            # is empty): end of DATA, not a failure —
+                            # routing it through recovery would burn the
+                            # restart budget on a non-fault
+                            outcome = "data_exhausted"
+                            _log().warning(
+                                "supervisor: data source exhausted after "
+                                "%d applied steps (requested %d) — "
+                                "stopping", self._applied, num_steps)
+                            break
+                    loss = self._attempt_step(self._pending_batch)
+                    self._pending_batch = None
+                    self._applied += 1
+                    self._record_loss(loss)
+                    self._note_progress()
+                    if self._mgr is not None and self.checkpoint_every and \
+                            self._applied % self.checkpoint_every == 0:
+                        self._save_checkpoint()
+                    if self._applied % self.check_every == 0:
+                        self._health_check()
+                    if self._watchdog.enabled:
+                        self._watchdog.check(step=self._applied)
+                except (KeyboardInterrupt, SystemExit):
+                    raise
+                except Preempted as e:
+                    # the emergency save (armed below) already ran inside
+                    # the signal handler; leave a resumable trail and exit
+                    # — counted like every other domain recovery so
+                    # dashboards see real preemptions, not only
+                    # custom-classified ones
+                    outcome = "preempted"
+                    self.incidents.append(
+                        {"domain": "preemption", "applied": self._applied,
+                         "error": repr(e), "recovered": True,
+                         "time": time.time()})
+                    self.recoveries["preemption"] += 1
+                    _count_recovery("preemption")
+                    _log().warning(
+                        "supervisor: preempted after %d applied steps; "
+                        "emergency checkpoint %s — exiting resumable",
+                        self._applied,
+                        "written" if self._emergency else "NOT armed")
+                    break
+                except BaseException as e:
+                    if self._recover(e) == "preempted":
+                        # a classify hook mapped a custom preemption
+                        # notice here: _recover already saved the
+                        # resumable checkpoint
+                        outcome = "preempted"
+                        _log().warning(
+                            "supervisor: classified preemption after %d "
+                            "applied steps; checkpoint written — exiting "
+                            "resumable", self._applied)
+                        break
+        finally:
+            self._disarm()
+        return {"outcome": outcome, "applied": self._applied,
+                "final_loss": self._losses[-1] if self._losses else None,
+                "incidents": list(self.incidents),
+                "recoveries": dict(self.recoveries),
+                "budget_remaining": self._budget_left,
+                "resumed_from": resumed_from}
+
+    # -------------------------------------------------- step execution
+    def _attempt_step(self, batch):
+        """One step under the transient RetryPolicy: retryable failures
+        re-run the SAME batch (bitwise — the optimizer update never
+        applied); non-transient failures lift straight out to their
+        domain policy. An in-step retry that SUCCEEDS counts as a
+        recovered transient incident but consumes no restart budget
+        (the RetryPolicy itself bounds it)."""
+        attempts = [0]
+        last_err = [None]
+
+        def once():
+            attempts[0] += 1
+            try:
+                return self._step_fn(batch)
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except BaseException as e:
+                last_err[0] = e
+                if isinstance(e, Exception) and \
+                        self._classify(e) == "transient":
+                    raise
+                raise _NonTransient(e) from e
+
+        try:
+            result = self._retry.call(once)
+        except _NonTransient as carrier:
+            raise carrier.exc
+        if attempts[0] > 1:
+            self.incidents.append(
+                {"domain": "transient", "applied": self._applied,
+                 "error": repr(last_err[0]), "retries": attempts[0] - 1,
+                 "recovered": True, "time": time.time()})
+            self.recoveries["transient"] += 1
+            _count_recovery("transient")
+            if _tracer.ACTIVE:
+                _tracer.instant("fault.incident", cat="fault",
+                                args={"domain": "transient",
+                                      "applied": self._applied,
+                                      "retries": attempts[0] - 1})
+        return result
+
+    def _record_loss(self, loss):
+        import numpy as np
+        try:
+            # .item() accepts any size-1 array shape ((), (1,), (1,1));
+            # float(ndarray) on ndim>0 is deprecated and will raise
+            value = float(np.asarray(getattr(loss, "_data", loss)).item())
+        except (TypeError, ValueError) as e:
+            raise MXNetError(
+                f"supervisor: step_fn must return a scalar-coercible "
+                f"loss (got {type(loss).__name__}): {e}") from e
+        self._losses.append(value)
+        if len(self._losses) > 4 * self.health_window:
+            del self._losses[:-2 * self.health_window]
+
+    def _health_check(self):
+        window = self._losses[-self.health_window:]
+        if not window:
+            return
+        if not math.isfinite(window[-1]):
+            raise NonFiniteLoss(
+                f"loss {window[-1]} at applied step {self._applied} — "
+                f"parameters are likely poisoned; rolling back")
+        if self._diverged(window):
+            raise DivergedLoss(
+                f"loss {window[-1]:g} exploded past "
+                f"{self.divergence_factor:g}x the rolling median at "
+                f"applied step {self._applied}; rolling back")
+
+    def _note_progress(self):
+        self._steps_since_incident += 1
+        if self._consec_incidents and \
+                self._steps_since_incident >= self.budget_reset_steps:
+            self._consec_incidents = 0
+            if self._budget_left < self.restart_budget:
+                _log().info(
+                    "supervisor: %d clean steps — restart budget restored "
+                    "to %d", self._steps_since_incident, self.restart_budget)
+                self._budget_left = self.restart_budget
+                _budget_gauge.set(self._budget_left)
+
+    # ----------------------------------------------------- recoveries
+    def _recover(self, exc):
+        domain = self._classify(exc)
+        if domain not in DOMAINS:
+            # a custom classify hook returned something off-table:
+            # treat as transient (the safe catch-all) rather than
+            # KeyError'ing after the recovery already ran
+            _log().warning("supervisor: classify hook returned unknown "
+                           "domain %r — treating as transient", domain)
+            domain = "transient"
+        incident = {"domain": domain, "applied": self._applied,
+                    "error": repr(exc), "time": time.time()}
+        self.incidents.append(incident)
+        if _tracer.ACTIVE:
+            _tracer.instant("fault.incident", cat="fault",
+                            args={"domain": domain,
+                                  "applied": self._applied,
+                                  "error": repr(exc)[:200]})
+        if domain == "preemption":
+            # a custom classify hook mapped its cluster's preemption
+            # notice here without a SIGTERM ever being delivered (the
+            # built-in Preempted never reaches _recover): the policy is
+            # emergency save + resumable exit, NOT rollback — and it
+            # consumes no restart budget
+            if self._mgr is not None:
+                self._save_checkpoint()
+            incident["recovered"] = True
+            self.recoveries[domain] += 1
+            _count_recovery(domain)
+            return "preempted"
+        if self._budget_left <= 0:
+            self._crash(exc, domain, "restart budget exhausted")
+        self._budget_left -= 1
+        _budget_gauge.set(self._budget_left)
+        self._consec_incidents += 1
+        self._steps_since_incident = 0
+        delay = min(self.backoff_max,
+                    self.backoff_base * 2 ** (self._consec_incidents - 1))
+        _log().warning(
+            "supervisor: %s failure at applied step %d (%r) — recovering "
+            "(budget %d/%d left, backoff %.3fs)", domain, self._applied,
+            exc, self._budget_left, self.restart_budget, delay)
+        if delay > 0:
+            self._sleep(delay)
+        if domain == "capacity_loss":
+            self._shrink_mesh(exc)
+        elif domain == "hang":
+            self._hang_post_mortem(exc)
+            self._rollback(exc, domain)
+        else:
+            # corrupt_state, and transient steps that exhausted their
+            # in-step retries: the state may already be poisoned — the
+            # only sound move is rollback to last-known-good + replay
+            self._rollback(exc, domain)
+        incident["recovered"] = True
+        self.recoveries[domain] += 1
+        _count_recovery(domain)
+        return "recovered"
+
+    def _hang_post_mortem(self, exc):
+        """The multi-controller hang answer: dump the post-mortem (what
+        wedged, what was queued behind it), then a BOUNDED engine drain +
+        failure reset so the in-process restart starts from a quiet
+        engine instead of inheriting the wedge."""
+        from .. import engine
+        path = getattr(exc, "snapshot_path", None)
+        if path is None:    # WatchdogTimeout already wrote its own
+            path = self._watchdog.dump_snapshot(
+                step=self._applied, reason=f"hang recovery: {exc!r}")
+        if path:
+            _log().warning("supervisor: hang post-mortem at %s", path)
+        engine.wait_for_all_timeout(self.drain_timeout_ms)
+        engine.clear_failures()
+
+    def _rollback(self, exc, domain):
+        if self._mgr is None:
+            self._crash(exc, domain, "no checkpoint manager configured — "
+                                     "rollback impossible")
+        self._restore(initial=False, cause=exc, domain=domain)
+
+    def _restore(self, initial, cause=None, domain=None):
+        """Restore the newest valid+HEALTHY checkpoint and fast-forward
+        the data stream to its recorded cursor. Returns the restored
+        step, or None on an initial start with an empty dir."""
+        step, params = self._mgr.restore_latest_healthy(self._template())
+        if step is None:
+            if initial:
+                return None
+            self._crash(cause, domain or "corrupt_state",
+                        "no restorable checkpoint for rollback")
+        self._set_params_fn(params)
+        meta = {}
+        raw = self._mgr.read_extra(step, META_NAME)
+        if raw:
+            try:
+                meta = json.loads(raw.decode())
+            except (ValueError, UnicodeDecodeError):
+                meta = {}
+        states = self._mgr.read_extra(step, STATES_NAME)
+        if states:
+            self._trainer.load_states_bytes(states)
+        applied = int(meta.get("applied", step))
+        try:
+            self._cursor.seek(applied)
+        except MXNetError as e:
+            # a non-replayable source makes rollback impossible — that
+            # is a recovery dead end like any other: crash report +
+            # RecoveryExhausted, not a bare error escaping run()
+            self._crash(cause or e, domain or "corrupt_state",
+                        f"rollback impossible: {e}")
+        self._applied = applied
+        self._pending_batch = None
+        self._losses = [v for v in meta.get("loss_window", [])
+                        if isinstance(v, (int, float))]
+        _log().warning("supervisor: restored checkpoint step %s "
+                       "(applied=%d) and replayed the data stream", step,
+                       applied)
+        return step
+
+    def _shrink_mesh(self, exc):
+        """Capacity loss: rebuild the mesh over the survivors and keep
+        training sharded — collective-only redistribution
+        (`Trainer.resize_mesh`), no rollback, params ride live. Parity
+        is NOT promised across a shrink (the reduction geometry
+        changes); determinism within the new mesh is."""
+        lost = set(_finj.lost_devices())
+        dev = getattr(exc, "device", None)
+        if dev is not None:
+            lost.add(int(dev))
+        if self._on_capacity_loss is not None:
+            self._on_capacity_loss(self._trainer, sorted(lost))
+            return
+        plan = getattr(self._trainer, "shard_plan", None)
+        if plan is None:
+            self._crash(exc, "capacity_loss",
+                        "capacity loss without a shard plan — nothing to "
+                        "shrink (attach one via Trainer.shard, or pass "
+                        "on_capacity_loss)")
+        # survivors of the CURRENT mesh: a lost chip shrinks the mesh it
+        # belonged to; drafting idle spare devices is a grow decision the
+        # on_capacity_loss hook can make, not a default
+        survivors = [d for d in plan.mesh.devices.flatten()
+                     if d.id not in lost]
+        axes = dict(plan.mesh.shape)
+        other = 1
+        for name, size in axes.items():
+            if name != plan.data_axis:
+                other *= int(size)
+        new_dp = len(survivors) // other
+        if new_dp < 1:
+            self._crash(exc, "capacity_loss",
+                        f"only {len(survivors)} devices survive but the "
+                        f"non-data axes need {other} — cannot shrink")
+        axes[plan.data_axis] = new_dp
+        self._trainer.resize_mesh(axes,
+                                  devices=survivors[:new_dp * other])
+        _log().warning(
+            "supervisor: lost device(s) %s — resharded onto %d survivors "
+            "(%s) and continuing", sorted(lost), new_dp * other, axes)
+
+    # ---------------------------------------------------- crash report
+    def _crash(self, exc, domain, reason):
+        """Out of moves: write the structured crash report and raise
+        `RecoveryExhausted`. Crash-only to the end — an unwritable crash
+        dir degrades to the in-exception report, never a second crash."""
+        from .. import engine
+        report = {
+            "time": time.time(),
+            "reason": reason,
+            "domain": domain,
+            "error": repr(exc),
+            "applied": self._applied,
+            "restart_budget": self.restart_budget,
+            "budget_remaining": self._budget_left,
+            "incidents": list(self.incidents),
+            "recoveries": dict(self.recoveries),
+            "lost_devices": _finj.lost_devices(),
+            "engine_pending": engine.pending_report(),
+            "engine_failures": engine.failures(),
+            "metrics": _reg.snapshot(),
+        }
+        _crash_counter.inc()
+        if _tracer.ACTIVE:
+            _tracer.instant("fault.crash_report", cat="fault",
+                            args={"domain": domain, "reason": reason})
+        path = None
+        try:
+            os.makedirs(self._crash_dir, exist_ok=True)
+            path = os.path.join(
+                self._crash_dir,
+                f"crash-{time.strftime('%Y%m%d-%H%M%S')}-{os.getpid()}"
+                f".json")
+            with open(path, "w") as f:
+                json.dump(report, f, indent=1, default=str)
+        except OSError as e:
+            _warn_unwritable(self._crash_dir, e)
+            path = None
+        raise RecoveryExhausted(
+            f"supervisor: {reason} ({domain} failure at applied step "
+            f"{self._applied}: {exc!r}); crash report: "
+            f"{path or 'unwritable — embedded in this exception'}",
+            report=report, report_path=path) from exc
+
+    # ------------------------------------------------ arm/disarm hooks
+    def _arm(self):
+        if not self._emergency:
+            return
+        # one snapshot serves both the payload and the health verdict —
+        # the emergency save runs inside the preemption grace window,
+        # where a second full param materialisation can cost the
+        # checkpoint (CheckpointManager materialises params_fn() before
+        # health_fn() for exactly this sharing)
+        snap = {}
+
+        def params_fn():
+            snap["params"] = self._params_fn()
+            return snap["params"]
+
+        def health_fn():
+            return self.health_record(params=snap.pop("params", None))
+
+        self._mgr.enable_emergency_save(
+            params_fn=params_fn,
+            step_fn=lambda: self._applied,
+            extras_fn=self._extras,
+            health_fn=health_fn)
+
+    def _disarm(self):
+        if self._emergency:
+            self._mgr.disable_emergency_save()
+
+
+def run_supervised(trainer, step_fn, data, num_steps, resume=None,
+                   **kwargs):
+    """Convenience: build a `TrainingSupervisor` and run it (`resume`
+    forwards to `run`). Returns (report, supervisor) so callers can
+    inspect incidents or resume with the same configuration."""
+    sup = TrainingSupervisor(trainer, step_fn, data, **kwargs)
+    return sup.run(num_steps, resume=resume), sup

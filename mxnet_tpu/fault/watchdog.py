@@ -28,6 +28,13 @@ _reg = _obs_registry()
 _timeout_counter = _reg.counter("watchdog_timeouts")
 
 
+def _warn_unwritable(path, exc):
+    from ..log import get_logger
+    get_logger("mxnet_tpu.fault").warning(
+        "watchdog post-mortem not written (%s: %s); continuing without "
+        "a snapshot", path, exc)
+
+
 class WatchdogTimeout(MXNetError):
     """The engine failed to drain within the step deadline. The snapshot
     path (when one was written) is in `.snapshot_path`."""
@@ -102,22 +109,30 @@ class StepWatchdog:
         raise WatchdogTimeout(
             f"watchdog: step{'' if step is None else f' {step}'} exceeded "
             f"{self.timeout_ms}ms engine-drain deadline with no progress "
-            f"(snapshot: {path}; engine: {engine.last_error() or 'n/a'})",
+            f"(snapshot: {path or 'unwritable — see log'}; "
+            f"engine: {engine.last_error() or 'n/a'})",
             snapshot_path=path)
 
     def dump_snapshot(self, step=None, reason=""):
         """Write the post-mortem: metrics snapshot, engine failure report
         and last_error as JSON, plus the in-flight trace when the tracer
-        is capturing. Returns the JSON path."""
+        is capturing. Returns the JSON path — or None when the snapshot
+        dir cannot be created or written: a read-only disk must not mask
+        the `WatchdogTimeout` (or crash report) the snapshot decorates,
+        so IO failures here log a warning instead of raising."""
         from .. import engine
         from ..observability import tracer
-        os.makedirs(self.snapshot_dir, exist_ok=True)
         stamp = time.strftime("%Y%m%d-%H%M%S")
         base = os.path.join(self.snapshot_dir, f"watchdog-{stamp}")
         trace_path = None
-        if tracer.ACTIVE:
-            trace_path = base + ".trace.json"
-            tracer.dump(trace_path)
+        try:
+            os.makedirs(self.snapshot_dir, exist_ok=True)
+            if tracer.ACTIVE:
+                trace_path = base + ".trace.json"
+                tracer.dump(trace_path)
+        except OSError as e:
+            _warn_unwritable(self.snapshot_dir, e)
+            return None
         snap = {
             "time": time.time(),
             "step": step,
@@ -133,8 +148,12 @@ class StepWatchdog:
             "metrics": _reg.snapshot(),
         }
         path = base + ".json"
-        with open(path, "w") as f:
-            json.dump(snap, f, indent=1, default=str)
+        try:
+            with open(path, "w") as f:
+                json.dump(snap, f, indent=1, default=str)
+        except OSError as e:
+            _warn_unwritable(path, e)
+            return None
         return path
 
 

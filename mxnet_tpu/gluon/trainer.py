@@ -518,30 +518,66 @@ class Trainer:
                 self._updater.update_bucket(bucket, inv_scale=inv_scale)
         self._note_applied()
 
+    def states_bytes(self):
+        """The optimizer state as ONE bytes blob (the same pickle
+        `save_states` writes) — the checkpoint-extras form: the recovery
+        supervisor (fault/supervisor.py) snapshots this beside every
+        periodic save so a rollback restores momentum/Adam state without
+        a temp-file round trip."""
+        import pickle
+        if self._update_on_kvstore:
+            # the state lives ON the store; reuse its pickler
+            import os
+            import tempfile
+            fd, path = tempfile.mkstemp(suffix=".states")
+            os.close(fd)
+            try:
+                self._kvstore.save_optimizer_states(path)
+                with open(path, "rb") as f:
+                    return f.read()
+            finally:
+                os.unlink(path)
+        import numpy as np
+        import jax
+        states = {k: jax.tree_util.tree_map(lambda x: np.asarray(x._data), v)
+                  for k, v in self._updater.states.items()}
+        return pickle.dumps({"num_update": self._optimizer.num_update,
+                             "states": states})
+
+    def load_states_bytes(self, blob):
+        """Inverse of `states_bytes`."""
+        import pickle
+        if self._update_on_kvstore:
+            import os
+            import tempfile
+            fd, path = tempfile.mkstemp(suffix=".states")
+            os.close(fd)
+            try:
+                with open(path, "wb") as f:
+                    f.write(blob)
+                self._kvstore.load_optimizer_states(path)
+            finally:
+                os.unlink(path)
+            return
+        from ..ndarray.ndarray import NDArray
+        import jax.numpy as jnp
+        data = pickle.loads(blob)
+        self._optimizer.num_update = data["num_update"]
+        self._updater.states = {
+            k: tuple(NDArray(jnp.asarray(s)) for s in v)
+            for k, v in data["states"].items()}
+
     def save_states(self, fname):
         if self._update_on_kvstore:
             # the optimizer state lives ON the store
             self._kvstore.save_optimizer_states(fname)
             return
-        import pickle
-        import numpy as np
-        import jax
-        states = {k: jax.tree_util.tree_map(lambda x: np.asarray(x._data), v)
-                  for k, v in self._updater.states.items()}
         with open(fname, "wb") as f:
-            pickle.dump({"num_update": self._optimizer.num_update,
-                         "states": states}, f)
+            f.write(self.states_bytes())
 
     def load_states(self, fname):
         if self._update_on_kvstore:
             self._kvstore.load_optimizer_states(fname)
             return
-        import pickle
-        from ..ndarray.ndarray import NDArray
-        import jax.numpy as jnp
         with open(fname, "rb") as f:
-            blob = pickle.load(f)
-        self._optimizer.num_update = blob["num_update"]
-        self._updater.states = {
-            k: tuple(NDArray(jnp.asarray(s)) for s in v)
-            for k, v in blob["states"].items()}
+            self.load_states_bytes(f.read())

@@ -26,6 +26,8 @@ scheduler bootstrap) so `rank`/`num_workers` are real on multi-host pods.
 """
 from __future__ import annotations
 
+import threading
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -37,7 +39,8 @@ from .observability import registry as _obs_registry
 from .fault import injection as _finj
 from .fault import retry as _retry
 
-__all__ = ["KVStore", "create", "init_distributed"]
+__all__ = ["KVStore", "create", "init_distributed", "CollectiveTimeout",
+           "collective_timeout_ms"]
 
 # always-on collective accounting (bytes entering a cross-replica reduce),
 # per collective kind — the per-collective byte/latency signal motivating
@@ -65,6 +68,91 @@ def _nbytes(a):
         return int(a.nbytes)
     except Exception:
         return 0
+
+
+# ------------------------------------------------- collective deadlines
+# A blocking collective on a multi-controller pod hangs FOREVER when a
+# peer dies mid-rendezvous — the classic undebuggable multi-host wedge.
+# MXTPU_COLLECTIVE_TIMEOUT_MS bounds every host-blocking collective in
+# this module: the call runs on a daemon worker thread and a typed
+# `CollectiveTimeout` raises when it misses the deadline, which the
+# recovery supervisor (fault/supervisor.py) classifies as a HANG and
+# answers with a post-mortem + in-process restart from checkpoint.
+# Crash-only semantics: the wedged thread is abandoned (XLA offers no
+# safe cancellation), so the only sound continuation is restoring from
+# a checkpoint. SCOPE: the in-process restart is sound single-
+# controller (the abandoned work touches only local devices). On a
+# MULTI-CONTROLLER pod an abandoned collective may later unwedge and
+# desynchronize this host's collective stream against its peers — there
+# the timeout's job is to convert an infinite hang into a typed error
+# for a PROCESS-level restart (exit after the emergency checkpoint),
+# not an in-process replay. 0/unset disables (no thread, no overhead);
+# the ``kv.timeout`` fault point stalls inside the deadline window so
+# the path is testable without a real wedge.
+
+class CollectiveTimeout(MXNetError):
+    """A blocking collective exceeded ``MXTPU_COLLECTIVE_TIMEOUT_MS``.
+    The worker thread running it is abandoned (daemon); treat the
+    process's collective state as poisoned and restart from checkpoint
+    (see docs/RELIABILITY.md "Recovery playbook")."""
+
+    def __init__(self, op, timeout_ms, key=None):
+        self.op = op
+        self.timeout_ms = float(timeout_ms)
+        self.key = key
+        super().__init__(
+            f"collective {op!r}{f' (key={key})' if key else ''} did not "
+            f"complete within MXTPU_COLLECTIVE_TIMEOUT_MS={timeout_ms:g}ms"
+            f" — peer lost or interconnect wedged")
+
+
+def collective_timeout_ms():
+    """The active collective deadline in ms (0 = disabled). Read from the
+    environment on every call so tests/operators can toggle it live;
+    malformed values fall back to 0 with a one-time warning."""
+    return _retry._env_float("MXTPU_COLLECTIVE_TIMEOUT_MS", 0.0)
+
+
+_deadline_tls = threading.local()
+
+
+def _deadline_call(fn, op, key=None, timeout=None):
+    """Run `fn` under the collective deadline (`timeout` ms; None reads
+    the env — pass it when the caller already did, the per-param
+    gradient path must not parse the env twice per collective). Inline
+    (zero overhead) when we are already inside a deadline-bounded call
+    (nested collectives share the outer bound — checked FIRST, before
+    any env read) or the deadline is off. Armed mode spawns one worker
+    thread per bounded collective: that is the deliberate cost of the
+    opt-in knob — it buys a hang bound without a persistent watchdog
+    thread's lifecycle, and fused/captured paths issue few collectives
+    per step."""
+    if getattr(_deadline_tls, "active", False):
+        return fn()
+    if timeout is None:
+        timeout = collective_timeout_ms()
+    if timeout <= 0:
+        return fn()
+    box = {}
+
+    def worker():
+        _deadline_tls.active = True    # thread-local: marks the worker
+        try:
+            box["r"] = fn()
+        except BaseException as e:     # noqa: BLE001 — re-raised below
+            box["e"] = e
+
+    th = threading.Thread(target=worker, daemon=True,
+                          name=f"mxtpu-collective-{op}")
+    th.start()
+    th.join(timeout / 1000.0)
+    if th.is_alive():
+        _reg.counter("kv_collective_timeouts", op=op).inc()
+        raise CollectiveTimeout(op, timeout, key)
+    if "e" in box:
+        raise box["e"]
+    return box.get("r")
+
 
 _DIST_INITIALIZED = False
 
@@ -375,11 +463,27 @@ class KVStore:
         stack and lose its leading dim. Callers that know the layout must
         say so explicitly (gluon.Trainer passes layout="replicated");
         "auto" is the convention for imperative push() of stacked towers.
+
+        With ``MXTPU_COLLECTIVE_TIMEOUT_MS`` set the whole reduce runs
+        under the collective deadline and raises `CollectiveTimeout`
+        instead of blocking forever (see module notes above).
         """
+        timeout = collective_timeout_ms()
+        if timeout <= 0:
+            return self._allreduce_body(arrays, axis, layout, key)
+        return _deadline_call(
+            lambda: self._allreduce_body(arrays, axis, layout, key),
+            "allreduce", key, timeout=timeout)
+
+    def _allreduce_body(self, arrays, axis, layout, key):
         if _finj.ENABLED:
             # 'stall' specs here simulate a hung collective (the watchdog
-            # test bed); 'raise' specs simulate a lost peer
+            # test bed); 'raise' specs simulate a lost peer. kv.timeout is
+            # the deadline-specific flavor: its stall happens INSIDE the
+            # deadline window, so it deterministically produces a
+            # CollectiveTimeout when one is armed
             _finj.check("kv.collective", context=f"key={key}")
+            _finj.check("kv.timeout", context=f"key={key}")
         out = arrays[0]
         for a in arrays[1:]:
             out = out + a
@@ -434,8 +538,10 @@ class KVStore:
                               args={"bytes": nbytes,
                                     "workers": jax.process_count(),
                                     "devices": jax.device_count()}):
-                return self._process_sum_impl(a)
-        return self._process_sum_impl(a)
+                return _deadline_call(lambda: self._process_sum_impl(a),
+                                      "process_sum")
+        return _deadline_call(lambda: self._process_sum_impl(a),
+                              "process_sum")
 
     def _process_sum_impl(self, a):
         import numpy as _np
@@ -514,13 +620,18 @@ class KVStore:
         flatten, split = fns
         profiler.record_dispatch("kv_flatten")
         flat = flatten(list(arrays))
-        if _finj.ENABLED:
-            # fires ONLY where the flat path actually performs a cross-
-            # worker collective (the identity/mixed fast paths above hit
-            # allreduce_'s own check per array instead)
-            _finj.check("kv.collective", context=f"flat key={key}")
+
+        def _reduce():
+            if _finj.ENABLED:
+                # fires ONLY where the flat path actually performs a cross-
+                # worker collective (the identity/mixed fast paths above hit
+                # allreduce_'s own check per array instead)
+                _finj.check("kv.collective", context=f"flat key={key}")
+                _finj.check("kv.timeout", context=f"flat key={key}")
+            return self.allreduce_process_sum(flat)
+
         profiler.record_dispatch("kv_allreduce")
-        red = self.allreduce_process_sum(flat)
+        red = _deadline_call(_reduce, "allreduce_flat", key)
         profiler.record_dispatch("kv_split")
         return split(red)
 

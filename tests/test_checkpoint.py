@@ -390,3 +390,171 @@ def test_cancel_pending_and_emergency_save_cancels_queued_saves():
         engine.wait_for_all()
         assert mgr.valid_steps() == [9]        # cancelled saves never wrote
         assert engine.failures() == []         # cancelled is not a failure
+
+
+# --------------------------------------- ISSUE 10: last-known-good journal
+def test_health_journal_save_read_and_healthy_steps():
+    with tempfile.TemporaryDirectory() as d:
+        mgr = checkpoint.CheckpointManager(d, max_to_keep=10)
+        mgr.save(1, {"w": jnp.ones(2)}, health={"healthy": True,
+                                                "loss": 0.5})
+        mgr.save(2, {"w": jnp.ones(2) * 2},
+                 health={"healthy": False, "loss": float("nan")})
+        mgr.save(3, {"w": jnp.ones(2) * 3})       # pre-journal: trusted
+        assert mgr.read_health(1)["loss"] == 0.5
+        assert mgr.read_health(3) is None
+        assert checkpoint.is_healthy(mgr.read_health(3))
+        assert not checkpoint.is_healthy(mgr.read_health(2))
+        assert mgr.healthy_steps() == [1, 3]
+
+
+def test_restore_latest_healthy_skips_unhealthy_counts_metric():
+    from mxnet_tpu.observability import registry
+    with tempfile.TemporaryDirectory() as d:
+        mgr = checkpoint.CheckpointManager(d, max_to_keep=10)
+        mgr.save(1, {"w": jnp.ones(2)}, health={"healthy": True})
+        mgr.save(2, {"w": jnp.ones(2) * 2}, health={"healthy": False})
+        u0 = registry().counter("checkpoint_unhealthy_skips").value
+        step, params = mgr.restore_latest_healthy({"w": jnp.zeros(2)})
+        assert step == 1
+        np.testing.assert_array_equal(np.asarray(params["w"]), [1, 1])
+        assert registry().counter(
+            "checkpoint_unhealthy_skips").value == u0 + 1
+        # plain restore_latest ignores the journal (newest valid wins)
+        step, _ = mgr.restore_latest({"w": jnp.zeros(2)})
+        assert step == 2
+
+
+def test_restore_latest_healthy_fallback_and_strict():
+    with tempfile.TemporaryDirectory() as d:
+        mgr = checkpoint.CheckpointManager(d, max_to_keep=10)
+        mgr.save(4, {"w": jnp.ones(2)}, health={"healthy": False})
+        # nothing healthy: default degrades to newest merely-valid...
+        step, params = mgr.restore_latest_healthy({"w": jnp.zeros(2)})
+        assert step == 4
+        # ...strict returns nothing instead
+        step, params = mgr.restore_latest_healthy({"w": jnp.zeros(2)},
+                                                  strict=True)
+        assert step is None and params is None
+
+
+def test_restore_scan_validates_every_candidate():
+    """Regression (ISSUE 10 satellite): the descending fallback scan
+    must re-validate the manifest sha256 of EVERY candidate it tries —
+    two differently-corrupted newest steps are both detected and
+    counted, and the scan lands on the third."""
+    from mxnet_tpu.observability import registry
+    with tempfile.TemporaryDirectory() as d:
+        mgr = checkpoint.CheckpointManager(d, max_to_keep=10)
+        for s in (1, 2, 3):
+            mgr.save(s, {"w": jnp.full((2,), float(s))},
+                     health={"healthy": True})
+        # newest: torn (manifest gone); second: silent byte corruption
+        # only a real checksum re-validation can catch
+        os.remove(os.path.join(d, "3", checkpoint.MANIFEST_NAME))
+        with open(os.path.join(d, "2", checkpoint.HEALTH_NAME), "ab") as f:
+            f.write(b" ")
+        c0 = registry().counter("checkpoint_fallbacks").value
+        step, params = mgr.restore_latest({"w": jnp.zeros(2)})
+        assert step == 1
+        np.testing.assert_array_equal(np.asarray(params["w"]), [1, 1])
+        assert registry().counter("checkpoint_fallbacks").value == c0 + 2
+        # the healthy scan applies the same discipline
+        c1 = registry().counter("checkpoint_fallbacks").value
+        step, _ = mgr.restore_latest_healthy({"w": jnp.zeros(2)})
+        assert step == 1
+        assert registry().counter("checkpoint_fallbacks").value == c1 + 2
+
+
+def test_health_extra_name_collision_raises():
+    with tempfile.TemporaryDirectory() as d:
+        mgr = checkpoint.CheckpointManager(d)
+        with pytest.raises(mx.base.MXNetError):
+            mgr.save(1, {"w": jnp.ones(2)},
+                     extras={checkpoint.HEALTH_NAME: b"{}"},
+                     health={"healthy": True})
+
+
+def test_emergency_save_records_health():
+    from mxnet_tpu import fault
+    with tempfile.TemporaryDirectory() as d:
+        mgr = checkpoint.CheckpointManager(d)
+        try:
+            mgr.enable_emergency_save(
+                params_fn=lambda: {"w": jnp.ones(2)},
+                step_fn=lambda: 7,
+                health_fn=lambda: {"healthy": False, "loss": 1e30})
+            os.kill(os.getpid(), __import__("signal").SIGTERM)
+            for _ in range(1000):
+                if fault.preempted():
+                    break
+            assert fault.preempted()
+            h = mgr.read_health(7)
+            assert h is not None and h["healthy"] is False
+        finally:
+            mgr.disable_emergency_save()
+            fault.reset_preemption(clear_callbacks=True)
+            fault.uninstall_preemption_handler()
+
+
+def test_restore_latest_healthy_fallback_counts_each_corrupt_once():
+    """Regression: the no-healthy-step fallback reuses the candidates
+    the first pass already validated — a torn step is checksum-counted
+    into checkpoint_fallbacks exactly ONCE, not once per pass."""
+    from mxnet_tpu.observability import registry
+    with tempfile.TemporaryDirectory() as d:
+        mgr = checkpoint.CheckpointManager(d, max_to_keep=10)
+        mgr.save(1, {"w": jnp.ones(2)}, health={"healthy": False})
+        mgr.save(2, {"w": jnp.ones(2) * 2}, health={"healthy": False})
+        os.remove(os.path.join(d, "2", checkpoint.MANIFEST_NAME))  # torn
+        c0 = registry().counter("checkpoint_fallbacks").value
+        u0 = registry().counter("checkpoint_unhealthy_skips").value
+        step, params = mgr.restore_latest_healthy({"w": jnp.zeros(2)})
+        assert step == 1                      # merely-valid fallback
+        np.testing.assert_array_equal(np.asarray(params["w"]), [1, 1])
+        assert registry().counter("checkpoint_fallbacks").value == c0 + 1
+        assert registry().counter(
+            "checkpoint_unhealthy_skips").value == u0 + 1
+
+
+def test_retention_pins_newest_healthy_step():
+    """Regression: pruning must not evict the last known-good step — a
+    streak of unhealthy saves (NaN storm, deferred health check) keeps
+    the newest HEALTHY checkpoint alive beyond the quota."""
+    with tempfile.TemporaryDirectory() as d:
+        mgr = checkpoint.CheckpointManager(d, max_to_keep=2)
+        mgr.save(1, {"w": jnp.ones(2)}, health={"healthy": True})
+        for s in (2, 3, 4):
+            mgr.save(s, {"w": jnp.ones(2) * s}, health={"healthy": False})
+        assert 1 in mgr.steps()               # pinned past the quota
+        step, params = mgr.restore_latest_healthy({"w": jnp.zeros(2)})
+        assert step == 1
+        np.testing.assert_array_equal(np.asarray(params["w"]), [1, 1])
+        # a new healthy save releases the pin: quota applies again
+        mgr.save(5, {"w": jnp.ones(2) * 5}, health={"healthy": True})
+        mgr.save(6, {"w": jnp.ones(2) * 6}, health={"healthy": True})
+        assert 1 not in mgr.steps()
+
+
+def test_retention_exact_quota_when_saves_healthy():
+    """Regression: the last-known-good pin engages only during an
+    unhealthy streak — steady-state healthy saves keep max_to_keep
+    exact (max_to_keep=1 holds exactly one step)."""
+    with tempfile.TemporaryDirectory() as d:
+        mgr = checkpoint.CheckpointManager(d, max_to_keep=1)
+        for s in range(1, 5):
+            mgr.save(s, {"w": jnp.ones(2) * s}, health={"healthy": True})
+        assert mgr.steps() == [4]
+        # pre-journal saves (no health=) behave identically
+        mgr.save(5, {"w": jnp.ones(2) * 5})
+        assert mgr.steps() == [5]
+
+
+def test_health_extra_forbidden_even_without_health_kwarg():
+    """Regression: a forged health.json cannot be smuggled through
+    extras when health= is omitted — same input, same refusal."""
+    with tempfile.TemporaryDirectory() as d:
+        mgr = checkpoint.CheckpointManager(d)
+        with pytest.raises(mx.base.MXNetError):
+            mgr.save(1, {"w": jnp.ones(2)},
+                     extras={checkpoint.HEALTH_NAME: b'{"healthy": false}'})

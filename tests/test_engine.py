@@ -874,3 +874,23 @@ def test_py_engine_push_after_close_raises():
         eng.push(lambda: 2)
     eng.close()            # idempotent
     eng.wait_for_all()     # no-op on a drained closed engine
+
+
+def test_default_engines_never_single_worker():
+    """Regression (ISSUE 10): engine tasks frequently BLOCK (gate waits,
+    checkpoint IO, prefetch stages) — a default-sized engine on a 1-CPU
+    machine must still have enough workers that one blocking task cannot
+    wedge every other push. Floor: the _PyEngine default (4)."""
+    from mxnet_tpu._native import NativeEngine
+    assert engine.num_workers() >= 2
+    py = _PyEngine()
+    try:
+        assert py.workers >= 4
+    finally:
+        py.close()
+    if engine.native_engine_loaded():
+        native = NativeEngine()
+        try:
+            assert native.workers >= 4
+        finally:
+            native.close()

@@ -411,3 +411,126 @@ def test_kv_collective_stall_injection():
     out = kv.allreduce_([jnp.ones(4)])
     assert time.monotonic() - t0 >= 0.05
     np.testing.assert_allclose(np.asarray(out), np.ones(4))
+
+
+# ---------------------------------------------- ISSUE 10: new fault points
+def test_device_lost_point_deterministic():
+    """device.lost masks the spec'd device on the scheduled hit, raises
+    the typed DeviceLost, and accumulates into lost_devices()."""
+    fault.inject("device.lost", at=[2, 3], device=5)
+    assert fault.check_device_loss() is False       # hit 1: no fire
+    with pytest.raises(fault.DeviceLost) as ei:
+        fault.check_device_loss()
+    assert ei.value.device == 5
+    assert fault.lost_devices() == [5]
+    # a second fire with the same spec device masks the same id
+    with pytest.raises(fault.DeviceLost):
+        fault.check_device_loss()
+    assert fault.lost_devices() == [5]
+    fault.clear("device.lost")
+    assert fault.lost_devices() == []               # clear unmasks
+
+
+def test_device_lost_default_device_is_highest_free():
+    import jax
+    fault.inject("device.lost", at=[1, 2])          # no device= spec
+    with pytest.raises(fault.DeviceLost) as e1:
+        fault.check_device_loss()
+    with pytest.raises(fault.DeviceLost) as e2:
+        fault.check_device_loss()
+    top = jax.device_count() - 1
+    assert e1.value.device == top
+    assert e2.value.device == top - 1               # next free one
+    assert fault.lost_devices() == sorted({top, top - 1})
+
+
+def test_kv_timeout_point_env_parse():
+    """kv.timeout rides MXTPU_FAULTS like any point, including device=
+    parsing for device.lost."""
+    specs = fault.configure("kv.timeout:at=3:action=stall:delay=0.01,"
+                            "device.lost:at=1:device=2")
+    assert {s.point for s in specs} == {"kv.timeout", "device.lost"}
+    assert fault.active("kv.timeout")
+    assert specs[1].device == 2
+    assert "kv.timeout" in fault.injection.POINTS
+    assert "device.lost" in fault.injection.POINTS
+
+
+def test_policy_from_env_malformed_falls_back(monkeypatch, caplog):
+    """Malformed MXTPU_*_RETRY_* values degrade to defaults with a
+    one-time warning instead of crashing at import (strtol-parity with
+    the MXTPU_ENGINE_AGING_MS fix)."""
+    import logging
+    from mxnet_tpu.fault import retry as retry_mod
+    monkeypatch.setenv("MXTPU_T1_RETRIES", "three")
+    monkeypatch.setenv("MXTPU_T1_RETRY_BASE", "inf")
+    monkeypatch.setenv("MXTPU_T1_RETRY_MAX", "-2")
+    monkeypatch.setenv("MXTPU_T1_RETRY_DEADLINE", "12.5")
+    retry_mod._warned_env.discard("MXTPU_T1_RETRIES")
+    with caplog.at_level(logging.WARNING, logger="mxnet_tpu.fault"):
+        p = fault.policy_from_env("MXTPU_T1", max_retries=4)
+    assert p.max_retries == 4           # malformed -> default
+    assert p.base_delay == 0.05         # inf -> default
+    assert p.max_delay == 2.0           # negative -> default
+    assert p.deadline == 12.5           # well-formed value still honoured
+    warned = [r for r in caplog.records if "MXTPU_T1_RETRIES" in r.message]
+    assert len(warned) == 1
+    caplog.clear()
+    with caplog.at_level(logging.WARNING, logger="mxnet_tpu.fault"):
+        fault.policy_from_env("MXTPU_T1")
+    assert not [r for r in caplog.records
+                if "MXTPU_T1_RETRIES" in r.message]   # one-time only
+
+
+def test_watchdog_snapshot_missing_dir_created(tmp_path):
+    wd = fault.StepWatchdog(timeout_ms=0,
+                            snapshot_dir=str(tmp_path / "a" / "b"))
+    path = wd.dump_snapshot(step=3, reason="test")
+    assert path and os.path.exists(path)
+
+
+def test_watchdog_snapshot_unwritable_dir_degrades(tmp_path):
+    """An unwritable snapshot dir must not mask the timeout: dump
+    returns None and check() still raises WatchdogTimeout with
+    snapshot_path=None."""
+    blocker = tmp_path / "file"
+    blocker.write_text("not a dir")
+    wd = fault.StepWatchdog(timeout_ms=100,
+                            snapshot_dir=str(blocker / "sub"))
+    assert wd.dump_snapshot(step=1, reason="x") is None
+    gate = threading.Event()
+    engine.push(gate.wait)
+    assert wd.check(step=1) == 0        # baseline window
+    with pytest.raises(fault.WatchdogTimeout) as ei:
+        wd.check(step=2)
+    gate.set()
+    engine.wait_for_all()
+    assert ei.value.snapshot_path is None
+    engine.clear_error()
+
+
+def test_preemption_second_sigterm_does_not_reenter_save():
+    """Re-entrancy: a second SIGTERM delivered WHILE the emergency save
+    runs must not re-enter the save (the sticky flag is set before the
+    callbacks run)."""
+    calls = []
+
+    def emergency():
+        calls.append(1)
+        # second preemption signal lands mid-save; its python-level
+        # handler runs at the next bytecode boundary inside/after this
+        # callback and must skip the callback list
+        os.kill(os.getpid(), signal.SIGTERM)
+        for _ in range(100):
+            pass                        # boundaries for delivery
+
+    fault.install_preemption_handler()
+    fault.on_preemption(emergency)
+    os.kill(os.getpid(), signal.SIGTERM)
+    for _ in range(1000):
+        if fault.preempted():
+            break
+    assert fault.preempted()
+    assert calls == [1]
+    with pytest.raises(fault.Preempted):
+        fault.check_preempted()

@@ -378,3 +378,51 @@ def test_update_on_kvstore_respects_mults_and_states(tmp_path):
     tr.load_states(f)                # momentum restored from the STORE
     with pytest.raises(mx.base.MXNetError, match="update_on_kvstore"):
         tr.update(2)
+
+
+# ------------------------------------- ISSUE 10: collective deadlines
+def test_collective_timeout_fires_and_recovers(monkeypatch):
+    """A kv.timeout stall past MXTPU_COLLECTIVE_TIMEOUT_MS raises the
+    typed CollectiveTimeout (counted per op); once the schedule is
+    exhausted the same store keeps working under the deadline."""
+    from mxnet_tpu import fault
+    from mxnet_tpu.observability import registry
+    monkeypatch.setenv("MXTPU_COLLECTIVE_TIMEOUT_MS", "100")
+    kv = kvstore.create("ici")
+    a = jnp.ones((4,))
+    c0 = registry().counter("kv_collective_timeouts", op="allreduce").value
+    fault.inject("kv.timeout", at=[1], action="stall", delay=0.6)
+    try:
+        with pytest.raises(kvstore.CollectiveTimeout) as ei:
+            kv.allreduce_([a], layout="replicated", key="w")
+        assert ei.value.op == "allreduce" and ei.value.timeout_ms == 100
+        assert registry().counter("kv_collective_timeouts",
+                                  op="allreduce").value == c0 + 1
+        out = kv.allreduce_([a], layout="replicated", key="w")
+        np.testing.assert_array_equal(np.asarray(out), np.ones(4))
+    finally:
+        fault.clear()
+
+
+def test_collective_deadline_propagates_inner_errors(monkeypatch):
+    """A collective that FAILS (rather than hangs) under the deadline
+    re-raises its own error, not a timeout."""
+    from mxnet_tpu import fault
+    monkeypatch.setenv("MXTPU_COLLECTIVE_TIMEOUT_MS", "500")
+    kv = kvstore.create("ici")
+    fault.inject("kv.collective", at=[1])
+    try:
+        with pytest.raises(fault.FaultInjected):
+            kv.allreduce_([jnp.ones(2)], layout="replicated")
+    finally:
+        fault.clear()
+
+
+def test_collective_timeout_env_malformed_disables(monkeypatch):
+    from mxnet_tpu.fault import retry as retry_mod
+    monkeypatch.setenv("MXTPU_COLLECTIVE_TIMEOUT_MS", "soon")
+    retry_mod._warned_env.discard("MXTPU_COLLECTIVE_TIMEOUT_MS")
+    assert kvstore.collective_timeout_ms() == 0.0
+    kv = kvstore.create("ici")        # and the fast path still works
+    out = kv.allreduce_([jnp.ones(3)], layout="replicated")
+    np.testing.assert_array_equal(np.asarray(out), np.ones(3))

@@ -1,0 +1,388 @@
+"""TrainingSupervisor unit tests (ISSUE 10 tentpole): failure
+classification, replay cursor determinism, per-domain recovery policies,
+restart-budget escalation, crash report, resume. The full cross-domain
+soak (bitwise parity, leaks) runs in tests/test_check_resilience.py."""
+import json
+import math
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, engine, fault, gluon, kvstore, nd
+from mxnet_tpu.fault.supervisor import _ReplayCursor
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.observability import registry
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    yield
+    fault.clear()
+    fault.reset_preemption(clear_callbacks=True)
+    fault.uninstall_preemption_handler()
+    fault.watchdog.set_default(None)
+    engine.clear_failures()
+
+
+def _build(seed=3):
+    mx.random.seed(seed)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, activation="relu", in_units=16),
+            nn.Dense(4, in_units=8))
+    net.initialize(mx.init.Xavier())
+    net(nd.zeros((1, 16)))
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.05, "momentum": 0.9},
+                       kvstore="ici", fused=False)
+    return net, tr
+
+
+def _data(n=5, seed=0):
+    rng = np.random.RandomState(seed)
+    return [(nd.array(rng.randn(4, 16).astype(np.float32)),
+             nd.array(rng.randint(0, 4, 4).astype(np.float32)))
+            for _ in range(n)]
+
+
+_lossf = gluon.loss.SoftmaxCrossEntropyLoss()
+
+
+def _step(net, tr):
+    def step(batch):
+        x, y = batch
+        with autograd.record():
+            loss = _lossf(net(x), y).mean()
+        loss.backward()
+        tr.step(x.shape[0])
+        return loss
+    return step
+
+
+def _params(net):
+    return [np.asarray(p.data().asnumpy())
+            for p in net.collect_params().values()]
+
+
+# ------------------------------------------------------- classification
+def test_classify_failure_table():
+    cf = fault.classify_failure
+    assert cf(fault.Preempted("x")) == "preemption"
+    assert cf(fault.DeviceLost(3)) == "capacity_loss"
+    assert cf(fault.WatchdogTimeout("x")) == "hang"
+    assert cf(kvstore.CollectiveTimeout("allreduce", 100)) == "hang"
+    assert cf(fault.NonFiniteLoss("x")) == "corrupt_state"
+    assert cf(fault.DivergedLoss("x")) == "corrupt_state"
+    assert cf(fault.FaultInjected("io.read")) == "transient"
+    assert cf(OSError("disk")) == "transient"
+    assert cf(RuntimeError("?")) == "transient"
+
+
+# -------------------------------------------------------- replay cursor
+def test_replay_cursor_factory_seek_is_deterministic():
+    data = list(range(7))
+    cur = _ReplayCursor(lambda: iter(data))
+    first = [cur.next() for _ in range(10)]   # wraps the epoch at 7
+    cur.seek(4)
+    assert cur.drawn == 4
+    assert [cur.next() for _ in range(6)] == first[4:10]
+
+
+def test_replay_cursor_reiterable_and_one_shot():
+    cur = _ReplayCursor([1, 2, 3])            # re-iterable: replayable
+    assert [cur.next() for _ in range(4)] == [1, 2, 3, 1]
+    cur.seek(0)
+    assert cur.next() == 1
+    one = _ReplayCursor(iter([1, 2]))         # bare iterator: trainable...
+    assert one.next() == 1
+    with pytest.raises(mx.base.MXNetError):   # ...but seek refuses
+        one.seek(0)
+
+
+# -------------------------------------------------- per-domain policies
+def test_divergence_detection_rolls_back(tmp_path):
+    """A loss explosion (not NaN) triggers the corrupt-state policy via
+    DivergedLoss."""
+    net, tr = _build()
+    data = _data()
+    calls = {"n": 0}
+    real = _step(net, tr)
+
+    def step(batch):
+        loss = real(batch)
+        calls["n"] += 1
+        if calls["n"] == 6:
+            return nd.array([1e9])            # diverged, finite
+        return loss
+
+    rep, sup = fault.run_supervised(
+        tr, step, lambda: iter(data), 8,
+        checkpoint_dir=str(tmp_path / "ck"), checkpoint_every=2,
+        backoff_base=0.0, emergency_save=False, divergence_factor=100.0)
+    assert rep["outcome"] == "completed"
+    assert rep["recoveries"]["corrupt_state"] >= 1
+    assert any("DivergedLoss" in i["error"] for i in rep["incidents"]
+               if i["domain"] == "corrupt_state")
+
+
+def test_transient_retries_do_not_consume_budget(tmp_path):
+    r0 = registry().counter("fault_recoveries", domain="transient").value
+    fault.inject("kv.collective", at=[5])     # one mid-step raise
+    net, tr = _build()
+    rep, sup = fault.run_supervised(
+        tr, _step(net, tr), lambda: iter(_data()), 6,
+        checkpoint_dir=str(tmp_path / "ck"), backoff_base=0.0,
+        emergency_save=False)
+    assert rep["outcome"] == "completed"
+    assert rep["recoveries"]["transient"] == 1
+    assert rep["budget_remaining"] == sup.restart_budget   # untouched
+    assert registry().counter("fault_recoveries",
+                              domain="transient").value == r0 + 1
+
+
+def test_rollback_restores_optimizer_state(tmp_path):
+    """Momentum state must ride the rollback: after recovery the params
+    are bitwise-equal to a fault-free run (which only holds if momentum
+    was restored too)."""
+    data = _data()
+    net, tr = _build()
+    fault.clear()
+    rep, _ = fault.run_supervised(tr, _step(net, tr), lambda: iter(data),
+                                  8, checkpoint_dir=None,
+                                  emergency_save=False)
+    clean = _params(net)
+    fault.inject("grad.nan", at=[5])
+    net, tr = _build()
+    rep, _ = fault.run_supervised(
+        tr, _step(net, tr), lambda: iter(data), 8,
+        checkpoint_dir=str(tmp_path / "ck2"), checkpoint_every=2,
+        backoff_base=0.0, emergency_save=False)
+    assert rep["recoveries"]["corrupt_state"] == 1
+    assert all(np.array_equal(a, b) for a, b in zip(clean, _params(net)))
+
+
+def test_budget_exhaustion_crash_report(tmp_path):
+    fault.inject("grad.nan", prob=1.0)
+    net, tr = _build()
+    with pytest.raises(fault.RecoveryExhausted) as ei:
+        fault.run_supervised(
+            tr, _step(net, tr), lambda: iter(_data()), 10,
+            checkpoint_dir=str(tmp_path / "ck"), checkpoint_every=2,
+            restart_budget=2, backoff_base=0.0, emergency_save=False,
+            crash_dir=str(tmp_path / "crash"))
+    fault.clear()
+    e = ei.value
+    assert e.report["reason"] == "restart budget exhausted"
+    assert len(e.report["incidents"]) >= 3    # 2 recovered + the fatal one
+    assert e.report_path and os.path.exists(e.report_path)
+    blob = json.load(open(e.report_path))
+    assert blob["domain"] == "corrupt_state"
+    assert "metrics" in blob and "engine_pending" in blob
+    assert registry().gauge("fault_restart_budget_remaining").value == 0
+
+
+def test_budget_restores_after_clean_progress(tmp_path):
+    """budget_reset_steps of clean progress refills the restart budget —
+    two incidents separated by a long quiet stretch never exhaust a
+    budget of 1."""
+    fault.inject("grad.nan", at=[3, 14])
+    net, tr = _build()
+    rep, sup = fault.run_supervised(
+        tr, _step(net, tr), lambda: iter(_data()), 18,
+        checkpoint_dir=str(tmp_path / "ck"), checkpoint_every=2,
+        restart_budget=1, budget_reset_steps=4, backoff_base=0.0,
+        emergency_save=False)
+    assert rep["outcome"] == "completed"
+    assert rep["recoveries"]["corrupt_state"] == 2
+    assert rep["budget_remaining"] >= 0
+
+
+def test_unwritable_crash_dir_still_raises_structured(tmp_path):
+    """Crash-only to the end: an unwritable crash dir degrades to the
+    in-exception report — never a secondary crash."""
+    blocker = tmp_path / "f"
+    blocker.write_text("x")
+    fault.inject("grad.nan", prob=1.0)
+    net, tr = _build()
+    with pytest.raises(fault.RecoveryExhausted) as ei:
+        fault.run_supervised(
+            tr, _step(net, tr), lambda: iter(_data()), 10,
+            checkpoint_dir=str(tmp_path / "ck"), checkpoint_every=2,
+            restart_budget=1, backoff_base=0.0, emergency_save=False,
+            crash_dir=str(blocker / "sub"))
+    fault.clear()
+    assert ei.value.report_path is None
+    assert ei.value.report["reason"] == "restart budget exhausted"
+
+
+def test_resume_auto_detects_existing_checkpoints(tmp_path):
+    data = _data()
+    net, tr = _build()
+    rep, _ = fault.run_supervised(tr, _step(net, tr), lambda: iter(data),
+                                  6, checkpoint_dir=str(tmp_path / "ck"),
+                                  checkpoint_every=3, emergency_save=False)
+    assert rep["outcome"] == "completed"
+    # second supervisor over the same dir resumes instead of restarting
+    net2, tr2 = _build(seed=99)
+    rep2, _ = fault.run_supervised(tr2, _step(net2, tr2),
+                                   lambda: iter(data), 10,
+                                   checkpoint_dir=str(tmp_path / "ck"),
+                                   checkpoint_every=3,
+                                   emergency_save=False)
+    assert rep2["resumed_from"] == 6
+    assert rep2["applied"] == 10
+
+
+def test_health_record_and_step_failure_metric(tmp_path):
+    """The health record reflects the rolling window, and a captured-
+    step death shows up in cachedop_step_failures{kind=}."""
+    net, tr = _build()
+    sup = fault.TrainingSupervisor(tr, _step(net, tr),
+                                   lambda: iter(_data()),
+                                   checkpoint_dir=str(tmp_path / "ck"),
+                                   emergency_save=False)
+    sup._losses = [1.0, 0.9, float("nan")]
+    h = sup.health_record()
+    assert h["finite"] is False and h["healthy"] is False
+    sup._losses = [1.0, 1.1, 0.9, 1.0, 1.05]
+    assert sup.health_record()["healthy"] is True
+    # poisoned PARAMS with a clean loss window: the journal must still
+    # flag the save unhealthy (params_finite)
+    p0 = next(iter(net.collect_params().values()))
+    keep = np.asarray(p0.data().asnumpy())
+    p0.set_data(nd.array(keep * np.nan))
+    h = sup.health_record()
+    assert h["params_finite"] is False and h["healthy"] is False
+    p0.set_data(nd.array(keep))
+    # captured-step failure surfacing (the fault fires INSIDE the step)
+    c0 = registry().counter("cachedop_step_failures",
+                            kind="FaultInjected").value
+
+    def loss_fn(x, y):
+        fault.check("step.custom")
+        return _lossf(net(x), y).mean()
+
+    step = tr.capture(loss_fn)
+    fault.inject("step.custom", at=[1])
+    x, y = _data()[0]
+    with pytest.raises(fault.FaultInjected):
+        step(x, y)
+    fault.clear()
+    assert registry().counter("cachedop_step_failures",
+                              kind="FaultInjected").value == c0 + 1
+
+
+def test_states_bytes_roundtrip():
+    net, tr = _build()
+    s = _step(net, tr)
+    for batch in _data(3):
+        s(batch)
+    blob = tr.states_bytes()
+    assert isinstance(blob, bytes) and blob
+    net2, tr2 = _build(seed=5)
+    for batch in _data(3, seed=9):
+        _step(net2, tr2)(batch)
+    tr2.load_states_bytes(blob)
+    assert tr2._optimizer.num_update == tr._optimizer.num_update
+    a = sorted(float(np.asarray(v._data).sum()) for st in
+               tr._updater.states.values() for v in st if v is not None)
+    b = sorted(float(np.asarray(v._data).sum()) for st in
+               tr2._updater.states.values() for v in st if v is not None)
+    assert np.allclose(a, b)
+
+
+def test_one_shot_iterator_exhaustion_is_not_a_fault(tmp_path):
+    """A bare iterator running dry ends the run with outcome
+    'data_exhausted' — no budget burned, no recovery attempted."""
+    net, tr = _build()
+    data = _data(3)
+    rep, sup = fault.run_supervised(
+        tr, _step(net, tr), iter(data), 10,
+        checkpoint_dir=str(tmp_path / "ck"), emergency_save=False)
+    assert rep["outcome"] == "data_exhausted"
+    assert rep["applied"] == 3
+    assert rep["incidents"] == []
+    assert rep["budget_remaining"] == sup.restart_budget
+
+
+def test_rollback_with_unreplayable_source_crashes_structured(tmp_path):
+    """Rollback over a bare iterator is a recovery dead end — it must
+    exit through the RecoveryExhausted/crash-report contract, not leak
+    a bare MXNetError out of run()."""
+    net, tr = _build()
+    data = _data(30)
+    fault.inject("grad.nan", at=[4])
+    with pytest.raises(fault.RecoveryExhausted) as ei:
+        fault.run_supervised(
+            tr, _step(net, tr), iter(data), 20,
+            checkpoint_dir=str(tmp_path / "ck"), checkpoint_every=2,
+            backoff_base=0.0, emergency_save=False,
+            crash_dir=str(tmp_path / "crash"))
+    fault.clear()
+    assert "rollback impossible" in ei.value.report["reason"]
+    assert ei.value.report_path and os.path.exists(ei.value.report_path)
+
+
+def test_unknown_classify_domain_falls_back_to_transient(tmp_path):
+    net, tr = _build()
+    fault.inject("grad.nan", at=[3])
+    rep, _ = fault.run_supervised(
+        tr, _step(net, tr), lambda: iter(_data()), 6,
+        checkpoint_dir=str(tmp_path / "ck"), checkpoint_every=2,
+        backoff_base=0.0, emergency_save=False,
+        classify=lambda e: "network")     # off-table domain
+    fault.clear()
+    assert rep["outcome"] == "completed"
+    assert rep["recoveries"]["transient"] >= 1
+
+
+def test_custom_classified_preemption_exits_resumable(tmp_path):
+    """A classify hook mapping a cluster's own preemption notice to
+    'preemption' gets the domain's promised policy — emergency save +
+    resumable exit — not rollback-and-continue on a dying node."""
+    class NodeReclaim(RuntimeError):
+        pass
+
+    data = _data()
+    net, tr = _build()
+    calls = {"n": 0}
+    real = _step(net, tr)
+
+    def step(batch):
+        calls["n"] += 1
+        if calls["n"] == 5:
+            raise NodeReclaim("node reclaim notice")
+        return real(batch)
+
+    cls = lambda e: ("preemption" if isinstance(e, NodeReclaim)  # noqa: E731
+                     else fault.classify_failure(e))
+    rep, sup = fault.run_supervised(
+        tr, step, lambda: iter(data), 10,
+        checkpoint_dir=str(tmp_path / "ck"), checkpoint_every=100,
+        backoff_base=0.0, emergency_save=False, classify=cls)
+    assert rep["outcome"] == "preempted"
+    assert rep["applied"] == 4
+    assert rep["recoveries"]["preemption"] == 1
+    assert rep["budget_remaining"] == sup.restart_budget   # no budget
+    # the exit left a resumable checkpoint at the preempted step
+    net2, tr2 = _build(seed=50)
+    rep2, _ = fault.run_supervised(
+        tr2, _step(net2, tr2), lambda: iter(data), 10,
+        checkpoint_dir=str(tmp_path / "ck"), emergency_save=False)
+    assert rep2["resumed_from"] == 4 and rep2["applied"] == 10
+
+
+def test_resume_false_over_foreign_steps_refuses(tmp_path):
+    """resume=False over a dir holding another run's steps must refuse
+    loudly — a later rollback would splice the foreign state in."""
+    data = _data()
+    net, tr = _build()
+    fault.run_supervised(tr, _step(net, tr), lambda: iter(data), 4,
+                         checkpoint_dir=str(tmp_path / "ck"),
+                         checkpoint_every=2, emergency_save=False)
+    net2, tr2 = _build(seed=8)
+    with pytest.raises(mx.base.MXNetError, match="resume=True"):
+        fault.run_supervised(tr2, _step(net2, tr2), lambda: iter(data), 4,
+                             checkpoint_dir=str(tmp_path / "ck"),
+                             resume=False, emergency_save=False)

@@ -1,0 +1,398 @@
+#!/usr/bin/env python
+"""Resilience check: drive the recovery supervisor (ISSUE 10) through a
+seeded chaos soak covering EVERY failure domain, and assert each one
+auto-recovers without process death:
+
+  * **transient**  — an injected `kv.collective` raise mid-allreduce is
+    retried on the same batch (bitwise parity with the fault-free run);
+  * **corrupt_state** — a NaN storm (`grad.nan`) poisons the params; the
+    deferred health check lets an INTACT-but-unhealthy checkpoint land
+    first, so the rollback must consult the last-known-good journal,
+    skip it (``checkpoint_unhealthy_skips``), restore the older healthy
+    step and replay to bitwise parity;
+  * **hang** — a `kv.timeout` stall trips `MXTPU_COLLECTIVE_TIMEOUT_MS`
+    → typed `CollectiveTimeout` → watchdog post-mortem written → in-
+    process restart from checkpoint, bitwise parity;
+  * **preemption** — an injected SIGTERM mid-run produces the emergency
+    checkpoint and a resumable exit; the simulated restart must resume
+    past a deliberately TORN higher-step checkpoint
+    (``checkpoint_fallbacks``) and finish at bitwise parity;
+  * **capacity_loss** — a `device.lost` fire on a mesh device shrinks a
+    rule-sharded (dp=2) trainer to the survivors via
+    `Trainer.resize_mesh` and training CONTINUES (no bitwise promise —
+    the reduction geometry changed; finiteness + progress asserted);
+  * **exhaustion** — an unbounded NaN source against a restart budget of
+    1 must exit through `RecoveryExhausted` with a parseable structured
+    crash report and ``fault_restart_budget_remaining`` == 0.
+
+Plus the leak gate: zero pending engine tasks, zero live task groups,
+and zero leftover checkpoint tmp dirs after the whole soak.
+
+Standalone:  python tools/check_resilience.py [--seed N] [--steps N]
+(one JSON line on stdout; exit 0 = every domain recovered). Wired into
+tier-1 by tests/test_check_resilience.py. Capacity-loss phase skips
+cleanly under 2 devices (same discipline as check_dispatch's shard
+phase).
+"""
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+
+
+def _force_cpu():
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = \
+            flags + " --xla_force_host_platform_device_count=8"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+
+N_BATCHES = 6
+BATCH = 8
+FEATS = 32
+CLASSES = 4
+
+
+def make_data(seed):
+    """Deterministic in-memory batch list; the replayable factory is
+    `lambda: iter(data)` — every run (and every rollback replay) sees
+    the identical stream."""
+    import numpy as np
+    from mxnet_tpu import nd
+    rng = np.random.RandomState(seed)
+    return [(nd.array(rng.randn(BATCH, FEATS).astype(np.float32)),
+             nd.array(rng.randint(0, CLASSES, BATCH).astype(np.float32)))
+            for _ in range(N_BATCHES)]
+
+
+def build(seed):
+    """Deterministic net + trainer (momentum SGD: optimizer STATE must
+    survive every rollback/restart too). 'ici' + fused=False so the
+    per-param allreduce path — where kv.collective / kv.timeout fire —
+    actually runs."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd, gluon
+    from mxnet_tpu.gluon import nn
+    mx.random.seed(seed)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu", in_units=FEATS),
+            nn.Dense(CLASSES, in_units=16))
+    net.initialize(mx.init.Xavier(rnd_type="gaussian", magnitude=2.0))
+    net(nd.zeros((1, FEATS)))
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.05, "momentum": 0.9},
+                            kvstore="ici", fused=False)
+    return net, trainer
+
+
+def make_step(net, trainer, lossf):
+    from mxnet_tpu import autograd
+
+    def step(batch):
+        x, y = batch
+        with autograd.record():
+            loss = lossf(net(x), y).mean()
+        loss.backward()
+        trainer.step(BATCH)
+        return loss
+    return step
+
+
+def params_list(net):
+    import numpy as np
+    return [np.asarray(p.data().asnumpy())
+            for p in net.collect_params().values()]
+
+
+def assert_parity(clean, got, phase):
+    import numpy as np
+    bad = [i for i, (a, b) in enumerate(zip(clean, got))
+           if not np.array_equal(a, b)]
+    if bad:
+        raise AssertionError(f"{phase}: params diverged from the "
+                             f"fault-free run at positions {bad}")
+
+
+def _metric(name, **labels):
+    from mxnet_tpu.observability import registry
+    return registry().counter(name, **labels).value
+
+
+def _find_tmp_dirs(root):
+    leaks = []
+    for dirpath, dirnames, _ in os.walk(root):
+        for d in dirnames:
+            if d.startswith(".tmp-"):
+                leaks.append(os.path.join(dirpath, d))
+    return leaks
+
+
+def run(workdir=None, seed=0, steps=14):
+    """Execute the soak; returns the result dict (raises on any
+    recovery/parity/leak failure). Armed faults and preemption state
+    are cleaned up on EVERY exit path — a failing phase must not leave
+    e.g. a prob=1.0 grad.nan spec poisoning the rest of the pytest
+    session."""
+    from mxnet_tpu import fault
+    try:
+        return _run_phases(workdir, seed, steps)
+    finally:
+        fault.clear()
+        fault.reset_preemption(clear_callbacks=True)
+        fault.uninstall_preemption_handler()
+
+
+def _run_phases(workdir, seed, steps):
+    import numpy as np
+    from mxnet_tpu import fault, gluon, engine
+    from mxnet_tpu.fault.watchdog import StepWatchdog
+    import jax
+
+    owns_dir = workdir is None
+    workdir = workdir or tempfile.mkdtemp(prefix="mxtpu_resilience_")
+    os.makedirs(workdir, exist_ok=True)
+    lossf = gluon.loss.SoftmaxCrossEntropyLoss()
+    data = make_data(seed)
+    factory = lambda: iter(data)    # noqa: E731
+
+    rng = np.random.RandomState(seed + 1)
+    # 4 params/step on this net -> per-hit schedules for kv points
+    nan_at = int(rng.randint(3, 6)) * 2 - 1       # odd: see corrupt phase
+    transient_step = int(rng.randint(2, steps - 1))
+    hang_step = int(rng.randint(2, steps - 1))
+    preempt_at = int(rng.randint(4, steps - 2))
+    loss_at = int(rng.randint(2, steps - 2))
+    params_per_step = 4
+
+    groups0 = engine.active_groups()
+    recovered = {}
+
+    def supervise(net, trainer, ckpt, **kw):
+        kw.setdefault("checkpoint_every", 2)
+        kw.setdefault("backoff_base", 0.0)
+        kw.setdefault("emergency_save", False)
+        step = make_step(net, trainer, lossf)
+        return fault.run_supervised(trainer, step, factory, steps,
+                                    checkpoint_dir=ckpt, **kw)
+
+    # ----------------------------------------------------- clean run
+    fault.clear()
+    fault.reset_preemption(clear_callbacks=True)
+    net, trainer = build(seed)
+    rep, _ = supervise(net, trainer, None)
+    if rep["outcome"] != "completed" or rep["applied"] != steps:
+        raise AssertionError(f"clean run did not complete: {rep}")
+    clean = params_list(net)
+    clean_loss = rep["final_loss"]
+
+    # ----------------------------------------------------- transient
+    fault.inject("kv.collective",
+                 at=[(transient_step - 1) * params_per_step + 1])
+    net, trainer = build(seed)
+    rep, _ = supervise(net, trainer, os.path.join(workdir, "ck_transient"))
+    fault.clear()
+    if rep["recoveries"]["transient"] < 1:
+        raise AssertionError(f"transient recovery not recorded: {rep}")
+    assert_parity(clean, params_list(net), "transient")
+    recovered["transient"] = rep["recoveries"]["transient"]
+
+    # ------------------------------------------- corrupt state (NaN)
+    # grad.nan at an ODD step + checkpoint_every=2 + check_every=2: the
+    # poisoned loss is RECORDED at the next (even) step, the periodic
+    # save lands an intact-but-unhealthy checkpoint, and only then does
+    # the health check fire — rollback must skip the unhealthy step via
+    # the journal, restore the older healthy one, and replay
+    unh0 = _metric("checkpoint_unhealthy_skips")
+    fault.inject("grad.nan", at=[nan_at])
+    net, trainer = build(seed)
+    rep, _ = supervise(net, trainer, os.path.join(workdir, "ck_corrupt"),
+                       check_every=2)
+    fault.clear()
+    if rep["recoveries"]["corrupt_state"] < 1:
+        raise AssertionError(f"corrupt-state recovery not recorded: {rep}")
+    if _metric("checkpoint_unhealthy_skips") - unh0 < 1:
+        raise AssertionError("rollback never consulted the health "
+                             "journal (checkpoint_unhealthy_skips flat)")
+    assert_parity(clean, params_list(net), "corrupt_state")
+    recovered["corrupt_state"] = rep["recoveries"]["corrupt_state"]
+
+    # ---------------------------------------------------------- hang
+    wd_dir = os.path.join(workdir, "watchdog")
+    os.environ["MXTPU_COLLECTIVE_TIMEOUT_MS"] = "120"
+    to0 = _metric("kv_collective_timeouts", op="allreduce")
+    try:
+        fault.inject("kv.timeout",
+                     at=[(hang_step - 1) * params_per_step + 1],
+                     action="stall", delay=0.6)
+        net, trainer = build(seed)
+        rep, _ = supervise(net, trainer, os.path.join(workdir, "ck_hang"),
+                           watchdog=StepWatchdog(timeout_ms=0,
+                                                 snapshot_dir=wd_dir))
+        fault.clear()
+    finally:
+        del os.environ["MXTPU_COLLECTIVE_TIMEOUT_MS"]
+    if rep["recoveries"]["hang"] < 1:
+        raise AssertionError(f"hang recovery not recorded: {rep}")
+    if _metric("kv_collective_timeouts", op="allreduce") - to0 < 1:
+        raise AssertionError("CollectiveTimeout never fired")
+    snaps = [f for f in os.listdir(wd_dir) if f.startswith("watchdog-")] \
+        if os.path.isdir(wd_dir) else []
+    if not snaps:
+        raise AssertionError("hang recovery wrote no post-mortem snapshot")
+    assert_parity(clean, params_list(net), "hang")
+    recovered["hang"] = rep["recoveries"]["hang"]
+
+    # ----------------------------------------------------- preemption
+    ck_pre = os.path.join(workdir, "ck_preempt")
+    fb0 = _metric("checkpoint_fallbacks")
+    fault.inject("preempt.sigterm", at=[preempt_at + 1], action="sigterm")
+    net, trainer = build(seed)
+    pre_rep, _ = supervise(net, trainer, ck_pre, emergency_save=True)
+    if pre_rep["outcome"] != "preempted":
+        raise AssertionError(f"SIGTERM never preempted the run: "
+                             f"{pre_rep}")
+    preempted_at = pre_rep["applied"]
+    fault.clear()
+    fault.reset_preemption(clear_callbacks=True)
+    fault.uninstall_preemption_handler()
+    # torn checkpoint at a HIGHER step: resume must skip it
+    torn = os.path.join(ck_pre, str(steps + 100))
+    os.makedirs(torn, exist_ok=True)
+    with open(os.path.join(torn, "junk"), "wb") as f:
+        f.write(b"\x00torn")
+    net, trainer = build(seed + 999)     # different init: restore must win
+    rep, _ = supervise(net, trainer, ck_pre, emergency_save=True)
+    fault.reset_preemption(clear_callbacks=True)
+    fault.uninstall_preemption_handler()
+    if rep["outcome"] != "completed" or rep["resumed_from"] != preempted_at:
+        raise AssertionError(f"resume after preemption failed: {rep}")
+    if _metric("checkpoint_fallbacks") - fb0 < 1:
+        raise AssertionError("torn checkpoint skip not counted")
+    assert_parity(clean, params_list(net), "preemption")
+    if pre_rep["recoveries"]["preemption"] < 1:
+        raise AssertionError("preemption not counted as a recovered "
+                             f"incident: {pre_rep['recoveries']}")
+    recovered["preemption"] = pre_rep["recoveries"]["preemption"]
+
+    # -------------------------------------------------- capacity loss
+    capacity = "skipped"
+    if jax.device_count() >= 2:
+        net, trainer = build(seed)
+        plan = trainer.shard(mesh={"dp": 2, "tp": 1})
+        cstep = trainer.capture(lambda x, y: lossf(net(x), y).mean())
+        mesh_ids = [d.id for d in plan.mesh.devices.flatten()]
+        fault.inject("device.lost", at=[loss_at + 1], device=mesh_ids[-1])
+        step_fn = lambda b: cstep(b[0], b[1])       # noqa: E731
+        rep, _ = fault.run_supervised(
+            trainer, step_fn, factory, steps,
+            checkpoint_dir=os.path.join(workdir, "ck_capacity"),
+            checkpoint_every=4, backoff_base=0.0, emergency_save=False)
+        fault.clear()
+        if rep["outcome"] != "completed" or \
+                rep["recoveries"]["capacity_loss"] < 1:
+            raise AssertionError(f"capacity-loss recovery failed: {rep}")
+        new_shape = dict(trainer.shard_plan.mesh.shape)
+        if new_shape.get("dp") != 1:
+            raise AssertionError(f"mesh did not shrink: {new_shape}")
+        finals = params_list(net)
+        if not all(np.isfinite(a).all() for a in finals):
+            raise AssertionError("post-shrink params not finite")
+        if rep["final_loss"] is None or not np.isfinite(rep["final_loss"]):
+            raise AssertionError("post-shrink loss not finite")
+        capacity = {"survivor_mesh": new_shape,
+                    "final_loss": rep["final_loss"]}
+        recovered["capacity_loss"] = rep["recoveries"]["capacity_loss"]
+    else:
+        capacity = f"skipped ({jax.device_count()} devices)"
+
+    # ----------------------------------------------------- exhaustion
+    from mxnet_tpu.observability import registry
+    crash_dir = os.path.join(workdir, "crash")
+    fault.inject("grad.nan", prob=1.0)
+    net, trainer = build(seed)
+    step = make_step(net, trainer, lossf)
+    try:
+        fault.run_supervised(trainer, step, factory, steps,
+                             checkpoint_dir=os.path.join(workdir, "ck_ex"),
+                             checkpoint_every=2, restart_budget=1,
+                             backoff_base=0.0, emergency_save=False,
+                             crash_dir=crash_dir)
+        raise AssertionError("unbounded NaN source did not exhaust the "
+                             "restart budget")
+    except fault.RecoveryExhausted as e:
+        fault.clear()
+        if not e.report_path or not os.path.exists(e.report_path):
+            raise AssertionError(f"no crash report on disk: {e}")
+        with open(e.report_path) as f:
+            report = json.load(f)
+        for field in ("reason", "domain", "incidents", "metrics",
+                      "engine_pending", "budget_remaining"):
+            if field not in report:
+                raise AssertionError(f"crash report missing {field!r}")
+        if report["reason"] != "restart budget exhausted":
+            raise AssertionError(f"wrong crash reason: {report['reason']}")
+        if registry().gauge("fault_restart_budget_remaining").value != 0:
+            raise AssertionError("budget gauge not zero after exhaustion")
+
+    # ------------------------------------------------------ leak gate
+    engine.wait_for_all()
+    if engine.pending_tasks() != 0:
+        raise AssertionError(f"{engine.pending_tasks()} engine tasks "
+                             f"leaked")
+    if engine.active_groups() != groups0:
+        raise AssertionError(
+            f"task groups leaked: {engine.active_groups()} != {groups0}")
+    tmp_leaks = _find_tmp_dirs(workdir)
+    if tmp_leaks:
+        raise AssertionError(f"checkpoint tmp dirs leaked: {tmp_leaks}")
+
+    result = {
+        "metric": "resilience_soak",
+        "value": 1,
+        "seed": seed,
+        "steps": steps,
+        "parity": "bitwise",            # transient/corrupt/hang/preempt
+        "clean_loss": clean_loss,
+        "recoveries": recovered,
+        "preempted_after": preempted_at,
+        "capacity": capacity,
+        "crash_report_fields": sorted(report.keys()),
+        "delta_checkpoint_fallbacks": _metric("checkpoint_fallbacks") - fb0,
+        "delta_unhealthy_skips": _metric("checkpoint_unhealthy_skips")
+        - unh0,
+    }
+    if owns_dir:
+        shutil.rmtree(workdir, ignore_errors=True)
+    return result
+
+
+def main(argv=None):
+    argv = list(sys.argv[1:] if argv is None else argv)
+    seed, steps = 0, 14
+    if "--seed" in argv:
+        seed = int(argv[argv.index("--seed") + 1])
+    if "--steps" in argv:
+        steps = int(argv[argv.index("--steps") + 1])
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if repo not in sys.path:
+        sys.path.insert(0, repo)
+    _force_cpu()
+    try:
+        res = run(seed=seed, steps=steps)
+    except AssertionError as e:
+        print(f"check_resilience: FAIL: {e}", file=sys.stderr)
+        return 1
+    print(json.dumps(res))
+    print(f"check_resilience: OK (seed={seed}, domains="
+          f"{sorted(res['recoveries'])})", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
