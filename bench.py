@@ -164,14 +164,21 @@ def _enable_compile_cache():
     if os.environ.get("BENCH_CACHE") == "0":
         return
     import jax
-    cache_dir = os.environ.get(
-        "JAX_COMPILATION_CACHE_DIR",
-        os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                     ".jax_cache"))
+    # MXTPU_COMPILE_CACHE is the framework-wide knob (ISSUE 11,
+    # mx.set_compilation_cache); either env wins over the repo default
+    cache_dir = (os.environ.get("JAX_COMPILATION_CACHE_DIR")
+                 or os.environ.get("MXTPU_COMPILE_CACHE")
+                 or os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                 ".jax_cache"))
     try:
         jax.config.update("jax_compilation_cache_dir", cache_dir)
+        # cache EVERY compile (same policy as mx.set_compilation_cache):
+        # a write threshold above the captured step's CPU compile time
+        # (~0.4s) would make the supervisor's compile_cache_hit field
+        # unreachable on the only runs that exist while the TPU tunnel
+        # is dead, and differ from what MXTPU_COMPILE_CACHE configures
         jax.config.update("jax_persistent_cache_min_compile_time_secs",
-                          2.0)
+                          0.0)
         print(f"[bench] compile cache: {cache_dir}", file=sys.stderr)
     except Exception as e:  # pragma: no cover - config API drift
         print(f"[bench] compile cache unavailable: {e!r}",
@@ -342,8 +349,13 @@ def main():
     if not smoke:
         try:
             import bench_mlp
-            result["captured_step_throughput"] = \
-                bench_mlp.measure_captured()
+            cres = bench_mlp.measure_captured()
+            result["captured_step_throughput"] = cres
+            # ISSUE 11: compile cost + persistent-cache outcome of the
+            # captured step as first-class supervisor contract fields —
+            # the perf trajectory records compile cost alongside steps/s
+            result["compile_seconds"] = cres.get("compile_seconds")
+            result["compile_cache_hit"] = cres.get("compile_cache_hit")
         except Exception as e:  # pragma: no cover
             print(f"[bench] captured-step bench failed: {e!r}",
                   file=sys.stderr)

@@ -107,13 +107,24 @@ def _run_imperative(net, n, batch, X, y, lossf, fused=True):
 
 def _run_captured(net, n, batch, X, y, lossf):
     """The whole step as ONE executable (Trainer.capture): steps/s and
-    trainer-issued dispatches/step against the PR-1 fused baseline."""
+    trainer-issued dispatches/step against the PR-1 fused baseline, plus
+    the first-call compile cost and whether it hit the persistent
+    compilation cache (ISSUE 11 supervisor-contract fields)."""
     from mxnet_tpu import gluon, profiler
+    from mxnet_tpu.observability import compilex
     tr = gluon.Trainer(net.collect_params(), "sgd",
                        {"learning_rate": 0.05, "momentum": 0.9})
     step = tr.capture(lambda a, b: lossf(net(a), b).mean())
-    for _ in range(2):                       # compile + warm
-        step(X, y)
+    hits0 = compilex.compile_cache_stats()[0]
+    t0 = time.monotonic()
+    step(X, y)                               # compile
+    # the instrumented executable times its own compiling dispatch
+    # BEFORE the HLO-inspection recompile, so this is the cost a
+    # training loop actually pays; the raw first-call wall clock (which
+    # would fold the inspection in) is only the fallback
+    compile_s = step.last_compile_seconds or (time.monotonic() - t0)
+    cache_hit = compilex.compile_cache_stats()[0] > hits0
+    step(X, y)                               # warm
     profiler.reset_dispatches()
     step(X, y)
     step_dispatches = profiler.dispatch_count()
@@ -127,7 +138,8 @@ def _run_captured(net, n, batch, X, y, lossf):
     if fallback is not None:
         print(f"[bench_mlp] WARNING: captured step fell back "
               f"({fallback})", file=sys.stderr)
-    return batch * n / dt, n / dt, step_dispatches, final, fallback
+    return (batch * n / dt, n / dt, step_dispatches, final, fallback,
+            compile_s, cache_hit)
 
 
 def measure(on_result=None, trace=None):
@@ -140,7 +152,7 @@ def measure(on_result=None, trace=None):
         return _run_imperative(net, n, batch, X, y, lossf, fused=fused)
 
     def run_captured(net, n):
-        return _run_captured(net, n, batch, X, y, lossf)[:4]
+        return _run_captured(net, n, batch, X, y, lossf)
 
     imp_s, imp_steps_s, imp_disp, imp_loss = run(build(), imp_steps)
     print(f"[bench_mlp] imperative fused: {imp_s:.0f} samples/s "
@@ -154,11 +166,13 @@ def measure(on_result=None, trace=None):
           f"loss {unf_loss:.4f}, fused is {imp_s / unf_s:.2f}x)",
           file=sys.stderr)
 
-    cap_s, cap_steps_s, cap_disp, cap_loss = run_captured(build(), steps)
+    (cap_s, cap_steps_s, cap_disp, cap_loss, _, cap_compile_s,
+     cap_cache_hit) = run_captured(build(), steps)
     print(f"[bench_mlp] captured: {cap_s:.0f} samples/s "
           f"({cap_steps_s:.2f} steps/s, {cap_disp} dispatches/step, "
           f"loss {cap_loss:.4f}, {cap_s / imp_s:.2f}x the fused "
-          "imperative baseline)", file=sys.stderr)
+          f"imperative baseline; compile {cap_compile_s:.2f}s, "
+          f"cache {'hit' if cap_cache_hit else 'miss'})", file=sys.stderr)
 
     hyb_net = build()
     hyb_net.hybridize()
@@ -182,6 +196,8 @@ def measure(on_result=None, trace=None):
         "captured_steps_s": round(cap_steps_s, 3),
         "captured_dispatches_per_step": int(cap_disp),
         "captured_vs_fused": round(cap_s / imp_s, 3),
+        "compile_seconds": round(cap_compile_s, 3),
+        "compile_cache_hit": bool(cap_cache_hit),
     }
     if trace:
         from mxnet_tpu import profiler
@@ -245,8 +261,8 @@ def measure_captured(on_result=None):
     # side, so it gets the reduced step count
     imp_steps = max(3, steps // 5)
 
-    _, cap_steps_s, disp, _, fallback = _run_captured(
-        build(), steps, batch, X, y, lossf)
+    (_, cap_steps_s, disp, _, fallback, compile_s,
+     cache_hit) = _run_captured(build(), steps, batch, X, y, lossf)
     _, fused_steps_s, _, _ = _run_imperative(
         build(), imp_steps, batch, X, y, lossf)
 
@@ -259,10 +275,16 @@ def measure_captured(on_result=None):
         "captured_vs_fused": round(cap_steps_s / fused_steps_s, 3),
         "captured_dispatches_per_step": int(disp),
         "fallback": fallback,
+        # ISSUE 11: first-compile cost + persistent-cache outcome ride
+        # the supervisor contract so the perf trajectory records compile
+        # cost alongside steps/s
+        "compile_seconds": round(compile_s, 3),
+        "compile_cache_hit": bool(cache_hit),
     }
     print(f"[bench_mlp] captured-only: {cap_steps_s:.2f} steps/s "
           f"({disp} dispatch/step, {res['captured_vs_fused']}x the fused "
-          "imperative loop)", file=sys.stderr)
+          f"imperative loop; compile {compile_s:.2f}s, cache "
+          f"{'hit' if cache_hit else 'miss'})", file=sys.stderr)
     if on_result is not None:
         on_result(res)
     return res

@@ -61,6 +61,7 @@ from . import callback
 from . import rtc
 from . import monitor
 from . import observability
+from .observability import set_compilation_cache
 from . import fault
 from . import profiler
 from . import amp

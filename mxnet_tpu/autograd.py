@@ -24,6 +24,7 @@ import numpy as np
 
 from .base import MXNetError
 from .observability import registry as _obs_registry
+from .observability import compilex as _compilex
 
 __all__ = ["record", "pause", "train_mode", "predict_mode", "is_recording",
            "is_training", "mark_variables", "backward", "grad", "get_symbol",
@@ -301,7 +302,7 @@ def _make_backward_fn(spec):
         _, vjp_fn = jax.vjp(pure, list(leaf_vals))
         return vjp_fn(tuple(cots))[0]
 
-    return jax.jit(bwd)
+    return _compilex.instrument(jax.jit(bwd), "autograd_backward")
 
 
 def _cached_backward(spec, extras, leaf_values, cots):

@@ -71,6 +71,7 @@ from .gluon.block import _TraceContext
 from .ndarray.ndarray import NDArray
 from .observability import tracer as _tracer
 from .observability import registry as _obs_registry
+from .observability import compilex as _compilex
 from .fault import injection as _finj
 
 __all__ = ["CachedStep", "jit_step"]
@@ -215,6 +216,28 @@ class CachedStep:
     @property
     def cache_size(self):
         return len(self._cache)
+
+    def hlo_info(self):
+        """Optimized-HLO counts of the most recently dispatched
+        executable (compilex inspection: fusions, collectives, copies,
+        donation aliases, module bytes) — None before the first captured
+        call or when inspection was skipped by policy. What
+        tools/check_fusion.py budgets."""
+        entry = self._cache.get(self._last_key)
+        if entry is None or entry[0] == "unsupported":
+            return None
+        return getattr(entry[0], "last_hlo", None)
+
+    @property
+    def last_compile_seconds(self):
+        """Wall clock of the most recent executable's compiling dispatch
+        (measured by compilex BEFORE any HLO-inspection recompile, so it
+        is the cost a training loop actually paid) — None if the current
+        entry never compiled in this process."""
+        entry = self._cache.get(self._last_key)
+        if entry is None or entry[0] == "unsupported":
+            return None
+        return getattr(entry[0], "last_compile_seconds", None)
 
     def __call__(self, *batch, batch_size=None):
         try:
@@ -758,7 +781,13 @@ class CachedStep:
                 repl,
             )
 
-        jfn = jax.jit(fn, donate_argnums=(1, 3), **jit_kwargs)
+        # compile observatory (observability/compilex.py): the captured
+        # step's compiles/HLO structure publish under the executable name
+        # check_fusion budgets — "sharded_step" when a rule plan owns the
+        # layout, "captured_step" otherwise (single-device or 1-D mesh)
+        jfn = _compilex.instrument(
+            jax.jit(fn, donate_argnums=(1, 3), **jit_kwargs),
+            "sharded_step" if plan is not None else "captured_step")
         meta.update({
             "fresh": True,     # first dispatch compiles: scope the CPU
                                # donation-noop warning to that call only

@@ -11,19 +11,32 @@ as two orthogonal pieces).
     bucket telemetry records here; engine, KVStore and Trainer
     instrumentation add queue-depth, collective-bytes, var-wait and
     step-rate series.
+  * `compilex` — the compile observatory: every framework-owned jitted
+    executable (captured/sharded step, serve prefill/decode, fused
+    update kernels, cached backward) reports compile counts/seconds,
+    optimized-HLO structure (fusions, collectives, copies, donation
+    aliases) and persistent-compilation-cache hits/misses
+    (`mx.set_compilation_cache`; gated in tier-1 by
+    tools/check_fusion.py).
 
-`summary()` renders a human-readable step breakdown from both.
+`summary()` renders a human-readable step breakdown from all three.
 
 Env knobs: MXTPU_TRACE_BUFFER (ring capacity, events, default 65536),
-MXTPU_TRACE_OP_SAMPLE (imperative-op sampling rate, default 16).
+MXTPU_TRACE_OP_SAMPLE (imperative-op sampling rate, default 16),
+MXTPU_COMPILE_CACHE (persistent compile-cache dir),
+MXTPU_HLO_TELEMETRY (auto|always|0) and MXTPU_HLO_MAX_S (inspection
+cost ceiling, default 20s).
 """
 from __future__ import annotations
 
 from . import tracer
 from . import metrics_registry
 from .metrics_registry import MetricsRegistry, registry
+from . import compilex
+from .compilex import set_compilation_cache, compile_cache_stats
 
 __all__ = ["tracer", "metrics_registry", "MetricsRegistry", "registry",
+           "compilex", "set_compilation_cache", "compile_cache_stats",
            "summary"]
 
 
@@ -78,6 +91,6 @@ def summary(max_rows=25):
                 val = series["value"]
                 if series["kind"] == "histogram":
                     val = (f"n={val['count']} mean={val['mean']:.3g} "
-                           f"p99={val['p99']:.3g}")
+                           f"p95={val['p95']:.3g} p99={val['p99']:.3g}")
                 lines.append(f"{label[:43]:<44}{str(val)[:26]:>26}")
     return "\n".join(lines)

@@ -36,6 +36,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import profiler
+from ..observability import compilex as _compilex
 from .updater import Updater
 from .optimizer import Optimizer, DCASGD
 
@@ -199,7 +200,8 @@ def _make_kernel(optimizer, mp_flags, clip, unscale, n):
                 out_gs.append(out_g)
         return new_ws, new_ss, out_gs
 
-    return jax.jit(kernel, donate_argnums=(2,))
+    return _compilex.instrument(jax.jit(kernel, donate_argnums=(2,)),
+                                "fused_update")
 
 
 class FusedUpdater(Updater):

@@ -214,6 +214,26 @@ def dumps(reset=False):
         lines.append(f"[jit-cache] hits={hits} misses={misses}")
     if bucket_sizes():
         lines.append(f"[buckets] sizes_bytes={bucket_sizes()}")
+    # compile observatory (observability/compilex.py): per-executable
+    # compile counts/seconds (p95 from the histogram) + last-inspected
+    # HLO fusion count, and the persistent-cache outcome totals
+    fus_by_ex = {dict(g.labels).get("executable"): g.snapshot()
+                 for g in _reg.series("hlo_fusions")}
+    for h in _reg.series("compile_seconds"):
+        snap = h.snapshot()
+        if not snap["count"]:
+            continue
+        ex = dict(h.labels).get("executable", "?")
+        line = (f"[compile] {ex}: n={snap['count']} "
+                f"total={snap['sum']:.3f}s p95={snap['p95']:.3f}s")
+        if fus_by_ex.get(ex) is not None:
+            line += f" hlo_fusions={fus_by_ex[ex]}"
+        lines.append(line)
+    from .observability import compilex as _compilex
+    c_hits, c_misses = _compilex.compile_cache_stats()
+    if c_hits or c_misses:
+        lines.append(f"[compile-cache] hits={c_hits} misses={c_misses} "
+                     f"dir={_compilex.compilation_cache_dir()}")
     if reset:
         _state["ops"].clear()
         reset_dispatches()

@@ -43,6 +43,7 @@ from ..models.transformer import (decode_embed, decode_project,
                                   decoder_layer_cross, decoder_layer_ffn,
                                   encode_memory, precompute_memory_kv)
 from ..observability import tracer as _tracer
+from ..observability import compilex as _compilex
 from ..ops.pallas_kernels import ragged_paged_attention
 from .kv_pages import NULL_PAGE
 
@@ -102,13 +103,21 @@ class DecodeRuntime:
         # slot-occupancy / page-table variation
         self.decode_traces = 0
         self.prefill_traces = 0
-        self._decode_fn = jax.jit(self._decode_program,
-                                  donate_argnums=(0, 1))
-        self._prefill_fn = jax.jit(self._prefill_program,
-                                   donate_argnums=(0, 1, 2))
-        self._remap_fn = jax.jit(
-            lambda kp, vp, perm: (kp[:, perm], vp[:, perm]),
-            donate_argnums=(0, 1))
+        # compile observatory: prefill vs decode publish as separate
+        # executables (`compiles{executable=serve_decode}` == number of
+        # decode compilations, the same invariant decode_traces counts —
+        # check_fusion budgets the decode HLO, test_serve pins zero warm
+        # recompiles against these counters)
+        self._decode_fn = _compilex.instrument(
+            jax.jit(self._decode_program, donate_argnums=(0, 1)),
+            "serve_decode")
+        self._prefill_fn = _compilex.instrument(
+            jax.jit(self._prefill_program, donate_argnums=(0, 1, 2)),
+            "serve_prefill")
+        self._remap_fn = _compilex.instrument(
+            jax.jit(lambda kp, vp, perm: (kp[:, perm], vp[:, perm]),
+                    donate_argnums=(0, 1)),
+            "serve_page_remap")
 
     # ------------------------------------------------------- programs
     def _decode_program(self, k_pages, v_pages, page_tables, lens, tok,

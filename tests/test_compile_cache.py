@@ -1,0 +1,119 @@
+"""Persistent compilation cache round-trip (ISSUE 11 acceptance): with
+`MXTPU_COMPILE_CACHE` set, a second COLD process compiling the same
+captured step hits the disk cache (`compile_cache_hits` >= 1) and sees
+measurably lower first-step latency; with the cache disabled behaviour
+is bitwise-identical (same losses, zero cache lookups)."""
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+# the worker compiles a captured MLP step big enough that a cold XLA
+# compile clearly dominates a warm disk-cache deserialisation
+_WORKER = textwrap.dedent("""
+    import json, os, time
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, nd
+    from mxnet_tpu.observability import compilex
+
+    rng = np.random.RandomState(0)
+    X = nd.array(rng.randn(32, 64).astype(np.float32))
+    y = nd.array(rng.randint(0, 16, 32).astype(np.float32))
+    lossf = gluon.loss.SoftmaxCrossEntropyLoss()
+    mx.random.seed(0)
+    net = gluon.nn.Sequential()
+    net.add(gluon.nn.Dense(128, activation="relu"),
+            gluon.nn.Dense(128, activation="relu"),
+            gluon.nn.Dense(16))
+    net.initialize(mx.init.Xavier())
+    net(X)
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.05, "momentum": 0.9})
+    step = tr.capture(lambda a, b: lossf(net(a), b).mean())
+    t0 = time.monotonic()
+    L1 = step(X, y)
+    first_s = time.monotonic() - t0
+    L2 = step(X, y)
+    hits, misses = compilex.compile_cache_stats()
+    print(json.dumps({
+        "first_step_s": first_s,
+        "hits": hits, "misses": misses,
+        "cache_dir": compilex.compilation_cache_dir(),
+        "loss1": float(L1.asnumpy()), "loss2": float(L2.asnumpy()),
+        "fallback": step.last_fallback_reason,
+    }))
+""")
+
+
+def _run_worker(tmp_path, cache_dir):
+    script = tmp_path / "worker.py"
+    script.write_text(_WORKER)
+    env = dict(os.environ)
+    repo = os.path.join(os.path.dirname(__file__), "..")
+    env["PYTHONPATH"] = os.path.abspath(repo) + os.pathsep + \
+        env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    # isolate the measurement: no HLO-inspection recompiles, and no
+    # stray cache dir inherited from the invoking environment
+    env["MXTPU_HLO_TELEMETRY"] = "0"
+    env.pop("JAX_COMPILATION_CACHE_DIR", None)
+    if cache_dir is None:
+        env.pop("MXTPU_COMPILE_CACHE", None)
+    else:
+        env["MXTPU_COMPILE_CACHE"] = str(cache_dir)
+    proc = subprocess.run([sys.executable, str(script)],
+                          stdout=subprocess.PIPE,
+                          stderr=subprocess.DEVNULL,
+                          env=env, timeout=300)
+    assert proc.returncode == 0, proc.stdout.decode(errors="replace")
+    line = [l for l in proc.stdout.decode().splitlines()
+            if l.strip().startswith("{")][-1]
+    return json.loads(line)
+
+
+def test_compile_cache_cold_warm_round_trip(tmp_path):
+    cache = tmp_path / "cc"
+    cold = _run_worker(tmp_path, cache)
+    assert cold["fallback"] is None
+    assert str(cold["cache_dir"]) == str(cache)
+    assert cold["hits"] == 0            # nothing on disk yet
+    assert cold["misses"] >= 1          # ...but the cache was consulted
+    assert len(os.listdir(cache)) > 0   # entries persisted
+
+    warm = _run_worker(tmp_path, cache)
+    # the second cold PROCESS deserialises from disk instead of
+    # re-running XLA...
+    assert warm["hits"] >= 1
+    # ...and its first captured step is measurably faster
+    assert warm["first_step_s"] < cold["first_step_s"]
+
+    # cache disabled: no lookups, and training is bitwise-identical
+    off = _run_worker(tmp_path, None)
+    assert off["cache_dir"] in (None, "None")
+    assert off["hits"] == 0 and off["misses"] == 0
+    for k in ("loss1", "loss2"):
+        assert off[k] == cold[k] == warm[k]
+
+
+def test_set_compilation_cache_api_round_trip(tmp_path):
+    """mx.set_compilation_cache in-process: enable -> dir created and
+    readable back; None -> disabled. (Restores the prior setting.)"""
+    import mxnet_tpu as mx
+    from mxnet_tpu.observability import compilex
+
+    prev = compilex.compilation_cache_dir()
+    try:
+        d = mx.set_compilation_cache(tmp_path / "cc_api")
+        assert os.path.isdir(d)
+        assert str(compilex.compilation_cache_dir()) == str(d)
+        assert mx.set_compilation_cache(None) is None
+        assert compilex.compilation_cache_dir() in (None, "")
+    finally:
+        if prev:
+            mx.set_compilation_cache(prev)
+        else:
+            mx.set_compilation_cache(None)

@@ -318,6 +318,50 @@ def test_train_steps_produce_valid_trace_with_all_span_kinds(tmp_path):
     assert "Trainer.step" in rep and "trainer_steps_per_s" in rep
 
 
+def test_compile_spans_in_trace_and_summary(tmp_path):
+    """ISSUE 11 satellite: a compile that happens while tracing lands a
+    `compile.<executable>` span the Chrome-trace validator accepts
+    (balanced like every other track — 'X' events carry their own dur),
+    the compile/HLO series ride the registry with p95s in snapshot and
+    summary(), and profiler.dumps() prints the [compile] breakdown."""
+    path = str(tmp_path / "compile_trace.json")
+    rng = np.random.RandomState(3)
+    X = nd.array(rng.randn(8, 16).astype(np.float32))
+    y = nd.array(rng.randint(0, 4, 8).astype(np.float32))
+    lossf = gluon.loss.SoftmaxCrossEntropyLoss()
+    mx.random.seed(0)
+    net = gluon.nn.Sequential()
+    net.add(gluon.nn.Dense(16, activation="relu"), gluon.nn.Dense(4))
+    net.initialize(mx.init.Xavier())
+    net(X)
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.05, "momentum": 0.9})
+    step = tr.capture(lambda a, b: lossf(net(a), b).mean())
+    tracer.start()
+    step(X, y)                           # compiles INSIDE the trace
+    step(X, y)
+    tracer.stop()
+    assert tracer.dump(path) == path
+    assert check_trace.validate_file(path) == []
+    events = json.load(open(path))["traceEvents"]
+    comp = [e for e in events if str(e.get("name", ""))
+            .startswith("compile.")]
+    assert comp, "no compile span recorded"
+    assert comp[0]["ph"] == "X" and comp[0]["dur"] > 0
+    assert comp[0]["args"]["executable"] == "captured_step"
+    # registry: compile_seconds histogram with a p95 in its snapshot
+    snap = registry().snapshot()
+    series = [s for s in snap["compile_seconds"]
+              if dict(s["labels"]).get("executable") == "captured_step"]
+    assert series and series[0]["value"]["count"] >= 1
+    assert "p95" in series[0]["value"]
+    # summary() and profiler.dumps() render the new families
+    rep = mx.observability.summary()
+    assert "compile_seconds" in rep
+    dump = profiler.dumps()
+    assert "[compile] captured_step:" in dump and "p95=" in dump
+
+
 def test_sampled_op_spans_feed_host_tally(tmp_path):
     tracer.set_op_sample_rate(1)             # deterministic: every op
     try:

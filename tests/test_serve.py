@@ -606,6 +606,38 @@ def test_serve_metrics_and_percentiles():
     assert reg.gauge("serve_tokens_per_s").snapshot() == tps
 
 
+def test_warm_server_zero_recompiles_against_compile_counters():
+    """ISSUE 11 satellite: the existing retrace pin (decode compiles
+    once, ever) restated against the compile observatory — a WARM server
+    performs ZERO recompiles of either executable across varying slot
+    occupancy, measured on `compiles{executable=serve_decode|serve_prefill}`,
+    and both executables land compile telemetry in the metrics snapshot."""
+    reg = registry()
+    dec_c = reg.counter("compiles", executable="serve_decode")
+    pre_c = reg.counter("compiles", executable="serve_prefill")
+    srv = _server(slots=3, max_new_tokens=8)
+    rng = np.random.RandomState(21)
+    # warm: the first request compiles prefill + decode exactly once
+    srv.submit(rng.randint(4, 50, (5,)), max_new_tokens=3).result()
+    base_d, base_p = dec_c.value, pre_c.value
+    assert srv.runtime.decode_traces == 1
+    # mixed-length traffic so occupancy and page tables vary mid-flight
+    hs = [srv.submit(rng.randint(4, 50, (n,)), max_new_tokens=t)
+          for n, t in ((3, 8), (7, 2), (6, 5), (8, 4), (4, 7))]
+    for h in hs:
+        h.result()
+    assert dec_c.value == base_d, "warm decode recompiled"
+    assert pre_c.value == base_p, "warm prefill recompiled"
+    assert srv.runtime.decode_traces == 1
+    srv.close()
+    # per-executable compile telemetry (prefill vs decode) is in the
+    # snapshot next to the serve_* series
+    snap = reg.snapshot()
+    execs = {dict(s["labels"]).get("executable")
+             for s in snap.get("compile_seconds", [])}
+    assert {"serve_decode", "serve_prefill"} <= execs
+
+
 def test_encode_memory_matches_eager_encoder_bitwise():
     """The prefill executable's pure encoder is bitwise-equal to the
     eager `model.encode` path (they share flash_attention and the

@@ -1,0 +1,342 @@
+#!/usr/bin/env python
+"""HLO fusion/collective budget gate (ISSUE 11; same tier-1 wiring
+pattern as check_dispatch).
+
+Raw TPU speed is decided by what XLA fuses and how many collectives /
+copies survive lowering (arXiv:2301.13062) — and with the real-TPU bench
+tunnel dead, hardware-independent HLO structure is the trustworthy perf
+currency. This gate compiles the framework's own executables through the
+compile observatory (observability/compilex.py) and budgets their
+optimized-HLO counts:
+
+  * captured step (replicated, single executable): fusion count inside a
+    pinned band, ZERO collectives, and every donated parameter/optimizer
+    buffer aliased input->output (donation held — no cross-program copy
+    of the update path; 4 params + 4 momentum buffers = 8 aliases for
+    the reference MLP);
+  * captured step under the (2,2) ('dp','tp') DEFAULT_RULES shard plan:
+    the collective mix must EXACTLY match the budget derived from the
+    rules (gradient reduction over dp -> all-reduce; rule-sharded
+    weights gathered before use -> all-gather; batch/layout resharding
+    -> all-to-all / collective-permute), fusion band holds, donation
+    aliases hold. Needs >= 4 devices (tier-1 conftest forks 8); skipped
+    cleanly below that;
+  * serve decode + prefill executables: fusion bands, zero collectives,
+    and the donated KV-page pools / encoder-memory buffers aliased;
+  * a deliberately DE-FUSED control: a subprocess compiles the same
+    captured step with XLA's fusion pass disabled
+    (--xla_disable_hlo_passes=fusion) and the same budget must TRIP on
+    it — proving the gate bites, not just that the numbers were copied
+    from a passing run.
+
+ALL budgets live in BUDGETS below — a legitimate fusion-count shift is a
+one-line reviewed edit here, not a scattered test hunt
+(tests/test_check_fusion.py asserts against this same table).
+
+Standalone:
+
+    JAX_PLATFORMS=cpu python tools/check_fusion.py
+
+exit 0 = within budget, 1 = violation (details on stderr). Prints one
+JSON line with the measured counts on stdout.
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+# ---------------------------------------------------------------------
+# THE budget table (the one place; see module doc). Bands are (lo, hi)
+# inclusive; scalar entries are exact. Measured 2026-08 on the pinned
+# toolchain (jax 0.4.37 CPU): captured 23 fusions, sharded 39, decode
+# 32, prefill 18 — bands leave ~±60% headroom for benign drift while
+# still rejecting a de-fused build (0 fusions) outright.
+BUDGETS = {
+    "captured_step": {
+        "fusions": (10, 40),
+        "collective_total": 0,       # no mesh -> no collectives, exactly
+        "aliased_inputs": 8,         # 4 params + 4 momenta, all donated
+    },
+    "sharded_step": {
+        "fusions": (18, 70),
+        # rule-derived mix for the reference MLP under the (2,2) plan:
+        #   all-reduce        — dp gradient/loss reduction
+        #   all-gather        — rule-sharded weights gathered before use
+        #   all-to-all /      — batch + layout resharding between the
+        #   collective-permute  dp-split batch and tp-sharded matmuls
+        "collectives": {"all-reduce": 6, "all-gather": 10,
+                        "all-to-all": 3, "collective-permute": 4},
+        "aliased_inputs": 8,
+    },
+    "serve_decode": {
+        "fusions": (14, 56),
+        "collective_total": 0,
+        "aliased_inputs": 2,         # donated K/V page pools
+    },
+    "serve_prefill": {
+        "fusions": (8, 36),
+        "collective_total": 0,
+        "aliased_inputs": 3,         # donated mem_k / mem_v / mem_vl
+    },
+}
+
+CONTROL_TIMEOUT_S = 240
+
+
+def check_budget(name, info, budget=None):
+    """Evaluate one executable's HLO counts against its BUDGETS entry;
+    returns a list of violation strings (empty = within budget)."""
+    budget = budget if budget is not None else BUDGETS[name]
+    errors = []
+    if info is None:
+        return [f"{name}: no HLO inspection available (compile observatory "
+                f"disabled or inspection failed)"]
+    lo, hi = budget["fusions"]
+    if not lo <= info["fusions"] <= hi:
+        errors.append(f"{name}: fusion count {info['fusions']} outside "
+                      f"the pinned band [{lo}, {hi}]")
+    if "collective_total" in budget \
+            and info["collective_total"] != budget["collective_total"]:
+        errors.append(f"{name}: {info['collective_total']} collective(s) "
+                      f"(expected exactly {budget['collective_total']}: "
+                      f"{info['collectives']})")
+    if "collectives" in budget and info["collectives"] \
+            != budget["collectives"]:
+        errors.append(f"{name}: collective mix {info['collectives']} != "
+                      f"rule-derived budget {budget['collectives']}")
+    if "aliased_inputs" in budget \
+            and info["aliased_inputs"] != budget["aliased_inputs"]:
+        errors.append(f"{name}: {info['aliased_inputs']} donated input(s) "
+                      f"aliased (expected {budget['aliased_inputs']} — a "
+                      f"shortfall means XLA copies the donated update "
+                      f"path instead of updating in place)")
+    return errors
+
+
+def expected_collective_kinds(plan, params):
+    """The collective-op KINDS the shard plan's rules imply must appear
+    in the lowered program: dp-reduction of gradients/loss is always an
+    all-reduce; any rule that shards a weight dim forces a gather before
+    use. The exact counts are pinned in BUDGETS; this derivation guards
+    that the pinned mix stays CONSISTENT with the rules."""
+    kinds = {"all-reduce"}
+    for name, arr in params.items():
+        spec = plan.spec_for(name, arr.shape)
+        if any(e is not None for e in tuple(spec)):
+            kinds.add("all-gather")
+            break
+    return kinds
+
+
+# ------------------------------------------------------------- fixtures
+def _strip(info):
+    """Drop the verbose per-opcode histogram for JSON output."""
+    if info is None:
+        return None
+    return {k: v for k, v in info.items() if k != "ops"}
+
+
+def captured_step_info(sharded=False, steps=2):
+    """Build the reference MLP (the check_dispatch zoo model), capture
+    its training step (optionally under the (2,2) DEFAULT_RULES shard
+    plan), run `steps` steps and return (hlo_info, step, plan, params)."""
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, nd
+
+    rng = np.random.RandomState(0)
+    X = nd.array(rng.randn(16, 32).astype(np.float32))
+    y = nd.array(rng.randint(0, 8, 16).astype(np.float32))
+    lossf = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    mx.random.seed(0)
+    net = gluon.nn.Sequential()
+    net.add(gluon.nn.Dense(32, activation="relu"), gluon.nn.Dense(8))
+    net.initialize(mx.init.Xavier())
+    net(X)
+
+    plan = None
+    if sharded:
+        tr = gluon.Trainer(net.collect_params(), "sgd",
+                           {"learning_rate": 0.05, "momentum": 0.9},
+                           kvstore="ici")
+        plan = tr.shard(mesh={"dp": 2, "tp": 2})
+    else:
+        tr = gluon.Trainer(net.collect_params(), "sgd",
+                           {"learning_rate": 0.05, "momentum": 0.9})
+    step = tr.capture(lambda a, b: lossf(net(a), b).mean())
+    for _ in range(steps):
+        step(X, y)
+    params = {p.name: p.data()._data
+              for p in net.collect_params().values()}
+    return step.hlo_info(), step, plan, params
+
+
+def _serve_infos():
+    """Warm one tiny server (the check_dispatch serve model) and return
+    (decode_info, prefill_info, decode_traces)."""
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.models.transformer import TransformerNMT
+
+    mx.random.seed(0)
+    model = TransformerNMT(32, units=16, hidden=32, num_layers=1,
+                           num_heads=2, max_length=32, dropout=0.0)
+    model.initialize()
+    srv = mx.serve.Server(model, slots=3, page_size=4, max_src_len=8,
+                          max_new_tokens=12, engine_driven=False)
+    rng = np.random.RandomState(0)
+    srv.submit(rng.randint(4, 32, (5,)), max_new_tokens=4)
+    srv.scheduler.step()
+    srv.scheduler.step()
+    dec = srv.runtime._decode_fn.last_hlo
+    pre = srv.runtime._prefill_fn.last_hlo
+    traces = srv.runtime.decode_traces
+    srv.close()
+    return dec, pre, traces
+
+
+def _run_control():
+    """Compile the SAME captured step in a subprocess with XLA's fusion
+    pass disabled and return its HLO counts — the gate's liveness
+    control (budget must trip on it)."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                        + " --xla_disable_hlo_passes=fusion")
+    env["MXTPU_HLO_TELEMETRY"] = "always"
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--control"],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, env=env,
+        timeout=CONTROL_TIMEOUT_S)
+    line = None
+    for raw in proc.stdout.decode(errors="replace").splitlines():
+        raw = raw.strip()
+        if raw.startswith("{"):
+            line = raw
+    if proc.returncode != 0 or line is None:
+        raise RuntimeError(f"control subprocess failed "
+                           f"(rc={proc.returncode})")
+    return json.loads(line)
+
+
+# ------------------------------------------------------------------ run
+def run():
+    # the gate measures its OWN compiles: force inspection regardless of
+    # the process-wide sampling policy, restore on exit
+    prev_pol = os.environ.get("MXTPU_HLO_TELEMETRY")
+    os.environ["MXTPU_HLO_TELEMETRY"] = "always"
+    try:
+        return _run_impl()
+    finally:
+        if prev_pol is None:
+            os.environ.pop("MXTPU_HLO_TELEMETRY", None)
+        else:
+            os.environ["MXTPU_HLO_TELEMETRY"] = prev_pol
+
+
+def _run_impl():
+    import jax
+
+    errors = []
+
+    # -- captured (replicated, single executable) ----------------------
+    cap_info, _, _, _ = captured_step_info(sharded=False)
+    errors += check_budget("captured_step", cap_info)
+
+    # -- (2,2) rule-sharded (>= 4 devices; mirror check_dispatch's
+    # shard-phase skip) -----------------------------------------------
+    shard_mesh = len(jax.devices()) >= 4
+    sh_info = None
+    kinds_ok = None
+    if shard_mesh:
+        sh_info, _, plan, params = captured_step_info(sharded=True)
+        errors += check_budget("sharded_step", sh_info)
+        if sh_info is not None:
+            kinds = expected_collective_kinds(plan, params)
+            kinds_ok = kinds <= set(sh_info["collectives"])
+            if not kinds_ok:
+                errors.append(
+                    f"sharded_step: rule-derived collective kinds "
+                    f"{sorted(kinds)} missing from lowered program "
+                    f"{sorted(sh_info['collectives'])}")
+
+    # -- serve decode / prefill ----------------------------------------
+    dec_info, pre_info, dec_traces = _serve_infos()
+    errors += check_budget("serve_decode", dec_info)
+    errors += check_budget("serve_prefill", pre_info)
+    if dec_traces != 1:
+        errors.append(f"serve decode executable traced {dec_traces}x "
+                      f"during the warm-up (expected exactly 1 — HLO "
+                      f"inspection must not retrace)")
+
+    # -- de-fused control: the SAME budget must trip -------------------
+    control_fusions = None
+    control_tripped = None
+    try:
+        ctrl_info = _run_control()
+        control_fusions = ctrl_info.get("fusions")
+        control_tripped = bool(check_budget("captured_step", ctrl_info))
+        if not control_tripped:
+            errors.append(
+                f"de-fused control (fusion pass disabled, "
+                f"{control_fusions} fusions) did NOT trip the captured "
+                f"budget — the gate is not measuring anything")
+    except Exception as e:
+        errors.append(f"de-fused control failed to run: {e!r}")
+
+    res = {
+        "captured": _strip(cap_info),
+        "shard_mesh": shard_mesh,
+        "sharded": _strip(sh_info),
+        "sharded_kinds_consistent": kinds_ok,
+        "serve_decode": _strip(dec_info),
+        "serve_prefill": _strip(pre_info),
+        "serve_decode_traces": dec_traces,
+        "control_fusions": control_fusions,
+        "control_tripped": control_tripped,
+        "budgets": BUDGETS,
+        "errors": errors,
+        "ok": not errors,
+    }
+    return res
+
+
+def main(argv=None):
+    argv = list(sys.argv[1:] if argv is None else argv)
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    if "--control" in argv:
+        # de-fused control mode: compile the captured step under the
+        # inherited --xla_disable_hlo_passes=fusion and report counts
+        os.environ["MXTPU_HLO_TELEMETRY"] = "always"
+        info, _, _, _ = captured_step_info(sharded=False)
+        print(json.dumps(_strip(info) or {}))
+        return 0 if info is not None else 1
+    res = run()
+    print(json.dumps(res))
+    for err in res["errors"]:
+        print(f"check_fusion: {err}", file=sys.stderr)
+    if res["errors"]:
+        print("check_fusion: FAIL", file=sys.stderr)
+        return 1
+    shard_txt = ("shard phase skipped (<4 devices)" if not res["shard_mesh"]
+                 else f"sharded {res['sharded']['fusions']} fusions / "
+                      f"{res['sharded']['collectives']}")
+    print(f"check_fusion: OK (captured {res['captured']['fusions']} "
+          f"fusions / {res['captured']['collective_total']} collectives "
+          f"/ {res['captured']['aliased_inputs']} aliased; {shard_txt}; "
+          f"decode {res['serve_decode']['fusions']} fusions; de-fused "
+          f"control tripped at {res['control_fusions']} fusions)",
+          file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
