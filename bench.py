@@ -400,6 +400,21 @@ def main():
                     sres["p99_contended_fifo_ms"]
         except Exception as e:  # pragma: no cover
             print(f"[bench] serve bench failed: {e!r}", file=sys.stderr)
+        # ISSUE 12: the serving fast path — prefix-cache speedup on the
+        # shared-system-prompt mix + speculative acceptance/turns. Own
+        # guard: a fast-path failure must not take down the headline
+        # serve fields already recorded above.
+        try:
+            import bench_serve
+            fres = bench_serve.measure_fastpath()
+            result["serve_prefix_hit_rate"] = fres["prefix_hit_rate"]
+            result["serve_prefix_speedup"] = fres["prefix_speedup"]
+            result["serve_spec_accept_rate"] = fres["spec_accept_rate"]
+            result["serve_decode_turns_per_token"] = \
+                fres["spec_turns_per_token"]
+        except Exception as e:  # pragma: no cover
+            print(f"[bench] serve fast-path bench failed: {e!r}",
+                  file=sys.stderr)
 
     # Second headline metric (BASELINE.json): BERT-base MLM tokens/sec/chip.
     # Merged into the same single JSON line so the driver's one-line parse

@@ -1,6 +1,16 @@
 """Serving benchmark (ISSUE 6): request latency percentiles + aggregate
 tokens/s under Poisson arrivals, continuous vs static batching.
 
+ISSUE 12 extension — the `--fastpath` arm (also folded into bench.py's
+supervisor fields) measures the serving fast path on a shared-system-
+prompt Poisson mix: the SAME prompted trace runs warm (content-hashed
+radix prefix cache on — later requests adopt cached prompt pages and
+skip that prefill) vs cold (cache disabled), and once more with
+speculative_k=3 (n-gram drafts verified by one widened dispatch per
+turn). Headlines: `prefix_speedup` (wall tokens/s, warm over cold) with
+`{warm,cold}_decode_turns` as the deterministic witness, and
+`spec_turns_per_token` vs `control_turns_per_token` for speculation.
+
 ISSUE 7 extension — the `--background-train` arm replays the same trace
 while a sustained background engine flood (prefetch/checkpoint stand-in
 tasks) contends for the engine workers, once with QoS priorities on and
@@ -147,6 +157,126 @@ def _contended_fields(reqs):
     }
 
 
+def _build_fast_server(speculative_k=0, prefix_cache=True):
+    """The fast-path server (ISSUE 12): prompt budget for the shared
+    system prompts, optional speculative width. Same model/seed as the
+    headline arms so the executables compare like for like."""
+    import mxnet_tpu as mx
+    from mxnet_tpu.models.transformer import TransformerNMT
+
+    mx.random.seed(7)
+    model = TransformerNMT(64, units=32, hidden=64, num_layers=2,
+                           num_heads=4, max_length=64, dropout=0.0)
+    model.initialize()
+    return mx.serve.Server(model, slots=SLOTS, page_size=8,
+                           max_src_len=16, max_new_tokens=24,
+                           max_prompt_len=32,
+                           speculative_k=speculative_k,
+                           prefix_cache=prefix_cache,
+                           max_queue=N_REQUESTS, engine_driven=True)
+
+
+def _prefix_workload(seed=1, n=N_REQUESTS, templates=3):
+    """Shared-system-prompt Poisson mix: every request draws one of
+    `templates` (source, 24-token system prompt) pairs — the radix-
+    shareable material — plus a short unique prompt suffix on some
+    requests (partial-prefix hits) and a mixed generation budget."""
+    import numpy as np
+    rng = np.random.RandomState(seed)
+    temps = [(rng.randint(4, 64, (int(rng.randint(6, 16)),)
+                          ).astype(np.int32),
+              rng.randint(4, 64, (24,)).astype(np.int32))
+             for _ in range(templates)]
+    reqs = []
+    for _ in range(n):
+        src, sys_prompt = temps[int(rng.randint(templates))]
+        prompt = sys_prompt
+        if rng.rand() < 0.4:
+            prompt = np.concatenate(
+                [sys_prompt,
+                 rng.randint(4, 64, (int(rng.randint(1, 5)),))]
+            ).astype(np.int32)
+        max_new = int(rng.choice([4, 8, 16, 24]))
+        gap = float(rng.exponential(1.0 / RATE_HZ))
+        reqs.append((src, prompt, max_new, gap))
+    return reqs
+
+
+def _run_fast(reqs, speculative_k=0, prefix_cache=True):
+    """One pass of the prompted trace; returns wall tokens/s plus the
+    deterministic witnesses: decode turns, committed tokens, prefix hit
+    rate and draft acceptance."""
+    srv = _build_fast_server(speculative_k=speculative_k,
+                             prefix_cache=prefix_cache)
+    handles = []
+    try:
+        # warm-up compiles prefill + (widened) decode outside the clock
+        srv.submit(list(range(4, 12)), max_new_tokens=4,
+                   prompt_tokens=list(range(4, 10))).result(timeout=300)
+        sched = srv.scheduler
+        turns0, toks0 = sched.decode_turns, sched.tokens_generated
+        t0 = time.perf_counter()
+        for src, prompt, max_new, gap in reqs:
+            time.sleep(gap)
+            handles.append(srv.submit(src, max_new_tokens=max_new,
+                                      prompt_tokens=prompt))
+        for h in handles:
+            h.result(timeout=300)
+        wall = time.perf_counter() - t0
+        turns = sched.decode_turns - turns0
+        toks = sched.tokens_generated - toks0
+        cache = srv.prefix_cache
+        hit_rate = (cache.hits / max(cache.hits + cache.misses, 1)
+                    if cache is not None else 0.0)
+        saved = cache.tokens_saved if cache is not None else 0
+        accept = (sched.spec_accepted / max(sched.spec_drafted, 1)
+                  if speculative_k else 0.0)
+    finally:
+        srv.close()
+    return {
+        "tokens": toks,
+        "tokens_per_s": toks / wall,
+        "wall_s": wall,
+        "decode_turns": turns,
+        "turns_per_token": turns / max(toks, 1),
+        "prefix_hit_rate": hit_rate,
+        "prefix_tokens_saved": saved,
+        "spec_accept_rate": accept,
+    }
+
+
+def measure_fastpath(seed=1, repeats=2):
+    """The ISSUE 12 arms. Prefix-heavy: the same shared-system-prompt
+    trace warm (radix cache on) vs cold (cache disabled) — the headline
+    is wall tokens/s speedup, with prefill-turns-saved as the
+    deterministic witness. Speculative: the same trace with k=3 n-gram
+    drafts per turn vs the 1-wide control — the witness is decode turns
+    per committed token."""
+    reqs = _prefix_workload(seed)
+    warm = min((_run_fast(reqs, prefix_cache=True)
+                for _ in range(repeats)), key=lambda r: r["wall_s"])
+    cold = min((_run_fast(reqs, prefix_cache=False)
+                for _ in range(repeats)), key=lambda r: r["wall_s"])
+    spec = _run_fast(reqs, speculative_k=3, prefix_cache=True)
+    return {
+        "metric": "serve_fastpath",
+        "unit": "tokens/sec",
+        "value": round(warm["tokens_per_s"], 2),
+        "requests": len(reqs),
+        "prefix_hit_rate": round(warm["prefix_hit_rate"], 4),
+        "prefix_tokens_saved": warm["prefix_tokens_saved"],
+        "prefix_speedup": round(
+            warm["tokens_per_s"] / max(cold["tokens_per_s"], 1e-9), 3),
+        "cold_tokens_per_s": round(cold["tokens_per_s"], 2),
+        "warm_decode_turns": warm["decode_turns"],
+        "cold_decode_turns": cold["decode_turns"],
+        "spec_accept_rate": round(spec["spec_accept_rate"], 4),
+        "spec_turns_per_token": round(spec["turns_per_token"], 4),
+        "control_turns_per_token": round(cold["turns_per_token"], 4),
+        "spec_tokens_per_s": round(spec["tokens_per_s"], 2),
+    }
+
+
 def measure(seed=0, repeats=2, background_train=True):
     """Best-of-`repeats` per policy: shared-box wall clocks are noisy at
     this scale, so each arm keeps its best run — and the DETERMINISTIC
@@ -195,6 +325,10 @@ def main(argv=None):
     if os.environ.get("JAX_PLATFORMS") == "cpu":
         import jax
         jax.config.update("jax_platforms", "cpu")
+    if "--fastpath" in argv:
+        # ISSUE 12 arms only: prefix-heavy warm-vs-cold + speculative
+        print(json.dumps(measure_fastpath()), flush=True)
+        return 0
     if "--background-train" in argv:
         # contended arm only: decode p99 under background-train load,
         # QoS vs FIFO

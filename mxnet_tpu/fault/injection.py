@@ -47,7 +47,8 @@ __all__ = ["FaultInjected", "DeviceLost", "POINTS", "ENABLED", "inject",
 POINTS = ("io.read", "io.decode", "engine.task", "kv.collective",
           "kv.timeout", "kv.init", "grad.nan", "preempt.sigterm",
           "checkpoint.save", "checkpoint.load", "serve.admit",
-          "serve.decode", "device.lost")
+          "serve.decode", "serve.prefix", "serve.speculate",
+          "device.lost")
 
 ENABLED = False            # fast-path guard; True iff any spec registered
 

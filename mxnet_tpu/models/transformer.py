@@ -563,6 +563,21 @@ def decoder_layer_cross(L, h, x, mk, mv, mem_vl=None):
     return _ln_apply(x + _affine(attn, L["cproj"]), L["ln2"])
 
 
+def decoder_layer_cross_multi(L, h, x, mk, mv, mem_vl=None):
+    """Cross-attention over precomputed memory K/V for a WINDOW of
+    decode tokens (ISSUE 12's widened verify executable): x (B, W, U),
+    mk/mv (B, H, S, dh). Per-token independent — each window row runs
+    the same math `decoder_layer_cross` runs for its single token."""
+    qc = _split_heads(_affine(x, L["q"]), h)          # (B, H, W, dh)
+    keep = None
+    if mem_vl is not None:
+        keep = (jnp.arange(mk.shape[2])[None, :]
+                < mem_vl[:, None])[:, None, None, :]
+    attn = _merge_heads(
+        single_query_cached_attention(qc, mk, mv, keep))  # (B, W, U)
+    return _ln_apply(x + _affine(attn, L["cproj"]), L["ln2"])
+
+
 def decoder_layer_ffn(L, x):
     """Position-wise FFN + residual + LN."""
     f = jnp.maximum(_affine(x, L["ffn1"]), 0)

@@ -749,6 +749,30 @@ def _paged_attention_lax(q, k_pages, v_pages, page_tables, lengths):
                                          mask)[:, :, 0]
 
 
+def _paged_attention_lax_multi(q, k_pages, v_pages, page_tables, lengths):
+    """Pure-lax fallback for the WIDENED (speculative-verify) launch:
+    gather each slot's pages into a dense context, then the SAME shared
+    math as `_paged_attention_lax`, with one extra query axis.
+
+    q: (S, W, H, dh) — W query tokens per slot at consecutive positions;
+    lengths: (S,) int32 keys visible to query 0 (including its own
+    position); query i sees exactly `lengths + i` keys, which is the
+    ragged-per-slot-query-length shape speculative verification and
+    chunked prompt prefill need. Returns (S, W, H, dh)."""
+    S, W, H, dh = q.shape
+    psize = k_pages.shape[1]
+    npages = page_tables.shape[1]
+    L = npages * psize
+    kc = k_pages[page_tables].reshape(S, L, H, dh).transpose(0, 2, 1, 3)
+    vc = v_pages[page_tables].reshape(S, L, H, dh).transpose(0, 2, 1, 3)
+    vis = lengths[:, None] + jnp.arange(W, dtype=lengths.dtype)[None, :]
+    mask = (jnp.arange(L)[None, None, :]
+            < vis[:, :, None])[:, None, :, :]        # (S, 1, W, L)
+    qh = q.transpose(0, 2, 1, 3)                     # (S, H, W, dh)
+    out = single_query_cached_attention(qh, kc, vc, mask)
+    return out.transpose(0, 2, 1, 3)
+
+
 def _rpa_kernel(pt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
                 m_scr, l_scr, acc_scr, *, psize, num_heads, sm_scale):
     """Ragged paged attention, one (slot, head) per grid row, one KV page
@@ -848,6 +872,102 @@ def _rpa_pallas(q, k_pages, v_pages, page_tables, lengths, sm_scale):
     return out.reshape(S, H, dh)
 
 
+def _rpa_multi_kernel(pt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
+                      m_scr, l_scr, acc_scr, *, psize, num_heads, sm_scale):
+    """Widened ragged paged attention (ISSUE 12): W query rows per
+    (slot, head) grid row, one KV page per inner step. Query row i masks
+    keys at `len_ref[slot] + i` — consecutive positions, so a single
+    per-slot scalar carries the whole ragged query-length structure.
+    Rows beyond a slot's real window produce garbage nobody commits."""
+    g = pl.program_id(0)                    # slot * num_heads + head
+    j = pl.program_id(1)                    # page slot within the request
+    nj = pl.num_programs(1)
+    s_idx = g // num_heads
+    length = len_ref[s_idx]                 # keys visible to query row 0
+    k_start = j * psize
+    wp = q_ref.shape[1]                     # padded query rows (>= 8)
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, -1e30)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # a page is live when ANY query row can see it: row wp-1 sees
+    # length + wp - 1 keys
+    @pl.when(k_start < length + wp - 1)
+    def _compute():
+        q = q_ref[0]                        # (wp, dh)
+        k = k_ref[0, 0]                     # (psize, dh)
+        v = v_ref[0, 0]                     # (psize, dh)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * sm_scale
+        qi = lax.broadcasted_iota(jnp.int32, (wp, psize), 0)
+        kj = k_start + lax.broadcasted_iota(jnp.int32, (wp, psize), 1)
+        s = jnp.where(kj < length + qi, s, -1e30)
+        m_prev = m_scr[:, :1]               # (wp, 1)
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)              # (wp, psize) fp32
+        l_new = alpha * l_scr[:, :1] + jnp.sum(p, axis=-1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(j == nj - 1)
+    def _finalize():
+        o_ref[0] = (acc_scr[:] /
+                    jnp.maximum(l_scr[:, :1], 1e-30)).astype(o_ref.dtype)
+
+
+def _rpa_multi_pallas(q, k_pages, v_pages, page_tables, lengths, sm_scale):
+    S, W, H, dh = q.shape
+    psize = k_pages.shape[1]
+    npages = page_tables.shape[1]
+    # pad the query-row dim to the Mosaic 8-sublane tile; extra rows
+    # attend a few more (valid-page) keys and are sliced away below
+    wp = max(8, -(-W // 8) * 8)
+    qr = q.transpose(0, 2, 1, 3).reshape(S * H, W, dh)
+    if wp != W:
+        qr = jnp.pad(qr, ((0, 0), (0, wp - W), (0, 0)))
+    kr = k_pages.transpose(2, 0, 1, 3)      # (H, P, psize, dh)
+    vr = v_pages.transpose(2, 0, 1, 3)
+    grid = (S * H, npages)
+    kern = functools.partial(_rpa_multi_kernel, psize=psize, num_heads=H,
+                             sm_scale=sm_scale)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,              # page tables + lengths
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, wp, dh), lambda g, j, pt, ln: (g, 0, 0)),
+            pl.BlockSpec((1, 1, psize, dh),
+                         lambda g, j, pt, ln, _h=H: (g % _h, pt[g // _h, j],
+                                                     0, 0)),
+            pl.BlockSpec((1, 1, psize, dh),
+                         lambda g, j, pt, ln, _h=H: (g % _h, pt[g // _h, j],
+                                                     0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, wp, dh), lambda g, j, pt, ln: (g, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((wp, 128), jnp.float32),
+            pltpu.VMEM((wp, 128), jnp.float32),
+            pltpu.VMEM((wp, dh), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kern,
+        grid_spec=grid_spec,
+        out_shape=_sds((S * H, wp, dh), q.dtype, q, k_pages, v_pages),
+        compiler_params=_CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+        interpret=_interpret(),
+    )(page_tables.astype(jnp.int32), lengths.astype(jnp.int32), qr, kr, vr)
+    return out[:, :W].reshape(S, H, W, dh).transpose(0, 2, 1, 3)
+
+
 def _rpa_pallas_ok(psize):
     if os.environ.get("MXTPU_PALLAS_DISABLE") == "1":
         return False
@@ -859,11 +979,16 @@ def ragged_paged_attention(q, k_pages, v_pages, page_tables, lengths,
                            sm_scale=None):
     """One shared attention launch per decode step over a paged KV cache.
 
-    q: (S, H, dh) — ONE query token per decode slot; k_pages/v_pages:
-    (P, psize, H, dh) fixed-size page pools; page_tables: (S, npages)
-    int32 page ids per slot (unused entries must point at a valid page —
-    the pool's reserved null page 0); lengths: (S,) int32 valid cached
-    positions per slot INCLUDING the current token. Returns (S, H, dh).
+    q: (S, H, dh) — ONE query token per decode slot — or (S, W, H, dh)
+    (ISSUE 12): W query tokens per slot at CONSECUTIVE positions, the
+    ragged per-slot-query-length shape speculative verification and
+    chunked prompt prefill use (query i of a slot sees `lengths + i`
+    keys; rows past a slot's real window compute garbage nobody reads).
+    k_pages/v_pages: (P, psize, H, dh) fixed-size page pools;
+    page_tables: (S, npages) int32 page ids per slot (unused entries
+    must point at a valid page — the pool's reserved null page 0);
+    lengths: (S,) int32 valid cached positions per slot INCLUDING the
+    current (first) token. Returns (S, H, dh) or (S, W, H, dh).
 
     On TPU (or MXTPU_PALLAS_INTERPRET=1) runs the Pallas kernel: the page
     table rides in scalar-prefetch SMEM and the BlockSpec index maps read
@@ -873,6 +998,15 @@ def ragged_paged_attention(q, k_pages, v_pages, page_tables, lengths,
     `single_query_cached_attention` (inference-only; no custom vjp)."""
     if sm_scale is None:
         sm_scale = 1.0 / (q.shape[-1] ** 0.5)
+    if q.ndim == 4:
+        if _rpa_pallas_ok(k_pages.shape[1]):
+            try:
+                return _rpa_multi_pallas(q, k_pages, v_pages, page_tables,
+                                         lengths, sm_scale)
+            except Exception as e:
+                _warn_fallback("ragged_paged_multi", e)
+        return _paged_attention_lax_multi(q, k_pages, v_pages, page_tables,
+                                          lengths)
     if _rpa_pallas_ok(k_pages.shape[1]):
         try:
             return _rpa_pallas(q, k_pages, v_pages, page_tables, lengths,

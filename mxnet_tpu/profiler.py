@@ -234,6 +234,16 @@ def dumps(reset=False):
     if c_hits or c_misses:
         lines.append(f"[compile-cache] hits={c_hits} misses={c_misses} "
                      f"dir={_compilex.compilation_cache_dir()}")
+    # serving fast path (ISSUE 12): the speculative acceptance
+    # distribution — the regression signal for the draft proposer (a
+    # falling mean/p95 means more turns per committed token)
+    for h in _reg.series("serve_spec_accepted_tokens"):
+        snap = h.snapshot()
+        if snap["count"]:
+            lines.append(
+                f"[serve-spec] accepted/turn: n={snap['count']} "
+                f"mean={snap['mean']:.3f} p95={snap['p95']:.3g} "
+                f"max={snap['max']:.3g}")
     if reset:
         _state["ops"].clear()
         reset_dispatches()

@@ -40,7 +40,9 @@ from .. import profiler
 from ..base import MXNetError
 from ..models.transformer import (decode_embed, decode_project,
                                   decoder_layer_qkv, decoder_layer_self_post,
-                                  decoder_layer_cross, decoder_layer_ffn,
+                                  decoder_layer_cross,
+                                  decoder_layer_cross_multi,
+                                  decoder_layer_ffn,
                                   encode_memory, precompute_memory_kv)
 from ..observability import tracer as _tracer
 from ..observability import compilex as _compilex
@@ -67,7 +69,7 @@ class DecodeRuntime:
     host-side int arrays."""
 
     def __init__(self, weights, enc_weights, slots, num_pages, page_size,
-                 max_pages_per_slot, max_src_len):
+                 max_pages_per_slot, max_src_len, width=1):
         u = weights["embed"].shape[1]
         h = weights["num_heads"]
         if u % h:
@@ -97,12 +99,17 @@ class DecodeRuntime:
         self.k_pages = jnp.zeros(shape, dtype)
         self.v_pages = jnp.zeros(shape, dtype)
         self.reset_mem()
+        self.width = int(width)
+        if self.width < 1:
+            raise MXNetError("decode width must be >= 1")
         # retrace telemetry: the python bodies run ONLY while jax traces,
         # so these counters are exactly the number of compilations — the
         # check_dispatch serve gate asserts they stay at 1 across every
-        # slot-occupancy / page-table variation
+        # slot-occupancy / page-table variation (and, for the widened
+        # verify executable, across every draft-acceptance variation)
         self.decode_traces = 0
         self.prefill_traces = 0
+        self.verify_traces = 0
         # compile observatory: prefill vs decode publish as separate
         # executables (`compiles{executable=serve_decode}` == number of
         # decode compilations, the same invariant decode_traces counts —
@@ -118,6 +125,17 @@ class DecodeRuntime:
             jax.jit(lambda kp, vp, perm: (kp[:, perm], vp[:, perm]),
                     donate_argnums=(0, 1)),
             "serve_page_remap")
+        # the WIDENED verify executable (ISSUE 12): width > 1 servers run
+        # every decode turn through one (slots, width) program — drafted
+        # tokens verified by a single batched target pass, chunked prompt
+        # prefill teacher-forced width tokens at a time. Static shapes;
+        # per-slot ragged window lengths ride as arguments, so varying
+        # draft acceptance never retraces (verify_traces stays 1).
+        self._verify_fn = None
+        if self.width > 1:
+            self._verify_fn = _compilex.instrument(
+                jax.jit(self._verify_program, donate_argnums=(0, 1)),
+                "serve_verify")
 
     # ------------------------------------------------------- programs
     def _decode_program(self, k_pages, v_pages, page_tables, lens, tok,
@@ -143,6 +161,46 @@ class DecodeRuntime:
             x = decoder_layer_cross(L, h, x, mem_k[li], mem_v[li], mem_vl)
             x = decoder_layer_ffn(L, x)
         logits = decode_project(w, x)
+        next_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return k_pages, v_pages, next_tok, logits
+
+    def _verify_program(self, k_pages, v_pages, page_tables, lens, toks,
+                        qlens, active, mem_k, mem_v, mem_vl):
+        """The widened decode step: toks (S, W) window tokens per slot at
+        positions lens..lens+W-1, qlens (S,) valid window lengths (ragged
+        — rows past qlen scatter to the null page and their outputs are
+        garbage the scheduler never commits). Returns logits for EVERY
+        window position, so one dispatch verifies a whole drafted run."""
+        self.verify_traces += 1
+        w, h, psize = self._w, self._h, self.page_size
+        s_n, width = toks.shape
+        npages = page_tables.shape[1]
+        rows = jnp.arange(s_n)
+        pos = lens[:, None] + jnp.arange(width, dtype=lens.dtype)[None, :]
+        x = decode_embed(w, toks, pos)                   # (S, W, U)
+        slot_page = jnp.minimum(pos // psize, npages - 1)
+        page = page_tables[rows[:, None], slot_page]     # (S, W)
+        valid = (jnp.arange(width)[None, :] < qlens[:, None]) \
+            & (active[:, None] > 0)
+        page = jnp.where(valid, page, NULL_PAGE)
+        off = pos % psize
+        for li, L in enumerate(w["layers"]):
+            q, k, v = decoder_layer_qkv(L, x)
+            qh = q.reshape(s_n, width, h, self._dh)
+            kh = k.reshape(s_n, width, h, self._dh)
+            vh = v.reshape(s_n, width, h, self._dh)
+            k_pages = k_pages.at[li, page, off].set(kh)
+            v_pages = v_pages.at[li, page, off].set(vh)
+            # query i sees positions 0..lens+i (its own included): the
+            # ragged-query-length form of the shared paged attention
+            a = ragged_paged_attention(qh, k_pages[li], v_pages[li],
+                                       page_tables, lens + 1)
+            x = decoder_layer_self_post(
+                L, x, a.reshape(s_n, width, h * self._dh))
+            x = decoder_layer_cross_multi(L, h, x, mem_k[li], mem_v[li],
+                                          mem_vl)
+            x = decoder_layer_ffn(L, x)
+        logits = decode_project(w, x)                    # (S, W, V)
         next_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
         return k_pages, v_pages, next_tok, logits
 
@@ -208,6 +266,26 @@ class DecodeRuntime:
             jnp.asarray(page_tables, jnp.int32),
             jnp.asarray(lens, jnp.int32), jnp.asarray(tok, jnp.int32),
             jnp.asarray(active, jnp.int32),
+            self.mem_k, self.mem_v, self.mem_vl)
+        return np.asarray(next_tok), logits
+
+    def decode_multi(self, page_tables, lens, toks, qlens, active):
+        """One WIDENED decode turn for every slot (still ONE dispatch):
+        writes each active slot's window K/V into its pages in place,
+        runs the shared ragged-paged-attention launch with per-slot
+        ragged query lengths, returns (next_tok (S, W) host int32,
+        logits (S, W, V) device array). Greedy commits derived from
+        these outputs are identical to `decode` run token-by-token —
+        the bitwise-greedy contract tests/test_serve.py pins."""
+        if self._verify_fn is None:
+            raise MXNetError("decode_multi needs width > 1 (construct "
+                             "DecodeRuntime(width=k+1))")
+        profiler.record_dispatch("serve_decode")
+        self.k_pages, self.v_pages, next_tok, logits = self._verify_fn(
+            self.k_pages, self.v_pages,
+            jnp.asarray(page_tables, jnp.int32),
+            jnp.asarray(lens, jnp.int32), jnp.asarray(toks, jnp.int32),
+            jnp.asarray(qlens, jnp.int32), jnp.asarray(active, jnp.int32),
             self.mem_k, self.mem_v, self.mem_vl)
         return np.asarray(next_tok), logits
 

@@ -22,14 +22,36 @@ unboundedly. A request that cannot get its next page mid-decode is
 PREEMPTED — pages freed, requeued at the front — rather than deadlocking
 the pool (`serve_page_preemptions`).
 
+The serving fast path (ISSUE 12) stacks two optimisations on the same
+crank:
+
+  * PREFIX CACHE — requests may carry a decoder-side `prompt_tokens`
+    sequence (system prompt / few-shot template) that is teacher-forced
+    into the paged KV cache before generation. Full prompt pages are
+    indexed in a content-hashed radix tree (`prefix_cache.PrefixCache`);
+    a later request with the same source and a matching prompt prefix
+    ADOPTS those pages (refcounted sharing, never a copy) and skips that
+    part of prefill. Under page pressure admission evicts LRU cache-only
+    pages instead of failing (`serve_prefix_evictions`).
+  * SPECULATIVE DECODING — with `width > 1` (Server(speculative_k=k)),
+    each turn drafts up to k tokens by n-gram prompt lookup over the
+    request's own committed history and verifies the whole window with
+    ONE pass through the widened decode executable; the accepted run +
+    one corrective token commit together. Greedy output is IDENTICAL to
+    the 1-wide loop — drafts only change how many turns it takes.
+
 Fault discipline (fault/injection.py points `serve.admit` /
-`serve.decode`): an admit-time fault fails ONLY the request being
-admitted. A decode-time fault kills the whole in-flight batch — every
-active request frees its pages and is retried from scratch (bounded by
-`max_retries`) or failed cleanly; either way `kv_pages_in_use` returns to
-baseline (the chaos test asserts this). An error raised by the decode
-executable itself additionally resets the page pools (their contents are
-no longer trustworthy after a partial in-place step).
+`serve.decode` / `serve.prefix` / `serve.speculate`): an admit-time
+fault fails ONLY the request being admitted. A decode-time fault kills
+the whole in-flight batch — every active request frees its pages and is
+retried from scratch (bounded by `max_retries`) or failed cleanly;
+either way `kv_pages_in_use` returns to baseline (the chaos test
+asserts this). An error raised by the decode executable itself
+additionally resets the page pools AND clears the prefix cache (their
+contents are no longer trustworthy after a partial in-place step). A
+`serve.prefix` or `serve.speculate` fault merely DEGRADES — cache
+lookup/insert skipped, turn runs unspeculated — with bitwise-identical
+request output.
 """
 from __future__ import annotations
 
@@ -43,6 +65,8 @@ from ..observability import registry as _obs_registry
 from ..observability import tracer as _tracer
 from .decode import MemoryStateLost
 from .kv_pages import NULL_PAGE, PageAllocError
+from .prefix_cache import PrefixCache, content_key
+from .speculate import propose_ngram
 
 __all__ = ["Request", "Scheduler", "ServeError", "ServeOverloaded",
            "ServeDeadlineExceeded", "StepResult"]
@@ -68,9 +92,14 @@ class Request:
     """One inference request + its result/stream plumbing. Create via
     `Server.submit`; consume via `.result()` / `.stream()` / `.tokens`."""
 
-    def __init__(self, rid, src, max_new_tokens, deadline_ms=None):
+    def __init__(self, rid, src, max_new_tokens, prompt=None,
+                 deadline_ms=None):
         self.id = rid
         self.src = src
+        # decoder-side prompt (ISSUE 12): tokens teacher-forced into the
+        # paged KV cache before free-running generation — the shared-
+        # system-prompt material the radix prefix cache deduplicates
+        self.prompt = [] if prompt is None else [int(t) for t in prompt]
         self.max_new_tokens = int(max_new_tokens)
         # absolute monotonic deadline: survives retries/preemptions (the
         # budget is end-to-end, not per-attempt)
@@ -87,7 +116,12 @@ class Request:
         self.t_done = None
         self._slot = None
         self._pages = []
-        self._cur_tok = None
+        self.known = None           # [BOS] + prompt + committed tokens
+        self._n_table = 0           # valid page-table entries this attempt
+        self._cache_done = False    # prompt pages offered to the cache
+        self.prompt_cached_tokens = 0   # adopted prefix length (positions)
+        self._content_key = None    # memoized source hash (Scheduler)
+        self._admit_bypassed = 0    # warm-preference skips of THIS head
         self._done = threading.Event()
         self._chunks = collections.deque()  # streamed tokens + sentinel
         self._chunk_cv = threading.Condition()
@@ -214,11 +248,23 @@ class StepResult:
 
 class Scheduler:
     def __init__(self, runtime, pool, bos_id=2, eos_id=3, max_queue=64,
-                 max_retries=1, max_preemptions=8, static_batching=False):
+                 max_retries=1, max_preemptions=8, static_batching=False,
+                 prefix_cache=True, spec_ngram=2):
         import numpy as np
         self._np = np
         self._rt = runtime
         self._pool = pool
+        # speculative decoding rides the runtime's widened executable:
+        # width = spec_k + 1 (window = current token + k drafts)
+        self.width = int(getattr(runtime, "width", 1))
+        self.spec_k = self.width - 1
+        self.spec_ngram = int(spec_ngram)
+        if prefix_cache is True:
+            self._cache = PrefixCache(pool)
+        elif prefix_cache:
+            self._cache = prefix_cache      # caller-supplied instance
+        else:
+            self._cache = None
         self.bos_id = int(bos_id)
         self.eos_id = int(eos_id)
         self.max_queue = int(max_queue)
@@ -262,32 +308,62 @@ class Scheduler:
         self._m_ttft = reg.histogram("serve_ttft_seconds")
         self._m_latency = reg.histogram("serve_request_seconds")
         self._m_step = reg.histogram("serve_decode_step_seconds")
+        # speculative decoding telemetry (ISSUE 12): the acceptance
+        # distribution is the regression signal — profiler.dumps() shows
+        # it as a [serve-spec] row
+        self._m_spec_hist = reg.histogram("serve_spec_accepted_tokens")
+        self._m_spec_drafted = reg.counter("serve_spec_drafted")
+        self._m_spec_accepted = reg.counter("serve_spec_accepted")
+        self._m_spec_degraded = reg.counter("serve_spec_degraded")
+        self._m_prefix_degraded = reg.counter("serve_prefix_degraded")
+        self._m_warm_pref = reg.counter("serve_prefix_admit_preferred")
+        # per-instance tallies (registry counters are process-global)
+        self.decode_turns = 0
+        self.spec_drafted = 0
+        self.spec_accepted = 0
 
     # ------------------------------------------------------------ API
-    def submit(self, src_tokens, max_new_tokens, deadline_ms=None):
+    @property
+    def prefix_cache(self):
+        """The radix prefix cache (None when disabled)."""
+        return self._cache
+
+    def submit(self, src_tokens, max_new_tokens, prompt_tokens=None,
+               deadline_ms=None):
         """Enqueue a request; returns the `Request` handle. Raises
         `ServeOverloaded` when the bounded admission queue is full and
         `ServeError` when the `serve.admit` fault point fires.
-        `deadline_ms` bounds the request END-TO-END (queue wait included):
-        once it elapses the request is evicted wherever it is — queued or
-        mid-decode — with `ServeDeadlineExceeded`, its pages freed and
+        `prompt_tokens` (ISSUE 12) is a decoder-side prompt teacher-
+        forced before generation begins — its full KV pages are shared
+        through the radix prefix cache, so a later request with the same
+        source and a matching prompt prefix adopts them and skips that
+        part of prefill. `deadline_ms` bounds the request END-TO-END
+        (queue wait included): once it elapses the request is evicted
+        wherever it is — queued or mid-decode — with
+        `ServeDeadlineExceeded`, its pages freed and
         `serve_deadline_expired` counting the eviction."""
         max_new = int(max_new_tokens)
         if max_new < 1:
             raise MXNetError("max_new_tokens must be >= 1")
-        if max_new > self._rt.max_pages_per_slot * self._rt.page_size:
+        prompt = [] if prompt_tokens is None else [
+            int(t) for t in self._np.asarray(prompt_tokens,
+                                             self._np.int32).reshape(-1)]
+        budget = self._rt.max_pages_per_slot * self._rt.page_size
+        if len(prompt) + max_new > budget:
             raise MXNetError(
-                f"max_new_tokens {max_new} exceeds the per-slot page "
-                f"budget ({self._rt.max_pages_per_slot} pages x "
+                f"prompt ({len(prompt)}) + max_new_tokens ({max_new}) "
+                f"exceeds the per-slot page budget "
+                f"({self._rt.max_pages_per_slot} pages x "
                 f"{self._rt.page_size})")
-        need = self._pool.pages_for(max_new)
+        need = self._pool.pages_for(len(prompt) + max_new)
         if need > self._pool.capacity:
             # doomed even with the pool to itself: reject at submit time
             # instead of burning prefills + retries on guaranteed
             # mid-decode page exhaustion
             raise MXNetError(
-                f"max_new_tokens {max_new} needs {need} pages but the "
-                f"pool only has {self._pool.capacity} total")
+                f"prompt + max_new_tokens ({len(prompt)} + {max_new}) "
+                f"needs {need} pages but the pool only has "
+                f"{self._pool.capacity} total")
         src = self._np.asarray(src_tokens, self._np.int32).reshape(-1)
         if src.size == 0:
             raise MXNetError("src_tokens must be non-empty (an empty "
@@ -299,7 +375,8 @@ class Scheduler:
         with self._lock:
             rid = self._next_id
             self._next_id += 1
-        req = Request(rid, src, max_new, deadline_ms=deadline_ms)
+        req = Request(rid, src, max_new, prompt=prompt,
+                      deadline_ms=deadline_ms)
         try:
             if _finj.ENABLED:
                 _finj.check("serve.admit", context=f"request {rid}")
@@ -356,12 +433,12 @@ class Scheduler:
             if _finj.ENABLED:
                 _finj.check("serve.decode",
                             context=f"{len(active)} active")
-            self._grow_pages(active, res)
+            plans = self._plan_turn(active, res)
             active = [(s, r) for s, r in enumerate(self._slots)
                       if r is not None]
             if not active:
                 return res
-            next_tok = self._decode(active)
+            next_tok = self._decode(active, plans)
         except _finj.FaultInjected as e:
             self._fail_inflight(active, res, e, reset_pages=False)
             return res
@@ -370,15 +447,56 @@ class Scheduler:
             return res
         self._m_step.observe(time.perf_counter() - t0)
         res.decoded = len(active)
+        self.decode_turns += 1
         now = time.perf_counter()
         for s, r in active:
-            tok = int(next_tok[s])
+            window, f = plans[s]
+            q = len(window)
+            g = next_tok[s]                    # (width,) host int32
+            L = int(self._lens[s])
+            commits = []
+            accepted = 0
+            if L + f == len(r.known):
+                # the window reaches the generation frontier: g[f-1] is
+                # the greedy token after the last known one, and each
+                # accepted draft (window[i+1] == g[i]) validates one
+                # more greedy commit — EXACTLY the tokens the 1-wide
+                # loop would have produced over as many turns
+                i = f - 1
+                while True:
+                    tok = int(g[i])
+                    commits.append(tok)
+                    if tok == self.eos_id or \
+                            len(r.tokens) + len(commits) \
+                            >= r.max_new_tokens:
+                        break
+                    if i + 1 < q and window[i + 1] == tok:
+                        i += 1
+                        continue
+                    break
+                accepted = i - (f - 1)
+                self._lens[s] = L + f + accepted
+            else:
+                # pure prompt turn: every window token was forced, every
+                # prediction is for a position we already know
+                self._lens[s] = L + q
+            if q > f:
+                drafted = q - f
+                self._m_spec_drafted.inc(drafted)
+                self.spec_drafted += drafted
+                self._m_spec_accepted.inc(accepted)
+                self.spec_accepted += accepted
+                self._m_spec_hist.observe(accepted)
+            self._offer_prompt_pages(s, r)
+            if not commits:
+                continue
             if r.t_first_token is None:
                 r.t_first_token = now
-            r._emit(tok)
-            r._cur_tok = tok
-            self._lens[s] += 1
-            if tok == self.eos_id or len(r.tokens) >= r.max_new_tokens:
+            r.known.extend(commits)
+            for tok in commits:
+                r._emit(tok)
+            if commits[-1] == self.eos_id \
+                    or len(r.tokens) >= r.max_new_tokens:
                 self._evict(s, r, "done")
                 res.completed += 1
         self._m_active.set(self.active_count())
@@ -407,6 +525,8 @@ class Scheduler:
         for r in self._slots:
             if r is not None:
                 r._pages = [mapping.get(p, p) for p in r._pages]
+        if self._cache is not None:
+            self._cache.remap(mapping)
         return len(mapping)
 
     def shutdown(self, reason="server closed"):
@@ -429,6 +549,8 @@ class Scheduler:
                 self._release_slot(s, r)
                 self._m_failed.inc()
                 r._finish("failed", reason)
+        if self._cache is not None:
+            self._cache.clear()
         self._m_active.set(0)
 
     def run_until_idle(self, max_steps=100000):
@@ -499,17 +621,44 @@ class Scheduler:
             with self._lock:
                 if not self._queue:
                     break
-                req = self._queue.popleft()
+                req = self._pop_next_locked()
                 self._m_queue.set(len(self._queue))
+            psize = self._pool.page_size
+            known = [self.bos_id] + req.prompt
+            # prefix-cache adoption (ISSUE 12): the longest cached chain
+            # of FULL prompt pages under this source's content hash is
+            # adopted (shared, never copied) — those positions skip
+            # teacher-forced prefill entirely. Capped so the next input
+            # token is still a KNOWN one (the page after the adopted run
+            # starts with prompt material).
+            hit = []
+            if self._cache is not None and len(req.prompt) >= psize:
+                try:
+                    if _finj.ENABLED:
+                        _finj.check("serve.prefix",
+                                    context=f"lookup request {req.id}")
+                    hit = self._cache.lookup(self._src_key(req), known,
+                                             len(req.prompt) // psize)
+                except _finj.FaultInjected:
+                    # degrade to the cold path: same output, no reuse
+                    self._m_prefix_degraded.inc()
+                    hit = []
+                if hit:
+                    # the adopter's reference FIRST: pressure eviction
+                    # below must never reap the pages just handed out
+                    self._pool.share(hit)
             try:
-                pages = self._pool.alloc(1)
+                first = self._alloc_pages(1)
             except PageAllocError:
                 # no first page -> push back and stop admitting; decode
                 # progress on the current actives will free pages
+                if hit:
+                    self._pool.free(hit)
                 with self._lock:
                     self._queue.appendleft(req)
                     self._m_queue.set(len(self._queue))
                 break
+            pages = hit + first
             s = free[0]
             try:
                 self._rt.prefill(s, req.src)
@@ -532,58 +681,190 @@ class Scheduler:
             req.state = "running"
             req._slot = s
             req._pages = pages
-            req._cur_tok = self.bos_id
+            req.known = known
+            req.prompt_cached_tokens = len(hit) * psize
+            req._cache_done = False
             self._slots[s] = req
             self._page_tables[s, :] = NULL_PAGE
-            self._page_tables[s, 0] = pages[0]
-            self._lens[s] = 0
+            for i, p in enumerate(pages):
+                self._page_tables[s, i] = p
+            req._n_table = len(pages)
+            self._lens[s] = len(hit) * psize
             admitted += 1
         if admitted:
             self._m_active.set(self.active_count())
         return admitted
 
-    def _grow_pages(self, active, res):
-        """Allocate the next page for any active slot whose NEXT cached
-        position crosses a page boundary; preempt (free + requeue) the
-        request when the pool is dry instead of wedging the batch."""
+    # a cold queue head is bypassed by warm-preferred admissions at most
+    # this many times before FIFO order reasserts itself — bounds
+    # starvation under sustained warm traffic
+    MAX_ADMIT_BYPASS = 4
+
+    def _pop_next_locked(self):
+        """Cache-aware admission order: FIFO normally, but when pages
+        are TIGHT (the head's full cold working set no longer fits the
+        free pool) prefer the queued request with the LONGEST warm
+        cached prefix — it admits at a smaller fresh-page cost, which
+        cuts the mid-decode preemptions page pressure would otherwise
+        cause. A head bypassed `MAX_ADMIT_BYPASS` times is admitted
+        regardless (no starvation under sustained warm arrivals). Probes
+        use `PrefixCache.peek` (no metrics, no LRU touch);
+        `serve_prefix_admit_preferred` counts reorders. Caller holds
+        `self._lock`."""
+        if self._cache is None or len(self._queue) <= 1:
+            return self._queue.popleft()
+        head = self._queue[0]
+        if head._admit_bypassed >= self.MAX_ADMIT_BYPASS \
+                or self._pool.available() >= self._pool.pages_for(
+                    len(head.prompt) + head.max_new_tokens):
+            return self._queue.popleft()
+        psize = self._pool.page_size
+        best_i, best_warm = 0, -1
+        for i, r in enumerate(self._queue):
+            warm = 0
+            if len(r.prompt) >= psize:
+                warm = self._cache.peek(self._src_key(r),
+                                        [self.bos_id] + r.prompt,
+                                        len(r.prompt) // psize)
+            if warm > best_warm:
+                best_i, best_warm = i, warm
+        if best_i == 0:
+            return self._queue.popleft()
+        head._admit_bypassed += 1
+        req = self._queue[best_i]
+        del self._queue[best_i]
+        self._m_warm_pref.inc()
+        return req
+
+    @staticmethod
+    def _src_key(req):
+        """Memoized content hash of the request's source (immutable per
+        request; the admission hot path probes it repeatedly)."""
+        if req._content_key is None:
+            req._content_key = content_key(req.src)
+        return req._content_key
+
+    def _alloc_pages(self, n):
+        """`pool.alloc` with prefix-cache pressure relief: when the pool
+        is dry, evict least-recently-used CACHE-ONLY pages (nothing in
+        flight adopted them) and retry, so cached prefixes cost capacity
+        only while it is spare — admission never fails because of them."""
+        try:
+            return self._pool.alloc(n)
+        except PageAllocError:
+            if self._cache is None or not self._cache.evict(n):
+                raise
+            return self._pool.alloc(n)
+
+    def _plan_turn(self, active, res):
+        """Build every active slot's token window for this turn — the
+        FORCED tokens first (known-but-uncached prompt / committed
+        tokens), then up to `spec_k` n-gram drafts once the window
+        reaches the generation frontier — and allocate the pages those
+        positions need. A slot whose current page is full when the pool
+        is dry is preempted (pages freed, requeued) exactly like the
+        1-wide path; a slot that can only fit part of its window just
+        runs a shorter window (ragged qlens are free — same executable,
+        same dispatch)."""
         psize = self._rt.page_size
-        for s, r in active:
-            pos = int(self._lens[s])
-            if pos == 0 or pos % psize:
-                continue        # current page still has room
-            slot_page = pos // psize
+        budget = self._rt.max_pages_per_slot * psize
+        width = self.width
+        draft_ok = self.spec_k > 0
+        if draft_ok and _finj.ENABLED:
             try:
-                page = self._pool.alloc(1)[0]
-            except PageAllocError:
+                _finj.check("serve.speculate", context="draft window")
+            except _finj.FaultInjected:
+                # degrade: run the turn unspeculated — committed output
+                # is IDENTICAL, only turns/token suffers
+                self._m_spec_degraded.inc()
+                draft_ok = False
+        plans = {}
+        for s, r in active:
+            L = int(self._lens[s])
+            window = list(r.known[L:L + width])
+            f = len(window)
+            if draft_ok and f < width:
+                window.extend(propose_ngram(r.known, width - f,
+                                            self.spec_ngram))
+            del window[budget - L:]     # never write past the page budget
+            need_idx = (L + len(window) - 1) // psize
+            while r._n_table <= need_idx:
+                try:
+                    page = self._alloc_pages(1)[0]
+                except PageAllocError:
+                    del window[r._n_table * psize - L:]
+                    break
+                r._pages.append(page)
+                self._page_tables[s, r._n_table] = page
+                r._n_table += 1
+            if not window:
                 self._m_preempt.inc()
                 self._requeue(s, r, "page pool exhausted mid-decode",
                               preempted=True)
                 res.preempted += 1
                 continue
-            r._pages.append(page)
-            self._page_tables[s, slot_page] = page
+            plans[s] = (window, min(f, len(window)))
+        return plans
 
-    def _decode(self, active):
-        mask = self._np.zeros((self._rt.slots,), self._np.int32)
-        toks = self._np.zeros((self._rt.slots,), self._np.int32)
+    def _decode(self, active, plans):
+        np = self._np
+        width = self.width
+        mask = np.zeros((self._rt.slots,), np.int32)
+        toks = np.zeros((self._rt.slots, width), np.int32)
+        qlens = np.ones((self._rt.slots,), np.int32)
         for s, r in active:
+            window, _f = plans[s]
             mask[s] = 1
-            toks[s] = r._cur_tok
+            toks[s, :len(window)] = window
+            qlens[s] = len(window)
+
+        def launch():
+            if width == 1:
+                out, _ = self._rt.decode(self._page_tables, self._lens,
+                                         toks[:, 0], mask)
+                return out.reshape(-1, 1)
+            out, _ = self._rt.decode_multi(self._page_tables, self._lens,
+                                           toks, qlens, mask)
+            return out
+
         if _tracer.ACTIVE:
             with _tracer.span("serve.decode_step", cat="serve",
                               args={"active": len(active)}):
-                out, _ = self._rt.decode(self._page_tables, self._lens,
-                                         toks, mask)
-        else:
-            out, _ = self._rt.decode(self._page_tables, self._lens,
-                                     toks, mask)
-        return out
+                return launch()
+        return launch()
+
+    def _offer_prompt_pages(self, s, r):
+        """Once a request's prompt positions are fully cached, index its
+        FULL prompt pages in the radix cache (the cache takes its own
+        reference; chunks another request already cached keep theirs).
+        One-shot per admission attempt; a `serve.prefix` fault degrades
+        to not caching — the request itself is unaffected."""
+        if self._cache is None or r._cache_done:
+            return
+        psize = self._rt.page_size
+        ncache = (len(r.prompt) + 1) // psize   # [BOS] + prompt chunks
+        if ncache == 0:
+            r._cache_done = True
+            return
+        if int(self._lens[s]) < ncache * psize:
+            return
+        r._cache_done = True
+        try:
+            if _finj.ENABLED:
+                _finj.check("serve.prefix",
+                            context=f"insert request {r.id}")
+        except _finj.FaultInjected:
+            self._m_prefix_degraded.inc()
+            return
+        pages = [int(p) for p in self._page_tables[s, :ncache]]
+        self._cache.insert(self._src_key(r), r.known, pages)
 
     def _release_slot(self, s, r):
         if r._pages:
             self._pool.free(r._pages)
         r._pages = []
         r._slot = None
+        r._n_table = 0
         self._slots[s] = None
         self._page_tables[s, :] = NULL_PAGE
         self._lens[s] = 0
@@ -620,7 +901,9 @@ class Scheduler:
             r.retries += 1
             exhausted = r.retries > self.max_retries
         r.tokens = []
-        r._cur_tok = None
+        r.known = None              # rebuilt (and re-adopted) at admission
+        r._cache_done = False
+        r.prompt_cached_tokens = 0
         r.t_first_token = None
         with r._chunk_cv:
             r._chunks.clear()
@@ -644,4 +927,8 @@ class Scheduler:
                 res.retried += 1
         if reset_pages:
             self._rt.reset_pages()
+            if self._cache is not None:
+                # page CONTENTS are no longer trustworthy — cached
+                # prefixes must not be adopted into fresh requests
+                self._cache.clear()
         self._m_active.set(self.active_count())

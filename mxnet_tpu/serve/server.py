@@ -45,30 +45,42 @@ class Server:
     slots: max concurrent decoding requests; page_size: tokens per KV
     page; num_pages: device pool size INCLUDING the reserved null page;
     max_src_len: static source padding length; max_new_tokens: per-slot
-    generation cap (and page-budget denominator). See docs/SERVING.md for
-    pool sizing."""
+    generation cap; max_prompt_len: per-slot decoder-prompt cap (page-
+    budget denominator is prompt + generation); speculative_k: tokens
+    drafted per turn and verified in ONE widened dispatch (0 = classic
+    one-token turns); prefix_cache: share full prompt pages across
+    requests through the content-hashed radix index. See
+    docs/SERVING.md for pool sizing and the fast-path contracts."""
 
     def __init__(self, model, slots=8, page_size=16, num_pages=None,
-                 max_src_len=32, max_new_tokens=32, bos_id=2, eos_id=3,
+                 max_src_len=32, max_new_tokens=32, max_prompt_len=0,
+                 speculative_k=0, prefix_cache=True, bos_id=2, eos_id=3,
                  max_queue=64, max_retries=1, static_batching=False,
                  engine_driven=True):
         if max_new_tokens < 1:
             raise MXNetError("max_new_tokens must be >= 1")
+        if speculative_k < 0:
+            raise MXNetError("speculative_k must be >= 0")
         self.max_new_tokens = int(max_new_tokens)
+        self.max_prompt_len = int(max_prompt_len)
+        self.speculative_k = int(speculative_k)
+        budget_tokens = int(max_new_tokens) + self.max_prompt_len
         if num_pages is None:
             # every slot can hold a full-length request + the null page
             num_pages = slots * \
-                (-(-int(max_new_tokens) // int(page_size))) + 1
+                (-(-budget_tokens // int(page_size))) + 1
         self._pool = PagePool(num_pages, page_size)
-        pages_per_slot = self._pool.pages_for(max_new_tokens)
+        pages_per_slot = self._pool.pages_for(budget_tokens)
         self._rt = DecodeRuntime(
             decoder_weights(model), encoder_weights(model), slots=slots,
             num_pages=num_pages, page_size=page_size,
-            max_pages_per_slot=pages_per_slot, max_src_len=max_src_len)
+            max_pages_per_slot=pages_per_slot, max_src_len=max_src_len,
+            width=self.speculative_k + 1)
         self._sched = Scheduler(self._rt, self._pool, bos_id=bos_id,
                                 eos_id=eos_id, max_queue=max_queue,
                                 max_retries=max_retries,
-                                static_batching=static_batching)
+                                static_batching=static_batching,
+                                prefix_cache=prefix_cache)
         self._engine_driven = bool(engine_driven)
         self._loop = EngineLoop(self._sched) if self._engine_driven \
             else None
@@ -93,32 +105,50 @@ class Server:
     def pool(self):
         return self._pool
 
-    def submit(self, src_tokens, max_new_tokens=None, deadline_ms=None):
+    @property
+    def prefix_cache(self):
+        """The radix prefix cache (None when disabled)."""
+        return self._sched.prefix_cache
+
+    def submit(self, src_tokens, max_new_tokens=None, prompt_tokens=None,
+               deadline_ms=None):
         """Enqueue a request; returns its `Request` handle immediately.
         Raises `ServeOverloaded` under backpressure. The handle's
         `.result(timeout)` / `.stream(timeout)` / `.done()` consume it.
 
-        `deadline_ms` bounds the request END-TO-END (queue wait
-        included): when it elapses the scheduler evicts the request —
-        queued or mid-decode — with a clean `ServeDeadlineExceeded`,
-        frees its KV pages, and counts it into
+        `prompt_tokens` is a decoder-side prompt (system prompt /
+        few-shot template) teacher-forced before generation; its full KV
+        pages are shared across requests through the content-hashed
+        radix prefix cache, so a matching prefix skips that part of
+        prefill (see docs/SERVING.md). `deadline_ms` bounds the request
+        END-TO-END (queue wait included): when it elapses the scheduler
+        evicts the request — queued or mid-decode — with a clean
+        `ServeDeadlineExceeded`, frees its KV pages, and counts it into
         `serve_deadline_expired`."""
+        if prompt_tokens is not None \
+                and len(prompt_tokens) > self.max_prompt_len:
+            raise MXNetError(
+                f"prompt of {len(prompt_tokens)} tokens exceeds this "
+                f"server's max_prompt_len {self.max_prompt_len} (size "
+                f"the server with max_prompt_len= to accept prompts)")
         with self._close_lock:
             if self._closed:
                 raise MXNetError("Server is closed")
             req = self._sched.submit(
                 src_tokens, max_new_tokens if max_new_tokens is not None
-                else self.max_new_tokens, deadline_ms=deadline_ms)
+                else self.max_new_tokens, prompt_tokens=prompt_tokens,
+                deadline_ms=deadline_ms)
             if self._loop is not None:
                 self._loop.kick()
             else:
                 req._inline_sched = self._sched
             return req
 
-    def stream(self, src_tokens, max_new_tokens=None, timeout=None,
-               deadline_ms=None):
+    def stream(self, src_tokens, max_new_tokens=None, prompt_tokens=None,
+               timeout=None, deadline_ms=None):
         """Submit + yield generated token ids as they are produced."""
         req = self.submit(src_tokens, max_new_tokens,
+                          prompt_tokens=prompt_tokens,
                           deadline_ms=deadline_ms)
         yield from req.stream(timeout=timeout)
 

@@ -38,6 +38,19 @@ def test_captured_dispatch_budget_and_parity():
     assert res["serve_decode_retraces"] == 0
     assert res["serve_pages_leaked"] == 0
     assert res["serve_decode_steps_measured"] > 0
+    # ISSUE 12: the serving fast path — speculative decode holds the
+    # same one-dispatch/zero-retrace budget while draft acceptance
+    # varies (and genuinely accepts drafts), the prefix cache strictly
+    # reduces prefill dispatches vs the cold control while the cache-
+    # disabled control shows no reduction, and refcounted pages all
+    # come home
+    assert res["serve_spec_dispatches_per_turn"] <= 1
+    assert res["serve_spec_retraces"] == 0
+    assert res["serve_spec_accept_rate"] > 0
+    assert res["serve_prefix_warm_turns"] < res["serve_prefix_cold_turns"]
+    assert res["serve_prefix_nocache_turns"] >= \
+        res["serve_prefix_cold_turns"]
+    assert res["serve_fastpath_pages_leaked"] == 0
 
 
 def test_check_dispatch_cli_smoke():

@@ -33,6 +33,16 @@ def test_fusion_budgets_hold_and_control_trips():
     # serve: both executables inside budget, decode compiled exactly once
     assert res["serve_decode"]["collective_total"] == 0
     assert res["serve_decode_traces"] == 1
+    # ISSUE 12: the widened speculative-verify executable holds its
+    # fusion AND copy bands, keeps both page pools donated in place,
+    # and compiled exactly once across varying draft acceptance
+    lo, hi = check_fusion.BUDGETS["serve_verify"]["fusions"]
+    assert lo <= res["serve_verify"]["fusions"] <= hi
+    clo, chi = check_fusion.BUDGETS["serve_verify"]["copies"]
+    assert clo <= res["serve_verify"]["copies"] <= chi
+    assert res["serve_verify"]["aliased_inputs"] == 2
+    assert res["serve_verify"]["collective_total"] == 0
+    assert res["serve_verify_traces"] == 1
     # the gate provably bites: the fusion-pass-disabled control landed
     # below the band and tripped the SAME budget table
     assert res["control_tripped"] is True
@@ -159,4 +169,5 @@ def test_hlo_counting_handles_tpu_layout_annotations():
 def test_check_fusion_cli_smoke():
     assert callable(check_fusion.main)
     assert set(check_fusion.BUDGETS) == {
-        "captured_step", "sharded_step", "serve_decode", "serve_prefill"}
+        "captured_step", "sharded_step", "serve_decode", "serve_prefill",
+        "serve_verify"}

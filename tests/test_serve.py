@@ -826,3 +826,361 @@ def test_engine_loop_survives_high_class_queue_limits():
     assert not sched2.pending_work()
     loop2.close()
     assert blocker.done()
+
+
+# ------------------------------------------- serving fast path (ISSUE 12)
+def test_page_pool_free_is_atomic_regression():
+    """A double-free mid-list must leave the pool UNTOUCHED: before the
+    fix, the earlier pages of the list were already freed and counted
+    when the error fired, corrupting the leak accounting the tier-1
+    gates assert on."""
+    reg = registry()
+    pool = PagePool(num_pages=8, page_size=4)
+    a = pool.alloc(3)
+    pool.free([a[0]])
+    frees0 = reg.counter("kv_page_frees").value
+    with pytest.raises(MXNetError):
+        pool.free([a[1], a[0], a[2]])    # a[0] already free, mid-list
+    # NOTHING moved: a[1]/a[2] still live, free counter flat
+    assert pool.in_use() == 2
+    assert pool.ref_count(a[1]) == 1 and pool.ref_count(a[2]) == 1
+    assert reg.counter("kv_page_frees").value == frees0
+    # over-release via duplicates within ONE list is caught up front too
+    with pytest.raises(MXNetError):
+        pool.free([a[1], a[1]])
+    assert pool.in_use() == 2
+    pool.free([a[1], a[2]])
+    assert pool.in_use() == 0
+
+
+def test_page_pool_refcount_sharing():
+    reg = registry()
+    pool = PagePool(num_pages=8, page_size=4)
+    pages = pool.alloc(2)
+    pool.share(pages)                    # second owner
+    assert pool.ref_count(pages[0]) == 2
+    assert pool.total_refs() == 4
+    assert reg.gauge("kv_page_refs").value == 4
+    pool.free(pages)                     # first owner releases
+    assert pool.in_use() == 2            # still live (one owner left)
+    assert pool.available() == 5
+    # duplicate releases within one list are legal up to the refcount
+    pool.share([pages[0]])
+    pool.free([pages[0], pages[0]])
+    assert pool.ref_count(pages[0]) == 0
+    pool.free([pages[1]])
+    assert pool.in_use() == 0 and pool.total_refs() == 0
+    with pytest.raises(MXNetError):
+        pool.share([pages[0]])           # free page: nothing to share
+
+
+def test_prefix_cache_radix_unit():
+    from mxnet_tpu.serve.prefix_cache import PrefixCache, content_key
+    pool = PagePool(num_pages=16, page_size=4)
+    cache = PrefixCache(pool)
+    k1 = content_key([7, 8, 9])
+    k2 = content_key([7, 8])             # different source: no sharing
+    seq = [2, 10, 11, 12, 13, 14, 15, 16, 17]    # [BOS] + 8 prompt
+    pages = pool.alloc(2)
+    assert cache.insert(k1, seq, pages) == 2
+    assert pool.ref_count(pages[0]) == 2         # cache's own reference
+    # full match, partial match, foreign-source and diverging lookups
+    assert cache.lookup(k1, seq, 2) == pages
+    assert cache.lookup(k1, seq, 1) == [pages[0]]
+    assert cache.lookup(k2, seq, 2) == []
+    div = list(seq)
+    div[6] = 99                                   # diverges in chunk 2
+    assert cache.lookup(k1, div, 2) == [pages[0]]
+    # owner releases; cache keeps the pages alive at refcount 1
+    pool.free(pages)
+    assert pool.in_use() == 2
+    # LRU eviction: only LEAF nodes with no in-flight adopters go, least
+    # recently used first — and an adopted page is skipped
+    pool.share([pages[1]])                        # simulate an adopter
+    assert cache.evict(2) == 0                    # leaf pinned, parent has
+    pool.free([pages[1]])                         # a child: nothing to do
+    assert cache.evict(1) == 1                    # leaf (chunk 2) goes
+    assert cache.lookup(k1, seq, 2) == [pages[0]]
+    assert cache.evict(1) == 1                    # now the root chunk
+    assert cache.pages_held() == 0 and pool.in_use() == 0
+    # remap keeps the index coherent with a real defrag
+    anchors = pool.alloc(2)                       # occupy the low ids
+    p2 = pool.alloc(1)
+    cache.insert(k1, seq, p2)
+    pool.free(p2)                                 # cache is the only owner
+    pool.free(anchors)                            # low ids free: p2 moves
+    mapping = pool.defrag()
+    cache.remap(mapping)
+    new_id = mapping[p2[0]]
+    assert cache.lookup(k1, seq, 1) == [new_id]
+    assert cache.clear() == 1
+    assert pool.in_use() == 0
+
+
+def _drain(srv, *submits, max_steps=500):
+    handles = [srv.submit(s, max_new_tokens=m, prompt_tokens=p)
+               for s, m, p in submits]
+    srv.scheduler.run_until_idle(max_steps=max_steps)
+    return [h.result(timeout=60) for h in handles]
+
+
+def test_prompted_greedy_bitwise_contract():
+    """THE fast-path contract: for one (source, prompt, budget) request
+    the committed token sequence is IDENTICAL across every serving
+    configuration — prefix cache cold, warm, disabled; speculative k=2
+    and k=3 — and page refcounts return to the cache-held baseline
+    after every request, to zero after close()."""
+    from mxnet_tpu import profiler
+    model = _tiny_model(max_length=48)
+    rng = np.random.RandomState(5)
+    src = rng.randint(4, 50, (7,)).astype(np.int32)
+    prompt = rng.randint(4, 50, (9,)).astype(np.int32)
+
+    def run(srv):
+        t0 = srv.scheduler.decode_turns
+        out = _drain(srv, (src, 8, prompt))[0]
+        return out, srv.scheduler.decode_turns - t0
+
+    srv = _server(model, max_new_tokens=8, max_prompt_len=12,
+                  num_pages=16)
+    cold, cold_turns = run(srv)
+    warm, warm_turns = run(srv)
+    assert warm == cold
+    assert warm_turns < cold_turns          # adopted pages skip prefill
+    cache = srv.prefix_cache
+    assert cache.hits == 1 and cache.misses == 1
+    assert cache.tokens_saved == 8          # two full 4-token pages
+    # drained: only the cache holds pages, each at refcount exactly 1
+    assert srv.pool.in_use() == cache.pages_held() == 2
+    assert srv.pool.total_refs() == 2
+    srv.close()
+    assert srv.pool.in_use() == 0 and srv.pool.total_refs() == 0
+
+    srv = _server(model, max_new_tokens=8, max_prompt_len=12,
+                  num_pages=16, prefix_cache=False)
+    nocache, _ = run(srv)
+    assert srv.prefix_cache is None
+    srv.close()
+    assert nocache == cold
+
+    for k in (2, 3):
+        srv = _server(model, max_new_tokens=8, max_prompt_len=12,
+                      num_pages=16, speculative_k=k)
+        spec, _ = run(srv)
+        assert spec == cold, f"speculative k={k} changed greedy output"
+        assert srv.runtime.verify_traces == 1
+        srv.close()
+        assert srv.pool.in_use() == 0
+
+
+def test_speculative_accepts_and_reduces_turns():
+    """On self-repetitive greedy output the n-gram proposer earns its
+    keep: drafted tokens get accepted, a solo request finishes in fewer
+    decode turns than tokens, and the acceptance histogram records the
+    distribution profiler.dumps() surfaces."""
+    reg = registry()
+    hist0 = reg.histogram("serve_spec_accepted_tokens").count
+    model = _tiny_model(max_length=48)
+    rng = np.random.RandomState(5)
+    src = rng.randint(4, 50, (7,)).astype(np.int32)
+    srv = _server(model, max_new_tokens=12, max_prompt_len=12,
+                  num_pages=16, speculative_k=3)
+    out = _drain(srv, (src, 12, None))[0]
+    sched = srv.scheduler
+    assert sched.spec_accepted > 0
+    assert sched.decode_turns < len(out)    # strictly fewer turns/token
+    assert reg.histogram("serve_spec_accepted_tokens").count > hist0
+    srv.close()
+
+
+def test_prefix_eviction_under_pressure():
+    """When the pool is dry, admission evicts LRU cache-only pages
+    instead of failing or preempting — cached prefixes only cost
+    capacity while it is spare."""
+    reg = registry()
+    ev0 = reg.counter("serve_prefix_evictions").value
+    model = _tiny_model(max_length=48)
+    rng = np.random.RandomState(6)
+    src = rng.randint(4, 50, (5,)).astype(np.int32)
+    pa = rng.randint(4, 50, (9,)).astype(np.int32)
+    pb = rng.randint(4, 50, (9,)).astype(np.int32)
+    # capacity 5: a request's working set is 4 pages (prompt 9 + 6 new),
+    # so after A leaves its 2 cached pages behind, B's growth hits a dry
+    # pool and must reclaim from the cache
+    srv = _server(model, slots=1, max_new_tokens=6, max_prompt_len=12,
+                  num_pages=6)
+    a = _drain(srv, (src, 6, pa))[0]
+    assert srv.prefix_cache.pages_held() == 2
+    b = _drain(srv, (src, 6, pb))[0]
+    assert len(a) >= 1 and len(b) >= 1
+    assert reg.counter("serve_prefix_evictions").value > ev0
+    # evicted pages left the cache index too — nothing dangling
+    assert srv.pool.in_use() == srv.prefix_cache.pages_held()
+    srv.close()
+    assert srv.pool.in_use() == 0
+
+
+def test_chaos_prefix_and_speculate_faults_degrade_identically():
+    """Injected cache-lookup/insert and draft faults DEGRADE (cold path /
+    unspeculated turn) with bitwise-identical request output, zero
+    leaked pages and zero stuck refcounts."""
+    model = _tiny_model(max_length=48)
+    rng = np.random.RandomState(8)
+    reqs = [(rng.randint(4, 50, (6,)).astype(np.int32),
+             5, rng.randint(4, 50, (9,)).astype(np.int32))
+            for _ in range(3)]
+    reqs.append(reqs[0])                    # a warm repeat in the mix
+
+    def run(faulty):
+        srv = _server(model, slots=2, max_new_tokens=6, max_prompt_len=12,
+                      num_pages=24, speculative_k=2)
+        fired = 0
+        if faulty:
+            finj.inject("serve.prefix", prob=0.5, seed=13)
+            finj.inject("serve.speculate", prob=0.5, seed=14)
+        try:
+            outs = _drain(srv, *reqs)
+            if faulty:
+                fired = (finj.fires("serve.prefix")
+                         + finj.fires("serve.speculate"))
+        finally:
+            finj.clear()
+        held = srv.prefix_cache.pages_held()
+        assert srv.pool.in_use() == held    # requests fully released
+        assert srv.pool.total_refs() == held
+        srv.close()
+        assert srv.pool.in_use() == 0
+        return outs, fired
+
+    clean, _ = run(faulty=False)
+    chaos, fired = run(faulty=True)
+    assert fired > 0
+    assert chaos == clean
+
+
+def test_spec_preemption_with_prompt_no_leak():
+    """Page-pressure preemption under speculation + prompts: requests
+    restart (re-adopting any cached prefix), complete, and the pool
+    returns to the cache-held baseline."""
+    reg = registry()
+    pre0 = reg.counter("serve_page_preemptions").value
+    model = _tiny_model(max_length=48)
+    rng = np.random.RandomState(11)
+    src = rng.randint(4, 50, (5,)).astype(np.int32)
+    prompts = [rng.randint(4, 50, (6,)).astype(np.int32)
+               for _ in range(2)]
+    srv = _server(model, slots=2, max_new_tokens=8, max_prompt_len=8,
+                  num_pages=7, speculative_k=2)   # capacity 6: contended
+    outs = _drain(srv, (src, 8, prompts[0]), (src, 8, prompts[1]),
+                  max_steps=2000)
+    assert all(len(o) >= 1 for o in outs)
+    assert reg.counter("serve_page_preemptions").value > pre0
+    assert srv.pool.in_use() == srv.prefix_cache.pages_held()
+    srv.close()
+    assert srv.pool.in_use() == 0
+
+
+def test_submit_prompt_validation():
+    srv = _server(max_new_tokens=8, max_prompt_len=8)
+    with pytest.raises(MXNetError):
+        # prompt + max_new over the per-slot page budget
+        srv.submit([5, 6, 7], max_new_tokens=8,
+                   prompt_tokens=list(range(4, 20)))
+    srv.close()
+
+
+def test_paged_attention_multi_rowwise_matches_single():
+    """The widened lax fallback runs the SAME shared math per query row:
+    row i equals the single-query path over `lengths + i` visible keys
+    to reduction-order tolerance (XLA batches the W-row contraction; the
+    TOKEN-level identity the speculative commits rely on is pinned end
+    to end by test_prompted_greedy_bitwise_contract)."""
+    import jax.numpy as jnp
+    from mxnet_tpu.ops.pallas_kernels import (_paged_attention_lax,
+                                              _paged_attention_lax_multi)
+    q1, kp, vp, pt, lens = _paged_fixture()
+    S, H, dh = q1.shape
+    W = 3
+    rng = np.random.RandomState(21)
+    q = jnp.asarray(rng.randn(S, W, H, dh).astype(np.float32))
+    out = _paged_attention_lax_multi(q, kp, vp, pt, lens)
+    for i in range(W):
+        ref = _paged_attention_lax(q[:, i], kp, vp, pt, lens + i)
+        np.testing.assert_allclose(np.asarray(out[:, i]),
+                                   np.asarray(ref),
+                                   rtol=2e-6, atol=2e-6, err_msg=str(i))
+
+
+def test_paged_attention_multi_kernel_interpret(monkeypatch):
+    """The widened Pallas kernel numerics, pinned on CPU via interpret
+    mode against the lax fallback (same harness as the 1-wide test)."""
+    monkeypatch.setenv("MXTPU_PALLAS_INTERPRET", "1")
+    import jax.numpy as jnp
+    from mxnet_tpu.ops.pallas_kernels import (_paged_attention_lax_multi,
+                                              ragged_paged_attention)
+    q1, kp, vp, pt, lens = _paged_fixture()
+    S, H, dh = q1.shape
+    rng = np.random.RandomState(22)
+    q = jnp.asarray(rng.randn(S, 4, H, dh).astype(np.float32))
+    out_k = ragged_paged_attention(q, kp, vp, pt, lens)
+    ref = _paged_attention_lax_multi(q, kp, vp, pt, lens)
+    np.testing.assert_allclose(np.asarray(out_k), np.asarray(ref),
+                               rtol=2e-6, atol=2e-6)
+
+
+def test_cache_aware_admission_prefers_warm_prefix_under_pressure():
+    """When pages are tight, admission reorders the queue toward the
+    request with the longest warm cached prefix (smaller fresh-page
+    cost) instead of blind FIFO — counted by
+    `serve_prefix_admit_preferred`."""
+    reg = registry()
+    pref0 = reg.counter("serve_prefix_admit_preferred").value
+    model = _tiny_model(max_length=48)
+    rng = np.random.RandomState(14)
+    src = rng.randint(4, 50, (5,)).astype(np.int32)
+    pa = rng.randint(4, 50, (9,)).astype(np.int32)
+    pc = rng.randint(4, 50, (9,)).astype(np.int32)
+    srv = _server(model, slots=1, max_new_tokens=6, max_prompt_len=12,
+                  num_pages=6)                    # capacity 5: tight
+    _drain(srv, (src, 6, pa))                     # cache pa's 2 pages
+    blocker = srv.submit(src, max_new_tokens=6)   # occupies the slot
+    srv.scheduler.step()
+    assert blocker.state == "running"
+    cold = srv.submit(src, max_new_tokens=6, prompt_tokens=pc)
+    warm = srv.submit(src, max_new_tokens=6, prompt_tokens=pa)
+    srv.scheduler.run_until_idle(max_steps=1000)
+    assert len(cold.result()) >= 1 and len(warm.result()) >= 1
+    assert reg.counter("serve_prefix_admit_preferred").value > pref0
+    assert warm.prompt_cached_tokens == 8         # adopted, not rebuilt
+    assert warm.t_done < cold.t_done              # warm jumped the queue
+    srv.close()
+    assert srv.pool.in_use() == 0
+
+
+def test_warm_preference_cannot_starve_cold_head():
+    """The warm-prefix admission preference is BOUNDED: a cold queue
+    head bypassed `MAX_ADMIT_BYPASS` times is admitted regardless, so
+    sustained warm traffic cannot starve it."""
+    from mxnet_tpu.serve.scheduler import Scheduler
+    model = _tiny_model(max_length=48)
+    rng = np.random.RandomState(15)
+    src = rng.randint(4, 50, (5,)).astype(np.int32)
+    pa = rng.randint(4, 50, (9,)).astype(np.int32)
+    pc = rng.randint(4, 50, (9,)).astype(np.int32)
+    srv = _server(model, slots=1, max_new_tokens=6, max_prompt_len=12,
+                  num_pages=6, max_queue=16)     # capacity 5: tight
+    _drain(srv, (src, 6, pa))                    # warm pa's prefix
+    blocker = srv.submit(src, max_new_tokens=6)
+    srv.scheduler.step()
+    cold = srv.submit(src, max_new_tokens=6, prompt_tokens=pc)
+    warms = [srv.submit(src, max_new_tokens=6, prompt_tokens=pa)
+             for _ in range(Scheduler.MAX_ADMIT_BYPASS + 2)]
+    srv.scheduler.run_until_idle(max_steps=4000)
+    assert len(cold.result()) >= 1
+    # the bound bit: cold was bypassed at most MAX_ADMIT_BYPASS times,
+    # so it finished before the LAST warm request
+    assert cold._admit_bypassed <= Scheduler.MAX_ADMIT_BYPASS
+    assert cold.t_done < warms[-1].t_done
+    assert len(blocker.result()) >= 1
+    srv.close()
+    assert srv.pool.in_use() == 0

@@ -36,6 +36,15 @@ tables vary mid-flight (mixed-length admissions/evictions between
 steps), and the KV page pool must return to zero pages in use once
 every request completes.
 
+ISSUE 12 extension — the serving FAST PATH: speculative decode holds
+the same <=1 dispatch per warm turn with ZERO retraces of the widened
+verify executable across varying draft acceptance (and must actually
+accept drafts, or the zero would be vacuous); a prefix-cache-warm
+request takes STRICTLY fewer prefill (decode-turn) dispatches than the
+cold control while a cache-disabled control shows no reduction; page
+refcounts return to exactly the cache-held baseline after every
+request and to zero after close().
+
 Standalone:
 
     JAX_PLATFORMS=cpu python tools/check_dispatch.py [--steps N] [--budget B]
@@ -130,6 +139,7 @@ def run(steps=DEFAULT_STEPS, budget=DISPATCH_BUDGET):
     prefetch_res = _run_prefetch_phase(steps, errors)
     shard_res = _run_shard_phase(steps, errors)
     serve_res = _run_serve_phase(errors)
+    serve_res.update(_run_serve_fastpath_phase(errors))
 
     res = {
         "steps": steps,
@@ -371,6 +381,146 @@ def _run_serve_phase(errors):
     }
 
 
+def _run_serve_fastpath_phase(errors):
+    """Serving fast-path budgets (ISSUE 12).
+
+    SPECULATIVE decode: a width-(k+1) server's warm turns stay at ONE
+    dispatch each, and the widened verify executable never retraces
+    while draft acceptance varies (ragged window lengths are arguments,
+    not shapes). Liveness: the run must actually accept drafted tokens
+    (accept rate > 0) — a dead proposer would make the retrace zero
+    vacuous.
+
+    PREFIX cache: a request whose source+prompt prefix is cached must
+    take STRICTLY fewer prefill (decode-turn) dispatches than the cold
+    control — and the de-optimised control (cache disabled, identical
+    request) must show NO reduction, proving the delta is the cache.
+    Pages: after the traffic drains, only cache-held pages remain (each
+    at refcount exactly 1), and close() returns the pool to zero."""
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import profiler
+    from mxnet_tpu.models.transformer import TransformerNMT
+
+    def build(**kw):
+        mx.random.seed(0)
+        model = TransformerNMT(32, units=16, hidden=32, num_layers=1,
+                               num_heads=2, max_length=48, dropout=0.0)
+        model.initialize()
+        # num_pages sized generously: page PRESSURE (cache eviction /
+        # preemption) is unit-tested in tests/test_serve.py — here it
+        # would let an eviction turn the warm request cold and make the
+        # strictly-fewer comparison flaky
+        return mx.serve.Server(model, slots=2, page_size=4, max_src_len=8,
+                               max_new_tokens=8, max_prompt_len=12,
+                               num_pages=16, engine_driven=False, **kw)
+
+    rng = np.random.RandomState(3)
+    src = rng.randint(4, 32, (6,)).astype(np.int32)
+    prompt = rng.randint(4, 32, (9,)).astype(np.int32)
+
+    def drain_turns(srv, *submits):
+        handles = [srv.submit(s, max_new_tokens=m, prompt_tokens=p)
+                   for s, m, p in submits]
+        base = profiler.dispatch_count("serve_decode")
+        srv.scheduler.run_until_idle()
+        outs = [h.result(timeout=300) for h in handles]
+        return outs, profiler.dispatch_count("serve_decode") - base
+
+    # -- speculative server: warm-up compiles verify + prefill ---------
+    srv = build(speculative_k=2)
+    cold_out, cold_turns = drain_turns(srv, (src, 8, prompt))
+    warm_traces = srv.runtime.verify_traces
+
+    # warm request adopts the cached prefix; extra mixed traffic varies
+    # occupancy AND draft acceptance (different prompts/sources accept
+    # differently) while we hold the per-turn dispatch budget
+    for s_, m_, p_ in ((src, 8, prompt),
+                       (rng.randint(4, 32, (5,)), 6,
+                        rng.randint(4, 32, (6,))),
+                       (src, 4, prompt[:6])):
+        srv.submit(s_, max_new_tokens=m_, prompt_tokens=p_)
+    worst = 0
+    decode_steps = 0
+    for _ in range(200):
+        if not srv.scheduler.pending_work():
+            break
+        profiler.reset_dispatches()
+        r = srv.scheduler.step()
+        if r.decoded and not r.admitted:
+            worst = max(worst, profiler.dispatch_count())
+            decode_steps += 1
+    undrained = srv.scheduler.pending_work()
+    retraces = srv.runtime.verify_traces - warm_traces
+    drafted = srv.scheduler.spec_drafted
+    accepted = srv.scheduler.spec_accepted
+    accept_rate = accepted / max(drafted, 1)
+    # warm twin of the cold request, measured alone for the strict
+    # prefill-dispatch comparison
+    warm_out, warm_turns = drain_turns(srv, (src, 8, prompt))
+    in_use_drained = srv.pool.in_use()
+    cache_pages = srv.prefix_cache.pages_held()
+    bad_refs = [p for p in range(1, srv.pool.num_pages)
+                if srv.pool.ref_count(p) not in (0, 1)]
+    srv.close()
+    leaked = srv.pool.in_use()
+
+    if undrained:
+        errors.append("serve fast-path phase did not drain")
+    if decode_steps == 0:
+        errors.append("serve fast-path phase measured no pure decode "
+                      "turns")
+    if worst > 1:
+        errors.append(f"speculative decode budget exceeded: {worst} "
+                      f"dispatches/turn (budget 1)")
+    if retraces:
+        errors.append(f"widened verify executable retraced {retraces}x "
+                      f"across draft-acceptance variation (budget 0)")
+    if accepted <= 0:
+        errors.append("speculative phase accepted no drafted tokens "
+                      "(the zero-retrace budget would be vacuous)")
+    if warm_out != cold_out:
+        errors.append("prefix-cached request output differs from the "
+                      "cold control (bitwise-greedy contract broken)")
+    if not warm_turns < cold_turns:
+        errors.append(f"prefix cache did not reduce prefill dispatches: "
+                      f"warm {warm_turns} vs cold {cold_turns} decode "
+                      f"turns (budget: strictly fewer)")
+    if in_use_drained != cache_pages:
+        errors.append(f"drained fast-path pool holds {in_use_drained} "
+                      f"pages but the cache owns {cache_pages} — "
+                      f"stuck request references")
+    if bad_refs:
+        errors.append(f"pages with refcount > 1 after drain: {bad_refs}")
+    if leaked:
+        errors.append(f"serve fast-path phase leaked {leaked} KV pages "
+                      f"after close()")
+
+    # -- de-optimised control: cache disabled, identical request -------
+    ctrl = build(speculative_k=2, prefix_cache=False)
+    c1_out, c1_turns = drain_turns(ctrl, (src, 8, prompt))
+    c2_out, c2_turns = drain_turns(ctrl, (src, 8, prompt))
+    ctrl.close()
+    if c1_out != cold_out or c2_out != cold_out:
+        errors.append("cache-disabled control output differs (bitwise-"
+                      "greedy contract broken)")
+    if c2_turns < c1_turns:
+        errors.append(f"cache-DISABLED control got faster on repeat "
+                      f"({c2_turns} vs {c1_turns} turns) — the prefix "
+                      f"reduction above proves nothing")
+
+    return {
+        "serve_spec_dispatches_per_turn": worst,
+        "serve_spec_retraces": retraces,
+        "serve_spec_accept_rate": round(accept_rate, 4),
+        "serve_prefix_cold_turns": cold_turns,
+        "serve_prefix_warm_turns": warm_turns,
+        "serve_prefix_nocache_turns": c2_turns,
+        "serve_fastpath_pages_leaked": leaked,
+    }
+
+
 def main(argv=None):
     argv = list(sys.argv[1:] if argv is None else argv)
     steps, budget = DEFAULT_STEPS, DISPATCH_BUDGET
@@ -400,7 +550,12 @@ def main(argv=None):
           f"{res['prefetch_sync_h2d_per_step']} sync H2D/step with the "
           f"device prefetcher; {shard_txt}; "
           f"{res['serve_decode_dispatches_per_step']} dispatch/decode "
-          f"turn, {res['serve_decode_retraces']} retraces serving)",
+          f"turn, {res['serve_decode_retraces']} retraces serving; "
+          f"speculative {res['serve_spec_dispatches_per_turn']} "
+          f"dispatch/turn, {res['serve_spec_retraces']} retraces, "
+          f"accept rate {res['serve_spec_accept_rate']}; prefix warm "
+          f"{res['serve_prefix_warm_turns']} vs cold "
+          f"{res['serve_prefix_cold_turns']} turns)",
           file=sys.stderr)
     return 0
 

@@ -80,6 +80,17 @@ BUDGETS = {
         "collective_total": 0,
         "aliased_inputs": 3,         # donated mem_k / mem_v / mem_vl
     },
+    # ISSUE 12: the WIDENED speculative-verify decode executable
+    # ((slots, k+1) window per turn). Measured 35 fusions / 10 copies on
+    # the pinned toolchain; the copy band additionally guards the
+    # donation path — a widened program that starts materialising its
+    # page pools out of place would show up here first.
+    "serve_verify": {
+        "fusions": (16, 60),
+        "collective_total": 0,
+        "copies": (0, 24),
+        "aliased_inputs": 2,         # donated K/V page pools
+    },
 }
 
 CONTROL_TIMEOUT_S = 240
@@ -106,6 +117,11 @@ def check_budget(name, info, budget=None):
             != budget["collectives"]:
         errors.append(f"{name}: collective mix {info['collectives']} != "
                       f"rule-derived budget {budget['collectives']}")
+    if "copies" in budget:
+        lo, hi = budget["copies"]
+        if not lo <= info["copies"] <= hi:
+            errors.append(f"{name}: copy count {info['copies']} outside "
+                          f"the pinned band [{lo}, {hi}]")
     if "aliased_inputs" in budget \
             and info["aliased_inputs"] != budget["aliased_inputs"]:
         errors.append(f"{name}: {info['aliased_inputs']} donated input(s) "
@@ -200,6 +216,30 @@ def _serve_infos():
     return dec, pre, traces
 
 
+def _serve_verify_info():
+    """Warm a SPECULATIVE server (ISSUE 12: width = k+1 widened verify
+    executable) and return (verify_info, verify_traces)."""
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.models.transformer import TransformerNMT
+
+    mx.random.seed(0)
+    model = TransformerNMT(32, units=16, hidden=32, num_layers=1,
+                           num_heads=2, max_length=32, dropout=0.0)
+    model.initialize()
+    srv = mx.serve.Server(model, slots=3, page_size=4, max_src_len=8,
+                          max_new_tokens=8, max_prompt_len=8,
+                          speculative_k=2, engine_driven=False)
+    rng = np.random.RandomState(0)
+    srv.submit(rng.randint(4, 32, (5,)), max_new_tokens=4,
+               prompt_tokens=rng.randint(4, 32, (6,))).result(timeout=300)
+    info = srv.runtime._verify_fn.last_hlo
+    traces = srv.runtime.verify_traces
+    srv.close()
+    return info, traces
+
+
 def _run_control():
     """Compile the SAME captured step in a subprocess with XLA's fusion
     pass disabled and return its HLO counts — the gate's liveness
@@ -274,6 +314,14 @@ def _run_impl():
                       f"during the warm-up (expected exactly 1 — HLO "
                       f"inspection must not retrace)")
 
+    # -- widened speculative-verify executable (ISSUE 12) --------------
+    ver_info, ver_traces = _serve_verify_info()
+    errors += check_budget("serve_verify", ver_info)
+    if ver_traces != 1:
+        errors.append(f"serve verify executable traced {ver_traces}x "
+                      f"during the warm-up (expected exactly 1 — draft "
+                      f"acceptance variation must not retrace)")
+
     # -- de-fused control: the SAME budget must trip -------------------
     control_fusions = None
     control_tripped = None
@@ -297,6 +345,8 @@ def _run_impl():
         "serve_decode": _strip(dec_info),
         "serve_prefill": _strip(pre_info),
         "serve_decode_traces": dec_traces,
+        "serve_verify": _strip(ver_info),
+        "serve_verify_traces": ver_traces,
         "control_fusions": control_fusions,
         "control_tripped": control_tripped,
         "budgets": BUDGETS,
@@ -332,7 +382,9 @@ def main(argv=None):
     print(f"check_fusion: OK (captured {res['captured']['fusions']} "
           f"fusions / {res['captured']['collective_total']} collectives "
           f"/ {res['captured']['aliased_inputs']} aliased; {shard_txt}; "
-          f"decode {res['serve_decode']['fusions']} fusions; de-fused "
+          f"decode {res['serve_decode']['fusions']} fusions; verify "
+          f"{res['serve_verify']['fusions']} fusions / "
+          f"{res['serve_verify']['copies']} copies; de-fused "
           f"control tripped at {res['control_fusions']} fusions)",
           file=sys.stderr)
     return 0
