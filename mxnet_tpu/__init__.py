@@ -62,6 +62,7 @@ from . import rtc
 from . import monitor
 from . import observability
 from .observability import set_compilation_cache
+from . import analysis
 from . import fault
 from . import profiler
 from . import amp

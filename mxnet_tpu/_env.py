@@ -1,0 +1,117 @@
+"""THE hardened environment-knob parser (ISSUE 13, MXTPU-E01).
+
+Every numeric ``MXTPU_*`` (and launcher ``DMLC_*``) environment read in
+the framework routes through this module — raw ``int(os.environ...)`` /
+``float(os.environ...)`` call sites are a lint error
+(`analysis/astlint.py` rule MXTPU-E01). The discipline exists because
+the same bug class kept recurring: ``int()`` accepts forms the C++
+engine's ``strtol``+endptr parse rejects (``"250 "``, ``"1_0"``), so the
+cpp/python parity pair silently ran with different knob values, and a
+typo'd knob on a fleet launcher crashed every worker at import instead
+of degrading (see CHANGES.md PR 7/PR 10 hardening notes).
+
+Rules, identical across all entry points:
+
+  * strtol/strtod parity — leading C whitespace and an optional sign are
+    accepted, ANYTHING after the number (trailing whitespace included)
+    is malformed; no underscores, no hex, no ``inf``/``nan``;
+  * a malformed or out-of-bounds value falls back to the caller's
+    default with ONE warning per key per process (never an exception —
+    import must survive any environment);
+  * bounds are part of the parse: a value outside ``[minimum, maximum]``
+    is as malformed as ``"fast"``.
+
+`parse_int` / `parse_float` are the strict building blocks (raise
+``ValueError``) for callers where silent defaulting would be wrong —
+e.g. the kvstore cluster spec, where a garbled worker count must fail
+loudly, not train on a default.
+"""
+from __future__ import annotations
+
+import os
+import re
+
+__all__ = ["env_int", "env_float", "env_ms", "parse_int", "parse_float"]
+
+# C strtol discipline: isspace() whitespace, optional sign, decimal
+# digits, endptr at end-of-string (trailing ANYTHING = malformed).
+_INT_RE = re.compile(r"[ \t\n\r\f\v]*[+-]?[0-9]+")
+# strtod subset: decimal forms with optional fraction/exponent; no
+# inf/nan (a knob must be finite), no hex floats, no underscores.
+_FLOAT_RE = re.compile(
+    r"[ \t\n\r\f\v]*[+-]?(?:[0-9]+(?:\.[0-9]*)?|\.[0-9]+)"
+    r"(?:[eE][+-]?[0-9]+)?")
+
+_warned = set()          # keys already warned about (one warning per key)
+
+
+def _warn(key, raw, reason, default):
+    if key in _warned:
+        return
+    _warned.add(key)
+    from .log import get_logger
+    get_logger("mxnet_tpu.env").warning(
+        "ignoring malformed %s=%r (%s); using default %s",
+        key, raw, reason, default)
+
+
+def parse_int(raw, key="value"):
+    """Strict strtol-parity int parse of an already-fetched string;
+    raises ``ValueError`` naming `key` on any malformed form."""
+    if raw is None or not _INT_RE.fullmatch(str(raw)):
+        raise ValueError(f"{key}={raw!r} is not a strtol-parseable "
+                         f"integer")
+    return int(str(raw))
+
+
+def parse_float(raw, key="value"):
+    """Strict strtod-parity finite-float parse; raises ``ValueError``."""
+    if raw is None or not _FLOAT_RE.fullmatch(str(raw)):
+        raise ValueError(f"{key}={raw!r} is not a strtod-parseable "
+                         f"finite float")
+    return float(str(raw))
+
+
+def _bounded(key, raw, value, default, minimum, maximum):
+    if minimum is not None and value < minimum:
+        _warn(key, raw, f"below minimum {minimum}", default)
+        return default
+    if maximum is not None and value > maximum:
+        _warn(key, raw, f"above maximum {maximum}", default)
+        return default
+    return value
+
+
+def env_int(key, default, minimum=None, maximum=None):
+    """``int(os.environ[key])`` with the house rules: strtol parity,
+    bounds, one-warning fallback to `default` (returned verbatim when
+    the key is unset — it may be ``None``)."""
+    raw = os.environ.get(key)
+    if raw is None:
+        return default
+    try:
+        value = parse_int(raw, key)
+    except ValueError as e:
+        _warn(key, raw, str(e), default)
+        return default
+    return _bounded(key, raw, value, default, minimum, maximum)
+
+
+def env_float(key, default, minimum=None, maximum=None):
+    """``float(os.environ[key])`` with the house rules (finite only)."""
+    raw = os.environ.get(key)
+    if raw is None:
+        return default
+    try:
+        value = parse_float(raw, key)
+    except ValueError as e:
+        _warn(key, raw, str(e), default)
+        return default
+    return _bounded(key, raw, value, default, minimum, maximum)
+
+
+def env_ms(key, default):
+    """A millisecond knob: non-negative finite float, same fallback
+    rules (``MXTPU_STEP_TIMEOUT_MS``, ``MXTPU_COLLECTIVE_TIMEOUT_MS``,
+    ... — 0 conventionally disables the feature)."""
+    return env_float(key, default, minimum=0.0)

@@ -1,0 +1,51 @@
+"""Graft-lint: static analysis over the framework's source AND its
+lowered executables (ISSUE 13).
+
+Two coordinated analyzers plus one tier-1 gate:
+
+  * `astlint`   — a framework-aware AST lint over ``mxnet_tpu/`` itself
+    (rules MXTPU-E01..E06, each distilled from a CHANGES.md bug class);
+  * `graphlint` — a structural linter over the abstract-lowered jaxpr +
+    optimized HLO of every compilex-registered executable
+    (rules MXTPU-G01..G05: donation leaks, copies, dead/duplicate
+    collectives, unconstrained shardings, retrace-hazard consts);
+  * `tools/check_static.py` — the gate: zero non-baselined findings at
+    HEAD, a seeded-violation control per rule, a hard runtime ceiling.
+
+Suppression: inline ``# mxtpu: disable=E0x reason`` or an entry in
+tools/static_baseline.json. Rule catalog + workflow:
+docs/STATIC_ANALYSIS.md.
+
+`astlint` is pure stdlib (usable without jax); `graphlint` imports jax
+lazily inside `lint_jit`.
+"""
+from __future__ import annotations
+
+from . import astlint
+from . import graphlint
+from .astlint import (Finding, RULES, apply_baseline, lint_file,
+                      lint_package, lint_source, lint_tree,
+                      load_baseline)
+from .graphlint import (GRAPH_RULES, GraphFinding, apply_graph_baseline,
+                        lint_hlo_texts, lint_jit)
+
+__all__ = ["astlint", "graphlint", "Finding", "GraphFinding", "RULES",
+           "GRAPH_RULES", "lint_source", "lint_file", "lint_tree",
+           "lint_package", "lint_hlo_texts", "lint_jit",
+           "load_baseline", "apply_baseline", "apply_graph_baseline",
+           "report_to_registry"]
+
+
+def report_to_registry(rules_run, findings_total, findings_new,
+                       baseline_size, suppressed=0):
+    """Publish the `[static]` telemetry row (profiler.dumps reads these
+    gauges): rules run, live/new finding counts, baseline size. Called
+    by tools/check_static.py after a gate run so drift is visible in
+    the supervisor contract."""
+    from ..observability import registry as _registry
+    reg = _registry()
+    reg.gauge("static_rules_run").set(int(rules_run))
+    reg.gauge("static_findings", kind="total").set(int(findings_total))
+    reg.gauge("static_findings", kind="new").set(int(findings_new))
+    reg.gauge("static_findings", kind="suppressed").set(int(suppressed))
+    reg.gauge("static_baseline_size").set(int(baseline_size))

@@ -1,0 +1,691 @@
+"""Framework-aware AST lint over ``mxnet_tpu/`` itself (ISSUE 13).
+
+Six rules, each distilled from a bug class that recurred across landing
+passes (the CHANGES.md incident that motivated each is catalogued in
+docs/STATIC_ANALYSIS.md):
+
+  MXTPU-E01  raw ``int()``/``float()`` of an ``os.environ``/``getenv``
+             read — must route through `mxnet_tpu._env` (the PR 7
+             MXTPU_ENGINE_AGING_MS cpp/python parity drift, re-fixed in
+             PR 10 for the retry knobs).
+  MXTPU-E02  host-sync calls (``.asnumpy()``/``.item()``/``.tolist()``/
+             host-numpy ``asarray``/``jax.device_get``) inside an
+             engine-task body or a traced function — a silent
+             host/device round-trip in the exact scopes where one
+             dispatch per step is the contract.
+  MXTPU-E03  a ``Counter``/``Gauge``/``Histogram`` instantiated directly
+             instead of through the ``metrics_registry`` memo (PR 10
+             dropped three hand-kept counter-memo dicts; a direct
+             instance forks the series from its registry twin).
+  MXTPU-E04  a bare ``except:`` / ``except BaseException`` in
+             engine/serve code whose body never re-raises — it swallows
+             cancellation/preemption (the PR 7 parity helpers exist to
+             re-raise these).
+  MXTPU-E05  a fault point fired (``_finj.check("x.y")``) with no
+             degradation path in sight — no enclosing ``try`` and no
+             evidence the enclosing function runs under a retry/deadline
+             wrapper (every PR 3/6/10 fault point ships one).
+  MXTPU-E06  wall-clock / RNG nondeterminism (``time.time()``, module
+             ``random``, ``np.random``) inside traced code — it bakes
+             one trace-time value into the executable and breaks
+             bitwise replay (the PR 10 rollback contract).
+
+Every rule supports inline suppression::
+
+    risky_line()   # mxtpu: disable=E05 degradation is at the call site
+
+and a checked-in baseline (tools/static_baseline.json) so pre-existing
+ACCEPTED findings don't block the `check_static` gate while new ones do.
+A finding's baseline fingerprint is (rule, path, scope, stripped source
+line) — stable across unrelated line drift.
+
+Pure stdlib; `lint_source` works on any source string so the gate's
+seeded-violation controls and the tests feed it fixtures directly.
+"""
+from __future__ import annotations
+
+import ast
+import json
+import os
+from dataclasses import dataclass, field
+
+__all__ = ["Finding", "RULES", "lint_source", "lint_file", "lint_tree",
+           "lint_package", "load_baseline", "apply_baseline",
+           "package_root"]
+
+RULES = {
+    "MXTPU-E01": "raw numeric os.environ/getenv parse (use mxnet_tpu._env)",
+    "MXTPU-E02": "host sync inside an engine-task or traced function",
+    "MXTPU-E03": "metric instantiated outside the metrics_registry memo",
+    "MXTPU-E04": "except swallows BaseException (cancellation) without "
+                 "re-raise",
+    "MXTPU-E05": "fault point fired with no visible degradation/retry "
+                 "path",
+    "MXTPU-E06": "wall-clock/RNG nondeterminism inside traced code",
+}
+
+# host-sync attribute calls (E02); zero-arg device->host pulls
+_HOST_SYNC_ATTRS = ("asnumpy", "item", "tolist")
+# numpy-module aliases whose .asarray/.array on a device value is a sync
+_NUMPY_NAMES = ("numpy", "np", "onp", "_np")
+# modules whose import binds a "random source" name (E06)
+_TIME_FNS = ("time", "time_ns", "monotonic", "perf_counter",
+             "monotonic_ns", "perf_counter_ns")
+_DATETIME_FNS = ("now", "utcnow", "today")
+# retry/degradation wrappers (E05): a function whose NAME is referenced
+# inside any argument of a call to one of these has a degradation path
+_RETRY_WRAPPERS = ("call", "retry_call", "_deadline_call", "wrap")
+# engine/serve modules where E04 applies wholesale (elsewhere it applies
+# only inside engine-task scopes)
+_E04_MODULES = ("engine.py", "_engine_common.py")
+_E04_DIRS = ("serve",)
+
+
+@dataclass
+class Finding:
+    rule: str
+    path: str            # repo-relative posix path
+    line: int
+    col: int
+    scope: str           # dotted enclosing class/function qualname
+    message: str
+    snippet: str         # stripped source line (fingerprint component)
+    suppressed: bool = False
+    baselined: bool = False
+
+    @property
+    def fingerprint(self):
+        return (self.rule, self.path, self.scope, self.snippet)
+
+    def to_dict(self):
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "scope": self.scope, "message": self.message,
+                "snippet": self.snippet}
+
+    def __str__(self):
+        return (f"{self.path}:{self.line}:{self.col}: {self.rule} "
+                f"[{self.scope or '<module>'}] {self.message}")
+
+
+# ------------------------------------------------------------ suppression
+def _suppressed_rules(lines, lineno):
+    """Rule ids disabled on `lineno` (1-based): an inline
+    ``# mxtpu: disable=E01,E05 ...`` on the line itself or on a
+    comment-only line directly above."""
+    out = set()
+    for cand in (lineno, lineno - 1):
+        if not 1 <= cand <= len(lines):
+            continue
+        text = lines[cand - 1]
+        if cand != lineno and not text.lstrip().startswith("#"):
+            continue
+        marker = "mxtpu: disable="
+        idx = text.find(marker)
+        if idx < 0 or "#" not in text[:idx]:
+            continue
+        spec = text[idx + len(marker):].split()[0] if \
+            text[idx + len(marker):].split() else ""
+        for tok in spec.split(","):
+            tok = tok.strip().upper()
+            if not tok:
+                continue
+            if not tok.startswith("MXTPU-"):
+                tok = "MXTPU-" + tok
+            out.add(tok)
+    return out
+
+
+# ---------------------------------------------------------------- helpers
+def _dotted(node):
+    """'a.b.c' for a Name/Attribute chain, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _contains(node, pred):
+    return any(pred(n) for n in ast.walk(node))
+
+
+class _Scope:
+    __slots__ = ("node", "name", "defs", "hot")
+
+    def __init__(self, node, name):
+        self.node = node
+        self.name = name          # qualname component ("" for module)
+        self.defs = {}            # local name -> FunctionDef/Lambda node
+        self.hot = None           # "traced" | "engine_task" | None
+
+
+class _Linter(ast.NodeVisitor):
+    """One pass to build scopes + collect deferred facts, then a second
+    resolution pass marks hot scopes and emits findings."""
+
+    def __init__(self, src, path, relpath):
+        self.lines = src.splitlines()
+        self.path = relpath
+        self.base = os.path.basename(path)
+        self.in_serve = any(d in relpath.replace("\\", "/").split("/")
+                            for d in _E04_DIRS)
+        self.findings = []
+        self.tree = ast.parse(src)
+        # module-level import aliases
+        self.os_names = set()          # names bound to the os module
+        self.environ_names = set()     # names bound to os.environ
+        self.getenv_names = set()      # names bound to os.getenv
+        self.time_names = set()        # names bound to the time module
+        self.random_names = set()      # names bound to the random module
+        self.np_names = set(_NUMPY_NAMES)
+        self.datetime_names = set()    # datetime module or class
+        self.jax_names = set()
+        self.registry_classes = set()  # Counter/... imported from
+                                       # metrics_registry
+        self.registry_mods = set()     # aliases of the metrics_registry
+                                       # module itself
+        self.is_registry_module = self.base == "metrics_registry.py"
+        self.is_env_module = relpath.replace("\\", "/").endswith(
+            "mxnet_tpu/_env.py")
+        # deferred hot-scope requests: (scopes tuple, fn name, kind)
+        self._hot_requests = []
+        # names referenced inside retry-wrapper call args (E05 evidence)
+        self.retried_names = set()
+        # per-scope env-assigned local names: {scope node: {name}}
+        self._env_locals = {}
+        self._scopes = []              # stack of _Scope
+        self._all_scopes = []
+        self._node_scope = {}          # id(node) -> tuple of _Scope stack
+
+    # ------------------------------------------------------ import walk
+    def _collect_imports(self):
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    bind = a.asname or a.name.split(".")[0]
+                    if a.name == "os":
+                        self.os_names.add(bind)
+                    elif a.name == "time":
+                        self.time_names.add(bind)
+                    elif a.name == "random":
+                        self.random_names.add(bind)
+                    elif a.name == "numpy":
+                        self.np_names.add(a.asname or "numpy")
+                    elif a.name == "datetime":
+                        self.datetime_names.add(bind)
+                    elif a.name == "jax":
+                        self.jax_names.add(bind)
+            elif isinstance(node, ast.ImportFrom):
+                mod = node.module or ""
+                for a in node.names:
+                    bind = a.asname or a.name
+                    if mod == "os":
+                        if a.name == "environ":
+                            self.environ_names.add(bind)
+                        elif a.name == "getenv":
+                            self.getenv_names.add(bind)
+                    elif mod == "datetime" and a.name == "datetime":
+                        self.datetime_names.add(bind)
+                    elif mod.endswith("metrics_registry") \
+                            or mod == "observability":
+                        if a.name in ("Counter", "Gauge", "Histogram"):
+                            self.registry_classes.add(bind)
+                    if a.name == "metrics_registry":
+                        self.registry_mods.add(bind)
+
+    # ------------------------------------------------------------- emit
+    def _emit(self, rule, node, message):
+        lineno = getattr(node, "lineno", 1)
+        col = getattr(node, "col_offset", 0)
+        snippet = self.lines[lineno - 1].strip() if \
+            1 <= lineno <= len(self.lines) else ""
+        scope = ".".join(s.name for s in self._node_scope.get(
+            id(node), ()) if s.name)
+        f = Finding(rule, self.path, lineno, col, scope, message, snippet)
+        if rule in _suppressed_rules(self.lines, lineno):
+            f.suppressed = True
+        self.findings.append(f)
+
+    # --------------------------------------------------------- the walk
+    def run(self):
+        self._collect_imports()
+        self._scopes = [_Scope(self.tree, "")]
+        self._all_scopes = [self._scopes[0]]
+        self._walk(self.tree, parents=())
+        self._resolve_hot()
+        self._second_pass()
+        return self.findings
+
+    def _walk(self, node, parents):
+        """Scope-tracking walk: records each node's scope stack, local
+        defs, jit/push/retry call facts, and env-assigned locals."""
+        for child in ast.iter_child_nodes(node):
+            self._node_scope[id(child)] = tuple(self._scopes)
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._scopes[-1].defs[child.name] = child
+                sc = _Scope(child, child.name)
+                if self._decorated_jit(child):
+                    sc.hot = "traced"
+                if child.name == "hybrid_forward":
+                    sc.hot = "traced"     # _TraceContext traces these
+                self._scopes.append(sc)
+                self._all_scopes.append(sc)
+                self._walk(child, parents + (node,))
+                self._scopes.pop()
+            elif isinstance(child, ast.ClassDef):
+                sc = _Scope(child, child.name)
+                self._scopes.append(sc)
+                self._all_scopes.append(sc)
+                self._walk(child, parents + (node,))
+                self._scopes.pop()
+            elif isinstance(child, ast.Lambda):
+                sc = _Scope(child, "<lambda>")
+                self._scopes.append(sc)
+                self._all_scopes.append(sc)
+                self._walk(child, parents + (node,))
+                self._scopes.pop()
+            else:
+                if isinstance(child, ast.Call):
+                    self._note_call(child)
+                if isinstance(child, ast.Assign):
+                    self._note_assign(child)
+                self._walk(child, parents + (node,))
+
+    def _decorated_jit(self, fn):
+        for dec in fn.decorator_list:
+            target = dec.func if isinstance(dec, ast.Call) else dec
+            d = _dotted(target)
+            if d in ("jit", "jax.jit") or (d == "partial" and isinstance(
+                    dec, ast.Call) and any(
+                    _dotted(a) in ("jit", "jax.jit") for a in dec.args)):
+                return True
+            # functools.partial(jax.jit, ...) used as decorator factory
+            if d and d.endswith(".partial") and isinstance(dec, ast.Call) \
+                    and any(_dotted(a) in ("jit", "jax.jit")
+                            for a in dec.args):
+                return True
+        return False
+
+    def _note_call(self, call):
+        d = _dotted(call.func)
+        # jax.jit(fn, ...) / jit(fn, ...): first positional arg is traced
+        if d in ("jit", "jax.jit") or (
+                d and d.split(".")[-1] == "jit"
+                and d.split(".")[0] in self.jax_names):
+            self._mark_arg_hot(call, "traced")
+        # <x>.push(fn, ...) / push(fn, ...): fn becomes an engine task
+        if d and d.split(".")[-1] == "push" or (
+                isinstance(call.func, ast.Attribute)
+                and call.func.attr == "push"):
+            self._mark_arg_hot(call, "engine_task")
+        # retry/degradation wrappers: any name referenced inside the
+        # args has a degradation path (E05 evidence)
+        fn_name = (call.func.attr if isinstance(call.func, ast.Attribute)
+                   else call.func.id if isinstance(call.func, ast.Name)
+                   else None)
+        if fn_name in _RETRY_WRAPPERS:
+            for arg in list(call.args) + [kw.value for kw in
+                                          call.keywords]:
+                for n in ast.walk(arg):
+                    if isinstance(n, ast.Name):
+                        self.retried_names.add(n.id)
+                    elif isinstance(n, ast.Attribute):
+                        self.retried_names.add(n.attr)
+
+    def _mark_arg_hot(self, call, kind):
+        if not call.args:
+            return
+        arg = call.args[0]
+        if isinstance(arg, ast.Lambda):
+            # the lambda's scope gets created when we descend into it;
+            # defer by node identity
+            self._hot_requests.append((tuple(self._scopes), arg, kind))
+        elif isinstance(arg, ast.Name):
+            self._hot_requests.append((tuple(self._scopes), arg.id, kind))
+        elif isinstance(arg, ast.Attribute):
+            self._hot_requests.append((tuple(self._scopes), arg.attr,
+                                       kind))
+
+    def _note_assign(self, assign):
+        """name = <env read> inside the current scope (E01 dataflow)."""
+        if not _contains(assign.value, self._is_env_read):
+            return
+        scope_node = self._scopes[-1].node
+        names = self._env_locals.setdefault(id(scope_node), set())
+        for tgt in assign.targets:
+            if isinstance(tgt, ast.Name):
+                names.add(tgt.id)
+            elif isinstance(tgt, (ast.Tuple, ast.List)):
+                for e in tgt.elts:
+                    if isinstance(e, ast.Name):
+                        names.add(e.id)
+
+    # -------------------------------------------------- hot resolution
+    def _resolve_hot(self):
+        by_node = {id(s.node): s for s in self._all_scopes}
+        for scopes, target, kind in self._hot_requests:
+            if isinstance(target, ast.AST):        # a lambda literal
+                sc = by_node.get(id(target))
+                if sc is not None and sc.hot is None:
+                    sc.hot = kind
+                continue
+            # look the name up innermost-first in the recorded stack
+            for s in reversed(scopes):
+                fn = s.defs.get(target)
+                if fn is not None:
+                    sc = by_node.get(id(fn))
+                    if sc is not None and sc.hot is None:
+                        sc.hot = kind
+                    break
+
+    def _hot_kind(self, node):
+        """The hot kind of `node`'s scope chain (innermost wins;
+        nested defs inherit)."""
+        for s in reversed(self._node_scope.get(id(node), ())):
+            if s.hot:
+                return s.hot
+        return None
+
+    def _enclosing_function(self, node):
+        for s in reversed(self._node_scope.get(id(node), ())):
+            if isinstance(s.node, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                return s
+        return None
+
+    # --------------------------------------------------- second pass
+    def _second_pass(self):
+        in_try = []      # stack depth bookkeeping done via parent map
+        parents = {}
+        for n in ast.walk(self.tree):
+            for c in ast.iter_child_nodes(n):
+                parents[id(c)] = n
+        self._parents = parents
+
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Call):
+                self._check_E01(node)
+                self._check_E02(node)
+                self._check_E03(node)
+                self._check_E05(node)
+                self._check_E06(node)
+            elif isinstance(node, ast.ExceptHandler):
+                self._check_E04(node)
+
+    def _ancestors(self, node):
+        n = self._parents.get(id(node))
+        while n is not None:
+            yield n
+            n = self._parents.get(id(n))
+
+    # ---------------------------------------------------------- E01
+    def _is_env_read(self, n):
+        if isinstance(n, ast.Subscript):
+            d = _dotted(n.value)
+            return d is not None and (
+                d.split(".")[-1] == "environ"
+                and (len(d.split(".")) == 1 and d in self.environ_names
+                     or d.split(".")[0] in self.os_names
+                     or d.endswith(".environ")))
+        if isinstance(n, ast.Call):
+            d = _dotted(n.func)
+            if d is None:
+                return False
+            parts = d.split(".")
+            if parts[-1] == "getenv":
+                return (len(parts) == 1 and d in self.getenv_names) \
+                    or parts[0] in self.os_names or len(parts) > 1
+            if parts[-1] == "get" and len(parts) >= 2 \
+                    and parts[-2] == "environ":
+                return True
+            if parts[-1] == "get" and parts[0] in self.environ_names \
+                    and len(parts) == 2:
+                return True
+        if isinstance(n, ast.Name):
+            return n.id in self.environ_names
+        return False
+
+    def _check_E01(self, call):
+        if self.is_env_module:
+            return
+        if not isinstance(call.func, ast.Name) \
+                or call.func.id not in ("int", "float"):
+            return
+        direct = any(_contains(a, self._is_env_read) for a in call.args)
+        viaflow = False
+        if not direct:
+            # local dataflow: int(x) where x was assigned from an env
+            # read in the same scope (or the module scope)
+            candidates = set()
+            for s in self._node_scope.get(id(call), ()):
+                candidates |= self._env_locals.get(id(s.node), set())
+            viaflow = any(isinstance(a, ast.Name) and a.id in candidates
+                          for a in call.args)
+        if direct or viaflow:
+            self._emit("MXTPU-E01", call,
+                       "numeric env parse bypasses mxnet_tpu._env "
+                       "(strtol parity + one-warning fallback)")
+
+    # ---------------------------------------------------------- E02
+    def _check_E02(self, call):
+        kind = self._hot_kind(call)
+        if kind is None:
+            return
+        f = call.func
+        if isinstance(f, ast.Attribute):
+            if f.attr in _HOST_SYNC_ATTRS and not call.args:
+                self._emit("MXTPU-E02", call,
+                           f".{f.attr}() host sync inside "
+                           f"{'an engine task' if kind == 'engine_task' else 'traced code'}")
+                return
+            d = _dotted(f)
+            if d and f.attr in ("asarray", "array"):
+                head = d.split(".")[0]
+                leaf_mod = d.split(".")[-2] if len(d.split(".")) > 1 \
+                    else head
+                if head in self.np_names or leaf_mod in _NUMPY_NAMES:
+                    self._emit("MXTPU-E02", call,
+                               f"host-numpy {d}() materialises a device "
+                               f"value inside "
+                               f"{'an engine task' if kind == 'engine_task' else 'traced code'}")
+                    return
+            if d and d.split(".")[-1] == "device_get" \
+                    and d.split(".")[0] in (self.jax_names or {"jax"}):
+                self._emit("MXTPU-E02", call,
+                           "jax.device_get host sync in hot path")
+
+    # ---------------------------------------------------------- E03
+    def _check_E03(self, call):
+        if self.is_registry_module:
+            return
+        f = call.func
+        if isinstance(f, ast.Name) and f.id in self.registry_classes:
+            self._emit("MXTPU-E03", call,
+                       f"{f.id}(...) bypasses the metrics_registry memo "
+                       f"(forks the series from its registry twin)")
+        elif isinstance(f, ast.Attribute) \
+                and f.attr in ("Counter", "Gauge", "Histogram"):
+            d = _dotted(f)
+            if d and (d.split(".")[0] in self.registry_mods
+                      or ".metrics_registry." in "." + d + "."):
+                self._emit("MXTPU-E03", call,
+                           f"{d}(...) bypasses the metrics_registry memo")
+
+    # ---------------------------------------------------------- E04
+    def _check_E04(self, handler):
+        applies = (self.base in _E04_MODULES or self.in_serve
+                   or self._hot_kind(handler) == "engine_task")
+        if not applies:
+            return
+        t = handler.type
+        catches_base = t is None or (
+            isinstance(t, ast.Name) and t.id == "BaseException") or (
+            isinstance(t, ast.Tuple) and any(
+                isinstance(e, ast.Name) and e.id == "BaseException"
+                for e in t.elts))
+        if not catches_base:
+            return
+        for n in ast.walk(ast.Module(body=handler.body,
+                                     type_ignores=[])):
+            if isinstance(n, ast.Raise):
+                return
+            if isinstance(n, ast.Call):
+                d = _dotted(n.func)
+                leaf = d.split(".")[-1] if d else ""
+                if "reraise" in leaf:
+                    return        # the PR 7 parity helper re-raises
+                if leaf in ("set_exception", "_set_exc"):
+                    return        # stored into a future — the waiter
+                                  # re-raises it; nothing is swallowed
+        # an EARLIER sibling handler that re-raises KeyboardInterrupt/
+        # SystemExit already lets cancellation escape this try
+        parent = self._parents.get(id(handler))
+        if isinstance(parent, ast.Try):
+            for sib in parent.handlers:
+                if sib is handler:
+                    break
+                names = {e.id for e in ast.walk(sib.type or ast.Pass())
+                         if isinstance(e, ast.Name)}
+                if names & {"KeyboardInterrupt", "SystemExit"} and any(
+                        isinstance(n, ast.Raise) for b in sib.body
+                        for n in ast.walk(b)):
+                    return
+        self._emit("MXTPU-E04", handler,
+                   "handler catches BaseException (cancellation/"
+                   "preemption) and never re-raises")
+
+    # ---------------------------------------------------------- E05
+    def _check_E05(self, call):
+        f = call.func
+        if not (isinstance(f, ast.Attribute) and f.attr == "check"):
+            return
+        if not (call.args and isinstance(call.args[0], ast.Constant)
+                and isinstance(call.args[0].value, str)
+                and "." in call.args[0].value):
+            return
+        point = call.args[0].value
+        # lexically inside a try with handlers?
+        for anc in self._ancestors(call):
+            if isinstance(anc, ast.Try) and anc.handlers:
+                return
+        # enclosing function referenced in a retry/deadline wrapper?
+        fn = self._enclosing_function(call)
+        if fn is not None and fn.name in self.retried_names:
+            return
+        self._emit("MXTPU-E05", call,
+                   f"fault point {point!r} fired with no enclosing try "
+                   f"and no retry/deadline wrapper in sight — a fault "
+                   f"here has no degradation path")
+
+    # ---------------------------------------------------------- E06
+    def _check_E06(self, call):
+        if self._hot_kind(call) != "traced":
+            return
+        d = _dotted(call.func)
+        if d is None:
+            return
+        parts = d.split(".")
+        head, leaf = parts[0], parts[-1]
+        bad = None
+        if head in self.time_names and leaf in _TIME_FNS:
+            bad = f"{d}() wall clock"
+        elif head in self.datetime_names and leaf in _DATETIME_FNS:
+            bad = f"{d}() wall clock"
+        elif head in self.random_names and len(parts) == 2:
+            bad = f"module-RNG {d}()"
+        elif len(parts) >= 3 and head in self.np_names \
+                and parts[1] == "random":
+            bad = f"global-np-RNG {d}()"
+        if bad:
+            self._emit("MXTPU-E06", call,
+                       f"{bad} inside traced code bakes a trace-time "
+                       f"value into the executable (breaks bitwise "
+                       f"replay)")
+
+
+# -------------------------------------------------------------- front end
+def package_root():
+    """The mxnet_tpu package directory this module ships in."""
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _relpath(path, root):
+    root_parent = os.path.dirname(os.path.abspath(root))
+    return os.path.relpath(os.path.abspath(path),
+                           root_parent).replace(os.sep, "/")
+
+
+def lint_source(src, path="<string>", relpath=None):
+    """Lint one source string; returns ALL findings (including
+    suppressed ones, marked ``suppressed=True``)."""
+    return _Linter(src, path, relpath or path).run()
+
+
+def lint_file(path, root=None):
+    with open(path, "r", encoding="utf-8") as f:
+        src = f.read()
+    rel = _relpath(path, root) if root else os.path.basename(path)
+    return lint_source(src, path, rel)
+
+
+def lint_tree(root):
+    """Lint every ``*.py`` under `root` (skipping __pycache__);
+    returns (findings, files_scanned)."""
+    findings, scanned = [], 0
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in sorted(dirnames)
+                       if d != "__pycache__"]
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            scanned += 1
+            findings.extend(lint_file(os.path.join(dirpath, fn),
+                                      root=root))
+    return findings, scanned
+
+
+def lint_package():
+    """Lint the installed mxnet_tpu package itself."""
+    return lint_tree(package_root())
+
+
+# ---------------------------------------------------------------- baseline
+def load_baseline(path):
+    """The checked-in baseline: {"ast": [entry...], "graph": [entry...]}.
+    A missing file is an empty baseline."""
+    if not path or not os.path.exists(path):
+        return {"ast": [], "graph": []}
+    with open(path, "r", encoding="utf-8") as f:
+        data = json.load(f)
+    data.setdefault("ast", [])
+    data.setdefault("graph", [])
+    return data
+
+
+def apply_baseline(findings, baseline_entries):
+    """Split live findings against the baseline. An entry
+    {rule, path, scope, snippet, why} suppresses every finding with the
+    same fingerprint (marked ``baselined=True``). Returns
+    (new_findings, baselined_findings, stale_entries) — stale entries
+    matched nothing and should be pruned."""
+    index = {}
+    for e in baseline_entries:
+        index[(e["rule"], e["path"], e.get("scope", ""),
+               e.get("snippet", ""))] = e
+    used = set()
+    new, matched = [], []
+    for f in findings:
+        if f.suppressed:
+            continue
+        e = index.get(f.fingerprint)
+        if e is not None:
+            f.baselined = True
+            used.add(id(e))
+            matched.append(f)
+        else:
+            new.append(f)
+    stale = [e for e in baseline_entries if id(e) not in used]
+    return new, matched, stale

@@ -1,0 +1,388 @@
+"""Structural linter over the lowered jaxpr + optimized HLO of the
+framework's jitted executables (ISSUE 13 — the graph half of
+graft-lint).
+
+Where `astlint` reads the framework's *source*, this module reads what
+the framework actually *ships to the accelerator*: for each
+compilex-registered executable it AOT-traces against abstract avals
+(the jaxpr re-trace is cached, so traced python does NOT re-run and
+``decode_traces``-style pins hold — the PR 11 inspection discipline)
+and checks the structure XLA-level speed depends on:
+
+  MXTPU-G01  donation leak — an input leaf the framework donated
+             (``args_info.donated``) that the compiled module does NOT
+             alias to an output (``input_output_alias``): XLA copies
+             the update path out of place instead of updating in place,
+             exactly the regression class check_fusion's alias counts
+             were added to catch, now attributed per executable.
+  MXTPU-G02  copies above the executable's allowance, each attributed
+             back to its source op via HLO metadata ``op_name`` — a
+             rising copy count with a named source beats a bare number.
+  MXTPU-G03  dead or duplicate collectives — a collective whose result
+             feeds nothing (dead weight XLA kept), or two collectives
+             with identical (op, shape, operands, groups): both burn
+             interconnect for nothing.
+  MXTPU-G04  unconstrained sharding — in a program where at least one
+             input carries an ``mhlo.sharding`` annotation (a ShardPlan
+             is in force), another input above `min_shard_bytes` with
+             NO annotation: GSPMD is free to replicate it.
+  MXTPU-G05  retrace hazard — a closure-captured SCALAR constant with a
+             strong (non-weak) dtype in the jaxpr consts: the value is
+             baked into the trace, so the next different value means a
+             full re-trace + re-compile (the PR 4 weak-typed-args
+             discipline).
+
+The text analyzers (`find_*`) are pure functions over HLO / StableHLO
+text so `tools/check_static.py`'s seeded-violation controls and the
+tests can feed them synthetic modules; `lint_jit` wires them to a live
+jitted callable. Baseline/suppression semantics are shared with astlint
+through the same tools/static_baseline.json ("graph" section; an entry
+is {rule, executable, key, why}).
+"""
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+
+__all__ = ["GraphFinding", "GRAPH_RULES", "find_copies",
+           "find_dead_or_dup_collectives", "find_unconstrained_args",
+           "find_strong_scalar_consts", "find_donation_leaks",
+           "lint_hlo_texts", "lint_jit", "lint_instrumented",
+           "apply_graph_baseline"]
+
+GRAPH_RULES = {
+    "MXTPU-G01": "donated input not aliased in input_output_alias",
+    "MXTPU-G02": "copies above allowance (attributed to source ops)",
+    "MXTPU-G03": "dead or duplicate collective",
+    "MXTPU-G04": "unconstrained sharding on a large input under a plan",
+    "MXTPU-G05": "strong-typed scalar closure constant (retrace hazard)",
+}
+
+# collective opcodes (async -start forms count as the op; -done halves
+# are the completion marker, not a second collective)
+_COLLECTIVES = ("all-reduce", "reduce-scatter", "all-gather",
+                "all-to-all", "collective-permute")
+
+
+@dataclass
+class GraphFinding:
+    rule: str
+    executable: str
+    key: str             # stable detail fingerprint component
+    message: str
+    baselined: bool = False
+
+    @property
+    def fingerprint(self):
+        return (self.rule, self.executable, self.key)
+
+    def to_dict(self):
+        return {"rule": self.rule, "executable": self.executable,
+                "key": self.key, "message": self.message}
+
+    def __str__(self):
+        return f"{self.executable}: {self.rule} [{self.key}] " \
+               f"{self.message}"
+
+
+# -------------------------------------------------------- HLO text parse
+# one optimized-HLO instruction: optional ROOT, %name = <shape> op(args)
+_INSTR_RE = re.compile(
+    r"^\s*(?:ROOT\s+)?%?([\w.\-]+)\s*=\s*[^=]*?\s([a-z][a-z0-9\-]*)"
+    r"\((.*)$")
+_OPERAND_RE = re.compile(r"%([\w.\-]+)")
+_METADATA_RE = re.compile(r'op_name="([^"]*)"')
+
+
+def _instructions(hlo_text):
+    """Yield (result, opcode, operand names, rest-of-line, is_root) for
+    every instruction line of an optimized-HLO module text."""
+    for line in hlo_text.splitlines():
+        m = _INSTR_RE.match(line)
+        if not m:
+            continue
+        result, opcode, rest = m.groups()
+        # operands live before the first "), " attr break; %-names in
+        # attrs (e.g. calls=%fused_computation) would inflate usage, so
+        # split at the closing paren of the operand list
+        depth, end = 1, len(rest)
+        for i, ch in enumerate(rest):
+            if ch == "(":
+                depth += 1
+            elif ch == ")":
+                depth -= 1
+                if depth == 0:
+                    end = i
+                    break
+        operands = _OPERAND_RE.findall(rest[:end])
+        yield (result, opcode, operands, rest[end:],
+               line.lstrip().startswith("ROOT"))
+
+
+def find_copies(hlo_text):
+    """[(source op_name or '<unattributed>', count)] for every
+    copy/copy-start in the module, largest first."""
+    sources = {}
+    for _, opcode, _, rest, _ in _instructions(hlo_text):
+        if opcode not in ("copy", "copy-start"):
+            continue
+        m = _METADATA_RE.search(rest)
+        src = m.group(1) if m else "<unattributed>"
+        sources[src] = sources.get(src, 0) + 1
+    return sorted(sources.items(), key=lambda kv: -kv[1])
+
+
+def _references(hlo_text, result):
+    """Occurrences of %result in the module BEYOND its definition —
+    robust to instruction lines the structured parse can't handle (the
+    ROOT tuple of a big module overflows any line regex)."""
+    pat = re.compile(r"%" + re.escape(result) + r"(?![\w.\-])")
+    return len(pat.findall(hlo_text)) - 1
+
+
+def find_dead_or_dup_collectives(hlo_text):
+    """[{kind: 'dead'|'duplicate', op, result, detail}] over the module.
+    Dead: the collective's result is referenced nowhere beyond its
+    definition (whole-text occurrence count, so consumers on lines the
+    instruction parse skips still count) and is not ROOT. Duplicate:
+    identical (op, operands, replica_groups, dimensions) pairs."""
+    colls = []      # (result, op, key, is_root)
+    for result, opcode, operands, rest, is_root in _instructions(
+            hlo_text):
+        base = opcode[:-6] if opcode.endswith("-start") else opcode
+        if base in _COLLECTIVES:
+            groups = ""
+            mg = re.search(r"replica_groups=({[^}]*}|\S+)", rest)
+            if mg:
+                groups = mg.group(1)
+            dims = ""
+            md = re.search(r"dimensions={[^}]*}", rest)
+            if md:
+                dims = md.group(0)
+            key = (base, tuple(sorted(operands)), groups, dims)
+            colls.append((result, base, key, is_root))
+    out = []
+    seen = {}
+    for result, op, key, is_root in colls:
+        if not is_root and _references(hlo_text, result) == 0:
+            out.append({"kind": "dead", "op": op, "result": result,
+                        "detail": f"result %{result} feeds nothing"})
+        first = seen.get(key)
+        if first is not None:
+            out.append({"kind": "duplicate", "op": op, "result": result,
+                        "detail": f"identical to %{first} "
+                                  f"(same operands/groups)"})
+        else:
+            seen[key] = result
+    return out
+
+
+# StableHLO entry arguments: %argN: tensor<2x3xf32> {attrs}
+_ARG_RE = re.compile(
+    r"%arg(\d+):\s*tensor<([0-9x]*?)x?(f64|f32|f16|bf16|i64|i32|i16|i8|"
+    r"u64|u32|u16|u8|i1)>\s*(\{[^}]*\})?")
+_DTYPE_BYTES = {"f64": 8, "i64": 8, "u64": 8, "f32": 4, "i32": 4,
+                "u32": 4, "f16": 2, "bf16": 2, "i16": 2, "u16": 2,
+                "i8": 1, "u8": 1, "i1": 1}
+
+
+def find_unconstrained_args(stablehlo_text, min_bytes=1024):
+    """Under a plan, the args above `min_bytes` with NO sharding
+    annotation: [(argnum, bytes)]. "Under a plan" means at least one
+    arg carries a real GSPMD tile assignment (``devices=[...]``) — a
+    ``maximal`` (single-device commit) or absent annotation does not
+    put the program under a plan, and an explicit ``replicated``
+    annotation on an arg is a constrained choice, not a finding."""
+    # only the PUBLIC entry signature: private helper funcs also bind
+    # %arg0..., annotation-free, and must not count as entry inputs
+    start = stablehlo_text.find("func.func public @main(")
+    if start >= 0:
+        open_i = stablehlo_text.index("(", start)
+        depth, end_i = 0, len(stablehlo_text)
+        for i in range(open_i, len(stablehlo_text)):
+            ch = stablehlo_text[i]
+            if ch == "(":
+                depth += 1
+            elif ch == ")":
+                depth -= 1
+                if depth == 0:
+                    end_i = i
+                    break
+        stablehlo_text = stablehlo_text[open_i:end_i]
+    args = []
+    any_planned = False
+    for m in _ARG_RE.finditer(stablehlo_text):
+        argnum, dims, dtype, attrs = m.groups()
+        n = 1
+        for d in (dims.split("x") if dims else []):
+            if d:
+                n *= int(d)
+        nbytes = n * _DTYPE_BYTES.get(dtype, 4)
+        attrs = attrs or ""
+        constrained = "mhlo.sharding" in attrs
+        if constrained and "devices=[" in attrs:
+            any_planned = True
+        args.append((int(argnum), nbytes, constrained))
+    if not any_planned:
+        return []
+    return [(a, b) for a, b, constrained in args
+            if not constrained and b >= min_bytes]
+
+
+def find_strong_scalar_consts(jaxpr):
+    """Scalar (size-1) consts with a strong (non-weak) inexact/integer
+    dtype in a ClosedJaxpr — the value is baked into the trace:
+    [(index, dtype, shape)]."""
+    out = []
+    consts = getattr(jaxpr, "consts", ())
+    cvars = getattr(getattr(jaxpr, "jaxpr", None), "constvars", ())
+    for i, (c, v) in enumerate(zip(consts, cvars)):
+        aval = getattr(v, "aval", None)
+        shape = tuple(getattr(aval, "shape", getattr(c, "shape", ())))
+        size = 1
+        for d in shape:
+            size *= d
+        if size != 1:
+            continue
+        dtype = getattr(aval, "dtype", getattr(c, "dtype", None))
+        if dtype is None or str(dtype) == "bool":
+            continue
+        if not getattr(aval, "weak_type", False):
+            out.append((i, str(dtype), shape))
+    return out
+
+
+def find_donation_leaks(args_info, optimized_text):
+    """(donated_leaves, aliased_count): how many input leaves were
+    donated vs how many the compiled module aliases in place. A
+    shortfall is the G01 finding."""
+    import jax
+
+    leaves = jax.tree_util.tree_leaves(
+        args_info, is_leaf=lambda x: hasattr(x, "donated"))
+    donated = sum(1 for a in leaves if getattr(a, "donated", False))
+    aliased = optimized_text.count("may-alias") \
+        + optimized_text.count("must-alias")
+    return donated, aliased
+
+
+# ------------------------------------------------------------- the linter
+def lint_hlo_texts(executable, optimized_text, stablehlo_text=None,
+                   jaxpr=None, args_info=None, copies_allow=0,
+                   min_shard_bytes=1024):
+    """Run every graph rule that its inputs allow; pure — no jax work
+    beyond tree_leaves. Returns [GraphFinding]."""
+    findings = []
+    if args_info is not None:
+        donated, aliased = find_donation_leaks(args_info, optimized_text)
+        if aliased < donated:
+            findings.append(GraphFinding(
+                "MXTPU-G01", executable,
+                f"aliased {aliased} of {donated} donated",
+                f"{donated - aliased} donated input leaf/leaves not in "
+                f"input_output_alias — XLA materialises the update out "
+                f"of place"))
+    copies = find_copies(optimized_text)
+    total_copies = sum(n for _, n in copies)
+    if total_copies > copies_allow:
+        top = ", ".join(f"{src.rsplit('/', 1)[-1]}x{n}"
+                        for src, n in copies[:4])
+        findings.append(GraphFinding(
+            "MXTPU-G02", executable,
+            f"copies>{copies_allow}",
+            f"{total_copies} copies (allowance {copies_allow}); top "
+            f"sources: {top}"))
+    for d in find_dead_or_dup_collectives(optimized_text):
+        findings.append(GraphFinding(
+            "MXTPU-G03", executable,
+            f"{d['kind']}:{d['op']}",
+            f"{d['kind']} {d['op']}: {d['detail']}"))
+    if stablehlo_text is not None:
+        for argnum, nbytes in find_unconstrained_args(
+                stablehlo_text, min_bytes=min_shard_bytes):
+            findings.append(GraphFinding(
+                "MXTPU-G04", executable,
+                f"arg{argnum}",
+                f"input %arg{argnum} ({nbytes} B) has no sharding "
+                f"annotation while the program runs under a plan — "
+                f"GSPMD may replicate it"))
+    if jaxpr is not None:
+        for idx, dtype, shape in find_strong_scalar_consts(jaxpr):
+            findings.append(GraphFinding(
+                "MXTPU-G05", executable,
+                f"const{idx}:{dtype}",
+                f"closure-captured strong-typed scalar const #{idx} "
+                f"({dtype}{list(shape)}) — a different value at this "
+                f"site means a full retrace; ride it as a weak-typed "
+                f"arg"))
+    return findings
+
+
+def lint_jit(jfn, *args, executable="executable", copies_allow=0,
+             min_shard_bytes=1024, **kwargs):
+    """AOT trace+lower+compile `jfn` (an InstrumentedJit or bare jitted
+    callable) for the avals of `args`/`kwargs` and run every graph rule.
+    Traced python does not re-run (the jaxpr cache), and the duplicate
+    XLA compile is flagged as inspection so the compile-cache counters
+    stay honest."""
+    import jax
+
+    from ..observability import compilex as _compilex
+
+    jfn = getattr(jfn, "_jfn", jfn)
+    aargs, akwargs = jax.tree_util.tree_map(_compilex._abstract,
+                                            (args, kwargs))
+    tl = _compilex._tl
+    prev = getattr(tl, "inspecting", False)
+    tl.inspecting = True
+    try:
+        import warnings
+        with warnings.catch_warnings():
+            # donated-but-unaliased inputs warn at lower(); that signal
+            # IS finding G01 — don't also spam stderr while linting
+            warnings.simplefilter("ignore")
+            traced = jfn.trace(*aargs, **akwargs)
+            lowered = traced.lower()
+            compiled = lowered.compile()
+    finally:
+        tl.inspecting = prev
+    return lint_hlo_texts(
+        executable,
+        compiled.as_text(),
+        stablehlo_text=lowered.as_text(),
+        jaxpr=traced.jaxpr,
+        args_info=getattr(lowered, "args_info", None),
+        copies_allow=copies_allow,
+        min_shard_bytes=min_shard_bytes)
+
+
+def lint_instrumented(ij, copies_allow=0, min_shard_bytes=1024):
+    """Lint a live `compilex.InstrumentedJit` using the aval skeleton it
+    recorded at its last compile (`last_abstract`); returns None when
+    the wrapper never compiled in this process."""
+    la = getattr(ij, "last_abstract", None)
+    if la is None:
+        return None
+    args, kwargs = la
+    return lint_jit(ij, *args, executable=ij.executable,
+                    copies_allow=copies_allow,
+                    min_shard_bytes=min_shard_bytes, **kwargs)
+
+
+def apply_graph_baseline(findings, baseline_entries):
+    """Same contract as astlint.apply_baseline, over the baseline's
+    "graph" section ({rule, executable, key, why} entries)."""
+    index = {(e["rule"], e["executable"], e.get("key", "")): e
+             for e in baseline_entries}
+    used = set()
+    new, matched = [], []
+    for f in findings:
+        e = index.get(f.fingerprint)
+        if e is not None:
+            f.baselined = True
+            used.add(id(e))
+            matched.append(f)
+        else:
+            new.append(f)
+    stale = [e for e in baseline_entries if id(e) not in used]
+    return new, matched, stale

@@ -60,11 +60,11 @@ from __future__ import annotations
 import atexit as _atexit
 import collections as _collections
 import os as _os
-import re as _re
 import threading
 import time as _time
 from concurrent.futures import Future, InvalidStateError
 
+from ._env import env_int as _env_int
 from ._engine_common import FailureLog as _FailureLog
 from ._engine_common import failure_site as _failure_site
 from ._engine_common import reraise_unless_cancelled as _reraise_unless_cancelled
@@ -245,19 +245,13 @@ class _PyEngine:
 
     def __init__(self, workers=4, aging_ms=None):
         if aging_ms is None:
-            # Mirror the C++ engine's strtol+endptr parse exactly (leading
-            # C whitespace + optional sign + decimal digits, nothing after,
-            # <= INT32_MAX): bare int() also accepts trailing whitespace
-            # and "1_0" forms the native engine rejects, so the parity
-            # pair would run with different starvation bounds.
-            raw = _os.environ.get("MXTPU_ENGINE_AGING_MS")
-            if raw is not None and _re.fullmatch(
-                    r"[ \t\n\r\f\v]*[+-]?[0-9]+", raw):
-                aging_ms = int(raw)
-            else:
-                aging_ms = _DEFAULT_AGING_MS
-            if not 0 <= aging_ms <= 2**31 - 1:   # engine.cc: ms >= 0 and
-                aging_ms = _DEFAULT_AGING_MS     # <= INT32_MAX, else default
+            # Mirror the C++ engine's strtol+endptr parse exactly
+            # (engine.cc: ms >= 0 and <= INT32_MAX, else default) — the
+            # shared `_env` parser IS that discipline, so the parity
+            # pair cannot run with different starvation bounds.
+            aging_ms = _env_int("MXTPU_ENGINE_AGING_MS",
+                                _DEFAULT_AGING_MS, minimum=0,
+                                maximum=2**31 - 1)
         self._aging_ms = max(0, int(aging_ms))
         self._aging_s = self._aging_ms / 1000.0
         self.workers = workers
@@ -537,6 +531,7 @@ def _get():
             def _drain_at_exit():
                 try:
                     _engine.wait_for_all()
+                # mxtpu: disable=E04 interpreter exit: errors already in failures(), nothing to cancel
                 except BaseException:
                     pass
 

@@ -21,11 +21,14 @@ Env-tunable site defaults via `policy_from_env(prefix)`:
 """
 from __future__ import annotations
 
-import math
-import os
 import random
 import time
 
+from .._env import env_float as _env_float_knob
+from .._env import env_int as _env_int_knob
+# back-compat aliases: tests (and kvstore's regression suite) reach the
+# one-warning-per-key set through this module's historical names
+from .._env import _warned as _warned_env            # noqa: F401
 from ..observability import registry as _obs_registry
 
 __all__ = ["RetryPolicy", "retry_call", "policy_from_env"]
@@ -103,30 +106,11 @@ def retry_call(fn, *args, policy=None, **kwargs):
     return (policy or RetryPolicy()).call(fn, *args, **kwargs)
 
 
-_warned_env = set()        # keys already warned about (one warning per key)
-
-
 def _env_float(key, default):
-    """Strtol-parity env parsing (the MXTPU_ENGINE_AGING_MS discipline):
-    a malformed, non-finite, or negative value falls back to the default
-    with ONE warning per key instead of crashing at import — a typo'd
-    retry knob on a fleet launcher must degrade, not kill every worker."""
-    v = os.environ.get(key)
-    if v is None:
-        return default
-    try:
-        out = float(v.strip())
-        if not math.isfinite(out) or out < 0:
-            raise ValueError(f"non-finite or negative: {out}")
-        return out
-    except (ValueError, AttributeError) as e:
-        if key not in _warned_env:
-            _warned_env.add(key)
-            from ..log import get_logger
-            get_logger("mxnet_tpu.fault").warning(
-                "ignoring malformed %s=%r (%s); using default %s",
-                key, v, e, default)
-        return default
+    """Historical entry point (the parser itself now lives in
+    `mxnet_tpu._env`, shared by every subsystem): non-negative finite
+    float with the one-warning-per-key fallback."""
+    return _env_float_knob(key, default, minimum=0.0)
 
 
 def policy_from_env(prefix, max_retries=4, base_delay=0.05, max_delay=2.0,
@@ -135,9 +119,10 @@ def policy_from_env(prefix, max_retries=4, base_delay=0.05, max_delay=2.0,
     ``_RETRY_BASE`` / ``_RETRY_MAX`` / ``_RETRY_DEADLINE`` env overrides.
     ``<prefix>_RETRIES=0`` disables retrying at that site. Malformed
     values fall back to the defaults with a one-time warning (see
-    `_env_float`)."""
+    `mxnet_tpu._env`)."""
     return RetryPolicy(
-        max_retries=int(_env_float(f"{prefix}_RETRIES", max_retries)),
+        max_retries=_env_int_knob(f"{prefix}_RETRIES", int(max_retries),
+                                  minimum=0),
         base_delay=_env_float(f"{prefix}_RETRY_BASE", base_delay),
         max_delay=_env_float(f"{prefix}_RETRY_MAX", max_delay),
         deadline=_env_float(f"{prefix}_RETRY_DEADLINE", deadline),

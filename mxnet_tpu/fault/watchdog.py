@@ -19,6 +19,7 @@ import json
 import os
 import time
 
+from .. import _env
 from ..base import MXNetError
 from ..observability import registry as _obs_registry
 
@@ -66,7 +67,7 @@ class StepWatchdog:
 
     def __init__(self, timeout_ms=None, snapshot_dir=None):
         if timeout_ms is None:
-            timeout_ms = float(os.environ.get("MXTPU_STEP_TIMEOUT_MS", 0))
+            timeout_ms = _env.env_ms("MXTPU_STEP_TIMEOUT_MS", 0.0)
         self.timeout_ms = int(timeout_ms)
         self.snapshot_dir = snapshot_dir or os.environ.get(
             "MXTPU_WATCHDOG_DIR", "/tmp/mxtpu_watchdog")

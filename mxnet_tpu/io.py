@@ -12,6 +12,7 @@ from collections import namedtuple
 
 import numpy as np
 
+from . import _env
 from .base import MXNetError, _as_list
 from .ndarray.ndarray import NDArray, array
 from .observability import registry as _obs_registry
@@ -359,8 +360,8 @@ class ImageRecordIter(DataIter):
         # bounded bad-record tolerance (reference: the C++ iter logs and
         # skips undecodable records): per-epoch budget, lifetime tally
         if max_bad_records is None:
-            max_bad_records = int(os.environ.get("MXTPU_MAX_BAD_RECORDS",
-                                                 16))
+            max_bad_records = _env.env_int("MXTPU_MAX_BAD_RECORDS", 16,
+                                           minimum=0)
         self.max_bad_records = max_bad_records
         self.records_skipped = 0      # lifetime, mirrors the global metric
         self._epoch_skipped = 0

@@ -36,6 +36,7 @@ from .base import MXNetError, _as_list
 from .ndarray.ndarray import NDArray
 from .observability import tracer as _tracer
 from .observability import registry as _obs_registry
+from . import _env
 from .fault import injection as _finj
 from .fault import retry as _retry
 
@@ -110,7 +111,7 @@ def collective_timeout_ms():
     """The active collective deadline in ms (0 = disabled). Read from the
     environment on every call so tests/operators can toggle it live;
     malformed values fall back to 0 with a one-time warning."""
-    return _retry._env_float("MXTPU_COLLECTIVE_TIMEOUT_MS", 0.0)
+    return _env.env_ms("MXTPU_COLLECTIVE_TIMEOUT_MS", 0.0)
 
 
 _deadline_tls = threading.local()
@@ -173,7 +174,16 @@ def _cluster_env():
     n = os.environ.get("MXTPU_NUM_WORKERS", os.environ.get("DMLC_NUM_WORKER"))
     rank = os.environ.get("MXTPU_WORKER_ID", os.environ.get("DMLC_WORKER_ID"))
     if coord and n is not None and rank is not None:
-        return coord, int(n), int(rank)
+        # cluster identity must fail LOUDLY on a garbled launcher export
+        # (strict parse) — a worker count degraded to a default would
+        # join the wrong collective, not crash. strip() first: int()
+        # historically tolerated a newline-padded env-file export, and
+        # padding is not garbling
+        return (coord,
+                _env.parse_int(n.strip(),
+                               "MXTPU_NUM_WORKERS/DMLC_NUM_WORKER"),
+                _env.parse_int(rank.strip(),
+                               "MXTPU_WORKER_ID/DMLC_WORKER_ID"))
     return None, None, None
 
 

@@ -54,17 +54,19 @@ from __future__ import annotations
 import os
 import re
 import threading
+import weakref
 from time import perf_counter_ns
 
 import jax
 
+from .. import _env
 from . import tracer as _tracer
 from .metrics_registry import registry as _registry
 
 __all__ = ["instrument", "InstrumentedJit", "inspect_hlo_text",
            "analyze_jit", "analyze_compiled", "set_compilation_cache",
            "compilation_cache_dir", "compile_cache_stats", "executables",
-           "COLLECTIVE_OPS"]
+           "instrumented", "COLLECTIVE_OPS"]
 
 # HLO collective opcodes tallied into hlo_collectives{op=}; async
 # ("-start") forms count toward the same op, "-done" halves do not.
@@ -214,10 +216,7 @@ def _policy():
 
 
 def _max_inspect_s():
-    try:
-        return float(os.environ.get("MXTPU_HLO_MAX_S", "20"))
-    except ValueError:
-        return 20.0
+    return _env.env_float("MXTPU_HLO_MAX_S", 20.0, minimum=0.0)
 
 
 class InstrumentedJit:
@@ -228,7 +227,8 @@ class InstrumentedJit:
     jit function, so `.lower()` / `.clear_cache()` keep working."""
 
     __slots__ = ("_jfn", "executable", "_csize", "_called", "_compiles",
-                 "_seconds", "last_hlo", "last_compile_seconds")
+                 "_seconds", "last_hlo", "last_compile_seconds",
+                 "last_abstract", "__weakref__")
 
     def __init__(self, jfn, executable):
         self._jfn = jfn
@@ -240,6 +240,16 @@ class InstrumentedJit:
                                        executable=executable)
         self.last_hlo = None
         self.last_compile_seconds = None
+        # aval/sharding skeleton of the last COMPILING call's arguments:
+        # lets analysis/graphlint.py re-lower the executable post-hoc
+        # (no python re-trace, no concrete buffers held alive)
+        self.last_abstract = None
+        # a fresh wrapper must not shadow a COMPILED same-name sibling
+        # in the weak registry (two serve runtimes both instrument
+        # "serve_decode"; only one ever dispatches) — _note_compile
+        # re-registers, so the last wrapper that actually compiled wins
+        if executable not in _instances:
+            _instances[executable] = self
 
     @property
     def compile_count(self):
@@ -274,6 +284,12 @@ class InstrumentedJit:
         self._compiles.inc()
         self._seconds.observe(dt)
         self.last_compile_seconds = dt
+        try:
+            self.last_abstract = jax.tree_util.tree_map(
+                _abstract, (args, dict(kwargs)))
+        except Exception:
+            self.last_abstract = None    # exotic pytree: lint skips it
+        _instances[self.executable] = self   # last COMPILED wins
         if _tracer.ACTIVE:
             _tracer.complete(f"compile.{self.executable}", t0_ns, t1_ns,
                              cat="compile",
@@ -330,6 +346,18 @@ def executables():
     series (one source of truth with the snapshot/reset machinery)."""
     return {dict(c.labels).get("executable"): int(c.value)
             for c in _reg.series("compiles")}
+
+
+_instances = weakref.WeakValueDictionary()   # executable -> live wrapper
+                                             # (latest instance wins)
+
+
+def instrumented():
+    """{executable name: live InstrumentedJit} — every instrumented
+    executable still alive in this process. What
+    analysis/graphlint.py / tools/check_static.py iterate to lint the
+    framework's real programs instead of hand-kept fixtures."""
+    return dict(_instances)
 
 
 # -------------------------------------------- persistent compile cache

@@ -38,6 +38,8 @@ import threading
 from collections import deque
 from time import perf_counter_ns
 
+from .. import _env
+
 __all__ = ["start", "stop", "clear", "enabled", "span", "instant",
            "counter", "complete", "to_chrome_trace", "dump",
            "set_jax_annotation", "events_recorded", "sample_op",
@@ -48,13 +50,10 @@ __all__ = ["start", "stop", "clear", "enabled", "span", "instant",
 ACTIVE = False
 
 def _env_int(name, default, minimum=1):
-    """Env knob parse that can never break `import mxnet_tpu`: malformed
-    values degrade to the default."""
-    try:
-        v = int(os.environ.get(name, default))
-    except (TypeError, ValueError):
-        return default
-    return max(minimum, v)
+    """Env knob parse that can never break `import mxnet_tpu` (the
+    shared strtol-parity parser; values below `minimum` degrade to the
+    default with a one-time warning)."""
+    return _env.env_int(name, default, minimum=minimum)
 
 
 _DEFAULT_CAP = _env_int("MXTPU_TRACE_BUFFER", 65536)

@@ -29,6 +29,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from .. import _env
+
 try:
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -72,7 +74,7 @@ def _block_sizes(sq, sk):
     bigger blocks amortise grid overhead and feed the MXU larger dots;
     override with MXTPU_FLASH_BLOCK_Q / MXTPU_FLASH_BLOCK_K."""
     def pick(s, env):
-        forced = int(os.environ.get(env, "0"))
+        forced = _env.env_int(env, 0, minimum=0)
         if forced and s % forced == 0:
             return min(forced, s)
         for b in (512, 256, 128):

@@ -244,6 +244,22 @@ def dumps(reset=False):
                 f"[serve-spec] accepted/turn: n={snap['count']} "
                 f"mean={snap['mean']:.3f} p95={snap['p95']:.3g} "
                 f"max={snap['max']:.3g}")
+    # graft-lint gate (ISSUE 13): the last check_static run in this
+    # process — rules run, finding counts, baseline size; a growing
+    # baseline or a nonzero "new" count is drift the supervisor
+    # contract should surface
+    rules_run = next((g.value for g in _reg.series("static_rules_run")),
+                     0)
+    if rules_run:
+        by_kind = {dict(g.labels).get("kind"): int(g.value)
+                   for g in _reg.series("static_findings")}
+        bl = next((int(g.value) for g in
+                   _reg.series("static_baseline_size")), 0)
+        lines.append(
+            f"[static] rules={int(rules_run)} "
+            f"findings={by_kind.get('total', 0)} "
+            f"new={by_kind.get('new', 0)} "
+            f"suppressed={by_kind.get('suppressed', 0)} baseline={bl}")
     if reset:
         _state["ops"].clear()
         reset_dispatches()

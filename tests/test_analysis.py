@@ -1,0 +1,380 @@
+"""Graft-lint unit tests (ISSUE 13): a true-positive AND a clean
+fixture per AST rule, suppression + baseline semantics, the pure
+graph-text analyzers, and one graphlint run against a tiny captured
+step. The AST half is parse-only (no jax work) per the tier-1 time
+budget."""
+import json
+
+import pytest
+
+from mxnet_tpu.analysis import astlint, graphlint
+
+
+def _rules(findings, suppressed=False):
+    return [f.rule for f in findings
+            if suppressed or not f.suppressed]
+
+
+def lint(src, path="mxnet_tpu/_fix.py"):
+    return astlint.lint_source(src, path=path, relpath=path)
+
+
+# ------------------------------------------------------------- MXTPU-E01
+def test_e01_fires_on_raw_env_numeric_parse():
+    fs = lint("import os\n"
+              "x = int(os.environ.get('MXTPU_A_MS', '5'))\n"
+              "y = float(os.getenv('MXTPU_B', '1.5'))\n")
+    assert _rules(fs) == ["MXTPU-E01", "MXTPU-E01"]
+
+
+def test_e01_fires_through_local_dataflow():
+    fs = lint("import os\n"
+              "def f():\n"
+              "    raw = os.environ.get('MXTPU_N')\n"
+              "    if raw is not None:\n"
+              "        return int(raw)\n")
+    assert _rules(fs) == ["MXTPU-E01"]
+
+
+def test_e01_clean_when_routed_through_env_module():
+    fs = lint("from . import _env\n"
+              "x = _env.env_int('MXTPU_A_MS', 5)\n"
+              "import os\n"
+              "s = os.environ.get('MXTPU_NAME', 'x')\n")   # string read ok
+    assert _rules(fs) == []
+
+
+def test_e01_exempts_the_env_module_itself():
+    fs = lint("import os\nx = int(os.environ.get('K', '1'))\n",
+              path="mxnet_tpu/_env.py")
+    assert _rules(fs) == []
+
+
+# ------------------------------------------------------------- MXTPU-E02
+def test_e02_fires_in_engine_task_and_traced_scopes():
+    fs = lint("import numpy as np\n"
+              "import engine\n"
+              "def stage(arr, dev):\n"
+              "    def task():\n"
+              "        a = arr.asnumpy()\n"
+              "        b = dev.item()\n"
+              "        return np.asarray(a)\n"
+              "    engine.push(task)\n")
+    assert _rules(fs) == ["MXTPU-E02"] * 3
+    fs = lint("import jax\n"
+              "def step(x):\n"
+              "    return x.tolist()\n"
+              "j = jax.jit(step)\n")
+    assert _rules(fs) == ["MXTPU-E02"]
+
+
+def test_e02_clean_outside_hot_scopes_and_for_jnp():
+    fs = lint("import numpy as np\n"
+              "import jax.numpy as jnp\n"
+              "def host_helper(arr):\n"
+              "    return arr.asnumpy()\n"       # not hot: fine
+              "import jax\n"
+              "def step(x):\n"
+              "    return jnp.asarray(x)\n"       # device-side asarray
+              "j = jax.jit(step)\n")
+    assert _rules(fs) == []
+
+
+# ------------------------------------------------------------- MXTPU-E03
+def test_e03_fires_on_direct_metric_instantiation():
+    fs = lint("from ..observability.metrics_registry import Counter\n"
+              "c = Counter('x', ())\n")
+    assert _rules(fs) == ["MXTPU-E03"]
+
+
+def test_e03_clean_for_registry_memo_and_collections_counter():
+    fs = lint("from collections import Counter\n"
+              "from ..observability import registry\n"
+              "c1 = Counter()\n"                  # collections: fine
+              "c2 = registry().counter('x')\n")   # the memo: fine
+    assert _rules(fs) == []
+
+
+def test_e03_skips_the_registry_module_itself():
+    fs = lint("c = Counter('x', ())\n",
+              path="mxnet_tpu/observability/metrics_registry.py")
+    assert _rules(fs) == []
+
+
+# ------------------------------------------------------------- MXTPU-E04
+def test_e04_fires_on_swallowed_base_exception_in_serve():
+    fs = lint("def cb():\n"
+              "    try:\n"
+              "        work()\n"
+              "    except BaseException:\n"
+              "        pass\n",
+              path="mxnet_tpu/serve/x.py")
+    assert _rules(fs) == ["MXTPU-E04"]
+
+
+def test_e04_accepts_reraise_set_exception_and_sibling_guard():
+    clean = ("def cb(f):\n"
+             "    try:\n"
+             "        work()\n"
+             "    except BaseException as e:\n"
+             "        f.set_exception(e)\n"       # stored, not swallowed
+             "def cb2(e):\n"
+             "    try:\n"
+             "        work()\n"
+             "    except BaseException as exc:\n"
+             "        _reraise_unless_cancelled(exc)\n"
+             "def cb3():\n"
+             "    try:\n"
+             "        work()\n"
+             "    except (KeyboardInterrupt, SystemExit):\n"
+             "        raise\n"
+             "    except BaseException:\n"        # KI/SE already escape
+             "        pass\n")
+    fs = lint(clean, path="mxnet_tpu/serve/x.py")
+    assert _rules(fs) == []
+
+
+def test_e04_scope_limited_to_engine_serve_or_engine_tasks():
+    src = ("def helper():\n"
+           "    try:\n"
+           "        work()\n"
+           "    except BaseException:\n"
+           "        pass\n")
+    assert _rules(lint(src, path="mxnet_tpu/io.py")) == []
+    assert _rules(lint(src, path="mxnet_tpu/engine.py")) == \
+        ["MXTPU-E04"]
+
+
+# ------------------------------------------------------------- MXTPU-E05
+def test_e05_fires_on_naked_fault_point():
+    fs = lint("from .fault import injection as _finj\n"
+              "def hot():\n"
+              "    _finj.check('io.read', context='r')\n")
+    assert _rules(fs) == ["MXTPU-E05"]
+
+
+def test_e05_clean_under_try_or_retry_wrapper():
+    fs = lint("from .fault import injection as _finj\n"
+              "def guarded():\n"
+              "    try:\n"
+              "        _finj.check('io.read')\n"
+              "    except Exception:\n"
+              "        recover()\n"
+              "def attempt():\n"
+              "    _finj.check('io.decode')\n"
+              "    return read()\n"
+              "def outer(policy):\n"
+              "    return policy.call(attempt)\n")
+    assert _rules(fs) == []
+
+
+# ------------------------------------------------------------- MXTPU-E06
+def test_e06_fires_on_wall_clock_and_rng_in_traced_code():
+    fs = lint("import time, random\n"
+              "import numpy as np\n"
+              "import jax\n"
+              "def step(x):\n"
+              "    t = time.time()\n"
+              "    r = random.random()\n"
+              "    z = np.random.randn(3)\n"
+              "    return x + t + r\n"
+              "j = jax.jit(step)\n")
+    assert _rules(fs) == ["MXTPU-E06"] * 3
+
+
+def test_e06_clean_outside_trace_and_for_seeded_rng():
+    fs = lint("import time, random\n"
+              "import jax\n"
+              "def host_loop():\n"
+              "    return time.time()\n"          # host code: fine
+              "def step(x, rng):\n"
+              "    return x + rng.normal()\n"     # passed-in RNG: fine
+              "j = jax.jit(step)\n")
+    assert _rules(fs) == []
+
+
+# ----------------------------------------------------------- suppression
+def test_inline_suppression_same_line_and_line_above():
+    fs = lint("import os\n"
+              "a = int(os.environ.get('A', '1'))"
+              "  # mxtpu: disable=E01 bootstrap\n"
+              "# mxtpu: disable=MXTPU-E01 second form\n"
+              "b = int(os.environ.get('B', '2'))\n")
+    assert len(fs) == 2 and all(f.suppressed for f in fs)
+
+
+def test_suppression_is_rule_specific():
+    fs = lint("import os\n"
+              "a = int(os.environ.get('A', '1'))"
+              "  # mxtpu: disable=E05 wrong rule\n")
+    assert _rules(fs) == ["MXTPU-E01"]
+
+
+# -------------------------------------------------------------- baseline
+def test_baseline_matches_marks_and_reports_stale(tmp_path):
+    src = ("import os\n"
+           "a = int(os.environ.get('A', '1'))\n")
+    findings = lint(src)
+    entry = {"rule": "MXTPU-E01", "path": "mxnet_tpu/_fix.py",
+             "scope": "", "snippet": "a = int(os.environ.get('A', '1'))",
+             "why": "test"}
+    stale_entry = {"rule": "MXTPU-E01", "path": "mxnet_tpu/_fix.py",
+                   "scope": "gone", "snippet": "x = 1", "why": "old"}
+    new, matched, stale = astlint.apply_baseline(
+        findings, [entry, stale_entry])
+    assert new == [] and len(matched) == 1 and matched[0].baselined
+    assert stale == [stale_entry]
+    # load_baseline round-trip + missing file
+    p = tmp_path / "b.json"
+    p.write_text(json.dumps({"ast": [entry]}))
+    loaded = astlint.load_baseline(str(p))
+    assert loaded["ast"] == [entry] and loaded["graph"] == []
+    assert astlint.load_baseline(str(tmp_path / "none.json")) == \
+        {"ast": [], "graph": []}
+
+
+def test_lint_tree_scans_the_package_and_head_is_clean():
+    findings, scanned = astlint.lint_tree(astlint.package_root())
+    assert scanned > 100
+    live = [f for f in findings if not f.suppressed]
+    # HEAD carries exactly the baselined acceptances (ISSUE 13: E01
+    # runs baseline-free — zero raw numeric env parses remain)
+    assert [f.rule for f in live if f.rule == "MXTPU-E01"] == []
+    baseline = astlint.load_baseline(
+        astlint.package_root() + "/../tools/static_baseline.json")
+    new, _, stale = astlint.apply_baseline(live, baseline["ast"])
+    assert new == [] and stale == []
+
+
+# ---------------------------------------------------- graph text analyzers
+def test_find_copies_attributes_sources():
+    txt = ('HloModule m\n'
+           '  %p = f32[8]{0} parameter(0)\n'
+           '  %c1 = f32[8]{0} copy(%p), metadata={op_name="jit(s)/tr"}\n'
+           '  %c2 = f32[8]{0} copy(%c1), metadata={op_name="jit(s)/tr"}\n'
+           '  %c3 = f32[8]{0} copy(%c2)\n'
+           '  ROOT %r = f32[8]{0} add(%c3, %c3)\n')
+    assert graphlint.find_copies(txt) == [("jit(s)/tr", 2),
+                                          ("<unattributed>", 1)]
+
+
+def test_dead_and_duplicate_collectives():
+    txt = ('HloModule m\n'
+           '  %p = f32[8]{0} parameter(0)\n'
+           '  %a1 = f32[8]{0} all-reduce(%p), replica_groups={{0,1}}\n'
+           '  %a2 = f32[8]{0} all-reduce(%p), replica_groups={{0,1}}\n'
+           '  %dead = f32[16]{0} all-gather(%p), dimensions={0}\n'
+           '  ROOT %r = f32[8]{0} add(%a1, %a2)\n')
+    out = graphlint.find_dead_or_dup_collectives(txt)
+    kinds = {(d["kind"], d["op"]) for d in out}
+    assert kinds == {("duplicate", "all-reduce"),
+                     ("dead", "all-gather")}
+
+
+def test_root_tuple_consumption_counts_as_use():
+    """The 8-device sharded step's ROOT tuple overflows any line-level
+    instruction regex — usage must fall back to whole-text reference
+    counting, or every output-feeding collective reads as dead (the
+    false positive the first graphlint sweep hit)."""
+    txt = ('HloModule m\n'
+           '  %p = f32[8]{0} parameter(0)\n'
+           '  %ag = f32[16]{0} all-gather(%p), dimensions={0}\n'
+           '  ROOT %t = (f32[], /*index=5*/f32[16]{0}) '
+           'tuple(f32[] %x, f32[16]{0} %ag)\n')
+    assert graphlint.find_dead_or_dup_collectives(txt) == []
+
+
+def test_unconstrained_args_require_a_real_plan():
+    # maximal (single-device commit) annotations are NOT a plan
+    single = ('func.func public @main(%arg0: tensor<64x64xf32> '
+              '{mhlo.sharding = "{maximal device=0}"}, '
+              '%arg1: tensor<64x64xf32>) -> tensor<64x64xf32>')
+    assert graphlint.find_unconstrained_args(single) == []
+    planned = ('func.func public @main(%arg0: tensor<64x64xf32> '
+               '{mhlo.sharding = "{devices=[2,1]0,1}"}, '
+               '%arg1: tensor<64x64xf32>, '
+               '%arg2: tensor<f32>) -> tensor<64x64xf32>\n'
+               'func.func private @helper(%arg0: tensor<64x64xf32>) '
+               '-> tensor<64x64xf32>')
+    out = graphlint.find_unconstrained_args(planned, min_bytes=1024)
+    # arg1 flagged; the scalar arg2 is under threshold; the PRIVATE
+    # helper's annotation-free %arg0 must not count as an entry input
+    assert out == [(1, 64 * 64 * 4)]
+    # an explicit replicated annotation is a constrained choice
+    repl = ('func.func public @main(%arg0: tensor<64x64xf32> '
+            '{mhlo.sharding = "{devices=[2,1]0,1}"}, '
+            '%arg1: tensor<64x64xf32> '
+            '{mhlo.sharding = "{replicated}"}) -> tensor<64x64xf32>')
+    assert graphlint.find_unconstrained_args(repl) == []
+
+
+# ------------------------------------------------------- graphlint (live)
+def test_donation_leak_and_strong_const_fire_live():
+    import jax
+    import jax.numpy as jnp
+
+    j = jax.jit(lambda x, dead: x + 1.0, donate_argnums=(1,))
+    fs = graphlint.lint_jit(j, jnp.ones(4, jnp.float32),
+                            jnp.ones((8, 8), jnp.float32),
+                            executable="ctl", copies_allow=64)
+    assert any(f.rule == "MXTPU-G01" for f in fs)
+    c = jnp.float32(3.0)
+    fs = graphlint.lint_jit(jax.jit(lambda x: x * c),
+                            jnp.ones(4, jnp.float32),
+                            executable="ctl", copies_allow=64)
+    assert [f.rule for f in fs] == ["MXTPU-G05"]
+    # a weak python-float capture is the FIX — and lints clean
+    fs = graphlint.lint_jit(jax.jit(lambda x: x * 3.0),
+                            jnp.ones(4, jnp.float32),
+                            executable="ctl", copies_allow=64)
+    assert fs == []
+
+
+def test_graphlint_on_a_tiny_captured_step():
+    """ISSUE 13 satellite: a real captured training step lints clean
+    under its copy allowance — donation fully aliased, no dead/dup
+    collectives, no strong scalar consts (per-step lr/wd ride as
+    weak-typed args by the PR 4 design)."""
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, nd
+    from mxnet_tpu.observability import compilex
+
+    rng = np.random.RandomState(0)
+    X = nd.array(rng.randn(8, 16).astype(np.float32))
+    y = nd.array(rng.randint(0, 4, 8).astype(np.float32))
+    lossf = gluon.loss.SoftmaxCrossEntropyLoss()
+    mx.random.seed(0)
+    net = gluon.nn.Sequential()
+    net.add(gluon.nn.Dense(16, activation="relu"), gluon.nn.Dense(4))
+    net.initialize(mx.init.Xavier())
+    net(X)
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.05, "momentum": 0.9})
+    step = tr.capture(lambda a, b: lossf(net(a), b).mean())
+    step(X, y)
+    ij = compilex.instrumented().get("captured_step")
+    assert ij is not None and ij.last_abstract is not None
+    fs = graphlint.lint_instrumented(ij, copies_allow=12)
+    assert fs == [], [str(f) for f in fs]
+    # and the donation accounting itself is visible: >0 donated leaves,
+    # all aliased
+    args, kwargs = ij.last_abstract
+    traced = ij._jfn.trace(*args, **kwargs)
+    donated, aliased = graphlint.find_donation_leaks(
+        traced.lower().args_info, traced.lower().compile().as_text())
+    assert donated > 0 and aliased >= donated
+
+
+def test_graph_baseline_semantics():
+    f = graphlint.GraphFinding("MXTPU-G02", "captured_step",
+                               "copies>0", "msg")
+    entry = {"rule": "MXTPU-G02", "executable": "captured_step",
+             "key": "copies>0", "why": "test"}
+    stale = {"rule": "MXTPU-G03", "executable": "gone", "key": "k",
+             "why": "old"}
+    new, matched, stale_out = graphlint.apply_graph_baseline(
+        [f], [entry, stale])
+    assert new == [] and matched == [f] and f.baselined
+    assert stale_out == [stale]
