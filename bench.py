@@ -415,6 +415,20 @@ def main():
         except Exception as e:  # pragma: no cover
             print(f"[bench] serve fast-path bench failed: {e!r}",
                   file=sys.stderr)
+        # ISSUE 14: low-precision serving — int8-KV tokens/s ratio +
+        # token capacity at a fixed HBM budget, with the accuracy
+        # contract (greedy token match vs fp32) riding the same JSON so
+        # the speed ratio never ships without it. Own guard, as above.
+        try:
+            import bench_serve
+            ires = bench_serve.measure_int8kv()
+            result["serve_int8_kv_speedup"] = ires["speedup_vs_fp"]
+            result["serve_int8_token_match"] = ires["token_match"]
+            result["serve_int8_capacity_ratio"] = \
+                ires["capacity_tokens_ratio"]
+        except Exception as e:  # pragma: no cover
+            print(f"[bench] serve int8 bench failed: {e!r}",
+                  file=sys.stderr)
 
     # Second headline metric (BASELINE.json): BERT-base MLM tokens/sec/chip.
     # Merged into the same single JSON line so the driver's one-line parse

@@ -8,6 +8,12 @@ resnet50_v1 at batch 128, bf16 forward vs the calibrated int8 forward
 ratio. No baseline denominator — the deliverable is the measured
 speedup itself, reported in the JSON line.
 
+ISSUE 14: the speed ratio never ships without an accuracy number —
+`logit_mse` (mean squared logit error vs the fp forward on a held
+batch) and `greedy_match` (top-1 / greedy-prediction agreement rate)
+ride the same JSON line, the quality-column contract the serving
+low-precision path also follows (bench_serve --int8-kv).
+
 Off by default; BENCH_INT8=1 adds it to bench.py's extra_metrics.
 Standalone: `python bench_int8.py` prints ONE JSON line.
 """
@@ -61,6 +67,16 @@ def measure(on_result=None):
     print(f"[bench_int8] int8: {int8_s:.1f} img/s "
           f"({int8_s / fp_s:.2f}x)", file=sys.stderr)
 
+    # quality columns (ISSUE 14): logit MSE + greedy-prediction match on
+    # a held batch, so the ratio above never ships alone
+    ref_logits = np.asarray(net(x).asnumpy(), np.float64)
+    q_logits = np.asarray(qnet(x).asnumpy(), np.float64)
+    logit_mse = float(np.mean((ref_logits - q_logits) ** 2))
+    greedy_match = float(np.mean(
+        ref_logits.argmax(axis=-1) == q_logits.argmax(axis=-1)))
+    print(f"[bench_int8] logit MSE {logit_mse:.3e}, greedy match "
+          f"{greedy_match:.4f}", file=sys.stderr)
+
     res = {
         "metric": f"{mname}_int8_inference_throughput",
         "value": round(int8_s, 1),
@@ -70,6 +86,8 @@ def measure(on_result=None):
         # the speedup over the SAME chip's fp path
         "speedup_vs_fp": round(int8_s / fp_s, 4),
         "fp_samples_s": round(fp_s, 1),
+        "logit_mse": logit_mse,
+        "greedy_match": round(greedy_match, 4),
     }
     if on_result is not None:
         on_result(res)

@@ -157,10 +157,12 @@ def _contended_fields(reqs):
     }
 
 
-def _build_fast_server(speculative_k=0, prefix_cache=True):
+def _build_fast_server(speculative_k=0, prefix_cache=True, **kw):
     """The fast-path server (ISSUE 12): prompt budget for the shared
     system prompts, optional speculative width. Same model/seed as the
-    headline arms so the executables compare like for like."""
+    headline arms so the executables compare like for like. Extra
+    keywords (kv_dtype / weight_dtype, ISSUE 14) pass through to
+    `Server`."""
     import mxnet_tpu as mx
     from mxnet_tpu.models.transformer import TransformerNMT
 
@@ -173,7 +175,8 @@ def _build_fast_server(speculative_k=0, prefix_cache=True):
                            max_prompt_len=32,
                            speculative_k=speculative_k,
                            prefix_cache=prefix_cache,
-                           max_queue=N_REQUESTS, engine_driven=True)
+                           max_queue=N_REQUESTS, engine_driven=True,
+                           **kw)
 
 
 def _prefix_workload(seed=1, n=N_REQUESTS, templates=3):
@@ -202,12 +205,13 @@ def _prefix_workload(seed=1, n=N_REQUESTS, templates=3):
     return reqs
 
 
-def _run_fast(reqs, speculative_k=0, prefix_cache=True):
+def _run_fast(reqs, speculative_k=0, prefix_cache=True, **kw):
     """One pass of the prompted trace; returns wall tokens/s plus the
     deterministic witnesses: decode turns, committed tokens, prefix hit
-    rate and draft acceptance."""
+    rate, draft acceptance and the per-request token outputs (the
+    accuracy-contract comparison material)."""
     srv = _build_fast_server(speculative_k=speculative_k,
-                             prefix_cache=prefix_cache)
+                             prefix_cache=prefix_cache, **kw)
     handles = []
     try:
         # warm-up compiles prefill + (widened) decode outside the clock
@@ -231,6 +235,7 @@ def _run_fast(reqs, speculative_k=0, prefix_cache=True):
         saved = cache.tokens_saved if cache is not None else 0
         accept = (sched.spec_accepted / max(sched.spec_drafted, 1)
                   if speculative_k else 0.0)
+        outputs = [list(h.tokens) for h in handles]
     finally:
         srv.close()
     return {
@@ -242,6 +247,7 @@ def _run_fast(reqs, speculative_k=0, prefix_cache=True):
         "prefix_hit_rate": hit_rate,
         "prefix_tokens_saved": saved,
         "spec_accept_rate": accept,
+        "outputs": outputs,
     }
 
 
@@ -274,6 +280,95 @@ def measure_fastpath(seed=1, repeats=2):
         "spec_turns_per_token": round(spec["turns_per_token"], 4),
         "control_turns_per_token": round(cold["turns_per_token"], 4),
         "spec_tokens_per_s": round(spec["tokens_per_s"], 2),
+    }
+
+
+def _token_match(ref_outputs, outputs):
+    """Position-wise greedy token-match rate vs the fp32 reference
+    (length mismatches count as mismatches) — the accuracy number every
+    low-precision speed claim ships with (ISSUE 14)."""
+    matched = total = 0
+    for a, b in zip(ref_outputs, outputs):
+        total += max(len(a), len(b))
+        matched += sum(1 for x, y in zip(a, b) if x == y)
+    return matched / max(total, 1)
+
+
+def _logit_mse(kv_dtype=None, weight_dtype=None, steps=8, seed=5):
+    """Teacher-forced decode-logit MSE vs the fp32 runtime: both
+    runtimes prefill the same source and decode the same forced token
+    sequence, so the per-position logits compare like for like."""
+    import numpy as np
+
+    def drive(srv):
+        rng = np.random.RandomState(seed)
+        src = rng.randint(4, 64, (8,)).astype(np.int32)
+        toks = rng.randint(4, 64, (steps,)).astype(np.int32)
+        rt = srv.runtime
+        pool = srv.pool
+        pages = pool.alloc(pool.pages_for(steps))
+        tables = np.full((rt.slots, rt.max_pages_per_slot), 0, np.int32)
+        tables[0, :len(pages)] = pages
+        rt.prefill(0, src)
+        active = np.zeros((rt.slots,), np.int32)
+        active[0] = 1
+        cur = np.zeros((rt.slots,), np.int32)
+        lens = np.zeros((rt.slots,), np.int32)
+        logits = []
+        for t in range(steps):
+            cur[0] = toks[t]
+            lens[0] = t
+            _, lg = rt.decode(tables, lens, cur, active)
+            logits.append(np.asarray(lg[0], np.float64))
+        pool.free(pages)
+        srv.close()
+        return np.stack(logits)
+
+    ref = drive(_build_fast_server())
+    got = drive(_build_fast_server(kv_dtype=kv_dtype,
+                                   weight_dtype=weight_dtype))
+    return float(np.mean((ref - got) ** 2))
+
+
+def measure_int8kv(seed=2):
+    """The ISSUE 14 arm: the same shared-system-prompt trace through an
+    int8-KV server vs the fp32 twin. Headlines: wall tokens/s ratio
+    (honest — on the CPU mesh the quantise/requantise work is not free,
+    so the ratio can sit below 1; the bandwidth win needs a chip) and
+    the CAPACITY witnesses (tokens + concurrent full-size requests a
+    fixed HBM byte budget holds — deterministic, hardware-independent,
+    ~3.5x vs fp32 pages). Every speed number ships with its accuracy
+    contract: greedy token-match rate + teacher-forced logit MSE vs
+    fp32."""
+    from mxnet_tpu.serve.quant import kv_page_bytes, token_capacity
+
+    reqs = _prefix_workload(seed)
+    fp = _run_fast(reqs, prefix_cache=True)
+    q = _run_fast(reqs, prefix_cache=True, kv_dtype="int8")
+    match = _token_match(fp["outputs"], q["outputs"])
+    mse = _logit_mse(kv_dtype="int8")
+    # capacity at a fixed byte budget (the bench model's KV geometry:
+    # 2 layers x 4 heads x 8 head-dim, page_size 8)
+    geo = dict(n_layers=2, page_size=8, num_heads=4, head_dim=8)
+    budget = 256 * kv_page_bytes(kv_dtype="float32", **geo)
+    cap_fp = token_capacity(budget, kv_dtype="float32", **geo)
+    cap_q = token_capacity(budget, kv_dtype="int8", **geo)
+    return {
+        "metric": "serve_int8_kv",
+        "unit": "tokens/sec",
+        "value": round(q["tokens_per_s"], 2),
+        "fp_tokens_per_s": round(fp["tokens_per_s"], 2),
+        "speedup_vs_fp": round(
+            q["tokens_per_s"] / max(fp["tokens_per_s"], 1e-9), 3),
+        "token_match": round(match, 4),
+        "logit_mse": mse,
+        "capacity_tokens_ratio": round(cap_q / cap_fp, 3),
+        "tokens_at_budget_int8": cap_q,
+        "tokens_at_budget_fp32": cap_fp,
+        "concurrent_slots_int8": cap_q // (32 + 24),
+        "concurrent_slots_fp32": cap_fp // (32 + 24),
+        "decode_turns": q["decode_turns"],
+        "fp_decode_turns": fp["decode_turns"],
     }
 
 
@@ -328,6 +423,11 @@ def main(argv=None):
     if "--fastpath" in argv:
         # ISSUE 12 arms only: prefix-heavy warm-vs-cold + speculative
         print(json.dumps(measure_fastpath()), flush=True)
+        return 0
+    if "--int8-kv" in argv:
+        # ISSUE 14 arm only: int8-KV tokens/s + capacity-at-fixed-budget
+        # vs fp32, with the accuracy contract riding along
+        print(json.dumps(measure_int8kv()), flush=True)
         return 0
     if "--background-train" in argv:
         # contended arm only: decode p99 under background-train load,

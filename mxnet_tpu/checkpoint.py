@@ -45,8 +45,9 @@ __all__ = ["save_checkpoint", "load_checkpoint", "save_sharded",
            "load_sharded", "CheckpointManager", "validate_checkpoint",
            "read_extra", "read_health", "is_healthy",
            "saved_partition_specs", "derive_partition_specs",
-           "spec_mismatches", "MANIFEST_NAME", "HEALTH_NAME",
-           "CHECKPOINT_FORMAT"]
+           "spec_mismatches", "saved_quantization",
+           "derive_quantization", "quantization_mismatches",
+           "MANIFEST_NAME", "HEALTH_NAME", "CHECKPOINT_FORMAT"]
 
 MANIFEST_NAME = "manifest.json"
 HEALTH_NAME = "health.json"
@@ -126,14 +127,18 @@ def _walk_files(root):
             yield os.path.relpath(full, root), full
 
 
-def _write_manifest(root, step, partition_specs=None):
+def _write_manifest(root, step, partition_specs=None, quantization=None):
     """Checksum every file under `root` into manifest.json (written last:
     its presence marks the payload complete *before* the dir rename makes
     the step visible — two commit barriers, either catches a tear).
     `partition_specs` ({leaf name -> JSON-encoded PartitionSpec}) records
     the ACTIVE sharding layout each param was saved under, so a
     spec-mismatched restore is diagnosable from the manifest instead of
-    failing deep inside device_put (ISSUE 8)."""
+    failing deep inside device_put (ISSUE 8). `quantization` records the
+    quantization scheme (storage dtype + per-leaf shapes, ISSUE 14) the
+    same way — a restore against a differently-quantized template is
+    refused pre-flight with a readable diagnosis instead of an XLA
+    shape/dtype error."""
     files = {}
     for rel, full in _walk_files(root):
         if rel == MANIFEST_NAME:
@@ -143,6 +148,8 @@ def _write_manifest(root, step, partition_specs=None):
                 "complete": True, "files": files}
     if partition_specs:
         manifest["partition_specs"] = dict(partition_specs)
+    if quantization:
+        manifest["quantization"] = dict(quantization)
     path = os.path.join(root, MANIFEST_NAME)
     tmp = path + ".tmp"
     with open(tmp, "w") as f:
@@ -291,13 +298,100 @@ def spec_mismatches(path, template):
     return lines
 
 
+# --------------------------------------------------- quantization scheme
+# ISSUE 14: int8-quantized serve weights ride the same manifest
+# machinery as partition specs — the SCHEME (storage dtype + per-leaf
+# shapes, e.g. per-output-channel int8 with its scale vectors) is
+# recorded at save time, and a restore whose template disagrees is
+# refused PRE-FLIGHT with names instead of dying in orbax/XLA on a
+# dtype/shape mismatch.
+
+_QUANT_DTYPES = ("int8", "uint8")
+
+
+def derive_quantization(params):
+    """The quantization scheme of a params pytree: {"dtype", "leaves":
+    {leaf name -> {"dtype", "shape"}}} covering every int8/uint8-stored
+    leaf (the quantized-storage dtypes; ordinary int32 step counters are
+    NOT quantization). Returns None for a tree with no quantized leaves
+    — fp checkpoints carry no scheme, exactly like spec-less manifests."""
+    import jax
+    leaves = jax.tree_util.tree_flatten_with_path(
+        params, is_leaf=lambda x: isinstance(x, NDArray))[0]
+    out = {}
+    dtypes = set()
+    for path, leaf in leaves:
+        data = getattr(leaf, "_data", leaf)
+        dt = getattr(data, "dtype", None)
+        if dt is None or str(np.dtype(dt)) not in _QUANT_DTYPES:
+            continue
+        name = _leaf_name(path)
+        out[name] = {"dtype": str(np.dtype(dt)),
+                     "shape": [int(s) for s in data.shape]}
+        dtypes.add(str(np.dtype(dt)))
+    if not out:
+        return None
+    return {"dtype": dtypes.pop() if len(dtypes) == 1 else "mixed",
+            "leaves": out}
+
+
+def saved_quantization(directory, step=None):
+    """The quantization scheme recorded in a checkpoint's manifest, or
+    None for a checkpoint saved without one. `directory` may be the step
+    dir itself (step=None) or the checkpoint root + step."""
+    path = directory if step is None else _step_path(directory, step)
+    try:
+        with open(os.path.join(path, MANIFEST_NAME)) as f:
+            return json.load(f).get("quantization")
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+def quantization_mismatches(path, template):
+    """Saved-vs-template quantization-scheme differences for one step
+    dir (human-readable strings; empty when the schemes agree). UNLIKE
+    partition specs — which merely reshard — a scheme mismatch (int8
+    saved, fp template, or different shapes) cannot restore:
+    `load_sharded` refuses pre-flight with exactly these lines instead
+    of surfacing an XLA shape error.
+
+    A manifest with NO recorded scheme (pre-scheme checkpoint, or a
+    `quantization=False` opt-out save) yields NO diagnosis — absence
+    means unknown, not full-precision, so a restorable checkpoint is
+    never refused on missing metadata. Scheme-aware saves of fp-only
+    trees record an explicit empty scheme, which keeps the reverse
+    direction (fp saved, quantized template) diagnosable."""
+    saved = saved_quantization(path)
+    if saved is None:
+        return []
+    want = derive_quantization(template)
+    saved_leaves = saved.get("leaves", {})
+    want_leaves = (want or {}).get("leaves", {})
+    lines = []
+    for name, meta in saved_leaves.items():
+        t = want_leaves.get(name)
+        if t is None:
+            lines.append(f"{name}: saved quantized ({meta['dtype']} "
+                         f"{meta['shape']}) but the template leaf is "
+                         f"full precision (or absent)")
+        elif t != meta:
+            lines.append(f"{name}: saved {meta['dtype']} {meta['shape']}, "
+                         f"template wants {t['dtype']} {t['shape']}")
+    for name, meta in want_leaves.items():
+        if name not in saved_leaves:
+            lines.append(f"{name}: template is quantized "
+                         f"({meta['dtype']} {meta['shape']}) but the "
+                         f"checkpoint saved it full precision")
+    return lines
+
+
 # ------------------------------------------------------- sharded save
 def _step_path(directory, step):
     return os.path.abspath(os.path.join(directory, str(step)))
 
 
 def save_sharded(directory, step, params, _async=False, extras=None,
-                 _group=None, partition_specs=None):
+                 _group=None, partition_specs=None, quantization=None):
     """Sharded distributed checkpoint via Orbax (multi-host resume path),
     committed atomically: Orbax writes into a hidden tmp dir, `extras`
     (name -> bytes sidecars) land beside it, the checksum manifest is
@@ -315,7 +409,9 @@ def save_sharded(directory, step, params, _async=False, extras=None,
     `partition_specs` records each param's active PartitionSpec in the
     manifest (default: DERIVED from the params' own shardings — a
     rule-sharded training run documents its layout for free); pass
-    False to omit."""
+    False to omit. `quantization` records the quantization scheme the
+    same way (default: derived from the params' storage dtypes — int8
+    leaves document themselves; ISSUE 14); pass False to omit."""
     from . import engine
     directory = os.path.abspath(directory)
     os.makedirs(directory, exist_ok=True)
@@ -327,6 +423,18 @@ def save_sharded(directory, step, params, _async=False, extras=None,
             partition_specs = None   # exotic pytree: save without specs
     elif partition_specs is False:
         partition_specs = None
+    if quantization is None:
+        try:
+            quantization = derive_quantization(params)
+            if quantization is None:
+                # explicit empty scheme: "this save KNOWS it is full
+                # precision" — distinguishable from a pre-scheme or
+                # opted-out manifest, where absence means unknown
+                quantization = {"dtype": None, "leaves": {}}
+        except Exception:
+            quantization = None      # exotic pytree: save without scheme
+    elif quantization is False:
+        quantization = None
 
     def do_save(params=params, extras=extras):
         import orbax.checkpoint as ocp
@@ -351,7 +459,8 @@ def save_sharded(directory, step, params, _async=False, extras=None,
                 with open(os.path.join(tmp, name), "wb") as f:
                     f.write(blob if isinstance(blob, bytes)
                             else bytes(blob))
-            _write_manifest(tmp, step, partition_specs=partition_specs)
+            _write_manifest(tmp, step, partition_specs=partition_specs,
+                            quantization=quantization)
             if os.path.exists(final):
                 # POSIX rename refuses a non-empty target dir, so an
                 # overwrite needs two renames — move the old step ASIDE
@@ -418,6 +527,19 @@ def load_sharded(directory, step, template, validate=True):
         errors = validate_checkpoint(final)
         if errors:
             raise MXNetError("invalid checkpoint: " + "; ".join(errors))
+    # pre-flight quantization-scheme check (ISSUE 14): unlike partition
+    # specs (which reshard template-wins), a dtype/shape scheme mismatch
+    # CANNOT restore — refuse with names now instead of an XLA error
+    try:
+        qdiag = quantization_mismatches(final, template)
+    except Exception:
+        qdiag = []                  # exotic template: let orbax decide
+    if qdiag:
+        raise MXNetError(
+            f"restore of {final} refused: quantization scheme mismatch "
+            f"(saved vs template): " + "; ".join(qdiag) +
+            " — requantize the template (or restore into a matching "
+            "quantized tree) before loading")
 
     def do_load():
         import orbax.checkpoint as ocp

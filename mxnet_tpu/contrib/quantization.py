@@ -46,7 +46,8 @@ import jax.numpy as jnp
 from ..base import MXNetError
 from ..ndarray.ndarray import NDArray, _apply
 
-__all__ = ["quantize", "dequantize", "QuantizedDense", "QuantizedConv2D",
+__all__ = ["quantize", "dequantize", "quantize_channelwise",
+           "QuantizedDense", "QuantizedConv2D",
            "quantize_net", "quantize_model", "kl_optimal_threshold"]
 
 
@@ -169,6 +170,27 @@ def kl_optimal_threshold(hist, amax, num_quantized_bins=_QUANT_LEVELS):
         if kl < best_kl:
             best_kl, best_i = kl, i
     return best_i * bin_width
+
+
+def quantize_channelwise(w, axis=0):
+    """Symmetric PER-CHANNEL int8 quantization (ISSUE 14): an independent
+    scale per index along `axis` (for a Dense weight (out, in), axis=0 is
+    per-OUTPUT-channel — the granularity that lets the dequant fold into
+    the matmul epilogue as one per-column multiply). Returns
+    (int8 array, float32 scale vector of length w.shape[axis]) with
+    x ≈ q * scale broadcast along `axis`. A channel of all zeros gets the
+    minimum scale (its values quantize to 0 exactly)."""
+    w = jnp.asarray(w)
+    axis = axis % w.ndim
+    red = tuple(i for i in range(w.ndim) if i != axis)
+    wf = w.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(wf), axis=red)
+    scale = jnp.maximum(amax, 1e-12) / 127.0
+    shape = [1] * w.ndim
+    shape[axis] = -1
+    q = jnp.clip(jnp.round(wf / scale.reshape(shape)),
+                 -127, 127).astype(jnp.int8)
+    return q, scale
 
 
 def _quantize_weight(w):

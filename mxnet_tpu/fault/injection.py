@@ -48,7 +48,7 @@ POINTS = ("io.read", "io.decode", "engine.task", "kv.collective",
           "kv.timeout", "kv.init", "grad.nan", "preempt.sigterm",
           "checkpoint.save", "checkpoint.load", "serve.admit",
           "serve.decode", "serve.prefix", "serve.speculate",
-          "device.lost")
+          "serve.quant", "device.lost")
 
 ENABLED = False            # fast-path guard; True iff any spec registered
 

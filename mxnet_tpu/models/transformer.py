@@ -480,7 +480,8 @@ def encode_memory(weights, src, src_vl=None):
     numerics."""
     h = weights["num_heads"]
     s = src.shape[1]
-    x = weights["embed"][src] * weights["scale"] + weights["pos"][:s][None]
+    x = _embed_rows(weights, src) * weights["scale"] \
+        + weights["pos"][:s][None]
     kv_len = src_vl.astype(jnp.int32) if src_vl is not None else None
     for L in weights["layers"]:
         qkv = _affine(x, L["qkv"])
@@ -502,9 +503,28 @@ def _ln_apply(x, lnw):
 
 
 def _affine(x, wb):
-    w, b = wb
-    y = x @ w.T
+    # 3-tuple = per-output-channel int8 weight (ISSUE 14, serve/quant.py
+    # snapshots): the dot runs over the exact int8 values converted
+    # in-register and the scale lands as ONE epilogue multiply per
+    # column — more accurate than dequantize-then-dot (integer-exact
+    # accumulation) and fused by XLA into the matmul
+    if len(wb) == 3:
+        wq, b, s = wb
+        y = (x @ wq.T.astype(x.dtype)) * s.astype(x.dtype)
+    else:
+        w, b = wb
+        y = x @ w.T
     return y + b if b is not None else y
+
+
+def _embed_rows(weights, idx):
+    """Embedding gather; int8-quantized embeddings (ISSUE 14) dequantize
+    the GATHERED rows with their per-vocab-row scales."""
+    e = weights["embed"][idx]
+    es = weights.get("embed_scale")
+    if es is not None:
+        e = e.astype(weights["pos"].dtype) * es[idx][..., None]
+    return e
 
 
 def _heads(x, h):
@@ -535,11 +555,18 @@ def precompute_memory_kv(weights, memory):
 def decode_embed(weights, tok_t, t):
     """Embed the current token(s) at position(s) t: tok_t (B,) int32,
     t scalar or (B,) int32 -> (B, U)."""
-    return weights["embed"][tok_t] * weights["scale"] + weights["pos"][t]
+    return _embed_rows(weights, tok_t) * weights["scale"] \
+        + weights["pos"][t]
 
 
 def decode_project(weights, x):
-    """Tied output projection for the decode path: (B, U) -> (B, V)."""
+    """Tied output projection for the decode path: (B, U) -> (B, V).
+    int8-quantized embeddings (ISSUE 14): the per-vocab-row scale is the
+    projection's per-OUTPUT-channel scale — one epilogue multiply after
+    the integer-exact dot."""
+    es = weights.get("embed_scale")
+    if es is not None:
+        return (x @ weights["embed"].T.astype(x.dtype)) * es.astype(x.dtype)
     return x @ weights["embed"].T
 
 

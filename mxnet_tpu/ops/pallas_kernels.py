@@ -732,26 +732,43 @@ def single_query_cached_attention(qh, kc, vc, mask=None):
     return jnp.einsum("bhqk,bhkd->bhqd", p, vc)
 
 
-def _paged_attention_lax(q, k_pages, v_pages, page_tables, lengths):
+def _dequant_gathered(pages, page_tables, scales, dtype):
+    """Gather (S, npages, psize, H, dh) pages; with per-page (P, H)
+    `scales` (int8 KV mode, ISSUE 14) dequantize the gathered context —
+    never the whole pool — into `dtype`."""
+    ctx = pages[page_tables]
+    if scales is not None:
+        ctx = ctx.astype(dtype) * scales[page_tables][:, :, None, :, None]
+    return ctx
+
+
+def _paged_attention_lax(q, k_pages, v_pages, page_tables, lengths,
+                         k_scales=None, v_scales=None):
     """Pure-lax fallback: gather each slot's pages into a dense context,
     then run the SAME shared math as the dense decoder (so CPU serving is
     bitwise-parity with `decode_step` on equal context width).
 
     q: (S, H, dh); k_pages/v_pages: (P, psize, H, dh);
     page_tables: (S, npages) int32; lengths: (S,) int32 valid positions
-    (including the current token). Returns (S, H, dh)."""
+    (including the current token). k_scales/v_scales: optional (P, H)
+    per-page/per-head dequant scales for int8 page pools (ISSUE 14) —
+    only the GATHERED context dequantizes, never the pool. Returns
+    (S, H, dh)."""
     S, H, dh = q.shape
     psize = k_pages.shape[1]
     npages = page_tables.shape[1]
     L = npages * psize
-    kc = k_pages[page_tables].reshape(S, L, H, dh).transpose(0, 2, 1, 3)
-    vc = v_pages[page_tables].reshape(S, L, H, dh).transpose(0, 2, 1, 3)
+    kc = _dequant_gathered(k_pages, page_tables, k_scales, q.dtype) \
+        .reshape(S, L, H, dh).transpose(0, 2, 1, 3)
+    vc = _dequant_gathered(v_pages, page_tables, v_scales, q.dtype) \
+        .reshape(S, L, H, dh).transpose(0, 2, 1, 3)
     mask = (jnp.arange(L)[None, :] < lengths[:, None])[:, None, None, :]
     return single_query_cached_attention(q[:, :, None, :], kc, vc,
                                          mask)[:, :, 0]
 
 
-def _paged_attention_lax_multi(q, k_pages, v_pages, page_tables, lengths):
+def _paged_attention_lax_multi(q, k_pages, v_pages, page_tables, lengths,
+                               k_scales=None, v_scales=None):
     """Pure-lax fallback for the WIDENED (speculative-verify) launch:
     gather each slot's pages into a dense context, then the SAME shared
     math as `_paged_attention_lax`, with one extra query axis.
@@ -765,8 +782,10 @@ def _paged_attention_lax_multi(q, k_pages, v_pages, page_tables, lengths):
     psize = k_pages.shape[1]
     npages = page_tables.shape[1]
     L = npages * psize
-    kc = k_pages[page_tables].reshape(S, L, H, dh).transpose(0, 2, 1, 3)
-    vc = v_pages[page_tables].reshape(S, L, H, dh).transpose(0, 2, 1, 3)
+    kc = _dequant_gathered(k_pages, page_tables, k_scales, q.dtype) \
+        .reshape(S, L, H, dh).transpose(0, 2, 1, 3)
+    vc = _dequant_gathered(v_pages, page_tables, v_scales, q.dtype) \
+        .reshape(S, L, H, dh).transpose(0, 2, 1, 3)
     vis = lengths[:, None] + jnp.arange(W, dtype=lengths.dtype)[None, :]
     mask = (jnp.arange(L)[None, None, :]
             < vis[:, :, None])[:, None, :, :]        # (S, 1, W, L)
@@ -775,18 +794,35 @@ def _paged_attention_lax_multi(q, k_pages, v_pages, page_tables, lengths):
     return out.transpose(0, 2, 1, 3)
 
 
-def _rpa_kernel(pt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
-                m_scr, l_scr, acc_scr, *, psize, num_heads, sm_scale):
+def _rpa_kernel(*refs, psize, num_heads, sm_scale, quant=False):
     """Ragged paged attention, one (slot, head) per grid row, one KV page
     per inner step. The page id for (slot, page_slot) was already consumed
     by the BlockSpec index maps (scalar prefetch); here we only need the
-    slot's valid length for masking and dead-page skipping."""
+    slot's valid length for masking and dead-page skipping.
+
+    quant (ISSUE 14): the page pools are int8 and two extra scalar-
+    prefetch refs carry the per-page/per-head dequant scales as BITCAST
+    int32 (scalar prefetch is SMEM/int territory; `bitcast_convert_type`
+    recovers the f32 in-kernel) — the page block dequantizes in VMEM
+    right after the DMA, so HBM only ever moves int8 bytes."""
+    if quant:
+        (pt_ref, len_ref, ks_ref, vs_ref, q_ref, k_ref, v_ref, o_ref,
+         m_scr, l_scr, acc_scr) = refs
+    else:
+        ks_ref = vs_ref = None
+        (pt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
+         m_scr, l_scr, acc_scr) = refs
     g = pl.program_id(0)                    # slot * num_heads + head
     j = pl.program_id(1)                    # page slot within the request
     nj = pl.num_programs(1)
     s_idx = g // num_heads
     length = len_ref[s_idx]
     k_start = j * psize
+    if quant:
+        page = pt_ref[s_idx, j]
+        h_idx = g % num_heads
+        ks = lax.bitcast_convert_type(ks_ref[h_idx, page], jnp.float32)
+        vs = lax.bitcast_convert_type(vs_ref[h_idx, page], jnp.float32)
 
     @pl.when(j == 0)
     def _init():
@@ -802,6 +838,11 @@ def _rpa_kernel(pt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
         q = q_ref[0]                        # (1, dh)
         k = k_ref[0, 0]                     # (psize, dh)
         v = v_ref[0, 0]                     # (psize, dh)
+        if quant:
+            # dequantize in VMEM, same element-wise form as the lax
+            # fallback's gathered dequant (parity pinned in interpret)
+            k = k.astype(jnp.float32) * ks
+            v = v.astype(jnp.float32) * vs
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * sm_scale
         kj = k_start + lax.broadcasted_iota(jnp.int32, (1, psize), 1)
@@ -829,10 +870,19 @@ def _rpa_kernel(pt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
                     jnp.maximum(l_scr[:1, :1], 1e-30)).astype(o_ref.dtype)
 
 
-def _rpa_pallas(q, k_pages, v_pages, page_tables, lengths, sm_scale):
+def _scale_bits(scales):
+    """(P, H) f32 scales -> (H, P) int32 bitcast for scalar prefetch
+    (SMEM carries ints; the kernel bitcasts the f32 back)."""
+    return lax.bitcast_convert_type(
+        scales.astype(jnp.float32).T, jnp.int32)
+
+
+def _rpa_pallas(q, k_pages, v_pages, page_tables, lengths, sm_scale,
+                k_scales=None, v_scales=None):
     S, H, dh = q.shape
     psize = k_pages.shape[1]
     npages = page_tables.shape[1]
+    quant = k_scales is not None
     qr = q.reshape(S * H, 1, dh)
     # page-major layout for the kernel: (H, P, psize, dh) so one (slot,
     # head, page) block is a contiguous (psize, dh) tile
@@ -840,29 +890,34 @@ def _rpa_pallas(q, k_pages, v_pages, page_tables, lengths, sm_scale):
     vr = v_pages.transpose(2, 0, 1, 3)
     grid = (S * H, npages)
     kern = functools.partial(_rpa_kernel, psize=psize, num_heads=H,
-                             sm_scale=sm_scale)
+                             sm_scale=sm_scale, quant=quant)
+    nsp = 4 if quant else 2
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,              # page tables + lengths
+        num_scalar_prefetch=nsp,        # page tables + lengths (+ scales)
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, 1, dh), lambda g, j, pt, ln: (g, 0, 0)),
+            pl.BlockSpec((1, 1, dh), lambda g, j, pt, ln, *_: (g, 0, 0)),
             # the paged gather: the page id comes from the scalar-
             # prefetched table, so the DMA fetches exactly the pages the
             # slot owns — never a dense (S, Lmax) context
             pl.BlockSpec((1, 1, psize, dh),
-                         lambda g, j, pt, ln, _h=H: (g % _h, pt[g // _h, j],
-                                                     0, 0)),
+                         lambda g, j, pt, ln, *_, _h=H:
+                         (g % _h, pt[g // _h, j], 0, 0)),
             pl.BlockSpec((1, 1, psize, dh),
-                         lambda g, j, pt, ln, _h=H: (g % _h, pt[g // _h, j],
-                                                     0, 0)),
+                         lambda g, j, pt, ln, *_, _h=H:
+                         (g % _h, pt[g // _h, j], 0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, 1, dh), lambda g, j, pt, ln: (g, 0, 0)),
+        out_specs=pl.BlockSpec((1, 1, dh),
+                               lambda g, j, pt, ln, *_: (g, 0, 0)),
         scratch_shapes=[
             pltpu.VMEM((8, 128), jnp.float32),
             pltpu.VMEM((8, 128), jnp.float32),
             pltpu.VMEM((8, dh), jnp.float32),
         ],
     )
+    scal = (page_tables.astype(jnp.int32), lengths.astype(jnp.int32))
+    if quant:
+        scal += (_scale_bits(k_scales), _scale_bits(v_scales))
     out = pl.pallas_call(
         kern,
         grid_spec=grid_spec,
@@ -870,17 +925,25 @@ def _rpa_pallas(q, k_pages, v_pages, page_tables, lengths, sm_scale):
         compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "arbitrary")),
         interpret=_interpret(),
-    )(page_tables.astype(jnp.int32), lengths.astype(jnp.int32), qr, kr, vr)
+    )(*scal, qr, kr, vr)
     return out.reshape(S, H, dh)
 
 
-def _rpa_multi_kernel(pt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
-                      m_scr, l_scr, acc_scr, *, psize, num_heads, sm_scale):
+def _rpa_multi_kernel(*refs, psize, num_heads, sm_scale, quant=False):
     """Widened ragged paged attention (ISSUE 12): W query rows per
     (slot, head) grid row, one KV page per inner step. Query row i masks
     keys at `len_ref[slot] + i` — consecutive positions, so a single
     per-slot scalar carries the whole ragged query-length structure.
-    Rows beyond a slot's real window produce garbage nobody commits."""
+    Rows beyond a slot's real window produce garbage nobody commits.
+    quant: int8 page pools with bitcast-int32 scalar-prefetch scales,
+    dequantized in VMEM (same scheme as `_rpa_kernel`)."""
+    if quant:
+        (pt_ref, len_ref, ks_ref, vs_ref, q_ref, k_ref, v_ref, o_ref,
+         m_scr, l_scr, acc_scr) = refs
+    else:
+        ks_ref = vs_ref = None
+        (pt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
+         m_scr, l_scr, acc_scr) = refs
     g = pl.program_id(0)                    # slot * num_heads + head
     j = pl.program_id(1)                    # page slot within the request
     nj = pl.num_programs(1)
@@ -888,6 +951,11 @@ def _rpa_multi_kernel(pt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
     length = len_ref[s_idx]                 # keys visible to query row 0
     k_start = j * psize
     wp = q_ref.shape[1]                     # padded query rows (>= 8)
+    if quant:
+        page = pt_ref[s_idx, j]
+        h_idx = g % num_heads
+        ks = lax.bitcast_convert_type(ks_ref[h_idx, page], jnp.float32)
+        vs = lax.bitcast_convert_type(vs_ref[h_idx, page], jnp.float32)
 
     @pl.when(j == 0)
     def _init():
@@ -902,6 +970,9 @@ def _rpa_multi_kernel(pt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
         q = q_ref[0]                        # (wp, dh)
         k = k_ref[0, 0]                     # (psize, dh)
         v = v_ref[0, 0]                     # (psize, dh)
+        if quant:
+            k = k.astype(jnp.float32) * ks
+            v = v.astype(jnp.float32) * vs
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * sm_scale
         qi = lax.broadcasted_iota(jnp.int32, (wp, psize), 0)
@@ -925,10 +996,12 @@ def _rpa_multi_kernel(pt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
                     jnp.maximum(l_scr[:, :1], 1e-30)).astype(o_ref.dtype)
 
 
-def _rpa_multi_pallas(q, k_pages, v_pages, page_tables, lengths, sm_scale):
+def _rpa_multi_pallas(q, k_pages, v_pages, page_tables, lengths, sm_scale,
+                      k_scales=None, v_scales=None):
     S, W, H, dh = q.shape
     psize = k_pages.shape[1]
     npages = page_tables.shape[1]
+    quant = k_scales is not None
     # pad the query-row dim to the Mosaic 8-sublane tile; extra rows
     # attend a few more (valid-page) keys and are sliced away below
     wp = max(8, -(-W // 8) * 8)
@@ -939,26 +1012,31 @@ def _rpa_multi_pallas(q, k_pages, v_pages, page_tables, lengths, sm_scale):
     vr = v_pages.transpose(2, 0, 1, 3)
     grid = (S * H, npages)
     kern = functools.partial(_rpa_multi_kernel, psize=psize, num_heads=H,
-                             sm_scale=sm_scale)
+                             sm_scale=sm_scale, quant=quant)
+    nsp = 4 if quant else 2
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,              # page tables + lengths
+        num_scalar_prefetch=nsp,        # page tables + lengths (+ scales)
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, wp, dh), lambda g, j, pt, ln: (g, 0, 0)),
+            pl.BlockSpec((1, wp, dh), lambda g, j, pt, ln, *_: (g, 0, 0)),
             pl.BlockSpec((1, 1, psize, dh),
-                         lambda g, j, pt, ln, _h=H: (g % _h, pt[g // _h, j],
-                                                     0, 0)),
+                         lambda g, j, pt, ln, *_, _h=H:
+                         (g % _h, pt[g // _h, j], 0, 0)),
             pl.BlockSpec((1, 1, psize, dh),
-                         lambda g, j, pt, ln, _h=H: (g % _h, pt[g // _h, j],
-                                                     0, 0)),
+                         lambda g, j, pt, ln, *_, _h=H:
+                         (g % _h, pt[g // _h, j], 0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, wp, dh), lambda g, j, pt, ln: (g, 0, 0)),
+        out_specs=pl.BlockSpec((1, wp, dh),
+                               lambda g, j, pt, ln, *_: (g, 0, 0)),
         scratch_shapes=[
             pltpu.VMEM((wp, 128), jnp.float32),
             pltpu.VMEM((wp, 128), jnp.float32),
             pltpu.VMEM((wp, dh), jnp.float32),
         ],
     )
+    scal = (page_tables.astype(jnp.int32), lengths.astype(jnp.int32))
+    if quant:
+        scal += (_scale_bits(k_scales), _scale_bits(v_scales))
     out = pl.pallas_call(
         kern,
         grid_spec=grid_spec,
@@ -966,7 +1044,7 @@ def _rpa_multi_pallas(q, k_pages, v_pages, page_tables, lengths, sm_scale):
         compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "arbitrary")),
         interpret=_interpret(),
-    )(page_tables.astype(jnp.int32), lengths.astype(jnp.int32), qr, kr, vr)
+    )(*scal, qr, kr, vr)
     return out[:, :W].reshape(S, H, W, dh).transpose(0, 2, 1, 3)
 
 
@@ -978,7 +1056,7 @@ def _rpa_pallas_ok(psize):
 
 
 def ragged_paged_attention(q, k_pages, v_pages, page_tables, lengths,
-                           sm_scale=None):
+                           sm_scale=None, k_scales=None, v_scales=None):
     """One shared attention launch per decode step over a paged KV cache.
 
     q: (S, H, dh) — ONE query token per decode slot — or (S, W, H, dh)
@@ -992,6 +1070,13 @@ def ragged_paged_attention(q, k_pages, v_pages, page_tables, lengths,
     lengths: (S,) int32 valid cached positions per slot INCLUDING the
     current (first) token. Returns (S, H, dh) or (S, W, H, dh).
 
+    k_scales/v_scales (ISSUE 14): per-page/per-head (P, H) f32 dequant
+    scales for int8 page pools. The Pallas kernels carry them through
+    scalar prefetch (bitcast int32) and dequantize each page block in
+    VMEM after the DMA — HBM traffic stays int8, the dequant rides free
+    inside the kernel; the lax fallback dequantizes only the GATHERED
+    context.
+
     On TPU (or MXTPU_PALLAS_INTERPRET=1) runs the Pallas kernel: the page
     table rides in scalar-prefetch SMEM and the BlockSpec index maps read
     it to DMA exactly the owned pages, skipping pages beyond each slot's
@@ -1000,22 +1085,29 @@ def ragged_paged_attention(q, k_pages, v_pages, page_tables, lengths,
     `single_query_cached_attention` (inference-only; no custom vjp)."""
     if sm_scale is None:
         sm_scale = 1.0 / (q.shape[-1] ** 0.5)
+    if (k_scales is None) != (v_scales is None):
+        raise ValueError("k_scales and v_scales must be given together")
     if q.ndim == 4:
         if _rpa_pallas_ok(k_pages.shape[1]):
             try:
                 return _rpa_multi_pallas(q, k_pages, v_pages, page_tables,
-                                         lengths, sm_scale)
+                                         lengths, sm_scale,
+                                         k_scales=k_scales,
+                                         v_scales=v_scales)
             except Exception as e:
                 _warn_fallback("ragged_paged_multi", e)
         return _paged_attention_lax_multi(q, k_pages, v_pages, page_tables,
-                                          lengths)
+                                          lengths, k_scales=k_scales,
+                                          v_scales=v_scales)
     if _rpa_pallas_ok(k_pages.shape[1]):
         try:
             return _rpa_pallas(q, k_pages, v_pages, page_tables, lengths,
-                               sm_scale)
+                               sm_scale, k_scales=k_scales,
+                               v_scales=v_scales)
         except Exception as e:
             _warn_fallback("ragged_paged", e)
-    return _paged_attention_lax(q, k_pages, v_pages, page_tables, lengths)
+    return _paged_attention_lax(q, k_pages, v_pages, page_tables, lengths,
+                                k_scales=k_scales, v_scales=v_scales)
 
 
 # ---------------------------------------------------------------------------

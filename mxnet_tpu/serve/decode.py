@@ -28,6 +28,22 @@ The per-layer math is `models.transformer`'s factored decode core
 the shared-math lax gather on the CPU mesh — so a paged decode is
 bitwise-identical to the dense-cache `decode_step` on equal context
 width (tests/test_serve.py pins this).
+
+Int8 KV cache (ISSUE 14, ``kv_dtype="int8"``): the page pools store
+int8 with PER-PAGE / PER-HEAD f32 scales in parallel ``(L, P, H)``
+arrays, so a fixed HBM page budget holds ~4x the tokens of fp32 pages
+(~2x bf16) — directly more concurrent requests per chip on the
+bandwidth-bound decode loop. Writes keep a RUNNING-MAX scale per page:
+a token whose |K| exceeds the page's current range grows the scale and
+requantises the page's existing rows in the same fused scatter (exact
+no-op when the scale doesn't move — ratio 1.0 round-trips int8
+losslessly); a write at page offset 0 RESETS the page (a freed page's
+stale scale must not leak into its next owner). Scales are indexed by
+page id, so prefix-cache page sharing and `defrag` carry them for free,
+and all four pool arrays are donated — the executables stay 1 dispatch
+/ 0 retraces (check_dispatch's quantized-serve phase gates this).
+Dequantisation happens inside `ragged_paged_attention` (in-kernel on
+TPU, gathered-context-only in the lax fallback).
 """
 from __future__ import annotations
 
@@ -44,12 +60,50 @@ from ..models.transformer import (decode_embed, decode_project,
                                   decoder_layer_cross_multi,
                                   decoder_layer_ffn,
                                   encode_memory, precompute_memory_kv)
+from ..observability import registry as _obs_registry
 from ..observability import tracer as _tracer
 from ..observability import compilex as _compilex
 from ..ops.pallas_kernels import ragged_paged_attention
 from .kv_pages import NULL_PAGE
 
 __all__ = ["DecodeRuntime", "MemoryStateLost"]
+
+
+def _quant_page_write(pages, scales, li, page, off, vals):
+    """Quantised paged K/V write with running-max per-page/per-head
+    scales (ISSUE 14). pages: (L, P, psize, H, dh) int8; scales:
+    (L, P, H) f32; page/off: (...,) int32 target page ids/offsets
+    (inactive rows routed to the null page by the caller); vals:
+    (..., H, dh) fp token projections. Leading dims are (S,) for the
+    1-wide decode program and (S, W) for the widened verify program —
+    duplicate page ids within a window are safe because every duplicate
+    computes identical update values (scatter-max for scales, identical
+    requantised blocks for content). Returns (pages, scales)."""
+    f32 = scales.dtype
+    amax = jnp.max(jnp.abs(vals.astype(f32)), axis=-1)       # (..., H)
+    # a write at offset 0 starts the page's life: zero the stale content
+    # AND scale a previous owner left behind (scales only ever grow
+    # within a life, so without the reset a hot former tenant would
+    # permanently coarsen the page's quantisation grid)
+    fresh_page = jnp.zeros((pages.shape[1],), bool).at[
+        jnp.where(off == 0, page, NULL_PAGE)].set(True)
+    sc = scales[li]                                          # (P, H)
+    sc0 = jnp.where(fresh_page[:, None], jnp.float32(0), sc)
+    new_sc = sc0.at[page].max(amax / 127.0)
+    old_g = sc[page]                                         # (..., H)
+    new_g = new_sc[page]
+    safe = jnp.maximum(new_g, 1e-30)
+    ratio = jnp.where(new_g > 0, old_g / safe, jnp.float32(1))
+    blk = pages[li, page].astype(f32)                # (..., psize, H, dh)
+    blk = jnp.round(blk * ratio[..., None, :, None])
+    blk = jnp.where(fresh_page[page][..., None, None, None],
+                    jnp.float32(0), blk)
+    tok = jnp.clip(jnp.round(vals.astype(f32) / safe[..., None]),
+                   -127, 127)
+    pages = pages.at[li, page].set(blk.astype(jnp.int8))
+    pages = pages.at[li, page, off].set(tok.astype(jnp.int8))
+    scales = scales.at[li].set(new_sc)
+    return pages, scales
 
 
 class MemoryStateLost(MXNetError):
@@ -69,7 +123,7 @@ class DecodeRuntime:
     host-side int arrays."""
 
     def __init__(self, weights, enc_weights, slots, num_pages, page_size,
-                 max_pages_per_slot, max_src_len, width=1):
+                 max_pages_per_slot, max_src_len, width=1, kv_dtype=None):
         u = weights["embed"].shape[1]
         h = weights["num_heads"]
         if u % h:
@@ -94,10 +148,14 @@ class DecodeRuntime:
             raise MXNetError(
                 f"max_src_len {self.max_src_len} exceeds the encoder pos "
                 f"table ({enc_pos}) — every prefill would fail")
-        dtype = weights["embed"].dtype
-        shape = (self._n_layers, self.num_pages, self.page_size, h, self._dh)
-        self.k_pages = jnp.zeros(shape, dtype)
-        self.v_pages = jnp.zeros(shape, dtype)
+        if kv_dtype not in (None, "float32", "int8"):
+            raise MXNetError(f"kv_dtype must be None/'float32'/'int8', "
+                             f"got {kv_dtype!r}")
+        self.kv_quant = kv_dtype == "int8"
+        # compute dtype from the (always-fp) pos table, NOT the embed —
+        # an int8-quantised weight snapshot keeps its embed in int8
+        self._dtype = weights["pos"].dtype
+        self.reset_pages()
         self.reset_mem()
         self.width = int(width)
         if self.width < 1:
@@ -114,17 +172,33 @@ class DecodeRuntime:
         # executables (`compiles{executable=serve_decode}` == number of
         # decode compilations, the same invariant decode_traces counts —
         # check_fusion budgets the decode HLO, test_serve pins zero warm
-        # recompiles against these counters)
-        self._decode_fn = _compilex.instrument(
-            jax.jit(self._decode_program, donate_argnums=(0, 1)),
-            "serve_decode")
+        # recompiles against these counters). int8-KV runtimes publish
+        # under their own *_int8 names so the quantized-serve budgets
+        # (check_fusion) and the fp budgets never shadow each other.
+        if self.kv_quant:
+            self._decode_fn = _compilex.instrument(
+                jax.jit(self._decode_program_q,
+                        donate_argnums=(0, 1, 2, 3)),
+                "serve_decode_int8")
+        else:
+            self._decode_fn = _compilex.instrument(
+                jax.jit(self._decode_program, donate_argnums=(0, 1)),
+                "serve_decode")
         self._prefill_fn = _compilex.instrument(
             jax.jit(self._prefill_program, donate_argnums=(0, 1, 2)),
             "serve_prefill")
-        self._remap_fn = _compilex.instrument(
-            jax.jit(lambda kp, vp, perm: (kp[:, perm], vp[:, perm]),
-                    donate_argnums=(0, 1)),
-            "serve_page_remap")
+        if self.kv_quant:
+            self._remap_fn = _compilex.instrument(
+                jax.jit(lambda kp, vp, ks, vs, perm:
+                        (kp[:, perm], vp[:, perm],
+                         ks[:, perm], vs[:, perm]),
+                        donate_argnums=(0, 1, 2, 3)),
+                "serve_page_remap")
+        else:
+            self._remap_fn = _compilex.instrument(
+                jax.jit(lambda kp, vp, perm: (kp[:, perm], vp[:, perm]),
+                        donate_argnums=(0, 1)),
+                "serve_page_remap")
         # the WIDENED verify executable (ISSUE 12): width > 1 servers run
         # every decode turn through one (slots, width) program — drafted
         # tokens verified by a single batched target pass, chunked prompt
@@ -133,14 +207,30 @@ class DecodeRuntime:
         # draft acceptance never retraces (verify_traces stays 1).
         self._verify_fn = None
         if self.width > 1:
-            self._verify_fn = _compilex.instrument(
-                jax.jit(self._verify_program, donate_argnums=(0, 1)),
-                "serve_verify")
+            if self.kv_quant:
+                self._verify_fn = _compilex.instrument(
+                    jax.jit(self._verify_program_q,
+                            donate_argnums=(0, 1, 2, 3)),
+                    "serve_verify_int8")
+            else:
+                self._verify_fn = _compilex.instrument(
+                    jax.jit(self._verify_program, donate_argnums=(0, 1)),
+                    "serve_verify")
 
     # ------------------------------------------------------- programs
-    def _decode_program(self, k_pages, v_pages, page_tables, lens, tok,
-                        active, mem_k, mem_v, mem_vl):
-        self.decode_traces += 1
+    # ONE decode/verify core each, shared by the fp and int8-KV entry
+    # points (`k_scales is None` selects the write/attention form at
+    # TRACE time — the fp programs lower to exactly the pre-ISSUE-14
+    # HLO, so a decode-loop fix can never reach one precision and miss
+    # the other).
+    def _page_write(self, pages, scales, li, page, off, vals):
+        if scales is None:
+            return pages.at[li, page, off].set(vals), None
+        return _quant_page_write(pages, scales, li, page, off, vals)
+
+    def _decode_core(self, k_pages, v_pages, k_scales, v_scales,
+                     page_tables, lens, tok, active, mem_k, mem_v,
+                     mem_vl):
         w, h, psize = self._w, self._h, self.page_size
         s_n = tok.shape[0]
         x = decode_embed(w, tok, lens)                       # (S, U)
@@ -153,25 +243,31 @@ class DecodeRuntime:
             qh = q.reshape(s_n, h, self._dh)
             kh = k.reshape(s_n, h, self._dh)
             vh = v.reshape(s_n, h, self._dh)
-            k_pages = k_pages.at[li, page, off].set(kh)
-            v_pages = v_pages.at[li, page, off].set(vh)
-            a = ragged_paged_attention(qh, k_pages[li], v_pages[li],
-                                       page_tables, lens + 1)
+            k_pages, k_scales = self._page_write(
+                k_pages, k_scales, li, page, off, kh)
+            v_pages, v_scales = self._page_write(
+                v_pages, v_scales, li, page, off, vh)
+            a = ragged_paged_attention(
+                qh, k_pages[li], v_pages[li], page_tables, lens + 1,
+                k_scales=None if k_scales is None else k_scales[li],
+                v_scales=None if v_scales is None else v_scales[li])
             x = decoder_layer_self_post(L, x, a.reshape(s_n, h * self._dh))
             x = decoder_layer_cross(L, h, x, mem_k[li], mem_v[li], mem_vl)
             x = decoder_layer_ffn(L, x)
         logits = decode_project(w, x)
         next_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        return k_pages, v_pages, next_tok, logits
+        return k_pages, v_pages, k_scales, v_scales, next_tok, logits
 
-    def _verify_program(self, k_pages, v_pages, page_tables, lens, toks,
-                        qlens, active, mem_k, mem_v, mem_vl):
+    def _verify_core(self, k_pages, v_pages, k_scales, v_scales,
+                     page_tables, lens, toks, qlens, active, mem_k,
+                     mem_v, mem_vl):
         """The widened decode step: toks (S, W) window tokens per slot at
         positions lens..lens+W-1, qlens (S,) valid window lengths (ragged
         — rows past qlen scatter to the null page and their outputs are
         garbage the scheduler never commits). Returns logits for EVERY
-        window position, so one dispatch verifies a whole drafted run."""
-        self.verify_traces += 1
+        window position, so one dispatch verifies a whole drafted run.
+        int8 mode: window writes that share a page combine through the
+        quantised write helper's scatter-max scales."""
         w, h, psize = self._w, self._h, self.page_size
         s_n, width = toks.shape
         npages = page_tables.shape[1]
@@ -189,12 +285,16 @@ class DecodeRuntime:
             qh = q.reshape(s_n, width, h, self._dh)
             kh = k.reshape(s_n, width, h, self._dh)
             vh = v.reshape(s_n, width, h, self._dh)
-            k_pages = k_pages.at[li, page, off].set(kh)
-            v_pages = v_pages.at[li, page, off].set(vh)
+            k_pages, k_scales = self._page_write(
+                k_pages, k_scales, li, page, off, kh)
+            v_pages, v_scales = self._page_write(
+                v_pages, v_scales, li, page, off, vh)
             # query i sees positions 0..lens+i (its own included): the
             # ragged-query-length form of the shared paged attention
-            a = ragged_paged_attention(qh, k_pages[li], v_pages[li],
-                                       page_tables, lens + 1)
+            a = ragged_paged_attention(
+                qh, k_pages[li], v_pages[li], page_tables, lens + 1,
+                k_scales=None if k_scales is None else k_scales[li],
+                v_scales=None if v_scales is None else v_scales[li])
             x = decoder_layer_self_post(
                 L, x, a.reshape(s_n, width, h * self._dh))
             x = decoder_layer_cross_multi(L, h, x, mem_k[li], mem_v[li],
@@ -202,7 +302,45 @@ class DecodeRuntime:
             x = decoder_layer_ffn(L, x)
         logits = decode_project(w, x)                    # (S, W, V)
         next_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return k_pages, v_pages, k_scales, v_scales, next_tok, logits
+
+    def _decode_program(self, k_pages, v_pages, page_tables, lens, tok,
+                        active, mem_k, mem_v, mem_vl):
+        self.decode_traces += 1
+        k_pages, v_pages, _, _, next_tok, logits = self._decode_core(
+            k_pages, v_pages, None, None, page_tables, lens, tok,
+            active, mem_k, mem_v, mem_vl)
         return k_pages, v_pages, next_tok, logits
+
+    def _verify_program(self, k_pages, v_pages, page_tables, lens, toks,
+                        qlens, active, mem_k, mem_v, mem_vl):
+        self.verify_traces += 1
+        k_pages, v_pages, _, _, next_tok, logits = self._verify_core(
+            k_pages, v_pages, None, None, page_tables, lens, toks,
+            qlens, active, mem_k, mem_v, mem_vl)
+        return k_pages, v_pages, next_tok, logits
+
+    def _decode_program_q(self, k_pages, v_pages, k_scales, v_scales,
+                          page_tables, lens, tok, active, mem_k, mem_v,
+                          mem_vl):
+        """The int8-KV decode step (ISSUE 14): the shared core with
+        page writes through the running-max quantiser and the attention
+        launch dequantising with the per-page scales. All four pool
+        arrays are donated — still ONE dispatch, still zero retraces
+        across occupancy."""
+        self.decode_traces += 1
+        return self._decode_core(k_pages, v_pages, k_scales, v_scales,
+                                 page_tables, lens, tok, active, mem_k,
+                                 mem_v, mem_vl)
+
+    def _verify_program_q(self, k_pages, v_pages, k_scales, v_scales,
+                          page_tables, lens, toks, qlens, active, mem_k,
+                          mem_v, mem_vl):
+        """The int8-KV widened verify step (see `_verify_core`)."""
+        self.verify_traces += 1
+        return self._verify_core(k_pages, v_pages, k_scales, v_scales,
+                                 page_tables, lens, toks, qlens, active,
+                                 mem_k, mem_v, mem_vl)
 
     def _prefill_program(self, mem_k, mem_v, mem_vl, src, src_len, slot):
         self.prefill_traces += 1
@@ -261,12 +399,18 @@ class DecodeRuntime:
         ragged-paged-attention launch, returns (next_tok (S,) host int32,
         logits (S, V) device array)."""
         profiler.record_dispatch("serve_decode")
-        self.k_pages, self.v_pages, next_tok, logits = self._decode_fn(
-            self.k_pages, self.v_pages,
-            jnp.asarray(page_tables, jnp.int32),
-            jnp.asarray(lens, jnp.int32), jnp.asarray(tok, jnp.int32),
-            jnp.asarray(active, jnp.int32),
-            self.mem_k, self.mem_v, self.mem_vl)
+        args = (jnp.asarray(page_tables, jnp.int32),
+                jnp.asarray(lens, jnp.int32), jnp.asarray(tok, jnp.int32),
+                jnp.asarray(active, jnp.int32),
+                self.mem_k, self.mem_v, self.mem_vl)
+        if self.kv_quant:
+            (self.k_pages, self.v_pages, self.k_scales, self.v_scales,
+             next_tok, logits) = self._decode_fn(
+                self.k_pages, self.v_pages, self.k_scales, self.v_scales,
+                *args)
+        else:
+            self.k_pages, self.v_pages, next_tok, logits = \
+                self._decode_fn(self.k_pages, self.v_pages, *args)
         return np.asarray(next_tok), logits
 
     def decode_multi(self, page_tables, lens, toks, qlens, active):
@@ -281,40 +425,73 @@ class DecodeRuntime:
             raise MXNetError("decode_multi needs width > 1 (construct "
                              "DecodeRuntime(width=k+1))")
         profiler.record_dispatch("serve_decode")
-        self.k_pages, self.v_pages, next_tok, logits = self._verify_fn(
-            self.k_pages, self.v_pages,
-            jnp.asarray(page_tables, jnp.int32),
-            jnp.asarray(lens, jnp.int32), jnp.asarray(toks, jnp.int32),
-            jnp.asarray(qlens, jnp.int32), jnp.asarray(active, jnp.int32),
-            self.mem_k, self.mem_v, self.mem_vl)
+        args = (jnp.asarray(page_tables, jnp.int32),
+                jnp.asarray(lens, jnp.int32), jnp.asarray(toks, jnp.int32),
+                jnp.asarray(qlens, jnp.int32),
+                jnp.asarray(active, jnp.int32),
+                self.mem_k, self.mem_v, self.mem_vl)
+        if self.kv_quant:
+            (self.k_pages, self.v_pages, self.k_scales, self.v_scales,
+             next_tok, logits) = self._verify_fn(
+                self.k_pages, self.v_pages, self.k_scales, self.v_scales,
+                *args)
+        else:
+            self.k_pages, self.v_pages, next_tok, logits = \
+                self._verify_fn(self.k_pages, self.v_pages, *args)
         return np.asarray(next_tok), logits
 
     def remap_pages(self, mapping):
-        """Apply a `PagePool.defrag()` renumbering to the device pools:
-        one gather-permutation dispatch (donated, in-place)."""
+        """Apply a `PagePool.defrag()` renumbering to the device pools
+        (and, int8 mode, the parallel scale arrays — scales travel with
+        their page ids): one gather-permutation dispatch (donated,
+        in-place)."""
         if not mapping:
             return
         perm = np.arange(self.num_pages)
         for old, new in mapping.items():
             perm[new] = old
         profiler.record_dispatch("serve_page_remap")
-        self.k_pages, self.v_pages = self._remap_fn(
-            self.k_pages, self.v_pages, jnp.asarray(perm))
+        if self.kv_quant:
+            (self.k_pages, self.v_pages, self.k_scales,
+             self.v_scales) = self._remap_fn(
+                self.k_pages, self.v_pages, self.k_scales, self.v_scales,
+                jnp.asarray(perm))
+        else:
+            self.k_pages, self.v_pages = self._remap_fn(
+                self.k_pages, self.v_pages, jnp.asarray(perm))
 
     def reset_pages(self):
-        """Drop ALL cached KV state (used by the scheduler's catastrophic
-        failure path after an executable error, when page contents can no
-        longer be trusted)."""
-        shape = self.k_pages.shape
-        dtype = self.k_pages.dtype
-        self.k_pages = jnp.zeros(shape, dtype)
-        self.v_pages = jnp.zeros(shape, dtype)
+        """Drop ALL cached KV state, scales included (construction, and
+        the scheduler's catastrophic failure path after an executable
+        error, when page contents can no longer be trusted)."""
+        shape = (self._n_layers, self.num_pages, self.page_size, self._h,
+                 self._dh)
+        if self.kv_quant:
+            self.k_pages = jnp.zeros(shape, jnp.int8)
+            self.v_pages = jnp.zeros(shape, jnp.int8)
+            sshape = (self._n_layers, self.num_pages, self._h)
+            self.k_scales = jnp.zeros(sshape, jnp.float32)
+            self.v_scales = jnp.zeros(sshape, jnp.float32)
+            _obs_registry().gauge("kv_page_scale_bytes").set(
+                2 * self.k_scales.size * 4)
+        else:
+            self.k_pages = jnp.zeros(shape, self._dtype)
+            self.v_pages = jnp.zeros(shape, self._dtype)
+            self.k_scales = self.v_scales = None
+
+    def kv_bytes_per_page(self):
+        """Device bytes one page costs in THIS runtime's layout (K + V
+        across layers; int8 mode includes the per-page scale rows)."""
+        from .quant import kv_page_bytes
+        return kv_page_bytes(
+            self._n_layers, self.page_size, self._h, self._dh,
+            "int8" if self.kv_quant else str(self._dtype))
 
     def reset_mem(self):
         """Rebuild zeroed per-slot encoder memory (after a prefill
         failure consumed the donated buffers)."""
         shape = (self._n_layers, self.slots, self._h, self.max_src_len,
                  self._dh)
-        self.mem_k = jnp.zeros(shape, self._w["embed"].dtype)
-        self.mem_v = jnp.zeros(shape, self._w["embed"].dtype)
+        self.mem_k = jnp.zeros(shape, self._dtype)
+        self.mem_v = jnp.zeros(shape, self._dtype)
         self.mem_vl = jnp.zeros((self.slots,), jnp.int32)

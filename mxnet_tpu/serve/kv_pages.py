@@ -41,6 +41,16 @@ cleared — asserted by the tier-1 serve tests including the chaos case),
 `kv_page_refs` (gauge: total outstanding references across all pages),
 `kv_page_allocs` / `kv_page_shares` / `kv_page_frees` /
 `kv_page_alloc_failures` counters and `kv_pool_defrags`.
+
+Int8 KV mode (ISSUE 14): the pool's accounting is dtype-agnostic — the
+device arrays (int8 pages + the per-page/per-head scale arrays) live on
+`serve.decode.DecodeRuntime(kv_dtype="int8")`, and scales are indexed
+by PAGE ID, so every host-side operation here (share/free/defrag
+renumbering) governs the scales for free. `page_bytes` (passed by the
+Server from `DecodeRuntime.kv_bytes_per_page()`) records what one page
+costs in HBM — `kv_pool_bytes` is the capacity story's denominator: at
+a fixed byte budget an int8 pool simply HAS ~4x the fp32 pages
+(`serve.quant.pages_for_budget`).
 """
 from __future__ import annotations
 
@@ -61,7 +71,8 @@ class PageAllocError(MXNetError):
 class PagePool:
     """Host-side refcounted page allocator over a fixed device page pool."""
 
-    def __init__(self, num_pages, page_size, registry=None):
+    def __init__(self, num_pages, page_size, registry=None,
+                 page_bytes=None):
         if num_pages < 2:
             raise MXNetError("PagePool needs num_pages >= 2 (page 0 is "
                              "the reserved null page)")
@@ -69,12 +80,19 @@ class PagePool:
             raise MXNetError("page_size must be >= 1")
         self.num_pages = int(num_pages)
         self.page_size = int(page_size)
+        # HBM bytes one page costs (ISSUE 14: the Server passes the
+        # runtime's dtype-aware figure, scale arrays included) — None
+        # when the caller doesn't account bytes
+        self.page_bytes = None if page_bytes is None else int(page_bytes)
         self._lock = threading.Lock()
         # LIFO free stack: hot pages get reused while still cache/TLB warm
         self._free = list(range(self.num_pages - 1, NULL_PAGE, -1))
         self._refs = {}                 # page id -> owner count (>= 1)
         reg = registry if registry is not None else _obs_registry()
         reg.gauge("kv_pages_total").set(self.capacity)
+        if self.page_bytes is not None:
+            reg.gauge("kv_pool_bytes").set(
+                self.num_pages * self.page_bytes)
         self._in_use_gauge = reg.gauge("kv_pages_in_use")
         self._in_use_gauge.set(0)
         self._refs_gauge = reg.gauge("kv_page_refs")

@@ -1,0 +1,118 @@
+"""Low-precision serving fast path (ISSUE 14): per-channel int8 weight
+snapshots for the serve executables + the KV-page byte accounting that
+turns a fixed HBM budget into a page count.
+
+Weight quantization rides the same master-weight discipline as AMP
+(mxnet_tpu/amp.py): the MODEL keeps its full-precision parameters — the
+server quantizes a SNAPSHOT of the decode/encode weight pytrees at
+construction, so training/eager paths are untouched and a re-snapshot
+(new `Server`) picks up updated masters. Each Dense leaf ``(w, b)``
+becomes ``(w_int8, b, scale)`` with one symmetric scale per OUTPUT
+channel (`contrib.quantization.quantize_channelwise(axis=0)`); the tied
+embedding quantizes per vocabulary row (``embed_scale``). LayerNorm
+parameters stay full precision, the same keep-fp32 rule as
+`amp.convert_block`. Dequantization is FOLDED INTO THE DOTS by
+`models.transformer._affine` / `decode_project`: the dot runs over the
+exact int8 values converted in-register and the per-channel scale lands
+as one epilogue multiply, so XLA fuses the whole thing into the matmul
+(tools/check_fusion.py budgets the quantized-serve executables' copies).
+
+KV byte accounting (`kv_page_bytes` / `pages_for_budget`): the decode
+hot loop is memory-bandwidth-bound, so the int8 KV cache's real win is
+CAPACITY — the same HBM byte budget holds int8 pages' tokens where fp32
+pages held a quarter as many (per-page scale arrays included in the
+arithmetic, so the claim is honest). `Server(kv_hbm_bytes=...)` sizes
+its pool through `pages_for_budget`; the check_dispatch quantized-serve
+phase pins the >= 1.9x token-capacity ratio.
+"""
+from __future__ import annotations
+
+from ..base import MXNetError
+from ..contrib.quantization import quantize_channelwise
+
+__all__ = ["quantize_decoder_weights", "quantize_encoder_weights",
+           "kv_page_bytes", "pages_for_budget", "token_capacity"]
+
+# the Dense leaves of one decoder/encoder layer dict (LayerNorm tuples
+# — ln1/ln2/ln3 — stay full precision, amp.convert_block's keep-fp32
+# rule applied to the snapshot)
+_DEC_DENSE = ("qkv", "sproj", "q", "kv", "cproj", "ffn1", "ffn2")
+_ENC_DENSE = ("qkv", "proj", "ffn1", "ffn2")
+
+
+def _quant_dense(wb):
+    """(w, b) -> (w_int8, b, scale): per-output-channel symmetric int8.
+    `models.transformer._affine` recognises the 3-tuple and folds the
+    scale into the dot epilogue."""
+    w, b = wb
+    wq, scale = quantize_channelwise(w, axis=0)
+    return (wq, b, scale)
+
+
+def _quant_tree(weights, dense_keys):
+    out = dict(weights)
+    embed_q, embed_scale = quantize_channelwise(weights["embed"], axis=0)
+    out["embed"] = embed_q
+    out["embed_scale"] = embed_scale      # per-vocab-row (tied projection)
+    out["layers"] = [
+        {k: (_quant_dense(v) if k in dense_keys else v)
+         for k, v in layer.items()}
+        for layer in weights["layers"]]
+    return out
+
+
+def quantize_decoder_weights(weights):
+    """Per-channel int8 snapshot of a `decoder_weights(model)` pytree
+    (Server(weight_dtype="int8") decode path). The input tree is not
+    mutated — the model's master weights stay full precision."""
+    return _quant_tree(weights, _DEC_DENSE)
+
+
+def quantize_encoder_weights(weights):
+    """Per-channel int8 snapshot of an `encoder_weights(model)` pytree
+    (Server(weight_dtype="int8") prefill path)."""
+    return _quant_tree(weights, _ENC_DENSE)
+
+
+# ------------------------------------------------- KV byte accounting
+_KV_ITEMSIZE = {"float32": 4, "bfloat16": 2, "float16": 2, "int8": 1}
+
+
+def kv_page_bytes(n_layers, page_size, num_heads, head_dim,
+                  kv_dtype="float32"):
+    """Device bytes ONE KV page costs: K + V across all decoder layers,
+    plus (int8 mode) the per-page/per-head f32 scale rows the page drags
+    along — included so the capacity claim is honest."""
+    kv_dtype = str(kv_dtype)
+    if kv_dtype not in _KV_ITEMSIZE:
+        raise MXNetError(f"unknown kv_dtype {kv_dtype!r} (one of "
+                         f"{sorted(_KV_ITEMSIZE)})")
+    per_side = n_layers * page_size * num_heads * head_dim \
+        * _KV_ITEMSIZE[kv_dtype]
+    scale = n_layers * num_heads * 4 if kv_dtype == "int8" else 0
+    return 2 * (per_side + scale)
+
+
+def pages_for_budget(budget_bytes, n_layers, page_size, num_heads,
+                     head_dim, kv_dtype="float32"):
+    """Pool size (num_pages, INCLUDING the reserved null page) a fixed
+    HBM byte budget affords. int8 pages are a quarter the fp32 bytes
+    (half of bf16), which is directly more tokens — therefore more
+    concurrent users — per chip."""
+    per_page = kv_page_bytes(n_layers, page_size, num_heads, head_dim,
+                             kv_dtype)
+    num_pages = int(budget_bytes) // per_page
+    if num_pages < 2:
+        raise MXNetError(
+            f"kv_hbm_bytes={budget_bytes} affords {num_pages} page(s) of "
+            f"{per_page} bytes — the pool needs at least 2 (one usable + "
+            f"the reserved null page)")
+    return num_pages
+
+
+def token_capacity(budget_bytes, n_layers, page_size, num_heads, head_dim,
+                   kv_dtype="float32"):
+    """Usable cached TOKENS the budget holds (null page excluded) — the
+    number the >=1.9x int8-vs-fp32 acceptance pin compares."""
+    return (pages_for_budget(budget_bytes, n_layers, page_size, num_heads,
+                             head_dim, kv_dtype) - 1) * page_size

@@ -249,7 +249,7 @@ class StepResult:
 class Scheduler:
     def __init__(self, runtime, pool, bos_id=2, eos_id=3, max_queue=64,
                  max_retries=1, max_preemptions=8, static_batching=False,
-                 prefix_cache=True, spec_ngram=2):
+                 prefix_cache=True, spec_ngram=2, quant_fallback=None):
         import numpy as np
         self._np = np
         self._rt = runtime
@@ -274,6 +274,11 @@ class Scheduler:
         # pressure cannot burn a request's fault retries
         self.max_preemptions = int(max_preemptions)
         self.static_batching = bool(static_batching)
+        # low-precision degradation path (ISSUE 14): on a `serve.quant`
+        # fault, a quantized server routes THAT request through this
+        # full-precision callback instead of the int8 executables —
+        # identical greedy output to an fp32 server, no pages touched
+        self._quant_fallback = quant_fallback
         s = runtime.slots
         self._slots = [None] * s                       # Request per slot
         self._page_tables = np.full(
@@ -316,6 +321,7 @@ class Scheduler:
         self._m_spec_accepted = reg.counter("serve_spec_accepted")
         self._m_spec_degraded = reg.counter("serve_spec_degraded")
         self._m_prefix_degraded = reg.counter("serve_prefix_degraded")
+        self._m_quant_degraded = reg.counter("serve_quant_degraded")
         self._m_warm_pref = reg.counter("serve_prefix_admit_preferred")
         # per-instance tallies (registry counters are process-global)
         self.decode_turns = 0
@@ -623,6 +629,16 @@ class Scheduler:
                     break
                 req = self._pop_next_locked()
                 self._m_queue.set(len(self._queue))
+            # serve.quant fault (ISSUE 14): degrade THIS request to the
+            # full-precision path before it touches pages or slots —
+            # leak-freedom is structural (nothing was allocated yet)
+            if self._quant_fallback is not None and _finj.ENABLED:
+                try:
+                    _finj.check("serve.quant",
+                                context=f"request {req.id}")
+                except _finj.FaultInjected:
+                    self._degrade_quant(req)
+                    continue
             psize = self._pool.page_size
             known = [self.bos_id] + req.prompt
             # prefix-cache adoption (ISSUE 12): the longest cached chain
@@ -858,6 +874,49 @@ class Scheduler:
             return
         pages = [int(p) for p in self._page_tables[s, :ncache]]
         self._cache.insert(self._src_key(r), r.known, pages)
+
+    def _degrade_quant(self, req):
+        """Run one request through the full-precision fallback (a
+        `serve.quant` fault fired at its admission): greedy output is
+        IDENTICAL to an fp32 server's, the quantized executables and the
+        page pool are never touched for it, and the handle's stream/
+        result plumbing behaves normally (tokens arrive in one burst).
+        The request's end-to-end deadline stays in force — the remaining
+        budget rides into the fallback, and expiry surfaces as the same
+        `ServeDeadlineExceeded` the normal path raises."""
+        self._m_quant_degraded.inc()
+        req.state = "running"
+        try:
+            toks = self._quant_fallback(req.src, req.prompt,
+                                        req.max_new_tokens,
+                                        deadline=req.deadline)
+        except ServeDeadlineExceeded:
+            self._m_deadline.inc()
+            self._m_failed.inc()
+            req._exc = ServeDeadlineExceeded(
+                f"request {req.id} exceeded its deadline (degraded "
+                f"full-precision attempt)")
+            req._finish("failed", "deadline exceeded")
+            return
+        except Exception as e:
+            self._m_failed.inc()
+            req._finish("failed", f"quant degrade failed: {e!r}")
+            return
+        now = time.perf_counter()
+        if toks and req.t_first_token is None:
+            req.t_first_token = now
+        for tok in toks:
+            req._emit(tok)
+        self._m_ok.inc()
+        self._m_tokens.inc(len(req.tokens))
+        self.tokens_generated += len(req.tokens)
+        if req.ttft is not None:
+            self._m_ttft.observe(req.ttft)
+        self._m_latency.observe(time.perf_counter() - req.t_submit)
+        req._finish("done")
+        if _tracer.ACTIVE:
+            _tracer.instant("serve.quant_degraded",
+                            args={"id": req.id, "tokens": len(req.tokens)})
 
     def _release_slot(self, s, r):
         if r._pages:

@@ -49,38 +49,92 @@ class Server:
     budget denominator is prompt + generation); speculative_k: tokens
     drafted per turn and verified in ONE widened dispatch (0 = classic
     one-token turns); prefix_cache: share full prompt pages across
-    requests through the content-hashed radix index. See
-    docs/SERVING.md for pool sizing and the fast-path contracts."""
+    requests through the content-hashed radix index.
+
+    Low precision (ISSUE 14): `kv_dtype="int8"` stores K/V pages int8
+    with per-page/per-head scales — a fixed HBM budget holds ~4x the
+    tokens of fp32 pages (`kv_hbm_bytes=` sizes the pool from a byte
+    budget instead of a page count); `weight_dtype="int8"` runs the
+    decode/prefill matmuls over per-output-channel int8 weight
+    SNAPSHOTS (the model's master weights stay full precision). Every
+    quantized server keeps a lazy full-precision twin: a `serve.quant`
+    fault degrades that request to it with fp32-identical greedy
+    output. See docs/SERVING.md "Low-precision serving" for the
+    accuracy contract and knobs."""
 
     def __init__(self, model, slots=8, page_size=16, num_pages=None,
                  max_src_len=32, max_new_tokens=32, max_prompt_len=0,
                  speculative_k=0, prefix_cache=True, bos_id=2, eos_id=3,
                  max_queue=64, max_retries=1, static_batching=False,
-                 engine_driven=True):
+                 engine_driven=True, kv_dtype=None, weight_dtype=None,
+                 kv_hbm_bytes=None):
         if max_new_tokens < 1:
             raise MXNetError("max_new_tokens must be >= 1")
         if speculative_k < 0:
             raise MXNetError("speculative_k must be >= 0")
+        if weight_dtype not in (None, "float32", "int8"):
+            raise MXNetError(f"weight_dtype must be None/'float32'/"
+                             f"'int8', got {weight_dtype!r}")
         self.max_new_tokens = int(max_new_tokens)
         self.max_prompt_len = int(max_prompt_len)
         self.speculative_k = int(speculative_k)
+        self.kv_dtype = kv_dtype if kv_dtype != "float32" else None
+        self.weight_dtype = weight_dtype if weight_dtype != "float32" \
+            else None
+        dec_w = decoder_weights(model)
+        enc_w = encoder_weights(model)
+        if self.weight_dtype == "int8":
+            from .quant import (quantize_decoder_weights,
+                                quantize_encoder_weights)
+            dec_w = quantize_decoder_weights(dec_w)
+            enc_w = quantize_encoder_weights(enc_w)
         budget_tokens = int(max_new_tokens) + self.max_prompt_len
         if num_pages is None:
-            # every slot can hold a full-length request + the null page
-            num_pages = slots * \
-                (-(-budget_tokens // int(page_size))) + 1
-        self._pool = PagePool(num_pages, page_size)
+            if kv_hbm_bytes is not None:
+                # pool sized from an HBM byte budget: the int8 cache's
+                # capacity story — same bytes, ~4x the fp32 tokens
+                from .quant import pages_for_budget
+                u = dec_w["embed"].shape[1]
+                h = dec_w["num_heads"]
+                num_pages = pages_for_budget(
+                    kv_hbm_bytes, len(dec_w["layers"]), int(page_size),
+                    h, u // h, self.kv_dtype or str(dec_w["pos"].dtype))
+            else:
+                # every slot can hold a full-length request + null page
+                num_pages = slots * \
+                    (-(-budget_tokens // int(page_size))) + 1
+        elif kv_hbm_bytes is not None:
+            raise MXNetError("pass num_pages OR kv_hbm_bytes, not both")
+        try:
+            from .quant import kv_page_bytes
+            u = dec_w["embed"].shape[1]
+            h = dec_w["num_heads"]
+            pbytes = kv_page_bytes(
+                len(dec_w["layers"]), int(page_size), h, u // h,
+                self.kv_dtype or str(dec_w["pos"].dtype))
+        except MXNetError:
+            pbytes = None            # exotic compute dtype: no byte gauge
+        self._pool = PagePool(num_pages, page_size, page_bytes=pbytes)
         pages_per_slot = self._pool.pages_for(budget_tokens)
         self._rt = DecodeRuntime(
-            decoder_weights(model), encoder_weights(model), slots=slots,
+            dec_w, enc_w, slots=slots,
             num_pages=num_pages, page_size=page_size,
             max_pages_per_slot=pages_per_slot, max_src_len=max_src_len,
-            width=self.speculative_k + 1)
+            width=self.speculative_k + 1, kv_dtype=self.kv_dtype)
+        # quantized servers keep the model handle so a serve.quant fault
+        # can degrade a request to a lazily-built full-precision twin
+        self._model = model if (self.kv_dtype or self.weight_dtype) \
+            else None
+        self._fp_twin = None
+        self._fp_lock = threading.Lock()
+        quant_fallback = self._full_precision_decode if \
+            self._model is not None else None
         self._sched = Scheduler(self._rt, self._pool, bos_id=bos_id,
                                 eos_id=eos_id, max_queue=max_queue,
                                 max_retries=max_retries,
                                 static_batching=static_batching,
-                                prefix_cache=prefix_cache)
+                                prefix_cache=prefix_cache,
+                                quant_fallback=quant_fallback)
         self._engine_driven = bool(engine_driven)
         self._loop = EngineLoop(self._sched) if self._engine_driven \
             else None
@@ -194,6 +248,38 @@ class Server:
         self._m_tps.set(tps)
         return tps
 
+    def _full_precision_decode(self, src, prompt, max_new,
+                               deadline=None):
+        """The serve.quant degradation path (ISSUE 14): decode ONE
+        request through a lazily-built full-precision twin server (1
+        slot, inline, no prefix cache, no speculation) — greedy output
+        is identical to an fp32 `Server`'s BY CONSTRUCTION, and the
+        request never touches the quantized executables or this
+        server's page pool. The twin compiles on the first fault only;
+        fault-free quantized serving pays nothing. `deadline` is the
+        original request's absolute monotonic deadline: the REMAINING
+        budget becomes the twin request's own `deadline_ms`, so expiry
+        surfaces as `ServeDeadlineExceeded` exactly as on the normal
+        path (a degraded request gets no deadline amnesty)."""
+        deadline_ms = None
+        if deadline is not None:
+            deadline_ms = max(0.0, (deadline - time.monotonic()) * 1e3)
+        with self._fp_lock:
+            if self._fp_twin is None:
+                self._fp_twin = Server(
+                    self._model, slots=1,
+                    page_size=self._pool.page_size,
+                    max_src_len=self._rt.max_src_len,
+                    max_new_tokens=self.max_new_tokens,
+                    max_prompt_len=self.max_prompt_len,
+                    bos_id=self._sched.bos_id, eos_id=self._sched.eos_id,
+                    prefix_cache=False, engine_driven=False)
+            h = self._fp_twin.submit(
+                src, max_new,
+                prompt_tokens=prompt if len(prompt) else None,
+                deadline_ms=deadline_ms)
+            return h.result(timeout=600)
+
     def close(self):
         """Stop the loop and FAIL any still-pending requests (their
         handles unblock with `ServeError`, their pages return to the
@@ -205,6 +291,14 @@ class Server:
         if self._loop is not None:
             self._loop.close()
         self._sched.shutdown()
+        if self._rt.kv_quant:
+            # the gauge is last-writer-wins across servers (like
+            # serve_tokens_per_s); a closed pool's scale bytes are gone
+            _obs_registry().gauge("kv_page_scale_bytes").set(0)
+        with self._fp_lock:
+            if self._fp_twin is not None:
+                self._fp_twin.close()
+                self._fp_twin = None
 
     def __enter__(self):
         return self

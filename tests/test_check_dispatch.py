@@ -51,6 +51,14 @@ def test_captured_dispatch_budget_and_parity():
     assert res["serve_prefix_nocache_turns"] >= \
         res["serve_prefix_cold_turns"]
     assert res["serve_fastpath_pages_leaked"] == 0
+    # ISSUE 14: the QUANTIZED serve path — int8-KV decode turns hold
+    # the same one-dispatch/zero-retrace budget, a fixed HBM byte
+    # budget holds >= 1.9x the fp32 pool's tokens, and the page
+    # accounting stays exact at that capacity (zero leaked pages)
+    assert res["serve_int8_dispatches_per_step"] <= 1
+    assert res["serve_int8_retraces"] == 0
+    assert res["serve_int8_capacity_ratio"] >= 1.9
+    assert res["serve_int8_pages_leaked"] == 0
 
 
 def test_check_dispatch_cli_smoke():

@@ -43,6 +43,18 @@ def test_fusion_budgets_hold_and_control_trips():
     assert res["serve_verify"]["aliased_inputs"] == 2
     assert res["serve_verify"]["collective_total"] == 0
     assert res["serve_verify_traces"] == 1
+    # ISSUE 14: the quantized-serve executables — int8 KV pages with
+    # per-page scales + per-channel int8 weights — hold their fusion
+    # and copy bands (dequant fused into the dots, not a copy pass) and
+    # keep all FOUR donated pool buffers (pages + scales) aliased
+    for name in ("serve_decode_int8", "serve_verify_int8"):
+        lo, hi = check_fusion.BUDGETS[name]["fusions"]
+        assert lo <= res[name]["fusions"] <= hi
+        clo, chi = check_fusion.BUDGETS[name]["copies"]
+        assert clo <= res[name]["copies"] <= chi
+        assert res[name]["aliased_inputs"] == 4
+        assert res[name]["collective_total"] == 0
+    assert res["serve_int8_traces"] == 2
     # the gate provably bites: the fusion-pass-disabled control landed
     # below the band and tripped the SAME budget table
     assert res["control_tripped"] is True
@@ -170,4 +182,4 @@ def test_check_fusion_cli_smoke():
     assert callable(check_fusion.main)
     assert set(check_fusion.BUDGETS) == {
         "captured_step", "sharded_step", "serve_decode", "serve_prefill",
-        "serve_verify"}
+        "serve_verify", "serve_decode_int8", "serve_verify_int8"}

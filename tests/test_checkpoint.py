@@ -558,3 +558,72 @@ def test_health_extra_forbidden_even_without_health_kwarg():
         with pytest.raises(mx.base.MXNetError):
             mgr.save(1, {"w": jnp.ones(2)},
                      extras={checkpoint.HEALTH_NAME: b'{"healthy": false}'})
+
+
+# ------------------------------------------- quantization scheme (ISSUE 14)
+def test_manifest_records_quantization_scheme():
+    """int8-quantized params document their scheme in the manifest the
+    way partition specs do: auto-derived from storage dtypes, readable
+    back, absent for fp-only trees."""
+    with tempfile.TemporaryDirectory() as d:
+        params = {"w": jnp.arange(12, dtype=jnp.int8).reshape(3, 4),
+                  "w_scale": jnp.ones((3,), jnp.float32),
+                  "b": jnp.zeros((4,), jnp.float32)}
+        checkpoint.save_sharded(d, 0, params)
+        scheme = checkpoint.saved_quantization(d, 0)
+        assert scheme["dtype"] == "int8"
+        assert scheme["leaves"]["w"] == {"dtype": "int8",
+                                         "shape": [3, 4]}
+        # scale/bias leaves are fp — not part of the quantized set
+        assert "w_scale" not in scheme["leaves"]
+        # a matching template restores
+        t = {"w": jnp.zeros((3, 4), jnp.int8),
+             "w_scale": jnp.zeros((3,), jnp.float32),
+             "b": jnp.zeros((4,), jnp.float32)}
+        out = checkpoint.load_sharded(d, 0, t)
+        assert int(np.asarray(out["w"]).sum()) == 66
+        # fp-only trees record an EXPLICIT empty scheme ("known full
+        # precision"); quantization=False omits the key entirely
+        checkpoint.save_sharded(d, 1, {"a": jnp.zeros((2,), jnp.float32)})
+        assert checkpoint.saved_quantization(d, 1) == {
+            "dtype": None, "leaves": {}}
+        checkpoint.save_sharded(d, 2, params, quantization=False)
+        assert checkpoint.saved_quantization(d, 2) is None
+        # no recorded scheme = UNKNOWN, never a refusal: the opted-out
+        # int8 save still restores into a matching int8 template
+        assert checkpoint.quantization_mismatches(
+            os.path.join(d, "2"), t) == []
+        out2 = checkpoint.load_sharded(d, 2, t)
+        assert int(np.asarray(out2["w"]).sum()) == 66
+
+
+def test_quantization_mismatch_refused_preflight():
+    """A scheme-mismatched restore is refused PRE-FLIGHT with leaf names
+    (instead of an XLA/orbax dtype-shape error), and the diagnosis names
+    every direction: quantized-saved vs fp template, fp-saved vs
+    quantized template, and shape drift."""
+    with tempfile.TemporaryDirectory() as d:
+        params = {"w": jnp.zeros((3, 4), jnp.int8),
+                  "b": jnp.zeros((4,), jnp.float32)}
+        checkpoint.save_sharded(d, 0, params)
+        fp_t = {"w": jnp.zeros((3, 4), jnp.float32),
+                "b": jnp.zeros((4,), jnp.float32)}
+        with pytest.raises(mx.base.MXNetError, match="quantization"):
+            checkpoint.load_sharded(d, 0, fp_t)
+        diag = checkpoint.quantization_mismatches(
+            os.path.join(d, "0"), fp_t)
+        assert any("w" in line and "full precision" in line
+                   for line in diag)
+        # shape drift
+        shp_t = {"w": jnp.zeros((6, 4), jnp.int8),
+                 "b": jnp.zeros((4,), jnp.float32)}
+        diag = checkpoint.quantization_mismatches(
+            os.path.join(d, "0"), shp_t)
+        assert any("template wants" in line for line in diag)
+        # the reverse direction: fp checkpoint into a quantized template
+        checkpoint.save_sharded(d, 1, fp_t)
+        diag = checkpoint.quantization_mismatches(
+            os.path.join(d, "1"), params)
+        assert any("saved it full precision" in line for line in diag)
+        with pytest.raises(mx.base.MXNetError, match="quantization"):
+            checkpoint.load_sharded(d, 1, params)

@@ -568,3 +568,31 @@ def test_quantized_pooling_uint8_and_int_attrs():
         sym.Variable("b1"), sym.Variable("a2"), sym.Variable("b2"),
         stride=1, pad=1, no_bias=True)
     assert "_contrib_quantized_conv" in s2.tojson()
+
+
+def test_quantize_channelwise_per_channel_scales():
+    """ISSUE 14: per-channel symmetric int8 — one independent scale per
+    index of `axis`, reconstruction error bounded by half a quantisation
+    step per channel, zero channels exact."""
+    import jax.numpy as jnp
+    rng = np.random.RandomState(0)
+    w = rng.randn(5, 16).astype(np.float32)
+    w[2] *= 100.0          # a hot channel must not coarsen the others
+    w[4] = 0.0             # all-zero channel
+    wq, scale = q.quantize_channelwise(jnp.asarray(w), axis=0)
+    assert wq.dtype == jnp.int8 and scale.shape == (5,)
+    rec = np.asarray(wq, np.float32) * np.asarray(scale)[:, None]
+    amax = np.abs(w).max(axis=1)
+    for c in range(5):
+        step = max(amax[c], 1e-12) / 127.0
+        assert np.max(np.abs(rec[c] - w[c])) <= step / 2 + 1e-7
+    assert np.all(rec[4] == 0.0)
+    # per-channel independence: the hot row's scale is ~100x the rest
+    s = np.asarray(scale)
+    assert s[2] > 20 * s[0]
+    # axis=1 variant quantises per input channel
+    wq1, scale1 = q.quantize_channelwise(jnp.asarray(w), axis=1)
+    assert scale1.shape == (16,)
+    rec1 = np.asarray(wq1, np.float32) * np.asarray(scale1)[None, :]
+    step1 = np.abs(w).max(axis=0) / 127.0
+    assert np.all(np.abs(rec1 - w) <= step1[None, :] / 2 + 1e-7)

@@ -1184,3 +1184,222 @@ def test_warm_preference_cannot_starve_cold_head():
     assert len(blocker.result()) >= 1
     srv.close()
     assert srv.pool.in_use() == 0
+
+
+# ------------------------------------------- low precision (ISSUE 14)
+def _int8_model():
+    # smaller than _tiny_model: the low-precision suite compiles several
+    # extra executables, and the tier-1 window is tight
+    return _tiny_model(vocab=40, units=16, layers=1, heads=2,
+                       max_length=48, seed=13)
+
+
+def _match_rate(ref, out):
+    matched = sum(sum(1 for x, y in zip(a, b) if x == y)
+                  for a, b in zip(ref, out))
+    total = sum(max(len(a), len(b)) for a, b in zip(ref, out))
+    return matched / max(total, 1)
+
+
+def _lp_requests(n=5, seed=3):
+    rng = np.random.RandomState(seed)
+    reqs = []
+    for i in range(n):
+        src = rng.randint(4, 40, (int(rng.randint(3, 10)),)).astype(
+            np.int32)
+        prompt = rng.randint(4, 40, (8,)).astype(np.int32) if i % 2 \
+            else None
+        reqs.append((src, int(rng.choice([4, 6, 8])), prompt))
+    # repeat a prompted request so the prefix-warm path runs too
+    return reqs + [r for r in reqs if r[2] is not None][:1]
+
+
+def test_int8_kv_token_match_cold_warm_and_speculative():
+    """The accuracy contract: int8-KV greedy output matches fp32 at
+    >= 0.99 token-match rate across prefix-cache cold/warm traffic and
+    speculative k in {2, 3} — and the pool accounting stays exact (no
+    stuck references beyond the cache, zero after close)."""
+    model = _int8_model()
+    reqs = _lp_requests()
+    fp = _server(model, max_prompt_len=8)
+    ref = _drain(fp, *reqs)
+    fp.close()
+    for k in (0, 2, 3):
+        srv = _server(model, max_prompt_len=8, kv_dtype="int8",
+                      speculative_k=k)
+        out = _drain(srv, *reqs)
+        assert srv.pool.in_use() == srv.prefix_cache.pages_held()
+        rate = _match_rate(ref, out)
+        srv.close()
+        assert srv.pool.in_use() == 0
+        assert rate >= 0.99, (k, rate)
+
+
+def test_int8_pages_carry_scales_through_radix_cache():
+    """Shared int8 pages carry their scales: scales are indexed by page
+    id in the pool-parallel scale arrays, so a warm request adopting
+    cached prompt pages sees the cold request's exact quantised content
+    AND grid — cold vs warm output is BITWISE identical."""
+    model = _int8_model()
+    rng = np.random.RandomState(7)
+    src = rng.randint(4, 40, (6,)).astype(np.int32)
+    prompt = rng.randint(4, 40, (8,)).astype(np.int32)
+    srv = _server(model, max_prompt_len=8, kv_dtype="int8")
+    cold = _drain(srv, (src, 8, prompt))[0]
+    cache = srv.prefix_cache
+    pages = [n.page for n in cache._nodes]
+    assert pages, "prompt pages were not cached"
+    ks = np.asarray(srv.runtime.k_scales)      # (L, P, H)
+    vs = np.asarray(srv.runtime.v_scales)
+    assert np.all(ks[:, pages, :] > 0) and np.all(vs[:, pages, :] > 0)
+    hits0 = cache.hits
+    warm = _drain(srv, (src, 8, prompt))[0]
+    assert cache.hits == hits0 + 1
+    assert warm == cold            # adopted pages + scales, bit for bit
+    traces = srv.runtime.decode_traces
+    srv.close()
+    assert traces == 1 and srv.pool.in_use() == 0
+
+
+def test_int8_kv_fixed_budget_capacity():
+    """The capacity pin: a fixed HBM byte budget holds >= 1.9x the
+    TOKENS of the fp32 pool (scale arrays included in the arithmetic),
+    and `Server(kv_hbm_bytes=)` sizes its pool to exactly that
+    accounting."""
+    from mxnet_tpu.serve.quant import kv_page_bytes, token_capacity
+    geo = dict(n_layers=1, page_size=4, num_heads=2, head_dim=8)
+    budget = 32 * kv_page_bytes(kv_dtype="float32", **geo)
+    cap_fp = token_capacity(budget, kv_dtype="float32", **geo)
+    cap_q = token_capacity(budget, kv_dtype="int8", **geo)
+    assert cap_q / cap_fp >= 1.9
+    model = _int8_model()
+    srv = _server(model, kv_dtype="int8", kv_hbm_bytes=budget,
+                  max_new_tokens=8)
+    assert srv.pool.capacity * srv.pool.page_size == cap_q
+    assert srv.runtime.kv_bytes_per_page() == kv_page_bytes(
+        kv_dtype="int8", **geo)
+    srv.close()
+    with pytest.raises(MXNetError):
+        _server(model, kv_dtype="int8", kv_hbm_bytes=budget, num_pages=8)
+
+
+def test_chaos_quant_fault_degrades_to_full_precision():
+    """serve.quant chaos (the PR 12 fault-discipline mold): an injected
+    quantization fault degrades THAT request to the full-precision path
+    with output identical to an fp32 server's, zero leaked pages and
+    zero stuck refcounts; the next request runs the quantized path
+    normally."""
+    from mxnet_tpu.observability import registry as _registry
+    model = _int8_model()
+    rng = np.random.RandomState(9)
+    src = rng.randint(4, 40, (6,)).astype(np.int32)
+    prompt = rng.randint(4, 40, (8,)).astype(np.int32)
+    fp = _server(model, max_prompt_len=8)
+    ref = _drain(fp, (src, 8, prompt))[0]
+    fp.close()
+    srv = _server(model, max_prompt_len=8, kv_dtype="int8",
+                  weight_dtype="int8")
+    deg0 = _registry().counter("serve_quant_degraded").value
+    finj.inject("serve.quant", times=1)
+    degraded = _drain(srv, (src, 8, prompt))[0]
+    assert degraded == ref
+    assert _registry().counter("serve_quant_degraded").value == deg0 + 1
+    # the degraded request never touched the quantized pool: nothing
+    # held beyond (possibly) cache pages, and no refcount above 1
+    assert srv.pool.in_use() == srv.prefix_cache.pages_held()
+    # fault exhausted: the next request runs quantized (counter flat,
+    # decode executable actually dispatched)
+    out2 = _drain(srv, (src, 8, prompt))[0]
+    assert len(out2) == len(ref)
+    assert _registry().counter("serve_quant_degraded").value == deg0 + 1
+    assert srv.runtime.decode_traces == 1
+    bad = [p for p in range(1, srv.pool.num_pages)
+           if srv.pool.ref_count(p) > 1]
+    assert not bad
+    srv.close()
+    assert srv.pool.in_use() == 0
+
+
+def test_weight_int8_serve_matches_fp32():
+    """Per-channel int8 weights: the serve snapshot quantises (decoder
+    Dense leaves become (int8, bias, per-output-channel scale); the
+    embed carries per-row scales), the MODEL's master weights stay full
+    precision, and greedy output matches fp32 at >= 0.99."""
+    import jax.numpy as jnp
+    model = _int8_model()
+    reqs = _lp_requests(n=4, seed=5)
+    fp = _server(model, max_prompt_len=8)
+    ref = _drain(fp, *reqs)
+    fp.close()
+    srv = _server(model, max_prompt_len=8, weight_dtype="int8")
+    w = srv.runtime._w
+    assert w["embed"].dtype == jnp.int8 and "embed_scale" in w
+    wq, b, s = w["layers"][0]["qkv"]
+    assert wq.dtype == jnp.int8 and s.shape == (wq.shape[0],)
+    # master weights untouched
+    assert model.embed.weight.data()._data.dtype == jnp.float32
+    out = _drain(srv, *reqs)
+    rate = _match_rate(ref, out)
+    srv.close()
+    assert rate >= 0.99, rate
+    assert srv.pool.in_use() == 0
+
+
+def test_paged_attention_quant_kernel_interpret(monkeypatch):
+    """The quantised Pallas kernels' numerics (scales via bitcast
+    scalar prefetch, dequant in VMEM), pinned on CPU via interpret mode
+    against the lax gathered-dequant fallback — 1-wide and widened."""
+    monkeypatch.setenv("MXTPU_PALLAS_INTERPRET", "1")
+    import jax.numpy as jnp
+    from mxnet_tpu.ops.pallas_kernels import (
+        _paged_attention_lax, _paged_attention_lax_multi,
+        ragged_paged_attention)
+    rng = np.random.RandomState(0)
+    S, H, dh, P, psize = 3, 2, 8, 9, 8
+    q = jnp.asarray(rng.randn(S, H, dh).astype(np.float32))
+    kp = jnp.asarray(rng.randint(-127, 128, (P, psize, H, dh))
+                     .astype(np.int8))
+    vp = jnp.asarray(rng.randint(-127, 128, (P, psize, H, dh))
+                     .astype(np.int8))
+    ks = jnp.asarray((rng.rand(P, H) * 0.05 + 1e-3).astype(np.float32))
+    vs = jnp.asarray((rng.rand(P, H) * 0.05 + 1e-3).astype(np.float32))
+    pt = jnp.asarray(np.array([[1, 2], [3, 0], [4, 5]], np.int32))
+    lens = jnp.asarray(np.array([12, 5, 16], np.int32))
+    out = ragged_paged_attention(q, kp, vp, pt, lens,
+                                 k_scales=ks, v_scales=vs)
+    ref = _paged_attention_lax(q, kp, vp, pt, lens,
+                               k_scales=ks, v_scales=vs)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-6, atol=2e-6)
+    qm = jnp.asarray(rng.randn(S, 3, H, dh).astype(np.float32))
+    outm = ragged_paged_attention(qm, kp, vp, pt, lens,
+                                  k_scales=ks, v_scales=vs)
+    refm = _paged_attention_lax_multi(qm, kp, vp, pt, lens,
+                                      k_scales=ks, v_scales=vs)
+    np.testing.assert_allclose(np.asarray(outm), np.asarray(refm),
+                               rtol=2e-6, atol=2e-6)
+
+
+def test_quant_degrade_honours_deadline():
+    """A deadline_ms request hit by a serve.quant fault gets no deadline
+    amnesty: the remaining budget rides into the full-precision
+    fallback, and an already/soon-expired request surfaces the same
+    `ServeDeadlineExceeded` the normal path raises (counted into
+    `serve_deadline_expired`), with nothing leaked."""
+    from mxnet_tpu.observability import registry as _registry
+    from mxnet_tpu.serve.scheduler import ServeDeadlineExceeded
+    model = _int8_model()
+    rng = np.random.RandomState(4)
+    src = rng.randint(4, 40, (6,)).astype(np.int32)
+    srv = _server(model, kv_dtype="int8")
+    exp0 = _registry().counter("serve_deadline_expired").value
+    finj.inject("serve.quant", times=1)
+    h = srv.submit(src, max_new_tokens=8, deadline_ms=0.5)
+    with pytest.raises(ServeDeadlineExceeded):
+        h.result(timeout=60)
+    assert _registry().counter("serve_deadline_expired").value > exp0
+    # fault exhausted + no deadline: the quantized path serves normally
+    out = _drain(srv, (src, 4, None))[0]
+    assert len(out) >= 1
+    srv.close()
+    assert srv.pool.in_use() == 0

@@ -140,6 +140,7 @@ def run(steps=DEFAULT_STEPS, budget=DISPATCH_BUDGET):
     shard_res = _run_shard_phase(steps, errors)
     serve_res = _run_serve_phase(errors)
     serve_res.update(_run_serve_fastpath_phase(errors))
+    serve_res.update(_run_serve_int8_phase(errors))
 
     res = {
         "steps": steps,
@@ -521,6 +522,106 @@ def _run_serve_fastpath_phase(errors):
     }
 
 
+def _run_serve_int8_phase(errors):
+    """Quantized-serve budgets (ISSUE 14).
+
+    DISPATCH/RETRACE: an int8-KV server's warm decode turns stay at ONE
+    dispatch each and the quantized decode executable never retraces
+    while occupancy and page tables vary (the per-page scale arrays are
+    donated arguments, not shapes).
+
+    CAPACITY: a fixed HBM byte budget must hold >= 1.9x the TOKENS of
+    the fp32 pool (scale arrays included in the arithmetic, so the claim
+    is honest — on this toolchain's fp32 pages it is ~3.5x; bf16 pages
+    would make it ~1.9x), and the page accounting stays exact at that
+    doubled capacity: `kv_pages_in_use` returns to 0 once the traffic
+    drains and the server closes."""
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import profiler
+    from mxnet_tpu.models.transformer import TransformerNMT
+    from mxnet_tpu.serve.quant import kv_page_bytes, token_capacity
+
+    n_layers, heads, units, psize = 1, 2, 16, 4
+    budget = 64 * kv_page_bytes(n_layers, psize, heads, units // heads,
+                                "float32")
+    cap_fp = token_capacity(budget, n_layers, psize, heads,
+                            units // heads, "float32")
+    cap_q = token_capacity(budget, n_layers, psize, heads,
+                           units // heads, "int8")
+    ratio = cap_q / cap_fp
+    if ratio < 1.9:
+        errors.append(f"int8 KV capacity ratio {ratio:.3f} < 1.9 at a "
+                      f"fixed {budget}-byte budget ({cap_q} vs {cap_fp} "
+                      f"tokens)")
+
+    mx.random.seed(0)
+    model = TransformerNMT(32, units=units, hidden=2 * units,
+                           num_layers=n_layers, num_heads=heads,
+                           max_length=32, dropout=0.0)
+    model.initialize()
+    srv = mx.serve.Server(model, slots=3, page_size=psize, max_src_len=8,
+                          max_new_tokens=12, kv_dtype="int8",
+                          kv_hbm_bytes=budget, engine_driven=False)
+    if srv.pool.capacity * psize != cap_q:
+        errors.append(f"kv_hbm_bytes pool sizing disagrees with "
+                      f"token_capacity: {srv.pool.capacity * psize} vs "
+                      f"{cap_q}")
+    sched = srv.scheduler
+    rng = np.random.RandomState(0)
+    srv.submit(rng.randint(4, 32, (5,)), max_new_tokens=4)
+    sched.step()
+    sched.step()
+    warm_traces = srv.runtime.decode_traces
+    for n, mt in ((3, 10), (7, 3), (6, 7), (4, 12), (8, 5)):
+        srv.submit(rng.randint(4, 32, (n,)), max_new_tokens=mt)
+    worst = 0
+    decode_steps = 0
+    for _ in range(100):
+        if not sched.pending_work():
+            break
+        profiler.reset_dispatches()
+        r = sched.step()
+        if r.decoded and not r.admitted:
+            worst = max(worst, profiler.dispatch_count())
+            decode_steps += 1
+    undrained = sched.pending_work()
+    retraces = srv.runtime.decode_traces - warm_traces
+    # the prefix cache may legitimately hold pages after the drain; the
+    # accounting bar is: nothing BEYOND the cache, and zero after close
+    held = srv.pool.in_use()
+    cache_pages = srv.prefix_cache.pages_held() if srv.prefix_cache \
+        else 0
+    srv.close()
+    leaked = srv.pool.in_use()
+    if undrained:
+        errors.append("int8 serve phase did not drain")
+    if decode_steps == 0:
+        errors.append("int8 serve phase measured no pure decode turns")
+    if worst > 1:
+        errors.append(f"int8 serve decode budget exceeded: {worst} "
+                      f"dispatches/turn (budget 1)")
+    if retraces:
+        errors.append(f"int8 serve decode executable retraced "
+                      f"{retraces}x across occupancy changes (budget 0)")
+    if held != cache_pages:
+        errors.append(f"int8 pool holds {held} pages after drain but "
+                      f"the cache owns {cache_pages} — stuck request "
+                      f"references at 2x capacity")
+    if leaked:
+        errors.append(f"int8 serve phase leaked {leaked} KV pages "
+                      f"after close()")
+    return {
+        "serve_int8_dispatches_per_step": worst,
+        "serve_int8_retraces": retraces,
+        "serve_int8_capacity_ratio": round(ratio, 4),
+        "serve_int8_tokens_at_budget": cap_q,
+        "serve_fp32_tokens_at_budget": cap_fp,
+        "serve_int8_pages_leaked": leaked,
+    }
+
+
 def main(argv=None):
     argv = list(sys.argv[1:] if argv is None else argv)
     steps, budget = DEFAULT_STEPS, DISPATCH_BUDGET
@@ -555,7 +656,9 @@ def main(argv=None):
           f"dispatch/turn, {res['serve_spec_retraces']} retraces, "
           f"accept rate {res['serve_spec_accept_rate']}; prefix warm "
           f"{res['serve_prefix_warm_turns']} vs cold "
-          f"{res['serve_prefix_cold_turns']} turns)",
+          f"{res['serve_prefix_cold_turns']} turns; int8 KV "
+          f"{res['serve_int8_dispatches_per_step']} dispatch/turn at "
+          f"{res['serve_int8_capacity_ratio']}x token capacity)",
           file=sys.stderr)
     return 0
 

@@ -91,6 +91,27 @@ BUDGETS = {
         "copies": (0, 24),
         "aliased_inputs": 2,         # donated K/V page pools
     },
+    # ISSUE 14: the QUANTIZED-serve executables (int8 KV pages with
+    # per-page scales + per-channel int8 weights, one server covering
+    # both dequant paths). Measured 65 fusions / 22 copies (decode) and
+    # 68 / 22 (verify) on the pinned toolchain — the running-max
+    # requantising page writes cost scatters, not copy passes, and the
+    # weight/KV dequant must stay fused into the dots (a copy-band trip
+    # here is the dequant materialising). All FOUR donated pool buffers
+    # (K/V pages + K/V scales) must alias or the in-place page-write
+    # story is fiction at 2x token capacity.
+    "serve_decode_int8": {
+        "fusions": (30, 110),
+        "collective_total": 0,
+        "copies": (0, 40),
+        "aliased_inputs": 4,         # donated K/V pages + K/V scales
+    },
+    "serve_verify_int8": {
+        "fusions": (32, 115),
+        "collective_total": 0,
+        "copies": (0, 40),
+        "aliased_inputs": 4,
+    },
 }
 
 CONTROL_TIMEOUT_S = 240
@@ -240,6 +261,46 @@ def _serve_verify_info():
     return info, traces
 
 
+def _serve_int8_infos():
+    """Warm ONE quantized server (ISSUE 14: int8 KV pages + per-channel
+    int8 weights, speculative width 3 so both the 1-wide and widened
+    quantized programs exist) and return (decode_info, verify_info,
+    decode_traces + verify_traces)."""
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.models.transformer import TransformerNMT
+
+    mx.random.seed(0)
+    model = TransformerNMT(32, units=16, hidden=32, num_layers=1,
+                           num_heads=2, max_length=48, dropout=0.0)
+    model.initialize()
+    srv = mx.serve.Server(model, slots=3, page_size=4, max_src_len=8,
+                          max_new_tokens=8, max_prompt_len=12,
+                          num_pages=16, speculative_k=2, kv_dtype="int8",
+                          weight_dtype="int8", engine_driven=False)
+    rng = np.random.RandomState(0)
+    srv.submit(rng.randint(4, 32, (5,)), max_new_tokens=4,
+               prompt_tokens=rng.randint(4, 32, (6,))).result(timeout=300)
+    ver = srv.runtime._verify_fn.last_hlo
+    traces = srv.runtime.decode_traces + srv.runtime.verify_traces
+    srv.close()
+
+    mx.random.seed(0)
+    model = TransformerNMT(32, units=16, hidden=32, num_layers=1,
+                           num_heads=2, max_length=32, dropout=0.0)
+    model.initialize()
+    srv = mx.serve.Server(model, slots=3, page_size=4, max_src_len=8,
+                          max_new_tokens=12, kv_dtype="int8",
+                          weight_dtype="int8", engine_driven=False)
+    srv.submit(rng.randint(4, 32, (5,)), max_new_tokens=4).result(
+        timeout=300)
+    dec = srv.runtime._decode_fn.last_hlo
+    traces += srv.runtime.decode_traces
+    srv.close()
+    return dec, ver, traces
+
+
 def _run_control():
     """Compile the SAME captured step in a subprocess with XLA's fusion
     pass disabled and return its HLO counts — the gate's liveness
@@ -322,6 +383,15 @@ def _run_impl():
                       f"during the warm-up (expected exactly 1 — draft "
                       f"acceptance variation must not retrace)")
 
+    # -- quantized-serve executables (ISSUE 14) ------------------------
+    qdec_info, qver_info, q_traces = _serve_int8_infos()
+    errors += check_budget("serve_decode_int8", qdec_info)
+    errors += check_budget("serve_verify_int8", qver_info)
+    if q_traces != 2:
+        errors.append(f"quantized serve executables traced {q_traces}x "
+                      f"during warm-up (expected exactly 2: one decode "
+                      f"+ one verify compilation)")
+
     # -- de-fused control: the SAME budget must trip -------------------
     control_fusions = None
     control_tripped = None
@@ -347,6 +417,9 @@ def _run_impl():
         "serve_decode_traces": dec_traces,
         "serve_verify": _strip(ver_info),
         "serve_verify_traces": ver_traces,
+        "serve_decode_int8": _strip(qdec_info),
+        "serve_verify_int8": _strip(qver_info),
+        "serve_int8_traces": q_traces,
         "control_fusions": control_fusions,
         "control_tripped": control_tripped,
         "budgets": BUDGETS,
@@ -384,9 +457,12 @@ def main(argv=None):
           f"/ {res['captured']['aliased_inputs']} aliased; {shard_txt}; "
           f"decode {res['serve_decode']['fusions']} fusions; verify "
           f"{res['serve_verify']['fusions']} fusions / "
-          f"{res['serve_verify']['copies']} copies; de-fused "
-          f"control tripped at {res['control_fusions']} fusions)",
-          file=sys.stderr)
+          f"{res['serve_verify']['copies']} copies; int8 decode "
+          f"{res['serve_decode_int8']['fusions']} fusions / "
+          f"{res['serve_decode_int8']['copies']} copies / "
+          f"{res['serve_decode_int8']['aliased_inputs']} aliased; "
+          f"de-fused control tripped at {res['control_fusions']} "
+          f"fusions)", file=sys.stderr)
     return 0
 
 

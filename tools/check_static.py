@@ -57,6 +57,12 @@ BUDGETS = {
     "serve_decode": {"copies_allow": 20},
     "serve_prefill": {"copies_allow": 10},
     "serve_verify": {"copies_allow": 24},   # = check_fusion's band hi
+    # ISSUE 14 quantized-serve executables: measured 22 copies each (the
+    # running-max requantising page writes cost scatters + transposes,
+    # not copy passes; dequant stays fused) — allowance = check_fusion's
+    # copy-band hi, one reviewed number in both tables
+    "serve_decode_int8": {"copies_allow": 40},
+    "serve_verify_int8": {"copies_allow": 40},
     "serve_page_remap": {"copies_allow": 8},
     "fused_update": {"copies_allow": 4},
     "autograd_backward": {"copies_allow": 8},
@@ -239,6 +245,25 @@ def warm_executables():
                 prompt_tokens=rng.randint(4, 32, (4,))).result(
         timeout=300)
     keep.append(srv2)
+    # quantized-serve executables (ISSUE 14): one int8-KV + int8-weight
+    # server each way — 1-wide (serve_decode_int8) and speculative
+    # (serve_verify_int8) — so the donation-leak / copy-allowance lint
+    # covers the quantized programs deterministically, not only when a
+    # co-resident gate test happens to leave them alive
+    srv3 = mx.serve.Server(model, slots=2, page_size=4, max_src_len=8,
+                           max_new_tokens=6, kv_dtype="int8",
+                           weight_dtype="int8", engine_driven=False)
+    srv3.submit(rng.randint(4, 32, (5,)), max_new_tokens=2).result(
+        timeout=300)
+    keep.append(srv3)
+    srv4 = mx.serve.Server(model, slots=2, page_size=4, max_src_len=8,
+                           max_new_tokens=6, max_prompt_len=8,
+                           speculative_k=2, kv_dtype="int8",
+                           engine_driven=False)
+    srv4.submit(rng.randint(4, 32, (5,)), max_new_tokens=3,
+                prompt_tokens=rng.randint(4, 32, (4,))).result(
+        timeout=300)
+    keep.append(srv4)
     # fused bucket kernel + cached jitted backward via a short fused
     # imperative loop (the backward cache compiles on the 3rd sighting)
     X = nd.array(rng.randn(8, 16).astype(np.float32))
