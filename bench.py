@@ -377,6 +377,22 @@ def main():
                     shres["shard_vs_replicated"]
         except Exception as e:  # pragma: no cover
             print(f"[bench] shard bench failed: {e!r}", file=sys.stderr)
+        # ISSUE 15: the recommender workload — sharded-embedding DLRM
+        # steps/s + per-device embedding bytes vs the replicated
+        # dense-take layout. Same honesty contract: the fields are
+        # OMITTED below 4 devices (bench_rec reports value None), never
+        # faked; own guard so a rec failure can't take down the shard
+        # fields above.
+        try:
+            import bench_rec
+            rres = bench_rec.measure()
+            if rres.get("value") is not None:
+                result["rec_step_throughput"] = rres["value"]
+                result["rec_embed_bytes_per_dev"] = \
+                    rres["rec_embed_bytes_per_dev"]
+                result["rec_vs_replicated"] = rres["rec_vs_replicated"]
+        except Exception as e:  # pragma: no cover
+            print(f"[bench] rec bench failed: {e!r}", file=sys.stderr)
 
     # Serving headline (ISSUE 6): continuous-batching tokens/s + p99
     # latency under Poisson arrivals, recorded as first-class fields of

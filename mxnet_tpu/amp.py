@@ -27,7 +27,7 @@ __all__ = ["init", "reset", "convert_block", "scale_loss", "unscale",
 bfloat16 = jnp.bfloat16
 
 _CAST_LAYERS = ("Dense", "Conv1D", "Conv2D", "Conv3D", "Conv2DTranspose",
-                "Embedding")
+                "Embedding", "ShardedEmbedding")
 _KEEP_FP32 = ("BatchNorm", "LayerNorm", "InstanceNorm", "GroupNorm")
 
 _state = {"scaler": None, "initialized": False, "target_dtype": None}

@@ -84,7 +84,7 @@ _fallback_counters = {}  # reason -> Counter cachedop_fallbacks{reason=}
 # cache-key layout; positions feed miss-reason classification
 _KEY_FIELDS = ("shape_change", "param_change", "state_change", "scale_mode",
                "hyper_change", "autocast", "mesh", "sharded", "grad_reduce",
-               "clip", "plan")
+               "clip", "plan", "sparse")
 
 
 def _miss(reason):
@@ -101,6 +101,31 @@ def _fallback(reason):
         c = _fallback_counters[reason] = _reg.counter("cachedop_fallbacks",
                                                       reason=reason)
     c.inc()
+
+
+_sparse_demotions = _reg.counter("cachedop_sparse_demotions")
+_demotion_warned = set()    # param names already warned about
+
+
+def _warn_sparse_demotion(name):
+    """A `ShardedEmbedding` table used OUTSIDE its lookup sites (tied
+    output projection, a norm over the raw weights, ...) cannot take
+    the sparse fast path — the hoisted-table backward would silently
+    drop the non-lookup use's gradient. It trains dense instead:
+    correct numerics, O(vocab) gradient, and this one-per-name warning
+    so the lost memory headline is visible."""
+    _sparse_demotions.inc()
+    if name in _demotion_warned:
+        return
+    _demotion_warned.add(name)
+    warnings.warn(
+        f"ShardedEmbedding table {name!r} is read outside its lookup "
+        f"sites (tied projection / raw-weight use); the sparse "
+        f"fast path cannot carry that use's gradient, so the table "
+        f"trains through the DENSE path (correct, but materialises an "
+        f"O(vocab) gradient). Untie the weight or look it up through "
+        f"the block to regain the sparse path.", RuntimeWarning,
+        stacklevel=3)
 
 
 def _note_step_failure(exc):
@@ -308,6 +333,11 @@ class CachedStep:
         scaled) loss, `Trainer.step`. Same return value as the captured
         path (the RAW loss, not the scaled one)."""
         from . import amp
+        for p in self._trainer._params:
+            # the imperative path computes dense grads for everything;
+            # drop any sparse pair an earlier captured step left behind
+            if getattr(p, "_sparse_grad", None) is not None:
+                p._sparse_grad = None
         with autograd.record():
             out = self._loss_fn(*batch_nd)
             leaves, _ = jax.tree_util.tree_flatten(
@@ -382,6 +412,12 @@ class CachedStep:
         scale_mode = ("amp" if scaler is not None
                       else "skip" if tr.skip_nonfinite else "none")
 
+        # sparse-embedding fast-path eligibility (ISSUE 15): marked
+        # `ShardedEmbedding` tables, row-sharded by their rule over one
+        # mesh axis, elementwise optimizer — shard/embedding.py
+        from .shard import embedding as _semb
+        sparse_info = _semb.sparse_eligibility(plan, diff, opt)
+
         updater = tr._updater
         state_nds = []
         for i, p in diff:
@@ -405,6 +441,7 @@ class CachedStep:
             self._grad_reduce,
             None if opt.clip_gradient is None else float(opt.clip_gradient),
             None if plan is None else plan.signature(),
+            tuple(sorted((k, v["axis"]) for k, v in sparse_info.items())),
         )
         entry = self._cache.get(key)
         if entry is None:
@@ -413,7 +450,7 @@ class CachedStep:
             self._last_key = key
             try:
                 entry = self._build(batch_nd, diff, state_nds, scale_mode,
-                                    spec, plan)
+                                    spec, plan, sparse_info)
             except _CaptureUnsupported as e:
                 # negative-cache the failure: later steps with the same
                 # signature skip straight to the imperative path instead
@@ -452,13 +489,16 @@ class CachedStep:
 
     # ------------------------------------------------------------ build
     def _build(self, batch_nd, diff, state_nds, scale_mode, spec,
-               plan=None):
+               plan=None, sparse_info=None):
         tr = self._trainer
         opt = tr._optimizer
         kv = tr._kvstore
+        from .optimizer import multi_tensor as _mt
         from .optimizer.multi_tensor import apply_param_update
         from .jax_compat import shard_map
+        from .shard import embedding as _semb
         from jax.sharding import PartitionSpec as P
+        sparse_info = sparse_info or {}
 
         diff_ids = {id(p) for _, p in diff}
         diff_params = [p for _, p in diff]
@@ -586,27 +626,153 @@ class CachedStep:
         pos_of = {id(p): j for j, p in enumerate(nondiff)}
         meta["aux_pos"] = [pos_of.get(id(p)) for p in meta["aux"]]
 
+        # sparse-embedding site discovery (ISSUE 15): one more abstract
+        # pass with the RECORD context installed tells us which eligible
+        # tables the model actually looks up and with what index shapes
+        # — the out_shardings pytree below needs that before tracing.
+        # An eligible table with no lookup site trains dense (zero grad).
+        # The pass traces to a JAXPR with the diff values as the
+        # arguments: record-mode lookups never touch the table value, so
+        # a table whose argument is still REFERENCED anywhere has a
+        # NON-lookup use (a tied output projection, a norm over the raw
+        # weights, ...). Its cotangent could not ride the sparse row
+        # block — the fast path would silently drop that use's gradient
+        # — so such a table DEMOTES to the dense path (correct numerics,
+        # dense O(vocab) gradient), loudly.
+        sparse_live = {}
+        if sparse_info:
+            rec = _semb.SparseLookupContext(
+                "record", [id(diff_params[k]) for k in sparse_info])
+            try:
+                with rec:
+                    nvals0 = [p._data._data for p in meta["nondiff"]]
+                    closed = jax.make_jaxpr(
+                        lambda dv: traced(rng0, dv, nvals0, bvals))(
+                        dvals)
+            except MXNetError:
+                raise
+            except Exception as e:
+                raise _CaptureUnsupported(
+                    f"trace_error:{type(e).__name__}") from e
+            # every reference to a top-level arg appears in some eqn's
+            # (or the output's) invars — call-style primitives receive
+            # outer vars at their call site, so no recursion is needed.
+            # A pass-through into a sub-jaxpr counts as a use: that can
+            # only demote (dense = always-correct), never miss a use.
+            referenced = set()
+            for eqn in closed.jaxpr.eqns:
+                referenced.update(id(v) for v in eqn.invars)
+            referenced.update(id(v) for v in closed.jaxpr.outvars)
+            for k, info in sparse_info.items():
+                sites = rec.sites.get(id(diff_params[k]))
+                if not sites:
+                    continue
+                if id(closed.jaxpr.invars[k]) in referenced:
+                    _warn_sparse_demotion(diff_params[k].name)
+                    continue
+                shapes = [tuple(int(d) for d in s.shape) for s in sites]
+                n_flat = sum(
+                    int(np.prod(shp, dtype=np.int64)) if shp else 1
+                    for shp in shapes)
+                sparse_live[k] = dict(info, site_shapes=shapes,
+                                      n_flat=n_flat)
+        live_ks = sorted(sparse_live)
+        dense_ks = [k for k in range(n_diff) if k not in sparse_live]
+
         def program(batch_vals, diff_vals, nondiff_vals, state_vals, rng,
                     lrs, wds, rescale, inv_scale, loss_scale, poison):
-            def fwd(dv):
-                leaves, aux = traced(rng, dv, nondiff_vals, batch_vals)
-                return leaves[0], (leaves[1:], aux)
+            se = {}
+            if sparse_live:
+                # discovery pass with CONCRETE tracers: record each
+                # lookup site's index value. Only the recorded index
+                # extraction survives DCE — the rest of this forward is
+                # dead (its outputs are unused).
+                rec = _semb.SparseLookupContext(
+                    "record", [id(diff_params[k]) for k in live_ks])
+                with rec:
+                    traced(rng, diff_vals, nondiff_vals, batch_vals)
+                for k in live_ks:
+                    info = sparse_live[k]
+                    sites = rec.sites[id(diff_params[k])]
+                    flats = [s.reshape(-1).astype(jnp.int32)
+                             for s in sites]
+                    flat = jnp.concatenate(flats) if len(flats) > 1 \
+                        else flats[0]
+                    # dedup: each distinct row crosses the interconnect
+                    # once per step; the sentinel (vocab) is out of
+                    # range on every shard, so scatters drop pad slots
+                    uniq, inv = jnp.unique(
+                        flat, size=int(flat.shape[0]),
+                        fill_value=info["vocab"], return_inverse=True)
+                    inv = inv.reshape(-1).astype(jnp.int32)
+                    rows = _semb.gather_rows(diff_vals[k], uniq,
+                                             plan.mesh, info["axis"])
+                    segs, off = [], 0
+                    for shp in info["site_shapes"]:
+                        segs.append((off, shp))
+                        off += int(np.prod(shp, dtype=np.int64)) \
+                            if shp else 1
+                    se[k] = [uniq, inv, rows, segs]
 
-            head, vjp_fn, (extra, aux_vals) = jax.vjp(
-                fwd, diff_vals, has_aux=True)
-            cot = jnp.ones_like(head) * jnp.asarray(loss_scale, head.dtype)
-            grads = list(vjp_fn(cot)[0])
-            # grad.nan fault point: poison is 1.0 unless the injection
-            # schedule fired this step (then NaN) — same reflex test as the
-            # imperative trainer's gradient poisoning, in-graph
-            grads = [g * poison for g in grads]
+            def run_traced(dv_full, consume_rows=None):
+                if not sparse_live:
+                    return traced(rng, dv_full, nondiff_vals, batch_vals)
+                cctx = _semb.SparseLookupContext(
+                    "consume", [id(diff_params[k]) for k in live_ks])
+                for k, r in zip(live_ks, consume_rows):
+                    uniq, inv, _, segs = se[k]
+                    cctx.set_rows(diff_params[k], r, inv, segs)
+                with cctx:
+                    return traced(rng, dv_full, nondiff_vals, batch_vals)
+
+            if sparse_live:
+                # the tables are HOISTED OUT of the vjp: the gathered
+                # (U, D) row blocks are the differentiable inputs, so
+                # the backward materialises a dense-of-touched block +
+                # indices, never an O(vocab) gradient
+                def fwd(dv_dense, rows_list):
+                    full = list(diff_vals)
+                    for k, v in zip(dense_ks, dv_dense):
+                        full[k] = v
+                    leaves, aux = run_traced(full, rows_list)
+                    return leaves[0], (leaves[1:], aux)
+
+                head, vjp_fn, (extra, aux_vals) = jax.vjp(
+                    fwd, [diff_vals[k] for k in dense_ks],
+                    [se[k][2] for k in live_ks], has_aux=True)
+                cot = jnp.ones_like(head) * jnp.asarray(loss_scale,
+                                                        head.dtype)
+                g_dense, g_rows_list = vjp_fn(cot)
+                grads = [None] * n_diff
+                for k, g in zip(dense_ks, g_dense):
+                    grads[k] = g * poison
+                g_rows = {k: g * poison
+                          for k, g in zip(live_ks, g_rows_list)}
+            else:
+                def fwd(dv):
+                    leaves, aux = traced(rng, dv, nondiff_vals,
+                                         batch_vals)
+                    return leaves[0], (leaves[1:], aux)
+
+                head, vjp_fn, (extra, aux_vals) = jax.vjp(
+                    fwd, diff_vals, has_aux=True)
+                cot = jnp.ones_like(head) * jnp.asarray(loss_scale,
+                                                        head.dtype)
+                grads = list(vjp_fn(cot)[0])
+                # grad.nan fault point: poison is 1.0 unless the
+                # injection schedule fired this step (then NaN) — same
+                # reflex test as the imperative trainer's gradient
+                # poisoning, in-graph
+                grads = [g * poison for g in grads]
+                g_rows = {}
 
             if plan_specs is not None:
                 # rule-driven layout: no explicit psum — the loss is
                 # computed over the GLOBAL batch, so the dp reduction is
                 # already part of the backward; the constraint makes each
                 # gradient land reduce-scattered into its weight's layout
-                grads = [kv.graph_constrain(g, ps)
+                # (sparse-path tables have no dense gradient to constrain)
+                grads = [g if g is None else kv.graph_constrain(g, ps)
                          for g, ps in zip(grads, plan_specs)]
 
             if mesh is not None:
@@ -642,15 +808,49 @@ class CachedStep:
                 repl_cnt = sum(
                     (jnp.sum(~jnp.isfinite(g.astype(jnp.float32)),
                              dtype=jnp.int32)
-                     for g, sh in zip(grads, shard_ok) if not sh),
+                     for g, sh in zip(grads, shard_ok)
+                     if not sh and g is not None),
+                    jnp.zeros((), jnp.int32))
+                # sparse rows count into the same reflex: a nonfinite
+                # touched-row gradient skips the whole update
+                repl_cnt = repl_cnt + sum(
+                    (jnp.sum(~jnp.isfinite(g.astype(jnp.float32)),
+                             dtype=jnp.int32) for g in g_rows.values()),
                     jnp.zeros((), jnp.int32))
                 if mesh is not None and any(shard_ok):
                     shard_cnt = kv.graph_allreduce(shard_cnt, axis, n_rep)
                 flag = ((shard_cnt + repl_cnt) > 0).astype(jnp.int32)
 
+            def _sparse_out_g(k):
+                og = g_rows[k] * inv_scale if unscale else g_rows[k]
+                return (se[k][0], og)
+
             def do_update(_):
                 nws, nss, ogs = [], [], []
                 for k in range(n_diff):
+                    if k in sparse_live:
+                        # scatter-add arm (ISSUE 15): touched rows are
+                        # gathered, staged through the exact multi-
+                        # tensor numerics, and written back on the
+                        # OWNING shard only — the donated table/state
+                        # buffers update in place, untouched rows never
+                        # move (lazy/sparse-update semantics)
+                        uniq = se[k][0]
+
+                        def stage(w_r, g_r, sv_r, _k=k):
+                            nw, ns, _ = _mt.sparse_update_rows(
+                                opt, w_r, g_r, sv_r, lrs[_k], wds[_k],
+                                mp_flags[_k], clip, rescale,
+                                inv_scale if unscale else None)
+                            return nw, ns
+
+                        nw, ns = _semb.sparse_row_update(
+                            w_locals[k], sv_locals[k], uniq, g_rows[k],
+                            plan.mesh, sparse_live[k]["axis"], stage)
+                        nws.append(nw)
+                        nss.append(ns)
+                        ogs.append(_sparse_out_g(k))
+                        continue
                     nw, ns, og = apply_param_update(
                         opt, w_locals[k], grads[k], sv_locals[k],
                         lrs[k], wds[k], mp_flags[k], clip, rescale,
@@ -663,8 +863,10 @@ class CachedStep:
             def skip_update(_):
                 # grads still end unscaled on the skip path (per-param
                 # path parity: amp.unscale runs before the skip)
-                ogs = tuple(g * inv_scale for g in grads) if unscale \
-                    else tuple(grads)
+                ogs = tuple(
+                    _sparse_out_g(k) if k in sparse_live
+                    else (grads[k] * inv_scale if unscale else grads[k])
+                    for k in range(n_diff))
                 return (tuple(w_locals),
                         tuple(tuple(sv) for sv in sv_locals), ogs)
 
@@ -718,12 +920,17 @@ class CachedStep:
                         p.name, w_shape, s._data.shape)) for s in sv))
             aux_sh = [plan.sharding(p.name, p._data._data.shape)
                       for p in meta["aux"]]
+            # grads: dense params land in their weight's layout; a
+            # sparse-path table's "gradient" is the (unique_ids, rows)
+            # pair — replicated, O(touched), never O(vocab)
+            grad_sh = [(repl, repl) if k in sparse_live else diff_sh[k]
+                       for k in range(len(diff_sh))]
             jit_kwargs["out_shardings"] = (
                 [repl] * meta["n_out"],      # loss leaves: replicated
                 aux_sh,
                 diff_sh,                     # new weights keep their rule
                 state_sh,                    # state stays sharded
-                diff_sh,                     # grads land in weight layout
+                grad_sh,
                 repl,                        # guard flag
             )
             meta["shardings"] = (
@@ -732,13 +939,26 @@ class CachedStep:
             )
             # per-spec collective accounting: gradient bytes entering the
             # cross-replica reduction, attributed to the layout that rule
-            # produced (kv_collective_bytes{op=spmd_grad_reduce,spec=})
+            # produced (kv_collective_bytes{op=spmd_grad_reduce,spec=});
+            # sparse tables account their all-to-all payloads instead —
+            # per step per table: one (shards, U) int32 index exchange
+            # plus one (shards, U, D) vector return
             per_spec = {}
-            for (i, p), ps in zip(diff, plan_specs):
+            for k, ((i, p), ps) in enumerate(zip(diff, plan_specs)):
+                if k in sparse_live:
+                    continue
                 g = p._grad._data
                 nbytes = int(g.size) * jnp.dtype(g.dtype).itemsize
                 per_spec[str(ps)] = per_spec.get(str(ps), 0) + nbytes
             meta["coll_specs"] = sorted(per_spec.items())
+            embed_bytes = 0
+            for k, info in sparse_live.items():
+                n_sh = int(pmesh.shape[info["axis"]])
+                itemsize = jnp.dtype(
+                    diff[k][1].data()._data.dtype).itemsize
+                embed_bytes += n_sh * info["n_flat"] * (
+                    4 + info["dim"] * itemsize)
+            meta["embed_bytes"] = embed_bytes
         else:
             def state_spec(k, sv):
                 return tuple(
@@ -783,11 +1003,15 @@ class CachedStep:
 
         # compile observatory (observability/compilex.py): the captured
         # step's compiles/HLO structure publish under the executable name
-        # check_fusion budgets — "sharded_step" when a rule plan owns the
-        # layout, "captured_step" otherwise (single-device or 1-D mesh)
+        # check_fusion budgets — "sharded_embed_step" when the sparse
+        # embedding fast path is live (its all-to-all count is pinned),
+        # "sharded_step" when a rule plan owns the layout,
+        # "captured_step" otherwise (single-device or 1-D mesh)
+        exe_name = ("sharded_embed_step" if sparse_live
+                    else "sharded_step" if plan is not None
+                    else "captured_step")
         jfn = _compilex.instrument(
-            jax.jit(fn, donate_argnums=(1, 3), **jit_kwargs),
-            "sharded_step" if plan is not None else "captured_step")
+            jax.jit(fn, donate_argnums=(1, 3), **jit_kwargs), exe_name)
         meta.update({
             "fresh": True,     # first dispatch compiles: scope the CPU
                                # donation-noop warning to that call only
@@ -796,6 +1020,7 @@ class CachedStep:
             "shard_ok": shard_ok,
             "mesh": spec,
             "plan": plan is not None,
+            "sparse": sorted(sparse_live),
             "coll_bytes": 0 if mesh is None else sum(
                 int(p._grad._data.size)
                 * jnp.dtype(p._grad._data.dtype).itemsize
@@ -833,6 +1058,11 @@ class CachedStep:
         for spec_str, nbytes in meta.get("coll_specs", ()):
             kvs_mod._count_collective("spmd_grad_reduce", nbytes,
                                       spec=spec_str)
+        if meta.get("embed_bytes"):
+            # the hot-path currency of the sharded-embedding workload:
+            # bytes the bucketed index/vector all-to-alls move per step
+            kvs_mod._count_collective("embed_all_to_all",
+                                      meta["embed_bytes"])
         batch_vals = [b._data for b in batch_nd]
         diff_vals = [self._mesh_resident("d", i, p.data()._data)
                      for i, p in diff]
@@ -940,6 +1170,20 @@ class CachedStep:
                 p.data()._rebind(v)
                 self._mesh_cache[("d", i)] = (v, w)
             for (_, p), g in zip(diff, out_gs):
+                if isinstance(g, tuple):
+                    # sparse fast path: the table's gradient exists ONLY
+                    # as (unique_ids, touched_rows) — p.grad() keeps its
+                    # previous (stale) buffer; consumers of sparse grads
+                    # read this pair (docs/PERFORMANCE.md "Sharded
+                    # embeddings")
+                    p._sparse_grad = (NDArray(_dev0_view(g[0])),
+                                      NDArray(_dev0_view(g[1])))
+                    continue
+                # a table that trained sparse EARLIER but dense now
+                # (demotion, plan/optimizer change) must not leave a
+                # stale (ids, rows) pair for consumers to read
+                if getattr(p, "_sparse_grad", None) is not None:
+                    p._sparse_grad = None
                 p._grad._rebind(_logical_view(g))
             for p, v, j in zip(meta["aux"], aux_vals, meta["aux_pos"]):
                 view = _logical_view(v)

@@ -12,7 +12,8 @@ from ..block import (Block, HybridBlock, _layer_rng, _report_aux_update,
                      is_symbolic)
 
 __all__ = ["Sequential", "HybridSequential", "Dense", "Dropout", "Flatten",
-           "Lambda", "HybridLambda", "Embedding", "BatchNorm", "LayerNorm",
+           "Lambda", "HybridLambda", "Embedding", "ShardedEmbedding",
+           "BatchNorm", "LayerNorm",
            "InstanceNorm", "GroupNorm", "Activation", "LeakyReLU", "PReLU",
            "ELU", "SELU", "Swish", "GELU", "SiLU", "Concurrent", "Identity", "BatchNormReLU"]
 
@@ -186,8 +187,70 @@ class Embedding(HybridBlock):
         return F.Embedding(x, weight, input_dim=self._input_dim,
                            output_dim=self._output_dim)
 
+    def cast(self, dtype):
+        # the block's dtype governs the TABLE only; integer index batches
+        # must never be cast through a float dtype (exactness dies at
+        # 2**24 — ISSUE 15 satellite). HybridBlock.cast already touches
+        # parameters only; this override just documents + pins that.
+        return super().cast(dtype)
+
     def __repr__(self):
         return f"Embedding({self._input_dim} -> {self._output_dim})"
+
+
+class ShardedEmbedding(Embedding):
+    """Model-parallel embedding table for recommender-scale vocabularies
+    (ISSUE 15; docs/PERFORMANCE.md "Sharded embeddings").
+
+    Same forward contract as `Embedding`, but the table is meant to be
+    ROW-SHARDED over a mesh axis by a shard-plan rule
+    (`shard.DEFAULT_RULES` row-shards ``*embed*_weight`` over ``tp``),
+    and under a captured step (`Trainer.capture` with `Trainer.shard`)
+    the lookup lowers to the sparse fast path of
+    mxnet_tpu/shard/embedding.py: dedup -> owner-bucketed all-to-all
+    index exchange -> local gather -> all-to-all vector return, with a
+    `(unique_rows, D)` sparse backward and a scatter-add optimizer
+    update on the owning shard only — no O(vocab) gradient, no
+    host-side gather, table + state mesh-resident between steps.
+
+    Integer index batches are REQUIRED (int32/int64 pass untouched); a
+    float index batch raises instead of silently looking up the wrong
+    row above 2**24. Outside a captured+sharded step the block behaves
+    exactly like `Embedding` on integer inputs.
+    """
+
+    def __init__(self, input_dim, output_dim, dtype=np.float32,
+                 weight_initializer=None, **kwargs):
+        super().__init__(input_dim, output_dim, dtype=dtype,
+                         weight_initializer=weight_initializer, **kwargs)
+        # the capture-path marker mxnet_tpu/cachedop.py keys sparse
+        # eligibility on (shard/embedding.py sparse_eligibility)
+        self.weight._sharded_embedding = {"vocab": int(input_dim),
+                                          "dim": int(output_dim)}
+
+    def hybrid_forward(self, F, x, weight):
+        from ...shard import embedding as _semb
+        if is_symbolic(x):
+            # a Symbol's dtype is only a HINT (usually None until bind);
+            # enforce the integer contract when the hint is there — the
+            # eager/captured paths below always enforce it at execution
+            hint = getattr(x, "_dtype_hint", None)
+            if hint is not None:
+                _semb.check_index_dtype(hint)
+            return F.Embedding(x, weight, input_dim=self._input_dim,
+                               output_dim=self._output_dim)
+        _semb.check_index_dtype(x.dtype)
+        ctx = _semb.SparseLookupContext.active()
+        if ctx is not None and ctx.handles(self.weight):
+            # captured-step trace: recording is off, tracers flow raw
+            return type(x)(_semb.lookup(self.weight, x._data,
+                                        weight._data))
+        return F.Embedding(x, weight, input_dim=self._input_dim,
+                           output_dim=self._output_dim)
+
+    def __repr__(self):
+        return (f"ShardedEmbedding({self._input_dim} -> "
+                f"{self._output_dim})")
 
 
 class BatchNorm(HybridBlock):

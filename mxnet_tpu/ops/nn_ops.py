@@ -402,7 +402,15 @@ def dropout(x, key, p=0.5, training=True):
 
 
 def embedding(indices, weight):
-    return jnp.take(weight, indices.astype(jnp.int32), axis=0)
+    # integer index batches pass through UNTOUCHED (int32/int64): the old
+    # unconditional astype(int32) round-tripped nothing through float,
+    # but ISSUE 15 pins the contract — only non-integer indices (the
+    # MXNet float-default compat path) are cast, and that cast is lossy
+    # above 2**24 rows (recommender scale wants a ShardedEmbedding with
+    # integer inputs, which refuses floats outright)
+    if not jnp.issubdtype(indices.dtype, jnp.integer):
+        indices = indices.astype(jnp.int32)
+    return jnp.take(weight, indices, axis=0)
 
 
 def softmax(x, axis=-1, temperature=None):

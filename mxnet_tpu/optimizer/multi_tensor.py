@@ -41,7 +41,8 @@ from .updater import Updater
 from .optimizer import Optimizer, DCASGD
 
 __all__ = ["FusedUpdater", "build_buckets", "bucket_signature", "supports",
-           "flat_layout", "split_flat", "apply_param_update"]
+           "flat_layout", "split_flat", "apply_param_update",
+           "sparse_update_rows"]
 
 
 def flat_layout(shapes):
@@ -176,6 +177,26 @@ def apply_param_update(optimizer, w, g, sv, lr, wd, mp, clip, rescale,
         new_w, new_s = optimizer.apply(w, gg, tuple(sv), lr, wd)
         full = tuple(new_s)
     return new_w, full + tuple(sv[len(full):]), out_g
+
+
+def sparse_update_rows(optimizer, w_rows, g_rows, sv_rows, lr, wd, mp,
+                       clip, rescale, inv_scale=None):
+    """The scatter-add arm of the multi-tensor update (ISSUE 15): stage
+    ONE gathered row block of a row-sharded embedding table through the
+    exact `apply_param_update` numerics — folded AMP unscale, f32
+    upcast, rescale, clip, optional fp32 master rows, the optimizer's
+    elementwise `apply` — so the sparse fast path's touched rows update
+    bit-for-bit like the dense path would update them. Only valid for
+    `Optimizer.elementwise` rules (cachedop gates eligibility on it):
+    an elementwise `apply` restricted to the touched rows IS the dense
+    update restricted to those rows; untouched rows keep their weight
+    AND state (MXNet's lazy/sparse-update semantics — wd and
+    momentum-style state decay touch looked-up rows only). Scalar state
+    leaves (Adam's step counter) ride whole and update once.
+    The caller scatters the returned rows back on the owning shard
+    (shard/embedding.py `sparse_row_update`)."""
+    return apply_param_update(optimizer, w_rows, g_rows, sv_rows, lr, wd,
+                              mp, clip, rescale, inv_scale)
 
 
 def _make_kernel(optimizer, mp_flags, clip, unscale, n):

@@ -22,6 +22,7 @@ Quick start::
 from . import rules
 from . import mesh
 from . import redistribute
+from . import embedding
 from .rules import (DEFAULT_RULES, match_partition_rules, validate_rules,
                     normalize_spec, spec_to_json, spec_from_json)
 from .mesh import ShardPlan, plan, make_mesh_2d, as_mesh
@@ -29,7 +30,7 @@ from .redistribute import redistribute as redistribute_array
 from .redistribute import redistribute_tree, resharded_bytes
 
 __all__ = [
-    "rules", "mesh", "redistribute",
+    "rules", "mesh", "redistribute", "embedding",
     "DEFAULT_RULES", "match_partition_rules", "validate_rules",
     "normalize_spec", "spec_to_json", "spec_from_json",
     "ShardPlan", "plan", "make_mesh_2d", "as_mesh",

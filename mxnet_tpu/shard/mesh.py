@@ -21,6 +21,7 @@ reference works.
 from __future__ import annotations
 
 import itertools
+import warnings
 
 import numpy as np
 
@@ -106,7 +107,41 @@ class ShardPlan:
             spec = self._cache[key] = specs[name]
             self._unmatched.extend(report["unmatched"])
             self._fallbacks.extend(report["fallbacks"])
+            self._check_large_replicated(name, key[1], spec,
+                                         report["unmatched"])
         return spec
+
+    def _check_large_replicated(self, name, shape, spec, unmatched):
+        """An unmatched (or rule-downgraded) parameter big enough that
+        replicating it hurts must REPORT loudly, not vanish into the
+        report dict (ISSUE 15: a 10**8-row embedding table a rule typo
+        fails to match would silently replicate onto every device and
+        OOM at recommender scale — long before anyone reads
+        `plan.report()`). Once per name; threshold via
+        MXTPU_SHARD_WARN_BYTES (0 disables)."""
+        if any(e is not None for e in tuple(spec)) or name in self._warned:
+            return
+        from .._env import env_int
+        limit = env_int("MXTPU_SHARD_WARN_BYTES", 64 << 20, minimum=0)
+        if not limit:
+            return
+        # dtype is unknown at rule-resolution time; 4 bytes/element is
+        # the fp32 floor (fp16 tables halve it — still the right order)
+        nbytes = int(np.prod(shape or (1,), dtype=np.int64)) * 4
+        if nbytes < limit:
+            return
+        self._warned.add(name)
+        why = ("no partition rule matched" if name in unmatched
+               else "its rule downgraded to replicated "
+                    "(non-divisible dim or unknown axis)")
+        warnings.warn(
+            f"shard plan replicates {name!r} (~{nbytes >> 20} MiB per "
+            f"device): {why}. At this size replication is probably an "
+            f"OOM, not a layout choice — add or fix a rule "
+            f"(shard.DEFAULT_RULES row-shards '*embed*_weight' over "
+            f"'tp'; see docs/PERFORMANCE.md \"Sharded embeddings\"). "
+            f"Silence with MXTPU_SHARD_WARN_BYTES=0.", RuntimeWarning,
+            stacklevel=4)
 
     def sharding(self, name, shape):
         return NamedSharding(self.mesh, self.spec_for(name, shape))

@@ -38,8 +38,22 @@ from jax.sharding import PartitionSpec as P
 
 from ..base import MXNetError
 
-__all__ = ["DEFAULT_RULES", "match_partition_rules", "validate_rules",
+__all__ = ["DEFAULT_RULES", "EMBED_WEIGHT_PATTERN",
+           "match_partition_rules", "validate_rules",
            "normalize_spec", "spec_to_json", "spec_from_json"]
+
+
+# What counts as an embedding table, BY NAME: either "embed" ANYWHERE
+# in the final segment (zoo/transformer "embed*"/"embedding*",
+# "wordembed0"/"posembed" compound names, `ShardedEmbedding`'s
+# "shardedembedding*" — the pre-ISSUE-15 rule's reach, kept so no
+# existing model silently loses its sharding) or a segment STARTING
+# with "emb" (DLRM-style "emb0"/"emb_cat3") — while "member0_weight"
+# (no "embed", "emb" mid-word) stays a plain Dense weight. ONE
+# definition shared by the DEFAULT_RULES row-shard rule below and the
+# recommender memory headline (shard/embedding.py
+# `embed_param_bytes_frac`).
+EMBED_WEIGHT_PATTERN = r"(?:embed[^/]*|(?:^|_)emb[^/]*)_weight$"
 
 
 # First match wins. The attention/ffn rules sit ABOVE the generic
@@ -49,9 +63,12 @@ __all__ = ["DEFAULT_RULES", "match_partition_rules", "validate_rules",
 DEFAULT_RULES = (
     # norm statistics / affine params + biases: tiny, replicate
     (r"_(gamma|beta|running_mean|running_var|bias|scales)$", None),
-    # embeddings: row-shard the vocab dim over tp (lookup becomes a
-    # sharded gather; GSPMD inserts the exchange)
-    (r"embed[^/]*_weight$", P("tp", None)),
+    # embedding tables: row-shard the vocab dim over tp. Under a
+    # captured step a `ShardedEmbedding` table with this layout takes
+    # the sparse fast path (shard/embedding.py: bucketed all-to-all
+    # lookup + scatter-add update); anything else lets GSPMD insert
+    # the exchange.
+    (EMBED_WEIGHT_PATTERN, P("tp", None)),
     # attention + ffn matmul weights: TP over the output dim (Dense
     # weights are (out, in) — dim 0 is the output features)
     (r"(?:^|_)(qkv|query|key|value|proj|q|k|v|out|ffn[0-9]*)_weight$",

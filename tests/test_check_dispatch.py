@@ -31,6 +31,16 @@ def test_captured_dispatch_budget_and_parity():
     assert res["shard_dispatches_per_step"] <= res["budget"]
     assert res["shard_sync_h2d_per_step"] == 0
     assert res["shard_param_bytes_frac"] < 1.0
+    # ISSUE 15: the sharded-embedding captured step (DLRM, vocab >>
+    # batch) holds the same budget warm, stages integer index batches
+    # transfer-free, shrinks per-device embedding bytes to ~1/tp, and
+    # its backward temp allocation fits far under one dense (V, D)
+    # table gradient — the no-O(vocab)-gradient proof
+    assert res["embed_mesh"] is True
+    assert res["embed_dispatches_per_step"] <= res["budget"]
+    assert res["embed_sync_h2d_per_step"] == 0
+    assert res["embed_param_bytes_frac"] <= 0.5 + 1e-9
+    assert res["embed_backward_temp_frac"] < 1.0
     # ISSUE 6: the serve decode loop is ONE dispatch per warm decode
     # turn, never retraces across varying slot occupancy, and returns
     # every KV page when the traffic drains

@@ -55,6 +55,16 @@ def test_fusion_budgets_hold_and_control_trips():
         assert res[name]["aliased_inputs"] == 4
         assert res[name]["collective_total"] == 0
     assert res["serve_int8_traces"] == 2
+    # ISSUE 15: the sharded-embedding step — the sparse fast path costs
+    # EXACTLY 2 all-to-alls per table (bucketed index exchange + vector
+    # return; 2 tables in the fixture), the pin agrees with the
+    # exchange math, and the donated tables alias in place
+    from mxnet_tpu.shard import embedding as semb
+    assert res["sharded_embed"]["collectives"]["all-to-all"] == \
+        check_fusion.BUDGETS["sharded_embed_step"]["all_to_all"] == \
+        semb.A2A_PER_TABLE * 2
+    assert res["sharded_embed_a2a_consistent"] is True
+    assert res["sharded_embed"]["aliased_inputs"] == 4
     # the gate provably bites: the fusion-pass-disabled control landed
     # below the band and tripped the SAME budget table
     assert res["control_tripped"] is True
@@ -181,5 +191,6 @@ def test_hlo_counting_handles_tpu_layout_annotations():
 def test_check_fusion_cli_smoke():
     assert callable(check_fusion.main)
     assert set(check_fusion.BUDGETS) == {
-        "captured_step", "sharded_step", "serve_decode", "serve_prefill",
+        "captured_step", "sharded_step", "sharded_embed_step",
+        "serve_decode", "serve_prefill",
         "serve_verify", "serve_decode_int8", "serve_verify_int8"}

@@ -28,6 +28,16 @@ attached, a warm step must stay within the same dispatch budget, do zero
 synchronous H2D when the device prefetcher feeds it, and genuinely
 reduce per-device parameter bytes (>= 4 devices; skipped below that).
 
+ISSUE 15 extension — the warm-step budget also covers the SHARDED-
+EMBEDDING captured step: a DLRM-style model with a `ShardedEmbedding`
+table row-sharded over 'tp' (vocab >> batch) must hold the same <=2
+dispatch budget warm, do zero synchronous H2D with the device
+prefetcher staging integer index batches, shrink per-device embedding
+bytes (`embed_param_bytes_frac` < 1), and its backward must fit under
+the bytes of ONE dense (V, D) table gradient — the in-HLO proof that
+the sparse fast path never materialises an O(vocab) cotangent
+(>= 4 devices; skipped below).
+
 ISSUE 6 extension — the warm-step budget also covers the SERVE decode
 loop: a warm continuous-batching decode turn must be at most ONE device
 dispatch (the shared ragged-paged-attention decode executable), the
@@ -138,6 +148,7 @@ def run(steps=DEFAULT_STEPS, budget=DISPATCH_BUDGET):
 
     prefetch_res = _run_prefetch_phase(steps, errors)
     shard_res = _run_shard_phase(steps, errors)
+    shard_res.update(_run_embed_phase(errors))
     serve_res = _run_serve_phase(errors)
     serve_res.update(_run_serve_fastpath_phase(errors))
     serve_res.update(_run_serve_int8_phase(errors))
@@ -308,6 +319,133 @@ def _run_shard_phase(steps, errors):
         "shard_dispatches_per_step": worst,
         "shard_sync_h2d_per_step": worst_sync,
         "shard_param_bytes_frac": round(frac, 4),
+    }
+
+
+def _run_embed_phase(errors):
+    """Sharded-embedding budget (ISSUE 15): a warm captured DLRM step —
+    `ShardedEmbedding` table row-sharded over 'tp' on the (2,2) mesh,
+    vocab >> batch so the bound below bites — must stay within the <=2
+    dispatch budget, do ZERO synchronous H2D with the device prefetcher
+    staging the INTEGER index batches, genuinely shrink per-device
+    embedding bytes (`embed_param_bytes_frac` < 1; ~1/tp), and its
+    backward must never materialise an O(vocab) dense gradient: the
+    executable's temp allocation is asserted under the bytes ONE dense
+    (V, D) table gradient would cost. Needs >= 4 devices; skipped
+    cleanly below that. The model is deliberately tiny (one table, a
+    1-unit tower, ~10 steps total) to stay inside the tier-1 verify
+    window."""
+    import jax
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, nd, profiler
+    from mxnet_tpu.observability import registry
+    from mxnet_tpu.prefetch import DevicePrefetcher
+    from mxnet_tpu.shard import embedding as semb
+
+    if len(jax.devices()) < 4:
+        return {"embed_mesh": False, "embed_dispatches_per_step": None,
+                "embed_sync_h2d_per_step": None,
+                "embed_param_bytes_frac": None,
+                "embed_backward_temp_frac": None}
+
+    V, D, B, F = 4096, 16, 16, 4          # vocab >> B*F touched rows
+    rng = np.random.RandomState(3)
+    Ih = rng.randint(0, V, (B, F)).astype(np.int32)
+    Xh = rng.randn(B, 4).astype(np.float32)
+    yh = rng.randn(B).astype(np.float32)
+
+    class _DLRM(gluon.nn.HybridBlock):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            with self.name_scope():
+                self.embed = gluon.nn.ShardedEmbedding(V, D)
+                self.top = gluon.nn.Dense(1, in_units=F * D + 4)
+
+        def hybrid_forward(self, F_, idx, xd):
+            e = self.embed(idx).reshape((idx.shape[0], -1))
+            return self.top(F_.concat(e, xd, dim=1))
+
+    mx.random.seed(0)
+    net = _DLRM()
+    net.initialize(mx.init.Xavier())
+    net(nd.array(Ih, dtype=np.int32), nd.array(Xh))
+    lossf = gluon.loss.L2Loss()
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.1}, kvstore="ici")
+    plan = tr.shard(mesh={"dp": 2, "tp": 2})
+
+    params = {p.name: p.data()._data
+              for p in net.collect_params().values()}
+    frac = semb.embed_param_bytes_frac(plan, params)
+    if frac is None or frac >= 1.0:
+        errors.append(f"shard plan did not reduce per-device embedding "
+                      f"bytes (embed_param_bytes_frac={frac})")
+
+    step = tr.capture(lambda a, b, c: lossf(net(a, b), c).mean())
+    step(nd.array(Ih, dtype=np.int32), nd.array(Xh), nd.array(yh))
+    if step.last_fallback_reason is not None:
+        errors.append(f"sharded embed step fell back on compile: "
+                      f"{step.last_fallback_reason}")
+
+    sync = registry().counter("prefetch_h2d_sync")
+    worst = 0
+    worst_sync = 0
+    pf = DevicePrefetcher(((Ih, Xh, yh) for _ in range(4)),
+                          capture_spec=tr._kvstore)
+    try:
+        for ib, xb, yb in pf:
+            base = sync.value
+            profiler.reset_dispatches()
+            step(ib, xb, yb)
+            worst = max(worst, profiler.dispatch_count())
+            worst_sync = max(worst_sync, sync.value - base)
+            if step.last_fallback_reason is not None:
+                errors.append(f"sharded embed step fell back: "
+                              f"{step.last_fallback_reason}")
+    finally:
+        pf.close()
+    if worst > DISPATCH_BUDGET:
+        errors.append(f"sharded embed dispatch budget exceeded: "
+                      f"{worst}/step (budget {DISPATCH_BUDGET})")
+    if worst_sync:
+        errors.append(f"device-prefetched integer index batches "
+                      f"performed {worst_sync} synchronous H2D "
+                      f"transfer(s) (budget 0)")
+
+    # the no-dense-gradient proof: relower the warm executable from its
+    # recorded aval skeleton (no python re-trace) and bound its TEMP
+    # allocation under one dense (V, D) fp32 table gradient — at
+    # vocab >> batch a backward that materialised the O(vocab) cotangent
+    # could not fit the bound (actual temps are O(unique_rows * D))
+    dense_grad_bytes = V * D * 4
+    temp_frac = None
+    from mxnet_tpu.observability import compilex
+    ij = compilex.instrumented().get("sharded_embed_step")
+    if ij is None or ij.last_abstract is None:
+        errors.append("sharded_embed_step never registered with the "
+                      "compile observatory — the sparse fast path did "
+                      "not engage")
+    else:
+        args, kwargs = ij.last_abstract
+        ma = ij.lower(*args, **kwargs).compile().memory_analysis()
+        temp_frac = ma.temp_size_in_bytes / dense_grad_bytes
+        if ma.temp_size_in_bytes >= dense_grad_bytes:
+            errors.append(
+                f"sharded embed backward temp allocation "
+                f"{ma.temp_size_in_bytes} B >= one dense (V={V}, D={D}) "
+                f"table gradient ({dense_grad_bytes} B) — the sparse "
+                f"path is materialising an O(vocab) buffer")
+
+    return {
+        "embed_mesh": True,
+        "embed_dispatches_per_step": worst,
+        "embed_sync_h2d_per_step": worst_sync,
+        "embed_param_bytes_frac": (None if frac is None
+                                   else round(frac, 4)),
+        "embed_backward_temp_frac": (None if temp_frac is None
+                                     else round(temp_frac, 4)),
     }
 
 
@@ -644,7 +782,12 @@ def main(argv=None):
                  if not res["shard_mesh"] else
                  f"{res['shard_dispatches_per_step']} dispatch/step "
                  f"sharded (2,2) at "
-                 f"{res['shard_param_bytes_frac']}x param bytes/dev")
+                 f"{res['shard_param_bytes_frac']}x param bytes/dev; "
+                 f"embed {res['embed_dispatches_per_step']} "
+                 f"dispatch/step at {res['embed_param_bytes_frac']}x "
+                 f"embed bytes/dev, backward temp "
+                 f"{res['embed_backward_temp_frac']}x of one dense "
+                 f"table grad")
     print(f"check_dispatch: OK ({res['captured_dispatches_per_step']} "
           f"dispatch/step captured vs "
           f"{res['imperative_dispatches_per_step']} imperative; "

@@ -21,6 +21,11 @@ optimized-HLO counts:
     -> all-to-all / collective-permute), fusion band holds, donation
     aliases hold. Needs >= 4 devices (tier-1 conftest forks 8); skipped
     cleanly below that;
+  * the sharded-embedding captured step (ISSUE 15; >= 4 devices): the
+    sparse-lookup fast path's all-to-all count pinned EXACTLY at 2 per
+    table (bucketed index exchange + vector return), cross-checked
+    in-process against shard/embedding.py's A2A_PER_TABLE, with every
+    donated table/tower buffer aliased;
   * serve decode + prefill executables: fusion bands, zero collectives,
     and the donated KV-page pools / encoder-memory buffers aliased;
   * a deliberately DE-FUSED control: a subprocess compiles the same
@@ -112,6 +117,26 @@ BUDGETS = {
         "copies": (0, 40),
         "aliased_inputs": 4,
     },
+    # ISSUE 15: the sharded-embedding captured step (two ShardedEmbedding
+    # tables + a dense tower on the (2,2) DEFAULT_RULES mesh). The
+    # headline pin is `all_to_all`: the sparse fast path lowers each
+    # table's lookup to EXACTLY one bucketed index exchange plus one
+    # vector return (shard/embedding.py A2A_PER_TABLE == 2), so the
+    # fixture's two tables must cost exactly 4 all-to-alls — run()
+    # cross-checks this pin against A2A_PER_TABLE * n_tables, so the
+    # budget and the exchange math cannot drift apart silently. The
+    # other collective kinds are GSPMD's dense-tower/replication
+    # plumbing and stay un-pinned (the mix shifts benignly with XLA
+    # versions; a sparse-path regression shows up in the a2a count or
+    # the copy band first). Measured 89 fusions / 34 copies on the
+    # pinned toolchain. All 4 donated buffers (2 tables + dense W/b)
+    # must alias — table donation is the mesh-residency story.
+    "sharded_embed_step": {
+        "fusions": (45, 135),
+        "all_to_all": 4,
+        "copies": (0, 68),
+        "aliased_inputs": 4,
+    },
 }
 
 CONTROL_TIMEOUT_S = 240
@@ -138,6 +163,13 @@ def check_budget(name, info, budget=None):
             != budget["collectives"]:
         errors.append(f"{name}: collective mix {info['collectives']} != "
                       f"rule-derived budget {budget['collectives']}")
+    if "all_to_all" in budget and info["collectives"].get(
+            "all-to-all", 0) != budget["all_to_all"]:
+        errors.append(
+            f"{name}: {info['collectives'].get('all-to-all', 0)} "
+            f"all-to-all(s) (expected exactly {budget['all_to_all']} — "
+            f"the bucketed-exchange math says 2 per sharded table: one "
+            f"index exchange + one vector return)")
     if "copies" in budget:
         lo, hi = budget["copies"]
         if not lo <= info["copies"] <= hi:
@@ -210,6 +242,57 @@ def captured_step_info(sharded=False, steps=2):
     params = {p.name: p.data()._data
               for p in net.collect_params().values()}
     return step.hlo_info(), step, plan, params
+
+
+def sharded_embed_step_info(steps=2):
+    """Build a tiny two-table DLRM (two `ShardedEmbedding` tables + a
+    dense tower), capture its training step under the (2,2)
+    DEFAULT_RULES shard plan — the tables row-shard over 'tp', so the
+    sparse fast path is live and the step publishes as
+    `sharded_embed_step` — run `steps` steps and return
+    (hlo_info, step, n_tables). Needs >= 4 devices (callers skip below
+    that, like the sharded phase). check_static.py reuses this fixture
+    so its copy allowance guards a program the gate deterministically
+    compiled."""
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, nd
+
+    rng = np.random.RandomState(0)
+    V1, V2, D, B, F = 64, 96, 8, 8, 3
+    I1 = nd.array(rng.randint(0, V1, (B, F)).astype(np.int32),
+                  dtype=np.int32)
+    I2 = nd.array(rng.randint(0, V2, (B,)).astype(np.int32),
+                  dtype=np.int32)
+    Xd = nd.array(rng.randn(B, 4).astype(np.float32))
+    yh = nd.array(rng.randn(B).astype(np.float32))
+
+    class _DLRM(gluon.nn.HybridBlock):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            with self.name_scope():
+                self.emb_a = gluon.nn.ShardedEmbedding(V1, D)
+                self.emb_b = gluon.nn.ShardedEmbedding(V2, D)
+                self.top = gluon.nn.Dense(1, in_units=(F + 1) * D + 4)
+
+        def hybrid_forward(self, F_, i1, i2, xd):
+            a = self.emb_a(i1).reshape((i1.shape[0], -1))
+            b = self.emb_b(i2)
+            return self.top(F_.concat(a, b, xd, dim=1))
+
+    mx.random.seed(0)
+    net = _DLRM()
+    net.initialize(mx.init.Xavier())
+    net(I1, I2, Xd)
+    lossf = gluon.loss.L2Loss()
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.1}, kvstore="ici")
+    tr.shard(mesh={"dp": 2, "tp": 2})
+    step = tr.capture(lambda a, b, c, d: lossf(net(a, b, c), d).mean())
+    for _ in range(steps):
+        step(I1, I2, Xd, yh)
+    return step.hlo_info(), step, 2
 
 
 def _serve_infos():
@@ -366,6 +449,29 @@ def _run_impl():
                     f"{sorted(kinds)} missing from lowered program "
                     f"{sorted(sh_info['collectives'])}")
 
+    # -- sharded-embedding step (ISSUE 15; >= 4 devices, same skip) ----
+    emb_info = None
+    emb_a2a_consistent = None
+    if shard_mesh:
+        emb_info, emb_step, n_tables = sharded_embed_step_info()
+        errors += check_budget("sharded_embed_step", emb_info)
+        if emb_step.last_fallback_reason is not None:
+            errors.append(f"sharded embed step fell back: "
+                          f"{emb_step.last_fallback_reason}")
+        # cross-check the pinned all-to-all count against the bucketed-
+        # exchange math: 2 per table (index exchange + vector return)
+        from mxnet_tpu.shard import embedding as _semb
+        expect_a2a = _semb.A2A_PER_TABLE * n_tables
+        if BUDGETS["sharded_embed_step"]["all_to_all"] != expect_a2a:
+            errors.append(
+                f"sharded_embed_step: pinned all_to_all budget "
+                f"{BUDGETS['sharded_embed_step']['all_to_all']} "
+                f"disagrees with the exchange math "
+                f"A2A_PER_TABLE * n_tables = {expect_a2a} — fix the "
+                f"budget or the exchange, not one of them")
+        emb_a2a_consistent = \
+            BUDGETS["sharded_embed_step"]["all_to_all"] == expect_a2a
+
     # -- serve decode / prefill ----------------------------------------
     dec_info, pre_info, dec_traces = _serve_infos()
     errors += check_budget("serve_decode", dec_info)
@@ -412,6 +518,8 @@ def _run_impl():
         "shard_mesh": shard_mesh,
         "sharded": _strip(sh_info),
         "sharded_kinds_consistent": kinds_ok,
+        "sharded_embed": _strip(emb_info),
+        "sharded_embed_a2a_consistent": emb_a2a_consistent,
         "serve_decode": _strip(dec_info),
         "serve_prefill": _strip(pre_info),
         "serve_decode_traces": dec_traces,
@@ -451,7 +559,10 @@ def main(argv=None):
         return 1
     shard_txt = ("shard phase skipped (<4 devices)" if not res["shard_mesh"]
                  else f"sharded {res['sharded']['fusions']} fusions / "
-                      f"{res['sharded']['collectives']}")
+                      f"{res['sharded']['collectives']}; embed step "
+                      f"{res['sharded_embed']['collectives'].get('all-to-all', 0)} "
+                      f"all-to-alls / "
+                      f"{res['sharded_embed']['aliased_inputs']} aliased")
     print(f"check_fusion: OK (captured {res['captured']['fusions']} "
           f"fusions / {res['captured']['collective_total']} collectives "
           f"/ {res['captured']['aliased_inputs']} aliased; {shard_txt}; "
