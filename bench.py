@@ -393,6 +393,21 @@ def main():
                 result["rec_vs_replicated"] = rres["rec_vs_replicated"]
         except Exception as e:  # pragma: no cover
             print(f"[bench] rec bench failed: {e!r}", file=sys.stderr)
+        # ISSUE 16: expert parallelism — sharded-MoE steps/s vs the
+        # equal-parameter dense FFN, with the capacity-overflow drop
+        # fraction the run suffered. Same honesty contract: fields
+        # OMITTED below 4 devices (bench_moe reports value None), never
+        # faked; own guard so an MoE failure can't take down the rec/
+        # shard fields above.
+        try:
+            import bench_moe
+            mres = bench_moe.measure()
+            if mres.get("value") is not None:
+                result["moe_step_throughput"] = mres["value"]
+                result["moe_vs_dense_ffn"] = mres["moe_vs_dense_ffn"]
+                result["moe_drop_frac"] = mres["moe_drop_frac"]
+        except Exception as e:  # pragma: no cover
+            print(f"[bench] moe bench failed: {e!r}", file=sys.stderr)
 
     # Serving headline (ISSUE 6): continuous-batching tokens/s + p99
     # latency under Poisson arrivals, recorded as first-class fields of

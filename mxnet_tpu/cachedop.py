@@ -338,14 +338,22 @@ class CachedStep:
             # drop any sparse pair an earlier captured step left behind
             if getattr(p, "_sparse_grad", None) is not None:
                 p._sparse_grad = None
+        from .shard import moe as _smoe
         with autograd.record():
-            out = self._loss_fn(*batch_nd)
-            leaves, _ = jax.tree_util.tree_flatten(
+            with _smoe.capture_scope(None) as moe_tape:
+                out = self._loss_fn(*batch_nd)
+            leaves, treedef = jax.tree_util.tree_flatten(
                 out, is_leaf=lambda x: isinstance(x, NDArray))
             if not leaves or not isinstance(leaves[0], NDArray):
                 raise MXNetError("capture: loss_fn must return an NDArray "
                                  "loss (optionally nested with extra "
                                  "outputs, loss leaf first)")
+            # MoE load-balancing aux losses join the head exactly like
+            # the captured path does (same loss value either way)
+            for aux_l in moe_tape.losses:
+                leaves[0] = leaves[0] + aux_l
+            if moe_tape.losses:
+                out = jax.tree_util.tree_unflatten(treedef, leaves)
             sc = amp.scaler()
             head = leaves[0] * sc.loss_scale if sc is not None else leaves[0]
         head.backward()
@@ -497,6 +505,7 @@ class CachedStep:
         from .optimizer.multi_tensor import apply_param_update
         from .jax_compat import shard_map
         from .shard import embedding as _semb
+        from .shard import moe as _smoe
         from jax.sharding import PartitionSpec as P
         sparse_info = sparse_info or {}
 
@@ -547,7 +556,8 @@ class CachedStep:
             prev_rec = autograd.set_recording(False)
             prev_train = autograd.set_training(True)
             try:
-                with _TraceContext(rng) as tctx:
+                with _TraceContext(rng) as tctx, \
+                        _smoe.capture_scope(plan) as moe_tape:
                     for p, v in zip(diff_params, diff_vals):
                         p._trace_override = NDArray(v)
                     for p, v in zip(nd_list, nondiff_vals):
@@ -560,10 +570,19 @@ class CachedStep:
                         raise MXNetError(
                             "capture: loss_fn must return NDArray(s), "
                             "loss leaf first")
+                    # MoE aux losses (load balancing) join the loss
+                    # head HERE, inside the trace — so they are part of
+                    # the differentiated program and their gradient
+                    # drives the router (shard/moe.py)
+                    head = leaves[0]
+                    for aux_l in moe_tape.losses:
+                        head = head + aux_l
                     meta["treedef"] = treedef
                     meta["n_out"] = len(leaves)
                     meta["aux"] = [p for p, _ in tctx.aux_updates]
-                    return ([l._data for l in leaves],
+                    meta["moe_sites"] = list(moe_tape.sites)
+                    return ([head._data] +
+                            [l._data for l in leaves[1:]],
                             [v._data if isinstance(v, NDArray) else v
                              for _, v in tctx.aux_updates])
             finally:
@@ -1001,13 +1020,27 @@ class CachedStep:
                 repl,
             )
 
+        # MoE routing sites the trace reported (shard/moe.py tape): the
+        # sharded ones carry their static a2a byte cost; a site that
+        # fell back to local dispatch carries bytes=0 plus its reason —
+        # loud accounting, the demotion-not-silent discipline
+        moe_sites = meta.get("moe_sites") or []
+        moe_live = plan is not None and any(s["sharded"]
+                                            for s in moe_sites)
+        meta["moe_bytes"] = sum(s.get("bytes", 0) for s in moe_sites)
+
         # compile observatory (observability/compilex.py): the captured
         # step's compiles/HLO structure publish under the executable name
         # check_fusion budgets — "sharded_embed_step" when the sparse
         # embedding fast path is live (its all-to-all count is pinned),
+        # "moe_step" when expert-parallel MoE routing is live under a
+        # plan (its all-to-all count is pinned too; a model with BOTH
+        # sparse tables and MoE keeps the embed name — the sparse path
+        # restructures the program, MoE only adds in-graph collectives),
         # "sharded_step" when a rule plan owns the layout,
         # "captured_step" otherwise (single-device or 1-D mesh)
         exe_name = ("sharded_embed_step" if sparse_live
+                    else "moe_step" if moe_live
                     else "sharded_step" if plan is not None
                     else "captured_step")
         jfn = _compilex.instrument(
@@ -1063,6 +1096,12 @@ class CachedStep:
             # bytes the bucketed index/vector all-to-alls move per step
             kvs_mod._count_collective("embed_all_to_all",
                                       meta["embed_bytes"])
+        if meta.get("moe_bytes"):
+            # same currency for expert parallelism: bytes the MoE
+            # dispatch/combine all-to-alls move per step (forward pair,
+            # shard/moe.py a2a_bytes_per_step convention)
+            kvs_mod._count_collective("moe_all_to_all",
+                                      meta["moe_bytes"])
         batch_vals = [b._data for b in batch_nd]
         diff_vals = [self._mesh_resident("d", i, p.data()._data)
                      for i, p in diff]

@@ -13,7 +13,7 @@ from ..block import (Block, HybridBlock, _layer_rng, _report_aux_update,
 
 __all__ = ["Sequential", "HybridSequential", "Dense", "Dropout", "Flatten",
            "Lambda", "HybridLambda", "Embedding", "ShardedEmbedding",
-           "BatchNorm", "LayerNorm",
+           "ShardedMoE", "BatchNorm", "LayerNorm",
            "InstanceNorm", "GroupNorm", "Activation", "LeakyReLU", "PReLU",
            "ELU", "SELU", "Swish", "GELU", "SiLU", "Concurrent", "Identity", "BatchNormReLU"]
 
@@ -251,6 +251,196 @@ class ShardedEmbedding(Embedding):
     def __repr__(self):
         return (f"ShardedEmbedding({self._input_dim} -> "
                 f"{self._output_dim})")
+
+
+class ShardedMoE(HybridBlock):
+    """Expert-parallel Mixture-of-Experts FFN (ISSUE 16;
+    docs/PERFORMANCE.md "Expert parallelism").
+
+    Replaces one dense FFN with ``num_experts`` expert FFNs and a
+    learned top-``k`` softmax router. The stacked expert banks
+    (``expert_ffn*_weight`` / ``_bias``, dim 0 = expert index) are
+    routed to the 'tp' mesh axis by `shard.DEFAULT_RULES`' axis
+    override, so each device holds ``E / tp`` experts; under a captured
+    step with a shard plan the dispatch/combine lowers to the
+    shard/moe.py 2-all-to-all exchange (tokens sharded over (dp, tp)
+    jointly — the GShard layout). Without a plan, on an axis of size 1,
+    or with non-divisible expert/token counts, the layer degenerates to
+    pure local dispatch with zero collectives.
+
+    Capacity-factor token dropping is LOUD, never silent: the
+    ``dropped`` aux parameter accumulates the psum'd drop count,
+    ``overflow_frac`` holds the last step's dropped fraction of
+    (token, choice) pairs, and `publish_metrics()` forwards both to the
+    observability registry (`moe_tokens_dropped` counter,
+    `moe_overflow_frac` / `moe_aux_loss` gauges). A dropped token's MoE
+    output is exactly zero, so with ``residual=True`` (default) it
+    passes through the skip connection unchanged — gradients included.
+
+    The Switch-style load-balancing auxiliary loss
+    ``E * sum_e f_e * P_e`` (scaled by ``aux_loss_coef``) is threaded
+    into the captured/imperative training loss automatically by
+    `Trainer.capture`; in a hand-written eager loop read it from
+    ``self.last_aux_loss`` after the forward and add it yourself.
+    """
+
+    def __init__(self, units, hidden_units, num_experts, k=2,
+                 capacity_factor=1.25, aux_loss_coef=0.01,
+                 activation="relu", residual=True, normalize_gates=True,
+                 dtype=np.float32, weight_initializer=None, **kwargs):
+        super().__init__(**kwargs)
+        if not 1 <= int(k) <= int(num_experts):
+            raise MXNetError(f"ShardedMoE: k={k} must be in "
+                             f"[1, num_experts={num_experts}]")
+        if capacity_factor <= 0:
+            raise MXNetError("ShardedMoE: capacity_factor must be > 0")
+        from ...shard import moe as _smoe
+        if activation not in _smoe._ACTS:
+            raise MXNetError(f"ShardedMoE: unknown activation "
+                             f"{activation!r} (have "
+                             f"{sorted(_smoe._ACTS)})")
+        self._units = int(units)
+        self._hidden = int(hidden_units)
+        self._num_experts = int(num_experts)
+        self._k = int(k)
+        self._capacity_factor = float(capacity_factor)
+        self._aux_loss_coef = float(aux_loss_coef)
+        self._activation = activation
+        self._residual = bool(residual)
+        self._normalize_gates = bool(normalize_gates)
+        self.last_aux_loss = None
+        self._published_dropped = 0.0
+        E, d, h = self._num_experts, self._units, self._hidden
+        with self.name_scope():
+            self.gate_weight = self.params.get(
+                "gate_weight", shape=(E, d), dtype=dtype,
+                init=weight_initializer)
+            self.expert_ffn1_weight = self.params.get(
+                "expert_ffn1_weight", shape=(E, d, h), dtype=dtype,
+                init=weight_initializer)
+            self.expert_ffn1_bias = self.params.get(
+                "expert_ffn1_bias", shape=(E, h), dtype=dtype,
+                init="zeros")
+            self.expert_ffn2_weight = self.params.get(
+                "expert_ffn2_weight", shape=(E, h, d), dtype=dtype,
+                init=weight_initializer)
+            self.expert_ffn2_bias = self.params.get(
+                "expert_ffn2_bias", shape=(E, d), dtype=dtype,
+                init="zeros")
+            # loud-accounting aux state (BN running-stat pattern):
+            # last-step aux loss + overflow fraction, cumulative drops
+            self.aux_loss = self.params.get(
+                "aux_loss", shape=(1,), init="zeros", grad_req="null")
+            self.overflow_frac = self.params.get(
+                "overflow_frac", shape=(1,), init="zeros",
+                grad_req="null")
+            self.dropped = self.params.get(
+                "dropped", shape=(1,), init="zeros", grad_req="null")
+
+    def _routing(self, n_tokens):
+        """(mesh, axis, layout) for this layer under the enclosing
+        captured step's plan — honouring per-param axis overrides via
+        `plan.spec_for` on the expert bank (None/size-1/non-divisible
+        all land on the local path)."""
+        from ...shard import moe as _smoe
+        plan = _smoe.current_plan()
+        mesh = axis = data_axis = None
+        if plan is not None:
+            E, d, h = self._num_experts, self._units, self._hidden
+            spec = tuple(plan.spec_for(self.expert_ffn1_weight.name,
+                                       (E, d, h)))
+            if spec and isinstance(spec[0], str):
+                mesh, axis = plan.mesh, spec[0]
+                data_axis = plan.data_axis
+        lay = _smoe.routing_layout(
+            n_tokens, self._num_experts, self._k, self._capacity_factor,
+            mesh=mesh, axis=axis, data_axis=data_axis)
+        return mesh, axis, data_axis, lay
+
+    def hybrid_forward(self, F, x, gate_weight, expert_ffn1_weight,
+                       expert_ffn1_bias, expert_ffn2_weight,
+                       expert_ffn2_bias, aux_loss=None,
+                       overflow_frac=None, dropped=None):
+        from ...shard import moe as _smoe
+        from ..block import _TraceContext
+        if is_symbolic(x):
+            raise MXNetError(
+                "ShardedMoE has no symbolic/export path — data-dependent "
+                "token routing does not lower to a static symbol graph; "
+                "hybridize/capture the imperative block instead")
+        if x.shape[-1] != self._units:
+            raise MXNetError(
+                f"ShardedMoE: input feature dim {x.shape[-1]} != "
+                f"units {self._units}")
+        n_tokens = 1
+        for s in x.shape[:-1]:
+            n_tokens *= int(s)
+        mesh, axis, data_axis, lay = self._routing(n_tokens)
+        itemsize = np.dtype(x.dtype).itemsize
+        _smoe.report_site({
+            "name": self.name, "sharded": lay["sharded"],
+            "reason": lay["reason"], "capacity": lay["capacity"],
+            "n_exp_shards": lay["n_exp_shards"],
+            "a2a_per_pass": _smoe.A2A_PER_LAYER if lay["sharded"] else 0,
+            "bytes": _smoe.a2a_bytes_per_step(
+                lay, self._num_experts, self._units, itemsize)})
+
+        def fn(xv, gw, w1, b1, w2, b2, _E=self._num_experts,
+               _k=self._k, _cf=self._capacity_factor,
+               _act=self._activation, _nrm=self._normalize_gates,
+               _mesh=mesh, _axis=axis, _dax=data_axis):
+            shp = xv.shape
+            y2, aux, frac, drops = _smoe.moe_forward(
+                xv.reshape((-1, shp[-1])), gw, w1, b1, w2, b2,
+                n_experts=_E, k=_k, capacity_factor=_cf,
+                activation=_act, normalize_gates=_nrm,
+                mesh=_mesh, axis=_axis, data_axis=_dax)
+            return y2.reshape(shp), aux, frac, drops
+
+        y, aux, frac, drops = _apply(
+            fn, [x, gate_weight, expert_ffn1_weight, expert_ffn1_bias,
+                 expert_ffn2_weight, expert_ffn2_bias], n_out=4)
+        out = (y + x) if self._residual else y
+
+        if autograd.is_training():
+            _report_aux_update(self.aux_loss, aux.reshape((1,)))
+            _report_aux_update(self.overflow_frac, frac.reshape((1,)))
+            _report_aux_update(self.dropped,
+                               dropped + drops.reshape((1,)))
+        scaled = aux * self._aux_loss_coef
+        if not _smoe.report_aux_loss(scaled) \
+                and _TraceContext.active() is None:
+            # hand-written eager loop: the caller owns the aux loss
+            # (never stash a tracer on the block under a trace)
+            self.last_aux_loss = scaled
+        return out
+
+    def publish_metrics(self):
+        """Flush the layer's drop/aux accounting to the observability
+        registry: the cumulative `moe_tokens_dropped{layer=}` counter
+        delta since the last publish plus the `moe_overflow_frac` /
+        `moe_aux_loss` gauges. Host-syncs the three scalars — call it
+        between steps (eval boundaries, bench teardown), never inside
+        a captured loss. Returns {"dropped", "overflow_frac",
+        "aux_loss"} as floats."""
+        from ...observability import registry
+        dropped = float(self.dropped.data().asnumpy()[0])
+        frac = float(self.overflow_frac.data().asnumpy()[0])
+        aux = float(self.aux_loss.data().asnumpy()[0])
+        reg = registry()
+        delta = dropped - self._published_dropped
+        if delta > 0:
+            reg.counter("moe_tokens_dropped", layer=self.name).inc(delta)
+            self._published_dropped = dropped
+        reg.gauge("moe_overflow_frac", layer=self.name).set(frac)
+        reg.gauge("moe_aux_loss", layer=self.name).set(aux)
+        return {"dropped": dropped, "overflow_frac": frac,
+                "aux_loss": aux}
+
+    def __repr__(self):
+        return (f"ShardedMoE({self._units} -> {self._hidden} -> "
+                f"{self._units}, experts={self._num_experts}, "
+                f"k={self._k}, cf={self._capacity_factor})")
 
 
 class BatchNorm(HybridBlock):

@@ -53,7 +53,12 @@ _coll_bytes = {}
 def _count_collective(op, nbytes, spec=None):
     """`spec` (a PartitionSpec, stringified) adds a second label so the
     rule-sharded captured step's traffic is attributable per layout —
-    which rules move bytes, not just which collective kinds."""
+    which rules move bytes, not just which collective kinds. Op kinds
+    counted today: push/pull/broadcast (this module's host collectives),
+    in_graph_psum / in_graph_reduce_scatter / spmd_grad_reduce (captured
+    gradient reduction), embed_all_to_all (sparse-lookup exchange,
+    shard/embedding.py) and moe_all_to_all (expert dispatch/combine,
+    shard/moe.py)."""
     key = op if spec is None else (op, str(spec))
     c = _coll_bytes.get(key)
     if c is None:

@@ -8,6 +8,11 @@ elastic resharding (ISSUE 8; docs/PERFORMANCE.md "Parameter sharding").
                     compiles against)
   redistribute.py — portable collective-based mesh->mesh moves (elastic
                     resize + resharded restore; arXiv:2112.01075)
+  exchange.py     — owner-bucketing + static-shape all-to-all core
+                    shared by the embedding lookup and MoE routing
+  embedding.py    — model-parallel sparse lookup fast path (ISSUE 15)
+  moe.py          — expert-parallel token routing for ShardedMoE
+                    (ISSUE 16; top-k gating, capacity drop accounting)
 
 Quick start::
 
@@ -22,17 +27,21 @@ Quick start::
 from . import rules
 from . import mesh
 from . import redistribute
+from . import exchange
 from . import embedding
+from . import moe
 from .rules import (DEFAULT_RULES, match_partition_rules, validate_rules,
-                    normalize_spec, spec_to_json, spec_from_json)
+                    normalize_spec, spec_to_json, spec_from_json,
+                    rules_to_json, rules_from_json)
 from .mesh import ShardPlan, plan, make_mesh_2d, as_mesh
 from .redistribute import redistribute as redistribute_array
 from .redistribute import redistribute_tree, resharded_bytes
 
 __all__ = [
-    "rules", "mesh", "redistribute", "embedding",
+    "rules", "mesh", "redistribute", "exchange", "embedding", "moe",
     "DEFAULT_RULES", "match_partition_rules", "validate_rules",
     "normalize_spec", "spec_to_json", "spec_from_json",
+    "rules_to_json", "rules_from_json",
     "ShardPlan", "plan", "make_mesh_2d", "as_mesh",
     "redistribute_array", "redistribute_tree", "resharded_bytes",
 ]

@@ -58,6 +58,7 @@ from jax.sharding import PartitionSpec as P
 
 from ..base import MXNetError
 from ..jax_compat import shard_map
+from .exchange import exchange, plan_buckets  # noqa: F401  (re-export)
 
 __all__ = ["plan_buckets", "gather_rows", "sparse_row_update",
            "SparseLookupContext", "lookup", "sparse_eligibility",
@@ -68,29 +69,9 @@ __all__ = ["plan_buckets", "gather_rows", "sparse_row_update",
 # exchange plus the vector return. tools/check_fusion.py cross-checks
 # its pinned count for the (2,2) embedding step against
 # `A2A_PER_TABLE * n_tables` so the budget and the exchange math cannot
-# drift apart silently.
+# drift apart silently. The bucket layout + a2a primitive live in
+# shard/exchange.py (shared with the MoE token-routing head).
 A2A_PER_TABLE = 2
-
-
-def plan_buckets(uniq, n_shards, rows_per_shard, vocab):
-    """Owner-bucketed static layout of a deduped id vector.
-
-    Returns ``(buckets, sorted_owner, rank, order)`` where ``buckets``
-    is ``(n_shards, U)`` int32 — row ``j`` holds the ids owned by shard
-    ``j`` (front-packed, ``vocab`` sentinel pads; the sentinel is
-    out-of-range on every shard, so downstream scatters drop it) — and
-    ``(sorted_owner, rank, order)`` address each original slot's bucket
-    position for the un-permute after the vector return."""
-    U = uniq.shape[0]
-    owner = jnp.clip(uniq // rows_per_shard, 0, n_shards - 1)
-    order = jnp.argsort(owner, stable=True)
-    sorted_ids = uniq[order]
-    sorted_owner = owner[order]
-    start = jnp.searchsorted(sorted_owner, jnp.arange(n_shards))
-    rank = jnp.arange(U) - start[sorted_owner]
-    buckets = jnp.full((n_shards, U), vocab, dtype=uniq.dtype)
-    buckets = buckets.at[sorted_owner, rank].set(sorted_ids, mode="drop")
-    return buckets, sorted_owner, rank, order
 
 
 def gather_rows(table, uniq, mesh, axis):
@@ -113,10 +94,10 @@ def gather_rows(table, uniq, mesh, axis):
         t = jax.lax.axis_index(axis)
         buckets, s_owner, rank, order = plan_buckets(
             ids, n_shards, rows_per, vocab)
-        recv_ids = jax.lax.all_to_all(buckets, axis, 0, 0, tiled=True)
+        recv_ids = exchange(buckets, axis)
         loc = jnp.clip(recv_ids - t * rows_per, 0, tab.shape[0] - 1)
         send_rows = tab[loc]                       # (n_shards, U, D)
-        rows_back = jax.lax.all_to_all(send_rows, axis, 0, 0, tiled=True)
+        rows_back = exchange(send_rows, axis)
         got_sorted = rows_back[s_owner, rank]      # (U, D)
         inv_order = jnp.argsort(order, stable=True)
         return got_sorted[inv_order]

@@ -1,0 +1,82 @@
+"""Shared owner-bucketing + static-shape all-to-all exchange core.
+
+ISSUE 15's sharded embedding lookup and ISSUE 16's MoE token routing
+are ONE communication skeleton with two heads:
+
+  group ids by owner shard  ->  static-capacity buffer  ->  all-to-all
+  ->  local compute on the owner  ->  all-to-all back  ->  un-permute
+
+(for embeddings the "id" is a table row and the owner is
+``row // rows_per_shard``; for MoE the "id" is an expert index and the
+owner is ``expert // experts_per_shard`` — Switch Transformer
+arXiv:2101.03961 / GShard arXiv:2006.16668 dispatch). This module holds
+the pieces both heads share so the bucket math and the exchange
+primitive cannot drift apart:
+
+  * `group_ranks` — the stable-sort + searchsorted rank-within-group
+    kernel. Every static-capacity scatter (bucket slotting, expert
+    capacity assignment) is "rank of this element within its group",
+    and rank order IS the drop priority when capacity truncates.
+  * `plan_buckets` — owner-bucketed ``(n_shards, U)`` layout of a
+    deduped id vector (moved here from shard/embedding.py, which
+    re-exports it unchanged).
+  * `exchange` — the one-line tiled ``all_to_all`` wrapper. Each call
+    is exactly ONE collective in the lowered HLO; the per-step pins in
+    tools/check_fusion.py (`A2A_PER_TABLE`, `A2A_PER_LAYER`) count
+    calls to this function per traced pass.
+
+Everything here is shape-static: buffer capacities come from trace-time
+Python ints, never from data — the captured step re-lowers on shape
+change only, not on index distribution change.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["group_ranks", "plan_buckets", "exchange"]
+
+
+def group_ranks(ids, n_groups):
+    """Stable rank-within-group of an int vector.
+
+    Returns ``(order, sorted_ids, rank_sorted)``: ``order`` stably
+    sorts ``ids`` ascending, ``sorted_ids = ids[order]``, and
+    ``rank_sorted[j]`` is the rank of sorted element ``j`` within its
+    group (0 for the first occurrence of each id value, counting up in
+    original-order priority). Ids must lie in ``[0, n_groups)`` for the
+    ranks to be meaningful; callers clip/sentinel out-of-range ids
+    before or after."""
+    n = ids.shape[0]
+    order = jnp.argsort(ids, stable=True)
+    sorted_ids = ids[order]
+    start = jnp.searchsorted(sorted_ids, jnp.arange(n_groups))
+    rank_sorted = jnp.arange(n) - start[sorted_ids]
+    return order, sorted_ids, rank_sorted
+
+
+def plan_buckets(uniq, n_shards, rows_per_shard, vocab):
+    """Owner-bucketed static layout of a deduped id vector.
+
+    Returns ``(buckets, sorted_owner, rank, order)`` where ``buckets``
+    is ``(n_shards, U)`` int32 — row ``j`` holds the ids owned by shard
+    ``j`` (front-packed, ``vocab`` sentinel pads; the sentinel is
+    out-of-range on every shard, so downstream scatters drop it) — and
+    ``(sorted_owner, rank, order)`` address each original slot's bucket
+    position for the un-permute after the vector return."""
+    U = uniq.shape[0]
+    owner = jnp.clip(uniq // rows_per_shard, 0, n_shards - 1)
+    order, sorted_owner, rank = group_ranks(owner, n_shards)
+    sorted_ids = uniq[order]
+    buckets = jnp.full((n_shards, U), vocab, dtype=uniq.dtype)
+    buckets = buckets.at[sorted_owner, rank].set(sorted_ids, mode="drop")
+    return buckets, sorted_owner, rank, order
+
+
+def exchange(buf, axis):
+    """ONE tiled all-to-all over named mesh ``axis`` inside a
+    `shard_map` body: ``buf`` is ``(n_shards, ...)`` — block ``j`` goes
+    to peer ``j``; the result's block ``i`` is what peer ``i`` sent
+    here. Static shape in == static shape out; this is the single
+    collective the a2a budget pins count."""
+    return jax.lax.all_to_all(buf, axis, 0, 0, tiled=True)

@@ -82,7 +82,10 @@ class ShardPlan:
         self.mesh = mesh
         self.rules = tuple(rules if rules is not None
                            else _rules.DEFAULT_RULES)
-        _rules.validate_rules(self.rules)   # fail fast on bad rule sets
+        # fail fast on bad rule sets; a string axis override naming an
+        # axis this mesh lacks raises HERE (explicit intent — unlike a
+        # PartitionSpec's unknown axis, it never downgrades silently)
+        _rules.validate_rules(self.rules, mesh=mesh)
         axes = mesh.axis_names
         self.data_axis = data_axis if data_axis is not None else axes[0]
         if self.data_axis not in axes:
@@ -117,8 +120,9 @@ class ShardPlan:
         report dict (ISSUE 15: a 10**8-row embedding table a rule typo
         fails to match would silently replicate onto every device and
         OOM at recommender scale — long before anyone reads
-        `plan.report()`). Once per name; threshold via
-        MXTPU_SHARD_WARN_BYTES (0 disables)."""
+        `plan.report()`; ISSUE 16: same story for a ShardedMoE expert
+        bank, whose whole point is E/tp experts per device). Once per
+        name; threshold via MXTPU_SHARD_WARN_BYTES (0 disables)."""
         if any(e is not None for e in tuple(spec)) or name in self._warned:
             return
         from .._env import env_int
@@ -134,14 +138,18 @@ class ShardPlan:
         why = ("no partition rule matched" if name in unmatched
                else "its rule downgraded to replicated "
                     "(non-divisible dim or unknown axis)")
+        kind = ("expert bank"
+                if _rules.re.search(_rules.EXPERT_WEIGHT_PATTERN, name)
+                else "parameter")
         warnings.warn(
-            f"shard plan replicates {name!r} (~{nbytes >> 20} MiB per "
-            f"device): {why}. At this size replication is probably an "
-            f"OOM, not a layout choice — add or fix a rule "
+            f"shard plan replicates {kind} {name!r} (~{nbytes >> 20} "
+            f"MiB per device): {why}. At this size replication is "
+            f"probably an OOM, not a layout choice — add or fix a rule "
             f"(shard.DEFAULT_RULES row-shards '*embed*_weight' over "
-            f"'tp'; see docs/PERFORMANCE.md \"Sharded embeddings\"). "
-            f"Silence with MXTPU_SHARD_WARN_BYTES=0.", RuntimeWarning,
-            stacklevel=4)
+            f"'tp' and routes 'expert*_weight' to 'tp'; see "
+            f"docs/PERFORMANCE.md \"Sharded embeddings\" / \"Expert "
+            f"parallelism\"). Silence with MXTPU_SHARD_WARN_BYTES=0.",
+            RuntimeWarning, stacklevel=4)
 
     def sharding(self, name, shape):
         return NamedSharding(self.mesh, self.spec_for(name, shape))

@@ -39,8 +39,10 @@ from jax.sharding import PartitionSpec as P
 from ..base import MXNetError
 
 __all__ = ["DEFAULT_RULES", "EMBED_WEIGHT_PATTERN",
+           "EXPERT_WEIGHT_PATTERN",
            "match_partition_rules", "validate_rules",
-           "normalize_spec", "spec_to_json", "spec_from_json"]
+           "normalize_spec", "spec_to_json", "spec_from_json",
+           "rules_to_json", "rules_from_json"]
 
 
 # What counts as an embedding table, BY NAME: either "embed" ANYWHERE
@@ -55,12 +57,33 @@ __all__ = ["DEFAULT_RULES", "EMBED_WEIGHT_PATTERN",
 # `embed_param_bytes_frac`).
 EMBED_WEIGHT_PATTERN = r"(?:embed[^/]*|(?:^|_)emb[^/]*)_weight$"
 
+# What counts as an expert bank, BY NAME: `ShardedMoE`'s stacked
+# ``expert_ffn*_weight`` / ``_bias`` parameters (dim 0 is the expert
+# index on every one of them — weights AND biases shard together, so a
+# shard owns its experts whole). Shared by the DEFAULT_RULES expert
+# rule and `ShardPlan._check_large_replicated`'s expert-bank warning.
+EXPERT_WEIGHT_PATTERN = r"(?:^|_)expert[^/]*_(?:weight|bias)$"
+
 
 # First match wins. The attention/ffn rules sit ABOVE the generic
 # ``_weight$`` catch-all; the final (".*", None) makes the replicated
 # fallback explicit (an unmatched name never errors, it replicates and
 # lands in the report).
+#
+# A rule's spec may also be a BARE AXIS NAME string — shorthand for
+# "row-shard dim 0 over that axis" (``P(axis)``), the per-param axis
+# override syntax. Unlike PartitionSpec rules (whose unknown axes
+# downgrade to replicated with a fallback report), a string override
+# is explicit user intent: `ShardPlan` validates it against the mesh
+# and raises on an axis the mesh does not have.
 DEFAULT_RULES = (
+    # expert banks (ShardedMoE): dim 0 is the expert index — shard it
+    # over tp (the axis-override shorthand, dogfooded) so each device
+    # holds E/tp experts; biases included, see EXPERT_WEIGHT_PATTERN.
+    # Sits ABOVE the bias-replicate rule on purpose.
+    (EXPERT_WEIGHT_PATTERN, "tp"),
+    # MoE router: (E, d), tiny, every device gates locally — replicate
+    (r"(?:^|_)gate_weight$", None),
     # norm statistics / affine params + biases: tiny, replicate
     (r"_(gamma|beta|running_mean|running_var|bias|scales)$", None),
     # embedding tables: row-shard the vocab dim over tp. Under a
@@ -80,11 +103,17 @@ DEFAULT_RULES = (
 )
 
 
-def validate_rules(rules):
+def validate_rules(rules, mesh=None):
     """Compile and sanity-check an ordered rule set. Returns a tuple of
     ``(compiled_regex, spec)`` pairs; raises MXNetError on an invalid
-    pattern or a spec that is neither None nor a PartitionSpec (a plain
-    tuple of axis names is accepted and converted)."""
+    pattern or a spec that is none of: None, a PartitionSpec, a plain
+    tuple of axis names (converted), or a bare axis-name STRING — the
+    per-param axis override, shorthand for ``P(axis)`` (row-shard dim 0
+    over that axis). When ``mesh`` is given, every string override is
+    validated against its axis names and an unknown axis raises — an
+    explicit override silently replicating would be the one downgrade
+    the fallback report cannot excuse."""
+    mesh_axes = None if mesh is None else set(mesh.shape)
     out = []
     for i, item in enumerate(rules):
         try:
@@ -96,13 +125,20 @@ def validate_rules(rules):
             rx = re.compile(pattern)
         except re.error as e:
             raise MXNetError(f"rule {i}: bad regex {pattern!r}: {e}")
-        if spec is not None and not isinstance(spec, P):
+        if isinstance(spec, str):
+            if mesh_axes is not None and spec not in mesh_axes:
+                raise MXNetError(
+                    f"rule {i} ({pattern!r}): axis override {spec!r} "
+                    f"names no axis of the mesh "
+                    f"(axes: {sorted(mesh_axes)})")
+            spec = P(spec)
+        elif spec is not None and not isinstance(spec, P):
             if isinstance(spec, (tuple, list)):
                 spec = P(*spec)
             else:
                 raise MXNetError(f"rule {i} ({pattern!r}): spec must be a "
-                                 f"PartitionSpec, tuple, or None, "
-                                 f"got {spec!r}")
+                                 f"PartitionSpec, tuple, axis-name "
+                                 f"string, or None, got {spec!r}")
         out.append((rx, spec))
     return tuple(out)
 
@@ -166,7 +202,7 @@ def match_partition_rules(rules, named_shapes, mesh=None,
     First matching rule wins (`re.search`). A name no rule matches is
     replicated and recorded under ``unmatched`` (``on_unmatched="error"``
     raises instead — the fmengine behaviour)."""
-    compiled = validate_rules(rules)
+    compiled = validate_rules(rules, mesh=mesh)
     specs = {}
     report = {"unmatched": [], "fallbacks": []}
     for name, shp in named_shapes.items():
@@ -212,3 +248,38 @@ def spec_from_json(data):
         else:
             entries.append(entry)
     return P(*entries)
+
+
+def rules_to_json(rules):
+    """An ordered rule set as a JSON-friendly list, round-tripping all
+    three spec forms: ``{"pattern": ..., "axis": name}`` for the
+    string axis-override shorthand, ``{"pattern": ..., "spec": null}``
+    for replicate, ``{"pattern": ..., "spec": [...]}``
+    (`spec_to_json`) for a PartitionSpec."""
+    out = []
+    for pattern, spec in rules:
+        if isinstance(spec, str):
+            out.append({"pattern": pattern, "axis": spec})
+        elif spec is None:
+            out.append({"pattern": pattern, "spec": None})
+        else:
+            if not isinstance(spec, P):
+                spec = P(*spec)
+            out.append({"pattern": pattern, "spec": spec_to_json(spec)})
+    return out
+
+
+def rules_from_json(data):
+    """Inverse of `rules_to_json`. Returns the ``(pattern, spec)``
+    tuple form `validate_rules` accepts (axis overrides stay strings,
+    so a decode -> encode round-trip is byte-identical)."""
+    rules = []
+    for item in (data or []):
+        pattern = item["pattern"]
+        if "axis" in item:
+            rules.append((pattern, item["axis"]))
+        elif item.get("spec") is None:
+            rules.append((pattern, None))
+        else:
+            rules.append((pattern, spec_from_json(item["spec"])))
+    return tuple(rules)

@@ -41,6 +41,12 @@ def test_captured_dispatch_budget_and_parity():
     assert res["embed_sync_h2d_per_step"] == 0
     assert res["embed_param_bytes_frac"] <= 0.5 + 1e-9
     assert res["embed_backward_temp_frac"] < 1.0
+    # ISSUE 16: the expert-parallel MoE captured step (Dense stem +
+    # ShardedMoE on (2,2)) holds the same warm budget and stages its
+    # batches transfer-free through the device prefetcher
+    assert res["moe_mesh"] is True
+    assert res["moe_dispatches_per_step"] <= res["budget"]
+    assert res["moe_sync_h2d_per_step"] == 0
     # ISSUE 6: the serve decode loop is ONE dispatch per warm decode
     # turn, never retraces across varying slot occupancy, and returns
     # every KV page when the traffic drains

@@ -65,6 +65,17 @@ def test_fusion_budgets_hold_and_control_trips():
         semb.A2A_PER_TABLE * 2
     assert res["sharded_embed_a2a_consistent"] is True
     assert res["sharded_embed"]["aliased_inputs"] == 4
+    # ISSUE 16: the expert-parallel MoE step — dispatch + combine cost
+    # EXACTLY A2A_PER_LAYER per traversal, forward and backward (the
+    # banks sit inside the vjp), 2 layers in the fixture; the pin
+    # agrees with the routing constants in-process
+    from mxnet_tpu.shard import moe as smoe
+    assert res["moe"]["collectives"]["all-to-all"] == \
+        check_fusion.BUDGETS["moe_step"]["all_to_all"] == \
+        smoe.A2A_PER_LAYER * smoe.STEP_TRAVERSALS * 2
+    assert res["moe_a2a_consistent"] is True
+    assert res["moe"]["aliased_inputs"] == \
+        check_fusion.BUDGETS["moe_step"]["aliased_inputs"]
     # the gate provably bites: the fusion-pass-disabled control landed
     # below the band and tripped the SAME budget table
     assert res["control_tripped"] is True
@@ -192,5 +203,5 @@ def test_check_fusion_cli_smoke():
     assert callable(check_fusion.main)
     assert set(check_fusion.BUDGETS) == {
         "captured_step", "sharded_step", "sharded_embed_step",
-        "serve_decode", "serve_prefill",
+        "moe_step", "serve_decode", "serve_prefill",
         "serve_verify", "serve_decode_int8", "serve_verify_int8"}

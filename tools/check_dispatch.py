@@ -38,6 +38,13 @@ the bytes of ONE dense (V, D) table gradient — the in-HLO proof that
 the sparse fast path never materialises an O(vocab) cotangent
 (>= 4 devices; skipped below).
 
+ISSUE 16 extension — the warm-step budget also covers the EXPERT-
+PARALLEL MoE captured step: a `ShardedMoE` layer with its expert banks
+row-sharded over 'tp' on the (2,2) mesh (the 2-all-to-all token-routing
+path live, publishing as `moe_step`) must hold the same <=2 dispatch
+budget warm and do zero synchronous H2D with the device prefetcher
+(>= 4 devices; skipped below).
+
 ISSUE 6 extension — the warm-step budget also covers the SERVE decode
 loop: a warm continuous-batching decode turn must be at most ONE device
 dispatch (the shared ragged-paged-attention decode executable), the
@@ -149,6 +156,7 @@ def run(steps=DEFAULT_STEPS, budget=DISPATCH_BUDGET):
     prefetch_res = _run_prefetch_phase(steps, errors)
     shard_res = _run_shard_phase(steps, errors)
     shard_res.update(_run_embed_phase(errors))
+    shard_res.update(_run_moe_phase(errors))
     serve_res = _run_serve_phase(errors)
     serve_res.update(_run_serve_fastpath_phase(errors))
     serve_res.update(_run_serve_int8_phase(errors))
@@ -446,6 +454,97 @@ def _run_embed_phase(errors):
                                    else round(frac, 4)),
         "embed_backward_temp_frac": (None if temp_frac is None
                                      else round(temp_frac, 4)),
+    }
+
+
+def _run_moe_phase(errors):
+    """Expert-parallel MoE budget (ISSUE 16): a warm captured step over
+    a Dense stem + `ShardedMoE` layer — expert banks row-sharded over
+    'tp' on the (2,2) mesh, so the 2-all-to-all token-routing path is
+    live — must stay within the <=2 dispatch budget, do ZERO
+    synchronous H2D with the device prefetcher staging the batches, and
+    must compile as the `moe_step` executable (the routing fast path
+    engaged, not the dense fallback). Needs >= 4 devices; skipped
+    cleanly below that. Tiny shapes (one MoE layer, ~6 steps) to stay
+    inside the tier-1 verify window."""
+    import jax
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, nd, profiler
+    from mxnet_tpu.observability import registry
+    from mxnet_tpu.prefetch import DevicePrefetcher
+
+    if len(jax.devices()) < 4:
+        return {"moe_mesh": False, "moe_dispatches_per_step": None,
+                "moe_sync_h2d_per_step": None}
+
+    B, D = 8, 16
+    rng = np.random.RandomState(5)
+    Xh = rng.randn(B, D).astype(np.float32)
+    yh = rng.randn(B, D).astype(np.float32)
+
+    class _MoENet(gluon.nn.HybridBlock):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            with self.name_scope():
+                self.proj = gluon.nn.Dense(D, in_units=D)
+                self.moe = gluon.nn.ShardedMoE(
+                    D, 16, num_experts=4, k=2, capacity_factor=1.25)
+
+        def hybrid_forward(self, F_, x):
+            return self.moe(self.proj(x))
+
+    mx.random.seed(0)
+    net = _MoENet()
+    net.initialize(mx.init.Xavier())
+    net(nd.array(Xh))
+    lossf = gluon.loss.L2Loss()
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.1}, kvstore="ici")
+    tr.shard(mesh={"dp": 2, "tp": 2})
+
+    step = tr.capture(lambda a, b: lossf(net(a), b).mean())
+    step(nd.array(Xh), nd.array(yh))
+    if step.last_fallback_reason is not None:
+        errors.append(f"moe step fell back on compile: "
+                      f"{step.last_fallback_reason}")
+
+    sync = registry().counter("prefetch_h2d_sync")
+    worst = 0
+    worst_sync = 0
+    pf = DevicePrefetcher(((Xh, yh) for _ in range(4)),
+                          capture_spec=tr._kvstore)
+    try:
+        for xb, yb in pf:
+            base = sync.value
+            profiler.reset_dispatches()
+            step(xb, yb)
+            worst = max(worst, profiler.dispatch_count())
+            worst_sync = max(worst_sync, sync.value - base)
+            if step.last_fallback_reason is not None:
+                errors.append(f"moe step fell back: "
+                              f"{step.last_fallback_reason}")
+    finally:
+        pf.close()
+    if worst > DISPATCH_BUDGET:
+        errors.append(f"moe dispatch budget exceeded: {worst}/step "
+                      f"(budget {DISPATCH_BUDGET})")
+    if worst_sync:
+        errors.append(f"device-prefetched MoE batches performed "
+                      f"{worst_sync} synchronous H2D transfer(s) "
+                      f"(budget 0)")
+
+    from mxnet_tpu.observability import compilex
+    if compilex.instrumented().get("moe_step") is None:
+        errors.append("moe_step never registered with the compile "
+                      "observatory — the expert-parallel routing path "
+                      "did not engage")
+
+    return {
+        "moe_mesh": True,
+        "moe_dispatches_per_step": worst,
+        "moe_sync_h2d_per_step": worst_sync,
     }
 
 
@@ -787,7 +886,9 @@ def main(argv=None):
                  f"dispatch/step at {res['embed_param_bytes_frac']}x "
                  f"embed bytes/dev, backward temp "
                  f"{res['embed_backward_temp_frac']}x of one dense "
-                 f"table grad")
+                 f"table grad; moe {res['moe_dispatches_per_step']} "
+                 f"dispatch/step, {res['moe_sync_h2d_per_step']} sync "
+                 f"H2D")
     print(f"check_dispatch: OK ({res['captured_dispatches_per_step']} "
           f"dispatch/step captured vs "
           f"{res['imperative_dispatches_per_step']} imperative; "

@@ -26,6 +26,11 @@ optimized-HLO counts:
     table (bucketed index exchange + vector return), cross-checked
     in-process against shard/embedding.py's A2A_PER_TABLE, with every
     donated table/tower buffer aliased;
+  * the expert-parallel MoE captured step (ISSUE 16; >= 4 devices): the
+    token-routing all-to-all count pinned EXACTLY at 2 per layer per
+    traversal x 2 traversals (forward + vjp), cross-checked in-process
+    against shard/moe.py's A2A_PER_LAYER * STEP_TRAVERSALS, with every
+    donated expert-bank buffer aliased;
   * serve decode + prefill executables: fusion bands, zero collectives,
     and the donated KV-page pools / encoder-memory buffers aliased;
   * a deliberately DE-FUSED control: a subprocess compiles the same
@@ -137,6 +142,29 @@ BUDGETS = {
         "copies": (0, 68),
         "aliased_inputs": 4,
     },
+    # ISSUE 16: the expert-parallel MoE captured step (a Dense stem +
+    # two ShardedMoE layers on the (2,2) DEFAULT_RULES mesh). The
+    # headline pin is again `all_to_all`: each MoE layer costs EXACTLY
+    # 2 all-to-alls per traversal (token dispatch + expert-output
+    # return; shard/moe.py A2A_PER_LAYER == 2) and the training step
+    # traverses twice (forward + the vjp, whose transposes are
+    # themselves all-to-alls; STEP_TRAVERSALS == 2), so the fixture's
+    # two layers must cost exactly 2*2*2 = 8 — run() cross-checks the
+    # pin against A2A_PER_LAYER * STEP_TRAVERSALS * n_layers so the
+    # budget and the routing math cannot drift apart silently. The
+    # Dense stem is load-bearing: without a layer below the first MoE
+    # its input cotangent is dead and XLA deletes one backward a2a —
+    # pin 8, not 7, because real stacks always have live dx. Measured
+    # 168 fusions / 94 copies on the pinned toolchain. All 12
+    # differentiable params (stem W/b + per-layer gate + 4 expert
+    # banks, plain SGD) must alias — expert-bank donation is the
+    # mesh-residency story.
+    "moe_step": {
+        "fusions": (85, 250),
+        "all_to_all": 8,
+        "copies": (0, 188),
+        "aliased_inputs": 12,
+    },
 }
 
 CONTROL_TIMEOUT_S = 240
@@ -168,8 +196,9 @@ def check_budget(name, info, budget=None):
         errors.append(
             f"{name}: {info['collectives'].get('all-to-all', 0)} "
             f"all-to-all(s) (expected exactly {budget['all_to_all']} — "
-            f"the bucketed-exchange math says 2 per sharded table: one "
-            f"index exchange + one vector return)")
+            f"the exchange math pins 2 per sharded table per lookup "
+            f"and 2 per MoE layer per traversal: one dispatch + one "
+            f"return)")
     if "copies" in budget:
         lo, hi = budget["copies"]
         if not lo <= info["copies"] <= hi:
@@ -292,6 +321,52 @@ def sharded_embed_step_info(steps=2):
     step = tr.capture(lambda a, b, c, d: lossf(net(a, b, c), d).mean())
     for _ in range(steps):
         step(I1, I2, Xd, yh)
+    return step.hlo_info(), step, 2
+
+
+def moe_step_info(steps=2):
+    """Build a Dense stem + two `ShardedMoE` layers, capture the
+    training step under the (2,2) DEFAULT_RULES plan — the expert
+    banks row-shard over 'tp', so the 2-a2a-per-layer expert-parallel
+    path is live and the step publishes as `moe_step` — run `steps`
+    steps and return (hlo_info, step, n_moe_layers). The Dense stem
+    keeps the first MoE layer's input cotangent live (see the BUDGETS
+    comment). Needs >= 4 devices; callers skip below that.
+    check_static.py reuses this fixture."""
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, nd
+
+    rng = np.random.RandomState(0)
+    B, D = 8, 16
+    X = nd.array(rng.randn(B, D).astype(np.float32))
+    y = nd.array(rng.randn(B, D).astype(np.float32))
+
+    class _MoENet(gluon.nn.HybridBlock):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            with self.name_scope():
+                self.proj = gluon.nn.Dense(D, in_units=D)
+                self.moe_a = gluon.nn.ShardedMoE(
+                    D, 16, num_experts=4, k=2, capacity_factor=1.25)
+                self.moe_b = gluon.nn.ShardedMoE(
+                    D, 16, num_experts=4, k=2, capacity_factor=1.25)
+
+        def hybrid_forward(self, F_, x):
+            return self.moe_b(self.moe_a(self.proj(x)))
+
+    mx.random.seed(0)
+    net = _MoENet()
+    net.initialize(mx.init.Xavier())
+    net(X)
+    lossf = gluon.loss.L2Loss()
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.1}, kvstore="ici")
+    tr.shard(mesh={"dp": 2, "tp": 2})
+    step = tr.capture(lambda a, b: lossf(net(a), b).mean())
+    for _ in range(steps):
+        step(X, y)
     return step.hlo_info(), step, 2
 
 
@@ -472,6 +547,31 @@ def _run_impl():
         emb_a2a_consistent = \
             BUDGETS["sharded_embed_step"]["all_to_all"] == expect_a2a
 
+    # -- expert-parallel MoE step (ISSUE 16; >= 4 devices, same skip) --
+    moe_info = None
+    moe_a2a_consistent = None
+    if shard_mesh:
+        moe_info, moe_step, n_moe_layers = moe_step_info()
+        errors += check_budget("moe_step", moe_info)
+        if moe_step.last_fallback_reason is not None:
+            errors.append(f"moe step fell back: "
+                          f"{moe_step.last_fallback_reason}")
+        # cross-check the pinned all-to-all count against the routing
+        # math: 2 per layer per traversal (dispatch + return), 2
+        # traversals per training step (forward + vjp transposes)
+        from mxnet_tpu.shard import moe as _smoe
+        expect_moe = (_smoe.A2A_PER_LAYER * _smoe.STEP_TRAVERSALS
+                      * n_moe_layers)
+        if BUDGETS["moe_step"]["all_to_all"] != expect_moe:
+            errors.append(
+                f"moe_step: pinned all_to_all budget "
+                f"{BUDGETS['moe_step']['all_to_all']} disagrees with "
+                f"the routing math A2A_PER_LAYER * STEP_TRAVERSALS * "
+                f"n_moe_layers = {expect_moe} — fix the budget or the "
+                f"routing, not one of them")
+        moe_a2a_consistent = \
+            BUDGETS["moe_step"]["all_to_all"] == expect_moe
+
     # -- serve decode / prefill ----------------------------------------
     dec_info, pre_info, dec_traces = _serve_infos()
     errors += check_budget("serve_decode", dec_info)
@@ -520,6 +620,8 @@ def _run_impl():
         "sharded_kinds_consistent": kinds_ok,
         "sharded_embed": _strip(emb_info),
         "sharded_embed_a2a_consistent": emb_a2a_consistent,
+        "moe": _strip(moe_info),
+        "moe_a2a_consistent": moe_a2a_consistent,
         "serve_decode": _strip(dec_info),
         "serve_prefill": _strip(pre_info),
         "serve_decode_traces": dec_traces,
@@ -562,7 +664,11 @@ def main(argv=None):
                       f"{res['sharded']['collectives']}; embed step "
                       f"{res['sharded_embed']['collectives'].get('all-to-all', 0)} "
                       f"all-to-alls / "
-                      f"{res['sharded_embed']['aliased_inputs']} aliased")
+                      f"{res['sharded_embed']['aliased_inputs']} aliased; "
+                      f"moe step "
+                      f"{res['moe']['collectives'].get('all-to-all', 0)} "
+                      f"all-to-alls / "
+                      f"{res['moe']['aliased_inputs']} aliased")
     print(f"check_fusion: OK (captured {res['captured']['fusions']} "
           f"fusions / {res['captured']['collective_total']} collectives "
           f"/ {res['captured']['aliased_inputs']} aliased; {shard_txt}; "

@@ -69,6 +69,11 @@ BUDGETS = {
     # bucketed all-to-all exchange) — allowance = check_fusion's copy-
     # band hi, one reviewed number in both tables
     "sharded_embed_step": {"copies_allow": 68},
+    # ISSUE 16 expert-parallel MoE captured step: measured 94 copies on
+    # the pinned toolchain (GSPMD resharding around the 8 routing
+    # all-to-alls plus the capacity-buffer scatters) — allowance =
+    # check_fusion's copy-band hi, one reviewed number in both tables
+    "moe_step": {"copies_allow": 188},
     "fused_update": {"copies_allow": 4},
     "autograd_backward": {"copies_allow": 8},
 }
@@ -226,6 +231,8 @@ def warm_executables():
         # so its copy allowance guards a program the gate actually saw,
         # not only when a co-resident gate test leaves one alive
         keep.append(check_fusion.sharded_embed_step_info(steps=1))
+        # expert-parallel MoE step (ISSUE 16): same determinism story
+        keep.append(check_fusion.moe_step_info(steps=1))
     # serve: one plain server (prefill + decode) and one speculative
     # (verify); both tiny — the executables, not the workload, matter
     from mxnet_tpu.models.transformer import TransformerNMT
