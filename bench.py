@@ -393,6 +393,24 @@ def main():
                 result["rec_vs_replicated"] = rres["rec_vs_replicated"]
         except Exception as e:  # pragma: no cover
             print(f"[bench] rec bench failed: {e!r}", file=sys.stderr)
+        # ISSUE 18: the elastic grow-back episode — shrink/regrow
+        # resharding latency plus the fleet counters of a supervised
+        # shrink -> regrow round trip. Same honesty contract: fields
+        # OMITTED below 4 devices (bench_mlp reports value None), never
+        # faked; fleet_restarts is 0 in-process by construction (only
+        # the launcher's respawn path increments it). BENCH_FLEET=0
+        # disables; own guard so a fleet failure can't take down the
+        # shard fields above.
+        if os.environ.get("BENCH_FLEET") != "0":
+            try:
+                flres = bench_mlp.measure_fleet()
+                if flres.get("value") is not None:
+                    result["fleet_regrow_ms"] = flres["value"]
+                    result["fleet_regrows"] = flres["fleet_regrows"]
+                    result["fleet_restarts"] = flres["fleet_restarts"]
+            except Exception as e:  # pragma: no cover
+                print(f"[bench] fleet bench failed: {e!r}",
+                      file=sys.stderr)
         # ISSUE 16: expert parallelism — sharded-MoE steps/s vs the
         # equal-parameter dense FFN, with the capacity-overflow drop
         # fraction the run suffered. Same honesty contract: fields

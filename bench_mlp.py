@@ -509,6 +509,104 @@ def measure_shard(on_result=None, axes="dp,tp"):
     return res
 
 
+def measure_fleet(on_result=None):
+    """The elastic grow-back episode (ISSUE 18): the wall-clock cost of
+    a shrink -> grow-back resharding round trip on the bench MLP's
+    (2,2) mesh — the headline is the GROW direction (device returns,
+    supervisor reverses the shrink through collective redistribution) —
+    plus the fleet counters a supervised shrink/regrow episode produces
+    (``fleet_regrows``; ``fleet_restarts`` stays 0 in-process — the
+    launcher increments it, and a faked value here would lie). Needs
+    >= 4 devices; reports ``value: None`` below that so the supervisor
+    contract fields stay honest on a 1-chip run."""
+    import tempfile
+
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    if len(jax.devices()) < 4:
+        res = {"metric": "fleet_regrow_ms", "value": None,
+               "unit": "ms", "skipped": "needs >= 4 devices"}
+        print("[bench_mlp] fleet: skipped (needs >= 4 devices)",
+              file=sys.stderr)
+        if on_result is not None:
+            on_result(res)
+        return res
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import fault, gluon
+    from mxnet_tpu.observability import registry
+
+    batch, steps, X, y, lossf, build = _setup()
+    rules = ((r"_bias$", None),
+             (r"dense2_weight$", P("tp", None)),
+             (r"_weight$", P("dp", None)),
+             (r".*", None))
+    mx.random.seed(0)
+    net = build()
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.05, "momentum": 0.9},
+                       kvstore="ici")
+    plan = tr.shard(mesh={"dp": 2, "tp": 2}, rules=rules)
+    step = tr.capture(lambda a, b: lossf(net(a), b).mean())
+    for _ in range(2):
+        step(X, y)                          # compile + warm
+
+    # timed resize round trips; best-of so a one-off GC pause doesn't
+    # become the number. The second lap regrows onto the ORIGINAL plan
+    # fingerprint, so it also exercises the executable-cache reuse path.
+    shrink_ms, grow_ms = [], []
+    for _ in range(3):
+        t0 = time.monotonic()
+        tr.resize_mesh({"dp": 1, "tp": 2})
+        shrink_ms.append((time.monotonic() - t0) * 1e3)
+        t0 = time.monotonic()
+        tr.resize_mesh({"dp": 2, "tp": 2})
+        grow_ms.append((time.monotonic() - t0) * 1e3)
+        step(X, y)
+
+    # one supervised shrink -> regrow episode for the counters
+    regrows0 = registry().counter("fault_regrows").value
+    restarts0 = registry().counter("fleet_restarts").value
+    ids = [d.id for d in tr.shard_plan.mesh.devices.flatten()]
+    data = [(X, y)] * 4
+    count = {"n": 0}
+
+    def sup_step(b):
+        count["n"] += 1
+        if count["n"] >= 4 and fault.lost_devices():
+            fault.clear("device.lost")
+        return step(b[0], b[1])
+
+    with tempfile.TemporaryDirectory(prefix="bench_fleet_") as ck:
+        try:
+            fault.inject("device.lost", at=[2], device=ids[-1])
+            rep, _sup = fault.run_supervised(
+                tr, sup_step, lambda: iter(data), 10,
+                checkpoint_dir=ck, checkpoint_every=4,
+                backoff_base=0.0, emergency_save=False,
+                regrow_cooldown=1, regrow_hysteresis=1)
+        finally:
+            fault.clear()
+    res = {
+        "metric": "fleet_regrow_ms",
+        "value": round(min(grow_ms), 2),
+        "unit": "ms",
+        "shrink_ms": round(min(shrink_ms), 2),
+        "fleet_regrows": int(registry().counter("fault_regrows").value
+                             - regrows0),
+        "fleet_restarts": int(registry().counter("fleet_restarts").value
+                              - restarts0),
+        "supervised_outcome": rep["outcome"],
+    }
+    print(f"[bench_mlp] fleet: regrow {res['value']:.2f} ms / shrink "
+          f"{res['shrink_ms']:.2f} ms; supervised episode regrows="
+          f"{res['fleet_regrows']} ({rep['outcome']})", file=sys.stderr)
+    if on_result is not None:
+        on_result(res)
+    return res
+
+
 def main():
     args = sys.argv[1:]
     # --prefetch wants >= 2 devices so the mesh placement path is what's
@@ -520,8 +618,9 @@ def main():
             os.environ.get("XLA_FLAGS", ""):
         os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
                                    " --xla_force_host_platform_device_count=2")
-    # --shard wants >= 4 (a (2,2) mesh) — same dance
-    if "--shard" in args and "jax" not in sys.modules \
+    # --shard / --fleet want >= 4 (a (2,2) mesh) — same dance
+    if ("--shard" in args or "--fleet" in args) \
+            and "jax" not in sys.modules \
             and os.environ.get("JAX_PLATFORMS", "") == "cpu" \
             and "xla_force_host_platform_device_count" not in \
             os.environ.get("XLA_FLAGS", ""):
@@ -544,6 +643,9 @@ def main():
         axes = (args[i + 1] if len(args) > i + 1
                 and not args[i + 1].startswith("-") else "dp,tp")
         print(json.dumps(measure_shard(axes=axes)))
+        return
+    if "--fleet" in args:
+        print(json.dumps(measure_fleet()))
         return
     if "--trace" in args:
         i = args.index("--trace")

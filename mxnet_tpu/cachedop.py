@@ -87,6 +87,20 @@ _KEY_FIELDS = ("shape_change", "param_change", "state_change", "scale_mode",
                "clip", "plan", "sparse")
 
 
+def _mesh_fingerprint(mesh):
+    """Structural identity of a mesh for executable cache keys: axis
+    names, axis sizes, and the exact device ids in mesh order. Two
+    meshes with the same fingerprint produce equal NamedShardings, so a
+    step compiled over one runs over the other — which is what lets an
+    elastic shrink → grow-back round trip (fault/supervisor.py) reuse
+    the pre-shrink executables instead of recompiling (an `id(mesh)`
+    key — the pre-PR-18 scheme — could not, since resize always builds
+    a fresh Mesh object)."""
+    return (tuple(mesh.axis_names),
+            tuple(int(mesh.shape[a]) for a in mesh.axis_names),
+            tuple(int(d.id) for d in mesh.devices.flatten()))
+
+
 def _miss(reason):
     c = _miss_counters.get(reason)
     if c is None:
@@ -444,7 +458,8 @@ class CachedStep:
             scale_mode,
             multi_tensor._hyper_sig(opt),
             str(amp.autocast_dtype()),
-            None if spec is None else (id(spec[0]), spec[1], spec[2]),
+            None if spec is None else (_mesh_fingerprint(spec[0]),
+                                       spec[1], spec[2]),
             self._sharded,
             self._grad_reduce,
             None if opt.clip_gradient is None else float(opt.clip_gradient),

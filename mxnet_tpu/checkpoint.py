@@ -831,6 +831,23 @@ class CheckpointManager:
         one loads. Returns (step, params) or (None, None)."""
         return self._restore_scan(template, validate=validate)
 
+    def restore_step(self, step, template, validate=True):
+        """Restore one SPECIFIC step — no fallback scan. The fleet
+        rollback path (fault/fleet.py) uses this: after the survivors
+        agree on a common step, every member must restore exactly that
+        step, not its own newest. Raises on a missing or (with
+        `validate`) torn checkpoint instead of silently substituting a
+        different one."""
+        path = _step_path(self.directory, int(step))
+        if validate:
+            errors = validate_checkpoint(path)
+            if errors:
+                raise MXNetError(
+                    f"checkpoint step {step} failed validation: "
+                    f"{errors}")
+        return load_sharded(self.directory, int(step), template,
+                            validate=False)
+
     def restore_latest_healthy(self, template, validate=True,
                                strict=False):
         """Restore the newest step that is both VALID (manifest-checked)

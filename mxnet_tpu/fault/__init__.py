@@ -25,11 +25,17 @@ composes them into a closed detect → diagnose → recover loop:
     poll `check_preempted()` and catch `Preempted`.
   * `supervisor` — the crash-only recovery loop (`run_supervised`):
     classifies every failure into a domain (transient / corrupt-state /
-    hang / capacity-loss / preemption) and applies the matching policy —
-    retry, rollback + deterministic replay, post-mortem + in-process
-    restart, mesh shrink to survivors, or emergency-save + resumable
+    hang / capacity-loss / preemption / capacity-gain / host-lost) and
+    applies the matching policy — retry, rollback + deterministic
+    replay, post-mortem + in-process restart, mesh shrink to survivors
+    (and grow-back when they return), or emergency-save + resumable
     exit — under a bounded restart budget (docs/RELIABILITY.md
     "Recovery playbook"; tier-1 gate: tools/check_resilience.py).
+  * `fleet` — cross-host supervision over the kvstore control plane
+    (`FleetMember`, `FleetSupervisor`, `run_fleet`): heartbeats with
+    deadlines, lowest-live-rank leader election, and rollback-step
+    agreement so a multi-host job survives a SIGKILL'd worker
+    (docs/RELIABILITY.md "Fleet recovery").
 
 Recoveries are visible as metrics: ``fault_injected{point=}``,
 ``fault_retries{site=}``, ``watchdog_timeouts``, plus the subsystem
@@ -43,11 +49,13 @@ from . import retry
 from . import watchdog
 from . import preemption
 from . import supervisor
+from . import fleet
 
-from .injection import (FaultInjected, DeviceLost, inject, clear,
+from .injection import (FaultInjected, DeviceLost, HostLost, inject, clear,
                         configure, active, should_fire, check, hits,
                         fires, points, check_device_loss, lost_devices,
-                        reset_lost_devices)
+                        reset_lost_devices, check_host_loss, lost_hosts,
+                        reset_lost_hosts)
 from .retry import RetryPolicy, retry_call, policy_from_env
 from .watchdog import StepWatchdog, WatchdogTimeout
 from .preemption import (Preempted, install_preemption_handler,
@@ -56,13 +64,15 @@ from .preemption import (Preempted, install_preemption_handler,
 from .supervisor import (TrainingSupervisor, run_supervised,
                          RecoveryExhausted, NonFiniteLoss, DivergedLoss,
                          classify_failure, DOMAINS)
+from .fleet import FleetMember, FleetSupervisor, run_fleet
 
 __all__ = [
-    "injection", "retry", "watchdog", "preemption", "supervisor",
+    "injection", "retry", "watchdog", "preemption", "supervisor", "fleet",
     # injection
-    "FaultInjected", "DeviceLost", "inject", "clear", "configure",
-    "active", "should_fire", "check", "hits", "fires", "points",
-    "check_device_loss", "lost_devices", "reset_lost_devices",
+    "FaultInjected", "DeviceLost", "HostLost", "inject", "clear",
+    "configure", "active", "should_fire", "check", "hits", "fires",
+    "points", "check_device_loss", "lost_devices", "reset_lost_devices",
+    "check_host_loss", "lost_hosts", "reset_lost_hosts",
     # retry
     "RetryPolicy", "retry_call", "policy_from_env",
     # watchdog
@@ -74,4 +84,6 @@ __all__ = [
     # supervisor
     "TrainingSupervisor", "run_supervised", "RecoveryExhausted",
     "NonFiniteLoss", "DivergedLoss", "classify_failure", "DOMAINS",
+    # fleet
+    "FleetMember", "FleetSupervisor", "run_fleet",
 ]

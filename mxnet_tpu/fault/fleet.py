@@ -1,0 +1,536 @@
+"""Elastic fleet supervision: cross-host recovery over the kvstore
+control plane.
+
+PR 10's `TrainingSupervisor` is a single-controller state machine — it
+can retry, roll back, shrink and (now) regrow ONE process. On a
+multi-controller pod that is not enough: when a whole HOST dies
+(SIGKILL, kernel panic, preemption without grace), its peers wedge in
+the next collective and the `CollectiveTimeout` scope note used to end
+with "process-level restart is out of scope". This module closes that
+gap with a small, crash-only coordination layer over
+`kvstore.control_plane()` (memory / shared-file / jax-coordination
+backends, one duck-typed surface):
+
+  * **Heartbeats** — every `FleetMember` stamps ``hb/<rank>`` with its
+    wall-clock time; a member whose stamp is older than
+    ``MXTPU_FLEET_DEADLINE_MS`` (or whose rank the ``host.lost`` fault
+    point masked) is dead to the fleet. Wall clock, not monotonic:
+    stamps must compare across processes.
+  * **Leader election** — no Paxos: the leader IS the lowest live rank.
+    Deterministic, agreement-free, and re-election after a leader loss
+    is just the next liveness read. Observed transitions count into
+    ``fleet_elections``.
+  * **Rollback agreement** — on a host loss every survivor bumps the
+    fleet ``epoch``, proposes its newest locally-restorable step under
+    ``rollback/<epoch>/<rank>``, and the leader publishes
+    ``agreed/<epoch>`` = min over the proposals it collected before the
+    deadline (a straggler that posts late simply finds the agreement
+    already published). min() is the only safe pick: it is the newest
+    step EVERY proposer can restore.
+
+`FleetSupervisor` extends `TrainingSupervisor` with a per-step fleet
+probe (beat, watch peers, fire the ``host.lost`` chaos point) and a
+``host_lost`` recovery policy that runs the agreement, optionally
+re-bootstraps the distributed runtime (`kvstore.reset_distributed` +
+`init_distributed`, gated by ``MXTPU_FLEET_REBOOTSTRAP`` — collectives
+cannot re-form around a re-spawned peer without it), and restores the
+agreed step exactly. The process-level half lives in tools/launch.py
+(``--max-restarts`` respawns a SIGKILL'd worker with
+``MXTPU_RESTART_COUNT`` incremented); a respawned member finds the
+current epoch's agreement on the control plane and resumes from it.
+
+Observability: ``fleet_heartbeats``, ``fleet_heartbeat_failures``,
+``fleet_elections``, ``fleet_rollback_agreements``, ``fleet_restarts``
+(counted once by a member whose ``MXTPU_RESTART_COUNT`` says it is a
+respawn), plus the supervisor's ``fault_recoveries{domain=host_lost}``.
+Knobs and semantics: docs/RELIABILITY.md "Fleet recovery".
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+from ..base import MXNetError
+from ..observability import registry as _obs_registry
+from ..observability import tracer as _tracer
+from .. import _env
+from . import injection as _finj
+from .injection import FaultInjected, HostLost
+from .supervisor import TrainingSupervisor
+
+__all__ = ["FleetMember", "FleetSupervisor", "run_fleet"]
+
+_reg = _obs_registry()
+_hb_counter = _reg.counter("fleet_heartbeats")
+_hb_fail_counter = _reg.counter("fleet_heartbeat_failures")
+_election_counter = _reg.counter("fleet_elections")
+_agreement_counter = _reg.counter("fleet_rollback_agreements")
+_restart_counter = _reg.counter("fleet_restarts")
+
+
+def _log():
+    from ..log import get_logger
+    return get_logger("mxnet_tpu.fault")
+
+
+class FleetMember:
+    """One host's handle on the fleet control plane.
+
+    rank/world: this worker's identity (the launcher's
+    MXTPU_WORKER_ID / MXTPU_NUM_WORKERS); control: a
+    `kvstore.ControlPlane` (default: `kvstore.control_plane()` — a
+    shared MXTPU_FLEET_DIR selects the file backend);
+    heartbeat_ms/deadline_ms: stamp cadence and staleness bound
+    (defaults MXTPU_FLEET_HEARTBEAT_MS=500 /
+    MXTPU_FLEET_DEADLINE_MS=2500 — the deadline should cover several
+    missed beats so one slow filesystem write is not a death);
+    clock/sleep: injectable for deterministic tests (clock is WALL time
+    — `time.time` — because stamps compare across processes)."""
+
+    def __init__(self, rank, world, control=None, *, heartbeat_ms=None,
+                 deadline_ms=None, clock=time.time, sleep=time.sleep):
+        from .. import kvstore as _kv
+        self.rank = int(rank)
+        self.world = int(world)
+        if not 0 <= self.rank < self.world:
+            raise MXNetError(f"fleet rank {rank} outside world of "
+                             f"{world}")
+        self.control = control if control is not None \
+            else _kv.control_plane()
+        self.heartbeat_ms = float(heartbeat_ms) if heartbeat_ms is not None \
+            else _env.env_ms("MXTPU_FLEET_HEARTBEAT_MS", 500.0)
+        self.deadline_ms = float(deadline_ms) if deadline_ms is not None \
+            else _env.env_ms("MXTPU_FLEET_DEADLINE_MS", 2500.0)
+        self._clock = clock
+        self._sleep = sleep
+        self._last_leader = None
+        self._seen = set()            # ranks observed alive at least once
+        self._stop = threading.Event()
+        self._thread = None
+        self.incarnation = _env.env_int("MXTPU_RESTART_COUNT", 0,
+                                        minimum=0)
+        if self.incarnation:
+            # this process IS a fleet restart (the launcher respawned a
+            # SIGKILL'd worker): count it once, at the member that knows
+            _restart_counter.inc()
+
+    # ------------------------------------------------------ heartbeats
+    def beat(self):
+        """Stamp this rank's heartbeat key. The ``kv.heartbeat`` fault
+        point (rank-keyed) simulates a lost/failed stamp: any firing —
+        raise or stall — counts into ``fleet_heartbeat_failures`` and
+        the stamp is skipped, so peers see this member age toward the
+        deadline. Returns True when the stamp was written."""
+        try:
+            if _finj.ENABLED and _finj.check(
+                    "kv.heartbeat", context=f"rank {self.rank}",
+                    rank=self.rank):
+                _hb_fail_counter.inc()
+                return False
+        except FaultInjected:
+            _hb_fail_counter.inc()
+            return False
+        try:
+            self.control.put(f"hb/{self.rank}", json.dumps(
+                {"t": self._clock(), "pid": os.getpid(),
+                 "incarnation": self.incarnation}))
+        except (OSError, MXNetError) as e:
+            # a failed stamp is survivable by design — peers notice the
+            # stale key; crashing the member here would turn one slow
+            # filesystem write into a host loss
+            _hb_fail_counter.inc()
+            _log().warning("fleet: rank %d heartbeat write failed (%r)",
+                           self.rank, e)
+            return False
+        _hb_counter.inc()
+        return True
+
+    def start(self):
+        """Start the background heartbeat thread (daemon, one stamp per
+        `heartbeat_ms`). Idempotent. The fleet supervisor also beats
+        inline once per applied step — the thread covers long steps and
+        the gaps around restore/reshard."""
+        if self._thread is not None:
+            return self
+        try:
+            # a respawned incarnation retracts any previous farewell
+            self.control.delete(f"bye/{self.rank}")
+        except (OSError, MXNetError):
+            pass
+        self._stop.clear()
+        self.beat()
+
+        def loop():
+            while not self._stop.wait(self.heartbeat_ms / 1000.0):
+                self.beat()
+
+        self._thread = threading.Thread(
+            target=loop, daemon=True, name=f"mxtpu-fleet-hb-{self.rank}")
+        self._thread.start()
+        return self
+
+    def stop(self):
+        """Stop the heartbeat thread and post a farewell (``bye/<rank>``)
+        so peers classify this exit as DEPARTED, not dead: without the
+        goodbye a member that finishes its run is indistinguishable from
+        a SIGKILL'd one, and its peers would burn a rollback on it."""
+        self._stop.set()
+        th, self._thread = self._thread, None
+        if th is not None:
+            th.join(timeout=2 * self.heartbeat_ms / 1000.0)
+        try:
+            self.control.put(f"bye/{self.rank}", "1")
+        except (OSError, MXNetError):
+            pass        # peers fall back to heartbeat aging
+
+    def departed(self):
+        """Ranks that said goodbye (clean exits)."""
+        out = set()
+        for k in self.control.keys("bye/"):
+            try:
+                out.add(int(k[len("bye/"):]))
+            except ValueError:
+                continue
+        return out
+
+    def last_beat(self, rank):
+        """The decoded heartbeat record for `rank` ({"t", "pid",
+        "incarnation"}) or None (never stamped / torn JSON)."""
+        raw = self.control.get(f"hb/{int(rank)}")
+        if raw is None:
+            return None
+        try:
+            rec = json.loads(raw)
+        except ValueError:
+            return None
+        return rec if isinstance(rec, dict) else None
+
+    # -------------------------------------------------- liveness/leader
+    def live_ranks(self, now=None):
+        """Ranks with a heartbeat younger than `deadline_ms` and not
+        masked by a fired ``host.lost`` fault point (sorted). Also
+        feeds `_seen`: dead-peer detection distinguishes "expired" from
+        "never joined"."""
+        now = self._clock() if now is None else now
+        masked = set(_finj.lost_hosts())
+        out = []
+        for r in range(self.world):
+            rec = self.last_beat(r)
+            if rec is None:
+                continue
+            self._seen.add(r)
+            if r in masked:
+                continue
+            age_ms = (now - float(rec.get("t", 0.0))) * 1000.0
+            if age_ms <= self.deadline_ms:
+                out.append(r)
+        return out
+
+    def dead_peers(self, now=None):
+        """Peers (not self) that JOINED the fleet and are now dead:
+        heartbeat older than the deadline, or rank masked by
+        ``host.lost``. A rank never seen is absent, not dead — a fleet
+        starting up must not declare unjoined peers lost — and a rank
+        that posted ``bye/<rank>`` departed cleanly, which is not a
+        death either."""
+        now = self._clock() if now is None else now
+        live = set(self.live_ranks(now))
+        gone = self.departed()
+        return sorted(r for r in self._seen
+                      if r != self.rank and r not in live
+                      and r not in gone)
+
+    def leader(self, now=None):
+        """The lowest live rank (None: nobody live — not even self).
+        Leadership needs no agreement round: every member computes the
+        same min over the same heartbeat keys. Observed transitions
+        count into ``fleet_elections``."""
+        live = self.live_ranks(now)
+        lead = min(live) if live else None
+        if lead is not None and lead != self._last_leader:
+            _election_counter.inc()
+            if self._last_leader is not None:
+                _log().warning("fleet: rank %d observed leadership move "
+                               "%d -> %d", self.rank, self._last_leader,
+                               lead)
+            self._last_leader = lead
+        return lead
+
+    def is_leader(self, now=None):
+        return self.leader(now) == self.rank
+
+    # ------------------------------------------------------- epochs
+    def epoch(self):
+        """The fleet epoch: bumped by whichever survivor first detects a
+        host loss; namespaces one agreement round's keys."""
+        raw = self.control.get("epoch")
+        if raw is None:
+            return 0
+        try:
+            return int(raw)
+        except ValueError:
+            return 0
+
+    def bump_epoch(self):
+        """Advance the epoch and return the new value. Two survivors
+        detecting the same loss concurrently both write the same
+        successor — the race converges on one epoch, which is all the
+        agreement round needs."""
+        new = self.epoch() + 1
+        self.control.put("epoch", str(new))
+        return new
+
+    # ------------------------------------------------ rollback agreement
+    def propose_rollback(self, epoch, step):
+        """Post this rank's newest locally-restorable step for `epoch`."""
+        self.control.put(f"rollback/{int(epoch)}/{self.rank}",
+                         str(int(step)))
+
+    def proposals(self, epoch):
+        """{rank: step} posted for `epoch` so far."""
+        prefix = f"rollback/{int(epoch)}/"
+        out = {}
+        for k in self.control.keys(prefix):
+            raw = self.control.get(k)
+            try:
+                out[int(k[len(prefix):])] = int(raw)
+            except (TypeError, ValueError):
+                continue    # torn/foreign key: not a proposal
+        return out
+
+    def agree_rollback(self, epoch, expect=None, timeout_ms=None,
+                       poll_ms=50.0):
+        """LEADER side: wait (bounded) for proposals from `expect`
+        (default: the currently-live ranks, self included), publish
+        ``agreed/<epoch>`` = min over whatever was posted by the
+        deadline, and return it. A straggler that never posts cannot
+        block the fleet — the deadline converts it into "agreed without
+        you" (its own proposal, had it arrived, could only have LOWERED
+        the step; min over a subset is still restorable by every
+        subset member, and the straggler restores the published step or
+        dies trying)."""
+        timeout_ms = self.deadline_ms if timeout_ms is None \
+            else float(timeout_ms)
+        expect = set(self.live_ranks() if expect is None else expect)
+        expect.add(self.rank)
+        deadline = self._clock() + timeout_ms / 1000.0
+        while True:
+            got = self.proposals(epoch)
+            if expect <= set(got) or self._clock() >= deadline:
+                break
+            self._sleep(poll_ms / 1000.0)
+        if not got:
+            raise MXNetError(
+                f"fleet: no rollback proposals for epoch {epoch} within "
+                f"{timeout_ms:g}ms — cannot agree a rollback step")
+        agreed = min(got.values())
+        self.control.put(f"agreed/{int(epoch)}", str(agreed))
+        _agreement_counter.inc()
+        missing = sorted(expect - set(got))
+        _log().warning(
+            "fleet: epoch %d rollback agreed at step %d over proposals "
+            "%s%s", epoch, agreed, got,
+            f" (stragglers {missing} missed the deadline)" if missing
+            else "")
+        return agreed
+
+    def agreed_rollback(self, epoch):
+        """The published agreement for `epoch`, or None."""
+        raw = self.control.get(f"agreed/{int(epoch)}")
+        if raw is None:
+            return None
+        try:
+            return int(raw)
+        except ValueError:
+            return None
+
+    def wait_rollback(self, epoch, timeout_ms=None, poll_ms=50.0):
+        """FOLLOWER side: poll for the leader's published agreement.
+        Returns the agreed step, or None when the deadline passes with
+        nothing published (leader died mid-agreement — the caller
+        re-enters detection, where the next liveness read elects a new
+        leader)."""
+        timeout_ms = self.deadline_ms if timeout_ms is None \
+            else float(timeout_ms)
+        deadline = self._clock() + timeout_ms / 1000.0
+        while True:
+            step = self.agreed_rollback(epoch)
+            if step is not None:
+                return step
+            if self._clock() >= deadline:
+                return None
+            self._sleep(poll_ms / 1000.0)
+
+
+class FleetSupervisor(TrainingSupervisor):
+    """`TrainingSupervisor` + fleet membership. Adds to the per-step
+    probe: an inline heartbeat, peer liveness (a newly-dead peer raises
+    `HostLost` into the CLASSIFY → RECOVER loop), and the rank-keyed
+    ``host.lost`` chaos point. The ``host_lost`` recovery policy is the
+    cross-host rollback agreement described in the module docstring.
+
+    Extra keyword arguments over the base: `member` (a prebuilt
+    `FleetMember`) or `rank`/`world`/`control` to build one;
+    `rebootstrap` (None reads MXTPU_FLEET_REBOOTSTRAP, default off) to
+    tear down + re-init the jax distributed runtime after an agreement
+    so collectives re-form around the respawned peer."""
+
+    def __init__(self, trainer, step_fn, data, *, member=None, rank=None,
+                 world=None, control=None, rebootstrap=None, **kwargs):
+        super().__init__(trainer, step_fn, data, **kwargs)
+        if member is None:
+            member = FleetMember(0 if rank is None else rank,
+                                 1 if world is None else world,
+                                 control=control)
+        self.member = member
+        self._known_dead = set()
+        if rebootstrap is None:
+            rebootstrap = _env.env_int("MXTPU_FLEET_REBOOTSTRAP", 0,
+                                       minimum=0) > 0
+        self._rebootstrap = bool(rebootstrap)
+
+    # ------------------------------------------------------- per-step
+    def _probe(self):
+        self.member.beat()
+        self._check_peers()
+        if _finj.ENABLED:
+            _finj.check_host_loss(self.member.rank,
+                                  context=f"step {self._applied}")
+        super()._probe()
+
+    def _check_peers(self):
+        dead = set(self.member.dead_peers())
+        returned = self._known_dead - dead
+        if returned:
+            # a respawned worker re-joined (fresh heartbeat under a new
+            # incarnation): clear it so a LATER death is detected again
+            self._known_dead -= returned
+            _log().warning("fleet: rank %d sees peers %s back",
+                           self.member.rank, sorted(returned))
+        fresh = sorted(dead - self._known_dead)
+        if fresh:
+            self._known_dead |= dead
+            raise HostLost(
+                fresh[0],
+                context=f"peer heartbeat expired (dead={sorted(dead)}, "
+                        f"observer rank {self.member.rank})")
+
+    # -------------------------------------------------- host_lost policy
+    def _host_lost_recover(self, exc):
+        """Survivor-side host-loss recovery: bump the epoch, run the
+        rollback agreement, optionally re-bootstrap the distributed
+        runtime, and restore the agreed step exactly."""
+        if self._mgr is None:
+            self._crash(exc, "host_lost",
+                        "no checkpoint manager configured — cross-host "
+                        "rollback impossible")
+        m = self.member
+        if getattr(exc, "rank", None) == m.rank:
+            # OUR own death (the rank-keyed host.lost chaos point): this
+            # rollback IS the in-place restart, so the member unmasks
+            # itself — leaving the mask on would exclude it from its own
+            # liveness reads and it could never lead (or even join) the
+            # agreement. Genuinely dead peers stay masked.
+            _finj.reset_lost_hosts(m.rank)
+            m.beat()
+        epoch = m.bump_epoch()
+        healthy = self._mgr.healthy_steps()
+        own = max(healthy) if healthy else 0
+        m.propose_rollback(epoch, own)
+        if m.is_leader():
+            agreed = m.agree_rollback(epoch)
+        else:
+            agreed = m.wait_rollback(epoch)
+            if agreed is None:
+                # the leader died mid-agreement; if WE are the new
+                # leader, publish — else this episode is unrecoverable
+                # from here (the next detection round re-enters)
+                if m.is_leader():
+                    agreed = m.agree_rollback(epoch)
+                else:
+                    self._crash(exc, "host_lost",
+                                f"no rollback agreement published for "
+                                f"epoch {epoch} within the deadline")
+        if _tracer.ACTIVE:
+            _tracer.instant("fault.fleet_rollback", cat="fault",
+                            args={"epoch": epoch, "agreed": int(agreed),
+                                  "rank": m.rank})
+        if self._rebootstrap:
+            self._rebootstrap_distributed()
+        self._restore_agreed(agreed, cause=exc)
+        _log().warning(
+            "fleet: rank %d recovered from host loss (%r) — epoch %d, "
+            "agreed rollback step %d", m.rank, exc, epoch, agreed)
+
+    def _rebootstrap_distributed(self):
+        """Re-form the multi-host runtime around the survivors (and any
+        respawned member): tear down the old client, then
+        `init_distributed` — which already wraps the rendezvous in the
+        MXTPU_DIST retry/backoff policy, because a respawned peer may
+        arrive seconds later."""
+        from .. import kvstore as _kv
+        _kv.reset_distributed()
+        _kv.init_distributed()
+
+    def _restore_agreed(self, agreed, cause=None):
+        """Restore the agreed step. The agreement is min() over newest
+        locally-restorable steps, so it exists here unless retention
+        pruned it between propose and restore — then fall back to the
+        newest local healthy step at or below it (strictly older =
+        strictly safer; it replays more, it cannot diverge)."""
+        candidates = [s for s in self._mgr.healthy_steps() if s <= agreed]
+        if not candidates:
+            self._crash(cause, "host_lost",
+                        f"agreed rollback step {agreed} has no local "
+                        f"checkpoint at or below it")
+        local = max(candidates)
+        if local != agreed:
+            _log().warning(
+                "fleet: agreed step %d not on local disk — restoring "
+                "older healthy step %d (pruned between propose and "
+                "restore?)", agreed, local)
+        params = self._mgr.restore_step(local, self._template())
+        self._apply_restored(local, params, cause=cause,
+                             domain="host_lost")
+
+    def _restore(self, initial, cause=None, domain=None):
+        """On an INITIAL (re)start — the respawned-worker path — honor
+        an already-published agreement for the current epoch instead of
+        this host's own newest step: the fleet already decided where
+        everyone resumes."""
+        if initial and self._mgr is not None:
+            epoch = self.member.epoch()
+            agreed = self.member.agreed_rollback(epoch) \
+                if epoch > 0 else None
+            if agreed is not None and \
+                    any(s <= agreed for s in self._mgr.healthy_steps()):
+                self._restore_agreed(agreed, cause=cause)
+                _log().warning(
+                    "fleet: rank %d resumed from agreed step %d "
+                    "(epoch %d) after restart", self.member.rank,
+                    self._applied, epoch)
+                return self._applied
+        return super()._restore(initial, cause=cause, domain=domain)
+
+
+def run_fleet(trainer, step_fn, data, num_steps, *, rank=None, world=None,
+              control=None, resume=None, **kwargs):
+    """Convenience: build a `FleetSupervisor` (rank/world default to the
+    launcher env — MXTPU_WORKER_ID / MXTPU_NUM_WORKERS — else a
+    single-member fleet), run it with the background heartbeat thread
+    up, and return (report, supervisor)."""
+    if rank is None or world is None:
+        from ..kvstore import _cluster_env
+        _, n, r = _cluster_env()
+        rank = (r or 0) if rank is None else rank
+        world = (n or 1) if world is None else world
+    sup = FleetSupervisor(trainer, step_fn, data, rank=rank, world=world,
+                          control=control, **kwargs)
+    sup.member.start()
+    try:
+        report = sup.run(num_steps, resume=resume)
+    finally:
+        sup.member.stop()
+    return report, sup

@@ -36,10 +36,11 @@ import time
 from ..base import MXNetError
 from ..observability import registry as _obs_registry
 
-__all__ = ["FaultInjected", "DeviceLost", "POINTS", "ENABLED", "inject",
-           "clear", "configure", "active", "should_fire", "check", "hits",
-           "fires", "points", "check_device_loss", "lost_devices",
-           "reset_lost_devices"]
+__all__ = ["FaultInjected", "DeviceLost", "HostLost", "POINTS", "ENABLED",
+           "inject", "clear", "configure", "active", "should_fire", "check",
+           "hits", "fires", "points", "check_device_loss", "lost_devices",
+           "reset_lost_devices", "check_host_loss", "lost_hosts",
+           "reset_lost_hosts"]
 
 # the failure points wired through the framework (a spec may name any
 # string — new sites don't need registration here — but these are the
@@ -48,7 +49,7 @@ POINTS = ("io.read", "io.decode", "engine.task", "kv.collective",
           "kv.timeout", "kv.init", "grad.nan", "preempt.sigterm",
           "checkpoint.save", "checkpoint.load", "serve.admit",
           "serve.decode", "serve.prefix", "serve.speculate",
-          "serve.quant", "device.lost")
+          "serve.quant", "device.lost", "host.lost", "kv.heartbeat")
 
 ENABLED = False            # fast-path guard; True iff any spec registered
 
@@ -57,6 +58,7 @@ _lock = threading.Lock()
 _specs = {}                # point -> _Spec
 _injected_counters = {}    # point -> Counter handle
 _lost_devices = set()      # device ids masked by fired device.lost points
+_lost_hosts = set()        # worker ranks masked by fired host.lost points
 
 
 class FaultInjected(MXNetError):
@@ -85,12 +87,28 @@ class DeviceLost(MXNetError):
         super().__init__(msg)
 
 
+class HostLost(MXNetError):
+    """Raised by `check_host_loss` when the ``host.lost`` fault point
+    fires for this worker's rank: the whole host (its process, not just
+    a chip) drops out of the fleet. Lost ranks accumulate in
+    `lost_hosts()` so peer supervisors see the member as dead even when
+    its heartbeat file would otherwise look fresh."""
+
+    def __init__(self, rank, context=""):
+        self.rank = int(rank)
+        msg = f"injected host loss: worker rank {rank} left the fleet"
+        if context:
+            msg += f" ({context})"
+        super().__init__(msg)
+
+
 class _Spec:
     __slots__ = ("point", "prob", "times", "at", "action", "delay",
-                 "message", "device", "_rng", "hits", "fires")
+                 "message", "device", "rank", "_rng", "hits", "fires")
 
     def __init__(self, point, prob=1.0, times=None, at=None, seed=0,
-                 action="raise", delay=0.5, message="", device=None):
+                 action="raise", delay=0.5, message="", device=None,
+                 rank=None):
         if action not in ("raise", "stall", "sigterm"):
             raise MXNetError(f"unknown fault action {action!r}; use "
                              "'raise', 'stall' or 'sigterm'")
@@ -102,9 +120,18 @@ class _Spec:
         self.delay = float(delay)
         self.message = message
         self.device = None if device is None else int(device)
+        self.rank = None if rank is None else int(rank)
         self._rng = random.Random(seed)
         self.hits = 0       # times the point was reached
         self.fires = 0      # times the fault actually triggered
+
+    def rank_matches(self, rank):
+        """Rank-keyed specs (``rank=N``) fire only at the worker that
+        owns rank N. A non-matching hit returns False WITHOUT consuming
+        a hit, so the target rank's ``at=``/``n=`` schedule stays
+        deterministic no matter how often other ranks pass the point."""
+        return self.rank is None or (rank is not None
+                                     and int(rank) == self.rank)
 
     def decide(self):
         """One hit: returns True when the fault fires. Caller holds _lock."""
@@ -131,17 +158,19 @@ def _counter(point):
 
 
 def inject(point, prob=1.0, times=None, at=None, seed=0, action="raise",
-           delay=0.5, message="", device=None):
+           delay=0.5, message="", device=None, rank=None):
     """Arm a failure point. Replaces any existing spec for `point`.
 
     at: iterable of 1-based hit indices that fire (overrides prob);
     times: max total fires; seed: RNG seed for probabilistic schedules;
     action: 'raise' | 'stall' (sleep `delay` s) | 'sigterm';
     device: the device id a firing ``device.lost`` point masks (see
-    `check_device_loss`)."""
+    `check_device_loss`); rank: key the spec to one worker rank — only
+    hits carrying that rank count (see `_Spec.rank_matches`)."""
     global ENABLED
     spec = _Spec(point, prob=prob, times=times, at=at, seed=seed,
-                 action=action, delay=delay, message=message, device=device)
+                 action=action, delay=delay, message=message, device=device,
+                 rank=rank)
     with _lock:
         _specs[point] = spec
         ENABLED = True
@@ -157,10 +186,13 @@ def clear(point=None):
         if point is None:
             _specs.clear()
             _lost_devices.clear()
+            _lost_hosts.clear()
         else:
             _specs.pop(point, None)
             if point == "device.lost":
                 _lost_devices.clear()
+            elif point == "host.lost":
+                _lost_hosts.clear()
         ENABLED = bool(_specs)
 
 
@@ -184,7 +216,7 @@ def configure(spec_string):
                 kw["at"] = [int(x) for x in v.split("+")]
             elif k == "prob":
                 kw["prob"] = float(v)
-            elif k in ("times", "seed", "device"):
+            elif k in ("times", "seed", "device", "rank"):
                 kw[k] = int(v)
             elif k == "delay":
                 kw["delay"] = float(v)
@@ -223,16 +255,17 @@ def fires(point):
         return s.fires if s is not None else 0
 
 
-def should_fire(point):
+def should_fire(point, rank=None):
     """One hit at `point`: True when the armed schedule says fire (the
     caller then applies its own failure semantics — e.g. the Trainer
     poisons gradients for `grad.nan`). Counts into
-    ``fault_injected{point=}`` when firing."""
+    ``fault_injected{point=}`` when firing. `rank` identifies the
+    calling worker for rank-keyed specs."""
     if not ENABLED:
         return False
     with _lock:
         spec = _specs.get(point)
-        if spec is None:
+        if spec is None or not spec.rank_matches(rank):
             return False
         fire = spec.decide()
     if fire:
@@ -240,16 +273,17 @@ def should_fire(point):
     return fire
 
 
-def check(point, context=""):
+def check(point, context="", rank=None):
     """One hit at `point`, applying the spec's action when it fires:
     raise `FaultInjected`, stall (sleep), or deliver SIGTERM to this
     process. Returns True when the fault fired with a non-raise action,
-    False when nothing fired."""
+    False when nothing fired. `rank` identifies the calling worker for
+    rank-keyed specs."""
     if not ENABLED:
         return False
     with _lock:
         spec = _specs.get(point)
-        if spec is None:
+        if spec is None or not spec.rank_matches(rank):
             return False
         fire = spec.decide()
         action, delay, msg = spec.action, spec.delay, spec.message
@@ -308,6 +342,48 @@ def reset_lost_devices():
     """Unmask every lost device (recovery complete / test hygiene)."""
     with _lock:
         _lost_devices.clear()
+
+
+def check_host_loss(rank, context=""):
+    """One hit at the ``host.lost`` point for the worker that owns
+    `rank`. A rank-keyed spec (``rank=N``) fires only at that worker —
+    other ranks pass through without consuming a hit. When the schedule
+    fires, the caller's rank is masked into `lost_hosts()` and
+    `HostLost` raises: the fleet member treats its own process as gone
+    (peers see the masked rank as dead regardless of heartbeat
+    freshness). Like device loss, the action key is ignored — host loss
+    always raises. Returns False when nothing fired."""
+    if not ENABLED:
+        return False
+    with _lock:
+        spec = _specs.get("host.lost")
+        if spec is None or not spec.rank_matches(rank):
+            return False
+        fire = spec.decide()
+        if fire:
+            _lost_hosts.add(int(rank))
+    if not fire:
+        return False
+    _counter("host.lost").inc()
+    raise HostLost(rank, context)
+
+
+def lost_hosts():
+    """Worker ranks masked by fired ``host.lost`` points (sorted)."""
+    with _lock:
+        return sorted(_lost_hosts)
+
+
+def reset_lost_hosts(rank=None):
+    """Unmask lost hosts: all of them (default — fleet recovery
+    complete / test hygiene) or one `rank` (a member recovering from its
+    OWN injected death unmasks itself without resurrecting genuinely
+    dead peers)."""
+    with _lock:
+        if rank is None:
+            _lost_hosts.clear()
+        else:
+            _lost_hosts.discard(int(rank))
 
 
 # env arming: parsed once at import — the chaos harness and users arm

@@ -8,7 +8,7 @@ the loop as a crash-only state machine around the training loop:
 
     RUN ──failure──▶ CLASSIFY ──▶ RECOVER(domain) ──▶ RUN
 
-Every failure lands in one of five domains, each with a policy:
+Every failure lands in one of the recovery domains, each with a policy:
 
   ================  ==========================  =======================
   domain            detected by                 recovery (parity)
@@ -31,6 +31,17 @@ Every failure lands in one of five domains, each with a policy:
                                                 the CheckpointManager) →
                                                 resumable exit (bitwise
                                                 across the restart)
+  capacity_gain     capacity probe: every       regrow the mesh back to
+                    pre-shrink device is back   its pre-shrink shape via
+                    (hysteresis + cooldown      `Trainer.resize_mesh`
+                    guarded)                    (collective-only; restart
+                                                budget refilled; NOT
+                                                bitwise across the
+                                                geometry change)
+  host_lost         `HostLost` (host.lost       fleet rollback agreement
+                    fault point / dead peer     (fault/fleet.py); without
+                    heartbeat via               a fleet: rollback +
+                    `FleetSupervisor`)          replay like hang
   ================  ==========================  =======================
 
 Rollback + replay is deterministic: the periodic checkpoint records the
@@ -67,8 +78,9 @@ import time
 from ..base import MXNetError
 from ..observability import registry as _obs_registry
 from ..observability import tracer as _tracer
+from .. import _env
 from . import injection as _finj
-from .injection import DeviceLost
+from .injection import DeviceLost, HostLost
 from .preemption import Preempted, check_preempted
 from .retry import RetryPolicy
 from .watchdog import StepWatchdog, WatchdogTimeout, _warn_unwritable
@@ -78,14 +90,16 @@ __all__ = ["DOMAINS", "TrainingSupervisor", "run_supervised",
            "classify_failure"]
 
 DOMAINS = ("transient", "corrupt_state", "hang", "capacity_loss",
-           "preemption")
+           "preemption", "capacity_gain", "host_lost")
 
 META_NAME = "supervisor.json"      # per-checkpoint replay cursor extra
 STATES_NAME = "trainer.states"     # per-checkpoint optimizer-state extra
+INCIDENTS_NAME = "incidents.jsonl"  # per-incident JSONL in the crash dir
 
 _reg = _obs_registry()
 _budget_gauge = _reg.gauge("fault_restart_budget_remaining")
 _crash_counter = _reg.counter("fault_crash_reports")
+_regrow_counter = _reg.counter("fault_regrows")
 
 
 def _count_recovery(domain):
@@ -127,6 +141,8 @@ def classify_failure(exc):
     from ..kvstore import CollectiveTimeout
     if isinstance(exc, Preempted):
         return "preemption"
+    if isinstance(exc, HostLost):
+        return "host_lost"
     if isinstance(exc, DeviceLost):
         return "capacity_loss"
     if isinstance(exc, (WatchdogTimeout, CollectiveTimeout)):
@@ -242,6 +258,7 @@ class TrainingSupervisor:
                  watchdog=None, crash_dir=None, classify=None,
                  on_capacity_loss=None, params_fn=None, set_params_fn=None,
                  emergency_save=True, drain_timeout_ms=2000,
+                 regrow_cooldown=None, regrow_hysteresis=None,
                  sleep=time.sleep):
         from ..checkpoint import CheckpointManager
         self._trainer = trainer
@@ -280,8 +297,22 @@ class TrainingSupervisor:
         self._budget_left = self.restart_budget
         self._consec_incidents = 0
         self._steps_since_incident = 0
-        self.incidents = []           # structured incident log
+        self._incidents = []          # structured incident log
         self.recoveries = {d: 0 for d in DOMAINS}
+        # grow-back: a shrink records the pre-shrink layout; the per-step
+        # capacity probe regrows once every lost device is back, guarded
+        # by hysteresis (consecutive clean probes) and a cooldown (applied
+        # steps since the shrink / last failed regrow) against capacity
+        # flapping re-resharding the job every few steps
+        self.regrow_cooldown = int(regrow_cooldown) \
+            if regrow_cooldown is not None \
+            else _env.env_int("MXTPU_REGROW_COOLDOWN_STEPS", 8, minimum=0)
+        self.regrow_hysteresis = max(1, int(regrow_hysteresis)) \
+            if regrow_hysteresis is not None \
+            else _env.env_int("MXTPU_REGROW_HYSTERESIS", 2, minimum=1)
+        self._pre_shrink = None       # {"axes", "devices", "lost"}
+        self._regrow_ready = 0        # consecutive capacity-clean probes
+        self._regrow_wait_from = 0    # cooldown anchor (applied steps)
         _budget_gauge.set(self._budget_left)
 
     # --------------------------------------------- default state hooks
@@ -425,6 +456,7 @@ class TrainingSupervisor:
                     self._applied += 1
                     self._record_loss(loss)
                     self._note_progress()
+                    self._probe()
                     if self._mgr is not None and self.checkpoint_every and \
                             self._applied % self.checkpoint_every == 0:
                         self._save_checkpoint()
@@ -441,10 +473,12 @@ class TrainingSupervisor:
                     # dashboards see real preemptions, not only
                     # custom-classified ones
                     outcome = "preempted"
-                    self.incidents.append(
-                        {"domain": "preemption", "applied": self._applied,
-                         "error": repr(e), "recovered": True,
-                         "time": time.time()})
+                    incident = {"domain": "preemption",
+                                "applied": self._applied,
+                                "error": repr(e), "recovered": True,
+                                "time": time.time()}
+                    self._incidents.append(incident)
+                    self._emit_incident(incident)
                     self.recoveries["preemption"] += 1
                     _count_recovery("preemption")
                     _log().warning(
@@ -468,7 +502,7 @@ class TrainingSupervisor:
             self._disarm()
         return {"outcome": outcome, "applied": self._applied,
                 "final_loss": self._losses[-1] if self._losses else None,
-                "incidents": list(self.incidents),
+                "incidents": list(self._incidents),
                 "recoveries": dict(self.recoveries),
                 "budget_remaining": self._budget_left,
                 "resumed_from": resumed_from}
@@ -502,10 +536,12 @@ class TrainingSupervisor:
         except _NonTransient as carrier:
             raise carrier.exc
         if attempts[0] > 1:
-            self.incidents.append(
-                {"domain": "transient", "applied": self._applied,
-                 "error": repr(last_err[0]), "retries": attempts[0] - 1,
-                 "recovered": True, "time": time.time()})
+            incident = {"domain": "transient", "applied": self._applied,
+                        "error": repr(last_err[0]),
+                        "retries": attempts[0] - 1,
+                        "recovered": True, "time": time.time()}
+            self._incidents.append(incident)
+            self._emit_incident(incident)
             self.recoveries["transient"] += 1
             _count_recovery("transient")
             if _tracer.ACTIVE:
@@ -555,7 +591,121 @@ class TrainingSupervisor:
                 self._budget_left = self.restart_budget
                 _budget_gauge.set(self._budget_left)
 
+    # ------------------------------------------------- incident records
+    def incidents(self):
+        """The structured incident log, oldest first: one dict per
+        incident ({"domain", "applied", "error"/"axes", "recovered",
+        "time", ...}). Every recovery — successful or not — lands here;
+        successful ones are ALSO appended as JSON lines to
+        ``incidents.jsonl`` in the crash dir, so a run that never
+        exhausts its budget still leaves an on-disk trail."""
+        return list(self._incidents)
+
+    def _emit_incident(self, incident):
+        """Best-effort one-line JSONL append in the crash dir. Crash-only
+        discipline: an unwritable dir degrades to the in-memory log (and
+        the eventual crash report), never a secondary failure."""
+        try:
+            os.makedirs(self._crash_dir, exist_ok=True)
+            with open(os.path.join(self._crash_dir, INCIDENTS_NAME),
+                      "a") as f:
+                f.write(json.dumps(incident, default=str) + "\n")
+        except OSError as e:
+            _warn_unwritable(self._crash_dir, e)
+
+    # ------------------------------------------------- capacity probe
+    def _probe(self):
+        """Per-applied-step probe hook, called once after every clean
+        step (inside the supervised try block, so anything it raises
+        routes through CLASSIFY → RECOVER like a step failure). The base
+        implementation runs the grow-back capacity probe; the fleet
+        supervisor (fault/fleet.py) extends it with heartbeats and peer
+        liveness."""
+        self._maybe_regrow()
+
+    def _maybe_regrow(self):
+        """Grow-back: when every device the shrink lost is back in the
+        active set (unmasked from `injection.lost_devices`), reverse the
+        shrink via `Trainer.resize_mesh` to the recorded pre-shrink
+        layout. Hysteresis demands `regrow_hysteresis` CONSECUTIVE clean
+        probes and the cooldown `regrow_cooldown` applied steps since
+        the shrink (or the last failed regrow) — both guard against
+        capacity flapping thrashing the job through resharding. Returns
+        True when a regrow happened."""
+        pre = self._pre_shrink
+        if pre is None:
+            return False
+        if self._applied - self._regrow_wait_from < self.regrow_cooldown:
+            return False
+        still_lost = set(_finj.lost_devices())
+        if any(d in still_lost for d in pre["lost"]):
+            self._regrow_ready = 0
+            return False
+        self._regrow_ready += 1
+        if self._regrow_ready < self.regrow_hysteresis:
+            return False
+        return self._regrow(pre)
+
+    def _regrow(self, pre):
+        import jax
+        incident = {"domain": "capacity_gain", "applied": self._applied,
+                    "axes": dict(pre["axes"]),
+                    "devices": list(pre["devices"]), "time": time.time()}
+        by_id = {d.id: d for d in jax.devices()}
+        try:
+            devices = [by_id[i] for i in pre["devices"]]
+            self._trainer.resize_mesh(dict(pre["axes"]), devices=devices)
+        except Exception as e:
+            # a failed regrow is NOT fatal: the job keeps training on
+            # the shrunk mesh (which works), consumes no restart budget,
+            # and re-probes after a fresh cooldown
+            incident["error"] = repr(e)
+            incident["recovered"] = False
+            self._incidents.append(incident)
+            self._emit_incident(incident)
+            self._regrow_ready = 0
+            self._regrow_wait_from = self._applied
+            _log().warning(
+                "supervisor: regrow to %s failed (%r) — staying on the "
+                "shrunk mesh, re-probing after %d steps", pre["axes"], e,
+                self.regrow_cooldown)
+            return False
+        incident["recovered"] = True
+        self._incidents.append(incident)
+        self._emit_incident(incident)
+        self.recoveries["capacity_gain"] += 1
+        _count_recovery("capacity_gain")
+        _regrow_counter.inc()
+        if _tracer.ACTIVE:
+            _tracer.instant("fault.regrow", cat="fault",
+                            args={"applied": self._applied,
+                                  "axes": dict(pre["axes"])})
+        self._pre_shrink = None
+        self._regrow_ready = 0
+        self._regrow_wait_from = self._applied
+        # the job is whole again: a regrow ENDS the degraded episode the
+        # shrink opened, so the restart budget refills like a clean-
+        # progress window would
+        self._consec_incidents = 0
+        if self._budget_left < self.restart_budget:
+            self._budget_left = self.restart_budget
+            _budget_gauge.set(self._budget_left)
+        _log().warning(
+            "supervisor: capacity returned — regrew mesh to %s over "
+            "devices %s at applied step %d (restart budget restored to "
+            "%d)", pre["axes"], pre["devices"], self._applied,
+            self.restart_budget)
+        return True
+
     # ----------------------------------------------------- recoveries
+    def _host_lost_recover(self, exc):
+        """Host-loss policy WITHOUT a fleet: a peer (or this process's
+        own injected death) left mid-collective, so the collective
+        stream is poisoned exactly like a hang — rollback to
+        last-known-good and replay. `FleetSupervisor` overrides this
+        with the cross-host rollback agreement."""
+        self._rollback(exc, "host_lost")
+
     def _recover(self, exc):
         domain = self._classify(exc)
         if domain not in DOMAINS:
@@ -567,7 +717,7 @@ class TrainingSupervisor:
             domain = "transient"
         incident = {"domain": domain, "applied": self._applied,
                     "error": repr(exc), "time": time.time()}
-        self.incidents.append(incident)
+        self._incidents.append(incident)
         if _tracer.ACTIVE:
             _tracer.instant("fault.incident", cat="fault",
                             args={"domain": domain,
@@ -582,6 +732,7 @@ class TrainingSupervisor:
             if self._mgr is not None:
                 self._save_checkpoint()
             incident["recovered"] = True
+            self._emit_incident(incident)
             self.recoveries[domain] += 1
             _count_recovery(domain)
             return "preempted"
@@ -601,6 +752,8 @@ class TrainingSupervisor:
             self._sleep(delay)
         if domain == "capacity_loss":
             self._shrink_mesh(exc)
+        elif domain == "host_lost":
+            self._host_lost_recover(exc)
         elif domain == "hang":
             self._hang_post_mortem(exc)
             self._rollback(exc, domain)
@@ -610,6 +763,7 @@ class TrainingSupervisor:
             # only sound move is rollback to last-known-good + replay
             self._rollback(exc, domain)
         incident["recovered"] = True
+        self._emit_incident(incident)
         self.recoveries[domain] += 1
         _count_recovery(domain)
         return "recovered"
@@ -645,6 +799,14 @@ class TrainingSupervisor:
                 return None
             self._crash(cause, domain or "corrupt_state",
                         "no restorable checkpoint for rollback")
+        self._apply_restored(step, params, cause=cause, domain=domain)
+        return step
+
+    def _apply_restored(self, step, params, cause=None, domain=None):
+        """Install an already-loaded checkpoint (params + optimizer
+        states + replay cursor) as the live training state. Shared by
+        the rollback path and the fleet's restore-a-specific-step path
+        (fault/fleet.py)."""
         self._set_params_fn(params)
         meta = {}
         raw = self._mgr.read_extra(step, META_NAME)
@@ -672,7 +834,6 @@ class TrainingSupervisor:
         _log().warning("supervisor: restored checkpoint step %s "
                        "(applied=%d) and replayed the data stream", step,
                        applied)
-        return step
 
     def _shrink_mesh(self, exc):
         """Capacity loss: rebuild the mesh over the survivors and keep
@@ -708,6 +869,21 @@ class TrainingSupervisor:
             self._crash(exc, "capacity_loss",
                         f"only {len(survivors)} devices survive but the "
                         f"non-data axes need {other} — cannot shrink")
+        # record the pre-shrink layout so the capacity probe can reverse
+        # this exact resize when the lost devices return. A SECOND shrink
+        # keeps the ORIGINAL layout as the regrow target (the job should
+        # come all the way back) and extends the lost set.
+        if self._pre_shrink is None:
+            self._pre_shrink = {
+                "axes": {k: int(v) for k, v in plan.mesh.shape.items()},
+                "devices": [int(d.id)
+                            for d in plan.mesh.devices.flatten()],
+                "lost": sorted(lost)}
+        else:
+            self._pre_shrink["lost"] = sorted(
+                set(self._pre_shrink["lost"]) | {int(d) for d in lost})
+        self._regrow_ready = 0
+        self._regrow_wait_from = self._applied
         axes[plan.data_axis] = new_dp
         self._trainer.resize_mesh(axes,
                                   devices=survivors[:new_dp * other])
@@ -729,7 +905,7 @@ class TrainingSupervisor:
             "applied": self._applied,
             "restart_budget": self.restart_budget,
             "budget_remaining": self._budget_left,
-            "incidents": list(self.incidents),
+            "incidents": list(self._incidents),
             "recoveries": dict(self.recoveries),
             "lost_devices": _finj.lost_devices(),
             "engine_pending": engine.pending_report(),

@@ -40,8 +40,10 @@ from . import _env
 from .fault import injection as _finj
 from .fault import retry as _retry
 
-__all__ = ["KVStore", "create", "init_distributed", "CollectiveTimeout",
-           "collective_timeout_ms"]
+__all__ = ["KVStore", "create", "init_distributed", "reset_distributed",
+           "CollectiveTimeout", "collective_timeout_ms", "ControlPlane",
+           "MemoryControlPlane", "FileControlPlane",
+           "DistributedControlPlane", "control_plane"]
 
 # always-on collective accounting (bytes entering a cross-replica reduce),
 # per collective kind — the per-collective byte/latency signal motivating
@@ -90,11 +92,14 @@ def _nbytes(a):
 # controller (the abandoned work touches only local devices). On a
 # MULTI-CONTROLLER pod an abandoned collective may later unwedge and
 # desynchronize this host's collective stream against its peers — there
-# the timeout's job is to convert an infinite hang into a typed error
-# for a PROCESS-level restart (exit after the emergency checkpoint),
-# not an in-process replay. 0/unset disables (no thread, no overhead);
-# the ``kv.timeout`` fault point stalls inside the deadline window so
-# the path is testable without a real wedge.
+# the right answer is a PROCESS-level restart coordinated through the
+# fleet control plane (fault/fleet.py): the survivors agree on a common
+# rollback step over `control_plane()` keys, re-bootstrap the
+# distributed runtime (`reset_distributed` + `init_distributed`), and
+# resume together — see docs/RELIABILITY.md "Fleet recovery". 0/unset
+# disables (no thread, no overhead); the ``kv.timeout`` fault point
+# stalls inside the deadline window so the path is testable without a
+# real wedge.
 
 class CollectiveTimeout(MXNetError):
     """A blocking collective exceeded ``MXTPU_COLLECTIVE_TIMEOUT_MS``.
@@ -261,6 +266,225 @@ def init_distributed(coordinator_address=None, num_processes=None,
                 f"jax.distributed.initialize failed ({e!r}); continuing "
                 f"SINGLE-PROCESS — cross-host gradients will NOT reduce",
                 RuntimeWarning, stacklevel=2)
+
+
+def reset_distributed():
+    """Tear down the multi-host runtime so a SURVIVOR can re-bootstrap
+    after a peer died: `jax.distributed.shutdown()` + clear the
+    module-level init flag, after which `init_distributed` (with its
+    retry/backoff policy) may run again against a re-formed cluster.
+    Safe to call when nothing was initialised. The fleet supervisor
+    (fault/fleet.py) calls this between the rollback agreement and the
+    re-bootstrap; single-process runs never need it."""
+    global _DIST_INITIALIZED
+    try:
+        if jax.distributed.is_initialized():
+            jax.distributed.shutdown()
+    except Exception as e:
+        # a half-dead client may fail its own shutdown; the flag reset
+        # below still lets init_distributed re-attempt the bootstrap
+        _reg.counter("kv_dist_reset_errors").inc()
+        from .log import get_logger
+        get_logger("mxnet_tpu.kvstore").warning(
+            "reset_distributed: shutdown failed (%r) — proceeding to "
+            "re-bootstrap anyway", e)
+    _DIST_INITIALIZED = False
+
+
+# ----------------------------------------------- fleet control plane
+# Small-value coordination KEYS for the elastic fleet (fault/fleet.py):
+# heartbeats, leader election, epoch counters, rollback-step agreement.
+# This is the kvstore's CONTROL plane — tiny strings with atomic
+# visibility — distinct from the DATA plane above (gradient
+# collectives). Three backends, one duck-typed surface:
+#
+#   * MemoryControlPlane — in-process dict; tier-1 tests and
+#     single-process fleets.
+#   * FileControlPlane — one file per key on a shared directory
+#     (atomic tmp+rename writes); the launcher-spawned multi-process
+#     case, surviving member process restarts.
+#   * DistributedControlPlane — the jax.distributed coordination
+#     service's key-value store (the same rendezvous service the
+#     collectives bootstrap through); multi-host pods without a shared
+#     filesystem. Requires `init_distributed` to have run.
+
+class ControlPlane:
+    """Duck-typed key-value surface for fleet coordination. Values are
+    strings (callers JSON-encode structure). `put` must be atomic at
+    key granularity: a concurrent `get` sees the old or the new value,
+    never a torn write."""
+
+    def put(self, key, value):
+        raise NotImplementedError
+
+    def get(self, key, default=None):
+        raise NotImplementedError
+
+    def keys(self, prefix=""):
+        raise NotImplementedError
+
+    def delete(self, key):
+        raise NotImplementedError
+
+
+class MemoryControlPlane(ControlPlane):
+    """In-process backend: a lock-guarded dict. Exercises the exact
+    protocol code paths (heartbeats, election, agreement) without
+    processes — the tier-1 test backend, and the degenerate
+    single-member fleet."""
+
+    def __init__(self):
+        self._data = {}
+        self._mu = threading.Lock()
+
+    def put(self, key, value):
+        with self._mu:
+            self._data[str(key)] = str(value)
+
+    def get(self, key, default=None):
+        with self._mu:
+            return self._data.get(str(key), default)
+
+    def keys(self, prefix=""):
+        with self._mu:
+            return sorted(k for k in self._data if k.startswith(prefix))
+
+    def delete(self, key):
+        with self._mu:
+            self._data.pop(str(key), None)
+
+
+class FileControlPlane(ControlPlane):
+    """Shared-directory backend: one file per key, writes go through a
+    same-directory tmp file + `os.replace` so readers never observe a
+    torn value (POSIX rename atomicity). Keys are percent-encoded into
+    filenames, so hierarchical keys ("hb/0") are fine. This is the
+    backend a launcher-spawned fleet uses (MXTPU_FLEET_DIR): it
+    survives member process restarts, which an in-memory or
+    coordination-service store would not."""
+
+    def __init__(self, directory):
+        import os
+        self.directory = str(directory)
+        os.makedirs(self.directory, exist_ok=True)
+
+    @staticmethod
+    def _fname(key):
+        from urllib.parse import quote
+        return quote(str(key), safe="")
+
+    @staticmethod
+    def _kname(fname):
+        from urllib.parse import unquote
+        return unquote(fname)
+
+    def put(self, key, value):
+        import os
+        import tempfile
+        fd, tmp = tempfile.mkstemp(prefix=".cp-", dir=self.directory)
+        try:
+            with os.fdopen(fd, "w") as f:
+                f.write(str(value))
+            os.replace(tmp, os.path.join(self.directory, self._fname(key)))
+        except OSError:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+
+    def get(self, key, default=None):
+        import os
+        path = os.path.join(self.directory, self._fname(key))
+        try:
+            with open(path, "r") as f:
+                return f.read()
+        except OSError:
+            return default
+
+    def keys(self, prefix=""):
+        import os
+        try:
+            names = os.listdir(self.directory)
+        except OSError:
+            return []
+        out = [self._kname(n) for n in names if not n.startswith(".cp-")]
+        return sorted(k for k in out if k.startswith(prefix))
+
+    def delete(self, key):
+        import os
+        try:
+            os.unlink(os.path.join(self.directory, self._fname(key)))
+        except OSError:
+            pass
+
+
+class DistributedControlPlane(ControlPlane):
+    """jax.distributed coordination-service backend: the same rendezvous
+    service `init_distributed` bootstraps through also exposes a
+    key-value store — multi-host pods coordinate fleet state over it
+    without any shared filesystem. Keys live under a namespace prefix so
+    fleet traffic cannot collide with XLA's own rendezvous keys.
+
+    Caveats: the service lives in process 0 — if THAT host dies the
+    control plane dies with it (prefer FileControlPlane when a shared
+    directory exists); deletes of absent keys are best-effort."""
+
+    NAMESPACE = "mxtpu/fleet/"
+
+    def __init__(self, client=None):
+        if client is None:
+            from jax._src import distributed as _dist
+            client = getattr(_dist.global_state, "client", None)
+        if client is None:
+            raise MXNetError(
+                "DistributedControlPlane needs the jax.distributed client "
+                "— call init_distributed() first (or use "
+                "FileControlPlane/MemoryControlPlane)")
+        self._client = client
+
+    def put(self, key, value):
+        self._client.key_value_set(self.NAMESPACE + str(key), str(value),
+                                   allow_overwrite=True)
+
+    def get(self, key, default=None):
+        # the client only exposes a BLOCKING get; a short deadline turns
+        # it into a poll (absent key -> timeout error -> default). The
+        # deadline is a poll granularity, not a correctness knob.
+        timeout_ms = int(_env.env_ms("MXTPU_CP_GET_TIMEOUT_MS", 100.0))
+        try:
+            return self._client.blocking_key_value_get(
+                self.NAMESPACE + str(key), timeout_ms)
+        except Exception:
+            return default
+
+    def keys(self, prefix=""):
+        pairs = self._client.key_value_dir_get(self.NAMESPACE + prefix)
+        n = len(self.NAMESPACE)
+        return sorted(k[n:] for k, _ in pairs)
+
+    def delete(self, key):
+        try:
+            self._client.key_value_delete(self.NAMESPACE + str(key))
+        except Exception:
+            pass    # absent key: nothing to delete
+
+
+def control_plane(directory=None):
+    """Build the fleet control plane for this process: an explicit
+    `directory` (or MXTPU_FLEET_DIR) selects `FileControlPlane`; else an
+    initialised multi-host runtime selects `DistributedControlPlane`;
+    else `MemoryControlPlane` (single-process)."""
+    import os
+    directory = directory or os.environ.get("MXTPU_FLEET_DIR")
+    if directory:
+        return FileControlPlane(directory)
+    try:
+        if jax.distributed.is_initialized():
+            return DistributedControlPlane()
+    except Exception:
+        pass
+    return MemoryControlPlane()
 
 
 def _is_process_local(a):

@@ -95,9 +95,10 @@ class ShardPlan:
         self._unmatched = []
         self._fallbacks = []
         self._warned = set()
-        # identity for executable cache keys: a NEW plan (new mesh, new
-        # rules) must miss the captured-step cache even if specs coincide
+        # debugging identity (repr/logs); NOT part of signature() — see
+        # there for why cache keys are structural
         self.plan_id = next(_plan_seq)
+        self._signature = None    # memoized structural signature
 
     # ------------------------------------------------------- resolution
     def spec_for(self, name, shape):
@@ -214,11 +215,30 @@ class ShardPlan:
         mesh = as_mesh(mesh)
         return ShardPlan(mesh, rules=self.rules, data_axis=self.data_axis)
 
-    # executable cache key: plan identity + the mesh's device fingerprint
+    # executable cache key: a STRUCTURAL fingerprint — rules + data axis
+    # + mesh axes/shape + the exact device ids in mesh order. Two plans
+    # with the same fingerprint resolve every parameter to the same
+    # NamedSharding, so a compiled step is reusable between them. This
+    # is what makes an elastic shrink → grow-back round trip
+    # (fault/supervisor.py) land back on the ORIGINAL executables
+    # instead of recompiling the whole step: the regrown plan is a new
+    # object, but its fingerprint equals the pre-shrink plan's. (An
+    # object-identity plan_id here — the pre-PR-18 scheme — forced that
+    # recompile; jax Mesh/NamedSharding equality is itself structural,
+    # so keying structurally is sound.)
     def signature(self):
-        return (self.plan_id, self.data_axis,
+        sig = self._signature
+        if sig is None:
+            import json as _json
+            rules_fp = _json.dumps(_rules.rules_to_json(self.rules),
+                                   sort_keys=True)
+            sig = self._signature = (
+                rules_fp, self.data_axis,
                 tuple(self.mesh.axis_names),
-                tuple(self.mesh.shape[a] for a in self.mesh.axis_names))
+                tuple(int(self.mesh.shape[a])
+                      for a in self.mesh.axis_names),
+                tuple(int(d.id) for d in self.mesh.devices.flatten()))
+        return sig
 
     def __repr__(self):
         shape = dict(self.mesh.shape)

@@ -33,3 +33,10 @@ def _seed():
     mx.random.seed(42)
     np.random.seed(42)
     yield
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: multi-process drills excluded from the tier-1 window "
+        "(run with -m slow)")

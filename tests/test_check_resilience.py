@@ -22,6 +22,12 @@ def test_resilience_soak(tmp_path):
     # (not skipped) and genuinely shrank the mesh to the survivors
     assert res["capacity"]["survivor_mesh"] == {"dp": 1, "tp": 1}
     assert res["recoveries"]["capacity_loss"] >= 1
+    # ... and the fleet phase RAN on the 8-device mesh: a (2,2) job lost
+    # a device, trained shrunk on (1,2), then regrew to the original
+    # layout when the device returned — with the budget refilled and the
+    # resize round trip pinned bitwise inside the tool
+    assert res["fleet"]["regrown_mesh"] == {"dp": 2, "tp": 2}
+    assert res["recoveries"]["capacity_gain"] >= 1
     # the rollback consulted the last-known-good journal (an intact but
     # unhealthy checkpoint was skipped) and the torn resume candidate
     # was checksum-rejected

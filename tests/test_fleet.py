@@ -1,0 +1,423 @@
+"""Fleet control-plane primitives + cross-host supervision (ISSUE 18
+tentpole): heartbeat expiry, leader re-election after a leader loss,
+rollback-step agreement with a straggler, graceful departure vs death,
+and the in-process FleetSupervisor host-loss recovery — all over
+`MemoryControlPlane` with an injectable clock so tier-1 never sleeps.
+The real 2-process SIGKILL drill (tools/fleet_drill.py under
+tools/launch.py --max-restarts) runs behind ``-m slow``."""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, fault, gluon, kvstore, nd
+from mxnet_tpu.fault.fleet import FleetMember, FleetSupervisor, run_fleet
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.observability import registry
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    yield
+    fault.clear()
+    fault.reset_preemption(clear_callbacks=True)
+    fault.uninstall_preemption_handler()
+
+
+class FakeClock:
+    """Deterministic wall clock; `sleep` ADVANCES it so agreement
+    deadlines expire without real waiting."""
+
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+    def sleep(self, dt):
+        self.t += dt
+
+
+def _member(rank, world, cp, clock, **kw):
+    kw.setdefault("heartbeat_ms", 100.0)
+    kw.setdefault("deadline_ms", 500.0)
+    return FleetMember(rank, world, control=cp, clock=clock,
+                       sleep=clock.sleep, **kw)
+
+
+def _fleet(world, clock=None, cp=None):
+    clock = clock or FakeClock()
+    cp = cp or kvstore.MemoryControlPlane()
+    return [_member(r, world, cp, clock) for r in range(world)], clock, cp
+
+
+# --------------------------------------------------------- heartbeats
+def test_heartbeat_roundtrip_and_expiry():
+    members, clock, _ = _fleet(2)
+    for m in members:
+        assert m.beat()
+    assert members[0].live_ranks() == [0, 1]
+    assert members[1].dead_peers() == []
+    # rank 0 goes silent past the deadline; rank 1 keeps beating
+    clock.advance(0.6)
+    members[1].beat()
+    assert members[1].live_ranks() == [1]
+    assert members[1].dead_peers() == [0]
+    # a fresh stamp resurrects it
+    members[0].beat()
+    assert members[1].dead_peers() == []
+
+
+def test_never_joined_peer_is_absent_not_dead():
+    members, clock, _ = _fleet(3)
+    members[0].beat()
+    # ranks 1 and 2 never stamped: a starting fleet must not declare
+    # unjoined peers lost
+    assert members[0].dead_peers() == []
+    assert members[0].live_ranks() == [0]
+
+
+def test_departed_is_not_dead():
+    members, clock, _ = _fleet(2)
+    for m in members:
+        m.beat()
+    assert members[1].live_ranks() == [0, 1]
+    members[0].stop()               # posts bye/0 (clean exit)
+    clock.advance(1.0)
+    members[1].beat()
+    assert members[1].dead_peers() == []        # departed, not dead
+    # a respawned incarnation retracts the farewell and rejoins
+    members[0].start()
+    members[0].stop()               # no thread leak in the test
+    members[0].control.delete("bye/0")
+    members[0].beat()
+    assert members[1].live_ranks() == [0, 1]
+
+
+def test_heartbeat_fault_point_rank_keyed():
+    members, clock, _ = _fleet(2)
+    for m in members:
+        m.beat()                    # rank 1 JOINS before its stamps die
+    assert members[0].live_ranks() == [0, 1]
+    fails0 = registry().counter("fleet_heartbeat_failures").value
+    fault.inject("kv.heartbeat", prob=1.0, rank=1)
+    try:
+        clock.advance(0.6)
+        assert members[0].beat()            # rank 0 unaffected
+        assert not members[1].beat()        # rank 1's stamp is eaten
+        assert registry().counter("fleet_heartbeat_failures").value \
+            - fails0 >= 1
+        # its last good stamp aged out: dead by staleness, not by mask
+        assert members[0].dead_peers() == [1]
+    finally:
+        fault.clear()
+
+
+# ------------------------------------------------------ leader election
+def test_leader_is_lowest_live_rank_and_reelects():
+    members, clock, _ = _fleet(3)
+    for m in members:
+        m.beat()
+    assert [m.leader() for m in members] == [0, 0, 0]
+    assert members[0].is_leader() and not members[2].is_leader()
+    elections0 = registry().counter("fleet_elections").value
+    # the leader dies: its heartbeat ages out while 1 and 2 keep beating
+    clock.advance(0.6)
+    members[1].beat()
+    members[2].beat()
+    assert members[1].leader() == 1
+    assert members[2].leader() == 1
+    assert members[1].is_leader()
+    assert registry().counter("fleet_elections").value - elections0 >= 2
+
+
+def test_host_lost_fault_masks_rank():
+    members, clock, _ = _fleet(2)
+    for m in members:
+        m.beat()
+    fault.inject("host.lost", prob=1.0, rank=0)
+    try:
+        with pytest.raises(fault.HostLost):
+            fault.check_host_loss(0)
+        assert fault.lost_hosts() == [0]
+        # the mask beats a fresh heartbeat: rank 0 is dead to the fleet
+        members[0].beat()
+        assert members[1].live_ranks() == [1]
+        assert members[1].dead_peers() == [0]
+        assert members[1].leader() == 1
+    finally:
+        fault.clear()               # clear() unmasks
+    assert fault.lost_hosts() == []
+    assert members[1].live_ranks() == [0, 1]
+
+
+# --------------------------------------------------- rollback agreement
+def test_rollback_agreement_min_over_proposals():
+    members, clock, _ = _fleet(3)
+    for m in members:
+        m.beat()
+    epoch = members[1].bump_epoch()
+    assert epoch == 1 and members[0].epoch() == 1
+    members[0].propose_rollback(epoch, 10)
+    members[1].propose_rollback(epoch, 8)
+    members[2].propose_rollback(epoch, 12)
+    agreed = members[0].agree_rollback(epoch)
+    assert agreed == 8              # min: the newest EVERYONE can restore
+    assert members[2].agreed_rollback(epoch) == 8
+    assert members[2].wait_rollback(epoch) == 8
+
+
+def test_rollback_agreement_straggler_cannot_block():
+    members, clock, _ = _fleet(3)
+    for m in members:
+        m.beat()
+    epoch = members[0].bump_epoch()
+    members[0].propose_rollback(epoch, 6)
+    members[1].propose_rollback(epoch, 4)
+    # rank 2 is live but never proposes: the deadline converts it into
+    # "agreed without you" (fake sleep advances the clock past it)
+    agreed = members[0].agree_rollback(epoch, timeout_ms=300.0)
+    assert agreed == 4
+    # the straggler finds the published agreement afterwards
+    assert members[2].agreed_rollback(epoch) == 4
+
+
+def test_wait_rollback_times_out_when_leader_died():
+    members, clock, _ = _fleet(2)
+    for m in members:
+        m.beat()
+    epoch = members[1].bump_epoch()
+    members[1].propose_rollback(epoch, 5)
+    assert members[1].wait_rollback(epoch, timeout_ms=200.0) is None
+
+
+def test_agree_rollback_without_proposals_raises():
+    members, clock, _ = _fleet(2)
+    members[0].beat()
+    with pytest.raises(mx.MXNetError):
+        members[0].agree_rollback(1, timeout_ms=100.0)
+
+
+def test_epoch_bump_converges():
+    members, clock, _ = _fleet(2)
+    # two survivors detecting the same loss concurrently write the same
+    # successor — the race converges on one epoch
+    assert members[0].bump_epoch() == 1
+    assert members[1].bump_epoch() == 2
+    assert members[0].epoch() == members[1].epoch() == 2
+
+
+# ------------------------------------------------- control-plane backends
+def test_file_control_plane_roundtrip(tmp_path):
+    cp = kvstore.FileControlPlane(str(tmp_path / "cp"))
+    cp.put("hb/0", "x")
+    cp.put("rollback/1/0", "7")
+    cp.put("odd key/with%stuff", "v")
+    assert cp.get("hb/0") == "x"
+    assert cp.get("odd key/with%stuff") == "v"
+    assert cp.get("missing") is None
+    assert sorted(cp.keys("rollback/")) == ["rollback/1/0"]
+    assert sorted(cp.keys()) == ["hb/0", "odd key/with%stuff",
+                                 "rollback/1/0"]
+    cp.delete("hb/0")
+    assert cp.get("hb/0") is None
+    # no tmp droppings from the atomic writes
+    assert not [f for f in os.listdir(str(tmp_path / "cp"))
+                if f.startswith(".cp-")]
+
+
+def test_control_plane_factory(tmp_path, monkeypatch):
+    assert isinstance(kvstore.control_plane(),
+                      kvstore.MemoryControlPlane)
+    monkeypatch.setenv("MXTPU_FLEET_DIR", str(tmp_path / "fleet"))
+    assert isinstance(kvstore.control_plane(),
+                      kvstore.FileControlPlane)
+
+
+# --------------------------------------------- FleetSupervisor recovery
+def _build(seed=3):
+    mx.random.seed(seed)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, activation="relu", in_units=16),
+            nn.Dense(4, in_units=8))
+    net.initialize(mx.init.Xavier())
+    net(nd.zeros((1, 16)))
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.05, "momentum": 0.9},
+                       kvstore="ici", fused=False)
+    return net, tr
+
+
+def _data(n=5, seed=0):
+    rng = np.random.RandomState(seed)
+    return [(nd.array(rng.randn(4, 16).astype(np.float32)),
+             nd.array(rng.randint(0, 4, 4).astype(np.float32)))
+            for _ in range(n)]
+
+
+_lossf = gluon.loss.SoftmaxCrossEntropyLoss()
+
+
+def _step(net, tr, on_step=None):
+    count = {"n": 0}
+
+    def step(batch):
+        count["n"] += 1
+        if on_step is not None:
+            on_step(count["n"])
+        x, y = batch
+        with autograd.record():
+            loss = _lossf(net(x), y).mean()
+        loss.backward()
+        tr.step(x.shape[0])
+        return loss
+    return step
+
+
+def test_fleet_supervisor_recovers_peer_death(tmp_path):
+    """A peer joins, beats, then goes silent mid-run: the supervisor
+    must raise HostLost into the recovery loop, run the single-survivor
+    agreement (it IS the leader), and restore the agreed step."""
+    clock = FakeClock()
+    cp = kvstore.MemoryControlPlane()
+    me = _member(0, 2, cp, clock)
+    peer = _member(1, 2, cp, clock)
+    peer.beat()
+    net, tr = _build()
+    data = _data()
+    # each applied step advances the fake clock 200ms; my own inline
+    # beat keeps me live while the silent peer expires after ~3 steps
+    step = _step(net, tr, on_step=lambda n: clock.advance(0.2))
+    sup = FleetSupervisor(tr, step, lambda: iter(data), member=me,
+                          checkpoint_dir=str(tmp_path / "ck"),
+                          checkpoint_every=2, backoff_base=0.0,
+                          emergency_save=False)
+    me.beat()
+    rep = sup.run(10)
+    assert rep["outcome"] == "completed" and rep["applied"] == 10
+    assert rep["recoveries"]["host_lost"] >= 1
+    domains = [i["domain"] for i in sup.incidents()]
+    assert "host_lost" in domains
+    # the agreement round left its keys: epoch bumped, step published
+    epoch = me.epoch()
+    assert epoch >= 1
+    assert me.agreed_rollback(epoch) is not None
+    # a peer death is detected ONCE — no budget-draining re-raise loop
+    assert rep["recoveries"]["host_lost"] == 1
+
+
+def test_fleet_supervisor_host_lost_injection(tmp_path):
+    """The rank-keyed host.lost chaos point fires at MY rank inside the
+    probe and routes through the same agreement recovery."""
+    clock = FakeClock()
+    cp = kvstore.MemoryControlPlane()
+    me = _member(0, 1, cp, clock)
+    net, tr = _build()
+    data = _data()
+    fault.inject("host.lost", at=[4], rank=0)
+    step = _step(net, tr)
+    sup = FleetSupervisor(tr, step, lambda: iter(data), member=me,
+                          checkpoint_dir=str(tmp_path / "ck"),
+                          checkpoint_every=2, backoff_base=0.0,
+                          emergency_save=False)
+    rep = sup.run(8)
+    assert rep["outcome"] == "completed"
+    assert rep["recoveries"]["host_lost"] >= 1
+    # clear() unmasked the rank during recovery bookkeeping or at test
+    # teardown; the run itself survived its own injected death
+
+
+def test_fleet_supervisor_no_manager_crashes(tmp_path):
+    """Cross-host rollback without a checkpoint manager is impossible:
+    the policy must crash-report, not limp on."""
+    clock = FakeClock()
+    cp = kvstore.MemoryControlPlane()
+    me = _member(0, 1, cp, clock)
+    net, tr = _build()
+    fault.inject("host.lost", at=[2], rank=0)
+    step = _step(net, tr)
+    sup = FleetSupervisor(tr, step, lambda: iter(_data()), member=me,
+                          checkpoint_dir=None, backoff_base=0.0,
+                          crash_dir=str(tmp_path / "crash"),
+                          emergency_save=False)
+    with pytest.raises(fault.RecoveryExhausted):
+        sup.run(8)
+
+
+def test_run_fleet_single_member(tmp_path):
+    net, tr = _build()
+    data = _data()
+    rep, sup = run_fleet(tr, _step(net, tr), lambda: iter(data), 6,
+                         rank=0, world=1,
+                         control=kvstore.MemoryControlPlane(),
+                         checkpoint_dir=str(tmp_path / "ck"),
+                         checkpoint_every=3, backoff_base=0.0,
+                         emergency_save=False)
+    assert rep["outcome"] == "completed" and rep["applied"] == 6
+    assert sup.member.rank == 0 and sup.member.world == 1
+    # the run left a heartbeat and a farewell on the control plane
+    assert sup.member.last_beat(0) is not None
+    assert sup.member.control.get("bye/0") == "1"
+
+
+def test_resumed_member_honors_published_agreement(tmp_path):
+    """The respawned-worker path: a published agreement for the current
+    epoch beats the host's own newest checkpoint on initial restore."""
+    cp = kvstore.MemoryControlPlane()
+    net, tr = _build()
+    data = _data()
+    ck = str(tmp_path / "ck")
+    rep, sup = run_fleet(tr, _step(net, tr), lambda: iter(data), 8,
+                         rank=0, world=1, control=cp,
+                         checkpoint_dir=ck, checkpoint_every=2,
+                         backoff_base=0.0, emergency_save=False)
+    assert rep["applied"] == 8      # checkpoints at 2,4,6,8 on disk
+    # the fleet decided everyone resumes from 4 (someone else's min)
+    cp.put("epoch", "1")
+    cp.put("agreed/1", "4")
+    cp.delete("bye/0")
+    net2, tr2 = _build()
+    rep2, sup2 = run_fleet(tr2, _step(net2, tr2), lambda: iter(data), 8,
+                           rank=0, world=1, control=cp,
+                           checkpoint_dir=ck, checkpoint_every=2,
+                           backoff_base=0.0, emergency_save=False)
+    assert rep2["outcome"] == "completed"
+    assert rep2["resumed_from"] == 4        # NOT its own newest (8)
+
+
+# ------------------------------------------------- the real SIGKILL drill
+@pytest.mark.slow
+def test_two_process_sigkill_drill(tmp_path):
+    """End to end over real processes: worker 0 SIGKILLs itself, the
+    launcher respawns it with MXTPU_RESTART_COUNT=1, the survivor
+    detects the death by heartbeat staleness and rolls back to the
+    agreed step, and BOTH incarnations finish."""
+    launch = os.path.join(REPO, "tools", "launch.py")
+    drill = os.path.join(REPO, "tools", "fleet_drill.py")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, launch, "-n", "2", "--max-restarts", "1",
+         sys.executable, drill, "--dir", str(tmp_path), "--die-rank",
+         "0", "--steps", "20"],
+        capture_output=True, timeout=300, env=env)
+    out = r.stdout.decode()
+    assert r.returncode == 0, (out, r.stderr.decode())
+    lines = [json.loads(ln.split("] ", 1)[1]) for ln in out.splitlines()
+             if '"fleet_drill"' in ln]
+    by_rank = {ln["rank"]: ln for ln in lines}
+    assert set(by_rank) == {0, 1}
+    survivor, reborn = by_rank[1], by_rank[0]
+    assert survivor["outcome"] == "completed"
+    assert survivor["host_lost_recoveries"] >= 1
+    assert reborn["outcome"] == "completed"
+    assert reborn["incarnation"] == 1
+    assert reborn["resumed_from"] is not None

@@ -460,6 +460,68 @@ def test_resize_same_device_set_respec_and_cycles():
         assert step.last_fallback_reason is None
 
 
+def test_resize_mesh_grow_back_is_bitwise_with_zero_host_gather():
+    """The GROW direction (1,2)->(2,2) — the supervisor's elastic
+    grow-back (ISSUE 18) — is held to the same bar as the shrink:
+    params/optimizer state bitwise across the resize, zero
+    `shard_host_gather_bytes`, and a full shrink -> grow round trip
+    lands back on the original layout bit for bit and keeps training."""
+    X, y = _data()
+    net = _build(X)
+    tr = gluon.Trainer(net.collect_params(), "adam",
+                       {"learning_rate": 0.05}, kvstore="ici")
+    tr.shard(mesh={"dp": 1, "tp": 2}, rules=_MLP_RULES)
+    step = tr.capture(lambda a, b: _lossf(net(a), b).mean())
+    for _ in range(3):
+        step(X, y)
+    w_before = _weights(net)
+
+    rb = registry().counter("shard_resharded_bytes")
+    hg = registry().counter("shard_host_gather_bytes")
+    b0, h0 = rb.value, hg.value
+    tr.resize_mesh({"dp": 2, "tp": 2})          # GROW onto new devices
+    assert rb.value > b0
+    assert hg.value == h0 == 0
+    for a, b in zip(_weights(net), w_before):
+        np.testing.assert_array_equal(a, b)
+    p0 = list(net.collect_params().values())[0].data()._data
+    assert len(p0.sharding.device_set) == 4     # now on the (2,2) mesh
+    for _ in range(2):
+        step(X, y)
+        assert step.last_fallback_reason is None
+
+    # the round trip the supervisor drives: shrink away, grow back
+    w_mid = _weights(net)
+    grown_sig = tr.shard_plan.signature()
+    tr.resize_mesh({"dp": 1, "tp": 2})
+    tr.resize_mesh({"dp": 2, "tp": 2})
+    for a, b in zip(_weights(net), w_mid):
+        np.testing.assert_array_equal(a, b)
+    assert hg.value == 0
+    # the regrown plan is a NEW object but the SAME structural layout:
+    # its signature matches, so compiled executables are reusable
+    assert tr.shard_plan.signature() == grown_sig
+    step(X, y)
+    assert step.last_fallback_reason is None
+
+
+def test_plan_signature_is_structural():
+    """Two independently-built plans with identical rules/axes/devices
+    share a signature (executable-cache reuse across a grow-back); any
+    structural difference — mesh shape, device set, rules — splits it."""
+    p1 = shard.plan({"dp": 2, "tp": 2}, rules=_MLP_RULES)
+    p2 = shard.plan({"dp": 2, "tp": 2}, rules=_MLP_RULES)
+    assert p1 is not p2 and p1.plan_id != p2.plan_id
+    assert p1.signature() == p2.signature()
+    assert p1.with_mesh({"dp": 1, "tp": 2}).signature() != p1.signature()
+    assert shard.plan({"dp": 2, "tp": 2}).signature() != p1.signature()
+    devs = list(p1.mesh.devices.flatten())
+    swapped = shard.plan(
+        {"dp": 2, "tp": 2}, rules=_MLP_RULES,
+        devices=[devs[1], devs[0]] + devs[2:])
+    assert swapped.signature() != p1.signature()
+
+
 def test_redistribute_same_mesh_respec_is_exact():
     mesh = _mesh22()
     x = jax.device_put(jnp.arange(64, dtype=jnp.float32).reshape(8, 8),

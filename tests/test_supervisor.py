@@ -386,3 +386,120 @@ def test_resume_false_over_foreign_steps_refuses(tmp_path):
         fault.run_supervised(tr2, _step(net2, tr2), lambda: iter(data), 4,
                              checkpoint_dir=str(tmp_path / "ck"),
                              resume=False, emergency_save=False)
+
+
+# ------------------------------------------- ISSUE 18: fleet + grow-back
+def test_classify_host_lost_and_domains():
+    assert fault.classify_failure(fault.HostLost(2)) == "host_lost"
+    # HostLost subclasses nothing device-ish: it must NOT be shadowed by
+    # an earlier capacity_loss match
+    assert "capacity_gain" in fault.DOMAINS
+    assert "host_lost" in fault.DOMAINS
+
+
+def test_incidents_method_and_jsonl_trail(tmp_path):
+    """Every concluded incident — even in a run that never crashes —
+    lands in `incidents()` AND as a JSON line in incidents.jsonl, so a
+    healthy run still leaves an on-disk trail."""
+    crash = tmp_path / "crash"
+    fault.inject("kv.collective", at=[5])
+    net, tr = _build()
+    rep, sup = fault.run_supervised(
+        tr, _step(net, tr), lambda: iter(_data()), 6,
+        checkpoint_dir=str(tmp_path / "ck"), backoff_base=0.0,
+        emergency_save=False, crash_dir=str(crash))
+    assert rep["outcome"] == "completed"
+    incs = sup.incidents()
+    assert incs and incs is not sup.incidents()      # a COPY
+    assert any(i["domain"] == "transient" and i.get("recovered")
+               for i in incs)
+    trail = crash / "incidents.jsonl"
+    assert trail.exists()
+    lines = [json.loads(ln) for ln in
+             trail.read_text().strip().splitlines()]
+    assert any(ln["domain"] == "transient" for ln in lines)
+    assert all("applied" in ln and "time" in ln for ln in lines)
+
+
+def _sharded_build(seed=3):
+    net, tr = _build(seed)
+    plan = tr.shard(mesh={"dp": 2, "tp": 1})
+    _lf = gluon.loss.SoftmaxCrossEntropyLoss()
+    cstep = tr.capture(lambda x, y: _lf(net(x), y).mean())
+    ids = [d.id for d in plan.mesh.devices.flatten()]
+    return net, tr, cstep, ids
+
+
+def test_regrow_when_capacity_returns(tmp_path):
+    """Device lost at step 3 shrinks the mesh; the device is unmasked at
+    step 6 (fault.clear); the probe must regrow to the ORIGINAL layout
+    and devices, count fault_regrows + a capacity_gain recovery, emit an
+    incident, and refill the restart budget."""
+    rg0 = registry().counter("fault_regrows").value
+    net, tr, cstep, ids = _sharded_build()
+    orig_axes = {k: int(v) for k, v in tr.shard_plan.mesh.shape.items()}
+    fault.inject("device.lost", at=[3], device=ids[-1])
+    count = {"n": 0}
+
+    def step(batch):
+        count["n"] += 1
+        if count["n"] >= 6 and fault.lost_devices():
+            fault.clear("device.lost")
+        return cstep(batch[0], batch[1])
+
+    rep, sup = fault.run_supervised(
+        tr, step, lambda: iter(_data(n=6)), 14,
+        checkpoint_dir=str(tmp_path / "ck"), checkpoint_every=4,
+        backoff_base=0.0, emergency_save=False,
+        regrow_cooldown=1, regrow_hysteresis=2)
+    assert rep["outcome"] == "completed"
+    assert rep["recoveries"]["capacity_loss"] >= 1
+    assert rep["recoveries"]["capacity_gain"] == 1
+    assert registry().counter("fault_regrows").value == rg0 + 1
+    assert {k: int(v)
+            for k, v in tr.shard_plan.mesh.shape.items()} == orig_axes
+    assert [d.id for d in tr.shard_plan.mesh.devices.flatten()] == ids
+    gains = [i for i in sup.incidents() if i["domain"] == "capacity_gain"]
+    assert gains and gains[0]["recovered"]
+    assert gains[0]["axes"] == orig_axes
+    # the job is whole again: the shrink's budget debit was refunded
+    assert rep["budget_remaining"] == sup.restart_budget
+
+
+def test_regrow_cooldown_gates_thrash(tmp_path):
+    """With a cooldown longer than the run there is NO regrow even
+    though capacity returned — the thrash guard holds the shrunk mesh."""
+    net, tr, cstep, ids = _sharded_build()
+    fault.inject("device.lost", at=[3], device=ids[-1])
+    count = {"n": 0}
+
+    def step(batch):
+        count["n"] += 1
+        if count["n"] >= 6 and fault.lost_devices():
+            fault.clear("device.lost")
+        return cstep(batch[0], batch[1])
+
+    rep, sup = fault.run_supervised(
+        tr, step, lambda: iter(_data(n=6)), 12,
+        checkpoint_dir=str(tmp_path / "ck"), checkpoint_every=4,
+        backoff_base=0.0, emergency_save=False,
+        regrow_cooldown=1000, regrow_hysteresis=1)
+    assert rep["outcome"] == "completed"
+    assert rep["recoveries"]["capacity_gain"] == 0
+    assert dict(tr.shard_plan.mesh.shape).get("dp") == 1   # still shrunk
+    assert sup._pre_shrink is not None          # probe stays armed
+
+
+def test_no_regrow_while_device_still_lost(tmp_path):
+    """The lost device never returns: the probe must never fire and the
+    run completes on the survivor mesh (the pre-18 behavior exactly)."""
+    net, tr, cstep, ids = _sharded_build()
+    fault.inject("device.lost", at=[3], device=ids[-1])
+    rep, sup = fault.run_supervised(
+        tr, lambda b: cstep(b[0], b[1]), lambda: iter(_data(n=6)), 10,
+        checkpoint_dir=str(tmp_path / "ck"), checkpoint_every=4,
+        backoff_base=0.0, emergency_save=False,
+        regrow_cooldown=0, regrow_hysteresis=1)
+    assert rep["outcome"] == "completed"
+    assert rep["recoveries"]["capacity_gain"] == 0
+    assert dict(tr.shard_plan.mesh.shape).get("dp") == 1

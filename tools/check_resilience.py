@@ -21,6 +21,15 @@ auto-recovers without process death:
     rule-sharded (dp=2) trainer to the survivors via
     `Trainer.resize_mesh` and training CONTINUES (no bitwise promise —
     the reduction geometry changed; finiteness + progress asserted);
+  * **capacity_gain** (fleet phase, >= 4 devices) — a (2,2) mesh loses
+    a device, shrinks to (1,2), then the device RETURNS mid-run
+    (`fault.clear` unmasks it); the grow-back probe must reverse the
+    shrink to the original (2,2) layout over the original device ids
+    with ``shard_host_gather_bytes`` pinned at zero across the whole
+    episode, refill the restart budget, and count ``fault_regrows``.
+    The pure resize round trip (no intervening steps) is additionally
+    pinned BITWISE — the parity redistribute promises; training across
+    the degraded window is finiteness-only, same as capacity_loss;
   * **exhaustion** — an unbounded NaN source against a restart budget of
     1 must exit through `RecoveryExhausted` with a parseable structured
     crash report and ``fault_restart_budget_remaining`` == 0.
@@ -311,6 +320,82 @@ def _run_phases(workdir, seed, steps):
     else:
         capacity = f"skipped ({jax.device_count()} devices)"
 
+    # ------------------------------------- fleet grow-back (capacity_gain)
+    fleet = "skipped"
+    if jax.device_count() >= 4 and steps >= 12:
+        hg0 = _metric("shard_host_gather_bytes")
+        rg0 = _metric("fault_regrows")
+        net, trainer = build(seed)
+        plan = trainer.shard(mesh={"dp": 2, "tp": 2})
+        orig_axes = {k: int(v) for k, v in plan.mesh.shape.items()}
+        orig_ids = [d.id for d in plan.mesh.devices.flatten()]
+        cstep = trainer.capture(lambda x, y: lossf(net(x), y).mean())
+        fault.inject("device.lost", at=[4], device=orig_ids[-1])
+        applied = {"n": 0}
+
+        def fleet_step(b):
+            # capacity "returns" a couple of shrunk steps after the
+            # loss: clearing the spec unmasks the lost device, which is
+            # what arms the supervisor's grow-back probe
+            if applied["n"] >= 6 and fault.lost_devices():
+                fault.clear("device.lost")
+            applied["n"] += 1
+            return cstep(b[0], b[1])
+
+        rep, sup = fault.run_supervised(
+            trainer, fleet_step, factory, steps,
+            checkpoint_dir=os.path.join(workdir, "ck_fleet"),
+            checkpoint_every=4, backoff_base=0.0, emergency_save=False,
+            restart_budget=3, regrow_cooldown=2, regrow_hysteresis=2)
+        fault.clear()
+        if rep["outcome"] != "completed" or \
+                rep["recoveries"]["capacity_loss"] < 1:
+            raise AssertionError(f"fleet phase never lost capacity: {rep}")
+        if rep["recoveries"]["capacity_gain"] < 1:
+            raise AssertionError(f"grow-back never happened: {rep}")
+        if _metric("fault_regrows") - rg0 < 1:
+            raise AssertionError("fault_regrows counter flat after regrow")
+        regrown_axes = {k: int(v)
+                        for k, v in trainer.shard_plan.mesh.shape.items()}
+        regrown_ids = [d.id
+                       for d in trainer.shard_plan.mesh.devices.flatten()]
+        if regrown_axes != orig_axes or regrown_ids != orig_ids:
+            raise AssertionError(
+                f"regrow did not restore the pre-shrink layout: "
+                f"{regrown_axes} over {regrown_ids} != {orig_axes} over "
+                f"{orig_ids}")
+        if rep["budget_remaining"] != 3:
+            raise AssertionError(
+                f"regrow did not refill the restart budget: "
+                f"{rep['budget_remaining']} != 3")
+        gains = [i for i in sup.incidents()
+                 if i["domain"] == "capacity_gain" and i.get("recovered")]
+        if not gains:
+            raise AssertionError("no capacity_gain incident recorded")
+        finals = params_list(net)
+        if not all(np.isfinite(a).all() for a in finals):
+            raise AssertionError("post-regrow params not finite")
+        # the parity redistribute DOES promise: a pure shrink -> grow
+        # round trip with no intervening optimizer steps is bitwise, and
+        # neither direction may gather through the host
+        trainer.resize_mesh({"dp": 1, "tp": 2},
+                            devices=[d for d in plan.mesh.devices.flatten()
+                                     if d.id in orig_ids[:2]])
+        trainer.resize_mesh(orig_axes,
+                            devices=list(plan.mesh.devices.flatten()))
+        assert_parity(finals, params_list(net), "fleet regrow round-trip")
+        if _metric("shard_host_gather_bytes") - hg0 != 0:
+            raise AssertionError(
+                f"grow-back episode gathered "
+                f"{_metric('shard_host_gather_bytes') - hg0} bytes "
+                f"through the host (promised zero)")
+        fleet = {"regrown_mesh": regrown_axes,
+                 "regrows": rep["recoveries"]["capacity_gain"],
+                 "final_loss": rep["final_loss"]}
+        recovered["capacity_gain"] = rep["recoveries"]["capacity_gain"]
+    else:
+        fleet = f"skipped ({jax.device_count()} devices, {steps} steps)"
+
     # ----------------------------------------------------- exhaustion
     from mxnet_tpu.observability import registry
     crash_dir = os.path.join(workdir, "crash")
@@ -362,6 +447,7 @@ def _run_phases(workdir, seed, steps):
         "recoveries": recovered,
         "preempted_after": preempted_at,
         "capacity": capacity,
+        "fleet": fleet,
         "crash_report_fields": sorted(report.keys()),
         "delta_checkpoint_fallbacks": _metric("checkpoint_fallbacks") - fb0,
         "delta_unhealthy_skips": _metric("checkpoint_unhealthy_skips")

@@ -1,0 +1,174 @@
+#!/usr/bin/env python
+"""Fleet recovery drill worker: one rank of a multi-process SIGKILL
+drill over the file control plane.
+
+Run under tools/launch.py with an elastic restart budget:
+
+    python tools/launch.py -n 2 --max-restarts 1 \
+        python tools/fleet_drill.py --dir /tmp/drill --die-rank 0
+
+Each worker trains its own small model on a single-process CPU mesh (no
+cross-process collectives — jax CPU has no multiprocess psum; what this
+drill exercises is the CONTROL plane, not the data plane) while a
+`FleetSupervisor` heartbeats into a shared `FileControlPlane` under
+``<dir>/cp``. The worker whose rank is ``--die-rank`` SIGKILLs itself at
+applied step ``--die-at`` on its FIRST incarnation only
+(``MXTPU_RESTART_COUNT`` == 0). The drill then demands both halves of
+fleet recovery:
+
+  * **survivors** — detect the dead peer by heartbeat staleness, raise
+    `HostLost` into the supervisor, bump the epoch, run the rollback
+    agreement, restore the agreed step, and finish the run;
+  * **the respawn** — the launcher re-execs the killed rank with
+    ``MXTPU_RESTART_COUNT=1``; the reborn worker waits (bounded) for the
+    published agreement and resumes from it instead of its own newest
+    checkpoint.
+
+Each worker ends by printing ONE JSON line:
+    {"metric": "fleet_drill", "rank": r, "incarnation": k,
+     "outcome": ..., "applied": n, "resumed_from": s,
+     "host_lost_recoveries": m, "final_loss": x}
+The drill passes when the launcher exits 0 and the survivor line shows
+``host_lost_recoveries >= 1`` (tests/test_fleet.py, ``-m slow``).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import time
+
+
+def _force_cpu():
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    # fast fleet timing so the drill fits in a test window; explicit env
+    # set by the caller still wins
+    os.environ.setdefault("MXTPU_FLEET_HEARTBEAT_MS", "100")
+    os.environ.setdefault("MXTPU_FLEET_DEADLINE_MS", "600")
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+
+BATCH = 8
+FEATS = 16
+CLASSES = 4
+N_BATCHES = 4
+
+
+def _build(seed):
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd, gluon
+    from mxnet_tpu.gluon import nn
+    mx.random.seed(seed)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, activation="relu", in_units=FEATS),
+            nn.Dense(CLASSES, in_units=8))
+    net.initialize(mx.init.Xavier(rnd_type="gaussian", magnitude=2.0))
+    net(nd.zeros((1, FEATS)))
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.05, "momentum": 0.9},
+                            kvstore="ici", fused=False)
+    return net, trainer
+
+
+def _data(seed):
+    import numpy as np
+    from mxnet_tpu import nd
+    rng = np.random.RandomState(seed)
+    return [(nd.array(rng.randn(BATCH, FEATS).astype(np.float32)),
+             nd.array(rng.randint(0, CLASSES, BATCH).astype(np.float32)))
+            for _ in range(N_BATCHES)]
+
+
+def run(args):
+    from mxnet_tpu import fault, gluon, kvstore
+    rank = args.rank
+    world = args.world
+    incarnation = int(os.environ.get("MXTPU_RESTART_COUNT", "0") or 0)
+    cp = kvstore.FileControlPlane(os.path.join(args.dir, "cp"))
+
+    if incarnation and args.join_wait_ms > 0:
+        # reborn worker: give the survivors a moment to publish the
+        # rollback agreement so the initial restore resumes from it
+        # (best-effort — an expired wait degrades to own-newest restore)
+        deadline = time.time() + args.join_wait_ms / 1000.0
+        while time.time() < deadline:
+            try:
+                ep = int(cp.get("epoch") or 0)
+            except ValueError:
+                ep = 0
+            if ep > 0 and cp.get(f"agreed/{ep}") is not None:
+                break
+            time.sleep(0.05)
+
+    net, trainer = _build(args.seed)
+    lossf = gluon.loss.SoftmaxCrossEntropyLoss()
+    data = _data(args.seed + rank)
+    factory = lambda: iter(data)    # noqa: E731
+    from mxnet_tpu import autograd
+    count = {"n": 0}
+
+    def step_fn(batch):
+        count["n"] += 1
+        if incarnation == 0 and rank == args.die_rank and \
+                count["n"] >= args.die_at:
+            os.kill(os.getpid(), signal.SIGKILL)   # the drill's host loss
+        x, y = batch
+        with autograd.record():
+            loss = lossf(net(x), y).mean()
+        loss.backward()
+        trainer.step(BATCH)
+        if args.step_ms:
+            time.sleep(args.step_ms / 1000.0)      # wall time: heartbeats
+        return loss
+
+    rep, sup = fault.run_fleet(
+        trainer, step_fn, factory, args.steps, rank=rank, world=world,
+        control=cp,
+        checkpoint_dir=os.path.join(args.dir, f"ck-{rank}"),
+        checkpoint_every=2, backoff_base=0.0, emergency_save=False)
+    print(json.dumps({
+        "metric": "fleet_drill",
+        "rank": rank,
+        "incarnation": incarnation,
+        "outcome": rep["outcome"],
+        "applied": rep["applied"],
+        "resumed_from": rep["resumed_from"],
+        "host_lost_recoveries": rep["recoveries"]["host_lost"],
+        "final_loss": rep["final_loss"],
+    }), flush=True)
+    return 0 if rep["outcome"] == "completed" else 1
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description="fleet SIGKILL drill worker")
+    ap.add_argument("--rank", type=int,
+                    default=int(os.environ.get("MXTPU_WORKER_ID", "0")))
+    ap.add_argument("--world", type=int,
+                    default=int(os.environ.get("MXTPU_NUM_WORKERS", "1")))
+    ap.add_argument("--dir", required=True,
+                    help="shared drill dir (control plane + checkpoints)")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--die-at", type=int, default=6,
+                    help="applied step at which --die-rank SIGKILLs "
+                         "itself (first incarnation only)")
+    ap.add_argument("--die-rank", type=int, default=0)
+    ap.add_argument("--step-ms", type=float, default=100.0,
+                    help="wall-time per step so heartbeat deadlines are "
+                         "meaningful")
+    ap.add_argument("--join-wait-ms", type=float, default=3000.0,
+                    help="how long a respawned worker waits for the "
+                         "published rollback agreement before resuming")
+    args = ap.parse_args(argv)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if repo not in sys.path:
+        sys.path.insert(0, repo)
+    _force_cpu()
+    return run(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

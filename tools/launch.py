@@ -16,6 +16,12 @@ Exported env (both spellings, so either bootstrap path works):
     MXTPU_COORDINATOR=host:port   MXTPU_NUM_WORKERS=N   MXTPU_WORKER_ID=i
     DMLC_PS_ROOT_URI=host  DMLC_PS_ROOT_PORT=port
     DMLC_NUM_WORKER=N      DMLC_WORKER_ID=i   DMLC_ROLE=worker
+    MXTPU_RESTART_COUNT=k          (incarnation; bumped by --max-restarts)
+
+``--max-restarts N`` makes the launcher elastic: a crashed worker is
+respawned in place (same rank, incarnation incremented) instead of
+tearing the job down, until its per-rank budget runs out — the process
+half of the fleet recovery drill (tools/fleet_drill.py).
 
 TPU-first design note: upstream's launcher starts a ps-lite tracker plus
 scheduler/server/worker roles. Here there are only WORKERS — the XLA
@@ -74,18 +80,34 @@ def _read_hostfile(path, n):
     return [hosts[i % len(hosts)] for i in range(n)]
 
 
-def launch(n, command, launcher="local", hostfile=None, env=None):
+def launch(n, command, launcher="local", hostfile=None, env=None,
+           max_restarts=0):
     """Spawn the workers; returns the first non-zero exit code (0 if all
-    succeed). Importable for tests."""
+    succeed). Importable for tests.
+
+    ``max_restarts`` makes the launcher ELASTIC: a worker that dies with
+    a non-zero exit (including a SIGKILL) is respawned in place — same
+    command, same rank/coordinator env, ``MXTPU_RESTART_COUNT``
+    incremented so the reborn process knows its incarnation (the fleet
+    supervisor reads it — fault/fleet.py). Only a worker that exhausts
+    its per-rank restart budget propagates failure and tears the job
+    down; the surviving workers meanwhile keep running, detect the
+    dead peer by heartbeat staleness, and agree on a rollback step, so
+    the respawned incarnation rejoins at the agreed checkpoint instead
+    of the whole gang restarting (docs/RELIABILITY.md "Fleet
+    recovery")."""
     base_env = dict(os.environ if env is None else env)
     port = _free_port()
     hosts = _read_hostfile(hostfile, n) if hostfile else ["127.0.0.1"] * n
     coord_host = hosts[0] if launcher == "ssh" else "127.0.0.1"
 
-    procs = []
+    procs = [None] * n
     threads = []
-    for rank in range(n):
+    restarts = [0] * n
+
+    def _spawn(rank):
         wenv = _worker_env(base_env, coord_host, port, n, rank)
+        wenv["MXTPU_RESTART_COUNT"] = str(restarts[rank])
         if launcher == "ssh" and hosts[rank] not in ("127.0.0.1",
                                                      "localhost"):
             exports = " ".join(
@@ -102,22 +124,34 @@ def launch(n, command, launcher="local", hostfile=None, env=None):
             p = subprocess.Popen(command, env=wenv,
                                  stdout=subprocess.PIPE,
                                  stderr=subprocess.STDOUT)
-        procs.append(p)
+        procs[rank] = p
         t = threading.Thread(target=_stream, args=(f"[worker {rank}] ",
                                                    p.stdout, sys.stdout),
                              daemon=True)
         t.start()
         threads.append(t)
 
+    for rank in range(n):
+        _spawn(rank)
+
     rc = 0
     try:
-        # propagate the FIRST failure: poll until any worker exits non-zero
+        # poll until every worker exits cleanly; a non-zero exit is
+        # respawned while its restart budget lasts, and propagates
+        # (killing the rest) once it is exhausted
         import time
         pending = set(range(n))
         while pending:
             for i in list(pending):
                 r = procs[i].poll()
                 if r is None:
+                    continue
+                if r != 0 and restarts[i] < max_restarts:
+                    restarts[i] += 1
+                    print(f"[launch] worker {i} exited rc={r}; "
+                          f"respawning (restart {restarts[i]}/"
+                          f"{max_restarts})", file=sys.stderr)
+                    _spawn(i)
                     continue
                 pending.discard(i)
                 if r != 0 and rc == 0:
@@ -129,7 +163,7 @@ def launch(n, command, launcher="local", hostfile=None, env=None):
             time.sleep(0.2)
     finally:
         for p in procs:
-            if p.poll() is None:
+            if p is not None and p.poll() is None:
                 p.kill()
         for t in threads:
             t.join(timeout=5)
@@ -144,6 +178,10 @@ def main(argv=None):
     ap.add_argument("-n", "--num-workers", type=int, required=True)
     ap.add_argument("-H", "--hostfile", default=None)
     ap.add_argument("--launcher", choices=("local", "ssh"), default="local")
+    ap.add_argument("--max-restarts", type=int, default=0,
+                    help="respawn a crashed worker in place up to N times "
+                         "(MXTPU_RESTART_COUNT incremented) before its "
+                         "failure propagates")
     ap.add_argument("command", nargs=argparse.REMAINDER)
     args = ap.parse_args(argv)
     if not args.command:
@@ -151,7 +189,7 @@ def main(argv=None):
     if args.launcher == "ssh" and not args.hostfile:
         ap.error("--launcher ssh needs -H hostfile")
     return launch(args.num_workers, args.command, launcher=args.launcher,
-                  hostfile=args.hostfile)
+                  hostfile=args.hostfile, max_restarts=args.max_restarts)
 
 
 if __name__ == "__main__":
