@@ -11,22 +11,36 @@ gap with a small, crash-only coordination layer over
 `kvstore.control_plane()` (memory / shared-file / jax-coordination
 backends, one duck-typed surface):
 
-  * **Heartbeats** — every `FleetMember` stamps ``hb/<rank>`` with its
-    wall-clock time; a member whose stamp is older than
-    ``MXTPU_FLEET_DEADLINE_MS`` (or whose rank the ``host.lost`` fault
-    point masked) is dead to the fleet. Wall clock, not monotonic:
-    stamps must compare across processes.
+  * **Heartbeats** — every `FleetMember` stamps ``hb/<rank>`` with a
+    monotonically-changing record (sequence number + wall time, the
+    latter informational); a member whose stamp has not CHANGED for
+    ``MXTPU_FLEET_DEADLINE_MS`` of the OBSERVER's own clock (or whose
+    rank the ``host.lost`` fault point masked) is dead to the fleet.
+    Liveness never compares a peer's wall-clock stamp against the local
+    clock, so cross-host clock skew or an NTP step cannot declare a
+    live peer dead — each observer ages a stamp from the moment it last
+    saw the value change.
   * **Leader election** — no Paxos: the leader IS the lowest live rank.
     Deterministic, agreement-free, and re-election after a leader loss
     is just the next liveness read. Observed transitions count into
     ``fleet_elections``.
-  * **Rollback agreement** — on a host loss every survivor bumps the
-    fleet ``epoch``, proposes its newest locally-restorable step under
+  * **Rollback agreement** — on a host loss the survivors converge on
+    ONE fleet ``epoch`` for the incident (the bump is arbitrated by a
+    put-if-absent claim keyed by the dead rank and its incarnation:
+    the first detector assigns the epoch, every later detector adopts
+    it), each proposes its newest locally-restorable step under
     ``rollback/<epoch>/<rank>``, and the leader publishes
     ``agreed/<epoch>`` = min over the proposals it collected before the
     deadline (a straggler that posts late simply finds the agreement
     already published). min() is the only safe pick: it is the newest
-    step EVERY proposer can restore.
+    step EVERY proposer can restore. As a backstop against the epoch
+    counter still splitting (it is a plain KV key), both sides of the
+    round re-poll the epoch and abandon a round the counter moved past
+    — everyone re-proposes under the current max, so survivors cannot
+    strand themselves waiting on ``agreed/<stale-epoch>``. Followers
+    wait 2x the leader's collection window by default: a leader with a
+    straggler only publishes AT its deadline, so an equal deadline
+    would time prompt followers out moments before publication.
 
 `FleetSupervisor` extends `TrainingSupervisor` with a per-step fleet
 probe (beat, watch peers, fire the ``host.lost`` chaos point) and a
@@ -86,11 +100,15 @@ class FleetMember:
     (defaults MXTPU_FLEET_HEARTBEAT_MS=500 /
     MXTPU_FLEET_DEADLINE_MS=2500 — the deadline should cover several
     missed beats so one slow filesystem write is not a death);
-    clock/sleep: injectable for deterministic tests (clock is WALL time
-    — `time.time` — because stamps compare across processes)."""
+    clock/mono/sleep: injectable for deterministic tests. `clock` is
+    WALL time (`time.time`) and only annotates the heartbeat payload;
+    `mono` (`time.monotonic`) is what liveness ages stamps and
+    agreement deadlines run on — strictly local, so cross-host clock
+    skew cannot affect either."""
 
     def __init__(self, rank, world, control=None, *, heartbeat_ms=None,
-                 deadline_ms=None, clock=time.time, sleep=time.sleep):
+                 deadline_ms=None, clock=time.time, mono=time.monotonic,
+                 sleep=time.sleep):
         from .. import kvstore as _kv
         self.rank = int(rank)
         self.world = int(world)
@@ -104,9 +122,12 @@ class FleetMember:
         self.deadline_ms = float(deadline_ms) if deadline_ms is not None \
             else _env.env_ms("MXTPU_FLEET_DEADLINE_MS", 2500.0)
         self._clock = clock
+        self._mono = mono
         self._sleep = sleep
         self._last_leader = None
         self._seen = set()            # ranks observed alive at least once
+        self._beats = 0               # local sequence: every stamp differs
+        self._hb_obs = {}             # rank -> (raw value, mono last seen)
         self._stop = threading.Event()
         self._thread = None
         self.incarnation = _env.env_int("MXTPU_RESTART_COUNT", 0,
@@ -133,8 +154,13 @@ class FleetMember:
             _hb_fail_counter.inc()
             return False
         try:
+            # seq guarantees the value changes every beat (peers detect
+            # liveness by value CHANGE, not by comparing wall clocks);
+            # t/pid ride along for humans reading the control plane
+            self._beats += 1
             self.control.put(f"hb/{self.rank}", json.dumps(
-                {"t": self._clock(), "pid": os.getpid(),
+                {"t": self._clock(), "seq": self._beats,
+                 "pid": os.getpid(),
                  "incarnation": self.incarnation}))
         except (OSError, MXNetError) as e:
             # a failed stamp is survivable by design — peers notice the
@@ -196,7 +222,7 @@ class FleetMember:
         return out
 
     def last_beat(self, rank):
-        """The decoded heartbeat record for `rank` ({"t", "pid",
+        """The decoded heartbeat record for `rank` ({"t", "seq", "pid",
         "incarnation"}) or None (never stamped / torn JSON)."""
         raw = self.control.get(f"hb/{int(rank)}")
         if raw is None:
@@ -209,33 +235,44 @@ class FleetMember:
 
     # -------------------------------------------------- liveness/leader
     def live_ranks(self, now=None):
-        """Ranks with a heartbeat younger than `deadline_ms` and not
-        masked by a fired ``host.lost`` fault point (sorted). Also
-        feeds `_seen`: dead-peer detection distinguishes "expired" from
-        "never joined"."""
-        now = self._clock() if now is None else now
+        """Ranks whose heartbeat value changed within the last
+        `deadline_ms` and that are not masked by a fired ``host.lost``
+        fault point (sorted). A stamp is aged from the moment THIS
+        observer last saw its value change, on the observer's own
+        `mono` clock — never by comparing the peer's embedded wall time
+        against the local clock — so cross-host clock skew or an NTP
+        step cannot declare a beating peer dead. (The flip side: a
+        stamp first seen already-stale counts as fresh and takes one
+        full deadline to expire — conservative, it only delays
+        detection.) Also feeds `_seen`: dead-peer detection
+        distinguishes "expired" from "never joined"."""
+        now = self._mono() if now is None else now
         masked = set(_finj.lost_hosts())
         out = []
         for r in range(self.world):
-            rec = self.last_beat(r)
-            if rec is None:
+            raw = self.control.get(f"hb/{r}")
+            if raw is None:
                 continue
             self._seen.add(r)
+            obs = self._hb_obs.get(r)
+            if obs is None or obs[0] != raw:
+                obs = (raw, now)
+                self._hb_obs[r] = obs
             if r in masked:
                 continue
-            age_ms = (now - float(rec.get("t", 0.0))) * 1000.0
+            age_ms = (now - obs[1]) * 1000.0
             if age_ms <= self.deadline_ms:
                 out.append(r)
         return out
 
     def dead_peers(self, now=None):
         """Peers (not self) that JOINED the fleet and are now dead:
-        heartbeat older than the deadline, or rank masked by
+        heartbeat unchanged past the deadline, or rank masked by
         ``host.lost``. A rank never seen is absent, not dead — a fleet
         starting up must not declare unjoined peers lost — and a rank
         that posted ``bye/<rank>`` departed cleanly, which is not a
         death either."""
-        now = self._clock() if now is None else now
+        now = self._mono() if now is None else now
         live = set(self.live_ranks(now))
         gone = self.departed()
         return sorted(r for r in self._seen
@@ -273,13 +310,36 @@ class FleetMember:
         except ValueError:
             return 0
 
-    def bump_epoch(self):
-        """Advance the epoch and return the new value. Two survivors
-        detecting the same loss concurrently both write the same
-        successor — the race converges on one epoch, which is all the
-        agreement round needs."""
+    def bump_epoch(self, incident=None):
+        """Advance the epoch and return the value this incident's
+        survivors converge on. The counter itself is a plain KV key —
+        a bare read-increment-write would let two survivors detecting
+        the same loss at different moments split across epochs (the
+        leader agreeing under one while followers wait on
+        ``agreed/<other>`` until they crash). With `incident` (a stable
+        string naming the failure — the supervisor uses
+        ``rank/<dead>/<incarnation>``) the successor is claimed exactly
+        once with put-if-absent: the FIRST detector assigns the epoch,
+        every later detector of the same incident adopts it. A repeat
+        of an identical incident name (a rank chaos-masked twice in one
+        incarnation) re-joins the original epoch's agreement, which
+        restores an older step — conservative, never divergent.
+        Without `incident` the bump is the plain read-increment-write
+        (single-caller paths and tests only)."""
+        if incident is None:
+            new = self.epoch() + 1
+            self.control.put("epoch", str(new))
+            return new
+        key = f"incident/{incident}"
         new = self.epoch() + 1
-        self.control.put("epoch", str(new))
+        if not self.control.put_new(key, str(new)):
+            try:
+                new = int(self.control.get(key))
+            except (TypeError, ValueError):
+                pass    # torn claim: keep our own successor; the
+                        # round-level epoch re-poll converges the rest
+        if new > self.epoch():
+            self.control.put("epoch", str(new))
         return new
 
     # ------------------------------------------------ rollback agreement
@@ -310,15 +370,20 @@ class FleetMember:
         you" (its own proposal, had it arrived, could only have LOWERED
         the step; min over a subset is still restorable by every
         subset member, and the straggler restores the published step or
-        dies trying)."""
+        dies trying). Returns None when the fleet epoch moves past
+        `epoch` mid-collection: the round is stale — another survivor
+        of the same incident raced the counter higher — and the caller
+        must re-propose and re-agree under the current epoch."""
         timeout_ms = self.deadline_ms if timeout_ms is None \
             else float(timeout_ms)
         expect = set(self.live_ranks() if expect is None else expect)
         expect.add(self.rank)
-        deadline = self._clock() + timeout_ms / 1000.0
+        deadline = self._mono() + timeout_ms / 1000.0
         while True:
+            if self.epoch() > int(epoch):
+                return None
             got = self.proposals(epoch)
-            if expect <= set(got) or self._clock() >= deadline:
+            if expect <= set(got) or self._mono() >= deadline:
                 break
             self._sleep(poll_ms / 1000.0)
         if not got:
@@ -348,18 +413,26 @@ class FleetMember:
 
     def wait_rollback(self, epoch, timeout_ms=None, poll_ms=50.0):
         """FOLLOWER side: poll for the leader's published agreement.
-        Returns the agreed step, or None when the deadline passes with
-        nothing published (leader died mid-agreement — the caller
-        re-enters detection, where the next liveness read elects a new
-        leader)."""
-        timeout_ms = self.deadline_ms if timeout_ms is None \
+        The DEFAULT deadline is 2x `deadline_ms` — strictly longer than
+        the leader's collection window, because a leader with a
+        straggler only publishes AT its own deadline; an equal deadline
+        would time a prompt follower out moments before publication and
+        crash it against an imminent agreement. Returns the agreed
+        step, or None when either the deadline passes with nothing
+        published (leader died mid-agreement — the caller re-enters
+        detection, where the next liveness read elects a new leader) or
+        the fleet epoch moved past `epoch` (stale round — re-propose
+        and wait under the current epoch)."""
+        timeout_ms = 2.0 * self.deadline_ms if timeout_ms is None \
             else float(timeout_ms)
-        deadline = self._clock() + timeout_ms / 1000.0
+        deadline = self._mono() + timeout_ms / 1000.0
         while True:
             step = self.agreed_rollback(epoch)
             if step is not None:
                 return step
-            if self._clock() >= deadline:
+            if self.epoch() > int(epoch):
+                return None
+            if self._mono() >= deadline:
                 return None
             self._sleep(poll_ms / 1000.0)
 
@@ -419,15 +492,27 @@ class FleetSupervisor(TrainingSupervisor):
 
     # -------------------------------------------------- host_lost policy
     def _host_lost_recover(self, exc):
-        """Survivor-side host-loss recovery: bump the epoch, run the
-        rollback agreement, optionally re-bootstrap the distributed
-        runtime, and restore the agreed step exactly."""
+        """Survivor-side host-loss recovery: converge on the incident's
+        epoch, run the rollback agreement, optionally re-bootstrap the
+        distributed runtime, and restore the agreed step exactly.
+
+        Epoch convergence is two-layered. The bump is arbitrated by a
+        put-if-absent claim keyed by the dead rank and its incarnation,
+        so survivors detecting the same loss at different moments adopt
+        the first detector's epoch instead of splitting the counter.
+        And should the counter still move past a round (the claim key
+        differs — e.g. two distinct deaths overlap), both sides of the
+        agreement re-poll the epoch, abandon the stale round (None),
+        and this loop re-proposes under the current epoch — so a
+        follower can never strand itself waiting on
+        ``agreed/<stale-epoch>`` while the leader agrees elsewhere."""
         if self._mgr is None:
             self._crash(exc, "host_lost",
                         "no checkpoint manager configured — cross-host "
                         "rollback impossible")
         m = self.member
-        if getattr(exc, "rank", None) == m.rank:
+        dead = getattr(exc, "rank", None)
+        if dead == m.rank:
             # OUR own death (the rank-keyed host.lost chaos point): this
             # rollback IS the in-place restart, so the member unmasks
             # itself — leaving the mask on would exclude it from its own
@@ -435,24 +520,51 @@ class FleetSupervisor(TrainingSupervisor):
             # agreement. Genuinely dead peers stay masked.
             _finj.reset_lost_hosts(m.rank)
             m.beat()
-        epoch = m.bump_epoch()
+        incident = None
+        if dead is not None:
+            # the dead peer's record is stable (it stopped writing at
+            # least a deadline ago), so every detector derives the same
+            # incident name from it
+            rec = m.last_beat(dead) or {}
+            incident = f"rank/{dead}/{rec.get('incarnation', 0)}"
+        epoch = m.bump_epoch(incident=incident)
         healthy = self._mgr.healthy_steps()
         own = max(healthy) if healthy else 0
-        m.propose_rollback(epoch, own)
-        if m.is_leader():
-            agreed = m.agree_rollback(epoch)
-        else:
-            agreed = m.wait_rollback(epoch)
-            if agreed is None:
-                # the leader died mid-agreement; if WE are the new
-                # leader, publish — else this episode is unrecoverable
-                # from here (the next detection round re-enters)
-                if m.is_leader():
-                    agreed = m.agree_rollback(epoch)
-                else:
-                    self._crash(exc, "host_lost",
-                                f"no rollback agreement published for "
-                                f"epoch {epoch} within the deadline")
+        agreed = None
+        rounds = 0
+        while agreed is None:
+            rounds += 1
+            if rounds > max(4, 2 * m.world):
+                self._crash(exc, "host_lost",
+                            f"rollback agreement failed to converge "
+                            f"after {rounds - 1} rounds (epoch {epoch})")
+            m.beat()    # rounds can outlast the deadline; stay live
+            m.propose_rollback(epoch, own)
+            if m.is_leader():
+                agreed = m.agree_rollback(epoch)
+            else:
+                agreed = m.wait_rollback(epoch)
+            if agreed is not None:
+                break
+            cur = m.epoch()
+            if cur > epoch:
+                # the counter moved past this round: converge on the
+                # incident's final epoch and re-run under it
+                epoch = cur
+                continue
+            if m.is_leader():
+                # the leader died mid-agreement and WE are its
+                # successor: publish (None again = epoch moved, loop)
+                agreed = m.agree_rollback(epoch)
+                if agreed is not None:
+                    break
+                cur = m.epoch()
+                if cur > epoch:
+                    epoch = cur
+                    continue
+            self._crash(exc, "host_lost",
+                        f"no rollback agreement published for "
+                        f"epoch {epoch} within the deadline")
         if _tracer.ACTIVE:
             _tracer.instant("fault.fleet_rollback", cat="fault",
                             args={"epoch": epoch, "agreed": int(agreed),

@@ -312,9 +312,17 @@ class ControlPlane:
     """Duck-typed key-value surface for fleet coordination. Values are
     strings (callers JSON-encode structure). `put` must be atomic at
     key granularity: a concurrent `get` sees the old or the new value,
-    never a torn write."""
+    never a torn write. `put_new` must be atomic put-if-absent: of N
+    concurrent callers exactly one creates the key — the arbitration
+    primitive first-detector-wins races (fleet epoch claims) build on."""
 
     def put(self, key, value):
+        raise NotImplementedError
+
+    def put_new(self, key, value):
+        """Create `key` with `value` iff it does not exist. Returns True
+        when THIS call created it, False when the key already existed
+        (the existing value is untouched)."""
         raise NotImplementedError
 
     def get(self, key, default=None):
@@ -340,6 +348,13 @@ class MemoryControlPlane(ControlPlane):
     def put(self, key, value):
         with self._mu:
             self._data[str(key)] = str(value)
+
+    def put_new(self, key, value):
+        with self._mu:
+            if str(key) in self._data:
+                return False
+            self._data[str(key)] = str(value)
+            return True
 
     def get(self, key, default=None):
         with self._mu:
@@ -392,6 +407,32 @@ class FileControlPlane(ControlPlane):
             except OSError:
                 pass
             raise
+
+    def put_new(self, key, value):
+        # write the tmp file fully, then hard-link it to the final name:
+        # link() fails with EEXIST when the key exists (atomic
+        # put-if-absent) and readers of a created key never see a torn
+        # value (the name only appears after the write completed)
+        import errno
+        import os
+        import tempfile
+        fd, tmp = tempfile.mkstemp(prefix=".cp-", dir=self.directory)
+        try:
+            with os.fdopen(fd, "w") as f:
+                f.write(str(value))
+            try:
+                os.link(tmp, os.path.join(self.directory,
+                                          self._fname(key)))
+            except OSError as e:
+                if e.errno == errno.EEXIST:
+                    return False
+                raise
+            return True
+        finally:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
 
     def get(self, key, default=None):
         import os
@@ -447,6 +488,17 @@ class DistributedControlPlane(ControlPlane):
         self._client.key_value_set(self.NAMESPACE + str(key), str(value),
                                    allow_overwrite=True)
 
+    def put_new(self, key, value):
+        try:
+            self._client.key_value_set(self.NAMESPACE + str(key),
+                                       str(value), allow_overwrite=False)
+        except Exception as e:
+            msg = str(e)
+            if "ALREADY_EXISTS" in msg or "already exists" in msg:
+                return False
+            raise
+        return True
+
     def get(self, key, default=None):
         # the client only exposes a BLOCKING get; a short deadline turns
         # it into a poll (absent key -> timeout error -> default). The
@@ -455,8 +507,17 @@ class DistributedControlPlane(ControlPlane):
         try:
             return self._client.blocking_key_value_get(
                 self.NAMESPACE + str(key), timeout_ms)
-        except Exception:
-            return default
+        except Exception as e:
+            # ONLY the poll expiry means "absent key". A genuine
+            # coordination-service failure must propagate: swallowed
+            # into `default` it would make every previously-seen peer
+            # look dead at once (a spurious HostLost storm) and an
+            # agreement read look permanently unpublished.
+            msg = str(e)
+            if "DEADLINE_EXCEEDED" in msg or "NOT_FOUND" in msg \
+                    or "deadline exceeded" in msg.lower():
+                return default
+            raise
 
     def keys(self, prefix=""):
         pairs = self._client.key_value_dir_get(self.NAMESPACE + prefix)

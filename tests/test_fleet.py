@@ -50,6 +50,9 @@ class FakeClock:
 def _member(rank, world, cp, clock, **kw):
     kw.setdefault("heartbeat_ms", 100.0)
     kw.setdefault("deadline_ms", 500.0)
+    # clock doubles as the monotonic observation clock: liveness ages
+    # stamps on `mono`, the wall `clock` only annotates the payload
+    kw.setdefault("mono", clock)
     return FleetMember(rank, world, control=cp, clock=clock,
                        sleep=clock.sleep, **kw)
 
@@ -208,12 +211,67 @@ def test_agree_rollback_without_proposals_raises():
 
 
 def test_epoch_bump_converges():
+    members, clock, _ = _fleet(3)
+    # every survivor detecting the SAME incident gets the SAME epoch no
+    # matter how their read-increment-writes interleave: the
+    # put-if-absent incident claim arbitrates, first detector wins
+    assert members[0].bump_epoch(incident="rank/2/0") == 1
+    assert members[1].bump_epoch(incident="rank/2/0") == 1
+    assert members[0].epoch() == members[1].epoch() == 1
+    # a DIFFERENT incident advances the fleet to a fresh epoch
+    assert members[1].bump_epoch(incident="rank/2/1") == 2
+    assert members[0].epoch() == 2
+
+
+def test_late_detector_adopts_incident_epoch_and_agreement():
+    """Survivor A bumped, led, and published before survivor B even
+    detected the loss: B's bump must adopt A's epoch (same incident
+    claim) and find the agreement already waiting there — not mint a
+    fresh epoch and wait forever on agreed/<it>."""
+    members, clock, _ = _fleet(3)
+    for m in members:
+        m.beat()
+    a, b = members[0], members[1]
+    ep_a = a.bump_epoch(incident="rank/2/0")
+    a.propose_rollback(ep_a, 6)
+    assert a.agree_rollback(ep_a) == 6
+    ep_b = b.bump_epoch(incident="rank/2/0")
+    assert ep_b == ep_a
+    assert b.agreed_rollback(ep_b) == 6
+
+
+def test_agreement_round_follows_epoch_moves():
+    """Both sides of a round abandon a stale epoch (return None) as soon
+    as the fleet counter moves past it, instead of burning their whole
+    deadline waiting under an epoch nobody will publish to."""
+    members, clock, _ = _fleet(3)
+    for m in members:
+        m.beat()
+    members[0].propose_rollback(1, 7)
+    members[0].control.put("epoch", "2")
+    assert members[0].agree_rollback(1, timeout_ms=60_000.0) is None
+    assert members[2].wait_rollback(1, timeout_ms=60_000.0) is None
+
+
+def test_follower_wait_outlasts_leader_collection_window():
+    """wait_rollback's DEFAULT deadline is 2x the leader's straggler
+    window: a leader that only publishes AT its deadline (a live rank
+    never proposed) must not time out its prompt followers. Emulate the
+    leader publishing just AFTER one full deadline_ms (0.5s) of the
+    follower's wait — inside the 2x default, past the old 1x one."""
     members, clock, _ = _fleet(2)
-    # two survivors detecting the same loss concurrently write the same
-    # successor — the race converges on one epoch
-    assert members[0].bump_epoch() == 1
-    assert members[1].bump_epoch() == 2
-    assert members[0].epoch() == members[1].epoch() == 2
+    follower = members[1]
+    members[0].control.put("epoch", "1")
+    published = {"done": False}
+    orig_sleep = clock.sleep
+
+    def sleep(dt):
+        orig_sleep(dt)
+        if not published["done"] and clock.t >= 1000.55:
+            members[0].control.put("agreed/1", "4")
+            published["done"] = True
+    follower._sleep = sleep
+    assert follower.wait_rollback(1) == 4
 
 
 # ------------------------------------------------- control-plane backends
@@ -233,6 +291,44 @@ def test_file_control_plane_roundtrip(tmp_path):
     # no tmp droppings from the atomic writes
     assert not [f for f in os.listdir(str(tmp_path / "cp"))
                 if f.startswith(".cp-")]
+
+
+def test_control_plane_put_new(tmp_path):
+    for cp in (kvstore.MemoryControlPlane(),
+               kvstore.FileControlPlane(str(tmp_path / "cp"))):
+        assert cp.put_new("claim", "a")
+        assert not cp.put_new("claim", "b")
+        assert cp.get("claim") == "a"       # the loser did not clobber
+        cp.delete("claim")
+        assert cp.put_new("claim", "c")     # deletable, then reclaimable
+    # no tmp droppings from the file backend's link-based create
+    assert not [f for f in os.listdir(str(tmp_path / "cp"))
+                if f.startswith(".cp-")]
+
+
+def test_liveness_immune_to_cross_host_clock_skew():
+    """Liveness ages a stamp from when the OBSERVER last saw its value
+    change (observer's own clock), never by comparing the peer's
+    embedded wall time against the local clock: a peer whose wall clock
+    is hours off stays live as long as its beats keep landing, and
+    silence is still detected by the value going unchanged."""
+    members, clock, cp = _fleet(2)
+    members[0].beat()
+    skewed = FakeClock(t=clock.t - 4 * 3600.0)      # 4h in the past
+    far = FleetMember(1, 2, control=cp, clock=skewed, mono=clock,
+                      sleep=clock.sleep, heartbeat_ms=100.0,
+                      deadline_ms=500.0)
+    far.beat()
+    assert members[0].live_ranks() == [0, 1]
+    for _ in range(3):
+        clock.advance(0.3)
+        skewed.advance(0.3)
+        far.beat()
+        members[0].beat()
+        assert members[0].dead_peers() == []
+    clock.advance(0.6)                  # now it really goes silent
+    members[0].beat()
+    assert members[0].dead_peers() == [1]
 
 
 def test_control_plane_factory(tmp_path, monkeypatch):
@@ -392,6 +488,50 @@ def test_resumed_member_honors_published_agreement(tmp_path):
                            backoff_base=0.0, emergency_save=False)
     assert rep2["outcome"] == "completed"
     assert rep2["resumed_from"] == 4        # NOT its own newest (8)
+
+
+def test_fleet_supervisor_follows_epoch_move_mid_wait(tmp_path):
+    """The review scenario end to end: a follower that bumped to its own
+    (now stale) epoch and is waiting for agreed/<it> must abandon the
+    wait when the counter moves, re-propose under the leader's epoch,
+    and find the agreement there — instead of timing out and crashing
+    with RecoveryExhausted while healthy."""
+    clock = FakeClock()
+    cp = kvstore.MemoryControlPlane()
+    me = _member(1, 3, cp, clock)
+    leader = _member(0, 3, cp, clock)
+    victim = _member(2, 3, cp, clock)
+    leader.beat()
+    victim.beat()                   # then silent: the host we lose
+    net, tr = _build()
+    data = _data()
+
+    state = {"fired": False}
+    orig_sleep = clock.sleep
+
+    def sleep(dt):
+        orig_sleep(dt)
+        leader.beat()               # the leader host stays live
+        if not state["fired"] and cp.get("rollback/1/1") is not None:
+            # I proposed under epoch 1; the leader meanwhile raced the
+            # counter to 2 and published its agreement THERE
+            cp.put("epoch", "2")
+            cp.put("rollback/2/0", "2")
+            cp.put("agreed/2", "2")
+            state["fired"] = True
+    me._sleep = sleep
+    step = _step(net, tr, on_step=lambda n: (clock.advance(0.2),
+                                             leader.beat()))
+    sup = FleetSupervisor(tr, step, lambda: iter(data), member=me,
+                          checkpoint_dir=str(tmp_path / "ck"),
+                          checkpoint_every=2, backoff_base=0.0,
+                          emergency_save=False)
+    me.beat()
+    rep = sup.run(10)
+    assert rep["outcome"] == "completed" and rep["applied"] == 10
+    assert rep["recoveries"]["host_lost"] >= 1
+    assert state["fired"]           # the stale-epoch wait really ran
+    assert me.epoch() == 2          # and converged on the leader's epoch
 
 
 # ------------------------------------------------- the real SIGKILL drill
