@@ -393,6 +393,27 @@ def main():
                 result["rec_vs_replicated"] = rres["rec_vs_replicated"]
         except Exception as e:  # pragma: no cover
             print(f"[bench] rec bench failed: {e!r}", file=sys.stderr)
+        # ISSUE 19: the tiered-embedding arm — DLRM steps/s at a FIXED
+        # HBM budget (per-shard rows >> hbm_rows, so the table cannot
+        # be device-resident), with the hot-cache hit rate and the
+        # async H2D row-staging bytes each step costs. Same honesty
+        # contract: fields OMITTED below 4 devices, never faked; own
+        # guard so a tiered failure can't take down the rec fields
+        # above.
+        try:
+            import bench_rec
+            tres = bench_rec.measure_tiered()
+            if tres.get("value") is not None:
+                result["rec_tiered_step_throughput"] = tres["value"]
+                result["rec_tiered_hit_rate"] = \
+                    tres["rec_tiered_hit_rate"]
+                result["rec_tiered_h2d_bytes_per_step"] = \
+                    tres["rec_tiered_h2d_bytes_per_step"]
+                result["rec_tiered_resident_frac"] = \
+                    tres["rec_tiered_resident_frac"]
+        except Exception as e:  # pragma: no cover
+            print(f"[bench] tiered rec bench failed: {e!r}",
+                  file=sys.stderr)
         # ISSUE 18: the elastic grow-back episode — shrink/regrow
         # resharding latency plus the fleet counters of a supervised
         # shrink -> regrow round trip. Same honesty contract: fields

@@ -23,12 +23,20 @@ Two arms on the same model, data and captured-step protocol:
     'dp' mesh: tables whole on every device, dense take, dense O(vocab)
     gradient. This is the SURVEY §8 layout the sharded arm retires.
 
+A third arm (ISSUE 19, `--tiered` / `measure_tiered`) trains a tiered
+table at a FIXED HBM budget: per-shard rows exceed `hbm_rows`, so the
+full table cannot be device-resident and every step runs through the
+host tier + engine-prefetched hot cache (`shard/tiered.py`), fed by the
+`RowPrefetcher`.
+
 Needs >= 4 devices (a (2,2) mesh); below that `value: None` so the
 bench.py supervisor fields (`rec_step_throughput`,
-`rec_embed_bytes_per_dev`, `rec_vs_replicated`) are omitted honestly
-rather than faked — the BENCH_SHARD=0 pattern.
+`rec_embed_bytes_per_dev`, `rec_vs_replicated`, and the `rec_tiered_*`
+set) are omitted honestly rather than faked — the BENCH_SHARD=0
+pattern.
 
-Standalone: `python bench_rec.py` prints ONE JSON line.
+Standalone: `python bench_rec.py` prints ONE JSON line;
+`python bench_rec.py --tiered` runs the fixed-HBM tiered arm instead.
 """
 from __future__ import annotations
 
@@ -213,6 +221,122 @@ def measure(on_result=None):
     return res
 
 
+def measure_tiered(on_result=None):
+    """The fixed-HBM arm (ISSUE 19): ONE tiered `ShardedEmbedding`
+    table whose per-shard rows EXCEED its hbm_rows budget — the full
+    table cannot be device-resident, which is the tier's reason to
+    exist — trained end-to-end through the `RowPrefetcher`-fed captured
+    step (host-resident cold rows, engine-prefetched hot cache;
+    docs/PERFORMANCE.md "Tiered embeddings"). Headline is samples/sec/
+    chip AT the fixed HBM budget, alongside the cache hit rate the
+    Poisson-ish traffic earns and the async H2D row-staging bytes each
+    step costs. `value: None` below 4 devices — the omit-honestly
+    pattern."""
+    import jax
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, nd
+    from mxnet_tpu.prefetch import RowPrefetcher
+    from mxnet_tpu.shard import tiered as _tiered
+
+    if len(jax.devices()) < 4:
+        res = {"metric": "rec_tiered_step_throughput", "value": None,
+               "unit": "samples/sec/chip",
+               "skipped": "needs >= 4 devices"}
+        print("[bench_rec] tiered arm skipped (needs >= 4 devices)",
+              file=sys.stderr)
+        if on_result is not None:
+            on_result(res)
+        return res
+
+    on_tpu = jax.default_backend() == "tpu"
+    V, D, F = 8192, 32, 4
+    HBM_ROWS = 256            # per-'tp'-shard rows = V/2 = 4096 >> 256
+    batch = 256 if on_tpu else 32
+    steps = 30 if on_tpu else 6
+
+    rng = np.random.RandomState(7)
+    # Poisson-ish categorical traffic (hot centre + long tail) so the
+    # cache hit rate is a property of the workload, not of uniform draws
+    idx = (rng.poisson(64, size=(8, batch, F)) % V).astype(np.int32)
+    yb = rng.randn(8, batch, 1).astype(np.float32)
+
+    class _TieredDLRM(gluon.nn.HybridBlock):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            with self.name_scope():
+                self.embed = gluon.nn.ShardedEmbedding(
+                    V, D, tiered=True, hbm_rows=HBM_ROWS)
+                self.top = gluon.nn.Dense(1, in_units=F * D)
+
+        def hybrid_forward(self, F_, i):
+            return self.top(self.embed(i).reshape((i.shape[0], -1)))
+
+    mx.random.seed(0)
+    net = _TieredDLRM()
+    net.initialize(mx.init.Xavier())
+    lossf = gluon.loss.L2Loss()
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.05}, kvstore="ici")
+    tr.shard(mesh={"dp": 2, "tp": 2})
+    step = tr.capture(lambda i, y: lossf(net(i), y).mean())
+    shard_rows = V // 2       # rows each 'tp' shard owns in the host tier
+
+    def feed(n):
+        for k in range(n):
+            j = k % 8
+            yield nd.array(idx[j], dtype=np.int32), nd.array(yb[j])
+
+    # compile + warm THROUGH the prefetcher: tiered steps only dispatch
+    # behind a RowPrefetcher (the loud no-prefetcher error is the point)
+    with RowPrefetcher(feed(2), tr, tables={0: net.embed}) as pf:
+        for ib, y in pf:
+            L = step(ib, y)
+    fallback = step.last_fallback_reason
+
+    h2d0 = _tiered._h2d_b.value
+    hits0, miss0 = _tiered._hits_c.value, _tiered._miss_c.value
+    t0 = time.monotonic()
+    with RowPrefetcher(feed(steps), tr, tables={0: net.embed}) as pf:
+        for ib, y in pf:
+            L = step(ib, y)
+    float(L.asnumpy())
+    dt = time.monotonic() - t0
+    hits = _tiered._hits_c.value - hits0
+    miss = _tiered._miss_c.value - miss0
+    hit_rate = hits / max(1, hits + miss)
+    h2d_step = (_tiered._h2d_b.value - h2d0) / steps
+    steps_s = steps / dt
+    if fallback is not None:
+        print(f"[bench_rec] WARNING: tiered arm fell back ({fallback})",
+              file=sys.stderr)
+
+    res = {
+        "metric": "rec_tiered_step_throughput",
+        "value": round(steps_s * batch / 4, 1),
+        "unit": "samples/sec/chip",
+        "vs_baseline": round(steps_s * batch / 4 / BASELINE_SAMPLES_S,
+                             4),
+        "mesh": {"dp": 2, "tp": 2},
+        "rec_tiered_steps_s": round(steps_s, 3),
+        "rec_tiered_hit_rate": round(hit_rate, 4),
+        "rec_tiered_h2d_bytes_per_step": int(h2d_step),
+        "rec_tiered_hbm_rows": HBM_ROWS,
+        "rec_tiered_shard_rows": shard_rows,
+        "rec_tiered_resident_frac": round(HBM_ROWS / shard_rows, 4),
+        "fallback": fallback,
+    }
+    print(f"[bench_rec] tiered {steps_s:.2f} steps/s at a "
+          f"{HBM_ROWS}/{shard_rows}-row HBM budget "
+          f"({res['rec_tiered_resident_frac']:.3f}x resident); hit "
+          f"rate {hit_rate:.2f}; {int(h2d_step)} async H2D B/step",
+          file=sys.stderr)
+    if on_result is not None:
+        on_result(res)
+    return res
+
+
 def main():
     # fork CPU devices BEFORE jax imports so the (2,2) mesh exists on a
     # laptop/CI run (no-op when jax is already in, e.g. under bench.py)
@@ -223,7 +347,10 @@ def main():
         os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
                                    + " --xla_force_host_platform_"
                                      "device_count=4")
-    res = measure()
+    if "--tiered" in sys.argv[1:]:
+        res = measure_tiered()
+    else:
+        res = measure()
     print(json.dumps(res))
     return 0
 

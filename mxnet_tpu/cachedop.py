@@ -84,7 +84,7 @@ _fallback_counters = {}  # reason -> Counter cachedop_fallbacks{reason=}
 # cache-key layout; positions feed miss-reason classification
 _KEY_FIELDS = ("shape_change", "param_change", "state_change", "scale_mode",
                "hyper_change", "autocast", "mesh", "sharded", "grad_reduce",
-               "clip", "plan", "sparse")
+               "clip", "plan", "sparse", "tiered")
 
 
 def _mesh_fingerprint(mesh):
@@ -440,6 +440,19 @@ class CachedStep:
         from .shard import embedding as _semb
         sparse_info = _semb.sparse_eligibility(plan, diff, opt)
 
+        # tiered tables (ISSUE 19): a converted parameter's live data IS
+        # the hot cache — it can only train through the captured sparse
+        # path fed by a RowPrefetcher, never imperatively
+        tiered_ks = {k: p._tiered_state for k, (i, p) in enumerate(diff)
+                     if getattr(p, "_tiered_state", None) is not None}
+        if tiered_ks and plan is None:
+            names = sorted(diff[k][1].name for k in tiered_ks)
+            raise MXNetError(
+                f"tiered embedding tables {names} can only train under "
+                f"an active shard plan (the live parameter is the hot "
+                f"cache, not the logical table); call Trainer.shard "
+                f"and capture the step")
+
         updater = tr._updater
         state_nds = []
         for i, p in diff:
@@ -465,6 +478,7 @@ class CachedStep:
             None if opt.clip_gradient is None else float(opt.clip_gradient),
             None if plan is None else plan.signature(),
             tuple(sorted((k, v["axis"]) for k, v in sparse_info.items())),
+            tuple(sorted(tiered_ks)),
         )
         entry = self._cache.get(key)
         if entry is None:
@@ -473,7 +487,7 @@ class CachedStep:
             self._last_key = key
             try:
                 entry = self._build(batch_nd, diff, state_nds, scale_mode,
-                                    spec, plan, sparse_info)
+                                    spec, plan, sparse_info, tiered_ks)
             except _CaptureUnsupported as e:
                 # negative-cache the failure: later steps with the same
                 # signature skip straight to the imperative path instead
@@ -512,7 +526,7 @@ class CachedStep:
 
     # ------------------------------------------------------------ build
     def _build(self, batch_nd, diff, state_nds, scale_mode, spec,
-               plan=None, sparse_info=None):
+               plan=None, sparse_info=None, tiered_ks=None):
         tr = self._trainer
         opt = tr._optimizer
         kv = tr._kvstore
@@ -523,6 +537,7 @@ class CachedStep:
         from .shard import moe as _smoe
         from jax.sharding import PartitionSpec as P
         sparse_info = sparse_info or {}
+        tiered_ks = tiered_ks or {}
 
         diff_ids = {id(p) for _, p in diff}
         diff_params = [p for _, p in diff]
@@ -713,8 +728,54 @@ class CachedStep:
         live_ks = sorted(sparse_live)
         dense_ks = [k for k in range(n_diff) if k not in sparse_live]
 
+        # tiered hot caches (ISSUE 19) are hard-wired to the sparse fast
+        # path: a tiered table that fell off it (demoted by a direct
+        # table reference, tied weights, or no recorded lookup) cannot
+        # train — the dense path would read the cache as if it were the
+        # logical table. Loud, no fallback.
+        tiered_live = sorted(tiered_ks)
+        for k in tiered_live:
+            if k not in sparse_live:
+                raise MXNetError(
+                    f"tiered embedding {diff_params[k].name!r} did not "
+                    f"take the sparse fast path this step (demoted by a "
+                    f"direct table reference, or the table was never "
+                    f"looked up) — a tiered table trains only through "
+                    f"the sparse lookup; remove direct uses of the "
+                    f"weight from loss_fn")
+        if tiered_live:
+            meta["tiered"] = [
+                (k, int(sparse_live[k]["n_flat"]),
+                 2 + sum(bool(b) for b in tiered_ks[k].row_like))
+                for k in tiered_live]
+
         def program(batch_vals, diff_vals, nondiff_vals, state_vals, rng,
-                    lrs, wds, rescale, inv_scale, loss_scale, poison):
+                    lrs, wds, rescale, inv_scale, loss_scale, poison,
+                    tiered_vals=()):
+            if tiered_vals:
+                # scatter the prefetcher's staged cold rows into their
+                # slots FIRST — the record pass, lookup, and scatter-add
+                # update below all see the filled cache. Sentinel slot
+                # ids (== n_slots) drop; an all-hit step scatters an
+                # all-sentinel block (pure device no-op after fusion).
+                diff_vals = list(diff_vals)
+                state_vals = [list(sv) for sv in state_vals]
+                off = 0
+                for k in tiered_live:
+                    ts = tiered_ks[k]
+                    ax = sparse_live[k]["axis"]
+                    inc_slots = tiered_vals[off]
+                    inc_rows = tiered_vals[off + 1]
+                    off += 2
+                    diff_vals[k] = _semb.scatter_rows(
+                        diff_vals[k], inc_slots, inc_rows, plan.mesh, ax)
+                    for j, rl in enumerate(ts.row_like):
+                        if not rl:
+                            continue
+                        state_vals[k][j] = _semb.scatter_rows(
+                            state_vals[k][j], inc_slots,
+                            tiered_vals[off], plan.mesh, ax)
+                        off += 1
             se = {}
             if sparse_live:
                 # discovery pass with CONCRETE tracers: record each
@@ -1156,6 +1217,33 @@ class CachedStep:
                                               nondiff_vals[j])
         args = (batch_vals, diff_vals, nondiff_vals, state_vals,
                 rng, lrs, wds, rescale, inv_scale, loss_scale, poison)
+        if meta.get("tiered"):
+            # consume the RowPrefetcher's staged cold-row plan for this
+            # step (already committed replicated on the mesh — passing
+            # it costs no placement here). The contract is strict
+            # depth-1: exactly one planned batch per dispatch.
+            tiered_vals = []
+            for k, n_flat, n_blocks in meta["tiered"]:
+                ts = diff[k][1]._tiered_state
+                prod = ts.take_pending()
+                if prod is None:
+                    raise MXNetError(
+                        f"tiered embedding {diff[k][1].name!r}: no "
+                        f"staged row plan for this step — feed the "
+                        f"training loop through prefetch.RowPrefetcher "
+                        f"(raw index batches cannot address the hot "
+                        f"cache)")
+                if len(prod) != n_blocks or \
+                        int(prod[0].shape[0]) != n_flat:
+                    raise MXNetError(
+                        f"tiered embedding {diff[k][1].name!r}: staged "
+                        f"row plan shape ({len(prod)} blocks, "
+                        f"{int(prod[0].shape[0])} ids) does not match "
+                        f"the captured step ({n_blocks} blocks, "
+                        f"{n_flat} ids) — the prefetcher must translate "
+                        f"exactly this step's index batch, once")
+                tiered_vals.extend(prod)
+            args = args + (tuple(tiered_vals),)
         fresh = meta.pop("fresh", False)
         try:
             if fresh:
@@ -1268,6 +1356,14 @@ class CachedStep:
         for sv_nd, sv_new in zip(state_nds, new_ss):
             for s_nd, s_val in zip(sv_nd, sv_new):
                 s_nd._rebind(s_val)
+
+        # step k is dispatched and every NDArray handle points at its
+        # post-step buffer: wake the RowPrefetcher so batch k+1's row
+        # plan resolves overlapped with this step's device compute (its
+        # writeback np.asarray blocks until the compute lands — the
+        # data-flow barrier)
+        for k, _n, _b in meta.get("tiered") or ():
+            diff[k][1]._tiered_state.notify_step()
 
         applied = True
         if meta["guard"]:

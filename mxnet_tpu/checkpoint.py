@@ -127,7 +127,8 @@ def _walk_files(root):
             yield os.path.relpath(full, root), full
 
 
-def _write_manifest(root, step, partition_specs=None, quantization=None):
+def _write_manifest(root, step, partition_specs=None, quantization=None,
+                    tiered=None):
     """Checksum every file under `root` into manifest.json (written last:
     its presence marks the payload complete *before* the dir rename makes
     the step visible — two commit barriers, either catches a tear).
@@ -150,6 +151,12 @@ def _write_manifest(root, step, partition_specs=None, quantization=None):
         manifest["partition_specs"] = dict(partition_specs)
     if quantization:
         manifest["quantization"] = dict(quantization)
+    if tiered:
+        # tiered embedding tables (ISSUE 19): the payload holds the FULL
+        # flushed logical table; this records which leaves restore back
+        # through a hot cache (shard/tiered.py) — resize-proof, since
+        # the logical table never depends on the mesh
+        manifest["tiered"] = dict(tiered)
     path = os.path.join(root, MANIFEST_NAME)
     tmp = path + ".tmp"
     with open(tmp, "w") as f:
@@ -257,6 +264,19 @@ def saved_partition_specs(directory, step=None):
     if specs is None:
         return None
     return {k: spec_from_json(v) for k, v in specs.items()}
+
+
+def saved_tiered(directory, step=None):
+    """The tiered-table manifest entry of a checkpoint
+    ({leaf name -> {vocab, dim, hbm_rows, dtype}}), or None for a save
+    with no tiered tables (shard/tiered.py; ISSUE 19)."""
+    path = directory if step is None else _step_path(directory, step)
+    try:
+        with open(os.path.join(path, MANIFEST_NAME)) as f:
+            manifest = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+    return manifest.get("tiered")
 
 
 def _trim_spec(spec_json):
@@ -416,6 +436,16 @@ def save_sharded(directory, step, params, _async=False, extras=None,
     directory = os.path.abspath(directory)
     os.makedirs(directory, exist_ok=True)
     final = _step_path(directory, step)
+    # tiered tables (ISSUE 19): swap each live hot-cache leaf for the
+    # FLUSHED full logical table before specs/quantization derive —
+    # synchronously even under _async, so the snapshot is consistent
+    # with the step count being saved
+    tiered_meta = None
+    try:
+        from .shard import tiered as _tiered
+        params, tiered_meta = _tiered.swap_for_save(params)
+    except ImportError:
+        pass
     if partition_specs is None:
         try:
             partition_specs = derive_partition_specs(params)
@@ -460,7 +490,7 @@ def save_sharded(directory, step, params, _async=False, extras=None,
                     f.write(blob if isinstance(blob, bytes)
                             else bytes(blob))
             _write_manifest(tmp, step, partition_specs=partition_specs,
-                            quantization=quantization)
+                            quantization=quantization, tiered=tiered_meta)
             if os.path.exists(final):
                 # POSIX rename refuses a non-empty target dir, so an
                 # overwrite needs two renames — move the old step ASIDE
@@ -540,6 +570,15 @@ def load_sharded(directory, step, template, validate=True):
             f"(saved vs template): " + "; ".join(qdiag) +
             " — requantize the template (or restore into a matching "
             "quantized tree) before loading")
+    # tiered tables (ISSUE 19): the checkpoint holds FULL logical
+    # tables — restore them into full-size host templates, then route
+    # each back through its live TieredState (host tier replaced, cache
+    # cold). Works across mesh resizes: the logical table is mesh-free.
+    tiered_routes = None
+    tmeta = saved_tiered(final)
+    if tmeta:
+        from .shard import tiered as _tiered
+        template, tiered_routes = _tiered.prepare_restore(template, tmeta)
 
     def do_load():
         import orbax.checkpoint as ocp
@@ -552,7 +591,11 @@ def load_sharded(directory, step, template, validate=True):
         return ckptr.restore(state, template)
 
     try:
-        return _policy().call(do_load)
+        restored = _policy().call(do_load)
+        if tiered_routes:
+            from .shard import tiered as _tiered
+            restored = _tiered.finish_restore(restored, tiered_routes)
+        return restored
     except MXNetError:
         raise
     except Exception as e:

@@ -220,13 +220,27 @@ class ShardedEmbedding(Embedding):
     """
 
     def __init__(self, input_dim, output_dim, dtype=np.float32,
-                 weight_initializer=None, **kwargs):
+                 weight_initializer=None, tiered=False, hbm_rows=None,
+                 **kwargs):
         super().__init__(input_dim, output_dim, dtype=dtype,
                          weight_initializer=weight_initializer, **kwargs)
         # the capture-path marker mxnet_tpu/cachedop.py keys sparse
         # eligibility on (shard/embedding.py sparse_eligibility)
         self.weight._sharded_embedding = {"vocab": int(input_dim),
                                           "dim": int(output_dim)}
+        if tiered:
+            from ...base import MXNetError
+            from ...shard import tiered as _tiered
+            if hbm_rows is None or int(hbm_rows) < 1:
+                raise MXNetError(
+                    "ShardedEmbedding(tiered=True) needs hbm_rows >= 1 "
+                    "(hot-cache rows per shard)")
+            # conversion happens at Trainer.shard (shard/tiered.py
+            # on_plan); registering the budget by NAME here lets
+            # ShardPlan._check_large_replicated account HBM-resident
+            # bytes before the table is ever converted
+            self.weight._tiered = {"hbm_rows": int(hbm_rows)}
+            _tiered.register_hbm_rows(self.weight.name, int(hbm_rows))
 
     def hybrid_forward(self, F, x, weight):
         from ...shard import embedding as _semb
@@ -245,6 +259,37 @@ class ShardedEmbedding(Embedding):
             # captured-step trace: recording is off, tracers flow raw
             return type(x)(_semb.lookup(self.weight, x._data,
                                         weight._data))
+        ts = getattr(self.weight, "_tiered_state", None)
+        if ts is not None:
+            if getattr(self.weight, "_trace_override", None) is not None:
+                # inside the capture machinery's ABSTRACT passes
+                # (eval_shape pre-pass / jaxpr record, cachedop.py):
+                # only shapes matter — the live record/consume passes
+                # take the SparseLookupContext branch above — so the
+                # plain gather below is shape-correct and never
+                # materialises values
+                return F.Embedding(x, weight, input_dim=self._input_dim,
+                                   output_dim=self._output_dim)
+            # eager/eval on a converted table: the live parameter is the
+            # HOT CACHE, not the logical table — look up through the
+            # host tier instead (slow path by design)
+            import jax
+            import jax.numpy as jnp
+            try:
+                # eager-only by construction (capture passes return
+                # shapes above, foreign traces raise below); the host
+                # sync IS the point of the read-through path
+                # mxtpu: disable=E02
+                idx = np.asarray(x._data)
+            except (jax.errors.TracerArrayConversionError,
+                    jax.errors.ConcretizationTypeError):
+                from ...base import MXNetError
+                raise MXNetError(
+                    f"tiered embedding {self.weight.name!r} cannot be "
+                    f"looked up inside a foreign trace — use the "
+                    f"captured step (Trainer.capture) or call it "
+                    f"eagerly") from None
+            return type(x)(jnp.asarray(ts.lookup_np(idx)))
         return F.Embedding(x, weight, input_dim=self._input_dim,
                            output_dim=self._output_dim)
 

@@ -256,6 +256,10 @@ class Trainer:
             mesh = kv._mesh
         plan = shard_mod.plan(mesh, rules=rules, data_axis=data_axis)
         kv.set_shard_plan(plan)
+        # tiered tables convert (or re-tier) BEFORE placement so their
+        # fresh hot caches are built directly on the plan's shardings
+        # and the redistribution pass no-ops over them
+        shard_mod.tiered.on_plan(self, plan)
         self._place_on_plan(plan)
         return plan
 
@@ -281,6 +285,7 @@ class Trainer:
                 f"not include the plan's data axis {old.data_axis!r}")
         plan = old.with_mesh(new_mesh)
         kv.set_shard_plan(plan)
+        shard_mod.tiered.on_plan(self, plan)
         self._place_on_plan(plan)
         return plan
 

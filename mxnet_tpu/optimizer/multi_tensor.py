@@ -42,7 +42,7 @@ from .optimizer import Optimizer, DCASGD
 
 __all__ = ["FusedUpdater", "build_buckets", "bucket_signature", "supports",
            "flat_layout", "split_flat", "apply_param_update",
-           "sparse_update_rows"]
+           "sparse_update_rows", "classify_state_rows"]
 
 
 def flat_layout(shapes):
@@ -197,6 +197,55 @@ def sparse_update_rows(optimizer, w_rows, g_rows, sv_rows, lr, wd, mp,
     (shard/embedding.py `sparse_row_update`)."""
     return apply_param_update(optimizer, w_rows, g_rows, sv_rows, lr, wd,
                               mp, clip, rescale, inv_scale)
+
+
+def classify_state_rows(optimizer, index, probe_nd):
+    """How each row-shaped optimizer-state leaf initialises, probed on a
+    tiny weight slice — what lets a TIERED table's host-resident state
+    rows materialise lazily (shard/tiered.py): a row that has never been
+    looked up has never been updated, so its state rows are still
+    exactly their init values, and the host tier can synthesise them on
+    demand instead of holding O(vocab) device state.
+
+    Returns one entry per state leaf of
+    ``create_state_multi_precision(index, probe)``:
+
+      "zero"    — the leaf initialises all-zero (momentum, Adam m/v,
+                  RMSProp n, ...): cold host rows are zeros
+      "master"  — the leaf initialises as a cast of the weight (fp32
+                  master under multi_precision): cold host rows are the
+                  host weight cast to the leaf dtype
+      None      — not row-shaped (scalar step counters, ...): rides
+                  whole on-device, never tiered
+
+    A row-shaped leaf matching neither pattern raises: the host tier
+    could not reconstruct evicted rows for it, and training through a
+    wrong reconstruction would corrupt silently."""
+    st = optimizer.create_state_multi_precision(index, probe_nd)
+    leaves = st if isinstance(st, tuple) else \
+        ((st,) if st is not None else ())
+    probe = np.asarray(probe_nd._data)
+    kinds = []
+    for j, s in enumerate(leaves):
+        v = np.asarray(getattr(s, "_data", s))
+        if tuple(v.shape) != tuple(probe.shape):
+            kinds.append(None)
+            continue
+        if not v.any():
+            kinds.append("zero")
+        elif np.array_equal(v, probe.astype(v.dtype)):
+            kinds.append("master")
+        else:
+            from ..base import MXNetError
+            raise MXNetError(
+                f"tiered embedding: optimizer "
+                f"{type(optimizer).__name__} state leaf {j} initialises "
+                f"to neither zeros nor a cast of the weight — its "
+                f"host-resident rows cannot be reconstructed after "
+                f"eviction; train this table fully resident "
+                f"(tiered=False) or use an optimizer whose row state "
+                f"initialises from the weight")
+    return tuple(kinds)
 
 
 def _make_kernel(optimizer, mp_flags, clip, unscale, n):

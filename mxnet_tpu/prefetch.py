@@ -50,8 +50,8 @@ from .ndarray.ndarray import NDArray
 from .observability import tracer as _tracer
 from .observability import registry as _obs_registry
 
-__all__ = ["DevicePrefetcher", "resolve_placement", "place",
-           "record_sync_h2d", "sync_h2d_count", "DEFAULT_DEPTH"]
+__all__ = ["DevicePrefetcher", "RowPrefetcher", "resolve_placement",
+           "place", "record_sync_h2d", "sync_h2d_count", "DEFAULT_DEPTH"]
 
 # double-buffered by default: slot k stages batch N+1 while the step
 # consumes batch N; raise to 3 for triple buffering when step times are
@@ -497,6 +497,188 @@ class DevicePrefetcher:
                 it_close()
             except Exception:
                 pass    # a worker may be mid-next() on the generator
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class RowPrefetcher:
+    """Engine-driven row prefetch for TIERED embedding tables (ISSUE 19;
+    shard/tiered.py). Wraps a batch iterable like `DevicePrefetcher`,
+    but besides staging the batch it RESOLVES each tiered table's cache
+    misses for the NEXT step against the hot cache: evict + write back
+    victims, stage the incoming cold rows (async committed device_put),
+    and rewrite the index leaf from row ids to SLOT ids — the captured
+    step then gathers from the cache with ZERO synchronous H2D on a warm
+    hit path.
+
+        pf = RowPrefetcher(loader, trainer, tables={0: net.embed})
+        for xb, yb in pf:
+            loss = step(xb, yb)     # consumes the staged row plan
+
+    `tables` maps the TOP-LEVEL batch position of an integer index leaf
+    to its `ShardedEmbedding` block (or its weight Parameter directly) —
+    one position per table. Construct AFTER `Trainer.shard` (conversion
+    happens there); tables must already be tiered.
+
+    The pipeline is STRICT depth-1 by construction — a row plan is only
+    valid against the post-step cache, so batch k+1's resolve hangs off
+    the step-k dispatch (TieredState step listener) as a background
+    engine task on this pipeline's write Var, overlapped with step k's
+    device compute: the resolve's writeback `np.asarray` blocks until
+    step k's arrays land, which is the only ordering barrier it needs.
+    Fetching two batches without stepping raises (the first plan would
+    be consumed by a step that never ran); stepping a batch this
+    prefetcher did not translate raises in the dispatch. Telemetry rides
+    the tiered counters (`embed_cache_*`, `embed_h2d_bytes`,
+    `embed_writeback_bytes`) plus the shared `prefetch_*` family."""
+
+    def __init__(self, source, trainer, tables, capture_spec=None):
+        from .base import MXNetError
+        self._tables = {}
+        for pos, blk in dict(tables).items():
+            p = getattr(blk, "weight", blk)
+            ts = getattr(p, "_tiered_state", None)
+            if ts is None:
+                raise MXNetError(
+                    f"RowPrefetcher: parameter {p.name!r} is not a "
+                    f"converted tiered table — build the prefetcher "
+                    f"AFTER Trainer.shard, and construct the block with "
+                    f"ShardedEmbedding(tiered=True, hbm_rows=N)")
+            self._tables[int(pos)] = ts
+        if not self._tables:
+            raise MXNetError("RowPrefetcher needs at least one tiered "
+                             "table in `tables`")
+        target = capture_spec if capture_spec is not None else trainer
+        self._placement = resolve_placement(target)
+        self._group = engine.TaskGroup("row_prefetch")
+        self._state = _State(iter(source))
+        self._var = engine.Var()
+        self._fut = None
+        self._awaiting_step = False
+        self._delivered = 0
+        # ONE listener is enough: the dispatch notifies every tiered
+        # table after its rebinds, and all of this pipeline's pendings
+        # were consumed by that same dispatch
+        self._anchor = next(iter(self._tables.values()))
+        self._anchor.add_step_listener(self._on_step)
+
+    # ------------------------------------------------------------ produce
+    def _task(self):
+        st = self._state
+        tables = self._tables
+        placement = self._placement
+
+        def resolve_stage():
+            if st.closed:
+                return _DROPPED
+            try:
+                item = next(st.it)
+            except StopIteration:
+                st.exhausted = True
+                return _EOF
+            if st.closed:
+                return _DROPPED
+            batch = list(item) if isinstance(item, (tuple, list)) \
+                else [item]
+            for pos, ts in tables.items():
+                leaf = batch[pos]
+                idx = np.asarray(leaf._data if isinstance(leaf, NDArray)
+                                 else leaf)
+                if _tracer.ACTIVE:
+                    with _tracer.span("row_prefetch:plan", cat="data"):
+                        batch[pos] = ts.plan_step(idx)
+                else:
+                    batch[pos] = ts.plan_step(idx)
+            out = place(tuple(batch), placement)
+            return out if isinstance(item, (tuple, list)) else out[0]
+
+        return resolve_stage
+
+    def _submit(self):
+        st = self._state
+        if st.closed or st.exhausted or self._fut is not None:
+            return
+        task = self._task()
+        try:
+            fut = engine.push(task, write_vars=(self._var,),
+                              priority=engine.PRIORITY_BACKGROUND,
+                              group=self._group)
+        except engine.EngineQueueFull:
+            fut = engine.inline_future(task)
+        self._fut = fut
+        _depth_delta(+1)
+
+    def _on_step(self):
+        if not self._awaiting_step:
+            return
+        self._awaiting_step = False
+        self._submit()
+
+    # ------------------------------------------------------------ consume
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        from .base import MXNetError
+        if self._awaiting_step:
+            raise MXNetError(
+                "RowPrefetcher: the previous batch was fetched but "
+                "never stepped — its staged row plan is still pending; "
+                "run the captured step on every fetched batch (strict "
+                "depth-1 pipeline)")
+        if self._fut is None:
+            # cold start (first batch) or recovery: resolve inline
+            if self._state.closed or self._state.exhausted:
+                raise StopIteration
+            self._fut = engine.inline_future(self._task())
+            _depth_delta(+1)
+        fut, self._fut = self._fut, None
+        _depth_delta(-1)
+        was_ready = fut.done()
+        res = fut.result()
+        if engine.skipped(res):
+            # shed by the bounded background queue before running: the
+            # source never advanced — re-resolve inline
+            res = self._task()()
+        if res is _EOF or res is _DROPPED:
+            raise StopIteration
+        if not was_ready and self._delivered >= 1:
+            _starved.inc()
+        self._delivered += 1
+        _batches_counter.inc()
+        self._awaiting_step = True
+        return res
+
+    next = __next__
+
+    # ------------------------------------------------------------ cleanup
+    def close(self):
+        st = self._state
+        if st.closed:
+            return
+        st.closed = True
+        self._anchor.remove_step_listener(self._on_step)
+        self._group.cancel()
+        if self._fut is not None:
+            self._fut = None
+            _depth_delta(-1)
+        it_close = getattr(st.it, "close", None)
+        if it_close is not None:
+            try:
+                it_close()
+            except Exception:
+                pass
 
     def __enter__(self):
         return self

@@ -13,6 +13,8 @@ elastic resharding (ISSUE 8; docs/PERFORMANCE.md "Parameter sharding").
   embedding.py    — model-parallel sparse lookup fast path (ISSUE 15)
   moe.py          — expert-parallel token routing for ShardedMoE
                     (ISSUE 16; top-k gating, capacity drop accounting)
+  tiered.py       — host-resident cold rows + engine-prefetched hot
+                    cache for tables larger than HBM (ISSUE 19)
 
 Quick start::
 
@@ -30,6 +32,7 @@ from . import redistribute
 from . import exchange
 from . import embedding
 from . import moe
+from . import tiered
 from .rules import (DEFAULT_RULES, match_partition_rules, validate_rules,
                     normalize_spec, spec_to_json, spec_from_json,
                     rules_to_json, rules_from_json)
@@ -39,6 +42,7 @@ from .redistribute import redistribute_tree, resharded_bytes
 
 __all__ = [
     "rules", "mesh", "redistribute", "exchange", "embedding", "moe",
+    "tiered",
     "DEFAULT_RULES", "match_partition_rules", "validate_rules",
     "normalize_spec", "spec_to_json", "spec_from_json",
     "rules_to_json", "rules_from_json",

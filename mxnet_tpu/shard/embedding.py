@@ -58,11 +58,12 @@ from jax.sharding import PartitionSpec as P
 
 from ..base import MXNetError
 from ..jax_compat import shard_map
-from .exchange import exchange, plan_buckets  # noqa: F401  (re-export)
+from .exchange import (exchange, local_offsets,  # noqa: F401  (re-export)
+                       plan_buckets)
 
 __all__ = ["plan_buckets", "gather_rows", "sparse_row_update",
-           "SparseLookupContext", "lookup", "sparse_eligibility",
-           "embed_param_bytes_frac"]
+           "scatter_rows", "SparseLookupContext", "lookup",
+           "sparse_eligibility", "embed_param_bytes_frac"]
 
 
 # how many all-to-alls one sharded lookup lowers to — the forward index
@@ -139,14 +140,13 @@ def sparse_row_update(table, state_vals, uniq, g_rows, mesh, axis,
 
     def local(tab, sv, ids, g):
         t = jax.lax.axis_index(axis)
-        loc = ids - t * rows_per
-        own = (loc >= 0) & (loc < rows_per)
-        cl = jnp.clip(loc, 0, rows_per - 1)
+        safe, _own = local_offsets(ids, t, rows_per)
+        cl = jnp.clip(safe, 0, rows_per - 1)
         w_rows = tab[cl]
         sv_rows = tuple(s[cl] if rl else s
                         for s, rl in zip(sv, row_like))
         new_rows, new_sv = stage_fn(w_rows, g, sv_rows)
-        safe = jnp.where(own, loc, rows_per)       # out of range -> drop
+        # non-owned and sentinel ids carry safe == rows_per -> drop
         new_tab = tab.at[safe].set(new_rows, mode="drop")
         out_sv = tuple(
             s.at[safe].set(ns, mode="drop") if rl else ns
@@ -166,6 +166,33 @@ def sparse_row_update(table, state_vals, uniq, g_rows, mesh, axis,
         in_specs=(table_spec, sv_specs, P(), P()),
         out_specs=(table_spec, sv_specs),
         check_vma=False)(table, tuple(state_vals), uniq, g_rows)
+
+
+def scatter_rows(table, slots, rows, mesh, axis):
+    """Write ``rows[i]`` into ``table[slots[i]]`` in place on the owning
+    shard — ZERO collectives (every shard receives the replicated
+    ``(M,)``/``(M, D)`` blocks and keeps only the slots it owns; the
+    sentinel ``table.shape[0]`` and non-owned slots drop). The tiered
+    hot cache's in-program scatter-in (shard/tiered.py): the
+    RowPrefetcher stages incoming cold rows replicated, and the captured
+    step lands them into freed cache slots before the lookup gathers.
+    Axis size 1 degenerates to a local drop-scatter."""
+    n_shards = int(mesh.shape[axis])
+    if n_shards <= 1:
+        safe = jnp.where(slots < table.shape[0], slots, table.shape[0])
+        return table.at[safe].set(rows.astype(table.dtype), mode="drop")
+    rows_per = table.shape[0] // n_shards
+
+    def local(tab, s, r):
+        t = jax.lax.axis_index(axis)
+        safe, _own = local_offsets(s, t, rows_per)
+        return tab.at[safe].set(r.astype(tab.dtype), mode="drop")
+
+    table_spec = P(*([axis] + [None] * (table.ndim - 1)))
+    return shard_map(local, mesh=mesh,
+                     in_specs=(table_spec, P(), P()),
+                     out_specs=table_spec, check_vma=False)(
+                         table, slots, rows)
 
 
 # ------------------------------------------------ capture integration

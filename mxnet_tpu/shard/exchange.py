@@ -34,7 +34,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-__all__ = ["group_ranks", "plan_buckets", "exchange"]
+__all__ = ["group_ranks", "plan_buckets", "exchange", "local_offsets"]
 
 
 def group_ranks(ids, n_groups):
@@ -71,6 +71,20 @@ def plan_buckets(uniq, n_shards, rows_per_shard, vocab):
     buckets = jnp.full((n_shards, U), vocab, dtype=uniq.dtype)
     buckets = buckets.at[sorted_owner, rank].set(sorted_ids, mode="drop")
     return buckets, sorted_owner, rank, order
+
+
+def local_offsets(ids, rank, rows_per_shard):
+    """Owner-local scatter offsets for one shard of a row-sharded table:
+    ``(safe, own)`` where ``own`` marks the ids this ``rank`` owns and
+    ``safe`` is their shard-local row (non-owned and sentinel ids map to
+    ``rows_per_shard`` — out of range, so an ``.at[safe]`` write with
+    ``mode='drop'`` discards them). The one place the "a shard never
+    writes rows it does not own" rule is computed, shared by the
+    sparse scatter-add update and the tiered-cache scatter-in
+    (shard/embedding.py `sparse_row_update` / `scatter_rows`)."""
+    loc = ids - rank * rows_per_shard
+    own = (loc >= 0) & (loc < rows_per_shard)
+    return jnp.where(own, loc, rows_per_shard), own
 
 
 def exchange(buf, axis):

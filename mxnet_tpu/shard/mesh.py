@@ -133,6 +133,14 @@ class ShardPlan:
         # dtype is unknown at rule-resolution time; 4 bytes/element is
         # the fp32 floor (fp16 tables halve it — still the right order)
         nbytes = int(np.prod(shape or (1,), dtype=np.int64)) * 4
+        # a TIERED table (shard/tiered.py) keeps only hbm_rows rows per
+        # shard on device — the HBM-resident bytes are what an OOM
+        # warning should account, not the host-tier full table
+        from . import tiered as _tiered
+        hbm = _tiered.hbm_rows_for(name)
+        if hbm is not None and shape and shape[0] > hbm:
+            nbytes = int(hbm) * int(np.prod(shape[1:] or (1,),
+                                            dtype=np.int64)) * 4
         if nbytes < limit:
             return
         self._warned.add(name)

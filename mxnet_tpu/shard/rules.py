@@ -134,6 +134,31 @@ def validate_rules(rules, mesh=None):
             spec = P(spec)
         elif spec is not None and not isinstance(spec, P):
             if isinstance(spec, (tuple, list)):
+                # the TUPLE form of the axis override (ISSUE 19
+                # satellite): per-dim entries shard dim 1 / both dims of
+                # a table — e.g. ("tp", "dp") or (None, "tp"). Like the
+                # bare string it is an explicit override, so every named
+                # axis must exist on the mesh (divisibility still
+                # downgrades per-shape through normalize_spec — a hard
+                # error there would break partial batches)
+                for d, entry in enumerate(spec):
+                    if entry is None:
+                        continue
+                    names = entry if isinstance(entry, (tuple, list)) \
+                        else (entry,)
+                    for nm in names:
+                        if not isinstance(nm, str):
+                            raise MXNetError(
+                                f"rule {i} ({pattern!r}): tuple spec "
+                                f"entry {d} must be None, an axis name, "
+                                f"or a tuple of axis names, got "
+                                f"{entry!r}")
+                        if mesh_axes is not None and nm not in mesh_axes:
+                            raise MXNetError(
+                                f"rule {i} ({pattern!r}): tuple spec "
+                                f"entry {d} names axis {nm!r} which is "
+                                f"no axis of the mesh "
+                                f"(axes: {sorted(mesh_axes)})")
                 spec = P(*spec)
             else:
                 raise MXNetError(f"rule {i} ({pattern!r}): spec must be a "
@@ -252,8 +277,9 @@ def spec_from_json(data):
 
 def rules_to_json(rules):
     """An ordered rule set as a JSON-friendly list, round-tripping all
-    three spec forms: ``{"pattern": ..., "axis": name}`` for the
-    string axis-override shorthand, ``{"pattern": ..., "spec": null}``
+    four spec forms: ``{"pattern": ..., "axis": name}`` for the
+    string axis-override shorthand, ``{"pattern": ..., "axes": [...]}``
+    for its per-dim TUPLE form, ``{"pattern": ..., "spec": null}``
     for replicate, ``{"pattern": ..., "spec": [...]}``
     (`spec_to_json`) for a PartitionSpec."""
     out = []
@@ -262,22 +288,27 @@ def rules_to_json(rules):
             out.append({"pattern": pattern, "axis": spec})
         elif spec is None:
             out.append({"pattern": pattern, "spec": None})
+        elif isinstance(spec, (tuple, list)) and not isinstance(spec, P):
+            out.append({"pattern": pattern, "axes": spec_to_json(spec)})
         else:
-            if not isinstance(spec, P):
-                spec = P(*spec)
             out.append({"pattern": pattern, "spec": spec_to_json(spec)})
     return out
 
 
 def rules_from_json(data):
     """Inverse of `rules_to_json`. Returns the ``(pattern, spec)``
-    tuple form `validate_rules` accepts (axis overrides stay strings,
-    so a decode -> encode round-trip is byte-identical)."""
+    tuple form `validate_rules` accepts (axis overrides stay strings
+    and tuple overrides stay tuples, so a decode -> encode round-trip
+    is byte-identical)."""
     rules = []
     for item in (data or []):
         pattern = item["pattern"]
         if "axis" in item:
             rules.append((pattern, item["axis"]))
+        elif "axes" in item:
+            rules.append((pattern, tuple(
+                tuple(e) if isinstance(e, list) else e
+                for e in item["axes"])))
         elif item.get("spec") is None:
             rules.append((pattern, None))
         else:

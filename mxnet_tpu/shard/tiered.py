@@ -1,0 +1,663 @@
+"""Tiered embedding storage: host-resident cold rows + a fixed-size
+device-resident hot cache, prefetched by the dependency engine
+(ISSUE 19; docs/PERFORMANCE.md "Tiered embeddings").
+
+A 10**8-row table exceeds HBM even row-sharded (PR 15 halves bytes per
+device; it cannot shrink the table). Production recommenders keep the
+hot rows on-device and the cold tail in host DRAM — and this framework's
+actual differentiator, the host-side dependency engine, is exactly the
+machinery to hide the host<->HBM row movement behind compute, the same
+way `DevicePrefetcher` stages batches (PR 5).
+
+Layout per converted table (`ShardedEmbedding(tiered=True, hbm_rows=C)`
+after `Trainer.shard`):
+
+  host tier  (this module, pinned numpy)
+    host_weight (vocab, D)        the FULL logical table
+    host state  (vocab, D) per row-shaped optimizer-state leaf, created
+                lazily-by-construction: a never-resident row has never
+                been updated, so its state rows are exactly their init
+                values (multi_tensor.classify_state_rows — zeros, or the
+                weight cast for fp32 masters)
+
+  device tier (the parameter's live data — the captured step trains it
+               directly, the sparse fast path unchanged)
+    hot cache   (S*C, D) row-sharded over the table's mesh axis (S
+                shards x hbm_rows slots each); slot s lives on shard
+                s // C
+    id maps     slot_of (vocab,) id -> slot | -1;  id_at (S*C,) slot ->
+                id | -1;  LRU stamps
+
+The pipeline (strict depth-1, driven by `prefetch.RowPrefetcher`):
+
+  1. PLAN (host, engine background task, overlapped with step k's
+     device compute): dedup batch k+1's raw row ids; hits translate to
+     slots for free. Misses pick victim slots — free first, then LRU
+     among slots batch k+1 does not need — write the victims' CURRENT
+     weight+state rows back device->host (every resident row is dirty:
+     the scatter-add update touched it the step it was inserted), and
+     stage the incoming cold rows as committed replicated device_put
+     blocks (async H2D — `embed_h2d_bytes`). The batch's ids are
+     REWRITTEN to slot ids: the captured program never learns the table
+     was tiered.
+  2. STEP k+1 (one dispatch, unchanged executable shape): the program
+     first scatter-drops the incoming blocks into their slots
+     (`embedding.scatter_rows`, zero collectives), then runs the normal
+     sparse fast path against the cache as if it were a (S*C, D) table
+     — dedup, 2 all-to-alls, hoisted-row backward, scatter-add update
+     into the touched slots. An all-hit step stages NOTHING: the cached
+     all-sentinel block is reused and sync H2D on the hot path is zero
+     (tools/check_dispatch.py `_run_tiered_phase` pins this).
+
+Correct by data flow, not by locks: the plan task gathers writeback
+rows with `np.asarray` on the post-step-k arrays (blocks until step k's
+compute lands), and step k+1 cannot dispatch until the prefetcher
+returns the translated batch.
+
+Checkpoints save the FLUSHED full logical table through the manifest
+(`manifest["tiered"]`) — restore works onto any mesh size because the
+host tier is the logical value (checkpoint.save_sharded/load_sharded
+route through `swap_for_save` / `prepare_restore` / `finish_restore`).
+"""
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..base import MXNetError
+from ..observability import registry as _obs_registry
+
+__all__ = ["TieredState", "on_plan", "register_hbm_rows", "hbm_rows_for",
+           "state_for", "tiered_tables", "swap_for_save",
+           "prepare_restore", "finish_restore"]
+
+_reg = _obs_registry()
+_hits_c = _reg.counter("embed_cache_hits")
+_miss_c = _reg.counter("embed_cache_misses")
+_evict_c = _reg.counter("embed_cache_evictions")
+_h2d_b = _reg.counter("embed_h2d_bytes")
+_writeback_b = _reg.counter("embed_writeback_bytes")
+_hit_rate_g = _reg.gauge("embed_cache_hit_rate")
+
+# name-keyed registries (parameter names are the stable identity that
+# survives save/restore and mesh resizes):
+#   _HBM_ROWS — declared at ShardedEmbedding(tiered=True) construction,
+#     BEFORE any plan resolves the name (ShardPlan._check_large_replicated
+#     reads it to warn on HBM-resident bytes, not the host-tier shard)
+#   _REGISTRY — live TieredState per converted table (checkpoint routing)
+_HBM_ROWS = {}
+_REGISTRY = {}
+
+
+def register_hbm_rows(name, hbm_rows):
+    _HBM_ROWS[name] = int(hbm_rows)
+
+
+def hbm_rows_for(name):
+    """Declared hot-cache rows per shard for a tiered table name, or
+    None for an untiered parameter."""
+    return _HBM_ROWS.get(name)
+
+
+def state_for(name):
+    """The live `TieredState` for a converted table name, or None."""
+    return _REGISTRY.get(name)
+
+
+def tiered_tables():
+    """{name: TieredState} for every converted table in this process."""
+    return dict(_REGISTRY)
+
+
+@jax.jit
+def _take_rows(arrs, idx):
+    # one shared jitted gather for writeback/flush: jax's jit cache keys
+    # on the avals, and callers pad idx to a power-of-two length so the
+    # retrace count stays logarithmic in the eviction batch size
+    return tuple(jnp.take(a, idx, axis=0) for a in arrs)
+
+
+def _resolve_axis(plan, name, shape):
+    """The mesh axis a tiered table's rule row-shards it over. Resolution
+    prefers the normalised spec; when the LOGICAL vocab does not divide
+    the axis (irrelevant — only the cache lives on device) the raw
+    matched rule decides. Purely-row-sharded (spec[1:] all None) is
+    required: the hot cache must take the PR 15 sparse fast path."""
+    from . import rules as _rules
+    spec = tuple(plan.spec_for(name, shape))
+    axis = spec[0] if spec and isinstance(spec[0], str) else None
+    trailing = spec[1:]
+    if axis is None:
+        raw, _rep = _rules.match_partition_rules(
+            plan.rules, {name: tuple(shape)})
+        rspec = tuple(raw[name] or ())
+        if rspec and isinstance(rspec[0], str):
+            axis = rspec[0]
+            trailing = rspec[1:]
+    if axis is not None and any(e is not None for e in trailing):
+        raise MXNetError(
+            f"tiered embedding {name!r}: its rule shards more than the "
+            f"row dim ({spec!r}) — a tiered table must be purely "
+            f"row-sharded so its hot cache takes the sparse fast path")
+    if axis is None or axis not in plan.mesh.shape:
+        raise MXNetError(
+            f"tiered embedding {name!r}: no partition rule row-shards "
+            f"it over a mesh axis (resolved spec {spec!r}); add a rule "
+            f"like ('{name}$', 'tp')")
+    n = int(plan.mesh.shape[axis])
+    if n < 2:
+        raise MXNetError(
+            f"tiered embedding {name!r}: mesh axis {axis!r} has size "
+            f"{n}; tiering needs the table row-sharded over an axis of "
+            f"size >= 2 (the sparse fast path's eligibility)")
+    return axis, n
+
+
+def _state_leaves(updater, index):
+    st = updater.states.get(index)
+    return st if isinstance(st, tuple) else \
+        ((st,) if st is not None else ())
+
+
+def _zeros_like_placed(arr):
+    return jax.device_put(np.zeros(arr.shape, arr.dtype), arr.sharding)
+
+
+class TieredState:
+    """Host tier + hot-cache bookkeeping for ONE converted table (module
+    docstring). Built by `on_plan` (never directly); thread-safe — the
+    RowPrefetcher resolves on an engine worker while the training loop
+    dispatches."""
+
+    def __init__(self, param, hbm_rows):
+        self.param = param
+        self.name = param.name
+        self.hbm_rows = int(hbm_rows)
+        self.vocab = int(param._sharded_embedding["vocab"])
+        self.dim = int(param._sharded_embedding["dim"])
+        self._lock = threading.RLock()
+        self._listeners = []
+        self._pending = None
+        self._zero_blocks = {}     # M -> cached all-sentinel arg tuple
+        # filled by _attach:
+        self.axis = self.n_shards = self.n_slots = None
+        self.mesh = self._repl = None
+        self.host_weight = None
+        self.host_state = []       # np (vocab, D) per ROW-LIKE leaf
+        self.kinds = ()            # per state leaf: zero|master|None
+        self.row_like = ()
+        self.state_nds = ()        # the leaf NDArrays cachedop rebinds
+        self.slot_of = self.id_at = self.stamp = None
+        self.clock = 0
+
+    # ------------------------------------------------------- conversion
+    def _attach(self, trainer, plan, index):
+        """(Re)build the device tier on `plan`: fresh zero cache + fresh
+        optimizer state placed on the plan's shardings, maps reset. The
+        host tier must already hold the logical table."""
+        p = self.param
+        axis, n_shards = _resolve_axis(plan, self.name,
+                                       (self.vocab, self.dim))
+        n_slots = n_shards * self.hbm_rows
+        cache_sh = plan.sharding(self.name, (n_slots, self.dim))
+        if tuple(cache_sh.spec) and cache_sh.spec[0] != axis:
+            raise MXNetError(
+                f"tiered embedding {self.name!r}: the rule shards the "
+                f"(S*hbm_rows, D) cache over {cache_sh.spec!r}, not the "
+                f"table's row axis {axis!r}")
+        dtype = self.host_weight.dtype
+        cache = jax.device_put(np.zeros((n_slots, self.dim), dtype),
+                               cache_sh)
+        p._data._rebind(cache)
+        if p._grad is not None:
+            p._grad._rebind(jax.device_put(
+                np.zeros((n_slots, self.dim), p._grad._data.dtype),
+                cache_sh))
+
+        opt = trainer._optimizer
+        updater = trainer._updater
+        old_leaves = _state_leaves(updater, index)
+        updater.states.pop(index, None)
+        st = opt.create_state_multi_precision(index, p.data())
+        updater.states[index] = st
+        leaves = _state_leaves(updater, index)
+        self.row_like = tuple(
+            s is not None and
+            tuple(s._data.shape) == (n_slots, self.dim) for s in leaves)
+        for j, (s, rl) in enumerate(zip(leaves, self.row_like)):
+            if rl or s is None or j >= len(old_leaves):
+                continue
+            old = old_leaves[j]
+            if old is not None and \
+                    tuple(old._data.shape) == tuple(s._data.shape):
+                # scalar leaves (step counters, ...) carry their value
+                # across the rebuild — they are not tiered
+                s._rebind(jnp.asarray(np.asarray(old._data)))
+        self.state_nds = leaves
+        self.axis, self.n_shards, self.n_slots = axis, n_shards, n_slots
+        self.mesh = plan.mesh
+        self._repl = NamedSharding(plan.mesh, P())
+        self.slot_of = np.full((self.vocab,), -1, np.int64)
+        self.id_at = np.full((n_slots,), -1, np.int64)
+        self.stamp = np.zeros((n_slots,), np.int64)
+        self.clock = 0
+        self._pending = None
+        self._zero_blocks.clear()
+
+    def _init_host_state(self, old_leaves=()):
+        """Host stores for the row-like state leaves, from their lazy
+        init rule (`classify_state_rows` kinds) — or captured from a
+        pre-existing FULL-shape leaf (a trainer that already stepped
+        before tiering)."""
+        self.host_state = []
+        ri = -1
+        for j, (kind, rl) in enumerate(zip(self.kinds, self.row_like)):
+            if not rl:
+                continue
+            ri += 1
+            dt = np.dtype(self.state_nds[j]._data.dtype)
+            old = old_leaves[j] if j < len(old_leaves) else None
+            if old is not None and \
+                    tuple(old._data.shape) == (self.vocab, self.dim):
+                self.host_state.append(
+                    np.array(np.asarray(old._data), dtype=dt))
+            elif kind == "master":
+                self.host_state.append(self.host_weight.astype(dt))
+            else:
+                self.host_state.append(
+                    np.zeros((self.vocab, self.dim), dt))
+
+    def retier(self, trainer, plan, index):
+        """Elastic reshard (Trainer.resize_mesh): flush the live cache
+        into the host tier on the OLD mesh, then rebuild the device tier
+        directly on the new plan's shardings. Any RowPrefetcher feeding
+        this table keeps working (listeners survive), but its staged
+        plan — if one was in flight — is dropped with the cache."""
+        with self._lock:
+            self.flush()
+            self._attach(trainer, plan, index)
+            self._init_host_state()
+
+    # ------------------------------------------------- the row pipeline
+    def plan_step(self, idx):
+        """Resolve one index batch AGAINST the hot cache (host side,
+        engine-worker safe): evict + write back what must go, stage the
+        incoming cold rows as committed replicated device blocks, and
+        return `idx` rewritten to SLOT ids. Exactly one un-stepped plan
+        may be outstanding (the strict depth-1 contract RowPrefetcher
+        drives); the staged product is popped by the next captured-step
+        dispatch."""
+        idx = np.asarray(idx)
+        if not np.issubdtype(idx.dtype, np.integer):
+            raise MXNetError(
+                f"tiered embedding {self.name!r}: index batch dtype "
+                f"{idx.dtype} — integer indices are required")
+        flat = idx.reshape(-1).astype(np.int64)
+        M = int(flat.size)
+        with self._lock:
+            if self._pending is not None:
+                raise MXNetError(
+                    f"tiered embedding {self.name!r}: a staged row plan "
+                    f"was never consumed — every planned batch must be "
+                    f"STEPPED before the next resolves (drive the loop "
+                    f"through prefetch.RowPrefetcher; do not fetch two "
+                    f"batches per step)")
+            if M and (flat.min() < 0 or flat.max() >= self.vocab):
+                raise MXNetError(
+                    f"tiered embedding {self.name!r}: index out of "
+                    f"range for vocab {self.vocab}")
+            uniq = np.unique(flat)
+            cur = self.slot_of[uniq]
+            hit = cur >= 0
+            n_hits = int(hit.sum())
+            n_miss = int(uniq.size) - n_hits
+            _hits_c.inc(n_hits)
+            _miss_c.inc(n_miss)
+            _hit_rate_g.set(n_hits / uniq.size if uniq.size else 1.0)
+            if uniq.size > self.n_slots:
+                raise MXNetError(
+                    f"tiered embedding {self.name!r}: cache thrash — "
+                    f"this step needs {uniq.size} unique rows but the "
+                    f"hot cache holds {self.n_slots} slots "
+                    f"({self.n_shards} shards x hbm_rows="
+                    f"{self.hbm_rows}). Raise hbm_rows to at least "
+                    f"ceil(unique_rows_per_step / {self.n_shards}) or "
+                    f"shrink the batch; a cache smaller than one step's "
+                    f"working set cannot make progress")
+            misses = uniq[~hit]
+            new_slots = np.empty((0,), np.int64)
+            if n_miss:
+                free = np.flatnonzero(self.id_at < 0)
+                take = free[:n_miss]
+                n_evict = n_miss - int(take.size)
+                if n_evict > 0:
+                    needed = np.zeros((self.n_slots,), bool)
+                    needed[cur[hit]] = True
+                    cand = np.flatnonzero((self.id_at >= 0) & ~needed)
+                    order = np.argsort(self.stamp[cand], kind="stable")
+                    evict = cand[order[:n_evict]]
+                    self._writeback(evict)
+                    new_slots = np.concatenate([take, evict])
+                else:
+                    new_slots = take
+                self.slot_of[misses] = new_slots
+                self.id_at[new_slots] = misses
+            # LRU touch for every slot this step references
+            self.clock += 1
+            self.stamp[self.slot_of[uniq]] = self.clock
+            self._pending = self._incoming(misses, new_slots, M)
+            slots_flat = self.slot_of[flat].astype(np.int32)
+        return slots_flat.reshape(idx.shape)
+
+    def _row_arrays(self):
+        return (self.param._data._data,) + tuple(
+            s._data for s, rl in zip(self.state_nds, self.row_like)
+            if rl)
+
+    def _gather_rows(self, slots):
+        """Device->host gather of `slots` from the cache + row-like
+        state leaves (padded to a power of two so the shared jit
+        retraces O(log) times). Blocks until in-flight compute lands —
+        the writeback correctness barrier."""
+        n = int(slots.size)
+        cap = 1 << max(0, (n - 1).bit_length())
+        pad = np.zeros((max(cap, 1),), np.int32)
+        pad[:n] = slots
+        out = _take_rows(self._row_arrays(), pad)
+        return [np.asarray(o)[:n] for o in out]
+
+    def _writeback(self, evict):
+        """Spill `evict` slots host-side: every resident row is dirty
+        (the scatter-add update touched it the step it came in), so the
+        weight AND state rows copy back unconditionally."""
+        blocks = self._gather_rows(evict)
+        ids = self.id_at[evict]
+        self.host_weight[ids] = blocks[0].astype(self.host_weight.dtype,
+                                                 copy=False)
+        for store, rows in zip(self.host_state, blocks[1:]):
+            store[ids] = rows.astype(store.dtype, copy=False)
+        self.slot_of[ids] = -1
+        self.id_at[evict] = -1
+        self.stamp[evict] = 0
+        _evict_c.inc(int(evict.size))
+        _writeback_b.inc(sum(int(b.nbytes) for b in blocks))
+
+    def _incoming(self, misses, slots, M):
+        """The staged scatter-in product for one step: `(inc_slots,
+        inc_rows, *inc_state_rows)`, committed replicated, STATIC length
+        M (= the step's flat index count — the executable's shape never
+        depends on the miss count) with the `n_slots` sentinel padding.
+        All-hit steps reuse one cached all-sentinel tuple per M: zero
+        H2D on the warm path."""
+        n = int(misses.size)
+        if n == 0:
+            cached = self._zero_blocks.get(M)
+            if cached is None:
+                cached = self._zero_blocks[M] = self._stage(
+                    np.full((M,), self.n_slots, np.int32),
+                    [np.zeros((M, self.dim), self.host_weight.dtype)] +
+                    [np.zeros((M, self.dim), s.dtype)
+                     for s in self.host_state])
+            return cached
+        inc_slots = np.full((M,), self.n_slots, np.int32)
+        inc_slots[:n] = slots
+        rows = np.zeros((M, self.dim), self.host_weight.dtype)
+        rows[:n] = self.host_weight[misses]
+        blocks = [rows]
+        for store in self.host_state:
+            b = np.zeros((M, self.dim), store.dtype)
+            b[:n] = store[misses]
+            blocks.append(b)
+        return self._stage(inc_slots, blocks)
+
+    def _stage(self, inc_slots, blocks):
+        nbytes = int(inc_slots.nbytes) + sum(int(b.nbytes)
+                                             for b in blocks)
+        _h2d_b.inc(nbytes)
+        # committed replicated async device_put — overlaps step k's
+        # compute; the dispatch passes these straight into the jit
+        return tuple(jax.device_put([inc_slots] + blocks,
+                                    [self._repl] * (1 + len(blocks))))
+
+    def take_pending(self):
+        with self._lock:
+            out, self._pending = self._pending, None
+            return out
+
+    # step listeners: cachedop fires notify_step() after a dispatch's
+    # rebinds — RowPrefetcher hangs the NEXT batch's resolve off it
+    def add_step_listener(self, cb):
+        with self._lock:
+            if cb not in self._listeners:
+                self._listeners.append(cb)
+
+    def remove_step_listener(self, cb):
+        with self._lock:
+            if cb in self._listeners:
+                self._listeners.remove(cb)
+
+    def notify_step(self):
+        with self._lock:
+            listeners = tuple(self._listeners)
+        for cb in listeners:
+            cb()
+
+    # --------------------------------------------------- host-tier I/O
+    def flush(self):
+        """Mirror every RESIDENT row back into the host tier (rows stay
+        cached — maps unchanged). After this, host_weight/host_state ARE
+        the logical table+state."""
+        with self._lock:
+            live = np.flatnonzero(self.id_at >= 0)
+            if not live.size:
+                return
+            blocks = self._gather_rows(live)
+            ids = self.id_at[live]
+            self.host_weight[ids] = blocks[0].astype(
+                self.host_weight.dtype, copy=False)
+            for store, rows in zip(self.host_state, blocks[1:]):
+                store[ids] = rows.astype(store.dtype, copy=False)
+
+    def export_table(self):
+        """The full logical (vocab, D) table, flushed, as numpy — what
+        checkpoints save."""
+        with self._lock:
+            self.flush()
+            return self.host_weight.copy()
+
+    def export_state(self):
+        """Flushed full logical row-like state stores, in state-leaf
+        order (row-like leaves only)."""
+        with self._lock:
+            self.flush()
+            return [s.copy() for s in self.host_state]
+
+    def import_table(self, full):
+        """Replace the logical table (checkpoint restore): host_weight
+        := full, state stores re-derive from their init rule, the device
+        cache goes COLD (zeroed in place, shardings kept) and any staged
+        plan is dropped. Resize-proof by construction — the host tier
+        never depends on the mesh."""
+        full = np.asarray(full)
+        if tuple(full.shape) != (self.vocab, self.dim):
+            raise MXNetError(
+                f"tiered embedding {self.name!r}: imported table shape "
+                f"{tuple(full.shape)} != ({self.vocab}, {self.dim})")
+        with self._lock:
+            self.host_weight = full.astype(self.host_weight.dtype,
+                                           copy=True)
+            self._init_host_state()
+            self.param._data._rebind(
+                _zeros_like_placed(self.param._data._data))
+            if self.param._grad is not None:
+                self.param._grad._rebind(
+                    _zeros_like_placed(self.param._grad._data))
+            for s, rl in zip(self.state_nds, self.row_like):
+                if rl:
+                    s._rebind(_zeros_like_placed(s._data))
+            self.slot_of[:] = -1
+            self.id_at[:] = -1
+            self.stamp[:] = 0
+            self.clock = 0
+            self._pending = None
+            self._zero_blocks.clear()
+
+    # ----------------------------------------------------- eager reads
+    def lookup_np(self, idx):
+        """Eager/eval lookup through the host tier: the logical table is
+        host_weight overlaid with the LIVE cache rows (flush without the
+        store mutation). Correct anywhere; slow by design — the training
+        hot path never comes here."""
+        idx = np.asarray(idx)
+        with self._lock:
+            table = self.host_weight
+            live = np.flatnonzero(self.id_at >= 0)
+            if live.size:
+                rows = self._gather_rows(live)[0]
+                table = table.copy()
+                table[self.id_at[live]] = rows.astype(table.dtype,
+                                                      copy=False)
+            return table[idx]
+
+
+# ---------------------------------------------------------- conversion
+def on_plan(trainer, plan):
+    """Trainer.shard / Trainer.resize_mesh hook, called BEFORE
+    `_place_on_plan`: convert every `tiered=True`-marked table to the
+    two-tier layout (first shard), or re-tier already-converted state
+    onto the new plan. Freshly-built device arrays land directly on the
+    plan's shardings, so the subsequent redistribution pass no-ops over
+    them."""
+    from ..ndarray.ndarray import NDArray
+    from ..optimizer import multi_tensor as _mt
+    for index, p in enumerate(trainer._params):
+        ts = getattr(p, "_tiered_state", None)
+        if ts is not None:
+            ts.retier(trainer, plan, index)
+            continue
+        marker = getattr(p, "_tiered", None)
+        if not marker or p._data is None:
+            continue
+        opt = trainer._optimizer
+        if not type(opt).elementwise:
+            raise MXNetError(
+                f"tiered embedding {p.name!r}: optimizer "
+                f"{type(opt).__name__} is not elementwise — the tiered "
+                f"cache requires the sparse fast path's scatter-add "
+                f"update")
+        ts = TieredState(p, marker["hbm_rows"])
+        if tuple(p._data.shape) != (ts.vocab, ts.dim):
+            raise MXNetError(
+                f"tiered embedding {p.name!r}: live shape "
+                f"{tuple(p._data.shape)} != declared "
+                f"({ts.vocab}, {ts.dim})")
+        # snapshot the full logical table host-side BEFORE the device
+        # rebind (np.asarray gathers a sharded array transparently)
+        ts.host_weight = np.array(np.asarray(p._data._data))
+        old_leaves = _state_leaves(trainer._updater, index)
+        ts._attach(trainer, plan, index)
+        probe = NDArray(jnp.asarray(ts.host_weight[:2]))
+        ts.kinds = _mt.classify_state_rows(opt, index, probe)
+        if len(ts.kinds) != len(ts.row_like) or any(
+                (k is not None) != rl
+                for k, rl in zip(ts.kinds, ts.row_like)):
+            raise MXNetError(
+                f"tiered embedding {p.name!r}: optimizer state layout "
+                f"probed on a row slice disagrees with the cache-shaped "
+                f"state — cannot tier this optimizer's state")
+        ts._init_host_state(old_leaves)
+        p._tiered_state = ts
+        _REGISTRY[p.name] = ts
+        register_hbm_rows(p.name, ts.hbm_rows)
+
+
+# ------------------------------------------------- checkpoint routing
+def _is_nd(x):
+    from ..ndarray.ndarray import NDArray
+    return isinstance(x, NDArray)
+
+
+def swap_for_save(params):
+    """Checkpoint pre-pass (checkpoint.save_sharded): replace every leaf
+    that IS a live tiered hot cache (identity match on the device array,
+    or param-name + cache-shape match) with the FLUSHED full logical
+    table. Returns `(params_with_full_tables, tiered_manifest_or_None)`
+    — the manifest entry records vocab/dim/hbm_rows/dtype per name so a
+    restore knows to route the full table back through the tier."""
+    if not _REGISTRY:
+        return params, None
+    from ..checkpoint import _leaf_name
+    leaves, treedef = jax.tree_util.tree_flatten_with_path(
+        params, is_leaf=_is_nd)
+    by_id = {}
+    for ts in _REGISTRY.values():
+        if ts.param._data is not None:
+            by_id[id(ts.param._data._data)] = ts
+    meta, new = {}, []
+    for path, leaf in leaves:
+        data = getattr(leaf, "_data", leaf)
+        ts = by_id.get(id(data))
+        if ts is None:
+            cand = _REGISTRY.get(_leaf_name(path))
+            if cand is not None and cand.n_slots is not None and \
+                    tuple(getattr(data, "shape", ())) == \
+                    (cand.n_slots, cand.dim):
+                ts = cand
+        if ts is None:
+            new.append(leaf)
+            continue
+        full = ts.export_table()
+        meta[ts.name] = {"vocab": ts.vocab, "dim": ts.dim,
+                         "hbm_rows": ts.hbm_rows,
+                         "dtype": str(full.dtype)}
+        new.append(full)
+    if not meta:
+        return params, None
+    return jax.tree_util.tree_unflatten(treedef, new), meta
+
+
+def prepare_restore(template, tiered_meta):
+    """Checkpoint restore pre-pass (checkpoint.load_sharded): for every
+    template leaf whose name the manifest's `tiered` entry covers,
+    substitute a full-table (vocab, D) zeros template — the checkpoint
+    holds the logical table, not a cache. Returns `(template, routes)`;
+    routes is None when nothing matched."""
+    from ..checkpoint import _leaf_name
+    leaves, treedef = jax.tree_util.tree_flatten_with_path(
+        template, is_leaf=_is_nd)
+    routes, new = [], []
+    for j, (path, leaf) in enumerate(leaves):
+        m = (tiered_meta or {}).get(_leaf_name(path))
+        if m is None:
+            new.append(leaf)
+            continue
+        dt = np.dtype(m.get("dtype") or "float32")
+        new.append(np.zeros((int(m["vocab"]), int(m["dim"])), dt))
+        routes.append((j, _leaf_name(path)))
+    if not routes:
+        return template, None
+    return jax.tree_util.tree_unflatten(treedef, new), routes
+
+
+def finish_restore(restored, routes):
+    """Checkpoint restore post-pass: route each restored full table back
+    into its live TieredState (`import_table` — host tier replaced,
+    cache cold) and hand back the cache leaf in its place; a name with
+    no live tiered table keeps the full table (an untiered consumer
+    restoring a tiered save)."""
+    leaves, treedef = jax.tree_util.tree_flatten(restored,
+                                                 is_leaf=_is_nd)
+    for j, name in routes:
+        full = np.asarray(getattr(leaves[j], "_data", leaves[j]))
+        ts = _REGISTRY.get(name)
+        if ts is None or ts.n_slots is None:
+            leaves[j] = full
+            continue
+        ts.import_table(full)
+        leaves[j] = ts.param._data._data
+    return jax.tree_util.tree_unflatten(treedef, leaves)

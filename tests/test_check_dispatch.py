@@ -47,6 +47,14 @@ def test_captured_dispatch_budget_and_parity():
     assert res["moe_mesh"] is True
     assert res["moe_dispatches_per_step"] <= res["budget"]
     assert res["moe_sync_h2d_per_step"] == 0
+    # ISSUE 19: the TIERED embedding captured step (host-resident cold
+    # rows + device hot cache, RowPrefetcher-fed) holds the same warm
+    # budget on an all-hit step with ZERO synchronous H2D, and its
+    # forced-miss async staging moved — bounded — row bytes
+    assert res["tiered_mesh"] is True
+    assert res["tiered_dispatches_per_step"] <= res["budget"]
+    assert res["tiered_sync_h2d_per_step"] == 0
+    assert res["tiered_async_h2d_bytes"] > 0
     # ISSUE 6: the serve decode loop is ONE dispatch per warm decode
     # turn, never retraces across varying slot occupancy, and returns
     # every KV page when the traffic drains

@@ -1,0 +1,289 @@
+"""Tiered embedding storage (mxnet_tpu/shard/tiered.py, ISSUE 19):
+bitwise parity vs fully-resident training through forced evictions
+(weights AND momentum/Adam state rows riding the writeback), checkpoint
+save->restore of the flushed logical table onto a resized mesh, the
+loud cache-thrash / missing-prefetcher / fetch-without-step contracts,
+the tuple-form per-table rule overrides (satellite 1), and the
+HBM-resident warn accounting for tiered tables (satellite 2)."""
+import tempfile
+import warnings
+
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import mxnet_tpu as mx
+from mxnet_tpu import checkpoint, gluon, nd, shard
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.prefetch import RowPrefetcher
+from mxnet_tpu.shard import tiered as stiered
+
+V, D, B, F = 64, 8, 8, 3
+HBM = 16          # 2 tp shards x 16 = 32 slots < V=64 -> forced evictions
+_rng = np.random.RandomState(0)
+
+
+def _batches(n, seed=1):
+    rng = np.random.RandomState(seed)
+    return [(rng.randint(0, V, size=(B, F)).astype(np.int32),
+             rng.randn(B, 1).astype(np.float32)) for _ in range(n)]
+
+
+class _DLRM(gluon.nn.HybridBlock):
+    def __init__(self, tiered=False, hbm_rows=HBM, **kw):
+        super().__init__(**kw)
+        with self.name_scope():
+            if tiered:
+                self.embed = gluon.nn.ShardedEmbedding(
+                    V, D, tiered=True, hbm_rows=hbm_rows)
+            else:
+                self.embed = gluon.nn.ShardedEmbedding(V, D)
+            self.top = gluon.nn.Dense(1, in_units=F * D)
+
+    def hybrid_forward(self, Fm, idx):
+        e = self.embed(idx)
+        return self.top(e.reshape((idx.shape[0], -1)))
+
+
+def _build(tiered, opt="sgd", opt_args=None, mesh=None, hbm_rows=HBM,
+           prefix=None):
+    mx.random.seed(0)
+    net = _DLRM(tiered=tiered, hbm_rows=hbm_rows, prefix=prefix)
+    net.initialize(mx.init.Xavier())
+    if not tiered:
+        # un-defer shapes; a tiered table trains only captured, and the
+        # Dense has in_units, so the tiered twin needs no warm-up call
+        net(nd.array(np.zeros((B, F), np.int32), dtype=np.int32))
+    tr = gluon.Trainer(net.collect_params(), opt,
+                       opt_args or {"learning_rate": 0.1}, kvstore="ici")
+    tr.shard(mesh=mesh or {"dp": 2, "tp": 2})
+    lossf = gluon.loss.L2Loss()
+    step = tr.capture(lambda i, y: lossf(net(i), y).mean())
+    return net, tr, step
+
+
+def _state_rows(tr, p):
+    i = [j for j, q in enumerate(tr._params) if q is p][0]
+    st = tr._updater.states.get(i)
+    st = st if isinstance(st, tuple) else ((st,) if st is not None else ())
+    return [np.asarray(s._data) for s in st
+            if s is not None and tuple(s._data.shape) == (V, D)]
+
+
+# ----------------------------------------------------- bitwise parity
+@pytest.mark.parametrize("opt,opt_args", [
+    ("sgd", None),
+    ("sgd", {"learning_rate": 0.1, "momentum": 0.9}),
+    ("adam", {"learning_rate": 0.01}),
+])
+def test_tiered_bitwise_parity(opt, opt_args):
+    """After N steps with forced evictions (32 slots, 64-row vocab), the
+    flushed logical table AND every row-like optimizer-state leaf are
+    BITWISE equal to a fully-resident run — writeback and re-fault of a
+    row is exact, not approximate."""
+    batches = _batches(6)
+    net0, tr0, step0 = _build(False, opt, opt_args)
+    for x, y in batches:
+        step0(nd.array(x, dtype=np.int32), nd.array(y))
+    w_ref = np.asarray(net0.embed.weight.data()._data)
+    s_ref = _state_rows(tr0, net0.embed.weight)
+
+    ev0 = stiered._evict_c.value
+    net1, tr1, step1 = _build(True, opt, opt_args)
+    src = iter([(nd.array(x, dtype=np.int32), nd.array(y))
+                for x, y in batches])
+    with RowPrefetcher(src, tr1, tables={0: net1.embed}) as pf:
+        n = 0
+        for xb, yb in pf:
+            step1(xb, yb)
+            n += 1
+    assert n == len(batches)
+    assert stiered._evict_c.value > ev0, \
+        "test must force evictions to exercise the writeback path"
+    ts = net1.embed.weight._tiered_state
+    assert np.array_equal(w_ref, ts.export_table())
+    s_tier = ts.export_state()
+    assert len(s_tier) == len(s_ref)
+    for a, b in zip(s_ref, s_tier):
+        assert np.array_equal(a, b)
+
+
+def test_tiered_cache_is_device_resident_slots():
+    """The live parameter after conversion IS the (S*hbm_rows, D) hot
+    cache, row-sharded by the table's rule; the logical shape stays on
+    the Parameter."""
+    net, tr, step = _build(True)
+    p = net.embed.weight
+    assert tuple(p.shape) == (V, D)                 # logical, unchanged
+    assert tuple(p._data.shape) == (2 * HBM, D)     # tp=2 shards
+    spec = tuple(p._data._data.sharding.spec)
+    assert spec and spec[0] == "tp"
+
+
+# --------------------------------------------------- loud degradation
+def test_cache_thrash_raises_with_sizing_guidance():
+    """hbm_rows too small for one step's unique rows fails LOUDLY with
+    sizing guidance (never deadlocks, never silently drops rows)."""
+    net, tr, step = _build(True, hbm_rows=2)   # 4 slots << B*F uniques
+    batches = _batches(1)
+    src = iter([(nd.array(x, dtype=np.int32), nd.array(y))
+                for x, y in batches])
+    pf = RowPrefetcher(src, tr, tables={0: net.embed})
+    with pytest.raises(MXNetError, match="thrash.*hbm_rows"):
+        next(iter(pf))
+    pf.close()
+
+
+def test_step_without_prefetcher_raises():
+    """A raw (untranslated) batch cannot address the hot cache — the
+    dispatch refuses instead of training on garbage slots."""
+    net, tr, step = _build(True)
+    x, y = _batches(1)[0]
+    with pytest.raises(MXNetError, match="RowPrefetcher"):
+        step(nd.array(x, dtype=np.int32), nd.array(y))
+
+
+def test_fetch_without_step_raises():
+    """Strict depth-1: fetching two batches without stepping the first
+    raises (its staged row plan would never be consumed)."""
+    net, tr, step = _build(True)
+    batches = _batches(2)
+    src = iter([(nd.array(x, dtype=np.int32), nd.array(y))
+                for x, y in batches])
+    pf = RowPrefetcher(src, tr, tables={0: net.embed})
+    it = iter(pf)
+    next(it)
+    with pytest.raises(MXNetError, match="never stepped"):
+        next(it)
+    pf.close()
+
+
+def test_untiered_parameter_rejected_by_prefetcher():
+    net, tr, step = _build(False)
+    with pytest.raises(MXNetError, match="not a converted tiered"):
+        RowPrefetcher(iter([]), tr, tables={0: net.embed})
+
+
+# ----------------------------------------------- eager/eval host tier
+def test_eager_lookup_reads_through_host_tier():
+    """Outside the captured step the block reads the LOGICAL table (host
+    tier overlaid with live cache rows) — eval keeps working."""
+    net, tr, step = _build(True)
+    ts = net.embed.weight._tiered_state
+    idx = np.arange(8, dtype=np.int32)
+    out = net.embed(nd.array(idx, dtype=np.int32))
+    assert np.array_equal(np.asarray(out._data), ts.host_weight[idx])
+
+
+# ------------------------------------------- checkpoint + mesh resize
+def test_checkpoint_restore_onto_resized_mesh():
+    """save_sharded writes the FLUSHED full logical table (manifest
+    `tiered` entry); load_sharded routes it back through the live
+    TieredState — onto a different mesh size — and training continues."""
+    batches = _batches(4)
+    net, tr, step = _build(True, prefix="ck_")
+    src = iter([(nd.array(x, dtype=np.int32), nd.array(y))
+                for x, y in batches])
+    with RowPrefetcher(src, tr, tables={0: net.embed}) as pf:
+        for xb, yb in pf:
+            step(xb, yb)
+    full = net.embed.weight._tiered_state.export_table()
+
+    d = tempfile.mkdtemp()
+    params = {p.name: p.data()._data for p in tr._params}
+    checkpoint.save_sharded(d, 1, params)
+    tmeta = checkpoint.saved_tiered(d, 1)
+    assert tmeta and "ck_shardedembedding0_weight" in tmeta
+    ent = tmeta["ck_shardedembedding0_weight"]
+    assert (ent["vocab"], ent["dim"]) == (V, D)
+
+    # fresh model, SMALLER mesh (2,2) -> (1,2)
+    net2, tr2, step2 = _build(True, mesh={"dp": 1, "tp": 2}, prefix="ck_")
+    template = {p.name: p.data()._data for p in tr2._params}
+    checkpoint.load_sharded(d, 1, template)
+    ts2 = net2.embed.weight._tiered_state
+    assert np.array_equal(ts2.export_table(), full)
+    src2 = iter([(nd.array(x, dtype=np.int32), nd.array(y))
+                 for x, y in batches[:2]])
+    with RowPrefetcher(src2, tr2, tables={0: net2.embed}) as pf2:
+        for xb, yb in pf2:
+            step2(xb, yb)            # post-restore training proceeds
+
+
+def test_resize_mesh_retiers_in_place():
+    """Trainer.resize_mesh flushes the cache, rebuilds the device tier
+    on the new plan, and the SAME prefetcher keeps feeding steps."""
+    batches = _batches(4)
+    net, tr, step = _build(True)
+    src = iter([(nd.array(x, dtype=np.int32), nd.array(y))
+                for x, y in batches])
+    pf = RowPrefetcher(src, tr, tables={0: net.embed})
+    it = iter(pf)
+    xb, yb = next(it)
+    step(xb, yb)
+    before = net.embed.weight._tiered_state.export_table()
+    tr.resize_mesh({"dp": 1, "tp": 2})
+    ts = net.embed.weight._tiered_state
+    assert np.array_equal(ts.export_table(), before)   # flush preserved
+    assert tuple(net.embed.weight._data.shape) == (2 * HBM, D)
+    # the staged plan (if any) died with the old cache; the pipeline
+    # resumes on the next fetch->step cycle
+    pf.close()
+
+
+# ------------------------------------- satellite 1: tuple-form rules
+def test_tuple_rule_validates_against_mesh():
+    mesh = shard.as_mesh({"dp": 2, "tp": 2})
+    # valid: shard dim 1, both dims, nested tuple
+    shard.validate_rules(
+        ((r"a_weight$", (None, "tp")),
+         (r"b_weight$", ("dp", "tp")),
+         (r"c_weight$", (("dp", "tp"), None))), mesh=mesh)
+    with pytest.raises(MXNetError, match="names axis 'xx'"):
+        shard.validate_rules(((r"a_weight$", (None, "xx")),), mesh=mesh)
+    with pytest.raises(MXNetError, match="entry 0"):
+        shard.validate_rules(((r"a_weight$", (3, None)),), mesh=mesh)
+    # no mesh given: structure still checked, axis names not
+    shard.validate_rules(((r"a_weight$", (None, "anything")),))
+
+
+def test_tuple_rule_shards_dim1_and_round_trips_json():
+    mesh = shard.as_mesh({"dp": 2, "tp": 2})
+    rules = ((r"colshard_weight$", (None, "tp")),
+             (r"gridshard_weight$", ("dp", "tp")),
+             (r".*", None))
+    plan = shard.plan(mesh, rules=rules)
+    assert tuple(plan.spec_for("colshard_weight", (8, 8))) == (None, "tp")
+    assert tuple(plan.spec_for("gridshard_weight", (8, 8))) == ("dp", "tp")
+    # JSON round-trip is byte-identical: tuples stay tuples
+    encoded = shard.rules_to_json(rules)
+    assert encoded[0] == {"pattern": r"colshard_weight$",
+                          "axes": [None, "tp"]}
+    assert shard.rules_from_json(encoded) == rules
+    import json
+    assert json.loads(json.dumps(encoded)) == encoded
+
+
+# ------------------------- satellite 2: HBM-resident warn accounting
+def test_large_replicated_warning_uses_hbm_resident_bytes(monkeypatch):
+    """A tiered table whose HOST tier crosses MXTPU_SHARD_WARN_BYTES but
+    whose HBM-resident cache does not must NOT warn — tiering makes it
+    not-an-OOM; the same shape untiered still warns."""
+    monkeypatch.setenv("MXTPU_SHARD_WARN_BYTES", str(1 << 20))
+    mesh = shard.as_mesh({"dp": 2, "tp": 2})
+    shape = (1 << 17, 8)          # 4 MiB at fp32 floor, unmatched name
+    rules = ((r".*", None),)
+    plan1 = shard.plan(mesh, rules=rules)
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        plan1.spec_for("plain_giant_weight", shape)
+    assert any("replicates" in str(x.message) for x in w)
+    stiered.register_hbm_rows("tiered_giant_weight", 64)   # 2 KiB on HBM
+    try:
+        plan2 = shard.plan(mesh, rules=rules)
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            plan2.spec_for("tiered_giant_weight", shape)
+        assert not [x for x in w if "replicates" in str(x.message)]
+    finally:
+        stiered._HBM_ROWS.pop("tiered_giant_weight", None)

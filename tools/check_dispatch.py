@@ -45,6 +45,15 @@ path live, publishing as `moe_step`) must hold the same <=2 dispatch
 budget warm and do zero synchronous H2D with the device prefetcher
 (>= 4 devices; skipped below).
 
+ISSUE 19 extension — the warm-step budget also covers the TIERED
+embedding captured step: a `ShardedEmbedding(tiered=True, hbm_rows=N)`
+table — host-resident cold rows behind a fixed device hot cache, fed
+through the engine-prefetched `RowPrefetcher` — must hold the same <=2
+dispatch budget on a warm all-hit step with ZERO synchronous H2D (a hot
+step touches only slots already on device), and a forced miss step's
+asynchronous row staging must stay bounded by the touched-row bytes
+(>= 4 devices; skipped below).
+
 ISSUE 6 extension — the warm-step budget also covers the SERVE decode
 loop: a warm continuous-batching decode turn must be at most ONE device
 dispatch (the shared ragged-paged-attention decode executable), the
@@ -157,6 +166,7 @@ def run(steps=DEFAULT_STEPS, budget=DISPATCH_BUDGET):
     shard_res = _run_shard_phase(steps, errors)
     shard_res.update(_run_embed_phase(errors))
     shard_res.update(_run_moe_phase(errors))
+    shard_res.update(_run_tiered_phase(errors))
     serve_res = _run_serve_phase(errors)
     serve_res.update(_run_serve_fastpath_phase(errors))
     serve_res.update(_run_serve_int8_phase(errors))
@@ -548,6 +558,118 @@ def _run_moe_phase(errors):
     }
 
 
+def _run_tiered_phase(errors):
+    """Tiered-embedding budget (ISSUE 19): a captured DLRM step over a
+    `ShardedEmbedding(tiered=True, hbm_rows=...)` table — host-resident
+    cold rows, a fixed (hbm_rows, D)-per-shard device hot cache, the
+    `RowPrefetcher` resolving next-step rows off the engine's background
+    lane — must hold the same <=2 dispatch budget on a warm ALL-HIT step
+    and do ZERO synchronous H2D there (the whole point of the tier: a
+    hot step touches only cache slots already on device), while a forced
+    MISS step's asynchronous row staging stays bounded by the touched-row
+    bytes (cold stage + the cached all-hit zero block + one miss stage —
+    never O(vocab)). Needs >= 4 devices; skipped cleanly below that.
+    Tiny shapes (one table, 5 steps) to stay inside the tier-1 verify
+    window."""
+    import jax
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, nd, profiler
+    from mxnet_tpu.observability import registry
+    from mxnet_tpu.prefetch import RowPrefetcher
+    from mxnet_tpu.shard import tiered as stiered
+
+    if len(jax.devices()) < 4:
+        return {"tiered_mesh": False, "tiered_dispatches_per_step": None,
+                "tiered_sync_h2d_per_step": None,
+                "tiered_async_h2d_bytes": None}
+
+    V, D, B, F = 4096, 16, 16, 4
+    HBM_ROWS = 48          # n_slots = tp * 48 = 96 >= B*F touched rows
+    rng = np.random.RandomState(11)
+    Ah = rng.randint(0, 2048, (B, F)).astype(np.int32)     # resident set
+    Bh = rng.randint(2048, 4096, (B, F)).astype(np.int32)  # cold set
+    yh = rng.randn(B, 1).astype(np.float32)
+
+    class _DLRM(gluon.nn.HybridBlock):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            with self.name_scope():
+                self.embed = gluon.nn.ShardedEmbedding(
+                    V, D, tiered=True, hbm_rows=HBM_ROWS)
+                self.top = gluon.nn.Dense(1, in_units=F * D)
+
+        def hybrid_forward(self, F_, i):
+            return self.top(self.embed(i).reshape((i.shape[0], -1)))
+
+    mx.random.seed(0)
+    net = _DLRM()
+    net.initialize(mx.init.Xavier())
+    lossf = gluon.loss.L2Loss()
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.1}, kvstore="ici")
+    tr.shard(mesh={"dp": 2, "tp": 2})
+    step = tr.capture(lambda i, y: lossf(net(i), y).mean())
+
+    # batch sequence: cold-A (compile + first stage), 3x repeat-A (warm
+    # ALL-HIT steps — the zero-H2D hot path under test), cold-B (a
+    # forced full-miss step whose staging must stay bounded)
+    seq = [Ah, Ah, Ah, Ah, Bh]
+    src = ((nd.array(i, dtype=np.int32), nd.array(yh)) for i in seq)
+
+    sync = registry().counter("prefetch_h2d_sync")
+    worst = 0
+    worst_sync = 0
+    h2d0 = stiered._h2d_b.value
+    pf = RowPrefetcher(src, tr, tables={0: net.embed})
+    try:
+        for k, (ib, yb) in enumerate(pf):
+            base = sync.value
+            profiler.reset_dispatches()
+            step(ib, yb)
+            if k >= 1:                    # every post-compile step
+                worst = max(worst, profiler.dispatch_count())
+            if 1 <= k <= 3:               # the warm all-hit steps
+                worst_sync = max(worst_sync, sync.value - base)
+            if step.last_fallback_reason is not None:
+                errors.append(f"tiered step fell back: "
+                              f"{step.last_fallback_reason}")
+    finally:
+        pf.close()
+    h2d_total = stiered._h2d_b.value - h2d0
+
+    if worst > DISPATCH_BUDGET:
+        errors.append(f"tiered dispatch budget exceeded: {worst}/step "
+                      f"(budget {DISPATCH_BUDGET})")
+    if worst_sync:
+        errors.append(f"tiered warm all-hit steps performed "
+                      f"{worst_sync} synchronous H2D transfer(s) "
+                      f"(budget 0)")
+    # bounded async staging: slots (M,) int32 + one (M, D) fp32 row
+    # block per stage, three stages total (cold-A, the cached all-hit
+    # zero block, cold-B). A tier that shipped O(vocab) rows — or
+    # restaged on every all-hit step — cannot fit this bound.
+    M = B * F
+    stage_bytes = M * 4 + M * D * 4
+    bound = 3 * stage_bytes
+    if not h2d_total:
+        errors.append("tiered async H2D byte counter never moved — the "
+                      "row-prefetch staging path did not engage")
+    elif h2d_total > bound:
+        errors.append(f"tiered async H2D traffic {h2d_total} B exceeds "
+                      f"the touched-row bound ({bound} B = 3 stages of "
+                      f"{stage_bytes} B) — the hot-cache tier is "
+                      f"shipping more than the missed rows")
+
+    return {
+        "tiered_mesh": True,
+        "tiered_dispatches_per_step": worst,
+        "tiered_sync_h2d_per_step": worst_sync,
+        "tiered_async_h2d_bytes": int(h2d_total),
+    }
+
+
 def _run_serve_phase(errors):
     """Serve decode-loop budget (ISSUE 6): warm continuous-batching decode
     turns are at most ONE dispatch (the shared paged-decode executable),
@@ -888,7 +1010,10 @@ def main(argv=None):
                  f"{res['embed_backward_temp_frac']}x of one dense "
                  f"table grad; moe {res['moe_dispatches_per_step']} "
                  f"dispatch/step, {res['moe_sync_h2d_per_step']} sync "
-                 f"H2D")
+                 f"H2D; tiered {res['tiered_dispatches_per_step']} "
+                 f"dispatch/step, {res['tiered_sync_h2d_per_step']} "
+                 f"sync H2D warm, {res['tiered_async_h2d_bytes']} B "
+                 f"async staged")
     print(f"check_dispatch: OK ({res['captured_dispatches_per_step']} "
           f"dispatch/step captured vs "
           f"{res['imperative_dispatches_per_step']} imperative; "
