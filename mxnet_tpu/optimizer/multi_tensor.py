@@ -225,6 +225,13 @@ def classify_state_rows(optimizer, index, probe_nd):
     leaves = st if isinstance(st, tuple) else \
         ((st,) if st is not None else ())
     probe = np.asarray(probe_nd._data)
+    if not probe.size or not np.any(probe.astype(np.float64)):
+        from ..base import MXNetError
+        raise MXNetError(
+            "classify_state_rows: the probe slice is all-zero — a "
+            "weight-cast (fp32 master) leaf is indistinguishable from "
+            "a zero-initialised one on it; probe with synthetic "
+            "nonzero rows, never real table rows")
     kinds = []
     for j, s in enumerate(leaves):
         v = np.asarray(getattr(s, "_data", s))

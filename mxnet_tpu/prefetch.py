@@ -673,6 +673,13 @@ class RowPrefetcher:
         if self._fut is not None:
             self._fut = None
             _depth_delta(-1)
+        # a plan staged for a batch that will never be stepped would
+        # wedge the table forever (plan_step raises on an unconsumed
+        # plan): settle any in-flight resolve, then discard what it
+        # staged — drop_pending rolls the planned residency back
+        self._group.drain()
+        for ts in self._tables.values():
+            ts.drop_pending()
         it_close = getattr(st.it, "close", None)
         if it_close is not None:
             try:

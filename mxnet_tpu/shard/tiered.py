@@ -73,7 +73,7 @@ from ..base import MXNetError
 from ..observability import registry as _obs_registry
 
 __all__ = ["TieredState", "on_plan", "register_hbm_rows", "hbm_rows_for",
-           "state_for", "tiered_tables", "swap_for_save",
+           "state_for", "tiered_tables", "release", "swap_for_save",
            "prepare_restore", "finish_restore"]
 
 _reg = _obs_registry()
@@ -90,6 +90,10 @@ _hit_rate_g = _reg.gauge("embed_cache_hit_rate")
 #     BEFORE any plan resolves the name (ShardPlan._check_large_replicated
 #     reads it to warn on HBM-resident bytes, not the host-tier shard)
 #   _REGISTRY — live TieredState per converted table (checkpoint routing)
+# Because checkpoint routing is name-keyed, a second LIVE table under an
+# already-registered name is a hard error at conversion (`on_plan`) —
+# a silent overwrite would route saves/restores into the wrong
+# TieredState. Discarding a model frees its name via `release`.
 _HBM_ROWS = {}
 _REGISTRY = {}
 
@@ -112,6 +116,18 @@ def state_for(name):
 def tiered_tables():
     """{name: TieredState} for every converted table in this process."""
     return dict(_REGISTRY)
+
+
+def release(name):
+    """Drop a discarded table's registry entries (its live `TieredState`
+    and the declared hbm_rows budget). Call this when the model/trainer
+    that owned a tiered table is discarded and a NEW table will reuse
+    its parameter name — e.g. rebuilding a same-prefix model for a
+    checkpoint restore — because `on_plan` refuses a name collision
+    rather than silently rerouting checkpoints. Returns True when a
+    live state was registered under `name`."""
+    _HBM_ROWS.pop(name, None)
+    return _REGISTRY.pop(name, None) is not None
 
 
 @jax.jit
@@ -183,6 +199,7 @@ class TieredState:
         self._lock = threading.RLock()
         self._listeners = []
         self._pending = None
+        self._staged_rows = None   # (ids, slots) of an outstanding plan
         self._zero_blocks = {}     # M -> cached all-sentinel arg tuple
         # filled by _attach:
         self.axis = self.n_shards = self.n_slots = None
@@ -247,6 +264,7 @@ class TieredState:
         self.stamp = np.zeros((n_slots,), np.int64)
         self.clock = 0
         self._pending = None
+        self._staged_rows = None
         self._zero_blocks.clear()
 
     def _init_host_state(self, old_leaves=()):
@@ -275,13 +293,26 @@ class TieredState:
     def retier(self, trainer, plan, index):
         """Elastic reshard (Trainer.resize_mesh): flush the live cache
         into the host tier on the OLD mesh, then rebuild the device tier
-        directly on the new plan's shardings. Any RowPrefetcher feeding
-        this table keeps working (listeners survive), but its staged
-        plan — if one was in flight — is dropped with the cache."""
+        directly on the new plan's shardings. The host tier — weight AND
+        the row-like optimizer-state stores — is preserved across the
+        rebuild: the stores are mesh-free (vocab, D) numpy arrays and
+        after `flush` they ARE the logical state, so re-initialising
+        them here would silently zero momentum/Adam rows and re-derive
+        fp32 masters from the low-precision weight. Any RowPrefetcher
+        feeding this table keeps working (listeners survive), but its
+        staged plan — if one was in flight — is dropped with the
+        cache."""
         with self._lock:
             self.flush()
+            n_host = len(self.host_state)
             self._attach(trainer, plan, index)
-            self._init_host_state()
+            if sum(map(bool, self.row_like)) != n_host:
+                raise MXNetError(
+                    f"tiered embedding {self.name!r}: the rebuilt "
+                    f"optimizer state has "
+                    f"{sum(map(bool, self.row_like))} row-like leaves "
+                    f"but the host tier holds {n_host} stores — the "
+                    f"optimizer changed shape across resize_mesh")
 
     # ------------------------------------------------- the row pipeline
     def plan_step(self, idx):
@@ -351,6 +382,10 @@ class TieredState:
             self.clock += 1
             self.stamp[self.slot_of[uniq]] = self.clock
             self._pending = self._incoming(misses, new_slots, M)
+            # the staged rows' cache slots hold stale data until the
+            # step's scatter-in lands: flush/lookup must keep reading
+            # them host-side, and drop_pending can roll them back
+            self._staged_rows = (misses, new_slots)
             slots_flat = self.slot_of[flat].astype(np.int32)
         return slots_flat.reshape(idx.shape)
 
@@ -427,7 +462,43 @@ class TieredState:
     def take_pending(self):
         with self._lock:
             out, self._pending = self._pending, None
+            if out is not None:
+                # the consuming dispatch scatters the staged rows in;
+                # from here their cache slots are the live copies
+                self._staged_rows = None
             return out
+
+    def drop_pending(self):
+        """Discard a staged-but-never-stepped row plan
+        (`RowPrefetcher.close` after a fetched batch was abandoned):
+        without this the table is wedged — the next `plan_step` raises
+        forever on the unconsumed plan. The staged incoming rows never
+        reached the cache, so their residency rolls back (the host rows
+        are still authoritative — `_incoming` copied, never moved) and
+        the next plan starts clean. Returns True when a plan was
+        dropped."""
+        with self._lock:
+            if self._pending is None:
+                return False
+            staged, self._staged_rows = self._staged_rows, None
+            self._pending = None
+            if staged is not None:
+                ids, slots = staged
+                if ids.size:
+                    self.slot_of[ids] = -1
+                    self.id_at[slots] = -1
+                    self.stamp[slots] = 0
+            return True
+
+    def _live_slots(self):
+        """Resident slots whose CACHE rows are current — excludes slots
+        claimed by an outstanding plan (their scatter-in has not run;
+        the host tier still holds their rows)."""
+        live = np.flatnonzero(self.id_at >= 0)
+        staged = self._staged_rows
+        if staged is not None and staged[1].size:
+            live = np.setdiff1d(live, staged[1], assume_unique=True)
+        return live
 
     # step listeners: cachedop fires notify_step() after a dispatch's
     # rebinds — RowPrefetcher hangs the NEXT batch's resolve off it
@@ -453,7 +524,7 @@ class TieredState:
         cached — maps unchanged). After this, host_weight/host_state ARE
         the logical table+state."""
         with self._lock:
-            live = np.flatnonzero(self.id_at >= 0)
+            live = self._live_slots()
             if not live.size:
                 return
             blocks = self._gather_rows(live)
@@ -505,6 +576,7 @@ class TieredState:
             self.stamp[:] = 0
             self.clock = 0
             self._pending = None
+            self._staged_rows = None
             self._zero_blocks.clear()
 
     # ----------------------------------------------------- eager reads
@@ -516,7 +588,7 @@ class TieredState:
         idx = np.asarray(idx)
         with self._lock:
             table = self.host_weight
-            live = np.flatnonzero(self.id_at >= 0)
+            live = self._live_slots()
             if live.size:
                 rows = self._gather_rows(live)[0]
                 table = table.copy()
@@ -550,6 +622,16 @@ def on_plan(trainer, plan):
                 f"{type(opt).__name__} is not elementwise — the tiered "
                 f"cache requires the sparse fast path's scatter-add "
                 f"update")
+        prev = _REGISTRY.get(p.name)
+        if prev is not None and prev.param is not p:
+            raise MXNetError(
+                f"tiered embedding {p.name!r}: a different live table "
+                f"is already registered under this parameter name — "
+                f"checkpoint routing is name-keyed, so a silent "
+                f"overwrite would route saves/restores into the wrong "
+                f"table. Give the blocks distinct prefixes, or call "
+                f"shard.tiered.release({p.name!r}) after discarding "
+                f"the old model")
         ts = TieredState(p, marker["hbm_rows"])
         if tuple(p._data.shape) != (ts.vocab, ts.dim):
             raise MXNetError(
@@ -561,7 +643,15 @@ def on_plan(trainer, plan):
         ts.host_weight = np.array(np.asarray(p._data._data))
         old_leaves = _state_leaves(trainer._updater, index)
         ts._attach(trainer, plan, index)
-        probe = NDArray(jnp.asarray(ts.host_weight[:2]))
+        # probe the optimizer's state-init rule on a SYNTHETIC,
+        # guaranteed-nonzero row slice — probing real table rows
+        # (zero-initialised embeddings and padding rows are common)
+        # makes an fp32-master leaf (== the weight cast) look all-zero
+        # and misclassify as "zero", silently zeroing restored masters
+        probe_np = np.linspace(0.25, 1.0, 2 * ts.dim,
+                               dtype=np.float64).reshape(2, ts.dim)
+        probe = NDArray(jnp.asarray(
+            probe_np.astype(ts.host_weight.dtype)))
         ts.kinds = _mt.classify_state_rows(opt, index, probe)
         if len(ts.kinds) != len(ts.row_like) or any(
                 (k is not None) != rl

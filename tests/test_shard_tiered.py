@@ -158,6 +158,71 @@ def test_fetch_without_step_raises():
     pf.close()
 
 
+def test_close_after_fetch_without_step_unwedges_table():
+    """RowPrefetcher.close() discards a staged-but-never-stepped plan
+    and rolls back its planned residency — a fresh prefetcher on the
+    same table starts clean instead of raising forever on the
+    unconsumed plan."""
+    net, tr, step = _build(True)
+    batches = _batches(3, seed=7)
+    src = iter([(nd.array(x, dtype=np.int32), nd.array(y))
+                for x, y in batches])
+    pf = RowPrefetcher(src, tr, tables={0: net.embed})
+    next(iter(pf))                     # fetched, never stepped
+    pf.close()
+    ts = net.embed.weight._tiered_state
+    assert ts._pending is None
+    assert not (ts.id_at >= 0).any()   # rolled back: cache fully cold
+    src2 = iter([(nd.array(x, dtype=np.int32), nd.array(y))
+                 for x, y in batches])
+    with RowPrefetcher(src2, tr, tables={0: net.embed}) as pf2:
+        n = 0
+        for xb, yb in pf2:
+            step(xb, yb)
+            n += 1
+    assert n == len(batches)
+
+
+def test_duplicate_tiered_name_raises_until_released():
+    """Two LIVE tiered tables under one parameter name cannot coexist —
+    checkpoint routing is name-keyed, so a silent overwrite would
+    cross-route saves/restores; tiered.release() frees a discarded
+    model's name."""
+    _build(True, prefix="dup_")
+    with pytest.raises(MXNetError, match="already registered"):
+        _build(True, prefix="dup_")
+    assert stiered.release("dup_shardedembedding0_weight")
+    net2, _, _ = _build(True, prefix="dup_")
+    assert stiered.state_for("dup_shardedembedding0_weight") \
+        is net2.embed.weight._tiered_state
+    stiered.release("dup_shardedembedding0_weight")
+
+
+def test_master_state_classified_on_zero_initialized_table():
+    """fp32-master leaves classify as "master" even when the real table
+    rows are all-zero (zero-init / padding rows): the state-init probe
+    is synthetic nonzero, so a checkpoint restore re-derives masters
+    from the restored weight cast instead of silently zeroing them."""
+    mx.random.seed(0)
+    emb = gluon.nn.ShardedEmbedding(V, D, dtype=np.float16,
+                                    tiered=True, hbm_rows=HBM)
+    emb.initialize(mx.init.Zero())
+    tr = gluon.Trainer(emb.collect_params(), "sgd",
+                       {"learning_rate": 0.1, "momentum": 0.9,
+                        "multi_precision": True}, kvstore="ici")
+    tr.shard(mesh={"dp": 2, "tp": 2})
+    ts = emb.weight._tiered_state
+    try:
+        assert ts.kinds == ("master", "zero")
+        full = _rng.randn(V, D).astype(np.float16)
+        ts.import_table(full)
+        assert np.array_equal(ts.host_state[0],
+                              full.astype(np.float32))
+        assert not ts.host_state[1].any()
+    finally:
+        stiered.release(emb.weight.name)
+
+
 def test_untiered_parameter_rejected_by_prefetcher():
     net, tr, step = _build(False)
     with pytest.raises(MXNetError, match="not a converted tiered"):
@@ -197,7 +262,10 @@ def test_checkpoint_restore_onto_resized_mesh():
     ent = tmeta["ck_shardedembedding0_weight"]
     assert (ent["vocab"], ent["dim"]) == (V, D)
 
-    # fresh model, SMALLER mesh (2,2) -> (1,2)
+    # fresh model, SMALLER mesh (2,2) -> (1,2); the old model is done
+    # with, so free its name first — conversion raises on a live
+    # name collision instead of silently rerouting checkpoints
+    assert stiered.release("ck_shardedembedding0_weight")
     net2, tr2, step2 = _build(True, mesh={"dp": 1, "tp": 2}, prefix="ck_")
     template = {p.name: p.data()._data for p in tr2._params}
     checkpoint.load_sharded(d, 1, template)
@@ -212,19 +280,28 @@ def test_checkpoint_restore_onto_resized_mesh():
 
 def test_resize_mesh_retiers_in_place():
     """Trainer.resize_mesh flushes the cache, rebuilds the device tier
-    on the new plan, and the SAME prefetcher keeps feeding steps."""
+    on the new plan — preserving the host-tier WEIGHT and row-like
+    optimizer state (momentum must not silently zero) — and the SAME
+    prefetcher keeps feeding steps."""
     batches = _batches(4)
-    net, tr, step = _build(True)
+    net, tr, step = _build(True, "sgd",
+                           {"learning_rate": 0.1, "momentum": 0.9})
     src = iter([(nd.array(x, dtype=np.int32), nd.array(y))
                 for x, y in batches])
     pf = RowPrefetcher(src, tr, tables={0: net.embed})
     it = iter(pf)
     xb, yb = next(it)
     step(xb, yb)
-    before = net.embed.weight._tiered_state.export_table()
-    tr.resize_mesh({"dp": 1, "tp": 2})
     ts = net.embed.weight._tiered_state
+    before = ts.export_table()
+    before_state = ts.export_state()
+    assert any(s.any() for s in before_state), \
+        "momentum must be nonzero pre-resize for the check to bite"
+    tr.resize_mesh({"dp": 1, "tp": 2})
+    assert net.embed.weight._tiered_state is ts
     assert np.array_equal(ts.export_table(), before)   # flush preserved
+    for a, b in zip(before_state, ts.export_state()):
+        assert np.array_equal(a, b)    # state rode the host tier intact
     assert tuple(net.embed.weight._data.shape) == (2 * HBM, D)
     # the staged plan (if any) died with the old cache; the pipeline
     # resumes on the next fetch->step cycle
