@@ -360,6 +360,21 @@ def main():
             print(f"[bench] captured-step bench failed: {e!r}",
                   file=sys.stderr)
 
+    # Compile-space autotuner (ISSUE 20): measured winner of the XLA
+    # flag search on the same captured step, as first-class supervisor
+    # fields. Same honesty contract as the serve fields: OMITTED when
+    # the search fails, never faked (speedup 1.0 means the defaults
+    # won — a valid, recorded outcome).
+    if not smoke:
+        try:
+            import bench_mlp
+            ares = bench_mlp.measure_autotune()
+            result["autotune_speedup"] = ares["value"]
+            result["autotune_trials"] = ares["autotune_trials"]
+        except Exception as e:  # pragma: no cover
+            print(f"[bench] autotune bench failed: {e!r}",
+                  file=sys.stderr)
+
     # Rule-sharded captured step (ISSUE 8): steps/s + per-device param
     # bytes of the (dp,tp) shard plan vs the replicated captured step,
     # as first-class supervisor fields. Needs >= 4 devices (a (2,2)

@@ -290,6 +290,55 @@ def measure_captured(on_result=None):
     return res
 
 
+def measure_autotune(on_result=None, trials=5):
+    """The `--autotune` mode (ISSUE 20): run the compile-space search on
+    the bench MLP's own captured step — median warm step time per XLA
+    flag candidate, guard stack live — and report the measured winner.
+    `autotune_speedup` is baseline_ms / winner_ms (1.0 when the default
+    build wins: the search proved the defaults, not a regression);
+    `autotune_trials` is the per-candidate trial count. bench.py records
+    both as first-class supervisor fields — OMITTED when the search
+    fails, never faked."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, tune
+
+    batch, steps, X, y, lossf, build = _setup()
+    net = build()
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.05, "momentum": 0.9})
+    step = tr.capture(lambda a, b: lossf(net(a), b).mean())
+    step(X, y)                        # warm: compile outside the search
+    with tune.capture_workload("captured_step") as caught:
+        step(X, y)
+    wl = caught.get("captured_step")
+    if wl is None:
+        raise RuntimeError("captured_step dispatch was not recorded "
+                           f"(fallback: {step.last_fallback_reason})")
+    res = tune.search(wl, trials=trials)
+    searched = [r for r in res.candidates
+                if not r.candidate.is_baseline]
+    out = {
+        "metric": "autotune_speedup",
+        "value": round(res.speedup, 4),
+        "unit": "x vs untuned captured step",
+        "autotune_trials": trials,
+        "baseline_ms": round(res.baseline.score_ms, 4),
+        "winner_ms": round(res.winner.score_ms, 4),
+        "winner": res.winner.candidate.name,
+        "improved": res.improved,
+        "candidates_searched": len(searched),
+        "candidates_rejected": sum(1 for r in searched if r.rejected),
+    }
+    print(f"[bench_mlp] autotune: winner={out['winner']} "
+          f"{out['baseline_ms']}ms -> {out['winner_ms']}ms "
+          f"(x{out['value']}, {out['candidates_searched']} candidates, "
+          f"{out['candidates_rejected']} rejected, trials={trials})",
+          file=sys.stderr)
+    if on_result is not None:
+        on_result(out)
+    return out
+
+
 def measure_prefetch(on_result=None):
     """The `--prefetch` mode (ISSUE 5): steps/s of a warm captured step
     fed by (a) the host-prefetch DataLoader baseline and (b) the
@@ -634,6 +683,9 @@ def main():
     trace = None
     if "--captured" in args:
         print(json.dumps(measure_captured()))
+        return
+    if "--autotune" in args:
+        print(json.dumps(measure_autotune()))
         return
     if "--prefetch" in args:
         print(json.dumps(measure_prefetch()))

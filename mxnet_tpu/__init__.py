@@ -62,6 +62,8 @@ from . import rtc
 from . import monitor
 from . import observability
 from .observability import set_compilation_cache
+from . import tune
+from .tune import set_autotune
 from . import analysis
 from . import fault
 from . import profiler

@@ -1119,6 +1119,16 @@ class CachedStep:
                     else "moe_step" if moe_live
                     else "sharded_step" if plan is not None
                     else "captured_step")
+        # autotune (ISSUE 20): the shard-plan signature versions any
+        # stored compile-space winner (a winner tuned under one layout
+        # is stale under another, tune_stale{reason=plan}), and the
+        # training step's numerics contract is the documented fp
+        # tolerance — optimisation may re-associate, not drift
+        from . import tune as _tune
+        _tune.note_plan(exe_name,
+                        None if plan is None else str(plan.signature()))
+        _tune.register_contract(exe_name, "allclose", rtol=1e-5,
+                                atol=1e-7)
         jfn = _compilex.instrument(
             jax.jit(fn, donate_argnums=(1, 3), **jit_kwargs), exe_name)
         meta.update({

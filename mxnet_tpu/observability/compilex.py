@@ -66,7 +66,8 @@ from .metrics_registry import registry as _registry
 __all__ = ["instrument", "InstrumentedJit", "inspect_hlo_text",
            "analyze_jit", "analyze_compiled", "set_compilation_cache",
            "compilation_cache_dir", "compile_cache_stats", "executables",
-           "instrumented", "COLLECTIVE_OPS"]
+           "instrumented", "COLLECTIVE_OPS", "set_dispatch_hook",
+           "dispatch_hook"]
 
 # HLO collective opcodes tallied into hlo_collectives{op=}; async
 # ("-start") forms count toward the same op, "-done" halves do not.
@@ -210,6 +211,31 @@ def analyze_jit(jfn, *args, **kwargs):
         _tl.inspecting = prev
 
 
+# --------------------------------------------------------- dispatch hook
+# one process-wide interception point over EVERY instrumented dispatch:
+# `fn(ij, args, kwargs) -> (handled, out)`. handled=True short-circuits
+# the normal jit route with `out` (the autotuner's winner-application
+# path, tune/apply.py); handled=False falls through untouched (the
+# workload-capture recorder, tune/search.py, stacks by chaining). The
+# hook owns its own error containment — an exception here propagates to
+# the caller like any dispatch failure.
+_hook = None
+
+
+def set_dispatch_hook(fn):
+    """Install (or with None, remove) the dispatch hook. Returns the
+    previous hook so callers can chain/restore."""
+    global _hook
+    prev = _hook
+    _hook = fn
+    return prev
+
+
+def dispatch_hook():
+    """The active dispatch hook, or None."""
+    return _hook
+
+
 # ------------------------------------------------------- the instrument
 def _policy():
     return os.environ.get("MXTPU_HLO_TELEMETRY", "auto").lower()
@@ -259,6 +285,11 @@ class InstrumentedJit:
         return getattr(self._jfn, name)
 
     def __call__(self, *args, **kwargs):
+        hook = _hook
+        if hook is not None:
+            handled, out = hook(self, args, kwargs)
+            if handled:
+                return out
         csize = self._csize
         n0 = csize() if csize is not None else None
         t0_ns = perf_counter_ns()

@@ -30,6 +30,8 @@ import jax.numpy as jnp
 from jax import lax
 
 from .. import _env
+from ..observability.metrics_registry import registry as _metrics_registry
+from ..tune import overrides as _tune_overrides
 
 try:
     from jax.experimental import pallas as pl
@@ -69,19 +71,92 @@ def _pallas_ok(seq_len):
             and seq_len % 128 == 0 and seq_len >= 128)
 
 
+_breg = _metrics_registry()
+_ignored_warned = set()          # (knob, value, dim): warn once each
+
+
+def _note_ignored(source, knob, val, dim, fallback):
+    """A forced block override the kernel cannot honour used to be
+    SILENTLY dropped — the tuner (and any operator A/B-ing knobs) then
+    measures the default config under the override's label. Count every
+    dead override on `pallas_block_override_ignored{knob=}` and warn
+    once per (knob, value, dim)."""
+    _breg.counter("pallas_block_override_ignored", knob=knob).inc()
+    key = (knob, val, dim)
+    if key not in _ignored_warned:
+        _ignored_warned.add(key)
+        import warnings
+        warnings.warn(
+            f"{knob}={val} (from {source}) is incompatible with size "
+            f"{dim}; using {fallback} — the override is DEAD",
+            RuntimeWarning, stacklevel=4)
+
+
+def _knob(name, env):
+    """Resolve one tunable kernel knob: the autotuner's thread-local
+    override scope (tune/overrides.py) wins, the MXTPU_* env var is the
+    operator-facing fallback. Returns (value, source); 0 = unset."""
+    cfg = _tune_overrides.current()
+    if cfg is not None and name in cfg:
+        return int(cfg[name]), "tune override"
+    return _env.env_int(env, 0, minimum=0), "env"
+
+
 def _block_sizes(sq, sk):
     """Largest tiling block (<=512) that divides each sequence length —
     bigger blocks amortise grid overhead and feed the MXU larger dots;
-    override with MXTPU_FLASH_BLOCK_Q / MXTPU_FLASH_BLOCK_K."""
-    def pick(s, env):
-        forced = _env.env_int(env, 0, minimum=0)
-        if forced and s % forced == 0:
-            return min(forced, s)
+    override with MXTPU_FLASH_BLOCK_Q / MXTPU_FLASH_BLOCK_K (or a
+    tune/overrides.py scope). A forced value that does not divide the
+    sequence falls back LOUDLY (`pallas_block_override_ignored`)."""
+    def auto(s):
         for b in (512, 256, 128):
             if s % b == 0:
                 return b
         return 128
-    return pick(sq, "MXTPU_FLASH_BLOCK_Q"), pick(sk, "MXTPU_FLASH_BLOCK_K")
+
+    def pick(s, name, env):
+        forced, src = _knob(name, env)
+        if forced and s % forced == 0:
+            return min(forced, s)
+        fb = auto(s)
+        if forced:
+            _note_ignored(src, env, forced, s, fb)
+        return fb
+    return (pick(sq, "flash_block_q", "MXTPU_FLASH_BLOCK_Q"),
+            pick(sk, "flash_block_k", "MXTPU_FLASH_BLOCK_K"))
+
+
+def _rpa_block_k(psize):
+    """Sub-page K block of the ragged-paged-attention kernels (ISSUE
+    20): the inner grid walks `psize // block` steps per page, each
+    DMA-ing a (block, dh) tile — smaller blocks overlap compute with
+    more, smaller DMAs; the default (= psize) keeps one page per step.
+    MXTPU_RPA_BLOCK_K / tune override `rpa_block_k`; must divide the
+    page size and keep the 8-sublane tile, else the default is used
+    loudly."""
+    forced, src = _knob("rpa_block_k", "MXTPU_RPA_BLOCK_K")
+    if not forced:
+        return psize
+    if forced % 8 == 0 and 8 <= forced <= psize and psize % forced == 0:
+        return forced
+    _note_ignored(src, "MXTPU_RPA_BLOCK_K", forced, psize, psize)
+    return psize
+
+
+def _rpa_sublanes(W):
+    """Padded query-row count of the WIDENED (multi-query verify) RPA
+    launch: default rounds W up to the Mosaic 8-sublane tile; a larger
+    forced value (MXTPU_RPA_SUBLANES / tune override `rpa_sublanes`)
+    trades padded-row compute for bigger VPU tiles. Must be >= W and a
+    multiple of 8, else the default is used loudly."""
+    default = max(8, -(-W // 8) * 8)
+    forced, src = _knob("rpa_sublanes", "MXTPU_RPA_SUBLANES")
+    if not forced:
+        return default
+    if forced % 8 == 0 and forced >= W:
+        return max(forced, 8)
+    _note_ignored(src, "MXTPU_RPA_SUBLANES", forced, W, default)
+    return default
 
 
 def _sds(shape, dtype, *refs):
@@ -794,11 +869,14 @@ def _paged_attention_lax_multi(q, k_pages, v_pages, page_tables, lengths,
     return out.transpose(0, 2, 1, 3)
 
 
-def _rpa_kernel(*refs, psize, num_heads, sm_scale, quant=False):
-    """Ragged paged attention, one (slot, head) per grid row, one KV page
-    per inner step. The page id for (slot, page_slot) was already consumed
-    by the BlockSpec index maps (scalar prefetch); here we only need the
-    slot's valid length for masking and dead-page skipping.
+def _rpa_kernel(*refs, psize, block_k, num_heads, sm_scale, quant=False):
+    """Ragged paged attention, one (slot, head) per grid row, one
+    (block_k, dh) KV tile per inner step — `psize // block_k` steps per
+    page (block_k == psize is the one-page-per-step default; the
+    autotuner searches smaller tiles, `_rpa_block_k`). The page id for
+    (slot, page_slot) was already consumed by the BlockSpec index maps
+    (scalar prefetch); here we only need the slot's valid length for
+    masking and dead-page skipping.
 
     quant (ISSUE 14): the page pools are int8 and two extra scalar-
     prefetch refs carry the per-page/per-head dequant scales as BITCAST
@@ -812,14 +890,15 @@ def _rpa_kernel(*refs, psize, num_heads, sm_scale, quant=False):
         ks_ref = vs_ref = None
         (pt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
          m_scr, l_scr, acc_scr) = refs
+    npb = psize // block_k                  # sub-page blocks per page
     g = pl.program_id(0)                    # slot * num_heads + head
-    j = pl.program_id(1)                    # page slot within the request
+    j = pl.program_id(1)                    # page slot * npb + block
     nj = pl.num_programs(1)
     s_idx = g // num_heads
     length = len_ref[s_idx]
-    k_start = j * psize
+    k_start = j * block_k
     if quant:
-        page = pt_ref[s_idx, j]
+        page = pt_ref[s_idx, j // npb]
         h_idx = g % num_heads
         ks = lax.bitcast_convert_type(ks_ref[h_idx, page], jnp.float32)
         vs = lax.bitcast_convert_type(vs_ref[h_idx, page], jnp.float32)
@@ -830,14 +909,14 @@ def _rpa_kernel(*refs, psize, num_heads, sm_scale, quant=False):
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    # pages entirely beyond the valid length are skipped — the ragged part:
-    # a 3-token request costs one page of work while its 300-token
-    # neighbour walks its whole table, in the same launch
+    # blocks entirely beyond the valid length are skipped — the ragged
+    # part: a 3-token request costs one block of work while its
+    # 300-token neighbour walks its whole table, in the same launch
     @pl.when(k_start < length)
     def _compute():
         q = q_ref[0]                        # (1, dh)
-        k = k_ref[0, 0]                     # (psize, dh)
-        v = v_ref[0, 0]                     # (psize, dh)
+        k = k_ref[0, 0]                     # (block_k, dh)
+        v = v_ref[0, 0]                     # (block_k, dh)
         if quant:
             # dequantize in VMEM, same element-wise form as the lax
             # fallback's gathered dequant (parity pinned in interpret)
@@ -845,7 +924,7 @@ def _rpa_kernel(*refs, psize, num_heads, sm_scale, quant=False):
             v = v.astype(jnp.float32) * vs
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * sm_scale
-        kj = k_start + lax.broadcasted_iota(jnp.int32, (1, psize), 1)
+        kj = k_start + lax.broadcasted_iota(jnp.int32, (1, block_k), 1)
         s = jnp.where(kj < length, s, -1e30)
         m_prev = m_scr[:1, :1]              # (1, 1)
         m_cur = jnp.max(s, axis=-1, keepdims=True)
@@ -883,14 +962,16 @@ def _rpa_pallas(q, k_pages, v_pages, page_tables, lengths, sm_scale,
     psize = k_pages.shape[1]
     npages = page_tables.shape[1]
     quant = k_scales is not None
+    bk = _rpa_block_k(psize)
+    npb = psize // bk               # sub-page K blocks per page
     qr = q.reshape(S * H, 1, dh)
     # page-major layout for the kernel: (H, P, psize, dh) so one (slot,
     # head, page) block is a contiguous (psize, dh) tile
     kr = k_pages.transpose(2, 0, 1, 3)
     vr = v_pages.transpose(2, 0, 1, 3)
-    grid = (S * H, npages)
-    kern = functools.partial(_rpa_kernel, psize=psize, num_heads=H,
-                             sm_scale=sm_scale, quant=quant)
+    grid = (S * H, npages * npb)
+    kern = functools.partial(_rpa_kernel, psize=psize, block_k=bk,
+                             num_heads=H, sm_scale=sm_scale, quant=quant)
     nsp = 4 if quant else 2
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=nsp,        # page tables + lengths (+ scales)
@@ -899,13 +980,14 @@ def _rpa_pallas(q, k_pages, v_pages, page_tables, lengths, sm_scale,
             pl.BlockSpec((1, 1, dh), lambda g, j, pt, ln, *_: (g, 0, 0)),
             # the paged gather: the page id comes from the scalar-
             # prefetched table, so the DMA fetches exactly the pages the
-            # slot owns — never a dense (S, Lmax) context
-            pl.BlockSpec((1, 1, psize, dh),
-                         lambda g, j, pt, ln, *_, _h=H:
-                         (g % _h, pt[g // _h, j], 0, 0)),
-            pl.BlockSpec((1, 1, psize, dh),
-                         lambda g, j, pt, ln, *_, _h=H:
-                         (g % _h, pt[g // _h, j], 0, 0)),
+            # slot owns — never a dense (S, Lmax) context; with bk <
+            # psize the dim-2 block index walks the npb tiles of a page
+            pl.BlockSpec((1, 1, bk, dh),
+                         lambda g, j, pt, ln, *_, _h=H, _b=npb:
+                         (g % _h, pt[g // _h, j // _b], j % _b, 0)),
+            pl.BlockSpec((1, 1, bk, dh),
+                         lambda g, j, pt, ln, *_, _h=H, _b=npb:
+                         (g % _h, pt[g // _h, j // _b], j % _b, 0)),
         ],
         out_specs=pl.BlockSpec((1, 1, dh),
                                lambda g, j, pt, ln, *_: (g, 0, 0)),
@@ -929,7 +1011,8 @@ def _rpa_pallas(q, k_pages, v_pages, page_tables, lengths, sm_scale,
     return out.reshape(S, H, dh)
 
 
-def _rpa_multi_kernel(*refs, psize, num_heads, sm_scale, quant=False):
+def _rpa_multi_kernel(*refs, psize, block_k, num_heads, sm_scale,
+                      quant=False):
     """Widened ragged paged attention (ISSUE 12): W query rows per
     (slot, head) grid row, one KV page per inner step. Query row i masks
     keys at `len_ref[slot] + i` — consecutive positions, so a single
@@ -944,15 +1027,16 @@ def _rpa_multi_kernel(*refs, psize, num_heads, sm_scale, quant=False):
         ks_ref = vs_ref = None
         (pt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
          m_scr, l_scr, acc_scr) = refs
+    npb = psize // block_k                  # sub-page blocks per page
     g = pl.program_id(0)                    # slot * num_heads + head
-    j = pl.program_id(1)                    # page slot within the request
+    j = pl.program_id(1)                    # page slot * npb + block
     nj = pl.num_programs(1)
     s_idx = g // num_heads
     length = len_ref[s_idx]                 # keys visible to query row 0
-    k_start = j * psize
+    k_start = j * block_k
     wp = q_ref.shape[1]                     # padded query rows (>= 8)
     if quant:
-        page = pt_ref[s_idx, j]
+        page = pt_ref[s_idx, j // npb]
         h_idx = g % num_heads
         ks = lax.bitcast_convert_type(ks_ref[h_idx, page], jnp.float32)
         vs = lax.bitcast_convert_type(vs_ref[h_idx, page], jnp.float32)
@@ -963,26 +1047,26 @@ def _rpa_multi_kernel(*refs, psize, num_heads, sm_scale, quant=False):
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    # a page is live when ANY query row can see it: row wp-1 sees
+    # a block is live when ANY query row can see it: row wp-1 sees
     # length + wp - 1 keys
     @pl.when(k_start < length + wp - 1)
     def _compute():
         q = q_ref[0]                        # (wp, dh)
-        k = k_ref[0, 0]                     # (psize, dh)
-        v = v_ref[0, 0]                     # (psize, dh)
+        k = k_ref[0, 0]                     # (block_k, dh)
+        v = v_ref[0, 0]                     # (block_k, dh)
         if quant:
             k = k.astype(jnp.float32) * ks
             v = v.astype(jnp.float32) * vs
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * sm_scale
-        qi = lax.broadcasted_iota(jnp.int32, (wp, psize), 0)
-        kj = k_start + lax.broadcasted_iota(jnp.int32, (wp, psize), 1)
+        qi = lax.broadcasted_iota(jnp.int32, (wp, block_k), 0)
+        kj = k_start + lax.broadcasted_iota(jnp.int32, (wp, block_k), 1)
         s = jnp.where(kj < length + qi, s, -1e30)
         m_prev = m_scr[:, :1]               # (wp, 1)
         m_cur = jnp.max(s, axis=-1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_cur)
         alpha = jnp.exp(m_prev - m_new)
-        p = jnp.exp(s - m_new)              # (wp, psize) fp32
+        p = jnp.exp(s - m_new)              # (wp, block_k) fp32
         l_new = alpha * l_scr[:, :1] + jnp.sum(p, axis=-1, keepdims=True)
         acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
             p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
@@ -1002,29 +1086,32 @@ def _rpa_multi_pallas(q, k_pages, v_pages, page_tables, lengths, sm_scale,
     psize = k_pages.shape[1]
     npages = page_tables.shape[1]
     quant = k_scales is not None
-    # pad the query-row dim to the Mosaic 8-sublane tile; extra rows
-    # attend a few more (valid-page) keys and are sliced away below
-    wp = max(8, -(-W // 8) * 8)
+    bk = _rpa_block_k(psize)
+    npb = psize // bk               # sub-page K blocks per page
+    # pad the query-row dim to the Mosaic 8-sublane tile (or the forced
+    # tuner sublane count); extra rows attend a few more (valid-page)
+    # keys and are sliced away below
+    wp = _rpa_sublanes(W)
     qr = q.transpose(0, 2, 1, 3).reshape(S * H, W, dh)
     if wp != W:
         qr = jnp.pad(qr, ((0, 0), (0, wp - W), (0, 0)))
     kr = k_pages.transpose(2, 0, 1, 3)      # (H, P, psize, dh)
     vr = v_pages.transpose(2, 0, 1, 3)
-    grid = (S * H, npages)
-    kern = functools.partial(_rpa_multi_kernel, psize=psize, num_heads=H,
-                             sm_scale=sm_scale, quant=quant)
+    grid = (S * H, npages * npb)
+    kern = functools.partial(_rpa_multi_kernel, psize=psize, block_k=bk,
+                             num_heads=H, sm_scale=sm_scale, quant=quant)
     nsp = 4 if quant else 2
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=nsp,        # page tables + lengths (+ scales)
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, wp, dh), lambda g, j, pt, ln, *_: (g, 0, 0)),
-            pl.BlockSpec((1, 1, psize, dh),
-                         lambda g, j, pt, ln, *_, _h=H:
-                         (g % _h, pt[g // _h, j], 0, 0)),
-            pl.BlockSpec((1, 1, psize, dh),
-                         lambda g, j, pt, ln, *_, _h=H:
-                         (g % _h, pt[g // _h, j], 0, 0)),
+            pl.BlockSpec((1, 1, bk, dh),
+                         lambda g, j, pt, ln, *_, _h=H, _b=npb:
+                         (g % _h, pt[g // _h, j // _b], j % _b, 0)),
+            pl.BlockSpec((1, 1, bk, dh),
+                         lambda g, j, pt, ln, *_, _h=H, _b=npb:
+                         (g % _h, pt[g // _h, j // _b], j % _b, 0)),
         ],
         out_specs=pl.BlockSpec((1, wp, dh),
                                lambda g, j, pt, ln, *_: (g, 0, 0)),
@@ -1082,7 +1169,13 @@ def ragged_paged_attention(q, k_pages, v_pages, page_tables, lengths,
     it to DMA exactly the owned pages, skipping pages beyond each slot's
     length — mixed-length slots share one launch. Elsewhere the pure-lax
     gather fallback reproduces the same numbers through
-    `single_query_cached_attention` (inference-only; no custom vjp)."""
+    `single_query_cached_attention` (inference-only; no custom vjp).
+
+    Tunable knobs (ISSUE 20; MXTPU_RPA_BLOCK_K / MXTPU_RPA_SUBLANES or a
+    tune/overrides.py scope): sub-page K tile size of the inner grid
+    (`_rpa_block_k`) and the padded query-row count of the widened form
+    (`_rpa_sublanes`). Invalid values fall back loudly
+    (`pallas_block_override_ignored`)."""
     if sm_scale is None:
         sm_scale = 1.0 / (q.shape[-1] ** 0.5)
     if (k_scales is None) != (v_scales is None):

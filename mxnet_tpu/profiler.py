@@ -234,6 +234,24 @@ def dumps(reset=False):
     if c_hits or c_misses:
         lines.append(f"[compile-cache] hits={c_hits} misses={c_misses} "
                      f"dir={_compilex.compilation_cache_dir()}")
+    # compile-space autotuner (ISSUE 20): winner applications, stale
+    # rejections by reason, store corruption — the apply-side health of
+    # the measure->decide->apply loop (docs/PERFORMANCE.md "Autotuning")
+    from . import tune as _tune
+    t_applied = _tune.applied_count()
+    t_stale = {dict(c.labels).get("reason"): int(c.value)
+               for c in _reg.series("tune_stale") if c.value}
+    t_corrupt = next((int(c.value) for c in
+                      _reg.series("tune_store_corrupt")), 0)
+    if t_applied or t_stale or t_corrupt or _tune.autotune_dir():
+        line = f"[autotune] applied={t_applied}"
+        if t_stale:
+            line += " stale=" + ",".join(
+                f"{k}:{v}" for k, v in sorted(t_stale.items()))
+        if t_corrupt:
+            line += f" corrupt={t_corrupt}"
+        line += f" dir={_tune.autotune_dir()}"
+        lines.append(line)
     # serving fast path (ISSUE 12): the speculative acceptance
     # distribution — the regression signal for the draft proposer (a
     # falling mean/p95 means more turns per committed token)

@@ -216,6 +216,15 @@ class DecodeRuntime:
                 self._verify_fn = _compilex.instrument(
                     jax.jit(self._verify_program, donate_argnums=(0, 1)),
                     "serve_verify")
+        # autotune (ISSUE 20): greedy decode is bitwise-contracted — a
+        # compile-space candidate that moves ONE logit bit is rejected
+        # by the search guard regardless of speed; these executables are
+        # unsharded (plan None is the note_plan default, nothing to note)
+        from .. import tune as _tune
+        for _exe in ("serve_decode", "serve_decode_int8", "serve_prefill",
+                     "serve_verify", "serve_verify_int8",
+                     "serve_page_remap"):
+            _tune.register_contract(_exe, "bitwise")
 
     # ------------------------------------------------------- programs
     # ONE decode/verify core each, shared by the fp and int8-KV entry

@@ -1,0 +1,31 @@
+"""Compile-space autotuner (ISSUE 20): close the loop the compile
+observatory opened.
+
+PR 11 measures per-executable fusions/copies/compile time; this package
+ACTS on the measurements. `tune.search` scores compile-space candidates
+— Pallas kernel block sizes (tune/overrides.py) and a curated XLA flag
+allowlist — by median warm wall time with the check_fusion HLO counters
+as tie-breaker and hard guard; winners persist in a JSON store beside
+the persistent compilation cache (tune/store.py) keyed by
+(executable, platform, shape-class) and versioned by jax/jaxlib + shard
+plan signature; `mx.set_autotune(dir)` / `MXTPU_AUTOTUNE` applies them
+at lowering time with zero extra retraces (tune/apply.py).
+
+Driver: `tools/autotune.py`. Gate: `tests/test_autotune.py`.
+Docs: docs/PERFORMANCE.md "Autotuning".
+"""
+from . import overrides
+from .apply import (set_autotune, autotune_dir, active_store, note_plan,
+                    plan_signature, register_contract, contract_for,
+                    shape_class, applied_count)
+from .store import TuneStore, store_dir
+from .search import (Candidate, Workload, SearchResult, search,
+                     capture_workload, default_flag_candidates,
+                     check_budget, XLA_FLAG_ALLOWLIST)
+
+__all__ = ["overrides", "set_autotune", "autotune_dir", "active_store",
+           "note_plan", "plan_signature", "register_contract",
+           "contract_for", "shape_class", "applied_count", "TuneStore",
+           "store_dir", "Candidate", "Workload", "SearchResult",
+           "search", "capture_workload", "default_flag_candidates",
+           "check_budget", "XLA_FLAG_ALLOWLIST"]

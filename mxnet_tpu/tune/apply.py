@@ -1,0 +1,296 @@
+"""Winner application (ISSUE 20): make every compilex-instrumented
+entry point (cachedop captured/sharded step, serve prefill/decode/
+verify, fused multi-tensor buckets) compile through its stored
+autotune winner with ZERO extra retraces.
+
+Mechanism: `set_autotune(dir)` (exported as `mx.set_autotune`; env
+`MXTPU_AUTOTUNE=dir` — or `=1` to ride beside the compilation cache —
+wires it at import) registers a dispatch hook with
+`observability/compilex.py`. On the first dispatch of each
+(executable, argument-signature) the hook computes the shape class
+from the live arguments (the same skeleton `InstrumentedJit.
+last_abstract` records), looks the winner up in the `TuneStore`, and
+when one exists takes the AOT route instead of the jit cache:
+
+    with overrides.scope(winner["pallas"]):
+        compiled = jfn.lower(*args, **kwargs)       # ONE trace
+                     .compile(compiler_options=winner["flags"])
+
+The Compiled object is memoised per signature on this side, so warm
+dispatches are a dict hit + `compiled(*args)` — the traced python body
+ran exactly once (serve's `decode_traces`/`verify_traces` invariants
+hold), donation flows through unchanged (jax aliases donated buffers
+through AOT compile), and weak-typed python scalars (per-step lr/wd)
+stay dynamic arguments. `tune_applied{executable=}` counts each
+applied compilation; misses fall straight back to the normal jit path
+with a one-entry negative cache so the store is probed once per
+signature, not per step.
+
+Note the compile-cost tradeoff documented in docs/PERFORMANCE.md: a
+winner's flag set changes the XLA cache key, so the FIRST process
+applying a fresh winner re-pays one compile per executable (absorbed
+by the persistent compilation cache afterwards).
+
+Shard-plan signatures: cachedop calls `note_plan(executable, sig)` as
+it instruments each step executable; the store rejects winners
+recorded under a different plan (`tune_stale{reason=plan}`).
+Numerics contracts (`register_contract`) are declared at the same
+sites and consumed by `tune.search` — the guard side of the loop.
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+import re
+import weakref
+
+__all__ = ["set_autotune", "autotune_dir", "active_store", "note_plan",
+           "plan_signature", "register_contract", "contract_for",
+           "shape_class", "applied_count"]
+
+_DEFAULT_CONTRACT = ("allclose", 1e-5, 1e-7)
+
+_store = None                    # active TuneStore, None = disabled
+_plan_sigs = {}                  # executable -> shard-plan signature
+_contracts = {}                  # executable -> contract tuple
+# per-wrapper memo: InstrumentedJit -> {signature: Compiled | None}
+_compiled = weakref.WeakKeyDictionary()
+
+
+def _reg():
+    from ..observability.metrics_registry import registry
+    return registry()
+
+
+# --------------------------------------------------------- registries
+def note_plan(executable, signature):
+    """Record the shard-plan signature an executable was built under
+    (None = unsharded). Called by cachedop next to `instrument()`."""
+    _plan_sigs[executable] = signature
+
+
+def plan_signature(executable):
+    return _plan_sigs.get(executable)
+
+
+def register_contract(executable, kind, rtol=0.0, atol=0.0):
+    """Declare an executable's numerics contract for the search guard:
+    ``"bitwise"`` (greedy decode — candidate outputs must match the
+    baseline bit for bit) or ``"allclose"`` with a documented fp
+    tolerance (training steps — optimisation may re-associate)."""
+    if kind == "bitwise":
+        _contracts[executable] = ("bitwise",)
+    elif kind == "allclose":
+        _contracts[executable] = ("allclose", float(rtol), float(atol))
+    else:
+        raise ValueError(f"unknown numerics contract kind {kind!r}")
+
+
+def contract_for(executable):
+    return _contracts.get(executable, _DEFAULT_CONTRACT)
+
+
+# -------------------------------------------------------- shape class
+_ADDR_RE = re.compile(r"0x[0-9a-fA-F]+")
+
+
+def _leaf_desc(x):
+    shape = getattr(x, "shape", None)
+    dtype = getattr(x, "dtype", None)
+    if shape is not None and dtype is not None:
+        return f"{tuple(shape)}:{dtype}"
+    # python scalars: the TYPE is the class, never the value — a decayed
+    # lr must not fork a new shape class (nor a new compile: weak-typed
+    # scalars stay dynamic arguments through the AOT route)
+    return f"py:{type(x).__name__}"
+
+
+def shape_class(args, kwargs):
+    """Short stable digest of the argument skeleton: treedef + per-leaf
+    (shape, dtype) with python scalars collapsed to their type. The
+    persisted key half that `InstrumentedJit.last_abstract` carries —
+    shardings are excluded, the plan signature covers layout."""
+    import jax
+    leaves, treedef = jax.tree_util.tree_flatten((args, dict(kwargs)))
+    text = _ADDR_RE.sub("0x", str(treedef)) + "|" + \
+        "|".join(_leaf_desc(l) for l in leaves)
+    return hashlib.blake2b(text.encode(), digest_size=6).hexdigest()
+
+
+def _signature(args, kwargs):
+    """Hashable process-local memo key for the compiled cache — finer
+    than the digest only in that it is cheap and collision-free."""
+    import jax
+    leaves, treedef = jax.tree_util.tree_flatten((args, dict(kwargs)))
+    return (treedef, tuple(_leaf_desc(l) for l in leaves))
+
+
+def _pallas_trace_scope(pallas):
+    """A context that makes a non-empty Pallas override config part of
+    jax's TRACE-CACHE key. Without this, pjit's jaxpr cache serves the
+    baseline trace to every later candidate and the kernel pickers
+    never re-run — the override would be silently unread (the exact
+    mislabelling guard 4 exists to catch). An `xla_metadata` scope is
+    in `config.trace_context()`, so a distinct config string forces an
+    honest re-trace while identical configs still share one."""
+    if not pallas:
+        import contextlib
+        return contextlib.nullcontext()
+    from jax.experimental.xla_metadata import set_xla_metadata
+    cfg = ",".join(f"{k}={pallas[k]}" for k in sorted(pallas))
+    return set_xla_metadata(mxtpu_tune_pallas=cfg)
+
+
+# ----------------------------------------------------------- the hook
+def compile_winner(ij, args, kwargs, entry):
+    """AOT-compile `ij`'s wrapped jit for these arguments under the
+    winner's pallas overrides + XLA flag set, with compilex bookkeeping
+    (compile counters, last_abstract, HLO gauges reflecting the TUNED
+    executable). Shared by the apply hook and `tune.search`."""
+    from time import perf_counter_ns
+    import jax
+    from ..observability import compilex as _compilex
+    from . import overrides as _overrides
+    flags = {k: v for k, v in (entry.get("flags") or {}).items()}
+    pallas = entry.get("pallas") or None
+    t0 = perf_counter_ns()
+    prev = getattr(_compilex._tl, "label", None)
+    _compilex._tl.label = ij.executable
+    try:
+        with _overrides.scope(pallas), _pallas_trace_scope(pallas):
+            lowered = ij._jfn.lower(*args, **kwargs)
+            compiled = lowered.compile(compiler_options=flags or None)
+    finally:
+        _compilex._tl.label = prev
+    dt = (perf_counter_ns() - t0) / 1e9
+    ij._compiles.inc()
+    ij._seconds.observe(dt)
+    ij.last_compile_seconds = dt
+    try:
+        ij.last_abstract = jax.tree_util.tree_map(
+            _compilex._abstract, (args, dict(kwargs)))
+    except Exception:
+        ij.last_abstract = None
+    info = _compilex.analyze_compiled(compiled)
+    _publish(ij, info)
+    return compiled, info
+
+
+def _publish(ij, info):
+    """Mirror compilex's HLO gauge publication for a tuned compile so
+    check_fusion and the profiler see the winner's REAL structure, not
+    the default-flag build's."""
+    from ..observability import compilex as _compilex
+    reg = _compilex._reg
+    ex = ij.executable
+    ij.last_hlo = info
+    _compilex._inspected.add(ex)
+    _compilex._instances[ex] = ij
+    reg.gauge("hlo_fusions", executable=ex).set(info["fusions"])
+    reg.gauge("hlo_collective_total",
+              executable=ex).set(info["collective_total"])
+    for op, n in info["collectives"].items():
+        reg.gauge("hlo_collectives", executable=ex, op=op).set(n)
+    reg.gauge("hlo_copies", executable=ex).set(info["copies"])
+    reg.gauge("hlo_aliased_inputs",
+              executable=ex).set(info["aliased_inputs"])
+    reg.gauge("hlo_bytes", executable=ex).set(info["module_bytes"])
+
+
+def _hook(ij, args, kwargs):
+    """compilex dispatch hook: (handled, out). Never raises out of the
+    lookup/compile path — a broken store or un-lowerable winner counts
+    on `tune_apply_errors` and falls back to the normal jit route."""
+    store = _store
+    if store is None:
+        return False, None
+    try:
+        sig = _signature(args, kwargs)
+        memo = _compiled.get(ij)
+        if memo is None:
+            memo = _compiled[ij] = {}
+        if sig in memo:
+            compiled = memo[sig]
+            if compiled is None:
+                return False, None
+        else:
+            import jax
+            platform = jax.default_backend()
+            entry = store.lookup(ij.executable, platform,
+                                 shape_class(args, kwargs),
+                                 plan=_plan_sigs.get(ij.executable))
+            if entry is None or \
+                    not (entry.get("flags") or entry.get("pallas")):
+                memo[sig] = None
+                return False, None
+            compiled, _ = compile_winner(ij, args, kwargs, entry)
+            memo[sig] = compiled
+            _reg().counter("tune_applied", executable=ij.executable).inc()
+    except Exception as e:
+        _reg().counter("tune_apply_errors").inc()
+        import warnings
+        warnings.warn(f"autotune apply failed for {ij.executable!r} "
+                      f"({e!r}); using the untuned path",
+                      RuntimeWarning, stacklevel=3)
+        try:
+            _compiled.setdefault(ij, {})[_signature(args, kwargs)] = None
+        except Exception:
+            pass
+        return False, None
+    # execution errors (donation misuse etc.) propagate — they are the
+    # caller's bug exactly as on the untuned path
+    return True, compiled(*args, **kwargs)
+
+
+# ------------------------------------------------------------- switch
+def set_autotune(path=None, enabled=True):
+    """Enable winner application from the store at `path` (resolution
+    falls back to MXTPU_TUNE_DIR, then the compilation cache dir — see
+    tune/store.py). `enabled=False` (or a store with no resolvable
+    directory) disables and unhooks. Returns the active store dir or
+    None. Exported as `mx.set_autotune`; `MXTPU_AUTOTUNE=<dir|1>`
+    applies it at import time."""
+    global _store
+    from ..observability import compilex as _compilex
+    from .store import TuneStore
+    if not enabled:
+        _store = None
+        _compilex.set_dispatch_hook(None)
+        _compiled.clear()
+        return None
+    st = TuneStore(path)
+    if st.dir is None:
+        _store = None
+        _compilex.set_dispatch_hook(None)
+        _compiled.clear()
+        return None
+    _store = st
+    _compiled.clear()
+    _compilex.set_dispatch_hook(_hook)
+    return st.dir
+
+
+def autotune_dir():
+    """The active winner-store directory, or None when disabled."""
+    return None if _store is None else _store.dir
+
+
+def active_store():
+    return _store
+
+
+def applied_count():
+    """Total winner applications this process (all executables)."""
+    return sum(int(c.value) for c in _reg().series("tune_applied"))
+
+
+# env wiring: MXTPU_AUTOTUNE=<dir> points at an explicit store;
+# MXTPU_AUTOTUNE=1 enables with the resolved default (MXTPU_TUNE_DIR or
+# the compilation cache dir). Same import-time pattern as
+# MXTPU_COMPILE_CACHE — a fleet worker opts in with no code change.
+_env_val = os.environ.get("MXTPU_AUTOTUNE", "")
+if _env_val and _env_val not in ("0", "off", "false"):
+    try:
+        set_autotune(None if _env_val in ("1", "on", "true") else _env_val)
+    except Exception:
+        pass                      # never break import on a bad store dir

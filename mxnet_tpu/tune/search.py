@@ -1,0 +1,445 @@
+"""Measured compile-space search (ISSUE 20): score candidates, guard
+them, pick a winner.
+
+A *candidate* is one point in the compile space: a Pallas knob dict
+(tune/overrides.py names) plus an allowlisted XLA ``compiler_options``
+dict. The *baseline* — empty on both axes — is always candidate zero,
+so the winner is >= baseline on the measured metric BY CONSTRUCTION.
+
+Scoring: median warm wall time of `trials` dispatches (per-trial fresh
+donated buffers, `block_until_ready` fenced). The check_fusion HLO
+counters are the tie-breaker AND the hard guard:
+
+  guard 1 (budget)      an explicit (lo, hi)/exact budget table — the
+                        check_fusion.BUDGETS row for gated executables —
+                        must hold on the candidate's optimized HLO;
+  guard 2 (regression)  relative to the measured BASELINE structure:
+                        more copies, more collectives, or fewer aliased
+                        (donated-in-place) inputs than baseline rejects
+                        the candidate regardless of speed;
+  guard 3 (numerics)    candidate outputs vs baseline outputs on
+                        identical inputs, per the executable's declared
+                        contract (`tune.register_contract`): bitwise
+                        for greedy decode, documented fp tolerance for
+                        training steps;
+  guard 4 (dead knobs)  a candidate whose Pallas override was IGNORED
+                        by the kernel pickers (doesn't divide, wrong
+                        granularity — `pallas_block_override_ignored`
+                        grew during its compile) is measuring the
+                        default config under a wrong label: rejected.
+
+Near-ties (within `TIE_BAND` of the best median) resolve by HLO
+structure — fewer copies, then fewer fusions, then baseline-first — so
+a flag that only shrinks the graph still wins when wall time is noise.
+
+The XLA flag allowlist is CURATED: every entry is a scalar DebugOption
+verified to ride `compiled = lowered.compile(compiler_options=...)`
+on the pinned toolchain (repeated-field flags like
+``xla_disable_hlo_passes`` cannot — jax's env_option_overrides carries
+scalars only). The guard, not the allowlist, is what keeps a flag
+honest: ``xla_cpu_multi_thread_eigen=False`` really builds (and really
+gets rejected for inflating copies).
+"""
+from __future__ import annotations
+
+import math
+import statistics
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from time import perf_counter
+
+from . import apply as _apply
+from . import overrides as _overrides
+
+__all__ = ["Candidate", "Workload", "SearchResult", "CandidateResult",
+           "search", "capture_workload", "default_flag_candidates",
+           "check_budget", "XLA_FLAG_ALLOWLIST", "TIE_BAND"]
+
+# near-tie band for the structural tie-breaker: medians within 2% are
+# timing noise on the CPU mesh (and on a busy TPU host)
+TIE_BAND = 0.02
+
+# scalar DebugOptions verified compilable per-executable on the pinned
+# toolchain (jax 0.4.37 / jaxlib 0.4.36); values are the NON-DEFAULT
+# setting a flag candidate toggles to
+XLA_FLAG_ALLOWLIST = {
+    "xla_cpu_copy_insertion_use_region_analysis": True,
+    "xla_cpu_enable_fast_min_max": True,
+    "xla_cpu_enable_concurrency_optimized_scheduler": True,
+    "xla_cpu_multi_thread_eigen": False,
+    "xla_backend_optimization_level": 2,
+    "xla_llvm_disable_expensive_passes": True,
+    "xla_tpu_enable_latency_hiding_scheduler": True,   # TPU-only
+}
+
+# flags meaningless off their platform (compiling with them raises on
+# the other backend); keyed by jax.default_backend() prefix
+_PLATFORM_ONLY = {"xla_cpu_": "cpu", "xla_tpu_": "tpu"}
+
+
+@dataclass(frozen=True)
+class Candidate:
+    """One compile-space point. `pallas` uses tune/overrides.py knob
+    names; `flags` is an XLA compiler_options dict."""
+    name: str
+    pallas: dict = field(default_factory=dict)
+    flags: dict = field(default_factory=dict)
+
+    @property
+    def is_baseline(self):
+        return not self.pallas and not self.flags
+
+
+@dataclass
+class CandidateResult:
+    candidate: Candidate
+    score_ms: float = math.inf
+    trial_ms: list = field(default_factory=list)
+    hlo: dict = None
+    rejected: str = None           # guard-rejection reason, None = OK
+    compile_s: float = 0.0
+
+
+@dataclass
+class SearchResult:
+    executable: str
+    platform: str
+    shape_class: str
+    baseline: CandidateResult
+    winner: CandidateResult
+    candidates: list
+    trials: int
+
+    @property
+    def improved(self):
+        return not self.winner.candidate.is_baseline
+
+    @property
+    def speedup(self):
+        if self.winner.score_ms <= 0:
+            return 1.0
+        return self.baseline.score_ms / self.winner.score_ms
+
+    def winner_entry(self):
+        """The TuneStore entry for the winner, or None when the
+        baseline won (nothing to persist — defaults ARE the winner)."""
+        if not self.improved:
+            return None
+        w = self.winner
+        return {
+            "executable": self.executable,
+            "platform": self.platform,
+            "shape_class": self.shape_class,
+            "plan": _apply.plan_signature(self.executable),
+            "pallas": dict(w.candidate.pallas),
+            "flags": dict(w.candidate.flags),
+            "score_ms": round(w.score_ms, 6),
+            "baseline_ms": round(self.baseline.score_ms, 6),
+            "trials": self.trials,
+            "hlo": {k: w.hlo.get(k) for k in
+                    ("fusions", "copies", "collective_total",
+                     "aliased_inputs")} if w.hlo else {},
+        }
+
+
+class Workload:
+    """What the search needs from one executable:
+
+    ij           the InstrumentedJit to tune
+    executable   its compilex name (budget table / store key)
+    make_args()  -> (args, kwargs) with FRESH device buffers of
+                 identical values on every call — donated inputs are
+                 consumed per dispatch, and the numerics guard compares
+                 candidate outputs on equal inputs
+    contract     numerics contract override; None reads the
+                 `tune.register_contract` registry for the executable
+    """
+
+    def __init__(self, ij, make_args, executable=None, contract=None):
+        self.ij = ij
+        self.make_args = make_args
+        self.executable = executable or ij.executable
+        self._contract = contract
+
+    @property
+    def contract(self):
+        return self._contract or _apply.contract_for(self.executable)
+
+
+class _Snap:
+    """Host snapshot of one argument leaf (an opaque pytree LEAF — a
+    tuple here would be descended into by tree_map). Taken BEFORE the
+    recorded dispatch executes, so donation has not consumed the
+    buffer; the sharding rides along so replay compiles the same
+    layout."""
+    __slots__ = ("kind", "val", "sharding")
+
+    def __init__(self, x):
+        import jax
+        import numpy as np
+        self.sharding = None
+        if isinstance(x, jax.Array):
+            try:
+                self.kind, self.val = "arr", np.asarray(x)
+                self.sharding = x.sharding
+            except Exception:
+                self.kind, self.val = "live", x   # exotic dtype: keep
+                                                  # the object (never a
+                                                  # donated buffer here)
+        else:
+            self.kind, self.val = "py", x
+
+    def replay(self):
+        import jax
+        if self.kind != "arr":
+            return self.val
+        try:
+            return jax.device_put(self.val, self.sharding)
+        except Exception:
+            return jax.device_put(self.val)
+
+
+@contextmanager
+def capture_workload(*executables):
+    """Record the NEXT dispatch of each named compilex executable into a
+    replayable Workload: the InstrumentedJit plus host snapshots of its
+    concrete arguments, so `make_args()` rebuilds fresh donated buffers
+    with identical values for every trial. Yields a dict the caller
+    reads AFTER driving one real step/turn:
+
+        with capture_workload("captured_step") as caught:
+            trainer_step(batch)          # the dispatch being recorded
+        wl = caught["captured_step"]
+
+    Stacks on top of an existing dispatch hook (autotune apply), which
+    keeps running underneath."""
+    import jax
+    from ..observability import compilex as _compilex
+
+    want = set(executables)
+    caught = {}
+    prev = _compilex.dispatch_hook()
+
+    def _rec(ij, args, kwargs):
+        if ij.executable in want and ij.executable not in caught:
+            snaps = jax.tree_util.tree_map(_Snap, (args, dict(kwargs)))
+
+            def make_args(_snaps=snaps):
+                return jax.tree_util.tree_map(
+                    lambda s: s.replay(), _snaps,
+                    is_leaf=lambda s: isinstance(s, _Snap))
+
+            caught[ij.executable] = Workload(ij, make_args)
+        if prev is not None:
+            return prev(ij, args, kwargs)
+        return False, None
+
+    _compilex.set_dispatch_hook(_rec)
+    try:
+        yield caught
+    finally:
+        _compilex.set_dispatch_hook(prev)
+
+
+def default_flag_candidates(platform=None):
+    """One single-flag candidate per allowlisted flag valid on this
+    platform — the curated XLA dimension of the search space."""
+    if platform is None:
+        import jax
+        platform = jax.default_backend()
+    cands = []
+    for flag, val in XLA_FLAG_ALLOWLIST.items():
+        only = next((p for pre, p in _PLATFORM_ONLY.items()
+                     if flag.startswith(pre)), None)
+        if only is not None and only != platform:
+            continue
+        cands.append(Candidate(name=f"flag:{flag}={val}",
+                               flags={flag: val}))
+    return cands
+
+
+def check_budget(info, budget):
+    """check_fusion-style budget check: (lo, hi) bands inclusive, dicts
+    compared per-op exactly, scalars exactly. Returns violation strings
+    (empty = within budget). Mirrors tools/check_fusion.check_budget so
+    the guard and the gate agree on semantics without the library
+    importing from tools/."""
+    errs = []
+    for key, want in (budget or {}).items():
+        got = info.get(key)
+        if isinstance(want, tuple) and len(want) == 2:
+            lo, hi = want
+            if not (lo <= got <= hi):
+                errs.append(f"{key}={got} outside [{lo}, {hi}]")
+        elif isinstance(want, dict):
+            if dict(got or {}) != dict(want):
+                errs.append(f"{key}={got} != {want}")
+        elif got != want:
+            errs.append(f"{key}={got} != {want}")
+    return errs
+
+
+def _ignored_override_count():
+    from ..observability.metrics_registry import registry
+    return sum(int(c.value) for c in
+               registry().series("pallas_block_override_ignored"))
+
+
+def _leaves(tree):
+    import jax
+    return jax.tree_util.tree_leaves(tree)
+
+
+def _numerics_ok(ref, out, contract):
+    import numpy as np
+    rl, ol = _leaves(ref), _leaves(out)
+    if len(rl) != len(ol):
+        return False, "output structure differs"
+    for i, (r, o) in enumerate(zip(rl, ol)):
+        r = np.asarray(r)
+        o = np.asarray(o)
+        if r.shape != o.shape or r.dtype != o.dtype:
+            return False, f"leaf {i} shape/dtype differs"
+        if contract[0] == "bitwise":
+            if not np.array_equal(r, o, equal_nan=True):
+                return False, f"leaf {i} not bitwise-equal"
+        else:
+            _, rtol, atol = contract
+            if not np.allclose(r, o, rtol=rtol, atol=atol,
+                               equal_nan=True):
+                worst = float(np.max(np.abs(
+                    r.astype("float64") - o.astype("float64"))))
+                return False, (f"leaf {i} outside tolerance "
+                               f"(rtol={rtol}, atol={atol}, "
+                               f"max_abs_diff={worst:.3g})")
+    return True, None
+
+
+def _time_trials(compiled, make_args, trials, warmup=1):
+    import jax
+    times = []
+    for t in range(warmup + trials):
+        args, kwargs = make_args()
+        t0 = perf_counter()
+        jax.block_until_ready(compiled(*args, **kwargs))
+        dt = (perf_counter() - t0) * 1e3
+        if t >= warmup:
+            times.append(dt)
+    return times
+
+
+def search(workload, candidates=None, trials=5, budget=None,
+           log=None):
+    """Run the measured search for one workload; returns SearchResult.
+
+    `candidates` defaults to the platform's flag allowlist; the
+    baseline is always prepended. `budget` is an optional
+    check_fusion-style table applied as guard 1 (the CLI passes the
+    BUDGETS row of gated executables). `log` is an optional callable
+    for progress lines."""
+    import jax
+    platform = jax.default_backend()
+    ij = workload.ij
+    log = log or (lambda s: None)
+    if candidates is None:
+        candidates = default_flag_candidates(platform)
+    cands = [Candidate("baseline")] + [c for c in candidates
+                                       if not c.is_baseline]
+    args0, kwargs0 = workload.make_args()
+    sclass = _apply.shape_class(args0, kwargs0)
+    contract = workload.contract
+
+    results = []
+    base = None
+    ref_out = None
+    for cand in cands:
+        rec = CandidateResult(candidate=cand)
+        results.append(rec)
+        entry = {"pallas": cand.pallas, "flags": cand.flags}
+        ignored0 = _ignored_override_count()
+        t0 = perf_counter()
+        try:
+            args, kwargs = workload.make_args()
+            compiled, info = _apply.compile_winner(ij, args, kwargs,
+                                                   entry)
+        except Exception as e:
+            rec.rejected = f"compile_error: {e!r}"
+            log(f"  {cand.name}: REJECTED ({rec.rejected})")
+            if cand.is_baseline:
+                raise RuntimeError(
+                    f"baseline compile failed for {workload.executable}"
+                ) from e
+            continue
+        rec.compile_s = perf_counter() - t0
+        rec.hlo = info
+        # guard 4: a Pallas candidate whose override the kernel pickers
+        # ignored is mislabelled default-config — reject, don't mislead
+        if cand.pallas and _ignored_override_count() > ignored0:
+            rec.rejected = "dead_pallas_override"
+            log(f"  {cand.name}: REJECTED ({rec.rejected})")
+            continue
+        # guard 1: explicit budget table (gated executables)
+        errs = check_budget(info, budget)
+        if errs:
+            rec.rejected = "budget: " + "; ".join(errs)
+            log(f"  {cand.name}: REJECTED ({rec.rejected})")
+            if cand.is_baseline:
+                # the DEFAULT build breaking its own gate budget is a
+                # config error, not a candidate to tune around
+                raise RuntimeError(
+                    f"baseline of {workload.executable} breaks its "
+                    f"budget: {rec.rejected}")
+            continue
+        # guard 2: structural regression vs the measured baseline
+        if base is not None and base.hlo:
+            b = base.hlo
+            if info["copies"] > b["copies"]:
+                rec.rejected = (f"hlo_regression: copies "
+                                f"{info['copies']} > {b['copies']}")
+            elif info["collective_total"] > b["collective_total"]:
+                rec.rejected = (f"hlo_regression: collectives "
+                                f"{info['collective_total']} > "
+                                f"{b['collective_total']}")
+            elif info["aliased_inputs"] < b["aliased_inputs"]:
+                rec.rejected = (f"hlo_regression: aliased_inputs "
+                                f"{info['aliased_inputs']} < "
+                                f"{b['aliased_inputs']}")
+            if rec.rejected:
+                log(f"  {cand.name}: REJECTED ({rec.rejected})")
+                continue
+        # guard 3: numerics vs baseline outputs on identical inputs
+        import numpy as np
+        args, kwargs = workload.make_args()
+        out = compiled(*args, **kwargs)
+        if cand.is_baseline:
+            ref_out = jax.tree_util.tree_map(np.asarray, out)
+        else:
+            ok, why = _numerics_ok(ref_out, out, contract)
+            if not ok:
+                rec.rejected = f"numerics[{contract[0]}]: {why}"
+                log(f"  {cand.name}: REJECTED ({rec.rejected})")
+                continue
+        del out
+        rec.trial_ms = _time_trials(compiled, workload.make_args,
+                                    trials)
+        rec.score_ms = statistics.median(rec.trial_ms)
+        if cand.is_baseline:
+            base = rec
+        log(f"  {cand.name}: median={rec.score_ms:.3f}ms "
+            f"copies={info['copies']} fusions={info['fusions']}")
+
+    accepted = [r for r in results if r.rejected is None]
+    best_ms = min(r.score_ms for r in accepted)
+    near = [r for r in accepted
+            if r.score_ms <= best_ms * (1.0 + TIE_BAND)]
+    # structural tie-breaker; baseline-first on full structural ties
+    # (results order has baseline at index 0, min() is stable)
+    winner = min(near, key=lambda r: (r.hlo["copies"], r.hlo["fusions"],
+                                      r.hlo["module_bytes"]))
+    # leave the published gauges describing the WINNER's structure (the
+    # per-candidate compiles walked them through every config)
+    _apply._publish(ij, winner.hlo)
+    return SearchResult(executable=workload.executable,
+                        platform=platform, shape_class=sclass,
+                        baseline=base, winner=winner,
+                        candidates=results, trials=trials)

@@ -135,14 +135,24 @@ def test_paged_attention_lax_matches_shared_math():
     assert np.array_equal(np.asarray(out), np.asarray(ref))
 
 
-def test_paged_attention_kernel_interpret(monkeypatch):
+@pytest.mark.parametrize("cfg", [{}, {"rpa_block_k": 8}],
+                         ids=["default", "block_k=8"])
+def test_paged_attention_kernel_interpret(monkeypatch, cfg):
     """The Pallas ragged-paged kernel numerics, pinned on CPU via
-    interpret mode (same harness as the flash-kernel tests)."""
+    interpret mode (same harness as the flash-kernel tests) — at the
+    default block config AND under the ISSUE 20 `rpa_block_k` tuning
+    knob (psize=16 fixture so a sub-page tile is legal): every
+    reachable block config must reproduce the lax fallback."""
     monkeypatch.setenv("MXTPU_PALLAS_INTERPRET", "1")
     from mxnet_tpu.ops.pallas_kernels import (_paged_attention_lax,
                                               ragged_paged_attention)
-    q, kp, vp, pt, lens = _paged_fixture()
-    out_k = ragged_paged_attention(q, kp, vp, pt, lens)
+    from mxnet_tpu.tune import overrides
+    q, kp, vp, pt, lens = (_paged_fixture() if not cfg else
+                           _paged_fixture(psize=16))
+    if cfg:
+        lens = lens * 2              # reach into the second K block
+    with overrides.scope(cfg):
+        out_k = ragged_paged_attention(q, kp, vp, pt, lens)
     ref = _paged_attention_lax(q, kp, vp, pt, lens)
     np.testing.assert_allclose(np.asarray(out_k), np.asarray(ref),
                                rtol=2e-6, atol=2e-6)
@@ -1111,18 +1121,26 @@ def test_paged_attention_multi_rowwise_matches_single():
                                    rtol=2e-6, atol=2e-6, err_msg=str(i))
 
 
-def test_paged_attention_multi_kernel_interpret(monkeypatch):
+@pytest.mark.parametrize("cfg", [{}, {"rpa_sublanes": 16},
+                                 {"rpa_block_k": 8}],
+                         ids=["default", "sublanes=16", "block_k=8"])
+def test_paged_attention_multi_kernel_interpret(monkeypatch, cfg):
     """The widened Pallas kernel numerics, pinned on CPU via interpret
-    mode against the lax fallback (same harness as the 1-wide test)."""
+    mode against the lax fallback (same harness as the 1-wide test) —
+    at the default config AND under the ISSUE 20 tuning knobs (padded
+    query-sublane count, sub-page K tile)."""
     monkeypatch.setenv("MXTPU_PALLAS_INTERPRET", "1")
     import jax.numpy as jnp
     from mxnet_tpu.ops.pallas_kernels import (_paged_attention_lax_multi,
                                               ragged_paged_attention)
-    q1, kp, vp, pt, lens = _paged_fixture()
+    from mxnet_tpu.tune import overrides
+    q1, kp, vp, pt, lens = (_paged_fixture() if "rpa_block_k" not in cfg
+                            else _paged_fixture(psize=16))
     S, H, dh = q1.shape
     rng = np.random.RandomState(22)
     q = jnp.asarray(rng.randn(S, 4, H, dh).astype(np.float32))
-    out_k = ragged_paged_attention(q, kp, vp, pt, lens)
+    with overrides.scope(cfg):
+        out_k = ragged_paged_attention(q, kp, vp, pt, lens)
     ref = _paged_attention_lax_multi(q, kp, vp, pt, lens)
     np.testing.assert_allclose(np.asarray(out_k), np.asarray(ref),
                                rtol=2e-6, atol=2e-6)
